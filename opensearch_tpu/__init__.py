@@ -1,0 +1,32 @@
+"""opensearch_tpu — a TPU-native search & analytics engine.
+
+A from-scratch rebuild of the capabilities of OpenSearch (reference:
+/root/reference, Java/Lucene) designed TPU-first:
+
+- Host (Python): REST-style API, cluster state, mappings, analysis, the
+  write path (engine + translog), query planning.
+- Device (JAX/XLA/Pallas): query execution. Inverted-index segments live in
+  HBM as CSR posting blocks; BM25 scoring is a batched gather -> scatter-add
+  -> fused top-k instead of Lucene's per-doc scoring loop
+  (reference: lucene BulkScorer driven by
+  server/src/main/java/org/opensearch/search/query/QueryPhase.java).
+- Distribution: shards map onto a `jax.sharding.Mesh` axis; the coordinator
+  scatter/gather of reference
+  `action/search/TransportSearchAction.java` becomes `shard_map` with a
+  per-device top-k followed by an `all_gather` merge over ICI.
+"""
+
+from .version import __version__
+
+__all__ = ["__version__", "Node", "RestClient"]
+
+
+def __getattr__(name):
+    # lazy to keep `import opensearch_tpu` light and cycle-free
+    if name == "Node":
+        from .cluster.node import Node
+        return Node
+    if name == "RestClient":
+        from .rest.client import RestClient
+        return RestClient
+    raise AttributeError(name)

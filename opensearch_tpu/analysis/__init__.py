@@ -1,0 +1,4 @@
+from .analyzers import AnalysisRegistry, Analyzer
+from .tokenizers import Token
+
+__all__ = ["AnalysisRegistry", "Analyzer", "Token"]

@@ -1,0 +1,114 @@
+"""Analyzers and the analysis registry. Analog of reference
+`server/src/main/java/org/opensearch/index/analysis/AnalysisRegistry.java` and
+the built-in analyzers wired in `AnalysisModule`.
+
+An Analyzer = [char filters] -> tokenizer -> [token filters]. Custom analyzers
+are declared in index settings exactly like the reference:
+
+    {"analysis": {"analyzer": {"my": {"type": "custom", "tokenizer": "standard",
+                                       "filter": ["lowercase", "stop"]}}}}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List
+
+from .filters import (CharFilter, TokenFilter, lowercase_filter, make_stop_filter,
+                      porter_stem_filter, resolve_char_filter, resolve_token_filter)
+from .tokenizers import Token, keyword_tokenizer, resolve_tokenizer, standard_tokenizer, whitespace_tokenizer
+
+
+@dataclass
+class Analyzer:
+    name: str
+    tokenizer: Callable[[str], List[Token]]
+    token_filters: List[TokenFilter] = field(default_factory=list)
+    char_filters: List[CharFilter] = field(default_factory=list)
+
+    def analyze(self, text: str) -> List[Token]:
+        for cf in self.char_filters:
+            text = cf(text)
+        tokens = self.tokenizer(text)
+        for tf in self.token_filters:
+            tokens = tf(tokens)
+        return tokens
+
+    def terms(self, text: str) -> List[str]:
+        return [t.text for t in self.analyze(text)]
+
+
+def _builtin(name: str) -> Analyzer:
+    if name == "standard":
+        return Analyzer(name, standard_tokenizer, [lowercase_filter])
+    if name == "simple":
+        return Analyzer(name, resolve_tokenizer("lowercase"), [])
+    if name == "whitespace":
+        return Analyzer(name, whitespace_tokenizer, [])
+    if name == "keyword":
+        return Analyzer(name, keyword_tokenizer, [])
+    if name == "stop":
+        return Analyzer(name, resolve_tokenizer("lowercase"), [make_stop_filter()])
+    if name == "english":
+        # reference EnglishAnalyzerProvider: std -> lowercase -> stop -> porter
+        return Analyzer(name, standard_tokenizer,
+                        [lowercase_filter, make_stop_filter(), porter_stem_filter])
+    raise ValueError(f"unknown analyzer [{name}]")
+
+
+class AnalysisRegistry:
+    """Per-index analyzer registry built from index settings."""
+
+    def __init__(self, analysis_settings: dict | None = None):
+        self._settings = analysis_settings or {}
+        self._cache: dict[str, Analyzer] = {}
+
+    def get(self, name: str) -> Analyzer:
+        if name in self._cache:
+            return self._cache[name]
+        custom = self._settings.get("analyzer", {}).get(name)
+        if custom is not None:
+            ana = self._build_custom(name, custom)
+        else:
+            ana = _builtin(name)
+        self._cache[name] = ana
+        return ana
+
+    def normalizer(self, name: str | None) -> Analyzer:
+        """Keyword-field normalizers (reference: keyword normalizers are
+        analyzers without a tokenizer). `lowercase` builtin supported."""
+        if name is None:
+            return Analyzer("identity", keyword_tokenizer, [])
+        if name == "lowercase":
+            return Analyzer("lowercase", keyword_tokenizer, [lowercase_filter])
+        custom = self._settings.get("normalizer", {}).get(name)
+        if custom is not None:
+            filters = [self._resolve_filter(f) for f in custom.get("filter", [])]
+            chars = [self._resolve_char(f) for f in custom.get("char_filter", [])]
+            return Analyzer(name, keyword_tokenizer, filters, chars)
+        raise ValueError(f"unknown normalizer [{name}]")
+
+    def _resolve_filter(self, name: str) -> TokenFilter:
+        custom = self._settings.get("filter", {}).get(name)
+        if custom is not None:
+            return resolve_token_filter(custom["type"], custom)
+        return resolve_token_filter(name)
+
+    def _resolve_char(self, name: str) -> CharFilter:
+        custom = self._settings.get("char_filter", {}).get(name)
+        if custom is not None:
+            return resolve_char_filter(custom["type"], custom)
+        return resolve_char_filter(name)
+
+    def _build_custom(self, name: str, cfg: dict) -> Analyzer:
+        if cfg.get("type", "custom") != "custom":
+            return _builtin(cfg["type"])
+        tok_name = cfg.get("tokenizer", "standard")
+        tok_custom = self._settings.get("tokenizer", {}).get(tok_name)
+        if tok_custom is not None:
+            tokenizer = resolve_tokenizer(tok_custom["type"], tok_custom)
+        else:
+            tokenizer = resolve_tokenizer(tok_name)
+        filters = [self._resolve_filter(f) for f in cfg.get("filter", [])]
+        chars = [self._resolve_char(f) for f in cfg.get("char_filter", [])]
+        return Analyzer(name, tokenizer, filters, chars)

@@ -1,0 +1,201 @@
+"""Token filters and char filters. Analog of reference
+`modules/analysis-common` filter factories (lowercase, stop, stemmer,
+asciifolding, trim, length, shingle, synonym, unique, reverse, truncate) and
+char filters (html_strip, mapping, pattern_replace).
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from typing import Callable, Dict, List
+
+from .porter import porter_stem
+from .tokenizers import Token
+
+TokenFilter = Callable[[List[Token]], List[Token]]
+CharFilter = Callable[[str], str]
+
+# Lucene EnglishAnalyzer.ENGLISH_STOP_WORDS_SET
+ENGLISH_STOPWORDS = frozenset(
+    "a an and are as at be but by for if in into is it no not of on or such that "
+    "the their then there these they this to was will with".split()
+)
+
+
+def lowercase_filter(tokens: List[Token]) -> List[Token]:
+    return [Token(t.text.lower(), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def uppercase_filter(tokens: List[Token]) -> List[Token]:
+    return [Token(t.text.upper(), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def make_stop_filter(stopwords=ENGLISH_STOPWORDS) -> TokenFilter:
+    """Removes stopwords but preserves position gaps (like Lucene StopFilter
+    with enablePositionIncrements), so phrase queries stay correct."""
+    stopset = frozenset(stopwords)
+
+    def f(tokens: List[Token]) -> List[Token]:
+        return [t for t in tokens if t.text not in stopset]
+
+    return f
+
+
+def porter_stem_filter(tokens: List[Token]) -> List[Token]:
+    return [Token(porter_stem(t.text), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def asciifolding_filter(tokens: List[Token]) -> List[Token]:
+    def fold(s: str) -> str:
+        return unicodedata.normalize("NFKD", s).encode("ascii", "ignore").decode("ascii") or s
+
+    return [Token(fold(t.text), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def trim_filter(tokens: List[Token]) -> List[Token]:
+    return [Token(t.text.strip(), t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def unique_filter(tokens: List[Token]) -> List[Token]:
+    seen, out = set(), []
+    for t in tokens:
+        if t.text not in seen:
+            seen.add(t.text)
+            out.append(t)
+    return out
+
+
+def reverse_filter(tokens: List[Token]) -> List[Token]:
+    return [Token(t.text[::-1], t.position, t.start_offset, t.end_offset) for t in tokens]
+
+
+def make_length_filter(min_len: int = 0, max_len: int = 1 << 30) -> TokenFilter:
+    return lambda tokens: [t for t in tokens if min_len <= len(t.text) <= max_len]
+
+
+def make_truncate_filter(length: int = 10) -> TokenFilter:
+    return lambda tokens: [Token(t.text[:length], t.position, t.start_offset, t.end_offset)
+                           for t in tokens]
+
+
+def make_shingle_filter(min_size: int = 2, max_size: int = 2,
+                        separator: str = " ", output_unigrams: bool = True) -> TokenFilter:
+    def f(tokens: List[Token]) -> List[Token]:
+        out = list(tokens) if output_unigrams else []
+        for n in range(min_size, max_size + 1):
+            for i in range(len(tokens) - n + 1):
+                grp = tokens[i:i + n]
+                out.append(Token(separator.join(t.text for t in grp), grp[0].position,
+                                 grp[0].start_offset, grp[-1].end_offset))
+        out.sort(key=lambda t: (t.position, t.end_offset))
+        return out
+
+    return f
+
+
+def make_synonym_filter(synonyms: List[str]) -> TokenFilter:
+    """Solr-format synonym rules: "a, b => c" (replace) or "a, b, c" (expand).
+    Expansion emits extra tokens at the same position (like Lucene SynonymGraphFilter
+    for single-word synonyms; multi-word synonym graphs are a later round)."""
+    replace: Dict[str, List[str]] = {}
+    expand: Dict[str, List[str]] = {}
+    for rule in synonyms:
+        if "=>" in rule:
+            lhs, rhs = rule.split("=>")
+            targets = [w.strip() for w in rhs.split(",") if w.strip()]
+            for w in lhs.split(","):
+                replace[w.strip()] = targets
+        else:
+            group = [w.strip() for w in rule.split(",") if w.strip()]
+            for w in group:
+                expand[w] = group
+
+    def f(tokens: List[Token]) -> List[Token]:
+        out: List[Token] = []
+        for t in tokens:
+            if t.text in replace:
+                for w in replace[t.text]:
+                    out.append(Token(w, t.position, t.start_offset, t.end_offset))
+            elif t.text in expand:
+                for w in expand[t.text]:
+                    out.append(Token(w, t.position, t.start_offset, t.end_offset))
+            else:
+                out.append(t)
+        return out
+
+    return f
+
+
+# ---------------- char filters ----------------
+
+_HTML_TAG_RE = re.compile(r"<[^>]*>")
+
+
+def html_strip_char_filter(text: str) -> str:
+    import html
+
+    return html.unescape(_HTML_TAG_RE.sub(" ", text))
+
+
+def make_mapping_char_filter(mappings: List[str]) -> CharFilter:
+    """Rules like "ph => f"."""
+    pairs = []
+    for rule in mappings:
+        lhs, rhs = rule.split("=>")
+        pairs.append((lhs.strip(), rhs.strip()))
+
+    def f(text: str) -> str:
+        for a, b in pairs:
+            text = text.replace(a, b)
+        return text
+
+    return f
+
+
+def make_pattern_replace_char_filter(pattern: str, replacement: str = "") -> CharFilter:
+    compiled = re.compile(pattern)
+    return lambda text: compiled.sub(replacement, text)
+
+
+def resolve_token_filter(name: str, params: dict | None = None) -> TokenFilter:
+    params = params or {}
+    simple: Dict[str, TokenFilter] = {
+        "lowercase": lowercase_filter,
+        "uppercase": uppercase_filter,
+        "porter_stem": porter_stem_filter,
+        "stemmer": porter_stem_filter,
+        "asciifolding": asciifolding_filter,
+        "trim": trim_filter,
+        "unique": unique_filter,
+        "reverse": reverse_filter,
+    }
+    if name in simple:
+        return simple[name]
+    if name == "stop":
+        sw = params.get("stopwords", "_english_")
+        return make_stop_filter(ENGLISH_STOPWORDS if sw == "_english_" else sw)
+    if name == "length":
+        return make_length_filter(params.get("min", 0), params.get("max", 1 << 30))
+    if name == "truncate":
+        return make_truncate_filter(params.get("length", 10))
+    if name == "shingle":
+        return make_shingle_filter(params.get("min_shingle_size", 2),
+                                   params.get("max_shingle_size", 2),
+                                   params.get("token_separator", " "),
+                                   params.get("output_unigrams", True))
+    if name == "synonym":
+        return make_synonym_filter(params.get("synonyms", []))
+    raise ValueError(f"unknown token filter [{name}]")
+
+
+def resolve_char_filter(name: str, params: dict | None = None) -> CharFilter:
+    params = params or {}
+    if name == "html_strip":
+        return html_strip_char_filter
+    if name == "mapping":
+        return make_mapping_char_filter(params.get("mappings", []))
+    if name == "pattern_replace":
+        return make_pattern_replace_char_filter(params.get("pattern", ""),
+                                                params.get("replacement", ""))
+    raise ValueError(f"unknown char filter [{name}]")

@@ -1,0 +1,6 @@
+from .engine import Engine, VersionConflictError
+from .mappings import FieldType, Mappings, ParsedDocument
+from .segment import Segment, build_segment
+
+__all__ = ["Engine", "VersionConflictError", "Mappings", "FieldType",
+           "ParsedDocument", "Segment", "build_segment"]

@@ -1,0 +1,287 @@
+"""The index engine: buffered writes, realtime get, refresh, flush/commit,
+recovery. Analog of reference `index/engine/InternalEngine.java` +
+`index/shard/IndexShard.java`.
+
+Write path: parse → version/concurrency check → translog append → in-memory
+buffer. `refresh()` turns the buffer into an immutable device-resident
+Segment (the searchable unit). `flush()` persists segments + a commit point
+and rolls the translog. Opening an engine on an existing path recovers from
+the last commit point + translog replay (reference:
+InternalEngine#recoverFromTranslog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from .mappings import Mappings, ParsedDocument
+from .merge import TieredMergePolicy, merge_segments
+from .segment import Segment, build_segment
+from .translog import Translog
+
+
+class VersionConflictError(Exception):
+    """Analog of reference VersionConflictEngineException (HTTP 409)."""
+
+
+@dataclass
+class DocLocation:
+    seq_no: int
+    in_buffer: bool
+    segment: Optional[Segment] = None
+    local_doc: int = -1
+    buffer_idx: int = -1
+
+
+class Engine:
+    def __init__(self, mappings: Mappings, path: Optional[str] = None,
+                 merge_policy: Optional[TieredMergePolicy] = None,
+                 primary_term: int = 1):
+        self.mappings = mappings
+        self.path = path
+        self.merge_policy = merge_policy or TieredMergePolicy()
+        self.primary_term = primary_term
+        self.segments: List[Segment] = []
+        self.buffer: List[ParsedDocument] = []
+        self.buffer_seq: List[int] = []
+        self._buffer_ids: Dict[str, int] = {}
+        self.seq_no = -1
+        self._seg_counter = 0
+        self.version_map: Dict[str, DocLocation] = {}
+        self._tombstones: Dict[str, int] = {}
+        self.translog: Optional[Translog] = None
+        self.last_commit_gen = 0
+        self.stats = {"index_ops": 0, "delete_ops": 0, "refreshes": 0,
+                      "flushes": 0, "merges": 0}
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._recover()
+
+    # ---------------- write path ----------------
+
+    def _next_seq(self) -> int:
+        self.seq_no += 1
+        return self.seq_no
+
+    def _check_concurrency(self, doc_id: str, if_seq_no: Optional[int],
+                           if_primary_term: Optional[int], op: str) -> None:
+        if if_seq_no is None and if_primary_term is None:
+            return
+        loc = self.version_map.get(doc_id)
+        cur = loc.seq_no if loc else -1
+        if if_seq_no is not None and cur != if_seq_no:
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
+                f"current document has seqNo [{cur}] ({op})")
+        if if_primary_term is not None and self.primary_term != if_primary_term:
+            raise VersionConflictError(
+                f"[{doc_id}]: version conflict on primary term ({op})")
+
+    def index_doc(self, doc_id: str, source: dict, routing: Optional[str] = None,
+                  if_seq_no: Optional[int] = None, if_primary_term: Optional[int] = None,
+                  op_type: str = "index", translog_op: bool = True) -> dict:
+        self._check_concurrency(doc_id, if_seq_no, if_primary_term, "index")
+        existed = doc_id in self.version_map
+        if op_type == "create" and existed:
+            raise VersionConflictError(f"[{doc_id}]: document already exists")
+        parsed = self.mappings.parse(doc_id, source, routing)
+        seq = self._next_seq()
+        if translog_op and self.translog is not None:
+            self.translog.add_index(doc_id, source, routing, seq)
+        self._delete_previous(doc_id)
+        self._buffer_ids[doc_id] = len(self.buffer)
+        self.buffer.append(parsed)
+        self.buffer_seq.append(seq)
+        self.version_map[doc_id] = DocLocation(seq, in_buffer=True,
+                                               buffer_idx=len(self.buffer) - 1)
+        self._tombstones.pop(doc_id, None)
+        self.stats["index_ops"] += 1
+        return {"_id": doc_id, "_seq_no": seq, "_primary_term": self.primary_term,
+                "result": "updated" if existed else "created"}
+
+    def delete_doc(self, doc_id: str, if_seq_no: Optional[int] = None,
+                   if_primary_term: Optional[int] = None, translog_op: bool = True) -> dict:
+        self._check_concurrency(doc_id, if_seq_no, if_primary_term, "delete")
+        found = doc_id in self.version_map
+        seq = self._next_seq()
+        if translog_op and self.translog is not None:
+            self.translog.add_delete(doc_id, seq)
+        if found:
+            self._delete_previous(doc_id)
+            del self.version_map[doc_id]
+            self._tombstones[doc_id] = seq
+        self.stats["delete_ops"] += 1
+        return {"_id": doc_id, "_seq_no": seq, "_primary_term": self.primary_term,
+                "result": "deleted" if found else "not_found"}
+
+    def _delete_previous(self, doc_id: str) -> None:
+        loc = self.version_map.get(doc_id)
+        if loc is None:
+            return
+        if loc.in_buffer:
+            idx = self._buffer_ids.pop(doc_id, None)
+            if idx is not None:
+                # tombstone the buffered doc (compacted away at refresh)
+                self.buffer[idx] = None
+        else:
+            loc.segment.delete_doc(loc.local_doc)
+
+    # ---------------- realtime get ----------------
+
+    def get(self, doc_id: str) -> Optional[dict]:
+        """Realtime get through the version map (reference: InternalEngine#get
+        refreshes-on-demand; our buffer is directly readable so no refresh)."""
+        loc = self.version_map.get(doc_id)
+        if loc is None:
+            return None
+        if loc.in_buffer:
+            parsed = self.buffer[loc.buffer_idx]
+            return {"_id": doc_id, "_source": parsed.source, "_seq_no": loc.seq_no,
+                    "_primary_term": self.primary_term, "found": True}
+        return {"_id": doc_id, "_source": loc.segment.sources[loc.local_doc],
+                "_seq_no": loc.seq_no, "_primary_term": self.primary_term, "found": True}
+
+    # ---------------- refresh / merge / flush ----------------
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.live_count for s in self.segments) + \
+            sum(1 for d in self.buffer if d is not None)
+
+    def refresh(self) -> bool:
+        live_docs = [(d, s) for d, s in zip(self.buffer, self.buffer_seq) if d is not None]
+        self.buffer = []
+        self.buffer_seq = []
+        self._buffer_ids = {}
+        if not live_docs:
+            return False
+        docs = [d for d, _ in live_docs]
+        seqs = [s for _, s in live_docs]
+        name = f"_{self._seg_counter}"
+        self._seg_counter += 1
+        seg = build_segment(name, docs, self.mappings, seq_nos=seqs)
+        self.segments.append(seg)
+        for local, d in enumerate(docs):
+            self.version_map[d.doc_id] = DocLocation(
+                seqs[local], in_buffer=False, segment=seg, local_doc=local)
+        self.stats["refreshes"] += 1
+        self.maybe_merge()
+        return True
+
+    def maybe_merge(self) -> None:
+        for group in self.merge_policy.find_merges(self.segments):
+            if len(group) < 2 and not any(s.live_count < s.ndocs for s in group):
+                continue
+            self.force_merge_group(group)
+
+    def force_merge_group(self, group: List[Segment]) -> Segment:
+        name = f"_m{self._seg_counter}"
+        self._seg_counter += 1
+        merged = merge_segments(name, group)
+        group_set = set(id(s) for s in group)
+        self.segments = [s for s in self.segments if id(s) not in group_set]
+        self.segments.append(merged)
+        for local, doc_id in enumerate(merged.ids):
+            loc = self.version_map.get(doc_id)
+            if loc is not None and not loc.in_buffer:
+                self.version_map[doc_id] = DocLocation(
+                    int(merged.seq_nos[local]), in_buffer=False,
+                    segment=merged, local_doc=local)
+        self.stats["merges"] += 1
+        return merged
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        if len(self.segments) > max_num_segments:
+            self.force_merge_group(list(self.segments))
+
+    def flush(self) -> None:
+        """Durable commit: segments to disk + commit point, translog rolled
+        (reference: InternalEngine#flush -> Lucene commit + translog trim)."""
+        self.refresh()
+        if self.path is None:
+            return
+        seg_dir = os.path.join(self.path, "segments")
+        committed = []
+        for seg in self.segments:
+            d = os.path.join(seg_dir, seg.name)
+            if not os.path.exists(os.path.join(d, "meta.json")):
+                seg.save(d)
+            else:
+                # persist up-to-date live masks for previously saved segments
+                import numpy as np
+                seg.save(d)
+            committed.append(seg.name)
+        gen = self.translog.rollover() if self.translog else 0
+        commit = {"segments": committed, "seq_no": self.seq_no,
+                  "translog_gen": gen, "primary_term": self.primary_term,
+                  "ts": time.time()}
+        tmp = os.path.join(self.path, "commit.json.tmp")
+        with open(tmp, "w") as fh:
+            json.dump(commit, fh)
+        os.replace(tmp, os.path.join(self.path, "commit.json"))
+        if self.translog:
+            self.translog.prune_below(gen)
+        self.last_commit_gen = gen
+        self.stats["flushes"] += 1
+
+    # ---------------- recovery ----------------
+
+    def _recover(self) -> None:
+        commit_path = os.path.join(self.path, "commit.json")
+        translog_dir = os.path.join(self.path, "translog")
+        gen = 0
+        if os.path.exists(commit_path):
+            with open(commit_path) as fh:
+                commit = json.load(fh)
+            for name in commit["segments"]:
+                seg = Segment.load(os.path.join(self.path, "segments", name))
+                self.segments.append(seg)
+                num = int(name.lstrip("_m").lstrip("_") or 0)
+                self._seg_counter = max(self._seg_counter, num + 1)
+                for local, doc_id in enumerate(seg.ids):
+                    if seg.live[local]:
+                        self.version_map[doc_id] = DocLocation(
+                            int(seg.seq_nos[local]), in_buffer=False,
+                            segment=seg, local_doc=local)
+            self.seq_no = commit["seq_no"]
+            gen = commit["translog_gen"]
+            self.primary_term = commit.get("primary_term", 1)
+        self.translog = Translog(translog_dir, generation=gen)
+        replayed = 0
+        for rec in self.translog.replay_from(gen):
+            if rec["seq_no"] <= self.seq_no and os.path.exists(commit_path):
+                continue
+            if rec["op"] == "index":
+                self.index_doc(rec["_id"], rec["_source"], rec.get("routing"),
+                               translog_op=False)
+            else:
+                self.delete_doc(rec["_id"], translog_op=False)
+            replayed += 1
+        if replayed:
+            self.refresh()
+
+    # ---------------- index-wide stats for scoring ----------------
+
+    def field_stats(self, field: str):
+        """Index-wide (doc_count, sum_dl, total_docs) for BM25 avgdl/idf —
+        the analog of Lucene CollectionStatistics aggregated across leaves."""
+        doc_count = 0
+        sum_dl = 0
+        for s in self.segments:
+            st = s.text_stats.get(field)
+            if st:
+                doc_count += st.doc_count
+                sum_dl += st.sum_dl
+        return doc_count, sum_dl
+
+    def doc_freq(self, field: str, term: str) -> int:
+        return sum(s.postings[field].doc_freq(term)
+                   for s in self.segments if field in s.postings)
+
+    def close(self) -> None:
+        if self.translog:
+            self.translog.close()

@@ -1,0 +1,387 @@
+"""Field mappings and document parsing. Analog of reference
+`server/src/main/java/org/opensearch/index/mapper/` (MapperService,
+DocumentMapper, TextFieldMapper, KeywordFieldMapper, NumberFieldMapper,
+DateFieldMapper, BooleanFieldMapper, IpFieldMapper, GeoPointFieldMapper,
+ObjectMapper, FieldAliasMapper, dynamic templates).
+
+Documents are parsed on the host into per-field term lists (indexed fields)
+and doc-value scalars (columnar fields); the device only ever sees integer
+term rows and numeric columns.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import ipaddress
+import numbers
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..analysis import AnalysisRegistry, Analyzer
+
+TEXT_TYPES = {"text"}
+KEYWORD_TYPES = {"keyword", "ip"}
+INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean"}
+FLOAT_TYPES = {"double", "float", "half_float"}
+NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
+GEO_TYPES = {"geo_point"}
+
+
+@dataclass
+class FieldType:
+    name: str
+    type: str
+    analyzer: str = "standard"
+    search_analyzer: Optional[str] = None
+    normalizer: Optional[str] = None
+    index: bool = True
+    doc_values: bool = True
+    store: bool = False
+    null_value: Any = None
+    ignore_above: Optional[int] = None
+    copy_to: List[str] = dc_field(default_factory=list)
+    date_format: Optional[str] = None
+    boost: float = 1.0
+    # text fields keep norms (doc length) unless disabled; keyword fields never
+    norms: bool = True
+    subfields: Dict[str, "FieldType"] = dc_field(default_factory=dict)
+
+    @property
+    def is_indexed_terms(self) -> bool:
+        return self.index and (self.type in TEXT_TYPES or self.type in KEYWORD_TYPES)
+
+    @property
+    def has_norms(self) -> bool:
+        return self.type in TEXT_TYPES and self.norms
+
+
+def _parse_date(value: Any, fmt: Optional[str]) -> int:
+    """Parse a date into epoch millis (reference DateFieldMapper; default
+    format `strict_date_optional_time||epoch_millis`)."""
+    if isinstance(value, bool):
+        raise ValueError(f"cannot parse date from boolean [{value}]")
+    if isinstance(value, numbers.Number):
+        return int(value)
+    s = str(value).strip()
+    if fmt == "epoch_second":
+        return int(float(s) * 1000)
+    if s.isdigit() or (s[:1] == "-" and s[1:].isdigit()):
+        return int(s)
+    iso = s.replace("Z", "+00:00")
+    try:
+        dt = _dt.datetime.fromisoformat(iso)
+    except ValueError:
+        for f in ("%Y/%m/%d", "%Y/%m/%d %H:%M:%S", "%d-%m-%Y", "%m/%d/%Y"):
+            try:
+                dt = _dt.datetime.strptime(s, f)
+                break
+            except ValueError:
+                continue
+        else:
+            raise ValueError(f"failed to parse date field [{s}]")
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return int(dt.timestamp() * 1000)
+
+
+def _ip_to_int(value: str) -> int:
+    """IPs index as integers (v4 mapped into v6 space like Lucene InetAddressPoint)."""
+    ip = ipaddress.ip_address(value)
+    if isinstance(ip, ipaddress.IPv4Address):
+        ip = ipaddress.IPv6Address(f"::ffff:{value}")
+    return int(ip)
+
+
+def coerce_value(ft: FieldType, value: Any):
+    """Coerce a raw JSON value to the column representation: ints for the long
+    family (dates→millis, bool→0/1, ip→int), floats for the float family."""
+    t = ft.type
+    if t == "date":
+        return _parse_date(value, ft.date_format)
+    if t == "boolean":
+        if isinstance(value, str):
+            if value in ("true", "True"):
+                return 1
+            if value in ("false", "False", ""):
+                return 0
+            raise ValueError(f"cannot parse boolean [{value}]")
+        return 1 if bool(value) else 0
+    if t == "ip":
+        return _ip_to_int(str(value))
+    if t in INT_TYPES:
+        iv = int(value)
+        limits = {"long": 63, "integer": 31, "short": 15, "byte": 7}
+        bits = limits.get(t, 63)
+        if not (-(1 << bits)) <= iv < (1 << bits):
+            raise ValueError(f"value [{value}] out of range for field type [{t}]")
+        return iv
+    if t in FLOAT_TYPES:
+        return float(value)
+    raise ValueError(f"cannot coerce for type [{t}]")
+
+
+@dataclass
+class ParsedDocument:
+    """Index-ready view of one document (analog of reference ParsedDocument)."""
+
+    doc_id: str
+    source: dict
+    routing: Optional[str]
+    # field -> list of terms (text: analyzed tokens incl. duplicates for tf;
+    # keyword: normalized exact values)
+    terms: Dict[str, List[str]] = dc_field(default_factory=dict)
+    # field -> list of (term, position) for positional indexes
+    positions: Dict[str, List[Tuple[str, int]]] = dc_field(default_factory=dict)
+    # field -> list of numeric values (column stores the first; extra values
+    # still participate in term-style matching for the long family)
+    numerics: Dict[str, List[Any]] = dc_field(default_factory=dict)
+    # field -> list of keyword strings for doc values (terms agg / sort)
+    keywords: Dict[str, List[str]] = dc_field(default_factory=dict)
+    # field -> list of (lat, lon)
+    geos: Dict[str, List[Tuple[float, float]]] = dc_field(default_factory=dict)
+
+
+class Mappings:
+    """Per-index mappings with dynamic mapping (reference MapperService).
+
+    Construction takes the `{"properties": {...}}` mapping dict; unseen fields
+    encountered at parse time are dynamically mapped (string→text+`.keyword`
+    subfield, int→long, float→double, bool→boolean, dict→object) exactly like
+    the reference's default dynamic rules.
+    """
+
+    def __init__(self, mapping: dict | None = None, analysis: AnalysisRegistry | None = None,
+                 dynamic: bool | str = True):
+        self.analysis = analysis or AnalysisRegistry()
+        self.fields: Dict[str, FieldType] = {}
+        self.aliases: Dict[str, str] = {}
+        self.dynamic = dynamic
+        self.dynamic_templates: List[dict] = []
+        self._meta: dict = {}
+        if mapping:
+            self.merge(mapping)
+
+    # ---------------- mapping CRUD ----------------
+
+    def merge(self, mapping: dict) -> None:
+        if "dynamic" in mapping:
+            self.dynamic = mapping["dynamic"]
+        if "_meta" in mapping:
+            self._meta.update(mapping["_meta"])
+        self.dynamic_templates.extend(mapping.get("dynamic_templates", []))
+        self._merge_props(mapping.get("properties", {}), prefix="")
+
+    def _merge_props(self, props: dict, prefix: str) -> None:
+        for name, cfg in props.items():
+            path = f"{prefix}{name}"
+            ftype = cfg.get("type", "object" if "properties" in cfg else "text")
+            if ftype == "alias":
+                self.aliases[path] = cfg["path"]
+                continue
+            if ftype in ("object", "nested"):
+                self._merge_props(cfg.get("properties", {}), prefix=f"{path}.")
+                continue
+            self.fields[path] = self._build_field(path, ftype, cfg)
+
+    def _build_field(self, path: str, ftype: str, cfg: dict) -> FieldType:
+        ft = FieldType(
+            name=path, type=ftype,
+            analyzer=cfg.get("analyzer", "standard"),
+            search_analyzer=cfg.get("search_analyzer"),
+            normalizer=cfg.get("normalizer"),
+            index=cfg.get("index", True),
+            doc_values=cfg.get("doc_values", True),
+            store=cfg.get("store", False),
+            null_value=cfg.get("null_value"),
+            ignore_above=cfg.get("ignore_above"),
+            copy_to=list(cfg.get("copy_to", []) if isinstance(cfg.get("copy_to", []), list)
+                         else [cfg["copy_to"]]),
+            date_format=cfg.get("format"),
+            boost=cfg.get("boost", 1.0),
+            norms=cfg.get("norms", True),
+        )
+        for sub, subcfg in cfg.get("fields", {}).items():
+            ft.subfields[sub] = self._build_field(f"{path}.{sub}", subcfg.get("type", "keyword"), subcfg)
+        return ft
+
+    def to_dict(self) -> dict:
+        props: dict = {}
+        for path, ft in self.fields.items():
+            node = props
+            parts = path.split(".")
+            # reconstruct nested properties for object paths
+            skip = False
+            for p in parts[:-1]:
+                if f"{'.'.join(parts[:parts.index(p)+1])}" in self.fields:
+                    skip = True  # dotted subfield of a mapped field, not an object
+                    break
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            if skip:
+                continue
+            d: dict = {"type": ft.type}
+            if ft.type == "text" and ft.analyzer != "standard":
+                d["analyzer"] = ft.analyzer
+            if ft.normalizer:
+                d["normalizer"] = ft.normalizer
+            if not ft.index:
+                d["index"] = False
+            if ft.subfields:
+                d["fields"] = {s: {"type": sf.type} for s, sf in ft.subfields.items()}
+            node[parts[-1]] = d
+        out = {"properties": props}
+        if self._meta:
+            out["_meta"] = self._meta
+        return out
+
+    # ---------------- field resolution ----------------
+
+    def resolve_field(self, name: str) -> Optional[FieldType]:
+        name = self.aliases.get(name, name)
+        ft = self.fields.get(name)
+        if ft is not None:
+            return ft
+        # multi-field lookup: "title.keyword"
+        if "." in name:
+            parent, sub = name.rsplit(".", 1)
+            parent = self.aliases.get(parent, parent)
+            pft = self.fields.get(parent)
+            if pft and sub in pft.subfields:
+                return pft.subfields[sub]
+        return None
+
+    def index_analyzer(self, ft: FieldType) -> Analyzer:
+        if ft.type in KEYWORD_TYPES:
+            return self.analysis.normalizer(ft.normalizer)
+        return self.analysis.get(ft.analyzer)
+
+    def search_analyzer_for(self, ft: FieldType) -> Analyzer:
+        if ft.type in KEYWORD_TYPES:
+            return self.analysis.normalizer(ft.normalizer)
+        return self.analysis.get(ft.search_analyzer or ft.analyzer)
+
+    # ---------------- dynamic mapping ----------------
+
+    def _dynamic_type(self, path: str, value: Any) -> Optional[FieldType]:
+        for tmpl in self.dynamic_templates:
+            rule = next(iter(tmpl.values()))
+            match = rule.get("match", "*")
+            import fnmatch
+            if fnmatch.fnmatch(path.split(".")[-1], match):
+                cfg = dict(rule.get("mapping", {}))
+                return self._build_field(path, cfg.get("type", "text"), cfg)
+        if isinstance(value, bool):
+            return self._build_field(path, "boolean", {})
+        if isinstance(value, int):
+            return self._build_field(path, "long", {})
+        if isinstance(value, float):
+            return self._build_field(path, "double", {})
+        if isinstance(value, str):
+            # try date detection like reference's date_detection (ISO only)
+            try:
+                _dt.datetime.fromisoformat(value.replace("Z", "+00:00"))
+                return self._build_field(path, "date", {})
+            except ValueError:
+                pass
+            return self._build_field(path, "text",
+                                     {"fields": {"keyword": {"type": "keyword",
+                                                             "ignore_above": 256}}})
+        return None
+
+    # ---------------- document parsing ----------------
+
+    def parse(self, doc_id: str, source: dict, routing: Optional[str] = None) -> ParsedDocument:
+        parsed = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
+        self._parse_obj(source, "", parsed)
+        return parsed
+
+    def _parse_obj(self, obj: dict, prefix: str, parsed: ParsedDocument) -> None:
+        for key, value in obj.items():
+            path = f"{prefix}{key}"
+            if isinstance(value, dict):
+                ft = self.resolve_field(path)
+                if ft is not None and ft.type in GEO_TYPES:
+                    self._index_value(ft, value, parsed)
+                else:
+                    self._parse_obj(value, f"{path}.", parsed)
+                continue
+            values = value if isinstance(value, list) else [value]
+            if values and all(isinstance(v, dict) for v in values):
+                for v in values:
+                    self._parse_obj(v, f"{path}.", parsed)
+                continue
+            ft = self.resolve_field(path)
+            if ft is None:
+                if self.dynamic in (False, "false"):
+                    continue
+                if self.dynamic == "strict":
+                    raise ValueError(f"strict_dynamic_mapping_exception: [{path}] not allowed")
+                sample = next((v for v in values if v is not None), None)
+                if sample is None:
+                    continue
+                ft = self._dynamic_type(path, sample)
+                if ft is None:
+                    continue
+                self.fields[path] = ft
+            self._index_value(ft, value, parsed)
+
+    def _index_value(self, ft: FieldType, value: Any, parsed: ParsedDocument) -> None:
+        values = value if isinstance(value, list) else [value]
+        for v in values:
+            if v is None:
+                v = ft.null_value
+                if v is None:
+                    continue
+            self._index_single(ft, v, parsed)
+        for sub in ft.subfields.values():
+            self._index_value(sub, value, parsed)
+        for target in ft.copy_to:
+            tft = self.resolve_field(target)
+            if tft is None:
+                tft = self._dynamic_type(target, values[0] if values else "")
+                if tft is None:
+                    continue
+                self.fields[target] = tft
+            self._index_value(tft, value, parsed)
+
+    def _index_single(self, ft: FieldType, v: Any, parsed: ParsedDocument) -> None:
+        name = ft.name
+        if ft.type == "text":
+            if ft.index:
+                tokens = self.index_analyzer(ft).analyze(str(v))
+                tl = parsed.terms.setdefault(name, [])
+                pl = parsed.positions.setdefault(name, [])
+                base = pl[-1][1] + 100 if pl else 0  # position gap between values
+                for t in tokens:
+                    tl.append(t.text)
+                    pl.append((t.text, base + t.position))
+            return
+        if ft.type == "keyword":
+            s = str(v)
+            if ft.ignore_above is not None and len(s) > ft.ignore_above:
+                return
+            norm = self.index_analyzer(ft).terms(s)
+            s = norm[0] if norm else s
+            if ft.index:
+                parsed.terms.setdefault(name, []).append(s)
+            if ft.doc_values:
+                parsed.keywords.setdefault(name, []).append(s)
+            return
+        if ft.type in GEO_TYPES:
+            lat, lon = _parse_geo(v)
+            parsed.geos.setdefault(name, []).append((lat, lon))
+            return
+        cv = coerce_value(ft, v)
+        parsed.numerics.setdefault(name, []).append(cv)
+        if ft.type == "ip" and ft.index:
+            parsed.terms.setdefault(name, []).append(str(v))
+
+
+def _parse_geo(v: Any) -> Tuple[float, float]:
+    if isinstance(v, dict):
+        return float(v["lat"]), float(v["lon"])
+    if isinstance(v, str):
+        lat, lon = v.split(",")
+        return float(lat), float(lon)
+    if isinstance(v, (list, tuple)):  # GeoJSON order [lon, lat]
+        return float(v[1]), float(v[0])
+    raise ValueError(f"cannot parse geo_point [{v}]")

@@ -1,0 +1,201 @@
+"""Segment merging. Analog of reference `OpenSearchTieredMergePolicy.java` +
+Lucene's SegmentMerger, rebuilt as vectorized multiway sorted-run merges over
+CSR arrays (deleted docs are compacted away, exactly like Lucene merges).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from .segment import (GeoColumn, KeywordColumn, NumericColumn, PostingsBlock, Segment,
+                      TextFieldStats)
+
+
+class TieredMergePolicy:
+    """Size-tiered selection: merge when >= `segments_per_tier` segments share
+    a size tier (by live doc count), preferring the smallest."""
+
+    def __init__(self, segments_per_tier: int = 8, max_merged_docs: int = 1 << 24):
+        self.segments_per_tier = segments_per_tier
+        self.max_merged_docs = max_merged_docs
+
+    def find_merges(self, segments: List[Segment]) -> List[List[Segment]]:
+        candidates = [s for s in segments if s.live_count < self.max_merged_docs]
+        if len(candidates) < self.segments_per_tier:
+            # also merge when deletes dominate (reference: forceMergeDeletes)
+            heavy = [s for s in segments
+                     if s.ndocs > 0 and s.live_count < 0.5 * s.ndocs]
+            return [[s] for s in heavy]
+        candidates.sort(key=lambda s: s.live_count)
+        return [candidates[: self.segments_per_tier]]
+
+
+def merge_segments(name: str, segments: List[Segment]) -> Segment:
+    """Compacting multiway merge of N segments into one."""
+    live_masks = [s.live.astype(bool) for s in segments]
+    live_counts = [int(m.sum()) for m in live_masks]
+    ndocs = sum(live_counts)
+    # old (seg, doc) -> new doc id
+    doc_maps: List[np.ndarray] = []
+    base = 0
+    for s, m, c in zip(segments, live_masks, live_counts):
+        dmap = np.full(s.ndocs, -1, dtype=np.int64)
+        dmap[m] = base + np.arange(c, dtype=np.int64)
+        doc_maps.append(dmap)
+        base += c
+
+    ids: List[str] = []
+    sources: List[dict] = []
+    seq_nos = np.empty(ndocs, dtype=np.int64)
+    for s, m, dmap in zip(segments, live_masks, doc_maps):
+        for old in np.nonzero(m)[0]:
+            ids.append(s.ids[old])
+            sources.append(s.sources[old])
+        seq_nos[dmap[m]] = s.seq_nos[m]
+
+    # ---- postings ----
+    post_fields = {f for s in segments for f in s.postings}
+    postings: Dict[str, PostingsBlock] = {}
+    for f in post_fields:
+        vocab_union = sorted({t for s in segments if f in s.postings for t in s.postings[f].vocab})
+        new_row_of = {t: i for i, t in enumerate(vocab_union)}
+        rows_parts, docs_parts, tfs_parts, pos_len_parts, pos_parts = [], [], [], [], []
+        has_positions = all(f not in s.postings or s.postings[f].pos_starts is not None
+                            for s in segments)
+        for s, dmap in zip(segments, doc_maps):
+            pb = s.postings.get(f)
+            if pb is None or pb.size == 0:
+                continue
+            lens = np.diff(pb.starts)
+            row_map = np.fromiter((new_row_of[t] for t in pb.vocab), dtype=np.int64,
+                                  count=len(pb.vocab))
+            rows = np.repeat(row_map, lens)
+            new_docs = dmap[pb.doc_ids]
+            keep = new_docs >= 0
+            rows_parts.append(rows[keep])
+            docs_parts.append(new_docs[keep])
+            tfs_parts.append(pb.tfs[keep])
+            if has_positions and pb.pos_starts is not None:
+                plens = np.diff(pb.pos_starts)[keep]
+                pos_len_parts.append(plens)
+                # gather each kept posting's position run
+                kept_starts = pb.pos_starts[:-1][keep]
+                idx = _ranges_gather(kept_starts, plens)
+                pos_parts.append(pb.positions[idx])
+        if not rows_parts:
+            continue
+        rows = np.concatenate(rows_parts)
+        docs = np.concatenate(docs_parts)
+        tfs = np.concatenate(tfs_parts)
+        order = np.lexsort((docs, rows))
+        rows, docs, tfs = rows[order], docs[order], tfs[order]
+        starts = np.zeros(len(vocab_union) + 1, dtype=np.int64)
+        np.cumsum(np.bincount(rows, minlength=len(vocab_union)), out=starts[1:])
+        pos_starts = positions = None
+        if has_positions and pos_len_parts:
+            plens = np.concatenate(pos_len_parts)[order]
+            all_pos_parts = np.concatenate(pos_parts) if pos_parts else np.empty(0, np.int32)
+            # positions were concatenated in pre-sort posting order; regather
+            pre_starts = np.zeros(len(plens) + 1, dtype=np.int64)
+            np.cumsum(np.concatenate(pos_len_parts), out=pre_starts[1:])
+            idx = _ranges_gather(pre_starts[:-1][order], plens)
+            positions = all_pos_parts[idx]
+            pos_starts = np.zeros(len(plens) + 1, dtype=np.int64)
+            np.cumsum(plens, out=pos_starts[1:])
+        postings[f] = PostingsBlock(f, vocab_union, new_row_of, starts,
+                                    docs.astype(np.int32), tfs.astype(np.float32),
+                                    pos_starts, positions)
+
+    # ---- numeric columns ----
+    numeric_cols: Dict[str, NumericColumn] = {}
+    for f in {f for s in segments for f in s.numeric_cols}:
+        kind = next(s.numeric_cols[f].kind for s in segments if f in s.numeric_cols)
+        dtype = np.float64 if kind == "float" else np.int64
+        values = np.zeros(ndocs, dtype=dtype)
+        present = np.zeros(ndocs, dtype=bool)
+        for s, m, dmap in zip(segments, live_masks, doc_maps):
+            col = s.numeric_cols.get(f)
+            if col is None:
+                continue
+            values[dmap[m]] = col.values[m]
+            present[dmap[m]] = col.present[m]
+        numeric_cols[f] = NumericColumn(f, kind, values, present)
+
+    # ---- keyword columns ----
+    keyword_cols: Dict[str, KeywordColumn] = {}
+    for f in {f for s in segments for f in s.keyword_cols}:
+        vocab_union = sorted({v for s in segments if f in s.keyword_cols
+                              for v in s.keyword_cols[f].vocab})
+        new_ord_of = {v: i for i, v in enumerate(vocab_union)}
+        doc_parts, ord_parts = [], []
+        for s, dmap in zip(segments, doc_maps):
+            col = s.keyword_cols.get(f)
+            if col is None or len(col.ords) == 0:
+                continue
+            remap = np.fromiter((new_ord_of[v] for v in col.vocab), dtype=np.int64,
+                                count=len(col.vocab))
+            new_docs = dmap[col.doc_of_value]
+            keep = new_docs >= 0
+            doc_parts.append(new_docs[keep])
+            ord_parts.append(remap[col.ords[keep]])
+        if doc_parts:
+            docs = np.concatenate(doc_parts)
+            ords = np.concatenate(ord_parts)
+            order = np.lexsort((ords, docs))
+            docs, ords = docs[order], ords[order]
+        else:
+            docs = np.empty(0, np.int64)
+            ords = np.empty(0, np.int64)
+        starts = np.zeros(ndocs + 1, dtype=np.int64)
+        np.cumsum(np.bincount(docs, minlength=ndocs), out=starts[1:])
+        min_ord = np.full(ndocs, -1, dtype=np.int32)
+        if len(docs):
+            first = np.unique(docs, return_index=True)
+            min_ord[first[0]] = ords[first[1]].astype(np.int32)
+        keyword_cols[f] = KeywordColumn(f, vocab_union, starts, ords.astype(np.int32),
+                                        docs.astype(np.int32), min_ord)
+
+    # ---- geo columns ----
+    geo_cols: Dict[str, GeoColumn] = {}
+    for f in {f for s in segments for f in s.geo_cols}:
+        lat = np.zeros(ndocs, dtype=np.float32)
+        lon = np.zeros(ndocs, dtype=np.float32)
+        present = np.zeros(ndocs, dtype=bool)
+        for s, m, dmap in zip(segments, live_masks, doc_maps):
+            col = s.geo_cols.get(f)
+            if col is None:
+                continue
+            lat[dmap[m]] = col.lat[m]
+            lon[dmap[m]] = col.lon[m]
+            present[dmap[m]] = col.present[m]
+        geo_cols[f] = GeoColumn(f, lat, lon, present)
+
+    # ---- doc lens + stats ----
+    doc_lens: Dict[str, np.ndarray] = {}
+    text_stats: Dict[str, TextFieldStats] = {}
+    for f in {f for s in segments for f in s.doc_lens}:
+        dl = np.zeros(ndocs, dtype=np.int64)
+        for s, m, dmap in zip(segments, live_masks, doc_maps):
+            sdl = s.doc_lens.get(f)
+            if sdl is not None:
+                dl[dmap[m]] = sdl[m]
+        doc_lens[f] = dl
+        text_stats[f] = TextFieldStats(doc_count=int((dl > 0).sum()), sum_dl=int(dl.sum()))
+
+    return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
+                   doc_lens, text_stats, ids, sources, seq_nos=seq_nos)
+
+
+def _ranges_gather(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Indices selecting [starts[i], starts[i]+lens[i]) runs, concatenated —
+    the vectorized run-gather underlying positional merges."""
+    total = int(lens.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    ends = np.cumsum(lens)
+    idx = np.arange(total, dtype=np.int64)
+    run = np.searchsorted(ends, idx, side="right")
+    prev = np.concatenate(([0], ends[:-1]))
+    return starts[run] + (idx - prev[run])

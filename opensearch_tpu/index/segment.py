@@ -1,0 +1,483 @@
+"""Immutable index segments: CSR posting blocks + columnar doc values in HBM.
+
+This replaces Lucene's segment files (reference: `index/codec/`, Lucene
+Lucene101PostingsFormat / DocValuesFormat / StoredFieldsFormat). Layout is
+TPU-first instead of disk-first:
+
+- Postings for one field are a CSR matrix over (term row -> doc postings):
+  `starts[t]..starts[t+1]` indexes flat `doc_ids` / `tfs` arrays. Flat arrays
+  are padded to power-of-two lengths so XLA sees a small set of static shapes
+  across segments (compile-cache friendly); padded doc_ids hold an
+  out-of-range sentinel so scatter `mode=drop` ignores them.
+- Term frequencies are stored as f32 (exact for tf < 2^24) so the BM25
+  tf-saturation runs on the VPU with no decode step — the analog of Lucene's
+  "impacts" but kept separate from the per-doc length norm so k1/b/avgdl stay
+  query-time parameters (similarity parity with reference
+  `index/similarity/`).
+- Doc values are dense columns: the long family (long/date/boolean/ip-lo...)
+  is stored as exact (hi,lo) i32 pairs (TPU jit default is 32-bit; the pair
+  compare keeps 64-bit range semantics exact), floats as f32, keywords as a
+  doc-major CSR of segment-local ordinals + per-doc min-ord for sorting.
+- Stored fields (`_source`) stay on host (the device never needs them; the
+  fetch phase is host-side, reference `search/fetch/FetchPhase.java`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field as dc_field
+from functools import cached_property
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .mappings import FLOAT_TYPES, GEO_TYPES, FieldType, Mappings
+
+INT32_SENTINEL = np.int32(2**31 - 1)  # padded doc_id -> dropped by scatter
+
+
+def next_pow2(n: int, floor: int = 16) -> int:
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+def _pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
+    out = np.full(size, fill, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    return out
+
+
+def split_i64(vals: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """i64 -> (hi i32, lo i32-with-offset-binary) such that lexicographic
+    (hi, lo) compare == signed 64-bit compare. lo is biased by 2^31 so a plain
+    signed compare works on the low word."""
+    v = vals.astype(np.int64)
+    hi = (v >> 32).astype(np.int32)
+    lo = ((v & 0xFFFFFFFF) - (1 << 31)).astype(np.int64).astype(np.int32)
+    return hi, lo
+
+
+@dataclass
+class PostingsBlock:
+    """CSR postings for one indexed field."""
+
+    field: str
+    vocab: List[str]                    # row -> term (sorted)
+    terms: Dict[str, int]               # term -> row
+    starts: np.ndarray                  # i64[nterms+1] host row pointers
+    doc_ids: np.ndarray                 # i32[P] host
+    tfs: np.ndarray                     # f32[P] host
+    # optional positional data: pos_starts aligned with postings flat index
+    pos_starts: Optional[np.ndarray] = None   # i64[P+1]
+    positions: Optional[np.ndarray] = None    # i32[total_positions]
+
+    @property
+    def nterms(self) -> int:
+        return len(self.vocab)
+
+    @property
+    def size(self) -> int:
+        return int(self.starts[-1])
+
+    def row(self, term: str) -> int:
+        """Row for term, or -1 when absent (maps to the guaranteed-empty
+        padding row on device)."""
+        return self.terms.get(term, -1)
+
+    def doc_freq(self, term: str) -> int:
+        r = self.terms.get(term)
+        if r is None:
+            return 0
+        return int(self.starts[r + 1] - self.starts[r])
+
+    def row_slice(self, row: int) -> Tuple[int, int]:
+        return int(self.starts[row]), int(self.starts[row + 1])
+
+
+@dataclass
+class NumericColumn:
+    field: str
+    kind: str                 # "int" (long family, exact i64) | "float"
+    values: np.ndarray        # host i64 or f64
+    present: np.ndarray       # bool[ndocs]
+
+    _sort_ords: Optional[np.ndarray] = None
+
+    @property
+    def min_max(self) -> Tuple[float, float]:
+        if not self.present.any():
+            return (0.0, 0.0)
+        vals = self.values[self.present]
+        return (float(vals.min()), float(vals.max()))
+
+    def sort_ords(self) -> np.ndarray:
+        """Per-doc rank of the value among the segment's distinct values —
+        exact i32 sort keys for device top-k even when values need 64 bits
+        (see SURVEY §2.5 sort). Missing docs get rank -1."""
+        if self._sort_ords is None:
+            ords = np.full(len(self.values), -1, dtype=np.int32)
+            if self.present.any():
+                uniq = np.unique(self.values[self.present])
+                ords[self.present] = np.searchsorted(uniq, self.values[self.present]).astype(np.int32)
+            self._sort_ords = ords
+        return self._sort_ords
+
+
+@dataclass
+class KeywordColumn:
+    field: str
+    vocab: List[str]          # sorted distinct values
+    starts: np.ndarray        # i64[ndocs+1] doc-major CSR
+    ords: np.ndarray          # i32[total_values]
+    doc_of_value: np.ndarray  # i32[total_values] (doc id per flat value)
+    min_ord: np.ndarray       # i32[ndocs], -1 = missing
+
+    @property
+    def present(self) -> np.ndarray:
+        return self.min_ord >= 0
+
+
+@dataclass
+class GeoColumn:
+    field: str
+    lat: np.ndarray           # f32[ndocs]
+    lon: np.ndarray           # f32[ndocs]
+    present: np.ndarray
+
+
+@dataclass
+class TextFieldStats:
+    doc_count: int = 0        # docs containing this field
+    sum_dl: int = 0           # total tokens across docs
+
+
+class Segment:
+    """One immutable searchable unit (analog of a Lucene segment + its
+    SegmentReader, reference `index/engine/Engine.java#acquireSearcher`)."""
+
+    _seq = 0
+
+    def __init__(self, name: str, ndocs: int,
+                 postings: Dict[str, PostingsBlock],
+                 numeric_cols: Dict[str, NumericColumn],
+                 keyword_cols: Dict[str, KeywordColumn],
+                 geo_cols: Dict[str, GeoColumn],
+                 doc_lens: Dict[str, np.ndarray],
+                 text_stats: Dict[str, TextFieldStats],
+                 ids: List[str], sources: List[dict],
+                 seq_nos: Optional[np.ndarray] = None):
+        self.name = name
+        self.ndocs = ndocs
+        self.postings = postings
+        self.numeric_cols = numeric_cols
+        self.keyword_cols = keyword_cols
+        self.geo_cols = geo_cols
+        self.doc_lens = doc_lens
+        self.text_stats = text_stats
+        self.ids = ids
+        self.sources = sources
+        self.seq_nos = seq_nos if seq_nos is not None else np.zeros(ndocs, dtype=np.int64)
+        self.live = np.ones(ndocs, dtype=bool)
+        self.id2doc: Dict[str, int] = {d: i for i, d in enumerate(ids)}
+        self._device: Optional[dict] = None
+        self._device_live_dirty = True
+
+    # ---------------- live docs / deletes ----------------
+
+    def delete_doc(self, local_doc: int) -> None:
+        self.live[local_doc] = False
+        self._device_live_dirty = True
+
+    @property
+    def live_count(self) -> int:
+        return int(self.live.sum())
+
+    # ---------------- device residency ----------------
+
+    @property
+    def ndocs_pad(self) -> int:
+        return next_pow2(self.ndocs)
+
+    def device_arrays(self) -> dict:
+        """The pytree of device-resident arrays consumed by `ops` kernels.
+        Shapes are padded to pow2 buckets; structure is stable across segments
+        of the same index so jitted plans re-hit the XLA compile cache."""
+        import jax.numpy as jnp
+
+        if self._device is None:
+            dpad = self.ndocs_pad
+            post = {}
+            for f, pb in self.postings.items():
+                ppad = next_pow2(pb.size)
+                rpad = next_pow2(pb.nterms + 2)
+                starts = _pad_to(pb.starts.astype(np.int32), rpad, np.int32(pb.size))
+                post[f] = {
+                    "starts": jnp.asarray(starts),
+                    "doc_ids": jnp.asarray(_pad_to(pb.doc_ids.astype(np.int32), ppad, INT32_SENTINEL)),
+                    "tfs": jnp.asarray(_pad_to(pb.tfs.astype(np.float32), ppad, np.float32(0))),
+                }
+            ncols = {}
+            for f, col in self.numeric_cols.items():
+                if col.kind == "int":
+                    hi, lo = split_i64(col.values)
+                    ncols[f] = {
+                        "hi": jnp.asarray(_pad_to(hi, dpad, np.int32(0))),
+                        "lo": jnp.asarray(_pad_to(lo, dpad, np.int32(0))),
+                        "f32": jnp.asarray(_pad_to(col.values.astype(np.float32), dpad, np.float32(0))),
+                        "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+                    }
+                else:
+                    ncols[f] = {
+                        "f32": jnp.asarray(_pad_to(col.values.astype(np.float32), dpad, np.float32(0))),
+                        "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+                    }
+            kcols = {}
+            for f, col in self.keyword_cols.items():
+                vpad = next_pow2(len(col.ords))
+                kcols[f] = {
+                    "ords": jnp.asarray(_pad_to(col.ords, vpad, np.int32(-1))),
+                    "doc_of_value": jnp.asarray(_pad_to(col.doc_of_value, vpad, INT32_SENTINEL)),
+                    "min_ord": jnp.asarray(_pad_to(col.min_ord, dpad, np.int32(-1))),
+                    "nvocab": len(col.vocab),
+                }
+            gcols = {}
+            for f, col in self.geo_cols.items():
+                gcols[f] = {
+                    "lat": jnp.asarray(_pad_to(col.lat, dpad, np.float32(0))),
+                    "lon": jnp.asarray(_pad_to(col.lon, dpad, np.float32(0))),
+                    "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+                }
+            dls = {f: jnp.asarray(_pad_to(dl.astype(np.float32), dpad, np.float32(0)))
+                   for f, dl in self.doc_lens.items()}
+            self._device = {
+                "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
+                "doc_lens": dls, "ndocs": self.ndocs, "ndocs_pad": dpad,
+            }
+        if self._device_live_dirty:
+            import jax.numpy as jnp
+            self._device["live"] = jnp.asarray(
+                _pad_to(self.live.astype(np.float32), self.ndocs_pad, np.float32(0)))
+            self._device_live_dirty = False
+        return self._device
+
+    def drop_device(self) -> None:
+        self._device = None
+        self._device_live_dirty = True
+
+    # ---------------- persistence (flush/commit) ----------------
+
+    def save(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        arrays: Dict[str, np.ndarray] = {"live": self.live, "seq_nos": self.seq_nos}
+        meta: Dict[str, Any] = {"name": self.name, "ndocs": self.ndocs,
+                                "postings": {}, "numeric": {}, "keyword": {}, "geo": {},
+                                "text_stats": {f: [s.doc_count, s.sum_dl]
+                                               for f, s in self.text_stats.items()}}
+        for f, pb in self.postings.items():
+            key = f"post__{f}"
+            arrays[f"{key}__starts"] = pb.starts
+            arrays[f"{key}__doc_ids"] = pb.doc_ids
+            arrays[f"{key}__tfs"] = pb.tfs
+            if pb.pos_starts is not None:
+                arrays[f"{key}__pos_starts"] = pb.pos_starts
+                arrays[f"{key}__positions"] = pb.positions
+            meta["postings"][f] = {"vocab_file": True, "positional": pb.pos_starts is not None}
+            with open(os.path.join(path, f"vocab__{f.replace('/', '_')}.txt"), "w") as fh:
+                fh.write("\n".join(pb.vocab))
+        for f, col in self.numeric_cols.items():
+            arrays[f"num__{f}__values"] = col.values
+            arrays[f"num__{f}__present"] = col.present
+            meta["numeric"][f] = {"kind": col.kind}
+        for f, col in self.keyword_cols.items():
+            arrays[f"kw__{f}__starts"] = col.starts
+            arrays[f"kw__{f}__ords"] = col.ords
+            arrays[f"kw__{f}__docs"] = col.doc_of_value
+            arrays[f"kw__{f}__min_ord"] = col.min_ord
+            meta["keyword"][f] = {"vocab_file": True}
+            with open(os.path.join(path, f"kwvocab__{f.replace('/', '_')}.txt"), "w") as fh:
+                fh.write("\n".join(col.vocab))
+        for f, col in self.geo_cols.items():
+            arrays[f"geo__{f}__lat"] = col.lat
+            arrays[f"geo__{f}__lon"] = col.lon
+            arrays[f"geo__{f}__present"] = col.present
+        for f, dl in self.doc_lens.items():
+            arrays[f"dl__{f}"] = dl
+        np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
+        with open(os.path.join(path, "meta.json"), "w") as fh:
+            json.dump(meta, fh)
+        with open(os.path.join(path, "stored.jsonl"), "w") as fh:
+            for i, src in enumerate(self.sources):
+                fh.write(json.dumps({"_id": self.ids[i], "_source": src}) + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Segment":
+        with open(os.path.join(path, "meta.json")) as fh:
+            meta = json.load(fh)
+        arrays = np.load(os.path.join(path, "arrays.npz"), allow_pickle=False)
+        ids, sources = [], []
+        with open(os.path.join(path, "stored.jsonl")) as fh:
+            for line in fh:
+                rec = json.loads(line)
+                ids.append(rec["_id"])
+                sources.append(rec["_source"])
+        postings = {}
+        for f, pmeta in meta["postings"].items():
+            with open(os.path.join(path, f"vocab__{f.replace('/', '_')}.txt")) as fh:
+                content = fh.read()
+                vocab = content.split("\n") if content else []
+            key = f"post__{f}"
+            postings[f] = PostingsBlock(
+                field=f, vocab=vocab, terms={t: i for i, t in enumerate(vocab)},
+                starts=arrays[f"{key}__starts"], doc_ids=arrays[f"{key}__doc_ids"],
+                tfs=arrays[f"{key}__tfs"],
+                pos_starts=arrays.get(f"{key}__pos_starts"),
+                positions=arrays.get(f"{key}__positions"))
+        numeric = {f: NumericColumn(f, m["kind"], arrays[f"num__{f}__values"],
+                                    arrays[f"num__{f}__present"])
+                   for f, m in meta["numeric"].items()}
+        keyword = {}
+        for f in meta["keyword"]:
+            with open(os.path.join(path, f"kwvocab__{f.replace('/', '_')}.txt")) as fh:
+                content = fh.read()
+                kvocab = content.split("\n") if content else []
+            keyword[f] = KeywordColumn(f, kvocab, arrays[f"kw__{f}__starts"],
+                                       arrays[f"kw__{f}__ords"], arrays[f"kw__{f}__docs"],
+                                       arrays[f"kw__{f}__min_ord"])
+        geo = {f: GeoColumn(f, arrays[f"geo__{f}__lat"], arrays[f"geo__{f}__lon"],
+                            arrays[f"geo__{f}__present"])
+               for f in meta["geo"]}
+        doc_lens = {k[len("dl__"):]: arrays[k] for k in arrays.files if k.startswith("dl__")}
+        seg = cls(meta["name"], meta["ndocs"], postings, numeric, keyword, geo, doc_lens,
+                  {f: TextFieldStats(dc, sd) for f, (dc, sd) in meta["text_stats"].items()},
+                  ids, sources, seq_nos=arrays["seq_nos"])
+        seg.live = arrays["live"].copy()
+        seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
+        return seg
+
+
+def build_segment(name: str, parsed_docs: list, mappings: Mappings,
+                  seq_nos: Optional[List[int]] = None,
+                  with_positions: bool = True) -> Segment:
+    """Build an immutable segment from buffered parsed docs (the refresh path,
+    analog of Lucene DWPT flush driven by reference
+    `index/engine/InternalEngine.java#refresh`)."""
+    ndocs = len(parsed_docs)
+    ids = [d.doc_id for d in parsed_docs]
+    sources = [d.source for d in parsed_docs]
+
+    # ---- inverted fields ----
+    field_term_docs: Dict[str, Dict[str, dict]] = {}
+    field_term_pos: Dict[str, Dict[str, dict]] = {}
+    doc_lens: Dict[str, np.ndarray] = {}
+    text_stats: Dict[str, TextFieldStats] = {}
+    for doc_i, pd in enumerate(parsed_docs):
+        for fname, terms in pd.terms.items():
+            td = field_term_docs.setdefault(fname, {})
+            for t in terms:
+                postings = td.setdefault(t, {})
+                postings[doc_i] = postings.get(doc_i, 0) + 1
+            ft = mappings.resolve_field(fname)
+            if ft is not None and ft.type == "text":
+                stats = text_stats.setdefault(fname, TextFieldStats())
+                stats.doc_count += 1
+                stats.sum_dl += len(terms)
+                dl = doc_lens.setdefault(fname, np.zeros(ndocs, dtype=np.int64))
+                dl[doc_i] = len(terms)
+        if with_positions:
+            for fname, tps in pd.positions.items():
+                tp = field_term_pos.setdefault(fname, {})
+                for t, p in tps:
+                    tp.setdefault(t, {}).setdefault(doc_i, []).append(p)
+
+    postings: Dict[str, PostingsBlock] = {}
+    for fname, term_docs in field_term_docs.items():
+        vocab = sorted(term_docs)
+        terms = {t: i for i, t in enumerate(vocab)}
+        lens = np.fromiter((len(term_docs[t]) for t in vocab), dtype=np.int64, count=len(vocab))
+        starts = np.zeros(len(vocab) + 1, dtype=np.int64)
+        np.cumsum(lens, out=starts[1:])
+        total = int(starts[-1])
+        doc_ids = np.empty(total, dtype=np.int32)
+        tfs = np.empty(total, dtype=np.float32)
+        pos_chunks: List[List[int]] = []
+        pos_lens = np.zeros(total, dtype=np.int64) if with_positions else None
+        k = 0
+        tp = field_term_pos.get(fname, {})
+        for t in vocab:
+            d = term_docs[t]
+            for doc_i in sorted(d):
+                doc_ids[k] = doc_i
+                tfs[k] = d[doc_i]
+                if with_positions:
+                    plist = tp.get(t, {}).get(doc_i, [])
+                    pos_lens[k] = len(plist)
+                    pos_chunks.append(plist)
+                k += 1
+        pos_starts = positions = None
+        if with_positions:
+            pos_starts = np.zeros(total + 1, dtype=np.int64)
+            np.cumsum(pos_lens, out=pos_starts[1:])
+            positions = np.fromiter((p for chunk in pos_chunks for p in chunk),
+                                    dtype=np.int32, count=int(pos_starts[-1]))
+        postings[fname] = PostingsBlock(fname, vocab, terms, starts, doc_ids, tfs,
+                                        pos_starts, positions)
+
+    # ---- doc values ----
+    numeric_cols: Dict[str, NumericColumn] = {}
+    keyword_cols: Dict[str, KeywordColumn] = {}
+    geo_cols: Dict[str, GeoColumn] = {}
+    num_fields = {f for pd in parsed_docs for f in pd.numerics}
+    kw_fields = {f for pd in parsed_docs for f in pd.keywords}
+    geo_fields = {f for pd in parsed_docs for f in pd.geos}
+
+    for fname in num_fields:
+        ft = mappings.resolve_field(fname)
+        kind = "float" if (ft is not None and ft.type in FLOAT_TYPES) else "int"
+        dtype = np.float64 if kind == "float" else np.int64
+        values = np.zeros(ndocs, dtype=dtype)
+        present = np.zeros(ndocs, dtype=bool)
+        for doc_i, pd in enumerate(parsed_docs):
+            vals = pd.numerics.get(fname)
+            if vals:
+                values[doc_i] = vals[0]
+                present[doc_i] = True
+        numeric_cols[fname] = NumericColumn(fname, kind, values, present)
+
+    for fname in kw_fields:
+        value_set = set()
+        for pd in parsed_docs:
+            value_set.update(pd.keywords.get(fname, ()))
+        vocab = sorted(value_set)
+        ord_of = {v: i for i, v in enumerate(vocab)}
+        starts = np.zeros(ndocs + 1, dtype=np.int64)
+        flat_ords: List[int] = []
+        flat_docs: List[int] = []
+        min_ord = np.full(ndocs, -1, dtype=np.int32)
+        for doc_i, pd in enumerate(parsed_docs):
+            vals = pd.keywords.get(fname, ())
+            ords = sorted(ord_of[v] for v in set(vals))
+            for o in ords:
+                flat_ords.append(o)
+                flat_docs.append(doc_i)
+            if ords:
+                min_ord[doc_i] = ords[0]
+            starts[doc_i + 1] = len(flat_ords)
+        keyword_cols[fname] = KeywordColumn(
+            fname, vocab, starts, np.asarray(flat_ords, dtype=np.int32),
+            np.asarray(flat_docs, dtype=np.int32), min_ord)
+
+    for fname in geo_fields:
+        lat = np.zeros(ndocs, dtype=np.float32)
+        lon = np.zeros(ndocs, dtype=np.float32)
+        present = np.zeros(ndocs, dtype=bool)
+        for doc_i, pd in enumerate(parsed_docs):
+            vals = pd.geos.get(fname)
+            if vals:
+                lat[doc_i], lon[doc_i] = vals[0]
+                present[doc_i] = True
+        geo_cols[fname] = GeoColumn(fname, lat, lon, present)
+
+    seq = np.asarray(seq_nos, dtype=np.int64) if seq_nos is not None else None
+    return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
+                   doc_lens, text_stats, ids, sources, seq_nos=seq)

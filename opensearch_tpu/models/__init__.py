@@ -1,0 +1,3 @@
+from .similarity import BM25, Boolean, Classic, LMDirichlet, Similarity, resolve_similarity
+
+__all__ = ["Similarity", "BM25", "Classic", "Boolean", "LMDirichlet", "resolve_similarity"]

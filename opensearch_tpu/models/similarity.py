@@ -1,0 +1,102 @@
+"""Similarity (scoring) models. Analog of reference
+`index/similarity/SimilarityService.java` which wraps Lucene's
+BM25Similarity / ClassicSimilarity / BooleanSimilarity / LMDirichletSimilarity.
+
+A Similarity contributes two things:
+- a host-side per-term weight (idf × boost — collection-level statistics,
+  computed index-wide across segments like Lucene's CollectionStatistics),
+- the static `sim_id` + scalar params consumed by the traced per-posting
+  formula in `ops.scoring.posting_contrib`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..ops.scoring import (SIM_BM25, SIM_BOOLEAN, SIM_CLASSIC, SIM_LM_DIRICHLET,
+                           bm25_idf, classic_idf)
+
+
+@dataclass(frozen=True)
+class Similarity:
+    sim_id: int
+    k1: float = 1.2
+    b: float = 0.75
+
+    def term_weight(self, boost: float, n_docs: int, df: int) -> float:
+        raise NotImplementedError
+
+    def term_aux(self, cf: float, total_tf: float) -> float:
+        """Per-term auxiliary scalar (collection LM probability for Dirichlet)."""
+        return 0.0
+
+    @property
+    def uses_norms(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True)
+class BM25(Similarity):
+    """BM25 with Lucene's idf and tf saturation (reference BM25Similarity;
+    default k1=1.2 b=0.75 per IndexSettings)."""
+
+    sim_id: int = SIM_BM25
+
+    def term_weight(self, boost: float, n_docs: int, df: int) -> float:
+        return boost * bm25_idf(n_docs, df)
+
+
+@dataclass(frozen=True)
+class Classic(Similarity):
+    sim_id: int = SIM_CLASSIC
+
+    def term_weight(self, boost: float, n_docs: int, df: int) -> float:
+        idf = classic_idf(n_docs, df)
+        return boost * idf * idf
+
+
+@dataclass(frozen=True)
+class Boolean(Similarity):
+    sim_id: int = SIM_BOOLEAN
+
+    def term_weight(self, boost: float, n_docs: int, df: int) -> float:
+        return boost
+
+    @property
+    def uses_norms(self) -> bool:
+        return False
+
+
+@dataclass(frozen=True)
+class LMDirichlet(Similarity):
+    """LM with Dirichlet smoothing; k1 carries mu (default 2000 like Lucene)."""
+
+    sim_id: int = SIM_LM_DIRICHLET
+    k1: float = 2000.0
+
+    def term_weight(self, boost: float, n_docs: int, df: int) -> float:
+        return boost
+
+    def term_aux(self, cf: float, total_tf: float) -> float:
+        return max(cf, 1.0) / max(total_tf, 1.0)
+
+
+def resolve_similarity(cfg) -> Similarity:
+    """Index-settings similarity resolution (reference SimilarityService
+    built-ins: BM25 (default), boolean, classic, LMDirichlet)."""
+    if cfg is None:
+        return BM25()
+    if isinstance(cfg, Similarity):
+        return cfg
+    if isinstance(cfg, str):
+        cfg = {"type": cfg}
+    t = cfg.get("type", "BM25").lower()
+    if t == "bm25":
+        return BM25(k1=float(cfg.get("k1", 1.2)), b=float(cfg.get("b", 0.75)))
+    if t == "classic":
+        return Classic()
+    if t == "boolean":
+        return Boolean()
+    if t in ("lmdirichlet", "lm_dirichlet"):
+        return LMDirichlet(k1=float(cfg.get("mu", 2000.0)))
+    raise ValueError(f"unknown similarity [{t}]")

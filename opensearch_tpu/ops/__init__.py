@@ -1,0 +1,3 @@
+from . import aggs, scoring
+
+__all__ = ["scoring", "aggs"]

@@ -1,0 +1,153 @@
+"""Device aggregation kernels. Analog of reference
+`search/aggregations/bucket/*` and `metrics/*` aggregators, which walk
+matching docs one at a time; here each aggregation is a masked columnar
+reduction (bincount / segment reduce / scatter-max) over the whole segment.
+
+All kernels take `match` — the query's dense f32 0/1 match vector (already
+live-masked) — so aggregations run in the same jitted program as scoring and
+XLA fuses the mask with the reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+F32_MAX = jnp.float32(3.4e38)
+
+
+def _gather_match(match: jnp.ndarray, docs: jnp.ndarray) -> jnp.ndarray:
+    safe = jnp.minimum(docs, match.shape[0] - 1)
+    return jnp.where(docs < match.shape[0], match[safe], 0.0)
+
+
+def terms_counts(kw: dict, match: jnp.ndarray, nvocab_pad: int) -> jnp.ndarray:
+    """Keyword terms agg: per-ordinal doc counts (reference
+    GlobalOrdinalsStringTermsAggregator). Returns f32[nvocab_pad]."""
+    w = _gather_match(match, kw["doc_of_value"])
+    return jnp.zeros(nvocab_pad, jnp.float32).at[kw["ords"]].add(w, mode="drop")
+
+
+def terms_sub_metric(kw: dict, match: jnp.ndarray, values_f32: jnp.ndarray,
+                     present: jnp.ndarray, nvocab_pad: int):
+    """Per-ordinal (sum, count, min, max) of a numeric column — powers metric
+    sub-aggregations under a terms bucket in a single fused pass."""
+    docs = kw["doc_of_value"]
+    safe = jnp.minimum(docs, values_f32.shape[0] - 1)
+    w = _gather_match(match, docs) * jnp.where(present[safe], 1.0, 0.0)
+    v = values_f32[safe]
+    ords = kw["ords"]
+    sums = jnp.zeros(nvocab_pad, jnp.float32).at[ords].add(w * v, mode="drop")
+    cnts = jnp.zeros(nvocab_pad, jnp.float32).at[ords].add(w, mode="drop")
+    mins = jnp.full(nvocab_pad, F32_MAX).at[ords].min(
+        jnp.where(w > 0, v, F32_MAX), mode="drop")
+    maxs = jnp.full(nvocab_pad, -F32_MAX).at[ords].max(
+        jnp.where(w > 0, v, -F32_MAX), mode="drop")
+    return sums, cnts, mins, maxs
+
+
+def histogram_counts(values_f32: jnp.ndarray, present: jnp.ndarray, match: jnp.ndarray,
+                     interval: float, offset: float, min_bucket: int, nbuckets: int):
+    """Fixed-interval histogram (reference HistogramAggregator). The bucket
+    window [min_bucket, min_bucket+nbuckets) is static, derived on the host
+    from segment column stats."""
+    b = jnp.floor((values_f32 - offset) / interval).astype(jnp.int32) - min_bucket
+    w = match * jnp.where(present, 1.0, 0.0)
+    b = jnp.where((b >= 0) & (b < nbuckets), b, nbuckets)  # OOB -> dropped
+    return jnp.zeros(nbuckets, jnp.float32).at[b].add(w, mode="drop")
+
+
+def range_counts(values_f32: jnp.ndarray, present: jnp.ndarray, match: jnp.ndarray,
+                 lows: jnp.ndarray, highs: jnp.ndarray):
+    """range agg: [low, high) per reference RangeAggregator. lows/highs are
+    f32[nranges] traced arrays; returns f32[nranges] counts."""
+    v = values_f32[None, :]
+    in_range = (v >= lows[:, None]) & (v < highs[:, None])
+    w = (match * jnp.where(present, 1.0, 0.0))[None, :]
+    return jnp.sum(jnp.where(in_range, w, 0.0), axis=1)
+
+
+def stats_agg(values_f32: jnp.ndarray, present: jnp.ndarray, match: jnp.ndarray):
+    """count/sum/min/max/sumsq in one pass (reference StatsAggregator /
+    ExtendedStatsAggregator)."""
+    w = match * jnp.where(present, 1.0, 0.0)
+    v = values_f32
+    count = jnp.sum(w)
+    s = jnp.sum(w * v)
+    ssq = jnp.sum(w * v * v)
+    mn = jnp.min(jnp.where(w > 0, v, F32_MAX))
+    mx = jnp.max(jnp.where(w > 0, v, -F32_MAX))
+    return count, s, mn, mx, ssq
+
+
+def value_count_keyword(kw: dict, match: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(_gather_match(match, kw["doc_of_value"]))
+
+
+def cardinality_keyword(kw: dict, match: jnp.ndarray, nvocab_pad: int) -> jnp.ndarray:
+    """Exact distinct count via ordinals (the reference uses global ords +
+    HLL; segment-local ords are exact on-device, merged across segments on
+    the host via vocab union)."""
+    counts = terms_counts(kw, match, nvocab_pad)
+    return jnp.sum(jnp.where(counts > 0, 1, 0))
+
+
+def _hash_f32(v: jnp.ndarray) -> jnp.ndarray:
+    """Cheap 32-bit integer mix (fmix32 from MurmurHash3) of float bit patterns."""
+    h = jax.lax.bitcast_convert_type(v, jnp.int32).astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def cardinality_numeric_hll(values_f32: jnp.ndarray, present: jnp.ndarray,
+                            match: jnp.ndarray, log2m: int = 12) -> jnp.ndarray:
+    """HyperLogLog on device (reference CardinalityAggregator's HLL++,
+    without the sparse/linear-counting low range — bias-corrected below):
+    registers via scatter-max of the rank of the remaining hash bits."""
+    m = 1 << log2m
+    h = _hash_f32(values_f32)
+    reg = (h & (m - 1)).astype(jnp.int32)
+    rest = h >> log2m
+    # rank = leading position of first set bit in the remaining 32-log2m bits
+    nbits = 32 - log2m
+    rank = (nbits + 1) - jnp.ceil(jnp.log2(rest.astype(jnp.float32) + 1.0)).astype(jnp.int32)
+    rank = jnp.clip(rank, 1, nbits + 1)
+    w = (match > 0) & present
+    reg = jnp.where(w, reg, m)  # dropped
+    regs = jnp.zeros(m, jnp.int32).at[reg].max(jnp.where(w, rank, 0), mode="drop")
+    # harmonic mean estimate with small-range linear counting correction
+    z = jnp.sum(2.0 ** (-regs.astype(jnp.float32)))
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    est = alpha * m * m / z
+    zeros = jnp.sum(jnp.where(regs == 0, 1.0, 0.0))
+    lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+    return jnp.where((est <= 2.5 * m) & (zeros > 0), lin, est)
+
+
+def percentile_values(values_f32: jnp.ndarray, present: jnp.ndarray, match: jnp.ndarray,
+                      qs: jnp.ndarray) -> jnp.ndarray:
+    """Percentiles by full device sort (exact for f32; the reference uses
+    approximate TDigest — we can afford the exact sort at HBM bandwidth)."""
+    w = (match > 0) & present
+    n = jnp.sum(w.astype(jnp.int32))
+    vals = jnp.where(w, values_f32, F32_MAX)
+    svals = jnp.sort(vals)
+    pos = jnp.clip((qs / 100.0) * jnp.maximum(n - 1, 0).astype(jnp.float32), 0, values_f32.shape[0] - 1)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.ceil(pos).astype(jnp.int32)
+    frac = pos - lo.astype(jnp.float32)
+    return svals[lo] * (1 - frac) + svals[hi] * frac
+
+
+def min_ord_sort_key(min_ord: jnp.ndarray, descending: bool, missing_last: bool) -> jnp.ndarray:
+    """Keyword sort keys from per-doc min ordinals; missing docs pushed to the
+    configured end (reference: SortedSetSortField missing _first/_last)."""
+    key = min_ord.astype(jnp.float32)
+    big = jnp.float32(2.0**30)
+    missing_val = big if (missing_last != descending) else -big
+    key = jnp.where(min_ord < 0, missing_val, key)
+    return -key if descending else key

@@ -1,0 +1,4 @@
+"""Version info. Analog of reference `server/src/main/java/org/opensearch/Version.java`."""
+
+__version__ = "0.1.0"
+LUCENE_ANALOG_VERSION = "tpu-csr-1"  # postings/codec layout version
