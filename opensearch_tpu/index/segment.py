@@ -239,7 +239,6 @@ class Segment:
                     "ords": jnp.asarray(_pad_to(col.ords, vpad, np.int32(-1))),
                     "doc_of_value": jnp.asarray(_pad_to(col.doc_of_value, vpad, INT32_SENTINEL)),
                     "min_ord": jnp.asarray(_pad_to(col.min_ord, dpad, np.int32(-1))),
-                    "nvocab": len(col.vocab),
                 }
             gcols = {}
             for f, col in self.geo_cols.items():
@@ -250,9 +249,11 @@ class Segment:
                 }
             dls = {f: jnp.asarray(_pad_to(dl.astype(np.float32), dpad, np.float32(0)))
                    for f, dl in self.doc_lens.items()}
+            # NOTE: values must all be arrays — plain ints would become traced
+            # jit arguments and poison static shape derivation downstream
             self._device = {
                 "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
-                "doc_lens": dls, "ndocs": self.ndocs, "ndocs_pad": dpad,
+                "doc_lens": dls,
             }
         if self._device_live_dirty:
             import jax.numpy as jnp

@@ -43,7 +43,8 @@ def terms_sub_metric(kw: dict, match: jnp.ndarray, values_f32: jnp.ndarray,
         jnp.where(w > 0, v, F32_MAX), mode="drop")
     maxs = jnp.full(nvocab_pad, -F32_MAX).at[ords].max(
         jnp.where(w > 0, v, -F32_MAX), mode="drop")
-    return sums, cnts, mins, maxs
+    sumsq = jnp.zeros(nvocab_pad, jnp.float32).at[ords].add(w * v * v, mode="drop")
+    return sums, cnts, mins, maxs, sumsq
 
 
 def histogram_counts(values_f32: jnp.ndarray, present: jnp.ndarray, match: jnp.ndarray,
@@ -103,29 +104,32 @@ def _hash_f32(v: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
-def cardinality_numeric_hll(values_f32: jnp.ndarray, present: jnp.ndarray,
-                            match: jnp.ndarray, log2m: int = 12) -> jnp.ndarray:
-    """HyperLogLog on device (reference CardinalityAggregator's HLL++,
-    without the sparse/linear-counting low range — bias-corrected below):
-    registers via scatter-max of the rank of the remaining hash bits."""
+def hll_registers(hashes_u32: jnp.ndarray, valid: jnp.ndarray, log2m: int = 14) -> jnp.ndarray:
+    """HyperLogLog registers from 32-bit hashes via scatter-max (the
+    mergeable core of reference CardinalityAggregator's HLL++; merge across
+    segments/shards = elementwise max on the host). Returns i32[2^log2m]."""
     m = 1 << log2m
-    h = _hash_f32(values_f32)
-    reg = (h & (m - 1)).astype(jnp.int32)
-    rest = h >> log2m
-    # rank = leading position of first set bit in the remaining 32-log2m bits
+    reg = (hashes_u32 & jnp.uint32(m - 1)).astype(jnp.int32)
+    rest = hashes_u32 >> log2m
+    # rank = position of the first set bit in the remaining 32-log2m bits
     nbits = 32 - log2m
     rank = (nbits + 1) - jnp.ceil(jnp.log2(rest.astype(jnp.float32) + 1.0)).astype(jnp.int32)
     rank = jnp.clip(rank, 1, nbits + 1)
-    w = (match > 0) & present
-    reg = jnp.where(w, reg, m)  # dropped
-    regs = jnp.zeros(m, jnp.int32).at[reg].max(jnp.where(w, rank, 0), mode="drop")
-    # harmonic mean estimate with small-range linear counting correction
-    z = jnp.sum(2.0 ** (-regs.astype(jnp.float32)))
-    alpha = 0.7213 / (1.0 + 1.079 / m)
-    est = alpha * m * m / z
-    zeros = jnp.sum(jnp.where(regs == 0, 1.0, 0.0))
-    lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
-    return jnp.where((est <= 2.5 * m) & (zeros > 0), lin, est)
+    reg = jnp.where(valid, reg, m)  # invalid -> dropped
+    return jnp.zeros(m, jnp.int32).at[reg].max(jnp.where(valid, rank, 0), mode="drop")
+
+
+def cardinality_numeric_registers(values_f32: jnp.ndarray, present: jnp.ndarray,
+                                  match: jnp.ndarray, log2m: int = 14) -> jnp.ndarray:
+    return hll_registers(_hash_f32(values_f32), (match > 0) & present, log2m)
+
+
+def cardinality_keyword_registers(kw: dict, match: jnp.ndarray, nvocab_pad: int,
+                                  ord_hashes_u32: jnp.ndarray, log2m: int = 14) -> jnp.ndarray:
+    """Keyword cardinality: HLL over per-ordinal string hashes (host-computed
+    once per segment), activated by matched ordinals."""
+    counts = terms_counts(kw, match, nvocab_pad)
+    return hll_registers(ord_hashes_u32, counts > 0, log2m)
 
 
 def percentile_values(values_f32: jnp.ndarray, present: jnp.ndarray, match: jnp.ndarray,

@@ -1,0 +1,4 @@
+from .executor import ShardSearcher, search_shards
+from .query_dsl import parse_query
+
+__all__ = ["ShardSearcher", "search_shards", "parse_query"]

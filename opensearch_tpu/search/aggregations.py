@@ -1,0 +1,365 @@
+"""Aggregations: DSL parsing + cross-segment/shard merge + response shaping.
+Analog of reference `search/aggregations/` (AggregatorFactories parse tree,
+InternalAggregation#reduce, and the response XContent shapes).
+
+Device emission lives in `compiler.py` (same jitted program as scoring);
+this module is host-only: it defines the agg tree, merges per-segment
+partials (the analog of InternalAggregation.reduce), and renders the
+OpenSearch-shaped response JSON.
+
+Design notes vs the reference:
+- terms aggs are exact per shard (full ordinal bincount on device — no
+  shard_size truncation error; doc_count_error_upper_bound is honestly 0).
+- cardinality is device-side HyperLogLog (log2m=14) over value hashes —
+  mergeable across segments and shards like the reference's HLL++.
+- percentiles use a mergeable 4096-bin histogram sketch between index-wide
+  column bounds instead of TDigest.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+BUCKET_KINDS = {"terms", "histogram", "date_histogram", "range", "date_range",
+                "filter", "filters", "global", "missing"}
+METRIC_KINDS = {"min", "max", "sum", "avg", "stats", "extended_stats",
+                "value_count", "cardinality", "percentiles", "top_hits"}
+PIPELINE_KINDS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
+                  "stats_bucket", "cumulative_sum", "derivative", "bucket_script",
+                  "bucket_selector"}
+
+
+@dataclass
+class AggNode:
+    name: str
+    kind: str
+    body: dict
+    subs: List["AggNode"] = dc_field(default_factory=list)
+    pipelines: List["AggNode"] = dc_field(default_factory=list)
+
+
+def parse_aggs(aggs: Optional[dict]) -> List[AggNode]:
+    out: List[AggNode] = []
+    if not aggs:
+        return out
+    for name, spec in aggs.items():
+        sub_specs = spec.get("aggs", spec.get("aggregations"))
+        kinds = [k for k in spec if k not in ("aggs", "aggregations", "meta")]
+        if len(kinds) != 1:
+            raise ValueError(f"aggregation [{name}] must define exactly one type")
+        kind = kinds[0]
+        if kind not in BUCKET_KINDS | METRIC_KINDS | PIPELINE_KINDS:
+            raise ValueError(f"unknown aggregation type [{kind}]")
+        node = AggNode(name, kind, spec[kind])
+        children = parse_aggs(sub_specs)
+        node.subs = [c for c in children if c.kind not in PIPELINE_KINDS]
+        node.pipelines = [c for c in children if c.kind in PIPELINE_KINDS]
+        if kind in METRIC_KINDS and node.subs:
+            raise ValueError(f"metric aggregation [{name}] cannot have sub-aggregations")
+        out.append(node)
+    return out
+
+
+# ---------------- merge (reduce) ----------------
+
+def merge_partials(node: AggNode, partials: List[dict]) -> dict:
+    """Merge per-segment/per-shard partials for one agg node (reference:
+    InternalAggregation#reduce). Each partial is a host dict produced by the
+    compiler's device run + segment context."""
+    parts = [p for p in partials if p is not None]
+    if not parts:
+        return {}
+    kind = node.kind
+    if kind == "terms":
+        acc: Dict[Any, dict] = {}
+        for p in parts:
+            for key, rec in p["buckets"].items():
+                slot = acc.setdefault(key, {"doc_count": 0, "subs": []})
+                slot["doc_count"] += rec["doc_count"]
+                slot["subs"].append(rec.get("subs"))
+        for key, slot in acc.items():
+            slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
+        return {"buckets": acc}
+    if kind in ("histogram", "date_histogram"):
+        acc = {}
+        for p in parts:
+            for b, rec in p["buckets"].items():
+                slot = acc.setdefault(b, {"doc_count": 0, "subs": []})
+                slot["doc_count"] += rec["doc_count"]
+                slot["subs"].append(rec.get("subs"))
+        for b, slot in acc.items():
+            slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
+        return {"buckets": acc, "interval": parts[0]["interval"],
+                "offset": parts[0].get("offset", 0.0), "keyed_fmt": parts[0].get("keyed_fmt")}
+    if kind in ("range", "date_range", "filters"):
+        acc = {}
+        for p in parts:
+            for key, rec in p["buckets"].items():
+                slot = acc.setdefault(key, {"doc_count": 0, "subs": [], "meta": rec.get("meta")})
+                slot["doc_count"] += rec["doc_count"]
+                slot["subs"].append(rec.get("subs"))
+        for key, slot in acc.items():
+            slot["subs"] = _merge_subtrees(node.subs, slot["subs"])
+        return {"buckets": acc}
+    if kind in ("filter", "global", "missing"):
+        total = sum(p["doc_count"] for p in parts)
+        subs = _merge_subtrees(node.subs, [p.get("subs") for p in parts])
+        return {"doc_count": total, "subs": subs}
+    if kind in ("min", "max", "sum", "avg", "stats", "extended_stats", "value_count"):
+        return _merge_stats(parts)
+    if kind == "cardinality":
+        regs = parts[0]["registers"]
+        for p in parts[1:]:
+            regs = np.maximum(regs, p["registers"])
+        return {"registers": regs}
+    if kind == "percentiles":
+        hist = parts[0]["hist"].copy()
+        for p in parts[1:]:
+            hist += p["hist"]
+        return {"hist": hist, "lo": parts[0]["lo"], "hi": parts[0]["hi"],
+                "percents": parts[0]["percents"]}
+    if kind == "top_hits":
+        rows = [r for p in parts for r in p["hits"]]
+        rows.sort(key=lambda r: -r["_score"] if r["_score"] is not None else 0)
+        return {"hits": rows[: parts[0]["size"]], "total": sum(p["total"] for p in parts)}
+    raise ValueError(f"cannot merge aggregation kind [{kind}]")
+
+
+def _merge_stats(parts: List[dict]) -> dict:
+    count = sum(p["count"] for p in parts)
+    s = sum(p["sum"] for p in parts)
+    ssq = sum(p.get("sumsq", 0.0) for p in parts)
+    mn = min((p["min"] for p in parts if p["count"] > 0), default=float("inf"))
+    mx = max((p["max"] for p in parts if p["count"] > 0), default=float("-inf"))
+    return {"count": count, "sum": s, "min": mn, "max": mx, "sumsq": ssq}
+
+
+def _merge_sub_metrics(subs: List[AggNode], partial_lists: List[Optional[dict]]) -> dict:
+    out = {}
+    for sub in subs:
+        parts = [pl.get(sub.name) for pl in partial_lists if pl]
+        out[sub.name] = merge_partials(sub, parts)
+    return out
+
+
+def _merge_subtrees(subs: List[AggNode], partial_lists: List[Optional[dict]]) -> dict:
+    return _merge_sub_metrics(subs, partial_lists)
+
+
+# ---------------- finalize (response shaping) ----------------
+
+def finalize(node: AggNode, merged: dict) -> dict:
+    kind = node.kind
+    if not merged:
+        return _empty_result(node)
+    if kind == "terms":
+        size = int(node.body.get("size", 10))
+        order = node.body.get("order", {"_count": "desc"})
+        (okey, odir), = order.items() if isinstance(order, dict) else [("_count", "desc")]
+        items = [(k, v) for k, v in merged["buckets"].items() if v["doc_count"] > 0]
+        min_doc_count = int(node.body.get("min_doc_count", 1))
+        items = [(k, v) for k, v in items if v["doc_count"] >= min_doc_count]
+        if okey == "_key":
+            items.sort(key=lambda kv: kv[0], reverse=(odir == "desc"))
+        else:
+            items.sort(key=lambda kv: (-kv[1]["doc_count"], kv[0])
+                       if odir == "desc" else (kv[1]["doc_count"], kv[0]))
+        total_count = sum(v["doc_count"] for _, v in items)
+        buckets = []
+        for k, v in items[:size]:
+            b = {"key": k, "doc_count": int(v["doc_count"])}
+            for sub in node.subs:
+                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}))
+            _apply_pipelines(node, buckets_ref=None)
+            buckets.append(b)
+        shown = sum(b["doc_count"] for b in buckets)
+        result = {"doc_count_error_upper_bound": 0,
+                  "sum_other_doc_count": int(total_count - shown),
+                  "buckets": buckets}
+        _apply_bucket_pipelines(node, result)
+        return result
+    if kind in ("histogram", "date_histogram"):
+        buckets = []
+        for b in sorted(merged["buckets"]):
+            rec = merged["buckets"][b]
+            if rec["doc_count"] <= 0 and int(node.body.get("min_doc_count", 0)) > 0:
+                continue
+            key = b * merged["interval"] + merged.get("offset", 0.0)
+            entry = {"key": key, "doc_count": int(rec["doc_count"])}
+            if kind == "date_histogram":
+                entry["key"] = int(key)
+                entry["key_as_string"] = _format_epoch_ms(int(key))
+            for sub in node.subs:
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+            buckets.append(entry)
+        result = {"buckets": buckets}
+        _apply_bucket_pipelines(node, result)
+        return result
+    if kind in ("range", "date_range"):
+        buckets = []
+        for key in merged["buckets"]:
+            rec = merged["buckets"][key]
+            entry = {"key": key, "doc_count": int(rec["doc_count"])}
+            if rec.get("meta"):
+                entry.update(rec["meta"])
+            for sub in node.subs:
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+            buckets.append(entry)
+        return {"buckets": buckets}
+    if kind == "filters":
+        buckets = {}
+        for key in merged["buckets"]:
+            rec = merged["buckets"][key]
+            entry = {"doc_count": int(rec["doc_count"])}
+            for sub in node.subs:
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+            buckets[key] = entry
+        return {"buckets": buckets}
+    if kind in ("filter", "global", "missing"):
+        out = {"doc_count": int(merged["doc_count"])}
+        for sub in node.subs:
+            out[sub.name] = finalize(sub, merged["subs"].get(sub.name, {}))
+        return out
+    if kind == "value_count":
+        return {"value": int(merged["count"])}
+    if kind == "min":
+        return {"value": None if merged["count"] == 0 else merged["min"]}
+    if kind == "max":
+        return {"value": None if merged["count"] == 0 else merged["max"]}
+    if kind == "sum":
+        return {"value": merged["sum"]}
+    if kind == "avg":
+        return {"value": None if merged["count"] == 0 else merged["sum"] / merged["count"]}
+    if kind == "stats":
+        c = merged["count"]
+        return {"count": int(c), "min": None if c == 0 else merged["min"],
+                "max": None if c == 0 else merged["max"], "sum": merged["sum"],
+                "avg": None if c == 0 else merged["sum"] / c}
+    if kind == "extended_stats":
+        c = merged["count"]
+        if c == 0:
+            return {"count": 0, "min": None, "max": None, "sum": 0.0, "avg": None,
+                    "sum_of_squares": 0.0, "variance": None, "std_deviation": None}
+        var = max(merged["sumsq"] / c - (merged["sum"] / c) ** 2, 0.0)
+        return {"count": int(c), "min": merged["min"], "max": merged["max"],
+                "sum": merged["sum"], "avg": merged["sum"] / c,
+                "sum_of_squares": merged["sumsq"], "variance": var,
+                "std_deviation": math.sqrt(var)}
+    if kind == "cardinality":
+        return {"value": int(round(_hll_estimate(merged["registers"])))}
+    if kind == "percentiles":
+        return {"values": _hist_percentiles(merged)}
+    if kind == "top_hits":
+        return {"hits": {"total": {"value": int(merged["total"]), "relation": "eq"},
+                         "max_score": merged["hits"][0]["_score"] if merged["hits"] else None,
+                         "hits": merged["hits"]}}
+    raise ValueError(f"cannot finalize aggregation kind [{kind}]")
+
+
+def _empty_result(node: AggNode) -> dict:
+    if node.kind in ("terms", "histogram", "date_histogram", "range", "date_range", "filters"):
+        return {"buckets": [] if node.kind != "filters" else {}}
+    if node.kind in ("filter", "global", "missing"):
+        return {"doc_count": 0}
+    if node.kind in ("min", "max", "avg"):
+        return {"value": None}
+    if node.kind in ("sum", "value_count", "cardinality"):
+        return {"value": 0}
+    if node.kind == "stats":
+        return {"count": 0, "min": None, "max": None, "sum": 0.0, "avg": None}
+    if node.kind == "percentiles":
+        return {"values": {}}
+    return {}
+
+
+def _hll_estimate(regs: np.ndarray) -> float:
+    m = len(regs)
+    z = float(np.sum(np.exp2(-regs.astype(np.float64))))
+    alpha = 0.7213 / (1.0 + 1.079 / m)
+    est = alpha * m * m / z
+    zeros = int(np.sum(regs == 0))
+    if est <= 2.5 * m and zeros > 0:
+        return m * math.log(m / zeros)
+    return est
+
+
+def _hist_percentiles(merged: dict) -> Dict[str, float]:
+    hist = merged["hist"].astype(np.float64)
+    lo, hi = merged["lo"], merged["hi"]
+    total = hist.sum()
+    out: Dict[str, float] = {}
+    if total == 0:
+        return {f"{p:.1f}": None for p in merged["percents"]}
+    cum = np.cumsum(hist)
+    nb = len(hist)
+    width = (hi - lo) / nb if hi > lo else 0.0
+    for p in merged["percents"]:
+        target = p / 100.0 * total
+        b = int(np.searchsorted(cum, target, side="left"))
+        b = min(b, nb - 1)
+        prev = cum[b - 1] if b > 0 else 0.0
+        frac = 0.0 if hist[b] == 0 else (target - prev) / hist[b]
+        out[f"{p:.1f}"] = lo + (b + frac) * width if width > 0 else lo
+    return out
+
+
+def _format_epoch_ms(ms: int) -> str:
+    import datetime as dt
+
+    return dt.datetime.fromtimestamp(ms / 1000.0, dt.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+# ---------------- pipeline aggregations (host post-processing) ----------------
+
+def _apply_pipelines(node: AggNode, buckets_ref) -> None:  # placeholder hook
+    return
+
+
+def _apply_bucket_pipelines(node: AggNode, result: dict) -> None:
+    """Sibling pipeline aggs over this bucket agg's finalized buckets
+    (reference `search/aggregations/pipeline/`): cumulative_sum, derivative
+    attach per-bucket; *_bucket kinds attach as sibling values."""
+    buckets = result.get("buckets")
+    if not isinstance(buckets, list):
+        return
+    for p in node.pipelines:
+        path = p.body.get("buckets_path", "_count")
+        series = []
+        for b in buckets:
+            if path == "_count":
+                series.append(float(b["doc_count"]))
+            else:
+                head = path.split(">")[0].split(".")[0]
+                sub = b.get(head, {})
+                leaf = path.split(".")[-1] if "." in path else "value"
+                series.append(sub.get(leaf) if isinstance(sub, dict) else None)
+        vals = [v for v in series if v is not None]
+        if p.kind == "cumulative_sum":
+            run = 0.0
+            for b, v in zip(buckets, series):
+                run += (v or 0.0)
+                b[p.name] = {"value": run}
+        elif p.kind == "derivative":
+            prev = None
+            for b, v in zip(buckets, series):
+                b[p.name] = {"value": None if prev is None or v is None else v - prev}
+                prev = v
+        elif p.kind in ("avg_bucket", "sum_bucket", "min_bucket", "max_bucket", "stats_bucket"):
+            if p.kind == "avg_bucket":
+                result[p.name] = {"value": sum(vals) / len(vals) if vals else None}
+            elif p.kind == "sum_bucket":
+                result[p.name] = {"value": sum(vals)}
+            elif p.kind == "min_bucket":
+                result[p.name] = {"value": min(vals) if vals else None}
+            elif p.kind == "max_bucket":
+                result[p.name] = {"value": max(vals) if vals else None}
+            else:
+                result[p.name] = {"count": len(vals), "sum": sum(vals),
+                                  "min": min(vals) if vals else None,
+                                  "max": max(vals) if vals else None,
+                                  "avg": sum(vals) / len(vals) if vals else None}

@@ -1,0 +1,1668 @@
+"""Query compiler: DSL tree -> logical plan -> jitted device program.
+
+The analog of the reference chain QueryBuilder.toQuery -> Query.rewrite ->
+Weight/Scorer (`index/query/*`, Lucene createWeight), redesigned for XLA:
+
+1. `rewrite(query, ctx)` runs once per query on the host: analysis,
+   multi-term expansion, index-wide idf/avgdl statistics -> a LogicalNode
+   tree whose *structure* is static and whose numeric inputs are arrays.
+2. `prepare(node, segment)` binds the plan to one segment: term -> CSR row
+   lookups, pow2 bucket selection (from host row pointers — no device sync),
+   producing a `spec` (hashable static structure) + `params` (traced arrays).
+3. `build_executor(spec)` constructs the traced function interpreting the
+   spec; it is jitted once per spec and cached — segments with equal padded
+   shapes and queries with equal structure all reuse the same XLA program.
+
+Every node evaluates to a dense ScoredMask over ndocs_pad; scoring leaves are
+gather->scatter passes (ops.scoring), predicates are vectorized column
+compares, and combinators are elementwise VPU ops that XLA fuses.
+"""
+
+from __future__ import annotations
+
+import fnmatch as _fnmatch
+import re
+from bisect import bisect_left, bisect_right
+from dataclasses import dataclass, field as dc_field
+from functools import lru_cache
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..index.mappings import (FLOAT_TYPES, INT_TYPES, KEYWORD_TYPES, TEXT_TYPES,
+                              Mappings, coerce_value)
+from ..index.segment import Segment, next_pow2, split_i64
+from ..models.similarity import Similarity, resolve_similarity
+from ..ops import aggs as agg_ops
+from ..ops import scoring as ops
+from . import query_dsl as dsl
+from .aggregations import AggNode
+
+INT32_SENTINEL = np.int32(2**31 - 1)
+HLL_LOG2M = 14
+PCTL_BINS = 4096
+
+
+# =====================================================================
+# shard context (index-wide statistics)
+# =====================================================================
+
+class ShardContext:
+    """Index-wide view used during rewrite (reference QueryShardContext)."""
+
+    def __init__(self, mappings: Mappings, segments: List[Segment],
+                 similarity=None, field_similarities: Optional[dict] = None):
+        self.mappings = mappings
+        self.segments = segments
+        self.default_sim = resolve_similarity(similarity)
+        self.field_sims = {f: resolve_similarity(s)
+                           for f, s in (field_similarities or {}).items()}
+
+    def sim_for(self, field: str) -> Similarity:
+        return self.field_sims.get(field, self.default_sim)
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.ndocs for s in self.segments)  # incl. deleted, like Lucene maxDoc
+
+    def doc_freq(self, field: str, term: str) -> int:
+        return sum(s.postings[field].doc_freq(term)
+                   for s in self.segments if field in s.postings)
+
+    def collection_tf(self, field: str, term: str) -> float:
+        total = 0.0
+        for s in self.segments:
+            pb = s.postings.get(field)
+            if pb is None:
+                continue
+            r = pb.row(term)
+            if r >= 0:
+                a, b = pb.row_slice(r)
+                total += float(pb.tfs[a:b].sum())
+        return total
+
+    def field_stats(self, field: str) -> Tuple[int, int]:
+        doc_count, sum_dl = 0, 0
+        for s in self.segments:
+            st = s.text_stats.get(field)
+            if st:
+                doc_count += st.doc_count
+                sum_dl += st.sum_dl
+        return doc_count, sum_dl
+
+    def avgdl(self, field: str) -> float:
+        dc, sdl = self.field_stats(field)
+        return (sdl / dc) if dc > 0 else 1.0
+
+    def total_tf(self, field: str) -> float:
+        _, sdl = self.field_stats(field)
+        return float(max(sdl, 1))
+
+
+# =====================================================================
+# logical plan nodes
+# =====================================================================
+
+_node_counter = [0]
+
+
+def _nid() -> int:
+    _node_counter[0] += 1
+    return _node_counter[0]
+
+
+@dataclass
+class LNode:
+    nid: int = dc_field(default_factory=_nid)
+    name: Optional[str] = None  # _name
+
+
+@dataclass
+class LTerms(LNode):
+    """One weighted term group over a field — the fused scoring leaf."""
+
+    field: str = ""
+    terms: List[str] = dc_field(default_factory=list)
+    weights: Optional[np.ndarray] = None   # f32[T] idf*boost
+    aux: Optional[np.ndarray] = None       # f32[T] (LM collection prob)
+    msm: int = 1
+    mode: str = "score"                    # score | filter
+    sim: Optional[Similarity] = None
+    has_norms: bool = True
+    boost: float = 1.0                     # filter-mode constant score
+
+
+@dataclass
+class LExpandTerms(LNode):
+    """Multi-term expansion (prefix/wildcard/fuzzy/regexp/keyword-range):
+    rows resolved per segment via `expander(segment) -> np.ndarray[rows]`.
+    Constant-score like Lucene's MultiTermQuery CONSTANT_SCORE rewrite."""
+
+    field: str = ""
+    expander: Optional[Callable[[Segment], np.ndarray]] = None
+    boost: float = 1.0
+
+
+@dataclass
+class LMatchAll(LNode):
+    boost: float = 1.0
+
+
+@dataclass
+class LMatchNone(LNode):
+    pass
+
+
+@dataclass
+class LRange(LNode):
+    field: str = ""
+    kind: str = "int"                      # int | float
+    lo: Any = None                         # i64/f64 or None
+    hi: Any = None
+    include_lo: bool = True
+    include_hi: bool = True
+    boost: float = 1.0
+
+
+@dataclass
+class LExists(LNode):
+    field: str = ""
+    boost: float = 1.0
+
+
+@dataclass
+class LIds(LNode):
+    ids: List[str] = dc_field(default_factory=list)
+    boost: float = 1.0
+
+
+@dataclass
+class LBool(LNode):
+    musts: List[LNode] = dc_field(default_factory=list)
+    shoulds: List[LNode] = dc_field(default_factory=list)
+    must_nots: List[LNode] = dc_field(default_factory=list)
+    filters: List[LNode] = dc_field(default_factory=list)
+    msm: int = 0
+    boost: float = 1.0
+
+
+@dataclass
+class LConstScore(LNode):
+    child: Optional[LNode] = None
+    boost: float = 1.0
+
+
+@dataclass
+class LDisMax(LNode):
+    children: List[LNode] = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+    boost: float = 1.0
+
+
+@dataclass
+class LBoosting(LNode):
+    positive: Optional[LNode] = None
+    negative: Optional[LNode] = None
+    negative_boost: float = 0.5
+    boost: float = 1.0
+
+
+@dataclass
+class LFuncScore(LNode):
+    child: Optional[LNode] = None
+    functions: List[dsl.ScoreFunction] = dc_field(default_factory=list)
+    fn_filters: List[Optional[LNode]] = dc_field(default_factory=list)
+    score_mode: str = "multiply"
+    boost_mode: str = "multiply"
+    min_score: Optional[float] = None
+    boost: float = 1.0
+
+
+@dataclass
+class LGeoDist(LNode):
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    radius_m: float = 0.0
+    boost: float = 1.0
+
+
+@dataclass
+class LGeoBox(LNode):
+    field: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
+    boost: float = 1.0
+
+
+# =====================================================================
+# rewrite: DSL tree -> logical plan (host, index-wide stats)
+# =====================================================================
+
+def rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool = True) -> LNode:
+    out = _rewrite(q, ctx, scoring)
+    out.name = getattr(q, "name", None) or out.name
+    return out
+
+
+def _weighted_terms(field: str, terms: List[str], boosts: List[float],
+                    ctx: ShardContext, msm: int, mode: str, boost: float) -> LTerms:
+    ft = ctx.mappings.resolve_field(field)
+    sim = ctx.sim_for(field)
+    has_norms = bool(ft is not None and ft.has_norms and sim.uses_norms)
+    n = ctx.num_docs
+    weights = np.zeros(len(terms), dtype=np.float32)
+    aux = np.zeros(len(terms), dtype=np.float32)
+    for i, t in enumerate(terms):
+        df = ctx.doc_freq(field, t)
+        weights[i] = sim.term_weight(boosts[i] * boost, n, max(df, 0)) if df > 0 else 0.0
+        if sim.sim_id == ops.SIM_LM_DIRICHLET:
+            aux[i] = sim.term_aux(ctx.collection_tf(field, t), ctx.total_tf(field))
+    return LTerms(field=field, terms=terms, weights=weights, aux=aux, msm=msm,
+                  mode=mode, sim=sim, has_norms=has_norms, boost=boost)
+
+
+def _analyze_query_text(field: str, text: Any, ctx: ShardContext,
+                        analyzer_override: Optional[str] = None) -> List[str]:
+    ft = ctx.mappings.resolve_field(field)
+    if ft is None:
+        return [str(text)]
+    if analyzer_override:
+        return ctx.mappings.analysis.get(analyzer_override).terms(str(text))
+    return ctx.mappings.search_analyzer_for(ft).terms(str(text))
+
+
+def _index_term(field: str, value: Any, ctx: ShardContext) -> str:
+    """Single exact term for term/terms queries: keyword normalizer applies,
+    text fields match the raw token (reference TermQueryBuilder semantics)."""
+    ft = ctx.mappings.resolve_field(field)
+    if ft is not None and ft.type in KEYWORD_TYPES:
+        norm = ctx.mappings.index_analyzer(ft).terms(str(value))
+        return norm[0] if norm else str(value)
+    return str(value)
+
+
+def _numeric_eq_node(ft, field: str, value: Any, boost: float) -> LNode:
+    cv = coerce_value(ft, value)
+    kind = "float" if ft.type in FLOAT_TYPES else "int"
+    return LRange(field=field, kind=kind, lo=cv, hi=cv,
+                  include_lo=True, include_hi=True, boost=boost)
+
+
+def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: C901
+    m = ctx.mappings
+
+    if isinstance(q, dsl.MatchAllQuery):
+        return LMatchAll(boost=q.boost)
+    if isinstance(q, dsl.MatchNoneQuery):
+        return LMatchNone()
+
+    if isinstance(q, dsl.TermQuery):
+        ft = m.resolve_field(q.field)
+        if ft is not None and ft.type in (INT_TYPES | FLOAT_TYPES) and ft.type != "date":
+            return _numeric_eq_node(ft, ft.name, q.value, q.boost)
+        if ft is not None and ft.type == "date":
+            return _numeric_eq_node(ft, ft.name, q.value, q.boost)
+        field = ft.name if ft else q.field
+        term = _index_term(field, q.value, ctx)
+        if q.case_insensitive:
+            term = term.lower()
+        mode = "score" if scoring else "filter"
+        return _weighted_terms(field, [term], [1.0], ctx, 1, mode, q.boost)
+
+    if isinstance(q, dsl.TermsQuery):
+        ft = m.resolve_field(q.field)
+        if ft is not None and ft.type in (INT_TYPES | FLOAT_TYPES):
+            children = [_numeric_eq_node(ft, ft.name, v, 1.0) for v in q.values]
+            return LBool(shoulds=children, msm=1, boost=q.boost)
+        field = ft.name if ft else q.field
+        terms = [_index_term(field, v, ctx) for v in q.values]
+        # terms query is constant-score (reference TermInSetQuery)
+        return _weighted_terms(field, terms, [1.0] * len(terms), ctx, 1, "filter", q.boost)
+
+    if isinstance(q, dsl.MatchQuery):
+        ft = m.resolve_field(q.field)
+        if ft is not None and ft.type in (INT_TYPES | FLOAT_TYPES) and ft.type != "date":
+            return _numeric_eq_node(ft, ft.name, q.query, q.boost)
+        field = ft.name if ft else q.field
+        terms = _analyze_query_text(field, q.query, ctx, q.analyzer)
+        if not terms:
+            return LMatchNone()
+        if q.fuzziness is not None:
+            expanded: List[LNode] = []
+            for t in terms:
+                expanded.append(LExpandTerms(field=field,
+                                             expander=_fuzzy_expander(field, t, q.fuzziness, 0),
+                                             boost=q.boost))
+            msm = len(expanded) if q.operator == "and" else \
+                dsl.parse_minimum_should_match(q.minimum_should_match, len(expanded)) or 1
+            return LBool(shoulds=expanded, msm=msm, boost=1.0)
+        msm = len(terms) if q.operator == "and" else \
+            dsl.parse_minimum_should_match(q.minimum_should_match, len(terms)) or 1
+        mode = "score" if scoring else "score"  # scores also drive msm counts
+        return _weighted_terms(field, terms, [1.0] * len(terms), ctx, msm, mode, q.boost)
+
+    if isinstance(q, dsl.MultiMatchQuery):
+        children = [rewrite(dsl.MatchQuery(field=f.split("^")[0], query=q.query,
+                                           operator=q.operator,
+                                           minimum_should_match=q.minimum_should_match,
+                                           boost=float(f.split("^")[1]) if "^" in f else 1.0),
+                    ctx, scoring) for f in q.fields]
+        if q.type in ("best_fields", "phrase"):
+            return LDisMax(children=children, tie_breaker=q.tie_breaker, boost=q.boost)
+        return LBool(shoulds=children, msm=1, boost=q.boost)  # most_fields
+
+    if isinstance(q, dsl.MatchPhraseQuery):
+        # r1: phrase == AND-match + host positional verification in the fetch
+        # window (exact device phrase join lands with positional postings, r2)
+        field = q.field
+        terms = _analyze_query_text(field, q.query, ctx, q.analyzer)
+        if not terms:
+            return LMatchNone()
+        node = _weighted_terms(field, terms, [1.0] * len(terms), ctx, len(terms),
+                               "score", q.boost)
+        node.name = node.name or None
+        node._phrase_terms = terms  # host verify hook
+        node._phrase_slop = q.slop
+        return node
+
+    if isinstance(q, dsl.BoolQuery):
+        musts = [rewrite(c, ctx, scoring) for c in q.must]
+        shoulds = [rewrite(c, ctx, scoring) for c in q.should]
+        must_nots = [rewrite(c, ctx, False) for c in q.must_not]
+        filters = [rewrite(c, ctx, False) for c in q.filter]
+        n_should = len(shoulds)
+        if q.minimum_should_match is not None:
+            msm = dsl.parse_minimum_should_match(q.minimum_should_match, n_should)
+        else:
+            msm = 1 if (n_should and not musts and not filters) else 0
+        return LBool(musts=musts, shoulds=shoulds, must_nots=must_nots,
+                     filters=filters, msm=msm, boost=q.boost)
+
+    if isinstance(q, dsl.RangeQuery):
+        ft = m.resolve_field(q.field)
+        if ft is None:
+            return LMatchNone()
+        if ft.type in KEYWORD_TYPES and ft.type != "ip":
+            return LExpandTerms(field=ft.name,
+                                expander=_keyword_range_expander(ft.name, q),
+                                boost=q.boost)
+        kind = "float" if ft.type in FLOAT_TYPES else "int"
+        lo = hi = None
+        inc_lo = inc_hi = True
+        if q.gte is not None:
+            lo, inc_lo = coerce_value(ft, q.gte), True
+        if q.gt is not None:
+            lo, inc_lo = coerce_value(ft, q.gt), False
+        if q.lte is not None:
+            hi, inc_hi = coerce_value(ft, q.lte), True
+        if q.lt is not None:
+            hi, inc_hi = coerce_value(ft, q.lt), False
+        return LRange(field=ft.name, kind=kind, lo=lo, hi=hi,
+                      include_lo=inc_lo, include_hi=inc_hi, boost=q.boost)
+
+    if isinstance(q, dsl.ExistsQuery):
+        ft = m.resolve_field(q.field)
+        return LExists(field=ft.name if ft else q.field, boost=q.boost)
+
+    if isinstance(q, dsl.IdsQuery):
+        return LIds(ids=list(q.values), boost=q.boost)
+
+    if isinstance(q, dsl.ConstantScoreQuery):
+        return LConstScore(child=rewrite(q.filter, ctx, False), boost=q.boost)
+
+    if isinstance(q, dsl.BoostingQuery):
+        return LBoosting(positive=rewrite(q.positive, ctx, scoring),
+                         negative=rewrite(q.negative, ctx, False),
+                         negative_boost=q.negative_boost, boost=q.boost)
+
+    if isinstance(q, dsl.DisMaxQuery):
+        return LDisMax(children=[rewrite(c, ctx, scoring) for c in q.queries],
+                       tie_breaker=q.tie_breaker, boost=q.boost)
+
+    if isinstance(q, dsl.PrefixQuery):
+        return LExpandTerms(field=q.field, expander=_prefix_expander(q.field, q.value,
+                                                                     q.case_insensitive),
+                            boost=q.boost)
+    if isinstance(q, dsl.WildcardQuery):
+        return LExpandTerms(field=q.field, expander=_wildcard_expander(q.field, q.value,
+                                                                       q.case_insensitive),
+                            boost=q.boost)
+    if isinstance(q, dsl.RegexpQuery):
+        return LExpandTerms(field=q.field, expander=_regexp_expander(q.field, q.value),
+                            boost=q.boost)
+    if isinstance(q, dsl.FuzzyQuery):
+        return LExpandTerms(field=q.field,
+                            expander=_fuzzy_expander(q.field, q.value, q.fuzziness,
+                                                     q.prefix_length),
+                            boost=q.boost)
+
+    if isinstance(q, (dsl.QueryStringQuery, dsl.SimpleQueryStringQuery)):
+        return _rewrite_query_string(q, ctx, scoring)
+
+    if isinstance(q, dsl.GeoDistanceQuery):
+        return LGeoDist(field=q.field, lat=q.lat, lon=q.lon, radius_m=q.distance_m,
+                        boost=q.boost)
+    if isinstance(q, dsl.GeoBoundingBoxQuery):
+        return LGeoBox(field=q.field, top=q.top, left=q.left, bottom=q.bottom,
+                       right=q.right, boost=q.boost)
+
+    if isinstance(q, dsl.FunctionScoreQuery):
+        child = rewrite(q.query or dsl.MatchAllQuery(), ctx, scoring)
+        fn_filters = [rewrite(f.filter, ctx, False) if f.filter else None
+                      for f in q.functions]
+        return LFuncScore(child=child, functions=q.functions, fn_filters=fn_filters,
+                          score_mode=q.score_mode, boost_mode=q.boost_mode,
+                          min_score=q.min_score, boost=q.boost)
+
+    if isinstance(q, dsl.NestedQuery):
+        # r1: nested docs are indexed flattened, so delegate to the inner query
+        return rewrite(q.query, ctx, scoring)
+
+    raise dsl.QueryParseError(f"cannot compile query {type(q).__name__}")
+
+
+def _rewrite_query_string(q, ctx: ShardContext, scoring: bool) -> LNode:
+    """Mini query_string grammar: `field:term`, quoted phrases, +/- prefixes,
+    AND/OR, parentheses not supported in r1 (reference full grammar r2+)."""
+    default_fields = q.fields or ([q.default_field] if getattr(q, "default_field", None)
+                                  else ["*"])
+    if default_fields == ["*"]:
+        default_fields = [f for f, ft in ctx.mappings.fields.items()
+                          if ft.type in TEXT_TYPES]
+        if not default_fields:
+            default_fields = list(ctx.mappings.fields)[:1] or ["_all"]
+    tokens = re.findall(r'[+-]?(?:[\w.]+:)?(?:"[^"]*"|\S+)', q.query)
+    musts: List[LNode] = []
+    shoulds: List[LNode] = []
+    must_nots: List[LNode] = []
+    op_and = q.default_operator == "and"
+    for raw in tokens:
+        if raw in ("AND", "OR"):
+            op_and = raw == "AND"
+            continue
+        occur = "should"
+        if raw.startswith("+"):
+            occur, raw = "must", raw[1:]
+        elif raw.startswith("-"):
+            occur, raw = "must_not", raw[1:]
+        fields = default_fields
+        mm = re.match(r"([\w.]+):(.*)", raw)
+        if mm and ctx.mappings.resolve_field(mm.group(1)) is not None:
+            fields, raw = [mm.group(1)], mm.group(2)
+        raw = raw.strip('"')
+        if not raw:
+            continue
+        if "*" in raw or "?" in raw:
+            sub: LNode = LBool(shoulds=[LExpandTerms(field=f,
+                                                     expander=_wildcard_expander(f, raw, False))
+                                        for f in fields], msm=1)
+        else:
+            children = [rewrite(dsl.MatchQuery(field=f, query=raw), ctx, scoring)
+                        for f in fields]
+            sub = children[0] if len(children) == 1 else LDisMax(children=children)
+        {"must": musts, "should": shoulds, "must_not": must_nots}[occur].append(sub)
+    if op_and and shoulds and not musts:
+        musts, shoulds = shoulds, []
+    return LBool(musts=musts, shoulds=shoulds, must_nots=must_nots,
+                 msm=1 if shoulds and not musts else 0, boost=q.boost)
+
+
+# ---------------- multi-term expanders (host, per segment vocab) ----------------
+
+def _prefix_expander(field: str, prefix: str, ci: bool):
+    def expand(seg: Segment) -> np.ndarray:
+        pb = seg.postings.get(field)
+        if pb is None:
+            return np.empty(0, np.int32)
+        if ci:
+            rows = [i for i, t in enumerate(pb.vocab) if t.lower().startswith(prefix.lower())]
+            return np.asarray(rows, np.int32)
+        lo = bisect_left(pb.vocab, prefix)
+        hi = bisect_left(pb.vocab, prefix + "￿")
+        return np.arange(lo, hi, dtype=np.int32)
+    return expand
+
+
+def _wildcard_expander(field: str, pattern: str, ci: bool):
+    def expand(seg: Segment) -> np.ndarray:
+        pb = seg.postings.get(field)
+        if pb is None:
+            return np.empty(0, np.int32)
+        pat = pattern.lower() if ci else pattern
+        rows = [i for i, t in enumerate(pb.vocab)
+                if _fnmatch.fnmatchcase(t.lower() if ci else t, pat)]
+        return np.asarray(rows, np.int32)
+    return expand
+
+
+def _regexp_expander(field: str, pattern: str):
+    compiled = re.compile(pattern)
+    def expand(seg: Segment) -> np.ndarray:
+        pb = seg.postings.get(field)
+        if pb is None:
+            return np.empty(0, np.int32)
+        rows = [i for i, t in enumerate(pb.vocab) if compiled.fullmatch(t)]
+        return np.asarray(rows, np.int32)
+    return expand
+
+
+def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    if abs(len(a) - len(b)) > k:
+        return False
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i] + [0] * len(b)
+        lo = len(b) + 1
+        for j, cb in enumerate(b, 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            lo = min(lo, cur[j])
+        if lo > k:
+            return False
+        prev = cur
+    return prev[-1] <= k
+
+
+def _auto_fuzz(term: str, fuzziness) -> int:
+    if fuzziness in ("AUTO", "auto", None):
+        # reference Fuzziness.AUTO: 0 for <3 chars, 1 for 3-5, 2 for >5
+        return 0 if len(term) < 3 else (1 if len(term) <= 5 else 2)
+    return int(fuzziness)
+
+
+def _fuzzy_expander(field: str, term: str, fuzziness, prefix_length: int):
+    k = None
+    def expand(seg: Segment) -> np.ndarray:
+        nonlocal k
+        if k is None:
+            k = _auto_fuzz(term, fuzziness)
+        pb = seg.postings.get(field)
+        if pb is None:
+            return np.empty(0, np.int32)
+        pre = term[:prefix_length]
+        rows = [i for i, t in enumerate(pb.vocab)
+                if t.startswith(pre) and _edit_distance_le(t, term, k)]
+        return np.asarray(rows, np.int32)
+    return expand
+
+
+def _keyword_range_expander(field: str, q: dsl.RangeQuery):
+    def expand(seg: Segment) -> np.ndarray:
+        pb = seg.postings.get(field)
+        if pb is None:
+            return np.empty(0, np.int32)
+        lo = 0
+        hi = len(pb.vocab)
+        if q.gte is not None:
+            lo = bisect_left(pb.vocab, str(q.gte))
+        if q.gt is not None:
+            lo = bisect_right(pb.vocab, str(q.gt))
+        if q.lte is not None:
+            hi = bisect_right(pb.vocab, str(q.lte))
+        if q.lt is not None:
+            hi = bisect_left(pb.vocab, str(q.lt))
+        return np.arange(lo, max(hi, lo), dtype=np.int32)
+    return expand
+
+
+# =====================================================================
+# prepare: bind logical plan to one segment -> (spec, params)
+# =====================================================================
+
+F32_MIN = np.float32(-3.4e38)
+F32_MAX_HOST = np.float32(3.4e38)
+
+
+def _p(params: dict, key: str, value) -> str:
+    params[key] = value
+    return key
+
+
+def _scalar_f32(params, key, v) -> str:
+    return _p(params, key, np.float32(v))
+
+
+def _scalar_i32(params, key, v) -> str:
+    return _p(params, key, np.int32(v))
+
+
+def _i64_bounds(params, nid: int, lo, hi) -> Tuple[str, str, str, str]:
+    lo = -(2**63) if lo is None else int(lo)
+    hi = 2**63 - 1 if hi is None else int(hi)
+    lo_hi, lo_lo = split_i64(np.asarray([lo]))
+    hi_hi, hi_lo = split_i64(np.asarray([hi]))
+    return (_p(params, f"q{nid}_lohi", lo_hi[0]), _p(params, f"q{nid}_lolo", lo_lo[0]),
+            _p(params, f"q{nid}_hihi", hi_hi[0]), _p(params, f"q{nid}_hilo", hi_lo[0]))
+
+
+def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa: C901
+    """-> hashable spec tree; fills `params` with this segment's arrays."""
+    nid = node.nid
+
+    if isinstance(node, LTerms):
+        pb = seg.postings.get(node.field)
+        T_pad = next_pow2(len(node.terms), floor=1)
+        rows = np.full(T_pad, -1, dtype=np.int32)
+        total = 0
+        if pb is not None:
+            for i, t in enumerate(node.terms):
+                r = pb.row(t)
+                rows[i] = r
+                if r >= 0:
+                    a, b = pb.row_slice(r)
+                    total += b - a
+        bucket = ops.pick_bucket(total)
+        w = np.zeros(T_pad, dtype=np.float32)
+        w[: len(node.terms)] = node.weights
+        a = np.zeros(T_pad, dtype=np.float32)
+        a[: len(node.terms)] = node.aux
+        _p(params, f"q{nid}_rows", rows)
+        _p(params, f"q{nid}_w", w)
+        _p(params, f"q{nid}_aux", a)
+        _scalar_f32(params, f"q{nid}_msm", node.msm)
+        _scalar_f32(params, f"q{nid}_avgdl", ctx.avgdl(node.field))
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        sim = node.sim
+        b_eff = sim.b if node.has_norms else 0.0
+        return ("terms", nid, node.field, T_pad, bucket, sim.sim_id,
+                float(sim.k1), float(b_eff), node.mode)
+
+    if isinstance(node, LExpandTerms):
+        rows_np = node.expander(seg)
+        pb = seg.postings.get(node.field)
+        total = 0
+        if pb is not None and len(rows_np):
+            lens = pb.starts[rows_np + 1] - pb.starts[rows_np]
+            total = int(lens.sum())
+        T_pad = next_pow2(max(len(rows_np), 1), floor=1)
+        rows = np.full(T_pad, -1, dtype=np.int32)
+        rows[: len(rows_np)] = rows_np
+        bucket = ops.pick_bucket(total)
+        _p(params, f"q{nid}_rows", rows)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("xterms", nid, node.field, T_pad, bucket)
+
+    if isinstance(node, LMatchAll):
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("match_all", nid)
+
+    if isinstance(node, LMatchNone):
+        return ("match_none", nid)
+
+    if isinstance(node, LRange):
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        if node.kind == "int":
+            _i64_bounds(params, nid, node.lo, node.hi)
+        else:
+            _scalar_f32(params, f"q{nid}_flo",
+                        -np.inf if node.lo is None else node.lo)
+            _scalar_f32(params, f"q{nid}_fhi",
+                        np.inf if node.hi is None else node.hi)
+        return ("range", nid, node.field, node.kind, node.include_lo, node.include_hi,
+                node.field in seg.numeric_cols)
+
+    if isinstance(node, LExists):
+        src = ("numeric" if node.field in seg.numeric_cols else
+               "keyword" if node.field in seg.keyword_cols else
+               "geo" if node.field in seg.geo_cols else
+               "dl" if node.field in seg.doc_lens else
+               "none")
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("exists", nid, node.field, src)
+
+    if isinstance(node, LIds):
+        docs = [seg.id2doc[i] for i in node.ids if i in seg.id2doc]
+        pad = next_pow2(max(len(docs), 1), floor=8)
+        arr = np.full(pad, INT32_SENTINEL, dtype=np.int32)
+        arr[: len(docs)] = docs
+        _p(params, f"q{nid}_docs", arr)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("ids", nid, pad)
+
+    if isinstance(node, LBool):
+        _scalar_f32(params, f"q{nid}_msm", node.msm)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("bool", nid,
+                tuple(prepare(c, seg, ctx, params) for c in node.musts),
+                tuple(prepare(c, seg, ctx, params) for c in node.shoulds),
+                tuple(prepare(c, seg, ctx, params) for c in node.must_nots),
+                tuple(prepare(c, seg, ctx, params) for c in node.filters))
+
+    if isinstance(node, LConstScore):
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("const", nid, prepare(node.child, seg, ctx, params))
+
+    if isinstance(node, LDisMax):
+        _scalar_f32(params, f"q{nid}_tie", node.tie_breaker)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("dismax", nid, tuple(prepare(c, seg, ctx, params) for c in node.children))
+
+    if isinstance(node, LBoosting):
+        _scalar_f32(params, f"q{nid}_nb", node.negative_boost)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("boosting", nid, prepare(node.positive, seg, ctx, params),
+                prepare(node.negative, seg, ctx, params))
+
+    if isinstance(node, LFuncScore):
+        child_spec = prepare(node.child, seg, ctx, params)
+        fn_specs = []
+        for i, (fn, filt) in enumerate(zip(node.functions, node.fn_filters)):
+            fspec = prepare(filt, seg, ctx, params) if filt is not None else None
+            _scalar_f32(params, f"q{nid}_fn{i}_w", fn.weight)
+            if fn.kind == "field_value_factor":
+                _scalar_f32(params, f"q{nid}_fn{i}_factor", fn.factor)
+                _scalar_f32(params, f"q{nid}_fn{i}_missing",
+                            fn.missing if fn.missing is not None else 1.0)
+                fn_specs.append(("fvf", i, fn.field, fn.modifier,
+                                 fn.field in seg.numeric_cols, fspec))
+            elif fn.kind == "random_score":
+                _scalar_i32(params, f"q{nid}_fn{i}_seed", fn.seed)
+                fn_specs.append(("random", i, fspec))
+            else:
+                fn_specs.append(("weight", i, fspec))
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        _scalar_f32(params, f"q{nid}_minscore",
+                    node.min_score if node.min_score is not None else -3.4e38)
+        return ("fnscore", nid, child_spec, tuple(fn_specs),
+                node.score_mode, node.boost_mode)
+
+    if isinstance(node, LGeoDist):
+        _scalar_f32(params, f"q{nid}_lat", node.lat)
+        _scalar_f32(params, f"q{nid}_lon", node.lon)
+        _scalar_f32(params, f"q{nid}_rad", node.radius_m)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("geodist", nid, node.field, node.field in seg.geo_cols)
+
+    if isinstance(node, LGeoBox):
+        for k, v in (("top", node.top), ("left", node.left),
+                     ("bottom", node.bottom), ("right", node.right)):
+            _scalar_f32(params, f"q{nid}_{k}", v)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("geobox", nid, node.field, node.field in seg.geo_cols)
+
+    raise TypeError(f"cannot prepare node {type(node).__name__}")
+
+
+def can_match(node: LNode, seg: Segment) -> bool:
+    """Shard/segment pre-filter (reference CanMatchPreFilterSearchPhase):
+    cheaply prove a segment has zero hits."""
+    if isinstance(node, LTerms):
+        pb = seg.postings.get(node.field)
+        if pb is None:
+            return False
+        if node.msm >= len(node.terms):
+            return all(pb.row(t) >= 0 for t in node.terms)
+        return any(pb.row(t) >= 0 for t in node.terms)
+    if isinstance(node, LRange):
+        col = seg.numeric_cols.get(node.field)
+        if col is None:
+            return False
+        mn, mx = col.min_max
+        if node.lo is not None and float(node.lo) > mx:
+            return False
+        if node.hi is not None and float(node.hi) < mn:
+            return False
+        return True
+    if isinstance(node, LBool):
+        for c in node.musts + node.filters:
+            if not can_match(c, seg):
+                return False
+        if node.shoulds and not node.musts and not node.filters:
+            return any(can_match(c, seg) for c in node.shoulds)
+        return True
+    if isinstance(node, LConstScore):
+        return can_match(node.child, seg)
+    if isinstance(node, LMatchNone):
+        return False
+    return True
+
+
+# =====================================================================
+# emit: spec -> traced device computation (runs under jit trace)
+# =====================================================================
+
+def _emit_seg_helpers(seg_arrays: dict):
+    import jax.numpy as jnp
+
+    ndocs_pad = seg_arrays["live"].shape[0]
+    live = seg_arrays["live"]
+    zeros = jnp.zeros(ndocs_pad, jnp.float32)
+    return jnp, ndocs_pad, live, zeros
+
+
+def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
+    import jax.numpy as jnp
+
+    kind = spec[0]
+    nid = spec[1]
+    ndocs_pad = seg_arrays["live"].shape[0]
+    live = seg_arrays["live"]
+    zeros = jnp.zeros(ndocs_pad, jnp.float32)
+
+    if kind == "terms":
+        _, _, field, T_pad, bucket, sim_id, k1, b, mode = spec
+        post = seg_arrays["postings"].get(field)
+        if post is None:
+            return ops.ScoredMask(zeros, zeros)
+        dl = seg_arrays["doc_lens"].get(field, zeros)
+        if mode == "filter":
+            mask = ops.term_filter_mask(post, live, params[f"q{nid}_rows"], bucket, ndocs_pad)
+            boost = params[f"q{nid}_boost"]
+            m = mask.astype(jnp.float32)
+            return ops.ScoredMask(m * boost, m)
+        sm = ops.score_term_group(post, dl, live, params[f"q{nid}_rows"],
+                                  params[f"q{nid}_w"], params[f"q{nid}_aux"],
+                                  bucket, ndocs_pad, sim_id, k1, b,
+                                  params[f"q{nid}_avgdl"])
+        msm = params[f"q{nid}_msm"]
+        ok = sm.count >= msm
+        return ops.ScoredMask(jnp.where(ok, sm.scores, 0.0),
+                              jnp.where(ok, sm.count, 0.0))
+
+    if kind == "xterms":
+        _, _, field, T_pad, bucket = spec
+        post = seg_arrays["postings"].get(field)
+        if post is None:
+            return ops.ScoredMask(zeros, zeros)
+        mask = ops.term_filter_mask(post, live, params[f"q{nid}_rows"], bucket, ndocs_pad)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "match_all":
+        m = (live > 0).astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "match_none":
+        return ops.ScoredMask(zeros, zeros)
+
+    if kind == "range":
+        _, _, field, ckind, inc_lo, inc_hi, col_exists = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        col = seg_arrays["numeric"][field]
+        if ckind == "int":
+            mask = ops.int64_range_mask(col, params[f"q{nid}_lohi"], params[f"q{nid}_lolo"],
+                                        params[f"q{nid}_hihi"], params[f"q{nid}_hilo"],
+                                        inc_lo, inc_hi)
+        else:
+            mask = ops.float_range_mask(col, params[f"q{nid}_flo"], params[f"q{nid}_fhi"],
+                                        inc_lo, inc_hi)
+        mask = mask & (live > 0)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "exists":
+        _, _, field, src = spec
+        if src == "numeric":
+            present = seg_arrays["numeric"][field]["present"]
+        elif src == "keyword":
+            present = seg_arrays["keyword"][field]["min_ord"] >= 0
+        elif src == "geo":
+            present = seg_arrays["geo"][field]["present"]
+        elif src == "dl":
+            present = seg_arrays["doc_lens"][field] > 0
+        else:
+            return ops.ScoredMask(zeros, zeros)
+        mask = present & (live > 0)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "ids":
+        mask = ops.docs_mask(params[f"q{nid}_docs"], ndocs_pad) & (live > 0)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "bool":
+        _, _, musts, shoulds, must_nots, filters = spec
+        m_sms = [emit(s, seg_arrays, params) for s in musts]
+        s_sms = [emit(s, seg_arrays, params) for s in shoulds]
+        n_sms = [emit(s, seg_arrays, params) for s in must_nots]
+        f_sms = [emit(s, seg_arrays, params) for s in filters]
+        scores = zeros
+        for sm in m_sms + s_sms:
+            scores = scores + sm.scores
+        matched = live > 0
+        for sm in m_sms:
+            matched = matched & sm.matched
+        for sm in f_sms:
+            matched = matched & sm.matched
+        for sm in n_sms:
+            matched = matched & (~sm.matched)
+        if s_sms:
+            s_count = zeros
+            for sm in s_sms:
+                s_count = s_count + sm.matched.astype(jnp.float32)
+            matched = matched & (s_count >= params[f"q{nid}_msm"])
+        scores = jnp.where(matched, scores * params[f"q{nid}_boost"], 0.0)
+        return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "const":
+        sm = emit(spec[2], seg_arrays, params)
+        m = sm.matched.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "dismax":
+        children = [emit(s, seg_arrays, params) for s in spec[2]]
+        tie = params[f"q{nid}_tie"]
+        best = zeros
+        total = zeros
+        matched = jnp.zeros_like(live, dtype=bool)
+        for sm in children:
+            best = jnp.maximum(best, sm.scores)
+            total = total + sm.scores
+            matched = matched | sm.matched
+        scores = best + tie * (total - best)
+        scores = jnp.where(matched, scores * params[f"q{nid}_boost"], 0.0)
+        return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "boosting":
+        pos = emit(spec[2], seg_arrays, params)
+        neg = emit(spec[3], seg_arrays, params)
+        nb = params[f"q{nid}_nb"]
+        scores = pos.scores * jnp.where(neg.matched, nb, 1.0) * params[f"q{nid}_boost"]
+        return ops.ScoredMask(jnp.where(pos.matched, scores, 0.0), pos.count)
+
+    if kind == "fnscore":
+        _, _, child_spec, fn_specs, score_mode, boost_mode = spec
+        child = emit(child_spec, seg_arrays, params)
+        factors = []
+        for fs in fn_specs:
+            fkind = fs[0]
+            i = fs[1]
+            if fkind == "fvf":
+                _, _, ffield, modifier, col_exists, fspec = fs
+                if col_exists:
+                    col = seg_arrays["numeric"][ffield]
+                    v = jnp.where(col["present"],
+                                  col["f32"] * params[f"q{nid}_fn{i}_factor"],
+                                  params[f"q{nid}_fn{i}_missing"])
+                else:
+                    v = jnp.full(ndocs_pad, params[f"q{nid}_fn{i}_missing"])
+                v = _apply_modifier(jnp, v, modifier)
+            elif fkind == "random":
+                _, _, fspec = fs
+                seed = params[f"q{nid}_fn{i}_seed"]
+                h = (jnp.arange(ndocs_pad, dtype=jnp.uint32) * jnp.uint32(2654435761)
+                     ^ seed.astype(jnp.uint32))
+                h = h ^ (h >> 16)
+                h = h * jnp.uint32(0x45D9F3B)
+                h = h ^ (h >> 16)
+                v = h.astype(jnp.float32) / jnp.float32(2**32)
+            else:  # weight
+                _, _, fspec = fs
+                v = jnp.ones(ndocs_pad, jnp.float32)
+            v = v * params[f"q{nid}_fn{i}_w"]
+            if fspec is not None:
+                fmask = emit(fspec, seg_arrays, params).matched
+                neutral = _score_mode_neutral(score_mode)
+                v = jnp.where(fmask, v, neutral)
+            factors.append(v)
+        if factors:
+            fac = _combine_factors(jnp, factors, score_mode, ndocs_pad)
+        else:
+            fac = jnp.ones(ndocs_pad, jnp.float32)
+        scores = _combine_boost(jnp, child.scores, fac, boost_mode)
+        scores = scores * params[f"q{nid}_boost"]
+        matched = child.matched & (scores >= params[f"q{nid}_minscore"])
+        scores = jnp.where(matched, scores, 0.0)
+        return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "geodist":
+        _, _, field, col_exists = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        geo = seg_arrays["geo"][field]
+        mask = ops.geo_distance_mask(geo, params[f"q{nid}_lat"], params[f"q{nid}_lon"],
+                                     params[f"q{nid}_rad"]) & (live > 0)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "geobox":
+        _, _, field, col_exists = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        geo = seg_arrays["geo"][field]
+        lat, lon = geo["lat"], geo["lon"]
+        mask = ((lat <= params[f"q{nid}_top"]) & (lat >= params[f"q{nid}_bottom"]) &
+                (lon >= params[f"q{nid}_left"]) & (lon <= params[f"q{nid}_right"]) &
+                geo["present"] & (live > 0))
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    raise ValueError(f"cannot emit spec kind [{kind}]")
+
+
+def _apply_modifier(jnp, v, modifier: str):
+    if modifier == "none":
+        return v
+    if modifier == "log":
+        return jnp.log10(jnp.maximum(v, 1e-9))
+    if modifier == "log1p":
+        return jnp.log10(v + 1.0)
+    if modifier == "log2p":
+        return jnp.log10(v + 2.0)
+    if modifier == "ln":
+        return jnp.log(jnp.maximum(v, 1e-9))
+    if modifier == "ln1p":
+        return jnp.log1p(v)
+    if modifier == "ln2p":
+        return jnp.log(v + 2.0)
+    if modifier == "square":
+        return v * v
+    if modifier == "sqrt":
+        return jnp.sqrt(jnp.maximum(v, 0.0))
+    if modifier == "reciprocal":
+        return 1.0 / jnp.maximum(v, 1e-9)
+    raise ValueError(f"unknown modifier [{modifier}]")
+
+
+def _score_mode_neutral(mode: str) -> float:
+    return 1.0 if mode == "multiply" else 0.0
+
+
+def _combine_factors(jnp, factors, mode: str, ndocs_pad: int):
+    if mode == "multiply":
+        out = factors[0]
+        for f in factors[1:]:
+            out = out * f
+        return out
+    if mode in ("sum", "avg"):
+        out = factors[0]
+        for f in factors[1:]:
+            out = out + f
+        return out / len(factors) if mode == "avg" else out
+    if mode == "max":
+        out = factors[0]
+        for f in factors[1:]:
+            out = jnp.maximum(out, f)
+        return out
+    if mode == "min":
+        out = factors[0]
+        for f in factors[1:]:
+            out = jnp.minimum(out, f)
+        return out
+    if mode == "first":
+        return factors[0]
+    raise ValueError(f"unknown score_mode [{mode}]")
+
+
+def _combine_boost(jnp, score, factor, mode: str):
+    if mode == "multiply":
+        return score * factor
+    if mode == "sum":
+        return score + factor
+    if mode == "replace":
+        return factor
+    if mode == "avg":
+        return (score + factor) / 2.0
+    if mode == "max":
+        return jnp.maximum(score, factor)
+    if mode == "min":
+        return jnp.minimum(score, factor)
+    raise ValueError(f"unknown boost_mode [{mode}]")
+
+
+# =====================================================================
+# sort
+# =====================================================================
+
+def prepare_sort(sort_specs: List[dict], seg: Segment, params: dict):
+    """Bind sort to a segment. Device ranks by the PRIMARY key exactly (rank
+    ordinals for numerics — see NumericColumn.sort_ords); the executor
+    re-orders the k-window on the host with the full key tuple."""
+    import jax.numpy as jnp
+
+    if not sort_specs:
+        return ("score",)
+    primary = sort_specs[0]
+    field = primary["field"]
+    if field == "_score":
+        return ("score",) if primary.get("order", "desc") == "desc" else ("score_asc",)
+    if field == "_doc":
+        return ("doc",)
+    desc = primary.get("order", "asc") == "desc"
+    missing = primary.get("missing", "_last")
+    missing_last = missing == "_last"
+    if field in seg.numeric_cols:
+        cache = getattr(seg, "_sort_dev_cache", None)
+        if cache is None:
+            cache = seg._sort_dev_cache = {}
+        if field not in cache:
+            ords = seg.numeric_cols[field].sort_ords()
+            pad = np.full(seg.ndocs_pad, -1, dtype=np.int32)
+            pad[: seg.ndocs] = ords
+            cache[field] = jnp.asarray(pad)
+        params["sort_ords"] = cache[field]
+        return ("field_ord", desc, missing_last)
+    if field in seg.keyword_cols:
+        return ("kw_ord", field, desc, missing_last)
+    return ("missing_field", desc, missing_last)
+
+
+def emit_sort_key(sort_spec, seg_arrays: dict, params: dict, scores):
+    import jax.numpy as jnp
+
+    kind = sort_spec[0]
+    ndocs_pad = seg_arrays["live"].shape[0]
+    if kind == "score":
+        return scores
+    if kind == "score_asc":
+        return -scores
+    if kind == "doc":
+        return -jnp.arange(ndocs_pad, dtype=jnp.float32)
+    big = jnp.float32(2.0**30)
+    if kind == "field_ord":
+        _, desc, missing_last = sort_spec
+        ords = params["sort_ords"].astype(jnp.float32)
+        present = params["sort_ords"] >= 0
+    elif kind == "kw_ord":
+        _, field, desc, missing_last = sort_spec
+        mo = seg_arrays["keyword"][field]["min_ord"]
+        ords = mo.astype(jnp.float32)
+        present = mo >= 0
+    else:
+        _, desc, missing_last = sort_spec
+        ords = jnp.zeros(ndocs_pad, jnp.float32)
+        present = jnp.zeros(ndocs_pad, bool)
+    key = ords if desc else -ords
+    missing_key = -big if missing_last else big
+    return jnp.where(present, key, missing_key)
+
+
+# =====================================================================
+# aggregations: prepare + emit
+# =====================================================================
+
+def _host_date_buckets(seg: Segment, field: str, interval_ms: int, offset_ms: int,
+                       calendar: Optional[str]) -> Tuple[np.ndarray, int, int]:
+    """Exact date bucketing on host i64 (cached per segment): returns
+    (bucket_id i32[ndocs], min_bucket, nbuckets). Calendar intervals walk real
+    calendars (reference Rounding.Builder)."""
+    cache = getattr(seg, "_date_bucket_cache", None)
+    if cache is None:
+        cache = seg._date_bucket_cache = {}
+    key = (field, interval_ms, offset_ms, calendar)
+    if key in cache:
+        return cache[key]
+    col = seg.numeric_cols.get(field)
+    if col is None or not col.present.any():
+        res = (np.full(seg.ndocs, -1, np.int32), 0, 1)
+        cache[key] = res
+        return res
+    vals = col.values.astype(np.int64)
+    if calendar is None:
+        b = np.floor_divide(vals - offset_ms, interval_ms)
+    else:
+        b = _calendar_bucket_ids(vals, calendar)
+    b = np.where(col.present, b, np.int64(-(1 << 40)))
+    bp = b[col.present]
+    mn, mx = int(bp.min()), int(bp.max())
+    out = (b - mn).astype(np.int64)
+    out = np.where(col.present, out, -1).astype(np.int32)
+    res = (out, mn, int(mx - mn + 1))
+    cache[key] = res
+    return res
+
+
+def _calendar_bucket_ids(ms: np.ndarray, calendar: str) -> np.ndarray:
+    import datetime as dt
+
+    out = np.empty(len(ms), dtype=np.int64)
+    for i, v in enumerate(ms):
+        d = dt.datetime.fromtimestamp(int(v) / 1000.0, dt.timezone.utc)
+        if calendar in ("month", "1M"):
+            out[i] = (d.year - 1970) * 12 + (d.month - 1)
+        elif calendar in ("year", "1y"):
+            out[i] = d.year - 1970
+        elif calendar in ("quarter", "1q"):
+            out[i] = (d.year - 1970) * 4 + (d.month - 1) // 3
+        elif calendar in ("week", "1w"):
+            out[i] = (int(v) // 86400000 + 3) // 7  # epoch day 0 = Thursday
+        elif calendar in ("day", "1d"):
+            out[i] = int(v) // 86400000
+        elif calendar in ("hour", "1h"):
+            out[i] = int(v) // 3600000
+        elif calendar in ("minute", "1m"):
+            out[i] = int(v) // 60000
+        else:
+            raise ValueError(f"unknown calendar_interval [{calendar}]")
+    return out
+
+
+_CAL_MS = {"month": None, "1M": None, "year": None, "1y": None, "quarter": None,
+           "1q": None, "week": None, "1w": None}
+
+_FIXED_MS = {"ms": 1, "s": 1000, "m": 60000, "h": 3600000, "d": 86400000}
+
+
+def parse_interval_ms(s) -> int:
+    if isinstance(s, (int, float)):
+        return int(s)
+    mm = re.fullmatch(r"(\d+)(ms|s|m|h|d)", str(s))
+    if not mm:
+        raise ValueError(f"invalid fixed_interval [{s}]")
+    return int(mm.group(1)) * _FIXED_MS[mm.group(2)]
+
+
+def _kw_hash_cache(seg: Segment, field: str) -> np.ndarray:
+    cache = getattr(seg, "_kw_hash_cache", None)
+    if cache is None:
+        cache = seg._kw_hash_cache = {}
+    if field not in cache:
+        col = seg.keyword_cols[field]
+        import zlib
+        h = np.fromiter((zlib.crc32(v.encode()) for v in col.vocab),
+                        dtype=np.uint32, count=len(col.vocab))
+        pad = next_pow2(max(len(h), 1))
+        out = np.zeros(pad, dtype=np.uint32)
+        out[: len(h)] = h
+        cache[field] = out
+    return cache[field]
+
+
+def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
+                prefix: str):  # noqa: C901
+    """-> hashable agg spec; params filled per segment. `prefix` keys params."""
+    kind = node.kind
+    body = node.body
+
+    if kind == "terms":
+        field = _resolve_agg_field(node, ctx)
+        if field not in seg.keyword_cols:
+            return ("terms_missing", prefix)
+        nvocab_pad = next_pow2(max(len(seg.keyword_cols[field].vocab), 1))
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("terms", prefix, field, nvocab_pad, subs)
+
+    if kind == "histogram":
+        field = _resolve_agg_field(node, ctx)
+        interval = float(body["interval"])
+        offset = float(body.get("offset", 0.0))
+        col = seg.numeric_cols.get(field)
+        if col is None or not col.present.any():
+            return ("hist_missing", prefix, interval, offset)
+        mn, mx = col.min_max
+        min_b = int(np.floor((mn - offset) / interval))
+        max_b = int(np.floor((mx - offset) / interval))
+        nb = max_b - min_b + 1
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("hist", prefix, field, interval, offset, min_b, nb, subs)
+
+    if kind == "date_histogram":
+        field = _resolve_agg_field(node, ctx)
+        calendar = body.get("calendar_interval")
+        if calendar is not None:
+            interval_ms = 0
+        else:
+            interval_ms = parse_interval_ms(body.get("fixed_interval",
+                                                     body.get("interval", "1d")))
+        offset_ms = parse_interval_ms(body.get("offset", 0)) if body.get("offset") else 0
+        bucket_ids, min_b, nb = _host_date_buckets(seg, field, max(interval_ms, 1),
+                                                   offset_ms, calendar)
+        pad = np.full(next_pow2(len(bucket_ids)), -1, dtype=np.int32)
+        pad[: len(bucket_ids)] = bucket_ids
+        params[f"{prefix}_dbuckets"] = pad
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("date_hist", prefix, field, interval_ms, offset_ms, calendar,
+                min_b, nb, subs)
+
+    if kind in ("range", "date_range"):
+        field = _resolve_agg_field(node, ctx)
+        ranges = body.get("ranges", [])
+        lows = np.full(len(ranges), -np.inf, dtype=np.float32)
+        highs = np.full(len(ranges), np.inf, dtype=np.float32)
+        keys = []
+        ft = ctx.mappings.resolve_field(field)
+        for i, r in enumerate(ranges):
+            frm = r.get("from")
+            to = r.get("to")
+            if kind == "date_range":
+                frm = coerce_value(ft, frm) if frm is not None else None
+                to = coerce_value(ft, to) if to is not None else None
+            if frm is not None:
+                lows[i] = float(frm)
+            if to is not None:
+                highs[i] = float(to)
+            keys.append(r.get("key", f"{frm if frm is not None else '*'}-"
+                                     f"{to if to is not None else '*'}"))
+        params[f"{prefix}_lows"] = lows
+        params[f"{prefix}_highs"] = highs
+        col_exists = field in seg.numeric_cols
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("range", prefix, field, tuple(keys), col_exists, subs,
+                tuple((float(lows[i]), float(highs[i])) for i in range(len(ranges))))
+
+    if kind == "filter":
+        lnode = rewrite(dsl.parse_query(body), ctx, scoring=False)
+        fspec = prepare(lnode, seg, ctx, params)
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("filter", prefix, fspec, subs)
+
+    if kind == "filters":
+        raw = body.get("filters", {})
+        if isinstance(raw, dict):
+            items = list(raw.items())
+        else:
+            items = [(str(i), f) for i, f in enumerate(raw)]
+        fspecs = []
+        for key, f in items:
+            lnode = rewrite(dsl.parse_query(f), ctx, scoring=False)
+            fspecs.append((key, prepare(lnode, seg, ctx, params)))
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("filters", prefix, tuple(fspecs), subs)
+
+    if kind == "global":
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("global", prefix, subs)
+
+    if kind == "missing":
+        field = _resolve_agg_field(node, ctx)
+        src = ("numeric" if field in seg.numeric_cols else
+               "keyword" if field in seg.keyword_cols else "none")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("missing", prefix, field, src, subs)
+
+    if kind in ("min", "max", "sum", "avg", "stats", "extended_stats", "value_count"):
+        field = _resolve_agg_field(node, ctx)
+        if kind == "value_count" and field in seg.keyword_cols:
+            return ("vc_keyword", prefix, field)
+        return ("stats", prefix, field, field in seg.numeric_cols)
+
+    if kind == "cardinality":
+        field = _resolve_agg_field(node, ctx)
+        if field in seg.keyword_cols:
+            params[f"{prefix}_hashes"] = _kw_hash_cache(seg, field)
+            nvocab_pad = next_pow2(max(len(seg.keyword_cols[field].vocab), 1))
+            return ("card_kw", prefix, field, nvocab_pad)
+        return ("card_num", prefix, field, field in seg.numeric_cols)
+
+    if kind == "percentiles":
+        field = _resolve_agg_field(node, ctx)
+        col = seg.numeric_cols.get(field)
+        percents = tuple(body.get("percents", (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)))
+        # sketch bounds must be index-wide so partials merge
+        lo, hi = np.inf, -np.inf
+        for s in ctx.segments:
+            c = s.numeric_cols.get(field)
+            if c is not None and c.present.any():
+                cmn, cmx = c.min_max
+                lo, hi = min(lo, cmn), max(hi, cmx)
+        if not np.isfinite(lo):
+            lo, hi = 0.0, 1.0
+        return ("pctl", prefix, field, col is not None, float(lo), float(hi), percents)
+
+    if kind == "top_hits":
+        return ("top_hits", prefix, int(body.get("size", 3)))
+
+    raise ValueError(f"cannot prepare aggregation [{kind}]")
+
+
+def _resolve_agg_field(node: AggNode, ctx: ShardContext) -> str:
+    field = node.body.get("field", "")
+    ft = ctx.mappings.resolve_field(field)
+    return ft.name if ft else field
+
+
+def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
+    """-> nested dict of device arrays (this segment's partial)."""
+    import jax.numpy as jnp
+
+    kind = spec[0]
+    ndocs_pad = seg_arrays["live"].shape[0]
+
+    if kind in ("terms_missing", "hist_missing"):
+        return {}
+
+    if kind == "terms":
+        _, prefix, field, nvocab_pad, subs = spec
+        kw = seg_arrays["keyword"][field]
+        out = {"counts": agg_ops.terms_counts(kw, match, nvocab_pad)}
+        for i, sub in enumerate(subs):
+            if sub and sub[0] == "stats":
+                _, sprefix, sfield, col_exists = sub
+                if col_exists:
+                    col = seg_arrays["numeric"][sfield]
+                    out[f"sub{i}"] = agg_ops.terms_sub_metric(
+                        kw, match, col["f32"], col["present"], nvocab_pad)
+        return out
+
+    if kind == "hist":
+        _, prefix, field, interval, offset, min_b, nb, subs = spec
+        col = seg_arrays["numeric"][field]
+        w = match * jnp.where(col["present"], 1.0, 0.0)
+        b = jnp.floor((col["f32"] - offset) / interval).astype(jnp.int32) - min_b
+        b = jnp.where((b >= 0) & (b < nb) & (w > 0), b, nb)
+        out = {"counts": jnp.zeros(nb, jnp.float32).at[b].add(w, mode="drop")}
+        for i, sub in enumerate(subs):
+            out.update(_emit_bucketed_sub(jnp, sub, i, b, nb, seg_arrays, match))
+        return out
+
+    if kind == "date_hist":
+        _, prefix, field, interval_ms, offset_ms, calendar, min_b, nb, subs = spec
+        b_all = params[f"{prefix}_dbuckets"][:ndocs_pad]
+        w = match * jnp.where(b_all >= 0, 1.0, 0.0)
+        b = jnp.where((b_all >= 0) & (w > 0), b_all, nb)
+        out = {"counts": jnp.zeros(nb, jnp.float32).at[b].add(w, mode="drop")}
+        for i, sub in enumerate(subs):
+            out.update(_emit_bucketed_sub(jnp, sub, i, b, nb, seg_arrays, match))
+        return out
+
+    if kind == "range":
+        _, prefix, field, keys, col_exists, subs, bounds = spec
+        if not col_exists:
+            return {}
+        col = seg_arrays["numeric"][field]
+        out = {"counts": agg_ops.range_counts(col["f32"], col["present"], match,
+                                              params[f"{prefix}_lows"],
+                                              params[f"{prefix}_highs"])}
+        for ri in range(len(keys)):
+            rmask = agg_ops.float_range_mask if False else None
+            lo = params[f"{prefix}_lows"][ri]
+            hi = params[f"{prefix}_highs"][ri]
+            bucket_match = match * ((col["f32"] >= lo) & (col["f32"] < hi) &
+                                    col["present"]).astype(jnp.float32)
+            for i, sub in enumerate(subs):
+                res = emit_agg(sub, seg_arrays, params, bucket_match)
+                if res:
+                    out[f"r{ri}_sub{i}"] = res
+        return out
+
+    if kind == "filter":
+        _, prefix, fspec, subs = spec
+        fmask = emit(fspec, seg_arrays, params).matched
+        bucket_match = match * fmask.astype(jnp.float32)
+        out = {"count": jnp.sum(bucket_match)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, seg_arrays, params, bucket_match)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "filters":
+        _, prefix, fspecs, subs = spec
+        out = {}
+        for ki, (key, fspec) in enumerate(fspecs):
+            fmask = emit(fspec, seg_arrays, params).matched
+            bucket_match = match * fmask.astype(jnp.float32)
+            entry = {"count": jnp.sum(bucket_match)}
+            for i, sub in enumerate(subs):
+                res = emit_agg(sub, seg_arrays, params, bucket_match)
+                if res:
+                    entry[f"sub{i}"] = res
+            out[f"k{ki}"] = entry
+        return out
+
+    if kind == "global":
+        _, prefix, subs = spec
+        gmatch = seg_arrays["live"]
+        out = {"count": jnp.sum(gmatch)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, seg_arrays, params, gmatch)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "missing":
+        _, prefix, field, src, subs = spec
+        if src == "numeric":
+            present = seg_arrays["numeric"][field]["present"]
+        elif src == "keyword":
+            present = seg_arrays["keyword"][field]["min_ord"] >= 0
+        else:
+            present = jnp.zeros(ndocs_pad, bool)
+        bucket_match = match * (~present).astype(jnp.float32)
+        out = {"count": jnp.sum(bucket_match)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, seg_arrays, params, bucket_match)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "stats":
+        _, prefix, field, col_exists = spec
+        if not col_exists:
+            return {"empty": jnp.float32(0)}
+        col = seg_arrays["numeric"][field]
+        count, s, mn, mx, ssq = agg_ops.stats_agg(col["f32"], col["present"], match)
+        return {"count": count, "sum": s, "min": mn, "max": mx, "sumsq": ssq}
+
+    if kind == "vc_keyword":
+        _, prefix, field = spec
+        return {"count": agg_ops.value_count_keyword(seg_arrays["keyword"][field], match)}
+
+    if kind == "card_kw":
+        _, prefix, field, nvocab_pad = spec
+        return {"registers": agg_ops.cardinality_keyword_registers(
+            seg_arrays["keyword"][field], match, nvocab_pad,
+            params[f"{prefix}_hashes"], HLL_LOG2M)}
+
+    if kind == "card_num":
+        _, prefix, field, col_exists = spec
+        if not col_exists:
+            return {"registers": jnp.zeros(1 << HLL_LOG2M, jnp.int32)}
+        col = seg_arrays["numeric"][field]
+        return {"registers": agg_ops.cardinality_numeric_registers(
+            col["f32"], col["present"], match, HLL_LOG2M)}
+
+    if kind == "pctl":
+        _, prefix, field, col_exists, lo, hi, percents = spec
+        if not col_exists:
+            return {"hist": jnp.zeros(PCTL_BINS, jnp.float32)}
+        col = seg_arrays["numeric"][field]
+        width = max((hi - lo) / PCTL_BINS, 1e-30)
+        return {"hist": agg_ops.histogram_counts(col["f32"], col["present"], match,
+                                                 width, lo, 0, PCTL_BINS)}
+
+    if kind == "top_hits":
+        _, prefix, size = spec
+        return {"top_hits_marker": jnp.float32(size)}  # resolved host-side
+
+    raise ValueError(f"cannot emit aggregation spec [{kind}]")
+
+
+def _emit_bucketed_sub(jnp, sub, i: int, bucket_ids, nb: int, seg_arrays, match):
+    """Metric sub-agg under an ordinal bucket agg: scatter into per-bucket
+    accumulators."""
+    if not sub or sub[0] != "stats":
+        return {}
+    _, sprefix, sfield, col_exists = sub
+    if not col_exists:
+        return {}
+    col = seg_arrays["numeric"][sfield]
+    w = match * jnp.where(col["present"], 1.0, 0.0)
+    v = col["f32"]
+    b = jnp.where(w > 0, bucket_ids, nb)
+    sums = jnp.zeros(nb, jnp.float32).at[b].add(w * v, mode="drop")
+    cnts = jnp.zeros(nb, jnp.float32).at[b].add(w, mode="drop")
+    mins = jnp.full(nb, 3.4e38, jnp.float32).at[b].min(
+        jnp.where(w > 0, v, 3.4e38), mode="drop")
+    maxs = jnp.full(nb, -3.4e38, jnp.float32).at[b].max(
+        jnp.where(w > 0, v, -3.4e38), mode="drop")
+    sumsq = jnp.zeros(nb, jnp.float32).at[b].add(w * v * v, mode="drop")
+    return {f"sub{i}": (sums, cnts, mins, maxs, sumsq)}
+
+
+# =====================================================================
+# executor: jitted per-spec program
+# =====================================================================
+
+@lru_cache(maxsize=512)
+def _build_executor(full_spec):
+    import jax
+
+    query_spec, sort_spec, agg_specs, k_pad, named_specs, has_after = full_spec
+
+    def run(seg_arrays, params):
+        import jax.numpy as jnp
+
+        sm = emit(query_spec, seg_arrays, params)
+        live = seg_arrays["live"]
+        key = emit_sort_key(sort_spec, seg_arrays, params, sm.scores)
+        matched = sm.matched
+        if has_after:
+            # search_after: strictly below the cursor in ranking order
+            matched = matched & (key < params["after_key"])
+        sm = ops.ScoredMask(sm.scores, matched.astype(jnp.float32))
+        vals, idx = ops.topk_docs(key, sm.matched, live, k_pad)
+        out = {
+            "topk_key": vals,
+            "topk_idx": idx,
+            "topk_scores": sm.scores[idx],
+            "total": ops.total_hits(sm.matched, live),
+            "max_score": jnp.max(jnp.where(sm.matched & (live > 0), sm.scores, -jnp.inf)),
+        }
+        match_f = sm.matched.astype(jnp.float32) * jnp.where(live > 0, 1.0, 0.0)
+        aggs = {}
+        for name, aspec in agg_specs:
+            res = emit_agg(aspec, seg_arrays, params, match_f)
+            if res:
+                aggs[name] = res
+        if aggs:
+            out["aggs"] = aggs
+        named = {}
+        for nm, nspec in named_specs:
+            nsm = emit(nspec, seg_arrays, params)
+            named[nm] = nsm.matched[idx]
+        if named:
+            out["named"] = named
+        return out
+
+    return jax.jit(run)
+
+
+def run_segment(query_spec, sort_spec, agg_specs, named_specs, k_pad: int,
+                seg_arrays: dict, params: dict, has_after: bool = False) -> dict:
+    exe = _build_executor((query_spec, sort_spec, tuple(agg_specs), k_pad,
+                           tuple(named_specs), has_after))
+    return exe(seg_arrays, params)
+
+
+@lru_cache(maxsize=256)
+def _build_gather_executor(query_spec):
+    """Scores of a query at an explicit doc list (rescore second pass,
+    reference `search/rescore/QueryRescorer.java`)."""
+    import jax
+
+    def run(seg_arrays, params):
+        sm = emit(query_spec, seg_arrays, params)
+        docs = params["gather_docs"]
+        return sm.scores[docs], sm.matched[docs]
+
+    return jax.jit(run)
+
+
+def run_gather_scores(query_spec, seg_arrays: dict, params: dict, docs: np.ndarray):
+    exe = _build_gather_executor(query_spec)
+    params = dict(params)
+    params["gather_docs"] = docs
+    return exe(seg_arrays, params)

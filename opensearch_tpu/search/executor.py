@@ -1,0 +1,844 @@
+"""Per-shard search execution + coordinator reduce. Analog of reference
+`search/SearchService.java` (executeQueryPhase/executeFetchPhase),
+`search/query/QueryPhase.java`, `search/fetch/FetchPhase.java`, and the
+coordinator-side `action/search/SearchPhaseController.java`.
+
+Query-then-fetch: the QUERY phase runs the jitted device program per segment
+(scoring + top-k + aggs in one XLA program), returns light-weight candidate
+descriptors; the coordinator merges candidates across shards; the FETCH phase
+materializes `_source`, highlights, docvalue_fields for the winning docs only.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import time
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..index.engine import Engine
+from ..index.segment import Segment, next_pow2
+from . import compiler as C
+from . import query_dsl as dsl
+from .aggregations import AggNode, finalize, merge_partials, parse_aggs
+from .highlight import collect_query_terms, highlight_field
+
+INT32_SENTINEL = np.int32(2**31 - 1)
+
+
+@dataclass
+class Candidate:
+    """One query-phase hit descriptor (analog of Lucene ScoreDoc + shard ref)."""
+
+    shard: int
+    seg_ord: int
+    local_doc: int
+    score: Optional[float]
+    sort_values: Tuple            # host-comparable, already direction-adjusted
+    raw_sort_values: Tuple        # user-facing sort array
+
+
+@dataclass
+class ShardQueryResult:
+    shard: int
+    candidates: List[Candidate] = dc_field(default_factory=list)
+    total: int = 0
+    max_score: float = float("-inf")
+    agg_partials: Dict[str, dict] = dc_field(default_factory=dict)
+    segments: List[Segment] = dc_field(default_factory=list)
+    named_by_doc: Dict[Tuple[int, int], List[str]] = dc_field(default_factory=dict)
+    took_ms: float = 0.0
+
+
+def _norm_sort_specs(body: dict) -> List[dict]:
+    out = []
+    for s in body.get("sort", []):
+        if isinstance(s, str):
+            out.append({"field": s, "order": "desc" if s == "_score" else "asc"})
+        else:
+            ((f, spec),) = s.items()
+            if isinstance(spec, str):
+                out.append({"field": f, "order": spec})
+            else:
+                out.append({"field": f, **spec})
+    return out
+
+
+class ShardSearcher:
+    """Executes searches over one shard's engine (one set of segments)."""
+
+    def __init__(self, engine: Engine, shard_id: int = 0,
+                 similarity=None, field_similarities=None):
+        self.engine = engine
+        self.shard_id = shard_id
+        self.similarity = similarity
+        self.field_similarities = field_similarities
+
+    def context(self) -> C.ShardContext:
+        return C.ShardContext(self.engine.mappings, self.engine.segments,
+                              self.similarity, self.field_similarities)
+
+    # ---------------- QUERY phase ----------------
+
+    def query_phase(self, body: dict, segments: Optional[List[Segment]] = None
+                    ) -> ShardQueryResult:
+        t0 = time.monotonic()
+        segments = segments if segments is not None else list(self.engine.segments)
+        ctx = C.ShardContext(self.engine.mappings, segments,
+                             self.similarity, self.field_similarities)
+        query = dsl.parse_query(body.get("query"))
+        lroot = C.rewrite(query, ctx, scoring=True)
+
+        size = int(body.get("size", 10))
+        frm = int(body.get("from", 0))
+        sort_specs = _norm_sort_specs(body)
+        is_field_sort = bool(sort_specs) and sort_specs[0]["field"] not in ("_score",)
+        # oversample: host tie-refinement + multi-key sorting need slack
+        window = frm + size
+        oversample = 2 if (is_field_sort or len(sort_specs) > 1) else 1
+        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
+        named_nodes = _collect_named(lroot)
+        rescores = body.get("rescore")
+        if rescores is not None and not isinstance(rescores, list):
+            rescores = [rescores]
+        min_score = body.get("min_score")
+        search_after = body.get("search_after")
+
+        result = ShardQueryResult(shard=self.shard_id, segments=segments)
+        phrase_checks = _collect_phrases(lroot)
+
+        for seg_ord, seg in enumerate(segments):
+            if seg.live_count == 0:
+                continue
+            if not C.can_match(lroot, seg):
+                # segment provably has no hits; aggs over zero docs are empty
+                continue
+            k_pad = min(next_pow2(max(window * oversample, 16)), seg.ndocs_pad)
+            params: Dict[str, Any] = {}
+            qspec = C.prepare(lroot, seg, ctx, params)
+            sspec = C.prepare_sort(sort_specs, seg, params)
+            agg_specs = []
+            for i, an in enumerate(agg_nodes):
+                if an.kind == "top_hits":
+                    continue  # resolved from this segment's top-k below
+                agg_specs.append((an.name, C.prepare_agg(an, seg, ctx, params, f"a{i}")))
+            named_specs = []
+            for nm, nnode in named_nodes:
+                nparams: Dict[str, Any] = {}
+                nspec = C.prepare(nnode, seg, ctx, params)
+                named_specs.append((nm, nspec))
+            has_after = search_after is not None
+            if has_after:
+                params["after_key"] = np.float32(
+                    _after_key_value(search_after, sort_specs, seg))
+            out = C.run_segment(qspec, sspec, agg_specs, named_specs, k_pad,
+                                seg.device_arrays(), params, has_after)
+
+            keys = np.asarray(out["topk_key"])
+            idx = np.asarray(out["topk_idx"])
+            scores = np.asarray(out["topk_scores"])
+            valid = keys > -np.inf
+            result.total += int(out["total"])
+            ms = float(out["max_score"])
+            if ms > result.max_score:
+                result.max_score = ms
+
+            named_np = {nm: np.asarray(v) for nm, v in out.get("named", {}).items()}
+            for name, aspec in agg_specs:
+                node = next(a for a in agg_nodes if a.name == name)
+                partial = _device_agg_to_partial(node, aspec,
+                                                 out.get("aggs", {}).get(name), seg, ctx)
+                result.agg_partials.setdefault(name, []).append(partial)
+
+            # rescore second pass over this segment's window
+            if rescores:
+                scores = self._apply_rescores(rescores, ctx, seg, idx, valid, scores)
+
+            for j in np.nonzero(valid)[0]:
+                d = int(idx[j])
+                if d >= seg.ndocs:
+                    continue
+                sc = float(scores[j])
+                if min_score is not None and not is_field_sort and sc < min_score:
+                    continue
+                if phrase_checks and not _verify_phrases(phrase_checks, seg, d):
+                    result.total -= 1
+                    continue
+                sort_vals, raw_vals = _host_sort_values(sort_specs, seg, d, sc)
+                cand = Candidate(self.shard_id, seg_ord, d, sc, sort_vals, raw_vals)
+                result.candidates.append(cand)
+                names = [nm for nm, arr in named_np.items() if arr[j]]
+                if names:
+                    result.named_by_doc[(seg_ord, d)] = names
+
+        # top_hits root aggs from candidates
+        for i, an in enumerate(agg_nodes):
+            if an.kind == "top_hits":
+                top = sorted(result.candidates, key=lambda c: -(c.score or 0.0))
+                size_th = int(an.body.get("size", 3))
+                hits = [self._fetch_one(result.segments[c.seg_ord], c, an.body)
+                        for c in top[:size_th]]
+                result.agg_partials[an.name] = [{"hits": hits, "total": result.total,
+                                                 "size": size_th}]
+
+        # keep only the best window per shard
+        result.candidates.sort(key=lambda c: c.sort_values)
+        result.candidates = result.candidates[: window * oversample]
+        result.took_ms = (time.monotonic() - t0) * 1000.0
+        return result
+
+    def _apply_rescores(self, rescores: List[dict], ctx, seg, idx, valid, scores):
+        for rs in rescores:
+            spec = rs.get("query", rs)
+            window = int(rs.get("window_size", 10))
+            rq = dsl.parse_query(spec.get("rescore_query"))
+            qw = float(spec.get("query_weight", 1.0))
+            rw = float(spec.get("rescore_query_weight", 1.0))
+            mode = spec.get("score_mode", "total")
+            lr = C.rewrite(rq, ctx, scoring=True)
+            params: Dict[str, Any] = {}
+            rspec = C.prepare(lr, seg, ctx, params)
+            docs = np.where(valid, idx, INT32_SENTINEL % seg.ndocs_pad).astype(np.int32)
+            rscores, rmatched = C.run_gather_scores(rspec, seg.device_arrays(), params,
+                                                    np.minimum(docs, seg.ndocs_pad - 1))
+            rscores = np.asarray(rscores)
+            rmatched = np.asarray(rmatched)
+            in_window = np.arange(len(scores)) < window
+            combined = np.where(rmatched, _combine_rescore(mode, qw * scores, rw * rscores),
+                                qw * scores)
+            scores = np.where(valid & in_window, combined, scores)
+        return scores
+
+    # ---------------- FETCH phase ----------------
+
+    def fetch_phase(self, result: ShardQueryResult, selected: List[Candidate],
+                    body: dict) -> List[dict]:
+        ctx = C.ShardContext(self.engine.mappings, result.segments,
+                             self.similarity, self.field_similarities)
+        lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx, scoring=True)
+        hl_terms = collect_query_terms(lroot) if body.get("highlight") else {}
+        hits = []
+        for c in selected:
+            seg = result.segments[c.seg_ord]
+            hit = self._fetch_one(seg, c, body, hl_terms)
+            names = result.named_by_doc.get((c.seg_ord, c.local_doc))
+            if names:
+                hit["matched_queries"] = names
+            if body.get("explain"):
+                hit["_explanation"] = explain_doc(lroot, seg, c.local_doc, ctx)
+            hits.append(hit)
+        return hits
+
+    def _fetch_one(self, seg: Segment, c: Candidate, body: dict,
+                   hl_terms: Optional[dict] = None) -> dict:
+        hit = {"_index": body.get("_index_name", ""), "_id": seg.ids[c.local_doc],
+               "_score": c.score}
+        if body.get("sort"):
+            hit["sort"] = list(c.raw_sort_values)
+        src_opt = body.get("_source", True)
+        if src_opt is not False:
+            src = seg.sources[c.local_doc]
+            hit["_source"] = _filter_source(src, src_opt)
+        if body.get("docvalue_fields"):
+            hit["fields"] = _docvalue_fields(seg, c.local_doc, body["docvalue_fields"])
+        if body.get("fields"):
+            flds = hit.setdefault("fields", {})
+            for f in body["fields"]:
+                fname = f if isinstance(f, str) else f.get("field")
+                vals = _extract_source_values(seg.sources[c.local_doc], fname)
+                if vals:
+                    flds[fname] = vals
+        if body.get("highlight") and hl_terms is not None:
+            hl = {}
+            hl_body = body["highlight"]
+            for fname, fopts in hl_body.get("fields", {}).items():
+                ft = self.engine.mappings.resolve_field(fname)
+                if ft is None:
+                    continue
+                terms = hl_terms.get(fname, set())
+                vals = _extract_source_values(seg.sources[c.local_doc], fname)
+                frags = []
+                analyzer = self.engine.mappings.index_analyzer(ft)
+                for v in vals:
+                    frags.extend(highlight_field(
+                        str(v), terms, analyzer,
+                        pre_tag=(hl_body.get("pre_tags") or ["<em>"])[0],
+                        post_tag=(hl_body.get("post_tags") or ["</em>"])[0],
+                        fragment_size=int(fopts.get("fragment_size",
+                                                    hl_body.get("fragment_size", 100))),
+                        number_of_fragments=int(fopts.get("number_of_fragments",
+                                                          hl_body.get("number_of_fragments", 5)))))
+                if frags:
+                    hl[fname] = frags
+            if hl:
+                hit["highlight"] = hl
+        return hit
+
+
+# =====================================================================
+# coordinator reduce (SearchPhaseController analog)
+# =====================================================================
+
+def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
+                         agg_nodes: Optional[List[AggNode]] = None) -> dict:
+    size = int(body.get("size", 10))
+    frm = int(body.get("from", 0))
+    all_cands: List[Candidate] = []
+    total = 0
+    max_score = float("-inf")
+    for r in shard_results:
+        all_cands.extend(r.candidates)
+        total += r.total
+        max_score = max(max_score, r.max_score)
+    all_cands.sort(key=lambda c: c.sort_values)
+    selected = all_cands[frm: frm + size]
+
+    if agg_nodes is None:
+        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
+    aggs_out = {}
+    for node in agg_nodes:
+        partials = []
+        for r in shard_results:
+            partials.extend(r.agg_partials.get(node.name, []))
+        merged = merge_partials(node, partials) if partials else {}
+        aggs_out[node.name] = finalize(node, merged)
+
+    return {"selected": selected, "total": total,
+            "max_score": None if max_score == float("-inf") else max_score,
+            "aggs": aggs_out}
+
+
+def search_shards(searchers: List[ShardSearcher], body: dict,
+                  index_name: str = "") -> dict:
+    """Full query-then-fetch across shards -> OpenSearch-shaped response."""
+    t0 = time.monotonic()
+    body = dict(body)
+    body["_index_name"] = index_name
+    results = [s.query_phase(body) for s in searchers]
+    reduced = reduce_shard_results(results, body)
+    by_shard: Dict[int, List[Candidate]] = {}
+    for c in reduced["selected"]:
+        by_shard.setdefault(c.shard, []).append(c)
+    hits_by_key: Dict[Tuple, dict] = {}
+    for r in results:
+        sel = by_shard.get(r.shard, [])
+        if not sel:
+            continue
+        searcher = next(s for s in searchers if s.shard_id == r.shard)
+        fetched = searcher.fetch_phase(r, sel, body)
+        for c, h in zip(sel, fetched):
+            hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
+    hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)] for c in reduced["selected"]
+            if (c.shard, c.seg_ord, c.local_doc) in hits_by_key]
+
+    track = body.get("track_total_hits", True)
+    relation = "eq"
+    total = reduced["total"]
+    if track is not True and track is not False:
+        track_n = int(track)
+        if total > track_n:
+            total, relation = track_n, "gte"
+    resp = {
+        "took": int((time.monotonic() - t0) * 1000),
+        "timed_out": False,
+        "_shards": {"total": len(searchers), "successful": len(searchers),
+                    "skipped": 0, "failed": 0},
+        "hits": {"total": {"value": total, "relation": relation},
+                 "max_score": reduced["max_score"] if not body.get("sort") else None,
+                 "hits": hits},
+    }
+    if reduced["aggs"]:
+        resp["aggregations"] = reduced["aggs"]
+    if body.get("profile"):
+        resp["profile"] = {"shards": [{"id": r.shard, "query_ms": r.took_ms}
+                                      for r in results]}
+    return resp
+
+
+# =====================================================================
+# helpers
+# =====================================================================
+
+def _combine_rescore(mode: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    if mode == "total":
+        return a + b
+    if mode == "multiply":
+        return a * b
+    if mode == "avg":
+        return (a + b) / 2
+    if mode == "max":
+        return np.maximum(a, b)
+    if mode == "min":
+        return np.minimum(a, b)
+    raise ValueError(f"unknown rescore score_mode [{mode}]")
+
+
+def _collect_named(lroot) -> List[Tuple[str, Any]]:
+    out = []
+
+    def walk(n):
+        if n is None:
+            return
+        if getattr(n, "name", None):
+            out.append((n.name, n))
+        for attr in ("musts", "shoulds", "must_nots", "filters", "children"):
+            for c in getattr(n, attr, []) or []:
+                walk(c)
+        for attr in ("child", "positive", "negative"):
+            walk(getattr(n, attr, None))
+
+    walk(lroot)
+    return out
+
+
+def _collect_phrases(lroot) -> List[Any]:
+    out = []
+
+    def walk(n):
+        if n is None:
+            return
+        if getattr(n, "_phrase_terms", None):
+            out.append(n)
+        for attr in ("musts", "shoulds", "must_nots", "filters", "children"):
+            for c in getattr(n, attr, []) or []:
+                walk(c)
+        for attr in ("child", "positive", "negative"):
+            walk(getattr(n, attr, None))
+
+    walk(lroot)
+    return out
+
+
+def _verify_phrases(phrase_nodes: List[Any], seg: Segment, doc: int) -> bool:
+    """Host positional verification of phrase candidates (r1; device phrase
+    join lands in r2 — see SURVEY §2.4)."""
+    for node in phrase_nodes:
+        pb = seg.postings.get(node.field)
+        if pb is None or pb.pos_starts is None:
+            continue
+        pos_lists = []
+        for t in node._phrase_terms:
+            r = pb.row(t)
+            if r < 0:
+                return False
+            a, b = pb.row_slice(r)
+            k = a + int(np.searchsorted(pb.doc_ids[a:b], doc))
+            if k >= b or pb.doc_ids[k] != doc:
+                return False
+            pos_lists.append(pb.positions[pb.pos_starts[k]: pb.pos_starts[k + 1]])
+        if not _phrase_match(pos_lists, node._phrase_slop):
+            return False
+    return True
+
+
+def _phrase_match(pos_lists: List[np.ndarray], slop: int) -> bool:
+    if any(len(p) == 0 for p in pos_lists):
+        return False
+    if slop == 0:
+        base = set(pos_lists[0])
+        for off, pl in enumerate(pos_lists[1:], 1):
+            base &= {p - off for p in pl}
+            if not base:
+                return False
+        return True
+    # sloppy: minimal span containing one position per term in order tolerance
+    import itertools
+    if np.prod([len(p) for p in pos_lists]) <= 4096:
+        for combo in itertools.product(*[list(p) for p in pos_lists]):
+            adjusted = [p - i for i, p in enumerate(combo)]
+            if max(adjusted) - min(adjusted) <= slop:
+                return True
+        return False
+    return True  # very dense doc: accept (avoid pathological host cost)
+
+
+def _host_sort_values(sort_specs: List[dict], seg: Segment, doc: int,
+                      score: float) -> Tuple[Tuple, Tuple]:
+    """(comparison tuple asc-ordered, raw user-facing values)."""
+    if not sort_specs:
+        return ((-score, seg.ids[doc]), (score,))
+    comp = []
+    raw = []
+    for spec in sort_specs:
+        f = spec["field"]
+        desc = spec.get("order", "desc" if f == "_score" else "asc") == "desc"
+        missing_last = spec.get("missing", "_last") == "_last"
+        if f == "_score":
+            v: Any = score
+            comp.append(-v if desc else v)
+            raw.append(v)
+            continue
+        if f == "_doc":
+            comp.append(doc)
+            raw.append(doc)
+            continue
+        col = seg.numeric_cols.get(f)
+        if col is not None and col.present[doc]:
+            v = col.values[doc]
+            v = float(v) if col.kind == "float" else int(v)
+            comp.append((0 if not missing_last else 0, -v if desc else v))
+            raw.append(v)
+            continue
+        kcol = seg.keyword_cols.get(f)
+        if kcol is not None and kcol.min_ord[doc] >= 0:
+            sv = kcol.vocab[kcol.min_ord[doc]]
+            comp.append((0, _StrKey(sv, desc)))
+            raw.append(sv)
+            continue
+        comp.append((1 if missing_last else -1, 0))
+        raw.append(None)
+    comp.append(seg.ids[doc])  # stable tiebreak
+    return (tuple(comp), tuple(raw))
+
+
+class _StrKey:
+    """String sort key supporting descending order in tuple comparisons."""
+
+    __slots__ = ("s", "desc")
+
+    def __init__(self, s: str, desc: bool):
+        self.s = s
+        self.desc = desc
+
+    def __lt__(self, other):
+        return (self.s > other.s) if self.desc else (self.s < other.s)
+
+    def __eq__(self, other):
+        return self.s == other.s
+
+
+def _after_key_value(search_after: List, sort_specs: List[dict], seg: Segment) -> float:
+    """Device-comparable primary-key cursor for search_after."""
+    if not sort_specs or sort_specs[0]["field"] == "_score":
+        return float(search_after[0])
+    f = sort_specs[0]["field"]
+    desc = sort_specs[0].get("order", "asc") == "desc"
+    v = search_after[0]
+    col = seg.numeric_cols.get(f)
+    if col is not None:
+        ords = col.sort_ords()
+        pos = np.searchsorted(np.unique(col.values[col.present]), v)
+        key = float(pos)
+        return key if desc else -key
+    kcol = seg.keyword_cols.get(f)
+    if kcol is not None:
+        from bisect import bisect_left
+        pos = bisect_left(kcol.vocab, str(v))
+        return float(pos) if desc else -float(pos)
+    return float("inf")
+
+
+def _filter_source(src: dict, opt) -> dict:
+    if opt is True:
+        return src
+    if isinstance(opt, str):
+        opt = {"includes": [opt]}
+    if isinstance(opt, list):
+        opt = {"includes": opt}
+    includes = opt.get("includes", [])
+    excludes = opt.get("excludes", [])
+
+    def flatten(d, prefix=""):
+        for k, v in d.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, dict):
+                yield from flatten(v, f"{path}.")
+            else:
+                yield path, v
+
+    def keep(path):
+        if includes and not any(fnmatch.fnmatch(path, p) or path.startswith(p + ".")
+                                for p in includes):
+            return False
+        if any(fnmatch.fnmatch(path, p) for p in excludes):
+            return False
+        return True
+
+    out: dict = {}
+    for path, v in flatten(src):
+        if keep(path):
+            node = out
+            parts = path.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = v
+    return out
+
+
+def _docvalue_fields(seg: Segment, doc: int, specs: List) -> dict:
+    out = {}
+    for spec in specs:
+        f = spec if isinstance(spec, str) else spec.get("field")
+        col = seg.numeric_cols.get(f)
+        if col is not None and col.present[doc]:
+            v = col.values[doc]
+            out[f] = [float(v) if col.kind == "float" else int(v)]
+            continue
+        kcol = seg.keyword_cols.get(f)
+        if kcol is not None:
+            a, b = int(kcol.starts[doc]), int(kcol.starts[doc + 1])
+            if b > a:
+                out[f] = [kcol.vocab[o] for o in kcol.ords[a:b]]
+    return out
+
+
+def _extract_source_values(src: dict, path: str) -> List:
+    node: Any = src
+    for part in path.split("."):
+        if isinstance(node, dict):
+            node = node.get(part)
+        elif isinstance(node, list):
+            node = [n.get(part) for n in node if isinstance(n, dict)]
+        else:
+            return []
+        if node is None:
+            return []
+    return node if isinstance(node, list) else [node]
+
+
+def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
+                           seg: Segment, ctx) -> Optional[dict]:
+    """Device arrays -> host partial in the shapes `aggregations.merge_partials`
+    expects."""
+    if device_out is None:
+        return None
+    kind = aspec[0]
+
+    if kind in ("terms_missing", "hist_missing"):
+        return None
+
+    if kind == "terms":
+        _, prefix, f, nvocab_pad, subs = aspec
+        counts = np.asarray(device_out["counts"])
+        vocab = seg.keyword_cols[f].vocab
+        nz = np.nonzero(counts[: len(vocab)] > 0)[0]
+        buckets = {}
+        for o in nz:
+            rec: dict = {"doc_count": int(round(float(counts[o])))}
+            sub_partials = {}
+            for i, sub_node in enumerate(node.subs):
+                t = device_out.get(f"sub{i}")
+                if t is not None:
+                    sums, cnts, mins, maxs, sumsq = (np.asarray(x) for x in t)
+                    sub_partials[sub_node.name] = {
+                        "count": float(cnts[o]), "sum": float(sums[o]),
+                        "min": float(mins[o]), "max": float(maxs[o]),
+                        "sumsq": float(sumsq[o])}
+            if sub_partials:
+                rec["subs"] = sub_partials
+            buckets[vocab[o]] = rec
+        return {"buckets": buckets}
+
+    if kind == "hist":
+        _, prefix, f, interval, offset, min_b, nb, subs = aspec
+        return _hist_partial(node, device_out, min_b, interval, offset)
+
+    if kind == "date_hist":
+        _, prefix, f, interval_ms, offset_ms, calendar, min_b, nb, subs = aspec
+        if calendar is not None:
+            # convert calendar bucket ids to epoch-ms keys host-side
+            counts = np.asarray(device_out["counts"])
+            buckets = {}
+            for j in np.nonzero(counts > 0)[0]:
+                epoch = _calendar_bucket_to_epoch_ms(min_b + int(j), calendar)
+                rec = {"doc_count": int(round(float(counts[j])))}
+                rec["subs"] = _bucket_subs(node, device_out, int(j))
+                buckets[epoch] = rec
+            return {"buckets": buckets, "interval": 1, "offset": 0.0}
+        return _hist_partial(node, device_out, min_b, float(interval_ms),
+                             float(offset_ms))
+
+    if kind == "range":
+        _, prefix, f, keys, col_exists, subs, bounds = aspec
+        counts = np.asarray(device_out["counts"])
+        buckets = {}
+        for ri, key in enumerate(keys):
+            rec = {"doc_count": int(round(float(counts[ri])))}
+            lo, hi = bounds[ri]
+            meta = {}
+            if np.isfinite(lo):
+                meta["from"] = lo
+            if np.isfinite(hi):
+                meta["to"] = hi
+            rec["meta"] = meta
+            sub_partials = {}
+            for i, sub_node in enumerate(node.subs):
+                r = device_out.get(f"r{ri}_sub{i}")
+                if r is not None:
+                    sub_partials[sub_node.name] = _device_agg_to_partial(
+                        sub_node, _find_sub_spec(aspec, i), r, seg, ctx)
+            rec["subs"] = sub_partials
+            buckets[key] = rec
+        return {"buckets": buckets}
+
+    if kind in ("filter", "global", "missing"):
+        subs_field = {"filter": 3, "global": 2, "missing": 4}[kind]
+        sub_specs = aspec[subs_field]
+        rec = {"doc_count": int(round(float(np.asarray(device_out["count"])))),
+               "subs": {}}
+        for i, sub_node in enumerate(node.subs):
+            r = device_out.get(f"sub{i}")
+            if r is not None:
+                rec["subs"][sub_node.name] = _device_agg_to_partial(
+                    sub_node, sub_specs[i], r, seg, ctx)
+        return rec
+
+    if kind == "filters":
+        _, prefix, fspecs, sub_specs = aspec
+        buckets = {}
+        for ki, (key, _) in enumerate(fspecs):
+            ent = device_out.get(f"k{ki}", {})
+            rec = {"doc_count": int(round(float(np.asarray(ent.get("count", 0.0))))),
+                   "subs": {}}
+            for i, sub_node in enumerate(node.subs):
+                r = ent.get(f"sub{i}")
+                if r is not None:
+                    rec["subs"][sub_node.name] = _device_agg_to_partial(
+                        sub_node, sub_specs[i], r, seg, ctx)
+            buckets[key] = rec
+        return {"buckets": buckets}
+
+    if kind == "stats":
+        if "empty" in device_out:
+            return {"count": 0, "sum": 0.0, "min": float("inf"),
+                    "max": float("-inf"), "sumsq": 0.0}
+        return {"count": float(np.asarray(device_out["count"])),
+                "sum": float(np.asarray(device_out["sum"])),
+                "min": float(np.asarray(device_out["min"])),
+                "max": float(np.asarray(device_out["max"])),
+                "sumsq": float(np.asarray(device_out["sumsq"]))}
+
+    if kind == "vc_keyword":
+        return {"count": float(np.asarray(device_out["count"])), "sum": 0.0,
+                "min": 0.0, "max": 0.0, "sumsq": 0.0}
+
+    if kind in ("card_kw", "card_num"):
+        return {"registers": np.asarray(device_out["registers"])}
+
+    if kind == "pctl":
+        _, prefix, f, col_exists, lo, hi, percents = aspec
+        return {"hist": np.asarray(device_out["hist"]), "lo": lo, "hi": hi,
+                "percents": list(percents)}
+
+    raise ValueError(f"cannot build partial for agg spec [{kind}]")
+
+
+def _find_sub_spec(aspec, i):
+    for item in aspec:
+        if isinstance(item, tuple) and len(item) > i and isinstance(item[i], tuple):
+            return item[i]
+    return None
+
+
+def _bucket_subs(node: AggNode, device_out: dict, j: int) -> dict:
+    subs = {}
+    for i, sub_node in enumerate(node.subs):
+        t = device_out.get(f"sub{i}")
+        if t is not None:
+            sums, cnts, mins, maxs, sumsq = (np.asarray(x) for x in t)
+            subs[sub_node.name] = {"count": float(cnts[j]), "sum": float(sums[j]),
+                                   "min": float(mins[j]), "max": float(maxs[j]),
+                                   "sumsq": float(sumsq[j])}
+    return subs
+
+
+def _hist_partial(node: AggNode, device_out: dict, min_b: int, interval: float,
+                  offset: float) -> dict:
+    counts = np.asarray(device_out["counts"])
+    buckets = {}
+    for j in np.nonzero(counts > 0)[0]:
+        rec = {"doc_count": int(round(float(counts[j])))}
+        rec["subs"] = _bucket_subs(node, device_out, int(j))
+        buckets[min_b + int(j)] = rec
+    return {"buckets": buckets, "interval": interval, "offset": offset}
+
+
+def _calendar_bucket_to_epoch_ms(b: int, calendar: str) -> int:
+    import datetime as dt
+
+    if calendar in ("month", "1M"):
+        y, m = 1970 + b // 12, b % 12 + 1
+        return int(dt.datetime(y, m, 1, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    if calendar in ("year", "1y"):
+        return int(dt.datetime(1970 + b, 1, 1, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    if calendar in ("quarter", "1q"):
+        y, q = 1970 + b // 4, b % 4
+        return int(dt.datetime(y, q * 3 + 1, 1, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    if calendar in ("week", "1w"):
+        return (b * 7 - 3) * 86400000
+    if calendar in ("day", "1d"):
+        return b * 86400000
+    if calendar in ("hour", "1h"):
+        return b * 3600000
+    if calendar in ("minute", "1m"):
+        return b * 60000
+    raise ValueError(calendar)
+
+
+# =====================================================================
+# explain (host recompute, reference TransportExplainAction)
+# =====================================================================
+
+def explain_doc(lroot, seg: Segment, doc: int, ctx) -> dict:
+    from .compiler import LBool, LConstScore, LDisMax, LTerms
+    from ..ops.scoring import SIM_BM25
+
+    def walk(n) -> Tuple[float, dict]:
+        if isinstance(n, LTerms):
+            details = []
+            total = 0.0
+            dl = float(seg.doc_lens.get(n.field, np.zeros(seg.ndocs))[doc]) \
+                if n.field in seg.doc_lens else 0.0
+            avgdl = ctx.avgdl(n.field)
+            pb = seg.postings.get(n.field)
+            for i, t in enumerate(n.terms):
+                if pb is None:
+                    continue
+                r = pb.row(t)
+                if r < 0:
+                    continue
+                a, b = pb.row_slice(r)
+                k = a + int(np.searchsorted(pb.doc_ids[a:b], doc))
+                if k >= b or pb.doc_ids[k] != doc:
+                    continue
+                tf = float(pb.tfs[k])
+                w = float(n.weights[i])
+                sim = n.sim
+                if sim.sim_id == SIM_BM25:
+                    b_eff = sim.b if n.has_norms else 0.0
+                    kk = sim.k1 * (1 - b_eff + b_eff * dl / max(avgdl, 1e-9))
+                    contrib = w * tf / (tf + kk)
+                    desc = (f"weight({n.field}:{t}) = idf*boost {w:.4f} * "
+                            f"tf {tf:.0f}/(tf+{kk:.3f})")
+                else:
+                    contrib = w
+                    desc = f"weight({n.field}:{t})"
+                total += contrib
+                details.append({"value": contrib, "description": desc, "details": []})
+            return total, {"value": total,
+                           "description": f"sum of term scores on [{n.field}]",
+                           "details": details}
+        if isinstance(n, LBool):
+            total = 0.0
+            details = []
+            for c in n.musts + n.shoulds:
+                v, d = walk(c)
+                total += v
+                details.append(d)
+            total *= n.boost
+            return total, {"value": total, "description": "sum of:", "details": details}
+        if isinstance(n, LConstScore):
+            return n.boost, {"value": n.boost, "description": "ConstantScore",
+                             "details": []}
+        if isinstance(n, LDisMax):
+            vals = [walk(c) for c in n.children]
+            best = max((v for v, _ in vals), default=0.0)
+            total = best + n.tie_breaker * (sum(v for v, _ in vals) - best)
+            return total, {"value": total, "description": "max plus tie_breaker of:",
+                           "details": [d for _, d in vals]}
+        return 0.0, {"value": 0.0, "description": type(n).__name__, "details": []}
+
+    _, expl = walk(lroot)
+    return expl

@@ -1,0 +1,80 @@
+"""Plain highlighter. Analog of reference
+`search/fetch/subphase/highlight/PlainHighlighter.java`: re-analyzes the
+stored field text, marks query-term occurrences, and emits the best
+fragments."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..analysis import Analyzer
+
+
+def highlight_field(text: str, terms: Set[str], analyzer: Analyzer,
+                    pre_tag: str = "<em>", post_tag: str = "</em>",
+                    fragment_size: int = 100, number_of_fragments: int = 5) -> List[str]:
+    tokens = analyzer.analyze(text)
+    hits = [(t.start_offset, t.end_offset) for t in tokens if t.text in terms]
+    if not hits:
+        return []
+    if number_of_fragments == 0:
+        # highlight whole field
+        return [_mark(text, hits, pre_tag, post_tag)]
+    # greedy fragmenting: grow a window around consecutive hits
+    fragments: List[tuple] = []
+    cur: List[tuple] = []
+    for h in hits:
+        if cur and h[1] - cur[0][0] > fragment_size:
+            fragments.append(tuple(cur))
+            cur = []
+        cur.append(h)
+    if cur:
+        fragments.append(tuple(cur))
+    out = []
+    for frag_hits in fragments[:number_of_fragments]:
+        s = max(0, frag_hits[0][0] - (fragment_size - (frag_hits[-1][1] - frag_hits[0][0])) // 2)
+        e = min(len(text), s + max(fragment_size, frag_hits[-1][1] - frag_hits[0][0]))
+        rel = [(a - s, b - s) for a, b in frag_hits if a >= s and b <= e]
+        out.append(_mark(text[s:e], rel, pre_tag, post_tag))
+    return out
+
+
+def _mark(text: str, spans: List[tuple], pre: str, post: str) -> str:
+    out = []
+    prev = 0
+    for a, b in spans:
+        out.append(text[prev:a])
+        out.append(pre)
+        out.append(text[a:b])
+        out.append(post)
+        prev = b
+    out.append(text[prev:])
+    return "".join(out)
+
+
+def collect_query_terms(lnode) -> Dict[str, Set[str]]:
+    """field -> query terms, walked from the logical plan (for highlighting)."""
+    from .compiler import (LBool, LBoosting, LConstScore, LDisMax, LFuncScore, LTerms)
+
+    out: Dict[str, Set[str]] = {}
+
+    def walk(n):
+        if n is None:
+            return
+        if isinstance(n, LTerms):
+            out.setdefault(n.field, set()).update(n.terms)
+        elif isinstance(n, LBool):
+            for c in n.musts + n.shoulds + n.filters:
+                walk(c)
+        elif isinstance(n, LConstScore):
+            walk(n.child)
+        elif isinstance(n, LDisMax):
+            for c in n.children:
+                walk(c)
+        elif isinstance(n, LBoosting):
+            walk(n.positive)
+        elif isinstance(n, LFuncScore):
+            walk(n.child)
+
+    walk(lnode)
+    return out

@@ -1,0 +1,460 @@
+"""Query DSL parsing: JSON dicts -> QueryBuilder tree. Analog of reference
+`index/query/*QueryBuilder.java` fromXContent parsers (same DSL surface).
+
+The tree is *unrewritten*: analysis, multi-term expansion, and idf weighting
+happen in `compiler.rewrite` (the analog of QueryBuilder.rewrite +
+Query.createWeight, which need index statistics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class QueryParseError(ValueError):
+    """Analog of reference ParsingException (HTTP 400)."""
+
+
+@dataclass
+class Query:
+    boost: float = 1.0
+    name: Optional[str] = None  # _name for matched_queries
+
+
+@dataclass
+class MatchAllQuery(Query):
+    pass
+
+
+@dataclass
+class MatchNoneQuery(Query):
+    pass
+
+
+@dataclass
+class TermQuery(Query):
+    field: str = ""
+    value: Any = None
+    case_insensitive: bool = False
+
+
+@dataclass
+class TermsQuery(Query):
+    field: str = ""
+    values: List[Any] = dc_field(default_factory=list)
+
+
+@dataclass
+class MatchQuery(Query):
+    field: str = ""
+    query: Any = None
+    operator: str = "or"
+    minimum_should_match: Optional[str] = None
+    analyzer: Optional[str] = None
+    fuzziness: Optional[Any] = None
+
+
+@dataclass
+class MultiMatchQuery(Query):
+    fields: List[str] = dc_field(default_factory=list)
+    query: Any = None
+    type: str = "best_fields"
+    operator: str = "or"
+    tie_breaker: float = 0.0
+    minimum_should_match: Optional[str] = None
+
+
+@dataclass
+class MatchPhraseQuery(Query):
+    field: str = ""
+    query: Any = None
+    slop: int = 0
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class BoolQuery(Query):
+    must: List[Query] = dc_field(default_factory=list)
+    should: List[Query] = dc_field(default_factory=list)
+    must_not: List[Query] = dc_field(default_factory=list)
+    filter: List[Query] = dc_field(default_factory=list)
+    minimum_should_match: Optional[str] = None
+
+
+@dataclass
+class RangeQuery(Query):
+    field: str = ""
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+    date_format: Optional[str] = None
+
+
+@dataclass
+class ExistsQuery(Query):
+    field: str = ""
+
+
+@dataclass
+class IdsQuery(Query):
+    values: List[str] = dc_field(default_factory=list)
+
+
+@dataclass
+class ConstantScoreQuery(Query):
+    filter: Optional[Query] = None
+
+
+@dataclass
+class BoostingQuery(Query):
+    positive: Optional[Query] = None
+    negative: Optional[Query] = None
+    negative_boost: float = 0.5
+
+
+@dataclass
+class DisMaxQuery(Query):
+    queries: List[Query] = dc_field(default_factory=list)
+    tie_breaker: float = 0.0
+
+
+@dataclass
+class PrefixQuery(Query):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class WildcardQuery(Query):
+    field: str = ""
+    value: str = ""
+    case_insensitive: bool = False
+
+
+@dataclass
+class RegexpQuery(Query):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class FuzzyQuery(Query):
+    field: str = ""
+    value: str = ""
+    fuzziness: Any = "AUTO"
+    prefix_length: int = 0
+
+
+@dataclass
+class QueryStringQuery(Query):
+    query: str = ""
+    default_field: Optional[str] = None
+    fields: List[str] = dc_field(default_factory=list)
+    default_operator: str = "or"
+
+
+@dataclass
+class SimpleQueryStringQuery(Query):
+    query: str = ""
+    fields: List[str] = dc_field(default_factory=list)
+    default_operator: str = "or"
+
+
+@dataclass
+class GeoDistanceQuery(Query):
+    field: str = ""
+    lat: float = 0.0
+    lon: float = 0.0
+    distance_m: float = 0.0
+
+
+@dataclass
+class GeoBoundingBoxQuery(Query):
+    field: str = ""
+    top: float = 0.0
+    left: float = 0.0
+    bottom: float = 0.0
+    right: float = 0.0
+
+
+@dataclass
+class ScoreFunction:
+    kind: str                      # weight | field_value_factor | random_score | script_score(stub)
+    weight: float = 1.0
+    filter: Optional[Query] = None
+    field: Optional[str] = None
+    factor: float = 1.0
+    modifier: str = "none"
+    missing: Optional[float] = None
+    seed: int = 0
+
+
+@dataclass
+class FunctionScoreQuery(Query):
+    query: Optional[Query] = None
+    functions: List[ScoreFunction] = dc_field(default_factory=list)
+    score_mode: str = "multiply"   # multiply | sum | avg | max | min | first
+    boost_mode: str = "multiply"   # multiply | sum | replace | avg | max | min
+    max_boost: float = 3.4e38
+    min_score: Optional[float] = None
+
+
+@dataclass
+class NestedQuery(Query):
+    path: str = ""
+    query: Optional[Query] = None
+    score_mode: str = "avg"
+
+
+def _one_entry(d: dict, what: str) -> Tuple[str, Any]:
+    if not isinstance(d, dict) or len(d) != 1:
+        raise QueryParseError(f"[{what}] malformed query, expected a single field object")
+    return next(iter(d.items()))
+
+
+def _common(q: Query, body: Any) -> None:
+    if isinstance(body, dict):
+        q.boost = float(body.get("boost", 1.0))
+        q.name = body.get("_name")
+
+
+def parse_query(dsl: Optional[dict]) -> Query:
+    """DSL dict -> Query tree (reference: SearchModule registered parsers)."""
+    if dsl is None:
+        return MatchAllQuery()
+    kind, body = _one_entry(dsl, "query")
+
+    if kind == "match_all":
+        q = MatchAllQuery(); _common(q, body); return q
+    if kind == "match_none":
+        q = MatchNoneQuery(); _common(q, body); return q
+
+    if kind == "term":
+        f, spec = _one_entry(body, "term")
+        if isinstance(spec, dict):
+            q = TermQuery(field=f, value=spec.get("value"),
+                          case_insensitive=spec.get("case_insensitive", False))
+            _common(q, spec)
+        else:
+            q = TermQuery(field=f, value=spec)
+        return q
+
+    if kind == "terms":
+        opts = {k: v for k, v in body.items() if k in ("boost", "_name")}
+        fields = [(k, v) for k, v in body.items() if k not in ("boost", "_name")]
+        if len(fields) != 1:
+            raise QueryParseError("[terms] query requires exactly one field")
+        f, vals = fields[0]
+        q = TermsQuery(field=f, values=list(vals))
+        _common(q, opts)
+        return q
+
+    if kind == "match":
+        f, spec = _one_entry(body, "match")
+        if isinstance(spec, dict):
+            q = MatchQuery(field=f, query=spec.get("query"),
+                           operator=str(spec.get("operator", "or")).lower(),
+                           minimum_should_match=spec.get("minimum_should_match"),
+                           analyzer=spec.get("analyzer"),
+                           fuzziness=spec.get("fuzziness"))
+            _common(q, spec)
+        else:
+            q = MatchQuery(field=f, query=spec)
+        return q
+
+    if kind == "multi_match":
+        q = MultiMatchQuery(fields=list(body.get("fields", [])), query=body.get("query"),
+                            type=body.get("type", "best_fields"),
+                            operator=str(body.get("operator", "or")).lower(),
+                            tie_breaker=float(body.get("tie_breaker", 0.0)),
+                            minimum_should_match=body.get("minimum_should_match"))
+        _common(q, body)
+        return q
+
+    if kind in ("match_phrase", "match_phrase_prefix"):
+        f, spec = _one_entry(body, kind)
+        if isinstance(spec, dict):
+            q = MatchPhraseQuery(field=f, query=spec.get("query"),
+                                 slop=int(spec.get("slop", 0)), analyzer=spec.get("analyzer"))
+            _common(q, spec)
+        else:
+            q = MatchPhraseQuery(field=f, query=spec)
+        return q
+
+    if kind == "bool":
+        def many(key):
+            v = body.get(key, [])
+            v = v if isinstance(v, list) else [v]
+            return [parse_query(x) for x in v]
+        q = BoolQuery(must=many("must"), should=many("should"),
+                      must_not=many("must_not"), filter=many("filter"),
+                      minimum_should_match=body.get("minimum_should_match"))
+        _common(q, body)
+        return q
+
+    if kind == "range":
+        f, spec = _one_entry(body, "range")
+        q = RangeQuery(field=f, gte=spec.get("gte", spec.get("from")),
+                       gt=spec.get("gt"), lte=spec.get("lte", spec.get("to")),
+                       lt=spec.get("lt"), date_format=spec.get("format"))
+        _common(q, spec)
+        return q
+
+    if kind == "exists":
+        q = ExistsQuery(field=body["field"]); _common(q, body); return q
+
+    if kind == "ids":
+        q = IdsQuery(values=list(body.get("values", []))); _common(q, body); return q
+
+    if kind == "constant_score":
+        q = ConstantScoreQuery(filter=parse_query(body["filter"]))
+        _common(q, body)
+        return q
+
+    if kind == "boosting":
+        q = BoostingQuery(positive=parse_query(body["positive"]),
+                          negative=parse_query(body["negative"]),
+                          negative_boost=float(body.get("negative_boost", 0.5)))
+        _common(q, body)
+        return q
+
+    if kind == "dis_max":
+        q = DisMaxQuery(queries=[parse_query(x) for x in body.get("queries", [])],
+                        tie_breaker=float(body.get("tie_breaker", 0.0)))
+        _common(q, body)
+        return q
+
+    if kind in ("prefix", "wildcard", "regexp", "fuzzy"):
+        f, spec = _one_entry(body, kind)
+        if isinstance(spec, dict):
+            value = spec.get("value", spec.get(kind))
+            ci = spec.get("case_insensitive", False)
+        else:
+            value, ci, spec = spec, False, {}
+        if kind == "prefix":
+            q = PrefixQuery(field=f, value=str(value), case_insensitive=ci)
+        elif kind == "wildcard":
+            q = WildcardQuery(field=f, value=str(value), case_insensitive=ci)
+        elif kind == "regexp":
+            q = RegexpQuery(field=f, value=str(value))
+        else:
+            q = FuzzyQuery(field=f, value=str(value),
+                           fuzziness=spec.get("fuzziness", "AUTO"),
+                           prefix_length=int(spec.get("prefix_length", 0)))
+        _common(q, spec)
+        return q
+
+    if kind == "query_string":
+        q = QueryStringQuery(query=body["query"], default_field=body.get("default_field"),
+                             fields=list(body.get("fields", [])),
+                             default_operator=str(body.get("default_operator", "or")).lower())
+        _common(q, body)
+        return q
+
+    if kind == "simple_query_string":
+        q = SimpleQueryStringQuery(query=body["query"], fields=list(body.get("fields", [])),
+                                   default_operator=str(body.get("default_operator", "or")).lower())
+        _common(q, body)
+        return q
+
+    if kind == "geo_distance":
+        dist = _parse_distance(body["distance"])
+        fields = [(k, v) for k, v in body.items()
+                  if k not in ("distance", "boost", "_name", "validation_method")]
+        f, point = fields[0]
+        lat, lon = _parse_point(point)
+        q = GeoDistanceQuery(field=f, lat=lat, lon=lon, distance_m=dist)
+        _common(q, body)
+        return q
+
+    if kind == "geo_bounding_box":
+        fields = [(k, v) for k, v in body.items() if k not in ("boost", "_name", "validation_method")]
+        f, box = fields[0]
+        tl = box.get("top_left")
+        br = box.get("bottom_right")
+        if tl is not None:
+            tlat, tlon = _parse_point(tl)
+            blat, blon = _parse_point(br)
+        else:
+            tlat, tlon, blat, blon = box["top"], box["left"], box["bottom"], box["right"]
+        q = GeoBoundingBoxQuery(field=f, top=tlat, left=tlon, bottom=blat, right=blon)
+        _common(q, body)
+        return q
+
+    if kind == "function_score":
+        inner = parse_query(body.get("query")) if body.get("query") else MatchAllQuery()
+        functions = []
+        raw_fns = body.get("functions", [])
+        if not raw_fns:  # single-function shorthand
+            raw_fns = [{k: v for k, v in body.items()
+                        if k in ("weight", "field_value_factor", "random_score", "script_score")}]
+        for fn in raw_fns:
+            filt = parse_query(fn["filter"]) if "filter" in fn else None
+            if "field_value_factor" in fn:
+                fv = fn["field_value_factor"]
+                functions.append(ScoreFunction("field_value_factor", fn.get("weight", 1.0),
+                                               filt, fv["field"], fv.get("factor", 1.0),
+                                               fv.get("modifier", "none"), fv.get("missing")))
+            elif "random_score" in fn:
+                functions.append(ScoreFunction("random_score", fn.get("weight", 1.0), filt,
+                                               seed=int(fn["random_score"].get("seed", 0))))
+            elif "weight" in fn:
+                functions.append(ScoreFunction("weight", float(fn["weight"]), filt))
+        q = FunctionScoreQuery(query=inner, functions=functions,
+                               score_mode=body.get("score_mode", "multiply"),
+                               boost_mode=body.get("boost_mode", "multiply"),
+                               min_score=body.get("min_score"))
+        _common(q, body)
+        return q
+
+    if kind == "nested":
+        q = NestedQuery(path=body["path"], query=parse_query(body["query"]),
+                        score_mode=body.get("score_mode", "avg"))
+        _common(q, body)
+        return q
+
+    raise QueryParseError(f"unknown query [{kind}]")
+
+
+def _parse_distance(d) -> float:
+    """'5km', '100m', '2mi' -> meters (reference DistanceUnit)."""
+    if isinstance(d, (int, float)):
+        return float(d)
+    s = str(d).strip().lower()
+    units = [("km", 1000.0), ("mi", 1609.344), ("yd", 0.9144), ("ft", 0.3048),
+             ("nmi", 1852.0), ("mm", 0.001), ("cm", 0.01), ("m", 1.0)]
+    for suf, mult in units:
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    return float(s)
+
+
+def _parse_point(p) -> Tuple[float, float]:
+    if isinstance(p, dict):
+        return float(p["lat"]), float(p["lon"])
+    if isinstance(p, str):
+        lat, lon = p.split(",")
+        return float(lat), float(lon)
+    return float(p[1]), float(p[0])  # GeoJSON [lon, lat]
+
+
+def parse_minimum_should_match(spec: Optional[str], n_optional: int) -> int:
+    """'2', '-1', '75%', '-25%' semantics (reference Queries.calculateMinShouldMatch)."""
+    if spec is None or n_optional == 0:
+        return 0 if spec is None else 0
+    s = str(spec).strip()
+    try:
+        if s.endswith("%"):
+            pct = float(s[:-1])
+            if pct < 0:
+                return max(n_optional - int(-pct / 100.0 * n_optional), 0)
+            return int(pct / 100.0 * n_optional)
+        v = int(s)
+        if v < 0:
+            return max(n_optional + v, 0)
+        return min(v, n_optional)
+    except ValueError:
+        raise QueryParseError(f"invalid minimum_should_match [{spec}]")
