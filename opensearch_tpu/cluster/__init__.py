@@ -1,0 +1,6 @@
+from .node import IndexService, Node
+from .routing import shard_for
+from .state import ClusterMetadata, IndexMetadata, IndexNotFoundError
+
+__all__ = ["Node", "IndexService", "shard_for", "ClusterMetadata",
+           "IndexMetadata", "IndexNotFoundError"]

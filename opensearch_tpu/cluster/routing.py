@@ -1,0 +1,54 @@
+"""Shard routing. Analog of reference
+`cluster/routing/OperationRouting.java` + `cluster/routing/Murmur3HashFunction.java`:
+shard = floorMod(murmur3_x86_32(routing_string), num_shards).
+"""
+
+from __future__ import annotations
+
+
+def _rotl32(x: int, r: int) -> int:
+    return ((x << r) | (x >> (32 - r))) & 0xFFFFFFFF
+
+
+def murmur3_x86_32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86_32 (same algorithm/seed as the reference's
+    Murmur3HashFunction, which hashes the UTF-16LE... actually the reference
+    hashes the String's UTF-16 code units via StringHelper on UTF-8 bytes of
+    the id; we standardize on UTF-8 bytes — consistent within this engine)."""
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        k = int.from_bytes(data[i * 4:(i + 1) * 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = _rotl32(k, 15)
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = _rotl32(h, 13)
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    tail = data[nblocks * 4:]
+    k = 0
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = _rotl32(k, 15)
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def shard_for(routing: str, num_shards: int) -> int:
+    h = murmur3_x86_32(routing.encode("utf-8"))
+    # Java floorMod on the signed 32-bit value
+    signed = h - (1 << 32) if h >= (1 << 31) else h
+    return signed % num_shards

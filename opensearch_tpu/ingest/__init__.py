@@ -1,0 +1,3 @@
+from .pipeline import IngestService, Pipeline, IngestProcessorException
+
+__all__ = ["IngestService", "Pipeline", "IngestProcessorException"]

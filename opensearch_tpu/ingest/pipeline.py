@@ -1,0 +1,305 @@
+"""Ingest pipelines. Analog of reference `ingest/IngestService.java` +
+`modules/ingest-common` processors. Pipelines run on the host before a doc
+reaches the engine (exactly like the reference runs them on the ingest node
+before the shard bulk)."""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+
+class IngestProcessorException(Exception):
+    pass
+
+
+def _get_path(doc: dict, path: str, default=None):
+    node: Any = doc
+    for p in path.split("."):
+        if not isinstance(node, dict) or p not in node:
+            return default
+        node = node[p]
+    return node
+
+
+def _set_path(doc: dict, path: str, value) -> None:
+    node = doc
+    parts = path.split(".")
+    for p in parts[:-1]:
+        node = node.setdefault(p, {})
+    node[parts[-1]] = value
+
+
+def _del_path(doc: dict, path: str) -> None:
+    node = doc
+    parts = path.split(".")
+    for p in parts[:-1]:
+        if not isinstance(node, dict) or p not in node:
+            return
+        node = node[p]
+    if isinstance(node, dict):
+        node.pop(parts[-1], None)
+
+
+class DropDocument(Exception):
+    """Raised by the drop processor: the doc is silently discarded."""
+
+
+def _render(template: str, doc: dict) -> str:
+    """Tiny mustache: {{field}} substitution (reference lang-mustache)."""
+    return re.sub(r"\{\{\s*([\w.]+)\s*\}\}",
+                  lambda m: str(_get_path(doc, m.group(1), "")), template)
+
+
+def build_processor(kind: str, cfg: dict) -> Callable[[dict], None]:  # noqa: C901
+    if kind == "set":
+        field, value = cfg["field"], cfg.get("value")
+        override = cfg.get("override", True)
+
+        def p_set(doc):
+            if override or _get_path(doc, field) is None:
+                v = _render(value, doc) if isinstance(value, str) else value
+                _set_path(doc, field, v)
+        return p_set
+
+    if kind == "remove":
+        fields = cfg["field"] if isinstance(cfg["field"], list) else [cfg["field"]]
+        return lambda doc: [_del_path(doc, f) for f in fields] and None
+
+    if kind == "rename":
+        src, dst = cfg["field"], cfg["target_field"]
+
+        def p_rename(doc):
+            v = _get_path(doc, src)
+            if v is None:
+                if not cfg.get("ignore_missing", False):
+                    raise IngestProcessorException(f"field [{src}] not present")
+                return
+            _set_path(doc, dst, v)
+            _del_path(doc, src)
+        return p_rename
+
+    if kind == "convert":
+        field = cfg["field"]
+        target = cfg.get("target_field", field)
+        typ = cfg["type"]
+
+        def p_convert(doc):
+            v = _get_path(doc, field)
+            if v is None:
+                if not cfg.get("ignore_missing", False):
+                    raise IngestProcessorException(f"field [{field}] not present")
+                return
+            try:
+                if typ == "integer" or typ == "long":
+                    out: Any = int(v)
+                elif typ == "float" or typ == "double":
+                    out = float(v)
+                elif typ == "boolean":
+                    out = str(v).lower() in ("true", "1", "yes")
+                elif typ == "string":
+                    out = str(v)
+                elif typ == "auto":
+                    try:
+                        out = int(v)
+                    except (TypeError, ValueError):
+                        try:
+                            out = float(v)
+                        except (TypeError, ValueError):
+                            out = v
+                else:
+                    raise IngestProcessorException(f"unknown convert type [{typ}]")
+            except (TypeError, ValueError) as e:
+                raise IngestProcessorException(str(e))
+            _set_path(doc, target, out)
+        return p_convert
+
+    if kind in ("lowercase", "uppercase", "trim"):
+        field = cfg["field"]
+        fn = {"lowercase": str.lower, "uppercase": str.upper, "trim": str.strip}[kind]
+
+        def p_str(doc):
+            v = _get_path(doc, field)
+            if isinstance(v, str):
+                _set_path(doc, field, fn(v))
+            elif isinstance(v, list):
+                _set_path(doc, field, [fn(x) if isinstance(x, str) else x for x in v])
+        return p_str
+
+    if kind == "split":
+        field, sep = cfg["field"], cfg["separator"]
+        return lambda doc: _set_path(doc, cfg.get("target_field", field),
+                                     re.split(sep, _get_path(doc, field, "")))
+
+    if kind == "join":
+        field, sep = cfg["field"], cfg["separator"]
+        return lambda doc: _set_path(doc, cfg.get("target_field", field),
+                                     sep.join(str(x) for x in _get_path(doc, field, [])))
+
+    if kind == "gsub":
+        field = cfg["field"]
+        pat = re.compile(cfg["pattern"])
+        rep = cfg["replacement"]
+        return lambda doc: _set_path(doc, cfg.get("target_field", field),
+                                     pat.sub(rep, str(_get_path(doc, field, ""))))
+
+    if kind == "append":
+        field, value = cfg["field"], cfg["value"]
+
+        def p_append(doc):
+            cur = _get_path(doc, field)
+            vals = value if isinstance(value, list) else [value]
+            if cur is None:
+                _set_path(doc, field, list(vals))
+            elif isinstance(cur, list):
+                cur.extend(vals)
+            else:
+                _set_path(doc, field, [cur] + list(vals))
+        return p_append
+
+    if kind == "date":
+        field = cfg["field"]
+        target = cfg.get("target_field", "@timestamp")
+        formats = cfg.get("formats", ["ISO8601"])
+
+        def p_date(doc):
+            v = _get_path(doc, field)
+            for fmt in formats:
+                try:
+                    if fmt in ("ISO8601", "strict_date_optional_time"):
+                        d = _dt.datetime.fromisoformat(str(v).replace("Z", "+00:00"))
+                    elif fmt == "UNIX":
+                        d = _dt.datetime.fromtimestamp(float(v), _dt.timezone.utc)
+                    elif fmt == "UNIX_MS":
+                        d = _dt.datetime.fromtimestamp(float(v) / 1000, _dt.timezone.utc)
+                    else:
+                        d = _dt.datetime.strptime(str(v), fmt)
+                    if d.tzinfo is None:
+                        d = d.replace(tzinfo=_dt.timezone.utc)
+                    _set_path(doc, target, d.isoformat().replace("+00:00", "Z"))
+                    return
+                except (ValueError, TypeError):
+                    continue
+            raise IngestProcessorException(f"unable to parse date [{v}]")
+        return p_date
+
+    if kind == "grok":
+        field = cfg["field"]
+        patterns = cfg["patterns"]
+        compiled = [_grok_compile(p) for p in patterns]
+
+        def p_grok(doc):
+            v = str(_get_path(doc, field, ""))
+            for rx in compiled:
+                m = rx.match(v)
+                if m:
+                    for k, val in m.groupdict().items():
+                        if val is not None:
+                            _set_path(doc, k, val)
+                    return
+            if not cfg.get("ignore_missing", False):
+                raise IngestProcessorException("grok patterns do not match")
+        return p_grok
+
+    if kind == "drop":
+        def p_drop(doc):
+            raise DropDocument()
+        return p_drop
+
+    if kind == "fail":
+        msg = cfg.get("message", "fail processor triggered")
+
+        def p_fail(doc):
+            raise IngestProcessorException(_render(msg, doc))
+        return p_fail
+
+    if kind == "pipeline":
+        raise IngestProcessorException("nested pipeline processor requires service context")
+
+    raise IngestProcessorException(f"unknown processor type [{kind}]")
+
+
+_GROK_BASE = {
+    "WORD": r"\w+", "NUMBER": r"[-+]?\d+(?:\.\d+)?", "INT": r"[-+]?\d+",
+    "IP": r"\d{1,3}(?:\.\d{1,3}){3}", "LOGLEVEL": r"[A-Za-z]+",
+    "TIMESTAMP_ISO8601": r"\d{4}-\d{2}-\d{2}[T ]\d{2}:\d{2}:\d{2}(?:[.,]\d+)?(?:Z|[+-]\d{2}:?\d{2})?",
+    "GREEDYDATA": r".*", "DATA": r".*?", "NOTSPACE": r"\S+", "SPACE": r"\s*",
+    "USERNAME": r"[a-zA-Z0-9._-]+", "UUID": r"[0-9a-fA-F-]{36}",
+}
+
+
+def _grok_compile(pattern: str) -> re.Pattern:
+    def repl(m):
+        name, alias = m.group(1), m.group(2)
+        base = _GROK_BASE.get(name, r".*?")
+        if alias:
+            safe = alias.replace(".", "_DOT_")
+            return f"(?P<{safe}>{base})"
+        return f"(?:{base})"
+
+    rx = re.sub(r"%\{(\w+)(?::([\w.]+))?\}", repl, pattern)
+    compiled = re.compile(rx)
+    return compiled
+
+
+class Pipeline:
+    def __init__(self, pid: str, config: dict):
+        self.id = pid
+        self.description = config.get("description", "")
+        self.processors: List[tuple] = []
+        for pspec in config.get("processors", []):
+            ((kind, cfg),) = pspec.items()
+            self.processors.append((kind, cfg, build_processor(kind, cfg),
+                                    cfg.get("ignore_failure", False),
+                                    [build_processor(*next(iter(f.items())))
+                                     for f in cfg.get("on_failure", [])]))
+
+    def run(self, doc: dict) -> Optional[dict]:
+        """Returns the transformed doc, or None when dropped."""
+        for kind, cfg, proc, ignore_failure, on_failure in self.processors:
+            try:
+                proc(doc)
+            except DropDocument:
+                return None
+            except IngestProcessorException:
+                if on_failure:
+                    for fp in on_failure:
+                        fp(doc)
+                elif not ignore_failure:
+                    raise
+        return doc
+
+
+class IngestService:
+    def __init__(self):
+        self.pipelines: Dict[str, Pipeline] = {}
+
+    def put_pipeline(self, pid: str, config: dict) -> None:
+        self.pipelines[pid] = Pipeline(pid, config)
+
+    def delete_pipeline(self, pid: str) -> None:
+        self.pipelines.pop(pid, None)
+
+    def get_pipeline(self, pid: str) -> Optional[Pipeline]:
+        return self.pipelines.get(pid)
+
+    def run(self, pid: str, doc: dict) -> Optional[dict]:
+        p = self.pipelines.get(pid)
+        if p is None:
+            raise IngestProcessorException(f"pipeline [{pid}] does not exist")
+        return p.run(doc)
+
+    def simulate(self, config: dict, docs: List[dict]) -> List[dict]:
+        p = Pipeline("_simulate", config)
+        out = []
+        for d in docs:
+            src = dict(d.get("_source", d))
+            try:
+                res = p.run(src)
+                out.append({"doc": {"_source": res}} if res is not None
+                           else {"doc": None, "dropped": True})
+            except IngestProcessorException as e:
+                out.append({"error": {"type": "ingest_processor_exception",
+                                      "reason": str(e)}})
+        return out

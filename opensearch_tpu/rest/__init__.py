@@ -1,0 +1,3 @@
+from .client import ApiError, RestClient
+
+__all__ = ["RestClient", "ApiError"]

@@ -1,0 +1,705 @@
+"""RestClient: the user-facing API façade mirroring the OpenSearch REST
+surface (reference `rest/action/*`, `action/admin/*`, and the opensearch-py
+client method names). Dict-in / dict-out with the same JSON shapes, HTTP-less.
+
+Doc APIs route through the cluster's write index + murmur3 shard routing;
+search fans out over shard searchers and reduces like the coordinator node.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..cluster.node import Node
+from ..cluster.state import IndexNotFoundError
+from ..index.engine import VersionConflictError
+from ..ingest.pipeline import DropDocument
+from ..search.executor import ShardSearcher, explain_doc, search_shards
+from ..search import compiler as C
+from ..search import query_dsl as dsl
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, err_type: str, reason: str):
+        super().__init__(reason)
+        self.status = status
+        self.err_type = err_type
+        self.reason = reason
+
+    def body(self) -> dict:
+        return {"error": {"type": self.err_type, "reason": self.reason},
+                "status": self.status}
+
+
+class RestClient:
+    def __init__(self, node: Optional[Node] = None, data_path: Optional[str] = None):
+        self.node = node or Node(data_path=data_path)
+        self.indices = IndicesClient(self)
+        self.ingest = IngestClient(self)
+        self.snapshot = SnapshotClient(self)
+        self.cluster = ClusterClient(self)
+        self.cat = CatClient(self)
+        self._scrolls: Dict[str, dict] = {}
+        self._pits: Dict[str, dict] = {}
+
+    # ---------------- document APIs ----------------
+
+    def index(self, index: str, body: dict, id: Optional[str] = None,
+              routing: Optional[str] = None, refresh: bool = False,
+              op_type: str = "index", pipeline: Optional[str] = None,
+              if_seq_no: Optional[int] = None,
+              if_primary_term: Optional[int] = None) -> dict:
+        svc = self.node.index_service_for_write(index)
+        pipeline = pipeline or svc.meta.settings.get("index", {}).get("default_pipeline")
+        if pipeline:
+            try:
+                body = self.node.ingest.run(pipeline, dict(body))
+            except DropDocument:
+                body = None
+            if body is None:
+                return {"_index": index, "_id": id or "", "result": "noop"}
+        doc_id = id if id is not None else uuid.uuid4().hex[:20]
+        try:
+            res = svc.route(doc_id, routing).index_doc(
+                doc_id, body, routing, if_seq_no, if_primary_term, op_type)
+        except VersionConflictError as e:
+            raise ApiError(409, "version_conflict_engine_exception", str(e))
+        svc.generation += 1
+        if refresh:
+            svc.refresh()
+        res["_index"] = svc.meta.name
+        res["_shards"] = {"total": 1, "successful": 1, "failed": 0}
+        return res
+
+    def create(self, index: str, id: str, body: dict, **kw) -> dict:
+        return self.index(index, body, id=id, op_type="create", **kw)
+
+    def get(self, index: str, id: str, routing: Optional[str] = None) -> dict:
+        svc = self.node.get_index(self.node.metadata.write_index(index))
+        res = svc.route(id, routing).get(id)
+        if res is None:
+            raise ApiError(404, "document_missing_exception",
+                           f"[{id}]: document missing")
+        res["_index"] = svc.meta.name
+        return res
+
+    def exists(self, index: str, id: str, routing: Optional[str] = None) -> bool:
+        try:
+            self.get(index, id, routing)
+            return True
+        except (ApiError, IndexNotFoundError):
+            return False
+
+    def mget(self, body: dict, index: Optional[str] = None) -> dict:
+        docs = []
+        for spec in body.get("docs", []):
+            idx = spec.get("_index", index)
+            try:
+                docs.append(self.get(idx, spec["_id"], spec.get("routing")))
+            except (ApiError, IndexNotFoundError):
+                docs.append({"_index": idx, "_id": spec["_id"], "found": False})
+        return {"docs": docs}
+
+    def delete(self, index: str, id: str, routing: Optional[str] = None,
+               refresh: bool = False, if_seq_no: Optional[int] = None,
+               if_primary_term: Optional[int] = None) -> dict:
+        svc = self.node.get_index(self.node.metadata.write_index(index))
+        try:
+            res = svc.route(id, routing).delete_doc(id, if_seq_no, if_primary_term)
+        except VersionConflictError as e:
+            raise ApiError(409, "version_conflict_engine_exception", str(e))
+        svc.generation += 1
+        if refresh:
+            svc.refresh()
+        res["_index"] = svc.meta.name
+        if res["result"] == "not_found":
+            raise ApiError(404, "document_missing_exception", f"[{id}]: not found")
+        return res
+
+    def update(self, index: str, id: str, body: dict, routing: Optional[str] = None,
+               refresh: bool = False, **kw) -> dict:
+        """Partial-doc update / upsert (reference UpdateHelper)."""
+        svc = self.node.index_service_for_write(index)
+        eng = svc.route(id, routing)
+        current = eng.get(id)
+        if current is None:
+            if body.get("doc_as_upsert") and "doc" in body:
+                return self.index(index, body["doc"], id=id, routing=routing,
+                                  refresh=refresh)
+            if "upsert" in body:
+                return self.index(index, body["upsert"], id=id, routing=routing,
+                                  refresh=refresh)
+            raise ApiError(404, "document_missing_exception", f"[{id}]: document missing")
+        src = dict(current["_source"])
+        if "doc" in body:
+            merged = _deep_merge(src, body["doc"])
+            if body.get("detect_noop", True) and merged == src:
+                return {"_index": svc.meta.name, "_id": id, "result": "noop"}
+            return self.index(index, merged, id=id, routing=routing, refresh=refresh)
+        if "script" in body:
+            raise ApiError(400, "illegal_argument_exception",
+                           "scripted updates not supported yet (painless-lite r2)")
+        raise ApiError(400, "action_request_validation_exception",
+                       "update requires doc, upsert or script")
+
+    def bulk(self, body, index: Optional[str] = None, refresh: bool = False) -> dict:
+        """Bulk API. Accepts NDJSON string or a list of alternating
+        action/source dicts (reference RestBulkAction)."""
+        if isinstance(body, str):
+            lines = [json.loads(ln) for ln in body.splitlines() if ln.strip()]
+        else:
+            lines = list(body)
+        items = []
+        errors = False
+        touched = set()
+        i = 0
+        while i < len(lines):
+            action_line = lines[i]
+            ((action, meta),) = action_line.items()
+            idx = meta.get("_index", index)
+            doc_id = meta.get("_id")
+            routing = meta.get("routing", meta.get("_routing"))
+            i += 1
+            try:
+                if action in ("index", "create"):
+                    src = lines[i]; i += 1
+                    res = self.index(idx, src, id=doc_id, routing=routing,
+                                     op_type="create" if action == "create" else "index")
+                    status = 201 if res.get("result") == "created" else 200
+                    items.append({action: {**res, "status": status}})
+                elif action == "delete":
+                    try:
+                        res = self.delete(idx, doc_id, routing=routing)
+                        items.append({"delete": {**res, "status": 200}})
+                    except ApiError as e:
+                        if e.status != 404:
+                            raise
+                        items.append({"delete": {"_index": idx, "_id": doc_id,
+                                                 "result": "not_found", "status": 404}})
+                elif action == "update":
+                    src = lines[i]; i += 1
+                    res = self.update(idx, doc_id, src, routing=routing)
+                    items.append({"update": {**res, "status": 200}})
+                else:
+                    raise ApiError(400, "illegal_argument_exception",
+                                   f"unknown bulk action [{action}]")
+                touched.add(idx)
+            except ApiError as e:
+                errors = True
+                items.append({action: {"_index": idx, "_id": doc_id,
+                                       "status": e.status, "error": e.body()["error"]}})
+        if refresh:
+            for idx in touched:
+                try:
+                    self.node.get_index(self.node.metadata.write_index(idx)).refresh()
+                except IndexNotFoundError:
+                    pass
+        return {"took": 0, "errors": errors, "items": items}
+
+    # ---------------- search APIs ----------------
+
+    def search(self, index: str = "_all", body: Optional[dict] = None,
+               scroll: Optional[str] = None, **kw) -> dict:
+        body = dict(body or {})
+        body.update({k: v for k, v in kw.items() if v is not None})
+        pit = body.pop("pit", None)
+        if pit is not None:
+            return self._search_pit(pit["id"], body)
+        resp = self.node.search(index, body)
+        if scroll:
+            sid = uuid.uuid4().hex
+            names = self.node.metadata.resolve(index)
+            snapshot = {n: [list(s.segments) for s in self.node.indices[n].shards]
+                        for n in names}
+            self._scrolls[sid] = {"index": index, "body": body,
+                                  "offset": int(body.get("from", 0)) + int(body.get("size", 10)),
+                                  "snapshot": snapshot}
+            resp["_scroll_id"] = sid
+        return resp
+
+    def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> dict:
+        sctx = self._scrolls.get(scroll_id)
+        if sctx is None:
+            raise ApiError(404, "search_context_missing_exception",
+                           f"No search context found for id [{scroll_id}]")
+        body = dict(sctx["body"])
+        body["from"] = sctx["offset"]
+        searchers = []
+        for n, shard_segs in sctx["snapshot"].items():
+            svc = self.node.indices.get(n)
+            if svc is None:
+                continue
+            for sid, segs in enumerate(shard_segs):
+                s = ShardSearcher(svc.shards[sid], shard_id=sid,
+                                  similarity=svc.default_sim)
+                s._snapshot_segments = segs
+                searchers.append(s)
+        resp = _search_snapshot(searchers, body, sctx["index"])
+        sctx["offset"] += int(body.get("size", 10))
+        resp["_scroll_id"] = scroll_id
+        return resp
+
+    def clear_scroll(self, scroll_id=None, body: Optional[dict] = None) -> dict:
+        ids = []
+        if scroll_id:
+            ids = scroll_id if isinstance(scroll_id, list) else [scroll_id]
+        if body:
+            ids.extend(body.get("scroll_id", []))
+        n = 0
+        for sid in ids:
+            if self._scrolls.pop(sid, None) is not None:
+                n += 1
+        return {"succeeded": True, "num_freed": n}
+
+    def create_pit(self, index: str, keep_alive: str = "1m") -> dict:
+        """Point-in-time reader: snapshot of the immutable segment lists
+        (reference `action/search/CreatePitAction` — free with immutability)."""
+        pid = uuid.uuid4().hex
+        names = self.node.metadata.resolve(index)
+        snapshot = {n: [list(s.segments) for s in self.node.indices[n].shards]
+                    for n in names}
+        self._pits[pid] = {"index": index, "snapshot": snapshot,
+                           "creation_time": time.time()}
+        return {"pit_id": pid, "creation_time": int(time.time() * 1000)}
+
+    def delete_pit(self, body: dict) -> dict:
+        ids = body.get("pit_id", [])
+        ids = ids if isinstance(ids, list) else [ids]
+        deleted = [p for p in ids if self._pits.pop(p, None) is not None]
+        return {"pits": [{"pit_id": p, "successful": True} for p in deleted]}
+
+    def _search_pit(self, pit_id: str, body: dict) -> dict:
+        pctx = self._pits.get(pit_id)
+        if pctx is None:
+            raise ApiError(404, "search_context_missing_exception",
+                           f"Point in time [{pit_id}] not found")
+        searchers = []
+        for n, shard_segs in pctx["snapshot"].items():
+            svc = self.node.indices.get(n)
+            if svc is None:
+                continue
+            for sid, segs in enumerate(shard_segs):
+                s = ShardSearcher(svc.shards[sid], shard_id=sid,
+                                  similarity=svc.default_sim)
+                s._snapshot_segments = segs
+                searchers.append(s)
+        resp = _search_snapshot(searchers, body, pctx["index"])
+        resp["pit_id"] = pit_id
+        return resp
+
+    def msearch(self, body: List[dict], index: Optional[str] = None) -> dict:
+        responses = []
+        i = 0
+        while i < len(body):
+            header = body[i]; i += 1
+            search_body = body[i]; i += 1
+            idx = header.get("index", index or "_all")
+            try:
+                responses.append(self.search(idx, search_body))
+            except (ApiError, IndexNotFoundError) as e:
+                responses.append({"error": {"type": type(e).__name__, "reason": str(e)}})
+        return {"took": 0, "responses": responses}
+
+    def count(self, index: str = "_all", body: Optional[dict] = None) -> dict:
+        body = dict(body or {})
+        body["size"] = 0
+        body.pop("sort", None)
+        resp = self.node.search(index, body)
+        return {"count": resp["hits"]["total"]["value"],
+                "_shards": resp["_shards"]}
+
+    def explain(self, index: str, id: str, body: dict) -> dict:
+        svc = self.node.get_index(self.node.metadata.write_index(index))
+        eng = svc.route(id)
+        eng_refresh_needed = id in {d.doc_id for d in eng.buffer if d is not None}
+        if eng_refresh_needed:
+            eng.refresh()
+        loc = eng.version_map.get(id)
+        if loc is None or loc.in_buffer:
+            raise ApiError(404, "document_missing_exception", f"[{id}] missing")
+        seg, doc = loc.segment, loc.local_doc
+        ctx = C.ShardContext(svc.mappings, eng.segments, svc.default_sim)
+        lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx, scoring=True)
+        expl = explain_doc(lroot, seg, doc, ctx)
+        return {"_index": svc.meta.name, "_id": id,
+                "matched": expl["value"] > 0, "explanation": expl}
+
+    def field_caps(self, index: str = "_all", fields: str = "*") -> dict:
+        names = self.node.metadata.resolve(index)
+        pats = fields if isinstance(fields, list) else fields.split(",")
+        import fnmatch as fn
+        out: Dict[str, dict] = {}
+        for n in names:
+            svc = self.node.indices[n]
+            allf = dict(svc.mappings.fields)
+            for f, ft in list(allf.items()):
+                for sub, sft in ft.subfields.items():
+                    allf[f"{f}.{sub}"] = sft
+            for f, ft in allf.items():
+                if not any(fn.fnmatch(f, p) for p in pats):
+                    continue
+                caps = out.setdefault(f, {}).setdefault(ft.type, {
+                    "type": ft.type, "searchable": ft.index,
+                    "aggregatable": ft.doc_values or ft.type == "text"})
+        return {"indices": names, "fields": out}
+
+    def termvectors(self, index: str, id: str, fields: Optional[List[str]] = None) -> dict:
+        doc = self.get(index, id)
+        svc = self.node.get_index(self.node.metadata.write_index(index))
+        out_fields = {}
+        src = doc["_source"]
+        for fname, ft in list(svc.mappings.fields.items()):
+            if ft.type != "text" or (fields and fname not in fields):
+                continue
+            vals = _get_source_path(src, fname)
+            if vals is None:
+                continue
+            terms: Dict[str, dict] = {}
+            for v in (vals if isinstance(vals, list) else [vals]):
+                for tok in svc.mappings.index_analyzer(ft).analyze(str(v)):
+                    t = terms.setdefault(tok.text, {"term_freq": 0, "tokens": []})
+                    t["term_freq"] += 1
+                    t["tokens"].append({"position": tok.position,
+                                        "start_offset": tok.start_offset,
+                                        "end_offset": tok.end_offset})
+            if terms:
+                out_fields[fname] = {"terms": terms}
+        return {"_index": svc.meta.name, "_id": id, "found": True,
+                "term_vectors": out_fields}
+
+    # ---------------- reindex family ----------------
+
+    def reindex(self, body: dict, refresh: bool = False) -> dict:
+        src = body["source"]
+        dest = body["dest"]
+        query = {"query": src.get("query", {"match_all": {}}), "size": 10000}
+        resp = self.search(src["index"], query)
+        created = 0
+        pipeline = dest.get("pipeline")
+        for h in resp["hits"]["hits"]:
+            self.index(dest["index"], h["_source"], id=h["_id"], pipeline=pipeline)
+            created += 1
+        if refresh and created:
+            self.node.get_index(self.node.metadata.write_index(dest["index"])).refresh()
+        return {"took": resp["took"], "created": created, "updated": 0,
+                "total": created, "failures": []}
+
+    def delete_by_query(self, index: str, body: dict, refresh: bool = False) -> dict:
+        resp = self.search(index, {"query": body.get("query", {"match_all": {}}),
+                                   "size": 10000})
+        deleted = 0
+        for h in resp["hits"]["hits"]:
+            try:
+                self.delete(h["_index"] or index, h["_id"])
+                deleted += 1
+            except ApiError:
+                pass
+        if refresh:
+            for n in self.node.metadata.resolve(index):
+                self.node.indices[n].refresh()
+        return {"took": resp["took"], "deleted": deleted, "total": deleted,
+                "failures": []}
+
+    def update_by_query(self, index: str, body: Optional[dict] = None,
+                        refresh: bool = False) -> dict:
+        body = body or {}
+        resp = self.search(index, {"query": body.get("query", {"match_all": {}}),
+                                   "size": 10000})
+        updated = 0
+        for h in resp["hits"]["hits"]:
+            # re-index the doc (picks up mapping changes; scripts are r2)
+            self.index(h["_index"] or index, h["_source"], id=h["_id"])
+            updated += 1
+        if refresh:
+            for n in self.node.metadata.resolve(index):
+                self.node.indices[n].refresh()
+        return {"took": resp["took"], "updated": updated, "total": updated,
+                "failures": []}
+
+
+def _search_snapshot(searchers: List[ShardSearcher], body: dict, index: str) -> dict:
+    """Search against snapshotted segment lists (scroll/PIT)."""
+    body = dict(body)
+    body["_index_name"] = index
+    from ..search.executor import reduce_shard_results
+    results = [s.query_phase(body, segments=s._snapshot_segments, shard_ord=i)
+               for i, s in enumerate(searchers)]
+    reduced = reduce_shard_results(results, body)
+    by_shard: Dict[int, List] = {}
+    for c in reduced["selected"]:
+        by_shard.setdefault(c.shard, []).append(c)
+    hits_by_key: Dict[tuple, dict] = {}
+    for i, r in enumerate(results):
+        sel = by_shard.get(r.shard, [])
+        if sel:
+            for c, h in zip(sel, searchers[i].fetch_phase(r, sel, body)):
+                hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
+    hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)]
+            for c in reduced["selected"]
+            if (c.shard, c.seg_ord, c.local_doc) in hits_by_key]
+    resp = {"took": 0, "timed_out": False,
+            "_shards": {"total": len(searchers), "successful": len(searchers),
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": reduced["total"], "relation": "eq"},
+                     "max_score": reduced["max_score"], "hits": hits}}
+    if reduced["aggs"]:
+        resp["aggregations"] = reduced["aggs"]
+    return resp
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _get_source_path(src: dict, path: str):
+    node: Any = src
+    for p in path.split("."):
+        if isinstance(node, dict):
+            node = node.get(p)
+        else:
+            return None
+    return node
+
+
+# =====================================================================
+# namespaced sub-clients
+# =====================================================================
+
+class IndicesClient:
+    def __init__(self, client: RestClient):
+        self.c = client
+
+    def create(self, index: str, body: Optional[dict] = None) -> dict:
+        return self.c.node.create_index(index, body)
+
+    def delete(self, index: str) -> dict:
+        return self.c.node.delete_index(index)
+
+    def exists(self, index: str) -> bool:
+        try:
+            return bool(self.c.node.metadata.resolve(index, allow_no_indices=False))
+        except IndexNotFoundError:
+            return False
+
+    def get(self, index: str) -> dict:
+        out = {}
+        for n in self.c.node.metadata.resolve(index, allow_no_indices=False):
+            svc = self.c.node.indices[n]
+            aliases = {a: am.indices[n] for a, am in self.c.node.metadata.aliases.items()
+                       if n in am.indices}
+            out[n] = {"settings": {"index": {**svc.meta.settings.get("index", {}),
+                                             "number_of_shards": svc.meta.num_shards,
+                                             "uuid": n}},
+                      "mappings": svc.mappings.to_dict(),
+                      "aliases": aliases}
+        return out
+
+    def get_mapping(self, index: str = "_all") -> dict:
+        return {n: {"mappings": self.c.node.indices[n].mappings.to_dict()}
+                for n in self.c.node.metadata.resolve(index)}
+
+    def put_mapping(self, index: str, body: dict) -> dict:
+        for n in self.c.node.metadata.resolve(index, allow_no_indices=False):
+            self.c.node.indices[n].mappings.merge(body)
+            self.c.node._persist_meta(n)
+        return {"acknowledged": True}
+
+    def get_settings(self, index: str = "_all") -> dict:
+        return {n: {"settings": {"index": self.c.node.indices[n].meta.settings.get("index", {})}}
+                for n in self.c.node.metadata.resolve(index)}
+
+    def refresh(self, index: str = "_all") -> dict:
+        for n in self.c.node.metadata.resolve(index):
+            self.c.node.indices[n].refresh()
+        return {"_shards": {"successful": 1, "failed": 0}}
+
+    def flush(self, index: str = "_all") -> dict:
+        for n in self.c.node.metadata.resolve(index):
+            self.c.node.indices[n].flush()
+        return {"_shards": {"successful": 1, "failed": 0}}
+
+    def forcemerge(self, index: str = "_all", max_num_segments: int = 1) -> dict:
+        for n in self.c.node.metadata.resolve(index):
+            self.c.node.indices[n].force_merge(max_num_segments)
+        return {"_shards": {"successful": 1, "failed": 0}}
+
+    def stats(self, index: str = "_all") -> dict:
+        out = {n: self.c.node.indices[n].stats()
+               for n in self.c.node.metadata.resolve(index)}
+        total = {"docs": {"count": sum(v["docs"]["count"] for v in out.values())}}
+        return {"_all": {"primaries": total, "total": total},
+                "indices": {n: {"primaries": v, "total": v} for n, v in out.items()}}
+
+    def analyze(self, index: Optional[str] = None, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        text = body.get("text", "")
+        texts = text if isinstance(text, list) else [text]
+        if index is not None:
+            svc = self.c.node.get_index(self.c.node.metadata.write_index(index))
+            registry = svc.mappings.analysis
+            if "field" in body:
+                ft = svc.mappings.resolve_field(body["field"])
+                analyzer = svc.mappings.index_analyzer(ft) if ft else registry.get("standard")
+            else:
+                analyzer = registry.get(body.get("analyzer", "standard"))
+        else:
+            from ..analysis import AnalysisRegistry
+            analyzer = AnalysisRegistry().get(body.get("analyzer", "standard"))
+        tokens = []
+        for t in texts:
+            for tok in analyzer.analyze(t):
+                tokens.append({"token": tok.text, "position": tok.position,
+                               "start_offset": tok.start_offset,
+                               "end_offset": tok.end_offset, "type": "<ALPHANUM>"})
+        return {"tokens": tokens}
+
+    def get_alias(self, index: str = "_all", name: Optional[str] = None) -> dict:
+        out: Dict[str, dict] = {}
+        for a, am in self.c.node.metadata.aliases.items():
+            if name and a != name:
+                continue
+            for n, cfg in am.indices.items():
+                out.setdefault(n, {"aliases": {}})["aliases"][a] = cfg
+        return out
+
+    def update_aliases(self, body: dict) -> dict:
+        return self.c.node.update_aliases(body.get("actions", []))
+
+    def put_alias(self, index: str, name: str, body: Optional[dict] = None) -> dict:
+        return self.c.node.update_aliases(
+            [{"add": {"index": index, "alias": name, **(body or {})}}])
+
+    def put_index_template(self, name: str, body: dict) -> dict:
+        self.c.node.metadata.templates[name] = body
+        return {"acknowledged": True}
+
+    put_template = put_index_template
+
+    def delete_index_template(self, name: str) -> dict:
+        if self.c.node.metadata.templates.pop(name, None) is None:
+            raise ApiError(404, "resource_not_found_exception",
+                           f"index template [{name}] missing")
+        return {"acknowledged": True}
+
+    def exists_index_template(self, name: str) -> bool:
+        return name in self.c.node.metadata.templates
+
+
+class IngestClient:
+    def __init__(self, client: RestClient):
+        self.c = client
+
+    def put_pipeline(self, id: str, body: dict) -> dict:
+        self.c.node.ingest.put_pipeline(id, body)
+        return {"acknowledged": True}
+
+    def get_pipeline(self, id: Optional[str] = None) -> dict:
+        svc = self.c.node.ingest
+        if id:
+            p = svc.get_pipeline(id)
+            if p is None:
+                raise ApiError(404, "resource_not_found_exception",
+                               f"pipeline [{id}] not found")
+            return {id: {"description": p.description}}
+        return {pid: {"description": p.description} for pid, p in svc.pipelines.items()}
+
+    def delete_pipeline(self, id: str) -> dict:
+        self.c.node.ingest.delete_pipeline(id)
+        return {"acknowledged": True}
+
+    def simulate(self, body: dict) -> dict:
+        return {"docs": self.c.node.ingest.simulate(body.get("pipeline", body),
+                                                    body.get("docs", []))}
+
+
+class SnapshotClient:
+    def __init__(self, client: RestClient):
+        self.c = client
+        self.repos: Dict[str, dict] = {}
+
+    def create_repository(self, repository: str, body: dict) -> dict:
+        self.repos[repository] = body.get("settings", body)
+        return {"acknowledged": True}
+
+    def create(self, repository: str, snapshot: str, body: Optional[dict] = None,
+               wait_for_completion: bool = True) -> dict:
+        repo = self.repos.get(repository)
+        if repo is None:
+            raise ApiError(404, "repository_missing_exception",
+                           f"[{repository}] missing")
+        return self.c.node.snapshot(repo["location"], snapshot,
+                                    (body or {}).get("indices", "_all"))
+
+    def restore(self, repository: str, snapshot: str, body: Optional[dict] = None) -> dict:
+        repo = self.repos.get(repository)
+        if repo is None:
+            raise ApiError(404, "repository_missing_exception",
+                           f"[{repository}] missing")
+        body = body or {}
+        return self.c.node.restore(repo["location"], snapshot,
+                                   body.get("rename_pattern"),
+                                   body.get("rename_replacement"))
+
+    def get(self, repository: str, snapshot: str = "_all") -> dict:
+        import os
+        repo = self.repos.get(repository)
+        snaps = []
+        if repo and os.path.isdir(repo["location"]):
+            for name in sorted(os.listdir(repo["location"])):
+                if snapshot in ("_all", "*") or name == snapshot:
+                    snaps.append({"snapshot": name, "state": "SUCCESS"})
+        return {"snapshots": snaps}
+
+
+class ClusterClient:
+    def __init__(self, client: RestClient):
+        self.c = client
+
+    def health(self, index: Optional[str] = None) -> dict:
+        node = self.c.node
+        shard_count = sum(s.meta.num_shards for s in node.indices.values())
+        return {"cluster_name": node.metadata.cluster_name, "status": "green",
+                "number_of_nodes": 1, "number_of_data_nodes": 1,
+                "active_primary_shards": shard_count, "active_shards": shard_count,
+                "relocating_shards": 0, "initializing_shards": 0,
+                "unassigned_shards": 0, "active_shards_percent_as_number": 100.0}
+
+    def state(self) -> dict:
+        node = self.c.node
+        return {"cluster_name": node.metadata.cluster_name,
+                "version": node.metadata.version,
+                "metadata": {"indices": {n: {"state": m.state,
+                                             "settings": m.settings}
+                                         for n, m in node.metadata.indices.items()}}}
+
+    def stats(self) -> dict:
+        return self.c.node.stats()
+
+
+class CatClient:
+    def __init__(self, client: RestClient):
+        self.c = client
+
+    def indices(self, format: str = "json") -> List[dict]:
+        out = []
+        for n, svc in sorted(self.c.node.indices.items()):
+            st = svc.stats()
+            out.append({"health": "green", "status": "open", "index": n,
+                        "pri": str(svc.meta.num_shards), "rep": "0",
+                        "docs.count": str(st["docs"]["count"]),
+                        "store.size": str(st["store"]["size_in_bytes"])})
+        return out
+
+    def count(self, index: str = "_all") -> List[dict]:
+        total = sum(self.c.node.indices[n].num_docs
+                    for n in self.c.node.metadata.resolve(index))
+        return [{"epoch": str(int(time.time())), "count": str(total)}]

@@ -120,8 +120,7 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
         hist = parts[0]["hist"].copy()
         for p in parts[1:]:
             hist += p["hist"]
-        return {"hist": hist, "lo": parts[0]["lo"], "hi": parts[0]["hi"],
-                "percents": parts[0]["percents"]}
+        return {"hist": hist, "percents": parts[0]["percents"]}
     if kind == "top_hits":
         rows = [r for p in parts for r in p["hits"]]
         rows.sort(key=lambda r: -r["_score"] if r["_score"] is not None else 0)
@@ -288,22 +287,19 @@ def _hll_estimate(regs: np.ndarray) -> float:
 
 
 def _hist_percentiles(merged: dict) -> Dict[str, float]:
+    from ..ops.aggs import ddsketch_value
+
     hist = merged["hist"].astype(np.float64)
-    lo, hi = merged["lo"], merged["hi"]
     total = hist.sum()
     out: Dict[str, float] = {}
     if total == 0:
         return {f"{p:.1f}": None for p in merged["percents"]}
     cum = np.cumsum(hist)
     nb = len(hist)
-    width = (hi - lo) / nb if hi > lo else 0.0
     for p in merged["percents"]:
-        target = p / 100.0 * total
+        target = max(p / 100.0 * total, 1e-9)
         b = int(np.searchsorted(cum, target, side="left"))
-        b = min(b, nb - 1)
-        prev = cum[b - 1] if b > 0 else 0.0
-        frac = 0.0 if hist[b] == 0 else (target - prev) / hist[b]
-        out[f"{p:.1f}"] = lo + (b + frac) * width if width > 0 else lo
+        out[f"{p:.1f}"] = ddsketch_value(min(b, nb - 1))
     return out
 
 

@@ -1390,16 +1390,7 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         field = _resolve_agg_field(node, ctx)
         col = seg.numeric_cols.get(field)
         percents = tuple(body.get("percents", (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)))
-        # sketch bounds must be index-wide so partials merge
-        lo, hi = np.inf, -np.inf
-        for s in ctx.segments:
-            c = s.numeric_cols.get(field)
-            if c is not None and c.present.any():
-                cmn, cmx = c.min_max
-                lo, hi = min(lo, cmn), max(hi, cmx)
-        if not np.isfinite(lo):
-            lo, hi = 0.0, 1.0
-        return ("pctl", prefix, field, col is not None, float(lo), float(hi), percents)
+        return ("pctl", prefix, field, col is not None, percents)
 
     if kind == "top_hits":
         return ("top_hits", prefix, int(body.get("size", 3)))
@@ -1555,13 +1546,11 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
             col["f32"], col["present"], match, HLL_LOG2M)}
 
     if kind == "pctl":
-        _, prefix, field, col_exists, lo, hi, percents = spec
+        _, prefix, field, col_exists, percents = spec
         if not col_exists:
-            return {"hist": jnp.zeros(PCTL_BINS, jnp.float32)}
+            return {"hist": jnp.zeros(agg_ops.DD_NBINS, jnp.float32)}
         col = seg_arrays["numeric"][field]
-        width = max((hi - lo) / PCTL_BINS, 1e-30)
-        return {"hist": agg_ops.histogram_counts(col["f32"], col["present"], match,
-                                                 width, lo, 0, PCTL_BINS)}
+        return {"hist": agg_ops.ddsketch_hist(col["f32"], col["present"], match)}
 
     if kind == "top_hits":
         _, prefix, size = spec

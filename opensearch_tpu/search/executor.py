@@ -82,9 +82,13 @@ class ShardSearcher:
 
     # ---------------- QUERY phase ----------------
 
-    def query_phase(self, body: dict, segments: Optional[List[Segment]] = None
-                    ) -> ShardQueryResult:
+    def query_phase(self, body: dict, segments: Optional[List[Segment]] = None,
+                    shard_ord: Optional[int] = None) -> ShardQueryResult:
+        """`shard_ord` overrides the candidate shard tag so a coordinator can
+        search shards of several indices in one pass without id collisions."""
         t0 = time.monotonic()
+        if shard_ord is None:
+            shard_ord = self.shard_id
         segments = segments if segments is not None else list(self.engine.segments)
         ctx = C.ShardContext(self.engine.mappings, segments,
                              self.similarity, self.field_similarities)
@@ -106,7 +110,7 @@ class ShardSearcher:
         min_score = body.get("min_score")
         search_after = body.get("search_after")
 
-        result = ShardQueryResult(shard=self.shard_id, segments=segments)
+        result = ShardQueryResult(shard=shard_ord, segments=segments)
         phrase_checks = _collect_phrases(lroot)
 
         for seg_ord, seg in enumerate(segments):
@@ -167,7 +171,7 @@ class ShardSearcher:
                     result.total -= 1
                     continue
                 sort_vals, raw_vals = _host_sort_values(sort_specs, seg, d, sc)
-                cand = Candidate(self.shard_id, seg_ord, d, sc, sort_vals, raw_vals)
+                cand = Candidate(shard_ord, seg_ord, d, sc, sort_vals, raw_vals)
                 result.candidates.append(cand)
                 names = [nm for nm, arr in named_np.items() if arr[j]]
                 if names:
@@ -316,18 +320,17 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     t0 = time.monotonic()
     body = dict(body)
     body["_index_name"] = index_name
-    results = [s.query_phase(body) for s in searchers]
+    results = [s.query_phase(body, shard_ord=i) for i, s in enumerate(searchers)]
     reduced = reduce_shard_results(results, body)
     by_shard: Dict[int, List[Candidate]] = {}
     for c in reduced["selected"]:
         by_shard.setdefault(c.shard, []).append(c)
     hits_by_key: Dict[Tuple, dict] = {}
-    for r in results:
+    for i, r in enumerate(results):
         sel = by_shard.get(r.shard, [])
         if not sel:
             continue
-        searcher = next(s for s in searchers if s.shard_id == r.shard)
-        fetched = searcher.fetch_phase(r, sel, body)
+        fetched = searchers[i].fetch_phase(r, sel, body)
         for c, h in zip(sel, fetched):
             hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
     hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)] for c in reduced["selected"]
@@ -718,9 +721,8 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
         return {"registers": np.asarray(device_out["registers"])}
 
     if kind == "pctl":
-        _, prefix, f, col_exists, lo, hi, percents = aspec
-        return {"hist": np.asarray(device_out["hist"]), "lo": lo, "hi": hi,
-                "percents": list(percents)}
+        _, prefix, f, col_exists, percents = aspec
+        return {"hist": np.asarray(device_out["hist"]), "percents": list(percents)}
 
     raise ValueError(f"cannot build partial for agg spec [{kind}]")
 

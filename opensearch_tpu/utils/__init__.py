@@ -1,0 +1,3 @@
+from .breaker import BreakerService, CircuitBreaker, CircuitBreakingException
+
+__all__ = ["BreakerService", "CircuitBreaker", "CircuitBreakingException"]
