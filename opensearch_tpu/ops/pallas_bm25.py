@@ -1,0 +1,326 @@
+"""Fused BM25 top-k Pallas kernel — the flagship device kernel.
+
+Replaces Lucene's per-doc BulkScorer loop (reference
+`search/query/QueryPhase.java` + BM25Similarity) with one fused TPU program
+per query:
+
+    HBM CSR postings ──async DMA──▶ VMEM [T, L] (docs, impacts)
+      ─▶ mask + weight (VPU) ─▶ bitonic MERGE of T doc-sorted runs
+      ─▶ shift-add dedup (runs ≤ T) ─▶ iterative top-k extraction
+      ─▶ [K] (scores, doc_ids) per query
+
+Why not XLA: on TPU, XLA `gather`, `scatter-add` and `sort` on this access
+pattern each cost ~100ms for a 512-query batch (measured on v5e) — they
+serialize or relayout. Everything here is DMA + dense VPU ops:
+
+- The CSR gather is contiguous per term -> plain async DMA (posting rows are
+  128-aligned at build time so DMAs are lane-aligned).
+- The per-term posting lists are ALREADY doc-sorted, so we need a merge
+  network, not a sort: log2(n) compare-exchange stages, each a pair of
+  `pltpu.roll`s + selects (strides >= 128 roll sublanes, < 128 roll lanes).
+- Duplicate docs across terms form runs of length <= T in the merged order,
+  so per-doc score sums are T-1 shifted adds — no segment scatter.
+- top-k for k<=K_MAX is k rounds of (max-reduce, arg-select, mask), each a
+  full-array VPU reduction.
+
+All shapes are static per (T, L, K) bucket; the host picks L = pow2 of the
+longest posting list among the query's terms (from host row pointers — no
+device sync) so one compiled kernel serves all queries in that bucket.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+INT_SENTINEL = np.int32(2**31 - 1)
+LANES = 128
+# 1D HBM memrefs are tiled at 1024 elements (i32/f32): DMA slice starts and
+# sizes must be 1024-aligned, so CSR rows are packed to this alignment
+HBM_ALIGN = 1024
+NEG_INF = float("-inf")
+
+
+# ---------------------------------------------------------------------
+# flattened [R, 128] helpers: rolls that emulate ops on the flat [R*128] order
+# ---------------------------------------------------------------------
+
+def _ids(shape):
+    rows = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    lanes = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return rows, lanes
+
+
+def _roll(x, shift: int, axis: int):
+    """pltpu.roll with negative shifts normalized (it requires shift >= 0)."""
+    n = x.shape[axis]
+    return pltpu.roll(x, shift % n, axis)
+
+
+def _cx(keys, payload, s: int):
+    """One ascending compare-exchange stage at element stride `s` (partner =
+    index XOR s) over the flattened [R,128] array. Moves `payload` with keys."""
+    shape = keys.shape
+    rows, lanes = _ids(shape)
+    if s >= LANES:
+        r = s // LANES
+        kf = _roll(keys, -r, 0)
+        kb = _roll(keys, r, 0)
+        pf = _roll(payload, -r, 0)
+        pb = _roll(payload, r, 0)
+        first = ((rows // r) % 2) == 0
+    else:
+        kf = _roll(keys, -s, 1)
+        kb = _roll(keys, s, 1)
+        pf = _roll(payload, -s, 1)
+        pb = _roll(payload, s, 1)
+        first = ((lanes // s) % 2) == 0
+    nk = jnp.where(first, jnp.minimum(keys, kf), jnp.maximum(keys, kb))
+    # NB: selecting between bool arrays with jnp.where trips a Mosaic i8->i1
+    # truncation bug; keep predicates in pure i1 logic
+    take_self = (first & (keys <= kf)) | ((~first) & (keys >= kb))
+    npay = jnp.where(take_self, payload, jnp.where(first, pf, pb))
+    return nk, npay
+
+
+def _swap(x, s: int):
+    """Unconditional exchange at element stride s (index XOR s)."""
+    shape = x.shape
+    rows, lanes = _ids(shape)
+    if s >= LANES:
+        r = s // LANES
+        xf = _roll(x, -r, 0)
+        xb = _roll(x, r, 0)
+        first = ((rows // r) % 2) == 0
+    else:
+        xf = _roll(x, -s, 1)
+        xb = _roll(x, s, 1)
+        first = ((lanes // s) % 2) == 0
+    return jnp.where(first, xf, xb)
+
+
+def _block_flip(x, block: int):
+    """Reverse every `block`-length run of the flattened order (index XOR
+    (block-1)) by composing unconditional stride swaps over all bits."""
+    s = 1
+    while s < block:
+        x = _swap(x, s)
+        s *= 2
+    return x
+
+
+def _merge_pairs(keys, payload, half: int):
+    """Merge adjacent sorted runs of length `half` into sorted runs of
+    2*half (Batcher bitonic merge, ascending)."""
+    kf = _block_flip(keys, 2 * half)
+    pf = _block_flip(payload, 2 * half)
+    rows, lanes = _ids(keys.shape)
+    idx = rows * LANES + lanes
+    first = (idx % (2 * half)) < half
+    take_self = (first & (keys <= kf)) | ((~first) & (keys >= kf))
+    nk = jnp.where(take_self, keys, kf)
+    npay = jnp.where(take_self, payload, pf)
+    s = half // 2
+    while s >= 1:
+        nk, npay = _cx(nk, npay, s)
+        s //= 2
+    return nk, npay
+
+
+def _flat_shift_down(x, fill):
+    """y[i] = x[i-1] over the flattened order (y[0] = fill)."""
+    rows, lanes = _ids(x.shape)
+    a = _roll(x, 1, 1)                      # lane l <- l-1 (lane0 wraps)
+    b = _roll(_roll(x, 1, 0), 1, 1)         # row r-1, lane 127 at lane 0
+    y = jnp.where(lanes == 0, b, a)
+    return jnp.where((rows == 0) & (lanes == 0), fill, y)
+
+
+def _flat_shift_up(x, fill):
+    """y[i] = x[i+1] (y[last] = fill)."""
+    rows, lanes = _ids(x.shape)
+    nrows = x.shape[0]
+    a = _roll(x, -1, 1)
+    b = _roll(_roll(x, -1, 0), -1, 1)
+    y = jnp.where(lanes == LANES - 1, b, a)
+    return jnp.where((rows == nrows - 1) & (lanes == LANES - 1), fill, y)
+
+
+# ---------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------
+
+def _bm25_kernel(T: int, L: int, K: int,
+                 starts_ref, lens_ref, weights_ref, msm_ref,
+                 docs_hbm, norms_hbm, out_scores, out_docs,
+                 docs_v, norms_v, sems):
+    q = pl.program_id(0)
+
+    # ---- DMA all term posting ranges HBM -> VMEM ----
+    # HBM arrays are [P/128, 128]; starts are element offsets aligned to
+    # HBM_ALIGN so row starts/extents satisfy the (8, 128) tiling
+    rows_per_term = L // LANES
+    dmas = []
+    for t in range(T):
+        row_start = pl.multiple_of(starts_ref[t, q] // LANES, HBM_ALIGN // LANES)
+        d1 = pltpu.make_async_copy(docs_hbm.at[pl.ds(row_start, rows_per_term)],
+                                   docs_v.at[t], sems.at[2 * t])
+        d2 = pltpu.make_async_copy(norms_hbm.at[pl.ds(row_start, rows_per_term)],
+                                   norms_v.at[t], sems.at[2 * t + 1])
+        d1.start()
+        d2.start()
+        dmas.extend((d1, d2))
+    for d in dmas:
+        d.wait()
+
+    # ---- mask tails, apply per-term weights ----
+    R = (T * L) // LANES
+    docs2 = docs_v[:].reshape(R, LANES)
+    norms2 = norms_v[:].reshape(R, LANES)
+    rows, lanes = _ids((R, LANES))
+    term_of_row = rows // rows_per_term
+    pos_in_term = (rows % rows_per_term) * LANES + lanes
+
+    # per-row scalars from SMEM (loop over T is static & tiny)
+    w_row = jnp.zeros((R, LANES), jnp.float32)
+    len_row = jnp.zeros((R, LANES), jnp.int32)
+    for t in range(T):
+        sel = term_of_row == t
+        w_row = jnp.where(sel, weights_ref[t, q], w_row)
+        len_row = jnp.where(sel, lens_ref[t, q], len_row)
+    valid = pos_in_term < len_row
+    keys = jnp.where(valid, docs2, INT_SENTINEL)
+    contrib = jnp.where(valid, w_row * norms2, 0.0)
+
+    # ---- merge the T doc-sorted runs (each of length L) ----
+    half = L
+    while half < T * L:
+        keys, contrib = _merge_pairs(keys, contrib, half)
+        half *= 2
+
+    # ---- dedup: runs of equal doc have length <= T ----
+    score = contrib
+    kk = keys
+    cc = contrib
+    count = jnp.ones((R, LANES), jnp.float32)
+    for _ in range(T - 1):
+        kk = _flat_shift_down(kk, INT_SENTINEL)
+        cc = _flat_shift_down(cc, 0.0)
+        eq = (kk == keys) & (keys < INT_SENTINEL)
+        score = score + jnp.where(eq, cc, 0.0)
+        count = count + jnp.where(eq, 1.0, 0.0)
+    knext = _flat_shift_up(keys, INT_SENTINEL)
+    is_last = (knext != keys) & (keys < INT_SENTINEL)
+    msm = msm_ref[0, q]
+    final = jnp.where(is_last & (count >= msm), score, NEG_INF)
+
+    # ---- iterative top-K extraction ----
+    acc_s = jnp.full((1, LANES), NEG_INF, jnp.float32)
+    acc_d = jnp.full((1, LANES), -1, jnp.int32)
+    out_lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    for j in range(K):
+        best = jnp.max(final)
+        sel = final == best
+        bdoc = jnp.min(jnp.where(sel, keys, INT_SENTINEL))
+        # scalar selects first: scalar-bool & vector-bool hits a Mosaic
+        # truncation bug, so fold `got` into scalars
+        got = best > NEG_INF
+        best_or = jnp.where(got, best, NEG_INF)
+        bdoc_or = jnp.where(got, bdoc, -1)
+        hit = out_lane == j
+        acc_s = jnp.where(hit, best_or, acc_s)
+        acc_d = jnp.where(hit, bdoc_or, acc_d)
+        final = jnp.where(sel & (keys == bdoc), NEG_INF, final)
+    out_scores[q, :] = acc_s[0]
+    out_docs[q, :] = acc_d[0]
+
+
+@functools.partial(jax.jit, static_argnames=("T", "L", "K"))
+def fused_bm25_topk(docs_hbm: jnp.ndarray, norms_hbm: jnp.ndarray,
+                    starts: jnp.ndarray, lens: jnp.ndarray,
+                    weights: jnp.ndarray, msm: jnp.ndarray,
+                    T: int, L: int, K: int):
+    """Batched fused BM25 top-k.
+
+    docs_hbm  i32[P] — doc ids, CSR-flat, rows 128-aligned, >= L tail margin
+    norms_hbm f32[P] — per-posting eager impacts tf/(tf+K_d) (BM25S-style)
+    starts    i32[QB, T] — 128-aligned row starts (absent term: any aligned
+              offset with lens=0)
+    lens      i32[QB, T]
+    weights   f32[QB, T] — query-time idf * boost (collection-wide stats)
+    msm       f32[QB, 1] — minimum matching terms (1=OR, T=AND)
+    Returns (scores f32[QB, 128], doc_ids i32[QB, 128]) — first K valid.
+    """
+    QB = starts.shape[0]
+    # SMEM operands are lane-padded to 128 in their last dim: keep QB (large)
+    # last and T (tiny) first so prefetch stays a few KB
+    starts = starts.T
+    lens = lens.T
+    weights = weights.T
+    msm = msm.T
+    assert docs_hbm.shape[0] % LANES == 0
+    docs_hbm = docs_hbm.reshape(-1, LANES)
+    norms_hbm = norms_hbm.reshape(-1, LANES)
+    kernel = functools.partial(_bm25_kernel, T, L, K)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(QB,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            # whole-array blocks: each program writes its own row q (TPU grid
+            # steps are sequential; (1, 128) blocks violate the (8, 128)
+            # min-tile rule)
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
+            pltpu.VMEM((T, L // LANES, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2 * T,)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((QB, LANES), jnp.float32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+    ]
+    scores, doc_ids = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(starts, lens, weights, msm, docs_hbm, norms_hbm)
+    return scores, doc_ids
+
+
+def align_csr_rows(starts: np.ndarray, doc_ids: np.ndarray, tfs: np.ndarray,
+                   margin: int, alignment: int = HBM_ALIGN):
+    """Re-pack CSR postings so every row begins at a 128-aligned offset
+    (sentinel-padded gaps), with `margin` sentinel slack at the end so a
+    fixed-size DMA window never runs off the buffer. Returns
+    (new_starts i64[nrows+1 -> aligned row starts], docs, tfs)."""
+    nrows = len(starts) - 1
+    lens = np.diff(starts)
+    aligned_lens = ((lens + alignment - 1) // alignment) * alignment
+    new_starts = np.zeros(nrows + 1, dtype=np.int64)
+    np.cumsum(aligned_lens, out=new_starts[1:])
+    total = int(new_starts[-1]) + margin
+    total = ((total + LANES - 1) // LANES) * LANES
+    new_docs = np.full(total, INT_SENTINEL, dtype=np.int32)
+    new_tfs = np.zeros(total, dtype=np.float32)
+    # vectorized row scatter
+    src_idx = np.arange(len(doc_ids), dtype=np.int64)
+    row_of = np.searchsorted(starts, src_idx, side="right") - 1
+    offset_in_row = src_idx - starts[row_of]
+    dst = new_starts[row_of] + offset_in_row
+    new_docs[dst] = doc_ids
+    new_tfs[dst] = tfs
+    return new_starts, new_docs, new_tfs

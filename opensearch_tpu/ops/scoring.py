@@ -182,6 +182,64 @@ def geo_distance_mask(geo: dict, lat: jnp.ndarray, lon: jnp.ndarray,
     return (d <= radius_m) & geo["present"]
 
 
+# ---------------- scatter-free sort-merge scoring ----------------
+
+def sortmerge_topk(docs: jnp.ndarray, contribs: jnp.ndarray, k: int,
+                   msm=None):
+    """Top-k doc scores from flat (doc, contribution) postings WITHOUT a
+    dense scatter (XLA scatter serializes on TPU — the dense path costs ~ms;
+    this path is sort + cumsum + gathers, all MXU/VPU-friendly).
+
+    Sort postings by doc id, then per-doc totals fall out of a cumulative-sum
+    difference between run boundaries; the run start index comes from a
+    prefix-max scan, so the whole reduction is dense ops. Returns
+    (scores f32[k], doc_ids i32[k]) with -inf/-1 padding. `msm` (traced
+    scalar) keeps only docs matched by >= msm distinct terms — each term
+    contributes at most one posting per doc, so run length == match count.
+
+    This is the TAAT->sort-merge reformulation of Lucene's BulkScorer loop:
+    work is O(B log B) in the number of query postings B, independent of
+    corpus size (the dense path is O(ndocs) + serialized scatter).
+    """
+    B = docs.shape[0]
+    order = jnp.argsort(docs)
+    d = docs[order]
+    c = contribs[order]
+    idx = jnp.arange(B, dtype=jnp.int32)
+    is_first = jnp.concatenate([jnp.array([True]), d[1:] != d[:-1]])
+    is_last = jnp.concatenate([d[:-1] != d[1:], jnp.array([True])])
+    csum = jnp.cumsum(c)
+    # index of the start of each position's run, via prefix max
+    run_start = jax.lax.associative_scan(jnp.maximum,
+                                         jnp.where(is_first, idx, -1))
+    pre = jnp.where(run_start > 0, csum[jnp.maximum(run_start - 1, 0)], 0.0)
+    run_total = csum - pre
+    run_len = (idx - run_start + 1).astype(jnp.float32)
+    valid = is_last & (d < jnp.int32(2**31 - 1))
+    if msm is not None:
+        valid = valid & (run_len >= msm)
+    masked = jnp.where(valid, run_total, NEG_INF)
+    k = min(k, B)
+    vals, pos = jax.lax.top_k(masked, k)
+    out_docs = jnp.where(vals > NEG_INF, d[pos], -1)
+    return vals, out_docs
+
+
+def count_matches_sortmerge(docs: jnp.ndarray, msm=None) -> jnp.ndarray:
+    """Total distinct matching docs from flat postings, scatter-free."""
+    d = jnp.sort(docs)
+    is_last = jnp.concatenate([d[:-1] != d[1:], jnp.array([True])])
+    valid = is_last & (d < jnp.int32(2**31 - 1))
+    if msm is not None:
+        idx = jnp.arange(d.shape[0], dtype=jnp.int32)
+        is_first = jnp.concatenate([jnp.array([True]), d[1:] != d[:-1]])
+        run_start = jax.lax.associative_scan(jnp.maximum,
+                                             jnp.where(is_first, idx, -1))
+        run_len = (idx - run_start + 1).astype(jnp.float32)
+        valid = valid & (run_len >= msm)
+    return jnp.sum(valid.astype(jnp.int32))
+
+
 # ---------------- top-k ----------------
 
 def topk_docs(scores: jnp.ndarray, matched: jnp.ndarray, live: jnp.ndarray, k: int):
