@@ -43,7 +43,8 @@ class IndexService:
             eng = Engine(self.mappings, path=path)
             self.shards.append(eng)
             self.searchers.append(ShardSearcher(eng, shard_id=sid,
-                                                similarity=self.default_sim))
+                                                similarity=self.default_sim,
+                                                index_key=meta.name))
         self.generation = 0  # bumped on refresh/writes: request-cache key part
 
     def route(self, doc_id: str, routing: Optional[str] = None) -> Engine:

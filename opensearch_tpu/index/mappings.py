@@ -325,6 +325,9 @@ class Mappings:
             self._index_value(ft, value, parsed)
 
     def _index_value(self, ft: FieldType, value: Any, parsed: ParsedDocument) -> None:
+        if (ft.type in GEO_TYPES and isinstance(value, list) and value
+                and isinstance(value[0], numbers.Number)):
+            value = [value]  # GeoJSON [lon, lat] is one point, not two values
         values = value if isinstance(value, list) else [value]
         for v in values:
             if v is None:

@@ -204,7 +204,7 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         gdocs = jnp.take_along_axis(flat_gids, gpos, axis=1)
         return gdocs, gvals, totals
 
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
@@ -213,7 +213,7 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
                    in_specs=(tree_spec, P("shard", "replica"), P("replica"),
                              P("replica")),
                    out_specs=(P("replica"), P("replica"), P("replica")),
-                   check_rep=False)
+                   check_vma=False)
     return jax.jit(fn)
 
 
@@ -245,13 +245,13 @@ def build_term_sharded_score(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         vals, idx = jax.lax.top_k(masked, min(k, ndocs_pad))
         return vals, idx
 
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(P("shard"), P("shard"), P("shard"),
                              P(), P(), P(), P(), P(), P(), P(), P()),
                    out_specs=(P(), P()),
-                   check_rep=False)
+                   check_vma=False)
     return jax.jit(fn)
 
 

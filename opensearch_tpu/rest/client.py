@@ -206,9 +206,13 @@ class RestClient:
         body = dict(body or {})
         body.update({k: v for k, v in kw.items() if v is not None})
         pit = body.pop("pit", None)
-        if pit is not None:
-            return self._search_pit(pit["id"], body)
-        resp = self.node.search(index, body)
+        try:
+            if pit is not None:
+                return self._search_pit(pit["id"], body)
+            resp = self.node.search(index, body)
+        except dsl.QueryParseError as e:
+            # malformed DSL is a client error, not an engine crash
+            raise ApiError(400, "parsing_exception", str(e))
         if scroll:
             sid = uuid.uuid4().hex
             names = self.node.metadata.resolve(index)
@@ -220,6 +224,20 @@ class RestClient:
             resp["_scroll_id"] = sid
         return resp
 
+    def _snapshot_searchers(self, snapshot: Dict[str, list]) -> List[ShardSearcher]:
+        """Searchers bound to a scroll/PIT segment snapshot."""
+        searchers = []
+        for n, shard_segs in snapshot.items():
+            svc = self.node.indices.get(n)
+            if svc is None:
+                continue
+            for sid, segs in enumerate(shard_segs):
+                s = ShardSearcher(svc.shards[sid], shard_id=sid,
+                                  similarity=svc.default_sim, index_key=n)
+                s._snapshot_segments = segs
+                searchers.append(s)
+        return searchers
+
     def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> dict:
         sctx = self._scrolls.get(scroll_id)
         if sctx is None:
@@ -227,16 +245,7 @@ class RestClient:
                            f"No search context found for id [{scroll_id}]")
         body = dict(sctx["body"])
         body["from"] = sctx["offset"]
-        searchers = []
-        for n, shard_segs in sctx["snapshot"].items():
-            svc = self.node.indices.get(n)
-            if svc is None:
-                continue
-            for sid, segs in enumerate(shard_segs):
-                s = ShardSearcher(svc.shards[sid], shard_id=sid,
-                                  similarity=svc.default_sim)
-                s._snapshot_segments = segs
-                searchers.append(s)
+        searchers = self._snapshot_searchers(sctx["snapshot"])
         resp = _search_snapshot(searchers, body, sctx["index"])
         sctx["offset"] += int(body.get("size", 10))
         resp["_scroll_id"] = scroll_id
@@ -276,16 +285,7 @@ class RestClient:
         if pctx is None:
             raise ApiError(404, "search_context_missing_exception",
                            f"Point in time [{pit_id}] not found")
-        searchers = []
-        for n, shard_segs in pctx["snapshot"].items():
-            svc = self.node.indices.get(n)
-            if svc is None:
-                continue
-            for sid, segs in enumerate(shard_segs):
-                s = ShardSearcher(svc.shards[sid], shard_id=sid,
-                                  similarity=svc.default_sim)
-                s._snapshot_segments = segs
-                searchers.append(s)
+        searchers = self._snapshot_searchers(pctx["snapshot"])
         resp = _search_snapshot(searchers, body, pctx["index"])
         resp["pit_id"] = pit_id
         return resp
@@ -424,8 +424,10 @@ def _search_snapshot(searchers: List[ShardSearcher], body: dict, index: str) -> 
     """Search against snapshotted segment lists (scroll/PIT)."""
     body = dict(body)
     body["_index_name"] = index
-    from ..search.executor import reduce_shard_results
-    results = [s.query_phase(body, segments=s._snapshot_segments, shard_ord=i)
+    from ..search.executor import _global_stats_contexts, reduce_shard_results
+    stats = _global_stats_contexts(searchers)
+    results = [s.query_phase(body, segments=s._snapshot_segments, shard_ord=i,
+                             stats_ctx=stats[i])
                for i, s in enumerate(searchers)]
     reduced = reduce_shard_results(results, body)
     by_shard: Dict[int, List] = {}

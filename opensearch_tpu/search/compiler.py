@@ -550,18 +550,24 @@ def _regexp_expander(field: str, pattern: str):
 
 
 def _edit_distance_le(a: str, b: str, k: int) -> bool:
+    """Optimal-string-alignment distance <= k (transpositions count 1, like
+    Lucene FuzzyQuery's default transpositions=true)."""
     if abs(len(a) - len(b)) > k:
         return False
+    prev2: Optional[list] = None
     prev = list(range(len(b) + 1))
     for i, ca in enumerate(a, 1):
         cur = [i] + [0] * len(b)
         lo = len(b) + 1
         for j, cb in enumerate(b, 1):
             cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (ca != cb))
+            if (prev2 is not None and i > 1 and j > 1
+                    and ca == b[j - 2] and a[i - 2] == cb):
+                cur[j] = min(cur[j], prev2[j - 2] + 1)
             lo = min(lo, cur[j])
         if lo > k:
             return False
-        prev = cur
+        prev2, prev = prev, cur
     return prev[-1] <= k
 
 

@@ -70,11 +70,15 @@ class ShardSearcher:
     """Executes searches over one shard's engine (one set of segments)."""
 
     def __init__(self, engine: Engine, shard_id: int = 0,
-                 similarity=None, field_similarities=None):
+                 similarity=None, field_similarities=None,
+                 index_key: Optional[str] = None):
         self.engine = engine
         self.shard_id = shard_id
         self.similarity = similarity
         self.field_similarities = field_similarities
+        # shards sharing an index_key share collection statistics (DFS);
+        # standalone searchers all fall into one default group
+        self.index_key = index_key
 
     def context(self) -> C.ShardContext:
         return C.ShardContext(self.engine.mappings, self.engine.segments,
@@ -83,15 +87,19 @@ class ShardSearcher:
     # ---------------- QUERY phase ----------------
 
     def query_phase(self, body: dict, segments: Optional[List[Segment]] = None,
-                    shard_ord: Optional[int] = None) -> ShardQueryResult:
+                    shard_ord: Optional[int] = None,
+                    stats_ctx: Optional[C.ShardContext] = None) -> ShardQueryResult:
         """`shard_ord` overrides the candidate shard tag so a coordinator can
-        search shards of several indices in one pass without id collisions."""
+        search shards of several indices in one pass without id collisions.
+        `stats_ctx` carries index-wide collection statistics (the coordinator
+        DFS phase, reference DFS_QUERY_THEN_FETCH) so idf/avgdl — and thus
+        scores — are identical across shards."""
         t0 = time.monotonic()
         if shard_ord is None:
             shard_ord = self.shard_id
         segments = segments if segments is not None else list(self.engine.segments)
-        ctx = C.ShardContext(self.engine.mappings, segments,
-                             self.similarity, self.field_similarities)
+        ctx = stats_ctx or C.ShardContext(self.engine.mappings, segments,
+                                          self.similarity, self.field_similarities)
         query = dsl.parse_query(body.get("query"))
         lroot = C.rewrite(query, ctx, scoring=True)
 
@@ -116,8 +124,10 @@ class ShardSearcher:
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
                 continue
-            if not C.can_match(lroot, seg):
-                # segment provably has no hits; aggs over zero docs are empty
+            if not _aggs_need_all_segments(agg_nodes) and not C.can_match(lroot, seg):
+                # segment provably has no hits (can_match pre-filter); only
+                # global/filter-family aggs see docs the query doesn't match,
+                # so ordinary agg trees still allow the skip
                 continue
             k_pad = min(next_pow2(max(window * oversample, 16)), seg.ndocs_pad)
             params: Dict[str, Any] = {}
@@ -218,9 +228,11 @@ class ShardSearcher:
     # ---------------- FETCH phase ----------------
 
     def fetch_phase(self, result: ShardQueryResult, selected: List[Candidate],
-                    body: dict) -> List[dict]:
-        ctx = C.ShardContext(self.engine.mappings, result.segments,
-                             self.similarity, self.field_similarities)
+                    body: dict, stats_ctx: Optional[C.ShardContext] = None) -> List[dict]:
+        # explain must recompute with the SAME collection-wide statistics the
+        # query phase scored with, or _explanation diverges from _score
+        ctx = stats_ctx or C.ShardContext(self.engine.mappings, result.segments,
+                                          self.similarity, self.field_similarities)
         lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx, scoring=True)
         hl_terms = collect_query_terms(lroot) if body.get("highlight") else {}
         hits = []
@@ -320,7 +332,9 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     t0 = time.monotonic()
     body = dict(body)
     body["_index_name"] = index_name
-    results = [s.query_phase(body, shard_ord=i) for i, s in enumerate(searchers)]
+    stats = _global_stats_contexts(searchers)
+    results = [s.query_phase(body, shard_ord=i, stats_ctx=stats[i])
+               for i, s in enumerate(searchers)]
     reduced = reduce_shard_results(results, body)
     by_shard: Dict[int, List[Candidate]] = {}
     for c in reduced["selected"]:
@@ -330,7 +344,7 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
         sel = by_shard.get(r.shard, [])
         if not sel:
             continue
-        fetched = searchers[i].fetch_phase(r, sel, body)
+        fetched = searchers[i].fetch_phase(r, sel, body, stats_ctx=stats[i])
         for c, h in zip(sel, fetched):
             hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
     hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)] for c in reduced["selected"]
@@ -364,6 +378,21 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
 # helpers
 # =====================================================================
 
+def _global_stats_contexts(searchers: List[ShardSearcher]) -> List[Any]:
+    """DFS phase: collection statistics span ALL segments of the searcher's
+    index_key group, so idf/avgdl are collection-wide — but each searcher
+    keeps its OWN mappings/similarity for rewrite (heterogeneous standalone
+    searchers must not resolve fields against another index's mappings).
+    Returns one stats context per searcher, aligned by position."""
+    group_segs: Dict[Any, List] = {}
+    for s in searchers:
+        group_segs.setdefault(s.index_key, []).extend(
+            getattr(s, "_snapshot_segments", None) or s.engine.segments)
+    return [C.ShardContext(s.engine.mappings, group_segs[s.index_key],
+                           s.similarity, s.field_similarities)
+            for s in searchers]
+
+
 def _combine_rescore(mode: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     if mode == "total":
         return a + b
@@ -376,6 +405,17 @@ def _combine_rescore(mode: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
     if mode == "min":
         return np.minimum(a, b)
     raise ValueError(f"unknown rescore score_mode [{mode}]")
+
+
+def _aggs_need_all_segments(agg_nodes) -> bool:
+    """True if any agg in the tree observes docs outside the query match set
+    (reference: global/filter/filters/missing aggregators)."""
+    for n in agg_nodes:
+        if n.kind in ("global", "filter", "filters", "missing"):
+            return True
+        if _aggs_need_all_segments(n.subs):
+            return True
+    return False
 
 
 def _collect_named(lroot) -> List[Tuple[str, Any]]:

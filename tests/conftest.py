@@ -1,0 +1,18 @@
+"""Test config: run on a virtual 8-device CPU mesh (SURVEY §4) so sharding
+tests exercise real collectives without TPU hardware."""
+
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_data_path(tmp_path):
+    return str(tmp_path / "data")

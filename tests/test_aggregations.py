@@ -1,0 +1,195 @@
+import math
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.search.executor import ShardSearcher, search_shards
+
+MAPPING = {"properties": {"cat": {"type": "keyword"}, "price": {"type": "double"},
+                          "qty": {"type": "long"}, "ts": {"type": "date"},
+                          "name": {"type": "text"}}}
+
+ROWS = [
+    ("1", {"cat": "a", "price": 10.0, "qty": 1, "ts": "2024-01-05", "name": "one"}),
+    ("2", {"cat": "a", "price": 20.0, "qty": 2, "ts": "2024-01-20", "name": "two"}),
+    ("3", {"cat": "b", "price": 30.0, "qty": 3, "ts": "2024-02-10", "name": "three"}),
+    ("4", {"cat": "b", "price": 40.0, "qty": 4, "ts": "2024-03-01", "name": "four"}),
+    ("5", {"cat": "c", "price": 50.0, "qty": 5, "ts": "2024-03-15", "name": "five"}),
+    ("6", {"cat": ["a", "b"], "price": 60.0, "qty": 6, "ts": "2024-03-20", "name": "six"}),
+]
+
+
+@pytest.fixture(scope="module", params=[1, 2], ids=["1seg", "2seg"])
+def searcher(request):
+    e = Engine(Mappings(MAPPING))
+    n = len(ROWS)
+    cut = n if request.param == 1 else n // 2
+    for i, (did, src) in enumerate(ROWS):
+        e.index_doc(did, src)
+        if i == cut - 1:
+            e.refresh()
+    e.refresh()
+    return ShardSearcher(e)
+
+
+def agg(searcher, aggs, query=None):
+    body = {"size": 0, "aggs": aggs}
+    if query:
+        body["query"] = query
+    return search_shards([searcher], body, "t")["aggregations"]
+
+
+def test_terms_agg_counts_and_order(searcher):
+    r = agg(searcher, {"cats": {"terms": {"field": "cat"}}})
+    buckets = r["cats"]["buckets"]
+    assert buckets[0]["key"] == "a" and buckets[0]["doc_count"] == 3
+    assert buckets[1]["key"] == "b" and buckets[1]["doc_count"] == 3
+    assert buckets[2] == {"key": "c", "doc_count": 1}
+
+
+def test_terms_agg_size_and_other(searcher):
+    r = agg(searcher, {"cats": {"terms": {"field": "cat", "size": 1}}})
+    assert len(r["cats"]["buckets"]) == 1
+    assert r["cats"]["sum_other_doc_count"] == 4
+
+
+def test_terms_key_order(searcher):
+    r = agg(searcher, {"cats": {"terms": {"field": "cat",
+                                          "order": {"_key": "desc"}}}})
+    assert [b["key"] for b in r["cats"]["buckets"]] == ["c", "b", "a"]
+
+
+def test_terms_with_sub_metrics(searcher):
+    r = agg(searcher, {"cats": {"terms": {"field": "cat"},
+                                "aggs": {"avg_p": {"avg": {"field": "price"}},
+                                         "max_p": {"max": {"field": "price"}}}}})
+    b = {x["key"]: x for x in r["cats"]["buckets"]}
+    assert b["a"]["avg_p"]["value"] == pytest.approx(30.0)  # 10,20,60
+    assert b["a"]["max_p"]["value"] == pytest.approx(60.0)
+    assert b["c"]["avg_p"]["value"] == pytest.approx(50.0)
+
+
+def test_stats_family(searcher):
+    r = agg(searcher, {"s": {"stats": {"field": "price"}},
+                       "es": {"extended_stats": {"field": "qty"}},
+                       "vc": {"value_count": {"field": "price"}},
+                       "mn": {"min": {"field": "price"}},
+                       "mx": {"max": {"field": "price"}},
+                       "sm": {"sum": {"field": "qty"}}})
+    assert r["s"] == {"count": 6, "min": 10.0, "max": 60.0, "sum": 210.0, "avg": 35.0}
+    assert r["vc"]["value"] == 6
+    assert r["mn"]["value"] == 10.0 and r["mx"]["value"] == 60.0
+    assert r["sm"]["value"] == 21.0
+    qty = np.array([1, 2, 3, 4, 5, 6], float)
+    assert r["es"]["variance"] == pytest.approx(qty.var(), rel=1e-4)
+    assert r["es"]["std_deviation"] == pytest.approx(qty.std(), rel=1e-4)
+
+
+def test_agg_respects_query(searcher):
+    r = agg(searcher, {"s": {"sum": {"field": "price"}}},
+            query={"term": {"cat": "b"}})
+    assert r["s"]["value"] == pytest.approx(130.0)  # 30+40+60
+
+
+def test_histogram(searcher):
+    r = agg(searcher, {"h": {"histogram": {"field": "price", "interval": 25.0}}})
+    by_key = {b["key"]: b["doc_count"] for b in r["h"]["buckets"]}
+    assert by_key == {0.0: 2, 25.0: 2, 50.0: 2}
+
+
+def test_histogram_with_sub(searcher):
+    r = agg(searcher, {"h": {"histogram": {"field": "price", "interval": 50.0},
+                             "aggs": {"q": {"sum": {"field": "qty"}}}}})
+    by_key = {b["key"]: b for b in r["h"]["buckets"]}
+    assert by_key[0.0]["q"]["value"] == pytest.approx(10.0)  # qty 1+2+3+4
+    assert by_key[50.0]["q"]["value"] == pytest.approx(11.0)
+
+
+def test_date_histogram_calendar(searcher):
+    r = agg(searcher, {"m": {"date_histogram": {"field": "ts",
+                                                "calendar_interval": "month"}}})
+    counts = [b["doc_count"] for b in r["m"]["buckets"]]
+    assert counts == [2, 1, 3]
+    assert r["m"]["buckets"][0]["key_as_string"].startswith("2024-01-01")
+
+
+def test_date_histogram_fixed(searcher):
+    r = agg(searcher, {"d": {"date_histogram": {"field": "ts",
+                                                "fixed_interval": "30d"}}})
+    assert sum(b["doc_count"] for b in r["d"]["buckets"]) == 6
+
+
+def test_range_agg(searcher):
+    r = agg(searcher, {"pr": {"range": {"field": "price",
+                                        "ranges": [{"to": 25}, {"from": 25, "to": 45},
+                                                   {"from": 45}]}}})
+    counts = [b["doc_count"] for b in r["pr"]["buckets"]]
+    assert counts == [2, 2, 2]
+
+
+def test_range_agg_with_sub(searcher):
+    r = agg(searcher, {"pr": {"range": {"field": "price",
+                                        "ranges": [{"key": "cheap", "to": 35}]},
+                              "aggs": {"c": {"value_count": {"field": "qty"}}}}})
+    b = r["pr"]["buckets"][0]
+    assert b["key"] == "cheap" and b["doc_count"] == 3
+    assert b["c"]["value"] == 3
+
+
+def test_filter_and_filters_agg(searcher):
+    r = agg(searcher, {"only_a": {"filter": {"term": {"cat": "a"}},
+                                  "aggs": {"s": {"sum": {"field": "price"}}}}})
+    assert r["only_a"]["doc_count"] == 3
+    assert r["only_a"]["s"]["value"] == pytest.approx(90.0)
+    r = agg(searcher, {"f": {"filters": {"filters": {
+        "cheap": {"range": {"price": {"lt": 25}}},
+        "costly": {"range": {"price": {"gte": 45}}}}}}})
+    assert r["f"]["buckets"]["cheap"]["doc_count"] == 2
+    assert r["f"]["buckets"]["costly"]["doc_count"] == 2
+
+
+def test_global_and_missing(searcher):
+    r = agg(searcher, {"g": {"global": {}, "aggs": {"c": {"value_count": {"field": "qty"}}}},
+                       "no_price": {"missing": {"field": "price"}}},
+            query={"term": {"cat": "c"}})
+    assert r["g"]["doc_count"] == 6  # global ignores the query
+    assert r["no_price"]["doc_count"] == 0
+
+
+def test_cardinality(searcher):
+    r = agg(searcher, {"c": {"cardinality": {"field": "cat"}},
+                       "q": {"cardinality": {"field": "qty"}}})
+    assert r["c"]["value"] == 3
+    assert r["q"]["value"] == 6
+
+
+def test_percentiles(searcher):
+    r = agg(searcher, {"p": {"percentiles": {"field": "price",
+                                             "percents": [50.0, 100.0]}}})
+    assert r["p"]["values"]["50.0"] == pytest.approx(30.0, rel=0.02)
+    assert r["p"]["values"]["100.0"] == pytest.approx(60.0, rel=0.02)
+
+
+def test_pipeline_aggs(searcher):
+    r = agg(searcher, {"m": {"date_histogram": {"field": "ts",
+                                                "calendar_interval": "month"},
+                             "aggs": {"s": {"sum": {"field": "price"}},
+                                      "cum": {"cumulative_sum": {"buckets_path": "s.value"}},
+                                      "d": {"derivative": {"buckets_path": "_count"}},
+                                      "total": {"sum_bucket": {"buckets_path": "s.value"}}}}})
+    buckets = r["m"]["buckets"]
+    sums = [b["s"]["value"] for b in buckets]
+    cums = [b["cum"]["value"] for b in buckets]
+    assert cums == pytest.approx(np.cumsum(sums).tolist())
+    assert buckets[0]["d"]["value"] is None
+    assert buckets[1]["d"]["value"] == buckets[1]["doc_count"] - buckets[0]["doc_count"]
+    assert r["m"]["total"]["value"] == pytest.approx(sum(sums))
+
+
+def test_top_hits_root(searcher):
+    body = {"size": 0, "query": {"match_all": {}},
+            "aggs": {"th": {"top_hits": {"size": 2}}}}
+    r = search_shards([searcher], body, "t")["aggregations"]
+    assert len(r["th"]["hits"]["hits"]) == 2

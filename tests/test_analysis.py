@@ -1,0 +1,82 @@
+from opensearch_tpu.analysis import AnalysisRegistry
+from opensearch_tpu.analysis.filters import (ENGLISH_STOPWORDS, make_shingle_filter,
+                                             make_synonym_filter)
+from opensearch_tpu.analysis.porter import porter_stem
+from opensearch_tpu.analysis.tokenizers import (make_edge_ngram_tokenizer,
+                                                standard_tokenizer)
+
+
+def test_standard_tokenizer_offsets():
+    toks = standard_tokenizer("Hello, World! foo-bar")
+    assert [t.text for t in toks] == ["Hello", "World", "foo", "bar"]
+    assert toks[0].start_offset == 0 and toks[0].end_offset == 5
+    assert toks[1].position == 1
+
+
+def test_standard_analyzer_lowercases():
+    ana = AnalysisRegistry().get("standard")
+    assert ana.terms("The Quick BROWN Fox") == ["the", "quick", "brown", "fox"]
+
+
+def test_english_analyzer_stems_and_stops():
+    ana = AnalysisRegistry().get("english")
+    assert ana.terms("The running foxes jumped") == ["run", "fox", "jump"]
+
+
+def test_porter_examples():
+    # examples from the published Porter algorithm description
+    for word, stem in [("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+                       ("agreed", "agre"), ("plastered", "plaster"),
+                       ("motoring", "motor"), ("happy", "happi"),
+                       ("relational", "relat"), ("conditional", "condit"),
+                       ("triplicate", "triplic"), ("formative", "form"),
+                       ("adjustable", "adjust"), ("effective", "effect")]:
+        assert porter_stem(word) == stem, word
+
+
+def test_keyword_analyzer():
+    ana = AnalysisRegistry().get("keyword")
+    assert ana.terms("New York City") == ["New York City"]
+
+
+def test_stopwords_set():
+    assert "the" in ENGLISH_STOPWORDS and "fox" not in ENGLISH_STOPWORDS
+
+
+def test_custom_analyzer_from_settings():
+    reg = AnalysisRegistry({
+        "analyzer": {"my": {"type": "custom", "tokenizer": "whitespace",
+                            "filter": ["lowercase", "my_stop"]}},
+        "filter": {"my_stop": {"type": "stop", "stopwords": ["foo"]}},
+    })
+    assert reg.get("my").terms("Foo BAR baz") == ["bar", "baz"]
+
+
+def test_edge_ngram():
+    toks = make_edge_ngram_tokenizer(2, 4)("search")
+    assert [t.text for t in toks] == ["se", "sea", "sear"]
+
+
+def test_shingles():
+    from opensearch_tpu.analysis.tokenizers import whitespace_tokenizer
+    toks = make_shingle_filter(2, 2)(whitespace_tokenizer("a b c"))
+    assert [t.text for t in toks] == ["a", "a b", "b", "b c", "c"]
+
+
+def test_synonyms_expand_and_replace():
+    from opensearch_tpu.analysis.tokenizers import whitespace_tokenizer
+    f = make_synonym_filter(["tv, television", "auto => car"])
+    assert [t.text for t in f(whitespace_tokenizer("tv auto"))] == \
+        ["tv", "television", "car"]
+
+
+def test_normalizer():
+    reg = AnalysisRegistry()
+    assert reg.normalizer("lowercase").terms("FooBar") == ["foobar"]
+
+
+def test_html_strip_char_filter():
+    reg = AnalysisRegistry({
+        "analyzer": {"h": {"type": "custom", "tokenizer": "standard",
+                           "char_filter": ["html_strip"], "filter": ["lowercase"]}}})
+    assert reg.get("h").terms("<b>Bold</b> move") == ["bold", "move"]

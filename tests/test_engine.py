@@ -1,0 +1,134 @@
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import Engine, VersionConflictError
+from opensearch_tpu.index.mappings import Mappings
+
+
+def make_engine(path=None):
+    m = Mappings({"properties": {"body": {"type": "text"},
+                                 "n": {"type": "long"},
+                                 "tag": {"type": "keyword"}}})
+    return Engine(m, path=path)
+
+
+def test_index_refresh_search_roundtrip():
+    e = make_engine()
+    e.index_doc("1", {"body": "hello world", "n": 1})
+    e.index_doc("2", {"body": "hello there", "n": 2})
+    assert e.num_docs == 2
+    e.refresh()
+    assert len(e.segments) == 1
+    assert e.doc_freq("body", "hello") == 2
+    assert e.doc_freq("body", "world") == 1
+
+
+def test_realtime_get_from_buffer_and_segment():
+    e = make_engine()
+    e.index_doc("1", {"body": "x", "n": 5})
+    assert e.get("1")["_source"]["n"] == 5  # from buffer, no refresh
+    e.refresh()
+    assert e.get("1")["_source"]["n"] == 5  # from segment
+    assert e.get("missing") is None
+
+
+def test_update_replaces_old_version():
+    e = make_engine()
+    e.index_doc("1", {"body": "old", "n": 1})
+    e.refresh()
+    e.index_doc("1", {"body": "new", "n": 2})
+    e.refresh()
+    assert e.num_docs == 1
+    assert e.get("1")["_source"]["body"] == "new"
+    # old segment has the doc tombstoned
+    assert sum(s.live_count for s in e.segments) == 1
+
+
+def test_delete_and_tombstone():
+    e = make_engine()
+    e.index_doc("1", {"body": "a"})
+    e.index_doc("2", {"body": "b"})
+    e.refresh()
+    res = e.delete_doc("1")
+    assert res["result"] == "deleted"
+    assert e.num_docs == 1
+    assert e.get("1") is None
+    assert e.delete_doc("zzz")["result"] == "not_found"
+
+
+def test_optimistic_concurrency():
+    e = make_engine()
+    r = e.index_doc("1", {"body": "v1"})
+    seq = r["_seq_no"]
+    e.index_doc("1", {"body": "v2"}, if_seq_no=seq, if_primary_term=1)
+    with pytest.raises(VersionConflictError):
+        e.index_doc("1", {"body": "v3"}, if_seq_no=seq, if_primary_term=1)
+    with pytest.raises(VersionConflictError):
+        e.index_doc("1", {"body": "x"}, op_type="create")
+
+
+def test_merge_compacts_deletes():
+    e = make_engine()
+    for i in range(10):
+        e.index_doc(str(i), {"body": f"doc number {i}", "n": i})
+    e.refresh()
+    for i in range(5):
+        e.delete_doc(str(i))
+    merged = e.force_merge_group(list(e.segments))
+    assert merged.ndocs == 5
+    assert merged.live_count == 5
+    assert sorted(merged.ids) == [str(i) for i in range(5, 10)]
+    # postings doc ids remapped and valid
+    pb = merged.postings["body"]
+    assert pb.doc_ids.max() < 5
+
+
+def test_flush_and_recover(tmp_data_path):
+    e = make_engine(tmp_data_path)
+    e.index_doc("1", {"body": "persisted doc", "n": 7})
+    e.flush()
+    e.index_doc("2", {"body": "translog only", "n": 8})  # not flushed
+    e.close()
+
+    e2 = make_engine(tmp_data_path)
+    assert e2.num_docs == 2
+    assert e2.get("1")["_source"]["n"] == 7
+    assert e2.get("2")["_source"]["n"] == 8  # recovered from translog replay
+
+
+def test_translog_replay_of_delete(tmp_data_path):
+    e = make_engine(tmp_data_path)
+    e.index_doc("1", {"body": "a"})
+    e.flush()
+    e.delete_doc("1")
+    e.close()
+    e2 = make_engine(tmp_data_path)
+    assert e2.get("1") is None
+    assert e2.num_docs == 0
+
+
+def test_segment_save_load_roundtrip(tmp_path):
+    e = make_engine()
+    e.index_doc("1", {"body": "round trip", "n": 3, "tag": ["x", "y"]})
+    e.index_doc("2", {"body": "trip round round", "n": 4, "tag": "y"})
+    e.refresh()
+    seg = e.segments[0]
+    from opensearch_tpu.index.segment import Segment
+    seg.save(str(tmp_path / "seg"))
+    loaded = Segment.load(str(tmp_path / "seg"))
+    assert loaded.ndocs == 2
+    assert loaded.postings["body"].vocab == seg.postings["body"].vocab
+    np.testing.assert_array_equal(loaded.postings["body"].doc_ids,
+                                  seg.postings["body"].doc_ids)
+    assert loaded.keyword_cols["tag"].vocab == ["x", "y"]
+    assert loaded.sources[0]["body"] == "round trip"
+
+
+def test_tf_recorded():
+    e = make_engine()
+    e.index_doc("1", {"body": "spam spam spam ham"})
+    e.refresh()
+    pb = e.segments[0].postings["body"]
+    r = pb.row("spam")
+    a, b = pb.row_slice(r)
+    assert pb.tfs[a] == 3.0

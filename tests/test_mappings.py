@@ -1,0 +1,115 @@
+import pytest
+
+from opensearch_tpu.index.mappings import Mappings, coerce_value
+
+
+def test_basic_parse_text_and_numeric():
+    m = Mappings({"properties": {"title": {"type": "text"},
+                                 "price": {"type": "double"}}})
+    d = m.parse("1", {"title": "Quick Fox", "price": 3.5})
+    assert d.terms["title"] == ["quick", "fox"]
+    assert d.numerics["price"] == [3.5]
+
+
+def test_dynamic_mapping_types():
+    m = Mappings()
+    m.parse("1", {"s": "hello world", "i": 42, "f": 1.5, "b": True,
+                  "d": "2024-01-01T10:00:00Z"})
+    assert m.fields["s"].type == "text"
+    assert "keyword" in m.fields["s"].subfields  # default .keyword multi-field
+    assert m.fields["i"].type == "long"
+    assert m.fields["f"].type == "double"
+    assert m.fields["b"].type == "boolean"
+    assert m.fields["d"].type == "date"
+
+
+def test_dynamic_strict_raises():
+    m = Mappings({"properties": {"a": {"type": "keyword"}}, "dynamic": "strict"})
+    with pytest.raises(ValueError, match="strict_dynamic"):
+        m.parse("1", {"b": 1})
+
+
+def test_object_flattening():
+    m = Mappings()
+    d = m.parse("1", {"user": {"name": "alice", "age": 30}})
+    assert m.fields["user.name"].type == "text"
+    assert d.numerics["user.age"] == [30]
+
+
+def test_multifield_resolution():
+    m = Mappings({"properties": {"title": {"type": "text",
+                                           "fields": {"raw": {"type": "keyword"}}}}})
+    d = m.parse("1", {"title": "Foo Bar"})
+    assert d.terms["title"] == ["foo", "bar"]
+    assert d.terms["title.raw"] == ["Foo Bar"]
+    assert m.resolve_field("title.raw").type == "keyword"
+
+
+def test_date_formats():
+    ft = Mappings({"properties": {"d": {"type": "date"}}}).fields["d"]
+    assert coerce_value(ft, "1970-01-01T00:00:01Z") == 1000
+    assert coerce_value(ft, 1234) == 1234
+    assert coerce_value(ft, "2024-06-15") == 1718409600000
+
+
+def test_boolean_coercion():
+    ft = Mappings({"properties": {"b": {"type": "boolean"}}}).fields["b"]
+    assert coerce_value(ft, "true") == 1
+    assert coerce_value(ft, False) == 0
+    with pytest.raises(ValueError):
+        coerce_value(ft, "maybe")
+
+
+def test_integer_range_check():
+    ft = Mappings({"properties": {"v": {"type": "byte"}}}).fields["v"]
+    with pytest.raises(ValueError, match="out of range"):
+        coerce_value(ft, 1000)
+
+
+def test_copy_to():
+    m = Mappings({"properties": {"first": {"type": "text", "copy_to": ["full"]},
+                                 "full": {"type": "text"}}})
+    d = m.parse("1", {"first": "john"})
+    assert d.terms["full"] == ["john"]
+
+
+def test_null_value():
+    m = Mappings({"properties": {"tag": {"type": "keyword", "null_value": "NONE"}}})
+    d = m.parse("1", {"tag": None})
+    assert d.keywords["tag"] == ["NONE"]
+
+
+def test_ignore_above():
+    m = Mappings({"properties": {"k": {"type": "keyword", "ignore_above": 3}}})
+    d = m.parse("1", {"k": ["ab", "abcdef"]})
+    assert d.keywords["k"] == ["ab"]
+
+
+def test_field_alias():
+    m = Mappings({"properties": {"real": {"type": "long"},
+                                 "nick": {"type": "alias", "path": "real"}}})
+    assert m.resolve_field("nick").name == "real"
+
+
+def test_geo_point_formats():
+    m = Mappings({"properties": {"loc": {"type": "geo_point"}}})
+    for v in [{"lat": 40.7, "lon": -74.0}, "40.7,-74.0", [-74.0, 40.7]]:
+        d = m.parse("1", {"loc": v})
+        lat, lon = d.geos["loc"][0]
+        assert abs(lat - 40.7) < 1e-6 and abs(lon + 74.0) < 1e-6
+
+
+def test_ip_field():
+    m = Mappings({"properties": {"addr": {"type": "ip"}}})
+    d = m.parse("1", {"addr": "192.168.0.1"})
+    assert d.numerics["addr"][0] == int.from_bytes(
+        bytes([0] * 10 + [0xFF, 0xFF, 192, 168, 0, 1]), "big")
+
+
+def test_to_dict_roundtrip():
+    src = {"properties": {"title": {"type": "text"},
+                          "tags": {"type": "keyword"}}}
+    m = Mappings(src)
+    out = m.to_dict()
+    assert out["properties"]["title"]["type"] == "text"
+    assert out["properties"]["tags"]["type"] == "keyword"

@@ -1,0 +1,52 @@
+import pytest
+
+from opensearch_tpu.search.query_dsl import (BoolQuery, MatchQuery, QueryParseError,
+                                             TermQuery, parse_minimum_should_match,
+                                             parse_query)
+
+
+def test_parse_shorthand_and_full_forms():
+    q = parse_query({"term": {"f": "v"}})
+    assert isinstance(q, TermQuery) and q.value == "v" and q.boost == 1.0
+    q = parse_query({"term": {"f": {"value": "v", "boost": 2.0}}})
+    assert q.boost == 2.0
+    q = parse_query({"match": {"f": {"query": "a b", "operator": "AND"}}})
+    assert isinstance(q, MatchQuery) and q.operator == "and"
+
+
+def test_parse_bool_nested():
+    q = parse_query({"bool": {"must": {"term": {"a": 1}},
+                              "should": [{"match": {"b": "x"}}],
+                              "filter": [{"range": {"c": {"gte": 0}}}]}})
+    assert isinstance(q, BoolQuery)
+    assert len(q.must) == 1 and len(q.should) == 1 and len(q.filter) == 1
+
+
+def test_parse_errors():
+    with pytest.raises(QueryParseError):
+        parse_query({"unknown_query": {}})
+    with pytest.raises(QueryParseError):
+        parse_query({"terms": {"a": [1], "b": [2]}})
+
+
+def test_minimum_should_match_grammar():
+    assert parse_minimum_should_match("2", 5) == 2
+    assert parse_minimum_should_match("-1", 5) == 4
+    assert parse_minimum_should_match("60%", 5) == 3
+    assert parse_minimum_should_match("-25%", 4) == 3
+    assert parse_minimum_should_match(None, 5) == 0
+    assert parse_minimum_should_match("10", 3) == 3
+
+
+def test_geo_distance_units():
+    q = parse_query({"geo_distance": {"distance": "2km",
+                                      "loc": {"lat": 1.0, "lon": 2.0}}})
+    assert q.distance_m == 2000.0
+    q = parse_query({"geo_distance": {"distance": "1mi", "loc": "1,2"}})
+    assert abs(q.distance_m - 1609.344) < 1e-6
+
+
+def test_match_none_and_all():
+    from opensearch_tpu.search.query_dsl import MatchAllQuery, MatchNoneQuery
+    assert isinstance(parse_query(None), MatchAllQuery)
+    assert isinstance(parse_query({"match_none": {}}), MatchNoneQuery)

@@ -1,0 +1,276 @@
+import pytest
+
+from opensearch_tpu import RestClient
+from opensearch_tpu.rest.client import ApiError
+
+
+@pytest.fixture
+def client(tmp_data_path):
+    return RestClient(data_path=tmp_data_path)
+
+
+def seed(c, index="items", shards=2):
+    c.indices.create(index, {"settings": {"number_of_shards": shards},
+                             "mappings": {"properties": {
+                                 "name": {"type": "text"},
+                                 "price": {"type": "double"},
+                                 "cat": {"type": "keyword"}}}})
+    c.bulk([
+        {"index": {"_index": index, "_id": "1"}}, {"name": "red sweater", "price": 40.0, "cat": "clothing"},
+        {"index": {"_index": index, "_id": "2"}}, {"name": "blue sweater", "price": 30.0, "cat": "clothing"},
+        {"index": {"_index": index, "_id": "3"}}, {"name": "espresso machine", "price": 250.0, "cat": "kitchen"},
+    ], refresh=True)
+
+
+def test_doc_crud(client):
+    client.index("i", {"a": 1}, id="x", refresh=True)
+    assert client.get("i", "x")["_source"] == {"a": 1}
+    assert client.exists("i", "x")
+    client.delete("i", "x", refresh=True)
+    assert not client.exists("i", "x")
+    with pytest.raises(ApiError) as e:
+        client.get("i", "x")
+    assert e.value.status == 404
+
+
+def test_auto_id_and_op_type(client):
+    r = client.index("i", {"a": 1})
+    assert r["_id"]
+    client.create("i", "fixed", {"b": 2})
+    with pytest.raises(ApiError) as e:
+        client.create("i", "fixed", {"b": 3})
+    assert e.value.status == 409
+
+
+def test_bulk_mixed_and_errors(client):
+    r = client.bulk([
+        {"index": {"_index": "b", "_id": "1"}}, {"v": 1},
+        {"create": {"_index": "b", "_id": "1"}}, {"v": 2},   # conflict
+        {"delete": {"_index": "b", "_id": "zz"}},             # not found
+        {"update": {"_index": "b", "_id": "1"}}, {"doc": {"v": 9}},
+    ], refresh=True)
+    assert r["errors"] is True
+    stats = [list(i.values())[0]["status"] for i in r["items"]]
+    assert stats == [201, 409, 404, 200]
+    assert client.get("b", "1")["_source"]["v"] == 9
+
+
+def test_update_upsert_noop(client):
+    r = client.update("u", "1", {"doc": {"x": 1}, "doc_as_upsert": True})
+    assert r["result"] in ("created", "updated")
+    r = client.update("u", "1", {"doc": {"x": 1}})
+    assert r["result"] == "noop"
+    client.update("u", "2", {"upsert": {"y": 5}, "doc": {"y": 6}})
+    assert client.get("u", "2")["_source"]["y"] == 5
+
+
+def test_search_and_count(client):
+    seed(client)
+    r = client.search("items", {"query": {"match": {"name": "sweater"}}})
+    assert r["hits"]["total"]["value"] == 2
+    assert client.count("items", {"query": {"term": {"cat": "kitchen"}}})["count"] == 1
+
+
+def test_msearch(client):
+    seed(client)
+    r = client.msearch([{"index": "items"}, {"query": {"match_all": {}}},
+                        {"index": "items"}, {"query": {"term": {"cat": "kitchen"}}}])
+    assert r["responses"][0]["hits"]["total"]["value"] == 3
+    assert r["responses"][1]["hits"]["total"]["value"] == 1
+
+
+def test_mget(client):
+    seed(client)
+    r = client.mget({"docs": [{"_index": "items", "_id": "1"},
+                              {"_index": "items", "_id": "nope"}]})
+    assert r["docs"][0]["_source"]["price"] == 40.0
+    assert r["docs"][1]["found"] is False
+
+
+def test_aliases_and_wildcards(client):
+    seed(client, "logs-2024-01")
+    seed(client, "logs-2024-02")
+    client.indices.update_aliases({"actions": [
+        {"add": {"index": "logs-2024-01", "alias": "logs"}},
+        {"add": {"index": "logs-2024-02", "alias": "logs"}}]})
+    assert client.count("logs")["count"] == 6
+    assert client.count("logs-2024-*")["count"] == 6
+    al = client.indices.get_alias(name="logs")
+    assert set(al) == {"logs-2024-01", "logs-2024-02"}
+
+
+def test_index_templates(client):
+    client.indices.put_index_template("tmpl", {
+        "index_patterns": ["tmp-*"],
+        "template": {"settings": {"number_of_shards": 3},
+                     "mappings": {"properties": {"f": {"type": "keyword"}}}}})
+    client.index("tmp-1", {"f": "v"}, id="1", refresh=True)
+    svc = client.node.indices["tmp-1"]
+    assert svc.meta.num_shards == 3
+    assert svc.mappings.fields["f"].type == "keyword"
+
+
+def test_mapping_apis(client):
+    seed(client)
+    m = client.indices.get_mapping("items")
+    assert m["items"]["mappings"]["properties"]["name"]["type"] == "text"
+    client.indices.put_mapping("items", {"properties": {"extra": {"type": "long"}}})
+    assert client.node.indices["items"].mappings.fields["extra"].type == "long"
+
+
+def test_analyze_api(client):
+    seed(client)
+    toks = client.indices.analyze("items", {"text": "Red Sweaters",
+                                            "analyzer": "english"})["tokens"]
+    assert [t["token"] for t in toks] == ["red", "sweater"]
+    toks = client.indices.analyze("items", {"field": "cat", "text": "As-Is"})["tokens"]
+    assert [t["token"] for t in toks] == ["As-Is"]
+
+
+def test_field_caps(client):
+    seed(client)
+    r = client.field_caps("items", "*")
+    assert r["fields"]["price"]["double"]["aggregatable"]
+    assert r["fields"]["name"]["text"]["searchable"]
+
+
+def test_reindex_and_delete_by_query(client):
+    seed(client)
+    client.reindex({"source": {"index": "items"}, "dest": {"index": "copy"}},
+                   refresh=True)
+    assert client.count("copy")["count"] == 3
+    client.delete_by_query("copy", {"query": {"term": {"cat": "clothing"}}},
+                           refresh=True)
+    assert client.count("copy")["count"] == 1
+
+
+def test_scroll(client):
+    seed(client)
+    r = client.search("items", {"query": {"match_all": {}}, "size": 2,
+                                "sort": [{"price": "asc"}]}, scroll="1m")
+    page1 = [h["_id"] for h in r["hits"]["hits"]]
+    r2 = client.scroll(r["_scroll_id"])
+    page2 = [h["_id"] for h in r2["hits"]["hits"]]
+    assert page1 == ["2", "1"] and page2 == ["3"]
+    client.clear_scroll(r["_scroll_id"])
+    with pytest.raises(ApiError):
+        client.scroll(r["_scroll_id"])
+
+
+def test_pit_isolation(client):
+    seed(client)
+    pit = client.create_pit("items")
+    client.index("items", {"name": "new thing", "price": 5.0}, id="9", refresh=True)
+    live = client.search("items", {"query": {"match_all": {}}})
+    pinned = client.search("items", {"query": {"match_all": {}},
+                                     "pit": {"id": pit["pit_id"]}})
+    assert live["hits"]["total"]["value"] == 4
+    assert pinned["hits"]["total"]["value"] == 3
+    client.delete_pit({"pit_id": pit["pit_id"]})
+
+
+def test_ingest_pipeline(client):
+    client.ingest.put_pipeline("p1", {"processors": [
+        {"set": {"field": "tagged", "value": True}},
+        {"uppercase": {"field": "name"}},
+        {"convert": {"field": "num", "type": "integer", "ignore_missing": True}},
+    ]})
+    client.index("pi", {"name": "abc", "num": "42"}, id="1", pipeline="p1",
+                 refresh=True)
+    src = client.get("pi", "1")["_source"]
+    assert src == {"name": "ABC", "num": 42, "tagged": True}
+    sim = client.ingest.simulate({"pipeline": {"processors": [
+        {"fail": {"message": "boom"}}]}, "docs": [{"_source": {}}]})
+    assert "error" in sim["docs"][0]
+
+
+def test_default_pipeline(client):
+    client.ingest.put_pipeline("dp", {"processors": [
+        {"set": {"field": "via", "value": "pipeline"}}]})
+    client.indices.create("auto", {"settings": {"default_pipeline": "dp"}})
+    client.index("auto", {"x": 1}, id="1", refresh=True)
+    assert client.get("auto", "1")["_source"]["via"] == "pipeline"
+
+
+def test_snapshot_restore(client, tmp_path):
+    seed(client)
+    client.snapshot.create_repository("repo", {"settings": {"location": str(tmp_path / "snaps")}})
+    client.snapshot.create("repo", "snap1", {"indices": "items"})
+    client.indices.delete("items")
+    assert not client.indices.exists("items")
+    client.snapshot.restore("repo", "snap1")
+    assert client.count("items")["count"] == 3
+    assert client.snapshot.get("repo")["snapshots"][0]["snapshot"] == "snap1"
+
+
+def test_explain_api(client):
+    seed(client)
+    r = client.explain("items", "1", {"query": {"match": {"name": "red"}}})
+    assert r["matched"] is True
+    r = client.explain("items", "3", {"query": {"match": {"name": "red"}}})
+    assert r["matched"] is False
+
+
+def test_termvectors(client):
+    seed(client)
+    r = client.termvectors("items", "1", fields=["name"])
+    assert r["term_vectors"]["name"]["terms"]["red"]["term_freq"] == 1
+
+
+def test_cluster_and_cat(client):
+    seed(client)
+    assert client.cluster.health()["status"] == "green"
+    assert client.cluster.state()["metadata"]["indices"]["items"]["state"] == "open"
+    cats = client.cat.indices()
+    assert any(row["index"] == "items" and row["docs.count"] == "3" for row in cats)
+    assert client.cat.count("items")[0]["count"] == "3"
+
+
+def test_request_cache(client):
+    seed(client)
+    body = {"query": {"match": {"name": "sweater"}}}
+    client.search("items", body)
+    m0 = client.node.request_cache.hits
+    client.search("items", body)
+    assert client.node.request_cache.hits == m0 + 1
+    # a write invalidates via generation
+    client.index("items", {"name": "green sweater", "price": 10.0}, id="9",
+                 refresh=True)
+    r = client.search("items", body)
+    assert r["hits"]["total"]["value"] == 3
+
+
+def test_node_recovery(tmp_data_path):
+    c = RestClient(data_path=tmp_data_path)
+    seed(c)
+    c.indices.flush("items")
+    c2 = RestClient(data_path=tmp_data_path)
+    assert c2.count("items")["count"] == 3
+    assert c2.get("items", "1")["_source"]["name"] == "red sweater"
+
+
+def test_routing_param(client):
+    client.indices.create("r", {"settings": {"number_of_shards": 4}})
+    client.index("r", {"v": 1}, id="a", routing="user1", refresh=True)
+    assert client.get("r", "a", routing="user1")["_source"]["v"] == 1
+
+
+def test_index_not_found(client):
+    from opensearch_tpu.cluster.state import IndexNotFoundError
+    with pytest.raises(IndexNotFoundError):
+        client.search("missing_index", {"query": {"match_all": {}}})
+
+
+def test_bad_query_is_400(client):
+    seed(client)
+    with pytest.raises(ApiError) as e:
+        client.search("items", {"query": {"frobnicate": {}}})
+    assert e.value.status == 400
+
+
+def test_explain_matches_score_across_shards(client):
+    seed(client)
+    r = client.search("items", {"query": {"match": {"name": "sweater"}},
+                                "explain": True})
+    for h in r["hits"]["hits"]:
+        assert h["_explanation"]["value"] == pytest.approx(h["_score"], rel=1e-4)

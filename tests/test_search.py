@@ -1,0 +1,307 @@
+import math
+
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.search.executor import ShardSearcher, search_shards
+
+DOCS = [
+    ("1", {"title": "quick brown fox", "body": "the quick brown fox jumps over the lazy dog",
+           "price": 3.5, "tag": ["animal", "fast"], "ts": "2024-01-01", "views": 100}),
+    ("2", {"title": "lazy dog", "body": "a lazy dog sleeps all day",
+           "price": 1.0, "tag": ["animal", "slow"], "ts": "2024-01-02", "views": 50}),
+    ("3", {"title": "quick quick quick", "body": "quick as lightning",
+           "price": 9.9, "tag": ["fast"], "ts": "2024-02-01", "views": 500}),
+    ("4", {"title": "unrelated document", "body": "nothing to see here",
+           "price": 7.0, "tag": ["other"], "ts": "2024-02-15", "views": 10}),
+]
+
+MAPPING = {"properties": {"title": {"type": "text"}, "body": {"type": "text"},
+                          "price": {"type": "double"}, "tag": {"type": "keyword"},
+                          "ts": {"type": "date"}, "views": {"type": "long"}}}
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    e = Engine(Mappings(MAPPING))
+    for i, s in DOCS:
+        e.index_doc(i, s)
+    e.refresh()
+    return ShardSearcher(e)
+
+
+def search(searcher, body):
+    return search_shards([searcher], body, "idx")
+
+
+def ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+def test_bm25_score_matches_lucene_formula(searcher):
+    # single-term query on title:"fox" — exact Lucene BM25:
+    # idf = ln(1 + (N - df + 0.5)/(df + 0.5)); tf=1, dl=3, avgdl computed
+    r = search(searcher, {"query": {"match": {"title": "fox"}}})
+    assert ids(r) == ["1"]
+    N, df = 4, 1
+    dls = [3, 2, 3, 2]
+    avgdl = sum(dls) / 4
+    idf = math.log(1 + (N - df + 0.5) / (df + 0.5))
+    tf = 1.0
+    expected = idf * tf / (tf + 1.2 * (1 - 0.75 + 0.75 * 3 / avgdl))
+    assert abs(r["hits"]["hits"][0]["_score"] - expected) < 1e-5
+
+
+def test_match_or_and(searcher):
+    r = search(searcher, {"query": {"match": {"body": "lazy dog"}}})
+    assert set(ids(r)) == {"1", "2"}
+    r = search(searcher, {"query": {"match": {"body": {"query": "lazy sleeps",
+                                                       "operator": "and"}}}})
+    assert ids(r) == ["2"]
+
+
+def test_term_and_terms(searcher):
+    r = search(searcher, {"query": {"term": {"tag": "fast"}}})
+    assert set(ids(r)) == {"1", "3"}
+    r = search(searcher, {"query": {"terms": {"tag": ["slow", "other"]}}})
+    assert set(ids(r)) == {"2", "4"}
+
+
+def test_term_on_numeric(searcher):
+    r = search(searcher, {"query": {"term": {"views": 500}}})
+    assert ids(r) == ["3"]
+
+
+def test_bool_query(searcher):
+    r = search(searcher, {"query": {"bool": {
+        "must": [{"match": {"body": "quick"}}],
+        "must_not": [{"term": {"tag": "animal"}}]}}})
+    assert ids(r) == ["3"]
+    r = search(searcher, {"query": {"bool": {
+        "should": [{"term": {"tag": "slow"}}, {"term": {"tag": "other"}}],
+        "minimum_should_match": 1}}})
+    assert set(ids(r)) == {"2", "4"}
+
+
+def test_filter_does_not_score(searcher):
+    r1 = search(searcher, {"query": {"bool": {"must": [{"match": {"title": "quick"}}],
+                                              "filter": [{"range": {"price": {"gte": 0}}}]}}})
+    r2 = search(searcher, {"query": {"match": {"title": "quick"}}})
+    assert r1["hits"]["hits"][0]["_score"] == pytest.approx(
+        r2["hits"]["hits"][0]["_score"])
+
+
+def test_range_queries(searcher):
+    r = search(searcher, {"query": {"range": {"price": {"gte": 3.5, "lt": 9.9}}}})
+    assert set(ids(r)) == {"1", "4"}
+    r = search(searcher, {"query": {"range": {"views": {"gt": 50}}}})
+    assert set(ids(r)) == {"1", "3"}
+    r = search(searcher, {"query": {"range": {"ts": {"gte": "2024-02-01"}}}})
+    assert set(ids(r)) == {"3", "4"}
+
+
+def test_exists_ids_matchall(searcher):
+    r = search(searcher, {"query": {"exists": {"field": "price"}}})
+    assert len(ids(r)) == 4
+    r = search(searcher, {"query": {"ids": {"values": ["2", "4", "nope"]}}})
+    assert set(ids(r)) == {"2", "4"}
+    r = search(searcher, {"query": {"match_all": {"boost": 2.0}}})
+    assert r["hits"]["hits"][0]["_score"] == 2.0
+    r = search(searcher, {"query": {"match_none": {}}})
+    assert ids(r) == []
+
+
+def test_constant_score_and_boost(searcher):
+    r = search(searcher, {"query": {"constant_score": {
+        "filter": {"term": {"tag": "fast"}}, "boost": 3.0}}})
+    assert all(h["_score"] == 3.0 for h in r["hits"]["hits"])
+
+
+def test_dis_max(searcher):
+    r = search(searcher, {"query": {"dis_max": {
+        "queries": [{"match": {"title": "quick"}}, {"match": {"body": "quick"}}],
+        "tie_breaker": 0.0}}})
+    assert "3" in ids(r) and "1" in ids(r)
+
+
+def test_boosting_query(searcher):
+    r = search(searcher, {"query": {"boosting": {
+        "positive": {"match": {"body": "quick"}},
+        "negative": {"term": {"tag": "animal"}},
+        "negative_boost": 0.1}}})
+    # doc 1 is demoted below doc 3
+    assert ids(r)[0] == "3"
+
+
+def test_multi_match(searcher):
+    r = search(searcher, {"query": {"multi_match": {
+        "query": "quick", "fields": ["title^2", "body"]}}})
+    assert set(ids(r)) == {"1", "3"}
+
+
+def test_prefix_wildcard_fuzzy(searcher):
+    assert set(ids(search(searcher, {"query": {"prefix": {"body": "sleep"}}}))) == {"2"}
+    assert set(ids(search(searcher, {"query": {"wildcard": {"body": "light*"}}}))) == {"3"}
+    assert set(ids(search(searcher, {"query": {"fuzzy": {"body": "quikc"}}}))) == {"1", "3"}
+    assert set(ids(search(searcher, {"query": {"regexp": {"body": "slee.."}}}))) == {"2"}
+
+
+def test_match_phrase(searcher):
+    r = search(searcher, {"query": {"match_phrase": {"body": "lazy dog"}}})
+    assert set(ids(r)) == {"1", "2"}
+    r = search(searcher, {"query": {"match_phrase": {"body": "dog lazy"}}})
+    assert ids(r) == []
+
+
+def test_query_string(searcher):
+    r = search(searcher, {"query": {"query_string": {
+        "query": "tag:fast AND title:quick"}}})
+    assert set(ids(r)) == {"1", "3"}
+    r = search(searcher, {"query": {"simple_query_string": {
+        "query": "lazy -sleeps", "fields": ["body"]}}})
+    assert set(ids(r)) == {"1"}
+
+
+def test_function_score(searcher):
+    r = search(searcher, {"query": {"function_score": {
+        "query": {"match_all": {}},
+        "functions": [{"field_value_factor": {"field": "views", "factor": 1.0,
+                                              "modifier": "none"}}],
+        "boost_mode": "replace"}}})
+    assert ids(r) == ["3", "1", "2", "4"]
+    assert r["hits"]["hits"][0]["_score"] == pytest.approx(500.0)
+
+
+def test_sort_and_pagination(searcher):
+    r = search(searcher, {"query": {"match_all": {}},
+                          "sort": [{"price": "asc"}], "size": 2})
+    assert ids(r) == ["2", "1"]
+    assert r["hits"]["hits"][0]["sort"] == [1.0]
+    r = search(searcher, {"query": {"match_all": {}},
+                          "sort": [{"price": "asc"}], "size": 2, "from": 2})
+    assert ids(r) == ["4", "3"]
+
+
+def test_sort_desc_and_keyword(searcher):
+    r = search(searcher, {"query": {"match_all": {}}, "sort": [{"views": "desc"}]})
+    assert ids(r) == ["3", "1", "2", "4"]
+    r = search(searcher, {"query": {"match_all": {}}, "sort": [{"tag": "asc"}]})
+    assert ids(r)[0] in ("1", "2")  # "animal" sorts first
+
+
+def test_search_after(searcher):
+    r1 = search(searcher, {"query": {"match_all": {}}, "sort": [{"views": "desc"}],
+                           "size": 2})
+    after = r1["hits"]["hits"][-1]["sort"]
+    r2 = search(searcher, {"query": {"match_all": {}}, "sort": [{"views": "desc"}],
+                           "size": 2, "search_after": after})
+    assert ids(r1) + ids(r2) == ["3", "1", "2", "4"]
+
+
+def test_total_and_track_total_hits(searcher):
+    r = search(searcher, {"query": {"match_all": {}}, "size": 1})
+    assert r["hits"]["total"] == {"value": 4, "relation": "eq"}
+    r = search(searcher, {"query": {"match_all": {}}, "size": 1,
+                          "track_total_hits": 2})
+    assert r["hits"]["total"] == {"value": 2, "relation": "gte"}
+
+
+def test_min_score(searcher):
+    r = search(searcher, {"query": {"match": {"title": "quick"}}, "min_score": 100.0})
+    assert ids(r) == []
+
+
+def test_source_filtering_and_fields(searcher):
+    r = search(searcher, {"query": {"ids": {"values": ["1"]}},
+                          "_source": {"includes": ["title", "price"]}})
+    src = r["hits"]["hits"][0]["_source"]
+    assert set(src) == {"title", "price"}
+    r = search(searcher, {"query": {"ids": {"values": ["1"]}}, "_source": False,
+                          "docvalue_fields": ["views", "tag"]})
+    h = r["hits"]["hits"][0]
+    assert "_source" not in h
+    assert h["fields"]["views"] == [100]
+    assert sorted(h["fields"]["tag"]) == ["animal", "fast"]
+
+
+def test_highlight(searcher):
+    r = search(searcher, {"query": {"match": {"body": "lazy"}},
+                          "highlight": {"fields": {"body": {}}}})
+    hl = r["hits"]["hits"][0]["highlight"]["body"][0]
+    assert "<em>lazy</em>" in hl
+
+
+def test_named_queries(searcher):
+    r = search(searcher, {"query": {"bool": {"should": [
+        {"term": {"tag": {"value": "fast", "_name": "is_fast"}}},
+        {"term": {"tag": {"value": "slow", "_name": "is_slow"}}}]}}})
+    by_id = {h["_id"]: h.get("matched_queries", []) for h in r["hits"]["hits"]}
+    assert by_id["3"] == ["is_fast"]
+    assert by_id["2"] == ["is_slow"]
+
+
+def test_explain(searcher):
+    r = search(searcher, {"query": {"match": {"title": "fox"}}, "explain": True})
+    expl = r["hits"]["hits"][0]["_explanation"]
+    assert expl["value"] == pytest.approx(r["hits"]["hits"][0]["_score"], rel=1e-4)
+
+
+def test_rescore(searcher):
+    r = search(searcher, {"query": {"match": {"body": "quick"}},
+                          "rescore": {"window_size": 10, "query": {
+                              "rescore_query": {"term": {"tag": "animal"}},
+                              "query_weight": 1.0, "rescore_query_weight": 10.0}}})
+    assert ids(r)[0] == "1"  # boosted by rescore
+
+
+def test_multi_shard_equals_single_shard():
+    from opensearch_tpu.cluster.routing import shard_for
+    single = Engine(Mappings(MAPPING))
+    shards = [Engine(Mappings(MAPPING)) for _ in range(3)]
+    for i, s in DOCS:
+        single.index_doc(i, s)
+        shards[shard_for(i, 3)].index_doc(i, s)
+    single.refresh()
+    for sh in shards:
+        sh.refresh()
+    body = {"query": {"match": {"body": "quick lazy dog"}}}
+    r1 = search_shards([ShardSearcher(single)], body, "a")
+    rN = search_shards([ShardSearcher(e, shard_id=i) for i, e in enumerate(shards)],
+                       body, "a")
+    assert ids(r1) == ids(rN)
+    s1 = [h["_score"] for h in r1["hits"]["hits"]]
+    sN = [h["_score"] for h in rN["hits"]["hits"]]
+    assert s1 == pytest.approx(sN, rel=1e-5)
+
+
+def test_multi_segment_consistency(searcher):
+    e = Engine(Mappings(MAPPING))
+    for i, s in DOCS[:2]:
+        e.index_doc(i, s)
+    e.refresh()
+    for i, s in DOCS[2:]:
+        e.index_doc(i, s)
+    e.refresh()
+    assert len(e.segments) == 2
+    body = {"query": {"match": {"body": "quick lazy"}}}
+    r2 = search_shards([ShardSearcher(e)], body, "a")
+    r1 = search(searcher, body)
+    assert ids(r1) == ids(r2)
+    assert [h["_score"] for h in r1["hits"]["hits"]] == pytest.approx(
+        [h["_score"] for h in r2["hits"]["hits"]], rel=1e-5)
+
+
+def test_geo_distance():
+    m = Mappings({"properties": {"loc": {"type": "geo_point"}}})
+    e = Engine(m)
+    e.index_doc("sf", {"loc": {"lat": 37.77, "lon": -122.42}})
+    e.index_doc("ny", {"loc": {"lat": 40.71, "lon": -74.00}})
+    e.refresh()
+    r = search_shards([ShardSearcher(e)], {"query": {"geo_distance": {
+        "distance": "100km", "loc": {"lat": 37.7, "lon": -122.4}}}}, "g")
+    assert ids(r) == ["sf"]
+    r = search_shards([ShardSearcher(e)], {"query": {"geo_bounding_box": {
+        "loc": {"top_left": {"lat": 41, "lon": -75},
+                "bottom_right": {"lat": 40, "lon": -73}}}}}, "g")
+    assert ids(r) == ["ny"]
