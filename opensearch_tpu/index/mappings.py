@@ -25,6 +25,7 @@ INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean"}
 FLOAT_TYPES = {"double", "float", "half_float"}
 NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
 GEO_TYPES = {"geo_point"}
+VECTOR_TYPES = {"dense_vector", "knn_vector"}
 
 
 @dataclass
@@ -42,6 +43,8 @@ class FieldType:
     copy_to: List[str] = dc_field(default_factory=list)
     date_format: Optional[str] = None
     boost: float = 1.0
+    dims: int = 0                       # dense_vector dimension
+    vector_similarity: str = "cosine"   # cosine | dot_product | l2_norm
     # text fields keep norms (doc length) unless disabled; keyword fields never
     norms: bool = True
     subfields: Dict[str, "FieldType"] = dc_field(default_factory=dict)
@@ -139,6 +142,8 @@ class ParsedDocument:
     keywords: Dict[str, List[str]] = dc_field(default_factory=dict)
     # field -> list of (lat, lon)
     geos: Dict[str, List[Tuple[float, float]]] = dc_field(default_factory=dict)
+    # field -> vector (one per doc)
+    vectors: Dict[str, List[float]] = dc_field(default_factory=dict)
 
 
 class Mappings:
@@ -199,6 +204,9 @@ class Mappings:
             date_format=cfg.get("format"),
             boost=cfg.get("boost", 1.0),
             norms=cfg.get("norms", True),
+            dims=int(cfg.get("dims", cfg.get("dimension", 0))),
+            vector_similarity=cfg.get("similarity",
+                                      cfg.get("space_type", "cosine")),
         )
         for sub, subcfg in cfg.get("fields", {}).items():
             ft.subfields[sub] = self._build_field(f"{path}.{sub}", subcfg.get("type", "keyword"), subcfg)
@@ -328,6 +336,8 @@ class Mappings:
         if (ft.type in GEO_TYPES and isinstance(value, list) and value
                 and isinstance(value[0], numbers.Number)):
             value = [value]  # GeoJSON [lon, lat] is one point, not two values
+        if ft.type in VECTOR_TYPES and isinstance(value, list):
+            value = [value]  # the whole list is ONE vector value
         values = value if isinstance(value, list) else [value]
         for v in values:
             if v is None:
@@ -372,6 +382,14 @@ class Mappings:
         if ft.type in GEO_TYPES:
             lat, lon = _parse_geo(v)
             parsed.geos.setdefault(name, []).append((lat, lon))
+            return
+        if ft.type in VECTOR_TYPES:
+            vec = [float(x) for x in (v if isinstance(v, list) else [v])]
+            if ft.dims and len(vec) != ft.dims:
+                raise ValueError(
+                    f"vector length [{len(vec)}] differs from mapped dims "
+                    f"[{ft.dims}] for field [{name}]")
+            parsed.vectors[name] = vec
             return
         cv = coerce_value(ft, v)
         parsed.numerics.setdefault(name, []).append(cv)

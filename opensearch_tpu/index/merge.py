@@ -10,7 +10,7 @@ from typing import Dict, List
 import numpy as np
 
 from .segment import (GeoColumn, KeywordColumn, NumericColumn, PostingsBlock, Segment,
-                      TextFieldStats)
+                      TextFieldStats, VectorColumn)
 
 
 class TieredMergePolicy:
@@ -172,6 +172,21 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
             present[dmap[m]] = col.present[m]
         geo_cols[f] = GeoColumn(f, lat, lon, present)
 
+    # ---- vector columns ----
+    vector_cols: Dict[str, VectorColumn] = {}
+    for f in {f for s in segments for f in getattr(s, "vector_cols", {})}:
+        first = next(s.vector_cols[f] for s in segments if f in s.vector_cols)
+        dims = first.values.shape[1]
+        values = np.zeros((ndocs, dims), np.float32)
+        present = np.zeros(ndocs, bool)
+        for s, m, dmap in zip(segments, live_masks, doc_maps):
+            col = s.vector_cols.get(f)
+            if col is None:
+                continue
+            values[dmap[m]] = col.values[m]
+            present[dmap[m]] = col.present[m]
+        vector_cols[f] = VectorColumn(f, values, present, first.similarity)
+
     # ---- doc lens + stats ----
     doc_lens: Dict[str, np.ndarray] = {}
     text_stats: Dict[str, TextFieldStats] = {}
@@ -185,7 +200,8 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
         text_stats[f] = TextFieldStats(doc_count=int((dl > 0).sum()), sum_dl=int(dl.sum()))
 
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
-                   doc_lens, text_stats, ids, sources, seq_nos=seq_nos)
+                   doc_lens, text_stats, ids, sources, seq_nos=seq_nos,
+                   vector_cols=vector_cols)
 
 
 def _ranges_gather(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:

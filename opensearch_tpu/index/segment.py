@@ -147,6 +147,26 @@ class GeoColumn:
 
 
 @dataclass
+class VectorColumn:
+    """Dense vectors for kNN search, row-major [ndocs, dims] (brute-force
+    exact kNN runs as one MXU matmul per segment — see ops/knn; the
+    reference's k-NN plugin uses HNSW/faiss, approximate)."""
+
+    field: str
+    values: np.ndarray        # f32[ndocs, dims]
+    present: np.ndarray       # bool[ndocs]
+    similarity: str = "cosine"
+    # unit-norm copy for cosine (precomputed at build)
+    _normed: Optional[np.ndarray] = None
+
+    def normed(self) -> np.ndarray:
+        if self._normed is None:
+            n = np.linalg.norm(self.values, axis=1, keepdims=True)
+            self._normed = (self.values / np.maximum(n, 1e-12)).astype(np.float32)
+        return self._normed
+
+
+@dataclass
 class TextFieldStats:
     doc_count: int = 0        # docs containing this field
     sum_dl: int = 0           # total tokens across docs
@@ -166,13 +186,15 @@ class Segment:
                  doc_lens: Dict[str, np.ndarray],
                  text_stats: Dict[str, TextFieldStats],
                  ids: List[str], sources: List[dict],
-                 seq_nos: Optional[np.ndarray] = None):
+                 seq_nos: Optional[np.ndarray] = None,
+                 vector_cols: Optional[Dict[str, VectorColumn]] = None):
         self.name = name
         self.ndocs = ndocs
         self.postings = postings
         self.numeric_cols = numeric_cols
         self.keyword_cols = keyword_cols
         self.geo_cols = geo_cols
+        self.vector_cols = vector_cols or {}
         self.doc_lens = doc_lens
         self.text_stats = text_stats
         self.ids = ids
@@ -240,6 +262,17 @@ class Segment:
                     "doc_of_value": jnp.asarray(_pad_to(col.doc_of_value, vpad, INT32_SENTINEL)),
                     "min_ord": jnp.asarray(_pad_to(col.min_ord, dpad, np.int32(-1))),
                 }
+            vcols = {}
+            for f, col in self.vector_cols.items():
+                dims = col.values.shape[1]
+                dpad128 = ((dims + 127) // 128) * 128  # MXU lane alignment
+                mat = np.zeros((dpad, dpad128), np.float32)
+                src = col.normed() if col.similarity == "cosine" else col.values
+                mat[: self.ndocs, :dims] = src
+                vcols[f] = {
+                    "mat": jnp.asarray(mat),
+                    "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+                }
             gcols = {}
             for f, col in self.geo_cols.items():
                 gcols[f] = {
@@ -253,7 +286,7 @@ class Segment:
             # jit arguments and poison static shape derivation downstream
             self._device = {
                 "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
-                "doc_lens": dls,
+                "vector": vcols, "doc_lens": dls,
             }
         if self._device_live_dirty:
             import jax.numpy as jnp
@@ -302,6 +335,11 @@ class Segment:
             arrays[f"geo__{f}__lat"] = col.lat
             arrays[f"geo__{f}__lon"] = col.lon
             arrays[f"geo__{f}__present"] = col.present
+        for f, col in self.vector_cols.items():
+            arrays[f"vec__{f}__values"] = col.values
+            arrays[f"vec__{f}__present"] = col.present
+            meta["vector"] = meta.get("vector", {})
+            meta["vector"][f] = {"similarity": col.similarity}
         for f, dl in self.doc_lens.items():
             arrays[f"dl__{f}"] = dl
         np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
@@ -348,10 +386,14 @@ class Segment:
         geo = {f: GeoColumn(f, arrays[f"geo__{f}__lat"], arrays[f"geo__{f}__lon"],
                             arrays[f"geo__{f}__present"])
                for f in meta["geo"]}
+        vectors = {f: VectorColumn(f, arrays[f"vec__{f}__values"],
+                                   arrays[f"vec__{f}__present"],
+                                   m.get("similarity", "cosine"))
+                   for f, m in meta.get("vector", {}).items()}
         doc_lens = {k[len("dl__"):]: arrays[k] for k in arrays.files if k.startswith("dl__")}
         seg = cls(meta["name"], meta["ndocs"], postings, numeric, keyword, geo, doc_lens,
                   {f: TextFieldStats(dc, sd) for f, (dc, sd) in meta["text_stats"].items()},
-                  ids, sources, seq_nos=arrays["seq_nos"])
+                  ids, sources, seq_nos=arrays["seq_nos"], vector_cols=vectors)
         seg.live = arrays["live"].copy()
         seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
         return seg
@@ -431,6 +473,7 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
     num_fields = {f for pd in parsed_docs for f in pd.numerics}
     kw_fields = {f for pd in parsed_docs for f in pd.keywords}
     geo_fields = {f for pd in parsed_docs for f in pd.geos}
+    vec_fields = {f for pd in parsed_docs for f in pd.vectors}
 
     for fname in num_fields:
         ft = mappings.resolve_field(fname)
@@ -479,6 +522,23 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                 present[doc_i] = True
         geo_cols[fname] = GeoColumn(fname, lat, lon, present)
 
+    vector_cols: Dict[str, VectorColumn] = {}
+    for fname in vec_fields:
+        ft = mappings.resolve_field(fname)
+        dims = next(len(pd.vectors[fname]) for pd in parsed_docs
+                    if fname in pd.vectors)
+        values = np.zeros((ndocs, dims), np.float32)
+        present = np.zeros(ndocs, bool)
+        for doc_i, pd in enumerate(parsed_docs):
+            vec = pd.vectors.get(fname)
+            if vec is not None:
+                values[doc_i] = vec
+                present[doc_i] = True
+        vector_cols[fname] = VectorColumn(
+            fname, values, present,
+            ft.vector_similarity if ft is not None else "cosine")
+
     seq = np.asarray(seq_nos, dtype=np.int64) if seq_nos is not None else None
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
-                   doc_lens, text_stats, ids, sources, seq_nos=seq)
+                   doc_lens, text_stats, ids, sources, seq_nos=seq,
+                   vector_cols=vector_cols)

@@ -219,6 +219,16 @@ class LFuncScore(LNode):
 
 
 @dataclass
+class LKnn(LNode):
+    field: str = ""
+    vector: Optional[np.ndarray] = None
+    k: int = 10
+    filter: Optional[LNode] = None
+    similarity: str = "cosine"
+    boost: float = 1.0
+
+
+@dataclass
 class LGeoDist(LNode):
     field: str = ""
     lat: float = 0.0
@@ -441,6 +451,16 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
 
     if isinstance(q, (dsl.QueryStringQuery, dsl.SimpleQueryStringQuery)):
         return _rewrite_query_string(q, ctx, scoring)
+
+    if isinstance(q, dsl.KnnQuery):
+        ft = m.resolve_field(q.field)
+        sim = ft.vector_similarity if ft is not None else "cosine"
+        vec = np.asarray(q.vector, np.float32)
+        if sim == "cosine":
+            vec = vec / max(float(np.linalg.norm(vec)), 1e-12)
+        return LKnn(field=q.field, vector=vec, k=q.k,
+                    filter=rewrite(q.filter, ctx, False) if q.filter else None,
+                    similarity=sim, boost=q.boost)
 
     if isinstance(q, dsl.GeoDistanceQuery):
         return LGeoDist(field=q.field, lat=q.lat, lon=q.lon, radius_m=q.distance_m,
@@ -774,6 +794,19 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("fnscore", nid, child_spec, tuple(fn_specs),
                 node.score_mode, node.boost_mode)
 
+    if isinstance(node, LKnn):
+        col_exists = node.field in seg.vector_cols
+        if col_exists:
+            dims = seg.vector_cols[node.field].values.shape[1]
+            dpad = ((dims + 127) // 128) * 128
+            v = np.zeros(dpad, np.float32)
+            v[:dims] = node.vector[:dims]
+            _p(params, f"q{nid}_vec", v)
+            _scalar_f32(params, f"q{nid}_qsq", float(np.dot(node.vector, node.vector)))
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        fspec = prepare(node.filter, seg, ctx, params) if node.filter else None
+        return ("knn", nid, node.field, col_exists, node.similarity, fspec)
+
     if isinstance(node, LGeoDist):
         _scalar_f32(params, f"q{nid}_lat", node.lat)
         _scalar_f32(params, f"q{nid}_lon", node.lon)
@@ -1014,6 +1047,30 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         matched = child.matched & (scores >= params[f"q{nid}_minscore"])
         scores = jnp.where(matched, scores, 0.0)
         return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "knn":
+        _, _, field, col_exists, simkind, fspec = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        vc = seg_arrays["vector"][field]
+        # one MXU matvec per segment: exact brute-force kNN (the reference
+        # k-NN plugin approximates with HNSW; at HBM bandwidth the dense
+        # scan is the TPU-native answer)
+        raw = jnp.dot(vc["mat"], params[f"q{nid}_vec"],
+                      preferred_element_type=jnp.float32)
+        if simkind == "cosine":
+            score = (1.0 + raw) / 2.0
+        elif simkind in ("dot_product", "innerproduct"):
+            score = jnp.where(raw > 0, raw + 1.0, 1.0 / (1.0 - raw))
+        else:  # l2_norm
+            sq = jnp.sum(vc["mat"] * vc["mat"], axis=1)
+            d2 = jnp.maximum(sq + params[f"q{nid}_qsq"] - 2.0 * raw, 0.0)
+            score = 1.0 / (1.0 + d2)
+        matched = vc["present"] & (live > 0)
+        if fspec is not None:
+            matched = matched & emit(fspec, seg_arrays, params).matched
+        score = jnp.where(matched, score * params[f"q{nid}_boost"], 0.0)
+        return ops.ScoredMask(score, matched.astype(jnp.float32))
 
     if kind == "geodist":
         _, _, field, col_exists = spec

@@ -100,7 +100,20 @@ class ShardSearcher:
         segments = segments if segments is not None else list(self.engine.segments)
         ctx = stats_ctx or C.ShardContext(self.engine.mappings, segments,
                                           self.similarity, self.field_similarities)
-        query = dsl.parse_query(body.get("query"))
+        query = dsl.parse_query(body.get("query")) if (body.get("query")
+                                                        or "knn" not in body) else None
+        knn_spec = body.get("knn")
+        if knn_spec is not None:
+            # ES-style top-level knn: {"field", "query_vector", "k", "filter"}
+            kq = dsl.KnnQuery(field=knn_spec["field"],
+                              vector=list(knn_spec.get("query_vector",
+                                                       knn_spec.get("vector", []))),
+                              k=int(knn_spec.get("k", 10)),
+                              filter=(dsl.parse_query(knn_spec["filter"])
+                                      if knn_spec.get("filter") else None),
+                              boost=float(knn_spec.get("boost", 1.0)))
+            query = dsl.BoolQuery(should=[query, kq], minimum_should_match="1") \
+                if query is not None else kq
         lroot = C.rewrite(query, ctx, scoring=True)
 
         size = int(body.get("size", 10))

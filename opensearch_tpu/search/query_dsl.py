@@ -203,6 +203,14 @@ class FunctionScoreQuery(Query):
 
 
 @dataclass
+class KnnQuery(Query):
+    field: str = ""
+    vector: List[float] = dc_field(default_factory=list)
+    k: int = 10
+    filter: Optional[Query] = None
+
+
+@dataclass
 class NestedQuery(Query):
     path: str = ""
     query: Optional[Query] = None
@@ -408,6 +416,16 @@ def parse_query(dsl: Optional[dict]) -> Query:
                                boost_mode=body.get("boost_mode", "multiply"),
                                min_score=body.get("min_score"))
         _common(q, body)
+        return q
+
+    if kind == "knn":
+        # OpenSearch k-NN plugin form: {"knn": {"fieldname": {"vector": [...],
+        # "k": 10, "filter": {...}}}}
+        f, spec = _one_entry(body, "knn")
+        q = KnnQuery(field=f, vector=list(spec["vector"]),
+                     k=int(spec.get("k", 10)),
+                     filter=parse_query(spec["filter"]) if spec.get("filter") else None)
+        _common(q, spec)
         return q
 
     if kind == "nested":

@@ -1,0 +1,105 @@
+"""Dense-vector kNN search: brute-force exact on the MXU (reference: k-NN
+plugin, which approximates with HNSW)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.search.executor import ShardSearcher, search_shards
+
+MAPPING = {"properties": {"vec": {"type": "dense_vector", "dims": 4,
+                                  "similarity": "cosine"},
+                          "cat": {"type": "keyword"}}}
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    e = Engine(Mappings(MAPPING))
+    vecs = {"1": [1, 0, 0, 0], "2": [0.9, 0.1, 0, 0], "3": [0, 1, 0, 0],
+            "4": [0, 0, 1, 0], "5": [-1, 0, 0, 0]}
+    for did, v in vecs.items():
+        e.index_doc(did, {"vec": v, "cat": "odd" if int(did) % 2 else "even"})
+    e.refresh()
+    return ShardSearcher(e)
+
+
+def ids(r):
+    return [h["_id"] for h in r["hits"]["hits"]]
+
+
+def test_knn_query_cosine_order(searcher):
+    r = search_shards([searcher], {"query": {"knn": {"vec": {
+        "vector": [1, 0, 0, 0], "k": 3}}}, "size": 3}, "v")
+    assert ids(r) == ["1", "2", "3"] or ids(r)[:2] == ["1", "2"]
+    s = [h["_score"] for h in r["hits"]["hits"]]
+    assert s[0] == pytest.approx(1.0, abs=1e-5)          # identical vector
+    assert s == sorted(s, reverse=True)
+
+
+def test_knn_exact_scores(searcher):
+    r = search_shards([searcher], {"query": {"knn": {"vec": {
+        "vector": [1, 0, 0, 0], "k": 5}}}, "size": 5}, "v")
+    by_id = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    q = np.array([1, 0, 0, 0], float)
+    for did, v in {"1": [1, 0, 0, 0], "3": [0, 1, 0, 0], "5": [-1, 0, 0, 0]}.items():
+        vv = np.array(v, float)
+        cos = q @ vv / (np.linalg.norm(q) * np.linalg.norm(vv))
+        assert by_id[did] == pytest.approx((1 + cos) / 2, abs=1e-5)
+
+
+def test_knn_with_filter(searcher):
+    r = search_shards([searcher], {"query": {"knn": {"vec": {
+        "vector": [1, 0, 0, 0], "k": 5,
+        "filter": {"term": {"cat": "odd"}}}}}, "size": 5}, "v")
+    assert set(ids(r)) == {"1", "3", "5"}
+    assert ids(r)[0] == "1"
+
+
+def test_top_level_knn_body(searcher):
+    r = search_shards([searcher], {"knn": {"field": "vec",
+                                           "query_vector": [0, 0, 1, 0],
+                                           "k": 2}, "size": 2}, "v")
+    assert ids(r)[0] == "4"
+
+
+def test_knn_in_bool(searcher):
+    r = search_shards([searcher], {"query": {"bool": {
+        "must": [{"knn": {"vec": {"vector": [1, 0, 0, 0], "k": 5}}}],
+        "filter": [{"term": {"cat": "even"}}]}}, "size": 5}, "v")
+    assert set(ids(r)) == {"2", "4"}
+
+
+def test_knn_l2():
+    m = Mappings({"properties": {"v": {"type": "dense_vector", "dims": 2,
+                                       "similarity": "l2_norm"}}})
+    e = Engine(m)
+    e.index_doc("a", {"v": [0.0, 0.0]})
+    e.index_doc("b", {"v": [3.0, 4.0]})
+    e.refresh()
+    r = search_shards([ShardSearcher(e)], {"query": {"knn": {"v": {
+        "vector": [0.0, 0.0], "k": 2}}}}, "v")
+    by_id = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert by_id["a"] == pytest.approx(1.0)
+    assert by_id["b"] == pytest.approx(1.0 / 26.0, rel=1e-4)  # 1/(1+25)
+
+
+def test_knn_survives_merge_and_reload(tmp_path):
+    e = Engine(Mappings(MAPPING), path=str(tmp_path / "idx"))
+    e.index_doc("1", {"vec": [1, 0, 0, 0]})
+    e.refresh()
+    e.index_doc("2", {"vec": [0, 1, 0, 0]})
+    e.refresh()
+    e.force_merge(1)
+    e.flush()
+    e.close()
+    e2 = Engine(Mappings(MAPPING), path=str(tmp_path / "idx"))
+    r = search_shards([ShardSearcher(e2)], {"query": {"knn": {"vec": {
+        "vector": [1, 0, 0, 0], "k": 2}}}}, "v")
+    assert [h["_id"] for h in r["hits"]["hits"]][0] == "1"
+
+
+def test_vector_dims_validation():
+    m = Mappings(MAPPING)
+    with pytest.raises(ValueError, match="differs from mapped dims"):
+        m.parse("1", {"vec": [1, 2]})
