@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-F32_MAX = jnp.float32(3.4e38)
+F32_MAX = np.float32(3.4e38)  # numpy, not jnp (see ops/scoring.NEG_INF note)
 
 
 def _gather_match(match: jnp.ndarray, docs: jnp.ndarray) -> jnp.ndarray:

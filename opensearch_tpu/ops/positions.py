@@ -1,0 +1,157 @@
+"""Device-side positional joins: phrase / span-near matching on the TPU.
+
+Replaces Lucene's ExactPhraseMatcher / SloppyPhraseMatcher doc-at-a-time
+position merging (reference: `search/` via Lucene PhraseQuery,
+SpanNearQuery) with a fully vectorized formulation:
+
+- Each query term i carries a flat, lexicographically sorted array of
+  (doc_id, position - i) pairs for the whole segment (built on the host from
+  the CSR positional postings; padded to pow2 with an INT32_MAX sentinel).
+- Term 0's pairs are the *candidate anchors*. For every anchor (d, base) we
+  binary-search each other term's array for the nearest adjusted position in
+  the same doc; the per-term displacement |p_adj - base| is that term's move
+  cost. A phrase occurrence exists when every term occurs in the doc and the
+  total move cost <= slop (exact phrase: slop 0 forces full adjacency).
+- The per-anchor weight 1/(1+cost) is Lucene's sloppyFreq; scatter-adding it
+  per doc yields the phrase frequency that feeds the normal BM25 tf curve.
+
+Everything is static-shaped: the binary search is a statically unrolled
+log2(N) loop of gathers (compare on (doc, pos) i32 pairs — no 64-bit keys
+needed), so one XLA program serves all phrase queries with equal bucket
+shapes.
+
+Semantics note (documented deviation): Lucene's SloppyPhraseMatcher computes
+the minimal *total* movement over a simultaneous alignment, with repeats
+handled via restarts. The per-term nearest-position relaxation here equals it
+whenever terms don't compete for the same position (the overwhelmingly common
+case) and is otherwise a superset that still respects the total-slop bound
+per anchor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+INT32_SENTINEL = np.int32(2**31 - 1)
+# plain numpy scalar, NOT jnp: a module-level jax.Array would be captured as
+# a device-resident trace constant, which the jit fast path can hoist into an
+# extra executable parameter and then under-supply buffers on cache hits
+BIG_COST = np.float32(1e9)
+
+
+def pair_searchsorted(dA: jnp.ndarray, pA: jnp.ndarray,
+                      dq: jnp.ndarray, pq: jnp.ndarray) -> jnp.ndarray:
+    """Index of the first element of the lex-sorted pair array (dA, pA) that
+    is >= (dq, pq), vectorized over queries. Statically unrolled binary
+    search: log2(N)+1 rounds of 2 gathers each."""
+    n = dA.shape[0]
+    lo = jnp.zeros(dq.shape, jnp.int32)
+    hi = jnp.full(dq.shape, n, jnp.int32)
+    for _ in range(int(n).bit_length()):
+        mid = (lo + hi) >> 1
+        m = jnp.minimum(mid, n - 1)
+        dm = dA[m]
+        pm = pA[m]
+        less = (dm < dq) | ((dm == dq) & (pm < pq))
+        lo = jnp.where(less, mid + 1, lo)
+        hi = jnp.where(less, hi, mid)
+    return lo
+
+
+def nearest_delta(dA: jnp.ndarray, pA: jnp.ndarray,
+                  d0: jnp.ndarray, base: jnp.ndarray):
+    """Signed displacement (adjusted position - base) of the term occurrence
+    nearest to the anchor within the anchor's doc, and a found flag."""
+    n = dA.shape[0]
+    idx = pair_searchsorted(dA, pA, d0, base)
+    ridx = jnp.minimum(idx, n - 1)
+    right_ok = (idx < n) & (dA[ridx] == d0)
+    right_delta = (pA[ridx] - base).astype(jnp.float32)
+    right_cost = jnp.where(right_ok, right_delta, BIG_COST)
+    lidx = jnp.maximum(idx - 1, 0)
+    left_ok = (idx > 0) & (dA[lidx] == d0)
+    left_delta = (pA[lidx] - base).astype(jnp.float32)
+    left_cost = jnp.where(left_ok, -left_delta, BIG_COST)
+    delta = jnp.where(right_cost <= left_cost, right_delta, left_delta)
+    return delta, right_ok | left_ok
+
+
+def phrase_freqs(anchor_d: jnp.ndarray, anchor_p: jnp.ndarray,
+                 others: List[Tuple[jnp.ndarray, jnp.ndarray]],
+                 slop: jnp.ndarray, ndocs_pad: int,
+                 ordered: bool = False, gap_cost: bool = False) -> jnp.ndarray:
+    """Dense per-doc sloppy phrase frequency f32[ndocs_pad].
+
+    anchor_d/anchor_p: term 0's (doc, adjusted position) pairs (sentinel
+    padded). others: the remaining terms' sorted pair arrays.
+
+    Cost of an occurrence, compared against `slop`:
+    - default (match_phrase slop): total movement against the OPTIMAL common
+      offset, min_s Σ|delta_i - s| — attained at the median of the per-term
+      deltas — matching Lucene SloppyPhraseMatcher's "total movement" slop
+      (all terms may move, e.g. `quick and nimble brown fox` vs `quick brown
+      fox` costs 2, not 4, because brown+fox stay put and quick moves).
+    - gap_cost=True (span_near slop / intervals max_gaps): positions inside
+      the matched span not covered by a query term (span_width - m) — so an
+      adjacent transposition costs 0 gaps but 2 moves.
+
+    `ordered` (span_near in_order / intervals ordered) switches to a greedy
+    sequential join: term i takes its EARLIEST adjusted position >= term
+    i-1's (pos_i > pos_{i-1} in absolute terms). Greedy-earliest is exact for
+    ordered existence anchored at each term-0 occurrence, and the resulting
+    gap count is simply the last delta. Ordered implies gap cost (both its
+    callers are span-family queries)."""
+    ok = anchor_d != INT32_SENTINEL
+    m = len(others) + 1
+    if ordered:
+        prev = jnp.zeros(anchor_p.shape, jnp.int32)  # delta_0 = 0
+        for dA, pA in others:
+            n = dA.shape[0]
+            idx = pair_searchsorted(dA, pA, anchor_d, anchor_p + prev)
+            safe = jnp.minimum(idx, n - 1)
+            found = (idx < n) & (dA[safe] == anchor_d)
+            prev = pA[safe] - anchor_p
+            ok = ok & found
+        cost = prev.astype(jnp.float32)  # = pos_last - pos_0 + 1 - m = gaps
+    elif m > 1:
+        deltas = [jnp.zeros(anchor_d.shape, jnp.float32)]
+        for dA, pA in others:
+            di, found = nearest_delta(dA, pA, anchor_d, anchor_p)
+            ok = ok & found
+            deltas.append(di)
+        if gap_cost:
+            # unordered gaps: span width over nearest-per-term choices — a
+            # superset-leaning heuristic (exact when terms don't compete)
+            abs_off = [di + jnp.float32(i) for i, di in enumerate(deltas)]
+            span_hi = abs_off[0]
+            span_lo = abs_off[0]
+            for a in abs_off[1:]:
+                span_hi = jnp.maximum(span_hi, a)
+                span_lo = jnp.minimum(span_lo, a)
+            cost = span_hi - span_lo + 1.0 - jnp.float32(m)
+        else:
+            stacked = jnp.sort(jnp.stack(deltas, axis=0), axis=0)
+            med = stacked[m // 2]
+            cost = jnp.zeros(anchor_d.shape, jnp.float32)
+            for di in deltas:
+                cost = cost + jnp.abs(di - med)
+    else:
+        cost = jnp.zeros(anchor_d.shape, jnp.float32)
+    ok = ok & (cost <= slop)
+    w = jnp.where(ok, 1.0 / (1.0 + cost), 0.0)  # Lucene sloppyFreq
+    return jnp.zeros(ndocs_pad, jnp.float32).at[anchor_d].add(w, mode="drop")
+
+
+def phrase_score(freq: jnp.ndarray, dl: jnp.ndarray, live: jnp.ndarray,
+                 weight: jnp.ndarray, k1: float, b: float,
+                 avgdl: jnp.ndarray):
+    """BM25 over the phrase frequency: weight = sum of the terms' idf*boost
+    (Lucene PhraseWeight scores the phrase as one pseudo-term)."""
+    k = k1 * (1.0 - b + b * dl / avgdl)
+    scores = weight * freq / (freq + k)
+    matched = (freq > 0) & (live > 0)
+    return jnp.where(matched, scores, 0.0), matched

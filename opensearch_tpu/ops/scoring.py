@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-NEG_INF = jnp.float32(-jnp.inf)
+NEG_INF = np.float32(-np.inf)  # numpy, not jnp: a module-level jax.Array
+# becomes a device-resident trace constant that the jit fast path can hoist
+# into an extra executable parameter (buffer-count mismatch on cache hits)
 
 # similarity ids (static switch inside traced code)
 SIM_BM25 = 0

@@ -144,6 +144,26 @@ class LExpandTerms(LNode):
 
 
 @dataclass
+class LPhrase(LNode):
+    """Positional phrase/span-near: device pair-join over positional postings
+    (ops/positions.py). `weight` is the summed idf*boost of the terms (Lucene
+    PhraseWeight convention); the last term may expand by prefix
+    (match_phrase_prefix)."""
+
+    field: str = ""
+    terms: List[str] = dc_field(default_factory=list)
+    slop: int = 0
+    weight: float = 0.0
+    sim: Optional[Similarity] = None
+    has_norms: bool = True
+    prefix_last: bool = False
+    max_expansions: int = 50
+    ordered: bool = False              # span_near in_order / intervals ordered
+    gap_cost: bool = False             # intervals max_gaps (span gaps, not moves)
+    boost: float = 1.0
+
+
+@dataclass
 class LMatchAll(LNode):
     boost: float = 1.0
 
@@ -274,6 +294,50 @@ def _weighted_terms(field: str, terms: List[str], boosts: List[float],
                   mode=mode, sim=sim, has_norms=has_norms, boost=boost)
 
 
+def _prefix_rows(pb, term: str, cap: Optional[int] = None) -> range:
+    """Vocab row range whose terms start with `term`, optionally capped at
+    `cap` expansions (reference MultiTermQuery maxExpansions)."""
+    lo = bisect_left(pb.vocab, term)
+    hi = bisect_left(pb.vocab, term + "￿")
+    if cap is not None:
+        hi = min(hi, lo + cap)
+    return range(lo, hi)
+
+
+def _phrase_node(field: str, terms: List[str], slop: int, ctx: ShardContext,
+                 boost: float, prefix_last: bool = False,
+                 max_expansions: int = 50, ordered: bool = False,
+                 gap_cost: bool = False) -> LPhrase:
+    """Phrase weight = sum of per-term idf (Lucene PhraseWeight: the phrase
+    scores as one pseudo-term whose idf is the terms' idf sum)."""
+    ft = ctx.mappings.resolve_field(field)
+    sim = ctx.sim_for(field)
+    has_norms = bool(ft is not None and ft.has_norms and sim.uses_norms)
+    n = ctx.num_docs
+    w = 0.0
+    last = len(terms) - 1
+    for i, t in enumerate(terms):
+        if prefix_last and i == last:
+            # expansion union df (capped) stands in for the prefix "term"
+            df = 0
+            for s in ctx.segments:
+                pb = s.postings.get(field)
+                if pb is None:
+                    continue
+                for r in _prefix_rows(pb, t, max_expansions):
+                    df += int(pb.starts[r + 1] - pb.starts[r])
+        else:
+            df = ctx.doc_freq(field, t)
+        if df > 0:
+            # prefix-union df can exceed maxDoc; Lucene never sees df > N
+            # (negative idf would break ranking invariants)
+            w += sim.term_weight(1.0, n, min(df, n))
+    return LPhrase(field=field, terms=terms, slop=slop, weight=w * boost,
+                   sim=sim, has_norms=has_norms, prefix_last=prefix_last,
+                   max_expansions=max_expansions, ordered=ordered,
+                   gap_cost=gap_cost, boost=boost)
+
+
 def _analyze_query_text(field: str, text: Any, ctx: ShardContext,
                         analyzer_override: Optional[str] = None) -> List[str]:
     ft = ctx.mappings.resolve_field(field)
@@ -355,28 +419,79 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         return _weighted_terms(field, terms, [1.0] * len(terms), ctx, msm, mode, q.boost)
 
     if isinstance(q, dsl.MultiMatchQuery):
-        children = [rewrite(dsl.MatchQuery(field=f.split("^")[0], query=q.query,
-                                           operator=q.operator,
-                                           minimum_should_match=q.minimum_should_match,
-                                           boost=float(f.split("^")[1]) if "^" in f else 1.0),
-                    ctx, scoring) for f in q.fields]
-        if q.type in ("best_fields", "phrase"):
+        if q.type in ("phrase", "phrase_prefix"):
+            children = [rewrite(dsl.MatchPhraseQuery(
+                            field=f.split("^")[0], query=q.query,
+                            prefix=q.type == "phrase_prefix",
+                            boost=float(f.split("^")[1]) if "^" in f else 1.0),
+                        ctx, scoring) for f in q.fields]
+        else:
+            children = [rewrite(dsl.MatchQuery(field=f.split("^")[0], query=q.query,
+                                               operator=q.operator,
+                                               minimum_should_match=q.minimum_should_match,
+                                               boost=float(f.split("^")[1]) if "^" in f else 1.0),
+                        ctx, scoring) for f in q.fields]
+        if q.type in ("best_fields", "phrase", "phrase_prefix"):
             return LDisMax(children=children, tie_breaker=q.tie_breaker, boost=q.boost)
         return LBool(shoulds=children, msm=1, boost=q.boost)  # most_fields
 
     if isinstance(q, dsl.MatchPhraseQuery):
-        # r1: phrase == AND-match + host positional verification in the fetch
-        # window (exact device phrase join lands with positional postings, r2)
-        field = q.field
+        ft = m.resolve_field(q.field)
+        field = ft.name if ft else q.field
         terms = _analyze_query_text(field, q.query, ctx, q.analyzer)
         if not terms:
             return LMatchNone()
-        node = _weighted_terms(field, terms, [1.0] * len(terms), ctx, len(terms),
-                               "score", q.boost)
-        node.name = node.name or None
-        node._phrase_terms = terms  # host verify hook
-        node._phrase_slop = q.slop
-        return node
+        if len(terms) == 1 and not q.prefix:
+            # Lucene rewrites a single-term phrase to a TermQuery
+            return _weighted_terms(field, terms, [1.0], ctx, 1, "score", q.boost)
+        if len(terms) == 1 and q.prefix:
+            return LExpandTerms(field=field,
+                                expander=_prefix_expander(field, terms[0], False,
+                                                          cap=q.max_expansions),
+                                boost=q.boost)
+        return _phrase_node(field, terms, q.slop, ctx, q.boost,
+                            prefix_last=q.prefix, max_expansions=q.max_expansions)
+
+    if isinstance(q, dsl.SpanTermQuery):
+        field = q.field
+        term = _index_term(field, q.value, ctx)
+        return _weighted_terms(field, [term], [1.0], ctx, 1, "score", q.boost)
+
+    if isinstance(q, dsl.SpanNearQuery):
+        flat_terms: List[str] = []
+        field = None
+        for c in q.clauses:
+            if not isinstance(c, dsl.SpanTermQuery):
+                raise dsl.QueryParseError(
+                    "[span_near] only span_term clauses are supported")
+            if field is None:
+                field = c.field
+            elif field != c.field:
+                raise dsl.QueryParseError("[span_near] clauses must share a field")
+            flat_terms.append(_index_term(c.field, c.value, ctx))
+        if not flat_terms or field is None:
+            return LMatchNone()
+        if len(flat_terms) == 1:
+            return _weighted_terms(field, flat_terms, [1.0], ctx, 1, "score", q.boost)
+        # Lucene SpanNearQuery slop counts intervening unmatched positions
+        # (gaps), not term movement
+        return _phrase_node(field, flat_terms, q.slop, ctx, q.boost,
+                            ordered=q.in_order, gap_cost=True)
+
+    if isinstance(q, dsl.IntervalsQuery):
+        ft = m.resolve_field(q.field)
+        field = ft.name if ft else q.field
+        terms = _analyze_query_text(field, q.query, ctx, q.analyzer)
+        if not terms:
+            return LMatchNone()
+        if len(terms) == 1:
+            return _weighted_terms(field, terms, [1.0], ctx, 1, "score", q.boost)
+        # max_gaps=-1 means unbounded; bound by a large window (the device
+        # join needs a finite slop). For ordered matches the median-centered
+        # movement cost equals the total gap count, so max_gaps maps 1:1.
+        slop = q.max_gaps if q.max_gaps >= 0 else 1 << 20
+        return _phrase_node(field, terms, slop, ctx, q.boost, ordered=q.ordered,
+                            gap_cost=True)
 
     if isinstance(q, dsl.BoolQuery):
         musts = [rewrite(c, ctx, scoring) for c in q.must]
@@ -532,17 +647,17 @@ def _rewrite_query_string(q, ctx: ShardContext, scoring: bool) -> LNode:
 
 # ---------------- multi-term expanders (host, per segment vocab) ----------------
 
-def _prefix_expander(field: str, prefix: str, ci: bool):
+def _prefix_expander(field: str, prefix: str, ci: bool, cap: Optional[int] = None):
     def expand(seg: Segment) -> np.ndarray:
         pb = seg.postings.get(field)
         if pb is None:
             return np.empty(0, np.int32)
         if ci:
             rows = [i for i, t in enumerate(pb.vocab) if t.lower().startswith(prefix.lower())]
+            rows = rows[:cap] if cap is not None else rows
             return np.asarray(rows, np.int32)
-        lo = bisect_left(pb.vocab, prefix)
-        hi = bisect_left(pb.vocab, prefix + "￿")
-        return np.arange(lo, hi, dtype=np.int32)
+        r = _prefix_rows(pb, prefix, cap)
+        return np.arange(r.start, r.stop, dtype=np.int32)
     return expand
 
 
@@ -663,6 +778,39 @@ def _i64_bounds(params, nid: int, lo, hi) -> Tuple[str, str, str, str]:
             _p(params, f"q{nid}_hihi", hi_hi[0]), _p(params, f"q{nid}_hilo", hi_lo[0]))
 
 
+def _phrase_pairs(seg: Segment, pb, rows: Tuple[int, ...]):
+    """Unshifted (doc, position) pairs for a term's postings (union over
+    `rows` for prefix expansion), lex-sorted; cached per segment and shared
+    across query positions (the caller subtracts the phrase offset when
+    padding — a constant shift keeps lex order)."""
+    cache = getattr(seg, "_phrase_pair_cache", None)
+    if cache is None:
+        cache = seg._phrase_pair_cache = {}
+    key = (pb.field, rows)
+    if key in cache:
+        return cache[key]
+    docs_parts, pos_parts = [], []
+    for r in rows:
+        a, b = pb.row_slice(r)
+        counts = pb.pos_starts[a + 1: b + 1] - pb.pos_starts[a: b]
+        docs_parts.append(np.repeat(pb.doc_ids[a:b], counts))
+        pos_parts.append(pb.positions[pb.pos_starts[a]: pb.pos_starts[b]])
+    d = np.concatenate(docs_parts) if docs_parts else np.empty(0, np.int32)
+    p = np.concatenate(pos_parts) if pos_parts else np.empty(0, np.int32)
+    if len(rows) > 1 and len(d):
+        order = np.lexsort((p, d))
+        d, p = d[order], p[order]
+    res = (d.astype(np.int32), p.astype(np.int32))
+    cache[key] = res
+    return res
+
+
+def _pad_to_sentinel(arr: np.ndarray, size: int) -> np.ndarray:
+    out = np.full(size, INT32_SENTINEL, dtype=np.int32)
+    out[: len(arr)] = arr
+    return out
+
+
 def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa: C901
     """-> hashable spec tree; fills `params` with this segment's arrays."""
     nid = node.nid
@@ -694,6 +842,36 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         b_eff = sim.b if node.has_norms else 0.0
         return ("terms", nid, node.field, T_pad, bucket, sim.sim_id,
                 float(sim.k1), float(b_eff), node.mode)
+
+    if isinstance(node, LPhrase):
+        pb = seg.postings.get(node.field)
+        if pb is None or pb.pos_starts is None:
+            return ("match_none", nid)
+        m_terms = len(node.terms)
+        last = m_terms - 1
+        arrays = []
+        for i, t in enumerate(node.terms):
+            if node.prefix_last and i == last:
+                rows = list(_prefix_rows(pb, t, node.max_expansions))
+            else:
+                r = pb.row(t)
+                rows = [r] if r >= 0 else []
+            if not rows:
+                return ("match_none", nid)  # phrase needs every term
+            arrays.append(_phrase_pairs(seg, pb, tuple(rows)))
+        buckets = []
+        for i, (d, p) in enumerate(arrays):
+            bucket = next_pow2(max(len(d), 1), floor=8)
+            _p(params, f"q{nid}_d{i}", _pad_to_sentinel(d, bucket))
+            _p(params, f"q{nid}_p{i}", _pad_to_sentinel(p - i, bucket))
+            buckets.append(bucket)
+        sim = node.sim
+        b_eff = sim.b if node.has_norms else 0.0
+        _scalar_f32(params, f"q{nid}_w", node.weight)
+        _scalar_f32(params, f"q{nid}_slop", node.slop)
+        _scalar_f32(params, f"q{nid}_avgdl", ctx.avgdl(node.field))
+        return ("phrase", nid, node.field, m_terms, tuple(buckets),
+                float(sim.k1), float(b_eff), node.ordered, node.gap_cost)
 
     if isinstance(node, LExpandTerms):
         rows_np = node.expander(seg)
@@ -834,6 +1012,18 @@ def can_match(node: LNode, seg: Segment) -> bool:
         if node.msm >= len(node.terms):
             return all(pb.row(t) >= 0 for t in node.terms)
         return any(pb.row(t) >= 0 for t in node.terms)
+    if isinstance(node, LPhrase):
+        pb = seg.postings.get(node.field)
+        if pb is None or pb.pos_starts is None:
+            return False
+        last = len(node.terms) - 1
+        for i, t in enumerate(node.terms):
+            if node.prefix_last and i == last:
+                if not _prefix_rows(pb, t, node.max_expansions):
+                    return False
+            elif pb.row(t) < 0:
+                return False
+        return True
     if isinstance(node, LRange):
         col = seg.numeric_cols.get(node.field)
         if col is None:
@@ -899,6 +1089,22 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         ok = sm.count >= msm
         return ops.ScoredMask(jnp.where(ok, sm.scores, 0.0),
                               jnp.where(ok, sm.count, 0.0))
+
+    if kind == "phrase":
+        from ..ops import positions as pos_ops
+
+        _, _, field, m_terms, buckets, k1, b, ordered, gap_cost = spec
+        dl = seg_arrays["doc_lens"].get(field, zeros)
+        anchor_d = params[f"q{nid}_d0"]
+        anchor_p = params[f"q{nid}_p0"]
+        others = [(params[f"q{nid}_d{i}"], params[f"q{nid}_p{i}"])
+                  for i in range(1, m_terms)]
+        freq = pos_ops.phrase_freqs(anchor_d, anchor_p, others,
+                                    params[f"q{nid}_slop"], ndocs_pad,
+                                    ordered=ordered, gap_cost=gap_cost)
+        scores, matched = pos_ops.phrase_score(freq, dl, live, params[f"q{nid}_w"],
+                                               k1, b, params[f"q{nid}_avgdl"])
+        return ops.ScoredMask(scores, matched.astype(jnp.float32))
 
     if kind == "xterms":
         _, _, field, T_pad, bucket = spec

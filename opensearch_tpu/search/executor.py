@@ -132,7 +132,6 @@ class ShardSearcher:
         search_after = body.get("search_after")
 
         result = ShardQueryResult(shard=shard_ord, segments=segments)
-        phrase_checks = _collect_phrases(lroot)
 
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
@@ -189,9 +188,6 @@ class ShardSearcher:
                     continue
                 sc = float(scores[j])
                 if min_score is not None and not is_field_sort and sc < min_score:
-                    continue
-                if phrase_checks and not _verify_phrases(phrase_checks, seg, d):
-                    result.total -= 1
                     continue
                 sort_vals, raw_vals = _host_sort_values(sort_specs, seg, d, sc)
                 cand = Candidate(shard_ord, seg_ord, d, sc, sort_vals, raw_vals)
@@ -447,67 +443,6 @@ def _collect_named(lroot) -> List[Tuple[str, Any]]:
 
     walk(lroot)
     return out
-
-
-def _collect_phrases(lroot) -> List[Any]:
-    out = []
-
-    def walk(n):
-        if n is None:
-            return
-        if getattr(n, "_phrase_terms", None):
-            out.append(n)
-        for attr in ("musts", "shoulds", "must_nots", "filters", "children"):
-            for c in getattr(n, attr, []) or []:
-                walk(c)
-        for attr in ("child", "positive", "negative"):
-            walk(getattr(n, attr, None))
-
-    walk(lroot)
-    return out
-
-
-def _verify_phrases(phrase_nodes: List[Any], seg: Segment, doc: int) -> bool:
-    """Host positional verification of phrase candidates (r1; device phrase
-    join lands in r2 — see SURVEY §2.4)."""
-    for node in phrase_nodes:
-        pb = seg.postings.get(node.field)
-        if pb is None or pb.pos_starts is None:
-            continue
-        pos_lists = []
-        for t in node._phrase_terms:
-            r = pb.row(t)
-            if r < 0:
-                return False
-            a, b = pb.row_slice(r)
-            k = a + int(np.searchsorted(pb.doc_ids[a:b], doc))
-            if k >= b or pb.doc_ids[k] != doc:
-                return False
-            pos_lists.append(pb.positions[pb.pos_starts[k]: pb.pos_starts[k + 1]])
-        if not _phrase_match(pos_lists, node._phrase_slop):
-            return False
-    return True
-
-
-def _phrase_match(pos_lists: List[np.ndarray], slop: int) -> bool:
-    if any(len(p) == 0 for p in pos_lists):
-        return False
-    if slop == 0:
-        base = set(pos_lists[0])
-        for off, pl in enumerate(pos_lists[1:], 1):
-            base &= {p - off for p in pl}
-            if not base:
-                return False
-        return True
-    # sloppy: minimal span containing one position per term in order tolerance
-    import itertools
-    if np.prod([len(p) for p in pos_lists]) <= 4096:
-        for combo in itertools.product(*[list(p) for p in pos_lists]):
-            adjusted = [p - i for i, p in enumerate(combo)]
-            if max(adjusted) - min(adjusted) <= slop:
-                return True
-        return False
-    return True  # very dense doc: accept (avoid pathological host cost)
 
 
 def _host_sort_values(sort_specs: List[dict], seg: Segment, doc: int,
@@ -836,11 +771,82 @@ def _calendar_bucket_to_epoch_ms(b: int, calendar: str) -> int:
 # explain (host recompute, reference TransportExplainAction)
 # =====================================================================
 
+def _host_phrase_freq(node, seg: Segment, doc: int) -> float:
+    """Host mirror of ops.positions.phrase_freqs for one doc (explain)."""
+    from .compiler import _prefix_rows
+
+    pb = seg.postings.get(node.field)
+    if pb is None or pb.pos_starts is None:
+        return 0.0
+    pos_lists: List[np.ndarray] = []
+    last = len(node.terms) - 1
+    for i, t in enumerate(node.terms):
+        if node.prefix_last and i == last:
+            rows = list(_prefix_rows(pb, t, node.max_expansions))
+        else:
+            r = pb.row(t)
+            rows = [r] if r >= 0 else []
+        plist: List[int] = []
+        for r in rows:
+            a, b = pb.row_slice(r)
+            k = a + int(np.searchsorted(pb.doc_ids[a:b], doc))
+            if k < b and pb.doc_ids[k] == doc:
+                plist.extend((pb.positions[pb.pos_starts[k]: pb.pos_starts[k + 1]]
+                              - i).tolist())
+        if not plist:
+            return 0.0
+        pos_lists.append(np.asarray(sorted(plist)))
+    freq = 0.0
+    for base in pos_lists[0]:
+        ok = True
+        if node.ordered:
+            # greedy sequential join, mirroring the device ordered path
+            prev = 0.0
+            for arr in pos_lists[1:]:
+                j = int(np.searchsorted(arr, base + prev))
+                if j >= len(arr):
+                    ok = False
+                    break
+                prev = float(arr[j]) - float(base)
+            cost = prev if ok else 0.0
+        else:
+            deltas = [0.0]
+            for arr in pos_lists[1:]:
+                j = int(np.searchsorted(arr, base))
+                # tie prefers the right neighbor, like the device kernel
+                cands = [int(arr[jj]) - int(base)
+                         for jj in (j, j - 1) if 0 <= jj < len(arr)]
+                if not cands:
+                    ok = False
+                    break
+                deltas.append(float(min(cands, key=abs)))
+            if ok:
+                if node.gap_cost:
+                    abs_off = [d + i for i, d in enumerate(deltas)]
+                    cost = max(abs_off) - min(abs_off) + 1 - len(deltas)
+                else:
+                    med = sorted(deltas)[len(deltas) // 2]  # optimal offset
+                    cost = sum(abs(d - med) for d in deltas)
+        if ok and cost <= node.slop:
+            freq += 1.0 / (1.0 + cost)
+    return freq
+
 def explain_doc(lroot, seg: Segment, doc: int, ctx) -> dict:
-    from .compiler import LBool, LConstScore, LDisMax, LTerms
+    from .compiler import LBool, LConstScore, LDisMax, LPhrase, LTerms
     from ..ops.scoring import SIM_BM25
 
     def walk(n) -> Tuple[float, dict]:
+        if isinstance(n, LPhrase):
+            freq = _host_phrase_freq(n, seg, doc)
+            dl = float(seg.doc_lens.get(n.field, np.zeros(seg.ndocs))[doc]) \
+                if n.field in seg.doc_lens else 0.0
+            avgdl = max(ctx.avgdl(n.field), 1e-9)
+            b_eff = n.sim.b if n.has_norms else 0.0
+            kk = n.sim.k1 * (1 - b_eff + b_eff * dl / avgdl)
+            total = n.weight * freq / (freq + kk) if freq > 0 else 0.0
+            desc = (f'phrase "{" ".join(n.terms)}" on [{n.field}]: idf-sum*boost '
+                    f'{n.weight:.4f} * sloppyFreq {freq:.3f}/(freq+{kk:.3f})')
+            return total, {"value": total, "description": desc, "details": []}
         if isinstance(n, LTerms):
             details = []
             total = 0.0

@@ -13,8 +13,12 @@ from ..analysis import Analyzer
 def highlight_field(text: str, terms: Set[str], analyzer: Analyzer,
                     pre_tag: str = "<em>", post_tag: str = "</em>",
                     fragment_size: int = 100, number_of_fragments: int = 5) -> List[str]:
+    # terms ending in "*" are prefixes (match_phrase_prefix's last position)
+    exact = {t for t in terms if not t.endswith("*")}
+    prefixes = tuple(t[:-1] for t in terms if t.endswith("*") and len(t) > 1)
     tokens = analyzer.analyze(text)
-    hits = [(t.start_offset, t.end_offset) for t in tokens if t.text in terms]
+    hits = [(t.start_offset, t.end_offset) for t in tokens
+            if t.text in exact or (prefixes and t.text.startswith(prefixes))]
     if not hits:
         return []
     if number_of_fragments == 0:
@@ -54,14 +58,20 @@ def _mark(text: str, spans: List[tuple], pre: str, post: str) -> str:
 
 def collect_query_terms(lnode) -> Dict[str, Set[str]]:
     """field -> query terms, walked from the logical plan (for highlighting)."""
-    from .compiler import (LBool, LBoosting, LConstScore, LDisMax, LFuncScore, LTerms)
+    from .compiler import (LBool, LBoosting, LConstScore, LDisMax, LFuncScore,
+                           LPhrase, LTerms)
 
     out: Dict[str, Set[str]] = {}
 
     def walk(n):
         if n is None:
             return
-        if isinstance(n, LTerms):
+        if isinstance(n, LPhrase):
+            s = out.setdefault(n.field, set())
+            s.update(n.terms[:-1] if n.prefix_last else n.terms)
+            if n.prefix_last:
+                s.add(n.terms[-1] + "*")  # "*" suffix marks a prefix match
+        elif isinstance(n, LTerms):
             out.setdefault(n.field, set()).update(n.terms)
         elif isinstance(n, LBool):
             for c in n.musts + n.shoulds + n.filters:

@@ -71,6 +71,33 @@ class MatchPhraseQuery(Query):
     query: Any = None
     slop: int = 0
     analyzer: Optional[str] = None
+    prefix: bool = False               # match_phrase_prefix
+    max_expansions: int = 50
+
+
+@dataclass
+class SpanTermQuery(Query):
+    field: str = ""
+    value: str = ""
+
+
+@dataclass
+class SpanNearQuery(Query):
+    clauses: List[Query] = dc_field(default_factory=list)
+    slop: int = 0
+    in_order: bool = True
+
+
+@dataclass
+class IntervalsQuery(Query):
+    """intervals query, `match` rule only (ordered/max_gaps); the reference's
+    full interval algebra (all_of/any_of/contains...) is a later round."""
+
+    field: str = ""
+    query: str = ""
+    max_gaps: int = -1
+    ordered: bool = False
+    analyzer: Optional[str] = None
 
 
 @dataclass
@@ -284,12 +311,44 @@ def parse_query(dsl: Optional[dict]) -> Query:
 
     if kind in ("match_phrase", "match_phrase_prefix"):
         f, spec = _one_entry(body, kind)
+        prefix = kind == "match_phrase_prefix"
         if isinstance(spec, dict):
             q = MatchPhraseQuery(field=f, query=spec.get("query"),
-                                 slop=int(spec.get("slop", 0)), analyzer=spec.get("analyzer"))
+                                 slop=int(spec.get("slop", 0)), analyzer=spec.get("analyzer"),
+                                 prefix=prefix,
+                                 max_expansions=int(spec.get("max_expansions", 50)))
             _common(q, spec)
         else:
-            q = MatchPhraseQuery(field=f, query=spec)
+            q = MatchPhraseQuery(field=f, query=spec, prefix=prefix)
+        return q
+
+    if kind == "span_term":
+        f, spec = _one_entry(body, "span_term")
+        if isinstance(spec, dict):
+            q = SpanTermQuery(field=f, value=str(spec.get("value")))
+            _common(q, spec)
+        else:
+            q = SpanTermQuery(field=f, value=str(spec))
+        return q
+
+    if kind == "span_near":
+        q = SpanNearQuery(clauses=[parse_query(c) for c in body.get("clauses", [])],
+                          slop=int(body.get("slop", 0)),
+                          in_order=bool(body.get("in_order", True)))
+        _common(q, body)
+        return q
+
+    if kind == "intervals":
+        f, spec = _one_entry(body, "intervals")
+        rule = spec.get("match") if isinstance(spec, dict) else None
+        if not isinstance(rule, dict):
+            raise QueryParseError("[intervals] only the `match` rule "
+                                  "(an object) is supported")
+        q = IntervalsQuery(field=f, query=str(rule.get("query", "")),
+                           max_gaps=int(rule.get("max_gaps", -1)),
+                           ordered=bool(rule.get("ordered", False)),
+                           analyzer=rule.get("analyzer"))
+        _common(q, spec)
         return q
 
     if kind == "bool":

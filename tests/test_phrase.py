@@ -1,0 +1,264 @@
+"""Positional phrase / span / intervals queries: the device pair-join
+(ops/positions.py) vs naive reference semantics (reference: Lucene
+PhraseQuery / SloppyPhraseMatcher via `index/query/MatchPhraseQueryBuilder`)."""
+
+import math
+
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.search.executor import ShardSearcher, search_shards
+
+DOCS = [
+    ("1", {"body": "the quick brown fox jumps over the lazy dog"}),
+    ("2", {"body": "the brown quick fox is not a dog"}),           # swapped order
+    ("3", {"body": "quick and nimble brown fox"}),                 # gap of 2
+    ("4", {"body": "a fox that is brown and quick"}),              # far apart
+    ("5", {"body": "quick brown fox quick brown fox"}),            # phrase tf 2
+    ("6", {"body": "nothing relevant here"}),
+]
+
+MAPPING = {"properties": {"body": {"type": "text"}}}
+
+
+@pytest.fixture(scope="module")
+def searcher():
+    e = Engine(Mappings(MAPPING))
+    for i, s in DOCS:
+        e.index_doc(i, s)
+    e.refresh()
+    return ShardSearcher(e)
+
+
+def search(s, body):
+    return search_shards([s], body, "idx")
+
+
+def ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+def test_exact_phrase(searcher):
+    r = search(searcher, {"query": {"match_phrase": {"body": "quick brown fox"}}})
+    assert set(ids(r)) == {"1", "5"}
+
+
+def test_exact_phrase_excludes_swapped_and_gapped(searcher):
+    r = search(searcher, {"query": {"match_phrase": {"body": "brown fox"}}})
+    assert set(ids(r)) == {"1", "3", "5"}
+    r = search(searcher, {"query": {"match_phrase": {"body": "quick fox"}}})
+    assert ids(r) == ["2"]  # "brown quick fox" has them adjacent
+    r = search(searcher, {"query": {"match_phrase": {"body": "fox brown"}}})
+    assert ids(r) == []  # order matters for exact phrases
+
+
+def test_phrase_slop(searcher):
+    # slop 2 lets "quick ... brown fox" (doc 3, quick displaced by 2) match,
+    # and "brown quick fox" (doc 2: adjacent transposition costs 2 moves)
+    r = search(searcher, {"query": {"match_phrase": {
+        "body": {"query": "quick brown fox", "slop": 2}}}})
+    assert set(ids(r)) == {"1", "2", "3", "5"}
+    r = search(searcher, {"query": {"match_phrase": {
+        "body": {"query": "quick brown fox", "slop": 1}}}})
+    assert set(ids(r)) == {"1", "5"}
+    # swapped adjacent terms need total displacement 2 as well
+    r = search(searcher, {"query": {"match_phrase": {
+        "body": {"query": "quick brown", "slop": 2}}}})
+    assert "2" in ids(r)
+
+
+def test_phrase_freq_scoring(searcher):
+    """Doc 5 has the phrase twice -> freq 2 drives the BM25 tf curve with
+    weight = sum of term idfs (Lucene PhraseWeight)."""
+    r = search(searcher, {"query": {"match_phrase": {"body": "quick brown fox"}}})
+    by_id = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    N = 6
+    dls = [9, 8, 5, 7, 6, 3]
+    avgdl = sum(dls) / N
+
+    def idf(df):
+        return math.log(1 + (N - df + 0.5) / (df + 0.5))
+
+    w = idf(5) + idf(5) + idf(5)  # quick df=5, brown df=5, fox df=5
+
+    def bm25(freq, dl):
+        k = 1.2 * (1 - 0.75 + 0.75 * dl / avgdl)
+        return w * freq / (freq + k)
+
+    assert abs(by_id["5"] - bm25(2.0, 6)) < 1e-5
+    assert abs(by_id["1"] - bm25(1.0, 9)) < 1e-5
+    assert by_id["5"] > by_id["1"]
+
+
+def test_single_term_phrase_is_term_query(searcher):
+    r = search(searcher, {"query": {"match_phrase": {"body": "nimble"}}})
+    assert ids(r) == ["3"]
+
+
+def test_match_phrase_prefix(searcher):
+    r = search(searcher, {"query": {"match_phrase_prefix": {"body": "quick bro"}}})
+    assert set(ids(r)) == {"1", "5"}
+    r = search(searcher, {"query": {"match_phrase_prefix": {"body": "lazy d"}}})
+    assert ids(r) == ["1"]
+
+
+def test_phrase_in_bool(searcher):
+    r = search(searcher, {"query": {"bool": {
+        "must": [{"match_phrase": {"body": "brown fox"}}],
+        "must_not": [{"match": {"body": "nimble"}}]}}})
+    assert set(ids(r)) == {"1", "5"}
+
+
+def test_span_near(searcher):
+    r = search(searcher, {"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "fox"}}],
+        "slop": 1, "in_order": True}}})
+    assert set(ids(r)) == {"1", "2", "5"}  # adjacent or one term between
+
+
+def test_intervals_match(searcher):
+    r = search(searcher, {"query": {"intervals": {"body": {
+        "match": {"query": "quick fox", "max_gaps": 1}}}}})
+    assert set(ids(r)) == {"1", "2", "5"}
+
+
+def test_span_near_in_order_rejects_swapped(searcher):
+    # doc 2 has "brown quick": unordered span_near matches, in_order doesn't
+    body = {"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "brown"}}],
+        "slop": 2, "in_order": False}}}
+    assert "2" in ids(search(searcher, body))
+    body["query"]["span_near"]["in_order"] = True
+    r = search(searcher, body)
+    assert "2" not in ids(r)
+    assert {"1", "3", "5"} <= set(ids(r))
+
+
+def test_intervals_gaps_not_moves(searcher):
+    # unordered intervals: adjacent transposition ("brown quick" in doc 2)
+    # has 0 gaps even though it costs 2 moves
+    r = search(searcher, {"query": {"intervals": {"body": {
+        "match": {"query": "quick brown", "max_gaps": 0}}}}})
+    assert "2" in ids(r)
+    # ordered + max_gaps=0 excludes it again
+    r = search(searcher, {"query": {"intervals": {"body": {
+        "match": {"query": "quick brown", "max_gaps": 0, "ordered": True}}}}})
+    assert "2" not in ids(r)
+    # gaps budget is total across the span: "quick and nimble brown fox"
+    # has 2 gap positions for "quick brown fox"
+    r = search(searcher, {"query": {"intervals": {"body": {
+        "match": {"query": "quick brown fox", "max_gaps": 1, "ordered": True}}}}})
+    assert "3" not in ids(r)
+    r = search(searcher, {"query": {"intervals": {"body": {
+        "match": {"query": "quick brown fox", "max_gaps": 2, "ordered": True}}}}})
+    assert "3" in ids(r)
+
+
+def test_phrase_prefix_max_expansions():
+    e = Engine(Mappings(MAPPING))
+    for i, word in enumerate(["apple", "apricot", "avocado"]):
+        e.index_doc(str(i), {"body": f"ripe {word}"})
+    e.refresh()
+    s = ShardSearcher(e)
+    r = search(s, {"query": {"match_phrase_prefix": {"body": {"query": "ap"}}}})
+    assert set(ids(r)) == {"0", "1"}
+    r = search(s, {"query": {"match_phrase_prefix": {
+        "body": {"query": "ap", "max_expansions": 1}}}})
+    assert ids(r) == ["0"]  # only first expansion (sorted vocab: apple)
+
+
+def test_ordered_span_skips_earlier_out_of_order_occurrence():
+    # nearest occurrence of "fox" to the anchor is BEFORE it; the ordered
+    # join must still find the later in-order one (greedy sequential)
+    e = Engine(Mappings(MAPPING))
+    e.index_doc("1", {"body": "fox quick one two three fox"})
+    e.refresh()
+    s = ShardSearcher(e)
+    r = search(s, {"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "fox"}}],
+        "slop": 4, "in_order": True}}})
+    assert ids(r) == ["1"]
+    r = search(s, {"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "fox"}}],
+        "slop": 2, "in_order": True}}})
+    assert ids(r) == []  # 3 gaps > 2
+    # explain agrees with the device result
+    r = search(s, {"query": {"span_near": {
+        "clauses": [{"span_term": {"body": "quick"}},
+                    {"span_term": {"body": "fox"}}],
+        "slop": 4, "in_order": True}}, "explain": True})
+    h = r["hits"]["hits"][0]
+    assert abs(h["_explanation"]["value"] - h["_score"]) < 1e-4
+
+
+def test_phrase_prefix_df_clamped_nonnegative():
+    # union df of the prefix expansions exceeds maxDoc; scores must stay > 0
+    e = Engine(Mappings(MAPPING))
+    for i in range(4):
+        e.index_doc(str(i), {"body": "ripe apple apricot avocado amber"})
+    e.refresh()
+    s = ShardSearcher(e)
+    r = search(s, {"query": {"match_phrase_prefix": {"body": "ripe a"}}})
+    assert len(ids(r)) == 4
+    assert all(h["_score"] > 0 for h in r["hits"]["hits"])
+
+
+def test_intervals_bad_rule_is_parse_error():
+    from opensearch_tpu.search.query_dsl import QueryParseError, parse_query
+    with pytest.raises(QueryParseError):
+        parse_query({"intervals": {"body": {"match": "quick fox"}}})
+    with pytest.raises(QueryParseError):
+        parse_query({"intervals": {"body": {"fuzzy": {"term": "x"}}}})
+
+
+def test_phrase_prefix_highlight_marks_expanded_term():
+    e = Engine(Mappings(MAPPING))
+    e.index_doc("1", {"body": "the quick brown fox"})
+    e.refresh()
+    s = ShardSearcher(e)
+    r = search(s, {"query": {"match_phrase_prefix": {"body": "quick bro"}},
+                   "highlight": {"fields": {"body": {}}}})
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    assert any("<em>quick</em> <em>brown</em>" in f for f in frags)
+
+
+def test_phrase_explain_matches_score(searcher):
+    r = search(searcher, {"query": {"match_phrase": {"body": "quick brown fox"}},
+                          "explain": True})
+    for h in r["hits"]["hits"]:
+        assert abs(h["_explanation"]["value"] - h["_score"]) < 1e-4
+
+
+def test_multi_match_phrase():
+    e = Engine(Mappings({"properties": {"t": {"type": "text"},
+                                        "b": {"type": "text"}}}))
+    e.index_doc("1", {"t": "alpha beta", "b": "gamma delta"})
+    e.index_doc("2", {"t": "beta alpha", "b": "delta gamma"})
+    e.refresh()
+    s = ShardSearcher(e)
+    r = search(s, {"query": {"multi_match": {"query": "gamma delta",
+                                             "fields": ["t", "b"],
+                                             "type": "phrase"}}})
+    assert ids(r) == ["1"]
+
+
+def test_phrase_across_segments_and_deletes():
+    e = Engine(Mappings(MAPPING))
+    e.index_doc("a", {"body": "red green blue"})
+    e.refresh()
+    e.index_doc("b", {"body": "red green yellow"})
+    e.index_doc("c", {"body": "green red blue"})
+    e.refresh()
+    s = ShardSearcher(e)
+    r = search(s, {"query": {"match_phrase": {"body": "red green"}}})
+    assert set(ids(r)) == {"a", "b"}
+    e.delete_doc("b")
+    e.refresh()
+    s2 = ShardSearcher(e)
+    r = search(s2, {"query": {"match_phrase": {"body": "red green"}}})
+    assert set(ids(r)) == {"a"}
