@@ -214,6 +214,25 @@ def build_processor(kind: str, cfg: dict) -> Callable[[dict], None]:  # noqa: C9
             raise IngestProcessorException(_render(msg, doc))
         return p_fail
 
+    if kind == "script":
+        from ..script import ScriptError, run_ingest_script
+        from ..script.painless_lite import parse as parse_script
+        src = cfg.get("source", cfg.get("inline", ""))
+        if not src:
+            raise IngestProcessorException("script processor requires [source]")
+        try:
+            parse_script(src)  # reject bad scripts at pipeline PUT, not per-doc
+        except ScriptError as e:
+            raise IngestProcessorException(f"script compile error: {e}")
+        params = cfg.get("params") or {}
+
+        def p_script(doc):
+            try:
+                run_ingest_script(src, params, doc)
+            except ScriptError as e:
+                raise IngestProcessorException(f"script processor failed: {e}")
+        return p_script
+
     if kind == "pipeline":
         raise IngestProcessorException("nested pipeline processor requires service context")
 

@@ -34,6 +34,23 @@ class ApiError(Exception):
                 "status": self.status}
 
 
+def _run_update_script_or_400(script_body, src: dict, meta: dict):
+    """Deep-copy `src`, run the update script, map ScriptError to 400.
+    The deep copy matters: engine.get() hands back the live stored _source,
+    and a script that mutates nested state then sets ctx.op='none' must not
+    corrupt the segment in place."""
+    import copy
+
+    from ..script import ScriptError, run_update_script
+    from ..search.query_dsl import parse_script_spec
+    src_str, prm = parse_script_spec(script_body)
+    try:
+        return run_update_script(src_str, prm, copy.deepcopy(src), meta)
+    except ScriptError as e:
+        raise ApiError(400, "illegal_argument_exception",
+                       f"failed to execute script: {e}")
+
+
 class RestClient:
     def __init__(self, node: Optional[Node] = None, data_path: Optional[str] = None):
         self.node = node or Node(data_path=data_path)
@@ -130,7 +147,14 @@ class RestClient:
                 return self.index(index, body["doc"], id=id, routing=routing,
                                   refresh=refresh)
             if "upsert" in body:
-                return self.index(index, body["upsert"], id=id, routing=routing,
+                upsert_src = dict(body["upsert"])
+                if body.get("scripted_upsert") and "script" in body:
+                    upsert_src, op = _run_update_script_or_400(
+                        body["script"], upsert_src,
+                        {"_index": svc.meta.name, "_id": id, "op": "create"})
+                    if op in ("none", "delete"):
+                        return {"_index": svc.meta.name, "_id": id, "result": "noop"}
+                return self.index(index, upsert_src, id=id, routing=routing,
                                   refresh=refresh)
             raise ApiError(404, "document_missing_exception", f"[{id}]: document missing")
         src = dict(current["_source"])
@@ -140,8 +164,15 @@ class RestClient:
                 return {"_index": svc.meta.name, "_id": id, "result": "noop"}
             return self.index(index, merged, id=id, routing=routing, refresh=refresh)
         if "script" in body:
-            raise ApiError(400, "illegal_argument_exception",
-                           "scripted updates not supported yet (painless-lite r2)")
+            meta = {"_index": svc.meta.name, "_id": id,
+                    "_version": current.get("_version", 1),
+                    "_routing": routing}
+            new_src, op = _run_update_script_or_400(body["script"], src, meta)
+            if op == "none":
+                return {"_index": svc.meta.name, "_id": id, "result": "noop"}
+            if op == "delete":
+                return self.delete(index, id, routing=routing, refresh=refresh)
+            return self.index(index, new_src, id=id, routing=routing, refresh=refresh)
         raise ApiError(400, "action_request_validation_exception",
                        "update requires doc, upsert or script")
 
@@ -409,9 +440,20 @@ class RestClient:
         resp = self.search(index, {"query": body.get("query", {"match_all": {}}),
                                    "size": 10000})
         updated = 0
+        script_body = body.get("script")
         for h in resp["hits"]["hits"]:
-            # re-index the doc (picks up mapping changes; scripts are r2)
-            self.index(h["_index"] or index, h["_source"], id=h["_id"])
+            new_src = h["_source"]
+            if script_body is not None:
+                new_src, op = _run_update_script_or_400(
+                    script_body, new_src,
+                    {"_index": h["_index"] or index, "_id": h["_id"]})
+                if op == "none":
+                    continue
+                if op == "delete":
+                    self.delete(h["_index"] or index, h["_id"])
+                    updated += 1
+                    continue
+            self.index(h["_index"] or index, new_src, id=h["_id"])
             updated += 1
         if refresh:
             for n in self.node.metadata.resolve(index):

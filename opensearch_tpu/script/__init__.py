@@ -1,0 +1,10 @@
+"""Scripting subsystem: painless-lite (reference `modules/lang-painless`,
+`script/ScriptService.java`), re-designed so score-context scripts trace to
+XLA and host contexts interpret the same AST."""
+
+from .painless_lite import (ScriptError, execute, parse, run_field_script,
+                            run_ingest_script, run_update_script,
+                            validate_device_script)
+
+__all__ = ["ScriptError", "execute", "parse", "run_field_script",
+           "run_ingest_script", "run_update_script", "validate_device_script"]

@@ -1,0 +1,1041 @@
+"""painless-lite: a safe expression/statement language compiled to either a
+host interpreter or a vectorized JAX evaluator.
+
+The analog of the reference's Painless scripting engine
+(`modules/lang-painless`, reference ScriptService / Script contexts in
+`script/ScriptService.java`), re-designed for XLA: score-context scripts are
+*traced* over dense per-document columns — `doc['f'].value` becomes a f32
+vector over the whole segment, operators become VPU elementwise ops, and the
+whole script fuses into the surrounding query program. Host contexts (update,
+ingest processors, script_fields, sort) interpret the same AST per document.
+
+Grammar (subset of Painless):
+  program   := stmt (';' stmt)* ';'?
+  stmt      := 'def' ID '=' expr | 'if' '(' expr ')' block ('else' (block|if))?
+             | 'for' '(' ID 'in' expr ')' block | 'return' expr
+             | lvalue ('='|'+='|'-='|'*='|'/=') expr | expr
+  expr      := ternary with ||, &&, ==/!=, </<=/>/>=, +/-, */ /%, unary -/!,
+               postfix .member, [index], call(args)
+Literals: numbers, 'str'/"str", true/false/null, [a,b] lists, [:] maps.
+
+ASTs are nested tuples — hashable, so a device script can live inside a jit
+static spec and share the XLA program cache across segments.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+MAX_LOOP_ITERS = 100_000
+
+
+class ScriptError(ValueError):
+    """Analog of reference ScriptException (HTTP 400)."""
+
+
+# =====================================================================
+# lexer
+# =====================================================================
+
+_TOKEN_RE = re.compile(r"""
+    (?P<ws>\s+)
+  | (?P<comment>//[^\n]*|/\*.*?\*/)
+  | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?[fFdDlL]?)
+  | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
+  | (?P<id>[A-Za-z_][A-Za-z0-9_]*)
+  | (?P<op>==|!=|<=|>=|&&|\|\||\+=|-=|\*=|/=|%=|\+\+|--|[-+*/%!<>=?:.,()\[\]{};])
+""", re.VERBOSE | re.DOTALL)
+
+_KEYWORDS = {"def", "if", "else", "for", "in", "return", "true", "false", "null",
+             "int", "long", "float", "double", "boolean", "String", "var"}
+
+
+def _lex(src: str) -> List[Tuple[str, Any]]:
+    toks: List[Tuple[str, Any]] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            raise ScriptError(f"unexpected character {src[pos]!r} at {pos}")
+        pos = m.end()
+        if m.lastgroup in ("ws", "comment"):
+            continue
+        if m.lastgroup == "num":
+            t = m.group("num")
+            if t[-1] in "fFdDlL":
+                t = t[:-1]
+            toks.append(("num", float(t) if ("." in t or "e" in t or "E" in t)
+                         else int(t)))
+        elif m.lastgroup == "str":
+            raw = m.group("str")[1:-1]
+            toks.append(("str", re.sub(
+                r"\\(.)",
+                lambda mm: {"n": "\n", "t": "\t", "r": "\r"}.get(mm.group(1),
+                                                                mm.group(1)),
+                raw)))
+        elif m.lastgroup == "id":
+            name = m.group("id")
+            toks.append(("kw" if name in _KEYWORDS else "id", name))
+        else:
+            toks.append(("op", m.group("op")))
+    toks.append(("eof", None))
+    return toks
+
+
+# =====================================================================
+# parser -> tuple AST
+# =====================================================================
+
+class _Parser:
+    def __init__(self, toks: List[Tuple[str, Any]]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> Tuple[str, Any]:
+        return self.toks[self.i]
+
+    def next(self) -> Tuple[str, Any]:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, val=None) -> bool:
+        k, v = self.peek()
+        if k == kind and (val is None or v == val):
+            self.i += 1
+            return True
+        return False
+
+    def expect(self, kind: str, val=None) -> Any:
+        k, v = self.next()
+        if k != kind or (val is not None and v != val):
+            raise ScriptError(f"expected {val or kind}, got {v!r}")
+        return v
+
+    # ---- statements ----
+
+    def program(self) -> tuple:
+        stmts = []
+        while self.peek()[0] != "eof":
+            if self.accept("op", ";"):
+                continue
+            stmts.append(self.stmt())
+        return ("block", tuple(stmts))
+
+    def block(self) -> tuple:
+        if self.accept("op", "{"):
+            stmts = []
+            while not self.accept("op", "}"):
+                if self.accept("op", ";"):
+                    continue
+                stmts.append(self.stmt())
+            return ("block", tuple(stmts))
+        return ("block", (self.stmt(),))
+
+    def stmt(self) -> tuple:
+        k, v = self.peek()
+        if k == "kw" and v in ("def", "var", "int", "long", "float", "double",
+                               "boolean", "String"):
+            self.next()
+            name = self.expect("id")
+            self.expect("op", "=")
+            return ("decl", name, self.expr())
+        if k == "kw" and v == "if":
+            return self._if()
+        if k == "kw" and v == "for":
+            self.next()
+            self.expect("op", "(")
+            name = self.expect("id")
+            self.expect("kw", "in")
+            it = self.expr()
+            self.expect("op", ")")
+            return ("for", name, it, self.block())
+        if k == "kw" and v == "return":
+            self.next()
+            if self.peek() in (("op", ";"), ("eof", None)):
+                return ("return", ("null",))
+            return ("return", self.expr())
+        expr = self.expr()
+        kk, vv = self.peek()
+        if kk == "op" and vv in ("=", "+=", "-=", "*=", "/=", "%="):
+            self.next()
+            rhs = self.expr()
+            if expr[0] not in ("var", "member", "index"):
+                raise ScriptError("invalid assignment target")
+            return ("assign", vv, expr, rhs)
+        return ("exprstmt", expr)
+
+    def _if(self) -> tuple:
+        self.expect("kw", "if")
+        self.expect("op", "(")
+        cond = self.expr()
+        self.expect("op", ")")
+        then = self.block()
+        if self.accept("kw", "else"):
+            if self.peek() == ("kw", "if"):
+                return ("if", cond, then, ("block", (self._if(),)))
+            return ("if", cond, then, self.block())
+        return ("if", cond, then, ("block", ()))
+
+    # ---- expressions (precedence climbing) ----
+
+    def expr(self) -> tuple:
+        return self.ternary()
+
+    def ternary(self) -> tuple:
+        c = self.or_()
+        if self.accept("op", "?"):
+            t = self.expr()
+            self.expect("op", ":")
+            f = self.expr()
+            return ("cond", c, t, f)
+        return c
+
+    def _binop(self, sub, ops) -> tuple:
+        left = sub()
+        while True:
+            k, v = self.peek()
+            if k == "op" and v in ops:
+                self.next()
+                left = ("bin", v, left, sub())
+            else:
+                return left
+
+    def or_(self):
+        return self._binop(self.and_, ("||",))
+
+    def and_(self):
+        return self._binop(self.eq, ("&&",))
+
+    def eq(self):
+        return self._binop(self.cmp, ("==", "!="))
+
+    def cmp(self):
+        return self._binop(self.add, ("<", "<=", ">", ">="))
+
+    def add(self):
+        return self._binop(self.mul, ("+", "-"))
+
+    def mul(self):
+        return self._binop(self.unary, ("*", "/", "%"))
+
+    def unary(self) -> tuple:
+        if self.accept("op", "-"):
+            return ("un", "-", self.unary())
+        if self.accept("op", "!"):
+            return ("un", "!", self.unary())
+        if self.accept("op", "+"):
+            return self.unary()
+        return self.postfix()
+
+    def postfix(self) -> tuple:
+        e = self.primary()
+        while True:
+            if self.accept("op", "."):
+                name = self.next()
+                if name[0] not in ("id", "kw"):
+                    raise ScriptError(f"expected member name, got {name[1]!r}")
+                if self.accept("op", "("):
+                    args = self._args()
+                    e = ("call", e, name[1], tuple(args))
+                else:
+                    e = ("member", e, name[1])
+            elif self.accept("op", "["):
+                idx = self.expr()
+                self.expect("op", "]")
+                e = ("index", e, idx)
+            else:
+                return e
+
+    def _args(self) -> List[tuple]:
+        args: List[tuple] = []
+        if self.accept("op", ")"):
+            return args
+        args.append(self.expr())
+        while self.accept("op", ","):
+            args.append(self.expr())
+        self.expect("op", ")")
+        return args
+
+    def primary(self) -> tuple:
+        k, v = self.next()
+        if k == "num":
+            return ("num", v)
+        if k == "str":
+            return ("strlit", v)
+        if k == "kw" and v == "true":
+            return ("bool", True)
+        if k == "kw" and v == "false":
+            return ("bool", False)
+        if k == "kw" and v == "null":
+            return ("null",)
+        if k == "id":
+            return ("var", v)
+        if k == "op" and v == "(":
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        if k == "op" and v == "[":
+            if self.accept("op", ":"):  # [:] empty map
+                self.expect("op", "]")
+                return ("maplit", ())
+            items = []
+            if not self.accept("op", "]"):
+                first = self.expr()
+                if self.accept("op", ":"):  # map literal
+                    pairs = [(first, self.expr())]
+                    while self.accept("op", ","):
+                        pk = self.expr()
+                        self.expect("op", ":")
+                        pairs.append((pk, self.expr()))
+                    self.expect("op", "]")
+                    return ("maplit", tuple(pairs))
+                items.append(first)
+                while self.accept("op", ","):
+                    items.append(self.expr())
+                self.expect("op", "]")
+            return ("listlit", tuple(items))
+        raise ScriptError(f"unexpected token {v!r}")
+
+
+def parse(source: str) -> tuple:
+    """Parse script source -> hashable tuple AST (cached)."""
+    return _parse_cached(source)
+
+
+_parse_cache: Dict[str, tuple] = {}
+
+
+def _parse_cached(source: str) -> tuple:
+    ast = _parse_cache.get(source)
+    if ast is None:
+        ast = _Parser(_lex(source)).program()
+        if len(_parse_cache) > 4096:
+            _parse_cache.clear()
+        _parse_cache[source] = ast
+    return ast
+
+
+def referenced_doc_fields(ast: tuple) -> Tuple[str, ...]:
+    """Fields read via doc['f'] / doc.f — drives per-segment column binding."""
+    out: List[str] = []
+
+    def walk(n):
+        if not isinstance(n, tuple) or not n:
+            return
+        if n[0] == "index" and n[1] == ("var", "doc") \
+                and isinstance(n[2], tuple) and n[2][0] == "strlit":
+            out.append(n[2][1])
+        elif n[0] == "member" and n[1] == ("var", "doc") and isinstance(n[2], str):
+            out.append(n[2])
+        for c in n:
+            walk(c)
+    walk(ast)
+    seen, uniq = set(), []
+    for f in out:
+        if f not in seen:
+            seen.add(f)
+            uniq.append(f)
+    return tuple(uniq)
+
+
+# =====================================================================
+# host interpreter (update / ingest / script_fields / sort contexts)
+# =====================================================================
+
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+_MATH_FNS: Dict[str, Callable] = {
+    "log": math.log, "log10": math.log10, "sqrt": math.sqrt, "abs": abs,
+    "exp": math.exp, "pow": math.pow, "min": min, "max": max,
+    "floor": math.floor, "ceil": math.ceil, "round": round,
+    "sin": math.sin, "cos": math.cos, "tan": math.tan, "atan2": math.atan2,
+}
+_MATH_CONSTS = {"PI": math.pi, "E": math.e}
+
+
+class _DocValuesView:
+    """Host `doc['field']` — mimics reference ScriptDocValues."""
+
+    def __init__(self, values: list):
+        self.values = values
+
+    @property
+    def value(self):
+        if not self.values:
+            raise ScriptError("A document doesn't have a value for a field")
+        return self.values[0]
+
+    def size(self):
+        return len(self.values)
+
+    @property
+    def empty(self):
+        return not self.values
+
+    @property
+    def length(self):
+        return len(self.values)
+
+    def get(self, i):
+        return self.values[int(i)]
+
+    def contains(self, v):
+        return v in self.values
+
+
+class HostEnv:
+    """Variable scope + builtins for the host interpreter."""
+
+    def __init__(self, variables: Dict[str, Any]):
+        self.vars = dict(variables)
+
+    def lookup(self, name: str):
+        if name in self.vars:
+            return self.vars[name]
+        if name == "Math":
+            return "__Math__"
+        raise ScriptError(f"unknown variable [{name}]")
+
+
+def execute(ast_or_src, variables: Dict[str, Any]) -> Any:
+    """Run a script on the host; returns the `return` value or the value of
+    the final expression statement (Painless's implicit return)."""
+    ast = parse(ast_or_src) if isinstance(ast_or_src, str) else ast_or_src
+    env = HostEnv(variables)
+    try:
+        return _exec_block(ast, env)
+    except _Return as r:
+        return r.value
+    except ScriptError:
+        raise
+    except (ZeroDivisionError, IndexError, TypeError, KeyError, ValueError,
+            OverflowError, AttributeError) as e:
+        # runtime faults keep the ScriptError contract (callers map it to 400)
+        raise ScriptError(f"runtime error: {type(e).__name__}: {e}")
+
+
+def _exec_block(block: tuple, env: HostEnv) -> Any:
+    last = None
+    for st in block[1]:
+        last = _exec_stmt(st, env)
+    return last
+
+
+def _exec_stmt(st: tuple, env: HostEnv) -> Any:  # noqa: C901
+    op = st[0]
+    if op == "decl":
+        env.vars[st[1]] = _eval(st[2], env)
+        return None
+    if op == "if":
+        if _truthy(_eval(st[1], env)):
+            return _exec_block(st[2], env)
+        return _exec_block(st[3], env)
+    if op == "for":
+        _, name, it_expr, body = st
+        it = _eval(it_expr, env)
+        if isinstance(it, _DocValuesView):
+            it = it.values
+        if not isinstance(it, (list, tuple, dict)):
+            raise ScriptError("for-in requires a list or map")
+        if isinstance(it, dict):
+            it = list(it.keys())
+        for i, item in enumerate(it):
+            if i >= MAX_LOOP_ITERS:
+                raise ScriptError("loop iteration limit exceeded")
+            env.vars[name] = item
+            _exec_block(body, env)
+        return None
+    if op == "return":
+        raise _Return(_eval(st[1], env))
+    if op == "assign":
+        _, aop, target, rhs = st
+        val = _eval(rhs, env)
+        if aop != "=":
+            cur = _eval(target, env)
+            val = _apply_binop(aop[0], cur, val)
+        _assign(target, val, env)
+        return None
+    if op == "exprstmt":
+        return _eval(st[1], env)
+    raise ScriptError(f"unknown statement {op}")
+
+
+def _assign(target: tuple, val, env: HostEnv) -> None:
+    kind = target[0]
+    if kind == "var":
+        env.vars[target[1]] = val
+        return
+    if kind == "member":
+        obj = _eval(target[1], env)
+        if isinstance(obj, dict):
+            obj[target[2]] = val
+            return
+        raise ScriptError(f"cannot assign member [{target[2]}]")
+    if kind == "index":
+        obj = _eval(target[1], env)
+        key = _eval(target[2], env)
+        if isinstance(obj, dict):
+            obj[key] = val
+            return
+        if isinstance(obj, list):
+            obj[int(key)] = val
+            return
+        raise ScriptError("cannot index-assign")
+    raise ScriptError("invalid assignment target")
+
+
+def _truthy(v) -> bool:
+    if isinstance(v, _DocValuesView):
+        return not v.empty
+    return bool(v)
+
+
+def _apply_binop(op: str, a, b):  # noqa: C901
+    if op == "+":
+        if isinstance(a, str) or isinstance(b, str):
+            return _to_str(a) + _to_str(b)
+        if isinstance(a, list) and isinstance(b, list):
+            return a + b
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        if isinstance(a, int) and isinstance(b, int):
+            if b == 0:
+                raise ScriptError("/ by zero")
+            q = a // b
+            if q < 0 and q * b != a:
+                q += 1  # Java integer division truncates toward zero
+            return q
+        return a / b
+    if op == "%":
+        if isinstance(a, int) and isinstance(b, int):
+            r = abs(a) % abs(b)
+            return -r if a < 0 else r  # Java remainder semantics
+        return math.fmod(a, b)
+    if op == "==":
+        return _unwrap(a) == _unwrap(b)
+    if op == "!=":
+        return _unwrap(a) != _unwrap(b)
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise ScriptError(f"unknown operator {op}")
+
+
+def _unwrap(v):
+    return v.value if isinstance(v, _DocValuesView) else v
+
+
+def _to_str(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(v)
+    if v is None:
+        return "null"
+    return str(v)
+
+
+def _eval(e: tuple, env: HostEnv) -> Any:  # noqa: C901
+    kind = e[0]
+    if kind == "num":
+        return e[1]
+    if kind == "strlit":
+        return e[1]
+    if kind == "bool":
+        return e[1]
+    if kind == "null":
+        return None
+    if kind == "var":
+        return env.lookup(e[1])
+    if kind == "listlit":
+        return [_eval(x, env) for x in e[1]]
+    if kind == "maplit":
+        return {_eval(k, env): _eval(v, env) for k, v in e[1]}
+    if kind == "cond":
+        return _eval(e[2], env) if _truthy(_eval(e[1], env)) else _eval(e[3], env)
+    if kind == "un":
+        v = _eval(e[2], env)
+        return (not _truthy(v)) if e[1] == "!" else -v
+    if kind == "bin":
+        op = e[1]
+        if op == "&&":
+            return _truthy(_eval(e[2], env)) and _truthy(_eval(e[3], env))
+        if op == "||":
+            return _truthy(_eval(e[2], env)) or _truthy(_eval(e[3], env))
+        return _apply_binop(op, _eval(e[2], env), _eval(e[3], env))
+    if kind == "member":
+        return _member(_eval(e[1], env), e[2])
+    if kind == "index":
+        obj = _eval(e[1], env)
+        key = _eval(e[2], env)
+        if isinstance(obj, _LazyDoc):
+            return obj.get(key)
+        if isinstance(obj, dict):
+            return obj.get(key)
+        if isinstance(obj, (list, str)):
+            return obj[int(key)]
+        if isinstance(obj, _DocValuesView):
+            return obj.get(key)
+        raise ScriptError(f"cannot index {type(obj).__name__}")
+    if kind == "call":
+        return _call(e, env)
+    raise ScriptError(f"cannot evaluate {kind}")
+
+
+def _member(obj, name: str):  # noqa: C901
+    if isinstance(obj, _LazyDoc):
+        return obj.get(name)
+    if obj == "__Math__":
+        if name in _MATH_CONSTS:
+            return _MATH_CONSTS[name]
+        raise ScriptError(f"unknown Math member [{name}]")
+    if isinstance(obj, dict):
+        return obj.get(name)
+    if isinstance(obj, _DocValuesView):
+        if name == "value":
+            return obj.value
+        if name == "empty":
+            return obj.empty
+        if name == "length":
+            return obj.length
+        if name == "values":
+            return obj.values
+    if isinstance(obj, str) and name == "length":
+        return len(obj)
+    raise ScriptError(f"unknown member [{name}] on {type(obj).__name__}")
+
+
+def _call(e: tuple, env: HostEnv):  # noqa: C901
+    _, obj_expr, name, arg_exprs = e
+    if obj_expr == ("var", "Math"):
+        fn = _MATH_FNS.get(name)
+        if fn is None:
+            raise ScriptError(f"unknown Math function [{name}]")
+        return fn(*[_eval(a, env) for a in arg_exprs])
+    obj = _eval(obj_expr, env)
+    args = [_eval(a, env) for a in arg_exprs]
+    if isinstance(obj, _DocValuesView):
+        if name == "size":
+            return obj.size()
+        if name == "contains":
+            return obj.contains(args[0])
+        if name == "get":
+            return obj.get(args[0])
+        if name == "isEmpty":
+            return obj.empty
+    if isinstance(obj, str):
+        return _str_method(obj, name, args)
+    if isinstance(obj, list):
+        return _list_method(obj, name, args)
+    if isinstance(obj, dict):
+        return _map_method(obj, name, args)
+    if isinstance(obj, (int, float)):
+        if name == "intValue":
+            return int(obj)
+        if name == "doubleValue" or name == "floatValue":
+            return float(obj)
+        if name == "longValue":
+            return int(obj)
+        if name == "toString":
+            return _to_str(obj)
+    raise ScriptError(f"unknown method [{name}] on {type(obj).__name__}")
+
+
+def _str_method(s: str, name: str, args: list):  # noqa: C901
+    if name == "contains":
+        return args[0] in s
+    if name == "startsWith":
+        return s.startswith(args[0])
+    if name == "endsWith":
+        return s.endswith(args[0])
+    if name == "toLowerCase":
+        return s.lower()
+    if name == "toUpperCase":
+        return s.upper()
+    if name == "trim":
+        return s.strip()
+    if name == "length":
+        return len(s)
+    if name == "substring":
+        return s[int(args[0]):] if len(args) == 1 else s[int(args[0]): int(args[1])]
+    if name == "replace":
+        return s.replace(args[0], args[1])
+    if name == "split":
+        return re.split(args[0], s)
+    if name == "indexOf":
+        return s.find(args[0])
+    if name == "equals":
+        return s == args[0]
+    if name == "equalsIgnoreCase":
+        return s.lower() == str(args[0]).lower()
+    if name == "isEmpty":
+        return len(s) == 0
+    if name == "charAt":
+        return s[int(args[0])]
+    if name == "toString":
+        return s
+    raise ScriptError(f"unknown String method [{name}]")
+
+
+def _list_method(lst: list, name: str, args: list):  # noqa: C901
+    if name == "add":
+        if len(args) == 2:
+            lst.insert(int(args[0]), args[1])
+        else:
+            lst.append(args[0])
+        return None
+    if name == "remove":
+        v = args[0]
+        if isinstance(v, int):
+            return lst.pop(v)
+        lst.remove(v)
+        return None
+    if name == "removeIf":
+        raise ScriptError("removeIf (lambdas) not supported in painless-lite")
+    if name == "size":
+        return len(lst)
+    if name == "contains":
+        return args[0] in lst
+    if name == "get":
+        return lst[int(args[0])]
+    if name == "indexOf":
+        return lst.index(args[0]) if args[0] in lst else -1
+    if name == "isEmpty":
+        return len(lst) == 0
+    if name == "addAll":
+        lst.extend(args[0])
+        return None
+    if name == "sort":
+        lst.sort()
+        return None
+    raise ScriptError(f"unknown List method [{name}]")
+
+
+def _map_method(m: dict, name: str, args: list):  # noqa: C901
+    if name == "containsKey":
+        return args[0] in m
+    if name == "get":
+        return m.get(args[0])
+    if name == "getOrDefault":
+        return m.get(args[0], args[1])
+    if name == "put":
+        prev = m.get(args[0])
+        m[args[0]] = args[1]
+        return prev
+    if name == "remove":
+        return m.pop(args[0], None)
+    if name == "keySet":
+        return list(m.keys())
+    if name == "values":
+        return list(m.values())
+    if name == "size":
+        return len(m)
+    if name == "isEmpty":
+        return len(m) == 0
+    if name == "entrySet":
+        return [{"key": k, "value": v} for k, v in m.items()]
+    raise ScriptError(f"unknown Map method [{name}]")
+
+
+# =====================================================================
+# script contexts (host)
+# =====================================================================
+
+def run_update_script(source: str, params: Optional[dict], src: dict,
+                      doc_meta: dict) -> Tuple[dict, str]:
+    """Update-context: mutate ctx._source; ctx.op in {index,none,delete}
+    (reference UpdateHelper.executeScriptedUpsert)."""
+    ctx = {"_source": src, "op": "index", **doc_meta}
+    execute(source, {"ctx": ctx, "params": params or {}})
+    op = ctx.get("op", "index")
+    if op == "noop":
+        op = "none"
+    if op not in ("index", "none", "delete", "create"):
+        raise ScriptError(f"invalid ctx.op [{op}]")
+    return ctx["_source"], op
+
+
+def run_ingest_script(source: str, params: Optional[dict], doc: dict) -> None:
+    """Ingest-processor context: the document IS ctx (flat mutation)."""
+    execute(source, {"ctx": doc, "params": params or {}})
+
+
+def doc_view_for(seg, doc: int, field: str) -> _DocValuesView:
+    """Build `doc['field']` for one stored doc from segment columns."""
+    col = seg.numeric_cols.get(field)
+    if col is not None:
+        if col.present[doc]:
+            v = col.values[doc]
+            return _DocValuesView([float(v) if col.kind == "float" else int(v)])
+        return _DocValuesView([])
+    kcol = seg.keyword_cols.get(field)
+    if kcol is not None:
+        a, b = int(kcol.starts[doc]), int(kcol.starts[doc + 1])
+        return _DocValuesView([kcol.vocab[o] for o in kcol.ords[a:b]])
+    gcol = seg.geo_cols.get(field) if hasattr(seg, "geo_cols") else None
+    if gcol is not None and gcol.present[doc]:
+        return _DocValuesView([{"lat": float(gcol.lat[doc]),
+                                "lon": float(gcol.lon[doc])}])
+    return _DocValuesView([])
+
+
+class _LazyDoc:
+    """Lazy doc map: only referenced fields materialize views."""
+
+    def __init__(self, seg, doc: int):
+        self.seg = seg
+        self.doc = doc
+        self._cache: Dict[str, _DocValuesView] = {}
+
+    def get(self, field):
+        v = self._cache.get(field)
+        if v is None:
+            v = self._cache[field] = doc_view_for(self.seg, self.doc, field)
+        return v
+
+    def __contains__(self, field):
+        return True
+
+
+def run_field_script(source: str, params: Optional[dict], seg, doc: int,
+                     score: Optional[float] = None,
+                     extra: Optional[dict] = None) -> Any:
+    """script_fields / script-sort / field-context evaluation for one doc."""
+    variables: Dict[str, Any] = {"doc": _LazyDoc(seg, doc), "params": params or {},
+                                 "_score": 0.0 if score is None else float(score)}
+    ast = parse(source)
+    if _references_source(ast):
+        variables["_source"] = seg.sources[doc] if hasattr(seg, "sources") else {}
+    if extra:
+        variables.update(extra)
+    return execute(ast, variables)
+
+
+def _references_source(ast: tuple) -> bool:
+    def walk(n) -> bool:
+        if not isinstance(n, tuple) or not n:
+            return False
+        if n == ("var", "_source"):
+            return True
+        return any(walk(c) for c in n if isinstance(c, tuple))
+    return walk(ast)
+
+
+# =====================================================================
+# device (vectorized JAX) evaluator — score/filter contexts
+# =====================================================================
+
+def validate_device_script(source: str) -> tuple:
+    """Parse + check the script is expressible as a traced computation:
+    decls + if-less expressions + final return/expression. Returns the AST."""
+    ast = parse(source)
+    for st in ast[1]:
+        if st[0] not in ("decl", "return", "exprstmt", "assign"):
+            raise ScriptError(
+                f"score scripts support expressions and `def` locals; "
+                f"got a `{st[0]}` statement (use ternaries instead of if)")
+    return ast
+
+
+class DeviceEnv:
+    """Bindings for the traced evaluator. `columns[f]` is the per-doc value
+    vector for doc['f'].value; `present[f]` the existence mask."""
+
+    def __init__(self, jnp, columns: Dict[str, Any], present: Dict[str, Any],
+                 score, params: Dict[str, Any], ndocs: int):
+        self.jnp = jnp
+        self.columns = columns
+        self.present = present
+        self.score = score
+        self.params = params
+        self.ndocs = ndocs
+        self.locals: Dict[str, Any] = {}
+
+
+def eval_device(ast: tuple, env: DeviceEnv):
+    """Trace the script over dense columns -> f32[ndocs] vector."""
+    result = None
+    for st in ast[1]:
+        if st[0] == "decl":
+            env.locals[st[1]] = _dev_expr(st[2], env)
+        elif st[0] == "assign":
+            if st[2][0] != "var":
+                raise ScriptError("device scripts only assign local variables")
+            val = _dev_expr(st[3], env)
+            if st[1] != "=":
+                if st[2][1] not in env.locals:
+                    raise ScriptError(f"unknown variable [{st[2][1]}]")
+                val = _dev_binop(env, st[1][0], env.locals[st[2][1]], val)
+            env.locals[st[2][1]] = val
+        elif st[0] == "return":
+            return _as_vec(_dev_expr(st[1], env), env)
+        else:  # exprstmt
+            result = _dev_expr(st[1], env)
+    if result is None:
+        raise ScriptError("script has no result expression")
+    return _as_vec(result, env)
+
+
+def _as_vec(v, env: DeviceEnv):
+    jnp = env.jnp
+    arr = jnp.asarray(v, jnp.float32)
+    if arr.ndim == 0:
+        arr = jnp.full(env.ndocs, arr)
+    return arr
+
+
+_DEV_MATH = {"log": "log", "log10": "log10", "sqrt": "sqrt", "abs": "abs",
+             "exp": "exp", "floor": "floor", "ceil": "ceil", "round": "round",
+             "sin": "sin", "cos": "cos", "tan": "tan"}
+
+
+def _dev_binop(env: DeviceEnv, op: str, a, b):  # noqa: C901
+    jnp = env.jnp
+    if op == "+":
+        return a + b
+    if op == "-":
+        return a - b
+    if op == "*":
+        return a * b
+    if op == "/":
+        return a / b
+    if op == "%":
+        return jnp.where(jnp.asarray(a) < 0, -(jnp.abs(a) % jnp.abs(b)),
+                         jnp.abs(a) % jnp.abs(b))
+    if op == "==":
+        return a == b
+    if op == "!=":
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    if op == ">=":
+        return a >= b
+    raise ScriptError(f"unsupported device operator {op}")
+
+
+def _dev_expr(e: tuple, env: DeviceEnv):  # noqa: C901
+    jnp = env.jnp
+    kind = e[0]
+    if kind == "num":
+        return e[1]
+    if kind == "bool":
+        return e[1]
+    if kind == "null":
+        return 0.0
+    if kind == "var":
+        name = e[1]
+        if name == "_score":
+            if env.score is None:
+                raise ScriptError("_score unavailable in this context")
+            return env.score
+        if name in env.locals:
+            return env.locals[name]
+        raise ScriptError(f"unknown variable [{name}] in score script")
+    if kind == "member":
+        obj, name = e[1], e[2]
+        if obj == ("var", "Math"):
+            if name in _MATH_CONSTS:
+                return _MATH_CONSTS[name]
+            raise ScriptError(f"unknown Math member [{name}]")
+        if obj == ("var", "params"):
+            if name not in env.params:
+                raise ScriptError(f"unknown param [{name}]")
+            return env.params[name]
+        dv = _dev_docvalues(obj, env)
+        if dv is not None:
+            col, present = dv
+            if name == "value":
+                return col
+            if name == "empty":
+                return ~present
+            if name == "length":
+                return present.astype(jnp.float32)
+        raise ScriptError(f"unsupported member [{name}] in score script")
+    if kind == "index":
+        if e[1] == ("var", "params") and e[2][0] == "strlit":
+            key = e[2][1]
+            if key not in env.params:
+                raise ScriptError(f"unknown param [{key}]")
+            return env.params[key]
+        raise ScriptError("only params['k'] / doc['f'].value indexing on device")
+    if kind == "call":
+        _, obj, name, args = e
+        if obj == ("var", "Math"):
+            vals = [_dev_expr(a, env) for a in args]
+            if name == "pow":
+                return jnp.power(vals[0], vals[1])
+            if name == "min":
+                return jnp.minimum(vals[0], vals[1])
+            if name == "max":
+                return jnp.maximum(vals[0], vals[1])
+            fn = _DEV_MATH.get(name)
+            if fn is None:
+                raise ScriptError(f"unknown Math function [{name}]")
+            return getattr(jnp, fn)(*vals)
+        dv = _dev_docvalues(obj, env)
+        if dv is not None:
+            col, present = dv
+            if name == "size":
+                return present.astype(jnp.float32)
+            if name == "isEmpty":
+                return ~present
+        raise ScriptError(f"unsupported call [{name}] in score script")
+    if kind == "cond":
+        c = _dev_expr(e[1], env)
+        t = _dev_expr(e[2], env)
+        f = _dev_expr(e[3], env)
+        return jnp.where(c, t, f)
+    if kind == "un":
+        v = _dev_expr(e[2], env)
+        if e[1] == "!":
+            return ~jnp.asarray(v, bool)
+        return -v if not isinstance(v, (int, float)) else -v
+    if kind == "bin":
+        op = e[1]
+        if op == "&&":
+            return (jnp.asarray(_dev_expr(e[2], env), bool)
+                    & jnp.asarray(_dev_expr(e[3], env), bool))
+        if op == "||":
+            return (jnp.asarray(_dev_expr(e[2], env), bool)
+                    | jnp.asarray(_dev_expr(e[3], env), bool))
+        return _dev_binop(env, op, _dev_expr(e[2], env), _dev_expr(e[3], env))
+    raise ScriptError(f"cannot trace {kind} on device")
+
+
+def _dev_docvalues(obj: tuple, env: DeviceEnv):
+    """Match doc['f'] / doc.f -> (values vector, present mask) or None."""
+    field = None
+    if obj[0] == "index" and obj[1] == ("var", "doc") and obj[2][0] == "strlit":
+        field = obj[2][1]
+    elif obj[0] == "member" and obj[1] == ("var", "doc"):
+        field = obj[2]
+    if field is None:
+        return None
+    jnp = env.jnp
+    if field not in env.columns:
+        return (jnp.zeros(env.ndocs, jnp.float32),
+                jnp.zeros(env.ndocs, bool))
+    return env.columns[field], env.present[field]

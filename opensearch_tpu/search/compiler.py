@@ -35,6 +35,7 @@ from ..index.segment import Segment, next_pow2, split_i64
 from ..models.similarity import Similarity, resolve_similarity
 from ..ops import aggs as agg_ops
 from ..ops import scoring as ops
+from ..script import painless_lite as pl
 from . import query_dsl as dsl
 from .aggregations import AggNode
 
@@ -234,6 +235,29 @@ class LFuncScore(LNode):
     fn_filters: List[Optional[LNode]] = dc_field(default_factory=list)
     score_mode: str = "multiply"
     boost_mode: str = "multiply"
+    min_score: Optional[float] = None
+    boost: float = 1.0
+
+
+@dataclass
+class LScriptFilter(LNode):
+    """`script` query: filter where the traced expression is truthy. The AST
+    (hashable tuples) lives in the jit-static spec; numeric script params are
+    traced scalars, so param changes reuse the XLA program."""
+
+    ast: tuple = ()
+    params: dict = dc_field(default_factory=dict)
+    boost: float = 1.0
+
+
+@dataclass
+class LScriptScore(LNode):
+    """`script_score` query (reference ScriptScoreQueryBuilder): the script
+    replaces the child's score; `_score` binds to the child's score vector."""
+
+    child: Optional[LNode] = None
+    ast: tuple = ()
+    params: dict = dc_field(default_factory=dict)
     min_score: Optional[float] = None
     boost: float = 1.0
 
@@ -584,10 +608,32 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         return LGeoBox(field=q.field, top=q.top, left=q.left, bottom=q.bottom,
                        right=q.right, boost=q.boost)
 
+    if isinstance(q, dsl.ScriptQuery):
+        try:
+            ast = pl.validate_device_script(q.source)
+        except pl.ScriptError as e:
+            raise dsl.QueryParseError(f"[script] compile error: {e}")
+        return LScriptFilter(ast=ast, params=q.params or {}, boost=q.boost)
+
+    if isinstance(q, dsl.ScriptScoreQuery):
+        try:
+            ast = pl.validate_device_script(q.source)
+        except pl.ScriptError as e:
+            raise dsl.QueryParseError(f"[script_score] compile error: {e}")
+        return LScriptScore(child=rewrite(q.query or dsl.MatchAllQuery(), ctx, scoring),
+                            ast=ast, params=q.params or {},
+                            min_score=q.min_score, boost=q.boost)
+
     if isinstance(q, dsl.FunctionScoreQuery):
         child = rewrite(q.query or dsl.MatchAllQuery(), ctx, scoring)
         fn_filters = [rewrite(f.filter, ctx, False) if f.filter else None
                       for f in q.functions]
+        for f in q.functions:
+            if f.kind == "script_score":
+                try:
+                    pl.validate_device_script(f.script or "")
+                except pl.ScriptError as e:
+                    raise dsl.QueryParseError(f"[script_score] compile error: {e}")
         return LFuncScore(child=child, functions=q.functions, fn_filters=fn_filters,
                           score_mode=q.score_mode, boost_mode=q.boost_mode,
                           min_score=q.min_score, boost=q.boost)
@@ -964,6 +1010,11 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
             elif fn.kind == "random_score":
                 _scalar_i32(params, f"q{nid}_fn{i}_seed", fn.seed)
                 fn_specs.append(("random", i, fspec))
+            elif fn.kind == "script_score":
+                ast = pl.parse(fn.script or "")
+                field_srcs, pkeys = _prepare_script(ast, fn.script_params or {},
+                                                    seg, params, nid, f"fn{i}s")
+                fn_specs.append(("script", i, ast, field_srcs, pkeys, fspec))
             else:
                 fn_specs.append(("weight", i, fspec))
         _scalar_f32(params, f"q{nid}_boost", node.boost)
@@ -971,6 +1022,21 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
                     node.min_score if node.min_score is not None else -3.4e38)
         return ("fnscore", nid, child_spec, tuple(fn_specs),
                 node.score_mode, node.boost_mode)
+
+    if isinstance(node, LScriptFilter):
+        field_srcs, pkeys = _prepare_script(node.ast, node.params, seg, params,
+                                            nid, "s")
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("script", nid, node.ast, field_srcs, pkeys)
+
+    if isinstance(node, LScriptScore):
+        child_spec = prepare(node.child, seg, ctx, params)
+        field_srcs, pkeys = _prepare_script(node.ast, node.params, seg, params,
+                                            nid, "s")
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        _scalar_f32(params, f"q{nid}_minscore",
+                    node.min_score if node.min_score is not None else F32_MIN)
+        return ("scriptscore", nid, child_spec, node.ast, field_srcs, pkeys)
 
     if isinstance(node, LKnn):
         col_exists = node.field in seg.vector_cols
@@ -1000,6 +1066,39 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("geobox", nid, node.field, node.field in seg.geo_cols)
 
     raise TypeError(f"cannot prepare node {type(node).__name__}")
+
+
+def _prepare_script(ast: tuple, script_params: dict, seg: Segment, params: dict,
+                    nid: int, tag: str):
+    """Bind a device script to one segment: resolve doc['f'] columns and
+    trace numeric params (date epochs ride the f32 column view — ms-epoch
+    precision ~2min at f32, fine for scoring)."""
+    fields = pl.referenced_doc_fields(ast)
+    field_srcs = tuple((f, "numeric" if f in seg.numeric_cols else "none")
+                       for f in fields)
+    pkeys = []
+    for k in sorted(script_params):
+        v = script_params[k]
+        if isinstance(v, bool):
+            v = float(v)
+        if not isinstance(v, (int, float)):
+            raise dsl.QueryParseError(
+                f"script param [{k}] must be numeric in score/filter scripts")
+        _scalar_f32(params, f"q{nid}_{tag}p_{k}", v)
+        pkeys.append(k)
+    return field_srcs, tuple(pkeys)
+
+
+def _script_env(jnp, field_srcs, pkeys, nid: int, tag: str, seg_arrays: dict,
+                params: dict, score, ndocs_pad: int) -> pl.DeviceEnv:
+    cols: Dict[str, Any] = {}
+    present: Dict[str, Any] = {}
+    for f, src in field_srcs:
+        if src == "numeric":
+            cols[f] = seg_arrays["numeric"][f]["f32"]
+            present[f] = seg_arrays["numeric"][f]["present"]
+    sparams = {k: params[f"q{nid}_{tag}p_{k}"] for k in pkeys}
+    return pl.DeviceEnv(jnp, cols, present, score, sparams, ndocs_pad)
 
 
 def can_match(node: LNode, seg: Segment) -> bool:
@@ -1235,6 +1334,11 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
                 h = h * jnp.uint32(0x45D9F3B)
                 h = h ^ (h >> 16)
                 v = h.astype(jnp.float32) / jnp.float32(2**32)
+            elif fkind == "script":
+                _, _, s_ast, s_fields, s_pkeys, fspec = fs
+                env = _script_env(jnp, s_fields, s_pkeys, nid, f"fn{i}s",
+                                  seg_arrays, params, child.scores, ndocs_pad)
+                v = pl.eval_device(s_ast, env)
             else:  # weight
                 _, _, fspec = fs
                 v = jnp.ones(ndocs_pad, jnp.float32)
@@ -1253,6 +1357,25 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         matched = child.matched & (scores >= params[f"q{nid}_minscore"])
         scores = jnp.where(matched, scores, 0.0)
         return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "script":
+        _, _, ast, field_srcs, pkeys = spec
+        env = _script_env(jnp, field_srcs, pkeys, nid, "s", seg_arrays, params,
+                          None, ndocs_pad)
+        vec = pl.eval_device(ast, env)
+        mask = (vec != 0) & (live > 0)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "scriptscore":
+        _, _, child_spec, ast, field_srcs, pkeys = spec
+        child = emit(child_spec, seg_arrays, params)
+        env = _script_env(jnp, field_srcs, pkeys, nid, "s", seg_arrays, params,
+                          child.scores, ndocs_pad)
+        scores = pl.eval_device(ast, env) * params[f"q{nid}_boost"]
+        matched = child.matched & (scores >= params[f"q{nid}_minscore"])
+        return ops.ScoredMask(jnp.where(matched, scores, 0.0),
+                              matched.astype(jnp.float32))
 
     if kind == "knn":
         _, _, field, col_exists, simkind, fspec = spec

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..index.engine import Engine
 from ..index.segment import Segment, next_pow2
+from ..script.painless_lite import ScriptError as _ScriptError
 from . import compiler as C
 from . import query_dsl as dsl
 from .aggregations import AggNode, finalize, merge_partials, parse_aggs
@@ -141,7 +142,12 @@ class ShardSearcher:
                 # global/filter-family aggs see docs the query doesn't match,
                 # so ordinary agg trees still allow the skip
                 continue
-            k_pad = min(next_pow2(max(window * oversample, 16)), seg.ndocs_pad)
+            if sort_specs and sort_specs[0]["field"] == "_script":
+                # script order is host-computed: collect the full segment
+                # window so the host re-sort sees every matching doc
+                k_pad = seg.ndocs_pad
+            else:
+                k_pad = min(next_pow2(max(window * oversample, 16)), seg.ndocs_pad)
             params: Dict[str, Any] = {}
             qspec = C.prepare(lroot, seg, ctx, params)
             sspec = C.prepare_sort(sort_specs, seg, params)
@@ -157,10 +163,17 @@ class ShardSearcher:
                 named_specs.append((nm, nspec))
             has_after = search_after is not None
             if has_after:
+                if sort_specs and sort_specs[0]["field"] == "_script":
+                    raise dsl.QueryParseError(
+                        "search_after is not supported with a primary _script sort")
                 params["after_key"] = np.float32(
                     _after_key_value(search_after, sort_specs, seg))
-            out = C.run_segment(qspec, sspec, agg_specs, named_specs, k_pad,
-                                seg.device_arrays(), params, has_after)
+            try:
+                out = C.run_segment(qspec, sspec, agg_specs, named_specs, k_pad,
+                                    seg.device_arrays(), params, has_after)
+            except _ScriptError as e:
+                # device-script trace failures are user errors (HTTP 400)
+                raise dsl.QueryParseError(f"script compile error: {e}")
 
             keys = np.asarray(out["topk_key"])
             idx = np.asarray(out["topk_idx"])
@@ -275,6 +288,18 @@ class ShardSearcher:
                 vals = _extract_source_values(seg.sources[c.local_doc], fname)
                 if vals:
                     flds[fname] = vals
+        if body.get("script_fields"):
+            from ..script import ScriptError, run_field_script
+            from .query_dsl import parse_script_spec
+            flds = hit.setdefault("fields", {})
+            for fname, fspec in body["script_fields"].items():
+                src_str, prm = parse_script_spec(fspec.get("script"))
+                try:
+                    v = run_field_script(src_str, prm, seg, c.local_doc,
+                                         score=c.score)
+                except ScriptError as e:
+                    raise dsl.QueryParseError(f"[script_fields.{fname}]: {e}")
+                flds[fname] = v if isinstance(v, list) else [v]
         if body.get("highlight") and hl_terms is not None:
             hl = {}
             hl_body = body["highlight"]
@@ -464,6 +489,21 @@ def _host_sort_values(sort_specs: List[dict], seg: Segment, doc: int,
         if f == "_doc":
             comp.append(doc)
             raw.append(doc)
+            continue
+        if f == "_script":
+            from ..script import run_field_script
+            from .query_dsl import parse_script_spec
+            src_str, prm = parse_script_spec(spec.get("script"))
+            try:
+                v = run_field_script(src_str, prm, seg, doc, score=score)
+            except _ScriptError as e:
+                raise dsl.QueryParseError(f"[_script sort]: {e}")
+            if spec.get("type") == "string":
+                comp.append((0, _StrKey(str(v), desc)))
+            else:
+                v = float(v)
+                comp.append((0, -v if desc else v))
+            raw.append(v)
             continue
         col = seg.numeric_cols.get(f)
         if col is not None and col.present[doc]:

@@ -209,7 +209,7 @@ class GeoBoundingBoxQuery(Query):
 
 @dataclass
 class ScoreFunction:
-    kind: str                      # weight | field_value_factor | random_score | script_score(stub)
+    kind: str                      # weight | field_value_factor | random_score | script_score
     weight: float = 1.0
     filter: Optional[Query] = None
     field: Optional[str] = None
@@ -217,6 +217,8 @@ class ScoreFunction:
     modifier: str = "none"
     missing: Optional[float] = None
     seed: int = 0
+    script: Optional[str] = None   # painless-lite source
+    script_params: Optional[dict] = None
 
 
 @dataclass
@@ -226,6 +228,24 @@ class FunctionScoreQuery(Query):
     score_mode: str = "multiply"   # multiply | sum | avg | max | min | first
     boost_mode: str = "multiply"   # multiply | sum | replace | avg | max | min
     max_boost: float = 3.4e38
+    min_score: Optional[float] = None
+
+
+@dataclass
+class ScriptQuery(Query):
+    """`script` query: filter docs where the expression is truthy."""
+
+    source: str = ""
+    params: Optional[dict] = None
+
+
+@dataclass
+class ScriptScoreQuery(Query):
+    """`script_score` query: replace the child's score with the script's."""
+
+    query: Optional[Query] = None
+    source: str = ""
+    params: Optional[dict] = None
     min_score: Optional[float] = None
 
 
@@ -468,12 +488,29 @@ def parse_query(dsl: Optional[dict]) -> Query:
             elif "random_score" in fn:
                 functions.append(ScoreFunction("random_score", fn.get("weight", 1.0), filt,
                                                seed=int(fn["random_score"].get("seed", 0))))
+            elif "script_score" in fn:
+                src, prm = parse_script_spec(fn["script_score"].get("script"))
+                functions.append(ScoreFunction("script_score", fn.get("weight", 1.0),
+                                               filt, script=src, script_params=prm))
             elif "weight" in fn:
                 functions.append(ScoreFunction("weight", float(fn["weight"]), filt))
         q = FunctionScoreQuery(query=inner, functions=functions,
                                score_mode=body.get("score_mode", "multiply"),
                                boost_mode=body.get("boost_mode", "multiply"),
                                min_score=body.get("min_score"))
+        _common(q, body)
+        return q
+
+    if kind == "script":
+        src, prm = parse_script_spec(body.get("script"))
+        q = ScriptQuery(source=src, params=prm)
+        _common(q, body)
+        return q
+
+    if kind == "script_score":
+        src, prm = parse_script_spec(body.get("script"))
+        q = ScriptScoreQuery(query=parse_query(body.get("query")), source=src,
+                             params=prm, min_score=body.get("min_score"))
         _common(q, body)
         return q
 
@@ -494,6 +531,22 @@ def parse_query(dsl: Optional[dict]) -> Query:
         return q
 
     raise QueryParseError(f"unknown query [{kind}]")
+
+
+def parse_script_spec(spec) -> Tuple[str, dict]:
+    """{"source": ..., "params": ...} | "inline src" -> (source, params)
+    (reference Script.parse; `lang` is accepted and ignored — painless-lite
+    is the only engine)."""
+    if spec is None:
+        raise QueryParseError("missing required [script]")
+    if isinstance(spec, str):
+        return spec, {}
+    if isinstance(spec, dict):
+        src = spec.get("source", spec.get("inline"))
+        if not isinstance(src, str):
+            raise QueryParseError("script requires a [source] string")
+        return src, dict(spec.get("params") or {})
+    raise QueryParseError("malformed [script]")
 
 
 def _parse_distance(d) -> float:

@@ -1,0 +1,278 @@
+"""painless-lite tests: parser/interpreter semantics, device tracing parity,
+and every script context (query, score, fields, sort, update, ingest).
+Reference behaviors: `modules/lang-painless` + ScriptScoreQueryBuilder,
+UpdateHelper.executeScriptedUpsert, ScriptProcessor."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.script import ScriptError, execute
+from opensearch_tpu.script.painless_lite import (parse, referenced_doc_fields,
+                                                 validate_device_script)
+
+
+# ---------------------------------------------------------------- interpreter
+
+class TestInterpreter:
+    def test_arithmetic_precedence(self):
+        assert execute("1 + 2 * 3", {}) == 7
+        assert execute("(1 + 2) * 3", {}) == 9
+        assert execute("2 * 3 % 4", {}) == 2
+
+    def test_java_integer_division(self):
+        assert execute("7 / 2", {}) == 3
+        assert execute("-7 / 2", {}) == -3  # truncates toward zero
+        assert execute("7.0 / 2", {}) == 3.5
+        assert execute("-7 % 3", {}) == -1  # Java remainder keeps sign
+
+    def test_division_by_zero_raises(self):
+        with pytest.raises(ScriptError):
+            execute("1 / 0", {})
+
+    def test_string_concat(self):
+        assert execute("'a' + 'b' + 1", {}) == "ab1"
+
+    def test_ternary_and_bool(self):
+        assert execute("x > 3 ? 'big' : 'small'", {"x": 5}) == "big"
+        assert execute("true && false || true", {})
+        assert execute("!false", {})
+
+    def test_locals_and_blocks(self):
+        assert execute("def a = 2; def b = a * a; b + 1", {}) == 5
+
+    def test_if_else_chain(self):
+        src = "if (x < 0) { return 'neg' } else if (x == 0) { return 'zero' } else { return 'pos' }"
+        assert execute(src, {"x": -2}) == "neg"
+        assert execute(src, {"x": 0}) == "zero"
+        assert execute(src, {"x": 9}) == "pos"
+
+    def test_for_in_loop(self):
+        assert execute("def t = 0; for (v in vals) { t += v } return t",
+                       {"vals": [1, 2, 3]}) == 6
+
+    def test_math(self):
+        assert execute("Math.max(Math.abs(-3), 2)", {}) == 3
+        assert abs(execute("Math.pow(2, 10)", {}) - 1024) < 1e-9
+        assert abs(execute("Math.log(Math.E)", {}) - 1.0) < 1e-12
+
+    def test_string_methods(self):
+        assert execute("'Hello'.toLowerCase()", {}) == "hello"
+        assert execute("'hello world'.contains('wor')", {})
+        assert execute("'a,b,c'.split(',')", {}) == ["a", "b", "c"]
+        assert execute("'abc'.substring(1)", {}) == "bc"
+
+    def test_list_and_map_methods(self):
+        assert execute("def l = [1, 2]; l.add(3); l.size()", {}) == 3
+        assert execute("def m = ['a': 1]; m.put('b', 2); m.containsKey('b')", {})
+        assert execute("def m = [:]; m.isEmpty()", {})
+        assert execute("params.getOrDefault('missing', 42)", {"params": {}}) == 42
+
+    def test_compound_assignment_on_map(self):
+        ctx = {"_source": {"n": 10}}
+        execute("ctx._source.n *= 3", {"ctx": ctx})
+        assert ctx["_source"]["n"] == 30
+
+    def test_loop_limit(self):
+        with pytest.raises(ScriptError):
+            execute("def t = 0; for (v in vals) { t += v }",
+                    {"vals": list(range(200_001))})
+
+    def test_parse_error(self):
+        with pytest.raises(ScriptError):
+            parse("def = 1")
+        with pytest.raises(ScriptError):
+            parse("1 +")
+
+    def test_comments(self):
+        assert execute("// note\n1 + 1 /* mid */ + 1", {}) == 3
+
+    def test_referenced_doc_fields(self):
+        ast = parse("doc['a'].value + doc['b'].value * doc['a'].value")
+        assert referenced_doc_fields(ast) == ("a", "b")
+
+    def test_device_validation_rejects_if(self):
+        with pytest.raises(ScriptError):
+            validate_device_script("if (x > 1) { return 1 }")
+
+
+# ---------------------------------------------------------------- contexts
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("s", {"mappings": {"properties": {
+        "price": {"type": "float"}, "qty": {"type": "integer"},
+        "name": {"type": "text"}, "tag": {"type": "keyword"}}}})
+    docs = [(10.0, 2, "red shirt", "a"), (20.0, 1, "blue shirt", "b"),
+            (5.0, 7, "green hat", "a"), (40.0, 0, "red hat", "c")]
+    for i, (p, q, n, t) in enumerate(docs):
+        c.index("s", {"price": p, "qty": q, "name": n, "tag": t}, id=str(i))
+    c.indices.refresh("s")
+    return c
+
+
+class TestScriptQuery:
+    def test_filter_by_expression(self, client):
+        r = client.search("s", {"query": {"script": {"script": {
+            "source": "doc['price'].value * doc['qty'].value > params.t",
+            "params": {"t": 19}}}}})
+        assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["0", "1", "2"]
+
+    def test_missing_field_is_empty(self, client):
+        r = client.search("s", {"query": {"script": {"script": {
+            "source": "doc['nope'].empty"}}}})
+        assert r["hits"]["total"]["value"] == 4
+
+    def test_bad_script_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("s", {"query": {"script": {"script": {"source": "1 +"}}}})
+        assert ei.value.status == 400
+
+    def test_non_numeric_param_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("s", {"query": {"script": {"script": {
+                "source": "doc['price'].value > 1", "params": {"s": "x"}}}}})
+        assert ei.value.status == 400
+
+
+class TestScriptScoreQuery:
+    def test_replaces_score(self, client):
+        r = client.search("s", {"query": {"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "doc['price'].value + 1"}}}})
+        got = [(h["_id"], h["_score"]) for h in r["hits"]["hits"]]
+        assert got[0] == ("3", 41.0)
+        assert got[-1] == ("2", 6.0)
+
+    def test_score_variable_binds_child(self, client):
+        r = client.search("s", {"query": {"script_score": {
+            "query": {"match": {"name": "shirt"}},
+            "script": {"source": "_score * 0 + doc['qty'].value"}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got == {"0": 2.0, "1": 1.0}
+
+    def test_min_score_cuts(self, client):
+        r = client.search("s", {"query": {"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "doc['price'].value"},
+            "min_score": 15.0}}})
+        assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["1", "3"]
+
+    def test_params_reuse_compiled_program(self, client):
+        a = client.search("s", {"query": {"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "doc['price'].value * params.m",
+                       "params": {"m": 2.0}}}}})
+        b = client.search("s", {"query": {"script_score": {
+            "query": {"match_all": {}},
+            "script": {"source": "doc['price'].value * params.m",
+                       "params": {"m": 3.0}}}}})
+        sa = {h["_id"]: h["_score"] for h in a["hits"]["hits"]}
+        sb = {h["_id"]: h["_score"] for h in b["hits"]["hits"]}
+        assert sb["0"] == pytest.approx(sa["0"] * 1.5)
+
+    def test_function_score_script_function(self, client):
+        r = client.search("s", {"query": {"function_score": {
+            "query": {"match": {"name": "hat"}},
+            "functions": [{"script_score": {"script": {
+                "source": "Math.sqrt(doc['price'].value)"}}}],
+            "boost_mode": "replace"}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["3"] == pytest.approx(40 ** 0.5, rel=1e-5)
+        assert got["2"] == pytest.approx(5 ** 0.5, rel=1e-5)
+
+
+class TestScriptFieldsSortUpdate:
+    def test_script_fields(self, client):
+        r = client.search("s", {"query": {"ids": {"values": ["2"]}},
+                                "script_fields": {
+                                    "margin": {"script": {
+                                        "source": "doc['price'].value * 0.5"}},
+                                    "label": {"script": {
+                                        "source": "doc['tag'].value + '!'"}}}})
+        f = r["hits"]["hits"][0]["fields"]
+        assert f["margin"] == [2.5]
+        assert f["label"] == ["a!"]
+
+    def test_script_sort(self, client):
+        r = client.search("s", {"query": {"match_all": {}},
+                                "sort": [{"_script": {
+                                    "type": "number",
+                                    "script": {"source": "doc['qty'].value * -1"},
+                                    "order": "asc"}}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["2", "0", "1", "3"]
+
+    def test_scripted_update_and_noop(self, client):
+        client.update("s", "0", {"script": {
+            "source": "ctx._source.qty += params.n", "params": {"n": 10}}})
+        assert client.get("s", "0")["_source"]["qty"] == 12
+        r = client.update("s", "0", {"script": {"source": "ctx.op = 'none'"}})
+        assert r["result"] == "noop"
+
+    def test_scripted_update_delete(self, client):
+        client.update("s", "1", {"script": {
+            "source": "if (ctx._source.qty < 5) { ctx.op = 'delete' }"}})
+        assert not client.exists("s", "1")
+
+    def test_scripted_upsert(self, client):
+        client.update("s", "counter", {"scripted_upsert": True,
+                                       "upsert": {"n": 0},
+                                       "script": {"source": "ctx._source.n += 1"}})
+        assert client.get("s", "counter")["_source"]["n"] == 1
+        client.update("s", "counter", {"scripted_upsert": True,
+                                       "upsert": {"n": 0},
+                                       "script": {"source": "ctx._source.n += 1"}})
+        assert client.get("s", "counter")["_source"]["n"] == 2
+
+    def test_update_by_query_script(self, client):
+        client.update_by_query("s", {"query": {"term": {"tag": "a"}},
+                                     "script": {"source":
+                                                "ctx._source.flagged = true"}},
+                               refresh=True)
+        assert client.get("s", "0")["_source"].get("flagged") is True
+        assert client.get("s", "1")["_source"].get("flagged") is None
+
+    def test_noop_script_does_not_corrupt_stored_source(self, client):
+        # mutating nested state then op='none' must not leak into the segment
+        client.index("s", {"tags": ["x"]}, id="nest", refresh=True)
+        r = client.update("s", "nest", {"script": {
+            "source": "ctx._source.tags.add('evil'); ctx.op = 'none'"}})
+        assert r["result"] == "noop"
+        assert client.get("s", "nest")["_source"]["tags"] == ["x"]
+
+    def test_runtime_fault_maps_to_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.update("s", "0", {"script": {"source": "ctx._source.x = 1 % 0"}})
+        assert ei.value.status == 400
+
+    def test_device_trace_error_maps_to_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("s", {"query": {"script": {"script": {
+                "source": "doc['price'].values"}}}})
+        assert ei.value.status == 400
+
+    def test_search_after_with_script_sort_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("s", {"query": {"match_all": {}},
+                                "search_after": [1.0],
+                                "sort": [{"_script": {
+                                    "type": "number",
+                                    "script": {"source": "doc['qty'].value"}}}]})
+        assert ei.value.status == 400
+
+    def test_backslash_escape_decoding(self):
+        assert execute(r"'a\\nb'", {}) == "a\\nb"  # escaped backslash + n
+        assert execute(r"'a\nb'", {}) == "a\nb"    # real newline escape
+
+    def test_bad_ingest_script_rejected_at_put(self, client):
+        from opensearch_tpu.ingest.pipeline import IngestProcessorException
+        with pytest.raises((ApiError, IngestProcessorException)):
+            client.ingest.put_pipeline("bad", {"processors": [
+                {"script": {"source": "1 +"}}]})
+
+    def test_ingest_script_processor(self, client):
+        client.ingest.put_pipeline("calc", {"processors": [
+            {"script": {"source": "ctx.total = ctx.price * ctx.qty"}}]})
+        client.index("s", {"price": 3.0, "qty": 4}, id="x", pipeline="calc",
+                     refresh=True)
+        assert client.get("s", "x")["_source"]["total"] == 12.0
