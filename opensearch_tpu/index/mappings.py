@@ -144,6 +144,9 @@ class ParsedDocument:
     geos: Dict[str, List[Tuple[float, float]]] = dc_field(default_factory=dict)
     # field -> vector (one per doc)
     vectors: Dict[str, List[float]] = dc_field(default_factory=dict)
+    # nested path -> child ParsedDocuments (block-join children; reference
+    # NestedObjectMapper creates separate Lucene docs in the parent's block)
+    nested: Dict[str, List["ParsedDocument"]] = dc_field(default_factory=dict)
 
 
 class Mappings:
@@ -160,6 +163,7 @@ class Mappings:
         self.analysis = analysis or AnalysisRegistry()
         self.fields: Dict[str, FieldType] = {}
         self.aliases: Dict[str, str] = {}
+        self.nested_paths: set = set()
         self.dynamic = dynamic
         self.dynamic_templates: List[dict] = []
         self._meta: dict = {}
@@ -184,6 +188,8 @@ class Mappings:
                 self.aliases[path] = cfg["path"]
                 continue
             if ftype in ("object", "nested"):
+                if ftype == "nested":
+                    self.nested_paths.add(path)
                 self._merge_props(cfg.get("properties", {}), prefix=f"{path}.")
                 continue
             self.fields[path] = self._build_field(path, ftype, cfg)
@@ -236,6 +242,12 @@ class Mappings:
             if ft.subfields:
                 d["fields"] = {s: {"type": sf.type} for s, sf in ft.subfields.items()}
             node[parts[-1]] = d
+        for npath in sorted(self.nested_paths):
+            parts = npath.split(".")
+            node = props
+            for p in parts[:-1]:
+                node = node.setdefault(p, {}).setdefault("properties", {})
+            node.setdefault(parts[-1], {})["type"] = "nested"
         out = {"properties": props}
         if self._meta:
             out["_meta"] = self._meta
@@ -305,6 +317,29 @@ class Mappings:
     def _parse_obj(self, obj: dict, prefix: str, parsed: ParsedDocument) -> None:
         for key, value in obj.items():
             path = f"{prefix}{key}"
+            if path in self.nested_paths:
+                # block-join children: each object indexes as its own child
+                # doc (fields keep their full dotted path), attached to the
+                # nearest enclosing doc — multi-level nested paths therefore
+                # attach grandchildren to their child doc, and build_segment's
+                # recursion gives every level its own block
+                if value is None:
+                    continue  # explicit null nested value == missing
+                children = value if isinstance(value, list) else [value]
+                bucket = parsed.nested.setdefault(path, [])
+                for child_obj in children:
+                    if child_obj is None:
+                        continue
+                    if not isinstance(child_obj, dict):
+                        raise ValueError(
+                            f"object mapping for [{path}] tried to parse a "
+                            f"non-object value")
+                    child = ParsedDocument(
+                        doc_id=f"{parsed.doc_id}#{path}#{len(bucket)}",
+                        source=child_obj, routing=None)
+                    bucket.append(child)
+                    self._parse_obj(child_obj, f"{path}.", child)
+                continue
             if isinstance(value, dict):
                 ft = self.resolve_field(path)
                 if ft is not None and ft.type in GEO_TYPES:

@@ -199,9 +199,36 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
         doc_lens[f] = dl
         text_stats[f] = TextFieldStats(doc_count=int((dl > 0).sum()), sum_dl=int(dl.sum()))
 
+    # ---- nested blocks: drop children of deleted parents, remap parent ids ----
+    nested = {}
+    for npath in sorted({p for s in segments for p in s.nested}):
+        child_segs: List[Segment] = []
+        saved_lives: List[np.ndarray] = []
+        new_parent_parts: List[np.ndarray] = []
+        for s, dmap in zip(segments, doc_maps):
+            blk = s.nested.get(npath)
+            if blk is None or blk.child.ndocs == 0:
+                continue
+            keep = (dmap[blk.parent_of] >= 0) & blk.child.live
+            saved_lives.append(blk.child.live)
+            blk.child.live = keep  # temporary: drives the child compaction
+            child_segs.append(blk.child)
+            new_parent_parts.append(dmap[blk.parent_of[keep]].astype(np.int32))
+        if not child_segs:
+            continue
+        try:
+            merged_child = merge_segments(f"{name}/{npath}", child_segs)
+        finally:
+            for cs, old in zip(child_segs, saved_lives):
+                cs.live = old
+        parent_of = (np.concatenate(new_parent_parts) if new_parent_parts
+                     else np.empty(0, np.int32))
+        from .segment import NestedBlock
+        nested[npath] = NestedBlock(merged_child, parent_of)
+
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
                    doc_lens, text_stats, ids, sources, seq_nos=seq_nos,
-                   vector_cols=vector_cols)
+                   vector_cols=vector_cols, nested=nested)
 
 
 def _ranges_gather(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:

@@ -172,6 +172,24 @@ class TextFieldStats:
     sum_dl: int = 0           # total tokens across docs
 
 
+@dataclass
+class NestedBlock:
+    """Block-join children for one nested path: a full child-space Segment
+    (its docs are the nested objects, fields keyed by dotted path) plus the
+    child->parent doc map. The reference stores children as adjacent Lucene
+    docs in the parent's block (NestedObjectMapper/ToParentBlockJoinQuery);
+    here the child space is its own CSR segment and the join is a device
+    scatter-reduce over `parent_of`."""
+
+    child: "Segment"
+    parent_of: np.ndarray  # i32[child.ndocs], nondecreasing (doc order)
+
+    def children_of(self, parent_doc: int) -> Tuple[int, int]:
+        a = int(np.searchsorted(self.parent_of, parent_doc, side="left"))
+        b = int(np.searchsorted(self.parent_of, parent_doc, side="right"))
+        return a, b
+
+
 class Segment:
     """One immutable searchable unit (analog of a Lucene segment + its
     SegmentReader, reference `index/engine/Engine.java#acquireSearcher`)."""
@@ -187,7 +205,8 @@ class Segment:
                  text_stats: Dict[str, TextFieldStats],
                  ids: List[str], sources: List[dict],
                  seq_nos: Optional[np.ndarray] = None,
-                 vector_cols: Optional[Dict[str, VectorColumn]] = None):
+                 vector_cols: Optional[Dict[str, VectorColumn]] = None,
+                 nested: Optional[Dict[str, NestedBlock]] = None):
         self.name = name
         self.ndocs = ndocs
         self.postings = postings
@@ -197,6 +216,7 @@ class Segment:
         self.vector_cols = vector_cols or {}
         self.doc_lens = doc_lens
         self.text_stats = text_stats
+        self.nested: Dict[str, NestedBlock] = nested or {}
         self.ids = ids
         self.sources = sources
         self.seq_nos = seq_nos if seq_nos is not None else np.zeros(ndocs, dtype=np.int64)
@@ -284,9 +304,18 @@ class Segment:
                    for f, dl in self.doc_lens.items()}
             # NOTE: values must all be arrays — plain ints would become traced
             # jit arguments and poison static shape derivation downstream
+            nst = {}
+            for path, blk in self.nested.items():
+                carr = dict(blk.child.device_arrays())
+                cpad = blk.child.ndocs_pad
+                # padded children map to parent 0 but carry live=0, so every
+                # scatter-reduce contribution from padding is identically zero
+                carr["parent"] = jnp.asarray(
+                    _pad_to(blk.parent_of.astype(np.int32), cpad, np.int32(0)))
+                nst[path] = carr
             self._device = {
                 "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
-                "vector": vcols, "doc_lens": dls,
+                "vector": vcols, "doc_lens": dls, "nested": nst,
             }
         if self._device_live_dirty:
             import jax.numpy as jnp
@@ -298,6 +327,8 @@ class Segment:
     def drop_device(self) -> None:
         self._device = None
         self._device_live_dirty = True
+        for blk in self.nested.values():
+            blk.child.drop_device()
 
     # ---------------- persistence (flush/commit) ----------------
 
@@ -342,6 +373,11 @@ class Segment:
             meta["vector"][f] = {"similarity": col.similarity}
         for f, dl in self.doc_lens.items():
             arrays[f"dl__{f}"] = dl
+        meta["nested"] = sorted(self.nested)
+        for npath, blk in self.nested.items():
+            sub = os.path.join(path, f"nested__{npath.replace('/', '_')}")
+            blk.child.save(sub)
+            arrays[f"nested__{npath}__parent"] = blk.parent_of
         np.savez_compressed(os.path.join(path, "arrays.npz"), **arrays)
         with open(os.path.join(path, "meta.json"), "w") as fh:
             json.dump(meta, fh)
@@ -391,9 +427,15 @@ class Segment:
                                    m.get("similarity", "cosine"))
                    for f, m in meta.get("vector", {}).items()}
         doc_lens = {k[len("dl__"):]: arrays[k] for k in arrays.files if k.startswith("dl__")}
+        nested = {}
+        for npath in meta.get("nested", []):
+            sub = os.path.join(path, f"nested__{npath.replace('/', '_')}")
+            nested[npath] = NestedBlock(cls.load(sub),
+                                        arrays[f"nested__{npath}__parent"])
         seg = cls(meta["name"], meta["ndocs"], postings, numeric, keyword, geo, doc_lens,
                   {f: TextFieldStats(dc, sd) for f, (dc, sd) in meta["text_stats"].items()},
-                  ids, sources, seq_nos=arrays["seq_nos"], vector_cols=vectors)
+                  ids, sources, seq_nos=arrays["seq_nos"], vector_cols=vectors,
+                  nested=nested)
         seg.live = arrays["live"].copy()
         seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
         return seg
@@ -538,7 +580,22 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
             fname, values, present,
             ft.vector_similarity if ft is not None else "cosine")
 
+    # ---- nested blocks: child docs become their own CSR segment ----
+    nested_paths = {p for pd in parsed_docs for p in pd.nested}
+    nested: Dict[str, NestedBlock] = {}
+    for npath in sorted(nested_paths):
+        child_docs: List[Any] = []
+        parent_of: List[int] = []
+        for doc_i, pd in enumerate(parsed_docs):
+            for child in pd.nested.get(npath, ()):
+                child_docs.append(child)
+                parent_of.append(doc_i)
+        child_seg = build_segment(f"{name}/{npath}", child_docs, mappings,
+                                  with_positions=with_positions)
+        nested[npath] = NestedBlock(child_seg,
+                                    np.asarray(parent_of, dtype=np.int32))
+
     seq = np.asarray(seq_nos, dtype=np.int64) if seq_nos is not None else None
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
                    doc_lens, text_stats, ids, sources, seq_nos=seq,
-                   vector_cols=vector_cols)
+                   vector_cols=vector_cols, nested=nested)

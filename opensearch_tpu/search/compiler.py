@@ -240,6 +240,20 @@ class LFuncScore(LNode):
 
 
 @dataclass
+class LNested(LNode):
+    """Block-join to-parent query: the child subtree executes in the nested
+    path's child doc space (its own CSR arrays), then scores reduce to the
+    parent space via scatter-add/max over the child->parent map (reference
+    ToParentBlockJoinQuery; design per SURVEY §2.2 nested = doc-block)."""
+
+    path: str = ""
+    child: Optional[LNode] = None
+    child_ctx: Optional["ShardContext"] = None
+    score_mode: str = "avg"
+    boost: float = 1.0
+
+
+@dataclass
 class LScriptFilter(LNode):
     """`script` query: filter where the traced expression is truthy. The AST
     (hashable tuples) lives in the jit-static spec; numeric script params are
@@ -639,10 +653,43 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
                           min_score=q.min_score, boost=q.boost)
 
     if isinstance(q, dsl.NestedQuery):
-        # r1: nested docs are indexed flattened, so delegate to the inner query
-        return rewrite(q.query, ctx, scoring)
+        if q.path not in m.nested_paths:
+            if q.ignore_unmapped:
+                return LMatchNone()
+            raise dsl.QueryParseError(
+                f"[nested] failed to find nested object under path [{q.path}]")
+        # multi-level path queried from an outer level: blocks live on the
+        # intermediate child segments, so route through the nested chain
+        # (nested(a, nested(a.b, q)) — reference resolves the chain the same
+        # way via parent filters)
+        if not any(q.path in s.nested for s in ctx.segments):
+            parts = q.path.split(".")
+            for cut in range(len(parts) - 1, 0, -1):
+                pfx = ".".join(parts[:cut])
+                if pfx in m.nested_paths and any(pfx in s.nested
+                                                 for s in ctx.segments):
+                    inner_q = dsl.NestedQuery(path=q.path, query=q.query,
+                                              score_mode=q.score_mode,
+                                              ignore_unmapped=q.ignore_unmapped)
+                    outer = dsl.NestedQuery(path=pfx, query=inner_q,
+                                            score_mode=q.score_mode,
+                                            boost=q.boost)
+                    return _rewrite(outer, ctx, scoring)
+        child_ctx = nested_context(ctx, q.path)
+        inner = rewrite(q.query, child_ctx, scoring)
+        return LNested(path=q.path, child=inner, child_ctx=child_ctx,
+                       score_mode=q.score_mode, boost=q.boost)
 
     raise dsl.QueryParseError(f"cannot compile query {type(q).__name__}")
+
+
+def nested_context(ctx: ShardContext, path: str) -> ShardContext:
+    """Child-space statistics context: BM25 idf/avgdl over the nested path's
+    child docs (Lucene computes stats over child Lucene docs the same way)."""
+    child_segs = [s.nested[path].child for s in ctx.segments if path in s.nested]
+    return ShardContext(ctx.mappings, child_segs,
+                        similarity=ctx.default_sim,
+                        field_similarities=ctx.field_sims)
 
 
 def _rewrite_query_string(q, ctx: ShardContext, scoring: bool) -> LNode:
@@ -1023,6 +1070,14 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("fnscore", nid, child_spec, tuple(fn_specs),
                 node.score_mode, node.boost_mode)
 
+    if isinstance(node, LNested):
+        blk = seg.nested.get(node.path)
+        if blk is None or blk.child.ndocs == 0:
+            return ("match_none", nid)
+        child_spec = prepare(node.child, blk.child, node.child_ctx, params)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("nested", nid, node.path, node.score_mode, child_spec)
+
     if isinstance(node, LScriptFilter):
         field_srcs, pkeys = _prepare_script(node.ast, node.params, seg, params,
                                             nid, "s")
@@ -1142,6 +1197,11 @@ def can_match(node: LNode, seg: Segment) -> bool:
         return True
     if isinstance(node, LConstScore):
         return can_match(node.child, seg)
+    if isinstance(node, LNested):
+        blk = seg.nested.get(node.path)
+        if blk is None or blk.child.ndocs == 0:
+            return False
+        return can_match(node.child, blk.child)
     if isinstance(node, LMatchNone):
         return False
     return True
@@ -1357,6 +1417,34 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         matched = child.matched & (scores >= params[f"q{nid}_minscore"])
         scores = jnp.where(matched, scores, 0.0)
         return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "nested":
+        _, _, path, score_mode, child_spec = spec
+        carr = dict(seg_arrays["nested"][path])
+        parent = carr["parent"]
+        # child liveness inherits the parent's delete mask via a gather
+        carr["live"] = carr["live"] * live[parent]
+        sm = emit(child_spec, carr, params)
+        cmatch = sm.matched
+        cscore = jnp.where(cmatch, sm.scores, 0.0)
+        cnt = zeros.at[parent].add(cmatch.astype(jnp.float32))
+        pmatch = cnt > 0
+        if score_mode == "none":
+            pscores = pmatch.astype(jnp.float32)
+        elif score_mode == "max":
+            neg_inf = jnp.full(ndocs_pad, -jnp.inf, jnp.float32)
+            mx = neg_inf.at[parent].max(jnp.where(cmatch, sm.scores, -jnp.inf))
+            pscores = jnp.where(pmatch, mx, 0.0)
+        elif score_mode == "min":
+            pos_inf = jnp.full(ndocs_pad, jnp.inf, jnp.float32)
+            mn = pos_inf.at[parent].min(jnp.where(cmatch, sm.scores, jnp.inf))
+            pscores = jnp.where(pmatch, mn, 0.0)
+        else:
+            total = zeros.at[parent].add(cscore)
+            pscores = total / jnp.maximum(cnt, 1.0) if score_mode == "avg" else total
+        pmatch = pmatch & (live > 0)
+        pscores = jnp.where(pmatch, pscores * params[f"q{nid}_boost"], 0.0)
+        return ops.ScoredMask(pscores, pmatch.astype(jnp.float32))
 
     if kind == "script":
         _, _, ast, field_srcs, pkeys = spec

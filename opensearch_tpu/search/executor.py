@@ -255,8 +255,11 @@ class ShardSearcher:
         # query phase scored with, or _explanation diverges from _score
         ctx = stats_ctx or C.ShardContext(self.engine.mappings, result.segments,
                                           self.similarity, self.field_similarities)
-        lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx, scoring=True)
+        qtree = dsl.parse_query(body.get("query"))
+        lroot = C.rewrite(qtree, ctx, scoring=True)
         hl_terms = collect_query_terms(lroot) if body.get("highlight") else {}
+        nested_ihs = _nested_queries_with_inner_hits(qtree)
+        ih_cache: Dict[Tuple[int, int], Any] = {}
         hits = []
         for c in selected:
             seg = result.segments[c.seg_ord]
@@ -266,8 +269,49 @@ class ShardSearcher:
                 hit["matched_queries"] = names
             if body.get("explain"):
                 hit["_explanation"] = explain_doc(lroot, seg, c.local_doc, ctx)
+            for nq in nested_ihs:
+                self._add_inner_hits(hit, nq, seg, c, ctx, ih_cache)
             hits.append(hit)
         return hits
+
+    def _add_inner_hits(self, hit: dict, nq: dsl.NestedQuery, seg: Segment,
+                        c: Candidate, ctx, ih_cache: dict) -> None:
+        """Matching child docs for one nested query (reference InnerHitsContext
+        / InnerHitsPhase): one device pass scores the whole child space per
+        segment, then each parent slices its block."""
+        blk = seg.nested.get(nq.path)
+        if blk is None or blk.child.ndocs == 0:
+            return
+        ih = nq.inner_hits or {}
+        name = ih.get("name", nq.path)
+        key = (id(nq), c.seg_ord)
+        if key not in ih_cache:
+            child_ctx = C.nested_context(ctx, nq.path)
+            inner_l = C.rewrite(nq.query, child_ctx, scoring=True)
+            cparams: Dict[str, Any] = {}
+            cspec = C.prepare(inner_l, blk.child, child_ctx, cparams)
+            docs = np.arange(blk.child.ndocs_pad, dtype=np.int32)
+            scores, matched = C.run_gather_scores(
+                cspec, blk.child.device_arrays(), cparams, docs)
+            ih_cache[key] = (np.asarray(scores), np.asarray(matched))
+        scores, matched = ih_cache[key]
+        a, b = blk.children_of(c.local_doc)
+        kids = [(float(scores[i]), i) for i in range(a, b) if matched[i]]
+        kids.sort(key=lambda t: -t[0])
+        frm = int(ih.get("from", 0))
+        size = int(ih.get("size", 3))
+        child_hits = []
+        for sc, i in kids[frm: frm + size]:
+            ch = {"_index": hit.get("_index", ""), "_id": hit["_id"],
+                  "_nested": {"field": nq.path, "offset": i - a},
+                  "_score": sc}
+            if ih.get("_source", True) is not False:
+                ch["_source"] = blk.child.sources[i]
+            child_hits.append(ch)
+        hit.setdefault("inner_hits", {})[name] = {
+            "hits": {"total": {"value": len(kids), "relation": "eq"},
+                     "max_score": kids[0][0] if kids else None,
+                     "hits": child_hits}}
 
     def _fetch_one(self, seg: Segment, c: Candidate, body: dict,
                    hl_terms: Optional[dict] = None) -> dict:
@@ -450,6 +494,26 @@ def _aggs_need_all_segments(agg_nodes) -> bool:
         if _aggs_need_all_segments(n.subs):
             return True
     return False
+
+
+def _nested_queries_with_inner_hits(q) -> List[dsl.NestedQuery]:
+    out: List[dsl.NestedQuery] = []
+
+    def walk(node):
+        if not hasattr(node, "__dataclass_fields__"):
+            return
+        if isinstance(node, dsl.NestedQuery) and node.inner_hits is not None:
+            out.append(node)
+        for fname in node.__dataclass_fields__:
+            v = getattr(node, fname)
+            if isinstance(v, dsl.Query):
+                walk(v)
+            elif isinstance(v, list):
+                for x in v:
+                    if isinstance(x, dsl.Query):
+                        walk(x)
+    walk(q)
+    return out
 
 
 def _collect_named(lroot) -> List[Tuple[str, Any]]:
@@ -939,6 +1003,61 @@ def explain_doc(lroot, seg: Segment, doc: int, ctx) -> dict:
             total = best + n.tie_breaker * (sum(v for v, _ in vals) - best)
             return total, {"value": total, "description": "max plus tie_breaker of:",
                            "details": [d for _, d in vals]}
+        from .compiler import LExists, LMatchAll, LRange
+        if isinstance(n, LRange):
+            col = seg.numeric_cols.get(n.field)
+            ok = col is not None and bool(col.present[doc])
+            if ok:
+                v = float(col.values[doc])
+                if n.lo is not None:
+                    ok = v >= float(n.lo) if n.include_lo else v > float(n.lo)
+                if ok and n.hi is not None:
+                    ok = v <= float(n.hi) if n.include_hi else v < float(n.hi)
+            val = n.boost if ok else 0.0
+            return val, {"value": val,
+                         "description": f"range filter on [{n.field}]", "details": []}
+        if isinstance(n, LMatchAll):
+            return n.boost, {"value": n.boost, "description": "*:*", "details": []}
+        if isinstance(n, LExists):
+            ok = ((n.field in seg.numeric_cols and bool(seg.numeric_cols[n.field].present[doc]))
+                  or (n.field in seg.keyword_cols and int(seg.keyword_cols[n.field].min_ord[doc]) >= 0)
+                  or (n.field in seg.doc_lens and int(seg.doc_lens[n.field][doc]) > 0))
+            val = n.boost if ok else 0.0
+            return val, {"value": val,
+                         "description": f"exists [{n.field}]", "details": []}
+        from .compiler import LNested
+        if isinstance(n, LNested):
+            blk = seg.nested.get(n.path)
+            if blk is None or blk.child.ndocs == 0:
+                return 0.0, {"value": 0.0, "description": "no nested docs",
+                             "details": []}
+            # match/score truth comes from the same device program the query
+            # ran (host explains can't see filter-context matches); the host
+            # child explains are attached as details only
+            from . import compiler as _C
+            cparams: Dict[str, Any] = {}
+            cspec = _C.prepare(n.child, blk.child, n.child_ctx, cparams)
+            a, b = blk.children_of(doc)
+            docs = np.arange(blk.child.ndocs_pad, dtype=np.int32)
+            csc, cm = _C.run_gather_scores(cspec, blk.child.device_arrays(),
+                                           cparams, docs)
+            csc, cm = np.asarray(csc), np.asarray(cm)
+            vals = [float(csc[i]) for i in range(a, b) if cm[i]]
+            if not vals:
+                return 0.0, {"value": 0.0,
+                             "description": f"no matching children in [{n.path}]",
+                             "details": []}
+            mode = n.score_mode
+            total = (sum(vals) / len(vals) if mode == "avg" else
+                     max(vals) if mode == "max" else
+                     min(vals) if mode == "min" else
+                     1.0 if mode == "none" else sum(vals))
+            total *= n.boost
+            details = [explain_doc(n.child, blk.child, cd, n.child_ctx)
+                       for cd in range(a, b) if cm[cd]]
+            return total, {"value": total,
+                           "description": f"nested [{n.path}] {mode} of children:",
+                           "details": details}
         return 0.0, {"value": 0.0, "description": type(n).__name__, "details": []}
 
     _, expl = walk(lroot)

@@ -261,7 +261,9 @@ class KnnQuery(Query):
 class NestedQuery(Query):
     path: str = ""
     query: Optional[Query] = None
-    score_mode: str = "avg"
+    score_mode: str = "avg"   # avg | sum | max | min | none
+    ignore_unmapped: bool = False
+    inner_hits: Optional[dict] = None
 
 
 def _one_entry(d: dict, what: str) -> Tuple[str, Any]:
@@ -526,7 +528,9 @@ def parse_query(dsl: Optional[dict]) -> Query:
 
     if kind == "nested":
         q = NestedQuery(path=body["path"], query=parse_query(body["query"]),
-                        score_mode=body.get("score_mode", "avg"))
+                        score_mode=body.get("score_mode", "avg"),
+                        ignore_unmapped=bool(body.get("ignore_unmapped", False)),
+                        inner_hits=body.get("inner_hits"))
         _common(q, body)
         return q
 

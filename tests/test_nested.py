@@ -1,0 +1,239 @@
+"""Nested field / block-join tests. Reference semantics:
+NestedObjectMapper (child Lucene docs), ToParentBlockJoinQuery score modes,
+InnerHitsPhase. Ours: child-space CSR segments + device scatter-reduce."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("n", {"mappings": {"properties": {
+        "title": {"type": "text"},
+        "comments": {"type": "nested", "properties": {
+            "author": {"type": "keyword"},
+            "stars": {"type": "integer"},
+            "text": {"type": "text"}}}}}})
+    c.index("n", {"title": "post one", "comments": [
+        {"author": "alice", "stars": 5, "text": "great post"},
+        {"author": "bob", "stars": 1, "text": "terrible post"}]}, id="1")
+    c.index("n", {"title": "post two", "comments": [
+        {"author": "alice", "stars": 2, "text": "meh"}]}, id="2")
+    c.index("n", {"title": "post three"}, id="3")
+    c.indices.refresh("n")
+    return c
+
+
+class TestNestedQuery:
+    def test_same_child_conjunction(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"bool": {"must": [
+                {"term": {"comments.author": "alice"}},
+                {"term": {"comments.stars": 5}}]}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_cross_child_conjunction_does_not_match(self, client):
+        # bob wrote stars=1; stars=2 belongs to a different child -> no hit.
+        # (A flattened object mapping WOULD match doc 1 here.)
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"bool": {"must": [
+                {"term": {"comments.author": "bob"}},
+                {"term": {"comments.stars": 2}}]}}}}})
+        assert r["hits"]["hits"] == []
+
+    def test_score_modes(self, client):
+        def score(mode):
+            r = client.search("n", {"query": {"nested": {
+                "path": "comments", "score_mode": mode,
+                "query": {"function_score": {
+                    "query": {"match_all": {}},
+                    "functions": [{"script_score": {"script": {
+                        "source": "doc['comments.stars'].value"}}}],
+                    "boost_mode": "replace"}}}}})
+            return {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert score("avg") == {"1": 3.0, "2": 2.0}
+        assert score("sum") == {"1": 6.0, "2": 2.0}
+        assert score("max") == {"1": 5.0, "2": 2.0}
+        assert score("min") == {"1": 1.0, "2": 2.0}
+        assert score("none") == {"1": 1.0, "2": 1.0}
+
+    def test_text_child_search_with_bm25(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "post"}}}}})
+        assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["1"]
+        assert r["hits"]["hits"][0]["_score"] > 0
+
+    def test_in_bool_with_parent_clause(self, client):
+        r = client.search("n", {"query": {"bool": {"must": [
+            {"match": {"title": "post"}},
+            {"nested": {"path": "comments",
+                        "query": {"term": {"comments.author": "alice"}}}}]}}})
+        assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["1", "2"]
+
+    def test_unmapped_path_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("n", {"query": {"nested": {
+                "path": "nope", "query": {"match_all": {}}}}})
+        assert ei.value.status == 400
+
+    def test_ignore_unmapped(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "nope", "query": {"match_all": {}},
+            "ignore_unmapped": True}}})
+        assert r["hits"]["hits"] == []
+
+    def test_range_on_child(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"range": {"comments.stars": {"gte": 3}}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_explain(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"term": {"comments.stars": 5}}}}, "explain": True})
+        expl = r["hits"]["hits"][0]["_explanation"]
+        assert "nested" in expl["description"]
+        assert expl["value"] == pytest.approx(r["hits"]["hits"][0]["_score"], rel=1e-4)
+
+
+class TestInnerHits:
+    def test_basic(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"term": {"comments.author": "alice"}},
+            "inner_hits": {}}}})
+        by_id = {h["_id"]: h for h in r["hits"]["hits"]}
+        ih = by_id["1"]["inner_hits"]["comments"]["hits"]
+        assert ih["total"]["value"] == 1
+        assert ih["hits"][0]["_source"]["stars"] == 5
+        assert ih["hits"][0]["_nested"] == {"field": "comments", "offset": 0}
+
+    def test_named_and_sized(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "post"}},
+            "inner_hits": {"name": "c", "size": 1}}}})
+        h = r["hits"]["hits"][0]
+        ih = h["inner_hits"]["c"]["hits"]
+        assert ih["total"]["value"] == 2
+        assert len(ih["hits"]) == 1
+        # best-scoring child first
+        assert ih["max_score"] == ih["hits"][0]["_score"]
+
+    def test_source_disabled(self, client):
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"term": {"comments.author": "bob"}},
+            "inner_hits": {"_source": False}}}})
+        ih = r["hits"]["hits"][0]["inner_hits"]["comments"]["hits"]["hits"][0]
+        assert "_source" not in ih
+
+
+class TestMultiLevelNested:
+    @pytest.fixture
+    def deep(self):
+        c = RestClient()
+        c.indices.create("m", {"mappings": {"properties": {
+            "comments": {"type": "nested", "properties": {
+                "author": {"type": "keyword"},
+                "replies": {"type": "nested", "properties": {
+                    "who": {"type": "keyword"}}}}}}}})
+        c.index("m", {"comments": [
+            {"author": "alice", "replies": [{"who": "bob"}, {"who": "carol"}]},
+            {"author": "dan", "replies": [{"who": "erin"}]}]}, id="1")
+        c.index("m", {"comments": [{"author": "bob", "replies": None}]}, id="2")
+        c.index("m", {"comments": None}, id="3")  # explicit null == missing
+        c.indices.refresh("m")
+        return c
+
+    def test_explicit_chain(self, deep):
+        r = deep.search("m", {"query": {"nested": {
+            "path": "comments", "query": {"nested": {
+                "path": "comments.replies",
+                "query": {"term": {"comments.replies.who": "erin"}}}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_direct_multilevel_path(self, deep):
+        r = deep.search("m", {"query": {"nested": {
+            "path": "comments.replies",
+            "query": {"term": {"comments.replies.who": "carol"}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_cross_level_conjunction_does_not_match(self, deep):
+        r = deep.search("m", {"query": {"nested": {
+            "path": "comments", "query": {"bool": {"must": [
+                {"term": {"comments.author": "dan"}},
+                {"nested": {"path": "comments.replies",
+                            "query": {"term": {"comments.replies.who": "bob"}}}}]}}}}})
+        assert r["hits"]["hits"] == []
+
+    def test_explain_filter_only_child_matches(self, deep):
+        r = deep.search("m", {"query": {"nested": {
+            "path": "comments", "score_mode": "none",
+            "query": {"bool": {"filter": [
+                {"term": {"comments.author": "alice"}}]}}}},
+            "explain": True})
+        h = r["hits"]["hits"][0]
+        assert h["_explanation"]["value"] == pytest.approx(h["_score"], rel=1e-4)
+
+
+class TestNestedLifecycle:
+    def test_delete_parent_hides_children(self, client):
+        client.delete("n", "1", refresh=True)
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments",
+            "query": {"term": {"comments.author": "bob"}}}}})
+        assert r["hits"]["hits"] == []
+
+    def test_update_parent_replaces_children(self, client):
+        client.index("n", {"title": "post one v2", "comments": [
+            {"author": "carol", "stars": 4, "text": "nice"}]}, id="1",
+            refresh=True)
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments", "query": {"term": {"comments.author": "bob"}}}}})
+        assert r["hits"]["hits"] == []
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments", "query": {"term": {"comments.author": "carol"}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_multi_segment(self, client):
+        client.index("n", {"title": "post four", "comments": [
+            {"author": "dave", "stars": 3, "text": "ok"}]}, id="4",
+            refresh=True)  # second segment
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments", "query": {"range": {"comments.stars": {"gte": 2}}}}}})
+        assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["1", "2", "4"]
+
+    def test_force_merge_preserves_nested(self, client):
+        client.index("n", {"title": "post four", "comments": [
+            {"author": "dave", "stars": 3, "text": "ok"}]}, id="4", refresh=True)
+        client.delete("n", "2", refresh=True)
+        client.indices.forcemerge("n")
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments", "query": {"term": {"comments.author": "alice"}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+        r = client.search("n", {"query": {"nested": {
+            "path": "comments", "query": {"term": {"comments.author": "dave"}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["4"]
+
+    def test_flush_and_reload(self, client, tmp_data_path):
+        c = RestClient(data_path=tmp_data_path)
+        c.indices.create("n", {"mappings": {"properties": {
+            "comments": {"type": "nested", "properties": {
+                "author": {"type": "keyword"}}}}}})
+        c.index("n", {"comments": [{"author": "zoe"}]}, id="1", refresh=True)
+        c.indices.flush("n")
+        c2 = RestClient(data_path=tmp_data_path)
+        r = c2.search("n", {"query": {"nested": {
+            "path": "comments", "query": {"term": {"comments.author": "zoe"}}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_mapping_roundtrip_keeps_nested_type(self, client):
+        m = client.indices.get_mapping("n")["n"]["mappings"]
+        assert m["properties"]["comments"]["type"] == "nested"
