@@ -26,7 +26,26 @@ class Analyzer:
     token_filters: List[TokenFilter] = field(default_factory=list)
     char_filters: List[CharFilter] = field(default_factory=list)
 
+    def _std_fast(self) -> bool:
+        """True when this chain is exactly standard-tokenize + lowercase with
+        no char filters — the shape the native ASCII tokenizer implements."""
+        cached = getattr(self, "_std_fast_cache", None)
+        if cached is None:
+            cached = (self.tokenizer is standard_tokenizer
+                      and self.token_filters == [lowercase_filter]
+                      and not self.char_filters)
+            if cached:
+                from .. import native
+                cached = native.available()
+            object.__setattr__(self, "_std_fast_cache", cached)
+        return cached
+
     def analyze(self, text: str) -> List[Token]:
+        if self._std_fast() and text.isascii():
+            from .. import native
+            low = text.lower()
+            return [Token(low[s:e], i, int(s), int(e))
+                    for i, (s, e) in enumerate(native.tokenize_ascii(text))]
         for cf in self.char_filters:
             text = cf(text)
         tokens = self.tokenizer(text)

@@ -48,7 +48,11 @@ def murmur3_x86_32(data: bytes, seed: int = 0) -> int:
 
 
 def shard_for(routing: str, num_shards: int) -> int:
-    h = murmur3_x86_32(routing.encode("utf-8"))
+    from .. import native
+    if native.available():
+        h = native.murmur3(routing.encode("utf-8"))
+    else:
+        h = murmur3_x86_32(routing.encode("utf-8"))
     # Java floorMod on the signed 32-bit value
     signed = h - (1 << 32) if h >= (1 << 31) else h
     return signed % num_shards

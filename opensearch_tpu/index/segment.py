@@ -441,34 +441,18 @@ class Segment:
         return seg
 
 
-def build_segment(name: str, parsed_docs: list, mappings: Mappings,
-                  seq_nos: Optional[List[int]] = None,
-                  with_positions: bool = True) -> Segment:
-    """Build an immutable segment from buffered parsed docs (the refresh path,
-    analog of Lucene DWPT flush driven by reference
-    `index/engine/InternalEngine.java#refresh`)."""
-    ndocs = len(parsed_docs)
-    ids = [d.doc_id for d in parsed_docs]
-    sources = [d.source for d in parsed_docs]
-
-    # ---- inverted fields ----
+def _pack_postings_python(parsed_docs: list, with_positions: bool) -> Dict[str, PostingsBlock]:
+    """Pure-Python postings pack (dict accumulate -> sort -> CSR). Reference
+    semantics: one posting per (term, doc) with tf; positions flattened in
+    ascending order per posting."""
     field_term_docs: Dict[str, Dict[str, dict]] = {}
     field_term_pos: Dict[str, Dict[str, dict]] = {}
-    doc_lens: Dict[str, np.ndarray] = {}
-    text_stats: Dict[str, TextFieldStats] = {}
     for doc_i, pd in enumerate(parsed_docs):
         for fname, terms in pd.terms.items():
             td = field_term_docs.setdefault(fname, {})
             for t in terms:
                 postings = td.setdefault(t, {})
                 postings[doc_i] = postings.get(doc_i, 0) + 1
-            ft = mappings.resolve_field(fname)
-            if ft is not None and ft.type == "text":
-                stats = text_stats.setdefault(fname, TextFieldStats())
-                stats.doc_count += 1
-                stats.sum_dl += len(terms)
-                dl = doc_lens.setdefault(fname, np.zeros(ndocs, dtype=np.int64))
-                dl[doc_i] = len(terms)
         if with_positions:
             for fname, tps in pd.positions.items():
                 tp = field_term_pos.setdefault(fname, {})
@@ -507,6 +491,99 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                                     dtype=np.int32, count=int(pos_starts[-1]))
         postings[fname] = PostingsBlock(fname, vocab, terms, starts, doc_ids, tfs,
                                         pos_starts, positions)
+    return postings
+
+
+def pack_postings(parsed_docs: list, with_positions: bool) -> Dict[str, PostingsBlock]:
+    """Pack buffered per-doc term lists into CSR PostingsBlocks. Uses the
+    native C++ packer (native/opensearch_native.cpp: intern -> sort ->
+    CSR scan) when built; falls back to the Python path per-field otherwise
+    (bit-identical output — tests/test_native.py asserts parity)."""
+    from .. import native
+
+    if not native.available():
+        return _pack_postings_python(parsed_docs, with_positions)
+
+    # flatten the token stream per field
+    field_tokens: Dict[str, List[str]] = {}
+    field_counts: Dict[str, List[Tuple[int, int]]] = {}
+    field_pos: Dict[str, List[int]] = {}
+    fallback_fields: set = set()
+    for doc_i, pd in enumerate(parsed_docs):
+        for fname, terms in pd.terms.items():
+            bucket = field_tokens.setdefault(fname, [])  # empty lists still
+            if not terms:                                # register the field
+                continue
+            bucket.extend(terms)
+            field_counts.setdefault(fname, []).append((doc_i, len(terms)))
+            if with_positions:
+                pl = pd.positions.get(fname)
+                if pl is not None:
+                    if len(pl) != len(terms):
+                        fallback_fields.add(fname)  # mis-aligned stream
+                    field_pos.setdefault(fname, []).extend(p for _, p in pl)
+
+    out: Dict[str, PostingsBlock] = {}
+    python_fields: List[str] = []
+    for fname, tokens in field_tokens.items():
+        joined = "\x00".join(tokens)
+        if fname in fallback_fields or (
+                tokens and joined.count("\x00") != len(tokens) - 1):
+            python_fields.append(fname)  # embedded NUL in a token
+            continue
+        pairs = field_counts.get(fname, [])
+        docs = np.fromiter((d for d, _ in pairs), np.int32, count=len(pairs))
+        cnts = np.fromiter((c for _, c in pairs), np.int64, count=len(pairs))
+        doc_of = np.repeat(docs, cnts)
+        has_pos = with_positions and fname in field_pos
+        pos_arr = (np.fromiter(field_pos[fname], np.int32, count=len(tokens))
+                   if has_pos else None)
+        packer = native.Packer(with_positions=has_pos)
+        packer.add(joined, len(tokens), doc_of, pos_arr)
+        vocab, starts, doc_ids, tfs, pos_starts, positions = packer.finish()
+        packer.close()
+        if with_positions and not has_pos:
+            # fields indexed without positions (keyword/ip) still carry an
+            # all-empty positions CSR when the segment is positional — same
+            # as the Python path
+            pos_starts = np.zeros(len(doc_ids) + 1, dtype=np.int64)
+            positions = np.empty(0, dtype=np.int32)
+        out[fname] = PostingsBlock(fname, vocab, {t: i for i, t in enumerate(vocab)},
+                                   starts, doc_ids, tfs, pos_starts, positions)
+    if python_fields:
+        sub = [type(pd)(doc_id=pd.doc_id, source=pd.source, routing=pd.routing,
+                        terms={f: pd.terms[f] for f in python_fields if f in pd.terms},
+                        positions={f: pd.positions[f] for f in python_fields
+                                   if f in pd.positions})
+               for pd in parsed_docs]
+        out.update(_pack_postings_python(sub, with_positions))
+    return out
+
+
+def build_segment(name: str, parsed_docs: list, mappings: Mappings,
+                  seq_nos: Optional[List[int]] = None,
+                  with_positions: bool = True) -> Segment:
+    """Build an immutable segment from buffered parsed docs (the refresh path,
+    analog of Lucene DWPT flush driven by reference
+    `index/engine/InternalEngine.java#refresh`)."""
+    ndocs = len(parsed_docs)
+    ids = [d.doc_id for d in parsed_docs]
+    sources = [d.source for d in parsed_docs]
+
+    # ---- inverted fields ----
+    doc_lens: Dict[str, np.ndarray] = {}
+    text_stats: Dict[str, TextFieldStats] = {}
+    for doc_i, pd in enumerate(parsed_docs):
+        for fname, terms in pd.terms.items():
+            ft = mappings.resolve_field(fname)
+            if ft is not None and ft.type == "text":
+                stats = text_stats.setdefault(fname, TextFieldStats())
+                stats.doc_count += 1
+                stats.sum_dl += len(terms)
+                dl = doc_lens.setdefault(fname, np.zeros(ndocs, dtype=np.int64))
+                dl[doc_i] = len(terms)
+
+    postings = pack_postings(parsed_docs, with_positions)
 
     # ---- doc values ----
     numeric_cols: Dict[str, NumericColumn] = {}

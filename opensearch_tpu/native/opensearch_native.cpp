@@ -1,0 +1,247 @@
+// Native host-side kernels for opensearch_tpu (SURVEY §2.10).
+//
+// The reference (OpenSearch) runs on the JVM and leans on Lucene's
+// MMap/VarHandle decode for its hot host loops; our host-side hot loops are
+// (a) tokenization, (b) doc-id hashing for shard routing
+// (`cluster/routing/Murmur3HashFunction.java` analog), and (c) packing
+// buffered postings into the CSR segment layout at refresh time
+// (the analog of Lucene's DWPT flush sort in
+// `index/engine/InternalEngine.java#refresh`). The device never sees any of
+// this — it consumes the CSR arrays this code produces.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+// Python keeps a pure-numpy fallback for every entry point; parity is tested
+// in tests/test_native.py.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <numeric>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// MurmurHash3 x86_32 — bit-exact with cluster/routing.py::murmur3_x86_32
+// (which itself mirrors the reference's Murmur3HashFunction).
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+uint32_t osn_murmur3(const uint8_t* data, int64_t len, uint32_t seed) {
+  const uint32_t c1 = 0xcc9e2d51, c2 = 0x1b873593;
+  uint32_t h = seed;
+  const int64_t nblocks = len / 4;
+  for (int64_t i = 0; i < nblocks; i++) {
+    uint32_t k;
+    std::memcpy(&k, data + i * 4, 4);  // little-endian host assumed (x86/arm)
+    k *= c1;
+    k = rotl32(k, 15);
+    k *= c2;
+    h ^= k;
+    h = rotl32(h, 13);
+    h = h * 5 + 0xe6546b64;
+  }
+  const uint8_t* tail = data + nblocks * 4;
+  uint32_t k = 0;
+  switch (len & 3) {
+    case 3: k ^= tail[2] << 16; [[fallthrough]];
+    case 2: k ^= tail[1] << 8; [[fallthrough]];
+    case 1:
+      k ^= tail[0];
+      k *= c1;
+      k = rotl32(k, 15);
+      k *= c2;
+      h ^= k;
+  }
+  h ^= (uint32_t)len;
+  h ^= h >> 16;
+  h *= 0x85ebca6b;
+  h ^= h >> 13;
+  h *= 0xc2b2ae35;
+  h ^= h >> 16;
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// ASCII standard tokenizer: byte-exact with the Python regex `[\w][\w']*`
+// (analysis/tokenizers.py::standard_tokenizer) for pure-ASCII input. The
+// Python wrapper only routes `text.isascii()` strings here, so the Unicode
+// word classes never come into play.
+// ---------------------------------------------------------------------------
+
+static inline bool is_word(uint8_t c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+         (c >= '0' && c <= '9') || c == '_';
+}
+
+// Writes (start, end) byte-offset pairs into `out` (capacity `cap` pairs).
+// Returns the number of tokens found (may exceed cap; caller re-sizes).
+int64_t osn_tokenize_ascii(const uint8_t* buf, int64_t len, int32_t* out,
+                           int64_t cap) {
+  int64_t ntok = 0;
+  int64_t i = 0;
+  while (i < len) {
+    if (is_word(buf[i])) {
+      int64_t start = i++;
+      while (i < len && (is_word(buf[i]) || buf[i] == '\'')) i++;
+      if (ntok < cap) {
+        out[2 * ntok] = (int32_t)start;
+        out[2 * ntok + 1] = (int32_t)i;
+      }
+      ntok++;
+    } else {
+      i++;
+    }
+  }
+  return ntok;
+}
+
+// ---------------------------------------------------------------------------
+// CSR postings packer. Accumulates a token stream (term bytes, doc id,
+// optional position) across calls, then `finish` sorts the vocabulary
+// lexicographically (UTF-8 byte order == code-point order, matching Python's
+// sorted()), remaps, sorts records by (term, doc, position), and emits the
+// exact CSR layout produced by index/segment.py::build_segment.
+// ---------------------------------------------------------------------------
+
+struct Rec {
+  int32_t tid, doc, pos;
+};
+
+struct Pack {
+  bool with_pos;
+  // term intern table; deque keeps element addresses stable for string_view
+  std::deque<std::string> term_store;
+  std::unordered_map<std::string_view, int32_t> lookup;
+  std::vector<Rec> recs;
+  // outputs
+  std::vector<int64_t> starts;
+  std::vector<int32_t> doc_ids;
+  std::vector<float> tfs;
+  std::vector<int64_t> pos_starts;
+  std::vector<int32_t> positions;
+  std::vector<int64_t> vocab_offs;
+  std::string vocab_buf;
+};
+
+void* osn_pack_new(int32_t with_positions) {
+  Pack* p = new Pack();
+  p->with_pos = with_positions != 0;
+  return p;
+}
+
+void osn_pack_free(void* h) { delete (Pack*)h; }
+
+// `buf` holds `ntok` tokens separated by '\0' (no trailing separator);
+// `doc_of[i]` is the doc for token i; `pos` is per-token position or null.
+// Returns 0 on success, -1 if the separator count does not match ntok.
+int32_t osn_pack_add(void* h, const uint8_t* buf, int64_t buflen, int64_t ntok,
+                     const int32_t* doc_of, const int32_t* pos) {
+  Pack* p = (Pack*)h;
+  if (ntok == 0) return 0;
+  const char* cur = (const char*)buf;
+  const char* end = (const char*)buf + buflen;
+  for (int64_t i = 0; i < ntok; i++) {
+    const char* sep = (const char*)memchr(cur, '\0', end - cur);
+    const char* tok_end = sep ? sep : end;
+    if (!sep && i != ntok - 1) return -1;  // ran out of separators early
+    std::string_view sv(cur, tok_end - cur);
+    auto it = p->lookup.find(sv);
+    int32_t tid;
+    if (it == p->lookup.end()) {
+      tid = (int32_t)p->term_store.size();
+      p->term_store.emplace_back(sv);
+      p->lookup.emplace(std::string_view(p->term_store.back()), tid);
+    } else {
+      tid = it->second;
+    }
+    p->recs.push_back({tid, doc_of[i], pos ? pos[i] : 0});
+    cur = sep ? sep + 1 : end;
+  }
+  if (cur < end) return -1;  // extra separators: token had an embedded NUL
+  return 0;
+}
+
+int32_t osn_pack_finish(void* h) {
+  Pack* p = (Pack*)h;
+  const int64_t nterms = (int64_t)p->term_store.size();
+  // sort vocab lexicographically, build old->new tid map
+  std::vector<int32_t> order(nterms);
+  std::iota(order.begin(), order.end(), 0);
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t b) {
+    return p->term_store[a] < p->term_store[b];
+  });
+  std::vector<int32_t> newtid(nterms);
+  for (int64_t i = 0; i < nterms; i++) newtid[order[i]] = (int32_t)i;
+  p->vocab_offs.assign(nterms + 1, 0);
+  for (int64_t i = 0; i < nterms; i++) {
+    p->vocab_buf += p->term_store[order[i]];
+    p->vocab_offs[i + 1] = (int64_t)p->vocab_buf.size();
+  }
+  for (Rec& r : p->recs) r.tid = newtid[r.tid];
+  std::sort(p->recs.begin(), p->recs.end(), [](const Rec& a, const Rec& b) {
+    if (a.tid != b.tid) return a.tid < b.tid;
+    if (a.doc != b.doc) return a.doc < b.doc;
+    return a.pos < b.pos;
+  });
+  // scan: one posting per (tid, doc) group
+  p->starts.assign(nterms + 1, 0);
+  const int64_t nrec = (int64_t)p->recs.size();
+  for (int64_t i = 0; i < nrec;) {
+    int64_t j = i;
+    while (j < nrec && p->recs[j].tid == p->recs[i].tid &&
+           p->recs[j].doc == p->recs[i].doc)
+      j++;
+    p->doc_ids.push_back(p->recs[i].doc);
+    p->tfs.push_back((float)(j - i));
+    if (p->with_pos) {
+      for (int64_t k = i; k < j; k++) p->positions.push_back(p->recs[k].pos);
+      p->pos_starts.push_back((int64_t)p->positions.size());
+    }
+    p->starts[p->recs[i].tid + 1] = (int64_t)p->doc_ids.size();
+    i = j;
+  }
+  // starts holds end offsets where a term had postings; fill gaps (terms can't
+  // be absent here — every interned term has >=1 record — but keep it robust)
+  for (int64_t t = 1; t <= nterms; t++)
+    if (p->starts[t] < p->starts[t - 1]) p->starts[t] = p->starts[t - 1];
+  return 0;
+}
+
+// dims out: [nterms, npostings, npositions, vocab_bytes]
+void osn_pack_dims(void* h, int64_t* out) {
+  Pack* p = (Pack*)h;
+  out[0] = (int64_t)p->term_store.size();
+  out[1] = (int64_t)p->doc_ids.size();
+  out[2] = (int64_t)p->positions.size();
+  out[3] = (int64_t)p->vocab_buf.size();
+}
+
+void osn_pack_export(void* h, int64_t* starts, int32_t* doc_ids, float* tfs,
+                     int64_t* pos_starts, int32_t* positions, uint8_t* vocab,
+                     int64_t* vocab_offs) {
+  Pack* p = (Pack*)h;
+  std::memcpy(starts, p->starts.data(), p->starts.size() * 8);
+  if (!p->doc_ids.empty()) {
+    std::memcpy(doc_ids, p->doc_ids.data(), p->doc_ids.size() * 4);
+    std::memcpy(tfs, p->tfs.data(), p->tfs.size() * 4);
+  }
+  if (p->with_pos && pos_starts) {
+    pos_starts[0] = 0;
+    if (!p->pos_starts.empty())
+      std::memcpy(pos_starts + 1, p->pos_starts.data(),
+                  p->pos_starts.size() * 8);
+    if (!p->positions.empty())
+      std::memcpy(positions, p->positions.data(), p->positions.size() * 4);
+  }
+  if (!p->vocab_buf.empty()) std::memcpy(vocab, p->vocab_buf.data(), p->vocab_buf.size());
+  std::memcpy(vocab_offs, p->vocab_offs.data(), p->vocab_offs.size() * 8);
+}
+
+}  // extern "C"
