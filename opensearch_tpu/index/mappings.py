@@ -45,6 +45,9 @@ class FieldType:
     boost: float = 1.0
     dims: int = 0                       # dense_vector dimension
     vector_similarity: str = "cosine"   # cosine | dot_product | l2_norm
+    # join field (reference modules/parent-join ParentJoinFieldMapper):
+    # {"parent_relation": ["child_relation", ...]}
+    relations: Dict[str, List[str]] = dc_field(default_factory=dict)
     # text fields keep norms (doc length) unless disabled; keyword fields never
     norms: bool = True
     subfields: Dict[str, "FieldType"] = dc_field(default_factory=dict)
@@ -164,6 +167,7 @@ class Mappings:
         self.fields: Dict[str, FieldType] = {}
         self.aliases: Dict[str, str] = {}
         self.nested_paths: set = set()
+        self.join_field: Optional[str] = None  # at most one per index (like reference)
         self.dynamic = dynamic
         self.dynamic_templates: List[dict] = []
         self._meta: dict = {}
@@ -193,6 +197,12 @@ class Mappings:
                 self._merge_props(cfg.get("properties", {}), prefix=f"{path}.")
                 continue
             self.fields[path] = self._build_field(path, ftype, cfg)
+            if ftype == "join":
+                if self.join_field is not None and self.join_field != path:
+                    raise ValueError(
+                        f"only one [join] field can be defined per index, "
+                        f"found [{self.join_field}] and [{path}]")
+                self.join_field = path
 
     def _build_field(self, path: str, ftype: str, cfg: dict) -> FieldType:
         ft = FieldType(
@@ -214,6 +224,9 @@ class Mappings:
             vector_similarity=cfg.get("similarity",
                                       cfg.get("space_type", "cosine")),
         )
+        if ftype == "join":
+            ft.relations = {p: (c if isinstance(c, list) else [c])
+                            for p, c in cfg.get("relations", {}).items()}
         for sub, subcfg in cfg.get("fields", {}).items():
             ft.subfields[sub] = self._build_field(f"{path}.{sub}", subcfg.get("type", "keyword"), subcfg)
         return ft
@@ -233,6 +246,8 @@ class Mappings:
             if skip:
                 continue
             d: dict = {"type": ft.type}
+            if ft.relations:
+                d["relations"] = ft.relations
             if ft.type == "text" and ft.analyzer != "standard":
                 d["analyzer"] = ft.analyzer
             if ft.normalizer:
@@ -342,7 +357,7 @@ class Mappings:
                 continue
             if isinstance(value, dict):
                 ft = self.resolve_field(path)
-                if ft is not None and ft.type in GEO_TYPES:
+                if ft is not None and (ft.type in GEO_TYPES or ft.type == "join"):
                     self._index_value(ft, value, parsed)
                 else:
                     self._parse_obj(value, f"{path}.", parsed)
@@ -393,6 +408,33 @@ class Mappings:
 
     def _index_single(self, ft: FieldType, v: Any, parsed: ParsedDocument) -> None:
         name = ft.name
+        if ft.type == "join":
+            # reference ParentJoinFieldMapper: value is the relation name, or
+            # {"name": ..., "parent": id} for child docs; children must carry
+            # an explicit routing (same-shard requirement for the join)
+            if isinstance(v, str):
+                rel, parent = v, None
+            elif isinstance(v, dict):
+                rel, parent = v.get("name"), v.get("parent")
+            else:
+                raise ValueError(f"cannot parse join field value [{v}]")
+            child_rels = {c for cs in ft.relations.values() for c in cs}
+            if rel not in set(ft.relations) | child_rels:
+                raise ValueError(f"unknown join name [{rel}] for field [{name}]")
+            if rel in child_rels:
+                if parent is None:
+                    raise ValueError(
+                        f"[parent] is missing for join field [{name}] "
+                        f"child relation [{rel}]")
+                if parsed.routing is None:
+                    raise ValueError(
+                        "[routing] is missing for a doc with a child join "
+                        f"relation [{rel}]")
+                parsed.terms.setdefault(f"{name}#parent", []).append(str(parent))
+                parsed.keywords.setdefault(f"{name}#parent", []).append(str(parent))
+            parsed.terms.setdefault(name, []).append(rel)
+            parsed.keywords.setdefault(name, []).append(rel)
+            return
         if ft.type == "text":
             if ft.index:
                 tokens = self.index_analyzer(ft).analyze(str(v))

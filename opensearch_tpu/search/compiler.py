@@ -254,6 +254,42 @@ class LNested(LNode):
 
 
 @dataclass
+class LHasChild(LNode):
+    """Parents with matching children. Two device passes over the shard's
+    join slot space (search/join.py): pass 1 scatters child-query scores into
+    parent slots across ALL segments; pass 2 (emit) slices each segment's
+    window out of the slot vectors. Reference modules/parent-join
+    HasChildQueryBuilder + ToParentBlockJoin-style score modes."""
+
+    join_field: str = ""
+    child_rel: str = ""
+    child: Optional[LNode] = None          # inner query AND join==child_rel
+    parent_filter: Optional[LNode] = None  # join==parent_rel
+    score_mode: str = "none"
+    min_children: int = 1
+    max_children: int = 2**31 - 1
+    boost: float = 1.0
+    join_index: Any = None
+    pre: Any = None                        # lazily-computed slot vectors
+
+
+@dataclass
+class LHasParent(LNode):
+    """Children whose parent matches (reference HasParentQueryBuilder):
+    pass 1 places parent-query scores at the parents' own slots; pass 2
+    gathers through each child's `parent_slot`."""
+
+    join_field: str = ""
+    parent_rel: str = ""
+    child: Optional[LNode] = None          # inner query AND join==parent_rel
+    child_filter: Optional[LNode] = None   # join in child relations
+    use_score: bool = False
+    boost: float = 1.0
+    join_index: Any = None
+    pre: Any = None
+
+
+@dataclass
 class LScriptFilter(LNode):
     """`script` query: filter where the traced expression is truthy. The AST
     (hashable tuples) lives in the jit-static spec; numeric script params are
@@ -680,7 +716,64 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         return LNested(path=q.path, child=inner, child_ctx=child_ctx,
                        score_mode=q.score_mode, boost=q.boost)
 
+    if isinstance(q, (dsl.HasChildQuery, dsl.HasParentQuery, dsl.ParentIdQuery)):
+        return _rewrite_join(q, ctx, scoring)
+
     raise dsl.QueryParseError(f"cannot compile query {type(q).__name__}")
+
+
+def _rewrite_join(q, ctx: ShardContext, scoring: bool) -> LNode:
+    from .join import get_join_index
+
+    m = ctx.mappings
+    jf = m.join_field
+    kind = {dsl.HasChildQuery: "has_child", dsl.HasParentQuery: "has_parent",
+            dsl.ParentIdQuery: "parent_id"}[type(q)]
+    relations = m.fields[jf].relations if jf else {}
+    child_rels_all = {c for cs in relations.values() for c in cs}
+
+    def unmapped(msg: str) -> LNode:
+        if q.ignore_unmapped:
+            return LMatchNone()
+        raise dsl.QueryParseError(f"[{kind}] {msg}")
+
+    if jf is None:
+        return unmapped("no [join] field is mapped on this index")
+
+    if kind == "parent_id":
+        if q.type not in child_rels_all:
+            return unmapped(f"[{q.type}] is not a child relation")
+        inner = LBool(filters=[
+            _weighted_terms(f"{jf}#parent", [q.id], [1.0], ctx, 1, "filter", 1.0),
+            _weighted_terms(jf, [q.type], [1.0], ctx, 1, "filter", 1.0)])
+        return LConstScore(child=inner, boost=q.boost)
+
+    ji = get_join_index(ctx.segments, jf)
+    if kind == "has_child":
+        parent_rel = next((p for p, cs in relations.items() if q.type in cs), None)
+        if parent_rel is None:
+            return unmapped(f"[{q.type}] is not a child relation of the join field")
+        inner = rewrite(q.query or dsl.MatchAllQuery(), ctx, scoring)
+        child = LBool(musts=[inner], filters=[
+            _weighted_terms(jf, [q.type], [1.0], ctx, 1, "filter", 1.0)])
+        pf = _weighted_terms(jf, [parent_rel], [1.0], ctx, 1, "filter", 1.0)
+        return LHasChild(join_field=jf, child_rel=q.type, child=child,
+                         parent_filter=pf, score_mode=q.score_mode,
+                         min_children=q.min_children, max_children=q.max_children,
+                         boost=q.boost, join_index=ji)
+
+    # has_parent
+    if q.parent_type not in relations:
+        return unmapped(f"[{q.parent_type}] is not a parent relation")
+    inner = rewrite(q.query or dsl.MatchAllQuery(), ctx, scoring)
+    parent_plan = LBool(musts=[inner], filters=[
+        _weighted_terms(jf, [q.parent_type], [1.0], ctx, 1, "filter", 1.0)])
+    cf = _weighted_terms(jf, sorted(relations[q.parent_type]),
+                         [1.0] * len(relations[q.parent_type]), ctx, 1,
+                         "filter", 1.0)
+    return LHasParent(join_field=jf, parent_rel=q.parent_type, child=parent_plan,
+                      child_filter=cf, use_score=q.score, boost=q.boost,
+                      join_index=ji)
 
 
 def nested_context(ctx: ShardContext, path: str) -> ShardContext:
@@ -1078,6 +1171,37 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         _scalar_f32(params, f"q{nid}_boost", node.boost)
         return ("nested", nid, node.path, node.score_mode, child_spec)
 
+    if isinstance(node, LHasChild):
+        if node.pre is None:
+            need = {"cnt"}
+            if node.score_mode in ("sum", "avg"):
+                need.add("sum")
+            elif node.score_mode in ("max", "min"):
+                need.add(node.score_mode)
+            node.pre = _join_prepass(node.child, node.join_index, tuple(sorted(need)), ctx)
+        for k, v in node.pre.items():
+            params[f"q{nid}_{k}"] = v
+        pf_spec = prepare(node.parent_filter, seg, ctx, params)
+        _scalar_i32(params, f"q{nid}_base", node.join_index.seg_base(seg))
+        # at least one matching child is always required (reference semantics)
+        _scalar_f32(params, f"q{nid}_minc", max(node.min_children, 1))
+        _scalar_f32(params, f"q{nid}_maxc", min(node.max_children, 2**31 - 1))
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("has_child", nid, node.score_mode, pf_spec)
+
+    if isinstance(node, LHasParent):
+        if node.pre is None:
+            # parents occupy their own slot (base + doc): reuse the scatter
+            # with identity slots — "cnt" is the match vector, "sum" the score
+            node.pre = _join_prepass(node.child, node.join_index, ("cnt", "sum"),
+                                     ctx, self_slots=True)
+        params[f"q{nid}_match"] = node.pre["cnt"]
+        params[f"q{nid}_score"] = node.pre["sum"]
+        params[f"q{nid}_pslot"] = node.join_index.pslot(seg)
+        cf_spec = prepare(node.child_filter, seg, ctx, params)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("has_parent", nid, node.use_score, cf_spec)
+
     if isinstance(node, LScriptFilter):
         field_srcs, pkeys = _prepare_script(node.ast, node.params, seg, params,
                                             nid, "s")
@@ -1121,6 +1245,72 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("geobox", nid, node.field, node.field in seg.geo_cols)
 
     raise TypeError(f"cannot prepare node {type(node).__name__}")
+
+
+@lru_cache(maxsize=64)
+def _build_join_scatter(gsize: int, need: Tuple[str, ...]):
+    """Pass-1 kernel: scatter one segment's matched scores into the shard's
+    join slot space (padding/unresolved slots are -1 -> sentinel -> dropped)."""
+    import jax
+
+    def run(gslot, scores, matched):
+        import jax.numpy as jnp
+
+        ok = (gslot >= 0) & (matched > 0)
+        idx = jnp.where(ok, gslot, INT32_SENTINEL)
+        sc = jnp.where(ok, scores, 0.0)
+        out = {}
+        if "cnt" in need:
+            out["cnt"] = jnp.zeros(gsize, jnp.float32).at[idx].add(
+                ok.astype(jnp.float32), mode="drop")
+        if "sum" in need:
+            out["sum"] = jnp.zeros(gsize, jnp.float32).at[idx].add(sc, mode="drop")
+        if "max" in need:
+            out["max"] = jnp.full(gsize, -3.4e38, jnp.float32).at[idx].max(
+                jnp.where(ok, scores, -3.4e38), mode="drop")
+        if "min" in need:
+            out["min"] = jnp.full(gsize, 3.4e38, jnp.float32).at[idx].min(
+                jnp.where(ok, scores, 3.4e38), mode="drop")
+        return out
+
+    return jax.jit(run)
+
+
+def _join_prepass(child: LNode, ji, need: Tuple[str, ...], ctx: ShardContext,
+                  self_slots: bool = False) -> dict:
+    """Run the inner plan densely over every segment of the join index and
+    accumulate slot-space vectors on device (no host round trip — the result
+    arrays feed pass 2 as traced params)."""
+    import jax.numpy as jnp
+
+    acc: Dict[str, Any] = {}
+    for seg in ji.segments:
+        if seg.live_count == 0:
+            continue
+        cparams: Dict[str, Any] = {}
+        cspec = prepare(child, seg, ctx, cparams)
+        docs = np.arange(seg.ndocs_pad, dtype=np.int32)
+        scores, matched = run_gather_scores(cspec, seg.device_arrays(), cparams, docs)
+        if self_slots:
+            base = ji.seg_base(seg)
+            gslot = np.arange(base, base + seg.ndocs_pad, dtype=np.int32)
+            gslot[seg.ndocs:] = -1
+        else:
+            gslot = ji.pslot(seg)
+        vecs = _build_join_scatter(ji.gsize, need)(gslot, scores, matched)
+        for k, v in vecs.items():
+            if k not in acc:
+                acc[k] = v
+            elif k == "max":
+                acc[k] = jnp.maximum(acc[k], v)
+            elif k == "min":
+                acc[k] = jnp.minimum(acc[k], v)
+            else:
+                acc[k] = acc[k] + v
+    if not acc:
+        fill = {"cnt": 0.0, "sum": 0.0, "max": -3.4e38, "min": 3.4e38}
+        acc = {k: jnp.full(ji.gsize, fill[k], jnp.float32) for k in need}
+    return acc
 
 
 def _prepare_script(ast: tuple, script_params: dict, seg: Segment, params: dict,
@@ -1202,6 +1392,12 @@ def can_match(node: LNode, seg: Segment) -> bool:
         if blk is None or blk.child.ndocs == 0:
             return False
         return can_match(node.child, blk.child)
+    if isinstance(node, LHasChild):
+        # pass 2 only reads parent docs of this segment; the child pre-pass
+        # spans all segments regardless
+        return can_match(node.parent_filter, seg)
+    if isinstance(node, LHasParent):
+        return can_match(node.child_filter, seg)
     if isinstance(node, LMatchNone):
         return False
     return True
@@ -1445,6 +1641,40 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         pmatch = pmatch & (live > 0)
         pscores = jnp.where(pmatch, pscores * params[f"q{nid}_boost"], 0.0)
         return ops.ScoredMask(pscores, pmatch.astype(jnp.float32))
+
+    if kind == "has_child":
+        from jax import lax
+
+        _, _, score_mode, pf_spec = spec
+        base = params[f"q{nid}_base"]
+        cnt = lax.dynamic_slice(params[f"q{nid}_cnt"], (base,), (ndocs_pad,))
+        pmask = emit(pf_spec, seg_arrays, params).matched
+        ok = ((cnt >= params[f"q{nid}_minc"]) & (cnt <= params[f"q{nid}_maxc"])
+              & (pmask > 0) & (live > 0))
+        if score_mode == "none":
+            sc = jnp.ones(ndocs_pad, jnp.float32)
+        elif score_mode in ("sum", "avg"):
+            sc = lax.dynamic_slice(params[f"q{nid}_sum"], (base,), (ndocs_pad,))
+            if score_mode == "avg":
+                sc = sc / jnp.maximum(cnt, 1.0)
+        else:  # max | min
+            sc = lax.dynamic_slice(params[f"q{nid}_{score_mode}"], (base,),
+                                   (ndocs_pad,))
+        sc = jnp.where(ok, sc * params[f"q{nid}_boost"], 0.0)
+        return ops.ScoredMask(sc, ok.astype(jnp.float32))
+
+    if kind == "has_parent":
+        _, _, use_score, cf_spec = spec
+        pslot = params[f"q{nid}_pslot"]
+        gmatch = params[f"q{nid}_match"]
+        gscore = params[f"q{nid}_score"]
+        valid = pslot >= 0
+        idx = jnp.clip(pslot, 0, gmatch.shape[0] - 1)
+        cmask = emit(cf_spec, seg_arrays, params).matched
+        ok = valid & (gmatch[idx] > 0) & (cmask > 0) & (live > 0)
+        sc = gscore[idx] if use_score else jnp.ones(ndocs_pad, jnp.float32)
+        sc = jnp.where(ok, sc * params[f"q{nid}_boost"], 0.0)
+        return ops.ScoredMask(sc, ok.astype(jnp.float32))
 
     if kind == "script":
         _, _, ast, field_srcs, pkeys = spec

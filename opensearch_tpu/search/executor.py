@@ -259,6 +259,7 @@ class ShardSearcher:
         lroot = C.rewrite(qtree, ctx, scoring=True)
         hl_terms = collect_query_terms(lroot) if body.get("highlight") else {}
         nested_ihs = _nested_queries_with_inner_hits(qtree)
+        join_ihs = _join_queries_with_inner_hits(qtree)
         ih_cache: Dict[Tuple[int, int], Any] = {}
         hits = []
         for c in selected:
@@ -271,8 +272,87 @@ class ShardSearcher:
                 hit["_explanation"] = explain_doc(lroot, seg, c.local_doc, ctx)
             for nq in nested_ihs:
                 self._add_inner_hits(hit, nq, seg, c, ctx, ih_cache)
+            for jq in join_ihs:
+                self._add_join_inner_hits(hit, jq, seg, c, ctx, ih_cache)
             hits.append(hit)
         return hits
+
+    def _join_child_scores(self, jq_key, lnode, cseg, ctx, ih_cache):
+        """Dense matched scores of a join inner query over one segment
+        (cached per (query, segment) across the fetch loop)."""
+        key = (jq_key, id(cseg))
+        if key not in ih_cache:
+            cparams: Dict[str, Any] = {}
+            cspec = C.prepare(lnode, cseg, ctx, cparams)
+            docs = np.arange(cseg.ndocs_pad, dtype=np.int32)
+            sc, cm = C.run_gather_scores(cspec, cseg.device_arrays(), cparams, docs)
+            ih_cache[key] = (np.asarray(sc), np.asarray(cm))
+        return ih_cache[key]
+
+    def _add_join_inner_hits(self, hit: dict, jq, seg: Segment, c: Candidate,
+                             ctx, ih_cache: dict) -> None:
+        """inner_hits for has_child (matching children under each parent hit)
+        and has_parent (the matched parent of each child hit) — reference
+        modules/parent-join InnerHitContextBuilder."""
+        from .join import get_join_index
+
+        jf = self.engine.mappings.join_field
+        if jf is None:
+            return
+        ji = get_join_index(ctx.segments, jf)
+        ih = jq.inner_hits or {}
+        if isinstance(jq, dsl.HasChildQuery):
+            name = ih.get("name", jq.type)
+            inner_q = dsl.BoolQuery(must=[jq.query or dsl.MatchAllQuery()],
+                                    filter=[dsl.TermQuery(field=jf, value=jq.type)])
+            lkey = ("jihc", id(jq))
+            if lkey not in ih_cache:
+                ih_cache[lkey] = C.rewrite(inner_q, ctx, scoring=True)
+            lnode = ih_cache[lkey]
+            kids = []
+            for cseg, cd in ji.children_of(ji.seg_base(seg) + c.local_doc):
+                sc, cm = self._join_child_scores(id(jq), lnode, cseg, ctx, ih_cache)
+                if cm[cd] and cseg.live[cd]:
+                    kids.append((float(sc[cd]), cseg, cd))
+            kids.sort(key=lambda t: -t[0])
+            frm, size = int(ih.get("from", 0)), int(ih.get("size", 3))
+            child_hits = []
+            for sc_v, cseg, cd in kids[frm: frm + size]:
+                ch = {"_index": hit.get("_index", ""), "_id": cseg.ids[cd],
+                      "_score": sc_v, "_routing": seg.ids[c.local_doc]}
+                if ih.get("_source", True) is not False:
+                    ch["_source"] = cseg.sources[cd]
+                child_hits.append(ch)
+            hit.setdefault("inner_hits", {})[name] = {
+                "hits": {"total": {"value": len(kids), "relation": "eq"},
+                         "max_score": kids[0][0] if kids else None,
+                         "hits": child_hits}}
+            return
+        # has_parent: the one matched parent of this child hit
+        name = ih.get("name", jq.parent_type)
+        slot = int(ji.pslot(seg)[c.local_doc])
+        loc = ji.slot_to_doc(slot) if slot >= 0 else None
+        parent_hits = []
+        if loc is not None:
+            pseg, pd = loc
+            inner_q = dsl.BoolQuery(must=[jq.query or dsl.MatchAllQuery()],
+                                    filter=[dsl.TermQuery(field=jf,
+                                                          value=jq.parent_type)])
+            lkey = ("jihp", id(jq))
+            if lkey not in ih_cache:
+                ih_cache[lkey] = C.rewrite(inner_q, ctx, scoring=True)
+            sc, cm = self._join_child_scores(id(jq), ih_cache[lkey], pseg, ctx,
+                                             ih_cache)
+            if cm[pd] and pseg.live[pd]:
+                ph = {"_index": hit.get("_index", ""), "_id": pseg.ids[pd],
+                      "_score": float(sc[pd])}
+                if ih.get("_source", True) is not False:
+                    ph["_source"] = pseg.sources[pd]
+                parent_hits.append(ph)
+        hit.setdefault("inner_hits", {})[name] = {
+            "hits": {"total": {"value": len(parent_hits), "relation": "eq"},
+                     "max_score": parent_hits[0]["_score"] if parent_hits else None,
+                     "hits": parent_hits}}
 
     def _add_inner_hits(self, hit: dict, nq: dsl.NestedQuery, seg: Segment,
                         c: Candidate, ctx, ih_cache: dict) -> None:
@@ -503,6 +583,27 @@ def _nested_queries_with_inner_hits(q) -> List[dsl.NestedQuery]:
         if not hasattr(node, "__dataclass_fields__"):
             return
         if isinstance(node, dsl.NestedQuery) and node.inner_hits is not None:
+            out.append(node)
+        for fname in node.__dataclass_fields__:
+            v = getattr(node, fname)
+            if isinstance(v, dsl.Query):
+                walk(v)
+            elif isinstance(v, list):
+                for x in v:
+                    if isinstance(x, dsl.Query):
+                        walk(x)
+    walk(q)
+    return out
+
+
+def _join_queries_with_inner_hits(q) -> List:
+    out: List = []
+
+    def walk(node):
+        if not hasattr(node, "__dataclass_fields__"):
+            return
+        if (isinstance(node, (dsl.HasChildQuery, dsl.HasParentQuery))
+                and node.inner_hits is not None):
             out.append(node)
         for fname in node.__dataclass_fields__:
             v = getattr(node, fname)
@@ -1058,6 +1159,53 @@ def explain_doc(lroot, seg: Segment, doc: int, ctx) -> dict:
             return total, {"value": total,
                            "description": f"nested [{n.path}] {mode} of children:",
                            "details": details}
+        from .compiler import LHasChild, LHasParent
+        if isinstance(n, LHasChild):
+            from . import compiler as _C
+            ji = n.join_index
+            cache: Dict[int, Any] = {}
+            vals = []
+            for cseg, cd in ji.children_of(ji.seg_base(seg) + doc):
+                if id(cseg) not in cache:
+                    cparams: Dict[str, Any] = {}
+                    cspec = _C.prepare(n.child, cseg, ctx, cparams)
+                    darr = np.arange(cseg.ndocs_pad, dtype=np.int32)
+                    csc, cm = _C.run_gather_scores(cspec, cseg.device_arrays(),
+                                                   cparams, darr)
+                    cache[id(cseg)] = (np.asarray(csc), np.asarray(cm))
+                csc, cm = cache[id(cseg)]
+                if cm[cd] and cseg.live[cd]:
+                    vals.append(float(csc[cd]))
+            ok = max(n.min_children, 1) <= len(vals) <= n.max_children
+            mode = n.score_mode
+            total = 0.0
+            if ok:
+                total = (1.0 if mode == "none" else
+                         sum(vals) / len(vals) if mode == "avg" else
+                         max(vals) if mode == "max" else
+                         min(vals) if mode == "min" else sum(vals)) * n.boost
+            return total, {"value": total,
+                           "description": (f"has_child [{n.child_rel}] {mode} of "
+                                           f"{len(vals)} matching children"),
+                           "details": []}
+        if isinstance(n, LHasParent):
+            from . import compiler as _C
+            ji = n.join_index
+            slot = int(ji.pslot(seg)[doc])
+            loc = ji.slot_to_doc(slot) if slot >= 0 else None
+            total = 0.0
+            if loc is not None:
+                pseg, pd = loc
+                cparams = {}
+                cspec = _C.prepare(n.child, pseg, ctx, cparams)
+                darr = np.arange(pseg.ndocs_pad, dtype=np.int32)
+                psc, pm = _C.run_gather_scores(cspec, pseg.device_arrays(),
+                                               cparams, darr)
+                if np.asarray(pm)[pd] and pseg.live[pd]:
+                    total = (float(np.asarray(psc)[pd]) if n.use_score else 1.0) * n.boost
+            return total, {"value": total,
+                           "description": f"has_parent [{n.parent_rel}]",
+                           "details": []}
         return 0.0, {"value": 0.0, "description": type(n).__name__, "details": []}
 
     _, expl = walk(lroot)

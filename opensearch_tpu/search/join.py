@@ -1,0 +1,130 @@
+"""Parent-child join index (reference: modules/parent-join, esp.
+ParentJoinFieldMapper + ParentIdFieldMapper global ordinals).
+
+The reference joins parent and child Lucene docs through global ordinals of
+the parent-id field, rebuilt per index reader. Here the shard-level join is a
+flat **global doc-slot space**: every segment gets a base offset (multiples of
+`ndocs_pad`, so per-segment views are static slices), a doc's own slot is
+`base + doc`, and each child doc stores the slot of its parent
+(`parent_slot`, -1 when the parent id is unresolved). Query execution then
+becomes two device passes (compiler.py): scatter child scores into slot space
+(`.at[slot].add/max/min`), then per segment slice/gather the slot vectors —
+no host loops in the scoring path.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..index.segment import Segment, next_pow2
+
+
+class JoinIndex:
+    """Shard-level parent→slot maps over one immutable segment list.
+
+    Segments are held via weakref so a cached JoinIndex never pins replaced
+    segments' device arrays in HBM after a refresh/merge — the engine holds
+    the strong refs for the segments any in-flight query actually uses."""
+
+    def __init__(self, segments: List[Segment], join_field: str):
+        self._seg_refs = [weakref.ref(s) for s in segments]
+        self.join_field = join_field
+        self.base: Dict[int, int] = {}
+        off = 0
+        for s in segments:
+            self.base[id(s)] = off
+            off += s.ndocs_pad
+        self.gsize = next_pow2(max(off, 16))
+
+        def locate(pid: str) -> int:
+            # latest live copy of the parent wins (updates leave dead copies
+            # in older segments, same as Lucene liveDocs)
+            fallback = -1
+            for s in segments:
+                d = s.id2doc.get(pid)
+                if d is not None:
+                    if s.live[d]:
+                        return self.base[id(s)] + d
+                    if fallback < 0:
+                        fallback = self.base[id(s)] + d
+            return fallback
+
+        self.parent_slot: Dict[int, np.ndarray] = {}
+        for s in segments:
+            arr = np.full(s.ndocs_pad, -1, np.int32)
+            pcol = s.keyword_cols.get(f"{join_field}#parent")
+            if pcol is not None and pcol.vocab:
+                # resolve each distinct parent id once, then fan out by ordinal
+                slot_of_ord = np.fromiter((locate(p) for p in pcol.vocab),
+                                          np.int32, count=len(pcol.vocab))
+                present = pcol.min_ord >= 0
+                vals = np.where(present, pcol.min_ord, 0)
+                arr[: s.ndocs] = np.where(present, slot_of_ord[vals], -1)
+            self.parent_slot[id(s)] = arr
+        self._children_sorted: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
+
+    @property
+    def segments(self) -> List[Segment]:
+        return [s for s in (r() for r in self._seg_refs) if s is not None]
+
+    def seg_base(self, seg: Segment) -> int:
+        return self.base.get(id(seg), 0)
+
+    def pslot(self, seg: Segment) -> np.ndarray:
+        arr = self.parent_slot.get(id(seg))
+        if arr is None:
+            arr = np.full(seg.ndocs_pad, -1, np.int32)
+        return arr
+
+    def slot_to_doc(self, slot: int) -> Optional[Tuple[Segment, int]]:
+        for s in self.segments:
+            b = self.base[id(s)]
+            if b <= slot < b + s.ndocs_pad:
+                d = slot - b
+                return (s, d) if d < s.ndocs else None
+        return None
+
+    def children_of(self, gslot: int) -> List[Tuple[Segment, int]]:
+        """All child docs whose parent occupies `gslot` (host reverse lookup
+        for inner_hits/explain; the scoring path never calls this)."""
+        if self._children_sorted is None:
+            snapshot = self.segments  # fixed positional order for sg below
+            slots, segi, docs = [], [], []
+            for i, s in enumerate(snapshot):
+                arr = self.parent_slot[id(s)][: s.ndocs]
+                nz = np.nonzero(arr >= 0)[0]
+                slots.append(arr[nz])
+                segi.append(np.full(len(nz), i, np.int32))
+                docs.append(nz.astype(np.int32))
+            sl = np.concatenate(slots) if slots else np.empty(0, np.int32)
+            sg = np.concatenate(segi) if segi else np.empty(0, np.int32)
+            dc = np.concatenate(docs) if docs else np.empty(0, np.int32)
+            order = np.argsort(sl, kind="stable")
+            self._children_sorted = (sl[order], sg[order], dc[order],
+                                     [weakref.ref(s) for s in snapshot])
+        sl, sg, dc, refs = self._children_sorted
+        a = int(np.searchsorted(sl, gslot, "left"))
+        b = int(np.searchsorted(sl, gslot, "right"))
+        out = []
+        for i in range(a, b):
+            s = refs[int(sg[i])]()
+            if s is not None:
+                out.append((s, int(dc[i])))
+        return out
+
+
+_cache: Dict[Tuple, JoinIndex] = {}
+
+
+def get_join_index(segments: List[Segment], join_field: str) -> JoinIndex:
+    key = (join_field, tuple(id(s) for s in segments))
+    ji = _cache.get(key)
+    if ji is None:
+        ji = JoinIndex(segments, join_field)
+        if len(_cache) >= 8:
+            _cache.pop(next(iter(_cache)))
+        _cache[key] = ji
+    return ji

@@ -266,6 +266,40 @@ class NestedQuery(Query):
     inner_hits: Optional[dict] = None
 
 
+@dataclass
+class HasChildQuery(Query):
+    """Parents with matching children (reference modules/parent-join
+    HasChildQueryBuilder)."""
+
+    type: str = ""
+    query: Optional[Query] = None
+    score_mode: str = "none"  # none | min | max | sum | avg
+    min_children: int = 1
+    max_children: int = 2**31 - 1
+    ignore_unmapped: bool = False
+    inner_hits: Optional[dict] = None
+
+
+@dataclass
+class HasParentQuery(Query):
+    """Children whose parent matches (reference HasParentQueryBuilder)."""
+
+    parent_type: str = ""
+    query: Optional[Query] = None
+    score: bool = False
+    ignore_unmapped: bool = False
+    inner_hits: Optional[dict] = None
+
+
+@dataclass
+class ParentIdQuery(Query):
+    """Children of one specific parent id (reference ParentIdQueryBuilder)."""
+
+    type: str = ""
+    id: str = ""
+    ignore_unmapped: bool = False
+
+
 def _one_entry(d: dict, what: str) -> Tuple[str, Any]:
     if not isinstance(d, dict) or len(d) != 1:
         raise QueryParseError(f"[{what}] malformed query, expected a single field object")
@@ -531,6 +565,34 @@ def parse_query(dsl: Optional[dict]) -> Query:
                         score_mode=body.get("score_mode", "avg"),
                         ignore_unmapped=bool(body.get("ignore_unmapped", False)),
                         inner_hits=body.get("inner_hits"))
+        _common(q, body)
+        return q
+
+    if kind == "has_child":
+        if body.get("score_mode", "none") not in ("none", "min", "max", "sum", "avg"):
+            raise QueryParseError(
+                f"[has_child] unknown score_mode [{body['score_mode']}]")
+        q = HasChildQuery(type=body["type"], query=parse_query(body["query"]),
+                          score_mode=body.get("score_mode", "none"),
+                          min_children=int(body.get("min_children", 1)),
+                          max_children=int(body.get("max_children", 2**31 - 1)),
+                          ignore_unmapped=bool(body.get("ignore_unmapped", False)),
+                          inner_hits=body.get("inner_hits"))
+        _common(q, body)
+        return q
+
+    if kind == "has_parent":
+        q = HasParentQuery(parent_type=body["parent_type"],
+                           query=parse_query(body["query"]),
+                           score=bool(body.get("score", False)),
+                           ignore_unmapped=bool(body.get("ignore_unmapped", False)),
+                           inner_hits=body.get("inner_hits"))
+        _common(q, body)
+        return q
+
+    if kind == "parent_id":
+        q = ParentIdQuery(type=body["type"], id=str(body["id"]),
+                          ignore_unmapped=bool(body.get("ignore_unmapped", False)))
         _common(q, body)
         return q
 
