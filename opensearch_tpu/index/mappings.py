@@ -357,7 +357,8 @@ class Mappings:
                 continue
             if isinstance(value, dict):
                 ft = self.resolve_field(path)
-                if ft is not None and (ft.type in GEO_TYPES or ft.type == "join"):
+                if ft is not None and (ft.type in GEO_TYPES
+                                       or ft.type in ("join", "percolator")):
                     self._index_value(ft, value, parsed)
                 else:
                     self._parse_obj(value, f"{path}.", parsed)
@@ -408,6 +409,23 @@ class Mappings:
 
     def _index_single(self, ft: FieldType, v: Any, parsed: ParsedDocument) -> None:
         name = ft.name
+        if ft.type == "percolator":
+            # validate the stored query now and extract its pre-filter terms
+            # (reference PercolatorFieldMapper + QueryAnalyzer); the query
+            # itself lives in _source
+            if not isinstance(v, dict):
+                raise ValueError(f"percolator field [{name}] must hold a query object")
+            from ..search.percolate import extract_index_terms
+            from ..search.query_dsl import QueryParseError
+            try:
+                terms, always = extract_index_terms(v, self)
+            except QueryParseError as e:
+                raise ValueError(f"percolator query is invalid: {e}")
+            if terms:
+                parsed.keywords.setdefault(f"{name}#terms", []).extend(terms)
+            if always:
+                parsed.keywords.setdefault(f"{name}#flags", []).append("any")
+            return
         if ft.type == "join":
             # reference ParentJoinFieldMapper: value is the relation name, or
             # {"name": ..., "parent": id} for child docs; children must carry

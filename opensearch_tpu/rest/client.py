@@ -236,6 +236,8 @@ class RestClient:
                scroll: Optional[str] = None, **kw) -> dict:
         body = dict(body or {})
         body.update({k: v for k, v in kw.items() if v is not None})
+        if body.get("query") is not None:
+            body["query"] = self._resolve_percolate_refs(body["query"])
         pit = body.pop("pit", None)
         try:
             if pit is not None:
@@ -254,6 +256,29 @@ class RestClient:
                                   "snapshot": snapshot}
             resp["_scroll_id"] = sid
         return resp
+
+    def _resolve_percolate_refs(self, node):
+        """Inline `{"percolate": {"index": ..., "id": ...}}` doc references by
+        fetching the stored doc (reference TransportPercolateQuery GET step).
+        Pure: returns a copied tree; never descends into percolate bodies
+        (candidate documents are user content, not DSL)."""
+        if isinstance(node, dict):
+            out = {}
+            for k, v in node.items():
+                if k == "percolate" and isinstance(v, dict):
+                    if ("document" not in v and "documents" not in v
+                            and v.get("index") and v.get("id")):
+                        got = self.get(v["index"], v["id"],
+                                       routing=v.get("routing"))
+                        v = dict(v)
+                        v["document"] = got.get("_source", {})
+                    out[k] = v
+                else:
+                    out[k] = self._resolve_percolate_refs(v)
+            return out
+        if isinstance(node, list):
+            return [self._resolve_percolate_refs(v) for v in node]
+        return node
 
     def _snapshot_searchers(self, snapshot: Dict[str, list]) -> List[ShardSearcher]:
         """Searchers bound to a scroll/PIT segment snapshot."""
@@ -338,6 +363,8 @@ class RestClient:
         body = dict(body or {})
         body["size"] = 0
         body.pop("sort", None)
+        if body.get("query") is not None:
+            body["query"] = self._resolve_percolate_refs(body["query"])
         resp = self.node.search(index, body)
         return {"count": resp["hits"]["total"]["value"],
                 "_shards": resp["_shards"]}
@@ -353,7 +380,9 @@ class RestClient:
             raise ApiError(404, "document_missing_exception", f"[{id}] missing")
         seg, doc = loc.segment, loc.local_doc
         ctx = C.ShardContext(svc.mappings, eng.segments, svc.default_sim)
-        lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx, scoring=True)
+        qdict = (self._resolve_percolate_refs(body["query"])
+                 if body.get("query") is not None else None)
+        lroot = C.rewrite(dsl.parse_query(qdict), ctx, scoring=True)
         expl = explain_doc(lroot, seg, doc, ctx)
         return {"_index": svc.meta.name, "_id": id,
                 "matched": expl["value"] > 0, "explanation": expl}

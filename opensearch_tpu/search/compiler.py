@@ -290,6 +290,18 @@ class LHasParent(LNode):
 
 
 @dataclass
+class LPercolate(LNode):
+    """Stored-query reverse match: per segment, a host-computed f32 mask of
+    which percolator docs' queries match the candidate mini-segment
+    (search/percolate.py); the device plan just consumes the mask."""
+
+    field: str = ""
+    mini_seg: Any = None
+    mini_ctx: Any = None
+    boost: float = 1.0
+
+
+@dataclass
 class LScriptFilter(LNode):
     """`script` query: filter where the traced expression is truthy. The AST
     (hashable tuples) lives in the jit-static spec; numeric script params are
@@ -718,6 +730,24 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
 
     if isinstance(q, (dsl.HasChildQuery, dsl.HasParentQuery, dsl.ParentIdQuery)):
         return _rewrite_join(q, ctx, scoring)
+
+    if isinstance(q, dsl.PercolateQuery):
+        from .percolate import build_mini
+
+        ft = m.resolve_field(q.field)
+        if ft is None or ft.type != "percolator":
+            raise dsl.QueryParseError(
+                f"[percolate] field [{q.field}] is not a percolator field")
+        if not q.documents:
+            raise dsl.QueryParseError(
+                "[percolate] document reference was not resolved "
+                "(use the REST layer, or inline `document`)")
+        try:
+            mini_seg, mini_ctx = build_mini(m, q.documents)
+        except ValueError as e:
+            raise dsl.QueryParseError(f"[percolate] cannot parse document: {e}")
+        return LPercolate(field=ft.name, mini_seg=mini_seg, mini_ctx=mini_ctx,
+                          boost=q.boost)
 
     raise dsl.QueryParseError(f"cannot compile query {type(q).__name__}")
 
@@ -1202,6 +1232,14 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         _scalar_f32(params, f"q{nid}_boost", node.boost)
         return ("has_parent", nid, node.use_score, cf_spec)
 
+    if isinstance(node, LPercolate):
+        from .percolate import segment_mask
+
+        _p(params, f"q{nid}_mask",
+           segment_mask(node.field, node.mini_seg, node.mini_ctx, seg))
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("percolate", nid)
+
     if isinstance(node, LScriptFilter):
         field_srcs, pkeys = _prepare_script(node.ast, node.params, seg, params,
                                             nid, "s")
@@ -1392,6 +1430,9 @@ def can_match(node: LNode, seg: Segment) -> bool:
         if blk is None or blk.child.ndocs == 0:
             return False
         return can_match(node.child, blk.child)
+    if isinstance(node, LPercolate):
+        return (f"{node.field}#terms" in seg.keyword_cols
+                or f"{node.field}#flags" in seg.keyword_cols)
     if isinstance(node, LHasChild):
         # pass 2 only reads parent docs of this segment; the child pre-pass
         # spans all segments regardless
@@ -1675,6 +1716,11 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         sc = gscore[idx] if use_score else jnp.ones(ndocs_pad, jnp.float32)
         sc = jnp.where(ok, sc * params[f"q{nid}_boost"], 0.0)
         return ops.ScoredMask(sc, ok.astype(jnp.float32))
+
+    if kind == "percolate":
+        mask = (params[f"q{nid}_mask"] > 0) & (live > 0)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
 
     if kind == "script":
         _, _, ast, field_srcs, pkeys = spec

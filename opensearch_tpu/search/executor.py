@@ -260,6 +260,8 @@ class ShardSearcher:
         hl_terms = collect_query_terms(lroot) if body.get("highlight") else {}
         nested_ihs = _nested_queries_with_inner_hits(qtree)
         join_ihs = _join_queries_with_inner_hits(qtree)
+        perc_multi = [pq for pq in _walk_query_nodes(qtree, dsl.PercolateQuery)
+                      if len(pq.documents) > 1]
         ih_cache: Dict[Tuple[int, int], Any] = {}
         hits = []
         for c in selected:
@@ -274,8 +276,28 @@ class ShardSearcher:
                 self._add_inner_hits(hit, nq, seg, c, ctx, ih_cache)
             for jq in join_ihs:
                 self._add_join_inner_hits(hit, jq, seg, c, ctx, ih_cache)
+            for pq in perc_multi:
+                self._add_percolate_slots(hit, pq, seg, c, ih_cache)
             hits.append(hit)
         return hits
+
+    def _add_percolate_slots(self, hit: dict, pq, seg: Segment, c: Candidate,
+                             ih_cache: dict) -> None:
+        """`_percolator_document_slot` for multi-document percolation
+        (reference PercolatorMatchedSlotSubFetchPhase)."""
+        from . import percolate as P
+
+        key = ("perc", id(pq))
+        if key not in ih_cache:
+            ih_cache[key] = P.build_mini(self.engine.mappings, pq.documents)
+        mini_seg, mini_ctx = ih_cache[key]
+        field = self.engine.mappings.resolve_field(pq.field)
+        slots = P.document_slots(field.name if field else pq.field, mini_seg,
+                                 mini_ctx, seg, c.local_doc)
+        # multiple percolate clauses disambiguate by _name, like the reference
+        key = (f"_percolator_document_slot_{pq.name}" if pq.name
+               else "_percolator_document_slot")
+        hit.setdefault("fields", {})[key] = slots
 
     def _join_child_scores(self, jq_key, lnode, cseg, ctx, ih_cache):
         """Dense matched scores of a join inner query over one segment
@@ -577,12 +599,17 @@ def _aggs_need_all_segments(agg_nodes) -> bool:
 
 
 def _nested_queries_with_inner_hits(q) -> List[dsl.NestedQuery]:
-    out: List[dsl.NestedQuery] = []
+    return [n for n in _walk_query_nodes(q, dsl.NestedQuery)
+            if n.inner_hits is not None]
+
+
+def _walk_query_nodes(q, types) -> List:
+    out: List = []
 
     def walk(node):
         if not hasattr(node, "__dataclass_fields__"):
             return
-        if isinstance(node, dsl.NestedQuery) and node.inner_hits is not None:
+        if isinstance(node, types):
             out.append(node)
         for fname in node.__dataclass_fields__:
             v = getattr(node, fname)
@@ -597,24 +624,8 @@ def _nested_queries_with_inner_hits(q) -> List[dsl.NestedQuery]:
 
 
 def _join_queries_with_inner_hits(q) -> List:
-    out: List = []
-
-    def walk(node):
-        if not hasattr(node, "__dataclass_fields__"):
-            return
-        if (isinstance(node, (dsl.HasChildQuery, dsl.HasParentQuery))
-                and node.inner_hits is not None):
-            out.append(node)
-        for fname in node.__dataclass_fields__:
-            v = getattr(node, fname)
-            if isinstance(v, dsl.Query):
-                walk(v)
-            elif isinstance(v, list):
-                for x in v:
-                    if isinstance(x, dsl.Query):
-                        walk(x)
-    walk(q)
-    return out
+    return [n for n in _walk_query_nodes(q, (dsl.HasChildQuery, dsl.HasParentQuery))
+            if n.inner_hits is not None]
 
 
 def _collect_named(lroot) -> List[Tuple[str, Any]]:

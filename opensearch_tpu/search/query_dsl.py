@@ -292,6 +292,19 @@ class HasParentQuery(Query):
 
 
 @dataclass
+class PercolateQuery(Query):
+    """Match stored percolator queries against candidate document(s)
+    (reference modules/percolator PercolateQueryBuilder)."""
+
+    field: str = ""
+    documents: List[dict] = dc_field(default_factory=list)
+    # reference to an existing doc (resolved by the REST layer before parse)
+    index: Optional[str] = None
+    id: Optional[str] = None
+    routing: Optional[str] = None
+
+
+@dataclass
 class ParentIdQuery(Query):
     """Children of one specific parent id (reference ParentIdQueryBuilder)."""
 
@@ -593,6 +606,19 @@ def parse_query(dsl: Optional[dict]) -> Query:
     if kind == "parent_id":
         q = ParentIdQuery(type=body["type"], id=str(body["id"]),
                           ignore_unmapped=bool(body.get("ignore_unmapped", False)))
+        _common(q, body)
+        return q
+
+    if kind == "percolate":
+        docs = body.get("documents")
+        if docs is None and body.get("document") is not None:
+            docs = [body["document"]]
+        if docs is None and body.get("index") is None:
+            raise QueryParseError(
+                "[percolate] requires `document`, `documents`, or `index`+`id`")
+        q = PercolateQuery(field=body["field"], documents=list(docs or []),
+                           index=body.get("index"), id=body.get("id"),
+                           routing=body.get("routing"))
         _common(q, body)
         return q
 

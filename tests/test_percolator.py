@@ -1,0 +1,153 @@
+"""Percolator tests. Reference semantics: modules/percolator
+(PercolatorFieldMapper term extraction, PercolateQueryBuilder, matched
+document slots). Ours: candidate mini-segment + host plan evaluator with
+keyword-column term pre-filtering (search/percolate.py)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("alerts", {"mappings": {"properties": {
+        "query": {"type": "percolator"},
+        "message": {"type": "text"},
+        "severity": {"type": "integer"},
+        "tag": {"type": "keyword"}}}})
+    c.index("alerts", {"query": {"match": {"message": "error"}}}, id="q_err")
+    c.index("alerts", {"query": {"bool": {"must": [
+        {"match": {"message": "disk"}},
+        {"range": {"severity": {"gte": 5}}}]}}}, id="q_disk")
+    c.index("alerts", {"query": {"term": {"tag": "network"}}}, id="q_net")
+    c.index("alerts", {"query": {"range": {"severity": {"gte": 9}}}}, id="q_crit")
+    c.index("alerts", {"query": {"match_phrase": {"message": "out of memory"}}},
+            id="q_oom")
+    c.indices.refresh("alerts")
+    return c
+
+
+class TestPercolate:
+    def test_basic_match(self, client):
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query",
+            "document": {"message": "a disk error occurred", "severity": 7}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_err", "q_disk"}
+
+    def test_range_only_query_always_evaluated(self, client):
+        # q_crit has no extractable terms -> must still be tried
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query", "document": {"severity": 10}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_crit"}
+
+    def test_phrase(self, client):
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query",
+            "document": {"message": "process killed: out of memory"}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_oom"}
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query",
+            "document": {"message": "memory of out"}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == set()
+
+    def test_keyword_term(self, client):
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query", "document": {"tag": "network"}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_net"}
+
+    def test_no_match(self, client):
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query", "document": {"message": "all quiet"}}}})
+        assert r["hits"]["hits"] == []
+
+    def test_multiple_documents_with_slots(self, client):
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query",
+            "documents": [{"message": "error one"},
+                          {"message": "quiet"},
+                          {"message": "disk error", "severity": 6}]}}})
+        by_id = {h["_id"]: h for h in r["hits"]["hits"]}
+        assert set(by_id) == {"q_err", "q_disk"}
+        assert by_id["q_err"]["fields"]["_percolator_document_slot"] == [0, 2]
+        assert by_id["q_disk"]["fields"]["_percolator_document_slot"] == [2]
+
+    def test_document_reference(self, client):
+        client.indices.create("docs", {})
+        client.index("docs", {"message": "error in prod"}, id="d1", refresh=True)
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query", "index": "docs", "id": "d1"}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_err"}
+
+    def test_invalid_stored_query_rejected(self, client):
+        with pytest.raises((ApiError, ValueError)):
+            client.index("alerts", {"query": {"bogus_kind": {}}}, id="bad")
+
+    def test_updates_and_deletes(self, client):
+        client.delete("alerts", "q_err")
+        client.index("alerts", {"query": {"match": {"message": "warning"}}},
+                     id="q_warn", refresh=True)
+        r = client.search("alerts", {"query": {"percolate": {
+            "field": "query", "document": {"message": "error warning"}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_warn"}
+
+    def test_bool_of_percolate_and_term(self, client):
+        # percolate composes with ordinary queries on the percolator index
+        client.index("alerts", {"query": {"match": {"message": "error"}},
+                                "tag": "paging"}, id="q_page", refresh=True)
+        r = client.search("alerts", {"query": {"bool": {
+            "must": [{"percolate": {"field": "query",
+                                    "document": {"message": "error"}}}],
+            "filter": [{"term": {"tag": "paging"}}]}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_page"}
+
+    def test_document_containing_percolate_key_not_resolved(self, client):
+        # candidate doc content must never be treated as DSL
+        body = {"query": {"percolate": {"field": "query", "document": {
+            "message": "error", "percolate": {"index": "nope", "id": "1"}}}}}
+        r = client.search("alerts", body)
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q_err"}
+        # the caller's body was not mutated
+        assert "document" not in body["query"]["percolate"].get("percolate", {})
+
+    def test_two_named_percolate_queries_keep_separate_slots(self, client):
+        r = client.search("alerts", {"query": {"bool": {"should": [
+            {"percolate": {"field": "query", "_name": "p1",
+                           "documents": [{"message": "error"}, {"message": "x"}]}},
+            {"percolate": {"field": "query", "_name": "p2",
+                           "documents": [{"message": "y"}, {"message": "error"}]}},
+        ]}}})
+        h = next(x for x in r["hits"]["hits"] if x["_id"] == "q_err")
+        assert h["fields"]["_percolator_document_slot_p1"] == [0]
+        assert h["fields"]["_percolator_document_slot_p2"] == [1]
+
+    def test_count_with_doc_reference(self, client):
+        client.indices.create("docs2", {})
+        client.index("docs2", {"message": "error here"}, id="d1", refresh=True)
+        r = client.count("alerts", {"query": {"percolate": {
+            "field": "query", "index": "docs2", "id": "d1"}}})
+        assert r["count"] == 1
+
+    def test_unknown_percolator_field_is_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("alerts", {"query": {"percolate": {
+                "field": "message", "document": {"message": "x"}}}})
+
+    def test_missing_document_is_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("alerts", {"query": {"percolate": {"field": "query"}}})
+
+    def test_nested_query_percolation(self, client):
+        c = RestClient()
+        c.indices.create("np", {"mappings": {"properties": {
+            "query": {"type": "percolator"},
+            "comments": {"type": "nested", "properties": {
+                "text": {"type": "text"}}}}}})
+        c.index("np", {"query": {"nested": {
+            "path": "comments",
+            "query": {"match": {"comments.text": "spam"}}}}}, id="q1",
+            refresh=True)
+        r = c.search("np", {"query": {"percolate": {
+            "field": "query",
+            "document": {"comments": [{"text": "this is spam"}]}}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"q1"}
