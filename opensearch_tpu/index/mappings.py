@@ -22,10 +22,13 @@ from ..analysis import AnalysisRegistry, Analyzer
 TEXT_TYPES = {"text"}
 KEYWORD_TYPES = {"keyword", "ip"}
 INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean"}
-FLOAT_TYPES = {"double", "float", "half_float"}
+FLOAT_TYPES = {"double", "float", "half_float", "rank_feature"}
 NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
 GEO_TYPES = {"geo_point"}
 VECTOR_TYPES = {"dense_vector", "knn_vector"}
+# feature-weight CSR fields (reference mapper-extras RankFeaturesFieldMapper;
+# sparse_vector is the same storage with learned-sparse token weights)
+FEATURE_TYPES = {"rank_features", "sparse_vector"}
 
 
 @dataclass
@@ -48,6 +51,9 @@ class FieldType:
     # join field (reference modules/parent-join ParentJoinFieldMapper):
     # {"parent_relation": ["child_relation", ...]}
     relations: Dict[str, List[str]] = dc_field(default_factory=dict)
+    # rank_feature(s): False flips the scoring functions (reference
+    # RankFeatureFieldMapper positive_score_impact)
+    positive_score_impact: bool = True
     # text fields keep norms (doc length) unless disabled; keyword fields never
     norms: bool = True
     subfields: Dict[str, "FieldType"] = dc_field(default_factory=dict)
@@ -122,7 +128,11 @@ def coerce_value(ft: FieldType, value: Any):
             raise ValueError(f"value [{value}] out of range for field type [{t}]")
         return iv
     if t in FLOAT_TYPES:
-        return float(value)
+        fv = float(value)
+        if t == "rank_feature" and fv <= 0:
+            raise ValueError(
+                f"[rank_feature] fields must hold positive values, got [{fv}]")
+        return fv
     raise ValueError(f"cannot coerce for type [{t}]")
 
 
@@ -150,6 +160,8 @@ class ParsedDocument:
     # nested path -> child ParsedDocuments (block-join children; reference
     # NestedObjectMapper creates separate Lucene docs in the parent's block)
     nested: Dict[str, List["ParsedDocument"]] = dc_field(default_factory=dict)
+    # field -> {feature: weight} (rank_features / sparse_vector)
+    features: Dict[str, Dict[str, float]] = dc_field(default_factory=dict)
 
 
 class Mappings:
@@ -227,6 +239,7 @@ class Mappings:
         if ftype == "join":
             ft.relations = {p: (c if isinstance(c, list) else [c])
                             for p, c in cfg.get("relations", {}).items()}
+        ft.positive_score_impact = bool(cfg.get("positive_score_impact", True))
         for sub, subcfg in cfg.get("fields", {}).items():
             ft.subfields[sub] = self._build_field(f"{path}.{sub}", subcfg.get("type", "keyword"), subcfg)
         return ft
@@ -357,7 +370,7 @@ class Mappings:
                 continue
             if isinstance(value, dict):
                 ft = self.resolve_field(path)
-                if ft is not None and (ft.type in GEO_TYPES
+                if ft is not None and (ft.type in GEO_TYPES or ft.type in FEATURE_TYPES
                                        or ft.type in ("join", "percolator")):
                     self._index_value(ft, value, parsed)
                 else:
@@ -365,6 +378,11 @@ class Mappings:
                 continue
             values = value if isinstance(value, list) else [value]
             if values and all(isinstance(v, dict) for v in values):
+                lft = self.resolve_field(path)
+                if lft is not None and lft.type in FEATURE_TYPES:
+                    raise ValueError(
+                        f"[{lft.type}] field [{path}] does not support arrays "
+                        f"of feature objects")
                 for v in values:
                     self._parse_obj(v, f"{path}.", parsed)
                 continue
@@ -477,6 +495,20 @@ class Mappings:
         if ft.type in GEO_TYPES:
             lat, lon = _parse_geo(v)
             parsed.geos.setdefault(name, []).append((lat, lon))
+            return
+        if ft.type in FEATURE_TYPES:
+            if not isinstance(v, dict):
+                raise ValueError(
+                    f"[{ft.type}] field [{name}] must hold an object of "
+                    f"feature weights")
+            bucket = parsed.features.setdefault(name, {})
+            for feat, w in v.items():
+                w = float(w)
+                if w <= 0:
+                    raise ValueError(
+                        f"[{ft.type}] weights must be positive, got "
+                        f"[{feat}]={w}")
+                bucket[str(feat)] = w
             return
         if ft.type in VECTOR_TYPES:
             vec = [float(x) for x in (v if isinstance(v, list) else [v])]

@@ -585,6 +585,27 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
 
     postings = pack_postings(parsed_docs, with_positions)
 
+    # ---- feature postings (rank_features / sparse_vector): CSR rows are
+    # features, "tf" carries the feature weight — the device scores them with
+    # the same gather->scatter pass as terms (reference mapper-extras encodes
+    # weights in the term frequency the same way) ----
+    feat_fields = {f for pd in parsed_docs for f in pd.features}
+    for fname in sorted(feat_fields):
+        feat_docs: Dict[str, List[Tuple[int, float]]] = {}
+        for doc_i, pd in enumerate(parsed_docs):
+            for feat, w in pd.features.get(fname, {}).items():
+                feat_docs.setdefault(feat, []).append((doc_i, w))
+        vocab = sorted(feat_docs)
+        terms = {t: i for i, t in enumerate(vocab)}
+        starts = np.zeros(len(vocab) + 1, dtype=np.int64)
+        flat: List[Tuple[int, float]] = []
+        for i, t in enumerate(vocab):
+            flat.extend(feat_docs[t])
+            starts[i + 1] = len(flat)
+        doc_ids = np.fromiter((d for d, _ in flat), np.int32, count=len(flat))
+        tfs = np.fromiter((w for _, w in flat), np.float32, count=len(flat))
+        postings[fname] = PostingsBlock(fname, vocab, terms, starts, doc_ids, tfs)
+
     # ---- doc values ----
     numeric_cols: Dict[str, NumericColumn] = {}
     keyword_cols: Dict[str, KeywordColumn] = {}

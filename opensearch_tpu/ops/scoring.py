@@ -133,6 +133,42 @@ def term_filter_mask(field_arrays: dict, live: jnp.ndarray, rows: jnp.ndarray,
     return (hits > 0) & (live > 0)
 
 
+def feature_score(field_arrays: dict, live: jnp.ndarray, rows: jnp.ndarray,
+                  bucket: int, ndocs_pad: int, contrib_fn) -> ScoredMask:
+    """Score a feature-postings row group (rank_feature / sparse dot):
+    gather (doc, weight) postings, apply `contrib_fn(weight, term_idx)` on the
+    VPU, scatter-add. Matches only docs carrying the feature(s) (reference
+    RankFeatureQuery / learned-sparse dot product)."""
+    docs, w, term_idx, valid = gather_postings(
+        field_arrays["starts"], field_arrays["doc_ids"], field_arrays["tfs"],
+        rows, bucket)
+    contrib = jnp.where(valid, contrib_fn(w, term_idx), 0.0)
+    scores = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(contrib, mode="drop")
+    counts = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(
+        jnp.where(valid, 1.0, 0.0), mode="drop")
+    live_ok = live > 0
+    return ScoredMask(jnp.where(live_ok, scores, 0.0),
+                      jnp.where(live_ok, counts, 0.0))
+
+
+def rank_feature_value(w, fn_id: str, p1, p2, positive: bool):
+    """The four reference rank_feature scoring functions (RankFeatureQuery):
+    saturation w/(w+pivot), log ln(scaling+w), sigmoid w^e/(w^e+p^e), linear.
+    `positive=False` flips saturation/sigmoid (p/(p+w) style) like
+    positive_score_impact=false."""
+    if fn_id == "linear":
+        return w
+    if fn_id == "saturation":
+        return p1 / (p1 + w) if not positive else w / (w + p1)
+    if fn_id == "log":
+        return jnp.log(p1 + w)
+    if fn_id == "sigmoid":
+        we = jnp.power(jnp.maximum(w, 0.0), p2)
+        pe = jnp.power(p1, p2)
+        return pe / (pe + we) if not positive else we / (we + pe)
+    raise ValueError(f"unknown rank_feature function [{fn_id}]")
+
+
 # ---------------- dense column predicates ----------------
 
 def int64_range_mask(col: dict, lo_hi: jnp.ndarray, lo_lo: jnp.ndarray,

@@ -290,6 +290,40 @@ class LHasParent(LNode):
 
 
 @dataclass
+class LRankFeature(LNode):
+    """rank_feature scoring: a single feature row of a feature-postings block
+    (gather→fn→scatter) or a dense rank_feature numeric column."""
+
+    field: str = ""
+    feature: Optional[str] = None   # None = numeric rank_feature column
+    fn: str = "saturation"
+    p1: float = 1.0
+    p2: float = 1.0
+    positive: bool = True
+    boost: float = 1.0
+
+
+@dataclass
+class LSparseDot(LNode):
+    """Learned-sparse dot product: sum of query-token weight × stored feature
+    weight over a rank_features/sparse_vector block."""
+
+    field: str = ""
+    tokens: List[str] = dc_field(default_factory=list)
+    weights: Optional[np.ndarray] = None
+    boost: float = 1.0
+
+
+@dataclass
+class LDistanceFeature(LNode):
+    field: str = ""
+    kind: str = "date"     # date | geo
+    origin: Any = None     # i64 epoch-ms | (lat, lon)
+    pivot: float = 0.0     # ms | meters
+    boost: float = 1.0
+
+
+@dataclass
 class LPercolate(LNode):
     """Stored-query reverse match: per segment, a host-computed f32 mask of
     which percolator docs' queries match the candidate mini-segment
@@ -728,6 +762,41 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         return LNested(path=q.path, child=inner, child_ctx=child_ctx,
                        score_mode=q.score_mode, boost=q.boost)
 
+    if isinstance(q, dsl.RankFeatureQuery):
+        return _rewrite_rank_feature(q, ctx)
+
+    if isinstance(q, dsl.NeuralSparseQuery):
+        ft = m.resolve_field(q.field)
+        if ft is None or ft.type not in ("rank_features", "sparse_vector"):
+            raise dsl.QueryParseError(
+                f"[neural_sparse] field [{q.field}] is not a rank_features/"
+                f"sparse_vector field")
+        toks = sorted(q.tokens)
+        return LSparseDot(field=ft.name, tokens=toks,
+                          weights=np.asarray([q.tokens[t] for t in toks],
+                                             np.float32),
+                          boost=q.boost)
+
+    if isinstance(q, dsl.DistanceFeatureQuery):
+        ft = m.resolve_field(q.field)
+        if ft is None:
+            raise dsl.QueryParseError(
+                f"[distance_feature] unknown field [{q.field}]")
+        if ft.type == "date":
+            from ..index.mappings import _parse_date
+            origin = _parse_date(q.origin, ft.date_format)
+            pivot = float(parse_interval_ms(q.pivot))
+            return LDistanceFeature(field=ft.name, kind="date", origin=origin,
+                                    pivot=pivot, boost=q.boost)
+        if ft.type in ("geo_point",):
+            origin = dsl._parse_point(q.origin)
+            pivot = dsl._parse_distance(q.pivot)
+            return LDistanceFeature(field=ft.name, kind="geo", origin=origin,
+                                    pivot=pivot, boost=q.boost)
+        raise dsl.QueryParseError(
+            f"[distance_feature] field [{q.field}] must be a date or "
+            f"geo_point field")
+
     if isinstance(q, (dsl.HasChildQuery, dsl.HasParentQuery, dsl.ParentIdQuery)):
         return _rewrite_join(q, ctx, scoring)
 
@@ -750,6 +819,62 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
                           boost=q.boost)
 
     raise dsl.QueryParseError(f"cannot compile query {type(q).__name__}")
+
+
+def _rewrite_rank_feature(q: dsl.RankFeatureQuery, ctx: ShardContext) -> LNode:
+    m = ctx.mappings
+    ft = m.resolve_field(q.field)
+    if ft is not None and ft.type == "rank_feature":
+        field, feature, positive = ft.name, None, ft.positive_score_impact
+    else:
+        # "features.pagerank": longest mapped prefix typed rank_features
+        parts = q.field.split(".")
+        field = feature = None
+        for cut in range(len(parts) - 1, 0, -1):
+            pft = m.resolve_field(".".join(parts[:cut]))
+            if pft is not None and pft.type in ("rank_features", "sparse_vector"):
+                field, feature = pft.name, ".".join(parts[cut:])
+                positive = pft.positive_score_impact
+                break
+        if field is None:
+            raise dsl.QueryParseError(
+                f"[rank_feature] field [{q.field}] is not a rank_feature or "
+                f"rank_features feature")
+
+    fn, p1, p2 = q.function, 1.0, 1.0
+    if not positive and fn in ("log", "linear"):
+        raise dsl.QueryParseError(
+            f"[rank_feature] [{fn}] is incompatible with "
+            f"positive_score_impact=false fields")
+    if fn == "saturation":
+        p1 = q.pivot if q.pivot is not None else _default_pivot(ctx, field, feature)
+    elif fn == "log":
+        p1 = float(q.scaling_factor)
+    elif fn == "sigmoid":
+        p1, p2 = float(q.pivot), float(q.exponent)
+    return LRankFeature(field=field, feature=feature, fn=fn, p1=float(p1),
+                        p2=float(p2), positive=positive, boost=q.boost)
+
+
+def _default_pivot(ctx: ShardContext, field: str, feature: Optional[str]) -> float:
+    """Default saturation pivot ≈ mean feature value over the index
+    (reference computes an approximate geometric mean from the index stats)."""
+    total, count = 0.0, 0
+    for s in ctx.segments:
+        if feature is None:
+            col = s.numeric_cols.get(field)
+            if col is not None and col.present.any():
+                total += float(col.values[col.present].sum())
+                count += int(col.present.sum())
+        else:
+            pb = s.postings.get(field)
+            if pb is not None:
+                r = pb.row(feature)
+                if r >= 0:
+                    a, b = pb.row_slice(r)
+                    total += float(pb.tfs[a:b].sum())
+                    count += b - a
+    return (total / count) if count else 1.0
 
 
 def _rewrite_join(q, ctx: ShardContext, scoring: bool) -> LNode:
@@ -1231,6 +1356,48 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         cf_spec = prepare(node.child_filter, seg, ctx, params)
         _scalar_f32(params, f"q{nid}_boost", node.boost)
         return ("has_parent", nid, node.use_score, cf_spec)
+
+    if isinstance(node, LRankFeature):
+        _scalar_f32(params, f"q{nid}_p1", node.p1)
+        _scalar_f32(params, f"q{nid}_p2", node.p2)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        if node.feature is None:
+            return ("rank_feature_col", nid, node.field, node.fn, node.positive,
+                    node.field in seg.numeric_cols)
+        pb = seg.postings.get(node.field)
+        row = pb.row(node.feature) if pb is not None else -1
+        df = pb.doc_freq(node.feature) if pb is not None else 0
+        _p(params, f"q{nid}_rows", np.asarray([row], np.int32))
+        return ("rank_feature_post", nid, node.field, ops.pick_bucket(df, 16),
+                node.fn, node.positive, pb is not None)
+
+    if isinstance(node, LSparseDot):
+        pb = seg.postings.get(node.field)
+        if pb is None:
+            return ("match_none", nid)
+        T_pad = next_pow2(len(node.tokens), floor=8)
+        rows = np.full(T_pad, -1, np.int32)
+        rows[: len(node.tokens)] = [pb.row(t) for t in node.tokens]
+        _p(params, f"q{nid}_rows", rows)
+        w = np.zeros(T_pad, np.float32)
+        w[: len(node.tokens)] = node.weights
+        _p(params, f"q{nid}_w", w)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        total = sum(pb.doc_freq(t) for t in node.tokens)
+        return ("sparse_dot", nid, node.field, T_pad, ops.pick_bucket(total))
+
+    if isinstance(node, LDistanceFeature):
+        _scalar_f32(params, f"q{nid}_pivot", node.pivot)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        if node.kind == "date":
+            hi, lo = split_i64(np.asarray([node.origin], np.int64))
+            _scalar_i32(params, f"q{nid}_ohi", int(hi[0]))
+            _scalar_i32(params, f"q{nid}_olo", int(lo[0]))
+            return ("distfeat_date", nid, node.field,
+                    node.field in seg.numeric_cols)
+        _scalar_f32(params, f"q{nid}_lat", node.origin[0])
+        _scalar_f32(params, f"q{nid}_lon", node.origin[1])
+        return ("distfeat_geo", nid, node.field, node.field in seg.geo_cols)
 
     if isinstance(node, LPercolate):
         from .percolate import segment_mask
@@ -1716,6 +1883,68 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         sc = gscore[idx] if use_score else jnp.ones(ndocs_pad, jnp.float32)
         sc = jnp.where(ok, sc * params[f"q{nid}_boost"], 0.0)
         return ops.ScoredMask(sc, ok.astype(jnp.float32))
+
+    if kind == "rank_feature_post":
+        _, _, field, bucket, fn, positive, pb_exists = spec
+        post = seg_arrays["postings"].get(field)
+        if not pb_exists or post is None:
+            return ops.ScoredMask(zeros, zeros)
+        p1, p2 = params[f"q{nid}_p1"], params[f"q{nid}_p2"]
+        sm = ops.feature_score(
+            post, live, params[f"q{nid}_rows"], bucket, ndocs_pad,
+            lambda w, ti: ops.rank_feature_value(w, fn, p1, p2, positive))
+        return ops.ScoredMask(sm.scores * params[f"q{nid}_boost"], sm.count)
+
+    if kind == "rank_feature_col":
+        _, _, field, fn, positive, col_exists = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        col = seg_arrays["numeric"][field]
+        v = ops.rank_feature_value(col["f32"], fn, params[f"q{nid}_p1"],
+                                   params[f"q{nid}_p2"], positive)
+        mask = col["present"] & (live > 0)
+        return ops.ScoredMask(jnp.where(mask, v * params[f"q{nid}_boost"], 0.0),
+                              mask.astype(jnp.float32))
+
+    if kind == "sparse_dot":
+        _, _, field, T_pad, bucket = spec
+        post = seg_arrays["postings"].get(field)
+        if post is None:
+            return ops.ScoredMask(zeros, zeros)
+        qw = params[f"q{nid}_w"]
+        sm = ops.feature_score(post, live, params[f"q{nid}_rows"], bucket,
+                               ndocs_pad, lambda w, ti: qw[ti] * w)
+        return ops.ScoredMask(sm.scores * params[f"q{nid}_boost"], sm.count)
+
+    if kind == "distfeat_date":
+        _, _, field, col_exists = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        col = seg_arrays["numeric"][field]
+        dhi = (col["hi"] - params[f"q{nid}_ohi"]).astype(jnp.float32)
+        dlo = col["lo"].astype(jnp.float32) - jnp.float32(params[f"q{nid}_olo"])
+        dist = jnp.abs(dhi * 4294967296.0 + dlo)
+        pivot = params[f"q{nid}_pivot"]
+        mask = col["present"] & (live > 0)
+        sc = params[f"q{nid}_boost"] * pivot / (pivot + dist)
+        return ops.ScoredMask(jnp.where(mask, sc, 0.0), mask.astype(jnp.float32))
+
+    if kind == "distfeat_geo":
+        _, _, field, col_exists = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        geo = seg_arrays["geo"][field]
+        r = 6371008.8
+        p1r = jnp.deg2rad(geo["lat"])
+        p2r = jnp.deg2rad(params[f"q{nid}_lat"])
+        dphi = p2r - p1r
+        dlmb = jnp.deg2rad(params[f"q{nid}_lon"] - geo["lon"])
+        a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1r) * jnp.cos(p2r) * jnp.sin(dlmb / 2) ** 2
+        dist = 2 * r * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+        pivot = params[f"q{nid}_pivot"]
+        mask = geo["present"] & (live > 0)
+        sc = params[f"q{nid}_boost"] * pivot / (pivot + dist)
+        return ops.ScoredMask(jnp.where(mask, sc, 0.0), mask.astype(jnp.float32))
 
     if kind == "percolate":
         mask = (params[f"q{nid}_mask"] > 0) & (live > 0)

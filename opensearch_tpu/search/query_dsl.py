@@ -292,6 +292,38 @@ class HasParentQuery(Query):
 
 
 @dataclass
+class RankFeatureQuery(Query):
+    """Score docs by a rank_feature(s) value through one of four monotone
+    functions (reference mapper-extras RankFeatureQueryBuilder)."""
+
+    field: str = ""
+    function: str = "saturation"   # saturation | log | sigmoid | linear
+    pivot: Optional[float] = None  # saturation/sigmoid
+    scaling_factor: Optional[float] = None  # log
+    exponent: Optional[float] = None        # sigmoid
+
+
+@dataclass
+class DistanceFeatureQuery(Query):
+    """Decaying proximity score on date/geo fields:
+    boost * pivot / (pivot + distance) (reference DistanceFeatureQueryBuilder)."""
+
+    field: str = ""
+    origin: Any = None
+    pivot: Any = None
+
+
+@dataclass
+class NeuralSparseQuery(Query):
+    """Learned-sparse dot product over a rank_features/sparse_vector field
+    (reference neural-search plugin neural_sparse, raw query_tokens mode —
+    model inference happens outside the engine)."""
+
+    field: str = ""
+    tokens: Dict[str, float] = dc_field(default_factory=dict)
+
+
+@dataclass
 class PercolateQuery(Query):
     """Match stored percolator queries against candidate document(s)
     (reference modules/percolator PercolateQueryBuilder)."""
@@ -607,6 +639,43 @@ def parse_query(dsl: Optional[dict]) -> Query:
         q = ParentIdQuery(type=body["type"], id=str(body["id"]),
                           ignore_unmapped=bool(body.get("ignore_unmapped", False)))
         _common(q, body)
+        return q
+
+    if kind == "rank_feature":
+        fns = [k for k in ("saturation", "log", "sigmoid", "linear") if k in body]
+        if len(fns) > 1:
+            raise QueryParseError("[rank_feature] accepts at most one function")
+        fn = fns[0] if fns else "saturation"
+        spec = body.get(fn) or {}
+        if fn == "log" and "scaling_factor" not in spec:
+            raise QueryParseError("[rank_feature] [log] requires scaling_factor")
+        if fn == "sigmoid" and ("pivot" not in spec or "exponent" not in spec):
+            raise QueryParseError("[rank_feature] [sigmoid] requires pivot and exponent")
+        q = RankFeatureQuery(field=body["field"], function=fn,
+                             pivot=spec.get("pivot"),
+                             scaling_factor=spec.get("scaling_factor"),
+                             exponent=spec.get("exponent"))
+        _common(q, body)
+        return q
+
+    if kind == "distance_feature":
+        if body.get("origin") is None or body.get("pivot") is None:
+            raise QueryParseError("[distance_feature] requires origin and pivot")
+        q = DistanceFeatureQuery(field=body["field"], origin=body["origin"],
+                                 pivot=body["pivot"])
+        _common(q, body)
+        return q
+
+    if kind == "neural_sparse":
+        f, spec = _one_entry(body, "neural_sparse")
+        tokens = spec.get("query_tokens")
+        if not isinstance(tokens, dict) or not tokens:
+            raise QueryParseError(
+                "[neural_sparse] requires query_tokens (raw token weights; "
+                "model inference is out of engine scope)")
+        q = NeuralSparseQuery(field=f,
+                              tokens={str(t): float(w) for t, w in tokens.items()})
+        _common(q, spec)
         return q
 
     if kind == "percolate":

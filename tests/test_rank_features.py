@@ -1,0 +1,186 @@
+"""rank_feature(s) / sparse_vector / distance_feature tests. Reference:
+mapper-extras RankFeature(s)FieldMapper + RankFeatureQuery,
+DistanceFeatureQueryBuilder, neural-search learned-sparse scoring. Ours:
+feature-weight CSR postings scored by the gather->fn->scatter pass
+(ops.feature_score)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("rf", {"mappings": {"properties": {
+        "pagerank": {"type": "rank_feature"},
+        "topics": {"type": "rank_features"},
+        "embedding": {"type": "sparse_vector"},
+        "title": {"type": "text"},
+        "published": {"type": "date"},
+        "location": {"type": "geo_point"}}}})
+    c.index("rf", {"title": "jax on tpu", "pagerank": 10.0,
+                   "topics": {"ml": 5.0, "hardware": 2.0},
+                   "embedding": {"jax": 2.0, "tpu": 1.5},
+                   "published": "2024-06-01", "location": {"lat": 0, "lon": 0}},
+            id="1")
+    c.index("rf", {"title": "cooking pasta", "pagerank": 2.0,
+                   "topics": {"food": 8.0},
+                   "embedding": {"pasta": 3.0},
+                   "published": "2020-01-01", "location": {"lat": 10, "lon": 10}},
+            id="2")
+    c.index("rf", {"title": "tpu pods", "pagerank": 30.0,
+                   "topics": {"ml": 1.0, "hardware": 9.0},
+                   "embedding": {"tpu": 3.0, "pod": 1.0},
+                   "published": "2024-05-01", "location": {"lat": 0.1, "lon": 0.1}},
+            id="3")
+    c.indices.refresh("rf")
+    return c
+
+
+class TestRankFeature:
+    def test_saturation_on_numeric_field(self, client):
+        r = client.search("rf", {"query": {"rank_feature": {
+            "field": "pagerank", "saturation": {"pivot": 10}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["1"] == pytest.approx(10 / 20)
+        assert got["2"] == pytest.approx(2 / 12)
+        assert got["3"] == pytest.approx(30 / 40)
+
+    def test_default_pivot_is_mean(self, client):
+        r = client.search("rf", {"query": {"rank_feature": {"field": "pagerank"}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        mean = (10 + 2 + 30) / 3
+        assert got["1"] == pytest.approx(10 / (10 + mean))
+
+    def test_features_field(self, client):
+        r = client.search("rf", {"query": {"rank_feature": {
+            "field": "topics.ml", "saturation": {"pivot": 1}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert set(got) == {"1", "3"}  # doc 2 has no ml feature
+        assert got["1"] == pytest.approx(5 / 6)
+        assert got["3"] == pytest.approx(1 / 2)
+
+    def test_log_and_sigmoid_and_linear(self, client):
+        import math
+        r = client.search("rf", {"query": {"rank_feature": {
+            "field": "pagerank", "log": {"scaling_factor": 4}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["1"] == pytest.approx(math.log(14), rel=1e-5)
+        r = client.search("rf", {"query": {"rank_feature": {
+            "field": "pagerank", "sigmoid": {"pivot": 10, "exponent": 2}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["3"] == pytest.approx(900 / (900 + 100))
+        r = client.search("rf", {"query": {"rank_feature": {
+            "field": "pagerank", "linear": {}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["3"] == pytest.approx(30.0)
+
+    def test_boost_and_bool_combination(self, client):
+        r = client.search("rf", {"query": {"bool": {
+            "must": [{"match": {"title": "tpu"}}],
+            "should": [{"rank_feature": {"field": "pagerank",
+                                         "saturation": {"pivot": 10},
+                                         "boost": 2.0}}]}}})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert set(ids) == {"1", "3"}
+        assert ids[0] == "3"  # pagerank boost dominates
+
+    def test_bad_function_spec_is_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("rf", {"query": {"rank_feature": {
+                "field": "pagerank", "log": {}}}})
+        with pytest.raises(ApiError):
+            client.search("rf", {"query": {"rank_feature": {
+                "field": "title"}}})
+
+    def test_positive_score_impact_false(self, client):
+        c = RestClient()
+        c.indices.create("neg", {"mappings": {"properties": {
+            "url_length": {"type": "rank_feature",
+                           "positive_score_impact": False}}}})
+        c.index("neg", {"url_length": 10.0}, id="a")
+        c.index("neg", {"url_length": 90.0}, id="b", refresh=True)
+        r = c.search("neg", {"query": {"rank_feature": {
+            "field": "url_length", "saturation": {"pivot": 10}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["a"] > got["b"]  # shorter URL scores higher
+        assert got["a"] == pytest.approx(10 / 20)
+
+
+class TestNeuralSparse:
+    def test_dot_product(self, client):
+        r = client.search("rf", {"query": {"neural_sparse": {"embedding": {
+            "query_tokens": {"tpu": 2.0, "jax": 1.0}}}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["1"] == pytest.approx(2 * 1.5 + 1 * 2.0)
+        assert got["3"] == pytest.approx(2 * 3.0)
+        assert "2" not in got
+
+    def test_unknown_field_is_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("rf", {"query": {"neural_sparse": {"title": {
+                "query_tokens": {"x": 1.0}}}}})
+
+
+class TestDistanceFeature:
+    def test_date(self, client):
+        r = client.search("rf", {"query": {"distance_feature": {
+            "field": "published", "origin": "2024-06-01", "pivot": "7d"}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["1"] == pytest.approx(1.0, abs=1e-3)   # zero distance
+        assert got["3"] == pytest.approx(7 / (7 + 31), rel=1e-2)
+        assert got["1"] > got["3"] > got["2"]
+
+    def test_geo(self, client):
+        r = client.search("rf", {"query": {"distance_feature": {
+            "field": "location", "origin": [0, 0], "pivot": "100km"}}})
+        got = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert got["1"] == pytest.approx(1.0, abs=1e-3)
+        assert got["1"] > got["3"] > got["2"]
+
+    def test_combined_with_match(self, client):
+        r = client.search("rf", {"query": {"bool": {
+            "must": [{"match": {"title": "tpu"}}],
+            "should": [{"distance_feature": {"field": "published",
+                                             "origin": "2024-06-01",
+                                             "pivot": "1d", "boost": 5.0}}]}}})
+        assert [h["_id"] for h in r["hits"]["hits"]][0] == "1"
+
+
+class TestFeaturePersistence:
+    def test_flush_and_reload(self, client, tmp_path):
+        import tempfile
+        p = str(tmp_path / "data")
+        c = RestClient(data_path=p)
+        c.indices.create("rfp", {"mappings": {"properties": {
+            "topics": {"type": "rank_features"}}}})
+        c.index("rfp", {"topics": {"a": 4.0}}, id="1", refresh=True)
+        c.indices.flush("rfp")
+        c2 = RestClient(data_path=p)
+        r = c2.search("rfp", {"query": {"rank_feature": {
+            "field": "topics.a", "saturation": {"pivot": 4}}}})
+        assert r["hits"]["hits"][0]["_score"] == pytest.approx(0.5)
+
+    def test_negative_weight_rejected(self, client):
+        with pytest.raises((ApiError, ValueError)):
+            client.index("rf", {"topics": {"bad": -1.0}}, id="x")
+
+    def test_negative_scalar_rank_feature_rejected(self, client):
+        with pytest.raises((ApiError, ValueError)):
+            client.index("rf", {"pagerank": -5.0}, id="x")
+        with pytest.raises((ApiError, ValueError)):
+            client.index("rf", {"pagerank": 0.0}, id="x")
+
+    def test_array_of_feature_objects_rejected(self, client):
+        with pytest.raises((ApiError, ValueError)):
+            client.index("rf", {"topics": [{"ml": 2.0}]}, id="x")
+
+    def test_log_on_negative_impact_field_is_400(self):
+        c = RestClient()
+        c.indices.create("neg2", {"mappings": {"properties": {
+            "len": {"type": "rank_feature", "positive_score_impact": False}}}})
+        c.index("neg2", {"len": 5.0}, id="a", refresh=True)
+        with pytest.raises(ApiError):
+            c.search("neg2", {"query": {"rank_feature": {
+                "field": "len", "log": {"scaling_factor": 2}}}})
