@@ -25,12 +25,15 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 BUCKET_KINDS = {"terms", "histogram", "date_histogram", "range", "date_range",
-                "filter", "filters", "global", "missing"}
+                "filter", "filters", "global", "missing", "significant_terms",
+                "sampler", "geohash_grid", "geotile_grid"}
 METRIC_KINDS = {"min", "max", "sum", "avg", "stats", "extended_stats",
-                "value_count", "cardinality", "percentiles", "top_hits"}
+                "value_count", "cardinality", "percentiles", "top_hits",
+                "matrix_stats"}
 PIPELINE_KINDS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                   "stats_bucket", "cumulative_sum", "derivative", "bucket_script",
-                  "bucket_selector"}
+                  "bucket_selector", "moving_avg", "moving_fn", "serial_diff",
+                  "percentiles_bucket", "bucket_sort"}
 
 
 @dataclass
@@ -74,16 +77,8 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
     if not parts:
         return {}
     kind = node.kind
-    if kind == "terms":
-        acc: Dict[Any, dict] = {}
-        for p in parts:
-            for key, rec in p["buckets"].items():
-                slot = acc.setdefault(key, {"doc_count": 0, "subs": []})
-                slot["doc_count"] += rec["doc_count"]
-                slot["subs"].append(rec.get("subs"))
-        for key, slot in acc.items():
-            slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
-        return {"buckets": acc}
+    if kind in ("terms", "geohash_grid", "geotile_grid"):
+        return {"buckets": _acc_buckets(node, parts)}
     if kind in ("histogram", "date_histogram"):
         acc = {}
         for p in parts:
@@ -105,10 +100,26 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
         for key, slot in acc.items():
             slot["subs"] = _merge_subtrees(node.subs, slot["subs"])
         return {"buckets": acc}
-    if kind in ("filter", "global", "missing"):
+    if kind in ("filter", "global", "missing", "sampler"):
         total = sum(p["doc_count"] for p in parts)
         subs = _merge_subtrees(node.subs, [p.get("subs") for p in parts])
         return {"doc_count": total, "subs": subs}
+    if kind == "significant_terms":
+        bg: Dict[Any, int] = {}
+        for p in parts:
+            for key, c in p["bg"].items():
+                bg[key] = bg.get(key, 0) + c
+        return {"buckets": _acc_buckets(node, parts), "bg": bg,
+                "fg_total": sum(p["fg_total"] for p in parts),
+                "bg_total": sum(p["bg_total"] for p in parts)}
+    if kind == "matrix_stats":
+        count = sum(p["count"] for p in parts)
+        out = {"count": count, "fields": parts[0]["fields"],
+               "shift": parts[0].get("shift")}
+        for key in ("s1", "s2", "s3", "s4"):
+            out[key] = np.sum([p[key] for p in parts], axis=0)
+        out["xy"] = np.sum([p["xy"] for p in parts], axis=0)
+        return out
     if kind in ("min", "max", "sum", "avg", "stats", "extended_stats", "value_count"):
         return _merge_stats(parts)
     if kind == "cardinality":
@@ -126,6 +137,20 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
         rows.sort(key=lambda r: -r["_score"] if r["_score"] is not None else 0)
         return {"hits": rows[: parts[0]["size"]], "total": sum(p["total"] for p in parts)}
     raise ValueError(f"cannot merge aggregation kind [{kind}]")
+
+
+def _acc_buckets(node: AggNode, parts: List[dict]) -> Dict[Any, dict]:
+    """Accumulate keyed buckets + their sub-metric partials across segments
+    (shared by terms / significant_terms / geo grids)."""
+    acc: Dict[Any, dict] = {}
+    for p in parts:
+        for key, rec in p["buckets"].items():
+            slot = acc.setdefault(key, {"doc_count": 0, "subs": []})
+            slot["doc_count"] += rec["doc_count"]
+            slot["subs"].append(rec.get("subs"))
+    for key, slot in acc.items():
+        slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
+    return acc
 
 
 def _merge_stats(parts: List[dict]) -> dict:
@@ -208,7 +233,9 @@ def finalize(node: AggNode, merged: dict) -> dict:
             for sub in node.subs:
                 entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
             buckets.append(entry)
-        return {"buckets": buckets}
+        result = {"buckets": buckets}
+        _apply_bucket_pipelines(node, result)
+        return result
     if kind == "filters":
         buckets = {}
         for key in merged["buckets"]:
@@ -218,11 +245,29 @@ def finalize(node: AggNode, merged: dict) -> dict:
                 entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
             buckets[key] = entry
         return {"buckets": buckets}
-    if kind in ("filter", "global", "missing"):
+    if kind in ("filter", "global", "missing", "sampler"):
         out = {"doc_count": int(merged["doc_count"])}
         for sub in node.subs:
             out[sub.name] = finalize(sub, merged["subs"].get(sub.name, {}))
         return out
+    if kind == "significant_terms":
+        return _finalize_significant(node, merged)
+    if kind in ("geohash_grid", "geotile_grid"):
+        size = int(node.body.get("size", 10000))
+        items = sorted(((k, v) for k, v in merged["buckets"].items()
+                        if v["doc_count"] > 0),
+                       key=lambda kv: (-kv[1]["doc_count"], kv[0]))
+        buckets = []
+        for k, v in items[:size]:
+            b = {"key": k, "doc_count": int(v["doc_count"])}
+            for sub in node.subs:
+                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}))
+            buckets.append(b)
+        result = {"buckets": buckets}
+        _apply_bucket_pipelines(node, result)
+        return result
+    if kind == "matrix_stats":
+        return _finalize_matrix_stats(merged)
     if kind == "value_count":
         return {"value": int(merged["count"])}
     if kind == "min":
@@ -259,10 +304,98 @@ def finalize(node: AggNode, merged: dict) -> dict:
     raise ValueError(f"cannot finalize aggregation kind [{kind}]")
 
 
+def _significance_score(fg: float, fg_total: float, bg: float, bg_total: float,
+                        heuristic: str) -> float:
+    """Reference significance heuristics (JLH default, chi_square,
+    percentage) over foreground vs background frequencies."""
+    if fg_total == 0 or bg_total == 0 or bg == 0:
+        return 0.0
+    fgp = fg / fg_total
+    bgp = bg / bg_total
+    if heuristic == "percentage":
+        return fg / bg
+    if heuristic == "chi_square":
+        num = (fgp - bgp) ** 2
+        den = bgp * (1 - bgp)
+        return (num / den) * bg_total if den > 0 else 0.0
+    # JLH: absolute change * relative change
+    return (fgp - bgp) * (fgp / bgp) if fgp > bgp else 0.0
+
+
+def _finalize_significant(node: AggNode, merged: dict) -> dict:
+    body = node.body
+    heuristic = next((h for h in ("jlh", "chi_square", "percentage")
+                      if h in body), "jlh")
+    size = int(body.get("size", 10))
+    min_doc_count = int(body.get("min_doc_count", 3))
+    fg_total, bg_total = merged["fg_total"], merged["bg_total"]
+    scored = []
+    for key, rec in merged["buckets"].items():
+        fg = rec["doc_count"]
+        bg = merged["bg"].get(key, fg)
+        if fg < min_doc_count:
+            continue
+        score = _significance_score(fg, fg_total, bg, bg_total, heuristic)
+        if score > 0:
+            scored.append((score, key, fg, bg, rec))
+    scored.sort(key=lambda t: (-t[0], t[1]))
+    buckets = []
+    for score, key, fg, bg, rec in scored[:size]:
+        b = {"key": key, "doc_count": int(fg), "score": score,
+             "bg_count": int(bg)}
+        for sub in node.subs:
+            b[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+        buckets.append(b)
+    return {"doc_count": int(fg_total), "bg_count": int(bg_total),
+            "buckets": buckets}
+
+
+def _finalize_matrix_stats(merged: dict) -> dict:
+    n = float(merged["count"])
+    fields = merged["fields"]
+    if n == 0:
+        return {"doc_count": 0, "fields": []}
+    s1, s2, s3, s4 = (np.asarray(merged[k], np.float64)
+                      for k in ("s1", "s2", "s3", "s4"))
+    xy = np.asarray(merged["xy"], np.float64)
+    shift = np.asarray(merged.get("shift", np.zeros(len(fields))), np.float64)
+    # device sums are centered about `shift`; `mean` below is the small
+    # residual d = Σ(x-shift)/n, so the central-moment differences don't cancel
+    mean = s1 / n
+    m2 = s2 / n - mean ** 2
+    var = m2 * n / max(n - 1, 1)  # unbiased, like the reference
+    out_fields = []
+    for i, f in enumerate(fields):
+        m2i = max(m2[i], 0.0)
+        m3 = s3[i] / n - 3 * mean[i] * s2[i] / n + 2 * mean[i] ** 3
+        m4 = (s4[i] / n - 4 * mean[i] * s3[i] / n
+              + 6 * mean[i] ** 2 * s2[i] / n - 3 * mean[i] ** 4)
+        skew = m3 / m2i ** 1.5 if m2i > 0 else 0.0
+        kurt = m4 / m2i ** 2 if m2i > 0 else 0.0
+        cov = {}
+        corr = {}
+        for j, g in enumerate(fields):
+            c = (xy[i, j] - s1[i] * s1[j] / n) / max(n - 1, 1)
+            cov[g] = c
+            denom = math.sqrt(var[i] * var[j])
+            corr[g] = c / denom if denom > 0 else 0.0
+        out_fields.append({"name": f, "count": int(n),
+                           "mean": shift[i] + mean[i],
+                           "variance": var[i], "skewness": skew,
+                           "kurtosis": kurt, "covariance": cov,
+                           "correlation": corr})
+    return {"doc_count": int(n), "fields": out_fields}
+
+
 def _empty_result(node: AggNode) -> dict:
-    if node.kind in ("terms", "histogram", "date_histogram", "range", "date_range", "filters"):
+    if node.kind in ("terms", "histogram", "date_histogram", "range",
+                     "date_range", "filters", "geohash_grid", "geotile_grid"):
         return {"buckets": [] if node.kind != "filters" else {}}
-    if node.kind in ("filter", "global", "missing"):
+    if node.kind == "significant_terms":
+        return {"doc_count": 0, "bg_count": 0, "buckets": []}
+    if node.kind == "matrix_stats":
+        return {"doc_count": 0, "fields": []}
+    if node.kind in ("filter", "global", "missing", "sampler"):
         return {"doc_count": 0}
     if node.kind in ("min", "max", "avg"):
         return {"value": None}
@@ -316,24 +449,116 @@ def _apply_pipelines(node: AggNode, buckets_ref) -> None:  # placeholder hook
     return
 
 
+def _bucket_path_value(b: dict, path: str):
+    """Resolve one buckets_path against a finalized bucket (reference
+    BucketHelpers.resolveBucketValue): `_count`, `sub.value`, `sub.avg`,
+    `sub>nested.value` chains."""
+    if path == "_count":
+        return float(b["doc_count"])
+    node: Any = b
+    parts = path.replace(">", ".").split(".")
+    for part in parts:
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    if isinstance(node, dict):
+        node = node.get("value")
+    return node
+
+
+def _moving_fn_eval(script: str, values: List[float], params: dict):
+    """moving_fn scripts: the reference MovingFunctions helpers, plus
+    arbitrary painless-lite expressions over `values`."""
+    fns = {"max": lambda v: max(v) if v else None,
+           "min": lambda v: min(v) if v else None,
+           "sum": lambda v: sum(v),
+           "unweightedAvg": lambda v: sum(v) / len(v) if v else None,
+           "stdDev": None,
+           "linearWeightedAvg": lambda v: (sum((i + 1) * x for i, x in enumerate(v))
+                                           / sum(range(1, len(v) + 1))) if v else None}
+    import re as _re
+    m = _re.match(r"\s*MovingFunctions\.(\w+)\(values(?:,\s*[\w.()]+)?\)\s*$", script)
+    if m and m.group(1) in fns:
+        name = m.group(1)
+        if name == "stdDev":
+            if not values:
+                return None
+            avg = sum(values) / len(values)
+            return math.sqrt(sum((x - avg) ** 2 for x in values) / len(values))
+        return fns[name](values)
+    from ..script import painless_lite as pl
+    return pl.execute(script, {"values": list(values), "params": params})
+
+
 def _apply_bucket_pipelines(node: AggNode, result: dict) -> None:
     """Sibling pipeline aggs over this bucket agg's finalized buckets
-    (reference `search/aggregations/pipeline/`): cumulative_sum, derivative
-    attach per-bucket; *_bucket kinds attach as sibling values."""
+    (reference `search/aggregations/pipeline/`): cumulative_sum, derivative,
+    moving_avg/fn, serial_diff, bucket_script attach per-bucket;
+    bucket_selector/bucket_sort mutate the bucket list; *_bucket /
+    percentiles_bucket attach as sibling values."""
     buckets = result.get("buckets")
     if not isinstance(buckets, list):
         return
     for p in node.pipelines:
-        path = p.body.get("buckets_path", "_count")
-        series = []
-        for b in buckets:
-            if path == "_count":
-                series.append(float(b["doc_count"]))
-            else:
-                head = path.split(">")[0].split(".")[0]
-                sub = b.get(head, {})
-                leaf = path.split(".")[-1] if "." in path else "value"
-                series.append(sub.get(leaf) if isinstance(sub, dict) else None)
+        raw_path = p.body.get("buckets_path", "_count")
+
+        if p.kind in ("bucket_script", "bucket_selector"):
+            from ..script import painless_lite as pl
+            from .query_dsl import parse_script_spec
+            src, sparams = parse_script_spec(p.body.get("script"))
+            paths = raw_path if isinstance(raw_path, dict) else {"_value": raw_path}
+            keep = []
+            for b in buckets:
+                variables = {"params": dict(sparams)}
+                missing = False
+                for var, pth in paths.items():
+                    v = _bucket_path_value(b, pth)
+                    if v is None:
+                        missing = True
+                    variables["params"][var] = v
+                    variables[var] = v
+                if missing:
+                    # gap_policy=skip: retain the bucket unevaluated
+                    # (reference BucketSelector/BucketScript PipelineAggregator)
+                    if p.kind == "bucket_script":
+                        b[p.name] = {"value": None}
+                    keep.append(b)
+                    continue
+                try:
+                    val = pl.execute(src, variables)
+                except pl.ScriptError as e:
+                    raise ValueError(f"[{p.name}] script error: {e}")
+                if p.kind == "bucket_script":
+                    b[p.name] = {"value": float(val) if val is not None else None}
+                    keep.append(b)
+                elif val:
+                    keep.append(b)
+            if p.kind == "bucket_selector":
+                result["buckets"] = buckets = keep
+            continue
+
+        if p.kind == "bucket_sort":
+            sorts = p.body.get("sort", [])
+            frm = int(p.body.get("from", 0))
+            size = p.body.get("size")
+
+            def sort_key(b):
+                key = []
+                for s in sorts:
+                    ((pth, spec),) = s.items() if isinstance(s, dict) else [(s, "asc")]
+                    order = spec.get("order", "asc") if isinstance(spec, dict) else spec
+                    v = _bucket_path_value(b, pth)
+                    v = float("-inf") if v is None else v
+                    key.append(-v if order == "desc" else v)
+                return tuple(key)
+
+            if sorts:
+                buckets.sort(key=sort_key)
+            end = frm + int(size) if size is not None else None
+            result["buckets"] = buckets = buckets[frm:end]
+            continue
+
+        series = [_bucket_path_value(b, raw_path) for b in buckets]
         vals = [v for v in series if v is not None]
         if p.kind == "cumulative_sum":
             run = 0.0
@@ -345,6 +570,47 @@ def _apply_bucket_pipelines(node: AggNode, result: dict) -> None:
             for b, v in zip(buckets, series):
                 b[p.name] = {"value": None if prev is None or v is None else v - prev}
                 prev = v
+        elif p.kind == "serial_diff":
+            lag = int(p.body.get("lag", 1))
+            for i, b in enumerate(series):
+                cur = series[i]
+                ref = series[i - lag] if i >= lag else None
+                buckets[i][p.name] = {
+                    "value": None if cur is None or ref is None else cur - ref}
+        elif p.kind in ("moving_avg", "moving_fn"):
+            window = int(p.body.get("window", 5))
+            shift = int(p.body.get("shift", 0))
+            for i, b in enumerate(buckets):
+                lo = max(0, i - window + shift)
+                hi = max(0, i + shift)
+                win = [v for v in series[lo:hi] if v is not None]
+                if p.kind == "moving_avg":
+                    model = p.body.get("model", "simple")
+                    if not win:
+                        out = None
+                    elif model == "linear":
+                        wsum = sum(range(1, len(win) + 1))
+                        out = sum((j + 1) * x for j, x in enumerate(win)) / wsum
+                    else:
+                        out = sum(win) / len(win)
+                else:
+                    src, sparams = None, {}
+                    from .query_dsl import parse_script_spec
+                    src, sparams = parse_script_spec(p.body.get("script"))
+                    out = _moving_fn_eval(src, win, sparams)
+                b[p.name] = {"value": out}
+        elif p.kind == "percentiles_bucket":
+            percents = p.body.get("percents", [1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0])
+            svals = sorted(vals)
+            out = {}
+            for pc in percents:
+                if not svals:
+                    out[f"{pc:.1f}"] = None
+                else:
+                    idx = min(int(round(pc / 100.0 * len(svals) + 0.5)) - 1,
+                              len(svals) - 1)
+                    out[f"{pc:.1f}"] = svals[max(idx, 0)]
+            result[p.name] = {"values": out}
         elif p.kind in ("avg_bucket", "sum_bucket", "min_bucket", "max_bucket", "stats_bucket"):
             if p.kind == "avg_bucket":
                 result[p.name] = {"value": sum(vals) / len(vals) if vals else None}

@@ -2247,6 +2247,93 @@ def _kw_hash_cache(seg: Segment, field: str) -> np.ndarray:
     return cache[field]
 
 
+_B32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+def _geohash_strings(codes: np.ndarray, precision: int) -> List[str]:
+    out = []
+    for c in codes.tolist():
+        s = []
+        for i in range(precision):
+            shift = 5 * (precision - 1 - i)
+            s.append(_B32[(c >> shift) & 31])
+        out.append("".join(s))
+    return out
+
+
+def _geo_grid_cache(seg: Segment, field: str, kind: str, precision: int):
+    """(vocab cell keys, per-doc cell ordinal i32[ndocs_pad], -1 missing) —
+    computed once per (segment, field, kind, precision) on the host; the
+    device then bincounts ordinals exactly like the terms agg. (Reference
+    GeoHashGridAggregator/GeoTileGridAggregator bucket by cell the same way,
+    via doc-value cell ids.)"""
+    cache = getattr(seg, "_geo_grid_cells", None)
+    if cache is None:
+        cache = seg._geo_grid_cells = {}
+    key = (field, kind, precision)
+    if key in cache:
+        return cache[key]
+    col = seg.geo_cols.get(field)
+    ords = np.full(seg.ndocs_pad, -1, np.int32)
+    vocab: List[str] = []
+    if col is not None and col.present.any():
+        lat = col.lat[: seg.ndocs].astype(np.float64)
+        lon = col.lon[: seg.ndocs].astype(np.float64)
+        if kind == "geotile_grid":
+            z = precision
+            n = 1 << z
+            x = np.clip(np.floor((lon + 180.0) / 360.0 * n), 0, n - 1)
+            latc = np.clip(lat, -85.05112878, 85.05112878)
+            latr = np.deg2rad(latc)
+            y = np.clip(np.floor(
+                (1.0 - np.log(np.tan(latr) + 1.0 / np.cos(latr)) / np.pi)
+                / 2.0 * n), 0, n - 1)
+            codes = (x.astype(np.int64) * n + y.astype(np.int64))
+            uniq, inv = np.unique(codes, return_inverse=True)
+            vocab = [f"{z}/{int(c) // n}/{int(c) % n}" for c in uniq]
+        else:  # geohash
+            nbits = 5 * precision
+            lonb = (nbits + 1) // 2
+            latb = nbits // 2
+            li = np.clip(np.floor((lon + 180.0) / 360.0 * (1 << lonb)),
+                         0, (1 << lonb) - 1).astype(np.uint64)
+            la = np.clip(np.floor((lat + 90.0) / 180.0 * (1 << latb)),
+                         0, (1 << latb) - 1).astype(np.uint64)
+            codes = np.zeros(len(lat), np.uint64)
+            # interleave, lon first (standard geohash bit order)
+            for b in range(nbits):
+                if b % 2 == 0:
+                    src, idx = li, lonb - 1 - b // 2
+                else:
+                    src, idx = la, latb - 1 - b // 2
+                bit = (src >> np.uint64(idx)) & np.uint64(1)
+                codes = (codes << np.uint64(1)) | bit
+            uniq, inv = np.unique(codes, return_inverse=True)
+            vocab = _geohash_strings(uniq, precision)
+        o = np.where(col.present[: seg.ndocs], inv.astype(np.int32), -1)
+        ords[: seg.ndocs] = o
+    cache[key] = (vocab, ords)
+    return cache[key]
+
+
+def _kw_doc_counts(seg: Segment, field: str) -> Dict[str, int]:
+    """Background per-value doc counts over the segment's live docs
+    (significant_terms superset statistics)."""
+    cache = getattr(seg, "_kw_doc_count_cache", None)
+    if cache is None:
+        cache = seg._kw_doc_count_cache = {}
+    if field in cache:
+        return cache[field]
+    col = seg.keyword_cols.get(field)
+    out: Dict[str, int] = {}
+    if col is not None and len(col.vocab):
+        live_vals = seg.live[col.doc_of_value]
+        counts = np.bincount(col.ords[live_vals], minlength=len(col.vocab))
+        out = {col.vocab[i]: int(c) for i, c in enumerate(counts) if c > 0}
+    cache[field] = out
+    return out
+
+
 def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
                 prefix: str):  # noqa: C901
     """-> hashable agg spec; params filled per segment. `prefix` keys params."""
@@ -2380,6 +2467,58 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
     if kind == "top_hits":
         return ("top_hits", prefix, int(body.get("size", 3)))
 
+    if kind == "significant_terms":
+        field = _resolve_agg_field(node, ctx)
+        if field not in seg.keyword_cols:
+            return ("terms_missing", prefix)
+        nvocab_pad = next_pow2(max(len(seg.keyword_cols[field].vocab), 1))
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("sig_terms", prefix, field, nvocab_pad, subs)
+
+    if kind == "sampler":
+        shard_size = max(int(body.get("shard_size", 100)), 1)
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        # pass 2 of the shard-wide resample (executor._resample_samplers)
+        # supplies a global score threshold instead of a per-segment top-k
+        thr = getattr(node, "_global_thr", None)
+        if thr is not None:
+            _scalar_f32(params, f"{prefix}_thr", thr)
+        return ("sampler", prefix, shard_size, thr is not None, subs)
+
+    if kind in ("geohash_grid", "geotile_grid"):
+        field = _resolve_agg_field(node, ctx)
+        precision = int(body.get("precision",
+                                 5 if kind == "geohash_grid" else 7))
+        vocab, ords = _geo_grid_cache(seg, field, kind, precision)
+        params[f"{prefix}_gords"] = ords
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                     for i, s in enumerate(node.subs))
+        return ("geo_grid", prefix, kind, field, precision,
+                next_pow2(max(len(vocab), 1)), subs)
+
+    if kind == "matrix_stats":
+        fields = tuple(body.get("fields", []))
+        exists = tuple(f in seg.numeric_cols for f in fields)
+        # index-wide per-field shift: device power sums run CENTERED about it
+        # so f32 accumulation doesn't catastrophically cancel (the reference
+        # keeps running central moments in double for the same reason)
+        shift = getattr(node, "_ms_shift", None)
+        if shift is None:
+            shift = np.zeros(len(fields), np.float64)
+            for i, f in enumerate(fields):
+                tot, cnt = 0.0, 0
+                for s in ctx.segments:
+                    col = s.numeric_cols.get(f)
+                    if col is not None and col.present.any():
+                        tot += float(col.values[col.present].astype(np.float64).sum())
+                        cnt += int(col.present.sum())
+                shift[i] = tot / cnt if cnt else 0.0
+            node._ms_shift = shift
+        params[f"{prefix}_shift"] = shift.astype(np.float32)
+        return ("matrix_stats", prefix, fields, exists)
+
     raise ValueError(f"cannot prepare aggregation [{kind}]")
 
 
@@ -2389,8 +2528,9 @@ def _resolve_agg_field(node: AggNode, ctx: ShardContext) -> str:
     return ft.name if ft else field
 
 
-def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
+def emit_agg(spec, seg_arrays: dict, params: dict, match, scores=None):  # noqa: C901
     """-> nested dict of device arrays (this segment's partial)."""
+    import jax
     import jax.numpy as jnp
 
     kind = spec[0]
@@ -2398,6 +2538,79 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
 
     if kind in ("terms_missing", "hist_missing"):
         return {}
+
+    if kind == "sig_terms":
+        _, prefix, field, nvocab_pad, subs = spec
+        kw = seg_arrays["keyword"][field]
+        out = {"counts": agg_ops.terms_counts(kw, match, nvocab_pad),
+               "fg_total": jnp.sum(match)}
+        for i, sub in enumerate(subs):
+            if sub and sub[0] == "stats":
+                _, sprefix, sfield, col_exists = sub
+                if col_exists:
+                    col = seg_arrays["numeric"][sfield]
+                    out[f"sub{i}"] = agg_ops.terms_sub_metric(
+                        kw, match, col["f32"], col["present"], nvocab_pad)
+        return out
+
+    if kind == "sampler":
+        _, prefix, shard_size, use_thr, subs = spec
+        out = {}
+        if scores is None:
+            sel = match
+        elif use_thr:
+            masked = jnp.where(match > 0, scores, -jnp.inf)
+            sel = match * (masked >= params[f"{prefix}_thr"]).astype(jnp.float32)
+        else:
+            # best-scoring shard_size matching docs (reference
+            # SamplerAggregator); score ties at the threshold may admit a few
+            # extra docs. The per-segment top scores also go back to the host
+            # so multi-segment shards can re-threshold shard-wide (pass 2).
+            masked = jnp.where(match > 0, scores, -jnp.inf)
+            k = min(shard_size, ndocs_pad)
+            vals, _ = jax.lax.top_k(masked, k)
+            thr = vals[k - 1]
+            thr = jnp.where(jnp.isfinite(thr), thr, -jnp.inf)
+            sel = match * (masked >= thr).astype(jnp.float32)
+            out["topscores"] = vals
+        out["doc_count"] = jnp.sum(sel)
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, seg_arrays, params, sel, scores)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "geo_grid":
+        _, prefix, gkind, field, precision, nb, subs = spec
+        ords = params[f"{prefix}_gords"][:ndocs_pad]
+        w = match * (ords >= 0).astype(jnp.float32)
+        b = jnp.where(w > 0, ords, nb)
+        out = {"counts": jnp.zeros(nb, jnp.float32).at[b].add(w, mode="drop")}
+        for i, sub in enumerate(subs):
+            out.update(_emit_bucketed_sub(jnp, sub, i, b, nb, seg_arrays, match))
+        return out
+
+    if kind == "matrix_stats":
+        _, prefix, fields, exists = spec
+        if not fields or not all(exists):
+            return {"count": jnp.float32(0)}
+        cols = [seg_arrays["numeric"][f] for f in fields]
+        present_all = match > 0
+        for c in cols:
+            present_all = present_all & c["present"]
+        w = present_all.astype(jnp.float32)
+        X = jnp.stack([c["f32"] for c in cols])          # [k, ndocs]
+        X = X - params[f"{prefix}_shift"][:, None]       # center (see prepare)
+        Xw = X * w[None, :]
+        out = {"count": jnp.sum(w),
+               "s1": Xw.sum(axis=1),
+               "s2": (Xw * X).sum(axis=1),
+               "s3": (Xw * X * X).sum(axis=1),
+               "s4": (Xw * X * X * X).sum(axis=1),
+               # pairwise Σ w·x_i·x_j rides the MXU
+               "xy": jnp.dot(Xw, X.T, preferred_element_type=jnp.float32),
+               "shift": params[f"{prefix}_shift"]}
+        return out
 
     if kind == "terms":
         _, prefix, field, nvocab_pad, subs = spec
@@ -2448,7 +2661,7 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
             bucket_match = match * ((col["f32"] >= lo) & (col["f32"] < hi) &
                                     col["present"]).astype(jnp.float32)
             for i, sub in enumerate(subs):
-                res = emit_agg(sub, seg_arrays, params, bucket_match)
+                res = emit_agg(sub, seg_arrays, params, bucket_match, scores)
                 if res:
                     out[f"r{ri}_sub{i}"] = res
         return out
@@ -2459,7 +2672,7 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
         bucket_match = match * fmask.astype(jnp.float32)
         out = {"count": jnp.sum(bucket_match)}
         for i, sub in enumerate(subs):
-            res = emit_agg(sub, seg_arrays, params, bucket_match)
+            res = emit_agg(sub, seg_arrays, params, bucket_match, scores)
             if res:
                 out[f"sub{i}"] = res
         return out
@@ -2472,7 +2685,7 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
             bucket_match = match * fmask.astype(jnp.float32)
             entry = {"count": jnp.sum(bucket_match)}
             for i, sub in enumerate(subs):
-                res = emit_agg(sub, seg_arrays, params, bucket_match)
+                res = emit_agg(sub, seg_arrays, params, bucket_match, scores)
                 if res:
                     entry[f"sub{i}"] = res
             out[f"k{ki}"] = entry
@@ -2483,7 +2696,7 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
         gmatch = seg_arrays["live"]
         out = {"count": jnp.sum(gmatch)}
         for i, sub in enumerate(subs):
-            res = emit_agg(sub, seg_arrays, params, gmatch)
+            res = emit_agg(sub, seg_arrays, params, gmatch, scores)
             if res:
                 out[f"sub{i}"] = res
         return out
@@ -2499,7 +2712,7 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match):  # noqa: C901
         bucket_match = match * (~present).astype(jnp.float32)
         out = {"count": jnp.sum(bucket_match)}
         for i, sub in enumerate(subs):
-            res = emit_agg(sub, seg_arrays, params, bucket_match)
+            res = emit_agg(sub, seg_arrays, params, bucket_match, scores)
             if res:
                 out[f"sub{i}"] = res
         return out
@@ -2598,7 +2811,7 @@ def _build_executor(full_spec):
         match_f = sm.matched.astype(jnp.float32) * jnp.where(live > 0, 1.0, 0.0)
         aggs = {}
         for name, aspec in agg_specs:
-            res = emit_agg(aspec, seg_arrays, params, match_f)
+            res = emit_agg(aspec, seg_arrays, params, match_f, sm.scores)
             if res:
                 aggs[name] = res
         if aggs:
@@ -2640,3 +2853,26 @@ def run_gather_scores(query_spec, seg_arrays: dict, params: dict, docs: np.ndarr
     params = dict(params)
     params["gather_docs"] = docs
     return exe(seg_arrays, params)
+
+
+@lru_cache(maxsize=128)
+def _build_agg_executor(key):
+    """Aggs-only program (no top-k): the shard-wide sampler re-threshold
+    pass re-runs just the agg tree with a global threshold param."""
+    import jax
+
+    query_spec, agg_spec = key
+
+    def run(seg_arrays, params):
+        import jax.numpy as jnp
+
+        sm = emit(query_spec, seg_arrays, params)
+        live = seg_arrays["live"]
+        match_f = sm.matched.astype(jnp.float32) * jnp.where(live > 0, 1.0, 0.0)
+        return emit_agg(agg_spec, seg_arrays, params, match_f, sm.scores)
+
+    return jax.jit(run)
+
+
+def run_agg_only(query_spec, agg_spec, seg_arrays: dict, params: dict):
+    return _build_agg_executor((query_spec, agg_spec))(seg_arrays, params)

@@ -133,6 +133,7 @@ class ShardSearcher:
         search_after = body.get("search_after")
 
         result = ShardQueryResult(shard=shard_ord, segments=segments)
+        ran_segs: List[Segment] = []
 
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
@@ -175,6 +176,7 @@ class ShardSearcher:
                 # device-script trace failures are user errors (HTTP 400)
                 raise dsl.QueryParseError(f"script compile error: {e}")
 
+            ran_segs.append(seg)
             keys = np.asarray(out["topk_key"])
             idx = np.asarray(out["topk_idx"])
             scores = np.asarray(out["topk_scores"])
@@ -209,6 +211,8 @@ class ShardSearcher:
                 if names:
                     result.named_by_doc[(seg_ord, d)] = names
 
+        self._resample_samplers(agg_nodes, result, ran_segs, ctx, lroot)
+
         # top_hits root aggs from candidates
         for i, an in enumerate(agg_nodes):
             if an.kind == "top_hits":
@@ -224,6 +228,40 @@ class ShardSearcher:
         result.candidates = result.candidates[: window * oversample]
         result.took_ms = (time.monotonic() - t0) * 1000.0
         return result
+
+    def _resample_samplers(self, agg_nodes, result: ShardQueryResult,
+                           ran_segs: List[Segment], ctx, lroot) -> None:
+        """Shard-wide sampler pass 2: pass 1 thresholds per segment, so a
+        multi-segment shard would sample up to segments×shard_size docs.
+        Merge the per-segment top scores, derive ONE shard-wide threshold,
+        and re-run just the agg tree with it (reference SamplerAggregator
+        samples per shard). Top-level sampler nodes only — a sampler nested
+        under another bucket agg keeps per-segment semantics."""
+        for an in agg_nodes:
+            if an.kind != "sampler":
+                continue
+            partials = [p for p in result.agg_partials.get(an.name, []) if p]
+            tops = [p.pop("topscores") for p in partials if "topscores" in p]
+            if len(partials) <= 1 or not tops:
+                continue
+            shard_size = max(int(an.body.get("shard_size", 100)), 1)
+            allscores = np.concatenate(tops)
+            allscores = allscores[np.isfinite(allscores)]
+            if len(allscores) <= shard_size:
+                continue  # fewer matches than shard_size: pass 1 was exact
+            thr = float(np.sort(allscores)[-shard_size])
+            an._global_thr = thr
+            try:
+                new_parts = []
+                for seg in ran_segs:
+                    params: Dict[str, Any] = {}
+                    qspec = C.prepare(lroot, seg, ctx, params)
+                    aspec = C.prepare_agg(an, seg, ctx, params, "rs")
+                    out = C.run_agg_only(qspec, aspec, seg.device_arrays(), params)
+                    new_parts.append(_device_agg_to_partial(an, aspec, out, seg, ctx))
+                result.agg_partials[an.name] = new_parts
+            finally:
+                an._global_thr = None
 
     def _apply_rescores(self, rescores: List[dict], ctx, seg, idx, valid, scores):
         for rs in rescores:
@@ -589,9 +627,11 @@ def _combine_rescore(mode: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
 
 def _aggs_need_all_segments(agg_nodes) -> bool:
     """True if any agg in the tree observes docs outside the query match set
-    (reference: global/filter/filters/missing aggregators)."""
+    (reference: global/filter/filters/missing aggregators; significant_terms
+    needs every segment's background counts)."""
     for n in agg_nodes:
-        if n.kind in ("global", "filter", "filters", "missing"):
+        if n.kind in ("global", "filter", "filters", "missing",
+                      "significant_terms"):
             return True
         if _aggs_need_all_segments(n.subs):
             return True
@@ -805,6 +845,28 @@ def _extract_source_values(src: dict, path: str) -> List:
     return node if isinstance(node, list) else [node]
 
 
+def _ordinal_buckets(node: AggNode, device_out: dict, vocab) -> dict:
+    """Shared ordinal-bucket partial extraction (terms / significant_terms /
+    geo grids): nonzero counts keyed by vocab + per-bucket stats tuples."""
+    counts = np.asarray(device_out["counts"])
+    buckets: dict = {}
+    for o in np.nonzero(counts[: len(vocab)] > 0)[0]:
+        rec: dict = {"doc_count": int(round(float(counts[o])))}
+        sub_partials = {}
+        for i, sub_node in enumerate(node.subs):
+            t = device_out.get(f"sub{i}")
+            if t is not None:
+                sums, cnts, mins, maxs, sumsq = (np.asarray(x) for x in t)
+                sub_partials[sub_node.name] = {
+                    "count": float(cnts[o]), "sum": float(sums[o]),
+                    "min": float(mins[o]), "max": float(maxs[o]),
+                    "sumsq": float(sumsq[o])}
+        if sub_partials:
+            rec["subs"] = sub_partials
+        buckets[vocab[o]] = rec
+    return buckets
+
+
 def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
                            seg: Segment, ctx) -> Optional[dict]:
     """Device arrays -> host partial in the shapes `aggregations.merge_partials`
@@ -818,25 +880,8 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
 
     if kind == "terms":
         _, prefix, f, nvocab_pad, subs = aspec
-        counts = np.asarray(device_out["counts"])
-        vocab = seg.keyword_cols[f].vocab
-        nz = np.nonzero(counts[: len(vocab)] > 0)[0]
-        buckets = {}
-        for o in nz:
-            rec: dict = {"doc_count": int(round(float(counts[o])))}
-            sub_partials = {}
-            for i, sub_node in enumerate(node.subs):
-                t = device_out.get(f"sub{i}")
-                if t is not None:
-                    sums, cnts, mins, maxs, sumsq = (np.asarray(x) for x in t)
-                    sub_partials[sub_node.name] = {
-                        "count": float(cnts[o]), "sum": float(sums[o]),
-                        "min": float(mins[o]), "max": float(maxs[o]),
-                        "sumsq": float(sumsq[o])}
-            if sub_partials:
-                rec["subs"] = sub_partials
-            buckets[vocab[o]] = rec
-        return {"buckets": buckets}
+        return {"buckets": _ordinal_buckets(node, device_out,
+                                            seg.keyword_cols[f].vocab)}
 
     if kind == "hist":
         _, prefix, f, interval, offset, min_b, nb, subs = aspec
@@ -906,6 +951,48 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
                         sub_node, sub_specs[i], r, seg, ctx)
             buckets[key] = rec
         return {"buckets": buckets}
+
+    if kind == "sig_terms":
+        _, prefix, f, nvocab_pad, subs = aspec
+        return {"buckets": _ordinal_buckets(node, device_out,
+                                            seg.keyword_cols[f].vocab),
+                "fg_total": int(round(float(np.asarray(device_out["fg_total"])))),
+                "bg": C._kw_doc_counts(seg, f),
+                "bg_total": seg.live_count}
+
+    if kind == "sampler":
+        _, prefix, shard_size, use_thr, sub_specs = aspec
+        rec = {"doc_count": int(round(float(np.asarray(device_out["doc_count"])))),
+               "subs": {}}
+        if "topscores" in device_out:
+            rec["topscores"] = np.asarray(device_out["topscores"])
+        for i, sub_node in enumerate(node.subs):
+            r = device_out.get(f"sub{i}")
+            if r is not None:
+                rec["subs"][sub_node.name] = _device_agg_to_partial(
+                    sub_node, sub_specs[i], r, seg, ctx)
+        return rec
+
+    if kind == "geo_grid":
+        _, prefix, gkind, f, precision, nb, subs = aspec
+        vocab, _ords = C._geo_grid_cache(seg, f, gkind, precision)
+        return {"buckets": _ordinal_buckets(node, device_out, vocab)}
+
+    if kind == "matrix_stats":
+        _, prefix, fields, exists = aspec
+        n = float(np.asarray(device_out["count"]))
+        k = len(fields)
+        if not fields or "s1" not in device_out:
+            return {"count": 0, "fields": list(fields), "shift": np.zeros(k),
+                    "s1": np.zeros(k), "s2": np.zeros(k), "s3": np.zeros(k),
+                    "s4": np.zeros(k), "xy": np.zeros((k, k))}
+        return {"count": n, "fields": list(fields),
+                "shift": np.asarray(device_out["shift"], np.float64),
+                "s1": np.asarray(device_out["s1"], np.float64),
+                "s2": np.asarray(device_out["s2"], np.float64),
+                "s3": np.asarray(device_out["s3"], np.float64),
+                "s4": np.asarray(device_out["s4"], np.float64),
+                "xy": np.asarray(device_out["xy"], np.float64)}
 
     if kind == "stats":
         if "empty" in device_out:

@@ -1,0 +1,233 @@
+"""Extended aggregations: significant_terms, sampler, geo grids,
+matrix_stats, and the full pipeline-agg family. Reference:
+`search/aggregations/bucket/{significant,sampler,geogrid}`,
+`aggregations/matrix/stats`, `search/aggregations/pipeline/`."""
+
+import math
+
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("logs", {"mappings": {"properties": {
+        "msg": {"type": "text"},
+        "service": {"type": "keyword"},
+        "level": {"type": "keyword"},
+        "latency": {"type": "double"},
+        "bytes": {"type": "double"},
+        "day": {"type": "integer"},
+        "pos": {"type": "geo_point"}}}})
+    rows = [
+        # errors cluster on svc-b; info spread evenly
+        # day bucket sizes: [3, 2, 2, 1]
+        ("error timeout", "svc-b", "error", 90.0, 900.0, 1, (52.37, 4.89)),
+        ("error crash bang", "svc-b", "error", 80.0, 800.0, 1, (52.38, 4.90)),
+        ("error disk full today", "svc-b", "error", 85.0, 850.0, 1, (52.52, 13.40)),
+        ("ok request", "svc-a", "info", 10.0, 100.0, 2, (48.85, 2.35)),
+        ("ok request", "svc-a", "info", 12.0, 120.0, 2, (48.86, 2.35)),
+        ("ok request", "svc-c", "info", 11.0, 110.0, 3, (40.71, -74.00)),
+        ("ok request", "svc-b", "info", 13.0, 130.0, 3, (40.72, -74.01)),
+        ("error timeout woes in the late afternoon", "svc-a", "error",
+         95.0, 950.0, 4, (52.37, 4.89)),
+    ]
+    for i, (msg, svc, lvl, lat, byt, day, (la, lo)) in enumerate(rows):
+        c.index("logs", {"msg": msg, "service": svc, "level": lvl,
+                         "latency": lat, "bytes": byt, "day": day,
+                         "pos": {"lat": la, "lon": lo}}, id=str(i))
+    c.indices.refresh("logs")
+    return c
+
+
+class TestSignificantTerms:
+    def test_svc_b_significant_for_errors(self, client):
+        r = client.search("logs", {"size": 0,
+                                   "query": {"term": {"level": "error"}},
+                                   "aggs": {"sig": {"significant_terms": {
+                                       "field": "service",
+                                       "min_doc_count": 2}}}})
+        sig = r["aggregations"]["sig"]
+        assert sig["doc_count"] == 4
+        keys = [b["key"] for b in sig["buckets"]]
+        assert keys and keys[0] == "svc-b"
+        b = sig["buckets"][0]
+        assert b["doc_count"] == 3 and b["bg_count"] == 4
+        assert b["score"] > 0
+
+    def test_chi_square_heuristic(self, client):
+        r = client.search("logs", {"size": 0,
+                                   "query": {"term": {"level": "error"}},
+                                   "aggs": {"sig": {"significant_terms": {
+                                       "field": "service", "chi_square": {},
+                                       "min_doc_count": 1}}}})
+        assert any(b["key"] == "svc-b" for b in r["aggregations"]["sig"]["buckets"])
+
+
+class TestSampler:
+    def test_sampler_limits_docs(self, client):
+        r = client.search("logs", {"size": 0,
+                                   "query": {"match": {"msg": "error"}},
+                                   "aggs": {"s": {"sampler": {"shard_size": 2},
+                                                  "aggs": {"m": {"max": {
+                                                      "field": "latency"}}}}}})
+        s = r["aggregations"]["s"]
+        assert s["doc_count"] == 2  # distinct scores -> exactly shard_size
+        # the two shortest (highest-BM25) error docs carry latencies 90, 80
+        assert s["m"]["value"] == pytest.approx(90.0)
+
+
+class TestGeoGrids:
+    def test_geohash_grid(self, client):
+        r = client.search("logs", {"size": 0, "aggs": {"g": {"geohash_grid": {
+            "field": "pos", "precision": 3}}}})
+        buckets = {b["key"]: b["doc_count"] for b in r["aggregations"]["g"]["buckets"]}
+        assert buckets.get("u17") == 3 or buckets.get("u17") is None
+        assert sum(buckets.values()) == 8
+        assert all(len(k) == 3 for k in buckets)
+
+    def test_geotile_grid(self, client):
+        r = client.search("logs", {"size": 0, "aggs": {"g": {"geotile_grid": {
+            "field": "pos", "precision": 4}}}})
+        buckets = {b["key"]: b["doc_count"] for b in r["aggregations"]["g"]["buckets"]}
+        assert sum(buckets.values()) == 8
+        assert all(k.startswith("4/") for k in buckets)
+
+    def test_geohash_matches_reference_encoding(self, client):
+        # 52.37,4.89 (Amsterdam) encodes to u173z... at precision 4 -> "u173"
+        r = client.search("logs", {"size": 0,
+                                   "query": {"ids": {"values": ["0"]}},
+                                   "aggs": {"g": {"geohash_grid": {
+                                       "field": "pos", "precision": 4}}}})
+        assert r["aggregations"]["g"]["buckets"][0]["key"] == "u173"
+
+
+class TestMatrixStats:
+    def test_correlated_fields(self, client):
+        r = client.search("logs", {"size": 0, "aggs": {"m": {"matrix_stats": {
+            "fields": ["latency", "bytes"]}}}})
+        m = r["aggregations"]["m"]
+        assert m["doc_count"] == 8
+        f0 = next(f for f in m["fields"] if f["name"] == "latency")
+        assert f0["mean"] == pytest.approx((90 + 80 + 85 + 10 + 12 + 11 + 13 + 95) / 8)
+        # bytes = latency * 10 -> perfect correlation
+        assert f0["correlation"]["bytes"] == pytest.approx(1.0, abs=1e-4)
+        assert f0["correlation"]["latency"] == pytest.approx(1.0, abs=1e-6)
+        import numpy as np
+        lat = np.array([90, 80, 85, 10, 12, 11, 13, 95.0])
+        assert f0["variance"] == pytest.approx(lat.var(ddof=1), rel=1e-4)
+
+
+class TestSamplerMultiSegment:
+    def test_shard_size_holds_across_segments(self):
+        c = RestClient()
+        c.indices.create("ms", {"mappings": {"properties": {
+            "msg": {"type": "text"}, "v": {"type": "double"}}}})
+        # two refreshes -> two segments; doc lengths make scores distinct
+        for i in range(4):
+            c.index("ms", {"msg": "error " + "pad " * i, "v": float(i)},
+                    id=f"a{i}")
+        c.indices.refresh("ms")
+        for i in range(4, 8):
+            c.index("ms", {"msg": "error " + "pad " * i, "v": float(i)},
+                    id=f"b{i}")
+        c.indices.refresh("ms")
+        r = c.search("ms", {"size": 0, "query": {"match": {"msg": "error"}},
+                            "aggs": {"s": {"sampler": {"shard_size": 3},
+                                           "aggs": {"mx": {"max": {
+                                               "field": "v"}}}}}})
+        s = r["aggregations"]["s"]
+        assert s["doc_count"] == 3  # shard-wide, not per segment
+        # shortest docs score highest -> v in {0, 1, 2}
+        assert s["mx"]["value"] == pytest.approx(2.0)
+
+
+class TestMatrixStatsPrecision:
+    def test_large_mean_small_spread(self):
+        c = RestClient()
+        c.indices.create("mp", {"mappings": {"properties": {
+            "a": {"type": "double"}, "b": {"type": "double"}}}})
+        import numpy as np
+        rng = np.random.default_rng(0)
+        vals = 1.0e4 + rng.standard_normal(300)
+        for i, v in enumerate(vals):
+            c.index("mp", {"a": float(v), "b": float(2 * v)})
+        c.indices.refresh("mp")
+        r = c.search("mp", {"size": 0, "aggs": {"m": {"matrix_stats": {
+            "fields": ["a", "b"]}}}})
+        f = next(x for x in r["aggregations"]["m"]["fields"] if x["name"] == "a")
+        assert f["mean"] == pytest.approx(float(vals.mean()), rel=1e-5)
+        assert f["variance"] == pytest.approx(float(vals.var(ddof=1)), rel=0.05)
+        assert f["correlation"]["b"] == pytest.approx(1.0, abs=1e-3)
+
+
+class TestPipelines:
+    def _hist(self, client, pipelines):
+        return client.search("logs", {"size": 0, "aggs": {"h": {
+            "histogram": {"field": "day", "interval": 1},
+            "aggs": {"lat": {"avg": {"field": "latency"}}, **pipelines}}}})
+
+    def test_moving_avg(self, client):
+        r = self._hist(client, {"ma": {"moving_avg": {
+            "buckets_path": "_count", "window": 2}}})
+        buckets = r["aggregations"]["h"]["buckets"]
+        # counts per day: [3, 2, 2, 1]; window = trailing 2 excl. current
+        assert buckets[0]["ma"]["value"] is None
+        assert buckets[1]["ma"]["value"] == pytest.approx(3.0)
+        assert buckets[2]["ma"]["value"] == pytest.approx(2.5)
+
+    def test_moving_fn(self, client):
+        r = self._hist(client, {"mf": {"moving_fn": {
+            "buckets_path": "_count", "window": 3,
+            "script": "MovingFunctions.max(values)"}}})
+        buckets = r["aggregations"]["h"]["buckets"]
+        assert buckets[2]["mf"]["value"] == pytest.approx(3.0)
+
+    def test_serial_diff(self, client):
+        r = self._hist(client, {"sd": {"serial_diff": {
+            "buckets_path": "_count", "lag": 1}}})
+        buckets = r["aggregations"]["h"]["buckets"]
+        assert buckets[0]["sd"]["value"] is None
+        assert buckets[1]["sd"]["value"] == pytest.approx(-1.0)
+
+    def test_bucket_script_and_selector(self, client):
+        r = client.search("logs", {"size": 0, "aggs": {"h": {
+            "histogram": {"field": "day", "interval": 1},
+            "aggs": {
+                "lat": {"avg": {"field": "latency"}},
+                "byt": {"avg": {"field": "bytes"}},
+                "ratio": {"bucket_script": {
+                    "buckets_path": {"l": "lat.value", "b": "byt.value"},
+                    "script": "params.b / params.l"}},
+                "keep": {"bucket_selector": {
+                    "buckets_path": {"c": "_count"},
+                    "script": "params.c > 1"}}}}}})
+        buckets = r["aggregations"]["h"]["buckets"]
+        assert all(b["doc_count"] > 1 for b in buckets)  # selector pruned day 4
+        assert all(b["ratio"]["value"] == pytest.approx(10.0) for b in buckets)
+
+    def test_bucket_sort(self, client):
+        r = client.search("logs", {"size": 0, "aggs": {"h": {
+            "histogram": {"field": "day", "interval": 1},
+            "aggs": {"srt": {"bucket_sort": {
+                "sort": [{"_count": {"order": "desc"}}], "size": 2}}}}}})
+        buckets = r["aggregations"]["h"]["buckets"]
+        assert len(buckets) == 2
+        assert buckets[0]["doc_count"] >= buckets[1]["doc_count"]
+
+    def test_percentiles_bucket(self, client):
+        r = self._hist(client, {})
+        r = client.search("logs", {"size": 0, "aggs": {"h": {
+            "histogram": {"field": "day", "interval": 1},
+            "aggs": {"pb": {"percentiles_bucket": {
+                "buckets_path": "_count", "percents": [50.0, 100.0]}}}}}})
+        pb = r["aggregations"]["h"]["pb"]["values"]
+        assert pb["100.0"] == 3.0
+
+    def test_stats_bucket_sibling(self, client):
+        r = self._hist(client, {"sb": {"stats_bucket": {
+            "buckets_path": "lat.value"}}})
+        sb = r["aggregations"]["h"]["sb"]
+        assert sb["count"] == 4 and sb["max"] > 80
