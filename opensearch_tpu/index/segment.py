@@ -221,6 +221,7 @@ class Segment:
         self.sources = sources
         self.seq_nos = seq_nos if seq_nos is not None else np.zeros(ndocs, dtype=np.int64)
         self.live = np.ones(ndocs, dtype=bool)
+        self.live_gen = 0
         self.id2doc: Dict[str, int] = {d: i for i, d in enumerate(ids)}
         self._device: Optional[dict] = None
         self._device_live_dirty = True
@@ -230,6 +231,7 @@ class Segment:
     def delete_doc(self, local_doc: int) -> None:
         self.live[local_doc] = False
         self._device_live_dirty = True
+        self.live_gen += 1  # invalidates live-dependent host caches
 
     @property
     def live_count(self) -> int:

@@ -26,7 +26,8 @@ import numpy as np
 
 BUCKET_KINDS = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing", "significant_terms",
-                "sampler", "geohash_grid", "geotile_grid"}
+                "sampler", "geohash_grid", "geotile_grid", "nested",
+                "reverse_nested", "children", "parent", "composite"}
 METRIC_KINDS = {"min", "max", "sum", "avg", "stats", "extended_stats",
                 "value_count", "cardinality", "percentiles", "top_hits",
                 "matrix_stats"}
@@ -100,7 +101,8 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
         for key, slot in acc.items():
             slot["subs"] = _merge_subtrees(node.subs, slot["subs"])
         return {"buckets": acc}
-    if kind in ("filter", "global", "missing", "sampler"):
+    if kind in ("filter", "global", "missing", "sampler", "nested",
+                "reverse_nested", "children", "parent"):
         total = sum(p["doc_count"] for p in parts)
         subs = _merge_subtrees(node.subs, [p.get("subs") for p in parts])
         return {"doc_count": total, "subs": subs}
@@ -112,10 +114,15 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
         return {"buckets": _acc_buckets(node, parts), "bg": bg,
                 "fg_total": sum(p["fg_total"] for p in parts),
                 "bg_total": sum(p["bg_total"] for p in parts)}
+    if kind == "composite":
+        return {"buckets": _acc_buckets(node, parts)}
     if kind == "matrix_stats":
         count = sum(p["count"] for p in parts)
-        out = {"count": count, "fields": parts[0]["fields"],
-               "shift": parts[0].get("shift")}
+        # the shift is index-wide and identical for every non-empty partial;
+        # empty (missing-field) partials carry zeros and must not win
+        shift = next((p["shift"] for p in parts
+                      if p["count"] > 0 and p.get("shift") is not None), None)
+        out = {"count": count, "fields": parts[0]["fields"], "shift": shift}
         for key in ("s1", "s2", "s3", "s4"):
             out[key] = np.sum([p[key] for p in parts], axis=0)
         out["xy"] = np.sum([p["xy"] for p in parts], axis=0)
@@ -245,7 +252,8 @@ def finalize(node: AggNode, merged: dict) -> dict:
                 entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
             buckets[key] = entry
         return {"buckets": buckets}
-    if kind in ("filter", "global", "missing", "sampler"):
+    if kind in ("filter", "global", "missing", "sampler", "nested",
+                "reverse_nested", "children", "parent"):
         out = {"doc_count": int(merged["doc_count"])}
         for sub in node.subs:
             out[sub.name] = finalize(sub, merged["subs"].get(sub.name, {}))
@@ -268,6 +276,8 @@ def finalize(node: AggNode, merged: dict) -> dict:
         return result
     if kind == "matrix_stats":
         return _finalize_matrix_stats(merged)
+    if kind == "composite":
+        return _finalize_composite(node, merged)
     if kind == "value_count":
         return {"value": int(merged["count"])}
     if kind == "min":
@@ -302,6 +312,60 @@ def finalize(node: AggNode, merged: dict) -> dict:
                          "max_score": merged["hits"][0]["_score"] if merged["hits"] else None,
                          "hits": merged["hits"]}}
     raise ValueError(f"cannot finalize aggregation kind [{kind}]")
+
+
+def composite_sources(node: AggNode) -> List[tuple]:
+    """[(name, source_type, config, order)] from the composite body."""
+    out = []
+    for s in node.body.get("sources", []):
+        ((nm, spec),) = s.items()
+        ((stype, scfg),) = spec.items()
+        out.append((nm, stype, scfg, scfg.get("order", "asc")))
+    return out
+
+
+class _CompVal:
+    """Per-source comparable honoring its order direction."""
+
+    __slots__ = ("v", "desc")
+
+    def __init__(self, v, desc: bool):
+        self.v = v
+        self.desc = desc
+
+    def __lt__(self, other):
+        return (self.v > other.v) if self.desc else (self.v < other.v)
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+
+def _finalize_composite(node: AggNode, merged: dict) -> dict:
+    sources = composite_sources(node)
+    size = int(node.body.get("size", 10))
+    after = node.body.get("after")
+
+    def comp(key_tuple):
+        return tuple(_CompVal(v, o == "desc")
+                     for v, (_, _, _, o) in zip(key_tuple, sources))
+
+    items = [(k, v) for k, v in merged["buckets"].items() if v["doc_count"] > 0]
+    items.sort(key=lambda kv: comp(kv[0]))
+    if after is not None:
+        after_tuple = tuple(after[nm] for nm, _, _, _ in sources)
+        ac = comp(after_tuple)
+        items = [kv for kv in items if comp(kv[0]) > ac]
+    buckets = []
+    for key, rec in items[:size]:
+        b = {"key": {nm: v for (nm, _, _, _), v in zip(sources, key)},
+             "doc_count": int(rec["doc_count"])}
+        for sub in node.subs:
+            b[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+        buckets.append(b)
+    out = {"buckets": buckets}
+    if buckets:
+        out["after_key"] = buckets[-1]["key"]
+    return out
 
 
 def _significance_score(fg: float, fg_total: float, bg: float, bg_total: float,
@@ -389,13 +453,15 @@ def _finalize_matrix_stats(merged: dict) -> dict:
 
 def _empty_result(node: AggNode) -> dict:
     if node.kind in ("terms", "histogram", "date_histogram", "range",
-                     "date_range", "filters", "geohash_grid", "geotile_grid"):
+                     "date_range", "filters", "geohash_grid", "geotile_grid",
+                     "composite"):
         return {"buckets": [] if node.kind != "filters" else {}}
     if node.kind == "significant_terms":
         return {"doc_count": 0, "bg_count": 0, "buckets": []}
     if node.kind == "matrix_stats":
         return {"doc_count": 0, "fields": []}
-    if node.kind in ("filter", "global", "missing", "sampler"):
+    if node.kind in ("filter", "global", "missing", "sampler", "nested",
+                     "reverse_nested", "children", "parent"):
         return {"doc_count": 0}
     if node.kind in ("min", "max", "avg"):
         return {"value": None}
@@ -580,6 +646,10 @@ def _apply_bucket_pipelines(node: AggNode, result: dict) -> None:
         elif p.kind in ("moving_avg", "moving_fn"):
             window = int(p.body.get("window", 5))
             shift = int(p.body.get("shift", 0))
+            # moving_avg includes the current bucket (reference
+            # MovAvgPipelineAggregator); moving_fn's shift=0 excludes it
+            if p.kind == "moving_avg":
+                shift += 1
             for i, b in enumerate(buckets):
                 lo = max(0, i - window + shift)
                 hi = max(0, i + shift)

@@ -2316,12 +2316,30 @@ def _geo_grid_cache(seg: Segment, field: str, kind: str, precision: int):
     return cache[key]
 
 
+def _col_sum(seg: Segment, field: str) -> Tuple[float, int]:
+    """(Σ values, present count) of a numeric column, f64, cached per segment
+    (segments are immutable apart from deletes, which don't need to perturb a
+    scoring shift)."""
+    cache = getattr(seg, "_col_sum_cache", None)
+    if cache is None:
+        cache = seg._col_sum_cache = {}
+    if field not in cache:
+        col = seg.numeric_cols.get(field)
+        if col is None or not col.present.any():
+            cache[field] = (0.0, 0)
+        else:
+            cache[field] = (float(col.values[col.present].astype(np.float64).sum()),
+                            int(col.present.sum()))
+    return cache[field]
+
+
 def _kw_doc_counts(seg: Segment, field: str) -> Dict[str, int]:
     """Background per-value doc counts over the segment's live docs
-    (significant_terms superset statistics)."""
+    (significant_terms superset statistics); invalidated by deletes via
+    `live_gen`."""
     cache = getattr(seg, "_kw_doc_count_cache", None)
-    if cache is None:
-        cache = seg._kw_doc_count_cache = {}
+    if cache is None or cache.get("__gen") != seg.live_gen:
+        cache = seg._kw_doc_count_cache = {"__gen": seg.live_gen}
     if field in cache:
         return cache[field]
     col = seg.keyword_cols.get(field)
@@ -2335,8 +2353,10 @@ def _kw_doc_counts(seg: Segment, field: str) -> Dict[str, int]:
 
 
 def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
-                prefix: str):  # noqa: C901
-    """-> hashable agg spec; params filled per segment. `prefix` keys params."""
+                prefix: str, nest_stack: Tuple = ()):  # noqa: C901
+    """-> hashable agg spec; params filled per segment. `prefix` keys params.
+    `nest_stack` is the nesting path down to `seg`: ((path, segment), ...)
+    root-first, empty at root — reverse_nested climbs it."""
     kind = node.kind
     body = node.body
 
@@ -2345,7 +2365,8 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         if field not in seg.keyword_cols:
             return ("terms_missing", prefix)
         nvocab_pad = next_pow2(max(len(seg.keyword_cols[field].vocab), 1))
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("terms", prefix, field, nvocab_pad, subs)
 
@@ -2360,7 +2381,8 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         min_b = int(np.floor((mn - offset) / interval))
         max_b = int(np.floor((mx - offset) / interval))
         nb = max_b - min_b + 1
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("hist", prefix, field, interval, offset, min_b, nb, subs)
 
@@ -2378,7 +2400,8 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         pad = np.full(next_pow2(len(bucket_ids)), -1, dtype=np.int32)
         pad[: len(bucket_ids)] = bucket_ids
         params[f"{prefix}_dbuckets"] = pad
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("date_hist", prefix, field, interval_ms, offset_ms, calendar,
                 min_b, nb, subs)
@@ -2405,7 +2428,8 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         params[f"{prefix}_lows"] = lows
         params[f"{prefix}_highs"] = highs
         col_exists = field in seg.numeric_cols
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("range", prefix, field, tuple(keys), col_exists, subs,
                 tuple((float(lows[i]), float(highs[i])) for i in range(len(ranges))))
@@ -2413,7 +2437,8 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
     if kind == "filter":
         lnode = rewrite(dsl.parse_query(body), ctx, scoring=False)
         fspec = prepare(lnode, seg, ctx, params)
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("filter", prefix, fspec, subs)
 
@@ -2427,12 +2452,14 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         for key, f in items:
             lnode = rewrite(dsl.parse_query(f), ctx, scoring=False)
             fspecs.append((key, prepare(lnode, seg, ctx, params)))
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("filters", prefix, tuple(fspecs), subs)
 
     if kind == "global":
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("global", prefix, subs)
 
@@ -2440,7 +2467,8 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         field = _resolve_agg_field(node, ctx)
         src = ("numeric" if field in seg.numeric_cols else
                "keyword" if field in seg.keyword_cols else "none")
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("missing", prefix, field, src, subs)
 
@@ -2470,15 +2498,19 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
     if kind == "significant_terms":
         field = _resolve_agg_field(node, ctx)
         if field not in seg.keyword_cols:
-            return ("terms_missing", prefix)
+            # still contributes its live docs to the background total —
+            # supersetSize spans the whole shard (reference semantics)
+            return ("sig_missing", prefix)
         nvocab_pad = next_pow2(max(len(seg.keyword_cols[field].vocab), 1))
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("sig_terms", prefix, field, nvocab_pad, subs)
 
     if kind == "sampler":
         shard_size = max(int(body.get("shard_size", 100)), 1)
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         # pass 2 of the shard-wide resample (executor._resample_samplers)
         # supplies a global score threshold instead of a per-segment top-k
@@ -2493,10 +2525,52 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
                                  5 if kind == "geohash_grid" else 7))
         vocab, ords = _geo_grid_cache(seg, field, kind, precision)
         params[f"{prefix}_gords"] = ords
-        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
                      for i, s in enumerate(node.subs))
         return ("geo_grid", prefix, kind, field, precision,
                 next_pow2(max(len(vocab), 1)), subs)
+
+    if kind == "nested":
+        path = body.get("path")
+        blk = seg.nested.get(path)
+        if blk is None or blk.child.ndocs == 0:
+            return ("terms_missing", prefix)
+        new_stack = (nest_stack or ((None, seg),)) + ((path, blk.child),)
+        subs = tuple(prepare_agg(s, blk.child, ctx, params, f"{prefix}_{i}",
+                                 new_stack)
+                     for i, s in enumerate(node.subs))
+        return ("nested_agg", prefix, path, subs)
+
+    if kind == "reverse_nested":
+        if len(nest_stack) < 2:
+            raise dsl.QueryParseError(
+                "[reverse_nested] must be nested inside a [nested] aggregation")
+        rpath = body.get("path")
+        if rpath is None:
+            ti = 0  # default: all the way back to the root document
+        else:
+            ti = next((i for i, (p, _) in enumerate(nest_stack) if p == rpath),
+                      None)
+            if ti is None:
+                raise dsl.QueryParseError(
+                    f"[reverse_nested] path [{rpath}] is not an enclosing "
+                    f"nested level")
+        up_k = len(nest_stack) - 1 - ti
+        if up_k <= 0:
+            raise dsl.QueryParseError(
+                "[reverse_nested] path must point above the current level")
+        target_seg = nest_stack[ti][1]
+        subs = tuple(prepare_agg(s, target_seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack[: ti + 1] if ti > 0 else ())
+                     for i, s in enumerate(node.subs))
+        return ("reverse_nested", prefix, up_k, subs)
+
+    if kind in ("children", "parent"):
+        return _prepare_join_agg(node, seg, ctx, params, prefix)
+
+    if kind == "composite":
+        return _prepare_composite(node, seg, ctx, params, prefix, nest_stack)
 
     if kind == "matrix_stats":
         fields = tuple(body.get("fields", []))
@@ -2508,18 +2582,135 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         if shift is None:
             shift = np.zeros(len(fields), np.float64)
             for i, f in enumerate(fields):
-                tot, cnt = 0.0, 0
-                for s in ctx.segments:
-                    col = s.numeric_cols.get(f)
-                    if col is not None and col.present.any():
-                        tot += float(col.values[col.present].astype(np.float64).sum())
-                        cnt += int(col.present.sum())
+                sums = [_col_sum(s, f) for s in ctx.segments]
+                tot = sum(t for t, _ in sums)
+                cnt = sum(c for _, c in sums)
                 shift[i] = tot / cnt if cnt else 0.0
             node._ms_shift = shift
         params[f"{prefix}_shift"] = shift.astype(np.float32)
         return ("matrix_stats", prefix, fields, exists)
 
     raise ValueError(f"cannot prepare aggregation [{kind}]")
+
+
+def _prepare_join_agg(node: AggNode, seg: Segment, ctx: ShardContext,
+                      params: dict, prefix: str):
+    """children / parent aggregations (reference modules/parent-join
+    ChildrenAggregator / ParentAggregator). The cross-segment join rides the
+    same slot-space pre-pass as has_child/has_parent; the bucket context is
+    the TOP-LEVEL query (`ctx._current_lroot`) — like the reference, these
+    only make sense directly under the query context."""
+    from .join import get_join_index
+
+    kind = node.kind
+    jf = ctx.mappings.join_field
+    if jf is None:
+        return ("terms_missing", prefix)
+    relations = ctx.mappings.fields[jf].relations
+    child_rel = node.body.get("type")
+    parent_rel = next((p for p, cs in relations.items() if child_rel in cs), None)
+    if parent_rel is None:
+        raise dsl.QueryParseError(
+            f"[{kind}] [{child_rel}] is not a child relation of the join field")
+    ji = get_join_index(ctx.segments, jf)
+    lroot = getattr(ctx, "_current_lroot", None) or LMatchAll()
+    pre = getattr(node, "_agg_pre", None)
+    if pre is None:
+        # filter nodes are built ONCE per agg node so their nids (and thus
+        # the jit spec) stay stable across segments
+        node._rel_filters = {
+            "child": _weighted_terms(jf, [child_rel], [1.0], ctx, 1, "filter", 1.0),
+            "parent": _weighted_terms(jf, [parent_rel], [1.0], ctx, 1, "filter", 1.0)}
+        if kind == "children":
+            # global mask of context-matched PARENT docs at their own slots
+            plan = LBool(musts=[lroot], filters=[node._rel_filters["parent"]])
+            pre = _join_prepass(plan, ji, ("cnt",), ctx, self_slots=True)
+        else:
+            # global mask of parents having context-matched CHILD docs
+            plan = LBool(musts=[lroot], filters=[node._rel_filters["child"]])
+            pre = _join_prepass(plan, ji, ("cnt",), ctx, self_slots=False)
+        node._agg_pre = pre
+    params[f"{prefix}_gmatch"] = pre["cnt"]
+    subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}")
+                 for i, s in enumerate(node.subs))
+    if kind == "children":
+        params[f"{prefix}_pslot"] = ji.pslot(seg)
+        cf = prepare(node._rel_filters["child"], seg, ctx, params)
+        return ("children_agg", prefix, cf, subs)
+    _scalar_i32(params, f"{prefix}_base", ji.seg_base(seg))
+    pf = prepare(node._rel_filters["parent"], seg, ctx, params)
+    return ("parent_agg", prefix, pf, subs)
+
+
+def _prepare_composite(node: AggNode, seg: Segment, ctx: ShardContext,
+                       params: dict, prefix: str, nest_stack):
+    """Composite agg: each doc maps to one combined ordinal over the product
+    of per-source value spaces; one device bincount yields every composite
+    bucket of the segment, the coordinator pages with after_key (reference
+    CompositeAggregator builds the same slot machinery per leaf)."""
+    from .aggregations import composite_sources
+
+    sources = composite_sources(node)
+    infos = []
+    total = 1
+    for si, (nm, stype, scfg, order) in enumerate(sources):
+        field = scfg.get("field", "")
+        ft = ctx.mappings.resolve_field(field)
+        field = ft.name if ft else field
+        if stype == "terms":
+            col = seg.keyword_cols.get(field)
+            if col is None:
+                return ("terms_missing", prefix)
+            multi = (len(col.ords) > 0 and
+                     int(np.max(col.starts[1:] - col.starts[:-1])) > 1)
+            if multi:
+                # a doc contributes one composite key per value (reference
+                # behavior); supported for a single-source composite, where
+                # it degenerates to an ordinal bincount
+                if len(sources) > 1:
+                    raise dsl.QueryParseError(
+                        "[composite] a multi-valued terms source cannot be "
+                        "combined with other sources")
+                subs_mv = tuple(prepare_agg(s, seg, ctx, params,
+                                            f"{prefix}_{i}", nest_stack)
+                                for i, s in enumerate(node.subs))
+                return ("composite_mv", prefix, field,
+                        next_pow2(max(len(col.vocab), 1)), subs_mv)
+            infos.append(("terms", field, len(col.vocab), 0, 0.0, 0.0))
+        elif stype == "histogram":
+            interval = float(scfg["interval"])
+            col = seg.numeric_cols.get(field)
+            if col is None or not col.present.any():
+                return ("terms_missing", prefix)
+            mn, mx = col.min_max
+            min_b = int(np.floor(mn / interval))
+            nb = int(np.floor(mx / interval)) - min_b + 1
+            infos.append(("hist", field, nb, min_b, interval, 0.0))
+        elif stype == "date_histogram":
+            calendar = scfg.get("calendar_interval")
+            interval_ms = (0 if calendar else
+                           parse_interval_ms(scfg.get("fixed_interval",
+                                                      scfg.get("interval", "1d"))))
+            bucket_ids, min_b, nb = _host_date_buckets(
+                seg, field, max(interval_ms, 1), 0, calendar)
+            if nb <= 0:
+                return ("terms_missing", prefix)
+            pad = np.full(next_pow2(len(bucket_ids)), -1, dtype=np.int32)
+            pad[: len(bucket_ids)] = bucket_ids
+            params[f"{prefix}_s{si}"] = pad
+            infos.append(("date", field, nb, min_b,
+                          float(max(interval_ms, 1)), calendar or ""))
+        else:
+            raise dsl.QueryParseError(
+                f"[composite] unsupported source type [{stype}]")
+        total *= max(infos[-1][2], 1)
+    if total > (1 << 22):
+        raise dsl.QueryParseError(
+            f"[composite] too many composite buckets [{total}] "
+            f"(limit {1 << 22})")
+    subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}", nest_stack)
+                 for i, s in enumerate(node.subs))
+    return ("composite", prefix, tuple(infos), total, subs)
 
 
 def _resolve_agg_field(node: AggNode, ctx: ShardContext) -> str:
@@ -2538,6 +2729,9 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match, scores=None):  # noqa:
 
     if kind in ("terms_missing", "hist_missing"):
         return {}
+
+    if kind == "sig_missing":
+        return {"marker": jnp.float32(0)}
 
     if kind == "sig_terms":
         _, prefix, field, nvocab_pad, subs = spec
@@ -2588,6 +2782,106 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match, scores=None):  # noqa:
         out = {"counts": jnp.zeros(nb, jnp.float32).at[b].add(w, mode="drop")}
         for i, sub in enumerate(subs):
             out.update(_emit_bucketed_sub(jnp, sub, i, b, nb, seg_arrays, match))
+        return out
+
+    if kind == "nested_agg":
+        _, prefix, path, subs = spec
+        carr = dict(seg_arrays["nested"][path])
+        parent = carr["parent"]
+        live_p = seg_arrays["live"]
+        carr["live"] = carr["live"] * live_p[parent]
+        carr["__chain"] = ((seg_arrays, parent),) + seg_arrays.get("__chain", ())
+        cmatch = match[parent] * jnp.where(carr["live"] > 0, 1.0, 0.0)
+        out = {"doc_count": jnp.sum(cmatch)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, carr, params, cmatch, None)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "reverse_nested":
+        _, prefix, up_k, subs = spec
+        chain = seg_arrays["__chain"]
+        pmask, parent_arrays = match, seg_arrays
+        for lvl in range(up_k):
+            parent_arrays, parent_map = chain[lvl]
+            npad_p = parent_arrays["live"].shape[0]
+            pm = jnp.zeros(npad_p, jnp.float32).at[parent_map].add(pmask,
+                                                                   mode="drop")
+            pmask = ((pm > 0) & (parent_arrays["live"] > 0)).astype(jnp.float32)
+        out = {"doc_count": jnp.sum(pmask)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, parent_arrays, params, pmask, None)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "children_agg":
+        _, prefix, cf, subs = spec
+        g = params[f"{prefix}_gmatch"]
+        pslot = params[f"{prefix}_pslot"]
+        valid = pslot >= 0
+        idx = jnp.clip(pslot, 0, g.shape[0] - 1)
+        cfm = emit(cf, seg_arrays, params).matched
+        cmask = (valid & (g[idx] > 0) & (cfm > 0)
+                 & (seg_arrays["live"] > 0)).astype(jnp.float32)
+        out = {"doc_count": jnp.sum(cmask)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, seg_arrays, params, cmask, None)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "parent_agg":
+        from jax import lax
+
+        _, prefix, pf, subs = spec
+        base = params[f"{prefix}_base"]
+        cnt = lax.dynamic_slice(params[f"{prefix}_gmatch"], (base,), (ndocs_pad,))
+        pfm = emit(pf, seg_arrays, params).matched
+        pmask = ((cnt > 0) & (pfm > 0)
+                 & (seg_arrays["live"] > 0)).astype(jnp.float32)
+        out = {"doc_count": jnp.sum(pmask)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, seg_arrays, params, pmask, None)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "composite_mv":
+        _, prefix, field, nb, subs = spec
+        kw = seg_arrays["keyword"][field]
+        out = {"counts": agg_ops.terms_counts(kw, match, nb)}
+        for i, sub in enumerate(subs):
+            if sub and sub[0] == "stats":
+                _, sprefix, sfield, col_exists = sub
+                if col_exists:
+                    col = seg_arrays["numeric"][sfield]
+                    out[f"sub{i}"] = agg_ops.terms_sub_metric(
+                        kw, match, col["f32"], col["present"], nb)
+        return out
+
+    if kind == "composite":
+        _, prefix, infos, total, subs = spec
+        combined = jnp.zeros(ndocs_pad, jnp.int32)
+        valid = (match > 0) & (seg_arrays["live"] > 0)
+        for si, (stype, field, n, min_b, interval, cal) in enumerate(infos):
+            if stype == "terms":
+                o = seg_arrays["keyword"][field]["min_ord"]
+            elif stype == "hist":
+                col = seg_arrays["numeric"][field]
+                o = jnp.floor(col["f32"] / interval).astype(jnp.int32) - min_b
+                o = jnp.where(col["present"] & (o >= 0) & (o < n), o, -1)
+            else:  # date
+                o = params[f"{prefix}_s{si}"][:ndocs_pad]
+            valid = valid & (o >= 0)
+            combined = combined * n + jnp.maximum(o, 0)
+        w = valid.astype(jnp.float32)
+        b = jnp.where(valid, combined, total)
+        out = {"counts": jnp.zeros(total, jnp.float32).at[b].add(w, mode="drop")}
+        for i, sub in enumerate(subs):
+            out.update(_emit_bucketed_sub(jnp, sub, i, b, total, seg_arrays,
+                                          match * w))
         return out
 
     if kind == "matrix_stats":

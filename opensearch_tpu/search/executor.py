@@ -116,6 +116,7 @@ class ShardSearcher:
             query = dsl.BoolQuery(should=[query, kq], minimum_should_match="1") \
                 if query is not None else kq
         lroot = C.rewrite(query, ctx, scoring=True)
+        ctx._current_lroot = lroot  # children/parent aggs join against it
 
         size = int(body.get("size", 10))
         frm = int(body.get("from", 0))
@@ -631,7 +632,7 @@ def _aggs_need_all_segments(agg_nodes) -> bool:
     needs every segment's background counts)."""
     for n in agg_nodes:
         if n.kind in ("global", "filter", "filters", "missing",
-                      "significant_terms"):
+                      "significant_terms", "children", "parent"):
             return True
         if _aggs_need_all_segments(n.subs):
             return True
@@ -868,7 +869,8 @@ def _ordinal_buckets(node: AggNode, device_out: dict, vocab) -> dict:
 
 
 def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
-                           seg: Segment, ctx) -> Optional[dict]:
+                           seg: Segment, ctx,
+                           seg_stack: Tuple[Segment, ...] = ()) -> Optional[dict]:
     """Device arrays -> host partial in the shapes `aggregations.merge_partials`
     expects."""
     if device_out is None:
@@ -920,7 +922,8 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
                 r = device_out.get(f"r{ri}_sub{i}")
                 if r is not None:
                     sub_partials[sub_node.name] = _device_agg_to_partial(
-                        sub_node, _find_sub_spec(aspec, i), r, seg, ctx)
+                        sub_node, _find_sub_spec(aspec, i), r, seg, ctx,
+                        seg_stack)
             rec["subs"] = sub_partials
             buckets[key] = rec
         return {"buckets": buckets}
@@ -934,7 +937,7 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
             r = device_out.get(f"sub{i}")
             if r is not None:
                 rec["subs"][sub_node.name] = _device_agg_to_partial(
-                    sub_node, sub_specs[i], r, seg, ctx)
+                    sub_node, sub_specs[i], r, seg, ctx, seg_stack)
         return rec
 
     if kind == "filters":
@@ -951,6 +954,10 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
                         sub_node, sub_specs[i], r, seg, ctx)
             buckets[key] = rec
         return {"buckets": buckets}
+
+    if kind == "sig_missing":
+        return {"buckets": {}, "fg_total": 0, "bg": {},
+                "bg_total": seg.live_count}
 
     if kind == "sig_terms":
         _, prefix, f, nvocab_pad, subs = aspec
@@ -970,7 +977,7 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
             r = device_out.get(f"sub{i}")
             if r is not None:
                 rec["subs"][sub_node.name] = _device_agg_to_partial(
-                    sub_node, sub_specs[i], r, seg, ctx)
+                    sub_node, sub_specs[i], r, seg, ctx, seg_stack)
         return rec
 
     if kind == "geo_grid":
@@ -993,6 +1000,67 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
                 "s3": np.asarray(device_out["s3"], np.float64),
                 "s4": np.asarray(device_out["s4"], np.float64),
                 "xy": np.asarray(device_out["xy"], np.float64)}
+
+    if kind in ("nested_agg", "reverse_nested", "children_agg", "parent_agg"):
+        sub_specs = aspec[3]
+        sub_seg, sub_stack = seg, seg_stack
+        if kind == "nested_agg":
+            blk = seg.nested.get(aspec[2])
+            sub_seg = blk.child if blk else seg
+            sub_stack = seg_stack + (seg,)
+        elif kind == "reverse_nested":
+            up_k = aspec[2]
+            full = seg_stack + (seg,)
+            sub_seg = full[-(up_k + 1)]
+            sub_stack = full[: -(up_k + 1)]
+        rec = {"doc_count": int(round(float(np.asarray(device_out["doc_count"])))),
+               "subs": {}}
+        for i, sub_node in enumerate(node.subs):
+            r = device_out.get(f"sub{i}")
+            if r is not None:
+                rec["subs"][sub_node.name] = _device_agg_to_partial(
+                    sub_node, sub_specs[i], r, sub_seg, ctx, sub_stack)
+        return rec
+
+    if kind == "composite_mv":
+        _, prefix, f, nb, subs = aspec
+        flat = _ordinal_buckets(node, device_out, seg.keyword_cols[f].vocab)
+        return {"buckets": {(k,): v for k, v in flat.items()}}
+
+    if kind == "composite":
+        _, prefix, infos, total, subs = aspec
+        counts = np.asarray(device_out["counts"])
+        nz = np.nonzero(counts[:total] > 0)[0]
+        buckets = {}
+        for comb in nz:
+            vals = []
+            rem = int(comb)
+            for stype, field, n, min_b, interval, cal in reversed(infos):
+                o = rem % n
+                rem //= n
+                if stype == "terms":
+                    vals.append(seg.keyword_cols[field].vocab[o])
+                elif stype == "hist":
+                    vals.append((min_b + o) * interval)
+                elif cal:
+                    vals.append(_calendar_bucket_to_epoch_ms(min_b + o, cal))
+                else:
+                    vals.append(int((min_b + o) * interval))
+            key = tuple(reversed(vals))
+            rec = {"doc_count": int(round(float(counts[comb])))}
+            sub_partials = {}
+            for i, sub_node in enumerate(node.subs):
+                t = device_out.get(f"sub{i}")
+                if t is not None:
+                    sums, cnts, mins, maxs, sumsq = (np.asarray(x) for x in t)
+                    sub_partials[sub_node.name] = {
+                        "count": float(cnts[comb]), "sum": float(sums[comb]),
+                        "min": float(mins[comb]), "max": float(maxs[comb]),
+                        "sumsq": float(sumsq[comb])}
+            if sub_partials:
+                rec["subs"] = sub_partials
+            buckets[key] = rec
+        return {"buckets": buckets}
 
     if kind == "stats":
         if "empty" in device_out:

