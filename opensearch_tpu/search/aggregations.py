@@ -183,7 +183,9 @@ def _merge_subtrees(subs: List[AggNode], partial_lists: List[Optional[dict]]) ->
 
 # ---------------- finalize (response shaping) ----------------
 
-def finalize(node: AggNode, merged: dict) -> dict:
+def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
+    """`pipelines=False` defers pipeline application (the coordinator applies
+    them after bucket refinement via `apply_pipelines_tree`)."""
     kind = node.kind
     if not merged:
         return _empty_result(node)
@@ -204,14 +206,15 @@ def finalize(node: AggNode, merged: dict) -> dict:
         for k, v in items[:size]:
             b = {"key": k, "doc_count": int(v["doc_count"])}
             for sub in node.subs:
-                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}))
+                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}), pipelines)
             _apply_pipelines(node, buckets_ref=None)
             buckets.append(b)
         shown = sum(b["doc_count"] for b in buckets)
         result = {"doc_count_error_upper_bound": 0,
                   "sum_other_doc_count": int(total_count - shown),
                   "buckets": buckets}
-        _apply_bucket_pipelines(node, result)
+        if pipelines:
+            _apply_bucket_pipelines(node, result)
         return result
     if kind in ("histogram", "date_histogram"):
         buckets = []
@@ -225,10 +228,11 @@ def finalize(node: AggNode, merged: dict) -> dict:
                 entry["key"] = int(key)
                 entry["key_as_string"] = _format_epoch_ms(int(key))
             for sub in node.subs:
-                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
             buckets.append(entry)
         result = {"buckets": buckets}
-        _apply_bucket_pipelines(node, result)
+        if pipelines:
+            _apply_bucket_pipelines(node, result)
         return result
     if kind in ("range", "date_range"):
         buckets = []
@@ -238,10 +242,11 @@ def finalize(node: AggNode, merged: dict) -> dict:
             if rec.get("meta"):
                 entry.update(rec["meta"])
             for sub in node.subs:
-                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
             buckets.append(entry)
         result = {"buckets": buckets}
-        _apply_bucket_pipelines(node, result)
+        if pipelines:
+            _apply_bucket_pipelines(node, result)
         return result
     if kind == "filters":
         buckets = {}
@@ -249,14 +254,14 @@ def finalize(node: AggNode, merged: dict) -> dict:
             rec = merged["buckets"][key]
             entry = {"doc_count": int(rec["doc_count"])}
             for sub in node.subs:
-                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
             buckets[key] = entry
         return {"buckets": buckets}
     if kind in ("filter", "global", "missing", "sampler", "nested",
                 "reverse_nested", "children", "parent"):
         out = {"doc_count": int(merged["doc_count"])}
         for sub in node.subs:
-            out[sub.name] = finalize(sub, merged["subs"].get(sub.name, {}))
+            out[sub.name] = finalize(sub, merged["subs"].get(sub.name, {}), pipelines)
         return out
     if kind == "significant_terms":
         return _finalize_significant(node, merged)
@@ -269,10 +274,11 @@ def finalize(node: AggNode, merged: dict) -> dict:
         for k, v in items[:size]:
             b = {"key": k, "doc_count": int(v["doc_count"])}
             for sub in node.subs:
-                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}))
+                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}), pipelines)
             buckets.append(b)
         result = {"buckets": buckets}
-        _apply_bucket_pipelines(node, result)
+        if pipelines:
+            _apply_bucket_pipelines(node, result)
         return result
     if kind == "matrix_stats":
         return _finalize_matrix_stats(merged)
@@ -360,7 +366,7 @@ def _finalize_composite(node: AggNode, merged: dict) -> dict:
         b = {"key": {nm: v for (nm, _, _, _), v in zip(sources, key)},
              "doc_count": int(rec["doc_count"])}
         for sub in node.subs:
-            b[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+            b[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
         buckets.append(b)
     out = {"buckets": buckets}
     if buckets:
@@ -408,7 +414,7 @@ def _finalize_significant(node: AggNode, merged: dict) -> dict:
         b = {"key": key, "doc_count": int(fg), "score": score,
              "bg_count": int(bg)}
         for sub in node.subs:
-            b[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}))
+            b[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
         buckets.append(b)
     return {"doc_count": int(fg_total), "bg_count": int(bg_total),
             "buckets": buckets}
@@ -507,6 +513,27 @@ def _format_epoch_ms(ms: int) -> str:
 
     return dt.datetime.fromtimestamp(ms / 1000.0, dt.timezone.utc).strftime(
         "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+def apply_pipelines_tree(node: AggNode, result) -> None:
+    """Post-order pipeline application over a finalized agg tree — run by the
+    coordinator AFTER bucket refinement so buckets_path targets resolved by
+    refinement sub-searches (cardinality, terms, ...) carry real values."""
+    if not isinstance(result, dict):
+        return
+    buckets = result.get("buckets")
+    if isinstance(buckets, list):
+        for b in buckets:
+            for s in node.subs:
+                apply_pipelines_tree(s, b.get(s.name))
+    elif isinstance(buckets, dict):
+        for bd in buckets.values():
+            for s in node.subs:
+                apply_pipelines_tree(s, bd.get(s.name))
+    else:
+        for s in node.subs:
+            apply_pipelines_tree(s, result.get(s.name))
+    _apply_bucket_pipelines(node, result)
 
 
 # ---------------- pipeline aggregations (host post-processing) ----------------

@@ -517,7 +517,8 @@ class ShardSearcher:
 # =====================================================================
 
 def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
-                         agg_nodes: Optional[List[AggNode]] = None) -> dict:
+                         agg_nodes: Optional[List[AggNode]] = None,
+                         defer_pipelines: bool = False) -> dict:
     size = int(body.get("size", 10))
     frm = int(body.get("from", 0))
     all_cands: List[Candidate] = []
@@ -538,7 +539,8 @@ def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
         for r in shard_results:
             partials.extend(r.agg_partials.get(node.name, []))
         merged = merge_partials(node, partials) if partials else {}
-        aggs_out[node.name] = finalize(node, merged)
+        aggs_out[node.name] = finalize(node, merged,
+                                       pipelines=not defer_pipelines)
 
     return {"selected": selected, "total": total,
             "max_score": None if max_score == float("-inf") else max_score,
@@ -569,6 +571,17 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)] for c in reduced["selected"]
             if (c.shard, c.seg_ord, c.local_doc) in hits_by_key]
 
+    if reduced["aggs"]:
+        # bucket refinement: ordinal bucket aggs execute complex sub-trees
+        # (terms>terms, bucket top_hits, cardinality-under-terms, ...) as one
+        # recursive sub-search per top bucket — the device pass only fuses
+        # the stats-family metrics into the ordinal bincount
+        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
+        for an in agg_nodes:
+            _refine_complex_subs(searchers, body, index_name, an,
+                                 reduced["aggs"].get(an.name),
+                                 body.get("query"), [])
+
     track = body.get("track_total_hits", True)
     relation = "eq"
     total = reduced["total"]
@@ -596,6 +609,200 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
 # =====================================================================
 # helpers
 # =====================================================================
+
+_STATS_FAMILY = {"min", "max", "sum", "avg", "stats", "extended_stats",
+                 "value_count"}
+_ORDINAL_KINDS = {"terms", "significant_terms", "histogram", "date_histogram",
+                  "geohash_grid", "geotile_grid", "composite"}
+
+
+def _agg_to_dsl(node: AggNode) -> dict:
+    spec: dict = {node.kind: node.body}
+    subs = {s.name: _agg_to_dsl(s) for s in node.subs}
+    subs.update({p.name: _agg_to_dsl(p) for p in node.pipelines})
+    if subs:
+        spec["aggs"] = subs
+    return spec
+
+
+def _next_calendar_ms(ms: int, cal: str) -> int:
+    import datetime as dt
+
+    d = dt.datetime.fromtimestamp(ms / 1000.0, dt.timezone.utc)
+    if cal in ("month", "1M"):
+        y, m = (d.year + 1, 1) if d.month == 12 else (d.year, d.month + 1)
+        return int(dt.datetime(y, m, 1, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    if cal in ("year", "1y"):
+        return int(dt.datetime(d.year + 1, 1, 1,
+                               tzinfo=dt.timezone.utc).timestamp() * 1000)
+    if cal in ("quarter", "1q"):
+        m = ((d.month - 1) // 3) * 3 + 4
+        y = d.year + (1 if m > 12 else 0)
+        m = 1 if m > 12 else m
+        return int(dt.datetime(y, m, 1, tzinfo=dt.timezone.utc).timestamp() * 1000)
+    step = {"week": 7 * 86400000, "1w": 7 * 86400000, "day": 86400000,
+            "1d": 86400000, "hour": 3600000, "1h": 3600000,
+            "minute": 60000, "1m": 60000}[cal]
+    return ms + step
+
+
+def _geohash_bbox(cell: str):
+    lat_lo, lat_hi = -90.0, 90.0
+    lon_lo, lon_hi = -180.0, 180.0
+    is_lon = True
+    for ch in cell:
+        bits = "0123456789bcdefghjkmnpqrstuvwxyz".index(ch)
+        for b in (16, 8, 4, 2, 1):
+            if is_lon:
+                mid = (lon_lo + lon_hi) / 2
+                if bits & b:
+                    lon_lo = mid
+                else:
+                    lon_hi = mid
+            else:
+                mid = (lat_lo + lat_hi) / 2
+                if bits & b:
+                    lat_lo = mid
+                else:
+                    lat_hi = mid
+            is_lon = not is_lon
+    return lat_lo, lat_hi, lon_lo, lon_hi
+
+
+def _geotile_bbox(cell: str):
+    import math as _m
+
+    z, x, y = (int(p) for p in cell.split("/"))
+    n = 1 << z
+    lon_lo = x / n * 360.0 - 180.0
+    lon_hi = (x + 1) / n * 360.0 - 180.0
+
+    def lat_of(yy):
+        return _m.degrees(_m.atan(_m.sinh(_m.pi * (1 - 2 * yy / n))))
+
+    return lat_of(y + 1), lat_of(y), lon_lo, lon_hi
+
+
+def _bucket_filter(node: AggNode, bucket: dict) -> Optional[dict]:
+    """DSL filter selecting exactly the docs of one finalized bucket."""
+    body = node.body
+    field = body.get("field")
+    kind = node.kind
+    if kind in ("terms", "significant_terms"):
+        return {"term": {field: bucket["key"]}}
+    if kind == "histogram":
+        interval = float(body["interval"])
+        return {"range": {field: {"gte": bucket["key"],
+                                  "lt": bucket["key"] + interval}}}
+    if kind == "date_histogram":
+        key = int(bucket["key"])
+        cal = body.get("calendar_interval")
+        if cal:
+            end = _next_calendar_ms(key, cal)
+        else:
+            end = key + C.parse_interval_ms(body.get("fixed_interval",
+                                                     body.get("interval", "1d")))
+        return {"range": {field: {"gte": key, "lt": end}}}
+    if kind in ("geohash_grid", "geotile_grid"):
+        lat_lo, lat_hi, lon_lo, lon_hi = (
+            _geohash_bbox(bucket["key"]) if kind == "geohash_grid"
+            else _geotile_bbox(bucket["key"]))
+        return {"geo_bounding_box": {field: {
+            "top": lat_hi, "left": lon_lo, "bottom": lat_lo, "right": lon_hi}}}
+    if kind == "composite":
+        from .aggregations import composite_sources
+
+        flt = []
+        for nm, stype, scfg, _ in composite_sources(node):
+            v = bucket["key"][nm]
+            f = scfg.get("field")
+            if stype == "terms":
+                flt.append({"term": {f: v}})
+            elif stype == "histogram":
+                flt.append({"range": {f: {"gte": v,
+                                          "lt": v + float(scfg["interval"])}}})
+            else:
+                cal = scfg.get("calendar_interval")
+                end = (_next_calendar_ms(int(v), cal) if cal else
+                       int(v) + C.parse_interval_ms(scfg.get(
+                           "fixed_interval", scfg.get("interval", "1d"))))
+                flt.append({"range": {f: {"gte": int(v), "lt": end}}})
+        return {"bool": {"filter": flt}} if len(flt) != 1 else flt[0]
+    return None
+
+
+def _refine_complex_subs(searchers: List[ShardSearcher], body: dict,
+                         index_name: str, node: AggNode, result: Optional[dict],
+                         query: Optional[dict], filters: List[dict]) -> None:
+    """Recursive bucket refinement (see search_shards). Descends through
+    filter-expressible containers accumulating context filters; for each
+    ordinal bucket with complex subs, runs one size-0 sub-search whose own
+    aggs recurse naturally. Doc-space-changing aggs (nested, children,
+    sampler) stop the walk — their device recursion covers the stats family."""
+    if result is None:
+        return
+    kind = node.kind
+    if kind in _ORDINAL_KINDS:
+        complex_subs = [s for s in node.subs if s.kind not in _STATS_FAMILY]
+        buckets = result.get("buckets")
+        if not isinstance(buckets, list) or not complex_subs:
+            return
+        for b in buckets:
+            bf = _bucket_filter(node, b)
+            if bf is None:
+                continue
+            sub_body = {"size": 0, "_index_name": index_name,
+                        "query": {"bool": {"must": ([query] if query else []),
+                                           "filter": filters + [bf]}},
+                        "aggs": {s.name: _agg_to_dsl(s) for s in complex_subs}}
+            resp = search_shards(searchers, sub_body, index_name)
+            for s in complex_subs:
+                b[s.name] = resp["aggregations"][s.name]
+        return
+    if kind == "filter":
+        for s in node.subs:
+            _refine_complex_subs(searchers, body, index_name, s,
+                                 result.get(s.name), query,
+                                 filters + [node.body])
+        return
+    if kind == "filters":
+        raw = node.body.get("filters", {})
+        items = (list(raw.items()) if isinstance(raw, dict)
+                 else [(str(i), f) for i, f in enumerate(raw)])
+        fmap = dict(items)
+        for key, bucket in (result.get("buckets") or {}).items():
+            bf = fmap.get(key)
+            if bf is None:
+                continue
+            for s in node.subs:
+                _refine_complex_subs(searchers, body, index_name, s,
+                                     bucket.get(s.name), query, filters + [bf])
+        return
+    if kind in ("range", "date_range"):
+        field = node.body.get("field")
+        for bucket in (result.get("buckets") or []):
+            rng = {}
+            if bucket.get("from") is not None:
+                rng["gte"] = bucket["from"]
+            if bucket.get("to") is not None:
+                rng["lt"] = bucket["to"]
+            for s in node.subs:
+                _refine_complex_subs(searchers, body, index_name, s,
+                                     bucket.get(s.name), query,
+                                     filters + [{"range": {field: rng}}])
+        return
+    if kind == "global":
+        for s in node.subs:
+            _refine_complex_subs(searchers, body, index_name, s,
+                                 result.get(s.name), None, [])
+        return
+    if kind == "missing":
+        mf = {"bool": {"must_not": [{"exists": {"field": node.body.get("field")}}]}}
+        for s in node.subs:
+            _refine_complex_subs(searchers, body, index_name, s,
+                                 result.get(s.name), query, filters + [mf])
+        return
+
 
 def _global_stats_contexts(searchers: List[ShardSearcher]) -> List[Any]:
     """DFS phase: collection statistics span ALL segments of the searcher's

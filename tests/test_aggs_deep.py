@@ -1,0 +1,109 @@
+"""Arbitrary-depth sub-aggregation nesting: ordinal bucket aggs (terms/
+histogram/date_histogram) carrying complex sub-trees (terms-under-terms,
+per-bucket cardinality/percentiles/top_hits). Reference: AggregatorFactories
+deep trees; ours: device fast path for stats metrics + per-bucket refinement
+sub-searches (executor._refine_complex_subs)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("t", {"mappings": {"properties": {
+        "region": {"type": "keyword"},
+        "product": {"type": "keyword"},
+        "user": {"type": "keyword"},
+        "qty": {"type": "integer"},
+        "day": {"type": "integer"}}}})
+    rows = [
+        ("eu", "apple", "u1", 1, 1), ("eu", "apple", "u2", 2, 1),
+        ("eu", "pear", "u1", 3, 2), ("us", "apple", "u3", 4, 1),
+        ("us", "pear", "u3", 5, 2), ("us", "pear", "u4", 6, 2),
+    ]
+    for i, (rg, p, u, q, d) in enumerate(rows):
+        c.index("t", {"region": rg, "product": p, "user": u, "qty": q,
+                      "day": d}, id=str(i))
+    c.indices.refresh("t")
+    return c
+
+
+class TestDeepNesting:
+    def test_terms_under_terms(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"rg": {
+            "terms": {"field": "region"},
+            "aggs": {"pd": {"terms": {"field": "product"},
+                            "aggs": {"s": {"sum": {"field": "qty"}}}}}}}})
+        out = {b["key"]: {p["key"]: (p["doc_count"], p["s"]["value"])
+                         for p in b["pd"]["buckets"]}
+               for b in r["aggregations"]["rg"]["buckets"]}
+        assert out == {"eu": {"apple": (2, 3.0), "pear": (1, 3.0)},
+                       "us": {"pear": (2, 11.0), "apple": (1, 4.0)}}
+
+    def test_three_levels(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"rg": {
+            "terms": {"field": "region"},
+            "aggs": {"pd": {"terms": {"field": "product"},
+                            "aggs": {"u": {"terms": {"field": "user"}}}}}}}})
+        eu = next(b for b in r["aggregations"]["rg"]["buckets"]
+                  if b["key"] == "eu")
+        apple = next(p for p in eu["pd"]["buckets"] if p["key"] == "apple")
+        assert {u["key"] for u in apple["u"]["buckets"]} == {"u1", "u2"}
+
+    def test_cardinality_under_terms(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"rg": {
+            "terms": {"field": "region"},
+            "aggs": {"users": {"cardinality": {"field": "user"}}}}}})
+        got = {b["key"]: b["users"]["value"]
+               for b in r["aggregations"]["rg"]["buckets"]}
+        assert got == {"eu": 2, "us": 2}
+
+    def test_top_hits_under_terms(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"rg": {
+            "terms": {"field": "region"},
+            "aggs": {"th": {"top_hits": {"size": 1}}}}}})
+        for b in r["aggregations"]["rg"]["buckets"]:
+            hits = b["th"]["hits"]["hits"]
+            assert len(hits) == 1
+            assert hits[0]["_source"]["region"] == b["key"]
+
+    def test_histogram_with_terms_sub(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"d": {
+            "histogram": {"field": "day", "interval": 1},
+            "aggs": {"pd": {"terms": {"field": "product"}}}}}})
+        day1 = next(b for b in r["aggregations"]["d"]["buckets"]
+                    if b["key"] == 1.0)
+        got = {p["key"]: p["doc_count"] for p in day1["pd"]["buckets"]}
+        assert got == {"apple": 3}
+
+    def test_filter_then_terms_then_terms(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"f": {
+            "filter": {"term": {"region": "us"}},
+            "aggs": {"pd": {"terms": {"field": "product"},
+                            "aggs": {"u": {"terms": {"field": "user"}}}}}}}})
+        pd = r["aggregations"]["f"]["pd"]["buckets"]
+        pear = next(p for p in pd if p["key"] == "pear")
+        assert {u["key"] for u in pear["u"]["buckets"]} == {"u3", "u4"}
+
+    def test_respects_query_context(self, client):
+        r = client.search("t", {"size": 0,
+                                "query": {"range": {"qty": {"gte": 4}}},
+                                "aggs": {"rg": {
+                                    "terms": {"field": "region"},
+                                    "aggs": {"pd": {"terms": {
+                                        "field": "product"}}}}}})
+        assert [b["key"] for b in r["aggregations"]["rg"]["buckets"]] == ["us"]
+        us = r["aggregations"]["rg"]["buckets"][0]
+        got = {p["key"]: p["doc_count"] for p in us["pd"]["buckets"]}
+        assert got == {"pear": 2, "apple": 1}
+
+    def test_percentiles_under_terms(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"rg": {
+            "terms": {"field": "region"},
+            "aggs": {"p": {"percentiles": {"field": "qty",
+                                           "percents": [50.0]}}}}}})
+        us = next(b for b in r["aggregations"]["rg"]["buckets"]
+                  if b["key"] == "us")
+        assert us["p"]["values"]["50.0"] == pytest.approx(5.0, rel=0.1)
