@@ -113,7 +113,7 @@ def main():
     reps = 5
     t0 = time.time()
     for _ in range(reps):
-        vals, idx = fused_bm25_topk(d_docs, d_imp, qs, ql, qw, msm, T=T, L=L, K=K)
+        vals, idx, _tot = fused_bm25_topk(d_docs, d_imp, qs, ql, qw, msm, T=T, L=L, K=K)
     results_flat = np.asarray(idx)[:, :k]
     wall = time.time() - t0
     qps = (reps * nq) / wall

@@ -207,6 +207,8 @@ class Segment:
                  seq_nos: Optional[np.ndarray] = None,
                  vector_cols: Optional[Dict[str, VectorColumn]] = None,
                  nested: Optional[Dict[str, NestedBlock]] = None):
+        Segment._seq += 1
+        self.uid = Segment._seq  # stable identity (id() can be reused post-GC)
         self.name = name
         self.ndocs = ndocs
         self.postings = postings
@@ -538,6 +540,11 @@ def pack_postings(parsed_docs: list, with_positions: bool) -> Dict[str, Postings
         cnts = np.fromiter((c for _, c in pairs), np.int64, count=len(pairs))
         doc_of = np.repeat(docs, cnts)
         has_pos = with_positions and fname in field_pos
+        if has_pos and len(field_pos[fname]) != len(tokens):
+            # positions for some docs but not others — mis-aligned stream,
+            # take the Python fallback (same as the len(pl) != len(terms) guard)
+            python_fields.append(fname)
+            continue
         pos_arr = (np.fromiter(field_pos[fname], np.int32, count=len(tokens))
                    if has_pos else None)
         packer = native.Packer(with_positions=has_pos)

@@ -158,7 +158,7 @@ def _flat_shift_up(x, fill):
 
 def _bm25_kernel(T: int, L: int, K: int,
                  starts_ref, lens_ref, weights_ref, msm_ref,
-                 docs_hbm, norms_hbm, out_scores, out_docs,
+                 docs_hbm, norms_hbm, out_scores, out_docs, out_totals,
                  docs_v, norms_v, sems):
     q = pl.program_id(0)
 
@@ -220,6 +220,10 @@ def _bm25_kernel(T: int, L: int, K: int,
     msm = msm_ref[0, q]
     final = jnp.where(is_last & (count >= msm), score, NEG_INF)
 
+    # exact total hits (track_total_hits): one doc survives per dedup run
+    total = jnp.sum((final > NEG_INF).astype(jnp.int32))
+    out_totals[q, :] = jnp.full((LANES,), total, jnp.int32)
+
     # ---- iterative top-K extraction ----
     acc_s = jnp.full((1, LANES), NEG_INF, jnp.float32)
     acc_d = jnp.full((1, LANES), -1, jnp.int32)
@@ -255,7 +259,9 @@ def fused_bm25_topk(docs_hbm: jnp.ndarray, norms_hbm: jnp.ndarray,
     lens      i32[QB, T]
     weights   f32[QB, T] — query-time idf * boost (collection-wide stats)
     msm       f32[QB, 1] — minimum matching terms (1=OR, T=AND)
-    Returns (scores f32[QB, 128], doc_ids i32[QB, 128]) — first K valid.
+    Returns (scores f32[QB, 128], doc_ids i32[QB, 128], totals i32[QB, 128])
+    — first K lanes of scores/doc_ids valid; totals[q, 0] is the exact hit
+    count (docs matching >= msm terms).
     """
     QB = starts.shape[0]
     # SMEM operands are lane-padded to 128 in their last dim: keep QB (large)
@@ -281,6 +287,7 @@ def fused_bm25_topk(docs_hbm: jnp.ndarray, norms_hbm: jnp.ndarray,
             # min-tile rule)
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
         scratch_shapes=[
             pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
@@ -291,22 +298,25 @@ def fused_bm25_topk(docs_hbm: jnp.ndarray, norms_hbm: jnp.ndarray,
     out_shape = [
         jax.ShapeDtypeStruct((QB, LANES), jnp.float32),
         jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
     ]
-    scores, doc_ids = pl.pallas_call(
+    scores, doc_ids, totals = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
     )(starts, lens, weights, msm, docs_hbm, norms_hbm)
-    return scores, doc_ids
+    return scores, doc_ids, totals
 
 
-def align_csr_rows(starts: np.ndarray, doc_ids: np.ndarray, tfs: np.ndarray,
+def align_csr_rows(starts: np.ndarray, doc_ids: np.ndarray, *vals: np.ndarray,
                    margin: int, alignment: int = HBM_ALIGN):
     """Re-pack CSR postings so every row begins at a 128-aligned offset
     (sentinel-padded gaps), with `margin` sentinel slack at the end so a
     fixed-size DMA window never runs off the buffer. Returns
-    (new_starts i64[nrows+1 -> aligned row starts], docs, tfs)."""
+    (new_starts i64[nrows+1 -> aligned row starts], docs, *aligned vals) —
+    each extra `vals` array (tfs, impacts, per-posting dl, ...) is scattered
+    to the same aligned layout with zero fill."""
     nrows = len(starts) - 1
     lens = np.diff(starts)
     aligned_lens = ((lens + alignment - 1) // alignment) * alignment
@@ -315,12 +325,15 @@ def align_csr_rows(starts: np.ndarray, doc_ids: np.ndarray, tfs: np.ndarray,
     total = int(new_starts[-1]) + margin
     total = ((total + LANES - 1) // LANES) * LANES
     new_docs = np.full(total, INT_SENTINEL, dtype=np.int32)
-    new_tfs = np.zeros(total, dtype=np.float32)
     # vectorized row scatter
     src_idx = np.arange(len(doc_ids), dtype=np.int64)
     row_of = np.searchsorted(starts, src_idx, side="right") - 1
     offset_in_row = src_idx - starts[row_of]
     dst = new_starts[row_of] + offset_in_row
     new_docs[dst] = doc_ids
-    new_tfs[dst] = tfs
-    return new_starts, new_docs, new_tfs
+    out_vals = []
+    for v in vals:
+        nv = np.zeros(total, dtype=np.float32)
+        nv[dst] = v
+        out_vals.append(nv)
+    return (new_starts, new_docs, *out_vals)

@@ -44,6 +44,10 @@ class AggNode:
     body: dict
     subs: List["AggNode"] = dc_field(default_factory=list)
     pipelines: List["AggNode"] = dc_field(default_factory=list)
+    # pipeline nodes whose buckets_path targets a refinement-resolved sub-agg
+    # are deferred: the coordinator applies them AFTER bucket refinement
+    # (executor._mark_deferred_pipelines / _apply_deferred_tree)
+    deferred: bool = False
 
 
 def parse_aggs(aggs: Optional[dict]) -> List[AggNode]:
@@ -184,8 +188,10 @@ def _merge_subtrees(subs: List[AggNode], partial_lists: List[Optional[dict]]) ->
 # ---------------- finalize (response shaping) ----------------
 
 def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
-    """`pipelines=False` defers pipeline application (the coordinator applies
-    them after bucket refinement via `apply_pipelines_tree`)."""
+    """`pipelines=True` applies every pipeline agg; `pipelines=False` applies
+    only non-deferred ones — the coordinator applies deferred pipelines after
+    bucket refinement (executor._apply_deferred_tree), so a buckets_path
+    targeting a refined sub-agg sees post-refinement values."""
     kind = node.kind
     if not merged:
         return _empty_result(node)
@@ -213,8 +219,7 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
         result = {"doc_count_error_upper_bound": 0,
                   "sum_other_doc_count": int(total_count - shown),
                   "buckets": buckets}
-        if pipelines:
-            _apply_bucket_pipelines(node, result)
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
         return result
     if kind in ("histogram", "date_histogram"):
         buckets = []
@@ -231,8 +236,7 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
                 entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
             buckets.append(entry)
         result = {"buckets": buckets}
-        if pipelines:
-            _apply_bucket_pipelines(node, result)
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
         return result
     if kind in ("range", "date_range"):
         buckets = []
@@ -245,8 +249,7 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
                 entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
             buckets.append(entry)
         result = {"buckets": buckets}
-        if pipelines:
-            _apply_bucket_pipelines(node, result)
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
         return result
     if kind == "filters":
         buckets = {}
@@ -264,7 +267,7 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
             out[sub.name] = finalize(sub, merged["subs"].get(sub.name, {}), pipelines)
         return out
     if kind == "significant_terms":
-        return _finalize_significant(node, merged)
+        return _finalize_significant(node, merged, pipelines)
     if kind in ("geohash_grid", "geotile_grid"):
         size = int(node.body.get("size", 10000))
         items = sorted(((k, v) for k, v in merged["buckets"].items()
@@ -277,13 +280,12 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
                 b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}), pipelines)
             buckets.append(b)
         result = {"buckets": buckets}
-        if pipelines:
-            _apply_bucket_pipelines(node, result)
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
         return result
     if kind == "matrix_stats":
         return _finalize_matrix_stats(merged)
     if kind == "composite":
-        return _finalize_composite(node, merged)
+        return _finalize_composite(node, merged, pipelines)
     if kind == "value_count":
         return {"value": int(merged["count"])}
     if kind == "min":
@@ -346,7 +348,7 @@ class _CompVal:
         return self.v == other.v
 
 
-def _finalize_composite(node: AggNode, merged: dict) -> dict:
+def _finalize_composite(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
     sources = composite_sources(node)
     size = int(node.body.get("size", 10))
     after = node.body.get("after")
@@ -371,6 +373,7 @@ def _finalize_composite(node: AggNode, merged: dict) -> dict:
     out = {"buckets": buckets}
     if buckets:
         out["after_key"] = buckets[-1]["key"]
+    _apply_bucket_pipelines(node, out, "all" if pipelines else "early")
     return out
 
 
@@ -392,7 +395,7 @@ def _significance_score(fg: float, fg_total: float, bg: float, bg_total: float,
     return (fgp - bgp) * (fgp / bgp) if fgp > bgp else 0.0
 
 
-def _finalize_significant(node: AggNode, merged: dict) -> dict:
+def _finalize_significant(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
     body = node.body
     heuristic = next((h for h in ("jlh", "chi_square", "percentage")
                       if h in body), "jlh")
@@ -416,8 +419,10 @@ def _finalize_significant(node: AggNode, merged: dict) -> dict:
         for sub in node.subs:
             b[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}), pipelines)
         buckets.append(b)
-    return {"doc_count": int(fg_total), "bg_count": int(bg_total),
-            "buckets": buckets}
+    out = {"doc_count": int(fg_total), "bg_count": int(bg_total),
+           "buckets": buckets}
+    _apply_bucket_pipelines(node, out, "all" if pipelines else "early")
+    return out
 
 
 def _finalize_matrix_stats(merged: dict) -> dict:
@@ -516,9 +521,11 @@ def _format_epoch_ms(ms: int) -> str:
 
 
 def apply_pipelines_tree(node: AggNode, result) -> None:
-    """Post-order pipeline application over a finalized agg tree — run by the
-    coordinator AFTER bucket refinement so buckets_path targets resolved by
-    refinement sub-searches (cardinality, terms, ...) carry real values."""
+    """Post-order application of DEFERRED pipelines over a finalized agg
+    subtree — used for subtrees the refinement walk never reached (their
+    early pipelines already ran in finalize; deferred ones run here with the
+    same values). The coordinator's refinement-aware walk is
+    executor._apply_deferred_tree."""
     if not isinstance(result, dict):
         return
     buckets = result.get("buckets")
@@ -533,7 +540,7 @@ def apply_pipelines_tree(node: AggNode, result) -> None:
     else:
         for s in node.subs:
             apply_pipelines_tree(s, result.get(s.name))
-    _apply_bucket_pipelines(node, result)
+    _apply_bucket_pipelines(node, result, "deferred")
 
 
 # ---------------- pipeline aggregations (host post-processing) ----------------
@@ -583,16 +590,24 @@ def _moving_fn_eval(script: str, values: List[float], params: dict):
     return pl.execute(script, {"values": list(values), "params": params})
 
 
-def _apply_bucket_pipelines(node: AggNode, result: dict) -> None:
+def _apply_bucket_pipelines(node: AggNode, result: dict,
+                            which: str = "all") -> None:
     """Sibling pipeline aggs over this bucket agg's finalized buckets
     (reference `search/aggregations/pipeline/`): cumulative_sum, derivative,
     moving_avg/fn, serial_diff, bucket_script attach per-bucket;
     bucket_selector/bucket_sort mutate the bucket list; *_bucket /
-    percentiles_bucket attach as sibling values."""
+    percentiles_bucket attach as sibling values.
+
+    `which` selects the phase: "all" every pipeline, "early" only
+    non-deferred, "deferred" only deferred (see AggNode.deferred)."""
     buckets = result.get("buckets")
     if not isinstance(buckets, list):
         return
     for p in node.pipelines:
+        if which == "early" and p.deferred:
+            continue
+        if which == "deferred" and not p.deferred:
+            continue
         raw_path = p.body.get("buckets_path", "_count")
 
         if p.kind in ("bucket_script", "bucket_selector"):

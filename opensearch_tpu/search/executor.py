@@ -23,7 +23,9 @@ from ..index.segment import Segment, next_pow2
 from ..script.painless_lite import ScriptError as _ScriptError
 from . import compiler as C
 from . import query_dsl as dsl
-from .aggregations import AggNode, finalize, merge_partials, parse_aggs
+from .aggregations import (AggNode, _apply_bucket_pipelines,
+                           apply_pipelines_tree, finalize, merge_partials,
+                           parse_aggs)
 from .highlight import collect_query_terms, highlight_field
 
 INT32_SENTINEL = np.int32(2**31 - 1)
@@ -556,7 +558,14 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     stats = _global_stats_contexts(searchers)
     results = [s.query_phase(body, shard_ord=i, stats_ctx=stats[i])
                for i, s in enumerate(searchers)]
-    reduced = reduce_shard_results(results, body)
+    agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
+    # pipelines whose buckets_path targets a refinement-resolved sub-agg are
+    # deferred until after _refine_complex_subs; the rest run in finalize so
+    # bucket_selector/bucket_sort still prune BEFORE per-bucket refinement
+    for an in agg_nodes:
+        _mark_deferred_pipelines(an)
+    reduced = reduce_shard_results(results, body, agg_nodes=agg_nodes,
+                                   defer_pipelines=bool(agg_nodes))
     by_shard: Dict[int, List[Candidate]] = {}
     for c in reduced["selected"]:
         by_shard.setdefault(c.shard, []).append(c)
@@ -576,11 +585,12 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
         # (terms>terms, bucket top_hits, cardinality-under-terms, ...) as one
         # recursive sub-search per top bucket — the device pass only fuses
         # the stats-family metrics into the ordinal bincount
-        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
         for an in agg_nodes:
             _refine_complex_subs(searchers, body, index_name, an,
                                  reduced["aggs"].get(an.name),
                                  body.get("query"), [])
+        for an in agg_nodes:
+            _apply_deferred_tree(an, reduced["aggs"].get(an.name))
 
     track = body.get("track_total_hits", True)
     relation = "eq"
@@ -614,6 +624,71 @@ _STATS_FAMILY = {"min", "max", "sum", "avg", "stats", "extended_stats",
                  "value_count"}
 _ORDINAL_KINDS = {"terms", "significant_terms", "histogram", "date_histogram",
                   "geohash_grid", "geotile_grid", "composite"}
+_WALK_CONTAINERS = {"filter", "filters", "range", "date_range", "global",
+                    "missing"}
+
+
+def _pipeline_input_names(p: AggNode) -> set:
+    """First path components of every buckets_path (and bucket_sort sort
+    fields) a pipeline node reads."""
+    raw = p.body.get("buckets_path", "_count")
+    paths = list(raw.values()) if isinstance(raw, dict) else [raw]
+    if p.kind == "bucket_sort":
+        for s in p.body.get("sort", []):
+            if isinstance(s, dict):
+                paths.extend(s.keys())
+            elif isinstance(s, str):
+                paths.append(s)
+    return {str(pth).replace(">", ".").split(".")[0] for pth in paths if pth}
+
+
+def _mark_deferred_pipelines(node: AggNode) -> None:
+    """Flag pipelines whose inputs come from refinement-resolved sub-aggs
+    (complex subs of ordinal buckets) — transitively through pipelines that
+    read other deferred pipelines' outputs."""
+    deferred_names = ({s.name for s in node.subs if s.kind not in _STATS_FAMILY}
+                      if node.kind in _ORDINAL_KINDS else set())
+    for p in node.pipelines:
+        p.deferred = False
+    changed = True
+    while changed:
+        changed = False
+        for p in node.pipelines:
+            if not p.deferred and (_pipeline_input_names(p) & deferred_names):
+                p.deferred = True
+                deferred_names.add(p.name)
+                changed = True
+    for s in node.subs:
+        _mark_deferred_pipelines(s)
+
+
+def _apply_deferred_tree(node: AggNode, result) -> None:
+    """Apply deferred pipelines after refinement, mirroring the
+    _refine_complex_subs walk: complex subs of reached ordinal nodes were
+    REPLACED by fully-pipelined refinement sub-search results — don't descend
+    into them (double application); subtrees the walk never reached get the
+    plain post-order pass."""
+    if not isinstance(result, dict):
+        return
+    if node.kind in _ORDINAL_KINDS:
+        _apply_bucket_pipelines(node, result, "deferred")
+        return
+    if node.kind in _WALK_CONTAINERS:
+        buckets = result.get("buckets")
+        if isinstance(buckets, list):
+            for b in buckets:
+                for s in node.subs:
+                    _apply_deferred_tree(s, b.get(s.name))
+        elif isinstance(buckets, dict):
+            for bd in buckets.values():
+                for s in node.subs:
+                    _apply_deferred_tree(s, bd.get(s.name))
+        else:
+            for s in node.subs:
+                _apply_deferred_tree(s, result.get(s.name))
+        _apply_bucket_pipelines(node, result, "deferred")
+        return
+    apply_pipelines_tree(node, result)
 
 
 def _agg_to_dsl(node: AggNode) -> dict:

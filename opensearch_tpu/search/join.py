@@ -35,7 +35,7 @@ class JoinIndex:
         self.base: Dict[int, int] = {}
         off = 0
         for s in segments:
-            self.base[id(s)] = off
+            self.base[s.uid] = off
             off += s.ndocs_pad
         self.gsize = next_pow2(max(off, 16))
 
@@ -47,9 +47,9 @@ class JoinIndex:
                 d = s.id2doc.get(pid)
                 if d is not None:
                     if s.live[d]:
-                        return self.base[id(s)] + d
+                        return self.base[s.uid] + d
                     if fallback < 0:
-                        fallback = self.base[id(s)] + d
+                        fallback = self.base[s.uid] + d
             return fallback
 
         self.parent_slot: Dict[int, np.ndarray] = {}
@@ -63,7 +63,7 @@ class JoinIndex:
                 present = pcol.min_ord >= 0
                 vals = np.where(present, pcol.min_ord, 0)
                 arr[: s.ndocs] = np.where(present, slot_of_ord[vals], -1)
-            self.parent_slot[id(s)] = arr
+            self.parent_slot[s.uid] = arr
         self._children_sorted: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None
 
     @property
@@ -71,17 +71,17 @@ class JoinIndex:
         return [s for s in (r() for r in self._seg_refs) if s is not None]
 
     def seg_base(self, seg: Segment) -> int:
-        return self.base.get(id(seg), 0)
+        return self.base.get(seg.uid, 0)
 
     def pslot(self, seg: Segment) -> np.ndarray:
-        arr = self.parent_slot.get(id(seg))
+        arr = self.parent_slot.get(seg.uid)
         if arr is None:
             arr = np.full(seg.ndocs_pad, -1, np.int32)
         return arr
 
     def slot_to_doc(self, slot: int) -> Optional[Tuple[Segment, int]]:
         for s in self.segments:
-            b = self.base[id(s)]
+            b = self.base[s.uid]
             if b <= slot < b + s.ndocs_pad:
                 d = slot - b
                 return (s, d) if d < s.ndocs else None
@@ -94,7 +94,7 @@ class JoinIndex:
             snapshot = self.segments  # fixed positional order for sg below
             slots, segi, docs = [], [], []
             for i, s in enumerate(snapshot):
-                arr = self.parent_slot[id(s)][: s.ndocs]
+                arr = self.parent_slot[s.uid][: s.ndocs]
                 nz = np.nonzero(arr >= 0)[0]
                 slots.append(arr[nz])
                 segi.append(np.full(len(nz), i, np.int32))
@@ -120,7 +120,7 @@ _cache: Dict[Tuple, JoinIndex] = {}
 
 
 def get_join_index(segments: List[Segment], join_field: str) -> JoinIndex:
-    key = (join_field, tuple(id(s) for s in segments))
+    key = (join_field, tuple((s.uid, s.live_gen) for s in segments))
     ji = _cache.get(key)
     if ji is None:
         ji = JoinIndex(segments, join_field)

@@ -107,3 +107,46 @@ class TestDeepNesting:
         us = next(b for b in r["aggregations"]["rg"]["buckets"]
                   if b["key"] == "us")
         assert us["p"]["values"]["50.0"] == pytest.approx(5.0, rel=0.1)
+
+
+class TestDeferredPipelines:
+    """Pipelines whose buckets_path targets a refinement-resolved sub-agg run
+    AFTER refinement; the rest run in finalize (and prune before refinement).
+    Refined subtrees arrive fully pipelined — the coordinator must not apply
+    their pipelines twice (bucket_sort from/size is not idempotent)."""
+
+    def test_derivative_over_refined_cardinality(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"d": {
+            "histogram": {"field": "day", "interval": 1},
+            "aggs": {"card": {"cardinality": {"field": "user"}},
+                     "dv": {"derivative": {"buckets_path": "card.value"}}}}}})
+        b = r["aggregations"]["d"]["buckets"]
+        # day1 users {u1,u2,u3}=3, day2 users {u1,u3,u4}=3 -> derivative 0
+        assert b[0].get("dv") is None or "value" not in b[0].get("dv", {}) \
+            or b[0]["dv"].get("value") is None or len(b) == 2
+        assert b[1]["dv"]["value"] == 0
+
+    def test_bucket_sort_inside_refined_subtree_applied_once(self, client):
+        r = client.search("t", {"size": 0, "aggs": {"rg": {
+            "terms": {"field": "region"},
+            "aggs": {"pd": {"terms": {"field": "product"},
+                            "aggs": {"s": {"sum": {"field": "qty"}},
+                                     "bs": {"bucket_sort": {"from": 1}}}}}}}})
+        eu = next(b for b in r["aggregations"]["rg"]["buckets"]
+                  if b["key"] == "eu")
+        # eu has 2 product buckets; bucket_sort from=1 keeps exactly 1 —
+        # double application would leave 0
+        assert len(eu["pd"]["buckets"]) == 1
+
+    def test_early_selector_prunes_before_refinement(self, client):
+        # selector reads _count (not refined) -> applied in finalize; the
+        # surviving bucket still gets its complex sub refined
+        r = client.search("t", {"size": 0, "aggs": {"rg": {
+            "terms": {"field": "region"},
+            "aggs": {"u": {"terms": {"field": "user"}},
+                     "keep": {"bucket_selector": {
+                         "buckets_path": {"c": "_count"},
+                         "script": "params.c >= 3"}}}}}})
+        b = r["aggregations"]["rg"]["buckets"]
+        assert {x["key"] for x in b} == {"eu", "us"}
+        assert all(len(x["u"]["buckets"]) > 0 for x in b)
