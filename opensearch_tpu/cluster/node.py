@@ -18,7 +18,7 @@ from ..analysis import AnalysisRegistry
 from ..index.engine import Engine
 from ..index.mappings import Mappings
 from ..ingest import IngestService
-from ..search.executor import ShardSearcher, search_shards
+from ..search.executor import ShardSearcher, msearch_batched, search_shards
 from ..utils.breaker import BreakerService
 from .routing import shard_for
 from .state import (ClusterMetadata, ClusterStateError, IndexMetadata,
@@ -341,6 +341,21 @@ class Node:
         if cache_key is not None:
             self.request_cache.put(cache_key, resp)
         return resp
+
+    def msearch(self, expression: str, bodies: List[dict]) -> Optional[List[dict]]:
+        """Batched msearch over one index expression: all bodies' term-group
+        queries fuse into grouped Pallas kernel launches (grid over queries).
+        Returns None when ineligible — caller falls back to per-body search."""
+        names = self.metadata.resolve(expression)
+        searchers = []
+        for name in names:
+            searchers.extend(self.indices[name].searchers)
+        resps = msearch_batched(searchers, bodies, index_name=",".join(names))
+        if resps is not None and len(names) == 1:
+            for resp in resps:
+                for h in resp["hits"]["hits"]:
+                    h["_index"] = names[0]
+        return resps
 
     def stats(self) -> dict:
         return {

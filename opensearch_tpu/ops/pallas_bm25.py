@@ -1,11 +1,12 @@
-"""Fused BM25 top-k Pallas kernel — the flagship device kernel.
+"""Fused BM25 top-k Pallas kernel — the flagship device kernel, and the
+PRODUCTION scorer for term/match queries (see search/fastpath.py).
 
 Replaces Lucene's per-doc BulkScorer loop (reference
 `search/query/QueryPhase.java` + BM25Similarity) with one fused TPU program
 per query:
 
-    HBM CSR postings ──async DMA──▶ VMEM [T, L] (docs, impacts)
-      ─▶ mask + weight (VPU) ─▶ bitonic MERGE of T doc-sorted runs
+    HBM CSR postings ──async DMA──▶ VMEM [T, L] (docs, packed tf·dl)
+      ─▶ decode + BM25 (VPU) ─▶ bitonic MERGE of T doc-sorted runs
       ─▶ shift-add dedup (runs ≤ T) ─▶ iterative top-k extraction
       ─▶ [K] (scores, doc_ids) per query
 
@@ -14,7 +15,14 @@ pattern each cost ~100ms for a 512-query batch (measured on v5e) — they
 serialize or relayout. Everything here is DMA + dense VPU ops:
 
 - The CSR gather is contiguous per term -> plain async DMA (posting rows are
-  128-aligned at build time so DMAs are lane-aligned).
+  1024-element-aligned at build time so DMA slices are tile-aligned).
+- Each term's DMA covers only ITS OWN pow2 bucket (static-size branches on a
+  prefetched row count), not the batch-wide max — rare terms don't pay the
+  frequent term's bandwidth.
+- Postings carry (doc_id, tf·dl packed in one i32); BM25 is computed on the
+  VPU with the SAME f32 expression the XLA path uses, so both paths are
+  bit-identical per posting (no pre-rounded "eager impact" drift) and the
+  avgdl collection statistic stays a query-time scalar.
 - The per-term posting lists are ALREADY doc-sorted, so we need a merge
   network, not a sort: log2(n) compare-exchange stages, each a pair of
   `pltpu.roll`s + selects (strides >= 128 roll sublanes, < 128 roll lanes).
@@ -40,6 +48,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 INT_SENTINEL = np.int32(2**31 - 1)
+NEG_SENTINEL = np.int32(-2**31)
 LANES = 128
 # 1D HBM memrefs are tiled at 1024 elements (i32/f32): DMA slice starts and
 # sizes must be 1024-aligned, so CSR rows are packed to this alignment
@@ -309,6 +318,214 @@ def fused_bm25_topk(docs_hbm: jnp.ndarray, norms_hbm: jnp.ndarray,
     return scores, doc_ids, totals
 
 
+# ---------------------------------------------------------------------
+# production variant: packed (tf, dl) postings + per-term DMA buckets
+# ---------------------------------------------------------------------
+
+# tf and doc length packed losslessly into one i32 per posting:
+#   packed = tf << DL_BITS | dl    (tf < 2^TF_BITS, dl < 2^DL_BITS)
+# Segments violating the bounds (tf >= 2048 or a 2M-token doc) fall back to
+# the XLA path — see search/fastpath.py.
+TF_BITS = 11
+DL_BITS = 21
+DL_MASK = (1 << DL_BITS) - 1
+TF_MAX = (1 << TF_BITS) - 1
+DL_MAX = DL_MASK
+
+
+def _bm25_tfdl_kernel(T: int, L: int, K: int, k1: float, b: float,
+                      sizes: tuple,
+                      rowstart_ref, nrows_ref, lens_ref, weights_ref,
+                      msm_ref, avgdl_ref, dlo_ref, dhi_ref,
+                      docs_hbm, tfdl_hbm, out_scores, out_docs, out_totals,
+                      docs_v, tfdl_v, sems):
+    q = pl.program_id(0)
+    rows_per_term = L // LANES
+
+    # ---- per-term DMA at the term's own pow2 bucket ----
+    # `nrows_ref[t, q]` is the pow2 number of 128-lane rows this term needs
+    # (0 = absent term, no DMA). DMA sizes must be static, so each size in
+    # `sizes` is its own predicated start; rare terms move KBs while a
+    # frequent term in the same query moves its full row — no shared max-L.
+    for t in range(T):
+        nr = nrows_ref[t, q]
+        row_start = pl.multiple_of(rowstart_ref[t, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(t=t, s=s, row_start=row_start):
+                pltpu.make_async_copy(docs_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t]).start()
+                pltpu.make_async_copy(tfdl_hbm.at[pl.ds(row_start, s)],
+                                      tfdl_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t + 1]).start()
+    for t in range(T):
+        nr = nrows_ref[t, q]
+        row_start = pl.multiple_of(rowstart_ref[t, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(t=t, s=s, row_start=row_start):
+                pltpu.make_async_copy(docs_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t]).wait()
+                pltpu.make_async_copy(tfdl_hbm.at[pl.ds(row_start, s)],
+                                      tfdl_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t + 1]).wait()
+
+    # ---- decode + BM25 on the VPU (tails beyond each term's true length are
+    # masked by position, so un-DMA'd scratch garbage never contributes) ----
+    R = (T * L) // LANES
+    docs2 = docs_v[:].reshape(R, LANES)
+    tfdl2 = tfdl_v[:].reshape(R, LANES)
+    rows, lanes = _ids((R, LANES))
+    term_of_row = rows // rows_per_term
+    pos_in_term = (rows % rows_per_term) * LANES + lanes
+
+    w_row = jnp.zeros((R, LANES), jnp.float32)
+    len_row = jnp.zeros((R, LANES), jnp.int32)
+    for t in range(T):
+        sel = term_of_row == t
+        w_row = jnp.where(sel, weights_ref[t, q], w_row)
+        len_row = jnp.where(sel, lens_ref[t, q], len_row)
+    # doc-range window: oversized posting rows are split by the host into
+    # virtual sub-queries over disjoint [dlo, dhi) doc ranges (DMA windows
+    # align down to 1024 elements and spill a prefix of smaller doc ids).
+    # The merge network needs each slot ASCENDING, so below-range docs map to
+    # a NEGATIVE sentinel (front of the run, excluded at the end) — mapping
+    # them to +sentinel would break sortedness and split dedup runs.
+    dlo = dlo_ref[0, q]
+    dhi = dhi_ref[0, q]
+    in_pos = pos_in_term < len_row
+    valid = in_pos & (docs2 >= dlo) & (docs2 < dhi)
+    keys = jnp.where(in_pos & (docs2 < dlo), NEG_SENTINEL,
+                     jnp.where(valid, docs2, INT_SENTINEL))
+
+    tf = (tfdl2 >> DL_BITS).astype(jnp.float32)
+    dl = (tfdl2 & DL_MASK).astype(jnp.float32)
+    avgdl = avgdl_ref[0, q]
+    # EXACTLY the XLA path's expression (ops/scoring.py posting_contrib,
+    # SIM_BM25) so both paths agree bit-for-bit per posting
+    k = k1 * (1.0 - b + b * dl / avgdl)
+    contrib = jnp.where(valid, w_row * tf / (tf + k), 0.0)
+
+    # ---- merge the T doc-sorted runs (each of length L) ----
+    half = L
+    while half < T * L:
+        keys, contrib = _merge_pairs(keys, contrib, half)
+        half *= 2
+
+    # ---- dedup: runs of equal doc have length <= T ----
+    score = contrib
+    kk = keys
+    cc = contrib
+    count = jnp.ones((R, LANES), jnp.float32)
+    for _ in range(T - 1):
+        kk = _flat_shift_down(kk, INT_SENTINEL)
+        cc = _flat_shift_down(cc, 0.0)
+        eq = (kk == keys) & (keys < INT_SENTINEL)
+        score = score + jnp.where(eq, cc, 0.0)
+        count = count + jnp.where(eq, 1.0, 0.0)
+    knext = _flat_shift_up(keys, INT_SENTINEL)
+    is_last = (knext != keys) & (keys < INT_SENTINEL) & (keys > NEG_SENTINEL)
+    msm = msm_ref[0, q]
+    final = jnp.where(is_last & (count >= msm), score, NEG_INF)
+
+    total = jnp.sum((final > NEG_INF).astype(jnp.int32))
+    out_totals[q, :] = jnp.full((LANES,), total, jnp.int32)
+
+    # ---- iterative top-K extraction ----
+    acc_s = jnp.full((1, LANES), NEG_INF, jnp.float32)
+    acc_d = jnp.full((1, LANES), -1, jnp.int32)
+    out_lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    for j in range(K):
+        best = jnp.max(final)
+        sel = final == best
+        bdoc = jnp.min(jnp.where(sel, keys, INT_SENTINEL))
+        got = best > NEG_INF
+        best_or = jnp.where(got, best, NEG_INF)
+        bdoc_or = jnp.where(got, bdoc, -1)
+        hit = out_lane == j
+        acc_s = jnp.where(hit, best_or, acc_s)
+        acc_d = jnp.where(hit, bdoc_or, acc_d)
+        final = jnp.where(sel & (keys == bdoc), NEG_INF, final)
+    out_scores[q, :] = acc_s[0]
+    out_docs[q, :] = acc_d[0]
+
+
+@functools.partial(jax.jit, static_argnames=("T", "L", "K", "k1", "b"))
+def fused_bm25_topk_tfdl(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
+                         rowstarts: jnp.ndarray, nrows: jnp.ndarray,
+                         lens: jnp.ndarray, weights: jnp.ndarray,
+                         msm: jnp.ndarray, avgdl: jnp.ndarray,
+                         dlo: jnp.ndarray, dhi: jnp.ndarray,
+                         T: int, L: int, K: int, k1: float, b: float):
+    """Batched fused BM25 top-k over packed (tf, dl) postings.
+
+    docs_hbm  i32[P] — doc ids, CSR-flat, rows 1024-element aligned
+    tfdl_hbm  i32[P] — tf << DL_BITS | dl per posting (lossless)
+    rowstarts i32[QB, T] — aligned row starts in 128-lane ROW units
+    nrows     i32[QB, T] — pow2 rows to DMA per term (0 = absent)
+    lens      i32[QB, T] — true posting counts (element units)
+    weights   f32[QB, T] — query-time idf * boost
+    msm       f32[QB, 1] — minimum matching terms
+    avgdl     f32[QB, 1] — query-time average doc length scalar
+    dlo/dhi   i32[QB, 1] — doc-id window [dlo, dhi) (0, INT_MAX = whole)
+    k1, b     static similarity params (b already zeroed when norms are off)
+    Returns (scores f32[QB, 128], doc_ids i32[QB, 128], totals i32[QB, 128]).
+    """
+    QB = rowstarts.shape[0]
+    rowstarts = rowstarts.T
+    nrows = nrows.T
+    lens = lens.T
+    weights = weights.T
+    msm = msm.T
+    avgdl = avgdl.T
+    dlo = dlo.T
+    dhi = dhi.T
+    assert docs_hbm.shape[0] % LANES == 0
+    docs_hbm = docs_hbm.reshape(-1, LANES)
+    tfdl_hbm = tfdl_hbm.reshape(-1, LANES)
+    min_rows = HBM_ALIGN // LANES
+    sizes = []
+    s = min_rows
+    while s <= L // LANES:
+        sizes.append(s)
+        s *= 2
+    kernel = functools.partial(_bm25_tfdl_kernel, T, L, K, float(k1), float(b),
+                               tuple(sizes))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(QB,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
+            pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
+            pltpu.SemaphoreType.DMA((2 * T,)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((QB, LANES), jnp.float32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+    ]
+    scores, doc_ids, totals = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(rowstarts, nrows, lens, weights, msm, avgdl, dlo, dhi,
+      docs_hbm, tfdl_hbm)
+    return scores, doc_ids, totals
+
+
 def align_csr_rows(starts: np.ndarray, doc_ids: np.ndarray, *vals: np.ndarray,
                    margin: int, alignment: int = HBM_ALIGN):
     """Re-pack CSR postings so every row begins at a 128-aligned offset
@@ -333,7 +550,7 @@ def align_csr_rows(starts: np.ndarray, doc_ids: np.ndarray, *vals: np.ndarray,
     new_docs[dst] = doc_ids
     out_vals = []
     for v in vals:
-        nv = np.zeros(total, dtype=np.float32)
+        nv = np.zeros(total, dtype=v.dtype)
         nv[dst] = v
         out_vals.append(nv)
     return (new_starts, new_docs, *out_vals)

@@ -347,12 +347,25 @@ class RestClient:
         return resp
 
     def msearch(self, body: List[dict], index: Optional[str] = None) -> dict:
-        responses = []
+        pairs = []
         i = 0
         while i < len(body):
             header = body[i]; i += 1
             search_body = body[i]; i += 1
-            idx = header.get("index", index or "_all")
+            pairs.append((header.get("index", index or "_all"), search_body))
+        # batched TPU path: one index expression, all bodies fast-path
+        # eligible -> grouped Pallas kernel launches (grid over queries)
+        if pairs and len({idx for idx, _ in pairs}) == 1:
+            try:
+                resps = self.node.msearch(pairs[0][0],
+                                          [b for _, b in pairs])
+            except (dsl.QueryParseError, IndexNotFoundError, KeyError,
+                    TypeError, ValueError):
+                resps = None
+            if resps is not None:
+                return {"took": 0, "responses": resps}
+        responses = []
+        for idx, search_body in pairs:
             try:
                 responses.append(self.search(idx, search_body))
             except (ApiError, IndexNotFoundError) as e:

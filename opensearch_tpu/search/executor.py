@@ -22,6 +22,7 @@ from ..index.engine import Engine
 from ..index.segment import Segment, next_pow2
 from ..script.painless_lite import ScriptError as _ScriptError
 from . import compiler as C
+from . import fastpath
 from . import query_dsl as dsl
 from .aggregations import (AggNode, _apply_bucket_pipelines,
                            apply_pipelines_tree, finalize, merge_partials,
@@ -138,6 +139,14 @@ class ShardSearcher:
         result = ShardQueryResult(shard=shard_ord, segments=segments)
         ran_segs: List[Segment] = []
 
+        # Pallas fast path: plain BM25 term-group top-k goes through the
+        # fused kernel (search/fastpath.py); anything it can't serve falls
+        # back to the general XLA plan per segment
+        fast_ok = (fastpath.enabled()
+                   and fastpath.query_eligible(lroot, sort_specs, agg_nodes,
+                                               named_nodes, search_after,
+                                               window, body))
+
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
                 continue
@@ -146,6 +155,14 @@ class ShardSearcher:
                 # global/filter-family aggs see docs the query doesn't match,
                 # so ordinary agg trees still allow the skip
                 continue
+            if fast_ok:
+                fout = fastpath.segment_search(seg, ctx, lroot, window)
+                if fout is not None:
+                    ran_segs.append(seg)
+                    self._collect_topk(result, fout, seg, seg_ord, shard_ord,
+                                       sort_specs, rescores, min_score,
+                                       is_field_sort, ctx)
+                    continue
             if sort_specs and sort_specs[0]["field"] == "_script":
                 # script order is host-computed: collect the full segment
                 # window so the host re-sort sees every matching doc
@@ -231,6 +248,32 @@ class ShardSearcher:
         result.candidates = result.candidates[: window * oversample]
         result.took_ms = (time.monotonic() - t0) * 1000.0
         return result
+
+    def _collect_topk(self, result: ShardQueryResult, out: dict, seg: Segment,
+                      seg_ord: int, shard_ord: int, sort_specs, rescores,
+                      min_score, is_field_sort: bool, ctx) -> None:
+        """Fold one segment's top-k output (fast path) into the shard result —
+        the same bookkeeping the general path does inline."""
+        keys = np.asarray(out["topk_key"])
+        idx = np.asarray(out["topk_idx"])
+        scores = np.asarray(out["topk_scores"])
+        valid = keys > -np.inf
+        result.total += int(out["total"])
+        ms = float(out["max_score"])
+        if ms > result.max_score:
+            result.max_score = ms
+        if rescores:
+            scores = self._apply_rescores(rescores, ctx, seg, idx, valid, scores)
+        for j in np.nonzero(valid)[0]:
+            d = int(idx[j])
+            if d < 0 or d >= seg.ndocs:
+                continue
+            sc = float(scores[j])
+            if min_score is not None and not is_field_sort and sc < min_score:
+                continue
+            sort_vals, raw_vals = _host_sort_values(sort_specs, seg, d, sc)
+            result.candidates.append(
+                Candidate(shard_ord, seg_ord, d, sc, sort_vals, raw_vals))
 
     def _resample_samplers(self, agg_nodes, result: ShardQueryResult,
                            ran_segs: List[Segment], ctx, lroot) -> None:
@@ -564,6 +607,77 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     # bucket_selector/bucket_sort still prune BEFORE per-bucket refinement
     for an in agg_nodes:
         _mark_deferred_pipelines(an)
+    return _finish_search(searchers, results, body, stats, index_name, t0,
+                          agg_nodes)
+
+
+def msearch_batched(searchers: List[ShardSearcher],
+                    bodies: List[dict], index_name: str = ""
+                    ) -> Optional[List[dict]]:
+    """Batched msearch on the Pallas fast path: ALL bodies' term-group
+    queries over each segment run as ONE kernel launch per shape group (grid
+    over queries) — server-side query batching, the production shape of a TPU
+    search tier (reference analog: `action/search/TransportMultiSearchAction`
+    just loops; we fuse). Returns None when any body/segment is ineligible —
+    the caller falls back to sequential searches."""
+    if not fastpath.enabled() or not searchers:
+        return None
+    stats = _global_stats_contexts(searchers)
+    parsed = []
+    for body in bodies:
+        body = dict(body)
+        body["_index_name"] = index_name
+        if (body.get("aggs") or body.get("aggregations") or body.get("rescore")
+                or body.get("search_after") is not None or body.get("min_score")
+                is not None or body.get("profile")):
+            return None
+        query = dsl.parse_query(body.get("query"))
+        parsed.append((body, query, _norm_sort_specs(body),
+                       int(body.get("from", 0)) + int(body.get("size", 10))))
+
+    t0 = time.monotonic()
+    nb = len(bodies)
+    results = [[ShardQueryResult(shard=i, segments=list(s.engine.segments))
+                for i, s in enumerate(searchers)] for _ in range(nb)]
+    max_window = max((w for _, _, _, w in parsed), default=10)
+    for i, s in enumerate(searchers):
+        ctx = stats[i]
+        segments = list(s.engine.segments)
+        lroots = []
+        for body, query, sort_specs, window in parsed:
+            lroot = C.rewrite(query, ctx, scoring=True)
+            if not fastpath.query_eligible(lroot, sort_specs, [], [], None,
+                                           window, body):
+                return None
+            if _collect_named(lroot):
+                return None
+            lroots.append(lroot)
+        for seg_ord, seg in enumerate(segments):
+            if seg.live_count == 0:
+                continue
+            outs = fastpath.batch_search(seg, ctx, lroots, max_window)
+            if outs is None or any(o is None for o in outs):
+                return None
+            for bi, fout in enumerate(outs):
+                body, _, sort_specs, window = parsed[bi]
+                s._collect_topk(results[bi][i], fout, seg, seg_ord, i,
+                                sort_specs, None, None, False, ctx)
+        for bi, (body, _, sort_specs, window) in enumerate(parsed):
+            r = results[bi][i]
+            r.candidates.sort(key=lambda c: c.sort_values)
+            r.candidates = r.candidates[:window]
+            r.took_ms = (time.monotonic() - t0) * 1000.0
+    return [_finish_search(searchers, results[bi], parsed[bi][0], stats,
+                           index_name, t0, [])
+            for bi in range(nb)]
+
+
+def _finish_search(searchers: List[ShardSearcher],
+                   results: List[ShardQueryResult], body: dict, stats,
+                   index_name: str, t0: float,
+                   agg_nodes: List[AggNode]) -> dict:
+    """Coordinator reduce + fetch + response assembly (the tail of
+    query-then-fetch, shared by search and batched msearch)."""
     reduced = reduce_shard_results(results, body, agg_nodes=agg_nodes,
                                    defer_pipelines=bool(agg_nodes))
     by_shard: Dict[int, List[Candidate]] = {}

@@ -1,0 +1,362 @@
+"""Production Pallas fast path for the term/match hot path.
+
+Routes single-group BM25 term queries (term / terms / match / multi-term
+match with minimum_should_match — the traffic Lucene serves through
+BulkScorer, reference `search/query/QueryPhase.java`) through the fused
+Pallas kernel `ops/pallas_bm25.fused_bm25_topk_tfdl` instead of the XLA
+gather→scatter path. The XLA path stays as the general fallback for complex
+plans, segments with deletes, non-BM25 similarities, or posting rows larger
+than the VMEM bucket cap.
+
+Per (segment, field) we lazily build a DMA-friendly postings layout:
+1024-element-aligned CSR rows of (doc_id i32, tf<<21|dl i32). The packing is
+lossless (tf < 2048, dl < 2^21 — segments violating it are ineligible), and
+the kernel evaluates the SAME f32 BM25 expression as the XLA path with avgdl
+as a query-time scalar, so both paths rank identically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..index.segment import Segment, next_pow2
+from ..ops import scoring as ops
+from ..ops.pallas_bm25 import (DL_BITS, DL_MAX, HBM_ALIGN, LANES, TF_MAX,
+                               align_csr_rows, fused_bm25_topk_tfdl)
+
+MAX_T = 8            # pow2-padded term slots per query group
+MAX_L = 1 << 16      # per-term VMEM bucket cap (elements)
+MAX_TL = 1 << 17     # T_pad * L cap (~16MB VMEM incl. merge working set)
+MAX_K = 128          # top-k lanes the kernel returns
+MAX_CHUNKS = 64      # doc-range split bound for huge posting rows
+INT_MAX = np.int32(2**31 - 1)
+
+_enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
+
+# optional memory-accounting hook set by the Node (utils/breaker.py):
+# called with (nbytes, label) before aligned arrays go to device
+_breaker_hook = None
+
+
+def set_breaker_hook(fn) -> None:
+    global _breaker_hook
+    _breaker_hook = fn
+
+
+def set_enabled(flag: bool) -> None:
+    global _enabled
+    _enabled = flag
+
+
+_backend_ok = None
+
+
+def enabled() -> bool:
+    import os
+    global _backend_ok
+    if _backend_ok is None:
+        import jax
+        _backend_ok = jax.default_backend() == "tpu"
+    return (_enabled and _backend_ok
+            and not os.environ.get("OPENSEARCH_TPU_NO_FASTPATH"))
+
+
+class AlignedPostings:
+    """Device-resident aligned (doc, tf·dl) postings for one segment field."""
+
+    __slots__ = ("starts_rows", "lens", "d_docs", "d_tfdl", "nbytes")
+
+    def __init__(self, starts_rows: np.ndarray, lens: np.ndarray,
+                 d_docs, d_tfdl, nbytes: int):
+        self.starts_rows = starts_rows    # i64[nterms] aligned start / LANES
+        self.lens = lens                  # i64[nterms] true posting counts
+        self.d_docs = d_docs
+        self.d_tfdl = d_tfdl
+        self.nbytes = nbytes
+
+
+def get_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
+    """Build (or fetch cached) aligned postings; None when the segment is
+    ineligible (tf/dl exceed the lossless packing bounds, or no postings)."""
+    cache = seg.__dict__.setdefault("_fastpath_aligned", {})
+    if field in cache:
+        return cache[field]
+    out = _build_aligned(seg, field)
+    cache[field] = out
+    return out
+
+
+def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
+    import jax
+
+    pb = seg.postings.get(field)
+    dl = seg.doc_lens.get(field)
+    if pb is None or pb.size == 0:
+        return None
+    tfs = pb.tfs
+    if len(tfs) and tfs.max() > TF_MAX:
+        return None
+    dl_of = (dl[pb.doc_ids].astype(np.int64) if dl is not None
+             else np.zeros(len(pb.doc_ids), np.int64))
+    if len(dl_of) and dl_of.max() > DL_MAX:
+        return None
+    packed = ((tfs.astype(np.int64) << DL_BITS) | dl_of).astype(np.int32)
+    a_starts, a_docs, a_packed = align_csr_rows(
+        pb.starts, pb.doc_ids, packed, margin=MAX_L)
+    nbytes = a_docs.nbytes + a_packed.nbytes
+    if _breaker_hook is not None:
+        _breaker_hook(nbytes, f"fastpath[{seg.name}][{field}]")
+    lens = np.diff(pb.starts).astype(np.int64)
+    starts_rows = (a_starts[:-1] // LANES).astype(np.int64)
+    return AlignedPostings(starts_rows, lens,
+                           jax.device_put(a_docs), jax.device_put(a_packed),
+                           nbytes)
+
+
+def query_eligible(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
+                   search_after, window: int, body: dict) -> bool:
+    """Host-cheap check that this search is the plain BM25 top-k hot path."""
+    from . import compiler as C
+
+    if not isinstance(lroot, C.LTerms):
+        return False
+    lt = lroot
+    if lt.mode != "score" or lt.sim is None or lt.sim.sim_id != ops.SIM_BM25:
+        return False
+    nt = len(lt.terms)
+    if nt < 1 or next_pow2(nt, floor=1) > MAX_T:
+        return False
+    if lt.aux is not None and np.any(np.asarray(lt.aux)[:nt] != 0.0):
+        return False
+    if agg_nodes or named_nodes or search_after is not None:
+        return False
+    if window > MAX_K or window < 1:
+        return False
+    if sort_specs and not (len(sort_specs) == 1
+                           and sort_specs[0]["field"] == "_score"
+                           and sort_specs[0].get("order", "desc") == "desc"):
+        return False
+    if body.get("collapse") or body.get("suggest") or body.get("knn"):
+        return False
+    return True
+
+
+class _VQuery:
+    """One kernel-row: a whole query, or one doc-range chunk of it."""
+
+    __slots__ = ("qi", "T_pad", "L", "rowstarts", "nrows", "lens", "weights",
+                 "msm", "avgdl", "dlo", "dhi", "k1", "b_eff", "field")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def _chunk_slices(al: AlignedPostings, pb, rows: np.ndarray, ndocs: int
+                  ) -> Optional[List[np.ndarray]]:
+    """Split a query whose postings exceed the VMEM budget into doc-range
+    chunks: uniform doc-id edges, verified against exact per-(term, chunk)
+    posting counts (host searchsorted over the ORIGINAL CSR), doubling the
+    chunk count until every chunk fits. Returns per-chunk
+    [T, 4] = (rowstart_rows, nrows, lens, edge_lo) arrays via a list of
+    (dlo, dhi, rowstarts, nrows, lens) tuples; None -> fall back."""
+    T_pad = len(rows)
+    budget = MAX_TL // T_pad          # elements per term slot
+    nchunk = 2
+    while nchunk <= MAX_CHUNKS:
+        edges = np.linspace(0, ndocs, nchunk + 1).astype(np.int64)
+        edges[-1] = np.int64(2**31 - 1)
+        ok = True
+        per_chunk = []
+        for c in range(nchunk):
+            rowstarts = np.zeros(T_pad, np.int32)
+            nrows = np.zeros(T_pad, np.int32)
+            lens = np.zeros(T_pad, np.int32)
+            max_nr = HBM_ALIGN // LANES
+            for i, r in enumerate(rows):
+                if r < 0:
+                    continue
+                a, b = pb.row_slice(r)
+                seg_docs = pb.doc_ids[a:b]
+                lo_off = int(np.searchsorted(seg_docs, edges[c], "left"))
+                hi_off = int(np.searchsorted(seg_docs, edges[c + 1], "left"))
+                if hi_off == lo_off:
+                    continue
+                # align the DMA start down to the HBM tile; the doc-range
+                # window masks the spilled-in prefix
+                start_el = int(al.starts_rows[r]) * LANES
+                al_off = (lo_off // HBM_ALIGN) * HBM_ALIGN
+                ln = hi_off - al_off
+                if ln > budget:
+                    ok = False
+                    break
+                rowstarts[i] = (start_el + al_off) // LANES
+                nr = next_pow2((ln + LANES - 1) // LANES,
+                               floor=HBM_ALIGN // LANES)
+                nrows[i] = nr
+                lens[i] = ln
+                max_nr = max(max_nr, nr)
+            if not ok:
+                break
+            if T_pad * max_nr * LANES > MAX_TL:
+                ok = False
+                break
+            per_chunk.append((int(edges[c]), int(edges[c + 1]),
+                              rowstarts, nrows, lens))
+        if ok:
+            return per_chunk
+        nchunk *= 2
+    return None
+
+
+def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
+                      ) -> Optional[List[List[_VQuery]]]:
+    """-> per input query, its list of kernel rows (1 or NCHUNK); None entry
+    = that query falls back to the XLA path."""
+    out: List[Optional[List[_VQuery]]] = []
+    for qi, lt in enumerate(lts):
+        al = get_aligned(seg, lt.field)
+        pb = seg.postings.get(lt.field)
+        if al is None or pb is None:
+            out.append(None)
+            continue
+        nt = len(lt.terms)
+        T_pad = next_pow2(nt, floor=1)
+        rows = np.full(T_pad, -1, np.int64)
+        for i, t in enumerate(lt.terms):
+            rows[i] = pb.row(t)
+        weights = np.zeros(T_pad, np.float32)
+        weights[:nt] = np.asarray(lt.weights, np.float32)[:nt]
+        if lt.field not in avgdl_cache:
+            avgdl_cache[lt.field] = np.float32(ctx.avgdl(lt.field))
+        sim = lt.sim
+        b_eff = float(sim.b) if lt.has_norms else 0.0
+        common = dict(qi=qi, T_pad=T_pad, weights=weights,
+                      msm=float(lt.msm), avgdl=avgdl_cache[lt.field],
+                      k1=float(sim.k1), b_eff=b_eff, field=lt.field)
+
+        # single-launch case: every row fits the per-term bucket
+        min_rows = HBM_ALIGN // LANES
+        rowstarts = np.zeros(T_pad, np.int32)
+        nrows = np.zeros(T_pad, np.int32)
+        lens = np.zeros(T_pad, np.int32)
+        max_nr = min_rows
+        fits = True
+        for i, r in enumerate(rows):
+            if r < 0:
+                continue
+            ln = int(al.lens[r])
+            if ln == 0:
+                continue
+            if ln > MAX_L:
+                fits = False
+                break
+            rowstarts[i] = al.starts_rows[r]
+            nr = next_pow2((ln + LANES - 1) // LANES, floor=min_rows)
+            nrows[i] = nr
+            lens[i] = ln
+            max_nr = max(max_nr, nr)
+        if fits and T_pad * max_nr * LANES <= MAX_TL:
+            out.append([_VQuery(L=max_nr * LANES, rowstarts=rowstarts,
+                                nrows=nrows, lens=lens, dlo=0,
+                                dhi=int(INT_MAX), **common)])
+            continue
+
+        # oversized: doc-range chunk decomposition (each doc's postings live
+        # in exactly one chunk, so msm counting and score sums stay exact)
+        chunks = _chunk_slices(al, pb, rows, seg.ndocs)
+        if chunks is None:
+            out.append(None)
+            continue
+        vqs = []
+        for dlo, dhi, rowstarts, nrows, lens in chunks:
+            L = int(max(nrows.max(), min_rows)) * LANES
+            vqs.append(_VQuery(L=L, rowstarts=rowstarts, nrows=nrows,
+                               lens=lens, dlo=dlo, dhi=dhi, **common))
+        out.append(vqs)
+    return out
+
+
+def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
+                  K: int) -> List[Optional[dict]]:
+    """Group all kernel rows by shape, launch once per group, reassemble
+    per-query results (chunked queries merge their chunk top-Ks on host)."""
+    groups = {}
+    for vqs in vq_lists:
+        if vqs is None:
+            continue
+        for vq in vqs:
+            groups.setdefault((vq.field, vq.T_pad, vq.k1, vq.b_eff),
+                              []).append(vq)
+    results = {}   # id(vq) -> (scores, docs, total)
+    for (field, T_pad, k1, b_eff), vqs in groups.items():
+        al = get_aligned(seg, field)
+        # sub-group by L bucket so rare-term queries don't pay a frequent
+        # term's VPU width
+        by_l = {}
+        for vq in vqs:
+            by_l.setdefault(vq.L, []).append(vq)
+        for L, gvqs in by_l.items():
+            QB = len(gvqs)
+            rowstarts = np.stack([v.rowstarts for v in gvqs])
+            nrows = np.stack([v.nrows for v in gvqs])
+            lens = np.stack([v.lens for v in gvqs])
+            weights = np.stack([v.weights for v in gvqs])
+            msm = np.array([[v.msm] for v in gvqs], np.float32)
+            avg = np.array([[v.avgdl] for v in gvqs], np.float32)
+            dlo = np.array([[v.dlo] for v in gvqs], np.int32)
+            dhi = np.array([[v.dhi] for v in gvqs], np.int32)
+            scores, docs, totals = fused_bm25_topk_tfdl(
+                al.d_docs, al.d_tfdl, rowstarts, nrows, lens, weights,
+                msm, avg, dlo, dhi, T=T_pad, L=L, K=K, k1=k1, b=b_eff)
+            scores = np.asarray(scores)
+            docs = np.asarray(docs)
+            totals = np.asarray(totals)
+            for j, vq in enumerate(gvqs):
+                results[id(vq)] = (scores[j][:K], docs[j][:K],
+                                   int(totals[j][0]))
+    out: List[Optional[dict]] = []
+    for vqs in vq_lists:
+        if vqs is None:
+            out.append(None)
+            continue
+        if len(vqs) == 1:
+            sc, dc, total = results[id(vqs[0])]
+        else:
+            parts = [results[id(v)] for v in vqs]
+            sc_all = np.concatenate([p[0] for p in parts])
+            dc_all = np.concatenate([p[1] for p in parts])
+            total = sum(p[2] for p in parts)
+            # stable merge: score desc, doc asc on ties (matches the kernel)
+            order = np.lexsort((dc_all, -sc_all))[:K]
+            sc = sc_all[order]
+            dc = dc_all[order]
+        total_i = int(total)
+        ms = float(sc[0]) if total_i > 0 and np.isfinite(sc[0]) else -np.inf
+        out.append({"topk_key": sc, "topk_idx": dc, "topk_scores": sc,
+                    "total": total_i, "max_score": ms})
+    return out
+
+
+def segment_search(seg: Segment, ctx, lt, k: int) -> Optional[dict]:
+    """Run the fused kernel for LTerms `lt` over one segment. Returns a dict
+    shaped like compiler.run_segment output, or None to fall back."""
+    res = batch_search(seg, ctx, [lt], k)
+    return res[0] if res else None
+
+
+def batch_search(seg: Segment, ctx, lts: Sequence, k: int
+                 ) -> Optional[List[Optional[dict]]]:
+    """Many LTerms over ONE segment in as few kernel launches as possible
+    (grid over queries — the server-side query batching a TPU search tier
+    runs on). Oversized posting rows split into doc-range chunks that ride
+    the same launches. Per-query fallbacks are None entries."""
+    if seg.live_count != seg.ndocs:
+        return None
+    vq_lists = _prepare_vqueries(seg, ctx, lts, {})
+    if vq_lists is None:
+        return None
+    K = min(next_pow2(max(k, 16)), MAX_K)
+    return _run_vqueries(seg, vq_lists, K)

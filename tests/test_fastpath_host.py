@@ -1,0 +1,147 @@
+"""Host-side fast-path logic (search/fastpath.py) that runs without a TPU:
+aligned-layout construction, doc-range chunk decomposition invariants, and
+eligibility gating. Kernel-vs-XLA parity runs on real TPU in
+tests_tpu/test_fastpath.py."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.ops.pallas_bm25 import (DL_BITS, DL_MASK, HBM_ALIGN,
+                                            LANES, align_csr_rows)
+from opensearch_tpu.search import compiler as C
+from opensearch_tpu.search import fastpath
+from opensearch_tpu.search import query_dsl as dsl
+from opensearch_tpu.search.executor import ShardSearcher
+
+
+@pytest.fixture(scope="module")
+def seg_ctx():
+    rng = np.random.default_rng(7)
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    eng = Engine(m)
+    for i in range(5000):
+        parts = []
+        if rng.random() < 0.7:
+            parts.append("common")
+        parts.append(f"rare{int(rng.integers(0, 200))}")
+        eng.index_doc(str(i), {"body": " ".join(parts)})
+    eng.refresh()
+    eng.force_merge(1)
+    s = ShardSearcher(eng)
+    return eng.segments[0], s.context()
+
+
+def _lterms(ctx, text, field="body"):
+    q = dsl.parse_query({"match": {field: text}})
+    node = C.rewrite(q, ctx, scoring=True)
+    assert isinstance(node, C.LTerms)
+    return node
+
+
+class TestAlignedLayout:
+    def test_rows_aligned_and_lossless(self, seg_ctx):
+        seg, ctx = seg_ctx
+        al = fastpath.get_aligned(seg, "body")
+        assert al is not None
+        pb = seg.postings["body"]
+        docs = np.asarray(al.d_docs)
+        tfdl = np.asarray(al.d_tfdl)
+        dl = seg.doc_lens["body"]
+        for term in ("common", "rare3"):
+            r = pb.row(term)
+            a, b = pb.row_slice(r)
+            start = int(al.starts_rows[r]) * LANES
+            assert start % HBM_ALIGN == 0
+            n = b - a
+            assert int(al.lens[r]) == n
+            np.testing.assert_array_equal(docs[start: start + n],
+                                          pb.doc_ids[a:b])
+            got_tf = tfdl[start: start + n] >> DL_BITS
+            got_dl = tfdl[start: start + n] & DL_MASK
+            np.testing.assert_array_equal(got_tf, pb.tfs[a:b].astype(np.int64))
+            np.testing.assert_array_equal(got_dl, dl[pb.doc_ids[a:b]])
+
+    def test_align_csr_rows_preserves_dtype(self):
+        starts = np.array([0, 3, 5], np.int64)
+        docs = np.array([1, 5, 9, 2, 4], np.int32)
+        vals_i = np.array([10, 20, 30, 40, 50], np.int32)
+        ns, nd, nv = align_csr_rows(starts, docs, vals_i, margin=1024)
+        assert nv.dtype == np.int32
+        assert ns[1] % HBM_ALIGN == 0
+
+
+class TestChunkDecomposition:
+    def test_small_query_single_vquery(self, seg_ctx):
+        seg, ctx = seg_ctx
+        lt = _lterms(ctx, "rare3 rare5")
+        vls = fastpath._prepare_vqueries(seg, ctx, [lt], {})
+        assert vls is not None and len(vls[0]) == 1
+        vq = vls[0][0]
+        assert vq.dlo == 0 and vq.dhi == int(fastpath.INT_MAX)
+
+    def test_oversized_chunks_partition_doc_space(self, seg_ctx):
+        seg, ctx = seg_ctx
+        pb = seg.postings["body"]
+        al = fastpath.get_aligned(seg, "body")
+        lt = _lterms(ctx, "common rare3")
+        rows = np.array([pb.row("common"), pb.row("rare3")], np.int64)
+        # force chunking regardless of corpus size (budget must stay above
+        # the 1024-element DMA alignment slop per chunk)
+        old_l, old_tl = fastpath.MAX_L, fastpath.MAX_TL
+        fastpath.MAX_L, fastpath.MAX_TL = 1 << 12, 1 << 13
+        try:
+            chunks = fastpath._chunk_slices(al, pb, rows, seg.ndocs)
+        finally:
+            fastpath.MAX_L, fastpath.MAX_TL = old_l, old_tl
+        assert chunks is not None and len(chunks) >= 2
+        # doc ranges tile [0, ndocs) without gap or overlap
+        assert chunks[0][0] == 0
+        for (lo1, hi1, *_), (lo2, hi2, *_) in zip(chunks, chunks[1:]):
+            assert hi1 == lo2
+        assert chunks[-1][1] >= seg.ndocs
+        # every chunk's DMA start is tile-aligned and the postings of each
+        # term are fully covered across chunks
+        covered = {i: 0 for i in range(len(rows))}
+        for lo, hi, rowstarts, nrows, lens in chunks:
+            for i, r in enumerate(rows):
+                if lens[i] == 0:
+                    continue
+                assert (rowstarts[i] * LANES) % HBM_ALIGN == 0
+                assert nrows[i] * LANES >= lens[i]
+                a, b = pb.row_slice(r)
+                d = pb.doc_ids[a:b]
+                covered[i] += int(np.sum((d >= lo) & (d < hi)))
+        for i, r in enumerate(rows):
+            a, b = pb.row_slice(r)
+            assert covered[i] == b - a
+
+
+class TestEligibility:
+    def test_eligible_plain_match(self, seg_ctx):
+        seg, ctx = seg_ctx
+        lt = _lterms(ctx, "rare3 rare5")
+        assert fastpath.query_eligible(lt, [], [], [], None, 10, {})
+
+    def test_ineligible_shapes(self, seg_ctx):
+        seg, ctx = seg_ctx
+        lt = _lterms(ctx, "rare3")
+        assert not fastpath.query_eligible(lt, [], ["agg"], [], None, 10, {})
+        assert not fastpath.query_eligible(lt, [], [], ["nm"], None, 10, {})
+        assert not fastpath.query_eligible(lt, [], [], [], [1], 10, {})
+        assert not fastpath.query_eligible(
+            lt, [{"field": "price", "order": "asc"}], [], [], None, 10, {})
+        assert not fastpath.query_eligible(lt, [], [], [], None, 4096, {})
+        assert not fastpath.query_eligible(lt, [], [], [], None, 10,
+                                           {"collapse": {"field": "x"}})
+        # score-desc explicit sort is still the hot path
+        assert fastpath.query_eligible(
+            lt, [{"field": "_score", "order": "desc"}], [], [], None, 10, {})
+
+    def test_filter_mode_and_non_bm25_ineligible(self, seg_ctx):
+        seg, ctx = seg_ctx
+        lt = _lterms(ctx, "rare3")
+        import dataclasses
+        assert not fastpath.query_eligible(
+            dataclasses.replace(lt, mode="filter"), [], [], [], None, 10, {})
