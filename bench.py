@@ -1,16 +1,22 @@
-"""Benchmark: BM25 match-query throughput on one TPU chip vs a vectorized CPU
-baseline, on a synthetic MS-MARCO-shaped corpus (Zipf term distribution,
-~56 tokens/doc — see BASELINE.json config 1).
+"""Benchmark: BM25 match-query throughput THROUGH THE PRODUCT REST PATH on
+one TPU chip vs a vectorized CPU baseline, on a synthetic MS-MARCO-shaped
+corpus (Zipf term distribution, ~56 tokens/doc — BASELINE.json config 1;
+default BENCH_NDOCS=8_800_000 matches MS MARCO passage).
 
-The device path is the framework's flagship fused Pallas kernel
-(ops/pallas_bm25.py: async-DMA CSR posting ranges -> bitonic merge of the
-doc-sorted runs -> shift-add dedup -> iterative top-k), one grid step per
-query. The CPU baseline is a *vectorized numpy* scorer over the same CSR
-postings — a stronger baseline than Lucene's per-doc BulkScorer loop, so
-`vs_baseline` understates the advantage vs the reference.
+The measured path is `RestClient.msearch` end-to-end: DSL parse → plan
+rewrite → Pallas fused BM25 kernel (search/fastpath.py, grouped batched
+launches — the server-side query batching a TPU search tier runs) → shard
+reduce → fetch phase with `_id`/`_source` materialization. The CPU baseline
+is a *vectorized numpy* scorer over the same CSR postings — stronger than
+Lucene's per-doc BulkScorer loop (reference `search/query/QueryPhase.java`),
+so `vs_baseline` understates the advantage vs the reference.
+
+Corpus construction bypasses text analysis (the synthetic corpus IS its CSR
+postings; building 500M tokens of fake text to re-tokenize would bench the
+string generator), but everything from the query DSL inward is the product.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Env: BENCH_NDOCS (default 2_000_000), BENCH_QUERIES (default 256).
+Env: BENCH_NDOCS (default 8_800_000), BENCH_QUERIES (default 2048).
 """
 
 import json
@@ -35,7 +41,63 @@ def build_corpus(ndocs: int, vocab: int = 200_000, avg_dl: int = 56, seed: int =
     df_per_term = np.bincount(term_arr, minlength=vocab)
     starts = np.zeros(vocab + 1, dtype=np.int64)
     np.cumsum(df_per_term, out=starts[1:])
-    return starts, doc_ids, tfs, dl, df_per_term
+    # true per-doc token counts after tf rollup (dl = sum tf per doc)
+    true_dl = np.zeros(ndocs, np.int64)
+    np.add.at(true_dl, doc_ids, counts)
+    return starts, doc_ids, tfs, true_dl, df_per_term
+
+
+class _LazyIds:
+    """8.8M doc-id strings materialized on demand (fetch touches ~10/query)."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [str(j) for j in range(*i.indices(self.n))]
+        return str(i)
+
+
+class _LazySources:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"doc": int(i)}
+
+
+def make_index(client, starts, doc_ids, tfs, dl, vocab_strs):
+    """Wrap the synthetic CSR as a product Segment inside an index."""
+    from opensearch_tpu.index.segment import (PostingsBlock, Segment,
+                                              TextFieldStats)
+
+    ndocs = len(dl)
+    pb = PostingsBlock(
+        field="body", vocab=list(vocab_strs),
+        terms={t: i for i, t in enumerate(vocab_strs)},
+        starts=starts, doc_ids=doc_ids, tfs=tfs)
+    stats = TextFieldStats(doc_count=ndocs, sum_dl=int(dl.sum()))
+    seg = Segment(name="bench0", ndocs=ndocs, postings={"body": pb},
+                  numeric_cols={}, keyword_cols={}, geo_cols={},
+                  doc_lens={"body": dl}, text_stats={"body": stats},
+                  ids=[], sources=[])
+    seg.ids = _LazyIds(ndocs)
+    seg.sources = _LazySources(ndocs)
+    seg.id2doc = {}
+    seg.live = np.ones(ndocs, dtype=bool)
+    client.indices.create("bench", {"mappings": {"properties": {
+        "body": {"type": "text"}}}})
+    eng = client.node.indices["bench"].shards[0]
+    eng.segments = [seg]
+    client.node.indices["bench"].generation += 1
+    return seg
 
 
 def pick_queries(df_per_term, nq: int, seed: int = 1):
@@ -49,22 +111,24 @@ def pick_queries(df_per_term, nq: int, seed: int = 1):
 
 
 def main():
-    ndocs = int(os.environ.get("BENCH_NDOCS", 2_000_000))
-    nq = int(os.environ.get("BENCH_QUERIES", 256))
+    ndocs = int(os.environ.get("BENCH_NDOCS", 8_800_000))
+    nq = int(os.environ.get("BENCH_QUERIES", 2048))
     k = 10
 
     t0 = time.time()
     starts, doc_ids, tfs, dl, df_per_term = build_corpus(ndocs)
     queries = pick_queries(df_per_term, nq)
-    sum_dl = float(dl.sum())
-    avgdl = sum_dl / ndocs
-    n_total = float(ndocs)
-    idf = np.log1p((n_total - df_per_term + 0.5) / (df_per_term + 0.5)).astype(np.float32)
+    avgdl = float(dl.sum()) / ndocs
+    idf = np.log1p((float(ndocs) - df_per_term + 0.5)
+                   / (df_per_term + 0.5)).astype(np.float32)
     build_s = time.time() - t0
 
     # ---------------- CPU baseline (vectorized numpy) ----------------
+    # identical f32 expression to the product scorer (ops/scoring.py)
     k1, b = 1.2, 0.75
-    K_doc = (k1 * (1 - b + b * dl / avgdl)).astype(np.float32)
+    dl32 = dl.astype(np.float32)
+    K_doc = (k1 * (np.float32(1.0) - np.float32(b)
+                   + np.float32(b) * dl32 / np.float32(avgdl)))
 
     def cpu_query(q):
         scores = np.zeros(ndocs, np.float32)
@@ -73,68 +137,81 @@ def main():
             d = doc_ids[a:e]
             tf = tfs[a:e]
             np.add.at(scores, d, idf[t] * tf / (tf + K_doc[d]))
-        top = np.argpartition(scores, -k)[-k:]
-        return top[np.argsort(-scores[top])]
+        # ties break doc-ascending like Lucene's collector (and ours); use a
+        # slack partition so boundary ties resolve deterministically
+        kk = min(64, ndocs)
+        top = np.argpartition(scores, -kk)[-kk:]
+        order = np.lexsort((top, -scores[top]))
+        return top[order][:k], scores
 
     ncpu = min(nq, 64)
     t0 = time.time()
-    cpu_results = [cpu_query(q) for q in queries[:ncpu]]
+    cpu_results = []
+    cpu_score_arrays = []
+    for q in queries[:ncpu]:
+        top, scores = cpu_query(q)
+        cpu_results.append(top)
+        cpu_score_arrays.append(scores)
     cpu_s = time.time() - t0
     cpu_qps = ncpu / cpu_s
 
-    # ---------------- TPU path: fused Pallas BM25 top-k kernel ----------------
-    # (see opensearch_tpu/ops/pallas_bm25.py — DMA CSR ranges, bitonic-merge
-    # the doc-sorted runs, shift-add dedup, iterative top-k; no XLA
-    # gather/scatter/sort, which all serialize on TPU)
-    import jax
+    # ---------------- TPU product path: RestClient.msearch ----------------
+    from opensearch_tpu.rest.client import RestClient
 
-    from opensearch_tpu.ops.pallas_bm25 import align_csr_rows, fused_bm25_topk
+    vocab_strs = [f"t{i:07d}" for i in range(len(df_per_term))]
+    client = RestClient()
+    make_index(client, starts, doc_ids, tfs, dl, vocab_strs)
 
-    dev = jax.devices()[0]
-    # eager impacts (BM25S-style): tf/(tf + K_doc) precomputed at index time
-    impacts = (tfs / (tfs + K_doc[doc_ids])).astype(np.float32)
-    T, K = 2, k
-    L = 1 << int(np.ceil(np.log2(max(int((starts[queries + 1] - starts[queries]).max()),
-                                     1024))))
-    a_starts, a_docs, a_imp = align_csr_rows(starts, doc_ids, impacts, margin=L)
-    d_docs = jax.device_put(a_docs, dev)
-    d_imp = jax.device_put(a_imp, dev)
-    qs = jax.device_put(a_starts[queries].astype(np.int32), dev)
-    ql = jax.device_put((starts[queries + 1] - starts[queries]).astype(np.int32), dev)
-    qw = jax.device_put(idf[queries], dev)
-    msm = jax.device_put(np.ones((nq, 1), np.float32), dev)
+    def msearch_bodies(qs, tag):
+        out = []
+        for i, q in enumerate(qs):
+            out.append({"index": "bench"})
+            out.append({"query": {"match": {
+                "body": f"{vocab_strs[q[0]]} {vocab_strs[q[1]]}"}},
+                "size": k, "_bench": f"{tag}{i}"})
+        return out
 
-    # NOTE on timing: this chip sits behind a tunnel with ~70ms per
-    # host<->device round trip. All queries are staged on device and scored
-    # in ONE kernel launch (grid over queries) — the same shape a production
-    # TPU search tier uses (server-side query batching).
-    _ = np.asarray(fused_bm25_topk(d_docs, d_imp, qs, ql, qw, msm, T=T, L=L, K=K)[1])
+    # warmup: compile each (T, L) kernel bucket
+    warm = client.msearch(msearch_bodies(queries[:8], "w"))
+    assert all("hits" in r for r in warm["responses"]), warm["responses"][0]
 
     reps = 5
     t0 = time.time()
-    for _ in range(reps):
-        vals, idx, _tot = fused_bm25_topk(d_docs, d_imp, qs, ql, qw, msm, T=T, L=L, K=K)
-    results_flat = np.asarray(idx)[:, :k]
+    for rep in range(reps):
+        resp = client.msearch(msearch_bodies(queries, f"r{rep}-"))
     wall = time.time() - t0
     qps = (reps * nq) / wall
-    batch_p50 = wall / reps
+    responses = resp["responses"]
 
-    # recall@10 parity vs CPU baseline on the overlap
-    tpu_all = results_flat
-    overlap = min(len(cpu_results), len(tpu_all))
-    recall = np.mean([len(set(cpu_results[i]) & set(tpu_all[i])) / k
-                      for i in range(overlap)])
+    # recall@10 vs the CPU baseline. TPU f32 division is not IEEE-exact
+    # (~1 ulp), so docs whose CPU scores tie the k-th score to 1e-5 are
+    # interchangeable top-k members — count those as correct (tie-aware),
+    # and report the strict set overlap alongside.
+    tpu_ids = [[int(h["_id"]) for h in r["hits"]["hits"]] for r in responses]
+    tie_ok, strict = [], []
+    for i in range(ncpu):
+        cpu_set = set(int(d) for d in cpu_results[i])
+        scores = cpu_score_arrays[i]
+        kth = scores[cpu_results[i][-1]]
+        good = sum(1 for d in tpu_ids[i]
+                   if d in cpu_set or scores[d] >= kth - 1e-5 * max(kth, 1.0))
+        tie_ok.append(good / k)
+        strict.append(len(cpu_set & set(tpu_ids[i])) / k)
+    recall = float(np.mean(tie_ok))
+    recall_strict = float(np.mean(strict))
 
     print(json.dumps({
-        "metric": "bm25_qps_per_chip",
+        "metric": "bm25_rest_qps_per_chip",
         "value": round(qps, 2),
         "unit": "queries/sec",
         "vs_baseline": round(qps / cpu_qps, 2),
-        "extra": {"ndocs": ndocs, "batch_ms_all_queries": round(batch_p50 * 1000, 2),
+        "extra": {"ndocs": ndocs, "batch_ms_all_queries": round(wall / reps * 1000, 2),
                   "cpu_qps": round(cpu_qps, 2),
-                  "recall_at_10_vs_cpu": round(float(recall), 4),
+                  "recall_at_10_vs_cpu": round(recall, 4),
+                  "recall_at_10_strict_sets": round(recall_strict, 4),
                   "corpus_build_s": round(build_s, 1),
-                  "postings": int(len(doc_ids)), "L": L},
+                  "postings": int(len(doc_ids)),
+                  "path": "RestClient.msearch -> fastpath Pallas kernel"},
     }))
 
 

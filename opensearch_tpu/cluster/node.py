@@ -125,7 +125,8 @@ class RequestCache:
 
 class Node:
     def __init__(self, data_path: Optional[str] = None,
-                 cluster_name: str = "opensearch-tpu", node_name: str = "node-0"):
+                 cluster_name: str = "opensearch-tpu", node_name: str = "node-0",
+                 mesh_service=None):
         self.metadata = ClusterMetadata(cluster_name)
         self.node_name = node_name
         self.data_path = data_path
@@ -133,6 +134,20 @@ class Node:
         self.ingest = IngestService()
         self.breakers = BreakerService()
         self.request_cache = RequestCache()
+        # SPMD mesh dispatch (parallel/service.py): pass a MeshSearchService
+        # or set OPENSEARCH_TPU_MESH=1 to auto-build one over jax.devices();
+        # eligible searches then run the distributed program with host-loop
+        # fallback
+        if mesh_service is None and os.environ.get("OPENSEARCH_TPU_MESH"):
+            from ..parallel.service import MeshSearchService
+            mesh_service = MeshSearchService()
+        self.mesh_service = mesh_service
+        # account fast-path aligned postings (device HBM) against the
+        # fielddata breaker (charged at build, released at segment GC);
+        # module-level = one breaker per process, matching the
+        # one-device-per-process reality
+        from ..search import fastpath
+        fastpath.set_breaker(self.breakers.breaker("fielddata"))
         self.start_time = time.time()
         if data_path:
             os.makedirs(data_path, exist_ok=True)
@@ -329,7 +344,12 @@ class Node:
             cached = self.request_cache.get(cache_key)
             if cached is not None:
                 return cached
-        resp = search_shards(searchers, body, index_name=",".join(names))
+        resp = None
+        if self.mesh_service is not None and len(names) == 1:
+            resp = self.mesh_service.try_search(names[0],
+                                                self.indices[names[0]], body)
+        if resp is None:
+            resp = search_shards(searchers, body, index_name=",".join(names))
         # stamp per-hit index names
         by_searcher = {}
         for name in names:
@@ -358,10 +378,13 @@ class Node:
         return resps
 
     def stats(self) -> dict:
-        return {
+        out = {
             "cluster_name": self.metadata.cluster_name,
             "indices": {n: svc.stats() for n, svc in self.indices.items()},
             "breakers": self.breakers.stats(),
             "request_cache": self.request_cache.stats(),
             "uptime_in_millis": int((time.time() - self.start_time) * 1000),
         }
+        if self.mesh_service is not None:
+            out["mesh"] = self.mesh_service.stats()
+        return out

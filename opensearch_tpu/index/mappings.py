@@ -518,6 +518,10 @@ class Mappings:
                     f"[{ft.dims}] for field [{name}]")
             parsed.vectors[name] = vec
             return
+        if ft.type == "completion":
+            # suggester-only field: lives in _source, served by the host-side
+            # prefix index (search/suggest.py completion_suggest)
+            return
         cv = coerce_value(ft, v)
         parsed.numerics.setdefault(name, []).append(cv)
         if ft.type == "ip" and ft.index:

@@ -400,7 +400,9 @@ def _bm25_tfdl_kernel(T: int, L: int, K: int, k1: float, b: float,
     keys = jnp.where(in_pos & (docs2 < dlo), NEG_SENTINEL,
                      jnp.where(valid, docs2, INT_SENTINEL))
 
-    tf = (tfdl2 >> DL_BITS).astype(jnp.float32)
+    # mask after the shift: tf >= 1024 sets the i32 sign bit and >> is
+    # arithmetic (sign-extending)
+    tf = ((tfdl2 >> DL_BITS) & TF_MAX).astype(jnp.float32)
     dl = (tfdl2 & DL_MASK).astype(jnp.float32)
     avgdl = avgdl_ref[0, q]
     # EXACTLY the XLA path's expression (ops/scoring.py posting_contrib,

@@ -280,6 +280,31 @@ def count_matches_sortmerge(docs: jnp.ndarray, msm=None) -> jnp.ndarray:
 
 # ---------------- top-k ----------------
 
+def collapse_topk(key: jnp.ndarray, matched: jnp.ndarray, live: jnp.ndarray,
+                  ords: jnp.ndarray, n_ord_pad: int, k: int):
+    """Field-collapsed top-k: one best doc per group ordinal (reference
+    `search/collapse/CollapseBuilder.java` + CollapsingTopDocsCollector).
+
+    Three dense passes, no sorting: scatter-max of the ranking key into group
+    space, top-k over groups, then scatter-min of doc ids restricted to each
+    group's best key (ties -> lowest doc id, like the plain collector).
+    Docs with ord < 0 (missing field) share one null group (last slot)."""
+    ndocs_pad = key.shape[0]
+    masked = jnp.where(matched & (live > 0), key, NEG_INF)
+    g = jnp.where(ords >= 0, ords, n_ord_pad - 1).astype(jnp.int32)
+    g = jnp.clip(g, 0, n_ord_pad - 1)
+    gbest = jnp.full(n_ord_pad, NEG_INF, jnp.float32).at[g].max(masked)
+    doc_iota = jnp.arange(ndocs_pad, dtype=jnp.int32)
+    valid = masked > NEG_INF
+    cand = jnp.where(valid & (masked == gbest[g]), doc_iota,
+                     jnp.int32(2**31 - 1))
+    gdoc = jnp.full(n_ord_pad, 2**31 - 1, jnp.int32).at[g].min(cand)
+    kk = min(k, n_ord_pad)
+    vals, gsel = jax.lax.top_k(gbest, kk)
+    docs = jnp.minimum(gdoc[gsel], ndocs_pad - 1)
+    return vals, docs
+
+
 def topk_docs(scores: jnp.ndarray, matched: jnp.ndarray, live: jnp.ndarray, k: int):
     """Masked fused top-k. Ties broken by ascending doc id like Lucene's
     TopScoreDocCollector (implemented by a tiny monotone doc-id epsilon that

@@ -1,5 +1,7 @@
+from .service import MeshSearchService
 from .spmd import (StackedShardIndex, build_distributed_search,
                    build_term_sharded_score, make_mesh, pack_query_batch)
 
-__all__ = ["StackedShardIndex", "build_distributed_search",
-           "build_term_sharded_score", "make_mesh", "pack_query_batch"]
+__all__ = ["MeshSearchService", "StackedShardIndex",
+           "build_distributed_search", "build_term_sharded_score",
+           "make_mesh", "pack_query_batch"]

@@ -1,0 +1,197 @@
+"""MeshSearchService: the SPMD mesh path wired into the Node's REST search.
+
+When a multi-device mesh is available (real TPU pod slice, or the virtual
+8-CPU-device test mesh), eligible term-group queries dispatch over
+`parallel/spmd.py`'s distributed program instead of the host shard loop:
+per-shard scoring runs SPMD over the `shard` mesh axis, collection stats
+(df, N, sum_dl) psum over ICI (device-side DFS phase), and per-shard top-ks
+merge with an all_gather — the reference's coordinator fan-out
+(`action/search/TransportSearchAction.java`,
+`action/search/SearchPhaseController.java`) without the transport layer.
+
+Fallback contract: `try_search` returns None whenever the query shape or
+index layout isn't mesh-ready (complex plans, multi-segment shards, window
+too deep), and the Node falls back to the host loop — identical results
+either way (asserted by tests/test_distributed.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..index.segment import next_pow2
+from .spmd import StackedShardIndex, build_distributed_search, make_mesh
+
+MAX_WINDOW = 128
+
+
+class MeshSearchService:
+    def __init__(self, devices: Optional[list] = None):
+        import jax
+        self.devices = list(devices) if devices is not None else jax.devices()
+        self._meshes: Dict[int, object] = {}
+        self._stacked: Dict[Tuple[str, str], Tuple[int, StackedShardIndex]] = {}
+        self._programs: Dict[Tuple, object] = {}
+        self.dispatched = 0      # searches served by the mesh
+        self.fallbacks = 0       # searches declined -> host loop
+
+    # ---------------- caches ----------------
+
+    def _mesh_for(self, n_shard: int):
+        if n_shard > len(self.devices):
+            return None
+        m = self._meshes.get(n_shard)
+        if m is None:
+            m = make_mesh(n_replica=1, n_shard=n_shard,
+                          devices=self.devices[:n_shard])
+            self._meshes[n_shard] = m
+        return m
+
+    def _stacked_for(self, name: str, svc, field: str, segments
+                     ) -> Optional[StackedShardIndex]:
+        key = (name, field)
+        cached = self._stacked.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        mesh = self._mesh_for(len(segments))
+        if mesh is None:
+            return None
+        stacked = StackedShardIndex.build(segments, field, mesh)
+        self._stacked[key] = (svc.generation, stacked)
+        return stacked
+
+    def _program_for(self, mesh, bucket: int, ndocs_pad: int, k: int,
+                     k1: float, b: float):
+        key = (id(mesh), bucket, ndocs_pad, k, k1, b)
+        fn = self._programs.get(key)
+        if fn is None:
+            fn = build_distributed_search(mesh, bucket=bucket,
+                                          ndocs_pad=ndocs_pad, k=k,
+                                          k1=k1, b=b)
+            self._programs[key] = fn
+        return fn
+
+    # ---------------- dispatch ----------------
+
+    def try_search(self, name: str, svc, body: dict) -> Optional[dict]:
+        """One index, one term-group query -> full search response via the
+        mesh, or None to fall back to the host shard loop."""
+        from ..search import compiler as C
+        from ..search import fastpath
+        from ..search import query_dsl as dsl
+        from ..search.executor import (Candidate, ShardQueryResult,
+                                       _finish_search, _global_stats_contexts,
+                                       _host_sort_values, _norm_sort_specs,
+                                       parse_aggs, _collect_named)
+
+        t0 = time.monotonic()
+        searchers = svc.searchers
+        # mesh-ready layout: every shard exactly one segment (steady state
+        # after refresh+merge; reference analog: one Lucene reader per shard)
+        segments = []
+        for s in searchers:
+            if len(s.engine.segments) != 1:
+                self.fallbacks += 1
+                return None
+            segments.append(s.engine.segments[0])
+        if not segments:
+            self.fallbacks += 1
+            return None
+
+        stats = _global_stats_contexts(searchers)
+        ctx = stats[0]
+        try:
+            query = dsl.parse_query(body.get("query"))
+        except dsl.QueryParseError:
+            self.fallbacks += 1
+            return None
+        if body.get("knn") or body.get("rescore") or body.get("min_score") \
+                is not None or body.get("profile"):
+            self.fallbacks += 1
+            return None
+        lroot = C.rewrite(query, ctx, scoring=True)
+        sort_specs = _norm_sort_specs(body)
+        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
+        window = int(body.get("from", 0)) + int(body.get("size", 10))
+        if not fastpath.query_eligible(lroot, sort_specs, agg_nodes,
+                                       _collect_named(lroot),
+                                       body.get("search_after"), window,
+                                       body):
+            self.fallbacks += 1
+            return None
+        lt = lroot
+        field = lt.field
+        if getattr(lt, "raw_boosts", None) is None:
+            self.fallbacks += 1
+            return None
+
+        stacked = self._stacked_for(name, svc, field, segments)
+        if stacked is None:
+            self.fallbacks += 1
+            return None
+
+        S = len(segments)
+        nt = len(lt.terms)
+        T_pad = next_pow2(nt, floor=1)
+        rows = np.full((S, 1, T_pad), -1, np.int32)
+        total_max = 1
+        for si, seg in enumerate(segments):
+            pb = seg.postings.get(field)
+            tot = 0
+            for ti, t in enumerate(lt.terms):
+                r = pb.row(t) if pb is not None else -1
+                rows[si, 0, ti] = r
+                if r >= 0:
+                    a, bnd = pb.row_slice(r)
+                    tot += bnd - a
+            total_max = max(total_max, tot)
+        bucket = next_pow2(total_max, floor=256)
+        boosts = np.zeros((1, T_pad), np.float32)
+        boosts[0, :nt] = lt.raw_boosts[:nt]
+        msm = np.full(1, float(lt.msm), np.float32)
+        K = min(next_pow2(max(window, 16)), MAX_WINDOW, stacked.ndocs_pad)
+        sim = lt.sim
+        b_eff = float(sim.b) if lt.has_norms else 0.0
+
+        mesh = self._mesh_for(S)
+        fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K,
+                               float(sim.k1), b_eff)
+        gdocs, gvals, totals = fn(stacked.tree(), rows, boosts, msm)
+        gdocs = np.asarray(gdocs)[0]
+        gvals = np.asarray(gvals)[0]
+        total = int(np.asarray(totals)[0])
+
+        # global doc ids -> (shard, local doc) -> candidates
+        doc_base = np.asarray(stacked.doc_base)
+        results = [ShardQueryResult(shard=i, segments=[segments[i]])
+                   for i in range(S)]
+        results[0].total = total
+        max_score = float(gvals[0]) if total > 0 and np.isfinite(gvals[0]) \
+            else -np.inf
+        results[0].max_score = max_score
+        for j in range(len(gdocs)):
+            if not np.isfinite(gvals[j]) or gdocs[j] < 0:
+                continue
+            si = int(np.searchsorted(doc_base, gdocs[j], "right") - 1)
+            local = int(gdocs[j] - doc_base[si])
+            seg = segments[si]
+            if local >= seg.ndocs:
+                continue
+            sc = float(gvals[j])
+            sort_vals, raw_vals = _host_sort_values(sort_specs, seg, local, sc)
+            results[si].candidates.append(
+                Candidate(si, 0, local, sc, sort_vals, raw_vals))
+        for r in results:
+            r.took_ms = (time.monotonic() - t0) * 1000.0
+        self.dispatched += 1
+        body = dict(body)
+        body["_index_name"] = name
+        return _finish_search(searchers, results, body, stats, name, t0, [])
+
+    def stats(self) -> dict:
+        return {"devices": len(self.devices), "dispatched": self.dispatched,
+                "fallbacks": self.fallbacks,
+                "stacked_indices": len(self._stacked)}

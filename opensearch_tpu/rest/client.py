@@ -20,6 +20,7 @@ from ..ingest.pipeline import DropDocument
 from ..search.executor import ShardSearcher, explain_doc, search_shards
 from ..search import compiler as C
 from ..search import query_dsl as dsl
+from ..utils.breaker import CircuitBreakingException
 
 
 class ApiError(Exception):
@@ -61,6 +62,7 @@ class RestClient:
         self.cat = CatClient(self)
         self._scrolls: Dict[str, dict] = {}
         self._pits: Dict[str, dict] = {}
+        self._stored_scripts: Dict[str, Any] = {}
 
     # ---------------- document APIs ----------------
 
@@ -246,6 +248,8 @@ class RestClient:
         except dsl.QueryParseError as e:
             # malformed DSL is a client error, not an engine crash
             raise ApiError(400, "parsing_exception", str(e))
+        except CircuitBreakingException as e:
+            raise ApiError(429, "circuit_breaking_exception", str(e))
         if scroll:
             sid = uuid.uuid4().hex
             names = self.node.metadata.resolve(index)
@@ -360,7 +364,9 @@ class RestClient:
                 resps = self.node.msearch(pairs[0][0],
                                           [b for _, b in pairs])
             except (dsl.QueryParseError, IndexNotFoundError, KeyError,
-                    TypeError, ValueError):
+                    TypeError, ValueError, CircuitBreakingException):
+                # fall back to the sequential loop, which maps per-body
+                # errors into per-response error objects
                 resps = None
             if resps is not None:
                 return {"took": 0, "responses": resps}
@@ -371,6 +377,91 @@ class RestClient:
             except (ApiError, IndexNotFoundError) as e:
                 responses.append({"error": {"type": type(e).__name__, "reason": str(e)}})
         return {"took": 0, "responses": responses}
+
+    # ---------------- search templates (reference modules/lang-mustache) ----
+
+    def put_script(self, id: str, body: dict) -> dict:
+        """PUT _scripts/{id}: store a search template / script."""
+        script = body.get("script", body)
+        self._stored_scripts[id] = script.get("source", script)
+        return {"acknowledged": True}
+
+    def get_script(self, id: str) -> dict:
+        src = self._stored_scripts.get(id)
+        if src is None:
+            raise ApiError(404, "resource_not_found_exception",
+                           f"unable to find script [{id}]")
+        return {"_id": id, "found": True,
+                "script": {"lang": "mustache", "source": src}}
+
+    def delete_script(self, id: str) -> dict:
+        if self._stored_scripts.pop(id, None) is None:
+            raise ApiError(404, "resource_not_found_exception",
+                           f"unable to find script [{id}]")
+        return {"acknowledged": True}
+
+    def _resolve_template(self, body: dict) -> dict:
+        from .templates import TemplateError, render_template
+        if body.get("id") is not None:
+            src = self._stored_scripts.get(body["id"])
+            if src is None:
+                raise ApiError(404, "resource_not_found_exception",
+                               f"unable to find script [{body['id']}]")
+        else:
+            src = body.get("source")
+            if src is None:
+                raise ApiError(400, "action_request_validation_exception",
+                               "template is missing")
+        try:
+            return render_template(src, body.get("params"))
+        except TemplateError as e:
+            raise ApiError(400, "parsing_exception", str(e))
+
+    def search_template(self, index: str = "_all",
+                        body: Optional[dict] = None) -> dict:
+        rendered = self._resolve_template(body or {})
+        return self.search(index, rendered)
+
+    def render_search_template(self, body: Optional[dict] = None) -> dict:
+        return {"template_output": self._resolve_template(body or {})}
+
+    def msearch_template(self, body: List[dict],
+                         index: Optional[str] = None) -> dict:
+        lines = []
+        i = 0
+        while i < len(body):
+            header = body[i]; i += 1
+            tmpl = body[i]; i += 1
+            lines.append(header)
+            try:
+                lines.append(self._resolve_template(tmpl))
+            except ApiError as e:
+                lines.append({"_template_error": str(e)})
+        msb = []
+        for j in range(0, len(lines), 2):
+            if "_template_error" not in lines[j + 1]:
+                msb += [lines[j], lines[j + 1]]
+        sub = self.msearch(msb, index=index)["responses"] if msb else []
+        responses = []
+        si = 0
+        for j in range(0, len(lines), 2):
+            if "_template_error" in lines[j + 1]:
+                responses.append({"error": {
+                    "type": "parsing_exception",
+                    "reason": lines[j + 1]["_template_error"]}})
+            else:
+                responses.append(sub[si])
+                si += 1
+        return {"took": 0, "responses": responses}
+
+    def rank_eval(self, index: str = "_all",
+                  body: Optional[dict] = None) -> dict:
+        """POST {index}/_rank_eval (reference modules/rank-eval)."""
+        from ..search.rank_eval import run_rank_eval
+        try:
+            return run_rank_eval(self, index, body or {})
+        except dsl.QueryParseError as e:
+            raise ApiError(400, "parsing_exception", str(e))
 
     def count(self, index: str = "_all", body: Optional[dict] = None) -> dict:
         body = dict(body or {})

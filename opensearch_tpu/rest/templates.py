@@ -1,0 +1,160 @@
+"""Search templates: mustache-lite rendering (reference
+`modules/lang-mustache/` — MustacheScriptEngine + TransportSearchTemplateAction).
+
+Supported syntax (the subset the reference's search-template docs exercise):
+- `{{var}}` / `{{a.b.c}}` — scalar substitution (JSON-encoded when not str)
+- `{{{var}}}` — raw substitution
+- `{{#toJson}}var{{/toJson}}` — JSON-dump a param
+- `{{#join}}var{{/join}}` — comma-join an array param
+- `{{#var}}...{{/var}}` — section: truthy scalar, dict scope, or list loop
+  (`{{.}}` is the loop element)
+- `{{^var}}...{{/var}}` — inverted section
+- `{{! comment}}`
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, List, Optional, Tuple
+
+
+class TemplateError(ValueError):
+    pass
+
+
+def _lookup(ctx_stack: List[Any], path: str):
+    if path == ".":
+        return ctx_stack[-1]
+    for ctx in reversed(ctx_stack):
+        cur = ctx
+        ok = True
+        for part in path.split("."):
+            if isinstance(cur, dict) and part in cur:
+                cur = cur[part]
+            else:
+                ok = False
+                break
+        if ok:
+            return cur
+    return None
+
+
+_TAG = re.compile(r"\{\{\{(.+?)\}\}\}|\{\{(.+?)\}\}", re.S)
+
+
+def _tokenize(src: str):
+    """-> list of ("text", s) | ("var"/"raw", name) | ("open"/"inv", name)
+    | ("close", name) | ("comment", _)."""
+    out = []
+    pos = 0
+    for m in _TAG.finditer(src):
+        if m.start() > pos:
+            out.append(("text", src[pos: m.start()]))
+        if m.group(1) is not None:
+            out.append(("raw", m.group(1).strip()))
+        else:
+            tag = m.group(2).strip()
+            if tag.startswith("#"):
+                out.append(("open", tag[1:].strip()))
+            elif tag.startswith("^"):
+                out.append(("inv", tag[1:].strip()))
+            elif tag.startswith("/"):
+                out.append(("close", tag[1:].strip()))
+            elif tag.startswith("!"):
+                out.append(("comment", ""))
+            elif tag.startswith("&"):
+                out.append(("raw", tag[1:].strip()))
+            else:
+                out.append(("var", tag))
+        pos = m.end()
+    if pos < len(src):
+        out.append(("text", src[pos:]))
+    return out
+
+
+def _parse_block(tokens, i: int, until: Optional[str]) -> Tuple[list, int]:
+    """-> (nodes, next_index); nodes: ("text", s) | ("var"/"raw", name) |
+    ("section", name, inverted, children)."""
+    nodes = []
+    while i < len(tokens):
+        kind, val = tokens[i]
+        if kind == "close":
+            if val != until:
+                raise TemplateError(f"mismatched close tag [{val}]")
+            return nodes, i + 1
+        if kind in ("open", "inv"):
+            children, i2 = _parse_block(tokens, i + 1, val)
+            nodes.append(("section", val, kind == "inv", children))
+            i = i2
+            continue
+        if kind != "comment":
+            nodes.append((kind, val))
+        i += 1
+    if until is not None:
+        raise TemplateError(f"unclosed section [{until}]")
+    return nodes, i
+
+
+def _stringify(v: Any, raw: bool) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, str):
+        return v if raw else json.dumps(v)[1:-1]
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (dict, list)):
+        return json.dumps(v)
+    return json.dumps(v)
+
+
+def _render_nodes(nodes, stack: List[Any]) -> str:
+    out = []
+    for node in nodes:
+        kind = node[0]
+        if kind == "text":
+            out.append(node[1])
+        elif kind in ("var", "raw"):
+            out.append(_stringify(_lookup(stack, node[1]), kind == "raw"))
+        else:
+            _, name, inverted, children = node
+            if name == "toJson":
+                inner = _render_nodes(children, stack).strip()
+                out.append(json.dumps(_lookup(stack, inner)))
+                continue
+            if name == "join":
+                inner = _render_nodes(children, stack).strip()
+                v = _lookup(stack, inner) or []
+                out.append(",".join(_stringify(x, True) for x in v))
+                continue
+            v = _lookup(stack, name)
+            truthy = bool(v) and v != []
+            if inverted:
+                if not truthy:
+                    out.append(_render_nodes(children, stack))
+            elif truthy:
+                if isinstance(v, list):
+                    for item in v:
+                        out.append(_render_nodes(children, stack + [item]))
+                elif isinstance(v, dict):
+                    out.append(_render_nodes(children, stack + [v]))
+                else:
+                    out.append(_render_nodes(children, stack))
+    return "".join(out)
+
+
+def render_template(source: Any, params: Optional[dict]) -> dict:
+    """Render a search template (string or dict source) + params -> the
+    search body dict."""
+    if isinstance(source, dict):
+        src = json.dumps(source)
+    else:
+        src = str(source)
+    tokens = _tokenize(src)
+    nodes, _ = _parse_block(tokens, 0, None)
+    rendered = _render_nodes(nodes, [params or {}])
+    try:
+        return json.loads(rendered)
+    except json.JSONDecodeError as e:
+        raise TemplateError(f"rendered template is not valid JSON: {e}: "
+                            f"{rendered[:200]}")

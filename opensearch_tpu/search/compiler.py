@@ -410,8 +410,12 @@ def _weighted_terms(field: str, terms: List[str], boosts: List[float],
         weights[i] = sim.term_weight(boosts[i] * boost, n, max(df, 0)) if df > 0 else 0.0
         if sim.sim_id == ops.SIM_LM_DIRICHLET:
             aux[i] = sim.term_aux(ctx.collection_tf(field, t), ctx.total_tf(field))
-    return LTerms(field=field, terms=terms, weights=weights, aux=aux, msm=msm,
+    node = LTerms(field=field, terms=terms, weights=weights, aux=aux, msm=msm,
                   mode=mode, sim=sim, has_norms=has_norms, boost=boost)
+    # raw (pre-idf) per-term boosts: the SPMD mesh path recomputes idf on
+    # device from psum'd global stats (parallel/spmd.py DFS phase)
+    node.raw_boosts = np.asarray([bi * boost for bi in boosts], np.float32)
+    return node
 
 
 def _prefix_rows(pb, term: str, cap: Optional[int] = None) -> range:
@@ -734,6 +738,9 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
                           score_mode=q.score_mode, boost_mode=q.boost_mode,
                           min_score=q.min_score, boost=q.boost)
 
+    if isinstance(q, dsl.MoreLikeThisQuery):
+        return _rewrite_mlt(q, ctx, scoring)
+
     if isinstance(q, dsl.NestedQuery):
         if q.path not in m.nested_paths:
             if q.ignore_unmapped:
@@ -819,6 +826,111 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
                           boost=q.boost)
 
     raise dsl.QueryParseError(f"cannot compile query {type(q).__name__}")
+
+
+def _rewrite_mlt(q: dsl.MoreLikeThisQuery, ctx: ShardContext,
+                 scoring: bool) -> LNode:
+    """more_like_this (reference `index/query/MoreLikeThisQueryBuilder.java`,
+    Lucene MoreLikeThis): gather term frequencies from the liked texts/docs,
+    rank candidate terms by tf·idf, keep the top `max_query_terms`, and
+    search them as a weighted OR (device term-group). Liked docs are excluded
+    via must_not ids unless `include`."""
+    fields = list(q.fields)
+    if not fields:
+        fields = [name for name, ft in ctx.mappings.fields.items()
+                  if ft.type == "text"]
+        if not fields:
+            return LMatchNone()
+    stop = set(q.stop_words)
+
+    def texts_of(like_item, liked_ids):
+        if isinstance(like_item, str):
+            return {f: [like_item] for f in fields}
+        # {"_id": ...} / {"doc": {...}} document reference
+        if isinstance(like_item, dict):
+            if "doc" in like_item:
+                src = like_item["doc"]
+            else:
+                did = like_item.get("_id")
+                if did is None:
+                    raise dsl.QueryParseError(
+                        "[more_like_this] like item needs text, [_id] or [doc]")
+                liked_ids.append(str(did))
+                src = None
+                for seg in ctx.segments:
+                    d = seg.id2doc.get(str(did))
+                    if d is not None and seg.live[d]:
+                        src = seg.sources[d]
+                        break
+                if src is None:
+                    return {}
+            out = {}
+            for f in fields:
+                v = src.get(f)
+                if isinstance(v, str):
+                    out[f] = [v]
+                elif isinstance(v, list):
+                    out[f] = [str(x) for x in v]
+            return out
+        raise dsl.QueryParseError("[more_like_this] invalid like item")
+
+    liked_ids: List[str] = []
+    tf_counts: Dict[Tuple[str, str], int] = {}
+    for item in q.like:
+        for f, texts in texts_of(item, liked_ids).items():
+            for text in texts:
+                for t in _analyze_query_text(f, text, ctx):
+                    tf_counts[(f, t)] = tf_counts.get((f, t), 0) + 1
+    skip: set = set()
+    for item in q.unlike:
+        for f, texts in texts_of(item, []).items():
+            for text in texts:
+                for t in _analyze_query_text(f, text, ctx):
+                    skip.add((f, t))
+
+    n = max(ctx.num_docs, 1)
+    scored = []
+    for (f, t), tf in tf_counts.items():
+        if (f, t) in skip or t in stop or tf < q.min_term_freq:
+            continue
+        if len(t) < q.min_word_length:
+            continue
+        if q.max_word_length and len(t) > q.max_word_length:
+            continue
+        df = ctx.doc_freq(f, t)
+        if df < q.min_doc_freq or df > q.max_doc_freq or df <= 0:
+            continue
+        idf = ops.bm25_idf(n, df)
+        scored.append((tf * idf, f, t))
+    scored.sort(key=lambda x: (-x[0], x[1], x[2]))
+    scored = scored[: q.max_query_terms]
+    if not scored:
+        return LMatchNone()
+    best = scored[0][0]
+    by_field: Dict[str, List[Tuple[str, float]]] = {}
+    for s, f, t in scored:
+        boost = (q.boost_terms * s / best) if q.boost_terms > 0 else 1.0
+        by_field.setdefault(f, []).append((t, boost))
+    msm_total = dsl.parse_minimum_should_match(q.minimum_should_match,
+                                               len(scored))
+    mode = "score" if scoring else "filter"
+    if len(by_field) == 1:
+        ((f, pairs),) = by_field.items()
+        node = _weighted_terms(f, [t for t, _ in pairs],
+                               [b for _, b in pairs], ctx,
+                               msm=max(msm_total, 1), mode=mode,
+                               boost=q.boost)
+    else:
+        # multi-field: one single-term group per clause so msm counts terms
+        # across fields exactly like the reference boolean query
+        shoulds = [
+            _weighted_terms(f, [t], [b], ctx, msm=1, mode=mode, boost=1.0)
+            for f, pairs in by_field.items() for t, b in pairs]
+        node = LBool(shoulds=shoulds, msm=max(msm_total, 1), boost=q.boost)
+    if liked_ids and not q.include:
+        return LBool(musts=[node], must_nots=[LIds(ids=liked_ids)],
+                     boost=1.0)
+    return node
 
 
 def _rewrite_rank_feature(q: dsl.RankFeatureQuery, ctx: ShardContext) -> LNode:
@@ -1310,6 +1422,9 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
                 field_srcs, pkeys = _prepare_script(ast, fn.script_params or {},
                                                     seg, params, nid, f"fn{i}s")
                 fn_specs.append(("script", i, ast, field_srcs, pkeys, fspec))
+            elif fn.kind == "decay":
+                fn_specs.append(_prepare_decay(fn, i, nid, seg, ctx, params,
+                                               fspec))
             else:
                 fn_specs.append(("weight", i, fspec))
         _scalar_f32(params, f"q{nid}_boost", node.boost)
@@ -1450,6 +1565,87 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("geobox", nid, node.field, node.field in seg.geo_cols)
 
     raise TypeError(f"cannot prepare node {type(node).__name__}")
+
+
+def parse_distance_m(s) -> float:
+    """'10km' / '500m' / plain number (meters) -> meters (reference
+    `common/unit/DistanceUnit.java`); shares query_dsl's unit table."""
+    try:
+        return dsl._parse_distance(s)
+    except (ValueError, TypeError):
+        raise dsl.QueryParseError(f"invalid distance [{s}]")
+
+
+def _parse_time_ms(s) -> float:
+    """'10d' / '3h' / number (ms) -> milliseconds (decay scale/offset);
+    extends parse_interval_ms with fractional amounts and weeks."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    mm = re.fullmatch(r"\s*([\d.]+)\s*(ms|s|m|h|d|w)\s*", str(s))
+    if not mm:
+        raise dsl.QueryParseError(f"invalid time value [{s}]")
+    mult = {"ms": 1, "w": 7 * 86_400_000}.get(mm.group(2)) or \
+        _FIXED_MS[mm.group(2)]
+    return float(mm.group(1)) * mult
+
+
+def _prepare_decay(fn, i: int, nid: int, seg: Segment, ctx: ShardContext,
+                   params: dict, fspec):
+    """Host-side resolution of a gauss/exp/linear decay function: parse
+    origin/scale/offset per field family and bake the shape constant so the
+    device evaluates one exp()/mul per doc (reference
+    `functionscore/DecayFunctionBuilder.java`). Missing values decay to 1."""
+    import math as _math
+    import time as _time
+
+    from ..index.mappings import _parse_date
+
+    field = ctx.mappings.aliases.get(fn.field, fn.field)
+    ft = ctx.mappings.resolve_field(field)
+    ftype = ft.type if ft is not None else "float"
+    shape = fn.decay_shape
+    try:
+        if field in seg.geo_cols or ftype == "geo_point":
+            kind = "geo"
+            if fn.origin is None:
+                raise dsl.QueryParseError("[decay] geo requires [origin]")
+            lat, lon = dsl._parse_point(fn.origin)
+            scale = parse_distance_m(fn.scale)
+            offset = parse_distance_m(fn.offset or 0)
+            _scalar_f32(params, f"q{nid}_fn{i}_olat", lat)
+            _scalar_f32(params, f"q{nid}_fn{i}_olon", lon)
+        elif ftype == "date":
+            kind = "num"
+            origin = (float(_time.time() * 1000)
+                      if fn.origin in (None, "now")
+                      else float(_parse_date(fn.origin, ft.date_format
+                                             if ft is not None else None)))
+            scale = _parse_time_ms(fn.scale)
+            offset = _parse_time_ms(fn.offset or 0)
+            _scalar_f32(params, f"q{nid}_fn{i}_origin", origin)
+        else:
+            kind = "num"
+            if fn.origin is None:
+                raise dsl.QueryParseError("[decay] numeric requires [origin]")
+            scale = float(fn.scale)
+            offset = float(fn.offset or 0)
+            _scalar_f32(params, f"q{nid}_fn{i}_origin", float(fn.origin))
+    except (ValueError, TypeError, KeyError) as e:
+        # malformed origin/scale/offset is a client error (HTTP 400)
+        raise dsl.QueryParseError(f"[{shape}] decay on [{field}]: {e}")
+    if scale <= 0:
+        raise dsl.QueryParseError("[decay] scale must be > 0")
+    decay = min(max(float(fn.decay), 1e-12), 1.0 - 1e-12)
+    if shape == "gauss":
+        a = _math.log(decay) / (scale * scale)     # factor = exp(a * d^2)
+    elif shape == "exp":
+        a = _math.log(decay) / scale               # factor = exp(a * d)
+    else:                                          # linear
+        a = scale / (1.0 - decay)                  # factor = max(0, (a-d)/a)
+    _scalar_f32(params, f"q{nid}_fn{i}_a", a)
+    _scalar_f32(params, f"q{nid}_fn{i}_offset", offset)
+    col_map = seg.geo_cols if kind == "geo" else seg.numeric_cols
+    return ("decay", i, shape, kind, field, field in col_map, fspec)
 
 
 @lru_cache(maxsize=64)
@@ -1803,6 +1999,38 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
                 env = _script_env(jnp, s_fields, s_pkeys, nid, f"fn{i}s",
                                   seg_arrays, params, child.scores, ndocs_pad)
                 v = pl.eval_device(s_ast, env)
+            elif fkind == "decay":
+                _, _, shape, dk, dfield, col_exists, fspec = fs
+                a = params[f"q{nid}_fn{i}_a"]
+                off = params[f"q{nid}_fn{i}_offset"]
+                if not col_exists:
+                    v = jnp.ones(ndocs_pad, jnp.float32)
+                    present = jnp.zeros(ndocs_pad, bool)
+                elif dk == "geo":
+                    g = seg_arrays["geo"][dfield]
+                    r = 6371008.8
+                    p1 = jnp.deg2rad(params[f"q{nid}_fn{i}_olat"])
+                    p2 = jnp.deg2rad(g["lat"])
+                    dphi = p2 - p1
+                    dlmb = jnp.deg2rad(g["lon"] - params[f"q{nid}_fn{i}_olon"])
+                    h = (jnp.sin(dphi / 2) ** 2
+                         + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2) ** 2)
+                    d = 2 * r * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0, 1.0)))
+                    present = g["present"]
+                else:
+                    col = seg_arrays["numeric"][dfield]
+                    d = jnp.abs(col["f32"] - params[f"q{nid}_fn{i}_origin"])
+                    present = col["present"]
+                if col_exists:
+                    d = jnp.maximum(d - off, 0.0)
+                    if shape == "gauss":
+                        v = jnp.exp(a * d * d)
+                    elif shape == "exp":
+                        v = jnp.exp(a * d)
+                    else:  # linear
+                        v = jnp.maximum((a - d) / a, 0.0)
+                    # docs without a value don't decay (factor 1)
+                    v = jnp.where(present, v, 1.0)
             else:  # weight
                 _, _, fspec = fs
                 v = jnp.ones(ndocs_pad, jnp.float32)
@@ -3077,11 +3305,35 @@ def _emit_bucketed_sub(jnp, sub, i: int, bucket_ids, nb: int, seg_arrays, match)
 # executor: jitted per-spec program
 # =====================================================================
 
+def prepare_collapse(collapse: Optional[dict], seg: Segment, ctx: ShardContext,
+                     params: dict):
+    """-> hashable collapse spec for _build_executor, or None. Keyword fields
+    collapse on the device-resident min-ord column; numeric fields on the
+    host-built per-segment value-rank ords (exact for 64-bit values)."""
+    if not collapse:
+        return None
+    field = ctx.mappings.aliases.get(collapse["field"], collapse["field"])
+    if field in seg.keyword_cols:
+        n_ord_pad = next_pow2(len(seg.keyword_cols[field].vocab) + 1)
+        return ("collapse", field, n_ord_pad, True)
+    if field in seg.numeric_cols:
+        col = seg.numeric_cols[field]
+        ords = col.sort_ords()
+        _p(params, "collapse_ords",
+           np.pad(ords, (0, seg.ndocs_pad - len(ords)), constant_values=-1))
+        n_ord_pad = next_pow2(seg.ndocs + 1)
+        return ("collapse", field, n_ord_pad, False)
+    # unmapped in this segment: every doc falls into the null group
+    _p(params, "collapse_ords", np.full(seg.ndocs_pad, -1, np.int32))
+    return ("collapse", field, 2, False)
+
+
 @lru_cache(maxsize=512)
 def _build_executor(full_spec):
     import jax
 
-    query_spec, sort_spec, agg_specs, k_pad, named_specs, has_after = full_spec
+    (query_spec, sort_spec, agg_specs, k_pad, named_specs, has_after,
+     collapse_spec) = full_spec
 
     def run(seg_arrays, params):
         import jax.numpy as jnp
@@ -3094,7 +3346,16 @@ def _build_executor(full_spec):
             # search_after: strictly below the cursor in ranking order
             matched = matched & (key < params["after_key"])
         sm = ops.ScoredMask(sm.scores, matched.astype(jnp.float32))
-        vals, idx = ops.topk_docs(key, sm.matched, live, k_pad)
+        if collapse_spec is not None:
+            _, cfield, n_ord_pad, use_kw = collapse_spec
+            if use_kw:
+                ords = seg_arrays["keyword"][cfield]["min_ord"]
+            else:
+                ords = params["collapse_ords"]
+            vals, idx = ops.collapse_topk(key, sm.matched, live, ords,
+                                          n_ord_pad, k_pad)
+        else:
+            vals, idx = ops.topk_docs(key, sm.matched, live, k_pad)
         out = {
             "topk_key": vals,
             "topk_idx": idx,
@@ -3122,9 +3383,10 @@ def _build_executor(full_spec):
 
 
 def run_segment(query_spec, sort_spec, agg_specs, named_specs, k_pad: int,
-                seg_arrays: dict, params: dict, has_after: bool = False) -> dict:
+                seg_arrays: dict, params: dict, has_after: bool = False,
+                collapse_spec=None) -> dict:
     exe = _build_executor((query_spec, sort_spec, tuple(agg_specs), k_pad,
-                           tuple(named_specs), has_after))
+                           tuple(named_specs), has_after, collapse_spec))
     return exe(seg_arrays, params)
 
 

@@ -42,6 +42,7 @@ class Candidate:
     score: Optional[float]
     sort_values: Tuple            # host-comparable, already direction-adjusted
     raw_sort_values: Tuple        # user-facing sort array
+    collapse_key: Any = None      # field-collapse group value (None = null group)
 
 
 @dataclass
@@ -135,6 +136,13 @@ class ShardSearcher:
             rescores = [rescores]
         min_score = body.get("min_score")
         search_after = body.get("search_after")
+        collapse = body.get("collapse")
+        if collapse:
+            if not isinstance(collapse, dict) or not collapse.get("field"):
+                raise dsl.QueryParseError("[collapse] requires [field]")
+            if sort_specs and sort_specs[0]["field"] == "_script":
+                raise dsl.QueryParseError(
+                    "cannot use [collapse] with a primary _script sort")
 
         result = ShardQueryResult(shard=shard_ord, segments=segments)
         ran_segs: List[Segment] = []
@@ -189,9 +197,11 @@ class ShardSearcher:
                         "search_after is not supported with a primary _script sort")
                 params["after_key"] = np.float32(
                     _after_key_value(search_after, sort_specs, seg))
+            cspec = C.prepare_collapse(collapse, seg, ctx, params)
             try:
                 out = C.run_segment(qspec, sspec, agg_specs, named_specs, k_pad,
-                                    seg.device_arrays(), params, has_after)
+                                    seg.device_arrays(), params, has_after,
+                                    collapse_spec=cspec)
             except _ScriptError as e:
                 # device-script trace failures are user errors (HTTP 400)
                 raise dsl.QueryParseError(f"script compile error: {e}")
@@ -226,6 +236,10 @@ class ShardSearcher:
                     continue
                 sort_vals, raw_vals = _host_sort_values(sort_specs, seg, d, sc)
                 cand = Candidate(shard_ord, seg_ord, d, sc, sort_vals, raw_vals)
+                if collapse:
+                    cand.collapse_key = _collapse_key_value(
+                        seg, ctx.mappings.aliases.get(collapse["field"],
+                                                      collapse["field"]), d)
                 result.candidates.append(cand)
                 names = [nm for nm, arr in named_np.items() if arr[j]]
                 if names:
@@ -574,6 +588,18 @@ def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
         total += r.total
         max_score = max(max_score, r.max_score)
     all_cands.sort(key=lambda c: c.sort_values)
+    if body.get("collapse"):
+        # keep only the best hit per group across shards (reference
+        # SearchPhaseController + CollapseBuilder coordinator merge)
+        seen = set()
+        deduped = []
+        for c in all_cands:
+            gk = ("null",) if c.collapse_key is None else ("v", c.collapse_key)
+            if gk in seen:
+                continue
+            seen.add(gk)
+            deduped.append(c)
+        all_cands = deduped
     selected = all_cands[frm: frm + size]
 
     if agg_nodes is None:
@@ -694,6 +720,11 @@ def _finish_search(searchers: List[ShardSearcher],
     hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)] for c in reduced["selected"]
             if (c.shard, c.seg_ord, c.local_doc) in hits_by_key]
 
+    collapse = body.get("collapse")
+    if collapse:
+        _apply_collapse_inner_hits(searchers, body, index_name, collapse,
+                                   reduced["selected"], hits_by_key)
+
     if reduced["aggs"]:
         # bucket refinement: ordinal bucket aggs execute complex sub-trees
         # (terms>terms, bucket top_hits, cardinality-under-terms, ...) as one
@@ -724,6 +755,12 @@ def _finish_search(searchers: List[ShardSearcher],
     }
     if reduced["aggs"]:
         resp["aggregations"] = reduced["aggs"]
+    if body.get("suggest"):
+        from .suggest import run_suggest
+        segs = [g for s in searchers for g in s.engine.segments
+                if g.live_count > 0]
+        mappings = searchers[0].engine.mappings if searchers else None
+        resp["suggest"] = run_suggest(body["suggest"], segs, mappings)
     if body.get("profile"):
         resp["profile"] = {"shards": [{"id": r.shard, "query_ms": r.took_ms}
                                       for r in results]}
@@ -740,6 +777,38 @@ _ORDINAL_KINDS = {"terms", "significant_terms", "histogram", "date_histogram",
                   "geohash_grid", "geotile_grid", "composite"}
 _WALK_CONTAINERS = {"filter", "filters", "range", "date_range", "global",
                     "missing"}
+
+
+def _apply_collapse_inner_hits(searchers, body, index_name, collapse,
+                               selected, hits_by_key) -> None:
+    """Stamp the collapse field value into each hit and resolve inner_hits
+    groups via per-group sub-searches (reference ExpandSearchPhase)."""
+    field = collapse["field"]
+    ih_specs = collapse.get("inner_hits") or []
+    if isinstance(ih_specs, dict):
+        ih_specs = [ih_specs]
+    for c in selected:
+        h = hits_by_key.get((c.shard, c.seg_ord, c.local_doc))
+        if h is None:
+            continue
+        h.setdefault("fields", {})[field] = [c.collapse_key]
+        for ih in ih_specs:
+            name = ih.get("name", field)
+            if c.collapse_key is None:
+                gfilter = {"bool": {"must_not": [{"exists": {"field": field}}]}}
+            else:
+                gfilter = {"term": {field: c.collapse_key}}
+            sub = {
+                "query": {"bool": {
+                    "must": [body.get("query") or {"match_all": {}}],
+                    "filter": [gfilter]}},
+                "size": int(ih.get("size", 3)),
+                "from": int(ih.get("from", 0)),
+            }
+            if ih.get("sort"):
+                sub["sort"] = ih["sort"]
+            sub_resp = search_shards(searchers, sub, index_name=index_name)
+            h.setdefault("inner_hits", {})[name] = {"hits": sub_resp["hits"]}
 
 
 def _pipeline_input_names(p: AggNode) -> set:
@@ -1081,6 +1150,19 @@ def _collect_named(lroot) -> List[Tuple[str, Any]]:
 
     walk(lroot)
     return out
+
+
+def _collapse_key_value(seg: Segment, field: str, doc: int):
+    """Host group-key for one doc (keyword string or numeric value)."""
+    kcol = seg.keyword_cols.get(field)
+    if kcol is not None:
+        o = int(kcol.min_ord[doc])
+        return kcol.vocab[o] if o >= 0 else None
+    ncol = seg.numeric_cols.get(field)
+    if ncol is not None and ncol.present[doc]:
+        v = ncol.values[doc]
+        return float(v) if ncol.kind == "float" else int(v)
+    return None
 
 
 def _host_sort_values(sort_specs: List[dict], seg: Segment, doc: int,

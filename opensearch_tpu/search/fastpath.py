@@ -35,14 +35,15 @@ INT_MAX = np.int32(2**31 - 1)
 
 _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 
-# optional memory-accounting hook set by the Node (utils/breaker.py):
-# called with (nbytes, label) before aligned arrays go to device
-_breaker_hook = None
+# optional memory accounting set by the Node (utils/breaker.py): charged
+# before aligned arrays go to device, released when the segment is GC'd
+# (segments are immutable and replaced on refresh/merge)
+_breaker = None
 
 
-def set_breaker_hook(fn) -> None:
-    global _breaker_hook
-    _breaker_hook = fn
+def set_breaker(breaker) -> None:
+    global _breaker
+    _breaker = breaker
 
 
 def set_enabled(flag: bool) -> None:
@@ -106,8 +107,10 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     a_starts, a_docs, a_packed = align_csr_rows(
         pb.starts, pb.doc_ids, packed, margin=MAX_L)
     nbytes = a_docs.nbytes + a_packed.nbytes
-    if _breaker_hook is not None:
-        _breaker_hook(nbytes, f"fastpath[{seg.name}][{field}]")
+    if _breaker is not None:
+        import weakref
+        _breaker.add_estimate(nbytes, f"fastpath[{seg.name}][{field}]")
+        weakref.finalize(seg, _breaker.release, nbytes)
     lens = np.diff(pb.starts).astype(np.int64)
     starts_rows = (a_starts[:-1] // LANES).astype(np.int64)
     return AlignedPostings(starts_rows, lens,
@@ -291,32 +294,31 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
             groups.setdefault((vq.field, vq.T_pad, vq.k1, vq.b_eff),
                               []).append(vq)
     results = {}   # id(vq) -> (scores, docs, total)
-    for (field, T_pad, k1, b_eff), vqs in groups.items():
+    for (field, T_pad, k1, b_eff), gvqs in groups.items():
         al = get_aligned(seg, field)
-        # sub-group by L bucket so rare-term queries don't pay a frequent
-        # term's VPU width
-        by_l = {}
-        for vq in vqs:
-            by_l.setdefault(vq.L, []).append(vq)
-        for L, gvqs in by_l.items():
-            QB = len(gvqs)
-            rowstarts = np.stack([v.rowstarts for v in gvqs])
-            nrows = np.stack([v.nrows for v in gvqs])
-            lens = np.stack([v.lens for v in gvqs])
-            weights = np.stack([v.weights for v in gvqs])
-            msm = np.array([[v.msm] for v in gvqs], np.float32)
-            avg = np.array([[v.avgdl] for v in gvqs], np.float32)
-            dlo = np.array([[v.dlo] for v in gvqs], np.int32)
-            dhi = np.array([[v.dhi] for v in gvqs], np.int32)
-            scores, docs, totals = fused_bm25_topk_tfdl(
-                al.d_docs, al.d_tfdl, rowstarts, nrows, lens, weights,
-                msm, avg, dlo, dhi, T=T_pad, L=L, K=K, k1=k1, b=b_eff)
-            scores = np.asarray(scores)
-            docs = np.asarray(docs)
-            totals = np.asarray(totals)
-            for j, vq in enumerate(gvqs):
-                results[id(vq)] = (scores[j][:K], docs[j][:K],
-                                   int(totals[j][0]))
+        # ONE launch per group: DMA volume is set by per-term `nrows`, not L,
+        # so every row rides the group's max-L variant — launch (and its
+        # host<->device round trip) amortizes across the whole batch while
+        # rare terms still move only their own bytes
+        L = max(v.L for v in gvqs)
+        QB = len(gvqs)
+        rowstarts = np.stack([v.rowstarts for v in gvqs])
+        nrows = np.stack([v.nrows for v in gvqs])
+        lens = np.stack([v.lens for v in gvqs])
+        weights = np.stack([v.weights for v in gvqs])
+        msm = np.array([[v.msm] for v in gvqs], np.float32)
+        avg = np.array([[v.avgdl] for v in gvqs], np.float32)
+        dlo = np.array([[v.dlo] for v in gvqs], np.int32)
+        dhi = np.array([[v.dhi] for v in gvqs], np.int32)
+        scores, docs, totals = fused_bm25_topk_tfdl(
+            al.d_docs, al.d_tfdl, rowstarts, nrows, lens, weights,
+            msm, avg, dlo, dhi, T=T_pad, L=L, K=K, k1=k1, b=b_eff)
+        scores = np.asarray(scores)
+        docs = np.asarray(docs)
+        totals = np.asarray(totals)
+        for j, vq in enumerate(gvqs):
+            results[id(vq)] = (scores[j][:K], docs[j][:K],
+                               int(totals[j][0]))
     out: List[Optional[dict]] = []
     for vqs in vq_lists:
         if vqs is None:

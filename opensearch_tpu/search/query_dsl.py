@@ -209,7 +209,7 @@ class GeoBoundingBoxQuery(Query):
 
 @dataclass
 class ScoreFunction:
-    kind: str                      # weight | field_value_factor | random_score | script_score
+    kind: str                      # weight | field_value_factor | random_score | script_score | decay
     weight: float = 1.0
     filter: Optional[Query] = None
     field: Optional[str] = None
@@ -219,6 +219,35 @@ class ScoreFunction:
     seed: int = 0
     script: Optional[str] = None   # painless-lite source
     script_params: Optional[dict] = None
+    # decay (gauss | exp | linear) — reference functionscore/
+    # GaussDecayFunctionBuilder.java / ExponentialDecayFunctionBuilder.java /
+    # LinearDecayFunctionBuilder.java
+    decay_shape: Optional[str] = None   # gauss | exp | linear
+    origin: Any = None
+    scale: Any = None
+    offset: Any = None
+    decay: float = 0.5
+
+
+@dataclass
+class MoreLikeThisQuery(Query):
+    """Reference `index/query/MoreLikeThisQueryBuilder.java` (Lucene
+    MoreLikeThis): select interesting terms from liked texts/docs by tf·idf,
+    search as a weighted OR."""
+
+    fields: List[str] = dc_field(default_factory=list)
+    like: List[Any] = dc_field(default_factory=list)      # str | {"_id": ...}
+    unlike: List[Any] = dc_field(default_factory=list)
+    max_query_terms: int = 25
+    min_term_freq: int = 2
+    min_doc_freq: int = 5
+    max_doc_freq: int = 2**31 - 1
+    min_word_length: int = 0
+    max_word_length: int = 0          # 0 = unbounded
+    stop_words: List[str] = dc_field(default_factory=list)
+    minimum_should_match: Optional[str] = "30%"
+    boost_terms: float = 0.0
+    include: bool = False
 
 
 @dataclass
@@ -552,16 +581,54 @@ def parse_query(dsl: Optional[dict]) -> Query:
         _common(q, body)
         return q
 
+    if kind == "more_like_this":
+        like = body.get("like", [])
+        like = like if isinstance(like, list) else [like]
+        unlike = body.get("unlike", [])
+        unlike = unlike if isinstance(unlike, list) else [unlike]
+        if not like:
+            raise QueryParseError("[more_like_this] requires [like]")
+        q = MoreLikeThisQuery(
+            fields=list(body.get("fields", [])), like=like, unlike=unlike,
+            max_query_terms=int(body.get("max_query_terms", 25)),
+            min_term_freq=int(body.get("min_term_freq", 2)),
+            min_doc_freq=int(body.get("min_doc_freq", 5)),
+            max_doc_freq=int(body.get("max_doc_freq", 2**31 - 1)),
+            min_word_length=int(body.get("min_word_length", 0)),
+            max_word_length=int(body.get("max_word_length", 0)),
+            stop_words=list(body.get("stop_words", [])),
+            minimum_should_match=body.get("minimum_should_match", "30%"),
+            boost_terms=float(body.get("boost_terms", 0.0)),
+            include=bool(body.get("include", False)))
+        _common(q, body)
+        return q
+
     if kind == "function_score":
         inner = parse_query(body.get("query")) if body.get("query") else MatchAllQuery()
         functions = []
         raw_fns = body.get("functions", [])
         if not raw_fns:  # single-function shorthand
             raw_fns = [{k: v for k, v in body.items()
-                        if k in ("weight", "field_value_factor", "random_score", "script_score")}]
+                        if k in ("weight", "field_value_factor", "random_score",
+                                 "script_score", "gauss", "exp", "linear")}]
         for fn in raw_fns:
             filt = parse_query(fn["filter"]) if "filter" in fn else None
-            if "field_value_factor" in fn:
+            shape = next((s for s in ("gauss", "exp", "linear") if s in fn), None)
+            if shape is not None:
+                spec = dict(fn[shape])
+                spec.pop("multi_value_mode", None)
+                if len(spec) != 1:
+                    raise QueryParseError(
+                        f"[{shape}] decay needs exactly one field")
+                dfield, dspec = next(iter(spec.items()))
+                if "scale" not in dspec:
+                    raise QueryParseError(f"[{shape}] requires [scale]")
+                functions.append(ScoreFunction(
+                    "decay", fn.get("weight", 1.0), filt, dfield,
+                    decay_shape=shape, origin=dspec.get("origin"),
+                    scale=dspec["scale"], offset=dspec.get("offset", 0),
+                    decay=float(dspec.get("decay", 0.5))))
+            elif "field_value_factor" in fn:
                 fv = fn["field_value_factor"]
                 functions.append(ScoreFunction("field_value_factor", fn.get("weight", 1.0),
                                                filt, fv["field"], fv.get("factor", 1.0),
@@ -711,12 +778,16 @@ def parse_script_spec(spec) -> Tuple[str, dict]:
 
 
 def _parse_distance(d) -> float:
-    """'5km', '100m', '2mi' -> meters (reference DistanceUnit)."""
+    """'5km', '100m', '2mi' -> meters (reference DistanceUnit). Longest
+    suffix wins ('5nmi' is nautical miles, not '5n' miles)."""
     if isinstance(d, (int, float)):
         return float(d)
     s = str(d).strip().lower()
-    units = [("km", 1000.0), ("mi", 1609.344), ("yd", 0.9144), ("ft", 0.3048),
-             ("nmi", 1852.0), ("mm", 0.001), ("cm", 0.01), ("m", 1.0)]
+    units = [("nauticalmiles", 1852.0), ("kilometers", 1000.0),
+             ("meters", 1.0), ("miles", 1609.344), ("nmi", 1852.0),
+             ("km", 1000.0), ("mi", 1609.344), ("yd", 0.9144),
+             ("ft", 0.3048), ("in", 0.0254), ("mm", 0.001), ("cm", 0.01),
+             ("m", 1.0)]
     for suf, mult in units:
         if s.endswith(suf):
             return float(s[: -len(suf)]) * mult
@@ -733,10 +804,28 @@ def _parse_point(p) -> Tuple[float, float]:
 
 
 def parse_minimum_should_match(spec: Optional[str], n_optional: int) -> int:
-    """'2', '-1', '75%', '-25%' semantics (reference Queries.calculateMinShouldMatch)."""
+    """'2', '-1', '75%', '-25%', and conditional '3<90%' / multi
+    '2<-25% 9<-3' semantics (reference Queries.calculateMinShouldMatch)."""
     if spec is None or n_optional == 0:
         return 0 if spec is None else 0
     s = str(spec).strip()
+    if "<" in s:
+        # each "n<rule": when n_optional > n, apply rule; pick the clause
+        # with the LARGEST matching n (Lucene applies them in order)
+        result = n_optional  # fewer than every threshold -> all required
+        best_n = -1
+        for part in s.split():
+            if "<" not in part:
+                raise QueryParseError(f"invalid minimum_should_match [{spec}]")
+            left, right = part.split("<", 1)
+            try:
+                thr = int(left)
+            except ValueError:
+                raise QueryParseError(f"invalid minimum_should_match [{spec}]")
+            if n_optional > thr and thr > best_n:
+                best_n = thr
+                result = parse_minimum_should_match(right, n_optional)
+        return result
     try:
         if s.endswith("%"):
             pct = float(s[:-1])

@@ -1,0 +1,179 @@
+"""Ranking evaluation API (reference `modules/rank-eval/` —
+TransportRankEvalAction, PrecisionAtK, RecallAtK, MeanReciprocalRank,
+DiscountedCumulativeGain, ExpectedReciprocalRank)."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Tuple
+
+from . import query_dsl as dsl
+
+
+class _Rated(dict):
+    """rating lookup by hit; index constraint applied when the rating
+    specified one (reference RatedDocument key is (index, id))."""
+
+    def add(self, r: dict) -> None:
+        self[str(r["_id"])] = (r.get("_index"), int(r["rating"]))
+
+    def rating(self, hit_key) -> int:
+        idx, did = hit_key
+        v = self.get(did)
+        if v is None:
+            return -1
+        ridx, rating = v
+        if ridx is not None and idx and ridx != idx:
+            return -1
+        return rating
+
+    def __contains__(self, hit_key) -> bool:  # type: ignore[override]
+        return self.rating(hit_key) >= 0
+
+
+def _rated(ratings) -> "_Rated":
+    out = _Rated()
+    for r in ratings or []:
+        out.add(r)
+    return out
+
+
+def _hit_keys(hits) -> List[Tuple[str, str]]:
+    return [(h.get("_index", ""), str(h["_id"])) for h in hits]
+
+
+def _precision_at_k(hits, rated, opts) -> Tuple[float, dict]:
+    k = int(opts.get("k", 10))
+    thr = int(opts.get("relevant_rating_threshold", 1))
+    ignore_unlabeled = bool(opts.get("ignore_unlabeled", False))
+    relevant = 0
+    considered = 0
+    for key in _hit_keys(hits[:k]):
+        if key in rated:
+            considered += 1
+            if rated.rating(key) >= thr:
+                relevant += 1
+        elif not ignore_unlabeled:
+            considered += 1
+    score = relevant / considered if considered else 0.0
+    return score, {"relevant_docs_retrieved": relevant,
+                   "docs_retrieved": considered}
+
+
+def _recall_at_k(hits, rated, opts) -> Tuple[float, dict]:
+    k = int(opts.get("k", 10))
+    thr = int(opts.get("relevant_rating_threshold", 1))
+    relevant_total = sum(1 for _, rv in rated.values() if rv >= thr)
+    got = sum(1 for key in _hit_keys(hits[:k])
+              if rated.rating(key) >= thr)
+    score = got / relevant_total if relevant_total else 0.0
+    return score, {"relevant_docs_retrieved": got,
+                   "relevant_docs": relevant_total}
+
+
+def _mrr(hits, rated, opts) -> Tuple[float, dict]:
+    k = int(opts.get("k", 10))
+    thr = int(opts.get("relevant_rating_threshold", 1))
+    for rank, key in enumerate(_hit_keys(hits[:k]), start=1):
+        if rated.rating(key) >= thr:
+            return 1.0 / rank, {"first_relevant": rank}
+    return 0.0, {"first_relevant": -1}
+
+
+def _dcg(hits, rated, opts) -> Tuple[float, dict]:
+    k = int(opts.get("k", 10))
+    normalize = bool(opts.get("normalize", False))
+    gains = [max(rated.rating(key), 0) for key in _hit_keys(hits[:k])]
+
+    def dcg_of(gs):
+        return sum((2 ** g - 1) / math.log2(i + 2) for i, g in enumerate(gs))
+
+    score = dcg_of(gains)
+    details = {"dcg": score}
+    if normalize:
+        ideal = dcg_of(sorted((rv for _, rv in rated.values()),
+                              reverse=True)[:k])
+        details["ideal_dcg"] = ideal
+        score = score / ideal if ideal > 0 else 0.0
+        details["normalized_dcg"] = score
+    return score, details
+
+
+def _err(hits, rated, opts) -> Tuple[float, dict]:
+    k = int(opts.get("k", 10))
+    max_rel = int(opts.get("maximum_relevance",
+                           max((rv for _, rv in rated.values()),
+                               default=1) or 1))
+    p_stop_prev = 1.0
+    err = 0.0
+    for rank, key in enumerate(_hit_keys(hits[:k]), start=1):
+        g = max(rated.rating(key), 0)
+        r = (2 ** g - 1) / (2 ** max_rel)
+        err += p_stop_prev * r / rank
+        p_stop_prev *= (1 - r)
+    return err, {"unrated_docs": sum(1 for key in _hit_keys(hits[:k])
+                                     if key not in rated)}
+
+
+_METRICS = {
+    "precision": _precision_at_k,
+    "recall": _recall_at_k,
+    "mean_reciprocal_rank": _mrr,
+    "dcg": _dcg,
+    "expected_reciprocal_rank": _err,
+}
+
+
+def run_rank_eval(client, index: str, body: dict) -> dict:
+    """Execute the _rank_eval request via `client.search` per rated query."""
+    metric_spec = body.get("metric")
+    if not metric_spec or len(metric_spec) != 1:
+        raise dsl.QueryParseError("[rank_eval] requires exactly one [metric]")
+    (mname, mopts), = metric_spec.items()
+    fn = _METRICS.get(mname)
+    if fn is None:
+        raise dsl.QueryParseError(f"unknown rank_eval metric [{mname}]")
+    details = {}
+    failures = {}
+    scores = []
+    for req in body.get("requests", []):
+        rid = req.get("id", f"q{len(details)}")
+        search_body = req.get("request")
+        if search_body is None and req.get("template_id"):
+            from ..rest.templates import render_template
+            tmpl = client._stored_scripts.get(req["template_id"])
+            if tmpl is None:
+                failures[rid] = f"no stored template [{req['template_id']}]"
+                continue
+            search_body = render_template(tmpl, req.get("params"))
+        if search_body is None:
+            failures[rid] = "missing [request]"
+            continue
+        rated = _rated(req.get("ratings"))
+        k = int((mopts or {}).get("k", 10))
+        search_body = dict(search_body)
+        search_body.setdefault("size", k)
+        try:
+            resp = client.search(req.get("index", index), search_body)
+        except Exception as e:  # noqa: BLE001 - reference collects failures
+            failures[rid] = str(e)
+            continue
+        hits = resp["hits"]["hits"]
+        score, mdetails = fn(hits, rated, mopts or {})
+        scores.append(score)
+        details[rid] = {
+            "metric_score": score,
+            "unrated_docs": [{"_index": h.get("_index", ""), "_id": h["_id"]}
+                             for h in hits[:k]
+                             if (h.get("_index", ""), str(h["_id"]))
+                             not in rated],
+            "hits": [{"hit": {"_index": h.get("_index", ""),
+                              "_id": h["_id"], "_score": h.get("_score")},
+                      "rating": (lambda rr: rr if rr >= 0 else None)(
+                          rated.rating((h.get("_index", ""),
+                                        str(h["_id"]))))}
+                     for h in hits[:k]],
+            "metric_details": {mname: mdetails},
+        }
+    return {"metric_score": (sum(scores) / len(scores)) if scores else 0.0,
+            "details": details, "failures": failures}

@@ -1,0 +1,391 @@
+"""Suggesters: term, phrase, completion.
+
+Reference `search/suggest/SuggestBuilder.java`,
+`suggest/term/TermSuggester.java` (Lucene DirectSpellChecker),
+`suggest/phrase/PhraseSuggester.java` (candidate generation + gram language
+model + stupid-backoff/laplace smoothing),
+`suggest/completion/CompletionSuggester.java` (FST prefix automaton).
+
+TPU posture: suggestion is a tiny-term-dictionary problem, not a FLOPs
+problem — the reference runs it JVM-host-side over Lucene's FST; we run it
+Python-host-side over the segment term dictionaries (sorted vocab lists)
+with an edit-distance band filter. The completion suggester keeps a
+per-segment sorted (input, weight, doc) array built from `_source` — the
+FST-lite analog, prefix lookup by bisect.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import query_dsl as dsl
+
+
+# ---------------------------------------------------------------------
+# edit distance (banded, early-exit) — shared by term/phrase/completion
+# ---------------------------------------------------------------------
+
+def edit_distance_le(a: str, b: str, k: int) -> Optional[int]:
+    """Damerau-lite Levenshtein distance if <= k else None (banded DP)."""
+    la, lb = len(a), len(b)
+    if abs(la - lb) > k:
+        return None
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        lo = max(1, i - k)
+        hi = min(lb, i + k)
+        if lo > 1:
+            cur[lo - 1] = k + 1
+        best = k + 1
+        for j in range(lo, hi + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (i > 1 and j > 1 and a[i - 1] == b[j - 2]
+                    and a[i - 2] == b[j - 1]):
+                cur[j] = min(cur[j], prev[j - 1])  # adjacent transposition-ish
+            best = min(best, cur[j])
+        if best > k:
+            return None
+        prev = cur
+    return prev[lb] if prev[lb] <= k else None
+
+
+# ---------------------------------------------------------------------
+# shared term-dictionary access
+# ---------------------------------------------------------------------
+
+def _field_stats(segments, field: str):
+    """(doc_freq fn, vocab union iterator helpers) over live segments."""
+    def doc_freq(term: str) -> int:
+        return sum(s.postings[field].doc_freq(term) for s in segments
+                   if field in s.postings)
+    return doc_freq
+
+
+def _candidates(segments, field: str, token: str, max_edits: int,
+                prefix_len: int, max_inspections: int = 1000
+                ) -> List[Tuple[str, int, int]]:
+    """-> [(term, distance, doc_freq)] within edit distance, sharing the
+    required prefix (reference DirectSpellChecker.minPrefix)."""
+    seen: Dict[str, int] = {}
+    prefix = token[:prefix_len]
+    for seg in segments:
+        pb = seg.postings.get(field)
+        if pb is None:
+            continue
+        vocab = pb.vocab
+        if prefix:
+            lo = bisect.bisect_left(vocab, prefix)
+            hi = bisect.bisect_left(vocab, prefix + "￿")
+        else:
+            lo, hi = 0, len(vocab)
+        for i in range(lo, min(hi, lo + max_inspections)):
+            t = vocab[i]
+            if t == token or t in seen:
+                continue
+            d = edit_distance_le(token, t, max_edits)
+            if d is not None and d > 0:
+                seen[t] = d
+    doc_freq = _field_stats(segments, field)
+    return [(t, d, doc_freq(t)) for t, d in seen.items()]
+
+
+def _score(token: str, cand: str, distance: int) -> float:
+    """DirectSpellChecker-style similarity in (0, 1)."""
+    return 1.0 - distance / max(min(len(token), len(cand)), 1)
+
+
+# ---------------------------------------------------------------------
+# term suggester
+# ---------------------------------------------------------------------
+
+def term_suggest(segments, mappings, text: str, opts: dict) -> List[dict]:
+    field = opts["field"]
+    size = int(opts.get("size", 5))
+    mode = opts.get("suggest_mode", "missing")
+    max_edits = int(opts.get("max_edits", 2))
+    prefix_len = int(opts.get("prefix_length", 1))
+    min_len = int(opts.get("min_word_length", 4))
+    sort = opts.get("sort", "score")
+    doc_freq = _field_stats(segments, field)
+
+    ft = mappings.resolve_field(field)
+    analyzer = mappings.search_analyzer_for(ft) if ft is not None else None
+    tokens = analyzer.terms(text) if analyzer else text.lower().split()
+
+    out = []
+    offset = 0
+    for tok in tokens:
+        pos = text.lower().find(tok, offset)
+        if pos < 0:
+            pos = offset
+        entry = {"text": tok, "offset": pos, "length": len(tok),
+                 "options": []}
+        offset = pos + len(tok)
+        tok_df = doc_freq(tok)
+        need = (mode == "always" or (mode == "missing" and tok_df == 0)
+                or mode == "popular")
+        if need and len(tok) >= min_len:
+            cands = _candidates(segments, field, tok, max_edits, prefix_len)
+            opts_list = []
+            for t, d, df in cands:
+                if df <= 0:
+                    continue
+                if mode == "popular" and df <= tok_df:
+                    continue
+                opts_list.append({"text": t, "score": round(_score(tok, t, d), 6),
+                                  "freq": df})
+            if sort == "frequency":
+                opts_list.sort(key=lambda o: (-o["freq"], -o["score"], o["text"]))
+            else:
+                opts_list.sort(key=lambda o: (-o["score"], -o["freq"], o["text"]))
+            entry["options"] = opts_list[:size]
+        out.append(entry)
+    return out
+
+
+# ---------------------------------------------------------------------
+# phrase suggester
+# ---------------------------------------------------------------------
+
+def _collection_tf(segments, field: str, term: str) -> float:
+    tot = 0.0
+    for s in segments:
+        pb = s.postings.get(field)
+        if pb is None:
+            continue
+        r = pb.row(term)
+        if r >= 0:
+            a, b = pb.row_slice(r)
+            tot += float(pb.tfs[a:b].sum())
+    return tot
+
+
+def _total_tf(segments, field: str) -> float:
+    tot = 0.0
+    for s in segments:
+        st = s.text_stats.get(field)
+        if st:
+            tot += st.sum_dl
+    return tot
+
+
+def phrase_suggest(segments, mappings, text: str, opts: dict) -> List[dict]:
+    """Candidate generation per token + beam over combinations scored by a
+    stupid-backoff bigram LM. Bigram counts come from `collate`-style lookup
+    of the shingled gram field when `field` carries shingles ("w1 w2" terms);
+    otherwise the model backs off to unigrams only."""
+    field = opts["field"]
+    gram_field = opts.get("gram_field", field)
+    size = int(opts.get("size", 5))
+    max_errors = float(opts.get("max_errors", 1.0))
+    confidence = float(opts.get("confidence", 1.0))
+    rwel = float(opts.get("real_word_error_likelihood", 0.95))
+    discount = 0.4   # stupid backoff
+    hl = opts.get("highlight") or {}
+    pre, post = hl.get("pre_tag", ""), hl.get("post_tag", "")
+
+    ft = mappings.resolve_field(field)
+    analyzer = mappings.search_analyzer_for(ft) if ft is not None else None
+    tokens = analyzer.terms(text) if analyzer else text.lower().split()
+    if not tokens:
+        return [{"text": text, "offset": 0, "length": len(text),
+                 "options": []}]
+
+    total = max(_total_tf(segments, field), 1.0)
+    vocab_n = max(sum(len(s.postings[field].vocab) for s in segments
+                      if field in s.postings), 1)
+
+    def uni_p(w: str) -> float:
+        # laplace-smoothed unigram probability
+        return (_collection_tf(segments, field, w) + 0.5) / (total + 0.5 * vocab_n)
+
+    def bi_p(w1: str, w2: str) -> float:
+        big = _collection_tf(segments, gram_field, f"{w1} {w2}")
+        if big > 0:
+            c1 = _collection_tf(segments, field, w1)
+            if c1 > 0:
+                return big / c1
+        return discount * uni_p(w2)
+
+    max_cand = 4
+    per_token: List[List[Tuple[str, float]]] = []
+    doc_freq = _field_stats(segments, field)
+    for tok in tokens:
+        cands = [(tok, 1.0 if doc_freq(tok) > 0 else 0.5)]
+        for t, d, df in _candidates(segments, field, tok,
+                                    int(opts.get("max_edits", 2)),
+                                    int(opts.get("prefix_length", 1))):
+            if df > 0:
+                cands.append((t, _score(tok, t, d)))
+        cands.sort(key=lambda c: -c[1])
+        per_token.append(cands[:max_cand])
+
+    def lm_score(seq: List[str]) -> float:
+        p = uni_p(seq[0])
+        score = p
+        for i in range(1, len(seq)):
+            score *= bi_p(seq[i - 1], seq[i])
+        return score
+
+    # beam over combinations, bounded errors
+    max_changes = max(1, int(round(max_errors if max_errors >= 1
+                                   else max_errors * len(tokens))))
+    beams: List[Tuple[List[str], int, float]] = [([], 0, 1.0)]
+    for ti, cands in enumerate(per_token):
+        nxt = []
+        for seq, changes, sim in beams:
+            for ci, (cand, csim) in enumerate(cands):
+                ch = changes + (1 if cand != tokens[ti] else 0)
+                if ch > max_changes:
+                    continue
+                nxt.append((seq + [cand], ch,
+                            sim * (csim if cand != tokens[ti] else rwel)))
+        nxt.sort(key=lambda x: -x[2])
+        beams = nxt[: 12]
+
+    base_seq = tokens
+    base = lm_score(base_seq) * (rwel ** len(tokens))
+    options = []
+    seen = set()
+    for seq, changes, sim in beams:
+        phrase = " ".join(seq)
+        if phrase in seen:
+            continue
+        seen.add(phrase)
+        sc = lm_score(seq) * sim
+        if seq == base_seq:
+            options.append({"text": phrase, "score": sc})
+            continue
+        if sc <= base * confidence:
+            continue
+        opt = {"text": phrase, "score": sc}
+        if pre or post:
+            opt["highlighted"] = " ".join(
+                f"{pre}{w}{post}" if w != tokens[i] else w
+                for i, w in enumerate(seq))
+        options.append(opt)
+    options.sort(key=lambda o: -o["score"])
+    return [{"text": text, "offset": 0, "length": len(text),
+             "options": options[:size]}]
+
+
+# ---------------------------------------------------------------------
+# completion suggester
+# ---------------------------------------------------------------------
+
+def _completion_entries(seg, field: str) -> List[Tuple[str, int, int]]:
+    """Sorted (input_lower, weight, doc) built from _source — the FST-lite."""
+    cache = seg.__dict__.setdefault("_completion_cache", {})
+    if field in cache:
+        return cache[field]
+    entries: List[Tuple[str, int, int]] = []
+    for doc in range(seg.ndocs):
+        if not seg.live[doc]:
+            continue
+        src = seg.sources[doc]
+        v = src.get(field) if isinstance(src, dict) else None
+        if v is None:
+            continue
+        items = v if isinstance(v, list) else [v]
+        for it in items:
+            if isinstance(it, dict):
+                inputs = it.get("input", [])
+                inputs = inputs if isinstance(inputs, list) else [inputs]
+                w = int(it.get("weight", 1))
+            else:
+                inputs, w = [str(it)], 1
+            for inp in inputs:
+                entries.append((str(inp).lower(), w, doc))
+    entries.sort()
+    cache[field] = entries
+    return entries
+
+
+def completion_suggest(segments, mappings, prefix: str, opts: dict,
+                       seg_ids) -> List[dict]:
+    field = opts["field"]
+    size = int(opts.get("size", 5))
+    skip_dup = bool(opts.get("skip_duplicates", False))
+    fuzzy = opts.get("fuzzy")
+    p = prefix.lower()
+    collected = []
+    for si, seg in enumerate(segments):
+        entries = _completion_entries(seg, field)
+        keys = [e[0] for e in entries]
+        if fuzzy:
+            fz = (2 if fuzzy is True else
+                  int(fuzzy.get("fuzziness", 2) if str(fuzzy.get(
+                      "fuzziness", 2)).isdigit() else 2))
+            plen = int(fuzzy.get("prefix_length", 1)) if isinstance(
+                fuzzy, dict) else 1
+            anchor = p[:plen]
+            lo = bisect.bisect_left(keys, anchor)
+            hi = bisect.bisect_left(keys, anchor + "￿") if anchor \
+                else len(keys)
+            for i in range(lo, hi):
+                inp, w, doc = entries[i]
+                cand_prefix = inp[: len(p)]
+                if edit_distance_le(p, cand_prefix, fz) is not None:
+                    collected.append((inp, w, si, doc))
+        else:
+            lo = bisect.bisect_left(keys, p)
+            hi = bisect.bisect_left(keys, p + "￿")
+            for i in range(lo, hi):
+                inp, w, doc = entries[i]
+                collected.append((inp, w, si, doc))
+    collected.sort(key=lambda e: (-e[1], e[0]))
+    options = []
+    seen_txt = set()
+    for inp, w, si, doc in collected:
+        if skip_dup and inp in seen_txt:
+            continue
+        seen_txt.add(inp)
+        seg = segments[si]
+        options.append({"text": inp, "_id": seg.ids[doc],
+                        "_score": float(w),
+                        "_source": seg.sources[doc]})
+        if len(options) >= size:
+            break
+    return [{"text": prefix, "offset": 0, "length": len(prefix),
+             "options": options}]
+
+
+# ---------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------
+
+def run_suggest(suggest_body: dict, segments, mappings) -> dict:
+    """-> the response `suggest` section (reference shape: one entry list per
+    named suggestion)."""
+    out = {}
+    global_text = suggest_body.get("text")
+    for name, spec in suggest_body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise dsl.QueryParseError(f"invalid suggest section [{name}]")
+        text = spec.get("text", global_text)
+        if "term" in spec:
+            if text is None:
+                raise dsl.QueryParseError(f"suggest [{name}] requires [text]")
+            out[name] = term_suggest(segments, mappings, str(text),
+                                     spec["term"])
+        elif "phrase" in spec:
+            if text is None:
+                raise dsl.QueryParseError(f"suggest [{name}] requires [text]")
+            out[name] = phrase_suggest(segments, mappings, str(text),
+                                       spec["phrase"])
+        elif "completion" in spec:
+            prefix = spec.get("prefix", text)
+            if prefix is None:
+                raise dsl.QueryParseError(
+                    f"suggest [{name}] requires [prefix]")
+            out[name] = completion_suggest(segments, mappings, str(prefix),
+                                           spec["completion"], None)
+        else:
+            raise dsl.QueryParseError(
+                f"suggest [{name}] needs one of [term|phrase|completion]")
+    return out

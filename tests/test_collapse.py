@@ -1,0 +1,127 @@
+"""Field collapsing (reference `search/collapse/CollapseBuilder.java`,
+ExpandSearchPhase for inner_hits): one best hit per group, device-side
+scatter-max grouping (ops.collapse_topk)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("cars", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "desc": {"type": "text"},
+            "make": {"type": "keyword"},
+            "price": {"type": "long"},
+        }}})
+    docs = [
+        ("1", "fast red car", "honda", 20000),
+        ("2", "fast blue car", "honda", 25000),
+        ("3", "fast green car", "toyota", 22000),
+        ("4", "slow red car", "toyota", 18000),
+        ("5", "fast old car", "ford", 15000),
+        ("6", "fast shiny car car", "ford", 30000),
+        ("7", "fast car no make", None, 9000),
+        ("8", "fast car also none", None, 9500),
+    ]
+    for did, desc, make, price in docs:
+        body = {"desc": desc, "price": price}
+        if make is not None:
+            body["make"] = make
+        c.index("cars", body, id=did)
+    c.indices.refresh("cars")
+    return c
+
+
+class TestCollapse:
+    def test_one_hit_per_keyword_group(self, client):
+        r = client.search("cars", {
+            "query": {"match": {"desc": "car"}},
+            "collapse": {"field": "make"},
+            "size": 10,
+        })
+        hits = r["hits"]["hits"]
+        makes = [h["fields"]["make"][0] for h in hits]
+        # one hit per make + one null group
+        non_null = [m for m in makes if m is not None]
+        assert len(non_null) == len(set(non_null)) == 3
+        assert makes.count(None) == 1
+        # total still counts all matching docs
+        assert r["hits"]["total"]["value"] == 8
+        # best scoring doc of each group is the representative
+        full = client.search("cars", {"query": {"match": {"desc": "car"}},
+                                      "size": 20})
+        best = {}
+        for h in full["hits"]["hits"]:
+            mk = h["_source"].get("make")
+            if mk is not None and mk not in best:
+                best[mk] = h["_id"]
+        for h in hits:
+            mk = h["fields"]["make"][0]
+            if mk is not None:
+                assert h["_id"] == best[mk]
+
+    def test_collapse_numeric_field(self, client):
+        r = client.search("cars", {
+            "query": {"match": {"desc": "car"}},
+            "collapse": {"field": "price"},
+            "size": 20,
+        })
+        prices = [h["fields"]["price"][0] for h in r["hits"]["hits"]]
+        assert len(prices) == len(set(prices)) == 8  # all prices distinct
+
+    def test_collapse_with_sort(self, client):
+        r = client.search("cars", {
+            "query": {"match": {"desc": "car"}},
+            "collapse": {"field": "make"},
+            "sort": [{"price": {"order": "desc"}}],
+            "size": 10,
+        })
+        hits = r["hits"]["hits"]
+        got = {h["fields"]["make"][0]: h["_source"]["price"] for h in hits}
+        # highest price per make wins under price-desc sort
+        assert got["honda"] == 25000
+        assert got["toyota"] == 22000
+        assert got["ford"] == 30000
+        assert got[None] == 9500
+        # result ordering follows the sort
+        prices = [h["_source"]["price"] for h in hits]
+        assert prices == sorted(prices, reverse=True)
+
+    def test_inner_hits_expansion(self, client):
+        r = client.search("cars", {
+            "query": {"match": {"desc": "fast"}},
+            "collapse": {"field": "make",
+                         "inner_hits": {"name": "same_make", "size": 5,
+                                        "sort": [{"price": "asc"}]}},
+            "size": 10,
+        })
+        for h in r["hits"]["hits"]:
+            mk = h["fields"]["make"][0]
+            ih = h["inner_hits"]["same_make"]["hits"]
+            if mk == "honda":
+                assert [g["_id"] for g in ih["hits"]] == ["1", "2"]  # price asc
+                assert ih["total"]["value"] == 2
+
+    def test_collapse_rejects_script_sort(self, client):
+        with pytest.raises(ApiError):
+            client.search("cars", {
+                "query": {"match_all": {}},
+                "collapse": {"field": "make"},
+                "sort": [{"_script": {"script": "doc['price'].value",
+                                      "type": "number"}}]})
+
+    def test_pagination_over_groups(self, client):
+        r1 = client.search("cars", {"query": {"match": {"desc": "car"}},
+                                    "collapse": {"field": "make"},
+                                    "size": 2, "from": 0})
+        r2 = client.search("cars", {"query": {"match": {"desc": "car"}},
+                                    "collapse": {"field": "make"},
+                                    "size": 2, "from": 2})
+        ids1 = {h["_id"] for h in r1["hits"]["hits"]}
+        ids2 = {h["_id"] for h in r2["hits"]["hits"]}
+        assert len(ids1) == 2 and len(ids2) == 2 and not (ids1 & ids2)

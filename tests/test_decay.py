@@ -1,0 +1,140 @@
+"""function_score decay functions (gauss/exp/linear) vs hand-computed
+reference values (reference `functionscore/DecayFunctionBuilder.java`)."""
+
+import math
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("homes", {"mappings": {"properties": {
+        "desc": {"type": "text"},
+        "price": {"type": "double"},
+        "listed": {"type": "date"},
+        "loc": {"type": "geo_point"},
+    }}})
+    docs = [
+        {"desc": "cozy home", "price": 100.0, "listed": "2026-01-10",
+         "loc": {"lat": 40.0, "lon": -70.0}},
+        {"desc": "cozy cottage", "price": 150.0, "listed": "2026-01-20",
+         "loc": {"lat": 40.5, "lon": -70.0}},
+        {"desc": "cozy loft", "price": 300.0, "listed": "2026-02-20",
+         "loc": {"lat": 42.0, "lon": -70.0}},
+        {"desc": "cozy cabin"},  # no price/listed/loc
+    ]
+    for i, d in enumerate(docs):
+        c.index("homes", d, id=str(i))
+    c.indices.refresh("homes")
+    return c
+
+
+def _scores(resp):
+    return {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+
+
+def _base_scores(client):
+    return _scores(client.search("homes", {
+        "query": {"match": {"desc": "cozy"}}, "size": 10}))
+
+
+class TestNumericDecay:
+    def test_gauss(self, client):
+        base = _base_scores(client)
+        r = client.search("homes", {"query": {"function_score": {
+            "query": {"match": {"desc": "cozy"}},
+            "functions": [{"gauss": {"price": {
+                "origin": 100, "scale": 100, "decay": 0.5}}}],
+        }}, "size": 10})
+        got = _scores(r)
+        for did, price in (("0", 100.0), ("1", 150.0), ("2", 300.0)):
+            d = abs(price - 100.0)
+            expected = base[did] * math.exp(math.log(0.5) / 100.0**2 * d * d)
+            assert got[did] == pytest.approx(expected, rel=1e-5)
+        # missing value -> factor 1
+        assert got["3"] == pytest.approx(base["3"], rel=1e-5)
+
+    def test_exp_with_offset(self, client):
+        base = _base_scores(client)
+        r = client.search("homes", {"query": {"function_score": {
+            "query": {"match": {"desc": "cozy"}},
+            "functions": [{"exp": {"price": {
+                "origin": 100, "scale": 50, "offset": 25, "decay": 0.4}}}],
+        }}, "size": 10})
+        got = _scores(r)
+        for did, price in (("0", 100.0), ("1", 150.0), ("2", 300.0)):
+            d = max(abs(price - 100.0) - 25.0, 0.0)
+            expected = base[did] * math.exp(math.log(0.4) / 50.0 * d)
+            assert got[did] == pytest.approx(expected, rel=1e-5)
+
+    def test_linear_clamps_to_zero(self, client):
+        base = _base_scores(client)
+        r = client.search("homes", {"query": {"function_score": {
+            "query": {"match": {"desc": "cozy"}},
+            "functions": [{"linear": {"price": {
+                "origin": 100, "scale": 50, "decay": 0.5}}}],
+        }}, "size": 10})
+        got = _scores(r)
+        s = 50.0 / 0.5
+        for did, price in (("0", 100.0), ("1", 150.0)):
+            d = abs(price - 100.0)
+            assert got[did] == pytest.approx(base[did] * max(0.0, (s - d) / s),
+                                             rel=1e-5)
+        # price=300 -> d=200 > s=100 -> factor 0 -> score 0 (still matches)
+        assert got["2"] == pytest.approx(0.0, abs=1e-6)
+
+
+class TestDateGeoDecay:
+    def test_date_gauss_ordering(self, client):
+        r = client.search("homes", {"query": {"function_score": {
+            "query": {"match": {"desc": "cozy"}},
+            "functions": [{"gauss": {"listed": {
+                "origin": "2026-01-10", "scale": "10d"}}}],
+        }}, "size": 10})
+        got = _scores(r)
+        assert got["0"] > got["1"] > got["2"]
+        # 10 days from origin at decay 0.5 -> factor ~0.5
+        base = _base_scores(client)
+        assert got["1"] / base["1"] == pytest.approx(0.5, rel=1e-3)
+
+    def test_geo_exp_ordering(self, client):
+        r = client.search("homes", {"query": {"function_score": {
+            "query": {"match": {"desc": "cozy"}},
+            "functions": [{"exp": {"loc": {
+                "origin": {"lat": 40.0, "lon": -70.0},
+                "scale": "100km"}}}],
+        }}, "size": 10})
+        got = _scores(r)
+        base = _base_scores(client)
+        assert got["0"] == pytest.approx(base["0"], rel=1e-4)  # d = 0
+        assert got["1"] > got["2"]
+        # ~55.6km north at scale 100km decay .5
+        expected = base["1"] * math.exp(math.log(0.5) / 100_000 * 55_597.5)
+        assert got["1"] == pytest.approx(expected, rel=1e-2)
+
+    def test_decay_with_filter_and_weight(self, client):
+        base = _base_scores(client)
+        r = client.search("homes", {"query": {"function_score": {
+            "query": {"match": {"desc": "cozy"}},
+            "functions": [
+                {"gauss": {"price": {"origin": 100, "scale": 100}},
+                 "filter": {"term": {"desc": "cottage"}}, "weight": 2.0},
+            ],
+            "score_mode": "multiply",
+        }}, "size": 10})
+        got = _scores(r)
+        d = 50.0
+        fac = 2.0 * math.exp(math.log(0.5) / 100.0**2 * d * d)
+        assert got["1"] == pytest.approx(base["1"] * fac, rel=1e-5)
+        # docs failing the filter keep base score (neutral factor)
+        assert got["0"] == pytest.approx(base["0"], rel=1e-5)
+
+    def test_bad_decay_400(self, client):
+        from opensearch_tpu.rest.client import ApiError
+        with pytest.raises(ApiError):
+            client.search("homes", {"query": {"function_score": {
+                "functions": [{"gauss": {"price": {"origin": 1}}}]}}})

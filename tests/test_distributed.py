@@ -176,3 +176,72 @@ def test_stacked_index_doc_bases(corpus):
     bases = np.asarray(stacked.doc_base)
     assert bases[0] == 0
     assert (np.diff(bases) == np.array([s.ndocs for s in segs[:-1]])).all()
+
+
+# ---------------------------------------------------------------------
+# REST search == mesh search: the SPMD path wired into the Node
+# ---------------------------------------------------------------------
+
+class TestMeshService:
+    @pytest.fixture(scope="class")
+    def clients(self):
+        """Two clients over identically-populated 4-shard indices: one with
+        the mesh service, one host-loop."""
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        rng = np.random.default_rng(3)
+        cm = RestClient(node=Node(mesh_service=MeshSearchService()))
+        ch = RestClient()
+        for c in (cm, ch):
+            c.indices.create("idx", {"settings": {"number_of_shards": 4}})
+            bulk = []
+            for i in range(400):
+                bulk.append({"index": {"_index": "idx", "_id": str(i)}})
+                bulk.append({"body": " ".join(
+                    rng.choice(WORDS, size=int(rng.integers(3, 12))))})
+            rng = np.random.default_rng(3)  # same docs for both clients
+            c.bulk(bulk)
+            c.indices.refresh("idx")
+            c.indices.forcemerge("idx")
+        return cm, ch
+
+    @pytest.mark.parametrize("body", [
+        {"query": {"match": {"body": "alpha beta"}}, "size": 10},
+        {"query": {"term": {"body": "gamma"}}, "size": 5},
+        {"query": {"match": {"body": {"query": "delta eps zeta",
+                                      "minimum_should_match": 2}}}, "size": 8},
+    ])
+    def test_rest_equals_mesh(self, clients, body):
+        cm, ch = clients
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh path did not engage"
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        ids_m = [h["_id"] for h in rm["hits"]["hits"]]
+        ids_h = [h["_id"] for h in rh["hits"]["hits"]]
+        assert ids_m == ids_h
+        sm = np.array([h["_score"] for h in rm["hits"]["hits"]])
+        sh = np.array([h["_score"] for h in rh["hits"]["hits"]])
+        np.testing.assert_allclose(sm, sh, rtol=1e-5)
+
+    def test_complex_query_falls_back(self, clients):
+        cm, ch = clients
+        body = {"query": {"bool": {"must": [{"match": {"body": "alpha"}}],
+                                   "filter": [{"term": {"body": "beta"}}]}},
+                "size": 5}
+        before = cm.node.mesh_service.fallbacks
+        rm = cm.search(index="idx", body=body)
+        rh = ch.search(index="idx", body=body)
+        assert cm.node.mesh_service.fallbacks > before
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        assert [h["_id"] for h in rm["hits"]["hits"]] == \
+            [h["_id"] for h in rh["hits"]["hits"]]
+
+    def test_mesh_stats_exposed(self, clients):
+        cm, _ = clients
+        st = cm.node.stats()
+        assert st["mesh"]["dispatched"] >= 1

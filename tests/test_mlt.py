@@ -1,0 +1,92 @@
+"""more_like_this (reference `index/query/MoreLikeThisQueryBuilder.java`)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("posts", {"mappings": {"properties": {
+        "title": {"type": "text"}, "body": {"type": "text"}}}})
+    docs = [
+        ("1", "distributed search engines",
+         "lucene lucene elasticsearch opensearch sharding replication "
+         "lucene inverted index postings"),
+        ("2", "search engine internals",
+         "lucene lucene postings postings skip lists scoring bm25 lucene"),
+        ("3", "cooking pasta",
+         "boil water salt pasta sauce tomato basil olive oil"),
+        ("4", "tpu programming",
+         "mxu systolic array hbm bandwidth pallas kernels xla fusion"),
+        ("5", "more search stuff",
+         "postings lucene scoring ranking retrieval postings lucene"),
+    ]
+    for did, title, body in docs:
+        c.index("posts", {"title": title, "body": body}, id=did)
+    c.indices.refresh("posts")
+    return c
+
+
+class TestMoreLikeThis:
+    def test_like_doc_excludes_self(self, client):
+        r = client.search("posts", {"query": {"more_like_this": {
+            "fields": ["body"], "like": [{"_id": "1"}],
+            "min_term_freq": 1, "min_doc_freq": 1}}})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert "1" not in ids                 # include=false default
+        assert ids and ids[0] in ("2", "5")   # lucene/postings-heavy docs win
+        assert "3" not in ids                 # pasta shares nothing
+
+    def test_include_true(self, client):
+        r = client.search("posts", {"query": {"more_like_this": {
+            "fields": ["body"], "like": [{"_id": "1"}], "include": True,
+            "min_term_freq": 1, "min_doc_freq": 1}}})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert ids[0] == "1"                  # the doc matches itself best
+
+    def test_like_free_text(self, client):
+        r = client.search("posts", {"query": {"more_like_this": {
+            "fields": ["body"], "like": "lucene postings scoring",
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": "2<70%"}}})
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert ids and ids <= {"1", "2", "5"}
+
+    def test_min_term_freq_filters(self, client):
+        # with min_term_freq=2 only terms repeated in the like text qualify
+        r = client.search("posts", {"query": {"more_like_this": {
+            "fields": ["body"], "like": "mxu mxu pallas",
+            "min_term_freq": 2, "min_doc_freq": 1}}})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert ids == ["4"]
+
+    def test_unlike_suppresses_terms(self, client):
+        r = client.search("posts", {"query": {"more_like_this": {
+            "fields": ["body"], "like": "lucene postings tomato",
+            "unlike": "tomato",
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": 1}}})
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert "3" not in ids
+
+    def test_multi_field(self, client):
+        r = client.search("posts", {"query": {"more_like_this": {
+            "fields": ["title", "body"], "like": "search engines lucene",
+            "min_term_freq": 1, "min_doc_freq": 1,
+            "minimum_should_match": 1}}})
+        assert r["hits"]["total"]["value"] >= 2
+
+    def test_no_like_is_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("posts", {"query": {"more_like_this": {
+                "fields": ["body"]}}})
+
+    def test_doc_inline(self, client):
+        r = client.search("posts", {"query": {"more_like_this": {
+            "fields": ["body"],
+            "like": [{"doc": {"body": "pasta sauce tomato basil"}}],
+            "min_term_freq": 1, "min_doc_freq": 1}}})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert ids == ["3"]

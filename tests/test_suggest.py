@@ -1,0 +1,139 @@
+"""Suggesters (reference `search/suggest/`): term (DirectSpellChecker
+analog), phrase (gram LM), completion (prefix automaton analog)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("sugg", {
+        "settings": {"analysis": {"analyzer": {"shingler": {
+            "type": "custom", "tokenizer": "standard",
+            "filter": ["lowercase", "shingle"]}}}},
+        "mappings": {"properties": {
+            "body": {"type": "text"},
+            "grams": {"type": "text", "analyzer": "shingler"},
+            "sug": {"type": "completion"},
+        }}})
+    docs = [
+        "the quick brown fox jumps over the lazy dog",
+        "the quick brown fox is quick and brown",
+        "a lazy dog sleeps all day long",
+        "quick foxes are rarely lazy",
+        "the brown bear eats honey",
+    ]
+    for i, d in enumerate(docs):
+        c.index("sugg", {"body": d, "grams": d,
+                         "sug": {"input": [d.split()[1], d.split()[2]],
+                                 "weight": 10 - i}}, id=str(i))
+    # completion docs with richer inputs
+    c.index("sugg", {"sug": [{"input": ["quixotic", "quizzical"],
+                              "weight": 50}]}, id="c1")
+    c.index("sugg", {"sug": "plainstring"}, id="c2")
+    c.indices.refresh("sugg")
+    return c
+
+
+class TestTermSuggester:
+    def test_missing_mode_corrects_typo(self, client):
+        r = client.search("sugg", {"suggest": {
+            "sp": {"text": "quick brwon fx", "term": {
+                "field": "body", "min_word_length": 2}}}, "size": 0})
+        sug = r["suggest"]["sp"]
+        assert [e["text"] for e in sug] == ["quick", "brwon", "fx"]
+        # "quick" exists -> no options in missing mode
+        assert sug[0]["options"] == []
+        assert sug[1]["options"][0]["text"] == "brown"
+        assert sug[1]["options"][0]["freq"] >= 3
+        assert sug[2]["options"][0]["text"] == "fox"
+
+    def test_always_mode_and_sort_frequency(self, client):
+        r = client.search("sugg", {"suggest": {
+            "sp": {"text": "quick", "term": {
+                "field": "body", "suggest_mode": "always",
+                "sort": "frequency", "max_edits": 2,
+                "min_word_length": 2}}}, "size": 0})
+        opts = r["suggest"]["sp"][0]["options"]
+        if len(opts) > 1:
+            freqs = [o["freq"] for o in opts]
+            assert freqs == sorted(freqs, reverse=True)
+
+    def test_offsets(self, client):
+        r = client.search("sugg", {"suggest": {
+            "sp": {"text": "lazi dog", "term": {"field": "body",
+                                                "min_word_length": 2}}},
+            "size": 0})
+        e0, e1 = r["suggest"]["sp"]
+        assert (e0["offset"], e0["length"]) == (0, 4)
+        assert (e1["offset"], e1["length"]) == (5, 3)
+        assert e0["options"][0]["text"] == "lazy"
+
+
+class TestPhraseSuggester:
+    def test_corrects_with_bigram_grams(self, client):
+        r = client.search("sugg", {"suggest": {
+            "ph": {"text": "quick brwon fox", "phrase": {
+                "field": "body", "gram_field": "grams",
+                "highlight": {"pre_tag": "<em>", "post_tag": "</em>"}}}},
+            "size": 0})
+        opts = r["suggest"]["ph"][0]["options"]
+        assert opts, "no phrase suggestions returned"
+        assert opts[0]["text"] == "quick brown fox"
+        assert opts[0]["highlighted"] == "quick <em>brown</em> fox"
+
+    def test_confidence_suppresses_good_input(self, client):
+        r = client.search("sugg", {"suggest": {
+            "ph": {"text": "quick brown fox", "phrase": {
+                "field": "body", "gram_field": "grams",
+                "confidence": 2.0}}}, "size": 0})
+        opts = r["suggest"]["ph"][0]["options"]
+        # correct input at high confidence: no strictly-better rewrite
+        assert all(o["text"] == "quick brown fox" for o in opts)
+
+
+class TestCompletionSuggester:
+    def test_prefix_weight_order(self, client):
+        r = client.search("sugg", {"suggest": {
+            "cp": {"prefix": "qui", "completion": {"field": "sug"}}},
+            "size": 0})
+        opts = r["suggest"]["cp"][0]["options"]
+        assert opts[0]["text"] in ("quixotic", "quizzical")
+        assert opts[0]["_score"] == 50.0
+        texts = [o["text"] for o in opts]
+        assert any(t.startswith("qui") for t in texts)
+
+    def test_skip_duplicates_and_plain_string(self, client):
+        r = client.search("sugg", {"suggest": {
+            "cp": {"prefix": "plain", "completion": {
+                "field": "sug", "skip_duplicates": True}}}, "size": 0})
+        opts = r["suggest"]["cp"][0]["options"]
+        assert [o["text"] for o in opts] == ["plainstring"]
+        assert opts[0]["_id"] == "c2"
+
+    def test_fuzzy_completion(self, client):
+        r = client.search("sugg", {"suggest": {
+            "cp": {"prefix": "qvix", "completion": {
+                "field": "sug", "fuzzy": {"fuzziness": 2}}}}, "size": 0})
+        opts = r["suggest"]["cp"][0]["options"]
+        assert any(o["text"] == "quixotic" for o in opts)
+
+
+class TestSuggestErrors:
+    def test_unknown_kind_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("sugg", {"suggest": {"x": {"frob": {}}}})
+
+    def test_missing_text_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("sugg", {"suggest": {"x": {"term": {
+                "field": "body"}}}})
+
+    def test_global_text(self, client):
+        r = client.search("sugg", {"suggest": {
+            "text": "lazi",
+            "a": {"term": {"field": "body", "min_word_length": 2}},
+        }, "size": 0})
+        assert r["suggest"]["a"][0]["options"][0]["text"] == "lazy"

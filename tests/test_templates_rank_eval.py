@@ -1,0 +1,148 @@
+"""Search templates (reference `modules/lang-mustache/`) and the _rank_eval
+API (reference `modules/rank-eval/`)."""
+
+import math
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.rest.templates import render_template
+
+
+class TestMustacheLite:
+    def test_scalars_and_paths(self):
+        out = render_template(
+            {"query": {"match": {"{{fld}}": "{{q.text}}"}}, "size": "{{sz}}"},
+            {"fld": "title", "q": {"text": "hello"}, "sz": 5})
+        # quoted placeholders render as strings; the API coerces numerics
+        assert out == {"query": {"match": {"title": "hello"}}, "size": "5"}
+
+    def test_to_json_and_sections(self):
+        src = ('{"query": {"terms": {"tag": {{#toJson}}tags{{/toJson}} }}'
+               '{{#paged}}, "from": {{from}}{{/paged}} }')
+        out = render_template(src, {"tags": ["a", "b"],
+                                    "paged": {"from": 20}})
+        assert out == {"query": {"terms": {"tag": ["a", "b"]}}, "from": 20}
+
+    def test_inverted_and_loop(self):
+        src = ('{"v": [{{#xs}}"{{.}}",{{/xs}}{{^xs}}"none",{{/xs}} "end"]}')
+        assert render_template(src, {"xs": ["p", "q"]}) == \
+            {"v": ["p", "q", "end"]}
+        assert render_template(src, {}) == {"v": ["none", "end"]}
+
+    def test_join(self):
+        src = '{"q": "{{#join}}words{{/join}}"}'
+        assert render_template(src, {"words": ["a", "b", "c"]}) == \
+            {"q": "a,b,c"}
+
+    def test_string_escaping(self):
+        out = render_template({"query": {"match": {"t": "{{v}}"}}},
+                              {"v": 'he said "hi"\n'})
+        assert out["query"]["match"]["t"] == 'he said "hi"\n'
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("lib", {"mappings": {"properties": {
+        "title": {"type": "text"}, "year": {"type": "long"}}}})
+    books = [("1", "the art of search", 2001), ("2", "searching at scale", 2015),
+             ("3", "cooking for two", 2019), ("4", "search engines deep dive", 2020)]
+    for did, title, year in books:
+        c.index("lib", {"title": title, "year": year}, id=did)
+    c.indices.refresh("lib")
+    return c
+
+
+class TestSearchTemplateEndpoints:
+    def test_inline_source(self, client):
+        r = client.search_template("lib", {
+            "source": {"query": {"match": {"title": "{{q}}"}},
+                       "size": "{{size}}"},
+            "params": {"q": "search", "size": 2}})
+        assert len(r["hits"]["hits"]) == 2
+
+    def test_stored_template_roundtrip(self, client):
+        client.put_script("findbook", {"script": {
+            "lang": "mustache",
+            "source": {"query": {"match": {"title": "{{q}}"}}}}})
+        got = client.get_script("findbook")
+        assert got["found"]
+        r = client.search_template("lib", {"id": "findbook",
+                                           "params": {"q": "cooking"}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["3"]
+        client.delete_script("findbook")
+        with pytest.raises(ApiError):
+            client.get_script("findbook")
+
+    def test_render_endpoint(self, client):
+        r = client.render_search_template({
+            "source": '{"query": {"range": {"year": {"gte": {{y}}}}}}',
+            "params": {"y": 2015}})
+        assert r["template_output"] == \
+            {"query": {"range": {"year": {"gte": 2015}}}}
+
+    def test_msearch_template(self, client):
+        r = client.msearch_template([
+            {"index": "lib"},
+            {"source": {"query": {"match": {"title": "{{q}}"}}},
+             "params": {"q": "search"}},
+            {"index": "lib"},
+            {"id": "missing-template", "params": {}},
+        ])
+        assert r["responses"][0]["hits"]["total"]["value"] == 2
+        assert "error" in r["responses"][1]
+
+
+class TestRankEval:
+    def test_precision_and_recall(self, client):
+        body = {
+            "requests": [{
+                "id": "q1",
+                "request": {"query": {"match": {"title": "search"}}},
+                "ratings": [{"_index": "lib", "_id": "1", "rating": 1},
+                            {"_index": "lib", "_id": "2", "rating": 1},
+                            {"_index": "lib", "_id": "3", "rating": 0}],
+            }],
+            "metric": {"precision": {"k": 3,
+                                     "relevant_rating_threshold": 1}},
+        }
+        r = client.rank_eval("lib", body)
+        d = r["details"]["q1"]
+        # hits are 1,4 (no stemming: "searching" != "search"); 4 unrated
+        # counts as non-relevant
+        assert d["metric_score"] == pytest.approx(1 / 2)
+        assert {u["_id"] for u in d["unrated_docs"]} == {"4"}
+        body["metric"] = {"recall": {"k": 3}}
+        r = client.rank_eval("lib", body)
+        assert r["metric_score"] == pytest.approx(0.5)  # 1 of 2 relevant found
+
+    def test_mrr_and_ndcg_and_err(self, client):
+        reqs = [{
+            "id": "q",
+            "request": {"query": {"match": {"title": "search"}}},
+            "ratings": [{"_index": "lib", "_id": "4", "rating": 3},
+                        {"_index": "lib", "_id": "2", "rating": 1}],
+        }]
+        r = client.rank_eval("lib", {"requests": reqs, "metric": {
+            "mean_reciprocal_rank": {"k": 5}}})
+        assert 0 < r["metric_score"] <= 1.0
+        r = client.rank_eval("lib", {"requests": reqs, "metric": {
+            "dcg": {"k": 5, "normalize": True}}})
+        assert 0 < r["metric_score"] <= 1.0
+        r = client.rank_eval("lib", {"requests": reqs, "metric": {
+            "expected_reciprocal_rank": {"k": 5, "maximum_relevance": 3}}})
+        assert 0 < r["metric_score"] <= 1.0
+
+    def test_bad_metric_400(self, client):
+        with pytest.raises(ApiError):
+            client.rank_eval("lib", {"requests": [],
+                                     "metric": {"nope": {}}})
+
+    def test_failures_collected(self, client):
+        r = client.rank_eval("lib", {
+            "requests": [{"id": "bad",
+                          "request": {"query": {"zap": {}}},
+                          "ratings": []}],
+            "metric": {"precision": {"k": 2}}})
+        assert "bad" in r["failures"]

@@ -105,6 +105,27 @@ def test_chunked_oversized_rows(client):
         fastpath.MAX_L, fastpath.MAX_TL = old_l, old_tl
 
 
+def test_high_tf_packing(client):
+    """tf in [1024, 2047] sets the i32 sign bit in the packed tf·dl word;
+    the kernel must mask after its arithmetic shift (regression)."""
+    c = RestClient()
+    c.indices.create("hightf")
+    c.index("hightf", {"body": "word " * 1500 + "other"}, id="big")
+    c.index("hightf", {"body": "word other things"}, id="small")
+    c.indices.refresh("hightf")
+    for qi, q in enumerate(("word other", "word")):
+        body = {"query": {"match": {"body": q}}, "size": 5, "_p": qi}
+        fastpath.set_enabled(True)
+        fast = c.search(index="hightf", body=body)
+        fastpath.set_enabled(False)
+        slow = c.search(index="hightf", body=body)
+        fastpath.set_enabled(True)
+        assert _hits(fast) == _hits(slow)
+    # single-term: tf saturation beats length norm -> 1500x doc wins; a
+    # sign-extended tf would send its score negative instead
+    assert fast["hits"]["hits"][0]["_id"] == "big"
+
+
 def test_msearch_batched_parity(client):
     msb = []
     for q in ("w1 w2", "w5", "w3 w7 w11", "common w250"):
