@@ -12,7 +12,7 @@ from __future__ import annotations
 import os
 import shutil
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..analysis import AnalysisRegistry
 from ..index.engine import Engine
@@ -46,13 +46,128 @@ class IndexService:
                                                 similarity=self.default_sim,
                                                 index_key=meta.name))
         self.generation = 0  # bumped on refresh/writes: request-cache key part
+        self._init_replicas()
+
+    def _init_replicas(self) -> None:
+        """Allocate shard copies over devices and build replica shards
+        (segment replication: replicas re-host the primary's immutable
+        segments on their own device — cluster/replication.py)."""
+        import jax
+
+        from ..parallel.placement import ShardAllocator
+        from .replication import ReplicaShard
+
+        devices = jax.devices()
+        self.allocator = ShardAllocator(len(devices))
+        self.table = self.allocator.allocate(self.meta.num_shards,
+                                             self.meta.num_replicas)
+        self.replicas: Dict[Tuple[int, int], ReplicaShard] = {}
+        self.replica_searchers: Dict[Tuple[int, int], ShardSearcher] = {}
+        self._devices = devices
+        for copy in self.table.copies:
+            if copy.primary or copy.device is None:
+                continue
+            self._build_replica(copy)
+        self._rr = 0
+
+    def _build_replica(self, copy) -> None:
+        from .replication import ReplicaShard
+
+        dev = self._devices[copy.device]
+        rep = ReplicaShard(self.shards[copy.shard], copy.shard,
+                           copy.replica, device=dev)
+        rep.sync(warm=False)  # adopt recovered/restored segments now
+        self.replicas[(copy.shard, copy.replica)] = rep
+        s = ShardSearcher(self.shards[copy.shard], shard_id=copy.shard,
+                          similarity=self.default_sim,
+                          index_key=self.meta.name, device=dev)
+        s.replica = rep
+        self.replica_searchers[(copy.shard, copy.replica)] = s
+
+    def fail_device(self, device_ord: int) -> None:
+        """Device (chip) failure: re-allocate its shard copies and rebuild
+        the moved replicas on their new devices; a lost primary promotes a
+        surviving replica first (reference allocation + promotion flow)."""
+        lost_primaries = [c.shard for c in self.table.copies
+                          if c.primary and c.device == device_ord]
+        for sid in lost_primaries:
+            self.fail_primary(sid)
+        changed = self.allocator.fail_device(device_ord, self.table)
+        for copy in changed:
+            key = (copy.shard, copy.replica)
+            self.replicas.pop(key, None)
+            self.replica_searchers.pop(key, None)
+            if not copy.primary and copy.device is not None:
+                self._build_replica(copy)
+        self.generation += 1
 
     def route(self, doc_id: str, routing: Optional[str] = None) -> Engine:
         return self.shards[shard_for(routing or doc_id, self.meta.num_shards)]
 
+    def search_copies(self) -> List[ShardSearcher]:
+        """One searcher per shard, round-robin across started copies
+        (reference OperationRouting preference=round-robin replica fan-out)."""
+        self._rr += 1
+        out = []
+        for sid in range(self.meta.num_shards):
+            copies = [c for c in self.table.for_shard(sid)
+                      if c.state == "STARTED"]
+            if not copies:
+                out.append(self.searchers[sid])
+                continue
+            pick = copies[self._rr % len(copies)]
+            if pick.primary:
+                out.append(self.searchers[sid])
+            else:
+                out.append(self.replica_searchers[(sid, pick.replica)])
+        return out
+
+    def fail_primary(self, shard_id: int) -> None:
+        """Simulate primary loss: promote a started replica (segments it has
+        already synced) and rebuild its searcher. Raises if no replica."""
+        from .replication import promote_to_primary
+
+        cand = [(k, r) for k, r in self.replicas.items()
+                if k[0] == shard_id and r.state == "STARTED"]
+        if not cand:
+            raise ClusterStateError(
+                f"no started replica to promote for shard [{shard_id}]")
+        (key, rep) = cand[0]
+        new_primary = promote_to_primary(self.mappings, rep,
+                                         self.shards[shard_id].primary_term + 1)
+        self.shards[shard_id] = new_primary
+        self.searchers[shard_id] = ShardSearcher(
+            new_primary, shard_id=shard_id, similarity=self.default_sim,
+            index_key=self.meta.name, device=rep.device)
+        # the promoted copy takes over the primary slot in the table;
+        # remaining replicas track the new primary
+        del self.replicas[key]
+        del self.replica_searchers[key]
+        pcopy = next(c for c in self.table.for_shard(shard_id) if c.primary)
+        rcopy = next(c for c in self.table.for_shard(shard_id)
+                     if c.replica == key[1])
+        pcopy.device = rcopy.device
+        pcopy.state = "STARTED"
+        self.table.copies.remove(rcopy)
+        for (sid, rid), r in self.replicas.items():
+            if sid == shard_id:
+                r.primary = new_primary
+                r.sync()
+                self.replica_searchers[(sid, rid)].engine = new_primary
+        self.generation += 1
+
+    def health_status(self) -> str:
+        if any(c.state != "STARTED" and c.primary for c in self.table.copies):
+            return "red"
+        if any(c.state != "STARTED" for c in self.table.copies):
+            return "yellow"
+        return "green"
+
     def refresh(self) -> None:
         for s in self.shards:
             s.refresh()
+        for rep in self.replicas.values():
+            rep.sync()
         self.generation += 1
 
     def flush(self) -> None:
@@ -63,6 +178,10 @@ class IndexService:
     def force_merge(self, max_num_segments: int = 1) -> None:
         for s in self.shards:
             s.force_merge(max_num_segments)
+        # merged segments replace the shared objects; replicas must adopt
+        # them or deletes against the merged set stay invisible on copies
+        for rep in self.replicas.values():
+            rep.sync()
         self.generation += 1
 
     @property
@@ -332,7 +451,7 @@ class Node:
         gens = []
         for name in names:
             svc = self.indices[name]
-            searchers.extend(svc.searchers)
+            searchers.extend(svc.search_copies())
             gens.append(svc.generation)
         # request cache (deterministic bodies only)
         import json as _json
@@ -350,11 +469,6 @@ class Node:
                                                 self.indices[names[0]], body)
         if resp is None:
             resp = search_shards(searchers, body, index_name=",".join(names))
-        # stamp per-hit index names
-        by_searcher = {}
-        for name in names:
-            for s in self.indices[name].searchers:
-                by_searcher[id(s)] = name
         if len(names) == 1:
             for h in resp["hits"]["hits"]:
                 h["_index"] = names[0]

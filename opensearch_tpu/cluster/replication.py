@@ -1,0 +1,72 @@
+"""Segment replication + primary failover.
+
+Reference `indices/replication/OngoingSegmentReplications.java` /
+`SegmentReplicationTargetService` and the primary-promotion path in
+`cluster/routing/allocation/`. The TPU translation: segments are immutable
+host arrays re-hosted per device, so "copying segment files to the replica"
+becomes `Segment.device_arrays(replica_device)` — a device_put of the same
+arrays onto the replica's chip. Replicas never index; they sync the
+primary's refreshed segment list at each checkpoint (refresh), exactly the
+reference's NRT-segment-replication read path, and can be promoted to
+primary by seeding a fresh Engine with their synced segments.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..index.engine import DocLocation, Engine
+from ..index.segment import Segment
+
+
+class ReplicaShard:
+    """A read-only shard copy at the last published checkpoint."""
+
+    def __init__(self, primary: Engine, shard_id: int, replica_id: int,
+                 device=None):
+        self.primary = primary
+        self.shard_id = shard_id
+        self.replica_id = replica_id
+        self.device = device
+        self.segments: List[Segment] = []
+        self.checkpoint = -1       # primary seq_no this copy has synced to
+        self.state = "STARTED"
+
+    def sync(self, warm: bool = True) -> None:
+        """Publish checkpoint: adopt the primary's current segment list and
+        (optionally) re-host the arrays on this copy's device now rather
+        than at first search."""
+        self.segments = list(self.primary.segments)
+        self.checkpoint = self.primary.seq_no
+        if warm and self.device is not None:
+            for seg in self.segments:
+                seg.device_arrays(self.device)
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.live_count for s in self.segments)
+
+
+def promote_to_primary(mappings, replica: ReplicaShard,
+                       primary_term: int) -> Engine:
+    """Build a fresh primary Engine over the replica's synced segments
+    (reference: replica promotion replays the safe commit; with segment
+    replication the synced segments ARE the safe commit)."""
+    eng = Engine(mappings, primary_term=primary_term)
+    eng.segments = list(replica.segments)
+    seq = -1
+    for seg in eng.segments:
+        for local, doc_id in enumerate(seg.ids):
+            s = int(seg.seq_nos[local])
+            seq = max(seq, s)
+            if seg.live[local]:
+                cur = eng.version_map.get(doc_id)
+                if cur is None or s >= cur.seq_no:
+                    eng.version_map[doc_id] = DocLocation(
+                        s, in_buffer=False, segment=seg, local_doc=local)
+    eng.seq_no = seq
+    # keep fresh segment names unique under the new primary
+    for seg in eng.segments:
+        num = int(seg.name.lstrip("_m").lstrip("_") or 0)
+        eng._seg_counter = max(eng._seg_counter, num + 1)
+    return eng

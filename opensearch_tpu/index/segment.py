@@ -42,6 +42,18 @@ def next_pow2(n: int, floor: int = 16) -> int:
     return 1 << (n - 1).bit_length()
 
 
+class _DevicePut:
+    """jnp stand-in whose asarray lands on a specific device (replica
+    re-hosting path in Segment.device_arrays)."""
+
+    def __init__(self, device):
+        self.device = device
+
+    def asarray(self, x):
+        import jax
+        return jax.device_put(np.asarray(x), self.device)
+
+
 def _pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
     out = np.full(size, fill, dtype=arr.dtype)
     out[: len(arr)] = arr
@@ -225,14 +237,18 @@ class Segment:
         self.live = np.ones(ndocs, dtype=bool)
         self.live_gen = 0
         self.id2doc: Dict[str, int] = {d: i for i, d in enumerate(ids)}
-        self._device: Optional[dict] = None
-        self._device_live_dirty = True
+        # per-device host->HBM residency: key None = process default device;
+        # replicas re-host the SAME immutable arrays on their own device
+        # (segment replication, reference indices/replication/)
+        self._device_cache: Dict[Any, dict] = {}
+        self._device_live_dirty: Dict[Any, bool] = {}
 
     # ---------------- live docs / deletes ----------------
 
     def delete_doc(self, local_doc: int) -> None:
         self.live[local_doc] = False
-        self._device_live_dirty = True
+        for k in self._device_live_dirty:
+            self._device_live_dirty[k] = True
         self.live_gen += 1  # invalidates live-dependent host caches
 
     @property
@@ -245,13 +261,19 @@ class Segment:
     def ndocs_pad(self) -> int:
         return next_pow2(self.ndocs)
 
-    def device_arrays(self) -> dict:
+    def device_arrays(self, device=None) -> dict:
         """The pytree of device-resident arrays consumed by `ops` kernels.
         Shapes are padded to pow2 buckets; structure is stable across segments
-        of the same index so jitted plans re-hit the XLA compile cache."""
+        of the same index so jitted plans re-hit the XLA compile cache.
+        `device`: re-host on a specific device (replica placement); None =
+        the process default."""
+        import jax
         import jax.numpy as jnp
 
-        if self._device is None:
+        key = device
+        if key not in self._device_cache:
+            if device is not None:
+                jnp = _DevicePut(device)  # route jnp.asarray onto the device
             dpad = self.ndocs_pad
             post = {}
             for f, pb in self.postings.items():
@@ -310,27 +332,31 @@ class Segment:
             # jit arguments and poison static shape derivation downstream
             nst = {}
             for path, blk in self.nested.items():
-                carr = dict(blk.child.device_arrays())
+                carr = dict(blk.child.device_arrays(device))
                 cpad = blk.child.ndocs_pad
                 # padded children map to parent 0 but carry live=0, so every
                 # scatter-reduce contribution from padding is identically zero
                 carr["parent"] = jnp.asarray(
                     _pad_to(blk.parent_of.astype(np.int32), cpad, np.int32(0)))
                 nst[path] = carr
-            self._device = {
+            self._device_cache[key] = {
                 "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
                 "vector": vcols, "doc_lens": dls, "nested": nst,
             }
-        if self._device_live_dirty:
+            self._device_live_dirty[key] = True
+        if self._device_live_dirty.get(key, True):
             import jax.numpy as jnp
-            self._device["live"] = jnp.asarray(
-                _pad_to(self.live.astype(np.float32), self.ndocs_pad, np.float32(0)))
-            self._device_live_dirty = False
-        return self._device
+            live = _pad_to(self.live.astype(np.float32), self.ndocs_pad,
+                           np.float32(0))
+            self._device_cache[key]["live"] = (
+                jnp.asarray(live) if device is None
+                else jax.device_put(live, device))
+            self._device_live_dirty[key] = False
+        return self._device_cache[key]
 
     def drop_device(self) -> None:
-        self._device = None
-        self._device_live_dirty = True
+        self._device_cache = {}
+        self._device_live_dirty = {}
         for blk in self.nested.values():
             blk.child.drop_device()
 

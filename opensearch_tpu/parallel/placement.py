@@ -1,0 +1,101 @@
+"""Shard→device placement: the allocation decider layer.
+
+Reference `cluster/routing/allocation/` (BalancedShardsAllocator +
+SameShardAllocationDecider): copies of the same shard never share a device,
+load balances by copy count per device, and failed devices trigger
+re-allocation of their copies.
+
+In the TPU runtime a "node" is a device (chip): primaries and replicas are
+re-hosted immutable segment arrays on their assigned device
+(Segment.device_arrays(device)), so placement == where those arrays live and
+which chip serves that copy's searches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class ShardCopy:
+    shard: int
+    replica: int          # 0 = primary
+    device: Optional[int] # device ordinal, None = unassigned
+    state: str = "STARTED"  # STARTED | UNASSIGNED
+
+    @property
+    def primary(self) -> bool:
+        return self.replica == 0
+
+
+@dataclass
+class AllocationTable:
+    copies: List[ShardCopy] = dc_field(default_factory=list)
+
+    def for_shard(self, shard: int) -> List[ShardCopy]:
+        return [c for c in self.copies if c.shard == shard]
+
+    def assigned(self) -> List[ShardCopy]:
+        return [c for c in self.copies if c.device is not None]
+
+    def unassigned(self) -> List[ShardCopy]:
+        return [c for c in self.copies if c.device is None]
+
+
+class ShardAllocator:
+    """Round-robin with same-shard awareness over a set of live devices."""
+
+    def __init__(self, n_devices: int):
+        self.n_devices = n_devices
+        self.failed: set = set()
+
+    def live_devices(self) -> List[int]:
+        return [d for d in range(self.n_devices) if d not in self.failed]
+
+    def allocate(self, n_shards: int, n_replicas: int) -> AllocationTable:
+        table = AllocationTable()
+        load: Dict[int, int] = {d: 0 for d in self.live_devices()}
+        for s in range(n_shards):
+            used: set = set()
+            for r in range(n_replicas + 1):
+                dev = self._pick(load, used)
+                table.copies.append(ShardCopy(s, r, dev,
+                                              "STARTED" if dev is not None
+                                              else "UNASSIGNED"))
+                if dev is not None:
+                    used.add(dev)
+                    load[dev] += 1
+        return table
+
+    def _pick(self, load: Dict[int, int], exclude: set) -> Optional[int]:
+        """Least-loaded live device not already holding a copy of this shard
+        (SameShardAllocationDecider: a replica never lands with its
+        primary)."""
+        cands = [d for d in load if d not in exclude]
+        if not cands:
+            return None
+        return min(cands, key=lambda d: (load[d], d))
+
+    def fail_device(self, device: int, table: AllocationTable
+                    ) -> List[ShardCopy]:
+        """Mark a device failed and re-allocate its copies elsewhere.
+        Returns the copies that changed (new device or UNASSIGNED)."""
+        self.failed.add(device)
+        load: Dict[int, int] = {d: 0 for d in self.live_devices()}
+        for c in table.copies:
+            if c.device is not None and c.device in load:
+                load[c.device] += 1
+        changed = []
+        for c in table.copies:
+            if c.device != device:
+                continue
+            peers = {p.device for p in table.for_shard(c.shard)
+                     if p is not c and p.device is not None}
+            dev = self._pick(load, peers | {device})
+            c.device = dev
+            c.state = "STARTED" if dev is not None else "UNASSIGNED"
+            if dev is not None:
+                load[dev] += 1
+            changed.append(c)
+        return changed

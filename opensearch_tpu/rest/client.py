@@ -843,12 +843,31 @@ class ClusterClient:
 
     def health(self, index: Optional[str] = None) -> dict:
         node = self.c.node
-        shard_count = sum(s.meta.num_shards for s in node.indices.values())
-        return {"cluster_name": node.metadata.cluster_name, "status": "green",
+        names = (node.metadata.resolve(index) if index
+                 else list(node.indices.keys()))
+        primaries = active = unassigned = 0
+        status = "green"
+        rank = {"green": 0, "yellow": 1, "red": 2}
+        for n in names:
+            svc = node.indices[n]
+            for c in svc.table.copies:
+                if c.state == "STARTED":
+                    active += 1
+                    if c.primary:
+                        primaries += 1
+                else:
+                    unassigned += 1
+            s = svc.health_status()
+            if rank[s] > rank[status]:
+                status = s
+        total = active + unassigned
+        return {"cluster_name": node.metadata.cluster_name, "status": status,
                 "number_of_nodes": 1, "number_of_data_nodes": 1,
-                "active_primary_shards": shard_count, "active_shards": shard_count,
+                "active_primary_shards": primaries, "active_shards": active,
                 "relocating_shards": 0, "initializing_shards": 0,
-                "unassigned_shards": 0, "active_shards_percent_as_number": 100.0}
+                "unassigned_shards": unassigned,
+                "active_shards_percent_as_number":
+                    100.0 * active / total if total else 100.0}
 
     def state(self) -> dict:
         node = self.c.node
@@ -870,10 +889,32 @@ class CatClient:
         out = []
         for n, svc in sorted(self.c.node.indices.items()):
             st = svc.stats()
-            out.append({"health": "green", "status": "open", "index": n,
-                        "pri": str(svc.meta.num_shards), "rep": "0",
+            out.append({"health": svc.health_status(), "status": "open",
+                        "index": n,
+                        "pri": str(svc.meta.num_shards),
+                        "rep": str(svc.meta.num_replicas),
                         "docs.count": str(st["docs"]["count"]),
                         "store.size": str(st["store"]["size_in_bytes"])})
+        return out
+
+    def shards(self, index: str = "_all", format: str = "json") -> List[dict]:
+        """_cat/shards: one row per shard copy with its device placement."""
+        out = []
+        node = self.c.node
+        for n in sorted(node.metadata.resolve(index)):
+            svc = node.indices[n]
+            for c in sorted(svc.table.copies, key=lambda c: (c.shard, c.replica)):
+                if c.primary:
+                    docs = svc.shards[c.shard].num_docs
+                else:
+                    rep = svc.replicas.get((c.shard, c.replica))
+                    docs = rep.num_docs if rep else 0
+                out.append({"index": n, "shard": str(c.shard),
+                            "prirep": "p" if c.primary else "r",
+                            "state": c.state,
+                            "docs": str(docs),
+                            "node": (f"device-{c.device}"
+                                     if c.device is not None else "")})
         return out
 
     def count(self, index: str = "_all") -> List[dict]:

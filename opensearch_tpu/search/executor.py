@@ -76,7 +76,7 @@ class ShardSearcher:
 
     def __init__(self, engine: Engine, shard_id: int = 0,
                  similarity=None, field_similarities=None,
-                 index_key: Optional[str] = None):
+                 index_key: Optional[str] = None, device=None):
         self.engine = engine
         self.shard_id = shard_id
         self.similarity = similarity
@@ -84,6 +84,10 @@ class ShardSearcher:
         # shards sharing an index_key share collection statistics (DFS);
         # standalone searchers all fall into one default group
         self.index_key = index_key
+        # replica read path (cluster/replication.py): segments come from the
+        # replica's synced checkpoint, arrays hosted on its device
+        self.device = device
+        self.replica = None
 
     def context(self) -> C.ShardContext:
         return C.ShardContext(self.engine.mappings, self.engine.segments,
@@ -102,7 +106,9 @@ class ShardSearcher:
         t0 = time.monotonic()
         if shard_ord is None:
             shard_ord = self.shard_id
-        segments = segments if segments is not None else list(self.engine.segments)
+        if segments is None:
+            segments = (list(self.replica.segments) if self.replica is not None
+                        else list(self.engine.segments))
         ctx = stats_ctx or C.ShardContext(self.engine.mappings, segments,
                                           self.similarity, self.field_similarities)
         query = dsl.parse_query(body.get("query")) if (body.get("query")
@@ -150,7 +156,7 @@ class ShardSearcher:
         # Pallas fast path: plain BM25 term-group top-k goes through the
         # fused kernel (search/fastpath.py); anything it can't serve falls
         # back to the general XLA plan per segment
-        fast_ok = (fastpath.enabled()
+        fast_ok = (fastpath.enabled() and self.device is None
                    and fastpath.query_eligible(lroot, sort_specs, agg_nodes,
                                                named_nodes, search_after,
                                                window, body))
@@ -200,8 +206,8 @@ class ShardSearcher:
             cspec = C.prepare_collapse(collapse, seg, ctx, params)
             try:
                 out = C.run_segment(qspec, sspec, agg_specs, named_specs, k_pad,
-                                    seg.device_arrays(), params, has_after,
-                                    collapse_spec=cspec)
+                                    seg.device_arrays(self.device), params,
+                                    has_after, collapse_spec=cspec)
             except _ScriptError as e:
                 # device-script trace failures are user errors (HTTP 400)
                 raise dsl.QueryParseError(f"script compile error: {e}")
@@ -317,7 +323,7 @@ class ShardSearcher:
                     params: Dict[str, Any] = {}
                     qspec = C.prepare(lroot, seg, ctx, params)
                     aspec = C.prepare_agg(an, seg, ctx, params, "rs")
-                    out = C.run_agg_only(qspec, aspec, seg.device_arrays(), params)
+                    out = C.run_agg_only(qspec, aspec, seg.device_arrays(self.device), params)
                     new_parts.append(_device_agg_to_partial(an, aspec, out, seg, ctx))
                 result.agg_partials[an.name] = new_parts
             finally:
@@ -335,7 +341,7 @@ class ShardSearcher:
             params: Dict[str, Any] = {}
             rspec = C.prepare(lr, seg, ctx, params)
             docs = np.where(valid, idx, INT32_SENTINEL % seg.ndocs_pad).astype(np.int32)
-            rscores, rmatched = C.run_gather_scores(rspec, seg.device_arrays(), params,
+            rscores, rmatched = C.run_gather_scores(rspec, seg.device_arrays(self.device), params,
                                                     np.minimum(docs, seg.ndocs_pad - 1))
             rscores = np.asarray(rscores)
             rmatched = np.asarray(rmatched)
@@ -405,7 +411,7 @@ class ShardSearcher:
             cparams: Dict[str, Any] = {}
             cspec = C.prepare(lnode, cseg, ctx, cparams)
             docs = np.arange(cseg.ndocs_pad, dtype=np.int32)
-            sc, cm = C.run_gather_scores(cspec, cseg.device_arrays(), cparams, docs)
+            sc, cm = C.run_gather_scores(cspec, cseg.device_arrays(self.device), cparams, docs)
             ih_cache[key] = (np.asarray(sc), np.asarray(cm))
         return ih_cache[key]
 
@@ -492,7 +498,7 @@ class ShardSearcher:
             cspec = C.prepare(inner_l, blk.child, child_ctx, cparams)
             docs = np.arange(blk.child.ndocs_pad, dtype=np.int32)
             scores, matched = C.run_gather_scores(
-                cspec, blk.child.device_arrays(), cparams, docs)
+                cspec, blk.child.device_arrays(self.device), cparams, docs)
             ih_cache[key] = (np.asarray(scores), np.asarray(matched))
         scores, matched = ih_cache[key]
         a, b = blk.children_of(c.local_doc)

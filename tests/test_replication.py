@@ -1,0 +1,156 @@
+"""Replicas, segment replication, allocation, failover (reference
+`indices/replication/`, `cluster/routing/allocation/`). Runs on the 8-device
+virtual CPU mesh from conftest, so replica copies land on real (virtual)
+devices."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from opensearch_tpu.parallel.placement import ShardAllocator
+from opensearch_tpu.rest.client import RestClient
+
+WORDS = ["alpha", "beta", "gamma", "delta", "eps", "zeta"]
+
+
+class TestAllocator:
+    def test_same_shard_never_shares_device(self):
+        alloc = ShardAllocator(4)
+        table = alloc.allocate(n_shards=3, n_replicas=2)
+        for s in range(3):
+            devs = [c.device for c in table.for_shard(s)]
+            assert len(devs) == len(set(devs)) == 3
+        # balanced: 9 copies over 4 devices -> max 3 per device
+        by_dev = {}
+        for c in table.copies:
+            by_dev[c.device] = by_dev.get(c.device, 0) + 1
+        assert max(by_dev.values()) <= 3
+
+    def test_unassigned_when_devices_exhausted(self):
+        alloc = ShardAllocator(1)
+        table = alloc.allocate(n_shards=1, n_replicas=1)
+        assert table.for_shard(0)[0].state == "STARTED"
+        assert table.for_shard(0)[1].state == "UNASSIGNED"
+
+    def test_fail_device_reallocates(self):
+        alloc = ShardAllocator(3)
+        table = alloc.allocate(n_shards=2, n_replicas=1)
+        victim = table.for_shard(0)[1].device
+        changed = alloc.fail_device(victim, table)
+        assert changed
+        for c in table.copies:
+            assert c.device != victim
+        for s in range(2):
+            devs = [c.device for c in table.for_shard(s)
+                    if c.device is not None]
+            assert len(devs) == len(set(devs))
+
+
+@pytest.fixture
+def client():
+    rng = np.random.default_rng(11)
+    c = RestClient()
+    c.indices.create("r", {"settings": {"number_of_shards": 2,
+                                        "number_of_replicas": 1},
+                           "mappings": {"properties": {
+                               "body": {"type": "text"}}}})
+    for i in range(120):
+        c.index("r", {"body": " ".join(rng.choice(WORDS, size=5))}, id=str(i))
+    c.indices.refresh("r")
+    return c
+
+
+class TestReplication:
+    def test_replicas_allocated_and_synced(self, client):
+        svc = client.node.indices["r"]
+        assert len(svc.replicas) == 2       # 1 replica per shard
+        for (sid, _rid), rep in svc.replicas.items():
+            assert rep.segments == svc.shards[sid].segments
+            assert rep.checkpoint == svc.shards[sid].seq_no
+        health = client.cluster.health()
+        assert health["status"] == "green"
+        assert health["active_shards"] == 4
+
+    def test_replica_serves_identical_results(self, client):
+        svc = client.node.indices["r"]
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 20}
+        results = []
+        for _ in range(4):  # round-robin cycles primary/replica copies
+            r = client.search("r", dict(body, _probe=len(results)))
+            results.append((r["hits"]["total"]["value"],
+                            tuple((h["_id"], round(h["_score"], 5))
+                                  for h in r["hits"]["hits"])))
+        assert len({t for t, _ in results}) == 1
+        assert len({h for _, h in results}) == 1
+
+    def test_round_robin_uses_replicas(self, client):
+        svc = client.node.indices["r"]
+        picked = set()
+        for _ in range(6):
+            for s in svc.search_copies():
+                picked.add(id(s))
+        # 2 shards x 2 copies = 4 distinct searchers over the cycle
+        assert len(picked) == 4
+
+    def test_replica_lags_until_refresh(self, client):
+        svc = client.node.indices["r"]
+        client.index("r", {"body": "zeta omega"}, id="new1")
+        # primary buffer has it; replica checkpoint does not
+        for (sid, _), rep in svc.replicas.items():
+            assert rep.checkpoint < svc.shards[sid].seq_no or \
+                svc.shards[sid].seq_no == rep.checkpoint
+        client.indices.refresh("r")
+        for (sid, _), rep in svc.replicas.items():
+            assert rep.checkpoint == svc.shards[sid].seq_no
+
+    def test_cat_shards_shows_copies(self, client):
+        rows = client.cat.shards("r")
+        assert len(rows) == 4
+        assert {r["prirep"] for r in rows} == {"p", "r"}
+        assert all(r["state"] == "STARTED" for r in rows)
+        # copies of one shard never share a device
+        for sid in ("0", "1"):
+            devs = [r["node"] for r in rows if r["shard"] == sid]
+            assert len(set(devs)) == 2
+
+    def test_failover_promotes_replica(self, client):
+        svc = client.node.indices["r"]
+        before = client.search("r", {"query": {"match": {"body": "alpha"}},
+                                     "size": 30, "_probe": "pre"})
+        docs0 = svc.shards[0].num_docs
+        svc.fail_primary(0)
+        after = client.search("r", {"query": {"match": {"body": "alpha"}},
+                                    "size": 30, "_probe": "post"})
+        assert after["hits"]["total"] == before["hits"]["total"]
+        assert [h["_id"] for h in after["hits"]["hits"]] == \
+            [h["_id"] for h in before["hits"]["hits"]]
+        assert svc.shards[0].num_docs == docs0
+        # the promoted primary accepts writes
+        client.index("r", {"body": "alpha fresh"},
+                     id="post-failover", refresh=True)
+        got = client.get("r", "post-failover")
+        assert got["found"]
+
+    def test_fail_device_end_to_end(self, client):
+        svc = client.node.indices["r"]
+        before = client.search("r", {"query": {"match": {"body": "beta"}},
+                                     "size": 30, "_probe": "dev-pre"})
+        # kill the device holding shard 0's primary
+        victim = next(c.device for c in svc.table.for_shard(0) if c.primary)
+        svc.fail_device(victim)
+        assert all(c.device != victim for c in svc.table.copies
+                   if c.device is not None)
+        # every started replica copy has a live ReplicaShard on its device
+        for c in svc.table.copies:
+            if not c.primary and c.state == "STARTED":
+                assert (c.shard, c.replica) in svc.replicas
+        after = client.search("r", {"query": {"match": {"body": "beta"}},
+                                    "size": 30, "_probe": "dev-post"})
+        assert after["hits"]["total"] == before["hits"]["total"]
+
+    def test_zero_replicas_single_device_is_green(self):
+        c = RestClient()
+        c.indices.create("nr", {"settings": {"number_of_shards": 1,
+                                             "number_of_replicas": 0}})
+        assert c.node.indices["nr"].health_status() == "green"
