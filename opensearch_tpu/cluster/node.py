@@ -91,7 +91,15 @@ class IndexService:
         lost_primaries = [c.shard for c in self.table.copies
                           if c.primary and c.device == device_ord]
         for sid in lost_primaries:
-            self.fail_primary(sid)
+            try:
+                self.fail_primary(sid)
+            except ClusterStateError:
+                # no replica to promote: the shard goes unassigned and the
+                # index reports red (reference allocation on primary loss)
+                pcopy = next(c for c in self.table.for_shard(sid)
+                             if c.primary)
+                pcopy.device = None
+                pcopy.state = "UNASSIGNED"
         changed = self.allocator.fail_device(device_ord, self.table)
         for copy in changed:
             key = (copy.shard, copy.replica)
@@ -113,8 +121,7 @@ class IndexService:
             copies = [c for c in self.table.for_shard(sid)
                       if c.state == "STARTED"]
             if not copies:
-                out.append(self.searchers[sid])
-                continue
+                continue  # shard lost entirely -> partial results (red)
             pick = copies[self._rr % len(copies)]
             if pick.primary:
                 out.append(self.searchers[sid])

@@ -86,6 +86,53 @@ def value_count_keyword(kw: dict, match: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(_gather_match(match, kw["doc_of_value"]))
 
 
+def weighted_avg_agg(v: jnp.ndarray, v_present: jnp.ndarray,
+                     w: jnp.ndarray, w_present: jnp.ndarray,
+                     match: jnp.ndarray,
+                     v_missing, w_missing,
+                     has_v_missing: bool, has_w_missing: bool):
+    """Σ value·weight and Σ weight over matched docs (reference
+    WeightedAvgAggregator): docs missing value or weight are skipped unless
+    the corresponding `missing` default is configured."""
+    veff = jnp.where(v_present, v, v_missing)
+    weff = jnp.where(w_present, w, w_missing)
+    ok = match > 0
+    if not has_v_missing:
+        ok = ok & v_present
+    if not has_w_missing:
+        ok = ok & w_present
+    okf = ok.astype(jnp.float32)
+    return (jnp.sum(okf * veff * weff), jnp.sum(okf * weff), jnp.sum(okf))
+
+
+def geo_bounds_agg(lat: jnp.ndarray, lon: jnp.ndarray, present: jnp.ndarray,
+                   match: jnp.ndarray):
+    """(top, bottom, left, right, count) masked extremes (reference
+    GeoBoundsAggregator, wrap_longitude=false semantics)."""
+    ok = (match > 0) & present
+    count = jnp.sum(ok.astype(jnp.float32))
+    top = jnp.max(jnp.where(ok, lat, -F32_MAX))
+    bottom = jnp.min(jnp.where(ok, lat, F32_MAX))
+    left = jnp.min(jnp.where(ok, lon, F32_MAX))
+    right = jnp.max(jnp.where(ok, lon, -F32_MAX))
+    return top, bottom, left, right, count
+
+
+def geo_centroid_agg(lat: jnp.ndarray, lon: jnp.ndarray, present: jnp.ndarray,
+                     match: jnp.ndarray):
+    """(Σlat, Σlon, count) (reference GeoCentroidAggregator)."""
+    w = match * jnp.where(present, 1.0, 0.0)
+    return jnp.sum(w * lat), jnp.sum(w * lon), jnp.sum(w)
+
+
+def ord_counts(ords: jnp.ndarray, match: jnp.ndarray, nord_pad: int
+               ) -> jnp.ndarray:
+    """Doc-major single-valued ordinal bincount (multi_terms combined ords,
+    grid ords): ord < 0 = missing -> dropped."""
+    o = jnp.where(ords >= 0, ords, nord_pad)
+    return jnp.zeros(nord_pad, jnp.float32).at[o].add(match, mode="drop")
+
+
 def cardinality_keyword(kw: dict, match: jnp.ndarray, nvocab_pad: int) -> jnp.ndarray:
     """Exact distinct count via ordinals (the reference uses global ords +
     HLL; segment-local ords are exact on-device, merged across segments on

@@ -27,10 +27,14 @@ import numpy as np
 BUCKET_KINDS = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "filter", "filters", "global", "missing", "significant_terms",
                 "sampler", "geohash_grid", "geotile_grid", "nested",
-                "reverse_nested", "children", "parent", "composite"}
+                "reverse_nested", "children", "parent", "composite",
+                "ip_range", "rare_terms", "multi_terms", "adjacency_matrix",
+                "auto_date_histogram", "significant_text",
+                "diversified_sampler"}
 METRIC_KINDS = {"min", "max", "sum", "avg", "stats", "extended_stats",
                 "value_count", "cardinality", "percentiles", "top_hits",
-                "matrix_stats"}
+                "matrix_stats", "weighted_avg", "median_absolute_deviation",
+                "geo_bounds", "geo_centroid", "scripted_metric"}
 PIPELINE_KINDS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
                   "stats_bucket", "cumulative_sum", "derivative", "bucket_script",
                   "bucket_selector", "moving_avg", "moving_fn", "serial_diff",
@@ -82,7 +86,8 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
     if not parts:
         return {}
     kind = node.kind
-    if kind in ("terms", "geohash_grid", "geotile_grid"):
+    if kind in ("terms", "geohash_grid", "geotile_grid", "rare_terms",
+                "multi_terms"):
         return {"buckets": _acc_buckets(node, parts)}
     if kind in ("histogram", "date_histogram"):
         acc = {}
@@ -95,7 +100,8 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
             slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
         return {"buckets": acc, "interval": parts[0]["interval"],
                 "offset": parts[0].get("offset", 0.0), "keyed_fmt": parts[0].get("keyed_fmt")}
-    if kind in ("range", "date_range", "filters"):
+    if kind in ("range", "date_range", "filters", "ip_range",
+                "adjacency_matrix"):
         acc = {}
         for p in parts:
             for key, rec in p["buckets"].items():
@@ -106,11 +112,12 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
             slot["subs"] = _merge_subtrees(node.subs, slot["subs"])
         return {"buckets": acc}
     if kind in ("filter", "global", "missing", "sampler", "nested",
-                "reverse_nested", "children", "parent"):
+                "reverse_nested", "children", "parent",
+                "diversified_sampler"):
         total = sum(p["doc_count"] for p in parts)
         subs = _merge_subtrees(node.subs, [p.get("subs") for p in parts])
         return {"doc_count": total, "subs": subs}
-    if kind == "significant_terms":
+    if kind in ("significant_terms", "significant_text"):
         bg: Dict[Any, int] = {}
         for p in parts:
             for key, c in p["bg"].items():
@@ -118,6 +125,45 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
         return {"buckets": _acc_buckets(node, parts), "bg": bg,
                 "fg_total": sum(p["fg_total"] for p in parts),
                 "bg_total": sum(p["bg_total"] for p in parts)}
+    if kind == "weighted_avg":
+        return {"vwsum": sum(p["vwsum"] for p in parts),
+                "wsum": sum(p["wsum"] for p in parts),
+                "count": sum(p["count"] for p in parts)}
+    if kind == "median_absolute_deviation":
+        hist = parts[0]["hist"].copy()
+        for p in parts[1:]:
+            hist += p["hist"]
+        return {"hist": hist}
+    if kind == "geo_bounds":
+        live = [p for p in parts if p["count"] > 0]
+        if not live:
+            return {"count": 0}
+        return {"count": sum(p["count"] for p in live),
+                "top": max(p["top"] for p in live),
+                "bottom": min(p["bottom"] for p in live),
+                "left": min(p["left"] for p in live),
+                "right": max(p["right"] for p in live)}
+    if kind == "geo_centroid":
+        return {"count": sum(p["count"] for p in parts),
+                "slat": sum(p.get("slat", 0.0) for p in parts),
+                "slon": sum(p.get("slon", 0.0) for p in parts)}
+    if kind == "scripted_metric":
+        return {"states": [s for p in parts for s in p["states"]]}
+    if kind == "auto_date_histogram":
+        # shards may have rounded at different intervals: coarsen everything
+        # to the widest before accumulating (reference
+        # InternalAutoDateHistogram#reduce)
+        interval = max(p["interval_ms"] for p in parts)
+        acc: Dict[Any, dict] = {}
+        for p in parts:
+            for key, rec in p["buckets"].items():
+                ck = (int(key) // interval) * interval
+                slot = acc.setdefault(ck, {"doc_count": 0, "subs": []})
+                slot["doc_count"] += rec["doc_count"]
+                slot["subs"].append(rec.get("subs"))
+        for slot in acc.values():
+            slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
+        return {"buckets": acc, "interval_ms": interval}
     if kind == "composite":
         return {"buckets": _acc_buckets(node, parts)}
     if kind == "matrix_stats":
@@ -261,7 +307,8 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
             buckets[key] = entry
         return {"buckets": buckets}
     if kind in ("filter", "global", "missing", "sampler", "nested",
-                "reverse_nested", "children", "parent"):
+                "reverse_nested", "children", "parent",
+                "diversified_sampler"):
         out = {"doc_count": int(merged["doc_count"])}
         for sub in node.subs:
             out[sub.name] = finalize(sub, merged["subs"].get(sub.name, {}), pipelines)
@@ -319,7 +366,172 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
         return {"hits": {"total": {"value": int(merged["total"]), "relation": "eq"},
                          "max_score": merged["hits"][0]["_score"] if merged["hits"] else None,
                          "hits": merged["hits"]}}
+    if kind == "weighted_avg":
+        w = merged.get("wsum", 0.0)
+        return {"value": None if not w else merged["vwsum"] / w}
+    if kind == "median_absolute_deviation":
+        return {"value": _mad_from_hist(merged["hist"])}
+    if kind == "geo_bounds":
+        if not merged or merged.get("count", 0) == 0:
+            return {}
+        return {"bounds": {
+            "top_left": {"lat": float(merged["top"]),
+                         "lon": float(merged["left"])},
+            "bottom_right": {"lat": float(merged["bottom"]),
+                             "lon": float(merged["right"])}}}
+    if kind == "geo_centroid":
+        c = merged.get("count", 0)
+        if not c:
+            return {"count": 0}
+        return {"location": {"lat": float(merged["slat"] / c),
+                             "lon": float(merged["slon"] / c)},
+                "count": int(c)}
+    if kind == "scripted_metric":
+        from ..script.painless_lite import execute
+        body = node.body
+        states = merged.get("states", [])
+        reduce_src = body.get("reduce_script")
+        if reduce_src:
+            src, prm = _script_src(reduce_src)
+            val = execute(src, {"states": states, "params": prm})
+        else:
+            val = states
+        return {"value": val}
+    if kind == "ip_range":
+        buckets = []
+        for key in merged["buckets"]:
+            rec = merged["buckets"][key]
+            entry = {"key": key, "doc_count": int(rec["doc_count"])}
+            if rec.get("meta"):
+                entry.update(rec["meta"])
+            for sub in node.subs:
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}),
+                                           pipelines)
+            buckets.append(entry)
+        result = {"buckets": buckets}
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
+        return result
+    if kind == "rare_terms":
+        max_dc = int(node.body.get("max_doc_count", 1))
+        items = sorted(((k, v) for k, v in merged["buckets"].items()
+                        if 0 < v["doc_count"] <= max_dc),
+                       key=lambda kv: (kv[1]["doc_count"], kv[0]))
+        buckets = []
+        for k, v in items:
+            b = {"key": k, "doc_count": int(v["doc_count"])}
+            for sub in node.subs:
+                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}),
+                                       pipelines)
+            buckets.append(b)
+        result = {"buckets": buckets}
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
+        return result
+    if kind == "multi_terms":
+        size = int(node.body.get("size", 10))
+        items = sorted(((k, v) for k, v in merged["buckets"].items()
+                        if v["doc_count"] > 0),
+                       key=lambda kv: (-kv[1]["doc_count"], kv[0]))
+        buckets = []
+        for k, v in items[:size]:
+            b = {"key": list(k),
+                 "key_as_string": "|".join(str(x) for x in k),
+                 "doc_count": int(v["doc_count"])}
+            for sub in node.subs:
+                b[sub.name] = finalize(sub, v["subs"].get(sub.name, {}),
+                                       pipelines)
+            buckets.append(b)
+        total = sum(v["doc_count"] for _, v in items)
+        shown = sum(b["doc_count"] for b in buckets)
+        result = {"buckets": buckets,
+                  "sum_other_doc_count": int(total - shown)}
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
+        return result
+    if kind == "adjacency_matrix":
+        buckets = []
+        for key in sorted(merged["buckets"]):
+            rec = merged["buckets"][key]
+            if rec["doc_count"] <= 0:
+                continue
+            entry = {"key": key, "doc_count": int(rec["doc_count"])}
+            for sub in node.subs:
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}),
+                                           pipelines)
+            buckets.append(entry)
+        result = {"buckets": buckets}
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
+        return result
+    if kind == "auto_date_histogram":
+        target = max(int(node.body.get("buckets", 10)), 1)
+        interval = merged.get("interval_ms", 1000)
+        buckets = dict(merged.get("buckets", {}))
+        # coarsen until the bucket count fits the target (coordinator-side
+        # final rounding step of the reference)
+        from .compiler import _AUTO_LADDER, auto_interval_name
+        ladder = [ms for ms, _ in _AUTO_LADDER]
+        li = next((i for i, ms in enumerate(ladder) if ms >= interval), 0)
+        while buckets and len(buckets) > target and li + 1 < len(ladder):
+            li += 1
+            interval = ladder[li]
+            acc: Dict[Any, dict] = {}
+            for key, rec in buckets.items():
+                ck = (int(key) // interval) * interval
+                slot = acc.setdefault(ck, {"doc_count": 0, "subs": []})
+                slot["doc_count"] += rec["doc_count"]
+                slot["subs"].append(rec.get("subs"))
+            for slot in acc.values():
+                slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
+            buckets = acc
+        out_buckets = []
+        for key in sorted(buckets):
+            rec = buckets[key]
+            entry = {"key": int(key),
+                     "key_as_string": _format_epoch_ms(int(key)),
+                     "doc_count": int(rec["doc_count"])}
+            for sub in node.subs:
+                entry[sub.name] = finalize(sub, rec["subs"].get(sub.name, {}),
+                                           pipelines)
+            out_buckets.append(entry)
+        result = {"buckets": out_buckets,
+                  "interval": auto_interval_name(interval)}
+        _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
+        return result
+    if kind == "significant_text":
+        return _finalize_significant(node, merged, pipelines)
     raise ValueError(f"cannot finalize aggregation kind [{kind}]")
+
+
+def _script_src(spec):
+    """script spec (str or {"source", "params"}) -> (source, params)."""
+    if isinstance(spec, str):
+        return spec, {}
+    return spec.get("source", ""), spec.get("params", {})
+
+
+def _mad_from_hist(hist: np.ndarray) -> Optional[float]:
+    """Median absolute deviation from the mergeable DDSketch histogram
+    (reference MedianAbsoluteDeviationAggregator over TDigest): median of
+    |bin center - median| weighted by bin counts."""
+    from ..ops.aggs import ddsketch_value
+    total = float(hist.sum())
+    if total == 0:
+        return None
+    nz = np.nonzero(hist)[0]
+    centers = np.array([ddsketch_value(int(b)) for b in nz])
+    weights = hist[nz].astype(np.float64)
+
+    def weighted_median(vals, ws):
+        order = np.argsort(vals)
+        v, w = vals[order], ws[order]
+        cum = np.cumsum(w)
+        half = cum[-1] / 2.0
+        i = int(np.searchsorted(cum, half))
+        if cum[i] == half and i + 1 < len(v):
+            # even split: interpolate like numpy.median / TDigest
+            return float((v[i] + v[i + 1]) / 2.0)
+        return float(v[i])
+
+    med = weighted_median(centers, weights)
+    return weighted_median(np.abs(centers - med), weights)
 
 
 def composite_sources(node: AggNode) -> List[tuple]:
@@ -465,14 +677,24 @@ def _finalize_matrix_stats(merged: dict) -> dict:
 def _empty_result(node: AggNode) -> dict:
     if node.kind in ("terms", "histogram", "date_histogram", "range",
                      "date_range", "filters", "geohash_grid", "geotile_grid",
-                     "composite"):
+                     "composite", "ip_range", "rare_terms", "multi_terms",
+                     "adjacency_matrix", "auto_date_histogram"):
         return {"buckets": [] if node.kind != "filters" else {}}
-    if node.kind == "significant_terms":
+    if node.kind in ("significant_terms", "significant_text"):
         return {"doc_count": 0, "bg_count": 0, "buckets": []}
+    if node.kind in ("weighted_avg", "median_absolute_deviation"):
+        return {"value": None}
+    if node.kind == "geo_bounds":
+        return {}
+    if node.kind == "geo_centroid":
+        return {"count": 0}
+    if node.kind == "scripted_metric":
+        return {"value": None}
     if node.kind == "matrix_stats":
         return {"doc_count": 0, "fields": []}
     if node.kind in ("filter", "global", "missing", "sampler", "nested",
-                     "reverse_nested", "children", "parent"):
+                     "reverse_nested", "children", "parent",
+                     "diversified_sampler"):
         return {"doc_count": 0}
     if node.kind in ("min", "max", "avg"):
         return {"value": None}

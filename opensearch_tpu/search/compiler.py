@@ -2544,6 +2544,92 @@ def _geo_grid_cache(seg: Segment, field: str, kind: str, precision: int):
     return cache[key]
 
 
+# auto_date_histogram rounding ladder (fixed-interval approximation of the
+# reference's calendar ladder — months/years as 30/365 days)
+_AUTO_LADDER = [
+    (1_000, "1s"), (5_000, "5s"), (10_000, "10s"), (30_000, "30s"),
+    (60_000, "1m"), (300_000, "5m"), (600_000, "10m"), (1_800_000, "30m"),
+    (3_600_000, "1h"), (10_800_000, "3h"), (43_200_000, "12h"),
+    (86_400_000, "1d"), (604_800_000, "7d"), (2_592_000_000, "1M"),
+    (7_776_000_000, "3M"), (31_536_000_000, "1y"), (157_680_000_000, "5y"),
+    (315_360_000_000, "10y"), (3_153_600_000_000, "100y"),
+]
+
+
+def _auto_interval(col, target: int) -> int:
+    """Smallest ladder interval giving <= target buckets over the column's
+    span (reference AutoDateHistogramAggregator rounding prepare)."""
+    if col is None or not col.present.any():
+        return _AUTO_LADDER[0][0]
+    mn, mx = col.min_max
+    span = max(mx - mn, 1.0)
+    for ms, _name in _AUTO_LADDER:
+        if span / ms <= target:
+            return ms
+    return _AUTO_LADDER[-1][0]
+
+
+def auto_interval_name(interval_ms: int) -> str:
+    for ms, name in _AUTO_LADDER:
+        if ms == interval_ms:
+            return name
+    return f"{interval_ms}ms"
+
+
+def _multi_terms_cache(seg: Segment, ctx: ShardContext, node, fields: Tuple[str, ...]):
+    """(vocab of key tuples, combined doc-major ordinal i32[ndocs_pad]) for a
+    multi_terms source list; docs missing ANY source are excluded (-1),
+    matching reference MultiTermsAggregator."""
+    cache = getattr(seg, "_multi_terms_cache", None)
+    if cache is None:
+        cache = seg._multi_terms_cache = {}
+    if fields in cache:
+        return cache[fields]
+    per_field = []
+    for f in fields:
+        f = ctx.mappings.aliases.get(f, f)
+        kcol = seg.keyword_cols.get(f)
+        if kcol is not None:
+            per_field.append(("kw", kcol.min_ord[: seg.ndocs], kcol.vocab))
+            continue
+        ncol = seg.numeric_cols.get(f)
+        if ncol is not None:
+            ords = ncol.sort_ords()[: seg.ndocs]
+            vals = sorted({(float(v) if ncol.kind == "float" else int(v))
+                           for v in ncol.values[ncol.present]})
+            per_field.append(("num", ords, vals))
+            continue
+        per_field.append(("none", np.full(seg.ndocs, -1, np.int32), []))
+    combined = np.zeros(seg.ndocs, np.int64)
+    valid = np.ones(seg.ndocs, bool)
+    mult = 1
+    for kind_, ords, vocab in reversed(per_field):
+        valid &= ords >= 0
+        combined += np.maximum(ords, 0).astype(np.int64) * mult
+        mult *= max(len(vocab), 1)
+    uniq, inv = np.unique(combined[valid], return_inverse=True)
+    ords_out = np.full(next_pow2(seg.ndocs), -1, np.int32)
+    ords_out[: seg.ndocs][valid] = inv.astype(np.int32)
+    # decode each unique combined ordinal back to its key tuple
+    mults = []
+    m = 1
+    for _kind, _o, vocab in reversed(per_field):
+        mults.append(m)
+        m *= max(len(vocab), 1)
+    mults.reverse()
+    vocab_out = []
+    for code in uniq:
+        key = []
+        rem = int(code)
+        for (_kind, _o, vocab), mm in zip(per_field, mults):
+            idx = rem // mm
+            rem = rem % mm
+            key.append(vocab[idx] if idx < len(vocab) else None)
+        vocab_out.append(tuple(key))
+    cache[fields] = (vocab_out, ords_out)
+    return cache[fields]
+
+
 def _col_sum(seg: Segment, field: str) -> Tuple[float, int]:
     """(Σ values, present count) of a numeric column, f64, cached per segment
     (segments are immutable apart from deletes, which don't need to perturb a
@@ -2747,6 +2833,28 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
             _scalar_f32(params, f"{prefix}_thr", thr)
         return ("sampler", prefix, shard_size, thr is not None, subs)
 
+    if kind == "diversified_sampler":
+        shard_size = max(int(body.get("shard_size", 100)), 1)
+        maxper = max(int(body.get("max_docs_per_value", 1)), 1)
+        field = ctx.mappings.aliases.get(body.get("field", ""),
+                                        body.get("field", ""))
+        use_kw = field in seg.keyword_cols
+        if not use_kw and field in seg.numeric_cols:
+            ords = seg.numeric_cols[field].sort_ords()
+            params[f"{prefix}_dords"] = np.pad(
+                ords, (0, seg.ndocs_pad - len(ords)), constant_values=-1)
+            n_ord_pad = next_pow2(seg.ndocs + 1)
+        elif use_kw:
+            n_ord_pad = next_pow2(len(seg.keyword_cols[field].vocab) + 1)
+        else:
+            params[f"{prefix}_dords"] = np.full(seg.ndocs_pad, -1, np.int32)
+            n_ord_pad = 2
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
+                     for i, s in enumerate(node.subs))
+        return ("dsampler", prefix, shard_size, field, maxper, use_kw,
+                n_ord_pad, subs)
+
     if kind in ("geohash_grid", "geotile_grid"):
         field = _resolve_agg_field(node, ctx)
         precision = int(body.get("precision",
@@ -2799,6 +2907,137 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
 
     if kind == "composite":
         return _prepare_composite(node, seg, ctx, params, prefix, nest_stack)
+
+    if kind == "weighted_avg":
+        vspec = body.get("value", {})
+        wspec = body.get("weight", {})
+        vfield = ctx.mappings.aliases.get(vspec.get("field", ""),
+                                          vspec.get("field", ""))
+        wfield = ctx.mappings.aliases.get(wspec.get("field", ""),
+                                          wspec.get("field", ""))
+        _scalar_f32(params, f"{prefix}_vmiss", float(vspec.get("missing", 0.0)
+                                                     or 0.0))
+        _scalar_f32(params, f"{prefix}_wmiss", float(wspec.get("missing", 0.0)
+                                                     or 0.0))
+        return ("wavg", prefix, vfield, wfield,
+                vfield in seg.numeric_cols, wfield in seg.numeric_cols,
+                vspec.get("missing") is not None,
+                wspec.get("missing") is not None)
+
+    if kind == "median_absolute_deviation":
+        field = _resolve_agg_field(node, ctx)
+        return ("mad", prefix, field, field in seg.numeric_cols)
+
+    if kind in ("geo_bounds", "geo_centroid"):
+        field = _resolve_agg_field(node, ctx)
+        return ("geo_stat", prefix, kind, field, field in seg.geo_cols)
+
+    if kind == "ip_range":
+        from ..index.mappings import _ip_to_int
+        field = _resolve_agg_field(node, ctx)
+        ranges = body.get("ranges", [])
+        bounds = []
+        keys = []
+        for r in ranges:
+            if "mask" in r:
+                import ipaddress
+                net = ipaddress.ip_network(r["mask"], strict=False)
+                lo = _ip_to_int(str(net.network_address))
+                hi = _ip_to_int(str(net.broadcast_address)) + 1
+                keys.append(r.get("key", r["mask"]))
+                bounds.append((lo, hi, str(net.network_address),
+                               str(net.broadcast_address)))
+            else:
+                lo = _ip_to_int(r["from"]) if r.get("from") else None
+                hi = _ip_to_int(r["to"]) if r.get("to") else None
+                keys.append(r.get("key",
+                                  f"{r.get('from', '*')}-{r.get('to', '*')}"))
+                bounds.append((lo, hi, r.get("from"), r.get("to")))
+        lo_hi = np.zeros(len(bounds), np.int32)
+        lo_lo = np.zeros(len(bounds), np.int32)
+        hi_hi = np.zeros(len(bounds), np.int32)
+        hi_lo = np.zeros(len(bounds), np.int32)
+        open_lo = np.zeros(len(bounds), bool)
+        open_hi = np.zeros(len(bounds), bool)
+        for i, (lo, hi, _f, _t) in enumerate(bounds):
+            if lo is None:
+                open_lo[i] = True
+            else:
+                h, l = split_i64(np.array([lo], np.int64))
+                lo_hi[i], lo_lo[i] = h[0], l[0]
+            if hi is None:
+                open_hi[i] = True
+            else:
+                h, l = split_i64(np.array([hi], np.int64))
+                hi_hi[i], hi_lo[i] = h[0], l[0]
+        params[f"{prefix}_iplo"] = np.stack([lo_hi, lo_lo])
+        params[f"{prefix}_iphi"] = np.stack([hi_hi, hi_lo])
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
+                     for i, s in enumerate(node.subs))
+        return ("ip_range", prefix, field, tuple(keys),
+                tuple((b[2], b[3]) for b in bounds),
+                tuple(bool(x) for x in open_lo), tuple(bool(x) for x in open_hi),
+                field in seg.numeric_cols, subs)
+
+    if kind == "rare_terms":
+        field = _resolve_agg_field(node, ctx)
+        if field not in seg.keyword_cols:
+            return ("terms_missing", prefix)
+        nvocab_pad = next_pow2(max(len(seg.keyword_cols[field].vocab), 1))
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
+                     for i, s in enumerate(node.subs))
+        return ("terms", prefix, field, nvocab_pad, subs)
+
+    if kind == "multi_terms":
+        sources = body.get("terms", [])
+        if len(sources) < 2:
+            raise dsl.QueryParseError(
+                "[multi_terms] requires at least two [terms] sources")
+        vocab, ords = _multi_terms_cache(seg, ctx, node, tuple(
+            s["field"] for s in sources))
+        params[f"{prefix}_mords"] = ords
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
+                     for i, s in enumerate(node.subs))
+        return ("multi_terms", prefix, next_pow2(max(len(vocab), 1)),
+                len(vocab), subs)
+
+    if kind == "adjacency_matrix":
+        raw = body.get("filters", {})
+        sep = body.get("separator", "&")
+        fspecs = []
+        for key in sorted(raw):
+            lnode = rewrite(dsl.parse_query(raw[key]), ctx, scoring=False)
+            fspecs.append((key, prepare(lnode, seg, ctx, params)))
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
+                     for i, s in enumerate(node.subs))
+        return ("adjacency", prefix, tuple(fspecs), sep, subs)
+
+    if kind == "auto_date_histogram":
+        field = _resolve_agg_field(node, ctx)
+        target = max(int(body.get("buckets", 10)), 1)
+        col = seg.numeric_cols.get(field)
+        interval_ms = _auto_interval(col, target)
+        bucket_ids, min_b, nb = _host_date_buckets(seg, field, interval_ms,
+                                                   0, None)
+        pad = np.full(next_pow2(len(bucket_ids)), -1, dtype=np.int32)
+        pad[: len(bucket_ids)] = bucket_ids
+        params[f"{prefix}_dbuckets"] = pad
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
+                     for i, s in enumerate(node.subs))
+        return ("auto_date_hist", prefix, field, interval_ms, target,
+                min_b, nb, subs)
+
+    if kind == "scripted_metric":
+        return ("scripted", prefix)
+
+    if kind == "significant_text":
+        # resolved host-side from the top sampled hits (executor)
+        return ("sig_text", prefix)
 
     if kind == "matrix_stats":
         fields = tuple(body.get("fields", []))
@@ -3275,6 +3514,180 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match, scores=None):  # noqa:
     if kind == "top_hits":
         _, prefix, size = spec
         return {"top_hits_marker": jnp.float32(size)}  # resolved host-side
+
+    if kind == "dsampler":
+        _, prefix, shard_size, dfield, maxper, use_kw, n_ord_pad, subs = spec
+        # pass 1: the plain sampler's best-scoring shard_size matched docs
+        if scores is None:
+            sel = match
+        else:
+            masked = jnp.where(match > 0, scores, -jnp.inf)
+            k = min(shard_size, ndocs_pad)
+            vals, _ = jax.lax.top_k(masked, k)
+            thr = vals[k - 1]
+            thr = jnp.where(jnp.isfinite(thr), thr, -jnp.inf)
+            sel = match * (masked >= thr).astype(jnp.float32)
+        # pass 2: de-bias — keep at most max_docs_per_value docs per key
+        # (reference DiversifiedAggregator): `maxper` rounds of per-key
+        # argmax selection, ties to the lowest doc id (collapse machinery)
+        if use_kw:
+            ords = seg_arrays["keyword"][dfield]["min_ord"]
+        else:
+            ords = params[f"{prefix}_dords"][:ndocs_pad]
+        g = jnp.where(ords >= 0, ords, n_ord_pad - 1).astype(jnp.int32)
+        g = jnp.clip(g, 0, n_ord_pad - 1)
+        sc = scores if scores is not None else jnp.zeros(ndocs_pad, jnp.float32)
+        # docs without a key are each their own group (reference: only keyed
+        # docs dedup); they bypass the rounds and stay selected
+        keyed = ords >= 0
+        remaining = jnp.where((sel > 0) & keyed, sc, -jnp.inf)
+        doc_iota = jnp.arange(ndocs_pad, dtype=jnp.int32)
+        chosen = sel * (~keyed).astype(jnp.float32)
+        for _round in range(maxper):
+            gbest = jnp.full(n_ord_pad, -jnp.inf, jnp.float32).at[g].max(remaining)
+            cand = jnp.where(jnp.isfinite(remaining)
+                             & (remaining == gbest[g]),
+                             doc_iota, jnp.int32(2**31 - 1))
+            gdoc = jnp.full(n_ord_pad, 2**31 - 1, jnp.int32).at[g].min(cand)
+            pick = (doc_iota == gdoc[g]) & jnp.isfinite(remaining)
+            chosen = chosen + pick.astype(jnp.float32)
+            remaining = jnp.where(pick, -jnp.inf, remaining)
+        out = {"doc_count": jnp.sum(chosen)}
+        for i, sub in enumerate(subs):
+            res = emit_agg(sub, seg_arrays, params, chosen, scores)
+            if res:
+                out[f"sub{i}"] = res
+        return out
+
+    if kind == "wavg":
+        _, prefix, vf, wf, v_ok, w_ok, has_vm, has_wm = spec
+        if (not v_ok and not has_vm) or (not w_ok and not has_wm):
+            return {"vwsum": jnp.float32(0), "wsum": jnp.float32(0),
+                    "count": jnp.float32(0)}
+        if v_ok:
+            vcol = seg_arrays["numeric"][vf]
+            v, vp = vcol["f32"], vcol["present"]
+        else:  # absent column + configured missing default: all docs default
+            v = jnp.zeros(ndocs_pad, jnp.float32)
+            vp = jnp.zeros(ndocs_pad, bool)
+        if w_ok:
+            wcol = seg_arrays["numeric"][wf]
+            w, wp = wcol["f32"], wcol["present"]
+        else:
+            w = jnp.zeros(ndocs_pad, jnp.float32)
+            wp = jnp.zeros(ndocs_pad, bool)
+        vw, ws, cnt = agg_ops.weighted_avg_agg(
+            v, vp, w, wp, match,
+            params[f"{prefix}_vmiss"], params[f"{prefix}_wmiss"],
+            has_vm, has_wm)
+        return {"vwsum": vw, "wsum": ws, "count": cnt}
+
+    if kind == "mad":
+        _, prefix, field, col_exists = spec
+        if not col_exists:
+            return {"hist": jnp.zeros(agg_ops.DD_NBINS, jnp.float32)}
+        col = seg_arrays["numeric"][field]
+        return {"hist": agg_ops.ddsketch_hist(col["f32"], col["present"], match)}
+
+    if kind == "geo_stat":
+        _, prefix, gkind, field, col_exists = spec
+        if not col_exists:
+            return {"count": jnp.float32(0)}
+        g = seg_arrays["geo"][field]
+        if gkind == "geo_bounds":
+            top, bottom, left, right, count = agg_ops.geo_bounds_agg(
+                g["lat"], g["lon"], g["present"], match)
+            return {"top": top, "bottom": bottom, "left": left,
+                    "right": right, "count": count}
+        slat, slon, count = agg_ops.geo_centroid_agg(
+            g["lat"], g["lon"], g["present"], match)
+        return {"slat": slat, "slon": slon, "count": count}
+
+    if kind == "ip_range":
+        _, prefix, field, keys, bounds, open_lo, open_hi, col_exists, subs = spec
+        nr = len(keys)
+        if not col_exists:
+            out = {"counts": jnp.zeros(nr, jnp.float32)}
+            return out
+        col = seg_arrays["numeric"][field]
+        iplo = params[f"{prefix}_iplo"]
+        iphi = params[f"{prefix}_iphi"]
+        out = {}
+        counts = []
+        for ri in range(nr):
+            m = col["present"]
+            if not open_lo[ri]:
+                ge = ops.int64_range_mask(col, iplo[0, ri], iplo[1, ri],
+                                          jnp.int32(2**31 - 1),
+                                          jnp.int32(2**31 - 1), True, True)
+                m = m & ge
+            if not open_hi[ri]:
+                lt = ops.int64_range_mask(col, jnp.int32(-2**31),
+                                          jnp.int32(-2**31),
+                                          iphi[0, ri], iphi[1, ri],
+                                          True, False)
+                m = m & lt
+            sel = match * m.astype(jnp.float32)
+            counts.append(jnp.sum(sel))
+            for i, sub in enumerate(subs):
+                res = emit_agg(sub, seg_arrays, params, sel, scores)
+                if res:
+                    out[f"r{ri}_sub{i}"] = res
+        out["counts"] = jnp.stack(counts)
+        return out
+
+    if kind == "multi_terms":
+        _, prefix, nord_pad, nvocab, subs = spec
+        ords = params[f"{prefix}_mords"][:ndocs_pad]
+        out = {"counts": agg_ops.ord_counts(ords, match, nord_pad)}
+        b = jnp.where(ords >= 0, ords, nord_pad)
+        for i, sub in enumerate(subs):
+            out.update(_emit_bucketed_sub(jnp, sub, i, b, nord_pad,
+                                          seg_arrays, match))
+        return out
+
+    if kind == "adjacency":
+        _, prefix, fspecs, sep, subs = spec
+        masks = []
+        out = {}
+        for key, fs in fspecs:
+            masks.append((key, emit(fs, seg_arrays, params).matched))
+        idx = 0
+        for ai, (ka, ma) in enumerate(masks):
+            sel = match * ma.astype(jnp.float32)
+            out[f"c{idx}"] = jnp.sum(sel)
+            for i, sub in enumerate(subs):
+                res = emit_agg(sub, seg_arrays, params, sel, scores)
+                if res:
+                    out[f"c{idx}_sub{i}"] = res
+            idx += 1
+        for ai, (ka, ma) in enumerate(masks):
+            for bi in range(ai + 1, len(masks)):
+                kb, mb = masks[bi]
+                sel = match * (ma & mb).astype(jnp.float32)
+                out[f"c{idx}"] = jnp.sum(sel)
+                for i, sub in enumerate(subs):
+                    res = emit_agg(sub, seg_arrays, params, sel, scores)
+                    if res:
+                        out[f"c{idx}_sub{i}"] = res
+                idx += 1
+        return out
+
+    if kind == "auto_date_hist":
+        _, prefix, field, interval_ms, target, min_b, nb, subs = spec
+        bucket_ids = params[f"{prefix}_dbuckets"][:ndocs_pad]
+        w = match * (bucket_ids >= 0).astype(jnp.float32)
+        b = jnp.where(w > 0, bucket_ids, nb)
+        out = {"counts": jnp.zeros(nb, jnp.float32).at[b].add(w, mode="drop")}
+        for i, sub in enumerate(subs):
+            out.update(_emit_bucketed_sub(jnp, sub, i, b, nb, seg_arrays,
+                                          match))
+        return out
+
+    if kind in ("scripted", "sig_text"):
+        # host-resolved: the partial needs the dense match mask
+        return {"match_mask": match, "score_vec": (scores if scores is not None
+                                                   else jnp.zeros_like(match))}
 
     raise ValueError(f"cannot emit aggregation spec [{kind}]")
 

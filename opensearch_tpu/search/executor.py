@@ -780,7 +780,8 @@ def _finish_search(searchers: List[ShardSearcher],
 _STATS_FAMILY = {"min", "max", "sum", "avg", "stats", "extended_stats",
                  "value_count"}
 _ORDINAL_KINDS = {"terms", "significant_terms", "histogram", "date_histogram",
-                  "geohash_grid", "geotile_grid", "composite"}
+                  "geohash_grid", "geotile_grid", "composite", "rare_terms",
+                  "multi_terms", "auto_date_histogram", "significant_text"}
 _WALK_CONTAINERS = {"filter", "filters", "range", "date_range", "global",
                     "missing"}
 
@@ -952,8 +953,21 @@ def _bucket_filter(node: AggNode, bucket: dict) -> Optional[dict]:
     body = node.body
     field = body.get("field")
     kind = node.kind
-    if kind in ("terms", "significant_terms"):
+    if kind in ("terms", "significant_terms", "rare_terms",
+                "significant_text"):
+        # significant_text keys are analyzed tokens of a text field: a term
+        # query on the same field matches exactly the docs carrying the token
         return {"term": {field: bucket["key"]}}
+    if kind == "multi_terms":
+        flt = [{"term": {src["field"]: v}}
+               for src, v in zip(body.get("terms", []), bucket["key"])]
+        return {"bool": {"filter": flt}}
+    if kind == "auto_date_histogram":
+        key = int(bucket["key"])
+        # the chosen interval is in the finalized result, threaded onto the
+        # bucket by _refine via the parent result's "interval"
+        interval_ms = bucket.get("_interval_ms", 1000)
+        return {"range": {field: {"gte": key, "lt": key + interval_ms}}}
     if kind == "histogram":
         interval = float(body["interval"])
         return {"range": {field: {"gte": bucket["key"],
@@ -1011,6 +1025,12 @@ def _refine_complex_subs(searchers: List[ShardSearcher], body: dict,
         buckets = result.get("buckets")
         if not isinstance(buckets, list) or not complex_subs:
             return
+        if kind == "auto_date_histogram":
+            # thread the coordinator-chosen interval to the bucket filters
+            name_to_ms = {n: ms for ms, n in C._AUTO_LADDER}
+            iv = name_to_ms.get(result.get("interval"), 1000)
+            for b in buckets:
+                b["_interval_ms"] = iv
         for b in buckets:
             bf = _bucket_filter(node, b)
             if bf is None:
@@ -1022,6 +1042,8 @@ def _refine_complex_subs(searchers: List[ShardSearcher], body: dict,
             resp = search_shards(searchers, sub_body, index_name)
             for s in complex_subs:
                 b[s.name] = resp["aggregations"][s.name]
+        for b in buckets:
+            b.pop("_interval_ms", None)
         return
     if kind == "filter":
         for s in node.subs:
@@ -1451,8 +1473,8 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
                 "bg": C._kw_doc_counts(seg, f),
                 "bg_total": seg.live_count}
 
-    if kind == "sampler":
-        _, prefix, shard_size, use_thr, sub_specs = aspec
+    if kind in ("sampler", "dsampler"):
+        sub_specs = aspec[-1]
         rec = {"doc_count": int(round(float(np.asarray(device_out["doc_count"])))),
                "subs": {}}
         if "topscores" in device_out:
@@ -1567,7 +1589,165 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
         _, prefix, f, col_exists, percents = aspec
         return {"hist": np.asarray(device_out["hist"]), "percents": list(percents)}
 
+    if kind == "wavg":
+        return {"vwsum": float(np.asarray(device_out["vwsum"])),
+                "wsum": float(np.asarray(device_out["wsum"])),
+                "count": float(np.asarray(device_out["count"]))}
+
+    if kind == "mad":
+        return {"hist": np.asarray(device_out["hist"])}
+
+    if kind == "geo_stat":
+        out = {k: float(np.asarray(v)) for k, v in device_out.items()}
+        return out
+
+    if kind == "ip_range":
+        _, prefix, f, keys, bounds, open_lo, open_hi, col_exists, sub_specs = aspec
+        counts = np.asarray(device_out.get("counts", np.zeros(len(keys))))
+        buckets = {}
+        for ri, key in enumerate(keys):
+            rec = {"doc_count": int(round(float(counts[ri]))), "subs": {}}
+            meta = {}
+            frm, to = bounds[ri]
+            if frm is not None:
+                meta["from"] = frm
+            if to is not None:
+                meta["to"] = to
+            rec["meta"] = meta
+            for i, sub_node in enumerate(node.subs):
+                r = device_out.get(f"r{ri}_sub{i}")
+                if r is not None:
+                    rec["subs"][sub_node.name] = _device_agg_to_partial(
+                        sub_node, sub_specs[i], r, seg, ctx, seg_stack)
+            buckets[key] = rec
+        return {"buckets": buckets}
+
+    if kind == "multi_terms":
+        _, prefix, nord_pad, nvocab, sub_specs = aspec
+        fields = tuple(s["field"] for s in node.body.get("terms", []))
+        vocab, _ords = C._multi_terms_cache(seg, ctx, node, fields)
+        return {"buckets": _ordinal_buckets(node, device_out, vocab)}
+
+    if kind == "adjacency":
+        _, prefix, fspecs, sep, sub_specs = aspec
+        names = [key for key, _ in fspecs]
+        labels = list(names)
+        for ai in range(len(names)):
+            for bi in range(ai + 1, len(names)):
+                labels.append(f"{names[ai]}{sep}{names[bi]}")
+        buckets = {}
+        for ci, label in enumerate(labels):
+            cnt = int(round(float(np.asarray(device_out[f"c{ci}"]))))
+            rec = {"doc_count": cnt, "subs": {}}
+            for i, sub_node in enumerate(node.subs):
+                r = device_out.get(f"c{ci}_sub{i}")
+                if r is not None:
+                    rec["subs"][sub_node.name] = _device_agg_to_partial(
+                        sub_node, sub_specs[i], r, seg, ctx, seg_stack)
+            buckets[label] = rec
+        return {"buckets": buckets}
+
+    if kind == "auto_date_hist":
+        _, prefix, f, interval_ms, target, min_b, nb, sub_specs = aspec
+        part = _hist_partial(node, device_out, min_b, float(interval_ms), 0.0)
+        # re-key to absolute epoch ms (merge coarsens across intervals)
+        part["buckets"] = {int(b * interval_ms): rec
+                           for b, rec in part["buckets"].items()}
+        part["interval_ms"] = int(interval_ms)
+        return part
+
+    if kind == "scripted":
+        return _scripted_metric_partial(node, device_out, seg)
+
+    if kind == "sig_text":
+        return _significant_text_partial(node, device_out, seg, ctx)
+
     raise ValueError(f"cannot build partial for agg spec [{kind}]")
+
+
+def _scripted_metric_partial(node: AggNode, device_out: dict, seg: Segment) -> dict:
+    """Host map/combine passes of scripted_metric (reference
+    ScriptedMetricAggregator): painless-lite over each matched doc."""
+    from ..script.painless_lite import execute
+    from ..script.painless_lite import doc_view_for
+
+    body = node.body
+    sparams = body.get("params", {})
+    state: Dict[str, Any] = {}
+    if body.get("init_script"):
+        src, prm = _script_spec(body["init_script"], sparams)
+        execute(src, {"state": state, "params": prm})
+    map_src, map_prm = _script_spec(body.get("map_script", ""), sparams)
+    mask = np.asarray(device_out["match_mask"])[: seg.ndocs] > 0
+
+    class _Doc(dict):
+        def __init__(self, d):
+            self._d = d
+            super().__init__()
+
+        def __getitem__(self, f):
+            return doc_view_for(seg, self._d, f)
+
+        def get(self, f, default=None):
+            return doc_view_for(seg, self._d, f)
+
+        def containsKey(self, f):  # noqa: N802 (painless API)
+            return not doc_view_for(seg, self._d, f).empty
+
+    for d in np.nonzero(mask)[0]:
+        execute(map_src, {"state": state, "params": map_prm,
+                          "doc": _Doc(int(d))})
+    if body.get("combine_script"):
+        src, prm = _script_spec(body["combine_script"], sparams)
+        combined = execute(src, {"state": state, "params": prm})
+    else:
+        combined = state
+    return {"states": [combined]}
+
+
+def _script_spec(spec, defaults: dict):
+    if isinstance(spec, str):
+        return spec, dict(defaults)
+    prm = dict(defaults)
+    prm.update(spec.get("params", {}))
+    return spec.get("source", ""), prm
+
+
+def _significant_text_partial(node: AggNode, device_out: dict, seg: Segment,
+                              ctx) -> dict:
+    """significant_text (reference SignificantTextAggregator): sample the
+    best-scoring matched docs, re-analyze the text field from _source, and
+    score candidate terms against the index background (postings df)."""
+    body = node.body
+    field = body.get("field", "")
+    shard_size = int(body.get("shard_size", 200))
+    mask = np.asarray(device_out["match_mask"])[: seg.ndocs] > 0
+    scores = np.asarray(device_out["score_vec"])[: seg.ndocs]
+    docs = np.nonzero(mask)[0]
+    if len(docs) > shard_size:
+        order = np.argsort(-scores[docs], kind="stable")
+        docs = docs[order[:shard_size]]
+    from .compiler import _analyze_query_text
+    fg: Dict[str, int] = {}
+    for d in docs:
+        src = seg.sources[int(d)]
+        v = src.get(field) if isinstance(src, dict) else None
+        if v is None:
+            continue
+        texts = v if isinstance(v, list) else [v]
+        seen = set()
+        for t in texts:
+            for tok in _analyze_query_text(field, str(t), ctx):
+                seen.add(tok)
+        for tok in seen:
+            fg[tok] = fg.get(tok, 0) + 1
+    pb = seg.postings.get(field)
+    bg = {}
+    for tok in fg:
+        bg[tok] = pb.doc_freq(tok) if pb is not None else 0
+    buckets = {tok: {"doc_count": c, "subs": {}} for tok, c in fg.items()}
+    return {"buckets": buckets, "bg": bg, "fg_total": int(len(docs)),
+            "bg_total": int(seg.live_count)}
 
 
 def _find_sub_spec(aspec, i):

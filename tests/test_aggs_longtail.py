@@ -1,0 +1,298 @@
+"""Long-tail aggregations: weighted_avg, median_absolute_deviation,
+geo_bounds/centroid, ip_range, rare_terms, multi_terms, adjacency_matrix,
+auto_date_histogram, scripted_metric, significant_text (reference
+`search/aggregations/metrics/`, `bucket/adjacency/`, `bucket/terms/
+RareTermsAggregationBuilder.java`, ...)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("shop", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "desc": {"type": "text"},
+            "grade": {"type": "double"},
+            "weight": {"type": "double"},
+            "brand": {"type": "keyword"},
+            "color": {"type": "keyword"},
+            "ip": {"type": "ip"},
+            "loc": {"type": "geo_point"},
+            "ts": {"type": "date"},
+            "price": {"type": "long"},
+        }}})
+    rows = [
+        # id, grade, weight, brand, color, ip, (lat, lon), ts, price
+        ("1", 1.0, 2.0, "acme", "red", "10.0.0.1", (10, 20), "2026-01-01", 10),
+        ("2", 2.0, 3.0, "acme", "blue", "10.0.0.200", (12, 22), "2026-01-02", 20),
+        ("3", 3.0, 1.0, "bolt", "red", "10.0.1.1", (-5, 30), "2026-01-05", 10),
+        ("4", 4.0, 4.0, "bolt", "green", "192.168.1.7", (8, -10), "2026-02-01", 30),
+        ("5", 5.0, None, "cork", "blue", "10.0.0.17", (0, 0), "2026-02-15", 20),
+        ("6", 2.5, 2.0, "dune", "red", "10.0.0.42", (3, 4), "2026-03-01", 40),
+    ]
+    for did, grade, weight, brand, color, ip, (lat, lon), ts, price in rows:
+        body = {"desc": "widget thing", "grade": grade, "brand": brand,
+                "color": color, "ip": ip, "loc": {"lat": lat, "lon": lon},
+                "ts": ts, "price": price}
+        if weight is not None:
+            body["weight"] = weight
+        c.index("shop", body, id=did)
+    c.indices.refresh("shop")
+    return c
+
+
+def _agg(client, aggs, query=None):
+    body = {"size": 0, "aggs": aggs}
+    if query:
+        body["query"] = query
+    return client.search("shop", body)["aggregations"]
+
+
+class TestWeightedAvg:
+    def test_basic(self, client):
+        r = _agg(client, {"w": {"weighted_avg": {
+            "value": {"field": "grade"}, "weight": {"field": "weight"}}}})
+        # doc 5 skipped (no weight)
+        num = 1*2 + 2*3 + 3*1 + 4*4 + 2.5*2
+        den = 2 + 3 + 1 + 4 + 2
+        assert r["w"]["value"] == pytest.approx(num / den, rel=1e-6)
+
+    def test_weight_missing_default(self, client):
+        r = _agg(client, {"w": {"weighted_avg": {
+            "value": {"field": "grade"},
+            "weight": {"field": "weight", "missing": 1.0}}}})
+        num = 1*2 + 2*3 + 3*1 + 4*4 + 5*1 + 2.5*2
+        den = 2 + 3 + 1 + 4 + 1 + 2
+        assert r["w"]["value"] == pytest.approx(num / den, rel=1e-6)
+
+
+class TestMAD:
+    def test_against_numpy(self, client):
+        r = _agg(client, {"m": {"median_absolute_deviation": {
+            "field": "grade"}}})
+        vals = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 2.5])
+        med = np.median(vals)
+        expected = np.median(np.abs(vals - med))
+        assert r["m"]["value"] == pytest.approx(expected, rel=0.02)
+
+
+class TestGeo:
+    def test_bounds(self, client):
+        r = _agg(client, {"b": {"geo_bounds": {"field": "loc"}}})
+        b = r["b"]["bounds"]
+        assert b["top_left"]["lat"] == pytest.approx(12, abs=1e-4)
+        assert b["top_left"]["lon"] == pytest.approx(-10, abs=1e-4)
+        assert b["bottom_right"]["lat"] == pytest.approx(-5, abs=1e-4)
+        assert b["bottom_right"]["lon"] == pytest.approx(30, abs=1e-4)
+
+    def test_centroid(self, client):
+        r = _agg(client, {"cen": {"geo_centroid": {"field": "loc"}}})
+        lats = [10, 12, -5, 8, 0, 3]
+        lons = [20, 22, 30, -10, 0, 4]
+        assert r["cen"]["count"] == 6
+        assert r["cen"]["location"]["lat"] == pytest.approx(np.mean(lats), abs=1e-3)
+        assert r["cen"]["location"]["lon"] == pytest.approx(np.mean(lons), abs=1e-3)
+
+
+class TestIpRange:
+    def test_from_to_and_mask(self, client):
+        r = _agg(client, {"ips": {"ip_range": {"field": "ip", "ranges": [
+            {"from": "10.0.0.0", "to": "10.0.1.0"},
+            {"mask": "10.0.0.0/16"},
+            {"to": "10.0.0.100"},
+        ]}}})
+        buckets = {b["key"]: b["doc_count"] for b in r["ips"]["buckets"]}
+        assert buckets["10.0.0.0-10.0.1.0"] == 4   # .1, .200, .17, .42
+        assert buckets["10.0.0.0/16"] == 5          # + 10.0.1.1
+        assert buckets["*-10.0.0.100"] == 3         # .1, .17, .42
+
+    def test_sub_agg(self, client):
+        r = _agg(client, {"ips": {"ip_range": {"field": "ip", "ranges": [
+            {"mask": "10.0.0.0/8"}]},
+            "aggs": {"g": {"avg": {"field": "grade"}}}}})
+        b = r["ips"]["buckets"][0]
+        assert b["doc_count"] == 5
+        assert b["g"]["value"] == pytest.approx((1+2+3+5+2.5) / 5, rel=1e-6)
+
+
+class TestRareMultiAdjacency:
+    def test_rare_terms(self, client):
+        r = _agg(client, {"rare": {"rare_terms": {"field": "brand"}}})
+        keys = [b["key"] for b in r["rare"]["buckets"]]
+        assert set(keys) == {"cork", "dune"}   # doc_count == 1
+        r2 = _agg(client, {"rare": {"rare_terms": {"field": "brand",
+                                                   "max_doc_count": 2}}})
+        keys2 = [b["key"] for b in r2["rare"]["buckets"]]
+        assert set(keys2) == {"cork", "dune", "acme", "bolt"}
+        counts = [b["doc_count"] for b in r2["rare"]["buckets"]]
+        assert counts == sorted(counts)  # ascending doc_count order
+
+    def test_multi_terms(self, client):
+        r = _agg(client, {"mt": {"multi_terms": {"terms": [
+            {"field": "brand"}, {"field": "color"}]}}})
+        buckets = {tuple(b["key"]): b["doc_count"] for b in r["mt"]["buckets"]}
+        assert buckets[("acme", "red")] == 1
+        assert buckets[("acme", "blue")] == 1
+        assert buckets[("bolt", "red")] == 1
+        assert len(buckets) == 6
+        one = r["mt"]["buckets"][0]
+        assert "key_as_string" in one
+
+    def test_multi_terms_with_sub(self, client):
+        r = _agg(client, {"mt": {"multi_terms": {"terms": [
+            {"field": "color"}, {"field": "brand"}]},
+            "aggs": {"g": {"max": {"field": "grade"}}}}})
+        buckets = {tuple(b["key"]): b for b in r["mt"]["buckets"]}
+        assert buckets[("red", "bolt")]["g"]["value"] == pytest.approx(3.0)
+
+    def test_adjacency_matrix(self, client):
+        r = _agg(client, {"adj": {"adjacency_matrix": {"filters": {
+            "cheap": {"range": {"price": {"lte": 20}}},
+            "red": {"term": {"color": "red"}},
+        }}}})
+        buckets = {b["key"]: b["doc_count"] for b in r["adj"]["buckets"]}
+        assert buckets["cheap"] == 4           # 10,20,10,20
+        assert buckets["red"] == 3             # docs 1,3,6
+        assert buckets["cheap&red"] == 2       # docs 1,3
+        # empty intersections are omitted
+        assert all(v > 0 for v in buckets.values())
+
+
+class TestAutoDateHistogram:
+    def test_buckets_bounded_and_counts_preserved(self, client):
+        for target in (3, 5, 20):
+            r = _agg(client, {"h": {"auto_date_histogram": {
+                "field": "ts", "buckets": target}}})
+            bl = r["h"]["buckets"]
+            assert len(bl) <= target
+            assert sum(b["doc_count"] for b in bl) == 6
+            assert "interval" in r["h"]
+            keys = [b["key"] for b in bl]
+            assert keys == sorted(keys)
+
+    def test_sub_metrics_survive_coarsening(self, client):
+        r = _agg(client, {"h": {"auto_date_histogram": {
+            "field": "ts", "buckets": 2},
+            "aggs": {"p": {"sum": {"field": "price"}}}}})
+        total = sum(b["p"]["value"] for b in r["h"]["buckets"])
+        assert total == pytest.approx(130.0)
+
+
+class TestScriptedMetric:
+    def test_sum_via_scripts(self, client):
+        r = _agg(client, {"sm": {"scripted_metric": {
+            "init_script": "state.total = 0.0",
+            "map_script": "state.total += doc['price'].value",
+            "combine_script": "return state.total",
+            "reduce_script": ("double t = 0; for (s in states) { t += s } "
+                              "return t"),
+        }}})
+        assert r["sm"]["value"] == pytest.approx(130.0)
+
+    def test_respects_query(self, client):
+        r = _agg(client, {"sm": {"scripted_metric": {
+            "init_script": "state.n = 0",
+            "map_script": "state.n += 1",
+            "combine_script": "return state.n",
+            "reduce_script": ("long t = 0; for (s in states) { t += s } "
+                              "return t"),
+        }}}, query={"term": {"color": "red"}})
+        assert r["sm"]["value"] == 3
+
+
+class TestSignificantText:
+    def test_surfaces_query_specific_terms(self, client):
+        c = RestClient()
+        c.indices.create("news", {"mappings": {"properties": {
+            "body": {"type": "text"}, "topic": {"type": "keyword"}}}})
+        common = "the quick report about things"
+        for i in range(30):
+            topic = "bike" if i < 10 else "other"
+            extra = "crash accident pileup" if topic == "bike" else "calm"
+            c.index("news", {"body": f"{common} {extra}", "topic": topic},
+                    id=str(i))
+        c.indices.refresh("news")
+        r = c.search("news", {"size": 0,
+                              "query": {"term": {"topic": "bike"}},
+                              "aggs": {"sig": {"significant_text": {
+                                  "field": "body"}}}})
+        keys = [b["key"] for b in r["aggregations"]["sig"]["buckets"]]
+        assert "crash" in keys or "accident" in keys
+        assert "the" not in keys[:3]  # background-common terms don't lead
+
+
+class TestDiversifiedSampler:
+    def test_caps_per_key(self, client):
+        # brand acme and bolt each have 2 docs; cap at 1 per brand
+        r = _agg(client, {"ds": {"diversified_sampler": {
+            "field": "brand", "max_docs_per_value": 1, "shard_size": 100},
+            "aggs": {"n": {"value_count": {"field": "grade"}}}}},
+            query={"match": {"desc": "widget"}})
+        # 4 distinct brands -> 4 sampled docs
+        assert r["ds"]["doc_count"] == 4
+        assert r["ds"]["n"]["value"] == 4
+
+    def test_cap_two_keeps_all_here(self, client):
+        r = _agg(client, {"ds": {"diversified_sampler": {
+            "field": "brand", "max_docs_per_value": 2}}},
+            query={"match": {"desc": "widget"}})
+        assert r["ds"]["doc_count"] == 6
+
+
+class TestReviewRegressions:
+    def test_complex_sub_under_multi_terms(self, client):
+        r = _agg(client, {"mt": {"multi_terms": {"terms": [
+            {"field": "brand"}, {"field": "color"}]},
+            "aggs": {"u": {"cardinality": {"field": "price"}}}}})
+        buckets = {tuple(b["key"]): b for b in r["mt"]["buckets"]}
+        assert buckets[("acme", "red")]["u"]["value"] == 1
+
+    def test_complex_sub_under_rare_terms(self, client):
+        r = _agg(client, {"rare": {"rare_terms": {"field": "brand"},
+                                   "aggs": {"t": {"terms": {
+                                       "field": "color"}}}}})
+        by_key = {b["key"]: b for b in r["rare"]["buckets"]}
+        colors = {b["key"] for b in by_key["dune"]["t"]["buckets"]}
+        assert colors == {"red"}
+
+    def test_pipeline_under_ip_range(self, client):
+        r = _agg(client, {"ips": {"ip_range": {"field": "ip", "ranges": [
+            {"mask": "10.0.0.0/8"}, {"mask": "192.168.0.0/16"}]},
+            "aggs": {
+                "p": {"avg": {"field": "price"}},
+                "sel": {"bucket_selector": {
+                    "buckets_path": {"c": "_count"},
+                    "script": "params.c > 2"}}}}})
+        # bucket_selector prunes the 1-doc 192.168/16 bucket
+        keys = [b["key"] for b in r["ips"]["buckets"]]
+        assert keys == ["10.0.0.0/8"]
+
+    def test_wavg_missing_value_column(self, client):
+        c2 = RestClient()
+        c2.indices.create("wv", {"mappings": {"properties": {
+            "w": {"type": "double"}, "v": {"type": "double"}}}})
+        c2.index("wv", {"w": 2.0}, id="1")          # no v anywhere
+        c2.index("wv", {"w": 3.0}, id="2")
+        c2.indices.refresh("wv")
+        r = c2.search("wv", {"size": 0, "aggs": {"w": {"weighted_avg": {
+            "value": {"field": "v", "missing": 4.0},
+            "weight": {"field": "w"}}}}})
+        assert r["aggregations"]["w"]["value"] == pytest.approx(4.0)
+
+    def test_fail_device_without_replicas_goes_red(self):
+        c2 = RestClient()
+        c2.indices.create("nr2", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 0}})
+        c2.index("nr2", {"x": 1}, id="1", refresh=True)
+        svc = c2.node.indices["nr2"]
+        dev = next(cp.device for cp in svc.table.copies if cp.primary)
+        svc.fail_device(dev)
+        assert svc.health_status() == "red"
+        # searches return partial (empty) results, not an exception
+        r = c2.search("nr2", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 0
