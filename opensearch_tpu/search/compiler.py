@@ -369,6 +369,20 @@ class LKnn(LNode):
 
 
 @dataclass
+class LSpanHost(LNode):
+    """Span/interval algebra evaluated host-side (search/spans.py): prepare
+    computes the per-segment sloppy-frequency vector; the device scores it
+    like a phrase pseudo-term."""
+
+    field: str = ""
+    query: Any = None           # dsl span tree, or ("intervals", field, rule)
+    weight: float = 0.0         # Σ idf(term)·boost, host-computed
+    boost: float = 1.0
+    has_norms: bool = True
+    sim: Any = None
+
+
+@dataclass
 class LGeoDist(LNode):
     field: str = ""
     lat: float = 0.0
@@ -582,16 +596,15 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         return _weighted_terms(field, [term], [1.0], ctx, 1, "score", q.boost)
 
     if isinstance(q, dsl.SpanNearQuery):
+        if not all(isinstance(c, dsl.SpanTermQuery) for c in q.clauses) or \
+                len({c.field for c in q.clauses}) > 1:
+            # nested span algebra inside near -> host span engine
+            return _span_host_node(q, None, ctx, q.boost)
         flat_terms: List[str] = []
         field = None
         for c in q.clauses:
-            if not isinstance(c, dsl.SpanTermQuery):
-                raise dsl.QueryParseError(
-                    "[span_near] only span_term clauses are supported")
             if field is None:
                 field = c.field
-            elif field != c.field:
-                raise dsl.QueryParseError("[span_near] clauses must share a field")
             flat_terms.append(_index_term(c.field, c.value, ctx))
         if not flat_terms or field is None:
             return LMatchNone()
@@ -601,6 +614,24 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         # (gaps), not term movement
         return _phrase_node(field, flat_terms, q.slop, ctx, q.boost,
                             ordered=q.in_order, gap_cost=True)
+
+    if isinstance(q, (dsl.SpanOrQuery, dsl.SpanNotQuery, dsl.SpanFirstQuery,
+                      dsl.SpanContainingQuery, dsl.SpanWithinQuery,
+                      dsl.SpanMultiQuery, dsl.FieldMaskingSpanQuery)):
+        return _span_host_node(q, None, ctx, q.boost)
+
+    if isinstance(q, dsl.IntervalsQuery) and q.rule is not None:
+        ft = m.resolve_field(q.field)
+        field = ft.name if ft else q.field
+        r = q.rule
+        if r.kind == "match" and r.filter_kind is None:
+            # hot path: single match rule rides the device pair-join below
+            q = dsl.IntervalsQuery(field=q.field, query=r.query,
+                                   max_gaps=r.max_gaps, ordered=r.ordered,
+                                   analyzer=r.analyzer, boost=q.boost)
+        else:
+            return _span_host_node(("intervals", field, r), field, ctx,
+                                   q.boost)
 
     if isinstance(q, dsl.IntervalsQuery):
         ft = m.resolve_field(q.field)
@@ -826,6 +857,50 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
                           boost=q.boost)
 
     raise dsl.QueryParseError(f"cannot compile query {type(q).__name__}")
+
+
+def _span_host_node(query, field: Optional[str], ctx: ShardContext,
+                    boost: float) -> LNode:
+    """Evaluate a span/interval algebra tree host-side over every segment
+    (search/spans.py) and wrap the per-segment frequency vectors in an
+    LSpanHost scored on device. Evaluation is eager at rewrite so the
+    pseudo-term weight (Σ idf over involved terms) is identical across
+    segments (global statistics, like the DFS phase)."""
+    from . import spans as SP
+
+    # structural validation first: shape/field errors must surface even on
+    # an empty index (data-independent, like the reference's parse phase)
+    if not isinstance(query, tuple):
+        SP.span_query_field(query, ctx)
+
+    freqs: Dict[int, np.ndarray] = {}
+    terms_seen: List[str] = []
+    f = field
+    any_spans = False
+    for seg in ctx.segments:
+        if isinstance(query, tuple):
+            s, ts = SP.eval_interval_rule(query[2], query[1], seg, ctx)
+            f = query[1]
+        else:
+            f, s, ts = SP.eval_span_query(query, seg, ctx)
+        terms_seen.extend(ts)
+        freqs[seg.uid] = SP.freq_vector(s, seg.ndocs_pad)
+        any_spans = any_spans or len(s.docs) > 0
+    if f is None or not any_spans:
+        return LMatchNone()
+    sim = ctx.sim_for(f)
+    n = ctx.num_docs
+    weight = 0.0
+    for t in dict.fromkeys(terms_seen):
+        df = ctx.doc_freq(f, t)
+        if df > 0:
+            weight += sim.term_weight(1.0, n, df)
+    ft = ctx.mappings.resolve_field(f)
+    has_norms = bool(ft is not None and ft.has_norms and sim.uses_norms)
+    node = LSpanHost(field=f, query=query, weight=weight * boost,
+                     boost=boost, has_norms=has_norms, sim=sim)
+    node._freqs = freqs
+    return node
 
 
 def _rewrite_mlt(q: dsl.MoreLikeThisQuery, ctx: ShardContext,
@@ -1564,6 +1639,17 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         _scalar_f32(params, f"q{nid}_boost", node.boost)
         return ("geobox", nid, node.field, node.field in seg.geo_cols)
 
+    if isinstance(node, LSpanHost):
+        freq = node._freqs.get(seg.uid)
+        if freq is None or not freq.any():
+            return ("match_none", nid)
+        _p(params, f"q{nid}_freq", freq)
+        _scalar_f32(params, f"q{nid}_w", node.weight)
+        _scalar_f32(params, f"q{nid}_avgdl", ctx.avgdl(node.field))
+        sim = node.sim
+        b_eff = sim.b if node.has_norms else 0.0
+        return ("span_host", nid, node.field, float(sim.k1), float(b_eff))
+
     raise TypeError(f"cannot prepare node {type(node).__name__}")
 
 
@@ -1863,6 +1949,17 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
                                     ordered=ordered, gap_cost=gap_cost)
         scores, matched = pos_ops.phrase_score(freq, dl, live, params[f"q{nid}_w"],
                                                k1, b, params[f"q{nid}_avgdl"])
+        return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "span_host":
+        from ..ops import positions as pos_ops
+
+        _, _, field, k1, b = spec
+        dl = seg_arrays["doc_lens"].get(field, zeros)
+        freq = params[f"q{nid}_freq"]
+        scores, matched = pos_ops.phrase_score(freq, dl, live,
+                                               params[f"q{nid}_w"], k1, b,
+                                               params[f"q{nid}_avgdl"])
         return ops.ScoredMask(scores, matched.astype(jnp.float32))
 
     if kind == "xterms":

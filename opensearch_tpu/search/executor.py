@@ -1871,6 +1871,19 @@ def explain_doc(lroot, seg: Segment, doc: int, ctx) -> dict:
     from ..ops.scoring import SIM_BM25
 
     def walk(n) -> Tuple[float, dict]:
+        if isinstance(n, C.LSpanHost):
+            freq = float(n._freqs.get(seg.uid, np.zeros(1))[doc]
+                         if doc < len(n._freqs.get(seg.uid, [])) else 0.0)
+            dl = float(seg.doc_lens.get(n.field, np.zeros(seg.ndocs))[doc]) \
+                if n.field in seg.doc_lens else 0.0
+            avgdl = max(ctx.avgdl(n.field), 1e-9)
+            b_eff = n.sim.b if n.has_norms else 0.0
+            kk = n.sim.k1 * (1 - b_eff + b_eff * dl / avgdl)
+            total = n.weight * freq / (freq + kk) if freq > 0 else 0.0
+            return total, {"value": total,
+                           "description": f"span/intervals on [{n.field}]: "
+                                          f"sloppyFreq {freq:.3f}",
+                           "details": []}
         if isinstance(n, LPhrase):
             freq = _host_phrase_freq(n, seg, doc)
             dl = float(seg.doc_lens.get(n.field, np.zeros(seg.ndocs))[doc]) \

@@ -89,11 +89,71 @@ class SpanNearQuery(Query):
 
 
 @dataclass
-class IntervalsQuery(Query):
-    """intervals query, `match` rule only (ordered/max_gaps); the reference's
-    full interval algebra (all_of/any_of/contains...) is a later round."""
+class SpanOrQuery(Query):
+    clauses: List[Query] = dc_field(default_factory=list)
 
+
+@dataclass
+class SpanNotQuery(Query):
+    include: Optional[Query] = None
+    exclude: Optional[Query] = None
+    pre: int = 0
+    post: int = 0
+
+
+@dataclass
+class SpanFirstQuery(Query):
+    match: Optional[Query] = None
+    end: int = 0
+
+
+@dataclass
+class SpanContainingQuery(Query):
+    big: Optional[Query] = None
+    little: Optional[Query] = None
+
+
+@dataclass
+class SpanWithinQuery(Query):
+    big: Optional[Query] = None
+    little: Optional[Query] = None
+
+
+@dataclass
+class SpanMultiQuery(Query):
+    match: Optional[Query] = None      # prefix/wildcard/fuzzy/regexp
+
+
+@dataclass
+class FieldMaskingSpanQuery(Query):
+    query: Optional[Query] = None
+    field: str = ""                    # the masked-as field
+
+
+@dataclass
+class IntervalRule:
+    """One node of the intervals source tree (reference
+    IntervalsSourceProvider: match/prefix/wildcard/fuzzy/all_of/any_of with
+    an optional filter)."""
+
+    kind: str                          # match|prefix|wildcard|fuzzy|all_of|any_of
+    query: str = ""
+    max_gaps: int = -1
+    ordered: bool = False
+    analyzer: Optional[str] = None
+    rules: List["IntervalRule"] = dc_field(default_factory=list)
+    fuzziness: Any = "AUTO"
+    prefix_length: int = 0
+    filter_kind: Optional[str] = None  # containing|contained_by|not_containing|
+    #                                    not_contained_by|not_overlapping|before|after
+    filter_rule: Optional["IntervalRule"] = None
+
+
+@dataclass
+class IntervalsQuery(Query):
     field: str = ""
+    rule: Optional[IntervalRule] = None
+    # back-compat accessors for the old single-match form
     query: str = ""
     max_gaps: int = -1
     ordered: bool = False
@@ -468,16 +528,58 @@ def parse_query(dsl: Optional[dict]) -> Query:
         _common(q, body)
         return q
 
+    if kind == "span_or":
+        q = SpanOrQuery(clauses=[parse_query(c)
+                                 for c in body.get("clauses", [])])
+        _common(q, body)
+        return q
+
+    if kind == "span_not":
+        dist = int(body.get("dist", 0))
+        q = SpanNotQuery(include=parse_query(body["include"]),
+                         exclude=parse_query(body["exclude"]),
+                         pre=int(body.get("pre", dist)),
+                         post=int(body.get("post", dist)))
+        _common(q, body)
+        return q
+
+    if kind == "span_first":
+        if "end" not in body:
+            raise QueryParseError("[span_first] requires [end]")
+        q = SpanFirstQuery(match=parse_query(body["match"]),
+                           end=int(body["end"]))
+        _common(q, body)
+        return q
+
+    if kind == "span_containing":
+        q = SpanContainingQuery(big=parse_query(body["big"]),
+                                little=parse_query(body["little"]))
+        _common(q, body)
+        return q
+
+    if kind == "span_within":
+        q = SpanWithinQuery(big=parse_query(body["big"]),
+                            little=parse_query(body["little"]))
+        _common(q, body)
+        return q
+
+    if kind == "span_multi":
+        q = SpanMultiQuery(match=parse_query(body["match"]))
+        _common(q, body)
+        return q
+
+    if kind == "field_masking_span":
+        q = FieldMaskingSpanQuery(query=parse_query(body["query"]),
+                                  field=body.get("field", ""))
+        _common(q, body)
+        return q
+
     if kind == "intervals":
         f, spec = _one_entry(body, "intervals")
-        rule = spec.get("match") if isinstance(spec, dict) else None
-        if not isinstance(rule, dict):
-            raise QueryParseError("[intervals] only the `match` rule "
-                                  "(an object) is supported")
-        q = IntervalsQuery(field=f, query=str(rule.get("query", "")),
-                           max_gaps=int(rule.get("max_gaps", -1)),
-                           ordered=bool(rule.get("ordered", False)),
-                           analyzer=rule.get("analyzer"))
+        if not isinstance(spec, dict):
+            raise QueryParseError("[intervals] needs a rule object")
+        rule = parse_interval_rule(spec)
+        q = IntervalsQuery(field=f, rule=rule)
         _common(q, spec)
         return q
 
@@ -801,6 +903,50 @@ def _parse_point(p) -> Tuple[float, float]:
         lat, lon = p.split(",")
         return float(lat), float(lon)
     return float(p[1]), float(p[0])  # GeoJSON [lon, lat]
+
+
+_INTERVAL_FILTERS = ("containing", "contained_by", "not_containing",
+                     "not_contained_by", "not_overlapping", "before", "after")
+
+
+def parse_interval_rule(spec: dict) -> IntervalRule:
+    """Parse one intervals source node (reference IntervalsSourceProvider)."""
+    kinds = [k for k in spec if k in ("match", "prefix", "wildcard", "fuzzy",
+                                      "all_of", "any_of")]
+    if len(kinds) != 1:
+        raise QueryParseError(
+            "[intervals] rule must define exactly one of "
+            "[match|prefix|wildcard|fuzzy|all_of|any_of]")
+    kind = kinds[0]
+    body = spec[kind]
+    if not isinstance(body, dict):
+        body = {"query": body}
+    rule = IntervalRule(kind=kind)
+    if kind in ("match", "prefix", "wildcard", "fuzzy"):
+        rule.query = str(body.get("query", body.get(kind, body.get(
+            "prefix" if kind == "prefix" else "pattern", ""))))
+        rule.analyzer = body.get("analyzer")
+        rule.max_gaps = int(body.get("max_gaps", -1))
+        rule.ordered = bool(body.get("ordered", False))
+        if kind == "fuzzy":
+            rule.query = str(body.get("term", body.get("query", "")))
+            rule.fuzziness = body.get("fuzziness", "AUTO")
+            rule.prefix_length = int(body.get("prefix_length", 0))
+    else:
+        rule.max_gaps = int(body.get("max_gaps", -1))
+        rule.ordered = bool(body.get("ordered", False))
+        rule.rules = [parse_interval_rule(r) for r in body.get("intervals", [])]
+        if not rule.rules:
+            raise QueryParseError(f"[intervals] [{kind}] needs [intervals]")
+    filt = body.get("filter")
+    if filt:
+        fk = [k for k in filt if k in _INTERVAL_FILTERS]
+        if len(fk) != 1:
+            raise QueryParseError(
+                f"[intervals] filter must be one of {_INTERVAL_FILTERS}")
+        rule.filter_kind = fk[0]
+        rule.filter_rule = parse_interval_rule(filt[fk[0]])
+    return rule
 
 
 def parse_minimum_should_match(spec: Optional[str], n_optional: int) -> int:

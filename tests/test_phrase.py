@@ -210,10 +210,14 @@ def test_phrase_prefix_df_clamped_nonnegative():
 
 def test_intervals_bad_rule_is_parse_error():
     from opensearch_tpu.search.query_dsl import QueryParseError, parse_query
+    # shorthand match and fuzzy are supported rules now (full algebra);
+    # unknown rules still 400
+    parse_query({"intervals": {"body": {"match": "quick fox"}}})
+    parse_query({"intervals": {"body": {"fuzzy": {"term": "x"}}}})
     with pytest.raises(QueryParseError):
-        parse_query({"intervals": {"body": {"match": "quick fox"}}})
+        parse_query({"intervals": {"body": {"frob": {"x": 1}}}})
     with pytest.raises(QueryParseError):
-        parse_query({"intervals": {"body": {"fuzzy": {"term": "x"}}}})
+        parse_query({"intervals": {"body": {"all_of": {"intervals": []}}}})
 
 
 def test_phrase_prefix_highlight_marks_expanded_term():

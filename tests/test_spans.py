@@ -1,0 +1,184 @@
+"""Span query algebra + intervals sources/filters (reference
+`index/query/Span*QueryBuilder.java`, `IntervalsSourceProvider.java`),
+evaluated by the host span engine (search/spans.py)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("txt", {"mappings": {"properties": {
+        "body": {"type": "text"}, "alt": {"type": "text"}}}})
+    docs = [
+        ("1", "the quick brown fox jumps over the lazy dog"),
+        ("2", "quick fox"),
+        ("3", "the fox is quick and brown"),
+        ("4", "brown dog sleeps"),
+        ("5", "quick quick brown"),
+        ("6", "a very quick red fox"),
+    ]
+    for did, body in docs:
+        c.index("txt", {"body": body, "alt": body}, id=did)
+    c.indices.refresh("txt")
+    return c
+
+
+def _ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+class TestSpanAlgebra:
+    def test_span_or(self, client):
+        r = client.search("txt", {"query": {"span_or": {"clauses": [
+            {"span_term": {"body": "lazy"}},
+            {"span_term": {"body": "sleeps"}}]}}, "size": 10})
+        assert _ids(r) == ["1", "4"]
+
+    def test_span_not(self, client):
+        # quick not immediately followed by brown
+        r = client.search("txt", {"query": {"span_not": {
+            "include": {"span_term": {"body": "quick"}},
+            "exclude": {"span_near": {"clauses": [
+                {"span_term": {"body": "quick"}},
+                {"span_term": {"body": "brown"}}],
+                "slop": 0, "in_order": True}}}}, "size": 10})
+        # doc1 "quick brown" excluded; doc5 has standalone quick too
+        ids = _ids(r)
+        assert "2" in ids and "3" in ids and "6" in ids
+        assert "1" not in ids
+
+    def test_span_first(self, client):
+        r = client.search("txt", {"query": {"span_first": {
+            "match": {"span_term": {"body": "quick"}}, "end": 1}},
+            "size": 10})
+        assert _ids(r) == ["2", "5"]   # quick at position 0
+
+    def test_span_containing_and_within(self, client):
+        big = {"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"span_term": {"body": "fox"}}], "slop": 3, "in_order": True}}
+        little = {"span_term": {"body": "red"}}
+        r = client.search("txt", {"query": {"span_containing": {
+            "big": big, "little": little}}, "size": 10})
+        assert _ids(r) == ["6"]        # quick red fox contains red
+        r = client.search("txt", {"query": {"span_within": {
+            "big": big, "little": little}}, "size": 10})
+        assert _ids(r) == ["6"]
+
+    def test_span_multi_prefix(self, client):
+        r = client.search("txt", {"query": {"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"span_multi": {"match": {"prefix": {"body": "bro"}}}}],
+            "slop": 0, "in_order": True}}, "size": 10})
+        assert _ids(r) == ["1", "5"]   # quick brown adjacency
+
+    def test_field_masking_span(self, client):
+        r = client.search("txt", {"query": {"span_near": {"clauses": [
+            {"span_term": {"body": "quick"}},
+            {"field_masking_span": {
+                "query": {"span_term": {"alt": "brown"}},
+                "field": "body"}}],
+            "slop": 0, "in_order": True}}, "size": 10})
+        assert _ids(r) == ["1", "5"]
+
+    def test_mismatched_fields_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("txt", {"query": {"span_or": {"clauses": [
+                {"span_term": {"body": "quick"}},
+                {"span_term": {"alt": "fox"}}]}}})
+
+
+class TestIntervals:
+    def test_all_of_ordered(self, client):
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "all_of": {"ordered": True, "max_gaps": 0, "intervals": [
+                {"match": {"query": "quick"}},
+                {"match": {"query": "brown"}}]}}}}, "size": 10})
+        assert _ids(r) == ["1", "5"]
+
+    def test_any_of(self, client):
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "any_of": {"intervals": [
+                {"match": {"query": "lazy"}},
+                {"match": {"query": "sleeps"}}]}}}}, "size": 10})
+        assert _ids(r) == ["1", "4"]
+
+    def test_prefix_rule(self, client):
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "prefix": {"prefix": "jum"}}}}, "size": 10})
+        assert _ids(r) == ["1"]
+
+    def test_fuzzy_rule(self, client):
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "fuzzy": {"term": "quikc"}}}}, "size": 10})
+        assert "1" in _ids(r)
+
+    def test_filter_containing(self, client):
+        # quick..fox spans that contain "red"
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "all_of": {"ordered": True, "max_gaps": 2, "intervals": [
+                {"match": {"query": "quick"}},
+                {"match": {"query": "fox"}}],
+                "filter": {"containing": {"match": {"query": "red"}}}}}}},
+            "size": 10})
+        assert _ids(r) == ["6"]
+
+    def test_filter_not_overlapping(self, client):
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "match": {"query": "quick",
+                      "filter": {"not_overlapping": {
+                          "match": {"query": "quick brown",
+                                    "ordered": True, "max_gaps": 0}}}}}}},
+            "size": 10})
+        ids = _ids(r)
+        assert "2" in ids and "1" not in ids
+
+    def test_before_after(self, client):
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "match": {"query": "fox",
+                      "filter": {"before": {"match": {"query": "jumps"}}}}}}},
+            "size": 10})
+        assert _ids(r) == ["1"]
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "match": {"query": "fox",
+                      "filter": {"after": {"match": {"query": "the"}}}}}}},
+            "size": 10})
+        assert "1" in _ids(r) and "3" in _ids(r)
+
+    def test_plain_match_rule_still_device(self, client):
+        r = client.search("txt", {"query": {"intervals": {"body": {
+            "match": {"query": "quick brown", "max_gaps": 0,
+                      "ordered": True}}}}, "size": 10})
+        assert _ids(r) == ["1", "5"]
+
+    def test_scores_positive_and_explainable(self, client):
+        r = client.search("txt", {"query": {"span_or": {"clauses": [
+            {"span_term": {"body": "lazy"}}]}}, "size": 10})
+        assert all(h["_score"] > 0 for h in r["hits"]["hits"])
+
+
+class TestReviewRegressions:
+    def test_span_not_huge_post_still_excludes(self, client):
+        r = client.search("txt", {"query": {"span_not": {
+            "include": {"span_term": {"body": "quick"}},
+            "exclude": {"span_term": {"body": "brown"}},
+            "post": 8589934592}}, "size": 10})
+        # every doc containing both quick and brown is excluded
+        assert "1" not in _ids(r) and "5" not in _ids(r)
+        assert "2" in _ids(r)
+
+    def test_invalid_span_rejected_on_empty_index(self):
+        c = RestClient()
+        c.indices.create("empty-span")
+        with pytest.raises(ApiError):
+            c.search("empty-span", {"query": {"span_not": {
+                "include": {"span_term": {"a": "x"}},
+                "exclude": {"span_term": {"b": "y"}}}}})
+
+    def test_span_first_requires_end(self, client):
+        with pytest.raises(ApiError):
+            client.search("txt", {"query": {"span_first": {
+                "match": {"span_term": {"body": "quick"}}}}})
