@@ -20,6 +20,9 @@ from ..index.mappings import Mappings
 from ..ingest import IngestService
 from ..search.executor import ShardSearcher, msearch_batched, search_shards
 from ..utils.breaker import BreakerService
+from ..utils.slowlog import SlowLog
+from ..utils.tasks import TaskRegistry
+from ..utils.threadpool import ThreadPools
 from .routing import shard_for
 from .state import (ClusterMetadata, ClusterStateError, IndexMetadata,
                     IndexNotFoundError, ResourceAlreadyExistsError, AliasMetadata)
@@ -27,7 +30,7 @@ from .state import (ClusterMetadata, ClusterStateError, IndexMetadata,
 
 class IndexService:
     def __init__(self, meta: IndexMetadata, mapping: Optional[dict],
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None, thread_pools=None):
         self.meta = meta
         analysis = AnalysisRegistry(meta.settings.get("index", {}).get("analysis",
                                     meta.settings.get("analysis")))
@@ -46,6 +49,11 @@ class IndexService:
                                                 similarity=self.default_sim,
                                                 index_key=meta.name))
         self.generation = 0  # bumped on refresh/writes: request-cache key part
+        self.thread_pools = thread_pools
+        self.search_slowlog = SlowLog(meta.name, meta.settings, "search",
+                                      "query")
+        self.index_slowlog = SlowLog(meta.name, meta.settings, "indexing",
+                                     "index")
         self._init_replicas()
 
     def _init_replicas(self) -> None:
@@ -178,8 +186,14 @@ class IndexService:
         self.generation += 1
 
     def flush(self) -> None:
-        for s in self.shards:
-            s.flush()
+        # persistence is IO-bound: fan shards out on the write pool when the
+        # node provides one (reference ThreadPool.Names.FLUSH)
+        if self.thread_pools is not None and len(self.shards) > 1:
+            self.thread_pools.run_blocking("write",
+                                           [s.flush for s in self.shards])
+        else:
+            for s in self.shards:
+                s.flush()
         self.generation += 1
 
     def force_merge(self, max_num_segments: int = 1) -> None:
@@ -208,6 +222,8 @@ class IndexService:
                for k in ("index_ops", "delete_ops", "refreshes", "flushes", "merges")}
         return {"docs": {"count": self.num_docs},
                 "store": {"size_in_bytes": store_bytes},
+                "slowlog": {"search": self.search_slowlog.stats(),
+                            "indexing": self.index_slowlog.stats()},
                 "segments": {"count": seg_count},
                 "indexing": {"index_total": ops["index_ops"],
                              "delete_total": ops["delete_ops"]},
@@ -260,6 +276,8 @@ class Node:
         self.ingest = IngestService()
         self.breakers = BreakerService()
         self.request_cache = RequestCache()
+        self.tasks = TaskRegistry()
+        self.thread_pools = ThreadPools()
         # SPMD mesh dispatch (parallel/service.py): pass a MeshSearchService
         # or set OPENSEARCH_TPU_MESH=1 to auto-build one over jax.devices();
         # eligible searches then run the distributed program with host-loop
@@ -297,7 +315,8 @@ class Node:
             if mapping is None and tbody.get("mappings"):
                 mapping = tbody["mappings"]
         meta = IndexMetadata(name, settings={"index": settings.get("index", settings)})
-        svc = IndexService(meta, mapping, self.data_path)
+        svc = IndexService(meta, mapping, self.data_path,
+                           thread_pools=self.thread_pools)
         self.indices[name] = svc
         self.metadata.indices[name] = meta
         for alias, acfg in body.get("aliases", {}).items():
@@ -390,7 +409,8 @@ class Node:
             with open(meta_path) as fh:
                 saved = json.load(fh)
             meta = IndexMetadata(name, settings=saved.get("settings", {}))
-            svc = IndexService(meta, saved.get("mappings"), self.data_path)
+            svc = IndexService(meta, saved.get("mappings"), self.data_path,
+                               thread_pools=self.thread_pools)
             self.indices[name] = svc
             self.metadata.indices[name] = meta
 
@@ -443,7 +463,8 @@ class Node:
                 saved = json.load(fh)
             meta = IndexMetadata(target, settings=saved.get("settings", {}))
             self.indices[target] = IndexService(meta, saved.get("mappings"),
-                                                self.data_path)
+                                                self.data_path,
+                                                thread_pools=self.thread_pools)
             self.metadata.indices[target] = meta
             restored.append(target)
         self.metadata.bump()
@@ -470,12 +491,24 @@ class Node:
             cached = self.request_cache.get(cache_key)
             if cached is not None:
                 return cached
-        resp = None
-        if self.mesh_service is not None and len(names) == 1:
-            resp = self.mesh_service.try_search(names[0],
-                                                self.indices[names[0]], body)
-        if resp is None:
-            resp = search_shards(searchers, body, index_name=",".join(names))
+        task = self.tasks.register("indices:data/read/search",
+                                   f"indices[{expression}]")
+        t0 = time.monotonic()
+        try:
+            resp = None
+            if self.mesh_service is not None and len(names) == 1:
+                resp = self.mesh_service.try_search(names[0],
+                                                    self.indices[names[0]],
+                                                    body)
+            if resp is None:
+                resp = search_shards(searchers, body,
+                                     index_name=",".join(names), task=task)
+        finally:
+            self.tasks.unregister(task)
+        took = time.monotonic() - t0
+        for name in names:
+            self.indices[name].search_slowlog.maybe_log(took,
+                                                        body.get("query"))
         if len(names) == 1:
             for h in resp["hits"]["hits"]:
                 h["_index"] = names[0]
@@ -504,6 +537,8 @@ class Node:
             "indices": {n: svc.stats() for n, svc in self.indices.items()},
             "breakers": self.breakers.stats(),
             "request_cache": self.request_cache.stats(),
+            "tasks": self.tasks.stats(),
+            "thread_pool": self.thread_pools.stats(),
             "uptime_in_millis": int((time.time() - self.start_time) * 1000),
         }
         if self.mesh_service is not None:

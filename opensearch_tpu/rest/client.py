@@ -21,6 +21,7 @@ from ..search.executor import ShardSearcher, explain_doc, search_shards
 from ..search import compiler as C
 from ..search import query_dsl as dsl
 from ..utils.breaker import CircuitBreakingException
+from ..utils.tasks import TaskCancelledException
 
 
 class ApiError(Exception):
@@ -81,11 +82,14 @@ class RestClient:
             if body is None:
                 return {"_index": index, "_id": id or "", "result": "noop"}
         doc_id = id if id is not None else uuid.uuid4().hex[:20]
+        t0 = time.monotonic()
         try:
             res = svc.route(doc_id, routing).index_doc(
                 doc_id, body, routing, if_seq_no, if_primary_term, op_type)
         except VersionConflictError as e:
             raise ApiError(409, "version_conflict_engine_exception", str(e))
+        svc.index_slowlog.maybe_log(time.monotonic() - t0,
+                                    {"_id": doc_id})
         svc.generation += 1
         if refresh:
             svc.refresh()
@@ -250,6 +254,8 @@ class RestClient:
             raise ApiError(400, "parsing_exception", str(e))
         except CircuitBreakingException as e:
             raise ApiError(429, "circuit_breaking_exception", str(e))
+        except TaskCancelledException as e:
+            raise ApiError(400, "task_cancelled_exception", str(e))
         if scroll:
             sid = uuid.uuid4().hex
             names = self.node.metadata.resolve(index)
@@ -377,6 +383,25 @@ class RestClient:
             except (ApiError, IndexNotFoundError) as e:
                 responses.append({"error": {"type": type(e).__name__, "reason": str(e)}})
         return {"took": 0, "responses": responses}
+
+    # ---------------- tasks API (reference action/admin/cluster/node/tasks) --
+
+    def tasks(self, actions: Optional[str] = None) -> dict:
+        return {"nodes": {self.node.node_name: {
+            "tasks": {str(t["id"]): t
+                      for t in self.node.tasks.list(actions)}}}}
+
+    def cancel_task(self, task_id, reason: str = "by user request") -> dict:
+        try:
+            tid = int(str(task_id).rsplit(":", 1)[-1])
+        except ValueError:
+            raise ApiError(404, "resource_not_found_exception",
+                           f"task [{task_id}] is not found")
+        ok = self.node.tasks.cancel(tid, reason)
+        if not ok:
+            raise ApiError(404, "resource_not_found_exception",
+                           f"task [{task_id}] is not found or not cancellable")
+        return {"acknowledged": True}
 
     # ---------------- search templates (reference modules/lang-mustache) ----
 
@@ -700,9 +725,12 @@ class IndicesClient:
         return {"_shards": {"successful": 1, "failed": 0}}
 
     def flush(self, index: str = "_all") -> dict:
+        n_shards = 0
         for n in self.c.node.metadata.resolve(index):
-            self.c.node.indices[n].flush()
-        return {"_shards": {"successful": 1, "failed": 0}}
+            svc = self.c.node.indices[n]
+            n_shards += len(svc.shards)
+            svc.flush()
+        return {"_shards": {"successful": n_shards, "failed": 0}}
 
     def forcemerge(self, index: str = "_all", max_num_segments: int = 1) -> dict:
         for n in self.c.node.metadata.resolve(index):
@@ -921,3 +949,16 @@ class CatClient:
         total = sum(self.c.node.indices[n].num_docs
                     for n in self.c.node.metadata.resolve(index))
         return [{"epoch": str(int(time.time())), "count": str(total)}]
+
+    def thread_pool(self, format: str = "json") -> List[dict]:
+        node = self.c.node
+        return [{"node_name": node.node_name, "name": p["name"],
+                 "size": str(p["size"]), "active": str(p["active"]),
+                 "completed": str(p["completed"])}
+                for p in node.thread_pools.stats()]
+
+    def tasks(self, format: str = "json") -> List[dict]:
+        return [{"action": t["action"], "task_id": str(t["id"]),
+                 "running_time": str(t["running_time_in_nanos"]),
+                 "cancellable": str(t["cancellable"]).lower()}
+                for t in self.c.node.tasks.list()]

@@ -869,25 +869,16 @@ def _span_host_node(query, field: Optional[str], ctx: ShardContext,
     from . import spans as SP
 
     # structural validation first: shape/field errors must surface even on
-    # an empty index (data-independent, like the reference's parse phase)
-    if not isinstance(query, tuple):
-        SP.span_query_field(query, ctx)
-
-    freqs: Dict[int, np.ndarray] = {}
-    terms_seen: List[str] = []
-    f = field
-    any_spans = False
-    for seg in ctx.segments:
-        if isinstance(query, tuple):
-            s, ts = SP.eval_interval_rule(query[2], query[1], seg, ctx)
-            f = query[1]
-        else:
-            f, s, ts = SP.eval_span_query(query, seg, ctx)
-        terms_seen.extend(ts)
-        freqs[seg.uid] = SP.freq_vector(s, seg.ndocs_pad)
-        any_spans = any_spans or len(s.docs) > 0
-    if f is None or not any_spans:
+    # an empty index (data-independent, like the reference's parse phase);
+    # span evaluation itself is LAZY per segment (prepare) so a multi-shard
+    # coordinator doesn't evaluate every shard's segments once per shard
+    if isinstance(query, tuple):
+        f = query[1]
+    else:
+        f = SP.span_query_field(query, ctx) or field
+    if f is None:
         return LMatchNone()
+    terms_seen = SP.collect_terms(query, ctx)
     sim = ctx.sim_for(f)
     n = ctx.num_docs
     weight = 0.0
@@ -899,7 +890,7 @@ def _span_host_node(query, field: Optional[str], ctx: ShardContext,
     has_norms = bool(ft is not None and ft.has_norms and sim.uses_norms)
     node = LSpanHost(field=f, query=query, weight=weight * boost,
                      boost=boost, has_norms=has_norms, sim=sim)
-    node._freqs = freqs
+    node._freqs = {}
     return node
 
 
@@ -1640,8 +1631,17 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("geobox", nid, node.field, node.field in seg.geo_cols)
 
     if isinstance(node, LSpanHost):
+        from . import spans as SP
         freq = node._freqs.get(seg.uid)
-        if freq is None or not freq.any():
+        if freq is None:
+            if isinstance(node.query, tuple):
+                s, _ts = SP.eval_interval_rule(node.query[2], node.query[1],
+                                               seg, ctx)
+            else:
+                _f, s, _ts = SP.eval_span_query(node.query, seg, ctx)
+            freq = SP.freq_vector(s, seg.ndocs_pad)
+            node._freqs[seg.uid] = freq
+        if not freq.any():
             return ("match_none", nid)
         _p(params, f"q{nid}_freq", freq)
         _scalar_f32(params, f"q{nid}_w", node.weight)

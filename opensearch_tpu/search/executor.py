@@ -97,7 +97,8 @@ class ShardSearcher:
 
     def query_phase(self, body: dict, segments: Optional[List[Segment]] = None,
                     shard_ord: Optional[int] = None,
-                    stats_ctx: Optional[C.ShardContext] = None) -> ShardQueryResult:
+                    stats_ctx: Optional[C.ShardContext] = None,
+                    task=None) -> ShardQueryResult:
         """`shard_ord` overrides the candidate shard tag so a coordinator can
         search shards of several indices in one pass without id collisions.
         `stats_ctx` carries index-wide collection statistics (the coordinator
@@ -162,6 +163,10 @@ class ShardSearcher:
                                                window, body))
 
         for seg_ord, seg in enumerate(segments):
+            if task is not None:
+                # cooperative cancellation between segment programs
+                # (reference CancellableTask checks between leaves)
+                task.ensure_not_cancelled()
             if seg.live_count == 0:
                 continue
             if not _aggs_need_all_segments(agg_nodes) and not C.can_match(lroot, seg):
@@ -625,13 +630,13 @@ def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
 
 
 def search_shards(searchers: List[ShardSearcher], body: dict,
-                  index_name: str = "") -> dict:
+                  index_name: str = "", task=None) -> dict:
     """Full query-then-fetch across shards -> OpenSearch-shaped response."""
     t0 = time.monotonic()
     body = dict(body)
     body["_index_name"] = index_name
     stats = _global_stats_contexts(searchers)
-    results = [s.query_phase(body, shard_ord=i, stats_ctx=stats[i])
+    results = [s.query_phase(body, shard_ord=i, stats_ctx=stats[i], task=task)
                for i, s in enumerate(searchers)]
     agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
     # pipelines whose buckets_path targets a refinement-resolved sub-agg are

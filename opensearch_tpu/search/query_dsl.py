@@ -536,6 +536,8 @@ def parse_query(dsl: Optional[dict]) -> Query:
 
     if kind == "span_not":
         dist = int(body.get("dist", 0))
+        if "include" not in body or "exclude" not in body:
+            raise QueryParseError("[span_not] requires [include] and [exclude]")
         q = SpanNotQuery(include=parse_query(body["include"]),
                          exclude=parse_query(body["exclude"]),
                          pre=int(body.get("pre", dist)),
@@ -544,31 +546,40 @@ def parse_query(dsl: Optional[dict]) -> Query:
         return q
 
     if kind == "span_first":
-        if "end" not in body:
-            raise QueryParseError("[span_first] requires [end]")
+        if "end" not in body or "match" not in body:
+            raise QueryParseError("[span_first] requires [match] and [end]")
         q = SpanFirstQuery(match=parse_query(body["match"]),
                            end=int(body["end"]))
         _common(q, body)
         return q
 
     if kind == "span_containing":
+        if "big" not in body or "little" not in body:
+            raise QueryParseError(
+                "[span_containing] requires [big] and [little]")
         q = SpanContainingQuery(big=parse_query(body["big"]),
                                 little=parse_query(body["little"]))
         _common(q, body)
         return q
 
     if kind == "span_within":
+        if "big" not in body or "little" not in body:
+            raise QueryParseError("[span_within] requires [big] and [little]")
         q = SpanWithinQuery(big=parse_query(body["big"]),
                             little=parse_query(body["little"]))
         _common(q, body)
         return q
 
     if kind == "span_multi":
+        if "match" not in body:
+            raise QueryParseError("[span_multi] requires [match]")
         q = SpanMultiQuery(match=parse_query(body["match"]))
         _common(q, body)
         return q
 
     if kind == "field_masking_span":
+        if "query" not in body:
+            raise QueryParseError("[field_masking_span] requires [query]")
         q = FieldMaskingSpanQuery(query=parse_query(body["query"]),
                                   field=body.get("field", ""))
         _common(q, body)

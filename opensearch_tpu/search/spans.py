@@ -94,14 +94,28 @@ def or_spans(sets: List[SpanSet]) -> SpanSet:
                           np.concatenate([s.ends for s in sets])))
 
 
+def _seg_suffix_min(values: np.ndarray, docs: np.ndarray) -> np.ndarray:
+    """Per-doc suffix minimum: out[i] = min(values[i:j]) within doc i's run."""
+    if not len(values):
+        return values
+    vmax = int(values.max())
+    dmax = int(docs.max())
+    rev_v = (vmax - values)[::-1]            # suffix-min -> prefix-max
+    rev_g = (dmax - docs)[::-1]              # nondecreasing group ids
+    out = _seg_cummax(rev_v, rev_g)
+    return (vmax - out)[::-1]
+
+
 def near_spans(sets: List[SpanSet], slop: int, in_order: bool) -> SpanSet:
     """Combine clause span sets like SpanNearQuery: one result span per
     first-clause anchor when every clause matches nearby; `slop` bounds the
     uncovered positions inside the combined span (gap count).
 
-    Ordered: greedy earliest next span with start >= previous end (exact for
-    existence per anchor). Unordered: nearest span per clause around the
-    anchor — exact when clauses don't compete for positions (the device
+    Ordered: for each anchor, each next clause takes the valid span
+    (start >= previous end, same doc) with the MINIMAL end — the
+    interval-scheduling greedy, exact for ordered existence even with
+    variable-width alternatives. Unordered: nearest span per clause around
+    the anchor — exact when clauses don't compete for positions (the device
     phrase engine's documented relaxation)."""
     if not sets or any(len(s.docs) == 0 for s in sets):
         return SpanSet.empty()
@@ -113,13 +127,22 @@ def near_spans(sets: List[SpanSet], slop: int, in_order: bool) -> SpanSet:
         prev_end = ends.copy()
         for s in sets[1:]:
             key = s.key()
+            smin_end = _seg_suffix_min(s.ends, s.docs)
+            # second order (doc, end) -> recover the chosen span's start
+            # (max start for that end = narrowest, still >= prev_end)
+            o2 = np.lexsort((s.starts, s.ends, s.docs))
+            key2 = s.docs[o2] * BIG + s.ends[o2]
+            starts2 = s.starts[o2]
             idx = np.searchsorted(key, docs * BIG + prev_end, "left")
             safe = np.minimum(idx, len(key) - 1)
             found = (idx < len(key)) & (s.docs[safe] == docs)
+            e_star = smin_end[safe]
+            j2 = np.searchsorted(key2, docs * BIG + e_star, "right") - 1
+            j2safe = np.maximum(j2, 0)
+            s_star = starts2[j2safe]
             ok &= found
-            prev_end = np.where(found, s.ends[safe], prev_end)
-            width_used = width_used + np.where(found,
-                                               s.ends[safe] - s.starts[safe], 0)
+            prev_end = np.where(found, e_star, prev_end)
+            width_used = width_used + np.where(found, e_star - s_star, 0)
         span_lo, span_hi = starts, prev_end
     else:
         span_lo = starts.copy()
@@ -441,6 +464,76 @@ def _difference(all_s: SpanSet, minus: SpanSet) -> SpanSet:
     keep = np.ones(na, bool)
     keep[removed_src] = False
     return SpanSet(all_s.docs[keep], all_s.starts[keep], all_s.ends[keep])
+
+
+def collect_terms(query, ctx, cap: int = 16) -> List[str]:
+    """Light term collection for the pseudo-term idf weight: no positional
+    evaluation, only term-dict scans for expansions (cheap)."""
+    from . import compiler as C
+
+    out: List[str] = []
+
+    def expand(field, make_expander):
+        ft = ctx.mappings.resolve_field(field)
+        f = ft.name if ft else field
+        for seg in ctx.segments:
+            pb = seg.postings.get(f)
+            if pb is None:
+                continue
+            rows = make_expander(f)(seg)
+            out.extend(pb.vocab[int(r)] for r in rows[:cap])
+
+    def walk(q):
+        if isinstance(q, dsl.SpanTermQuery):
+            out.append(C._index_term(q.field, q.value, ctx))
+        elif isinstance(q, (dsl.SpanNearQuery, dsl.SpanOrQuery)):
+            for c in q.clauses:
+                walk(c)
+        elif isinstance(q, dsl.SpanNotQuery):
+            walk(q.include)
+        elif isinstance(q, dsl.SpanFirstQuery):
+            walk(q.match)
+        elif isinstance(q, dsl.SpanContainingQuery):
+            walk(q.big)
+        elif isinstance(q, dsl.SpanWithinQuery):
+            walk(q.little)
+        elif isinstance(q, dsl.FieldMaskingSpanQuery):
+            walk(q.query)
+        elif isinstance(q, dsl.SpanMultiQuery):
+            inner = q.match
+            if isinstance(inner, dsl.PrefixQuery):
+                expand(inner.field, lambda f: C._prefix_expander(
+                    f, inner.value, False))
+            elif isinstance(inner, dsl.WildcardQuery):
+                expand(inner.field, lambda f: C._wildcard_expander(
+                    f, inner.value, False))
+            elif isinstance(inner, dsl.FuzzyQuery):
+                expand(inner.field, lambda f: C._fuzzy_expander(
+                    f, inner.value, inner.fuzziness, inner.prefix_length))
+            elif isinstance(inner, dsl.RegexpQuery):
+                expand(inner.field, lambda f: C._regexp_expander(
+                    f, inner.value))
+
+    def walk_rule(rule, field):
+        if rule.kind == "match":
+            out.extend(C._analyze_query_text(field, rule.query, ctx,
+                                             rule.analyzer))
+        elif rule.kind == "prefix":
+            expand(field, lambda f: C._prefix_expander(f, rule.query, False))
+        elif rule.kind == "wildcard":
+            expand(field, lambda f: C._wildcard_expander(f, rule.query, False))
+        elif rule.kind == "fuzzy":
+            expand(field, lambda f: C._fuzzy_expander(
+                f, rule.query, rule.fuzziness, rule.prefix_length))
+        else:
+            for r in rule.rules:
+                walk_rule(r, field)
+
+    if isinstance(query, tuple):
+        walk_rule(query[2], query[1])
+    else:
+        walk(query)
+    return out
 
 
 def span_query_field(q, ctx) -> Optional[str]:

@@ -1,0 +1,90 @@
+"""Task management + cooperative cancellation.
+
+Reference `tasks/TaskManager.java` + `tasks/CancellableTask.java`: every
+long-running action registers a task; cancellation is cooperative — the
+running code polls `ensure_not_cancelled()` at safe points (between segments
+in the query phase, between docs in reindex loops). Device programs are
+uncancellable once dispatched (like a Lucene segment scorer mid-advance);
+the poll granularity is one segment's kernel, which is milliseconds."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class TaskCancelledException(Exception):
+    """Reference TaskCancelledException -> HTTP 400 search_phase_execution."""
+
+
+class Task:
+    def __init__(self, task_id: int, action: str, description: str,
+                 cancellable: bool = True):
+        self.id = task_id
+        self.action = action
+        self.description = description
+        self.cancellable = cancellable
+        self.start_time = time.time()
+        self.cancelled = False
+        self.cancel_reason: Optional[str] = None
+
+    def cancel(self, reason: str = "by user request") -> None:
+        if self.cancellable:
+            self.cancelled = True
+            self.cancel_reason = reason
+
+    def ensure_not_cancelled(self) -> None:
+        if self.cancelled:
+            raise TaskCancelledException(
+                f"task [{self.id}] was cancelled: {self.cancel_reason}")
+
+    def info(self) -> dict:
+        return {"id": self.id, "action": self.action,
+                "description": self.description,
+                "cancellable": self.cancellable,
+                "cancelled": self.cancelled,
+                "start_time_in_millis": int(self.start_time * 1000),
+                "running_time_in_nanos":
+                    int((time.time() - self.start_time) * 1e9)}
+
+
+class TaskRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tasks: Dict[int, Task] = {}
+        self._next = 0
+        self.completed = 0
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = True) -> Task:
+        with self._lock:
+            self._next += 1
+            t = Task(self._next, action, description, cancellable)
+            self._tasks[t.id] = t
+            return t
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.id, None)
+            self.completed += 1
+
+    def get(self, task_id: int) -> Optional[Task]:
+        return self._tasks.get(task_id)
+
+    def cancel(self, task_id: int, reason: str = "by user request") -> bool:
+        t = self._tasks.get(task_id)
+        if t is None or not t.cancellable:
+            return False
+        t.cancel(reason)
+        return True
+
+    def list(self, actions: Optional[str] = None) -> List[dict]:
+        out = [t.info() for t in list(self._tasks.values())]
+        if actions:
+            import fnmatch
+            out = [t for t in out if fnmatch.fnmatch(t["action"], actions)]
+        return out
+
+    def stats(self) -> dict:
+        return {"running": len(self._tasks), "completed": self.completed}

@@ -1,0 +1,71 @@
+"""Named host thread pools (reference `threadpool/ThreadPool.java`).
+
+In this runtime the device does the heavy lifting asynchronously (XLA
+dispatch is already non-blocking), so host pools serve what they serve in
+the reference minus the scoring loops: IO-bound work — snapshot/flush
+persistence, translog fsyncs — and fan-out coordination. Sizes follow the
+reference's defaults scaled to the host core count."""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, List
+
+
+class NamedPool:
+    def __init__(self, name: str, size: int):
+        self.name = name
+        self.size = size
+        self._ex = ThreadPoolExecutor(max_workers=size,
+                                      thread_name_prefix=f"ostpu-{name}")
+        self.submitted = 0
+        self.completed = 0
+
+    def submit(self, fn: Callable, *args, **kw) -> Future:
+        self.submitted += 1
+
+        def run():
+            try:
+                return fn(*args, **kw)
+            finally:
+                self.completed += 1
+
+        return self._ex.submit(run)
+
+    def stats(self) -> dict:
+        return {"name": self.name, "size": self.size,
+                "active": max(self.submitted - self.completed, 0),
+                "completed": self.completed}
+
+    def shutdown(self) -> None:
+        self._ex.shutdown(wait=True)
+
+
+class ThreadPools:
+    """The node's pool set: write (bulk persistence), snapshot (repo IO),
+    management (merges, refresh bookkeeping), generic."""
+
+    def __init__(self, cores: int = 0):
+        n = cores or os.cpu_count() or 1
+        self.pools: Dict[str, NamedPool] = {
+            "write": NamedPool("write", max(1, n)),
+            "snapshot": NamedPool("snapshot", max(1, min(n, 4))),
+            "management": NamedPool("management", max(1, min(n, 2))),
+            "generic": NamedPool("generic", max(1, min(4 * n, 16))),
+        }
+
+    def pool(self, name: str) -> NamedPool:
+        return self.pools[name]
+
+    def run_blocking(self, name: str, tasks: List[Callable]) -> list:
+        """Fan a batch out on a pool and wait (coordinated IO barrier)."""
+        futs = [self.pools[name].submit(t) for t in tasks]
+        return [f.result() for f in futs]
+
+    def stats(self) -> List[dict]:
+        return [p.stats() for p in self.pools.values()]
+
+    def shutdown(self) -> None:
+        for p in self.pools.values():
+            p.shutdown()

@@ -1,0 +1,126 @@
+"""Tasks + cooperative cancellation (reference `tasks/CancellableTask.java`),
+search/indexing slow logs (reference `index/SearchSlowLog.java`), and the
+host thread pools (reference `threadpool/`)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.utils.slowlog import SlowLog
+from opensearch_tpu.utils.tasks import TaskCancelledException, TaskRegistry
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("logidx", {
+        "settings": {
+            "search": {"slowlog": {"threshold": {"query": {
+                "warn": "0ms"}}}},
+            "indexing": {"slowlog": {"threshold": {"index": {
+                "info": "0ms"}}}},
+        }})
+    for i in range(20):
+        c.index("logidx", {"body": f"alpha doc{i}"}, id=str(i))
+    c.indices.refresh("logidx")
+    return c
+
+
+class TestSlowLog:
+    def test_search_slowlog_records(self, client):
+        client.search("logidx", {"query": {"match": {"body": "alpha"}}})
+        entries = client.node.indices["logidx"].search_slowlog.entries
+        assert entries and entries[-1]["level"] == "warn"
+        assert entries[-1]["index"] == "logidx"
+        assert entries[-1]["took_millis"] >= 0
+
+    def test_indexing_slowlog_records(self, client):
+        client.index("logidx", {"body": "beta"}, id="x1")
+        entries = client.node.indices["logidx"].index_slowlog.entries
+        assert entries and entries[-1]["level"] == "info"
+
+    def test_thresholds_respected(self):
+        sl = SlowLog("i", {"index": {"search": {"slowlog": {"threshold": {
+            "query": {"warn": "1s", "info": "100ms"}}}}}}, "search", "query")
+        assert sl.maybe_log(0.5, "q") == "info"
+        assert sl.maybe_log(1.5, "q") == "warn"
+        assert sl.maybe_log(0.05, "q") is None
+
+    def test_flattened_settings_form(self):
+        sl = SlowLog("i", {"index": {
+            "search.slowlog.threshold.query.warn": "10ms"}},
+            "search", "query")
+        assert sl.thresholds == {"warn": 0.01}
+
+    def test_stats_exposed(self, client):
+        client.search("logidx", {"query": {"match_all": {}}, "_p": 9})
+        st = client.node.indices["logidx"].stats()
+        assert st["slowlog"]["search"]["recent"]
+
+
+class TestTasks:
+    def test_registry_lifecycle(self):
+        reg = TaskRegistry()
+        t = reg.register("indices:data/read/search", "test")
+        assert reg.list()[0]["action"] == "indices:data/read/search"
+        assert reg.cancel(t.id)
+        with pytest.raises(TaskCancelledException):
+            t.ensure_not_cancelled()
+        reg.unregister(t)
+        assert reg.list() == []
+        assert reg.stats()["completed"] == 1
+
+    def test_cancelled_task_aborts_query_phase(self, client):
+        from opensearch_tpu.search.executor import ShardSearcher
+        svc = client.node.indices["logidx"]
+        s = ShardSearcher(svc.shards[0])
+        reg = TaskRegistry()
+        t = reg.register("search", "t")
+        t.cancel("test")
+        with pytest.raises(TaskCancelledException):
+            s.query_phase({"query": {"match": {"body": "alpha"}}}, task=t)
+
+    def test_rest_maps_cancel_to_400(self, client):
+        orig = client.node.tasks.register
+
+        def precancelled(action, description="", cancellable=True):
+            t = orig(action, description, cancellable)
+            t.cancel("injected")
+            return t
+
+        client.node.tasks.register = precancelled
+        try:
+            with pytest.raises(ApiError) as ei:
+                client.search("logidx", {"query": {"match": {"body": "alpha"}},
+                                         "_p": "cancel"})
+            assert ei.value.status == 400
+        finally:
+            client.node.tasks.register = orig
+
+    def test_tasks_api_and_cancel_endpoint(self, client):
+        t = client.node.tasks.register("indices:data/read/scroll", "demo")
+        listed = client.tasks(actions="indices:data/read/*")
+        assert str(t.id) in listed["nodes"][client.node.node_name]["tasks"]
+        assert client.cancel_task(t.id)["acknowledged"]
+        with pytest.raises(ApiError):
+            client.cancel_task(999999)
+        client.node.tasks.unregister(t)
+
+
+class TestThreadPools:
+    def test_flush_fans_out_on_write_pool(self, client, tmp_path):
+        c = RestClient(data_path=str(tmp_path / "d"))
+        c.indices.create("fp", {"settings": {"number_of_shards": 3}})
+        for i in range(9):
+            c.index("fp", {"v": i}, id=str(i))
+        c.indices.refresh("fp")
+        before = c.node.thread_pools.pool("write").completed
+        c.indices.flush("fp")
+        assert c.node.thread_pools.pool("write").completed >= before + 3
+        # durability preserved through the pooled flush
+        c2 = RestClient(data_path=str(tmp_path / "d"))
+        assert c2.count("fp")["count"] == 9
+
+    def test_cat_thread_pool(self, client):
+        rows = client.cat.thread_pool()
+        names = {r["name"] for r in rows}
+        assert {"write", "snapshot", "management", "generic"} <= names
