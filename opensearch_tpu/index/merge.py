@@ -9,6 +9,7 @@ from typing import Dict, List
 
 import numpy as np
 
+from ..ops import device_merge
 from .segment import (GeoColumn, KeywordColumn, NumericColumn, PostingsBlock, Segment,
                       TextFieldStats, VectorColumn)
 
@@ -89,10 +90,21 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
         rows = np.concatenate(rows_parts)
         docs = np.concatenate(docs_parts)
         tfs = np.concatenate(tfs_parts)
-        order = np.lexsort((docs, rows))
-        rows, docs, tfs = rows[order], docs[order], tfs[order]
         starts = np.zeros(len(vocab_union) + 1, dtype=np.int64)
-        np.cumsum(np.bincount(rows, minlength=len(vocab_union)), out=starts[1:])
+        if device_merge.use_device_merge(len(rows)):
+            # the O(P log P) multiway sorted-run merge runs on device
+            # (ops/device_merge.py); `order` drives the host position
+            # regather so results stay bit-identical to the numpy path
+            _r, d32, t32, order, counts = device_merge.merge_sorted_runs(
+                rows, docs, tfs, len(vocab_union))
+            docs, tfs = d32.astype(np.int64), t32
+            order = order.astype(np.int64)
+            np.cumsum(counts.astype(np.int64), out=starts[1:])
+        else:
+            order = np.lexsort((docs, rows))
+            rows, docs, tfs = rows[order], docs[order], tfs[order]
+            np.cumsum(np.bincount(rows, minlength=len(vocab_union)),
+                      out=starts[1:])
         pos_starts = positions = None
         if has_positions and pos_len_parts:
             plens = np.concatenate(pos_len_parts)[order]

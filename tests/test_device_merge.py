@@ -1,0 +1,86 @@
+"""Device-side multiway sorted-run merge (ops/device_merge.py): the postings
+lexsort of segment merging runs as a 2-key lax.sort; results must be
+bit-identical to the numpy path, including positional regathers."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.ops import device_merge
+from opensearch_tpu.rest.client import RestClient
+
+WORDS = [f"w{i}" for i in range(50)]
+
+
+def _build_engine():
+    rng = np.random.default_rng(3)
+    m = Mappings({"properties": {"body": {"type": "text"},
+                                 "tag": {"type": "keyword"}}})
+    eng = Engine(m)
+    for i in range(400):
+        eng.index_doc(str(i), {"body": " ".join(rng.choice(WORDS, size=8)),
+                               "tag": f"t{i % 7}"})
+        if i % 100 == 99:
+            eng.refresh()          # 4 segments
+    # delete some docs so the merge compacts
+    for i in range(0, 50, 5):
+        eng.delete_doc(str(i))
+    eng.refresh()
+    return eng
+
+
+class TestDeviceMerge:
+    def test_sorted_runs_match_lexsort(self):
+        rng = np.random.default_rng(0)
+        n, n_rows = 5000, 64
+        rows = rng.integers(0, n_rows, n).astype(np.int64)
+        docs = rng.permutation(n).astype(np.int64)  # unique (row, doc) pairs
+        tfs = rng.random(n).astype(np.float32)
+        r, d, t, order, counts = device_merge.merge_sorted_runs(
+            rows.astype(np.int32), docs.astype(np.int32), tfs, n_rows)
+        ref = np.lexsort((docs, rows))
+        np.testing.assert_array_equal(r, rows[ref])
+        np.testing.assert_array_equal(d, docs[ref])
+        np.testing.assert_array_equal(t, tfs[ref])
+        np.testing.assert_array_equal(order, ref)
+        np.testing.assert_array_equal(counts,
+                                      np.bincount(rows, minlength=n_rows))
+
+    def test_force_merge_bit_identical(self, monkeypatch):
+        eng_dev = _build_engine()
+        monkeypatch.setattr(device_merge, "DEVICE_MERGE_MIN", 1)
+        eng_dev.force_merge(1)
+        monkeypatch.setattr(device_merge, "DEVICE_MERGE_MIN", 1 << 62)
+        eng_np = _build_engine()
+        eng_np.force_merge(1)
+        sd, sn = eng_dev.segments[0], eng_np.segments[0]
+        assert sd.ndocs == sn.ndocs
+        assert sd.ids[:] == sn.ids[:]
+        for f in ("body", "tag"):
+            pd, pn = sd.postings.get(f), sn.postings.get(f)
+            if pn is None:
+                assert pd is None
+                continue
+            assert pd.vocab == pn.vocab
+            np.testing.assert_array_equal(pd.starts, pn.starts)
+            np.testing.assert_array_equal(pd.doc_ids, pn.doc_ids)
+            np.testing.assert_array_equal(pd.tfs, pn.tfs)
+            if pn.pos_starts is not None:
+                np.testing.assert_array_equal(pd.pos_starts, pn.pos_starts)
+                np.testing.assert_array_equal(pd.positions, pn.positions)
+
+    def test_phrases_survive_device_merge(self, monkeypatch):
+        monkeypatch.setattr(device_merge, "DEVICE_MERGE_MIN", 1)
+        c = RestClient()
+        c.indices.create("dm")
+        for i in range(120):
+            c.index("dm", {"body": f"alpha beta doc{i}"}, id=str(i))
+            if i % 40 == 39:
+                c.indices.refresh("dm")
+        c.indices.refresh("dm")
+        c.indices.forcemerge("dm")
+        eng = c.node.indices["dm"].shards[0]
+        assert len(eng.segments) == 1
+        r = c.search("dm", {"query": {"match_phrase": {"body": "alpha beta"}}})
+        assert r["hits"]["total"]["value"] == 120
