@@ -53,6 +53,26 @@ def _run_update_script_or_400(script_body, src: dict, meta: dict):
                        f"failed to execute script: {e}")
 
 
+def _parse_keepalive_s(v, default: float = 60.0) -> float:
+    """'1m' / '30s' / '500ms' -> seconds (scroll/PIT keep-alives); invalid
+    values are client errors (HTTP 400)."""
+    if v is None:
+        return default
+    if isinstance(v, (int, float)):
+        return float(v)
+    sv = str(v).strip()
+    try:
+        for suf, mult in (("micros", 1e-6), ("nanos", 1e-9), ("ms", 0.001),
+                          ("s", 1.0), ("m", 60.0), ("h", 3600.0),
+                          ("d", 86400.0)):
+            if sv.endswith(suf):
+                return float(sv[: -len(suf)]) * mult
+        return float(sv)
+    except ValueError:
+        raise ApiError(400, "illegal_argument_exception",
+                       f"failed to parse time value [{v}]")
+
+
 class RestClient:
     def __init__(self, node: Optional[Node] = None, data_path: Optional[str] = None):
         self.node = node or Node(data_path=data_path)
@@ -247,7 +267,7 @@ class RestClient:
         pit = body.pop("pit", None)
         try:
             if pit is not None:
-                return self._search_pit(pit["id"], body)
+                return self._search_pit(pit, body)
             resp = self.node.search(index, body)
         except dsl.QueryParseError as e:
             # malformed DSL is a client error, not an engine crash
@@ -261,9 +281,12 @@ class RestClient:
             names = self.node.metadata.resolve(index)
             snapshot = {n: [list(s.segments) for s in self.node.indices[n].shards]
                         for n in names}
+            ka = _parse_keepalive_s(scroll if scroll is not True else None)
             self._scrolls[sid] = {"index": index, "body": body,
                                   "offset": int(body.get("from", 0)) + int(body.get("size", 10)),
-                                  "snapshot": snapshot}
+                                  "snapshot": snapshot,
+                                  "keep_alive": ka,
+                                  "expires": time.time() + ka}
             resp["_scroll_id"] = sid
         return resp
 
@@ -304,11 +327,26 @@ class RestClient:
                 searchers.append(s)
         return searchers
 
+    def _expire_contexts(self) -> None:
+        """Lazy keep-alive enforcement (reference: reaper thread)."""
+        now = time.time()
+        for sid in [k for k, v in self._scrolls.items()
+                    if v.get("expires", now + 1) <= now]:
+            del self._scrolls[sid]
+        for pid in [k for k, v in self._pits.items()
+                    if v.get("expires", now + 1) <= now]:
+            del self._pits[pid]
+
     def scroll(self, scroll_id: str, scroll: Optional[str] = None) -> dict:
+        self._expire_contexts()
         sctx = self._scrolls.get(scroll_id)
         if sctx is None:
             raise ApiError(404, "search_context_missing_exception",
                            f"No search context found for id [{scroll_id}]")
+        ka = (_parse_keepalive_s(scroll) if scroll
+              else sctx.get("keep_alive", 60.0))
+        sctx["keep_alive"] = ka
+        sctx["expires"] = time.time() + ka
         body = dict(sctx["body"])
         body["from"] = sctx["offset"]
         searchers = self._snapshot_searchers(sctx["snapshot"])
@@ -336,8 +374,11 @@ class RestClient:
         names = self.node.metadata.resolve(index)
         snapshot = {n: [list(s.segments) for s in self.node.indices[n].shards]
                     for n in names}
+        ka = _parse_keepalive_s(keep_alive)
         self._pits[pid] = {"index": index, "snapshot": snapshot,
-                           "creation_time": time.time()}
+                           "creation_time": time.time(),
+                           "keep_alive": ka,
+                           "expires": time.time() + ka}
         return {"pit_id": pid, "creation_time": int(time.time() * 1000)}
 
     def delete_pit(self, body: dict) -> dict:
@@ -346,11 +387,18 @@ class RestClient:
         deleted = [p for p in ids if self._pits.pop(p, None) is not None]
         return {"pits": [{"pit_id": p, "successful": True} for p in deleted]}
 
-    def _search_pit(self, pit_id: str, body: dict) -> dict:
+    def _search_pit(self, pit: dict, body: dict) -> dict:
+        pit_id = pit["id"]
+        self._expire_contexts()
         pctx = self._pits.get(pit_id)
         if pctx is None:
             raise ApiError(404, "search_context_missing_exception",
                            f"Point in time [{pit_id}] not found")
+        # per-request keep_alive extends the context (reference behavior)
+        ka = (_parse_keepalive_s(pit["keep_alive"])
+              if pit.get("keep_alive") else pctx.get("keep_alive", 60.0))
+        pctx["keep_alive"] = ka
+        pctx["expires"] = time.time() + ka
         searchers = self._snapshot_searchers(pctx["snapshot"])
         resp = _search_snapshot(searchers, body, pctx["index"])
         resp["pit_id"] = pit_id

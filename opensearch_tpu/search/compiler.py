@@ -496,6 +496,21 @@ def _index_term(field: str, value: Any, ctx: ShardContext) -> str:
     return str(value)
 
 
+def _ip_cidr_node(field: str, mask: str, boost: float) -> LNode:
+    """CIDR -> exact 64-bit ip range (reference IpFieldMapper prefix query)."""
+    import ipaddress
+
+    from ..index.mappings import _ip_to_int
+    try:
+        net = ipaddress.ip_network(mask, strict=False)
+    except ValueError as e:
+        raise dsl.QueryParseError(f"invalid IP mask [{mask}]: {e}")
+    return LRange(field=field, kind="int",
+                  lo=_ip_to_int(str(net.network_address)),
+                  hi=_ip_to_int(str(net.broadcast_address)),
+                  include_lo=True, include_hi=True, boost=boost)
+
+
 def _numeric_eq_node(ft, field: str, value: Any, boost: float) -> LNode:
     cv = coerce_value(ft, value)
     kind = "float" if ft.type in FLOAT_TYPES else "int"
@@ -513,6 +528,9 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
 
     if isinstance(q, dsl.TermQuery):
         ft = m.resolve_field(q.field)
+        if (ft is not None and ft.type == "ip" and isinstance(q.value, str)
+                and "/" in q.value):
+            return _ip_cidr_node(ft.name, q.value, q.boost)
         if ft is not None and ft.type in (INT_TYPES | FLOAT_TYPES) and ft.type != "date":
             return _numeric_eq_node(ft, ft.name, q.value, q.boost)
         if ft is not None and ft.type == "date":
@@ -526,6 +544,17 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
 
     if isinstance(q, dsl.TermsQuery):
         ft = m.resolve_field(q.field)
+        if ft is not None and ft.type == "ip" and any(
+                isinstance(v, str) and "/" in v for v in q.values):
+            # CIDR members expand to ranges; exact ips stay term matches
+            # (reference IpFieldMapper.termsQuery)
+            children = [
+                _ip_cidr_node(ft.name, v, 1.0)
+                if isinstance(v, str) and "/" in v else
+                _weighted_terms(ft.name, [_index_term(ft.name, v, ctx)],
+                                [1.0], ctx, 1, "filter", 1.0)
+                for v in q.values]
+            return LBool(shoulds=children, msm=1, boost=q.boost)
         if ft is not None and ft.type in (INT_TYPES | FLOAT_TYPES):
             children = [_numeric_eq_node(ft, ft.name, v, 1.0) for v in q.values]
             return LBool(shoulds=children, msm=1, boost=q.boost)
@@ -1451,7 +1480,8 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
                 tuple(prepare(c, seg, ctx, params) for c in node.musts),
                 tuple(prepare(c, seg, ctx, params) for c in node.shoulds),
                 tuple(prepare(c, seg, ctx, params) for c in node.must_nots),
-                tuple(prepare(c, seg, ctx, params) for c in node.filters))
+                tuple(_prepare_cached_filter(c, seg, ctx, params)
+                      for c in node.filters))
 
     if isinstance(node, LConstScore):
         _scalar_f32(params, f"q{nid}_boost", node.boost)
@@ -1950,6 +1980,10 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         scores, matched = pos_ops.phrase_score(freq, dl, live, params[f"q{nid}_w"],
                                                k1, b, params[f"q{nid}_avgdl"])
         return ops.ScoredMask(scores, matched.astype(jnp.float32))
+
+    if kind == "cached_mask":
+        m = params[f"q{nid}_cached_mask"]
+        return ops.ScoredMask(zeros, m.astype(jnp.float32))
 
     if kind == "span_host":
         from ..ops import positions as pos_ops
@@ -3814,6 +3848,118 @@ def _emit_bucketed_sub(jnp, sub, i: int, bucket_ids, nb: int, seg_arrays, match)
 # =====================================================================
 # executor: jitted per-spec program
 # =====================================================================
+
+# filter-context mask cache (reference IndicesQueryCache: bitsets cached per
+# (segment, filter)): dense bool masks keyed by (segment uid, live_gen,
+# filter spec, param digest), device-resident, LRU-evicted
+_FILTER_MASK_CACHE: "OrderedDict[tuple, Any]" = __import__(
+    "collections").OrderedDict()
+_FILTER_MASK_MAX_BYTES = 256 << 20   # byte-bounded like IndicesQueryCache
+_FILTER_MASK_BYTES = [0]
+_FILTER_HASH_BYTE_CAP = 1 << 20   # don't hash megabyte param sets
+
+
+def filter_mask_cache_stats() -> dict:
+    return {"entries": len(_FILTER_MASK_CACHE),
+            "bytes": _FILTER_MASK_BYTES[0]}
+
+
+def _purge_masks_for_uid(uid: int) -> None:
+    """Weakref finalizer: a dropped segment's masks can never hit again."""
+    for k in [k for k in _FILTER_MASK_CACHE if k[0] == uid]:
+        _FILTER_MASK_BYTES[0] -= _FILTER_MASK_CACHE[k].nbytes
+        del _FILTER_MASK_CACHE[k]
+
+
+@lru_cache(maxsize=256)
+def _build_mask_executor(spec):
+    import jax
+
+    def run(seg_arrays, params):
+        return emit(spec, seg_arrays, params).matched
+
+    return jax.jit(run)
+
+
+def _canon_spec(spec, mapping: Dict[int, int]):
+    """Renumber node ids by first appearance so structurally identical
+    filter specs hash equal across queries (nids are a global counter)."""
+    if (isinstance(spec, tuple) and len(spec) >= 2
+            and isinstance(spec[0], str) and isinstance(spec[1], int)):
+        cid = mapping.setdefault(spec[1], len(mapping))
+        return (spec[0], cid) + tuple(_canon_spec(x, mapping)
+                                      for x in spec[2:])
+    if isinstance(spec, tuple):
+        return tuple(_canon_spec(x, mapping) for x in spec)
+    return spec
+
+
+def _canon_param_key(key: str, mapping: Dict[int, int]) -> str:
+    if key.startswith("q"):
+        head, _, rest = key.partition("_")
+        try:
+            nid = int(head[1:])
+        except ValueError:
+            return key
+        if nid in mapping:
+            return f"q{mapping[nid]}_{rest}"
+    return key
+
+
+def _prepare_cached_filter(node: LNode, seg: Segment, ctx: ShardContext,
+                           params: dict):
+    """Prepare a filter-context clause through the mask cache: repeated
+    filters (the classic "status:published + range" guardrails) reuse one
+    device-resident bool mask instead of re-running their program."""
+    import hashlib
+
+    local: Dict[str, Any] = {}
+    spec = prepare(node, seg, ctx, local)
+    # hash the nid-canonicalized spec + this segment's param payload
+    mapping: Dict[int, int] = {}
+    h = hashlib.blake2b(repr(_canon_spec(spec, mapping)).encode(),
+                        digest_size=16)
+    total = 0
+    for k0 in sorted(local, key=lambda k: _canon_param_key(k, mapping)):
+        v = local[k0]
+        arr = np.asarray(v)
+        total += arr.nbytes
+        if total > _FILTER_HASH_BYTE_CAP:
+            params.update(local)
+            return spec            # too big to hash cheaply: no caching
+        h.update(_canon_param_key(k0, mapping).encode())
+        h.update(arr.tobytes())
+    key = (seg.uid, seg.live_gen, h.hexdigest())
+    mask = _FILTER_MASK_CACHE.get(key)
+    if mask is None:
+        # use whichever device already hosts this segment (replica copies
+        # must not trigger a default-device re-host just for the cache)
+        dev_key = None
+        if seg._device_cache and None not in seg._device_cache:
+            dev_key = next(iter(seg._device_cache))
+        # jit against the CANONICAL spec/params so structurally identical
+        # filters share one compiled program across requests
+        canon = _canon_spec(spec, dict(mapping))
+        canon_local = {_canon_param_key(k, mapping): v
+                       for k, v in local.items()}
+        exe = _build_mask_executor(canon)
+        # host-resident bools: safe to feed executors on ANY device
+        mask = np.asarray(exe(seg.device_arrays(dev_key), canon_local))
+        _FILTER_MASK_CACHE[key] = mask
+        _FILTER_MASK_BYTES[0] += mask.nbytes
+        if not hasattr(seg, "_mask_fin"):
+            import weakref
+            seg._mask_fin = weakref.finalize(seg, _purge_masks_for_uid,
+                                             seg.uid)
+        while _FILTER_MASK_BYTES[0] > _FILTER_MASK_MAX_BYTES:
+            _k, _v = _FILTER_MASK_CACHE.popitem(last=False)
+            _FILTER_MASK_BYTES[0] -= _v.nbytes
+    else:
+        _FILTER_MASK_CACHE.move_to_end(key)
+    nid = node.nid
+    params[f"q{nid}_cached_mask"] = mask
+    return ("cached_mask", nid)
+
 
 def prepare_collapse(collapse: Optional[dict], seg: Segment, ctx: ShardContext,
                      params: dict):

@@ -27,7 +27,7 @@ from . import query_dsl as dsl
 from .aggregations import (AggNode, _apply_bucket_pipelines,
                            apply_pipelines_tree, finalize, merge_partials,
                            parse_aggs)
-from .highlight import collect_query_terms, highlight_field
+from .highlight import collect_query_terms, highlight_field, highlight_unified
 
 INT32_SENTINEL = np.int32(2**31 - 1)
 
@@ -566,8 +566,11 @@ class ShardSearcher:
                 vals = _extract_source_values(seg.sources[c.local_doc], fname)
                 frags = []
                 analyzer = self.engine.mappings.index_analyzer(ft)
+                hl_type = fopts.get("type", hl_body.get("type", "plain"))
+                hl_fn = (highlight_unified if hl_type == "unified"
+                         else highlight_field)
                 for v in vals:
-                    frags.extend(highlight_field(
+                    frags.extend(hl_fn(
                         str(v), terms, analyzer,
                         pre_tag=(hl_body.get("pre_tags") or ["<em>"])[0],
                         post_tag=(hl_body.get("post_tags") or ["</em>"])[0],

@@ -43,6 +43,54 @@ def highlight_field(text: str, terms: Set[str], analyzer: Analyzer,
     return out
 
 
+def highlight_unified(text: str, terms: Set[str], analyzer: Analyzer,
+                      pre_tag: str = "<em>", post_tag: str = "</em>",
+                      fragment_size: int = 100,
+                      number_of_fragments: int = 5) -> List[str]:
+    """Unified-highlighter analog (reference
+    `subphase/highlight/UnifiedHighlighter.java` over Lucene's passage
+    formatter): sentence-bounded passages scored by distinct matched terms
+    (unique-term coverage first, then hit count), best passages returned in
+    score order."""
+    exact = {t for t in terms if not t.endswith("*")}
+    prefixes = tuple(t[:-1] for t in terms if t.endswith("*") and len(t) > 1)
+    tokens = analyzer.analyze(text)
+    hits = [(t.start_offset, t.end_offset, t.text) for t in tokens
+            if t.text in exact or (prefixes and t.text.startswith(prefixes))]
+    if not hits:
+        return []
+    if number_of_fragments == 0:
+        return [_mark(text, [(a, b) for a, b, _ in hits], pre_tag, post_tag)]
+    # sentence-ish passage boundaries, merged up to ~fragment_size
+    bounds = [0]
+    for i, ch in enumerate(text):
+        if ch in ".!?\n":
+            bounds.append(i + 1)
+    if bounds[-1] != len(text):
+        bounds.append(len(text))
+    passages: List[tuple] = []
+    s = bounds[0]
+    for e in bounds[1:]:
+        if e - s >= fragment_size and s != e:
+            passages.append((s, e))
+            s = e
+    if s < len(text):
+        passages.append((s, len(text)))
+    scored = []
+    for (a, b) in passages:
+        ph = [(ha, hb, tt) for ha, hb, tt in hits if ha >= a and hb <= b]
+        if not ph:
+            continue
+        uniq = len({tt for _, _, tt in ph})
+        scored.append((uniq, len(ph), a, b, ph))
+    scored.sort(key=lambda x: (-x[0], -x[1], x[2]))
+    out = []
+    for _u, _n, a, b, ph in scored[:number_of_fragments]:
+        rel = [(ha - a, hb - a) for ha, hb, _ in ph]
+        out.append(_mark(text[a:b], rel, pre_tag, post_tag))
+    return out
+
+
 def _mark(text: str, spans: List[tuple], pre: str, post: str) -> str:
     out = []
     prev = 0

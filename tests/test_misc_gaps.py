@@ -1,0 +1,145 @@
+"""Round-2 gap fills: filter-mask query cache, scroll/PIT keep-alive
+expiry, unified highlighter, ip CIDR term queries."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.search import compiler as C
+
+
+@pytest.fixture(scope="module")
+def client():
+    c = RestClient()
+    c.indices.create("mg", {"mappings": {"properties": {
+        "body": {"type": "text"}, "status": {"type": "keyword"},
+        "ip": {"type": "ip"}, "n": {"type": "long"}}}})
+    for i in range(40):
+        c.index("mg", {"body": f"alpha beta doc{i}. second sentence here. "
+                               f"third one mentions alpha again.",
+                       "status": "published" if i % 2 == 0 else "draft",
+                       "ip": f"10.0.{i % 3}.{i}", "n": i}, id=str(i))
+    c.indices.refresh("mg")
+    return c
+
+
+class TestFilterMaskCache:
+    def test_repeated_filter_hits_cache(self, client):
+        before = C.filter_mask_cache_stats()["entries"]
+        body1 = {"query": {"bool": {
+            "must": [{"match": {"body": "alpha"}}],
+            "filter": [{"term": {"status": "published"}}]}}, "_p": 1}
+        body2 = {"query": {"bool": {
+            "must": [{"match": {"body": "beta"}}],
+            "filter": [{"term": {"status": "published"}}]}}, "_p": 2}
+        r1 = client.search("mg", body1)
+        entries_after_first = C.filter_mask_cache_stats()["entries"]
+        assert entries_after_first > before
+        r2 = client.search("mg", body2)
+        # same filter spec -> no new cache entry
+        assert C.filter_mask_cache_stats()["entries"] == entries_after_first
+        assert r1["hits"]["total"]["value"] == 20
+        assert r2["hits"]["total"]["value"] == 20
+
+    def test_cache_respects_deletes(self, client):
+        c = RestClient()
+        c.indices.create("fm2", {"mappings": {"properties": {
+            "s": {"type": "keyword"}, "b": {"type": "text"}}}})
+        for i in range(10):
+            c.index("fm2", {"s": "x", "b": "w"}, id=str(i))
+        c.indices.refresh("fm2")
+        q = {"query": {"bool": {"must": [{"match": {"b": "w"}}],
+                                "filter": [{"term": {"s": "x"}}]}}}
+        assert c.search("fm2", dict(q, _p=1))["hits"]["total"]["value"] == 10
+        c.delete("fm2", "0", refresh=True)
+        assert c.search("fm2", dict(q, _p=2))["hits"]["total"]["value"] == 9
+
+
+class TestScrollPitExpiry:
+    def test_scroll_expires(self, client):
+        import time as _t
+        r = client.search("mg", {"query": {"match_all": {}}, "size": 5,
+                                 "_p": "sc"}, scroll="50ms")
+        sid = r["_scroll_id"]
+        assert client.scroll(sid, scroll="50ms")["hits"]["hits"]
+        _t.sleep(0.1)
+        with pytest.raises(ApiError) as ei:
+            client.scroll(sid)
+        assert ei.value.status == 404
+
+    def test_pit_expires(self, client):
+        import time as _t
+        pit = client.create_pit("mg", keep_alive="50ms")
+        _t.sleep(0.1)
+        with pytest.raises(ApiError):
+            client.search("mg", {"query": {"match_all": {}},
+                                 "pit": {"id": pit["pit_id"]}})
+
+
+class TestUnifiedHighlighter:
+    def test_unified_passages(self, client):
+        r = client.search("mg", {
+            "query": {"match": {"body": "alpha"}},
+            "highlight": {"type": "unified",
+                          "fields": {"body": {"fragment_size": 40,
+                                              "number_of_fragments": 2}}},
+            "size": 1, "_p": "hl"})
+        frags = r["hits"]["hits"][0]["highlight"]["body"]
+        assert frags and all("<em>alpha</em>" in f for f in frags)
+        # passage with two distinct matched positions ranks first
+        assert len(frags) <= 2
+
+    def test_plain_still_default(self, client):
+        r = client.search("mg", {
+            "query": {"match": {"body": "beta"}},
+            "highlight": {"fields": {"body": {}}}, "size": 1, "_p": "hl2"})
+        assert "<em>beta</em>" in r["hits"]["hits"][0]["highlight"]["body"][0]
+
+
+class TestIpCidr:
+    def test_term_cidr(self, client):
+        r = client.search("mg", {"query": {"term": {"ip": "10.0.1.0/24"}},
+                                 "size": 0})
+        expected = sum(1 for i in range(40) if i % 3 == 1)
+        assert r["hits"]["total"]["value"] == expected
+
+    def test_exact_ip_term_still_works(self, client):
+        r = client.search("mg", {"query": {"term": {"ip": "10.0.0.0"}},
+                                 "size": 0})
+        assert r["hits"]["total"]["value"] == 1
+
+    def test_bad_cidr_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("mg", {"query": {"term": {"ip": "10.0.0.0/99"}}})
+
+
+class TestReviewFixes:
+    def test_bad_keepalive_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("mg", {"query": {"match_all": {}}, "_p": "ka"},
+                          scroll="1q")
+        assert ei.value.status == 400
+
+    def test_pit_keepalive_extends(self, client):
+        import time as _t
+        pit = client.create_pit("mg", keep_alive="150ms")
+        _t.sleep(0.08)
+        # renewal via the request's pit.keep_alive
+        client.search("mg", {"query": {"match_all": {}},
+                             "pit": {"id": pit["pit_id"],
+                                     "keep_alive": "10s"}, "_p": "r1"})
+        _t.sleep(0.1)   # past the ORIGINAL expiry, inside the renewed one
+        r = client.search("mg", {"query": {"match_all": {}},
+                                 "pit": {"id": pit["pit_id"]}, "_p": "r2"})
+        assert r["hits"]["total"]["value"] == 40
+
+    def test_terms_cidr_mix(self, client):
+        r = client.search("mg", {"query": {"terms": {
+            "ip": ["10.0.1.0/24", "10.0.0.0"]}}, "size": 0})
+        expected = sum(1 for i in range(40) if i % 3 == 1) + 1
+        assert r["hits"]["total"]["value"] == expected
+
+    def test_mask_cache_bytes_accounted(self, client):
+        st = C.filter_mask_cache_stats()
+        assert st["bytes"] >= 0
+        assert st["entries"] == 0 or st["bytes"] > 0
