@@ -1,0 +1,151 @@
+"""Index lifecycle management (ILM/ISM-lite).
+
+Reference: the ISM plugin's policy states + the core `_rollover` API
+(`action/admin/indices/rollover/`). Policies are simplified to the two
+actions that matter operationally — rollover (max_docs / max_age on the
+write index behind an alias) and delete (min_age) — and the state machine
+ticks DETERMINISTICALLY via `step()` instead of a background scheduler (the
+caller owns the clock; a cron wrapper recovers the reference behavior)."""
+
+from __future__ import annotations
+
+import re
+import time
+from typing import Dict, List, Optional
+
+
+def parse_age_s(v) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    for suf, mult in (("ms", 0.001), ("s", 1.0), ("m", 60.0), ("h", 3600.0),
+                      ("d", 86400.0)):
+        if s.endswith(suf):
+            return float(s[: -len(suf)]) * mult
+    return float(s)
+
+
+def next_rollover_name(index: str) -> str:
+    m = re.fullmatch(r"(.*)-(\d{6})", index)
+    if m:
+        return f"{m.group(1)}-{int(m.group(2)) + 1:06d}"
+    return f"{index}-000002"
+
+
+class LifecycleService:
+    def __init__(self, node):
+        self.node = node
+        self.policies: Dict[str, dict] = {}
+        self.history: List[dict] = []
+
+    def put_policy(self, name: str, body: dict) -> None:
+        self.policies[name] = body.get("policy", body)
+
+    def get_policy(self, name: str) -> Optional[dict]:
+        return self.policies.get(name)
+
+    def _policy_for(self, meta) -> Optional[dict]:
+        idx = meta.settings.get("index", meta.settings)
+        lc = idx.get("lifecycle", {})
+        pname = lc.get("name") if isinstance(lc, dict) else None
+        pname = pname or idx.get("lifecycle.name")
+        return self.policies.get(pname) if pname else None
+
+    def _rollover_alias(self, meta) -> Optional[str]:
+        idx = meta.settings.get("index", meta.settings)
+        lc = idx.get("lifecycle", {})
+        alias = lc.get("rollover_alias") if isinstance(lc, dict) else None
+        return alias or idx.get("lifecycle.rollover_alias")
+
+    def explain(self, index: str) -> dict:
+        meta = self.node.metadata.indices[index]
+        policy = self._policy_for(meta)
+        return {"index": index, "managed": policy is not None,
+                "policy": policy,
+                "age_seconds": time.time() - meta.creation_date}
+
+    def check_conditions(self, index: str, conds: dict,
+                         now: Optional[float] = None) -> dict:
+        """Evaluate rollover conditions for one index (reference
+        RolloverRequest conditions; unknown keys are a client error)."""
+        now = now if now is not None else time.time()
+        meta = self.node.metadata.indices[index]
+        results = {}
+        for key, v in conds.items():
+            if key == "max_docs":
+                results["[max_docs]"] = (
+                    self.node.indices[index].num_docs >= int(v))
+            elif key == "max_age":
+                results["[max_age]"] = (
+                    now - meta.creation_date >= parse_age_s(v))
+            else:
+                raise ValueError(f"unknown rollover condition [{key}]")
+        return results
+
+    def _is_write_index(self, name: str, alias: Optional[str]) -> bool:
+        if not alias:
+            return False
+        try:
+            return self.node.metadata.write_index(alias) == name
+        except Exception:
+            return False
+
+    def step(self, now: Optional[float] = None) -> List[dict]:
+        """One deterministic lifecycle tick over every managed index.
+        Rollover is considered first; the CURRENT write index of a rollover
+        series is never deleted (it must roll out of write duty first, like
+        the reference ISM state machine). Returns the actions taken."""
+        now = now if now is not None else time.time()
+        actions = []
+        for name in list(self.node.indices.keys()):
+            meta = self.node.metadata.indices.get(name)
+            if meta is None:
+                continue
+            policy = self._policy_for(meta)
+            if not policy:
+                continue
+            age = now - meta.creation_date
+            ro = policy.get("rollover")
+            alias = self._rollover_alias(meta)
+            is_write = self._is_write_index(name, alias)
+            if ro and alias and is_write:
+                results = self.check_conditions(name, ro, now)
+                if results and any(results.values()):
+                    docs = self.node.indices[name].num_docs
+                    new_name = self.rollover(alias, name)
+                    actions.append({"index": name, "action": "rollover",
+                                    "new_index": new_name,
+                                    "docs": docs, "age_seconds": age})
+                    continue
+            delete_cfg = policy.get("delete")
+            if (delete_cfg and not (ro and is_write)
+                    and age >= parse_age_s(delete_cfg.get("min_age", "0ms"))):
+                self.node.delete_index(name)
+                actions.append({"index": name, "action": "delete",
+                                "age_seconds": age})
+        self.history.extend(actions)
+        return actions
+
+    def rollover(self, alias: str, old_index: str) -> str:
+        """Roll the series: create the next index and move the write alias
+        (shared by the _rollover API and step())."""
+        new_name = self._do_rollover(alias, old_index)
+        self.history.append({"index": old_index, "action": "rollover",
+                             "new_index": new_name})
+        return new_name
+
+    def _do_rollover(self, alias: str, old_index: str) -> str:
+        node = self.node
+        new_name = next_rollover_name(old_index)
+        old_meta = node.metadata.indices[old_index]
+        node.create_index(new_name, {"settings": dict(old_meta.settings),
+                                     "mappings":
+                                         node.indices[old_index].mappings.to_dict()})
+        am = node.metadata.aliases.get(alias)
+        if am is not None:
+            for idx in am.indices:
+                am.indices[idx] = dict(am.indices[idx],
+                                       is_write_index=False)
+            am.indices[new_name] = {"is_write_index": True}
+        node.metadata.bump()
+        return new_name

@@ -278,6 +278,10 @@ class Node:
         self.request_cache = RequestCache()
         self.tasks = TaskRegistry()
         self.thread_pools = ThreadPools()
+        from ..utils.wlm import WorkloadManagement
+        from .lifecycle import LifecycleService
+        self.wlm = WorkloadManagement()
+        self.lifecycle = LifecycleService(self)
         # SPMD mesh dispatch (parallel/service.py): pass a MeshSearchService
         # or set OPENSEARCH_TPU_MESH=1 to auto-build one over jax.devices();
         # eligible searches then run the distributed program with host-loop
@@ -539,6 +543,7 @@ class Node:
             "request_cache": self.request_cache.stats(),
             "tasks": self.tasks.stats(),
             "thread_pool": self.thread_pools.stats(),
+            "wlm": self.wlm.stats(),
             "uptime_in_millis": int((time.time() - self.start_time) * 1000),
         }
         if self.mesh_service is not None:

@@ -22,6 +22,7 @@ from ..search import compiler as C
 from ..search import query_dsl as dsl
 from ..utils.breaker import CircuitBreakingException
 from ..utils.tasks import TaskCancelledException
+from ..utils.wlm import PressureRejectedException
 
 
 class ApiError(Exception):
@@ -209,6 +210,19 @@ class RestClient:
             lines = [json.loads(ln) for ln in body.splitlines() if ln.strip()]
         else:
             lines = list(body)
+        # indexing pressure admission (reference IndexingPressure): budget
+        # in-flight bulk bytes, reject with 429 when saturated
+        est_bytes = sum(len(repr(ln)) for ln in lines)
+        try:
+            self.node.wlm.indexing.acquire(est_bytes)
+        except PressureRejectedException as e:
+            raise ApiError(429, "rejected_execution_exception", str(e))
+        try:
+            return self._bulk_inner(lines, index, refresh)
+        finally:
+            self.node.wlm.indexing.release(est_bytes)
+
+    def _bulk_inner(self, lines, index: Optional[str], refresh: bool) -> dict:
         items = []
         errors = False
         touched = set()
@@ -262,6 +276,12 @@ class RestClient:
                scroll: Optional[str] = None, **kw) -> dict:
         body = dict(body or {})
         body.update({k: v for k, v in kw.items() if v is not None})
+        # workload-group admission (reference wlm/): token-bucket rate limit
+        group = body.pop("_workload_group", None)
+        try:
+            self.node.wlm.group(group).admit_search()
+        except PressureRejectedException as e:
+            raise ApiError(429, "rejected_execution_exception", str(e))
         if body.get("query") is not None:
             body["query"] = self._resolve_percolate_refs(body["query"])
         pit = body.pop("pit", None)
@@ -449,6 +469,55 @@ class RestClient:
         if not ok:
             raise ApiError(404, "resource_not_found_exception",
                            f"task [{task_id}] is not found or not cancellable")
+        return {"acknowledged": True}
+
+    # ---------------- lifecycle + workload management ----------------
+
+    def put_lifecycle_policy(self, name: str, body: dict) -> dict:
+        self.node.lifecycle.put_policy(name, body or {})
+        return {"acknowledged": True}
+
+    def get_lifecycle_policy(self, name: str) -> dict:
+        p = self.node.lifecycle.get_policy(name)
+        if p is None:
+            raise ApiError(404, "resource_not_found_exception",
+                           f"lifecycle policy [{name}] not found")
+        return {name: {"policy": p}}
+
+    def lifecycle_explain(self, index: str) -> dict:
+        return self.node.lifecycle.explain(
+            self.node.metadata.write_index(index))
+
+    def lifecycle_step(self, now: Optional[float] = None) -> dict:
+        """One deterministic ISM tick (the reference runs this on a
+        scheduler; callers own the clock here)."""
+        return {"actions": self.node.lifecycle.step(now)}
+
+    def rollover(self, alias: str, body: Optional[dict] = None) -> dict:
+        """_rollover: roll the alias's write index when ANY condition is met
+        (empty conditions = always; reference RolloverRequest)."""
+        body = body or {}
+        if alias not in self.node.metadata.aliases:
+            raise ApiError(400, "illegal_argument_exception",
+                           f"rollover target [{alias}] is not an alias")
+        old = self.node.metadata.write_index(alias)
+        conds = body.get("conditions", {})
+        try:
+            results = self.node.lifecycle.check_conditions(old, conds)
+        except ValueError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e))
+        rolled = (not conds) or any(results.values())
+        new_index = None
+        if rolled:
+            new_index = self.node.lifecycle.rollover(alias, old)
+        return {"acknowledged": rolled, "rolled_over": rolled,
+                "old_index": old, "new_index": new_index,
+                "conditions": results}
+
+    def put_workload_group(self, name: str, body: Optional[dict] = None) -> dict:
+        body = body or {}
+        self.node.wlm.put_group(name, body.get("search_rate"),
+                                body.get("search_burst"))
         return {"acknowledged": True}
 
     # ---------------- search templates (reference modules/lang-mustache) ----

@@ -1,0 +1,114 @@
+"""Workload management: indexing pressure + search admission control.
+
+Reference `index/IndexingPressure.java` (byte-budgeted write admission,
+rejections counted) and `wlm/` workload groups (per-group concurrent-search
+and token-bucket rate limits). Host-side accounting; device work is already
+admission-controlled by the HBM circuit breakers."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class PressureRejectedException(Exception):
+    """HTTP 429 (reference OpenSearchRejectedExecutionException)."""
+
+
+class IndexingPressure:
+    """Byte budget for in-flight indexing (coordinating + primary combined;
+    this runtime has one node so the split collapses)."""
+
+    def __init__(self, limit_bytes: int = 64 << 20):
+        self.limit = limit_bytes
+        self.current = 0
+        self.total = 0
+        self.rejections = 0
+        self._lock = threading.Lock()
+
+    def acquire(self, nbytes: int) -> None:
+        with self._lock:
+            if self.current + nbytes > self.limit:
+                self.rejections += 1
+                raise PressureRejectedException(
+                    f"rejecting operation of [{nbytes}] bytes: current "
+                    f"[{self.current}] + operation would exceed "
+                    f"[{self.limit}]")
+            self.current += nbytes
+            self.total += nbytes
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self.current = max(0, self.current - nbytes)
+
+    def stats(self) -> dict:
+        return {"current_bytes": self.current,
+                "total_bytes": self.total,
+                "limit_bytes": self.limit,
+                "rejections": self.rejections}
+
+
+class TokenBucket:
+    def __init__(self, rate_per_s: float, burst: float):
+        self.rate = rate_per_s
+        self.burst = burst
+        self.tokens = burst
+        self.last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self.last) * self.rate)
+            self.last = now
+            if self.tokens >= n:
+                self.tokens -= n
+                return True
+            return False
+
+
+class WorkloadGroup:
+    def __init__(self, name: str, search_rate: Optional[float] = None,
+                 search_burst: Optional[float] = None):
+        self.name = name
+        # rate=0 means "block" (a bucket that never refills), not unlimited;
+        # burst=0 is honored (only refill admits)
+        self.bucket = (TokenBucket(search_rate,
+                                   search_burst if search_burst is not None
+                                   else max(search_rate, 1.0))
+                       if search_rate is not None else None)
+        self.searches = 0
+        self.rejections = 0
+
+    def admit_search(self) -> None:
+        self.searches += 1
+        if self.bucket is not None and not self.bucket.try_take():
+            self.rejections += 1
+            raise PressureRejectedException(
+                f"workload group [{self.name}] search rate limit exceeded")
+
+    def stats(self) -> dict:
+        return {"searches": self.searches, "rejections": self.rejections,
+                "rate_limited": self.bucket is not None}
+
+
+class WorkloadManagement:
+    def __init__(self, indexing_limit_bytes: int = 64 << 20):
+        self.indexing = IndexingPressure(indexing_limit_bytes)
+        self.groups: Dict[str, WorkloadGroup] = {
+            "default": WorkloadGroup("default")}
+
+    def put_group(self, name: str, search_rate: Optional[float] = None,
+                  search_burst: Optional[float] = None) -> WorkloadGroup:
+        g = WorkloadGroup(name, search_rate, search_burst)
+        self.groups[name] = g
+        return g
+
+    def group(self, name: Optional[str]) -> WorkloadGroup:
+        return self.groups.get(name or "default", self.groups["default"])
+
+    def stats(self) -> dict:
+        return {"indexing_pressure": self.indexing.stats(),
+                "groups": {n: g.stats() for n, g in self.groups.items()}}

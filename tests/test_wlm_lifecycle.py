@@ -1,0 +1,166 @@
+"""Workload management (indexing pressure + search rate limits, reference
+`index/IndexingPressure.java`, `wlm/`) and ILM-lite (rollover/delete
+policies + the _rollover API, reference ISM + `action/admin/indices/
+rollover/`)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.utils.wlm import IndexingPressure, PressureRejectedException
+
+
+class TestIndexingPressure:
+    def test_acquire_release_and_reject(self):
+        p = IndexingPressure(limit_bytes=100)
+        p.acquire(60)
+        with pytest.raises(PressureRejectedException):
+            p.acquire(50)
+        assert p.stats()["rejections"] == 1
+        p.release(60)
+        p.acquire(90)
+        assert p.stats()["current_bytes"] == 90
+
+    def test_bulk_rejects_when_saturated(self):
+        c = RestClient()
+        c.indices.create("wp")
+        c.node.wlm.indexing.limit = 50    # tiny budget
+        with pytest.raises(ApiError) as ei:
+            c.bulk([{"index": {"_index": "wp", "_id": "1"}},
+                    {"body": "x" * 200}])
+        assert ei.value.status == 429
+        # budget released after rejection; small ops still pass
+        c.node.wlm.indexing.limit = 1 << 20
+        r = c.bulk([{"index": {"_index": "wp", "_id": "1"}}, {"b": 1}])
+        assert not r["errors"]
+
+
+class TestWorkloadGroups:
+    def test_search_rate_limit(self):
+        c = RestClient()
+        c.indices.create("wg")
+        c.index("wg", {"b": 1}, id="1", refresh=True)
+        c.put_workload_group("analytics", {"search_rate": 0.0001,
+                                           "search_burst": 2})
+        ok = 0
+        rejected = 0
+        for i in range(4):
+            try:
+                c.search("wg", {"query": {"match_all": {}}, "_p": i,
+                                "_workload_group": "analytics"})
+                ok += 1
+            except ApiError as e:
+                assert e.status == 429
+                rejected += 1
+        assert ok == 2 and rejected == 2
+        # default group is unlimited
+        for i in range(5):
+            c.search("wg", {"query": {"match_all": {}}, "_p": f"d{i}"})
+        assert c.node.stats()["wlm"]["groups"]["analytics"]["rejections"] == 2
+
+
+class TestLifecycle:
+    def test_rollover_api(self):
+        c = RestClient()
+        c.indices.create("logs-000001", {"aliases": {"logs": {
+            "is_write_index": True}}})
+        for i in range(5):
+            c.index("logs", {"n": i}, id=str(i))
+        r = c.rollover("logs", {"conditions": {"max_docs": 10}})
+        assert not r["rolled_over"]
+        r = c.rollover("logs", {"conditions": {"max_docs": 5}})
+        assert r["rolled_over"] and r["new_index"] == "logs-000002"
+        # writes now land in the new index
+        c.index("logs", {"n": 99}, id="99")
+        assert c.node.indices["logs-000002"].num_docs == 1
+        # searches through the alias see both
+        c.indices.refresh("logs-*")
+        resp = c.search("logs", {"query": {"match_all": {}}, "size": 20})
+        assert resp["hits"]["total"]["value"] == 6
+
+    def test_policy_step_rollover_and_delete(self):
+        c = RestClient()
+        c.put_lifecycle_policy("weekly", {"policy": {
+            "rollover": {"max_docs": 3},
+            "delete": {"min_age": "1h"},
+        }})
+        c.indices.create("app-000001", {
+            "settings": {"lifecycle": {"name": "weekly",
+                                       "rollover_alias": "app"}},
+            "aliases": {"app": {"is_write_index": True}}})
+        for i in range(3):
+            c.index("app", {"n": i}, id=str(i))
+        acts = c.lifecycle_step()["actions"]
+        assert any(a["action"] == "rollover" and a["new_index"] == "app-000002"
+                   for a in acts)
+        # second step: nothing to do yet
+        assert c.lifecycle_step()["actions"] == []
+        # far future: both indices age out and get deleted
+        import time as _t
+        acts = c.lifecycle_step(now=_t.time() + 7200)["actions"]
+        deleted = {a["index"] for a in acts if a["action"] == "delete"}
+        assert "app-000001" in deleted
+        assert not c.indices.exists("app-000001")
+
+    def test_explain(self):
+        c = RestClient()
+        c.put_lifecycle_policy("p1", {"policy": {"delete": {"min_age": "1d"}}})
+        c.indices.create("exp-1", {"settings": {
+            "lifecycle": {"name": "p1"}}})
+        e = c.lifecycle_explain("exp-1")
+        assert e["managed"] and e["policy"]["delete"]["min_age"] == "1d"
+        with pytest.raises(ApiError):
+            c.get_lifecycle_policy("nope")
+
+
+class TestReviewFixes:
+    def test_rollover_any_condition(self):
+        c = RestClient()
+        c.indices.create("rr-000001", {"aliases": {"rr": {
+            "is_write_index": True}}})
+        for i in range(3):
+            c.index("rr", {"n": i}, id=str(i))
+        # max_docs met, max_age not -> ANY semantics rolls
+        r = c.rollover("rr", {"conditions": {"max_docs": 2,
+                                             "max_age": "7d"}})
+        assert r["rolled_over"]
+
+    def test_rollover_unknown_condition_400(self):
+        c = RestClient()
+        c.indices.create("ru-000001", {"aliases": {"ru": {
+            "is_write_index": True}}})
+        with pytest.raises(ApiError) as ei:
+            c.rollover("ru", {"conditions": {"max_size": "5gb"}})
+        assert ei.value.status == 400
+
+    def test_rollover_concrete_index_400(self):
+        c = RestClient()
+        c.indices.create("plain-1")
+        with pytest.raises(ApiError) as ei:
+            c.rollover("plain-1")
+        assert ei.value.status == 400
+
+    def test_write_index_never_deleted(self):
+        c = RestClient()
+        c.put_lifecycle_policy("aggr", {"policy": {
+            "rollover": {"max_docs": 1000},
+            "delete": {"min_age": "1h"}}})
+        c.indices.create("keep-000001", {
+            "settings": {"lifecycle": {"name": "aggr",
+                                       "rollover_alias": "keep"}},
+            "aliases": {"keep": {"is_write_index": True}}})
+        import time as _t
+        acts = c.lifecycle_step(now=_t.time() + 7200)["actions"]
+        # aged past delete min_age but still the write index -> kept
+        assert c.indices.exists("keep-000001")
+        assert not any(a["action"] == "delete" for a in acts)
+
+    def test_rate_zero_blocks(self):
+        c = RestClient()
+        c.indices.create("z")
+        c.index("z", {"b": 1}, id="1", refresh=True)
+        c.put_workload_group("blocked", {"search_rate": 0,
+                                         "search_burst": 0})
+        with pytest.raises(ApiError) as ei:
+            c.search("z", {"query": {"match_all": {}},
+                           "_workload_group": "blocked"})
+        assert ei.value.status == 429
