@@ -39,7 +39,18 @@ class LifecycleService:
         self.history: List[dict] = []
 
     def put_policy(self, name: str, body: dict) -> None:
-        self.policies[name] = body.get("policy", body)
+        """Validate up front: a bad policy must be a 400 at PUT time, not a
+        crash inside every subsequent step() tick."""
+        policy = body.get("policy", body)
+        unknown = set(policy.get("rollover") or {}) - {"max_docs", "max_age"}
+        if unknown:
+            raise ValueError(
+                f"unknown rollover condition{'s' if len(unknown) > 1 else ''} "
+                f"{sorted(unknown)}")
+        unknown = set(policy.get("delete") or {}) - {"min_age"}
+        if unknown:
+            raise ValueError(f"unknown delete setting {sorted(unknown)}")
+        self.policies[name] = policy
 
     def get_policy(self, name: str) -> Optional[dict]:
         return self.policies.get(name)
@@ -109,10 +120,17 @@ class LifecycleService:
             alias = self._rollover_alias(meta)
             is_write = self._is_write_index(name, alias)
             if ro and alias and is_write:
-                results = self.check_conditions(name, ro, now)
+                try:
+                    results = self.check_conditions(name, ro, now)
+                except ValueError as e:
+                    # a policy edited behind put_policy's back must not brick
+                    # the whole tick — record and move on
+                    actions.append({"index": name, "action": "error",
+                                    "reason": str(e)})
+                    continue
                 if results and any(results.values()):
                     docs = self.node.indices[name].num_docs
-                    new_name = self.rollover(alias, name)
+                    new_name = self._do_rollover(alias, name)
                     actions.append({"index": name, "action": "rollover",
                                     "new_index": new_name,
                                     "docs": docs, "age_seconds": age})
@@ -135,10 +153,13 @@ class LifecycleService:
         return new_name
 
     def _do_rollover(self, alias: str, old_index: str) -> str:
+        import copy
         node = self.node
         new_name = next_rollover_name(old_index)
         old_meta = node.metadata.indices[old_index]
-        node.create_index(new_name, {"settings": dict(old_meta.settings),
+        # deep copy: create_index installs the inner "index" dict by
+        # reference, and the series must not share mutable settings
+        node.create_index(new_name, {"settings": copy.deepcopy(old_meta.settings),
                                      "mappings":
                                          node.indices[old_index].mappings.to_dict()})
         am = node.metadata.aliases.get(alias)

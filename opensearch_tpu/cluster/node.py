@@ -274,6 +274,8 @@ class Node:
         self.data_path = data_path
         self.indices: Dict[str, IndexService] = {}
         self.ingest = IngestService()
+        from ..search.pipeline import SearchPipelineService
+        self.search_pipelines = SearchPipelineService()
         self.breakers = BreakerService()
         self.request_cache = RequestCache()
         self.tasks = TaskRegistry()
@@ -477,7 +479,8 @@ class Node:
 
     # ---------------- search entry ----------------
 
-    def search(self, expression: str, body: dict) -> dict:
+    def search(self, expression: str, body: dict, phase_hook=None,
+               phase_ctx: Optional[dict] = None) -> dict:
         names = self.metadata.resolve(expression)
         searchers = []
         gens = []
@@ -485,11 +488,14 @@ class Node:
             svc = self.indices[name]
             searchers.extend(svc.search_copies())
             gens.append(svc.generation)
-        # request cache (deterministic bodies only)
+        # request cache (deterministic bodies only; a phase hook makes the
+        # response depend on pipeline state, so it bypasses the cache)
         import json as _json
         try:
             cache_key = (tuple(names), _json.dumps(body, sort_keys=True), tuple(gens))
         except TypeError:
+            cache_key = None
+        if phase_hook is not None:
             cache_key = None
         if cache_key is not None:
             cached = self.request_cache.get(cache_key)
@@ -500,13 +506,16 @@ class Node:
         t0 = time.monotonic()
         try:
             resp = None
-            if self.mesh_service is not None and len(names) == 1:
+            if (self.mesh_service is not None and len(names) == 1
+                    and phase_hook is None):
                 resp = self.mesh_service.try_search(names[0],
                                                     self.indices[names[0]],
                                                     body)
             if resp is None:
                 resp = search_shards(searchers, body,
-                                     index_name=",".join(names), task=task)
+                                     index_name=",".join(names), task=task,
+                                     phase_hook=phase_hook,
+                                     phase_ctx=phase_ctx)
         finally:
             self.tasks.unregister(task)
         took = time.monotonic() - t0
@@ -543,6 +552,7 @@ class Node:
             "request_cache": self.request_cache.stats(),
             "tasks": self.tasks.stats(),
             "thread_pool": self.thread_pools.stats(),
+            "search_pipelines": self.search_pipelines.stats(),
             "wlm": self.wlm.stats(),
             "uptime_in_millis": int((time.time() - self.start_time) * 1000),
         }

@@ -20,6 +20,7 @@ from ..ingest.pipeline import DropDocument
 from ..search.executor import ShardSearcher, explain_doc, search_shards
 from ..search import compiler as C
 from ..search import query_dsl as dsl
+from ..search.pipeline import SearchPipelineException
 from ..utils.breaker import CircuitBreakingException
 from ..utils.tasks import TaskCancelledException
 from ..utils.wlm import PressureRejectedException
@@ -285,10 +286,27 @@ class RestClient:
         if body.get("query") is not None:
             body["query"] = self._resolve_percolate_refs(body["query"])
         pit = body.pop("pit", None)
+        # search pipeline: request param / inline body > index default
+        sp_param = body.pop("search_pipeline", None)
+        phase_ctx: dict = {}
+        phase_hook = None
+        pipeline = None
+        try:
+            pipeline = self.node.search_pipelines.resolve(
+                sp_param, self._default_search_pipeline(index))
+            if pipeline is not None:
+                body = pipeline.transform_request(body, phase_ctx)
+                phase_hook = pipeline.phase_hook()
+        except SearchPipelineException as e:
+            raise ApiError(400, "search_pipeline_exception", str(e))
         try:
             if pit is not None:
-                return self._search_pit(pit, body)
-            resp = self.node.search(index, body)
+                resp = self._search_pit(pit, body, phase_hook=phase_hook,
+                                        phase_ctx=phase_ctx)
+                return self._apply_response_pipeline(pipeline, resp,
+                                                     phase_ctx, body)
+            resp = self.node.search(index, body, phase_hook=phase_hook,
+                                    phase_ctx=phase_ctx)
         except dsl.QueryParseError as e:
             # malformed DSL is a client error, not an engine crash
             raise ApiError(400, "parsing_exception", str(e))
@@ -296,6 +314,7 @@ class RestClient:
             raise ApiError(429, "circuit_breaking_exception", str(e))
         except TaskCancelledException as e:
             raise ApiError(400, "task_cancelled_exception", str(e))
+        resp = self._apply_response_pipeline(pipeline, resp, phase_ctx, body)
         if scroll:
             sid = uuid.uuid4().hex
             names = self.node.metadata.resolve(index)
@@ -309,6 +328,52 @@ class RestClient:
                                   "expires": time.time() + ka}
             resp["_scroll_id"] = sid
         return resp
+
+    def _default_search_pipeline(self, index: str) -> Optional[str]:
+        """`index.search.default_pipeline` — applied only when the search
+        targets a single concrete index (reference SearchPipelineService)."""
+        try:
+            names = self.node.metadata.resolve(index)
+        except IndexNotFoundError:
+            return None
+        if len(names) != 1:
+            return None
+        s = self.node.indices[names[0]].meta.settings.get("index", {})
+        return (s.get("search", {}).get("default_pipeline")
+                or s.get("search.default_pipeline"))
+
+    def _apply_response_pipeline(self, pipeline, resp: dict, phase_ctx: dict,
+                                 body: dict) -> dict:
+        if pipeline is None or not pipeline.response_procs:
+            return resp
+        import copy as _copy
+        resp = _copy.deepcopy(resp)  # never mutate a request-cache entry
+        try:
+            return pipeline.transform_response(resp, phase_ctx, body)
+        except SearchPipelineException as e:
+            raise ApiError(400, "search_pipeline_exception", str(e))
+
+    # ---------------- search pipeline CRUD (reference _search/pipeline) ----
+
+    def put_search_pipeline(self, id: str, body: dict) -> dict:
+        try:
+            self.node.search_pipelines.put(id, body)
+        except SearchPipelineException as e:
+            raise ApiError(400, "search_pipeline_exception", str(e))
+        return {"acknowledged": True}
+
+    def get_search_pipeline(self, id: Optional[str] = None) -> dict:
+        try:
+            return self.node.search_pipelines.get(id)
+        except SearchPipelineException as e:
+            raise ApiError(404, "resource_not_found_exception", str(e))
+
+    def delete_search_pipeline(self, id: str) -> dict:
+        try:
+            self.node.search_pipelines.delete(id)
+        except SearchPipelineException as e:
+            raise ApiError(404, "resource_not_found_exception", str(e))
+        return {"acknowledged": True}
 
     def _resolve_percolate_refs(self, node):
         """Inline `{"percolate": {"index": ..., "id": ...}}` doc references by
@@ -407,7 +472,8 @@ class RestClient:
         deleted = [p for p in ids if self._pits.pop(p, None) is not None]
         return {"pits": [{"pit_id": p, "successful": True} for p in deleted]}
 
-    def _search_pit(self, pit: dict, body: dict) -> dict:
+    def _search_pit(self, pit: dict, body: dict, phase_hook=None,
+                    phase_ctx: Optional[dict] = None) -> dict:
         pit_id = pit["id"]
         self._expire_contexts()
         pctx = self._pits.get(pit_id)
@@ -420,7 +486,8 @@ class RestClient:
         pctx["keep_alive"] = ka
         pctx["expires"] = time.time() + ka
         searchers = self._snapshot_searchers(pctx["snapshot"])
-        resp = _search_snapshot(searchers, body, pctx["index"])
+        resp = _search_snapshot(searchers, body, pctx["index"],
+                                phase_hook=phase_hook, phase_ctx=phase_ctx)
         resp["pit_id"] = pit_id
         return resp
 
@@ -432,8 +499,13 @@ class RestClient:
             search_body = body[i]; i += 1
             pairs.append((header.get("index", index or "_all"), search_body))
         # batched TPU path: one index expression, all bodies fast-path
-        # eligible -> grouped Pallas kernel launches (grid over queries)
-        if pairs and len({idx for idx, _ in pairs}) == 1:
+        # eligible -> grouped Pallas kernel launches (grid over queries);
+        # a search pipeline (explicit or index default) forces the
+        # sequential loop so each body gets its processors applied
+        if (pairs and len({idx for idx, _ in pairs}) == 1
+                and not any("search_pipeline" in b or "_workload_group" in b
+                            for _, b in pairs)
+                and not self._default_search_pipeline(pairs[0][0])):
             try:
                 resps = self.node.msearch(pairs[0][0],
                                           [b for _, b in pairs])
@@ -474,7 +546,10 @@ class RestClient:
     # ---------------- lifecycle + workload management ----------------
 
     def put_lifecycle_policy(self, name: str, body: dict) -> dict:
-        self.node.lifecycle.put_policy(name, body or {})
+        try:
+            self.node.lifecycle.put_policy(name, body or {})
+        except ValueError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e))
         return {"acknowledged": True}
 
     def get_lifecycle_policy(self, name: str) -> dict:
@@ -485,8 +560,12 @@ class RestClient:
         return {name: {"policy": p}}
 
     def lifecycle_explain(self, index: str) -> dict:
-        return self.node.lifecycle.explain(
-            self.node.metadata.write_index(index))
+        from ..cluster.state import ClusterStateError
+        try:
+            return self.node.lifecycle.explain(
+                self.node.metadata.write_index(index))
+        except ClusterStateError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e))
 
     def lifecycle_step(self, now: Optional[float] = None) -> dict:
         """One deterministic ISM tick (the reference runs this on a
@@ -500,7 +579,11 @@ class RestClient:
         if alias not in self.node.metadata.aliases:
             raise ApiError(400, "illegal_argument_exception",
                            f"rollover target [{alias}] is not an alias")
-        old = self.node.metadata.write_index(alias)
+        from ..cluster.state import ClusterStateError
+        try:
+            old = self.node.metadata.write_index(alias)
+        except ClusterStateError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e))
         conds = body.get("conditions", {})
         try:
             results = self.node.lifecycle.check_conditions(old, conds)
@@ -737,7 +820,8 @@ class RestClient:
                 "failures": []}
 
 
-def _search_snapshot(searchers: List[ShardSearcher], body: dict, index: str) -> dict:
+def _search_snapshot(searchers: List[ShardSearcher], body: dict, index: str,
+                     phase_hook=None, phase_ctx: Optional[dict] = None) -> dict:
     """Search against snapshotted segment lists (scroll/PIT)."""
     body = dict(body)
     body["_index_name"] = index
@@ -746,6 +830,8 @@ def _search_snapshot(searchers: List[ShardSearcher], body: dict, index: str) -> 
     results = [s.query_phase(body, segments=s._snapshot_segments, shard_ord=i,
                              stats_ctx=stats[i])
                for i, s in enumerate(searchers)]
+    if phase_hook is not None:
+        phase_hook(results, body, phase_ctx if phase_ctx is not None else {})
     reduced = reduce_shard_results(results, body)
     by_shard: Dict[int, List] = {}
     for c in reduced["selected"]:

@@ -633,14 +633,22 @@ def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
 
 
 def search_shards(searchers: List[ShardSearcher], body: dict,
-                  index_name: str = "", task=None) -> dict:
-    """Full query-then-fetch across shards -> OpenSearch-shaped response."""
+                  index_name: str = "", task=None, phase_hook=None,
+                  phase_ctx: Optional[dict] = None) -> dict:
+    """Full query-then-fetch across shards -> OpenSearch-shaped response.
+
+    `phase_hook(shard_results, body, ctx)` is the search-pipeline
+    phase-results slot (reference SearchPhaseResultsProcessor.java): it runs
+    after the per-shard device query phase, before the coordinator reduce.
+    """
     t0 = time.monotonic()
     body = dict(body)
     body["_index_name"] = index_name
     stats = _global_stats_contexts(searchers)
     results = [s.query_phase(body, shard_ord=i, stats_ctx=stats[i], task=task)
                for i, s in enumerate(searchers)]
+    if phase_hook is not None:
+        phase_hook(results, body, phase_ctx if phase_ctx is not None else {})
     agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
     # pipelines whose buckets_path targets a refinement-resolved sub-agg are
     # deferred until after _refine_complex_subs; the rest run in finalize so

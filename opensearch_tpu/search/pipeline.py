@@ -1,0 +1,376 @@
+"""Search pipelines: request / response / phase-results processors wrapped
+around the search phases.
+
+Reference analog: `server/src/main/java/org/opensearch/search/pipeline/
+SearchPipelineService.java` (resolution order: request param > index
+`index.search.default_pipeline` > none; `_none` disables), with the common
+processor set from `modules/search-pipeline-common/` —
+FilterQueryRequestProcessor.java, OversampleRequestProcessor.java,
+ScriptRequestProcessor.java, RenameFieldResponseProcessor.java,
+TruncateHitsResponseProcessor.java, SortResponseProcessor.java,
+SplitResponseProcessor.java, CollapseResponseProcessor.java — and the
+phase-results normalization hook (SearchPhaseResultsProcessor.java).
+
+Design notes (TPU framing): request processors run on the host BEFORE plan
+compilation, so a filter_query merge participates in plan canonicalization
+and (segment, plan) mask caching like any user filter. The phase-results
+hook runs between the device query phase and the coordinator reduce — it
+sees per-shard candidate lists (scores already on host), so normalization
+is a pure host pass and never forces a device sync of its own.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class SearchPipelineException(Exception):
+    pass
+
+
+# dotted-path access on hit _source — one implementation, shared with ingest
+from ..ingest.pipeline import _del_path, _get_path, _set_path  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# request processors: (body, ctx) -> body
+# ---------------------------------------------------------------------------
+
+def _req_filter_query(cfg: dict):
+    fq = cfg.get("query")
+    if fq is None:
+        raise SearchPipelineException("filter_query requires [query]")
+
+    def proc(body: dict, ctx: dict) -> dict:
+        orig = body.get("query")
+        clause: dict = {"bool": {"filter": [copy.deepcopy(fq)]}}
+        if orig is not None:
+            clause["bool"]["must"] = [orig]
+        body["query"] = clause
+        return body
+    return proc
+
+
+def _req_oversample(cfg: dict):
+    factor = float(cfg.get("sample_factor", 0))
+    if factor < 1.0:
+        raise SearchPipelineException("sample_factor must be >= 1.0")
+    prefix = cfg.get("context_prefix")
+    key = (prefix + "." if prefix else "") + "original_size"
+
+    def proc(body: dict, ctx: dict) -> dict:
+        size = int(body.get("size", 10))
+        ctx[key] = size
+        ctx["original_size"] = size
+        body["size"] = int(math.ceil(size * factor))
+        return body
+    return proc
+
+
+def _req_script(cfg: dict):
+    src = cfg.get("source") or (cfg.get("script") or {}).get("source")
+    if not src:
+        raise SearchPipelineException("script processor requires [source]")
+    params = cfg.get("params") or (cfg.get("script") or {}).get("params") or {}
+
+    def proc(body: dict, ctx: dict) -> dict:
+        from ..script.painless_lite import execute
+        # `ctx` inside the script IS the mutable request map, like the
+        # reference's SearchRequestMap (size/from/query/... all assignable)
+        execute(src, {"ctx": body, "params": dict(params)})
+        return body
+    return proc
+
+
+# ---------------------------------------------------------------------------
+# response processors: (resp, ctx, body) -> resp
+# ---------------------------------------------------------------------------
+
+def _hits(resp: dict) -> List[dict]:
+    return resp.get("hits", {}).get("hits", [])
+
+
+def _res_rename_field(cfg: dict):
+    field, target = cfg.get("field"), cfg.get("target_field")
+    if not field or not target:
+        raise SearchPipelineException(
+            "rename_field requires [field] and [target_field]")
+    ignore_missing = bool(cfg.get("ignore_missing", False))
+
+    def proc(resp: dict, ctx: dict, body: dict) -> dict:
+        for h in _hits(resp):
+            src = h.get("_source")
+            moved = False
+            if isinstance(src, dict):
+                v = _get_path(src, field)
+                if v is not None:
+                    _set_path(src, target, v)
+                    _del_path(src, field)
+                    moved = True
+            flds = h.get("fields")
+            if isinstance(flds, dict) and field in flds:
+                flds[target] = flds.pop(field)
+                moved = True
+            if not moved and not ignore_missing:
+                raise SearchPipelineException(
+                    f"Document with id {h.get('_id')} is missing field [{field}]")
+        return resp
+    return proc
+
+
+def _res_truncate_hits(cfg: dict):
+    cfg_size = cfg.get("target_size")
+    prefix = cfg.get("context_prefix")
+    key = (prefix + "." if prefix else "") + "original_size"
+
+    def proc(resp: dict, ctx: dict, body: dict) -> dict:
+        size = cfg_size if cfg_size is not None else ctx.get(key)
+        if size is None:
+            raise SearchPipelineException(
+                "truncate_hits: no target_size and no oversample context")
+        hits = resp.get("hits", {})
+        hits["hits"] = hits.get("hits", [])[: int(size)]
+        return resp
+    return proc
+
+
+def _res_sort(cfg: dict):
+    field = cfg.get("field")
+    if not field:
+        raise SearchPipelineException("sort processor requires [field]")
+    order = cfg.get("sort_order", "asc")
+    target = cfg.get("target_field", field)
+
+    def proc(resp: dict, ctx: dict, body: dict) -> dict:
+        for h in _hits(resp):
+            src = h.get("_source")
+            if not isinstance(src, dict):
+                continue
+            v = _get_path(src, field)
+            if v is None:
+                continue
+            if not isinstance(v, list):
+                raise SearchPipelineException(
+                    f"field [{field}] is not an array, cannot sort")
+            _set_path(src, target, sorted(v, reverse=(order == "desc")))
+        return resp
+    return proc
+
+
+def _res_split(cfg: dict):
+    field = cfg.get("field")
+    sep = cfg.get("separator")
+    if not field or sep is None:
+        raise SearchPipelineException("split requires [field] and [separator]")
+    target = cfg.get("target_field", field)
+    preserve = bool(cfg.get("preserve_trailing", False))
+
+    def proc(resp: dict, ctx: dict, body: dict) -> dict:
+        for h in _hits(resp):
+            src = h.get("_source")
+            if not isinstance(src, dict):
+                continue
+            v = _get_path(src, field)
+            if v is None:
+                continue
+            if not isinstance(v, str):
+                raise SearchPipelineException(
+                    f"field [{field}] is not a string, cannot split")
+            parts = v.split(sep)
+            if not preserve:
+                while parts and parts[-1] == "":
+                    parts.pop()
+            _set_path(src, target, parts)
+        return resp
+    return proc
+
+
+def _res_collapse(cfg: dict):
+    field = cfg.get("field")
+    if not field:
+        raise SearchPipelineException("collapse processor requires [field]")
+
+    def proc(resp: dict, ctx: dict, body: dict) -> dict:
+        seen = set()
+        kept = []
+        for h in _hits(resp):
+            v = _get_path(h.get("_source") or {}, field)
+            if v is None and isinstance(h.get("fields"), dict):
+                fv = h["fields"].get(field)
+                v = fv[0] if isinstance(fv, list) and fv else fv
+            k = ("null",) if v is None else ("v", str(v))
+            if k in seen:
+                continue
+            seen.add(k)
+            kept.append(h)
+        resp["hits"]["hits"] = kept
+        return resp
+    return proc
+
+
+# ---------------------------------------------------------------------------
+# phase-results processors: (shard_results, body, ctx) -> None  (mutate)
+# ---------------------------------------------------------------------------
+
+def _phase_normalization(cfg: dict):
+    technique = (cfg.get("normalization") or {}).get("technique", "min_max")
+    if technique not in ("min_max", "l2"):
+        raise SearchPipelineException(
+            f"unknown normalization technique [{technique}]")
+
+    def proc(shard_results: list, body: dict, ctx: dict) -> None:
+        if body.get("sort"):
+            return  # score normalization only applies to score-ordered results
+        from .executor import _host_sort_values
+        cands = [c for r in shard_results for c in r.candidates
+                 if c.score is not None]
+        if not cands:
+            return
+        scores = [c.score for c in cands]
+        if technique == "min_max":
+            lo, hi = min(scores), max(scores)
+            rng = (hi - lo) or 1.0
+            def norm(s): return (s - lo) / rng
+        else:
+            nrm = math.sqrt(sum(s * s for s in scores)) or 1.0
+            def norm(s): return s / nrm
+        for r in shard_results:
+            for c in r.candidates:
+                if c.score is None:
+                    continue
+                c.score = float(norm(c.score))
+                c.sort_values, c.raw_sort_values = _host_sort_values(
+                    [], r.segments[c.seg_ord], c.local_doc, c.score)
+            if r.candidates:
+                r.max_score = max((c.score or 0.0) for c in r.candidates)
+    return proc
+
+
+_REQUEST = {"filter_query": _req_filter_query, "oversample": _req_oversample,
+            "script": _req_script}
+_RESPONSE = {"rename_field": _res_rename_field,
+             "truncate_hits": _res_truncate_hits, "sort": _res_sort,
+             "split": _res_split, "collapse": _res_collapse}
+_PHASE = {"normalization": _phase_normalization}
+
+
+class _Proc:
+    __slots__ = ("kind", "tag", "ignore_failure", "fn", "stats")
+
+    def __init__(self, kind: str, cfg: dict, fn):
+        self.kind = kind
+        self.tag = cfg.get("tag")
+        self.ignore_failure = bool(cfg.get("ignore_failure", False))
+        self.fn = fn
+        self.stats = {"count": 0, "time_ms": 0.0, "failed": 0}
+
+    def run(self, *args):
+        t0 = time.monotonic()
+        self.stats["count"] += 1
+        try:
+            return self.fn(*args)
+        except SearchPipelineException:
+            self.stats["failed"] += 1
+            if self.ignore_failure:
+                return None
+            raise
+        finally:
+            self.stats["time_ms"] += (time.monotonic() - t0) * 1000.0
+
+
+class SearchPipeline:
+    def __init__(self, pid: str, config: dict):
+        self.id = pid
+        self.description = config.get("description", "")
+        self.version = config.get("version")
+        self.request_procs: List[_Proc] = []
+        self.response_procs: List[_Proc] = []
+        self.phase_procs: List[_Proc] = []
+        for block, registry, out in (
+                ("request_processors", _REQUEST, self.request_procs),
+                ("response_processors", _RESPONSE, self.response_procs),
+                ("phase_results_processors", _PHASE, self.phase_procs)):
+            for spec in config.get(block, []):
+                if not isinstance(spec, dict) or len(spec) != 1:
+                    raise SearchPipelineException(
+                        f"each entry in [{block}] must be a single-key "
+                        f"{{type: config}} object")
+                ((kind, cfg),) = spec.items()
+                if kind not in registry:
+                    raise SearchPipelineException(
+                        f"unknown processor [{kind}] in [{block}]")
+                out.append(_Proc(kind, cfg or {}, registry[kind](cfg or {})))
+
+    def transform_request(self, body: dict, ctx: dict) -> dict:
+        for p in self.request_procs:
+            out = p.run(body, ctx)
+            body = out if out is not None else body
+        return body
+
+    def transform_response(self, resp: dict, ctx: dict, body: dict) -> dict:
+        for p in self.response_procs:
+            out = p.run(resp, ctx, body)
+            resp = out if out is not None else resp
+        return resp
+
+    def phase_hook(self) -> Optional[Callable]:
+        if not self.phase_procs:
+            return None
+
+        def hook(shard_results: list, body: dict, ctx: dict) -> None:
+            for p in self.phase_procs:
+                p.run(shard_results, body, ctx)
+        return hook
+
+    def stats(self) -> dict:
+        def block(procs):
+            return [{"type": p.kind, **({"tag": p.tag} if p.tag else {}),
+                     "stats": dict(p.stats)} for p in procs]
+        return {"request_processors": block(self.request_procs),
+                "response_processors": block(self.response_procs),
+                "phase_results_processors": block(self.phase_procs)}
+
+
+class SearchPipelineService:
+    """Registry + per-request resolution (SearchPipelineService.java)."""
+
+    def __init__(self):
+        self.pipelines: Dict[str, SearchPipeline] = {}
+
+    def put(self, pid: str, config: dict) -> None:
+        self.pipelines[pid] = SearchPipeline(pid, config)
+
+    def delete(self, pid: str) -> None:
+        if pid not in self.pipelines:
+            raise SearchPipelineException(f"pipeline [{pid}] not found")
+        del self.pipelines[pid]
+
+    def get(self, pid: Optional[str] = None) -> dict:
+        if pid is not None:
+            p = self.pipelines.get(pid)
+            if p is None:
+                raise SearchPipelineException(f"pipeline [{pid}] not found")
+            return {pid: {"description": p.description}}
+        return {k: {"description": p.description}
+                for k, p in self.pipelines.items()}
+
+    def resolve(self, param: Optional[Any],
+                default_pipeline: Optional[str]) -> Optional[SearchPipeline]:
+        """param wins over the index default; "_none" disables; an inline
+        dict builds an ad-hoc (unregistered) pipeline."""
+        if isinstance(param, dict):
+            return SearchPipeline("_ad_hoc", param)
+        pid = param if param is not None else default_pipeline
+        if pid is None or pid == "_none":
+            return None
+        p = self.pipelines.get(pid)
+        if p is None:
+            raise SearchPipelineException(f"pipeline [{pid}] not found")
+        return p
+
+    def stats(self) -> dict:
+        return {"pipelines": {k: p.stats()
+                              for k, p in self.pipelines.items()}}

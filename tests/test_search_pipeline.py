@@ -1,0 +1,231 @@
+"""Search pipelines (reference `search/pipeline/SearchPipelineService.java` +
+`modules/search-pipeline-common/` processors): CRUD, request/response/
+phase-results processors, index default resolution, stats."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("p", body={"mappings": {"properties": {
+        "title": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "grp": {"type": "keyword"},
+        "n": {"type": "integer"}}}})
+    docs = [
+        {"title": "red fox jumps", "tags": ["b", "a", "c"], "grp": "g1",
+         "n": 1, "csv": "x,y,z,,"},
+        {"title": "red dog sleeps", "tags": ["z", "y"], "grp": "g1",
+         "n": 2, "csv": "a,b"},
+        {"title": "blue fox runs", "tags": ["m"], "grp": "g2",
+         "n": 3, "csv": "only"},
+        {"title": "red cat sits", "tags": ["k", "j"], "grp": "g2",
+         "n": 4, "csv": "p,q"},
+    ]
+    for i, d in enumerate(docs):
+        c.index("p", d, id=str(i))
+    c.indices.refresh("p")
+    return c
+
+
+class TestCrud:
+    def test_put_get_delete(self, client):
+        r = client.put_search_pipeline("sp1", {
+            "description": "demo",
+            "request_processors": [{"filter_query": {
+                "query": {"term": {"grp": "g1"}}}}]})
+        assert r["acknowledged"]
+        assert "sp1" in client.get_search_pipeline()
+        assert client.get_search_pipeline("sp1")["sp1"]["description"] == "demo"
+        client.delete_search_pipeline("sp1")
+        with pytest.raises(ApiError) as ei:
+            client.get_search_pipeline("sp1")
+        assert ei.value.status == 404
+
+    def test_unknown_processor_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.put_search_pipeline("bad", {
+                "request_processors": [{"nope": {}}]})
+        assert ei.value.status == 400
+
+    def test_missing_pipeline_param_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("p", {"query": {"match_all": {}}},
+                          search_pipeline="ghost")
+        assert ei.value.status == 400
+
+
+class TestRequestProcessors:
+    def test_filter_query(self, client):
+        client.put_search_pipeline("only_g1", {
+            "request_processors": [{"filter_query": {
+                "query": {"term": {"grp": "g1"}}}}]})
+        r = client.search("p", {"query": {"match": {"title": "red"}}},
+                          search_pipeline="only_g1")
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert ids == {"0", "1"}
+        # scores still BM25 (must clause kept), filter doesn't score
+        assert r["hits"]["max_score"] > 0
+
+    def test_filter_query_without_query(self, client):
+        client.put_search_pipeline("fq", {
+            "request_processors": [{"filter_query": {
+                "query": {"term": {"grp": "g2"}}}}]})
+        r = client.search("p", {}, search_pipeline="fq")
+        assert r["hits"]["total"]["value"] == 2
+
+    def test_script_processor_mutates_request(self, client):
+        client.put_search_pipeline("cap", {
+            "request_processors": [{"script": {
+                "source": "ctx['size'] = 1;"}}]})
+        r = client.search("p", {"query": {"match_all": {}}, "size": 10},
+                          search_pipeline="cap")
+        assert len(r["hits"]["hits"]) == 1
+        assert r["hits"]["total"]["value"] == 4
+
+    def test_oversample_truncate_roundtrip(self, client):
+        client.put_search_pipeline("ov", {
+            "request_processors": [{"oversample": {"sample_factor": 3}}],
+            "response_processors": [{"truncate_hits": {}}]})
+        r = client.search("p", {"query": {"match_all": {}}, "size": 2},
+                          search_pipeline="ov")
+        # oversampled internally, truncated back to the requested size
+        assert len(r["hits"]["hits"]) == 2
+
+
+class TestResponseProcessors:
+    def test_rename_field(self, client):
+        client.put_search_pipeline("rn", {
+            "response_processors": [{"rename_field": {
+                "field": "grp", "target_field": "group"}}]})
+        r = client.search("p", {"query": {"match_all": {}}},
+                          search_pipeline="rn")
+        for h in r["hits"]["hits"]:
+            assert "grp" not in h["_source"]
+            assert h["_source"]["group"] in ("g1", "g2")
+
+    def test_rename_missing_raises_unless_ignored(self, client):
+        client.put_search_pipeline("rn2", {
+            "response_processors": [{"rename_field": {
+                "field": "ghost", "target_field": "g2"}}]})
+        with pytest.raises(ApiError):
+            client.search("p", {"query": {"match_all": {}}},
+                          search_pipeline="rn2")
+        client.put_search_pipeline("rn3", {
+            "response_processors": [{"rename_field": {
+                "field": "ghost", "target_field": "g2",
+                "ignore_missing": True}}]})
+        r = client.search("p", {"query": {"match_all": {}}},
+                          search_pipeline="rn3")
+        assert r["hits"]["total"]["value"] == 4
+
+    def test_sort_and_split(self, client):
+        client.put_search_pipeline("ss", {
+            "response_processors": [
+                {"sort": {"field": "tags", "sort_order": "asc"}},
+                {"split": {"field": "csv", "separator": ","}}]})
+        r = client.search("p", {"query": {"ids": {"values": ["0"]}}},
+                          search_pipeline="ss")
+        src = r["hits"]["hits"][0]["_source"]
+        assert src["tags"] == ["a", "b", "c"]
+        assert src["csv"] == ["x", "y", "z"]   # trailing empties dropped
+
+    def test_split_preserve_trailing(self, client):
+        client.put_search_pipeline("sp", {
+            "response_processors": [{"split": {
+                "field": "csv", "separator": ",",
+                "preserve_trailing": True}}]})
+        r = client.search("p", {"query": {"ids": {"values": ["0"]}}},
+                          search_pipeline="sp")
+        assert r["hits"]["hits"][0]["_source"]["csv"] == ["x", "y", "z", "", ""]
+
+    def test_collapse_processor(self, client):
+        client.put_search_pipeline("cl", {
+            "response_processors": [{"collapse": {"field": "grp"}}]})
+        r = client.search("p", {"query": {"match_all": {}},
+                                "sort": [{"n": "asc"}]},
+                          search_pipeline="cl")
+        assert [h["_source"]["grp"] for h in r["hits"]["hits"]] == ["g1", "g2"]
+
+    def test_response_procs_do_not_corrupt_request_cache(self, client):
+        client.put_search_pipeline("rn", {
+            "response_processors": [{"rename_field": {
+                "field": "grp", "target_field": "group",
+                "ignore_missing": True}}]})
+        body = {"query": {"match_all": {}}}
+        client.search("p", body)                      # warm the cache
+        client.search("p", body, search_pipeline="rn")
+        r = client.search("p", body)                  # cached entry intact
+        assert all("grp" in h["_source"] for h in r["hits"]["hits"])
+
+
+class TestPhaseResults:
+    def test_min_max_normalization(self, client):
+        client.put_search_pipeline("nm", {
+            "phase_results_processors": [{"normalization": {
+                "normalization": {"technique": "min_max"}}}]})
+        r = client.search("p", {"query": {"match": {"title": "red fox"}}},
+                          search_pipeline="nm")
+        scores = [h["_score"] for h in r["hits"]["hits"]]
+        assert scores == sorted(scores, reverse=True)
+        assert max(scores) == pytest.approx(1.0)
+        assert min(scores) == pytest.approx(0.0)
+
+    def test_l2_normalization(self, client):
+        client.put_search_pipeline("l2", {
+            "phase_results_processors": [{"normalization": {
+                "normalization": {"technique": "l2"}}}]})
+        r = client.search("p", {"query": {"match": {"title": "red"}}},
+                          search_pipeline="l2")
+        import math
+        norm = math.sqrt(sum(h["_score"] ** 2 for h in r["hits"]["hits"]))
+        assert norm == pytest.approx(1.0, rel=1e-5)
+
+
+class TestResolution:
+    def test_index_default_pipeline(self, client):
+        client.put_search_pipeline("dflt", {
+            "request_processors": [{"filter_query": {
+                "query": {"term": {"grp": "g2"}}}}]})
+        svc = client.node.get_index("p")
+        svc.meta.settings.setdefault("index", {})["search"] = {
+            "default_pipeline": "dflt"}
+        r = client.search("p", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 2
+        # _none disables the default
+        r = client.search("p", {"query": {"match_all": {}}},
+                          search_pipeline="_none")
+        assert r["hits"]["total"]["value"] == 4
+
+    def test_inline_ad_hoc_pipeline(self, client):
+        r = client.search("p", {
+            "query": {"match_all": {}},
+            "search_pipeline": {
+                "request_processors": [{"filter_query": {
+                    "query": {"term": {"grp": "g1"}}}}]}})
+        assert r["hits"]["total"]["value"] == 2
+
+    def test_msearch_applies_pipeline(self, client):
+        client.put_search_pipeline("m1", {
+            "request_processors": [{"filter_query": {
+                "query": {"term": {"grp": "g1"}}}}]})
+        r = client.msearch([
+            {"index": "p"},
+            {"query": {"match_all": {}}, "search_pipeline": "m1"},
+            {"index": "p"},
+            {"query": {"match_all": {}}},
+        ])
+        assert r["responses"][0]["hits"]["total"]["value"] == 2
+        assert r["responses"][1]["hits"]["total"]["value"] == 4
+
+    def test_stats(self, client):
+        client.put_search_pipeline("st", {
+            "request_processors": [{"filter_query": {
+                "query": {"match_all": {}}}}]})
+        client.search("p", {"query": {"match_all": {}}},
+                      search_pipeline="st")
+        st = client.node.stats()["search_pipelines"]["pipelines"]["st"]
+        assert st["request_processors"][0]["stats"]["count"] == 1
