@@ -42,14 +42,33 @@ class LifecycleService:
         """Validate up front: a bad policy must be a 400 at PUT time, not a
         crash inside every subsequent step() tick."""
         policy = body.get("policy", body)
-        unknown = set(policy.get("rollover") or {}) - {"max_docs", "max_age"}
+        ro = policy.get("rollover") or {}
+        unknown = set(ro) - {"max_docs", "max_age"}
         if unknown:
             raise ValueError(
                 f"unknown rollover condition{'s' if len(unknown) > 1 else ''} "
                 f"{sorted(unknown)}")
-        unknown = set(policy.get("delete") or {}) - {"min_age"}
+        dl = policy.get("delete") or {}
+        unknown = set(dl) - {"min_age"}
         if unknown:
             raise ValueError(f"unknown delete setting {sorted(unknown)}")
+        # values must parse too — a bad duration is a 400 here, not a crash
+        # inside every subsequent tick
+        for label, v in (("rollover.max_age", ro.get("max_age")),
+                         ("delete.min_age", dl.get("min_age"))):
+            if v is not None:
+                try:
+                    parse_age_s(v)
+                except ValueError:
+                    raise ValueError(f"cannot parse duration [{v}] "
+                                     f"for [{label}]")
+        if "max_docs" in ro:
+            try:
+                int(ro["max_docs"])
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"cannot parse [rollover.max_docs] value "
+                    f"[{ro['max_docs']}]")
         self.policies[name] = policy
 
     def get_policy(self, name: str) -> Optional[dict]:
@@ -136,11 +155,17 @@ class LifecycleService:
                                     "docs": docs, "age_seconds": age})
                     continue
             delete_cfg = policy.get("delete")
-            if (delete_cfg and not (ro and is_write)
-                    and age >= parse_age_s(delete_cfg.get("min_age", "0ms"))):
-                self.node.delete_index(name)
-                actions.append({"index": name, "action": "delete",
-                                "age_seconds": age})
+            if delete_cfg and not (ro and is_write):
+                try:
+                    min_age = parse_age_s(delete_cfg.get("min_age", "0ms"))
+                except ValueError as e:
+                    actions.append({"index": name, "action": "error",
+                                    "reason": str(e)})
+                    continue
+                if age >= min_age:
+                    self.node.delete_index(name)
+                    actions.append({"index": name, "action": "delete",
+                                    "age_seconds": age})
         self.history.extend(actions)
         return actions
 

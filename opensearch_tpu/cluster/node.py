@@ -480,7 +480,12 @@ class Node:
     # ---------------- search entry ----------------
 
     def search(self, expression: str, body: dict, phase_hook=None,
-               phase_ctx: Optional[dict] = None) -> dict:
+               phase_ctx: Optional[dict] = None,
+               copy_protect: bool = False) -> dict:
+        """`copy_protect`: caller intends to mutate the response (search
+        pipeline response processors) — deep-copy it iff it aliases a
+        request-cache entry, so cached entries stay pristine without taxing
+        uncached paths."""
         names = self.metadata.resolve(expression)
         searchers = []
         gens = []
@@ -500,6 +505,9 @@ class Node:
         if cache_key is not None:
             cached = self.request_cache.get(cache_key)
             if cached is not None:
+                if copy_protect:
+                    import copy as _copy
+                    return _copy.deepcopy(cached)
                 return cached
         task = self.tasks.register("indices:data/read/search",
                                    f"indices[{expression}]")
@@ -527,6 +535,9 @@ class Node:
                 h["_index"] = names[0]
         if cache_key is not None:
             self.request_cache.put(cache_key, resp)
+            if copy_protect:
+                import copy as _copy
+                resp = _copy.deepcopy(resp)
         return resp
 
     def msearch(self, expression: str, bodies: List[dict]) -> Optional[List[dict]]:

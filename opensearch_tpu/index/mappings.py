@@ -48,6 +48,10 @@ class FieldType:
     boost: float = 1.0
     dims: int = 0                       # dense_vector dimension
     vector_similarity: str = "cosine"   # cosine | dot_product | l2_norm
+    # ANN method (reference k-NN plugin `method` / ES `index_options`):
+    # normalized to {"name": "ivf", "nlist": int|None, "nprobe": int|None};
+    # None = exact brute-force scan (the default)
+    vector_method: Optional[dict] = None
     # join field (reference modules/parent-join ParentJoinFieldMapper):
     # {"parent_relation": ["child_relation", ...]}
     relations: Dict[str, List[str]] = dc_field(default_factory=dict)
@@ -236,6 +240,21 @@ class Mappings:
             vector_similarity=cfg.get("similarity",
                                       cfg.get("space_type", "cosine")),
         )
+        if ftype in VECTOR_TYPES:
+            method = cfg.get("method") or cfg.get("index_options")
+            if method:
+                name = method.get("name", method.get("type", "ivf"))
+                if name not in ("ivf", "flat", "exact"):
+                    raise ValueError(
+                        f"unknown ANN method [{name}] for field [{path}] "
+                        f"(supported: ivf, flat)")
+                if name == "ivf":
+                    p = method.get("parameters", method)
+                    ft.vector_method = {
+                        "name": "ivf",
+                        "nlist": (int(p["nlist"]) if p.get("nlist") else None),
+                        "nprobe": (int(p["nprobe"]) if p.get("nprobe")
+                                   else None)}
         if ftype == "join":
             ft.relations = {p: (c if isinstance(c, list) else [c])
                             for p, c in cfg.get("relations", {}).items()}

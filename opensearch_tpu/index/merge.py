@@ -197,7 +197,8 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
                 continue
             values[dmap[m]] = col.values[m]
             present[dmap[m]] = col.present[m]
-        vector_cols[f] = VectorColumn(f, values, present, first.similarity)
+        vector_cols[f] = VectorColumn(f, values, present, first.similarity,
+                                      method=first.method)
 
     # ---- doc lens + stats ----
     doc_lens: Dict[str, np.ndarray] = {}

@@ -168,14 +168,29 @@ class VectorColumn:
     values: np.ndarray        # f32[ndocs, dims]
     present: np.ndarray       # bool[ndocs]
     similarity: str = "cosine"
+    # ANN method from the mapping ({"name": "ivf", "nlist", "nprobe"});
+    # None = exact scan only (see ops/ann.py for the IVF design)
+    method: Optional[dict] = None
     # unit-norm copy for cosine (precomputed at build)
     _normed: Optional[np.ndarray] = None
+    _ivf: Any = None
 
     def normed(self) -> np.ndarray:
         if self._normed is None:
             n = np.linalg.norm(self.values, axis=1, keepdims=True)
             self._normed = (self.values / np.maximum(n, 1e-12)).astype(np.float32)
         return self._normed
+
+    def ivf(self):
+        """Lazily built balanced-IVF index (deterministic: same data ->
+        same index, so persistence only records the method, not arrays)."""
+        if self._ivf is None and self.method and self.method.get("name") == "ivf":
+            from ..ops.ann import build_ivf
+            src = self.normed() if self.similarity == "cosine" else self.values
+            self._ivf = build_ivf(src, self.present,
+                                  nlist=self.method.get("nlist"),
+                                  nprobe=self.method.get("nprobe"))
+        return self._ivf
 
 
 @dataclass
@@ -319,6 +334,20 @@ class Segment:
                     "mat": jnp.asarray(mat),
                     "present": jnp.asarray(_pad_to(col.present, dpad, False)),
                 }
+                ivf = col.ivf()
+                if ivf is not None:
+                    # nlist padded pow2; padding rows are invalid (cvalid
+                    # False -> -inf centroid score, lists slots -1)
+                    lpad = next_pow2(ivf.nlist)
+                    cent = np.zeros((lpad, dpad128), np.float32)
+                    cent[: ivf.nlist, :dims] = ivf.centroids
+                    lists = np.full((lpad, ivf.cap), -1, np.int32)
+                    lists[: ivf.nlist] = ivf.lists
+                    cvalid = np.zeros(lpad, bool)
+                    cvalid[: ivf.nlist] = True
+                    vcols[f]["ivf_centroids"] = jnp.asarray(cent)
+                    vcols[f]["ivf_lists"] = jnp.asarray(lists)
+                    vcols[f]["ivf_cvalid"] = jnp.asarray(cvalid)
             gcols = {}
             for f, col in self.geo_cols.items():
                 gcols[f] = {
@@ -400,7 +429,8 @@ class Segment:
             arrays[f"vec__{f}__values"] = col.values
             arrays[f"vec__{f}__present"] = col.present
             meta["vector"] = meta.get("vector", {})
-            meta["vector"][f] = {"similarity": col.similarity}
+            meta["vector"][f] = {"similarity": col.similarity,
+                                 "method": col.method}
         for f, dl in self.doc_lens.items():
             arrays[f"dl__{f}"] = dl
         meta["nested"] = sorted(self.nested)
@@ -454,7 +484,8 @@ class Segment:
                for f in meta["geo"]}
         vectors = {f: VectorColumn(f, arrays[f"vec__{f}__values"],
                                    arrays[f"vec__{f}__present"],
-                                   m.get("similarity", "cosine"))
+                                   m.get("similarity", "cosine"),
+                                   method=m.get("method"))
                    for f, m in meta.get("vector", {}).items()}
         doc_lens = {k[len("dl__"):]: arrays[k] for k in arrays.files if k.startswith("dl__")}
         nested = {}
@@ -711,7 +742,8 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                 present[doc_i] = True
         vector_cols[fname] = VectorColumn(
             fname, values, present,
-            ft.vector_similarity if ft is not None else "cosine")
+            ft.vector_similarity if ft is not None else "cosine",
+            method=ft.vector_method if ft is not None else None)
 
     # ---- nested blocks: child docs become their own CSR segment ----
     nested_paths = {p for pd in parsed_docs for p in pd.nested}

@@ -1,0 +1,176 @@
+"""Approximate kNN: balanced IVF-flat, the TPU-native ANN layout.
+
+Reference analog: the k-NN plugin's ANN indexes (HNSW/faiss — graph walks
+with data-dependent branching, a shape XLA cannot tile). The TPU-first
+design is inverted-file with BALANCED clusters instead:
+
+- Build: k-means on device (chunked Lloyd iterations — assignment is one
+  [B,D]x[D,nlist] MXU matmul per block, centroid update a scatter-add),
+  then a vectorized host pass that caps every cluster at `cap` rows,
+  spilling overflow to the row's second-best cluster (the ScaNN-style
+  trade: bounded list length buys static shapes and dense DMA).
+- Layout: `lists` is a DENSE i32[nlist, cap] matrix (-1 padded). A probe
+  is `lists[top_nprobe]` — one gather of a [nprobe, cap] tile, no CSR
+  walk, no dynamic shapes anywhere.
+- Search (in search/compiler.py emit "knn"): centroid matvec -> static
+  top-nprobe -> gather candidate rows -> MXU matvec -> scatter scores
+  back into the dense per-doc score space, so ANN kNN composes with every
+  other plan node (bool, filters, aggs) exactly like the exact path.
+
+Setting nprobe = nlist provably recovers the exact search (every row is
+in exactly one list), which the tests assert.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class IvfIndex:
+    centroids: np.ndarray   # f32[nlist, D] (same space as the scored matrix)
+    lists: np.ndarray       # i32[nlist, cap], -1 = empty slot
+    nlist: int
+    cap: int
+    default_nprobe: int
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+_BLOCK = 8192
+
+
+def _kmeans_device(vals_b, pres_b, init, iters: int):
+    """Lloyd iterations over blocked data. vals_b: f32[nb, B, D],
+    pres_b: f32[nb, B], init: f32[nlist, D]. Returns f32[nlist, D]."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    nlist = init.shape[0]
+
+    def one_iter(cents, _):
+        csq = jnp.sum(cents * cents, axis=1)  # [nlist]
+
+        def block(carry, blk):
+            sums, counts = carry
+            v, p = blk
+            # ||v-c||^2 up to a per-row constant: -2 v.c + ||c||^2
+            d2 = csq - 2.0 * jnp.dot(v, cents.T,
+                                     preferred_element_type=jnp.float32)
+            a = jnp.argmin(d2, axis=1)
+            a = jnp.where(p > 0, a, nlist)      # absent rows drop out of bounds
+            sums = sums.at[a].add(v * p[:, None], mode="drop")
+            counts = counts.at[a].add(p, mode="drop")
+            return (sums, counts), None
+
+        (sums, counts), _ = lax.scan(
+            block, (jnp.zeros_like(cents), jnp.zeros(nlist, jnp.float32)),
+            (vals_b, pres_b))
+        newc = sums / jnp.maximum(counts, 1.0)[:, None]
+        return jnp.where((counts > 0)[:, None], newc, cents), None
+
+    cents, _ = lax.scan(one_iter, init, None, length=iters)
+    return cents
+
+
+def _assign_top2_device(vals_b, cents):
+    """Per row: (best cluster, 2nd-best cluster, best distance).
+    vals_b: f32[nb, B, D] -> (i32[nb,B], i32[nb,B], f32[nb,B])."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    csq = jnp.sum(cents * cents, axis=1)
+
+    def block(_, v):
+        d2 = csq - 2.0 * jnp.dot(v, cents.T,
+                                 preferred_element_type=jnp.float32)
+        a1 = jnp.argmin(d2, axis=1)
+        d1 = jnp.min(d2, axis=1)
+        d2b = d2.at[jnp.arange(v.shape[0]), a1].set(jnp.inf)
+        a2 = jnp.argmin(d2b, axis=1)
+        return None, (a1.astype(jnp.int32), a2.astype(jnp.int32), d1)
+
+    _, (a1, a2, d1) = lax.scan(block, None, vals_b)
+    return a1, a2, d1
+
+
+def build_ivf(values: np.ndarray, present: np.ndarray,
+              nlist: Optional[int] = None, nprobe: Optional[int] = None,
+              iters: int = 8, seed: int = 0, slack: float = 1.5
+              ) -> Optional[IvfIndex]:
+    """values: f32[N, D] — pass the SAME matrix the scorer uses (unit-normed
+    for cosine) so centroid geometry matches search geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    values = np.asarray(values, np.float32)
+    present = np.asarray(present, bool)
+    n = values.shape[0]
+    pres_idx = np.nonzero(present[:n])[0]
+    npres = len(pres_idx)
+    if npres == 0:
+        return None
+    nlist = int(min(nlist or max(1, round(npres ** 0.5)), npres))
+    cap = max(1, int(np.ceil(npres * slack / nlist)))
+    default_nprobe = int(min(nprobe or max(1, nlist // 8), nlist))
+
+    rng = np.random.default_rng(seed)
+    init = values[rng.choice(pres_idx, nlist, replace=False)].copy()
+
+    # block + pad for the scan (padded rows carry weight 0)
+    npad = ((n + _BLOCK - 1) // _BLOCK) * _BLOCK
+    vb = np.zeros((npad, values.shape[1]), np.float32)
+    vb[:n] = values
+    pb = np.zeros(npad, np.float32)
+    pb[:n] = present[:n].astype(np.float32)
+    vb = vb.reshape(-1, _BLOCK, values.shape[1])
+    pbb = pb.reshape(-1, _BLOCK)
+
+    kmeans = jax.jit(partial(_kmeans_device, iters=iters))
+    cents = kmeans(jnp.asarray(vb), jnp.asarray(pbb), jnp.asarray(init))
+    a1, a2, d1 = jax.jit(_assign_top2_device)(jnp.asarray(vb), cents)
+    cents = np.asarray(cents)
+    a1 = np.asarray(a1).reshape(-1)[:n]
+    a2 = np.asarray(a2).reshape(-1)[:n]
+    d1 = np.asarray(d1).reshape(-1)[:n]
+
+    # ---- balanced fill (vectorized host pass) ----
+    # round 1: rows claim their primary cluster, closest-first
+    lists = np.full((nlist, cap), -1, np.int32)
+    fill = np.zeros(nlist, np.int64)
+    rows = pres_idx[np.lexsort((d1[pres_idx], a1[pres_idx]))]
+    c = a1[rows]
+    # rank of each row within its cluster run
+    starts = np.searchsorted(c, np.arange(nlist))
+    rank = np.arange(len(rows)) - starts[c]
+    keep = rank < cap
+    kept_rows, kept_c, kept_rank = rows[keep], c[keep], rank[keep]
+    lists[kept_c, kept_rank] = kept_rows
+    fill = np.bincount(kept_c, minlength=nlist).astype(np.int64)
+
+    # round 2: spilled rows go to their 2nd-best cluster if it has room
+    spill = rows[~keep]
+    if len(spill):
+        c2 = a2[spill]
+        order2 = np.argsort(c2, kind="stable")
+        spill, c2 = spill[order2], c2[order2]
+        starts2 = np.searchsorted(c2, np.arange(nlist))
+        rank2 = (np.arange(len(spill)) - starts2[c2]) + fill[c2]
+        keep2 = rank2 < cap
+        lists[c2[keep2], rank2[keep2]] = spill[keep2]
+        fill = np.bincount(c2[keep2], minlength=nlist).astype(np.int64) + fill
+        # round 3 (rare): round-robin into whatever still has room
+        left = spill[~keep2]
+        if len(left):
+            open_slots = np.nonzero(lists.reshape(-1) == -1)[0]
+            take = open_slots[: len(left)]
+            lists.reshape(-1)[take] = left
+    return IvfIndex(centroids=cents, lists=lists, nlist=nlist, cap=cap,
+                    default_nprobe=default_nprobe)

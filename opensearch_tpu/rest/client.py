@@ -305,8 +305,10 @@ class RestClient:
                                         phase_ctx=phase_ctx)
                 return self._apply_response_pipeline(pipeline, resp,
                                                      phase_ctx, body)
-            resp = self.node.search(index, body, phase_hook=phase_hook,
-                                    phase_ctx=phase_ctx)
+            resp = self.node.search(
+                index, body, phase_hook=phase_hook, phase_ctx=phase_ctx,
+                copy_protect=bool(pipeline is not None
+                                  and pipeline.response_procs))
         except dsl.QueryParseError as e:
             # malformed DSL is a client error, not an engine crash
             raise ApiError(400, "parsing_exception", str(e))
@@ -344,10 +346,10 @@ class RestClient:
 
     def _apply_response_pipeline(self, pipeline, resp: dict, phase_ctx: dict,
                                  body: dict) -> dict:
+        """Mutates resp in place; node.search already deep-copied iff the
+        response aliases a request-cache entry (copy_protect)."""
         if pipeline is None or not pipeline.response_procs:
             return resp
-        import copy as _copy
-        resp = _copy.deepcopy(resp)  # never mutate a request-cache entry
         try:
             return pipeline.transform_response(resp, phase_ctx, body)
         except SearchPipelineException as e:

@@ -366,6 +366,10 @@ class LKnn(LNode):
     filter: Optional[LNode] = None
     similarity: str = "cosine"
     boost: float = 1.0
+    # ANN: None = exact scan; int = IVF nprobe request (clamped to the
+    # segment's actual nlist at prepare time)
+    nprobe: Optional[int] = None
+    exact: bool = False
 
 
 @dataclass
@@ -759,7 +763,8 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
             vec = vec / max(float(np.linalg.norm(vec)), 1e-12)
         return LKnn(field=q.field, vector=vec, k=q.k,
                     filter=rewrite(q.filter, ctx, False) if q.filter else None,
-                    similarity=sim, boost=q.boost)
+                    similarity=sim, boost=q.boost,
+                    nprobe=q.nprobe, exact=q.exact)
 
     if isinstance(q, dsl.GeoDistanceQuery):
         return LGeoDist(field=q.field, lat=q.lat, lon=q.lon, radius_m=q.distance_m,
@@ -1644,7 +1649,17 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
             _scalar_f32(params, f"q{nid}_qsq", float(np.dot(node.vector, node.vector)))
         _scalar_f32(params, f"q{nid}_boost", node.boost)
         fspec = prepare(node.filter, seg, ctx, params) if node.filter else None
-        return ("knn", nid, node.field, col_exists, node.similarity, fspec)
+        # ANN route: mapping opted into IVF and the query didn't force
+        # exact -> static nprobe (jit-key) clamped to this segment's nlist.
+        # Building here (host, once, cached on the column) keeps emit pure.
+        ann_nprobe = None
+        if col_exists and not node.exact:
+            ivf = seg.vector_cols[node.field].ivf()
+            if ivf is not None:
+                ann_nprobe = int(min(node.nprobe or ivf.default_nprobe,
+                                     ivf.nlist))
+        return ("knn", nid, node.field, col_exists, node.similarity, fspec,
+                ann_nprobe)
 
     if isinstance(node, LGeoDist):
         _scalar_f32(params, f"q{nid}_lat", node.lat)
@@ -2330,24 +2345,53 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
                               matched.astype(jnp.float32))
 
     if kind == "knn":
-        _, _, field, col_exists, simkind, fspec = spec
+        from jax import lax as _lax
+        _, _, field, col_exists, simkind, fspec, ann_nprobe = spec
         if not col_exists:
             return ops.ScoredMask(zeros, zeros)
         vc = seg_arrays["vector"][field]
-        # one MXU matvec per segment: exact brute-force kNN (the reference
-        # k-NN plugin approximates with HNSW; at HBM bandwidth the dense
-        # scan is the TPU-native answer)
-        raw = jnp.dot(vc["mat"], params[f"q{nid}_vec"],
-                      preferred_element_type=jnp.float32)
-        if simkind == "cosine":
-            score = (1.0 + raw) / 2.0
-        elif simkind in ("dot_product", "innerproduct"):
-            score = jnp.where(raw > 0, raw + 1.0, 1.0 / (1.0 - raw))
-        else:  # l2_norm
-            sq = jnp.sum(vc["mat"] * vc["mat"], axis=1)
-            d2 = jnp.maximum(sq + params[f"q{nid}_qsq"] - 2.0 * raw, 0.0)
-            score = 1.0 / (1.0 + d2)
-        matched = vc["present"] & (live > 0)
+        qvec = params[f"q{nid}_vec"]
+
+        def _sim_score(raw, vecs_sq):
+            if simkind == "cosine":
+                return (1.0 + raw) / 2.0
+            if simkind in ("dot_product", "innerproduct"):
+                return jnp.where(raw > 0, raw + 1.0, 1.0 / (1.0 - raw))
+            d2 = jnp.maximum(vecs_sq + params[f"q{nid}_qsq"] - 2.0 * raw, 0.0)
+            return 1.0 / (1.0 + d2)
+
+        if ann_nprobe is not None and "ivf_centroids" in vc:
+            # balanced-IVF probe (ops/ann.py): centroid matvec -> static
+            # top-nprobe -> dense [nprobe, cap] list gather -> candidate
+            # matvec -> scatter back into doc space. Everything static-shape;
+            # candidate count = nprobe*cap regardless of data.
+            cents, lists = vc["ivf_centroids"], vc["ivf_lists"]
+            cdot = jnp.dot(cents, qvec, preferred_element_type=jnp.float32)
+            if simkind in ("cosine", "dot_product", "innerproduct"):
+                caff = cdot
+            else:  # l2: nearest centroid = max of 2c.q - ||c||^2
+                caff = 2.0 * cdot - jnp.sum(cents * cents, axis=1)
+            caff = jnp.where(vc["ivf_cvalid"], caff, -jnp.inf)
+            _, pids = _lax.top_k(caff, ann_nprobe)
+            cand = lists[pids].reshape(-1)            # i32[nprobe*cap]
+            valid = cand >= 0
+            cidx = jnp.where(valid, cand, ndocs_pad)  # OOB -> dropped scatter
+            vecs = vc["mat"][jnp.where(valid, cand, 0)]
+            raw = jnp.dot(vecs, qvec, preferred_element_type=jnp.float32)
+            s = _sim_score(raw, jnp.sum(vecs * vecs, axis=1))
+            s = jnp.where(valid, s, 0.0)
+            # each doc lives in exactly one list -> max==set, but max is
+            # insensitive to the padding sentinel collisions
+            score = zeros.at[cidx].max(s, mode="drop")
+            cmask = zeros.at[cidx].max(valid.astype(jnp.float32), mode="drop")
+            matched = (cmask > 0) & vc["present"] & (live > 0)
+        else:
+            # one MXU matvec per segment: exact brute-force kNN (the
+            # reference k-NN plugin approximates with HNSW; at HBM bandwidth
+            # the dense scan is the TPU-native answer for exact)
+            raw = jnp.dot(vc["mat"], qvec, preferred_element_type=jnp.float32)
+            score = _sim_score(raw, jnp.sum(vc["mat"] * vc["mat"], axis=1))
+            matched = vc["present"] & (live > 0)
         if fspec is not None:
             matched = matched & emit(fspec, seg_arrays, params).matched
         score = jnp.where(matched, score * params[f"q{nid}_boost"], 0.0)

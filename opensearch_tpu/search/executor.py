@@ -117,13 +117,17 @@ class ShardSearcher:
         knn_spec = body.get("knn")
         if knn_spec is not None:
             # ES-style top-level knn: {"field", "query_vector", "k", "filter"}
+            _np = knn_spec.get("method_parameters", {}).get(
+                "nprobe", knn_spec.get("nprobe"))
             kq = dsl.KnnQuery(field=knn_spec["field"],
                               vector=list(knn_spec.get("query_vector",
                                                        knn_spec.get("vector", []))),
                               k=int(knn_spec.get("k", 10)),
                               filter=(dsl.parse_query(knn_spec["filter"])
                                       if knn_spec.get("filter") else None),
-                              boost=float(knn_spec.get("boost", 1.0)))
+                              boost=float(knn_spec.get("boost", 1.0)),
+                              nprobe=int(_np) if _np is not None else None,
+                              exact=bool(knn_spec.get("exact", False)))
             query = dsl.BoolQuery(should=[query, kq], minimum_should_match="1") \
                 if query is not None else kq
         lroot = C.rewrite(query, ctx, scoring=True)

@@ -272,11 +272,17 @@ class _Proc:
         self.stats["count"] += 1
         try:
             return self.fn(*args)
-        except SearchPipelineException:
+        except Exception as e:
+            # any processor failure (script runtime errors included) honors
+            # ignore_failure and surfaces as a pipeline exception -> 400
+            # (reference SearchPipelineProcessingException wrapping)
             self.stats["failed"] += 1
             if self.ignore_failure:
                 return None
-            raise
+            if isinstance(e, SearchPipelineException):
+                raise
+            raise SearchPipelineException(
+                f"processor [{self.kind}] failed: {e}") from e
         finally:
             self.stats["time_ms"] += (time.monotonic() - t0) * 1000.0
 

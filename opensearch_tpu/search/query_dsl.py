@@ -344,6 +344,10 @@ class KnnQuery(Query):
     vector: List[float] = dc_field(default_factory=list)
     k: int = 10
     filter: Optional[Query] = None
+    # ANN overrides (reference k-NN query `method_parameters`): nprobe
+    # widens/narrows the IVF probe; exact=True forces the brute-force scan
+    nprobe: Optional[int] = None
+    exact: bool = False
 
 
 @dataclass
@@ -779,9 +783,13 @@ def parse_query(dsl: Optional[dict]) -> Query:
         # OpenSearch k-NN plugin form: {"knn": {"fieldname": {"vector": [...],
         # "k": 10, "filter": {...}}}}
         f, spec = _one_entry(body, "knn")
+        mp = spec.get("method_parameters", {})
+        nprobe = mp.get("nprobe", spec.get("nprobe"))
         q = KnnQuery(field=f, vector=list(spec["vector"]),
                      k=int(spec.get("k", 10)),
-                     filter=parse_query(spec["filter"]) if spec.get("filter") else None)
+                     filter=parse_query(spec["filter"]) if spec.get("filter") else None,
+                     nprobe=int(nprobe) if nprobe is not None else None,
+                     exact=bool(spec.get("exact", False)))
         _common(q, spec)
         return q
 

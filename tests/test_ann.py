@@ -1,0 +1,179 @@
+"""Balanced-IVF ANN kNN (ops/ann.py + compiler "knn" probe path).
+
+Reference analog: the k-NN plugin's ANN method param on knn_vector fields
+(HNSW/faiss there; balanced IVF here — see ops/ann.py for why that is the
+TPU-native layout). Invariant under test everywhere: nprobe == nlist
+recovers the exact brute-force result bit-for-bit in rank order.
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.ops.ann import build_ivf
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+RNG = np.random.default_rng(7)
+DIMS = 32
+NDOCS = 400
+
+
+def _clustered(n, d, ncenters=12, spread=0.4):
+    centers = RNG.normal(size=(ncenters, d)).astype(np.float32) * 2.5
+    v = centers[RNG.integers(0, ncenters, n)] + \
+        RNG.normal(size=(n, d)).astype(np.float32) * spread
+    return v.astype(np.float32)
+
+
+class TestBuildIvf:
+    def test_partition_is_exact(self):
+        v = _clustered(500, 16)
+        pres = np.ones(500, bool)
+        pres[::13] = False
+        ivf = build_ivf(v, pres, nlist=16)
+        flat = ivf.lists.reshape(-1)
+        flat = flat[flat >= 0]
+        assert sorted(flat.tolist()) == np.nonzero(pres)[0].tolist()
+        assert ivf.lists.shape == (ivf.nlist, ivf.cap)
+
+    def test_empty_column(self):
+        assert build_ivf(np.zeros((5, 8), np.float32),
+                         np.zeros(5, bool)) is None
+
+    def test_nlist_clamped_to_present(self):
+        v = _clustered(10, 8)
+        ivf = build_ivf(v, np.ones(10, bool), nlist=64)
+        assert ivf.nlist <= 10
+
+
+@pytest.fixture(scope="module", params=["cosine", "l2_norm", "dot_product"])
+def ann_client(request):
+    sim = request.param
+    c = RestClient()
+    c.indices.create("v", body={"mappings": {"properties": {
+        "emb": {"type": "dense_vector", "dims": DIMS, "similarity": sim,
+                "method": {"name": "ivf",
+                           "parameters": {"nlist": 16, "nprobe": 4}}},
+        "tag": {"type": "keyword"}}}})
+    vecs = _clustered(NDOCS, DIMS)
+    for i in range(NDOCS):
+        c.index("v", {"emb": vecs[i].tolist(),
+                      "tag": "even" if i % 2 == 0 else "odd"}, id=str(i))
+    c.indices.refresh("v")
+    return c, vecs, sim
+
+
+class TestAnnSearch:
+    def test_full_probe_equals_exact(self, ann_client):
+        c, vecs, sim = ann_client
+        q = vecs[3] + RNG.normal(size=DIMS).astype(np.float32) * 0.05
+        body_ann = {"size": 10, "query": {"knn": {"emb": {
+            "vector": q.tolist(), "k": 10,
+            "method_parameters": {"nprobe": 16}}}}}
+        body_exact = {"size": 10, "query": {"knn": {"emb": {
+            "vector": q.tolist(), "k": 10, "exact": True}}}}
+        ra = c.search("v", body_ann)
+        re_ = c.search("v", body_exact)
+        assert [h["_id"] for h in ra["hits"]["hits"]] == \
+               [h["_id"] for h in re_["hits"]["hits"]]
+        for ha, he in zip(ra["hits"]["hits"], re_["hits"]["hits"]):
+            assert ha["_score"] == pytest.approx(he["_score"], rel=1e-5)
+
+    def test_default_nprobe_recall(self, ann_client):
+        c, vecs, sim = ann_client
+        hits_at_10 = 0
+        for qi in range(10):
+            q = vecs[qi * 7] + RNG.normal(size=DIMS).astype(np.float32) * 0.05
+            ra = c.search("v", {"size": 10, "query": {"knn": {"emb": {
+                "vector": q.tolist(), "k": 10}}}})
+            re_ = c.search("v", {"size": 10, "query": {"knn": {"emb": {
+                "vector": q.tolist(), "k": 10, "exact": True}}}})
+            exact_ids = {h["_id"] for h in re_["hits"]["hits"]}
+            ann_ids = {h["_id"] for h in ra["hits"]["hits"]}
+            hits_at_10 += len(exact_ids & ann_ids)
+        assert hits_at_10 / 100 >= 0.8   # recall@10 over 10 queries
+
+    def test_ann_with_filter(self, ann_client):
+        c, vecs, sim = ann_client
+        q = vecs[8]
+        r = c.search("v", {"size": 5, "query": {"knn": {"emb": {
+            "vector": q.tolist(), "k": 5,
+            "filter": {"term": {"tag": "even"}}}}}})
+        assert r["hits"]["hits"]
+        assert all(int(h["_id"]) % 2 == 0 for h in r["hits"]["hits"])
+
+    def test_top_level_knn_ann(self, ann_client):
+        c, vecs, sim = ann_client
+        q = vecs[11]
+        r = c.search("v", {"size": 5, "knn": {
+            "field": "emb", "query_vector": q.tolist(), "k": 5,
+            "method_parameters": {"nprobe": 16}}})
+        r2 = c.search("v", {"size": 5, "knn": {
+            "field": "emb", "query_vector": q.tolist(), "k": 5,
+            "exact": True}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == \
+               [h["_id"] for h in r2["hits"]["hits"]]
+
+    def test_self_query_finds_self(self, ann_client):
+        c, vecs, sim = ann_client
+        r = c.search("v", {"size": 1, "query": {"knn": {"emb": {
+            "vector": vecs[42].tolist(), "k": 1}}}})
+        if sim == "dot_product":
+            # MIPS: the top hit may be a higher-norm vector, not the query
+            # itself — just require agreement with the exact scan
+            re_ = c.search("v", {"size": 1, "query": {"knn": {"emb": {
+                "vector": vecs[42].tolist(), "k": 1, "exact": True}}}})
+            assert (r["hits"]["hits"][0]["_id"]
+                    == re_["hits"]["hits"][0]["_id"])
+        else:
+            assert r["hits"]["hits"][0]["_id"] == "42"
+
+
+class TestPersistenceAndMerge:
+    def test_method_survives_flush_reload(self, tmp_path):
+        path = str(tmp_path / "data")
+        c = RestClient(data_path=path)
+        c.indices.create("pv", body={"mappings": {"properties": {
+            "emb": {"type": "dense_vector", "dims": 8,
+                    "method": {"name": "ivf", "parameters": {"nlist": 4}}}}}})
+        vecs = _clustered(50, 8)
+        for i in range(50):
+            c.index("pv", {"emb": vecs[i].tolist()}, id=str(i))
+        c.indices.refresh("pv")
+        c.indices.flush("pv")
+        c2 = RestClient(data_path=path)
+        seg = c2.node.get_index("pv").shards[0].segments[0]
+        assert seg.vector_cols["emb"].method["name"] == "ivf"
+        r = c2.search("pv", {"size": 1, "query": {"knn": {"emb": {
+            "vector": vecs[7].tolist(), "k": 1}}}})
+        assert r["hits"]["hits"][0]["_id"] == "7"
+
+    def test_method_survives_force_merge(self):
+        c = RestClient()
+        c.indices.create("mv", body={
+            "settings": {"number_of_shards": 1},
+            "mappings": {"properties": {
+                "emb": {"type": "dense_vector", "dims": 8,
+                        "method": {"name": "ivf",
+                                   "parameters": {"nlist": 4}}}}}})
+        vecs = _clustered(60, 8)
+        for i in range(60):
+            c.index("mv", {"emb": vecs[i].tolist()}, id=str(i))
+            if i % 20 == 19:
+                c.indices.refresh("mv")
+        c.indices.refresh("mv")
+        c.indices.forcemerge("mv")
+        segs = c.node.get_index("mv").shards[0].segments
+        assert len(segs) == 1
+        assert segs[0].vector_cols["emb"].method["name"] == "ivf"
+        r = c.search("mv", {"size": 1, "query": {"knn": {"emb": {
+            "vector": vecs[33].tolist(), "k": 1}}}})
+        assert r["hits"]["hits"][0]["_id"] == "33"
+
+
+class TestMappingValidation:
+    def test_unknown_method_rejected(self):
+        c = RestClient()
+        with pytest.raises((ApiError, ValueError)):
+            c.indices.create("bad", body={"mappings": {"properties": {
+                "emb": {"type": "dense_vector", "dims": 8,
+                        "method": {"name": "hnsw"}}}}})

@@ -229,3 +229,23 @@ class TestResolution:
                       search_pipeline="st")
         st = client.node.stats()["search_pipelines"]["pipelines"]["st"]
         assert st["request_processors"][0]["stats"]["count"] == 1
+
+
+class TestProcessorFailureHandling:
+    def test_script_runtime_error_is_400(self, client):
+        client.put_search_pipeline("boom", {
+            "request_processors": [{"script": {
+                "source": "ctx['size'] = bogus_var"}}]})
+        with pytest.raises(ApiError) as ei:
+            client.search("p", {"query": {"match_all": {}}},
+                          search_pipeline="boom")
+        assert ei.value.status == 400
+
+    def test_script_error_ignored_with_ignore_failure(self, client):
+        client.put_search_pipeline("boom2", {
+            "request_processors": [{"script": {
+                "source": "ctx['size'] = bogus_var",
+                "ignore_failure": True}}]})
+        r = client.search("p", {"query": {"match_all": {}}},
+                          search_pipeline="boom2")
+        assert r["hits"]["total"]["value"] == 4

@@ -164,3 +164,19 @@ class TestReviewFixes:
             c.search("z", {"query": {"match_all": {}},
                            "_workload_group": "blocked"})
         assert ei.value.status == 429
+
+
+class TestPolicyValueValidation:
+    def test_bad_min_age_rejected_at_put(self):
+        c = RestClient()
+        with pytest.raises(ApiError) as ei:
+            c.put_lifecycle_policy("badp", {"policy": {
+                "delete": {"min_age": "soon"}}})
+        assert ei.value.status == 400
+
+    def test_bad_max_docs_rejected_at_put(self):
+        c = RestClient()
+        with pytest.raises(ApiError) as ei:
+            c.put_lifecycle_policy("badp2", {"policy": {
+                "rollover": {"max_docs": "lots"}}})
+        assert ei.value.status == 400
