@@ -25,6 +25,7 @@ INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean"}
 FLOAT_TYPES = {"double", "float", "half_float", "rank_feature"}
 NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
 GEO_TYPES = {"geo_point"}
+SHAPE_TYPES = {"geo_shape"}
 VECTOR_TYPES = {"dense_vector", "knn_vector"}
 # feature-weight CSR fields (reference mapper-extras RankFeaturesFieldMapper;
 # sparse_vector is the same storage with learned-sparse token weights)
@@ -159,6 +160,8 @@ class ParsedDocument:
     keywords: Dict[str, List[str]] = dc_field(default_factory=dict)
     # field -> list of (lat, lon)
     geos: Dict[str, List[Tuple[float, float]]] = dc_field(default_factory=dict)
+    # field -> list of geo_shape specs (GeoJSON dict / WKT string, validated)
+    shapes: Dict[str, List[Any]] = dc_field(default_factory=dict)
     # field -> vector (one per doc)
     vectors: Dict[str, List[float]] = dc_field(default_factory=dict)
     # nested path -> child ParsedDocuments (block-join children; reference
@@ -390,6 +393,7 @@ class Mappings:
             if isinstance(value, dict):
                 ft = self.resolve_field(path)
                 if ft is not None and (ft.type in GEO_TYPES or ft.type in FEATURE_TYPES
+                                       or ft.type in SHAPE_TYPES
                                        or ft.type in ("join", "percolator")):
                     self._index_value(ft, value, parsed)
                 else:
@@ -402,6 +406,11 @@ class Mappings:
                     raise ValueError(
                         f"[{lft.type}] field [{path}] does not support arrays "
                         f"of feature objects")
+                if lft is not None and (lft.type in SHAPE_TYPES
+                                        or lft.type in GEO_TYPES):
+                    for v in values:
+                        self._index_value(lft, v, parsed)
+                    continue
                 for v in values:
                     self._parse_obj(v, f"{path}.", parsed)
                 continue
@@ -514,6 +523,13 @@ class Mappings:
         if ft.type in GEO_TYPES:
             lat, lon = _parse_geo(v)
             parsed.geos.setdefault(name, []).append((lat, lon))
+            return
+        if ft.type in SHAPE_TYPES:
+            from ..search.geo import parse_shape
+            # validate now (a bad shape is an index-time 400) and keep the
+            # bbox so segment build doesn't re-parse every value
+            sh = parse_shape(v)
+            parsed.shapes.setdefault(name, []).append((v, sh.bbox))
             return
         if ft.type in FEATURE_TYPES:
             if not isinstance(v, dict):

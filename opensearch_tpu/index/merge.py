@@ -200,6 +200,30 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
         vector_cols[f] = VectorColumn(f, values, present, first.similarity,
                                       method=first.method)
 
+    # ---- shape columns ----
+    shape_cols = {}
+    for f in {f for s in segments for f in getattr(s, "shape_cols", {})}:
+        from .segment import ShapeColumn
+        specs: list = [None] * ndocs
+        minx = np.full(ndocs, np.inf)
+        miny = np.full(ndocs, np.inf)
+        maxx = np.full(ndocs, -np.inf)
+        maxy = np.full(ndocs, -np.inf)
+        present = np.zeros(ndocs, bool)
+        for s, m, dmap in zip(segments, live_masks, doc_maps):
+            col = s.shape_cols.get(f)
+            if col is None:
+                continue
+            tgt = dmap[m]
+            for old_i, new_i in zip(np.nonzero(m)[0], tgt):
+                specs[new_i] = col.specs[old_i]
+            minx[tgt] = col.minx[m]
+            miny[tgt] = col.miny[m]
+            maxx[tgt] = col.maxx[m]
+            maxy[tgt] = col.maxy[m]
+            present[tgt] = col.present[m]
+        shape_cols[f] = ShapeColumn(f, specs, minx, miny, maxx, maxy, present)
+
     # ---- doc lens + stats ----
     doc_lens: Dict[str, np.ndarray] = {}
     text_stats: Dict[str, TextFieldStats] = {}
@@ -241,7 +265,8 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
 
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
                    doc_lens, text_stats, ids, sources, seq_nos=seq_nos,
-                   vector_cols=vector_cols, nested=nested)
+                   vector_cols=vector_cols, nested=nested,
+                   shape_cols=shape_cols)
 
 
 def _ranges_gather(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:

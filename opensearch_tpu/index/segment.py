@@ -159,6 +159,53 @@ class GeoColumn:
 
 
 @dataclass
+class ShapeColumn:
+    """geo_shape storage: host-resident shape specs + per-doc bbox columns.
+
+    The TPU split (vs the reference's Lucene BKD tesselation,
+    `index/mapper/GeoShapeFieldMapper.java`): bboxes give a vectorized
+    numpy prefilter; exact relations (search/geo.py) run on the host over
+    bbox survivors at plan-prepare time; the result is a per-(segment,
+    query) boolean mask uploaded as a plan param — static device shapes,
+    and the mask rides the (segment, plan) filter cache."""
+
+    field: str
+    specs: list                    # per-doc: list of GeoJSON/WKT specs or None
+    minx: np.ndarray               # f64[ndocs] bbox columns
+    miny: np.ndarray
+    maxx: np.ndarray
+    maxy: np.ndarray
+    present: np.ndarray            # bool[ndocs]
+    _parsed: Any = None            # lazy per-doc merged Shape cache
+
+    def shape(self, doc: int):
+        """Merged Shape for one doc (multiple values = one collection)."""
+        from ..search.geo import Shape, parse_shape
+        if self._parsed is None:
+            self._parsed = [None] * len(self.specs)
+        s = self._parsed[doc]
+        if s is None and self.specs[doc]:
+            parts = [parse_shape(sp) for sp in self.specs[doc]]
+            if len(parts) == 1:
+                s = parts[0]
+            else:
+                s = Shape()
+                s.points = np.concatenate([p.points for p in parts])
+                for p in parts:
+                    s.lines += p.lines
+                    s.polys += p.polys
+                s.finish()
+            self._parsed[doc] = s
+        return s
+
+    def bbox_candidates(self, qbbox) -> np.ndarray:
+        """bool[ndocs]: docs whose bbox overlaps the query bbox."""
+        qminx, qminy, qmaxx, qmaxy = qbbox
+        return (self.present & (self.minx <= qmaxx) & (self.maxx >= qminx)
+                & (self.miny <= qmaxy) & (self.maxy >= qminy))
+
+
+@dataclass
 class VectorColumn:
     """Dense vectors for kNN search, row-major [ndocs, dims] (brute-force
     exact kNN runs as one MXU matmul per segment — see ops/knn; the
@@ -233,7 +280,8 @@ class Segment:
                  ids: List[str], sources: List[dict],
                  seq_nos: Optional[np.ndarray] = None,
                  vector_cols: Optional[Dict[str, VectorColumn]] = None,
-                 nested: Optional[Dict[str, NestedBlock]] = None):
+                 nested: Optional[Dict[str, NestedBlock]] = None,
+                 shape_cols: Optional[Dict[str, ShapeColumn]] = None):
         Segment._seq += 1
         self.uid = Segment._seq  # stable identity (id() can be reused post-GC)
         self.name = name
@@ -243,6 +291,7 @@ class Segment:
         self.keyword_cols = keyword_cols
         self.geo_cols = geo_cols
         self.vector_cols = vector_cols or {}
+        self.shape_cols = shape_cols or {}
         self.doc_lens = doc_lens
         self.text_stats = text_stats
         self.nested: Dict[str, NestedBlock] = nested or {}
@@ -433,6 +482,15 @@ class Segment:
                                  "method": col.method}
         for f, dl in self.doc_lens.items():
             arrays[f"dl__{f}"] = dl
+        meta["shape"] = sorted(self.shape_cols)
+        for f, col in self.shape_cols.items():
+            arrays[f"shape__{f}__bbox"] = np.stack(
+                [col.minx, col.miny, col.maxx, col.maxy])
+            arrays[f"shape__{f}__present"] = col.present
+            with open(os.path.join(path,
+                                   f"shapes__{f.replace('/', '_')}.json"),
+                      "w") as fh:
+                json.dump(col.specs, fh)
         meta["nested"] = sorted(self.nested)
         for npath, blk in self.nested.items():
             sub = os.path.join(path, f"nested__{npath.replace('/', '_')}")
@@ -488,6 +546,14 @@ class Segment:
                                    method=m.get("method"))
                    for f, m in meta.get("vector", {}).items()}
         doc_lens = {k[len("dl__"):]: arrays[k] for k in arrays.files if k.startswith("dl__")}
+        shapes = {}
+        for f in meta.get("shape", []):
+            with open(os.path.join(path,
+                                   f"shapes__{f.replace('/', '_')}.json")) as fh:
+                specs = json.load(fh)
+            bbox = arrays[f"shape__{f}__bbox"]
+            shapes[f] = ShapeColumn(f, specs, bbox[0], bbox[1], bbox[2],
+                                    bbox[3], arrays[f"shape__{f}__present"])
         nested = {}
         for npath in meta.get("nested", []):
             sub = os.path.join(path, f"nested__{npath.replace('/', '_')}")
@@ -496,7 +562,7 @@ class Segment:
         seg = cls(meta["name"], meta["ndocs"], postings, numeric, keyword, geo, doc_lens,
                   {f: TextFieldStats(dc, sd) for f, (dc, sd) in meta["text_stats"].items()},
                   ids, sources, seq_nos=arrays["seq_nos"], vector_cols=vectors,
-                  nested=nested)
+                  nested=nested, shape_cols=shapes)
         seg.live = arrays["live"].copy()
         seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
         return seg
@@ -745,6 +811,29 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
             ft.vector_similarity if ft is not None else "cosine",
             method=ft.vector_method if ft is not None else None)
 
+    shape_cols: Dict[str, ShapeColumn] = {}
+    shape_fields = {f for pd in parsed_docs for f in pd.shapes}
+    for fname in shape_fields:
+        specs: list = [None] * ndocs
+        minx = np.full(ndocs, np.inf)
+        miny = np.full(ndocs, np.inf)
+        maxx = np.full(ndocs, -np.inf)
+        maxy = np.full(ndocs, -np.inf)
+        present = np.zeros(ndocs, bool)
+        for doc_i, pd in enumerate(parsed_docs):
+            vals = pd.shapes.get(fname)  # [(spec, bbox)] from mapping parse
+            if not vals:
+                continue
+            specs[doc_i] = [sp for sp, _bx in vals]
+            present[doc_i] = True
+            for _sp, bx in vals:
+                minx[doc_i] = min(minx[doc_i], bx[0])
+                miny[doc_i] = min(miny[doc_i], bx[1])
+                maxx[doc_i] = max(maxx[doc_i], bx[2])
+                maxy[doc_i] = max(maxy[doc_i], bx[3])
+        shape_cols[fname] = ShapeColumn(fname, specs, minx, miny, maxx, maxy,
+                                        present)
+
     # ---- nested blocks: child docs become their own CSR segment ----
     nested_paths = {p for pd in parsed_docs for p in pd.nested}
     nested: Dict[str, NestedBlock] = {}
@@ -763,4 +852,5 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
     seq = np.asarray(seq_nos, dtype=np.int64) if seq_nos is not None else None
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
                    doc_lens, text_stats, ids, sources, seq_nos=seq,
-                   vector_cols=vector_cols, nested=nested)
+                   vector_cols=vector_cols, nested=nested,
+                   shape_cols=shape_cols)

@@ -207,6 +207,24 @@ def docs_mask(doc_list: jnp.ndarray, ndocs_pad: int) -> jnp.ndarray:
     return hits > 0
 
 
+def point_in_polygon_mask(geo: dict, plat: jnp.ndarray,
+                          plon: jnp.ndarray) -> jnp.ndarray:
+    """geo_polygon: ray-cast on the VPU. plat/plon are the query's closed
+    ring padded by repeating the last vertex (degenerate edges cross
+    nothing), so the [ndocs, V] crossing matrix is static-shape.
+    Reference analog GeoPolygonQueryBuilder (deprecated there, still
+    served)."""
+    x = geo["lon"][:, None]
+    y = geo["lat"][:, None]
+    x1, y1 = plon[None, :-1], plat[None, :-1]
+    x2, y2 = plon[None, 1:], plat[None, 1:]
+    spans = ((y1 <= y) & (y < y2)) | ((y2 <= y) & (y < y1))
+    denom = jnp.where(y2 == y1, 1e-30, y2 - y1)
+    xin = x1 + (y - y1) / denom * (x2 - x1)
+    crossings = jnp.sum((spans & (x < xin)).astype(jnp.int32), axis=1)
+    return (crossings % 2 == 1) & geo["present"]
+
+
 def geo_distance_mask(geo: dict, lat: jnp.ndarray, lon: jnp.ndarray,
                       radius_m: jnp.ndarray) -> jnp.ndarray:
     """Haversine distance filter on the VPU (reference GeoDistanceQuery)."""

@@ -110,6 +110,10 @@ class RestClient:
                 doc_id, body, routing, if_seq_no, if_primary_term, op_type)
         except VersionConflictError as e:
             raise ApiError(409, "version_conflict_engine_exception", str(e))
+        except ValueError as e:
+            # document parse failures (bad geo shapes/vectors/strict dynamic
+            # mapping) are client errors, reference mapper_parsing_exception
+            raise ApiError(400, "mapper_parsing_exception", str(e))
         svc.index_slowlog.maybe_log(time.monotonic() - t0,
                                     {"_id": doc_id})
         svc.generation += 1

@@ -405,6 +405,30 @@ class LGeoBox(LNode):
     boost: float = 1.0
 
 
+@dataclass
+class LGeoPolygon(LNode):
+    """geo_polygon on geo_point columns: device ray-cast, vertex arrays are
+    query params (static length per jit key)."""
+
+    field: str = ""
+    lats: Tuple[float, ...] = ()
+    lons: Tuple[float, ...] = ()
+    boost: float = 1.0
+
+
+@dataclass
+class LGeoShape(LNode):
+    """geo_shape relation filter. The mask is computed EXACTLY on the host
+    at prepare time (bbox-column prefilter -> search/geo.py refinement over
+    survivors) and uploaded as a bool[ndocs_pad] plan param — see
+    ShapeColumn for why that is the TPU-shaped split."""
+
+    field: str = ""
+    shape: Any = None             # parsed geo.Shape
+    relation: str = "intersects"
+    boost: float = 1.0
+
+
 # =====================================================================
 # rewrite: DSL tree -> logical plan (host, index-wide stats)
 # =====================================================================
@@ -772,6 +796,25 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
     if isinstance(q, dsl.GeoBoundingBoxQuery):
         return LGeoBox(field=q.field, top=q.top, left=q.left, bottom=q.bottom,
                        right=q.right, boost=q.boost)
+
+    if isinstance(q, dsl.GeoPolygonQuery):
+        return LGeoPolygon(field=q.field, lats=tuple(q.lats),
+                           lons=tuple(q.lons), boost=q.boost)
+
+    if isinstance(q, dsl.GeoShapeQuery):
+        from .geo import ShapeParseError, parse_shape
+        ft = m.resolve_field(q.field)
+        if ft is None:
+            if q.ignore_unmapped:
+                return LMatchNone()
+            raise dsl.QueryParseError(
+                f"[geo_shape] failed to find geo field [{q.field}]")
+        try:
+            shape = parse_shape(q.shape)
+        except ShapeParseError as e:
+            raise dsl.QueryParseError(f"[geo_shape] {e}")
+        return LGeoShape(field=q.field, shape=shape, relation=q.relation,
+                         boost=q.boost)
 
     if isinstance(q, dsl.ScriptQuery):
         try:
@@ -1675,6 +1718,65 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         _scalar_f32(params, f"q{nid}_boost", node.boost)
         return ("geobox", nid, node.field, node.field in seg.geo_cols)
 
+    if isinstance(node, LGeoPolygon):
+        # closed ring, padded to a pow2 vertex bucket with copies of the
+        # FIRST vertex: position n closes the ring and every pad edge after
+        # it is v0->v0, degenerate, contributing zero ray crossings
+        nv = len(node.lats) + 1
+        vpad = next_pow2(max(nv, 2), floor=8)
+        lats = np.full(vpad, node.lats[0], np.float32)
+        lons = np.full(vpad, node.lons[0], np.float32)
+        lats[: len(node.lats)] = node.lats
+        lons[: len(node.lons)] = node.lons
+        _p(params, f"q{nid}_plat", lats)
+        _p(params, f"q{nid}_plon", lons)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("geopoly", nid, node.field, node.field in seg.geo_cols, vpad)
+
+    if isinstance(node, LGeoShape):
+        from . import geo as G
+        mask = np.zeros(seg.ndocs_pad, bool)
+        col = seg.shape_cols.get(node.field)
+        if col is not None:
+            if node.relation == "disjoint":
+                # disjoint = present & !intersects: bbox survivors need the
+                # exact test; non-overlapping bboxes are disjoint for free
+                cands = np.nonzero(col.bbox_candidates(node.shape.bbox))[0]
+                mask[: seg.ndocs][col.present] = True
+                for d in cands:
+                    if G.intersects(col.shape(int(d)), node.shape):
+                        mask[d] = False
+            else:
+                cands = np.nonzero(col.bbox_candidates(node.shape.bbox))[0]
+                for d in cands:
+                    if G.relation_matches(col.shape(int(d)), node.shape,
+                                          node.relation):
+                        mask[d] = True
+        elif node.field in seg.geo_cols:
+            # geo_point docs are point shapes: fully vectorized
+            gc = seg.geo_cols[node.field]
+            pts = np.stack([gc.lon.astype(np.float64),
+                            gc.lat.astype(np.float64)], axis=1)
+            if node.relation in ("intersects", "within"):
+                m = G.points_in_shape(pts, node.shape) | \
+                    G._points_on_edges(pts, node.shape)
+                mask[: seg.ndocs] = m & gc.present
+            elif node.relation == "disjoint":
+                m = G.points_in_shape(pts, node.shape) | \
+                    G._points_on_edges(pts, node.shape)
+                mask[: seg.ndocs] = (~m) & gc.present
+            else:  # contains: a point only contains a point query at the
+                # same location
+                if len(node.shape.points) == 1 and not node.shape.polys \
+                        and not node.shape.lines:
+                    qx, qy = node.shape.points[0]
+                    mask[: seg.ndocs] = ((gc.lon == np.float32(qx))
+                                         & (gc.lat == np.float32(qy))
+                                         & gc.present)
+        _p(params, f"q{nid}_shapemask", mask)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("geoshape", nid)
+
     if isinstance(node, LSpanHost):
         from . import spans as SP
         freq = node._freqs.get(seg.uid)
@@ -2416,6 +2518,21 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         mask = ((lat <= params[f"q{nid}_top"]) & (lat >= params[f"q{nid}_bottom"]) &
                 (lon >= params[f"q{nid}_left"]) & (lon <= params[f"q{nid}_right"]) &
                 geo["present"] & (live > 0))
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "geopoly":
+        _, _, field, col_exists, _vpad = spec
+        if not col_exists:
+            return ops.ScoredMask(zeros, zeros)
+        mask = ops.point_in_polygon_mask(seg_arrays["geo"][field],
+                                         params[f"q{nid}_plat"],
+                                         params[f"q{nid}_plon"]) & (live > 0)
+        m = mask.astype(jnp.float32)
+        return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
+
+    if kind == "geoshape":
+        mask = params[f"q{nid}_shapemask"] & (live > 0)
         m = mask.astype(jnp.float32)
         return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
 

@@ -268,6 +268,22 @@ class GeoBoundingBoxQuery(Query):
 
 
 @dataclass
+class GeoPolygonQuery(Query):
+    field: str = ""
+    # vertex lists, parallel (lat[i], lon[i])
+    lats: List[float] = dc_field(default_factory=list)
+    lons: List[float] = dc_field(default_factory=list)
+
+
+@dataclass
+class GeoShapeQuery(Query):
+    field: str = ""
+    shape: Any = None              # GeoJSON dict or WKT string
+    relation: str = "intersects"   # intersects | disjoint | within | contains
+    ignore_unmapped: bool = False
+
+
+@dataclass
 class ScoreFunction:
     kind: str                      # weight | field_value_factor | random_score | script_score | decay
     weight: float = 1.0
@@ -695,6 +711,41 @@ def parse_query(dsl: Optional[dict]) -> Query:
         else:
             tlat, tlon, blat, blon = box["top"], box["left"], box["bottom"], box["right"]
         q = GeoBoundingBoxQuery(field=f, top=tlat, left=tlon, bottom=blat, right=blon)
+        _common(q, body)
+        return q
+
+    if kind == "geo_polygon":
+        fields = [(k, v) for k, v in body.items()
+                  if k not in ("boost", "_name", "validation_method")]
+        if not fields or not isinstance(fields[0][1], dict):
+            raise QueryParseError("[geo_polygon] requires a field with "
+                                  "a [points] object")
+        f, spec = fields[0]
+        pts = [_parse_point(p) for p in spec.get("points", [])]
+        if len(pts) < 3:
+            raise QueryParseError(
+                "[geo_polygon] requires at least 3 points")
+        q = GeoPolygonQuery(field=f, lats=[p[0] for p in pts],
+                            lons=[p[1] for p in pts])
+        _common(q, body)
+        return q
+
+    if kind == "geo_shape":
+        fields = [(k, v) for k, v in body.items()
+                  if k not in ("boost", "_name", "ignore_unmapped")]
+        if not fields:
+            raise QueryParseError("[geo_shape] requires a field")
+        f, spec = fields[0]
+        shape = spec.get("shape", spec.get("indexed_shape"))
+        if shape is None:
+            raise QueryParseError(
+                "[geo_shape] requires [shape] (or a resolved [indexed_shape])")
+        rel = str(spec.get("relation", "intersects")).lower()
+        if rel not in ("intersects", "disjoint", "within", "contains"):
+            raise QueryParseError(f"[geo_shape] unknown relation [{rel}]")
+        q = GeoShapeQuery(field=f, shape=shape, relation=rel,
+                          ignore_unmapped=bool(body.get("ignore_unmapped",
+                                                        False)))
         _common(q, body)
         return q
 

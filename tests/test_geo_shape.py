@@ -1,0 +1,233 @@
+"""geo_shape fields + geo_shape/geo_polygon queries.
+
+Reference: `index/mapper/GeoShapeFieldMapper.java`,
+`index/query/GeoShapeQueryBuilder.java`, `GeoPolygonQueryBuilder.java`.
+Here: device ray-cast for geo_polygon over point columns; host-exact
+relation masks (search/geo.py) over bbox-column survivors for geo_shape.
+"""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.search.geo import parse_shape, relation_matches
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("g", body={"mappings": {"properties": {
+        "pt": {"type": "geo_point"},
+        "shp": {"type": "geo_shape"},
+        "name": {"type": "keyword"}}}})
+    docs = [
+        # point docs
+        {"name": "inside", "pt": {"lat": 5, "lon": 5},
+         "shp": {"type": "point", "coordinates": [5, 5]}},
+        {"name": "outside", "pt": {"lat": 50, "lon": 50},
+         "shp": {"type": "point", "coordinates": [50, 50]}},
+        {"name": "edgehole", "pt": {"lat": 5.5, "lon": 5.5},
+         "shp": {"type": "point", "coordinates": [5.5, 5.5]}},
+        # polygon docs
+        {"name": "small_poly", "shp": {"type": "polygon", "coordinates": [
+            [[2, 2], [4, 2], [4, 4], [2, 4], [2, 2]]]}},
+        {"name": "big_poly", "shp": {"type": "polygon", "coordinates": [
+            [[-20, -20], [20, -20], [20, 20], [-20, 20], [-20, -20]]]}},
+        {"name": "far_poly", "shp": "POLYGON ((30 30, 40 30, 40 40, 30 40, 30 30))"},
+        {"name": "crossing", "shp": {"type": "polygon", "coordinates": [
+            [[8, 8], [15, 8], [15, 15], [8, 15], [8, 8]]]}},
+    ]
+    for i, d in enumerate(docs):
+        c.index("g", d, id=str(i))
+    c.indices.refresh("g")
+    return c
+
+
+QUERY_SQ = {"type": "polygon",
+            "coordinates": [[[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]]}
+
+
+def _names(r):
+    return {h["_source"]["name"] for h in r["hits"]["hits"]}
+
+
+class TestGeoShapeQuery:
+    def test_intersects(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"shape": QUERY_SQ, "relation": "intersects"}}}})
+        assert _names(r) == {"inside", "edgehole", "small_poly", "big_poly",
+                             "crossing"}
+
+    def test_within(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"shape": QUERY_SQ, "relation": "within"}}}})
+        assert _names(r) == {"inside", "edgehole", "small_poly"}
+
+    def test_contains(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"shape": {"type": "point", "coordinates": [3, 3]},
+                    "relation": "contains"}}}})
+        assert _names(r) == {"small_poly", "big_poly"}
+
+    def test_disjoint(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"shape": QUERY_SQ, "relation": "disjoint"}}}})
+        assert _names(r) == {"outside", "far_poly"}
+
+    def test_envelope_and_wkt_query(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"shape": {"type": "envelope",
+                              "coordinates": [[0, 10], [10, 0]]},
+                    "relation": "within"}}}})
+        assert _names(r) == {"inside", "edgehole", "small_poly"}
+        r2 = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"shape": "ENVELOPE (0, 10, 10, 0)",
+                    "relation": "within"}}}})
+        assert _names(r2) == _names(r)
+
+    def test_geo_shape_on_geo_point_field(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "pt": {"shape": QUERY_SQ}}}})
+        assert _names(r) == {"inside", "edgehole"}
+        r2 = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "pt": {"shape": QUERY_SQ, "relation": "disjoint"}}}})
+        assert _names(r2) == {"outside"}
+
+    def test_bool_compose(self, client):
+        r = client.search("g", {"size": 20, "query": {"bool": {
+            "filter": [{"geo_shape": {"shp": {"shape": QUERY_SQ}}}],
+            "must_not": [{"term": {"name": "inside"}}]}}})
+        assert _names(r) == {"edgehole", "small_poly", "big_poly", "crossing"}
+
+    def test_unknown_relation_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("g", {"query": {"geo_shape": {
+                "shp": {"shape": QUERY_SQ, "relation": "overlaps"}}}})
+        assert ei.value.status == 400
+
+    def test_ignore_unmapped(self, client):
+        with pytest.raises(ApiError):
+            client.search("g", {"query": {"geo_shape": {
+                "ghost": {"shape": QUERY_SQ}}}})
+        # note: ignore_unmapped sits at the query-body level
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "ghost": {"shape": QUERY_SQ}, "ignore_unmapped": True}}})
+        assert r["hits"]["total"]["value"] == 0
+
+
+class TestGeoPolygon:
+    def test_triangle(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_polygon": {
+            "pt": {"points": [{"lat": 0, "lon": 0}, {"lat": 0, "lon": 10},
+                              {"lat": 10, "lon": 5}]}}}})
+        assert _names(r) == {"inside", "edgehole"}
+
+    def test_too_few_points_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("g", {"query": {"geo_polygon": {
+                "pt": {"points": [{"lat": 0, "lon": 0},
+                                  {"lat": 1, "lon": 1}]}}}})
+
+    def test_concave(self, client):
+        # U-shape excluding the notch where "inside" (5,5) sits
+        pts = [[0, 0], [10, 0], [10, 10], [6, 10], [6, 3], [4, 3], [4, 10],
+               [0, 10]]
+        r = client.search("g", {"size": 20, "query": {"geo_polygon": {
+            "pt": {"points": [{"lat": la, "lon": lo}
+                              for lo, la in pts]}}}})
+        assert "inside" not in _names(r)
+
+
+class TestShapeDocsEdgeCases:
+    def test_polygon_with_hole_doc(self):
+        c = RestClient()
+        c.indices.create("h", body={"mappings": {"properties": {
+            "shp": {"type": "geo_shape"}}}})
+        c.index("h", {"shp": {"type": "polygon", "coordinates": [
+            [[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]],
+            [[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]]]}}, id="donut",
+            refresh=True)
+        # point in the hole does not intersect
+        r = c.search("h", {"query": {"geo_shape": {"shp": {
+            "shape": {"type": "point", "coordinates": [5, 5]}}}}})
+        assert r["hits"]["total"]["value"] == 0
+        r = c.search("h", {"query": {"geo_shape": {"shp": {
+            "shape": {"type": "point", "coordinates": [1, 1]}}}}})
+        assert r["hits"]["total"]["value"] == 1
+
+    def test_multiple_shapes_per_doc(self):
+        c = RestClient()
+        c.indices.create("m", body={"mappings": {"properties": {
+            "shp": {"type": "geo_shape"}}}})
+        c.index("m", {"shp": [
+            {"type": "point", "coordinates": [1, 1]},
+            {"type": "point", "coordinates": [100, 45]}]}, id="two",
+            refresh=True)
+        for coords in ([1, 1], [100, 45]):
+            r = c.search("m", {"query": {"geo_shape": {"shp": {
+                "shape": {"type": "circle", "coordinates": coords,
+                          "radius": "10km"}}}}})
+            assert r["hits"]["total"]["value"] == 1, coords
+
+    def test_bad_shape_doc_400(self):
+        c = RestClient()
+        c.indices.create("b", body={"mappings": {"properties": {
+            "shp": {"type": "geo_shape"}}}})
+        with pytest.raises(ApiError):
+            c.index("b", {"shp": {"type": "blob", "coordinates": [1, 2]}})
+
+    def test_persistence_and_merge(self, tmp_path):
+        path = str(tmp_path / "data")
+        c = RestClient(data_path=path)
+        c.indices.create("p", body={
+            "settings": {"number_of_shards": 1},
+            "mappings": {"properties": {"shp": {"type": "geo_shape"}}}})
+        c.index("p", {"shp": QUERY_SQ}, id="a")
+        c.indices.refresh("p")
+        c.index("p", {"shp": {"type": "point", "coordinates": [50, 50]}},
+                id="b")
+        c.indices.refresh("p")
+        c.indices.forcemerge("p")
+        q = {"query": {"geo_shape": {"shp": {
+            "shape": {"type": "point", "coordinates": [5, 5]}}}}}
+        assert [h["_id"] for h in c.search("p", q)["hits"]["hits"]] == ["a"]
+        c.indices.flush("p")
+        c2 = RestClient(data_path=path)
+        assert [h["_id"] for h in c2.search("p", q)["hits"]["hits"]] == ["a"]
+
+
+class TestReviewRegressions:
+    def test_polygon_pad_parity(self, client):
+        # nv not a pow2: the pad edges must be degenerate, or an outside
+        # point gains a spurious crossing (triangle, point west of it)
+        r = client.search("g", {"size": 20, "query": {"geo_polygon": {
+            "pt": {"points": [{"lat": 0, "lon": 4}, {"lat": 0, "lon": 10},
+                              {"lat": 10, "lon": 7}]}}}})
+        assert "inside" not in _names(r)     # (5,5) is west of this triangle
+
+    def test_multipart_containment_intersects(self):
+        c = RestClient()
+        c.indices.create("mp2", body={"mappings": {"properties": {
+            "shp": {"type": "geo_shape"}}}})
+        # part A far away, part B wholly inside the query square
+        c.index("mp2", {"shp": {"type": "multipolygon", "coordinates": [
+            [[[100, 100], [110, 100], [110, 110], [100, 110], [100, 100]]],
+            [[[4, 4], [6, 4], [6, 6], [4, 6], [4, 4]]]]}}, id="m",
+            refresh=True)
+        r = c.search("mp2", {"query": {"geo_shape": {"shp": {
+            "shape": QUERY_SQ, "relation": "intersects"}}}})
+        assert r["hits"]["total"]["value"] == 1
+        r = c.search("mp2", {"query": {"geo_shape": {"shp": {
+            "shape": QUERY_SQ, "relation": "disjoint"}}}})
+        assert r["hits"]["total"]["value"] == 0
+
+    def test_malformed_shapes_are_400(self, client):
+        for bad in ({"type": "point"}, {"type": "circle"},
+                    {"type": "polygon", "coordinates": "nope"}):
+            with pytest.raises(ApiError) as ei:
+                client.search("g", {"query": {"geo_shape": {
+                    "shp": {"shape": bad}}}})
+            assert ei.value.status == 400, bad
+        with pytest.raises(ApiError) as ei:
+            client.search("g", {"query": {"geo_polygon": {"boost": 2.0}}})
+        assert ei.value.status == 400
