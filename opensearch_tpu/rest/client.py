@@ -382,8 +382,12 @@ class RestClient:
         return {"acknowledged": True}
 
     def _resolve_percolate_refs(self, node):
-        """Inline `{"percolate": {"index": ..., "id": ...}}` doc references by
-        fetching the stored doc (reference TransportPercolateQuery GET step).
+        """Inline stored-document references before parsing:
+        - `{"percolate": {"index", "id"}}` fetches the candidate doc
+          (reference TransportPercolateQuery GET step);
+        - `{"geo_shape": {field: {"indexed_shape": {index, id, path}}}}`
+          fetches the pre-indexed shape (reference GeoShapeQueryBuilder
+          circuit through the get action).
         Pure: returns a copied tree; never descends into percolate bodies
         (candidate documents are user content, not DSL)."""
         if isinstance(node, dict):
@@ -397,12 +401,42 @@ class RestClient:
                         v = dict(v)
                         v["document"] = got.get("_source", {})
                     out[k] = v
+                elif k == "geo_shape" and isinstance(v, dict):
+                    out[k] = {fk: self._resolve_indexed_shape(fv)
+                              for fk, fv in v.items()}
                 else:
                     out[k] = self._resolve_percolate_refs(v)
             return out
         if isinstance(node, list):
             return [self._resolve_percolate_refs(v) for v in node]
         return node
+
+    def _resolve_indexed_shape(self, spec):
+        if not (isinstance(spec, dict) and isinstance(
+                spec.get("indexed_shape"), dict)):
+            return spec
+        ref = spec["indexed_shape"]
+        if not (ref.get("index") and ref.get("id")):
+            raise ApiError(400, "parsing_exception",
+                           "[geo_shape] indexed_shape needs [index] and [id]")
+        try:
+            got = self.get(ref["index"], ref["id"],
+                           routing=ref.get("routing"))
+        except (ApiError, IndexNotFoundError):
+            raise ApiError(400, "illegal_argument_exception",
+                           f"indexed shape [{ref['index']}/{ref['id']}] "
+                           f"not found")
+        src = got.get("_source", {})
+        shape = src
+        for part in str(ref.get("path", "shape")).split("."):
+            shape = shape.get(part) if isinstance(shape, dict) else None
+        if shape is None:
+            raise ApiError(400, "illegal_argument_exception",
+                           f"shape path [{ref.get('path', 'shape')}] not "
+                           f"found in indexed document")
+        out = {fk: fv for fk, fv in spec.items() if fk != "indexed_shape"}
+        out["shape"] = shape
+        return out
 
     def _snapshot_searchers(self, snapshot: Dict[str, list]) -> List[ShardSearcher]:
         """Searchers bound to a scroll/PIT segment snapshot."""

@@ -809,6 +809,10 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
                 return LMatchNone()
             raise dsl.QueryParseError(
                 f"[geo_shape] failed to find geo field [{q.field}]")
+        if ft.type not in ("geo_shape", "geo_point"):
+            raise dsl.QueryParseError(
+                f"[geo_shape] field [{q.field}] is of type [{ft.type}], "
+                f"not geo_shape/geo_point")
         try:
             shape = parse_shape(q.shape)
         except ShapeParseError as e:
@@ -1196,49 +1200,27 @@ def nested_context(ctx: ShardContext, path: str) -> ShardContext:
 
 
 def _rewrite_query_string(q, ctx: ShardContext, scoring: bool) -> LNode:
-    """Mini query_string grammar: `field:term`, quoted phrases, +/- prefixes,
-    AND/OR, parentheses not supported in r1 (reference full grammar r2+)."""
+    """Full Lucene query_string / lenient simple_query_string grammars
+    (search/querystring.py) -> DSL tree -> this rewriter. The string
+    grammar therefore compiles to exactly the same device plans as native
+    JSON DSL."""
+    from . import querystring as qsmod
     default_fields = q.fields or ([q.default_field] if getattr(q, "default_field", None)
                                   else ["*"])
-    if default_fields == ["*"]:
+    if list(default_fields) == ["*"]:
         default_fields = [f for f, ft in ctx.mappings.fields.items()
                           if ft.type in TEXT_TYPES]
         if not default_fields:
             default_fields = list(ctx.mappings.fields)[:1] or ["_all"]
-    tokens = re.findall(r'[+-]?(?:[\w.]+:)?(?:"[^"]*"|\S+)', q.query)
-    musts: List[LNode] = []
-    shoulds: List[LNode] = []
-    must_nots: List[LNode] = []
-    op_and = q.default_operator == "and"
-    for raw in tokens:
-        if raw in ("AND", "OR"):
-            op_and = raw == "AND"
-            continue
-        occur = "should"
-        if raw.startswith("+"):
-            occur, raw = "must", raw[1:]
-        elif raw.startswith("-"):
-            occur, raw = "must_not", raw[1:]
-        fields = default_fields
-        mm = re.match(r"([\w.]+):(.*)", raw)
-        if mm and ctx.mappings.resolve_field(mm.group(1)) is not None:
-            fields, raw = [mm.group(1)], mm.group(2)
-        raw = raw.strip('"')
-        if not raw:
-            continue
-        if "*" in raw or "?" in raw:
-            sub: LNode = LBool(shoulds=[LExpandTerms(field=f,
-                                                     expander=_wildcard_expander(f, raw, False))
-                                        for f in fields], msm=1)
-        else:
-            children = [rewrite(dsl.MatchQuery(field=f, query=raw), ctx, scoring)
-                        for f in fields]
-            sub = children[0] if len(children) == 1 else LDisMax(children=children)
-        {"must": musts, "should": shoulds, "must_not": must_nots}[occur].append(sub)
-    if op_and and shoulds and not musts:
-        musts, shoulds = shoulds, []
-    return LBool(musts=musts, shoulds=shoulds, must_nots=must_nots,
-                 msm=1 if shoulds and not musts else 0, boost=q.boost)
+    if isinstance(q, dsl.SimpleQueryStringQuery):
+        tree = qsmod.parse_simple_query_string(q.query, list(default_fields),
+                                               q.default_operator)
+    else:
+        tree = qsmod.parse_query_string(
+            q.query, list(default_fields), q.default_operator,
+            phrase_slop=int(getattr(q, "phrase_slop", 0) or 0))
+    tree.boost = tree.boost * q.boost
+    return rewrite(tree, ctx, scoring)
 
 
 # ---------------- multi-term expanders (host, per segment vocab) ----------------
@@ -1270,13 +1252,21 @@ def _wildcard_expander(field: str, pattern: str, ci: bool):
 
 
 def _regexp_expander(field: str, pattern: str):
-    compiled = re.compile(pattern)
+    """Full Lucene regexp syntax (search/regexp.py DFA engine, incl. ~ & @
+    <m-n>); the whole term dictionary is matched in one vectorized DFA run
+    over a cached per-(segment, field) codepoint matrix."""
+    from .regexp import RegexpError, compile_regexp, match_vocab
+    try:
+        compile_regexp(pattern)   # validate once -> 400, not per segment
+    except RegexpError as e:
+        raise dsl.QueryParseError(f"[regexp] {e}")
+
     def expand(seg: Segment) -> np.ndarray:
         pb = seg.postings.get(field)
         if pb is None:
             return np.empty(0, np.int32)
-        rows = [i for i, t in enumerate(pb.vocab) if compiled.fullmatch(t)]
-        return np.asarray(rows, np.int32)
+        hits = match_vocab(pattern, pb.vocab, cache_key=(seg.uid, field))
+        return np.nonzero(hits)[0].astype(np.int32)
     return expand
 
 

@@ -74,21 +74,14 @@ def _circle_poly(lon: float, lat: float, radius_m: float, n: int = 64) -> Ring:
     return np.stack([lon + dlon * np.cos(t), lat + dlat * np.sin(t)], axis=1)
 
 
-_DIST_UNITS = {"m": 1.0, "km": 1000.0, "mi": 1609.344, "yd": 0.9144,
-               "ft": 0.3048, "cm": 0.01, "mm": 0.001, "nmi": 1852.0,
-               "in": 0.0254}
-
-
 def parse_distance_m(v) -> float:
-    if isinstance(v, (int, float)):
-        return float(v)
-    m = re.fullmatch(r"\s*([\d.eE+-]+)\s*([a-zA-Z]*)\s*", str(v))
-    if not m:
-        raise ShapeParseError(f"cannot parse distance [{v}]")
-    unit = m.group(2).lower() or "m"
-    if unit not in _DIST_UNITS:
-        raise ShapeParseError(f"unknown distance unit [{unit}]")
-    return float(m.group(1)) * _DIST_UNITS[unit]
+    """Delegates to the one DistanceUnit table (query_dsl._parse_distance)
+    so circle radii accept exactly what geo_distance accepts."""
+    from .query_dsl import _parse_distance
+    try:
+        return _parse_distance(v)
+    except (ValueError, TypeError) as e:
+        raise ShapeParseError(f"cannot parse distance [{v}]: {e}")
 
 
 def parse_shape(spec) -> Shape:
@@ -364,16 +357,42 @@ def _all_vertices(shape: Shape) -> np.ndarray:
     return np.concatenate(parts) if parts else np.zeros((0, 2), np.float64)
 
 
+def _segments_cross_proper(a1, b1, a2, b2) -> bool:
+    """Transversal interior-to-interior crossing only: touching at
+    endpoints or collinear overlap does NOT count."""
+    if len(a1) == 0 or len(a2) == 0:
+        return False
+    d1 = (b1 - a1)[:, None, :]
+    d2 = (b2 - a2)[None, :, :]
+    w = a2[None, :, :] - a1[:, None, :]
+    den = d1[..., 0] * d2[..., 1] - d1[..., 1] * d2[..., 0]
+    t_num = w[..., 0] * d2[..., 1] - w[..., 1] * d2[..., 0]
+    u_num = w[..., 0] * d1[..., 1] - w[..., 1] * d1[..., 0]
+    eps = 1e-12
+    nonpar = np.abs(den) > eps
+    with np.errstate(divide="ignore", invalid="ignore"):
+        t = np.where(nonpar, t_num / np.where(nonpar, den, 1.0), np.inf)
+        u = np.where(nonpar, u_num / np.where(nonpar, den, 1.0), np.inf)
+    return bool((nonpar & (t > eps) & (t < 1 - eps)
+                 & (u > eps) & (u < 1 - eps)).any())
+
+
 def within(a: Shape, b: Shape) -> bool:
-    """a within b: b must be areal; every part of a inside b's polygons."""
+    """a within b: b must be areal; every part of a inside b's polygons.
+    Touching b's boundary is allowed; properly crossing it is not."""
     if a.empty or not b.polys:
         return False
     va = _all_vertices(a)
     if not points_in_shape(va, b).all():
         return False
-    # no boundary crossing (touching is allowed)
     ea = _shape_edges(a)
     eb = _shape_edges(b)
+    # a boundary edge of `a` transversally crossing b's boundary (outer
+    # rings OR holes) means part of a's interior escapes b — this is what
+    # catches a region protruding into a hole whose vertices/midpoints all
+    # sample inside b
+    if _segments_cross_proper(ea[0], ea[1], eb[0], eb[1]):
+        return False
     if len(ea[0]):
         mids = (ea[0] + ea[1]) / 2.0
         if not points_in_shape(mids, b).all():

@@ -241,6 +241,7 @@ class QueryStringQuery(Query):
     default_field: Optional[str] = None
     fields: List[str] = dc_field(default_factory=list)
     default_operator: str = "or"
+    phrase_slop: int = 0
 
 
 @dataclass
@@ -680,7 +681,8 @@ def parse_query(dsl: Optional[dict]) -> Query:
     if kind == "query_string":
         q = QueryStringQuery(query=body["query"], default_field=body.get("default_field"),
                              fields=list(body.get("fields", [])),
-                             default_operator=str(body.get("default_operator", "or")).lower())
+                             default_operator=str(body.get("default_operator", "or")).lower(),
+                             phrase_slop=int(body.get("phrase_slop", 0)))
         _common(q, body)
         return q
 

@@ -231,3 +231,46 @@ class TestReviewRegressions:
         with pytest.raises(ApiError) as ei:
             client.search("g", {"query": {"geo_polygon": {"boost": 2.0}}})
         assert ei.value.status == 400
+
+
+class TestReviewRegressions2:
+    def test_within_hole_protrusion(self):
+        from opensearch_tpu.search.geo import parse_shape, within
+        doc = parse_shape({"type": "polygon", "coordinates": [
+            [[0, 0], [10, 0], [10, 10], [0, 10], [0, 0]]]})
+        query = parse_shape({"type": "polygon", "coordinates": [
+            [[-20, -20], [20, -20], [20, 20], [-20, 20], [-20, -20]],
+            [[7, 2], [12, 2], [12, 4], [7, 4], [7, 2]]]})
+        assert not within(doc, query)   # protrudes into the hole
+        # but exact-cover envelope (boundary touch) is still within
+        cover = parse_shape({"type": "envelope",
+                             "coordinates": [[0, 10], [10, 0]]})
+        assert within(doc, cover)
+
+    def test_geo_shape_on_wrong_field_type_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("g", {"query": {"geo_shape": {
+                "name": {"shape": QUERY_SQ}}}})
+        assert ei.value.status == 400
+
+    def test_indexed_shape(self, client):
+        client.indices.create("shapes", body={"mappings": {"properties": {
+            "boundary": {"type": "geo_shape"}}}})
+        client.index("shapes", {"boundary": QUERY_SQ}, id="sq",
+                     refresh=True)
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"indexed_shape": {"index": "shapes", "id": "sq",
+                                      "path": "boundary"},
+                    "relation": "within"}}}})
+        assert _names(r) == {"inside", "edgehole", "small_poly"}
+        with pytest.raises(ApiError) as ei:
+            client.search("g", {"query": {"geo_shape": {
+                "shp": {"indexed_shape": {"index": "shapes",
+                                          "id": "ghost"}}}}})
+        assert ei.value.status == 400
+
+    def test_circle_long_units(self, client):
+        r = client.search("g", {"size": 20, "query": {"geo_shape": {
+            "shp": {"shape": {"type": "circle", "coordinates": [5, 5],
+                              "radius": "100kilometers"}}}}})
+        assert "inside" in _names(r)

@@ -1,0 +1,273 @@
+"""Full Lucene query_string grammar + lenient simple_query_string
+(search/querystring.py). Reference: QueryStringQueryBuilder.java /
+SimpleQueryStringBuilder.java over Lucene's classic QueryParser."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.search import query_dsl as dsl
+from opensearch_tpu.search.querystring import (parse_query_string,
+                                               parse_simple_query_string)
+
+
+class TestGrammarUnits:
+    def test_field_term(self):
+        q = parse_query_string("title:hello", ["body"])
+        assert isinstance(q, dsl.MatchQuery) and q.field == "title"
+
+    def test_default_fields_dismax(self):
+        q = parse_query_string("hello", ["title^2", "body"])
+        assert isinstance(q, dsl.DisMaxQuery)
+        assert {c.field for c in q.queries} == {"title", "body"}
+        assert {c.boost for c in q.queries} == {2.0, 1.0}
+
+    def test_and_or_classic_semantics(self):
+        # a AND b OR c => must:[a,b] should:[c]
+        q = parse_query_string("a AND b OR c", ["f"])
+        assert isinstance(q, dsl.BoolQuery)
+        assert [m.query for m in q.must] == ["a", "b"]
+        assert [s.query for s in q.should] == ["c"]
+
+    def test_not_and_minus(self):
+        q = parse_query_string("good -bad NOT ugly", ["f"])
+        assert [m.query for m in q.must_not] == ["bad", "ugly"]
+        assert [s.query for s in q.should] == ["good"]
+
+    def test_grouping(self):
+        q = parse_query_string("(a OR b) AND c", ["f"])
+        assert isinstance(q, dsl.BoolQuery)
+        assert len(q.must) == 2
+        assert isinstance(q.must[0], dsl.BoolQuery)
+
+    def test_field_group_scope(self):
+        q = parse_query_string("title:(a b)", ["body"])
+        assert isinstance(q, dsl.BoolQuery)
+        assert all(c.field == "title" for c in q.should)
+
+    def test_phrase_with_slop_and_boost(self):
+        q = parse_query_string('"quick fox"~2^3', ["f"])
+        assert isinstance(q, dsl.MatchPhraseQuery)
+        assert q.slop == 2 and q.boost == 3.0
+
+    def test_range_inclusive_exclusive(self):
+        q = parse_query_string("age:[10 TO 20}", ["f"])
+        assert isinstance(q, dsl.RangeQuery)
+        assert q.gte == "10" and q.lt == "20" and q.lte is None
+
+    def test_open_range(self):
+        q = parse_query_string("age:[* TO 5]", ["f"])
+        assert q.gte is None and q.lte == "5"
+
+    def test_regex(self):
+        q = parse_query_string("name:/jo.+n/", ["f"])
+        assert isinstance(q, dsl.RegexpQuery) and q.value == "jo.+n"
+
+    def test_fuzzy(self):
+        q = parse_query_string("roam~", ["f"])
+        assert isinstance(q, dsl.FuzzyQuery) and q.fuzziness == "AUTO"
+        q = parse_query_string("roam~1", ["f"])
+        assert q.fuzziness == 1
+
+    def test_wildcard_and_prefix(self):
+        assert isinstance(parse_query_string("qu*ck", ["f"]),
+                          dsl.WildcardQuery)
+        assert isinstance(parse_query_string("quick*", ["f"]),
+                          dsl.PrefixQuery)
+
+    def test_exists_and_match_all(self):
+        q = parse_query_string("_exists_:title", ["f"])
+        assert isinstance(q, dsl.ExistsQuery) and q.field == "title"
+        assert isinstance(parse_query_string("*:*", ["f"]),
+                          dsl.MatchAllQuery)
+        q = parse_query_string("title:*", ["f"])
+        assert isinstance(q, dsl.ExistsQuery)
+
+    def test_escaping(self):
+        q = parse_query_string(r"path:a\:b", ["f"])
+        assert q.query == "a:b"
+
+    def test_boost_on_term(self):
+        q = parse_query_string("hello^4", ["f"])
+        assert q.boost == 4.0
+
+    def test_default_operator_and(self):
+        q = parse_query_string("a b", ["f"], default_operator="and")
+        assert isinstance(q, dsl.BoolQuery) and len(q.must) == 2
+
+    def test_syntax_error_raises(self):
+        with pytest.raises(dsl.QueryParseError):
+            parse_query_string("(unbalanced", ["f"])
+
+    def test_amp_pipe_forms(self):
+        q = parse_query_string("a && b || c", ["f"])
+        assert [m.query for m in q.must] == ["a", "b"]
+
+
+class TestSimpleGrammar:
+    def test_basic(self):
+        q = parse_simple_query_string("a b", ["f"])
+        assert isinstance(q, dsl.BoolQuery) and len(q.should) == 2
+
+    def test_or_pipe(self):
+        q = parse_simple_query_string("a | b", ["f"],
+                                      default_operator="and")
+        assert isinstance(q, dsl.BoolQuery) and len(q.should) == 2
+
+    def test_plus_and(self):
+        q = parse_simple_query_string("a + b | c", ["f"])
+        assert isinstance(q, dsl.BoolQuery)
+        assert len(q.should) == 2              # (a+b) | c
+        assert isinstance(q.should[0], dsl.BoolQuery)
+
+    def test_negation_and_phrase(self):
+        q = parse_simple_query_string('-bad "exact phrase"', ["f"])
+        assert len(q.must_not) == 1
+        assert isinstance(q.should[0], dsl.MatchPhraseQuery)
+
+    def test_lenient_never_raises(self):
+        for s in ["(((", "a )", "~~", '"unterminated', "|||", "+", ""]:
+            parse_simple_query_string(s, ["f"])   # must not raise
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("qs", body={"mappings": {"properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "age": {"type": "integer"},
+        "tag": {"type": "keyword"}}}})
+    docs = [
+        {"title": "quick brown fox", "body": "jumps over the lazy dog",
+         "age": 10, "tag": "animal"},
+        {"title": "slow green turtle", "body": "crawls under the log",
+         "age": 20, "tag": "animal"},
+        {"title": "quick silver surfer", "body": "rides the wave",
+         "age": 30, "tag": "hero"},
+        {"title": "brown bread recipe", "body": "bake the quick dough",
+         "age": 40, "tag": "food"},
+    ]
+    for i, d in enumerate(docs):
+        c.index("qs", d, id=str(i))
+    c.indices.refresh("qs")
+    return c
+
+
+def _ids(r):
+    return {h["_id"] for h in r["hits"]["hits"]}
+
+
+class TestEndToEnd:
+    def test_field_and_bool(self, client):
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "title:quick AND tag:animal"}}})
+        assert _ids(r) == {"0"}
+
+    def test_grouping_and_not(self, client):
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "(title:quick OR title:brown) NOT tag:food"}}})
+        assert _ids(r) == {"0", "2"}
+
+    def test_range_and_exists(self, client):
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "age:[20 TO 30]"}}})
+        assert _ids(r) == {"1", "2"}
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "_exists_:tag AND age:{30 TO *]"}}})
+        assert _ids(r) == {"3"}
+
+    def test_phrase_and_slop(self, client):
+        r = client.search("qs", {"query": {"query_string": {
+            "query": '"quick fox"~1', "fields": ["title"]}}})
+        assert _ids(r) == {"0"}
+        r = client.search("qs", {"query": {"query_string": {
+            "query": '"quick fox"', "fields": ["title"]}}})
+        assert _ids(r) == set()
+
+    def test_wildcards_fuzzy_regex(self, client):
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "title:qu?ck"}}})
+        assert _ids(r) == {"0", "2"}
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "title:quikc~2"}}})
+        assert _ids(r) == {"0", "2"}
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "tag:/an.mal/"}}})
+        assert _ids(r) == {"0", "1"}
+
+    def test_multi_field_boost(self, client):
+        r = client.search("qs", {"query": {"query_string": {
+            "query": "quick", "fields": ["title^10", "body"]}}})
+        assert _ids(r) == {"0", "2", "3"}
+        # title hits outrank the body-only hit
+        assert r["hits"]["hits"][-1]["_id"] == "3"
+
+    def test_match_all_star(self, client):
+        r = client.search("qs", {"query": {"query_string": {"query": "*:*"}}})
+        assert r["hits"]["total"]["value"] == 4
+
+    def test_syntax_error_is_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("qs", {"query": {"query_string": {
+                "query": "title:(oops"}}})
+        assert ei.value.status == 400
+
+    def test_simple_query_string_e2e(self, client):
+        r = client.search("qs", {"query": {"simple_query_string": {
+            "query": "quick + fox | turtle", "fields": ["title"]}}})
+        assert _ids(r) == {"0", "1"}
+        # lenient garbage does not 400
+        client.search("qs", {"query": {"simple_query_string": {
+            "query": "(((", "fields": ["title"]}}})
+
+
+class TestRegexpEngine:
+    """Full Lucene regexp operators through the regexp query
+    (search/regexp.py DFA engine)."""
+
+    def test_operators_e2e(self, client):
+        # intersection: terms with 'o' AND ending in 'x' -> fox
+        r = client.search("qs", {"query": {"regexp": {
+            "title": ".*o.*&.*x"}}})
+        assert _ids(r) == {"0"}
+        # complement: any title term that is NOT 'quick' but starts with q
+        r = client.search("qs", {"query": {"regexp": {
+            "title": "q.*&~(quick)"}}})
+        assert _ids(r) == set()
+        # numeric interval
+        c = RestClient()
+        c.indices.create("rx", body={"mappings": {"properties": {
+            "code": {"type": "keyword"}}}})
+        for v in ("item7", "item31", "item32", "other"):
+            c.index("rx", {"code": v}, id=v)
+        c.indices.refresh("rx")
+        r = c.search("rx", {"query": {"regexp": {"code": "item<1-31>"}}})
+        assert _ids(r) == {"item7", "item31"}
+        # anystring
+        r = c.search("rx", {"query": {"regexp": {"code": "item@"}}})
+        assert _ids(r) == {"item7", "item31", "item32"}
+
+    def test_bad_pattern_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("qs", {"query": {"regexp": {"title": "(unclosed"}}})
+        assert ei.value.status == 400
+
+
+class TestLexerLiterals:
+    def test_hyphenated_term_is_one_term(self):
+        q = parse_query_string("well-known", ["f"])
+        assert isinstance(q, dsl.MatchQuery) and q.query == "well-known"
+
+    def test_cplusplus_and_ampersand(self):
+        q = parse_query_string("C++", ["f"])
+        assert isinstance(q, dsl.MatchQuery) and q.query == "C++"
+        q = parse_query_string("AT&T", ["f"])
+        assert isinstance(q, dsl.MatchQuery) and q.query == "AT&T"
+
+    def test_leading_minus_still_negates(self):
+        q = parse_query_string("good -bad-ish", ["f"])
+        assert [m.query for m in q.must_not] == ["bad-ish"]
+
+    def test_sqs_hyphenated(self):
+        q = parse_simple_query_string("well-known stuff", ["f"])
+        assert {c.query for c in q.should} == {"well-known", "stuff"}
