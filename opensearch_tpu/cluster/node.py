@@ -284,6 +284,12 @@ class Node:
         from .lifecycle import LifecycleService
         self.wlm = WorkloadManagement()
         self.lifecycle = LifecycleService(self)
+        from ..utils.trace import TRACER
+        self.tracer = TRACER
+        # node-level op counters (reference NodeIndicesStats rollup)
+        self.op_counters = {"search_total": 0, "search_time_ms": 0.0,
+                            "get_total": 0, "index_total": 0,
+                            "index_time_ms": 0.0}
         # SPMD mesh dispatch (parallel/service.py): pass a MeshSearchService
         # or set OPENSEARCH_TPU_MESH=1 to auto-build one over jax.devices();
         # eligible searches then run the distributed program with host-loop
@@ -513,20 +519,25 @@ class Node:
                                    f"indices[{expression}]")
         t0 = time.monotonic()
         try:
-            resp = None
-            if (self.mesh_service is not None and len(names) == 1
-                    and phase_hook is None):
-                resp = self.mesh_service.try_search(names[0],
-                                                    self.indices[names[0]],
-                                                    body)
-            if resp is None:
-                resp = search_shards(searchers, body,
-                                     index_name=",".join(names), task=task,
-                                     phase_hook=phase_hook,
-                                     phase_ctx=phase_ctx)
+            with self.tracer.span("indices:data/read/search",
+                                  index=expression,
+                                  shards=len(searchers)):
+                resp = None
+                if (self.mesh_service is not None and len(names) == 1
+                        and phase_hook is None):
+                    resp = self.mesh_service.try_search(names[0],
+                                                        self.indices[names[0]],
+                                                        body)
+                if resp is None:
+                    resp = search_shards(searchers, body,
+                                         index_name=",".join(names),
+                                         task=task, phase_hook=phase_hook,
+                                         phase_ctx=phase_ctx)
         finally:
             self.tasks.unregister(task)
         took = time.monotonic() - t0
+        self.op_counters["search_total"] += 1
+        self.op_counters["search_time_ms"] += took * 1000.0
         for name in names:
             self.indices[name].search_slowlog.maybe_log(took,
                                                         body.get("query"))

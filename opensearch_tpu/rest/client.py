@@ -114,8 +114,10 @@ class RestClient:
             # document parse failures (bad geo shapes/vectors/strict dynamic
             # mapping) are client errors, reference mapper_parsing_exception
             raise ApiError(400, "mapper_parsing_exception", str(e))
-        svc.index_slowlog.maybe_log(time.monotonic() - t0,
-                                    {"_id": doc_id})
+        took = time.monotonic() - t0
+        self.node.op_counters["index_total"] += 1
+        self.node.op_counters["index_time_ms"] += took * 1000.0
+        svc.index_slowlog.maybe_log(took, {"_id": doc_id})
         svc.generation += 1
         if refresh:
             svc.refresh()
@@ -128,6 +130,7 @@ class RestClient:
 
     def get(self, index: str, id: str, routing: Optional[str] = None) -> dict:
         svc = self.node.get_index(self.node.metadata.write_index(index))
+        self.node.op_counters["get_total"] += 1
         res = svc.route(id, routing).get(id)
         if res is None:
             raise ApiError(404, "document_missing_exception",
@@ -564,6 +567,73 @@ class RestClient:
                 responses.append({"error": {"type": type(e).__name__, "reason": str(e)}})
         return {"took": 0, "responses": responses}
 
+    # ---------------- node stats + tracing (reference _nodes/stats) --------
+
+    def nodes_stats(self) -> dict:
+        """Full per-node stats rollup (reference NodesStatsResponse):
+        indices totals + op counters, process mem/cpu, fs, pools,
+        breakers, caches, pipelines, wlm, tracing."""
+        import resource
+        import shutil
+        import sys
+        n = self.node
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss: bytes on macOS, KiB on Linux
+        rss_mult = 1 if sys.platform == "darwin" else 1024
+        try:
+            du = shutil.disk_usage(n.data_path or "/")
+            fs = {"total": {"total_in_bytes": du.total,
+                            "free_in_bytes": du.free,
+                            "available_in_bytes": du.free}}
+        except OSError:
+            fs = {}
+        docs = 0
+        store = 0
+        seg_count = 0
+        for svc in n.indices.values():
+            st = svc.stats()
+            docs += st["docs"]["count"]
+            store += st["store"]["size_in_bytes"]
+            seg_count += st["segments"]["count"]
+        oc = n.op_counters
+        node_block = {
+            "name": n.node_name,
+            "roles": ["cluster_manager", "data", "ingest"],
+            "indices": {
+                "docs": {"count": docs},
+                "store": {"size_in_bytes": store},
+                "segments": {"count": seg_count},
+                "search": {"query_total": oc["search_total"],
+                           "query_time_in_millis":
+                               int(oc["search_time_ms"])},
+                "indexing": {"index_total": oc["index_total"],
+                             "index_time_in_millis":
+                                 int(oc["index_time_ms"])},
+                "get": {"total": oc["get_total"]},
+                "request_cache": n.request_cache.stats(),
+            },
+            "process": {
+                "mem": {"resident_set_size_in_bytes":
+                        ru.ru_maxrss * rss_mult},
+                "cpu": {"total_in_millis":
+                        int((ru.ru_utime + ru.ru_stime) * 1000)},
+            },
+            "fs": fs,
+            "thread_pool": n.thread_pools.stats(),
+            "breakers": n.breakers.stats(),
+            "tasks": n.tasks.stats(),
+            "wlm": n.wlm.stats(),
+            "search_pipelines": n.search_pipelines.stats(),
+            "tracing": n.tracer.stats(),
+        }
+        return {"cluster_name": n.metadata.cluster_name,
+                "nodes": {n.node_name: node_block}}
+
+    def get_traces(self, limit: int = 20) -> dict:
+        """Recent completed request traces (reference telemetry in-memory
+        span exporter shape)."""
+        return {"traces": self.node.tracer.traces(limit)}
+
     # ---------------- tasks API (reference action/admin/cluster/node/tasks) --
 
     def tasks(self, actions: Optional[str] = None) -> dict:
@@ -775,29 +845,148 @@ class RestClient:
                     "aggregatable": ft.doc_values or ft.type == "text"})
         return {"indices": names, "fields": out}
 
-    def termvectors(self, index: str, id: str, fields: Optional[List[str]] = None) -> dict:
-        doc = self.get(index, id)
+    def termvectors(self, index: str, id: Optional[str] = None,
+                    body: Optional[dict] = None,
+                    fields: Optional[List[str]] = None,
+                    term_statistics: bool = False,
+                    field_statistics: bool = True,
+                    positions: bool = True, offsets: bool = True) -> dict:
+        """Reference `action/termvectors/TermVectorsRequest.java`: real doc
+        or artificial (`body["doc"]`), per-term tokens with positions/
+        offsets, optional term statistics (doc_freq/ttf across the index's
+        segments), field statistics, and the tf-idf `filter` block."""
+        body = body or {}
+        fields = fields or body.get("fields")
+        term_statistics = bool(body.get("term_statistics", term_statistics))
+        field_statistics = bool(body.get("field_statistics",
+                                         field_statistics))
+        positions = bool(body.get("positions", positions))
+        offsets = bool(body.get("offsets", offsets))
+        tv_filter = body.get("filter") or {}
         svc = self.node.get_index(self.node.metadata.write_index(index))
+        if body.get("doc") is not None:
+            src = body["doc"]
+            found = True
+            resp_id = id or ""
+        else:
+            if id is None:
+                raise ApiError(400, "action_request_validation_exception",
+                               "termvectors needs an [id] or a [doc]")
+            try:
+                doc = self.get(index, id)
+            except ApiError:
+                return {"_index": svc.meta.name, "_id": id, "found": False}
+            src = doc["_source"]
+            found = True
+            resp_id = id
+        segs = [s for sh in svc.shards for s in sh.segments]
+
+        def _stats(fname: str, term: str):
+            df = ttf = 0
+            for s in segs:
+                pb = s.postings.get(fname)
+                if pb is None:
+                    continue
+                r = pb.row(term)
+                if r >= 0:
+                    a, b = int(pb.starts[r]), int(pb.starts[r + 1])
+                    df += b - a
+                    ttf += int(pb.tfs[a:b].sum())
+            return df, ttf
+
         out_fields = {}
-        src = doc["_source"]
         for fname, ft in list(svc.mappings.fields.items()):
-            if ft.type != "text" or (fields and fname not in fields):
+            if ft.type not in ("text", "keyword") or \
+                    (fields and fname not in fields):
                 continue
             vals = _get_source_path(src, fname)
             if vals is None:
                 continue
             terms: Dict[str, dict] = {}
             for v in (vals if isinstance(vals, list) else [vals]):
-                for tok in svc.mappings.index_analyzer(ft).analyze(str(v)):
-                    t = terms.setdefault(tok.text, {"term_freq": 0, "tokens": []})
+                if ft.type == "keyword":
+                    t = terms.setdefault(str(v), {"term_freq": 0})
                     t["term_freq"] += 1
-                    t["tokens"].append({"position": tok.position,
-                                        "start_offset": tok.start_offset,
-                                        "end_offset": tok.end_offset})
-            if terms:
-                out_fields[fname] = {"terms": terms}
-        return {"_index": svc.meta.name, "_id": id, "found": True,
+                    continue
+                for tok in svc.mappings.index_analyzer(ft).analyze(str(v)):
+                    t = terms.setdefault(tok.text,
+                                         {"term_freq": 0, "tokens": []})
+                    t["term_freq"] += 1
+                    entry = {}
+                    if positions:
+                        entry["position"] = tok.position
+                    if offsets:
+                        entry["start_offset"] = tok.start_offset
+                        entry["end_offset"] = tok.end_offset
+                    if entry:
+                        t["tokens"].append(entry)
+            if not terms:
+                continue
+            ndocs = max(sum(s.live_count for s in segs), 1)
+            if term_statistics or tv_filter:
+                for term, t in terms.items():
+                    df, ttf = _stats(fname, term)
+                    if term_statistics:
+                        t["doc_freq"] = df
+                        t["ttf"] = ttf
+                    t["_df"] = df
+            if tv_filter:
+                import math
+                min_tf = int(tv_filter.get("min_term_freq", 1))
+                min_df = int(tv_filter.get("min_doc_freq", 1))
+                max_df = int(tv_filter.get("max_doc_freq", 1 << 60))
+                kept = {}
+                for term, t in terms.items():
+                    df = t["_df"]
+                    if t["term_freq"] < min_tf or df < min_df or df > max_df:
+                        continue
+                    idf = math.log(1.0 + (ndocs - df + 0.5) / (df + 0.5))
+                    kept[term] = (t["term_freq"] * idf, t)
+                maxn = tv_filter.get("max_num_terms")
+                ranked = sorted(kept.items(), key=lambda kv: -kv[1][0])
+                if maxn is not None:
+                    ranked = ranked[: int(maxn)]
+                terms = {}
+                for term, (score, t) in ranked:
+                    t["score"] = round(score, 6)
+                    terms[term] = t
+            for t in terms.values():
+                t.pop("_df", None)
+            fblock: dict = {"terms": dict(sorted(terms.items()))}
+            if field_statistics:
+                sum_ttf = sum_df = 0
+                for s in segs:
+                    pb = s.postings.get(fname)
+                    if pb is not None:
+                        sum_df += len(pb.doc_ids)
+                        sum_ttf += int(pb.tfs.sum())
+                doc_count = 0
+                for s in segs:
+                    if fname in s.text_stats:
+                        doc_count += s.text_stats[fname].doc_count
+                    elif fname in s.postings:
+                        import numpy as _np
+                        doc_count += len(_np.unique(
+                            s.postings[fname].doc_ids))
+                fblock["field_statistics"] = {
+                    "sum_doc_freq": sum_df, "doc_count": doc_count,
+                    "sum_ttf": sum_ttf}
+            out_fields[fname] = fblock
+        return {"_index": svc.meta.name, "_id": resp_id, "found": found,
                 "term_vectors": out_fields}
+
+    def mtermvectors(self, body: dict, index: Optional[str] = None) -> dict:
+        """Reference `action/termvectors/MultiTermVectorsRequest.java`."""
+        docs = []
+        for spec in body.get("docs", []):
+            idx = spec.get("_index", index)
+            if idx is None:
+                raise ApiError(400, "action_request_validation_exception",
+                               "mtermvectors doc needs an [_index]")
+            docs.append(self.termvectors(
+                idx, spec.get("_id"), body={k: v for k, v in spec.items()
+                                            if not k.startswith("_")}))
+        return {"docs": docs}
 
     # ---------------- reindex family ----------------
 

@@ -1970,6 +1970,37 @@ def _script_env(jnp, field_srcs, pkeys, nid: int, tag: str, seg_arrays: dict,
     return pl.DeviceEnv(jnp, cols, present, score, sparams, ndocs_pad)
 
 
+def describe_plan(node: Optional[LNode]) -> dict:
+    """Logical-plan tree for the profile API (reference search/profile/
+    ProfileResult): type + human description + children. Times live on the
+    root only — the whole tree executes as ONE fused XLA program."""
+    if node is None:
+        return {"type": "MatchAll", "description": "*:*"}
+    t = type(node).__name__.lstrip("L")
+    desc = ""
+    if isinstance(node, LTerms):
+        desc = f"{node.field}:{list(node.terms)[:8]}"
+    elif isinstance(node, LPhrase):
+        desc = f"{node.field}:\"{' '.join(node.terms)}\""
+    elif isinstance(node, (LRange,)):
+        desc = f"{node.field}:[{node.lo} TO {node.hi}]"
+    elif hasattr(node, "field") and getattr(node, "field", ""):
+        desc = str(getattr(node, "field"))
+    children = []
+    for attr in ("musts", "shoulds", "must_nots", "filters", "children"):
+        for c in getattr(node, attr, ()) or ():
+            children.append(describe_plan(c))
+    for attr in ("child", "positive", "negative", "filter"):
+        c = getattr(node, attr, None)
+        if isinstance(c, LNode):
+            children.append(describe_plan(c))
+    out = {"type": t, "description": desc, "time_in_nanos": 0,
+           "fused": True}
+    if children:
+        out["children"] = children
+    return out
+
+
 def can_match(node: LNode, seg: Segment) -> bool:
     """Shard/segment pre-filter (reference CanMatchPreFilterSearchPhase):
     cheaply prove a segment has zero hits."""
@@ -2027,6 +2058,30 @@ def can_match(node: LNode, seg: Segment) -> bool:
         return can_match(node.child_filter, seg)
     if isinstance(node, LMatchNone):
         return False
+    if isinstance(node, LExists):
+        f = node.field
+        return (f in seg.postings or f in seg.numeric_cols
+                or f in seg.keyword_cols or f in seg.geo_cols
+                or f in seg.vector_cols or f in seg.shape_cols
+                or f in seg.doc_lens)
+    if isinstance(node, LIds):
+        return any(i in seg.id2doc for i in node.ids)
+    if isinstance(node, LKnn):
+        return node.field in seg.vector_cols
+    if isinstance(node, (LGeoDist, LGeoBox, LGeoPolygon)):
+        return node.field in seg.geo_cols
+    if isinstance(node, LGeoShape):
+        return (node.field in seg.shape_cols or node.field in seg.geo_cols)
+    if isinstance(node, LDisMax):
+        return any(can_match(c, seg) for c in node.children)
+    if isinstance(node, LBoosting):
+        return node.positive is None or can_match(node.positive, seg)
+    if isinstance(node, LFuncScore):
+        return node.child is None or can_match(node.child, seg)
+    if isinstance(node, (LRankFeature, LSparseDot)):
+        # feature CSRs live in seg.postings; rank_feature on a numeric
+        # column falls back to numeric_cols
+        return node.field in seg.postings or node.field in seg.numeric_cols
     return True
 
 

@@ -649,8 +649,12 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     body = dict(body)
     body["_index_name"] = index_name
     stats = _global_stats_contexts(searchers)
-    results = [s.query_phase(body, shard_ord=i, stats_ctx=stats[i], task=task)
-               for i, s in enumerate(searchers)]
+    from ..utils.trace import TRACER
+    results = []
+    for i, s in enumerate(searchers):
+        with TRACER.span("query_phase", shard=i):
+            results.append(s.query_phase(body, shard_ord=i,
+                                         stats_ctx=stats[i], task=task))
     if phase_hook is not None:
         phase_hook(results, body, phase_ctx if phase_ctx is not None else {})
     agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
@@ -730,19 +734,23 @@ def _finish_search(searchers: List[ShardSearcher],
                    agg_nodes: List[AggNode]) -> dict:
     """Coordinator reduce + fetch + response assembly (the tail of
     query-then-fetch, shared by search and batched msearch)."""
-    reduced = reduce_shard_results(results, body, agg_nodes=agg_nodes,
-                                   defer_pipelines=bool(agg_nodes))
+    from ..utils.trace import TRACER
+    with TRACER.span("reduce"):
+        reduced = reduce_shard_results(results, body, agg_nodes=agg_nodes,
+                                       defer_pipelines=bool(agg_nodes))
     by_shard: Dict[int, List[Candidate]] = {}
     for c in reduced["selected"]:
         by_shard.setdefault(c.shard, []).append(c)
     hits_by_key: Dict[Tuple, dict] = {}
-    for i, r in enumerate(results):
-        sel = by_shard.get(r.shard, [])
-        if not sel:
-            continue
-        fetched = searchers[i].fetch_phase(r, sel, body, stats_ctx=stats[i])
-        for c, h in zip(sel, fetched):
-            hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
+    with TRACER.span("fetch_phase", hits=len(reduced["selected"])):
+        for i, r in enumerate(results):
+            sel = by_shard.get(r.shard, [])
+            if not sel:
+                continue
+            fetched = searchers[i].fetch_phase(r, sel, body,
+                                               stats_ctx=stats[i])
+            for c, h in zip(sel, fetched):
+                hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
     hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)] for c in reduced["selected"]
             if (c.shard, c.seg_ord, c.local_doc) in hits_by_key]
 
@@ -788,8 +796,33 @@ def _finish_search(searchers: List[ShardSearcher],
         mappings = searchers[0].engine.mappings if searchers else None
         resp["suggest"] = run_suggest(body["suggest"], segs, mappings)
     if body.get("profile"):
-        resp["profile"] = {"shards": [{"id": r.shard, "query_ms": r.took_ms}
-                                      for r in results]}
+        # per-plan-node breakdown (reference search/profile/): the plan tree
+        # with type/description per node. One honesty note a TPU engine owes
+        # its users: XLA fuses the whole plan into one program, so per-node
+        # device times are not separable — node entries carry the tree and
+        # the root carries the measured phase time (children fused=true).
+        try:
+            plan_tree = C.describe_plan(
+                C.rewrite(dsl.parse_query(body.get("query")),
+                          stats[0], scoring=True)) if stats else None
+        except Exception:
+            plan_tree = None
+        shards_profile = []
+        for r in results:
+            entry: dict = {"id": f"[shard][{r.shard}]",
+                           "query_ms": r.took_ms,
+                           "searches": [{"query": [], "rewrite_time": 0,
+                                         "collector": [{
+                                             "name": "SimpleTopKCollector",
+                                             "reason": "search_top_hits",
+                                             "time_in_nanos": int(
+                                                 r.took_ms * 1e6)}]}]}
+            if plan_tree is not None:
+                root = dict(plan_tree)
+                root["time_in_nanos"] = int(r.took_ms * 1e6)
+                entry["searches"][0]["query"] = [root]
+            shards_profile.append(entry)
+        resp["profile"] = {"shards": shards_profile}
     return resp
 
 

@@ -30,13 +30,20 @@ from . import query_dsl as dsl
 FieldSpec = Tuple[str, float]          # (name, boost)
 
 
+def _float_or_400(v: str, what: str) -> float:
+    try:
+        return float(v)
+    except ValueError:
+        raise dsl.QueryParseError(f"[query_string] bad {what} [{v}]")
+
+
 def parse_field_specs(fields: List[str]) -> List[FieldSpec]:
     """["title^5", "body"] -> [("title", 5.0), ("body", 1.0)]"""
     out = []
     for f in fields:
         if "^" in f:
             name, b = f.rsplit("^", 1)
-            out.append((name, float(b)))
+            out.append((name, _float_or_400(b, "field boost")))
         else:
             out.append((f, 1.0))
     return out
@@ -44,6 +51,38 @@ def parse_field_specs(fields: List[str]) -> List[FieldSpec]:
 
 def _unescape(s: str) -> str:
     return re.sub(r"\\(.)", r"\1", s)
+
+
+def _wild_tokens(text: str) -> List[Tuple[str, str]]:
+    """[("wild", "*"|"?") | ("lit", ch)]: only UNESCAPED * ? are wild."""
+    out: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(text):
+        c = text[i]
+        if c == "\\" and i + 1 < len(text):
+            out.append(("lit", text[i + 1]))
+            i += 2
+        elif c in "*?":
+            out.append(("wild", c))
+            i += 1
+        else:
+            out.append(("lit", c))
+            i += 1
+    return out
+
+
+def _wild_pattern(toks: List[Tuple[str, str]]) -> str:
+    """fnmatch pattern: literal * ? [ are bracket-escaped so only the
+    intended wildcards stay active."""
+    out = []
+    for kind, c in toks:
+        if kind == "wild":
+            out.append(c)
+        elif c in "*?[":
+            out.append(f"[{c}]")
+        else:
+            out.append(c)
+    return "".join(out)
 
 
 _TOKEN_RE = re.compile(r"""
@@ -214,9 +253,9 @@ class _Parser:
             while self.peek()[0] in ("TILDE", "CARET"):
                 k2, v2 = self.next()
                 if k2 == "TILDE":
-                    slop = int(float(v2)) if v2 else slop
+                    slop = int(_float_or_400(v2, "slop")) if v2 else slop
                 else:
-                    boost = float(v2)
+                    boost = _float_or_400(v2, "boost")
             return self._multi(
                 fields,
                 lambda f: dsl.MatchPhraseQuery(field=f, query=text,
@@ -260,23 +299,26 @@ class _Parser:
                 if k2 == "TILDE":
                     fuzz = v2 if v2 else "AUTO"
                 else:
-                    boost = float(v2)
-            has_wild = re.search(r"(?<!\\)[*?]", text) is not None
+                    boost = _float_or_400(v2, "boost")
+            toks = _wild_tokens(text)
+            wild_idx = [i for i, (k, _) in enumerate(toks) if k == "wild"]
             plain = _unescape(text)
 
             def mk_term(f):
                 if fuzz is not None:
                     fz = ("AUTO" if fuzz == "AUTO"
-                          else int(float(fuzz)))
+                          else int(_float_or_400(fuzz, "fuzziness")))
                     return dsl.FuzzyQuery(field=f, value=plain, fuzziness=fz)
-                if has_wild:
-                    if plain == "*":
+                if wild_idx:
+                    if len(toks) == 1 and toks[0] == ("wild", "*"):
                         return dsl.ExistsQuery(field=f)
-                    core = text.replace("\\", "")
-                    if core.endswith("*") and "*" not in core[:-1] \
-                            and "?" not in core:
-                        return dsl.PrefixQuery(field=f, value=core[:-1])
-                    return dsl.WildcardQuery(field=f, value=core)
+                    if (wild_idx == [len(toks) - 1]
+                            and toks[-1] == ("wild", "*")):
+                        return dsl.PrefixQuery(
+                            field=f,
+                            value="".join(c for _k, c in toks[:-1]))
+                    return dsl.WildcardQuery(field=f,
+                                             value=_wild_pattern(toks))
                 op = "and" if self.op_and else "or"
                 return dsl.MatchQuery(field=f, query=plain, operator=op)
             return self._multi(fields, mk_term, boost)
@@ -291,7 +333,7 @@ class _Parser:
 
     def _boost_suffix(self) -> float:
         if self.peek()[0] == "CARET":
-            return float(self.next()[1])
+            return _float_or_400(self.next()[1], "boost")
         return 1.0
 
     def _postfix_boost(self, q: dsl.Query) -> dsl.Query:
@@ -396,6 +438,10 @@ class _SqsParser:
             return None
         if len(parts) == 1:
             return parts[0]
+        # a purely-negative alternative ("-a | b") becomes its own
+        # NOT-clause inside the OR, never a bare sentinel
+        parts = [dsl.BoolQuery(must_not=[p.q]) if isinstance(p, _Negated)
+                 else p for p in parts]
         return dsl.BoolQuery(should=parts, minimum_should_match="1")
 
     def seq(self, in_group) -> Optional[dsl.Query]:
@@ -451,7 +497,11 @@ class _SqsParser:
         q = self.atom(in_group)
         if q is None:
             return None
-        return _Negated(q) if negate else q
+        if not negate:
+            return q
+        if isinstance(q, _Negated):      # "-(-a)" cancels
+            return q.q
+        return _Negated(q)
 
     def atom(self, in_group) -> Optional[dsl.Query]:
         kind, val = self.peek()

@@ -172,6 +172,8 @@ class _P:
                 break
             first = False
             if c == "\\":
+                if self.eof():
+                    raise RegexpError("trailing backslash in class")
                 c = self.next()
             lo = ord(c)
             hi = lo
@@ -181,6 +183,8 @@ class _P:
                 self.next()
                 c2 = self.next()
                 if c2 == "\\":
+                    if self.eof():
+                        raise RegexpError("trailing backslash in class")
                     c2 = self.next()
                 hi = ord(c2)
                 if hi < lo:
@@ -212,10 +216,14 @@ class _P:
         # the practical widths (guarded) and reuses the machinery
         if hi - lo > 2000:
             raise RegexpError(f"numeric interval too large <{body}>")
+        # Lucene's interval automaton accepts leading zeros up to the max
+        # operand width: <1-31> matches "07" as well as "7"
+        width = max(len(m[0]), len(m[1]))
         node = None
         for v in range(lo, hi + 1):
-            alt = _string_node(str(v))
-            node = alt if node is None else ("union", node, alt)
+            for w in range(len(str(v)), width + 1):
+                alt = _string_node(str(v).zfill(w))
+                node = alt if node is None else ("union", node, alt)
         return node if node is not None else ("empty_lang",)
 
 
@@ -258,13 +266,14 @@ class Dfa:
     `cuts`: sorted boundary starts; char -> class = searchsorted(cuts).
     `trans`: int32[nstates, nclasses]; -1 = dead. State 0 = start."""
 
-    __slots__ = ("cuts", "trans", "accept")
+    __slots__ = ("cuts", "trans", "accept", "_completed")
 
     def __init__(self, cuts: np.ndarray, trans: np.ndarray,
                  accept: np.ndarray):
         self.cuts = cuts
         self.trans = trans
         self.accept = accept
+        self._completed = None
 
     def match(self, term: str) -> bool:
         st = 0
@@ -281,12 +290,10 @@ class Dfa:
         n, maxlen = mat.shape
         cls = np.searchsorted(self.cuts, mat, side="right") - 1
         state = np.zeros(n, np.int64)
-        ncls = self.trans.shape[1]
-        # completed automaton with explicit dead state for vector stepping
-        trans = np.vstack([self.trans, np.full((1, ncls), -1, np.int64)])
+        if self._completed is None:
+            self._completed = _complete(self)  # shared with complement()
+        trans, accept = self._completed
         dead = trans.shape[0] - 1
-        trans = np.where(trans < 0, dead, trans)
-        accept = np.concatenate([self.accept, [False]])
         for pos in range(maxlen):
             step = trans[state, cls[:, pos]]
             state = np.where(pos < lens, step, state)

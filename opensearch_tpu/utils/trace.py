@@ -1,0 +1,94 @@
+"""Request tracing: nested spans with a ring buffer of finished traces.
+
+Reference analog: `telemetry/tracing/Tracer.java` (+ the telemetry-otel
+plugin). Spans carry name/attributes/duration and parent links via a
+contextvar, so instrumented layers (REST parse, per-shard query phase,
+reduce, fetch) nest naturally without passing a context object around.
+No exporter: completed root spans land in a bounded in-memory ring the
+stats API serves — the deterministic, dependency-free equivalent of an
+OTel in-memory span processor."""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "opensearch_tpu_span", default=None)
+
+
+class Span:
+    __slots__ = ("span_id", "name", "attributes", "start", "end", "children",
+                 "parent")
+
+    def __init__(self, span_id: int, name: str, attributes: Optional[dict],
+                 parent: Optional["Span"]):
+        self.span_id = span_id
+        self.name = name
+        self.attributes = dict(attributes or {})
+        self.start = time.monotonic()
+        self.end: Optional[float] = None
+        self.children: List["Span"] = []
+        self.parent = parent
+
+    def to_dict(self) -> dict:
+        dur = ((self.end if self.end is not None else time.monotonic())
+               - self.start)
+        return {"name": self.name, "span_id": self.span_id,
+                "duration_ms": round(dur * 1000.0, 3),
+                **({"attributes": self.attributes} if self.attributes else {}),
+                **({"children": [c.to_dict() for c in self.children]}
+                   if self.children else {})}
+
+
+class Tracer:
+    def __init__(self, max_traces: int = 256, enabled: bool = True):
+        self.enabled = enabled
+        self._ids = itertools.count(1)
+        self._traces: deque = deque(maxlen=max_traces)
+        self._lock = threading.Lock()
+        self.span_count = 0
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        if not self.enabled:
+            yield None
+            return
+        parent = _current.get()
+        s = Span(next(self._ids), name, attributes, parent)
+        if parent is not None:
+            parent.children.append(s)
+        token = _current.set(s)
+        try:
+            yield s
+        finally:
+            _current.reset(token)
+            s.end = time.monotonic()
+            with self._lock:
+                self.span_count += 1
+                if parent is None:
+                    self._traces.append(s)
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        s = _current.get()
+        if s is not None:
+            s.attributes[key] = value
+
+    def traces(self, limit: int = 20) -> List[dict]:
+        with self._lock:
+            items = list(self._traces)[-limit:]
+        return [s.to_dict() for s in reversed(items)]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"enabled": self.enabled, "spans": self.span_count,
+                    "retained_traces": len(self._traces)}
+
+
+# process-default tracer (one node per process, like the fielddata breaker)
+TRACER = Tracer()

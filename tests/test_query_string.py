@@ -271,3 +271,38 @@ class TestLexerLiterals:
     def test_sqs_hyphenated(self):
         q = parse_simple_query_string("well-known stuff", ["f"])
         assert {c.query for c in q.should} == {"well-known", "stuff"}
+
+
+class TestReviewRegressions:
+    def test_bad_boost_is_parse_error(self):
+        for bad in ("a^.", "a^b"):
+            with pytest.raises(dsl.QueryParseError):
+                parse_query_string(bad, ["f"])
+        with pytest.raises(dsl.QueryParseError):
+            parse_query_string("x", ["f^bad"])
+
+    def test_sqs_negative_or_alternative(self):
+        q = parse_simple_query_string("-a | b", ["f"])
+        assert isinstance(q, dsl.BoolQuery) and len(q.should) == 2
+        neg = q.should[0]
+        assert isinstance(neg, dsl.BoolQuery) and len(neg.must_not) == 1
+
+    def test_escaped_wildcards_stay_literal(self):
+        # trailing live *, escaped mid-star is a literal prefix char
+        q = parse_query_string(r"a\*b*", ["f"])
+        assert isinstance(q, dsl.PrefixQuery) and q.value == "a*b"
+        # mid-pattern live ? with escaped star -> bracket-escaped fnmatch
+        q = parse_query_string(r"a\*b?c", ["f"])
+        assert isinstance(q, dsl.WildcardQuery) and q.value == "a[*]b?c"
+        q = parse_query_string(r"ab\*", ["f"])   # no live wildcard at all
+        assert isinstance(q, dsl.MatchQuery) and q.query == "ab*"
+
+    def test_regexp_trailing_backslash_in_class_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("qs", {"query": {"regexp": {"title": "[a\\"}}})
+        assert ei.value.status == 400
+
+    def test_regexp_interval_zero_pad(self):
+        from opensearch_tpu.search.regexp import match_vocab
+        got = match_vocab("<1-31>", ["07", "7", "31", "032", "00"])
+        assert got.tolist() == [True, True, True, False, False]
