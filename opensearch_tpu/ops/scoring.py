@@ -122,6 +122,23 @@ def score_term_group(field_arrays: dict, dl: jnp.ndarray, live: jnp.ndarray,
     return ScoredMask(jnp.where(live_ok, scores, 0.0), jnp.where(live_ok, counts, 0.0))
 
 
+def gather_tf_dense(field_arrays: dict, rows: jnp.ndarray, bucket: int,
+                    ndocs_pad: int, t_pad: int) -> jnp.ndarray:
+    """Per-term dense raw term frequencies: f32[t_pad, ndocs_pad].
+    combined_fields (BM25F) needs tf BEFORE saturation so fields can be
+    weighted and summed; one flat scatter builds all T rows at once."""
+    docs, tf, term_idx, valid = gather_postings(
+        field_arrays["starts"], field_arrays["doc_ids"], field_arrays["tfs"],
+        rows, bucket)
+    # clamp BEFORE the flat-index multiply: sentinel doc ids would overflow
+    dsafe = jnp.clip(docs, 0, ndocs_pad - 1)
+    flat = jnp.where(valid, term_idx * ndocs_pad + dsafe,
+                     t_pad * ndocs_pad)   # OOB -> dropped
+    out = jnp.zeros(t_pad * ndocs_pad, jnp.float32).at[flat].add(
+        jnp.where(valid, tf, 0.0), mode="drop")
+    return out.reshape(t_pad, ndocs_pad)
+
+
 def term_filter_mask(field_arrays: dict, live: jnp.ndarray, rows: jnp.ndarray,
                      bucket: int, ndocs_pad: int) -> jnp.ndarray:
     """Non-scoring terms filter -> bool[ndocs_pad] (reference: filter clauses
@@ -223,6 +240,19 @@ def point_in_polygon_mask(geo: dict, plat: jnp.ndarray,
     xin = x1 + (y - y1) / denom * (x2 - x1)
     crossings = jnp.sum((spans & (x < xin)).astype(jnp.int32), axis=1)
     return (crossings % 2 == 1) & geo["present"]
+
+
+def geo_distance_vec(geo: dict, lat: jnp.ndarray,
+                     lon: jnp.ndarray) -> jnp.ndarray:
+    """Haversine distance in meters to (lat, lon), f32[ndocs] on the VPU."""
+    r = 6371008.8
+    p1 = jnp.deg2rad(geo["lat"])
+    p2 = jnp.deg2rad(lat)
+    dphi = p2 - p1
+    dlmb = jnp.deg2rad(lon - geo["lon"])
+    a = (jnp.sin(dphi / 2.0) ** 2
+         + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2.0) ** 2)
+    return 2.0 * r * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
 
 
 def geo_distance_mask(geo: dict, lat: jnp.ndarray, lon: jnp.ndarray,

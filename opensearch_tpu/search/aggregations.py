@@ -25,6 +25,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 BUCKET_KINDS = {"terms", "histogram", "date_histogram", "range", "date_range",
+                "geo_distance",
                 "filter", "filters", "global", "missing", "significant_terms",
                 "sampler", "geohash_grid", "geotile_grid", "nested",
                 "reverse_nested", "children", "parent", "composite",
@@ -100,7 +101,7 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
             slot["subs"] = _merge_sub_metrics(node.subs, slot["subs"])
         return {"buckets": acc, "interval": parts[0]["interval"],
                 "offset": parts[0].get("offset", 0.0), "keyed_fmt": parts[0].get("keyed_fmt")}
-    if kind in ("range", "date_range", "filters", "ip_range",
+    if kind in ("range", "date_range", "geo_distance", "filters", "ip_range",
                 "adjacency_matrix"):
         acc = {}
         for p in parts:
@@ -284,7 +285,7 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
         result = {"buckets": buckets}
         _apply_bucket_pipelines(node, result, "all" if pipelines else "early")
         return result
-    if kind in ("range", "date_range"):
+    if kind in ("range", "date_range", "geo_distance"):
         buckets = []
         for key in merged["buckets"]:
             rec = merged["buckets"][key]

@@ -406,6 +406,44 @@ class LGeoBox(LNode):
 
 
 @dataclass
+class LTermsSet(LNode):
+    """terms_set: the child LTerms counts matching terms per doc; the
+    per-DOC minimum comes from a numeric column or a host-evaluated
+    script vector (reference TermsSetQueryBuilder / Lucene CoveringQuery)."""
+
+    field: str = ""
+    child: Optional[LNode] = None
+    msm_field: Optional[str] = None
+    script: Optional[Tuple[str, dict]] = None   # (source, params)
+    num_terms: int = 0
+    boost: float = 1.0
+
+
+@dataclass
+class LPinned(LNode):
+    """pinned: listed ids rank first (descending by list order), organic
+    results follow (reference PinnedQueryBuilder)."""
+
+    ids: Tuple[str, ...] = ()
+    organic: Optional[LNode] = None
+    boost: float = 1.0
+
+
+@dataclass
+class LCombined(LNode):
+    """combined_fields: true BM25F — per-term tf combined across weighted
+    fields BEFORE saturation, idf from the union doc frequency, combined
+    dl/avgdl (reference CombinedFieldsQueryBuilder over Lucene
+    CombinedFieldQuery)."""
+
+    fields: Tuple[Tuple[str, float], ...] = ()
+    terms: Tuple[str, ...] = ()
+    msm: int = 1
+    boost: float = 1.0
+    idf: Optional[np.ndarray] = None   # per-term union-df idf (rewrite-time)
+
+
+@dataclass
 class LGeoPolygon(LNode):
     """geo_polygon on geo_point columns: device ray-cast, vertex arrays are
     query params (static length per jit key)."""
@@ -612,6 +650,91 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
             dsl.parse_minimum_should_match(q.minimum_should_match, len(terms)) or 1
         mode = "score" if scoring else "score"  # scores also drive msm counts
         return _weighted_terms(field, terms, [1.0] * len(terms), ctx, msm, mode, q.boost)
+
+    if isinstance(q, dsl.MatchBoolPrefixQuery):
+        ft = m.resolve_field(q.field)
+        field = ft.name if ft else q.field
+        terms = _analyze_query_text(field, q.query, ctx, q.analyzer)
+        if not terms:
+            return LMatchNone()
+        children: List[LNode] = [
+            _weighted_terms(field, [t], [1.0], ctx, 1, "score", q.boost)
+            for t in terms[:-1]]
+        children.append(LExpandTerms(
+            field=field,
+            expander=_prefix_expander(field, terms[-1], False, cap=50),
+            boost=q.boost))
+        msm = len(children) if q.operator == "and" else 1
+        return LBool(shoulds=children, msm=msm, boost=1.0)
+
+    if isinstance(q, dsl.TermsSetQuery):
+        ft = m.resolve_field(q.field)
+        field = ft.name if ft else q.field
+        terms = [str(t) for t in q.terms]
+        if not terms:
+            return LMatchNone()
+        child = _weighted_terms(field, terms, [1.0] * len(terms), ctx, 0,
+                                "score", q.boost)
+        script = None
+        if q.minimum_should_match_script is not None:
+            src, prm = dsl.parse_script_spec(q.minimum_should_match_script)
+            try:
+                pl.parse(src)
+            except pl.ScriptError as e:
+                raise dsl.QueryParseError(f"[terms_set] bad script: {e}")
+            script = (src, prm or {})
+        return LTermsSet(field=field, child=child,
+                         msm_field=q.minimum_should_match_field,
+                         script=script, num_terms=len(terms), boost=q.boost)
+
+    if isinstance(q, dsl.CombinedFieldsQuery):
+        fspecs = []
+        for f in q.fields:
+            name, w = (f.rsplit("^", 1) if "^" in f else (f, "1"))
+            ftc = m.resolve_field(name)
+            try:
+                wf = float(w)
+            except ValueError:
+                raise dsl.QueryParseError(
+                    f"[combined_fields] bad field boost [{f}]")
+            fspecs.append((ftc.name if ftc else name, wf))
+        # analyze with the first field's analyzer (reference requires all
+        # combined fields share one analyzer and errors otherwise)
+        terms = _analyze_query_text(fspecs[0][0], q.query, ctx, None)
+        if not terms:
+            return LMatchNone()
+        msm = len(terms) if q.operator == "and" else \
+            dsl.parse_minimum_should_match(q.minimum_should_match,
+                                           len(terms)) or 1
+        node = LCombined(fields=tuple(fspecs), terms=tuple(terms), msm=msm,
+                         boost=q.boost)
+        # union-df idf depends only on shard-wide stats: compute ONCE at
+        # rewrite (like LTerms.weights), not per segment in prepare
+        n = max(ctx.num_docs, 1)
+        idf = np.zeros(len(terms), np.float32)
+        for i, t in enumerate(terms):
+            union: Optional[np.ndarray] = None
+            for fname, _w in node.fields:
+                for si, s2 in enumerate(ctx.segments):
+                    pb = s2.postings.get(fname)
+                    r = pb.row(t) if pb is not None else -1
+                    if r >= 0:
+                        a, b2 = pb.row_slice(r)
+                        ids2 = (pb.doc_ids[a:b2].astype(np.int64)
+                                + si * (1 << 32))
+                        union = ids2 if union is None else \
+                            np.union1d(union, ids2)
+            df = len(union) if union is not None else 0
+            if df > 0:
+                idf[i] = q.boost * float(
+                    np.log(1.0 + (n - df + 0.5) / (df + 0.5)))
+        node.idf = idf
+        return node
+
+    if isinstance(q, dsl.PinnedQuery):
+        return LPinned(ids=tuple(q.ids),
+                       organic=(rewrite(q.organic, ctx, scoring)
+                                if q.organic else None), boost=q.boost)
 
     if isinstance(q, dsl.MultiMatchQuery):
         if q.type in ("phrase", "phrase_prefix"):
@@ -1694,6 +1817,79 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("knn", nid, node.field, col_exists, node.similarity, fspec,
                 ann_nprobe)
 
+    if isinstance(node, LTermsSet):
+        child_spec = prepare(node.child, seg, ctx, params)
+        msm = np.full(seg.ndocs_pad, np.inf, np.float32)  # missing -> no hit
+        if node.msm_field is not None:
+            col = seg.numeric_cols.get(node.msm_field)
+            if col is not None:
+                msm[: seg.ndocs][col.present] = \
+                    col.values[col.present].astype(np.float32)
+        else:
+            src, prm = node.script
+            ast = pl.parse(src)
+            variables = {"params": {**prm, "num_terms": node.num_terms}}
+            flds = pl.referenced_doc_fields(ast)
+            if not flds:
+                # constant script ("params.num_terms - 1"): evaluate once
+                msm[:] = float(pl.execute(ast, variables))
+            else:
+                for d in range(seg.ndocs):
+                    dv = {f: pl.doc_view_for(seg, d, f) for f in flds}
+                    msm[d] = float(pl.execute(ast, {**variables, "doc": dv}))
+        _p(params, f"q{nid}_ts_msm", msm)
+        return ("terms_set", nid, child_spec)
+
+    if isinstance(node, LPinned):
+        organic_spec = (prepare(node.organic, seg, ctx, params)
+                        if node.organic is not None else None)
+        docs = []
+        ranks = []
+        for rank, i in enumerate(node.ids):
+            d = seg.id2doc.get(i)
+            if d is not None:
+                docs.append(d)
+                ranks.append(rank)
+        pad = next_pow2(max(len(docs), 1), floor=8)
+        darr = np.full(pad, INT32_SENTINEL, np.int32)
+        rarr = np.zeros(pad, np.float32)
+        darr[: len(docs)] = docs
+        rarr[: len(ranks)] = ranks
+        _p(params, f"q{nid}_pin_docs", darr)
+        _p(params, f"q{nid}_pin_ranks", rarr)
+        _scalar_f32(params, f"q{nid}_boost", node.boost)
+        return ("pinned", nid, organic_spec, pad)
+
+    if isinstance(node, LCombined):
+        T = len(node.terms)
+        T_pad = next_pow2(T, floor=1)
+        sim = ctx.sim_for(node.fields[0][0])
+        idf = np.zeros(T_pad, np.float32)
+        idf[:T] = node.idf          # computed once at rewrite time
+        fspecs = []
+        avgdl_c = 0.0
+        for fi, (fname, w) in enumerate(node.fields):
+            pb = seg.postings.get(fname)
+            rows = np.full(T_pad, -1, np.int32)
+            total = 0
+            if pb is not None:
+                for i, t in enumerate(node.terms):
+                    r = pb.row(t)
+                    rows[i] = r
+                    if r >= 0:
+                        a, b2 = pb.row_slice(r)
+                        total += b2 - a
+            _p(params, f"q{nid}_cf_rows{fi}", rows)
+            _scalar_f32(params, f"q{nid}_cf_w{fi}", w)
+            fspecs.append((fname, ops.pick_bucket(total), pb is not None))
+            avgdl_c += w * ctx.avgdl(fname)
+        _p(params, f"q{nid}_cf_idf", idf)
+        _scalar_f32(params, f"q{nid}_cf_avgdl", max(avgdl_c, 1e-6))
+        _scalar_f32(params, f"q{nid}_cf_msm", node.msm)
+        k1 = getattr(sim, "k1", 1.2)
+        b_p = getattr(sim, "b", 0.75)
+        return ("combined", nid, tuple(fspecs), T_pad, float(k1), float(b_p))
+
     if isinstance(node, LGeoDist):
         _scalar_f32(params, f"q{nid}_lat", node.lat)
         _scalar_f32(params, f"q{nid}_lon", node.lon)
@@ -1990,7 +2186,7 @@ def describe_plan(node: Optional[LNode]) -> dict:
     for attr in ("musts", "shoulds", "must_nots", "filters", "children"):
         for c in getattr(node, attr, ()) or ():
             children.append(describe_plan(c))
-    for attr in ("child", "positive", "negative", "filter"):
+    for attr in ("child", "positive", "negative", "filter", "organic"):
         c = getattr(node, attr, None)
         if isinstance(c, LNode):
             children.append(describe_plan(c))
@@ -2078,6 +2274,12 @@ def can_match(node: LNode, seg: Segment) -> bool:
         return node.positive is None or can_match(node.positive, seg)
     if isinstance(node, LFuncScore):
         return node.child is None or can_match(node.child, seg)
+    if isinstance(node, LTermsSet):
+        return node.child is None or can_match(node.child, seg)
+    if isinstance(node, LCombined):
+        return any(seg.postings.get(f) is not None
+                   and seg.postings[f].row(t) >= 0
+                   for f, _w in node.fields for t in node.terms)
     if isinstance(node, (LRankFeature, LSparseDot)):
         # feature CSRs live in seg.postings; rank_feature on a numeric
         # column falls back to numeric_cols
@@ -2543,6 +2745,61 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
             matched = matched & emit(fspec, seg_arrays, params).matched
         score = jnp.where(matched, score * params[f"q{nid}_boost"], 0.0)
         return ops.ScoredMask(score, matched.astype(jnp.float32))
+
+    if kind == "terms_set":
+        _, _, child_spec = spec
+        sm = emit(child_spec, seg_arrays, params)   # child msm=0: raw counts
+        need = jnp.maximum(params[f"q{nid}_ts_msm"], 1.0)
+        ok = (sm.count >= need) & (live > 0)
+        return ops.ScoredMask(jnp.where(ok, sm.scores, 0.0),
+                              ok.astype(jnp.float32))
+
+    if kind == "pinned":
+        _, _, organic_spec, _pad = spec
+        org = (emit(organic_spec, seg_arrays, params) if organic_spec
+               is not None else ops.ScoredMask(zeros, zeros))
+        docs = params[f"q{nid}_pin_docs"]
+        ranks = params[f"q{nid}_pin_ranks"]
+        valid = (docs >= 0) & (docs < ndocs_pad)
+        didx = jnp.where(valid, docs, ndocs_pad)
+        # pinned scores sit far above any organic BM25 score, descending in
+        # list order (reference PinnedQueryBuilder MAX_ORGANIC_SCORE). Base
+        # chosen so a rank step of 1 survives f32 (ulp(1e6) = 0.0625; at
+        # 1e9 it would be 64 and all pins would tie)
+        pin_score = jnp.where(valid, 1e6 - ranks, 0.0)
+        pins = zeros.at[didx].max(pin_score, mode="drop")
+        pinned_mask = (pins > 0) & (live > 0)
+        score = jnp.where(pinned_mask, pins,
+                          org.scores * params[f"q{nid}_boost"])
+        matched = pinned_mask | (org.matched > 0)
+        return ops.ScoredMask(jnp.where(matched, score, 0.0),
+                              matched.astype(jnp.float32))
+
+    if kind == "combined":
+        _, _, fspecs, T_pad, k1, b_p = spec
+        tfc = jnp.zeros((T_pad, ndocs_pad), jnp.float32)
+        dlc = zeros
+        any_field = False
+        for fi, (fname, bucket, has_post) in enumerate(fspecs):
+            if not has_post:
+                continue
+            any_field = True
+            post = seg_arrays["postings"][fname]
+            w = params[f"q{nid}_cf_w{fi}"]
+            tfc = tfc + w * ops.gather_tf_dense(post,
+                                                params[f"q{nid}_cf_rows{fi}"],
+                                                bucket, ndocs_pad, T_pad)
+            dlc = dlc + w * seg_arrays["doc_lens"].get(fname, zeros)
+        if not any_field:
+            return ops.ScoredMask(zeros, zeros)
+        norm = k1 * (1.0 - b_p + b_p * dlc / params[f"q{nid}_cf_avgdl"])
+        sat = tfc * (k1 + 1.0) / (tfc + norm[None, :])
+        idf = params[f"q{nid}_cf_idf"]
+        scores = jnp.sum(jnp.where(tfc > 0, idf[:, None] * sat, 0.0), axis=0)
+        counts = jnp.sum((tfc > 0).astype(jnp.float32), axis=0)
+        ok = (counts >= params[f"q{nid}_cf_msm"]) & (live > 0)
+        return ops.ScoredMask(jnp.where(ok, scores, 0.0),
+                              ok.astype(jnp.float32))
 
     if kind == "geodist":
         _, _, field, col_exists = spec
@@ -3084,6 +3341,47 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
                      for i, s in enumerate(node.subs))
         return ("range", prefix, field, tuple(keys), col_exists, subs,
                 tuple((float(lows[i]), float(highs[i])) for i in range(len(ranges))))
+
+    if kind == "geo_distance":
+        # distance-ring buckets from an origin (reference bucket/range/
+        # GeoDistanceAggregationBuilder): haversine vector on device, then
+        # the same range-count pass as the numeric range agg
+        field = _resolve_agg_field(node, ctx)
+        if "origin" not in body:
+            raise dsl.QueryParseError(
+                "[geo_distance] aggregation requires [origin]")
+        try:
+            olat, olon = dsl._parse_point(body["origin"])
+            unit_m = dsl._parse_distance(f"1{body.get('unit', 'm')}")
+        except (ValueError, TypeError, KeyError) as e:
+            raise dsl.QueryParseError(f"[geo_distance] {e}")
+        ranges = body.get("ranges", [])
+        lows = np.full(len(ranges), -np.inf, dtype=np.float32)
+        highs = np.full(len(ranges), np.inf, dtype=np.float32)
+        keys = []
+        disp = []
+        for i, r in enumerate(ranges):
+            frm, to = r.get("from"), r.get("to")
+            if frm is not None:
+                lows[i] = float(frm) * unit_m
+            if to is not None:
+                highs[i] = float(to) * unit_m
+            keys.append(r.get("key", f"{frm if frm is not None else '*'}-"
+                                     f"{to if to is not None else '*'}"))
+            disp.append((float(frm) if frm is not None else None,
+                         float(to) if to is not None else None))
+        params[f"{prefix}_lows"] = lows
+        params[f"{prefix}_highs"] = highs
+        _scalar_f32(params, f"{prefix}_olat", olat)
+        _scalar_f32(params, f"{prefix}_olon", olon)
+        subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",
+                                 nest_stack)
+                     for i, s in enumerate(node.subs))
+        return ("geo_range", prefix, field, tuple(keys),
+                field in seg.geo_cols, subs,
+                tuple((lo if lo is not None else float("-inf"),
+                       hi if hi is not None else float("inf"))
+                      for lo, hi in disp))
 
     if kind == "filter":
         lnode = rewrite(dsl.parse_query(body), ctx, scoring=False)
@@ -3758,6 +4056,27 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match, scores=None):  # noqa:
             hi = params[f"{prefix}_highs"][ri]
             bucket_match = match * ((col["f32"] >= lo) & (col["f32"] < hi) &
                                     col["present"]).astype(jnp.float32)
+            for i, sub in enumerate(subs):
+                res = emit_agg(sub, seg_arrays, params, bucket_match, scores)
+                if res:
+                    out[f"r{ri}_sub{i}"] = res
+        return out
+
+    if kind == "geo_range":
+        _, prefix, field, keys, col_exists, subs, _disp = spec
+        if not col_exists:
+            return {}
+        geo = seg_arrays["geo"][field]
+        dist = ops.geo_distance_vec(geo, params[f"{prefix}_olat"],
+                                    params[f"{prefix}_olon"])
+        out = {"counts": agg_ops.range_counts(dist, geo["present"], match,
+                                              params[f"{prefix}_lows"],
+                                              params[f"{prefix}_highs"])}
+        for ri in range(len(keys)):
+            lo = params[f"{prefix}_lows"][ri]
+            hi = params[f"{prefix}_highs"][ri]
+            bucket_match = match * ((dist >= lo) & (dist < hi) &
+                                    geo["present"]).astype(jnp.float32)
             for i, sub in enumerate(subs):
                 res = emit_agg(sub, seg_arrays, params, bucket_match, scores)
                 if res:

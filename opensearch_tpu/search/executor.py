@@ -1130,6 +1130,24 @@ def _refine_complex_subs(searchers: List[ShardSearcher], body: dict,
                                      bucket.get(s.name), query,
                                      filters + [{"range": {field: rng}}])
         return
+    if kind == "geo_distance":
+        field = node.body.get("field")
+        origin = node.body.get("origin")
+        unit = node.body.get("unit", "m")
+        for bucket in (result.get("buckets") or []):
+            flt: List[dict] = []
+            if bucket.get("to") is not None:
+                flt.append({"geo_distance": {
+                    "distance": f"{bucket['to']}{unit}", field: origin}})
+            if bucket.get("from") is not None:
+                flt.append({"bool": {"must_not": [{"geo_distance": {
+                    "distance": f"{bucket['from']}{unit}",
+                    field: origin}}]}})
+            for s in node.subs:
+                _refine_complex_subs(searchers, body, index_name, s,
+                                     bucket.get(s.name), query,
+                                     filters + flt)
+        return
     if kind == "global":
         for s in node.subs:
             _refine_complex_subs(searchers, body, index_name, s,
@@ -1463,8 +1481,8 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
         return _hist_partial(node, device_out, min_b, float(interval_ms),
                              float(offset_ms))
 
-    if kind == "range":
-        _, prefix, f, keys, col_exists, subs, bounds = aspec
+    if kind in ("range", "geo_range"):
+        _, prefix, f, keys, col_exists, subs, bounds = aspec[:7]
         counts = np.asarray(device_out["counts"])
         buckets = {}
         for ri, key in enumerate(keys):

@@ -269,6 +269,42 @@ class GeoBoundingBoxQuery(Query):
 
 
 @dataclass
+class TermsSetQuery(Query):
+    """terms_set: per-DOC minimum_should_match from a numeric field or a
+    script (reference TermsSetQueryBuilder.java)."""
+
+    field: str = ""
+    terms: List[Any] = dc_field(default_factory=list)
+    minimum_should_match_field: Optional[str] = None
+    minimum_should_match_script: Optional[Any] = None
+
+
+@dataclass
+class MatchBoolPrefixQuery(Query):
+    field: str = ""
+    query: Any = None
+    operator: str = "or"
+    analyzer: Optional[str] = None
+
+
+@dataclass
+class CombinedFieldsQuery(Query):
+    """combined_fields: BM25F over weighted fields — combined tf/dl on
+    device, union df for the idf (reference CombinedFieldsQueryBuilder)."""
+
+    query: Any = None
+    fields: List[str] = dc_field(default_factory=list)
+    operator: str = "or"
+    minimum_should_match: Optional[str] = None
+
+
+@dataclass
+class PinnedQuery(Query):
+    ids: List[str] = dc_field(default_factory=list)
+    organic: Optional[Query] = None
+
+
+@dataclass
 class GeoPolygonQuery(Query):
     field: str = ""
     # vertex lists, parallel (lat[i], lon[i])
@@ -531,6 +567,62 @@ def parse_query(dsl: Optional[dict]) -> Query:
             _common(q, spec)
         else:
             q = MatchPhraseQuery(field=f, query=spec, prefix=prefix)
+        return q
+
+    if kind == "terms_set":
+        f, spec = _one_entry(body, "terms_set")
+        if not isinstance(spec, dict) or "terms" not in spec:
+            raise QueryParseError("[terms_set] requires [terms]")
+        msf = spec.get("minimum_should_match_field")
+        mss = spec.get("minimum_should_match_script")
+        if msf is None and mss is None:
+            raise QueryParseError(
+                "[terms_set] requires [minimum_should_match_field] or "
+                "[minimum_should_match_script]")
+        q = TermsSetQuery(field=f, terms=list(spec["terms"]),
+                          minimum_should_match_field=msf,
+                          minimum_should_match_script=mss)
+        _common(q, spec)
+        return q
+
+    if kind == "match_bool_prefix":
+        f, spec = _one_entry(body, "match_bool_prefix")
+        if isinstance(spec, dict):
+            q = MatchBoolPrefixQuery(field=f, query=spec.get("query"),
+                                     operator=str(spec.get("operator",
+                                                           "or")).lower(),
+                                     analyzer=spec.get("analyzer"))
+            _common(q, spec)
+        else:
+            q = MatchBoolPrefixQuery(field=f, query=spec)
+        return q
+
+    if kind == "combined_fields":
+        q = CombinedFieldsQuery(query=body.get("query"),
+                                fields=list(body.get("fields", [])),
+                                operator=str(body.get("operator",
+                                                      "or")).lower(),
+                                minimum_should_match=body.get(
+                                    "minimum_should_match"))
+        if not q.fields:
+            raise QueryParseError("[combined_fields] requires [fields]")
+        _common(q, body)
+        return q
+
+    if kind == "wrapper":
+        import base64
+        import json as _json
+        try:
+            inner = _json.loads(base64.b64decode(body["query"]))
+        except Exception as e:
+            raise QueryParseError(f"[wrapper] cannot decode query: {e}")
+        return parse_query(inner)
+
+    if kind == "pinned":
+        organic = body.get("organic")
+        q = PinnedQuery(ids=[str(i) for i in body.get("ids", [])],
+                        organic=parse_query(organic) if organic else None)
+        _common(q, body)
         return q
 
     if kind == "span_term":

@@ -1,0 +1,190 @@
+"""terms_set, match_bool_prefix, combined_fields (BM25F), wrapper, pinned
+queries + geo_distance aggregation.
+
+References: TermsSetQueryBuilder.java, MatchBoolPrefixQueryBuilder.java,
+CombinedFieldsQueryBuilder.java, WrapperQueryBuilder.java,
+PinnedQueryBuilder.java, bucket/range/GeoDistanceAggregationBuilder.java."""
+
+import base64
+import json
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture
+def client():
+    c = RestClient()
+    c.indices.create("nq", body={"mappings": {"properties": {
+        "codes": {"type": "keyword"},
+        "required": {"type": "integer"},
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "loc": {"type": "geo_point"}}}})
+    docs = [
+        {"codes": ["a", "b", "c"], "required": 2,
+         "title": "quick brown fox", "body": "lazy dog",
+         "loc": {"lat": 0.0, "lon": 0.0}},
+        {"codes": ["a"], "required": 2,
+         "title": "quick start guide", "body": "install quick tools",
+         "loc": {"lat": 0.0, "lon": 1.0}},
+        {"codes": ["b", "c"], "required": 1,
+         "title": "slow cooker", "body": "brown stew fox",
+         "loc": {"lat": 0.0, "lon": 3.0}},
+        {"codes": ["a", "b"], "required": 3,
+         "title": "fox hunting", "body": "quick quick quick",
+         "loc": {"lat": 45.0, "lon": 90.0}},
+    ]
+    for i, d in enumerate(docs):
+        c.index("nq", d, id=str(i))
+    c.indices.refresh("nq")
+    return c
+
+
+def _ids(r):
+    return {h["_id"] for h in r["hits"]["hits"]}
+
+
+class TestTermsSet:
+    def test_msm_field(self, client):
+        r = client.search("nq", {"query": {"terms_set": {"codes": {
+            "terms": ["a", "b", "c"],
+            "minimum_should_match_field": "required"}}}})
+        # doc0: 3 matches >= 2 OK; doc1: 1 >= 2 no; doc2: 2 >= 1 OK;
+        # doc3: 2 >= 3 no
+        assert _ids(r) == {"0", "2"}
+
+    def test_msm_constant_script(self, client):
+        r = client.search("nq", {"query": {"terms_set": {"codes": {
+            "terms": ["a", "b", "c"],
+            "minimum_should_match_script": {
+                "source": "params.num_terms - 1"}}}}})
+        # need >= 2 matches: doc0 (3), doc2 (2), doc3 (2)
+        assert _ids(r) == {"0", "2", "3"}
+
+    def test_msm_doc_script(self, client):
+        r = client.search("nq", {"query": {"terms_set": {"codes": {
+            "terms": ["a", "b", "c"],
+            "minimum_should_match_script": {
+                "source": "doc['required'].value"}}}}})
+        assert _ids(r) == {"0", "2"}
+
+    def test_validation_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("nq", {"query": {"terms_set": {"codes": {
+                "terms": ["a"]}}}})
+        assert ei.value.status == 400
+
+
+class TestMatchBoolPrefix:
+    def test_last_term_prefix(self, client):
+        r = client.search("nq", {"query": {"match_bool_prefix": {
+            "title": "quick br"}}})
+        # "quick" OR prefix "br": doc0 (quick+brown), doc1 (quick)
+        assert "0" in _ids(r) and "1" in _ids(r)
+
+    def test_operator_and(self, client):
+        r = client.search("nq", {"query": {"match_bool_prefix": {
+            "title": {"query": "quick br", "operator": "and"}}}})
+        assert _ids(r) == {"0"}
+
+
+class TestCombinedFields:
+    def test_union_semantics(self, client):
+        r = client.search("nq", {"query": {"combined_fields": {
+            "query": "quick", "fields": ["title", "body"]}}})
+        # quick in title (0,1) or body (1,3)
+        assert _ids(r) == {"0", "1", "3"}
+
+    def test_weighted_field_changes_ranking(self, client):
+        r1 = client.search("nq", {"query": {"combined_fields": {
+            "query": "quick", "fields": ["title^5", "body"]}}})
+        r2 = client.search("nq", {"query": {"combined_fields": {
+            "query": "quick", "fields": ["title", "body^5"]}}})
+        # body-heavy weighting favors doc3 (3x quick in body)
+        assert r2["hits"]["hits"][0]["_id"] == "3"
+        assert r1["hits"]["hits"][0]["_id"] != "3"
+
+    def test_operator_and(self, client):
+        r = client.search("nq", {"query": {"combined_fields": {
+            "query": "quick fox", "fields": ["title", "body"],
+            "operator": "and"}}})
+        # needs both terms across the combined field: doc0 (t+t),
+        # doc3 (title fox + body quick)
+        assert _ids(r) == {"0", "3"}
+
+    def test_requires_fields(self, client):
+        with pytest.raises(ApiError):
+            client.search("nq", {"query": {"combined_fields": {
+                "query": "x"}}})
+
+
+class TestWrapperAndPinned:
+    def test_wrapper(self, client):
+        inner = base64.b64encode(
+            json.dumps({"term": {"codes": "a"}}).encode()).decode()
+        r = client.search("nq", {"query": {"wrapper": {"query": inner}}})
+        assert _ids(r) == {"0", "1", "3"}
+
+    def test_wrapper_bad_payload_400(self, client):
+        with pytest.raises(ApiError):
+            client.search("nq", {"query": {"wrapper": {"query": "!!!"}}})
+
+    def test_pinned(self, client):
+        r = client.search("nq", {"query": {"pinned": {
+            "ids": ["2", "1"],
+            "organic": {"match": {"title": "quick"}}}}})
+        got = [h["_id"] for h in r["hits"]["hits"]]
+        assert got[:2] == ["2", "1"]          # pinned order wins
+        assert set(got[2:]) == {"0"}           # organic follows (doc1 pinned)
+
+    def test_pinned_no_organic(self, client):
+        r = client.search("nq", {"query": {"pinned": {"ids": ["3"]}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["3"]
+
+
+class TestGeoDistanceAgg:
+    def test_rings(self, client):
+        r = client.search("nq", {"size": 0, "aggs": {"rings": {
+            "geo_distance": {"field": "loc",
+                             "origin": {"lat": 0, "lon": 0},
+                             "unit": "km",
+                             "ranges": [{"to": 200},
+                                        {"from": 200, "to": 1000},
+                                        {"from": 1000}]}}}})
+        buckets = r["aggregations"]["rings"]["buckets"]
+        assert [b["doc_count"] for b in buckets] == [2, 1, 1]
+        assert buckets[1]["from"] == 200 and buckets[1]["to"] == 1000
+
+    def test_sub_metric(self, client):
+        r = client.search("nq", {"size": 0, "aggs": {"rings": {
+            "geo_distance": {"field": "loc",
+                             "origin": "0,0", "unit": "km",
+                             "ranges": [{"to": 500}]},
+            "aggs": {"mx": {"max": {"field": "required"}}}}}})
+        b = r["aggregations"]["rings"]["buckets"][0]
+        assert b["doc_count"] == 3     # docs at 0, ~111km, ~333km
+        assert b["mx"]["value"] == 2.0
+
+
+class TestReviewRegressions:
+    def test_combined_fields_bad_boost_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("nq", {"query": {"combined_fields": {
+                "query": "fox", "fields": ["title^bad"]}}})
+        assert ei.value.status == 400
+
+    def test_geo_distance_agg_missing_origin_400(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.search("nq", {"size": 0, "aggs": {"x": {
+                "geo_distance": {"field": "loc",
+                                 "ranges": [{"to": 100}]}}}})
+        assert ei.value.status == 400
+
+    def test_pinned_profile_shows_organic(self, client):
+        r = client.search("nq", {"profile": True, "query": {"pinned": {
+            "ids": ["1"], "organic": {"match": {"title": "quick"}}}}})
+        q = r["profile"]["shards"][0]["searches"][0]["query"][0]
+        assert q["type"] == "Pinned"
+        assert any(c["type"] == "Terms" for c in q.get("children", []))
