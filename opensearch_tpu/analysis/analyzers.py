@@ -128,6 +128,44 @@ class AnalysisRegistry:
             tokenizer = resolve_tokenizer(tok_custom["type"], tok_custom)
         else:
             tokenizer = resolve_tokenizer(tok_name)
-        filters = [self._resolve_filter(f) for f in cfg.get("filter", [])]
+        filters = self._build_filter_chain(cfg.get("filter", []))
         chars = [self._resolve_char(f) for f in cfg.get("char_filter", [])]
         return Analyzer(name, tokenizer, filters, chars)
+
+    def _build_filter_chain(self, names: list) -> List[TokenFilter]:
+        """Resolve the filter list, fusing keyword_marker keywords into a
+        following stemmer (tokens are plain tuples — the 'keyword' flag the
+        reference carries on attributes becomes a closure over the
+        protected set instead)."""
+        from .filters import make_keyword_marker_stemmer
+        protected: set = set()
+        overrides: dict = {}
+        out: List[TokenFilter] = []
+        for fname in names:
+            custom = self._settings.get("filter", {}).get(fname)
+            ftype = custom["type"] if custom is not None else fname
+            fparams = custom if custom is not None else {}
+            if ftype == "keyword_marker":
+                protected |= set(fparams.get("keywords", []))
+                continue
+            if ftype == "stemmer_override":
+                # overridden outputs must NOT be re-stemmed by a following
+                # stemmer (reference StemmerOverrideFilter keyword attr)
+                for r in fparams.get("rules", []):
+                    if "=>" in r:
+                        src, dst = r.split("=>", 1)
+                        overrides[src.strip()] = dst.strip()
+                continue
+            if ftype in ("stemmer", "porter_stem") and (protected
+                                                        or overrides):
+                out.append(make_keyword_marker_stemmer(sorted(protected),
+                                                       overrides))
+                protected, overrides = set(), {}
+                continue
+            out.append(resolve_token_filter(ftype, fparams))
+        if overrides:
+            # stemmer_override with no following stemmer: plain mapping
+            from .filters import make_stemmer_override_filter
+            out.append(make_stemmer_override_filter(
+                [f"{k} => {v}" for k, v in overrides.items()]))
+        return out

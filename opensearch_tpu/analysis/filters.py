@@ -8,7 +8,7 @@ from __future__ import annotations
 
 import re
 import unicodedata
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional
 
 from .porter import porter_stem
 from .tokenizers import Token
@@ -158,6 +158,216 @@ def make_pattern_replace_char_filter(pattern: str, replacement: str = "") -> Cha
     return lambda text: compiled.sub(replacement, text)
 
 
+def make_word_delimiter_filter(generate_word_parts: bool = True,
+                               generate_number_parts: bool = True,
+                               catenate_words: bool = False,
+                               catenate_numbers: bool = False,
+                               catenate_all: bool = False,
+                               preserve_original: bool = False,
+                               split_on_case_change: bool = True,
+                               split_on_numerics: bool = True) -> TokenFilter:
+    """word_delimiter(_graph): split on intra-word delimiters, case
+    transitions and letter/number transitions (reference analysis-common
+    WordDelimiterGraphFilterFactory; graph vs non-graph is a position
+    bookkeeping difference — both forms split identically here)."""
+
+    def split(text: str) -> List[str]:
+        runs: List[str] = []
+        cur = ""
+        prev_kind = ""
+        for ch in text:
+            if ch.isalpha():
+                kind = "u" if ch.isupper() else "l"
+            elif ch.isdigit():
+                kind = "d"
+            else:
+                kind = ""
+            if not kind:
+                if cur:
+                    runs.append(cur)
+                cur = ""
+                prev_kind = ""
+                continue
+            boundary = False
+            if cur:
+                if split_on_case_change and prev_kind == "l" and kind == "u":
+                    boundary = True
+                if split_on_numerics and prev_kind != kind \
+                        and "d" in (prev_kind, kind):
+                    boundary = True
+            if boundary:
+                runs.append(cur)
+                cur = ch
+            else:
+                cur += ch
+            prev_kind = kind
+        if cur:
+            runs.append(cur)
+        return runs
+
+    def f(tokens: List[Token]) -> List[Token]:
+        out: List[Token] = []
+        for t in tokens:
+            parts = split(t.text)
+            kept = [p for p in parts
+                    if (generate_word_parts and not p.isdigit())
+                    or (generate_number_parts and p.isdigit())]
+            emitted = []
+            if preserve_original or not kept:
+                emitted.append(t.text)
+            emitted.extend(kept)
+            if catenate_all and len(parts) > 1:
+                emitted.append("".join(parts))
+            elif catenate_words and len(parts) > 1 \
+                    and all(not p.isdigit() for p in parts):
+                emitted.append("".join(parts))
+            elif catenate_numbers and len(parts) > 1 \
+                    and all(p.isdigit() for p in parts):
+                emitted.append("".join(parts))
+            seen = set()
+            for e in emitted:
+                if e and e not in seen:
+                    seen.add(e)
+                    out.append(Token(e, t.position, t.start_offset,
+                                     t.end_offset))
+        return out
+    return f
+
+
+def make_pattern_capture_filter(patterns: List[str],
+                                preserve_original: bool = True
+                                ) -> TokenFilter:
+    compiled = [re.compile(p) for p in patterns]
+
+    def f(tokens: List[Token]) -> List[Token]:
+        out: List[Token] = []
+        for t in tokens:
+            emitted = [t.text] if preserve_original else []
+            for pat in compiled:
+                for m in pat.finditer(t.text):
+                    if m.groups():
+                        emitted.extend(g for g in m.groups() if g)
+                    else:
+                        emitted.append(m.group(0))
+            seen = set()
+            for e in emitted:
+                if e and e not in seen:
+                    seen.add(e)
+                    out.append(Token(e, t.position, t.start_offset,
+                                     t.end_offset))
+        return out
+    return f
+
+
+_ELISION_DEFAULT = ["l", "m", "t", "qu", "n", "s", "j"]
+
+
+def make_elision_filter(articles=None) -> TokenFilter:
+    arts = tuple(a.lower() + "'" for a in (articles or _ELISION_DEFAULT))
+
+    def f(tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            text = t.text
+            low = text.lower().replace("’", "'")
+            for a in arts:
+                if low.startswith(a):
+                    text = text[len(a):]
+                    break
+            if text:
+                out.append(Token(text, t.position, t.start_offset,
+                                 t.end_offset))
+        return out
+    return f
+
+
+def make_ngram_token_filter(min_gram: int = 1, max_gram: int = 2
+                            ) -> TokenFilter:
+    def f(tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            for n in range(min_gram, max_gram + 1):
+                for i in range(0, max(len(t.text) - n + 1, 0)):
+                    out.append(Token(t.text[i:i + n], t.position,
+                                     t.start_offset, t.end_offset))
+        return out
+    return f
+
+
+def make_edge_ngram_token_filter(min_gram: int = 1, max_gram: int = 2
+                                 ) -> TokenFilter:
+    def f(tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            for n in range(min_gram, min(max_gram, len(t.text)) + 1):
+                out.append(Token(t.text[:n], t.position, t.start_offset,
+                                 t.end_offset))
+        return out
+    return f
+
+
+def make_keyword_marker_stemmer(keywords: List[str],
+                                overrides: Optional[dict] = None
+                                ) -> TokenFilter:
+    """keyword_marker + stemmer_override semantics fused with the stemmer:
+    marked words skip stemming; override rules map and then skip stemming
+    (reference sets the keyword attribute for both, which the stemmer
+    honors — tokens here are plain tuples, so the flag becomes a closure)."""
+    kw = frozenset(keywords)
+    table = dict(overrides or {})
+
+    def f(tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            if t.text in table:
+                out.append(Token(table[t.text], t.position, t.start_offset,
+                                 t.end_offset))
+            elif t.text in kw:
+                out.append(t)
+            else:
+                out.append(Token(porter_stem(t.text), t.position,
+                                 t.start_offset, t.end_offset))
+        return out
+    return f
+
+
+def make_stemmer_override_filter(rules: List[str]) -> TokenFilter:
+    """"running => run" rules applied before/instead of the stemmer."""
+    table = {}
+    for r in rules:
+        if "=>" in r:
+            src, dst = r.split("=>", 1)
+            table[src.strip()] = dst.strip()
+
+    def f(tokens: List[Token]) -> List[Token]:
+        return [Token(table.get(t.text, t.text), t.position, t.start_offset,
+                      t.end_offset) for t in tokens]
+    return f
+
+
+def make_limit_filter(max_token_count: int = 1) -> TokenFilter:
+    return lambda tokens: tokens[:max_token_count]
+
+
+def decimal_digit_filter(tokens: List[Token]) -> List[Token]:
+    """Fold unicode digits to latin 0-9 (reference DecimalDigitFilter)."""
+    def fold(s: str) -> str:
+        return "".join(str(unicodedata.digit(c)) if c.isdigit() else c
+                       for c in s)
+    return [Token(fold(t.text), t.position, t.start_offset, t.end_offset)
+            for t in tokens]
+
+
+def apostrophe_filter(tokens: List[Token]) -> List[Token]:
+    """Strip everything after an apostrophe (reference ApostropheFilter)."""
+    out = []
+    for t in tokens:
+        text = t.text.split("'")[0].split("’")[0]
+        if text:
+            out.append(Token(text, t.position, t.start_offset, t.end_offset))
+    return out
+
+
 def resolve_token_filter(name: str, params: dict | None = None) -> TokenFilter:
     params = params or {}
     simple: Dict[str, TokenFilter] = {
@@ -169,9 +379,45 @@ def resolve_token_filter(name: str, params: dict | None = None) -> TokenFilter:
         "trim": trim_filter,
         "unique": unique_filter,
         "reverse": reverse_filter,
+        "decimal_digit": decimal_digit_filter,
+        "apostrophe": apostrophe_filter,
+        "flatten_graph": lambda tokens: tokens,  # positions already linear
     }
     if name in simple:
         return simple[name]
+    if name in ("word_delimiter", "word_delimiter_graph"):
+        return make_word_delimiter_filter(
+            generate_word_parts=params.get("generate_word_parts", True),
+            generate_number_parts=params.get("generate_number_parts", True),
+            catenate_words=params.get("catenate_words", False),
+            catenate_numbers=params.get("catenate_numbers", False),
+            catenate_all=params.get("catenate_all", False),
+            preserve_original=params.get("preserve_original", False),
+            split_on_case_change=params.get("split_on_case_change", True),
+            split_on_numerics=params.get("split_on_numerics", True))
+    if name == "pattern_capture":
+        return make_pattern_capture_filter(
+            params.get("patterns", []),
+            params.get("preserve_original", True))
+    if name == "elision":
+        return make_elision_filter(params.get("articles"))
+    if name == "ngram":
+        return make_ngram_token_filter(int(params.get("min_gram", 1)),
+                                       int(params.get("max_gram", 2)))
+    if name == "edge_ngram":
+        return make_edge_ngram_token_filter(int(params.get("min_gram", 1)),
+                                            int(params.get("max_gram", 2)))
+    if name == "keyword_marker":
+        # marking carries no token state here; the analyzer chain builder
+        # fuses a preceding keyword_marker into the following stemmer
+        # (make_keyword_marker_stemmer) — standalone it is an identity
+        return lambda tokens: tokens
+    if name == "stemmer_override":
+        return make_stemmer_override_filter(params.get("rules", []))
+    if name == "limit":
+        return make_limit_filter(int(params.get("max_token_count", 1)))
+    if name == "synonym_graph":
+        return make_synonym_filter(params.get("synonyms", []))
     if name == "stop":
         sw = params.get("stopwords", "_english_")
         return make_stop_filter(ENGLISH_STOPWORDS if sw == "_english_" else sw)

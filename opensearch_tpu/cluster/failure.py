@@ -1,0 +1,79 @@
+"""Device failure detection: deterministic heartbeat over the device set.
+
+Reference analog: `cluster/coordination/FollowersChecker.java` /
+`LeaderChecker.java` — periodic pings with a consecutive-failure threshold
+before a node is removed. Here the "followers" are accelerator chips: a
+probe runs one tiny device computation AND FETCHES it (under the tunnel,
+only a fetch proves the chip answered — a dispatched-but-unfetched op can
+hang silently). The caller owns the clock: `tick()` is one heartbeat round
+(a cron wrapper recovers the reference's scheduler), so tests and the
+driver get reproducible failure sequences.
+
+After `failure_threshold` CONSECUTIVE probe failures a device is declared
+dead: every IndexService re-allocates its copies (promote surviving
+replicas, rebuild moved ones — IndexService.fail_device), matching the
+reference's allocation response to a left node."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+
+def default_prober(device) -> bool:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    try:
+        out = jax.device_put(jnp.ones((8,), jnp.float32), device)
+        return bool(np.asarray(out + 1.0).sum() == 16.0)
+    except Exception:
+        return False
+
+
+class FailureDetector:
+    def __init__(self, node, failure_threshold: int = 3,
+                 prober: Optional[Callable] = None):
+        self.node = node
+        self.failure_threshold = failure_threshold
+        self.prober = prober or default_prober
+        self.consecutive: Dict[int, int] = {}
+        self.dead: set = set()
+        self.rounds = 0
+        self.last_tick: Optional[float] = None
+
+    def _devices(self) -> List:
+        import jax
+        return list(jax.devices())
+
+    def tick(self) -> List[dict]:
+        """One heartbeat round over live devices. Returns the events."""
+        self.rounds += 1
+        self.last_tick = time.time()
+        events: List[dict] = []
+        for ordinal, dev in enumerate(self._devices()):
+            if ordinal in self.dead:
+                continue
+            ok = bool(self.prober(dev))
+            if ok:
+                if self.consecutive.get(ordinal):
+                    events.append({"device": ordinal, "event": "recovered",
+                                   "after_failures":
+                                       self.consecutive[ordinal]})
+                self.consecutive[ordinal] = 0
+                continue
+            self.consecutive[ordinal] = self.consecutive.get(ordinal, 0) + 1
+            events.append({"device": ordinal, "event": "probe_failed",
+                           "consecutive": self.consecutive[ordinal]})
+            if self.consecutive[ordinal] >= self.failure_threshold:
+                self.dead.add(ordinal)
+                events.append({"device": ordinal, "event": "failed"})
+                for svc in self.node.indices.values():
+                    svc.fail_device(ordinal)
+        return events
+
+    def stats(self) -> dict:
+        return {"rounds": self.rounds, "dead_devices": sorted(self.dead),
+                "failure_threshold": self.failure_threshold,
+                "suspect": {str(k): v for k, v in self.consecutive.items()
+                            if v > 0}}

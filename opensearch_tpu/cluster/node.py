@@ -286,6 +286,8 @@ class Node:
         self.lifecycle = LifecycleService(self)
         from ..utils.trace import TRACER
         self.tracer = TRACER
+        from .failure import FailureDetector
+        self.failure_detector = FailureDetector(self)
         # node-level op counters (reference NodeIndicesStats rollup)
         self.op_counters = {"search_total": 0, "search_time_ms": 0.0,
                             "get_total": 0, "index_total": 0,
@@ -575,6 +577,7 @@ class Node:
             "tasks": self.tasks.stats(),
             "thread_pool": self.thread_pools.stats(),
             "search_pipelines": self.search_pipelines.stats(),
+            "failure_detection": self.failure_detector.stats(),
             "wlm": self.wlm.stats(),
             "uptime_in_millis": int((time.time() - self.start_time) * 1000),
         }

@@ -256,7 +256,8 @@ def geo_distance_vec(geo: dict, lat: jnp.ndarray,
 
 
 def geo_distance_mask(geo: dict, lat: jnp.ndarray, lon: jnp.ndarray,
-                      radius_m: jnp.ndarray) -> jnp.ndarray:
+                      radius_m: jnp.ndarray,
+                      inclusive: bool = True) -> jnp.ndarray:
     """Haversine distance filter on the VPU (reference GeoDistanceQuery)."""
     r = 6371008.8
     p1 = jnp.deg2rad(geo["lat"])
@@ -265,7 +266,7 @@ def geo_distance_mask(geo: dict, lat: jnp.ndarray, lon: jnp.ndarray,
     dlmb = jnp.deg2rad(lon - geo["lon"])
     a = jnp.sin(dphi / 2) ** 2 + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2) ** 2
     d = 2 * r * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
-    return (d <= radius_m) & geo["present"]
+    return ((d <= radius_m) if inclusive else (d < radius_m)) & geo["present"]
 
 
 # ---------------- scatter-free sort-merge scoring ----------------

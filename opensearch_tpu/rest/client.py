@@ -1394,3 +1394,55 @@ class CatClient:
                  "running_time": str(t["running_time_in_nanos"]),
                  "cancellable": str(t["cancellable"]).lower()}
                 for t in self.c.node.tasks.list()]
+
+    def nodes(self, format: str = "json") -> List[dict]:
+        stats = self.c.nodes_stats()["nodes"][self.c.node.node_name]
+        return [{"name": self.c.node.node_name,
+                 "node.role": "".join(r[0] for r in stats["roles"]),
+                 "master": "*",
+                 "segments.count": str(stats["indices"]["segments"]["count"]),
+                 "docs.count": str(stats["indices"]["docs"]["count"])}]
+
+    def health(self, format: str = "json") -> List[dict]:
+        h = self.c.cluster.health()
+        return [{"epoch": str(int(time.time())),
+                 "cluster": h["cluster_name"], "status": h["status"],
+                 "node.total": str(h["number_of_nodes"]),
+                 "shards": str(h["active_shards"]),
+                 "pri": str(h["active_primary_shards"]),
+                 "unassign": str(h["unassigned_shards"])}]
+
+    def segments(self, index: str = "_all",
+                 format: str = "json") -> List[dict]:
+        out = []
+        for n in sorted(self.c.node.metadata.resolve(index)):
+            svc = self.c.node.indices[n]
+            for si, sh in enumerate(svc.shards):
+                for seg in sh.segments:
+                    out.append({"index": n, "shard": str(si),
+                                "prirep": "p", "segment": seg.name,
+                                "docs.count": str(seg.live_count),
+                                "docs.deleted":
+                                    str(seg.ndocs - seg.live_count)})
+        return out
+
+    def aliases(self, format: str = "json") -> List[dict]:
+        out = []
+        for alias, am in sorted(self.c.node.metadata.aliases.items()):
+            for idx, cfg in sorted(am.indices.items()):
+                out.append({"alias": alias, "index": idx,
+                            "is_write_index":
+                                str(cfg.get("is_write_index",
+                                            False)).lower()})
+        return out
+
+    def templates(self, format: str = "json") -> List[dict]:
+        return [{"name": name,
+                 "index_patterns": str(t.get("index_patterns", [])),
+                 "order": str(t.get("order", t.get("priority", 0)))}
+                for name, t in sorted(
+                    self.c.node.metadata.templates.items())]
+
+    def allocation(self, format: str = "json") -> List[dict]:
+        shards = sum(len(svc.shards) for svc in self.c.node.indices.values())
+        return [{"node": self.c.node.node_name, "shards": str(shards)}]

@@ -393,6 +393,7 @@ class LGeoDist(LNode):
     lon: float = 0.0
     radius_m: float = 0.0
     boost: float = 1.0
+    inclusive: bool = True
 
 
 @dataclass
@@ -713,18 +714,21 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         n = max(ctx.num_docs, 1)
         idf = np.zeros(len(terms), np.float32)
         for i, t in enumerate(terms):
-            union: Optional[np.ndarray] = None
-            for fname, _w in node.fields:
-                for si, s2 in enumerate(ctx.segments):
+            # segments have disjoint doc-id spaces: union WITHIN each
+            # segment across fields, then sum the sizes
+            df = 0
+            for s2 in ctx.segments:
+                seg_lists = []
+                for fname, _w in node.fields:
                     pb = s2.postings.get(fname)
                     r = pb.row(t) if pb is not None else -1
                     if r >= 0:
                         a, b2 = pb.row_slice(r)
-                        ids2 = (pb.doc_ids[a:b2].astype(np.int64)
-                                + si * (1 << 32))
-                        union = ids2 if union is None else \
-                            np.union1d(union, ids2)
-            df = len(union) if union is not None else 0
+                        seg_lists.append(pb.doc_ids[a:b2])
+                if len(seg_lists) == 1:
+                    df += len(seg_lists[0])
+                elif seg_lists:
+                    df += len(np.unique(np.concatenate(seg_lists)))
             if df > 0:
                 idf[i] = q.boost * float(
                     np.log(1.0 + (n - df + 0.5) / (df + 0.5)))
@@ -915,7 +919,7 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
 
     if isinstance(q, dsl.GeoDistanceQuery):
         return LGeoDist(field=q.field, lat=q.lat, lon=q.lon, radius_m=q.distance_m,
-                        boost=q.boost)
+                        boost=q.boost, inclusive=q.inclusive)
     if isinstance(q, dsl.GeoBoundingBoxQuery):
         return LGeoBox(field=q.field, top=q.top, left=q.left, bottom=q.bottom,
                        right=q.right, boost=q.boost)
@@ -1895,7 +1899,8 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         _scalar_f32(params, f"q{nid}_lon", node.lon)
         _scalar_f32(params, f"q{nid}_rad", node.radius_m)
         _scalar_f32(params, f"q{nid}_boost", node.boost)
-        return ("geodist", nid, node.field, node.field in seg.geo_cols)
+        return ("geodist", nid, node.field, node.field in seg.geo_cols,
+                node.inclusive)
 
     if isinstance(node, LGeoBox):
         for k, v in (("top", node.top), ("left", node.left),
@@ -2793,7 +2798,10 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         if not any_field:
             return ops.ScoredMask(zeros, zeros)
         norm = k1 * (1.0 - b_p + b_p * dlc / params[f"q{nid}_cf_avgdl"])
-        sat = tfc * (k1 + 1.0) / (tfc + norm[None, :])
+        # LUCENE-8563 form (no (k1+1) factor) — every other scoring path
+        # here uses it, so combined_fields stays rank-commensurate in
+        # mixed bool queries
+        sat = tfc / (tfc + norm[None, :])
         idf = params[f"q{nid}_cf_idf"]
         scores = jnp.sum(jnp.where(tfc > 0, idf[:, None] * sat, 0.0), axis=0)
         counts = jnp.sum((tfc > 0).astype(jnp.float32), axis=0)
@@ -2802,12 +2810,13 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
                               ok.astype(jnp.float32))
 
     if kind == "geodist":
-        _, _, field, col_exists = spec
+        _, _, field, col_exists, inclusive = spec
         if not col_exists:
             return ops.ScoredMask(zeros, zeros)
         geo = seg_arrays["geo"][field]
         mask = ops.geo_distance_mask(geo, params[f"q{nid}_lat"], params[f"q{nid}_lon"],
-                                     params[f"q{nid}_rad"]) & (live > 0)
+                                     params[f"q{nid}_rad"],
+                                     inclusive=inclusive) & (live > 0)
         m = mask.astype(jnp.float32)
         return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
 

@@ -571,7 +571,11 @@ class ShardSearcher:
                 frags = []
                 analyzer = self.engine.mappings.index_analyzer(ft)
                 hl_type = fopts.get("type", hl_body.get("type", "plain"))
-                hl_fn = (highlight_unified if hl_type == "unified"
+                # "fvh" is served by the unified passage highlighter: the
+                # reference FVH exists to reuse stored term-vector offsets,
+                # but offsets are not persisted here (positions are), so
+                # both types re-derive offsets by re-analysis
+                hl_fn = (highlight_unified if hl_type in ("unified", "fvh")
                          else highlight_field)
                 for v in vals:
                     frags.extend(hl_fn(
@@ -1135,14 +1139,17 @@ def _refine_complex_subs(searchers: List[ShardSearcher], body: dict,
         origin = node.body.get("origin")
         unit = node.body.get("unit", "m")
         for bucket in (result.get("buckets") or []):
+            # match the device bucket semantics [from, to): strict < on
+            # the upper edge, NOT(dist < from) = dist >= from on the lower
             flt: List[dict] = []
             if bucket.get("to") is not None:
                 flt.append({"geo_distance": {
-                    "distance": f"{bucket['to']}{unit}", field: origin}})
+                    "distance": f"{bucket['to']}{unit}", field: origin,
+                    "_inclusive": False}})
             if bucket.get("from") is not None:
                 flt.append({"bool": {"must_not": [{"geo_distance": {
                     "distance": f"{bucket['from']}{unit}",
-                    field: origin}}]}})
+                    field: origin, "_inclusive": False}}]}})
             for s in node.subs:
                 _refine_complex_subs(searchers, body, index_name, s,
                                      bucket.get(s.name), query,

@@ -257,6 +257,8 @@ class GeoDistanceQuery(Query):
     lat: float = 0.0
     lon: float = 0.0
     distance_m: float = 0.0
+    # internal: strict < for agg-refinement ring boundaries ("_inclusive")
+    inclusive: bool = True
 
 
 @dataclass
@@ -787,10 +789,12 @@ def parse_query(dsl: Optional[dict]) -> Query:
     if kind == "geo_distance":
         dist = _parse_distance(body["distance"])
         fields = [(k, v) for k, v in body.items()
-                  if k not in ("distance", "boost", "_name", "validation_method")]
+                  if k not in ("distance", "boost", "_name",
+                               "validation_method", "_inclusive")]
         f, point = fields[0]
         lat, lon = _parse_point(point)
-        q = GeoDistanceQuery(field=f, lat=lat, lon=lon, distance_m=dist)
+        q = GeoDistanceQuery(field=f, lat=lat, lon=lon, distance_m=dist,
+                             inclusive=bool(body.get("_inclusive", True)))
         _common(q, body)
         return q
 

@@ -1,0 +1,186 @@
+"""Analysis filter additions (word_delimiter, pattern_capture, elision,
+ngram filters, keyword_marker+stemmer fusion, stemmer_override, limit,
+decimal_digit, apostrophe), _cat endpoint completion, failure-detector
+heartbeat, fvh highlight type.
+
+References: modules/analysis-common factories, rest/action/cat/,
+cluster/coordination/FollowersChecker.java."""
+
+import pytest
+
+from opensearch_tpu.analysis.analyzers import AnalysisRegistry
+from opensearch_tpu.rest.client import RestClient
+
+
+def _texts(reg, analyzer, s):
+    return [t.text for t in reg.get(analyzer).analyze(s)]
+
+
+def _registry(filters: dict, analyzer_filters: list):
+    return AnalysisRegistry({
+        "filter": filters,
+        "analyzer": {"t": {"type": "custom", "tokenizer": "whitespace",
+                           "filter": analyzer_filters}}})
+
+
+class TestNewFilters:
+    def test_word_delimiter(self):
+        reg = _registry({}, ["word_delimiter"])
+        assert _texts(reg, "t", "Wi-Fi PowerShot500") == \
+            ["Wi", "Fi", "Power", "Shot", "500"]
+
+    def test_word_delimiter_catenate(self):
+        reg = _registry({"wd": {"type": "word_delimiter",
+                                "catenate_words": True}}, ["wd"])
+        out = _texts(reg, "t", "wi-fi")
+        assert "wifi" in out and "wi" in out and "fi" in out
+
+    def test_pattern_capture(self):
+        reg = _registry({"pc": {"type": "pattern_capture",
+                                "patterns": [r"(\d+)"],
+                                "preserve_original": True}}, ["pc"])
+        assert set(_texts(reg, "t", "abc123def")) == {"abc123def", "123"}
+
+    def test_elision(self):
+        reg = _registry({}, ["elision"])
+        assert _texts(reg, "t", "l'avion d'art") == ["avion", "d'art"]
+
+    def test_edge_ngram_filter(self):
+        reg = _registry({"eg": {"type": "edge_ngram", "min_gram": 1,
+                                "max_gram": 3}}, ["eg"])
+        assert _texts(reg, "t", "fox") == ["f", "fo", "fox"]
+
+    def test_keyword_marker_protects_stemming(self):
+        reg = _registry({"km": {"type": "keyword_marker",
+                                "keywords": ["running"]}},
+                        ["km", "stemmer"])
+        assert _texts(reg, "t", "running jumping") == ["running", "jump"]
+
+    def test_stemmer_override(self):
+        reg = _registry({"so": {"type": "stemmer_override",
+                                "rules": ["running => sprint"]}},
+                        ["so"])
+        assert _texts(reg, "t", "running") == ["sprint"]
+
+    def test_limit_decimal_apostrophe(self):
+        reg = _registry({"lim": {"type": "limit", "max_token_count": 2}},
+                        ["lim"])
+        assert _texts(reg, "t", "a b c d") == ["a", "b"]
+        reg = _registry({}, ["apostrophe"])
+        assert _texts(reg, "t", "o'brien turkish'i") == ["o", "turkish"]
+
+
+class TestCatEndpoints:
+    @pytest.fixture
+    def client(self):
+        c = RestClient()
+        c.indices.create("c1", body={"aliases": {"al": {}}})
+        c.index("c1", {"x": 1}, id="1", refresh=True)
+        c.indices.put_index_template("tpl", {"index_patterns": ["z*"]})
+        return c
+
+    def test_cat_nodes_health_segments(self, client):
+        assert client.cat.nodes()[0]["docs.count"] == "1"
+        h = client.cat.health()[0]
+        assert h["status"] in ("green", "yellow", "red")
+        segs = client.cat.segments()
+        assert segs and segs[0]["index"] == "c1"
+        assert segs[0]["docs.count"] == "1"
+
+    def test_cat_aliases_templates_allocation(self, client):
+        al = client.cat.aliases()
+        assert al and al[0]["alias"] == "al" and al[0]["index"] == "c1"
+        t = client.cat.templates()
+        assert any(row["name"] == "tpl" for row in t)
+        assert int(client.cat.allocation()[0]["shards"]) >= 1
+
+
+class TestFailureDetector:
+    def test_threshold_and_failover(self):
+        c = RestClient()
+        c.indices.create("fd", body={"settings": {"number_of_shards": 1,
+                                                  "number_of_replicas": 1}})
+        c.index("fd", {"v": 1}, id="1", refresh=True)
+        fd = c.node.failure_detector
+        # probe that fails only device 0
+        calls = []
+
+        def prober(dev):
+            calls.append(dev)
+            import jax
+            return dev is not jax.devices()[0]
+        fd.prober = prober
+        fd.failure_threshold = 2
+        ev1 = fd.tick()
+        assert any(e["event"] == "probe_failed" for e in ev1)
+        assert not fd.dead
+        ev2 = fd.tick()
+        assert any(e["event"] == "failed" and e["device"] == 0 for e in ev2)
+        assert 0 in fd.dead
+        # search still works after failover handling
+        r = c.search("fd", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 1
+        st = c.node.stats()["failure_detection"]
+        assert st["dead_devices"] == [0] and st["rounds"] == 2
+
+    def test_recovery_event(self):
+        c = RestClient()
+        fd = c.node.failure_detector
+        flaky = {"fail": True}
+        fd.prober = lambda dev: not flaky["fail"]
+        fd.failure_threshold = 5
+        fd.tick()
+        flaky["fail"] = False
+        ev = fd.tick()
+        assert any(e["event"] == "recovered" for e in ev)
+
+
+class TestFvhType:
+    def test_fvh_highlight(self):
+        c = RestClient()
+        c.indices.create("hv", body={"mappings": {"properties": {
+            "t": {"type": "text"}}}})
+        c.index("hv", {"t": "the quick brown fox jumps over the dog"},
+                id="1", refresh=True)
+        r = c.search("hv", {"query": {"match": {"t": "fox"}},
+                            "highlight": {"fields": {"t": {"type": "fvh"}}}})
+        frags = r["hits"]["hits"][0]["highlight"]["t"]
+        assert any("<em>fox</em>" in f for f in frags)
+
+
+class TestReviewRegressions:
+    def test_stemmer_override_not_restemmed(self):
+        reg = _registry({"so": {"type": "stemmer_override",
+                                "rules": ["mice => mouse"]}},
+                        ["so", "stemmer"])
+        assert _texts(reg, "t", "mice running") == ["mouse", "run"]
+
+    def test_combined_fields_commensurate_with_match(self):
+        c = RestClient()
+        c.indices.create("cfm", body={"mappings": {"properties": {
+            "a": {"type": "text"}}}})
+        c.index("cfm", {"a": "zebra"}, id="1", refresh=True)
+        r1 = c.search("cfm", {"query": {"combined_fields": {
+            "query": "zebra", "fields": ["a"]}}})
+        r2 = c.search("cfm", {"query": {"match": {"a": "zebra"}}})
+        s1 = r1["hits"]["hits"][0]["_score"]
+        s2 = r2["hits"]["hits"][0]["_score"]
+        # single field, weight 1 -> identical BM25 (no (k1+1) inflation)
+        assert s1 == pytest.approx(s2, rel=1e-5)
+
+    def test_geo_ring_boundary_refinement(self):
+        c = RestClient()
+        c.indices.create("gb", body={"mappings": {"properties": {
+            "loc": {"type": "geo_point"}, "k": {"type": "keyword"}}}})
+        c.index("gb", {"loc": "0,0", "k": "x"}, id="origin", refresh=True)
+        # doc at distance exactly 0; ring [0, 10km): strict-< refinement
+        # keeps it in the same bucket the device counted it in
+        r = c.search("gb", {"size": 0, "aggs": {"rings": {
+            "geo_distance": {"field": "loc", "origin": "0,0", "unit": "km",
+                             "ranges": [{"from": 0, "to": 10}]},
+            "aggs": {"kt": {"terms": {"field": "k"},
+                            "aggs": {"c": {"cardinality": {
+                                "field": "k"}}}}}}}})
+        b = r["aggregations"]["rings"]["buckets"][0]
+        assert b["doc_count"] == 1
+        assert b["kt"]["buckets"][0]["doc_count"] == 1
