@@ -137,10 +137,21 @@ class AnalysisRegistry:
         following stemmer (tokens are plain tuples — the 'keyword' flag the
         reference carries on attributes becomes a closure over the
         protected set instead)."""
-        from .filters import make_keyword_marker_stemmer
+        from .filters import (make_keyword_marker_stemmer,
+                              make_stemmer_override_filter)
         protected: set = set()
         overrides: dict = {}
         out: List[TokenFilter] = []
+
+        def flush_pending() -> None:
+            # a non-stemmer filter (or chain end) follows the marker/
+            # override: apply the override AT ITS DECLARED POSITION as a
+            # plain mapping; a marker with no stemmer is an identity
+            nonlocal protected, overrides
+            if overrides:
+                out.append(make_stemmer_override_filter(dict(overrides)))
+            protected, overrides = set(), {}
+
         for fname in names:
             custom = self._settings.get("filter", {}).get(fname)
             ftype = custom["type"] if custom is not None else fname
@@ -149,8 +160,9 @@ class AnalysisRegistry:
                 protected |= set(fparams.get("keywords", []))
                 continue
             if ftype == "stemmer_override":
-                # overridden outputs must NOT be re-stemmed by a following
-                # stemmer (reference StemmerOverrideFilter keyword attr)
+                # overridden outputs must NOT be re-stemmed by a DIRECTLY
+                # following stemmer (reference keyword attribute); fusion is
+                # strictly positional — any intervening filter flushes
                 for r in fparams.get("rules", []):
                     if "=>" in r:
                         src, dst = r.split("=>", 1)
@@ -162,10 +174,7 @@ class AnalysisRegistry:
                                                        overrides))
                 protected, overrides = set(), {}
                 continue
+            flush_pending()
             out.append(resolve_token_filter(ftype, fparams))
-        if overrides:
-            # stemmer_override with no following stemmer: plain mapping
-            from .filters import make_stemmer_override_filter
-            out.append(make_stemmer_override_filter(
-                [f"{k} => {v}" for k, v in overrides.items()]))
+        flush_pending()
         return out

@@ -331,13 +331,17 @@ def make_keyword_marker_stemmer(keywords: List[str],
     return f
 
 
-def make_stemmer_override_filter(rules: List[str]) -> TokenFilter:
-    """"running => run" rules applied before/instead of the stemmer."""
-    table = {}
-    for r in rules:
-        if "=>" in r:
-            src, dst = r.split("=>", 1)
-            table[src.strip()] = dst.strip()
+def make_stemmer_override_filter(rules) -> TokenFilter:
+    """"running => run" rules (list of strings or a parsed {src: dst}
+    dict) applied before/instead of the stemmer."""
+    if isinstance(rules, dict):
+        table = dict(rules)
+    else:
+        table = {}
+        for r in rules:
+            if "=>" in r:
+                src, dst = r.split("=>", 1)
+                table[src.strip()] = dst.strip()
 
     def f(tokens: List[Token]) -> List[Token]:
         return [Token(table.get(t.text, t.text), t.position, t.start_offset,

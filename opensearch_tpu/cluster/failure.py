@@ -33,14 +33,36 @@ def default_prober(device) -> bool:
 
 class FailureDetector:
     def __init__(self, node, failure_threshold: int = 3,
-                 prober: Optional[Callable] = None):
+                 prober: Optional[Callable] = None,
+                 probe_timeout_s: float = 10.0):
         self.node = node
         self.failure_threshold = failure_threshold
         self.prober = prober or default_prober
+        self.probe_timeout_s = probe_timeout_s
         self.consecutive: Dict[int, int] = {}
         self.dead: set = set()
         self.rounds = 0
         self.last_tick: Optional[float] = None
+
+    def _probe_with_timeout(self, dev) -> bool:
+        """A wedged chip HANGS the fetch rather than raising — exactly the
+        case the probe exists for — so the probe runs on a watchdog thread
+        and a timeout counts as a failure. The orphaned thread parks on the
+        dead fetch; it is daemonic and costs one thread per hung probe."""
+        import threading
+        result = {"ok": False}
+
+        def run():
+            try:
+                result["ok"] = bool(self.prober(dev))
+            except Exception:
+                result["ok"] = False
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t.join(self.probe_timeout_s)
+        if t.is_alive():
+            return False
+        return result["ok"]
 
     def _devices(self) -> List:
         import jax
@@ -54,7 +76,7 @@ class FailureDetector:
         for ordinal, dev in enumerate(self._devices()):
             if ordinal in self.dead:
                 continue
-            ok = bool(self.prober(dev))
+            ok = self._probe_with_timeout(dev)
             if ok:
                 if self.consecutive.get(ordinal):
                     events.append({"device": ordinal, "event": "recovered",

@@ -184,3 +184,28 @@ class TestReviewRegressions:
         b = r["aggregations"]["rings"]["buckets"][0]
         assert b["doc_count"] == 1
         assert b["kt"]["buckets"][0]["doc_count"] == 1
+
+
+class TestPositionalFusion:
+    def test_marker_after_lowercase_protects_lowercased_form(self):
+        # keyword set matches the text AS IT IS at the stemmer's position
+        reg = _registry({"km": {"type": "keyword_marker",
+                                "keywords": ["running"]}},
+                        ["lowercase", "km", "stemmer"])
+        assert _texts(reg, "t", "Running jumping") == ["running", "jump"]
+
+    def test_override_before_intervening_filter_stays_positional(self):
+        # override applies at its declared position, before lowercase
+        reg = _registry({"so": {"type": "stemmer_override",
+                                "rules": ["FOO => Bar"]}},
+                        ["so", "lowercase"])
+        assert _texts(reg, "t", "FOO") == ["bar"]
+
+    def test_probe_timeout_counts_as_failure(self):
+        c = RestClient()
+        fd = c.node.failure_detector
+        fd.probe_timeout_s = 0.2
+        fd.failure_threshold = 1
+        fd.prober = lambda dev: __import__("time").sleep(5) or True
+        ev = fd.tick()
+        assert any(e["event"] == "failed" for e in ev)
