@@ -128,53 +128,6 @@ class AnalysisRegistry:
             tokenizer = resolve_tokenizer(tok_custom["type"], tok_custom)
         else:
             tokenizer = resolve_tokenizer(tok_name)
-        filters = self._build_filter_chain(cfg.get("filter", []))
+        filters = [self._resolve_filter(f) for f in cfg.get("filter", [])]
         chars = [self._resolve_char(f) for f in cfg.get("char_filter", [])]
         return Analyzer(name, tokenizer, filters, chars)
-
-    def _build_filter_chain(self, names: list) -> List[TokenFilter]:
-        """Resolve the filter list, fusing keyword_marker keywords into a
-        following stemmer (tokens are plain tuples — the 'keyword' flag the
-        reference carries on attributes becomes a closure over the
-        protected set instead)."""
-        from .filters import (make_keyword_marker_stemmer,
-                              make_stemmer_override_filter)
-        protected: set = set()
-        overrides: dict = {}
-        out: List[TokenFilter] = []
-
-        def flush_pending() -> None:
-            # a non-stemmer filter (or chain end) follows the marker/
-            # override: apply the override AT ITS DECLARED POSITION as a
-            # plain mapping; a marker with no stemmer is an identity
-            nonlocal protected, overrides
-            if overrides:
-                out.append(make_stemmer_override_filter(dict(overrides)))
-            protected, overrides = set(), {}
-
-        for fname in names:
-            custom = self._settings.get("filter", {}).get(fname)
-            ftype = custom["type"] if custom is not None else fname
-            fparams = custom if custom is not None else {}
-            if ftype == "keyword_marker":
-                protected |= set(fparams.get("keywords", []))
-                continue
-            if ftype == "stemmer_override":
-                # overridden outputs must NOT be re-stemmed by a DIRECTLY
-                # following stemmer (reference keyword attribute); fusion is
-                # strictly positional — any intervening filter flushes
-                for r in fparams.get("rules", []):
-                    if "=>" in r:
-                        src, dst = r.split("=>", 1)
-                        overrides[src.strip()] = dst.strip()
-                continue
-            if ftype in ("stemmer", "porter_stem") and (protected
-                                                        or overrides):
-                out.append(make_keyword_marker_stemmer(sorted(protected),
-                                                       overrides))
-                protected, overrides = set(), {}
-                continue
-            flush_pending()
-            out.append(resolve_token_filter(ftype, fparams))
-        flush_pending()
-        return out

@@ -24,11 +24,11 @@ ENGLISH_STOPWORDS = frozenset(
 
 
 def lowercase_filter(tokens: List[Token]) -> List[Token]:
-    return [Token(t.text.lower(), t.position, t.start_offset, t.end_offset) for t in tokens]
+    return [t.with_text(t.text.lower()) for t in tokens]
 
 
 def uppercase_filter(tokens: List[Token]) -> List[Token]:
-    return [Token(t.text.upper(), t.position, t.start_offset, t.end_offset) for t in tokens]
+    return [t.with_text(t.text.upper()) for t in tokens]
 
 
 def make_stop_filter(stopwords=ENGLISH_STOPWORDS) -> TokenFilter:
@@ -43,18 +43,21 @@ def make_stop_filter(stopwords=ENGLISH_STOPWORDS) -> TokenFilter:
 
 
 def porter_stem_filter(tokens: List[Token]) -> List[Token]:
-    return [Token(porter_stem(t.text), t.position, t.start_offset, t.end_offset) for t in tokens]
+    # keyword-flagged tokens (keyword_marker / stemmer_override) skip
+    # stemming, like Lucene stemmers honoring KeywordAttribute
+    return [t if t.keyword else t.with_text(porter_stem(t.text))
+            for t in tokens]
 
 
 def asciifolding_filter(tokens: List[Token]) -> List[Token]:
     def fold(s: str) -> str:
         return unicodedata.normalize("NFKD", s).encode("ascii", "ignore").decode("ascii") or s
 
-    return [Token(fold(t.text), t.position, t.start_offset, t.end_offset) for t in tokens]
+    return [t.with_text(fold(t.text)) for t in tokens]
 
 
 def trim_filter(tokens: List[Token]) -> List[Token]:
-    return [Token(t.text.strip(), t.position, t.start_offset, t.end_offset) for t in tokens]
+    return [t.with_text(t.text.strip()) for t in tokens]
 
 
 def unique_filter(tokens: List[Token]) -> List[Token]:
@@ -67,7 +70,7 @@ def unique_filter(tokens: List[Token]) -> List[Token]:
 
 
 def reverse_filter(tokens: List[Token]) -> List[Token]:
-    return [Token(t.text[::-1], t.position, t.start_offset, t.end_offset) for t in tokens]
+    return [t.with_text(t.text[::-1]) for t in tokens]
 
 
 def make_length_filter(min_len: int = 0, max_len: int = 1 << 30) -> TokenFilter:
@@ -75,7 +78,7 @@ def make_length_filter(min_len: int = 0, max_len: int = 1 << 30) -> TokenFilter:
 
 
 def make_truncate_filter(length: int = 10) -> TokenFilter:
-    return lambda tokens: [Token(t.text[:length], t.position, t.start_offset, t.end_offset)
+    return lambda tokens: [t.with_text(t.text[:length])
                            for t in tokens]
 
 
@@ -86,8 +89,9 @@ def make_shingle_filter(min_size: int = 2, max_size: int = 2,
         for n in range(min_size, max_size + 1):
             for i in range(len(tokens) - n + 1):
                 grp = tokens[i:i + n]
-                out.append(Token(separator.join(t.text for t in grp), grp[0].position,
-                                 grp[0].start_offset, grp[-1].end_offset))
+                out.append(Token(separator.join(t.text for t in grp),
+                                 grp[0].position, grp[0].start_offset,
+                                 grp[-1].end_offset))
         out.sort(key=lambda t: (t.position, t.end_offset))
         return out
 
@@ -116,10 +120,10 @@ def make_synonym_filter(synonyms: List[str]) -> TokenFilter:
         for t in tokens:
             if t.text in replace:
                 for w in replace[t.text]:
-                    out.append(Token(w, t.position, t.start_offset, t.end_offset))
+                    out.append(t.with_text(w))
             elif t.text in expand:
                 for w in expand[t.text]:
-                    out.append(Token(w, t.position, t.start_offset, t.end_offset))
+                    out.append(t.with_text(w))
             else:
                 out.append(t)
         return out
@@ -228,8 +232,7 @@ def make_word_delimiter_filter(generate_word_parts: bool = True,
             for e in emitted:
                 if e and e not in seen:
                     seen.add(e)
-                    out.append(Token(e, t.position, t.start_offset,
-                                     t.end_offset))
+                    out.append(t.with_text(e))
         return out
     return f
 
@@ -253,8 +256,7 @@ def make_pattern_capture_filter(patterns: List[str],
             for e in emitted:
                 if e and e not in seen:
                     seen.add(e)
-                    out.append(Token(e, t.position, t.start_offset,
-                                     t.end_offset))
+                    out.append(t.with_text(e))
         return out
     return f
 
@@ -275,8 +277,7 @@ def make_elision_filter(articles=None) -> TokenFilter:
                     text = text[len(a):]
                     break
             if text:
-                out.append(Token(text, t.position, t.start_offset,
-                                 t.end_offset))
+                out.append(t.with_text(text))
         return out
     return f
 
@@ -288,8 +289,7 @@ def make_ngram_token_filter(min_gram: int = 1, max_gram: int = 2
         for t in tokens:
             for n in range(min_gram, max_gram + 1):
                 for i in range(0, max(len(t.text) - n + 1, 0)):
-                    out.append(Token(t.text[i:i + n], t.position,
-                                     t.start_offset, t.end_offset))
+                    out.append(t.with_text(t.text[i:i + n]))
         return out
     return f
 
@@ -300,33 +300,28 @@ def make_edge_ngram_token_filter(min_gram: int = 1, max_gram: int = 2
         out = []
         for t in tokens:
             for n in range(min_gram, min(max_gram, len(t.text)) + 1):
-                out.append(Token(t.text[:n], t.position, t.start_offset,
-                                 t.end_offset))
+                out.append(t.with_text(t.text[:n]))
         return out
     return f
 
 
-def make_keyword_marker_stemmer(keywords: List[str],
-                                overrides: Optional[dict] = None
-                                ) -> TokenFilter:
-    """keyword_marker + stemmer_override semantics fused with the stemmer:
-    marked words skip stemming; override rules map and then skip stemming
-    (reference sets the keyword attribute for both, which the stemmer
-    honors — tokens here are plain tuples, so the flag becomes a closure)."""
-    kw = frozenset(keywords)
-    table = dict(overrides or {})
+def make_keyword_marker_filter(keywords: List[str],
+                               ignore_case: bool = False) -> TokenFilter:
+    """Sets the token keyword flag (Lucene KeywordMarkerFilter): the flag
+    survives later text transforms and stemmers skip flagged tokens."""
+    kw = frozenset(k.lower() for k in keywords) if ignore_case \
+        else frozenset(keywords)
 
     def f(tokens: List[Token]) -> List[Token]:
         out = []
         for t in tokens:
-            if t.text in table:
-                out.append(Token(table[t.text], t.position, t.start_offset,
-                                 t.end_offset))
-            elif t.text in kw:
-                out.append(t)
+            probe = t.text.lower() if ignore_case else t.text
+            if probe in kw and not t.keyword:
+                nt = t.with_text(t.text)
+                nt.keyword = True
+                out.append(nt)
             else:
-                out.append(Token(porter_stem(t.text), t.position,
-                                 t.start_offset, t.end_offset))
+                out.append(t)
         return out
     return f
 
@@ -344,8 +339,15 @@ def make_stemmer_override_filter(rules) -> TokenFilter:
                 table[src.strip()] = dst.strip()
 
     def f(tokens: List[Token]) -> List[Token]:
-        return [Token(table.get(t.text, t.text), t.position, t.start_offset,
-                      t.end_offset) for t in tokens]
+        out = []
+        for t in tokens:
+            if t.text in table:
+                nt = t.with_text(table[t.text])
+                nt.keyword = True    # overridden => later stemmers skip
+                out.append(nt)
+            else:
+                out.append(t)
+        return out
     return f
 
 
@@ -358,7 +360,7 @@ def decimal_digit_filter(tokens: List[Token]) -> List[Token]:
     def fold(s: str) -> str:
         return "".join(str(unicodedata.digit(c)) if c.isdigit() else c
                        for c in s)
-    return [Token(fold(t.text), t.position, t.start_offset, t.end_offset)
+    return [t.with_text(fold(t.text))
             for t in tokens]
 
 
@@ -368,7 +370,7 @@ def apostrophe_filter(tokens: List[Token]) -> List[Token]:
     for t in tokens:
         text = t.text.split("'")[0].split("’")[0]
         if text:
-            out.append(Token(text, t.position, t.start_offset, t.end_offset))
+            out.append(t.with_text(text))
     return out
 
 
@@ -412,10 +414,9 @@ def resolve_token_filter(name: str, params: dict | None = None) -> TokenFilter:
         return make_edge_ngram_token_filter(int(params.get("min_gram", 1)),
                                             int(params.get("max_gram", 2)))
     if name == "keyword_marker":
-        # marking carries no token state here; the analyzer chain builder
-        # fuses a preceding keyword_marker into the following stemmer
-        # (make_keyword_marker_stemmer) — standalone it is an identity
-        return lambda tokens: tokens
+        return make_keyword_marker_filter(params.get("keywords", []),
+                                          bool(params.get("ignore_case",
+                                                          False)))
     if name == "stemmer_override":
         return make_stemmer_override_filter(params.get("rules", []))
     if name == "limit":

@@ -16,12 +16,21 @@ from typing import Callable, Dict, List
 @dataclass
 class Token:
     """A single token with position + offsets (offsets power highlighting;
-    positions power phrase queries — analog of Lucene's PackedTokenAttributeImpl)."""
+    positions power phrase queries — analog of Lucene's PackedTokenAttributeImpl).
+    `keyword` mirrors Lucene's KeywordAttribute: set by keyword_marker /
+    stemmer_override, honored (skipped) by stemmers, and it SURVIVES
+    intervening text transforms because filters rebuild via with_text."""
 
     text: str
     position: int
     start_offset: int
     end_offset: int
+    keyword: bool = False
+
+    def with_text(self, text: str) -> "Token":
+        """Rebuild with new text, preserving position/offsets/flags."""
+        return Token(text, self.position, self.start_offset,
+                     self.end_offset, self.keyword)
 
 
 # UAX#29-lite: runs of word characters incl. digits; keeps unicode letters.

@@ -300,6 +300,12 @@ class Node:
             from ..parallel.service import MeshSearchService
             mesh_service = MeshSearchService()
         self.mesh_service = mesh_service
+        # cross-cluster search (reference RemoteClusterService): registered
+        # peer Nodes searchable via "alias:index" expressions. Peers are
+        # in-process, so CCS fans their shard searchers into THIS
+        # coordinator's single reduce — full-fidelity aggs and unified DFS
+        # stats across clusters (ccs_minimize_roundtrips=false model)
+        self.remote_clusters: Dict[str, "Node"] = {}
         # account fast-path aligned postings (device HBM) against the
         # fielddata breaker (charged at build, released at segment GC);
         # module-level = one breaker per process, matching the
@@ -487,6 +493,31 @@ class Node:
 
     # ---------------- search entry ----------------
 
+    def _split_remote_expression(self, expression):
+        """"logs,west:logs-*" -> (local names, [(alias, node, names)]).
+        Reference RemoteClusterAware.groupClusterIndices."""
+        local_parts: List[str] = []
+        remote: List[tuple] = []
+        parts = (expression if isinstance(expression, list)
+                 else str(expression if expression is not None
+                          else "").split(","))
+        for part in parts:
+            part = str(part).strip()
+            alias = part.split(":", 1)[0] if ":" in part else None
+            if alias is not None and alias in self.remote_clusters:
+                rnode = self.remote_clusters[alias]
+                sub = part.split(":", 1)[1]
+                remote.append((alias, rnode, rnode.metadata.resolve(sub)))
+            else:
+                local_parts.append(part)
+        # "" resolves to _all — only resolve locally when a local part
+        # exists, else a pure-remote expression would sweep in every
+        # local index
+        names = (self.metadata.resolve(",".join(local_parts))
+                 if local_parts and any(local_parts) else
+                 (self.metadata.resolve(expression) if not remote else []))
+        return names, remote
+
     def search(self, expression: str, body: dict, phase_hook=None,
                phase_ctx: Optional[dict] = None,
                copy_protect: bool = False) -> dict:
@@ -494,13 +525,22 @@ class Node:
         pipeline response processors) — deep-copy it iff it aliases a
         request-cache entry, so cached entries stay pristine without taxing
         uncached paths."""
-        names = self.metadata.resolve(expression)
+        names, remote_parts = self._split_remote_expression(expression)
         searchers = []
         gens = []
         for name in names:
             svc = self.indices[name]
             searchers.extend(svc.search_copies())
             gens.append(svc.generation)
+        for alias, rnode, rnames in remote_parts:
+            for rn in rnames:
+                rsvc = rnode.indices[rn]
+                for sid in range(rsvc.meta.num_shards):
+                    searchers.append(ShardSearcher(
+                        rsvc.shards[sid], shard_id=sid,
+                        similarity=rsvc.default_sim,
+                        index_key=f"{alias}:{rn}"))
+                gens.append((alias, rn, rsvc.generation))
         # request cache (deterministic bodies only; a phase hook makes the
         # response depend on pipeline state, so it bypasses the cache)
         import json as _json
@@ -526,13 +566,16 @@ class Node:
                                   shards=len(searchers)):
                 resp = None
                 if (self.mesh_service is not None and len(names) == 1
-                        and phase_hook is None):
+                        and not remote_parts and phase_hook is None):
                     resp = self.mesh_service.try_search(names[0],
                                                         self.indices[names[0]],
                                                         body)
                 if resp is None:
+                    all_names = list(names) + [
+                        f"{a}:{rn}" for a, _n, rns in remote_parts
+                        for rn in rns]
                     resp = search_shards(searchers, body,
-                                         index_name=",".join(names),
+                                         index_name=",".join(all_names),
                                          task=task, phase_hook=phase_hook,
                                          phase_ctx=phase_ctx)
         finally:
@@ -543,7 +586,7 @@ class Node:
         for name in names:
             self.indices[name].search_slowlog.maybe_log(took,
                                                         body.get("query"))
-        if len(names) == 1:
+        if len(names) == 1 and not remote_parts:
             for h in resp["hits"]["hits"]:
                 h["_index"] = names[0]
         if cache_key is not None:

@@ -153,6 +153,8 @@ class ParsedDocument:
     terms: Dict[str, List[str]] = dc_field(default_factory=dict)
     # field -> list of (term, position) for positional indexes
     positions: Dict[str, List[Tuple[str, int]]] = dc_field(default_factory=dict)
+    # field -> raw values for store=true fields (reference stored fields)
+    stored: Dict[str, list] = dc_field(default_factory=dict)
     # field -> list of numeric values (column stores the first; extra values
     # still participate in term-style matching for the long family)
     numerics: Dict[str, List[Any]] = dc_field(default_factory=dict)
@@ -190,6 +192,10 @@ class Mappings:
         self.dynamic = dynamic
         self.dynamic_templates: List[dict] = []
         self._meta: dict = {}
+        # reference SourceFieldMapper: `"_source": {"enabled": false}` stops
+        # persisting _source in segments (store=true fields remain fetchable
+        # via stored_fields; update/reindex lose their input, as upstream)
+        self.source_enabled = True
         if mapping:
             self.merge(mapping)
 
@@ -200,6 +206,8 @@ class Mappings:
             self.dynamic = mapping["dynamic"]
         if "_meta" in mapping:
             self._meta.update(mapping["_meta"])
+        if "_source" in mapping:
+            self.source_enabled = bool(mapping["_source"].get("enabled", True))
         self.dynamic_templates.extend(mapping.get("dynamic_templates", []))
         self._merge_props(mapping.get("properties", {}), prefix="")
 
@@ -301,6 +309,8 @@ class Mappings:
         out = {"properties": props}
         if self._meta:
             out["_meta"] = self._meta
+        if not self.source_enabled:
+            out["_source"] = {"enabled": False}
         return out
 
     # ---------------- field resolution ----------------
@@ -455,6 +465,9 @@ class Mappings:
 
     def _index_single(self, ft: FieldType, v: Any, parsed: ParsedDocument) -> None:
         name = ft.name
+        if ft.store:
+            # stored fields keep the raw JSON value (reference StoredField)
+            parsed.stored.setdefault(name, []).append(v)
         if ft.type == "percolator":
             # validate the stored query now and extract its pre-filter terms
             # (reference PercolatorFieldMapper + QueryAnalyzer); the query

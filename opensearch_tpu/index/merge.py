@@ -49,11 +49,16 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
 
     ids: List[str] = []
     sources: List[dict] = []
+    stored_vals: List = []
+    any_stored = any(getattr(s, "stored_vals", None) for s in segments)
     seq_nos = np.empty(ndocs, dtype=np.int64)
     for s, m, dmap in zip(segments, live_masks, doc_maps):
         for old in np.nonzero(m)[0]:
             ids.append(s.ids[old])
             sources.append(s.sources[old])
+            if any_stored:
+                stored_vals.append(s.stored_vals[old]
+                                   if s.stored_vals else None)
         seq_nos[dmap[m]] = s.seq_nos[m]
 
     # ---- postings ----
@@ -266,7 +271,8 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
                    doc_lens, text_stats, ids, sources, seq_nos=seq_nos,
                    vector_cols=vector_cols, nested=nested,
-                   shape_cols=shape_cols)
+                   shape_cols=shape_cols,
+                   stored_vals=stored_vals if any_stored else None)
 
 
 def _ranges_gather(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:

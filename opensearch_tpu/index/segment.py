@@ -281,7 +281,8 @@ class Segment:
                  seq_nos: Optional[np.ndarray] = None,
                  vector_cols: Optional[Dict[str, VectorColumn]] = None,
                  nested: Optional[Dict[str, NestedBlock]] = None,
-                 shape_cols: Optional[Dict[str, ShapeColumn]] = None):
+                 shape_cols: Optional[Dict[str, ShapeColumn]] = None,
+                 stored_vals: Optional[list] = None):
         Segment._seq += 1
         self.uid = Segment._seq  # stable identity (id() can be reused post-GC)
         self.name = name
@@ -292,6 +293,9 @@ class Segment:
         self.geo_cols = geo_cols
         self.vector_cols = vector_cols or {}
         self.shape_cols = shape_cols or {}
+        # per-doc {field: [raw values]} for store=true fields (reference
+        # stored fields, independent of _source)
+        self.stored_vals = stored_vals
         self.doc_lens = doc_lens
         self.text_stats = text_stats
         self.nested: Dict[str, NestedBlock] = nested or {}
@@ -501,19 +505,26 @@ class Segment:
             json.dump(meta, fh)
         with open(os.path.join(path, "stored.jsonl"), "w") as fh:
             for i, src in enumerate(self.sources):
-                fh.write(json.dumps({"_id": self.ids[i], "_source": src}) + "\n")
+                rec = {"_id": self.ids[i], "_source": src}
+                if self.stored_vals and self.stored_vals[i]:
+                    rec["_stored"] = self.stored_vals[i]
+                fh.write(json.dumps(rec) + "\n")
 
     @classmethod
     def load(cls, path: str) -> "Segment":
         with open(os.path.join(path, "meta.json")) as fh:
             meta = json.load(fh)
         arrays = np.load(os.path.join(path, "arrays.npz"), allow_pickle=False)
-        ids, sources = [], []
+        ids, sources, stored_vals = [], [], []
+        any_stored = False
         with open(os.path.join(path, "stored.jsonl")) as fh:
             for line in fh:
                 rec = json.loads(line)
                 ids.append(rec["_id"])
                 sources.append(rec["_source"])
+                sv = rec.get("_stored")
+                any_stored = any_stored or bool(sv)
+                stored_vals.append(sv)
         postings = {}
         for f, pmeta in meta["postings"].items():
             with open(os.path.join(path, f"vocab__{f.replace('/', '_')}.txt")) as fh:
@@ -562,7 +573,8 @@ class Segment:
         seg = cls(meta["name"], meta["ndocs"], postings, numeric, keyword, geo, doc_lens,
                   {f: TextFieldStats(dc, sd) for f, (dc, sd) in meta["text_stats"].items()},
                   ids, sources, seq_nos=arrays["seq_nos"], vector_cols=vectors,
-                  nested=nested, shape_cols=shapes)
+                  nested=nested, shape_cols=shapes,
+                  stored_vals=stored_vals if any_stored else None)
         seg.live = arrays["live"].copy()
         seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
         return seg
@@ -700,7 +712,12 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
     `index/engine/InternalEngine.java#refresh`)."""
     ndocs = len(parsed_docs)
     ids = [d.doc_id for d in parsed_docs]
-    sources = [d.source for d in parsed_docs]
+    sources = ([d.source for d in parsed_docs]
+               if getattr(mappings, "source_enabled", True)
+               else [{} for _ in parsed_docs])
+    stored_vals = ([dict(d.stored) if d.stored else None
+                    for d in parsed_docs]
+                   if any(d.stored for d in parsed_docs) else None)
 
     # ---- inverted fields ----
     doc_lens: Dict[str, np.ndarray] = {}
@@ -853,4 +870,4 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
     return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
                    doc_lens, text_stats, ids, sources, seq_nos=seq,
                    vector_cols=vector_cols, nested=nested,
-                   shape_cols=shape_cols)
+                   shape_cols=shape_cols, stored_vals=stored_vals)

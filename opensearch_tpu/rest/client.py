@@ -326,9 +326,13 @@ class RestClient:
         resp = self._apply_response_pipeline(pipeline, resp, phase_ctx, body)
         if scroll:
             sid = uuid.uuid4().hex
-            names = self.node.metadata.resolve(index)
+            names, remote_parts = self.node._split_remote_expression(index)
             snapshot = {n: [list(s.segments) for s in self.node.indices[n].shards]
                         for n in names}
+            for alias, rnode, rnames in remote_parts:
+                for rn in rnames:
+                    snapshot[f"{alias}:{rn}"] = [
+                        list(s.segments) for s in rnode.indices[rn].shards]
             ka = _parse_keepalive_s(scroll if scroll is not True else None)
             self._scrolls[sid] = {"index": index, "body": body,
                                   "offset": int(body.get("from", 0)) + int(body.get("size", 10)),
@@ -442,10 +446,16 @@ class RestClient:
         return out
 
     def _snapshot_searchers(self, snapshot: Dict[str, list]) -> List[ShardSearcher]:
-        """Searchers bound to a scroll/PIT segment snapshot."""
+        """Searchers bound to a scroll/PIT segment snapshot ("alias:index"
+        keys resolve through the registered remote cluster)."""
         searchers = []
         for n, shard_segs in snapshot.items():
-            svc = self.node.indices.get(n)
+            node = self.node
+            name = n
+            if ":" in n and n.split(":", 1)[0] in self.node.remote_clusters:
+                alias, name = n.split(":", 1)
+                node = self.node.remote_clusters[alias]
+            svc = node.indices.get(name)
             if svc is None:
                 continue
             for sid, segs in enumerate(shard_segs):
@@ -566,6 +576,32 @@ class RestClient:
             except (ApiError, IndexNotFoundError) as e:
                 responses.append({"error": {"type": type(e).__name__, "reason": str(e)}})
         return {"took": 0, "responses": responses}
+
+    # ---------------- cross-cluster search (reference RemoteClusterService)
+
+    def put_remote_cluster(self, alias: str, remote) -> dict:
+        """Register a peer cluster for "alias:index" expressions. `remote`
+        is another RestClient or Node (in-process peers — the HTTP-less
+        analog of `cluster.remote.<alias>.seeds`)."""
+        node = getattr(remote, "node", remote)
+        if node is self.node:
+            raise ApiError(400, "illegal_argument_exception",
+                           "cannot register a cluster with itself")
+        self.node.remote_clusters[alias] = node
+        return {"acknowledged": True}
+
+    def delete_remote_cluster(self, alias: str) -> dict:
+        if self.node.remote_clusters.pop(alias, None) is None:
+            raise ApiError(404, "resource_not_found_exception",
+                           f"remote cluster [{alias}] not found")
+        return {"acknowledged": True}
+
+    def remote_info(self) -> dict:
+        """GET _remote/info shape."""
+        return {alias: {"connected": True, "mode": "in_process",
+                        "num_indices": len(n.indices),
+                        "cluster_name": n.metadata.cluster_name}
+                for alias, n in self.node.remote_clusters.items()}
 
     # ---------------- node stats + tracing (reference _nodes/stats) --------
 

@@ -530,16 +530,33 @@ class ShardSearcher:
 
     def _fetch_one(self, seg: Segment, c: Candidate, body: dict,
                    hl_terms: Optional[dict] = None) -> dict:
-        hit = {"_index": body.get("_index_name", ""), "_id": seg.ids[c.local_doc],
+        # per-searcher index label (multi-index and cross-cluster searches
+        # need the concrete "alias:index" name, not the joined expression)
+        hit = {"_index": self.index_key or body.get("_index_name", ""),
+               "_id": seg.ids[c.local_doc],
                "_score": c.score}
         if body.get("sort"):
             hit["sort"] = list(c.raw_sort_values)
-        src_opt = body.get("_source", True)
+        stored_opt = body.get("stored_fields")
+        # reference semantics: asking for stored_fields suppresses _source
+        # unless the request opts back in explicitly
+        src_opt = body.get("_source",
+                           True if stored_opt is None else False)
         if src_opt is not False:
             src = seg.sources[c.local_doc]
             hit["_source"] = _filter_source(src, src_opt)
+        if stored_opt and stored_opt != "_none_":
+            stored = (seg.stored_vals[c.local_doc]
+                      if getattr(seg, "stored_vals", None) else None) or {}
+            flds = hit.setdefault("fields", {})
+            for f in (stored_opt if isinstance(stored_opt, list)
+                      else [stored_opt]):
+                if f in stored:
+                    flds[f] = list(stored[f])
         if body.get("docvalue_fields"):
-            hit["fields"] = _docvalue_fields(seg, c.local_doc, body["docvalue_fields"])
+            # merge: stored_fields may already have populated hit["fields"]
+            hit.setdefault("fields", {}).update(
+                _docvalue_fields(seg, c.local_doc, body["docvalue_fields"]))
         if body.get("fields"):
             flds = hit.setdefault("fields", {})
             for f in body["fields"]:

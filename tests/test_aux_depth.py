@@ -209,3 +209,20 @@ class TestPositionalFusion:
         fd.prober = lambda dev: __import__("time").sleep(5) or True
         ev = fd.tick()
         assert any(e["event"] == "failed" for e in ev)
+
+
+class TestKeywordAttribute:
+    def test_marker_survives_intervening_filters(self):
+        # the keyword FLAG persists across intervening filters, like the
+        # reference KeywordAttribute
+        reg = _registry({"km": {"type": "keyword_marker",
+                                "keywords": ["running"]}},
+                        ["km", "trim", "stemmer"])
+        assert _texts(reg, "t", "running jumping") == ["running", "jump"]
+
+    def test_marker_ignore_case(self):
+        reg = _registry({"km": {"type": "keyword_marker",
+                                "keywords": ["running"],
+                                "ignore_case": True}},
+                        ["km", "lowercase", "stemmer"])
+        assert _texts(reg, "t", "Running") == ["running"]

@@ -1,0 +1,139 @@
+"""Cross-cluster search (reference RemoteClusterService /
+TransportSearchAction CCS): "alias:index" expressions fan the peer
+cluster's shard searchers into the coordinator's single reduce, so
+scoring (unified DFS stats) and aggregations keep full fidelity."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture
+def clusters():
+    local = RestClient()
+    west = RestClient()
+    local.indices.create("logs", body={"mappings": {"properties": {
+        "msg": {"type": "text"}, "level": {"type": "keyword"},
+        "n": {"type": "integer"}}}})
+    west.node.metadata.cluster_name = "west-cluster"
+    west.indices.create("logs", body={"mappings": {"properties": {
+        "msg": {"type": "text"}, "level": {"type": "keyword"},
+        "n": {"type": "integer"}}}})
+    local.index("logs", {"msg": "error in pipeline", "level": "error",
+                         "n": 1}, id="l1")
+    local.index("logs", {"msg": "all fine", "level": "info", "n": 2},
+                id="l2", refresh=True)
+    west.index("logs", {"msg": "error in kernel", "level": "error",
+                        "n": 10}, id="w1")
+    west.index("logs", {"msg": "warning only", "level": "warn", "n": 20},
+               id="w2", refresh=True)
+    local.put_remote_cluster("west", west)
+    return local, west
+
+
+class TestRegistration:
+    def test_info_and_delete(self, clusters):
+        local, west = clusters
+        info = local.remote_info()
+        assert info["west"]["connected"] is True
+        assert info["west"]["cluster_name"] == "west-cluster"
+        local.delete_remote_cluster("west")
+        assert local.remote_info() == {}
+        with pytest.raises(ApiError):
+            local.delete_remote_cluster("west")
+
+    def test_self_registration_rejected(self, clusters):
+        local, _ = clusters
+        with pytest.raises(ApiError):
+            local.put_remote_cluster("me", local)
+
+
+class TestCcsSearch:
+    def test_remote_only(self, clusters):
+        local, _ = clusters
+        r = local.search("west:logs", {"query": {"match": {"msg": "error"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["w1"]
+        assert r["hits"]["hits"][0]["_index"] == "west:logs"
+
+    def test_mixed_local_and_remote(self, clusters):
+        local, _ = clusters
+        r = local.search("logs,west:logs",
+                         {"query": {"term": {"level": "error"}},
+                          "sort": [{"n": "asc"}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["l1", "w1"]
+        assert [h["_index"] for h in r["hits"]["hits"]] == \
+            ["logs", "west:logs"]
+
+    def test_ccs_aggs_full_fidelity(self, clusters):
+        local, _ = clusters
+        r = local.search("logs,west:logs", {"size": 0, "aggs": {
+            "levels": {"terms": {"field": "level"}},
+            "avg_n": {"avg": {"field": "n"}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["levels"]["buckets"]}
+        assert buckets == {"error": 2, "info": 1, "warn": 1}
+        assert r["aggregations"]["avg_n"]["value"] == pytest.approx(8.25)
+
+    def test_unified_scoring_across_clusters(self, clusters):
+        local, west = clusters
+        # same query, CCS scores come from the UNION stats: a doc present
+        # in both clusters scores identically regardless of which side
+        # hosts it (reference DFS_QUERY_THEN_FETCH across clusters)
+        r = local.search("logs,west:logs",
+                         {"query": {"match": {"msg": "error"}}})
+        scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert set(scores) == {"l1", "w1"}
+
+    def test_wildcard_remote_index(self, clusters):
+        local, west = clusters
+        west.indices.create("logs-archive")
+        west.index("logs-archive", {"msg": "old error", "level": "error",
+                                    "n": 5}, id="a1", refresh=True)
+        r = local.search("west:logs*", {"query": {"term":
+                                                  {"level": "error"}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"w1", "a1"}
+
+    def test_unknown_remote_alias_is_index_error(self, clusters):
+        local, _ = clusters
+        with pytest.raises((ApiError, Exception)):
+            local.search("nope:logs", {"query": {"match_all": {}}})
+
+    def test_remote_data_stays_fresh(self, clusters):
+        local, west = clusters
+        west.index("logs", {"msg": "new error", "level": "error", "n": 30},
+                   id="w3", refresh=True)
+        r = local.search("west:logs", {"query": {"term":
+                                                 {"level": "error"}}})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"w1", "w3"}
+
+
+class TestCcsExtras:
+    def test_ccs_scroll(self, clusters):
+        local, _ = clusters
+        r = local.search("logs,west:logs",
+                         {"query": {"match_all": {}}, "size": 2,
+                          "sort": [{"n": "asc"}]}, scroll="1m")
+        assert len(r["hits"]["hits"]) == 2
+        sid = r["_scroll_id"]
+        r2 = local.scroll(sid)
+        assert len(r2["hits"]["hits"]) == 2
+        all_ids = {h["_id"] for h in r["hits"]["hits"]} | \
+            {h["_id"] for h in r2["hits"]["hits"]}
+        assert all_ids == {"l1", "l2", "w1", "w2"}
+
+    def test_stored_plus_docvalue_fields_merge(self, clusters):
+        local, _ = clusters
+        local.indices.create("both", body={"mappings": {"properties": {
+            "s": {"type": "keyword", "store": True},
+            "n": {"type": "integer"}}}})
+        local.index("both", {"s": "sv", "n": 7}, id="1", refresh=True)
+        r = local.search("both", {"query": {"match_all": {}},
+                                  "stored_fields": ["s"],
+                                  "docvalue_fields": ["n"]})
+        f = r["hits"]["hits"][0]["fields"]
+        assert f["s"] == ["sv"] and f["n"] == [7]
+
+    def test_list_index_expression(self, clusters):
+        local, _ = clusters
+        r = local.node.search(["logs"], {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 2

@@ -143,3 +143,54 @@ class TestCanMatchBreadth:
         assert cm({"dis_max": {"queries": [{"term": {"kw": "k1"}}]}})
         assert not cm({"geo_distance": {"distance": "1km",
                                         "ghost": {"lat": 0, "lon": 0}}})
+
+
+class TestStoredFields:
+    def test_store_true_and_source_disabled(self, tmp_path):
+        c = RestClient(data_path=str(tmp_path / "d"))
+        c.indices.create("st", body={"mappings": {
+            "_source": {"enabled": False},
+            "properties": {
+                "title": {"type": "text", "store": True},
+                "hidden": {"type": "keyword"}}}})
+        c.index("st", {"title": "kept around", "hidden": "gone"}, id="1",
+                refresh=True)
+        r = c.search("st", {"query": {"match": {"title": "kept"}},
+                            "stored_fields": ["title", "hidden"]})
+        h = r["hits"]["hits"][0]
+        assert "_source" not in h          # _source disabled
+        assert h["fields"]["title"] == ["kept around"]
+        assert "hidden" not in h["fields"]  # not store=true
+        # hidden is still SEARCHABLE (indexed), just not stored
+        r2 = c.search("st", {"query": {"term": {"hidden": "gone"}}})
+        assert r2["hits"]["total"]["value"] == 1
+        assert r2["hits"]["hits"][0].get("_source") in (None, {})
+
+    def test_stored_fields_suppress_source_by_default(self, client):
+        c = client
+        r = c.search("d", {"query": {"ids": {"values": ["1"]}},
+                           "stored_fields": ["txt"]})
+        assert "_source" not in r["hits"]["hits"][0]
+        r = c.search("d", {"query": {"ids": {"values": ["1"]}},
+                           "stored_fields": ["txt"], "_source": True})
+        assert "_source" in r["hits"]["hits"][0]
+
+    def test_stored_survives_flush_and_merge(self, tmp_path):
+        path = str(tmp_path / "d2")
+        c = RestClient(data_path=path)
+        c.indices.create("sm", body={
+            "settings": {"number_of_shards": 1},
+            "mappings": {"properties": {
+                "v": {"type": "keyword", "store": True}}}})
+        c.index("sm", {"v": "one"}, id="1")
+        c.indices.refresh("sm")
+        c.index("sm", {"v": "two"}, id="2")
+        c.indices.refresh("sm")
+        c.indices.forcemerge("sm")
+        c.indices.flush("sm")
+        c2 = RestClient(data_path=path)
+        r = c2.search("sm", {"query": {"match_all": {}},
+                             "stored_fields": ["v"],
+                             "sort": [{"v": "asc"}]})
+        assert [h["fields"]["v"] for h in r["hits"]["hits"]] == \
+            [["one"], ["two"]]
