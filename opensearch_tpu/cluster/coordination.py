@@ -1,0 +1,144 @@
+"""Cluster coordination: leader election + two-phase state publication.
+
+Reference analog: `cluster/coordination/Coordinator.java`,
+`ElectionStrategy`, `CoordinationState`, `PublicationTransportHandler` —
+term-based voting with quorum, then PUBLISH -> COMMIT of the cluster
+state to followers.
+
+The deployment model here is in-process peer Nodes (the same peers
+cross-cluster search reaches), and like the lifecycle/failure-detector
+services the caller owns the clock: every transition is a deterministic
+method call, so election storms, quorum loss, partitions and stale-term
+publications are all unit-testable without timers or sockets. A real
+multi-host process story would put jax.distributed process groups under
+the same state machine; the protocol logic is host-side either way and
+does not touch the device path.
+
+Election rule (reference ElectionStrategy default): among live
+master-eligible nodes, the candidate with the FRESHEST accepted state —
+highest (term, version) — wins, node name as the deterministic
+tiebreak. A candidate needs votes from a MAJORITY of all master-eligible
+nodes (not just live ones), so a minority partition can never elect."""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+
+class CoordinationError(Exception):
+    pass
+
+
+class ClusterCoordinator:
+    def __init__(self, nodes: List):
+        if not nodes:
+            raise CoordinationError("coordinator needs at least one node")
+        names = [n.node_name for n in nodes]
+        if len(set(names)) != len(names):
+            raise CoordinationError("duplicate node names")
+        self.nodes: Dict[str, object] = {n.node_name: n for n in nodes}
+        self.live: set = set(names)
+        self.term = 0
+        self.leader: Optional[str] = None
+        # per-node accepted (term, version) — freshness for the election
+        self.accepted: Dict[str, tuple] = {name: (0, 0) for name in names}
+        self.history: List[dict] = []
+
+    # ---------------- membership ----------------
+
+    def fail_node(self, name: str) -> None:
+        if name not in self.nodes:
+            raise CoordinationError(f"unknown node [{name}]")
+        self.live.discard(name)
+        if self.leader == name:
+            self.leader = None
+            self.history.append({"event": "leader_lost", "node": name})
+
+    def heal_node(self, name: str) -> None:
+        if name not in self.nodes:
+            raise CoordinationError(f"unknown node [{name}]")
+        self.live.add(name)
+
+    def quorum(self) -> int:
+        return len(self.nodes) // 2 + 1
+
+    def has_quorum(self) -> bool:
+        return len(self.live) >= self.quorum()
+
+    # ---------------- election ----------------
+
+    def elect(self) -> Optional[str]:
+        """One election round. Returns the leader name, or None when no
+        quorum exists (the cluster stays leaderless — reference behavior
+        under lost majority)."""
+        if not self.has_quorum():
+            self.leader = None
+            self.history.append({"event": "election_failed",
+                                 "reason": "no_quorum",
+                                 "live": sorted(self.live)})
+            return None
+        # freshest accepted state wins; name is the deterministic tiebreak
+        candidate = max(self.live, key=lambda n: (self.accepted[n], n))
+        self.term += 1
+        self.leader = candidate
+        self.history.append({"event": "elected", "leader": candidate,
+                             "term": self.term})
+        return candidate
+
+    def ensure_leader(self) -> Optional[str]:
+        # a leader that lost its majority steps down (reference
+        # Coordinator.becomeCandidate on quorum loss)
+        if (self.leader is not None and self.leader in self.live
+                and self.has_quorum()):
+            return self.leader
+        return self.elect()
+
+    # ---------------- state publication ----------------
+
+    def publish(self, from_node: Optional[str] = None) -> dict:
+        """Two-phase publish of the leader's cluster metadata: PUBLISH to
+        every live follower, COMMIT once a quorum (leader included) has
+        accepted. Stale-term publishers are rejected (a deposed leader
+        cannot overwrite newer state)."""
+        src = from_node if from_node is not None else self.leader
+        if src is None:
+            raise CoordinationError("no leader to publish from")
+        if src != self.leader:
+            raise CoordinationError(
+                f"[{src}] is not the current leader (term {self.term})")
+        if src not in self.live:
+            raise CoordinationError(f"leader [{src}] is not live")
+        leader_node = self.nodes[src]
+        version = leader_node.metadata.version
+        # phase 1: PUBLISH — determine who can accept, check quorum BEFORE
+        # any acceptance is recorded (a failed publish must leave no
+        # follower claiming freshness for state it never received)
+        targets = [src] + sorted(self.live - {src})
+        if len(targets) < self.quorum():
+            raise CoordinationError(
+                f"publish failed: {len(targets)} acks < quorum "
+                f"{self.quorum()}")
+        # phase 2: COMMIT — install the state, recording acceptance
+        # together with the installation (atomically per follower)
+        for name in targets:
+            if name != src:
+                follower = self.nodes[name]
+                follower.metadata.indices = copy.deepcopy(
+                    leader_node.metadata.indices)
+                follower.metadata.aliases = copy.deepcopy(
+                    leader_node.metadata.aliases)
+                follower.metadata.templates = copy.deepcopy(
+                    leader_node.metadata.templates)
+                follower.metadata.version = version
+            self.accepted[name] = (self.term, version)
+        self.history.append({"event": "published", "term": self.term,
+                             "version": version, "acks": len(targets)})
+        return {"term": self.term, "version": version,
+                "committed": targets}
+
+    def stats(self) -> dict:
+        return {"term": self.term, "leader": self.leader,
+                "nodes": sorted(self.nodes), "live": sorted(self.live),
+                "quorum": self.quorum(),
+                "has_quorum": self.has_quorum()}

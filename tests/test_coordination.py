@@ -1,0 +1,133 @@
+"""Cluster coordination: election, quorum, two-phase publication,
+failover, stale-term rejection (reference cluster/coordination/
+Coordinator.java + CoordinationState.java)."""
+
+import pytest
+
+from opensearch_tpu.cluster.coordination import (ClusterCoordinator,
+                                                 CoordinationError)
+from opensearch_tpu.rest.client import RestClient
+
+
+def _cluster(n=3):
+    clients = [RestClient() for _ in range(n)]
+    for i, c in enumerate(clients):
+        c.node.node_name = f"node-{i}"
+    coord = ClusterCoordinator([c.node for c in clients])
+    return clients, coord
+
+
+class TestElection:
+    def test_deterministic_winner_and_term(self):
+        _, coord = _cluster(3)
+        leader = coord.elect()
+        assert leader == "node-2"       # equal freshness -> name tiebreak
+        assert coord.term == 1
+        # re-election bumps the term
+        coord.fail_node("node-2")
+        assert coord.elect() == "node-1"
+        assert coord.term == 2
+
+    def test_freshest_state_wins(self):
+        _, coord = _cluster(3)
+        coord.accepted["node-0"] = (5, 9)   # node-0 saw newer state
+        assert coord.elect() == "node-0"
+
+    def test_no_quorum_no_leader(self):
+        _, coord = _cluster(3)
+        coord.fail_node("node-1")
+        coord.fail_node("node-2")
+        assert coord.elect() is None
+        assert coord.leader is None
+        assert not coord.has_quorum()
+
+    def test_minority_partition_cannot_elect(self):
+        _, coord = _cluster(5)
+        for n in ("node-0", "node-1", "node-2"):
+            coord.fail_node(n)
+        assert coord.elect() is None
+
+
+class TestPublication:
+    def test_metadata_replicates_to_followers(self):
+        clients, coord = _cluster(3)
+        leader_name = coord.elect()
+        leader = next(c for c in clients
+                      if c.node.node_name == leader_name)
+        leader.indices.create("events", body={"aliases": {"ev": {}}})
+        out = coord.publish()
+        assert len(out["committed"]) == 3
+        for c in clients:
+            assert "events" in c.node.metadata.indices
+            assert "ev" in c.node.metadata.aliases
+
+    def test_stale_leader_rejected(self):
+        clients, coord = _cluster(3)
+        old = coord.elect()
+        coord.fail_node(old)
+        coord.elect()
+        coord.heal_node(old)            # deposed leader comes back
+        with pytest.raises(CoordinationError):
+            coord.publish(from_node=old)
+
+    def test_publish_without_leader_fails(self):
+        _, coord = _cluster(3)
+        with pytest.raises(CoordinationError):
+            coord.publish()
+
+    def test_failover_continuity(self):
+        clients, coord = _cluster(3)
+        first = coord.ensure_leader()
+        coord.fail_node(first)
+        second = coord.ensure_leader()
+        assert second is not None and second != first
+        leader = next(c for c in clients
+                      if c.node.node_name == second)
+        leader.indices.create("after-failover")
+        coord.publish()
+        survivors = [c for c in clients
+                     if c.node.node_name in coord.live]
+        for c in survivors:
+            assert "after-failover" in c.node.metadata.indices
+
+    def test_ensure_leader_is_stable(self):
+        _, coord = _cluster(3)
+        a = coord.ensure_leader()
+        t = coord.term
+        assert coord.ensure_leader() == a
+        assert coord.term == t          # no spurious re-election
+
+
+class TestReviewRegressions:
+    def test_failed_publish_leaves_no_false_freshness(self):
+        clients, coord = _cluster(5)
+        leader = coord.elect()
+        lc = next(c for c in clients if c.node.node_name == leader)
+        lc.indices.create("precious")
+        # majority gone: publish must fail WITHOUT poisoning accepted{}
+        for n in sorted(coord.live - {leader})[:3]:
+            coord.fail_node(n)
+        with pytest.raises(CoordinationError):
+            coord.publish()
+        survivor = next(iter(coord.live - {leader}))
+        assert coord.accepted[survivor] == (0, 0)
+        # everyone heals, old leader dies: the new leader must NOT be a
+        # node falsely claiming the unpublished state
+        for n in coord.nodes:
+            coord.heal_node(n)
+        coord.fail_node(leader)
+        newl = coord.elect()
+        assert coord.accepted[newl] == (0, 0)
+
+    def test_leader_steps_down_without_quorum(self):
+        _, coord = _cluster(5)
+        leader = coord.ensure_leader()
+        for n in [n for n in sorted(coord.nodes) if n != leader][:3]:
+            coord.fail_node(n)
+        assert not coord.has_quorum()
+        assert coord.ensure_leader() is None
+
+    def test_fail_unknown_node_raises(self):
+        _, coord = _cluster(3)
+        with pytest.raises(CoordinationError):
+            coord.fail_node("node_3")
