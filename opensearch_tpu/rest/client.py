@@ -577,6 +577,53 @@ class RestClient:
                 responses.append({"error": {"type": type(e).__name__, "reason": str(e)}})
         return {"took": 0, "responses": responses}
 
+    # ---------------- _validate/query (reference ValidateQueryAction) ------
+
+    def validate_query(self, index: str = "_all",
+                       body: Optional[dict] = None,
+                       explain: bool = False,
+                       rewrite: bool = False) -> dict:
+        """Parse AND rewrite the query against every resolved index without
+        executing it — the verdict never depends on the display flags
+        (explain/rewrite only add per-index explanation entries)."""
+        body = body or {}
+        try:
+            names = self.node.metadata.resolve(index)
+        except IndexNotFoundError as e:
+            raise ApiError(404, "index_not_found_exception", str(e))
+        try:
+            q = dsl.parse_query(body.get("query", {"match_all": {}}))
+        except ValueError as e:   # QueryParseError is a ValueError
+            out = {"valid": False,
+                   "_shards": {"total": 1, "successful": 1, "failed": 0}}
+            if explain:
+                out["explanations"] = [{"index": n, "valid": False,
+                                        "error": str(e)} for n in names] \
+                    or [{"index": index, "valid": False, "error": str(e)}]
+            return out
+        explanations = []
+        all_valid = True
+        for n in names:
+            svc = self.node.indices[n]
+            segs = [s for sh in svc.shards for s in sh.segments]
+            ctx = C.ShardContext(svc.mappings, segs, svc.default_sim)
+            try:
+                detail = C.describe_plan(C.rewrite(q, ctx, scoring=True))
+                explanations.append({
+                    "index": n, "valid": True,
+                    "explanation":
+                        f"{detail['type']}({detail['description']})"})
+            except ValueError as e:
+                all_valid = False
+                explanations.append({"index": n, "valid": False,
+                                     "error": str(e)})
+        out = {"valid": all_valid,
+               "_shards": {"total": len(names) or 1,
+                           "successful": len(names) or 1, "failed": 0}}
+        if explain or rewrite:
+            out["explanations"] = explanations
+        return out
+
     # ---------------- cross-cluster search (reference RemoteClusterService)
 
     def put_remote_cluster(self, alias: str, remote) -> dict:

@@ -194,3 +194,35 @@ class TestStoredFields:
                              "sort": [{"v": "asc"}]})
         assert [h["fields"]["v"] for h in r["hits"]["hits"]] == \
             [["one"], ["two"]]
+
+
+class TestValidateQuery:
+    def test_valid_and_invalid(self, client):
+        r = client.validate_query("d", {"query": {"match": {"txt": "fox"}}})
+        assert r["valid"] is True
+        r = client.validate_query("d", {"query": {"bogus_kind": {}}},
+                                  explain=True)
+        assert r["valid"] is False
+        assert "bogus_kind" in r["explanations"][0]["error"]
+
+    def test_explain_shows_rewritten(self, client):
+        r = client.validate_query("d", {"query": {"match": {"txt": "fox"}}},
+                                  explain=True)
+        assert r["valid"] and "Terms" in r["explanations"][0]["explanation"]
+
+    def test_validate_verdict_independent_of_flags(self, client):
+        # rewrite-stage failure detected with AND without explain
+        bad = {"query": {"regexp": {"txt": "(unclosed"}}}
+        assert client.validate_query("d", bad)["valid"] is False
+        r = client.validate_query("d", bad, explain=True)
+        assert r["valid"] is False and r["explanations"][0]["valid"] is False
+
+    def test_validate_missing_index_404(self, client):
+        with pytest.raises(ApiError) as ei:
+            client.validate_query("ghost-idx", {"query": {"match_all": {}}})
+        assert ei.value.status == 404
+
+    def test_validate_rewrite_flag_shows_plan(self, client):
+        r = client.validate_query("d", {"query": {"match": {"txt": "fox"}}},
+                                  rewrite=True)
+        assert r["explanations"][0]["explanation"].startswith("Terms")
