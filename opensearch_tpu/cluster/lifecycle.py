@@ -52,23 +52,39 @@ class LifecycleService:
         unknown = set(dl) - {"min_age"}
         if unknown:
             raise ValueError(f"unknown delete setting {sorted(unknown)}")
+        fm = policy.get("force_merge") or {}
+        unknown = set(fm) - {"min_age", "max_num_segments"}
+        if unknown:
+            raise ValueError(f"unknown force_merge setting {sorted(unknown)}")
+        ro_only = policy.get("read_only") or {}
+        unknown = set(ro_only) - {"min_age"}
+        if unknown:
+            raise ValueError(f"unknown read_only setting {sorted(unknown)}")
+        unknown = set(policy) - {"rollover", "delete", "force_merge",
+                                 "read_only"}
+        if unknown:
+            raise ValueError(f"unknown lifecycle action{'s' if len(unknown) > 1 else ''} "
+                             f"{sorted(unknown)}")
         # values must parse too — a bad duration is a 400 here, not a crash
         # inside every subsequent tick
         for label, v in (("rollover.max_age", ro.get("max_age")),
-                         ("delete.min_age", dl.get("min_age"))):
+                         ("delete.min_age", dl.get("min_age")),
+                         ("force_merge.min_age", fm.get("min_age")),
+                         ("read_only.min_age", ro_only.get("min_age"))):
             if v is not None:
                 try:
                     parse_age_s(v)
                 except ValueError:
                     raise ValueError(f"cannot parse duration [{v}] "
                                      f"for [{label}]")
-        if "max_docs" in ro:
-            try:
-                int(ro["max_docs"])
-            except (TypeError, ValueError):
-                raise ValueError(
-                    f"cannot parse [rollover.max_docs] value "
-                    f"[{ro['max_docs']}]")
+        for label, v in (("rollover.max_docs", ro.get("max_docs")),
+                         ("force_merge.max_num_segments",
+                          fm.get("max_num_segments"))):
+            if v is not None:
+                try:
+                    int(v)
+                except (TypeError, ValueError):
+                    raise ValueError(f"cannot parse [{label}] value [{v}]")
         self.policies[name] = policy
 
     def get_policy(self, name: str) -> Optional[dict]:
@@ -154,6 +170,32 @@ class LifecycleService:
                                     "new_index": new_name,
                                     "docs": docs, "age_seconds": age})
                     continue
+            idx_settings = meta.settings.setdefault("index", {})
+            lc_state = idx_settings.setdefault("lifecycle", {})
+            try:
+                fm = policy.get("force_merge")
+                if (fm and not (ro and is_write)
+                        and not lc_state.get("force_merged")
+                        and age >= parse_age_s(fm.get("min_age", "0ms"))):
+                    # the service helper also re-syncs replicas: merged
+                    # segments replace shared objects, and a replica left
+                    # on the old set would serve pre-merge deletes
+                    self.node.indices[name].force_merge(
+                        int(fm.get("max_num_segments", 1)))
+                    lc_state["force_merged"] = True
+                    actions.append({"index": name, "action": "force_merge",
+                                    "age_seconds": age})
+                ronly = policy.get("read_only")
+                if (ronly and not (ro and is_write)
+                        and not idx_settings.get("blocks", {}).get("write")
+                        and age >= parse_age_s(ronly.get("min_age", "0ms"))):
+                    idx_settings.setdefault("blocks", {})["write"] = True
+                    actions.append({"index": name, "action": "read_only",
+                                    "age_seconds": age})
+            except (TypeError, ValueError) as e:
+                actions.append({"index": name, "action": "error",
+                                "reason": str(e)})
+                continue
             delete_cfg = policy.get("delete")
             if delete_cfg and not (ro and is_write):
                 try:
@@ -183,8 +225,15 @@ class LifecycleService:
         new_name = next_rollover_name(old_index)
         old_meta = node.metadata.indices[old_index]
         # deep copy: create_index installs the inner "index" dict by
-        # reference, and the series must not share mutable settings
-        node.create_index(new_name, {"settings": copy.deepcopy(old_meta.settings),
+        # reference, and the series must not share mutable settings.
+        # Transient lifecycle STATE must not travel to the new index — a
+        # rolled-to index must not be born read-only or force_merged
+        settings = copy.deepcopy(old_meta.settings)
+        idx = settings.get("index", {})
+        idx.pop("blocks", None)
+        if isinstance(idx.get("lifecycle"), dict):
+            idx["lifecycle"].pop("force_merged", None)
+        node.create_index(new_name, {"settings": settings,
                                      "mappings":
                                          node.indices[old_index].mappings.to_dict()})
         am = node.metadata.aliases.get(alias)

@@ -89,12 +89,21 @@ class RestClient:
 
     # ---------------- document APIs ----------------
 
+    def _check_write_block(self, svc) -> None:
+        """index.blocks.write (set by hand or by the ILM read_only action)
+        rejects writes like the reference ClusterBlockException."""
+        if svc.meta.settings.get("index", {}).get("blocks", {}).get("write"):
+            raise ApiError(403, "cluster_block_exception",
+                           f"index [{svc.meta.name}] blocked by: "
+                           f"[FORBIDDEN/8/index write (api)]")
+
     def index(self, index: str, body: dict, id: Optional[str] = None,
               routing: Optional[str] = None, refresh: bool = False,
               op_type: str = "index", pipeline: Optional[str] = None,
               if_seq_no: Optional[int] = None,
               if_primary_term: Optional[int] = None) -> dict:
         svc = self.node.index_service_for_write(index)
+        self._check_write_block(svc)
         pipeline = pipeline or svc.meta.settings.get("index", {}).get("default_pipeline")
         if pipeline:
             try:
@@ -159,6 +168,7 @@ class RestClient:
                refresh: bool = False, if_seq_no: Optional[int] = None,
                if_primary_term: Optional[int] = None) -> dict:
         svc = self.node.get_index(self.node.metadata.write_index(index))
+        self._check_write_block(svc)
         try:
             res = svc.route(id, routing).delete_doc(id, if_seq_no, if_primary_term)
         except VersionConflictError as e:
@@ -175,6 +185,7 @@ class RestClient:
                refresh: bool = False, **kw) -> dict:
         """Partial-doc update / upsert (reference UpdateHelper)."""
         svc = self.node.index_service_for_write(index)
+        self._check_write_block(svc)
         eng = svc.route(id, routing)
         current = eng.get(id)
         if current is None:

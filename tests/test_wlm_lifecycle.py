@@ -180,3 +180,81 @@ class TestPolicyValueValidation:
             c.put_lifecycle_policy("badp2", {"policy": {
                 "rollover": {"max_docs": "lots"}}})
         assert ei.value.status == 400
+
+
+class TestIlmActions:
+    def test_force_merge_and_read_only(self):
+        c = RestClient()
+        c.put_lifecycle_policy("cold", {"policy": {
+            "force_merge": {"min_age": "0ms", "max_num_segments": 1},
+            "read_only": {"min_age": "0ms"}}})
+        c.indices.create("frozen", body={"settings": {
+            "number_of_shards": 1,
+            "index": {"lifecycle": {"name": "cold"}}}})
+        for i in range(3):
+            c.index("frozen", {"v": i}, id=str(i))
+            c.indices.refresh("frozen")
+        assert len(c.node.get_index("frozen").shards[0].segments) == 3
+        acts = c.lifecycle_step()["actions"]
+        kinds = {a["action"] for a in acts}
+        assert kinds == {"force_merge", "read_only"}
+        assert len(c.node.get_index("frozen").shards[0].segments) == 1
+        # writes now blocked (403), reads fine; tick is idempotent
+        with pytest.raises(ApiError) as ei:
+            c.index("frozen", {"v": 9})
+        assert ei.value.status == 403
+        with pytest.raises(ApiError):
+            c.delete("frozen", "0")
+        r = c.search("frozen", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 3
+        assert c.lifecycle_step()["actions"] == []
+
+    def test_unknown_action_rejected(self):
+        c = RestClient()
+        with pytest.raises(ApiError) as ei:
+            c.put_lifecycle_policy("bad", {"policy": {"shrink": {}}})
+        assert ei.value.status == 400
+
+    def test_bad_max_num_segments_rejected_at_put(self):
+        c = RestClient()
+        with pytest.raises(ApiError) as ei:
+            c.put_lifecycle_policy("fmbad", {"policy": {
+                "force_merge": {"max_num_segments": "all"}}})
+        assert ei.value.status == 400
+
+    def test_rollover_strips_lifecycle_state(self):
+        c = RestClient()
+        c.put_lifecycle_policy("roseries", {"policy": {
+            "read_only": {"min_age": "0ms"}}})
+        c.indices.create("series-000001", body={"settings": {"index": {
+            "lifecycle": {"name": "roseries",
+                          "rollover_alias": "series"}}}})
+        c.indices.put_alias("series-000001", "series",
+                            {"is_write_index": True})
+        # no rollover key in the policy: write index gets read_only'd
+        acts = c.lifecycle_step()["actions"]
+        assert {a["action"] for a in acts} == {"read_only"}
+        r = c.rollover("series")
+        assert r["rolled_over"]
+        new = r["new_index"]
+        # the rolled-to index must be born writable
+        ns = c.node.get_index(new).meta.settings["index"]
+        assert not ns.get("blocks", {}).get("write")
+        c.index(new, {"v": 1}, id="x")   # must not 403
+
+    def test_force_merge_syncs_replicas(self):
+        c = RestClient()
+        c.put_lifecycle_policy("fmrep", {"policy": {
+            "force_merge": {"min_age": "0ms"}}})
+        c.indices.create("fr", body={"settings": {
+            "number_of_shards": 1, "number_of_replicas": 1,
+            "index": {"lifecycle": {"name": "fmrep"}}}})
+        for i in range(3):
+            c.index("fr", {"v": i}, id=str(i))
+            c.indices.refresh("fr")
+        c.delete("fr", "1", refresh=True)
+        c.lifecycle_step()
+        # every copy (primary round-robin + replica) agrees post-merge
+        for _ in range(4):
+            r = c.search("fr", {"query": {"match_all": {}}})
+            assert r["hits"]["total"]["value"] == 2
