@@ -74,28 +74,32 @@ def _roll(x, shift: int, axis: int):
 
 def _cx(keys, payload, s: int):
     """One ascending compare-exchange stage at element stride `s` (partner =
-    index XOR s) over the flattened [R,128] array. Moves `payload` with keys."""
+    index XOR s) over the flattened [R,128] array. Moves `payload` with keys
+    (a single array or a tuple of arrays, all selected by the same mask)."""
+    single = not isinstance(payload, tuple)
+    ps = (payload,) if single else payload
     shape = keys.shape
     rows, lanes = _ids(shape)
     if s >= LANES:
         r = s // LANES
         kf = _roll(keys, -r, 0)
         kb = _roll(keys, r, 0)
-        pf = _roll(payload, -r, 0)
-        pb = _roll(payload, r, 0)
+        pf = [_roll(p, -r, 0) for p in ps]
+        pb = [_roll(p, r, 0) for p in ps]
         first = ((rows // r) % 2) == 0
     else:
         kf = _roll(keys, -s, 1)
         kb = _roll(keys, s, 1)
-        pf = _roll(payload, -s, 1)
-        pb = _roll(payload, s, 1)
+        pf = [_roll(p, -s, 1) for p in ps]
+        pb = [_roll(p, s, 1) for p in ps]
         first = ((lanes // s) % 2) == 0
     nk = jnp.where(first, jnp.minimum(keys, kf), jnp.maximum(keys, kb))
     # NB: selecting between bool arrays with jnp.where trips a Mosaic i8->i1
     # truncation bug; keep predicates in pure i1 logic
     take_self = (first & (keys <= kf)) | ((~first) & (keys >= kb))
-    npay = jnp.where(take_self, payload, jnp.where(first, pf, pb))
-    return nk, npay
+    nps = tuple(jnp.where(take_self, p, jnp.where(first, f, b))
+                for p, f, b in zip(ps, pf, pb))
+    return nk, (nps[0] if single else nps)
 
 
 def _swap(x, s: int):
@@ -126,20 +130,23 @@ def _block_flip(x, block: int):
 
 def _merge_pairs(keys, payload, half: int):
     """Merge adjacent sorted runs of length `half` into sorted runs of
-    2*half (Batcher bitonic merge, ascending)."""
+    2*half (Batcher bitonic merge, ascending). `payload` may be one array
+    or a tuple of arrays that all ride the same permutation."""
+    single = not isinstance(payload, tuple)
+    ps = (payload,) if single else payload
     kf = _block_flip(keys, 2 * half)
-    pf = _block_flip(payload, 2 * half)
+    pf = [_block_flip(p, 2 * half) for p in ps]
     rows, lanes = _ids(keys.shape)
     idx = rows * LANES + lanes
     first = (idx % (2 * half)) < half
     take_self = (first & (keys <= kf)) | ((~first) & (keys >= kf))
     nk = jnp.where(take_self, keys, kf)
-    npay = jnp.where(take_self, payload, pf)
+    npay = tuple(jnp.where(take_self, p, f) for p, f in zip(ps, pf))
     s = half // 2
     while s >= 1:
         nk, npay = _cx(nk, npay, s)
         s //= 2
-    return nk, npay
+    return nk, (npay[0] if single else npay)
 
 
 def _flat_shift_down(x, fill):
@@ -525,6 +532,246 @@ def fused_bm25_topk_tfdl(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
     )(rowstarts, nrows, lens, weights, msm, avgdl, dlo, dhi,
       docs_hbm, tfdl_hbm)
+    return scores, doc_ids, totals
+
+
+# ---------------------------------------------------------------------
+# bool/filtered variant: weighted-threshold clause semantics
+# ---------------------------------------------------------------------
+#
+# Generalizes the tfdl kernel to Lucene BooleanQuery shapes (reference
+# `search/BooleanScorer` / `ConjunctionDISI`): each slot carries a COUNT
+# WEIGHT `cw` alongside its score weight, and a doc passes iff the summed
+# count weight of its matching slots reaches `thresh`. With required slots
+# (must / filter) at cw=REQ_W and optional slots (should, or the terms of
+# one multi-term group) at cw=1, `thresh = REQ_W*n_required + msm` encodes
+# "ALL required AND >= msm optional" exactly (REQ_W > max optional count,
+# so optionals can never substitute for a missing required slot).
+#
+# Filters ride as one extra slot whose doc list comes from a SEPARATE HBM
+# buffer (`filt_hbm`, built host-side from the cached dense filter mask of
+# the XLA path — reference IndicesQueryCache bitsets) with score weight 0
+# and cw=REQ_W: the same merge network that dedups scoring terms performs
+# the filter intersection, so no per-doc gather is ever needed.
+REQ_W = 1024.0
+
+
+def _bm25_bool_kernel(TS: int, L: int, K: int, k1: float, b: float,
+                      sizes: tuple, filtered: bool,
+                      rowstart_ref, nrows_ref, lens_ref, weights_ref,
+                      cw_ref, thresh_ref, avgdl_ref, dlo_ref, dhi_ref,
+                      docs_hbm, tfdl_hbm, filt_hbm,
+                      out_scores, out_docs, out_totals,
+                      docs_v, tfdl_v, sems):
+    q = pl.program_id(0)
+    T = 2 * TS if filtered else TS
+    rows_per_term = L // LANES
+
+    # ---- per-slot DMA at the slot's own pow2 bucket ----
+    # term slots [0, TS) move (docs, tfdl) from the postings buffers; the
+    # filter slot TS (when present) moves docs only, from filt_hbm. Slots
+    # with nrows=0 (absent term / dead padding) match no size branch -> no
+    # DMA, and their VMEM garbage is masked below by len_row=0.
+    for t in range(TS):
+        nr = nrows_ref[t, q]
+        row_start = pl.multiple_of(rowstart_ref[t, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(t=t, s=s, row_start=row_start):
+                pltpu.make_async_copy(docs_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t]).start()
+                pltpu.make_async_copy(tfdl_hbm.at[pl.ds(row_start, s)],
+                                      tfdl_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t + 1]).start()
+    if filtered:
+        nr = nrows_ref[TS, q]
+        row_start = pl.multiple_of(rowstart_ref[TS, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(s=s, row_start=row_start):
+                pltpu.make_async_copy(filt_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[TS, pl.ds(0, s)],
+                                      sems.at[2 * TS]).start()
+    for t in range(TS):
+        nr = nrows_ref[t, q]
+        row_start = pl.multiple_of(rowstart_ref[t, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(t=t, s=s, row_start=row_start):
+                pltpu.make_async_copy(docs_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t]).wait()
+                pltpu.make_async_copy(tfdl_hbm.at[pl.ds(row_start, s)],
+                                      tfdl_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t + 1]).wait()
+    if filtered:
+        nr = nrows_ref[TS, q]
+        row_start = pl.multiple_of(rowstart_ref[TS, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(s=s, row_start=row_start):
+                pltpu.make_async_copy(filt_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[TS, pl.ds(0, s)],
+                                      sems.at[2 * TS]).wait()
+
+    # ---- decode + BM25 + per-slot count weights ----
+    R = (T * L) // LANES
+    docs2 = docs_v[:].reshape(R, LANES)
+    tfdl2 = tfdl_v[:].reshape(R, LANES)
+    rows, lanes = _ids((R, LANES))
+    term_of_row = rows // rows_per_term
+    pos_in_term = (rows % rows_per_term) * LANES + lanes
+
+    w_row = jnp.zeros((R, LANES), jnp.float32)
+    len_row = jnp.zeros((R, LANES), jnp.int32)
+    cw_row = jnp.zeros((R, LANES), jnp.float32)
+    for t in range(T):
+        sel = term_of_row == t
+        len_row = jnp.where(sel, lens_ref[t, q], len_row)
+        cw_row = jnp.where(sel, cw_ref[t, q], cw_row)
+        if t < TS:
+            w_row = jnp.where(sel, weights_ref[t, q], w_row)
+    dlo = dlo_ref[0, q]
+    dhi = dhi_ref[0, q]
+    in_pos = pos_in_term < len_row
+    valid = in_pos & (docs2 >= dlo) & (docs2 < dhi)
+    keys = jnp.where(in_pos & (docs2 < dlo), NEG_SENTINEL,
+                     jnp.where(valid, docs2, INT_SENTINEL))
+
+    tf = ((tfdl2 >> DL_BITS) & TF_MAX).astype(jnp.float32)
+    dl = (tfdl2 & DL_MASK).astype(jnp.float32)
+    avgdl = avgdl_ref[0, q]
+    kd = k1 * (1.0 - b + b * dl / avgdl)
+    # filter-slot rows score 0 (their tfdl scratch is never DMA'd garbage)
+    is_term = term_of_row < TS
+    contrib = jnp.where(valid & is_term, w_row * tf / (tf + kd), 0.0)
+    cw = jnp.where(valid, cw_row, 0.0)
+
+    # ---- merge the T doc-sorted runs, carrying (score, count-weight) ----
+    half = L
+    payload = (contrib, cw)
+    while half < T * L:
+        keys, payload = _merge_pairs(keys, payload, half)
+        half *= 2
+    contrib, cw = payload
+
+    # ---- dedup: runs of equal doc have length <= T ----
+    score = contrib
+    cnt = cw
+    kk = keys
+    cc = contrib
+    aa = cw
+    for _ in range(T - 1):
+        kk = _flat_shift_down(kk, INT_SENTINEL)
+        cc = _flat_shift_down(cc, 0.0)
+        aa = _flat_shift_down(aa, 0.0)
+        eq = (kk == keys) & (keys < INT_SENTINEL)
+        score = score + jnp.where(eq, cc, 0.0)
+        cnt = cnt + jnp.where(eq, aa, 0.0)
+    knext = _flat_shift_up(keys, INT_SENTINEL)
+    is_last = (knext != keys) & (keys < INT_SENTINEL) & (keys > NEG_SENTINEL)
+    final = jnp.where(is_last & (cnt >= thresh_ref[0, q]), score, NEG_INF)
+
+    total = jnp.sum((final > NEG_INF).astype(jnp.int32))
+    out_totals[q, :] = jnp.full((LANES,), total, jnp.int32)
+
+    # ---- iterative top-K extraction ----
+    acc_s = jnp.full((1, LANES), NEG_INF, jnp.float32)
+    acc_d = jnp.full((1, LANES), -1, jnp.int32)
+    out_lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    for j in range(K):
+        best = jnp.max(final)
+        sel = final == best
+        bdoc = jnp.min(jnp.where(sel, keys, INT_SENTINEL))
+        got = best > NEG_INF
+        best_or = jnp.where(got, best, NEG_INF)
+        bdoc_or = jnp.where(got, bdoc, -1)
+        hit = out_lane == j
+        acc_s = jnp.where(hit, best_or, acc_s)
+        acc_d = jnp.where(hit, bdoc_or, acc_d)
+        final = jnp.where(sel & (keys == bdoc), NEG_INF, final)
+    out_scores[q, :] = acc_s[0]
+    out_docs[q, :] = acc_d[0]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("TS", "L", "K", "k1", "b", "filtered"))
+def fused_bm25_bool_topk(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
+                         filt_hbm: jnp.ndarray,
+                         rowstarts: jnp.ndarray, nrows: jnp.ndarray,
+                         lens: jnp.ndarray, weights: jnp.ndarray,
+                         cw: jnp.ndarray, thresh: jnp.ndarray,
+                         avgdl: jnp.ndarray, dlo: jnp.ndarray,
+                         dhi: jnp.ndarray,
+                         TS: int, L: int, K: int, k1: float, b: float,
+                         filtered: bool):
+    """Batched fused bool/filtered BM25 top-k.
+
+    Slots [0, TS) are scoring terms over (docs_hbm, tfdl_hbm); when
+    `filtered`, slot TS is the filter doc list in filt_hbm (i32[Pf], rows
+    1024-aligned, INT_SENTINEL padded) and slots (TS, 2*TS) are dead
+    padding (nrows=0). Per-query arrays are [QB, T] (T = 2*TS when
+    filtered else TS) except weights [QB, TS] and thresh/avgdl/dlo/dhi
+    [QB, 1]. `cw` carries per-slot count weights (REQ_W required / 1.0
+    optional / 0 dead); a doc passes when its summed cw >= thresh.
+    Returns (scores f32[QB, 128], doc_ids i32[QB, 128], totals i32[QB, 128]).
+    """
+    QB = rowstarts.shape[0]
+    rowstarts = rowstarts.T
+    nrows = nrows.T
+    lens = lens.T
+    weights = weights.T
+    cw = cw.T
+    thresh = thresh.T
+    avgdl = avgdl.T
+    dlo = dlo.T
+    dhi = dhi.T
+    T = 2 * TS if filtered else TS
+    assert docs_hbm.shape[0] % LANES == 0
+    assert filt_hbm.shape[0] % LANES == 0
+    docs_hbm = docs_hbm.reshape(-1, LANES)
+    tfdl_hbm = tfdl_hbm.reshape(-1, LANES)
+    filt_hbm = filt_hbm.reshape(-1, LANES)
+    min_rows = HBM_ALIGN // LANES
+    sizes = []
+    s = min_rows
+    while s <= L // LANES:
+        sizes.append(s)
+        s *= 2
+    kernel = functools.partial(_bm25_bool_kernel, TS, L, K, float(k1),
+                               float(b), tuple(sizes), bool(filtered))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=9,
+        grid=(QB,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
+            pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
+            pltpu.SemaphoreType.DMA((2 * T,)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((QB, LANES), jnp.float32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+    ]
+    scores, doc_ids, totals = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(rowstarts, nrows, lens, weights, cw, thresh, avgdl, dlo, dhi,
+      docs_hbm, tfdl_hbm, filt_hbm)
     return scores, doc_ids, totals
 
 

@@ -4440,15 +4440,24 @@ def _canon_param_key(key: str, mapping: Dict[int, int]) -> str:
     return key
 
 
-def _prepare_cached_filter(node: LNode, seg: Segment, ctx: ShardContext,
-                           params: dict):
-    """Prepare a filter-context clause through the mask cache: repeated
-    filters (the classic "status:published + range" guardrails) reuse one
-    device-resident bool mask instead of re-running their program."""
-    import hashlib
-
+def filter_mask_for(node: LNode, seg: Segment, ctx: ShardContext):
+    """Dense bool match mask for a filter-context clause, through the mask
+    cache. Returns (mask np.bool_[ndocs_pad], cache_key, spec, local_params);
+    mask/key are None when the clause's params are too big to hash cheaply
+    (caller falls back to inlining spec+params into its own program)."""
     local: Dict[str, Any] = {}
     spec = prepare(node, seg, ctx, local)
+    key, mapping = _filter_cache_key(spec, local, seg)
+    if key is None:
+        return None, None, spec, local
+    mask = _mask_for_key(key, spec, local, mapping, seg)
+    return mask, key, spec, local
+
+
+def _filter_cache_key(spec, local: dict, seg: Segment):
+    """-> ((uid, live_gen, digest), nid-mapping) or (None, mapping)."""
+    import hashlib
+
     # hash the nid-canonicalized spec + this segment's param payload
     mapping: Dict[int, int] = {}
     h = hashlib.blake2b(repr(_canon_spec(spec, mapping)).encode(),
@@ -4459,11 +4468,28 @@ def _prepare_cached_filter(node: LNode, seg: Segment, ctx: ShardContext,
         arr = np.asarray(v)
         total += arr.nbytes
         if total > _FILTER_HASH_BYTE_CAP:
-            params.update(local)
-            return spec            # too big to hash cheaply: no caching
+            return None, mapping   # too big to hash cheaply: no caching
         h.update(_canon_param_key(k0, mapping).encode())
         h.update(arr.tobytes())
-    key = (seg.uid, seg.live_gen, h.hexdigest())
+    return (seg.uid, seg.live_gen, h.hexdigest()), mapping
+
+
+def _prepare_cached_filter(node: LNode, seg: Segment, ctx: ShardContext,
+                           params: dict):
+    """Prepare a filter-context clause through the mask cache: repeated
+    filters (the classic "status:published + range" guardrails) reuse one
+    device-resident bool mask instead of re-running their program."""
+    mask, key, spec, local = filter_mask_for(node, seg, ctx)
+    if mask is None:
+        params.update(local)
+        return spec
+    nid = node.nid
+    params[f"q{nid}_cached_mask"] = mask
+    return ("cached_mask", nid)
+
+
+def _mask_for_key(key, spec, local: dict, mapping: Dict[int, int],
+                  seg: Segment) -> np.ndarray:
     mask = _FILTER_MASK_CACHE.get(key)
     if mask is None:
         # use whichever device already hosts this segment (replica copies
@@ -4490,9 +4516,7 @@ def _prepare_cached_filter(node: LNode, seg: Segment, ctx: ShardContext,
             _FILTER_MASK_BYTES[0] -= _v.nbytes
     else:
         _FILTER_MASK_CACHE.move_to_end(key)
-    nid = node.nid
-    params[f"q{nid}_cached_mask"] = mask
-    return ("cached_mask", nid)
+    return mask
 
 
 def prepare_collapse(collapse: Optional[dict], seg: Segment, ctx: ShardContext,

@@ -158,13 +158,13 @@ class ShardSearcher:
         result = ShardQueryResult(shard=shard_ord, segments=segments)
         ran_segs: List[Segment] = []
 
-        # Pallas fast path: plain BM25 term-group top-k goes through the
-        # fused kernel (search/fastpath.py); anything it can't serve falls
-        # back to the general XLA plan per segment
-        fast_ok = (fastpath.enabled() and self.device is None
-                   and fastpath.query_eligible(lroot, sort_specs, agg_nodes,
-                                               named_nodes, search_after,
-                                               window, body))
+        # Pallas fast path: plain BM25 term-group top-k AND bool/filtered
+        # shapes go through the fused kernels (search/fastpath.py); anything
+        # they can't serve falls back to the general XLA plan per segment
+        fast_spec = (fastpath.make_spec(lroot, sort_specs, agg_nodes,
+                                        named_nodes, search_after, window,
+                                        body)
+                     if fastpath.enabled() and self.device is None else None)
 
         for seg_ord, seg in enumerate(segments):
             if task is not None:
@@ -178,8 +178,8 @@ class ShardSearcher:
                 # global/filter-family aggs see docs the query doesn't match,
                 # so ordinary agg trees still allow the skip
                 continue
-            if fast_ok:
-                fout = fastpath.segment_search(seg, ctx, lroot, window)
+            if fast_spec is not None:
+                fout = fastpath.segment_search(seg, ctx, fast_spec, window)
                 if fout is not None:
                     ran_segs.append(seg)
                     self._collect_topk(result, fout, seg, seg_ord, shard_ord,
@@ -717,24 +717,30 @@ def msearch_batched(searchers: List[ShardSearcher],
     results = [[ShardQueryResult(shard=i, segments=list(s.engine.segments))
                 for i, s in enumerate(searchers)] for _ in range(nb)]
     max_window = max((w for _, _, _, w in parsed), default=10)
+    served_batches: List[tuple] = []
     for i, s in enumerate(searchers):
         ctx = stats[i]
         segments = list(s.engine.segments)
-        lroots = []
+        fspecs = []
         for body, query, sort_specs, window in parsed:
             lroot = C.rewrite(query, ctx, scoring=True)
-            if not fastpath.query_eligible(lroot, sort_specs, [], [], None,
-                                           window, body):
-                return None
             if _collect_named(lroot):
                 return None
-            lroots.append(lroot)
+            fspec = fastpath.make_spec(lroot, sort_specs, [], [], None,
+                                       window, body)
+            if fspec is None:
+                return None
+            fspecs.append(fspec)
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
                 continue
-            outs = fastpath.batch_search(seg, ctx, lroots, max_window)
+            # stats counted only when the whole batch is actually served —
+            # a later fallback discards every result and re-runs slow
+            outs = fastpath.batch_search(seg, ctx, fspecs, max_window,
+                                         count_stats=False)
             if outs is None or any(o is None for o in outs):
                 return None
+            served_batches.append((fspecs, outs))
             for bi, fout in enumerate(outs):
                 body, _, sort_specs, window = parsed[bi]
                 s._collect_topk(results[bi][i], fout, seg, seg_ord, i,
@@ -744,6 +750,8 @@ def msearch_batched(searchers: List[ShardSearcher],
             r.candidates.sort(key=lambda c: c.sort_values)
             r.candidates = r.candidates[:window]
             r.took_ms = (time.monotonic() - t0) * 1000.0
+    for fs, outs in served_batches:
+        fastpath.count_served(fs, outs)
     return [_finish_search(searchers, results[bi], parsed[bi][0], stats,
                            index_name, t0, [])
             for bi in range(nb)]

@@ -17,14 +17,15 @@ as a query-time scalar, so both paths rank identically.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..index.segment import Segment, next_pow2
 from ..ops import scoring as ops
-from ..ops.pallas_bm25 import (DL_BITS, DL_MAX, HBM_ALIGN, LANES, TF_MAX,
-                               align_csr_rows, fused_bm25_topk_tfdl)
+from ..ops.pallas_bm25 import (DL_BITS, DL_MAX, HBM_ALIGN, INT_SENTINEL,
+                               LANES, REQ_W, TF_MAX, align_csr_rows,
+                               fused_bm25_bool_topk, fused_bm25_topk_tfdl)
 
 MAX_T = 8            # pow2-padded term slots per query group
 MAX_L = 1 << 16      # per-term VMEM bucket cap (elements)
@@ -34,6 +35,10 @@ MAX_CHUNKS = 64      # doc-range split bound for huge posting rows
 INT_MAX = np.int32(2**31 - 1)
 
 _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
+
+# served/fallback counters (surfaced in _nodes/stats; also used by tests to
+# prove the kernel actually engaged rather than silently falling back)
+STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0}
 
 # optional memory accounting set by the Node (utils/breaker.py): charged
 # before aligned arrays go to device, released when the segment is GC'd
@@ -118,21 +123,9 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
                            nbytes)
 
 
-def query_eligible(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
+def _body_eligible(sort_specs: List[dict], agg_nodes, named_nodes,
                    search_after, window: int, body: dict) -> bool:
-    """Host-cheap check that this search is the plain BM25 top-k hot path."""
-    from . import compiler as C
-
-    if not isinstance(lroot, C.LTerms):
-        return False
-    lt = lroot
-    if lt.mode != "score" or lt.sim is None or lt.sim.sim_id != ops.SIM_BM25:
-        return False
-    nt = len(lt.terms)
-    if nt < 1 or next_pow2(nt, floor=1) > MAX_T:
-        return False
-    if lt.aux is not None and np.any(np.asarray(lt.aux)[:nt] != 0.0):
-        return False
+    """Non-query body checks shared by every fastpath shape."""
     if agg_nodes or named_nodes or search_after is not None:
         return False
     if window > MAX_K or window < 1:
@@ -146,6 +139,162 @@ def query_eligible(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
     return True
 
 
+def _ok_group(lt) -> bool:
+    """LTerms usable as a fastpath scoring clause (plain BM25 term group)."""
+    from . import compiler as C
+
+    if not isinstance(lt, C.LTerms):
+        return False
+    if lt.mode != "score" or lt.sim is None or lt.sim.sim_id != ops.SIM_BM25:
+        return False
+    nt = len(lt.terms)
+    if nt < 1:
+        return False
+    if lt.aux is not None and np.any(np.asarray(lt.aux)[:nt] != 0.0):
+        return False
+    return True
+
+
+def query_eligible(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
+                   search_after, window: int, body: dict) -> bool:
+    """Host-cheap check that this search is the plain BM25 top-k hot path
+    (single unfiltered term group — the original fused kernel shape)."""
+    if not _ok_group(lroot):
+        return False
+    if next_pow2(len(lroot.terms), floor=1) > MAX_T:
+        return False
+    return _body_eligible(sort_specs, agg_nodes, named_nodes, search_after,
+                          window, body)
+
+
+class FastSpec:
+    """A search the fastpath can serve. kind 'pure' = single term group on
+    the original kernel; kind 'bool' = weighted-threshold bool/filtered
+    shape on `fused_bm25_bool_topk` (reference BooleanQuery semantics,
+    `search/query/QueryPhase.java`): required slots (single-term musts +
+    the combined filter/must_not mask), one optional count-constrained
+    family (a multi-term group's msm, or shoulds under the outer
+    minimum_should_match), and zero-count bonus shoulds."""
+
+    __slots__ = ("kind", "lt", "slots", "fam_msm", "filter_clauses",
+                 "field", "sim", "has_norms", "boost", "const_score")
+
+    def __init__(self, kind: str, **kw):
+        self.kind = kind
+        self.lt = None
+        self.slots = []            # [(term, weight, cw)] cw in {REQ_W, 1, 0}
+        self.fam_msm = 0
+        self.filter_clauses = []   # [(LNode, negated)] ANDed dense masks
+        self.field = None
+        self.sim = None
+        self.has_norms = True
+        self.boost = 1.0
+        self.const_score = None    # fixed score for every hit (filter-only)
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+    @property
+    def n_required(self) -> int:
+        return sum(1 for _, _, cw in self.slots if cw == REQ_W)
+
+
+def _flatten_bool(lroot) -> Optional[FastSpec]:
+    """Map an LBool/LConstScore tree onto the weighted-threshold slot model;
+    None = not expressible (falls back to the XLA plan path)."""
+    from . import compiler as C
+
+    if isinstance(lroot, C.LConstScore):
+        if lroot.child is None or lroot.boost < 0:
+            return None
+        return FastSpec("bool", filter_clauses=[(lroot.child, False)],
+                        const_score=float(lroot.boost), boost=1.0)
+    if not isinstance(lroot, C.LBool):
+        return None
+    b = lroot
+    if b.boost <= 0:
+        # boost 0 zeroes every score BEFORE top-k on the XLA path (ties then
+        # break by doc id); the kernel ranks pre-boost, so fall back
+        return None
+    for g in b.musts + b.shoulds:
+        if not _ok_group(g):
+            return None
+    groups = b.musts + b.shoulds
+    field = sim = None
+    has_norms = True
+    if groups:
+        field, sim, has_norms = (groups[0].field, groups[0].sim,
+                                 groups[0].has_norms)
+        for g in groups:
+            if (g.field != field or g.sim.k1 != sim.k1 or g.sim.b != sim.b
+                    or g.has_norms != has_norms):
+                return None
+
+    req: List[Tuple[str, float]] = []
+    fam: List[Tuple[str, float]] = []
+    bonus: List[Tuple[str, float]] = []
+    fam_msm = 0
+
+    def slot_weights(g):
+        return [(t, float(np.asarray(g.weights)[i]))
+                for i, t in enumerate(g.terms)]
+
+    for m in b.musts:
+        if len(m.terms) == 1 or m.msm >= len(m.terms):
+            req.extend(slot_weights(m))        # AND semantics: all required
+        elif not fam:
+            fam.extend(slot_weights(m))        # the one constrained family
+            fam_msm = max(int(m.msm), 1)
+        else:
+            return None
+    if b.shoulds:
+        outer = int(b.msm)
+        if outer == 0:
+            # pure score bonus: no count constraint, cw=0 so bonus matches
+            # can never stand in for a missing required/family slot
+            for s in b.shoulds:
+                if len(s.terms) > 1 and s.msm > 1:
+                    return None
+                bonus.extend(slot_weights(s))
+        else:
+            if fam:
+                return None                    # two constrained families
+            if all(len(s.terms) == 1 for s in b.shoulds):
+                for s in b.shoulds:
+                    fam.extend(slot_weights(s))
+                fam_msm = outer
+            elif len(b.shoulds) == 1 and outer == 1:
+                g = b.shoulds[0]
+                fam.extend(slot_weights(g))
+                fam_msm = max(int(g.msm), 1)
+            else:
+                return None
+
+    filter_clauses = ([(f, False) for f in b.filters]
+                      + [(n, True) for n in b.must_nots])
+    slots = ([(t, w, REQ_W) for t, w in req]
+             + [(t, w, 1.0) for t, w in fam]
+             + [(t, w, 0.0) for t, w in bonus])
+    if not slots and not filter_clauses:
+        return None                            # empty bool = match_all
+    if len(slots) > MAX_T:
+        return None
+    return FastSpec("bool", slots=slots, fam_msm=fam_msm,
+                    filter_clauses=filter_clauses, field=field, sim=sim,
+                    has_norms=has_norms, boost=float(b.boost),
+                    const_score=0.0 if not slots else None)
+
+
+def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
+              search_after, window: int, body: dict) -> Optional[FastSpec]:
+    """-> FastSpec when this search can ride a fused kernel, else None."""
+    if not _body_eligible(sort_specs, agg_nodes, named_nodes, search_after,
+                          window, body):
+        return None
+    if _ok_group(lroot) and next_pow2(len(lroot.terms), floor=1) <= MAX_T:
+        return FastSpec("pure", lt=lroot, field=lroot.field)
+    return _flatten_bool(lroot)
+
+
 class _VQuery:
     """One kernel-row: a whole query, or one doc-range chunk of it."""
 
@@ -157,39 +306,38 @@ class _VQuery:
             setattr(self, k, v)
 
 
-def _chunk_slices(al: AlignedPostings, pb, rows: np.ndarray, ndocs: int
-                  ) -> Optional[List[np.ndarray]]:
-    """Split a query whose postings exceed the VMEM budget into doc-range
-    chunks: uniform doc-id edges, verified against exact per-(term, chunk)
-    posting counts (host searchsorted over the ORIGINAL CSR), doubling the
-    chunk count until every chunk fits. Returns per-chunk
-    [T, 4] = (rowstart_rows, nrows, lens, edge_lo) arrays via a list of
-    (dlo, dhi, rowstarts, nrows, lens) tuples; None -> fall back."""
-    T_pad = len(rows)
-    budget = MAX_TL // T_pad          # elements per term slot
-    nchunk = 2
+def _chunk_slots(slots: List[Optional[Tuple[np.ndarray, int]]], ndocs: int,
+                 T_total: int, nchunk: int = 2
+                 ) -> Optional[List[tuple]]:
+    """Split a query whose slot windows exceed the VMEM budget into
+    doc-range chunks: uniform doc-id edges, verified against exact
+    per-(slot, chunk) posting counts (host searchsorted over the ORIGINAL
+    sorted doc lists), doubling the chunk count until every chunk fits.
+    `slots[i]` = (sorted_docs, aligned_start_elem) or None for an absent
+    slot (term/filter buffers alike — rowstarts are per-buffer row units).
+    Returns a list of (dlo, dhi, rowstarts, nrows, lens) tuples covering
+    disjoint doc ranges; None -> fall back."""
+    budget = MAX_TL // T_total        # elements per slot
     while nchunk <= MAX_CHUNKS:
         edges = np.linspace(0, ndocs, nchunk + 1).astype(np.int64)
         edges[-1] = np.int64(2**31 - 1)
         ok = True
         per_chunk = []
         for c in range(nchunk):
-            rowstarts = np.zeros(T_pad, np.int32)
-            nrows = np.zeros(T_pad, np.int32)
-            lens = np.zeros(T_pad, np.int32)
+            rowstarts = np.zeros(T_total, np.int32)
+            nrows = np.zeros(T_total, np.int32)
+            lens = np.zeros(T_total, np.int32)
             max_nr = HBM_ALIGN // LANES
-            for i, r in enumerate(rows):
-                if r < 0:
+            for i, slot in enumerate(slots):
+                if slot is None:
                     continue
-                a, b = pb.row_slice(r)
-                seg_docs = pb.doc_ids[a:b]
+                seg_docs, start_el = slot
                 lo_off = int(np.searchsorted(seg_docs, edges[c], "left"))
                 hi_off = int(np.searchsorted(seg_docs, edges[c + 1], "left"))
                 if hi_off == lo_off:
                     continue
                 # align the DMA start down to the HBM tile; the doc-range
                 # window masks the spilled-in prefix
-                start_el = int(al.starts_rows[r]) * LANES
                 al_off = (lo_off // HBM_ALIGN) * HBM_ALIGN
                 ln = hi_off - al_off
                 if ln > budget:
@@ -203,7 +351,7 @@ def _chunk_slices(al: AlignedPostings, pb, rows: np.ndarray, ndocs: int
                 max_nr = max(max_nr, nr)
             if not ok:
                 break
-            if T_pad * max_nr * LANES > MAX_TL:
+            if T_total * max_nr * LANES > MAX_TL:
                 ok = False
                 break
             per_chunk.append((int(edges[c]), int(edges[c + 1]),
@@ -212,6 +360,21 @@ def _chunk_slices(al: AlignedPostings, pb, rows: np.ndarray, ndocs: int
             return per_chunk
         nchunk *= 2
     return None
+
+
+def _term_slot(al: AlignedPostings, pb, r: int
+               ) -> Optional[Tuple[np.ndarray, int]]:
+    if r < 0:
+        return None
+    a, b = pb.row_slice(r)
+    return pb.doc_ids[a:b], int(al.starts_rows[r]) * LANES
+
+
+def _chunk_slices(al: AlignedPostings, pb, rows: np.ndarray, ndocs: int
+                  ) -> Optional[List[tuple]]:
+    """Doc-range chunk decomposition for the pure term-group path."""
+    return _chunk_slots([_term_slot(al, pb, int(r)) for r in rows], ndocs,
+                        len(rows))
 
 
 def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
@@ -342,23 +505,274 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
     return out
 
 
-def segment_search(seg: Segment, ctx, lt, k: int) -> Optional[dict]:
-    """Run the fused kernel for LTerms `lt` over one segment. Returns a dict
-    shaped like compiler.run_segment output, or None to fall back."""
-    res = batch_search(seg, ctx, [lt], k)
+# ---------------------------------------------------------------------
+# bool/filtered path: filter doc lists + weighted-threshold kernel rows
+# ---------------------------------------------------------------------
+
+class FilterList:
+    """Aligned sorted doc-id list for one (segment, filter conjunction) —
+    the fastpath analog of the reference's cached filter bitsets
+    (IndicesQueryCache): built once from the XLA path's dense masks, then
+    every query carrying this filter rides it as a merge slot."""
+
+    __slots__ = ("host_docs", "d_docs", "n", "nbytes", "__weakref__")
+
+    def __init__(self, host_docs: np.ndarray, d_docs, n: int, nbytes: int):
+        self.host_docs = host_docs
+        self.d_docs = d_docs
+        self.n = n
+        self.nbytes = nbytes
+
+
+_MAX_FILTER_LISTS = 32      # per segment
+
+
+def _filter_list(seg: Segment, ctx, clauses) -> Optional[FilterList]:
+    """Combined (ANDed) filter doc list for [(node, negated), ...]; cached
+    per segment (LRU) keyed by the clauses' mask-cache digests — a cache hit
+    costs only the host-cheap spec hashing, no mask materialization. None ->
+    fall back (a clause's params were too big to hash)."""
+    import collections
+
+    import jax
+
+    from . import compiler as C
+
+    cache = seg.__dict__.setdefault("_fastpath_filters",
+                                    collections.OrderedDict())
+    key_parts = []
+    prepped = []
+    for node, neg in clauses:
+        local: dict = {}
+        spec = C.prepare(node, seg, ctx, local)
+        mkey, mapping = C._filter_cache_key(spec, local, seg)
+        if mkey is None:
+            return None
+        key_parts.append((mkey, neg))
+        prepped.append((mkey, spec, local, mapping, neg))
+    key = tuple(key_parts)
+    fl = cache.get(key)
+    if fl is not None:
+        cache.move_to_end(key)
+        return fl
+    nd = seg.ndocs
+    combined = np.ones(nd, bool)
+    for mkey, spec, local, mapping, neg in prepped:
+        mask = np.asarray(C._mask_for_key(mkey, spec, local, mapping, seg))
+        m = mask[:nd].astype(bool)
+        combined &= ~m if neg else m
+    docs = np.nonzero(combined)[0].astype(np.int32)
+    n = len(docs)
+    total = ((n + HBM_ALIGN - 1) // HBM_ALIGN) * HBM_ALIGN + MAX_L
+    total = ((total + LANES - 1) // LANES) * LANES
+    buf = np.full(total, INT_SENTINEL, np.int32)
+    buf[:n] = docs
+    fl = FilterList(docs, jax.device_put(buf), n, buf.nbytes)
+    if _breaker is not None:
+        import weakref
+        _breaker.add_estimate(buf.nbytes, f"fastpath-filter[{seg.name}]")
+        weakref.finalize(fl, _breaker.release, buf.nbytes)
+    while len(cache) >= _MAX_FILTER_LISTS:
+        cache.popitem(last=False)
+    cache[key] = fl
+    return fl
+
+
+_dummy_hbm_arr = None
+
+
+def _dummy_hbm():
+    """Minimal aligned HBM operand for the unused buffer slots."""
+    global _dummy_hbm_arr
+    if _dummy_hbm_arr is None:
+        import jax
+        _dummy_hbm_arr = jax.device_put(
+            np.full(HBM_ALIGN, INT_SENTINEL, np.int32))
+    return _dummy_hbm_arr
+
+
+class _BVQuery:
+    """One bool-kernel row: a whole query, or one doc-range chunk of it."""
+
+    __slots__ = ("qi", "TS", "T", "L", "filtered", "rowstarts", "nrows",
+                 "lens", "weights", "cw", "thresh", "avgdl", "dlo", "dhi",
+                 "field", "k1", "b_eff", "fl")
+
+    def __init__(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+
+
+def _prepare_bool_vqueries(seg: Segment, ctx, specs: Sequence[FastSpec],
+                           avgdl_cache: dict
+                           ) -> List[Optional[List[_BVQuery]]]:
+    out: List[Optional[List[_BVQuery]]] = []
+    for qi, spec in enumerate(specs):
+        fl = None
+        if spec.filter_clauses:
+            fl = _filter_list(seg, ctx, spec.filter_clauses)
+            if fl is None:
+                out.append(None)
+                continue
+        nslots = len(spec.slots)
+        TS = next_pow2(max(nslots, 1), floor=1)
+        filtered = fl is not None
+        T = 2 * TS if filtered else TS
+        al = pb = None
+        if nslots:
+            al = get_aligned(seg, spec.field)
+            pb = seg.postings.get(spec.field)
+            if al is None or pb is None:
+                out.append(None)
+                continue
+        weights = np.zeros(TS, np.float32)
+        cw = np.zeros(T, np.float32)
+        slot_descs: List[Optional[Tuple[np.ndarray, int]]] = [None] * T
+        for i, (term, w, cwv) in enumerate(spec.slots):
+            weights[i] = w
+            cw[i] = cwv
+            r = pb.row(term)
+            if r >= 0:
+                slot_descs[i] = _term_slot(al, pb, r)
+        if filtered:
+            cw[TS] = REQ_W
+            slot_descs[TS] = (fl.host_docs, 0)
+        thresh = REQ_W * (spec.n_required + (1 if filtered else 0)) \
+            + spec.fam_msm
+        if spec.field is not None and spec.field not in avgdl_cache:
+            avgdl_cache[spec.field] = np.float32(ctx.avgdl(spec.field))
+        avgdl = avgdl_cache.get(spec.field, np.float32(1.0))
+        k1 = float(spec.sim.k1) if spec.sim is not None else 1.2
+        b_eff = (float(spec.sim.b)
+                 if spec.sim is not None and spec.has_norms else 0.0)
+        chunks = _chunk_slots(slot_descs, seg.ndocs, T, nchunk=1)
+        if chunks is None:
+            out.append(None)
+            continue
+        vqs = []
+        for dlo, dhi, rowstarts, nrows, lens in chunks:
+            L = int(max(int(nrows.max()), HBM_ALIGN // LANES)) * LANES
+            vqs.append(_BVQuery(qi=qi, TS=TS, T=T, L=L, filtered=filtered,
+                                rowstarts=rowstarts, nrows=nrows, lens=lens,
+                                weights=weights, cw=cw,
+                                thresh=np.float32(thresh), avgdl=avgdl,
+                                dlo=dlo, dhi=dhi, field=spec.field, k1=k1,
+                                b_eff=b_eff, fl=fl))
+        out.append(vqs)
+    return out
+
+
+def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
+              ) -> List[Optional[dict]]:
+    vq_lists = _prepare_bool_vqueries(seg, ctx, specs, {})
+    groups = {}
+    for vqs in vq_lists:
+        if vqs is None:
+            continue
+        for vq in vqs:
+            gk = (vq.field, vq.TS, vq.filtered,
+                  id(vq.fl) if vq.fl is not None else None, vq.k1, vq.b_eff)
+            groups.setdefault(gk, []).append(vq)
+    results = {}
+    for (field, TS, filtered, _flid, k1, b_eff), gvqs in groups.items():
+        if field is not None:
+            al = get_aligned(seg, field)
+            d_docs, d_tfdl = al.d_docs, al.d_tfdl
+        else:
+            d_docs = d_tfdl = _dummy_hbm()
+        fl = gvqs[0].fl
+        filt = fl.d_docs if fl is not None else _dummy_hbm()
+        L = max(v.L for v in gvqs)
+        rowstarts = np.stack([v.rowstarts for v in gvqs])
+        nrows = np.stack([v.nrows for v in gvqs])
+        lens = np.stack([v.lens for v in gvqs])
+        weights = np.stack([v.weights for v in gvqs])
+        cw = np.stack([v.cw for v in gvqs])
+        thresh = np.array([[v.thresh] for v in gvqs], np.float32)
+        avg = np.array([[v.avgdl] for v in gvqs], np.float32)
+        dlo = np.array([[v.dlo] for v in gvqs], np.int32)
+        dhi = np.array([[v.dhi] for v in gvqs], np.int32)
+        scores, docs, totals = fused_bm25_bool_topk(
+            d_docs, d_tfdl, filt, rowstarts, nrows, lens, weights, cw,
+            thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,
+            filtered=filtered)
+        scores = np.asarray(scores)
+        docs = np.asarray(docs)
+        totals = np.asarray(totals)
+        for j, vq in enumerate(gvqs):
+            results[id(vq)] = (scores[j][:K], docs[j][:K],
+                               int(totals[j][0]))
+    out: List[Optional[dict]] = []
+    for qi, vqs in enumerate(vq_lists):
+        if vqs is None:
+            out.append(None)
+            continue
+        if len(vqs) == 1:
+            sc, dc, total = results[id(vqs[0])]
+        else:
+            parts = [results[id(v)] for v in vqs]
+            sc_all = np.concatenate([p[0] for p in parts])
+            dc_all = np.concatenate([p[1] for p in parts])
+            total = sum(p[2] for p in parts)
+            order = np.lexsort((dc_all, -sc_all))[:K]
+            sc = sc_all[order]
+            dc = dc_all[order]
+        spec = specs[qi]
+        finite = np.isfinite(sc)
+        if spec.const_score is not None:
+            sc = np.where(finite, np.float32(spec.const_score), -np.inf)
+        elif spec.boost != 1.0:
+            sc = np.where(finite, sc * np.float32(spec.boost), -np.inf)
+        total_i = int(total)
+        ms = float(sc[0]) if total_i > 0 and np.isfinite(sc[0]) else -np.inf
+        out.append({"topk_key": sc, "topk_idx": dc, "topk_scores": sc,
+                    "total": total_i, "max_score": ms})
+    return out
+
+
+def segment_search(seg: Segment, ctx, spec: FastSpec, k: int
+                   ) -> Optional[dict]:
+    """Run the fused kernel for one FastSpec over one segment. Returns a
+    dict shaped like compiler.run_segment output, or None to fall back."""
+    res = batch_search(seg, ctx, [spec], k)
     return res[0] if res else None
 
 
-def batch_search(seg: Segment, ctx, lts: Sequence, k: int
+def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
+                 count_stats: bool = True
                  ) -> Optional[List[Optional[dict]]]:
-    """Many LTerms over ONE segment in as few kernel launches as possible
-    (grid over queries — the server-side query batching a TPU search tier
-    runs on). Oversized posting rows split into doc-range chunks that ride
-    the same launches. Per-query fallbacks are None entries."""
+    """Many FastSpecs over ONE segment in as few kernel launches as
+    possible (grid over queries — the server-side query batching a TPU
+    search tier runs on). Pure term groups and bool/filtered shapes each
+    batch into their own launches; oversized posting rows split into
+    doc-range chunks that ride the same launches. Per-query fallbacks are
+    None entries."""
     if seg.live_count != seg.ndocs:
         return None
-    vq_lists = _prepare_vqueries(seg, ctx, lts, {})
-    if vq_lists is None:
-        return None
     K = min(next_pow2(max(k, 16)), MAX_K)
-    return _run_vqueries(seg, vq_lists, K)
+    out: List[Optional[dict]] = [None] * len(specs)
+    pure_idx = [i for i, s in enumerate(specs) if s.kind == "pure"]
+    bool_idx = [i for i, s in enumerate(specs) if s.kind == "bool"]
+    if pure_idx:
+        vq_lists = _prepare_vqueries(seg, ctx,
+                                     [specs[i].lt for i in pure_idx], {})
+        if vq_lists is not None:
+            for i, r in zip(pure_idx, _run_vqueries(seg, vq_lists, K)):
+                out[i] = r
+    if bool_idx:
+        for i, r in zip(bool_idx,
+                        _run_bool(seg, ctx, [specs[i] for i in bool_idx], K)):
+            out[i] = r
+    if count_stats:
+        count_served(specs, out)
+    return out
+
+
+def count_served(specs: Sequence[FastSpec], outs: Sequence[Optional[dict]]
+                 ) -> None:
+    for spec, r in zip(specs, outs):
+        if r is None:
+            STATS["fallback"] += 1
+        else:
+            STATS["pure_served" if spec.kind == "pure"
+                  else "bool_served"] += 1

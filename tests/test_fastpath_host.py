@@ -145,3 +145,121 @@ class TestEligibility:
         import dataclasses
         assert not fastpath.query_eligible(
             dataclasses.replace(lt, mode="filter"), [], [], [], None, 10, {})
+
+
+def _spec(ctx, qbody, **kw):
+    q = dsl.parse_query(qbody)
+    node = C.rewrite(q, ctx, scoring=True)
+    return fastpath.make_spec(node, kw.get("sort", []), kw.get("aggs", []),
+                              kw.get("named", []), kw.get("after"),
+                              kw.get("window", 10), kw.get("body", {}))
+
+
+class TestBoolSpec:
+    """FastSpec flattening of bool trees onto the weighted-threshold slot
+    model (kernel parity itself runs in tests_tpu/test_fastpath_bool.py)."""
+
+    def test_pure_match_is_pure(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"match": {"body": "rare1 rare2"}})
+        assert s is not None and s.kind == "pure"
+
+    def test_filtered_match(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"bool": {
+            "must": [{"match": {"body": "rare1 rare2"}}],
+            "filter": [{"term": {"body": "common"}}]}})
+        assert s is not None and s.kind == "bool"
+        # OR-match group: both terms optional (family) with msm 1
+        assert [cw for _, _, cw in s.slots] == [1.0, 1.0]
+        assert s.fam_msm == 1
+        assert len(s.filter_clauses) == 1
+        assert s.n_required == 0
+
+    def test_and_match_promotes_to_required(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"bool": {
+            "must": [{"match": {"body": {"query": "rare1 rare2",
+                                         "operator": "and"}}}],
+            "filter": [{"term": {"body": "common"}}]}})
+        assert s is not None
+        assert all(cw == fastpath.REQ_W for _, _, cw in s.slots)
+
+    def test_bonus_shoulds_zero_count_weight(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"bool": {
+            "must": [{"term": {"body": "common"}}],
+            "should": [{"term": {"body": "rare1"}}]}})
+        assert s is not None
+        assert [cw for _, _, cw in s.slots] == [fastpath.REQ_W, 0.0]
+        assert s.fam_msm == 0
+
+    def test_should_msm_family(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"bool": {
+            "should": [{"term": {"body": "rare1"}},
+                       {"term": {"body": "rare2"}},
+                       {"term": {"body": "rare3"}}],
+            "minimum_should_match": 2,
+            "filter": [{"term": {"body": "common"}}]}})
+        assert s is not None
+        assert [cw for _, _, cw in s.slots] == [1.0, 1.0, 1.0]
+        assert s.fam_msm == 2
+
+    def test_two_constrained_families_fall_back(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"bool": {
+            "must": [{"match": {"body": {"query": "rare1 rare2 rare5",
+                                         "minimum_should_match": 2}}},
+                     {"match": {"body": {"query": "rare3 rare4 rare6",
+                                         "minimum_should_match": 2}}}]}})
+        assert s is None
+        # msm == nterms promotes to all-required: two such groups are fine
+        s2 = _spec(ctx, {"bool": {
+            "must": [{"match": {"body": {"query": "rare1 rare2",
+                                         "minimum_should_match": 2}}},
+                     {"match": {"body": {"query": "rare3 rare4",
+                                         "minimum_should_match": 2}}}]}})
+        assert s2 is not None and s2.n_required == 4
+
+    def test_filter_only_and_const_score(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"bool": {"filter": [{"term": {"body": "common"}}]}})
+        assert s is not None and s.const_score == 0.0 and not s.slots
+        s2 = _spec(ctx, {"constant_score": {
+            "filter": {"term": {"body": "common"}}, "boost": 2.0}})
+        assert s2 is not None and s2.const_score == 2.0
+
+    def test_nested_bool_falls_back(self, seg_ctx):
+        _, ctx = seg_ctx
+        s = _spec(ctx, {"bool": {
+            "must": [{"bool": {"must": [{"term": {"body": "rare1"}}]}}],
+            "filter": [{"term": {"body": "common"}}]}})
+        assert s is None
+
+    def test_empty_bool_falls_back(self, seg_ctx):
+        _, ctx = seg_ctx
+        assert _spec(ctx, {"bool": {}}) is None
+
+    def test_body_gates_apply(self, seg_ctx):
+        _, ctx = seg_ctx
+        q = {"bool": {"must": [{"term": {"body": "rare1"}}],
+                      "filter": [{"term": {"body": "common"}}]}}
+        assert _spec(ctx, q, aggs=["a"]) is None
+        assert _spec(ctx, q, window=4096) is None
+
+    def test_filter_list_build(self, seg_ctx):
+        seg, ctx = seg_ctx
+        q = dsl.parse_query({"term": {"body": "common"}})
+        node = C.rewrite(q, ctx, scoring=False)
+        fl = fastpath._filter_list(seg, ctx, [(node, False)])
+        assert fl is not None
+        pb = seg.postings["body"]
+        r = pb.row("common")
+        a, b = pb.row_slice(r)
+        np.testing.assert_array_equal(fl.host_docs, pb.doc_ids[a:b])
+        # negated clause = complement
+        fl2 = fastpath._filter_list(seg, ctx, [(node, True)])
+        assert fl2.n == seg.ndocs - fl.n
+        # cached on repeat
+        assert fastpath._filter_list(seg, ctx, [(node, False)]) is fl
