@@ -90,6 +90,10 @@ def _load():
     lib.osn_pack_export.restype = None
     lib.osn_pack_export.argtypes = [ctypes.c_void_p, i64p, i32p, f32p, i64p,
                                     i32p, u8p, i64p]
+    lib.osn_maxscore_topk.restype = ctypes.c_int64
+    lib.osn_maxscore_topk.argtypes = [i64p, i32p, f32p, f32p, f32p, f32p,
+                                      i32p, ctypes.c_int32, ctypes.c_int32,
+                                      ctypes.c_int32, u8p, i32p, f32p, i64p]
     _lib = lib
     return _lib
 
@@ -189,3 +193,56 @@ class Packer:
             self.close()
         except Exception:
             pass
+
+
+def maxscore_topk(starts: np.ndarray, doc_ids: np.ndarray, tfs: np.ndarray,
+                  kdoc: np.ndarray, idf: np.ndarray, ub: np.ndarray,
+                  qterms: np.ndarray, msm: int, k: int,
+                  filt: Optional[np.ndarray] = None):
+    """Skipping (MaxScore/conjunction) BM25 top-k over one CSR field — the
+    Lucene-BulkScorer-class CPU baseline used by bench.py, also a parity
+    oracle for tests. qterms: i32[nt] term rows (-1 pad). msm: minimum
+    matching terms (nt = conjunction). filt: optional u8[ndocs] 0/1 mask.
+    -> (docs i32[k] (-1 pad), scores f32[k], total int — exact for the
+    conjunction path, -1 when the MaxScore path early-terminated)."""
+    lib = _load()
+    if len(qterms) > 64:
+        raise ValueError("maxscore_topk supports at most 64 query terms")
+    starts = np.ascontiguousarray(starts, np.int64)
+    doc_ids = np.ascontiguousarray(doc_ids, np.int32)
+    tfs = np.ascontiguousarray(tfs, np.float32)
+    kdoc = np.ascontiguousarray(kdoc, np.float32)
+    idf = np.ascontiguousarray(idf, np.float32)
+    ub = np.ascontiguousarray(ub, np.float32)
+    qterms = np.ascontiguousarray(qterms, np.int32)
+    fptr = None
+    if filt is not None:
+        filt = np.ascontiguousarray(filt, np.uint8)
+        fptr = _u8(filt)
+    k = min(k, 256)
+    out_docs = np.empty(k, np.int32)
+    out_scores = np.empty(k, np.float32)
+    out_total = np.zeros(1, np.int64)
+    lib.osn_maxscore_topk(
+        _ptr(starts, ctypes.c_int64), _ptr(doc_ids, ctypes.c_int32),
+        _ptr(tfs, ctypes.c_float), _ptr(kdoc, ctypes.c_float),
+        _ptr(idf, ctypes.c_float), _ptr(ub, ctypes.c_float),
+        _ptr(qterms, ctypes.c_int32), len(qterms), msm, k, fptr,
+        _ptr(out_docs, ctypes.c_int32), _ptr(out_scores, ctypes.c_float),
+        _ptr(out_total, ctypes.c_int64))
+    return out_docs, out_scores, int(out_total[0])
+
+
+def term_upper_bounds(starts: np.ndarray, doc_ids: np.ndarray,
+                      tfs: np.ndarray, kdoc: np.ndarray,
+                      idf: np.ndarray) -> np.ndarray:
+    """Per-term MaxScore upper bounds idf_t * max_d tf/(tf+kdoc[d]),
+    vectorized on host (one pass over the postings)."""
+    contrib = tfs / (tfs + kdoc[doc_ids])
+    nterms = len(starts) - 1
+    ub = np.zeros(nterms, np.float32)
+    nonempty = np.flatnonzero(np.diff(starts) > 0)
+    if len(nonempty):
+        maxes = np.maximum.reduceat(contrib, starts[nonempty])
+        ub[nonempty] = maxes.astype(np.float32)
+    return ub * idf[:nterms]

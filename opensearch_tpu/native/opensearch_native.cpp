@@ -244,4 +244,212 @@ void osn_pack_export(void* h, int64_t* starts, int32_t* doc_ids, float* tfs,
   std::memcpy(vocab_offs, p->vocab_offs.data(), p->vocab_offs.size() * 8);
 }
 
+// ---------------------------------------------------------------------------
+// MaxScore / conjunction BM25 top-k over CSR postings — the bench's honest
+// CPU baseline (the skipping scorer class Lucene runs: MaxScoreBulkScorer /
+// ConjunctionDISI, reference `search/query/QueryPhase.java`). Document-at-a-
+// time with per-term upper bounds, galloping cursor advance, and a strict-
+// tie top-k heap (score desc, doc asc) identical to the device collector.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct HeapEnt {
+  float score;
+  int32_t doc;
+};
+
+// min-heap ordering: the WORST entry (lowest score, then largest doc) at root
+static inline bool heap_worse(const HeapEnt& a, const HeapEnt& b) {
+  return a.score < b.score || (a.score == b.score && a.doc > b.doc);
+}
+
+struct TopK {
+  HeapEnt h[256];
+  int n = 0, k;
+  explicit TopK(int kk) : k(kk) {}
+  bool full() const { return n == k; }
+  float theta() const { return n == k ? h[0].score : -1e30f; }
+  bool competitive(float s, int32_t d) const {
+    if (n < k) return true;
+    return s > h[0].score || (s == h[0].score && d < h[0].doc);
+  }
+  void sift_down(int i) {
+    for (;;) {
+      int l = 2 * i + 1, r = l + 1, m = i;
+      if (l < n && heap_worse(h[l], h[m])) m = l;
+      if (r < n && heap_worse(h[r], h[m])) m = r;
+      if (m == i) return;
+      std::swap(h[i], h[m]);
+      i = m;
+    }
+  }
+  void push(float s, int32_t d) {
+    if (n < k) {
+      h[n] = {s, d};
+      int i = n++;
+      while (i && heap_worse(h[i], h[(i - 1) / 2])) {
+        std::swap(h[i], h[(i - 1) / 2]);
+        i = (i - 1) / 2;
+      }
+    } else {
+      h[0] = {s, d};
+      sift_down(0);
+    }
+  }
+  // fill out[0..k) score-desc, doc-asc; -1 pad
+  void drain(int32_t* docs, float* scores) {
+    std::sort(h, h + n, [](const HeapEnt& a, const HeapEnt& b) {
+      return a.score > b.score || (a.score == b.score && a.doc < b.doc);
+    });
+    for (int i = 0; i < n; i++) {
+      docs[i] = h[i].doc;
+      scores[i] = h[i].score;
+    }
+    for (int i = n; i < k; i++) {
+      docs[i] = -1;
+      scores[i] = -1e30f;
+    }
+  }
+};
+
+// gallop `pos` forward until docs[pos] >= target (docs ascending)
+static inline int64_t gallop(const int32_t* docs, int64_t pos, int64_t end,
+                             int32_t target) {
+  if (pos >= end || docs[pos] >= target) return pos;
+  int64_t step = 1, lo = pos;
+  while (pos + step < end && docs[pos + step] < target) {
+    lo = pos + step;
+    step <<= 1;
+  }
+  int64_t hi = std::min(pos + step, end);
+  while (lo < hi) {
+    int64_t mid = (lo + hi) >> 1;
+    if (docs[mid] < target) lo = mid + 1; else hi = mid;
+  }
+  return lo;
+}
+
+}  // namespace
+
+// One query: `nt` term rows from the CSR, OR/msm or conjunction semantics,
+// optional dense 0/1 filter. Returns number of hits written; totals[0] gets
+// the exact hit count for the conjunction path, -1 for the early-terminating
+// MaxScore path (Lucene likewise lower-bounds totals when it skips).
+int64_t osn_maxscore_topk(const int64_t* starts, const int32_t* doc_ids,
+                          const float* tfs, const float* kdoc,
+                          const float* idf, const float* ub,
+                          const int32_t* qterms, int32_t nt, int32_t msm,
+                          int32_t k, const uint8_t* filter,
+                          int32_t* out_docs, float* out_scores,
+                          int64_t* out_total) {
+  TopK top(k);
+  // per-term state, dropping absent/empty rows
+  int32_t tid[64];
+  int64_t cur[64], end_[64];
+  float tub[64];
+  int T = 0;
+  for (int i = 0; i < nt && i < 64; i++) {
+    int32_t t = qterms[i];
+    if (t < 0 || starts[t] == starts[t + 1]) continue;
+    tid[T] = t;
+    cur[T] = starts[t];
+    end_[T] = starts[t + 1];
+    tub[T] = ub[t];
+    T++;
+  }
+  if (T == 0 || msm > T) {
+    *out_total = 0;
+    top.drain(out_docs, out_scores);
+    return 0;
+  }
+
+  if (msm >= T) {
+    // conjunction (ConjunctionDISI): drive on the rarest term, gallop rest
+    int drv = 0;
+    for (int i = 1; i < T; i++)
+      if (end_[i] - cur[i] < end_[drv] - cur[drv]) drv = i;
+    int64_t total = 0;
+    for (int64_t p = cur[drv]; p < end_[drv]; p++) {
+      int32_t d = doc_ids[p];
+      if (filter && !filter[d]) continue;
+      float s = idf[tid[drv]] * tfs[p] / (tfs[p] + kdoc[d]);
+      bool all = true;
+      for (int i = 0; i < T; i++) {
+        if (i == drv) continue;
+        cur[i] = gallop(doc_ids, cur[i], end_[i], d);
+        if (cur[i] >= end_[i] || doc_ids[cur[i]] != d) {
+          all = false;
+          break;
+        }
+        s += idf[tid[i]] * tfs[cur[i]] / (tfs[cur[i]] + kdoc[d]);
+      }
+      if (!all) continue;
+      total++;
+      if (top.competitive(s, d)) top.push(s, d);
+    }
+    *out_total = total;
+    int n = top.n;
+    top.drain(out_docs, out_scores);
+    return n;
+  }
+
+  // MaxScore OR: terms ascending by upper bound; prefix[i] = sum ub[0..i]
+  int ord[64];
+  for (int i = 0; i < T; i++) ord[i] = i;
+  std::sort(ord, ord + T, [&](int a, int b) { return tub[a] < tub[b]; });
+  float prefix[64];
+  float acc = 0;
+  for (int i = 0; i < T; i++) {
+    acc += tub[ord[i]];
+    prefix[i] = acc;
+  }
+  int ne = 0;  // terms ord[0..ne) are non-essential
+  for (;;) {
+    // next candidate: min current doc among essential terms
+    int32_t d = INT32_MAX;
+    for (int j = ne; j < T; j++) {
+      int i = ord[j];
+      if (cur[i] < end_[i] && doc_ids[cur[i]] < d) d = doc_ids[cur[i]];
+    }
+    if (d == INT32_MAX) break;
+    float s = 0;
+    int cnt = 0;
+    for (int j = ne; j < T; j++) {
+      int i = ord[j];
+      if (cur[i] < end_[i] && doc_ids[cur[i]] == d) {
+        s += idf[tid[i]] * tfs[cur[i]] / (tfs[cur[i]] + kdoc[d]);
+        cnt++;
+        cur[i]++;
+      }
+    }
+    if (filter && !filter[d]) continue;
+    float theta = top.theta();
+    // try non-essential terms in descending bound order, pruning when even
+    // their full upper bounds cannot reach the heap floor (strict: equal
+    // score can still win on the doc-asc tie-break)
+    for (int j = ne - 1; j >= 0; j--) {
+      if (top.full() && s + prefix[j] < theta) break;
+      int i = ord[j];
+      cur[i] = gallop(doc_ids, cur[i], end_[i], d);
+      if (cur[i] < end_[i] && doc_ids[cur[i]] == d) {
+        s += idf[tid[i]] * tfs[cur[i]] / (tfs[cur[i]] + kdoc[d]);
+        cnt++;
+        cur[i]++;
+      }
+    }
+    if (cnt >= msm && top.competitive(s, d)) {
+      top.push(s, d);
+      // grow the non-essential set as the heap floor rises
+      float th = top.theta();
+      if (top.full())
+        while (ne < T - 1 && prefix[ne] < th) ne++;
+    }
+  }
+  *out_total = -1;  // early-terminating scorer: exact totals not tracked
+  int n = top.n;
+  top.drain(out_docs, out_scores);
+  return n;
+}
+
 }  // extern "C"

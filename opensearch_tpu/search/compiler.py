@@ -1682,7 +1682,7 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
                 ast = pl.parse(fn.script or "")
                 field_srcs, pkeys = _prepare_script(ast, fn.script_params or {},
                                                     seg, params, nid, f"fn{i}s")
-                fn_specs.append(("script", i, ast, field_srcs, pkeys, fspec))
+                fn_specs.append(("fnscript", i, ast, field_srcs, pkeys, fspec))
             elif fn.kind == "decay":
                 fn_specs.append(_prepare_decay(fn, i, nid, seg, ctx, params,
                                                fspec))
@@ -2494,7 +2494,7 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
                 h = h * jnp.uint32(0x45D9F3B)
                 h = h ^ (h >> 16)
                 v = h.astype(jnp.float32) / jnp.float32(2**32)
-            elif fkind == "script":
+            elif fkind == "fnscript":
                 _, _, s_ast, s_fields, s_pkeys, fspec = fs
                 env = _script_env(jnp, s_fields, s_pkeys, nid, f"fn{i}s",
                                   seg_arrays, params, child.scores, ndocs_pad)
@@ -4415,11 +4415,26 @@ def _build_mask_executor(spec):
     return jax.jit(run)
 
 
+# spec kinds whose second element is a node id (everything `prepare`
+# returns with a nid head). Only these are renumbered — other (str, int)
+# tuples (e.g. function-score sub-specs ("fvf", i, ...)) keep their ints.
+_NID_KINDS = frozenset({
+    "terms", "xterms", "phrase", "match_all", "match_none", "range",
+    "exists", "ids", "bool", "const", "dismax", "boosting", "fnscore",
+    "nested", "has_child", "has_parent", "rank_feature_col",
+    "rank_feature_post", "sparse_dot", "distfeat_date", "distfeat_geo",
+    "percolate", "script", "scriptscore", "knn", "span_host", "geodist",
+    "geobox", "terms_set", "pinned", "combined", "geopoly", "geoshape",
+    "cached_mask",
+})
+
+
 def _canon_spec(spec, mapping: Dict[int, int]):
     """Renumber node ids by first appearance so structurally identical
-    filter specs hash equal across queries (nids are a global counter)."""
+    specs hash equal across queries (nids are a global counter)."""
     if (isinstance(spec, tuple) and len(spec) >= 2
-            and isinstance(spec[0], str) and isinstance(spec[1], int)):
+            and isinstance(spec[0], str) and isinstance(spec[1], int)
+            and spec[0] in _NID_KINDS):
         cid = mapping.setdefault(spec[1], len(mapping))
         return (spec[0], cid) + tuple(_canon_spec(x, mapping)
                                       for x in spec[2:])
@@ -4599,9 +4614,17 @@ def _build_executor(full_spec):
 def run_segment(query_spec, sort_spec, agg_specs, named_specs, k_pad: int,
                 seg_arrays: dict, params: dict, has_after: bool = False,
                 collapse_spec=None) -> dict:
-    exe = _build_executor((query_spec, sort_spec, tuple(agg_specs), k_pad,
-                           tuple(named_specs), has_after, collapse_spec))
-    return exe(seg_arrays, params)
+    # canonicalize node ids (nids come from a global counter) so
+    # structurally identical queries hit the same compiled executor instead
+    # of recompiling per request — the XLA analog of Lucene's per-shape
+    # query plan reuse
+    mapping: Dict[int, int] = {}
+    full = _canon_spec((query_spec, sort_spec, tuple(agg_specs), k_pad,
+                        tuple(named_specs), has_after, collapse_spec),
+                       mapping)
+    cparams = {_canon_param_key(k, mapping): v for k, v in params.items()}
+    exe = _build_executor(full)
+    return exe(seg_arrays, cparams)
 
 
 @lru_cache(maxsize=256)
@@ -4619,8 +4642,10 @@ def _build_gather_executor(query_spec):
 
 
 def run_gather_scores(query_spec, seg_arrays: dict, params: dict, docs: np.ndarray):
-    exe = _build_gather_executor(query_spec)
-    params = dict(params)
+    mapping: Dict[int, int] = {}
+    canon = _canon_spec(query_spec, mapping)
+    exe = _build_gather_executor(canon)
+    params = {_canon_param_key(k, mapping): v for k, v in params.items()}
     params["gather_docs"] = docs
     return exe(seg_arrays, params)
 
@@ -4645,4 +4670,7 @@ def _build_agg_executor(key):
 
 
 def run_agg_only(query_spec, agg_spec, seg_arrays: dict, params: dict):
-    return _build_agg_executor((query_spec, agg_spec))(seg_arrays, params)
+    mapping: Dict[int, int] = {}
+    canon = _canon_spec((query_spec, agg_spec), mapping)
+    cparams = {_canon_param_key(k, mapping): v for k, v in params.items()}
+    return _build_agg_executor(canon)(seg_arrays, cparams)

@@ -482,8 +482,16 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
         for j, vq in enumerate(gvqs):
             results[id(vq)] = (scores[j][:K], docs[j][:K],
                                int(totals[j][0]))
+    return _assemble(vq_lists, results, K)
+
+
+def _assemble(vq_lists, results: dict, K: int, transform=None
+              ) -> List[Optional[dict]]:
+    """Reassemble per-query outputs from per-kernel-row results (chunked
+    queries merge their chunk top-Ks on host; stable merge: score desc,
+    doc asc on ties, matching the kernel)."""
     out: List[Optional[dict]] = []
-    for vqs in vq_lists:
+    for qi, vqs in enumerate(vq_lists):
         if vqs is None:
             out.append(None)
             continue
@@ -494,10 +502,11 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
             sc_all = np.concatenate([p[0] for p in parts])
             dc_all = np.concatenate([p[1] for p in parts])
             total = sum(p[2] for p in parts)
-            # stable merge: score desc, doc asc on ties (matches the kernel)
             order = np.lexsort((dc_all, -sc_all))[:K]
             sc = sc_all[order]
             dc = dc_all[order]
+        if transform is not None:
+            sc = transform(qi, sc)
         total_i = int(total)
         ms = float(sc[0]) if total_i > 0 and np.isfinite(sc[0]) else -np.inf
         out.append({"topk_key": sc, "topk_idx": dc, "topk_scores": sc,
@@ -513,15 +522,21 @@ class FilterList:
     """Aligned sorted doc-id list for one (segment, filter conjunction) —
     the fastpath analog of the reference's cached filter bitsets
     (IndicesQueryCache): built once from the XLA path's dense masks, then
-    every query carrying this filter rides it as a merge slot."""
+    every query carrying this filter rides it as a merge slot (selective
+    filters) or triggers filter-specialized postings (dense filters)."""
 
-    __slots__ = ("host_docs", "d_docs", "n", "nbytes", "__weakref__")
+    __slots__ = ("host_docs", "d_docs", "n", "nbytes", "mask", "key",
+                 "hits", "__weakref__")
 
-    def __init__(self, host_docs: np.ndarray, d_docs, n: int, nbytes: int):
+    def __init__(self, host_docs: np.ndarray, d_docs, n: int, nbytes: int,
+                 mask: np.ndarray, key):
         self.host_docs = host_docs
         self.d_docs = d_docs
         self.n = n
         self.nbytes = nbytes
+        self.mask = mask          # dense bool[ndocs] (for materialization)
+        self.key = key
+        self.hits = 0
 
 
 _MAX_FILTER_LISTS = 32      # per segment
@@ -567,7 +582,7 @@ def _filter_list(seg: Segment, ctx, clauses) -> Optional[FilterList]:
     total = ((total + LANES - 1) // LANES) * LANES
     buf = np.full(total, INT_SENTINEL, np.int32)
     buf[:n] = docs
-    fl = FilterList(docs, jax.device_put(buf), n, buf.nbytes)
+    fl = FilterList(docs, jax.device_put(buf), n, buf.nbytes, combined, key)
     if _breaker is not None:
         import weakref
         _breaker.add_estimate(buf.nbytes, f"fastpath-filter[{seg.name}]")
@@ -576,6 +591,103 @@ def _filter_list(seg: Segment, ctx, clauses) -> Optional[FilterList]:
         cache.popitem(last=False)
     cache[key] = fl
     return fl
+
+
+# ---------------------------------------------------------------------
+# dense filters: filter-specialized postings
+# ---------------------------------------------------------------------
+#
+# The list-slot intersection pays O(filter size) merge work per query —
+# right for selective filters (Lucene's conjunction likewise walks the
+# rarer side), but a dense guardrail filter (status:published over half
+# the corpus) would cost more than the scoring itself. The TPU answer is
+# layout specialization: pre-intersect the postings with the filter ONCE
+# per (segment, field, filter), realign, and run every later query at
+# full pure-kernel speed — beating the reference, which re-walks its
+# cached bitset on every query (reference IndicesQueryCache +
+# ConjunctionDISI). Materialized on the filter's second use (dense +
+# hot), byte-bounded global LRU.
+
+_MATERIALIZE_MIN_DOCS = 1 << 18    # absolute floor
+_MATERIALIZE_DENSITY = 4           # n * density > ndocs -> "dense"
+_FILTERED_MAX_BYTES = 6 << 30
+_FILTERED_LRU: "OrderedDict[tuple, FilteredPostings]" = __import__(
+    "collections").OrderedDict()
+_FILTERED_BYTES = [0]
+
+
+class FilteredPostings:
+    """Filter-specialized aligned postings for one (segment, field,
+    filter): the term rows of `field` restricted to filter-passing docs."""
+
+    __slots__ = ("al", "starts", "host_docs", "nbytes", "__weakref__")
+
+    def __init__(self, al: AlignedPostings, starts: np.ndarray,
+                 host_docs: np.ndarray, nbytes: int):
+        self.al = al
+        self.starts = starts       # i64[nterms+1] filtered CSR row bounds
+        self.host_docs = host_docs  # i32 filtered doc ids (chunk windows)
+        self.nbytes = nbytes
+
+
+def _purge_filtered_for_uid(uid: int) -> None:
+    for k in [k for k in _FILTERED_LRU if k[0] == uid]:
+        _FILTERED_BYTES[0] -= _FILTERED_LRU[k].nbytes
+        del _FILTERED_LRU[k]
+
+
+def _filtered_postings(seg: Segment, field: str, fl: FilterList
+                       ) -> Optional[FilteredPostings]:
+    import jax
+
+    key = (seg.uid, field, fl.key)
+    fp = _FILTERED_LRU.get(key)
+    if fp is not None:
+        _FILTERED_LRU.move_to_end(key)
+        return fp
+    if get_aligned(seg, field) is None:     # validates tf/dl pack bounds
+        return None
+    pb = seg.postings.get(field)
+    dl = seg.doc_lens.get(field)
+    keep = fl.mask[pb.doc_ids]
+    kc = np.zeros(len(pb.doc_ids) + 1, np.int64)
+    np.cumsum(keep, out=kc[1:])
+    new_starts = kc[pb.starts]
+    new_docs = pb.doc_ids[keep]
+    tfs = pb.tfs[keep]
+    dl_of = (dl[new_docs].astype(np.int64) if dl is not None
+             else np.zeros(len(new_docs), np.int64))
+    packed = ((tfs.astype(np.int64) << DL_BITS) | dl_of).astype(np.int32)
+    a_starts, a_docs, a_packed = align_csr_rows(new_starts, new_docs, packed,
+                                                margin=MAX_L)
+    nbytes = a_docs.nbytes + a_packed.nbytes
+    al = AlignedPostings((a_starts[:-1] // LANES).astype(np.int64),
+                         np.diff(new_starts).astype(np.int64),
+                         jax.device_put(a_docs), jax.device_put(a_packed),
+                         nbytes)
+    fp = FilteredPostings(al, new_starts, new_docs, nbytes)
+    if _breaker is not None:
+        import weakref
+        _breaker.add_estimate(nbytes, f"fastpath-filtered[{seg.name}][{field}]")
+        weakref.finalize(fp, _breaker.release, nbytes)
+    if not hasattr(seg, "_filtered_fin"):
+        import weakref
+        seg._filtered_fin = weakref.finalize(seg, _purge_filtered_for_uid,
+                                             seg.uid)
+    _FILTERED_LRU[key] = fp
+    _FILTERED_BYTES[0] += nbytes
+    while _FILTERED_BYTES[0] > _FILTERED_MAX_BYTES and len(_FILTERED_LRU) > 1:
+        _k, _v = _FILTERED_LRU.popitem(last=False)
+        _FILTERED_BYTES[0] -= _v.nbytes
+    return fp
+
+
+def _dense_hot(seg: Segment, fl: FilterList) -> bool:
+    """Dense + repeated (hits counted AFTER this check, so >=1 here means
+    this is at least the filter's second use)."""
+    return (fl.n > _MATERIALIZE_MIN_DOCS
+            and fl.n * _MATERIALIZE_DENSITY > seg.ndocs
+            and fl.hits >= 1)
 
 
 _dummy_hbm_arr = None
@@ -596,7 +708,7 @@ class _BVQuery:
 
     __slots__ = ("qi", "TS", "T", "L", "filtered", "rowstarts", "nrows",
                  "lens", "weights", "cw", "thresh", "avgdl", "dlo", "dhi",
-                 "field", "k1", "b_eff", "fl")
+                 "field", "k1", "b_eff", "fl", "albuf")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -609,18 +721,30 @@ def _prepare_bool_vqueries(seg: Segment, ctx, specs: Sequence[FastSpec],
     out: List[Optional[List[_BVQuery]]] = []
     for qi, spec in enumerate(specs):
         fl = None
+        fp = None
+        nslots = len(spec.slots)
         if spec.filter_clauses:
             fl = _filter_list(seg, ctx, spec.filter_clauses)
             if fl is None:
                 out.append(None)
                 continue
-        nslots = len(spec.slots)
+            # specialized postings only see docs that match SOME term, so
+            # the route is sound only when passing requires a term match
+            # (required slot or a counted family) — a bonus-only bool's
+            # hits are the whole filter and need the filter slot
+            needs_term = spec.n_required > 0 or spec.fam_msm >= 1
+            if (nslots and needs_term and spec.field is not None
+                    and _dense_hot(seg, fl)):
+                # dense hot filter: run on filter-specialized postings at
+                # full kernel speed instead of merging a huge doc list
+                fp = _filtered_postings(seg, spec.field, fl)
+            fl.hits += 1
         TS = next_pow2(max(nslots, 1), floor=1)
-        filtered = fl is not None
+        filtered = fl is not None and fp is None
         T = 2 * TS if filtered else TS
         al = pb = None
         if nslots:
-            al = get_aligned(seg, spec.field)
+            al = fp.al if fp is not None else get_aligned(seg, spec.field)
             pb = seg.postings.get(spec.field)
             if al is None or pb is None:
                 out.append(None)
@@ -632,7 +756,14 @@ def _prepare_bool_vqueries(seg: Segment, ctx, specs: Sequence[FastSpec],
             weights[i] = w
             cw[i] = cwv
             r = pb.row(term)
-            if r >= 0:
+            if r < 0:
+                continue
+            if fp is not None:
+                a, b = int(fp.starts[r]), int(fp.starts[r + 1])
+                if a < b:
+                    slot_descs[i] = (fp.host_docs[a:b],
+                                     int(al.starts_rows[r]) * LANES)
+            else:
                 slot_descs[i] = _term_slot(al, pb, r)
         if filtered:
             cw[TS] = REQ_W
@@ -657,7 +788,8 @@ def _prepare_bool_vqueries(seg: Segment, ctx, specs: Sequence[FastSpec],
                                 weights=weights, cw=cw,
                                 thresh=np.float32(thresh), avgdl=avgdl,
                                 dlo=dlo, dhi=dhi, field=spec.field, k1=k1,
-                                b_eff=b_eff, fl=fl))
+                                b_eff=b_eff, fl=fl if filtered else None,
+                                albuf=al))
         out.append(vqs)
     return out
 
@@ -670,13 +802,13 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         if vqs is None:
             continue
         for vq in vqs:
-            gk = (vq.field, vq.TS, vq.filtered,
+            gk = (id(vq.albuf), vq.TS, vq.filtered,
                   id(vq.fl) if vq.fl is not None else None, vq.k1, vq.b_eff)
             groups.setdefault(gk, []).append(vq)
     results = {}
-    for (field, TS, filtered, _flid, k1, b_eff), gvqs in groups.items():
-        if field is not None:
-            al = get_aligned(seg, field)
+    for (_alid, TS, filtered, _flid, k1, b_eff), gvqs in groups.items():
+        al = gvqs[0].albuf
+        if al is not None:
             d_docs, d_tfdl = al.d_docs, al.d_tfdl
         else:
             d_docs = d_tfdl = _dummy_hbm()
@@ -702,32 +834,16 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         for j, vq in enumerate(gvqs):
             results[id(vq)] = (scores[j][:K], docs[j][:K],
                                int(totals[j][0]))
-    out: List[Optional[dict]] = []
-    for qi, vqs in enumerate(vq_lists):
-        if vqs is None:
-            out.append(None)
-            continue
-        if len(vqs) == 1:
-            sc, dc, total = results[id(vqs[0])]
-        else:
-            parts = [results[id(v)] for v in vqs]
-            sc_all = np.concatenate([p[0] for p in parts])
-            dc_all = np.concatenate([p[1] for p in parts])
-            total = sum(p[2] for p in parts)
-            order = np.lexsort((dc_all, -sc_all))[:K]
-            sc = sc_all[order]
-            dc = dc_all[order]
+    def transform(qi, sc):
         spec = specs[qi]
         finite = np.isfinite(sc)
         if spec.const_score is not None:
-            sc = np.where(finite, np.float32(spec.const_score), -np.inf)
-        elif spec.boost != 1.0:
-            sc = np.where(finite, sc * np.float32(spec.boost), -np.inf)
-        total_i = int(total)
-        ms = float(sc[0]) if total_i > 0 and np.isfinite(sc[0]) else -np.inf
-        out.append({"topk_key": sc, "topk_idx": dc, "topk_scores": sc,
-                    "total": total_i, "max_score": ms})
-    return out
+            return np.where(finite, np.float32(spec.const_score), -np.inf)
+        if spec.boost != 1.0:
+            return np.where(finite, sc * np.float32(spec.boost), -np.inf)
+        return sc
+
+    return _assemble(vq_lists, results, K, transform)
 
 
 def segment_search(seg: Segment, ctx, spec: FastSpec, k: int

@@ -163,6 +163,38 @@ def test_filter_list_cached(client):
     assert len(seg._fastpath_filters) == n_before
 
 
+def test_dense_filter_materializes(client):
+    """A dense, repeated filter flips to filter-specialized postings and
+    stays hit/score-identical to the XLA path."""
+    old_min, old_den = (fastpath._MATERIALIZE_MIN_DOCS,
+                        fastpath._MATERIALIZE_DENSITY)
+    fastpath._MATERIALIZE_MIN_DOCS = 16
+    fastpath._MATERIALIZE_DENSITY = 1000   # any filter counts as dense
+    n0 = len(fastpath._FILTERED_LRU)
+    try:
+        body = {"query": {"bool": {"must": [{"match": {"body": "w2 w6"}}],
+                                   "filter": [FILTER_PUB]}}, "size": 10}
+        # first use: list path (hits=0); second: materializes
+        for rep in range(3):
+            fast, slow, engaged = _both(client, dict(body, _p=f"mat{rep}"))
+            assert engaged
+            assert fast["hits"]["total"] == slow["hits"]["total"]
+            assert _hits(fast) == _hits(slow)
+        assert len(fastpath._FILTERED_LRU) > n0, "did not materialize"
+        # bonus-only shoulds under the same dense filter must NOT take the
+        # specialized route (hits = whole filter, incl. docs w/o any term)
+        bb = {"query": {"bool": {"should": [{"term": {"body": "w2"}}],
+                                 "filter": [FILTER_PUB]}}, "size": 10}
+        for rep in range(3):
+            fast, slow, engaged = _both(client, dict(bb, _p=f"bmat{rep}"))
+            assert engaged
+            assert fast["hits"]["total"] == slow["hits"]["total"]
+            assert _hits(fast) == _hits(slow)
+    finally:
+        fastpath._MATERIALIZE_MIN_DOCS = old_min
+        fastpath._MATERIALIZE_DENSITY = old_den
+
+
 def test_msearch_mixed_batch(client):
     """Batched msearch fuses pure and bool bodies into grouped launches."""
     bodies = [
