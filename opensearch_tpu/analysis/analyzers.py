@@ -107,6 +107,26 @@ class AnalysisRegistry:
             return Analyzer(name, keyword_tokenizer, filters, chars)
         raise ValueError(f"unknown normalizer [{name}]")
 
+    def ensure_sayt_chains(self, max_shingle: int) -> None:
+        """Register the search_as_you_type analyzer chains (reference
+        SearchAsYouTypeFieldMapper): `__sayt_{n}gram` = standard + lowercase
+        + fixed-size shingles; `__sayt_prefix` = the same plus edge ngrams
+        for the bool_prefix last-term match."""
+        ana = self._settings.setdefault("analyzer", {})
+        flt = self._settings.setdefault("filter", {})
+        for n in range(2, max_shingle + 1):
+            flt.setdefault(f"__sayt_shingle{n}", {
+                "type": "shingle", "min_shingle_size": n,
+                "max_shingle_size": n, "output_unigrams": False})
+            ana.setdefault(f"__sayt_{n}gram", {
+                "type": "custom", "tokenizer": "standard",
+                "filter": ["lowercase", f"__sayt_shingle{n}"]})
+        flt.setdefault("__sayt_edge", {
+            "type": "edge_ngram", "min_gram": 1, "max_gram": 20})
+        ana.setdefault("__sayt_prefix", {
+            "type": "custom", "tokenizer": "standard",
+            "filter": ["lowercase", "__sayt_edge"]})
+
     def _resolve_filter(self, name: str) -> TokenFilter:
         custom = self._settings.get("filter", {}).get(name)
         if custom is not None:

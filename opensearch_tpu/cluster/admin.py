@@ -1,0 +1,334 @@
+"""Index administration: dynamic settings updates, open/close, and the
+resize family (shrink / split / clone).
+
+Reference analogs:
+- `action/admin/indices/settings/put/TransportUpdateSettingsAction.java` +
+  the dynamic/static split of `common/settings/IndexScopedSettings.java`
+- `action/admin/indices/close/TransportCloseIndexAction.java`,
+  `.../open/TransportOpenIndexAction.java` (verify-before-close, block
+  semantics, wildcard handling)
+- `action/admin/indices/shrink/TransportResizeAction.java` (shard-count
+  factor rules, source write-block requirement, settings/mapping carry)
+- `action/admin/cluster/settings/TransportClusterUpdateSettingsAction.java`
+
+TPU-design notes: settings changes are host-side metadata operations — the
+only device-visible effects are replica rebuilds (number_of_replicas) and
+the write-block flag the fastpath's immutable segments already respect.
+Resize re-routes documents by `_id` through the target's write path and
+then force-merges, so the final segment build runs the device merge sort
+(`ops/device_merge.py`); the reference's hard-link recovery optimization
+is not replicated (documents are re-indexed; custom `_routing` values are
+not persisted per doc and therefore not preserved).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .state import ClusterStateError, IndexNotFoundError
+
+
+class IndexClosedError(ClusterStateError):
+    """HTTP 400 index_closed_exception analog."""
+
+
+class SettingsError(ClusterStateError):
+    """HTTP 400 illegal_argument_exception analog for settings updates."""
+
+
+# dynamic settings: updatable on an open index (reference
+# IndexScopedSettings dynamic registrations — the subset this engine
+# implements behavior for, plus passthrough knobs that only need storage)
+_DYNAMIC_EXACT = {
+    "number_of_replicas",
+    "refresh_interval",
+    "max_result_window",
+    "max_inner_result_window",
+    "default_pipeline",
+    "final_pipeline",
+    "search.default_pipeline",
+    "blocks.read_only",
+    "blocks.read_only_allow_delete",
+    "blocks.read",
+    "blocks.write",
+    "blocks.metadata",
+    "highlight.max_analyzed_offset",
+    "requests.cache.enable",
+}
+_DYNAMIC_PREFIXES = (
+    "search.slowlog.",
+    "indexing.slowlog.",
+    "routing.allocation.",
+    "lifecycle.",
+)
+
+# static settings: fixed after index creation; updatable only while the
+# index is CLOSED (reference allows e.g. analysis updates on closed
+# indices). `final` settings can never change.
+_FINAL = {"number_of_shards", "uuid", "creation_date", "version.created",
+          "routing_partition_size"}
+_STATIC_PREFIXES = ("analysis.", "similarity.", "sort.", "merge.")
+_STATIC_EXACT = {"codec", "knn"}
+
+
+def _flatten(settings: dict, prefix: str = "") -> Dict[str, object]:
+    """{"index": {"blocks": {"write": true}}} -> {"blocks.write": True};
+    accepts pre-flattened dotted keys and a leading "index." prefix."""
+    out: Dict[str, object] = {}
+    for k, v in (settings or {}).items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{key}."))
+        else:
+            out[key] = v
+    return {k[6:] if k.startswith("index.") else k: v
+            for k, v in out.items()}
+
+
+def _classify(key: str) -> str:
+    if key in _FINAL:
+        return "final"
+    if key in _DYNAMIC_EXACT or key.startswith(_DYNAMIC_PREFIXES):
+        return "dynamic"
+    if key in _STATIC_EXACT or key.startswith(_STATIC_PREFIXES):
+        return "static"
+    return "unknown"
+
+
+def _set_nested(d: dict, dotted: str, value) -> None:
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        nxt = d.get(p)
+        if not isinstance(nxt, dict):
+            nxt = d[p] = {}
+        d = nxt
+    d[parts[-1]] = value
+
+
+def update_index_settings(node, expression: str, body: dict,
+                          preserve_existing: bool = False) -> dict:
+    """PUT /{index}/_settings with dynamic-vs-static validation."""
+    flat = _flatten(body.get("settings", body))
+    names = node.metadata.resolve(expression, allow_no_indices=False)
+    # validate against every target first (all-or-nothing, like the
+    # reference's single cluster-state update)
+    for name in names:
+        svc = node.indices[name]
+        closed = svc.meta.state == "close"
+        for key, value in flat.items():
+            cls = _classify(key)
+            if cls == "final":
+                raise SettingsError(
+                    f"final index setting [index.{key}], not updateable")
+            if cls == "static" and not closed:
+                raise SettingsError(
+                    f"Can't update non dynamic settings [[index.{key}]] "
+                    f"for open indices [[{name}]]")
+            if cls == "unknown":
+                raise SettingsError(
+                    f"unknown setting [index.{key}]")
+            if key == "number_of_replicas" and int(value) < 0:
+                raise SettingsError("number_of_replicas must be >= 0")
+    for name in names:
+        svc = node.indices[name]
+        idx = svc.meta.settings.setdefault("index", {})
+        for key, value in flat.items():
+            if preserve_existing and _has_nested(idx, key):
+                continue
+            _set_nested(idx, key, value)
+        _apply_effects(node, svc, flat)
+        node._persist_meta(name)
+    return {"acknowledged": True}
+
+
+def _has_nested(d: dict, dotted: str) -> bool:
+    for p in dotted.split("."):
+        if not isinstance(d, dict) or p not in d:
+            return False
+        d = d[p]
+    return True
+
+
+def _apply_effects(node, svc, flat: Dict[str, object]) -> None:
+    from ..utils.slowlog import SlowLog
+
+    if "number_of_replicas" in flat and svc.meta.state != "close":
+        svc._init_replicas()
+        svc.generation += 1
+    if any(k.startswith("search.slowlog.") for k in flat):
+        svc.search_slowlog = SlowLog(svc.meta.name, svc.meta.settings,
+                                     "search", "query")
+    if any(k.startswith("indexing.slowlog.") for k in flat):
+        svc.index_slowlog = SlowLog(svc.meta.name, svc.meta.settings,
+                                    "indexing", "index")
+
+
+def close_index(node, expression: str) -> dict:
+    """POST /{index}/_close: flush for durability (the reference's
+    verify-before-close), then mark closed — searches and writes reject
+    with index_closed_exception until reopened."""
+    names = node.metadata.resolve(expression, allow_no_indices=False)
+    for name in names:
+        svc = node.indices[name]
+        if svc.meta.state == "close":
+            continue
+        svc.flush()
+        svc.meta.state = "close"
+        node._persist_meta(name)
+    return {"acknowledged": True, "shards_acknowledged": True,
+            "indices": {n: {"closed": True} for n in names}}
+
+
+def open_index(node, expression: str) -> dict:
+    names = node.metadata.resolve(expression, allow_no_indices=False)
+    for name in names:
+        svc = node.indices[name]
+        if svc.meta.state != "close":
+            continue
+        svc.meta.state = "open"
+        # static settings may have changed while closed (analysis etc.):
+        # rebuild the service like recovery does
+        node._reopen_service(name)
+    return {"acknowledged": True, "shards_acknowledged": True}
+
+
+def check_open(node, names: List[str], expression) -> List[str]:
+    """Filter closed indices out of wildcard resolutions; explicitly named
+    closed indices raise (reference IndicesOptions default: wildcards
+    expand to open only, concrete names must be open)."""
+    explicit = set()
+    if expression not in (None, "", "_all", "*"):
+        exprs = (expression if isinstance(expression, list)
+                 else str(expression).split(","))
+        explicit = {e.strip() for e in exprs if "*" not in e and "?" not in e}
+    out = []
+    for n in names:
+        svc = node.indices.get(n)
+        if svc is not None and svc.meta.state == "close":
+            if n in explicit:
+                raise IndexClosedError(f"closed index [{n}]")
+            continue
+        out.append(n)
+    return out
+
+
+def resize_index(node, source: str, target: str, kind: str,
+                 body: Optional[dict] = None) -> dict:
+    """_shrink / _split / _clone. Shard-count rules follow the reference
+    (murmur3 routing factor property): shrink needs a divisor, split a
+    multiple, clone the same count. Source must be write-blocked."""
+    body = body or {}
+    if source not in node.indices:
+        raise IndexNotFoundError(f"no such index [{source}]")
+    if target in node.indices:
+        raise SettingsError(f"index [{target}] already exists")
+    svc = node.indices[source]
+    if svc.meta.state == "close":
+        raise IndexClosedError(f"closed index [{source}]")
+    idx_settings = svc.meta.settings.get("index", {})
+    blocks = idx_settings.get("blocks", {})
+    if not (_truthy(blocks.get("write")) or _truthy(blocks.get("read_only"))):
+        raise SettingsError(
+            f"index {source} must be read-only to resize index. use "
+            f"\"index.blocks.write=true\"")
+    s_shards = svc.meta.num_shards
+    tset = _flatten(body.get("settings", {}))
+    t_shards = int(tset.get("number_of_shards",
+                            1 if kind == "shrink" else s_shards))
+    if kind == "shrink":
+        if t_shards > s_shards or s_shards % t_shards:
+            raise SettingsError(
+                f"the number of source shards [{s_shards}] must be a "
+                f"multiple of [{t_shards}]")
+    elif kind == "split":
+        if t_shards < s_shards or t_shards % s_shards:
+            raise SettingsError(
+                f"the number of target shards [{t_shards}] must be a "
+                f"multiple of the source shards [{s_shards}]")
+    elif t_shards != s_shards:
+        raise SettingsError("clone must keep the source shard count")
+
+    # target settings: source settings minus blocks, overridden by request
+    # (deep-copied so nested overrides never write through to the source)
+    import copy
+    new_index = copy.deepcopy({k: v for k, v in idx_settings.items()
+                               if k != "blocks"})
+    new_index["number_of_shards"] = t_shards
+    target_settings: dict = {"index": new_index}
+    for key, value in tset.items():
+        _set_nested(new_index, key, value)
+    node.create_index(target, {"settings": target_settings,
+                               "mappings": svc.mappings.to_dict()})
+    tsvc = node.indices[target]
+    svc.refresh()
+    copied = 0
+    for eng in svc.shards:
+        for seg in eng.segments:
+            for local in range(seg.ndocs):
+                if not seg.live[local]:
+                    continue
+                doc_id = seg.ids[local]
+                tsvc.route(doc_id).index_doc(doc_id, seg.sources[local])
+                copied += 1
+    tsvc.refresh()
+    tsvc.force_merge(1)       # final build runs the device merge path
+    for alias, cfg in (body.get("aliases") or {}).items():
+        node._put_alias(alias, target, cfg or {})
+    return {"acknowledged": True, "shards_acknowledged": True,
+            "index": target, "copied_docs": copied}
+
+
+def _truthy(v) -> bool:
+    return v is True or v == "true" or v == 1
+
+
+# ---------------------------------------------------------------------
+# cluster settings (reference TransportClusterUpdateSettingsAction)
+# ---------------------------------------------------------------------
+
+_CLUSTER_DYNAMIC_PREFIXES = (
+    "cluster.routing.allocation.",
+    "cluster.blocks.",
+    "indices.breaker.",
+    "search.",
+    "action.",
+    "wlm.",
+)
+
+
+def update_cluster_settings(node, body: dict) -> dict:
+    cs = node.__dict__.setdefault("cluster_settings", {})
+    out = {"acknowledged": True, "persistent": {}, "transient": {}}
+    for scope in ("persistent", "transient"):
+        flat = _flatten(body.get(scope, {}) or {})
+        for key, value in flat.items():
+            if not key.startswith(_CLUSTER_DYNAMIC_PREFIXES):
+                raise SettingsError(
+                    f"unknown or non-dynamic cluster setting [{key}]")
+            if value is None:
+                cs.get(scope, {}).pop(key, None)   # null resets a setting
+            else:
+                cs.setdefault(scope, {})[key] = value
+                out[scope][key] = value
+            if key == "indices.breaker.fielddata.limit":
+                _apply_breaker_limit(node, value)
+    return out
+
+
+def _apply_breaker_limit(node, value) -> None:
+    try:
+        breaker = node.breakers.breaker("fielddata")
+    except Exception:
+        return
+    if isinstance(value, str) and value.endswith("%"):
+        return                      # percent-of-heap n/a; store only
+    try:
+        breaker.limit = int(value)
+    except (TypeError, ValueError):
+        pass
+
+
+def get_cluster_settings(node, include_defaults: bool = False) -> dict:
+    cs = getattr(node, "cluster_settings", {})
+    return {"persistent": dict(cs.get("persistent", {})),
+            "transient": dict(cs.get("transient", {}))}

@@ -376,7 +376,11 @@ class Node:
                 raise
             self.create_index(name)
             concrete = name
-        return self.indices[concrete]
+        svc = self.indices[concrete]
+        if svc.meta.state == "close":
+            from .admin import IndexClosedError
+            raise IndexClosedError(f"closed index [{concrete}]")
+        return svc
 
     # ---------------- aliases ----------------
 
@@ -418,7 +422,67 @@ class Node:
         os.makedirs(p, exist_ok=True)
         with open(os.path.join(p, "index_meta.json"), "w") as fh:
             json.dump({"settings": svc.meta.settings,
-                       "mappings": svc.mappings.to_dict()}, fh)
+                       "mappings": svc.mappings.to_dict(),
+                       "state": svc.meta.state}, fh)
+
+    # -------- index admin (cluster/admin.py; reference transport actions
+    # under action/admin/indices/{settings,close,open,shrink}) --------
+
+    def update_index_settings(self, expression: str, body: dict,
+                              preserve_existing: bool = False) -> dict:
+        from . import admin
+        return admin.update_index_settings(self, expression, body,
+                                           preserve_existing)
+
+    def close_index(self, expression: str) -> dict:
+        from . import admin
+        return admin.close_index(self, expression)
+
+    def open_index(self, expression: str) -> dict:
+        from . import admin
+        return admin.open_index(self, expression)
+
+    def resize_index(self, source: str, target: str, kind: str,
+                     body: Optional[dict] = None) -> dict:
+        from . import admin
+        return admin.resize_index(self, source, target, kind, body)
+
+    def update_cluster_settings(self, body: dict) -> dict:
+        from . import admin
+        return admin.update_cluster_settings(self, body)
+
+    def get_cluster_settings(self) -> dict:
+        from . import admin
+        return admin.get_cluster_settings(self)
+
+    def resolve_open(self, expression, allow_no_indices: bool = True):
+        """resolve() then drop closed indices from wildcard expansions;
+        explicitly named closed indices raise IndexClosedError."""
+        from . import admin
+        names = self.metadata.resolve(expression, allow_no_indices)
+        return admin.check_open(self, names, expression)
+
+    def _reopen_service(self, name: str) -> None:
+        """Re-apply statically-configurable settings after _open (analysis
+        chain, default similarity) without rebuilding the engines."""
+        from ..analysis import AnalysisRegistry
+        svc = self.indices[name]
+        idx = svc.meta.settings.get("index", {})
+        svc.mappings.analysis = AnalysisRegistry(
+            idx.get("analysis", svc.meta.settings.get("analysis")))
+        # re-register programmatic chains (search_as_you_type shingle/prefix
+        # analyzers live in the registry, not the user's settings)
+        for ft in svc.mappings.fields.values():
+            if ft.type == "search_as_you_type":
+                shingles = sum(1 for s in ft.subfields if s.endswith("gram"))
+                svc.mappings.analysis.ensure_sayt_chains(shingles + 1)
+        sim = idx.get("similarity", svc.meta.settings.get("similarity", {}))
+        svc.default_sim = (sim.get("default")
+                           if isinstance(sim, dict) else None)
+        for s in svc.searchers:
+            s.similarity = svc.default_sim
+        svc.generation += 1
+        self._persist_meta(name)
 
     def _recover_indices(self) -> None:
         import json
@@ -429,6 +493,7 @@ class Node:
             with open(meta_path) as fh:
                 saved = json.load(fh)
             meta = IndexMetadata(name, settings=saved.get("settings", {}))
+            meta.state = saved.get("state", "open")
             svc = IndexService(meta, saved.get("mappings"), self.data_path,
                                thread_pools=self.thread_pools)
             self.indices[name] = svc
@@ -526,6 +591,8 @@ class Node:
         request-cache entry, so cached entries stay pristine without taxing
         uncached paths."""
         names, remote_parts = self._split_remote_expression(expression)
+        from .admin import check_open
+        names = check_open(self, names, expression)
         searchers = []
         gens = []
         for name in names:
@@ -600,13 +667,17 @@ class Node:
         """Batched msearch over one index expression: all bodies' term-group
         queries fuse into grouped Pallas kernel launches (grid over queries).
         Returns None when ineligible — caller falls back to per-body search."""
-        names = self.metadata.resolve(expression)
+        from .admin import check_open
+        names = check_open(self, self.metadata.resolve(expression),
+                           expression)
         searchers = []
         for name in names:
             searchers.extend(self.indices[name].searchers)
         resps = msearch_batched(searchers, bodies, index_name=",".join(names))
         if resps is not None and len(names) == 1:
             for resp in resps:
+                if resp is None:
+                    continue       # caller runs this body per-body
                 for h in resp["hits"]["hits"]:
                     h["_index"] = names[0]
         return resps

@@ -19,11 +19,25 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import AnalysisRegistry, Analyzer
 
-TEXT_TYPES = {"text"}
-KEYWORD_TYPES = {"keyword", "ip"}
-INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean"}
-FLOAT_TYPES = {"double", "float", "half_float", "rank_feature"}
+TEXT_TYPES = {"text", "match_only_text", "search_as_you_type"}
+KEYWORD_TYPES = {"keyword", "ip", "constant_keyword", "flat_object"}
+INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean",
+             "unsigned_long", "token_count"}
+FLOAT_TYPES = {"double", "float", "half_float", "rank_feature",
+               "scaled_float"}
 NUMERIC_TYPES = INT_TYPES | FLOAT_TYPES
+# range family (reference RangeFieldMapper): stored as closed [lo, hi]
+# interval columns `field#lo` / `field#hi` in the member type's column
+# representation; queried with relation intersects/within/contains
+RANGE_TYPES = {"integer_range", "long_range", "float_range", "double_range",
+               "date_range", "ip_range"}
+RANGE_MEMBER = {"integer_range": "integer", "long_range": "long",
+                "float_range": "float", "double_range": "double",
+                "date_range": "date", "ip_range": "ip"}
+# unsigned_long stores order-preserving BIASED i64 (v - 2^63) so 64-bit
+# compares/sorts stay exact; the device f32 view and fetch unbias
+# (reference UnsignedLongFieldMapper shifts the same way)
+U64_BIAS = 1 << 63
 GEO_TYPES = {"geo_point"}
 SHAPE_TYPES = {"geo_shape"}
 VECTOR_TYPES = {"dense_vector", "knn_vector"}
@@ -62,6 +76,15 @@ class FieldType:
     # text fields keep norms (doc length) unless disabled; keyword fields never
     norms: bool = True
     subfields: Dict[str, "FieldType"] = dc_field(default_factory=dict)
+    # scaled_float (mapper-extras ScaledFloatFieldMapper): values quantize
+    # to round(v * scaling_factor) / scaling_factor
+    scaling_factor: Optional[float] = None
+    # constant_keyword (ConstantKeywordFieldMapper): the index-wide value
+    # (from the mapping, or adopted from the first document that sets it)
+    const_value: Optional[str] = None
+    # synthetic flat_object leaf (FlatObjectFieldMapper ._valueAndPath):
+    # query terms become "<flat_prefix>=<value>" against `<root>#paths`
+    flat_prefix: Optional[str] = None
 
     @property
     def is_indexed_terms(self) -> bool:
@@ -69,7 +92,8 @@ class FieldType:
 
     @property
     def has_norms(self) -> bool:
-        return self.type in TEXT_TYPES and self.norms
+        return self.type in TEXT_TYPES and self.norms and \
+            self.type != "match_only_text"
 
 
 def _parse_date(value: Any, fmt: Optional[str]) -> int:
@@ -125,6 +149,12 @@ def coerce_value(ft: FieldType, value: Any):
         return 1 if bool(value) else 0
     if t == "ip":
         return _ip_to_int(str(value))
+    if t == "unsigned_long":
+        iv = int(value)
+        if not 0 <= iv < (1 << 64):
+            raise ValueError(
+                f"value [{value}] out of range for field type [unsigned_long]")
+        return iv - U64_BIAS
     if t in INT_TYPES:
         iv = int(value)
         limits = {"long": 63, "integer": 31, "short": 15, "byte": 7}
@@ -132,6 +162,9 @@ def coerce_value(ft: FieldType, value: Any):
         if not (-(1 << bits)) <= iv < (1 << bits):
             raise ValueError(f"value [{value}] out of range for field type [{t}]")
         return iv
+    if t == "scaled_float":
+        sf = ft.scaling_factor or 1.0
+        return round(float(value) * sf) / sf
     if t in FLOAT_TYPES:
         fv = float(value)
         if t == "rank_feature" and fv <= 0:
@@ -270,6 +303,28 @@ class Mappings:
             ft.relations = {p: (c if isinstance(c, list) else [c])
                             for p, c in cfg.get("relations", {}).items()}
         ft.positive_score_impact = bool(cfg.get("positive_score_impact", True))
+        if ftype == "scaled_float":
+            if "scaling_factor" not in cfg:
+                raise ValueError(
+                    f"Field [{path}] misses required parameter "
+                    f"[scaling_factor]")
+            ft.scaling_factor = float(cfg["scaling_factor"])
+        if ftype == "constant_keyword":
+            if cfg.get("value") is not None:
+                ft.const_value = str(cfg["value"])
+        if ftype == "search_as_you_type":
+            # reference SearchAsYouTypeFieldMapper: main field + shingle
+            # subfields + an edge-ngram prefix field for bool_prefix
+            shingles = int(cfg.get("max_shingle_size", 3))
+            self.analysis.ensure_sayt_chains(shingles)
+            for n in range(2, shingles + 1):
+                ft.subfields[f"_{n}gram"] = FieldType(
+                    name=f"{path}._{n}gram", type="text",
+                    analyzer=f"__sayt_{n}gram")
+            ft.subfields["_index_prefix"] = FieldType(
+                name=f"{path}._index_prefix", type="text",
+                analyzer="__sayt_prefix",
+                search_analyzer=cfg.get("analyzer", "standard"))
         for sub, subcfg in cfg.get("fields", {}).items():
             ft.subfields[sub] = self._build_field(f"{path}.{sub}", subcfg.get("type", "keyword"), subcfg)
         return ft
@@ -327,6 +382,16 @@ class Mappings:
             pft = self.fields.get(parent)
             if pft and sub in pft.subfields:
                 return pft.subfields[sub]
+            # flat_object leaf: "f.a.b" -> term "a.b=<v>" on "f#paths"
+            # (reference FlatObjectFieldMapper ._valueAndPath field)
+            parts = name.split(".")
+            for i in range(1, len(parts)):
+                root = ".".join(parts[:i])
+                rft = self.fields.get(root)
+                if rft is not None and rft.type == "flat_object":
+                    sub_path = ".".join(parts[i:])
+                    return FieldType(name=f"{root}#paths", type="keyword",
+                                     flat_prefix=sub_path)
         return None
 
     def index_analyzer(self, ft: FieldType) -> Analyzer:
@@ -372,6 +437,12 @@ class Mappings:
     def parse(self, doc_id: str, source: dict, routing: Optional[str] = None) -> ParsedDocument:
         parsed = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
         self._parse_obj(source, "", parsed)
+        # constant_keyword fields apply to EVERY document once a value is
+        # known (reference ConstantKeywordFieldMapper)
+        for ft in self.fields.values():
+            if ft.type == "constant_keyword" and ft.const_value is not None:
+                parsed.terms.setdefault(ft.name, []).append(ft.const_value)
+                parsed.keywords.setdefault(ft.name, []).append(ft.const_value)
         return parsed
 
     def _parse_obj(self, obj: dict, prefix: str, parsed: ParsedDocument) -> None:
@@ -404,7 +475,9 @@ class Mappings:
                 ft = self.resolve_field(path)
                 if ft is not None and (ft.type in GEO_TYPES or ft.type in FEATURE_TYPES
                                        or ft.type in SHAPE_TYPES
-                                       or ft.type in ("join", "percolator")):
+                                       or ft.type in RANGE_TYPES
+                                       or ft.type in ("join", "percolator",
+                                                      "flat_object")):
                     self._index_value(ft, value, parsed)
                 else:
                     self._parse_obj(value, f"{path}.", parsed)
@@ -417,7 +490,9 @@ class Mappings:
                         f"[{lft.type}] field [{path}] does not support arrays "
                         f"of feature objects")
                 if lft is not None and (lft.type in SHAPE_TYPES
-                                        or lft.type in GEO_TYPES):
+                                        or lft.type in GEO_TYPES
+                                        or lft.type in RANGE_TYPES
+                                        or lft.type == "flat_object"):
                     for v in values:
                         self._index_value(lft, v, parsed)
                     continue
@@ -512,15 +587,66 @@ class Mappings:
             parsed.terms.setdefault(name, []).append(rel)
             parsed.keywords.setdefault(name, []).append(rel)
             return
-        if ft.type == "text":
+        if ft.type in TEXT_TYPES:
             if ft.index:
                 tokens = self.index_analyzer(ft).analyze(str(v))
                 tl = parsed.terms.setdefault(name, [])
+                if ft.type == "match_only_text":
+                    # no freqs, no norms, no positions (reference
+                    # MatchOnlyTextFieldMapper): tf clamps to 1; phrases
+                    # verify against _source at query time
+                    seen = set(tl)
+                    for t in tokens:
+                        if t.text not in seen:
+                            tl.append(t.text)
+                            seen.add(t.text)
+                    return
                 pl = parsed.positions.setdefault(name, [])
                 base = pl[-1][1] + 100 if pl else 0  # position gap between values
                 for t in tokens:
                     tl.append(t.text)
                     pl.append((t.text, base + t.position))
+            return
+        if ft.type == "binary":
+            # base64 payload: stored/_source only, never indexed (reference
+            # BinaryFieldMapper)
+            return
+        if ft.type == "token_count":
+            tokens = self.analysis.get(ft.analyzer).analyze(str(v))
+            parsed.numerics.setdefault(name, []).append(len(tokens))
+            return
+        if ft.type == "constant_keyword":
+            s = str(v)
+            if ft.const_value is None:
+                ft.const_value = s     # first value fixes it (reference)
+            elif s != ft.const_value:
+                raise ValueError(
+                    f"[constant_keyword] field [{name}] only accepts value "
+                    f"[{ft.const_value}], got [{s}]")
+            return                     # indexed for every doc in parse()
+        if ft.type == "flat_object":
+            # flatten leaves: root field gets every leaf value (searchable
+            # + doc values), `name#paths` gets "path=value" terms
+            if not isinstance(v, dict):
+                raise ValueError(
+                    f"[flat_object] field [{name}] must hold an object")
+            for sub_path, leaf in _flat_leaves(v, ""):
+                s = str(leaf)
+                parsed.terms.setdefault(name, []).append(s)
+                parsed.keywords.setdefault(name, []).append(s)
+                parsed.terms.setdefault(f"{name}#paths", []).append(
+                    f"{sub_path}={s}")
+                parsed.keywords.setdefault(f"{name}#paths", []).append(
+                    f"{sub_path}={s}")
+            return
+        if ft.type in RANGE_TYPES:
+            lo, hi = _parse_range_value(ft, v)
+            if lo > hi:
+                raise ValueError(
+                    f"[{ft.type}] field [{name}]: lower bound [{lo}] > "
+                    f"upper bound [{hi}]")
+            parsed.numerics.setdefault(f"{name}#lo", []).append(lo)
+            parsed.numerics.setdefault(f"{name}#hi", []).append(hi)
             return
         if ft.type == "keyword":
             s = str(v)
@@ -574,6 +700,80 @@ class Mappings:
         parsed.numerics.setdefault(name, []).append(cv)
         if ft.type == "ip" and ft.index:
             parsed.terms.setdefault(name, []).append(str(v))
+
+
+def _flat_leaves(obj: dict, prefix: str):
+    """Depth-first (path, scalar) leaves of a flat_object value."""
+    for k, v in obj.items():
+        path = f"{prefix}{k}"
+        if isinstance(v, dict):
+            yield from _flat_leaves(v, f"{path}.")
+        elif isinstance(v, list):
+            for item in v:
+                if isinstance(item, dict):
+                    yield from _flat_leaves(item, f"{path}.")
+                elif item is not None:
+                    yield path, item
+        elif v is not None:
+            yield path, v
+
+
+_RANGE_INT_BOUNDS = {
+    "integer": (-(1 << 31), (1 << 31) - 1),
+    "long": (-(1 << 63), (1 << 63) - 1),
+    "date": (-(1 << 63), (1 << 63) - 1),
+    "ip": (0, (1 << 63) - 1),
+}
+
+
+def _range_member_coerce(member: str, value: Any, ft: FieldType):
+    if member == "date":
+        return _parse_date(value, ft.date_format)
+    if member == "ip":
+        iv = _ip_to_int(str(value))
+        if iv >= (1 << 63):
+            raise ValueError(
+                "ip_range supports IPv4(-mapped) addresses only in this "
+                "engine (value exceeds the exact i64 column range)")
+        return iv
+    if member in ("integer", "long"):
+        return int(value)
+    return float(value)
+
+
+def _parse_range_value(ft: FieldType, v: Any) -> Tuple[Any, Any]:
+    """{gte/gt/lte/lt} -> closed [lo, hi] in column representation
+    (reference RangeType: open bounds nudge by one ulp/step)."""
+    import math
+
+    if not isinstance(v, dict):
+        raise ValueError(
+            f"[{ft.type}] field [{ft.name}] must hold a range object")
+    member = RANGE_MEMBER[ft.type]
+    is_int = member in _RANGE_INT_BOUNDS
+    lo_def, hi_def = (_RANGE_INT_BOUNDS[member] if is_int
+                      else (-math.inf, math.inf))
+    lo, hi = lo_def, hi_def
+    for key, val in v.items():
+        if val is None:
+            continue
+        cv = _range_member_coerce(member, val, ft)
+        if key == "gte":
+            lo = cv
+        elif key == "gt":
+            lo = cv + 1 if is_int else float(np_nextafter(cv, math.inf))
+        elif key == "lte":
+            hi = cv
+        elif key == "lt":
+            hi = cv - 1 if is_int else float(np_nextafter(cv, -math.inf))
+        else:
+            raise ValueError(f"unknown range bound [{key}]")
+    return lo, hi
+
+
+def np_nextafter(v, toward):
+    import numpy as np
+    return np.nextafter(np.float64(v), np.float64(toward))
 
 
 def _parse_geo(v: Any) -> Tuple[float, float]:

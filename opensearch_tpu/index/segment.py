@@ -355,12 +355,17 @@ class Segment:
                 }
             ncols = {}
             for f, col in self.numeric_cols.items():
-                if col.kind == "int":
+                if col.kind in ("int", "uint"):
                     hi, lo = split_i64(col.values)
+                    # unsigned_long stores biased i64 (order-exact); the f32
+                    # agg/script view unbiases back to the real magnitude
+                    f32v = (col.values.astype(np.float64) + float(1 << 63)
+                            if col.kind == "uint"
+                            else col.values).astype(np.float32)
                     ncols[f] = {
                         "hi": jnp.asarray(_pad_to(hi, dpad, np.int32(0))),
                         "lo": jnp.asarray(_pad_to(lo, dpad, np.int32(0))),
-                        "f32": jnp.asarray(_pad_to(col.values.astype(np.float32), dpad, np.float32(0))),
+                        "f32": jnp.asarray(_pad_to(f32v, dpad, np.float32(0))),
                         "present": jnp.asarray(_pad_to(col.present, dpad, False)),
                     }
                 else:
@@ -766,7 +771,17 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
 
     for fname in num_fields:
         ft = mappings.resolve_field(fname)
-        kind = "float" if (ft is not None and ft.type in FLOAT_TYPES) else "int"
+        if fname.endswith(("#lo", "#hi")) and ft is None:
+            # range-field bound columns: member type decides the kind
+            from .mappings import RANGE_MEMBER
+            rft = mappings.resolve_field(fname[:-3])
+            member = RANGE_MEMBER.get(rft.type) if rft is not None else None
+            kind = "float" if member in ("float", "double") else "int"
+        elif ft is not None and ft.type == "unsigned_long":
+            kind = "uint"    # biased i64: exact order, unbiased f32 view
+        else:
+            kind = "float" if (ft is not None and ft.type in FLOAT_TYPES) \
+                else "int"
         dtype = np.float64 if kind == "float" else np.int64
         values = np.zeros(ndocs, dtype=dtype)
         present = np.zeros(ndocs, dtype=bool)

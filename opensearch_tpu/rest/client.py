@@ -14,6 +14,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ..cluster.node import Node
+from ..cluster.admin import IndexClosedError
 from ..cluster.state import IndexNotFoundError
 from ..index.engine import VersionConflictError
 from ..ingest.pipeline import DropDocument
@@ -89,10 +90,18 @@ class RestClient:
 
     # ---------------- document APIs ----------------
 
+    def _svc_for_write(self, index: str, auto_create: bool = True):
+        try:
+            return self.node.index_service_for_write(index, auto_create)
+        except IndexClosedError as e:
+            raise ApiError(400, "index_closed_exception", str(e))
+
     def _check_write_block(self, svc) -> None:
-        """index.blocks.write (set by hand or by the ILM read_only action)
-        rejects writes like the reference ClusterBlockException."""
-        if svc.meta.settings.get("index", {}).get("blocks", {}).get("write"):
+        """index.blocks.write / read_only (set by hand, PUT _settings, or
+        the ILM read_only action) reject writes like the reference
+        ClusterBlockException."""
+        blocks = svc.meta.settings.get("index", {}).get("blocks", {})
+        if blocks.get("write") or blocks.get("read_only"):
             raise ApiError(403, "cluster_block_exception",
                            f"index [{svc.meta.name}] blocked by: "
                            f"[FORBIDDEN/8/index write (api)]")
@@ -102,7 +111,7 @@ class RestClient:
               op_type: str = "index", pipeline: Optional[str] = None,
               if_seq_no: Optional[int] = None,
               if_primary_term: Optional[int] = None) -> dict:
-        svc = self.node.index_service_for_write(index)
+        svc = self._svc_for_write(index)
         self._check_write_block(svc)
         pipeline = pipeline or svc.meta.settings.get("index", {}).get("default_pipeline")
         if pipeline:
@@ -168,6 +177,9 @@ class RestClient:
                refresh: bool = False, if_seq_no: Optional[int] = None,
                if_primary_term: Optional[int] = None) -> dict:
         svc = self.node.get_index(self.node.metadata.write_index(index))
+        if svc.meta.state == "close":
+            raise ApiError(400, "index_closed_exception",
+                           f"closed index [{svc.meta.name}]")
         self._check_write_block(svc)
         try:
             res = svc.route(id, routing).delete_doc(id, if_seq_no, if_primary_term)
@@ -184,7 +196,7 @@ class RestClient:
     def update(self, index: str, id: str, body: dict, routing: Optional[str] = None,
                refresh: bool = False, **kw) -> dict:
         """Partial-doc update / upsert (reference UpdateHelper)."""
-        svc = self.node.index_service_for_write(index)
+        svc = self._svc_for_write(index)
         self._check_write_block(svc)
         eng = svc.route(id, routing)
         current = eng.get(id)
@@ -334,6 +346,8 @@ class RestClient:
             raise ApiError(429, "circuit_breaking_exception", str(e))
         except TaskCancelledException as e:
             raise ApiError(400, "task_cancelled_exception", str(e))
+        except IndexClosedError as e:
+            raise ApiError(400, "index_closed_exception", str(e))
         resp = self._apply_response_pipeline(pipeline, resp, phase_ctx, body)
         if scroll:
             sid = uuid.uuid4().hex
@@ -562,10 +576,12 @@ class RestClient:
             header = body[i]; i += 1
             search_body = body[i]; i += 1
             pairs.append((header.get("index", index or "_all"), search_body))
-        # batched TPU path: one index expression, all bodies fast-path
-        # eligible -> grouped Pallas kernel launches (grid over queries);
-        # a search pipeline (explicit or index default) forces the
-        # sequential loop so each body gets its processors applied
+        # batched TPU path: one index expression -> fast-path-eligible
+        # bodies fuse into grouped Pallas kernel launches (grid over
+        # queries); the rest come back as None and run per-body below.
+        # A search pipeline (explicit or index default) forces the
+        # per-body path so each body gets its processors applied
+        partial: List[Optional[dict]] = [None] * len(pairs)
         if (pairs and len({idx for idx, _ in pairs}) == 1
                 and not any("search_pipeline" in b or "_workload_group" in b
                             for _, b in pairs)
@@ -575,18 +591,34 @@ class RestClient:
                                           [b for _, b in pairs])
             except (dsl.QueryParseError, IndexNotFoundError, KeyError,
                     TypeError, ValueError, CircuitBreakingException):
-                # fall back to the sequential loop, which maps per-body
-                # errors into per-response error objects
+                # fall back to the per-body path, which maps errors into
+                # per-response error objects
                 resps = None
             if resps is not None:
-                return {"took": 0, "responses": resps}
-        responses = []
-        for idx, search_body in pairs:
+                partial = list(resps)
+        todo = [i for i, r in enumerate(partial) if r is None]
+
+        def run_one(i: int) -> dict:
+            idx, search_body = pairs[i]
             try:
-                responses.append(self.search(idx, search_body))
+                return self.search(idx, search_body)
             except (ApiError, IndexNotFoundError) as e:
-                responses.append({"error": {"type": type(e).__name__, "reason": str(e)}})
-        return {"took": 0, "responses": responses}
+                return {"error": {"type": type(e).__name__,
+                                  "reason": str(e)}}
+
+        if len(todo) > 1:
+            # concurrent per-body fallback (reference
+            # TransportMultiSearchAction runs items concurrently too):
+            # device steps serialize but host work and device round trips
+            # overlap across bodies
+            import concurrent.futures as _cf
+            with _cf.ThreadPoolExecutor(max_workers=min(8, len(todo))) as ex:
+                for i, resp in zip(todo, ex.map(run_one, todo)):
+                    partial[i] = resp
+        else:
+            for i in todo:
+                partial[i] = run_one(i)
+        return {"took": 0, "responses": partial}
 
     # ---------------- _validate/query (reference ValidateQueryAction) ------
 
@@ -1245,6 +1277,40 @@ class IndicesClient:
         return {n: {"settings": {"index": self.c.node.indices[n].meta.settings.get("index", {})}}
                 for n in self.c.node.metadata.resolve(index)}
 
+    def put_settings(self, index: str, body: dict,
+                     preserve_existing: bool = False) -> dict:
+        """PUT /{index}/_settings (reference
+        TransportUpdateSettingsAction): dynamic settings apply to open
+        indices; static settings require the index to be closed; final
+        settings never change."""
+        return _map_admin_errors(
+            self.c.node.update_index_settings, index, body,
+            preserve_existing)
+
+    def close(self, index: str) -> dict:
+        """POST /{index}/_close (reference TransportCloseIndexAction)."""
+        return _map_admin_errors(self.c.node.close_index, index)
+
+    def open(self, index: str) -> dict:
+        """POST /{index}/_open (reference TransportOpenIndexAction)."""
+        return _map_admin_errors(self.c.node.open_index, index)
+
+    def shrink(self, index: str, target: str,
+               body: Optional[dict] = None) -> dict:
+        """POST /{index}/_shrink/{target} (TransportResizeAction)."""
+        return _map_admin_errors(self.c.node.resize_index, index, target,
+                                 "shrink", body)
+
+    def split(self, index: str, target: str,
+              body: Optional[dict] = None) -> dict:
+        return _map_admin_errors(self.c.node.resize_index, index, target,
+                                 "split", body)
+
+    def clone(self, index: str, target: str,
+              body: Optional[dict] = None) -> dict:
+        return _map_admin_errors(self.c.node.resize_index, index, target,
+                                 "clone", body)
+
     def refresh(self, index: str = "_all") -> dict:
         for n in self.c.node.metadata.resolve(index):
             self.c.node.indices[n].refresh()
@@ -1391,9 +1457,31 @@ class SnapshotClient:
         return {"snapshots": snaps}
 
 
+def _map_admin_errors(fn, *args):
+    """cluster/admin.py exceptions -> HTTP-shaped ApiErrors."""
+    from ..cluster.admin import IndexClosedError, SettingsError
+    try:
+        return fn(*args)
+    except IndexClosedError as e:
+        raise ApiError(400, "index_closed_exception", str(e))
+    except SettingsError as e:
+        raise ApiError(400, "illegal_argument_exception", str(e))
+    except IndexNotFoundError as e:
+        raise ApiError(404, "index_not_found_exception", str(e))
+
+
 class ClusterClient:
     def __init__(self, client: RestClient):
         self.c = client
+
+    def put_settings(self, body: dict) -> dict:
+        """PUT /_cluster/settings (reference
+        TransportClusterUpdateSettingsAction): persistent/transient dynamic
+        settings; null values reset."""
+        return _map_admin_errors(self.c.node.update_cluster_settings, body)
+
+    def get_settings(self, include_defaults: bool = False) -> dict:
+        return self.c.node.get_cluster_settings()
 
     def health(self, index: Optional[str] = None) -> dict:
         node = self.c.node

@@ -29,8 +29,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..index.mappings import (FLOAT_TYPES, INT_TYPES, KEYWORD_TYPES, TEXT_TYPES,
-                              Mappings, coerce_value)
+from ..index.mappings import (FLOAT_TYPES, INT_TYPES, KEYWORD_TYPES,
+                              RANGE_MEMBER, RANGE_TYPES, TEXT_TYPES,
+                              Mappings, coerce_value, _parse_range_value)
 from ..index.segment import Segment, next_pow2, split_i64
 from ..models.similarity import Similarity, resolve_similarity
 from ..ops import aggs as agg_ops
@@ -509,6 +510,46 @@ def _prefix_rows(pb, term: str, cap: Optional[int] = None) -> range:
     return range(lo, hi)
 
 
+def _range_field_node(ft, q: "dsl.RangeQuery") -> LNode:
+    """Range query AGAINST a range field (reference RangeFieldMapper
+    relation semantics): the query bounds normalize to a closed [a, b] in
+    column space exactly like index-time values, then
+    intersects: lo <= b AND hi >= a; within: lo >= a AND hi <= b;
+    contains: lo <= a AND hi >= b. Constant score (like the reference)."""
+    member = RANGE_MEMBER[ft.type]
+    kind = "float" if member in ("float", "double") else "int"
+    bounds = {k: v for k, v in (("gte", q.gte), ("gt", q.gt),
+                                ("lte", q.lte), ("lt", q.lt))
+              if v is not None}
+    a, b = _parse_range_value(ft, bounds)
+    lo_f, hi_f = f"{ft.name}#lo", f"{ft.name}#hi"
+    rel = q.relation
+    if rel == "within":
+        parts = [LRange(field=lo_f, kind=kind, lo=a),
+                 LRange(field=hi_f, kind=kind, hi=b)]
+    elif rel == "contains":
+        parts = [LRange(field=lo_f, kind=kind, hi=a),
+                 LRange(field=hi_f, kind=kind, lo=b)]
+    else:                           # intersects (default)
+        parts = [LRange(field=lo_f, kind=kind, hi=b),
+                 LRange(field=hi_f, kind=kind, lo=a)]
+    return LConstScore(child=LBool(filters=parts), boost=q.boost)
+
+
+@dataclass
+class LSourcePhrase(LNode):
+    """Phrase over a positions-less `match_only_text` field: candidates from
+    the term postings conjunction, phrase verified by re-analyzing _source
+    (reference MatchOnlyTextFieldMapper phrase queries via
+    SourceConfirmedTextQuery). Documented deviation: hits score the constant
+    phrase weight rather than a sloppy-freq BM25 (freqs are not indexed)."""
+
+    field: str = ""
+    terms: List[str] = dc_field(default_factory=list)
+    slop: int = 0
+    weight: float = 1.0
+
+
 def _phrase_node(field: str, terms: List[str], slop: int, ctx: ShardContext,
                  boost: float, prefix_last: bool = False,
                  max_expansions: int = 50, ordered: bool = False,
@@ -516,6 +557,13 @@ def _phrase_node(field: str, terms: List[str], slop: int, ctx: ShardContext,
     """Phrase weight = sum of per-term idf (Lucene PhraseWeight: the phrase
     scores as one pseudo-term whose idf is the terms' idf sum)."""
     ft = ctx.mappings.resolve_field(field)
+    if ft is not None and ft.type == "match_only_text":
+        n = ctx.num_docs
+        sim = ctx.sim_for(field)
+        w = sum(sim.term_weight(1.0, n, min(ctx.doc_freq(field, t), n))
+                for t in terms if ctx.doc_freq(field, t) > 0)
+        return LSourcePhrase(field=field, terms=terms, slop=slop,
+                             weight=(w or 1.0) * boost)
     sim = ctx.sim_for(field)
     has_norms = bool(ft is not None and ft.has_norms and sim.uses_norms)
     n = ctx.num_docs
@@ -555,8 +603,11 @@ def _analyze_query_text(field: str, text: Any, ctx: ShardContext,
 
 def _index_term(field: str, value: Any, ctx: ShardContext) -> str:
     """Single exact term for term/terms queries: keyword normalizer applies,
-    text fields match the raw token (reference TermQueryBuilder semantics)."""
+    text fields match the raw token (reference TermQueryBuilder semantics).
+    flat_object leaves match their "path=value" composite terms."""
     ft = ctx.mappings.resolve_field(field)
+    if ft is not None and ft.flat_prefix:
+        return f"{ft.flat_prefix}={value}"
     if ft is not None and ft.type in KEYWORD_TYPES:
         norm = ctx.mappings.index_analyzer(ft).terms(str(value))
         return norm[0] if norm else str(value)
@@ -595,6 +646,17 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
 
     if isinstance(q, dsl.TermQuery):
         ft = m.resolve_field(q.field)
+        if ft is not None and ft.type in RANGE_TYPES:
+            # containment: stored [lo, hi] covers the value (reference
+            # RangeType.termQuery = intersects on a point)
+            from ..index.mappings import (RANGE_MEMBER, _range_member_coerce)
+            member = RANGE_MEMBER[ft.type]
+            cv = _range_member_coerce(member, q.value, ft)
+            kind = "float" if member in ("float", "double") else "int"
+            return LConstScore(child=LBool(filters=[
+                LRange(field=f"{ft.name}#lo", kind=kind, hi=cv),
+                LRange(field=f"{ft.name}#hi", kind=kind, lo=cv)]),
+                boost=q.boost)
         if (ft is not None and ft.type == "ip" and isinstance(q.value, str)
                 and "/" in q.value):
             return _ip_cidr_node(ft.name, q.value, q.boost)
@@ -603,7 +665,7 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         if ft is not None and ft.type == "date":
             return _numeric_eq_node(ft, ft.name, q.value, q.boost)
         field = ft.name if ft else q.field
-        term = _index_term(field, q.value, ctx)
+        term = _index_term(q.field, q.value, ctx)
         if q.case_insensitive:
             term = term.lower()
         mode = "score" if scoring else "filter"
@@ -626,7 +688,7 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
             children = [_numeric_eq_node(ft, ft.name, v, 1.0) for v in q.values]
             return LBool(shoulds=children, msm=1, boost=q.boost)
         field = ft.name if ft else q.field
-        terms = [_index_term(field, v, ctx) for v in q.values]
+        terms = [_index_term(q.field, v, ctx) for v in q.values]
         # terms query is constant-score (reference TermInSetQuery)
         return _weighted_terms(field, terms, [1.0] * len(terms), ctx, 1, "filter", q.boost)
 
@@ -849,6 +911,8 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
         ft = m.resolve_field(q.field)
         if ft is None:
             return LMatchNone()
+        if ft.type in RANGE_TYPES:
+            return _range_field_node(ft, q)
         if ft.type in KEYWORD_TYPES and ft.type != "ip":
             return LExpandTerms(field=ft.name,
                                 expander=_keyword_range_expander(ft.name, q),
@@ -869,6 +933,15 @@ def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: 
 
     if isinstance(q, dsl.ExistsQuery):
         ft = m.resolve_field(q.field)
+        if ft is not None and ft.type in RANGE_TYPES:
+            return LExists(field=f"{ft.name}#lo", boost=q.boost)
+        if ft is not None and ft.flat_prefix:
+            # flat_object leaf exists = any "path=..." term under #paths
+            return LExpandTerms(
+                field=ft.name,
+                expander=_prefix_expander(ft.name, f"{ft.flat_prefix}=",
+                                          False),
+                boost=q.boost)
         return LExists(field=ft.name if ft else q.field, boost=q.boost)
 
     if isinstance(q, dsl.IdsQuery):
@@ -1518,6 +1591,45 @@ def _phrase_pairs(seg: Segment, pb, rows: Tuple[int, ...]):
     return res
 
 
+def _source_phrase_match(seg: Segment, doc: int, field: str,
+                         terms: List[str], slop: int, analyzer) -> bool:
+    """Re-analyze one doc's _source value(s) for `field` and test the
+    phrase with the same median-offset total-movement slop cost the device
+    path uses (ops/positions.py phrase_freqs)."""
+    if analyzer is None:
+        return False
+    src = seg.sources[doc]
+    node = src
+    for part in field.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return False
+        node = node[part]
+    values = node if isinstance(node, list) else [node]
+    base = 0
+    positions: Dict[str, List[int]] = {}
+    for v in values:
+        toks = analyzer.analyze(str(v))
+        last = 0
+        for t in toks:
+            positions.setdefault(t.text, []).append(base + t.position)
+            last = t.position
+        base += last + 100          # value gap, matching index-time
+    per_term = [positions.get(t) for t in terms]
+    if any(p is None for p in per_term):
+        return False
+    for p0 in per_term[0]:
+        deltas = [0.0]
+        for i, plist in enumerate(per_term[1:], start=1):
+            # nearest adjusted position to the anchor
+            best = min((p - i - p0 for p in plist), key=abs)
+            deltas.append(float(best))
+        med = sorted(deltas)[len(deltas) // 2]
+        cost = sum(abs(d - med) for d in deltas)
+        if cost <= slop:
+            return True
+    return False
+
+
 def _pad_to_sentinel(arr: np.ndarray, size: int) -> np.ndarray:
     out = np.full(size, INT32_SENTINEL, dtype=np.int32)
     out[: len(arr)] = arr
@@ -1556,6 +1668,33 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         return ("terms", nid, node.field, T_pad, bucket, sim.sim_id,
                 float(sim.k1), float(b_eff), node.mode)
 
+    if isinstance(node, LSourcePhrase):
+        pb = seg.postings.get(node.field)
+        if pb is None:
+            return ("match_none", nid)
+        rows = [pb.row(t) for t in node.terms]
+        if any(r < 0 for r in rows):
+            return ("match_none", nid)
+        cand = None
+        for r in rows:
+            a, b = pb.row_slice(r)
+            d = pb.doc_ids[a:b]
+            cand = d if cand is None else np.intersect1d(
+                cand, d, assume_unique=True)
+            if len(cand) == 0:
+                break
+        ft = ctx.mappings.resolve_field(node.field)
+        analyzer = ctx.mappings.index_analyzer(ft) if ft is not None else None
+        docs = [int(d) for d in (cand if cand is not None else ())
+                if _source_phrase_match(seg, int(d), node.field, node.terms,
+                                        node.slop, analyzer)]
+        pad = next_pow2(max(len(docs), 1), floor=8)
+        arr = np.full(pad, INT32_SENTINEL, dtype=np.int32)
+        arr[: len(docs)] = np.asarray(docs, np.int32)
+        _p(params, f"q{nid}_docs", arr)
+        _scalar_f32(params, f"q{nid}_boost", node.weight)
+        return ("ids", nid, pad)
+
     if isinstance(node, LPhrase):
         pb = seg.postings.get(node.field)
         if pb is None or pb.pos_starts is None:
@@ -1574,7 +1713,11 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
             arrays.append(_phrase_pairs(seg, pb, tuple(rows)))
         buckets = []
         for i, (d, p) in enumerate(arrays):
-            bucket = next_pow2(max(len(d), 1), floor=8)
+            # coarse pow4 buckets: pair-array pads land on 1 of ~6 sizes so
+            # phrase programs compile once per coarse shape, not per df
+            bucket = next_pow2(max(len(d), 1), floor=64)
+            if bucket.bit_length() % 2 == 0:   # odd exponent -> round up
+                bucket <<= 1
             _p(params, f"q{nid}_d{i}", _pad_to_sentinel(d, bucket))
             _p(params, f"q{nid}_p{i}", _pad_to_sentinel(p - i, bucket))
             buckets.append(bucket)

@@ -691,70 +691,108 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
 def msearch_batched(searchers: List[ShardSearcher],
                     bodies: List[dict], index_name: str = ""
                     ) -> Optional[List[dict]]:
-    """Batched msearch on the Pallas fast path: ALL bodies' term-group
-    queries over each segment run as ONE kernel launch per shape group (grid
-    over queries) — server-side query batching, the production shape of a TPU
+    """Batched msearch on the Pallas fast path: eligible bodies' queries
+    over each segment run as ONE kernel launch per shape group (grid over
+    queries) — server-side query batching, the production shape of a TPU
     search tier (reference analog: `action/search/TransportMultiSearchAction`
-    just loops; we fuse). Returns None when any body/segment is ineligible —
-    the caller falls back to sequential searches."""
+    just loops; we fuse). Returns a per-body list whose entries are response
+    dicts for bodies the fast path served and None for the rest (the caller
+    runs those through the regular per-body search), or None wholesale when
+    the fast path is off."""
     if not fastpath.enabled() or not searchers:
         return None
     stats = _global_stats_contexts(searchers)
-    parsed = []
+    nb = len(bodies)
+    parsed: List[Optional[tuple]] = []
     for body in bodies:
         body = dict(body)
         body["_index_name"] = index_name
         if (body.get("aggs") or body.get("aggregations") or body.get("rescore")
                 or body.get("search_after") is not None or body.get("min_score")
                 is not None or body.get("profile")):
-            return None
-        query = dsl.parse_query(body.get("query"))
+            parsed.append(None)
+            continue
+        try:
+            query = dsl.parse_query(body.get("query"))
+        except dsl.QueryParseError:
+            parsed.append(None)     # slow path surfaces the error per body
+            continue
         parsed.append((body, query, _norm_sort_specs(body),
                        int(body.get("from", 0)) + int(body.get("size", 10))))
 
     t0 = time.monotonic()
-    nb = len(bodies)
+    ok = [p is not None for p in parsed]
     results = [[ShardQueryResult(shard=i, segments=list(s.engine.segments))
                 for i, s in enumerate(searchers)] for _ in range(nb)]
-    max_window = max((w for _, _, _, w in parsed), default=10)
     served_batches: List[tuple] = []
     for i, s in enumerate(searchers):
+        if not any(ok):
+            break
         ctx = stats[i]
         segments = list(s.engine.segments)
-        fspecs = []
-        for body, query, sort_specs, window in parsed:
-            lroot = C.rewrite(query, ctx, scoring=True)
+        fspecs: List[Optional[Any]] = [None] * nb
+        for bi, p in enumerate(parsed):
+            if not ok[bi]:
+                continue
+            body, query, sort_specs, window = p
+            try:
+                lroot = C.rewrite(query, ctx, scoring=True)
+            except dsl.QueryParseError:
+                ok[bi] = False
+                continue
             if _collect_named(lroot):
-                return None
-            fspec = fastpath.make_spec(lroot, sort_specs, [], [], None,
-                                       window, body)
-            if fspec is None:
-                return None
-            fspecs.append(fspec)
+                ok[bi] = False
+                continue
+            fspecs[bi] = fastpath.make_spec(lroot, sort_specs, [], [], None,
+                                            window, body)
+            if fspecs[bi] is None:
+                ok[bi] = False
+        live_bis = [bi for bi in range(nb) if ok[bi]]
+        if not live_bis:
+            continue
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
                 continue
-            # stats counted only when the whole batch is actually served —
-            # a later fallback discards every result and re-runs slow
-            outs = fastpath.batch_search(seg, ctx, fspecs, max_window,
+            live_bis = [bi for bi in live_bis if ok[bi]]
+            if not live_bis:
+                break
+            # stats counted only for bodies served on every shard/segment —
+            # a later fallback discards that body's results and re-runs slow
+            outs = fastpath.batch_search(seg, ctx,
+                                         [fspecs[bi] for bi in live_bis],
+                                         max((parsed[bi][3]
+                                              for bi in live_bis), default=10),
                                          count_stats=False)
-            if outs is None or any(o is None for o in outs):
-                return None
-            served_batches.append((fspecs, outs))
-            for bi, fout in enumerate(outs):
+            if outs is None:
+                for bi in live_bis:
+                    ok[bi] = False
+                break
+            for bi, o in zip(live_bis, outs):
+                if o is not None:
+                    served_batches.append((bi, fspecs[bi], o))
+            for bi, fout in zip(live_bis, outs):
+                if fout is None:
+                    ok[bi] = False
+                    continue
                 body, _, sort_specs, window = parsed[bi]
                 s._collect_topk(results[bi][i], fout, seg, seg_ord, i,
                                 sort_specs, None, None, False, ctx)
-        for bi, (body, _, sort_specs, window) in enumerate(parsed):
+        for bi in range(nb):
+            if not ok[bi]:
+                continue
+            body, _, sort_specs, window = parsed[bi]
             r = results[bi][i]
             r.candidates.sort(key=lambda c: c.sort_values)
             r.candidates = r.candidates[:window]
             r.took_ms = (time.monotonic() - t0) * 1000.0
-    for fs, outs in served_batches:
-        fastpath.count_served(fs, outs)
+    if not any(ok):
+        return [None] * nb
+    for bi, fs, o in served_batches:
+        if ok[bi]:
+            fastpath.count_served([fs], [o])
     return [_finish_search(searchers, results[bi], parsed[bi][0], stats,
                            index_name, t0, [])
-            for bi in range(nb)]
+            if ok[bi] else None for bi in range(nb)]
 
 
 def _finish_search(searchers: List[ShardSearcher],
@@ -1291,8 +1329,7 @@ def _collapse_key_value(seg: Segment, field: str, doc: int):
         return kcol.vocab[o] if o >= 0 else None
     ncol = seg.numeric_cols.get(field)
     if ncol is not None and ncol.present[doc]:
-        v = ncol.values[doc]
-        return float(v) if ncol.kind == "float" else int(v)
+        return _render_numeric(ncol, doc)
     return None
 
 
@@ -1333,8 +1370,7 @@ def _host_sort_values(sort_specs: List[dict], seg: Segment, doc: int,
             continue
         col = seg.numeric_cols.get(f)
         if col is not None and col.present[doc]:
-            v = col.values[doc]
-            v = float(v) if col.kind == "float" else int(v)
+            v = _render_numeric(col, doc)
             comp.append((0 if not missing_last else 0, -v if desc else v))
             raw.append(v)
             continue
@@ -1424,14 +1460,24 @@ def _filter_source(src: dict, opt) -> dict:
     return out
 
 
+def _render_numeric(col, doc: int):
+    """Column value -> JSON value; unsigned_long unbiases its i64 storage
+    (index/mappings.py U64_BIAS)."""
+    v = col.values[doc]
+    if col.kind == "float":
+        return float(v)
+    if col.kind == "uint":
+        return int(v) + (1 << 63)
+    return int(v)
+
+
 def _docvalue_fields(seg: Segment, doc: int, specs: List) -> dict:
     out = {}
     for spec in specs:
         f = spec if isinstance(spec, str) else spec.get("field")
         col = seg.numeric_cols.get(f)
         if col is not None and col.present[doc]:
-            v = col.values[doc]
-            out[f] = [float(v) if col.kind == "float" else int(v)]
+            out[f] = [_render_numeric(col, doc)]
             continue
         kcol = seg.keyword_cols.get(f)
         if kcol is not None:

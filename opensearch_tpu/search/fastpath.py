@@ -582,11 +582,17 @@ def _filter_list(seg: Segment, ctx, clauses) -> Optional[FilterList]:
     total = ((total + LANES - 1) // LANES) * LANES
     buf = np.full(total, INT_SENTINEL, np.int32)
     buf[:n] = docs
-    fl = FilterList(docs, jax.device_put(buf), n, buf.nbytes, combined, key)
+    # keep the dense mask only when this filter could ever take the
+    # materialized-postings route; breaker-charge what we actually retain
+    dense_capable = (n > _MATERIALIZE_MIN_DOCS
+                     and n * _MATERIALIZE_DENSITY > seg.ndocs)
+    mask_kept = combined if dense_capable else None
+    fl = FilterList(docs, jax.device_put(buf), n, buf.nbytes, mask_kept, key)
     if _breaker is not None:
         import weakref
-        _breaker.add_estimate(buf.nbytes, f"fastpath-filter[{seg.name}]")
-        weakref.finalize(fl, _breaker.release, buf.nbytes)
+        charged = buf.nbytes + (combined.nbytes if dense_capable else 0)
+        _breaker.add_estimate(charged, f"fastpath-filter[{seg.name}]")
+        weakref.finalize(fl, _breaker.release, charged)
     while len(cache) >= _MAX_FILTER_LISTS:
         cache.popitem(last=False)
     cache[key] = fl
@@ -609,7 +615,7 @@ def _filter_list(seg: Segment, ctx, clauses) -> Optional[FilterList]:
 # hot), byte-bounded global LRU.
 
 _MATERIALIZE_MIN_DOCS = 1 << 18    # absolute floor
-_MATERIALIZE_DENSITY = 4           # n * density > ndocs -> "dense"
+_MATERIALIZE_DENSITY = 8           # n * density > ndocs -> "dense" (>12.5%)
 _FILTERED_MAX_BYTES = 6 << 30
 _FILTERED_LRU: "OrderedDict[tuple, FilteredPostings]" = __import__(
     "collections").OrderedDict()
@@ -684,8 +690,10 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
 
 def _dense_hot(seg: Segment, fl: FilterList) -> bool:
     """Dense + repeated (hits counted AFTER this check, so >=1 here means
-    this is at least the filter's second use)."""
-    return (fl.n > _MATERIALIZE_MIN_DOCS
+    this is at least the filter's second use). The mask is only retained
+    for dense-capable filters, so its presence gates the route."""
+    return (fl.mask is not None
+            and fl.n > _MATERIALIZE_MIN_DOCS
             and fl.n * _MATERIALIZE_DENSITY > seg.ndocs
             and fl.hits >= 1)
 

@@ -177,6 +177,7 @@ class RangeQuery(Query):
     lte: Any = None
     lt: Any = None
     date_format: Optional[str] = None
+    relation: str = "intersects"   # range-field targets (RangeFieldMapper)
 
 
 @dataclass
@@ -724,7 +725,9 @@ def parse_query(dsl: Optional[dict]) -> Query:
         f, spec = _one_entry(body, "range")
         q = RangeQuery(field=f, gte=spec.get("gte", spec.get("from")),
                        gt=spec.get("gt"), lte=spec.get("lte", spec.get("to")),
-                       lt=spec.get("lt"), date_format=spec.get("format"))
+                       lt=spec.get("lt"), date_format=spec.get("format"),
+                       relation=str(spec.get("relation",
+                                             "intersects")).lower())
         _common(q, spec)
         return q
 

@@ -35,9 +35,14 @@ class CircuitBreaker:
 
 class BreakerService:
     def __init__(self, device_limit_bytes: int = 12 << 30):
-        # v5e has 16 GiB HBM; leave headroom for scratch + compiled programs
+        # v5e has 16 GiB HBM; leave headroom for scratch + compiled programs.
+        # fielddata covers the fastpath's device-resident layouts (aligned
+        # postings + filter-specialized copies), the dominant HBM tenant —
+        # give it most of the budget (reference fielddata default is 40% of
+        # a JVM heap; HBM residency is this engine's whole design)
         self.breakers = {
-            "fielddata": CircuitBreaker("fielddata", device_limit_bytes // 3),
+            "fielddata": CircuitBreaker("fielddata",
+                                        device_limit_bytes * 3 // 4),
             "request": CircuitBreaker("request", device_limit_bytes // 3),
             "parent": CircuitBreaker("parent", device_limit_bytes),
         }

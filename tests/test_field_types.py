@@ -1,0 +1,273 @@
+"""Field-type long tail: range family, scaled_float, unsigned_long,
+match_only_text, constant_keyword, flat_object, binary, token_count,
+search_as_you_type (reference RangeFieldMapper, mapper-extras
+ScaledFloatFieldMapper, MatchOnlyTextFieldMapper,
+ConstantKeywordFieldMapper, FlatObjectFieldMapper, BinaryFieldMapper,
+TokenCountFieldMapper, SearchAsYouTypeFieldMapper)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture()
+def client():
+    return RestClient()
+
+
+def _ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+class TestRangeFields:
+    @pytest.fixture()
+    def c(self, client):
+        client.indices.create("r", {"mappings": {"properties": {
+            "age": {"type": "integer_range"},
+            "when": {"type": "date_range"},
+            "temp": {"type": "float_range"},
+            "net": {"type": "ip_range"},
+        }}})
+        client.index("r", {"age": {"gte": 10, "lte": 20}}, id="a")
+        client.index("r", {"age": {"gt": 20, "lt": 30}}, id="b")
+        client.index("r", {"age": {"gte": 5, "lte": 50}}, id="c")
+        client.index("r", {"when": {"gte": "2024-01-01",
+                                    "lt": "2024-02-01"}}, id="d")
+        client.index("r", {"temp": {"gte": 1.5, "lt": 2.5}}, id="e")
+        client.index("r", {"net": {"gte": "10.0.0.1",
+                                   "lte": "10.0.0.200"}}, id="f")
+        client.indices.refresh("r")
+        return client
+
+    def test_intersects_default(self, c):
+        r = c.search("r", {"query": {"range": {"age": {"gte": 18,
+                                                       "lte": 22}}}})
+        assert _ids(r) == ["a", "b", "c"]
+
+    def test_within(self, c):
+        # b stores the open range (20, 30) = [21, 29]: 29 > 25 -> not within
+        r = c.search("r", {"query": {"range": {"age": {
+            "gte": 0, "lte": 25, "relation": "within"}}}})
+        assert _ids(r) == ["a"]
+        r2 = c.search("r", {"query": {"range": {"age": {
+            "gte": 0, "lte": 30, "relation": "within"}}}})
+        assert _ids(r2) == ["a", "b"]
+
+    def test_contains(self, c):
+        r = c.search("r", {"query": {"range": {"age": {
+            "gte": 12, "lte": 18, "relation": "contains"}}}})
+        assert _ids(r) == ["a", "c"]
+
+    def test_open_bounds_exact(self, c):
+        # b is (20, 30) exclusive: 20 itself must not match
+        r = c.search("r", {"query": {"term": {"age": 20}}})
+        assert _ids(r) == ["a", "c"]
+        r2 = c.search("r", {"query": {"term": {"age": 21}}})
+        assert _ids(r2) == ["b", "c"]
+
+    def test_date_range(self, c):
+        r = c.search("r", {"query": {"range": {"when": {
+            "gte": "2024-01-15", "lte": "2024-01-20"}}}})
+        assert _ids(r) == ["d"]
+        r2 = c.search("r", {"query": {"term": {"when": "2024-02-01"}}})
+        assert _ids(r2) == []    # lt bound is exclusive
+
+    def test_float_range_ulp(self, c):
+        r = c.search("r", {"query": {"term": {"temp": 2.5}}})
+        assert _ids(r) == []
+        r2 = c.search("r", {"query": {"term": {"temp": 2.4999}}})
+        assert _ids(r2) == ["e"]
+
+    def test_ip_range(self, c):
+        r = c.search("r", {"query": {"term": {"net": "10.0.0.77"}}})
+        assert _ids(r) == ["f"]
+        r2 = c.search("r", {"query": {"term": {"net": "10.0.1.1"}}})
+        assert _ids(r2) == []
+
+    def test_exists(self, c):
+        r = c.search("r", {"query": {"exists": {"field": "age"}}})
+        assert _ids(r) == ["a", "b", "c"]
+
+    def test_invalid_bounds_rejected(self, c):
+        with pytest.raises(ApiError):
+            c.index("r", {"age": {"gte": 30, "lte": 10}}, id="bad")
+
+
+class TestScaledFloat:
+    def test_quantization_and_queries(self, client):
+        client.indices.create("sf", {"mappings": {"properties": {
+            "price": {"type": "scaled_float", "scaling_factor": 100}}}})
+        client.index("sf", {"price": 9.991}, id="a")   # -> 9.99
+        client.index("sf", {"price": 10.004}, id="b")  # -> 10.00
+        client.indices.refresh("sf")
+        r = client.search("sf", {"query": {"range": {"price": {"gte": 10}}}})
+        assert _ids(r) == ["b"]
+        r2 = client.search("sf", {"query": {"term": {"price": 9.99}}})
+        assert _ids(r2) == ["a"]
+        agg = client.search("sf", {"size": 0, "aggs": {
+            "s": {"sum": {"field": "price"}}}})
+        assert abs(agg["aggregations"]["s"]["value"] - 19.99) < 0.01
+
+    def test_missing_factor_rejected(self, client):
+        with pytest.raises(Exception):
+            client.indices.create("sf2", {"mappings": {"properties": {
+                "x": {"type": "scaled_float"}}}})
+
+
+class TestUnsignedLong:
+    def test_order_and_render(self, client):
+        client.indices.create("ul", {"mappings": {"properties": {
+            "n": {"type": "unsigned_long"}}}})
+        big = (1 << 64) - 2
+        client.index("ul", {"n": big}, id="big")
+        client.index("ul", {"n": 5}, id="small")
+        client.index("ul", {"n": (1 << 63) + 7}, id="mid")
+        client.indices.refresh("ul")
+        r = client.search("ul", {"query": {"range": {"n": {
+            "gte": 1 << 63}}}, "sort": [{"n": "desc"}]})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["big", "mid"]
+        got = client.search("ul", {"query": {"term": {"n": big}},
+                                   "docvalue_fields": ["n"]})
+        assert got["hits"]["hits"][0]["fields"]["n"] == [big]
+
+    def test_out_of_range(self, client):
+        client.indices.create("ul2", {"mappings": {"properties": {
+            "n": {"type": "unsigned_long"}}}})
+        with pytest.raises(ApiError):
+            client.index("ul2", {"n": -1}, id="neg")
+        with pytest.raises(ApiError):
+            client.index("ul2", {"n": 1 << 64}, id="over")
+
+
+class TestMatchOnlyText:
+    @pytest.fixture()
+    def c(self, client):
+        client.indices.create("mot", {"mappings": {"properties": {
+            "body": {"type": "match_only_text"}}}})
+        client.index("mot", {"body": "quick brown fox jumps"}, id="a")
+        client.index("mot", {"body": "brown quick fox"}, id="b")
+        client.index("mot", {"body": "quick quick quick dog"}, id="c")
+        client.indices.refresh("mot")
+        return client
+
+    def test_match_constant_tf(self, c):
+        r = c.search("mot", {"query": {"match": {"body": "quick"}}})
+        assert len(r["hits"]["hits"]) == 3
+        # tf clamps to 1: the triple-quick doc scores no higher
+        scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+        assert abs(scores["c"] - scores["b"]) < 1e-4
+
+    def test_phrase_via_source(self, c):
+        r = c.search("mot", {"query": {"match_phrase": {
+            "body": "quick brown"}}})
+        assert _ids(r) == ["a"]
+        r2 = c.search("mot", {"query": {"match_phrase": {
+            "body": {"query": "quick fox", "slop": 1}}}})
+        assert _ids(r2) == ["a", "b"]
+
+
+class TestConstantKeyword:
+    def test_mapping_value(self, client):
+        client.indices.create("ck", {"mappings": {"properties": {
+            "env": {"type": "constant_keyword", "value": "prod"},
+            "body": {"type": "text"}}}})
+        client.index("ck", {"body": "one"}, id="a")          # no env given
+        client.index("ck", {"body": "two", "env": "prod"}, id="b")
+        client.indices.refresh("ck")
+        r = client.search("ck", {"query": {"term": {"env": "prod"}}})
+        assert _ids(r) == ["a", "b"]
+        r2 = client.search("ck", {"query": {"term": {"env": "dev"}}})
+        assert _ids(r2) == []
+        with pytest.raises(ApiError):
+            client.index("ck", {"env": "staging"}, id="bad")
+
+    def test_first_value_fixes(self, client):
+        client.indices.create("ck2", {"mappings": {"properties": {
+            "env": {"type": "constant_keyword"}}}})
+        client.index("ck2", {"env": "dev"}, id="a")
+        with pytest.raises(ApiError):
+            client.index("ck2", {"env": "prod"}, id="b")
+
+
+class TestFlatObject:
+    @pytest.fixture()
+    def c(self, client):
+        client.indices.create("fo", {"mappings": {"properties": {
+            "attrs": {"type": "flat_object"}}}})
+        client.index("fo", {"attrs": {"color": "red",
+                                      "size": {"h": "10", "w": "20"}}},
+                     id="a")
+        client.index("fo", {"attrs": {"color": "blue", "tags": ["x", "y"]}},
+                     id="b")
+        client.indices.refresh("fo")
+        return client
+
+    def test_leaf_term(self, c):
+        r = c.search("fo", {"query": {"term": {"attrs.color": "red"}}})
+        assert _ids(r) == ["a"]
+        r2 = c.search("fo", {"query": {"term": {"attrs.size.h": "10"}}})
+        assert _ids(r2) == ["a"]
+
+    def test_root_search(self, c):
+        # the root field matches any leaf value
+        r = c.search("fo", {"query": {"term": {"attrs": "red"}}})
+        assert _ids(r) == ["a"]
+        r2 = c.search("fo", {"query": {"terms": {"attrs": ["x", "red"]}}})
+        assert _ids(r2) == ["a", "b"]
+
+    def test_leaf_exists(self, c):
+        r = c.search("fo", {"query": {"exists": {"field": "attrs.tags"}}})
+        assert _ids(r) == ["b"]
+
+    def test_same_value_different_paths_distinct(self, c):
+        c.index("fo", {"attrs": {"size": {"w": "10"}}}, id="w10")
+        c.indices.refresh("fo")
+        r = c.search("fo", {"query": {"term": {"attrs.size.h": "10"}}})
+        assert _ids(r) == ["a"]
+
+
+class TestBinaryTokenCount:
+    def test_binary_stored_not_searchable(self, client):
+        client.indices.create("bin", {"mappings": {"properties": {
+            "blob": {"type": "binary"}}}})
+        client.index("bin", {"blob": "U29tZSBiaW5hcnkgYmxvYg=="}, id="a")
+        client.indices.refresh("bin")
+        got = client.get("bin", "a")
+        assert got["_source"]["blob"].startswith("U29tZSB")
+
+    def test_token_count(self, client):
+        client.indices.create("tc", {"mappings": {"properties": {
+            "name": {"type": "text", "fields": {
+                "length": {"type": "token_count", "analyzer": "standard"}}}}}})
+        client.index("tc", {"name": "John Smith"}, id="a")
+        client.index("tc", {"name": "Rachel Alice Williams"}, id="b")
+        client.indices.refresh("tc")
+        r = client.search("tc", {"query": {"range": {"name.length": {
+            "gte": 3}}}})
+        assert _ids(r) == ["b"]
+        agg = client.search("tc", {"size": 0, "aggs": {
+            "m": {"max": {"field": "name.length"}}}})
+        assert agg["aggregations"]["m"]["value"] == 3
+
+
+class TestSearchAsYouType:
+    def test_prefix_and_shingles(self, client):
+        client.indices.create("sayt", {"mappings": {"properties": {
+            "title": {"type": "search_as_you_type"}}}})
+        client.index("sayt", {"title": "quick brown fox"}, id="a")
+        client.index("sayt", {"title": "quick black cat"}, id="b")
+        client.indices.refresh("sayt")
+        # shingle subfield matches the 2gram
+        r = client.search("sayt", {"query": {"match": {
+            "title._2gram": "quick brown"}}})
+        assert _ids(r) == ["a"]
+        # prefix subfield matches partial last term
+        r2 = client.search("sayt", {"query": {"match": {
+            "title._index_prefix": "bro"}}})
+        assert _ids(r2) == ["a"]
+        # bool_prefix over the main field: should-clauses, so the full
+        # prefix match ranks first and the quick-only doc still matches
+        r3 = client.search("sayt", {"query": {"match_bool_prefix": {
+            "title": "quick bl"}}})
+        assert r3["hits"]["hits"][0]["_id"] == "b"
+        assert _ids(r3) == ["a", "b"]

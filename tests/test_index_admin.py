@@ -1,0 +1,206 @@
+"""Index admin APIs: dynamic settings updates, open/close, resize family,
+cluster settings (reference TransportUpdateSettingsAction,
+TransportCloseIndexAction, TransportResizeAction,
+TransportClusterUpdateSettingsAction semantics)."""
+
+import tempfile
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture()
+def client():
+    c = RestClient()
+    c.indices.create("idx", {"settings": {"number_of_shards": 2},
+                             "mappings": {"properties": {
+                                 "body": {"type": "text"},
+                                 "n": {"type": "integer"}}}})
+    for i in range(20):
+        c.index("idx", {"body": f"doc {i} common", "n": i}, id=str(i))
+    c.indices.refresh("idx")
+    return c
+
+
+class TestUpdateSettings:
+    def test_dynamic_settings_apply(self, client):
+        r = client.indices.put_settings("idx", {"index": {
+            "refresh_interval": "30s", "max_result_window": 50000}})
+        assert r["acknowledged"]
+        s = client.indices.get_settings("idx")["idx"]["settings"]["index"]
+        assert s["refresh_interval"] == "30s"
+        assert s["max_result_window"] == 50000
+
+    def test_flat_keys_and_blocks(self, client):
+        client.indices.put_settings("idx", {"index.blocks.write": True})
+        with pytest.raises(ApiError) as e:
+            client.index("idx", {"body": "x"}, id="blocked")
+        assert e.value.status == 403
+        client.indices.put_settings("idx", {"index.blocks.write": False})
+        client.index("idx", {"body": "x"}, id="ok")
+
+    def test_number_of_replicas_rebuilds(self, client):
+        client.indices.put_settings("idx", {"index": {"number_of_replicas": 0}})
+        svc = client.node.indices["idx"]
+        assert svc.meta.num_replicas == 0
+        assert not svc.replicas
+        client.indices.put_settings("idx", {"index": {"number_of_replicas": 1}})
+
+    def test_static_rejected_on_open(self, client):
+        with pytest.raises(ApiError) as e:
+            client.indices.put_settings("idx", {"index": {
+                "analysis": {"analyzer": {"a": {"type": "standard"}}}}})
+        assert e.value.status == 400
+        assert "non dynamic" in e.value.reason
+
+    def test_final_always_rejected(self, client):
+        client.indices.close("idx")
+        with pytest.raises(ApiError) as e:
+            client.indices.put_settings("idx", {"index": {"number_of_shards": 4}})
+        assert e.value.status == 400
+        assert "final" in e.value.reason
+
+    def test_unknown_rejected(self, client):
+        with pytest.raises(ApiError) as e:
+            client.indices.put_settings("idx", {"index": {"bogus_setting": 1}})
+        assert e.value.status == 400
+
+    def test_static_allowed_when_closed(self, client):
+        client.indices.close("idx")
+        client.indices.put_settings("idx", {"index": {"analysis": {
+            "analyzer": {"my": {"type": "custom", "tokenizer": "whitespace",
+                                "filter": ["lowercase"]}}}}})
+        client.indices.open("idx")
+        r = client.indices.analyze("idx", {"analyzer": "my",
+                                           "text": "Hello WORLD"})
+        assert [t["token"] for t in r["tokens"]] == ["hello", "world"]
+
+    def test_slowlog_threshold_update(self, client):
+        client.indices.put_settings("idx", {"index": {"search": {"slowlog": {
+            "threshold": {"query": {"warn": "0ms"}}}}}})
+        client.search("idx", {"query": {"match": {"body": "common"}}})
+        svc = client.node.indices["idx"]
+        assert any(e["level"] == "warn" for e in svc.search_slowlog.entries)
+
+
+class TestOpenClose:
+    def test_close_blocks_search_and_write(self, client):
+        client.indices.close("idx")
+        with pytest.raises(ApiError) as e:
+            client.search("idx", {"query": {"match_all": {}}})
+        assert e.value.status == 400
+        assert e.value.err_type == "index_closed_exception"
+        with pytest.raises(ApiError):
+            client.index("idx", {"body": "y"}, id="nope")
+        client.indices.open("idx")
+        r = client.search("idx", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 20
+
+    def test_wildcard_skips_closed(self, client):
+        client.indices.create("idx2")
+        client.index("idx2", {"body": "other"}, id="a")
+        client.indices.refresh("idx2")
+        client.indices.close("idx")
+        r = client.search("idx*", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 1
+
+    def test_closed_state_persists(self):
+        path = tempfile.mkdtemp()
+        c = RestClient(data_path=path)
+        c.indices.create("p")
+        c.index("p", {"f": 1}, id="1")
+        c.indices.close("p")
+        c2 = RestClient(data_path=path)
+        assert c2.node.indices["p"].meta.state == "close"
+        c2.indices.open("p")
+        c2.indices.refresh("p")
+        assert c2.search("p", {"query": {"match_all": {}}}
+                         )["hits"]["total"]["value"] == 1
+
+
+class TestResize:
+    def _block(self, client):
+        client.indices.put_settings("idx", {"index.blocks.write": True})
+
+    def test_requires_write_block(self, client):
+        with pytest.raises(ApiError) as e:
+            client.indices.shrink("idx", "small")
+        assert "read-only" in e.value.reason
+
+    def test_shrink(self, client):
+        self._block(client)
+        r = client.indices.shrink("idx", "small",
+                                  {"settings": {"index": {
+                                      "number_of_shards": 1}}})
+        assert r["acknowledged"] and r["copied_docs"] == 20
+        assert client.node.indices["small"].meta.num_shards == 1
+        got = client.search("small", {"query": {"match": {"body": "common"}},
+                                      "size": 25})
+        assert got["hits"]["total"]["value"] == 20
+        # docs keep ids and sources
+        d = client.get("small", "7")
+        assert d["_source"]["n"] == 7
+
+    def test_shrink_factor_check(self, client):
+        self._block(client)
+        client.indices.create("idx3", {"settings": {"number_of_shards": 3}})
+        client.indices.put_settings("idx3", {"index.blocks.write": True})
+        with pytest.raises(ApiError):
+            client.indices.shrink("idx3", "bad",
+                                  {"settings": {"index": {
+                                      "number_of_shards": 2}}})
+
+    def test_split_and_clone(self, client):
+        self._block(client)
+        r = client.indices.split("idx", "wide",
+                                 {"settings": {"index": {
+                                     "number_of_shards": 4}}})
+        assert r["copied_docs"] == 20
+        assert client.node.indices["wide"].meta.num_shards == 4
+        assert client.search("wide", {"query": {"match_all": {}}}
+                             )["hits"]["total"]["value"] == 20
+        r2 = client.indices.clone("idx", "copy")
+        assert client.node.indices["copy"].meta.num_shards == 2
+        assert client.search("copy", {"query": {"match_all": {}}}
+                             )["hits"]["total"]["value"] == 20
+        # target is writable (blocks not carried over)
+        client.index("copy", {"body": "new doc"}, id="new")
+
+    def test_target_exists_rejected(self, client):
+        self._block(client)
+        client.indices.create("taken")
+        with pytest.raises(ApiError) as e:
+            client.indices.clone("idx", "taken")
+        assert e.value.status == 400
+
+    def test_split_requires_multiple(self, client):
+        self._block(client)
+        with pytest.raises(ApiError):
+            client.indices.split("idx", "bad2",
+                                 {"settings": {"index": {
+                                     "number_of_shards": 3}}})
+
+
+class TestClusterSettings:
+    def test_put_get_and_reset(self, client):
+        r = client.cluster.put_settings({"persistent": {
+            "cluster.routing.allocation.enable": "primaries"}})
+        assert r["persistent"]["cluster.routing.allocation.enable"] == "primaries"
+        got = client.cluster.get_settings()
+        assert got["persistent"]["cluster.routing.allocation.enable"] == "primaries"
+        client.cluster.put_settings({"persistent": {
+            "cluster.routing.allocation.enable": None}})
+        assert "cluster.routing.allocation.enable" not in \
+            client.cluster.get_settings()["persistent"]
+
+    def test_unknown_rejected(self, client):
+        with pytest.raises(ApiError) as e:
+            client.cluster.put_settings({"persistent": {"nope.nope": 1}})
+        assert e.value.status == 400
+
+    def test_transient_scope(self, client):
+        client.cluster.put_settings({"transient": {
+            "search.default_keep_alive": "2m"}})
+        assert client.cluster.get_settings()["transient"][
+            "search.default_keep_alive"] == "2m"

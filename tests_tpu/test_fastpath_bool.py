@@ -170,6 +170,11 @@ def test_dense_filter_materializes(client):
                         fastpath._MATERIALIZE_DENSITY)
     fastpath._MATERIALIZE_MIN_DOCS = 16
     fastpath._MATERIALIZE_DENSITY = 1000   # any filter counts as dense
+    # drop FilterLists cached under the default thresholds (they didn't
+    # retain their dense masks, so they can never take the new route)
+    for eng in client.node.indices["bidx"].shards:
+        for seg in eng.segments:
+            getattr(seg, "_fastpath_filters", {}).clear()
     n0 = len(fastpath._FILTERED_LRU)
     try:
         body = {"query": {"bool": {"must": [{"match": {"body": "w2 w6"}}],
