@@ -1,0 +1,185 @@
+"""Data streams: append-only time-series abstractions over generations of
+backing indices.
+
+Reference analogs: `cluster/metadata/DataStream.java` (generation counter,
+backing-index naming, timestamp field), `action/admin/indices/datastream/
+{Create,Get,Delete}DataStreamAction.java`, and the rollover path in
+`action/admin/indices/rollover/` (a data-stream rollover creates the next
+backing generation and moves the write target).
+
+TPU-design note: a data stream is pure host-side metadata — each backing
+index is an ordinary index whose segments live in HBM; searches expand the
+stream to its backing indices and ride the normal shard fan-out, so a
+stream behaves like any multi-index expression to the device path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional
+
+from .state import ClusterStateError, IndexNotFoundError
+
+TIMESTAMP_FIELD = "@timestamp"
+
+
+@dataclass
+class DataStreamMetadata:
+    name: str
+    generation: int = 1
+    indices: List[str] = dc_field(default_factory=list)
+
+    @property
+    def write_index(self) -> str:
+        return self.indices[-1]
+
+    def to_dict(self) -> dict:
+        return {"name": self.name,
+                "timestamp_field": {"name": TIMESTAMP_FIELD},
+                "generation": self.generation,
+                "indices": [{"index_name": n} for n in self.indices],
+                "status": "GREEN", "template": ""}
+
+
+class DataStreamError(ClusterStateError):
+    """HTTP 400 analog for data-stream rule violations."""
+
+
+def backing_name(stream: str, generation: int) -> str:
+    return f".ds-{stream}-{generation:06d}"
+
+
+def _matching_ds_template(node, name: str) -> Optional[dict]:
+    for tmpl in node.metadata.matching_templates(name):
+        if "data_stream" in tmpl:
+            return tmpl
+    return None
+
+
+def create_data_stream(node, name: str) -> dict:
+    if name in node.metadata.data_streams:
+        raise DataStreamError(f"data_stream [{name}] already exists")
+    if name in node.indices or name in node.metadata.aliases:
+        raise DataStreamError(
+            f"[{name}] already exists as an index or alias")
+    tmpl = _matching_ds_template(node, name)
+    if tmpl is None:
+        raise DataStreamError(
+            f"no matching index template with a data_stream definition "
+            f"for [{name}]")
+    backing = backing_name(name, 1)
+    _create_backing(node, name, backing)
+    ds = DataStreamMetadata(name=name, generation=1, indices=[backing])
+    node.metadata.data_streams[name] = ds
+    node.metadata.bump()
+    node._persist_data_streams()
+    return {"acknowledged": True}
+
+
+def _create_backing(node, stream: str, backing: str) -> None:
+    """Create one backing index with the STREAM-matched template applied
+    (templates match the stream name, not the .ds-* backing name)."""
+    tmpl = _matching_ds_template(node, stream) or {}
+    tbody = tmpl.get("template", {})
+    node.create_index(backing, {"settings": tbody.get("settings", {}),
+                                "mappings": tbody.get("mappings")})
+    _ensure_timestamp_mapping(node, backing)
+
+
+def _ensure_timestamp_mapping(node, index: str) -> None:
+    svc = node.indices[index]
+    ft = svc.mappings.resolve_field(TIMESTAMP_FIELD)
+    if ft is None:
+        svc.mappings.merge({"properties": {TIMESTAMP_FIELD: {"type": "date"}}})
+    elif ft.type != "date":
+        raise DataStreamError(
+            f"data stream timestamp field [{TIMESTAMP_FIELD}] must be a "
+            f"date, found [{ft.type}]")
+
+
+def get_data_streams(node, expression: str = "*") -> List[dict]:
+    import fnmatch
+    out = []
+    for name in sorted(node.metadata.data_streams):
+        if expression in ("*", "_all", "", None) \
+                or fnmatch.fnmatch(name, expression) \
+                or name == expression:
+            out.append(node.metadata.data_streams[name].to_dict())
+    if not out and expression not in ("*", "_all", "", None) \
+            and "*" not in str(expression):
+        raise IndexNotFoundError(f"no such data stream [{expression}]")
+    return out
+
+
+def delete_data_stream(node, expression: str) -> dict:
+    import fnmatch
+    names = [n for n in list(node.metadata.data_streams)
+             if n == expression or fnmatch.fnmatch(n, str(expression))]
+    if not names:
+        raise IndexNotFoundError(f"no such data stream [{expression}]")
+    for name in names:
+        ds = node.metadata.data_streams.pop(name)
+        for idx in ds.indices:
+            if idx in node.indices:
+                node.delete_index(idx)
+    node.metadata.bump()
+    node._persist_data_streams()
+    return {"acknowledged": True}
+
+
+def rollover_data_stream(node, name: str) -> dict:
+    ds = node.metadata.data_streams.get(name)
+    if ds is None:
+        raise IndexNotFoundError(f"no such data stream [{name}]")
+    old = ds.write_index
+    ds.generation += 1
+    new = backing_name(name, ds.generation)
+    _create_backing(node, name, new)
+    ds.indices.append(new)
+    node.metadata.bump()
+    node._persist_data_streams()
+    return {"acknowledged": True, "old_index": old, "new_index": new,
+            "rolled_over": True, "dry_run": False}
+
+
+def check_write(node, target: str, op_type: str, body: Optional[dict]) -> None:
+    """Data-stream write rules (reference DataStream.validate): only
+    op_type=create appends, and every document carries @timestamp."""
+    if target not in node.metadata.data_streams:
+        return
+    if op_type != "create":
+        raise DataStreamError(
+            f"only write ops with an op_type of create are allowed in "
+            f"data streams [{target}]")
+    if not isinstance(body, dict) or TIMESTAMP_FIELD not in body:
+        raise DataStreamError(
+            f"documents must contain a [{TIMESTAMP_FIELD}] field in data "
+            f"stream [{target}]")
+
+
+def guard_backing_delete(node, index: str) -> None:
+    for ds in node.metadata.data_streams.values():
+        if index in ds.indices:
+            raise DataStreamError(
+                f"index [{index}] is a backing index of data stream "
+                f"[{ds.name}]; delete the data stream instead")
+
+
+def is_backing(node, index: str) -> Optional[str]:
+    for ds in node.metadata.data_streams.values():
+        if index in ds.indices:
+            return ds.name
+    return None
+
+
+def release_deleted(node, deleted: List[str]) -> None:
+    """Keep stream metadata consistent after backing indices were removed
+    through a guard-exempt path (ILM delete action)."""
+    changed = False
+    for ds in node.metadata.data_streams.values():
+        kept = [i for i in ds.indices if i not in deleted]
+        if len(kept) != len(ds.indices):
+            ds.indices = kept
+            changed = True
+    if changed:
+        node._persist_data_streams()

@@ -205,9 +205,16 @@ class LifecycleService:
                                     "reason": str(e)})
                     continue
                 if age >= min_age:
-                    self.node.delete_index(name)
-                    actions.append({"index": name, "action": "delete",
-                                    "age_seconds": age})
+                    from .datastream import DataStreamError
+                    try:
+                        # guard-exempt: ILM may reap rolled-over backing
+                        # indices (never a stream's write index)
+                        self.node.delete_index(name, _ds_guard=False)
+                        actions.append({"index": name, "action": "delete",
+                                        "age_seconds": age})
+                    except DataStreamError as e:
+                        actions.append({"index": name, "action": "error",
+                                        "reason": str(e)})
         self.history.extend(actions)
         return actions
 

@@ -316,6 +316,7 @@ class Node:
         if data_path:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
+            self._recover_data_streams()
 
     # ---------------- index lifecycle ----------------
 
@@ -345,8 +346,35 @@ class Node:
         self._persist_meta(name)
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
 
-    def delete_index(self, expression: str) -> dict:
+    def delete_index(self, expression: str, _ds_guard: bool = True) -> dict:
+        from .datastream import (DataStreamError, guard_backing_delete,
+                                 is_backing, release_deleted)
+        if _ds_guard and expression in self.metadata.data_streams:
+            # reference rejects index-API deletes of a data stream
+            raise DataStreamError(
+                f"[{expression}] is a data stream; use the data stream "
+                f"delete API")
         names = self.metadata.resolve(expression, allow_no_indices=False)
+        is_wild = "*" in str(expression) or "?" in str(expression)
+        if _ds_guard:
+            if is_wild:
+                # wildcards skip (hidden) backing indices, like the
+                # reference's expand-wildcards handling
+                names = [n for n in names if is_backing(self, n) is None]
+                if not names:
+                    return {"acknowledged": True}
+            else:
+                for name in names:
+                    guard_backing_delete(self, name)
+        else:
+            # guard-exempt path (ILM delete): never remove a write index
+            for name in names:
+                ds_name = is_backing(self, name)
+                if ds_name is not None and \
+                        self.metadata.data_streams[ds_name].write_index == name:
+                    raise DataStreamError(
+                        f"cannot delete the write index [{name}] of data "
+                        f"stream [{ds_name}]")
         for name in names:
             svc = self.indices.pop(name, None)
             if svc:
@@ -360,6 +388,8 @@ class Node:
                     shutil.rmtree(p)
         self.metadata.aliases = {a: am for a, am in self.metadata.aliases.items()
                                  if am.indices}
+        if not _ds_guard:
+            release_deleted(self, names)
         self.metadata.bump()
         return {"acknowledged": True}
 
@@ -454,6 +484,32 @@ class Node:
     def get_cluster_settings(self) -> dict:
         from . import admin
         return admin.get_cluster_settings(self)
+
+    # -------- data streams (cluster/datastream.py) --------
+
+    def _persist_data_streams(self) -> None:
+        if not self.data_path:
+            return
+        import json
+        with open(os.path.join(self.data_path, "data_streams.json"),
+                  "w") as fh:
+            json.dump({n: {"generation": ds.generation,
+                           "indices": ds.indices}
+                       for n, ds in self.metadata.data_streams.items()}, fh)
+
+    def _recover_data_streams(self) -> None:
+        import json
+
+        from .datastream import DataStreamMetadata
+        p = os.path.join(self.data_path, "data_streams.json")
+        if not os.path.exists(p):
+            return
+        with open(p) as fh:
+            saved = json.load(fh)
+        for name, d in saved.items():
+            self.metadata.data_streams[name] = DataStreamMetadata(
+                name=name, generation=d["generation"],
+                indices=[i for i in d["indices"] if i in self.indices])
 
     def resolve_open(self, expression, allow_no_indices: bool = True):
         """resolve() then drop closed indices from wildcard expansions;

@@ -58,6 +58,8 @@ class ClusterMetadata:
         self.indices: Dict[str, IndexMetadata] = {}
         self.aliases: Dict[str, AliasMetadata] = {}
         self.templates: Dict[str, dict] = {}
+        # data streams (cluster/datastream.py; reference DataStream.java)
+        self.data_streams: Dict[str, "object"] = {}
         self.version = 0
 
     def bump(self) -> None:
@@ -77,6 +79,9 @@ class ClusterMetadata:
             if ex in self.indices:
                 out.append(ex)
                 continue
+            if ex in self.data_streams:
+                out.extend(self.data_streams[ex].indices)
+                continue
             if ex in self.aliases:
                 out.extend(sorted(self.aliases[ex].indices))
                 continue
@@ -84,6 +89,8 @@ class ClusterMetadata:
                 matched = [n for n in self.indices if fnmatch.fnmatch(n, ex)]
                 matched += [n for a, am in self.aliases.items()
                             if fnmatch.fnmatch(a, ex) for n in am.indices]
+                matched += [n for d, ds in self.data_streams.items()
+                            if fnmatch.fnmatch(d, ex) for n in ds.indices]
                 out.extend(sorted(set(matched)))
                 continue
             raise IndexNotFoundError(f"no such index [{ex}]")
@@ -94,9 +101,12 @@ class ClusterMetadata:
         return uniq
 
     def write_index(self, name: str) -> str:
-        """Resolve an alias to its write index for doc operations."""
+        """Resolve an alias or data stream to its write index."""
         if name in self.indices:
             return name
+        ds = self.data_streams.get(name)
+        if ds is not None:
+            return ds.write_index
         am = self.aliases.get(name)
         if am is not None:
             writes = [i for i, cfg in am.indices.items() if cfg.get("is_write_index")]

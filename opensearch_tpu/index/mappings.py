@@ -224,6 +224,7 @@ class Mappings:
         self.join_field: Optional[str] = None  # at most one per index (like reference)
         self.dynamic = dynamic
         self.dynamic_templates: List[dict] = []
+        self.derived: Dict[str, Any] = {}   # name -> DerivedField
         self._meta: dict = {}
         # reference SourceFieldMapper: `"_source": {"enabled": false}` stops
         # persisting _source in segments (store=true fields remain fetchable
@@ -243,6 +244,13 @@ class Mappings:
             self.source_enabled = bool(mapping["_source"].get("enabled", True))
         self.dynamic_templates.extend(mapping.get("dynamic_templates", []))
         self._merge_props(mapping.get("properties", {}), prefix="")
+        if "derived" in mapping:
+            # derived (runtime) fields: scripts evaluated per segment at
+            # query time (search/derived.py; reference DerivedFieldMapper)
+            from ..search.derived import check_conflicts, parse_defs
+            defs = parse_defs(mapping["derived"])
+            check_conflicts(self, defs)
+            self.derived.update(defs)
 
     def _merge_props(self, props: dict, prefix: str) -> None:
         for name, cfg in props.items():
@@ -362,6 +370,10 @@ class Mappings:
                 node = node.setdefault(p, {}).setdefault("properties", {})
             node.setdefault(parts[-1], {})["type"] = "nested"
         out = {"properties": props}
+        if self.derived:
+            out["derived"] = {n: {"type": d.type,
+                                  "script": {"source": d.source}}
+                              for n, d in self.derived.items()}
         if self._meta:
             out["_meta"] = self._meta
         if not self.source_enabled:
@@ -392,6 +404,11 @@ class Mappings:
                     sub_path = ".".join(parts[i:])
                     return FieldType(name=f"{root}#paths", type="keyword",
                                      flat_prefix=sub_path)
+        df = self.derived.get(name)
+        if df is not None:
+            t = {"long": "long", "double": "double", "date": "date",
+                 "boolean": "boolean", "keyword": "keyword"}[df.type]
+            return FieldType(name=name, type=t, date_format=df.fmt)
         return None
 
     def index_analyzer(self, ft: FieldType) -> Analyzer:

@@ -456,7 +456,10 @@ class Segment:
                                 "postings": {}, "numeric": {}, "keyword": {}, "geo": {},
                                 "text_stats": {f: [s.doc_count, s.sum_dl]
                                                for f, s in self.text_stats.items()}}
+        derived = self.__dict__.get("_derived_names", set())
         for f, pb in self.postings.items():
+            if f in derived:
+                continue   # derived fields are query-time only, never persisted
             key = f"post__{f}"
             arrays[f"{key}__starts"] = pb.starts
             arrays[f"{key}__doc_ids"] = pb.doc_ids
@@ -468,10 +471,14 @@ class Segment:
             with open(os.path.join(path, f"vocab__{f.replace('/', '_')}.txt"), "w") as fh:
                 fh.write("\n".join(pb.vocab))
         for f, col in self.numeric_cols.items():
+            if f in derived:
+                continue
             arrays[f"num__{f}__values"] = col.values
             arrays[f"num__{f}__present"] = col.present
             meta["numeric"][f] = {"kind": col.kind}
         for f, col in self.keyword_cols.items():
+            if f in derived:
+                continue
             arrays[f"kw__{f}__starts"] = col.starts
             arrays[f"kw__{f}__ords"] = col.ords
             arrays[f"kw__{f}__docs"] = col.doc_of_value

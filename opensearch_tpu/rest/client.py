@@ -111,6 +111,10 @@ class RestClient:
               op_type: str = "index", pipeline: Optional[str] = None,
               if_seq_no: Optional[int] = None,
               if_primary_term: Optional[int] = None) -> dict:
+        if index in self.node.metadata.data_streams:
+            from ..cluster import datastream as dstream
+            _map_ds_errors(dstream.check_write, self.node, index, op_type,
+                           body)
         svc = self._svc_for_write(index)
         self._check_write_block(svc)
         pipeline = pipeline or svc.meta.settings.get("index", {}).get("default_pipeline")
@@ -809,9 +813,27 @@ class RestClient:
         return {"actions": self.node.lifecycle.step(now)}
 
     def rollover(self, alias: str, body: Optional[dict] = None) -> dict:
-        """_rollover: roll the alias's write index when ANY condition is met
-        (empty conditions = always; reference RolloverRequest)."""
+        """_rollover: roll the alias's (or data stream's) write index when
+        ANY condition is met (empty conditions = always; reference
+        RolloverRequest)."""
         body = body or {}
+        if alias in self.node.metadata.data_streams:
+            from ..cluster import datastream as dstream
+            old = self.node.metadata.write_index(alias)
+            conds = body.get("conditions", {})
+            try:
+                results = self.node.lifecycle.check_conditions(old, conds)
+            except ValueError as e:
+                raise ApiError(400, "illegal_argument_exception", str(e))
+            rolled = (not conds) or any(results.values())
+            if not rolled:
+                return {"acknowledged": False, "rolled_over": False,
+                        "old_index": old, "new_index": None,
+                        "conditions": results}
+            out = _map_ds_errors(dstream.rollover_data_stream, self.node,
+                                 alias)
+            out["conditions"] = results
+            return out
         if alias not in self.node.metadata.aliases:
             raise ApiError(400, "illegal_argument_exception",
                            f"rollover target [{alias}] is not an alias")
@@ -1242,7 +1264,7 @@ class IndicesClient:
         return self.c.node.create_index(index, body)
 
     def delete(self, index: str) -> dict:
-        return self.c.node.delete_index(index)
+        return _map_ds_errors(self.c.node.delete_index, index)
 
     def exists(self, index: str) -> bool:
         try:
@@ -1389,6 +1411,31 @@ class IndicesClient:
 
     def exists_index_template(self, name: str) -> bool:
         return name in self.c.node.metadata.templates
+
+    # -------- data streams (reference action/admin/indices/datastream) ----
+
+    def create_data_stream(self, name: str) -> dict:
+        from ..cluster import datastream as dstream
+        return _map_ds_errors(dstream.create_data_stream, self.c.node, name)
+
+    def get_data_stream(self, name: str = "*") -> dict:
+        from ..cluster import datastream as dstream
+        return {"data_streams": _map_ds_errors(dstream.get_data_streams,
+                                               self.c.node, name)}
+
+    def delete_data_stream(self, name: str) -> dict:
+        from ..cluster import datastream as dstream
+        return _map_ds_errors(dstream.delete_data_stream, self.c.node, name)
+
+
+def _map_ds_errors(fn, *args):
+    from ..cluster.datastream import DataStreamError
+    try:
+        return fn(*args)
+    except DataStreamError as e:
+        raise ApiError(400, "illegal_argument_exception", str(e))
+    except IndexNotFoundError as e:
+        raise ApiError(404, "index_not_found_exception", str(e))
 
 
 class IngestClient:

@@ -393,6 +393,9 @@ class _DocValuesView:
         return v in self.values
 
 
+HostDocValue = _DocValuesView     # public alias (search/derived.py)
+
+
 class HostEnv:
     """Variable scope + builtins for the host interpreter."""
 

@@ -112,6 +112,30 @@ class ShardSearcher:
                         else list(self.engine.segments))
         ctx = stats_ctx or C.ShardContext(self.engine.mappings, segments,
                                           self.similarity, self.field_similarities)
+        # derived (runtime) fields: mapping-level + search-body defs
+        # materialize into per-segment columns before rewrite sees them
+        ddefs = dict(getattr(ctx.mappings, "derived", {}) or {})
+        if body.get("derived"):
+            from . import derived as derived_mod
+            try:
+                req_defs = derived_mod.parse_defs(body["derived"])
+                derived_mod.check_conflicts(ctx.mappings, req_defs)
+                ddefs.update(req_defs)
+            except ValueError as e:
+                raise dsl.QueryParseError(str(e))
+            import copy as _copy
+            ctx = _copy.copy(ctx)
+            ctx.mappings = derived_mod.MappingsOverlay(ctx.mappings, ddefs)
+        if ddefs:
+            from . import derived as derived_mod
+            names = derived_mod.referenced(ddefs, body)
+            if names:
+                from ..script.painless_lite import ScriptError
+                try:
+                    for seg in segments:
+                        derived_mod.ensure(seg, ctx.mappings, ddefs, names)
+                except (ScriptError, ValueError) as e:
+                    raise dsl.QueryParseError(f"derived field: {e}")
         query = dsl.parse_query(body.get("query")) if (body.get("query")
                                                         or "knn" not in body) else None
         knn_spec = body.get("knn")

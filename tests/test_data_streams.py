@@ -1,0 +1,163 @@
+"""Data streams: template-gated creation, backing-index naming/rollover,
+@timestamp enforcement, create-only writes, search expansion
+(reference cluster/metadata/DataStream.java +
+action/admin/indices/datastream/)."""
+
+import tempfile
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture()
+def client():
+    c = RestClient()
+    c.indices.put_index_template("logs-template", {
+        "index_patterns": ["logs-*"],
+        "data_stream": {},
+        "template": {"mappings": {"properties": {
+            "msg": {"type": "text"}, "level": {"type": "keyword"}}}},
+    })
+    return c
+
+
+def _put(c, stream, docs):
+    for i, d in enumerate(docs):
+        c.index(stream, d, id=f"d{i}", op_type="create")
+    c.indices.refresh(stream)
+
+
+class TestDataStreamCRUD:
+    def test_requires_template(self, client):
+        with pytest.raises(ApiError) as e:
+            client.indices.create_data_stream("metrics-app")
+        assert "template" in e.value.reason
+
+    def test_create_get_delete(self, client):
+        client.indices.create_data_stream("logs-app")
+        got = client.indices.get_data_stream("logs-app")["data_streams"]
+        assert len(got) == 1
+        ds = got[0]
+        assert ds["generation"] == 1
+        assert ds["indices"] == [{"index_name": ".ds-logs-app-000001"}]
+        assert ds["timestamp_field"] == {"name": "@timestamp"}
+        client.indices.delete_data_stream("logs-app")
+        assert client.indices.get_data_stream("*")["data_streams"] == []
+        assert not client.indices.exists(".ds-logs-app-000001")
+
+    def test_name_conflicts(self, client):
+        client.indices.create("logs-taken")
+        with pytest.raises(ApiError):
+            client.indices.create_data_stream("logs-taken")
+        client.indices.create_data_stream("logs-app")
+        with pytest.raises(ApiError):
+            client.indices.create_data_stream("logs-app")
+
+    def test_backing_index_delete_guarded(self, client):
+        client.indices.create_data_stream("logs-app")
+        with pytest.raises(ApiError) as e:
+            client.indices.delete(".ds-logs-app-000001")
+        assert "backing index" in e.value.reason
+        # the index delete API rejects the stream name itself too
+        with pytest.raises(ApiError) as e2:
+            client.indices.delete("logs-app")
+        assert "data stream" in e2.value.reason
+
+    def test_wildcard_delete_skips_backing(self, client):
+        client.indices.create_data_stream("logs-app")
+        client.indices.create("plain")
+        client.indices.delete("*")
+        assert not client.indices.exists("plain")
+        assert client.indices.exists(".ds-logs-app-000001")
+
+    def test_template_mappings_applied(self, client):
+        client.indices.create_data_stream("logs-app")
+        svc = client.node.indices[".ds-logs-app-000001"]
+        ft = svc.mappings.resolve_field("level")
+        assert ft is not None and ft.type == "keyword"
+
+
+class TestDataStreamWrites:
+    def test_create_only_and_timestamp(self, client):
+        client.indices.create_data_stream("logs-app")
+        with pytest.raises(ApiError) as e:
+            client.index("logs-app", {"@timestamp": "2025-01-01",
+                                      "msg": "x"})  # default op_type=index
+        assert "op_type of create" in e.value.reason
+        with pytest.raises(ApiError) as e2:
+            client.index("logs-app", {"msg": "no ts"}, op_type="create")
+        assert "@timestamp" in e2.value.reason
+        r = client.index("logs-app", {"@timestamp": "2025-01-01T10:00:00Z",
+                                      "msg": "hello"}, op_type="create")
+        assert r["result"] == "created"
+        # responses name the concrete backing index (reference behavior)
+        assert r["_index"] == ".ds-logs-app-000001"
+
+    def test_bulk_create(self, client):
+        client.indices.create_data_stream("logs-app")
+        r = client.bulk([
+            {"create": {"_index": "logs-app"}},
+            {"@timestamp": "2025-01-01", "msg": "a"},
+            {"index": {"_index": "logs-app"}},          # rejected
+            {"@timestamp": "2025-01-01", "msg": "b"},
+        ])
+        assert r["errors"]
+        ok = [it for it in r["items"] if "create" in it]
+        bad = [it for it in r["items"] if "index" in it]
+        assert ok[0]["create"]["status"] == 201
+        assert bad[0]["index"]["status"] == 400
+
+    def test_search_expands_backing_indices(self, client):
+        client.indices.create_data_stream("logs-app")
+        _put(client, "logs-app", [
+            {"@timestamp": "2025-01-01", "msg": "alpha", "level": "info"}])
+        client.rollover("logs-app")
+        _put(client, "logs-app", [
+            {"@timestamp": "2025-01-02", "msg": "beta", "level": "warn"}])
+        r = client.search("logs-app", {"query": {"match_all": {}}})
+        assert r["hits"]["total"]["value"] == 2
+        idxs = {h["_index"] for h in r["hits"]["hits"]}
+        assert idxs == {".ds-logs-app-000001", ".ds-logs-app-000002"}
+
+
+class TestDataStreamRollover:
+    def test_rollover_generations(self, client):
+        client.indices.create_data_stream("logs-app")
+        r = client.rollover("logs-app")
+        assert r["rolled_over"]
+        assert r["old_index"] == ".ds-logs-app-000001"
+        assert r["new_index"] == ".ds-logs-app-000002"
+        ds = client.indices.get_data_stream("logs-app")["data_streams"][0]
+        assert ds["generation"] == 2
+        # writes land in the new write index
+        client.index("logs-app", {"@timestamp": "2025-01-03", "msg": "x"},
+                     id="w", op_type="create")
+        client.indices.refresh("logs-app")
+        got = client.search("logs-app", {"query": {"term": {"_id": "w"}}}) \
+            if False else client.search("logs-app", {"query": {"ids": {
+                "values": ["w"]}}})
+        assert got["hits"]["hits"][0]["_index"] == ".ds-logs-app-000002"
+
+    def test_conditional_rollover(self, client):
+        client.indices.create_data_stream("logs-app")
+        r = client.rollover("logs-app", {"conditions": {"max_docs": 5}})
+        assert not r["rolled_over"]
+        _put(client, "logs-app",
+             [{"@timestamp": "2025-01-01", "msg": f"m{i}"} for i in range(6)])
+        r2 = client.rollover("logs-app", {"conditions": {"max_docs": 5}})
+        assert r2["rolled_over"]
+
+    def test_persistence(self):
+        path = tempfile.mkdtemp()
+        c = RestClient(data_path=path)
+        c.indices.put_index_template("t", {"index_patterns": ["s-*"],
+                                           "data_stream": {}})
+        c.indices.create_data_stream("s-1")
+        c.index("s-1", {"@timestamp": "2025-01-01"}, op_type="create")
+        c.rollover("s-1")
+        c.indices.flush("s-1")
+        c2 = RestClient(data_path=path)
+        ds = c2.indices.get_data_stream("s-1")["data_streams"][0]
+        assert ds["generation"] == 2
+        assert len(ds["indices"]) == 2

@@ -1,0 +1,142 @@
+"""Derived (runtime) fields: painless-lite scripts over _source/doc values,
+materialized per segment into ordinary columns so queries/sort/aggs/fetch
+run the normal device path (reference index/mapper/DerivedFieldMapper.java
++ the `derived` mapping and search-body sections)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+@pytest.fixture()
+def client():
+    c = RestClient()
+    c.indices.create("d", {
+        "mappings": {
+            "properties": {"price": {"type": "double"},
+                           "qty": {"type": "integer"},
+                           "name": {"type": "keyword"},
+                           "ts_ms": {"type": "long"}},
+            "derived": {
+                "total": {"type": "double",
+                          "script": {"source":
+                                     "emit(doc['price'].value * doc['qty'].value)"}},
+                "tier": {"type": "keyword",
+                         "script": {"source":
+                                    "if (doc['price'].value >= 100) { return 'high' } "
+                                    "return 'low'"}},
+                "when": {"type": "date",
+                         "script": {"source": "emit(doc['ts_ms'].value)"}},
+            },
+        }})
+    docs = [("a", 120.0, 2, 1700000000000), ("b", 10.0, 5, 1700000100000),
+            ("c", 99.0, 1, 1700000200000)]
+    for i, (n, p, q, t) in enumerate(docs):
+        c.index("d", {"name": n, "price": p, "qty": q, "ts_ms": t}, id=n)
+    c.indices.refresh("d")
+    return c
+
+
+def _ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+class TestMappingDerived:
+    def test_range_query_on_derived_double(self, client):
+        r = client.search("d", {"query": {"range": {"total": {"gte": 60}}}})
+        assert sorted(_ids(r)) == ["a", "c"]   # 240, 50, 99
+
+    def test_term_on_derived_keyword(self, client):
+        r = client.search("d", {"query": {"term": {"tier": "high"}}})
+        assert _ids(r) == ["a"]
+        # filter context too
+        r2 = client.search("d", {"query": {"bool": {
+            "must": [{"match_all": {}}],
+            "filter": [{"term": {"tier": "low"}}]}}})
+        assert sorted(_ids(r2)) == ["b", "c"]
+
+    def test_derived_date_range(self, client):
+        r = client.search("d", {"query": {"range": {"when": {
+            "gte": 1700000050000}}}})
+        assert sorted(_ids(r)) == ["b", "c"]
+
+    def test_sort_and_fields(self, client):
+        r = client.search("d", {"sort": [{"total": "desc"}],
+                                "docvalue_fields": ["total"]})
+        assert _ids(r) == ["a", "c", "b"]
+        assert r["hits"]["hits"][0]["fields"]["total"] == [240.0]
+
+    def test_aggs_on_derived(self, client):
+        r = client.search("d", {"size": 0, "aggs": {
+            "tiers": {"terms": {"field": "tier"}},
+            "sum_total": {"sum": {"field": "total"}}}})
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["tiers"]["buckets"]}
+        assert buckets == {"high": 1, "low": 2}
+        assert abs(r["aggregations"]["sum_total"]["value"] - 389.0) < 1e-3
+
+    def test_mapping_roundtrip(self, client):
+        m = client.indices.get_mapping("d")["d"]["mappings"]
+        assert m["derived"]["total"]["type"] == "double"
+
+
+class TestSearchBodyDerived:
+    def test_request_level_definition(self, client):
+        r = client.search("d", {
+            "derived": {"double_qty": {
+                "type": "long",
+                "script": {"source": "emit(doc['qty'].value * 2)"}}},
+            "query": {"range": {"double_qty": {"gte": 4}}}})
+        assert sorted(_ids(r)) == ["a", "b"]
+
+    def test_redefinition_rebuilds(self, client):
+        body1 = {"derived": {"x": {"type": "long",
+                                   "script": {"source": "emit(doc['qty'].value)"}}},
+                 "query": {"range": {"x": {"gte": 5}}}}
+        assert _ids(client.search("d", body1)) == ["b"]
+        body2 = {"derived": {"x": {"type": "long",
+                                   "script": {"source": "emit(doc['qty'].value * 10)"}}},
+                 "query": {"range": {"x": {"gte": 5}}}}
+        assert sorted(_ids(client.search("d", body2))) == ["a", "b", "c"]
+
+    def test_source_access(self, client):
+        r = client.search("d", {
+            "derived": {"nm": {"type": "keyword",
+                               "script": {"source": "params._source.name"}}},
+            "query": {"term": {"nm": "b"}}})
+        assert _ids(r) == ["b"]
+
+    def test_conflict_with_mapped_field_rejected(self, client):
+        with pytest.raises(ApiError) as e:
+            client.search("d", {
+                "derived": {"price": {"type": "double",
+                                      "script": {"source": "emit(1.0)"}}},
+                "query": {"range": {"price": {"gte": 0}}}})
+        assert e.value.status == 400
+        assert "conflict" in e.value.reason
+
+    def test_script_error_400(self, client):
+        with pytest.raises(ApiError) as e:
+            client.search("d", {
+                "derived": {"bad": {"type": "long",
+                                    "script": {"source": "doc['nope'].value"}}},
+                "query": {"range": {"bad": {"gte": 0}}}})
+        assert e.value.status == 400
+
+
+class TestDerivedPersistence:
+    def test_not_persisted(self):
+        import tempfile
+        path = tempfile.mkdtemp()
+        c = RestClient(data_path=path)
+        c.indices.create("p", {"mappings": {
+            "properties": {"n": {"type": "integer"}},
+            "derived": {"n2": {"type": "long",
+                               "script": {"source": "emit(doc['n'].value * 2)"}}}}})
+        c.index("p", {"n": 3}, id="1")
+        c.indices.refresh("p")
+        assert _ids(c.search("p", {"query": {"term": {"n2": 6}}})) == ["1"]
+        c.indices.flush("p")
+        c2 = RestClient(data_path=path)
+        # derived defs survive via the mapping; values rematerialize
+        assert _ids(c2.search("p", {"query": {"term": {"n2": 6}}})) == ["1"]
