@@ -78,12 +78,18 @@ def create_data_stream(node, name: str) -> dict:
 
 def _create_backing(node, stream: str, backing: str) -> None:
     """Create one backing index with the STREAM-matched template applied
-    (templates match the stream name, not the .ds-* backing name)."""
+    (templates match the stream name, not the .ds-* backing name). A bad
+    template (e.g. non-date @timestamp) rolls the index creation back so
+    no orphaned backing index survives."""
     tmpl = _matching_ds_template(node, stream) or {}
     tbody = tmpl.get("template", {})
     node.create_index(backing, {"settings": tbody.get("settings", {}),
                                 "mappings": tbody.get("mappings")})
-    _ensure_timestamp_mapping(node, backing)
+    try:
+        _ensure_timestamp_mapping(node, backing)
+    except DataStreamError:
+        node.delete_index(backing, _ds_guard=False)
+        raise
 
 
 def _ensure_timestamp_mapping(node, index: str) -> None:
@@ -132,9 +138,9 @@ def rollover_data_stream(node, name: str) -> dict:
     if ds is None:
         raise IndexNotFoundError(f"no such data stream [{name}]")
     old = ds.write_index
+    new = backing_name(name, ds.generation + 1)
+    _create_backing(node, name, new)    # state mutates only on success
     ds.generation += 1
-    new = backing_name(name, ds.generation)
-    _create_backing(node, name, new)
     ds.indices.append(new)
     node.metadata.bump()
     node._persist_data_streams()

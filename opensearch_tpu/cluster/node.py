@@ -279,6 +279,8 @@ class Node:
         self.breakers = BreakerService()
         self.request_cache = RequestCache()
         self.tasks = TaskRegistry()
+        from ..utils.backpressure import SearchBackpressureService
+        self.search_backpressure = SearchBackpressureService()
         self.thread_pools = ThreadPools()
         from ..utils.wlm import WorkloadManagement
         from .lifecycle import LifecycleService
@@ -507,9 +509,11 @@ class Node:
         with open(p) as fh:
             saved = json.load(fh)
         for name, d in saved.items():
+            indices = [i for i in d["indices"] if i in self.indices]
+            if not indices:
+                continue     # every backing index lost: the stream is gone
             self.metadata.data_streams[name] = DataStreamMetadata(
-                name=name, generation=d["generation"],
-                indices=[i for i in d["indices"] if i in self.indices])
+                name=name, generation=d["generation"], indices=indices)
 
     def resolve_open(self, expression, allow_no_indices: bool = True):
         """resolve() then drop closed indices from wildcard expansions;
@@ -680,6 +684,10 @@ class Node:
                     import copy as _copy
                     return _copy.deepcopy(cached)
                 return cached
+        # backpressure: hard admission gate, then duress check cancels the
+        # worst in-flight offender (reference SearchBackpressureService)
+        self.search_backpressure.admit(self.tasks)
+        self.search_backpressure.check(self.tasks)
         task = self.tasks.register("indices:data/read/search",
                                    f"indices[{expression}]")
         t0 = time.monotonic()
@@ -749,6 +757,7 @@ class Node:
             "search_pipelines": self.search_pipelines.stats(),
             "failure_detection": self.failure_detector.stats(),
             "wlm": self.wlm.stats(),
+            "search_backpressure": self.search_backpressure.stats(),
             "uptime_in_millis": int((time.time() - self.start_time) * 1000),
         }
         if self.mesh_service is not None:

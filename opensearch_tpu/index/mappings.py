@@ -85,6 +85,9 @@ class FieldType:
     # synthetic flat_object leaf (FlatObjectFieldMapper ._valueAndPath):
     # query terms become "<flat_prefix>=<value>" against `<root>#paths`
     flat_prefix: Optional[str] = None
+    # term_vector: "with_positions_offsets" persists per-doc (term, pos,
+    # start, end) for the real FastVectorHighlighter path
+    term_vector: str = "no"
 
     @property
     def is_indexed_terms(self) -> bool:
@@ -186,6 +189,10 @@ class ParsedDocument:
     terms: Dict[str, List[str]] = dc_field(default_factory=dict)
     # field -> list of (term, position) for positional indexes
     positions: Dict[str, List[Tuple[str, int]]] = dc_field(default_factory=dict)
+    # field -> per-VALUE lists of (term, position, start_offset,
+    # end_offset) for term_vector=with_positions_offsets fields (FVH);
+    # offsets are relative to their own value string
+    offsets: Dict[str, List[List[Tuple[str, int, int, int]]]] = dc_field(default_factory=dict)
     # field -> raw values for store=true fields (reference stored fields)
     stored: Dict[str, list] = dc_field(default_factory=dict)
     # field -> list of numeric values (column stores the first; extra values
@@ -286,6 +293,7 @@ class Mappings:
             copy_to=list(cfg.get("copy_to", []) if isinstance(cfg.get("copy_to", []), list)
                          else [cfg["copy_to"]]),
             date_format=cfg.get("format"),
+            term_vector=cfg.get("term_vector", "no"),
             boost=cfg.get("boost", 1.0),
             norms=cfg.get("norms", True),
             dims=int(cfg.get("dims", cfg.get("dimension", 0))),
@@ -371,9 +379,10 @@ class Mappings:
             node.setdefault(parts[-1], {})["type"] = "nested"
         out = {"properties": props}
         if self.derived:
-            out["derived"] = {n: {"type": d.type,
-                                  "script": {"source": d.source}}
-                              for n, d in self.derived.items()}
+            out["derived"] = {
+                n: {"type": d.type, "script": {"source": d.source},
+                    **({"format": d.fmt} if d.fmt else {})}
+                for n, d in self.derived.items()}
         if self._meta:
             out["_meta"] = self._meta
         if not self.source_enabled:
@@ -406,9 +415,7 @@ class Mappings:
                                      flat_prefix=sub_path)
         df = self.derived.get(name)
         if df is not None:
-            t = {"long": "long", "double": "double", "date": "date",
-                 "boolean": "boolean", "keyword": "keyword"}[df.type]
-            return FieldType(name=name, type=t, date_format=df.fmt)
+            return FieldType(name=name, type=df.type, date_format=df.fmt)
         return None
 
     def index_analyzer(self, ft: FieldType) -> Analyzer:
@@ -620,9 +627,16 @@ class Mappings:
                     return
                 pl = parsed.positions.setdefault(name, [])
                 base = pl[-1][1] + 100 if pl else 0  # position gap between values
+                ol = None
+                if "offsets" in ft.term_vector:
+                    ol = []
+                    parsed.offsets.setdefault(name, []).append(ol)
                 for t in tokens:
                     tl.append(t.text)
                     pl.append((t.text, base + t.position))
+                    if ol is not None:
+                        ol.append((t.text, base + t.position,
+                                   t.start_offset, t.end_offset))
             return
         if ft.type == "binary":
             # base64 payload: stored/_source only, never indexed (reference

@@ -51,14 +51,21 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
     sources: List[dict] = []
     stored_vals: List = []
     any_stored = any(getattr(s, "stored_vals", None) for s in segments)
+    tv_fields = {f for s in segments
+                 for f in (getattr(s, "term_vectors", None) or {})}
+    term_vectors = {f: [] for f in tv_fields}
     seq_nos = np.empty(ndocs, dtype=np.int64)
     for s, m, dmap in zip(segments, live_masks, doc_maps):
+        stv = getattr(s, "term_vectors", None) or {}
         for old in np.nonzero(m)[0]:
             ids.append(s.ids[old])
             sources.append(s.sources[old])
             if any_stored:
                 stored_vals.append(s.stored_vals[old]
                                    if s.stored_vals else None)
+            for f in tv_fields:
+                col = stv.get(f)
+                term_vectors[f].append(col[old] if col else None)
         seq_nos[dmap[m]] = s.seq_nos[m]
 
     # ---- postings ----
@@ -268,11 +275,13 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
         from .segment import NestedBlock
         nested[npath] = NestedBlock(merged_child, parent_of)
 
-    return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
-                   doc_lens, text_stats, ids, sources, seq_nos=seq_nos,
-                   vector_cols=vector_cols, nested=nested,
-                   shape_cols=shape_cols,
-                   stored_vals=stored_vals if any_stored else None)
+    merged = Segment(name, ndocs, postings, numeric_cols, keyword_cols,
+                     geo_cols, doc_lens, text_stats, ids, sources,
+                     seq_nos=seq_nos, vector_cols=vector_cols, nested=nested,
+                     shape_cols=shape_cols,
+                     stored_vals=stored_vals if any_stored else None)
+    merged.term_vectors = term_vectors if tv_fields else None
+    return merged
 
 
 def _ranges_gather(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:

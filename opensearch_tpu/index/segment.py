@@ -296,6 +296,8 @@ class Segment:
         # per-doc {field: [raw values]} for store=true fields (reference
         # stored fields, independent of _source)
         self.stored_vals = stored_vals
+        # term_vector offsets per field -> per-doc [(term, pos, start, end)]
+        self.term_vectors: Optional[Dict[str, list]] = None
         self.doc_lens = doc_lens
         self.text_stats = text_stats
         self.nested: Dict[str, NestedBlock] = nested or {}
@@ -343,44 +345,12 @@ class Segment:
             if device is not None:
                 jnp = _DevicePut(device)  # route jnp.asarray onto the device
             dpad = self.ndocs_pad
-            post = {}
-            for f, pb in self.postings.items():
-                ppad = next_pow2(pb.size)
-                rpad = next_pow2(pb.nterms + 2)
-                starts = _pad_to(pb.starts.astype(np.int32), rpad, np.int32(pb.size))
-                post[f] = {
-                    "starts": jnp.asarray(starts),
-                    "doc_ids": jnp.asarray(_pad_to(pb.doc_ids.astype(np.int32), ppad, INT32_SENTINEL)),
-                    "tfs": jnp.asarray(_pad_to(pb.tfs.astype(np.float32), ppad, np.float32(0))),
-                }
-            ncols = {}
-            for f, col in self.numeric_cols.items():
-                if col.kind in ("int", "uint"):
-                    hi, lo = split_i64(col.values)
-                    # unsigned_long stores biased i64 (order-exact); the f32
-                    # agg/script view unbiases back to the real magnitude
-                    f32v = (col.values.astype(np.float64) + float(1 << 63)
-                            if col.kind == "uint"
-                            else col.values).astype(np.float32)
-                    ncols[f] = {
-                        "hi": jnp.asarray(_pad_to(hi, dpad, np.int32(0))),
-                        "lo": jnp.asarray(_pad_to(lo, dpad, np.int32(0))),
-                        "f32": jnp.asarray(_pad_to(f32v, dpad, np.float32(0))),
-                        "present": jnp.asarray(_pad_to(col.present, dpad, False)),
-                    }
-                else:
-                    ncols[f] = {
-                        "f32": jnp.asarray(_pad_to(col.values.astype(np.float32), dpad, np.float32(0))),
-                        "present": jnp.asarray(_pad_to(col.present, dpad, False)),
-                    }
-            kcols = {}
-            for f, col in self.keyword_cols.items():
-                vpad = next_pow2(len(col.ords))
-                kcols[f] = {
-                    "ords": jnp.asarray(_pad_to(col.ords, vpad, np.int32(-1))),
-                    "doc_of_value": jnp.asarray(_pad_to(col.doc_of_value, vpad, INT32_SENTINEL)),
-                    "min_ord": jnp.asarray(_pad_to(col.min_ord, dpad, np.int32(-1))),
-                }
+            post = {f: _post_field_arrays(pb, jnp)
+                    for f, pb in self.postings.items()}
+            ncols = {f: _num_field_arrays(col, dpad, jnp)
+                     for f, col in self.numeric_cols.items()}
+            kcols = {f: _kw_field_arrays(col, dpad, jnp)
+                     for f, col in self.keyword_cols.items()}
             vcols = {}
             for f, col in self.vector_cols.items():
                 dims = col.values.shape[1]
@@ -406,13 +376,8 @@ class Segment:
                     vcols[f]["ivf_centroids"] = jnp.asarray(cent)
                     vcols[f]["ivf_lists"] = jnp.asarray(lists)
                     vcols[f]["ivf_cvalid"] = jnp.asarray(cvalid)
-            gcols = {}
-            for f, col in self.geo_cols.items():
-                gcols[f] = {
-                    "lat": jnp.asarray(_pad_to(col.lat, dpad, np.float32(0))),
-                    "lon": jnp.asarray(_pad_to(col.lon, dpad, np.float32(0))),
-                    "present": jnp.asarray(_pad_to(col.present, dpad, False)),
-                }
+            gcols = {f: _geo_field_arrays(col, dpad, jnp)
+                     for f, col in self.geo_cols.items()}
             dls = {f: jnp.asarray(_pad_to(dl.astype(np.float32), dpad, np.float32(0)))
                    for f, dl in self.doc_lens.items()}
             # NOTE: values must all be arrays — plain ints would become traced
@@ -441,9 +406,78 @@ class Segment:
             self._device_live_dirty[key] = False
         return self._device_cache[key]
 
+    def pruned_arrays(self, device, needs: Dict[str, set]) -> dict:
+        """Device arrays for ONLY the named fields — the filter-mask path
+        uses this so building a status-term mask never ships the body
+        postings to HBM (device_arrays is all-or-nothing; jit argument
+        pruning happens after the transfer already paid). Per-field device
+        arrays are cached; a later full device_arrays() reuses nothing
+        (separate cache) but is also not forced by a mask build anymore.
+        `needs` keys: postings / numeric / keyword / geo -> field sets."""
+        import jax
+        import jax.numpy as _jnp
+
+        key = device
+        if key in self._device_cache:
+            # the full pytree already exists: serve from it (no extra HBM)
+            return self.device_arrays(device)
+        jnp = _DevicePut(device) if device is not None else _jnp
+        cache = self.__dict__.setdefault("_field_device_cache", {})
+        dpad = self.ndocs_pad
+
+        def field(group: str, f: str, builder):
+            k = (key, group, f)
+            if k not in cache:
+                cache[k] = builder()
+            return cache[k]
+
+        out: Dict[str, Any] = {"postings": {}, "numeric": {}, "keyword": {},
+                               "geo": {}, "vector": {}, "doc_lens": {},
+                               "nested": {}}
+        for f in needs.get("postings", ()):
+            pb = self.postings.get(f)
+            if pb is not None:
+                out["postings"][f] = field(
+                    "postings", f, lambda pb=pb: _post_field_arrays(pb, jnp))
+        for f in needs.get("numeric", ()):
+            col = self.numeric_cols.get(f)
+            if col is not None:
+                out["numeric"][f] = field(
+                    "numeric", f,
+                    lambda col=col: _num_field_arrays(col, dpad, jnp))
+        for f in needs.get("keyword", ()):
+            col = self.keyword_cols.get(f)
+            if col is not None:
+                out["keyword"][f] = field(
+                    "keyword", f,
+                    lambda col=col: _kw_field_arrays(col, dpad, jnp))
+        for f in needs.get("geo", ()):
+            col = self.geo_cols.get(f)
+            if col is not None:
+                out["geo"][f] = field(
+                    "geo", f,
+                    lambda col=col: _geo_field_arrays(col, dpad, jnp))
+        for f in needs.get("doc_lens", ()):
+            dl = self.doc_lens.get(f)
+            if dl is not None:
+                out["doc_lens"][f] = field(
+                    "doc_lens", f, lambda dl=dl: jnp.asarray(
+                        _pad_to(dl.astype(np.float32), dpad, np.float32(0))))
+        lk = (key, "#live", self.live_gen)
+        if lk not in cache:
+            for stale in [c for c in cache if c[1] == "#live"]:
+                del cache[stale]
+            live = _pad_to(self.live.astype(np.float32), self.ndocs_pad,
+                           np.float32(0))
+            cache[lk] = (jax.device_put(live, device) if device is not None
+                         else _jnp.asarray(live))
+        out["live"] = cache[lk]
+        return out
+
     def drop_device(self) -> None:
         self._device_cache = {}
         self._device_live_dirty = {}
+        self.__dict__.pop("_field_device_cache", None)
         for blk in self.nested.values():
             blk.child.drop_device()
 
@@ -521,6 +555,10 @@ class Segment:
                 if self.stored_vals and self.stored_vals[i]:
                     rec["_stored"] = self.stored_vals[i]
                 fh.write(json.dumps(rec) + "\n")
+        if self.term_vectors:
+            with open(os.path.join(path, "term_vectors.json"), "w") as fh:
+                json.dump({f: col for f, col in self.term_vectors.items()},
+                          fh)
 
     @classmethod
     def load(cls, path: str) -> "Segment":
@@ -589,7 +627,62 @@ class Segment:
                   stored_vals=stored_vals if any_stored else None)
         seg.live = arrays["live"].copy()
         seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
+        tv_path = os.path.join(path, "term_vectors.json")
+        if os.path.exists(tv_path):
+            with open(tv_path) as fh:
+                raw = json.load(fh)
+            seg.term_vectors = {
+                f: [[tuple(e) for e in col] if col else None
+                    for col in cols]
+                for f, cols in raw.items()}
         return seg
+
+
+def _post_field_arrays(pb: "PostingsBlock", jnp) -> dict:
+    ppad = next_pow2(pb.size)
+    rpad = next_pow2(pb.nterms + 2)
+    starts = _pad_to(pb.starts.astype(np.int32), rpad, np.int32(pb.size))
+    return {
+        "starts": jnp.asarray(starts),
+        "doc_ids": jnp.asarray(_pad_to(pb.doc_ids.astype(np.int32), ppad, INT32_SENTINEL)),
+        "tfs": jnp.asarray(_pad_to(pb.tfs.astype(np.float32), ppad, np.float32(0))),
+    }
+
+
+def _num_field_arrays(col: "NumericColumn", dpad: int, jnp) -> dict:
+    if col.kind in ("int", "uint"):
+        hi, lo = split_i64(col.values)
+        # unsigned_long stores biased i64 (order-exact); the f32
+        # agg/script view unbiases back to the real magnitude
+        f32v = (col.values.astype(np.float64) + float(1 << 63)
+                if col.kind == "uint" else col.values).astype(np.float32)
+        return {
+            "hi": jnp.asarray(_pad_to(hi, dpad, np.int32(0))),
+            "lo": jnp.asarray(_pad_to(lo, dpad, np.int32(0))),
+            "f32": jnp.asarray(_pad_to(f32v, dpad, np.float32(0))),
+            "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+        }
+    return {
+        "f32": jnp.asarray(_pad_to(col.values.astype(np.float32), dpad, np.float32(0))),
+        "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+    }
+
+
+def _kw_field_arrays(col: "KeywordColumn", dpad: int, jnp) -> dict:
+    vpad = next_pow2(len(col.ords))
+    return {
+        "ords": jnp.asarray(_pad_to(col.ords, vpad, np.int32(-1))),
+        "doc_of_value": jnp.asarray(_pad_to(col.doc_of_value, vpad, INT32_SENTINEL)),
+        "min_ord": jnp.asarray(_pad_to(col.min_ord, dpad, np.int32(-1))),
+    }
+
+
+def _geo_field_arrays(col: "GeoColumn", dpad: int, jnp) -> dict:
+    return {
+        "lat": jnp.asarray(_pad_to(col.lat, dpad, np.float32(0))),
+        "lon": jnp.asarray(_pad_to(col.lon, dpad, np.float32(0))),
+        "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+    }
 
 
 def _pack_postings_python(parsed_docs: list, with_positions: bool) -> Dict[str, PostingsBlock]:
@@ -730,6 +823,13 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
     stored_vals = ([dict(d.stored) if d.stored else None
                     for d in parsed_docs]
                    if any(d.stored for d in parsed_docs) else None)
+    term_vectors = None
+    if any(d.offsets for d in parsed_docs):
+        term_vectors = {}
+        for doc_i, pd in enumerate(parsed_docs):
+            for fname, offs in pd.offsets.items():
+                col = term_vectors.setdefault(fname, [None] * ndocs)
+                col[doc_i] = offs
 
     # ---- inverted fields ----
     doc_lens: Dict[str, np.ndarray] = {}
@@ -889,7 +989,11 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                                     np.asarray(parent_of, dtype=np.int32))
 
     seq = np.asarray(seq_nos, dtype=np.int64) if seq_nos is not None else None
-    return Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
-                   doc_lens, text_stats, ids, sources, seq_nos=seq,
-                   vector_cols=vector_cols, nested=nested,
-                   shape_cols=shape_cols, stored_vals=stored_vals)
+    seg = Segment(name, ndocs, postings, numeric_cols, keyword_cols, geo_cols,
+                  doc_lens, text_stats, ids, sources, seq_nos=seq,
+                  vector_cols=vector_cols, nested=nested,
+                  shape_cols=shape_cols, stored_vals=stored_vals)
+    # term_vector=with_positions_offsets fields: per-doc (term, pos, start,
+    # end) for the FVH path (host-only, like _source)
+    seg.term_vectors = term_vectors
+    return seg

@@ -342,8 +342,8 @@ DL_MAX = DL_MASK
 
 def _bm25_tfdl_kernel(T: int, L: int, K: int, k1: float, b: float,
                       sizes: tuple,
-                      rowstart_ref, nrows_ref, lens_ref, weights_ref,
-                      msm_ref, avgdl_ref, dlo_ref, dhi_ref,
+                      rowstart_ref, nrows_ref, lens_ref, skips_ref,
+                      weights_ref, msm_ref, avgdl_ref, dlo_ref, dhi_ref,
                       docs_hbm, tfdl_hbm, out_scores, out_docs, out_totals,
                       docs_v, tfdl_v, sems):
     q = pl.program_id(0)
@@ -390,21 +390,28 @@ def _bm25_tfdl_kernel(T: int, L: int, K: int, k1: float, b: float,
 
     w_row = jnp.zeros((R, LANES), jnp.float32)
     len_row = jnp.zeros((R, LANES), jnp.int32)
+    skip_row = jnp.zeros((R, LANES), jnp.int32)
     for t in range(T):
         sel = term_of_row == t
         w_row = jnp.where(sel, weights_ref[t, q], w_row)
         len_row = jnp.where(sel, lens_ref[t, q], len_row)
-    # doc-range window: oversized posting rows are split by the host into
-    # virtual sub-queries over disjoint [dlo, dhi) doc ranges (DMA windows
-    # align down to 1024 elements and spill a prefix of smaller doc ids).
-    # The merge network needs each slot ASCENDING, so below-range docs map to
+        skip_row = jnp.where(sel, skips_ref[t, q], skip_row)
+    # posting rows are 128-lane aligned; each DMA starts at the 1024-aligned
+    # HBM block below the window, so `skip` masks the spilled-in prefix
+    # (which may belong to the PREVIOUS row) positionally. Oversized rows
+    # additionally split into [dlo, dhi) doc ranges. The merge network needs
+    # each slot ASCENDING, so excluded-but-in-window docs below range map to
     # a NEGATIVE sentinel (front of the run, excluded at the end) — mapping
     # them to +sentinel would break sortedness and split dedup runs.
     dlo = dlo_ref[0, q]
     dhi = dhi_ref[0, q]
-    in_pos = pos_in_term < len_row
+    in_pos = (pos_in_term >= skip_row) & (pos_in_term < skip_row + len_row)
     valid = in_pos & (docs2 >= dlo) & (docs2 < dhi)
-    keys = jnp.where(in_pos & (docs2 < dlo), NEG_SENTINEL,
+    # the skip prefix must sort to the FRONT of the slot (NEG_SENTINEL):
+    # +sentinel there would break the merge network's ascending-run
+    # invariant, exactly like below-range docs in chunked windows
+    is_prefix = pos_in_term < skip_row
+    keys = jnp.where(is_prefix | (in_pos & (docs2 < dlo)), NEG_SENTINEL,
                      jnp.where(valid, docs2, INT_SENTINEL))
 
     # mask after the shift: tf >= 1024 sets the i32 sign bit and >> is
@@ -464,17 +471,20 @@ def _bm25_tfdl_kernel(T: int, L: int, K: int, k1: float, b: float,
 @functools.partial(jax.jit, static_argnames=("T", "L", "K", "k1", "b"))
 def fused_bm25_topk_tfdl(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
                          rowstarts: jnp.ndarray, nrows: jnp.ndarray,
-                         lens: jnp.ndarray, weights: jnp.ndarray,
+                         lens: jnp.ndarray, skips: jnp.ndarray,
+                         weights: jnp.ndarray,
                          msm: jnp.ndarray, avgdl: jnp.ndarray,
                          dlo: jnp.ndarray, dhi: jnp.ndarray,
                          T: int, L: int, K: int, k1: float, b: float):
     """Batched fused BM25 top-k over packed (tf, dl) postings.
 
-    docs_hbm  i32[P] — doc ids, CSR-flat, rows 1024-element aligned
+    docs_hbm  i32[P] — doc ids, CSR-flat, rows 128-lane aligned
     tfdl_hbm  i32[P] — tf << DL_BITS | dl per posting (lossless)
-    rowstarts i32[QB, T] — aligned row starts in 128-lane ROW units
+    rowstarts i32[QB, T] — DMA starts in 128-lane ROW units, 1024-element
+              aligned (host aligns the window start DOWN to the HBM tile)
     nrows     i32[QB, T] — pow2 rows to DMA per term (0 = absent)
-    lens      i32[QB, T] — true posting counts (element units)
+    lens      i32[QB, T] — true window posting counts (element units)
+    skips     i32[QB, T] — spilled-in prefix length before the window
     weights   f32[QB, T] — query-time idf * boost
     msm       f32[QB, 1] — minimum matching terms
     avgdl     f32[QB, 1] — query-time average doc length scalar
@@ -486,6 +496,7 @@ def fused_bm25_topk_tfdl(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
     rowstarts = rowstarts.T
     nrows = nrows.T
     lens = lens.T
+    skips = skips.T
     weights = weights.T
     msm = msm.T
     avgdl = avgdl.T
@@ -503,7 +514,7 @@ def fused_bm25_topk_tfdl(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
     kernel = functools.partial(_bm25_tfdl_kernel, T, L, K, float(k1), float(b),
                                tuple(sizes))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=8,
+        num_scalar_prefetch=9,
         grid=(QB,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.ANY),
@@ -530,7 +541,7 @@ def fused_bm25_topk_tfdl(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
         grid_spec=grid_spec,
         out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
-    )(rowstarts, nrows, lens, weights, msm, avgdl, dlo, dhi,
+    )(rowstarts, nrows, lens, skips, weights, msm, avgdl, dlo, dhi,
       docs_hbm, tfdl_hbm)
     return scores, doc_ids, totals
 
@@ -558,7 +569,8 @@ REQ_W = 1024.0
 
 def _bm25_bool_kernel(TS: int, L: int, K: int, k1: float, b: float,
                       sizes: tuple, filtered: bool,
-                      rowstart_ref, nrows_ref, lens_ref, weights_ref,
+                      rowstart_ref, nrows_ref, lens_ref, skips_ref,
+                      weights_ref,
                       cw_ref, thresh_ref, avgdl_ref, dlo_ref, dhi_ref,
                       docs_hbm, tfdl_hbm, filt_hbm,
                       out_scores, out_docs, out_totals,
@@ -625,18 +637,24 @@ def _bm25_bool_kernel(TS: int, L: int, K: int, k1: float, b: float,
 
     w_row = jnp.zeros((R, LANES), jnp.float32)
     len_row = jnp.zeros((R, LANES), jnp.int32)
+    skip_row = jnp.zeros((R, LANES), jnp.int32)
     cw_row = jnp.zeros((R, LANES), jnp.float32)
     for t in range(T):
         sel = term_of_row == t
         len_row = jnp.where(sel, lens_ref[t, q], len_row)
+        skip_row = jnp.where(sel, skips_ref[t, q], skip_row)
         cw_row = jnp.where(sel, cw_ref[t, q], cw_row)
         if t < TS:
             w_row = jnp.where(sel, weights_ref[t, q], w_row)
     dlo = dlo_ref[0, q]
     dhi = dhi_ref[0, q]
-    in_pos = pos_in_term < len_row
+    in_pos = (pos_in_term >= skip_row) & (pos_in_term < skip_row + len_row)
     valid = in_pos & (docs2 >= dlo) & (docs2 < dhi)
-    keys = jnp.where(in_pos & (docs2 < dlo), NEG_SENTINEL,
+    # the skip prefix must sort to the FRONT of the slot (NEG_SENTINEL):
+    # +sentinel there would break the merge network's ascending-run
+    # invariant, exactly like below-range docs in chunked windows
+    is_prefix = pos_in_term < skip_row
+    keys = jnp.where(is_prefix | (in_pos & (docs2 < dlo)), NEG_SENTINEL,
                      jnp.where(valid, docs2, INT_SENTINEL))
 
     tf = ((tfdl2 >> DL_BITS) & TF_MAX).astype(jnp.float32)
@@ -700,7 +718,8 @@ def _bm25_bool_kernel(TS: int, L: int, K: int, k1: float, b: float,
 def fused_bm25_bool_topk(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
                          filt_hbm: jnp.ndarray,
                          rowstarts: jnp.ndarray, nrows: jnp.ndarray,
-                         lens: jnp.ndarray, weights: jnp.ndarray,
+                         lens: jnp.ndarray, skips: jnp.ndarray,
+                         weights: jnp.ndarray,
                          cw: jnp.ndarray, thresh: jnp.ndarray,
                          avgdl: jnp.ndarray, dlo: jnp.ndarray,
                          dhi: jnp.ndarray,
@@ -721,6 +740,7 @@ def fused_bm25_bool_topk(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
     rowstarts = rowstarts.T
     nrows = nrows.T
     lens = lens.T
+    skips = skips.T
     weights = weights.T
     cw = cw.T
     thresh = thresh.T
@@ -742,7 +762,7 @@ def fused_bm25_bool_topk(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
     kernel = functools.partial(_bm25_bool_kernel, TS, L, K, float(k1),
                                float(b), tuple(sizes), bool(filtered))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=9,
+        num_scalar_prefetch=10,
         grid=(QB,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.ANY),
@@ -770,7 +790,7 @@ def fused_bm25_bool_topk(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
         grid_spec=grid_spec,
         out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
-    )(rowstarts, nrows, lens, weights, cw, thresh, avgdl, dlo, dhi,
+    )(rowstarts, nrows, lens, skips, weights, cw, thresh, avgdl, dlo, dhi,
       docs_hbm, tfdl_hbm, filt_hbm)
     return scores, doc_ids, totals
 

@@ -352,6 +352,10 @@ class RestClient:
             raise ApiError(400, "task_cancelled_exception", str(e))
         except IndexClosedError as e:
             raise ApiError(400, "index_closed_exception", str(e))
+        except PressureRejectedException as e:
+            # search backpressure admission control (reference
+            # ratelimitting/admissioncontrol)
+            raise ApiError(429, "rejected_execution_exception", str(e))
         resp = self._apply_response_pipeline(pipeline, resp, phase_ctx, body)
         if scroll:
             sid = uuid.uuid4().hex
@@ -753,6 +757,7 @@ class RestClient:
             "breakers": n.breakers.stats(),
             "tasks": n.tasks.stats(),
             "wlm": n.wlm.stats(),
+            "search_backpressure": n.search_backpressure.stats(),
             "search_pipelines": n.search_pipelines.stats(),
             "tracing": n.tracer.stats(),
         }

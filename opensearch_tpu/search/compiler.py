@@ -3067,6 +3067,45 @@ def _combine_boost(jnp, score, factor, mode: str):
 # sort
 # =====================================================================
 
+def _nested_sort_values(seg: Segment, field: str, path: str, mode: str):
+    """Per-parent aggregate of a nested child numeric column (reference
+    NestedSortBuilder): min/max/sum/avg over each parent's block children.
+    Cached per (field, path, mode). -> (values f64[ndocs], present bool) or
+    (None, None)."""
+    cache = seg.__dict__.setdefault("_nested_sort_cache", {})
+    key = (field, path, mode)
+    if key in cache:
+        return cache[key]
+    blk = seg.nested.get(path)
+    col = blk.child.numeric_cols.get(field) if blk is not None else None
+    if col is None:
+        cache[key] = (None, None)
+        return cache[key]
+    n = seg.ndocs
+    parent = blk.parent_of[: blk.child.ndocs]
+    pres_child = col.present[: blk.child.ndocs] & blk.child.live[: blk.child.ndocs]
+    vals_child = col.values[: blk.child.ndocs].astype(np.float64)
+    out = np.full(n, np.inf if mode == "min" else
+                  (-np.inf if mode == "max" else 0.0), np.float64)
+    present = np.zeros(n, bool)
+    p = parent[pres_child]
+    v = vals_child[pres_child]
+    if mode == "min":
+        np.minimum.at(out, p, v)
+    elif mode == "max":
+        np.maximum.at(out, p, v)
+    else:                              # sum / avg
+        np.add.at(out, p, v)
+    present[np.unique(p)] = True
+    if mode == "avg":
+        cnt = np.zeros(n, np.float64)
+        np.add.at(cnt, p, 1.0)
+        out = np.divide(out, np.maximum(cnt, 1.0))
+    out = np.where(present, out, 0.0)
+    cache[key] = (out, present)
+    return cache[key]
+
+
 def prepare_sort(sort_specs: List[dict], seg: Segment, params: dict):
     """Bind sort to a segment. Device ranks by the PRIMARY key exactly (rank
     ordinals for numerics — see NumericColumn.sort_ords); the executor
@@ -3084,6 +3123,33 @@ def prepare_sort(sort_specs: List[dict], seg: Segment, params: dict):
     desc = primary.get("order", "asc") == "desc"
     missing = primary.get("missing", "_last")
     missing_last = missing == "_last"
+    if field == "_geo_distance":
+        # device primary key = f32 haversine meters (host re-orders the
+        # window exactly); reference GeoDistanceSortBuilder
+        gfield = primary["geo_field"]
+        if gfield not in seg.geo_cols:
+            return ("missing_field", desc, missing_last)
+        lat, lon = primary["origin"]
+        _p(params, "sort_geo_olat", np.float32(lat))
+        _p(params, "sort_geo_olon", np.float32(lon))
+        return ("geo_dist", gfield, desc, missing_last)
+    nspec = primary.get("nested")
+    if nspec and nspec.get("path"):
+        vals, present = _nested_sort_values(seg, field, nspec["path"],
+                                            primary.get("mode",
+                                                        "max" if desc
+                                                        else "min"))
+        if vals is None:
+            return ("missing_field", desc, missing_last)
+        ords = np.full(seg.ndocs, -1, np.int32)
+        if present.any():
+            uniq = np.unique(vals[present])
+            ords[present] = np.searchsorted(uniq, vals[present]).astype(np.int32)
+        import jax.numpy as _jnp
+        pad = np.full(seg.ndocs_pad, -1, dtype=np.int32)
+        pad[: seg.ndocs] = ords
+        params["sort_ords"] = _jnp.asarray(pad)
+        return ("field_ord", desc, missing_last)
     if field in seg.numeric_cols:
         cache = getattr(seg, "_sort_dev_cache", None)
         if cache is None:
@@ -3112,6 +3178,14 @@ def emit_sort_key(sort_spec, seg_arrays: dict, params: dict, scores):
     if kind == "doc":
         return -jnp.arange(ndocs_pad, dtype=jnp.float32)
     big = jnp.float32(2.0**30)
+    if kind == "geo_dist":
+        _, gfield, desc, missing_last = sort_spec
+        g = seg_arrays["geo"][gfield]
+        dist = ops.geo_distance_vec(g, params["sort_geo_olat"],
+                                    params["sort_geo_olon"])
+        key = dist if desc else -dist
+        missing_key = -big if missing_last else big
+        return jnp.where(g["present"], key, missing_key)
     if kind == "field_ord":
         _, desc, missing_last = sort_spec
         ords = params["sort_ords"].astype(jnp.float32)
@@ -4608,8 +4682,47 @@ def filter_mask_for(node: LNode, seg: Segment, ctx: ShardContext):
     key, mapping = _filter_cache_key(spec, local, seg)
     if key is None:
         return None, None, spec, local
-    mask = _mask_for_key(key, spec, local, mapping, seg)
+    mask = _mask_for_key(key, spec, local, mapping, seg,
+                         needs=node_needs(node))
     return mask, key, spec, local
+
+
+def node_needs(node: LNode) -> Optional[Dict[str, set]]:
+    """Per-group field sets a filter node's program reads — the mask
+    executor then ships ONLY those columns to device (Segment.pruned_arrays)
+    instead of the whole segment. None = unknown node kind, use the full
+    arrays."""
+    needs: Dict[str, set] = {"postings": set(), "numeric": set(),
+                             "keyword": set(), "geo": set(),
+                             "doc_lens": set()}
+
+    def walk(n) -> bool:
+        if n is None:
+            return True
+        if isinstance(n, (LMatchAll, LMatchNone, LIds)):
+            return True
+        if isinstance(n, (LTerms, LExpandTerms)):
+            needs["postings"].add(n.field)
+            needs["doc_lens"].add(n.field)
+            return True
+        if isinstance(n, LRange):
+            needs["numeric"].add(n.field)
+            return True
+        if isinstance(n, LExists):
+            for g in ("postings", "numeric", "keyword", "geo"):
+                needs[g].add(n.field)
+            return True
+        if isinstance(n, (LGeoDist, LGeoBox)):
+            needs["geo"].add(n.field)
+            return True
+        if isinstance(n, LConstScore):
+            return walk(n.child)
+        if isinstance(n, LBool):
+            return all(walk(c) for c in
+                       n.musts + n.shoulds + n.must_nots + n.filters)
+        return False     # unknown kind: caller ships the full arrays
+
+    return needs if walk(node) else None
 
 
 def _filter_cache_key(spec, local: dict, seg: Segment):
@@ -4647,7 +4760,8 @@ def _prepare_cached_filter(node: LNode, seg: Segment, ctx: ShardContext,
 
 
 def _mask_for_key(key, spec, local: dict, mapping: Dict[int, int],
-                  seg: Segment) -> np.ndarray:
+                  seg: Segment, needs: Optional[Dict[str, set]] = None
+                  ) -> np.ndarray:
     mask = _FILTER_MASK_CACHE.get(key)
     if mask is None:
         # use whichever device already hosts this segment (replica copies
@@ -4661,8 +4775,10 @@ def _mask_for_key(key, spec, local: dict, mapping: Dict[int, int],
         canon_local = {_canon_param_key(k, mapping): v
                        for k, v in local.items()}
         exe = _build_mask_executor(canon)
+        arrays = (seg.pruned_arrays(dev_key, needs) if needs is not None
+                  else seg.device_arrays(dev_key))
         # host-resident bools: safe to feed executors on ANY device
-        mask = np.asarray(exe(seg.device_arrays(dev_key), canon_local))
+        mask = np.asarray(exe(arrays, canon_local))
         _FILTER_MASK_CACHE[key] = mask
         _FILTER_MASK_BYTES[0] += mask.nbytes
         if not hasattr(seg, "_mask_fin"):

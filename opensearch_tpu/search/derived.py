@@ -133,9 +133,31 @@ def ensure(seg, mappings, defs: Dict[str, DerivedField],
         derived_names.add(name)
         changed = True
     if changed:
-        # structure of the device pytree changed: rebuilt on next access
-        seg._device_cache.clear()
-        seg._device_live_dirty.clear()
+        _purge_query_caches(seg, names)
+
+
+def _purge_query_caches(seg, names: List[str]) -> None:
+    """A rematerialized derived field invalidates every cache derived from
+    its old column: the device pytree, per-field device arrays, cached
+    filter masks and fastpath filter lists/aligned layouts, sort ordinals,
+    and date buckets."""
+    from . import compiler as C
+    from . import fastpath as FP
+
+    seg._device_cache.clear()
+    seg._device_live_dirty.clear()
+    seg.__dict__.pop("_field_device_cache", None)
+    C._purge_masks_for_uid(seg.uid)
+    FP._purge_filtered_for_uid(seg.uid)
+    seg.__dict__.get("_fastpath_filters", {}).clear()
+    for name in names:
+        seg.__dict__.get("_fastpath_aligned", {}).pop(name, None)
+        seg.__dict__.get("_sort_dev_cache", {}).pop(name, None)
+        for cache_name in ("_date_bucket_cache", "_nested_sort_cache"):
+            c = seg.__dict__.get(cache_name)
+            if c:
+                for k in [k for k in c if k[0] == name]:
+                    del c[k]
 
 
 class _LazyDocCols(dict):

@@ -27,7 +27,8 @@ from . import query_dsl as dsl
 from .aggregations import (AggNode, _apply_bucket_pipelines,
                            apply_pipelines_tree, finalize, merge_partials,
                            parse_aggs)
-from .highlight import collect_query_terms, highlight_field, highlight_unified
+from .highlight import (collect_query_terms, highlight_field,
+                        highlight_fvh, highlight_unified)
 
 INT32_SENTINEL = np.int32(2**31 - 1)
 
@@ -57,6 +58,14 @@ class ShardQueryResult:
     took_ms: float = 0.0
 
 
+_GEO_SORT_OPTS = {"order", "unit", "mode", "distance_type",
+                  "ignore_unmapped", "nested"}
+_DIST_UNITS = {"m": 1.0, "meters": 1.0, "km": 1000.0, "kilometers": 1000.0,
+               "mi": 1609.344, "miles": 1609.344, "yd": 0.9144,
+               "ft": 0.3048, "in": 0.0254, "cm": 0.01, "mm": 0.001,
+               "nmi": 1852.0, "nauticalmiles": 1852.0}
+
+
 def _norm_sort_specs(body: dict) -> List[dict]:
     out = []
     for s in body.get("sort", []):
@@ -64,7 +73,22 @@ def _norm_sort_specs(body: dict) -> List[dict]:
             out.append({"field": s, "order": "desc" if s == "_score" else "asc"})
         else:
             ((f, spec),) = s.items()
-            if isinstance(spec, str):
+            if f == "_geo_distance":
+                # {"_geo_distance": {"location": <origin>, "order": ...,
+                #  "unit": "km"}} (reference GeoDistanceSortBuilder)
+                from ..index.mappings import _parse_geo
+                opts = {k: v for k, v in spec.items() if k in _GEO_SORT_OPTS}
+                geo_fields = [k for k in spec if k not in _GEO_SORT_OPTS]
+                if len(geo_fields) != 1:
+                    raise dsl.QueryParseError(
+                        "[_geo_distance] sort needs exactly one geo field")
+                lat, lon = _parse_geo(spec[geo_fields[0]])
+                out.append({"field": "_geo_distance",
+                            "geo_field": geo_fields[0],
+                            "origin": (lat, lon),
+                            "order": opts.get("order", "asc"),
+                            "unit": opts.get("unit", "m")})
+            elif isinstance(spec, str):
                 out.append({"field": f, "order": spec})
             else:
                 out.append({"field": f, **spec})
@@ -190,10 +214,14 @@ class ShardSearcher:
                                         body)
                      if fastpath.enabled() and self.device is None else None)
 
+        seg_t0 = time.monotonic()
         for seg_ord, seg in enumerate(segments):
             if task is not None:
                 # cooperative cancellation between segment programs
-                # (reference CancellableTask checks between leaves)
+                # (reference CancellableTask checks between leaves) +
+                # device-time accounting for backpressure victim selection
+                task.track(device_seconds=time.monotonic() - seg_t0)
+                seg_t0 = time.monotonic()
                 task.ensure_not_cancelled()
             if seg.live_count == 0:
                 continue
@@ -612,21 +640,32 @@ class ShardSearcher:
                 frags = []
                 analyzer = self.engine.mappings.index_analyzer(ft)
                 hl_type = fopts.get("type", hl_body.get("type", "plain"))
-                # "fvh" is served by the unified passage highlighter: the
-                # reference FVH exists to reuse stored term-vector offsets,
-                # but offsets are not persisted here (positions are), so
-                # both types re-derive offsets by re-analysis
-                hl_fn = (highlight_unified if hl_type in ("unified", "fvh")
-                         else highlight_field)
-                for v in vals:
-                    frags.extend(hl_fn(
-                        str(v), terms, analyzer,
-                        pre_tag=(hl_body.get("pre_tags") or ["<em>"])[0],
-                        post_tag=(hl_body.get("post_tags") or ["</em>"])[0],
-                        fragment_size=int(fopts.get("fragment_size",
-                                                    hl_body.get("fragment_size", 100))),
-                        number_of_fragments=int(fopts.get("number_of_fragments",
-                                                          hl_body.get("number_of_fragments", 5)))))
+                hl_kw = dict(
+                    pre_tag=(hl_body.get("pre_tags") or ["<em>"])[0],
+                    post_tag=(hl_body.get("post_tags") or ["</em>"])[0],
+                    fragment_size=int(fopts.get(
+                        "fragment_size", hl_body.get("fragment_size", 100))),
+                    number_of_fragments=int(fopts.get(
+                        "number_of_fragments",
+                        hl_body.get("number_of_fragments", 5))))
+                tv = (getattr(seg, "term_vectors", None) or {}).get(fname)
+                entries = tv[c.local_doc] if tv else None
+                if hl_type == "fvh" and entries:
+                    # real FVH: persisted term-vector offsets, no
+                    # re-analysis; entries are per value, offsets relative
+                    # to that value (term_vector=with_positions_offsets)
+                    for v, ventry in zip(vals, entries):
+                        if ventry:
+                            frags.extend(highlight_fvh(
+                                str(v), terms, ventry, **hl_kw))
+                else:
+                    # fvh without stored vectors degrades to unified
+                    # (offsets re-derived by re-analysis)
+                    hl_fn = (highlight_unified
+                             if hl_type in ("unified", "fvh")
+                             else highlight_field)
+                    for v in vals:
+                        frags.extend(hl_fn(str(v), terms, analyzer, **hl_kw))
                 if frags:
                     hl[fname] = frags
             if hl:
@@ -1376,6 +1415,37 @@ def _host_sort_values(sort_specs: List[dict], seg: Segment, doc: int,
         if f == "_doc":
             comp.append(doc)
             raw.append(doc)
+            continue
+        if f == "_geo_distance":
+            import math
+            col = seg.geo_cols.get(spec["geo_field"])
+            if col is not None and col.present[doc]:
+                olat, olon = spec["origin"]
+                p1 = math.radians(float(col.lat[doc]))
+                p2 = math.radians(olat)
+                dl = math.radians(olon - float(col.lon[doc]))
+                a = (math.sin((p2 - p1) / 2) ** 2
+                     + math.cos(p1) * math.cos(p2) * math.sin(dl / 2) ** 2)
+                dist_m = 2 * 6371008.8 * math.asin(math.sqrt(min(a, 1.0)))
+                v = dist_m / _DIST_UNITS.get(spec.get("unit", "m"), 1.0)
+                comp.append((0, -v if desc else v))
+                raw.append(v)
+            else:
+                comp.append((1 if missing_last else -1, 0.0))
+                raw.append(None)
+            continue
+        nspec = spec.get("nested")
+        if nspec and nspec.get("path"):
+            vals, present = C._nested_sort_values(
+                seg, f, nspec["path"],
+                spec.get("mode", "max" if desc else "min"))
+            if vals is not None and present[doc]:
+                v = float(vals[doc])
+                comp.append((0, -v if desc else v))
+                raw.append(v)
+            else:
+                comp.append((1 if missing_last else -1, 0.0))
+                raw.append(None)
             continue
         if f == "_script":
             from ..script import run_field_script

@@ -9,7 +9,8 @@ plans, segments with deletes, non-BM25 similarities, or posting rows larger
 than the VMEM bucket cap.
 
 Per (segment, field) we lazily build a DMA-friendly postings layout:
-1024-element-aligned CSR rows of (doc_id i32, tf<<21|dl i32). The packing is
+128-lane-aligned CSR rows of (doc_id i32, tf<<21|dl i32); DMA windows
+align down to the 1024-element HBM tile with a positional skip mask. The packing is
 lossless (tf < 2048, dl < 2^21 — segments violating it are ineligible), and
 the kernel evaluates the SAME f32 BM25 expression as the XLA path with avgdl
 as a query-time scalar, so both paths rank identically.
@@ -109,8 +110,11 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     if len(dl_of) and dl_of.max() > DL_MAX:
         return None
     packed = ((tfs.astype(np.int64) << DL_BITS) | dl_of).astype(np.int32)
+    # rows align to 128 lanes only; DMA windows align DOWN to the 1024
+    # HBM tile and mask the spilled prefix positionally (skip) — the Zipf
+    # long tail would otherwise pay up to 1023 pad slots per rare term
     a_starts, a_docs, a_packed = align_csr_rows(
-        pb.starts, pb.doc_ids, packed, margin=MAX_L)
+        pb.starts, pb.doc_ids, packed, margin=MAX_L, alignment=LANES)
     nbytes = a_docs.nbytes + a_packed.nbytes
     if _breaker is not None:
         import weakref
@@ -298,8 +302,9 @@ def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
 class _VQuery:
     """One kernel-row: a whole query, or one doc-range chunk of it."""
 
-    __slots__ = ("qi", "T_pad", "L", "rowstarts", "nrows", "lens", "weights",
-                 "msm", "avgdl", "dlo", "dhi", "k1", "b_eff", "field")
+    __slots__ = ("qi", "T_pad", "L", "rowstarts", "nrows", "lens", "skips",
+                 "weights", "msm", "avgdl", "dlo", "dhi", "k1", "b_eff",
+                 "field")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -327,6 +332,7 @@ def _chunk_slots(slots: List[Optional[Tuple[np.ndarray, int]]], ndocs: int,
             rowstarts = np.zeros(T_total, np.int32)
             nrows = np.zeros(T_total, np.int32)
             lens = np.zeros(T_total, np.int32)
+            skips = np.zeros(T_total, np.int32)
             max_nr = HBM_ALIGN // LANES
             for i, slot in enumerate(slots):
                 if slot is None:
@@ -336,18 +342,22 @@ def _chunk_slots(slots: List[Optional[Tuple[np.ndarray, int]]], ndocs: int,
                 hi_off = int(np.searchsorted(seg_docs, edges[c + 1], "left"))
                 if hi_off == lo_off:
                     continue
-                # align the DMA start down to the HBM tile; the doc-range
-                # window masks the spilled-in prefix
-                al_off = (lo_off // HBM_ALIGN) * HBM_ALIGN
-                ln = hi_off - al_off
-                if ln > budget:
+                # DMA starts at the 1024 HBM tile below the window; the
+                # spilled prefix (which may belong to the previous row) is
+                # masked positionally by `skip` in the kernel
+                abs_el = start_el + lo_off
+                dma_el = (abs_el // HBM_ALIGN) * HBM_ALIGN
+                skip = abs_el - dma_el
+                ln = hi_off - lo_off
+                if skip + ln > budget:
                     ok = False
                     break
-                rowstarts[i] = (start_el + al_off) // LANES
-                nr = next_pow2((ln + LANES - 1) // LANES,
+                rowstarts[i] = dma_el // LANES
+                nr = next_pow2((skip + ln + LANES - 1) // LANES,
                                floor=HBM_ALIGN // LANES)
                 nrows[i] = nr
                 lens[i] = ln
+                skips[i] = skip
                 max_nr = max(max_nr, nr)
             if not ok:
                 break
@@ -355,7 +365,7 @@ def _chunk_slots(slots: List[Optional[Tuple[np.ndarray, int]]], ndocs: int,
                 ok = False
                 break
             per_chunk.append((int(edges[c]), int(edges[c + 1]),
-                              rowstarts, nrows, lens))
+                              rowstarts, nrows, lens, skips))
         if ok:
             return per_chunk
         nchunk *= 2
@@ -408,6 +418,7 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
         rowstarts = np.zeros(T_pad, np.int32)
         nrows = np.zeros(T_pad, np.int32)
         lens = np.zeros(T_pad, np.int32)
+        skips = np.zeros(T_pad, np.int32)
         max_nr = min_rows
         fits = True
         for i, r in enumerate(rows):
@@ -416,17 +427,21 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
             ln = int(al.lens[r])
             if ln == 0:
                 continue
-            if ln > MAX_L:
+            abs_el = int(al.starts_rows[r]) * LANES
+            dma_el = (abs_el // HBM_ALIGN) * HBM_ALIGN
+            skip = abs_el - dma_el
+            if skip + ln > MAX_L:
                 fits = False
                 break
-            rowstarts[i] = al.starts_rows[r]
-            nr = next_pow2((ln + LANES - 1) // LANES, floor=min_rows)
+            rowstarts[i] = dma_el // LANES
+            nr = next_pow2((skip + ln + LANES - 1) // LANES, floor=min_rows)
             nrows[i] = nr
             lens[i] = ln
+            skips[i] = skip
             max_nr = max(max_nr, nr)
         if fits and T_pad * max_nr * LANES <= MAX_TL:
             out.append([_VQuery(L=max_nr * LANES, rowstarts=rowstarts,
-                                nrows=nrows, lens=lens, dlo=0,
+                                nrows=nrows, lens=lens, skips=skips, dlo=0,
                                 dhi=int(INT_MAX), **common)])
             continue
 
@@ -437,10 +452,11 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
             out.append(None)
             continue
         vqs = []
-        for dlo, dhi, rowstarts, nrows, lens in chunks:
+        for dlo, dhi, rowstarts, nrows, lens, skips in chunks:
             L = int(max(nrows.max(), min_rows)) * LANES
             vqs.append(_VQuery(L=L, rowstarts=rowstarts, nrows=nrows,
-                               lens=lens, dlo=dlo, dhi=dhi, **common))
+                               lens=lens, skips=skips, dlo=dlo, dhi=dhi,
+                               **common))
         out.append(vqs)
     return out
 
@@ -468,13 +484,14 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
         rowstarts = np.stack([v.rowstarts for v in gvqs])
         nrows = np.stack([v.nrows for v in gvqs])
         lens = np.stack([v.lens for v in gvqs])
+        skips = np.stack([v.skips for v in gvqs])
         weights = np.stack([v.weights for v in gvqs])
         msm = np.array([[v.msm] for v in gvqs], np.float32)
         avg = np.array([[v.avgdl] for v in gvqs], np.float32)
         dlo = np.array([[v.dlo] for v in gvqs], np.int32)
         dhi = np.array([[v.dhi] for v in gvqs], np.int32)
         scores, docs, totals = fused_bm25_topk_tfdl(
-            al.d_docs, al.d_tfdl, rowstarts, nrows, lens, weights,
+            al.d_docs, al.d_tfdl, rowstarts, nrows, lens, skips, weights,
             msm, avg, dlo, dhi, T=T_pad, L=L, K=K, k1=k1, b=b_eff)
         scores = np.asarray(scores)
         docs = np.asarray(docs)
@@ -572,14 +589,15 @@ def _filter_list(seg: Segment, ctx, clauses) -> Optional[FilterList]:
         return fl
     nd = seg.ndocs
     combined = np.ones(nd, bool)
-    for mkey, spec, local, mapping, neg in prepped:
-        mask = np.asarray(C._mask_for_key(mkey, spec, local, mapping, seg))
+    for (node, neg), (mkey, spec, local, mapping, _n) in zip(clauses,
+                                                             prepped):
+        mask = np.asarray(C._mask_for_key(mkey, spec, local, mapping, seg,
+                                          needs=C.node_needs(node)))
         m = mask[:nd].astype(bool)
         combined &= ~m if neg else m
     docs = np.nonzero(combined)[0].astype(np.int32)
     n = len(docs)
-    total = ((n + HBM_ALIGN - 1) // HBM_ALIGN) * HBM_ALIGN + MAX_L
-    total = ((total + LANES - 1) // LANES) * LANES
+    total = ((n + LANES - 1) // LANES) * LANES + MAX_L
     buf = np.full(total, INT_SENTINEL, np.int32)
     buf[:n] = docs
     # keep the dense mask only when this filter could ever take the
@@ -665,7 +683,8 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
              else np.zeros(len(new_docs), np.int64))
     packed = ((tfs.astype(np.int64) << DL_BITS) | dl_of).astype(np.int32)
     a_starts, a_docs, a_packed = align_csr_rows(new_starts, new_docs, packed,
-                                                margin=MAX_L)
+                                                margin=MAX_L,
+                                                alignment=LANES)
     nbytes = a_docs.nbytes + a_packed.nbytes
     al = AlignedPostings((a_starts[:-1] // LANES).astype(np.int64),
                          np.diff(new_starts).astype(np.int64),
@@ -688,14 +707,16 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
     return fp
 
 
-def _dense_hot(seg: Segment, fl: FilterList) -> bool:
-    """Dense + repeated (hits counted AFTER this check, so >=1 here means
-    this is at least the filter's second use). The mask is only retained
-    for dense-capable filters, so its presence gates the route."""
-    return (fl.mask is not None
-            and fl.n > _MATERIALIZE_MIN_DOCS
-            and fl.n * _MATERIALIZE_DENSITY > seg.ndocs
-            and fl.hits >= 1)
+def _dense_hot(seg: Segment, fl: FilterList, nslots: int) -> bool:
+    """Materialize when the filter is dense-capable (mask retained) AND
+    either repeated (hits counted AFTER this check, so >=1 means second
+    use) or too large for the list path at all — falling back to the XLA
+    plan there would cost far more than one pre-intersection."""
+    if fl.mask is None:
+        return False
+    ts = next_pow2(max(nslots, 1), floor=1)
+    list_cap = MAX_CHUNKS * (MAX_TL // (2 * ts))
+    return fl.hits >= 1 or fl.n > list_cap // 2
 
 
 _dummy_hbm_arr = None
@@ -715,8 +736,8 @@ class _BVQuery:
     """One bool-kernel row: a whole query, or one doc-range chunk of it."""
 
     __slots__ = ("qi", "TS", "T", "L", "filtered", "rowstarts", "nrows",
-                 "lens", "weights", "cw", "thresh", "avgdl", "dlo", "dhi",
-                 "field", "k1", "b_eff", "fl", "albuf")
+                 "lens", "skips", "weights", "cw", "thresh", "avgdl", "dlo",
+                 "dhi", "field", "k1", "b_eff", "fl", "albuf")
 
     def __init__(self, **kw):
         for k, v in kw.items():
@@ -742,7 +763,7 @@ def _prepare_bool_vqueries(seg: Segment, ctx, specs: Sequence[FastSpec],
             # hits are the whole filter and need the filter slot
             needs_term = spec.n_required > 0 or spec.fam_msm >= 1
             if (nslots and needs_term and spec.field is not None
-                    and _dense_hot(seg, fl)):
+                    and _dense_hot(seg, fl, nslots)):
                 # dense hot filter: run on filter-specialized postings at
                 # full kernel speed instead of merging a huge doc list
                 fp = _filtered_postings(seg, spec.field, fl)
@@ -789,11 +810,11 @@ def _prepare_bool_vqueries(seg: Segment, ctx, specs: Sequence[FastSpec],
             out.append(None)
             continue
         vqs = []
-        for dlo, dhi, rowstarts, nrows, lens in chunks:
+        for dlo, dhi, rowstarts, nrows, lens, skips in chunks:
             L = int(max(int(nrows.max()), HBM_ALIGN // LANES)) * LANES
             vqs.append(_BVQuery(qi=qi, TS=TS, T=T, L=L, filtered=filtered,
                                 rowstarts=rowstarts, nrows=nrows, lens=lens,
-                                weights=weights, cw=cw,
+                                skips=skips, weights=weights, cw=cw,
                                 thresh=np.float32(thresh), avgdl=avgdl,
                                 dlo=dlo, dhi=dhi, field=spec.field, k1=k1,
                                 b_eff=b_eff, fl=fl if filtered else None,
@@ -826,6 +847,7 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         rowstarts = np.stack([v.rowstarts for v in gvqs])
         nrows = np.stack([v.nrows for v in gvqs])
         lens = np.stack([v.lens for v in gvqs])
+        skips = np.stack([v.skips for v in gvqs])
         weights = np.stack([v.weights for v in gvqs])
         cw = np.stack([v.cw for v in gvqs])
         thresh = np.array([[v.thresh] for v in gvqs], np.float32)
@@ -833,8 +855,8 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         dlo = np.array([[v.dlo] for v in gvqs], np.int32)
         dhi = np.array([[v.dhi] for v in gvqs], np.int32)
         scores, docs, totals = fused_bm25_bool_topk(
-            d_docs, d_tfdl, filt, rowstarts, nrows, lens, weights, cw,
-            thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,
+            d_docs, d_tfdl, filt, rowstarts, nrows, lens, skips, weights,
+            cw, thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,
             filtered=filtered)
         scores = np.asarray(scores)
         docs = np.asarray(docs)

@@ -43,6 +43,48 @@ def highlight_field(text: str, terms: Set[str], analyzer: Analyzer,
     return out
 
 
+def highlight_fvh(text: str, terms: Set[str],
+                  tv_entries: List[tuple],
+                  pre_tag: str = "<em>", post_tag: str = "</em>",
+                  fragment_size: int = 100,
+                  number_of_fragments: int = 5) -> List[str]:
+    """Real FastVectorHighlighter path (reference
+    `search/fetch/subphase/highlight/FastVectorHighlighter`): hit offsets
+    come from the PERSISTED term vectors (term_vector=with_positions_offsets
+    at index time), no re-analysis, and fragments rank by match count
+    (score-ordered like the reference's ScoreOrderFragmentsBuilder)."""
+    exact = {t for t in terms if not t.endswith("*")}
+    prefixes = tuple(t[:-1] for t in terms if t.endswith("*") and len(t) > 1)
+    hits = sorted(
+        (s, e) for term, _pos, s, e in tv_entries
+        if (term in exact or (prefixes and term.startswith(prefixes)))
+        and 0 <= s and e <= len(text))
+    if not hits:
+        return []
+    if number_of_fragments == 0:
+        return [_mark(text, hits, pre_tag, post_tag)]
+    fragments: List[tuple] = []
+    cur: List[tuple] = []
+    for h in hits:
+        if cur and h[1] - cur[0][0] > fragment_size:
+            fragments.append(tuple(cur))
+            cur = []
+        cur.append(h)
+    if cur:
+        fragments.append(tuple(cur))
+    # FVH scores fragments: most matches first (stable on position)
+    fragments.sort(key=lambda fr: -len(fr))
+    out = []
+    for frag_hits in fragments[:number_of_fragments]:
+        s = max(0, frag_hits[0][0]
+                - (fragment_size - (frag_hits[-1][1] - frag_hits[0][0])) // 2)
+        e = min(len(text), s + max(fragment_size,
+                                   frag_hits[-1][1] - frag_hits[0][0]))
+        rel = [(a - s, b - s) for a, b in frag_hits if a >= s and b <= e]
+        out.append(_mark(text[s:e], rel, pre_tag, post_tag))
+    return out
+
+
 def highlight_unified(text: str, terms: Set[str], analyzer: Analyzer,
                       pre_tag: str = "<em>", post_tag: str = "</em>",
                       fragment_size: int = 100,

@@ -1,0 +1,88 @@
+"""Search backpressure: per-task resource tracking + cancellation of the
+worst offender under node duress, and a hard admission gate.
+
+Reference analogs: `search/backpressure/SearchBackpressureService.java:68`
+(tracks task CPU/heap, cancels the most resource-consuming search tasks
+when the node is in duress) and `ratelimitting/admissioncontrol/` (rejects
+new work outright past a hard limit).
+
+TPU-design notes: the scarce resource here is device time — one chip
+serializes kernel launches, so a runaway scan starves neighbors by queue
+depth, not by heap. Tasks therefore account wall-clock device seconds
+(accumulated between segment programs, the same safe points cancellation
+polls) plus the bytes their plans moved to device. Duress = too many
+in-flight search tasks; the service then cancels the cancellable task
+with the highest device time above the minimum threshold. Deterministic:
+callers own the clock (like cluster/failure.py)."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+
+class SearchBackpressureService:
+    def __init__(self,
+                 max_in_flight: int = 32,        # duress threshold
+                 hard_limit: int = 256,          # admission-control reject
+                 cancel_min_device_s: float = 1.0,
+                 cancellation_ratio: float = 0.1):
+        self.max_in_flight = max_in_flight
+        self.hard_limit = hard_limit
+        self.cancel_min_device_s = cancel_min_device_s
+        self.cancellation_ratio = cancellation_ratio
+        self.cancellation_count = 0
+        self.rejection_count = 0
+        self.limit_reached_count = 0
+
+    # -------- admission (reference admissioncontrol) --------
+
+    def admit(self, registry) -> None:
+        from .wlm import PressureRejectedException
+        if self._in_flight(registry) >= self.hard_limit:
+            self.rejection_count += 1
+            raise PressureRejectedException(
+                f"rejecting search: {self.hard_limit} searches already in "
+                f"flight (admission control)")
+
+    # -------- duress monitoring (reference SearchBackpressureService) ----
+
+    def _in_flight(self, registry) -> int:
+        return sum(1 for t in registry.list("indices:data/read/search*"))
+
+    def check(self, registry, now: Optional[float] = None) -> List[int]:
+        """Cancel the worst offenders when the node is in duress; returns
+        the cancelled task ids. Called on search admission and by the
+        stats/monitor tick."""
+        tasks = [t for t in registry.all()
+                 if t.action.startswith("indices:data/read/search")
+                 and not t.cancelled and t.cancellable]
+        if len(tasks) <= self.max_in_flight:
+            return []
+        self.limit_reached_count += 1
+        # victims: highest device time first, above the floor; cancel at
+        # most ceil(ratio * in-flight) per pass so bursts drain gradually
+        victims = sorted(
+            (t for t in tasks if t.device_seconds >= self.cancel_min_device_s),
+            key=lambda t: t.device_seconds, reverse=True)
+        budget = max(1, int(len(tasks) * self.cancellation_ratio))
+        out: List[int] = []
+        for t in victims[:budget]:
+            t.cancel("cancelled by search backpressure (resource tracking: "
+                     f"{t.device_seconds:.2f}s device time)")
+            self.cancellation_count += 1
+            out.append(t.id)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "mode": "enforced",
+            "search_task": {
+                "cancellation_count": self.cancellation_count,
+                "limit_reached_count": self.limit_reached_count,
+                "rejection_count": self.rejection_count,
+                "cancel_min_device_seconds": self.cancel_min_device_s,
+                "max_in_flight": self.max_in_flight,
+                "hard_limit": self.hard_limit,
+            },
+        }

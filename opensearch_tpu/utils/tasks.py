@@ -28,6 +28,14 @@ class Task:
         self.start_time = time.time()
         self.cancelled = False
         self.cancel_reason: Optional[str] = None
+        # resource tracking (utils/backpressure.py; reference
+        # TaskResourceTrackingService): accumulated at segment boundaries
+        self.device_seconds = 0.0
+        self.mem_bytes = 0
+
+    def track(self, device_seconds: float = 0.0, mem_bytes: int = 0) -> None:
+        self.device_seconds += device_seconds
+        self.mem_bytes += mem_bytes
 
     def cancel(self, reason: str = "by user request") -> None:
         if self.cancellable:
@@ -46,7 +54,10 @@ class Task:
                 "cancelled": self.cancelled,
                 "start_time_in_millis": int(self.start_time * 1000),
                 "running_time_in_nanos":
-                    int((time.time() - self.start_time) * 1e9)}
+                    int((time.time() - self.start_time) * 1e9),
+                "resource_stats": {"device_time_seconds":
+                                   round(self.device_seconds, 6),
+                                   "memory_in_bytes": self.mem_bytes}}
 
 
 class TaskRegistry:
@@ -85,6 +96,10 @@ class TaskRegistry:
             import fnmatch
             out = [t for t in out if fnmatch.fnmatch(t["action"], actions)]
         return out
+
+    def all(self) -> List[Task]:
+        with self._lock:
+            return list(self._tasks.values())
 
     def stats(self) -> dict:
         return {"running": len(self._tasks), "completed": self.completed}

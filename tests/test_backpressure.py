@@ -1,0 +1,109 @@
+"""Search backpressure: per-task device-time tracking, duress cancellation
+of the worst offender, hard admission gate, stats surface (reference
+search/backpressure/SearchBackpressureService.java +
+ratelimitting/admissioncontrol/)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.utils.backpressure import SearchBackpressureService
+from opensearch_tpu.utils.tasks import (TaskCancelledException, TaskRegistry)
+from opensearch_tpu.utils.wlm import PressureRejectedException
+
+
+class TestVictimSelection:
+    def test_runaway_cancelled_neighbors_survive(self):
+        reg = TaskRegistry()
+        svc = SearchBackpressureService(max_in_flight=3,
+                                        cancel_min_device_s=0.5)
+        tasks = [reg.register("indices:data/read/search", f"q{i}")
+                 for i in range(5)]
+        for t in tasks[:4]:
+            t.track(device_seconds=0.6)
+        tasks[4].track(device_seconds=9.0)     # the runaway
+        cancelled = svc.check(reg)
+        assert cancelled == [tasks[4].id]
+        assert tasks[4].cancelled
+        assert not any(t.cancelled for t in tasks[:4])
+        with pytest.raises(TaskCancelledException):
+            tasks[4].ensure_not_cancelled()
+
+    def test_under_limit_no_cancellation(self):
+        reg = TaskRegistry()
+        svc = SearchBackpressureService(max_in_flight=8)
+        ts = [reg.register("indices:data/read/search", f"q{i}")
+              for i in range(4)]
+        for t in ts:
+            t.track(device_seconds=100.0)
+        assert svc.check(reg) == []
+
+    def test_floor_protects_young_tasks(self):
+        reg = TaskRegistry()
+        svc = SearchBackpressureService(max_in_flight=1,
+                                        cancel_min_device_s=5.0)
+        ts = [reg.register("indices:data/read/search", f"q{i}")
+              for i in range(3)]
+        for t in ts:
+            t.track(device_seconds=1.0)       # all below the floor
+        assert svc.check(reg) == []
+        assert svc.limit_reached_count == 1
+
+    def test_cancellation_ratio_bounds_burst(self):
+        reg = TaskRegistry()
+        svc = SearchBackpressureService(max_in_flight=2,
+                                        cancel_min_device_s=0.1,
+                                        cancellation_ratio=0.25)
+        ts = [reg.register("indices:data/read/search", f"q{i}")
+              for i in range(8)]
+        for i, t in enumerate(ts):
+            t.track(device_seconds=1.0 + i)
+        cancelled = svc.check(reg)
+        assert len(cancelled) == 2             # ceil-ish of 8 * 0.25
+        assert cancelled == [ts[7].id, ts[6].id]
+
+
+class TestAdmission:
+    def test_hard_limit_rejects(self):
+        reg = TaskRegistry()
+        svc = SearchBackpressureService(hard_limit=2)
+        reg.register("indices:data/read/search", "a")
+        reg.register("indices:data/read/search", "b")
+        with pytest.raises(PressureRejectedException):
+            svc.admit(reg)
+        assert svc.rejection_count == 1
+
+    def test_non_search_tasks_ignored(self):
+        reg = TaskRegistry()
+        svc = SearchBackpressureService(hard_limit=1, max_in_flight=1)
+        reg.register("indices:data/write/bulk", "w")
+        reg.register("cluster:monitor", "m")
+        svc.admit(reg)                         # no search tasks in flight
+        assert svc.check(reg) == []
+
+
+class TestIntegration:
+    def test_search_tracks_device_time_and_stats(self):
+        c = RestClient()
+        c.indices.create("bp")
+        for i in range(50):
+            c.index("bp", {"t": f"word{i % 7} filler"}, id=str(i))
+        c.indices.refresh("bp")
+        c.search("bp", {"query": {"match": {"t": "word3"}}})
+        stats = c.nodes_stats()
+        node_stats = next(iter(stats["nodes"].values()))
+        bp = node_stats["search_backpressure"]["search_task"]
+        assert bp["cancellation_count"] == 0
+        assert "max_in_flight" in bp
+
+    def test_admission_rejects_with_429(self):
+        c = RestClient()
+        c.indices.create("bp2")
+        c.index("bp2", {"t": "x"}, id="1")
+        c.indices.refresh("bp2")
+        c.node.search_backpressure.hard_limit = 0
+        try:
+            with pytest.raises(ApiError) as e:
+                c.search("bp2", {"query": {"match_all": {}}})
+            assert e.value.status == 429
+        finally:
+            c.node.search_backpressure.hard_limit = 256

@@ -134,9 +134,8 @@ class TestDataStreamRollover:
         client.index("logs-app", {"@timestamp": "2025-01-03", "msg": "x"},
                      id="w", op_type="create")
         client.indices.refresh("logs-app")
-        got = client.search("logs-app", {"query": {"term": {"_id": "w"}}}) \
-            if False else client.search("logs-app", {"query": {"ids": {
-                "values": ["w"]}}})
+        got = client.search("logs-app", {"query": {"ids": {
+            "values": ["w"]}}})
         assert got["hits"]["hits"][0]["_index"] == ".ds-logs-app-000002"
 
     def test_conditional_rollover(self, client):

@@ -53,7 +53,7 @@ class TestAlignedLayout:
             r = pb.row(term)
             a, b = pb.row_slice(r)
             start = int(al.starts_rows[r]) * LANES
-            assert start % HBM_ALIGN == 0
+            assert start % LANES == 0
             n = b - a
             assert int(al.lens[r]) == n
             np.testing.assert_array_equal(docs[start: start + n],
@@ -104,12 +104,12 @@ class TestChunkDecomposition:
         # every chunk's DMA start is tile-aligned and the postings of each
         # term are fully covered across chunks
         covered = {i: 0 for i in range(len(rows))}
-        for lo, hi, rowstarts, nrows, lens in chunks:
+        for lo, hi, rowstarts, nrows, lens, skips in chunks:
             for i, r in enumerate(rows):
                 if lens[i] == 0:
                     continue
                 assert (rowstarts[i] * LANES) % HBM_ALIGN == 0
-                assert nrows[i] * LANES >= lens[i]
+                assert nrows[i] * LANES >= lens[i] + skips[i]
                 a, b = pb.row_slice(r)
                 d = pb.doc_ids[a:b]
                 covered[i] += int(np.sum((d >= lo) & (d < hi)))

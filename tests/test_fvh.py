@@ -1,0 +1,63 @@
+"""True FVH: persisted term-vector offsets (term_vector=with_positions_offsets)."""
+import tempfile
+import pytest
+from opensearch_tpu.rest.client import RestClient
+
+
+@pytest.fixture()
+def client():
+    c = RestClient()
+    c.indices.create("h", {"mappings": {"properties": {
+        "body": {"type": "text", "term_vector": "with_positions_offsets"},
+        "plain": {"type": "text"}}}})
+    c.index("h", {"body": "The Quick brown fox JUMPS over the lazy dog",
+                  "plain": "quick stuff"}, id="a")
+    c.index("h", {"body": ["first value with fox", "second value has fox too"]}, id="m")
+    c.indices.refresh("h")
+    return c
+
+
+def test_fvh_uses_stored_offsets(client):
+    seg = client.node.indices["h"].shards[0].segments[0]
+    assert seg.term_vectors and "body" in seg.term_vectors
+    r = client.search("h", {"query": {"match": {"body": "fox jumps"}},
+                            "highlight": {"fields": {"body": {"type": "fvh"}},
+                                          "number_of_fragments": 0}})
+    hit = next(h for h in r["hits"]["hits"] if h["_id"] == "a")
+    frag = hit["highlight"]["body"][0]
+    assert "<em>fox</em>" in frag and "<em>JUMPS</em>" in frag
+
+
+def test_fvh_multivalue_validates(client):
+    r = client.search("h", {"query": {"match": {"body": "fox"}},
+                            "highlight": {"fields": {"body": {"type": "fvh"}}}})
+    hit = next(h for h in r["hits"]["hits"] if h["_id"] == "m")
+    joined = " ".join(hit["highlight"]["body"])
+    assert joined.count("<em>fox</em>") >= 2
+
+
+def test_fvh_without_vectors_degrades(client):
+    r = client.search("h", {"query": {"match": {"plain": "quick"}},
+                            "highlight": {"fields": {"plain": {"type": "fvh"}}}})
+    hit = next(h for h in r["hits"]["hits"] if h["_id"] == "a")
+    assert "<em>quick</em>" in hit["highlight"]["plain"][0]
+
+
+def test_vectors_survive_flush_and_merge(client):
+    path = tempfile.mkdtemp()
+    c = RestClient(data_path=path)
+    c.indices.create("h2", {"mappings": {"properties": {
+        "t": {"type": "text", "term_vector": "with_positions_offsets"}}}})
+    c.index("h2", {"t": "alpha beta"}, id="1")
+    c.indices.refresh("h2")
+    c.index("h2", {"t": "gamma alpha"}, id="2")
+    c.indices.refresh("h2")
+    c.indices.forcemerge("h2")     # merge carries vectors
+    c.indices.flush("h2")
+    c2 = RestClient(data_path=path)
+    r = c2.search("h2", {"query": {"match": {"t": "alpha"}},
+                         "highlight": {"fields": {"t": {"type": "fvh"}}}})
+    assert all("<em>alpha</em>" in h["highlight"]["t"][0]
+               for h in r["hits"]["hits"])
+    seg = c2.node.indices["h2"].shards[0].segments[0]
+    assert seg.term_vectors["t"][0]

@@ -1,0 +1,78 @@
+"""_geo_distance sort and nested sort options (reference
+GeoDistanceSortBuilder.java / NestedSortBuilder.java)."""
+
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+
+
+@pytest.fixture()
+def client():
+    c = RestClient()
+    c.indices.create("g", {"mappings": {"properties": {
+        "name": {"type": "keyword"}, "pin": {"type": "geo_point"},
+        "offers": {"type": "nested", "properties": {
+            "price": {"type": "double"}}}}}})
+    # distances from Berlin (52.52, 13.405): Potsdam ~26km, Leipzig ~149km,
+    # Hamburg ~255km
+    c.index("g", {"name": "potsdam", "pin": {"lat": 52.39, "lon": 13.06},
+                  "offers": [{"price": 30.0}, {"price": 12.0}]}, id="p")
+    c.index("g", {"name": "leipzig", "pin": {"lat": 51.34, "lon": 12.37},
+                  "offers": [{"price": 5.0}, {"price": 50.0}]}, id="l")
+    c.index("g", {"name": "hamburg", "pin": {"lat": 53.55, "lon": 9.99},
+                  "offers": [{"price": 20.0}]}, id="h")
+    c.index("g", {"name": "nowhere"}, id="n")    # no pin, no offers
+    c.indices.refresh("g")
+    return c
+
+
+def _order(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+class TestGeoDistanceSort:
+    def test_asc_from_berlin(self, client):
+        r = client.search("g", {"sort": [{"_geo_distance": {
+            "pin": {"lat": 52.52, "lon": 13.405}, "order": "asc",
+            "unit": "km"}}]})
+        assert _order(r) == ["p", "l", "h", "n"]    # missing last
+        d_km = r["hits"]["hits"][0]["sort"][0]
+        assert 20 < d_km < 35                        # Potsdam ~26km
+        assert r["hits"]["hits"][3]["sort"][0] is None
+
+    def test_desc(self, client):
+        r = client.search("g", {"sort": [{"_geo_distance": {
+            "pin": [13.405, 52.52], "order": "desc", "unit": "m"}}]})
+        assert _order(r)[:3] == ["h", "l", "p"]
+        assert r["hits"]["hits"][0]["sort"][0] > 200_000
+
+    def test_secondary_key(self, client):
+        r = client.search("g", {"sort": [
+            {"_geo_distance": {"pin": {"lat": 52.52, "lon": 13.405},
+                               "order": "asc"}},
+            {"name": "asc"}]})
+        assert _order(r)[0] == "p"
+
+
+class TestNestedSort:
+    def test_min_mode_asc(self, client):
+        r = client.search("g", {"sort": [{"offers.price": {
+            "order": "asc", "nested": {"path": "offers"}}}]})
+        # min prices: l=5, p=12, h=20; n missing -> last
+        assert _order(r) == ["l", "p", "h", "n"]
+        assert r["hits"]["hits"][0]["sort"][0] == 5.0
+
+    def test_max_mode_desc(self, client):
+        r = client.search("g", {"sort": [{"offers.price": {
+            "order": "desc", "mode": "max",
+            "nested": {"path": "offers"}}}]})
+        # max prices: l=50, p=30, h=20
+        assert _order(r) == ["l", "p", "h", "n"]
+
+    def test_avg_mode(self, client):
+        r = client.search("g", {"sort": [{"offers.price": {
+            "order": "asc", "mode": "avg",
+            "nested": {"path": "offers"}}}]})
+        # avgs: h=20, p=21, l=27.5
+        assert _order(r) == ["h", "p", "l", "n"]
+        assert abs(r["hits"]["hits"][1]["sort"][0] - 21.0) < 1e-6
