@@ -295,12 +295,18 @@ class Node:
                             "get_total": 0, "index_total": 0,
                             "index_time_ms": 0.0}
         # SPMD mesh dispatch (parallel/service.py): pass a MeshSearchService
-        # or set OPENSEARCH_TPU_MESH=1 to auto-build one over jax.devices();
-        # eligible searches then run the distributed program with host-loop
-        # fallback
-        if mesh_service is None and os.environ.get("OPENSEARCH_TPU_MESH"):
-            from ..parallel.service import MeshSearchService
-            mesh_service = MeshSearchService()
+        # the SPMD mesh path is ON BY DEFAULT whenever more than one device
+        # is visible (a pod slice, or the virtual 8-CPU-device test mesh);
+        # OPENSEARCH_TPU_MESH=0 disables it, =1 forces it even single-chip.
+        # Eligible searches run the distributed program; everything else
+        # falls back to the host shard loop with identical results
+        if mesh_service is None:
+            flag = os.environ.get("OPENSEARCH_TPU_MESH")
+            enable = (flag not in (None, "", "0") if flag is not None
+                      else self._device_count() > 1)
+            if enable:
+                from ..parallel.service import MeshSearchService
+                mesh_service = MeshSearchService()
         self.mesh_service = mesh_service
         # cross-cluster search (reference RemoteClusterService): registered
         # peer Nodes searchable via "alias:index" expressions. Peers are
@@ -319,6 +325,14 @@ class Node:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
             self._recover_data_streams()
+
+    @staticmethod
+    def _device_count() -> int:
+        import jax
+        try:
+            return len(jax.devices())
+        except RuntimeError:
+            return 1
 
     # ---------------- index lifecycle ----------------
 
@@ -696,7 +710,17 @@ class Node:
                                   index=expression,
                                   shards=len(searchers)):
                 resp = None
-                if (self.mesh_service is not None and len(names) == 1
+                if (len(names) == 1 and not remote_parts
+                        and phase_hook is None
+                        and self.indices[names[0]].mappings.star_trees):
+                    # star-tree composite index: eligible size=0 agg
+                    # requests answer from the pre-aggregated cubes
+                    from ..search import startree
+                    resp = startree.try_answer(
+                        searchers, body,
+                        self.indices[names[0]].mappings.star_trees)
+                if (resp is None and self.mesh_service is not None
+                        and len(names) == 1
                         and not remote_parts and phase_hook is None):
                     resp = self.mesh_service.try_search(names[0],
                                                         self.indices[names[0]],

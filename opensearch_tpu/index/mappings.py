@@ -232,6 +232,7 @@ class Mappings:
         self.dynamic = dynamic
         self.dynamic_templates: List[dict] = []
         self.derived: Dict[str, Any] = {}   # name -> DerivedField
+        self.star_trees: List[Any] = []     # StarTreeConfig (search/startree)
         self._meta: dict = {}
         # reference SourceFieldMapper: `"_source": {"enabled": false}` stops
         # persisting _source in segments (store=true fields remain fetchable
@@ -270,6 +271,12 @@ class Mappings:
                 if ftype == "nested":
                     self.nested_paths.add(path)
                 self._merge_props(cfg.get("properties", {}), prefix=f"{path}.")
+                continue
+            if ftype == "star_tree":
+                # composite pre-agg cube config (search/startree.py;
+                # reference StarTreeMapper) — config-only, no doc values
+                from ..search.startree import parse_config
+                self.star_trees.append(parse_config(path, cfg))
                 continue
             self.fields[path] = self._build_field(path, ftype, cfg)
             if ftype == "join":

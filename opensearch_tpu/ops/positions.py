@@ -30,7 +30,7 @@ per anchor.
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -63,18 +63,22 @@ def pair_searchsorted(dA: jnp.ndarray, pA: jnp.ndarray,
 
 
 def nearest_delta(dA: jnp.ndarray, pA: jnp.ndarray,
-                  d0: jnp.ndarray, base: jnp.ndarray):
+                  d0: jnp.ndarray, base: jnp.ndarray, shift=0):
     """Signed displacement (adjusted position - base) of the term occurrence
-    nearest to the anchor within the anchor's doc, and a found flag."""
+    nearest to the anchor within the anchor's doc, and a found flag.
+    `shift` is the query-position offset of this term: the pair arrays stay
+    RAW (device-resident per segment term), adjusted position = pA - shift —
+    shipping pre-shifted copies per query would re-upload megabytes of
+    positions on every search."""
     n = dA.shape[0]
-    idx = pair_searchsorted(dA, pA, d0, base)
+    idx = pair_searchsorted(dA, pA, d0, base + shift)
     ridx = jnp.minimum(idx, n - 1)
     right_ok = (idx < n) & (dA[ridx] == d0)
-    right_delta = (pA[ridx] - base).astype(jnp.float32)
+    right_delta = (pA[ridx] - shift - base).astype(jnp.float32)
     right_cost = jnp.where(right_ok, right_delta, BIG_COST)
     lidx = jnp.maximum(idx - 1, 0)
     left_ok = (idx > 0) & (dA[lidx] == d0)
-    left_delta = (pA[lidx] - base).astype(jnp.float32)
+    left_delta = (pA[lidx] - shift - base).astype(jnp.float32)
     left_cost = jnp.where(left_ok, -left_delta, BIG_COST)
     delta = jnp.where(right_cost <= left_cost, right_delta, left_delta)
     return delta, right_ok | left_ok
@@ -83,7 +87,8 @@ def nearest_delta(dA: jnp.ndarray, pA: jnp.ndarray,
 def phrase_freqs(anchor_d: jnp.ndarray, anchor_p: jnp.ndarray,
                  others: List[Tuple[jnp.ndarray, jnp.ndarray]],
                  slop: jnp.ndarray, ndocs_pad: int,
-                 ordered: bool = False, gap_cost: bool = False) -> jnp.ndarray:
+                 ordered: bool = False, gap_cost: bool = False,
+                 shifts: Optional[List] = None) -> jnp.ndarray:
     """Dense per-doc sloppy phrase frequency f32[ndocs_pad].
 
     anchor_d/anchor_p: term 0's (doc, adjusted position) pairs (sentinel
@@ -107,20 +112,22 @@ def phrase_freqs(anchor_d: jnp.ndarray, anchor_p: jnp.ndarray,
     callers are span-family queries)."""
     ok = anchor_d != INT32_SENTINEL
     m = len(others) + 1
+    if shifts is None:
+        shifts = [0] * len(others)
     if ordered:
         prev = jnp.zeros(anchor_p.shape, jnp.int32)  # delta_0 = 0
-        for dA, pA in others:
+        for (dA, pA), sh in zip(others, shifts):
             n = dA.shape[0]
-            idx = pair_searchsorted(dA, pA, anchor_d, anchor_p + prev)
+            idx = pair_searchsorted(dA, pA, anchor_d, anchor_p + prev + sh)
             safe = jnp.minimum(idx, n - 1)
             found = (idx < n) & (dA[safe] == anchor_d)
-            prev = pA[safe] - anchor_p
+            prev = pA[safe] - sh - anchor_p
             ok = ok & found
         cost = prev.astype(jnp.float32)  # = pos_last - pos_0 + 1 - m = gaps
     elif m > 1:
         deltas = [jnp.zeros(anchor_d.shape, jnp.float32)]
-        for dA, pA in others:
-            di, found = nearest_delta(dA, pA, anchor_d, anchor_p)
+        for (dA, pA), sh in zip(others, shifts):
+            di, found = nearest_delta(dA, pA, anchor_d, anchor_p, sh)
             ok = ok & found
             deltas.append(di)
         if gap_cost:

@@ -1,0 +1,117 @@
+"""Multi-host design: jax.distributed process groups under the existing
+coordination state machine.
+
+Reference analogs: `discovery/` + `transport-netty4` (node-to-node wire) and
+`cluster/coordination/Coordinator.java` (membership). The TPU translation:
+
+- **Wire layer**: there is none to write. `jax.distributed.initialize(
+  coordinator_address, num_processes, process_id)` brings up the XLA
+  runtime's cross-host world; collectives (psum/all_gather in
+  `parallel/spmd.py`) then ride ICI within a slice and DCN across slices —
+  the NCCL/MPI substitute is the compiler, not sockets.
+- **Mesh**: `jax.devices()` after initialize returns ALL hosts' devices.
+  `make_global_mesh` lays the (replica, shard) axes over them with shard
+  axes packed host-local first, so a shard's per-segment scoring never
+  crosses DCN and only the final all_gather top-k merge does.
+- **Membership**: `cluster/coordination.py`'s election/publish state
+  machine runs unchanged with one peer per process; its transport hooks
+  (`send_publish`, `send_ack`) map onto host-to-host RPC which, in the
+  jax.distributed world, is the coordinator service the runtime already
+  maintains. Each process's Node owns the PRIMARY shards whose mesh slot
+  lands on its local devices (shard_owner below).
+
+Single-process environments cannot exercise initialize() itself; what IS
+tested (tests/test_multihost.py) is the pure planning layer: config
+validation, global device-count math, host-local shard packing, and
+shard-ownership assignment — the parts a real two-host bringup consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class MultiHostConfig:
+    coordinator_address: str          # "host0:port" (reference discovery seed)
+    num_processes: int
+    process_id: int
+    local_device_count: int = 8       # chips per host (v5e host = 8)
+
+    def validate(self) -> None:
+        if self.num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not 0 <= self.process_id < self.num_processes:
+            raise ValueError(
+                f"process_id [{self.process_id}] out of range "
+                f"[0, {self.num_processes})")
+        if ":" not in self.coordinator_address:
+            raise ValueError(
+                "coordinator_address must be host:port "
+                f"(got [{self.coordinator_address}])")
+        if self.local_device_count < 1:
+            raise ValueError("local_device_count must be >= 1")
+
+    @property
+    def global_device_count(self) -> int:
+        return self.num_processes * self.local_device_count
+
+
+def initialize(cfg: MultiHostConfig) -> None:
+    """Bring up the cross-host XLA world. Call ONCE per process before any
+    jax operation (reference: node bootstrap + discovery join)."""
+    import jax
+
+    cfg.validate()
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id)
+
+
+def shard_layout(cfg: MultiHostConfig, n_shards: int
+                 ) -> List[Tuple[int, int]]:
+    """Shard slot -> (process, local_device). Shards pack host-local first
+    so one shard's segments (and its scoring collectives) stay on one
+    host's ICI; only the coordinator's top-k all_gather crosses DCN."""
+    cfg.validate()
+    if n_shards > cfg.global_device_count:
+        raise ValueError(
+            f"{n_shards} shards need more than the "
+            f"{cfg.global_device_count} global devices")
+    out = []
+    for s in range(n_shards):
+        proc = s // cfg.local_device_count
+        local = s % cfg.local_device_count
+        out.append((proc, local))
+    return out
+
+
+def shard_owner(cfg: MultiHostConfig, n_shards: int) -> List[int]:
+    """Primary ownership per shard: the process whose local device hosts
+    it (the analog of reference allocation deciders pinning primaries)."""
+    return [p for p, _ in shard_layout(cfg, n_shards)]
+
+
+def local_shards(cfg: MultiHostConfig, n_shards: int) -> List[int]:
+    """The shard ids THIS process indexes/serves."""
+    return [s for s, (p, _) in enumerate(shard_layout(cfg, n_shards))
+            if p == cfg.process_id]
+
+
+def make_global_mesh(cfg: MultiHostConfig, n_shards: int,
+                     devices: Optional[list] = None):
+    """(replica=1, shard=n_shards) mesh over the global device list in
+    shard_layout order. `devices` defaults to jax.devices() (which is
+    already globally ordered after initialize); tests pass the virtual
+    CPU devices."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < n_shards:
+        raise ValueError("not enough devices for the shard mesh")
+    picked = np.array(devs[:n_shards]).reshape(1, n_shards)
+    return Mesh(picked, axis_names=("replica", "shard"))

@@ -89,6 +89,12 @@ class MeshSearchService:
 
         t0 = time.monotonic()
         searchers = svc.searchers
+        # the mesh program earns its keep on SHARDED indices (per-shard
+        # SPMD scoring + device DFS/merge); a single-shard index would pay
+        # compile + dispatch overhead for zero parallelism
+        if svc.meta.num_shards < 2:
+            self.fallbacks += 1
+            return None
         # mesh-ready layout: every shard exactly one segment (steady state
         # after refresh+merge; reference analog: one Lucene reader per shard)
         segments = []

@@ -1702,6 +1702,7 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         m_terms = len(node.terms)
         last = m_terms - 1
         arrays = []
+        term_rows = []
         for i, t in enumerate(node.terms):
             if node.prefix_last and i == last:
                 rows = list(_prefix_rows(pb, t, node.max_expansions))
@@ -1711,15 +1712,31 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
             if not rows:
                 return ("match_none", nid)  # phrase needs every term
             arrays.append(_phrase_pairs(seg, pb, tuple(rows)))
+            term_rows.append(tuple(rows))
         buckets = []
+        # pair arrays are RAW and DEVICE-RESIDENT per (segment, term,
+        # bucket): the query position rides as a scalar shift, so repeated
+        # phrase queries never re-upload megabytes of positions (the
+        # positional analog of the resident CSR postings)
+        dev_cache = seg.__dict__.setdefault("_phrase_dev_cache", {})
         for i, (d, p) in enumerate(arrays):
             # coarse pow4 buckets: pair-array pads land on 1 of ~6 sizes so
             # phrase programs compile once per coarse shape, not per df
             bucket = next_pow2(max(len(d), 1), floor=64)
             if bucket.bit_length() % 2 == 0:   # odd exponent -> round up
                 bucket <<= 1
-            _p(params, f"q{nid}_d{i}", _pad_to_sentinel(d, bucket))
-            _p(params, f"q{nid}_p{i}", _pad_to_sentinel(p - i, bucket))
+            ck = (node.field, term_rows[i], bucket)
+            dev = dev_cache.get(ck)
+            if dev is None:
+                import jax
+                dev = (jax.device_put(_pad_to_sentinel(d, bucket)),
+                       jax.device_put(_pad_to_sentinel(p, bucket)))
+                while len(dev_cache) >= 1024:
+                    dev_cache.pop(next(iter(dev_cache)))
+                dev_cache[ck] = dev
+            _p(params, f"q{nid}_d{i}", dev[0])
+            _p(params, f"q{nid}_p{i}", dev[1])
+            _scalar_i32(params, f"q{nid}_shift{i}", i)
             buckets.append(bucket)
         sim = node.sim
         b_eff = sim.b if node.has_norms else 0.0
@@ -2486,9 +2503,11 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         anchor_p = params[f"q{nid}_p0"]
         others = [(params[f"q{nid}_d{i}"], params[f"q{nid}_p{i}"])
                   for i in range(1, m_terms)]
+        shifts = [params[f"q{nid}_shift{i}"] for i in range(1, m_terms)]
         freq = pos_ops.phrase_freqs(anchor_d, anchor_p, others,
                                     params[f"q{nid}_slop"], ndocs_pad,
-                                    ordered=ordered, gap_cost=gap_cost)
+                                    ordered=ordered, gap_cost=gap_cost,
+                                    shifts=shifts)
         scores, matched = pos_ops.phrase_score(freq, dl, live, params[f"q{nid}_w"],
                                                k1, b, params[f"q{nid}_avgdl"])
         return ops.ScoredMask(scores, matched.astype(jnp.float32))

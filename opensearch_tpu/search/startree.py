@@ -1,0 +1,421 @@
+"""Star-tree composite index: a pre-aggregated metric cube that answers
+eligible aggregation requests in O(cube) instead of O(ndocs).
+
+Reference analogs: `index/compositeindex/` + `index/mapper/StarTreeMapper.java`
+(the reference builds a star-tree of aggregated doc-value nodes at flush).
+The TPU re-design is a DENSE CUBE instead of a tree: for configured
+dimensions (keyword ordinals, optionally a date dimension at a fixed
+calendar interval) and metrics (sum/value_count/min/max, avg = sum+count),
+each segment lazily materializes `cube[metric, cell]` where `cell` ravels
+the dimension ordinals. A dense array in HBM is the natural TPU shape — a
+terms or date_histogram aggregation over a dimension becomes a reduction
+over the other axes, and a term filter on a dimension becomes a slice.
+
+Serving contract (`try_answer`): size=0 requests whose query is match_all
+(or a single term on a dimension) and whose agg tree is terms/
+date_histogram over dimensions with metric leaf sub-aggs on configured
+metrics. Anything else returns None and runs the live path; results are
+identical either way (asserted in tests/test_startree.py). Cubes live on
+the immutable segment, so invalidation is segment GC like every other
+derived structure."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAX_CELLS = 1 << 20      # refuse cubes larger than ~1M cells per segment
+METRIC_STATS = ("sum", "value_count", "min", "max", "avg")
+
+
+class StarTreeConfig:
+    __slots__ = ("name", "dims", "date_dim", "interval_ms", "metrics")
+
+    def __init__(self, name: str, dims: List[str],
+                 date_dim: Optional[str], interval_ms: Optional[int],
+                 metrics: List[str]):
+        self.name = name
+        self.dims = dims              # keyword dimension fields, in order
+        self.date_dim = date_dim      # optional date dimension field
+        self.interval_ms = interval_ms
+        self.metrics = metrics        # numeric metric fields
+
+
+def parse_config(name: str, cfg: dict) -> StarTreeConfig:
+    spec = cfg.get("config", cfg)
+    dims: List[str] = []
+    date_dim = None
+    interval_ms = None
+    for d in spec.get("ordered_dimensions", spec.get("dimensions", [])):
+        if isinstance(d, str):
+            dims.append(d)
+            continue
+        dname = d.get("name", d.get("field"))
+        if d.get("type") == "date" or "calendar_intervals" in d \
+                or "interval" in d:
+            date_dim = dname
+            interval_ms = _interval_ms(d.get("interval",
+                                             (d.get("calendar_intervals")
+                                              or ["day"])[0]))
+        else:
+            dims.append(dname)
+    metrics = []
+    for m in spec.get("metrics", []):
+        metrics.append(m if isinstance(m, str)
+                       else m.get("name", m.get("field")))
+    if not (dims or date_dim) or not metrics:
+        raise ValueError(
+            f"star_tree field [{name}] needs dimensions and metrics")
+    return StarTreeConfig(name, dims, date_dim, interval_ms, metrics)
+
+
+_CAL_MS = {"minute": 60_000, "1m": 60_000, "hour": 3_600_000,
+           "1h": 3_600_000, "day": 86_400_000, "1d": 86_400_000,
+           "week": 7 * 86_400_000, "1w": 7 * 86_400_000}
+
+
+def _interval_ms(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v)
+    if s in _CAL_MS:
+        return _CAL_MS[s]
+    raise ValueError(f"unsupported star_tree date interval [{v}]")
+
+
+class SegmentCube:
+    """Per-segment dense cube: axes = dims (+ date buckets last)."""
+
+    __slots__ = ("axes", "vocabs", "date_min", "counts", "sums", "mins",
+                 "maxs", "present")
+
+    def __init__(self, axes, vocabs, date_min, counts, sums, mins, maxs,
+                 present):
+        self.axes = axes          # per-axis size
+        self.vocabs = vocabs      # per dim axis: list of values (or None=date)
+        self.date_min = date_min  # first date bucket id (date axis)
+        self.counts = counts      # {metric: f64[cells]} value_count
+        self.sums = sums
+        self.mins = mins
+        self.maxs = maxs
+        self.present = present    # f64[cells] docs per cell (all-docs count)
+
+
+def get_cube(seg, cfg: StarTreeConfig) -> Optional[SegmentCube]:
+    cache = seg.__dict__.setdefault("_startree_cubes", {})
+    if cfg.name in cache:
+        return cache[cfg.name]
+    cube = _build_cube(seg, cfg)
+    cache[cfg.name] = cube
+    return cube
+
+
+def _build_cube(seg, cfg: StarTreeConfig) -> Optional[SegmentCube]:
+    n = seg.ndocs
+    live = seg.live.astype(bool)
+    axis_ords: List[np.ndarray] = []
+    axes: List[int] = []
+    vocabs: List[Optional[list]] = []
+    for d in cfg.dims:
+        col = seg.keyword_cols.get(d)
+        if col is None:
+            return None
+        # multi-valued docs are not cube-able (reference star-tree has the
+        # same single-value restriction)
+        counts = np.diff(col.starts)
+        if counts.max(initial=0) > 1:
+            return None
+        card = len(col.vocab) + 1          # last slot = missing
+        axis_ords.append(np.where(col.min_ord >= 0, col.min_ord,
+                                  card - 1).astype(np.int64))
+        axes.append(card)
+        vocabs.append(list(col.vocab))
+    date_min = 0
+    if cfg.date_dim is not None:
+        col = seg.numeric_cols.get(cfg.date_dim)
+        if col is None or not col.present.all():
+            return None
+        b = np.floor_divide(col.values.astype(np.int64), cfg.interval_ms)
+        date_min = int(b.min()) if n else 0
+        card = int(b.max() - date_min + 1) if n else 1
+        axis_ords.append((b - date_min).astype(np.int64))
+        axes.append(card)
+        vocabs.append(None)
+    cells = int(np.prod(axes)) if axes else 1
+    if cells > MAX_CELLS:
+        return None
+    flat = np.zeros(n, np.int64)
+    for ords, card in zip(axis_ords, axes):
+        flat = flat * card + ords
+    flat = flat[live]
+    present = np.zeros(cells, np.float64)
+    np.add.at(present, flat, 1.0)
+    counts: Dict[str, np.ndarray] = {}
+    sums: Dict[str, np.ndarray] = {}
+    mins: Dict[str, np.ndarray] = {}
+    maxs: Dict[str, np.ndarray] = {}
+    for m in cfg.metrics:
+        col = seg.numeric_cols.get(m)
+        if col is None:
+            return None
+        vals = col.values.astype(np.float64)[live]
+        pres = col.present[live]
+        f = flat[pres]
+        v = vals[pres]
+        c = np.zeros(cells, np.float64)
+        s = np.zeros(cells, np.float64)
+        mn = np.full(cells, np.inf)
+        mx = np.full(cells, -np.inf)
+        np.add.at(c, f, 1.0)
+        np.add.at(s, f, v)
+        np.minimum.at(mn, f, v)
+        np.maximum.at(mx, f, v)
+        counts[m], sums[m], mins[m], maxs[m] = c, s, mn, mx
+    return SegmentCube(axes, vocabs, date_min, counts, sums, mins, maxs,
+                       present)
+
+
+# ---------------------------------------------------------------------
+# serving
+# ---------------------------------------------------------------------
+
+def _eligible_aggs(cfg: StarTreeConfig, aggs: dict) -> Optional[list]:
+    """-> [(name, kind, field, params, sub_metrics)] or None. sub_metrics =
+    [(name, stat, field)]."""
+    out = []
+    for name, spec in (aggs or {}).items():
+        spec = dict(spec)
+        sub = spec.pop("aggs", spec.pop("aggregations", None))
+        kinds = [k for k in spec if k in ("terms", "date_histogram",
+                                          *METRIC_STATS)]
+        if len(kinds) != 1:
+            return None
+        kind = kinds[0]
+        body = spec[kind]
+        field = body.get("field")
+        if kind in METRIC_STATS:
+            if field not in cfg.metrics or sub:
+                return None
+            out.append((name, "metric", field, {"stat": kind}, []))
+            continue
+        if kind == "terms":
+            if field not in cfg.dims:
+                return None
+            params = {"size": int(body.get("size", 10))}
+        else:
+            if field != cfg.date_dim:
+                return None
+            iv = body.get("fixed_interval", body.get("calendar_interval"))
+            if iv is None or _interval_ms(iv) != cfg.interval_ms:
+                return None
+            params = {}
+        subs = []
+        for sname, sspec in (sub or {}).items():
+            skinds = [k for k in sspec if k in METRIC_STATS]
+            if len(skinds) != 1 or len(sspec) != 1:
+                return None
+            sfield = sspec[skinds[0]].get("field")
+            if sfield not in cfg.metrics:
+                return None
+            subs.append((sname, skinds[0], sfield))
+        out.append((name, kind, field, params, subs))
+    return out if out else None
+
+
+def try_answer(searchers, body: dict, configs: List[StarTreeConfig]
+               ) -> Optional[dict]:
+    """Answer an eligible size=0 aggregation request from the cubes, or
+    None to run the live path."""
+    if not configs or int(body.get("size", 10)) != 0:
+        return None
+    if body.get("sort") or body.get("search_after") or body.get("post_filter"):
+        return None
+    aggs = body.get("aggs", body.get("aggregations"))
+    if not aggs:
+        return None
+    query = body.get("query") or {"match_all": {}}
+    qk = list(query.keys())
+    term_filter: Optional[Tuple[str, str]] = None
+    if qk == ["term"]:
+        ((f, spec),) = query["term"].items()
+        v = spec.get("value") if isinstance(spec, dict) else spec
+        term_filter = (f, str(v))
+    elif qk != ["match_all"]:
+        return None
+    for cfg in configs:
+        if term_filter is not None and term_filter[0] not in cfg.dims:
+            continue
+        plan = _eligible_aggs(cfg, aggs)
+        if plan is None:
+            continue
+        return _answer(searchers, body, cfg, plan, term_filter)
+    return None
+
+
+def _answer(searchers, body: dict, cfg: StarTreeConfig, plan, term_filter):
+    import time
+    t0 = time.monotonic()
+    segs = []
+    for s in searchers:
+        for seg in s.engine.segments:
+            if seg.live_count == 0:
+                continue
+            cube = get_cube(seg, cfg)
+            if cube is None:
+                return None                    # some segment not cube-able
+            segs.append(cube)
+    total = 0
+    # accumulate per-agg across segments in VALUE space (per-segment
+    # ordinals differ)
+    acc: Dict[str, dict] = {name: {} for name, *_ in plan}
+    root: Dict[str, float] = {}
+    for cube in segs:
+        naxes = len(cube.axes)
+        shape = tuple(cube.axes)
+        sel = np.ones(shape, bool)
+        if term_filter is not None:
+            daxis = cfg.dims.index(term_filter[0])
+            vocab = cube.vocabs[daxis]
+            try:
+                o = vocab.index(term_filter[1])
+            except ValueError:
+                continue                       # value absent in this segment
+            mask = np.zeros(cube.axes[daxis], bool)
+            mask[o] = True
+            shape1 = [1] * naxes
+            shape1[daxis] = cube.axes[daxis]
+            sel = sel & mask.reshape(shape1)
+        selw = sel.astype(np.float64)
+        total += int((cube.present.reshape(shape) * selw).sum())
+        for name, kind, field, params, subs in plan:
+            if kind == "metric":
+                st = params["stat"]
+                r = root.setdefault(name, _stat_zero(st))
+                root[name] = _stat_fold(st, r, _reduce_all(cube, field,
+                                                           st, selw, shape))
+                continue
+            axis = (cfg.dims.index(field) if kind == "terms"
+                    else len(cfg.dims))
+            other = tuple(i for i in range(naxes) if i != axis)
+            cnts = (cube.present.reshape(shape) * selw).sum(axis=other)
+            submats = {}
+            for sname, stat, sfield in subs:
+                submats[(sname, stat, sfield)] = _reduce_axis(
+                    cube, sfield, stat, selw, shape, other)
+            for o in range(cube.axes[axis]):
+                if cnts[o] == 0:
+                    continue
+                if kind == "terms":
+                    if o == cube.axes[axis] - 1:
+                        continue               # missing slot
+                    key = cube.vocabs[axis][o]
+                else:
+                    key = (cube.date_min + o) * cfg.interval_ms
+                b = acc[name].setdefault(key, {"doc_count": 0.0, "subs": {}})
+                b["doc_count"] += float(cnts[o])
+                for sk, mat in submats.items():
+                    b["subs"][sk] = _stat_fold(sk[1],
+                                               b["subs"].get(sk),
+                                               mat[o] if mat is not None
+                                               else None)
+    # ---- render the standard response shape ----
+    aggregations: Dict[str, Any] = {}
+    for name, kind, field, params, subs in plan:
+        if kind == "metric":
+            aggregations[name] = _stat_render(params["stat"], root.get(name))
+            continue
+        buckets = []
+        items = sorted(acc[name].items(),
+                       key=(lambda kv: (-kv[1]["doc_count"], str(kv[0])))
+                       if kind == "terms" else (lambda kv: kv[0]))
+        if kind == "terms":
+            items = items[: params["size"]]
+        for key, b in items:
+            bucket = {"key": key, "doc_count": int(b["doc_count"])}
+            if kind == "date_histogram":
+                bucket["key_as_string"] = _iso(key)
+            for (sname, stat, _f), v in b["subs"].items():
+                bucket[sname] = _stat_render(stat, v)
+            buckets.append(bucket)
+        aggregations[name] = {"buckets": buckets}
+        if kind == "terms":
+            aggregations[name]["doc_count_error_upper_bound"] = 0
+            aggregations[name]["sum_other_doc_count"] = max(
+                0, len(acc[name]) - len(buckets))
+    return {
+        "took": int((time.monotonic() - t0) * 1000),
+        "timed_out": False,
+        "_shards": {"total": len(searchers), "successful": len(searchers),
+                    "skipped": 0, "failed": 0},
+        "hits": {"total": {"value": total, "relation": "eq"},
+                 "max_score": None, "hits": []},
+        "aggregations": aggregations,
+        "_star_tree": True,          # diagnosable acceleration marker
+    }
+
+
+def _reduce_all(cube, field, stat, selw, shape):
+    return _fold_mat(cube, field, stat, selw, shape, axis=None)
+
+
+def _reduce_axis(cube, field, stat, selw, shape, other):
+    return _fold_mat(cube, field, stat, selw, shape, axis=other)
+
+
+def _fold_mat(cube, field, stat, selw, shape, axis):
+    c = cube.counts[field].reshape(shape) * selw
+    if stat == "value_count":
+        return c.sum(axis=axis)
+    if stat in ("sum", "avg"):
+        s = cube.sums[field].reshape(shape) * selw
+        if stat == "sum":
+            return s.sum(axis=axis)
+        return np.stack([s.sum(axis=axis), c.sum(axis=axis)], axis=-1) \
+            if axis is not None else np.array([s.sum(), c.sum()])
+    m = cube.mins[field] if stat == "min" else cube.maxs[field]
+    m = m.reshape(shape)
+    masked = np.where(selw > 0, m, np.inf if stat == "min" else -np.inf)
+    return masked.min(axis=axis) if stat == "min" else masked.max(axis=axis)
+
+
+def _stat_zero(stat):
+    if stat == "min":
+        return np.inf
+    if stat == "max":
+        return -np.inf
+    if stat == "avg":
+        return np.zeros(2)
+    return 0.0
+
+
+def _stat_fold(stat, acc, v):
+    if v is None:
+        return acc
+    if acc is None:
+        acc = _stat_zero(stat)
+    if stat == "min":
+        return min(acc, float(np.min(v)) if np.ndim(v) else float(v))
+    if stat == "max":
+        return max(acc, float(np.max(v)) if np.ndim(v) else float(v))
+    if stat == "avg":
+        return np.asarray(acc, np.float64) + np.asarray(v, np.float64)
+    return float(acc) + float(v)
+
+
+def _stat_render(stat, v):
+    if v is None:
+        return {"value": None if stat in ("min", "max", "avg") else 0.0}
+    if stat == "avg":
+        s, c = float(v[0]), float(v[1])
+        return {"value": s / c if c else None}
+    if stat in ("min", "max"):
+        f = float(v)
+        return {"value": None if not np.isfinite(f) else f}
+    return {"value": float(v)}
+
+
+def _iso(ms: int) -> str:
+    import datetime as _dt
+    return _dt.datetime.fromtimestamp(
+        ms / 1000.0, tz=_dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.000Z")

@@ -1,0 +1,68 @@
+"""Multi-host planning layer (parallel/multihost.py): config validation,
+host-local shard packing, ownership, global mesh construction on the
+virtual device set. jax.distributed.initialize itself needs real
+processes; everything it consumes is tested here."""
+
+import jax
+import pytest
+
+from opensearch_tpu.parallel.multihost import (MultiHostConfig,
+                                               local_shards,
+                                               make_global_mesh,
+                                               shard_layout, shard_owner)
+
+
+def _cfg(**kw):
+    base = dict(coordinator_address="host0:1234", num_processes=2,
+                process_id=0, local_device_count=4)
+    base.update(kw)
+    return MultiHostConfig(**base)
+
+
+class TestConfig:
+    def test_validate_ok(self):
+        _cfg().validate()
+        assert _cfg().global_device_count == 8
+
+    def test_bad_process_id(self):
+        with pytest.raises(ValueError):
+            _cfg(process_id=2).validate()
+
+    def test_bad_address(self):
+        with pytest.raises(ValueError):
+            _cfg(coordinator_address="nope").validate()
+
+
+class TestLayout:
+    def test_shards_pack_host_local_first(self):
+        # 6 shards over 2 hosts x 4 devices: host0 gets 0-3, host1 gets 4-5
+        lay = shard_layout(_cfg(), 6)
+        assert lay == [(0, 0), (0, 1), (0, 2), (0, 3), (1, 0), (1, 1)]
+        assert shard_owner(_cfg(), 6) == [0, 0, 0, 0, 1, 1]
+
+    def test_local_shards_per_process(self):
+        assert local_shards(_cfg(process_id=0), 6) == [0, 1, 2, 3]
+        assert local_shards(_cfg(process_id=1), 6) == [4, 5]
+
+    def test_too_many_shards(self):
+        with pytest.raises(ValueError):
+            shard_layout(_cfg(), 9)
+
+
+class TestGlobalMesh:
+    def test_mesh_over_virtual_devices(self):
+        devs = jax.devices()
+        if len(devs) < 4:
+            pytest.skip("needs the 8-virtual-device conftest mesh")
+        mesh = make_global_mesh(_cfg(), 4, devices=devs)
+        assert mesh.axis_names == ("replica", "shard")
+        assert mesh.devices.shape == (1, 4)
+
+
+class TestMeshDefaultOn:
+    def test_node_enables_mesh_on_multidevice(self):
+        from opensearch_tpu.cluster.node import Node
+        if len(jax.devices()) <= 1:
+            pytest.skip("single device")
+        n = Node()
+        assert n.mesh_service is not None

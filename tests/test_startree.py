@@ -1,0 +1,145 @@
+"""Star-tree composite index: cube results identical to the live agg path
+(reference index/compositeindex/ + StarTreeMapper)."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+
+MAPPING = {"mappings": {"properties": {
+    "status": {"type": "keyword"},
+    "region": {"type": "keyword"},
+    "ts": {"type": "date"},
+    "price": {"type": "double"},
+    "qty": {"type": "integer"},
+    "cube": {"type": "star_tree", "config": {
+        "ordered_dimensions": [
+            "status", "region",
+            {"name": "ts", "type": "date", "interval": "day"}],
+        "metrics": ["price", "qty"]}},
+}}}
+
+
+@pytest.fixture(scope="module")
+def client():
+    rng = np.random.default_rng(11)
+    c = RestClient()
+    c.indices.create("st", MAPPING)
+    statuses = ["a", "b", "c"]
+    regions = ["eu", "us"]
+    day = 86_400_000
+    for i in range(400):
+        c.index("st", {
+            "status": statuses[int(rng.integers(0, 3))],
+            "region": regions[int(rng.integers(0, 2))],
+            "ts": 1700000000000 + int(rng.integers(0, 5)) * day,
+            "price": round(float(rng.random() * 100), 2),
+            "qty": int(rng.integers(1, 9)),
+        }, id=str(i))
+    c.indices.refresh("st")
+    return c
+
+
+def _both(c, body):
+    from opensearch_tpu.search import startree
+    fast = c.search("st", dict(body, _p1=1))
+    assert fast.get("_star_tree"), "star-tree did not engage"
+    # disable by raising the cell cap to zero so the live path runs
+    old = startree.MAX_CELLS
+    startree.MAX_CELLS = 0
+    for eng in c.node.indices["st"].shards:
+        for seg in eng.segments:
+            seg.__dict__.pop("_startree_cubes", None)
+    try:
+        slow = c.search("st", dict(body, _p2=2))
+    finally:
+        startree.MAX_CELLS = old
+        for eng in c.node.indices["st"].shards:
+            for seg in eng.segments:
+                seg.__dict__.pop("_startree_cubes", None)
+    assert not slow.get("_star_tree")
+    return fast, slow
+
+
+def _close(a, b, rel=1e-4):
+    # live path reduces in device f32, the cube in host f64
+    return abs(a - b) <= rel * max(1.0, abs(a), abs(b))
+
+
+class TestStarTreeParity:
+    def test_terms_with_metric_subs(self, client):
+        body = {"size": 0, "aggs": {"by_status": {
+            "terms": {"field": "status", "size": 10},
+            "aggs": {"rev": {"sum": {"field": "price"}},
+                     "avg_q": {"avg": {"field": "qty"}},
+                     "top": {"max": {"field": "price"}}}}}}
+        fast, slow = _both(client, body)
+        assert fast["hits"]["total"] == slow["hits"]["total"]
+        fb = fast["aggregations"]["by_status"]["buckets"]
+        sb = slow["aggregations"]["by_status"]["buckets"]
+        assert [b["key"] for b in fb] == [b["key"] for b in sb]
+        for f, s in zip(fb, sb):
+            assert f["doc_count"] == s["doc_count"]
+            assert _close(f["rev"]["value"], s["rev"]["value"])
+            assert _close(f["avg_q"]["value"], s["avg_q"]["value"])
+            assert _close(f["top"]["value"], s["top"]["value"])
+
+    def test_date_histogram(self, client):
+        body = {"size": 0, "aggs": {"per_day": {
+            "date_histogram": {"field": "ts", "fixed_interval": "1d"},
+            "aggs": {"q": {"sum": {"field": "qty"}}}}}}
+        fast, slow = _both(client, body)
+        fb = fast["aggregations"]["per_day"]["buckets"]
+        sb = slow["aggregations"]["per_day"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in fb] == \
+            [(b["key"], b["doc_count"]) for b in sb]
+        for f, s in zip(fb, sb):
+            assert _close(f["q"]["value"], s["q"]["value"])
+
+    def test_root_metrics(self, client):
+        body = {"size": 0, "aggs": {
+            "total": {"sum": {"field": "price"}},
+            "n": {"value_count": {"field": "qty"}},
+            "lo": {"min": {"field": "price"}}}}
+        fast, slow = _both(client, body)
+        for k in ("total", "n", "lo"):
+            assert _close(fast["aggregations"][k]["value"],
+                          slow["aggregations"][k]["value"])
+
+    def test_term_filter_slice(self, client):
+        body = {"size": 0, "query": {"term": {"region": "eu"}},
+                "aggs": {"by_status": {"terms": {"field": "status"},
+                                       "aggs": {"rev": {"sum": {
+                                           "field": "price"}}}}}}
+        fast, slow = _both(client, body)
+        assert fast["hits"]["total"] == slow["hits"]["total"]
+        fb = fast["aggregations"]["by_status"]["buckets"]
+        sb = slow["aggregations"]["by_status"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in fb] == \
+            [(b["key"], b["doc_count"]) for b in sb]
+
+    def test_ineligible_falls_back(self, client):
+        # match query is not cube-able
+        r = client.search("st", {"size": 0,
+                                 "query": {"range": {"price": {"gte": 50}}},
+                                 "aggs": {"s": {"terms": {
+                                     "field": "status"}}}, "_p3": 3})
+        assert not r.get("_star_tree")
+        # size>0 is not cube-able
+        r2 = client.search("st", {"size": 5, "aggs": {"s": {"terms": {
+            "field": "status"}}}, "_p4": 4})
+        assert not r2.get("_star_tree")
+
+    def test_multi_segment(self, client):
+        client.index("st", {"status": "a", "region": "eu",
+                            "ts": 1700000000000, "price": 10.0, "qty": 1},
+                     id="extra")
+        client.indices.refresh("st")
+        body = {"size": 0, "aggs": {"by_status": {
+            "terms": {"field": "status"},
+            "aggs": {"rev": {"sum": {"field": "price"}}}}}}
+        fast, slow = _both(client, body)
+        fb = fast["aggregations"]["by_status"]["buckets"]
+        sb = slow["aggregations"]["by_status"]["buckets"]
+        assert [(b["key"], b["doc_count"]) for b in fb] == \
+            [(b["key"], b["doc_count"]) for b in sb]
