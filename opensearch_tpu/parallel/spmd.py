@@ -64,6 +64,7 @@ class StackedShardIndex:
     doc_base: jnp.ndarray   # i32[S] global doc id offset per shard
     doc_count: jnp.ndarray  # f32[S] live docs per shard
     sum_dl: jnp.ndarray     # f32[S]
+    field_dc: jnp.ndarray   # f32[S] docs WITH this field (text_stats doc_count)
     n_shards: int
     ndocs_pad: int
 
@@ -84,6 +85,7 @@ class StackedShardIndex:
         doc_base = np.zeros(S, np.int32)
         doc_count = np.zeros(S, np.float32)
         sum_dl = np.zeros(S, np.float32)
+        field_dc = np.zeros(S, np.float32)
         base = 0
         for i, seg in enumerate(segments):
             pb = seg.postings.get(field)
@@ -102,8 +104,10 @@ class StackedShardIndex:
             doc_count[i] = seg.live_count
             st = seg.text_stats.get(field)
             sum_dl[i] = st.sum_dl if st else 0
+            field_dc[i] = st.doc_count if st else 0
         arrays = dict(starts=starts, doc_ids=doc_ids, tfs=tfs, dl=dl, live=live,
-                      doc_base=doc_base, doc_count=doc_count, sum_dl=sum_dl)
+                      doc_base=doc_base, doc_count=doc_count, sum_dl=sum_dl,
+                      field_dc=field_dc)
         if mesh is not None:
             sharding = NamedSharding(mesh, P("shard"))
             arrays = {k: jax.device_put(v, sharding) for k, v in arrays.items()}
@@ -114,7 +118,8 @@ class StackedShardIndex:
     def tree(self) -> dict:
         return {"starts": self.starts, "doc_ids": self.doc_ids, "tfs": self.tfs,
                 "dl": self.dl, "live": self.live, "doc_base": self.doc_base,
-                "doc_count": self.doc_count, "sum_dl": self.sum_dl}
+                "doc_count": self.doc_count, "sum_dl": self.sum_dl,
+                "field_dc": self.field_dc}
 
 
 def _local_gather(starts, doc_ids, tfs, rows, bucket: int):
@@ -144,7 +149,10 @@ def _score_one_query(starts, doc_ids, tfs, dl, live, rows, boosts, msm,
     w = jnp.where(df_global > 0, boosts * idf, 0.0)
     docs, tf, t_idx, valid = _local_gather(starts, doc_ids, tfs, rows, bucket)
     dsafe = jnp.minimum(docs, ndocs_pad - 1)
-    k = k1 * (1.0 - b + b * dl[dsafe] / avgdl)
+    # avgdl is pre-guarded > 0 by the caller (normless fields -> 1.0, matching
+    # the host StatsContext.avgdl default); keep a floor so 0/0 can never
+    # NaN-poison the whole shard's scores (silent-zero-hits bug, round 3).
+    k = k1 * (1.0 - b + b * dl[dsafe] / jnp.maximum(avgdl, 1e-9))
     contrib = jnp.where(valid, w[t_idx] * tf / (tf + k), 0.0)
     scores = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(contrib, mode="drop")
     counts = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(
@@ -178,7 +186,11 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         df_global = jax.lax.psum(local_df, "shard")                  # [QBl, T]
         n_global = jax.lax.psum(tree["doc_count"][0], "shard")
         sum_dl_g = jax.lax.psum(tree["sum_dl"][0], "shard")
-        avgdl = sum_dl_g / jnp.maximum(n_global, 1.0)
+        fdc_g = jax.lax.psum(tree["field_dc"][0], "shard")
+        # same semantics as the host StatsContext.avgdl (compiler.py): mean doc
+        # length over docs that HAVE the field, 1.0 when none (normless fields
+        # like keyword — sum_dl=0 there, and 0/0 was the r3 NaN poison).
+        avgdl = jnp.where(fdc_g > 0, sum_dl_g / jnp.maximum(fdc_g, 1.0), 1.0)
 
         # --- QUERY phase: vmap over the local query batch ---
         scores = jax.vmap(
@@ -208,7 +220,7 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
 
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
-                  "doc_count", "sum_dl")}
+                  "doc_count", "sum_dl", "field_dc")}
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(tree_spec, P("shard", "replica"), P("replica"),
                              P("replica")),
@@ -234,7 +246,7 @@ def build_term_sharded_score(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         w = jnp.where(df > 0, boosts * idf, 0.0)
         docs, tf, t_idx, valid = _local_gather(starts, doc_ids, tfs, rows, bucket)
         dsafe = jnp.minimum(docs, ndocs_pad - 1)
-        kfac = k1 * (1.0 - b + b * dl[dsafe] / avgdl)
+        kfac = k1 * (1.0 - b + b * dl[dsafe] / jnp.maximum(avgdl, 1e-9))
         contrib = jnp.where(valid, w[t_idx] * tf / (tf + kfac), 0.0)
         part = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(contrib, mode="drop")
         cnt = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(

@@ -191,17 +191,22 @@ class TestMeshService:
         from opensearch_tpu.parallel import MeshSearchService
         from opensearch_tpu.rest.client import RestClient
 
-        rng = np.random.default_rng(3)
         cm = RestClient(node=Node(mesh_service=MeshSearchService()))
         ch = RestClient()
+        cats = ["kitchen", "garden", "garage"]
         for c in (cm, ch):
-            c.indices.create("idx", {"settings": {"number_of_shards": 4}})
+            rng = np.random.default_rng(3)  # same docs for both clients
+            c.indices.create("idx", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "cat": {"type": "keyword"}, "body": {"type": "text"}}}})
             bulk = []
             for i in range(400):
                 bulk.append({"index": {"_index": "idx", "_id": str(i)}})
-                bulk.append({"body": " ".join(
-                    rng.choice(WORDS, size=int(rng.integers(3, 12))))})
-            rng = np.random.default_rng(3)  # same docs for both clients
+                body = " ".join(rng.choice(WORDS, size=int(rng.integers(3, 12))))
+                if i == 7:
+                    body += " solitaryterm"  # lives in exactly one shard's dict
+                bulk.append({"body": body, "cat": cats[i % 3]})
             c.bulk(bulk)
             c.indices.refresh("idx")
             c.indices.forcemerge("idx")
@@ -212,6 +217,16 @@ class TestMeshService:
         {"query": {"term": {"body": "gamma"}}, "size": 5},
         {"query": {"match": {"body": {"query": "delta eps zeta",
                                       "minimum_should_match": 2}}}, "size": 8},
+        # keyword (normless) field — the r3 NaN-poison regression
+        {"query": {"term": {"cat": "kitchen"}}, "size": 10},
+        {"query": {"term": {"cat": "garden"}}, "size": 10},
+        # term present in exactly one shard's dict (rows=-1 elsewhere)
+        {"query": {"term": {"body": "solitaryterm"}}, "size": 5},
+        # term in no shard at all
+        {"query": {"term": {"body": "zzznoterm"}}, "size": 5},
+        # msm == number of query terms (conjunction edge)
+        {"query": {"match": {"body": {"query": "alpha beta gamma",
+                                      "minimum_should_match": 3}}}, "size": 8},
     ])
     def test_rest_equals_mesh(self, clients, body):
         cm, ch = clients
@@ -245,3 +260,36 @@ class TestMeshService:
         cm, _ = clients
         st = cm.node.stats()
         assert st["mesh"]["dispatched"] >= 1
+
+    def test_deletes_parity(self, clients):
+        """Soft-deleted docs must vanish from mesh results exactly as they do
+        from the host loop (live-mask propagation through the SPMD program)."""
+        cm, ch = clients
+        for c in (cm, ch):
+            c.indices.create("idxdel", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "cat": {"type": "keyword"}, "body": {"type": "text"}}}})
+            rng = np.random.default_rng(9)
+            bulk = []
+            for i in range(200):
+                bulk.append({"index": {"_index": "idxdel", "_id": str(i)}})
+                bulk.append({"body": " ".join(
+                    rng.choice(WORDS, size=int(rng.integers(3, 12)))),
+                    "cat": "kitchen" if i % 2 == 0 else "garden"})
+            c.bulk(bulk)
+            c.indices.refresh("idxdel")
+            c.indices.forcemerge("idxdel")
+            for i in range(0, 200, 7):
+                c.delete(index="idxdel", id=str(i))
+            c.indices.refresh("idxdel")
+        for body in ({"query": {"match": {"body": "alpha beta"}}, "size": 10},
+                     {"query": {"term": {"cat": "kitchen"}}, "size": 10}):
+            before = cm.node.mesh_service.dispatched
+            rm = cm.search(index="idxdel", body=dict(body))
+            rh = ch.search(index="idxdel", body=dict(body))
+            assert cm.node.mesh_service.dispatched == before + 1, \
+                f"mesh path did not engage for {body}"
+            assert rm["hits"]["total"] == rh["hits"]["total"]
+            assert [h["_id"] for h in rm["hits"]["hits"]] == \
+                [h["_id"] for h in rh["hits"]["hits"]]
