@@ -31,11 +31,22 @@ postings; building 500M tokens of fake text to re-tokenize would bench the
 string generator), but everything from the query DSL inward is the product.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
-Env: BENCH_NDOCS (default 8_800_000), BENCH_QUERIES (default 2048).
+Env: BENCH_NDOCS (default 8_800_000), BENCH_QUERIES (default 2048),
+BENCH_BUDGET_S (default 540: soft wall-clock budget — reps scale down and
+optional streams drop to fit), BENCH_CACHE (default 1: memoize the synthetic
+corpus in .bench_cache/ so reruns skip the ~6 min build),
+BENCH_WRITE_BASELINE=1 to update BASELINE.json's `published` section
+(default: results go to BENCH_out.json only — benchmarking must not mutate
+checked-in baseline data as a side effect).
+
+Timeout-proof: partial results are flushed to BENCH_out.json after every
+config, and SIGTERM/SIGINT print the best-so-far JSON line before exiting,
+so a driver-imposed timeout still records the round's numbers.
 """
 
 import json
 import os
+import signal
 import sys
 import time
 
@@ -44,10 +55,67 @@ import numpy as np
 K1, B = 1.2, 0.75
 TOPK = 10
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_PARTIAL = {"metric": "bm25_rest_qps_per_chip", "value": None,
+            "unit": "queries/sec", "vs_baseline": None,
+            "extra": {"status": "started"}}
+_PRINTED = [False]
+
+
+def _emit_partial(status: str) -> None:
+    """Flush best-so-far results to BENCH_out.json (never stdout)."""
+    _PARTIAL["extra"]["status"] = status
+    try:
+        with open(os.path.join(_REPO, "BENCH_out.json"), "w") as f:
+            json.dump(_PARTIAL, f, indent=2)
+    except OSError:
+        pass
+
+
+def _on_term(signum, frame):
+    if not _PRINTED[0]:
+        _PRINTED[0] = True
+        _PARTIAL["extra"]["status"] = f"interrupted(sig{signum})"
+        _emit_partial(_PARTIAL["extra"]["status"])
+        print(json.dumps(_PARTIAL), flush=True)
+    os._exit(0)
+
+
+signal.signal(signal.SIGTERM, _on_term)
+signal.signal(signal.SIGINT, _on_term)
+
 
 # ---------------------------------------------------------------------
 # corpus builders
 # ---------------------------------------------------------------------
+
+# bump when a corpus builder's logic or defaults change — stale caches would
+# silently bench against the old corpus otherwise
+_CORPUS_VERSION = "v1-zipf1.15-dl56-vocab200k"
+
+
+def _cached(name: str, builder, enabled: bool):
+    """Memoize a tuple-of-ndarrays corpus build in .bench_cache/<name>/ and
+    reload with mmap (instant) — the 8.8M-doc build is ~6 min of pure numpy
+    that benches nothing we ship."""
+    d = os.path.join(_REPO, ".bench_cache", f"{_CORPUS_VERSION}-{name}")
+    meta = os.path.join(d, "ok")
+    if enabled and os.path.exists(meta):
+        n = int(open(meta).read())
+        return tuple(np.load(os.path.join(d, f"a{i}.npy"), mmap_mode="r")
+                     for i in range(n))
+    arrays = builder()
+    if enabled:
+        try:
+            os.makedirs(d, exist_ok=True)
+            for i, a in enumerate(arrays):
+                np.save(os.path.join(d, f"a{i}.npy"), a)
+            with open(meta, "w") as f:
+                f.write(str(len(arrays)))
+        except OSError:
+            pass
+    return arrays
+
 
 def build_corpus(ndocs: int, vocab: int = 200_000, avg_dl: int = 56, seed: int = 0):
     rng = np.random.default_rng(seed)
@@ -212,12 +280,20 @@ def pct(samples, p):
 def main():
     ndocs = int(os.environ.get("BENCH_NDOCS", 8_800_000))
     nq = int(os.environ.get("BENCH_QUERIES", 2048))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", 540))
+    cache_ok = os.environ.get("BENCH_CACHE", "1") not in ("0", "")
+    bench_start = time.time()
+
+    def remaining() -> float:
+        return budget_s - (time.time() - bench_start)
 
     t0 = time.time()
-    starts, doc_ids, tfs, dl, df_per_term = build_corpus(ndocs)
+    starts, doc_ids, tfs, dl, df_per_term = _cached(
+        f"body_{ndocs}", lambda: build_corpus(ndocs), cache_ok)
     queries = pick_queries(df_per_term, nq)
     (tstarts, tdoc_ids, ttfs, tpos_starts, tpositions,
-     pair_first, pair_second, pair_counts) = build_title_corpus(ndocs)
+     pair_first, pair_second, pair_counts) = _cached(
+        f"title_{ndocs}", lambda: build_title_corpus(ndocs), cache_ok)
     rng = np.random.default_rng(3)
     status_ord = rng.integers(0, 3, ndocs).astype(np.int32)
     price = rng.integers(0, 1000, ndocs).astype(np.int64)
@@ -321,8 +397,10 @@ def main():
     def log(msg):
         print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
-    def run_stream(bodies_fn, idxs, tag, reps, require_fast=True):
-        """msearch the stream `reps` times; -> (qps, wall_per_rep_ms, resp)"""
+    def run_stream(bodies_fn, idxs, tag, reps, require_fast=True,
+                   time_share=60.0):
+        """msearch the stream up to `reps` times, adaptively dropping reps to
+        fit `time_share` seconds; -> (qps, wall_per_rep_ms, resp)"""
         lines = []
         for i in idxs:
             lines.append({"index": "bench"})
@@ -333,69 +411,30 @@ def main():
         resp = client.msearch(lines)  # warmup rep (compiles + materializes)
         assert all("hits" in r for r in resp["responses"]), resp["responses"][0]
         log(f"{tag}: warmup done in {time.time()-t0:.1f}s")
-        t0 = time.time()
+        done = 0
+        wall = 0.0
         for rep in range(reps):
             for j, ln in enumerate(lines):
                 if j % 2:
                     ln["_bench"] = f"{tag}r{rep}-{j}"
+            t0 = time.time()
             resp = client.msearch(lines)
-        wall = time.time() - t0
-        if require_fast:
+            wall += time.time() - t0
+            done += 1
+            # a measured rep exists; stop early when the stream's share (or
+            # the whole bench budget) is spent
+            if wall + wall / done > time_share or remaining() < wall / done:
+                break
+        if done < reps:
+            log(f"{tag}: budget-capped at {done}/{reps} reps")
+        if require_fast and fastpath.enabled():
             served = (fastpath.STATS["pure_served"]
                       + fastpath.STATS["bool_served"]
                       - before["pure_served"] - before["bool_served"])
-            assert served >= (reps + 1) * len(idxs), \
+            assert served >= (done + 1) * len(idxs), \
                 f"{tag}: fastpath fell back ({served} served, " \
                 f"{fastpath.STATS['fallback']} fallbacks)"
-        return (reps * len(idxs)) / wall, wall / reps * 1000.0, resp
-
-    log("index built; cpu baselines done")
-    # warm the filter materialization: two passes over the 3 guardrail
-    # filters so hits>=1, then the specialized postings build. The first
-    # pass legitimately runs off-kernel (dense first-use filters exceed the
-    # list-slot budget), so no require_fast
-    run_stream(bool_body, range(3), "fwarm", 1, require_fast=False)
-    log("filter warm done")
-
-    qps1, wall1, resp1 = run_stream(match_body, range(nq), "m", 5)
-    qps2, wall2, resp2 = run_stream(bool_body, range(nq), "b", 3)
-    qps3, wall3, resp3 = run_stream(phrase_body, range(min(nq, 1024)), "p", 3,
-                                    require_fast=False)
-
-    # mixed stream: 50% filtered bool / 30% match / 20% phrase
-    def mixed_body(i, tag):
-        r = i % 10
-        if r < 5:
-            return bool_body(i, tag)
-        if r < 8:
-            return match_body(i, tag)
-        return phrase_body(i, tag)
-
-    qps_mixed, wall_mx, _ = run_stream(mixed_body, range(nq), "x", 3,
-                                       require_fast=False)
-
-    # per-call latency sweep (batch sizes; distinct queries defeat the
-    # request cache; first call per size is warmup)
-    latency = {}
-    for bsize, calls in ((1, 48), (16, 24), (256, 8)):
-        times = []
-        for c in range(calls):
-            lines = []
-            for j in range(bsize):
-                i = int((c * bsize + j) % nq)
-                lines.append({"index": "bench"})
-                lines.append(match_body(i, f"lat{bsize}-{c}-{j}"))
-            t0 = time.time()
-            client.msearch(lines)
-            times.append((time.time() - t0) * 1000.0)
-        times = times[1:]
-        latency[f"batch{bsize}"] = {
-            "p50_ms": round(pct(times, 50), 2),
-            "p99_ms": round(pct(times, 99), 2),
-            "qps": round(bsize / (pct(times, 50) / 1000.0), 1),
-        }
-    latency["batch2048"] = {"p50_ms": round(wall1, 2), "p99_ms": None,
-                            "qps": round(qps1, 1)}
+        return (done * len(idxs)) / wall, wall / done * 1000.0, resp
 
     # ------------- recall vs the CPU baseline -------------
     def recall(resp, cpu_results, n):
@@ -431,8 +470,6 @@ def main():
                 s += idf[t] * tf / (tf + kdoc[d])
         return s
 
-    rec1_tie, rec1_strict = recall(resp1, cpu1, ncpu)
-
     extra = {
         "ndocs": ndocs, "postings": int(len(doc_ids)),
         "corpus_build_s": round(build_s, 1),
@@ -440,21 +477,101 @@ def main():
                     "single core; published CPU-Lucene band 50-150 q/s/core",
         "cpu_maxscore_match_qps": round(cpu1_qps, 1),
         "cpu_maxscore_bool_qps": round(cpu2_qps, 1),
-        "configs": {
-            "1_match": {"qps": round(qps1, 1),
-                        "vs_cpu": round(qps1 / cpu1_qps, 1),
-                        "recall_at_10_vs_cpu": round(rec1_tie, 4),
-                        "recall_at_10_strict": round(rec1_strict, 4)},
-            "2_bool": {"qps": round(qps2, 1),
-                       "vs_cpu": round(qps2 / cpu2_qps, 1)},
-            "3_phrase": {"qps": round(qps3, 1)},
-            "mixed_50f_30m_20p": {"qps": round(qps_mixed, 1),
-                                  "pct_of_pure_match":
-                                      round(100.0 * qps_mixed / qps1, 1)},
-        },
-        "latency": latency,
+        "configs": {},
+        "latency": {},
         "path": "RestClient.msearch -> fastpath Pallas kernels",
     }
+    _PARTIAL["extra"] = extra
+    _emit_partial("cpu_baseline_done")
+
+    log("index built; cpu baselines done")
+    # warm the filter materialization: two passes over the 3 guardrail
+    # filters so hits>=1, then the specialized postings build. The first
+    # pass legitimately runs off-kernel (dense first-use filters exceed the
+    # list-slot budget), so no require_fast
+    run_stream(bool_body, range(3), "fwarm", 1, require_fast=False)
+    log("filter warm done")
+
+    # ---- config 1 (match) — the north-star number; budget priority #1
+    qps1, wall1, resp1 = run_stream(match_body, range(nq), "m", 5,
+                                    time_share=min(90.0, remaining() * 0.35))
+    rec1_tie, rec1_strict = recall(resp1, cpu1, ncpu)
+    extra["configs"]["1_match"] = {
+        "qps": round(qps1, 1), "vs_cpu": round(qps1 / cpu1_qps, 2),
+        "recall_at_10_vs_cpu": round(rec1_tie, 4),
+        "recall_at_10_strict": round(rec1_strict, 4)}
+    _PARTIAL["value"] = round(qps1, 2)
+    _PARTIAL["vs_baseline"] = round(qps1 / cpu1_qps, 2)
+    _emit_partial("config1_done")
+
+    # ---- interactive latency (batch-1 is a VERDICT priority) before the
+    # optional wide streams, so a timeout still records it
+    latency = extra["latency"]
+    for bsize, calls in ((1, 48), (16, 24), (256, 8)):
+        if remaining() < 30 and latency:
+            log(f"latency batch{bsize}: skipped (budget)")
+            continue
+        times = []
+        for c in range(calls):
+            lines = []
+            for j in range(bsize):
+                i = int((c * bsize + j) % nq)
+                lines.append({"index": "bench"})
+                lines.append(match_body(i, f"lat{bsize}-{c}-{j}"))
+            t0 = time.time()
+            client.msearch(lines)
+            times.append((time.time() - t0) * 1000.0)
+        times = times[1:]
+        latency[f"batch{bsize}"] = {
+            "p50_ms": round(pct(times, 50), 2),
+            "p99_ms": round(pct(times, 99), 2),
+            "qps": round(bsize / (pct(times, 50) / 1000.0), 1),
+        }
+    latency["batch2048"] = {"p50_ms": round(wall1, 2), "p99_ms": None,
+                            "qps": round(qps1, 1)}
+    _emit_partial("latency_done")
+
+    # ---- config 2 (bool)
+    if remaining() > 45:
+        qps2, wall2, resp2 = run_stream(
+            bool_body, range(nq), "b", 3,
+            time_share=min(60.0, remaining() * 0.4))
+        extra["configs"]["2_bool"] = {
+            "qps": round(qps2, 1), "vs_cpu": round(qps2 / cpu2_qps, 2)}
+        _emit_partial("config2_done")
+    else:
+        log("config 2: skipped (budget)")
+
+    # ---- config 3 (phrase)
+    if remaining() > 45:
+        qps3, wall3, resp3 = run_stream(
+            phrase_body, range(min(nq, 1024)), "p", 3, require_fast=False,
+            time_share=min(45.0, remaining() * 0.4))
+        extra["configs"]["3_phrase"] = {"qps": round(qps3, 1)}
+        _emit_partial("config3_done")
+    else:
+        log("config 3: skipped (budget)")
+
+    # ---- mixed stream: 50% filtered bool / 30% match / 20% phrase
+    def mixed_body(i, tag):
+        r = i % 10
+        if r < 5:
+            return bool_body(i, tag)
+        if r < 8:
+            return match_body(i, tag)
+        return phrase_body(i, tag)
+
+    if remaining() > 45 and "3_phrase" in extra["configs"]:
+        qps_mixed, wall_mx, _ = run_stream(
+            mixed_body, range(nq), "x", 3, require_fast=False,
+            time_share=min(45.0, remaining() * 0.5))
+        extra["configs"]["mixed_50f_30m_20p"] = {
+            "qps": round(qps_mixed, 1),
+            "pct_of_pure_match": round(100.0 * qps_mixed / qps1, 1)}
+    else:
+        log("mixed stream: skipped (budget)")
+
+    extra["bench_wall_s"] = round(time.time() - bench_start, 1)
     result = {
         "metric": "bm25_rest_qps_per_chip",
         "value": round(qps1, 2),
@@ -462,27 +579,30 @@ def main():
         "vs_baseline": round(qps1 / cpu1_qps, 2),
         "extra": extra,
     }
+    _PARTIAL.update(result)
+    _emit_partial("complete")
 
-    # record into BASELINE.json.published for the judge
-    try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BASELINE.json"), "r+") as f:
-            bl = json.load(f)
-            bl["published"] = {
-                "config1_match": extra["configs"]["1_match"],
-                "config2_bool": extra["configs"]["2_bool"],
-                "config3_phrase": extra["configs"]["3_phrase"],
-                "mixed": extra["configs"]["mixed_50f_30m_20p"],
-                "latency": latency,
-                "cpu_baseline_qps": {"match": round(cpu1_qps, 1),
-                                     "bool": round(cpu2_qps, 1)},
-            }
-            f.seek(0)
-            json.dump(bl, f, indent=2)
-            f.truncate()
-    except OSError:
-        pass
+    # update BASELINE.json.published only on request (a partial local run
+    # must not silently rewrite checked-in baseline data)
+    if os.environ.get("BENCH_WRITE_BASELINE") == "1":
+        try:
+            with open(os.path.join(_REPO, "BASELINE.json"), "r+") as f:
+                bl = json.load(f)
+                bl["published"] = {
+                    **{(f"config{k[0]}_{k[2:]}" if k[0].isdigit()
+                        else "mixed" if k.startswith("mixed") else k): v
+                       for k, v in extra["configs"].items()},
+                    "latency": latency,
+                    "cpu_baseline_qps": {"match": round(cpu1_qps, 1),
+                                         "bool": round(cpu2_qps, 1)},
+                }
+                f.seek(0)
+                json.dump(bl, f, indent=2)
+                f.truncate()
+        except OSError:
+            pass
 
+    _PRINTED[0] = True
     print(json.dumps(result))
 
 

@@ -200,7 +200,18 @@ def check_open(node, names: List[str], expression) -> List[str]:
     if expression not in (None, "", "_all", "*"):
         exprs = (expression if isinstance(expression, list)
                  else str(expression).split(","))
-        explicit = {e.strip() for e in exprs if "*" not in e and "?" not in e}
+        for e in exprs:
+            e = e.strip()
+            if "*" in e or "?" in e:
+                continue
+            explicit.add(e)
+            if e not in node.indices:
+                # alias / data-stream token: the reference treats its
+                # concrete backing indices as explicitly named too
+                try:
+                    explicit.update(node.metadata.resolve(e))
+                except Exception:
+                    pass
     out = []
     for n in names:
         svc = node.indices.get(n)

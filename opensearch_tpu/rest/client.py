@@ -597,8 +597,8 @@ class RestClient:
             try:
                 resps = self.node.msearch(pairs[0][0],
                                           [b for _, b in pairs])
-            except (dsl.QueryParseError, IndexNotFoundError, KeyError,
-                    TypeError, ValueError, CircuitBreakingException):
+            except (dsl.QueryParseError, IndexNotFoundError, IndexClosedError,
+                    KeyError, TypeError, ValueError, CircuitBreakingException):
                 # fall back to the per-body path, which maps errors into
                 # per-response error objects
                 resps = None

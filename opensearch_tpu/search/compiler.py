@@ -3289,13 +3289,17 @@ _CAL_MS = {"month": None, "1M": None, "year": None, "1y": None, "quarter": None,
 _FIXED_MS = {"ms": 1, "s": 1000, "m": 60000, "h": 3600000, "d": 86400000}
 
 
-def parse_interval_ms(s) -> int:
+def parse_interval_ms(s, allow_negative: bool = False) -> int:
     if isinstance(s, (int, float)):
         return int(s)
-    mm = re.fullmatch(r"(\d+)(ms|s|m|h|d)", str(s))
+    # sign is legal only where the caller says so (date_histogram `offset`
+    # accepts "+6h"/"-3h"; a negative fixed_interval must stay an error)
+    sign_re = r"([+-]?)" if allow_negative else r"()"
+    mm = re.fullmatch(sign_re + r"(\d+)(ms|s|m|h|d)", str(s))
     if not mm:
         raise ValueError(f"invalid fixed_interval [{s}]")
-    return int(mm.group(1)) * _FIXED_MS[mm.group(2)]
+    v = int(mm.group(2)) * _FIXED_MS[mm.group(3)]
+    return -v if mm.group(1) == "-" else v
 
 
 def _kw_hash_cache(seg: Segment, field: str) -> np.ndarray:
@@ -3547,7 +3551,9 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         else:
             interval_ms = parse_interval_ms(body.get("fixed_interval",
                                                      body.get("interval", "1d")))
-        offset_ms = parse_interval_ms(body.get("offset", 0)) if body.get("offset") else 0
+        offset_ms = (parse_interval_ms(body.get("offset", 0),
+                                       allow_negative=True)
+                     if body.get("offset") else 0)
         bucket_ids, min_b, nb = _host_date_buckets(seg, field, max(interval_ms, 1),
                                                    offset_ms, calendar)
         pad = np.full(next_pow2(len(bucket_ids)), -1, dtype=np.int32)
@@ -4627,6 +4633,10 @@ _FILTER_MASK_CACHE: "OrderedDict[tuple, Any]" = __import__(
 _FILTER_MASK_MAX_BYTES = 256 << 20   # byte-bounded like IndicesQueryCache
 _FILTER_MASK_BYTES = [0]
 _FILTER_HASH_BYTE_CAP = 1 << 20   # don't hash megabyte param sets
+# msearch's per-body fallback searches on a thread pool; LRU mutation and
+# the byte counter must not interleave (RLock: build path can re-enter via
+# nested cached filters)
+_FILTER_MASK_LOCK = __import__("threading").RLock()
 
 
 def filter_mask_cache_stats() -> dict:
@@ -4636,9 +4646,10 @@ def filter_mask_cache_stats() -> dict:
 
 def _purge_masks_for_uid(uid: int) -> None:
     """Weakref finalizer: a dropped segment's masks can never hit again."""
-    for k in [k for k in _FILTER_MASK_CACHE if k[0] == uid]:
-        _FILTER_MASK_BYTES[0] -= _FILTER_MASK_CACHE[k].nbytes
-        del _FILTER_MASK_CACHE[k]
+    with _FILTER_MASK_LOCK:
+        for k in [k for k in _FILTER_MASK_CACHE if k[0] == uid]:
+            _FILTER_MASK_BYTES[0] -= _FILTER_MASK_CACHE[k].nbytes
+            del _FILTER_MASK_CACHE[k]
 
 
 @lru_cache(maxsize=256)
@@ -4781,7 +4792,11 @@ def _prepare_cached_filter(node: LNode, seg: Segment, ctx: ShardContext,
 def _mask_for_key(key, spec, local: dict, mapping: Dict[int, int],
                   seg: Segment, needs: Optional[Dict[str, set]] = None
                   ) -> np.ndarray:
-    mask = _FILTER_MASK_CACHE.get(key)
+    with _FILTER_MASK_LOCK:
+        mask = _FILTER_MASK_CACHE.get(key)
+        if mask is not None:
+            _FILTER_MASK_CACHE.move_to_end(key)
+            return mask
     if mask is None:
         # use whichever device already hosts this segment (replica copies
         # must not trigger a default-device re-host just for the cache)
@@ -4798,17 +4813,22 @@ def _mask_for_key(key, spec, local: dict, mapping: Dict[int, int],
                   else seg.device_arrays(dev_key))
         # host-resident bools: safe to feed executors on ANY device
         mask = np.asarray(exe(arrays, canon_local))
-        _FILTER_MASK_CACHE[key] = mask
-        _FILTER_MASK_BYTES[0] += mask.nbytes
-        if not hasattr(seg, "_mask_fin"):
-            import weakref
-            seg._mask_fin = weakref.finalize(seg, _purge_masks_for_uid,
-                                             seg.uid)
-        while _FILTER_MASK_BYTES[0] > _FILTER_MASK_MAX_BYTES:
-            _k, _v = _FILTER_MASK_CACHE.popitem(last=False)
-            _FILTER_MASK_BYTES[0] -= _v.nbytes
-    else:
-        _FILTER_MASK_CACHE.move_to_end(key)
+        with _FILTER_MASK_LOCK:
+            # two threads can race the same miss: keep the winner's entry so
+            # the byte counter never double-counts one key
+            prev = _FILTER_MASK_CACHE.get(key)
+            if prev is not None:
+                _FILTER_MASK_CACHE.move_to_end(key)
+                return prev
+            _FILTER_MASK_CACHE[key] = mask
+            _FILTER_MASK_BYTES[0] += mask.nbytes
+            if not hasattr(seg, "_mask_fin"):
+                import weakref
+                seg._mask_fin = weakref.finalize(seg, _purge_masks_for_uid,
+                                                 seg.uid)
+            while _FILTER_MASK_BYTES[0] > _FILTER_MASK_MAX_BYTES:
+                _k, _v = _FILTER_MASK_CACHE.popitem(last=False)
+                _FILTER_MASK_BYTES[0] -= _v.nbytes
     return mask
 
 

@@ -117,23 +117,31 @@ def referenced(defs: Dict[str, DerivedField], body: dict) -> List[str]:
     return [n for n in defs if n in blob]
 
 
+# msearch's per-body fallback runs searches on a thread pool; materialization
+# mutates segment postings/column dicts, so two bodies referencing the same
+# derived field must not interleave (coarse lock: it's a once-per-(segment,
+# digest) cost)
+_ENSURE_LOCK = __import__("threading").RLock()
+
+
 def ensure(seg, mappings, defs: Dict[str, DerivedField],
            names: List[str]) -> None:
     """Materialize the named derived fields on one segment (idempotent per
     script digest)."""
-    built: Dict[str, str] = seg.__dict__.setdefault("_derived_built", {})
-    derived_names: set = seg.__dict__.setdefault("_derived_names", set())
-    changed = False
-    for name in names:
-        df = defs[name]
-        if built.get(name) == df.digest:
-            continue
-        _materialize(seg, mappings, df)
-        built[name] = df.digest
-        derived_names.add(name)
-        changed = True
-    if changed:
-        _purge_query_caches(seg, names)
+    with _ENSURE_LOCK:
+        built: Dict[str, str] = seg.__dict__.setdefault("_derived_built", {})
+        derived_names: set = seg.__dict__.setdefault("_derived_names", set())
+        changed = False
+        for name in names:
+            df = defs[name]
+            if built.get(name) == df.digest:
+                continue
+            _materialize(seg, mappings, df)
+            built[name] = df.digest
+            derived_names.add(name)
+            changed = True
+        if changed:
+            _purge_query_caches(seg, names)
 
 
 def _purge_query_caches(seg, names: List[str]) -> None:

@@ -638,6 +638,9 @@ _FILTERED_MAX_BYTES = 6 << 30
 _FILTERED_LRU: "OrderedDict[tuple, FilteredPostings]" = __import__(
     "collections").OrderedDict()
 _FILTERED_BYTES = [0]
+# msearch's per-body fallback runs searches on a thread pool; the LRU's
+# move_to_end/popitem and the byte counter are not atomic under that
+_FILTERED_LOCK = __import__("threading").RLock()
 
 
 class FilteredPostings:
@@ -655,9 +658,10 @@ class FilteredPostings:
 
 
 def _purge_filtered_for_uid(uid: int) -> None:
-    for k in [k for k in _FILTERED_LRU if k[0] == uid]:
-        _FILTERED_BYTES[0] -= _FILTERED_LRU[k].nbytes
-        del _FILTERED_LRU[k]
+    with _FILTERED_LOCK:
+        for k in [k for k in _FILTERED_LRU if k[0] == uid]:
+            _FILTERED_BYTES[0] -= _FILTERED_LRU[k].nbytes
+            del _FILTERED_LRU[k]
 
 
 def _filtered_postings(seg: Segment, field: str, fl: FilterList
@@ -665,10 +669,11 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
     import jax
 
     key = (seg.uid, field, fl.key)
-    fp = _FILTERED_LRU.get(key)
-    if fp is not None:
-        _FILTERED_LRU.move_to_end(key)
-        return fp
+    with _FILTERED_LOCK:
+        fp = _FILTERED_LRU.get(key)
+        if fp is not None:
+            _FILTERED_LRU.move_to_end(key)
+            return fp
     if get_aligned(seg, field) is None:     # validates tf/dl pack bounds
         return None
     pb = seg.postings.get(field)
@@ -699,11 +704,20 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
         import weakref
         seg._filtered_fin = weakref.finalize(seg, _purge_filtered_for_uid,
                                              seg.uid)
-    _FILTERED_LRU[key] = fp
-    _FILTERED_BYTES[0] += nbytes
-    while _FILTERED_BYTES[0] > _FILTERED_MAX_BYTES and len(_FILTERED_LRU) > 1:
-        _k, _v = _FILTERED_LRU.popitem(last=False)
-        _FILTERED_BYTES[0] -= _v.nbytes
+    with _FILTERED_LOCK:
+        # two threads can race the same miss: keep the winner so the byte
+        # counter never double-counts one key (the loser's breaker charge is
+        # released by its weakref finalizer when `fp` is dropped)
+        prev = _FILTERED_LRU.get(key)
+        if prev is not None:
+            _FILTERED_LRU.move_to_end(key)
+            return prev
+        _FILTERED_LRU[key] = fp
+        _FILTERED_BYTES[0] += nbytes
+        while _FILTERED_BYTES[0] > _FILTERED_MAX_BYTES \
+                and len(_FILTERED_LRU) > 1:
+            _k, _v = _FILTERED_LRU.popitem(last=False)
+            _FILTERED_BYTES[0] -= _v.nbytes
     return fp
 
 

@@ -189,22 +189,35 @@ def _eligible_aggs(cfg: StarTreeConfig, aggs: dict) -> Optional[list]:
         sub = spec.pop("aggs", spec.pop("aggregations", None))
         kinds = [k for k in spec if k in ("terms", "date_histogram",
                                           *METRIC_STATS)]
-        if len(kinds) != 1:
+        # strict: exactly the agg-kind key, nothing else (no meta, scripts...)
+        if len(kinds) != 1 or len(spec) != 1:
             return None
         kind = kinds[0]
         body = spec[kind]
+        if not isinstance(body, dict):
+            return None
         field = body.get("field")
+        # the cube serves DEFAULT semantics only: any param beyond the
+        # supported set (custom order, min_doc_count, missing, offset,
+        # time_zone, script, ...) must take the live path or results would
+        # silently diverge (advisor finding, round 3)
         if kind in METRIC_STATS:
             if field not in cfg.metrics or sub:
+                return None
+            if set(body) - {"field"}:
                 return None
             out.append((name, "metric", field, {"stat": kind}, []))
             continue
         if kind == "terms":
             if field not in cfg.dims:
                 return None
+            if set(body) - {"field", "size"}:
+                return None
             params = {"size": int(body.get("size", 10))}
         else:
             if field != cfg.date_dim:
+                return None
+            if set(body) - {"field", "fixed_interval", "calendar_interval"}:
                 return None
             iv = body.get("fixed_interval", body.get("calendar_interval"))
             if iv is None or _interval_ms(iv) != cfg.interval_ms:
@@ -215,7 +228,10 @@ def _eligible_aggs(cfg: StarTreeConfig, aggs: dict) -> Optional[list]:
             skinds = [k for k in sspec if k in METRIC_STATS]
             if len(skinds) != 1 or len(sspec) != 1:
                 return None
-            sfield = sspec[skinds[0]].get("field")
+            sbody = sspec[skinds[0]]
+            if not isinstance(sbody, dict) or set(sbody) - {"field"}:
+                return None
+            sfield = sbody.get("field")
             if sfield not in cfg.metrics:
                 return None
             subs.append((sname, skinds[0], sfield))

@@ -97,6 +97,27 @@ class TestOpenClose:
         r = client.search("idx", {"query": {"match_all": {}}})
         assert r["hits"]["total"]["value"] == 20
 
+    def test_msearch_closed_index_maps_error(self, client):
+        """msearch on an explicitly named closed index must come back as a
+        per-body error object, not escape as a raw exception (advisor
+        finding, round 3)."""
+        client.indices.close("idx")
+        r = client.msearch([{"index": "idx"},
+                            {"query": {"match_all": {}}}])
+        body = r["responses"][0]
+        assert "error" in body
+        assert "closed" in str(body["error"]).lower()
+
+    def test_alias_of_closed_index_raises(self, client):
+        """An alias naming a closed concrete index is 'explicit' too — the
+        reference raises index_closed_exception rather than silently
+        filtering it like a wildcard (advisor finding, round 3)."""
+        client.indices.put_alias("idx", "myalias")
+        client.indices.close("idx")
+        with pytest.raises(ApiError) as e:
+            client.search("myalias", {"query": {"match_all": {}}})
+        assert e.value.err_type == "index_closed_exception"
+
     def test_wildcard_skips_closed(self, client):
         client.indices.create("idx2")
         client.index("idx2", {"body": "other"}, id="a")

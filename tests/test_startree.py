@@ -129,6 +129,23 @@ class TestStarTreeParity:
         r2 = client.search("st", {"size": 5, "aggs": {"s": {"terms": {
             "field": "status"}}}, "_p4": 4})
         assert not r2.get("_star_tree")
+        # unsupported agg params must take the live path: the cube only
+        # serves default semantics (advisor finding, round 3)
+        for aggs in (
+            {"s": {"terms": {"field": "status",
+                             "order": {"_key": "asc"}}}},
+            {"s": {"terms": {"field": "status", "min_doc_count": 2}}},
+            {"s": {"terms": {"field": "status", "missing": "zzz"}}},
+            {"s": {"date_histogram": {"field": "ts",
+                                      "fixed_interval": "1d",
+                                      "offset": "+6h"}}},
+            {"s": {"terms": {"field": "status"},
+                   "aggs": {"m": {"sum": {"field": "price",
+                                          "missing": 1.0}}}}},
+        ):
+            r3 = client.search("st", {"size": 0, "aggs": aggs,
+                                      "_pp": str(aggs)})
+            assert not r3.get("_star_tree"), aggs
 
     def test_multi_segment(self, client):
         client.index("st", {"status": "a", "region": "eu",
