@@ -32,6 +32,9 @@ class IndexService:
     def __init__(self, meta: IndexMetadata, mapping: Optional[dict],
                  data_path: Optional[str] = None, thread_pools=None):
         self.meta = meta
+        # remote-backed storage mirror (index/remote.py), attached by the
+        # Node when a remote root is configured
+        self.remote = None
         analysis = AnalysisRegistry(meta.settings.get("index", {}).get("analysis",
                                     meta.settings.get("analysis")))
         self.mappings = Mappings(mapping, analysis=analysis,
@@ -195,6 +198,16 @@ class IndexService:
             for s in self.shards:
                 s.flush()
         self.generation += 1
+        # remote-backed storage: mirror every shard's new commit (reference
+        # RemoteStoreRefreshListener uploads after each refresh/commit)
+        if self.remote is not None:
+            for sid, eng in enumerate(self.shards):
+                if eng.path:
+                    self.remote.upload_shard(eng.path, sid)
+            self.remote.upload_index_meta({
+                "settings": self.meta.settings,
+                "mappings": self.mappings.to_dict(),
+                "state": self.meta.state})
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         for s in self.shards:
@@ -229,7 +242,9 @@ class IndexService:
                              "delete_total": ops["delete_ops"]},
                 "refresh": {"total": ops["refreshes"]},
                 "flush": {"total": ops["flushes"]},
-                "merges": {"total": ops["merges"]}}
+                "merges": {"total": ops["merges"]},
+                **({"remote_store": self.remote.stats()}
+                   if self.remote is not None else {})}
 
     def close(self) -> None:
         for s in self.shards:
@@ -268,10 +283,17 @@ class RequestCache:
 class Node:
     def __init__(self, data_path: Optional[str] = None,
                  cluster_name: str = "opensearch-tpu", node_name: str = "node-0",
-                 mesh_service=None):
+                 mesh_service=None, remote_root: Optional[str] = None):
         self.metadata = ClusterMetadata(cluster_name)
         self.node_name = node_name
         self.data_path = data_path
+        # remote-backed storage root (reference remote store repository):
+        # when set, every flush mirrors shard commits to this blob root and
+        # recovery can restore an index from the mirror alone
+        self.remote_root = (remote_root
+                            or os.environ.get("OPENSEARCH_TPU_REMOTE_ROOT")
+                            or None)
+        self.remote_stores: Dict[str, object] = {}
         self.indices: Dict[str, IndexService] = {}
         self.ingest = IngestService()
         from ..search.pipeline import SearchPipelineService
@@ -356,6 +378,7 @@ class Node:
                            thread_pools=self.thread_pools)
         self.indices[name] = svc
         self.metadata.indices[name] = meta
+        self._attach_remote(name)
         for alias, acfg in body.get("aliases", {}).items():
             self._put_alias(alias, name, acfg)
         self.metadata.bump()
@@ -572,6 +595,67 @@ class Node:
                                thread_pools=self.thread_pools)
             self.indices[name] = svc
             self.metadata.indices[name] = meta
+            self._attach_remote(name)
+        # remote-backed indices absent locally (lost data dir, fresh node):
+        # restore from the mirror alone — the headline remote-store promise
+        # (reference RestoreRemoteStoreAction)
+        from ..index.remote import remote_indices
+        for name in remote_indices(self.remote_root):
+            if name not in self.indices:
+                self.restore_from_remote(name)
+
+    # -------- remote-backed storage (index/remote.py) --------
+
+    def _attach_remote(self, name: str) -> None:
+        """Give an index its remote mirror when the node has a remote root
+        and the index doesn't opt out (index.remote_store.enabled=false)."""
+        if not self.remote_root:
+            return
+        svc = self.indices[name]
+        rs_cfg = svc.meta.settings.get("index", {}).get("remote_store", {})
+        if isinstance(rs_cfg, dict) and str(rs_cfg.get("enabled", True)) \
+                in ("False", "false", "0"):
+            return
+        from ..index.remote import RemoteSegmentStore
+        store = self.remote_stores.get(name)
+        if store is None:
+            store = RemoteSegmentStore(self.remote_root, name)
+            self.remote_stores[name] = store
+        svc.remote = store
+
+    def restore_from_remote(self, name: str) -> dict:
+        """Materialize an index from its remote mirror: download the latest
+        generation of every shard into the local data dir, then recover the
+        engines from the restored commit points + segments."""
+        from ..index.remote import RemoteSegmentStore
+        if not self.remote_root:
+            raise ClusterStateError("no remote store root configured")
+        if name in self.indices:
+            raise ResourceAlreadyExistsError(
+                f"index [{name}] exists; close and delete it before a "
+                f"remote restore")
+        if not self.data_path:
+            raise ClusterStateError("remote restore requires a node data_path")
+        store = RemoteSegmentStore(self.remote_root, name)
+        saved = store.load_index_meta()
+        if saved is None:
+            raise IndexNotFoundError(f"no remote index [{name}]")
+        restored_files = 0
+        for sid in store.shard_ids():
+            dest = os.path.join(self.data_path, name, str(sid))
+            restored_files += store.restore_shard(sid, dest)
+        meta = IndexMetadata(name, settings=saved.get("settings", {}))
+        meta.state = saved.get("state", "open")
+        svc = IndexService(meta, saved.get("mappings"), self.data_path,
+                           thread_pools=self.thread_pools)
+        self.indices[name] = svc
+        self.metadata.indices[name] = meta
+        self.remote_stores[name] = store
+        svc.remote = store
+        self._persist_meta(name)
+        self.metadata.bump()
+        return {"index": name, "restored_files": restored_files,
+                "shards": len(store.shard_ids())}
 
     # ---------------- snapshots (reference snapshots/SnapshotsService) ----------------
 

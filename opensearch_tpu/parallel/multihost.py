@@ -115,3 +115,47 @@ def make_global_mesh(cfg: MultiHostConfig, n_shards: int,
         raise ValueError("not enough devices for the shard mesh")
     picked = np.array(devs[:n_shards]).reshape(1, n_shards)
     return Mesh(picked, axis_names=("replica", "shard"))
+
+
+def put_global(arr, mesh, spec) -> "jax.Array":
+    """Place a host array onto a (possibly multi-process) mesh sharding.
+
+    Single process: plain device_put. Multi process: each process
+    contributes only its ADDRESSABLE portion via
+    `jax.make_array_from_process_local_data` — for `P("shard", ...)` that
+    is the block of leading-axis rows whose mesh slot lands on this
+    process's local devices; for replicated specs it is the full array.
+    The host array is the same on every process (deterministic build), so
+    the assembled global array is consistent without any host exchange."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, _local_block(arr, mesh, spec), global_shape=arr.shape)
+
+
+def _local_block(arr, mesh, spec):
+    """This process's addressable slice of `arr` under (mesh, spec):
+    leading-axis block for shard-sharded arrays, whole array when
+    replicated (replica axis has size 1 in our meshes)."""
+    import jax
+    import numpy as np
+
+    names = list(getattr(spec, "_partitions", spec))
+    if not names or names[0] != "shard":
+        return arr
+    n_shard = mesh.shape["shard"]
+    shard_devs = mesh.devices.reshape(-1)[:n_shard]
+    mine = [i for i, d in enumerate(shard_devs)
+            if d.process_index == jax.process_index()]
+    rows = arr.shape[0] // n_shard
+    if not mine:
+        # this process owns no shard slot (n_shards < global devices):
+        # contribute an empty block
+        return np.asarray(arr[:0])
+    lo, hi = min(mine), max(mine) + 1
+    assert mine == list(range(lo, hi)), "shard axis must be process-major"
+    return np.asarray(arr[lo * rows: hi * rows])

@@ -77,8 +77,10 @@ def _parse_keepalive_s(v, default: float = 60.0) -> float:
 
 
 class RestClient:
-    def __init__(self, node: Optional[Node] = None, data_path: Optional[str] = None):
-        self.node = node or Node(data_path=data_path)
+    def __init__(self, node: Optional[Node] = None,
+                 data_path: Optional[str] = None,
+                 remote_root: Optional[str] = None):
+        self.node = node or Node(data_path=data_path, remote_root=remote_root)
         self.indices = IndicesClient(self)
         self.ingest = IngestClient(self)
         self.snapshot = SnapshotClient(self)
@@ -627,6 +629,33 @@ class RestClient:
             for i in todo:
                 partial[i] = run_one(i)
         return {"took": 0, "responses": partial}
+
+    # ------ _remotestore/_restore (reference RestoreRemoteStoreAction) -----
+
+    def remotestore_restore(self, body: dict) -> dict:
+        """POST /_remotestore/_restore analog: re-materialize indices from
+        the node's remote-backed storage mirror. Indices must not exist
+        locally (delete/lose them first) — mirroring the reference's
+        closed-or-absent requirement."""
+        from ..cluster.state import (ClusterStateError, IndexNotFoundError,
+                                     ResourceAlreadyExistsError)
+        names = body.get("indices", [])
+        if isinstance(names, str):
+            names = [n.strip() for n in names.split(",") if n.strip()]
+        if not names:
+            raise ApiError(400, "action_request_validation_exception",
+                           "indices is required")
+        out = []
+        for name in names:
+            try:
+                out.append(self.node.restore_from_remote(name))
+            except ResourceAlreadyExistsError as e:
+                raise ApiError(400, "illegal_argument_exception", str(e))
+            except IndexNotFoundError as e:
+                raise ApiError(404, "index_not_found_exception", str(e))
+            except ClusterStateError as e:
+                raise ApiError(400, "illegal_argument_exception", str(e))
+        return {"remote_store": {"accepted": True, "indices": out}}
 
     # ---------------- _validate/query (reference ValidateQueryAction) ------
 
@@ -1230,7 +1259,8 @@ def _search_snapshot(searchers: List[ShardSearcher], body: dict, index: str,
     resp = {"took": 0, "timed_out": False,
             "_shards": {"total": len(searchers), "successful": len(searchers),
                         "skipped": 0, "failed": 0},
-            "hits": {"total": {"value": reduced["total"], "relation": "eq"},
+            "hits": {"total": {"value": reduced["total"],
+                               "relation": reduced.get("total_rel", "eq")},
                      "max_score": reduced["max_score"], "hits": hits}}
     if reduced["aggs"]:
         resp["aggregations"] = reduced["aggs"]

@@ -51,6 +51,7 @@ class ShardQueryResult:
     shard: int
     candidates: List[Candidate] = dc_field(default_factory=list)
     total: int = 0
+    total_rel: str = "eq"   # "gte" when a pruned segment undercounted
     max_score: float = float("-inf")
     agg_partials: Dict[str, dict] = dc_field(default_factory=dict)
     segments: List[Segment] = dc_field(default_factory=list)
@@ -340,6 +341,8 @@ class ShardSearcher:
         scores = np.asarray(out["topk_scores"])
         valid = keys > -np.inf
         result.total += int(out["total"])
+        if out.get("total_rel") == "gte":
+            result.total_rel = "gte"
         ms = float(out["max_score"])
         if ms > result.max_score:
             result.max_score = ms
@@ -684,10 +687,13 @@ def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
     frm = int(body.get("from", 0))
     all_cands: List[Candidate] = []
     total = 0
+    total_rel = "eq"
     max_score = float("-inf")
     for r in shard_results:
         all_cands.extend(r.candidates)
         total += r.total
+        if r.total_rel == "gte":
+            total_rel = "gte"
         max_score = max(max_score, r.max_score)
     all_cands.sort(key=lambda c: c.sort_values)
     if body.get("collapse"):
@@ -715,7 +721,7 @@ def reduce_shard_results(shard_results: List[ShardQueryResult], body: dict,
         aggs_out[node.name] = finalize(node, merged,
                                        pipelines=not defer_pipelines)
 
-    return {"selected": selected, "total": total,
+    return {"selected": selected, "total": total, "total_rel": total_rel,
             "max_score": None if max_score == float("-inf") else max_score,
             "aggs": aggs_out}
 
@@ -902,7 +908,7 @@ def _finish_search(searchers: List[ShardSearcher],
             _apply_deferred_tree(an, reduced["aggs"].get(an.name))
 
     track = body.get("track_total_hits", True)
-    relation = "eq"
+    relation = reduced.get("total_rel", "eq")
     total = reduced["total"]
     if track is not True and track is not False:
         track_n = int(track)

@@ -35,11 +35,23 @@ MAX_K = 128          # top-k lanes the kernel returns
 MAX_CHUNKS = 64      # doc-range split bound for huge posting rows
 INT_MAX = np.int32(2**31 - 1)
 
+# Impact-ordered head pruning (the device analog of Lucene's block-max
+# pruning, reference `search/query/TopDocsCollectorContext.java` over
+# Lucene MAXSCORE/WAND): a term with more than L_HEAD postings keeps an
+# extra on-device copy of its L_HEAD HIGHEST-IMPACT postings (selected by
+# tf/(tf+k·norm), stored doc-ascending so the kernel's merge network is
+# unchanged). Pruned queries stream heads only — fixed cost per term no
+# matter the df — then a host verify pass proves the result exact against
+# the remainder's upper bound, or reruns that query dense. See
+# `_verify_pruned` for the bound.
+L_HEAD = 1 << 12
+
 _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 
 # served/fallback counters (surfaced in _nodes/stats; also used by tests to
 # prove the kernel actually engaged rather than silently falling back)
-STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0}
+STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0,
+         "pruned_served": 0, "pruned_escalated": 0}
 
 # optional memory accounting set by the Node (utils/breaker.py): charged
 # before aligned arrays go to device, released when the segment is GC'd
@@ -70,18 +82,87 @@ def enabled() -> bool:
             and not os.environ.get("OPENSEARCH_TPU_NO_FASTPATH"))
 
 
-class AlignedPostings:
-    """Device-resident aligned (doc, tf·dl) postings for one segment field."""
+def _frontier(tfs: np.ndarray, dls: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    """(tf -> min dl over docs with that tf) of a posting set — its Pareto
+    frontier under the BM25 contribution tf/(tf+k(dl)), which is increasing
+    in tf and decreasing in dl. The max contribution of the set under ANY
+    (k1, b, avgdl) is attained on this frontier, so ~a dozen (tf, dl) pairs
+    give an EXACT set bound for every query-time similarity."""
+    if len(tfs) == 0:
+        return (np.zeros(0, np.float32), np.zeros(0, np.float32))
+    tf = tfs.astype(np.int64)
+    order = np.argsort(tf, kind="stable")
+    tf_s = tf[order]
+    dl_s = dls.astype(np.float32)[order]
+    # min dl per distinct tf via reduceat
+    heads = np.flatnonzero(np.concatenate(([True], tf_s[1:] != tf_s[:-1])))
+    return (tf_s[heads].astype(np.float32),
+            np.minimum.reduceat(dl_s, heads).astype(np.float32))
 
-    __slots__ = ("starts_rows", "lens", "d_docs", "d_tfdl", "nbytes")
+
+def _frontier_bound(fr: Tuple[np.ndarray, np.ndarray], k1: float,
+                    b_eff: float, avgdl: float) -> float:
+    """Max contribution tf/(tf+k1·(1-b+b·dl/avgdl)) over a frontier."""
+    tf, dl = fr
+    if len(tf) == 0:
+        return 0.0
+    k = k1 * (1.0 - b_eff + b_eff * dl / max(avgdl, 1e-9))
+    return float(np.max(tf / (tf + np.maximum(k, 1e-9))))
+
+
+class AlignedPostings:
+    """Device-resident aligned (doc, tf·dl) postings for one segment field,
+    plus the impact-selected heads of oversized rows (appended to the same
+    buffer) and the remainder frontiers that make pruned results provable."""
+
+    __slots__ = ("starts_rows", "lens", "d_docs", "d_tfdl", "nbytes",
+                 "head_starts_rows", "head_lens", "rem_frontiers",
+                 "_full_frontiers")
 
     def __init__(self, starts_rows: np.ndarray, lens: np.ndarray,
-                 d_docs, d_tfdl, nbytes: int):
+                 d_docs, d_tfdl, nbytes: int,
+                 head_starts_rows: Optional[np.ndarray] = None,
+                 head_lens: Optional[np.ndarray] = None,
+                 rem_frontiers: Optional[dict] = None):
         self.starts_rows = starts_rows    # i64[nterms] aligned start / LANES
         self.lens = lens                  # i64[nterms] true posting counts
         self.d_docs = d_docs
         self.d_tfdl = d_tfdl
         self.nbytes = nbytes
+        # head view: == (starts_rows, lens) for rows with <= L_HEAD postings;
+        # points at the appended impact-head region for clamped rows
+        self.head_starts_rows = (head_starts_rows if head_starts_rows
+                                 is not None else starts_rows)
+        self.head_lens = (head_lens if head_lens is not None
+                          else np.minimum(lens, L_HEAD))
+        # row -> frontier of the postings OUTSIDE the head (clamped rows
+        # only); absence means the head is the whole row
+        self.rem_frontiers = rem_frontiers or {}
+        self._full_frontiers: dict = {}
+
+    def clamped(self, row: int) -> bool:
+        return row in self.rem_frontiers
+
+    def rem_bound(self, row: int, k1: float, b_eff: float,
+                  avgdl: float) -> float:
+        """Upper bound of one remaining (non-head) posting's contribution
+        for this row under query-time similarity params."""
+        fr = self.rem_frontiers.get(row)
+        return 0.0 if fr is None else _frontier_bound(fr, k1, b_eff, avgdl)
+
+    def full_bound(self, pb, row: int, k1: float, b_eff: float,
+                   avgdl: float, dl_col) -> float:
+        """Upper bound of ANY single posting's contribution in this row
+        (lazy per-row frontier, cached — O(df) once per queried term)."""
+        fr = self._full_frontiers.get(row)
+        if fr is None:
+            a, b = pb.row_slice(row)
+            dls = (dl_col[pb.doc_ids[a:b]] if dl_col is not None
+                   else np.zeros(b - a, np.float32))
+            fr = _frontier(pb.tfs[a:b], dls)
+            self._full_frontiers[row] = fr
+        return _frontier_bound(fr, k1, b_eff, avgdl)
 
 
 def get_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
@@ -93,6 +174,27 @@ def get_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     out = _build_aligned(seg, field)
     cache[field] = out
     return out
+
+
+def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray
+                 ) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
+    """Pick the L_HEAD highest-impact postings of one oversized row.
+    Impact = tf/(tf + k1·(1-b+b·dl/avgdl)) with nominal params — the order
+    only steers which postings we keep; correctness rides on the returned
+    REMAINDER FRONTIER (tf -> min dl of the non-kept postings), which
+    bounds any remaining posting's contribution under any query-time
+    similarity. Returns (kept positions ASCENDING — i.e. doc-ascending, as
+    the kernel's merge network requires —, remainder frontier)."""
+    tf = tfs.astype(np.float32)
+    dlf = dl_of.astype(np.float32)
+    avg = max(float(dlf.mean()), 1.0)
+    c = tf / (tf + 1.2 * (0.25 + 0.75 * dlf / avg))
+    # stable sort: impact ties keep doc-ascending order, matching the exact
+    # path's doc-id tie-break so a tied top-k boundary selects the same docs
+    order = np.argsort(-c, kind="stable")
+    keep = order[:L_HEAD]
+    rest = order[L_HEAD:]
+    return np.sort(keep), _frontier(tf[rest], dlf[rest])
 
 
 def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
@@ -110,21 +212,52 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     if len(dl_of) and dl_of.max() > DL_MAX:
         return None
     packed = ((tfs.astype(np.int64) << DL_BITS) | dl_of).astype(np.int32)
+    lens = np.diff(pb.starts).astype(np.int64)
+    nterms = len(lens)
+
+    # impact heads for oversized rows, appended as EXTRA CSR rows so one
+    # aligned buffer serves both the dense path (original row region,
+    # offsets unchanged) and the pruned path (head region for big rows)
+    big = np.nonzero(lens > L_HEAD)[0]
+    rem_frontiers: dict = {}
+    cat_starts = pb.starts
+    cat_docs = pb.doc_ids
+    cat_packed = packed
+    if len(big):
+        h_docs, h_packed, h_lens = [], [], []
+        for r in big:
+            a, b = int(pb.starts[r]), int(pb.starts[r + 1])
+            keep, rem_fr = _head_select(pb.doc_ids[a:b], tfs[a:b],
+                                        dl_of[a:b])
+            h_docs.append(pb.doc_ids[a:b][keep])
+            h_packed.append(packed[a:b][keep])
+            h_lens.append(len(keep))
+            rem_frontiers[int(r)] = rem_fr
+        cat_docs = np.concatenate([pb.doc_ids] + h_docs)
+        cat_packed = np.concatenate([packed] + h_packed)
+        cat_starts = np.concatenate([
+            pb.starts,
+            pb.starts[-1] + np.cumsum(np.asarray(h_lens, np.int64))])
+
     # rows align to 128 lanes only; DMA windows align DOWN to the 1024
     # HBM tile and mask the spilled prefix positionally (skip) — the Zipf
     # long tail would otherwise pay up to 1023 pad slots per rare term
     a_starts, a_docs, a_packed = align_csr_rows(
-        pb.starts, pb.doc_ids, packed, margin=MAX_L, alignment=LANES)
+        cat_starts, cat_docs, cat_packed, margin=MAX_L, alignment=LANES)
     nbytes = a_docs.nbytes + a_packed.nbytes
     if _breaker is not None:
         import weakref
         _breaker.add_estimate(nbytes, f"fastpath[{seg.name}][{field}]")
         weakref.finalize(seg, _breaker.release, nbytes)
-    lens = np.diff(pb.starts).astype(np.int64)
     starts_rows = (a_starts[:-1] // LANES).astype(np.int64)
-    return AlignedPostings(starts_rows, lens,
+    head_starts_rows = starts_rows[:nterms].copy()
+    head_lens = np.minimum(lens, L_HEAD)
+    if len(big):
+        head_starts_rows[big] = starts_rows[nterms:]
+    return AlignedPostings(starts_rows[:nterms], lens,
                            jax.device_put(a_docs), jax.device_put(a_packed),
-                           nbytes)
+                           nbytes, head_starts_rows, head_lens,
+                           rem_frontiers)
 
 
 def _body_eligible(sort_specs: List[dict], agg_nodes, named_nodes,
@@ -181,7 +314,8 @@ class FastSpec:
     minimum_should_match), and zero-count bonus shoulds."""
 
     __slots__ = ("kind", "lt", "slots", "fam_msm", "filter_clauses",
-                 "field", "sim", "has_norms", "boost", "const_score")
+                 "field", "sim", "has_norms", "boost", "const_score",
+                 "window", "prune_ok")
 
     def __init__(self, kind: str, **kw):
         self.kind = kind
@@ -194,6 +328,8 @@ class FastSpec:
         self.has_norms = True
         self.boost = 1.0
         self.const_score = None    # fixed score for every hit (filter-only)
+        self.window = None         # requested from+size (for pruned verify)
+        self.prune_ok = False      # body allows impact-head pruning
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -294,19 +430,34 @@ def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
     if not _body_eligible(sort_specs, agg_nodes, named_nodes, search_after,
                           window, body):
         return None
+    # pruning changes total-hit semantics on clamped terms (lower bound,
+    # relation "gte" — same contract as the reference's default 10k
+    # total-hits cap); an explicit track_total_hits demands exact counts,
+    # so those bodies ride the dense kernel
+    prune_ok = "track_total_hits" not in body
     if _ok_group(lroot) and next_pow2(len(lroot.terms), floor=1) <= MAX_T:
-        return FastSpec("pure", lt=lroot, field=lroot.field)
-    return _flatten_bool(lroot)
+        return FastSpec("pure", lt=lroot, field=lroot.field, window=window,
+                        prune_ok=prune_ok)
+    spec = _flatten_bool(lroot)
+    if spec is not None:
+        spec.window = window
+    return spec
 
 
 class _VQuery:
-    """One kernel-row: a whole query, or one doc-range chunk of it."""
+    """One kernel-row: a whole query, one doc-range chunk of it, or its
+    impact-head pruned form (`head=True`)."""
 
     __slots__ = ("qi", "T_pad", "L", "rowstarts", "nrows", "lens", "skips",
                  "weights", "msm", "avgdl", "dlo", "dhi", "k1", "b_eff",
-                 "field")
+                 "field", "head", "clamped", "miss", "msm_true", "rows")
 
     def __init__(self, **kw):
+        self.head = False       # streams impact heads instead of full rows
+        self.clamped = False    # at least one term's head excludes postings
+        self.miss = None        # f32[T_pad]: w_t * remainder bound per term
+        self.msm_true = 1.0     # real msm (kernel gets 1.0 when clamped)
+        self.rows = None        # i64[T_pad] term-dict rows (for rescore)
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -387,10 +538,13 @@ def _chunk_slices(al: AlignedPostings, pb, rows: np.ndarray, ndocs: int
                         len(rows))
 
 
-def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
+def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict,
+                      prune: Optional[Sequence[bool]] = None
                       ) -> Optional[List[List[_VQuery]]]:
     """-> per input query, its list of kernel rows (1 or NCHUNK); None entry
-    = that query falls back to the XLA path."""
+    = that query falls back to the XLA path. When `prune[qi]` is true the
+    query streams impact heads (always single-launch) and carries the
+    verify metadata; otherwise the full rows, chunked when oversized."""
     out: List[Optional[List[_VQuery]]] = []
     for qi, lt in enumerate(lts):
         al = get_aligned(seg, lt.field)
@@ -412,8 +566,12 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
         common = dict(qi=qi, T_pad=T_pad, weights=weights,
                       msm=float(lt.msm), avgdl=avgdl_cache[lt.field],
                       k1=float(sim.k1), b_eff=b_eff, field=lt.field)
+        use_head = bool(prune[qi]) if prune is not None else False
+        src_starts = al.head_starts_rows if use_head else al.starts_rows
+        src_lens = al.head_lens if use_head else al.lens
 
-        # single-launch case: every row fits the per-term bucket
+        # single-launch case: every row fits the per-term bucket (always
+        # true for heads: L_HEAD <= MAX_L)
         min_rows = HBM_ALIGN // LANES
         rowstarts = np.zeros(T_pad, np.int32)
         nrows = np.zeros(T_pad, np.int32)
@@ -421,13 +579,19 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
         skips = np.zeros(T_pad, np.int32)
         max_nr = min_rows
         fits = True
+        clamped = False
+        miss = np.zeros(T_pad, np.float32)
         for i, r in enumerate(rows):
             if r < 0:
                 continue
-            ln = int(al.lens[r])
+            ln = int(src_lens[r])
+            if use_head and al.clamped(int(r)):
+                clamped = True
+                miss[i] = float(weights[i]) * al.rem_bound(
+                    int(r), float(sim.k1), b_eff, float(common["avgdl"]))
             if ln == 0:
                 continue
-            abs_el = int(al.starts_rows[r]) * LANES
+            abs_el = int(src_starts[r]) * LANES
             dma_el = (abs_el // HBM_ALIGN) * HBM_ALIGN
             skip = abs_el - dma_el
             if skip + ln > MAX_L:
@@ -440,9 +604,21 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
             skips[i] = skip
             max_nr = max(max_nr, nr)
         if fits and T_pad * max_nr * LANES <= MAX_TL:
-            out.append([_VQuery(L=max_nr * LANES, rowstarts=rowstarts,
-                                nrows=nrows, lens=lens, skips=skips, dlo=0,
-                                dhi=int(INT_MAX), **common)])
+            vq = _VQuery(L=max_nr * LANES, rowstarts=rowstarts,
+                         nrows=nrows, lens=lens, skips=skips, dlo=0,
+                         dhi=int(INT_MAX), **common)
+            if use_head:
+                vq.head = True
+                vq.clamped = clamped
+                vq.miss = miss
+                vq.msm_true = float(lt.msm)
+                vq.rows = rows
+                if clamped and vq.msm_true > 1.0:
+                    # kernel collects by raw sum; the true msm filter runs
+                    # in the exact rescore (a doc matching all terms but
+                    # only some heads must not be dropped on partial counts)
+                    vq.msm = 1.0
+            out.append([vq])
             continue
 
         # oversized: doc-range chunk decomposition (each doc's postings live
@@ -461,10 +637,11 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict
     return out
 
 
-def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
-                  K: int) -> List[Optional[dict]]:
-    """Group all kernel rows by shape, launch once per group, reassemble
-    per-query results (chunked queries merge their chunk top-Ks on host)."""
+def _launch_pure_groups(seg: Segment,
+                        vq_lists: List[Optional[List[_VQuery]]],
+                        K: int) -> dict:
+    """Group all kernel rows by shape, launch once per group.
+    -> id(vq) -> (scores, docs, total, relation)."""
     groups = {}
     for vqs in vq_lists:
         if vqs is None:
@@ -472,7 +649,7 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
         for vq in vqs:
             groups.setdefault((vq.field, vq.T_pad, vq.k1, vq.b_eff),
                               []).append(vq)
-    results = {}   # id(vq) -> (scores, docs, total)
+    results = {}
     for (field, T_pad, k1, b_eff), gvqs in groups.items():
         al = get_aligned(seg, field)
         # ONE launch per group: DMA volume is set by per-term `nrows`, not L,
@@ -480,7 +657,6 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
         # host<->device round trip) amortizes across the whole batch while
         # rare terms still move only their own bytes
         L = max(v.L for v in gvqs)
-        QB = len(gvqs)
         rowstarts = np.stack([v.rowstarts for v in gvqs])
         nrows = np.stack([v.nrows for v in gvqs])
         lens = np.stack([v.lens for v in gvqs])
@@ -498,7 +674,137 @@ def _run_vqueries(seg: Segment, vq_lists: List[Optional[List[_VQuery]]],
         totals = np.asarray(totals)
         for j, vq in enumerate(gvqs):
             results[id(vq)] = (scores[j][:K], docs[j][:K],
-                               int(totals[j][0]))
+                               int(totals[j][0]), "eq")
+    return results
+
+
+def _unseen_bound(al: AlignedPostings, pb, dl_col, vq: _VQuery,
+                  partial_k: float) -> float:
+    """Max possible TRUE score of any doc OUTSIDE the kernel's candidate
+    set — the MaxScore-style analysis adapted to head pruning.
+
+    An unseen doc misses some (possibly empty) subset S of the clamped
+    terms' heads. Its score splits as (contributions from terms whose rows/
+    heads contain it) + (remainder contributions of terms in S):
+      - in-head part: <= partial_k (it lost the kernel top-K) AND
+                      <= sum_{t not in S} w_t * full_bound_t
+      - remainder:    <= sum_{t in S} miss_t  (exact frontier bounds)
+    Take min of the two in-head bounds per subset, max over subsets. With
+    S = {} the doc is fully scored by the kernel: bounded by partial_k."""
+    T = len(vq.rows)
+    cl = [i for i in range(T) if vq.miss is not None and vq.miss[i] > 0.0]
+    # per-term single-posting bounds (lazy frontier, cached on the layout)
+    fb = np.zeros(T, np.float32)
+    for i, r in enumerate(vq.rows):
+        if r >= 0:
+            fb[i] = vq.weights[i] * al.full_bound(
+                pb, int(r), vq.k1, vq.b_eff, float(vq.avgdl), dl_col)
+    best = partial_k
+    for mask in range(1, 1 << len(cl)):
+        in_s = [cl[j] for j in range(len(cl)) if mask >> j & 1]
+        rem_part = float(sum(vq.miss[i] for i in in_s))
+        inhead = float(sum(fb[i] for i in range(T) if i not in in_s))
+        best = max(best, min(partial_k + rem_part, inhead + rem_part))
+    return best
+
+
+def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
+                   total: int, window: int, K: int) -> Optional[tuple]:
+    """Prove a clamped pruned result exact, or None -> rerun dense.
+
+    The kernel saw only each term's impact head, so candidate partial
+    scores may miss contributions (doc outside some term's head). Exact-
+    rescore the candidates on host (the analog of Lucene re-walking a WAND
+    candidate), then accept iff the `_unseen_bound` subset analysis proves
+    no unseen doc can displace the served window. Totals become a lower
+    bound (relation "gte"), the contract the reference's default
+    track-total-hits cap already has."""
+    pb = seg.postings.get(vq.field)
+    dl = seg.doc_lens.get(vq.field)
+    al = get_aligned(seg, vq.field)
+    valid = np.isfinite(sc) & (dc >= 0)
+    cand = dc[valid].astype(np.int64)
+    if len(cand) == 0:
+        # heads matched nothing; matches could still exist past the heads
+        if any(vq.miss[i] > 0 for i in range(len(vq.rows))):
+            return None
+        return (sc, dc, total, "eq")
+    dl_c = (dl[cand].astype(np.float32) if dl is not None
+            else np.zeros(len(cand), np.float32))
+    kfac = vq.k1 * (1.0 - vq.b_eff
+                    + vq.b_eff * dl_c / max(float(vq.avgdl), 1e-9))
+    exact = np.zeros(len(cand), np.float32)
+    counts = np.zeros(len(cand), np.int64)
+    for i, r in enumerate(vq.rows):
+        if r < 0:
+            continue
+        a, b = pb.row_slice(int(r))
+        rowdocs = pb.doc_ids[a:b]
+        pos = np.searchsorted(rowdocs, cand)
+        pos_c = np.minimum(pos, b - a - 1)
+        found = rowdocs[pos_c] == cand
+        tf = np.where(found, pb.tfs[a + pos_c], 0.0).astype(np.float32)
+        exact += np.where(found, vq.weights[i] * tf / (tf + kfac),
+                          0.0).astype(np.float32)
+        counts += found
+    pass_msm = counts >= vq.msm_true
+    n_pass = int(pass_msm.sum())
+    exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
+    partial_k = float(sc[valid][-1]) if len(cand) >= K else 0.0
+    bound = _unseen_bound(al, pb, dl, vq, partial_k)
+    order = np.lexsort((cand, -exact_m))
+    theta = (float(exact_m[order[window - 1]]) if n_pass >= window
+             else -np.inf)
+    # >= not >: the frontier bounds are ATTAINED by real docs, so an unseen
+    # doc can tie theta exactly and would deserve the window slot under the
+    # doc-id tie-break — equality must escalate to the dense pass
+    if bound >= theta:
+        return None
+    keep = order[pass_msm[order]][:K]
+    sc2 = np.full(K, -np.inf, np.float32)
+    dc2 = np.full(K, -1, np.int32)
+    sc2[: len(keep)] = exact_m[keep]
+    dc2[: len(keep)] = cand[keep]
+    total_out = n_pass if vq.msm_true > 1 else total
+    return (sc2, dc2, total_out, "gte")
+
+
+def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
+              K: int) -> Optional[List[Optional[dict]]]:
+    """The pure term-group path: pruned first pass, host verification, dense
+    rerun for the (rare) queries whose bound check fails."""
+    prune = [bool(s.prune_ok) for s in specs]
+    vq_lists = _prepare_vqueries(seg, ctx, lts, {}, prune=prune)
+    if vq_lists is None:
+        return None
+    results = _launch_pure_groups(seg, vq_lists, K)
+    redo = []
+    for qi, vqs in enumerate(vq_lists):
+        if vqs is None or len(vqs) != 1 or not vqs[0].head:
+            continue
+        vq = vqs[0]
+        if not vq.clamped:
+            continue                    # heads were the full rows: exact
+        sc, dc, total, _ = results[id(vq)]
+        ver = _verify_pruned(seg, vq, sc, dc, total,
+                             int(specs[qi].window or K), K)
+        if ver is None:
+            redo.append(qi)
+        else:
+            results[id(vq)] = ver
+    if redo:
+        STATS["pruned_escalated"] += len(redo)
+        dense_lists = _prepare_vqueries(seg, ctx, [lts[qi] for qi in redo],
+                                        {})
+        if dense_lists is None:
+            dense_lists = [None] * len(redo)
+        for qi, dvqs in zip(redo, dense_lists):
+            vq_lists[qi] = dvqs
+        results.update(_launch_pure_groups(seg, dense_lists, K))
+    STATS["pruned_served"] += sum(
+        1 for vqs in vq_lists
+        if vqs is not None and len(vqs) == 1 and vqs[0].head
+        and vqs[0].clamped)
     return _assemble(vq_lists, results, K)
 
 
@@ -512,8 +818,12 @@ def _assemble(vq_lists, results: dict, K: int, transform=None
         if vqs is None:
             out.append(None)
             continue
+        rel = "eq"
         if len(vqs) == 1:
-            sc, dc, total = results[id(vqs[0])]
+            entry = results[id(vqs[0])]
+            sc, dc, total = entry[0], entry[1], entry[2]
+            if len(entry) > 3:
+                rel = entry[3]
         else:
             parts = [results[id(v)] for v in vqs]
             sc_all = np.concatenate([p[0] for p in parts])
@@ -527,7 +837,7 @@ def _assemble(vq_lists, results: dict, K: int, transform=None
         total_i = int(total)
         ms = float(sc[0]) if total_i > 0 and np.isfinite(sc[0]) else -np.inf
         out.append({"topk_key": sc, "topk_idx": dc, "topk_scores": sc,
-                    "total": total_i, "max_score": ms})
+                    "total": total_i, "max_score": ms, "total_rel": rel})
     return out
 
 
@@ -914,10 +1224,10 @@ def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
     pure_idx = [i for i, s in enumerate(specs) if s.kind == "pure"]
     bool_idx = [i for i, s in enumerate(specs) if s.kind == "bool"]
     if pure_idx:
-        vq_lists = _prepare_vqueries(seg, ctx,
-                                     [specs[i].lt for i in pure_idx], {})
-        if vq_lists is not None:
-            for i, r in zip(pure_idx, _run_vqueries(seg, vq_lists, K)):
+        rs = _run_pure(seg, ctx, [specs[i].lt for i in pure_idx],
+                       [specs[i] for i in pure_idx], K)
+        if rs is not None:
+            for i, r in zip(pure_idx, rs):
                 out[i] = r
     if bool_idx:
         for i, r in zip(bool_idx,

@@ -1,0 +1,95 @@
+"""Child process for the real 2-process jax.distributed test
+(tests/test_multihost.py). Each process owns 4 virtual CPU devices; the two
+join a coordinator, form one 8-shard global mesh, contribute their local
+shards' postings, and run the SPMD distributed-search program whose
+collectives (DFS psum + all_gather top-k merge) cross the process boundary.
+Process 0 prints the result as one JSON line for the parent to check."""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))   # repo root, independent of cwd
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from opensearch_tpu.parallel import multihost
+
+    cfg = multihost.MultiHostConfig(
+        coordinator_address=f"localhost:{port}", num_processes=nproc,
+        process_id=pid, local_device_count=4)
+    multihost.initialize(cfg)
+    assert jax.process_count() == nproc
+    n_shards = cfg.global_device_count
+
+    import numpy as np
+
+    from opensearch_tpu.cluster.routing import shard_for
+    from opensearch_tpu.index.engine import Engine
+    from opensearch_tpu.index.mappings import Mappings
+    from opensearch_tpu.parallel.spmd import (StackedShardIndex,
+                                              build_distributed_search,
+                                              pack_query_batch)
+
+    # identical deterministic corpus on both processes; the host-side build
+    # is duplicated (cheap), but each process DEVICE-hosts only the shards
+    # whose mesh slot is local (multihost.put_global)
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(30)]
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    engines = [Engine(m) for _ in range(n_shards)]
+    for i in range(400):
+        did = str(i)
+        text = " ".join(rng.choice(words, size=int(rng.integers(3, 10))))
+        engines[shard_for(did, n_shards)].index_doc(did, {"body": text})
+    segs = []
+    for e in engines:
+        e.refresh()
+        e.force_merge(1)
+        segs.append(e.segments[0])
+
+    mesh = multihost.make_global_mesh(cfg, n_shards)
+    from jax.sharding import PartitionSpec as P
+
+    stacked = StackedShardIndex.build(segs, "body", mesh=None)
+    tree = {k: multihost.put_global(np.asarray(v), mesh, P("shard"))
+            for k, v in stacked.tree().items()}
+
+    QB, T, K = 4, 4, 8
+    queries = [["w1", "w2"], ["w3"], ["w5", "w7"], ["w2", "w9"]]
+    rows, boosts, msm = pack_query_batch(segs, "body", queries, QB, T)
+    g_rows = multihost.put_global(rows, mesh, P("shard", "replica"))
+    g_boosts = multihost.put_global(boosts, mesh, P("replica"))
+    g_msm = multihost.put_global(msm, mesh, P("replica"))
+
+    fn = build_distributed_search(mesh, bucket=512,
+                                  ndocs_pad=stacked.ndocs_pad, k=K)
+    gdocs, gvals, totals = fn(tree, g_rows, g_boosts, g_msm)
+    gdocs = np.asarray(gdocs)
+    gvals = np.asarray(gvals)
+    totals = np.asarray(totals)
+
+    if pid == 0:
+        # global doc ids -> engine doc ids for a process-independent check
+        bases = np.cumsum([0] + [s.ndocs for s in segs])
+        out = []
+        for qi in range(QB):
+            ids = []
+            for g, v in zip(gdocs[qi], gvals[qi]):
+                if g < 0 or not np.isfinite(v):
+                    continue
+                si = int(np.searchsorted(bases, g, side="right") - 1)
+                ids.append([segs[si].ids[int(g - bases[si])], float(v)])
+            out.append({"total": int(totals[qi]), "hits": ids})
+        print("RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
