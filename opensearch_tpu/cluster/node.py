@@ -425,6 +425,14 @@ class Node:
                 p = os.path.join(self.data_path, name)
                 if os.path.exists(p):
                     shutil.rmtree(p)
+            # a deleted index must not resurrect from the remote mirror on
+            # the next restart, and a re-created index must not inherit a
+            # stale mirror generation
+            self.remote_stores.pop(name, None)
+            if self.remote_root:
+                rp = os.path.join(self.remote_root, name)
+                if os.path.exists(rp):
+                    shutil.rmtree(rp, ignore_errors=True)
         self.metadata.aliases = {a: am for a, am in self.metadata.aliases.items()
                                  if am.indices}
         if not _ds_guard:

@@ -122,6 +122,7 @@ class RemoteSegmentStore:
             if os.path.exists(mpath):
                 with open(mpath) as fh:
                     prev = json.load(fh)["files"]
+        new_gen = gen + 1
         files: Dict[str, dict] = {}
         try:
             for rel in self._committed_files(local_path):
@@ -137,25 +138,34 @@ class RemoteSegmentStore:
                     t.files_skipped += 1
                     continue
                 digest = _md5(src)
-                files[rel] = {"size": size, "md5": digest,
-                              "mtime": st.st_mtime_ns}
                 if old and old["size"] == size and old["md5"] == digest:
+                    files[rel] = dict(old, mtime=st.st_mtime_ns)
                     t.files_skipped += 1   # touched but identical content
                     continue
-                dst = os.path.join(fdir, rel)
+                # changed content goes to a NEW generation-suffixed blob —
+                # never overwrite a path the previous manifest references,
+                # or a crash mid-upload would corrupt the restorable
+                # generation (commit.json changes every flush)
+                stored = f"{rel}.g{new_gen}" if old else rel
+                files[rel] = {"size": size, "md5": digest,
+                              "mtime": st.st_mtime_ns, "path": stored}
+                dst = os.path.join(fdir, stored)
                 os.makedirs(os.path.dirname(dst), exist_ok=True)
                 shutil.copy2(src, dst)
                 t.files_uploaded += 1
                 t.bytes_uploaded += size
-            new_gen = gen + 1
             _atomic_json(os.path.join(sdir, f"manifest-{new_gen}.json"),
                          {"files": files, "ts": time.time()})
             _atomic_json(latest, {"gen": new_gen})
             # prune ONLY after the new generation is live: a crash anywhere
-            # above leaves the previous manifest's files intact, so the
+            # above leaves the previous manifest's blobs intact, so the
             # prior generation stays fully restorable (two-phase commit)
-            for rel in set(prev) - set(files):
-                stale = os.path.join(fdir, rel)
+            live_paths = {f.get("path", rel) for rel, f in files.items()}
+            for rel, f in prev.items():
+                stored = f.get("path", rel)
+                if stored in live_paths:
+                    continue
+                stale = os.path.join(fdir, stored)
                 if os.path.exists(stale):
                     os.remove(stale)
                 # drop now-empty segment dirs so the mirror mirrors
@@ -212,8 +222,8 @@ class RemoteSegmentStore:
         with open(os.path.join(sdir, f"manifest-{gen}.json")) as fh:
             files = json.load(fh)["files"]
         n = 0
-        for rel in files:
-            src = os.path.join(sdir, "files", rel)
+        for rel, meta in files.items():
+            src = os.path.join(sdir, "files", meta.get("path", rel))
             dst = os.path.join(dest_path, rel)
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             shutil.copy2(src, dst)

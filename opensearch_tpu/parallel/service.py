@@ -25,7 +25,7 @@ import numpy as np
 from ..index.segment import next_pow2
 from .spmd import StackedShardIndex, build_distributed_search, make_mesh
 
-MAX_WINDOW = 128
+MAX_WINDOW = 1024
 
 
 class MeshSearchService:
@@ -78,15 +78,18 @@ class MeshSearchService:
 
     def try_search(self, name: str, svc, body: dict) -> Optional[dict]:
         """One index, one term-group query -> full search response via the
-        mesh, or None to fall back to the host shard loop."""
+        mesh, or None to fall back to the host shard loop. Served shapes:
+        scoring term groups (term/terms/match, any minimum_should_match)
+        AND filter-context groups (`terms`, constant_score term sets) via
+        the program's constant-score flag; shards may hold several
+        segments (stacked as one concatenated CSR per shard); windows up
+        to MAX_WINDOW."""
         from ..search import compiler as C
-        from ..search import fastpath
         from ..search import query_dsl as dsl
         from ..search.executor import (Candidate, ShardQueryResult,
                                        _finish_search, _global_stats_contexts,
                                        _host_sort_values, _norm_sort_specs,
                                        parse_aggs, _collect_named)
-
         t0 = time.monotonic()
         searchers = svc.searchers
         # the mesh program earns its keep on SHARDED indices (per-shard
@@ -95,17 +98,10 @@ class MeshSearchService:
         if svc.meta.num_shards < 2:
             self.fallbacks += 1
             return None
-        # mesh-ready layout: every shard exactly one segment (steady state
-        # after refresh+merge; reference analog: one Lucene reader per shard)
-        segments = []
-        for s in searchers:
-            if len(s.engine.segments) != 1:
-                self.fallbacks += 1
-                return None
-            segments.append(s.engine.segments[0])
-        if not segments:
-            self.fallbacks += 1
-            return None
+        # a shard may hold any number of segments (incl. zero for routing
+        # holes) — the stacked index concatenates them per shard
+        shard_segs = [[g for g in s.engine.segments if g.live_count > 0]
+                      for s in searchers]
 
         stats = _global_stats_contexts(searchers)
         ctx = stats[0]
@@ -114,65 +110,71 @@ class MeshSearchService:
         except dsl.QueryParseError:
             self.fallbacks += 1
             return None
-        if body.get("knn") or body.get("rescore") or body.get("min_score") \
-                is not None or body.get("profile"):
-            self.fallbacks += 1
-            return None
         lroot = C.rewrite(query, ctx, scoring=True)
         sort_specs = _norm_sort_specs(body)
         agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
         window = int(body.get("from", 0)) + int(body.get("size", 10))
-        if not fastpath.query_eligible(lroot, sort_specs, agg_nodes,
-                                       _collect_named(lroot),
-                                       body.get("search_after"), window,
-                                       body):
-            self.fallbacks += 1
-            return None
         lt = lroot
-        field = lt.field
-        if getattr(lt, "raw_boosts", None) is None:
+        if not self._eligible(lt, sort_specs, agg_nodes,
+                              _collect_named(lroot), body, window):
             self.fallbacks += 1
             return None
+        field = lt.field
+        const_score = 0.0
+        if lt.mode == "filter":
+            # filter-context term group (`terms` query): constant score,
+            # doc-id tie order — handled inside the SPMD program
+            const_score = float(getattr(lt, "boost", 1.0) or 1.0)
 
-        stacked = self._stacked_for(name, svc, field, segments)
+        stacked = self._stacked_for(name, svc, field, shard_segs)
         if stacked is None:
             self.fallbacks += 1
             return None
 
-        S = len(segments)
+        S = len(shard_segs)
         nt = len(lt.terms)
         T_pad = next_pow2(nt, floor=1)
         rows = np.full((S, 1, T_pad), -1, np.int32)
         total_max = 1
-        for si, seg in enumerate(segments):
-            pb = seg.postings.get(field)
+        for si in range(S):
             tot = 0
             for ti, t in enumerate(lt.terms):
-                r = pb.row(t) if pb is not None else -1
+                r = stacked.row(si, t)
                 rows[si, 0, ti] = r
-                if r >= 0:
-                    a, bnd = pb.row_slice(r)
-                    tot += bnd - a
+                tot += stacked.row_size(si, r)
             total_max = max(total_max, tot)
         bucket = next_pow2(total_max, floor=256)
         boosts = np.zeros((1, T_pad), np.float32)
         boosts[0, :nt] = lt.raw_boosts[:nt]
         msm = np.full(1, float(lt.msm), np.float32)
+        cscore = np.full(1, const_score, np.float32)
         K = min(next_pow2(max(window, 16)), MAX_WINDOW, stacked.ndocs_pad)
+        if window > K:
+            # the program's merged output has only K slots; a deeper page
+            # than K (tiny shards) must take the host loop or the page
+            # would silently truncate
+            self.fallbacks += 1
+            return None
         sim = lt.sim
-        b_eff = float(sim.b) if lt.has_norms else 0.0
+        k1 = float(sim.k1) if sim is not None else 1.2
+        b_eff = (float(sim.b)
+                 if sim is not None and lt.has_norms else 0.0)
 
         mesh = self._mesh_for(S)
-        fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K,
-                               float(sim.k1), b_eff)
-        gdocs, gvals, totals = fn(stacked.tree(), rows, boosts, msm)
+        if mesh is None:
+            self.fallbacks += 1
+            return None
+        fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K, k1, b_eff)
+        gdocs, gvals, totals = fn(stacked.tree(), rows, boosts, msm, cscore)
         gdocs = np.asarray(gdocs)[0]
         gvals = np.asarray(gvals)[0]
         total = int(np.asarray(totals)[0])
 
-        # global doc ids -> (shard, local doc) -> candidates
+        # global doc ids -> (shard, segment, local doc) -> candidates
         doc_base = np.asarray(stacked.doc_base)
-        results = [ShardQueryResult(shard=i, segments=[segments[i]])
+        seg_bases = [np.cumsum([0] + ndocs[:-1])
+                     for ndocs in stacked.seg_ndocs]
+        results = [ShardQueryResult(shard=i, segments=list(shard_segs[i]))
                    for i in range(S)]
         results[0].total = total
         max_score = float(gvals[0]) if total > 0 and np.isfinite(gvals[0]) \
@@ -182,20 +184,60 @@ class MeshSearchService:
             if not np.isfinite(gvals[j]) or gdocs[j] < 0:
                 continue
             si = int(np.searchsorted(doc_base, gdocs[j], "right") - 1)
-            local = int(gdocs[j] - doc_base[si])
-            seg = segments[si]
+            in_shard = int(gdocs[j] - doc_base[si])
+            seg_ord = int(np.searchsorted(seg_bases[si], in_shard,
+                                          "right") - 1)
+            local = in_shard - int(seg_bases[si][seg_ord])
+            seg = shard_segs[si][seg_ord]
             if local >= seg.ndocs:
                 continue
             sc = float(gvals[j])
             sort_vals, raw_vals = _host_sort_values(sort_specs, seg, local, sc)
             results[si].candidates.append(
-                Candidate(si, 0, local, sc, sort_vals, raw_vals))
+                Candidate(si, seg_ord, local, sc, sort_vals, raw_vals))
         for r in results:
             r.took_ms = (time.monotonic() - t0) * 1000.0
         self.dispatched += 1
         body = dict(body)
         body["_index_name"] = name
         return _finish_search(searchers, results, body, stats, name, t0, [])
+
+    def _eligible(self, lt, sort_specs, agg_nodes, named_nodes, body,
+                  window: int) -> bool:
+        """Mesh-servable shapes: a single term group (scoring OR filter
+        mode), plain relevance order, no secondary features."""
+        from ..search import compiler as C
+        from ..search.fastpath import MAX_T
+        from ..ops import scoring as ops
+
+        if body.get("knn") or body.get("rescore") or body.get("min_score") \
+                is not None or body.get("profile") or body.get("collapse") \
+                or body.get("suggest") or body.get("search_after") is not None:
+            return False
+        if agg_nodes or named_nodes:
+            return False
+        if window > MAX_WINDOW or window < 1:
+            return False
+        if sort_specs and not (len(sort_specs) == 1
+                               and sort_specs[0]["field"] == "_score"
+                               and sort_specs[0].get("order", "desc")
+                               == "desc"):
+            return False
+        if not isinstance(lt, C.LTerms):
+            return False
+        if lt.mode not in ("score", "filter"):
+            return False
+        if lt.mode == "score" and (lt.sim is None
+                                   or lt.sim.sim_id != ops.SIM_BM25):
+            return False
+        nt = len(lt.terms)
+        if nt < 1 or next_pow2(nt, floor=1) > MAX_T:
+            return False
+        if getattr(lt, "raw_boosts", None) is None:
+            return False
+        if lt.aux is not None and np.any(np.asarray(lt.aux)[:nt] != 0.0):
+            return False
+        return True
 
     def stats(self) -> dict:
         return {"devices": len(self.devices), "dispatched": self.dispatched,

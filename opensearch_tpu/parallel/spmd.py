@@ -53,7 +53,13 @@ def make_mesh(n_replica: int = 1, n_shard: Optional[int] = None,
 class StackedShardIndex:
     """N doc-shards of one field's postings + norms, padded to common shapes
     and stacked on a leading axis sharded over the mesh `shard` axis. This is
-    the device-resident form the SPMD query program consumes."""
+    the device-resident form the SPMD query program consumes.
+
+    A shard may hold SEVERAL segments: their postings concatenate into one
+    per-shard CSR on the host (term dict = union, doc ids offset by the
+    segment's base within the shard) — the mesh analog of the reference's
+    per-shard multi-leaf reader, built once per index generation and cached
+    by the MeshSearchService."""
 
     field: str
     starts: jnp.ndarray     # i32[S, R_pad]
@@ -67,16 +73,32 @@ class StackedShardIndex:
     field_dc: jnp.ndarray   # f32[S] docs WITH this field (text_stats doc_count)
     n_shards: int
     ndocs_pad: int
+    # host-side query-resolution metadata (term -> per-shard CSR row, and
+    # row sizes for DMA bucket sizing)
+    host_terms: Optional[List[Dict[str, int]]] = None
+    host_starts: Optional[List[np.ndarray]] = None
+    # (shard, segment) decomposition for mapping global ids back to
+    # (segment, local doc) at fetch: per shard, the ndocs of each segment
+    seg_ndocs: Optional[List[List[int]]] = None
+
+    def row(self, shard: int, term: str) -> int:
+        return self.host_terms[shard].get(term, -1)
+
+    def row_size(self, shard: int, row: int) -> int:
+        st = self.host_starts[shard]
+        return int(st[row + 1] - st[row]) if 0 <= row < len(st) - 1 else 0
 
     @classmethod
-    def build(cls, segments: List[Segment], field: str,
+    def build(cls, shards, field: str,
               mesh: Optional[Mesh] = None) -> "StackedShardIndex":
-        S = len(segments)
-        r_pad = max(next_pow2(s.postings[field].nterms + 2) for s in segments
-                    if field in s.postings)
-        p_pad = max(next_pow2(max(s.postings[field].size, 1)) for s in segments
-                    if field in s.postings)
-        d_pad = max(s.ndocs_pad for s in segments)
+        """`shards`: List[Segment] (one per shard) or List[List[Segment]]."""
+        shard_segs: List[List[Segment]] = [
+            list(s) if isinstance(s, (list, tuple)) else [s] for s in shards]
+        S = len(shard_segs)
+        merged = [_concat_shard(segs, field) for segs in shard_segs]
+        r_pad = max(next_pow2(len(m["starts"]) + 1) for m in merged)
+        p_pad = max(next_pow2(max(len(m["doc_ids"]), 1)) for m in merged)
+        d_pad = next_pow2(max(max(m["ndocs"] for m in merged), 1))
         starts = np.zeros((S, r_pad), np.int32)
         doc_ids = np.full((S, p_pad), INT32_SENTINEL, np.int32)
         tfs = np.zeros((S, p_pad), np.float32)
@@ -86,25 +108,25 @@ class StackedShardIndex:
         doc_count = np.zeros(S, np.float32)
         sum_dl = np.zeros(S, np.float32)
         field_dc = np.zeros(S, np.float32)
+        host_terms, host_starts, seg_ndocs = [], [], []
         base = 0
-        for i, seg in enumerate(segments):
-            pb = seg.postings.get(field)
-            if pb is not None:
-                n = pb.nterms
-                starts[i, : n + 1] = pb.starts
-                starts[i, n + 1:] = pb.size
-                doc_ids[i, : pb.size] = pb.doc_ids
-                tfs[i, : pb.size] = pb.tfs
-            sdl = seg.doc_lens.get(field)
-            if sdl is not None:
-                dl[i, : seg.ndocs] = sdl
-            live[i, : seg.ndocs] = seg.live.astype(np.float32)
+        for i, m in enumerate(merged):
+            n = len(m["starts"]) - 1
+            starts[i, : n + 1] = m["starts"]
+            starts[i, n + 1:] = m["starts"][-1]
+            np_ = len(m["doc_ids"])
+            doc_ids[i, :np_] = m["doc_ids"]
+            tfs[i, :np_] = m["tfs"]
+            dl[i, : m["ndocs"]] = m["dl"]
+            live[i, : m["ndocs"]] = m["live"]
             doc_base[i] = base
-            base += seg.ndocs
-            doc_count[i] = seg.live_count
-            st = seg.text_stats.get(field)
-            sum_dl[i] = st.sum_dl if st else 0
-            field_dc[i] = st.doc_count if st else 0
+            base += m["ndocs"]
+            doc_count[i] = m["live_count"]
+            sum_dl[i] = m["sum_dl"]
+            field_dc[i] = m["field_dc"]
+            host_terms.append(m["terms"])
+            host_starts.append(m["starts"])
+            seg_ndocs.append([s.ndocs for s in shard_segs[i]])
         arrays = dict(starts=starts, doc_ids=doc_ids, tfs=tfs, dl=dl, live=live,
                       doc_base=doc_base, doc_count=doc_count, sum_dl=sum_dl,
                       field_dc=field_dc)
@@ -113,13 +135,89 @@ class StackedShardIndex:
             arrays = {k: jax.device_put(v, sharding) for k, v in arrays.items()}
         else:
             arrays = {k: jnp.asarray(v) for k, v in arrays.items()}
-        return cls(field=field, n_shards=S, ndocs_pad=d_pad, **arrays)
+        return cls(field=field, n_shards=S, ndocs_pad=d_pad,
+                   host_terms=host_terms, host_starts=host_starts,
+                   seg_ndocs=seg_ndocs, **arrays)
 
     def tree(self) -> dict:
         return {"starts": self.starts, "doc_ids": self.doc_ids, "tfs": self.tfs,
                 "dl": self.dl, "live": self.live, "doc_base": self.doc_base,
                 "doc_count": self.doc_count, "sum_dl": self.sum_dl,
                 "field_dc": self.field_dc}
+
+
+def _concat_shard(segs: List[Segment], field: str) -> dict:
+    """One shard's segments -> a single host CSR view: union term dict,
+    per-term postings concatenated segment-by-segment with doc offsets.
+    An empty shard yields a zero-doc entry (all terms absent)."""
+    if not segs:
+        return {"terms": {}, "starts": np.zeros(1, np.int64),
+                "doc_ids": np.zeros(0, np.int32),
+                "tfs": np.zeros(0, np.float32),
+                "dl": np.zeros(0, np.float32),
+                "live": np.zeros(0, np.float32), "ndocs": 0,
+                "live_count": 0.0, "sum_dl": 0.0, "field_dc": 0.0}
+    ndocs = sum(s.ndocs for s in segs)
+    live = np.zeros(ndocs, np.float32)
+    dl = np.zeros(ndocs, np.float32)
+    off = 0
+    sum_dl = 0.0
+    field_dc = 0.0
+    live_count = 0.0
+    for s in segs:
+        live[off: off + s.ndocs] = s.live.astype(np.float32)
+        sdl = s.doc_lens.get(field)
+        if sdl is not None:
+            dl[off: off + s.ndocs] = sdl
+        st = s.text_stats.get(field)
+        if st:
+            sum_dl += st.sum_dl
+            field_dc += st.doc_count
+        live_count += s.live_count
+        off += s.ndocs
+    pbs = [s.postings.get(field) for s in segs]
+    if len(segs) == 1 and pbs[0] is not None:
+        pb = pbs[0]
+        return {"terms": pb.terms, "starts": pb.starts.astype(np.int64),
+                "doc_ids": pb.doc_ids, "tfs": pb.tfs, "dl": dl, "live": live,
+                "ndocs": ndocs, "live_count": live_count, "sum_dl": sum_dl,
+                "field_dc": field_dc}
+    vocab: Dict[str, int] = {}
+    for pb in pbs:
+        if pb is None:
+            continue
+        for t in pb.vocab:
+            vocab.setdefault(t, len(vocab))
+    nterms = len(vocab)
+    # vectorized merge: per-posting (target row, offset doc) keys, one
+    # stable argsort — no per-term Python loop (a vocabulary can be 10^5+)
+    trows_parts, docs_parts, tfs_parts = [], [], []
+    off = 0
+    for s, pb in zip(segs, pbs):
+        if pb is not None and pb.size:
+            rows = np.array([vocab[t] for t in pb.vocab], np.int64)
+            trows_parts.append(np.repeat(rows, np.diff(pb.starts)))
+            docs_parts.append(pb.doc_ids.astype(np.int64) + off)
+            tfs_parts.append(pb.tfs)
+        off += s.ndocs
+    if trows_parts:
+        trows = np.concatenate(trows_parts)
+        docs_all = np.concatenate(docs_parts)
+        tfs_all = np.concatenate(tfs_parts)
+        order = np.lexsort((docs_all, trows))
+        doc_ids = docs_all[order].astype(np.int32)
+        tfs = tfs_all[order]
+        lens = np.bincount(trows, minlength=nterms)
+    else:
+        doc_ids = np.zeros(0, np.int32)
+        tfs = np.zeros(0, np.float32)
+        lens = np.zeros(nterms, np.int64)
+    starts = np.zeros(nterms + 1, np.int64)
+    np.cumsum(lens, out=starts[1:])
+    return {"terms": vocab, "starts": starts, "doc_ids": doc_ids, "tfs": tfs,
+            "dl": dl, "live": live, "ndocs": ndocs,
+            "live_count": live_count, "sum_dl": sum_dl,
+            "field_dc": field_dc}
 
 
 def _local_gather(starts, doc_ids, tfs, rows, bucket: int):
@@ -142,9 +240,12 @@ def _local_gather(starts, doc_ids, tfs, rows, bucket: int):
 
 
 def _score_one_query(starts, doc_ids, tfs, dl, live, rows, boosts, msm,
-                     n_global, df_global, avgdl, bucket: int, ndocs_pad: int,
-                     k1: float, b: float):
-    """Shard-local BM25 scoring of one query with *global* statistics."""
+                     cscore, n_global, df_global, avgdl, bucket: int,
+                     ndocs_pad: int, k1: float, b: float):
+    """Shard-local BM25 scoring of one query with *global* statistics.
+    `cscore > 0` switches the query to constant-score semantics (filter
+    context / `terms` queries): every doc matching >= msm terms scores
+    exactly `cscore`, so top-k tie-breaks by doc id like the host path."""
     idf = jnp.log1p((n_global - df_global + 0.5) / (df_global + 0.5))
     w = jnp.where(df_global > 0, boosts * idf, 0.0)
     docs, tf, t_idx, valid = _local_gather(starts, doc_ids, tfs, rows, bucket)
@@ -158,18 +259,20 @@ def _score_one_query(starts, doc_ids, tfs, dl, live, rows, boosts, msm,
     counts = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(
         jnp.where(valid & (tf > 0), 1.0, 0.0), mode="drop")
     ok = (counts >= msm) & (live > 0)
+    scores = jnp.where(cscore > 0.0, cscore, scores)
     return jnp.where(ok, scores, -jnp.inf)
 
 
 def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
                              k1: float = 1.2, b: float = 0.75):
     """Returns a jitted SPMD function:
-        (index_tree, rows [S,QB,T], boosts [QB,T], msm [QB]) ->
+        (index_tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB]) ->
         (global_doc_ids [QB,k], scores [QB,k], total_hits [QB])
     Queries are sharded over `replica`, docs over `shard`; `rows` carries the
-    per-shard term-dict resolution so it is sharded over BOTH axes."""
+    per-shard term-dict resolution so it is sharded over BOTH axes. `cscore`
+    (optional; zeros = BM25) switches a query to constant-score semantics."""
 
-    def per_device(tree, rows, boosts, msm):
+    def per_device(tree, rows, boosts, msm, cscore):
         # leading stacked-shard axis is size-1 inside the shard_map block
         rows = rows[0]
         starts = tree["starts"][0]
@@ -194,10 +297,10 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
 
         # --- QUERY phase: vmap over the local query batch ---
         scores = jax.vmap(
-            lambda r, w, m, dfg: _score_one_query(
-                starts, doc_ids, tfs, dl, live, r, w, m, n_global, dfg,
+            lambda r, w, m, cs, dfg: _score_one_query(
+                starts, doc_ids, tfs, dl, live, r, w, m, cs, n_global, dfg,
                 avgdl, bucket, ndocs_pad, k1, b)
-        )(rows, boosts, msm, df_global)                               # [QBl, D]
+        )(rows, boosts, msm, cscore, df_global)                       # [QBl, D]
 
         totals_local = jnp.sum(scores > -jnp.inf, axis=1)
         totals = jax.lax.psum(totals_local, "shard")
@@ -223,10 +326,17 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
                   "doc_count", "sum_dl", "field_dc")}
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(tree_spec, P("shard", "replica"), P("replica"),
-                             P("replica")),
+                             P("replica"), P("replica")),
                    out_specs=(P("replica"), P("replica"), P("replica")),
                    check_vma=False)
-    return jax.jit(fn)
+    jitted = jax.jit(fn)
+
+    def call(tree, rows, boosts, msm, cscore=None):
+        if cscore is None:
+            cscore = jnp.zeros_like(jnp.asarray(msm))
+        return jitted(tree, rows, boosts, msm, cscore)
+
+    return call
 
 
 def build_term_sharded_score(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,

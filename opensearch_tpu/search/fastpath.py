@@ -32,7 +32,9 @@ MAX_T = 8            # pow2-padded term slots per query group
 MAX_L = 1 << 16      # per-term VMEM bucket cap (elements)
 MAX_TL = 1 << 17     # T_pad * L cap (~16MB VMEM incl. merge working set)
 MAX_K = 128          # top-k lanes the kernel returns
-MAX_CHUNKS = 64      # doc-range split bound for huge posting rows
+MAX_CHUNKS = 256     # doc-range split bound: covers a stopword-class row of
+                     # ~16M postings (256 x 64K) so even an every-doc term
+                     # stays on-kernel when a pruned query escalates dense
 INT_MAX = np.int32(2**31 - 1)
 
 # Impact-ordered head pruning (the device analog of Lucene's block-max

@@ -201,7 +201,9 @@ class TestMeshService:
                 "mappings": {"properties": {
                     "cat": {"type": "keyword"}, "body": {"type": "text"}}}})
             bulk = []
-            for i in range(400):
+            # 1600 docs over 4 shards -> per-shard ndocs_pad 512, so deep
+            # windows (>128) stay mesh-servable (window <= K)
+            for i in range(1600):
                 bulk.append({"index": {"_index": "idx", "_id": str(i)}})
                 body = " ".join(rng.choice(WORDS, size=int(rng.integers(3, 12))))
                 if i == 7:
@@ -260,6 +262,64 @@ class TestMeshService:
         cm, _ = clients
         st = cm.node.stats()
         assert st["mesh"]["dispatched"] >= 1
+
+    @pytest.mark.parametrize("body", [
+        # filter-context terms query: constant score over the mesh
+        {"query": {"terms": {"cat": ["garden", "garage"]}}, "size": 10},
+        {"query": {"terms": {"body": ["alpha", "beta", "gamma"]}},
+         "size": 12},
+        # window beyond the old 128 cap
+        {"query": {"match": {"body": "alpha beta"}}, "size": 200},
+        {"query": {"match": {"body": "alpha"}}, "from": 150, "size": 40},
+    ])
+    def test_widened_shapes(self, clients, body):
+        cm, ch = clients
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            f"mesh path did not engage for {body}"
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        assert [h["_id"] for h in rm["hits"]["hits"]] == \
+            [h["_id"] for h in rh["hits"]["hits"]]
+        np.testing.assert_allclose(
+            np.array([h["_score"] for h in rm["hits"]["hits"]]),
+            np.array([h["_score"] for h in rh["hits"]["hits"]]), rtol=1e-5)
+
+    def test_multi_segment_parity(self, clients):
+        """Shards with several segments (no forcemerge) are stacked as one
+        concatenated CSR per shard — results must equal the host loop."""
+        cm, ch = clients
+        for c in (cm, ch):
+            c.indices.create("idxms", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "cat": {"type": "keyword"}, "body": {"type": "text"}}}})
+            rng = np.random.default_rng(17)
+            for wave in range(3):          # 3 refreshes -> multi-segment
+                bulk = []
+                for i in range(wave * 100, wave * 100 + 100):
+                    bulk.append({"index": {"_index": "idxms",
+                                           "_id": str(i)}})
+                    bulk.append({"body": " ".join(
+                        rng.choice(WORDS, size=int(rng.integers(3, 12)))),
+                        "cat": ("kitchen", "garden")[i % 2]})
+                c.bulk(bulk)
+                c.indices.refresh("idxms")
+        n_segs = max(len(s.engine.segments)
+                     for s in cm.node.indices["idxms"].searchers)
+        assert n_segs >= 2, "corpus failed to produce multi-segment shards"
+        for body in ({"query": {"match": {"body": "alpha beta"}}, "size": 10},
+                     {"query": {"term": {"cat": "kitchen"}}, "size": 10},
+                     {"query": {"terms": {"cat": ["garden"]}}, "size": 10}):
+            before = cm.node.mesh_service.dispatched
+            rm = cm.search(index="idxms", body=dict(body))
+            rh = ch.search(index="idxms", body=dict(body))
+            assert cm.node.mesh_service.dispatched == before + 1, \
+                f"mesh path did not engage for {body}"
+            assert rm["hits"]["total"] == rh["hits"]["total"]
+            assert [h["_id"] for h in rm["hits"]["hits"]] == \
+                [h["_id"] for h in rh["hits"]["hits"]]
 
     def test_deletes_parity(self, clients):
         """Soft-deleted docs must vanish from mesh results exactly as they do
