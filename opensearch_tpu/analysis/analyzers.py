@@ -72,6 +72,20 @@ def _builtin(name: str) -> Analyzer:
         # reference EnglishAnalyzerProvider: std -> lowercase -> stop -> porter
         return Analyzer(name, standard_tokenizer,
                         [lowercase_filter, make_stop_filter(), porter_stem_filter])
+    if name == "cjk":
+        # reference CjkAnalyzerProvider: width fold -> lowercase -> bigram
+        # -> stop (std tokenizer keeps CJK runs; the bigram filter splits)
+        from .unicode_plugins import cjk_bigram_filter, cjk_width_filter
+        return Analyzer(name, standard_tokenizer,
+                        [cjk_width_filter, lowercase_filter,
+                         cjk_bigram_filter, make_stop_filter()])
+    if name == "icu_analyzer":
+        # reference plugins/analysis-icu IcuAnalyzerProvider:
+        # nfkc_cf normalization + folding over the standard tokenizer
+        from .unicode_plugins import (icu_folding_filter,
+                                      icu_normalizer_char_filter)
+        return Analyzer(name, standard_tokenizer, [icu_folding_filter],
+                        [icu_normalizer_char_filter])
     raise ValueError(f"unknown analyzer [{name}]")
 
 

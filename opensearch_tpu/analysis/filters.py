@@ -437,6 +437,14 @@ def resolve_token_filter(name: str, params: dict | None = None) -> TokenFilter:
                                    params.get("output_unigrams", True))
     if name == "synonym":
         return make_synonym_filter(params.get("synonyms", []))
+    if name in ("icu_folding", "icu_normalizer", "cjk_width", "cjk_bigram"):
+        from .unicode_plugins import (cjk_bigram_filter, cjk_width_filter,
+                                      icu_folding_filter,
+                                      icu_normalizer_filter)
+        return {"icu_folding": icu_folding_filter,
+                "icu_normalizer": icu_normalizer_filter,
+                "cjk_width": cjk_width_filter,
+                "cjk_bigram": cjk_bigram_filter}[name]
     raise ValueError(f"unknown token filter [{name}]")
 
 
@@ -449,4 +457,7 @@ def resolve_char_filter(name: str, params: dict | None = None) -> CharFilter:
     if name == "pattern_replace":
         return make_pattern_replace_char_filter(params.get("pattern", ""),
                                                 params.get("replacement", ""))
+    if name == "icu_normalizer":
+        from .unicode_plugins import icu_normalizer_char_filter
+        return icu_normalizer_char_filter
     raise ValueError(f"unknown char filter [{name}]")

@@ -1,0 +1,102 @@
+"""ICU-class and CJK analysis — the stdlib-unicodedata rebuild of the
+reference's language-analysis plugins.
+
+Reference: `plugins/analysis-icu/` (ICUNormalizerCharFilterFactory,
+ICUFoldingTokenFilterFactory, ICUNormalizer2TokenFilterFactory) and the
+CJK pieces of `modules/analysis-common` (CJKWidthFilterFactory,
+CJKBigramFilterFactory, CjkAnalyzerProvider). The real plugins wrap ICU4J;
+Python's `unicodedata` provides the same Unicode database operations this
+engine needs: NFKC/NFKD normalization, case folding, combining-mark
+stripping, and width folding (NFKC subsumes half/full-width mapping).
+Transliteration (icu_transform) is out of scope.
+
+All functions are host-side string/token transforms — the device only ever
+sees term ids, so language analysis composes with every query/agg path
+unchanged.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import List
+
+from .tokenizers import Token
+
+
+# ---------------------------------------------------------------------
+# ICU analogs
+# ---------------------------------------------------------------------
+
+def icu_normalizer_char_filter(text: str) -> str:
+    """nfkc_cf: NFKC normalization + Unicode case folding (the ICU
+    plugin's default normalizer) applied BEFORE tokenization."""
+    return unicodedata.normalize("NFKC", text).casefold()
+
+
+def _fold(term: str) -> str:
+    """ICU folding: NFKD-decompose, drop combining marks (diacritics in
+    any script), recompose, case fold. Broader than asciifolding, which
+    only maps the Latin-1/Latin-A supplement."""
+    decomposed = unicodedata.normalize("NFKD", term)
+    stripped = "".join(ch for ch in decomposed
+                       if not unicodedata.combining(ch))
+    return unicodedata.normalize("NFKC", stripped).casefold()
+
+
+def icu_folding_filter(tokens: List[Token]) -> List[Token]:
+    return [t.with_text(_fold(t.text)) for t in tokens]
+
+
+def icu_normalizer_filter(tokens: List[Token]) -> List[Token]:
+    """Token-filter form of nfkc_cf (ICUNormalizer2TokenFilterFactory)."""
+    return [t.with_text(unicodedata.normalize("NFKC", t.text).casefold())
+            for t in tokens]
+
+
+# ---------------------------------------------------------------------
+# CJK analogs
+# ---------------------------------------------------------------------
+
+def cjk_width_filter(tokens: List[Token]) -> List[Token]:
+    """Full-width ASCII -> half-width, half-width katakana -> full-width:
+    exactly the NFKC mapping restricted to width variants; NFKC itself is
+    a superset and matches the reference filter on its test corpus."""
+    return [t.with_text(unicodedata.normalize("NFKC", t.text))
+            for t in tokens]
+
+
+def _is_cjk(ch: str) -> bool:
+    cp = ord(ch)
+    return (0x4E00 <= cp <= 0x9FFF or     # CJK unified
+            0x3400 <= cp <= 0x4DBF or     # ext A
+            0xF900 <= cp <= 0xFAFF or     # compat ideographs
+            0x3040 <= cp <= 0x30FF or     # hiragana + katakana
+            0xAC00 <= cp <= 0xD7AF)       # hangul syllables
+
+
+def cjk_bigram_filter(tokens: List[Token]) -> List[Token]:
+    """Split runs of CJK characters into overlapping bigrams (reference
+    CJKBigramFilter): 'こんにちは' -> こん んに にち ちは. Non-CJK tokens
+    pass through; a single CJK char emits as a unigram. Position
+    INCREMENTS from the input stream are preserved (a stopword gap stays a
+    gap, like Lucene's posIncAtt handling); each extra bigram of one token
+    advances the position by 1, shifting everything after it."""
+    out: List[Token] = []
+    prev_in = None     # previous input token position
+    prev_out = -1      # last emitted position
+    for t in tokens:
+        inc = t.position - prev_in if prev_in is not None else t.position + 1
+        prev_in = t.position
+        pos = prev_out + max(inc, 1)
+        text = t.text
+        if len(text) >= 2 and all(_is_cjk(c) for c in text):
+            for i in range(len(text) - 1):
+                out.append(Token(text[i: i + 2], pos + i,
+                                 t.start_offset + i,
+                                 t.start_offset + i + 2, t.keyword))
+            prev_out = pos + len(text) - 2
+        else:
+            out.append(Token(text, pos, t.start_offset, t.end_offset,
+                             t.keyword))
+            prev_out = pos
+    return out

@@ -342,11 +342,19 @@ class Node:
         # one-device-per-process reality
         from ..search import fastpath
         fastpath.set_breaker(self.breakers.breaker("fielddata"))
+        # persistent tasks (reference persistent/AllocatedPersistentTask):
+        # durable task table + resumable executors; built-in: reindex
+        from ..utils.persistent_tasks import PersistentTasksService
+        self.persistent_tasks = PersistentTasksService(data_path,
+                                                       self.thread_pools)
+        self.persistent_tasks.register_executor("reindex",
+                                                self._persistent_reindex)
         self.start_time = time.time()
         if data_path:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
             self._recover_data_streams()
+            self.persistent_tasks.resume_all()
 
     @staticmethod
     def _device_count() -> int:
@@ -665,39 +673,129 @@ class Node:
         return {"index": name, "restored_files": restored_files,
                 "shards": len(store.shard_ids())}
 
-    # ---------------- snapshots (reference snapshots/SnapshotsService) ----------------
+    # -------- persistent-task executors (persistent/ reference) --------
+
+    def _persistent_reindex(self, params: dict, progress: dict,
+                            checkpoint) -> dict:
+        """Resumable reindex: copies live docs of `source` into `dest` in
+        _id order, checkpointing the done-count per batch — a restart
+        resumes from the last checkpoint instead of starting over
+        (reference reindex runs as a persistent task for exactly this)."""
+        src = params["source"]
+        dest = params["dest"]
+        batch = int(params.get("batch", 500))
+        if src not in self.indices:
+            raise IndexNotFoundError(f"no such index [{src}]")
+        svc = self.indices[src]
+        # collect (id, segment ref, local) ONLY — sources are fetched per
+        # batch at write time, so memory stays O(ids), not O(corpus)
+        # (the reference streams scroll batches for the same reason)
+        refs = []
+        for sh in svc.shards:
+            for seg in sh.segments:
+                for local, did in enumerate(seg.ids):
+                    if seg.live[local]:
+                        refs.append((did, seg, local))
+        refs.sort(key=lambda t: t[0])
+        done = int(progress.get("docs", 0))
+        dsvc = self.index_service_for_write(dest)
+        while done < len(refs):
+            for did, seg, local in refs[done: done + batch]:
+                dsvc.route(did, None).index_doc(did,
+                                                dict(seg.sources[local]))
+            done = min(done + batch, len(refs))
+            checkpoint({"docs": done, "total": len(refs)})
+        dsvc.refresh()
+        dsvc.generation += 1
+        return {"docs": done, "total": len(refs)}
+
+    # ---------------- snapshots (reference snapshots/SnapshotsService +
+    # repositories/blobstore/BlobStoreRepository.java: incremental shard
+    # snapshots with per-file dedup) ----------------
 
     def snapshot(self, repo_path: str, snapshot_name: str,
                  indices: str = "_all") -> dict:
-        names = self.metadata.resolve(indices)
-        dest = os.path.join(repo_path, snapshot_name)
-        if os.path.exists(dest):
-            raise ResourceAlreadyExistsError(f"snapshot [{snapshot_name}] already exists")
-        os.makedirs(dest, exist_ok=True)
+        """Incremental, content-addressed snapshot: every file is stored
+        once per repository under blobs/<md5>; a snapshot is a manifest
+        mapping file paths to blob digests. Repeat snapshots of unchanged
+        indices copy ZERO segment bytes (segments are immutable), exactly
+        the reference's incremental shard-snapshot behavior."""
         import json
-        manifest = {"snapshot": snapshot_name, "indices": names,
-                    "ts": time.time(), "state": "SUCCESS"}
+
+        from ..index.remote import _md5
+        names = self.metadata.resolve(indices)
+        snaps_dir = os.path.join(repo_path, "snapshots")
+        blob_dir = os.path.join(repo_path, "blobs")
+        man_path = os.path.join(snaps_dir, f"{snapshot_name}.json")
+        if os.path.exists(man_path) or \
+                os.path.exists(os.path.join(repo_path, snapshot_name)):
+            raise ResourceAlreadyExistsError(
+                f"snapshot [{snapshot_name}] already exists")
+        if not self.data_path:
+            raise ClusterStateError("snapshots require a node data_path")
+        os.makedirs(snaps_dir, exist_ok=True)
+        os.makedirs(blob_dir, exist_ok=True)
+        files: Dict[str, dict] = {}
+        new_bytes = 0
+        shared_bytes = 0
         for name in names:
             svc = self.indices[name]
             svc.flush()
-            if self.data_path:
-                src = os.path.join(self.data_path, name)
-                shutil.copytree(src, os.path.join(dest, name))
-            else:
-                raise ClusterStateError("snapshots require a node data_path")
-        with open(os.path.join(dest, "manifest.json"), "w") as fh:
+            root = os.path.join(self.data_path, name)
+            for dirpath, _dirs, fnames in os.walk(root):
+                for fn in fnames:
+                    full = os.path.join(dirpath, fn)
+                    rel = os.path.join(name, os.path.relpath(full, root))
+                    digest = _md5(full)
+                    size = os.path.getsize(full)
+                    files[rel] = {"md5": digest, "size": size}
+                    blob = os.path.join(blob_dir, digest)
+                    if os.path.exists(blob):
+                        shared_bytes += size      # dedup hit (incremental)
+                    else:
+                        # atomic blob write: a crash mid-copy must never
+                        # leave a truncated file at the content address —
+                        # every later snapshot would dedup against it
+                        shutil.copy2(full, blob + ".tmp")
+                        os.replace(blob + ".tmp", blob)
+                        new_bytes += size
+        manifest = {"snapshot": snapshot_name, "indices": names,
+                    "files": files, "ts": time.time(), "state": "SUCCESS",
+                    "stats": {"new_bytes": new_bytes,
+                              "shared_bytes": shared_bytes,
+                              "file_count": len(files)}}
+        tmp = man_path + ".tmp"
+        with open(tmp, "w") as fh:
             json.dump(manifest, fh)
+        os.replace(tmp, man_path)
         return {"snapshot": {"snapshot": snapshot_name, "indices": names,
-                             "state": "SUCCESS"}}
+                             "state": "SUCCESS",
+                             "stats": manifest["stats"]}}
+
+    def _load_snapshot_manifest(self, repo_path: str, snapshot_name: str):
+        import json
+        man_path = os.path.join(repo_path, "snapshots",
+                                f"{snapshot_name}.json")
+        if os.path.exists(man_path):
+            with open(man_path) as fh:
+                return json.load(fh)
+        # legacy layout (pre-r4): <repo>/<name>/manifest.json + per-index
+        # directory copies — still restorable
+        legacy = os.path.join(repo_path, snapshot_name, "manifest.json")
+        if os.path.exists(legacy):
+            with open(legacy) as fh:
+                m = json.load(fh)
+            m["_legacy_dir"] = os.path.join(repo_path, snapshot_name)
+            return m
+        raise IndexNotFoundError(f"no such snapshot [{snapshot_name}]")
 
     def restore(self, repo_path: str, snapshot_name: str,
                 rename_pattern: Optional[str] = None,
                 rename_replacement: Optional[str] = None) -> dict:
         import json
         import re as _re
-        src = os.path.join(repo_path, snapshot_name)
-        with open(os.path.join(src, "manifest.json")) as fh:
-            manifest = json.load(fh)
+        manifest = self._load_snapshot_manifest(repo_path, snapshot_name)
+        blob_dir = os.path.join(repo_path, "blobs")
         restored = []
         for name in manifest["indices"]:
             target = name
@@ -706,9 +804,20 @@ class Node:
             if target in self.indices:
                 raise ResourceAlreadyExistsError(
                     f"cannot restore index [{target}]: already exists")
-            shutil.copytree(os.path.join(src, name),
-                            os.path.join(self.data_path, target))
-            # translog/commit are part of the copied state; recover normally
+            if "_legacy_dir" in manifest:
+                shutil.copytree(os.path.join(manifest["_legacy_dir"], name),
+                                os.path.join(self.data_path, target))
+            else:
+                prefix = name + os.sep
+                for rel, meta in manifest["files"].items():
+                    if not rel.startswith(prefix):
+                        continue
+                    dst = os.path.join(self.data_path, target,
+                                       rel[len(prefix):])
+                    os.makedirs(os.path.dirname(dst), exist_ok=True)
+                    shutil.copy2(os.path.join(blob_dir, meta["md5"]), dst)
+            # translog/commit are part of the restored state; recover
+            # normally
             meta_path = os.path.join(self.data_path, target, "index_meta.json")
             with open(meta_path) as fh:
                 saved = json.load(fh)
@@ -717,6 +826,7 @@ class Node:
                                                 self.data_path,
                                                 thread_pools=self.thread_pools)
             self.metadata.indices[target] = meta
+            self._attach_remote(target)
             restored.append(target)
         self.metadata.bump()
         return {"snapshot": {"snapshot": snapshot_name, "indices": restored,
@@ -874,6 +984,7 @@ class Node:
             "failure_detection": self.failure_detector.stats(),
             "wlm": self.wlm.stats(),
             "search_backpressure": self.search_backpressure.stats(),
+            "persistent_tasks": self.persistent_tasks.stats(),
             "uptime_in_millis": int((time.time() - self.start_time) * 1000),
         }
         if self.mesh_service is not None:

@@ -166,9 +166,11 @@ class MeshSearchService:
             return None
         fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K, k1, b_eff)
         gdocs, gvals, totals = fn(stacked.tree(), rows, boosts, msm, cscore)
-        gdocs = np.asarray(gdocs)[0]
-        gvals = np.asarray(gvals)[0]
-        total = int(np.asarray(totals)[0])
+        import jax
+        gdocs, gvals, totals = jax.device_get((gdocs, gvals, totals))
+        gdocs = gdocs[0]
+        gvals = gvals[0]
+        total = int(totals[0])
 
         # global doc ids -> (shard, segment, local doc) -> candidates
         doc_base = np.asarray(stacked.doc_base)

@@ -313,12 +313,15 @@ class RestClient:
                scroll: Optional[str] = None, **kw) -> dict:
         body = dict(body or {})
         body.update({k: v for k, v in kw.items() if v is not None})
-        # workload-group admission (reference wlm/): token-bucket rate limit
+        # workload-group admission (reference wlm/): token-bucket rate
+        # limit + resource-tracking QueryGroup enforcement
         group = body.pop("_workload_group", None)
+        wg = self.node.wlm.group(group)
         try:
-            self.node.wlm.group(group).admit_search()
+            wg.admit_search()
         except PressureRejectedException as e:
             raise ApiError(429, "rejected_execution_exception", str(e))
+        _wg_t0 = time.monotonic()
         if body.get("query") is not None:
             body["query"] = self._resolve_percolate_refs(body["query"])
         pit = body.pop("pit", None)
@@ -358,6 +361,11 @@ class RestClient:
             # search backpressure admission control (reference
             # ratelimitting/admissioncontrol)
             raise ApiError(429, "rejected_execution_exception", str(e))
+        finally:
+            # charge the group's resource tracker unconditionally — PIT
+            # searches and searches that FAIL after consuming device time
+            # must not bypass an enforced QueryGroup cap
+            wg.record(time.monotonic() - _wg_t0)
         resp = self._apply_response_pipeline(pipeline, resp, phase_ctx, body)
         if scroll:
             sid = uuid.uuid4().hex
@@ -892,7 +900,9 @@ class RestClient:
     def put_workload_group(self, name: str, body: Optional[dict] = None) -> dict:
         body = body or {}
         self.node.wlm.put_group(name, body.get("search_rate"),
-                                body.get("search_burst"))
+                                body.get("search_burst"),
+                                body.get("resource_limits"),
+                                body.get("mode", "monitor"))
         return {"acknowledged": True}
 
     # ---------------- search templates (reference modules/lang-mustache) ----
@@ -1532,8 +1542,22 @@ class SnapshotClient:
         import os
         repo = self.repos.get(repository)
         snaps = []
-        if repo and os.path.isdir(repo["location"]):
-            for name in sorted(os.listdir(repo["location"])):
+        if repo:
+            seen = set()
+            sdir = os.path.join(repo["location"], "snapshots")
+            if os.path.isdir(sdir):
+                for fn in sorted(os.listdir(sdir)):
+                    if fn.endswith(".json"):
+                        seen.add(fn[:-5])
+            # legacy (pre-r4) directory-layout snapshots stay listed
+            if os.path.isdir(repo["location"]):
+                for d in sorted(os.listdir(repo["location"])):
+                    if d in ("snapshots", "blobs"):
+                        continue
+                    if os.path.exists(os.path.join(repo["location"], d,
+                                                   "manifest.json")):
+                        seen.add(d)
+            for name in sorted(seen):
                 if snapshot in ("_all", "*") or name == snapshot:
                     snaps.append({"snapshot": name, "state": "SUCCESS"})
         return {"snapshots": snaps}

@@ -1,0 +1,277 @@
+"""HTTP wire layer over the REST façade — the network transport the
+reference exposes through `http/HttpServerTransport.java:1` +
+`rest/RestController.java:1`.
+
+A threaded stdlib HTTP server speaking the same JSON (and NDJSON for
+_bulk/_msearch) dialect as the dict-level `RestClient`. Concurrency
+contract: searches and reads run fully concurrently (the engine's query
+path is read-only over immutable segments and its caches are
+lock-guarded); mutating endpoints serialize on one node-wide write lock —
+the coarse version of the reference's per-shard write queues, documented
+and measured rather than implied.
+
+Usage:
+    srv = HttpServer(client)          # or HttpServer(port=9200)
+    port = srv.start()                # background thread, returns port
+    ... real HTTP against http://localhost:{port} ...
+    srv.stop()
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, unquote, urlparse
+
+from .client import ApiError, IndexNotFoundError, RestClient
+
+
+def _truthy(v) -> bool:
+    return str(v).lower() in ("1", "true", "yes", "")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "opensearch-tpu"
+
+    # quiet the default stderr access log
+    def log_message(self, fmt, *args):
+        pass
+
+    # ---------------- plumbing ----------------
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        return raw.decode("utf-8") if raw else ""
+
+    def _json_body(self) -> Optional[dict]:
+        raw = self._body()
+        if not raw.strip():
+            return None
+        return json.loads(raw)
+
+    def _ndjson_body(self):
+        return [json.loads(ln) for ln in self._body().splitlines()
+                if ln.strip()]
+
+    def _send(self, status: int, payload, content_type="application/json"):
+        if isinstance(payload, (dict, list)):
+            data = json.dumps(payload).encode("utf-8")
+        else:
+            data = str(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(data)
+
+    def _dispatch(self):
+        try:
+            url = urlparse(self.path)
+            parts = [unquote(p) for p in url.path.split("/") if p]
+            # keep_blank_values: the bare `?refresh` idiom must read as true
+            params = {k: v[0] for k, v in
+                      parse_qs(url.query, keep_blank_values=True).items()}
+            status, payload = self._route(self.command, parts, params)
+            self._send(status, payload)
+        except ApiError as e:
+            self._send(e.status, e.body())
+        except IndexNotFoundError as e:
+            self._send(404, {"error": {"type": "index_not_found_exception",
+                                       "reason": str(e)}, "status": 404})
+        except json.JSONDecodeError as e:
+            self._send(400, {"error": {"type": "parsing_exception",
+                                       "reason": str(e)}, "status": 400})
+        except ValueError as e:
+            self._send(400, {"error": {"type": "illegal_argument_exception",
+                                       "reason": str(e)}, "status": 400})
+        except Exception as e:                         # noqa: BLE001
+            self._send(500, {"error": {"type": type(e).__name__,
+                                       "reason": str(e)}, "status": 500})
+
+    do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+
+    # ---------------- routing ----------------
+
+    def _route(self, method: str, parts, params) -> Tuple[int, object]:
+        c: RestClient = self.server.client            # type: ignore
+        wlock = self.server.write_lock                # type: ignore
+
+        if not parts:
+            return 200, {"name": c.node.node_name,
+                         "cluster_name": c.node.metadata.cluster_name,
+                         "version": {"distribution": "opensearch-tpu"},
+                         "tagline": "TPU-native search"}
+
+        head = parts[0]
+        # ---- cluster-level ----
+        if head == "_cluster":
+            if len(parts) >= 2 and parts[1] == "health":
+                return 200, c.cluster.health(parts[2] if len(parts) > 2
+                                             else None)
+            if len(parts) >= 2 and parts[1] == "settings":
+                if method == "PUT":
+                    with wlock:
+                        return 200, c.cluster.put_settings(self._json_body())
+                return 200, c.cluster.get_settings()
+            raise ApiError(400, "illegal_argument_exception",
+                           f"unsupported _cluster route {parts}")
+        if head == "_nodes":
+            return 200, c.nodes_stats()
+        if head == "_cat":
+            kind = parts[1] if len(parts) > 1 else "indices"
+            fn = getattr(c.cat, kind, None)
+            if fn is None:
+                raise ApiError(400, "illegal_argument_exception",
+                               f"unknown _cat endpoint [{kind}]")
+            rows = fn()
+            if params.get("format") == "json":
+                return 200, rows
+            text = "\n".join(" ".join(str(v) for v in r.values())
+                             for r in rows)
+            return 200, text + "\n"
+        if head == "_search":
+            return 200, c.search("_all", self._json_body() or {})
+        if head == "_msearch":
+            return 200, c.msearch(self._ndjson_body())
+        if head == "_bulk":
+            with wlock:
+                return 200, c.bulk(self._ndjson_body(),
+                                   refresh=_truthy(params.get("refresh",
+                                                              "false")))
+        if head == "_mget":
+            return 200, c.mget(self._json_body())
+        if head == "_stats":
+            return 200, c.node.stats()
+        if head == "_remotestore":
+            if len(parts) > 1 and parts[1] == "_restore":
+                with wlock:
+                    return 200, c.remotestore_restore(self._json_body() or {})
+        if head == "_index_template" and len(parts) == 2:
+            if method == "PUT":
+                with wlock:
+                    return 200, c.indices.put_index_template(
+                        parts[1], self._json_body())
+            if method == "HEAD":
+                return (200 if c.indices.exists_index_template(parts[1])
+                        else 404), {}
+            if method == "DELETE":
+                with wlock:
+                    return 200, c.indices.delete_index_template(parts[1])
+
+        # ---- index-level: /{index}[/...] ----
+        index = head
+        rest = parts[1:]
+        if not rest:
+            if method == "PUT":
+                with wlock:
+                    return 200, c.indices.create(index, self._json_body())
+            if method == "DELETE":
+                with wlock:
+                    return 200, c.indices.delete(index)
+            if method == "HEAD":
+                return (200 if c.indices.exists(index) else 404), {}
+            return 200, c.indices.get(index)
+
+        op = rest[0]
+        if op == "_doc":
+            doc_id = rest[1] if len(rest) > 1 else None
+            refresh = _truthy(params.get("refresh", "false"))
+            if method in ("PUT", "POST"):
+                with wlock:
+                    resp = c.index(index, self._json_body() or {},
+                                   id=doc_id, refresh=refresh,
+                                   routing=params.get("routing"))
+                # reference: 201 on create, 200 on overwrite-update
+                return (201 if resp.get("result") == "created"
+                        else 200), resp
+            if method == "GET":
+                return 200, c.get(index, doc_id,
+                                  routing=params.get("routing"))
+            if method == "HEAD":
+                return (200 if c.exists(index, doc_id) else 404), {}
+            if method == "DELETE":
+                with wlock:
+                    return 200, c.delete(index, doc_id,
+                                         routing=params.get("routing"))
+        if op == "_create" and len(rest) > 1:
+            with wlock:
+                return 201, c.create(index, rest[1], self._json_body() or {})
+        if op == "_update" and len(rest) > 1:
+            with wlock:
+                return 200, c.update(index, rest[1], self._json_body() or {},
+                                     routing=params.get("routing"))
+        if op == "_search":
+            return 200, c.search(index, self._json_body() or {})
+        if op == "_msearch":
+            body = self._ndjson_body()
+            return 200, c.msearch(body, index=index)
+        if op == "_count":
+            return 200, c.count(index, self._json_body())
+        if op == "_bulk":
+            with wlock:
+                return 200, c.bulk(self._ndjson_body(), index=index,
+                                   refresh=_truthy(params.get("refresh",
+                                                              "false")))
+        if op == "_refresh":
+            with wlock:
+                return 200, c.indices.refresh(index)
+        if op == "_flush":
+            with wlock:
+                return 200, c.indices.flush(index)
+        if op == "_forcemerge":
+            with wlock:
+                return 200, c.indices.forcemerge(index)
+        if op == "_mapping":
+            if method == "PUT":
+                with wlock:
+                    return 200, c.indices.put_mapping(index,
+                                                      self._json_body())
+            return 200, c.indices.get_mapping(index)
+        if op == "_settings":
+            if method == "PUT":
+                with wlock:
+                    return 200, c.indices.put_settings(index,
+                                                       self._json_body())
+            return 200, c.indices.get_settings(index)
+        if op == "_open":
+            with wlock:
+                return 200, c.indices.open(index)
+        if op == "_close":
+            with wlock:
+                return 200, c.indices.close(index)
+        raise ApiError(400, "illegal_argument_exception",
+                       f"unsupported route {method} /{'/'.join(parts)}")
+
+
+class HttpServer:
+    """Threaded HTTP transport bound to a RestClient."""
+
+    def __init__(self, client: Optional[RestClient] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.client = client or RestClient()
+        self.host = host
+        self.port = port
+        self._srv: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._srv = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._srv.client = self.client                 # type: ignore
+        self._srv.write_lock = threading.RLock()       # type: ignore
+        self._srv.daemon_threads = True
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None

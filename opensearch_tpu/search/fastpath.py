@@ -671,9 +671,11 @@ def _launch_pure_groups(seg: Segment,
         scores, docs, totals = fused_bm25_topk_tfdl(
             al.d_docs, al.d_tfdl, rowstarts, nrows, lens, skips, weights,
             msm, avg, dlo, dhi, T=T_pad, L=L, K=K, k1=k1, b=b_eff)
-        scores = np.asarray(scores)
-        docs = np.asarray(docs)
-        totals = np.asarray(totals)
+        # ONE device->host transfer for all three outputs: each np.asarray
+        # is its own round trip, and on a tunneled host a round trip is
+        # ~70ms — 3 fetches would triple the batch-1 latency floor
+        import jax
+        scores, docs, totals = jax.device_get((scores, docs, totals))
         for j, vq in enumerate(gvqs):
             results[id(vq)] = (scores[j][:K], docs[j][:K],
                                int(totals[j][0]), "eq")
@@ -1184,9 +1186,8 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
             d_docs, d_tfdl, filt, rowstarts, nrows, lens, skips, weights,
             cw, thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,
             filtered=filtered)
-        scores = np.asarray(scores)
-        docs = np.asarray(docs)
-        totals = np.asarray(totals)
+        import jax
+        scores, docs, totals = jax.device_get((scores, docs, totals))
         for j, vq in enumerate(gvqs):
             results[id(vq)] = (scores[j][:K], docs[j][:K],
                                int(totals[j][0]))

@@ -1,0 +1,177 @@
+"""Persistent tasks: long-running work registered in durable cluster state
+so it survives node restarts and resumes where it left off.
+
+Reference: `persistent/AllocatedPersistentTask.java:1` +
+`persistent/PersistentTasksClusterService.java:1` — tasks live in cluster
+state metadata, get (re)allocated to nodes, checkpoint progress, and are
+completed/cancelled through the cluster-state update path. The TPU-native
+analog keeps the same state machine on one node: a JSON task table under
+the node's data path, executor functions registered per task type, at-
+least-once resume semantics with an opaque `progress` checkpoint the
+executor maintains, and the same lifecycle verbs (start / update progress
+/ complete / cancel).
+
+Executors run on the node's generic thread pool when available, inline
+otherwise; they receive (params, progress, checkpoint_fn) and return the
+final result. An executor that raises marks the task `failed` (kept for
+inspection, like the reference's failed allocations)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+_STATES = ("running", "completed", "failed", "cancelled")
+
+
+class PersistentTasksService:
+    def __init__(self, data_path: Optional[str] = None, thread_pools=None):
+        self.data_path = data_path
+        self.thread_pools = thread_pools
+        self.executors: Dict[str, Callable] = {}
+        self.tasks: Dict[str, dict] = {}
+        self._lock = threading.RLock()
+        self._counter = 0
+        if data_path:
+            self._load()
+
+    # ---------------- persistence ----------------
+
+    def _file(self) -> Optional[str]:
+        if not self.data_path:
+            return None
+        return os.path.join(self.data_path, "persistent_tasks.json")
+
+    def _save(self) -> None:
+        f = self._file()
+        if f is None:
+            return
+        os.makedirs(self.data_path, exist_ok=True)
+        tmp = f + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"tasks": self.tasks, "counter": self._counter}, fh)
+        os.replace(tmp, f)
+
+    def _load(self) -> None:
+        f = self._file()
+        if f is None or not os.path.exists(f):
+            return
+        with open(f) as fh:
+            saved = json.load(fh)
+        self.tasks = saved.get("tasks", {})
+        self._counter = saved.get("counter", 0)
+        # tasks that were running when the node died stay `running` —
+        # resume_all() re-executes them from their checkpoint (the
+        # reference reallocates on cluster-state recovery)
+
+    # ---------------- registry ----------------
+
+    def register_executor(self, task_type: str, fn: Callable) -> None:
+        """fn(params: dict, progress: dict, checkpoint: Callable[[dict],
+        None]) -> dict. `checkpoint` persists intermediate progress; on
+        resume the executor sees the last checkpointed progress."""
+        self.executors[task_type] = fn
+
+    # ---------------- lifecycle ----------------
+
+    def start(self, task_type: str, params: Optional[dict] = None,
+              task_id: Optional[str] = None, run: bool = True) -> dict:
+        if task_type not in self.executors:
+            raise ValueError(f"no executor for task type [{task_type}]")
+        with self._lock:
+            self._counter += 1
+            tid = task_id or f"{task_type}-{self._counter}"
+            if tid in self.tasks and \
+                    self.tasks[tid]["state"] == "running":
+                raise ValueError(f"persistent task [{tid}] already running")
+            task = {"id": tid, "type": task_type, "params": params or {},
+                    "state": "running", "progress": {},
+                    "started_ts": time.time(), "result": None,
+                    "error": None}
+            self.tasks[tid] = task
+            self._save()
+        if run:
+            self._execute(tid)
+        return dict(self.tasks[tid])
+
+    def _execute(self, tid: str) -> None:
+        def body():
+            task = self.tasks[tid]
+            fn = self.executors[task["type"]]
+
+            def checkpoint(progress: dict) -> None:
+                with self._lock:
+                    if self.tasks.get(tid, {}).get("state") == "cancelled":
+                        raise TaskCancelled(tid)
+                    task["progress"] = dict(progress)
+                    self._save()
+
+            try:
+                result = fn(task["params"], dict(task["progress"]),
+                            checkpoint)
+                with self._lock:
+                    if task["state"] == "running":
+                        task["state"] = "completed"
+                        task["result"] = result
+                        task["completed_ts"] = time.time()
+                        self._save()
+            except TaskCancelled:
+                pass        # state already set by cancel()
+            except Exception as e:                     # noqa: BLE001
+                with self._lock:
+                    task["state"] = "failed"
+                    task["error"] = f"{type(e).__name__}: {e}"
+                    self._save()
+
+        if self.thread_pools is not None:
+            self.thread_pools.pool("generic").submit(body)
+        else:
+            body()
+
+    def resume_all(self) -> int:
+        """Re-execute every task that was `running` at the last shutdown
+        (called after node recovery). Executors must be re-registered
+        first; a running task with no executor becomes `failed`."""
+        resumed = 0
+        for tid, task in list(self.tasks.items()):
+            if task["state"] != "running":
+                continue
+            if task["type"] not in self.executors:
+                task["state"] = "failed"
+                task["error"] = "no executor registered after restart"
+                self._save()
+                continue
+            self._execute(tid)
+            resumed += 1
+        return resumed
+
+    def cancel(self, tid: str) -> bool:
+        with self._lock:
+            task = self.tasks.get(tid)
+            if task is None or task["state"] != "running":
+                return False
+            task["state"] = "cancelled"
+            task["cancelled_ts"] = time.time()
+            self._save()
+            return True
+
+    def get(self, tid: str) -> Optional[dict]:
+        t = self.tasks.get(tid)
+        return dict(t) if t else None
+
+    def list(self, task_type: Optional[str] = None) -> list:
+        return [dict(t) for t in self.tasks.values()
+                if task_type is None or t["type"] == task_type]
+
+    def stats(self) -> dict:
+        by_state: Dict[str, int] = {}
+        for t in self.tasks.values():
+            by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+        return {"count": len(self.tasks), "by_state": by_state}
+
+
+class TaskCancelled(Exception):
+    pass

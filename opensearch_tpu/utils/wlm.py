@@ -69,9 +69,43 @@ class TokenBucket:
             return False
 
 
+class _UsageWindow:
+    """Rolling window of (ts, seconds) samples -> consumption rate
+    (seconds of search time per second of wall clock — 'cores used')."""
+
+    def __init__(self, horizon_s: float = 30.0):
+        self.horizon = horizon_s
+        self._samples = []          # [(ts, secs)]
+        self._lock = threading.Lock()
+
+    def add(self, secs: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._samples.append((now, secs))
+            cut = now - self.horizon
+            while self._samples and self._samples[0][0] < cut:
+                self._samples.pop(0)
+
+    def rate(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            cut = now - self.horizon
+            while self._samples and self._samples[0][0] < cut:
+                self._samples.pop(0)
+            return sum(s for _, s in self._samples) / self.horizon
+
+
 class WorkloadGroup:
+    """Reference `wlm/` QueryGroup: token-bucket rate limits AND
+    resource-tracking limits. `resource_limits={"cpu": f}` caps the
+    group's rolling search-time consumption at f cores; mode "monitor"
+    only tracks (usage visible in stats), "enforced" rejects admission
+    while the group is over its cap (QueryGroupService's enforcement)."""
+
     def __init__(self, name: str, search_rate: Optional[float] = None,
-                 search_burst: Optional[float] = None):
+                 search_burst: Optional[float] = None,
+                 resource_limits: Optional[Dict[str, float]] = None,
+                 mode: str = "monitor"):
         self.name = name
         # rate=0 means "block" (a bucket that never refills), not unlimited;
         # burst=0 is honored (only refill admits)
@@ -79,8 +113,12 @@ class WorkloadGroup:
                                    search_burst if search_burst is not None
                                    else max(search_rate, 1.0))
                        if search_rate is not None else None)
+        self.resource_limits = resource_limits or {}
+        self.mode = mode
+        self.usage = _UsageWindow()
         self.searches = 0
         self.rejections = 0
+        self.resource_rejections = 0
 
     def admit_search(self) -> None:
         self.searches += 1
@@ -88,10 +126,26 @@ class WorkloadGroup:
             self.rejections += 1
             raise PressureRejectedException(
                 f"workload group [{self.name}] search rate limit exceeded")
+        cpu_cap = self.resource_limits.get("cpu")
+        if cpu_cap is not None and self.mode == "enforced" \
+                and self.usage.rate() > cpu_cap:
+            self.rejections += 1
+            self.resource_rejections += 1
+            raise PressureRejectedException(
+                f"workload group [{self.name}] over its cpu resource limit "
+                f"({self.usage.rate():.3f} > {cpu_cap}) [enforced mode]")
+
+    def record(self, seconds: float) -> None:
+        """Charge one completed search's wall time against the group."""
+        self.usage.add(max(seconds, 0.0))
 
     def stats(self) -> dict:
         return {"searches": self.searches, "rejections": self.rejections,
-                "rate_limited": self.bucket is not None}
+                "resource_rejections": self.resource_rejections,
+                "rate_limited": self.bucket is not None,
+                "mode": self.mode,
+                "resource_limits": self.resource_limits,
+                "cpu_usage_rate": round(self.usage.rate(), 4)}
 
 
 class WorkloadManagement:
@@ -101,8 +155,11 @@ class WorkloadManagement:
             "default": WorkloadGroup("default")}
 
     def put_group(self, name: str, search_rate: Optional[float] = None,
-                  search_burst: Optional[float] = None) -> WorkloadGroup:
-        g = WorkloadGroup(name, search_rate, search_burst)
+                  search_burst: Optional[float] = None,
+                  resource_limits: Optional[Dict[str, float]] = None,
+                  mode: str = "monitor") -> WorkloadGroup:
+        g = WorkloadGroup(name, search_rate, search_burst,
+                          resource_limits, mode)
         self.groups[name] = g
         return g
 

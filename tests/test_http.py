@@ -1,0 +1,182 @@
+"""HTTP wire layer (rest/http_server.py): real sockets, JSON + NDJSON
+dialects, status-code mapping, and the concurrent-client story. Reference:
+`http/HttpServerTransport.java:1`, `rest/RestController.java:1`."""
+
+import http.client
+import json
+import threading
+
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+from opensearch_tpu.rest.http_server import HttpServer
+
+
+@pytest.fixture(scope="module")
+def srv():
+    server = HttpServer(RestClient())
+    port = server.start()
+    yield server, port
+    server.stop()
+
+
+def req(port, method, path, body=None, ndjson=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    payload = None
+    headers = {}
+    if ndjson is not None:
+        payload = "\n".join(json.dumps(x) for x in ndjson) + "\n"
+        headers["Content-Type"] = "application/x-ndjson"
+    elif body is not None:
+        payload = json.dumps(body)
+        headers["Content-Type"] = "application/json"
+    conn.request(method, path, body=payload, headers=headers)
+    resp = conn.getresponse()
+    raw = resp.read().decode()
+    conn.close()
+    try:
+        return resp.status, json.loads(raw)
+    except json.JSONDecodeError:
+        return resp.status, raw
+
+
+class TestHttpBasics:
+    def test_root_info(self, srv):
+        _, port = srv
+        status, body = req(port, "GET", "/")
+        assert status == 200
+        assert body["version"]["distribution"] == "opensearch-tpu"
+
+    def test_index_lifecycle_and_docs(self, srv):
+        _, port = srv
+        status, body = req(port, "PUT", "/books", {
+            "mappings": {"properties": {"title": {"type": "text"},
+                                        "year": {"type": "integer"}}}})
+        assert status == 200 and body["acknowledged"]
+        # HEAD exists
+        assert req(port, "HEAD", "/books")[0] == 200
+        assert req(port, "HEAD", "/missing")[0] == 404
+        # index + get
+        status, body = req(port, "PUT", "/books/_doc/1?refresh=true",
+                           {"title": "dune", "year": 1965})
+        assert status == 201 and body["result"] in ("created", "updated")
+        status, body = req(port, "GET", "/books/_doc/1")
+        assert status == 200 and body["_source"]["year"] == 1965
+        # 404 doc
+        assert req(port, "GET", "/books/_doc/zzz")[0] == 404
+        # search
+        status, body = req(port, "POST", "/books/_search",
+                           {"query": {"match": {"title": "dune"}}})
+        assert status == 200
+        assert body["hits"]["total"]["value"] == 1
+        # delete doc
+        assert req(port, "DELETE", "/books/_doc/1")[0] == 200
+
+    def test_bulk_and_msearch_ndjson(self, srv):
+        _, port = srv
+        req(port, "PUT", "/bulkidx")
+        lines = []
+        for i in range(20):
+            lines.append({"index": {"_index": "bulkidx", "_id": str(i)}})
+            lines.append({"n": i, "tag": "even" if i % 2 == 0 else "odd"})
+        status, body = req(port, "POST", "/_bulk?refresh=true", ndjson=lines)
+        assert status == 200 and not body["errors"]
+        status, body = req(port, "POST", "/_msearch", ndjson=[
+            {"index": "bulkidx"}, {"query": {"term": {"tag": "even"}}},
+            {"index": "bulkidx"}, {"query": {"match_all": {}}, "size": 3},
+        ])
+        assert status == 200
+        assert body["responses"][0]["hits"]["total"]["value"] == 10
+        assert body["responses"][1]["hits"]["total"]["value"] == 20
+
+    def test_error_mapping(self, srv):
+        _, port = srv
+        status, body = req(port, "POST", "/nosuch/_search",
+                           {"query": {"match_all": {}}})
+        assert status == 404
+        assert body["error"]["type"] == "index_not_found_exception"
+        req(port, "PUT", "/errs")
+        status, body = req(port, "POST", "/errs/_search",
+                           {"query": {"bogus_kind": {}}})
+        assert status == 400
+        # malformed JSON
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("POST", "/errs/_search", body="{not json",
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        raw = json.loads(r.read().decode())
+        conn.close()
+        assert r.status == 400 and raw["error"]["type"] == "parsing_exception"
+        # unknown route
+        status, body = req(port, "POST", "/errs/_frobnicate")
+        assert status == 400
+
+    def test_cat_and_cluster(self, srv):
+        _, port = srv
+        status, body = req(port, "GET", "/_cluster/health")
+        assert status == 200 and "status" in body
+        status, rows = req(port, "GET", "/_cat/indices?format=json")
+        assert status == 200 and isinstance(rows, list)
+        status, text = req(port, "GET", "/_cat/indices")
+        assert status == 200 and isinstance(text, str)
+
+    def test_mapping_settings_roundtrip(self, srv):
+        _, port = srv
+        req(port, "PUT", "/maps", {"mappings": {"properties": {
+            "a": {"type": "keyword"}}}})
+        status, body = req(port, "GET", "/maps/_mapping")
+        assert status == 200
+        assert body["maps"]["mappings"]["properties"]["a"]["type"] == \
+            "keyword"
+        status, body = req(port, "PUT", "/maps/_mapping",
+                           {"properties": {"b": {"type": "integer"}}})
+        assert status == 200
+
+
+class TestHttpConcurrency:
+    def test_concurrent_searches_and_writes(self, srv):
+        """The concurrent-client story: parallel searches over HTTP all
+        succeed with consistent results while writes interleave."""
+        _, port = srv
+        req(port, "PUT", "/conc")
+        lines = []
+        for i in range(50):
+            lines.append({"index": {"_index": "conc", "_id": str(i)}})
+            lines.append({"body": f"word{i % 5} shared"})
+        req(port, "POST", "/_bulk?refresh=true", ndjson=lines)
+
+        results = []
+        errors = []
+
+        def reader(k):
+            try:
+                for _ in range(10):
+                    s, b = req(port, "POST", "/conc/_search",
+                               {"query": {"match": {"body": "shared"}},
+                                "size": 5, "_c": k})
+                    assert s == 200
+                    results.append(b["hits"]["total"]["value"])
+            except Exception as e:                     # noqa: BLE001
+                errors.append(e)
+
+        def writer(k):
+            try:
+                for j in range(5):
+                    s, _ = req(port, "PUT",
+                               f"/conc/_doc/w{k}-{j}?refresh=true",
+                               {"body": "extra doc"})
+                    assert s == 201
+            except Exception as e:                     # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=reader, args=(k,))
+                   for k in range(6)] + \
+                  [threading.Thread(target=writer, args=(k,))
+                   for k in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:3]
+        assert len(results) == 60
+        assert all(v >= 50 for v in results)

@@ -25,6 +25,10 @@ def client():
 
 class TestFilterMaskCache:
     def test_repeated_filter_hits_cache(self, client):
+        # the mask cache is global with weakref purges; collect first so
+        # other tests' dying segments can't change counts mid-assert
+        import gc
+        gc.collect()
         before = C.filter_mask_cache_stats()["entries"]
         body1 = {"query": {"bool": {
             "must": [{"match": {"body": "alpha"}}],
@@ -36,8 +40,9 @@ class TestFilterMaskCache:
         entries_after_first = C.filter_mask_cache_stats()["entries"]
         assert entries_after_first > before
         r2 = client.search("mg", body2)
-        # same filter spec -> no new cache entry
-        assert C.filter_mask_cache_stats()["entries"] == entries_after_first
+        # same filter spec -> no NEW cache entry (concurrent purges may
+        # only shrink the count)
+        assert C.filter_mask_cache_stats()["entries"] <= entries_after_first
         assert r1["hits"]["total"]["value"] == 20
         assert r2["hits"]["total"]["value"] == 20
 

@@ -1,0 +1,134 @@
+"""Persistent tasks (utils/persistent_tasks.py): durable task table,
+checkpointed resume across restarts, cancellation, and the built-in
+resumable reindex executor. Reference:
+`persistent/AllocatedPersistentTask.java:1`."""
+
+import tempfile
+
+import pytest
+
+from opensearch_tpu.rest.client import RestClient
+from opensearch_tpu.utils.persistent_tasks import PersistentTasksService
+
+
+class TestServiceCore:
+    def test_complete_and_stats(self, tmp_path):
+        svc = PersistentTasksService(str(tmp_path))
+        svc.register_executor(
+            "double", lambda p, pr, ck: {"out": p["x"] * 2})
+        t = svc.start("double", {"x": 21})
+        got = svc.get(t["id"])
+        assert got["state"] == "completed" and got["result"]["out"] == 42
+        assert svc.stats()["by_state"]["completed"] == 1
+
+    def test_failure_recorded(self, tmp_path):
+        svc = PersistentTasksService(str(tmp_path))
+
+        def boom(p, pr, ck):
+            raise RuntimeError("nope")
+
+        svc.register_executor("boom", boom)
+        t = svc.start("boom")
+        got = svc.get(t["id"])
+        assert got["state"] == "failed" and "nope" in got["error"]
+
+    def test_unknown_type_rejected(self, tmp_path):
+        svc = PersistentTasksService(str(tmp_path))
+        with pytest.raises(ValueError):
+            svc.start("nosuch")
+
+    def test_cancel_midway(self, tmp_path):
+        svc = PersistentTasksService(str(tmp_path))
+
+        def stepper(p, pr, ck):
+            for i in range(int(pr.get("i", 0)), 100):
+                if i == 3:
+                    svc.cancel(p["self_id"])
+                ck({"i": i + 1})
+            return {"i": 100}
+
+        svc.register_executor("stepper", stepper)
+        t = svc.start("stepper", {"self_id": "s1"}, task_id="s1")
+        got = svc.get("s1")
+        assert got["state"] == "cancelled"
+        assert got["progress"]["i"] <= 5
+
+    def test_resume_from_checkpoint_after_restart(self, tmp_path):
+        """The durable contract: a task `running` at shutdown resumes from
+        its LAST CHECKPOINT in a fresh service instance."""
+        path = str(tmp_path)
+        svc1 = PersistentTasksService(path)
+        seen1 = []
+
+        def walker_crashy(p, pr, ck):
+            start = int(pr.get("i", 0))
+            for i in range(start, 10):
+                seen1.append(i)
+                ck({"i": i + 1})
+                if i == 4:
+                    raise KeyboardInterrupt   # simulate process death
+            return {"i": 10}
+
+        svc1.register_executor("walk", walker_crashy)
+        try:
+            svc1.start("walk", task_id="w1")
+        except KeyboardInterrupt:
+            pass
+        assert seen1 == [0, 1, 2, 3, 4]
+
+        # "restart": new service over the same path
+        svc2 = PersistentTasksService(path)
+        assert svc2.get("w1")["state"] == "running"
+        seen2 = []
+
+        def walker(p, pr, ck):
+            for i in range(int(pr.get("i", 0)), 10):
+                seen2.append(i)
+                ck({"i": i + 1})
+            return {"i": 10}
+
+        svc2.register_executor("walk", walker)
+        assert svc2.resume_all() == 1
+        got = svc2.get("w1")
+        assert got["state"] == "completed"
+        assert seen2 == [5, 6, 7, 8, 9]   # resumed, not restarted
+
+    def test_resume_without_executor_fails_task(self, tmp_path):
+        path = str(tmp_path)
+        svc1 = PersistentTasksService(path)
+        svc1.register_executor("x", lambda p, pr, ck: {})
+        svc1.start("x", task_id="t", run=False)
+        svc2 = PersistentTasksService(path)
+        svc2.resume_all()
+        assert svc2.get("t")["state"] == "failed"
+
+
+class TestReindexTask:
+    def test_reindex_end_to_end_and_restart_durability(self):
+        path = tempfile.mkdtemp()
+        c = RestClient(data_path=path)
+        c.indices.create("src", {"settings": {"number_of_replicas": 0}})
+        for i in range(37):
+            c.index("src", {"n": i, "body": f"doc {i}"}, id=f"d{i:03d}")
+        c.indices.refresh("src")
+        t = c.node.persistent_tasks.start(
+            "reindex", {"source": "src", "dest": "dst", "batch": 10})
+        # node executors run async on the generic pool
+        import time as _time
+        for _ in range(200):
+            got = c.node.persistent_tasks.get(t["id"])
+            if got["state"] != "running":
+                break
+            _time.sleep(0.05)
+        assert got["state"] == "completed", got
+        assert got["result"]["docs"] == 37
+        r = c.search("dst", {"query": {"match_all": {}},
+                             "track_total_hits": True})
+        assert r["hits"]["total"]["value"] == 37
+        assert c.get("dst", "d007")["_source"]["n"] == 7
+        # task table survives restart
+        c.indices.flush("dst")
+        c2 = RestClient(data_path=path)
+        got2 = c2.node.persistent_tasks.get(t["id"])
+        assert got2["state"] == "completed"
+        assert c2.node.stats()["persistent_tasks"]["count"] >= 1

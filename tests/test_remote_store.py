@@ -182,6 +182,44 @@ class TestRemoteStore:
         c.indices.flush("noremote")
         assert not os.path.exists(os.path.join(remote, "noremote"))
 
+    def test_incremental_snapshots_dedup(self, dirs):
+        """Snapshots are content-addressed per repository: a second
+        snapshot of an unchanged index copies zero segment bytes, and
+        both snapshots restore correctly (reference
+        BlobStoreRepository incremental shard snapshots)."""
+        data, _ = dirs
+        repo = tempfile.mkdtemp()
+        try:
+            c = RestClient(data_path=data)
+            _populate(c, name="sidx", shards=1)
+            c.snapshot.create_repository(
+                "r", {"settings": {"location": repo}})
+            r1 = c.snapshot.create("r", "s1", {"indices": "sidx"})
+            st1 = r1["snapshot"]["stats"]
+            assert st1["new_bytes"] > 0 and st1["shared_bytes"] == 0
+            # second snapshot, nothing changed: full dedup
+            r2 = c.snapshot.create("r", "s2", {"indices": "sidx"})
+            st2 = r2["snapshot"]["stats"]
+            assert st2["new_bytes"] == 0 or \
+                st2["new_bytes"] < st1["new_bytes"] // 10
+            assert st2["shared_bytes"] > 0
+            # add docs -> only the new segment's bytes move
+            c.index("sidx", {"body": "alpha beta", "n": 777}, id="n1")
+            c.indices.refresh("sidx")
+            r3 = c.snapshot.create("r", "s3", {"indices": "sidx"})
+            st3 = r3["snapshot"]["stats"]
+            assert 0 < st3["new_bytes"] < st1["new_bytes"] + st3["shared_bytes"]
+            # restore s1 under a rename; results match the original count
+            c.snapshot.restore("r", "s1", {"rename_pattern": "sidx",
+                                           "rename_replacement": "sback"})
+            got = c.search("sback", {"query": {"match_all": {}},
+                                     "track_total_hits": True})
+            assert got["hits"]["total"]["value"] == 60
+            assert {s["snapshot"] for s in
+                    c.snapshot.get("r")["snapshots"]} == {"s1", "s2", "s3"}
+        finally:
+            shutil.rmtree(repo, ignore_errors=True)
+
     def test_upload_lag_tracking(self, dirs):
         data, remote = dirs
         c = RestClient(data_path=data, remote_root=remote)

@@ -57,6 +57,41 @@ class TestWorkloadGroups:
             c.search("wg", {"query": {"match_all": {}}, "_p": f"d{i}"})
         assert c.node.stats()["wlm"]["groups"]["analytics"]["rejections"] == 2
 
+    def test_query_group_resource_tracking(self):
+        """Resource-tracking QueryGroups (reference wlm/QueryGroupService):
+        usage accrues from completed searches; enforced mode rejects while
+        over the cpu cap; monitor mode only reports."""
+        c = RestClient()
+        c.indices.create("qg")
+        c.index("qg", {"b": 1}, id="1", refresh=True)
+        c.put_workload_group("mon", {"resource_limits": {"cpu": 0.5},
+                                     "mode": "monitor"})
+        c.put_workload_group("hard", {"resource_limits": {"cpu": 0.0},
+                                      "mode": "enforced"})
+        # monitor: usage recorded, never rejected
+        for i in range(3):
+            c.search("qg", {"query": {"match_all": {}}, "_p": f"m{i}",
+                            "_workload_group": "mon"})
+        st = c.node.stats()["wlm"]["groups"]["mon"]
+        assert st["mode"] == "monitor" and st["rejections"] == 0
+        assert st["cpu_usage_rate"] >= 0.0
+        # enforced with cap 0: first search admits (usage 0), charges the
+        # window, and every later search rejects while over the cap
+        c.search("qg", {"query": {"match_all": {}}, "_p": "h0",
+                        "_workload_group": "hard"})
+        rejected = 0
+        for i in range(3):
+            try:
+                c.search("qg", {"query": {"match_all": {}}, "_p": f"h{i+1}",
+                                "_workload_group": "hard"})
+            except ApiError as e:
+                assert e.status == 429
+                assert "resource limit" in str(e)
+                rejected += 1
+        assert rejected == 3
+        st = c.node.stats()["wlm"]["groups"]["hard"]
+        assert st["resource_rejections"] == 3
+
 
 class TestLifecycle:
     def test_rollover_api(self):

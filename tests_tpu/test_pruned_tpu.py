@@ -1,0 +1,75 @@
+"""TPU-hardware parity for impact-head pruning: the REAL Pallas kernel
+streaming head prefixes must match the dense XLA path exactly (modulo the
+documented gte-totals contract). Run on a real chip:
+`python -m pytest tests_tpu/test_pruned_tpu.py -q`."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from opensearch_tpu.rest.client import RestClient
+from opensearch_tpu.search import fastpath
+
+pytestmark = pytest.mark.skipif(jax.default_backend() != "tpu",
+                                reason="needs a real TPU chip")
+
+
+@pytest.fixture(scope="module")
+def client(request):
+    # shrink L_HEAD so a 20k-doc corpus genuinely clamps (df(common) ~12k)
+    orig = fastpath.L_HEAD
+    fastpath.L_HEAD = 1024
+    request.addfinalizer(lambda: setattr(fastpath, "L_HEAD", orig))
+    rng = np.random.default_rng(2)
+    words = [f"w{i}" for i in range(400)]
+    c = RestClient()
+    c.indices.create("pidx")
+    bulk = []
+    for i in range(20_000):
+        parts = list(rng.choice(words, size=10))
+        if rng.random() < 0.6:
+            parts.extend(["common"] * int(rng.integers(1, 4)))
+        if rng.random() < 0.3:
+            parts.append("semi")
+        bulk.append({"index": {"_index": "pidx", "_id": str(i)}})
+        bulk.append({"body": " ".join(parts)})
+    c.bulk(bulk)
+    c.indices.refresh("pidx")
+    c.indices.forcemerge("pidx")
+    return c
+
+
+@pytest.mark.parametrize("body", [
+    {"query": {"match": {"body": "common"}}, "size": 10},
+    {"query": {"match": {"body": "common w3"}}, "size": 10},
+    {"query": {"match": {"body": "common semi"}}, "size": 10},
+    {"query": {"match": {"body": {"query": "common semi",
+                                  "operator": "and"}}}, "size": 10},
+    {"query": {"match": {"body": "w1 w2"}}, "size": 10},   # unclamped
+])
+def test_pruned_kernel_matches_exact(client, body):
+    c = client
+    before = dict(fastpath.STATS)
+    pruned = c.search(index="pidx", body=dict(body))
+    served = fastpath.STATS["pure_served"] - before["pure_served"]
+    assert served == 1, "kernel did not serve the pruned query"
+    exact_body = dict(body, track_total_hits=True)
+    exact = c.search(index="pidx", body=exact_body)
+    p = [(h["_id"], round(h["_score"], 4)) for h in pruned["hits"]["hits"]]
+    e = [(h["_id"], round(h["_score"], 4)) for h in exact["hits"]["hits"]]
+    assert p == e, body
+    if pruned["hits"]["total"]["relation"] == "eq":
+        assert pruned["hits"]["total"] == exact["hits"]["total"]
+    else:
+        assert pruned["hits"]["total"]["value"] <= \
+            exact["hits"]["total"]["value"]
+
+
+def test_pruning_actually_engaged(client):
+    c = client
+    before = dict(fastpath.STATS)
+    c.search(index="pidx", body={"query": {"match": {"body": "common"}},
+                                 "size": 10})
+    assert fastpath.STATS["pruned_served"] > before["pruned_served"] \
+        or fastpath.STATS["pruned_escalated"] > before["pruned_escalated"]
