@@ -954,16 +954,36 @@ class Node:
         return resp
 
     def msearch(self, expression: str, bodies: List[dict]) -> Optional[List[dict]]:
-        """Batched msearch over one index expression: all bodies' term-group
-        queries fuse into grouped Pallas kernel launches (grid over queries).
-        Returns None when ineligible — caller falls back to per-body search."""
+        """Batched msearch over one index expression. Dispatch order: the
+        SPMD mesh serves eligible bodies as ONE distributed program
+        invocation per group (multi-shard indices on a pod); the remainder
+        fuse into grouped Pallas kernel launches (grid over queries).
+        Returns None when wholly ineligible — caller falls back per-body."""
         from .admin import check_open
         names = check_open(self, self.metadata.resolve(expression),
                            expression)
         searchers = []
         for name in names:
             searchers.extend(self.indices[name].searchers)
-        resps = msearch_batched(searchers, bodies, index_name=",".join(names))
+        resps: Optional[List[Optional[dict]]] = None
+        if self.mesh_service is not None and len(names) == 1:
+            svc = self.indices[names[0]]
+            if svc.meta.num_shards >= 2:
+                resps = self.mesh_service.try_msearch(names[0], svc, bodies)
+                if all(r is None for r in resps):
+                    resps = None
+        if resps is None or any(r is None for r in resps):
+            todo = ([i for i, r in enumerate(resps) if r is None]
+                    if resps is not None else list(range(len(bodies))))
+            batched = msearch_batched(searchers,
+                                      [bodies[i] for i in todo],
+                                      index_name=",".join(names))
+            if batched is not None:
+                if resps is None:
+                    resps = [None] * len(bodies)
+                for i, r in zip(todo, batched):
+                    if resps[i] is None:
+                        resps[i] = r
         if resps is not None and len(names) == 1:
             for resp in resps:
                 if resp is None:

@@ -78,131 +78,177 @@ class MeshSearchService:
 
     def try_search(self, name: str, svc, body: dict) -> Optional[dict]:
         """One index, one term-group query -> full search response via the
-        mesh, or None to fall back to the host shard loop. Served shapes:
-        scoring term groups (term/terms/match, any minimum_should_match)
-        AND filter-context groups (`terms`, constant_score term sets) via
-        the program's constant-score flag; shards may hold several
-        segments (stacked as one concatenated CSR per shard); windows up
-        to MAX_WINDOW."""
+        mesh, or None to fall back to the host shard loop."""
+        return self.try_msearch(name, svc, [body])[0]
+
+    def try_msearch(self, name: str, svc, bodies) -> list:
+        """A BATCH of search bodies over one index through the SPMD mesh:
+        eligible bodies group by (similarity, window class) and run as ONE
+        program invocation each — the query axis of the distributed
+        program is the batch (replica-sharded on a pod), so an msearch of
+        N term-group queries pays one dispatch, one DFS psum, and one
+        all_gather merge for the whole group. Ineligible bodies come back
+        as None for the host loop. Served shapes: scoring term groups
+        (term/terms/match, any minimum_should_match) and filter-context
+        groups (`terms`, constant score); multi-segment and empty shards;
+        windows to MAX_WINDOW."""
         from ..search import compiler as C
         from ..search import query_dsl as dsl
-        from ..search.executor import (Candidate, ShardQueryResult,
-                                       _finish_search, _global_stats_contexts,
-                                       _host_sort_values, _norm_sort_specs,
-                                       parse_aggs, _collect_named)
-        t0 = time.monotonic()
+        from ..search.executor import (_global_stats_contexts,
+                                       _norm_sort_specs, parse_aggs,
+                                       _collect_named)
+
+        out: list = [None] * len(bodies)
         searchers = svc.searchers
         # the mesh program earns its keep on SHARDED indices (per-shard
         # SPMD scoring + device DFS/merge); a single-shard index would pay
         # compile + dispatch overhead for zero parallelism
         if svc.meta.num_shards < 2:
-            self.fallbacks += 1
-            return None
+            self.fallbacks += len(bodies)
+            return out
         # a shard may hold any number of segments (incl. zero for routing
         # holes) — the stacked index concatenates them per shard
         shard_segs = [[g for g in s.engine.segments if g.live_count > 0]
                       for s in searchers]
-
         stats = _global_stats_contexts(searchers)
         ctx = stats[0]
-        try:
-            query = dsl.parse_query(body.get("query"))
-        except dsl.QueryParseError:
-            self.fallbacks += 1
-            return None
-        lroot = C.rewrite(query, ctx, scoring=True)
-        sort_specs = _norm_sort_specs(body)
-        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
-        window = int(body.get("from", 0)) + int(body.get("size", 10))
-        lt = lroot
-        if not self._eligible(lt, sort_specs, agg_nodes,
-                              _collect_named(lroot), body, window):
-            self.fallbacks += 1
-            return None
-        field = lt.field
-        const_score = 0.0
-        if lt.mode == "filter":
-            # filter-context term group (`terms` query): constant score,
-            # doc-id tie order — handled inside the SPMD program
-            const_score = float(getattr(lt, "boost", 1.0) or 1.0)
 
+        parsed = []   # (qi, lt, sort_specs, window, const_score)
+        for qi, body in enumerate(bodies):
+            try:
+                query = dsl.parse_query(body.get("query"))
+            except dsl.QueryParseError:
+                self.fallbacks += 1
+                continue
+            lroot = C.rewrite(query, ctx, scoring=True)
+            sort_specs = _norm_sort_specs(body)
+            agg_nodes = parse_aggs(body.get("aggs",
+                                            body.get("aggregations")))
+            window = int(body.get("from", 0)) + int(body.get("size", 10))
+            if not self._eligible(lroot, sort_specs, agg_nodes,
+                                  _collect_named(lroot), body, window):
+                self.fallbacks += 1
+                continue
+            const = (float(getattr(lroot, "boost", 1.0) or 1.0)
+                     if lroot.mode == "filter" else 0.0)
+            parsed.append((qi, lroot, sort_specs, window, const))
+        if not parsed:
+            return out
+
+        # group by program parameters: field (via the stacked index), sim,
+        # and the pow2 WINDOW CLASS — co-batching a size=10 body with a
+        # from+size=1000 body would force K=1024 merge slots on everyone
+        # and every distinct K is its own compiled program
+        groups: dict = {}
+        for item in parsed:
+            qi, lt, sort_specs, window, const = item
+            sim = lt.sim
+            k1 = float(sim.k1) if sim is not None else 1.2
+            b_eff = (float(sim.b)
+                     if sim is not None and lt.has_norms else 0.0)
+            k_class = min(next_pow2(max(window, 16)), MAX_WINDOW)
+            groups.setdefault((lt.field, k1, b_eff, k_class),
+                              []).append(item)
+        for (field, k1, b_eff, k_class), items in groups.items():
+            self._run_mesh_group(name, svc, bodies, out, shard_segs, stats,
+                                 searchers, field, k1, b_eff, k_class,
+                                 items)
+        return out
+
+    def _run_mesh_group(self, name, svc, bodies, out, shard_segs, stats,
+                        searchers, field, k1, b_eff, k_class,
+                        items) -> None:
+        from ..search.executor import (Candidate, ShardQueryResult,
+                                       _finish_search, _host_sort_values)
+
+        t0 = time.monotonic()
         stacked = self._stacked_for(name, svc, field, shard_segs)
         if stacked is None:
-            self.fallbacks += 1
-            return None
-
+            self.fallbacks += len(items)
+            return
         S = len(shard_segs)
-        nt = len(lt.terms)
-        T_pad = next_pow2(nt, floor=1)
-        rows = np.full((S, 1, T_pad), -1, np.int32)
+        K = min(k_class, stacked.ndocs_pad)
+        keep = []
+        for it in items:
+            if it[3] > K:
+                # deeper page than the program's merged top-k capacity
+                # (tiny shards): that body takes the host loop
+                self.fallbacks += 1
+            else:
+                keep.append(it)
+        items = keep
+        if not items:
+            return
+        # pad the query axis to pow2 so batch size never mints new program
+        # shapes (dummy slots: all rows -1 -> every score -inf)
+        QB = next_pow2(len(items), floor=1)
+        T_pad = max(next_pow2(len(it[1].terms), floor=1) for it in items)
+        rows = np.full((S, QB, T_pad), -1, np.int32)
+        boosts = np.zeros((QB, T_pad), np.float32)
+        msm = np.ones(QB, np.float32)
+        cscore = np.zeros(QB, np.float32)
         total_max = 1
-        for si in range(S):
-            tot = 0
-            for ti, t in enumerate(lt.terms):
-                r = stacked.row(si, t)
-                rows[si, 0, ti] = r
-                tot += stacked.row_size(si, r)
-            total_max = max(total_max, tot)
+        for bi, (qi, lt, sort_specs, window, const) in enumerate(items):
+            nt = len(lt.terms)
+            boosts[bi, :nt] = lt.raw_boosts[:nt]
+            msm[bi] = float(lt.msm)
+            cscore[bi] = const
+            for si in range(S):
+                tot = 0
+                for ti, t in enumerate(lt.terms):
+                    r = stacked.row(si, t)
+                    rows[si, bi, ti] = r
+                    tot += stacked.row_size(si, r)
+                total_max = max(total_max, tot)
         bucket = next_pow2(total_max, floor=256)
-        boosts = np.zeros((1, T_pad), np.float32)
-        boosts[0, :nt] = lt.raw_boosts[:nt]
-        msm = np.full(1, float(lt.msm), np.float32)
-        cscore = np.full(1, const_score, np.float32)
-        K = min(next_pow2(max(window, 16)), MAX_WINDOW, stacked.ndocs_pad)
-        if window > K:
-            # the program's merged output has only K slots; a deeper page
-            # than K (tiny shards) must take the host loop or the page
-            # would silently truncate
-            self.fallbacks += 1
-            return None
-        sim = lt.sim
-        k1 = float(sim.k1) if sim is not None else 1.2
-        b_eff = (float(sim.b)
-                 if sim is not None and lt.has_norms else 0.0)
-
         mesh = self._mesh_for(S)
         if mesh is None:
-            self.fallbacks += 1
-            return None
-        fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K, k1, b_eff)
-        gdocs, gvals, totals = fn(stacked.tree(), rows, boosts, msm, cscore)
+            self.fallbacks += len(items)
+            return
+        fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K, k1,
+                               b_eff)
+        gdocs_b, gvals_b, totals_b = fn(stacked.tree(), rows, boosts, msm,
+                                        cscore)
         import jax
-        gdocs, gvals, totals = jax.device_get((gdocs, gvals, totals))
-        gdocs = gdocs[0]
-        gvals = gvals[0]
-        total = int(totals[0])
+        gdocs_b, gvals_b, totals_b = jax.device_get(
+            (gdocs_b, gvals_b, totals_b))
 
-        # global doc ids -> (shard, segment, local doc) -> candidates
         doc_base = np.asarray(stacked.doc_base)
         seg_bases = [np.cumsum([0] + ndocs[:-1])
                      for ndocs in stacked.seg_ndocs]
-        results = [ShardQueryResult(shard=i, segments=list(shard_segs[i]))
-                   for i in range(S)]
-        results[0].total = total
-        max_score = float(gvals[0]) if total > 0 and np.isfinite(gvals[0]) \
-            else -np.inf
-        results[0].max_score = max_score
-        for j in range(len(gdocs)):
-            if not np.isfinite(gvals[j]) or gdocs[j] < 0:
-                continue
-            si = int(np.searchsorted(doc_base, gdocs[j], "right") - 1)
-            in_shard = int(gdocs[j] - doc_base[si])
-            seg_ord = int(np.searchsorted(seg_bases[si], in_shard,
-                                          "right") - 1)
-            local = in_shard - int(seg_bases[si][seg_ord])
-            seg = shard_segs[si][seg_ord]
-            if local >= seg.ndocs:
-                continue
-            sc = float(gvals[j])
-            sort_vals, raw_vals = _host_sort_values(sort_specs, seg, local, sc)
-            results[si].candidates.append(
-                Candidate(si, seg_ord, local, sc, sort_vals, raw_vals))
-        for r in results:
-            r.took_ms = (time.monotonic() - t0) * 1000.0
-        self.dispatched += 1
-        body = dict(body)
-        body["_index_name"] = name
-        return _finish_search(searchers, results, body, stats, name, t0, [])
+        for bi, (qi, lt, sort_specs, window, const) in enumerate(items):
+            gdocs = gdocs_b[bi]
+            gvals = gvals_b[bi]
+            total = int(totals_b[bi])
+            results = [ShardQueryResult(shard=i,
+                                        segments=list(shard_segs[i]))
+                       for i in range(S)]
+            results[0].total = total
+            results[0].max_score = (float(gvals[0]) if total > 0
+                                    and np.isfinite(gvals[0]) else -np.inf)
+            for j in range(len(gdocs)):
+                if not np.isfinite(gvals[j]) or gdocs[j] < 0:
+                    continue
+                si = int(np.searchsorted(doc_base, gdocs[j], "right") - 1)
+                in_shard = int(gdocs[j] - doc_base[si])
+                seg_ord = int(np.searchsorted(seg_bases[si], in_shard,
+                                              "right") - 1)
+                local = in_shard - int(seg_bases[si][seg_ord])
+                seg = shard_segs[si][seg_ord]
+                if local >= seg.ndocs:
+                    continue
+                sc = float(gvals[j])
+                sort_vals, raw_vals = _host_sort_values(sort_specs, seg,
+                                                        local, sc)
+                results[si].candidates.append(
+                    Candidate(si, seg_ord, local, sc, sort_vals, raw_vals))
+            for r in results:
+                r.took_ms = (time.monotonic() - t0) * 1000.0
+            self.dispatched += 1
+            body = dict(bodies[qi])
+            body["_index_name"] = name
+            out[qi] = _finish_search(searchers, results, body, stats, name,
+                                     t0, [])
 
     def _eligible(self, lt, sort_specs, agg_nodes, named_nodes, body,
                   window: int) -> bool:

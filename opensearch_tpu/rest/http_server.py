@@ -149,6 +149,9 @@ class _Handler(BaseHTTPRequestHandler):
             return 200, c.node.stats()
         if head == "_remotestore":
             if len(parts) > 1 and parts[1] == "_restore":
+                if method != "POST":
+                    raise ApiError(405, "method_not_allowed",
+                                   "restore requires POST")
                 with wlock:
                     return 200, c.remotestore_restore(self._json_body() or {})
         if head == "_index_template" and len(parts) == 2:
@@ -217,6 +220,12 @@ class _Handler(BaseHTTPRequestHandler):
                 return 200, c.bulk(self._ndjson_body(), index=index,
                                    refresh=_truthy(params.get("refresh",
                                                               "false")))
+        if op in ("_refresh", "_flush", "_forcemerge", "_open", "_close") \
+                and method not in ("POST", "PUT"):
+            # mutating routes are POST-only (reference RestController): a
+            # GET from a probe/browser must never close an index
+            raise ApiError(405, "method_not_allowed",
+                           f"{op} requires POST")
         if op == "_refresh":
             with wlock:
                 return 200, c.indices.refresh(index)

@@ -122,9 +122,12 @@ class PersistentTasksService:
                 pass        # state already set by cancel()
             except Exception as e:                     # noqa: BLE001
                 with self._lock:
-                    task["state"] = "failed"
-                    task["error"] = f"{type(e).__name__}: {e}"
-                    self._save()
+                    # a cancel that raced the failure wins: the user's
+                    # explicit verb must not be overwritten by `failed`
+                    if task["state"] == "running":
+                        task["state"] = "failed"
+                        task["error"] = f"{type(e).__name__}: {e}"
+                        self._save()
 
         if self.thread_pools is not None:
             self.thread_pools.pool("generic").submit(body)
@@ -136,14 +139,21 @@ class PersistentTasksService:
         (called after node recovery). Executors must be re-registered
         first; a running task with no executor becomes `failed`."""
         resumed = 0
-        for tid, task in list(self.tasks.items()):
-            if task["state"] != "running":
-                continue
-            if task["type"] not in self.executors:
-                task["state"] = "failed"
-                task["error"] = "no executor registered after restart"
-                self._save()
-                continue
+        # decide everything under the lock FIRST (state flips + one save),
+        # then kick executors — _save() iterating self.tasks must not race
+        # an already-resumed executor mutating its task dict
+        to_run = []
+        with self._lock:
+            for tid, task in self.tasks.items():
+                if task["state"] != "running":
+                    continue
+                if task["type"] not in self.executors:
+                    task["state"] = "failed"
+                    task["error"] = "no executor registered after restart"
+                else:
+                    to_run.append(tid)
+            self._save()
+        for tid in to_run:
             self._execute(tid)
             resumed += 1
         return resumed

@@ -321,6 +321,38 @@ class TestMeshService:
             assert [h["_id"] for h in rm["hits"]["hits"]] == \
                 [h["_id"] for h in rh["hits"]["hits"]]
 
+    def test_msearch_batches_through_mesh(self, clients):
+        """An msearch of N eligible term-group bodies runs as ONE grouped
+        program invocation over the mesh (query axis = the batch) and
+        matches the host loop body-for-body."""
+        cm, ch = clients
+        lines_m, lines_h = [], []
+        bodies = [
+            {"query": {"match": {"body": "alpha beta"}}, "size": 5},
+            {"query": {"term": {"cat": "kitchen"}}, "size": 8},
+            {"query": {"terms": {"cat": ["garden"]}}, "size": 4},
+            {"query": {"match": {"body": {"query": "delta eps",
+                                          "minimum_should_match": 2}}},
+             "size": 6},
+            # ineligible (aggs): must fall back per-body, same answer
+            {"query": {"match": {"body": "alpha"}}, "size": 3,
+             "aggs": {"c": {"terms": {"field": "cat"}}}},
+        ]
+        for b in bodies:
+            lines_m.extend([{"index": "idx"}, dict(b)])
+            lines_h.extend([{"index": "idx"}, dict(b)])
+        before = cm.node.mesh_service.dispatched
+        rm = cm.msearch(lines_m)
+        rh = ch.msearch(lines_h)
+        assert cm.node.mesh_service.dispatched >= before + 4, \
+            "mesh msearch batching did not engage"
+        for i, (bm, bh) in enumerate(zip(rm["responses"],
+                                         rh["responses"])):
+            assert bm["hits"]["total"] == bh["hits"]["total"], i
+            assert [h["_id"] for h in bm["hits"]["hits"]] == \
+                [h["_id"] for h in bh["hits"]["hits"]], i
+        assert "aggregations" in rm["responses"][4]
+
     def test_deletes_parity(self, clients):
         """Soft-deleted docs must vanish from mesh results exactly as they do
         from the host loop (live-mask propagation through the SPMD program)."""

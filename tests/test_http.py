@@ -111,6 +111,19 @@ class TestHttpBasics:
         status, body = req(port, "POST", "/errs/_frobnicate")
         assert status == 400
 
+    def test_mutating_routes_reject_get(self, srv):
+        """GET on mutating routes must 405, never mutate (a probe or
+        browser must not be able to close an index)."""
+        _, port = srv
+        req(port, "PUT", "/mget405")
+        status, body = req(port, "GET", "/mget405/_close")
+        assert status == 405
+        # index still open
+        assert req(port, "POST", "/mget405/_search",
+                   {"query": {"match_all": {}}})[0] == 200
+        assert req(port, "GET", "/mget405/_refresh")[0] == 405
+        assert req(port, "GET", "/_remotestore/_restore")[0] == 405
+
     def test_cat_and_cluster(self, srv):
         _, port = srv
         status, body = req(port, "GET", "/_cluster/health")
