@@ -220,10 +220,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return 200, c.bulk(self._ndjson_body(), index=index,
                                    refresh=_truthy(params.get("refresh",
                                                               "false")))
-        if op in ("_refresh", "_flush", "_forcemerge", "_open", "_close") \
+        if op in ("_forcemerge", "_open", "_close") \
                 and method not in ("POST", "PUT"):
-            # mutating routes are POST-only (reference RestController): a
-            # GET from a probe/browser must never close an index
+            # POST-only routes (reference RestController; note the
+            # reference DOES register GET for _refresh/_flush, so those
+            # stay method-agnostic): a probe must never close an index
             raise ApiError(405, "method_not_allowed",
                            f"{op} requires POST")
         if op == "_refresh":

@@ -215,6 +215,24 @@ class ShardSearcher:
                                         body)
                      if fastpath.enabled() and self.device is None else None)
 
+        # concurrent segment search, TPU-style: a many-segment shard runs
+        # as ONE kernel launch over the concatenated shard view instead of
+        # the serial per-segment loop (reference
+        # ConcurrentQueryPhaseSearcher parallelizes with threads; a TPU
+        # wants one bigger launch) — pure term-group specs only
+        if fast_spec is not None and len(segments) > 1 and not rescores:
+            sv = fastpath.shard_search(self, ctx, fast_spec, window)
+            if sv is not None:
+                view, fout = sv
+                self._collect_view_topk(result, view, fout, shard_ord,
+                                        sort_specs, min_score, ctx)
+                result.candidates.sort(key=lambda c: c.sort_values)
+                result.candidates = result.candidates[: window * oversample]
+                result.took_ms = (time.monotonic() - t0) * 1000.0
+                if task is not None:
+                    task.track(device_seconds=result.took_ms / 1000.0)
+                return result
+
         seg_t0 = time.monotonic()
         for seg_ord, seg in enumerate(segments):
             if task is not None:
@@ -330,6 +348,35 @@ class ShardSearcher:
         result.candidates = result.candidates[: window * oversample]
         result.took_ms = (time.monotonic() - t0) * 1000.0
         return result
+
+    def _collect_view_topk(self, result: ShardQueryResult, view, out: dict,
+                           shard_ord: int, sort_specs, min_score,
+                           ctx) -> None:
+        """Fold the shard-view launch's top-k (view-space doc ids) into the
+        shard result, translating to (segment, local doc)."""
+        keys = np.asarray(out["topk_key"])
+        idx = np.asarray(out["topk_idx"])
+        scores = np.asarray(out["topk_scores"])
+        valid = keys > -np.inf
+        result.total += int(out["total"])
+        if out.get("total_rel") == "gte":
+            result.total_rel = "gte"
+        ms = float(out["max_score"])
+        if ms > result.max_score:
+            result.max_score = ms
+        for j in np.nonzero(valid)[0]:
+            d = int(idx[j])
+            if d < 0 or d >= view.ndocs:
+                continue
+            sc = float(scores[j])
+            if min_score is not None and sc < min_score:
+                continue
+            seg_ord, seg, local = view.locate(d)
+            sort_vals, raw_vals = _host_sort_values(sort_specs, seg, local,
+                                                    sc)
+            result.candidates.append(
+                Candidate(shard_ord, seg_ord, local, sc, sort_vals,
+                          raw_vals))
 
     def _collect_topk(self, result: ShardQueryResult, out: dict, seg: Segment,
                       seg_ord: int, shard_ord: int, sort_specs, rescores,

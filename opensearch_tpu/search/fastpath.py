@@ -53,7 +53,8 @@ _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 # served/fallback counters (surfaced in _nodes/stats; also used by tests to
 # prove the kernel actually engaged rather than silently falling back)
 STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0,
-         "pruned_served": 0, "pruned_escalated": 0}
+         "pruned_served": 0, "pruned_escalated": 0,
+         "shard_view_served": 0}
 
 # optional memory accounting set by the Node (utils/breaker.py): charged
 # before aligned arrays go to device, released when the segment is GC'd
@@ -1209,6 +1210,100 @@ def segment_search(seg: Segment, ctx, spec: FastSpec, k: int
     dict shaped like compiler.run_segment output, or None to fall back."""
     res = batch_search(seg, ctx, [spec], k)
     return res[0] if res else None
+
+
+# ---------------------------------------------------------------------
+# concurrent segment search, the TPU way: ONE launch per shard
+# ---------------------------------------------------------------------
+#
+# The reference parallelizes a many-segment shard across threads
+# (`search/query/ConcurrentQueryPhaseSearcher.java`). A TPU doesn't want
+# more threads — it wants fewer, larger launches: concatenate the shard's
+# segment postings into ONE aligned layout (doc ids offset per segment)
+# and run the whole shard as a single kernel invocation, then map hits
+# back to (segment, local doc). Built lazily per (shard, generation),
+# pure term-group specs only (bool/filter specs need per-segment column
+# state and keep the per-segment loop).
+
+class ShardView:
+    """Segment-shaped facade over a shard's concatenated postings — just
+    the attribute surface the pure fastpath touches."""
+
+    def __init__(self, name: str, segments: List[Segment],
+                 seg_ords: Optional[List[int]] = None):
+        self.name = name
+        self.segments = segments
+        # original positions in the engine's segment list (the view may
+        # skip empty segments, and downstream Candidates index that list)
+        self.seg_ords = seg_ords or list(range(len(segments)))
+        self.seg_bases = np.cumsum([0] + [s.ndocs for s in segments])
+        self.ndocs = int(self.seg_bases[-1])
+        self.ndocs_pad = next_pow2(max(self.ndocs, 1))
+        self.live_count = sum(s.live_count for s in segments)
+        self.postings: dict = {}
+        self.doc_lens: dict = {}
+        self._built: set = set()
+
+    def ensure_field(self, field: str) -> bool:
+        from ..index.segment import PostingsBlock
+        from ..parallel.spmd import _concat_shard
+
+        if field in self._built:
+            return field in self.postings
+        self._built.add(field)
+        if not any(field in s.postings for s in self.segments):
+            return False
+        m = _concat_shard(self.segments, field)
+        self.postings[field] = PostingsBlock(
+            field=field, vocab=list(m["terms"]), terms=m["terms"],
+            starts=np.asarray(m["starts"], np.int64),
+            doc_ids=m["doc_ids"], tfs=m["tfs"])
+        if any(s.doc_lens.get(field) is not None for s in self.segments):
+            self.doc_lens[field] = m["dl"]
+        return True
+
+    def locate(self, view_doc: int):
+        """view-space doc -> (engine seg_ord, segment, local doc)."""
+        vi = int(np.searchsorted(self.seg_bases, view_doc, "right") - 1)
+        return (self.seg_ords[vi], self.segments[vi],
+                int(view_doc - self.seg_bases[vi]))
+
+
+def shard_view(searcher) -> Optional[ShardView]:
+    """Cached per (engine, generation-ish identity of the segment list):
+    rebuilt whenever refresh/merge changes the segment set."""
+    eng = searcher.engine
+    pairs = [(i, s) for i, s in enumerate(eng.segments)
+             if s.live_count > 0]
+    if len(pairs) < 2:
+        return None
+    if any(s.live_count != s.ndocs for _, s in pairs):
+        return None     # deletes: per-segment loop (same rule as the kernel)
+    key = tuple(id(s) for _, s in pairs)
+    cached = eng.__dict__.get("_shard_view")
+    if cached is not None and cached[0] == key:
+        return cached[1]
+    view = ShardView(f"view:{id(eng):x}", [s for _, s in pairs],
+                     [i for i, _ in pairs])
+    eng.__dict__["_shard_view"] = (key, view)
+    return view
+
+
+def shard_search(searcher, ctx, spec: FastSpec, k: int
+                 ) -> Optional[Tuple[ShardView, dict]]:
+    """One kernel launch over ALL the shard's segments for a pure spec;
+    None -> per-segment loop."""
+    if spec.kind != "pure":
+        return None
+    view = shard_view(searcher)
+    if view is None or not view.ensure_field(spec.lt.field):
+        return None
+    out = batch_search(view, ctx, [spec], k, count_stats=False)
+    if out is None or out[0] is None:
+        return None
+    STATS["pure_served"] += 1
+    STATS["shard_view_served"] += 1
+    return view, out[0]
 
 
 def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,

@@ -211,9 +211,19 @@ def _eligible_aggs(cfg: StarTreeConfig, aggs: dict) -> Optional[list]:
         if kind == "terms":
             if field not in cfg.dims:
                 return None
-            if set(body) - {"field", "size"}:
+            if set(body) - {"field", "size", "order", "min_doc_count"}:
                 return None
-            params = {"size": int(body.get("size", 10))}
+            order = body.get("order")
+            if order is not None:
+                if not (isinstance(order, dict) and len(order) == 1):
+                    return None
+                ((okey, odir),) = order.items()
+                if okey not in ("_key", "_count") \
+                        or odir not in ("asc", "desc"):
+                    return None   # order-by-subagg: live path
+            params = {"size": int(body.get("size", 10)),
+                      "order": order,
+                      "min_doc_count": int(body.get("min_doc_count", 1))}
         else:
             if field != cfg.date_dim:
                 return None
@@ -342,11 +352,36 @@ def _answer(searchers, body: dict, cfg: StarTreeConfig, plan, term_filter):
             aggregations[name] = _stat_render(params["stat"], root.get(name))
             continue
         buckets = []
-        items = sorted(acc[name].items(),
-                       key=(lambda kv: (-kv[1]["doc_count"], str(kv[0])))
-                       if kind == "terms" else (lambda kv: kv[0]))
         if kind == "terms":
+            order = params.get("order")
+            if order:
+                ((okey, odir),) = order.items()
+                if okey == "_key":
+                    items = sorted(acc[name].items(),
+                                   key=lambda kv: str(kv[0]),
+                                   reverse=(odir == "desc"))
+                elif odir == "asc":
+                    items = sorted(acc[name].items(),
+                                   key=lambda kv: (kv[1]["doc_count"],
+                                                   str(kv[0])))
+                else:   # _count desc: count desc, key asc on ties
+                    items = sorted(acc[name].items(),
+                                   key=lambda kv: (-kv[1]["doc_count"],
+                                                   str(kv[0])))
+            else:
+                items = sorted(acc[name].items(),
+                               key=lambda kv: (-kv[1]["doc_count"],
+                                               str(kv[0])))
+            mdc = params.get("min_doc_count", 1)
+            if mdc > 1:
+                items = [kv for kv in items if kv[1]["doc_count"] >= mdc]
+            # live-path semantics (aggregations.finalize): sum_other is the
+            # DOC COUNT of post-filter buckets beyond `size`, not a bucket
+            # count
+            terms_total = sum(kv[1]["doc_count"] for kv in items)
             items = items[: params["size"]]
+        else:
+            items = sorted(acc[name].items(), key=lambda kv: kv[0])
         for key, b in items:
             bucket = {"key": key, "doc_count": int(b["doc_count"])}
             if kind == "date_histogram":
@@ -356,9 +391,10 @@ def _answer(searchers, body: dict, cfg: StarTreeConfig, plan, term_filter):
             buckets.append(bucket)
         aggregations[name] = {"buckets": buckets}
         if kind == "terms":
+            shown = sum(b["doc_count"] for b in buckets)
             aggregations[name]["doc_count_error_upper_bound"] = 0
-            aggregations[name]["sum_other_doc_count"] = max(
-                0, len(acc[name]) - len(buckets))
+            aggregations[name]["sum_other_doc_count"] = int(
+                max(0, terms_total - shown))
     return {
         "took": int((time.monotonic() - t0) * 1000),
         "timed_out": False,

@@ -230,6 +230,51 @@ class TestPrunedProperty:
                                        ref["topk_scores"][:n], rtol=2e-5)
 
 
+class TestShardView:
+    def test_multi_segment_single_launch_parity(self, small_head):
+        """A many-segment shard serves pure term-group queries as ONE
+        kernel launch over the concatenated shard view, matching the
+        per-segment XLA path exactly (the TPU answer to reference
+        ConcurrentQueryPhaseSearcher)."""
+        from opensearch_tpu.rest.client import RestClient
+
+        rng = np.random.default_rng(31)
+        words = [f"v{i}" for i in range(40)]
+        cm = RestClient()
+        ch = RestClient()
+        for c in (cm, ch):
+            rng2 = np.random.default_rng(31)
+            c.indices.create("sv", {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0}})
+            for wave in range(3):     # 3 refreshes -> >= 3 segments
+                for i in range(wave * 80, wave * 80 + 80):
+                    c.index("sv", {"body": " ".join(
+                        rng2.choice(words, 6))}, id=f"{i:04d}")
+                c.indices.refresh("sv")
+        assert len(cm.node.indices["sv"].shards[0].segments) >= 2
+        # ch runs with fastpath disabled -> per-segment XLA reference
+        before = dict(fastpath.STATS)
+        for q, size in (("v1 v2", 10), ("v3", 25), ("v4 v5 v6", 7)):
+            rm = cm.search("sv", {"query": {"match": {"body": q}},
+                                  "size": size})
+            fastpath.set_enabled(False)
+            try:
+                rh = ch.search("sv", {"query": {"match": {"body": q}},
+                                      "size": size, "_ref": 1})
+            finally:
+                fastpath.set_enabled(True)
+            assert rm["hits"]["total"]["value"] >= \
+                len(rm["hits"]["hits"])
+            assert [h["_id"] for h in rm["hits"]["hits"]] == \
+                [h["_id"] for h in rh["hits"]["hits"]], q
+            sm = [round(h["_score"], 4) for h in rm["hits"]["hits"]]
+            sh = [round(h["_score"], 4) for h in rh["hits"]["hits"]]
+            assert sm == sh, q
+        assert fastpath.STATS["shard_view_served"] > \
+            before["shard_view_served"]
+
+
 class TestRestRelation:
     def test_totals_relation_via_rest(self, small_head):
         from opensearch_tpu.rest.client import RestClient

@@ -130,12 +130,12 @@ class TestStarTreeParity:
             "field": "status"}}}, "_p4": 4})
         assert not r2.get("_star_tree")
         # unsupported agg params must take the live path: the cube only
-        # serves default semantics (advisor finding, round 3)
+        # serves semantics it reproduces exactly (advisor finding, round 3)
         for aggs in (
-            {"s": {"terms": {"field": "status",
-                             "order": {"_key": "asc"}}}},
-            {"s": {"terms": {"field": "status", "min_doc_count": 2}}},
             {"s": {"terms": {"field": "status", "missing": "zzz"}}},
+            {"s": {"terms": {"field": "status",
+                             "order": {"m": "desc"}},
+                   "aggs": {"m": {"sum": {"field": "price"}}}}},
             {"s": {"date_histogram": {"field": "ts",
                                       "fixed_interval": "1d",
                                       "offset": "+6h"}}},
@@ -146,6 +146,27 @@ class TestStarTreeParity:
             r3 = client.search("st", {"size": 0, "aggs": aggs,
                                       "_pp": str(aggs)})
             assert not r3.get("_star_tree"), aggs
+
+    def test_order_and_min_doc_count_served(self, client):
+        """Supported non-default params (explicit order, min_doc_count)
+        serve from the cube and match the live path exactly."""
+        for aggs in (
+            {"s": {"terms": {"field": "status",
+                             "order": {"_key": "asc"}}}},
+            {"s": {"terms": {"field": "status",
+                             "order": {"_key": "desc"}}}},
+            {"s": {"terms": {"field": "status",
+                             "order": {"_count": "asc"}}}},
+            {"s": {"terms": {"field": "status", "min_doc_count": 2}}},
+        ):
+            cube, live = _both(client, {"size": 0, "aggs": dict(aggs)})
+            ckeys = [(b["key"], b["doc_count"])
+                     for b in cube["aggregations"]["s"]["buckets"]]
+            lkeys = [(b["key"], b["doc_count"])
+                     for b in live["aggregations"]["s"]["buckets"]]
+            assert ckeys == lkeys, aggs
+            assert cube["aggregations"]["s"]["sum_other_doc_count"] == \
+                live["aggregations"]["s"]["sum_other_doc_count"], aggs
 
     def test_multi_segment(self, client):
         client.index("st", {"status": "a", "region": "eu",
