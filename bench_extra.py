@@ -51,6 +51,10 @@ signal.signal(signal.SIGINT, _on_term)
 
 
 def _merge_published(key, value):
+    # same contract as bench.py: local/smoke runs must never rewrite the
+    # checked-in baseline; opt in with BENCH_WRITE_BASELINE=1
+    if os.environ.get("BENCH_WRITE_BASELINE") != "1":
+        return
     try:
         with open(os.path.join(_REPO, "BASELINE.json"), "r+") as f:
             bl = json.load(f)
@@ -266,8 +270,8 @@ def config5():
     out = {"ndocs": ndocs, "segments_before_merge": nseg,
            "qps": round(qps, 1),
            "sample_total": total0,
-           "device_merge_2x{}M_s".format(per // 1_000_000):
-               round(merge_s, 1),
+           "device_merge_s": round(merge_s, 1),
+           "device_merge_docs": 2 * per,
            "post_merge_ok": all("hits" in r for r in resp2["responses"])}
     _OUT["config5_multisegment"] = out
     _emit("config5_done")
