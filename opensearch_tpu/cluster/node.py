@@ -199,15 +199,24 @@ class IndexService:
                 s.flush()
         self.generation += 1
         # remote-backed storage: mirror every shard's new commit (reference
-        # RemoteStoreRefreshListener uploads after each refresh/commit)
+        # RemoteStoreRefreshListener uploads after each refresh/commit).
+        # An upload failure must NOT fail the LOCAL commit — the shard
+        # keeps serving, the tracker records the failure and the lag, and
+        # the next flush retries (reference marks the shard lagging)
         if self.remote is not None:
             for sid, eng in enumerate(self.shards):
                 if eng.path:
-                    self.remote.upload_shard(eng.path, sid)
-            self.remote.upload_index_meta({
-                "settings": self.meta.settings,
-                "mappings": self.mappings.to_dict(),
-                "state": self.meta.state})
+                    try:
+                        self.remote.upload_shard(eng.path, sid)
+                    except Exception:   # noqa: BLE001
+                        pass   # failure + lag recorded by the tracker
+            try:
+                self.remote.upload_index_meta({
+                    "settings": self.meta.settings,
+                    "mappings": self.mappings.to_dict(),
+                    "state": self.meta.state})
+            except Exception:           # noqa: BLE001
+                self.remote.meta_failures += 1
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         for s in self.shards:

@@ -86,6 +86,9 @@ class RemoteSegmentStore:
         self.index = index
         self.base = os.path.join(root, index)
         self.trackers: Dict[int, TransferTracker] = {}
+        # meta.json upload failures: without this, a mirror missing its
+        # index metadata (restore can't find the index) would look healthy
+        self.meta_failures = 0
 
     # ---------------- upload ----------------
 
@@ -111,20 +114,20 @@ class RemoteSegmentStore:
         t0 = time.monotonic()
         sdir = os.path.join(self.base, str(shard_id))
         fdir = os.path.join(sdir, "files")
-        os.makedirs(fdir, exist_ok=True)
-        prev: Dict[str, dict] = {}
-        gen = 0
-        latest = os.path.join(sdir, "latest.json")
-        if os.path.exists(latest):
-            with open(latest) as fh:
-                gen = json.load(fh)["gen"]
-            mpath = os.path.join(sdir, f"manifest-{gen}.json")
-            if os.path.exists(mpath):
-                with open(mpath) as fh:
-                    prev = json.load(fh)["files"]
-        new_gen = gen + 1
         files: Dict[str, dict] = {}
         try:
+            os.makedirs(fdir, exist_ok=True)
+            prev = {}
+            gen = 0
+            latest = os.path.join(sdir, "latest.json")
+            if os.path.exists(latest):
+                with open(latest) as fh:
+                    gen = json.load(fh)["gen"]
+                mpath = os.path.join(sdir, f"manifest-{gen}.json")
+                if os.path.exists(mpath):
+                    with open(mpath) as fh:
+                        prev = json.load(fh)["files"]
+            new_gen = gen + 1
             for rel in self._committed_files(local_path):
                 src = os.path.join(local_path, rel)
                 st = os.stat(src)
@@ -176,7 +179,10 @@ class RemoteSegmentStore:
             old_manifest = os.path.join(sdir, f"manifest-{gen}.json")
             if gen and os.path.exists(old_manifest):
                 os.remove(old_manifest)
-        except OSError:
+        except Exception:
+            # not just OSError: a corrupt latest.json/manifest (partial
+            # transfer, other writer) raises JSONDecodeError/KeyError —
+            # every failure mode must count before propagating
             t.failures += 1
             raise
         t.remote_gen = t.local_gen
@@ -245,7 +251,11 @@ class RemoteSegmentStore:
         return sorted(int(d) for d in os.listdir(self.base) if d.isdigit())
 
     def stats(self) -> dict:
-        return {str(sid): t.stats() for sid, t in sorted(self.trackers.items())}
+        out = {str(sid): t.stats()
+               for sid, t in sorted(self.trackers.items())}
+        if self.meta_failures:
+            out["meta_failures"] = self.meta_failures
+        return out
 
 
 def remote_indices(root: str) -> List[str]:

@@ -220,6 +220,32 @@ class TestRemoteStore:
         finally:
             shutil.rmtree(repo, ignore_errors=True)
 
+    def test_upload_failure_does_not_fail_local_flush(self, dirs):
+        """A dead blob store must not break the local commit: flush
+        succeeds, the tracker records the failure and a positive lag, and
+        the next healthy flush catches the mirror up."""
+        data, remote = dirs
+        c = RestClient(data_path=data, remote_root=remote)
+        _populate(c, name="flaky", shards=1)
+        c.indices.flush("flaky")
+        t = c.node.indices["flaky"].remote.tracker(0)
+        assert t.lag == 0
+        # break the mirror: replace the shard dir with an unwritable file
+        shutil.rmtree(os.path.join(remote, "flaky"))
+        with open(os.path.join(remote, "flaky"), "w") as fh:
+            fh.write("not a dir")
+        c.index("flaky", {"body": "gamma delta", "n": 1}, id="x")
+        c.indices.flush("flaky")          # must NOT raise
+        assert t.failures >= 1 and t.lag >= 1
+        # local data intact
+        r = c.search("flaky", {"query": {"match_all": {}},
+                               "track_total_hits": True})
+        assert r["hits"]["total"]["value"] == 61
+        # heal the mirror; next flush catches up
+        os.remove(os.path.join(remote, "flaky"))
+        c.indices.flush("flaky")
+        assert t.lag == 0
+
     def test_upload_lag_tracking(self, dirs):
         data, remote = dirs
         c = RestClient(data_path=data, remote_root=remote)
