@@ -216,7 +216,7 @@ class IndexService:
                     "mappings": self.mappings.to_dict(),
                     "state": self.meta.state})
             except Exception:           # noqa: BLE001
-                self.remote.meta_failures += 1
+                pass   # counted by upload_index_meta itself
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         for s in self.shards:

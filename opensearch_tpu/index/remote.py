@@ -93,8 +93,14 @@ class RemoteSegmentStore:
     # ---------------- upload ----------------
 
     def upload_index_meta(self, meta: dict) -> None:
-        os.makedirs(self.base, exist_ok=True)
-        _atomic_json(os.path.join(self.base, "meta.json"), meta)
+        try:
+            os.makedirs(self.base, exist_ok=True)
+            _atomic_json(os.path.join(self.base, "meta.json"), meta)
+        except Exception:
+            # counted HERE so every call site keeps the invariant: a mirror
+            # whose meta.json is missing/stale must never look healthy
+            self.meta_failures += 1
+            raise
 
     def tracker(self, shard_id: int) -> TransferTracker:
         t = self.trackers.get(shard_id)
@@ -251,11 +257,9 @@ class RemoteSegmentStore:
         return sorted(int(d) for d in os.listdir(self.base) if d.isdigit())
 
     def stats(self) -> dict:
-        out = {str(sid): t.stats()
-               for sid, t in sorted(self.trackers.items())}
-        if self.meta_failures:
-            out["meta_failures"] = self.meta_failures
-        return out
+        return {"shards": {str(sid): t.stats()
+                           for sid, t in sorted(self.trackers.items())},
+                "meta_failures": self.meta_failures}
 
 
 def remote_indices(root: str) -> List[str]:

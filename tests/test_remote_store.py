@@ -49,7 +49,7 @@ class TestRemoteStore:
         before = c.search("ridx", dict(q))
         c.indices.flush("ridx")
         # the mirror exists and is generation-tracked
-        st = c.node.indices["ridx"].stats()["remote_store"]
+        st = c.node.indices["ridx"].stats()["remote_store"]["shards"]
         assert st["0"]["remote_gen"] >= 1 and st["0"]["refresh_lag"] == 0
 
         shutil.rmtree(data)          # catastrophic local loss
@@ -253,6 +253,6 @@ class TestRemoteStore:
         c.indices.flush("lagidx")
         t = c.node.indices["lagidx"].remote.tracker(0)
         assert t.lag == 0
-        st = c.node.indices["lagidx"].stats()["remote_store"]["0"]
+        st = c.node.indices["lagidx"].stats()["remote_store"]["shards"]["0"]
         assert st["uploads"] >= 1 and st["bytes_uploaded"] > 0
         assert st["last_upload_ms"] >= 0

@@ -73,3 +73,35 @@ def test_pruning_actually_engaged(client):
                                  "size": 10})
     assert fastpath.STATS["pruned_served"] > before["pruned_served"] \
         or fastpath.STATS["pruned_escalated"] > before["pruned_escalated"]
+
+
+def test_shard_view_single_launch_on_tpu():
+    """Multi-segment shard -> one real-kernel launch over the shard view,
+    identical to the per-segment XLA reference."""
+    rng = np.random.default_rng(4)
+    words = [f"s{i}" for i in range(60)]
+    c = RestClient()
+    c.indices.create("svidx", {"settings": {"number_of_shards": 1,
+                                            "number_of_replicas": 0}})
+    for wave in range(3):
+        for i in range(wave * 300, wave * 300 + 300):
+            c.index("svidx", {"body": " ".join(rng.choice(words, 8))},
+                    id=f"{i:05d}")
+        c.indices.refresh("svidx")
+    assert len(c.node.indices["svidx"].shards[0].segments) >= 2
+    before = dict(fastpath.STATS)
+    fast = c.search(index="svidx",
+                    body={"query": {"match": {"body": "s1 s2"}},
+                          "size": 10})
+    assert fastpath.STATS["shard_view_served"] > \
+        before["shard_view_served"]
+    fastpath.set_enabled(False)
+    try:
+        slow = c.search(index="svidx",
+                        body={"query": {"match": {"body": "s1 s2"}},
+                              "size": 10, "_ref": 1})
+    finally:
+        fastpath.set_enabled(True)
+    assert [(h["_id"], round(h["_score"], 4))
+            for h in fast["hits"]["hits"]] == \
+        [(h["_id"], round(h["_score"], 4)) for h in slow["hits"]["hits"]]
