@@ -444,6 +444,7 @@ def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
     spec = _flatten_bool(lroot)
     if spec is not None:
         spec.window = window
+        spec.prune_ok = prune_ok
     return spec
 
 
@@ -744,6 +745,8 @@ def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
         if r < 0:
             continue
         a, b = pb.row_slice(int(r))
+        if b <= a:
+            continue   # term has no postings here (e.g. empty FILTERED row)
         rowdocs = pb.doc_ids[a:b]
         pos = np.searchsorted(rowdocs, cand)
         pos_c = np.minimum(pos, b - a - 1)
@@ -962,14 +965,17 @@ class FilteredPostings:
     """Filter-specialized aligned postings for one (segment, field,
     filter): the term rows of `field` restricted to filter-passing docs."""
 
-    __slots__ = ("al", "starts", "host_docs", "nbytes", "__weakref__")
+    __slots__ = ("al", "starts", "host_docs", "host_tfs", "nbytes",
+                 "view", "__weakref__")
 
     def __init__(self, al: AlignedPostings, starts: np.ndarray,
-                 host_docs: np.ndarray, nbytes: int):
+                 host_docs: np.ndarray, host_tfs: np.ndarray, nbytes: int):
         self.al = al
         self.starts = starts       # i64[nterms+1] filtered CSR row bounds
         self.host_docs = host_docs  # i32 filtered doc ids (chunk windows)
+        self.host_tfs = host_tfs    # f32 filtered tfs (pruned-path rescore)
         self.nbytes = nbytes
+        self.view = None            # lazy FilteredSegView (pruned bool path)
 
 
 def _purge_filtered_for_uid(uid: int) -> None:
@@ -1010,7 +1016,7 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
                          np.diff(new_starts).astype(np.int64),
                          jax.device_put(a_docs), jax.device_put(a_packed),
                          nbytes)
-    fp = FilteredPostings(al, new_starts, new_docs, nbytes)
+    fp = FilteredPostings(al, new_starts, new_docs, tfs, nbytes)
     if _breaker is not None:
         import weakref
         _breaker.add_estimate(nbytes, f"fastpath-filtered[{seg.name}][{field}]")
@@ -1034,6 +1040,80 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
             _k, _v = _FILTERED_LRU.popitem(last=False)
             _FILTERED_BYTES[0] -= _v.nbytes
     return fp
+
+
+class FilteredSegView:
+    """Segment facade over filter-specialized postings: the filtered CSR
+    (ORIGINAL doc ids) presented as a one-field segment, so the PURE
+    pipeline — impact heads, remainder frontiers, verified pruning — runs
+    unchanged on filtered bool queries. Doc lens/live come from the real
+    segment (doc ids are original); docs outside the filter appear in no
+    row, so match counts and totals are filtered automatically."""
+
+    def __init__(self, seg: Segment, field: str, fp: "FilteredPostings"):
+        from ..index.segment import PostingsBlock
+
+        pb = seg.postings[field]
+        self.name = f"{seg.name}|filtered"
+        self.ndocs = seg.ndocs
+        self.ndocs_pad = seg.ndocs_pad
+        self.live_count = seg.live_count
+        self.postings = {field: PostingsBlock(
+            field=field, vocab=pb.vocab, terms=pb.terms,
+            starts=fp.starts.astype(np.int64), doc_ids=fp.host_docs,
+            tfs=fp.host_tfs)}
+        self.doc_lens = seg.doc_lens
+
+
+def _filtered_view(seg: Segment, field: str, fp: "FilteredPostings"
+                   ) -> FilteredSegView:
+    with _FILTERED_LOCK:
+        if fp.view is None:
+            view = FilteredSegView(seg, field, fp)
+            # build the view's aligned layout eagerly and charge it to the
+            # SAME byte budget as fp itself: it is a second device copy of
+            # the filtered postings, and the LRU cap must see both
+            al = get_aligned(view, field)
+            if al is not None:
+                fp.nbytes += al.nbytes
+                _FILTERED_BYTES[0] += al.nbytes
+            fp.view = view
+    return fp.view
+
+
+class _PseudoLT:
+    """LTerms-shaped adapter for a family-only bool spec, so it can ride
+    the pure pruned pipeline over a FilteredSegView."""
+
+    def __init__(self, spec: FastSpec):
+        self.field = spec.field
+        self.terms = [t for t, _w, _c in spec.slots]
+        self.weights = np.asarray([w for _t, w, _c in spec.slots],
+                                  np.float32)
+        self.raw_boosts = self.weights
+        # all-required slots (operator=and) == msm over every term
+        self.msm = (len(spec.slots) if spec.n_required == len(spec.slots)
+                    else max(int(spec.fam_msm), 1))
+        self.sim = spec.sim
+        self.has_norms = spec.has_norms
+        self.aux = None
+
+
+def _family_only(spec: FastSpec) -> bool:
+    """bool spec == a single term group + filters, where the pass rule is
+    a plain minimum-match count: either one counted family (shoulds /
+    msm), or ALL slots required (operator=and -> msm = nterms). Both are
+    a pure msm term group over the filtered doc set."""
+    if not (spec.kind == "bool" and spec.filter_clauses
+            and spec.const_score is None and spec.field is not None
+            and len(spec.slots) > 0
+            and spec.sim is not None and spec.sim.sim_id == ops.SIM_BM25):
+        return False
+    counted_family = (spec.fam_msm >= 1
+                      and all(cw == 1 for _t, _w, cw in spec.slots))
+    all_required = (spec.n_required == len(spec.slots)
+                    and spec.fam_msm == 0)
+    return counted_family or all_required
 
 
 def _dense_hot(seg: Segment, fl: FilterList, nslots: int) -> bool:
@@ -1328,11 +1408,53 @@ def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
             for i, r in zip(pure_idx, rs):
                 out[i] = r
     if bool_idx:
+        # family-only bool specs over a dense hot filter ride the PURE
+        # pruned pipeline on the filter-specialized postings view —
+        # impact heads cut the per-query work from O(filtered df) to
+        # O(L_HEAD) exactly like unfiltered match queries
+        still_bool = []
+        for i in bool_idx:
+            r = _try_filtered_pure(seg, ctx, specs[i], K)
+            if r is not None:
+                out[i] = r
+            else:
+                still_bool.append(i)
+        bool_idx = still_bool
+    if bool_idx:
         for i, r in zip(bool_idx,
                         _run_bool(seg, ctx, [specs[i] for i in bool_idx], K)):
             out[i] = r
     if count_stats:
         count_served(specs, out)
+    return out
+
+
+def _try_filtered_pure(seg: Segment, ctx, spec: FastSpec, K: int
+                       ) -> Optional[dict]:
+    """Serve a family-only filtered bool spec through the pure pruned
+    pipeline over the FilteredSegView; None -> regular bool path."""
+    if not _family_only(spec):
+        return None
+    fl = _filter_list(seg, ctx, spec.filter_clauses)
+    if fl is None or not _dense_hot(seg, fl, len(spec.slots)):
+        return None
+    fp = _filtered_postings(seg, spec.field, fl)
+    if fp is None:
+        return None
+    view = _filtered_view(seg, spec.field, fp)
+    res = _run_pure(view, ctx, [_PseudoLT(spec)], [spec], K)
+    if res is None or res[0] is None:
+        return None   # the bool fallback will count this query's hit
+    fl.hits += 1
+    out = res[0]
+    if spec.boost != 1.0:
+        sc = out["topk_scores"]
+        finite = np.isfinite(sc)
+        sc = np.where(finite, sc * np.float32(spec.boost),
+                      sc).astype(np.float32)
+        out = dict(out, topk_scores=sc, topk_key=sc,
+                   max_score=(float(sc[0]) if out["total"] > 0
+                              and np.isfinite(sc[0]) else -np.inf))
     return out
 
 

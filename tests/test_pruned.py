@@ -230,6 +230,93 @@ class TestPrunedProperty:
                                        ref["topk_scores"][:n], rtol=2e-5)
 
 
+class TestFilteredPure:
+    def test_filtered_bool_rides_pruned_pure_pipeline(self, monkeypatch):
+        """Family-only bool specs over a dense hot filter serve through
+        the pure pruned pipeline on the FilteredSegView, matching the XLA
+        filtered path exactly."""
+        from opensearch_tpu.rest.client import RestClient
+
+        monkeypatch.setattr(fastpath, "L_HEAD", 64)
+        monkeypatch.setattr(fastpath, "fused_bm25_topk_tfdl",
+                            sim_fused_bm25_topk_tfdl)
+        monkeypatch.setattr(fastpath, "_backend_ok", True)
+        monkeypatch.setattr(fastpath, "_MATERIALIZE_MIN_DOCS", 16)
+        # skip the warm-up hop through the (TPU-only) bool kernel: treat
+        # the retained filter as hot immediately so every call takes the
+        # specialized-view pure path the test is about
+        monkeypatch.setattr(fastpath, "_dense_hot",
+                            lambda seg, fl, nslots: fl.mask is not None)
+        rng = np.random.default_rng(41)
+        c = RestClient()
+        c.indices.create("fb", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 0},
+            "mappings": {"properties": {
+                "status": {"type": "keyword"}, "body": {"type": "text"}}}})
+        for i in range(4000):
+            body = []
+            if rng.random() < 0.6:
+                body.extend(["common"] * int(rng.integers(1, 4)))
+            body.append(f"w{int(rng.integers(0, 30))}")
+            c.index("fb", {"body": " ".join(body),
+                           "status": ("pub", "draft")[i % 2]},
+                    id=f"{i:05d}")
+        c.indices.refresh("fb")
+        c.indices.forcemerge("fb")
+        bodies = [
+            {"query": {"bool": {"must": [{"match": {"body": "common w3"}}],
+                                "filter": [{"term": {"status": "pub"}}]}},
+             "size": 10},
+            {"query": {"bool": {
+                "must": [{"match": {"body": {"query": "common w5",
+                                             "operator": "and"}}}],
+                "filter": [{"term": {"status": "pub"}}]}}, "size": 10},
+        ]
+        for body in bodies:
+            # first call warms the filter (merge-slot path), the second
+            # takes the dense-hot specialized view
+            for rep in range(3):
+                before = dict(fastpath.STATS)
+                rm = c.search("fb", dict(body, _rep=rep))
+                assert fastpath.STATS["bool_served"] == \
+                    before["bool_served"] + 1
+                fastpath.set_enabled(False)
+                try:
+                    rh = c.search("fb", dict(body, _ref=rep))
+                finally:
+                    fastpath.set_enabled(True)
+                assert rm["hits"]["total"]["value"] <= \
+                    rh["hits"]["total"]["value"]
+                if rm["hits"]["total"]["relation"] == "eq":
+                    assert rm["hits"]["total"] == rh["hits"]["total"]
+                assert [h["_id"] for h in rm["hits"]["hits"]] == \
+                    [h["_id"] for h in rh["hits"]["hits"]], (body, rep)
+                sm = [round(h["_score"], 4) for h in rm["hits"]["hits"]]
+                sh = [round(h["_score"], 4) for h in rh["hits"]["hits"]]
+                assert sm == sh, (body, rep)
+        # the view path genuinely engaged (pruned or exact over the view)
+        assert fastpath.STATS["pruned_served"] + \
+            fastpath.STATS["pruned_escalated"] > 0
+        # regression: a term whose FILTERED row is empty (present in the
+        # vocab, zero postings pass the filter) must not crash the verify
+        # rescore — index a draft-only term and query it under status=pub
+        c.index("fb", {"body": "draftonly common", "status": "draft"},
+                id="dr1")
+        c.indices.refresh("fb")
+        c.indices.forcemerge("fb")
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "common draftonly"}}],
+            "filter": [{"term": {"status": "pub"}}]}}, "size": 5}
+        rm = c.search("fb", dict(body, _e=1))
+        fastpath.set_enabled(False)
+        try:
+            rh = c.search("fb", dict(body, _e=2))
+        finally:
+            fastpath.set_enabled(True)
+        assert [h["_id"] for h in rm["hits"]["hits"]] == \
+            [h["_id"] for h in rh["hits"]["hits"]]
+
+
 class TestShardView:
     def test_multi_segment_single_launch_parity(self, small_head):
         """A many-segment shard serves pure term-group queries as ONE
