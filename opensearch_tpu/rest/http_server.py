@@ -135,7 +135,18 @@ class _Handler(BaseHTTPRequestHandler):
                              for r in rows)
             return 200, text + "\n"
         if head == "_search":
-            return 200, c.search("_all", self._json_body() or {})
+            if len(parts) > 1 and parts[1] == "scroll":
+                body = self._json_body() or {}
+                # id may arrive in the body, query string, or URL path
+                sid = body.get("scroll_id", params.get("scroll_id"))
+                if sid is None and len(parts) > 2:
+                    sid = parts[2]
+                if method == "DELETE":
+                    return 200, c.clear_scroll(sid)
+                return 200, c.scroll(sid, scroll=body.get(
+                    "scroll", params.get("scroll")))
+            return 200, c.search("_all", self._json_body() or {},
+                                 scroll=params.get("scroll"))
         if head == "_msearch":
             return 200, c.msearch(self._ndjson_body())
         if head == "_bulk":
@@ -145,6 +156,20 @@ class _Handler(BaseHTTPRequestHandler):
                                                               "false")))
         if head == "_mget":
             return 200, c.mget(self._json_body())
+        if head == "_tasks":
+            if parts[-1] == "_cancel":
+                if method != "POST":
+                    raise ApiError(405, "method_not_allowed",
+                                   "cancel requires POST")
+                if len(parts) >= 3:
+                    return 200, c.cancel_task(parts[1])
+                # cancel-all form: POST /_tasks/_cancel[?actions=...]
+                cancelled = []
+                for t in c.node.tasks.list(params.get("actions")):
+                    if c.node.tasks.cancel(t["id"]):
+                        cancelled.append(t["id"])
+                return 200, {"nodes": {}, "cancelled": cancelled}
+            return 200, c.tasks(params.get("actions"))
         if head == "_stats":
             return 200, c.node.stats()
         if head == "_remotestore":
@@ -209,7 +234,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return 200, c.update(index, rest[1], self._json_body() or {},
                                      routing=params.get("routing"))
         if op == "_search":
-            return 200, c.search(index, self._json_body() or {})
+            return 200, c.search(index, self._json_body() or {},
+                                 scroll=params.get("scroll"))
         if op == "_msearch":
             body = self._ndjson_body()
             return 200, c.msearch(body, index=index)

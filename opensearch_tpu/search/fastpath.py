@@ -1065,16 +1065,19 @@ class FilteredSegView:
         self.doc_lens = seg.doc_lens
 
 
-def _filtered_view(seg: Segment, field: str, fp: "FilteredPostings"
-                   ) -> FilteredSegView:
+def _filtered_view(seg: Segment, field: str, fp: "FilteredPostings",
+                   key) -> FilteredSegView:
     with _FILTERED_LOCK:
         if fp.view is None:
             view = FilteredSegView(seg, field, fp)
             # build the view's aligned layout eagerly and charge it to the
             # SAME byte budget as fp itself: it is a second device copy of
-            # the filtered postings, and the LRU cap must see both
+            # the filtered postings, and the LRU cap must see both. Only
+            # account while fp is still a live LRU member — a concurrent
+            # eviction already subtracted fp.nbytes, and inflating the
+            # counter for a dead entry would never be undone
             al = get_aligned(view, field)
-            if al is not None:
+            if al is not None and _FILTERED_LRU.get(key) is fp:
                 fp.nbytes += al.nbytes
                 _FILTERED_BYTES[0] += al.nbytes
             fp.view = view
@@ -1412,14 +1415,11 @@ def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
         # pruned pipeline on the filter-specialized postings view —
         # impact heads cut the per-query work from O(filtered df) to
         # O(L_HEAD) exactly like unfiltered match queries
-        still_bool = []
-        for i in bool_idx:
-            r = _try_filtered_pure(seg, ctx, specs[i], K)
-            if r is not None:
-                out[i] = r
-            else:
-                still_bool.append(i)
-        bool_idx = still_bool
+        served = _try_filtered_pure_batch(
+            seg, ctx, [(i, specs[i]) for i in bool_idx], K)
+        for i, r in served.items():
+            out[i] = r
+        bool_idx = [i for i in bool_idx if i not in served]
     if bool_idx:
         for i, r in zip(bool_idx,
                         _run_bool(seg, ctx, [specs[i] for i in bool_idx], K)):
@@ -1429,32 +1429,44 @@ def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
     return out
 
 
-def _try_filtered_pure(seg: Segment, ctx, spec: FastSpec, K: int
-                       ) -> Optional[dict]:
-    """Serve a family-only filtered bool spec through the pure pruned
-    pipeline over the FilteredSegView; None -> regular bool path."""
-    if not _family_only(spec):
-        return None
-    fl = _filter_list(seg, ctx, spec.filter_clauses)
-    if fl is None or not _dense_hot(seg, fl, len(spec.slots)):
-        return None
-    fp = _filtered_postings(seg, spec.field, fl)
-    if fp is None:
-        return None
-    view = _filtered_view(seg, spec.field, fp)
-    res = _run_pure(view, ctx, [_PseudoLT(spec)], [spec], K)
-    if res is None or res[0] is None:
-        return None   # the bool fallback will count this query's hit
-    fl.hits += 1
-    out = res[0]
-    if spec.boost != 1.0:
-        sc = out["topk_scores"]
-        finite = np.isfinite(sc)
-        sc = np.where(finite, sc * np.float32(spec.boost),
-                      sc).astype(np.float32)
-        out = dict(out, topk_scores=sc, topk_key=sc,
-                   max_score=(float(sc[0]) if out["total"] > 0
-                              and np.isfinite(sc[0]) else -np.inf))
+def _try_filtered_pure_batch(seg: Segment, ctx, idx_specs, K: int) -> dict:
+    """Serve family-only filtered bool specs through the pure pruned
+    pipeline over their FilteredSegViews, ONE _run_pure per (field,
+    filter) group so an msearch batch pays one launch per view, not one
+    per query. -> {spec index: result dict}; missing indices take the
+    regular bool path."""
+    groups: dict = {}
+    for i, spec in idx_specs:
+        if not _family_only(spec):
+            continue
+        fl = _filter_list(seg, ctx, spec.filter_clauses)
+        if fl is None or not _dense_hot(seg, fl, len(spec.slots)):
+            continue
+        fp = _filtered_postings(seg, spec.field, fl)
+        if fp is None:
+            continue
+        key = (seg.uid, spec.field, fl.key)
+        groups.setdefault(key, (spec.field, fl, fp, []))[3].append((i, spec))
+    out: dict = {}
+    for key, (field, fl, fp, items) in groups.items():
+        view = _filtered_view(seg, field, fp, key)
+        res = _run_pure(view, ctx, [_PseudoLT(s) for _, s in items],
+                        [s for _, s in items], K)
+        if res is None:
+            continue
+        for (i, spec), r in zip(items, res):
+            if r is None:
+                continue   # the bool fallback will count this query's hit
+            fl.hits += 1
+            if spec.boost != 1.0:
+                sc = r["topk_scores"]
+                sc = np.where(np.isfinite(sc),
+                              sc * np.float32(spec.boost),
+                              sc).astype(np.float32)
+                r = dict(r, topk_scores=sc, topk_key=sc,
+                         max_score=(float(sc[0]) if r["total"] > 0
+                                    and np.isfinite(sc[0]) else -np.inf))
+            out[i] = r
     return out
 
 

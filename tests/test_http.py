@@ -135,6 +135,47 @@ class TestHttpBasics:
         status, text = req(port, "GET", "/_cat/indices")
         assert status == 200 and isinstance(text, str)
 
+    def test_scroll_and_tasks_over_http(self, srv):
+        _, port = srv
+        req(port, "PUT", "/scr")
+        lines = []
+        for i in range(25):
+            lines.append({"index": {"_index": "scr", "_id": str(i)}})
+            lines.append({"n": i})
+        req(port, "POST", "/_bulk?refresh=true", ndjson=lines)
+        status, first = req(port, "POST", "/scr/_search?scroll=1m",
+                            {"query": {"match_all": {}}, "size": 10,
+                             "sort": [{"n": "asc"}]})
+        assert status == 200 and "_scroll_id" in first
+        seen = [h["_source"]["n"] for h in first["hits"]["hits"]]
+        sid = first["_scroll_id"]
+        while True:
+            status, page = req(port, "POST", "/_search/scroll",
+                               {"scroll_id": sid, "scroll": "1m"})
+            assert status == 200
+            if not page["hits"]["hits"]:
+                break
+            seen.extend(h["_source"]["n"] for h in page["hits"]["hits"])
+            sid = page["_scroll_id"]
+        assert seen == list(range(25))
+        status, body = req(port, "DELETE", "/_search/scroll",
+                           {"scroll_id": sid})
+        assert status == 200
+        status, body = req(port, "GET", "/_tasks")
+        assert status == 200
+        assert "nodes" in body
+        # cancel-all form routes correctly (nothing running -> empty list)
+        status, body = req(port, "POST", "/_tasks/_cancel")
+        assert status == 200 and body["cancelled"] == []
+        # all-indices scroll opens a context too
+        status, allscroll = req(port, "POST", "/_search?scroll=1m",
+                                {"query": {"match_all": {}}, "size": 3})
+        assert status == 200 and "_scroll_id" in allscroll
+        # scroll id in the URL path form
+        status, nxt = req(port, "POST",
+                          f"/_search/scroll/{allscroll['_scroll_id']}")
+        assert status == 200
+
     def test_mapping_settings_roundtrip(self, srv):
         _, port = srv
         req(port, "PUT", "/maps", {"mappings": {"properties": {
