@@ -23,9 +23,14 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..index.segment import next_pow2
-from .spmd import StackedShardIndex, build_distributed_search, make_mesh
+from .spmd import (StackedShardIndex, build_distributed_metrics,
+                   build_distributed_search, make_mesh)
 
 MAX_WINDOW = 1024
+
+# metric agg kinds the mesh can reduce with psum/pmin/pmax (plain
+# {"field": ...} bodies only — anything fancier takes the host loop)
+_MESH_METRICS = ("min", "max", "sum", "avg", "value_count", "stats")
 
 
 class MeshSearchService:
@@ -35,6 +40,11 @@ class MeshSearchService:
         self._meshes: Dict[int, object] = {}
         self._stacked: Dict[Tuple[str, str], Tuple[int, StackedShardIndex]] = {}
         self._programs: Dict[Tuple, object] = {}
+        import collections
+        self._metric_programs: Dict[Tuple, object] = {}
+        # (index, field) -> (generation, arrays-or-None, nbytes); LRU
+        self._stacked_cols: "collections.OrderedDict" = \
+            collections.OrderedDict()
         self.dispatched = 0      # searches served by the mesh
         self.fallbacks = 0       # searches declined -> host loop
 
@@ -73,6 +83,63 @@ class MeshSearchService:
                                           k1=k1, b=b)
             self._programs[key] = fn
         return fn
+
+    def _metric_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                            k1: float, b: float):
+        key = (id(mesh), bucket, ndocs_pad, k1, b)
+        fn = self._metric_programs.get(key)
+        if fn is None:
+            fn = build_distributed_metrics(mesh, bucket=bucket,
+                                           ndocs_pad=ndocs_pad, k1=k1, b=b)
+            self._metric_programs[key] = fn
+        return fn
+
+    _COLS_MAX_BYTES = 1 << 30   # device budget for stacked agg columns
+
+    def _col_for(self, name: str, svc, field: str, shard_segs,
+                 d_pad: int, mesh) -> Optional[tuple]:
+        """Stacked numeric column + presence mask [S, d_pad] sharded over
+        the mesh, in the SAME per-shard concatenated doc space as the
+        stacked postings; None when no segment has the column. Cached
+        (incl. negative results) per generation under a byte-bounded LRU."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (name, field)
+        cached = self._stacked_cols.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            self._stacked_cols.move_to_end(key)
+            return cached[1]
+        # cheap membership test BEFORE any allocation: declining a text/
+        # missing field must not zero megabytes per request
+        if not any(field in seg.numeric_cols
+                   for segs in shard_segs for seg in segs):
+            self._stacked_cols[key] = (svc.generation, None, 0)
+            return None
+        S = len(shard_segs)
+        col = np.zeros((S, d_pad), np.float32)
+        pres = np.zeros((S, d_pad), np.float32)
+        for si, segs in enumerate(shard_segs):
+            off = 0
+            for seg in segs:
+                nc = seg.numeric_cols.get(field)
+                if nc is not None:
+                    col[si, off: off + seg.ndocs] = \
+                        nc.values.astype(np.float32)
+                    pres[si, off: off + seg.ndocs] = \
+                        nc.present.astype(np.float32)
+                off += seg.ndocs
+        sharding = NamedSharding(mesh, P("shard"))
+        out = (jax.device_put(col, sharding),
+               jax.device_put(pres, sharding))
+        self._stacked_cols[key] = (svc.generation, out,
+                                   col.nbytes + pres.nbytes)
+        # byte-bounded LRU so long-lived nodes aggregating over many
+        # fields/indices can't pin device columns forever
+        while sum(v[2] for v in self._stacked_cols.values()) \
+                > self._COLS_MAX_BYTES and len(self._stacked_cols) > 1:
+            self._stacked_cols.popitem(last=False)
+        return out
 
     # ---------------- dispatch ----------------
 
@@ -113,7 +180,7 @@ class MeshSearchService:
         stats = _global_stats_contexts(searchers)
         ctx = stats[0]
 
-        parsed = []   # (qi, lt, sort_specs, window, const_score)
+        parsed = []   # (qi, lt, sort_specs, window, const_score, aggs)
         for qi, body in enumerate(bodies):
             try:
                 query = dsl.parse_query(body.get("query"))
@@ -131,7 +198,8 @@ class MeshSearchService:
                 continue
             const = (float(getattr(lroot, "boost", 1.0) or 1.0)
                      if lroot.mode == "filter" else 0.0)
-            parsed.append((qi, lroot, sort_specs, window, const))
+            parsed.append((qi, lroot, sort_specs, max(window, 1), const,
+                           agg_nodes or []))
         if not parsed:
             return out
 
@@ -141,7 +209,7 @@ class MeshSearchService:
         # and every distinct K is its own compiled program
         groups: dict = {}
         for item in parsed:
-            qi, lt, sort_specs, window, const = item
+            qi, lt, sort_specs, window, const, aggs = item
             sim = lt.sim
             k1 = float(sim.k1) if sim is not None else 1.2
             b_eff = (float(sim.b)
@@ -174,8 +242,20 @@ class MeshSearchService:
                 # deeper page than the program's merged top-k capacity
                 # (tiny shards): that body takes the host loop
                 self.fallbacks += 1
-            else:
-                keep.append(it)
+                continue
+            # metric aggs need their stacked columns; a missing column
+            # means the host loop serves that body
+            agg_ok = True
+            for an in it[5]:
+                if self._col_for(name, svc, an.body["field"], shard_segs,
+                                 stacked.ndocs_pad,
+                                 self._mesh_for(S)) is None:
+                    agg_ok = False
+                    break
+            if not agg_ok:
+                self.fallbacks += 1
+                continue
+            keep.append(it)
         items = keep
         if not items:
             return
@@ -188,7 +268,8 @@ class MeshSearchService:
         msm = np.ones(QB, np.float32)
         cscore = np.zeros(QB, np.float32)
         total_max = 1
-        for bi, (qi, lt, sort_specs, window, const) in enumerate(items):
+        for bi, (qi, lt, sort_specs, window, const, aggs) in \
+                enumerate(items):
             nt = len(lt.terms)
             boosts[bi, :nt] = lt.raw_boosts[:nt]
             msm[bi] = float(lt.msm)
@@ -210,13 +291,29 @@ class MeshSearchService:
         gdocs_b, gvals_b, totals_b = fn(stacked.tree(), rows, boosts, msm,
                                         cscore)
         import jax
-        gdocs_b, gvals_b, totals_b = jax.device_get(
-            (gdocs_b, gvals_b, totals_b))
+
+        # metric aggs: one psum/pmin/pmax reduce per distinct field over
+        # the whole batch (items without that agg just ignore its column)
+        agg_fields = sorted({an.body["field"] for it in items
+                             for an in it[5]})
+        metrics_by_field = {}
+        if agg_fields:
+            mfn = self._metric_program_for(mesh, bucket, stacked.ndocs_pad,
+                                           k1, b_eff)
+            for f in agg_fields:
+                col, pres = self._col_for(name, svc, f, shard_segs,
+                                          stacked.ndocs_pad, mesh)
+                metrics_by_field[f] = mfn(stacked.tree(), rows, boosts,
+                                          msm, cscore, col, pres)
+        fetched = jax.device_get((gdocs_b, gvals_b, totals_b,
+                                  metrics_by_field))
+        gdocs_b, gvals_b, totals_b, metrics_by_field = fetched
 
         doc_base = np.asarray(stacked.doc_base)
         seg_bases = [np.cumsum([0] + ndocs[:-1])
                      for ndocs in stacked.seg_ndocs]
-        for bi, (qi, lt, sort_specs, window, const) in enumerate(items):
+        for bi, (qi, lt, sort_specs, window, const, aggs) in \
+                enumerate(items):
             gdocs = gdocs_b[bi]
             gvals = gvals_b[bi]
             total = int(totals_b[bi])
@@ -242,13 +339,24 @@ class MeshSearchService:
                                                         local, sc)
                 results[si].candidates.append(
                     Candidate(si, seg_ord, local, sc, sort_vals, raw_vals))
+            # attach the globally-reduced metric partials to shard 0 (the
+            # values are already psum'd across the mesh; the coordinator
+            # merge sees exactly one partial per agg)
+            for an in aggs:
+                m = metrics_by_field[an.body["field"]][bi]
+                cnt = float(m[0])
+                results[0].agg_partials[an.name] = [{
+                    "count": cnt, "sum": float(m[1]),
+                    "min": float(m[2]) if cnt > 0 else float("inf"),
+                    "max": float(m[3]) if cnt > 0 else float("-inf"),
+                    "sumsq": float(m[4])}]
             for r in results:
                 r.took_ms = (time.monotonic() - t0) * 1000.0
             self.dispatched += 1
             body = dict(bodies[qi])
             body["_index_name"] = name
             out[qi] = _finish_search(searchers, results, body, stats, name,
-                                     t0, [])
+                                     t0, aggs)
 
     def _eligible(self, lt, sort_specs, agg_nodes, named_nodes, body,
                   window: int) -> bool:
@@ -262,9 +370,15 @@ class MeshSearchService:
                 is not None or body.get("profile") or body.get("collapse") \
                 or body.get("suggest") or body.get("search_after") is not None:
             return False
-        if agg_nodes or named_nodes:
+        if named_nodes:
             return False
-        if window > MAX_WINDOW or window < 1:
+        # metric-only aggregations reduce over the mesh (psum/pmin/pmax);
+        # anything bucketed or scripted takes the host loop
+        for an in (agg_nodes or []):
+            if an.kind not in _MESH_METRICS or an.subs \
+                    or set(an.body) != {"field"}:
+                return False
+        if window > MAX_WINDOW or (window < 1 and not agg_nodes):
             return False
         if sort_specs and not (len(sort_specs) == 1
                                and sort_specs[0]["field"] == "_score"

@@ -339,6 +339,73 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
     return call
 
 
+def build_distributed_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
+                              k1: float = 1.2, b: float = 0.75):
+    """Metric aggregations over the mesh: re-evaluate each query's match
+    mask shard-locally (same scoring program shape), then psum/pmin/pmax
+    the masked column moments over the `shard` axis — the device-side
+    analog of the reference's per-shard metric collectors + coordinator
+    InternalAggregation#reduce. Returns a callable:
+        (tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB],
+         col [S,D_pad], present [S,D_pad]) ->
+        f32[QB, 5] = (count, sum, min, max, sumsq), already global."""
+
+    def per_device(tree, rows, boosts, msm, cscore, col, present):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        colv = col[0]
+        pres = present[0]
+
+        nrows_pad = starts.shape[0]
+        safe_rows = jnp.where(rows < 0, nrows_pad - 2, rows)
+        local_df = (starts[safe_rows + 1] - starts[safe_rows]).astype(
+            jnp.float32)
+        df_global = jax.lax.psum(local_df, "shard")
+        n_global = jax.lax.psum(tree["doc_count"][0], "shard")
+        sum_dl_g = jax.lax.psum(tree["sum_dl"][0], "shard")
+        fdc_g = jax.lax.psum(tree["field_dc"][0], "shard")
+        avgdl = jnp.where(fdc_g > 0, sum_dl_g / jnp.maximum(fdc_g, 1.0), 1.0)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b)
+            ok = (scores > -jnp.inf) & (pres > 0)
+            okf = ok.astype(jnp.float32)
+            cnt = jnp.sum(okf)
+            s = jnp.sum(jnp.where(ok, colv, 0.0))
+            ssq = jnp.sum(jnp.where(ok, colv * colv, 0.0))
+            mn = jnp.min(jnp.where(ok, colv, jnp.inf))
+            mx = jnp.max(jnp.where(ok, colv, -jnp.inf))
+            return jnp.stack([cnt, s, mn, mx, ssq])
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)  # [QB,5]
+        out = jnp.stack([
+            jax.lax.psum(part[:, 0], "shard"),
+            jax.lax.psum(part[:, 1], "shard"),
+            jax.lax.pmin(part[:, 2], "shard"),
+            jax.lax.pmax(part[:, 3], "shard"),
+            jax.lax.psum(part[:, 4], "shard"),
+        ], axis=1)
+        return out
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    fn = shard_map(per_device, mesh=mesh,
+                   in_specs=(tree_spec, P("shard", "replica"), P("replica"),
+                             P("replica"), P("replica"), P("shard"),
+                             P("shard")),
+                   out_specs=P("replica"),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
 def build_term_sharded_score(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
                              k1: float = 1.2, b: float = 0.75):
     """Sequence-parallel analog: ONE doc space replicated, posting rows of the

@@ -541,7 +541,12 @@ class RestClient:
         if scroll_id:
             ids = scroll_id if isinstance(scroll_id, list) else [scroll_id]
         if body:
-            ids.extend(body.get("scroll_id", []))
+            bid = body.get("scroll_id", [])
+            ids.extend(bid if isinstance(bid, list) else [bid])
+        if any(sid in ("_all", "*") for sid in ids):
+            n = len(self._scrolls)
+            self._scrolls.clear()
+            return {"succeeded": True, "num_freed": n}
         n = 0
         for sid in ids:
             if self._scrolls.pop(sid, None) is not None:

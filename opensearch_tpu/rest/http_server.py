@@ -169,6 +169,15 @@ class _Handler(BaseHTTPRequestHandler):
                     if c.node.tasks.cancel(t["id"]):
                         cancelled.append(t["id"])
                 return 200, {"nodes": {}, "cancelled": cancelled}
+            if len(parts) == 2:
+                # single-task form: GET /_tasks/{id}
+                for t in c.node.tasks.list(None):
+                    if str(t["id"]) == parts[1]:
+                        return 200, {"completed": t.get("cancelled", False)
+                                     or not t.get("running", True),
+                                     "task": t}
+                raise ApiError(404, "resource_not_found_exception",
+                               f"task [{parts[1]}] not found")
             return 200, c.tasks(params.get("actions"))
         if head == "_stats":
             return 200, c.node.stats()

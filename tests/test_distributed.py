@@ -199,7 +199,8 @@ class TestMeshService:
             c.indices.create("idx", {
                 "settings": {"number_of_shards": 4},
                 "mappings": {"properties": {
-                    "cat": {"type": "keyword"}, "body": {"type": "text"}}}})
+                    "cat": {"type": "keyword"}, "body": {"type": "text"},
+                    "num": {"type": "integer"}}}})
             bulk = []
             # 1600 docs over 4 shards -> per-shard ndocs_pad 512, so deep
             # windows (>128) stay mesh-servable (window <= K)
@@ -208,7 +209,7 @@ class TestMeshService:
                 body = " ".join(rng.choice(WORDS, size=int(rng.integers(3, 12))))
                 if i == 7:
                     body += " solitaryterm"  # lives in exactly one shard's dict
-                bulk.append({"body": body, "cat": cats[i % 3]})
+                bulk.append({"body": body, "cat": cats[i % 3], "num": i})
             c.bulk(bulk)
             c.indices.refresh("idx")
             c.indices.forcemerge("idx")
@@ -320,6 +321,47 @@ class TestMeshService:
             assert rm["hits"]["total"] == rh["hits"]["total"]
             assert [h["_id"] for h in rm["hits"]["hits"]] == \
                 [h["_id"] for h in rh["hits"]["hits"]]
+
+    @pytest.mark.parametrize("body", [
+        {"query": {"match": {"body": "alpha beta"}}, "size": 5,
+         "aggs": {"s": {"sum": {"field": "num"}},
+                  "a": {"avg": {"field": "num"}},
+                  "vc": {"value_count": {"field": "num"}}}},
+        {"query": {"term": {"cat": "kitchen"}}, "size": 0,
+         "aggs": {"st": {"stats": {"field": "num"}},
+                  "mn": {"min": {"field": "num"}},
+                  "mx": {"max": {"field": "num"}}}},
+    ])
+    def test_metric_aggs_reduce_over_mesh(self, clients, body):
+        """Metric-only aggregations psum/pmin/pmax over the mesh and match
+        the host loop; the query phase and aggs share one dispatch."""
+        cm, ch = clients
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            f"mesh path did not engage for {body}"
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        assert [h["_id"] for h in rm["hits"]["hits"]] == \
+            [h["_id"] for h in rh["hits"]["hits"]]
+        for name, agg in rh["aggregations"].items():
+            got = rm["aggregations"][name]
+            for k, v in agg.items():
+                if isinstance(v, (int, float)) and v is not None:
+                    assert abs(got[k] - v) <= 1e-3 * max(1.0, abs(v)), \
+                        (name, k, got, agg)
+                else:
+                    assert (got[k] is None) == (v is None), (name, k)
+
+    def test_bucket_aggs_fall_back(self, clients):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 3,
+                "aggs": {"t": {"terms": {"field": "cat"}}}}
+        before = cm.node.mesh_service.fallbacks
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.fallbacks > before
+        assert rm["aggregations"] == rh["aggregations"]
 
     def test_msearch_batches_through_mesh(self, clients):
         """An msearch of N eligible term-group bodies runs as ONE grouped
