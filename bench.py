@@ -357,11 +357,33 @@ def main():
         return native.maxscore_topk(starts, doc_ids, tfs, kdoc, idf, ub,
                                     np.asarray(q, np.int32), msm, TOPK, filt)
 
+    # PINNED baseline protocol (r4 verdict: the honest baseline swung 5x
+    # between rounds because one cold pass over mmap'd .bench_cache arrays
+    # pays disk page faults that an in-RAM build does not). Pin it:
+    #   1. materialize the posting arrays in RAM (the device path gets the
+    #      corpus resident in HBM; the CPU scorer gets it resident in DRAM),
+    #   2. one warm pass over the FIXED 256-query set,
+    #   3. >=3 timed passes; report the MEDIAN qps + min/max spread.
+    starts = np.ascontiguousarray(starts)
+    doc_ids = np.ascontiguousarray(doc_ids)
+    tfs = np.ascontiguousarray(tfs)
     ncpu = min(nq, 256)
-    t0 = time.time()
-    cpu1 = [cpu_match(q[:2]) for q in queries[:ncpu]]
-    cpu1_s = time.time() - t0
-    cpu1_qps = ncpu / cpu1_s
+    BASE_REPS = 3
+
+    def timed_passes(fn, n, reps=BASE_REPS):
+        """warm + reps timed passes -> (results, median_qps, spread)."""
+        res = fn(n)                      # warm (page-in, branch predictors)
+        qps = []
+        for _ in range(reps):
+            t0 = time.time()
+            res = fn(n)
+            qps.append(n / (time.time() - t0))
+        return res, float(np.median(qps)), \
+            {"min": round(min(qps), 1), "max": round(max(qps), 1),
+             "reps": reps}
+
+    cpu1, cpu1_qps, cpu1_spread = timed_passes(
+        lambda n: [cpu_match(q[:2]) for q in queries[:n]], ncpu)
 
     # config 2 shapes: i%3==0 filtered OR, ==1 AND conjunction, ==2 filtered
     # 3-term msm=2
@@ -372,13 +394,14 @@ def main():
             return q[:2], 2, "pubprice"
         return q[:3], 2, "draft"
 
-    t0 = time.time()
-    cpu2 = []
-    for i in range(ncpu):
-        qt, msm, fk = bool_shape(i, queries[i])
-        cpu2.append(cpu_match(qt, msm, fmasks_u8[fk]))
-    cpu2_s = time.time() - t0
-    cpu2_qps = ncpu / cpu2_s
+    def _cpu2_pass(n):
+        out = []
+        for i in range(n):
+            qt, msm, fk = bool_shape(i, queries[i])
+            out.append(cpu_match(qt, msm, fmasks_u8[fk]))
+        return out
+
+    cpu2, cpu2_qps, cpu2_spread = timed_passes(_cpu2_pass, ncpu)
 
     # record the CPU baselines BEFORE any device/backend touch: on a
     # tunneled-TPU host the first backend init can hang for many minutes,
@@ -389,7 +412,12 @@ def main():
         "baseline": "C++ MaxScore/conjunction skipping scorer (native/), "
                     "single core; published CPU-Lucene band 50-150 q/s/core",
         "cpu_maxscore_match_qps": round(cpu1_qps, 1),
+        "cpu_maxscore_match_spread": cpu1_spread,
         "cpu_maxscore_bool_qps": round(cpu2_qps, 1),
+        "cpu_maxscore_bool_spread": cpu2_spread,
+        "baseline_protocol": "pinned: arrays resident in RAM, warm pass, "
+                             f"median of {BASE_REPS} passes over the fixed "
+                             f"{ncpu}-query set",
         "configs": {},
         "latency": {},
         "path": "RestClient.msearch -> fastpath Pallas kernels",
@@ -600,15 +628,16 @@ def main():
         ds = {k: fastpath.STATS[k] - before_stats[k] for k in fastpath.STATS}
         served = ds["pure_served"] + ds["bool_served"]
         # CPU MaxScore on the SAME realistic 6-term stream + recall
+        # (pinned protocol: warm + median of timed passes)
         ncpu_r = min(len(queries_real), 128)
-        t0 = time.time()
-        cpu_r = [cpu_match(queries_real[i]) for i in range(ncpu_r)]
-        cpu_r_qps = ncpu_r / (time.time() - t0)
+        cpu_r, cpu_r_qps, cpu_r_spread = timed_passes(
+            lambda n: [cpu_match(queries_real[i]) for i in range(n)], ncpu_r)
         rec_r_tie, _rec_r_strict = recall(resp1r, cpu_r, ncpu_r,
                                           lambda i: queries_real[i])
         extra["configs"]["1r_real_mix"] = {
             "qps": round(qps1r, 1), "nterms": 6,
             "cpu_maxscore_qps": round(cpu_r_qps, 1),
+            "cpu_maxscore_spread": cpu_r_spread,
             "vs_cpu": round(qps1r / cpu_r_qps, 2),
             "recall_at_10_tie_aware": round(rec_r_tie, 4),
             "kernel_served": served, "fallbacks": ds["fallback"],

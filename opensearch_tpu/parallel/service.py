@@ -23,14 +23,52 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..index.segment import next_pow2
-from .spmd import (StackedShardIndex, build_distributed_metrics,
-                   build_distributed_search, make_mesh)
+from .spmd import (INT32_SENTINEL, StackedShardIndex,
+                   build_distributed_metrics, build_distributed_search,
+                   build_distributed_terms_agg, make_mesh)
 
 MAX_WINDOW = 1024
 
 # metric agg kinds the mesh can reduce with psum/pmin/pmax (plain
 # {"field": ...} bodies only — anything fancier takes the host loop)
 _MESH_METRICS = ("min", "max", "sum", "avg", "value_count", "stats")
+
+# keyword `terms` aggs run as an exact device bincount + psum when the
+# field's global ordinal space fits this cap (counts array is [QB, vpad])
+MAX_TERMS_VOCAB = 8192
+
+
+class _ByteLRU:
+    """Byte-budgeted LRU over an OrderedDict: one eviction policy for every
+    device/host cache the service keeps (stacked agg columns, global
+    ordinals, filter masks). Keeps a running byte total so eviction is O(1)
+    per evicted entry."""
+
+    def __init__(self, max_bytes: int):
+        import collections
+        self._od: "collections.OrderedDict" = collections.OrderedDict()
+        self._bytes = 0
+        self._max = max_bytes
+
+    def get(self, key):
+        hit = self._od.get(key)
+        if hit is not None:
+            self._od.move_to_end(key)
+            return hit[0]
+        return None
+
+    def put(self, key, value, nbytes: int) -> None:
+        old = self._od.pop(key, None)
+        if old is not None:
+            self._bytes -= old[1]
+        self._od[key] = (value, nbytes)
+        self._bytes += nbytes
+        while self._bytes > self._max and len(self._od) > 1:
+            _k, (_v, nb) = self._od.popitem(last=False)
+            self._bytes -= nb
+
+    def __len__(self) -> int:
+        return len(self._od)
 
 
 class MeshSearchService:
@@ -40,13 +78,22 @@ class MeshSearchService:
         self._meshes: Dict[int, object] = {}
         self._stacked: Dict[Tuple[str, str], Tuple[int, StackedShardIndex]] = {}
         self._programs: Dict[Tuple, object] = {}
-        import collections
         self._metric_programs: Dict[Tuple, object] = {}
-        # (index, field) -> (generation, arrays-or-None, nbytes); LRU
-        self._stacked_cols: "collections.OrderedDict" = \
-            collections.OrderedDict()
+        self._terms_programs: Dict[Tuple, object] = {}
+        # (index, field) -> (generation, arrays-or-None)
+        self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
+        # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
+        #                    -or-None); smaller caps for the r5 caches so
+        #        the aggregate device budget stays bounded near the original
+        #        1 GiB rather than quadrupling
+        self._stacked_ords = _ByteLRU(self._COLS_MAX_BYTES // 4)
+        # filter-combo key -> per-shard host masks / device stacked mask
+        self._host_masks = _ByteLRU(self._COLS_MAX_BYTES // 4)
+        self._dev_masks = _ByteLRU(self._COLS_MAX_BYTES // 4)
         self.dispatched = 0      # searches served by the mesh
         self.fallbacks = 0       # searches declined -> host loop
+        self.filtered_dispatched = 0   # of dispatched: bool-with-filters
+        self.terms_agg_dispatched = 0  # of dispatched: with a terms agg
 
     # ---------------- caches ----------------
 
@@ -74,24 +121,37 @@ class MeshSearchService:
         return stacked
 
     def _program_for(self, mesh, bucket: int, ndocs_pad: int, k: int,
-                     k1: float, b: float):
-        key = (id(mesh), bucket, ndocs_pad, k, k1, b)
+                     k1: float, b: float, filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, k, k1, b, filtered)
         fn = self._programs.get(key)
         if fn is None:
             fn = build_distributed_search(mesh, bucket=bucket,
                                           ndocs_pad=ndocs_pad, k=k,
-                                          k1=k1, b=b)
+                                          k1=k1, b=b, filtered=filtered)
             self._programs[key] = fn
         return fn
 
     def _metric_program_for(self, mesh, bucket: int, ndocs_pad: int,
-                            k1: float, b: float):
-        key = (id(mesh), bucket, ndocs_pad, k1, b)
+                            k1: float, b: float, filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, k1, b, filtered)
         fn = self._metric_programs.get(key)
         if fn is None:
             fn = build_distributed_metrics(mesh, bucket=bucket,
-                                           ndocs_pad=ndocs_pad, k1=k1, b=b)
+                                           ndocs_pad=ndocs_pad, k1=k1, b=b,
+                                           filtered=filtered)
             self._metric_programs[key] = fn
+        return fn
+
+    def _terms_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                           vpad: int, k1: float, b: float,
+                           filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, vpad, k1, b, filtered)
+        fn = self._terms_programs.get(key)
+        if fn is None:
+            fn = build_distributed_terms_agg(mesh, bucket=bucket,
+                                             ndocs_pad=ndocs_pad, vpad=vpad,
+                                             k1=k1, b=b, filtered=filtered)
+            self._terms_programs[key] = fn
         return fn
 
     _COLS_MAX_BYTES = 1 << 30   # device budget for stacked agg columns
@@ -108,13 +168,12 @@ class MeshSearchService:
         key = (name, field)
         cached = self._stacked_cols.get(key)
         if cached is not None and cached[0] == svc.generation:
-            self._stacked_cols.move_to_end(key)
             return cached[1]
         # cheap membership test BEFORE any allocation: declining a text/
         # missing field must not zero megabytes per request
         if not any(field in seg.numeric_cols
                    for segs in shard_segs for seg in segs):
-            self._stacked_cols[key] = (svc.generation, None, 0)
+            self._stacked_cols.put(key, (svc.generation, None), 0)
             return None
         S = len(shard_segs)
         col = np.zeros((S, d_pad), np.float32)
@@ -132,13 +191,132 @@ class MeshSearchService:
         sharding = NamedSharding(mesh, P("shard"))
         out = (jax.device_put(col, sharding),
                jax.device_put(pres, sharding))
-        self._stacked_cols[key] = (svc.generation, out,
-                                   col.nbytes + pres.nbytes)
         # byte-bounded LRU so long-lived nodes aggregating over many
         # fields/indices can't pin device columns forever
-        while sum(v[2] for v in self._stacked_cols.values()) \
-                > self._COLS_MAX_BYTES and len(self._stacked_cols) > 1:
-            self._stacked_cols.popitem(last=False)
+        self._stacked_cols.put(key, (svc.generation, out),
+                               col.nbytes + pres.nbytes)
+        return out
+
+    def _ord_for(self, name: str, svc, field: str, shard_segs, d_pad: int,
+                 mesh) -> Optional[tuple]:
+        """Stacked keyword GLOBAL-ordinal values for a `terms` agg:
+        (val_doc i32[S, NV], val_ord i32[S, NV], vocab) where val_doc is the
+        per-shard concatenated doc index of each flat keyword value and
+        val_ord its ordinal in the index-wide sorted vocab union — the mesh
+        analog of the reference's global ordinals build
+        (GlobalOrdinalsBuilder). Cached per generation; None when the field
+        has no keyword column or its vocab exceeds MAX_TERMS_VOCAB."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (name, field)
+        cached = self._stacked_ords.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        cols = [[seg.keyword_cols.get(field) for seg in segs]
+                for segs in shard_segs]
+        if not any(c is not None for cs in cols for c in cs):
+            self._stacked_ords.put(key, (svc.generation, None), 0)
+            return None
+        vocab = sorted({v for cs in cols for c in cs if c is not None
+                        for v in c.vocab})
+        if len(vocab) > MAX_TERMS_VOCAB:
+            self._stacked_ords.put(key, (svc.generation, None), 0)
+            return None
+        gord = {v: i for i, v in enumerate(vocab)}
+        S = len(shard_segs)
+        nv = max(max(sum(len(c.ords) for c in cs if c is not None)
+                     for cs in cols), 1)
+        nv_pad = next_pow2(nv, floor=8)
+        val_doc = np.full((S, nv_pad), INT32_SENTINEL, np.int32)
+        val_ord = np.zeros((S, nv_pad), np.int32)
+        for si, (segs, cs) in enumerate(zip(shard_segs, cols)):
+            off = 0      # doc offset of this segment within the shard
+            pos = 0      # flat value write position
+            for seg, c in zip(segs, cs):
+                if c is not None and len(c.ords):
+                    n = len(c.ords)
+                    val_doc[si, pos: pos + n] = \
+                        c.doc_of_value.astype(np.int32) + off
+                    remap = np.array([gord[v] for v in c.vocab], np.int32)
+                    val_ord[si, pos: pos + n] = remap[c.ords]
+                    pos += n
+                off += seg.ndocs
+        sharding = NamedSharding(mesh, P("shard"))
+        out = (jax.device_put(val_doc, sharding),
+               jax.device_put(val_ord, sharding), vocab,
+               next_pow2(len(vocab), floor=8))
+        self._stacked_ords.put(key, (svc.generation, out),
+                               val_doc.nbytes + val_ord.nbytes)
+        return out
+
+    def _fmask_resolve(self, shard_segs, stats, fnodes, notnodes
+                       ) -> Optional[tuple]:
+        """Resolve a bool query's filter/must_not clauses to per-segment
+        cached masks (compiler filter-mask cache) and combine them into one
+        per-shard host mask. Returns (combo_key, masks_by_shard) — the key
+        is the sorted per-clause cache keys, each already encoding segment
+        uid + live_gen + spec digest, so index mutations mint new keys —
+        or None when any clause's mask is unavailable (caller falls back to
+        the host loop). The AND-combine only runs on a combo-cache miss;
+        repeated guardrail combos pay just the per-clause cache hits."""
+        from ..search import compiler as C
+
+        # pass 1: per-clause cache keys (masks come along from the
+        # compiler's own cache; the per-body cost on a hit is ~zero)
+        clause_keys = []
+        clause_masks = []   # aligned [(si, seg, mask, positive), ...]
+        for si, segs in enumerate(shard_segs):
+            for seg in segs:
+                for node, positive in ([(n, True) for n in fnodes]
+                                       + [(n, False) for n in notnodes]):
+                    mask, mkey, _spec, _local = C.filter_mask_for(
+                        node, seg, stats[si])
+                    if mask is None:
+                        return None
+                    clause_keys.append((mkey, positive))
+                    clause_masks.append((si, seg, mask, positive))
+        combo = tuple(sorted(clause_keys))
+        cached = self._host_masks.get(combo)
+        if cached is not None:
+            return combo, cached
+        masks_by_shard = [[np.ones(seg.ndocs, bool) for seg in segs]
+                          for segs in shard_segs]
+        seg_pos = [{id(seg): j for j, seg in enumerate(segs)}
+                   for segs in shard_segs]
+        for si, seg, mask, positive in clause_masks:
+            m = np.asarray(mask[: seg.ndocs], bool)
+            tgt = masks_by_shard[si][seg_pos[si][id(seg)]]
+            tgt &= m if positive else ~m
+        self._host_masks.put(combo, masks_by_shard,
+                             sum(m.nbytes for ms in masks_by_shard
+                                 for m in ms))
+        return combo, masks_by_shard
+
+    def _dev_mask_for(self, combo, masks_by_shard, shard_segs, d_pad: int,
+                      mesh):
+        """Device-resident stacked f32[S, d_pad] filter mask for a resolved
+        combo (shard-sharded); built once and LRU-cached — the
+        guardrail-filter reuse the reference gets from its query cache
+        (`indices/IndicesQueryCache.java`), as device-resident masks. The
+        host masks travel WITH the call (not re-read from a cache that may
+        have evicted them between parse and run)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (combo, d_pad)
+        cached = self._dev_masks.get(key)
+        if cached is not None:
+            return cached
+        S = len(shard_segs)
+        fmask = np.zeros((S, d_pad), np.float32)
+        for si, (segs, masks) in enumerate(zip(shard_segs, masks_by_shard)):
+            off = 0
+            for seg, m in zip(segs, masks):
+                fmask[si, off: off + seg.ndocs] = m.astype(np.float32)
+                off += seg.ndocs
+        out = jax.device_put(fmask, NamedSharding(mesh, P("shard")))
+        self._dev_masks.put(key, out, fmask.nbytes)
         return out
 
     # ---------------- dispatch ----------------
@@ -180,7 +358,7 @@ class MeshSearchService:
         stats = _global_stats_contexts(searchers)
         ctx = stats[0]
 
-        parsed = []   # (qi, lt, sort_specs, window, const_score, aggs)
+        parsed = []  # (qi, lt, sort_specs, window, const_score, aggs, fkey)
         for qi, body in enumerate(bodies):
             try:
                 query = dsl.parse_query(body.get("query"))
@@ -192,32 +370,44 @@ class MeshSearchService:
             agg_nodes = parse_aggs(body.get("aggs",
                                             body.get("aggregations")))
             window = int(body.get("from", 0)) + int(body.get("size", 10))
-            if not self._eligible(lroot, sort_specs, agg_nodes,
-                                  _collect_named(lroot), body, window):
+            shape = self._eligible(lroot, sort_specs, agg_nodes,
+                                   _collect_named(lroot), body, window)
+            if shape is None:
                 self.fallbacks += 1
                 continue
-            const = (float(getattr(lroot, "boost", 1.0) or 1.0)
-                     if lroot.mode == "filter" else 0.0)
-            parsed.append((qi, lroot, sort_specs, max(window, 1), const,
-                           agg_nodes or []))
+            lt, fnodes, notnodes, qboost = shape
+            fpair = None            # (combo_key, per-shard host masks)
+            if fnodes or notnodes:
+                fpair = self._fmask_resolve(shard_segs, stats, fnodes,
+                                            notnodes)
+                if fpair is None:
+                    self.fallbacks += 1
+                    continue
+            const = (float(getattr(lt, "boost", 1.0) or 1.0) * qboost
+                     if lt.mode == "filter" else 0.0)
+            parsed.append((qi, lt, sort_specs, max(window, 1), const,
+                           agg_nodes or [], fpair, qboost))
         if not parsed:
             return out
 
         # group by program parameters: field (via the stacked index), sim,
-        # and the pow2 WINDOW CLASS — co-batching a size=10 body with a
+        # the pow2 WINDOW CLASS — co-batching a size=10 body with a
         # from+size=1000 body would force K=1024 merge slots on everyone
-        # and every distinct K is its own compiled program
+        # and every distinct K is its own compiled program — and the filter
+        # combo (one device mask argument serves the whole group; guardrail
+        # filters repeat heavily so batching survives the split)
         groups: dict = {}
         for item in parsed:
-            qi, lt, sort_specs, window, const, aggs = item
+            qi, lt, sort_specs, window, const, aggs, fpair, qboost = item
             sim = lt.sim
             k1 = float(sim.k1) if sim is not None else 1.2
             b_eff = (float(sim.b)
                      if sim is not None and lt.has_norms else 0.0)
             k_class = min(next_pow2(max(window, 16)), MAX_WINDOW)
-            groups.setdefault((lt.field, k1, b_eff, k_class),
+            fkey = fpair[0] if fpair is not None else None
+            groups.setdefault((lt.field, k1, b_eff, k_class, fkey),
                               []).append(item)
-        for (field, k1, b_eff, k_class), items in groups.items():
+        for (field, k1, b_eff, k_class, _fkey), items in groups.items():
             self._run_mesh_group(name, svc, bodies, out, shard_segs, stats,
                                  searchers, field, k1, b_eff, k_class,
                                  items)
@@ -235,6 +425,12 @@ class MeshSearchService:
             self.fallbacks += len(items)
             return
         S = len(shard_segs)
+        mesh = self._mesh_for(S)
+        if mesh is None:
+            self.fallbacks += len(items)
+            return
+        # every item in the group shares one filter combo (the group key)
+        fpair = items[0][6]
         K = min(k_class, stacked.ndocs_pad)
         keep = []
         for it in items:
@@ -243,13 +439,17 @@ class MeshSearchService:
                 # (tiny shards): that body takes the host loop
                 self.fallbacks += 1
                 continue
-            # metric aggs need their stacked columns; a missing column
-            # means the host loop serves that body
+            # aggs need their stacked columns (metric) or global-ordinal
+            # values (terms); a missing/oversized one -> host loop
             agg_ok = True
             for an in it[5]:
-                if self._col_for(name, svc, an.body["field"], shard_segs,
-                                 stacked.ndocs_pad,
-                                 self._mesh_for(S)) is None:
+                if an.kind == "terms":
+                    got = self._ord_for(name, svc, an.body["field"],
+                                        shard_segs, stacked.ndocs_pad, mesh)
+                else:
+                    got = self._col_for(name, svc, an.body["field"],
+                                        shard_segs, stacked.ndocs_pad, mesh)
+                if got is None:
                     agg_ok = False
                     break
             if not agg_ok:
@@ -268,10 +468,13 @@ class MeshSearchService:
         msm = np.ones(QB, np.float32)
         cscore = np.zeros(QB, np.float32)
         total_max = 1
-        for bi, (qi, lt, sort_specs, window, const, aggs) in \
+        for bi, (qi, lt, sort_specs, window, const, aggs, _fk, qboost) in \
                 enumerate(items):
             nt = len(lt.terms)
-            boosts[bi, :nt] = lt.raw_boosts[:nt]
+            # a wrapping bool's boost folds into the term weights: BM25 is
+            # linear in the per-term weight, so boost*score == sum of
+            # boost-scaled contributions (constant-score goes via cscore)
+            boosts[bi, :nt] = lt.raw_boosts[:nt] * qboost
             msm[bi] = float(lt.msm)
             cscore[bi] = const
             for si in range(S):
@@ -282,37 +485,53 @@ class MeshSearchService:
                     tot += stacked.row_size(si, r)
                 total_max = max(total_max, tot)
         bucket = next_pow2(total_max, floor=256)
-        mesh = self._mesh_for(S)
-        if mesh is None:
-            self.fallbacks += len(items)
-            return
+        filtered = fpair is not None
+        fmask = (self._dev_mask_for(fpair[0], fpair[1], shard_segs,
+                                    stacked.ndocs_pad, mesh)
+                 if filtered else None)
         fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K, k1,
-                               b_eff)
+                               b_eff, filtered)
         gdocs_b, gvals_b, totals_b = fn(stacked.tree(), rows, boosts, msm,
-                                        cscore)
+                                        cscore, fmask)
         import jax
 
         # metric aggs: one psum/pmin/pmax reduce per distinct field over
-        # the whole batch (items without that agg just ignore its column)
-        agg_fields = sorted({an.body["field"] for it in items
-                             for an in it[5]})
+        # the whole batch (items without that agg just ignore its column);
+        # terms aggs: one exact bincount+psum per distinct keyword field
+        metric_fields = sorted({an.body["field"] for it in items
+                                for an in it[5] if an.kind != "terms"})
+        terms_fields = sorted({an.body["field"] for it in items
+                               for an in it[5] if an.kind == "terms"})
         metrics_by_field = {}
-        if agg_fields:
+        if metric_fields:
             mfn = self._metric_program_for(mesh, bucket, stacked.ndocs_pad,
-                                           k1, b_eff)
-            for f in agg_fields:
+                                           k1, b_eff, filtered)
+            for f in metric_fields:
                 col, pres = self._col_for(name, svc, f, shard_segs,
                                           stacked.ndocs_pad, mesh)
-                metrics_by_field[f] = mfn(stacked.tree(), rows, boosts,
-                                          msm, cscore, col, pres)
+                margs = (stacked.tree(), rows, boosts, msm, cscore, col,
+                         pres) + ((fmask,) if filtered else ())
+                metrics_by_field[f] = mfn(*margs)
+        tcounts_by_field = {}
+        tvocab_by_field = {}
+        for f in terms_fields:
+            val_doc, val_ord, vocab, vpad = self._ord_for(
+                name, svc, f, shard_segs, stacked.ndocs_pad, mesh)
+            tfn = self._terms_program_for(mesh, bucket, stacked.ndocs_pad,
+                                          vpad, k1, b_eff, filtered)
+            targs = (stacked.tree(), rows, boosts, msm, cscore, val_doc,
+                     val_ord) + ((fmask,) if filtered else ())
+            tcounts_by_field[f] = tfn(*targs)
+            tvocab_by_field[f] = vocab
         fetched = jax.device_get((gdocs_b, gvals_b, totals_b,
-                                  metrics_by_field))
-        gdocs_b, gvals_b, totals_b, metrics_by_field = fetched
+                                  metrics_by_field, tcounts_by_field))
+        (gdocs_b, gvals_b, totals_b, metrics_by_field,
+         tcounts_by_field) = fetched
 
         doc_base = np.asarray(stacked.doc_base)
         seg_bases = [np.cumsum([0] + ndocs[:-1])
                      for ndocs in stacked.seg_ndocs]
-        for bi, (qi, lt, sort_specs, window, const, aggs) in \
+        for bi, (qi, lt, sort_specs, window, const, aggs, _fk, qboost) in \
                 enumerate(items):
             gdocs = gdocs_b[bi]
             gvals = gvals_b[bi]
@@ -339,10 +558,18 @@ class MeshSearchService:
                                                         local, sc)
                 results[si].candidates.append(
                     Candidate(si, seg_ord, local, sc, sort_vals, raw_vals))
-            # attach the globally-reduced metric partials to shard 0 (the
+            # attach the globally-reduced agg partials to shard 0 (the
             # values are already psum'd across the mesh; the coordinator
             # merge sees exactly one partial per agg)
             for an in aggs:
+                if an.kind == "terms":
+                    counts = tcounts_by_field[an.body["field"]][bi]
+                    vocab = tvocab_by_field[an.body["field"]]
+                    buckets = {vocab[o]: {"doc_count": int(round(c))}
+                               for o, c in enumerate(counts[: len(vocab)])
+                               if c > 0}
+                    results[0].agg_partials[an.name] = [{"buckets": buckets}]
+                    continue
                 m = metrics_by_field[an.body["field"]][bi]
                 cnt = float(m[0])
                 results[0].agg_partials[an.name] = [{
@@ -353,15 +580,22 @@ class MeshSearchService:
             for r in results:
                 r.took_ms = (time.monotonic() - t0) * 1000.0
             self.dispatched += 1
+            if _fk is not None:
+                self.filtered_dispatched += 1
+            if any(an.kind == "terms" for an in aggs):
+                self.terms_agg_dispatched += 1
             body = dict(bodies[qi])
             body["_index_name"] = name
             out[qi] = _finish_search(searchers, results, body, stats, name,
                                      t0, aggs)
 
-    def _eligible(self, lt, sort_specs, agg_nodes, named_nodes, body,
-                  window: int) -> bool:
+    def _eligible(self, lroot, sort_specs, agg_nodes, named_nodes, body,
+                  window: int) -> Optional[tuple]:
         """Mesh-servable shapes: a single term group (scoring OR filter
-        mode), plain relevance order, no secondary features."""
+        mode), optionally wrapped in a bool with mask-computable
+        filter/must_not clauses, plain relevance order, metric or keyword
+        `terms` aggregations. Returns (lt, filter_nodes, must_not_nodes,
+        bool_boost) or None (-> host loop)."""
         from ..search import compiler as C
         from ..search.fastpath import MAX_T
         from ..ops import scoring as ops
@@ -369,39 +603,88 @@ class MeshSearchService:
         if body.get("knn") or body.get("rescore") or body.get("min_score") \
                 is not None or body.get("profile") or body.get("collapse") \
                 or body.get("suggest") or body.get("search_after") is not None:
-            return False
+            return None
         if named_nodes:
-            return False
-        # metric-only aggregations reduce over the mesh (psum/pmin/pmax);
-        # anything bucketed or scripted takes the host loop
+            return None
+        # metric aggs reduce over the mesh (psum/pmin/pmax); keyword terms
+        # aggs as an exact device bincount; anything else -> host loop
         for an in (agg_nodes or []):
-            if an.kind not in _MESH_METRICS or an.subs \
-                    or set(an.body) != {"field"}:
-                return False
+            if an.subs:
+                return None
+            if an.kind in _MESH_METRICS and set(an.body) == {"field"}:
+                continue
+            if an.kind == "terms" and set(an.body) <= \
+                    {"field", "size", "min_doc_count", "order"}:
+                order = an.body.get("order", {"_count": "desc"})
+                if isinstance(order, dict) and len(order) == 1 and \
+                        next(iter(order)) in ("_count", "_key"):
+                    continue
+            return None
         if window > MAX_WINDOW or (window < 1 and not agg_nodes):
-            return False
+            return None
         if sort_specs and not (len(sort_specs) == 1
                                and sort_specs[0]["field"] == "_score"
                                and sort_specs[0].get("order", "desc")
                                == "desc"):
-            return False
+            return None
+
+        # unwrap a bool: one scoring clause + maskable filters/must_nots
+        fnodes: list = []
+        notnodes: list = []
+        qboost = 1.0
+        lt = lroot
+        if isinstance(lroot, C.LBool):
+            if lroot.shoulds:
+                if lroot.musts or len(lroot.shoulds) != 1 or lroot.msm > 1:
+                    return None
+                lt = lroot.shoulds[0]
+            elif len(lroot.musts) == 1:
+                lt = lroot.musts[0]
+            else:
+                return None
+            fnodes = list(lroot.filters)
+            notnodes = list(lroot.must_nots)
+            qboost = float(lroot.boost or 1.0)
+            if not all(self._maskable(n) for n in fnodes + notnodes):
+                return None
         if not isinstance(lt, C.LTerms):
-            return False
+            return None
         if lt.mode not in ("score", "filter"):
-            return False
+            return None
         if lt.mode == "score" and (lt.sim is None
                                    or lt.sim.sim_id != ops.SIM_BM25):
-            return False
+            return None
         nt = len(lt.terms)
         if nt < 1 or next_pow2(nt, floor=1) > MAX_T:
-            return False
+            return None
         if getattr(lt, "raw_boosts", None) is None:
-            return False
+            return None
         if lt.aux is not None and np.any(np.asarray(lt.aux)[:nt] != 0.0):
-            return False
-        return True
+            return None
+        return (lt, fnodes, notnodes, qboost)
+
+    def _maskable(self, node) -> bool:
+        """Filter-context clauses the mesh serves via cached dense masks
+        (compiler filter-mask cache) — the common guardrail kinds. Unknown
+        kinds decline to the host loop, never guess."""
+        from ..search import compiler as C
+
+        if isinstance(node, (C.LRange, C.LExists, C.LMatchAll,
+                             C.LMatchNone, C.LIds, C.LExpandTerms)):
+            return True
+        if isinstance(node, C.LTerms):
+            return True
+        if isinstance(node, C.LConstScore):
+            return self._maskable(node.child)
+        if isinstance(node, C.LBool):
+            return all(self._maskable(c) for c in
+                       node.musts + node.shoulds + node.must_nots
+                       + node.filters)
+        return False
 
     def stats(self) -> dict:
         return {"devices": len(self.devices), "dispatched": self.dispatched,
                 "fallbacks": self.fallbacks,
+                "filtered_dispatched": self.filtered_dispatched,
+                "terms_agg_dispatched": self.terms_agg_dispatched,
                 "stacked_indices": len(self._stacked)}

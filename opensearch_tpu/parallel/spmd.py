@@ -241,11 +241,13 @@ def _local_gather(starts, doc_ids, tfs, rows, bucket: int):
 
 def _score_one_query(starts, doc_ids, tfs, dl, live, rows, boosts, msm,
                      cscore, n_global, df_global, avgdl, bucket: int,
-                     ndocs_pad: int, k1: float, b: float):
+                     ndocs_pad: int, k1: float, b: float, fmask=None):
     """Shard-local BM25 scoring of one query with *global* statistics.
     `cscore > 0` switches the query to constant-score semantics (filter
     context / `terms` queries): every doc matching >= msm terms scores
-    exactly `cscore`, so top-k tie-breaks by doc id like the host path."""
+    exactly `cscore`, so top-k tie-breaks by doc id like the host path.
+    `fmask` (f32[ndocs_pad] or None) is a pre-combined filter-context match
+    mask (bool filters + must_nots): docs outside it can't hit."""
     idf = jnp.log1p((n_global - df_global + 0.5) / (df_global + 0.5))
     w = jnp.where(df_global > 0, boosts * idf, 0.0)
     docs, tf, t_idx, valid = _local_gather(starts, doc_ids, tfs, rows, bucket)
@@ -259,20 +261,47 @@ def _score_one_query(starts, doc_ids, tfs, dl, live, rows, boosts, msm,
     counts = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(
         jnp.where(valid & (tf > 0), 1.0, 0.0), mode="drop")
     ok = (counts >= msm) & (live > 0)
+    if fmask is not None:
+        ok = ok & (fmask > 0)
     scores = jnp.where(cscore > 0.0, cscore, scores)
     return jnp.where(ok, scores, -jnp.inf)
 
 
+def _global_dfs_stats(tree, rows):
+    """Device-side DFS phase shared by every distributed program: psum the
+    collection statistics over the `shard` axis. Returns
+    (df_global [QBl,T], n_global, avgdl). avgdl follows the host
+    StatsContext semantics: mean doc length over docs that HAVE the field,
+    1.0 when none (normless fields — 0/0 was the r3 NaN poison)."""
+    starts = tree["starts"][0]
+    nrows_pad = starts.shape[0]
+    safe_rows = jnp.where(rows < 0, nrows_pad - 2, rows)
+    local_df = (starts[safe_rows + 1] - starts[safe_rows]).astype(jnp.float32)
+    df_global = jax.lax.psum(local_df, "shard")
+    n_global = jax.lax.psum(tree["doc_count"][0], "shard")
+    sum_dl_g = jax.lax.psum(tree["sum_dl"][0], "shard")
+    fdc_g = jax.lax.psum(tree["field_dc"][0], "shard")
+    avgdl = jnp.where(fdc_g > 0, sum_dl_g / jnp.maximum(fdc_g, 1.0), 1.0)
+    return df_global, n_global, avgdl
+
+
 def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
-                             k1: float = 1.2, b: float = 0.75):
+                             k1: float = 1.2, b: float = 0.75,
+                             filtered: bool = False):
     """Returns a jitted SPMD function:
-        (index_tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB]) ->
+        (index_tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB]
+         [, fmask [S, ndocs_pad]]) ->
         (global_doc_ids [QB,k], scores [QB,k], total_hits [QB])
     Queries are sharded over `replica`, docs over `shard`; `rows` carries the
     per-shard term-dict resolution so it is sharded over BOTH axes. `cscore`
-    (optional; zeros = BM25) switches a query to constant-score semantics."""
+    (optional; zeros = BM25) switches a query to constant-score semantics.
+    `filtered=True` adds a per-shard filter-context mask argument (the
+    device-cached AND of a bool query's filter/must_not clauses): the mesh
+    analog of the reference's filtered BulkScorer
+    (`search/query/QueryPhase.java` with a filter bitset) — one mask serves
+    every query in the batch that shares the filter combo."""
 
-    def per_device(tree, rows, boosts, msm, cscore):
+    def per_device(tree, rows, boosts, msm, cscore, fmask=None):
         # leading stacked-shard axis is size-1 inside the shard_map block
         rows = rows[0]
         starts = tree["starts"][0]
@@ -281,25 +310,16 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         dl = tree["dl"][0]
         live = tree["live"][0]
         doc_base = tree["doc_base"][0]
+        fm = fmask[0] if fmask is not None else None
 
         # --- DFS phase on device: global collection stats via psum over ICI ---
-        nrows_pad = starts.shape[0]
-        safe_rows = jnp.where(rows < 0, nrows_pad - 2, rows)
-        local_df = (starts[safe_rows + 1] - starts[safe_rows]).astype(jnp.float32)
-        df_global = jax.lax.psum(local_df, "shard")                  # [QBl, T]
-        n_global = jax.lax.psum(tree["doc_count"][0], "shard")
-        sum_dl_g = jax.lax.psum(tree["sum_dl"][0], "shard")
-        fdc_g = jax.lax.psum(tree["field_dc"][0], "shard")
-        # same semantics as the host StatsContext.avgdl (compiler.py): mean doc
-        # length over docs that HAVE the field, 1.0 when none (normless fields
-        # like keyword — sum_dl=0 there, and 0/0 was the r3 NaN poison).
-        avgdl = jnp.where(fdc_g > 0, sum_dl_g / jnp.maximum(fdc_g, 1.0), 1.0)
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
 
         # --- QUERY phase: vmap over the local query batch ---
         scores = jax.vmap(
             lambda r, w, m, cs, dfg: _score_one_query(
                 starts, doc_ids, tfs, dl, live, r, w, m, cs, n_global, dfg,
-                avgdl, bucket, ndocs_pad, k1, b)
+                avgdl, bucket, ndocs_pad, k1, b, fm)
         )(rows, boosts, msm, cscore, df_global)                       # [QBl, D]
 
         totals_local = jnp.sum(scores > -jnp.inf, axis=1)
@@ -324,33 +344,39 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
-    fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(tree_spec, P("shard", "replica"), P("replica"),
-                             P("replica"), P("replica")),
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
                    out_specs=(P("replica"), P("replica"), P("replica")),
                    check_vma=False)
     jitted = jax.jit(fn)
 
-    def call(tree, rows, boosts, msm, cscore=None):
+    def call(tree, rows, boosts, msm, cscore=None, fmask=None):
         if cscore is None:
             cscore = jnp.zeros_like(jnp.asarray(msm))
+        if filtered:
+            return jitted(tree, rows, boosts, msm, cscore, fmask)
         return jitted(tree, rows, boosts, msm, cscore)
 
     return call
 
 
 def build_distributed_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
-                              k1: float = 1.2, b: float = 0.75):
+                              k1: float = 1.2, b: float = 0.75,
+                              filtered: bool = False):
     """Metric aggregations over the mesh: re-evaluate each query's match
     mask shard-locally (same scoring program shape), then psum/pmin/pmax
     the masked column moments over the `shard` axis — the device-side
     analog of the reference's per-shard metric collectors + coordinator
     InternalAggregation#reduce. Returns a callable:
         (tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB],
-         col [S,D_pad], present [S,D_pad]) ->
+         col [S,D_pad], present [S,D_pad] [, fmask [S,D_pad]]) ->
         f32[QB, 5] = (count, sum, min, max, sumsq), already global."""
 
-    def per_device(tree, rows, boosts, msm, cscore, col, present):
+    def per_device(tree, rows, boosts, msm, cscore, col, present,
+                   fmask=None):
         rows = rows[0]
         starts = tree["starts"][0]
         doc_ids = tree["doc_ids"][0]
@@ -359,21 +385,14 @@ def build_distributed_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
         live = tree["live"][0]
         colv = col[0]
         pres = present[0]
+        fm = fmask[0] if fmask is not None else None
 
-        nrows_pad = starts.shape[0]
-        safe_rows = jnp.where(rows < 0, nrows_pad - 2, rows)
-        local_df = (starts[safe_rows + 1] - starts[safe_rows]).astype(
-            jnp.float32)
-        df_global = jax.lax.psum(local_df, "shard")
-        n_global = jax.lax.psum(tree["doc_count"][0], "shard")
-        sum_dl_g = jax.lax.psum(tree["sum_dl"][0], "shard")
-        fdc_g = jax.lax.psum(tree["field_dc"][0], "shard")
-        avgdl = jnp.where(fdc_g > 0, sum_dl_g / jnp.maximum(fdc_g, 1.0), 1.0)
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
 
         def one(r, w, m, cs, dfg):
             scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
                                       m, cs, n_global, dfg, avgdl, bucket,
-                                      ndocs_pad, k1, b)
+                                      ndocs_pad, k1, b, fm)
             ok = (scores > -jnp.inf) & (pres > 0)
             okf = ok.astype(jnp.float32)
             cnt = jnp.sum(okf)
@@ -397,12 +416,68 @@ def build_distributed_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
-    fn = shard_map(per_device, mesh=mesh,
-                   in_specs=(tree_spec, P("shard", "replica"), P("replica"),
-                             P("replica"), P("replica"), P("shard"),
-                             P("shard")),
-                   out_specs=P("replica"),
-                   check_vma=False)
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
+def build_distributed_terms_agg(mesh: Mesh, bucket: int, ndocs_pad: int,
+                                vpad: int, k1: float = 1.2, b: float = 0.75,
+                                filtered: bool = False):
+    """Keyword `terms` aggregation over the mesh: re-evaluate each query's
+    match mask shard-locally, scatter-add it over the shard's flat
+    (doc, global-ordinal) value pairs, and psum the per-ordinal counts over
+    the `shard` axis — an EXACT global bincount (no per-shard size
+    truncation, so doc_count_error_upper_bound is genuinely 0), the
+    device-side analog of the reference's GlobalOrdinalsStringTermsAggregator
+    + coordinator reduce. Returns a callable:
+        (tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB],
+         val_doc [S,NV], val_ord [S,NV] [, fmask [S,D_pad]]) ->
+        f32[QB, vpad] global doc counts per ordinal."""
+
+    def per_device(tree, rows, boosts, msm, cscore, val_doc, val_ord,
+                   fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        vd = val_doc[0]
+        vo = val_ord[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        vvalid = vd < INT32_SENTINEL
+        vd_safe = jnp.minimum(vd, ndocs_pad - 1)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            matched = (scores > -jnp.inf).astype(jnp.float32)
+            contrib = jnp.where(vvalid, matched[vd_safe], 0.0)
+            return jnp.zeros(vpad, jnp.float32).at[vo].add(contrib,
+                                                           mode="drop")
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)  # [QB,V]
+        return jax.lax.psum(part, "shard")
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
     return jax.jit(fn)
 
 

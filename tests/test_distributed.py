@@ -246,11 +246,155 @@ class TestMeshService:
         sh = np.array([h["_score"] for h in rh["hits"]["hits"]])
         np.testing.assert_allclose(sm, sh, rtol=1e-5)
 
-    def test_complex_query_falls_back(self, clients):
+    def test_filtered_bool_dispatches_with_parity(self, clients):
+        # r5: filtered bool rides the mesh (device-cached filter masks)
         cm, ch = clients
         body = {"query": {"bool": {"must": [{"match": {"body": "alpha"}}],
                                    "filter": [{"term": {"body": "beta"}}]}},
                 "size": 5}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=body)
+        rh = ch.search(index="idx", body=body)
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert cm.node.mesh_service.filtered_dispatched >= 1
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        assert [h["_id"] for h in rm["hits"]["hits"]] == \
+            [h["_id"] for h in rh["hits"]["hits"]]
+        sm = np.array([h["_score"] for h in rm["hits"]["hits"]])
+        sh = np.array([h["_score"] for h in rh["hits"]["hits"]])
+        np.testing.assert_allclose(sm, sh, rtol=1e-5)
+
+    @pytest.mark.parametrize("body", [
+        # r5 mesh-filtered shapes: every one must match the host loop
+        # keyword term filter
+        {"query": {"bool": {"must": [{"match": {"body": "alpha beta"}}],
+                            "filter": [{"term": {"cat": "kitchen"}}]}},
+         "size": 10},
+        # numeric range filter (guardrail combo)
+        {"query": {"bool": {"must": [{"match": {"body": "gamma"}}],
+                            "filter": [{"term": {"cat": "garden"}},
+                                       {"range": {"num": {"gte": 200,
+                                                          "lt": 1200}}}]}},
+         "size": 10},
+        # must_not
+        {"query": {"bool": {"must": [{"match": {"body": "delta eps"}}],
+                            "must_not": [{"term": {"cat": "garage"}}]}},
+         "size": 10},
+        # filter + must_not + msm
+        {"query": {"bool": {"must": [{"match": {
+            "body": {"query": "alpha beta gamma",
+                     "minimum_should_match": 2}}}],
+            "filter": [{"range": {"num": {"gte": 100}}}],
+            "must_not": [{"term": {"cat": "kitchen"}}]}}, "size": 8},
+        # bool boost folds into term weights
+        {"query": {"bool": {"must": [{"match": {"body": "zeta"}}],
+                            "filter": [{"term": {"cat": "garden"}}],
+                            "boost": 2.5}}, "size": 10},
+        # single should == must (msm 1)
+        {"query": {"bool": {"should": [{"match": {"body": "alpha"}}],
+                            "filter": [{"range": {"num": {"lt": 800}}}]}},
+         "size": 10},
+        # filter-context terms scoring clause under a filtered bool
+        {"query": {"bool": {"must": [{"terms": {"cat": ["kitchen",
+                                                        "garden"]}}],
+                            "filter": [{"range": {"num": {"gte": 50,
+                                                          "lt": 1500}}}]}},
+         "size": 10},
+        # nested bool filter (maskable recursion)
+        {"query": {"bool": {"must": [{"match": {"body": "beta"}}],
+                            "filter": [{"bool": {"should": [
+                                {"term": {"cat": "kitchen"}},
+                                {"term": {"cat": "garden"}}]}}]}},
+         "size": 10},
+        # exists filter
+        {"query": {"bool": {"must": [{"match": {"body": "eps"}}],
+                            "filter": [{"exists": {"field": "num"}}]}},
+         "size": 10},
+    ])
+    def test_filtered_rest_equals_mesh(self, clients, body):
+        cm, ch = clients
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh path did not engage"
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        assert [h["_id"] for h in rm["hits"]["hits"]] == \
+            [h["_id"] for h in rh["hits"]["hits"]]
+        sm = np.array([h["_score"] for h in rm["hits"]["hits"]])
+        sh = np.array([h["_score"] for h in rh["hits"]["hits"]])
+        np.testing.assert_allclose(sm, sh, rtol=1e-5)
+
+    @pytest.mark.parametrize("aggs", [
+        {"t": {"terms": {"field": "cat"}}},
+        {"t": {"terms": {"field": "cat", "size": 2}}},
+        {"t": {"terms": {"field": "cat", "order": {"_key": "asc"}}}},
+        {"t": {"terms": {"field": "cat", "min_doc_count": 2}}},
+        # terms agg + metric agg in one body
+        {"t": {"terms": {"field": "cat"}}, "m": {"avg": {"field": "num"}}},
+    ])
+    def test_terms_agg_variants_parity(self, clients, aggs):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 5,
+                "aggs": aggs}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert rm["aggregations"] == rh["aggregations"]
+
+    def test_filtered_with_terms_agg_parity(self, clients):
+        cm, ch = clients
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "alpha"}}],
+            "filter": [{"range": {"num": {"gte": 100, "lt": 1400}}}]}},
+            "size": 5, "aggs": {"t": {"terms": {"field": "cat"}},
+                                "s": {"stats": {"field": "num"}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert rm["aggregations"] == rh["aggregations"]
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        assert [h["_id"] for h in rm["hits"]["hits"]] == \
+            [h["_id"] for h in rh["hits"]["hits"]]
+
+    def test_msearch_mixed_filtered_groups(self, clients):
+        """An msearch mixing unfiltered, two distinct filter combos, and a
+        repeated combo: combos group into separate program calls but every
+        body matches the host loop."""
+        cm, ch = clients
+        bodies = [
+            {"query": {"match": {"body": "alpha"}}, "size": 5},
+            {"query": {"bool": {"must": [{"match": {"body": "beta"}}],
+                                "filter": [{"term": {"cat": "kitchen"}}]}},
+             "size": 5},
+            {"query": {"bool": {"must": [{"match": {"body": "gamma"}}],
+                                "filter": [{"range": {"num":
+                                                      {"gte": 500}}}]}},
+             "size": 5},
+            {"query": {"bool": {"must": [{"match": {"body": "delta"}}],
+                                "filter": [{"term": {"cat": "kitchen"}}]}},
+             "size": 5},
+        ]
+        lines_m, lines_h = [], []
+        for b in bodies:
+            lines_m += [{"index": "idx"}, dict(b)]
+            lines_h += [{"index": "idx"}, dict(b)]
+        before = cm.node.mesh_service.dispatched
+        rm = cm.msearch(lines_m)
+        rh = ch.msearch(lines_h)
+        assert cm.node.mesh_service.dispatched == before + len(bodies)
+        for qm, qh in zip(rm["responses"], rh["responses"]):
+            assert qm["hits"]["total"] == qh["hits"]["total"]
+            assert [h["_id"] for h in qm["hits"]["hits"]] == \
+                [h["_id"] for h in qh["hits"]["hits"]]
+
+    def test_complex_query_falls_back(self, clients):
+        cm, ch = clients
+        body = {"query": {"dis_max": {"queries": [
+            {"match": {"body": "alpha"}}, {"match": {"body": "beta"}}]}},
+            "size": 5}
         before = cm.node.mesh_service.fallbacks
         rm = cm.search(index="idx", body=body)
         rh = ch.search(index="idx", body=body)
@@ -353,10 +497,23 @@ class TestMeshService:
                 else:
                     assert (got[k] is None) == (v is None), (name, k)
 
-    def test_bucket_aggs_fall_back(self, clients):
+    def test_terms_agg_dispatches_with_parity(self, clients):
+        # r5: keyword terms aggs run as an exact device bincount + psum
         cm, ch = clients
         body = {"query": {"match": {"body": "alpha"}}, "size": 3,
                 "aggs": {"t": {"terms": {"field": "cat"}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="idx", body=dict(body))
+        rh = ch.search(index="idx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert cm.node.mesh_service.terms_agg_dispatched >= 1
+        assert rm["aggregations"] == rh["aggregations"]
+
+    def test_histogram_aggs_fall_back(self, clients):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 3,
+                "aggs": {"h": {"histogram": {"field": "num",
+                                             "interval": 10}}}}
         before = cm.node.mesh_service.fallbacks
         rm = cm.search(index="idx", body=dict(body))
         rh = ch.search(index="idx", body=dict(body))
