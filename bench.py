@@ -515,6 +515,8 @@ def main():
                      f"{tvocab_strs[pair_second[pi]]}"}},
             "size": TOPK, "_bench": tag}
 
+    stream_stats = {}   # tag -> fastpath STATS delta over the measured reps
+
     def run_stream(bodies_fn, idxs, tag, reps, require_fast=True,
                    time_share=60.0):
         """msearch the stream up to `reps` times, adaptively dropping reps to
@@ -545,6 +547,11 @@ def main():
                 break
         if done < reps:
             log(f"{tag}: budget-capped at {done}/{reps} reps")
+        # escalation telemetry per stream: the pruned path is only as good
+        # as its escalation rate on real query shapes (surfaced per config
+        # in the emitted extra, and in _nodes/stats for production)
+        stream_stats[tag] = {k: fastpath.STATS[k] - before[k]
+                             for k in fastpath.STATS}
         if require_fast and fastpath.enabled():
             served = (fastpath.STATS["pure_served"]
                       + fastpath.STATS["bool_served"]
@@ -729,6 +736,11 @@ def main():
     else:
         log("mixed stream: skipped (budget)")
 
+    # per-stream device-path telemetry: kernel serves, fallbacks, pruned
+    # escalations (keys: m=match, r=realistic, b=bool, p=phrase, x=mixed)
+    extra["fastpath_per_stream"] = {
+        t: {k: v for k, v in d.items() if v}
+        for t, d in stream_stats.items() if t != "fwarm"}
     extra["bench_wall_s"] = round(time.time() - bench_start, 1)
     result = {
         "metric": "bm25_rest_qps_per_chip",

@@ -1,0 +1,580 @@
+"""Two-process cluster: full Nodes in separate OS processes, one index.
+
+The product promotion of the r4 two-process SPMD experiment
+(`tests/_mh_child.py`): each process runs a complete Node + RestClient +
+HttpServer; cluster membership, state publication, and the search
+scatter/gather all travel over the HTTP wire layer — the analog of the
+reference's netty transport + coordinator
+(`modules/transport-netty4/src/main/java/org/opensearch/transport/netty4/
+Netty4Transport.java:1`, `server/src/main/java/org/opensearch/cluster/
+coordination/Coordinator.java:1`, fan-out per
+`action/search/TransportSearchAction.java:1`).
+
+Design (primaries-only v1, documented):
+
+- **Membership**: the seed node is the cluster manager. A joiner POSTs
+  `/_internal/join`; the manager records it and publishes the full cluster
+  state (term/version, members, per-index shard routing) to every member —
+  the two-phase publish collapsed to one trusted-wire RPC.
+- **Routing**: `create_index` assigns each shard an owner round-robin over
+  the sorted member names. Every member creates the SAME index locally
+  (same num_shards); only the owner's copy of a shard ever receives
+  documents, so non-owned local shards stay empty and contribute nothing
+  to that node's local scatter leg.
+- **Writes**: a doc routes by `cluster.routing.shard_for(id)`; the
+  coordinator forwards non-local docs to the owner's PUBLIC HTTP doc
+  endpoint (the wire is the product wire, not a side channel).
+- **Search = DFS_QUERY_THEN_FETCH over HTTP** (reference
+  `search/dfs/DfsSearchResult.java:1` semantics):
+    1. DFS: every node reports the collection statistics its own rewrite
+       of the query consumes (df / collection_tf / field doc_count+sum_dl /
+       maxDoc), via a recording stats context; the coordinator sums them.
+    2. QUERY: every node runs its local per-shard query phase with a
+       GlobalStatsContext pinned to the summed statistics — scores are
+       therefore IDENTICAL to a single node holding all the data.
+    3. The coordinator reduces once (`reduce_shard_results`) and
+    4. FETCH: hydrates winning docs from their owning nodes.
+  Internal RPC payloads are pickled (base64 in a JSON envelope) — typed
+  agg partials and sort values cross the wire losslessly; the reference's
+  transport is binary object serialization for the same reason. The
+  `/_internal/*` surface is a trusted node-to-node wire (security is a
+  declared exclusion, SURVEY §2.9).
+- **Failure**: a dead member fails only ITS shards — the coordinator
+  serves partial results and reports `_shards.failed` (reference
+  allow_partial_search_results=true default). The kill-one-node test
+  (`tests/test_distnode.py`) asserts the survivor keeps serving its
+  shards' data.
+
+Unsupported on a distributed index (explicit 400, never silently wrong):
+non-`_score` sorts, collapse, rescore, search_after/scroll/PIT, suggest,
+profile, knn, and aggregations with sub-aggregations (their coordinator
+refinement needs cross-node sub-searches; reference parity for those is
+future work).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import pickle
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..rest.client import ApiError, RestClient
+from ..rest.http_server import HttpServer
+from ..search import compiler as C
+from ..search import query_dsl as dsl
+from ..search.aggregations import parse_aggs
+from ..search.executor import (Candidate, ShardQueryResult,
+                               _global_stats_contexts, reduce_shard_results)
+from .node import Node
+from .routing import shard_for
+
+_RPC_TIMEOUT_S = 30.0
+
+
+# ---------------------------------------------------------------------
+# statistics contexts for the cross-node DFS phase
+# ---------------------------------------------------------------------
+
+class RecordingStatsContext(C.ShardContext):
+    """Wraps the local collection-stats view and records every statistic
+    the query rewrite consumes — the node-local half of the DFS phase."""
+
+    def __init__(self, mappings, segments, similarity=None,
+                 field_similarities=None):
+        super().__init__(mappings, segments, similarity, field_similarities)
+        self.rec = {"num_docs": 0, "df": {}, "ctf": {}, "fs": {}}
+
+    @property
+    def num_docs(self) -> int:
+        n = C.ShardContext.num_docs.fget(self)
+        self.rec["num_docs"] = n
+        return n
+
+    def doc_freq(self, field: str, term: str) -> int:
+        v = super().doc_freq(field, term)
+        self.rec["df"][(field, term)] = v
+        return v
+
+    def collection_tf(self, field: str, term: str) -> float:
+        v = super().collection_tf(field, term)
+        self.rec["ctf"][(field, term)] = v
+        return v
+
+    def field_stats(self, field: str) -> Tuple[int, int]:
+        v = super().field_stats(field)
+        self.rec["fs"][field] = v
+        return v
+
+
+class GlobalStatsContext(C.ShardContext):
+    """A stats context pinned to coordinator-summed global statistics: every
+    node scores with the same idf/avgdl no matter where documents live.
+    Statistics the DFS recording did not capture (rare: a fetch-side
+    feature asking about a term the query rewrite never touched) fall back
+    to local values — degraded, never crashing."""
+
+    def __init__(self, mappings, segments, similarity, field_similarities,
+                 g: dict):
+        super().__init__(mappings, segments, similarity, field_similarities)
+        self._g = g
+
+    @property
+    def num_docs(self) -> int:
+        return self._g["num_docs"]
+
+    def doc_freq(self, field: str, term: str) -> int:
+        v = self._g["df"].get((field, term))
+        return v if v is not None else super().doc_freq(field, term)
+
+    def collection_tf(self, field: str, term: str) -> float:
+        v = self._g["ctf"].get((field, term))
+        return v if v is not None else super().collection_tf(field, term)
+
+    def field_stats(self, field: str) -> Tuple[int, int]:
+        v = self._g["fs"].get(field)
+        return tuple(v) if v is not None else super().field_stats(field)
+
+
+def _merge_dfs(parts: List[dict]) -> dict:
+    g = {"num_docs": 0, "df": {}, "ctf": {}, "fs": {}}
+    for p in parts:
+        g["num_docs"] += p["num_docs"]
+        for k, v in p["df"].items():
+            g["df"][k] = g["df"].get(k, 0) + v
+        for k, v in p["ctf"].items():
+            g["ctf"][k] = g["ctf"].get(k, 0.0) + v
+        for k, (dc, sdl) in p["fs"].items():
+            odc, osdl = g["fs"].get(k, (0, 0))
+            g["fs"][k] = (odc + dc, osdl + sdl)
+    return g
+
+
+# ---------------------------------------------------------------------
+# wire helpers
+# ---------------------------------------------------------------------
+
+def _b64(obj) -> str:
+    return base64.b64encode(pickle.dumps(obj)).decode("ascii")
+
+
+def _unb64(s: str):
+    return pickle.loads(base64.b64decode(s.encode("ascii")))
+
+
+def _http(addr: str, method: str, path: str, payload=None,
+          timeout: float = _RPC_TIMEOUT_S) -> dict:
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        f"http://{addr}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        raw = r.read().decode()
+    return json.loads(raw) if raw else {}
+
+
+class NodeUnreachable(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------
+# the distributed node
+# ---------------------------------------------------------------------
+
+class DistClusterNode:
+    """A full Node + HTTP server participating in a multi-process cluster.
+
+    Public surface: `create_index`, `index_doc`, `refresh`, `search`,
+    `get`, `cluster_state`, `stop`. Everything travels over HTTP — this
+    object is also the handler for `/_internal/*` RPCs on its server.
+    """
+
+    def __init__(self, name: str, seed: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.name = name
+        self.node = Node()
+        self.client = RestClient(node=self.node)
+        self.server = HttpServer(self.client, host=host, port=port)
+        self.server.dist = self
+        self.port = self.server.start()
+        self.addr = f"{host}:{self.port}"
+        self._lock = threading.RLock()
+        # cluster state (reference ClusterState: term/version + routing)
+        self.term = 1
+        self.version = 0
+        self.leader = name if seed is None else None
+        self.members: Dict[str, str] = {name: self.addr}
+        self.routing: Dict[str, Dict[int, str]] = {}   # index -> shard -> node
+        self.index_bodies: Dict[str, dict] = {}
+        if seed is not None:
+            st = _http(seed, "POST", "/_internal/join",
+                       {"name": name, "addr": self.addr})
+            self._apply_state(st["state"])
+
+    # ---------------- state machine ----------------
+
+    def _state(self) -> dict:
+        return {"term": self.term, "version": self.version,
+                "leader": self.leader, "members": self.members,
+                "routing": {i: {str(s): n for s, n in r.items()}
+                            for i, r in self.routing.items()},
+                "index_bodies": self.index_bodies}
+
+    def _apply_state(self, st: dict) -> None:
+        with self._lock:
+            self.term = st["term"]
+            self.version = st["version"]
+            self.leader = st["leader"]
+            self.members = dict(st["members"])
+            self.routing = {i: {int(s): n for s, n in r.items()}
+                            for i, r in st["routing"].items()}
+            self.index_bodies = dict(st["index_bodies"])
+            # idempotently materialize any index this node doesn't have yet
+            for iname, body in self.index_bodies.items():
+                if iname not in self.node.indices:
+                    self.client.indices.create(iname, body)
+
+    def _publish(self) -> None:
+        """Leader: bump version, push full state to every member (self
+        applies synchronously). Unreachable members keep their shards in
+        the routing table; searches report them failed until they rejoin."""
+        self.version += 1
+        st = self._state()
+        for name, addr in list(self.members.items()):
+            if name == self.name:
+                continue
+            try:
+                _http(addr, "POST", "/_internal/publish", {"state": st})
+            except (urllib.error.URLError, OSError):
+                pass
+
+    # ---------------- internal RPC handler (called by HttpServer) --------
+
+    def handle_internal(self, method: str, parts: List[str], body: dict
+                        ) -> Tuple[int, dict]:
+        op = parts[1] if len(parts) > 1 else ""
+        if op == "join" and method == "POST":
+            with self._lock:
+                self.members[body["name"]] = body["addr"]
+                self._publish()
+                return 200, {"state": self._state()}
+        if op == "publish" and method == "POST":
+            self._apply_state(body["state"])
+            return 200, {"acknowledged": True}
+        if op == "dfs" and method == "POST":
+            return 200, {"rec": _b64(self._local_dfs(body["index"],
+                                                     body["body"]))}
+        if op == "query_phase" and method == "POST":
+            results = self._local_query(body["index"], body["body"],
+                                        _unb64(body["g"]))
+            return 200, {"results": _b64(results)}
+        if op == "fetch_phase" and method == "POST":
+            hits = self._local_fetch(body["index"], body["body"],
+                                     int(body["shard"]),
+                                     _unb64(body["cands"]),
+                                     _unb64(body["g"]))
+            return 200, {"hits": _b64(hits)}
+        if op == "state" and method == "GET":
+            return 200, {"state": self._state()}
+        if op == "create_index" and method == "POST":
+            return 200, self.create_index(parts[2], body)
+        if op == "search" and method == "POST":
+            # run a DISTRIBUTED search coordinated by THIS node (any member
+            # can coordinate, like any reference node with the coordinator
+            # role)
+            return 200, self.search(body["index"], body["body"])
+        return 404, {"error": {"type": "resource_not_found_exception",
+                               "reason": f"unknown internal op [{op}]"}}
+
+    # ---------------- cluster API ----------------
+
+    def cluster_state(self) -> dict:
+        return self._state()
+
+    def create_index(self, name: str, body: dict) -> dict:
+        """Leader-only (forwarded if called on a follower): create on every
+        member, assign shard owners round-robin over sorted member names."""
+        if self.leader != self.name:
+            return _http(self.members[self.leader], "POST",
+                         f"/_internal/create_index/{name}", body)
+        with self._lock:
+            self.client.indices.create(name, body)
+            n_shards = self.node.indices[name].meta.num_shards
+            order = sorted(self.members)
+            self.routing[name] = {s: order[s % len(order)]
+                                  for s in range(n_shards)}
+            self.index_bodies[name] = body
+            for mname, addr in self.members.items():
+                if mname == self.name:
+                    continue
+                _http(addr, "PUT", f"/{name}", body)
+            self._publish()
+        return {"acknowledged": True, "index": name,
+                "routing": self.routing[name]}
+
+    def index_doc(self, index: str, doc: dict, id: str,
+                  refresh: bool = False) -> dict:
+        """Route by doc id; forward non-local docs to the owner's public
+        doc endpoint."""
+        owner = self._owner(index, id)
+        refresh_q = "?refresh=true" if refresh else ""
+        if owner == self.name:
+            return self.client.index(index, doc, id=id, refresh=refresh)
+        return _http(self.members[owner], "PUT",
+                     f"/{index}/_doc/{id}{refresh_q}", doc)
+
+    def get(self, index: str, id: str) -> dict:
+        owner = self._owner(index, id)
+        if owner == self.name:
+            return self.client.get(index, id)
+        try:
+            return _http(self.members[owner], "GET", f"/{index}/_doc/{id}")
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, "resource_not_found_exception",
+                           f"[{id}] not found")
+
+    def refresh(self, index: str) -> None:
+        self.client.indices.refresh(index)
+        for mname, addr in self.members.items():
+            if mname == self.name:
+                continue
+            try:
+                _http(addr, "POST", f"/{index}/_refresh")
+            except (urllib.error.URLError, OSError):
+                pass
+
+    def _owner(self, index: str, id: str) -> str:
+        r = self.routing.get(index)
+        if r is None:
+            raise ApiError(404, "index_not_found_exception",
+                           f"no such index [{index}]")
+        n = self.node.indices[index].meta.num_shards
+        return r[shard_for(id, n)]
+
+    # ---------------- distributed search ----------------
+
+    _UNSUPPORTED = ("collapse", "rescore", "search_after", "suggest",
+                    "profile", "knn", "scroll", "pit")
+
+    def _check_supported(self, body: dict) -> List:
+        for k in self._UNSUPPORTED:
+            if body.get(k):
+                raise ApiError(400, "illegal_argument_exception",
+                               f"[{k}] is not supported on a distributed "
+                               f"index")
+        for s in body.get("sort", []):
+            f = s if isinstance(s, str) else next(iter(s))
+            if f != "_score":
+                raise ApiError(400, "illegal_argument_exception",
+                               "only _score sort is supported on a "
+                               "distributed index")
+        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
+        for an in (agg_nodes or []):
+            if an.subs:
+                raise ApiError(400, "illegal_argument_exception",
+                               "sub-aggregations are not supported on a "
+                               "distributed index")
+        return agg_nodes or []
+
+    def _check_no_named(self, index: str, body: dict) -> None:
+        """matched_queries is fetch-side state that does not cross the wire
+        yet: refuse explicitly rather than silently dropping it."""
+        from ..search.executor import _collect_named
+        svc = self.node.indices[index]
+        segs = [s for sr in svc.searchers for s in sr.engine.segments]
+        ctx = C.ShardContext(svc.mappings, segs, svc.default_sim,
+                             getattr(svc, "field_similarities", None))
+        try:
+            lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx,
+                              scoring=True)
+        except dsl.QueryParseError:
+            return
+        if _collect_named(lroot):
+            raise ApiError(400, "illegal_argument_exception",
+                           "named queries (_name) are not supported on a "
+                           "distributed index")
+
+    def _local_dfs(self, index: str, body: dict) -> dict:
+        svc = self.node.indices[index]
+        searchers = svc.searchers
+        segs = [g for s in searchers for g in s.engine.segments]
+        ctx = RecordingStatsContext(svc.mappings, segs, svc.default_sim,
+                                    getattr(svc, "field_similarities", None))
+        try:
+            C.rewrite(dsl.parse_query(body.get("query")), ctx, scoring=True)
+        except dsl.QueryParseError:
+            pass
+        _ = ctx.num_docs          # maxDoc is always part of the DFS result
+        # avgdl (per-field doc_count + sum_dl) is consumed at the prepare
+        # stage, not rewrite — record it for every text field this node
+        # holds so the merged fs covers whatever the query touches
+        for s in segs:
+            for f in s.text_stats:
+                ctx.field_stats(f)
+        return ctx.rec
+
+    def _global_ctx(self, index: str, g: dict) -> GlobalStatsContext:
+        svc = self.node.indices[index]
+        segs = [s for sr in svc.searchers for s in sr.engine.segments]
+        return GlobalStatsContext(svc.mappings, segs, svc.default_sim,
+                                  getattr(svc, "field_similarities", None),
+                                  g)
+
+    def _local_query(self, index: str, body: dict, g: dict
+                     ) -> List[ShardQueryResult]:
+        """Per-shard query phase with global stats; results stripped of
+        segment references (they do not cross the wire)."""
+        svc = self.node.indices[index]
+        ctx = self._global_ctx(index, g)
+        out = []
+        for i, s in enumerate(svc.searchers):
+            r = s.query_phase(dict(body), shard_ord=i, stats_ctx=ctx)
+            r.segments = []        # host-local only
+            r.named_by_doc = {}
+            out.append(r)
+        return out
+
+    def _local_fetch(self, index: str, body: dict, shard: int,
+                     cands: List[tuple], g: dict) -> List[dict]:
+        svc = self.node.indices[index]
+        s = svc.searchers[shard]
+        segs = (list(s.replica.segments) if s.replica is not None
+                else list(s.engine.segments))
+        result = ShardQueryResult(shard=shard, segments=segs)
+        sel = [Candidate(shard, so, ld, sc, tuple(sv), tuple(rv))
+               for so, ld, sc, sv, rv in cands]
+        return s.fetch_phase(result, sel, dict(body),
+                             stats_ctx=self._global_ctx(index, g))
+
+    def search(self, index: str, body: dict) -> dict:
+        """Distributed DFS_QUERY_THEN_FETCH across every member, reduced
+        once on this node."""
+        t0 = time.monotonic()
+        agg_nodes = self._check_supported(body)
+        svc = self.node.indices.get(index)
+        if svc is None:
+            raise ApiError(404, "index_not_found_exception",
+                           f"no such index [{index}]")
+        self._check_no_named(index, body)
+        n_shards = svc.meta.num_shards
+        owners = self.routing.get(index, {s: self.name
+                                          for s in range(n_shards)})
+        remote_members = sorted({n for n in owners.values()
+                                 if n != self.name})
+
+        # --- phase 1: DFS (collection statistics from every node)
+        parts = [self._local_dfs(index, body)]
+        dead: List[str] = []
+        for m in remote_members:
+            try:
+                r = _http(self.members[m], "POST", "/_internal/dfs",
+                          {"index": index, "body": body})
+                parts.append(_unb64(r["rec"]))
+            except (urllib.error.URLError, OSError, KeyError):
+                dead.append(m)
+        g = _merge_dfs(parts)
+
+        # --- phase 2: QUERY everywhere with pinned global stats
+        results = self._local_query(index, body, g)
+        remote_results: Dict[int, ShardQueryResult] = {}
+        for m in remote_members:
+            if m in dead:
+                continue
+            try:
+                r = _http(self.members[m], "POST", "/_internal/query_phase",
+                          {"index": index, "body": body, "g": _b64(g)})
+                for sr in _unb64(r["results"]):
+                    # only the owner's copy of a shard carries data; the
+                    # coordinator keeps the owned legs and drops empty
+                    # non-owned duplicates
+                    if owners.get(sr.shard) == m:
+                        remote_results[sr.shard] = sr
+            except (urllib.error.URLError, OSError, KeyError):
+                dead.append(m)
+        merged: List[ShardQueryResult] = []
+        failed_shards = []
+        for s in range(n_shards):
+            owner = owners.get(s, self.name)
+            if owner == self.name:
+                merged.append(results[s])
+            elif s in remote_results:
+                merged.append(remote_results[s])
+            else:
+                failed_shards.append((s, owner))
+
+        reduced = reduce_shard_results(merged, body, agg_nodes=agg_nodes)
+
+        # --- phase 3: FETCH winners from their owning nodes
+        by_shard: Dict[int, List[Candidate]] = {}
+        for c in reduced["selected"]:
+            by_shard.setdefault(c.shard, []).append(c)
+        hits_by_key: Dict[Tuple, dict] = {}
+        for s_id, sel in by_shard.items():
+            owner = owners.get(s_id, self.name)
+            if owner == self.name:
+                sr = self.node.indices[index].searchers[s_id]
+                segs = (list(sr.replica.segments) if sr.replica is not None
+                        else list(sr.engine.segments))
+                res = ShardQueryResult(shard=s_id, segments=segs)
+                fetched = sr.fetch_phase(res, sel, dict(body),
+                                         stats_ctx=self._global_ctx(index,
+                                                                    g))
+            else:
+                cands = [(c.seg_ord, c.local_doc, c.score,
+                          list(c.sort_values), list(c.raw_sort_values))
+                         for c in sel]
+                try:
+                    r = _http(self.members[owner], "POST",
+                              "/_internal/fetch_phase",
+                              {"index": index, "body": body, "shard": s_id,
+                               "cands": _b64(cands), "g": _b64(g)})
+                    fetched = _unb64(r["hits"])
+                except (urllib.error.URLError, OSError, KeyError):
+                    # the owner died BETWEEN query and fetch: this shard's
+                    # winners can no longer be hydrated — report the shard
+                    # failed instead of silently returning fewer hits
+                    failed_shards.append((s_id, owner))
+                    fetched = []
+            for c, h in zip(sel, fetched):
+                hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
+        hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)]
+                for c in reduced["selected"]
+                if (c.shard, c.seg_ord, c.local_doc) in hits_by_key]
+        for h in hits:
+            h["_index"] = index
+
+        track = body.get("track_total_hits", True)
+        total, relation = reduced["total"], reduced.get("total_rel", "eq")
+        if track is not True and track is not False:
+            track_n = int(track)
+            if total > track_n:
+                total, relation = track_n, "gte"
+        resp = {
+            "took": int((time.monotonic() - t0) * 1000),
+            "timed_out": False,
+            "_shards": {"total": n_shards,
+                        "successful": n_shards - len(failed_shards),
+                        "skipped": 0, "failed": len(failed_shards),
+                        **({"failures": [
+                            {"shard": s, "node": n,
+                             "reason": {"type": "node_unreachable"}}
+                            for s, n in failed_shards]}
+                           if failed_shards else {})},
+            "hits": {"total": {"value": total, "relation": relation},
+                     "max_score": (reduced["max_score"]
+                                   if reduced["max_score"] != float("-inf")
+                                   else None),
+                     "hits": hits},
+        }
+        if reduced["aggs"]:
+            resp["aggregations"] = reduced["aggs"]
+        return resp
+
+    # ---------------- lifecycle ----------------
+
+    def stop(self) -> None:
+        self.server.stop()

@@ -875,6 +875,13 @@ class Node:
         pipeline response processors) — deep-copy it iff it aliases a
         request-cache entry, so cached entries stay pristine without taxing
         uncached paths."""
+        # a body the mesh already declined in this request (msearch batch
+        # decline -> per-body retry) skips the mesh: one logical search
+        # counts at most one mesh fallback, and the retry does no wasted
+        # eligibility work. Popped BEFORE cache-key derivation so the
+        # marker never perturbs request-cache identity.
+        mesh_declined = bool(body.pop("_mesh_declined", False)) \
+            if isinstance(body, dict) else False
         names, remote_parts = self._split_remote_expression(expression)
         from .admin import check_open
         names = check_open(self, names, expression)
@@ -931,11 +938,12 @@ class Node:
                         searchers, body,
                         self.indices[names[0]].mappings.star_trees)
                 if (resp is None and self.mesh_service is not None
-                        and len(names) == 1
+                        and not mesh_declined and len(names) == 1
                         and not remote_parts and phase_hook is None):
                     resp = self.mesh_service.try_search(names[0],
                                                         self.indices[names[0]],
                                                         body)
+                    body.pop("_mesh_declined", None)
                 if resp is None:
                     all_names = list(names) + [
                         f"{a}:{rn}" for a, _n, rns in remote_parts

@@ -350,7 +350,7 @@ class MeshSearchService:
         # compile + dispatch overhead for zero parallelism
         if svc.meta.num_shards < 2:
             self.fallbacks += len(bodies)
-            return out
+            return self._mark_declined(bodies, out)
         # a shard may hold any number of segments (incl. zero for routing
         # holes) — the stacked index concatenates them per shard
         shard_segs = [[g for g in s.engine.segments if g.live_count > 0]
@@ -388,7 +388,7 @@ class MeshSearchService:
             parsed.append((qi, lt, sort_specs, max(window, 1), const,
                            agg_nodes or [], fpair, qboost))
         if not parsed:
-            return out
+            return self._mark_declined(bodies, out)
 
         # group by program parameters: field (via the stacked index), sim,
         # the pow2 WINDOW CLASS — co-batching a size=10 body with a
@@ -411,6 +411,15 @@ class MeshSearchService:
             self._run_mesh_group(name, svc, bodies, out, shard_segs, stats,
                                  searchers, field, k1, b_eff, k_class,
                                  items)
+        return self._mark_declined(bodies, out)
+
+    def _mark_declined(self, bodies, out) -> list:
+        """Tag every body this call declined so the caller's per-body retry
+        skips the mesh instead of re-declining it (Node.search pops the
+        tag) — one logical search counts at most one fallback."""
+        for body, resp in zip(bodies, out):
+            if resp is None and isinstance(body, dict):
+                body["_mesh_declined"] = True
         return out
 
     def _run_mesh_group(self, name, svc, bodies, out, shard_segs, stats,
@@ -539,9 +548,11 @@ class MeshSearchService:
             results = [ShardQueryResult(shard=i,
                                         segments=list(shard_segs[i]))
                        for i in range(S)]
+            finite = np.isfinite(gvals)
             results[0].total = total
-            results[0].max_score = (float(gvals[0]) if total > 0
-                                    and np.isfinite(gvals[0]) else -np.inf)
+            results[0].max_score = (float(gvals[finite].max())
+                                    if total > 0 and finite.any()
+                                    else -np.inf)
             for j in range(len(gdocs)):
                 if not np.isfinite(gvals[j]) or gdocs[j] < 0:
                     continue

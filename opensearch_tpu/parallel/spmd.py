@@ -329,14 +329,18 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         vals, idx = jax.lax.top_k(scores, kk)                         # [QBl, kk]
         gids = jnp.where(vals > -jnp.inf, idx + doc_base, -1)
 
-        # --- coordinator reduce on device: all_gather + global top-k ---
+        # --- coordinator merge on device: all_gather the per-shard top-ks.
+        # The UNION of every shard's top-kk goes back to the host — the
+        # same candidate pool the host shard loop builds — so the final
+        # selection (host reduce, tie-break by (-score, doc id)) is
+        # IDENTICAL to the host path even on deep score ties. A device
+        # top_k over the flattened gather would instead tie-break by flat
+        # position (shard-major), silently reordering tied keyword hits.
         all_vals = jax.lax.all_gather(vals, "shard", axis=1)          # [QBl, S, kk]
         all_gids = jax.lax.all_gather(gids, "shard", axis=1)
         S = all_vals.shape[1]
-        flat_vals = all_vals.reshape(all_vals.shape[0], S * kk)
-        flat_gids = all_gids.reshape(all_gids.shape[0], S * kk)
-        gvals, gpos = jax.lax.top_k(flat_vals, kk)
-        gdocs = jnp.take_along_axis(flat_gids, gpos, axis=1)
+        gvals = all_vals.reshape(all_vals.shape[0], S * kk)
+        gdocs = all_gids.reshape(all_gids.shape[0], S * kk)
         return gdocs, gvals, totals
 
     shard_map = jax.shard_map

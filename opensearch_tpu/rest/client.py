@@ -20,6 +20,7 @@ from ..index.engine import VersionConflictError
 from ..ingest.pipeline import DropDocument
 from ..search.executor import ShardSearcher, explain_doc, search_shards
 from ..search import compiler as C
+from ..search import fastpath as _fastpath
 from ..search import query_dsl as dsl
 from ..search.pipeline import SearchPipelineException
 from ..utils.breaker import CircuitBreakingException
@@ -641,6 +642,12 @@ class RestClient:
         else:
             for i in todo:
                 partial[i] = run_one(i)
+        for _, b in pairs:
+            if isinstance(b, dict):
+                # internal mesh-decline marker must not leak into the
+                # caller's body dicts (bodies served by the batched kernel
+                # path never traverse Node.search, which pops it)
+                b.pop("_mesh_declined", None)
         return {"took": 0, "responses": partial}
 
     # ------ _remotestore/_restore (reference RestoreRemoteStoreAction) -----
@@ -802,7 +809,14 @@ class RestClient:
             "search_backpressure": n.search_backpressure.stats(),
             "search_pipelines": n.search_pipelines.stats(),
             "tracing": n.tracer.stats(),
+            # device query-phase telemetry: kernel serve/fallback counters
+            # incl. pruned-path escalations (the pruning design is only as
+            # good as its escalation rate), and the SPMD mesh dispatch
+            # share when a mesh service is attached
+            "fastpath": dict(_fastpath.STATS),
         }
+        if n.mesh_service is not None:
+            node_block["mesh"] = n.mesh_service.stats()
         return {"cluster_name": n.metadata.cluster_name,
                 "nodes": {n.node_name: node_block}}
 

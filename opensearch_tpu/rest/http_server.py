@@ -98,6 +98,20 @@ class _Handler(BaseHTTPRequestHandler):
     # ---------------- routing ----------------
 
     def _route(self, method: str, parts, params) -> Tuple[int, object]:
+        # node-to-node RPC surface for the multi-process cluster
+        # (cluster/distnode.py); absent unless a DistClusterNode owns this
+        # server
+        if parts and parts[0] == "_internal":
+            # read through the HttpServer wrapper so `srv.dist = node` works
+            # whether assigned before or after start()
+            owner = getattr(self.server, "owner", None)
+            dist = owner.dist if owner is not None else None
+            if dist is None:
+                return 404, {"error": {
+                    "type": "resource_not_found_exception",
+                    "reason": "not a cluster transport endpoint"}}
+            return dist.handle_internal(method, parts,
+                                        self._json_body() or {})
         c: RestClient = self.server.client            # type: ignore
         wlock = self.server.write_lock                # type: ignore
 
@@ -301,6 +315,7 @@ class HttpServer:
         self.client = client or RestClient()
         self.host = host
         self.port = port
+        self.dist = None          # DistClusterNode when clustered
         self._srv: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -308,6 +323,7 @@ class HttpServer:
         self._srv = ThreadingHTTPServer((self.host, self.port), _Handler)
         self._srv.client = self.client                 # type: ignore
         self._srv.write_lock = threading.RLock()       # type: ignore
+        self._srv.owner = self                         # type: ignore
         self._srv.daemon_threads = True
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(target=self._srv.serve_forever,

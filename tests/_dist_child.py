@@ -1,0 +1,25 @@
+"""Child process for the two-process cluster tests (tests/test_distnode.py):
+brings up a full DistClusterNode, joins the seed, serves until killed."""
+
+import sys
+import time
+
+import jax
+
+# the axon profile would force the TPU tunnel backend; these tests run the
+# product on CPU (same pattern as tests/conftest.py)
+jax.config.update("jax_platforms", "cpu")
+
+from opensearch_tpu.cluster.distnode import DistClusterNode  # noqa: E402
+
+
+def main():
+    seed = sys.argv[1]
+    n = DistClusterNode("b", seed=seed)
+    print(f"READY {n.addr}", flush=True)
+    while True:
+        time.sleep(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,7 +82,10 @@ def main() -> None:
         out = []
         for qi in range(QB):
             ids = []
-            for g, v in zip(gdocs[qi], gvals[qi]):
+            # the program returns the UNSORTED per-shard top-k union (the
+            # host coordinator owns the final selection): rank here
+            order = np.argsort(-gvals[qi], kind="stable")
+            for g, v in zip(gdocs[qi][order], gvals[qi][order]):
                 if g < 0 or not np.isfinite(v):
                     continue
                 si = int(np.searchsorted(bases, g, side="right") - 1)
