@@ -380,24 +380,6 @@ class DistClusterNode:
                                "distributed index")
         return agg_nodes or []
 
-    def _check_no_named(self, index: str, body: dict) -> None:
-        """matched_queries is fetch-side state that does not cross the wire
-        yet: refuse explicitly rather than silently dropping it."""
-        from ..search.executor import _collect_named
-        svc = self.node.indices[index]
-        segs = [s for sr in svc.searchers for s in sr.engine.segments]
-        ctx = C.ShardContext(svc.mappings, segs, svc.default_sim,
-                             getattr(svc, "field_similarities", None))
-        try:
-            lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx,
-                              scoring=True)
-        except dsl.QueryParseError:
-            return
-        if _collect_named(lroot):
-            raise ApiError(400, "illegal_argument_exception",
-                           "named queries (_name) are not supported on a "
-                           "distributed index")
-
     def _local_dfs(self, index: str, body: dict) -> dict:
         svc = self.node.indices[index]
         searchers = svc.searchers
@@ -405,7 +387,12 @@ class DistClusterNode:
         ctx = RecordingStatsContext(svc.mappings, segs, svc.default_sim,
                                     getattr(svc, "field_similarities", None))
         try:
-            C.rewrite(dsl.parse_query(body.get("query")), ctx, scoring=True)
+            from ..search.executor import _collect_named
+            lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx,
+                              scoring=True)
+            # named queries are fetch-side state that does not cross the
+            # wire yet; piggyback the check on the rewrite DFS already does
+            ctx.rec["named"] = bool(_collect_named(lroot))
         except dsl.QueryParseError:
             pass
         _ = ctx.num_docs          # maxDoc is always part of the DFS result
@@ -459,7 +446,6 @@ class DistClusterNode:
         if svc is None:
             raise ApiError(404, "index_not_found_exception",
                            f"no such index [{index}]")
-        self._check_no_named(index, body)
         n_shards = svc.meta.num_shards
         owners = self.routing.get(index, {s: self.name
                                           for s in range(n_shards)})
@@ -468,6 +454,10 @@ class DistClusterNode:
 
         # --- phase 1: DFS (collection statistics from every node)
         parts = [self._local_dfs(index, body)]
+        if parts[0].get("named"):
+            raise ApiError(400, "illegal_argument_exception",
+                           "named queries (_name) are not supported on a "
+                           "distributed index")
         dead: List[str] = []
         for m in remote_members:
             try:

@@ -375,7 +375,7 @@ class MeshSearchService:
             if shape is None:
                 self.fallbacks += 1
                 continue
-            lt, fnodes, notnodes, qboost = shape
+            lt, fnodes, notnodes, qboost, msm_eff = shape
             fpair = None            # (combo_key, per-shard host masks)
             if fnodes or notnodes:
                 fpair = self._fmask_resolve(shard_segs, stats, fnodes,
@@ -386,7 +386,7 @@ class MeshSearchService:
             const = (float(getattr(lt, "boost", 1.0) or 1.0) * qboost
                      if lt.mode == "filter" else 0.0)
             parsed.append((qi, lt, sort_specs, max(window, 1), const,
-                           agg_nodes or [], fpair, qboost))
+                           agg_nodes or [], fpair, qboost, msm_eff))
         if not parsed:
             return self._mark_declined(bodies, out)
 
@@ -398,7 +398,8 @@ class MeshSearchService:
         # filters repeat heavily so batching survives the split)
         groups: dict = {}
         for item in parsed:
-            qi, lt, sort_specs, window, const, aggs, fpair, qboost = item
+            (qi, lt, sort_specs, window, const, aggs, fpair, qboost,
+             msm_eff) = item
             sim = lt.sim
             k1 = float(sim.k1) if sim is not None else 1.2
             b_eff = (float(sim.b)
@@ -477,14 +478,14 @@ class MeshSearchService:
         msm = np.ones(QB, np.float32)
         cscore = np.zeros(QB, np.float32)
         total_max = 1
-        for bi, (qi, lt, sort_specs, window, const, aggs, _fk, qboost) in \
-                enumerate(items):
+        for bi, (qi, lt, sort_specs, window, const, aggs, _fk, qboost,
+                 msm_eff) in enumerate(items):
             nt = len(lt.terms)
             # a wrapping bool's boost folds into the term weights: BM25 is
             # linear in the per-term weight, so boost*score == sum of
             # boost-scaled contributions (constant-score goes via cscore)
             boosts[bi, :nt] = lt.raw_boosts[:nt] * qboost
-            msm[bi] = float(lt.msm)
+            msm[bi] = float(lt.msm) if msm_eff is None else float(msm_eff)
             cscore[bi] = const
             for si in range(S):
                 tot = 0
@@ -540,8 +541,8 @@ class MeshSearchService:
         doc_base = np.asarray(stacked.doc_base)
         seg_bases = [np.cumsum([0] + ndocs[:-1])
                      for ndocs in stacked.seg_ndocs]
-        for bi, (qi, lt, sort_specs, window, const, aggs, _fk, qboost) in \
-                enumerate(items):
+        for bi, (qi, lt, sort_specs, window, const, aggs, _fk, qboost,
+                 _msm_eff) in enumerate(items):
             gdocs = gdocs_b[bi]
             gvals = gvals_b[bi]
             total = int(totals_b[bi])
@@ -576,7 +577,7 @@ class MeshSearchService:
                 if an.kind == "terms":
                     counts = tcounts_by_field[an.body["field"]][bi]
                     vocab = tvocab_by_field[an.body["field"]]
-                    buckets = {vocab[o]: {"doc_count": int(round(c))}
+                    buckets = {vocab[o]: {"doc_count": int(c)}
                                for o, c in enumerate(counts[: len(vocab)])
                                if c > 0}
                     results[0].agg_partials[an.name] = [{"buckets": buckets}]
@@ -639,16 +640,28 @@ class MeshSearchService:
                                == "desc"):
             return None
 
-        # unwrap a bool: one scoring clause + maskable filters/must_nots
+        # unwrap a bool: one scoring clause + maskable filters/must_nots.
+        # msm_eff: the program-level minimum term matches — 0 when the bool
+        # makes its single should OPTIONAL (filter-context bool, compiler
+        # msm=0: docs matching only the filters still hit, scoring 0.0)
         fnodes: list = []
         notnodes: list = []
         qboost = 1.0
+        msm_eff = None           # None -> use the term group's own msm
         lt = lroot
         if isinstance(lroot, C.LBool):
             if lroot.shoulds:
                 if lroot.musts or len(lroot.shoulds) != 1 or lroot.msm > 1:
                     return None
                 lt = lroot.shoulds[0]
+                if lroot.msm == 0:
+                    # optional should: only sound with real filters (the
+                    # match set is the filter set) and a scoring group
+                    # (constant-score cscore would stamp non-matching docs)
+                    if not lroot.filters or getattr(lt, "mode", None) \
+                            != "score":
+                        return None
+                    msm_eff = 0.0
             elif len(lroot.musts) == 1:
                 lt = lroot.musts[0]
             else:
@@ -672,7 +685,7 @@ class MeshSearchService:
             return None
         if lt.aux is not None and np.any(np.asarray(lt.aux)[:nt] != 0.0):
             return None
-        return (lt, fnodes, notnodes, qboost)
+        return (lt, fnodes, notnodes, qboost, msm_eff)
 
     def _maskable(self, node) -> bool:
         """Filter-context clauses the mesh serves via cached dense masks

@@ -464,10 +464,13 @@ def build_distributed_terms_agg(mesh: Mesh, bucket: int, ndocs_pad: int,
             scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
                                       m, cs, n_global, dfg, avgdl, bucket,
                                       ndocs_pad, k1, b, fm)
-            matched = (scores > -jnp.inf).astype(jnp.float32)
-            contrib = jnp.where(vvalid, matched[vd_safe], 0.0)
-            return jnp.zeros(vpad, jnp.float32).at[vo].add(contrib,
-                                                           mode="drop")
+            # int32 accumulation: f32 scatter-adds stop counting exactly at
+            # 2^24 docs/bucket, which ClueWeb-class corpora exceed — the
+            # "doc_count_error_upper_bound: 0" contract requires integers
+            matched = (scores > -jnp.inf).astype(jnp.int32)
+            contrib = jnp.where(vvalid, matched[vd_safe], 0)
+            return jnp.zeros(vpad, jnp.int32).at[vo].add(contrib,
+                                                         mode="drop")
 
         part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)  # [QB,V]
         return jax.lax.psum(part, "shard")

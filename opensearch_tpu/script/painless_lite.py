@@ -9,14 +9,23 @@ vector over the whole segment, operators become VPU elementwise ops, and the
 whole script fuses into the surrounding query program. Host contexts (update,
 ingest processors, script_fields, sort) interpret the same AST per document.
 
-Grammar (subset of Painless):
+Grammar (subset of Painless; r5 widened to the reference test-corpus
+statement shapes):
   program   := stmt (';' stmt)* ';'?
-  stmt      := 'def' ID '=' expr | 'if' '(' expr ')' block ('else' (block|if))?
-             | 'for' '(' ID 'in' expr ')' block | 'return' expr
-             | lvalue ('='|'+='|'-='|'*='|'/=') expr | expr
+  stmt      := type ID '=' expr | 'if' '(' expr ')' block ('else' (block|if))?
+             | 'for' '(' [type] ID (in|':') expr ')' block
+             | 'for' '(' init ';' cond ';' update ')' block
+             | 'while' '(' expr ')' block | 'break' | 'continue'
+             | 'return' expr | lvalue ('='|'+='|'-='|'*='|'/=') expr | expr
   expr      := ternary with ||, &&, ==/!=, </<=/>/>=, +/-, */ /%, unary -/!,
-               postfix .member, [index], call(args)
+               ++/-- (pre/post), postfix .member, [index], call(args),
+               lambda: ID '->' body | '(' params ')' '->' body, f(args)
 Literals: numbers, 'str'/"str", true/false/null, [a,b] lists, [:] maps.
+Collections carry the whitelisted java.util surface incl. sort(cmp),
+removeIf(f), stream() pipelines (filter/map/sorted/distinct/limit/skip/
+count/sum/average/min/max/anyMatch/allMatch/noneMatch/collect/findFirst),
+String.splitOnToken, array .length. Device (score-context) tracing remains
+arithmetic-only — loops/collections are host contexts, documented contract.
 
 ASTs are nested tuples — hashable, so a device script can live inside a jit
 static spec and share the XLA program cache across segments.
@@ -45,11 +54,15 @@ _TOKEN_RE = re.compile(r"""
   | (?P<num>\d+\.\d*(?:[eE][+-]?\d+)?|\.\d+(?:[eE][+-]?\d+)?|\d+(?:[eE][+-]?\d+)?[fFdDlL]?)
   | (?P<str>'(?:[^'\\]|\\.)*'|"(?:[^"\\]|\\.)*")
   | (?P<id>[A-Za-z_][A-Za-z0-9_]*)
-  | (?P<op>==|!=|<=|>=|&&|\|\||\+=|-=|\*=|/=|%=|\+\+|--|[-+*/%!<>=?:.,()\[\]{};])
+  | (?P<op>==|!=|<=|>=|&&|\|\||->|\+=|-=|\*=|/=|%=|\+\+|--|[-+*/%!<>=?:.,()\[\]{};])
 """, re.VERBOSE | re.DOTALL)
 
 _KEYWORDS = {"def", "if", "else", "for", "in", "return", "true", "false", "null",
-             "int", "long", "float", "double", "boolean", "String", "var"}
+             "int", "long", "float", "double", "boolean", "String", "var",
+             "while", "break", "continue"}
+
+_TYPE_KWS = ("def", "var", "int", "long", "float", "double", "boolean",
+             "String")
 
 
 def _lex(src: str) -> List[Tuple[str, Any]]:
@@ -136,22 +149,27 @@ class _Parser:
 
     def stmt(self) -> tuple:
         k, v = self.peek()
-        if k == "kw" and v in ("def", "var", "int", "long", "float", "double",
-                               "boolean", "String"):
+        if k == "kw" and v in _TYPE_KWS:
             self.next()
             name = self.expect("id")
             self.expect("op", "=")
             return ("decl", name, self.expr())
         if k == "kw" and v == "if":
             return self._if()
-        if k == "kw" and v == "for":
+        if k == "kw" and v == "while":
             self.next()
             self.expect("op", "(")
-            name = self.expect("id")
-            self.expect("kw", "in")
-            it = self.expr()
+            cond = self.expr()
             self.expect("op", ")")
-            return ("for", name, it, self.block())
+            return ("while", cond, self.block())
+        if k == "kw" and v == "break":
+            self.next()
+            return ("break",)
+        if k == "kw" and v == "continue":
+            self.next()
+            return ("continue",)
+        if k == "kw" and v == "for":
+            return self._for()
         if k == "kw" and v == "return":
             self.next()
             if self.peek() in (("op", ";"), ("eof", None)):
@@ -166,6 +184,35 @@ class _Parser:
                 raise ScriptError("invalid assignment target")
             return ("assign", vv, expr, rhs)
         return ("exprstmt", expr)
+
+    def _for(self) -> tuple:
+        """All three reference for-forms:
+        `for (x in e)` / `for ([type] x : e)` (for-each) and the C-style
+        `for (init; cond; update)` (the dominant shape in the reference's
+        painless test corpus)."""
+        self.expect("kw", "for")
+        self.expect("op", "(")
+        save = self.i
+        # try for-each: optional type keyword, id, then `in` or `:`
+        k, v = self.peek()
+        if k == "kw" and v in _TYPE_KWS:
+            self.next()
+        if self.peek()[0] == "id":
+            name = self.next()[1]
+            if self.accept("kw", "in") or self.accept("op", ":"):
+                it = self.expr()
+                self.expect("op", ")")
+                return ("for", name, it, self.block())
+        # C-style: rewind and parse init; cond; update
+        self.i = save
+        init = None if self.peek() == ("op", ";") else self.stmt()
+        self.expect("op", ";")
+        cond = (("bool", True) if self.peek() == ("op", ";")
+                else self.expr())
+        self.expect("op", ";")
+        update = None if self.peek() == ("op", ")") else self.stmt()
+        self.expect("op", ")")
+        return ("cfor", init, cond, update, self.block())
 
     def _if(self) -> tuple:
         self.expect("kw", "if")
@@ -222,6 +269,10 @@ class _Parser:
         return self._binop(self.unary, ("*", "/", "%"))
 
     def unary(self) -> tuple:
+        if self.accept("op", "++"):
+            return ("incdec", self.unary(), 1, True)
+        if self.accept("op", "--"):
+            return ("incdec", self.unary(), -1, True)
         if self.accept("op", "-"):
             return ("un", "-", self.unary())
         if self.accept("op", "!"):
@@ -246,6 +297,12 @@ class _Parser:
                 idx = self.expr()
                 self.expect("op", "]")
                 e = ("index", e, idx)
+            elif self.accept("op", "++"):
+                e = ("incdec", e, 1, False)
+            elif self.accept("op", "--"):
+                e = ("incdec", e, -1, False)
+            elif e[0] in ("var", "lambda") and self.accept("op", "("):
+                e = ("invoke", e, tuple(self._args()))   # f(x): lambda call
             else:
                 return e
 
@@ -258,6 +315,30 @@ class _Parser:
             args.append(self.expr())
         self.expect("op", ")")
         return args
+
+    def _peek_lambda_params(self) -> Optional[tuple]:
+        """Called with '(' already consumed: scan ahead for the
+        `id (, id)* ) ->` (or `) ->`) pattern WITHOUT consuming; on match,
+        consume through '->' and return the parameter tuple."""
+        j = self.i
+        params = []
+        if self.toks[j][0] == "id":
+            params.append(self.toks[j][1])
+            j += 1
+            while self.toks[j] == ("op", ","):
+                if self.toks[j + 1][0] != "id":
+                    return None
+                params.append(self.toks[j + 1][1])
+                j += 2
+        if self.toks[j] != ("op", ")") or self.toks[j + 1] != ("op", "->"):
+            return None
+        self.i = j + 2
+        return tuple(params)
+
+    def _lambda_body(self) -> tuple:
+        if self.peek() == ("op", "{"):
+            return self.block()
+        return ("block", (("return", self.expr()),))
 
     def primary(self) -> tuple:
         k, v = self.next()
@@ -272,8 +353,14 @@ class _Parser:
         if k == "kw" and v == "null":
             return ("null",)
         if k == "id":
+            if self.peek() == ("op", "->"):        # x -> expr
+                self.next()
+                return ("lambda", (v,), self._lambda_body())
             return ("var", v)
         if k == "op" and v == "(":
+            params = self._peek_lambda_params()
+            if params is not None:                 # (a, b) -> expr
+                return ("lambda", params, self._lambda_body())
             e = self.expr()
             self.expect("op", ")")
             return e
@@ -352,6 +439,39 @@ class _Return(Exception):
 
 class _Break(Exception):
     pass
+
+
+class _Continue(Exception):
+    pass
+
+
+class _Lambda:
+    """A painless lambda closing over the enclosing scope (reference
+    lambdas capture effectively-final locals; we shadow + restore)."""
+
+    __slots__ = ("params", "body", "env")
+
+    def __init__(self, params, body, env):
+        self.params = params
+        self.body = body
+        self.env = env
+
+    def __call__(self, *args):
+        saved = {p: self.env.vars.get(p, _MISSING) for p in self.params}
+        self.env.vars.update(dict(zip(self.params, args)))
+        try:
+            return _exec_block(self.body, self.env)
+        except _Return as r:
+            return r.value
+        finally:
+            for p, old in saved.items():
+                if old is _MISSING:
+                    self.env.vars.pop(p, None)
+                else:
+                    self.env.vars[p] = old
+
+
+_MISSING = object()
 
 
 _MATH_FNS: Dict[str, Callable] = {
@@ -456,8 +576,49 @@ def _exec_stmt(st: tuple, env: HostEnv) -> Any:  # noqa: C901
             if i >= MAX_LOOP_ITERS:
                 raise ScriptError("loop iteration limit exceeded")
             env.vars[name] = item
-            _exec_block(body, env)
+            try:
+                _exec_block(body, env)
+            except _Break:
+                break
+            except _Continue:
+                continue
         return None
+    if op == "cfor":
+        _, init, cond, update, body = st
+        if init is not None:
+            _exec_stmt(init, env)
+        n = 0
+        while _truthy(_eval(cond, env)):
+            if n >= MAX_LOOP_ITERS:
+                raise ScriptError("loop iteration limit exceeded")
+            n += 1
+            try:
+                _exec_block(body, env)
+            except _Break:
+                break
+            except _Continue:
+                pass
+            if update is not None:
+                _exec_stmt(update, env)
+        return None
+    if op == "while":
+        _, cond, body = st
+        n = 0
+        while _truthy(_eval(cond, env)):
+            if n >= MAX_LOOP_ITERS:
+                raise ScriptError("loop iteration limit exceeded")
+            n += 1
+            try:
+                _exec_block(body, env)
+            except _Break:
+                break
+            except _Continue:
+                continue
+        return None
+    if op == "break":
+        raise _Break()
+    if op == "continue":
+        raise _Continue()
     if op == "return":
         raise _Return(_eval(st[1], env))
     if op == "assign":
@@ -575,6 +736,19 @@ def _eval(e: tuple, env: HostEnv) -> Any:  # noqa: C901
         return {_eval(k, env): _eval(v, env) for k, v in e[1]}
     if kind == "cond":
         return _eval(e[2], env) if _truthy(_eval(e[1], env)) else _eval(e[3], env)
+    if kind == "lambda":
+        return _Lambda(e[1], e[2], env)
+    if kind == "invoke":
+        fn = _eval(e[1], env)
+        if not callable(fn):
+            raise ScriptError("not a function")
+        return fn(*[_eval(a, env) for a in e[2]])
+    if kind == "incdec":
+        _, target, delta, pre = e
+        cur = _eval(target, env)
+        new = cur + delta
+        _assign(target, new, env)
+        return new if pre else cur
     if kind == "un":
         v = _eval(e[2], env)
         return (not _truthy(v)) if e[1] == "!" else -v
@@ -624,6 +798,8 @@ def _member(obj, name: str):  # noqa: C901
             return obj.values
     if isinstance(obj, str) and name == "length":
         return len(obj)
+    if isinstance(obj, list) and name == "length":
+        return len(obj)     # Java array .length (splitOnToken results)
     raise ScriptError(f"unknown member [{name}] on {type(obj).__name__}")
 
 
@@ -645,6 +821,8 @@ def _call(e: tuple, env: HostEnv):  # noqa: C901
             return obj.get(args[0])
         if name == "isEmpty":
             return obj.empty
+    if isinstance(obj, _Stream):
+        return obj.method(name, args)
     if isinstance(obj, str):
         return _str_method(obj, name, args)
     if isinstance(obj, list):
@@ -684,6 +862,9 @@ def _str_method(s: str, name: str, args: list):  # noqa: C901
         return s.replace(args[0], args[1])
     if name == "split":
         return re.split(args[0], s)
+    if name == "splitOnToken":
+        return s.split(args[0], int(args[1])) if len(args) == 2 \
+            else s.split(args[0])
     if name == "indexOf":
         return s.find(args[0])
     if name == "equals":
@@ -713,7 +894,10 @@ def _list_method(lst: list, name: str, args: list):  # noqa: C901
         lst.remove(v)
         return None
     if name == "removeIf":
-        raise ScriptError("removeIf (lambdas) not supported in painless-lite")
+        keep = [x for x in lst if not _truthy(args[0](x))]
+        changed = len(keep) != len(lst)
+        lst[:] = keep
+        return changed
     if name == "size":
         return len(lst)
     if name == "contains":
@@ -728,9 +912,86 @@ def _list_method(lst: list, name: str, args: list):  # noqa: C901
         lst.extend(args[0])
         return None
     if name == "sort":
-        lst.sort()
+        if args and callable(args[0]):
+            import functools
+            lst.sort(key=functools.cmp_to_key(
+                lambda a, b: int(args[0](a, b))))
+        else:
+            lst.sort()
+        return None
+    if name == "stream":
+        return _Stream(list(lst))
+    if name == "each":
+        for x in list(lst):
+            args[0](x)
         return None
     raise ScriptError(f"unknown List method [{name}]")
+
+
+class _Stream:
+    """Painless stream pipeline over a host list (the java.util.stream
+    subset the reference's painless whitelist exposes; terminal ops
+    materialize eagerly — scripts are bounded by MAX_LOOP_ITERS anyway)."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = items
+
+    def method(self, name, args):
+        if name == "filter":
+            return _Stream([x for x in self.items if _truthy(args[0](x))])
+        if name == "map" or name == "mapToDouble" or name == "mapToInt" \
+                or name == "mapToLong":
+            out = [args[0](x) for x in self.items]
+            if name == "mapToInt" or name == "mapToLong":
+                out = [int(x) for x in out]
+            elif name == "mapToDouble":
+                out = [float(x) for x in out]
+            return _Stream(out)
+        if name == "sorted":
+            if args and callable(args[0]):
+                import functools
+                return _Stream(sorted(self.items, key=functools.cmp_to_key(
+                    lambda a, b: int(args[0](a, b)))))
+            return _Stream(sorted(self.items))
+        if name == "distinct":
+            seen, out = set(), []
+            for x in self.items:
+                k = (type(x).__name__, x) if isinstance(x, (int, float, str, bool)) else id(x)
+                if k not in seen:
+                    seen.add(k)
+                    out.append(x)
+            return _Stream(out)
+        if name == "limit":
+            return _Stream(self.items[: int(args[0])])
+        if name == "skip":
+            return _Stream(self.items[int(args[0]):])
+        if name == "count":
+            return len(self.items)
+        if name == "sum":
+            return sum(self.items)
+        if name == "average":
+            return (sum(self.items) / len(self.items)) if self.items else None
+        if name == "min":
+            return min(self.items) if self.items else None
+        if name == "max":
+            return max(self.items) if self.items else None
+        if name == "anyMatch":
+            return any(_truthy(args[0](x)) for x in self.items)
+        if name == "allMatch":
+            return all(_truthy(args[0](x)) for x in self.items)
+        if name == "noneMatch":
+            return not any(_truthy(args[0](x)) for x in self.items)
+        if name == "forEach":
+            for x in self.items:
+                args[0](x)
+            return None
+        if name == "collect" or name == "toList":
+            return list(self.items)
+        if name == "findFirst" or name == "findAny":
+            return self.items[0] if self.items else None
+        raise ScriptError(f"unknown Stream method [{name}]")
 
 
 def _map_method(m: dict, name: str, args: list):  # noqa: C901

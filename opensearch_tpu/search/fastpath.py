@@ -32,9 +32,14 @@ MAX_T = 8            # pow2-padded term slots per query group
 MAX_L = 1 << 16      # per-term VMEM bucket cap (elements)
 MAX_TL = 1 << 17     # T_pad * L cap (~16MB VMEM incl. merge working set)
 MAX_K = 128          # top-k lanes the kernel returns
-MAX_CHUNKS = 256     # doc-range split bound: covers a stopword-class row of
-                     # ~16M postings (256 x 64K) so even an every-doc term
-                     # stays on-kernel when a pruned query escalates dense
+MAX_CHUNKS = 4096    # doc-range split bound. Postings are <=1 per doc, so a
+                     # chunk spanning W doc ids holds <=W postings per term;
+                     # at 4096 chunks a 50M-doc ClueWeb-class segment has
+                     # W ~= 12.2K <= the per-term VMEM budget even at
+                     # T_pad=8 (MAX_TL/8 = 16K) — EVERY df, including an
+                     # every-doc stopword, stays on-kernel (config 5).
+                     # _chunk_slots starts at the predicted count, so the
+                     # planning loop doesn't crawl up from 2 by doubling.
 INT_MAX = np.int32(2**31 - 1)
 
 # Impact-ordered head pruning (the device analog of Lucene's block-max
@@ -478,6 +483,11 @@ def _chunk_slots(slots: List[Optional[Tuple[np.ndarray, int]]], ndocs: int,
     Returns a list of (dlo, dhi, rowstarts, nrows, lens) tuples covering
     disjoint doc ranges; None -> fall back."""
     budget = MAX_TL // T_total        # elements per slot
+    # start at the provably-needed chunk count instead of doubling up from
+    # the caller's floor: a slot of L postings needs >= L/budget chunks
+    max_len = max((len(s[0]) for s in slots if s is not None), default=0)
+    if max_len > budget:
+        nchunk = max(nchunk, next_pow2(-(-max_len // budget), floor=2))
     while nchunk <= MAX_CHUNKS:
         edges = np.linspace(0, ndocs, nchunk + 1).astype(np.int64)
         edges[-1] = np.int64(2**31 - 1)

@@ -316,6 +316,15 @@ class TestMeshService:
         {"query": {"bool": {"must": [{"match": {"body": "eps"}}],
                             "filter": [{"exists": {"field": "num"}}]}},
          "size": 10},
+        # OPTIONAL should (compiler msm=0 when filters present): docs
+        # matching only the filter still hit, scoring 0.0 — the r5 review
+        # regression
+        {"query": {"bool": {"should": [{"match": {"body": "alpha"}}],
+                            "filter": [{"term": {"cat": "garage"}}]}},
+         "size": 20},
+        {"query": {"bool": {"should": [{"match": {"body": "zeta"}}],
+                            "filter": [{"range": {"num": {"lt": 60}}}]}},
+         "size": 30},
     ])
     def test_filtered_rest_equals_mesh(self, clients, body):
         cm, ch = clients

@@ -263,3 +263,42 @@ class TestBoolSpec:
         assert fl2.n == seg.ndocs - fl.n
         # cached on repeat
         assert fastpath._filter_list(seg, ctx, [(node, False)]) is fl
+
+
+class TestClueWebScaleChunking:
+    """ClueWeb-class rows (config 5): chunk planning must keep EVERY df on
+    the kernel — including an every-doc stopword at 50M docs (r4 verdict:
+    the old 256-chunk cap topped out at ~16.7M postings/term)."""
+
+    def test_stopword_row_50m_docs_plans_on_kernel(self):
+        ndocs = 50_000_000
+        # stopword: one posting in 3 of every 5 docs -> df = 30M
+        docs = np.arange(0, ndocs, dtype=np.int64)
+        docs = docs[(docs % 5) < 3]
+        assert len(docs) == 30_000_000
+        t_total = 8                       # worst-case term-slot padding
+        slots = [(docs, 0), None, (docs[: 1 << 20], 1 << 25)] + [None] * 5
+        plan = fastpath._chunk_slots(slots, ndocs, t_total)
+        assert plan is not None, "fell off-kernel"
+        assert len(plan) <= fastpath.MAX_CHUNKS
+        budget = fastpath.MAX_TL // t_total
+        covered = 0
+        prev_hi = 0
+        for dlo, dhi, rowstarts, nrows, lens, skips in plan:
+            assert dlo == prev_hi          # disjoint, gapless doc ranges
+            prev_hi = dhi
+            for i in range(t_total):
+                assert skips[i] + lens[i] <= budget
+            covered += int(lens[0])
+        assert covered == len(docs)        # every posting in exactly 1 chunk
+
+    def test_chunk_start_prediction_matches_doubling(self):
+        # the predicted starting nchunk must agree with what pure doubling
+        # finds (no over-chunking beyond one pow2 step)
+        ndocs = 1_000_000
+        docs = np.arange(ndocs, dtype=np.int64)
+        plan = fastpath._chunk_slots([(docs, 0)], ndocs, 1)
+        assert plan is not None
+        budget = fastpath.MAX_TL // 1
+        need = -(-len(docs) // budget)
+        assert len(plan) <= 2 * (1 << (need - 1).bit_length())

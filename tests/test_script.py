@@ -7,6 +7,7 @@ import pytest
 
 from opensearch_tpu.rest.client import ApiError, RestClient
 from opensearch_tpu.script import ScriptError, execute
+from opensearch_tpu.script import painless_lite
 from opensearch_tpu.script.painless_lite import (parse, referenced_doc_fields,
                                                  validate_device_script)
 
@@ -276,3 +277,53 @@ class TestScriptFieldsSortUpdate:
         client.index("s", {"price": 3.0, "qty": 4}, id="x", pipeline="calc",
                      refresh=True)
         assert client.get("s", "x")["_source"]["total"] == 12.0
+
+
+class TestReferencePainlessShapes:
+    """r5 depth probe: the statement/collection shapes that dominate the
+    reference's painless test corpus (`modules/lang-painless` tests) —
+    C-style for, for-each with `:`, while, break/continue, ++/--, lambdas,
+    streams, splitOnToken — must run on the host interpreter."""
+
+    @pytest.mark.parametrize("src,want", [
+        ("int total = 0; for (int i = 0; i < 10; ++i) { total += i } "
+         "return total;", 45),
+        ("def total = 0; for (def x : [1,2,3]) { total += x } "
+         "return total;", 6),
+        ("def i = 0; def s = 0; while (i < 5) { s += i; i += 1 } "
+         "return s;", 10),
+        ("def s = 0; for (int i = 0; i < 100; i++) { if (i > 4) break; "
+         "s += i } return s;", 10),
+        ("def s = 0; for (int i = 0; i < 6; i++) { if (i % 2 == 0) "
+         "continue; s += i } return s;", 9),
+        ("def s = 'a,b,c'; return s.splitOnToken(',').length;", 3),
+        ("def vals = [3,1,2]; vals.sort((a,b) -> a - b); "
+         "return vals[0];", 1),
+        ("def vals = [3,1,2]; vals.sort((a,b) -> b - a); "
+         "return vals[0];", 3),
+        ("def l = [1,2,3,4]; return l.stream().filter(x -> x > 2)"
+         ".count();", 2),
+        ("def l = [1,2,3,4]; return l.stream().map(x -> x * 2).sum();", 20),
+        ("def l = [4,1,3]; return l.stream().sorted().toList()[0];", 1),
+        ("def l = [1,2,2,3]; return l.stream().distinct().count();", 3),
+        ("def l = [1,5,2]; return l.stream().anyMatch(x -> x > 4);", True),
+        ("def l = [1,5,2]; return l.stream().allMatch(x -> x > 0);", True),
+        ("def l = [1,2,3]; l.removeIf(x -> x > 1); return l.size();", 1),
+        ("def i = 3; def j = i++; return i * 10 + j;", 43),
+        ("def i = 3; def j = ++i; return i * 10 + j;", 44),
+        ("def m = [:]; for (int i = 0; i < 3; i++) { m[i] = i * i } "
+         "return m[2];", 4),
+        ("def f = x -> x * x; return f(5);", 25),
+    ])
+    def test_shape(self, src, want):
+        assert painless_lite.execute(src, {}) == want
+
+    def test_loop_limit_guards_while(self):
+        with pytest.raises(painless_lite.ScriptError):
+            painless_lite.execute("def i = 0; while (true) { i += 1 } "
+                                  "return i;", {})
+
+    def test_lambda_captures_and_restores_scope(self):
+        src = ("def x = 7; def l = [1,2]; def s = l.stream()"
+               ".map(v -> v + x).sum(); return s * 100 + x;")
+        assert painless_lite.execute(src, {}) == 1707
