@@ -411,6 +411,9 @@ def main():
         "corpus_build_s": round(build_s, 1),
         "baseline": "C++ MaxScore/conjunction skipping scorer (native/), "
                     "single core; published CPU-Lucene band 50-150 q/s/core",
+        "corpus_provenance": "synthetic MS-MARCO-shaped (zero-egress image,"
+                             " no real datasets available): distribution "
+                             "match documented in docs/BENCH_CORPUS.md",
         "cpu_maxscore_match_qps": round(cpu1_qps, 1),
         "cpu_maxscore_match_spread": cpu1_spread,
         "cpu_maxscore_bool_qps": round(cpu2_qps, 1),

@@ -79,6 +79,25 @@ def _builtin(name: str) -> Analyzer:
         return Analyzer(name, standard_tokenizer,
                         [cjk_width_filter, lowercase_filter,
                          cjk_bigram_filter, make_stop_filter()])
+    if name == "smartcn":
+        # reference plugins/analysis-smartcn: dictionary segmentation
+        # (jieba-backed here — its dictionary ships in the wheel)
+        from .cjk_morph import smartcn_tokenizer
+        return Analyzer(name, smartcn_tokenizer, [lowercase_filter])
+    if name == "kuromoji":
+        # reference plugins/analysis-kuromoji: script-run segmentation +
+        # kanji-compound bigrams (dictionary-free approximation; see
+        # cjk_morph module docstring for the documented contract)
+        from .cjk_morph import (kanji_compound_bigram_filter,
+                                kuromoji_lite_tokenizer)
+        from .unicode_plugins import cjk_width_filter
+        return Analyzer(name, kuromoji_lite_tokenizer,
+                        [cjk_width_filter, lowercase_filter,
+                         kanji_compound_bigram_filter])
+    if name == "nori":
+        # reference plugins/analysis-nori: word segmentation + josa strip
+        from .cjk_morph import nori_lite_tokenizer
+        return Analyzer(name, nori_lite_tokenizer, [lowercase_filter])
     if name == "icu_analyzer":
         # reference plugins/analysis-icu IcuAnalyzerProvider:
         # nfkc_cf normalization + folding over the standard tokenizer

@@ -463,6 +463,10 @@ class _Lambda:
             return _exec_block(self.body, self.env)
         except _Return as r:
             return r.value
+        except (_Break, _Continue):
+            # real Painless rejects break/continue inside a lambda at
+            # compile time; it must never unwind into the CALLER's loop
+            raise ScriptError("break/continue not allowed in a lambda")
         finally:
             for p, old in saved.items():
                 if old is _MISSING:
@@ -539,10 +543,12 @@ def execute(ast_or_src, variables: Dict[str, Any]) -> Any:
         return _exec_block(ast, env)
     except _Return as r:
         return r.value
+    except (_Break, _Continue):
+        raise ScriptError("break/continue outside of a loop")
     except ScriptError:
         raise
     except (ZeroDivisionError, IndexError, TypeError, KeyError, ValueError,
-            OverflowError, AttributeError) as e:
+            OverflowError, AttributeError, RecursionError) as e:
         # runtime faults keep the ScriptError contract (callers map it to 400)
         raise ScriptError(f"runtime error: {type(e).__name__}: {e}")
 
@@ -863,8 +869,9 @@ def _str_method(s: str, name: str, args: list):  # noqa: C901
     if name == "split":
         return re.split(args[0], s)
     if name == "splitOnToken":
-        return s.split(args[0], int(args[1])) if len(args) == 2 \
-            else s.split(args[0])
+        # Java limit = max number of RESULT pieces (Python maxsplit + 1)
+        return s.split(args[0], int(args[1]) - 1) if len(args) == 2 \
+            and int(args[1]) > 0 else s.split(args[0])
     if name == "indexOf":
         return s.find(args[0])
     if name == "equals":
@@ -878,6 +885,13 @@ def _str_method(s: str, name: str, args: list):  # noqa: C901
     if name == "toString":
         return s
     raise ScriptError(f"unknown String method [{name}]")
+
+
+def _cmp_key(fn):
+    """Painless comparator -> sort key. int() truncation matches Java's
+    def-to-int cast of the comparator return."""
+    import functools
+    return functools.cmp_to_key(lambda a, b: int(fn(a, b)))
 
 
 def _list_method(lst: list, name: str, args: list):  # noqa: C901
@@ -913,9 +927,7 @@ def _list_method(lst: list, name: str, args: list):  # noqa: C901
         return None
     if name == "sort":
         if args and callable(args[0]):
-            import functools
-            lst.sort(key=functools.cmp_to_key(
-                lambda a, b: int(args[0](a, b))))
+            lst.sort(key=_cmp_key(args[0]))
         else:
             lst.sort()
         return None
@@ -951,17 +963,21 @@ class _Stream:
             return _Stream(out)
         if name == "sorted":
             if args and callable(args[0]):
-                import functools
-                return _Stream(sorted(self.items, key=functools.cmp_to_key(
-                    lambda a, b: int(args[0](a, b)))))
+                return _Stream(sorted(self.items, key=_cmp_key(args[0])))
             return _Stream(sorted(self.items))
         if name == "distinct":
+            # equals()-based like Java (lists/maps compare by value):
+            # O(n^2) contains scan for unhashables, set for primitives
             seen, out = set(), []
             for x in self.items:
-                k = (type(x).__name__, x) if isinstance(x, (int, float, str, bool)) else id(x)
-                if k not in seen:
+                if isinstance(x, (int, float, str, bool, type(None))):
+                    k = (type(x).__name__, x)
+                    if k in seen:
+                        continue
                     seen.add(k)
-                    out.append(x)
+                elif x in out:
+                    continue
+                out.append(x)
             return _Stream(out)
         if name == "limit":
             return _Stream(self.items[: int(args[0])])

@@ -1,3 +1,4 @@
+import pytest
 from opensearch_tpu.analysis import AnalysisRegistry
 from opensearch_tpu.analysis.filters import (ENGLISH_STOPWORDS, make_shingle_filter,
                                              make_synonym_filter)
@@ -80,3 +81,59 @@ def test_html_strip_char_filter():
         "analyzer": {"h": {"type": "custom", "tokenizer": "standard",
                            "char_filter": ["html_strip"], "filter": ["lowercase"]}}})
     assert reg.get("h").terms("<b>Bold</b> move") == ["bold", "move"]
+
+
+class TestCjkMorphological:
+    """r5: smartcn (jieba dictionary segmentation), kuromoji-lite
+    (script-run + kanji-compound bigrams), nori-lite (josa stripping) —
+    reference plugins/analysis-{smartcn,kuromoji,nori}."""
+
+    def test_smartcn_dictionary_segmentation(self):
+        from opensearch_tpu.analysis.analyzers import AnalysisRegistry
+        toks = AnalysisRegistry().get("smartcn").analyze("我来到北京清华大学")
+        texts = [t.text for t in toks]
+        # search-mode granularity: entity words AND their components
+        for w in ("我", "来到", "北京", "清华大学", "大学"):
+            assert w in texts, texts
+
+    def test_kuromoji_script_runs_and_compound_bigrams(self):
+        from opensearch_tpu.analysis.analyzers import AnalysisRegistry
+        toks = AnalysisRegistry().get("kuromoji").analyze(
+            "東京スカイツリーの観光案内です")
+        texts = [t.text for t in toks]
+        assert "東京" in texts            # kanji run
+        assert "スカイツリー" in texts    # katakana run incl. ー
+        assert "観光" in texts and "案内" in texts  # compound bigrams
+        assert "です" in texts            # hiragana run kept
+
+    def test_nori_josa_stripping(self):
+        from opensearch_tpu.analysis.analyzers import AnalysisRegistry
+        toks = AnalysisRegistry().get("nori").analyze(
+            "한국어를 배우고 있습니다")
+        assert [t.text for t in toks] == ["한국어", "배우", "있"]
+
+    @pytest.mark.parametrize("analyzer,doc,query", [
+        ("smartcn", "我来到北京清华大学", "北京"),
+        ("smartcn", "我来到北京清华大学", "清华大学"),
+        ("kuromoji", "東京スカイツリーの観光案内です", "スカイツリー"),
+        ("kuromoji", "東京スカイツリーの観光案内です", "観光"),
+        ("nori", "한국어를 열심히 배우고 있습니다", "한국어"),
+    ])
+    def test_end_to_end_search(self, analyzer, doc, query):
+        from opensearch_tpu.rest.client import RestClient
+        c = RestClient()
+        c.indices.create("cjk", {"mappings": {"properties": {
+            "t": {"type": "text", "analyzer": analyzer}}}})
+        c.index("cjk", {"t": doc}, id="1", refresh=True)
+        c.index("cjk", {"t": "unrelated english text"}, id="2", refresh=True)
+        r = c.search(index="cjk", body={"query": {"match": {"t": query}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"], \
+            (analyzer, query, r["hits"])
+
+    def test_kuromoji_halfwidth_katakana_not_split(self):
+        # U+FF9E voiced marks must continue a halfwidth-katakana word
+        from opensearch_tpu.analysis.analyzers import AnalysisRegistry
+        reg = AnalysisRegistry()
+        half = [t.text for t in reg.get("kuromoji").analyze("ﾊﾞｲｵﾘﾝ")]
+        full = [t.text for t in reg.get("kuromoji").analyze("バイオリン")]
+        assert half == full == ["バイオリン"]

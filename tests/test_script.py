@@ -327,3 +327,28 @@ class TestReferencePainlessShapes:
         src = ("def x = 7; def l = [1,2]; def s = l.stream()"
                ".map(v -> v + x).sum(); return s * 100 + x;")
         assert painless_lite.execute(src, {}) == 1707
+
+    def test_break_outside_loop_is_script_error(self):
+        with pytest.raises(painless_lite.ScriptError):
+            painless_lite.execute(
+                "def x = 1; if (x > 0) { break } return x;", {})
+
+    def test_break_in_lambda_is_script_error(self):
+        with pytest.raises(painless_lite.ScriptError):
+            painless_lite.execute(
+                "def f = x -> { break }; for (x in [1,2]) { f(x) }", {})
+
+    def test_runaway_lambda_recursion_is_script_error(self):
+        with pytest.raises(painless_lite.ScriptError):
+            painless_lite.execute("def f = x -> f(x + 1); return f(0);", {})
+
+    def test_split_on_token_java_limit_semantics(self):
+        assert painless_lite.execute(
+            "return 'a,b,c'.splitOnToken(',', 2).length;", {}) == 2
+        assert painless_lite.execute(
+            "def p = 'a,b,c'.splitOnToken(',', 2); return p[1];",
+            {}) == "b,c"
+
+    def test_stream_distinct_equals_semantics(self):
+        assert painless_lite.execute(
+            "return [[1,2],[1,2]].stream().distinct().count();", {}) == 1
