@@ -14,12 +14,14 @@ from __future__ import annotations
 import datetime as _dt
 import ipaddress
 import numbers
+import re
 from dataclasses import dataclass, field as dc_field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..analysis import AnalysisRegistry, Analyzer
 
-TEXT_TYPES = {"text", "match_only_text", "search_as_you_type"}
+TEXT_TYPES = {"text", "match_only_text", "search_as_you_type",
+              "annotated_text"}
 KEYWORD_TYPES = {"keyword", "ip", "constant_keyword", "flat_object"}
 INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean",
              "unsigned_long", "token_count"}
@@ -97,6 +99,33 @@ class FieldType:
     def has_norms(self) -> bool:
         return self.type in TEXT_TYPES and self.norms and \
             self.type != "match_only_text"
+
+
+_ANNOT_RE = re.compile(r"\[([^\]]*)\]\(([^)]+)\)")
+
+
+def parse_annotated_text(raw: str):
+    """-> (plain_text, [(char_start, char_end, [annotation values])]).
+
+    Markup follows the reference plugin (mapper-annotated-text): the covered
+    text appears in the plain stream; `&`-separated, URL-encoded annotation
+    values attach to its character span."""
+    import urllib.parse as _up
+    plain_parts = []
+    spans = []
+    pos = 0
+    last = 0
+    for m in _ANNOT_RE.finditer(raw):
+        plain_parts.append(raw[last:m.start()])
+        pos += m.start() - last
+        text = m.group(1)
+        anns = [_up.unquote(a) for a in m.group(2).split("&") if a]
+        spans.append((pos, pos + len(text), anns))
+        plain_parts.append(text)
+        pos += len(text)
+        last = m.end()
+    plain_parts.append(raw[last:])
+    return "".join(plain_parts), spans
 
 
 def _parse_date(value: Any, fmt: Optional[str]) -> int:
@@ -238,6 +267,9 @@ class Mappings:
         # persisting _source in segments (store=true fields remain fetchable
         # via stored_fields; update/reindex lose their input, as upstream)
         self.source_enabled = True
+        # plugins/mapper-size SizeFieldMapper: `"_size": {"enabled": true}`
+        # indexes the byte length of _source as numeric doc values
+        self.size_enabled = False
         if mapping:
             self.merge(mapping)
 
@@ -250,6 +282,11 @@ class Mappings:
             self._meta.update(mapping["_meta"])
         if "_source" in mapping:
             self.source_enabled = bool(mapping["_source"].get("enabled", True))
+        if "_size" in mapping:
+            self.size_enabled = bool(mapping["_size"].get("enabled", False))
+            if self.size_enabled and "_size" not in self.fields:
+                self.fields["_size"] = FieldType(name="_size", type="long",
+                                                 index=False)
         self.dynamic_templates.extend(mapping.get("dynamic_templates", []))
         self._merge_props(mapping.get("properties", {}), prefix="")
         if "derived" in mapping:
@@ -474,6 +511,10 @@ class Mappings:
             if ft.type == "constant_keyword" and ft.const_value is not None:
                 parsed.terms.setdefault(ft.name, []).append(ft.const_value)
                 parsed.keywords.setdefault(ft.name, []).append(ft.const_value)
+        if self.size_enabled:
+            import json as _json
+            parsed.numerics["_size"] = [len(_json.dumps(
+                source, separators=(",", ":"), default=str).encode("utf-8"))]
         return parsed
 
     def _parse_obj(self, obj: dict, prefix: str, parsed: ParsedDocument) -> None:
@@ -618,9 +659,30 @@ class Mappings:
             parsed.terms.setdefault(name, []).append(rel)
             parsed.keywords.setdefault(name, []).append(rel)
             return
+        if ft.type == "murmur3":
+            # plugins/mapper-murmur3 Murmur3FieldMapper: the value itself is
+            # not indexed — its murmur3 hash lands in numeric doc values
+            # (cardinality-agg fodder). The reference stores the first 64
+            # bits of the x64_128 hash; this build uses the same x86_32
+            # function the routing layer uses (documented divergence: both
+            # are stable murmur3 variants, neither is queryable by value).
+            from ..cluster.routing import murmur3_x86_32
+            h = murmur3_x86_32(str(v).encode("utf-8"))
+            parsed.numerics.setdefault(name, []).append(
+                h - 0x100000000 if h >= 0x80000000 else h)
+            return
         if ft.type in TEXT_TYPES:
             if ft.index:
-                tokens = self.index_analyzer(ft).analyze(str(v))
+                raw_text = str(v)
+                annot_spans: list = []
+                if ft.type == "annotated_text":
+                    # plugins/mapper-annotated-text AnnotatedTextFieldMapper:
+                    # inline [text](value1&value2) markup; the plain text is
+                    # analyzed normally and each annotation value is injected
+                    # as an un-analyzed term at the position of the first
+                    # token it covers (phrase positions stay consistent)
+                    raw_text, annot_spans = parse_annotated_text(raw_text)
+                tokens = self.index_analyzer(ft).analyze(raw_text)
                 tl = parsed.terms.setdefault(name, [])
                 if ft.type == "match_only_text":
                     # no freqs, no norms, no positions (reference
@@ -644,6 +706,18 @@ class Mappings:
                     if ol is not None:
                         ol.append((t.text, base + t.position,
                                    t.start_offset, t.end_offset))
+                for (cs, ce, anns) in annot_spans:
+                    # inject each annotation value as an exact term at the
+                    # position (and offsets) of the first covered token
+                    tok = next((t for t in tokens
+                                if cs <= t.start_offset < ce), None)
+                    at_pos = base + (tok.position if tok else 0)
+                    for a in anns:
+                        tl.append(a)
+                        pl.append((a, at_pos))
+                        if ol is not None and tok is not None:
+                            ol.append((a, at_pos, tok.start_offset,
+                                       tok.end_offset))
             return
         if ft.type == "binary":
             # base64 payload: stored/_source only, never indexed (reference

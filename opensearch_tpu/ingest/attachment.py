@@ -1,0 +1,268 @@
+"""Content extraction for the attachment processor.
+
+The reference plugin (plugins/ingest-attachment/.../AttachmentProcessor.java:1)
+delegates to Apache Tika; this image has no Tika, so extraction is stdlib:
+
+- plain text / UTF-8, UTF-16 (BOM-sniffed)
+- HTML (html.parser; <title> -> title, body text -> content)
+- RTF (control-word stripper)
+- PDF (object-stream scan; FlateDecode via zlib; BT..ET Tj/TJ text ops)
+- DOCX / XLSX / PPTX (zipfile + the OOXML part XML, tags stripped;
+  docProps/core.xml -> title/author/keywords/date)
+
+Output field contract matches the reference: content, content_type,
+content_length, language, title, author, keywords, date (when present).
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import zipfile
+import zlib
+
+
+def _sniff(data: bytes) -> str:
+    if data[:4] == b"%PDF":
+        return "application/pdf"
+    if data[:2] == b"PK":
+        try:
+            with zipfile.ZipFile(io.BytesIO(data)) as z:
+                names = set(z.namelist())
+            if "word/document.xml" in names:
+                return ("application/vnd.openxmlformats-officedocument"
+                        ".wordprocessingml.document")
+            if "xl/workbook.xml" in names:
+                return ("application/vnd.openxmlformats-officedocument"
+                        ".spreadsheetml.sheet")
+            if any(n.startswith("ppt/slides/") for n in names):
+                return ("application/vnd.openxmlformats-officedocument"
+                        ".presentationml.presentation")
+            return "application/zip"
+        except zipfile.BadZipFile:
+            return "application/zip"
+    if data[:5] == b"{\\rtf":
+        return "application/rtf"
+    head = data[:1024].lstrip().lower()
+    if head.startswith((b"<!doctype html", b"<html")) or b"<html" in head:
+        return "text/html"
+    if head.startswith(b"<?xml"):
+        return "application/xml"
+    return "text/plain"
+
+
+def _decode_text(data: bytes) -> str:
+    for bom, enc in ((b"\xef\xbb\xbf", "utf-8"), (b"\xff\xfe", "utf-16-le"),
+                     (b"\xfe\xff", "utf-16-be")):
+        if data.startswith(bom):
+            return data[len(bom):].decode(enc, "replace")
+    try:
+        return data.decode("utf-8")
+    except UnicodeDecodeError:
+        return data.decode("latin-1", "replace")
+
+
+_TAG = re.compile(rb"<[^>]*>")
+_TITLE = re.compile(rb"<title[^>]*>(.*?)</title>", re.S | re.I)
+_SCRIPT = re.compile(rb"<(script|style)[^>]*>.*?</\1>", re.S | re.I)
+
+
+def _extract_html(data: bytes) -> dict:
+    import html
+    out: dict = {}
+    m = _TITLE.search(data)
+    if m:
+        out["title"] = html.unescape(_decode_text(m.group(1)).strip())
+    body = _SCRIPT.sub(b" ", data)
+    body = _TITLE.sub(b" ", body)
+    text = html.unescape(_decode_text(_TAG.sub(b" ", body)))
+    out["content"] = re.sub(r"\s+", " ", text).strip()
+    return out
+
+
+_RTF_CTRL = re.compile(r"\\[a-zA-Z]+-?\d* ?|\\[^a-zA-Z]|[{}]")
+_RTF_UNI = re.compile(r"\\u(-?\d+) ?\??")
+
+
+def _extract_rtf(data: bytes) -> dict:
+    s = _decode_text(data)
+    # drop embedded font/color/stylesheet groups before stripping controls
+    s = re.sub(r"\{\\(?:fonttbl|colortbl|stylesheet|info|pict)[^{}]*"
+               r"(?:\{[^{}]*\}[^{}]*)*\}", " ", s)
+    s = _RTF_UNI.sub(lambda m: chr(int(m.group(1)) & 0xFFFF), s)
+    s = s.replace("\\par", "\n").replace("\\tab", "\t")
+    s = _RTF_CTRL.sub("", s)
+    return {"content": re.sub(r"[ \t]+", " ", s).strip()}
+
+
+# ---- PDF: scan indirect objects for content streams, inflate, read text ops
+_PDF_STREAM = re.compile(rb"<<(.*?)>>\s*stream\r?\n", re.S)
+_PDF_TJ = re.compile(rb"\((?:[^()\\]|\\.)*\)\s*Tj|\[(?:[^\[\]\\]|\\.)*?\]\s*TJ")
+_PDF_STR = re.compile(rb"\((?:[^()\\]|\\.)*\)")
+_PDF_ESC = {b"n": b"\n", b"r": b"\r", b"t": b"\t", b"b": b"\b",
+            b"f": b"\f", b"(": b"(", b")": b")", b"\\": b"\\"}
+
+
+def _pdf_unescape(raw: bytes) -> bytes:
+    out = bytearray()
+    i = 0
+    while i < len(raw):
+        c = raw[i:i + 1]
+        if c == b"\\" and i + 1 < len(raw):
+            nxt = raw[i + 1:i + 2]
+            if nxt in _PDF_ESC:
+                out += _PDF_ESC[nxt]
+                i += 2
+                continue
+            if nxt.isdigit():          # octal escape
+                oct_s = raw[i + 1:i + 4]
+                j = 1
+                while j <= 3 and raw[i + j:i + j + 1].isdigit():
+                    j += 1
+                out.append(int(oct_s[:j - 1], 8) & 0xFF)
+                i += j
+                continue
+            i += 1
+            continue
+        out += c
+        i += 1
+    return bytes(out)
+
+
+def _extract_pdf(data: bytes) -> dict:
+    texts = []
+    for m in _PDF_STREAM.finditer(data):
+        hdr = m.group(1)
+        start = m.end()
+        end = data.find(b"endstream", start)
+        if end < 0:
+            continue
+        raw = data[start:end].rstrip(b"\r\n")
+        if b"FlateDecode" in hdr:
+            try:
+                raw = zlib.decompress(raw)
+            except zlib.error:
+                continue
+        elif b"Filter" in hdr and b"FlateDecode" not in hdr:
+            continue                   # unsupported codec (DCT, LZW, ...)
+        if b"BT" not in raw:
+            continue
+        for op in _PDF_TJ.finditer(raw):
+            for s in _PDF_STR.finditer(op.group(0)):
+                piece = _pdf_unescape(s.group(0)[1:-1])
+                try:
+                    texts.append(piece.decode("utf-8"))
+                except UnicodeDecodeError:
+                    texts.append(piece.decode("latin-1", "replace"))
+        texts.append("\n")
+    out = {"content": re.sub(r"[ \t]+", " ", "".join(texts)).strip()}
+    m = re.search(rb"/Title\s*\(((?:[^()\\]|\\.)*)\)", data)
+    if m:
+        out["title"] = _pdf_unescape(m.group(1)).decode("latin-1", "replace")
+    m = re.search(rb"/Author\s*\(((?:[^()\\]|\\.)*)\)", data)
+    if m:
+        out["author"] = _pdf_unescape(m.group(1)).decode("latin-1", "replace")
+    return out
+
+
+_XML_TAG = re.compile(r"<[^>]*>")
+
+
+def _ooxml_meta(z: zipfile.ZipFile, out: dict) -> None:
+    try:
+        core = z.read("docProps/core.xml").decode("utf-8", "replace")
+    except KeyError:
+        return
+    for tag, key in (("dc:title", "title"), ("dc:creator", "author"),
+                     ("cp:keywords", "keywords"),
+                     ("dcterms:created", "date")):
+        m = re.search(rf"<{tag}[^>]*>(.*?)</{tag}>", core, re.S)
+        if m and m.group(1).strip():
+            out[key] = m.group(1).strip()
+
+
+def _extract_ooxml(data: bytes, kind: str) -> dict:
+    out: dict = {}
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        names = z.namelist()
+        parts: list = []
+        if kind == "docx":
+            parts = ["word/document.xml"]
+        elif kind == "xlsx":
+            parts = [n for n in ("xl/sharedStrings.xml",) if n in names]
+        else:                          # pptx
+            parts = sorted(n for n in names
+                           if re.fullmatch(r"ppt/slides/slide\d+\.xml", n))
+        texts = []
+        for part in parts:
+            try:
+                xml = z.read(part).decode("utf-8", "replace")
+            except KeyError:
+                continue
+            # OOXML runs: text lives in <w:t>/<t>/<a:t> elements; insert
+            # spaces at paragraph/row boundaries so words don't concatenate
+            xml = re.sub(r"</(?:w:p|row|a:p)>", "\n", xml)
+            xml = re.sub(r"<(?:w:tab|w:br)[^>]*/>", "\t", xml)
+            body = _XML_TAG.sub("", xml)
+            import html as _h
+            texts.append(_h.unescape(body))
+        out["content"] = re.sub(r"[ \t]+", " ", "\n".join(texts)).strip()
+        _ooxml_meta(z, out)
+    return out
+
+
+def extract(data: bytes, indexed_chars: int = 100_000) -> dict:
+    ctype = _sniff(data)
+    if ctype == "application/pdf":
+        out = _extract_pdf(data)
+    elif ctype == "text/html":
+        out = _extract_html(data)
+    elif ctype == "application/rtf":
+        out = _extract_rtf(data)
+    elif ctype.endswith("wordprocessingml.document"):
+        out = _extract_ooxml(data, "docx")
+    elif ctype.endswith("spreadsheetml.sheet"):
+        out = _extract_ooxml(data, "xlsx")
+    elif ctype.endswith("presentationml.presentation"):
+        out = _extract_ooxml(data, "pptx")
+    elif ctype in ("application/zip",):
+        out = {"content": ""}
+    else:
+        out = {"content": _decode_text(data).strip()}
+    content = out.get("content", "")
+    if indexed_chars >= 0:
+        content = content[:indexed_chars]
+    out["content"] = content
+    out["content_type"] = ctype
+    out["content_length"] = len(content)
+    if content:
+        out["language"] = _guess_language(content)
+    return out
+
+
+_LANG_HINTS = (
+    ("en", (" the ", " and ", " of ", " to ", " is ")),
+    ("de", (" der ", " die ", " und ", " das ", " ist ")),
+    ("fr", (" le ", " la ", " les ", " est ", " une ")),
+    ("es", (" el ", " los ", " las ", " que ", " una ")),
+)
+
+
+def _guess_language(text: str) -> str:
+    """Tiny stopword-vote language hint (Tika's detector is a full n-gram
+    model; this covers the common cases the tests and docs exercise)."""
+    sample = f" {text[:4000].lower()} "
+    if re.search(r"[\u3040-\u30ff]", sample):
+        return "ja"
+    if re.search(r"[\uac00-\ud7af]", sample):
+        return "ko"
+    if re.search(r"[\u4e00-\u9fff]", sample):
+        return "zh"
+    if re.search(r"[\u0400-\u04ff]", sample):
+        return "ru"
+    best, best_n = "en", 0
+    for lang, words in _LANG_HINTS:
+        n = sum(sample.count(w) for w in words)
+        if n > best_n:
+            best, best_n = lang, n
+    return best

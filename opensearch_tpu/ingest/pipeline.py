@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import re
+import threading as _threading
 from typing import Any, Callable, Dict, List, Optional
 
 
@@ -46,13 +47,19 @@ class DropDocument(Exception):
     """Raised by the drop processor: the doc is silently discarded."""
 
 
+# per-thread set of pipeline names currently executing (cycle guard for
+# the nested `pipeline` processor)
+_ACTIVE_PIPELINES = _threading.local()
+
+
 def _render(template: str, doc: dict) -> str:
     """Tiny mustache: {{field}} substitution (reference lang-mustache)."""
     return re.sub(r"\{\{\s*([\w.]+)\s*\}\}",
                   lambda m: str(_get_path(doc, m.group(1), "")), template)
 
 
-def build_processor(kind: str, cfg: dict) -> Callable[[dict], None]:  # noqa: C901
+def build_processor(kind: str, cfg: dict,
+                    service=None) -> Callable[[dict], None]:  # noqa: C901
     if kind == "set":
         field, value = cfg["field"], cfg.get("value")
         override = cfg.get("override", True)
@@ -234,7 +241,36 @@ def build_processor(kind: str, cfg: dict) -> Callable[[dict], None]:  # noqa: C9
         return p_script
 
     if kind == "pipeline":
-        raise IngestProcessorException("nested pipeline processor requires service context")
+        if service is None:
+            raise IngestProcessorException(
+                "nested pipeline processor requires service context")
+        name = cfg["name"]
+
+        def p_pipeline(doc):
+            inner = service.get_pipeline(name)
+            if inner is None:
+                raise IngestProcessorException(
+                    f"non-existent pipeline [{name}]")
+            # cycle guard (reference: "Cycle detected for pipeline: ...")
+            active = _ACTIVE_PIPELINES.__dict__.setdefault("names", set())
+            if name in active:
+                raise IngestProcessorException(
+                    f"Cycle detected for pipeline: {name}")
+            active.add(name)
+            try:
+                if inner.run(doc) is None:
+                    raise DropDocument()
+            finally:
+                active.discard(name)
+        return p_pipeline
+
+    from .ext import EXTRA_PROCESSORS, EXTRA_PROCESSORS_WITH_SERVICE
+    factory = EXTRA_PROCESSORS_WITH_SERVICE.get(kind)
+    if factory is not None:
+        return factory(cfg, service)
+    factory = EXTRA_PROCESSORS.get(kind)
+    if factory is not None:
+        return factory(cfg)
 
     raise IngestProcessorException(f"unknown processor type [{kind}]")
 
@@ -263,16 +299,17 @@ def _grok_compile(pattern: str) -> re.Pattern:
 
 
 class Pipeline:
-    def __init__(self, pid: str, config: dict):
+    def __init__(self, pid: str, config: dict, service=None):
         self.id = pid
         self.description = config.get("description", "")
         self.processors: List[tuple] = []
         for pspec in config.get("processors", []):
             ((kind, cfg),) = pspec.items()
-            self.processors.append((kind, cfg, build_processor(kind, cfg),
-                                    cfg.get("ignore_failure", False),
-                                    [build_processor(*next(iter(f.items())))
-                                     for f in cfg.get("on_failure", [])]))
+            self.processors.append(
+                (kind, cfg, build_processor(kind, cfg, service),
+                 cfg.get("ignore_failure", False),
+                 [build_processor(*next(iter(f.items())), service)
+                  for f in cfg.get("on_failure", [])]))
 
     def run(self, doc: dict) -> Optional[dict]:
         """Returns the transformed doc, or None when dropped."""
@@ -295,7 +332,7 @@ class IngestService:
         self.pipelines: Dict[str, Pipeline] = {}
 
     def put_pipeline(self, pid: str, config: dict) -> None:
-        self.pipelines[pid] = Pipeline(pid, config)
+        self.pipelines[pid] = Pipeline(pid, config, service=self)
 
     def delete_pipeline(self, pid: str) -> None:
         self.pipelines.pop(pid, None)
@@ -310,7 +347,7 @@ class IngestService:
         return p.run(doc)
 
     def simulate(self, config: dict, docs: List[dict]) -> List[dict]:
-        p = Pipeline("_simulate", config)
+        p = Pipeline("_simulate", config, service=self)
         out = []
         for d in docs:
             src = dict(d.get("_source", d))

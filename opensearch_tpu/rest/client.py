@@ -128,6 +128,13 @@ class RestClient:
                 body = None
             if body is None:
                 return {"_index": index, "_id": id or "", "result": "noop"}
+            # date_index_name (and any processor that rewrites _index)
+            # redirects the doc — resolve the new target before routing
+            new_index = body.pop("_index", None)
+            if new_index and new_index != index:
+                index = new_index
+                svc = self._svc_for_write(index)
+                self._check_write_block(svc)
         doc_id = id if id is not None else uuid.uuid4().hex[:20]
         t0 = time.monotonic()
         try:
@@ -1107,7 +1114,7 @@ class RestClient:
 
         out_fields = {}
         for fname, ft in list(svc.mappings.fields.items()):
-            if ft.type not in ("text", "keyword") or \
+            if ft.type not in ("text", "keyword", "annotated_text") or \
                     (fields and fname not in fields):
                 continue
             vals = _get_source_path(src, fname)
@@ -1119,7 +1126,25 @@ class RestClient:
                     t = terms.setdefault(str(v), {"term_freq": 0})
                     t["term_freq"] += 1
                     continue
-                for tok in svc.mappings.index_analyzer(ft).analyze(str(v)):
+                raw_v = str(v)
+                annot_spans: list = []
+                if ft.type == "annotated_text":
+                    from ..index.mappings import parse_annotated_text
+                    raw_v, annot_spans = parse_annotated_text(raw_v)
+                toks = list(svc.mappings.index_analyzer(ft).analyze(raw_v))
+                for (cs, ce, anns) in annot_spans:
+                    # annotation values occupy the first covered token's
+                    # position/offsets, mirroring the index-time injection
+                    tok0 = next((t for t in toks
+                                 if cs <= t.start_offset < ce), None)
+                    if tok0 is None:
+                        continue
+                    for a in anns:
+                        toks.append(type(tok0)(
+                            text=a, position=tok0.position,
+                            start_offset=tok0.start_offset,
+                            end_offset=tok0.end_offset))
+                for tok in toks:
                     t = terms.setdefault(tok.text,
                                          {"term_freq": 0, "tokens": []})
                     t["term_freq"] += 1

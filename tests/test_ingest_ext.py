@@ -1,0 +1,450 @@
+"""Long-tail ingest processors (reference ingest-common remainder +
+ingest-user-agent + ingest-geoip + ingest-attachment) and the mapper
+plugins (mapper-murmur3, mapper-size, mapper-annotated-text)."""
+
+import base64
+import io
+import zipfile
+import zlib
+
+import pytest
+
+from opensearch_tpu.ingest.pipeline import (IngestProcessorException,
+                                            IngestService)
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+def run_one(proc_def, doc):
+    svc = IngestService()
+    svc.put_pipeline("p", {"processors": [proc_def]})
+    return svc.run("p", doc)
+
+
+# ------------------------------------------------------------- structure
+
+def test_json_processor():
+    d = run_one({"json": {"field": "raw", "target_field": "parsed"}},
+                {"raw": '{"a": 1, "b": [2, 3]}'})
+    assert d["parsed"] == {"a": 1, "b": [2, 3]}
+    d = run_one({"json": {"field": "raw", "add_to_root": True}},
+                {"raw": '{"x": "y"}'})
+    assert d["x"] == "y"
+
+
+def test_kv_processor():
+    d = run_one({"kv": {"field": "msg", "field_split": " ",
+                        "value_split": "="}},
+                {"msg": "ip=1.2.3.4 error=REFUSED"})
+    assert d["ip"] == "1.2.3.4" and d["error"] == "REFUSED"
+    d = run_one({"kv": {"field": "msg", "field_split": "&",
+                        "value_split": "=", "target_field": "q",
+                        "include_keys": ["a"]}},
+                {"msg": "a=1&b=2"})
+    assert d["q"] == {"a": "1"} or d["q"]["a"] == "1"
+
+
+def test_dissect_processor():
+    d = run_one({"dissect": {
+        "field": "message",
+        "pattern": "%{clientip} %{ident} %{auth} [%{@timestamp}]"}},
+        {"message": '1.2.3.4 - admin [30/Apr/1998:22:00:52 +0000]'})
+    assert d["clientip"] == "1.2.3.4"
+    assert d["auth"] == "admin"
+    assert d["@timestamp"] == "30/Apr/1998:22:00:52 +0000"
+
+
+def test_dissect_modifiers():
+    # append with separator, skip key, right padding
+    d = run_one({"dissect": {"field": "m", "pattern": "%{+name} %{+name}",
+                             "append_separator": " "}},
+                {"m": "john smith"})
+    assert d["name"] == "john smith"
+    d = run_one({"dissect": {"field": "m", "pattern": "%{?skipme} %{keep}"}},
+                {"m": "drop kept"})
+    assert d["keep"] == "kept" and "skipme" not in d
+    with pytest.raises(IngestProcessorException):
+        run_one({"dissect": {"field": "m", "pattern": "%{a}:%{b}"}},
+                {"m": "no-colon-here"})
+
+
+def test_csv_processor():
+    d = run_one({"csv": {"field": "row",
+                         "target_fields": ["a", "b", "c"]}},
+                {"row": 'x,"y,with,commas",z'})
+    assert d["a"] == "x" and d["b"] == "y,with,commas" and d["c"] == "z"
+
+
+def test_bytes_processor():
+    d = run_one({"bytes": {"field": "sz"}}, {"sz": "2kb"})
+    assert d["sz"] == 2048
+    d = run_one({"bytes": {"field": "sz"}}, {"sz": "1.5mb"})
+    assert d["sz"] == int(1.5 * 1024 * 1024)
+    with pytest.raises(IngestProcessorException):
+        run_one({"bytes": {"field": "sz"}}, {"sz": "many"})
+
+
+def test_urldecode_uri_parts():
+    d = run_one({"urldecode": {"field": "u"}}, {"u": "a%20b%2Fc"})
+    assert d["u"] == "a b/c"
+    d = run_one({"uri_parts": {"field": "u"}},
+                {"u": "https://user:pw@example.com:8080/p/f.txt?q=1#frag"})
+    url = d["url"]
+    assert url["scheme"] == "https"
+    assert url["domain"] == "example.com"
+    assert url["port"] == 8080
+    assert url["path"] == "/p/f.txt"
+    assert url["extension"] == "txt"
+    assert url["query"] == "q=1"
+    assert url["fragment"] == "frag"
+    assert url["username"] == "user"
+
+
+def test_html_strip_sort_dot_expander():
+    d = run_one({"html_strip": {"field": "h"}},
+                {"h": "<p>Hello <b>world</b> &amp; more</p>"})
+    assert d["h"].strip() == "Hello world & more"
+    d = run_one({"sort": {"field": "v", "order": "desc"}}, {"v": [1, 3, 2]})
+    assert d["v"] == [3, 2, 1]
+    d = run_one({"dot_expander": {"field": "a.b"}}, {"a.b": 7})
+    assert d["a"]["b"] == 7
+
+
+def test_fingerprint_deterministic():
+    p = {"fingerprint": {"fields": ["user", "host"]}}
+    d1 = run_one(p, {"user": "kim", "host": "h1"})
+    d2 = run_one(p, {"host": "h1", "user": "kim"})
+    assert d1["fingerprint"] == d2["fingerprint"]
+    d3 = run_one(p, {"user": "kim", "host": "h2"})
+    assert d3["fingerprint"] != d1["fingerprint"]
+
+
+def test_foreach():
+    d = run_one({"foreach": {"field": "vals", "processor": {
+        "uppercase": {"field": "_ingest._value"}}}},
+        {"vals": ["a", "b"]})
+    assert d["vals"] == ["A", "B"]
+
+
+def test_remove_by_pattern():
+    d = run_one({"remove_by_pattern": {"field_pattern": "tmp_*"}},
+                {"tmp_a": 1, "tmp_b": 2, "keep": 3})
+    assert d == {"keep": 3}
+
+
+def test_nested_pipeline_processor():
+    svc = IngestService()
+    svc.put_pipeline("inner", {"processors": [
+        {"set": {"field": "inner_ran", "value": "yes"}}]})
+    svc.put_pipeline("outer", {"processors": [
+        {"pipeline": {"name": "inner"}},
+        {"set": {"field": "outer_ran", "value": "yes"}}]})
+    d = svc.run("outer", {})
+    assert d == {"inner_ran": "yes", "outer_ran": "yes"}
+
+
+def test_date_index_name_redirects_index():
+    client = RestClient()
+    client.ingest.put_pipeline("dt", {"processors": [
+        {"date_index_name": {"field": "ts", "index_name_prefix": "logs-",
+                             "date_rounding": "M",
+                             "index_name_format": "yyyy-MM"}}]})
+    client.index("logs-write", {"ts": "2026-07-15T10:00:00Z", "v": 1},
+                 id="1", pipeline="dt", refresh=True)
+    got = client.get("logs-2026-07", "1")
+    assert got["found"] and got["_source"]["v"] == 1
+
+
+def test_community_id_known_vector():
+    # canonical ordering: swapping src/dst yields the same flow hash
+    base = {"source": {"ip": "10.0.0.1", "port": 34855},
+            "destination": {"ip": "192.168.1.1", "port": 80},
+            "network": {"transport": "tcp"}}
+    d1 = run_one({"community_id": {}}, dict(base))
+    flipped = {"source": {"ip": "192.168.1.1", "port": 80},
+               "destination": {"ip": "10.0.0.1", "port": 34855},
+               "network": {"transport": "tcp"}}
+    d2 = run_one({"community_id": {}}, flipped)
+    cid1 = d1["network"]["community_id"]
+    assert cid1.startswith("1:")
+    assert cid1 == d2["network"]["community_id"]
+
+
+# ------------------------------------------------------------- user_agent
+
+def test_user_agent_chrome():
+    ua = ("Mozilla/5.0 (Windows NT 10.0; Win64; x64) AppleWebKit/537.36 "
+          "(KHTML, like Gecko) Chrome/120.0.6099.109 Safari/537.36")
+    d = run_one({"user_agent": {"field": "agent"}}, {"agent": ua})
+    out = d["user_agent"]
+    assert out["name"] == "Chrome"
+    assert out["version"].startswith("120")
+    assert out["os"]["name"] == "Windows"
+    assert out["os"]["version"] == "10"
+    assert out["original"] == ua
+
+
+def test_user_agent_iphone_and_bot():
+    ua = ("Mozilla/5.0 (iPhone; CPU iPhone OS 17_1 like Mac OS X) "
+          "AppleWebKit/605.1.15 (KHTML, like Gecko) Version/17.1 "
+          "Mobile/15E148 Safari/604.1")
+    d = run_one({"user_agent": {"field": "agent"}}, {"agent": ua})
+    out = d["user_agent"]
+    assert out["name"] == "Mobile Safari"
+    assert out["os"]["name"] == "iOS"
+    assert out["device"]["name"] == "iPhone"
+    d = run_one({"user_agent": {"field": "agent"}},
+                {"agent": "Googlebot/2.1 (+http://www.google.com/bot.html)"})
+    assert d["user_agent"]["device"]["name"] == "Spider"
+
+
+def test_user_agent_missing():
+    with pytest.raises(IngestProcessorException):
+        run_one({"user_agent": {"field": "agent"}}, {})
+    d = run_one({"user_agent": {"field": "agent", "ignore_missing": True}},
+                {"x": 1})
+    assert "user_agent" not in d
+
+
+# ------------------------------------------------------------------ geoip
+
+def test_geoip_builtin_ranges():
+    d = run_one({"geoip": {"field": "ip"}}, {"ip": "8.8.8.8"})
+    assert d["geoip"]["country_iso_code"] == "US"
+    assert d["geoip"]["continent_name"] == "North America"
+    assert "location" in d["geoip"]
+    d = run_one({"geoip": {"field": "ip"}}, {"ip": "203.0.113.9"})
+    assert d["geoip"]["country_iso_code"] == "JP"
+    assert d["geoip"]["city_name"] == "Tokyo"
+
+
+def test_geoip_private_and_miss_add_nothing():
+    d = run_one({"geoip": {"field": "ip"}}, {"ip": "192.168.0.1"})
+    assert "geoip" not in d
+    d = run_one({"geoip": {"field": "ip"}}, {"ip": "100.64.17.3"})
+    assert "geoip" not in d
+
+
+def test_geoip_properties_filter_and_custom_db(tmp_path):
+    d = run_one({"geoip": {"field": "ip",
+                           "properties": ["country_iso_code"]}},
+                {"ip": "1.1.1.1"})
+    assert d["geoip"] == {"country_iso_code": "AU"}
+    db = tmp_path / "geo.json"
+    db.write_text('{"77.0.0.0/8": {"country_iso_code": "XX", '
+                  '"country_name": "Testland"}}')
+    d = run_one({"geoip": {"field": "ip", "database_file": str(db)}},
+                {"ip": "77.1.2.3"})
+    assert d["geoip"]["country_iso_code"] == "XX"
+
+
+def test_geoip_bad_ip():
+    with pytest.raises(IngestProcessorException):
+        run_one({"geoip": {"field": "ip"}}, {"ip": "not-an-ip"})
+
+
+# ------------------------------------------------------------- attachment
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def test_attachment_plain_and_html():
+    d = run_one({"attachment": {"field": "data"}},
+                {"data": _b64("the quick brown fox and the dog".encode())})
+    att = d["attachment"]
+    assert att["content"] == "the quick brown fox and the dog"
+    assert att["content_type"] == "text/plain"
+    assert att["language"] == "en"
+    html = b"<html><head><title>T</title></head><body><p>Hello</p></body></html>"
+    d = run_one({"attachment": {"field": "data"}}, {"data": _b64(html)})
+    assert d["attachment"]["title"] == "T"
+    assert d["attachment"]["content"] == "Hello"
+    assert d["attachment"]["content_type"] == "text/html"
+
+
+def test_attachment_pdf_flate():
+    content = b"BT /F1 12 Tf (Hello from PDF) Tj ET"
+    comp = zlib.compress(content)
+    pdf = (b"%PDF-1.4\n1 0 obj\n<< /Length " + str(len(comp)).encode()
+           + b" /Filter /FlateDecode >>\nstream\n" + comp
+           + b"\nendstream\nendobj\ntrailer\n<< /Title (My Doc) >>\n%%EOF")
+    d = run_one({"attachment": {"field": "data"}}, {"data": _b64(pdf)})
+    att = d["attachment"]
+    assert att["content_type"] == "application/pdf"
+    assert "Hello from PDF" in att["content"]
+    assert att["title"] == "My Doc"
+
+
+def test_attachment_docx():
+    doc_xml = (b'<?xml version="1.0"?><w:document><w:body>'
+               b'<w:p><w:r><w:t>First para</w:t></w:r></w:p>'
+               b'<w:p><w:r><w:t>Second para</w:t></w:r></w:p>'
+               b'</w:body></w:document>')
+    core = (b'<?xml version="1.0"?><cp:coreProperties>'
+            b'<dc:title>DocTitle</dc:title>'
+            b'<dc:creator>An Author</dc:creator></cp:coreProperties>')
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("word/document.xml", doc_xml)
+        z.writestr("docProps/core.xml", core)
+        z.writestr("[Content_Types].xml", b"<Types/>")
+    d = run_one({"attachment": {"field": "data"}},
+                {"data": _b64(buf.getvalue())})
+    att = d["attachment"]
+    assert "First para" in att["content"] and "Second para" in att["content"]
+    assert att["title"] == "DocTitle"
+    assert att["author"] == "An Author"
+    assert att["content_type"].endswith("wordprocessingml.document")
+
+
+def test_attachment_limit_and_remove_binary():
+    d = run_one({"attachment": {"field": "data", "indexed_chars": 5,
+                                "remove_binary": True}},
+                {"data": _b64(b"abcdefghij")})
+    assert d["attachment"]["content"] == "abcde"
+    assert d["attachment"]["content_length"] == 5
+    assert "data" not in d
+
+
+def test_attachment_rtf():
+    rtf = (br"{\rtf1\ansi{\fonttbl{\f0 Arial;}}\f0 Plain rtf text\par}")
+    d = run_one({"attachment": {"field": "data"}}, {"data": _b64(rtf)})
+    assert "Plain rtf text" in d["attachment"]["content"]
+    assert d["attachment"]["content_type"] == "application/rtf"
+
+
+# ---------------------------------------------------------- mapper plugins
+
+def test_mapper_murmur3_doc_values():
+    client = RestClient()
+    client.indices.create("m3", {"mappings": {"properties": {
+        "tag": {"type": "keyword"},
+        "tag_hash": {"type": "murmur3"}}}})
+    for i, tag in enumerate(["a", "b", "a", "c", "b", "a"]):
+        client.index("m3", {"tag": tag, "tag_hash": tag}, id=str(i))
+    client.indices.refresh("m3")
+    r = client.search("m3", {"size": 0, "aggs": {
+        "distinct": {"cardinality": {"field": "tag_hash"}}}})
+    assert r["aggregations"]["distinct"]["value"] == 3
+
+
+def test_mapper_size_field():
+    client = RestClient()
+    client.indices.create("sz", {"mappings": {"_size": {"enabled": True},
+                                            "properties": {
+                                                "body": {"type": "text"}}}})
+    client.index("sz", {"body": "tiny"}, id="1")
+    client.index("sz", {"body": "x" * 500}, id="2", refresh=True)
+    r = client.search("sz", {"query": {"range": {"_size": {"gt": 100}}}})
+    ids = [h["_id"] for h in r["hits"]["hits"]]
+    assert ids == ["2"]
+    r = client.search("sz", {"size": 0, "aggs": {
+        "avg_size": {"avg": {"field": "_size"}}}})
+    assert r["aggregations"]["avg_size"]["value"] > 50
+
+
+def test_mapper_annotated_text():
+    client = RestClient()
+    client.indices.create("ann", {"mappings": {"properties": {
+        "body": {"type": "annotated_text"}}}})
+    client.index("ann", {"body":
+                         "visited [Paris](Q90&City) in the spring"},
+                 id="1", refresh=True)
+    # plain text tokens searchable, phrase positions intact
+    r = client.search("ann", {"query": {"match_phrase": {
+        "body": "visited paris in"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # annotation values searchable as exact terms at the covered position
+    r = client.search("ann", {"query": {"term": {"body": "Q90"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+    # annotation occupies the covered text's position: a phrase mixing
+    # plain tokens and the annotation value matches (query analyzed with
+    # whitespace so the annotation's exact casing survives)
+    r = client.search("ann", {"query": {"match_phrase": {
+        "body": {"query": "visited Q90", "analyzer": "whitespace"}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+
+def test_simulate_with_ext_processors():
+    client = RestClient()
+    out = client.ingest.simulate(
+        {"pipeline": {"processors": [
+            {"uri_parts": {"field": "u"}},
+            {"user_agent": {"field": "ua"}}]},
+         "docs": [{"_source": {
+             "u": "http://x.io/a.png",
+             "ua": "Mozilla/5.0 (X11; Linux x86_64; rv:109.0) "
+                   "Gecko/20100101 Firefox/115.0"}}]})
+    src = out["docs"][0]["doc"]["_source"]
+    assert src["url"]["extension"] == "png"
+    assert src["user_agent"]["name"] == "Firefox"
+    assert src["user_agent"]["os"]["name"] == "Linux"
+
+
+# ------------------------------------------------ review-finding regressions
+
+def test_foreach_writes_to_real_doc():
+    # sub-processor writes outside _ingest._value must land in the doc
+    d = run_one({"foreach": {"field": "vals", "processor": {
+        "set": {"field": "flag", "value": 1}}}}, {"vals": [1, 2]})
+    assert d["flag"] == 1 and d["vals"] == [1, 2]
+    assert "_ingest" not in d
+
+
+def test_bytes_bad_decimal_respects_ignore_failure():
+    svc = IngestService()
+    svc.put_pipeline("p", {"processors": [
+        {"bytes": {"field": "s", "ignore_failure": True}}]})
+    d = svc.run("p", {"s": "1.2.3kb"})
+    assert d["s"] == "1.2.3kb"          # untouched, failure swallowed
+
+
+def test_pipeline_cycle_detected():
+    svc = IngestService()
+    svc.put_pipeline("a", {"processors": [{"pipeline": {"name": "b"}}]})
+    svc.put_pipeline("b", {"processors": [{"pipeline": {"name": "a"}}]})
+    with pytest.raises(IngestProcessorException, match="[Cc]ycle"):
+        svc.run("a", {})
+
+
+def test_dot_expander_scalar_ancestor_and_append():
+    with pytest.raises(IngestProcessorException):
+        run_one({"dot_expander": {"field": "a.b"}}, {"a": 5, "a.b": 7})
+    d = run_one({"dot_expander": {"field": "a.b"}},
+                {"a": {"b": 1}, "a.b": 2})
+    assert d["a"]["b"] == [1, 2]        # existing leaf appends, as upstream
+
+
+def test_sort_mixed_types_is_processor_error():
+    with pytest.raises(IngestProcessorException):
+        run_one({"sort": {"field": "v"}}, {"v": [1, "a"]})
+
+
+def test_community_id_icmp_uses_type_code():
+    d = run_one({"community_id": {}}, {
+        "source": {"ip": "192.168.0.89"},
+        "destination": {"ip": "192.168.0.1"},
+        "icmp": {"type": 8, "code": 0},
+        "network": {"transport": "icmp"}})
+    cid = d["network"]["community_id"]
+    # echo request/reply pair hashes identically from either direction
+    d2 = run_one({"community_id": {}}, {
+        "source": {"ip": "192.168.0.1"},
+        "destination": {"ip": "192.168.0.89"},
+        "icmp": {"type": 0, "code": 0},
+        "network": {"transport": "icmp"}})
+    assert cid.startswith("1:")
+    assert cid == d2["network"]["community_id"]
+
+
+def test_annotated_text_term_vector_offsets():
+    client = RestClient()
+    client.indices.create("annv", {"mappings": {"properties": {
+        "body": {"type": "annotated_text",
+                 "term_vector": "with_positions_offsets"}}}})
+    client.index("annv", {"body": "met [Ada](Q7259) today"}, id="1",
+                 refresh=True)
+    tv = client.termvectors("annv", "1", fields=["body"])
+    terms = tv["term_vectors"]["body"]["terms"]
+    assert "Q7259" in terms             # annotation carries offsets too
+    assert "ada" in terms
