@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -52,6 +53,12 @@ class IndexService:
                                                 similarity=self.default_sim,
                                                 index_key=meta.name))
         self.generation = 0  # bumped on refresh/writes: request-cache key part
+        # per-index write serialization (the analog of the reference's
+        # per-shard engine write locks, InternalEngine.java:1): acquired by
+        # the client layer AFTER alias/pipeline resolution, so every
+        # transport (dict API, HTTP, dist) serializes mutations of this
+        # index while writes to other indices proceed in parallel
+        self.write_lock = threading.RLock()
         self.thread_pools = thread_pools
         self.search_slowlog = SlowLog(meta.name, meta.settings, "search",
                                       "query")
@@ -304,6 +311,10 @@ class Node:
                             or None)
         self.remote_stores: Dict[str, object] = {}
         self.indices: Dict[str, IndexService] = {}
+        # cluster-metadata mutations (index create/delete/open/close,
+        # template changes) serialize here — the single-master analog of
+        # the reference's cluster-state update task queue
+        self.meta_lock = threading.RLock()
         self.ingest = IngestService()
         from ..search.pipeline import SearchPipelineService
         self.search_pipelines = SearchPipelineService()
@@ -376,6 +387,11 @@ class Node:
     # ---------------- index lifecycle ----------------
 
     def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        with self.meta_lock:
+            return self._create_index_locked(name, body)
+
+    def _create_index_locked(self, name: str,
+                             body: Optional[dict] = None) -> dict:
         if name in self.indices:
             raise ResourceAlreadyExistsError(f"index [{name}] already exists")
         body = body or {}
@@ -432,12 +448,15 @@ class Node:
                         f"cannot delete the write index [{name}] of data "
                         f"stream [{ds_name}]")
         for name in names:
-            svc = self.indices.pop(name, None)
+            with self.meta_lock:
+                svc = self.indices.pop(name, None)
+                self.metadata.indices.pop(name, None)
+                for am in self.metadata.aliases.values():
+                    am.indices.pop(name, None)
             if svc:
-                svc.close()
-            self.metadata.indices.pop(name, None)
-            for am in self.metadata.aliases.values():
-                am.indices.pop(name, None)
+                # drain in-flight writers before tearing the engine down
+                with svc.write_lock:
+                    svc.close()
             if self.data_path:
                 p = os.path.join(self.data_path, name)
                 if os.path.exists(p):
@@ -468,7 +487,13 @@ class Node:
         except IndexNotFoundError:
             if not auto_create:
                 raise
-            self.create_index(name)
+            with self.meta_lock:
+                # re-check under the lock: another writer may have
+                # auto-created it while we waited
+                try:
+                    self.metadata.write_index(name)
+                except IndexNotFoundError:
+                    self._create_index_locked(name)
             concrete = name
         svc = self.indices[concrete]
         if svc.meta.state == "close":

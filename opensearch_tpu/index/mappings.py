@@ -695,7 +695,10 @@ class Mappings:
                             seen.add(t.text)
                     return
                 pl = parsed.positions.setdefault(name, [])
-                base = pl[-1][1] + 100 if pl else 0  # position gap between values
+                # position gap between values; max() not pl[-1] because
+                # annotation terms append with the position of the token
+                # they cover, which can be far below the value's extent
+                base = max(p for _, p in pl) + 100 if pl else 0
                 ol = None
                 if "offsets" in ft.term_vector:
                     ol = []

@@ -104,27 +104,29 @@ _DISSECT_KEY = re.compile(r"%\{([^}]*)\}")
 
 
 def _compile_dissect(pattern: str):
-    """-> list of (literal, key, append, skip, right_pad) segments.
+    """-> list of (literal, key, mode, skip, right_pad) segments.
 
     Supported modifiers (reference DissectParser): `+key` append with the
     pattern's append_separator, `?key`/empty skip, `key->` right-padding
-    (greedy trailing delimiter run), `*key`/`&key` reference pairs.
+    (greedy trailing delimiter run), `*key`/`&key` reference pairs (`*`
+    captures the output FIELD NAME, `&` the value; paired by key).
+    mode is one of "" (plain), "+" (append), "*" (name), "&" (value).
     """
     segs = []
     last = 0
     for m in _DISSECT_KEY.finditer(pattern):
         lit = pattern[last:m.start()]
         key = m.group(1)
-        append = key.startswith("+")
-        if append:
-            key = key[1:]
+        mode = ""
+        if key[:1] in ("+", "*", "&"):
+            mode, key = key[0], key[1:]
         skip = key.startswith("?") or key == ""
         if key.startswith("?"):
             key = key[1:]
         pad = key.endswith("->")
         if pad:
             key = key[:-2]
-        segs.append((lit, key, append, skip, pad))
+        segs.append((lit, key, mode, skip, pad))
         last = m.end()
     return segs, pattern[last:]
 
@@ -143,7 +145,9 @@ def _p_dissect(cfg: dict) -> Callable[[dict], None]:
         s = str(v)
         pos = 0
         out: dict = {}
-        for i, (lit, key, append, skip, pad) in enumerate(segs):
+        ref_names: dict = {}    # *key captures -> output field name
+        ref_vals: dict = {}     # &key captures -> output field value
+        for i, (lit, key, mode, skip, pad) in enumerate(segs):
             if lit:
                 idx = s.find(lit, pos)
                 if idx < 0:
@@ -166,13 +170,22 @@ def _p_dissect(cfg: dict) -> Callable[[dict], None]:
                     pos += len(nxt)
             if skip:
                 continue
-            if append and key in out:
+            if mode == "*":
+                ref_names[key] = val
+            elif mode == "&":
+                ref_vals[key] = val
+            elif mode == "+" and key in out:
                 out[key] = f"{out[key]}{app_sep}{val}"
             else:
                 out[key] = val
         if tail_lit and not s.startswith(tail_lit, pos):
             raise IngestProcessorException(
                 f"dissect pattern does not match [{s}]")
+        for k, fname in ref_names.items():
+            if k not in ref_vals:
+                raise IngestProcessorException(
+                    f"dissect reference key [*{k}] has no paired [&{k}]")
+            out[fname] = ref_vals[k]
         for k, val in out.items():
             _set_path(doc, k, val)
     return p
@@ -255,22 +268,22 @@ def _p_uri_parts(cfg: dict) -> Callable[[dict], None]:
             raise IngestProcessorException(f"field [{field}] not present")
         try:
             u = urllib.parse.urlsplit(str(v))
+            parts: dict = {"path": u.path}
+            if u.scheme:
+                parts["scheme"] = u.scheme
+            if u.hostname:
+                parts["domain"] = u.hostname
+            if u.port:    # deferred validation: can raise on bad ports
+                parts["port"] = u.port
+            if u.query:
+                parts["query"] = u.query
+            if u.fragment:
+                parts["fragment"] = u.fragment
+            if u.username:
+                parts["username"] = u.username
+                parts["user_info"] = f"{u.username}:{u.password or ''}"
         except ValueError as e:
             raise IngestProcessorException(f"unable to parse URI [{v}]: {e}")
-        parts: dict = {"path": u.path}
-        if u.scheme:
-            parts["scheme"] = u.scheme
-        if u.hostname:
-            parts["domain"] = u.hostname
-        if u.port:
-            parts["port"] = u.port
-        if u.query:
-            parts["query"] = u.query
-        if u.fragment:
-            parts["fragment"] = u.fragment
-        if u.username:
-            parts["username"] = u.username
-            parts["user_info"] = f"{u.username}:{u.password or ''}"
         if "." in u.path.rsplit("/", 1)[-1]:
             parts["extension"] = u.path.rsplit(".", 1)[-1]
         if keep:
@@ -493,6 +506,9 @@ _ICMP_EQUIV = {8: 0, 0: 8, 13: 14, 14: 13, 15: 16, 16: 15, 17: 18, 18: 17,
 
 def _p_community_id(cfg: dict) -> Callable[[dict], None]:
     seed = int(cfg.get("seed", 0))
+    if not 0 <= seed <= 0xFFFF:
+        raise IngestProcessorException(
+            f"community_id seed [{seed}] must be in [0, 65535]")
     target = cfg.get("target_field", "network.community_id")
 
     def p(doc):

@@ -113,15 +113,22 @@ class RestClient:
               routing: Optional[str] = None, refresh: bool = False,
               op_type: str = "index", pipeline: Optional[str] = None,
               if_seq_no: Optional[int] = None,
-              if_primary_term: Optional[int] = None) -> dict:
+              if_primary_term: Optional[int] = None,
+              _no_pipeline: bool = False) -> dict:
         if index in self.node.metadata.data_streams:
             from ..cluster import datastream as dstream
             _map_ds_errors(dstream.check_write, self.node, index, op_type,
                            body)
         svc = self._svc_for_write(index)
         self._check_write_block(svc)
-        pipeline = pipeline or svc.meta.settings.get("index", {}).get("default_pipeline")
-        if pipeline:
+        # update()'s internal rewrite (RMW under the index write lock)
+        # must land in the SAME index: no pipelines, no _index redirects
+        # (this also matches the reference, where the update's final index
+        # op does not re-run ingest pipelines on the merged source)
+        if not _no_pipeline:
+            pipeline = pipeline or svc.meta.settings.get(
+                "index", {}).get("default_pipeline")
+        if pipeline and not _no_pipeline:
             try:
                 body = self.node.ingest.run(pipeline, dict(body))
             except DropDocument:
@@ -137,22 +144,30 @@ class RestClient:
                 self._check_write_block(svc)
         doc_id = id if id is not None else uuid.uuid4().hex[:20]
         t0 = time.monotonic()
-        try:
-            res = svc.route(doc_id, routing).index_doc(
-                doc_id, body, routing, if_seq_no, if_primary_term, op_type)
-        except VersionConflictError as e:
-            raise ApiError(409, "version_conflict_engine_exception", str(e))
-        except ValueError as e:
-            # document parse failures (bad geo shapes/vectors/strict dynamic
-            # mapping) are client errors, reference mapper_parsing_exception
-            raise ApiError(400, "mapper_parsing_exception", str(e))
+        # per-index write serialization at the engine boundary, AFTER
+        # alias/data-stream/pipeline-_index resolution picked the final
+        # svc — so every transport is covered and two request names that
+        # resolve to the same engine share one lock
+        with svc.write_lock:
+            try:
+                res = svc.route(doc_id, routing).index_doc(
+                    doc_id, body, routing, if_seq_no, if_primary_term,
+                    op_type)
+            except VersionConflictError as e:
+                raise ApiError(409, "version_conflict_engine_exception",
+                               str(e))
+            except ValueError as e:
+                # document parse failures (bad geo shapes/vectors/strict
+                # dynamic mapping) are client errors, reference
+                # mapper_parsing_exception
+                raise ApiError(400, "mapper_parsing_exception", str(e))
+            svc.generation += 1
+            if refresh:
+                svc.refresh()
         took = time.monotonic() - t0
         self.node.op_counters["index_total"] += 1
         self.node.op_counters["index_time_ms"] += took * 1000.0
         svc.index_slowlog.maybe_log(took, {"_id": doc_id})
-        svc.generation += 1
-        if refresh:
-            svc.refresh()
         res["_index"] = svc.meta.name
         res["_shards"] = {"total": 1, "successful": 1, "failed": 0}
         return res
@@ -195,13 +210,16 @@ class RestClient:
             raise ApiError(400, "index_closed_exception",
                            f"closed index [{svc.meta.name}]")
         self._check_write_block(svc)
-        try:
-            res = svc.route(id, routing).delete_doc(id, if_seq_no, if_primary_term)
-        except VersionConflictError as e:
-            raise ApiError(409, "version_conflict_engine_exception", str(e))
-        svc.generation += 1
-        if refresh:
-            svc.refresh()
+        with svc.write_lock:
+            try:
+                res = svc.route(id, routing).delete_doc(id, if_seq_no,
+                                                        if_primary_term)
+            except VersionConflictError as e:
+                raise ApiError(409, "version_conflict_engine_exception",
+                               str(e))
+            svc.generation += 1
+            if refresh:
+                svc.refresh()
         res["_index"] = svc.meta.name
         if res["result"] == "not_found":
             raise ApiError(404, "document_missing_exception", f"[{id}]: not found")
@@ -212,12 +230,21 @@ class RestClient:
         """Partial-doc update / upsert (reference UpdateHelper)."""
         svc = self._svc_for_write(index)
         self._check_write_block(svc)
+        # hold the index's write lock across the WHOLE read-modify-write
+        # (reentrant: the nested self.index() re-acquires) so concurrent
+        # updates of one doc can't lose each other's changes
+        with svc.write_lock:
+            return self._update_locked(svc, index, id, body, routing,
+                                       refresh, **kw)
+
+    def _update_locked(self, svc, index: str, id: str, body: dict,
+                       routing: Optional[str], refresh: bool, **kw) -> dict:
         eng = svc.route(id, routing)
         current = eng.get(id)
         if current is None:
             if body.get("doc_as_upsert") and "doc" in body:
                 return self.index(index, body["doc"], id=id, routing=routing,
-                                  refresh=refresh)
+                                  refresh=refresh, _no_pipeline=True)
             if "upsert" in body:
                 upsert_src = dict(body["upsert"])
                 if body.get("scripted_upsert") and "script" in body:
@@ -227,14 +254,15 @@ class RestClient:
                     if op in ("none", "delete"):
                         return {"_index": svc.meta.name, "_id": id, "result": "noop"}
                 return self.index(index, upsert_src, id=id, routing=routing,
-                                  refresh=refresh)
+                                  refresh=refresh, _no_pipeline=True)
             raise ApiError(404, "document_missing_exception", f"[{id}]: document missing")
         src = dict(current["_source"])
         if "doc" in body:
             merged = _deep_merge(src, body["doc"])
             if body.get("detect_noop", True) and merged == src:
                 return {"_index": svc.meta.name, "_id": id, "result": "noop"}
-            return self.index(index, merged, id=id, routing=routing, refresh=refresh)
+            return self.index(index, merged, id=id, routing=routing,
+                              refresh=refresh, _no_pipeline=True)
         if "script" in body:
             meta = {"_index": svc.meta.name, "_id": id,
                     "_version": current.get("_version", 1),
@@ -244,7 +272,8 @@ class RestClient:
                 return {"_index": svc.meta.name, "_id": id, "result": "noop"}
             if op == "delete":
                 return self.delete(index, id, routing=routing, refresh=refresh)
-            return self.index(index, new_src, id=id, routing=routing, refresh=refresh)
+            return self.index(index, new_src, id=id, routing=routing,
+                              refresh=refresh, _no_pipeline=True)
         raise ApiError(400, "action_request_validation_exception",
                        "update requires doc, upsert or script")
 
@@ -310,9 +339,12 @@ class RestClient:
         if refresh:
             for idx in touched:
                 try:
-                    self.node.get_index(self.node.metadata.write_index(idx)).refresh()
+                    svc = self.node.get_index(
+                        self.node.metadata.write_index(idx))
                 except IndexNotFoundError:
-                    pass
+                    continue
+                with svc.write_lock:
+                    svc.refresh()
         return {"took": 0, "errors": errors, "items": items}
 
     # ---------------- search APIs ----------------
@@ -1424,7 +1456,9 @@ class IndicesClient:
 
     def refresh(self, index: str = "_all") -> dict:
         for n in self.c.node.metadata.resolve(index):
-            self.c.node.indices[n].refresh()
+            svc = self.c.node.indices[n]
+            with svc.write_lock:
+                svc.refresh()
         return {"_shards": {"successful": 1, "failed": 0}}
 
     def flush(self, index: str = "_all") -> dict:
@@ -1432,12 +1466,15 @@ class IndicesClient:
         for n in self.c.node.metadata.resolve(index):
             svc = self.c.node.indices[n]
             n_shards += len(svc.shards)
-            svc.flush()
+            with svc.write_lock:
+                svc.flush()
         return {"_shards": {"successful": n_shards, "failed": 0}}
 
     def forcemerge(self, index: str = "_all", max_num_segments: int = 1) -> dict:
         for n in self.c.node.metadata.resolve(index):
-            self.c.node.indices[n].force_merge(max_num_segments)
+            svc = self.c.node.indices[n]
+            with svc.write_lock:
+                svc.force_merge(max_num_segments)
         return {"_shards": {"successful": 1, "failed": 0}}
 
     def stats(self, index: str = "_all") -> dict:

@@ -6,9 +6,15 @@ A threaded stdlib HTTP server speaking the same JSON (and NDJSON for
 _bulk/_msearch) dialect as the dict-level `RestClient`. Concurrency
 contract: searches and reads run fully concurrently (the engine's query
 path is read-only over immutable segments and its caches are
-lock-guarded); mutating endpoints serialize on one node-wide write lock —
-the coarse version of the reference's per-shard write queues, documented
-and measured rather than implied.
+lock-guarded); writes serialize PER INDEX at the ENGINE boundary, not
+here — `IndexService.write_lock` is acquired by the client layer after
+alias/data-stream/pipeline-`_index` resolution (rest/client.py), the
+analog of the reference's per-shard engine locks
+(`index/engine/InternalEngine.java:1`), and `Node.meta_lock` serializes
+cluster-metadata mutations (create/delete/open/close, dynamic
+auto-create). So concurrent HTTP writers on different indices proceed in
+parallel, two names resolving to one engine share one lock, and this
+transport stays lock-free.
 
 Usage:
     srv = HttpServer(client)          # or HttpServer(port=9200)
@@ -113,7 +119,6 @@ class _Handler(BaseHTTPRequestHandler):
             return dist.handle_internal(method, parts,
                                         self._json_body() or {})
         c: RestClient = self.server.client            # type: ignore
-        wlock = self.server.write_lock                # type: ignore
 
         if not parts:
             return 200, {"name": c.node.node_name,
@@ -129,8 +134,7 @@ class _Handler(BaseHTTPRequestHandler):
                                              else None)
             if len(parts) >= 2 and parts[1] == "settings":
                 if method == "PUT":
-                    with wlock:
-                        return 200, c.cluster.put_settings(self._json_body())
+                    return 200, c.cluster.put_settings(self._json_body())
                 return 200, c.cluster.get_settings()
             raise ApiError(400, "illegal_argument_exception",
                            f"unsupported _cluster route {parts}")
@@ -164,10 +168,9 @@ class _Handler(BaseHTTPRequestHandler):
         if head == "_msearch":
             return 200, c.msearch(self._ndjson_body())
         if head == "_bulk":
-            with wlock:
-                return 200, c.bulk(self._ndjson_body(),
-                                   refresh=_truthy(params.get("refresh",
-                                                              "false")))
+            return 200, c.bulk(self._ndjson_body(),
+                               refresh=_truthy(params.get("refresh",
+                                                          "false")))
         if head == "_mget":
             return 200, c.mget(self._json_body())
         if head == "_tasks":
@@ -200,30 +203,25 @@ class _Handler(BaseHTTPRequestHandler):
                 if method != "POST":
                     raise ApiError(405, "method_not_allowed",
                                    "restore requires POST")
-                with wlock:
-                    return 200, c.remotestore_restore(self._json_body() or {})
+                return 200, c.remotestore_restore(self._json_body() or {})
         if head == "_index_template" and len(parts) == 2:
             if method == "PUT":
-                with wlock:
-                    return 200, c.indices.put_index_template(
-                        parts[1], self._json_body())
+                return 200, c.indices.put_index_template(
+                    parts[1], self._json_body())
             if method == "HEAD":
                 return (200 if c.indices.exists_index_template(parts[1])
                         else 404), {}
             if method == "DELETE":
-                with wlock:
-                    return 200, c.indices.delete_index_template(parts[1])
+                return 200, c.indices.delete_index_template(parts[1])
 
         # ---- index-level: /{index}[/...] ----
         index = head
         rest = parts[1:]
         if not rest:
             if method == "PUT":
-                with wlock:
-                    return 200, c.indices.create(index, self._json_body())
+                return 200, c.indices.create(index, self._json_body())
             if method == "DELETE":
-                with wlock:
-                    return 200, c.indices.delete(index)
+                return 200, c.indices.delete(index)
             if method == "HEAD":
                 return (200 if c.indices.exists(index) else 404), {}
             return 200, c.indices.get(index)
@@ -233,10 +231,9 @@ class _Handler(BaseHTTPRequestHandler):
             doc_id = rest[1] if len(rest) > 1 else None
             refresh = _truthy(params.get("refresh", "false"))
             if method in ("PUT", "POST"):
-                with wlock:
-                    resp = c.index(index, self._json_body() or {},
-                                   id=doc_id, refresh=refresh,
-                                   routing=params.get("routing"))
+                resp = c.index(index, self._json_body() or {},
+                               id=doc_id, refresh=refresh,
+                               routing=params.get("routing"))
                 # reference: 201 on create, 200 on overwrite-update
                 return (201 if resp.get("result") == "created"
                         else 200), resp
@@ -246,16 +243,13 @@ class _Handler(BaseHTTPRequestHandler):
             if method == "HEAD":
                 return (200 if c.exists(index, doc_id) else 404), {}
             if method == "DELETE":
-                with wlock:
-                    return 200, c.delete(index, doc_id,
-                                         routing=params.get("routing"))
-        if op == "_create" and len(rest) > 1:
-            with wlock:
-                return 201, c.create(index, rest[1], self._json_body() or {})
-        if op == "_update" and len(rest) > 1:
-            with wlock:
-                return 200, c.update(index, rest[1], self._json_body() or {},
+                return 200, c.delete(index, doc_id,
                                      routing=params.get("routing"))
+        if op == "_create" and len(rest) > 1:
+            return 201, c.create(index, rest[1], self._json_body() or {})
+        if op == "_update" and len(rest) > 1:
+            return 200, c.update(index, rest[1], self._json_body() or {},
+                                 routing=params.get("routing"))
         if op == "_search":
             return 200, c.search(index, self._json_body() or {},
                                  scroll=params.get("scroll"))
@@ -265,10 +259,9 @@ class _Handler(BaseHTTPRequestHandler):
         if op == "_count":
             return 200, c.count(index, self._json_body())
         if op == "_bulk":
-            with wlock:
-                return 200, c.bulk(self._ndjson_body(), index=index,
-                                   refresh=_truthy(params.get("refresh",
-                                                              "false")))
+            return 200, c.bulk(self._ndjson_body(), index=index,
+                               refresh=_truthy(params.get("refresh",
+                                                          "false")))
         if op in ("_forcemerge", "_open", "_close") \
                 and method not in ("POST", "PUT"):
             # POST-only routes (reference RestController; note the
@@ -277,32 +270,25 @@ class _Handler(BaseHTTPRequestHandler):
             raise ApiError(405, "method_not_allowed",
                            f"{op} requires POST")
         if op == "_refresh":
-            with wlock:
-                return 200, c.indices.refresh(index)
+            return 200, c.indices.refresh(index)
         if op == "_flush":
-            with wlock:
-                return 200, c.indices.flush(index)
+            return 200, c.indices.flush(index)
         if op == "_forcemerge":
-            with wlock:
-                return 200, c.indices.forcemerge(index)
+            return 200, c.indices.forcemerge(index)
         if op == "_mapping":
             if method == "PUT":
-                with wlock:
-                    return 200, c.indices.put_mapping(index,
-                                                      self._json_body())
+                return 200, c.indices.put_mapping(index,
+                                                  self._json_body())
             return 200, c.indices.get_mapping(index)
         if op == "_settings":
             if method == "PUT":
-                with wlock:
-                    return 200, c.indices.put_settings(index,
-                                                       self._json_body())
+                return 200, c.indices.put_settings(index,
+                                                   self._json_body())
             return 200, c.indices.get_settings(index)
         if op == "_open":
-            with wlock:
-                return 200, c.indices.open(index)
+            return 200, c.indices.open(index)
         if op == "_close":
-            with wlock:
-                return 200, c.indices.close(index)
+            return 200, c.indices.close(index)
         raise ApiError(400, "illegal_argument_exception",
                        f"unsupported route {method} /{'/'.join(parts)}")
 
@@ -322,7 +308,6 @@ class HttpServer:
     def start(self) -> int:
         self._srv = ThreadingHTTPServer((self.host, self.port), _Handler)
         self._srv.client = self.client                 # type: ignore
-        self._srv.write_lock = threading.RLock()       # type: ignore
         self._srv.owner = self                         # type: ignore
         self._srv.daemon_threads = True
         self.port = self._srv.server_address[1]

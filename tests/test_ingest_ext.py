@@ -448,3 +448,35 @@ def test_annotated_text_term_vector_offsets():
     terms = tv["term_vectors"]["body"]["terms"]
     assert "Q7259" in terms             # annotation carries offsets too
     assert "ada" in terms
+
+
+def test_uri_parts_bad_port_respects_ignore_failure():
+    svc = IngestService()
+    svc.put_pipeline("p", {"processors": [
+        {"uri_parts": {"field": "u", "ignore_failure": True}}]})
+    d = svc.run("p", {"u": "http://example.com:99999/a/b.txt"})
+    assert "url" not in d               # failure swallowed cleanly
+
+
+def test_dissect_reference_pairs():
+    d = run_one({"dissect": {"field": "m", "pattern": "%{*k1}=%{&k1}"}},
+                {"m": "ttl=500"})
+    assert d["ttl"] == "500" and "*k1" not in d and "&k1" not in d
+
+
+def test_annotated_text_multivalue_position_gap():
+    client = RestClient()
+    client.indices.create("annm", {"mappings": {"properties": {
+        "body": {"type": "annotated_text"}}}})
+    # value 1: annotation early, then a long tail; value 2 separate
+    v1 = "[start](S1) " + " ".join(f"w{i}" for i in range(150))
+    client.index("annm", {"body": [v1, "second value here"]}, id="1",
+                 refresh=True)
+    # a phrase spanning the value boundary must NOT match
+    r = client.search("annm", {"query": {"match_phrase": {
+        "body": "w149 second"}}})
+    assert r["hits"]["hits"] == []
+    # within-value phrases still match
+    r = client.search("annm", {"query": {"match_phrase": {
+        "body": "second value"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
