@@ -131,13 +131,14 @@ def update_index_settings(node, expression: str, body: dict,
                 raise SettingsError("number_of_replicas must be >= 0")
     for name in names:
         svc = node.indices[name]
-        idx = svc.meta.settings.setdefault("index", {})
-        for key, value in flat.items():
-            if preserve_existing and _has_nested(idx, key):
-                continue
-            _set_nested(idx, key, value)
-        _apply_effects(node, svc, flat)
-        node._persist_meta(name)
+        with svc.write_lock:
+            idx = svc.meta.settings.setdefault("index", {})
+            for key, value in flat.items():
+                if preserve_existing and _has_nested(idx, key):
+                    continue
+                _set_nested(idx, key, value)
+            _apply_effects(node, svc, flat)
+            node._persist_meta(name)
     return {"acknowledged": True}
 
 
@@ -172,9 +173,12 @@ def close_index(node, expression: str) -> dict:
         svc = node.indices[name]
         if svc.meta.state == "close":
             continue
-        svc.flush()
-        svc.meta.state = "close"
-        node._persist_meta(name)
+        # metadata-class transition: drain writers, exclude other
+        # metadata ops (node.py meta_lock contract)
+        with node.meta_lock, svc.write_lock:
+            svc.flush()
+            svc.meta.state = "close"
+            node._persist_meta(name)
     return {"acknowledged": True, "shards_acknowledged": True,
             "indices": {n: {"closed": True} for n in names}}
 
@@ -185,10 +189,11 @@ def open_index(node, expression: str) -> dict:
         svc = node.indices[name]
         if svc.meta.state != "close":
             continue
-        svc.meta.state = "open"
-        # static settings may have changed while closed (analysis etc.):
-        # rebuild the service like recovery does
-        node._reopen_service(name)
+        with node.meta_lock, svc.write_lock:
+            svc.meta.state = "open"
+            # static settings may have changed while closed (analysis
+            # etc.): rebuild the service like recovery does
+            node._reopen_service(name)
     return {"acknowledged": True, "shards_acknowledged": True}
 
 

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import pickle
 import threading
 import time
@@ -169,9 +170,16 @@ def _unb64(s: str):
 def _http(addr: str, method: str, path: str, payload=None,
           timeout: float = _RPC_TIMEOUT_S) -> dict:
     data = json.dumps(payload).encode() if payload is not None else None
+    headers = {"Content-Type": "application/json"}
+    # shared-secret node-to-node trust: when the cluster runs with REST
+    # security enabled, every /_internal call must carry this token (the
+    # compact analog of the reference's transport-layer TLS mutual auth)
+    tok = os.environ.get("OPENSEARCH_TPU_CLUSTER_TOKEN")
+    if tok:
+        headers["X-Cluster-Token"] = tok
     req = urllib.request.Request(
         f"http://{addr}{path}", data=data, method=method,
-        headers={"Content-Type": "application/json"})
+        headers=headers)
     with urllib.request.urlopen(req, timeout=timeout) as r:
         raw = r.read().decode()
     return json.loads(raw) if raw else {}

@@ -488,13 +488,15 @@ class Node:
             if not auto_create:
                 raise
             with self.meta_lock:
-                # re-check under the lock: another writer may have
-                # auto-created it while we waited
+                # re-check under the lock: another writer (or an alias/
+                # data-stream creation) may have claimed the name while
+                # we waited — re-resolve rather than assume the concrete
+                # index equals the request name
                 try:
-                    self.metadata.write_index(name)
+                    concrete = self.metadata.write_index(name)
                 except IndexNotFoundError:
                     self._create_index_locked(name)
-            concrete = name
+                    concrete = self.metadata.write_index(name)
         svc = self.indices[concrete]
         if svc.meta.state == "close":
             from .admin import IndexClosedError

@@ -149,6 +149,13 @@ class RestClient:
         # svc — so every transport is covered and two request names that
         # resolve to the same engine share one lock
         with svc.write_lock:
+            # re-check under the lock: a concurrent index delete may have
+            # popped this svc between resolution and acquisition — fail
+            # like the doc write arrived after the delete, never write
+            # into an orphaned engine
+            if self.node.indices.get(svc.meta.name) is not svc:
+                raise IndexNotFoundError(
+                    f"no such index [{svc.meta.name}]")
             try:
                 res = svc.route(doc_id, routing).index_doc(
                     doc_id, body, routing, if_seq_no, if_primary_term,
@@ -211,6 +218,9 @@ class RestClient:
                            f"closed index [{svc.meta.name}]")
         self._check_write_block(svc)
         with svc.write_lock:
+            if self.node.indices.get(svc.meta.name) is not svc:
+                raise IndexNotFoundError(
+                    f"no such index [{svc.meta.name}]")
             try:
                 res = svc.route(id, routing).delete_doc(id, if_seq_no,
                                                         if_primary_term)
@@ -1412,8 +1422,11 @@ class IndicesClient:
 
     def put_mapping(self, index: str, body: dict) -> dict:
         for n in self.c.node.metadata.resolve(index, allow_no_indices=False):
-            self.c.node.indices[n].mappings.merge(body)
-            self.c.node._persist_meta(n)
+            svc = self.c.node.indices[n]
+            # mapping merge mutates structures in-flight doc parses read
+            with svc.write_lock:
+                svc.mappings.merge(body)
+                self.c.node._persist_meta(n)
         return {"acknowledged": True}
 
     def get_settings(self, index: str = "_all") -> dict:

@@ -26,6 +26,7 @@ Usage:
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
@@ -36,6 +37,54 @@ from .client import ApiError, IndexNotFoundError, RestClient
 
 def _truthy(v) -> bool:
     return str(v).lower() in ("1", "true", "yes", "")
+
+
+# routes any authenticated principal may hit (cluster "monitor" class)
+_MONITOR_HEADS = {"", "_cluster", "_nodes", "_cat", "_stats", "_tasks"}
+# cluster-admin routes
+_ADMIN_HEADS = {"_index_template", "_template", "_remotestore", "_snapshot",
+                "_ingest", "_scripts", "_search_pipeline", "_data_stream",
+                "_aliases", "_security"}
+# per-index sub-ops that mutate data vs admin the index
+_INDEX_WRITE_OPS = {"_doc", "_create", "_update", "_bulk",
+                    "_update_by_query", "_delete_by_query"}
+_INDEX_ADMIN_OPS = {"_mapping", "_settings", "_open", "_close", "_refresh",
+                    "_flush", "_forcemerge", "_shrink", "_split", "_clone",
+                    "_rollover", "_alias", "_aliases"}
+
+
+def _classify(method: str, parts) -> Tuple[str, Optional[str]]:
+    """-> (action_group, index_or_None) for authorization. Mirrors the
+    reference security plugin's action-name -> action-group mapping at the
+    granularity this REST surface distinguishes."""
+    from ..security.identity import CLUSTER_ADMIN, INDEX_ADMIN, READ, WRITE
+    head = parts[0] if parts else ""
+    if head in _MONITOR_HEADS:
+        if head == "_cluster" and method == "PUT":
+            return CLUSTER_ADMIN, None
+        return "monitor", None
+    if head in _ADMIN_HEADS:
+        return CLUSTER_ADMIN, None
+    if head in ("_search", "_msearch", "_mget", "_count"):
+        return READ, "*"
+    if head == "_bulk":
+        return WRITE, "*"
+    # /{index}[/op...]
+    index = head
+    op = parts[1] if len(parts) > 1 else None
+    if op is None:
+        if method in ("PUT", "DELETE"):
+            return INDEX_ADMIN, index
+        return READ, index
+    if op in _INDEX_WRITE_OPS:
+        if op == "_doc" and method in ("GET", "HEAD"):
+            return READ, index
+        return WRITE, index
+    if op in _INDEX_ADMIN_OPS:
+        if method == "GET":
+            return READ, index
+        return INDEX_ADMIN, index
+    return READ, index
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -54,14 +103,23 @@ class _Handler(BaseHTTPRequestHandler):
         return raw.decode("utf-8") if raw else ""
 
     def _json_body(self) -> Optional[dict]:
+        cached = getattr(self, "_json_cache", None)
+        if cached is not None:
+            return cached
         raw = self._body()
         if not raw.strip():
             return None
-        return json.loads(raw)
+        self._json_cache = json.loads(raw)
+        return self._json_cache
 
     def _ndjson_body(self):
-        return [json.loads(ln) for ln in self._body().splitlines()
-                if ln.strip()]
+        cached = getattr(self, "_ndjson_cache", None)
+        if cached is not None:
+            return cached
+        self._ndjson_cache = [json.loads(ln)
+                              for ln in self._body().splitlines()
+                              if ln.strip()]
+        return self._ndjson_cache
 
     def _send(self, status: int, payload, content_type="application/json"):
         if isinstance(payload, (dict, list)):
@@ -76,6 +134,10 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(data)
 
     def _dispatch(self):
+        # one handler serves many requests over a keep-alive connection:
+        # body caches are strictly per-request
+        self._ndjson_cache = None
+        self._json_cache = None
         try:
             url = urlparse(self.path)
             parts = [unquote(p) for p in url.path.split("/") if p]
@@ -101,6 +163,106 @@ class _Handler(BaseHTTPRequestHandler):
 
     do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
 
+    def _body_targets(self, method: str, parts, url_index: str):
+        """The full set of indices a request addresses: the URL index plus
+        any per-line targets in bulk/msearch bodies and per-doc _index in
+        an _mget body."""
+        head = parts[0] if parts else ""
+        op = parts[1] if len(parts) > 1 else None
+        targets = set() if url_index == "*" else {url_index}
+        if head == "_bulk" or op == "_bulk":
+            default = None if head == "_bulk" else url_index
+            for ln in self._ndjson_body():
+                if isinstance(ln, dict):
+                    for verb in ("index", "create", "update", "delete"):
+                        if verb in ln and isinstance(ln[verb], dict):
+                            targets.add(ln[verb].get("_index", default))
+                            break
+        elif head == "_msearch" or op == "_msearch":
+            default = None if head == "_msearch" else url_index
+            for ln in self._ndjson_body():
+                if isinstance(ln, dict) and ("index" in ln
+                                             or not ln.get("query")):
+                    idx = ln.get("index", default)
+                    for i in (idx if isinstance(idx, list) else [idx]):
+                        targets.add(i)
+        elif head == "_mget" or op == "_mget":
+            body = self._json_body() or {}
+            for d in body.get("docs", []):
+                targets.add(d.get("_index",
+                                  None if head == "_mget" else url_index))
+        targets.discard(None)
+        # a line with no resolvable index (top-level bulk without _index)
+        # is a 400 later; for auth, treat it as the wildcard target
+        return targets or {"*"}
+
+    # ---------------- security API ----------------
+
+    def _security_route(self, method: str, parts, ident, subject):
+        """_security/user|role|token|authinfo (reference security plugin
+        REST API shapes). User/role management needs cluster_admin."""
+        from ..security.identity import CLUSTER_ADMIN
+        kind = parts[1] if len(parts) > 1 else None
+        if kind == "authinfo":
+            return 200, {"user_name": subject.principal,
+                         "roles": subject.roles}
+        if kind == "token" and method == "POST":
+            body = self._json_body() or {}
+            ttl = float(body.get("ttl_seconds", 3600))
+            return 200, {"token": ident.issue_token(subject, ttl),
+                         "type": "bearer"}
+        if kind in ("user", "role") and len(parts) > 2:
+            ident.authorize_cluster(subject, CLUSTER_ADMIN)
+            name = parts[2]
+            if kind == "user":
+                if method == "PUT":
+                    body = self._json_body() or {}
+                    try:
+                        ident.put_user(name, body.get("password", ""),
+                                       roles=body.get("roles", []),
+                                       attributes=body.get("attributes"))
+                    except ValueError as e:
+                        return 400, {"error": {
+                            "type": "illegal_argument_exception",
+                            "reason": str(e)}, "status": 400}
+                    return 200, {"status": "CREATED", "user": name}
+                if method == "DELETE":
+                    return ((200, {"status": "OK"})
+                            if ident.delete_user(name)
+                            else (404, {"status": "NOT_FOUND"}))
+                if method == "GET":
+                    u = ident.users.get(name)
+                    if u is None:
+                        return 404, {"status": "NOT_FOUND"}
+                    return 200, {name: {"roles": u.roles,
+                                        "attributes": u.attributes}}
+            else:
+                if method == "PUT":
+                    try:
+                        ident.put_role(name, self._json_body() or {})
+                    except ValueError as e:
+                        return 400, {"error": {
+                            "type": "illegal_argument_exception",
+                            "reason": str(e)}, "status": 400}
+                    return 200, {"status": "CREATED", "role": name}
+                if method == "DELETE":
+                    return ((200, {"status": "OK"})
+                            if ident.delete_role(name)
+                            else (404, {"status": "NOT_FOUND"}))
+                if method == "GET":
+                    r = ident.roles.get(name)
+                    if r is None:
+                        return 404, {"status": "NOT_FOUND"}
+                    return 200, {name: {
+                        "cluster_permissions": sorted(r.cluster),
+                        "index_permissions": [
+                            {"index_patterns": [p],
+                             "allowed_actions": sorted(a)}
+                            for p, a in r.indices]}}
+        return 400, {"error": {"type": "illegal_argument_exception",
+                               "reason": f"unsupported _security route "
+                                         f"{parts}"}, "status": 400}
+
     # ---------------- routing ----------------
 
     def _route(self, method: str, parts, params) -> Tuple[int, object]:
@@ -116,9 +278,61 @@ class _Handler(BaseHTTPRequestHandler):
                 return 404, {"error": {
                     "type": "resource_not_found_exception",
                     "reason": "not a cluster transport endpoint"}}
+            # when REST security is on, node-to-node calls must present
+            # the cluster's shared secret (OPENSEARCH_TPU_CLUSTER_TOKEN;
+            # compact analog of the reference's mutual transport TLS) —
+            # otherwise /_internal would be an auth bypass on this port
+            sident = getattr(self.server, "identity", None)
+            if sident is not None and sident.enabled:
+                import hmac as _hmac
+                tok = os.environ.get("OPENSEARCH_TPU_CLUSTER_TOKEN")
+                got = self.headers.get("X-Cluster-Token", "")
+                if not tok or not _hmac.compare_digest(tok, got):
+                    return 403, {"error": {
+                        "type": "security_exception",
+                        "reason": "node-to-node calls require the cluster "
+                                  "token when security is enabled"},
+                        "status": 403}
             return dist.handle_internal(method, parts,
                                         self._json_body() or {})
         c: RestClient = self.server.client            # type: ignore
+
+        # ---- authentication / authorization (security/identity.py) ----
+        # disabled unless an IdentityService is attached, like a reference
+        # distribution without the security plugin. `_internal` (above)
+        # stays exempt: node-to-node transport trust is a separate layer,
+        # as in the reference (transport TLS vs REST auth).
+        ident = getattr(self.server, "identity", None)
+        if ident is not None and ident.enabled:
+            from ..security.identity import (AuthenticationError,
+                                             AuthorizationError)
+            try:
+                subject = ident.authenticate_header(
+                    self.headers.get("Authorization"))
+                if parts and parts[0] == "_security":
+                    return self._security_route(method, parts, ident,
+                                                subject)
+                action, index = _classify(method, parts)
+                if action == "monitor":
+                    pass                  # any authenticated principal
+                elif index is None:
+                    ident.authorize_cluster(subject, action)
+                else:
+                    # bulk/msearch/mget bodies address indices PER LINE —
+                    # authorize every target, not just the URL index
+                    for tgt in self._body_targets(method, parts, index):
+                        ident.authorize_index(subject, tgt, action)
+            except AuthenticationError as e:
+                return 401, {"error": {"type": "security_exception",
+                                       "reason": str(e)}, "status": 401}
+            except AuthorizationError as e:
+                return 403, {"error": {"type": "security_exception",
+                                       "reason": str(e)}, "status": 403}
+        elif parts and parts[0] == "_security":
+            return 400, {"error": {
+                "type": "illegal_argument_exception",
+                "reason": "security is not enabled on this node"},
+                "status": 400}
 
         if not parts:
             return 200, {"name": c.node.node_name,
@@ -297,10 +511,11 @@ class HttpServer:
     """Threaded HTTP transport bound to a RestClient."""
 
     def __init__(self, client: Optional[RestClient] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, identity=None):
         self.client = client or RestClient()
         self.host = host
         self.port = port
+        self.identity = identity  # security.IdentityService or None (open)
         self.dist = None          # DistClusterNode when clustered
         self._srv: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -309,6 +524,7 @@ class HttpServer:
         self._srv = ThreadingHTTPServer((self.host, self.port), _Handler)
         self._srv.client = self.client                 # type: ignore
         self._srv.owner = self                         # type: ignore
+        self._srv.identity = self.identity             # type: ignore
         self._srv.daemon_threads = True
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(target=self._srv.serve_forever,

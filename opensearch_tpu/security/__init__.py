@@ -1,0 +1,5 @@
+from .identity import (AuthenticationError, AuthorizationError,
+                       IdentityService, Role, Subject, User)
+
+__all__ = ["IdentityService", "User", "Role", "Subject",
+           "AuthenticationError", "AuthorizationError"]

@@ -1,0 +1,246 @@
+"""Identity & access control — the analog of the reference's identity
+subsystem (`server/src/main/java/org/opensearch/identity/IdentityService.java:1`,
+`identity/tokens/BasicAuthToken.java:1`, `identity/tokens/BearerAuthToken.java:1`)
+plus the index/action permission model of the security plugin the reference
+ecosystem layers on top (`plugins/identity-shiro/.../ShiroIdentityPlugin.java:1`
+is the in-tree example).
+
+Scope vs the reference: the full security plugin carries TLS, LDAP/SAML/
+OIDC backends, DLS/FLS and audit logging; this build implements the core
+the API contract needs — an internal user store (PBKDF2-hashed passwords),
+roles with cluster/index permission patterns, HTTP Basic + bearer-token
+authentication, and per-request authorization — so a cluster can actually
+refuse unauthenticated writes. Disabled by default (like a reference
+distribution without the plugin): enabling is one `IdentityService` with
+users attached to the `HttpServer`/`Node`.
+
+Design: everything is plain host-side Python — auth gates the transport
+layer; nothing here touches the device path.
+"""
+
+from __future__ import annotations
+
+import base64
+import fnmatch
+import hashlib
+import hmac
+import os
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+
+class AuthenticationError(Exception):
+    """401: missing/invalid credentials."""
+
+
+class AuthorizationError(Exception):
+    """403: authenticated but not permitted."""
+
+
+# action groups (the reference security plugin's action-group granularity,
+# collapsed to the buckets this engine's REST surface distinguishes)
+READ = "read"          # search/get/aggregation/termvectors
+WRITE = "write"        # doc CRUD, bulk, update_by_query
+INDEX_ADMIN = "manage" # create/delete/settings/mappings/open/close
+CLUSTER_ADMIN = "cluster_admin"  # cluster settings, snapshots, templates
+ALL = "all"
+
+_ACTIONS = {READ, WRITE, INDEX_ADMIN, CLUSTER_ADMIN, ALL}
+
+
+def _hash_password(password: str, salt: bytes, rounds: int = 60_000) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", password.encode("utf-8"), salt,
+                               rounds)
+
+
+@dataclass
+class Role:
+    """Named permission set: index patterns -> allowed action groups,
+    plus cluster-level actions (reference roles.yml shape)."""
+    name: str
+    cluster: Set[str] = field(default_factory=set)
+    # list of (glob pattern, {actions})
+    indices: List = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, name: str, body: dict) -> "Role":
+        cluster = set(body.get("cluster_permissions", []))
+        bad = cluster - _ACTIONS
+        if bad:
+            raise ValueError(f"unknown cluster permissions {sorted(bad)}")
+        indices = []
+        for ip in body.get("index_permissions", []):
+            pats = ip.get("index_patterns", ["*"])
+            acts = set(ip.get("allowed_actions", []))
+            bad = acts - _ACTIONS
+            if bad:
+                raise ValueError(f"unknown index actions {sorted(bad)}")
+            for p in (pats if isinstance(pats, list) else [pats]):
+                indices.append((p, acts))
+        return cls(name=name, cluster=cluster, indices=indices)
+
+    def allows_cluster(self, action: str) -> bool:
+        return ALL in self.cluster or action in self.cluster
+
+    def allows_index(self, index: str, action: str) -> bool:
+        for pat, acts in self.indices:
+            if _glob_match(pat, index) and (ALL in acts or action in acts):
+                return True
+        return False
+
+
+def _glob_match(pattern: str, name: str) -> bool:
+    return fnmatch.fnmatchcase(name, pattern)
+
+
+@dataclass
+class User:
+    name: str
+    salt: bytes
+    pw_hash: bytes
+    roles: List[str] = field(default_factory=list)
+    attributes: dict = field(default_factory=dict)
+
+    def check_password(self, password: str) -> bool:
+        return hmac.compare_digest(self.pw_hash,
+                                   _hash_password(password, self.salt))
+
+
+@dataclass
+class Subject:
+    """An authenticated principal (reference identity/Subject.java:1)."""
+    principal: str
+    roles: List[str]
+
+    def __str__(self) -> str:  # NamedPrincipal.getName()
+        return self.principal
+
+
+class IdentityService:
+    """User store + token manager + authorizer.
+
+    Reference: `identity/IdentityService.java:1` (plugin discovery, subject
+    lookup), `identity/tokens/TokenManager.java:1` (token issue/reset).
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        # handler threads mutate users/roles/_tokens concurrently
+        self._lock = threading.RLock()
+        self.users: Dict[str, User] = {}
+        self.roles: Dict[str, Role] = {
+            # built-ins mirroring the reference defaults
+            "all_access": Role("all_access", cluster={ALL},
+                               indices=[("*", {ALL})]),
+            "readall": Role("readall", cluster=set(),
+                            indices=[("*", {READ})]),
+        }
+        # bearer tokens: token -> (principal, expiry_epoch)
+        self._tokens: Dict[str, tuple] = {}
+
+    # ---------------- user / role CRUD ----------------
+
+    def put_user(self, name: str, password: str,
+                 roles: Optional[List[str]] = None,
+                 attributes: Optional[dict] = None) -> None:
+        if not password or len(password) < 6:
+            raise ValueError("password must be at least 6 characters")
+        salt = os.urandom(16)
+        with self._lock:
+            self.users[name] = User(name=name, salt=salt,
+                                    pw_hash=_hash_password(password, salt),
+                                    roles=list(roles or []),
+                                    attributes=dict(attributes or {}))
+
+    def delete_user(self, name: str) -> bool:
+        with self._lock:
+            self._tokens = {t: v for t, v in self._tokens.items()
+                            if v[0] != name}
+            return self.users.pop(name, None) is not None
+
+    def put_role(self, name: str, body: dict) -> None:
+        role = Role.parse(name, body)
+        with self._lock:
+            self.roles[name] = role
+
+    def delete_role(self, name: str) -> bool:
+        with self._lock:
+            return self.roles.pop(name, None) is not None
+
+    # ---------------- authentication ----------------
+
+    def authenticate_basic(self, username: str, password: str) -> Subject:
+        u = self.users.get(username)
+        # constant-shape check: hash even for unknown users so the
+        # timing side channel can't enumerate principals
+        if u is None:
+            _hash_password(password, b"\x00" * 16)
+            raise AuthenticationError("invalid credentials")
+        if not u.check_password(password):
+            raise AuthenticationError("invalid credentials")
+        return Subject(principal=u.name, roles=list(u.roles))
+
+    def issue_token(self, subject: Subject,
+                    ttl_seconds: float = 3600.0) -> str:
+        """Reference TokenManager.issueOnBehalfOfToken (opaque bearer)."""
+        tok = secrets.token_urlsafe(24)
+        with self._lock:
+            self._tokens[tok] = (subject.principal,
+                                 time.time() + ttl_seconds)
+        return tok
+
+    def authenticate_bearer(self, token: str) -> Subject:
+        with self._lock:
+            ent = self._tokens.get(token)
+            if ent is not None and time.time() > ent[1]:
+                self._tokens.pop(token, None)
+                raise AuthenticationError("token expired")
+        if ent is None:
+            raise AuthenticationError("invalid token")
+        principal, _exp = ent
+        u = self.users.get(principal)
+        if u is None:
+            raise AuthenticationError("token principal no longer exists")
+        return Subject(principal=u.name, roles=list(u.roles))
+
+    def authenticate_header(self, authorization: Optional[str]) -> Subject:
+        """Parse an HTTP Authorization header (reference
+        `identity/tokens/RestTokenExtractor.java:1`)."""
+        if not authorization:
+            raise AuthenticationError("missing authentication credentials")
+        scheme, _, rest = authorization.partition(" ")
+        scheme = scheme.lower()
+        if scheme == "basic":
+            try:
+                up = base64.b64decode(rest.strip()).decode("utf-8")
+                username, _, password = up.partition(":")
+            except Exception:
+                raise AuthenticationError("malformed basic credentials")
+            return self.authenticate_basic(username, password)
+        if scheme == "bearer":
+            return self.authenticate_bearer(rest.strip())
+        raise AuthenticationError(f"unsupported auth scheme [{scheme}]")
+
+    # ---------------- authorization ----------------
+
+    def _roles_of(self, subject: Subject) -> List[Role]:
+        return [self.roles[r] for r in subject.roles if r in self.roles]
+
+    def authorize_cluster(self, subject: Subject, action: str) -> None:
+        if any(r.allows_cluster(action) for r in self._roles_of(subject)):
+            return
+        raise AuthorizationError(
+            f"no permissions for cluster action [{action}] and user "
+            f"[{subject.principal}]")
+
+    def authorize_index(self, subject: Subject, index: str,
+                        action: str) -> None:
+        if any(r.allows_index(index, action)
+               for r in self._roles_of(subject)):
+            return
+        raise AuthorizationError(
+            f"no permissions for [{action}] on index [{index}] and user "
+            f"[{subject.principal}]")
