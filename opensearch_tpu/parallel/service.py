@@ -23,9 +23,10 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..index.segment import next_pow2
-from .spmd import (INT32_SENTINEL, StackedShardIndex,
-                   build_distributed_metrics, build_distributed_search,
-                   build_distributed_terms_agg, make_mesh)
+from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
+                   build_distributed_metrics, build_distributed_phrase,
+                   build_distributed_search, build_distributed_terms_agg,
+                   make_mesh)
 
 MAX_WINDOW = 1024
 
@@ -36,6 +37,12 @@ _MESH_METRICS = ("min", "max", "sum", "avg", "value_count", "stats")
 # keyword `terms` aggs run as an exact device bincount + psum when the
 # field's global ordinal space fits this cap (counts array is [QB, vpad])
 MAX_TERMS_VOCAB = 8192
+
+# phrase queries: max terms the mesh serves (host loop beyond), and the
+# cap on the positional pair bucket (a stopword-anchored phrase on a huge
+# shard would blow the scatter working set)
+MAX_PHRASE_T = 8
+MAX_PHRASE_BUCKET = 1 << 22
 
 
 class _ByteLRU:
@@ -80,6 +87,7 @@ class MeshSearchService:
         self._programs: Dict[Tuple, object] = {}
         self._metric_programs: Dict[Tuple, object] = {}
         self._terms_programs: Dict[Tuple, object] = {}
+        self._phrase_programs: Dict[Tuple, object] = {}
         # (index, field) -> (generation, arrays-or-None)
         self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
         # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
@@ -90,10 +98,13 @@ class MeshSearchService:
         # filter-combo key -> per-shard host masks / device stacked mask
         self._host_masks = _ByteLRU(self._COLS_MAX_BYTES // 4)
         self._dev_masks = _ByteLRU(self._COLS_MAX_BYTES // 4)
+        # (index, field) -> (generation, StackedPhrasePairs-or-None)
+        self._stacked_pairs = _ByteLRU(self._COLS_MAX_BYTES // 2)
         self.dispatched = 0      # searches served by the mesh
         self.fallbacks = 0       # searches declined -> host loop
         self.filtered_dispatched = 0   # of dispatched: bool-with-filters
         self.terms_agg_dispatched = 0  # of dispatched: with a terms agg
+        self.phrase_dispatched = 0     # of dispatched: match_phrase
 
     # ---------------- caches ----------------
 
@@ -155,6 +166,33 @@ class MeshSearchService:
         return fn
 
     _COLS_MAX_BYTES = 1 << 30   # device budget for stacked agg columns
+
+    def _pairs_for(self, name: str, svc, field: str, shard_segs, stacked,
+                   mesh) -> Optional[StackedPhrasePairs]:
+        """Stacked positional pair arrays for `field` (phrase program
+        input), cached per generation incl. negative results (fields
+        without positions decline once, not per query)."""
+        key = ("pairs", name, field)
+        cached = self._stacked_pairs.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        pairs = StackedPhrasePairs.build(shard_segs, field, stacked, mesh)
+        self._stacked_pairs.put(key, (svc.generation, pairs),
+                                pairs.nbytes if pairs is not None else 0)
+        return pairs
+
+    def _phrase_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                            k: int, n_terms: int, k1: float, b: float,
+                            filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, k, n_terms, k1, b, filtered)
+        fn = self._phrase_programs.get(key)
+        if fn is None:
+            fn = build_distributed_phrase(mesh, bucket=bucket,
+                                          ndocs_pad=ndocs_pad, k=k,
+                                          n_terms=n_terms, k1=k1, b=b,
+                                          filtered=filtered)
+            self._phrase_programs[key] = fn
+        return fn
 
     def _col_for(self, name: str, svc, field: str, shard_segs,
                  d_pad: int, mesh) -> Optional[tuple]:
@@ -384,7 +422,7 @@ class MeshSearchService:
                     self.fallbacks += 1
                     continue
             const = (float(getattr(lt, "boost", 1.0) or 1.0) * qboost
-                     if lt.mode == "filter" else 0.0)
+                     if getattr(lt, "mode", None) == "filter" else 0.0)
             parsed.append((qi, lt, sort_specs, max(window, 1), const,
                            agg_nodes or [], fpair, qboost, msm_eff))
         if not parsed:
@@ -406,12 +444,20 @@ class MeshSearchService:
                      if sim is not None and lt.has_norms else 0.0)
             k_class = min(next_pow2(max(window, 16)), MAX_WINDOW)
             fkey = fpair[0] if fpair is not None else None
-            groups.setdefault((lt.field, k1, b_eff, k_class, fkey),
-                              []).append(item)
-        for (field, k1, b_eff, k_class, _fkey), items in groups.items():
-            self._run_mesh_group(name, svc, bodies, out, shard_segs, stats,
-                                 searchers, field, k1, b_eff, k_class,
-                                 items)
+            is_phrase = isinstance(lt, C.LPhrase)
+            nt_key = len(lt.terms) if is_phrase else 0
+            groups.setdefault((is_phrase, nt_key, lt.field, k1, b_eff,
+                               k_class, fkey), []).append(item)
+        for (is_phrase, nt_key, field, k1, b_eff, k_class,
+             _fkey), items in groups.items():
+            if is_phrase:
+                self._run_phrase_group(name, svc, bodies, out, shard_segs,
+                                       stats, searchers, field, nt_key, k1,
+                                       b_eff, k_class, items)
+            else:
+                self._run_mesh_group(name, svc, bodies, out, shard_segs,
+                                     stats, searchers, field, k1, b_eff,
+                                     k_class, items)
         return self._mark_declined(bodies, out)
 
     def _mark_declined(self, bodies, out) -> list:
@@ -426,9 +472,6 @@ class MeshSearchService:
     def _run_mesh_group(self, name, svc, bodies, out, shard_segs, stats,
                         searchers, field, k1, b_eff, k_class,
                         items) -> None:
-        from ..search.executor import (Candidate, ShardQueryResult,
-                                       _finish_search, _host_sort_values)
-
         t0 = time.monotonic()
         stacked = self._stacked_for(name, svc, field, shard_segs)
         if stacked is None:
@@ -538,10 +581,52 @@ class MeshSearchService:
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field) = fetched
 
+        # attach the globally-reduced agg partials to shard 0 (the values
+        # are already psum'd across the mesh; the coordinator merge sees
+        # exactly one partial per agg)
+        def attach_aggs(results, bi, aggs):
+            for an in aggs:
+                if an.kind == "terms":
+                    counts = tcounts_by_field[an.body["field"]][bi]
+                    vocab = tvocab_by_field[an.body["field"]]
+                    buckets = {vocab[o]: {"doc_count": int(c)}
+                               for o, c in enumerate(counts[: len(vocab)])
+                               if c > 0}
+                    results[0].agg_partials[an.name] = [{"buckets":
+                                                         buckets}]
+                    continue
+                m = metrics_by_field[an.body["field"]][bi]
+                cnt = float(m[0])
+                results[0].agg_partials[an.name] = [{
+                    "count": cnt, "sum": float(m[1]),
+                    "min": float(m[2]) if cnt > 0 else float("inf"),
+                    "max": float(m[3]) if cnt > 0 else float("-inf"),
+                    "sumsq": float(m[4])}]
+
+        self._emit_mesh_results(name, bodies, out, shard_segs, stats,
+                                searchers, stacked, items, gdocs_b,
+                                gvals_b, totals_b, t0,
+                                attach_aggs=attach_aggs)
+
+
+    def _emit_mesh_results(self, name, bodies, out, shard_segs, stats,
+                           searchers, stacked, items, gdocs_b, gvals_b,
+                           totals_b, t0, attach_aggs=None,
+                           phrase=False) -> None:
+        """Shared coordinator-side result assembly for every mesh program:
+        decode global doc ids back to (shard, segment, local), build the
+        candidate pool (host final selection keeps tie-breaks identical to
+        the shard loop), attach agg partials via `attach_aggs`, count
+        dispatch telemetry, and finish each body through the normal search
+        epilogue."""
+        from ..search.executor import (Candidate, ShardQueryResult,
+                                       _finish_search, _host_sort_values)
+
+        S = len(shard_segs)
         doc_base = np.asarray(stacked.doc_base)
         seg_bases = [np.cumsum([0] + ndocs[:-1])
                      for ndocs in stacked.seg_ndocs]
-        for bi, (qi, lt, sort_specs, window, const, aggs, _fk, qboost,
+        for bi, (qi, lt, sort_specs, window, _const, aggs, _fk, qboost,
                  _msm_eff) in enumerate(items):
             gdocs = gdocs_b[bi]
             gvals = gvals_b[bi]
@@ -570,36 +655,89 @@ class MeshSearchService:
                                                         local, sc)
                 results[si].candidates.append(
                     Candidate(si, seg_ord, local, sc, sort_vals, raw_vals))
-            # attach the globally-reduced agg partials to shard 0 (the
-            # values are already psum'd across the mesh; the coordinator
-            # merge sees exactly one partial per agg)
-            for an in aggs:
-                if an.kind == "terms":
-                    counts = tcounts_by_field[an.body["field"]][bi]
-                    vocab = tvocab_by_field[an.body["field"]]
-                    buckets = {vocab[o]: {"doc_count": int(c)}
-                               for o, c in enumerate(counts[: len(vocab)])
-                               if c > 0}
-                    results[0].agg_partials[an.name] = [{"buckets": buckets}]
-                    continue
-                m = metrics_by_field[an.body["field"]][bi]
-                cnt = float(m[0])
-                results[0].agg_partials[an.name] = [{
-                    "count": cnt, "sum": float(m[1]),
-                    "min": float(m[2]) if cnt > 0 else float("inf"),
-                    "max": float(m[3]) if cnt > 0 else float("-inf"),
-                    "sumsq": float(m[4])}]
+            if attach_aggs is not None:
+                attach_aggs(results, bi, aggs)
             for r in results:
                 r.took_ms = (time.monotonic() - t0) * 1000.0
             self.dispatched += 1
+            if phrase:
+                self.phrase_dispatched += 1
             if _fk is not None:
                 self.filtered_dispatched += 1
             if any(an.kind == "terms" for an in aggs):
                 self.terms_agg_dispatched += 1
             body = dict(bodies[qi])
             body["_index_name"] = name
-            out[qi] = _finish_search(searchers, results, body, stats, name,
-                                     t0, aggs)
+            out[qi] = _finish_search(searchers, results, body, stats,
+                                     name, t0, [] if phrase else aggs)
+
+    def _run_phrase_group(self, name, svc, bodies, out, shard_segs, stats,
+                          searchers, field, n_terms, k1, b_eff, k_class,
+                          items) -> None:
+        """One program invocation for a batch of same-length match_phrase
+        bodies: shard-local positional pair-join + BM25 pseudo-term scoring
+        + all_gather merge (spmd.build_distributed_phrase)."""
+        import jax
+
+        t0 = time.monotonic()
+        stacked = self._stacked_for(name, svc, field, shard_segs)
+        if stacked is None:
+            self.fallbacks += len(items)
+            return
+        S = len(shard_segs)
+        mesh = self._mesh_for(S)
+        if mesh is None:
+            self.fallbacks += len(items)
+            return
+        pairs = self._pairs_for(name, svc, field, shard_segs, stacked,
+                                mesh)
+        if pairs is None:         # field has no positional postings
+            self.fallbacks += len(items)
+            return
+        fpair = items[0][6]
+        K = min(k_class, stacked.ndocs_pad)
+        keep = []
+        for it in items:
+            if it[3] > K:
+                self.fallbacks += 1
+                continue
+            keep.append(it)
+        items = keep
+        if not items:
+            return
+        ctx = stats[0]
+        QB = next_pow2(len(items), floor=1)
+        rows = np.full((S, QB, n_terms), -1, np.int32)
+        weights = np.zeros(QB, np.float32)
+        slops = np.zeros(QB, np.float32)
+        avgdl = np.full(QB, max(float(ctx.avgdl(field)), 1e-9), np.float32)
+        max_pairs = 1
+        for bi, (qi, lt, sort_specs, window, _const, _aggs, _fk, qboost,
+                 _msm_eff) in enumerate(items):
+            weights[bi] = float(lt.weight) * float(qboost)
+            slops[bi] = float(lt.slop)
+            for si in range(S):
+                for ti, t in enumerate(lt.terms):
+                    r = stacked.row(si, t)
+                    rows[si, bi, ti] = r
+                    max_pairs = max(max_pairs, pairs.row_size(si, r))
+        bucket = next_pow2(max_pairs, floor=64)
+        if bucket > MAX_PHRASE_BUCKET:
+            self.fallbacks += len(items)
+            return
+        filtered = fpair is not None
+        fmask = (self._dev_mask_for(fpair[0], fpair[1], shard_segs,
+                                    stacked.ndocs_pad, mesh)
+                 if filtered else None)
+        fn = self._phrase_program_for(mesh, bucket, stacked.ndocs_pad, K,
+                                      n_terms, k1, b_eff, filtered)
+        args = (stacked.tree(), pairs.tree(), rows, weights, slops,
+                avgdl) + ((fmask,) if filtered else ())
+        gdocs_b, gvals_b, totals_b = jax.device_get(fn(*args))
+
+        self._emit_mesh_results(name, bodies, out, shard_segs, stats,
+                                searchers, stacked, items, gdocs_b,
+                                gvals_b, totals_b, t0, phrase=True)
 
     def _eligible(self, lroot, sort_specs, agg_nodes, named_nodes, body,
                   window: int) -> Optional[tuple]:
@@ -671,6 +809,21 @@ class MeshSearchService:
             qboost = float(lroot.boost or 1.0)
             if not all(self._maskable(n) for n in fnodes + notnodes):
                 return None
+        if isinstance(lt, C.LPhrase):
+            # plain/filtered match_phrase on the mesh: the positional
+            # pair-join program (spmd.build_distributed_phrase). Span
+            # family (ordered/gap_cost), prefix expansion, and agg
+            # combinations take the host loop; a bool-wrapped phrase must
+            # be the REQUIRED clause (msm_eff None).
+            if agg_nodes or msm_eff is not None:
+                return None
+            if lt.prefix_last or lt.ordered or lt.gap_cost:
+                return None
+            if lt.sim is None or lt.sim.sim_id != ops.SIM_BM25:
+                return None
+            if not 2 <= len(lt.terms) <= MAX_PHRASE_T:
+                return None
+            return (lt, fnodes, notnodes, qboost, msm_eff)
         if not isinstance(lt, C.LTerms):
             return None
         if lt.mode not in ("score", "filter"):
@@ -711,4 +864,5 @@ class MeshSearchService:
                 "fallbacks": self.fallbacks,
                 "filtered_dispatched": self.filtered_dispatched,
                 "terms_agg_dispatched": self.terms_agg_dispatched,
+                "phrase_dispatched": self.phrase_dispatched,
                 "stacked_indices": len(self._stacked)}

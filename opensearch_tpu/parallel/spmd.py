@@ -488,6 +488,170 @@ def build_distributed_terms_agg(mesh: Mesh, bucket: int, ndocs_pad: int,
     return jax.jit(fn)
 
 
+@dataclass
+class StackedPhrasePairs:
+    """Per-shard positional (doc, position) pair arrays in the SAME
+    term-row space as a StackedShardIndex — the mesh-resident form of the
+    host path's per-segment `_phrase_pair_cache` (search/compiler.py
+    `_phrase_pairs`). Rows are the stacked index's shard term-union rows;
+    each row's pairs concatenate the shard's segments (doc ids offset by
+    segment base) and are lex-sorted by (doc, position), sentinel padded."""
+
+    field: str
+    pair_starts: jnp.ndarray   # i32[S, R_pad]  (stacked.starts row space)
+    pair_d: jnp.ndarray        # i32[S, PP_pad]
+    pair_p: jnp.ndarray        # i32[S, PP_pad]
+    host_pair_starts: Optional[List[np.ndarray]] = None
+    nbytes: int = 0
+
+    def row_size(self, shard: int, row: int) -> int:
+        st = self.host_pair_starts[shard]
+        return int(st[row + 1] - st[row]) if 0 <= row < len(st) - 1 else 0
+
+    def tree(self) -> dict:
+        return {"pair_starts": self.pair_starts, "pair_d": self.pair_d,
+                "pair_p": self.pair_p}
+
+    @classmethod
+    def build(cls, shard_segs, field: str, stacked: StackedShardIndex,
+              mesh: Mesh) -> Optional["StackedPhrasePairs"]:
+        S = len(shard_segs)
+        per = []
+        any_positional = False
+        for si, segs in enumerate(shard_segs):
+            union = stacked.host_terms[si]
+            nterms = len(union)
+            trows_parts, d_parts, p_parts = [], [], []
+            off = 0
+            for seg in segs:
+                pb = seg.postings.get(field)
+                if pb is not None and pb.pos_starts is not None and pb.size:
+                    any_positional = True
+                    # vectorized: per-position (union row, offset doc, pos)
+                    rows_map = np.array([union[t] for t in pb.vocab],
+                                        np.int64)
+                    per_post = np.repeat(rows_map, np.diff(pb.starts))
+                    counts = np.diff(pb.pos_starts)
+                    trows_parts.append(np.repeat(per_post, counts))
+                    d_parts.append(np.repeat(
+                        pb.doc_ids.astype(np.int64) + off, counts))
+                    p_parts.append(pb.positions.astype(np.int64))
+                off += seg.ndocs
+            if trows_parts:
+                trows = np.concatenate(trows_parts)
+                d = np.concatenate(d_parts)
+                p = np.concatenate(p_parts)
+                order = np.lexsort((p, d, trows))
+                trows, d, p = trows[order], d[order], p[order]
+                lens = np.bincount(trows, minlength=nterms)
+            else:
+                d = p = np.zeros(0, np.int64)
+                lens = np.zeros(max(nterms, 1), np.int64)
+            starts = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=starts[1:])
+            per.append((starts, d, p))
+        if not any_positional:
+            return None
+        r_pad = int(stacked.starts.shape[1])
+        pp_pad = max(next_pow2(max(len(d), 1)) for _st, d, _p in per)
+        pair_starts = np.zeros((S, r_pad), np.int32)
+        pair_d = np.full((S, pp_pad), INT32_SENTINEL, np.int32)
+        pair_p = np.full((S, pp_pad), INT32_SENTINEL, np.int32)
+        host_ps = []
+        for si, (starts, d, p) in enumerate(per):
+            n = min(len(starts), r_pad)
+            pair_starts[si, :n] = starts[:n]
+            pair_starts[si, n:] = starts[-1]
+            pair_d[si, : len(d)] = d
+            pair_p[si, : len(p)] = p
+            host_ps.append(starts)
+        sharding = NamedSharding(mesh, P("shard"))
+        return cls(field=field,
+                   pair_starts=jax.device_put(pair_starts, sharding),
+                   pair_d=jax.device_put(pair_d, sharding),
+                   pair_p=jax.device_put(pair_p, sharding),
+                   host_pair_starts=host_ps,
+                   nbytes=pair_starts.nbytes + pair_d.nbytes
+                   + pair_p.nbytes)
+
+
+def build_distributed_phrase(mesh: Mesh, bucket: int, ndocs_pad: int,
+                             k: int, n_terms: int, k1: float = 1.2,
+                             b: float = 0.75, filtered: bool = False):
+    """Distributed match_phrase over the mesh: each shard runs the
+    vectorized positional pair-join (ops/positions.py phrase_freqs — the
+    device replacement for Lucene's ExactPhrase/SloppyPhraseMatcher) over
+    its own positional pairs, scores the phrase as one BM25 pseudo-term
+    with the HOST-computed global weight (same `LPhrase.weight` the host
+    shard loop uses, so scores are bit-identical), and the per-shard
+    top-ks merge with an all_gather — completing the coordinator fan-out
+    (`action/search/SearchPhaseController.java:1`) for the phrase-shaped
+    traffic the mesh previously declined. Returns a callable:
+        (tree, ptree, rows [S,QB,T], weights [QB], slops [QB],
+         avgdl [QB] [, fmask [S,D_pad]]) ->
+        (global_doc_ids [QB, S*k], scores [QB, S*k], totals [QB])"""
+    from ..ops import positions as pos_ops
+
+    def gather_pairs(pstarts, pair_d, pair_p, r):
+        rsafe = jnp.maximum(r, 0)
+        a = jnp.where(r >= 0, pstarts[rsafe], 0)
+        e = jnp.where(r >= 0, pstarts[rsafe + 1], 0)
+        idx = a + jnp.arange(bucket, dtype=jnp.int32)
+        valid = idx < e
+        safe = jnp.minimum(idx, pair_d.shape[0] - 1)
+        d = jnp.where(valid, pair_d[safe], INT32_SENTINEL)
+        p = jnp.where(valid, pair_p[safe], INT32_SENTINEL)
+        return d, p
+
+    def per_device(tree, ptree, rows, weights, slops, avgdl, fmask=None):
+        rows = rows[0]
+        pstarts = ptree["pair_starts"][0]
+        pair_d = ptree["pair_d"][0]
+        pair_p = ptree["pair_p"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        doc_base = tree["doc_base"][0]
+        fm = fmask[0] if fmask is not None else None
+        lv = live * fm if fm is not None else live
+
+        def one(r, w, slop, ad):
+            anchor_d, anchor_p = gather_pairs(pstarts, pair_d, pair_p,
+                                              r[0])
+            others = [gather_pairs(pstarts, pair_d, pair_p, r[i])
+                      for i in range(1, n_terms)]
+            freq = pos_ops.phrase_freqs(
+                anchor_d, anchor_p, others, slop, ndocs_pad,
+                shifts=list(range(1, n_terms)))
+            sc, matched = pos_ops.phrase_score(freq, dl, lv, w, k1, b, ad)
+            return jnp.where(matched, sc, -jnp.inf)
+
+        scores = jax.vmap(one)(rows, weights, slops, avgdl)       # [QB, D]
+        totals = jax.lax.psum(jnp.sum(scores > -jnp.inf, axis=1), "shard")
+        kk = min(k, ndocs_pad)
+        vals, idx = jax.lax.top_k(scores, kk)
+        gids = jnp.where(vals > -jnp.inf, idx + doc_base, -1)
+        all_vals = jax.lax.all_gather(vals, "shard", axis=1)
+        all_gids = jax.lax.all_gather(gids, "shard", axis=1)
+        S = all_vals.shape[1]
+        return (all_gids.reshape(all_gids.shape[0], S * kk),
+                all_vals.reshape(all_vals.shape[0], S * kk), totals)
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    ptree_spec = {k_: P("shard") for k_ in
+                  ("pair_starts", "pair_d", "pair_p")}
+    in_specs = (tree_spec, ptree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=(P("replica"), P("replica"), P("replica")),
+                   check_vma=False)
+    return jax.jit(fn)
+
+
 def build_term_sharded_score(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
                              k1: float = 1.2, b: float = 0.75):
     """Sequence-parallel analog: ONE doc space replicated, posting rows of the

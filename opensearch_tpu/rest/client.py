@@ -136,9 +136,13 @@ class RestClient:
             if body is None:
                 return {"_index": index, "_id": id or "", "result": "noop"}
             # date_index_name (and any processor that rewrites _index)
-            # redirects the doc — resolve the new target before routing
+            # redirects the doc — resolve the new target before routing,
+            # and re-authorize it against the ambient request subject
+            # (the transport authorized only the ORIGINAL request index)
             new_index = body.pop("_index", None)
             if new_index and new_index != index:
+                from ..security.context import authorize_index_if_active
+                authorize_index_if_active(new_index, "write")
                 index = new_index
                 svc = self._svc_for_write(index)
                 self._check_write_block(svc)

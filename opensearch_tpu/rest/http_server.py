@@ -53,6 +53,18 @@ _INDEX_ADMIN_OPS = {"_mapping", "_settings", "_open", "_close", "_refresh",
                     "_rollover", "_alias", "_aliases"}
 
 
+def _resolve_targets(c: RestClient, name: str):
+    """Concrete indices `name` resolves to (alias/data-stream/wildcard
+    expansion) for authorization; the raw name is always included so
+    pattern-based roles grant the names users actually type."""
+    out = {name}
+    try:
+        out.update(c.node.metadata.resolve(name))
+    except Exception:       # noqa: BLE001 — unresolvable: raw name only
+        pass
+    return out
+
+
 def _classify(method: str, parts) -> Tuple[str, Optional[str]]:
     """-> (action_group, index_or_None) for authorization. Mirrors the
     reference security plugin's action-name -> action-group mapping at the
@@ -62,6 +74,8 @@ def _classify(method: str, parts) -> Tuple[str, Optional[str]]:
     if head in _MONITOR_HEADS:
         if head == "_cluster" and method == "PUT":
             return CLUSTER_ADMIN, None
+        if head == "_tasks" and method == "POST":
+            return CLUSTER_ADMIN, None    # cancel is a mutating op
         return "monitor", None
     if head in _ADMIN_HEADS:
         return CLUSTER_ADMIN, None
@@ -81,7 +95,11 @@ def _classify(method: str, parts) -> Tuple[str, Optional[str]]:
             return READ, index
         return WRITE, index
     if op in _INDEX_ADMIN_OPS:
-        if method == "GET":
+        # _mapping/_settings GETs are reads; refresh/flush/forcemerge are
+        # maintenance regardless of method (the routes accept GET, like
+        # the reference's method-agnostic registrations)
+        if method == "GET" and op in ("_mapping", "_settings", "_alias",
+                                      "_aliases"):
             return READ, index
         return INDEX_ADMIN, index
     return READ, index
@@ -207,8 +225,17 @@ class _Handler(BaseHTTPRequestHandler):
             return 200, {"user_name": subject.principal,
                          "roles": subject.roles}
         if kind == "token" and method == "POST":
+            import math
             body = self._json_body() or {}
-            ttl = float(body.get("ttl_seconds", 3600))
+            try:
+                ttl = float(body.get("ttl_seconds", 3600))
+            except (TypeError, ValueError):
+                ttl = float("nan")
+            if not math.isfinite(ttl) or not 0 < ttl <= 30 * 86400:
+                return 400, {"error": {
+                    "type": "illegal_argument_exception",
+                    "reason": "ttl_seconds must be in (0, 2592000]"},
+                    "status": 400}
             return 200, {"token": ident.issue_token(subject, ttl),
                          "type": "bearer"}
         if kind in ("user", "role") and len(parts) > 2:
@@ -306,6 +333,7 @@ class _Handler(BaseHTTPRequestHandler):
         if ident is not None and ident.enabled:
             from ..security.identity import (AuthenticationError,
                                              AuthorizationError)
+            from ..security.context import request_subject
             try:
                 subject = ident.authenticate_header(
                     self.headers.get("Authorization"))
@@ -319,9 +347,17 @@ class _Handler(BaseHTTPRequestHandler):
                     ident.authorize_cluster(subject, action)
                 else:
                     # bulk/msearch/mget bodies address indices PER LINE —
-                    # authorize every target, not just the URL index
+                    # authorize every target, not just the URL index; and
+                    # authorize the CONCRETE indices a name resolves to
+                    # (alias/data-stream), not just the request name
                     for tgt in self._body_targets(method, parts, index):
-                        ident.authorize_index(subject, tgt, action)
+                        for concrete in _resolve_targets(c, tgt):
+                            ident.authorize_index(subject, concrete,
+                                                  action)
+                # mid-flight re-checks (ingest `_index` rewrites) consult
+                # the ambient request subject (security/context.py)
+                with request_subject(ident, subject):
+                    return self._route_after_auth(method, parts, params, c)
             except AuthenticationError as e:
                 return 401, {"error": {"type": "security_exception",
                                        "reason": str(e)}, "status": 401}
@@ -333,7 +369,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "type": "illegal_argument_exception",
                 "reason": "security is not enabled on this node"},
                 "status": 400}
+        return self._route_after_auth(method, parts, params, c)
 
+    def _route_after_auth(self, method: str, parts, params,
+                          c: RestClient) -> Tuple[int, object]:
         if not parts:
             return 200, {"name": c.node.node_name,
                          "cluster_name": c.node.metadata.cluster_name,
@@ -418,6 +457,23 @@ class _Handler(BaseHTTPRequestHandler):
                     raise ApiError(405, "method_not_allowed",
                                    "restore requires POST")
                 return 200, c.remotestore_restore(self._json_body() or {})
+        if head == "_ingest" and len(parts) >= 2 and \
+                parts[1] == "pipeline":
+            # reference RestPutPipelineAction / RestGetPipelineAction /
+            # RestDeletePipelineAction / RestSimulatePipelineAction
+            if parts[-1] == "_simulate":
+                return 200, c.ingest.simulate(self._json_body() or {})
+            pid = parts[2] if len(parts) > 2 else None
+            if method == "PUT":
+                if pid is None:
+                    raise ApiError(400, "illegal_argument_exception",
+                                   "pipeline id required")
+                return 200, c.ingest.put_pipeline(pid, self._json_body())
+            if method == "DELETE":
+                return 200, c.ingest.delete_pipeline(pid)
+            return 200, c.ingest.get_pipeline(pid)
+        if head == "_aliases" and method == "POST":
+            return 200, c.indices.update_aliases(self._json_body() or {})
         if head == "_index_template" and len(parts) == 2:
             if method == "PUT":
                 return 200, c.indices.put_index_template(
@@ -447,7 +503,8 @@ class _Handler(BaseHTTPRequestHandler):
             if method in ("PUT", "POST"):
                 resp = c.index(index, self._json_body() or {},
                                id=doc_id, refresh=refresh,
-                               routing=params.get("routing"))
+                               routing=params.get("routing"),
+                               pipeline=params.get("pipeline"))
                 # reference: 201 on create, 200 on overwrite-update
                 return (201 if resp.get("result") == "created"
                         else 200), resp

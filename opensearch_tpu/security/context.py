@@ -1,0 +1,37 @@
+"""Per-request security context — the compact analog of the reference's
+`ThreadContext` (`common/util/concurrent/ThreadContext.java:1`), which
+carries the authenticated subject through every layer of a request so
+authorization can re-check targets that only become known mid-flight
+(alias resolution, ingest-pipeline `_index` rewrites).
+
+The HTTP handler installs (identity, subject) for the request's duration;
+`RestClient` consults it at points where the effective target index can
+DIFFER from the one the transport already authorized."""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+_CTX = threading.local()
+
+
+@contextmanager
+def request_subject(identity, subject):
+    prev = getattr(_CTX, "entry", None)
+    _CTX.entry = (identity, subject)
+    try:
+        yield
+    finally:
+        _CTX.entry = prev
+
+
+def authorize_index_if_active(index: str, action: str) -> None:
+    """Re-check an index target against the ambient request subject.
+    No-op when no security context is active (open cluster / library
+    use); raises AuthorizationError like the transport-level check."""
+    entry = getattr(_CTX, "entry", None)
+    if entry is None:
+        return
+    identity, subject = entry
+    identity.authorize_index(subject, index, action)

@@ -241,3 +241,54 @@ class TestAuthzBodyTargets:
         # an auth bypass. On a dist node this returns 403 without the
         # shared token (exercised in dist tests).
         assert s in (403, 404)
+
+
+class TestAuthzHardening:
+    def test_reader_cannot_cancel_tasks_or_refresh(self, secured):
+        s, _ = req(secured, "POST", "/_tasks/_cancel",
+                   user="reader:readerpass")
+        assert s == 403
+        # maintenance ops are manage-class even via GET
+        s, _ = req(secured, "GET", "/adm/_refresh", user="reader:readerpass")
+        assert s == 403
+        s, _ = req(secured, "GET", "/adm/_mapping", user="reader:readerpass")
+        assert s == 200                  # real reads stay readable
+
+    def test_token_ttl_validated(self, secured):
+        for bad in ("NaN", "Infinity", "-5", "0", "999999999999"):
+            s, b = req(secured, "POST", "/_security/token",
+                       body=json.loads(f'{{"ttl_seconds": {bad}}}'),
+                       user="reader:readerpass")
+            assert s == 400, (bad, s, b)
+
+    def test_alias_resolution_authorized(self, secured):
+        # admin creates hidden index + alias inside logger's pattern;
+        # writing via the alias must check the CONCRETE index too
+        s, _ = req(secured, "PUT", "/private-idx", user="admin:adminpass")
+        assert s == 200
+        srv_client = None
+        # route alias creation through the admin API
+        s, _ = req(secured, "POST", "/_aliases", {
+            "actions": [{"add": {"index": "private-idx",
+                                 "alias": "logs-alias"}}]},
+            user="admin:adminpass")
+        # logger matches logs-* by name but the alias resolves outside it
+        s, b = req(secured, "PUT", "/logs-alias/_doc/1", {"v": 1},
+                   user="logger:loggerpass")
+        assert s == 403, (s, b)
+
+    def test_pipeline_index_rewrite_reauthorized(self, secured):
+        # admin installs a pipeline that redirects docs into an index the
+        # writer has no rights to; the redirect must 403, not land
+        s, _ = req(secured, "PUT", "/_ingest/pipeline/redir", {
+            "processors": [{"set": {"field": "_index",
+                                    "value": "protected-target"}}]},
+            user="admin:adminpass")
+        s, b = req(secured, "PUT",
+                   "/logs-redir/_doc/1?pipeline=redir", {"v": 1},
+                   user="logger:loggerpass")
+        assert s == 403, (s, b)
+        # and the doc must NOT exist in the protected target
+        s, b = req(secured, "GET", "/protected-target/_doc/1",
+                   user="admin:adminpass")
+        assert s == 404
