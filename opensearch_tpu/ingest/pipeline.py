@@ -330,12 +330,15 @@ class Pipeline:
 class IngestService:
     def __init__(self):
         self.pipelines: Dict[str, Pipeline] = {}
+        self.configs: Dict[str, dict] = {}
 
     def put_pipeline(self, pid: str, config: dict) -> None:
         self.pipelines[pid] = Pipeline(pid, config, service=self)
+        self.configs[pid] = config
 
     def delete_pipeline(self, pid: str) -> None:
         self.pipelines.pop(pid, None)
+        self.configs.pop(pid, None)
 
     def get_pipeline(self, pid: str) -> Optional[Pipeline]:
         return self.pipelines.get(pid)

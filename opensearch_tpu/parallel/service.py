@@ -24,7 +24,8 @@ import numpy as np
 
 from ..index.segment import next_pow2
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
-                   build_distributed_metrics, build_distributed_phrase,
+                   build_distributed_bincount, build_distributed_metrics,
+                   build_distributed_phrase, build_distributed_range_counts,
                    build_distributed_search, build_distributed_terms_agg,
                    make_mesh)
 
@@ -43,6 +44,12 @@ MAX_TERMS_VOCAB = 8192
 # shard would blow the scatter working set)
 MAX_PHRASE_T = 8
 MAX_PHRASE_BUCKET = 1 << 22
+
+# histogram-family aggs: bin-count cap for the mesh bincount program (a
+# pathological interval over a wide value range -> host loop) and the max
+# `range` agg ranges served as per-range masked sums
+MAX_MESH_BINS = 4096
+MAX_MESH_RANGES = 16
 
 
 class _ByteLRU:
@@ -88,6 +95,8 @@ class MeshSearchService:
         self._metric_programs: Dict[Tuple, object] = {}
         self._terms_programs: Dict[Tuple, object] = {}
         self._phrase_programs: Dict[Tuple, object] = {}
+        self._hist_programs: Dict[Tuple, object] = {}
+        self._range_programs: Dict[Tuple, object] = {}
         # (index, field) -> (generation, arrays-or-None)
         self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
         # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
@@ -100,6 +109,9 @@ class MeshSearchService:
         self._dev_masks = _ByteLRU(self._COLS_MAX_BYTES // 4)
         # (index, field) -> (generation, StackedPhrasePairs-or-None)
         self._stacked_pairs = _ByteLRU(self._COLS_MAX_BYTES // 2)
+        # (index, field, kind, interval, offset) ->
+        #     (generation, (bins_dev, min_b, nb)-or-None)
+        self._stacked_bins = _ByteLRU(self._COLS_MAX_BYTES // 4)
         self.dispatched = 0      # searches served by the mesh
         self.fallbacks = 0       # searches declined -> host loop
         self.filtered_dispatched = 0   # of dispatched: bool-with-filters
@@ -193,6 +205,105 @@ class MeshSearchService:
                                           filtered=filtered)
             self._phrase_programs[key] = fn
         return fn
+
+    def _hist_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                          nb: int, k1: float, b: float,
+                          filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, nb, k1, b, filtered)
+        fn = self._hist_programs.get(key)
+        if fn is None:
+            fn = build_distributed_bincount(mesh, bucket=bucket,
+                                            ndocs_pad=ndocs_pad, nb=nb,
+                                            k1=k1, b=b, filtered=filtered)
+            self._hist_programs[key] = fn
+        return fn
+
+    def _range_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                           nr: int, k1: float, b: float,
+                           filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, nr, k1, b, filtered)
+        fn = self._range_programs.get(key)
+        if fn is None:
+            fn = build_distributed_range_counts(mesh, bucket=bucket,
+                                                ndocs_pad=ndocs_pad, nr=nr,
+                                                k1=k1, b=b,
+                                                filtered=filtered)
+            self._range_programs[key] = fn
+        return fn
+
+    def _bins_for(self, name: str, svc, an, shard_segs, d_pad: int, mesh
+                  ) -> Optional[tuple]:
+        """Host-precomputed per-doc GLOBAL bin ids for a histogram /
+        fixed-interval date_histogram (-1 = no value), stacked and
+        shard-sharded — the mesh analog of the host 'hist' bin compute,
+        done in one vectorized pass per (field, interval, offset) and
+        cached per generation. Returns (bins_dev, min_b, nb, interval,
+        offset) or None (missing column / too many bins)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..search.compiler import parse_interval_ms
+
+        field = an.body["field"]
+        if an.kind == "date_histogram":
+            interval = float(parse_interval_ms(
+                an.body.get("fixed_interval", an.body.get("interval",
+                                                          "1d"))))
+            offset = (float(parse_interval_ms(an.body.get("offset", 0),
+                                              allow_negative=True))
+                      if an.body.get("offset") else 0.0)
+        else:
+            interval = float(an.body["interval"])
+            offset = float(an.body.get("offset", 0.0))
+        if interval <= 0:
+            return None
+        key = (name, field, an.kind, interval, offset)
+        cached = self._stacked_bins.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        if not any(field in seg.numeric_cols
+                   for segs in shard_segs for seg in segs):
+            self._stacked_bins.put(key, (svc.generation, None), 0)
+            return None
+        S = len(shard_segs)
+        raw = np.full((S, d_pad), np.iinfo(np.int64).min, np.int64)
+        for si, segs in enumerate(shard_segs):
+            off = 0
+            for seg in segs:
+                nc = seg.numeric_cols.get(field)
+                if nc is not None:
+                    if an.kind == "date_histogram":
+                        # exact i64 floor-div — the host date path
+                        # (`compiler._host_date_buckets`) is integer, and
+                        # epoch-ms values exceed f32 precision
+                        bins = np.floor_divide(
+                            nc.values.astype(np.int64) - np.int64(offset),
+                            np.int64(max(interval, 1)))
+                    else:
+                        # f32 arithmetic to MATCH the host 'hist' kernel
+                        # bit-for-bit (it bins the f32 column on device)
+                        bins = np.floor(
+                            (nc.values.astype(np.float32)
+                             - np.float32(offset)) / np.float32(interval)
+                        ).astype(np.int64)
+                    bins = np.where(nc.present, bins,
+                                    np.iinfo(np.int64).min).astype(np.int64)
+                    raw[si, off: off + seg.ndocs] = bins
+                off += seg.ndocs
+        present = raw > np.iinfo(np.int64).min
+        if not present.any():
+            self._stacked_bins.put(key, (svc.generation, None), 0)
+            return None
+        min_b = int(raw[present].min())
+        nb = int(raw[present].max()) - min_b + 1
+        if nb > MAX_MESH_BINS:
+            self._stacked_bins.put(key, (svc.generation, None), 0)
+            return None
+        bins32 = np.where(present, raw - min_b, -1).astype(np.int32)
+        dev = jax.device_put(bins32, NamedSharding(mesh, P("shard")))
+        out = (dev, min_b, nb, interval, offset)
+        self._stacked_bins.put(key, (svc.generation, out), bins32.nbytes)
+        return out
 
     def _col_for(self, name: str, svc, field: str, shard_segs,
                  d_pad: int, mesh) -> Optional[tuple]:
@@ -499,6 +610,9 @@ class MeshSearchService:
                 if an.kind == "terms":
                     got = self._ord_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
+                elif an.kind in ("histogram", "date_histogram"):
+                    got = self._bins_for(name, svc, an, shard_segs,
+                                         stacked.ndocs_pad, mesh)
                 else:
                     got = self._col_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
@@ -576,16 +690,98 @@ class MeshSearchService:
                      val_ord) + ((fmask,) if filtered else ())
             tcounts_by_field[f] = tfn(*targs)
             tvocab_by_field[f] = vocab
+        # histogram family: one bincount program per distinct
+        # (field, interval, offset); range: per-range masked sums
+        def _hist_key(an):
+            return (an.kind, an.body["field"],
+                    str(an.body.get("interval",
+                                    an.body.get("fixed_interval"))),
+                    str(an.body.get("offset", 0)))
+
+        def _range_key(an):
+            return (an.body["field"],
+                    tuple((str(r.get("from")), str(r.get("to")))
+                          for r in an.body["ranges"]))
+
+        hist_results = {}
+        range_results = {}
+        for it in items:
+            for an in it[5]:
+                if an.kind in ("histogram", "date_histogram"):
+                    hk = _hist_key(an)
+                    if hk in hist_results:
+                        continue
+                    bins_dev, min_b, nb, interval, offset = self._bins_for(
+                        name, svc, an, shard_segs, stacked.ndocs_pad, mesh)
+                    hfn = self._hist_program_for(
+                        mesh, bucket, stacked.ndocs_pad, nb, k1, b_eff,
+                        filtered)
+                    hargs = (stacked.tree(), rows, boosts, msm, cscore,
+                             bins_dev) + ((fmask,) if filtered else ())
+                    hist_results[hk] = (hfn(*hargs), min_b, nb, interval,
+                                        offset)
+                elif an.kind == "range":
+                    rk = _range_key(an)
+                    if rk in range_results:
+                        continue
+                    col, pres = self._col_for(name, svc, an.body["field"],
+                                              shard_segs,
+                                              stacked.ndocs_pad, mesh)
+                    ranges = an.body["ranges"]
+                    nr = len(ranges)
+                    lows = np.full(nr, -np.inf, np.float32)
+                    highs = np.full(nr, np.inf, np.float32)
+                    rkeys, metas = [], []
+                    for ri, r in enumerate(ranges):
+                        frm, to = r.get("from"), r.get("to")
+                        if frm is not None:
+                            lows[ri] = float(frm)
+                        if to is not None:
+                            highs[ri] = float(to)
+                        rkeys.append(r.get(
+                            "key",
+                            f"{frm if frm is not None else '*'}-"
+                            f"{to if to is not None else '*'}"))
+                        meta = {}
+                        if frm is not None:
+                            meta["from"] = float(frm)
+                        if to is not None:
+                            meta["to"] = float(to)
+                        metas.append(meta)
+                    rfn = self._range_program_for(
+                        mesh, bucket, stacked.ndocs_pad, nr, k1, b_eff,
+                        filtered)
+                    rargs = (stacked.tree(), rows, boosts, msm, cscore,
+                             col, pres, lows, highs)                         + ((fmask,) if filtered else ())
+                    range_results[rk] = (rfn(*rargs), rkeys, metas)
         fetched = jax.device_get((gdocs_b, gvals_b, totals_b,
-                                  metrics_by_field, tcounts_by_field))
+                                  metrics_by_field, tcounts_by_field,
+                                  hist_results, range_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
-         tcounts_by_field) = fetched
+         tcounts_by_field, hist_results, range_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
         # exactly one partial per agg)
         def attach_aggs(results, bi, aggs):
             for an in aggs:
+                if an.kind in ("histogram", "date_histogram"):
+                    counts, min_b, _nb, interval, offset = \
+                        hist_results[_hist_key(an)]
+                    buckets = {min_b + j: {"doc_count": int(c), "subs": {}}
+                               for j, c in enumerate(counts[bi]) if c > 0}
+                    results[0].agg_partials[an.name] = [{
+                        "buckets": buckets, "interval": interval,
+                        "offset": offset}]
+                    continue
+                if an.kind == "range":
+                    counts, rkeys, metas = range_results[_range_key(an)]
+                    buckets = {key: {"doc_count": int(counts[bi][ri]),
+                                     "meta": metas[ri], "subs": {}}
+                               for ri, key in enumerate(rkeys)}
+                    results[0].agg_partials[an.name] = [{
+                        "buckets": buckets}]
+                    continue
                 if an.kind == "terms":
                     counts = tcounts_by_field[an.body["field"]][bi]
                     vocab = tvocab_by_field[an.body["field"]]
@@ -769,6 +965,24 @@ class MeshSearchService:
                 if isinstance(order, dict) and len(order) == 1 and \
                         next(iter(order)) in ("_count", "_key"):
                     continue
+            # r5: histogram family as a device bincount over host-built
+            # global bin ids; `range` as per-range masked sums (ranges
+            # may overlap). Calendar date intervals -> host loop.
+            if an.kind == "histogram" and set(an.body) <= \
+                    {"field", "interval", "offset", "min_doc_count"} \
+                    and float(an.body.get("interval", 0)) > 0:
+                continue
+            if an.kind == "date_histogram" \
+                    and not an.body.get("calendar_interval") \
+                    and set(an.body) <= {"field", "fixed_interval",
+                                         "interval", "offset",
+                                         "min_doc_count"}:
+                continue
+            if an.kind == "range" and set(an.body) <= \
+                    {"field", "ranges", "keyed"} \
+                    and 1 <= len(an.body.get("ranges") or []) \
+                    <= MAX_MESH_RANGES:
+                continue
             return None
         if window > MAX_WINDOW or (window < 1 and not agg_nodes):
             return None

@@ -488,6 +488,108 @@ def build_distributed_terms_agg(mesh: Mesh, bucket: int, ndocs_pad: int,
     return jax.jit(fn)
 
 
+def build_distributed_bincount(mesh: Mesh, bucket: int, ndocs_pad: int,
+                               nb: int, k1: float = 1.2, b: float = 0.75,
+                               filtered: bool = False):
+    """Histogram / fixed-interval date_histogram over the mesh: re-evaluate
+    each query's match mask shard-locally, scatter-add it over a
+    host-precomputed per-doc bin-id array (global bin space; -1 = no value
+    or out of range), and psum the counts — the distributed analog of the
+    host 'hist' kernel (`search/compiler.py` emit_agg "hist") + the
+    coordinator reduce. Returns a callable:
+        (tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB],
+         bins i32[S, D_pad] [, fmask]) -> i32[QB, nb] global counts."""
+
+    def per_device(tree, rows, boosts, msm, cscore, bins, fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        bn = bins[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+        b_safe = jnp.where(bn >= 0, bn, nb)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            matched = (scores > -jnp.inf).astype(jnp.int32)
+            contrib = jnp.where(bn >= 0, matched, 0)
+            return jnp.zeros(nb, jnp.int32).at[b_safe].add(contrib,
+                                                           mode="drop")
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return jax.lax.psum(part, "shard")
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
+def build_distributed_range_counts(mesh: Mesh, bucket: int, ndocs_pad: int,
+                                   nr: int, k1: float = 1.2,
+                                   b: float = 0.75,
+                                   filtered: bool = False):
+    """`range` aggregation over the mesh: per-range [lo, hi) masked count
+    of matching docs (ranges may OVERLAP, so this is nr masked sums, not a
+    bincount), psum'd over the shard axis. Returns a callable:
+        (tree, rows, boosts, msm, cscore, col [S,D], pres [S,D],
+         lows f32[nr], highs f32[nr] [, fmask]) -> i32[QB, nr]."""
+
+    def per_device(tree, rows, boosts, msm, cscore, col, pres, lows, highs,
+                   fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        cv = col[0]
+        pr = pres[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            matched = (scores > -jnp.inf) & (pr > 0)
+            counts = []
+            for ri in range(nr):
+                sel = matched & (cv >= lows[ri]) & (cv < highs[ri])
+                counts.append(jnp.sum(sel.astype(jnp.int32)))
+            return jnp.stack(counts)
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return jax.lax.psum(part, "shard")
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"),
+                P(), P())
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
 @dataclass
 class StackedPhrasePairs:
     """Per-shard positional (doc, position) pair arrays in the SAME

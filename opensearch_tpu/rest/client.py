@@ -142,7 +142,14 @@ class RestClient:
             new_index = body.pop("_index", None)
             if new_index and new_index != index:
                 from ..security.context import authorize_index_if_active
-                authorize_index_if_active(new_index, "write")
+                from ..security.identity import AuthorizationError
+                try:
+                    authorize_index_if_active(new_index, "write")
+                except AuthorizationError as e:
+                    # ApiError so bulk reports it PER ITEM (committed
+                    # siblings stay committed, like the reference's
+                    # per-item security failures)
+                    raise ApiError(403, "security_exception", str(e))
                 index = new_index
                 svc = self._svc_for_write(index)
                 self._check_write_block(svc)

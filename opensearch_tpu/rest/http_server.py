@@ -462,7 +462,14 @@ class _Handler(BaseHTTPRequestHandler):
             # reference RestPutPipelineAction / RestGetPipelineAction /
             # RestDeletePipelineAction / RestSimulatePipelineAction
             if parts[-1] == "_simulate":
-                return 200, c.ingest.simulate(self._json_body() or {})
+                body = self._json_body() or {}
+                if len(parts) > 3:       # simulate the STORED pipeline
+                    cfg = c.node.ingest.configs.get(parts[2])
+                    if cfg is None:
+                        raise ApiError(404, "resource_not_found_exception",
+                                       f"pipeline [{parts[2]}] not found")
+                    body = {"pipeline": cfg, "docs": body.get("docs", [])}
+                return 200, c.ingest.simulate(body)
             pid = parts[2] if len(parts) > 2 else None
             if method == "PUT":
                 if pid is None:
@@ -470,6 +477,9 @@ class _Handler(BaseHTTPRequestHandler):
                                    "pipeline id required")
                 return 200, c.ingest.put_pipeline(pid, self._json_body())
             if method == "DELETE":
+                if pid is None:
+                    raise ApiError(400, "illegal_argument_exception",
+                                   "pipeline id required")
                 return 200, c.ingest.delete_pipeline(pid)
             return 200, c.ingest.get_pipeline(pid)
         if head == "_aliases" and method == "POST":

@@ -600,15 +600,27 @@ class TestMeshService:
         assert cm.node.mesh_service.terms_agg_dispatched >= 1
         assert rm["aggregations"] == rh["aggregations"]
 
-    def test_histogram_aggs_fall_back(self, clients):
+    def test_histogram_aggs_dispatch_with_parity(self, clients):
+        # r5: histograms now reduce ON the mesh (device bincount + psum);
+        # sub-agg'd histograms still fall back
         cm, ch = clients
         body = {"query": {"match": {"body": "alpha"}}, "size": 3,
                 "aggs": {"h": {"histogram": {"field": "num",
                                              "interval": 10}}}}
-        before = cm.node.mesh_service.fallbacks
+        before = cm.node.mesh_service.dispatched
         rm = cm.search(index="idx", body=dict(body))
         rh = ch.search(index="idx", body=dict(body))
-        assert cm.node.mesh_service.fallbacks > before
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert rm["aggregations"] == rh["aggregations"]
+        subbed = {"query": {"match": {"body": "alpha"}}, "size": 3,
+                  "aggs": {"h": {"histogram": {"field": "num",
+                                               "interval": 10},
+                                 "aggs": {"m": {"avg": {
+                                     "field": "num"}}}}}}
+        f0 = cm.node.mesh_service.fallbacks
+        rm = cm.search(index="idx", body=dict(subbed))
+        rh = ch.search(index="idx", body=dict(subbed))
+        assert cm.node.mesh_service.fallbacks == f0 + 1
         assert rm["aggregations"] == rh["aggregations"]
 
     def test_msearch_batches_through_mesh(self, clients):
@@ -675,3 +687,87 @@ class TestMeshService:
             assert rm["hits"]["total"] == rh["hits"]["total"]
             assert [h["_id"] for h in rm["hits"]["hits"]] == \
                 [h["_id"] for h in rh["hits"]["hits"]]
+
+
+class TestMeshBucketAggs:
+    """r5: histogram / fixed-interval date_histogram / range aggs reduce
+    on the mesh (device bincount + per-range masked sums, psum)."""
+
+    @pytest.fixture(scope="class")
+    def clients(self):
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        cm = RestClient(node=Node(mesh_service=MeshSearchService()))
+        ch = RestClient()
+        for c in (cm, ch):
+            rng = np.random.default_rng(7)
+            c.indices.create("hx", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "body": {"type": "text"}, "num": {"type": "integer"},
+                    "ts": {"type": "date"}}}})
+            bulk = []
+            for i in range(800):
+                bulk.append({"index": {"_index": "hx", "_id": str(i)}})
+                bulk.append({
+                    "body": " ".join(rng.choice(WORDS,
+                                                size=int(rng.integers(3, 9)))),
+                    "num": int(rng.integers(0, 500)),
+                    "ts": f"2026-07-{(i % 28) + 1:02d}T03:00:00Z"})
+            c.bulk(bulk)
+            c.indices.refresh("hx")
+            c.indices.forcemerge("hx")
+        return cm, ch
+
+    @pytest.mark.parametrize("aggs", [
+        {"h": {"histogram": {"field": "num", "interval": 50}}},
+        {"h": {"histogram": {"field": "num", "interval": 25,
+                             "offset": 10}}},
+        {"d": {"date_histogram": {"field": "ts", "fixed_interval": "7d"}}},
+        {"r": {"range": {"field": "num", "ranges": [
+            {"to": 100}, {"from": 100, "to": 300},
+            {"from": 250, "key": "high"}]}}},   # overlapping + keyed
+        {"h": {"histogram": {"field": "num", "interval": 100}},
+         "r": {"range": {"field": "num", "ranges": [{"from": 0}]}},
+         "s": {"sum": {"field": "num"}}},
+    ])
+    def test_bucket_agg_parity(self, clients, aggs):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 5,
+                "aggs": aggs}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the bucket-agg body"
+        assert rm["hits"]["total"] == rh["hits"]["total"]
+        for aname in aggs:
+            assert rm["aggregations"][aname] == rh["aggregations"][aname], \
+                (aname, rm["aggregations"][aname], rh["aggregations"][aname])
+
+    def test_filtered_bucket_agg_parity(self, clients):
+        cm, ch = clients
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "gamma"}}],
+            "filter": [{"range": {"num": {"gte": 100}}}]}},
+            "size": 5,
+            "aggs": {"h": {"histogram": {"field": "num",
+                                         "interval": 100}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert rm["aggregations"]["h"] == rh["aggregations"]["h"]
+
+    def test_calendar_interval_falls_back(self, clients):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 5,
+                "aggs": {"d": {"date_histogram": {
+                    "field": "ts", "calendar_interval": "month"}}}}
+        f0 = cm.node.mesh_service.fallbacks
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.fallbacks == f0 + 1
+        assert rm["aggregations"]["d"] == rh["aggregations"]["d"]

@@ -266,7 +266,6 @@ class TestAuthzHardening:
         # writing via the alias must check the CONCRETE index too
         s, _ = req(secured, "PUT", "/private-idx", user="admin:adminpass")
         assert s == 200
-        srv_client = None
         # route alias creation through the admin API
         s, _ = req(secured, "POST", "/_aliases", {
             "actions": [{"add": {"index": "private-idx",
