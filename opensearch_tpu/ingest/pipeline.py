@@ -5,6 +5,7 @@ before the shard bulk)."""
 
 from __future__ import annotations
 
+import copy
 import datetime as _dt
 import re
 import threading as _threading
@@ -301,6 +302,9 @@ def _grok_compile(pattern: str) -> re.Pattern:
 class Pipeline:
     def __init__(self, pid: str, config: dict, service=None):
         self.id = pid
+        # deep-copy: callers keep (and may mutate) their dict; GET /
+        # _simulate must reflect only what was actually PUT
+        self.config = copy.deepcopy(config)
         self.description = config.get("description", "")
         self.processors: List[tuple] = []
         for pspec in config.get("processors", []):
@@ -330,15 +334,12 @@ class Pipeline:
 class IngestService:
     def __init__(self):
         self.pipelines: Dict[str, Pipeline] = {}
-        self.configs: Dict[str, dict] = {}
 
     def put_pipeline(self, pid: str, config: dict) -> None:
         self.pipelines[pid] = Pipeline(pid, config, service=self)
-        self.configs[pid] = config
 
     def delete_pipeline(self, pid: str) -> None:
         self.pipelines.pop(pid, None)
-        self.configs.pop(pid, None)
 
     def get_pipeline(self, pid: str) -> Optional[Pipeline]:
         return self.pipelines.get(pid)

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..index.segment import next_pow2
+from ..search.compiler import hist_agg_interval, range_agg_spec
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
                    build_distributed_bincount, build_distributed_metrics,
                    build_distributed_phrase, build_distributed_range_counts,
@@ -242,19 +243,8 @@ class MeshSearchService:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..search.compiler import parse_interval_ms
-
         field = an.body["field"]
-        if an.kind == "date_histogram":
-            interval = float(parse_interval_ms(
-                an.body.get("fixed_interval", an.body.get("interval",
-                                                          "1d"))))
-            offset = (float(parse_interval_ms(an.body.get("offset", 0),
-                                              allow_negative=True))
-                      if an.body.get("offset") else 0.0)
-        else:
-            interval = float(an.body["interval"])
-            offset = float(an.body.get("offset", 0.0))
+        interval, offset = hist_agg_interval(an.kind, an.body)
         if interval <= 0:
             return None
         key = (name, field, an.kind, interval, offset)
@@ -665,8 +655,10 @@ class MeshSearchService:
         # metric aggs: one psum/pmin/pmax reduce per distinct field over
         # the whole batch (items without that agg just ignore its column);
         # terms aggs: one exact bincount+psum per distinct keyword field
-        metric_fields = sorted({an.body["field"] for it in items
-                                for an in it[5] if an.kind != "terms"})
+        metric_fields = sorted({
+            an.body["field"] for it in items for an in it[5]
+            if an.kind not in ("terms", "histogram", "date_histogram",
+                               "range")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5] if an.kind == "terms"})
         metrics_by_field = {}
@@ -693,15 +685,18 @@ class MeshSearchService:
         # histogram family: one bincount program per distinct
         # (field, interval, offset); range: per-range masked sums
         def _hist_key(an):
-            return (an.kind, an.body["field"],
-                    str(an.body.get("interval",
-                                    an.body.get("fixed_interval"))),
-                    str(an.body.get("offset", 0)))
+            # key on the PARSED (interval, offset) floats, via the same
+            # shared resolver _bins_for uses: semantically equal aggs share
+            # one device run, distinct aggs never alias one cache entry
+            interval, offset = hist_agg_interval(an.kind, an.body)
+            return (an.kind, an.body["field"], interval, offset)
 
         def _range_key(an):
-            return (an.body["field"],
-                    tuple((str(r.get("from")), str(r.get("to")))
-                          for r in an.body["ranges"]))
+            # bucket keys are part of the RESPONSE, so custom "key" labels
+            # must be part of the cache key too
+            _, _, rkeys, metas = range_agg_spec(an.body["ranges"])
+            return (an.body["field"], tuple(rkeys),
+                    tuple((m.get("from"), m.get("to")) for m in metas))
 
         hist_results = {}
         range_results = {}
@@ -727,32 +722,14 @@ class MeshSearchService:
                     col, pres = self._col_for(name, svc, an.body["field"],
                                               shard_segs,
                                               stacked.ndocs_pad, mesh)
-                    ranges = an.body["ranges"]
-                    nr = len(ranges)
-                    lows = np.full(nr, -np.inf, np.float32)
-                    highs = np.full(nr, np.inf, np.float32)
-                    rkeys, metas = [], []
-                    for ri, r in enumerate(ranges):
-                        frm, to = r.get("from"), r.get("to")
-                        if frm is not None:
-                            lows[ri] = float(frm)
-                        if to is not None:
-                            highs[ri] = float(to)
-                        rkeys.append(r.get(
-                            "key",
-                            f"{frm if frm is not None else '*'}-"
-                            f"{to if to is not None else '*'}"))
-                        meta = {}
-                        if frm is not None:
-                            meta["from"] = float(frm)
-                        if to is not None:
-                            meta["to"] = float(to)
-                        metas.append(meta)
+                    lows, highs, rkeys, metas = range_agg_spec(
+                        an.body["ranges"])
                     rfn = self._range_program_for(
-                        mesh, bucket, stacked.ndocs_pad, nr, k1, b_eff,
-                        filtered)
+                        mesh, bucket, stacked.ndocs_pad, len(rkeys), k1,
+                        b_eff, filtered)
                     rargs = (stacked.tree(), rows, boosts, msm, cscore,
-                             col, pres, lows, highs)                         + ((fmask,) if filtered else ())
+                             col, pres, lows, highs) \
+                        + ((fmask,) if filtered else ())
                     range_results[rk] = (rfn(*rargs), rkeys, metas)
         fetched = jax.device_get((gdocs_b, gvals_b, totals_b,
                                   metrics_by_field, tcounts_by_field,

@@ -8,6 +8,7 @@ search fans out over shard searchers and reduces like the coordinator node.
 
 from __future__ import annotations
 
+import copy
 import json
 import time
 import uuid
@@ -1603,8 +1604,9 @@ class IngestClient:
             if p is None:
                 raise ApiError(404, "resource_not_found_exception",
                                f"pipeline [{id}] not found")
-            return {id: {"description": p.description}}
-        return {pid: {"description": p.description} for pid, p in svc.pipelines.items()}
+            return {id: copy.deepcopy(p.config)}
+        return {pid: copy.deepcopy(p.config)
+                for pid, p in svc.pipelines.items()}
 
     def delete_pipeline(self, id: str) -> dict:
         self.c.node.ingest.delete_pipeline(id)

@@ -464,11 +464,12 @@ class _Handler(BaseHTTPRequestHandler):
             if parts[-1] == "_simulate":
                 body = self._json_body() or {}
                 if len(parts) > 3:       # simulate the STORED pipeline
-                    cfg = c.node.ingest.configs.get(parts[2])
-                    if cfg is None:
+                    p = c.node.ingest.get_pipeline(parts[2])
+                    if p is None:
                         raise ApiError(404, "resource_not_found_exception",
                                        f"pipeline [{parts[2]}] not found")
-                    body = {"pipeline": cfg, "docs": body.get("docs", [])}
+                    body = {"pipeline": p.config,
+                            "docs": body.get("docs", [])}
                 return 200, c.ingest.simulate(body)
             pid = parts[2] if len(parts) > 2 else None
             if method == "PUT":

@@ -3509,6 +3509,50 @@ def _kw_doc_counts(seg: Segment, field: str) -> Dict[str, int]:
     return out
 
 
+def hist_agg_interval(kind: str, body: dict) -> Tuple[float, float]:
+    """Shared host/mesh resolution of a histogram-family agg's (interval,
+    offset) in value space (ms for dates; fixed_interval preferred).
+    Single source of truth — the mesh service keys its device-program cache
+    on this and must never drift from the binning itself."""
+    if kind == "date_histogram":
+        interval = float(parse_interval_ms(
+            body.get("fixed_interval", body.get("interval", "1d"))))
+        offset = (float(parse_interval_ms(body.get("offset", 0),
+                                          allow_negative=True))
+                  if body.get("offset") else 0.0)
+    else:
+        interval = float(body["interval"])
+        offset = float(body.get("offset", 0.0))
+    return interval, offset
+
+
+def range_agg_spec(ranges: List[dict]) -> tuple:
+    """Shared host/mesh construction of a plain `range` agg's f32 bounds,
+    bucket keys, and from/to response meta (f32-roundtripped so host and
+    mesh responses are bit-identical). Single source of truth: the mesh
+    service (`parallel/service.py`) serves the same aggs and must never
+    drift from this formatting."""
+    nr = len(ranges)
+    lows = np.full(nr, -np.inf, dtype=np.float32)
+    highs = np.full(nr, np.inf, dtype=np.float32)
+    keys, metas = [], []
+    for i, r in enumerate(ranges):
+        frm, to = r.get("from"), r.get("to")
+        if frm is not None:
+            lows[i] = float(frm)
+        if to is not None:
+            highs[i] = float(to)
+        keys.append(r.get("key", f"{frm if frm is not None else '*'}-"
+                                 f"{to if to is not None else '*'}"))
+        meta = {}
+        if frm is not None:
+            meta["from"] = float(np.float32(frm))
+        if to is not None:
+            meta["to"] = float(np.float32(to))
+        metas.append(meta)
+    return lows, highs, keys, metas
+
+
 def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
                 prefix: str, nest_stack: Tuple = ()):  # noqa: C901
     """-> hashable agg spec; params filled per segment. `prefix` keys params.
@@ -3568,22 +3612,17 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
     if kind in ("range", "date_range"):
         field = _resolve_agg_field(node, ctx)
         ranges = body.get("ranges", [])
-        lows = np.full(len(ranges), -np.inf, dtype=np.float32)
-        highs = np.full(len(ranges), np.inf, dtype=np.float32)
-        keys = []
-        ft = ctx.mappings.resolve_field(field)
-        for i, r in enumerate(ranges):
-            frm = r.get("from")
-            to = r.get("to")
-            if kind == "date_range":
-                frm = coerce_value(ft, frm) if frm is not None else None
-                to = coerce_value(ft, to) if to is not None else None
-            if frm is not None:
-                lows[i] = float(frm)
-            if to is not None:
-                highs[i] = float(to)
-            keys.append(r.get("key", f"{frm if frm is not None else '*'}-"
-                                     f"{to if to is not None else '*'}"))
+        if kind == "date_range":
+            ft = ctx.mappings.resolve_field(field)
+            coerced = []
+            for r in ranges:
+                r2 = dict(r)
+                for end in ("from", "to"):
+                    if r.get(end) is not None:
+                        r2[end] = coerce_value(ft, r[end])
+                coerced.append(r2)
+            ranges = coerced
+        lows, highs, keys, _metas = range_agg_spec(ranges)
         params[f"{prefix}_lows"] = lows
         params[f"{prefix}_highs"] = highs
         col_exists = field in seg.numeric_cols

@@ -90,19 +90,38 @@ def enabled() -> bool:
             and not os.environ.get("OPENSEARCH_TPU_NO_FASTPATH"))
 
 
-def _frontier(tfs: np.ndarray, dls: np.ndarray
-              ) -> Tuple[np.ndarray, np.ndarray]:
+def _frontier(tfs: np.ndarray, dls: np.ndarray, ids: np.ndarray = None
+              ) -> tuple:
     """(tf -> min dl over docs with that tf) of a posting set — its Pareto
     frontier under the BM25 contribution tf/(tf+k(dl)), which is increasing
     in tf and decreasing in dl. The max contribution of the set under ANY
     (k1, b, avgdl) is attained on this frontier, so ~a dozen (tf, dl) pairs
-    give an EXACT set bound for every query-time similarity."""
+    give an EXACT set bound for every query-time similarity.
+
+    With `ids`, additionally returns per frontier point TWO tie witnesses:
+    the MIN doc id among postings attaining the point exactly (tf == tf_i
+    and dl == min dl — the attainer set when length norms matter) and the
+    MIN doc id over the whole tf class (the attainer set when b_eff ~ 0
+    makes dl irrelevant). The verifier needs these to prove a boundary TIE
+    non-displacing under the (score desc, doc asc) result order."""
     if len(tfs) == 0:
-        return (np.zeros(0, np.float32), np.zeros(0, np.float32))
+        z = np.zeros(0, np.float32)
+        zi = np.zeros(0, np.int64)
+        return (z, z) if ids is None else (z, z, zi, zi)
     tf = tfs.astype(np.int64)
+    dl_s32 = dls.astype(np.float32)
+    if ids is not None:
+        order = np.lexsort((ids, dl_s32, tf))
+        tf_s = tf[order]
+        id_s = ids[order].astype(np.int64)
+        first = np.flatnonzero(
+            np.concatenate(([True], tf_s[1:] != tf_s[:-1])))
+        id_any = np.minimum.reduceat(id_s, first)
+        return (tf_s[first].astype(np.float32), dl_s32[order][first],
+                id_s[first], id_any)
     order = np.argsort(tf, kind="stable")
     tf_s = tf[order]
-    dl_s = dls.astype(np.float32)[order]
+    dl_s = dl_s32[order]
     # min dl per distinct tf via reduceat
     heads = np.flatnonzero(np.concatenate(([True], tf_s[1:] != tf_s[:-1])))
     return (tf_s[heads].astype(np.float32),
@@ -112,7 +131,7 @@ def _frontier(tfs: np.ndarray, dls: np.ndarray
 def _frontier_bound(fr: Tuple[np.ndarray, np.ndarray], k1: float,
                     b_eff: float, avgdl: float) -> float:
     """Max contribution tf/(tf+k1·(1-b+b·dl/avgdl)) over a frontier."""
-    tf, dl = fr
+    tf, dl = fr[0], fr[1]
     if len(tf) == 0:
         return 0.0
     k = k1 * (1.0 - b_eff + b_eff * dl / max(avgdl, 1e-9))
@@ -202,7 +221,7 @@ def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray
     order = np.argsort(-c, kind="stable")
     keep = order[:L_HEAD]
     rest = order[L_HEAD:]
-    return np.sort(keep), _frontier(tf[rest], dlf[rest])
+    return np.sort(keep), _frontier(tf[rest], dlf[rest], doc_ids[rest])
 
 
 def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
@@ -705,8 +724,16 @@ def _unseen_bound(al: AlignedPostings, pb, dl_col, vq: _VQuery,
       - in-head part: <= partial_k (it lost the kernel top-K) AND
                       <= sum_{t not in S} w_t * full_bound_t
       - remainder:    <= sum_{t in S} miss_t  (exact frontier bounds)
-    Take min of the two in-head bounds per subset, max over subsets. With
-    S = {} the doc is fully scored by the kernel: bounded by partial_k."""
+    Take min of the two in-head bounds per subset, max over NONEMPTY
+    subsets. S = {} (doc fully scored by the kernel but outside its top-K)
+    is NOT a displacement threat when msm == 1: every candidate's exact
+    score dominates its kernel score, so theta >= partial_k and the kernel
+    already ranked the loser under the (score desc, doc asc) result order —
+    it sorts strictly after every window member even on an exact tie.
+    With msm > 1 that argument breaks (the kernel collects with msm
+    relaxed to 1, and the host msm filter can drop high-kernel-score
+    candidates, pushing theta BELOW partial_k), so the S = {} bound must
+    stay in."""
     T = len(vq.rows)
     cl = [i for i in range(T) if vq.miss is not None and vq.miss[i] > 0.0]
     # per-term single-posting bounds (lazy frontier, cached on the layout)
@@ -715,13 +742,54 @@ def _unseen_bound(al: AlignedPostings, pb, dl_col, vq: _VQuery,
         if r >= 0:
             fb[i] = vq.weights[i] * al.full_bound(
                 pb, int(r), vq.k1, vq.b_eff, float(vq.avgdl), dl_col)
-    best = partial_k
+    best = partial_k if vq.msm_true > 1.0 else -np.inf
     for mask in range(1, 1 << len(cl)):
         in_s = [cl[j] for j in range(len(cl)) if mask >> j & 1]
         rem_part = float(sum(vq.miss[i] for i in in_s))
         inhead = float(sum(fb[i] for i in range(T) if i not in in_s))
         best = max(best, min(partial_k + rem_part, inhead + rem_part))
     return best
+
+
+def _tie_serves(al: AlignedPostings, vq: _VQuery, theta: float,
+                cand: np.ndarray, order: np.ndarray, window: int) -> bool:
+    """Boundary-tie witness for SINGLE-term pruned queries: when the unseen
+    bound exactly ties theta, the only docs that can attain it are remainder
+    postings on the frontier points whose contribution equals the bound.
+    The frontier stores the MIN doc id attaining each point; head selection
+    is a stable impact sort (ties keep doc-ascending order), so those ids
+    are typically larger than every in-head tie.  A tying unseen doc
+    displaces the window iff its id sorts before the window's worst member —
+    so min attaining id > id(window[-1]) proves the served page exact."""
+    if len(vq.rows) != 1 or theta == -np.inf:
+        return False
+    fr = al.rem_frontiers.get(int(vq.rows[0]))
+    if fr is None or len(fr) != 4:
+        return False
+    tfv, dlv, id_dlmin, id_any = fr
+    if len(tfv) == 0:
+        return False
+    # MIRROR `_verify_pruned`'s exact-rescore arithmetic (same dtypes, same
+    # op order) so tie detection is BIT-exact in the f32 domain theta lives
+    # in: any frontier point strictly above theta escalates; only bit-equal
+    # points count as attainers needing the id witness
+    avg = max(float(vq.avgdl), 1e-9)
+    kfac = vq.k1 * (1.0 - vq.b_eff + vq.b_eff * dlv / avg)
+    contrib = vq.weights[0] * tfv / (tfv + kfac)
+    theta32 = np.float32(theta)
+    if np.any(contrib > theta32):
+        return False                      # genuinely above: real displacer
+    att = contrib == theta32
+    if not att.any():
+        return True                       # no remainder doc reaches theta
+    # the dl_min witness covers a point only when one dl step strictly
+    # lowers the f32 contribution (then no longer-doc posting can tie);
+    # otherwise fall back to the whole-tf-class min id (always sound)
+    kfac2 = vq.k1 * (1.0 - vq.b_eff
+                     + vq.b_eff * (dlv + np.float32(1.0)) / avg)
+    contrib2 = vq.weights[0] * tfv / (tfv + kfac2)
+    ids = np.where(contrib2 < contrib, id_dlmin, id_any)
+    return int(ids[att].min()) > int(cand[order[window - 1]])
 
 
 def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
@@ -775,9 +843,13 @@ def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
              else -np.inf)
     # >= not >: the frontier bounds are ATTAINED by real docs, so an unseen
     # doc can tie theta exactly and would deserve the window slot under the
-    # doc-id tie-break — equality must escalate to the dense pass
+    # doc-id tie-break — equality must escalate to the dense pass, UNLESS
+    # the tie witness below proves every attaining doc sorts after the
+    # window boundary (single-term case: score quantization makes boundary
+    # ties the COMMON case, and escalating on them re-runs dense every time)
     if bound >= theta:
-        return None
+        if not _tie_serves(al, vq, theta, cand, order, window):
+            return None
     keep = order[pass_msm[order]][:K]
     sc2 = np.full(K, -np.inf, np.float32)
     dc2 = np.full(K, -1, np.int32)

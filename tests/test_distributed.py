@@ -761,6 +761,42 @@ class TestMeshBucketAggs:
         assert cm.node.mesh_service.dispatched == before + 1
         assert rm["aggregations"]["h"] == rh["aggregations"]["h"]
 
+    def test_distinct_hist_aggs_do_not_alias(self, clients):
+        # regression: the program cache key must resolve the interval the
+        # same way _bins_for does (fixed_interval first), or these two
+        # aggs alias one entry and the second silently reuses 1d bins
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {
+                    "a": {"date_histogram": {"field": "ts",
+                                             "interval": "1d"}},
+                    "b": {"date_histogram": {"field": "ts",
+                                             "interval": "1d",
+                                             "fixed_interval": "7d"}}}}
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert rm["aggregations"]["a"] == rh["aggregations"]["a"]
+        assert rm["aggregations"]["b"] == rh["aggregations"]["b"]
+        assert rm["aggregations"]["a"] != rm["aggregations"]["b"]
+
+    def test_range_custom_keys_do_not_alias(self, clients):
+        # regression: two range aggs with identical bounds but different
+        # custom "key" labels must not share one cached batch entry
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {
+                    "a": {"range": {"field": "num",
+                                    "ranges": [{"to": 50, "key": "low"}]}},
+                    "b": {"range": {"field": "num",
+                                    "ranges": [{"to": 50,
+                                                "key": "small"}]}}}}
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert rm["aggregations"]["a"] == rh["aggregations"]["a"]
+        assert rm["aggregations"]["b"] == rh["aggregations"]["b"]
+        assert rm["aggregations"]["a"]["buckets"][0]["key"] == "low"
+        assert rm["aggregations"]["b"]["buckets"][0]["key"] == "small"
+
     def test_calendar_interval_falls_back(self, clients):
         cm, ch = clients
         body = {"query": {"match": {"body": "alpha"}}, "size": 5,
