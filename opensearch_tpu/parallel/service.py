@@ -26,7 +26,9 @@ from ..index.segment import next_pow2
 from ..search.compiler import hist_agg_interval, range_agg_spec
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
                    build_distributed_bincount, build_distributed_metrics,
-                   build_distributed_phrase, build_distributed_range_counts,
+                   build_distributed_pair_metrics, build_distributed_phrase,
+                   build_distributed_range_counts,
+                   build_distributed_range_metrics,
                    build_distributed_search, build_distributed_terms_agg,
                    make_mesh)
 
@@ -98,6 +100,8 @@ class MeshSearchService:
         self._phrase_programs: Dict[Tuple, object] = {}
         self._hist_programs: Dict[Tuple, object] = {}
         self._range_programs: Dict[Tuple, object] = {}
+        self._pair_metrics_programs: Dict[Tuple, object] = {}
+        self._range_metrics_programs: Dict[Tuple, object] = {}
         # (index, field) -> (generation, arrays-or-None)
         self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
         # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
@@ -230,6 +234,30 @@ class MeshSearchService:
                                                 k1=k1, b=b,
                                                 filtered=filtered)
             self._range_programs[key] = fn
+        return fn
+
+    def _pair_metrics_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                                  vpad: int, k1: float, b: float,
+                                  filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, vpad, k1, b, filtered)
+        fn = self._pair_metrics_programs.get(key)
+        if fn is None:
+            fn = build_distributed_pair_metrics(
+                mesh, bucket=bucket, ndocs_pad=ndocs_pad, vpad=vpad,
+                k1=k1, b=b, filtered=filtered)
+            self._pair_metrics_programs[key] = fn
+        return fn
+
+    def _range_metrics_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                                   nr: int, k1: float, b: float,
+                                   filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, nr, k1, b, filtered)
+        fn = self._range_metrics_programs.get(key)
+        if fn is None:
+            fn = build_distributed_range_metrics(
+                mesh, bucket=bucket, ndocs_pad=ndocs_pad, nr=nr,
+                k1=k1, b=b, filtered=filtered)
+            self._range_metrics_programs[key] = fn
         return fn
 
     def _bins_for(self, name: str, svc, an, shard_segs, d_pad: int, mesh
@@ -606,6 +634,12 @@ class MeshSearchService:
                 else:
                     got = self._col_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
+                for sub in an.subs:
+                    if got is None:
+                        break
+                    got = self._col_for(name, svc, sub.body["field"],
+                                        shard_segs, stacked.ndocs_pad,
+                                        mesh)
                 if got is None:
                     agg_ok = False
                     break
@@ -673,6 +707,10 @@ class MeshSearchService:
                 metrics_by_field[f] = mfn(*margs)
         tcounts_by_field = {}
         tvocab_by_field = {}
+        tsub_results = {}     # (terms_field, metric_field) -> [QB, vpad, 5]
+        terms_subs = sorted({(an.body["field"], s.body["field"])
+                             for it in items for an in it[5]
+                             if an.kind == "terms" for s in an.subs})
         for f in terms_fields:
             val_doc, val_ord, vocab, vpad = self._ord_for(
                 name, svc, f, shard_segs, stacked.ndocs_pad, mesh)
@@ -682,6 +720,21 @@ class MeshSearchService:
                      val_ord) + ((fmask,) if filtered else ())
             tcounts_by_field[f] = tfn(*targs)
             tvocab_by_field[f] = vocab
+            # per-bucket metric sub-aggs: one pair-metrics launch per
+            # (terms field, metric field), shared by every body in the
+            # batch that nests that metric under that parent
+            for tf, mf in terms_subs:
+                if tf != f:
+                    continue
+                mcol, mpres = self._col_for(name, svc, mf, shard_segs,
+                                            stacked.ndocs_pad, mesh)
+                pmfn = self._pair_metrics_program_for(
+                    mesh, bucket, stacked.ndocs_pad, vpad, k1, b_eff,
+                    filtered)
+                pmargs = (stacked.tree(), rows, boosts, msm, cscore,
+                          val_doc, val_ord, mcol, mpres) \
+                    + ((fmask,) if filtered else ())
+                tsub_results[(f, mf)] = pmfn(*pmargs)
         # histogram family: one bincount program per distinct
         # (field, interval, offset); range: per-range masked sums
         def _hist_key(an):
@@ -699,82 +752,151 @@ class MeshSearchService:
                     tuple((m.get("from"), m.get("to")) for m in metas))
 
         hist_results = {}
+        hist_bins = {}        # hist key -> device bins (sub-agg pair input)
+        hist_pairs = {}       # hist key -> (val_doc, val_ord) device pairs
         range_results = {}
+        hsub_results = {}     # (hist key, metric field) -> [QB, nb, 5]
+        rsub_results = {}     # (range key, metric field) -> [QB, nr, 5]
         for it in items:
             for an in it[5]:
                 if an.kind in ("histogram", "date_histogram"):
                     hk = _hist_key(an)
-                    if hk in hist_results:
-                        continue
-                    bins_dev, min_b, nb, interval, offset = self._bins_for(
-                        name, svc, an, shard_segs, stacked.ndocs_pad, mesh)
-                    hfn = self._hist_program_for(
-                        mesh, bucket, stacked.ndocs_pad, nb, k1, b_eff,
-                        filtered)
-                    hargs = (stacked.tree(), rows, boosts, msm, cscore,
-                             bins_dev) + ((fmask,) if filtered else ())
-                    hist_results[hk] = (hfn(*hargs), min_b, nb, interval,
-                                        offset)
+                    if hk not in hist_results:
+                        (bins_dev, min_b, nb, interval,
+                         offset) = self._bins_for(name, svc, an, shard_segs,
+                                                  stacked.ndocs_pad, mesh)
+                        hfn = self._hist_program_for(
+                            mesh, bucket, stacked.ndocs_pad, nb, k1, b_eff,
+                            filtered)
+                        hargs = (stacked.tree(), rows, boosts, msm, cscore,
+                                 bins_dev) + ((fmask,) if filtered else ())
+                        hist_results[hk] = (hfn(*hargs), min_b, nb,
+                                            interval, offset)
+                        hist_bins[hk] = bins_dev
+                    for s in an.subs:
+                        skey = (hk, s.body["field"])
+                        if skey in hsub_results:
+                            continue
+                        nb = hist_results[hk][2]
+                        if hk not in hist_pairs:
+                            # bin-id pairs reused by every metric sub
+                            # under this histogram: (local doc, bin) with
+                            # sentinel docs for unbinned slots
+                            import jax.numpy as jnp
+                            bins_dev = hist_bins[hk]
+                            hist_pairs[hk] = (
+                                jnp.where(
+                                    bins_dev >= 0,
+                                    jnp.arange(stacked.ndocs_pad,
+                                               dtype=jnp.int32)[None, :],
+                                    INT32_SENTINEL),
+                                jnp.maximum(bins_dev, 0))
+                        hvd, hvo = hist_pairs[hk]
+                        mcol, mpres = self._col_for(
+                            name, svc, s.body["field"], shard_segs,
+                            stacked.ndocs_pad, mesh)
+                        pmfn = self._pair_metrics_program_for(
+                            mesh, bucket, stacked.ndocs_pad, nb, k1,
+                            b_eff, filtered)
+                        pmargs = (stacked.tree(), rows, boosts, msm,
+                                  cscore, hvd, hvo, mcol, mpres) \
+                            + ((fmask,) if filtered else ())
+                        hsub_results[skey] = pmfn(*pmargs)
                 elif an.kind == "range":
                     rk = _range_key(an)
-                    if rk in range_results:
+                    needed_subs = [s for s in an.subs
+                                   if (rk, s.body["field"])
+                                   not in rsub_results]
+                    if rk in range_results and not needed_subs:
                         continue
+                    lows, highs, rkeys, metas = range_agg_spec(
+                        an.body["ranges"])
                     col, pres = self._col_for(name, svc, an.body["field"],
                                               shard_segs,
                                               stacked.ndocs_pad, mesh)
-                    lows, highs, rkeys, metas = range_agg_spec(
-                        an.body["ranges"])
-                    rfn = self._range_program_for(
-                        mesh, bucket, stacked.ndocs_pad, len(rkeys), k1,
-                        b_eff, filtered)
-                    rargs = (stacked.tree(), rows, boosts, msm, cscore,
-                             col, pres, lows, highs) \
-                        + ((fmask,) if filtered else ())
-                    range_results[rk] = (rfn(*rargs), rkeys, metas)
+                    if rk not in range_results:
+                        rfn = self._range_program_for(
+                            mesh, bucket, stacked.ndocs_pad, len(rkeys),
+                            k1, b_eff, filtered)
+                        rargs = (stacked.tree(), rows, boosts, msm, cscore,
+                                 col, pres, lows, highs) \
+                            + ((fmask,) if filtered else ())
+                        range_results[rk] = (rfn(*rargs), rkeys, metas)
+                    for s in needed_subs:
+                        mcol, mpres = self._col_for(
+                            name, svc, s.body["field"], shard_segs,
+                            stacked.ndocs_pad, mesh)
+                        rmfn = self._range_metrics_program_for(
+                            mesh, bucket, stacked.ndocs_pad, len(rkeys),
+                            k1, b_eff, filtered)
+                        rmargs = (stacked.tree(), rows, boosts, msm,
+                                  cscore, col, pres, lows, highs, mcol,
+                                  mpres) + ((fmask,) if filtered else ())
+                        rsub_results[(rk, s.body["field"])] = rmfn(*rmargs)
         fetched = jax.device_get((gdocs_b, gvals_b, totals_b,
                                   metrics_by_field, tcounts_by_field,
-                                  hist_results, range_results))
+                                  hist_results, range_results,
+                                  tsub_results, hsub_results,
+                                  rsub_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
-         tcounts_by_field, hist_results, range_results) = fetched
+         tcounts_by_field, hist_results, range_results,
+         tsub_results, hsub_results, rsub_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
         # exactly one partial per agg)
+        def _stat_partial(m):
+            # the host metric partial shape (`_merge_stats` input): count,
+            # sum, sumsq always; extrema only meaningful when count > 0
+            cnt = float(m[0])
+            return {"count": cnt, "sum": float(m[1]),
+                    "min": float(m[2]) if cnt > 0 else float("inf"),
+                    "max": float(m[3]) if cnt > 0 else float("-inf"),
+                    "sumsq": float(m[4])}
+
+        def _bucket_subs(an, sub_results, parent_key, bi, j):
+            return {s.name: _stat_partial(
+                        sub_results[(parent_key, s.body["field"])][bi][j])
+                    for s in an.subs}
+
         def attach_aggs(results, bi, aggs):
             for an in aggs:
                 if an.kind in ("histogram", "date_histogram"):
-                    counts, min_b, _nb, interval, offset = \
-                        hist_results[_hist_key(an)]
-                    buckets = {min_b + j: {"doc_count": int(c), "subs": {}}
-                               for j, c in enumerate(counts[bi]) if c > 0}
+                    hk = _hist_key(an)
+                    counts, min_b, _nb, interval, offset = hist_results[hk]
+                    buckets = {min_b + j: {
+                        "doc_count": int(c),
+                        "subs": _bucket_subs(an, hsub_results, hk, bi, j)}
+                        for j, c in enumerate(counts[bi]) if c > 0}
                     results[0].agg_partials[an.name] = [{
                         "buckets": buckets, "interval": interval,
                         "offset": offset}]
                     continue
                 if an.kind == "range":
-                    counts, rkeys, metas = range_results[_range_key(an)]
-                    buckets = {key: {"doc_count": int(counts[bi][ri]),
-                                     "meta": metas[ri], "subs": {}}
-                               for ri, key in enumerate(rkeys)}
+                    rk = _range_key(an)
+                    counts, rkeys, metas = range_results[rk]
+                    buckets = {key: {
+                        "doc_count": int(counts[bi][ri]),
+                        "meta": metas[ri],
+                        "subs": _bucket_subs(an, rsub_results, rk, bi, ri)}
+                        for ri, key in enumerate(rkeys)}
                     results[0].agg_partials[an.name] = [{
                         "buckets": buckets}]
                     continue
                 if an.kind == "terms":
-                    counts = tcounts_by_field[an.body["field"]][bi]
-                    vocab = tvocab_by_field[an.body["field"]]
-                    buckets = {vocab[o]: {"doc_count": int(c)}
-                               for o, c in enumerate(counts[: len(vocab)])
-                               if c > 0}
+                    f = an.body["field"]
+                    counts = tcounts_by_field[f][bi]
+                    vocab = tvocab_by_field[f]
+                    buckets = {vocab[o]: {
+                        "doc_count": int(c),
+                        "subs": _bucket_subs(an, tsub_results, f, bi, o)}
+                        for o, c in enumerate(counts[: len(vocab)])
+                        if c > 0}
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
-                m = metrics_by_field[an.body["field"]][bi]
-                cnt = float(m[0])
-                results[0].agg_partials[an.name] = [{
-                    "count": cnt, "sum": float(m[1]),
-                    "min": float(m[2]) if cnt > 0 else float("inf"),
-                    "max": float(m[3]) if cnt > 0 else float("-inf"),
-                    "sumsq": float(m[4])}]
+                results[0].agg_partials[an.name] = [
+                    _stat_partial(metrics_by_field[an.body["field"]][bi])]
 
         self._emit_mesh_results(name, bodies, out, shard_segs, stats,
                                 searchers, stacked, items, gdocs_b,
@@ -930,11 +1052,23 @@ class MeshSearchService:
         if named_nodes:
             return None
         # metric aggs reduce over the mesh (psum/pmin/pmax); keyword terms
-        # aggs as an exact device bincount; anything else -> host loop
+        # aggs as an exact device bincount; anything else -> host loop.
+        # r5: bucket parents may carry plain {field} METRIC sub-aggs —
+        # per-bucket moments scatter on device (pair/range metrics
+        # programs) exactly like the reference's nested collectors
+        def _subs_ok(an):
+            return all(s.kind in _MESH_METRICS
+                       and set(s.body) == {"field"}
+                       and not s.subs and not s.pipelines
+                       for s in an.subs) and not an.pipelines
+
         for an in (agg_nodes or []):
-            if an.subs:
+            if an.subs and not (
+                    an.kind in ("terms", "histogram", "date_histogram",
+                                "range") and _subs_ok(an)):
                 return None
-            if an.kind in _MESH_METRICS and set(an.body) == {"field"}:
+            if an.kind in _MESH_METRICS and set(an.body) == {"field"} \
+                    and not an.subs:
                 continue
             if an.kind == "terms" and set(an.body) <= \
                     {"field", "size", "min_doc_count", "order"}:

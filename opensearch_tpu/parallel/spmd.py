@@ -538,6 +538,153 @@ def build_distributed_bincount(mesh: Mesh, bucket: int, ndocs_pad: int,
     return jax.jit(fn)
 
 
+def build_distributed_pair_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
+                                   vpad: int, k1: float = 1.2,
+                                   b: float = 0.75,
+                                   filtered: bool = False):
+    """Per-BUCKET metric moments over the mesh — the device analog of the
+    reference's sub-aggregation collectors under a bucketing parent
+    (terms/histogram), `InternalTerms` buckets carrying nested
+    `InternalStats`: re-evaluate each query's match mask shard-locally,
+    scatter the metric column's (count, sum, min, max, sumsq) over the
+    (doc, bucket-ordinal) pair arrays, and psum/pmin/pmax per ordinal over
+    the `shard` axis. The pair form serves BOTH parents: keyword terms use
+    the global-ordinal value pairs, histogram families use
+    (arange, bin-id). Returns a callable:
+        (tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB],
+         val_doc [S,NV], val_ord [S,NV], mcol [S,D_pad], mpres [S,D_pad]
+         [, fmask]) -> f32[QB, vpad, 5] = (count, sum, min, max, sumsq),
+        already global."""
+
+    def per_device(tree, rows, boosts, msm, cscore, val_doc, val_ord,
+                   mcol, mpres, fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        vd = val_doc[0]
+        vo = val_ord[0]
+        mc = mcol[0]
+        mp = mpres[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        vvalid = vd < INT32_SENTINEL
+        vd_safe = jnp.minimum(vd, ndocs_pad - 1)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            matched = scores > -jnp.inf
+            ok = vvalid & matched[vd_safe] & (mp[vd_safe] > 0)
+            v = mc[vd_safe]
+            okf = ok.astype(jnp.float32)
+            cnt = jnp.zeros(vpad, jnp.float32).at[vo].add(okf, mode="drop")
+            s = jnp.zeros(vpad, jnp.float32).at[vo].add(
+                jnp.where(ok, v, 0.0), mode="drop")
+            ssq = jnp.zeros(vpad, jnp.float32).at[vo].add(
+                jnp.where(ok, v * v, 0.0), mode="drop")
+            mn = jnp.full(vpad, jnp.inf, jnp.float32).at[vo].min(
+                jnp.where(ok, v, jnp.inf), mode="drop")
+            mx = jnp.full(vpad, -jnp.inf, jnp.float32).at[vo].max(
+                jnp.where(ok, v, -jnp.inf), mode="drop")
+            return jnp.stack([cnt, s, mn, mx, ssq], axis=1)
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        # [QB, vpad, 5]; additive stats psum, extrema pmin/pmax
+        return jnp.stack([
+            jax.lax.psum(part[:, :, 0], "shard"),
+            jax.lax.psum(part[:, :, 1], "shard"),
+            jax.lax.pmin(part[:, :, 2], "shard"),
+            jax.lax.pmax(part[:, :, 3], "shard"),
+            jax.lax.psum(part[:, :, 4], "shard"),
+        ], axis=2)
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"),
+                P("shard"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
+def build_distributed_range_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
+                                    nr: int, k1: float = 1.2,
+                                    b: float = 0.75,
+                                    filtered: bool = False):
+    """Per-RANGE metric moments over the mesh (sub-aggregations under a
+    `range` parent; ranges may overlap so this is nr masked reductions, not
+    a scatter). Returns a callable:
+        (tree, rows, boosts, msm, cscore, col [S,D], pres [S,D],
+         lows f32[nr], highs f32[nr], mcol [S,D], mpres [S,D] [, fmask])
+        -> f32[QB, nr, 5] = (count, sum, min, max, sumsq), global."""
+
+    def per_device(tree, rows, boosts, msm, cscore, col, pres, lows, highs,
+                   mcol, mpres, fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        cv = col[0]
+        pr = pres[0]
+        mc = mcol[0]
+        mp = mpres[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            matched = (scores > -jnp.inf) & (pr > 0) & (mp > 0)
+            stats = []
+            for ri in range(nr):
+                ok = matched & (cv >= lows[ri]) & (cv < highs[ri])
+                okf = ok.astype(jnp.float32)
+                stats.append(jnp.stack([
+                    jnp.sum(okf),
+                    jnp.sum(jnp.where(ok, mc, 0.0)),
+                    jnp.min(jnp.where(ok, mc, jnp.inf)),
+                    jnp.max(jnp.where(ok, mc, -jnp.inf)),
+                    jnp.sum(jnp.where(ok, mc * mc, 0.0))]))
+            return jnp.stack(stats)
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return jnp.stack([
+            jax.lax.psum(part[:, :, 0], "shard"),
+            jax.lax.psum(part[:, :, 1], "shard"),
+            jax.lax.pmin(part[:, :, 2], "shard"),
+            jax.lax.pmax(part[:, :, 3], "shard"),
+            jax.lax.psum(part[:, :, 4], "shard"),
+        ], axis=2)
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"),
+                P(), P(), P("shard"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
 def build_distributed_range_counts(mesh: Mesh, bucket: int, ndocs_pad: int,
                                    nr: int, k1: float = 1.2,
                                    b: float = 0.75,

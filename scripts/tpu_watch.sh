@@ -1,8 +1,8 @@
 #!/bin/bash
 # TPU tunnel watcher: probe the device every 2 min; the moment it answers,
-# run the benchmark (the round's scarcest artifact) and the TPU test suite,
-# then keep watching for later windows.  The r3/r4 tunnel died for hours at
-# a stretch — bench opportunistically, never "at the end".
+# run the benchmark (the round's scarcest artifact), the TPU test suite,
+# then the extended configs 4/5. The r3/r4 tunnel died for hours at a
+# stretch — bench opportunistically, never "at the end".
 cd /root/repo
 LOG=.tpu_watch.log
 STAMP() { date -u +%Y-%m-%dT%H:%M:%SZ; }
@@ -12,7 +12,7 @@ while true; do
   if timeout 90 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1; then
     NOW=$(date +%s)
     echo "$(STAMP) tunnel UP" >> "$LOG"
-    # bench at most once per 40 min of up-time windows
+    # full artifact pass at most once per 40 min of up-time windows
     if [ $((NOW - LAST_BENCH)) -gt 2400 ]; then
       echo "$(STAMP) bench starting" >> "$LOG"
       if timeout 1500 env BENCH_BUDGET_S=900 python bench.py \
@@ -20,7 +20,8 @@ while true; do
         cp -f BENCH_out.json "BENCH_mid_r05_$(date +%s).json" 2>/dev/null
         echo "$(STAMP) bench DONE rc=0" >> "$LOG"
       else
-        echo "$(STAMP) bench rc=$? (partials in BENCH_out.json)" >> "$LOG"
+        RC=$?
+        echo "$(STAMP) bench rc=$RC (partials in BENCH_out.json)" >> "$LOG"
         cp -f BENCH_out.json "BENCH_mid_r05_partial_$(date +%s).json" \
           2>/dev/null
       fi
@@ -29,6 +30,18 @@ while true; do
         > .tpu_tests_last.txt 2>&1 \
         && echo "$(STAMP) tests_tpu GREEN" >> "$LOG" \
         || echo "$(STAMP) tests_tpu FAILED (see .tpu_tests_last.txt)" >> "$LOG"
+      echo "$(STAMP) bench_extra (configs 4+5) starting" >> "$LOG"
+      if timeout 2700 python bench_extra.py \
+           > .bench_extra_stdout.json 2>> "$LOG"; then
+        cp -f BENCH_extra_out.json \
+          "BENCH_extra_r05_$(date +%s).json" 2>/dev/null
+        echo "$(STAMP) bench_extra DONE rc=0" >> "$LOG"
+      else
+        RC=$?
+        echo "$(STAMP) bench_extra rc=$RC (partials kept)" >> "$LOG"
+        cp -f BENCH_extra_out.json \
+          "BENCH_extra_r05_partial_$(date +%s).json" 2>/dev/null
+      fi
     fi
     sleep 300
   else

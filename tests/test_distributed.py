@@ -601,8 +601,8 @@ class TestMeshService:
         assert rm["aggregations"] == rh["aggregations"]
 
     def test_histogram_aggs_dispatch_with_parity(self, clients):
-        # r5: histograms now reduce ON the mesh (device bincount + psum);
-        # sub-agg'd histograms still fall back
+        # r5: histograms reduce ON the mesh (device bincount + psum), and
+        # metric sub-aggs now ride along (pair-metrics scatter program)
         cm, ch = clients
         body = {"query": {"match": {"body": "alpha"}}, "size": 3,
                 "aggs": {"h": {"histogram": {"field": "num",
@@ -617,10 +617,10 @@ class TestMeshService:
                                                "interval": 10},
                                  "aggs": {"m": {"avg": {
                                      "field": "num"}}}}}}
-        f0 = cm.node.mesh_service.fallbacks
+        before = cm.node.mesh_service.dispatched
         rm = cm.search(index="idx", body=dict(subbed))
         rh = ch.search(index="idx", body=dict(subbed))
-        assert cm.node.mesh_service.fallbacks == f0 + 1
+        assert cm.node.mesh_service.dispatched == before + 1
         assert rm["aggregations"] == rh["aggregations"]
 
     def test_msearch_batches_through_mesh(self, clients):
@@ -707,6 +707,7 @@ class TestMeshBucketAggs:
                 "settings": {"number_of_shards": 4},
                 "mappings": {"properties": {
                     "body": {"type": "text"}, "num": {"type": "integer"},
+                    "status": {"type": "keyword"},
                     "ts": {"type": "date"}}}})
             bulk = []
             for i in range(800):
@@ -715,6 +716,7 @@ class TestMeshBucketAggs:
                     "body": " ".join(rng.choice(WORDS,
                                                 size=int(rng.integers(3, 9)))),
                     "num": int(rng.integers(0, 500)),
+                    "status": ["draft", "review", "published"][i % 3],
                     "ts": f"2026-07-{(i % 28) + 1:02d}T03:00:00Z"})
             c.bulk(bulk)
             c.indices.refresh("hx")
@@ -760,6 +762,63 @@ class TestMeshBucketAggs:
         rh = ch.search(index="hx", body=dict(body))
         assert cm.node.mesh_service.dispatched == before + 1
         assert rm["aggregations"]["h"] == rh["aggregations"]["h"]
+
+    @pytest.mark.parametrize("aggs", [
+        # r5: metric sub-aggs under bucket parents run on the mesh
+        # (pair/range metrics programs: per-bucket scatter + psum)
+        {"t": {"terms": {"field": "status"},
+               "aggs": {"p": {"avg": {"field": "num"}}}}},
+        {"t": {"terms": {"field": "status", "size": 2},
+               "aggs": {"p": {"stats": {"field": "num"}},
+                        "q": {"value_count": {"field": "num"}}}}},
+        {"h": {"histogram": {"field": "num", "interval": 100},
+               "aggs": {"s": {"sum": {"field": "num"}}}}},
+        {"d": {"date_histogram": {"field": "ts", "fixed_interval": "7d"},
+               "aggs": {"m": {"max": {"field": "num"}}}}},
+        {"r": {"range": {"field": "num",
+                         "ranges": [{"to": 100}, {"from": 50, "to": 400}]},
+               "aggs": {"m": {"min": {"field": "num"}}}}},
+    ])
+    def test_bucket_sub_agg_parity(self, clients, aggs):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 3,
+                "aggs": aggs}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the sub-agg body"
+        for aname in aggs:
+            assert rm["aggregations"][aname] == rh["aggregations"][aname], \
+                (aname, rm["aggregations"][aname], rh["aggregations"][aname])
+
+    def test_filtered_bucket_sub_agg_parity(self, clients):
+        cm, ch = clients
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "gamma"}}],
+            "filter": [{"range": {"num": {"gte": 100}}}]}},
+            "size": 3,
+            "aggs": {"t": {"terms": {"field": "status"},
+                           "aggs": {"a": {"avg": {"field": "num"}}}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert rm["aggregations"]["t"] == rh["aggregations"]["t"]
+
+    def test_complex_sub_agg_falls_back(self, clients):
+        # a terms sub-agg under terms is NOT meshable -> host loop, same
+        # answer
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {"t": {"terms": {"field": "status"},
+                               "aggs": {"n": {"terms": {
+                                   "field": "status"}}}}}}
+        f0 = cm.node.mesh_service.fallbacks
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.fallbacks == f0 + 1
+        assert rm["aggregations"]["t"] == rh["aggregations"]["t"]
 
     def test_distinct_hist_aggs_do_not_alias(self, clients):
         # regression: the program cache key must resolve the interval the
