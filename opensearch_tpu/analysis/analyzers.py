@@ -105,6 +105,15 @@ def _builtin(name: str) -> Analyzer:
                                       icu_normalizer_char_filter)
         return Analyzer(name, standard_tokenizer, [icu_folding_filter],
                         [icu_normalizer_char_filter])
+    if name == "polish":
+        # reference plugins/analysis-stempel PolishAnalyzerProvider
+        # (rule-based approximation; see slavic.py module contract)
+        from .slavic import make_polish_analyzer
+        return make_polish_analyzer()
+    if name == "ukrainian":
+        # reference plugins/analysis-ukrainian UkrainianAnalyzerProvider
+        from .slavic import make_ukrainian_analyzer
+        return make_ukrainian_analyzer()
     raise ValueError(f"unknown analyzer [{name}]")
 
 

@@ -445,6 +445,19 @@ def resolve_token_filter(name: str, params: dict | None = None) -> TokenFilter:
                 "icu_normalizer": icu_normalizer_filter,
                 "cjk_width": cjk_width_filter,
                 "cjk_bigram": cjk_bigram_filter}[name]
+    if name == "icu_transform":
+        from .unicode_plugins import make_icu_transform_filter
+        return make_icu_transform_filter(params.get("id", "Any-Latin"))
+    if name == "phonetic":
+        from .phonetic import make_phonetic_filter
+        return make_phonetic_filter(params.get("encoder", "metaphone"),
+                                    bool(params.get("replace", True)))
+    if name == "polish_stem":
+        from .slavic import polish_stem_filter
+        return polish_stem_filter
+    if name == "ukrainian_stem":
+        from .slavic import ukrainian_stem_filter
+        return ukrainian_stem_filter
     raise ValueError(f"unknown token filter [{name}]")
 
 

@@ -100,3 +100,106 @@ def cjk_bigram_filter(tokens: List[Token]) -> List[Token]:
                              t.keyword))
             prev_out = pos
     return out
+
+
+# ---------------------------------------------------------------------
+# icu_transform (subset) — reference: ICUTransformTokenFilterFactory
+# (plugins/analysis-icu). The real plugin exposes arbitrary ICU transliterator
+# ids; this rebuild supports the ids seen in practice, composed with ";".
+# Unknown ids raise — never silently pass text through.
+# ---------------------------------------------------------------------
+
+_CYR2LAT = {
+    "а": "a", "б": "b", "в": "v", "г": "g", "д": "d", "е": "e", "ё": "e",
+    "ж": "zh", "з": "z", "и": "i", "й": "j", "к": "k", "л": "l", "м": "m",
+    "н": "n", "о": "o", "п": "p", "р": "r", "с": "s", "т": "t", "у": "u",
+    "ф": "f", "х": "h", "ц": "c", "ч": "ch", "ш": "sh", "щ": "shch",
+    "ъ": "", "ы": "y", "ь": "", "э": "e", "ю": "ju", "я": "ja",
+    "є": "je", "і": "i", "ї": "ji", "ґ": "g",
+}
+
+_GRK2LAT = {
+    "α": "a", "β": "b", "γ": "g", "δ": "d", "ε": "e", "ζ": "z", "η": "e",
+    "θ": "th", "ι": "i", "κ": "k", "λ": "l", "μ": "m", "ν": "n",
+    "ξ": "x", "ο": "o", "π": "p", "ρ": "r", "σ": "s", "ς": "s",
+    "τ": "t", "υ": "y", "φ": "ph", "χ": "kh", "ψ": "ps", "ω": "o",
+}
+
+
+def _translit(text: str, table: dict) -> str:
+    out = []
+    for ch in text:
+        low = ch.lower()
+        rep = table.get(low)
+        if rep is None:
+            # accented forms fall back to their decomposed base letter
+            # (ICU transliterates e.g. ή the same as η)
+            base = unicodedata.normalize("NFD", low)[0]
+            rep = table.get(base)
+        if rep is None:
+            out.append(ch)
+        elif ch.isupper():
+            out.append(rep.capitalize())
+        else:
+            out.append(rep)
+    return "".join(out)
+
+
+def _strip_marks(text: str) -> str:
+    return unicodedata.normalize("NFC", "".join(
+        c for c in unicodedata.normalize("NFD", text)
+        if unicodedata.category(c) != "Mn"))
+
+
+def _latin_ascii(text: str) -> str:
+    return "".join(c for c in unicodedata.normalize("NFKD", text)
+                   if ord(c) < 128)
+
+
+_TRANSFORMS = {
+    "any-latin": lambda s: _translit(_translit(s, _CYR2LAT), _GRK2LAT),
+    "cyrillic-latin": lambda s: _translit(s, _CYR2LAT),
+    "greek-latin": lambda s: _translit(s, _GRK2LAT),
+    "latin-ascii": _latin_ascii,
+    "any-lower": str.lower,
+    "any-upper": str.upper,
+    "nfd; [:nonspacing mark:] remove; nfc": _strip_marks,
+    "nfd": lambda s: unicodedata.normalize("NFD", s),
+    "nfc": lambda s: unicodedata.normalize("NFC", s),
+    "nfkd": lambda s: unicodedata.normalize("NFKD", s),
+    "nfkc": lambda s: unicodedata.normalize("NFKC", s),
+    "[:nonspacing mark:] remove": lambda s: "".join(
+        c for c in s if unicodedata.category(c) != "Mn"),
+}
+
+
+def make_icu_transform_filter(transform_id: str = "Any-Latin"):
+    """Compose the ";"-separated transform id into one token transform.
+    The full literal id is tried first (so the canonical accent-strip
+    chain "NFD; [:Nonspacing Mark:] Remove; NFC" matches as one unit)."""
+    tid = transform_id.strip().lower()
+    if tid in _TRANSFORMS:
+        steps = [_TRANSFORMS[tid]]
+    else:
+        steps = []
+        for part in tid.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            fn = _TRANSFORMS.get(part)
+            if fn is None:
+                raise ValueError(
+                    f"icu_transform id [{transform_id}] not supported; "
+                    f"supported ids: {sorted(_TRANSFORMS)}")
+            steps.append(fn)
+
+    def icu_transform(tokens: List[Token]) -> List[Token]:
+        out = []
+        for t in tokens:
+            text = t.text
+            for fn in steps:
+                text = fn(text)
+            out.append(t.with_text(text))
+        return out
+
+    return icu_transform

@@ -707,10 +707,31 @@ class MeshSearchService:
                 metrics_by_field[f] = mfn(*margs)
         tcounts_by_field = {}
         tvocab_by_field = {}
-        tsub_results = {}     # (terms_field, metric_field) -> [QB, vpad, 5]
-        terms_subs = sorted({(an.body["field"], s.body["field"])
-                             for it in items for an in it[5]
-                             if an.kind == "terms" for s in an.subs})
+        # (parent key, metric field) -> (i32[QB, nb] counts,
+        #                                f32[QB, nb, 4] moments)
+        tsub_results = {}
+
+        def _launch_pair_subs(an, parent_key, vpad_b, pvd, pvo,
+                              sub_results):
+            """One pair-metrics launch per (bucket parent, metric field),
+            shared by every body in the batch nesting that metric."""
+            for s in an.subs:
+                skey = (parent_key, s.body["field"])
+                if skey in sub_results:
+                    continue
+                mcol, mpres = self._col_for(name, svc, s.body["field"],
+                                            shard_segs, stacked.ndocs_pad,
+                                            mesh)
+                pmfn = self._pair_metrics_program_for(
+                    mesh, bucket, stacked.ndocs_pad, vpad_b, k1, b_eff,
+                    filtered)
+                pmargs = (stacked.tree(), rows, boosts, msm, cscore,
+                          pvd, pvo, mcol, mpres) \
+                    + ((fmask,) if filtered else ())
+                sub_results[skey] = pmfn(*pmargs)
+
+        terms_subs = [an for it in items for an in it[5]
+                      if an.kind == "terms" and an.subs]
         for f in terms_fields:
             val_doc, val_ord, vocab, vpad = self._ord_for(
                 name, svc, f, shard_segs, stacked.ndocs_pad, mesh)
@@ -720,21 +741,10 @@ class MeshSearchService:
                      val_ord) + ((fmask,) if filtered else ())
             tcounts_by_field[f] = tfn(*targs)
             tvocab_by_field[f] = vocab
-            # per-bucket metric sub-aggs: one pair-metrics launch per
-            # (terms field, metric field), shared by every body in the
-            # batch that nests that metric under that parent
-            for tf, mf in terms_subs:
-                if tf != f:
-                    continue
-                mcol, mpres = self._col_for(name, svc, mf, shard_segs,
-                                            stacked.ndocs_pad, mesh)
-                pmfn = self._pair_metrics_program_for(
-                    mesh, bucket, stacked.ndocs_pad, vpad, k1, b_eff,
-                    filtered)
-                pmargs = (stacked.tree(), rows, boosts, msm, cscore,
-                          val_doc, val_ord, mcol, mpres) \
-                    + ((fmask,) if filtered else ())
-                tsub_results[(f, mf)] = pmfn(*pmargs)
+            for an in terms_subs:
+                if an.body["field"] == f:
+                    _launch_pair_subs(an, f, vpad, val_doc, val_ord,
+                                      tsub_results)
         # histogram family: one bincount program per distinct
         # (field, interval, offset); range: per-range masked sums
         def _hist_key(an):
@@ -773,11 +783,7 @@ class MeshSearchService:
                         hist_results[hk] = (hfn(*hargs), min_b, nb,
                                             interval, offset)
                         hist_bins[hk] = bins_dev
-                    for s in an.subs:
-                        skey = (hk, s.body["field"])
-                        if skey in hsub_results:
-                            continue
-                        nb = hist_results[hk][2]
+                    if an.subs:
                         if hk not in hist_pairs:
                             # bin-id pairs reused by every metric sub
                             # under this histogram: (local doc, bin) with
@@ -792,16 +798,8 @@ class MeshSearchService:
                                     INT32_SENTINEL),
                                 jnp.maximum(bins_dev, 0))
                         hvd, hvo = hist_pairs[hk]
-                        mcol, mpres = self._col_for(
-                            name, svc, s.body["field"], shard_segs,
-                            stacked.ndocs_pad, mesh)
-                        pmfn = self._pair_metrics_program_for(
-                            mesh, bucket, stacked.ndocs_pad, nb, k1,
-                            b_eff, filtered)
-                        pmargs = (stacked.tree(), rows, boosts, msm,
-                                  cscore, hvd, hvo, mcol, mpres) \
-                            + ((fmask,) if filtered else ())
-                        hsub_results[skey] = pmfn(*pmargs)
+                        _launch_pair_subs(an, hk, hist_results[hk][2],
+                                          hvd, hvo, hsub_results)
                 elif an.kind == "range":
                     rk = _range_key(an)
                     needed_subs = [s for s in an.subs
@@ -845,19 +843,21 @@ class MeshSearchService:
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
         # exactly one partial per agg)
-        def _stat_partial(m):
+        def _stat_partial(cnt, m4):
             # the host metric partial shape (`_merge_stats` input): count,
             # sum, sumsq always; extrema only meaningful when count > 0
-            cnt = float(m[0])
-            return {"count": cnt, "sum": float(m[1]),
-                    "min": float(m[2]) if cnt > 0 else float("inf"),
-                    "max": float(m[3]) if cnt > 0 else float("-inf"),
-                    "sumsq": float(m[4])}
+            cnt = float(cnt)
+            return {"count": cnt, "sum": float(m4[0]),
+                    "min": float(m4[1]) if cnt > 0 else float("inf"),
+                    "max": float(m4[2]) if cnt > 0 else float("-inf"),
+                    "sumsq": float(m4[3])}
 
         def _bucket_subs(an, sub_results, parent_key, bi, j):
-            return {s.name: _stat_partial(
-                        sub_results[(parent_key, s.body["field"])][bi][j])
-                    for s in an.subs}
+            out = {}
+            for s in an.subs:
+                cnts, m4 = sub_results[(parent_key, s.body["field"])]
+                out[s.name] = _stat_partial(cnts[bi][j], m4[bi][j])
+            return out
 
         def attach_aggs(results, bi, aggs):
             for an in aggs:
@@ -895,8 +895,9 @@ class MeshSearchService:
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
+                m = metrics_by_field[an.body["field"]][bi]
                 results[0].agg_partials[an.name] = [
-                    _stat_partial(metrics_by_field[an.body["field"]][bi])]
+                    _stat_partial(m[0], m[1:5])]
 
         self._emit_mesh_results(name, bodies, out, shard_segs, stats,
                                 searchers, stacked, items, gdocs_b,

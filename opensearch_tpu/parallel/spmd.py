@@ -553,7 +553,8 @@ def build_distributed_pair_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
     (arange, bin-id). Returns a callable:
         (tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB],
          val_doc [S,NV], val_ord [S,NV], mcol [S,D_pad], mpres [S,D_pad]
-         [, fmask]) -> f32[QB, vpad, 5] = (count, sum, min, max, sumsq),
+         [, fmask]) -> (i32[QB, vpad] counts,
+                        f32[QB, vpad, 4] = (sum, min, max, sumsq)),
         already global."""
 
     def per_device(tree, rows, boosts, msm, cscore, val_doc, val_ord,
@@ -582,8 +583,10 @@ def build_distributed_pair_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
             matched = scores > -jnp.inf
             ok = vvalid & matched[vd_safe] & (mp[vd_safe] > 0)
             v = mc[vd_safe]
-            okf = ok.astype(jnp.float32)
-            cnt = jnp.zeros(vpad, jnp.float32).at[vo].add(okf, mode="drop")
+            # int32 count plane: f32 scatter-adds stop counting exactly at
+            # 2^24 docs/bucket (same rule as the terms bincount program)
+            cnt = jnp.zeros(vpad, jnp.int32).at[vo].add(
+                ok.astype(jnp.int32), mode="drop")
             s = jnp.zeros(vpad, jnp.float32).at[vo].add(
                 jnp.where(ok, v, 0.0), mode="drop")
             ssq = jnp.zeros(vpad, jnp.float32).at[vo].add(
@@ -592,17 +595,17 @@ def build_distributed_pair_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
                 jnp.where(ok, v, jnp.inf), mode="drop")
             mx = jnp.full(vpad, -jnp.inf, jnp.float32).at[vo].max(
                 jnp.where(ok, v, -jnp.inf), mode="drop")
-            return jnp.stack([cnt, s, mn, mx, ssq], axis=1)
+            return cnt, jnp.stack([s, mn, mx, ssq], axis=1)
 
-        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
-        # [QB, vpad, 5]; additive stats psum, extrema pmin/pmax
-        return jnp.stack([
-            jax.lax.psum(part[:, :, 0], "shard"),
-            jax.lax.psum(part[:, :, 1], "shard"),
-            jax.lax.pmin(part[:, :, 2], "shard"),
-            jax.lax.pmax(part[:, :, 3], "shard"),
-            jax.lax.psum(part[:, :, 4], "shard"),
-        ], axis=2)
+        cnts, part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        # counts i32[QB, vpad] exact; moments f32[QB, vpad, 4]
+        return (jax.lax.psum(cnts, "shard"),
+                jnp.stack([
+                    jax.lax.psum(part[:, :, 0], "shard"),
+                    jax.lax.pmin(part[:, :, 1], "shard"),
+                    jax.lax.pmax(part[:, :, 2], "shard"),
+                    jax.lax.psum(part[:, :, 3], "shard"),
+                ], axis=2))
 
     shard_map = jax.shard_map
     tree_spec = {k_: P("shard") for k_ in
@@ -627,7 +630,8 @@ def build_distributed_range_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
     a scatter). Returns a callable:
         (tree, rows, boosts, msm, cscore, col [S,D], pres [S,D],
          lows f32[nr], highs f32[nr], mcol [S,D], mpres [S,D] [, fmask])
-        -> f32[QB, nr, 5] = (count, sum, min, max, sumsq), global."""
+        -> (i32[QB, nr] counts, f32[QB, nr, 4] = (sum, min, max, sumsq)),
+        global."""
 
     def per_device(tree, rows, boosts, msm, cscore, col, pres, lows, highs,
                    mcol, mpres, fmask=None):
@@ -650,26 +654,25 @@ def build_distributed_range_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
                                       m, cs, n_global, dfg, avgdl, bucket,
                                       ndocs_pad, k1, b, fm)
             matched = (scores > -jnp.inf) & (pr > 0) & (mp > 0)
-            stats = []
+            cnts, stats = [], []
             for ri in range(nr):
                 ok = matched & (cv >= lows[ri]) & (cv < highs[ri])
-                okf = ok.astype(jnp.float32)
+                cnts.append(jnp.sum(ok.astype(jnp.int32)))
                 stats.append(jnp.stack([
-                    jnp.sum(okf),
                     jnp.sum(jnp.where(ok, mc, 0.0)),
                     jnp.min(jnp.where(ok, mc, jnp.inf)),
                     jnp.max(jnp.where(ok, mc, -jnp.inf)),
                     jnp.sum(jnp.where(ok, mc * mc, 0.0))]))
-            return jnp.stack(stats)
+            return jnp.stack(cnts), jnp.stack(stats)
 
-        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
-        return jnp.stack([
-            jax.lax.psum(part[:, :, 0], "shard"),
-            jax.lax.psum(part[:, :, 1], "shard"),
-            jax.lax.pmin(part[:, :, 2], "shard"),
-            jax.lax.pmax(part[:, :, 3], "shard"),
-            jax.lax.psum(part[:, :, 4], "shard"),
-        ], axis=2)
+        cnts, part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return (jax.lax.psum(cnts, "shard"),
+                jnp.stack([
+                    jax.lax.psum(part[:, :, 0], "shard"),
+                    jax.lax.pmin(part[:, :, 1], "shard"),
+                    jax.lax.pmax(part[:, :, 2], "shard"),
+                    jax.lax.psum(part[:, :, 3], "shard"),
+                ], axis=2))
 
     shard_map = jax.shard_map
     tree_spec = {k_: P("shard") for k_ in

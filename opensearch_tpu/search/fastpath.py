@@ -58,7 +58,7 @@ _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 # served/fallback counters (surfaced in _nodes/stats; also used by tests to
 # prove the kernel actually engaged rather than silently falling back)
 STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0,
-         "pruned_served": 0, "pruned_escalated": 0,
+         "pruned_served": 0, "pruned_rescued": 0, "pruned_escalated": 0,
          "shard_view_served": 0}
 
 # optional memory accounting set by the Node (utils/breaker.py): charged
@@ -145,13 +145,14 @@ class AlignedPostings:
 
     __slots__ = ("starts_rows", "lens", "d_docs", "d_tfdl", "nbytes",
                  "head_starts_rows", "head_lens", "rem_frontiers",
-                 "_full_frontiers")
+                 "head_ids", "_full_frontiers")
 
     def __init__(self, starts_rows: np.ndarray, lens: np.ndarray,
                  d_docs, d_tfdl, nbytes: int,
                  head_starts_rows: Optional[np.ndarray] = None,
                  head_lens: Optional[np.ndarray] = None,
-                 rem_frontiers: Optional[dict] = None):
+                 rem_frontiers: Optional[dict] = None,
+                 head_ids: Optional[dict] = None):
         self.starts_rows = starts_rows    # i64[nterms] aligned start / LANES
         self.lens = lens                  # i64[nterms] true posting counts
         self.d_docs = d_docs
@@ -166,6 +167,9 @@ class AlignedPostings:
         # row -> frontier of the postings OUTSIDE the head (clamped rows
         # only); absence means the head is the whole row
         self.rem_frontiers = rem_frontiers or {}
+        # row -> np doc ids of the head postings (clamped rows only) — the
+        # candidate-union escalation path rescores exactly these
+        self.head_ids = head_ids or {}
         self._full_frontiers: dict = {}
 
     def clamped(self, row: int) -> bool:
@@ -247,6 +251,7 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     # offsets unchanged) and the pruned path (head region for big rows)
     big = np.nonzero(lens > L_HEAD)[0]
     rem_frontiers: dict = {}
+    head_ids: dict = {}
     cat_starts = pb.starts
     cat_docs = pb.doc_ids
     cat_packed = packed
@@ -260,6 +265,7 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
             h_packed.append(packed[a:b][keep])
             h_lens.append(len(keep))
             rem_frontiers[int(r)] = rem_fr
+            head_ids[int(r)] = h_docs[-1]
         cat_docs = np.concatenate([pb.doc_ids] + h_docs)
         cat_packed = np.concatenate([packed] + h_packed)
         cat_starts = np.concatenate([
@@ -284,7 +290,7 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     return AlignedPostings(starts_rows[:nterms], lens,
                            jax.device_put(a_docs), jax.device_put(a_packed),
                            nbytes, head_starts_rows, head_lens,
-                           rem_frontiers)
+                           rem_frontiers, head_ids)
 
 
 def _body_eligible(sort_specs: List[dict], agg_nodes, named_nodes,
@@ -690,6 +696,13 @@ def _launch_pure_groups(seg: Segment,
         # host<->device round trip) amortizes across the whole batch while
         # rare terms still move only their own bytes
         L = max(v.L for v in gvqs)
+        # clamped (pruned) queries extract the FULL 128 output lanes, not
+        # just the page window: the verifier's unseen-doc bound uses the
+        # deepest kernel partial, and a 10-candidate pool leaves it so
+        # high that every realistic multi-term query escalates (the
+        # balanced mid-partial docs the page needs sit at ranks 10..128)
+        K_launch = (LANES if any(v.head and v.clamped for v in gvqs)
+                    else K)
         rowstarts = np.stack([v.rowstarts for v in gvqs])
         nrows = np.stack([v.nrows for v in gvqs])
         lens = np.stack([v.lens for v in gvqs])
@@ -701,14 +714,15 @@ def _launch_pure_groups(seg: Segment,
         dhi = np.array([[v.dhi] for v in gvqs], np.int32)
         scores, docs, totals = fused_bm25_topk_tfdl(
             al.d_docs, al.d_tfdl, rowstarts, nrows, lens, skips, weights,
-            msm, avg, dlo, dhi, T=T_pad, L=L, K=K, k1=k1, b=b_eff)
+            msm, avg, dlo, dhi, T=T_pad, L=L, K=K_launch, k1=k1, b=b_eff)
         # ONE device->host transfer for all three outputs: each np.asarray
         # is its own round trip, and on a tunneled host a round trip is
         # ~70ms — 3 fetches would triple the batch-1 latency floor
         import jax
         scores, docs, totals = jax.device_get((scores, docs, totals))
         for j, vq in enumerate(gvqs):
-            results[id(vq)] = (scores[j][:K], docs[j][:K],
+            keep = K_launch if (vq.head and vq.clamped) else K
+            results[id(vq)] = (scores[j][:keep], docs[j][:keep],
                                int(totals[j][0]), "eq")
     return results
 
@@ -792,27 +806,13 @@ def _tie_serves(al: AlignedPostings, vq: _VQuery, theta: float,
     return int(ids[att].min()) > int(cand[order[window - 1]])
 
 
-def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
-                   total: int, window: int, K: int) -> Optional[tuple]:
-    """Prove a clamped pruned result exact, or None -> rerun dense.
-
-    The kernel saw only each term's impact head, so candidate partial
-    scores may miss contributions (doc outside some term's head). Exact-
-    rescore the candidates on host (the analog of Lucene re-walking a WAND
-    candidate), then accept iff the `_unseen_bound` subset analysis proves
-    no unseen doc can displace the served window. Totals become a lower
-    bound (relation "gte"), the contract the reference's default
-    track-total-hits cap already has."""
+def _exact_rescore(seg: Segment, vq: _VQuery, cand: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact scores + per-term match counts of `cand` against the FULL
+    rows (vectorized searchsorted per term — the analog of Lucene
+    re-walking a WAND candidate)."""
     pb = seg.postings.get(vq.field)
     dl = seg.doc_lens.get(vq.field)
-    al = get_aligned(seg, vq.field)
-    valid = np.isfinite(sc) & (dc >= 0)
-    cand = dc[valid].astype(np.int64)
-    if len(cand) == 0:
-        # heads matched nothing; matches could still exist past the heads
-        if any(vq.miss[i] > 0 for i in range(len(vq.rows))):
-            return None
-        return (sc, dc, total, "eq")
     dl_c = (dl[cand].astype(np.float32) if dl is not None
             else np.zeros(len(cand), np.float32))
     kfac = vq.k1 * (1.0 - vq.b_eff
@@ -833,10 +833,130 @@ def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
         exact += np.where(found, vq.weights[i] * tf / (tf + kfac),
                           0.0).astype(np.float32)
         counts += found
+    return exact, counts
+
+
+def _noheads_bound(al: AlignedPostings, vq: _VQuery) -> float:
+    """Max TRUE score of any doc outside EVERY queried head (the unseen
+    docs of the candidate-union escalation): all of its contributions come
+    from clamped remainders and share ONE doc length d, so
+        bound = max_d  sum_t  g_t(d),
+    where g_t(d) = w_t * max{tf/(tf+k(d)) : (tf, dlmin) in rem frontier of
+    t, dlmin <= d} and d ranges over the frontier dl minima (contribution
+    is decreasing and feasibility increasing in d, so the max over real
+    lengths is attained on that grid). Docs matching fewer than msm terms
+    can't pass, so grid points with too few feasible terms are skipped.
+    Unclamped rows don't appear: any doc matching one is a candidate."""
+    cl = [i for i, r in enumerate(vq.rows)
+          if r >= 0 and al.clamped(int(r))]
+    if not cl:
+        return -np.inf
+    fronts = []
+    ds = []
+    for i in cl:
+        fr = al.rem_frontiers.get(int(vq.rows[i]))
+        tfv = np.asarray(fr[0], np.float64)
+        dlv = np.asarray(fr[1], np.float64)
+        if len(tfv):
+            fronts.append((i, tfv, dlv))
+            ds.append(dlv)
+    if not fronts:
+        return -np.inf
+    avg = max(float(vq.avgdl), 1e-9)
+    best = -np.inf
+    for d in np.unique(np.concatenate(ds)):
+        k = max(vq.k1 * (1.0 - vq.b_eff + vq.b_eff * float(d) / avg),
+                1e-9)
+        total = 0.0
+        nfeas = 0
+        for i, tfv, dlv in fronts:
+            feas = dlv <= d
+            if not feas.any():
+                continue
+            nfeas += 1
+            total += float(vq.weights[i]) * float(
+                np.max(tfv[feas] / (tfv[feas] + k)))
+        if nfeas and nfeas >= vq.msm_true:
+            best = max(best, total)
+    return best
+
+
+def _phase2_rescore(seg: Segment, vq: _VQuery, window: int, K: int
+                    ) -> Optional[tuple]:
+    """Candidate-union escalation — the cheap middle rung between the
+    pruned kernel pass and the dense rerun. The kernel's top-K-by-PARTIAL
+    misses 'balanced' docs whose per-term partials are mid-pack but whose
+    sum is competitive (measured: 100% of clamped multi-term bench queries
+    escalated on it). Rescoring the ENTIRE head union (every doc any head
+    mentions, <= T*L_HEAD candidates, one vectorized pass) recovers
+    exactly those docs: a doc outside ALL heads is then bounded by the
+    dl-consistent `_noheads_bound`, which sits well below the top-K
+    threshold on real corpora. Totals stay the 'gte' contract."""
+    al = get_aligned(seg, vq.field)
+    pb = seg.postings.get(vq.field)
+    ids = []
+    for r in vq.rows:
+        if r < 0:
+            continue
+        r = int(r)
+        hid = al.head_ids.get(r)
+        if hid is None:
+            a, b = pb.row_slice(r)
+            hid = pb.doc_ids[a:b]
+        ids.append(np.asarray(hid, np.int64))
+    if not ids:
+        return None
+    cand = np.unique(np.concatenate(ids))
+    if len(cand) == 0:
+        return None
+    exact, counts = _exact_rescore(seg, vq, cand)
     pass_msm = counts >= vq.msm_true
     n_pass = int(pass_msm.sum())
     exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
-    partial_k = float(sc[valid][-1]) if len(cand) >= K else 0.0
+    order = np.lexsort((cand, -exact_m))
+    theta = (float(exact_m[order[window - 1]]) if n_pass >= window
+             else -np.inf)
+    bound = _noheads_bound(al, vq)
+    # equality escalates (frontier bounds are attained), as in phase 1
+    if bound >= theta:
+        return None
+    keep = order[pass_msm[order]][:K]
+    sc2 = np.full(K, -np.inf, np.float32)
+    dc2 = np.full(K, -1, np.int32)
+    sc2[: len(keep)] = exact_m[keep]
+    dc2[: len(keep)] = cand[keep].astype(np.int32)
+    return (sc2, dc2, n_pass, "gte")
+
+
+def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
+                   total: int, window: int, K: int) -> Optional[tuple]:
+    """Prove a clamped pruned result exact, or None -> rerun dense.
+
+    The kernel saw only each term's impact head, so candidate partial
+    scores may miss contributions (doc outside some term's head). Exact-
+    rescore the candidates on host (the analog of Lucene re-walking a WAND
+    candidate), then accept iff the `_unseen_bound` subset analysis proves
+    no unseen doc can displace the served window. Totals become a lower
+    bound (relation "gte"), the contract the reference's default
+    track-total-hits cap already has."""
+    pb = seg.postings.get(vq.field)
+    dl = seg.doc_lens.get(vq.field)
+    al = get_aligned(seg, vq.field)
+    valid = np.isfinite(sc) & (dc >= 0)
+    cand = dc[valid].astype(np.int64)
+    if len(cand) == 0:
+        # heads matched nothing; matches could still exist past the heads
+        if any(vq.miss[i] > 0 for i in range(len(vq.rows))):
+            return None
+        return (sc[:K], dc[:K], total, "eq")
+    exact, counts = _exact_rescore(seg, vq, cand)
+    pass_msm = counts >= vq.msm_true
+    n_pass = int(pass_msm.sum())
+    exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
+    # the unseen-doc in-head bound: the DEEPEST kernel partial. Zero when
+    # the kernel window wasn't full — then every head-matched doc is
+    # already a candidate and an unseen doc has no in-head part at all
+    partial_k = float(sc[valid][-1]) if len(cand) == len(sc) else 0.0
     bound = _unseen_bound(al, pb, dl, vq, partial_k)
     order = np.lexsort((cand, -exact_m))
     theta = (float(exact_m[order[window - 1]]) if n_pass >= window
@@ -882,6 +1002,20 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
             redo.append(qi)
         else:
             results[id(vq)] = ver
+    rescued = 0
+    if redo:
+        # middle rung: candidate-union rescore before any dense rerun
+        still = []
+        for qi in redo:
+            vq = vq_lists[qi][0]
+            ver2 = _phase2_rescore(seg, vq, int(specs[qi].window or K), K)
+            if ver2 is not None:
+                results[id(vq)] = ver2
+                rescued += 1
+            else:
+                still.append(qi)
+        STATS["pruned_rescued"] += rescued
+        redo = still
     if redo:
         STATS["pruned_escalated"] += len(redo)
         dense_lists = _prepare_vqueries(seg, ctx, [lts[qi] for qi in redo],
@@ -894,7 +1028,7 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
     STATS["pruned_served"] += sum(
         1 for vqs in vq_lists
         if vqs is not None and len(vqs) == 1 and vqs[0].head
-        and vqs[0].clamped)
+        and vqs[0].clamped) - rescued
     return _assemble(vq_lists, results, K)
 
 

