@@ -666,6 +666,7 @@ def main():
             "vs_cpu": round(qps1r / cpu_r_qps, 2),
             "recall_at_10_tie_aware": round(rec_r_tie, 4),
             "kernel_served": served, "fallbacks": ds["fallback"],
+            "pruned_rescued": ds["pruned_rescued"],
             "pruned_escalated": ds["pruned_escalated"]}
         _emit_partial("config1r_done")
     else:

@@ -25,7 +25,9 @@ import numpy as np
 from ..index.segment import next_pow2
 from ..search.compiler import hist_agg_interval, range_agg_spec
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
-                   build_distributed_bincount, build_distributed_metrics,
+                   build_distributed_bincount,
+                   build_distributed_cardinality,
+                   build_distributed_metrics,
                    build_distributed_pair_metrics, build_distributed_phrase,
                    build_distributed_range_counts,
                    build_distributed_range_metrics,
@@ -102,6 +104,8 @@ class MeshSearchService:
         self._range_programs: Dict[Tuple, object] = {}
         self._pair_metrics_programs: Dict[Tuple, object] = {}
         self._range_metrics_programs: Dict[Tuple, object] = {}
+        self._card_programs: Dict[Tuple, object] = {}
+        self._card_hashes: Dict[Tuple, tuple] = {}
         # (index, field) -> (generation, arrays-or-None)
         self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
         # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
@@ -234,6 +238,18 @@ class MeshSearchService:
                                                 k1=k1, b=b,
                                                 filtered=filtered)
             self._range_programs[key] = fn
+        return fn
+
+    def _card_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                          keyword: bool, vpad: int, k1: float, b: float,
+                          filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, keyword, vpad, k1, b, filtered)
+        fn = self._card_programs.get(key)
+        if fn is None:
+            fn = build_distributed_cardinality(
+                mesh, bucket=bucket, ndocs_pad=ndocs_pad, keyword=keyword,
+                vpad=vpad, k1=k1, b=b, filtered=filtered)
+            self._card_programs[key] = fn
         return fn
 
     def _pair_metrics_program_for(self, mesh, bucket: int, ndocs_pad: int,
@@ -631,6 +647,15 @@ class MeshSearchService:
                 elif an.kind in ("histogram", "date_histogram"):
                     got = self._bins_for(name, svc, an, shard_segs,
                                          stacked.ndocs_pad, mesh)
+                elif an.kind == "cardinality":
+                    # keyword fields ride global ordinals, numeric the
+                    # stacked column; neither -> host loop
+                    got = (self._ord_for(name, svc, an.body["field"],
+                                         shard_segs, stacked.ndocs_pad,
+                                         mesh)
+                           or self._col_for(name, svc, an.body["field"],
+                                            shard_segs, stacked.ndocs_pad,
+                                            mesh))
                 else:
                     got = self._col_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
@@ -692,7 +717,7 @@ class MeshSearchService:
         metric_fields = sorted({
             an.body["field"] for it in items for an in it[5]
             if an.kind not in ("terms", "histogram", "date_histogram",
-                               "range")})
+                               "range", "cardinality")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5] if an.kind == "terms"})
         metrics_by_field = {}
@@ -760,6 +785,45 @@ class MeshSearchService:
             _, _, rkeys, metas = range_agg_spec(an.body["ranges"])
             return (an.body["field"], tuple(rkeys),
                     tuple((m.get("from"), m.get("to")) for m in metas))
+
+        # cardinality: shard-local HLL registers + pmax (bit-identical to
+        # the host's per-segment registers merged by max)
+        card_results = {}
+        card_fields = sorted({an.body["field"] for it in items
+                              for an in it[5] if an.kind == "cardinality"})
+        for f in card_fields:
+            got = self._ord_for(name, svc, f, shard_segs,
+                                stacked.ndocs_pad, mesh)
+            if got is not None:
+                val_doc, val_ord, vocab, vpad = got
+                # vocab hashes cached per generation (the O(vocab) python
+                # crc32 loop must not run per request)
+                hkey = (name, f)
+                hcached = self._card_hashes.get(hkey)
+                if hcached is not None and hcached[0] == svc.generation:
+                    hashes = hcached[1]
+                else:
+                    import zlib
+                    hashes = np.zeros(vpad, np.uint32)
+                    hashes[: len(vocab)] = np.fromiter(
+                        (zlib.crc32(v.encode()) for v in vocab),
+                        np.uint32, count=len(vocab))
+                    self._card_hashes[hkey] = (svc.generation, hashes)
+                cfn = self._card_program_for(
+                    mesh, bucket, stacked.ndocs_pad, True, vpad, k1,
+                    b_eff, filtered)
+                cargs = (stacked.tree(), rows, boosts, msm, cscore,
+                         val_doc, val_ord, hashes) \
+                    + ((fmask,) if filtered else ())
+            else:
+                col, pres = self._col_for(name, svc, f, shard_segs,
+                                          stacked.ndocs_pad, mesh)
+                cfn = self._card_program_for(
+                    mesh, bucket, stacked.ndocs_pad, False, 0, k1, b_eff,
+                    filtered)
+                cargs = (stacked.tree(), rows, boosts, msm, cscore, col,
+                         pres) + ((fmask,) if filtered else ())
+            card_results[f] = cfn(*cargs)
 
         hist_results = {}
         hist_bins = {}        # hist key -> device bins (sub-agg pair input)
@@ -835,10 +899,11 @@ class MeshSearchService:
                                   metrics_by_field, tcounts_by_field,
                                   hist_results, range_results,
                                   tsub_results, hsub_results,
-                                  rsub_results))
+                                  rsub_results, card_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field, hist_results, range_results,
-         tsub_results, hsub_results, rsub_results) = fetched
+         tsub_results, hsub_results, rsub_results,
+         card_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
@@ -894,6 +959,10 @@ class MeshSearchService:
                         if c > 0}
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
+                    continue
+                if an.kind == "cardinality":
+                    results[0].agg_partials[an.name] = [{
+                        "registers": card_results[an.body["field"]][bi]}]
                     continue
                 m = metrics_by_field[an.body["field"]][bi]
                 results[0].agg_partials[an.name] = [
@@ -1070,6 +1139,10 @@ class MeshSearchService:
                 return None
             if an.kind in _MESH_METRICS and set(an.body) == {"field"} \
                     and not an.subs:
+                continue
+            # r5: cardinality as shard-local HLL registers + pmax (the
+            # registers ARE the mergeable form, bit-identical to host)
+            if an.kind == "cardinality" and set(an.body) == {"field"}:
                 continue
             if an.kind == "terms" and set(an.body) <= \
                     {"field", "size", "min_doc_count", "order"}:

@@ -688,6 +688,96 @@ def build_distributed_range_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
     return jax.jit(fn)
 
 
+def build_distributed_cardinality(mesh: Mesh, bucket: int, ndocs_pad: int,
+                                  keyword: bool, vpad: int = 0,
+                                  log2m: Optional[int] = None,
+                                  k1: float = 1.2,
+                                  b: float = 0.75, filtered: bool = False):
+    """`cardinality` over the mesh with EXACT host parity: per shard,
+    build the same HyperLogLog registers the host segment path builds
+    (ops/aggs.py hll_registers over crc32 ordinal hashes / fmix32 value
+    hashes), then reduce with pmax — HLL registers merge by elementwise
+    max, which is precisely the collective the mesh has. The estimate is
+    therefore bit-identical to the host shard loop's.
+
+    keyword=True: (tree, rows, boosts, msm, cscore, val_doc [S,NV],
+        val_ord [S,NV], ord_hashes u32[vpad] [, fmask])
+    keyword=False: (tree, rows, boosts, msm, cscore, col [S,D],
+        pres [S,D] [, fmask])
+    -> i32[QB, 2^log2m] registers, already global."""
+    from ..ops import aggs as agg_ops
+    from ..search.compiler import HLL_LOG2M
+    if log2m is None:
+        # the ONE precision constant: mesh registers must stay the same
+        # shape/precision as the host's or the max-merge silently drifts
+        log2m = HLL_LOG2M
+
+    def per_device(tree, rows, boosts, msm, cscore, *rest):
+        fmask = rest[-1] if filtered else None
+        rest = rest[:-1] if filtered else rest
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        if keyword:
+            val_doc, val_ord, ord_hashes = rest
+            vd = val_doc[0]
+            vo = val_ord[0]
+            vvalid = vd < INT32_SENTINEL
+            vd_safe = jnp.minimum(vd, ndocs_pad - 1)
+
+            def one(r, w, m, cs, dfg):
+                scores = _score_one_query(starts, doc_ids, tfs, dl, live,
+                                          r, w, m, cs, n_global, dfg,
+                                          avgdl, bucket, ndocs_pad, k1, b,
+                                          fm)
+                matched = (scores > -jnp.inf).astype(jnp.int32)
+                contrib = jnp.where(vvalid, matched[vd_safe], 0)
+                counts = jnp.zeros(vpad, jnp.int32).at[vo].add(
+                    contrib, mode="drop")
+                return agg_ops.hll_registers(ord_hashes, counts > 0,
+                                             log2m)
+        else:
+            col, pres = rest
+            cv = col[0]
+            pr = pres[0]
+            hashes = agg_ops._hash_f32(cv)
+
+            def one(r, w, m, cs, dfg):
+                scores = _score_one_query(starts, doc_ids, tfs, dl, live,
+                                          r, w, m, cs, n_global, dfg,
+                                          avgdl, bucket, ndocs_pad, k1, b,
+                                          fm)
+                valid = (scores > -jnp.inf) & (pr > 0)
+                return agg_ops.hll_registers(hashes, valid, log2m)
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return jax.lax.pmax(part, "shard")
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    if keyword:
+        in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                    P("replica"), P("replica"), P("shard"), P("shard"),
+                    P())
+    else:
+        in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                    P("replica"), P("replica"), P("shard"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
 def build_distributed_range_counts(mesh: Mesh, bucket: int, ndocs_pad: int,
                                    nr: int, k1: float = 1.2,
                                    b: float = 0.75,

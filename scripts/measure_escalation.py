@@ -147,9 +147,17 @@ def main():
 
     fastpath._verify_pruned = spy
 
-    for name, qs, terms_of in (
-            ("config1_2term", queries, lambda q: q[:2]),
-            ("config1r_6term", queries_real, lambda q: q)):
+    streams = [("config1_2term", queries, lambda q: q[:2]),
+               ("config1r_6term", queries_real, lambda q: q)]
+    pick = os.environ.get("ESC_STREAMS")
+    if pick:
+        names = [s[0] for s in streams]
+        wanted = pick.split(",")
+        streams = [s for s in streams if s[0] in wanted]
+        if not streams:
+            raise SystemExit(f"ESC_STREAMS={pick!r} matches none of "
+                             f"{names}")
+    for name, qs, terms_of in streams:
         outcomes.update({"serve": 0, "escalate": 0, "tie_serve": 0})
         gaps.clear()
         before = dict(fastpath.STATS)

@@ -820,6 +820,40 @@ class TestMeshBucketAggs:
         assert cm.node.mesh_service.fallbacks == f0 + 1
         assert rm["aggregations"]["t"] == rh["aggregations"]["t"]
 
+    @pytest.mark.parametrize("aggs", [
+        # r5: cardinality as shard-local HLL registers + pmax; the
+        # registers ARE the mergeable form, so mesh == host bit-for-bit
+        {"c": {"cardinality": {"field": "status"}}},
+        {"c": {"cardinality": {"field": "num"}}},
+        {"c": {"cardinality": {"field": "status"}},
+         "s": {"sum": {"field": "num"}}},
+    ])
+    def test_cardinality_parity(self, clients, aggs):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 0,
+                "aggs": aggs}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the cardinality body"
+        for aname in aggs:
+            assert rm["aggregations"][aname] == rh["aggregations"][aname], \
+                (aname, rm["aggregations"][aname], rh["aggregations"][aname])
+
+    def test_filtered_cardinality_parity(self, clients):
+        cm, ch = clients
+        body = {"query": {"bool": {
+            "must": [{"match": {"body": "gamma"}}],
+            "filter": [{"range": {"num": {"gte": 100}}}]}},
+            "size": 0,
+            "aggs": {"c": {"cardinality": {"field": "status"}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert rm["aggregations"]["c"] == rh["aggregations"]["c"]
+
     def test_distinct_hist_aggs_do_not_alias(self, clients):
         # regression: the program cache key must resolve the interval the
         # same way _bins_for does (fixed_interval first), or these two
