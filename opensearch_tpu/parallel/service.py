@@ -27,12 +27,13 @@ from ..search.compiler import hist_agg_interval, range_agg_spec
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
                    build_distributed_bincount,
                    build_distributed_cardinality,
+                   build_distributed_ddsketch,
                    build_distributed_metrics,
                    build_distributed_pair_metrics, build_distributed_phrase,
                    build_distributed_range_counts,
                    build_distributed_range_metrics,
                    build_distributed_search, build_distributed_terms_agg,
-                   make_mesh)
+                   build_distributed_weighted_avg, make_mesh)
 
 MAX_WINDOW = 1024
 
@@ -105,7 +106,9 @@ class MeshSearchService:
         self._pair_metrics_programs: Dict[Tuple, object] = {}
         self._range_metrics_programs: Dict[Tuple, object] = {}
         self._card_programs: Dict[Tuple, object] = {}
-        self._card_hashes: Dict[Tuple, tuple] = {}
+        self._card_hashes = _ByteLRU(64 << 20)
+        self._ddsketch_programs: Dict[Tuple, object] = {}
+        self._wavg_programs: Dict[Tuple, object] = {}
         # (index, field) -> (generation, arrays-or-None)
         self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
         # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
@@ -250,6 +253,28 @@ class MeshSearchService:
                 mesh, bucket=bucket, ndocs_pad=ndocs_pad, keyword=keyword,
                 vpad=vpad, k1=k1, b=b, filtered=filtered)
             self._card_programs[key] = fn
+        return fn
+
+    def _ddsketch_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                              k1: float, b: float, filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, k1, b, filtered)
+        fn = self._ddsketch_programs.get(key)
+        if fn is None:
+            fn = build_distributed_ddsketch(
+                mesh, bucket=bucket, ndocs_pad=ndocs_pad, k1=k1, b=b,
+                filtered=filtered)
+            self._ddsketch_programs[key] = fn
+        return fn
+
+    def _wavg_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                          k1: float, b: float, filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, k1, b, filtered)
+        fn = self._wavg_programs.get(key)
+        if fn is None:
+            fn = build_distributed_weighted_avg(
+                mesh, bucket=bucket, ndocs_pad=ndocs_pad, k1=k1, b=b,
+                filtered=filtered)
+            self._wavg_programs[key] = fn
         return fn
 
     def _pair_metrics_program_for(self, mesh, bucket: int, ndocs_pad: int,
@@ -656,6 +681,12 @@ class MeshSearchService:
                            or self._col_for(name, svc, an.body["field"],
                                             shard_segs, stacked.ndocs_pad,
                                             mesh))
+                elif an.kind == "weighted_avg":
+                    got = self._col_for(
+                        name, svc, an.body["value"]["field"], shard_segs,
+                        stacked.ndocs_pad, mesh) and self._col_for(
+                        name, svc, an.body["weight"]["field"], shard_segs,
+                        stacked.ndocs_pad, mesh)
                 else:
                     got = self._col_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
@@ -717,7 +748,9 @@ class MeshSearchService:
         metric_fields = sorted({
             an.body["field"] for it in items for an in it[5]
             if an.kind not in ("terms", "histogram", "date_histogram",
-                               "range", "cardinality")})
+                               "range", "cardinality", "percentiles",
+                               "median_absolute_deviation",
+                               "weighted_avg")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5] if an.kind == "terms"})
         metrics_by_field = {}
@@ -797,18 +830,18 @@ class MeshSearchService:
             if got is not None:
                 val_doc, val_ord, vocab, vpad = got
                 # vocab hashes cached per generation (the O(vocab) python
-                # crc32 loop must not run per request)
+                # crc32 loop must not run per request), byte-bounded like
+                # every other per-(index, field) cache here
+                from ..search.compiler import crc32_vocab_hashes
                 hkey = (name, f)
                 hcached = self._card_hashes.get(hkey)
                 if hcached is not None and hcached[0] == svc.generation:
                     hashes = hcached[1]
                 else:
-                    import zlib
-                    hashes = np.zeros(vpad, np.uint32)
-                    hashes[: len(vocab)] = np.fromiter(
-                        (zlib.crc32(v.encode()) for v in vocab),
-                        np.uint32, count=len(vocab))
-                    self._card_hashes[hkey] = (svc.generation, hashes)
+                    hashes = crc32_vocab_hashes(vocab, vpad)
+                    self._card_hashes.put(hkey,
+                                          (svc.generation, hashes),
+                                          hashes.nbytes)
                 cfn = self._card_program_for(
                     mesh, bucket, stacked.ndocs_pad, True, vpad, k1,
                     b_eff, filtered)
@@ -824,6 +857,38 @@ class MeshSearchService:
                 cargs = (stacked.tree(), rows, boosts, msm, cscore, col,
                          pres) + ((fmask,) if filtered else ())
             card_results[f] = cfn(*cargs)
+
+        # DDSketch histograms (percentiles + median_absolute_deviation
+        # share one program run per field) and weighted_avg moments
+        dd_results = {}
+        dd_fields = sorted({an.body["field"] for it in items
+                            for an in it[5]
+                            if an.kind in ("percentiles",
+                                           "median_absolute_deviation")})
+        for f in dd_fields:
+            col, pres = self._col_for(name, svc, f, shard_segs,
+                                      stacked.ndocs_pad, mesh)
+            dfn = self._ddsketch_program_for(mesh, bucket,
+                                             stacked.ndocs_pad, k1, b_eff,
+                                             filtered)
+            dargs = (stacked.tree(), rows, boosts, msm, cscore, col,
+                     pres) + ((fmask,) if filtered else ())
+            dd_results[f] = dfn(*dargs)
+        wavg_results = {}
+        wavg_pairs = sorted({(an.body["value"]["field"],
+                              an.body["weight"]["field"])
+                             for it in items for an in it[5]
+                             if an.kind == "weighted_avg"})
+        for vf, wf in wavg_pairs:
+            vcol, vpres = self._col_for(name, svc, vf, shard_segs,
+                                        stacked.ndocs_pad, mesh)
+            wcol, wpres = self._col_for(name, svc, wf, shard_segs,
+                                        stacked.ndocs_pad, mesh)
+            wfn = self._wavg_program_for(mesh, bucket, stacked.ndocs_pad,
+                                         k1, b_eff, filtered)
+            wargs = (stacked.tree(), rows, boosts, msm, cscore, vcol,
+                     vpres, wcol, wpres) + ((fmask,) if filtered else ())
+            wavg_results[(vf, wf)] = wfn(*wargs)
 
         hist_results = {}
         hist_bins = {}        # hist key -> device bins (sub-agg pair input)
@@ -899,11 +964,12 @@ class MeshSearchService:
                                   metrics_by_field, tcounts_by_field,
                                   hist_results, range_results,
                                   tsub_results, hsub_results,
-                                  rsub_results, card_results))
+                                  rsub_results, card_results,
+                                  dd_results, wavg_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field, hist_results, range_results,
          tsub_results, hsub_results, rsub_results,
-         card_results) = fetched
+         card_results, dd_results, wavg_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
@@ -963,6 +1029,25 @@ class MeshSearchService:
                 if an.kind == "cardinality":
                     results[0].agg_partials[an.name] = [{
                         "registers": card_results[an.body["field"]][bi]}]
+                    continue
+                if an.kind == "percentiles":
+                    percents = list(an.body.get(
+                        "percents",
+                        (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)))
+                    results[0].agg_partials[an.name] = [{
+                        "hist": dd_results[an.body["field"]][bi],
+                        "percents": percents}]
+                    continue
+                if an.kind == "median_absolute_deviation":
+                    results[0].agg_partials[an.name] = [{
+                        "hist": dd_results[an.body["field"]][bi]}]
+                    continue
+                if an.kind == "weighted_avg":
+                    wv = wavg_results[(an.body["value"]["field"],
+                                       an.body["weight"]["field"])][bi]
+                    results[0].agg_partials[an.name] = [{
+                        "vwsum": float(wv[0]), "wsum": float(wv[1]),
+                        "count": float(wv[2])}]
                     continue
                 m = metrics_by_field[an.body["field"]][bi]
                 results[0].agg_partials[an.name] = [
@@ -1143,6 +1228,19 @@ class MeshSearchService:
             # r5: cardinality as shard-local HLL registers + pmax (the
             # registers ARE the mergeable form, bit-identical to host)
             if an.kind == "cardinality" and set(an.body) == {"field"}:
+                continue
+            # r5: sketch metrics — DDSketch histograms merge by addition
+            # (psum), weighted_avg by summed moments
+            if an.kind == "percentiles" and set(an.body) <= \
+                    {"field", "percents", "keyed"}:
+                continue
+            if an.kind == "median_absolute_deviation" \
+                    and set(an.body) == {"field"}:
+                continue
+            if an.kind == "weighted_avg" \
+                    and set(an.body) <= {"value", "weight"} \
+                    and set(an.body.get("value") or {}) == {"field"} \
+                    and set(an.body.get("weight") or {}) == {"field"}:
                 continue
             if an.kind == "terms" and set(an.body) <= \
                     {"field", "size", "min_doc_count", "order"}:

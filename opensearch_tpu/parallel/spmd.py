@@ -690,7 +690,6 @@ def build_distributed_range_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
 
 def build_distributed_cardinality(mesh: Mesh, bucket: int, ndocs_pad: int,
                                   keyword: bool, vpad: int = 0,
-                                  log2m: Optional[int] = None,
                                   k1: float = 1.2,
                                   b: float = 0.75, filtered: bool = False):
     """`cardinality` over the mesh with EXACT host parity: per shard,
@@ -704,13 +703,11 @@ def build_distributed_cardinality(mesh: Mesh, bucket: int, ndocs_pad: int,
         val_ord [S,NV], ord_hashes u32[vpad] [, fmask])
     keyword=False: (tree, rows, boosts, msm, cscore, col [S,D],
         pres [S,D] [, fmask])
-    -> i32[QB, 2^log2m] registers, already global."""
+    -> i32[QB, 2^HLL_LOG2M] registers, already global."""
     from ..ops import aggs as agg_ops
-    from ..search.compiler import HLL_LOG2M
-    if log2m is None:
-        # the ONE precision constant: mesh registers must stay the same
-        # shape/precision as the host's or the max-merge silently drifts
-        log2m = HLL_LOG2M
+    # the ONE precision constant: mesh registers must stay the same
+    # shape/precision as the host's or the max-merge silently drifts
+    from ..search.compiler import HLL_LOG2M as log2m
 
     def per_device(tree, rows, boosts, msm, cscore, *rest):
         fmask = rest[-1] if filtered else None
@@ -771,6 +768,105 @@ def build_distributed_cardinality(mesh: Mesh, bucket: int, ndocs_pad: int,
     else:
         in_specs = (tree_spec, P("shard", "replica"), P("replica"),
                     P("replica"), P("replica"), P("shard"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
+def build_distributed_ddsketch(mesh: Mesh, bucket: int, ndocs_pad: int,
+                               k1: float = 1.2, b: float = 0.75,
+                               filtered: bool = False):
+    """DDSketch histogram over the mesh (serves BOTH `percentiles` and
+    `median_absolute_deviation`): bins are value-independent global
+    constants, so per-shard histograms merge by plain addition — psum IS
+    the reference's TDigest-merge analog. Returns a callable:
+        (tree, rows, boosts, msm, cscore, col [S,D], pres [S,D] [, fmask])
+        -> f32[QB, DD_NBINS], already global."""
+    from ..ops import aggs as agg_ops
+
+    def per_device(tree, rows, boosts, msm, cscore, col, pres, fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        cv = col[0]
+        pr = pres[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            matchf = (scores > -jnp.inf).astype(jnp.float32)
+            return agg_ops.ddsketch_hist(cv, pr > 0, matchf)
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return jax.lax.psum(part, "shard")
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
+def build_distributed_weighted_avg(mesh: Mesh, bucket: int, ndocs_pad: int,
+                                   k1: float = 1.2, b: float = 0.75,
+                                   filtered: bool = False):
+    """`weighted_avg` over the mesh: psum of (value*weight sum, weight
+    sum, count) over docs present in BOTH columns — the host's
+    weighted_avg_agg moments, reduced once. Returns a callable:
+        (tree, rows, boosts, msm, cscore, vcol, vpres, wcol, wpres
+         [, fmask]) -> f32[QB, 3] = (vwsum, wsum, count), global."""
+
+    def per_device(tree, rows, boosts, msm, cscore, vcol, vpres, wcol,
+                   wpres, fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        vv = vcol[0]
+        vp = vpres[0]
+        wv = wcol[0]
+        wp = wpres[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            ok = (scores > -jnp.inf) & (vp > 0) & (wp > 0)
+            okf = ok.astype(jnp.float32)
+            return jnp.stack([jnp.sum(okf * vv * wv),
+                              jnp.sum(okf * wv),
+                              jnp.sum(okf)])
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return jax.lax.psum(part, "shard")
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"),
+                P("shard"), P("shard"))
     if filtered:
         in_specs = in_specs + (P("shard"),)
     fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,

@@ -3302,19 +3302,26 @@ def parse_interval_ms(s, allow_negative: bool = False) -> int:
     return -v if mm.group(1) == "-" else v
 
 
+def crc32_vocab_hashes(vocab, pad: int) -> np.ndarray:
+    """crc32 of each vocab string, zero-padded to `pad` — the HLL value
+    hashes; shared by the host segment path and the mesh service so the
+    two register sets merge bit-identically."""
+    import zlib
+    out = np.zeros(pad, dtype=np.uint32)
+    out[: len(vocab)] = np.fromiter(
+        (zlib.crc32(v.encode()) for v in vocab), np.uint32,
+        count=len(vocab))
+    return out
+
+
 def _kw_hash_cache(seg: Segment, field: str) -> np.ndarray:
     cache = getattr(seg, "_kw_hash_cache", None)
     if cache is None:
         cache = seg._kw_hash_cache = {}
     if field not in cache:
         col = seg.keyword_cols[field]
-        import zlib
-        h = np.fromiter((zlib.crc32(v.encode()) for v in col.vocab),
-                        dtype=np.uint32, count=len(col.vocab))
-        pad = next_pow2(max(len(h), 1))
-        out = np.zeros(pad, dtype=np.uint32)
-        out[: len(h)] = h
-        cache[field] = out
+        cache[field] = crc32_vocab_hashes(
+            col.vocab, next_pow2(max(len(col.vocab), 1)))
     return cache[field]
 
 

@@ -841,6 +841,44 @@ class TestMeshBucketAggs:
             assert rm["aggregations"][aname] == rh["aggregations"][aname], \
                 (aname, rm["aggregations"][aname], rh["aggregations"][aname])
 
+    @pytest.mark.parametrize("aggs", [
+        # r5: sketch metrics — DDSketch hists psum, weighted_avg moments
+        {"p": {"percentiles": {"field": "num"}}},
+        {"p": {"percentiles": {"field": "num",
+                               "percents": [50.0, 90.0]}}},
+        {"m": {"median_absolute_deviation": {"field": "num"}}},
+        {"w": {"weighted_avg": {"value": {"field": "num"},
+                                "weight": {"field": "num"}}}},
+        {"p": {"percentiles": {"field": "num"}},
+         "m": {"median_absolute_deviation": {"field": "num"}},
+         "c": {"cardinality": {"field": "status"}}},
+    ])
+    def test_sketch_metric_parity(self, clients, aggs):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 0,
+                "aggs": aggs}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the sketch-metric body"
+        for aname in aggs:
+            assert rm["aggregations"][aname] == rh["aggregations"][aname], \
+                (aname, rm["aggregations"][aname], rh["aggregations"][aname])
+
+    def test_weighted_avg_missing_falls_back(self, clients):
+        # `missing` defaults aren't meshed: host loop, same answer
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {"w": {"weighted_avg": {
+                    "value": {"field": "num", "missing": 5},
+                    "weight": {"field": "num"}}}}}
+        f0 = cm.node.mesh_service.fallbacks
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.fallbacks == f0 + 1
+        assert rm["aggregations"]["w"] == rh["aggregations"]["w"]
+
     def test_filtered_cardinality_parity(self, clients):
         cm, ch = clients
         body = {"query": {"bool": {
