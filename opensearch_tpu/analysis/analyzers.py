@@ -142,6 +142,13 @@ class AnalysisRegistry:
             return Analyzer("identity", keyword_tokenizer, [])
         if name == "lowercase":
             return Analyzer("lowercase", keyword_tokenizer, [lowercase_filter])
+        if name.startswith("_icu_collation:"):
+            # internal: icu_collation_keyword fields normalize values to
+            # collation sort keys (strength encoded in the name)
+            from .unicode_plugins import make_collation_key_filter
+            return Analyzer(name, keyword_tokenizer,
+                            [make_collation_key_filter(
+                                name.split(":", 1)[1])])
         custom = self._settings.get("normalizer", {}).get(name)
         if custom is not None:
             filters = [self._resolve_filter(f) for f in custom.get("filter", [])]

@@ -203,3 +203,34 @@ def make_icu_transform_filter(transform_id: str = "Any-Latin"):
         return out
 
     return icu_transform
+
+
+# ---------------------------------------------------------------------
+# icu_collation_keyword (plugins/analysis-icu ICUCollationKeywordFieldMapper)
+# — collation SORT KEYS approximating the ICU strength cascade: primary
+# (base letters) > secondary (accents) > tertiary (case). Within-level
+# ordering uses codepoint order rather than DUCET weights (documented
+# approximation; the image has no ICU collation tables). Keys are what
+# gets indexed and stored in doc values, so term queries, sorting, and
+# aggregations all operate in collation space, like the reference.
+# ---------------------------------------------------------------------
+
+def collation_key(s: str, strength: str = "tertiary") -> str:
+    nfkd = unicodedata.normalize("NFKD", s)
+    base = "".join(c for c in nfkd
+                   if unicodedata.category(c) != "Mn").casefold()
+    if strength == "primary":
+        return base
+    marks = "".join(c for c in nfkd if unicodedata.category(c) == "Mn")
+    if strength == "secondary":
+        return f"{base}\x01{marks}"
+    case_sig = "".join("1" if c.isupper() else "0" for c in nfkd
+                       if unicodedata.category(c) != "Mn")
+    return f"{base}\x01{marks}\x01{case_sig}"
+
+
+def make_collation_key_filter(strength: str = "tertiary"):
+    def collation_filter(tokens: List[Token]) -> List[Token]:
+        return [t.with_text(collation_key(t.text, strength))
+                for t in tokens]
+    return collation_filter

@@ -22,7 +22,8 @@ from ..analysis import AnalysisRegistry, Analyzer
 
 TEXT_TYPES = {"text", "match_only_text", "search_as_you_type",
               "annotated_text"}
-KEYWORD_TYPES = {"keyword", "ip", "constant_keyword", "flat_object"}
+KEYWORD_TYPES = {"keyword", "ip", "constant_keyword", "flat_object",
+                 "icu_collation_keyword"}
 INT_TYPES = {"long", "integer", "short", "byte", "date", "boolean",
              "unsigned_long", "token_count"}
 FLOAT_TYPES = {"double", "float", "half_float", "rank_feature",
@@ -324,11 +325,26 @@ class Mappings:
                 self.join_field = path
 
     def _build_field(self, path: str, ftype: str, cfg: dict) -> FieldType:
+        normalizer = cfg.get("normalizer")
+        if ftype == "icu_collation_keyword":
+            # reference ICUCollationKeywordFieldMapper
+            # (plugins/analysis-icu): values index and doc-value as
+            # collation SORT KEYS, so term queries / sorting / aggs all
+            # operate in collation space. `language`/`country` accepted
+            # for API parity; key construction is locale-independent
+            # (strength cascade approximated; see unicode_plugins)
+            strength = cfg.get("strength", "tertiary")
+            if strength not in ("primary", "secondary", "tertiary"):
+                raise ValueError(
+                    f"[icu_collation_keyword] field [{path}]: unsupported "
+                    f"strength [{strength}] (supported: primary, "
+                    f"secondary, tertiary)")
+            normalizer = f"_icu_collation:{strength}"
         ft = FieldType(
             name=path, type=ftype,
             analyzer=cfg.get("analyzer", "standard"),
             search_analyzer=cfg.get("search_analyzer"),
-            normalizer=cfg.get("normalizer"),
+            normalizer=normalizer,
             index=cfg.get("index", True),
             doc_values=cfg.get("doc_values", True),
             store=cfg.get("store", False),
@@ -408,7 +424,13 @@ class Mappings:
                 d["relations"] = ft.relations
             if ft.type == "text" and ft.analyzer != "standard":
                 d["analyzer"] = ft.analyzer
-            if ft.normalizer:
+            if ft.type == "icu_collation_keyword":
+                # round-trip the strength PARAM, not the internal
+                # normalizer name (feeding the mapping back into create
+                # must reproduce the same field)
+                d["strength"] = (ft.normalizer or "_icu_collation:tertiary"
+                                 ).split(":", 1)[1]
+            elif ft.normalizer:
                 d["normalizer"] = ft.normalizer
             if not ft.index:
                 d["index"] = False
@@ -763,7 +785,7 @@ class Mappings:
             parsed.numerics.setdefault(f"{name}#lo", []).append(lo)
             parsed.numerics.setdefault(f"{name}#hi", []).append(hi)
             return
-        if ft.type == "keyword":
+        if ft.type in ("keyword", "icu_collation_keyword"):
             s = str(v)
             if ft.ignore_above is not None and len(s) > ft.ignore_above:
                 return

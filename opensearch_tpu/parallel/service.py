@@ -1031,9 +1031,9 @@ class MeshSearchService:
                         "registers": card_results[an.body["field"]][bi]}]
                     continue
                 if an.kind == "percentiles":
-                    percents = list(an.body.get(
-                        "percents",
-                        (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)))
+                    from ..search.compiler import DEFAULT_PERCENTS
+                    percents = list(an.body.get("percents",
+                                                DEFAULT_PERCENTS))
                     results[0].agg_partials[an.name] = [{
                         "hist": dd_results[an.body["field"]][bi],
                         "percents": percents}]

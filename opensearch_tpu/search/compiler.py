@@ -42,6 +42,9 @@ from .aggregations import AggNode
 
 INT32_SENTINEL = np.int32(2**31 - 1)
 HLL_LOG2M = 14
+# reference PercentilesAggregationBuilder defaults — shared with the mesh
+# service so host and mesh never drift
+DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
 PCTL_BINS = 4096
 
 
@@ -3735,7 +3738,7 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
     if kind == "percentiles":
         field = _resolve_agg_field(node, ctx)
         col = seg.numeric_cols.get(field)
-        percents = tuple(body.get("percents", (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)))
+        percents = tuple(body.get("percents", DEFAULT_PERCENTS))
         return ("pctl", prefix, field, col is not None, percents)
 
     if kind == "top_hits":

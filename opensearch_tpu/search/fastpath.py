@@ -58,8 +58,8 @@ _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 # served/fallback counters (surfaced in _nodes/stats; also used by tests to
 # prove the kernel actually engaged rather than silently falling back)
 STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0,
-         "pruned_served": 0, "pruned_rescued": 0, "pruned_escalated": 0,
-         "shard_view_served": 0}
+         "pruned_served": 0, "pruned_rescued": 0, "pruned_rescued2": 0,
+         "pruned_escalated": 0, "shard_view_served": 0}
 
 # optional memory accounting set by the Node (utils/breaker.py): charged
 # before aligned arrays go to device, released when the segment is GC'd
@@ -145,7 +145,7 @@ class AlignedPostings:
 
     __slots__ = ("starts_rows", "lens", "d_docs", "d_tfdl", "nbytes",
                  "head_starts_rows", "head_lens", "rem_frontiers",
-                 "head_ids", "_full_frontiers")
+                 "head_ids", "_full_frontiers", "_head2")
 
     def __init__(self, starts_rows: np.ndarray, lens: np.ndarray,
                  d_docs, d_tfdl, nbytes: int,
@@ -171,6 +171,26 @@ class AlignedPostings:
         # candidate-union escalation path rescores exactly these
         self.head_ids = head_ids or {}
         self._full_frontiers: dict = {}
+        # row -> (ids, remainder frontier) of the TIER-2 head (4x deeper,
+        # host-only): built lazily on first escalation past tier 1, cached
+        self._head2: dict = {}
+
+    def head2(self, pb, dl_col, row: int) -> tuple:
+        """Lazy 4x-deeper head for the second escalation rung: top
+        4*L_HEAD postings by nominal impact (ids only — the rescore is a
+        host pass) plus the frontier of what remains. O(df log df) once
+        per queried row, amortized across every later escalation."""
+        got = self._head2.get(row)
+        if got is None:
+            a, b = pb.row_slice(row)
+            dls = (dl_col[pb.doc_ids[a:b]] if dl_col is not None
+                   else np.zeros(b - a, np.int64))
+            keep, fr = _head_select(pb.doc_ids[a:b], pb.tfs[a:b],
+                                    np.asarray(dls, np.int64),
+                                    l_head=4 * L_HEAD)
+            got = (pb.doc_ids[a:b][keep], fr)
+            self._head2[row] = got
+        return got
 
     def clamped(self, row: int) -> bool:
         return row in self.rem_frontiers
@@ -207,8 +227,9 @@ def get_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     return out
 
 
-def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray
-                 ) -> Tuple[np.ndarray, Tuple[np.ndarray, np.ndarray]]:
+def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray,
+                 l_head: int = None
+                 ) -> Tuple[np.ndarray, tuple]:
     """Pick the L_HEAD highest-impact postings of one oversized row.
     Impact = tf/(tf + k1·(1-b+b·dl/avgdl)) with nominal params — the order
     only steers which postings we keep; correctness rides on the returned
@@ -223,8 +244,9 @@ def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray
     # stable sort: impact ties keep doc-ascending order, matching the exact
     # path's doc-id tie-break so a tied top-k boundary selects the same docs
     order = np.argsort(-c, kind="stable")
-    keep = order[:L_HEAD]
-    rest = order[L_HEAD:]
+    lh = L_HEAD if l_head is None else l_head
+    keep = order[:lh]
+    rest = order[lh:]
     return np.sort(keep), _frontier(tf[rest], dlf[rest], doc_ids[rest])
 
 
@@ -836,7 +858,8 @@ def _exact_rescore(seg: Segment, vq: _VQuery, cand: np.ndarray
     return exact, counts
 
 
-def _noheads_bound(al: AlignedPostings, vq: _VQuery) -> float:
+def _noheads_bound(al: AlignedPostings, vq: _VQuery,
+                   frontier_of=None) -> float:
     """Max TRUE score of any doc outside EVERY queried head (the unseen
     docs of the candidate-union escalation): all of its contributions come
     from clamped remainders and share ONE doc length d, so
@@ -846,7 +869,9 @@ def _noheads_bound(al: AlignedPostings, vq: _VQuery) -> float:
     is decreasing and feasibility increasing in d, so the max over real
     lengths is attained on that grid). Docs matching fewer than msm terms
     can't pass, so grid points with too few feasible terms are skipped.
-    Unclamped rows don't appear: any doc matching one is a candidate."""
+    Unclamped rows don't appear: any doc matching one is a candidate.
+    `frontier_of` overrides the per-row remainder frontier (the tier-2
+    rescue passes its deeper-cut frontiers)."""
     cl = [i for i, r in enumerate(vq.rows)
           if r >= 0 and al.clamped(int(r))]
     if not cl:
@@ -854,7 +879,11 @@ def _noheads_bound(al: AlignedPostings, vq: _VQuery) -> float:
     fronts = []
     ds = []
     for i in cl:
-        fr = al.rem_frontiers.get(int(vq.rows[i]))
+        row = int(vq.rows[i])
+        fr = (frontier_of(row) if frontier_of is not None
+              else al.rem_frontiers.get(row))
+        if fr is None:
+            continue
         tfv = np.asarray(fr[0], np.float64)
         dlv = np.asarray(fr[1], np.float64)
         if len(tfv):
@@ -894,38 +923,56 @@ def _phase2_rescore(seg: Segment, vq: _VQuery, window: int, K: int
     threshold on real corpora. Totals stay the 'gte' contract."""
     al = get_aligned(seg, vq.field)
     pb = seg.postings.get(vq.field)
-    ids = []
-    for r in vq.rows:
-        if r < 0:
-            continue
-        r = int(r)
-        hid = al.head_ids.get(r)
-        if hid is None:
-            a, b = pb.row_slice(r)
-            hid = pb.doc_ids[a:b]
-        ids.append(np.asarray(hid, np.int64))
-    if not ids:
-        return None
-    cand = np.unique(np.concatenate(ids))
-    if len(cand) == 0:
-        return None
-    exact, counts = _exact_rescore(seg, vq, cand)
-    pass_msm = counts >= vq.msm_true
-    n_pass = int(pass_msm.sum())
-    exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
-    order = np.lexsort((cand, -exact_m))
-    theta = (float(exact_m[order[window - 1]]) if n_pass >= window
-             else -np.inf)
-    bound = _noheads_bound(al, vq)
-    # equality escalates (frontier bounds are attained), as in phase 1
-    if bound >= theta:
-        return None
-    keep = order[pass_msm[order]][:K]
-    sc2 = np.full(K, -np.inf, np.float32)
-    dc2 = np.full(K, -1, np.int32)
-    sc2[: len(keep)] = exact_m[keep]
-    dc2[: len(keep)] = cand[keep].astype(np.int32)
-    return (sc2, dc2, n_pass, "gte")
+    dl_col = seg.doc_lens.get(vq.field)
+
+    def attempt(ids_of, frontier_of):
+        ids = []
+        for r in vq.rows:
+            if r < 0:
+                continue
+            r = int(r)
+            hid = ids_of(r)
+            if hid is None:
+                a, b = pb.row_slice(r)
+                hid = pb.doc_ids[a:b]
+            ids.append(np.asarray(hid, np.int64))
+        if not ids:
+            return None
+        cand = np.unique(np.concatenate(ids))
+        if len(cand) == 0:
+            return None
+        exact, counts = _exact_rescore(seg, vq, cand)
+        pass_msm = counts >= vq.msm_true
+        n_pass = int(pass_msm.sum())
+        exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
+        order = np.lexsort((cand, -exact_m))
+        theta = (float(exact_m[order[window - 1]]) if n_pass >= window
+                 else -np.inf)
+        bound = _noheads_bound(al, vq, frontier_of)
+        # equality escalates (frontier bounds are attained), as in phase 1
+        if bound >= theta:
+            return None
+        keep = order[pass_msm[order]][:K]
+        sc2 = np.full(K, -np.inf, np.float32)
+        dc2 = np.full(K, -1, np.int32)
+        sc2[: len(keep)] = exact_m[keep]
+        dc2[: len(keep)] = cand[keep].astype(np.int32)
+        return (sc2, dc2, n_pass, "gte")
+
+    out = attempt(al.head_ids.get, None)
+    if out is not None:
+        return out
+    # tier 2: 4x-deeper lazy heads for the clamped rows — the remainder
+    # bound drops with the cut depth, catching most of the multi-term
+    # stopword-class tail before any dense launch
+    h2 = {int(r): al.head2(pb, dl_col, int(r))
+          for r in vq.rows if r >= 0 and al.clamped(int(r))}
+    out = attempt(lambda row: h2[row][0] if row in h2 else None,
+                  lambda row: h2[row][1] if row in h2
+                  else al.rem_frontiers.get(row))
+    if out is not None:
+        STATS["pruned_rescued2"] += 1
+    return out
 
 
 def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,

@@ -77,3 +77,67 @@ class TestCjk:
         # no bigram with the standard run handling, so search with a pair
         r2 = c.search("cj", {"query": {"match_phrase": {"body": "京都"}}})
         assert [h["_id"] for h in r2["hits"]["hits"]] == ["2"]
+
+
+class TestIcuCollationKeyword:
+    """reference plugins/analysis-icu ICUCollationKeywordFieldMapper:
+    values index/doc-value as collation sort keys."""
+
+    def test_sort_and_term_query_in_collation_space(self):
+        c = RestClient()
+        c.indices.create("col", {"mappings": {"properties": {
+            "name": {"type": "icu_collation_keyword"},
+            "namep": {"type": "icu_collation_keyword",
+                      "strength": "primary"}}}})
+        for i, v in enumerate(["Ärger", "Zebra", "arm", "Apfel"]):
+            c.index("col", {"name": v, "namep": v}, id=str(i))
+        c.indices.refresh("col")
+        # collation sort: Ä sorts with A (not after Z as raw codepoints)
+        r = c.search("col", {"query": {"match_all": {}}, "size": 10,
+                             "sort": [{"name": {"order": "asc"}}]})
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        order = [["Ärger", "Zebra", "arm", "Apfel"][int(i)] for i in ids]
+        assert order == ["Apfel", "Ärger", "arm", "Zebra"], order
+        # primary strength: term query conflates case+accents
+        r2 = c.search("col", {"query": {"term": {"namep": "ärger"}}})
+        assert [h["_id"] for h in r2["hits"]["hits"]] == ["0"]
+        r3 = c.search("col", {"query": {"term": {"namep": "APFEL"}}})
+        assert [h["_id"] for h in r3["hits"]["hits"]] == ["3"]
+
+    def test_tertiary_distinguishes_case(self):
+        c = RestClient()
+        c.indices.create("col2", {"mappings": {"properties": {
+            "k": {"type": "icu_collation_keyword"}}}})
+        c.index("col2", {"k": "Foo"}, id="1", refresh=True)
+        # tertiary (default): exact value matches, different case doesn't
+        r = c.search("col2", {"query": {"term": {"k": "Foo"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+        r2 = c.search("col2", {"query": {"term": {"k": "foo"}}})
+        assert r2["hits"]["hits"] == []
+
+    def test_mapping_round_trip_preserves_strength(self):
+        # regression: GET _mapping must emit the strength PARAM (not the
+        # internal normalizer), and feeding it back must reproduce the
+        # same field behavior
+        c = RestClient()
+        c.indices.create("col3", {"mappings": {"properties": {
+            "k": {"type": "icu_collation_keyword",
+                  "strength": "primary"}}}})
+        m = c.indices.get_mapping("col3")["col3"]["mappings"]
+        cfg = m["properties"]["k"]
+        assert cfg["type"] == "icu_collation_keyword"
+        assert cfg["strength"] == "primary"
+        assert "normalizer" not in cfg
+        c.indices.create("col4", {"mappings": m})
+        c.index("col4", {"k": "Ärger"}, id="1", refresh=True)
+        r = c.search("col4", {"query": {"term": {"k": "arger"}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"]
+
+    def test_bad_strength_rejected(self):
+        import pytest as _pytest
+        from opensearch_tpu.rest.client import ApiError
+        c = RestClient()
+        with _pytest.raises((ValueError, ApiError)):
+            c.indices.create("colbad", {"mappings": {"properties": {
+                "k": {"type": "icu_collation_keyword",
+                      "strength": "quaternary"}}}})
