@@ -58,8 +58,9 @@ _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 # served/fallback counters (surfaced in _nodes/stats; also used by tests to
 # prove the kernel actually engaged rather than silently falling back)
 STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0,
-         "pruned_served": 0, "pruned_rescued": 0, "pruned_rescued2": 0,
-         "pruned_escalated": 0, "shard_view_served": 0}
+         "pruned_served": 0, "pruned_dview": 0, "pruned_rescued": 0,
+         "pruned_rescued2": 0, "pruned_escalated": 0,
+         "shard_view_served": 0}
 
 # optional memory accounting set by the Node (utils/breaker.py): charged
 # before aligned arrays go to device, released when the segment is GC'd
@@ -859,7 +860,7 @@ def _exact_rescore(seg: Segment, vq: _VQuery, cand: np.ndarray
 
 
 def _noheads_bound(al: AlignedPostings, vq: _VQuery,
-                   frontier_of=None) -> float:
+                   frontier_of=None, rows_all: bool = False) -> float:
     """Max TRUE score of any doc outside EVERY queried head (the unseen
     docs of the candidate-union escalation): all of its contributions come
     from clamped remainders and share ONE doc length d, so
@@ -871,9 +872,14 @@ def _noheads_bound(al: AlignedPostings, vq: _VQuery,
     can't pass, so grid points with too few feasible terms are skipped.
     Unclamped rows don't appear: any doc matching one is a candidate.
     `frontier_of` overrides the per-row remainder frontier (the tier-2
-    rescue passes its deeper-cut frontiers)."""
-    cl = [i for i, r in enumerate(vq.rows)
-          if r >= 0 and al.clamped(int(r))]
+    rescue passes its deeper-cut frontiers); `rows_all` makes EVERY valid
+    row participate (the quality-tier view restricts every row, so every
+    term has out-of-view postings an unseen doc could match)."""
+    if rows_all:
+        cl = [i for i, r in enumerate(vq.rows) if r >= 0]
+    else:
+        cl = [i for i, r in enumerate(vq.rows)
+              if r >= 0 and al.clamped(int(r))]
     if not cl:
         return -np.inf
     fronts = []
@@ -975,6 +981,136 @@ def _phase2_rescore(seg: Segment, vq: _VQuery, window: int, K: int
     return out
 
 
+QUALITY_SHARE = 8       # quality tier keeps ~ndocs/QUALITY_SHARE docs
+QUALITY_MIN_NDOCS = 1 << 16   # below this, dense is already cheap
+
+
+def _quality_tier(seg: Segment, field: str):
+    """Query-independent static index pruning (the device analog of the
+    'quality-tier' / static pruning literature Lucene-world engines use
+    for service tiers): keep the ~1/QUALITY_SHARE docs whose BEST
+    per-posting nominal impact is highest. Scores on the restricted view
+    are EXACT for view docs (the view restricts DOCS, so a kept doc keeps
+    every posting), and every posting of an outside doc has nominal
+    impact < tau by construction — the per-row out-of-view frontiers
+    certify the served window under any query-time similarity. One
+    vectorized pass per (segment, field), cached.
+
+    Returns (FilterList, frontier_of) or None (segment too small /
+    ineligible layout)."""
+    cache = seg.__dict__.setdefault("_fastpath_quality", {})
+    if field in cache:
+        return cache[field]
+    out = None
+    pb = seg.postings.get(field)
+    dl = seg.doc_lens.get(field)
+    # real Segments only: the filter-cache infrastructure keys on seg.uid,
+    # which ShardView/FilteredSegView facades don't have — those continue
+    # to the dense rung as before
+    if (pb is not None and pb.size > 0 and seg.ndocs >= QUALITY_MIN_NDOCS
+            and getattr(seg, "uid", None) is not None
+            and get_aligned(seg, field) is not None):
+        dl_of = (dl[pb.doc_ids].astype(np.float32) if dl is not None
+                 else np.zeros(len(pb.doc_ids), np.float32))
+        avg = max(float(dl_of.mean()), 1.0)
+        imp = pb.tfs / (pb.tfs + 1.2 * (0.25 + 0.75 * dl_of / avg))
+        docmax = np.zeros(seg.ndocs, np.float32)
+        np.maximum.at(docmax, pb.doc_ids, imp)
+        target = max(seg.ndocs // QUALITY_SHARE, QUALITY_MIN_NDOCS // 4)
+        tau = float(np.partition(docmax, seg.ndocs - target)
+                    [seg.ndocs - target])
+        mask = docmax >= tau
+        if 0 < mask.sum() < seg.ndocs:
+            host_docs = np.flatnonzero(mask).astype(np.int32)
+            fl = FilterList(host_docs, None, len(host_docs), 0, mask,
+                            ("_quality", field, QUALITY_SHARE))
+            frontiers: dict = {}
+
+            def frontier_of(row: int, _f=frontiers, _pb=pb, _dl=dl,
+                            _mask=mask):
+                # per-row slices derived on demand: only the tiny
+                # frontiers are retained, not per-posting arrays
+                fr = _f.get(row)
+                if fr is None:
+                    a, b = _pb.row_slice(row)
+                    rd = _pb.doc_ids[a:b]
+                    sel = ~_mask[rd]
+                    dls = (_dl[rd[sel]].astype(np.float32)
+                           if _dl is not None
+                           else np.zeros(int(sel.sum()), np.float32))
+                    fr = _frontier(_pb.tfs[a:b][sel], dls)
+                    _f[row] = fr
+                return fr
+
+            out = (fl, frontier_of)
+    cache[field] = out
+    return out
+
+
+def _dview_rescue(seg: Segment, ctx, lts: Sequence, specs: Sequence,
+                  vq_lists, results: dict, redo: List[int], K: int
+                  ) -> List[int]:
+    """Quality-tier escalation rung: run ALL still-unproven queries as ONE
+    batched dense launch over the quality view (exact scores, ~1/8 the
+    postings), certify each against the out-of-view frontiers, and return
+    the queries that still need the full dense pass. Mixed-field batches
+    group per field (one view launch each)."""
+    by_field: dict = {}
+    for qi in redo:
+        by_field.setdefault(vq_lists[qi][0].field, []).append(qi)
+    still: List[int] = []
+    for field, qis in by_field.items():
+        still.extend(_dview_rescue_field(seg, ctx, lts, specs, vq_lists,
+                                         results, qis, K, field))
+    STATS["pruned_dview"] += len(redo) - len(still)
+    return still
+
+
+def _dview_rescue_field(seg: Segment, ctx, lts: Sequence, specs: Sequence,
+                        vq_lists, results: dict, redo: List[int], K: int,
+                        field: str) -> List[int]:
+    qt = _quality_tier(seg, field)
+    if qt is None:
+        return redo
+    fl, frontier_of = qt
+    fp = _filtered_postings(seg, field, fl)
+    if fp is None:
+        return redo
+    view = _filtered_view(seg, field, fp, (seg.uid, field, fl.key))
+    al = get_aligned(seg, field)
+    dlists = _prepare_vqueries(view, ctx, [lts[qi] for qi in redo], {})
+    if dlists is None:
+        return redo
+    vres = _launch_pure_groups(view, dlists, K)
+    still = []
+    for qi, dvqs in zip(redo, dlists):
+        served = False
+        if dvqs is not None:
+            if len(dvqs) == 1:
+                sc, dc, total, _ = vres[id(dvqs[0])]
+            else:
+                parts = [vres[id(v)] for v in dvqs]
+                sc = np.concatenate([p[0] for p in parts])
+                dc = np.concatenate([p[1] for p in parts])
+                total = sum(p[2] for p in parts)
+                order = np.lexsort((dc, -sc))[:K]
+                sc, dc = sc[order], dc[order]
+            valid = np.isfinite(sc) & (dc >= 0)
+            window = int(specs[qi].window or K)
+            theta = (float(sc[valid][window - 1])
+                     if int(valid.sum()) >= window else -np.inf)
+            # the ORIGINAL (pruned) vq carries .rows/.weights — same term
+            # rows as the view launch, which runs the dense shape
+            ovq = vq_lists[qi][0]
+            bound = _noheads_bound(al, ovq, frontier_of, rows_all=True)
+            if bound < theta:
+                results[id(ovq)] = (sc[:K], dc[:K], int(total), "gte")
+                served = True
+        if not served:
+            still.append(qi)
+    return still
+
+
 def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
                    total: int, window: int, K: int) -> Optional[tuple]:
     """Prove a clamped pruned result exact, or None -> rerun dense.
@@ -1059,10 +1195,19 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
             if ver2 is not None:
                 results[id(vq)] = ver2
                 rescued += 1
+                STATS["pruned_rescued"] += 1
             else:
                 still.append(qi)
-        STATS["pruned_rescued"] += rescued
         redo = still
+    if redo:
+        # last rung before dense: ONE batched exact launch over the
+        # quality-tier view (~1/8 the postings). Only the hard tail pays
+        # it; a certify saves the 8x-bigger dense launch, a miss adds a
+        # small fraction of the dense cost it was about to pay anyway
+        n_redo = len(redo)
+        redo = _dview_rescue(seg, ctx, lts, specs, vq_lists, results,
+                             redo, K)
+        rescued += n_redo - len(redo)
     if redo:
         STATS["pruned_escalated"] += len(redo)
         dense_lists = _prepare_vqueries(seg, ctx, [lts[qi] for qi in redo],
