@@ -28,6 +28,7 @@ from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
                    build_distributed_bincount,
                    build_distributed_cardinality,
                    build_distributed_ddsketch,
+                   build_distributed_geo_stat,
                    build_distributed_metrics,
                    build_distributed_pair_metrics, build_distributed_phrase,
                    build_distributed_range_counts,
@@ -109,6 +110,7 @@ class MeshSearchService:
         self._card_hashes = _ByteLRU(64 << 20)
         self._ddsketch_programs: Dict[Tuple, object] = {}
         self._wavg_programs: Dict[Tuple, object] = {}
+        self._geo_programs: Dict[Tuple, object] = {}
         # (index, field) -> (generation, arrays-or-None)
         self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
         # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
@@ -241,6 +243,53 @@ class MeshSearchService:
                                                 k1=k1, b=b,
                                                 filtered=filtered)
             self._range_programs[key] = fn
+        return fn
+
+    def _geo_for(self, name: str, svc, field: str, shard_segs,
+                 d_pad: int, mesh) -> Optional[tuple]:
+        """Stacked geo lat/lon/presence [S, d_pad] sharded over the mesh;
+        None when no segment has the geo column. Cached per generation."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = ("geo", name, field)
+        cached = self._stacked_cols.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        if not any(field in seg.geo_cols
+                   for segs in shard_segs for seg in segs):
+            self._stacked_cols.put(key, (svc.generation, None), 0)
+            return None
+        S = len(shard_segs)
+        lat = np.zeros((S, d_pad), np.float32)
+        lon = np.zeros((S, d_pad), np.float32)
+        pres = np.zeros((S, d_pad), np.float32)
+        for si, segs in enumerate(shard_segs):
+            off = 0
+            for seg in segs:
+                gc = seg.geo_cols.get(field)
+                if gc is not None:
+                    lat[si, off: off + seg.ndocs] = gc.lat
+                    lon[si, off: off + seg.ndocs] = gc.lon
+                    pres[si, off: off + seg.ndocs] = \
+                        gc.present.astype(np.float32)
+                off += seg.ndocs
+        sh = NamedSharding(mesh, P("shard"))
+        out = (jax.device_put(lat, sh), jax.device_put(lon, sh),
+               jax.device_put(pres, sh))
+        self._stacked_cols.put(key, (svc.generation, out),
+                               lat.nbytes * 3)
+        return out
+
+    def _geo_program_for(self, mesh, bucket: int, ndocs_pad: int,
+                         k1: float, b: float, filtered: bool = False):
+        key = (id(mesh), bucket, ndocs_pad, k1, b, filtered)
+        fn = self._geo_programs.get(key)
+        if fn is None:
+            fn = build_distributed_geo_stat(
+                mesh, bucket=bucket, ndocs_pad=ndocs_pad, k1=k1, b=b,
+                filtered=filtered)
+            self._geo_programs[key] = fn
         return fn
 
     def _card_program_for(self, mesh, bucket: int, ndocs_pad: int,
@@ -687,6 +736,10 @@ class MeshSearchService:
                         stacked.ndocs_pad, mesh) and self._col_for(
                         name, svc, an.body["weight"]["field"], shard_segs,
                         stacked.ndocs_pad, mesh)
+                elif an.kind in ("geo_bounds", "geo_centroid"):
+                    got = self._geo_for(name, svc, an.body["field"],
+                                        shard_segs, stacked.ndocs_pad,
+                                        mesh)
                 else:
                     got = self._col_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
@@ -750,7 +803,8 @@ class MeshSearchService:
             if an.kind not in ("terms", "histogram", "date_histogram",
                                "range", "cardinality", "percentiles",
                                "median_absolute_deviation",
-                               "weighted_avg")})
+                               "weighted_avg", "geo_bounds",
+                               "geo_centroid")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5] if an.kind == "terms"})
         metrics_by_field = {}
@@ -890,6 +944,19 @@ class MeshSearchService:
                      vpres, wcol, wpres) + ((fmask,) if filtered else ())
             wavg_results[(vf, wf)] = wfn(*wargs)
 
+        geo_results = {}
+        geo_fields = sorted({an.body["field"] for it in items
+                             for an in it[5]
+                             if an.kind in ("geo_bounds", "geo_centroid")})
+        for f in geo_fields:
+            glat, glon, gpres = self._geo_for(name, svc, f, shard_segs,
+                                              stacked.ndocs_pad, mesh)
+            gfn = self._geo_program_for(mesh, bucket, stacked.ndocs_pad,
+                                        k1, b_eff, filtered)
+            gargs = (stacked.tree(), rows, boosts, msm, cscore, glat,
+                     glon, gpres) + ((fmask,) if filtered else ())
+            geo_results[f] = gfn(*gargs)
+
         hist_results = {}
         hist_bins = {}        # hist key -> device bins (sub-agg pair input)
         hist_pairs = {}       # hist key -> (val_doc, val_ord) device pairs
@@ -965,11 +1032,12 @@ class MeshSearchService:
                                   hist_results, range_results,
                                   tsub_results, hsub_results,
                                   rsub_results, card_results,
-                                  dd_results, wavg_results))
+                                  dd_results, wavg_results, geo_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field, hist_results, range_results,
          tsub_results, hsub_results, rsub_results,
-         card_results, dd_results, wavg_results) = fetched
+         card_results, dd_results, wavg_results,
+         geo_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
@@ -1048,6 +1116,18 @@ class MeshSearchService:
                     results[0].agg_partials[an.name] = [{
                         "vwsum": float(wv[0]), "wsum": float(wv[1]),
                         "count": float(wv[2])}]
+                    continue
+                if an.kind in ("geo_bounds", "geo_centroid"):
+                    g = geo_results[an.body["field"]][bi]
+                    if an.kind == "geo_bounds":
+                        results[0].agg_partials[an.name] = [{
+                            "count": float(g[0]), "top": float(g[1]),
+                            "bottom": float(g[2]), "left": float(g[3]),
+                            "right": float(g[4])}]
+                    else:
+                        results[0].agg_partials[an.name] = [{
+                            "count": float(g[0]), "slat": float(g[5]),
+                            "slon": float(g[6])}]
                     continue
                 m = metrics_by_field[an.body["field"]][bi]
                 results[0].agg_partials[an.name] = [
@@ -1241,6 +1321,11 @@ class MeshSearchService:
                     and set(an.body) <= {"value", "weight"} \
                     and set(an.body.get("value") or {}) == {"field"} \
                     and set(an.body.get("weight") or {}) == {"field"}:
+                continue
+            # r5: geo_bounds/geo_centroid — masked lat/lon extremes and
+            # centroid moments, pmax/pmin/psum over the shard axis
+            if an.kind in ("geo_bounds", "geo_centroid") \
+                    and set(an.body) == {"field"}:
                 continue
             if an.kind == "terms" and set(an.body) <= \
                     {"field", "size", "min_doc_count", "order"}:

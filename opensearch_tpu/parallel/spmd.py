@@ -874,6 +874,73 @@ def build_distributed_weighted_avg(mesh: Mesh, bucket: int, ndocs_pad: int,
     return jax.jit(fn)
 
 
+def build_distributed_geo_stat(mesh: Mesh, bucket: int, ndocs_pad: int,
+                               k1: float = 1.2, b: float = 0.75,
+                               filtered: bool = False):
+    """geo_bounds + geo_centroid over the mesh in one program (the two
+    kinds share every input): per shard, masked lat/lon extremes and
+    centroid moments, reduced with pmax/pmin/psum — the same collectives
+    the host merge applies across segments. Returns a callable:
+        (tree, rows, boosts, msm, cscore, glat [S,D], glon [S,D],
+         gpres [S,D] [, fmask]) ->
+        f32[QB, 7] = (count, top, bottom, left, right, slat, slon)."""
+    F32_MAX = np.float32(np.finfo(np.float32).max)
+
+    def per_device(tree, rows, boosts, msm, cscore, glat, glon, gpres,
+                   fmask=None):
+        rows = rows[0]
+        starts = tree["starts"][0]
+        doc_ids = tree["doc_ids"][0]
+        tfs = tree["tfs"][0]
+        dl = tree["dl"][0]
+        live = tree["live"][0]
+        la = glat[0]
+        lo = glon[0]
+        pr = gpres[0]
+        fm = fmask[0] if fmask is not None else None
+
+        df_global, n_global, avgdl = _global_dfs_stats(tree, rows)
+
+        def one(r, w, m, cs, dfg):
+            scores = _score_one_query(starts, doc_ids, tfs, dl, live, r, w,
+                                      m, cs, n_global, dfg, avgdl, bucket,
+                                      ndocs_pad, k1, b, fm)
+            ok = (scores > -jnp.inf) & (pr > 0)
+            okf = ok.astype(jnp.float32)
+            return jnp.stack([
+                jnp.sum(okf),
+                jnp.max(jnp.where(ok, la, -F32_MAX)),
+                jnp.min(jnp.where(ok, la, F32_MAX)),
+                jnp.min(jnp.where(ok, lo, F32_MAX)),
+                jnp.max(jnp.where(ok, lo, -F32_MAX)),
+                jnp.sum(okf * la),
+                jnp.sum(okf * lo)])
+
+        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
+        return jnp.stack([
+            jax.lax.psum(part[:, 0], "shard"),
+            jax.lax.pmax(part[:, 1], "shard"),
+            jax.lax.pmin(part[:, 2], "shard"),
+            jax.lax.pmin(part[:, 3], "shard"),
+            jax.lax.pmax(part[:, 4], "shard"),
+            jax.lax.psum(part[:, 5], "shard"),
+            jax.lax.psum(part[:, 6], "shard"),
+        ], axis=1)
+
+    shard_map = jax.shard_map
+    tree_spec = {k_: P("shard") for k_ in
+                 ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
+                  "doc_count", "sum_dl", "field_dc")}
+    in_specs = (tree_spec, P("shard", "replica"), P("replica"),
+                P("replica"), P("replica"), P("shard"), P("shard"),
+                P("shard"))
+    if filtered:
+        in_specs = in_specs + (P("shard"),)
+    fn = shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                   out_specs=P("replica"), check_vma=False)
+    return jax.jit(fn)
+
+
 def build_distributed_range_counts(mesh: Mesh, bucket: int, ndocs_pad: int,
                                    nr: int, k1: float = 1.2,
                                    b: float = 0.75,

@@ -228,6 +228,14 @@ def get_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     return out
 
 
+def _nominal_impact(tfs: np.ndarray, dls: np.ndarray,
+                    avg: float) -> np.ndarray:
+    """The ONE nominal-similarity impact (k1=1.2, b=0.75) both pruning
+    mechanisms order by: head selection and the quality tier must never
+    diverge on what 'high impact' means."""
+    return tfs / (tfs + 1.2 * (0.25 + 0.75 * dls / avg))
+
+
 def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray,
                  l_head: int = None
                  ) -> Tuple[np.ndarray, tuple]:
@@ -241,7 +249,7 @@ def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray,
     tf = tfs.astype(np.float32)
     dlf = dl_of.astype(np.float32)
     avg = max(float(dlf.mean()), 1.0)
-    c = tf / (tf + 1.2 * (0.25 + 0.75 * dlf / avg))
+    c = _nominal_impact(tf, dlf, avg)
     # stable sort: impact ties keep doc-ascending order, matching the exact
     # path's doc-id tie-break so a tied top-k boundary selects the same docs
     order = np.argsort(-c, kind="stable")
@@ -1013,14 +1021,17 @@ def _quality_tier(seg: Segment, field: str):
         dl_of = (dl[pb.doc_ids].astype(np.float32) if dl is not None
                  else np.zeros(len(pb.doc_ids), np.float32))
         avg = max(float(dl_of.mean()), 1.0)
-        imp = pb.tfs / (pb.tfs + 1.2 * (0.25 + 0.75 * dl_of / avg))
+        imp = _nominal_impact(pb.tfs, dl_of, avg)
         docmax = np.zeros(seg.ndocs, np.float32)
         np.maximum.at(docmax, pb.doc_ids, imp)
         target = max(seg.ndocs // QUALITY_SHARE, QUALITY_MIN_NDOCS // 4)
         tau = float(np.partition(docmax, seg.ndocs - target)
                     [seg.ndocs - target])
         mask = docmax >= tau
-        if 0 < mask.sum() < seg.ndocs:
+        # impact ties at tau can inflate the kept set far past the
+        # target, inverting the rung's cost model — decline rather than
+        # launch a near-dense-sized view
+        if 0 < mask.sum() <= 2 * target:
             host_docs = np.flatnonzero(mask).astype(np.int32)
             fl = FilterList(host_docs, None, len(host_docs), 0, mask,
                             ("_quality", field, QUALITY_SHARE))

@@ -866,6 +866,37 @@ class TestMeshBucketAggs:
             assert rm["aggregations"][aname] == rh["aggregations"][aname], \
                 (aname, rm["aggregations"][aname], rh["aggregations"][aname])
 
+    def test_geo_stat_parity(self, clients):
+        cm, ch = clients
+        rng = np.random.default_rng(13)
+        for c in (cm, ch):
+            c.indices.create("gx", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "body": {"type": "text"},
+                    "loc": {"type": "geo_point"}}}})
+            r2 = np.random.default_rng(13)
+            bulk = []
+            for i in range(400):
+                bulk.append({"index": {"_index": "gx", "_id": str(i)}})
+                bulk.append({
+                    "body": " ".join(r2.choice(WORDS, 5)),
+                    "loc": {"lat": float(r2.uniform(-60, 60)),
+                            "lon": float(r2.uniform(-170, 170))}})
+            c.bulk(bulk)
+            c.indices.refresh("gx")
+            c.indices.forcemerge("gx")
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 0,
+                "aggs": {"b": {"geo_bounds": {"field": "loc"}},
+                         "c": {"geo_centroid": {"field": "loc"}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="gx", body=dict(body))
+        rh = ch.search(index="gx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the geo-stat body"
+        assert rm["aggregations"]["b"] == rh["aggregations"]["b"]
+        assert rm["aggregations"]["c"] == rh["aggregations"]["c"]
+
     def test_weighted_avg_missing_falls_back(self, clients):
         # `missing` defaults aren't meshed: host loop, same answer
         cm, ch = clients
