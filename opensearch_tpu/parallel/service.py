@@ -281,6 +281,31 @@ class MeshSearchService:
                                lat.nbytes * 3)
         return out
 
+    def _sig_background(self, name: str, svc, field: str, shard_segs
+                        ) -> tuple:
+        """significant_terms superset stats summed over every segment of
+        every shard (segments WITHOUT the column still contribute their
+        live docs — reference supersetSize semantics). Cached per
+        generation; the host path computes the same per segment."""
+        from ..search.compiler import _kw_doc_counts
+
+        key = ("sigbg", name, field)
+        cached = self._stacked_cols.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        bg: Dict[str, int] = {}
+        bg_total = 0
+        for segs in shard_segs:
+            for seg in segs:
+                bg_total += seg.live_count
+                if field in seg.keyword_cols:
+                    for k, c in _kw_doc_counts(seg, field).items():
+                        bg[k] = bg.get(k, 0) + c
+        out = (bg, bg_total)
+        self._stacked_cols.put(key, (svc.generation, out),
+                               64 * max(len(bg), 1))
+        return out
+
     def _geo_program_for(self, mesh, bucket: int, ndocs_pad: int,
                          k1: float, b: float, filtered: bool = False):
         key = (id(mesh), bucket, ndocs_pad, k1, b, filtered)
@@ -715,9 +740,18 @@ class MeshSearchService:
             # values (terms); a missing/oversized one -> host loop
             agg_ok = True
             for an in it[5]:
-                if an.kind == "terms":
+                if an.kind in ("terms", "significant_terms"):
                     got = self._ord_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
+                    if an.kind == "significant_terms" and got is not None \
+                            and not all(an.body["field"] in seg.keyword_cols
+                                        for segs in shard_segs
+                                        for seg in segs):
+                        # host fg_total EXCLUDES matches in segments
+                        # lacking the column (sig_missing partials);
+                        # the mesh total is global — mixed presence
+                        # takes the host loop to keep parity exact
+                        got = None
                 elif an.kind in ("histogram", "date_histogram"):
                     got = self._bins_for(name, svc, an, shard_segs,
                                          stacked.ndocs_pad, mesh)
@@ -804,9 +838,11 @@ class MeshSearchService:
                                "range", "cardinality", "percentiles",
                                "median_absolute_deviation",
                                "weighted_avg", "geo_bounds",
-                               "geo_centroid")})
+                               "geo_centroid", "significant_terms")})
         terms_fields = sorted({an.body["field"] for it in items
-                               for an in it[5] if an.kind == "terms"})
+                               for an in it[5]
+                               if an.kind in ("terms",
+                                              "significant_terms")})
         metrics_by_field = {}
         if metric_fields:
             mfn = self._metric_program_for(mesh, bucket, stacked.ndocs_pad,
@@ -1094,6 +1130,20 @@ class MeshSearchService:
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
+                if an.kind == "significant_terms":
+                    f = an.body["field"]
+                    counts = tcounts_by_field[f][bi]
+                    vocab = tvocab_by_field[f]
+                    buckets = {vocab[o]: {"doc_count": int(c), "subs": {}}
+                               for o, c in enumerate(counts[: len(vocab)])
+                               if c > 0}
+                    bg, bg_total = self._sig_background(name, svc, f,
+                                                        shard_segs)
+                    results[0].agg_partials[an.name] = [{
+                        "buckets": buckets, "bg": bg,
+                        "fg_total": int(totals_b[bi]),
+                        "bg_total": bg_total}]
+                    continue
                 if an.kind == "cardinality":
                     results[0].agg_partials[an.name] = [{
                         "registers": card_results[an.body["field"]][bi]}]
@@ -1326,6 +1376,12 @@ class MeshSearchService:
             # centroid moments, pmax/pmin/psum over the shard axis
             if an.kind in ("geo_bounds", "geo_centroid") \
                     and set(an.body) == {"field"}:
+                continue
+            # r5: significant_terms — foreground counts are the exact
+            # terms bincount; background stats are static per field
+            if an.kind == "significant_terms" and set(an.body) <= \
+                    {"field", "size", "min_doc_count", "shard_size"} \
+                    and not an.subs:
                 continue
             if an.kind == "terms" and set(an.body) <= \
                     {"field", "size", "min_doc_count", "order"}:

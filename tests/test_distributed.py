@@ -866,6 +866,20 @@ class TestMeshBucketAggs:
             assert rm["aggregations"][aname] == rh["aggregations"][aname], \
                 (aname, rm["aggregations"][aname], rh["aggregations"][aname])
 
+    def test_significant_terms_parity(self, clients):
+        # r5: fg counts ride the exact terms bincount; bg stats are
+        # static per field — no extra device program
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {"s": {"significant_terms": {"field": "status"}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the significant_terms body"
+        assert rm["aggregations"]["s"] == rh["aggregations"]["s"], \
+            (rm["aggregations"]["s"], rh["aggregations"]["s"])
+
     def test_geo_stat_parity(self, clients):
         cm, ch = clients
         rng = np.random.default_rng(13)
@@ -969,3 +983,36 @@ class TestMeshBucketAggs:
         rh = ch.search(index="hx", body=dict(body))
         assert cm.node.mesh_service.fallbacks == f0 + 1
         assert rm["aggregations"]["d"] == rh["aggregations"]["d"]
+
+
+class TestSigTermsMixedPresence:
+    def test_mixed_presence_falls_back_with_parity(self):
+        # regression: a segment without the keyword column makes host
+        # fg_total exclude its matches; the mesh must decline, not serve
+        # a diverging global total
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        svc = MeshSearchService()
+        cm = RestClient(node=Node(mesh_service=svc))
+        ch = RestClient()
+        for c in (cm, ch):
+            c.indices.create("mp", {"mappings": {"properties": {
+                "body": {"type": "text"},
+                "tag": {"type": "keyword"}}}})
+            for i in range(40):
+                c.index("mp", {"body": "crash report",
+                               "tag": "bug" if i % 2 else "ok"}, id=str(i))
+            c.indices.refresh("mp")
+            # second segment: docs WITHOUT the tag field at all
+            for i in range(40, 60):
+                c.index("mp", {"body": "crash report"}, id=str(i))
+            c.indices.refresh("mp")
+        body = {"query": {"match": {"body": "crash"}}, "size": 0,
+                "aggs": {"s": {"significant_terms": {"field": "tag"}}}}
+        f0 = svc.fallbacks
+        rm = cm.search(index="mp", body=dict(body))
+        rh = ch.search(index="mp", body=dict(body))
+        assert svc.fallbacks == f0 + 1
+        assert rm["aggregations"]["s"] == rh["aggregations"]["s"]
