@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..index.segment import next_pow2
-from ..search.compiler import hist_agg_interval, range_agg_spec
+from ..search.compiler import (grid_agg_precision, hist_agg_interval,
+                               range_agg_spec)
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
                    build_distributed_bincount,
                    build_distributed_cardinality,
@@ -279,6 +280,48 @@ class MeshSearchService:
                jax.device_put(pres, sh))
         self._stacked_cols.put(key, (svc.generation, out),
                                lat.nbytes * 3)
+        return out
+
+    def _grid_for(self, name: str, svc, field: str, kind: str,
+                  precision: int, shard_segs, d_pad: int, mesh
+                  ) -> Optional[tuple]:
+        """Stacked GLOBAL geo-grid cell ordinals [S, d_pad] (-1 = no
+        value) + the cell-key vocab union — per-segment cell ords from
+        the host grid cache remapped into one index-wide ordinal space,
+        so the device bincount program buckets globally. Cached per
+        generation."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..search.compiler import _geo_grid_cache
+
+        key = ("grid", name, field, kind, precision)
+        cached = self._stacked_cols.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        per_seg = [[_geo_grid_cache(seg, field, kind, precision)
+                    for seg in segs] for segs in shard_segs]
+        vocab = sorted({v for row in per_seg for (vs, _o) in row
+                        for v in vs})
+        if not vocab or len(vocab) > MAX_TERMS_VOCAB:
+            self._stacked_cols.put(key, (svc.generation, None), 0)
+            return None
+        gord = {v: i for i, v in enumerate(vocab)}
+        S = len(shard_segs)
+        bins = np.full((S, d_pad), -1, np.int32)
+        for si, segs in enumerate(shard_segs):
+            off = 0
+            for seg, (vs, ords) in zip(segs, per_seg[si]):
+                remap = np.full(max(len(vs), 1) + 1, -1, np.int32)
+                for li, v in enumerate(vs):
+                    remap[li] = gord[v]
+                local = ords[: seg.ndocs]
+                bins[si, off: off + seg.ndocs] = np.where(
+                    local >= 0, remap[np.minimum(local, len(vs))], -1)
+                off += seg.ndocs
+        sh = NamedSharding(mesh, P("shard"))
+        out = (jax.device_put(bins, sh), vocab)
+        self._stacked_cols.put(key, (svc.generation, out), bins.nbytes)
         return out
 
     def _sig_background(self, name: str, svc, field: str, shard_segs
@@ -740,7 +783,15 @@ class MeshSearchService:
             # values (terms); a missing/oversized one -> host loop
             agg_ok = True
             for an in it[5]:
-                if an.kind in ("terms", "significant_terms"):
+                if an.kind in ("geohash_grid", "geotile_grid"):
+                    got = self._grid_for(name, svc, an.body["field"],
+                                         an.kind,
+                                         grid_agg_precision(an.kind,
+                                                            an.body),
+                                         shard_segs, stacked.ndocs_pad,
+                                         mesh)
+                elif an.kind in ("terms", "significant_terms",
+                                 "rare_terms"):
                     got = self._ord_for(name, svc, an.body["field"],
                                         shard_segs, stacked.ndocs_pad, mesh)
                     if an.kind == "significant_terms" and got is not None \
@@ -838,11 +889,13 @@ class MeshSearchService:
                                "range", "cardinality", "percentiles",
                                "median_absolute_deviation",
                                "weighted_avg", "geo_bounds",
-                               "geo_centroid", "significant_terms")})
+                               "geo_centroid", "significant_terms",
+                               "rare_terms", "geohash_grid",
+                               "geotile_grid")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5]
-                               if an.kind in ("terms",
-                                              "significant_terms")})
+                               if an.kind in ("terms", "significant_terms",
+                                              "rare_terms")})
         metrics_by_field = {}
         if metric_fields:
             mfn = self._metric_program_for(mesh, bucket, stacked.ndocs_pad,
@@ -980,6 +1033,32 @@ class MeshSearchService:
                      vpres, wcol, wpres) + ((fmask,) if filtered else ())
             wavg_results[(vf, wf)] = wfn(*wargs)
 
+        # geo grids: bincount over stacked global cell ordinals (the hist
+        # program), one run per (field, kind, precision)
+        grid_results = {}
+
+        def _grid_key(an):
+            return (an.body["field"], an.kind,
+                    grid_agg_precision(an.kind, an.body))
+
+        for it in items:
+            for an in it[5]:
+                if an.kind not in ("geohash_grid", "geotile_grid"):
+                    continue
+                gk = _grid_key(an)
+                if gk in grid_results:
+                    continue
+                bins_dev, gvocab = self._grid_for(
+                    name, svc, gk[0], gk[1], gk[2], shard_segs,
+                    stacked.ndocs_pad, mesh)
+                nbp = next_pow2(max(len(gvocab), 1))
+                gfn_ = self._hist_program_for(
+                    mesh, bucket, stacked.ndocs_pad, nbp, k1, b_eff,
+                    filtered)
+                gargs_ = (stacked.tree(), rows, boosts, msm, cscore,
+                          bins_dev) + ((fmask,) if filtered else ())
+                grid_results[gk] = (gfn_(*gargs_), gvocab)
+
         geo_results = {}
         geo_fields = sorted({an.body["field"] for it in items
                              for an in it[5]
@@ -1068,12 +1147,13 @@ class MeshSearchService:
                                   hist_results, range_results,
                                   tsub_results, hsub_results,
                                   rsub_results, card_results,
-                                  dd_results, wavg_results, geo_results))
+                                  dd_results, wavg_results, geo_results,
+                                  grid_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field, hist_results, range_results,
          tsub_results, hsub_results, rsub_results,
          card_results, dd_results, wavg_results,
-         geo_results) = fetched
+         geo_results, grid_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
@@ -1086,6 +1166,13 @@ class MeshSearchService:
                     "min": float(m4[1]) if cnt > 0 else float("inf"),
                     "max": float(m4[2]) if cnt > 0 else float("-inf"),
                     "sumsq": float(m4[3])}
+
+        def _ordinal_partial(counts, vocab, subs_of=None):
+            # shared ordinal-bucket partial shape (terms / rare_terms /
+            # significant_terms / geo grids)
+            return {vocab[o]: {"doc_count": int(c),
+                               "subs": subs_of(o) if subs_of else {}}
+                    for o, c in enumerate(counts[: len(vocab)]) if c > 0}
 
         def _bucket_subs(an, sub_results, parent_key, bi, j):
             out = {}
@@ -1118,25 +1205,26 @@ class MeshSearchService:
                     results[0].agg_partials[an.name] = [{
                         "buckets": buckets}]
                     continue
-                if an.kind == "terms":
+                if an.kind in ("terms", "rare_terms"):
                     f = an.body["field"]
-                    counts = tcounts_by_field[f][bi]
-                    vocab = tvocab_by_field[f]
-                    buckets = {vocab[o]: {
-                        "doc_count": int(c),
-                        "subs": _bucket_subs(an, tsub_results, f, bi, o)}
-                        for o, c in enumerate(counts[: len(vocab)])
-                        if c > 0}
+                    buckets = _ordinal_partial(
+                        tcounts_by_field[f][bi], tvocab_by_field[f],
+                        (lambda o, _a=an, _f=f: _bucket_subs(
+                            _a, tsub_results, _f, bi, o))
+                        if an.subs else None)
+                    results[0].agg_partials[an.name] = [{"buckets":
+                                                         buckets}]
+                    continue
+                if an.kind in ("geohash_grid", "geotile_grid"):
+                    counts, gvocab = grid_results[_grid_key(an)]
+                    buckets = _ordinal_partial(counts[bi], gvocab)
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
                 if an.kind == "significant_terms":
                     f = an.body["field"]
-                    counts = tcounts_by_field[f][bi]
-                    vocab = tvocab_by_field[f]
-                    buckets = {vocab[o]: {"doc_count": int(c), "subs": {}}
-                               for o, c in enumerate(counts[: len(vocab)])
-                               if c > 0}
+                    buckets = _ordinal_partial(tcounts_by_field[f][bi],
+                                               tvocab_by_field[f])
                     bg, bg_total = self._sig_background(name, svc, f,
                                                         shard_segs)
                     results[0].agg_partials[an.name] = [{
@@ -1381,6 +1469,17 @@ class MeshSearchService:
             # terms bincount; background stats are static per field
             if an.kind == "significant_terms" and set(an.body) <= \
                     {"field", "size", "min_doc_count", "shard_size"} \
+                    and not an.subs:
+                continue
+            # r5: rare_terms rides the same exact bincount (our host path
+            # is exact, not bloom-approximated, so parity is exact too)
+            if an.kind == "rare_terms" and set(an.body) <= \
+                    {"field", "max_doc_count"} and not an.subs:
+                continue
+            # r5: geo grids — host-precomputed per-doc cell ordinals
+            # through the same device bincount as histograms
+            if an.kind in ("geohash_grid", "geotile_grid") \
+                    and set(an.body) <= {"field", "precision", "size"} \
                     and not an.subs:
                 continue
             if an.kind == "terms" and set(an.body) <= \

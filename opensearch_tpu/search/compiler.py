@@ -3519,6 +3519,13 @@ def _kw_doc_counts(seg: Segment, field: str) -> Dict[str, int]:
     return out
 
 
+def grid_agg_precision(kind: str, body: dict) -> int:
+    """Shared host/mesh geo-grid precision resolution (geohash default 5,
+    geotile default 7). Single source of truth — the mesh keys its device
+    program cache on this and must never drift from the cell binning."""
+    return int(body.get("precision", 5 if kind == "geohash_grid" else 7))
+
+
 def hist_agg_interval(kind: str, body: dict) -> Tuple[float, float]:
     """Shared host/mesh resolution of a histogram-family agg's (interval,
     offset) in value space (ms for dates; fixed_interval preferred).
@@ -3792,8 +3799,7 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
 
     if kind in ("geohash_grid", "geotile_grid"):
         field = _resolve_agg_field(node, ctx)
-        precision = int(body.get("precision",
-                                 5 if kind == "geohash_grid" else 7))
+        precision = grid_agg_precision(kind, body)
         vocab, ords = _geo_grid_cache(seg, field, kind, precision)
         params[f"{prefix}_gords"] = ords
         subs = tuple(prepare_agg(s, seg, ctx, params, f"{prefix}_{i}",

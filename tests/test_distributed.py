@@ -866,6 +866,47 @@ class TestMeshBucketAggs:
             assert rm["aggregations"][aname] == rh["aggregations"][aname], \
                 (aname, rm["aggregations"][aname], rh["aggregations"][aname])
 
+    def test_rare_terms_parity(self, clients):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {"r": {"rare_terms": {"field": "status",
+                                              "max_doc_count": 500}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1
+        assert rm["aggregations"]["r"] == rh["aggregations"]["r"]
+
+    def test_geo_grid_parity(self, clients):
+        cm, ch = clients
+        for c in (cm, ch):
+            rng = np.random.default_rng(23)
+            c.indices.create("gg", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "body": {"type": "text"},
+                    "loc": {"type": "geo_point"}}}})
+            bulk = []
+            for i in range(300):
+                bulk.append({"index": {"_index": "gg", "_id": str(i)}})
+                bulk.append({
+                    "body": " ".join(rng.choice(WORDS, 5)),
+                    "loc": {"lat": float(rng.uniform(-60, 60)),
+                            "lon": float(rng.uniform(-170, 170))}})
+            c.bulk(bulk)
+            c.indices.refresh("gg")
+            c.indices.forcemerge("gg")
+        for aggs in (
+                {"g": {"geohash_grid": {"field": "loc", "precision": 3}}},
+                {"g": {"geotile_grid": {"field": "loc", "precision": 5}}}):
+            body = {"query": {"match": {"body": "alpha beta"}}, "size": 0,
+                    "aggs": aggs}
+            before = cm.node.mesh_service.dispatched
+            rm = cm.search(index="gg", body=dict(body))
+            rh = ch.search(index="gg", body=dict(body))
+            assert cm.node.mesh_service.dispatched == before + 1, aggs
+            assert rm["aggregations"]["g"] == rh["aggregations"]["g"]
+
     def test_significant_terms_parity(self, clients):
         # r5: fg counts ride the exact terms bincount; bg stats are
         # static per field — no extra device program
