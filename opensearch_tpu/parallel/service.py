@@ -324,6 +324,34 @@ class MeshSearchService:
         self._stacked_cols.put(key, (svc.generation, out), bins.nbytes)
         return out
 
+    def _resolve_filters_aggs(self, agg_nodes, shard_segs, stats) -> bool:
+        """Resolve every `filters` agg's named clauses to cached per-shard
+        masks (same machinery as the query-level guardrail filters).
+        Returns False when any clause can't be masked (caller falls back);
+        resolved (key, combo, masks) lists ride on the AggNode."""
+        from ..search import compiler as C
+        from ..search import query_dsl as dsl
+
+        for an in (agg_nodes or []):
+            if an.kind != "filters":
+                continue
+            items = C.filters_agg_items(an.body)
+            resolved = []
+            for fname, f in items:
+                try:
+                    lnode = C.rewrite(dsl.parse_query(f), stats[0],
+                                      scoring=False)
+                except dsl.QueryParseError:
+                    return False
+                if not self._maskable(lnode):
+                    return False
+                fp = self._fmask_resolve(shard_segs, stats, [lnode], [])
+                if fp is None:
+                    return False
+                resolved.append((fname, fp[0], fp[1]))
+            an._mesh_filters = resolved
+        return True
+
     def _sig_background(self, name: str, svc, field: str, shard_segs
                         ) -> tuple:
         """significant_terms superset stats summed over every segment of
@@ -710,6 +738,13 @@ class MeshSearchService:
                     continue
             const = (float(getattr(lt, "boost", 1.0) or 1.0) * qboost
                      if getattr(lt, "mode", None) == "filter" else 0.0)
+            # `filters` aggs: resolve each named filter to cached masks
+            # now (parse-time ctx); any unmaskable clause -> host loop.
+            # The resolved list rides on the AggNode (fresh per request)
+            if not self._resolve_filters_aggs(agg_nodes, shard_segs,
+                                              stats):
+                self.fallbacks += 1
+                continue
             parsed.append((qi, lt, sort_specs, max(window, 1), const,
                            agg_nodes or [], fpair, qboost, msm_eff))
         if not parsed:
@@ -815,6 +850,8 @@ class MeshSearchService:
                            or self._col_for(name, svc, an.body["field"],
                                             shard_segs, stacked.ndocs_pad,
                                             mesh))
+                elif an.kind == "filters":
+                    got = getattr(an, "_mesh_filters", None)
                 elif an.kind == "weighted_avg":
                     got = self._col_for(
                         name, svc, an.body["value"]["field"], shard_segs,
@@ -891,7 +928,7 @@ class MeshSearchService:
                                "weighted_avg", "geo_bounds",
                                "geo_centroid", "significant_terms",
                                "rare_terms", "geohash_grid",
-                               "geotile_grid")})
+                               "geotile_grid", "filters")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5]
                                if an.kind in ("terms", "significant_terms",
@@ -1059,6 +1096,25 @@ class MeshSearchService:
                           bins_dev) + ((fmask,) if filtered else ())
                 grid_results[gk] = (gfn_(*gargs_), gvocab)
 
+        # `filters` agg: one metric-program count per named clause mask
+        # (col == pres == the mask, so m[0] counts matched docs in it)
+        fagg_results = {}
+        for it in items:
+            for an in it[5]:
+                if an.kind != "filters":
+                    continue
+                for fname, combo, masks in an._mesh_filters:
+                    if combo in fagg_results:
+                        continue
+                    dev = self._dev_mask_for(combo, masks, shard_segs,
+                                             stacked.ndocs_pad, mesh)
+                    mfn = self._metric_program_for(
+                        mesh, bucket, stacked.ndocs_pad, k1, b_eff,
+                        filtered)
+                    margs = (stacked.tree(), rows, boosts, msm, cscore,
+                             dev, dev) + ((fmask,) if filtered else ())
+                    fagg_results[combo] = mfn(*margs)
+
         geo_results = {}
         geo_fields = sorted({an.body["field"] for it in items
                              for an in it[5]
@@ -1148,12 +1204,12 @@ class MeshSearchService:
                                   tsub_results, hsub_results,
                                   rsub_results, card_results,
                                   dd_results, wavg_results, geo_results,
-                                  grid_results))
+                                  grid_results, fagg_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field, hist_results, range_results,
          tsub_results, hsub_results, rsub_results,
          card_results, dd_results, wavg_results,
-         geo_results, grid_results) = fetched
+         geo_results, grid_results, fagg_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
@@ -1218,6 +1274,16 @@ class MeshSearchService:
                 if an.kind in ("geohash_grid", "geotile_grid"):
                     counts, gvocab = grid_results[_grid_key(an)]
                     buckets = _ordinal_partial(counts[bi], gvocab)
+                    results[0].agg_partials[an.name] = [{"buckets":
+                                                         buckets}]
+                    continue
+                if an.kind == "filters":
+                    buckets = {
+                        fname: {"doc_count":
+                                int(round(float(
+                                    fagg_results[combo][bi][0]))),
+                                "subs": {}}
+                        for fname, combo, _m in an._mesh_filters}
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
@@ -1470,6 +1536,11 @@ class MeshSearchService:
             if an.kind == "significant_terms" and set(an.body) <= \
                     {"field", "size", "min_doc_count", "shard_size"} \
                     and not an.subs:
+                continue
+            # r5: `filters` agg — each named maskable clause becomes a
+            # per-shard device mask; counts via the metric program
+            if an.kind == "filters" and set(an.body) <= {"filters"} \
+                    and an.body.get("filters") and not an.subs:
                 continue
             # r5: rare_terms rides the same exact bincount (our host path
             # is exact, not bloom-approximated, so parity is exact too)

@@ -3519,6 +3519,16 @@ def _kw_doc_counts(seg: Segment, field: str) -> Dict[str, int]:
     return out
 
 
+def filters_agg_items(body: dict) -> list:
+    """Shared host/mesh normalization of a `filters` agg body to
+    (key, clause) pairs (dict keys, or "0"/"1"/... for the anonymous list
+    form). Single source of truth — mesh bucket keys must match the host
+    coordinator merge exactly."""
+    raw = body.get("filters", {})
+    return (list(raw.items()) if isinstance(raw, dict)
+            else [(str(i), f) for i, f in enumerate(raw)])
+
+
 def grid_agg_precision(kind: str, body: dict) -> int:
     """Shared host/mesh geo-grid precision resolution (geohash default 5,
     geotile default 7). Single source of truth — the mesh keys its device
@@ -3699,11 +3709,7 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         return ("filter", prefix, fspec, subs)
 
     if kind == "filters":
-        raw = body.get("filters", {})
-        if isinstance(raw, dict):
-            items = list(raw.items())
-        else:
-            items = [(str(i), f) for i, f in enumerate(raw)]
+        items = filters_agg_items(body)
         fspecs = []
         for key, f in items:
             lnode = rewrite(dsl.parse_query(f), ctx, scoring=False)

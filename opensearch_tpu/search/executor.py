@@ -1288,10 +1288,7 @@ def _refine_complex_subs(searchers: List[ShardSearcher], body: dict,
                                  filters + [node.body])
         return
     if kind == "filters":
-        raw = node.body.get("filters", {})
-        items = (list(raw.items()) if isinstance(raw, dict)
-                 else [(str(i), f) for i, f in enumerate(raw)])
-        fmap = dict(items)
+        fmap = dict(C.filters_agg_items(node.body))
         for key, bucket in (result.get("buckets") or {}).items():
             bf = fmap.get(key)
             if bf is None:

@@ -866,6 +866,36 @@ class TestMeshBucketAggs:
             assert rm["aggregations"][aname] == rh["aggregations"][aname], \
                 (aname, rm["aggregations"][aname], rh["aggregations"][aname])
 
+    @pytest.mark.parametrize("filters_body", [
+        {"pub": {"term": {"status": "published"}},
+         "cheap": {"range": {"num": {"lt": 100}}}},
+        [{"term": {"status": "draft"}},
+         {"range": {"num": {"gte": 250, "lt": 400}}}],
+    ])
+    def test_filters_agg_parity(self, clients, filters_body):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {"f": {"filters": {"filters": filters_body}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the filters-agg body"
+        assert rm["aggregations"]["f"] == rh["aggregations"]["f"], \
+            (rm["aggregations"]["f"], rh["aggregations"]["f"])
+
+    def test_filters_agg_unmaskable_falls_back(self, clients):
+        # a positional clause inside `filters` isn't maskable -> host loop
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {"f": {"filters": {"filters": {
+                    "m": {"match_phrase": {"body": "beta gamma"}}}}}}}
+        f0 = cm.node.mesh_service.fallbacks
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.fallbacks == f0 + 1
+        assert rm["aggregations"]["f"] == rh["aggregations"]["f"]
+
     def test_rare_terms_parity(self, clients):
         cm, ch = clients
         body = {"query": {"match": {"body": "alpha"}}, "size": 0,
