@@ -23,8 +23,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..index.segment import next_pow2
-from ..search.compiler import (grid_agg_precision, hist_agg_interval,
-                               range_agg_spec)
+from ..search.compiler import (coerce_agg_ranges, grid_agg_precision,
+                               hist_agg_interval, range_agg_spec)
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
                    build_distributed_bincount,
                    build_distributed_cardinality,
@@ -301,7 +301,19 @@ class MeshSearchService:
             return cached[1]
         per_seg = [[_geo_grid_cache(seg, field, kind, precision)
                     for seg in segs] for segs in shard_segs]
-        vocab = sorted({v for row in per_seg for (vs, _o) in row
+        return self._stack_global_ords(key, svc, per_seg, shard_segs,
+                                       d_pad, mesh)
+
+    def _stack_global_ords(self, key: tuple, svc, per_seg, shard_segs,
+                           d_pad: int, mesh) -> Optional[tuple]:
+        """Shared remap of per-segment (vocab, doc-major ords) pairs into
+        one index-wide ordinal space, stacked [S, d_pad] and sharded (-1 =
+        missing). Used by the geo grids and multi_terms; cached per
+        generation including negative results."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        vocab = sorted({v for srow in per_seg for (vs, _o) in srow
                         for v in vs})
         if not vocab or len(vocab) > MAX_TERMS_VOCAB:
             self._stacked_cols.put(key, (svc.generation, None), 0)
@@ -323,6 +335,35 @@ class MeshSearchService:
         out = (jax.device_put(bins, sh), vocab)
         self._stacked_cols.put(key, (svc.generation, out), bins.nbytes)
         return out
+
+    def _mterms_for(self, name: str, svc, fields: tuple, an, shard_segs,
+                    stats, d_pad: int, mesh) -> Optional[tuple]:
+        """Stacked GLOBAL combined multi_terms ordinals [S, d_pad]
+        (-1 = doc missing any source) + the key-tuple vocab union — the
+        per-segment combined ords from the host cache remapped into one
+        index-wide ordinal space. Cached per generation."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..search.compiler import _multi_terms_cache
+
+        key = ("mterms", name, fields)
+        cached = self._stacked_cols.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            return cached[1]
+        per_seg = []
+        for si, segs in enumerate(shard_segs):
+            row = []
+            for seg in segs:
+                try:
+                    row.append(_multi_terms_cache(seg, stats[si], an,
+                                                  fields))
+                except Exception:
+                    self._stacked_cols.put(key, (svc.generation, None), 0)
+                    return None
+            per_seg.append(row)
+        return self._stack_global_ords(key, svc, per_seg, shard_segs,
+                                       d_pad, mesh)
 
     def _resolve_filters_aggs(self, agg_nodes, shard_segs, stats) -> bool:
         """Resolve every `filters` agg's named clauses to cached per-shard
@@ -841,6 +882,11 @@ class MeshSearchService:
                 elif an.kind in ("histogram", "date_histogram"):
                     got = self._bins_for(name, svc, an, shard_segs,
                                          stacked.ndocs_pad, mesh)
+                elif an.kind == "multi_terms":
+                    got = self._mterms_for(
+                        name, svc,
+                        tuple(src["field"] for src in an.body["terms"]),
+                        an, shard_segs, stats, stacked.ndocs_pad, mesh)
                 elif an.kind == "cardinality":
                     # keyword fields ride global ordinals, numeric the
                     # stacked column; neither -> host loop
@@ -928,7 +974,8 @@ class MeshSearchService:
                                "weighted_avg", "geo_bounds",
                                "geo_centroid", "significant_terms",
                                "rare_terms", "geohash_grid",
-                               "geotile_grid", "filters")})
+                               "geotile_grid", "filters", "date_range",
+                               "multi_terms")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5]
                                if an.kind in ("terms", "significant_terms",
@@ -992,11 +1039,24 @@ class MeshSearchService:
             interval, offset = hist_agg_interval(an.kind, an.body)
             return (an.kind, an.body["field"], interval, offset)
 
+        def _norm_ranges(an):
+            # date_range coerces from/to (date math/formats -> ms) through
+            # the shared host helper before bound construction; memoized
+            # per AggNode (fresh per request) — attach and the sub-launch
+            # loop re-enter this per body
+            got = getattr(an, "_mesh_ranges", None)
+            if got is None:
+                got = coerce_agg_ranges(an.kind, an.body,
+                                        an.body["field"],
+                                        stats[0].mappings)
+                an._mesh_ranges = got
+            return got
+
         def _range_key(an):
             # bucket keys are part of the RESPONSE, so custom "key" labels
             # must be part of the cache key too
-            _, _, rkeys, metas = range_agg_spec(an.body["ranges"])
-            return (an.body["field"], tuple(rkeys),
+            _, _, rkeys, metas = range_agg_spec(_norm_ranges(an))
+            return (an.kind, an.body["field"], tuple(rkeys),
                     tuple((m.get("from"), m.get("to")) for m in metas))
 
         # cardinality: shard-local HLL registers + pmax (bit-identical to
@@ -1115,6 +1175,26 @@ class MeshSearchService:
                              dev, dev) + ((fmask,) if filtered else ())
                     fagg_results[combo] = mfn(*margs)
 
+        # multi_terms: combined global ordinals through the bincount
+        mterms_results = {}
+        for it in items:
+            for an in it[5]:
+                if an.kind != "multi_terms":
+                    continue
+                mk = tuple(src["field"] for src in an.body["terms"])
+                if mk in mterms_results:
+                    continue
+                bins_dev, mvocab = self._mterms_for(
+                    name, svc, mk, an, shard_segs, stats,
+                    stacked.ndocs_pad, mesh)
+                nbp = next_pow2(max(len(mvocab), 1))
+                mfn_ = self._hist_program_for(
+                    mesh, bucket, stacked.ndocs_pad, nbp, k1, b_eff,
+                    filtered)
+                margs_ = (stacked.tree(), rows, boosts, msm, cscore,
+                          bins_dev) + ((fmask,) if filtered else ())
+                mterms_results[mk] = (mfn_(*margs_), mvocab)
+
         geo_results = {}
         geo_fields = sorted({an.body["field"] for it in items
                              for an in it[5]
@@ -1167,7 +1247,7 @@ class MeshSearchService:
                         hvd, hvo = hist_pairs[hk]
                         _launch_pair_subs(an, hk, hist_results[hk][2],
                                           hvd, hvo, hsub_results)
-                elif an.kind == "range":
+                elif an.kind in ("range", "date_range"):
                     rk = _range_key(an)
                     needed_subs = [s for s in an.subs
                                    if (rk, s.body["field"])
@@ -1175,7 +1255,7 @@ class MeshSearchService:
                     if rk in range_results and not needed_subs:
                         continue
                     lows, highs, rkeys, metas = range_agg_spec(
-                        an.body["ranges"])
+                        _norm_ranges(an))
                     col, pres = self._col_for(name, svc, an.body["field"],
                                               shard_segs,
                                               stacked.ndocs_pad, mesh)
@@ -1204,12 +1284,14 @@ class MeshSearchService:
                                   tsub_results, hsub_results,
                                   rsub_results, card_results,
                                   dd_results, wavg_results, geo_results,
-                                  grid_results, fagg_results))
+                                  grid_results, fagg_results,
+                                  mterms_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field, hist_results, range_results,
          tsub_results, hsub_results, rsub_results,
          card_results, dd_results, wavg_results,
-         geo_results, grid_results, fagg_results) = fetched
+         geo_results, grid_results, fagg_results,
+         mterms_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
@@ -1250,7 +1332,7 @@ class MeshSearchService:
                         "buckets": buckets, "interval": interval,
                         "offset": offset}]
                     continue
-                if an.kind == "range":
+                if an.kind in ("range", "date_range"):
                     rk = _range_key(an)
                     counts, rkeys, metas = range_results[rk]
                     buckets = {key: {
@@ -1274,6 +1356,13 @@ class MeshSearchService:
                 if an.kind in ("geohash_grid", "geotile_grid"):
                     counts, gvocab = grid_results[_grid_key(an)]
                     buckets = _ordinal_partial(counts[bi], gvocab)
+                    results[0].agg_partials[an.name] = [{"buckets":
+                                                         buckets}]
+                    continue
+                if an.kind == "multi_terms":
+                    mk = tuple(src["field"] for src in an.body["terms"])
+                    counts, mvocab = mterms_results[mk]
+                    buckets = _ordinal_partial(counts[bi], mvocab)
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
@@ -1504,7 +1593,7 @@ class MeshSearchService:
         for an in (agg_nodes or []):
             if an.subs and not (
                     an.kind in ("terms", "histogram", "date_histogram",
-                                "range") and _subs_ok(an)):
+                                "range", "date_range") and _subs_ok(an)):
                 return None
             if an.kind in _MESH_METRICS and set(an.body) == {"field"} \
                     and not an.subs:
@@ -1572,11 +1661,24 @@ class MeshSearchService:
                                          "interval", "offset",
                                          "min_doc_count"}:
                 continue
-            if an.kind == "range" and set(an.body) <= \
-                    {"field", "ranges", "keyed"} \
+            if an.kind in ("range", "date_range") and set(an.body) <= \
+                    {"field", "ranges", "keyed", "format"} \
                     and 1 <= len(an.body.get("ranges") or []) \
                     <= MAX_MESH_RANGES:
                 continue
+            # r5: multi_terms — per-doc combined ordinals through the
+            # same device bincount as the geo grids
+            if an.kind == "multi_terms" and set(an.body) <= \
+                    {"terms", "size", "min_doc_count", "order"} \
+                    and len(an.body.get("terms") or []) >= 2 \
+                    and all(set(src) == {"field"}
+                            for src in an.body["terms"]) \
+                    and not an.subs:
+                order = an.body.get("order", {"_count": "desc"})
+                if isinstance(order, dict) and len(order) == 1 and \
+                        next(iter(order)) in ("_count", "_key"):
+                    continue
+                return None
             return None
         if window > MAX_WINDOW or (window < 1 and not agg_nodes):
             return None

@@ -3519,6 +3519,25 @@ def _kw_doc_counts(seg: Segment, field: str) -> Dict[str, int]:
     return out
 
 
+def coerce_agg_ranges(kind: str, body: dict, field: str,
+                      mappings) -> list:
+    """Shared host/mesh range-agg bounds: date_range coerces from/to
+    through the field type (date math/formats -> epoch ms) before the
+    f32 bound construction. Single source of truth for both paths."""
+    ranges = body.get("ranges", [])
+    if kind != "date_range":
+        return ranges
+    ft = mappings.resolve_field(field)
+    coerced = []
+    for r in ranges:
+        r2 = dict(r)
+        for end in ("from", "to"):
+            if r.get(end) is not None:
+                r2[end] = coerce_value(ft, r[end])
+        coerced.append(r2)
+    return coerced
+
+
 def filters_agg_items(body: dict) -> list:
     """Shared host/mesh normalization of a `filters` agg body to
     (key, clause) pairs (dict keys, or "0"/"1"/... for the anonymous list
@@ -3638,17 +3657,7 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
 
     if kind in ("range", "date_range"):
         field = _resolve_agg_field(node, ctx)
-        ranges = body.get("ranges", [])
-        if kind == "date_range":
-            ft = ctx.mappings.resolve_field(field)
-            coerced = []
-            for r in ranges:
-                r2 = dict(r)
-                for end in ("from", "to"):
-                    if r.get(end) is not None:
-                        r2[end] = coerce_value(ft, r[end])
-                coerced.append(r2)
-            ranges = coerced
+        ranges = coerce_agg_ranges(kind, node.body, field, ctx.mappings)
         lows, highs, keys, _metas = range_agg_spec(ranges)
         params[f"{prefix}_lows"] = lows
         params[f"{prefix}_highs"] = highs

@@ -1087,3 +1087,56 @@ class TestSigTermsMixedPresence:
         rh = ch.search(index="mp", body=dict(body))
         assert svc.fallbacks == f0 + 1
         assert rm["aggregations"]["s"] == rh["aggregations"]["s"]
+
+
+class TestMeshDateRangeMultiTerms:
+    @pytest.fixture(scope="class")
+    def clients(self):
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        cm = RestClient(node=Node(mesh_service=MeshSearchService()))
+        ch = RestClient()
+        for c in (cm, ch):
+            rng = np.random.default_rng(29)
+            c.indices.create("dr", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "body": {"type": "text"},
+                    "ts": {"type": "date"},
+                    "cat": {"type": "keyword"},
+                    "lvl": {"type": "keyword"}}}})
+            bulk = []
+            for i in range(500):
+                bulk.append({"index": {"_index": "dr", "_id": str(i)}})
+                bulk.append({
+                    "body": " ".join(rng.choice(WORDS, 5)),
+                    "ts": f"2026-{(i % 12) + 1:02d}-10T00:00:00Z",
+                    "cat": ["x", "y", "z"][i % 3],
+                    "lvl": ["hi", "lo"][i % 2]})
+            c.bulk(bulk)
+            c.indices.refresh("dr")
+            c.indices.forcemerge("dr")
+        return cm, ch
+
+    @pytest.mark.parametrize("aggs", [
+        {"d": {"date_range": {"field": "ts", "ranges": [
+            {"to": "2026-06-01"}, {"from": "2026-04-01"}]}}},
+        {"d": {"date_range": {"field": "ts", "ranges": [
+            {"from": "2026-02-01", "to": "2026-09-01", "key": "mid"}]},
+               "aggs": {"c": {"value_count": {"field": "ts"}}}}},
+        {"m": {"multi_terms": {"terms": [{"field": "cat"},
+                                         {"field": "lvl"}]}}},
+    ])
+    def test_parity(self, clients, aggs):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha beta"}}, "size": 0,
+                "aggs": aggs}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="dr", body=dict(body))
+        rh = ch.search(index="dr", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, aggs
+        for aname in aggs:
+            assert rm["aggregations"][aname] == rh["aggregations"][aname], \
+                (aname, rm["aggregations"][aname], rh["aggregations"][aname])
