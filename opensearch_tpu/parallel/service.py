@@ -59,6 +59,11 @@ MAX_PHRASE_BUCKET = 1 << 22
 MAX_MESH_BINS = 4096
 MAX_MESH_RANGES = 16
 
+# adjacency_matrix builds N + N(N-1)/2 device masks (one metric launch
+# each) — quadratic, so the mesh serves small matrices only (host loop
+# beyond; the reference's own default cap is 100 filters)
+MAX_MESH_ADJ_FILTERS = 8
+
 
 class _ByteLRU:
     """Byte-budgeted LRU over an OrderedDict: one eviction policy for every
@@ -342,9 +347,6 @@ class MeshSearchService:
         (-1 = doc missing any source) + the key-tuple vocab union — the
         per-segment combined ords from the host cache remapped into one
         index-wide ordinal space. Cached per generation."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
         from ..search.compiler import _multi_terms_cache
 
         key = ("mterms", name, fields)
@@ -374,10 +376,14 @@ class MeshSearchService:
         from ..search import query_dsl as dsl
 
         for an in (agg_nodes or []):
-            if an.kind != "filters":
+            if an.kind not in ("filters", "adjacency_matrix"):
                 continue
-            items = C.filters_agg_items(an.body)
-            resolved = []
+            if an.kind == "adjacency_matrix":
+                raw = an.body.get("filters", {})
+                items = [(k, raw[k]) for k in sorted(raw)]
+            else:
+                items = C.filters_agg_items(an.body)
+            nodes = []
             for fname, f in items:
                 try:
                     lnode = C.rewrite(dsl.parse_query(f), stats[0],
@@ -386,7 +392,19 @@ class MeshSearchService:
                     return False
                 if not self._maskable(lnode):
                     return False
-                fp = self._fmask_resolve(shard_segs, stats, [lnode], [])
+                nodes.append((fname, lnode))
+            resolved = []
+            combos = [(fname, [ln]) for fname, ln in nodes]
+            if an.kind == "adjacency_matrix":
+                # plus the pairwise intersections, host label order
+                sep = an.body.get("separator", "&")
+                for ai in range(len(nodes)):
+                    for bi in range(ai + 1, len(nodes)):
+                        combos.append((
+                            f"{nodes[ai][0]}{sep}{nodes[bi][0]}",
+                            [nodes[ai][1], nodes[bi][1]]))
+            for fname, lns in combos:
+                fp = self._fmask_resolve(shard_segs, stats, lns, [])
                 if fp is None:
                     return False
                 resolved.append((fname, fp[0], fp[1]))
@@ -896,7 +914,7 @@ class MeshSearchService:
                            or self._col_for(name, svc, an.body["field"],
                                             shard_segs, stacked.ndocs_pad,
                                             mesh))
-                elif an.kind == "filters":
+                elif an.kind in ("filters", "adjacency_matrix"):
                     got = getattr(an, "_mesh_filters", None)
                 elif an.kind == "weighted_avg":
                     got = self._col_for(
@@ -975,7 +993,7 @@ class MeshSearchService:
                                "geo_centroid", "significant_terms",
                                "rare_terms", "geohash_grid",
                                "geotile_grid", "filters", "date_range",
-                               "multi_terms")})
+                               "multi_terms", "adjacency_matrix")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5]
                                if an.kind in ("terms", "significant_terms",
@@ -1161,7 +1179,7 @@ class MeshSearchService:
         fagg_results = {}
         for it in items:
             for an in it[5]:
-                if an.kind != "filters":
+                if an.kind not in ("filters", "adjacency_matrix"):
                     continue
                 for fname, combo, masks in an._mesh_filters:
                     if combo in fagg_results:
@@ -1366,7 +1384,7 @@ class MeshSearchService:
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
-                if an.kind == "filters":
+                if an.kind in ("filters", "adjacency_matrix"):
                     buckets = {
                         fname: {"doc_count":
                                 int(round(float(
@@ -1629,7 +1647,15 @@ class MeshSearchService:
             # r5: `filters` agg — each named maskable clause becomes a
             # per-shard device mask; counts via the metric program
             if an.kind == "filters" and set(an.body) <= {"filters"} \
-                    and an.body.get("filters") and not an.subs:
+                    and 1 <= len(an.body.get("filters") or ()) \
+                    <= MAX_MESH_RANGES and not an.subs:
+                continue
+            # r5: adjacency_matrix — singles + pairwise AND masks through
+            # the same filter-mask machinery as the `filters` agg
+            if an.kind == "adjacency_matrix" and set(an.body) <= \
+                    {"filters", "separator"} \
+                    and 1 <= len(an.body.get("filters") or {}) \
+                    <= MAX_MESH_ADJ_FILTERS and not an.subs:
                 continue
             # r5: rare_terms rides the same exact bincount (our host path
             # is exact, not bloom-approximated, so parity is exact too)

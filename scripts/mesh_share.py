@@ -110,11 +110,25 @@ def main():
 
     def agg_body(i):
         q = queries[i]
+        kinds = [
+            {"by_status": {"terms": {"field": "status"},
+                           "aggs": {"p": {"avg": {"field": "price"}}}}},
+            {"price_stats": {"stats": {"field": "price"}}},
+            {"price_hist": {"histogram": {"field": "price",
+                                          "interval": 100}}},
+            {"card": {"cardinality": {"field": "status"}}},
+            {"pct": {"percentiles": {"field": "price"}}},
+            {"rng": {"range": {"field": "price",
+                               "ranges": [{"to": 300}, {"from": 300}]}}},
+            {"by_day": {"date_histogram": {"field": "ts",
+                                           "fixed_interval": "30d"}}},
+            {"flt": {"filters": {"filters": {
+                "pub": {"term": {"status": "published"}},
+                "cheap": {"range": {"price": {"lt": 200}}}}}}},
+            {"sig": {"significant_terms": {"field": "status"}}},
+        ]
         return {"query": {"match": {"body": vocab_strs[q[0]]}}, "size": 0,
-                "aggs": {"by_status": {"terms": {"field": "status"}},
-                         "price_stats": {"avg": {"field": "price"}},
-                         "price_hist": {"histogram": {"field": "price",
-                                                      "interval": 100}}}}
+                "aggs": kinds[i % len(kinds)]}
 
     streams = {
         "mixed_50f_30m_20p": [

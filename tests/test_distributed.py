@@ -884,6 +884,21 @@ class TestMeshBucketAggs:
         assert rm["aggregations"]["f"] == rh["aggregations"]["f"], \
             (rm["aggregations"]["f"], rh["aggregations"]["f"])
 
+    def test_adjacency_matrix_parity(self, clients):
+        cm, ch = clients
+        body = {"query": {"match": {"body": "alpha"}}, "size": 0,
+                "aggs": {"adj": {"adjacency_matrix": {"filters": {
+                    "pub": {"term": {"status": "published"}},
+                    "draft": {"term": {"status": "draft"}},
+                    "cheap": {"range": {"num": {"lt": 250}}}}}}}}
+        before = cm.node.mesh_service.dispatched
+        rm = cm.search(index="hx", body=dict(body))
+        rh = ch.search(index="hx", body=dict(body))
+        assert cm.node.mesh_service.dispatched == before + 1, \
+            "mesh did not serve the adjacency_matrix body"
+        assert rm["aggregations"]["adj"] == rh["aggregations"]["adj"], \
+            (rm["aggregations"]["adj"], rh["aggregations"]["adj"])
+
     def test_filters_agg_unmaskable_falls_back(self, clients):
         # a positional clause inside `filters` isn't maskable -> host loop
         cm, ch = clients
