@@ -367,6 +367,46 @@ class MeshSearchService:
         return self._stack_global_ords(key, svc, per_seg, shard_segs,
                                        d_pad, mesh)
 
+    def _composite_fields(self, an) -> tuple:
+        return tuple(next(iter(src.values()))["terms"]["field"]
+                     for src in an.body["sources"])
+
+    def _composite_for(self, an, name: str, svc, shard_segs, stats,
+                       d_pad: int, mesh) -> Optional[tuple]:
+        """Stacked combined ordinals for a composite over single-valued
+        keyword terms sources — the per-doc key tuple equals the
+        multi_terms combined key, so the multi_terms per-segment cache
+        feeds the shared global-ordinal stacker. Declines (host loop)
+        when any source field is multi-valued anywhere: the host pages
+        per-value there, and a min-ord mapping would silently drop
+        values."""
+        fields = self._composite_fields(an)
+        key = ("composite-ok", name, fields)
+        cached = self._stacked_cols.get(key)
+        if cached is not None and cached[0] == svc.generation:
+            ok = cached[1]
+        else:
+            # every source must resolve (through aliases, like the host
+            # prepare does) to a SINGLE-valued keyword column present in
+            # EVERY segment: the host emits zero buckets per segment
+            # lacking the column, and a min-ord mapping of a multi-valued
+            # field would silently drop values — both decline
+            mp = stats[0].mappings
+            resolved = tuple(mp.aliases.get(f, f) for f in fields)
+            ok = True
+            for segs in shard_segs:
+                for seg in segs:
+                    for f in resolved:
+                        col = seg.keyword_cols.get(f)
+                        if col is None or (len(col.ords) and int(np.max(
+                                col.starts[1:] - col.starts[:-1])) > 1):
+                            ok = False
+            self._stacked_cols.put(key, (svc.generation, ok), 0)
+        if not ok:
+            return None
+        return self._mterms_for(name, svc, fields, an, shard_segs, stats,
+                                d_pad, mesh)
+
     def _resolve_filters_aggs(self, agg_nodes, shard_segs, stats) -> bool:
         """Resolve every `filters` agg's named clauses to cached per-shard
         masks (same machinery as the query-level guardrail filters).
@@ -905,6 +945,10 @@ class MeshSearchService:
                         name, svc,
                         tuple(src["field"] for src in an.body["terms"]),
                         an, shard_segs, stats, stacked.ndocs_pad, mesh)
+                elif an.kind == "composite":
+                    got = self._composite_for(an, name, svc, shard_segs,
+                                              stats, stacked.ndocs_pad,
+                                              mesh)
                 elif an.kind == "cardinality":
                     # keyword fields ride global ordinals, numeric the
                     # stacked column; neither -> host loop
@@ -993,7 +1037,8 @@ class MeshSearchService:
                                "geo_centroid", "significant_terms",
                                "rare_terms", "geohash_grid",
                                "geotile_grid", "filters", "date_range",
-                               "multi_terms", "adjacency_matrix")})
+                               "multi_terms", "adjacency_matrix",
+                               "composite")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5]
                                if an.kind in ("terms", "significant_terms",
@@ -1193,18 +1238,25 @@ class MeshSearchService:
                              dev, dev) + ((fmask,) if filtered else ())
                     fagg_results[combo] = mfn(*margs)
 
-        # multi_terms: combined global ordinals through the bincount
+        # multi_terms + composite: combined global ordinals through the
+        # bincount (a composite's key tuple IS the multi_terms key)
         mterms_results = {}
         for it in items:
             for an in it[5]:
-                if an.kind != "multi_terms":
+                if an.kind not in ("multi_terms", "composite"):
                     continue
-                mk = tuple(src["field"] for src in an.body["terms"])
+                if an.kind == "composite":
+                    mk = ("composite",) + self._composite_fields(an)
+                    bins_dev, mvocab = self._composite_for(
+                        an, name, svc, shard_segs, stats,
+                        stacked.ndocs_pad, mesh)
+                else:
+                    mk = tuple(src["field"] for src in an.body["terms"])
+                    bins_dev, mvocab = self._mterms_for(
+                        name, svc, mk, an, shard_segs, stats,
+                        stacked.ndocs_pad, mesh)
                 if mk in mterms_results:
                     continue
-                bins_dev, mvocab = self._mterms_for(
-                    name, svc, mk, an, shard_segs, stats,
-                    stacked.ndocs_pad, mesh)
                 nbp = next_pow2(max(len(mvocab), 1))
                 mfn_ = self._hist_program_for(
                     mesh, bucket, stacked.ndocs_pad, nbp, k1, b_eff,
@@ -1377,8 +1429,11 @@ class MeshSearchService:
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
-                if an.kind == "multi_terms":
-                    mk = tuple(src["field"] for src in an.body["terms"])
+                if an.kind in ("multi_terms", "composite"):
+                    mk = (("composite",) + self._composite_fields(an)
+                          if an.kind == "composite"
+                          else tuple(src["field"]
+                                     for src in an.body["terms"]))
                     counts, mvocab = mterms_results[mk]
                     buckets = _ordinal_partial(counts[bi], mvocab)
                     results[0].agg_partials[an.name] = [{"buckets":
@@ -1692,6 +1747,27 @@ class MeshSearchService:
                     and 1 <= len(an.body.get("ranges") or []) \
                     <= MAX_MESH_RANGES:
                 continue
+            # r5: composite over single-valued keyword terms sources —
+            # per-doc combined key == the multi_terms combined ordinal,
+            # so it rides the same stacker + bincount; paging (after/
+            # size/order) happens in the shared finalize
+            if an.kind == "composite" and set(an.body) <= \
+                    {"sources", "size", "after"} \
+                    and an.body.get("sources") and not an.subs:
+                ok = True
+                for src in an.body["sources"]:
+                    if len(src) != 1:
+                        ok = False
+                        break
+                    (nm, scfg), = src.items()
+                    if set(scfg) != {"terms"} \
+                            or "field" not in scfg["terms"] \
+                            or set(scfg["terms"]) - {"field", "order"}:
+                        ok = False
+                        break
+                if ok:
+                    continue
+                return None
             # r5: multi_terms — per-doc combined ordinals through the
             # same device bincount as the geo grids
             if an.kind == "multi_terms" and set(an.body) <= \

@@ -1136,6 +1136,18 @@ class TestMeshDateRangeMultiTerms:
         return cm, ch
 
     @pytest.mark.parametrize("aggs", [
+        # composite paginates the full product space; paging semantics
+        # (after/size/order) live in the shared finalize
+        {"c": {"composite": {"sources": [
+            {"a": {"terms": {"field": "cat"}}},
+            {"b": {"terms": {"field": "lvl"}}}], "size": 3}}},
+        {"c": {"composite": {"sources": [
+            {"a": {"terms": {"field": "cat",
+                             "order": "desc"}}}]}}},
+        {"c": {"composite": {"sources": [
+            {"a": {"terms": {"field": "cat"}}},
+            {"b": {"terms": {"field": "lvl"}}}], "size": 2,
+            "after": {"a": "x", "b": "hi"}}}},
         {"d": {"date_range": {"field": "ts", "ranges": [
             {"to": "2026-06-01"}, {"from": "2026-04-01"}]}}},
         {"d": {"date_range": {"field": "ts", "ranges": [
@@ -1155,3 +1167,37 @@ class TestMeshDateRangeMultiTerms:
         for aname in aggs:
             assert rm["aggregations"][aname] == rh["aggregations"][aname], \
                 (aname, rm["aggregations"][aname], rh["aggregations"][aname])
+
+
+class TestMeshCompositeEdges:
+    def test_bad_source_falls_back_not_crash(self):
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        svc = MeshSearchService()
+        cm = RestClient(node=Node(mesh_service=svc))
+        ch = RestClient()
+        for c in (cm, ch):
+            c.indices.create("ce", {"mappings": {"properties": {
+                "body": {"type": "text"}, "cat": {"type": "keyword"},
+                "n": {"type": "integer"}}}})
+            for i in range(30):
+                c.index("ce", {"body": "w1", "cat": f"c{i % 3}", "n": i},
+                        id=str(i))
+            c.indices.refresh("ce")
+        # numeric terms source: host treats as missing -> mesh must
+        # decline, not serve different buckets
+        body = {"query": {"match": {"body": "w1"}}, "size": 0,
+                "aggs": {"c": {"composite": {"sources": [
+                    {"a": {"terms": {"field": "n"}}}]}}}}
+        rm = cm.search(index="ce", body=dict(body))
+        rh = ch.search(index="ce", body=dict(body))
+        assert rm["aggregations"]["c"] == rh["aggregations"]["c"]
+        # field-less terms source: must not crash the request
+        body2 = {"query": {"match": {"body": "w1"}}, "size": 0,
+                 "aggs": {"c": {"composite": {"sources": [
+                     {"a": {"terms": {}}}]}}}}
+        rm2 = cm.search(index="ce", body=dict(body2))
+        rh2 = ch.search(index="ce", body=dict(body2))
+        assert rm2["aggregations"]["c"] == rh2["aggregations"]["c"]
