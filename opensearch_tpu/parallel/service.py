@@ -416,11 +416,13 @@ class MeshSearchService:
         from ..search import query_dsl as dsl
 
         for an in (agg_nodes or []):
-            if an.kind not in ("filters", "adjacency_matrix"):
+            if an.kind not in ("filters", "adjacency_matrix", "filter"):
                 continue
             if an.kind == "adjacency_matrix":
                 raw = an.body.get("filters", {})
                 items = [(k, raw[k]) for k in sorted(raw)]
+            elif an.kind == "filter":
+                items = [("_f", an.body)]
             else:
                 items = C.filters_agg_items(an.body)
             nodes = []
@@ -958,7 +960,8 @@ class MeshSearchService:
                            or self._col_for(name, svc, an.body["field"],
                                             shard_segs, stacked.ndocs_pad,
                                             mesh))
-                elif an.kind in ("filters", "adjacency_matrix"):
+                elif an.kind in ("filters", "adjacency_matrix",
+                                 "filter"):
                     got = getattr(an, "_mesh_filters", None)
                 elif an.kind == "weighted_avg":
                     got = self._col_for(
@@ -1038,7 +1041,7 @@ class MeshSearchService:
                                "rare_terms", "geohash_grid",
                                "geotile_grid", "filters", "date_range",
                                "multi_terms", "adjacency_matrix",
-                               "composite")})
+                               "composite", "filter")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5]
                                if an.kind in ("terms", "significant_terms",
@@ -1222,21 +1225,35 @@ class MeshSearchService:
         # `filters` agg: one metric-program count per named clause mask
         # (col == pres == the mask, so m[0] counts matched docs in it)
         fagg_results = {}
+        fsub_results = {}     # (combo, metric field) -> [QB, 5]
         for it in items:
             for an in it[5]:
-                if an.kind not in ("filters", "adjacency_matrix"):
+                if an.kind not in ("filters", "adjacency_matrix",
+                                   "filter"):
                     continue
+                mfn = self._metric_program_for(
+                    mesh, bucket, stacked.ndocs_pad, k1, b_eff, filtered)
                 for fname, combo, masks in an._mesh_filters:
-                    if combo in fagg_results:
-                        continue
                     dev = self._dev_mask_for(combo, masks, shard_segs,
                                              stacked.ndocs_pad, mesh)
-                    mfn = self._metric_program_for(
-                        mesh, bucket, stacked.ndocs_pad, k1, b_eff,
-                        filtered)
-                    margs = (stacked.tree(), rows, boosts, msm, cscore,
-                             dev, dev) + ((fmask,) if filtered else ())
-                    fagg_results[combo] = mfn(*margs)
+                    if combo not in fagg_results:
+                        margs = (stacked.tree(), rows, boosts, msm,
+                                 cscore, dev, dev) \
+                            + ((fmask,) if filtered else ())
+                        fagg_results[combo] = mfn(*margs)
+                    # metric subs under a `filter` wrapper: presence
+                    # composes with the wrapper's mask on device
+                    for sub in an.subs:
+                        skey = (combo, sub.body["field"])
+                        if skey in fsub_results:
+                            continue
+                        scol, spres = self._col_for(
+                            name, svc, sub.body["field"], shard_segs,
+                            stacked.ndocs_pad, mesh)
+                        sargs = (stacked.tree(), rows, boosts, msm,
+                                 cscore, scol, spres * dev) \
+                            + ((fmask,) if filtered else ())
+                        fsub_results[skey] = mfn(*sargs)
 
         # multi_terms + composite: combined global ordinals through the
         # bincount (a composite's key tuple IS the multi_terms key)
@@ -1355,13 +1372,13 @@ class MeshSearchService:
                                   rsub_results, card_results,
                                   dd_results, wavg_results, geo_results,
                                   grid_results, fagg_results,
-                                  mterms_results))
+                                  mterms_results, fsub_results))
         (gdocs_b, gvals_b, totals_b, metrics_by_field,
          tcounts_by_field, hist_results, range_results,
          tsub_results, hsub_results, rsub_results,
          card_results, dd_results, wavg_results,
          geo_results, grid_results, fagg_results,
-         mterms_results) = fetched
+         mterms_results, fsub_results) = fetched
 
         # attach the globally-reduced agg partials to shard 0 (the values
         # are already psum'd across the mesh; the coordinator merge sees
@@ -1438,6 +1455,17 @@ class MeshSearchService:
                     buckets = _ordinal_partial(counts[bi], mvocab)
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
+                    continue
+                if an.kind == "filter":
+                    _fn, combo, _m = an._mesh_filters[0]
+                    subs = {}
+                    for sub in an.subs:
+                        m = fsub_results[(combo, sub.body["field"])][bi]
+                        subs[sub.name] = _stat_partial(m[0], m[1:5])
+                    results[0].agg_partials[an.name] = [{
+                        "doc_count": int(round(float(
+                            fagg_results[combo][bi][0]))),
+                        "subs": subs}]
                     continue
                 if an.kind in ("filters", "adjacency_matrix"):
                     buckets = {
@@ -1666,8 +1694,14 @@ class MeshSearchService:
         for an in (agg_nodes or []):
             if an.subs and not (
                     an.kind in ("terms", "histogram", "date_histogram",
-                                "range", "date_range") and _subs_ok(an)):
+                                "range", "date_range", "filter")
+                    and _subs_ok(an)):
                 return None
+            # r5: single `filter` wrapper — the clause becomes a device
+            # mask (query-filter machinery); metric subs compose their
+            # presence with it
+            if an.kind == "filter":
+                continue
             if an.kind in _MESH_METRICS and set(an.body) == {"field"} \
                     and not an.subs:
                 continue

@@ -1201,3 +1201,65 @@ class TestMeshCompositeEdges:
         rm2 = cm.search(index="ce", body=dict(body2))
         rh2 = ch.search(index="ce", body=dict(body2))
         assert rm2["aggregations"]["c"] == rh2["aggregations"]["c"]
+
+
+class TestMeshFilterWrapper:
+    def test_filter_wrapper_parity(self):
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        svc = MeshSearchService()
+        cm = RestClient(node=Node(mesh_service=svc))
+        ch = RestClient()
+        for c in (cm, ch):
+            rng = np.random.default_rng(91)
+            c.indices.create("fw", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "body": {"type": "text"},
+                    "s": {"type": "keyword"},
+                    "n": {"type": "integer"}}}})
+            bulk = []
+            for i in range(400):
+                bulk.append({"index": {"_index": "fw", "_id": str(i)}})
+                bulk.append({"body": f"w{int(rng.integers(0, 5))}",
+                             "s": ["a", "b"][i % 2],
+                             "n": int(rng.integers(0, 100))})
+            c.bulk(bulk)
+            c.indices.refresh("fw")
+            c.indices.forcemerge("fw")
+        body = {"query": {"match": {"body": "w1"}}, "size": 0,
+                "aggs": {"f": {"filter": {"term": {"s": "a"}},
+                               "aggs": {"avg_n": {"avg": {"field": "n"}},
+                                        "st": {"stats": {
+                                            "field": "n"}}}}}}
+        d0 = svc.dispatched
+        rm = cm.search(index="fw", body=dict(body))
+        rh = ch.search(index="fw", body=dict(body))
+        assert svc.dispatched == d0 + 1, "mesh did not serve filter agg"
+        assert rm["aggregations"]["f"] == rh["aggregations"]["f"], \
+            (rm["aggregations"]["f"], rh["aggregations"]["f"])
+
+    def test_unmaskable_filter_wrapper_falls_back(self):
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        svc = MeshSearchService()
+        cm = RestClient(node=Node(mesh_service=svc))
+        ch = RestClient()
+        for c in (cm, ch):
+            c.indices.create("fw2", {"mappings": {"properties": {
+                "body": {"type": "text"}}}})
+            for i in range(30):
+                c.index("fw2", {"body": "red wool sweater"}, id=str(i))
+            c.indices.refresh("fw2")
+        body = {"query": {"match": {"body": "red"}}, "size": 0,
+                "aggs": {"f": {"filter": {"match_phrase": {
+                    "body": "wool sweater"}}}}}
+        f0 = svc.fallbacks
+        rm = cm.search(index="fw2", body=dict(body))
+        rh = ch.search(index="fw2", body=dict(body))
+        assert svc.fallbacks == f0 + 1
+        assert rm["aggregations"]["f"] == rh["aggregations"]["f"]
