@@ -416,13 +416,16 @@ class MeshSearchService:
         from ..search import query_dsl as dsl
 
         for an in (agg_nodes or []):
-            if an.kind not in ("filters", "adjacency_matrix", "filter"):
+            if an.kind not in ("filters", "adjacency_matrix", "filter",
+                               "missing"):
                 continue
             if an.kind == "adjacency_matrix":
                 raw = an.body.get("filters", {})
                 items = [(k, raw[k]) for k in sorted(raw)]
             elif an.kind == "filter":
                 items = [("_f", an.body)]
+            elif an.kind == "missing":
+                items = [("_f", {"exists": {"field": an.body["field"]}})]
             else:
                 items = C.filters_agg_items(an.body)
             nodes = []
@@ -436,6 +439,14 @@ class MeshSearchService:
                     return False
                 nodes.append((fname, lnode))
             resolved = []
+            if an.kind == "missing":
+                # the wrapper mask is NOT exists(field)
+                fp = self._fmask_resolve(shard_segs, stats, [],
+                                         [nodes[0][1]])
+                if fp is None:
+                    return False
+                an._mesh_filters = [("_f", fp[0], fp[1])]
+                continue
             combos = [(fname, [ln]) for fname, ln in nodes]
             if an.kind == "adjacency_matrix":
                 # plus the pairwise intersections, host label order
@@ -961,7 +972,7 @@ class MeshSearchService:
                                             shard_segs, stacked.ndocs_pad,
                                             mesh))
                 elif an.kind in ("filters", "adjacency_matrix",
-                                 "filter"):
+                                 "filter", "missing"):
                     got = getattr(an, "_mesh_filters", None)
                 elif an.kind == "weighted_avg":
                     got = self._col_for(
@@ -1041,7 +1052,7 @@ class MeshSearchService:
                                "rare_terms", "geohash_grid",
                                "geotile_grid", "filters", "date_range",
                                "multi_terms", "adjacency_matrix",
-                               "composite", "filter")})
+                               "composite", "filter", "missing")})
         terms_fields = sorted({an.body["field"] for it in items
                                for an in it[5]
                                if an.kind in ("terms", "significant_terms",
@@ -1229,7 +1240,7 @@ class MeshSearchService:
         for it in items:
             for an in it[5]:
                 if an.kind not in ("filters", "adjacency_matrix",
-                                   "filter"):
+                                   "filter", "missing"):
                     continue
                 mfn = self._metric_program_for(
                     mesh, bucket, stacked.ndocs_pad, k1, b_eff, filtered)
@@ -1456,7 +1467,7 @@ class MeshSearchService:
                     results[0].agg_partials[an.name] = [{"buckets":
                                                          buckets}]
                     continue
-                if an.kind == "filter":
+                if an.kind in ("filter", "missing"):
                     _fn, combo, _m = an._mesh_filters[0]
                     subs = {}
                     for sub in an.subs:
@@ -1694,13 +1705,17 @@ class MeshSearchService:
         for an in (agg_nodes or []):
             if an.subs and not (
                     an.kind in ("terms", "histogram", "date_histogram",
-                                "range", "date_range", "filter")
+                                "range", "date_range", "filter",
+                                "missing")
                     and _subs_ok(an)):
                 return None
             # r5: single `filter` wrapper — the clause becomes a device
             # mask (query-filter machinery); metric subs compose their
-            # presence with it
+            # presence with it. `missing` is the same wrapper with a
+            # negated exists mask
             if an.kind == "filter":
+                continue
+            if an.kind == "missing" and set(an.body) == {"field"}:
                 continue
             if an.kind in _MESH_METRICS and set(an.body) == {"field"} \
                     and not an.subs:

@@ -1263,3 +1263,40 @@ class TestMeshFilterWrapper:
         rh = ch.search(index="fw2", body=dict(body))
         assert svc.fallbacks == f0 + 1
         assert rm["aggregations"]["f"] == rh["aggregations"]["f"]
+
+    def test_missing_agg_parity(self):
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        svc = MeshSearchService()
+        cm = RestClient(node=Node(mesh_service=svc))
+        ch = RestClient()
+        for c in (cm, ch):
+            rng = np.random.default_rng(97)
+            c.indices.create("ms", {
+                "settings": {"number_of_shards": 4},
+                "mappings": {"properties": {
+                    "body": {"type": "text"},
+                    "tag": {"type": "keyword"},
+                    "n": {"type": "integer"}}}})
+            bulk = []
+            for i in range(300):
+                bulk.append({"index": {"_index": "ms", "_id": str(i)}})
+                doc = {"body": f"w{int(rng.integers(0, 4))}",
+                       "n": int(rng.integers(0, 50))}
+                if i % 3:
+                    doc["tag"] = "t"
+                bulk.append(doc)
+            c.bulk(bulk)
+            c.indices.refresh("ms")
+            c.indices.forcemerge("ms")
+        body = {"query": {"match": {"body": "w1"}}, "size": 0,
+                "aggs": {"no_tag": {"missing": {"field": "tag"},
+                                    "aggs": {"a": {"avg": {
+                                        "field": "n"}}}}}}
+        d0 = svc.dispatched
+        rm = cm.search(index="ms", body=dict(body))
+        rh = ch.search(index="ms", body=dict(body))
+        assert svc.dispatched == d0 + 1, "mesh did not serve missing agg"
+        assert rm["aggregations"]["no_tag"] == rh["aggregations"]["no_tag"]
