@@ -341,8 +341,12 @@ class Node:
         # is visible (a pod slice, or the virtual 8-CPU-device test mesh);
         # OPENSEARCH_TPU_MESH=0 disables it, =1 forces it even single-chip.
         # Eligible searches run the distributed program; everything else
-        # falls back to the host shard loop with identical results
-        if mesh_service is None:
+        # falls back to the host shard loop with identical results.
+        # mesh_service=False pins the TRUE host loop (parity-test
+        # reference clients must not silently auto-enable a mesh)
+        if mesh_service is False:
+            mesh_service = None
+        elif mesh_service is None:
             flag = os.environ.get("OPENSEARCH_TPU_MESH")
             enable = (flag not in (None, "", "0") if flag is not None
                       else self._device_count() > 1)

@@ -440,6 +440,18 @@ class MeshSearchService:
                 nodes.append((fname, lnode))
             resolved = []
             if an.kind == "missing":
+                # parity guard: the host missing aggregator recognizes
+                # ONLY numeric/keyword columns (text/geo fields count all
+                # docs as missing there), while the exists mask sees
+                # text/geo presence — serve only fields that are
+                # numeric/keyword-backed in EVERY segment
+                mp = stats[0].mappings
+                f = mp.aliases.get(an.body["field"], an.body["field"])
+                for segs in shard_segs:
+                    for seg in segs:
+                        if f not in seg.numeric_cols \
+                                and f not in seg.keyword_cols:
+                            return False
                 # the wrapper mask is NOT exists(field)
                 fp = self._fmask_resolve(shard_segs, stats, [],
                                          [nodes[0][1]])
@@ -818,8 +830,10 @@ class MeshSearchService:
             return self._mark_declined(bodies, out)
         # a shard may hold any number of segments (incl. zero for routing
         # holes) — the stacked index concatenates them per shard
-        shard_segs = [[g for g in s.engine.segments if g.live_count > 0]
-                      for s in searchers]
+        # ALL segments, including fully-deleted ones: the host's Lucene
+        # maxDoc stats (N, df) count their docs, so excluding them skews
+        # mesh idf; their live mask already zeroes every match
+        shard_segs = [list(s.engine.segments) for s in searchers]
         stats = _global_stats_contexts(searchers)
         ctx = stats[0]
 
@@ -1093,7 +1107,7 @@ class MeshSearchService:
                 sub_results[skey] = pmfn(*pmargs)
 
         terms_subs = [an for it in items for an in it[5]
-                      if an.kind == "terms" and an.subs]
+                      if an.kind in ("terms", "rare_terms") and an.subs]
         for f in terms_fields:
             val_doc, val_ord, vocab, vpad = self._ord_for(
                 name, svc, f, shard_segs, stacked.ndocs_pad, mesh)
@@ -1704,9 +1718,9 @@ class MeshSearchService:
 
         for an in (agg_nodes or []):
             if an.subs and not (
-                    an.kind in ("terms", "histogram", "date_histogram",
-                                "range", "date_range", "filter",
-                                "missing")
+                    an.kind in ("terms", "rare_terms", "histogram",
+                                "date_histogram", "range", "date_range",
+                                "filter", "missing")
                     and _subs_ok(an)):
                 return None
             # r5: single `filter` wrapper — the clause becomes a device
@@ -1764,7 +1778,7 @@ class MeshSearchService:
             # r5: rare_terms rides the same exact bincount (our host path
             # is exact, not bloom-approximated, so parity is exact too)
             if an.kind == "rare_terms" and set(an.body) <= \
-                    {"field", "max_doc_count"} and not an.subs:
+                    {"field", "max_doc_count"}:
                 continue
             # r5: geo grids — host-precomputed per-doc cell ordinals
             # through the same device bincount as histograms

@@ -68,7 +68,7 @@ class StackedShardIndex:
     dl: jnp.ndarray         # f32[S, D_pad]
     live: jnp.ndarray       # f32[S, D_pad]
     doc_base: jnp.ndarray   # i32[S] global doc id offset per shard
-    doc_count: jnp.ndarray  # f32[S] live docs per shard
+    doc_count: jnp.ndarray  # f32[S] maxDoc per shard (deleted INCLUDED)
     sum_dl: jnp.ndarray     # f32[S]
     field_dc: jnp.ndarray   # f32[S] docs WITH this field (text_stats doc_count)
     n_shards: int
@@ -121,7 +121,12 @@ class StackedShardIndex:
             live[i, : m["ndocs"]] = m["live"]
             doc_base[i] = base
             base += m["ndocs"]
-            doc_count[i] = m["live_count"]
+            # idf N follows host ShardContext.num_docs = Lucene maxDoc
+            # (deleted docs INCLUDED — the host rewrite and every scorer
+            # use it; psumming live counts instead skewed idf on indexes
+            # with deletes, hidden while parity tests compared mesh to
+            # its own mesh)
+            doc_count[i] = float(m["ndocs"])
             sum_dl[i] = m["sum_dl"]
             field_dc[i] = m["field_dc"]
             host_terms.append(m["terms"])
@@ -156,14 +161,13 @@ def _concat_shard(segs: List[Segment], field: str) -> dict:
                 "tfs": np.zeros(0, np.float32),
                 "dl": np.zeros(0, np.float32),
                 "live": np.zeros(0, np.float32), "ndocs": 0,
-                "live_count": 0.0, "sum_dl": 0.0, "field_dc": 0.0}
+                "sum_dl": 0.0, "field_dc": 0.0}
     ndocs = sum(s.ndocs for s in segs)
     live = np.zeros(ndocs, np.float32)
     dl = np.zeros(ndocs, np.float32)
     off = 0
     sum_dl = 0.0
     field_dc = 0.0
-    live_count = 0.0
     for s in segs:
         live[off: off + s.ndocs] = s.live.astype(np.float32)
         sdl = s.doc_lens.get(field)
@@ -173,14 +177,13 @@ def _concat_shard(segs: List[Segment], field: str) -> dict:
         if st:
             sum_dl += st.sum_dl
             field_dc += st.doc_count
-        live_count += s.live_count
         off += s.ndocs
     pbs = [s.postings.get(field) for s in segs]
     if len(segs) == 1 and pbs[0] is not None:
         pb = pbs[0]
         return {"terms": pb.terms, "starts": pb.starts.astype(np.int64),
                 "doc_ids": pb.doc_ids, "tfs": pb.tfs, "dl": dl, "live": live,
-                "ndocs": ndocs, "live_count": live_count, "sum_dl": sum_dl,
+                "ndocs": ndocs, "sum_dl": sum_dl,
                 "field_dc": field_dc}
     vocab: Dict[str, int] = {}
     for pb in pbs:
@@ -216,8 +219,7 @@ def _concat_shard(segs: List[Segment], field: str) -> dict:
     np.cumsum(lens, out=starts[1:])
     return {"terms": vocab, "starts": starts, "doc_ids": doc_ids, "tfs": tfs,
             "dl": dl, "live": live, "ndocs": ndocs,
-            "live_count": live_count, "sum_dl": sum_dl,
-            "field_dc": field_dc}
+            "sum_dl": sum_dl, "field_dc": field_dc}
 
 
 def _local_gather(starts, doc_ids, tfs, rows, bucket: int):

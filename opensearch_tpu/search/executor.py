@@ -1450,7 +1450,12 @@ def _host_sort_values(sort_specs: List[dict], seg: Segment, doc: int,
                       score: float) -> Tuple[Tuple, Tuple]:
     """(comparison tuple asc-ordered, raw user-facing values)."""
     if not sort_specs:
-        return ((-score, seg.ids[doc]), (score,))
+        # score ties break by (shard, segment, local doc) via the STABLE
+        # final sort over shard-concatenated candidates — the reference's
+        # own merge comparator (score, shard index, doc), and exactly the
+        # order every device selection (kernel top-k, mesh program) uses.
+        # An _id tie-break here would diverge from both.
+        return ((-score,), (score,))
     comp = []
     raw = []
     for spec in sort_specs:

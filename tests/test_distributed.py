@@ -195,7 +195,7 @@ class TestMeshService:
         from opensearch_tpu.rest.client import RestClient
 
         cm = RestClient(node=Node(mesh_service=MeshSearchService()))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         cats = ["kitchen", "garden", "garage"]
         for c in (cm, ch):
             rng = np.random.default_rng(3)  # same docs for both clients
@@ -700,7 +700,7 @@ class TestMeshBucketAggs:
         from opensearch_tpu.rest.client import RestClient
 
         cm = RestClient(node=Node(mesh_service=MeshSearchService()))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         for c in (cm, ch):
             rng = np.random.default_rng(7)
             c.indices.create("hx", {
@@ -915,7 +915,9 @@ class TestMeshBucketAggs:
         cm, ch = clients
         body = {"query": {"match": {"body": "alpha"}}, "size": 0,
                 "aggs": {"r": {"rare_terms": {"field": "status",
-                                              "max_doc_count": 500}}}}
+                                              "max_doc_count": 500},
+                               "aggs": {"a": {"avg": {
+                                   "field": "num"}}}}}}
         before = cm.node.mesh_service.dispatched
         rm = cm.search(index="hx", body=dict(body))
         rh = ch.search(index="hx", body=dict(body))
@@ -995,7 +997,15 @@ class TestMeshBucketAggs:
         assert cm.node.mesh_service.dispatched == before + 1, \
             "mesh did not serve the geo-stat body"
         assert rm["aggregations"]["b"] == rh["aggregations"]["b"]
-        assert rm["aggregations"]["c"] == rh["aggregations"]["c"]
+        # centroid sums fractional lat/lon: the device psum and the host
+        # f64 partial accumulation round differently (float tree
+        # reductions; counts and bounds stay exact)
+        assert rm["aggregations"]["c"]["count"] == \
+            rh["aggregations"]["c"]["count"]
+        for axis in ("lat", "lon"):
+            np.testing.assert_allclose(
+                rm["aggregations"]["c"]["location"][axis],
+                rh["aggregations"]["c"]["location"][axis], rtol=1e-5)
 
     def test_weighted_avg_missing_falls_back(self, clients):
         # `missing` defaults aren't meshed: host loop, same answer
@@ -1082,7 +1092,7 @@ class TestSigTermsMixedPresence:
 
         svc = MeshSearchService()
         cm = RestClient(node=Node(mesh_service=svc))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         for c in (cm, ch):
             c.indices.create("mp", {"mappings": {"properties": {
                 "body": {"type": "text"},
@@ -1112,7 +1122,7 @@ class TestMeshDateRangeMultiTerms:
         from opensearch_tpu.rest.client import RestClient
 
         cm = RestClient(node=Node(mesh_service=MeshSearchService()))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         for c in (cm, ch):
             rng = np.random.default_rng(29)
             c.indices.create("dr", {
@@ -1177,7 +1187,7 @@ class TestMeshCompositeEdges:
 
         svc = MeshSearchService()
         cm = RestClient(node=Node(mesh_service=svc))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         for c in (cm, ch):
             c.indices.create("ce", {"mappings": {"properties": {
                 "body": {"type": "text"}, "cat": {"type": "keyword"},
@@ -1211,7 +1221,7 @@ class TestMeshFilterWrapper:
 
         svc = MeshSearchService()
         cm = RestClient(node=Node(mesh_service=svc))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         for c in (cm, ch):
             rng = np.random.default_rng(91)
             c.indices.create("fw", {
@@ -1248,7 +1258,7 @@ class TestMeshFilterWrapper:
 
         svc = MeshSearchService()
         cm = RestClient(node=Node(mesh_service=svc))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         for c in (cm, ch):
             c.indices.create("fw2", {"mappings": {"properties": {
                 "body": {"type": "text"}}}})
@@ -1271,7 +1281,7 @@ class TestMeshFilterWrapper:
 
         svc = MeshSearchService()
         cm = RestClient(node=Node(mesh_service=svc))
-        ch = RestClient()
+        ch = RestClient(node=Node(mesh_service=False))
         for c in (cm, ch):
             rng = np.random.default_rng(97)
             c.indices.create("ms", {
@@ -1300,3 +1310,39 @@ class TestMeshFilterWrapper:
         rh = ch.search(index="ms", body=dict(body))
         assert svc.dispatched == d0 + 1, "mesh did not serve missing agg"
         assert rm["aggregations"]["no_tag"] == rh["aggregations"]["no_tag"]
+
+
+class TestFullyDeletedSegmentStats:
+    def test_idf_parity_with_dead_segment(self):
+        # regression: a fully-deleted segment still counts toward Lucene
+        # maxDoc stats (N, df) on the host; the mesh must include it in
+        # the stacked view or idf diverges
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+
+        svc = MeshSearchService()
+        cm = RestClient(node=Node(mesh_service=svc))
+        ch = RestClient(node=Node(mesh_service=False))
+        for c in (cm, ch):
+            c.indices.create("dd", {"settings": {"number_of_shards": 2},
+                             "mappings": {"properties": {
+                                 "body": {"type": "text"}}}})
+            for i in range(20):
+                c.index("dd", {"body": f"alpha w{i % 5}"}, id=str(i))
+            c.indices.refresh("dd")
+            for i in range(20, 40):
+                c.index("dd", {"body": f"alpha w{i % 5}"}, id=str(i))
+            c.indices.refresh("dd")
+            for i in range(20, 40):
+                c.delete(index="dd", id=str(i))
+            c.indices.refresh("dd")
+        body = {"query": {"match": {"body": "alpha w1"}}, "size": 10}
+        d0 = svc.dispatched
+        rm = cm.search(index="dd", body=dict(body))
+        rh = ch.search(index="dd", body=dict(body))
+        assert svc.dispatched == d0 + 1, "mesh did not serve"
+        assert [(h["_id"], round(h["_score"], 5))
+                for h in rm["hits"]["hits"]] == \
+            [(h["_id"], round(h["_score"], 5))
+             for h in rh["hits"]["hits"]]
