@@ -250,8 +250,11 @@ class DistClusterNode:
         """Leader: bump version, push full state to every member (self
         applies synchronously). Unreachable members keep their shards in
         the routing table; searches report them failed until they rejoin."""
-        self.version += 1
-        st = self._state()
+        # bump + snapshot under the (reentrant) state lock: the unlocked
+        # bump raced `_apply_state`'s locked `self.version = st["version"]`
+        with self._lock:
+            self.version += 1
+            st = self._state()
         for name, addr in list(self.members.items()):
             if name == self.name:
                 continue

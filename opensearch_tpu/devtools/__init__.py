@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the repo but never runs in serving
+paths: static analysis (`oslint`), future codegen/bench helpers."""

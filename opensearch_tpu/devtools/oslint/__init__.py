@@ -1,0 +1,33 @@
+"""oslint — AST-based host/device discipline linter for opensearch_tpu.
+
+Four checkers tailored to this repo's failure modes (see
+docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
+
+- OSL101/OSL102 dtype-discipline (`dtype_rules`): float domain mixing in
+  score comparisons; float-rounded count planes.
+- OSL201/OSL202/OSL203 jit-boundary (`jit_rules`): traced-value branches,
+  host syncs, nondeterminism inside jit/shard_map/Pallas code.
+- OSL301 breaker-discipline (`breaker_rules`): ndocs-scale host caches
+  without a memory-breaker charge/release.
+- OSL401/OSL402 lock-discipline (`lock_rules`): attributes mutated both
+  under and outside a lock; lock-order inversions.
+
+Run via `python scripts/oslint.py [--check]`; tier-1 runs it through
+tests/test_oslint.py. Suppress inline with
+`# oslint: disable=RULE -- justification`, or triage pre-existing debt in
+the checked-in `oslint_baseline.json`.
+"""
+
+from .breaker_rules import BreakerDisciplineChecker
+from .core import (Baseline, Checker, Finding, default_checkers,
+                   load_baseline, run_paths, run_source, write_baseline)
+from .dtype_rules import DtypeDisciplineChecker
+from .jit_rules import JitBoundaryChecker
+from .lock_rules import LockDisciplineChecker
+
+__all__ = [
+    "Baseline", "Checker", "Finding", "default_checkers", "load_baseline",
+    "run_paths", "run_source", "write_baseline",
+    "DtypeDisciplineChecker", "JitBoundaryChecker",
+    "BreakerDisciplineChecker", "LockDisciplineChecker",
+]

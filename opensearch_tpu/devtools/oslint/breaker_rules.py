@@ -1,0 +1,109 @@
+"""OSL3xx — memory-breaker discipline for long-lived host caches.
+
+The fastpath caches ndocs-sized host arrays (masks, doc lists, aligned
+layouts) on `Segment`s and services for the lifetime of the index
+generation. Every such cache must charge the memory breaker and release
+on eviction — otherwise large segments accumulate untracked host memory
+(the ADVICE round-5 `search/fastpath.py:1009` `_quality_tier` finding).
+
+Rule OSL301 fires when ONE function:
+  1. stores into a long-lived cache — the `obj.__dict__.setdefault(...)`
+     idiom this repo uses for per-segment caches, or a subscript store
+     into an attribute whose name contains "cache" — AND
+  2. allocates docs-scale host arrays (np.zeros/ones/full/empty/
+     flatnonzero/nonzero/arange, or a FilterList) while mentioning
+     `ndocs` — AND
+  3. never references a breaker (any name containing "breaker", e.g. the
+     module-level `_breaker` charged via `add_estimate`/`release`).
+
+Condition 3 is deliberately loose: the rule's job is to force the author
+to THINK about accounting, not to verify the arithmetic. Suppress with
+`# oslint: disable=OSL301 -- <why this cache is O(1)/already charged>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_ALLOCATORS = {"zeros", "ones", "full", "empty", "flatnonzero", "nonzero",
+               "arange", "unique", "concatenate", "copy"}
+_TRACKED_CTORS = {"FilterList"}
+
+
+class BreakerDisciplineChecker(Checker):
+    rules = ("OSL301",)
+    name = "breaker-discipline"
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_fn(node, qmap.get(node, node.name), path,
+                               findings)
+        return findings
+
+    def _check_fn(self, fn: ast.FunctionDef, sym: str, path: str,
+                  findings: List[Finding]) -> None:
+        cache_names: Set[str] = set()
+        cache_stores: List[ast.AST] = []
+        mentions_ndocs = False
+        allocates = False
+        mentions_breaker = False
+
+        for node in ast.walk(fn):
+            # cache = obj.__dict__.setdefault("...", ...)
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                f = node.value.func
+                if isinstance(f, ast.Attribute) and f.attr == "setdefault" \
+                        and isinstance(f.value, ast.Attribute) \
+                        and f.value.attr == "__dict__":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            cache_names.add(t.id)
+            # cache[key] = value   /   self._x_cache[key] = value
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        base = t.value
+                        if isinstance(base, ast.Name) and \
+                                base.id in cache_names:
+                            cache_stores.append(t)
+                        elif isinstance(base, ast.Attribute) and \
+                                "cache" in base.attr.lower():
+                            cache_stores.append(t)
+            if isinstance(node, ast.Attribute) and node.attr == "ndocs":
+                mentions_ndocs = True
+            if isinstance(node, ast.Name):
+                if node.id == "ndocs":
+                    mentions_ndocs = True
+                if "breaker" in node.id.lower():
+                    mentions_breaker = True
+            if isinstance(node, ast.Attribute) and \
+                    "breaker" in node.attr.lower():
+                mentions_breaker = True
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                leaf = d.rsplit(".", 1)[-1]
+                if leaf in _ALLOCATORS and d.split(".", 1)[0] in (
+                        "np", "numpy", "jnp"):
+                    allocates = True
+                if leaf in _TRACKED_CTORS:
+                    allocates = True
+
+        if cache_stores and mentions_ndocs and allocates \
+                and not mentions_breaker:
+            store = cache_stores[0]
+            findings.append(Finding(
+                "OSL301", path, store.lineno, store.col_offset, sym,
+                "ndocs-scale host allocation cached on a long-lived "
+                "object without a memory-breaker charge; charge "
+                "`_breaker.add_estimate(nbytes, ...)` with a "
+                "`weakref.finalize(obj, _breaker.release, nbytes)` "
+                "paired release",
+                detail=f"cache@{sym}"))

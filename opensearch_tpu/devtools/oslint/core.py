@@ -1,0 +1,257 @@
+"""oslint core: finding model, suppression comments, baseline files, and
+the file/checker driver.
+
+The linter encodes this repo's unwritten invariants as AST checks (see
+docs/STATIC_ANALYSIS.md). Design rules:
+
+- Findings carry a *stable fingerprint* (rule, path, enclosing symbol,
+  detail) rather than a line number, so baselines survive unrelated edits;
+  each baseline entry also records how many findings share the
+  fingerprint, so an ADDITIONAL same-rule violation in a baselined
+  symbol still fails the gate (count ratchet).
+- Pre-existing findings are TRIAGED, not silenced: the checked-in baseline
+  records a justification per entry, and `--check` fails only on findings
+  absent from it.
+- Inline escapes use `# oslint: disable=OSL101 -- why` on the flagged line.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*oslint:\s*disable(?:=([A-Za-z0-9_, ]+))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str          # e.g. "OSL101"
+    path: str          # repo-relative, forward slashes
+    line: int
+    col: int
+    symbol: str        # enclosing qualname ("" at module level)
+    msg: str
+    detail: str = ""   # short stable discriminator for the fingerprint
+
+    @property
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.detail)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}{sym} {self.msg}")
+
+
+class Checker:
+    """Base class: subclasses set `rules` and implement `check`."""
+
+    rules: Tuple[str, ...] = ()
+    name = "checker"
+
+    def applies(self, path: str) -> bool:
+        return True
+
+    def check(self, tree: ast.Module, path: str,
+              src: str) -> List[Finding]:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('np.float32', 'float');
+    '' when the base is not a plain Name."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def qualname_map(tree: ast.Module) -> Dict[ast.AST, str]:
+    """node -> enclosing dotted qualname for every function/class body
+    node (the node OF a def maps to that def's qualname)."""
+    out: Dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, stack: List[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sub = stack + [child.name]
+                out[child] = ".".join(sub)
+                visit(child, sub)
+            else:
+                out[child] = ".".join(stack)
+                visit(child, stack)
+
+    visit(tree, [])
+    return out
+
+
+def enclosing_symbol(qmap: Dict[ast.AST, str], node: ast.AST) -> str:
+    return qmap.get(node, "")
+
+
+def parse_suppressions(src: str) -> Dict[int, Optional[Set[str]]]:
+    """line -> suppressed rule set (None = all rules) from
+    `# oslint: disable[=RULE[,RULE]]` comments."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for i, text in enumerate(src.splitlines(), start=1):
+        m = SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        if m.group(1) is None:
+            out[i] = None
+        else:
+            out[i] = {r.strip().upper() for r in m.group(1).split(",")
+                      if r.strip()}
+    return out
+
+
+def _suppressed(f: Finding, sup: Dict[int, Optional[Set[str]]]) -> bool:
+    rules = sup.get(f.line, False)
+    if rules is False:
+        return False
+    return rules is None or f.rule in rules
+
+
+def default_checkers() -> List[Checker]:
+    from .breaker_rules import BreakerDisciplineChecker
+    from .dtype_rules import DtypeDisciplineChecker
+    from .jit_rules import JitBoundaryChecker
+    from .lock_rules import LockDisciplineChecker
+    return [DtypeDisciplineChecker(), JitBoundaryChecker(),
+            BreakerDisciplineChecker(), LockDisciplineChecker()]
+
+
+def run_source(src: str, path: str,
+               checkers: Optional[Sequence[Checker]] = None
+               ) -> List[Finding]:
+    """Lint one file's source. `path` is the repo-relative posix path the
+    scope filters and fingerprints use."""
+    checkers = list(checkers) if checkers is not None else default_checkers()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding("OSL000", path, e.lineno or 1, 0, "",
+                        f"syntax error: {e.msg}", "syntax")]
+    sup = parse_suppressions(src)
+    findings: List[Finding] = []
+    for ch in checkers:
+        if ch.applies(path):
+            findings.extend(ch.check(tree, path, src))
+    findings = [f for f in findings if not _suppressed(f, sup)]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    return findings
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", ".git"))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_paths(paths: Sequence[str], repo_root: str,
+              checkers: Optional[Sequence[Checker]] = None
+              ) -> List[Finding]:
+    files: List[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(repo_root, p)
+        if os.path.isdir(ap):
+            files.extend(iter_py_files(ap))
+        else:
+            files.append(ap)
+    findings: List[Finding] = []
+    for f in files:
+        rel = os.path.relpath(f, repo_root).replace(os.sep, "/")
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(run_source(src, rel, checkers))
+    return findings
+
+
+# --------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------
+
+def _entry_fp(e: dict) -> Tuple[str, str, str, str]:
+    return (e["rule"], e["path"], e.get("symbol", ""), e.get("detail", ""))
+
+
+@dataclass
+class Baseline:
+    """Fingerprints are line-free, so several same-rule findings in one
+    symbol share one; each entry therefore also records the triaged
+    `count`, and the gate is a RATCHET: more occurrences of a baselined
+    fingerprint than triaged is a new finding, fewer marks the entry
+    stale so the count (and eventually the entry) shrinks."""
+
+    entries: List[dict] = field(default_factory=list)
+
+    def fingerprints(self) -> Set[Tuple[str, str, str, str]]:
+        return {_entry_fp(e) for e in self.entries}
+
+    def counts(self) -> Dict[Tuple[str, str, str, str], int]:
+        return {_entry_fp(e): int(e.get("count", 1)) for e in self.entries}
+
+    def new_findings(self, findings: Sequence[Finding]) -> List[Finding]:
+        allowed = self.counts()
+        by_fp: Dict[Tuple[str, str, str, str], List[Finding]] = {}
+        for f in findings:
+            by_fp.setdefault(f.fingerprint, []).append(f)
+        out: List[Finding] = []
+        for fp, fs in by_fp.items():
+            extra = len(fs) - allowed.get(fp, 0)
+            if extra > 0:
+                # report the excess occurrences (last in line order —
+                # WHICH ones are new is unknowable without line-stable
+                # identity, but the count regression is the signal)
+                out.extend(sorted(fs, key=lambda f: f.line)[-extra:])
+        return out
+
+    def stale_entries(self, findings: Sequence[Finding]) -> List[dict]:
+        """Baseline entries firing FEWER times than triaged (candidates
+        for count shrink or removal — the debt was paid)."""
+        live: Dict[Tuple[str, str, str, str], int] = {}
+        for f in findings:
+            live[f.fingerprint] = live.get(f.fingerprint, 0) + 1
+        return [e for e in self.entries
+                if live.get(_entry_fp(e), 0) < int(e.get("count", 1))]
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return Baseline(entries=list(data.get("entries", [])))
+
+
+def write_baseline(findings: Sequence[Finding], path: str,
+                   reasons: Optional[Dict[Tuple[str, str, str, str],
+                                          str]] = None) -> None:
+    counts: Dict[Tuple[str, str, str, str], int] = {}
+    for f in findings:
+        counts[f.fingerprint] = counts.get(f.fingerprint, 0) + 1
+    entries = []
+    for fp in sorted(counts):
+        rule, path_, symbol, detail = fp
+        entries.append({
+            "rule": rule, "path": path_, "symbol": symbol,
+            "detail": detail, "count": counts[fp],
+            "reason": (reasons or {}).get(fp, "TRIAGE: justify or fix"),
+        })
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2,
+                  sort_keys=False)
+        fh.write("\n")

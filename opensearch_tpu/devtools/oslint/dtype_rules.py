@@ -1,0 +1,259 @@
+"""OSL1xx — dtype discipline for score/count planes.
+
+The fastpath's correctness proofs (pruned-serve certificates, tie
+witnesses) hold in ONE float domain: scores served to users are float32,
+so every comparison against a served score/theta must happen after the
+same float32 rounding `_exact_rescore` applies. Mixing a float64
+intermediate into such a comparison reintroduces the exact bug class of
+ADVICE round-5 `search/fastpath.py:823` (a contribution half an ulp below
+theta in f64 rounds UP to theta in f32 — the tie witness is skipped).
+
+Rules:
+- OSL101: comparison mixing a definite-float32 value (np.float32(...),
+  x.astype(np.float32), f32-dtype constructors) with a float64-tainted
+  expression (float(...) / np.float64 / .astype(float64) and arithmetic
+  derived from them). Cast to float32 first.
+- OSL102: integer count derived by rounding a float plane —
+  `int(round(x))` — where the host loop / pair-metrics program counts on
+  an int32 plane. f32 sums stop counting exactly at 2^24 docs
+  (ADVICE round-5 `parallel/service.py:1491`).
+
+Scope: `search/`, `ops/`, `parallel/` — the modules where score and count
+planes live.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+# inference domains
+F32 = "f32"
+F64 = "f64"
+INT = "int"
+NEUTRAL = "neutral"    # python literals: promote to nothing
+UNKNOWN = "unknown"
+
+_F32_NAMES = {"float32"}
+_F64_NAMES = {"float64", "double"}
+
+
+def _dtype_domain(node: ast.AST) -> Optional[str]:
+    """Domain named by a dtype expression: np.float32 / 'float32' / float /
+    jnp.float32 — or None if unrecognized."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value in _F32_NAMES:
+            return F32
+        if node.value in _F64_NAMES:
+            return F64
+        return None
+    d = _dotted(node)
+    leaf = d.rsplit(".", 1)[-1]
+    if leaf in _F32_NAMES:
+        return F32
+    if leaf in _F64_NAMES or d == "float":
+        return F64
+    if leaf in ("int32", "int64", "bool_", "bool"):
+        return INT
+    return None
+
+
+_ALLOC_FNS = {"zeros", "ones", "full", "empty", "asarray", "array",
+              "zeros_like", "ones_like", "full_like", "arange", "linspace"}
+_PROPAGATE_FNS = {"max", "min", "abs", "sum", "round"}
+
+
+class _FnScanner:
+    """Forward-pass domain inference over one function body (order of
+    appearance; control flow joins are ignored — later writes win, which
+    is the conservative choice for this rule's definite-only matching)."""
+
+    def __init__(self, checker: "DtypeDisciplineChecker", path: str,
+                 symbol: str, findings: List[Finding]):
+        self.env: Dict[str, str] = {}
+        self.checker = checker
+        self.path = path
+        self.symbol = symbol
+        self.findings = findings
+
+    # ---- expression classification ----
+
+    def classify(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, bool):
+                return INT
+            if isinstance(node.value, int):
+                return INT
+            if isinstance(node.value, float):
+                return NEUTRAL
+            return UNKNOWN
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, UNKNOWN)
+        if isinstance(node, ast.Subscript):
+            # element of an f32 array is f32; of an unknown, unknown
+            return self.classify(node.value)
+        if isinstance(node, ast.UnaryOp):
+            return self.classify(node.operand)
+        if isinstance(node, ast.BinOp):
+            return self._combine(self.classify(node.left),
+                                 self.classify(node.right))
+        if isinstance(node, ast.IfExp):
+            a, b = self.classify(node.body), self.classify(node.orelse)
+            if F64 in (a, b):
+                return F64
+            return a if a == b else UNKNOWN
+        if isinstance(node, ast.Call):
+            return self._classify_call(node)
+        return UNKNOWN
+
+    @staticmethod
+    def _combine(a: str, b: str) -> str:
+        if F64 in (a, b):
+            return F64
+        if UNKNOWN in (a, b):
+            return UNKNOWN
+        if F32 in (a, b):
+            return F32          # f32 op {f32, int, literal} stays f32
+        if a == b:
+            return a
+        return UNKNOWN
+
+    def _classify_call(self, node: ast.Call) -> str:
+        d = _dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1]
+        # direct casts: np.float32(x), float(x), np.float64(x)
+        dom = _dtype_domain(node.func)
+        if dom is not None:
+            return dom
+        # x.astype(dtype)
+        if isinstance(node.func, ast.Attribute) and leaf == "astype" \
+                and node.args:
+            dt = _dtype_domain(node.args[0])
+            return dt if dt is not None else UNKNOWN
+        # int(x) / round(x) -> int plane
+        if d == "int":
+            return INT
+        # allocators with a dtype argument
+        if leaf in _ALLOC_FNS:
+            dt_node = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt_node = kw.value
+            if dt_node is None and len(node.args) >= 2:
+                dt_node = node.args[-1]
+            if dt_node is not None:
+                dt = _dtype_domain(dt_node)
+                if dt is not None:
+                    return dt
+            return UNKNOWN
+        # max/min/abs/...: propagate the strongest operand domain
+        if d in _PROPAGATE_FNS:
+            doms = [self.classify(a) for a in node.args]
+            if F64 in doms:
+                return F64
+            if all(x == INT for x in doms) and doms:
+                return INT
+            if F32 in doms and UNKNOWN not in doms:
+                return F32
+            return UNKNOWN
+        return UNKNOWN
+
+    # ---- statement walk ----
+
+    def scan_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._scan_stmt(stmt)
+
+    @staticmethod
+    def _walk_same_scope(stmt: ast.stmt):
+        """ast.walk that does NOT descend into nested defs/lambdas (those
+        get their own scanner and environment)."""
+        yield stmt
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            yield from _FnScanner._walk_same_scope(child)
+
+    def _scan_stmt(self, stmt: ast.stmt) -> None:
+        # nested defs are scanned separately by the checker
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        for node in self._walk_same_scope(stmt):
+            if isinstance(node, ast.Assign):
+                dom = self.classify(node.value)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.env[t.id] = dom
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    self.env[node.target.id] = self.classify(node.value)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    self.env[node.target.id] = self._combine(
+                        self.env.get(node.target.id, UNKNOWN),
+                        self.classify(node.value))
+            elif isinstance(node, ast.Compare):
+                self._check_compare(node)
+            elif isinstance(node, ast.Call):
+                self._check_int_round(node)
+
+    def _check_compare(self, node: ast.Compare) -> None:
+        if any(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+               for op in node.ops):
+            return
+        doms = [self.classify(e) for e in [node.left] + node.comparators]
+        if F32 in doms and F64 in doms:
+            self.findings.append(Finding(
+                "OSL101", self.path, node.lineno, node.col_offset,
+                self.symbol,
+                "comparison mixes float32 and float64 score domains; "
+                "cast the f64 intermediate with .astype(np.float32) so "
+                "the compare runs in the served f32 domain",
+                detail=f"cmp@{self.symbol or 'module'}"))
+
+    def _check_int_round(self, node: ast.Call) -> None:
+        # int(round(x)) — float-plane count laundering
+        if _dotted(node.func) != "int" or len(node.args) != 1:
+            return
+        inner = node.args[0]
+        while isinstance(inner, ast.Call) and _dotted(inner.func) == "float" \
+                and len(inner.args) == 1:
+            inner = inner.args[0]
+        if isinstance(inner, ast.Call) and _dotted(inner.func) == "round":
+            if inner.args and self.classify(inner.args[0]) == INT:
+                return
+            self.findings.append(Finding(
+                "OSL102", self.path, node.lineno, node.col_offset,
+                self.symbol,
+                "integer count derived by rounding a float plane; count "
+                "on an int32 plane (f32 sums stop counting exactly at "
+                "2^24 docs)",
+                detail=f"intround@{self.symbol or 'module'}"))
+
+
+class DtypeDisciplineChecker(Checker):
+    rules = ("OSL101", "OSL102")
+    name = "dtype-discipline"
+
+    SCOPES = ("search/", "ops/", "parallel/")
+
+    def applies(self, path: str) -> bool:
+        return any(s in path for s in self.SCOPES)
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        # module level + each function get an independent environment
+        mod_scan = _FnScanner(self, path, "", findings)
+        mod_scan.scan_body([s for s in tree.body])
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _FnScanner(self, path, qmap.get(node, node.name),
+                                  findings)
+                scan.scan_body(node.body)
+        return findings

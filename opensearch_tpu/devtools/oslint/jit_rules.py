@@ -1,0 +1,251 @@
+"""OSL2xx — jit/trace boundary discipline.
+
+Code that runs under `jax.jit`/`pjit`/`shard_map`/`vmap`/Pallas is TRACED:
+its Python executes once with abstract values. Three failure modes this
+repo must never reintroduce:
+
+- OSL201: Python-level branching (`if`/`while`/conditional expressions) on
+  a traced value — raises ConcretizationTypeError at runtime, or worse,
+  silently bakes one branch into the compiled program.
+- OSL202: host syncs — `float(x)`, `int(x)`, `bool(x)`, `np.asarray(x)`,
+  `x.item()`, `x.tolist()` on traced values force a device->host transfer
+  (and fail under jit).
+- OSL203: nondeterminism — `time.*`, `random.*`, `np.random.*` inside a
+  traced function executes at TRACE time only, so the compiled program
+  freezes one sample forever (and replicas diverge across processes).
+
+Traced contexts are found structurally: functions decorated with
+jit/pjit (incl. `partial(jax.jit, ...)`), functions passed by name to
+jit/pjit/vmap/pmap/shard_map/pallas_call/scan/cond/while_loop/fori_loop/
+checkpoint/remat/grad, and every def nested inside one. `static_argnames`
+params are exempt from taint. Shape/dtype/ndim reads, `len()`,
+`isinstance()` and `is None` checks are trace-time-static and never
+tainted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_TRACING_FNS = {"jit", "pjit", "vmap", "pmap", "shard_map", "pallas_call",
+                "scan", "cond", "while_loop", "fori_loop", "switch",
+                "checkpoint", "remat", "grad", "value_and_grad",
+                "custom_vjp", "custom_jvp"}
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+                 "weak_type", "itemsize", "nbytes"}
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                 "callable", "id", "repr", "str"}
+_HOST_SYNC_CASTS = {"float", "int", "bool", "complex"}
+_HOST_SYNC_NP = {"asarray", "array", "copy"}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NONDET_ROOTS = {"time", "random", "datetime"}
+
+
+def _leaf(node: ast.AST) -> str:
+    d = _dotted(node)
+    return d.rsplit(".", 1)[-1] if d else ""
+
+
+def _static_argnames(dec: ast.Call) -> Set[str]:
+    """Literal static_argnames from a jit(...) / partial(jax.jit, ...)
+    decorator call — best-effort, unknown forms yield the empty set."""
+    out: Set[str] = set()
+    for kw in dec.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                  str):
+                        out.add(e.value)
+    return out
+
+
+def _is_tracing_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @jax.jit(static_argnames=...)
+        if _leaf(dec.func) == "partial" and dec.args:
+            return _leaf(dec.args[0]) in ("jit", "pjit")
+        return _leaf(dec.func) in ("jit", "pjit")
+    return _leaf(dec) in ("jit", "pjit")
+
+
+def _decorator_static_names(dec: ast.AST) -> Set[str]:
+    if isinstance(dec, ast.Call):
+        return _static_argnames(dec)
+    return set()
+
+
+class JitBoundaryChecker(Checker):
+    rules = ("OSL201", "OSL202", "OSL203")
+    name = "jit-boundary"
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+
+        # pass 1: names passed into tracing transforms anywhere in the file
+        traced_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    _leaf(node.func) in _TRACING_FNS and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    traced_names.add(first.id)
+
+        # pass 2: find traced FunctionDefs (decorated, or named above),
+        # then lint each (nested defs inherit traced-ness)
+        def visit(node: ast.AST, traced: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    is_traced = traced \
+                        or child.name in traced_names \
+                        or any(_is_tracing_decorator(d)
+                               for d in child.decorator_list)
+                    if is_traced and not traced:
+                        static = set()
+                        for d in child.decorator_list:
+                            static |= _decorator_static_names(d)
+                        self._lint_traced(child, qmap, path, findings,
+                                          static)
+                    visit(child, is_traced)
+                else:
+                    visit(child, traced)
+
+        visit(tree, False)
+        return findings
+
+    # ---- taint over one traced function (incl. nested defs) ----
+
+    def _lint_traced(self, fn: ast.FunctionDef, qmap, path: str,
+                     findings: List[Finding],
+                     static_names: Set[str]) -> None:
+        tainted: Set[str] = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.arg not in static_names and a.arg != "self":
+                tainted.add(a.arg)
+
+        def taint(e: ast.AST) -> bool:
+            if isinstance(e, ast.Name):
+                return e.id in tainted
+            if isinstance(e, ast.Attribute):
+                if e.attr in _STATIC_ATTRS:
+                    return False
+                return taint(e.value)
+            if isinstance(e, ast.Call):
+                if _dotted(e.func) in _STATIC_CALLS:
+                    return False
+                return (taint(e.func) or any(taint(a) for a in e.args)
+                        or any(taint(k.value) for k in e.keywords))
+            if isinstance(e, ast.Compare):
+                if all(isinstance(op, (ast.Is, ast.IsNot))
+                       for op in e.ops):
+                    return False
+                return taint(e.left) or any(taint(c)
+                                            for c in e.comparators)
+            if isinstance(e, ast.Constant):
+                return False
+            return any(taint(c) for c in ast.iter_child_nodes(e))
+
+        def handle_nested_def(node: ast.FunctionDef) -> None:
+            # a def inside a traced fn runs traced with the closure's
+            # taint; its own params are traced values too (vmap/scan
+            # bodies)
+            for a in (node.args.posonlyargs + node.args.args
+                      + node.args.kwonlyargs):
+                tainted.add(a.arg)
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                handle_nested_def(node)
+
+        sym = qmap.get(fn, fn.name)
+        # taint pass FIRST, to a fixpoint: ast.walk is breadth-first, so a
+        # single interleaved pass would visit `if y > 0:` before the
+        # deeper-nested `y = x * 2` that taints it
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) and taint(node.value):
+                    tgts = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                        and node.value is not None and taint(node.value):
+                    tgts = [node.target]
+                elif isinstance(node, ast.For) and taint(node.iter):
+                    tgts = [node.target]
+                else:
+                    continue
+                for t in tgts:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                if taint(node.test):
+                    findings.append(Finding(
+                        "OSL201", path, node.lineno, node.col_offset, sym,
+                        "Python-level branch on a traced value inside a "
+                        "jit/traced function; use jnp.where / lax.cond",
+                        detail=f"branch@{sym}"))
+            elif isinstance(node, ast.IfExp):
+                if taint(node.test):
+                    findings.append(Finding(
+                        "OSL201", path, node.lineno, node.col_offset, sym,
+                        "conditional expression on a traced value inside "
+                        "a jit/traced function; use jnp.where",
+                        detail=f"ifexp@{sym}"))
+            elif isinstance(node, ast.Call):
+                self._check_call(node, path, sym, findings, taint)
+
+    def _check_call(self, node: ast.Call, path: str, sym: str,
+                    findings: List[Finding], taint) -> None:
+        d = _dotted(node.func)
+        leaf = d.rsplit(".", 1)[-1]
+        root = d.split(".", 1)[0] if d else ""
+        # OSL203 nondeterminism — flagged regardless of taint
+        if root in _NONDET_ROOTS or d.startswith(("np.random.",
+                                                  "numpy.random.")):
+            findings.append(Finding(
+                "OSL203", path, node.lineno, node.col_offset, sym,
+                f"nondeterministic call `{d}` inside a traced function "
+                "executes at trace time only (frozen into the compiled "
+                "program); thread jax PRNG keys / timestamps in as "
+                "arguments",
+                detail=f"nondet:{d}@{sym}"))
+            return
+        # OSL202 host syncs on traced values
+        arg_tainted = any(taint(a) for a in node.args)
+        if d in _HOST_SYNC_CASTS and arg_tainted:
+            findings.append(Finding(
+                "OSL202", path, node.lineno, node.col_offset, sym,
+                f"`{d}()` on a traced value forces a host sync and fails "
+                "under jit; keep the value on-device",
+                detail=f"sync:{d}@{sym}"))
+        elif leaf in _HOST_SYNC_NP and root in ("np", "numpy") \
+                and arg_tainted:
+            findings.append(Finding(
+                "OSL202", path, node.lineno, node.col_offset, sym,
+                f"`{d}()` materializes a traced value on the host; use "
+                "jnp equivalents inside traced code",
+                detail=f"sync:{d}@{sym}"))
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _HOST_SYNC_METHODS \
+                and taint(node.func.value):
+            findings.append(Finding(
+                "OSL202", path, node.lineno, node.col_offset, sym,
+                f"`.{node.func.attr}()` on a traced value is a host "
+                "sync; not allowed inside traced code",
+                detail=f"sync:{node.func.attr}@{sym}"))

@@ -1,0 +1,162 @@
+"""OSL4xx — lock discipline for threaded modules.
+
+The cluster/rest/ingest layers and the fastpath's shared caches are hit
+from request threads concurrently. Two invariants, both checked
+structurally per module:
+
+- OSL401: an instance attribute mutated BOTH under a `with <lock>:` block
+  and outside any lock (in a non-__init__ method) — the unlocked write
+  races the locked readers. Either take the lock or document why the
+  write is safe (`# oslint: disable=OSL401 -- <why>`).
+- OSL402: inconsistent lock-acquisition order — lock B taken while
+  holding A in one place, and A taken while holding B in another. That
+  is the textbook deadlock shape; pick one order.
+
+Locks are recognized as (a) names/attributes assigned from
+`threading.Lock()/RLock()/Condition()` anywhere in the module, or (b) any
+`with` target whose dotted name contains "lock"/"cond"/"mutex".
+Explicit .acquire()/.release() pairs are NOT modeled — prefer `with`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__", "__reduce__",
+                   "__getstate__", "__setstate__"}
+
+
+def _looks_like_lock(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in ("lock", "cond", "mutex"))
+
+
+class LockDisciplineChecker(Checker):
+    rules = ("OSL401", "OSL402")
+    name = "lock-discipline"
+
+    SCOPES = ("cluster/", "rest/", "ingest/")
+    EXTRA_FILES = ("search/fastpath.py",)
+
+    def applies(self, path: str) -> bool:
+        return any(s in path for s in self.SCOPES) \
+            or any(path.endswith(e) for e in self.EXTRA_FILES)
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+
+        # module-wide lock identities: textual dotted names assigned from
+        # threading constructors
+        declared_locks: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                if _dotted(node.value.func).rsplit(".", 1)[-1] in \
+                        _LOCK_CTORS:
+                    for t in node.targets:
+                        d = _dotted(t)
+                        if d:
+                            declared_locks.add(d)
+        if not declared_locks and "threading" not in src:
+            return findings
+
+        def is_lock_expr(e: ast.AST) -> str:
+            """Dotted lock key of a with-item, or ''."""
+            d = _dotted(e)
+            if not d:
+                return ""
+            if d in declared_locks or _looks_like_lock(d):
+                return d
+            return ""
+
+        # per-class mutation ledger: attr -> [(locked?, node, symbol)]
+        # lock-order ledger: ordered pair -> first site
+        order_sites: Dict[Tuple[str, str], Tuple[ast.AST, str]] = {}
+
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            mutations: Dict[str, List[Tuple[bool, ast.AST, str]]] = {}
+
+            for method in [n for n in cls.body
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]:
+                sym = qmap.get(method, method.name)
+                exempt = method.name in _EXEMPT_METHODS
+
+                def walk(node: ast.AST, held: Tuple[str, ...]) -> None:
+                    if isinstance(node, (ast.With, ast.AsyncWith)):
+                        locks = [k for k in
+                                 (is_lock_expr(it.context_expr)
+                                  for it in node.items) if k]
+                        new_held = held
+                        for lk in locks:
+                            for outer in new_held:
+                                if outer != lk:
+                                    key = (outer, lk)
+                                    order_sites.setdefault(
+                                        key, (node, sym))
+                            new_held = new_held + (lk,)
+                        for child in node.body:
+                            walk(child, new_held)
+                        return
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) and \
+                            node is not method:
+                        return      # nested defs: separate discipline
+                    if not exempt and isinstance(node, (ast.Assign,
+                                                        ast.AugAssign)):
+                        targets = (node.targets
+                                   if isinstance(node, ast.Assign)
+                                   else [node.target])
+                        for t in targets:
+                            attr = self._self_attr(t)
+                            if attr and not _looks_like_lock(attr):
+                                mutations.setdefault(attr, []).append(
+                                    (bool(held), node, sym))
+                    for child in ast.iter_child_nodes(node):
+                        walk(child, held)
+
+                for stmt in method.body:
+                    walk(stmt, ())
+
+            for attr, sites in mutations.items():
+                locked = [s for s in sites if s[0]]
+                unlocked = [s for s in sites if not s[0]]
+                if locked and unlocked:
+                    for _, node, sym in unlocked:
+                        findings.append(Finding(
+                            "OSL401", path, node.lineno, node.col_offset,
+                            sym,
+                            f"attribute `self.{attr}` is written under a "
+                            "lock elsewhere in this class but mutated "
+                            "here without one; take the lock or justify",
+                            detail=f"attr:{attr}"))
+
+        for (a, b), (node, sym) in sorted(order_sites.items()):
+            if (b, a) in order_sites and a < b:
+                other = order_sites[(b, a)]
+                findings.append(Finding(
+                    "OSL402", path, node.lineno, node.col_offset, sym,
+                    f"lock order inversion: `{a}` -> `{b}` here but "
+                    f"`{b}` -> `{a}` in {other[1]} — pick one global "
+                    "order to avoid deadlock",
+                    detail=f"order:{a}~{b}"))
+        return findings
+
+    @staticmethod
+    def _self_attr(target: ast.AST) -> str:
+        """'x' for `self.x = ...` or `self.x[k] = ...`; '' otherwise."""
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            return target.attr
+        return ""

@@ -1250,7 +1250,8 @@ class MeshSearchService:
         # `filters` agg: one metric-program count per named clause mask
         # (col == pres == the mask, so m[0] counts matched docs in it)
         fagg_results = {}
-        fsub_results = {}     # (combo, metric field) -> [QB, 5]
+        fsub_results = {}     # (combo, metric field) ->
+        #                       (i32[QB] counts, f32[QB, 4] moments)
         for it in items:
             for an in it[5]:
                 if an.kind not in ("filters", "adjacency_matrix",
@@ -1485,18 +1486,18 @@ class MeshSearchService:
                     _fn, combo, _m = an._mesh_filters[0]
                     subs = {}
                     for sub in an.subs:
-                        m = fsub_results[(combo, sub.body["field"])][bi]
-                        subs[sub.name] = _stat_partial(m[0], m[1:5])
+                        sc, sm4 = fsub_results[(combo, sub.body["field"])]
+                        subs[sub.name] = _stat_partial(sc[bi], sm4[bi])
+                    # doc_count rides the program's int32 count plane:
+                    # exact past the 2^24 f32 ceiling, no rounding
                     results[0].agg_partials[an.name] = [{
-                        "doc_count": int(round(float(
-                            fagg_results[combo][bi][0]))),
+                        "doc_count": int(fagg_results[combo][0][bi]),
                         "subs": subs}]
                     continue
                 if an.kind in ("filters", "adjacency_matrix"):
                     buckets = {
                         fname: {"doc_count":
-                                int(round(float(
-                                    fagg_results[combo][bi][0]))),
+                                int(fagg_results[combo][0][bi]),
                                 "subs": {}}
                         for fname, combo, _m in an._mesh_filters}
                     results[0].agg_partials[an.name] = [{"buckets":
@@ -1548,9 +1549,9 @@ class MeshSearchService:
                             "count": float(g[0]), "slat": float(g[5]),
                             "slon": float(g[6])}]
                     continue
-                m = metrics_by_field[an.body["field"]][bi]
+                mc, m4 = metrics_by_field[an.body["field"]]
                 results[0].agg_partials[an.name] = [
-                    _stat_partial(m[0], m[1:5])]
+                    _stat_partial(mc[bi], m4[bi])]
 
         self._emit_mesh_results(name, bodies, out, shard_segs, stats,
                                 searchers, stacked, items, gdocs_b,

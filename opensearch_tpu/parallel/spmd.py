@@ -40,6 +40,19 @@ from ..index.segment import Segment, next_pow2
 INT32_SENTINEL = np.int32(2**31 - 1)
 
 
+def _shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+    """`jax.shard_map` across jax versions: older releases only ship
+    `jax.experimental.shard_map` whose replication check is spelled
+    `check_rep` instead of `check_vma`."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as esm
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def make_mesh(n_replica: int = 1, n_shard: Optional[int] = None,
               devices: Optional[list] = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
@@ -345,7 +358,7 @@ def build_distributed_search(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         gdocs = all_gids.reshape(all_gids.shape[0], S * kk)
         return gdocs, gvals, totals
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
 
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
@@ -379,7 +392,10 @@ def build_distributed_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
     InternalAggregation#reduce. Returns a callable:
         (tree, rows [S,QB,T], boosts [QB,T], msm [QB], cscore [QB],
          col [S,D_pad], present [S,D_pad] [, fmask [S,D_pad]]) ->
-        f32[QB, 5] = (count, sum, min, max, sumsq), already global."""
+        (i32[QB] counts, f32[QB, 4] = (sum, min, max, sumsq)),
+        already global. The count plane is int32 (same rule as the
+        terms/pair programs): f32 sums stop counting exactly at 2^24
+        matching docs, and filters/adjacency doc_counts ride this plane."""
 
     def per_device(tree, rows, boosts, msm, cscore, col, present,
                    fmask=None):
@@ -400,25 +416,24 @@ def build_distributed_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
                                       m, cs, n_global, dfg, avgdl, bucket,
                                       ndocs_pad, k1, b, fm)
             ok = (scores > -jnp.inf) & (pres > 0)
-            okf = ok.astype(jnp.float32)
-            cnt = jnp.sum(okf)
+            cnt = jnp.sum(ok.astype(jnp.int32))
             s = jnp.sum(jnp.where(ok, colv, 0.0))
             ssq = jnp.sum(jnp.where(ok, colv * colv, 0.0))
             mn = jnp.min(jnp.where(ok, colv, jnp.inf))
             mx = jnp.max(jnp.where(ok, colv, -jnp.inf))
-            return jnp.stack([cnt, s, mn, mx, ssq])
+            return cnt, jnp.stack([s, mn, mx, ssq])
 
-        part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)  # [QB,5]
-        out = jnp.stack([
-            jax.lax.psum(part[:, 0], "shard"),
-            jax.lax.psum(part[:, 1], "shard"),
-            jax.lax.pmin(part[:, 2], "shard"),
-            jax.lax.pmax(part[:, 3], "shard"),
-            jax.lax.psum(part[:, 4], "shard"),
-        ], axis=1)
-        return out
+        cnts, part = jax.vmap(one)(rows, boosts, msm, cscore,
+                                   df_global)  # i32[QB], f32[QB,4]
+        return (jax.lax.psum(cnts, "shard"),
+                jnp.stack([
+                    jax.lax.psum(part[:, 0], "shard"),
+                    jax.lax.pmin(part[:, 1], "shard"),
+                    jax.lax.pmax(part[:, 2], "shard"),
+                    jax.lax.psum(part[:, 3], "shard"),
+                ], axis=1))
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -477,7 +492,7 @@ def build_distributed_terms_agg(mesh: Mesh, bucket: int, ndocs_pad: int,
         part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)  # [QB,V]
         return jax.lax.psum(part, "shard")
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -527,7 +542,7 @@ def build_distributed_bincount(mesh: Mesh, bucket: int, ndocs_pad: int,
         part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
         return jax.lax.psum(part, "shard")
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -609,7 +624,7 @@ def build_distributed_pair_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
                     jax.lax.psum(part[:, :, 3], "shard"),
                 ], axis=2))
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -676,7 +691,7 @@ def build_distributed_range_metrics(mesh: Mesh, bucket: int, ndocs_pad: int,
                     jax.lax.psum(part[:, :, 3], "shard"),
                 ], axis=2))
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -759,7 +774,7 @@ def build_distributed_cardinality(mesh: Mesh, bucket: int, ndocs_pad: int,
         part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
         return jax.lax.pmax(part, "shard")
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -811,7 +826,7 @@ def build_distributed_ddsketch(mesh: Mesh, bucket: int, ndocs_pad: int,
         part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
         return jax.lax.psum(part, "shard")
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -862,7 +877,7 @@ def build_distributed_weighted_avg(mesh: Mesh, bucket: int, ndocs_pad: int,
         part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
         return jax.lax.psum(part, "shard")
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -929,7 +944,7 @@ def build_distributed_geo_stat(mesh: Mesh, bucket: int, ndocs_pad: int,
             jax.lax.psum(part[:, 6], "shard"),
         ], axis=1)
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -981,7 +996,7 @@ def build_distributed_range_counts(mesh: Mesh, bucket: int, ndocs_pad: int,
         part = jax.vmap(one)(rows, boosts, msm, cscore, df_global)
         return jax.lax.psum(part, "shard")
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -1143,7 +1158,7 @@ def build_distributed_phrase(mesh: Mesh, bucket: int, ndocs_pad: int,
         return (all_gids.reshape(all_gids.shape[0], S * kk),
                 all_vals.reshape(all_vals.shape[0], S * kk), totals)
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
     tree_spec = {k_: P("shard") for k_ in
                  ("starts", "doc_ids", "tfs", "dl", "live", "doc_base",
                   "doc_count", "sum_dl", "field_dc")}
@@ -1187,7 +1202,7 @@ def build_term_sharded_score(mesh: Mesh, bucket: int, ndocs_pad: int, k: int,
         vals, idx = jax.lax.top_k(masked, min(k, ndocs_pad))
         return vals, idx
 
-    shard_map = jax.shard_map
+    shard_map = _shard_map
 
     fn = shard_map(per_device, mesh=mesh,
                    in_specs=(P("shard"), P("shard"), P("shard"),

@@ -4964,15 +4964,15 @@ def _build_executor(full_spec):
         aggs = {}
         for name, aspec in agg_specs:
             res = emit_agg(aspec, seg_arrays, params, match_f, sm.scores)
-            if res:
+            if res:  # oslint: disable=OSL201 -- host dict truthiness, trace-static
                 aggs[name] = res
-        if aggs:
+        if aggs:  # oslint: disable=OSL201 -- host dict truthiness, trace-static
             out["aggs"] = aggs
         named = {}
         for nm, nspec in named_specs:
             nsm = emit(nspec, seg_arrays, params)
             named[nm] = nsm.matched[idx]
-        if named:
+        if named:  # oslint: disable=OSL201 -- host dict truthiness, trace-static
             out["named"] = named
         return out
 

@@ -820,7 +820,13 @@ def _tie_serves(al: AlignedPostings, vq: _VQuery, theta: float,
     # points count as attainers needing the id witness
     avg = max(float(vq.avgdl), 1e-9)
     kfac = vq.k1 * (1.0 - vq.b_eff + vq.b_eff * dlv / avg)
-    contrib = vq.weights[0] * tfv / (tfv + kfac)
+    # the final f32 cast PINS the compare to `_exact_rescore`'s per-term
+    # rounding whatever dtype the frontier carries: an f64 contribution
+    # half an ulp below theta would silently promote the whole compare to
+    # f64 (NEP50) and miss a tie that exists in the served f32 domain.
+    # `_frontier` emits f32 today, so this is an enforced invariant, not a
+    # live-bug fix — see TestTieServesF32Domain
+    contrib = (vq.weights[0] * tfv / (tfv + kfac)).astype(np.float32)
     theta32 = np.float32(theta)
     if np.any(contrib > theta32):
         return False                      # genuinely above: real displacer
@@ -832,7 +838,7 @@ def _tie_serves(al: AlignedPostings, vq: _VQuery, theta: float,
     # otherwise fall back to the whole-tf-class min id (always sound)
     kfac2 = vq.k1 * (1.0 - vq.b_eff
                      + vq.b_eff * (dlv + np.float32(1.0)) / avg)
-    contrib2 = vq.weights[0] * tfv / (tfv + kfac2)
+    contrib2 = (vq.weights[0] * tfv / (tfv + kfac2)).astype(np.float32)
     ids = np.where(contrib2 < contrib, id_dlmin, id_any)
     return int(ids[att].min()) > int(cand[order[window - 1]])
 
@@ -1025,16 +1031,22 @@ def _quality_tier(seg: Segment, field: str):
         docmax = np.zeros(seg.ndocs, np.float32)
         np.maximum.at(docmax, pb.doc_ids, imp)
         target = max(seg.ndocs // QUALITY_SHARE, QUALITY_MIN_NDOCS // 4)
-        tau = float(np.partition(docmax, seg.ndocs - target)
-                    [seg.ndocs - target])
+        tau = np.float32(np.partition(docmax, seg.ndocs - target)
+                         [seg.ndocs - target])
         mask = docmax >= tau
         # impact ties at tau can inflate the kept set far past the
         # target, inverting the rung's cost model — decline rather than
         # launch a near-dense-sized view
         if 0 < mask.sum() <= 2 * target:
             host_docs = np.flatnonzero(mask).astype(np.int32)
-            fl = FilterList(host_docs, None, len(host_docs), 0, mask,
+            nbytes = mask.nbytes + host_docs.nbytes
+            fl = FilterList(host_docs, None, len(host_docs), nbytes, mask,
                             ("_quality", field, QUALITY_SHARE))
+            if _breaker is not None:
+                import weakref
+                _breaker.add_estimate(
+                    nbytes, f"fastpath-quality[{seg.name}][{field}]")
+                weakref.finalize(fl, _breaker.release, nbytes)
             frontiers: dict = {}
 
             def frontier_of(row: int, _f=frontiers, _pb=pb, _dl=dl,

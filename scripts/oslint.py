@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""oslint runner — AST-based host/device discipline linter.
+
+Usage:
+    python scripts/oslint.py                 # report NEW findings
+    python scripts/oslint.py --check        # exit 1 on new findings (CI)
+    python scripts/oslint.py --all          # include baselined findings
+    python scripts/oslint.py --write-baseline   # triage current findings
+    python scripts/oslint.py path/to/file.py    # lint a subset
+
+Findings already triaged in oslint_baseline.json (with a justification
+per entry) do not fail --check; stale baseline entries (debt that was
+paid) are reported so the file shrinks over time. See
+docs/STATIC_ANALYSIS.md.
+"""
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from opensearch_tpu.devtools.oslint import (load_baseline, run_paths,
+                                            write_baseline)
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "oslint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=["opensearch_tpu"],
+                    help="files/dirs to lint (default: opensearch_tpu)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON path")
+    ap.add_argument("--check", action="store_true",
+                    help="exit nonzero on findings not in the baseline")
+    ap.add_argument("--all", action="store_true",
+                    help="show baselined findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write ALL current findings to the baseline "
+                         "(then edit in per-entry justifications)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or ["opensearch_tpu"]
+    findings = run_paths(paths, REPO_ROOT)
+
+    if args.write_baseline:
+        write_baseline(findings, args.baseline)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    baseline = load_baseline(args.baseline)
+    new = baseline.new_findings(findings)
+    shown = findings if args.all else new
+
+    for f in shown:
+        tag = "" if f in new else "  [baselined]"
+        print(f.render() + tag)
+
+    # stale entries only meaningful on a full-default run
+    if paths == ["opensearch_tpu"]:
+        stale = baseline.stale_entries(findings)
+        for e in stale:
+            print(f"stale baseline entry (debt paid — shrink its count or "
+                  f"remove it): {e['rule']} {e['path']} "
+                  f"[{e.get('symbol', '')}] {e.get('detail', '')} "
+                  f"count={e.get('count', 1)}")
+
+    n_base = len(findings) - len(new)
+    print(f"oslint: {len(new)} new finding(s), {n_base} baselined, "
+          f"{len(findings)} total")
+    if args.check and new:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

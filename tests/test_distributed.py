@@ -1346,3 +1346,39 @@ class TestFullyDeletedSegmentStats:
                 for h in rm["hits"]["hits"]] == \
             [(h["_id"], round(h["_score"], 5))
              for h in rh["hits"]["hits"]]
+
+
+def test_metrics_program_counts_on_int32_plane(corpus):
+    """ADVICE r5 `service.py:1491`: the mesh metric program's count plane is
+    int32 (psum of i32 ones) — doc_counts come off it exactly, never via an
+    f32 sum rounded back to int (f32 stops counting exactly at 2^24)."""
+    from opensearch_tpu.parallel.spmd import build_distributed_metrics
+
+    docs, segs = corpus
+    mesh = make_mesh(n_replica=1, n_shard=4)
+    stacked = StackedShardIndex.build(segs, "body", mesh)
+    QB, T = 4, 4
+    queries = [["alpha"], ["beta", "gamma"], ["zeta"], ["kappa", "iota"]]
+    rows, boosts, msm = pack_query_batch(segs, "body", queries, QB, T, mesh)
+    cscore = np.zeros(QB, np.float32)
+    S, D = len(segs), stacked.ndocs_pad
+    # numeric column: value of each doc = its integer doc id (known moments)
+    col = np.zeros((S, D), np.float32)
+    pres = np.zeros((S, D), np.float32)
+    for si, s in enumerate(segs):
+        for li in range(s.ndocs):
+            col[si, li] = float(s.ids[li])
+            pres[si, li] = 1.0
+    fn = build_distributed_metrics(mesh, bucket=512, ndocs_pad=D)
+    cnts, m4 = fn(stacked.tree(), rows, boosts, msm, cscore, col, pres)
+    cnts, m4 = np.asarray(cnts), np.asarray(m4)
+    assert cnts.dtype == np.int32
+    assert m4.shape == (QB, 4)
+    for qi, qterms in enumerate(queries):
+        matched = [float(did) for did, txt in docs.items()
+                   if any(t in txt.split() for t in qterms)]
+        assert int(cnts[qi]) == len(matched)     # exact integer count
+        vals = np.array(matched)
+        assert abs(m4[qi][0] - vals.sum()) <= 1e-3 * max(1.0, vals.sum())
+        assert m4[qi][1] == vals.min()
+        assert m4[qi][2] == vals.max()

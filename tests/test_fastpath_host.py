@@ -302,3 +302,98 @@ class TestClueWebScaleChunking:
         budget = fastpath.MAX_TL // 1
         need = -(-len(docs) // budget)
         assert len(plan) <= 2 * (1 << (need - 1).bit_length())
+
+
+class TestTieServesF32Domain:
+    """ADVICE r5 `fastpath.py:823`: `_tie_serves` must detect boundary ties
+    in the SERVED f32 domain. A frontier contribution half an ulp below
+    theta in f64 rounds UP to theta after `_exact_rescore`'s f32 cast — it
+    IS a tie, and its id witness must be checked before the pruned page is
+    served as exact.
+
+    NOTE `_frontier` emits f32 arrays today, so production inputs never hit
+    the f64 promotion; these tests feed f64 frontiers deliberately to pin
+    the INVARIANT (compares run in f32 no matter what dtype a future
+    frontier variant carries) rather than to reproduce a live bug."""
+
+    class _Al:
+        def __init__(self, fr):
+            self.rem_frontiers = fr
+
+    def _setup(self, witness_id, k1=1.2):
+        # find a tf whose f64 contribution tf/(tf+k1) rounds UP in f32
+        tf = next(t for t in range(1, 5000)
+                  if float(np.float32(t / (t + k1))) > t / (t + k1))
+        c64 = tf / (tf + k1)
+        theta = float(np.float32(c64))      # theta lives in the f32 domain
+        assert c64 < theta                  # ...but the f64 value sits below
+        # pre-fix counterfactual: the uncast f64 ARRAY compare (NEP50
+        # promotes f64 array vs f32 scalar to f64) sees NO tie at all
+        c64a = np.array([c64])
+        assert not np.any(c64a > np.float32(theta))
+        assert not np.any(c64a == np.float32(theta))
+        fr = (np.array([tf], np.float64), np.array([0.0], np.float64),
+              np.array([witness_id], np.int64),
+              np.array([witness_id], np.int64))
+        vq = fastpath._VQuery(rows=np.array([0]),
+                              weights=np.array([1.0], np.float32),
+                              k1=k1, b_eff=0.0, avgdl=10.0)
+        cand = np.array([10], np.int64)     # boundary member is doc 10
+        order = np.array([0], np.int64)
+        return self._Al({0: fr}), vq, theta, cand, order
+
+    def test_rounding_tie_with_smaller_id_escalates(self):
+        # witness doc 7 sorts before boundary doc 10 under (score desc,
+        # doc asc): the page is NOT provably exact -> False (pre-fix the
+        # f64 compare classified the doc as below theta and served)
+        al, vq, theta, cand, order = self._setup(witness_id=7)
+        assert fastpath._tie_serves(al, vq, theta, cand, order, 1) is False
+
+    def test_rounding_tie_with_larger_id_serves(self):
+        # same tie, but the min attaining id sorts after the boundary:
+        # the witness proves the served page exact
+        al, vq, theta, cand, order = self._setup(witness_id=20)
+        assert fastpath._tie_serves(al, vq, theta, cand, order, 1) is True
+
+
+class TestQualityTierBreaker:
+    """ADVICE r5 `fastpath.py:1009`: the `_quality_tier` FilterList's
+    ndocs-sized mask + host_docs bytes must be charged to the fastpath
+    breaker and released when the cached list is dropped."""
+
+    def test_charge_and_release_on_eviction(self, monkeypatch):
+        import gc
+
+        from opensearch_tpu.utils.breaker import CircuitBreaker
+
+        rng = np.random.default_rng(11)
+        m = Mappings({"properties": {"body": {"type": "text"}}})
+        eng = Engine(m)
+        for i in range(2048):
+            tf = int(rng.integers(1, 40))
+            pad = int(rng.integers(1, 40))
+            eng.index_doc(str(i), {"body": " ".join(
+                ["alpha"] * tf + [f"u{i}"] * pad)})
+        eng.refresh()
+        eng.force_merge(1)
+        seg = eng.segments[0]
+        # prewarm the aligned layout so its (separate) charge does not
+        # land on the test breaker
+        assert fastpath.get_aligned(seg, "body") is not None
+        monkeypatch.setattr(fastpath, "QUALITY_MIN_NDOCS", 256)
+        br = CircuitBreaker("test-fielddata", 1 << 30)
+        monkeypatch.setattr(fastpath, "_breaker", br)
+
+        qt = fastpath._quality_tier(seg, "body")
+        assert qt is not None
+        fl, _frontier_of = qt
+        nbytes = fl.mask.nbytes + fl.host_docs.nbytes
+        assert nbytes > 0
+        assert fl.nbytes == nbytes          # FilterList self-reports bytes
+        assert br.used == nbytes            # ...and the breaker holds them
+
+        # eviction: dropping the cached list releases the exact charge
+        seg._fastpath_quality.clear()
+        del fl, qt
+        gc.collect()
+        assert br.used == 0

@@ -1,0 +1,437 @@
+"""oslint — the AST host/device discipline linter (devtools/oslint).
+
+Two jobs:
+1. Per-rule fixtures: each checker catches the ADVICE-derived bug class it
+   was built for (true positive) and stays quiet on the disciplined
+   counterpart (false positive).
+2. The tier-1 gate: the repo itself lints clean against the checked-in
+   baseline, and every baseline entry carries a real justification.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from opensearch_tpu.devtools.oslint import (load_baseline, run_paths,
+                                            run_source, write_baseline)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "oslint_baseline.json")
+
+
+def lint(src, path="opensearch_tpu/search/mod.py"):
+    return run_source(textwrap.dedent(src), path)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ----------------------------------------------------------------------
+# OSL1xx dtype discipline
+# ----------------------------------------------------------------------
+
+class TestDtypeRules:
+    def test_osl101_f64_vs_f32_theta_compare(self):
+        # the fastpath.py:823 class: f64 contribution compared to theta32
+        src = """
+            import numpy as np
+
+            def tie(tfv, kfac, theta):
+                theta32 = np.float32(theta)
+                contrib = float(tfv) / (float(tfv) + float(kfac))
+                if contrib > theta32:
+                    return False
+                return contrib == theta32
+        """
+        assert "OSL101" in rules_of(lint(src))
+
+    def test_osl101_quiet_when_cast_first(self):
+        src = """
+            import numpy as np
+
+            def tie(tfv, kfac, theta):
+                theta32 = np.float32(theta)
+                contrib = (tfv / (tfv + kfac)).astype(np.float32)
+                if contrib > theta32:
+                    return False
+                return contrib == theta32
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl101_out_of_scope_module_quiet(self):
+        src = """
+            import numpy as np
+
+            def tie(x, theta):
+                return float(x) > np.float32(theta)
+        """
+        # dtype discipline only patrols search/, ops/, parallel/
+        assert rules_of(lint(src, "opensearch_tpu/rest/http.py")) == []
+
+    def test_osl102_int_round_float_count(self):
+        # the service.py:1491 class: f32 count plane laundered via round
+        src = """
+            def doc_count(fagg, bi):
+                return int(round(float(fagg[bi][0])))
+        """
+        assert "OSL102" in rules_of(lint(src))
+
+    def test_osl102_quiet_on_int_plane(self):
+        src = """
+            def doc_count(counts, bi):
+                n = 3
+                return int(round(n)) + int(counts[bi])
+        """
+        assert rules_of(lint(src)) == []
+
+
+# ----------------------------------------------------------------------
+# OSL2xx jit boundary
+# ----------------------------------------------------------------------
+
+class TestJitRules:
+    def test_osl201_branch_on_traced(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """
+        assert "OSL201" in rules_of(lint(src))
+
+    def test_osl201_scan_body_by_name(self):
+        src = """
+            import jax
+
+            def body(carry, x):
+                y = x + carry
+                out = 1 if y > 0 else 0
+                return carry, out
+
+            def run(xs):
+                return jax.lax.scan(body, 0.0, xs)
+        """
+        assert "OSL201" in rules_of(lint(src))
+
+    def test_osl201_quiet_on_shape_and_static(self):
+        src = """
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("mode",))
+            def f(x, mode):
+                if x.shape[0] > 2 and mode == "wide":
+                    return x * 2
+                return x
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl201_taint_through_deeper_nested_assignment(self):
+        # the tainted assignment sits DEEPER in the tree than the branch
+        # that uses it; a single breadth-first pass would check the branch
+        # before tainting `y`
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                for i in range(2):
+                    y = x * 2
+                if y > 0:
+                    return y
+                return x
+        """
+        assert "OSL201" in rules_of(lint(src))
+
+    def test_osl202_host_sync_casts(self):
+        src = """
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                a = float(x)
+                b = np.asarray(x)
+                c = x.item()
+                return a, b, c
+        """
+        found = [f for f in lint(src) if f.rule == "OSL202"]
+        assert len(found) == 3
+
+    def test_osl203_nondeterminism(self):
+        src = """
+            import jax
+            import time
+
+            @jax.jit
+            def f(x):
+                return x * time.time()
+        """
+        assert "OSL203" in rules_of(lint(src))
+
+    def test_jit_rules_quiet_on_host_code(self):
+        # identical constructs OUTSIDE a traced function are host-side fine
+        src = """
+            import time
+
+            def f(x):
+                if x > 0:
+                    return float(x) * time.time()
+                return 0.0
+        """
+        assert rules_of(lint(src)) == []
+
+
+# ----------------------------------------------------------------------
+# OSL301 breaker discipline
+# ----------------------------------------------------------------------
+
+class TestBreakerRules:
+    TIER = """
+        import numpy as np
+
+        def quality_tier(seg, field):
+            cache = seg.__dict__.setdefault("_fastpath_quality", {})
+            mask = np.zeros(seg.ndocs, bool)
+            docs = np.flatnonzero(mask).astype(np.int32)
+            fl = FilterList(docs, None, len(docs), 0, mask, ("q", field))
+            %s
+            cache[field] = fl
+            return fl
+    """
+
+    def test_osl301_uncharged_ndocs_cache(self):
+        # the fastpath.py:1009 class: ndocs-sized mask cached, no breaker
+        src = self.TIER % "pass"
+        assert "OSL301" in rules_of(lint(src))
+
+    def test_osl301_quiet_when_breaker_charged(self):
+        src = self.TIER % (
+            '_breaker.add_estimate(mask.nbytes + docs.nbytes, "q")')
+        assert rules_of(lint(src)) == []
+
+    def test_osl301_quiet_without_ndocs_scale(self):
+        src = """
+            def small_cache(obj, key):
+                cache = obj.__dict__.setdefault("_memo", {})
+                cache[key] = key * 2
+                return cache[key]
+        """
+        assert rules_of(lint(src)) == []
+
+
+# ----------------------------------------------------------------------
+# OSL4xx lock discipline
+# ----------------------------------------------------------------------
+
+class TestLockRules:
+    def test_osl401_mixed_locked_unlocked_writes(self):
+        # the distnode version-bump race class: one writer under the state
+        # lock, another bare
+        src = """
+            import threading
+
+            class Node:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.version = 0
+
+                def bump(self):
+                    self.version += 1
+
+                def apply(self, st):
+                    with self._lock:
+                        self.version = st["version"]
+        """
+        found = lint(src, "opensearch_tpu/cluster/node.py")
+        assert [f.rule for f in found] == ["OSL401"]
+        assert "version" in found[0].msg
+
+    def test_osl401_quiet_when_both_locked(self):
+        src = """
+            import threading
+
+            class Node:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.version = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.version += 1
+
+                def apply(self, st):
+                    with self._lock:
+                        self.version = st["version"]
+        """
+        assert rules_of(lint(src, "opensearch_tpu/cluster/node.py")) == []
+
+    def test_osl402_lock_order_inversion(self):
+        src = """
+            import threading
+
+            class Pair:
+                def f(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            self.x = 1
+
+                def g(self):
+                    with self.b_lock:
+                        with self.a_lock:
+                            self.y = 2
+        """
+        assert "OSL402" in rules_of(
+            lint(src, "opensearch_tpu/cluster/pair.py"))
+
+    def test_osl402_quiet_on_consistent_order(self):
+        src = """
+            import threading
+
+            class Pair:
+                def f(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            self.x = 1
+
+                def g(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            self.y = 2
+        """
+        assert rules_of(lint(src, "opensearch_tpu/cluster/pair.py")) == []
+
+    def test_lock_scope_excludes_search_non_fastpath(self):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+
+                def locked(self):
+                    with self._lock:
+                        self.n = 0
+        """
+        assert rules_of(lint(src, "opensearch_tpu/search/executor.py")) == []
+        assert rules_of(lint(src, "opensearch_tpu/search/fastpath.py")) \
+            == ["OSL401"]
+
+
+# ----------------------------------------------------------------------
+# suppression + baseline mechanics
+# ----------------------------------------------------------------------
+
+class TestSuppressionAndBaseline:
+    SRC = """
+        def doc_count(fagg, bi):
+            return int(round(float(fagg[bi][0])))%s
+    """
+
+    def test_inline_disable_with_rule(self):
+        assert rules_of(lint(self.SRC % "")) == ["OSL102"]
+        assert rules_of(lint(
+            self.SRC % "  # oslint: disable=OSL102 -- proven < 2^24")) == []
+
+    def test_inline_disable_other_rule_does_not_apply(self):
+        assert rules_of(lint(
+            self.SRC % "  # oslint: disable=OSL999 -- wrong rule")) \
+            == ["OSL102"]
+
+    def test_baseline_round_trip(self, tmp_path):
+        findings = lint(self.SRC % "")
+        bp = str(tmp_path / "baseline.json")
+        write_baseline(findings, bp)
+        bl = load_baseline(bp)
+        assert bl.new_findings(findings) == []
+        assert bl.stale_entries(findings) == []
+        # the debt is paid -> entry reported stale
+        assert len(bl.stale_entries([])) == 1
+
+    def test_count_ratchet_catches_additional_same_symbol_finding(
+            self, tmp_path):
+        # fingerprints are line-free, so same-rule findings in one symbol
+        # share one; the baseline records the COUNT and more occurrences
+        # than triaged still fail the gate
+        body = """
+            def doc_count(fagg, bi):
+                a = int(round(float(fagg[bi][0])))
+                b = int(round(float(fagg[bi][1])))
+                %s
+                return a + b
+        """
+        two = lint(body % "")
+        assert len(two) == 2
+        assert len({f.fingerprint for f in two}) == 1
+        bp = str(tmp_path / "baseline.json")
+        write_baseline(two, bp)
+        bl = load_baseline(bp)
+        assert bl.new_findings(two) == []
+        three = lint(body % "c = int(round(float(fagg[bi][2])))")
+        assert len(bl.new_findings(three)) == 1
+        # and paying one back marks the entry stale (shrink the count)
+        assert len(bl.stale_entries(two[:1])) == 1
+
+    def test_fingerprint_survives_line_moves(self):
+        a = lint(self.SRC % "")
+        b = lint("\n\n\n" + textwrap.dedent(self.SRC % ""))
+        assert a[0].line != b[0].line
+        assert a[0].fingerprint == b[0].fingerprint
+
+
+# ----------------------------------------------------------------------
+# tier-1 gate: the repo lints clean against its baseline
+# ----------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_repo_has_no_unbaselined_findings(self):
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        baseline = load_baseline(BASELINE)
+        new = baseline.new_findings(findings)
+        assert new == [], "new oslint findings (fix, suppress with " \
+            "justification, or triage into oslint_baseline.json):\n" \
+            + "\n".join(f.render() for f in new)
+
+    def test_baseline_entries_all_justified(self):
+        data = json.load(open(BASELINE))
+        for e in data["entries"]:
+            reason = e.get("reason", "")
+            assert reason and "TRIAGE" not in reason, \
+                f"baseline entry without a justification: {e}"
+
+    def test_runner_check_clean_file(self):
+        # CLI smoke: a disciplined file exits 0 under --check
+        rc = subprocess.call(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts", "oslint.py"),
+             "--check", "opensearch_tpu/devtools/oslint/core.py"],
+            cwd=REPO_ROOT, stdout=subprocess.DEVNULL)
+        assert rc == 0
+
+    def test_runner_check_fails_on_new_finding(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """))
+        rc = subprocess.call(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts", "oslint.py"),
+             "--check", str(bad)],
+            cwd=REPO_ROOT, stdout=subprocess.DEVNULL)
+        assert rc == 1
