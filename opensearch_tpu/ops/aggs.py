@@ -206,6 +206,26 @@ def ddsketch_hist(values_f32: jnp.ndarray, present: jnp.ndarray,
     return jnp.zeros(DD_NBINS, jnp.float32).at[b].add(w, mode="drop")
 
 
+def ddsketch_bin(v: float) -> int:
+    """Host-side bin index of one value — the same arithmetic as
+    `ddsketch_hist` (f32 log/floor, so a stored value and a queried value
+    land in the same bin bit-for-bit; percentile_ranks inverts percentiles
+    through this)."""
+    # every step in f32, mirroring the device (jnp canonicalizes the f64
+    # log/gamma constants to f32 before the subtract/divide; a host f64
+    # intermediate shifts ~1e-4 of values one bin off the device's)
+    mag = np.float32(abs(v))
+    ln = np.log(np.maximum(mag, np.float32(DD_MIN_MAG)))
+    idx = int(np.floor((ln - np.float32(np.log(DD_MIN_MAG)))
+                       / np.float32(DD_LN_GAMMA)))
+    idx = min(max(idx, 0), DD_HALF - 1)
+    if v > 0:
+        return DD_HALF + 1 + idx
+    if v < 0:
+        return DD_HALF - 1 - idx
+    return DD_HALF
+
+
 def ddsketch_value(b: int) -> float:
     """Representative value of bin b (host-side finalize)."""
     if b == DD_HALF:

@@ -1,0 +1,165 @@
+"""Device-side phase-2 exact rescore — the escalation ladder's middle rung
+without the host round trip (the device analog of Lucene re-walking a WAND
+candidate, reference `search/query/QueryPhase.java` two-phase iteration).
+
+`search/fastpath.py`'s pruned pipeline escalates a clamped query by exact-
+rescoring a CANDIDATE UNION (every doc any impact head mentions, ≤ T·4·L_HEAD
+ids) against the FULL posting rows. The r5 implementation was a host numpy
+pass (`_exact_rescore`) sandwiched between kernel launches: per escalated
+query, T vectorized `searchsorted`s over rows that can span millions of
+postings — serialized on the host exactly when the query is already slowest.
+This module moves that pass onto the device as ONE jit launch batched across
+the whole escalation queue:
+
+    per (query, term, candidate):  branchless lower-bound binary search over
+    the term's CSR window in the ALREADY-RESIDENT aligned postings buffers
+    (the same `AlignedPostings.d_docs/d_tfdl` the dense scorer DMAs from) —
+    no new device-resident state, no per-query transfer beyond the padded
+    candidate ids — then gather packed (tf, dl), decode, and accumulate
+    exact f32 BM25 + per-term match counts.
+
+Why `jnp` and not a Pallas kernel: the access pattern is C·T independent
+binary searches (log P dependent random gathers each) — there is no
+contiguous DMA window to stage into VMEM, which is the only thing the fused
+scorer's Pallas formulation buys. XLA compiles the probe loop into log2(P)
+batched gathers over [QB, T, C]; the arithmetic after the search is plain
+VPU work XLA fuses fine. A Pallas upgrade would only pay if the probe
+gathers dominate on silicon — measure first (docs/FASTPATH.md).
+
+BIT-PARITY CONTRACT: the accumulation mirrors `fastpath._exact_rescore`
+op-for-op in f32 (same expression shapes, same term order, weak-typed
+scalars rounding at the same points), so `_tie_serves`/theta32 comparisons
+made on device scores are bit-identical to the host oracle's. The host pass
+stays as the `JAX_PLATFORMS=cpu` fallback and the parity oracle
+(tests/test_rescore.py asserts exact equality, not allclose).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_bm25 import DL_BITS, DL_MASK, INT_SENTINEL, TF_MAX
+
+
+@functools.partial(jax.jit, static_argnames=("T", "C", "k1", "b"))
+def exact_rescore_batch(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
+                        starts: jnp.ndarray, lens: jnp.ndarray,
+                        weights: jnp.ndarray, avgdl: jnp.ndarray,
+                        cand: jnp.ndarray,
+                        T: int, C: int, k1: float, b: float):
+    """Exact BM25 scores + match counts of candidate docs vs full rows.
+
+    docs_hbm  i32[P] — aligned CSR doc ids (fastpath AlignedPostings.d_docs:
+              each row doc-ascending within its true window)
+    tfdl_hbm  i32[P] — packed tf << DL_BITS | dl per posting
+    starts    i32[QB, T] — ELEMENT offset of each term's full-row window
+    lens      i32[QB, T] — true posting count per window (0 = absent term)
+    weights   f32[QB, T] — query-time idf * boost
+    avgdl     f32[QB, 1]
+    cand      i32[QB, C] — candidate doc ids, INT_SENTINEL padded
+    k1, b     static similarity params (b pre-zeroed when norms are off)
+    Returns (exact f32[QB, C], counts i32[QB, C]) — 0 on padding slots.
+    """
+    P = docs_hbm.shape[0]
+    # lower_bound over [start, start+len): branchless bisection, static
+    # probe count from the (static) buffer length. mid = lo + (hi-lo)//2
+    # keeps i32 safe for buffers past 2^30 elements.
+    lo = jnp.broadcast_to(starts[:, :, None], starts.shape + (C,))
+    hi = lo + lens[:, :, None]
+    end = hi
+    c = cand[:, None, :]
+    for _ in range(max(int(P).bit_length(), 1)):
+        mid = lo + (hi - lo) // 2
+        v = docs_hbm[jnp.clip(mid, 0, P - 1)]
+        go = v < c
+        lo = jnp.where(go, mid + 1, lo)
+        hi = jnp.where(go, hi, mid)
+    # mirror the host's clamped probe: pos_c = min(pos, row_end - 1)
+    pos_c = jnp.clip(jnp.minimum(lo, end - 1), 0, P - 1)
+    found = ((docs_hbm[pos_c] == c) & (lens[:, :, None] > 0)
+             & (c < INT_SENTINEL))
+    tfdl = tfdl_hbm[pos_c]
+    tf = jnp.where(found, ((tfdl >> DL_BITS) & TF_MAX), 0
+                   ).astype(jnp.float32)
+    # the candidate's doc length, recovered from any matched posting (all
+    # postings of one doc in one field carry the same dl; candidates are
+    # head members, so a real candidate matches >= 1 full row). Padding /
+    # no-match candidates get dl 0 — their contribution is masked to 0
+    # anyway, matching the host oracle's zero output for them.
+    dl_c = jnp.max(jnp.where(found, (tfdl & DL_MASK), 0),
+                   axis=1).astype(jnp.float32)
+    # EXACTLY `fastpath._exact_rescore`'s expression and evaluation order:
+    # (1.0 - b) folds at trace time in f64 then rounds to f32 on the add,
+    # the same NEP50 weak-scalar rounding the numpy pass performs
+    avg = jnp.maximum(avgdl, jnp.float32(1e-9))           # [QB, 1]
+    kfac = k1 * ((1.0 - b) + b * dl_c / avg)              # [QB, C] f32
+    exact = jnp.zeros(kfac.shape, jnp.float32)
+    counts = jnp.zeros(kfac.shape, jnp.int32)
+    # term-order f32 accumulation: adding a masked 0.0f is an exact
+    # identity on the non-negative partial sums, so skipped/absent slots
+    # leave the running sum bit-identical to the host loop's
+    for t in range(T):
+        tft = tf[:, t, :]
+        foundt = found[:, t, :]
+        contrib = jnp.where(foundt,
+                            weights[:, t:t + 1] * tft / (tft + kfac), 0.0)
+        exact = exact + contrib.astype(jnp.float32)
+        counts = counts + foundt.astype(jnp.int32)
+    return exact, counts
+
+
+def rescore_elem_budget(T: int, C: int, max_elems: int = 1 << 24) -> int:
+    """Max queries per launch so the [QB, T, C] probe intermediates stay
+    inside a bounded HBM transient (~max_elems * ~16B live at the widest
+    point). The fastpath splits bigger batches into sequential launches.
+    Returned as a POWER OF TWO: the caller pads QB to pow2, so a non-pow2
+    step would let the padded launch overshoot the budget by up to 2x."""
+    n = max(1, max_elems // max(T * C, 1))
+    return 1 << (n.bit_length() - 1)
+
+
+def host_exact_rescore_batch(docs: np.ndarray, tfdl: np.ndarray,
+                             starts: np.ndarray, lens: np.ndarray,
+                             weights: np.ndarray, avgdl: np.ndarray,
+                             cand: np.ndarray, k1: float, b: float):
+    """Numpy mirror of `exact_rescore_batch` over the SAME padded operands —
+    the parity oracle tests pin the device path against (the per-query
+    production host path stays `fastpath._exact_rescore`)."""
+    QB, C = cand.shape
+    T = starts.shape[1]
+    exact = np.zeros((QB, C), np.float32)
+    counts = np.zeros((QB, C), np.int32)
+    for q in range(QB):
+        valid = cand[q] < INT_SENTINEL
+        dl_c = np.zeros(C, np.float32)
+        tf_q = np.zeros((T, C), np.float32)
+        found_q = np.zeros((T, C), bool)
+        for t in range(T):
+            a = int(starts[q, t])
+            ln = int(lens[q, t])
+            if ln <= 0:
+                continue
+            rowdocs = docs[a: a + ln]
+            pos = np.searchsorted(rowdocs, cand[q])
+            pos_c = np.minimum(pos, ln - 1)
+            found = (rowdocs[pos_c] == cand[q]) & valid
+            packed = tfdl[a + pos_c]
+            tf_q[t] = np.where(found, (packed >> DL_BITS) & TF_MAX,
+                               0.0).astype(np.float32)
+            dl_c = np.maximum(dl_c, np.where(found, packed & DL_MASK,
+                                             0).astype(np.float32))
+            found_q[t] = found
+        kfac = k1 * (1.0 - b + b * dl_c / max(float(avgdl[q, 0]), 1e-9))
+        for t in range(T):
+            tft = tf_q[t]
+            contrib = np.where(found_q[t],
+                               np.float32(weights[q, t]) * tft
+                               / (tft + kfac), 0.0).astype(np.float32)
+            exact[q] += contrib
+            counts[q] += found_q[t]
+    return exact, counts

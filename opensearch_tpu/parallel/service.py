@@ -137,6 +137,16 @@ class MeshSearchService:
         self.filtered_dispatched = 0   # of dispatched: bool-with-filters
         self.terms_agg_dispatched = 0  # of dispatched: with a terms agg
         self.phrase_dispatched = 0     # of dispatched: match_phrase
+        # WHY each declined search host-looped, by decline site — surfaced
+        # in _nodes/stats so a dispatch-share measurement (MESH_SHARE)
+        # can't silently flatter: a flat `fallbacks` total hides whether
+        # the misses are benign (single-shard index) or a served shape
+        # regressing (e.g. agg columns failing to stack)
+        self.fallback_shapes: Dict[str, int] = {}
+
+    def _fall(self, shape: str, n: int = 1) -> None:
+        self.fallbacks += n
+        self.fallback_shapes[shape] = self.fallback_shapes.get(shape, 0) + n
 
     # ---------------- caches ----------------
 
@@ -826,7 +836,7 @@ class MeshSearchService:
         # SPMD scoring + device DFS/merge); a single-shard index would pay
         # compile + dispatch overhead for zero parallelism
         if svc.meta.num_shards < 2:
-            self.fallbacks += len(bodies)
+            self._fall("single_shard", len(bodies))
             return self._mark_declined(bodies, out)
         # a shard may hold any number of segments (incl. zero for routing
         # holes) — the stacked index concatenates them per shard
@@ -842,7 +852,7 @@ class MeshSearchService:
             try:
                 query = dsl.parse_query(body.get("query"))
             except dsl.QueryParseError:
-                self.fallbacks += 1
+                self._fall("parse_error")
                 continue
             lroot = C.rewrite(query, ctx, scoring=True)
             sort_specs = _norm_sort_specs(body)
@@ -852,7 +862,7 @@ class MeshSearchService:
             shape = self._eligible(lroot, sort_specs, agg_nodes,
                                    _collect_named(lroot), body, window)
             if shape is None:
-                self.fallbacks += 1
+                self._fall("query_shape")
                 continue
             lt, fnodes, notnodes, qboost, msm_eff = shape
             fpair = None            # (combo_key, per-shard host masks)
@@ -860,7 +870,7 @@ class MeshSearchService:
                 fpair = self._fmask_resolve(shard_segs, stats, fnodes,
                                             notnodes)
                 if fpair is None:
-                    self.fallbacks += 1
+                    self._fall("filter_unmaskable")
                     continue
             const = (float(getattr(lt, "boost", 1.0) or 1.0) * qboost
                      if getattr(lt, "mode", None) == "filter" else 0.0)
@@ -869,7 +879,7 @@ class MeshSearchService:
             # The resolved list rides on the AggNode (fresh per request)
             if not self._resolve_filters_aggs(agg_nodes, shard_segs,
                                               stats):
-                self.fallbacks += 1
+                self._fall("filters_agg_unmaskable")
                 continue
             parsed.append((qi, lt, sort_specs, max(window, 1), const,
                            agg_nodes or [], fpair, qboost, msm_eff))
@@ -923,12 +933,12 @@ class MeshSearchService:
         t0 = time.monotonic()
         stacked = self._stacked_for(name, svc, field, shard_segs)
         if stacked is None:
-            self.fallbacks += len(items)
+            self._fall("no_stacked_index", len(items))
             return
         S = len(shard_segs)
         mesh = self._mesh_for(S)
         if mesh is None:
-            self.fallbacks += len(items)
+            self._fall("no_mesh", len(items))
             return
         # every item in the group shares one filter combo (the group key)
         fpair = items[0][6]
@@ -938,7 +948,7 @@ class MeshSearchService:
             if it[3] > K:
                 # deeper page than the program's merged top-k capacity
                 # (tiny shards): that body takes the host loop
-                self.fallbacks += 1
+                self._fall("deep_window")
                 continue
             # aggs need their stacked columns (metric) or global-ordinal
             # values (terms); a missing/oversized one -> host loop
@@ -1011,7 +1021,7 @@ class MeshSearchService:
                     agg_ok = False
                     break
             if not agg_ok:
-                self.fallbacks += 1
+                self._fall("agg_column")
                 continue
             keep.append(it)
         items = keep
@@ -1060,6 +1070,7 @@ class MeshSearchService:
             an.body["field"] for it in items for an in it[5]
             if an.kind not in ("terms", "histogram", "date_histogram",
                                "range", "cardinality", "percentiles",
+                               "percentile_ranks",
                                "median_absolute_deviation",
                                "weighted_avg", "geo_bounds",
                                "geo_centroid", "significant_terms",
@@ -1189,12 +1200,14 @@ class MeshSearchService:
                          pres) + ((fmask,) if filtered else ())
             card_results[f] = cfn(*cargs)
 
-        # DDSketch histograms (percentiles + median_absolute_deviation
-        # share one program run per field) and weighted_avg moments
+        # DDSketch histograms (percentiles + percentile_ranks +
+        # median_absolute_deviation share one program run per field) and
+        # weighted_avg moments
         dd_results = {}
         dd_fields = sorted({an.body["field"] for it in items
                             for an in it[5]
                             if an.kind in ("percentiles",
+                                           "percentile_ranks",
                                            "median_absolute_deviation")})
         for f in dd_fields:
             col, pres = self._col_for(name, svc, f, shard_segs,
@@ -1526,6 +1539,12 @@ class MeshSearchService:
                         "hist": dd_results[an.body["field"]][bi],
                         "percents": percents}]
                     continue
+                if an.kind == "percentile_ranks":
+                    results[0].agg_partials[an.name] = [{
+                        "hist": dd_results[an.body["field"]][bi],
+                        "values": [float(v) for v in
+                                   an.body.get("values", ())]}]
+                    continue
                 if an.kind == "median_absolute_deviation":
                     results[0].agg_partials[an.name] = [{
                         "hist": dd_results[an.body["field"]][bi]}]
@@ -1632,24 +1651,24 @@ class MeshSearchService:
         t0 = time.monotonic()
         stacked = self._stacked_for(name, svc, field, shard_segs)
         if stacked is None:
-            self.fallbacks += len(items)
+            self._fall("no_stacked_index", len(items))
             return
         S = len(shard_segs)
         mesh = self._mesh_for(S)
         if mesh is None:
-            self.fallbacks += len(items)
+            self._fall("no_mesh", len(items))
             return
         pairs = self._pairs_for(name, svc, field, shard_segs, stacked,
                                 mesh)
         if pairs is None:         # field has no positional postings
-            self.fallbacks += len(items)
+            self._fall("no_positions", len(items))
             return
         fpair = items[0][6]
         K = min(k_class, stacked.ndocs_pad)
         keep = []
         for it in items:
             if it[3] > K:
-                self.fallbacks += 1
+                self._fall("deep_window")
                 continue
             keep.append(it)
         items = keep
@@ -1673,7 +1692,7 @@ class MeshSearchService:
                     max_pairs = max(max_pairs, pairs.row_size(si, r))
         bucket = next_pow2(max_pairs, floor=64)
         if bucket > MAX_PHRASE_BUCKET:
-            self.fallbacks += len(items)
+            self._fall("phrase_bucket_cap", len(items))
             return
         filtered = fpair is not None
         fmask = (self._dev_mask_for(fpair[0], fpair[1], shard_segs,
@@ -1743,6 +1762,9 @@ class MeshSearchService:
             # (psum), weighted_avg by summed moments
             if an.kind == "percentiles" and set(an.body) <= \
                     {"field", "percents", "keyed"}:
+                continue
+            if an.kind == "percentile_ranks" and set(an.body) <= \
+                    {"field", "values", "keyed"}:
                 continue
             if an.kind == "median_absolute_deviation" \
                     and set(an.body) == {"field"}:
@@ -1938,6 +1960,7 @@ class MeshSearchService:
     def stats(self) -> dict:
         return {"devices": len(self.devices), "dispatched": self.dispatched,
                 "fallbacks": self.fallbacks,
+                "fallback_shapes": dict(self.fallback_shapes),
                 "filtered_dispatched": self.filtered_dispatched,
                 "terms_agg_dispatched": self.terms_agg_dispatched,
                 "phrase_dispatched": self.phrase_dispatched,

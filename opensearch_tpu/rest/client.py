@@ -875,6 +875,9 @@ class RestClient:
             # good as its escalation rate), and the SPMD mesh dispatch
             # share when a mesh service is attached
             "fastpath": dict(_fastpath.STATS),
+            # where the phase-2 candidate-union rescore ran and what it
+            # cost (host numpy fallback vs batched device launches)
+            "fastpath_rescore": _fastpath.rescore_stats(),
         }
         if n.mesh_service is not None:
             node_block["mesh"] = n.mesh_service.stats()

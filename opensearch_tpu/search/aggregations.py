@@ -33,7 +33,8 @@ BUCKET_KINDS = {"terms", "histogram", "date_histogram", "range", "date_range",
                 "auto_date_histogram", "significant_text",
                 "diversified_sampler"}
 METRIC_KINDS = {"min", "max", "sum", "avg", "stats", "extended_stats",
-                "value_count", "cardinality", "percentiles", "top_hits",
+                "value_count", "cardinality", "percentiles",
+                "percentile_ranks", "top_hits",
                 "matrix_stats", "weighted_avg", "median_absolute_deviation",
                 "geo_bounds", "geo_centroid", "scripted_metric"}
 PIPELINE_KINDS = {"avg_bucket", "sum_bucket", "min_bucket", "max_bucket",
@@ -185,11 +186,15 @@ def merge_partials(node: AggNode, partials: List[dict]) -> dict:
         for p in parts[1:]:
             regs = np.maximum(regs, p["registers"])
         return {"registers": regs}
-    if kind == "percentiles":
+    if kind in ("percentiles", "percentile_ranks"):
+        # DDSketch bins are global constants, so histogram addition IS the
+        # cross-segment/shard reduce; ranks carries the queried values
+        # where percentiles carries the queried percents
         hist = parts[0]["hist"].copy()
         for p in parts[1:]:
             hist += p["hist"]
-        return {"hist": hist, "percents": parts[0]["percents"]}
+        key = "percents" if kind == "percentiles" else "values"
+        return {"hist": hist, key: parts[0][key]}
     if kind == "top_hits":
         rows = [r for p in parts for r in p["hits"]]
         rows.sort(key=lambda r: -r["_score"] if r["_score"] is not None else 0)
@@ -363,6 +368,8 @@ def finalize(node: AggNode, merged: dict, pipelines: bool = True) -> dict:
         return {"value": int(round(_hll_estimate(merged["registers"])))}
     if kind == "percentiles":
         return {"values": _hist_percentiles(merged)}
+    if kind == "percentile_ranks":
+        return {"values": _hist_percentile_ranks(merged)}
     if kind == "top_hits":
         return {"hits": {"total": {"value": int(merged["total"]), "relation": "eq"},
                          "max_score": merged["hits"][0]["_score"] if merged["hits"] else None,
@@ -703,7 +710,7 @@ def _empty_result(node: AggNode) -> dict:
         return {"value": 0}
     if node.kind == "stats":
         return {"count": 0, "min": None, "max": None, "sum": 0.0, "avg": None}
-    if node.kind == "percentiles":
+    if node.kind in ("percentiles", "percentile_ranks"):
         return {"values": {}}
     return {}
 
@@ -733,6 +740,29 @@ def _hist_percentiles(merged: dict) -> Dict[str, float]:
         target = max(p / 100.0 * total, 1e-9)
         b = int(np.searchsorted(cum, target, side="left"))
         out[f"{p:.1f}"] = ddsketch_value(min(b, nb - 1))
+    return out
+
+
+def _hist_percentile_ranks(merged: dict) -> Dict[str, float]:
+    """percentile_ranks: the INVERSE of `_hist_percentiles` over the same
+    DDSketch histogram (reference PercentileRanksAggregationBuilder,
+    SearchModule.java:441) — for each requested value, the percentage of
+    observations <= it. Inclusive cumulative count of the value's own bin,
+    so rank(percentile(p)) round-trips to p within one bin's resolution."""
+    from ..ops.aggs import ddsketch_bin
+
+    hist = merged["hist"].astype(np.float64)
+    total = hist.sum()
+    out: Dict[str, float] = {}
+    # keys are the full-precision value strings (reference
+    # String.valueOf(double)): a fixed .1f format would collide distinct
+    # sub-0.05 values like 0.01 and 0.04 onto one key
+    if total == 0:
+        return {str(float(v)): None for v in merged["values"]}
+    cum = np.cumsum(hist)
+    for v in merged["values"]:
+        b = ddsketch_bin(float(v))
+        out[str(float(v))] = float(cum[b] / total * 100.0)
     return out
 
 

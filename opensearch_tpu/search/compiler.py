@@ -3763,6 +3763,12 @@ def prepare_agg(node: AggNode, seg: Segment, ctx: ShardContext, params: dict,
         percents = tuple(body.get("percents", DEFAULT_PERCENTS))
         return ("pctl", prefix, field, col is not None, percents)
 
+    if kind == "percentile_ranks":
+        field = _resolve_agg_field(node, ctx)
+        col = seg.numeric_cols.get(field)
+        values = tuple(float(v) for v in body.get("values", ()))
+        return ("pctl_ranks", prefix, field, col is not None, values)
+
     if kind == "top_hits":
         return ("top_hits", prefix, int(body.get("size", 3)))
 
@@ -4481,8 +4487,8 @@ def emit_agg(spec, seg_arrays: dict, params: dict, match, scores=None):  # noqa:
         return {"registers": agg_ops.cardinality_numeric_registers(
             col["f32"], col["present"], match, HLL_LOG2M)}
 
-    if kind == "pctl":
-        _, prefix, field, col_exists, percents = spec
+    if kind in ("pctl", "pctl_ranks"):
+        _, prefix, field, col_exists, _pv = spec
         if not col_exists:
             return {"hist": jnp.zeros(agg_ops.DD_NBINS, jnp.float32)}
         col = seg_arrays["numeric"][field]
@@ -4730,6 +4736,44 @@ def _build_mask_executor(spec):
         return emit(spec, seg_arrays, params).matched
 
     return jax.jit(run)
+
+
+# =====================================================================
+# device phase-2 rescore programs (search/fastpath.py escalation rung)
+# =====================================================================
+#
+# The candidate-union rescore launches with a dynamic candidate count per
+# query (anything from a few head hits to the full T*4*L_HEAD tier-2
+# union). Shapes are canonicalized HERE — pow2 candidate bucket with a
+# floor, pow2 query batch in the caller — so the jit cache sees a bounded
+# spec space (~10 C buckets x 4 T buckets per similarity) instead of one
+# program per candidate count: the same recompile-storm discipline as the
+# scoring executors above.
+
+RESCORE_C_MIN = 1 << 8          # pad floor: tiny unions share one program
+RESCORE_C_MAX = 1 << 17         # == MAX_T * 4 * L_HEAD (deepest tier-2
+                                # union); beyond -> caller's host fallback
+
+
+def rescore_cand_bucket(n: int) -> Optional[int]:
+    """Candidate-axis pow2 bucket for a union of `n` ids; None when the
+    union exceeds every compiled variant (host pass instead)."""
+    if n <= 0 or n > RESCORE_C_MAX:
+        return None
+    return min(max(next_pow2(n), RESCORE_C_MIN), RESCORE_C_MAX)
+
+
+@lru_cache(maxsize=64)
+def build_rescore_program(T: int, C: int, k1: float, b: float):
+    """Cached callable for one (term-slot, candidate-bucket, similarity)
+    shape of ops/rescore.exact_rescore_batch."""
+    from ..ops.rescore import exact_rescore_batch
+
+    def run(d_docs, d_tfdl, starts, lens, weights, avgdl, cand):
+        return exact_rescore_batch(d_docs, d_tfdl, starts, lens, weights,
+                                   avgdl, cand, T=T, C=C, k1=k1, b=b)
+
+    return run
 
 
 # spec kinds whose second element is a node id (everything `prepare`

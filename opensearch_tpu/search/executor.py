@@ -1887,6 +1887,10 @@ def _device_agg_to_partial(node: AggNode, aspec, device_out: Optional[dict],
         _, prefix, f, col_exists, percents = aspec
         return {"hist": np.asarray(device_out["hist"]), "percents": list(percents)}
 
+    if kind == "pctl_ranks":
+        _, prefix, f, col_exists, values = aspec
+        return {"hist": np.asarray(device_out["hist"]), "values": list(values)}
+
     if kind == "wavg":
         return {"vwsum": float(np.asarray(device_out["vwsum"])),
                 "wsum": float(np.asarray(device_out["wsum"])),

@@ -62,6 +62,45 @@ STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0,
          "pruned_rescued2": 0, "pruned_escalated": 0,
          "shard_view_served": 0}
 
+# phase-2 rescore instrumentation (surfaced in _nodes/stats and read by
+# scripts/measure_escalation.py): where the candidate-union rescore ran
+# and what it cost. wall_ms includes the device_get sync, so device
+# numbers are honest end-to-end, not launch-and-forget.
+RESCORE_STATS = {"host_calls": 0, "host_wall_ms": 0.0,
+                 "device_launches": 0, "device_queries": 0,
+                 "device_cands": 0, "device_wall_ms": 0.0}
+
+_rescore_override: Optional[str] = None   # tests/scripts pin a path
+
+
+def set_rescore_mode(mode: Optional[str]) -> None:
+    """Force the phase-2 rescore path: "device", "host", or None (auto).
+    Rejects anything else — a silently-ignored typo would make a parity
+    harness compare the host path against itself."""
+    global _rescore_override
+    if mode not in (None, "device", "host"):
+        raise ValueError(f"rescore mode must be 'device', 'host' or None, "
+                         f"got {mode!r}")
+    _rescore_override = mode
+
+
+def rescore_mode() -> str:
+    """Where the candidate-union rescore runs. Auto: device on TPU, host
+    numpy under JAX_PLATFORMS=cpu (the fallback + parity oracle). Env
+    OPENSEARCH_TPU_RESCORE=device|host overrides; set_rescore_mode wins."""
+    import os
+    if _rescore_override in ("device", "host"):
+        return _rescore_override
+    env = os.environ.get("OPENSEARCH_TPU_RESCORE", "").lower()
+    if env in ("device", "host"):
+        return env
+    import jax
+    return "device" if jax.default_backend() == "tpu" else "host"
+
+
+def rescore_stats() -> dict:
+    return dict(RESCORE_STATS)
+
 # optional memory accounting set by the Node (utils/breaker.py): charged
 # before aligned arrays go to device, released when the segment is GC'd
 # (segments are immutable and replaced on refresh/merge)
@@ -930,69 +969,232 @@ def _noheads_bound(al: AlignedPostings, vq: _VQuery,
     return best
 
 
+def _p2_candidates(vq: _VQuery, pb, ids_of) -> Optional[np.ndarray]:
+    """The candidate union of one query: every doc any queried head
+    mentions (`ids_of(row)`; None = the head is the full row)."""
+    ids = []
+    for r in vq.rows:
+        if r < 0:
+            continue
+        r = int(r)
+        hid = ids_of(r)
+        if hid is None:
+            a, b = pb.row_slice(r)
+            hid = pb.doc_ids[a:b]
+        ids.append(np.asarray(hid, np.int64))
+    if not ids:
+        return None
+    cand = np.unique(np.concatenate(ids))
+    return cand if len(cand) else None
+
+
+def _p2_decide(al: AlignedPostings, vq: _VQuery, cand: np.ndarray,
+               exact: np.ndarray, counts: np.ndarray, window: int, K: int,
+               frontier_of) -> Optional[tuple]:
+    """Serve-or-escalate decision on exact-rescored candidates: certify the
+    window against the dl-consistent `_noheads_bound` or return None."""
+    pass_msm = counts >= vq.msm_true
+    n_pass = int(pass_msm.sum())
+    exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
+    order = np.lexsort((cand, -exact_m))
+    theta = (float(exact_m[order[window - 1]]) if n_pass >= window
+             else -np.inf)
+    bound = _noheads_bound(al, vq, frontier_of)
+    # equality escalates (frontier bounds are attained), as in phase 1
+    if bound >= theta:
+        return None
+    keep = order[pass_msm[order]][:K]
+    sc2 = np.full(K, -np.inf, np.float32)
+    dc2 = np.full(K, -1, np.int32)
+    sc2[: len(keep)] = exact_m[keep]
+    dc2[: len(keep)] = cand[keep].astype(np.int32)
+    return (sc2, dc2, n_pass, "gte")
+
+
+def _rescore_many(seg: Segment, jobs: List[tuple]) -> List[tuple]:
+    """Exact scores + match counts for a BATCH of (vq, cand) rescore jobs.
+
+    rescore_mode() "device": one jit launch per (field, T, candidate
+    bucket, sim) group over the already-resident aligned buffers
+    (ops/rescore.exact_rescore_batch via compiler.build_rescore_program)
+    — the whole escalation queue rides a handful of launches instead of a
+    host searchsorted pass per query. "host": the numpy oracle
+    `_exact_rescore` per job (JAX_PLATFORMS=cpu fallback; also the path
+    parity tests pin the device results against, bit for bit)."""
+    import time
+    if not jobs:
+        return []
+    if rescore_mode() != "device":
+        t0 = time.perf_counter()
+        out = [_exact_rescore(seg, vq, cand) for vq, cand in jobs]
+        RESCORE_STATS["host_calls"] += len(jobs)
+        RESCORE_STATS["host_wall_ms"] += (time.perf_counter() - t0) * 1e3
+        return out
+    return _rescore_many_device(seg, jobs)
+
+
+def _rescore_many_device(seg: Segment, jobs: List[tuple]) -> List[tuple]:
+    import time
+
+    import jax
+
+    from . import compiler as C
+    from ..ops.rescore import rescore_elem_budget
+
+    t0 = time.perf_counter()
+    out: List[Optional[tuple]] = [None] * len(jobs)
+    groups: dict = {}
+    host_jobs: List[int] = []
+    for j, (vq, cand) in enumerate(jobs):
+        cb = C.rescore_cand_bucket(len(cand))
+        al = get_aligned(seg, vq.field)
+        # ineligible shapes (union past the bucket cap, element offsets
+        # beyond i32 on a pathologically large buffer) take the host pass
+        # for just that job — the rest of the batch stays on device
+        if (cb is None or al is None
+                or int(al.starts_rows[-1] + 1) * LANES + int(al.lens[-1])
+                > 2**31 - 1):
+            host_jobs.append(j)
+            continue
+        key = (vq.field, len(vq.rows), cb, vq.k1, vq.b_eff)
+        groups.setdefault(key, []).append(j)
+    for (field, T, cb, k1, b_eff), idxs in groups.items():
+        al = get_aligned(seg, field)
+        run = C.build_rescore_program(T, cb, k1, b_eff)
+        # bounded [QB, T, C] probe intermediates: split oversized groups
+        # into sequential launches
+        step = rescore_elem_budget(T, cb)
+        for lo in range(0, len(idxs), step):
+            part = idxs[lo: lo + step]
+            QB = next_pow2(len(part), floor=1)
+            starts = np.zeros((QB, T), np.int32)
+            lens = np.zeros((QB, T), np.int32)
+            weights = np.zeros((QB, T), np.float32)
+            avgdl = np.ones((QB, 1), np.float32)
+            cands = np.full((QB, cb), INT_MAX, np.int32)
+            for qj, j in enumerate(part):
+                vq, cand = jobs[j]
+                for i, r in enumerate(vq.rows):
+                    if r < 0:
+                        continue
+                    starts[qj, i] = int(al.starts_rows[int(r)]) * LANES
+                    lens[qj, i] = int(al.lens[int(r)])
+                weights[qj] = vq.weights
+                avgdl[qj, 0] = vq.avgdl
+                cands[qj, : len(cand)] = cand.astype(np.int32)
+            exact, counts = jax.device_get(
+                run(al.d_docs, al.d_tfdl, starts, lens, weights, avgdl,
+                    cands))
+            for qj, j in enumerate(part):
+                n = len(jobs[j][1])
+                out[j] = (exact[qj, :n], counts[qj, :n].astype(np.int64))
+            RESCORE_STATS["device_launches"] += 1
+            RESCORE_STATS["device_queries"] += len(part)
+            RESCORE_STATS["device_cands"] += int(
+                sum(len(jobs[j][1]) for j in part))
+    t_host = 0.0
+    for j in host_jobs:
+        vq, cand = jobs[j]
+        th = time.perf_counter()
+        out[j] = _exact_rescore(seg, vq, cand)
+        t_host += time.perf_counter() - th
+        RESCORE_STATS["host_calls"] += 1
+    # per-path attribution: a host-ineligible job's numpy time must not
+    # inflate device_wall_ms — that's the serialization signal these
+    # stats exist to expose
+    RESCORE_STATS["host_wall_ms"] += t_host * 1e3
+    RESCORE_STATS["device_wall_ms"] += \
+        (time.perf_counter() - t0 - t_host) * 1e3
+    return out
+
+
+def _phase2_batch(seg: Segment, vq_lists, specs: Sequence, results: dict,
+                  redo: List[int], K: int) -> List[int]:
+    """Candidate-union escalation — the cheap middle rung between the
+    pruned kernel pass and the dense rerun, batched across every query the
+    phase-1 verify failed. The kernel's top-K-by-PARTIAL misses 'balanced'
+    docs whose per-term partials are mid-pack but whose sum is competitive
+    (measured: 100% of clamped multi-term bench queries escalated on it).
+    Rescoring the ENTIRE head union (every doc any head mentions,
+    <= T*L_HEAD candidates) recovers exactly those docs: a doc outside ALL
+    heads is then bounded by the dl-consistent `_noheads_bound`, which
+    sits well below the top-K threshold on real corpora. Totals stay the
+    'gte' contract.
+
+    Tier 1 rescores every failed query's head union in ONE `_rescore_many`
+    batch; the still-unproven tail retries on lazily-built 4x-deeper
+    tier-2 heads (the remainder bound drops with the cut depth, catching
+    most of the multi-term stopword-class tail) as a second batch. Returns
+    the queries still unproven (-> quality-tier rung, then dense)."""
+    jobs: List[tuple] = []
+    meta: List[tuple] = []          # (qi, vq, cand)
+    still: List[int] = []
+    for qi in redo:
+        vq = vq_lists[qi][0]
+        pb = seg.postings.get(vq.field)
+        al = get_aligned(seg, vq.field)
+        cand = _p2_candidates(vq, pb, al.head_ids.get)
+        if cand is None:
+            still.append(qi)
+            continue
+        jobs.append((vq, cand))
+        meta.append((qi, vq, cand))
+    tier2: List[tuple] = []
+    for (qi, vq, cand), (exact, counts) in zip(meta,
+                                               _rescore_many(seg, jobs)):
+        al = get_aligned(seg, vq.field)
+        ver = _p2_decide(al, vq, cand, exact, counts,
+                         int(specs[qi].window or K), K, None)
+        if ver is not None:
+            results[id(vq)] = ver
+            STATS["pruned_rescued"] += 1
+        else:
+            tier2.append((qi, vq))
+    jobs2: List[tuple] = []
+    meta2: List[tuple] = []
+    for qi, vq in tier2:
+        pb = seg.postings.get(vq.field)
+        al = get_aligned(seg, vq.field)
+        dl_col = seg.doc_lens.get(vq.field)
+        h2 = {int(r): al.head2(pb, dl_col, int(r))
+              for r in vq.rows if r >= 0 and al.clamped(int(r))}
+        cand = _p2_candidates(
+            vq, pb, lambda row: h2[row][0] if row in h2 else None)
+        if cand is None:
+            still.append(qi)
+            continue
+        jobs2.append((vq, cand))
+        meta2.append((qi, vq, cand, h2))
+    for (qi, vq, cand, h2), (exact, counts) in zip(
+            meta2, _rescore_many(seg, jobs2)):
+        al = get_aligned(seg, vq.field)
+        ver = _p2_decide(al, vq, cand, exact, counts,
+                         int(specs[qi].window or K), K,
+                         lambda row, _h2=h2, _al=al:
+                         _h2[row][1] if row in _h2
+                         else _al.rem_frontiers.get(row))
+        if ver is not None:
+            results[id(vq)] = ver
+            STATS["pruned_rescued"] += 1
+            STATS["pruned_rescued2"] += 1
+        else:
+            still.append(qi)
+    return still
+
+
 def _phase2_rescore(seg: Segment, vq: _VQuery, window: int, K: int
                     ) -> Optional[tuple]:
-    """Candidate-union escalation — the cheap middle rung between the
-    pruned kernel pass and the dense rerun. The kernel's top-K-by-PARTIAL
-    misses 'balanced' docs whose per-term partials are mid-pack but whose
-    sum is competitive (measured: 100% of clamped multi-term bench queries
-    escalated on it). Rescoring the ENTIRE head union (every doc any head
-    mentions, <= T*L_HEAD candidates, one vectorized pass) recovers
-    exactly those docs: a doc outside ALL heads is then bounded by the
-    dl-consistent `_noheads_bound`, which sits well below the top-K
-    threshold on real corpora. Totals stay the 'gte' contract."""
-    al = get_aligned(seg, vq.field)
-    pb = seg.postings.get(vq.field)
-    dl_col = seg.doc_lens.get(vq.field)
+    """Single-query wrapper over the batched middle rung (kept for tests
+    and external callers; `_run_pure` batches via `_phase2_batch`)."""
+    results: dict = {}
 
-    def attempt(ids_of, frontier_of):
-        ids = []
-        for r in vq.rows:
-            if r < 0:
-                continue
-            r = int(r)
-            hid = ids_of(r)
-            if hid is None:
-                a, b = pb.row_slice(r)
-                hid = pb.doc_ids[a:b]
-            ids.append(np.asarray(hid, np.int64))
-        if not ids:
-            return None
-        cand = np.unique(np.concatenate(ids))
-        if len(cand) == 0:
-            return None
-        exact, counts = _exact_rescore(seg, vq, cand)
-        pass_msm = counts >= vq.msm_true
-        n_pass = int(pass_msm.sum())
-        exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
-        order = np.lexsort((cand, -exact_m))
-        theta = (float(exact_m[order[window - 1]]) if n_pass >= window
-                 else -np.inf)
-        bound = _noheads_bound(al, vq, frontier_of)
-        # equality escalates (frontier bounds are attained), as in phase 1
-        if bound >= theta:
-            return None
-        keep = order[pass_msm[order]][:K]
-        sc2 = np.full(K, -np.inf, np.float32)
-        dc2 = np.full(K, -1, np.int32)
-        sc2[: len(keep)] = exact_m[keep]
-        dc2[: len(keep)] = cand[keep].astype(np.int32)
-        return (sc2, dc2, n_pass, "gte")
+    class _S:
+        pass
 
-    out = attempt(al.head_ids.get, None)
-    if out is not None:
-        return out
-    # tier 2: 4x-deeper lazy heads for the clamped rows — the remainder
-    # bound drops with the cut depth, catching most of the multi-term
-    # stopword-class tail before any dense launch
-    h2 = {int(r): al.head2(pb, dl_col, int(r))
-          for r in vq.rows if r >= 0 and al.clamped(int(r))}
-    out = attempt(lambda row: h2[row][0] if row in h2 else None,
-                  lambda row: h2[row][1] if row in h2
-                  else al.rem_frontiers.get(row))
-    if out is not None:
-        STATS["pruned_rescued2"] += 1
-    return out
+    s = _S()
+    s.window = window
+    still = _phase2_batch(seg, [[vq]], [s], results, [0], K)
+    return None if still else results[id(vq)]
 
 
 QUALITY_SHARE = 8       # quality tier keeps ~ndocs/QUALITY_SHARE docs
@@ -1210,18 +1412,12 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
             results[id(vq)] = ver
     rescued = 0
     if redo:
-        # middle rung: candidate-union rescore before any dense rerun
-        still = []
-        for qi in redo:
-            vq = vq_lists[qi][0]
-            ver2 = _phase2_rescore(seg, vq, int(specs[qi].window or K), K)
-            if ver2 is not None:
-                results[id(vq)] = ver2
-                rescued += 1
-                STATS["pruned_rescued"] += 1
-            else:
-                still.append(qi)
-        redo = still
+        # middle rung: the candidate-union rescore for ALL failed queries,
+        # batched into as few device launches as their shape buckets allow
+        # (host numpy under JAX_PLATFORMS=cpu — see _rescore_many)
+        n_redo = len(redo)
+        redo = _phase2_batch(seg, vq_lists, specs, results, redo, K)
+        rescued += n_redo - len(redo)
     if redo:
         # last rung before dense: ONE batched exact launch over the
         # quality-tier view (~1/8 the postings). Only the hard tail pays

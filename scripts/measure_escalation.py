@@ -157,27 +157,71 @@ def main():
         if not streams:
             raise SystemExit(f"ESC_STREAMS={pick!r} matches none of "
                              f"{names}")
+    # each stream runs TWICE: phase-2 rescore on the host numpy oracle,
+    # then on the device kernel (ops/rescore.py — real jnp program, here
+    # on the CPU backend). The serve/dense split and the served pages must
+    # be BIT-IDENTICAL between the two; what differs is where the rescore
+    # wall time goes (RESCORE_STATS) — the number that decides whether the
+    # escalation ladder still serializes on the host.
+    modes = [m.strip().lower() for m in
+             os.environ.get("ESC_RESCORE", "host,device").split(",")
+             if m.strip()]
+    bad = [m for m in modes if m not in ("host", "device")]
+    if bad:
+        raise SystemExit(f"ESC_RESCORE modes must be host/device, got {bad}")
+    mismatches = 0
     for name, qs, terms_of in streams:
-        outcomes.update({"serve": 0, "escalate": 0, "tie_serve": 0})
-        gaps.clear()
-        before = dict(fastpath.STATS)
-        t0 = time.time()
-        lines = []
-        for i in range(len(qs)):
-            lines.append({"index": "bench"})
-            lines.append({"query": {"match": {"body": " ".join(
-                vocab_strs[t] for t in terms_of(qs[i]))}},
-                "size": 10, "_bench": f"esc-{name}-{i}"})
-        client.msearch(lines)
-        ds = {k: fastpath.STATS[k] - before[k] for k in fastpath.STATS
-              if fastpath.STATS[k] != before[k]}
-        tot = outcomes["serve"] + outcomes["escalate"]
-        print(f"{name}: n={len(qs)} verify_calls={tot} "
-              f"serve={outcomes['serve']} "
-              f"(ties {outcomes['tie_serve']}) "
-              f"escalate={outcomes['escalate']} "
-              f"rate={outcomes['escalate']/max(tot,1):.1%} "
-              f"stats={ds} wall={time.time()-t0:.1f}s", flush=True)
+        per_mode = {}
+        for mode in modes:
+            fastpath.set_rescore_mode(mode)
+            outcomes.update({"serve": 0, "escalate": 0, "tie_serve": 0})
+            gaps.clear()
+            before = dict(fastpath.STATS)
+            before_r = dict(fastpath.RESCORE_STATS)
+            t0 = time.time()
+            lines = []
+            for i in range(len(qs)):
+                lines.append({"index": "bench"})
+                lines.append({"query": {"match": {"body": " ".join(
+                    vocab_strs[t] for t in terms_of(qs[i]))}},
+                    "size": 10, "_bench": f"esc-{name}-{mode}-{i}"})
+            resp = client.msearch(lines)
+            ds = {k: fastpath.STATS[k] - before[k] for k in fastpath.STATS
+                  if fastpath.STATS[k] != before[k]}
+            dr = {k: round(fastpath.RESCORE_STATS[k] - before_r[k], 2)
+                  for k in fastpath.RESCORE_STATS
+                  if fastpath.RESCORE_STATS[k] != before_r[k]}
+            # served-page digest: hit ids + exact score bytes per query
+            digest = [tuple((h["_id"], h["_score"])
+                            for h in r["hits"]["hits"])
+                      for r in resp["responses"]]
+            tot = outcomes["serve"] + outcomes["escalate"]
+            print(f"{name}[rescore={mode}]: n={len(qs)} verify_calls={tot} "
+                  f"serve={outcomes['serve']} "
+                  f"(ties {outcomes['tie_serve']}) "
+                  f"escalate={outcomes['escalate']} "
+                  f"rate={outcomes['escalate']/max(tot,1):.1%} "
+                  f"stats={ds} rescore={dr} "
+                  f"wall={time.time()-t0:.1f}s", flush=True)
+            per_mode[mode] = (ds, digest)
+        fastpath.set_rescore_mode(None)
+        if {"host", "device"} <= set(per_mode):
+            ds_h, dig_h = per_mode["host"]
+            ds_d, dig_d = per_mode["device"]
+            split_keys = ("pruned_served", "pruned_rescued",
+                          "pruned_rescued2", "pruned_dview",
+                          "pruned_escalated")
+            split_h = {k: ds_h.get(k, 0) for k in split_keys}
+            split_d = {k: ds_d.get(k, 0) for k in split_keys}
+            same = split_h == split_d and dig_h == dig_d
+            mismatches += 0 if same else 1
+            print(f"{name}: host/device serve-dense split "
+                  f"{'IDENTICAL' if same else 'MISMATCH'} "
+                  f"host={split_h} device={split_d} "
+                  f"pages_equal={dig_h == dig_d}", flush=True)
+    if mismatches:
+        raise SystemExit(f"{mismatches} stream(s) diverged between host "
+                         f"and device rescore")
 
 
 if __name__ == "__main__":

@@ -172,6 +172,69 @@ def test_percentiles(searcher):
     assert r["p"]["values"]["100.0"] == pytest.approx(60.0, rel=0.02)
 
 
+def test_percentile_ranks(searcher):
+    # numpy parity: percentage of observations <= v, within the DDSketch
+    # bin resolution (the agg inverts the percentiles sketch)
+    r = agg(searcher, {"pr": {"percentile_ranks": {
+        "field": "price", "values": [25.0, 50.0, 60.0]}}})
+    prices = np.array([10.0, 20.0, 30.0, 40.0, 50.0, 60.0])
+    for v in (25.0, 50.0, 60.0):
+        exp = float((prices <= v).mean() * 100.0)
+        assert r["pr"]["values"][f"{v:.1f}"] == pytest.approx(exp, abs=1.0)
+    # full-precision keys: distinct sub-0.05 values must not collide
+    r = agg(searcher, {"pr": {"percentile_ranks": {
+        "field": "price", "values": [0.01, 0.04]}}})
+    assert set(r["pr"]["values"]) == {"0.01", "0.04"}
+
+
+def test_percentile_ranks_respects_query(searcher):
+    r = agg(searcher, {"pr": {"percentile_ranks": {
+        "field": "price", "values": [35.0]}}},
+        query={"term": {"cat": "b"}})
+    # b-docs: prices 30, 40, 60 -> one of three <= 35
+    assert r["pr"]["values"]["35.0"] == pytest.approx(100.0 / 3.0, abs=1.0)
+
+
+def test_percentile_ranks_round_trips_percentiles(searcher):
+    # rank(percentile(p)) == p within one sketch bin: the two aggs invert
+    # each other over the SAME histogram
+    p = agg(searcher, {"p": {"percentiles": {"field": "price",
+                                             "percents": [50.0]}}})
+    v = p["p"]["values"]["50.0"]
+    r = agg(searcher, {"pr": {"percentile_ranks": {"field": "price",
+                                                   "values": [v]}}})
+    assert r["pr"]["values"][str(float(v))] == pytest.approx(50.0, abs=1.0)
+
+
+def test_ddsketch_bin_matches_device_hist():
+    # the host inversion must land every value in the SAME bin the device
+    # hist puts it in (f32 arithmetic throughout — an f64 intermediate
+    # shifts boundary values like 391.537 one bin off)
+    import jax.numpy as jnp
+
+    from opensearch_tpu.ops import aggs as agg_ops
+
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        np.float32(10.0) ** rng.uniform(-8, 8, 200).astype(np.float32),
+        -(np.float32(10.0) ** rng.uniform(-8, 8, 60).astype(np.float32)),
+        np.asarray([0.0, 391.537, -391.537, 1e-12, 1e12], np.float32)])
+    present = jnp.asarray([True])
+    match = jnp.asarray([1.0], jnp.float32)
+    for v in vals:
+        hist = np.asarray(agg_ops.ddsketch_hist(
+            jnp.asarray([v], jnp.float32), present, match))
+        assert int(np.argmax(hist)) == agg_ops.ddsketch_bin(float(v)), v
+
+
+def test_percentile_ranks_no_matches(searcher):
+    # same empty-result convention as percentiles ({} — _empty_result)
+    r = agg(searcher, {"pr": {"percentile_ranks": {
+        "field": "price", "values": [10.0]}}},
+        query={"term": {"cat": "nope"}})
+    assert r["pr"]["values"] == {}
+
+
 def test_pipeline_aggs(searcher):
     r = agg(searcher, {"m": {"date_histogram": {"field": "ts",
                                                 "calendar_interval": "month"},

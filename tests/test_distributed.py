@@ -487,17 +487,33 @@ class TestMeshService:
             {"match": {"body": "alpha"}}, {"match": {"body": "beta"}}]}},
             "size": 5}
         before = cm.node.mesh_service.fallbacks
+        s0 = cm.node.mesh_service.fallback_shapes.get("query_shape", 0)
         rm = cm.search(index="idx", body=body)
         rh = ch.search(index="idx", body=body)
         assert cm.node.mesh_service.fallbacks > before
+        # the decline is attributed to its site (dis_max is not an
+        # eligible shape), not just a flat total
+        assert cm.node.mesh_service.fallback_shapes["query_shape"] > s0
         assert rm["hits"]["total"] == rh["hits"]["total"]
         assert [h["_id"] for h in rm["hits"]["hits"]] == \
             [h["_id"] for h in rh["hits"]["hits"]]
 
     def test_mesh_stats_exposed(self, clients):
         cm, _ = clients
+        cm.search(index="idx", body={"query": {"match": {"body": "alpha"}},
+                                     "size": 5})
         st = cm.node.stats()
         assert st["mesh"]["dispatched"] >= 1
+        # per-shape decline counters reconcile with the flat total, so a
+        # MESH_SHARE measurement can see WHICH shapes host-looped
+        shapes = st["mesh"]["fallback_shapes"]
+        assert sum(shapes.values()) == st["mesh"]["fallbacks"]
+        # the _nodes/stats API carries the same mesh block plus the
+        # phase-2 rescore instrumentation
+        from opensearch_tpu.search import fastpath
+        ns = next(iter(cm.nodes_stats()["nodes"].values()))
+        assert ns["mesh"]["fallback_shapes"] == shapes
+        assert set(ns["fastpath_rescore"]) == set(fastpath.RESCORE_STATS)
 
     @pytest.mark.parametrize("body", [
         # filter-context terms query: constant score over the mesh
@@ -846,10 +862,13 @@ class TestMeshBucketAggs:
         {"p": {"percentiles": {"field": "num"}}},
         {"p": {"percentiles": {"field": "num",
                                "percents": [50.0, 90.0]}}},
+        {"p": {"percentile_ranks": {"field": "num",
+                                    "values": [100.0, 250.0]}}},
         {"m": {"median_absolute_deviation": {"field": "num"}}},
         {"w": {"weighted_avg": {"value": {"field": "num"},
                                 "weight": {"field": "num"}}}},
         {"p": {"percentiles": {"field": "num"}},
+         "r": {"percentile_ranks": {"field": "num", "values": [200.0]}},
          "m": {"median_absolute_deviation": {"field": "num"}},
          "c": {"cardinality": {"field": "status"}}},
     ])

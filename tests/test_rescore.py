@@ -1,0 +1,225 @@
+"""Device-side phase-2 rescore (ops/rescore.py) — host/device parity on the
+CPU backend. The escalation ladder's middle rung (candidate-union exact
+rescore) can run as a batched jit launch over the aligned postings buffers;
+these tests pin it BIT-FOR-BIT against the host numpy oracle
+(`fastpath._exact_rescore`): exact f32 scores, match counts, and the
+serve/escalate decisions they feed (`_tie_serves`/theta32 semantics depend
+on exact f32 equality, so allclose is not enough)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.ops.pallas_bm25 import (DL_BITS, INT_SENTINEL, LANES,
+                                            align_csr_rows)
+from opensearch_tpu.ops.rescore import (exact_rescore_batch,
+                                        host_exact_rescore_batch)
+from opensearch_tpu.search import compiler as C
+from opensearch_tpu.search import fastpath
+from opensearch_tpu.search import query_dsl as dsl
+from opensearch_tpu.search.executor import ShardSearcher
+from tests.test_pruned import sim_fused_bm25_topk_tfdl
+
+
+class TestKernelParity:
+    """exact_rescore_batch vs the numpy mirror on raw padded operands."""
+
+    def _mk(self, rng, nterms=6, maxdf=800, ndocs=4000):
+        starts = [0]
+        docs, tfdl = [], []
+        for _ in range(nterms):
+            df = int(rng.integers(1, maxdf))
+            ids = np.sort(rng.choice(ndocs, size=df, replace=False))
+            tf = rng.integers(1, 30, df)
+            dl = rng.integers(1, 500, df)
+            docs.append(ids.astype(np.int32))
+            tfdl.append(((tf.astype(np.int64) << DL_BITS)
+                         | dl).astype(np.int32))
+            starts.append(starts[-1] + df)
+        a_starts, a_docs, a_tfdl = align_csr_rows(
+            np.asarray(starts, np.int64), np.concatenate(docs),
+            np.concatenate(tfdl), margin=1024, alignment=LANES)
+        return a_starts, a_docs, a_tfdl, nterms
+
+    @pytest.mark.parametrize("seed", [3, 17])
+    def test_bitwise_equal(self, seed):
+        rng = np.random.default_rng(seed)
+        a_starts, a_docs, a_tfdl, nterms = self._mk(rng)
+        T, CC, QB = 4, 256, 4
+        starts = np.zeros((QB, T), np.int32)
+        lens = np.zeros((QB, T), np.int32)
+        weights = np.zeros((QB, T), np.float32)
+        avgdl = np.zeros((QB, 1), np.float32)
+        cand = np.full((QB, CC), INT_SENTINEL, np.int32)
+        for q in range(QB):
+            for t in range(T):
+                if rng.random() < 0.2:
+                    continue          # absent slot (lens stays 0)
+                r = int(rng.integers(0, nterms))
+                a, b = int(a_starts[r]), int(a_starts[r + 1])
+                # true window length = non-sentinel prefix of the aligned row
+                starts[q, t] = a
+                lens[q, t] = int(np.sum(a_docs[a:b] != INT_SENTINEL))
+                weights[q, t] = np.float32(rng.uniform(0.1, 4.0))
+            avgdl[q, 0] = np.float32(rng.uniform(1.0, 300.0))
+            n = int(rng.integers(1, CC))
+            cand[q, :n] = np.sort(rng.choice(4000, size=n, replace=False))
+        for k1, b in ((1.2, 0.75), (0.9, 0.0)):
+            dx, dc = exact_rescore_batch(
+                jnp.asarray(a_docs), jnp.asarray(a_tfdl), starts, lens,
+                weights, avgdl, cand, T=T, C=CC, k1=k1, b=b)
+            hx, hc = host_exact_rescore_batch(
+                a_docs, a_tfdl, starts, lens, weights, avgdl, cand,
+                k1=k1, b=b)
+            assert np.asarray(dx).tobytes() == hx.tobytes()
+            assert (np.asarray(dc) == hc).all()
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(11)
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    eng = Engine(m)
+    for i in range(5000):
+        parts = []
+        if rng.random() < 0.7:
+            parts.extend(["common"] * int(rng.integers(1, 5)))
+        if rng.random() < 0.5:
+            parts.append("half%d" % int(rng.integers(0, 2)))
+        parts.append(f"rare{int(rng.integers(0, 300))}")
+        parts.extend(f"pad{int(x)}" for x in rng.integers(0, 1000, 3))
+        eng.index_doc(str(i), {"body": " ".join(parts)})
+    eng.refresh()
+    eng.force_merge(1)
+    return eng.segments[0], ShardSearcher(eng).context()
+
+
+@pytest.fixture()
+def small_head(monkeypatch):
+    monkeypatch.setattr(fastpath, "L_HEAD", 64)
+    monkeypatch.setattr(fastpath, "fused_bm25_topk_tfdl",
+                        sim_fused_bm25_topk_tfdl)
+    monkeypatch.setattr(fastpath, "_backend_ok", True)
+
+
+def _spec(ctx, q, window):
+    node = C.rewrite(dsl.parse_query(q), ctx, scoring=True)
+    return fastpath.make_spec(node, [], [], [], None, window, {})
+
+
+QUERIES = [
+    ({"match": {"body": "common half0"}}, 20),
+    ({"match": {"body": "common half1 half0"}}, 25),
+    ({"match": {"body": {"query": "common half0 rare2",
+                         "minimum_should_match": 2}}}, 10),
+    ({"match": {"body": "common"}}, 30),
+]
+
+
+class TestOracleParity:
+    def test_rescore_many_matches_exact_rescore(self, corpus, small_head):
+        """The batched device dispatcher returns EXACTLY what the per-query
+        host oracle returns for the same (vq, candidate-union) jobs."""
+        seg, ctx = corpus
+        seg.__dict__.pop("_fastpath_aligned", None)
+        al = fastpath.get_aligned(seg, "body")
+        pb = seg.postings["body"]
+        prune = [True] * len(QUERIES)
+        lts = []
+        for q, _w in QUERIES:
+            node = C.rewrite(dsl.parse_query(q), ctx, scoring=True)
+            lts.append(node)
+        vq_lists = fastpath._prepare_vqueries(seg, ctx, lts, {}, prune)
+        jobs = []
+        for vqs in vq_lists:
+            vq = vqs[0]
+            cand = fastpath._p2_candidates(vq, pb, al.head_ids.get)
+            assert cand is not None
+            jobs.append((vq, cand))
+        fastpath.set_rescore_mode("device")
+        try:
+            dev = fastpath._rescore_many(seg, jobs)
+        finally:
+            fastpath.set_rescore_mode(None)
+        for (vq, cand), (dx, dc) in zip(jobs, dev):
+            hx, hc = fastpath._exact_rescore(seg, vq, cand)
+            assert dx.tobytes() == hx.tobytes()
+            assert (dc == hc).all()
+
+    def test_serve_decisions_bit_identical(self, corpus, small_head):
+        """End-to-end: the full pruned pipeline produces the same docs,
+        bit-identical f32 scores, totals, and relation whether the middle
+        rung rescores on host or device."""
+        seg, ctx = corpus
+        outs = {}
+        for mode in ("host", "device"):
+            seg.__dict__.pop("_fastpath_aligned", None)
+            fastpath.set_rescore_mode(mode)
+            try:
+                res = []
+                for q, w in QUERIES:
+                    out = fastpath.batch_search(seg, ctx,
+                                                [_spec(ctx, q, w)], w)[0]
+                    assert out is not None
+                    res.append(out)
+            finally:
+                fastpath.set_rescore_mode(None)
+            outs[mode] = res
+        for (q, _w), h, d in zip(QUERIES, outs["host"], outs["device"]):
+            assert list(h["topk_idx"]) == list(d["topk_idx"]), q
+            assert h["topk_scores"].tobytes() == \
+                d["topk_scores"].tobytes(), q
+            assert (h["total"], h["total_rel"]) == \
+                (d["total"], d["total_rel"]), q
+
+    def test_batch_launch_count_and_buckets(self, corpus, small_head):
+        """An msearch-style batch of escalating queries rides FEW device
+        launches (grouped per shape bucket), and candidate counts inside
+        one bucket reuse one cached program."""
+        seg, ctx = corpus
+        seg.__dict__.pop("_fastpath_aligned", None)
+        # same T_pad bucket (2 terms -> T_pad 2) so tier-1 groups
+        batch = [({"match": {"body": "common half0"}}, 20),
+                 ({"match": {"body": "common half1"}}, 20)]
+        specs = [_spec(ctx, q, w) for q, w in batch]
+        before = dict(fastpath.RESCORE_STATS)
+        ci0 = C.build_rescore_program.cache_info()
+        fastpath.set_rescore_mode("device")
+        try:
+            outs = fastpath.batch_search(seg, ctx, specs, 20)
+        finally:
+            fastpath.set_rescore_mode(None)
+        assert all(o is not None for o in outs)
+        dq = fastpath.RESCORE_STATS["device_queries"] \
+            - before["device_queries"]
+        dl = fastpath.RESCORE_STATS["device_launches"] \
+            - before["device_launches"]
+        assert dq >= 2
+        # both tier-1 jobs shared one launch (tier-2 retries add their own)
+        assert dl < dq
+        ci1 = C.build_rescore_program.cache_info()
+        assert ci1.currsize >= ci0.currsize
+        # one more query with a DIFFERENT candidate count in the same
+        # bucket: no new program (canonicalized shape hit)
+        seg.__dict__.pop("_fastpath_aligned", None)
+        fastpath.set_rescore_mode("device")
+        try:
+            fastpath.batch_search(
+                seg, ctx, [_spec(ctx, {"match": {"body": "common half1"}},
+                                 15)], 15)
+        finally:
+            fastpath.set_rescore_mode(None)
+        ci2 = C.build_rescore_program.cache_info()
+        assert ci2.currsize == ci1.currsize
+        assert ci2.hits > ci1.hits
+
+    def test_bucket_canonicalization(self):
+        assert C.rescore_cand_bucket(1) == C.RESCORE_C_MIN
+        assert C.rescore_cand_bucket(C.RESCORE_C_MIN + 1) == \
+            2 * C.RESCORE_C_MIN
+        assert C.rescore_cand_bucket(C.RESCORE_C_MAX) == C.RESCORE_C_MAX
+        assert C.rescore_cand_bucket(C.RESCORE_C_MAX + 1) is None
+        assert C.rescore_cand_bucket(0) is None
