@@ -760,6 +760,18 @@ def main():
     extra["fastpath_per_stream"] = {
         t: {k: v for k, v in d.items() if v}
         for t, d in stream_stats.items() if t != "fwarm"}
+    # registry-sourced per-stage latency percentiles: the p50/p95/p99
+    # trajectory BENCH_*.json carries from now on (end-to-end search,
+    # per-phase, fastpath ladder rungs, jit compile/execute) — every
+    # measured request flowed through the instrumented product path, so
+    # this is the same data `_nodes/stats` would serve
+    from opensearch_tpu.search.compiler import jit_attribution
+    from opensearch_tpu.utils.metrics import METRICS
+    extra["latency_percentiles"] = {
+        stage: snap for stage, snap in METRICS.stage_percentiles().items()
+        if stage.startswith(("search.", "fastpath.", "mesh."))
+        and ".shape." not in stage}
+    extra["jit_attribution"] = jit_attribution()
     extra["bench_wall_s"] = round(time.time() - bench_start, 1)
     result = {
         "metric": "bm25_rest_qps_per_chip",

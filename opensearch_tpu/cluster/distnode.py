@@ -277,18 +277,22 @@ class DistClusterNode:
             self._apply_state(body["state"])
             return 200, {"acknowledged": True}
         if op == "dfs" and method == "POST":
-            return 200, {"rec": _b64(self._local_dfs(body["index"],
-                                                     body["body"]))}
+            with self._rpc_span("dist.dfs", body) as s:
+                rec = self._local_dfs(body["index"], body["body"])
+            return 200, {"rec": _b64(rec), "span": self._span_out(s)}
         if op == "query_phase" and method == "POST":
-            results = self._local_query(body["index"], body["body"],
-                                        _unb64(body["g"]))
-            return 200, {"results": _b64(results)}
+            with self._rpc_span("dist.query_phase", body) as s:
+                results = self._local_query(body["index"], body["body"],
+                                            _unb64(body["g"]))
+            return 200, {"results": _b64(results),
+                         "span": self._span_out(s)}
         if op == "fetch_phase" and method == "POST":
-            hits = self._local_fetch(body["index"], body["body"],
-                                     int(body["shard"]),
-                                     _unb64(body["cands"]),
-                                     _unb64(body["g"]))
-            return 200, {"hits": _b64(hits)}
+            with self._rpc_span("dist.fetch_phase", body) as s:
+                hits = self._local_fetch(body["index"], body["body"],
+                                         int(body["shard"]),
+                                         _unb64(body["cands"]),
+                                         _unb64(body["g"]))
+            return 200, {"hits": _b64(hits), "span": self._span_out(s)}
         if op == "state" and method == "GET":
             return 200, {"state": self._state()}
         if op == "create_index" and method == "POST":
@@ -300,6 +304,50 @@ class DistClusterNode:
             return 200, self.search(body["index"], body["body"])
         return 404, {"error": {"type": "resource_not_found_exception",
                                "reason": f"unknown internal op [{op}]"}}
+
+    # ---------------- trace propagation over the wire ----------------
+    #
+    # The coordinator stamps every /_internal RPC payload with its trace
+    # context (`trace_ctx`); the serving node runs the local phase under a
+    # span carrying that context and RETURNS the finished span tree in
+    # the response, which the coordinator grafts under its own phase span
+    # (`TRACER.attach_remote`) — so one distributed search reads as ONE
+    # coherent parent-child trace on the coordinating node, while each
+    # member's ring still holds its local half, attributable via the
+    # stamped parent ids.
+
+    def _rpc_span(self, name: str, body: dict):
+        from ..utils.trace import TRACER
+        tctx = body.get("trace_ctx") or {}
+        return TRACER.span(name, node=self.name,
+                           **{k: tctx[k] for k in
+                              ("trace_root_id", "parent_span_id",
+                               "coordinator") if k in tctx})
+
+    @staticmethod
+    def _span_out(s) -> Optional[dict]:
+        return s.to_dict() if s is not None else None
+
+    def _rpc(self, member: str, op: str, payload: dict) -> dict:
+        """Coordinator-side RPC with trace stamping + span grafting +
+        latency accounting."""
+        from ..utils.metrics import METRICS
+        from ..utils.trace import TRACER
+        wctx = TRACER.wire_context()
+        if wctx is not None:
+            payload = dict(payload,
+                           trace_ctx=dict(wctx, coordinator=self.name))
+        t0 = time.monotonic()
+        try:
+            r = _http(self.members[member], "POST", f"/_internal/{op}",
+                      payload)
+        except Exception:
+            METRICS.counter("dist.rpc.failed").inc()
+            raise
+        METRICS.histogram(f"dist.rpc.{op}").record(
+            (time.monotonic() - t0) * 1000.0)
+        TRACER.attach_remote(r.get("span"))
+        return r
 
     # ---------------- cluster API ----------------
 
@@ -450,7 +498,17 @@ class DistClusterNode:
 
     def search(self, index: str, body: dict) -> dict:
         """Distributed DFS_QUERY_THEN_FETCH across every member, reduced
-        once on this node."""
+        once on this node. The whole scatter/gather runs under ONE root
+        span; every remote leg's span tree comes back on the RPC response
+        and nests under the coordinator's phase span."""
+        from ..utils.trace import TRACER
+        with TRACER.span("dist.search", index=index,
+                         coordinator=self.name):
+            return self._search_traced(index, body)
+
+    def _search_traced(self, index: str, body: dict) -> dict:
+        from ..utils.metrics import METRICS
+        from ..utils.trace import TRACER
         t0 = time.monotonic()
         agg_nodes = self._check_supported(body)
         svc = self.node.indices.get(index)
@@ -464,38 +522,42 @@ class DistClusterNode:
                                  if n != self.name})
 
         # --- phase 1: DFS (collection statistics from every node)
-        parts = [self._local_dfs(index, body)]
-        if parts[0].get("named"):
-            raise ApiError(400, "illegal_argument_exception",
-                           "named queries (_name) are not supported on a "
-                           "distributed index")
         dead: List[str] = []
-        for m in remote_members:
-            try:
-                r = _http(self.members[m], "POST", "/_internal/dfs",
-                          {"index": index, "body": body})
-                parts.append(_unb64(r["rec"]))
-            except (urllib.error.URLError, OSError, KeyError):
-                dead.append(m)
+        with TRACER.span("dist.dfs", nodes=1 + len(remote_members)), \
+                METRICS.timer("dist.dfs"):
+            parts = [self._local_dfs(index, body)]
+            if parts[0].get("named"):
+                raise ApiError(400, "illegal_argument_exception",
+                               "named queries (_name) are not supported "
+                               "on a distributed index")
+            for m in remote_members:
+                try:
+                    r = self._rpc(m, "dfs", {"index": index, "body": body})
+                    parts.append(_unb64(r["rec"]))
+                except (urllib.error.URLError, OSError, KeyError):
+                    dead.append(m)
         g = _merge_dfs(parts)
 
         # --- phase 2: QUERY everywhere with pinned global stats
-        results = self._local_query(index, body, g)
         remote_results: Dict[int, ShardQueryResult] = {}
-        for m in remote_members:
-            if m in dead:
-                continue
-            try:
-                r = _http(self.members[m], "POST", "/_internal/query_phase",
-                          {"index": index, "body": body, "g": _b64(g)})
-                for sr in _unb64(r["results"]):
-                    # only the owner's copy of a shard carries data; the
-                    # coordinator keeps the owned legs and drops empty
-                    # non-owned duplicates
-                    if owners.get(sr.shard) == m:
-                        remote_results[sr.shard] = sr
-            except (urllib.error.URLError, OSError, KeyError):
-                dead.append(m)
+        with TRACER.span("dist.query", nodes=1 + len(remote_members)), \
+                METRICS.timer("dist.query"):
+            results = self._local_query(index, body, g)
+            for m in remote_members:
+                if m in dead:
+                    continue
+                try:
+                    r = self._rpc(m, "query_phase",
+                                  {"index": index, "body": body,
+                                   "g": _b64(g)})
+                    for sr in _unb64(r["results"]):
+                        # only the owner's copy of a shard carries data;
+                        # the coordinator keeps the owned legs and drops
+                        # empty non-owned duplicates
+                        if owners.get(sr.shard) == m:
+                            remote_results[sr.shard] = sr
+                except (urllib.error.URLError, OSError, KeyError):
+                    dead.append(m)
         merged: List[ShardQueryResult] = []
         failed_shards = []
         for s in range(n_shards):
@@ -507,41 +569,47 @@ class DistClusterNode:
             else:
                 failed_shards.append((s, owner))
 
-        reduced = reduce_shard_results(merged, body, agg_nodes=agg_nodes)
+        with TRACER.span("dist.reduce", shards=len(merged)):
+            reduced = reduce_shard_results(merged, body,
+                                           agg_nodes=agg_nodes)
 
         # --- phase 3: FETCH winners from their owning nodes
         by_shard: Dict[int, List[Candidate]] = {}
         for c in reduced["selected"]:
             by_shard.setdefault(c.shard, []).append(c)
         hits_by_key: Dict[Tuple, dict] = {}
-        for s_id, sel in by_shard.items():
-            owner = owners.get(s_id, self.name)
-            if owner == self.name:
-                sr = self.node.indices[index].searchers[s_id]
-                segs = (list(sr.replica.segments) if sr.replica is not None
-                        else list(sr.engine.segments))
-                res = ShardQueryResult(shard=s_id, segments=segs)
-                fetched = sr.fetch_phase(res, sel, dict(body),
-                                         stats_ctx=self._global_ctx(index,
-                                                                    g))
-            else:
-                cands = [(c.seg_ord, c.local_doc, c.score,
-                          list(c.sort_values), list(c.raw_sort_values))
-                         for c in sel]
-                try:
-                    r = _http(self.members[owner], "POST",
-                              "/_internal/fetch_phase",
-                              {"index": index, "body": body, "shard": s_id,
-                               "cands": _b64(cands), "g": _b64(g)})
-                    fetched = _unb64(r["hits"])
-                except (urllib.error.URLError, OSError, KeyError):
-                    # the owner died BETWEEN query and fetch: this shard's
-                    # winners can no longer be hydrated — report the shard
-                    # failed instead of silently returning fewer hits
-                    failed_shards.append((s_id, owner))
-                    fetched = []
-            for c, h in zip(sel, fetched):
-                hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
+        with TRACER.span("dist.fetch", shards=len(by_shard)), \
+                METRICS.timer("dist.fetch"):
+            for s_id, sel in by_shard.items():
+                owner = owners.get(s_id, self.name)
+                if owner == self.name:
+                    sr = self.node.indices[index].searchers[s_id]
+                    segs = (list(sr.replica.segments)
+                            if sr.replica is not None
+                            else list(sr.engine.segments))
+                    res = ShardQueryResult(shard=s_id, segments=segs)
+                    fetched = sr.fetch_phase(
+                        res, sel, dict(body),
+                        stats_ctx=self._global_ctx(index, g))
+                else:
+                    cands = [(c.seg_ord, c.local_doc, c.score,
+                              list(c.sort_values), list(c.raw_sort_values))
+                             for c in sel]
+                    try:
+                        r = self._rpc(owner, "fetch_phase",
+                                      {"index": index, "body": body,
+                                       "shard": s_id, "cands": _b64(cands),
+                                       "g": _b64(g)})
+                        fetched = _unb64(r["hits"])
+                    except (urllib.error.URLError, OSError, KeyError):
+                        # the owner died BETWEEN query and fetch: this
+                        # shard's winners can no longer be hydrated —
+                        # report the shard failed instead of silently
+                        # returning fewer hits
+                        failed_shards.append((s_id, owner))
+                        fetched = []
+                for c, h in zip(sel, fetched):
+                    hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
         hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)]
                 for c in reduced["selected"]
                 if (c.shard, c.seg_ord, c.local_doc) in hits_by_key]

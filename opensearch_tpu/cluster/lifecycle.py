@@ -108,7 +108,7 @@ class LifecycleService:
         policy = self._policy_for(meta)
         return {"index": index, "managed": policy is not None,
                 "policy": policy,
-                "age_seconds": time.time() - meta.creation_date}
+                "age_seconds": time.time() - meta.creation_date}  # oslint: disable=OSL501 -- age vs PERSISTED wall-clock creation epoch; monotonic cannot span restarts
 
     def check_conditions(self, index: str, conds: dict,
                          now: Optional[float] = None) -> dict:

@@ -373,7 +373,8 @@ class Node:
                                                        self.thread_pools)
         self.persistent_tasks.register_executor("reindex",
                                                 self._persistent_reindex)
-        self.start_time = time.time()
+        self.start_time = time.time()          # wall clock, display only
+        self._start_mono = time.monotonic()    # durations (uptime)
         if data_path:
             os.makedirs(data_path, exist_ok=True)
             self._recover_indices()
@@ -954,10 +955,17 @@ class Node:
         task = self.tasks.register("indices:data/read/search",
                                    f"indices[{expression}]")
         t0 = time.monotonic()
+        # ladder-rung attribution for the slowlog: which fastpath rungs
+        # this request exercised. A STATS delta over the request window
+        # (best-effort under concurrency — concurrent searches smear into
+        # each other's windows; the trace span carries the exact story)
+        from ..search import fastpath as _fp
+        rungs_before = dict(_fp.STATS)
+        root_span = None
         try:
             with self.tracer.span("indices:data/read/search",
                                   index=expression,
-                                  shards=len(searchers)):
+                                  shards=len(searchers)) as root_span:
                 resp = None
                 if (len(names) == 1 and not remote_parts
                         and phase_hook is None
@@ -986,11 +994,23 @@ class Node:
         finally:
             self.tasks.unregister(task)
         took = time.monotonic() - t0
+
+        def _slow_extra(_span=root_span, _before=rungs_before):
+            # built only when a slowlog threshold fires: rung deltas say
+            # WHICH escalation path burned the time, the root span says
+            # WHERE inside the request it went
+            rungs = {k: _fp.STATS[k] - _before.get(k, 0) for k in _before
+                     if _fp.STATS[k] != _before.get(k, 0)}
+            return {"fastpath_rungs": rungs,
+                    "rescore_path": _fp.rescore_mode(),
+                    **({"trace": _span.to_dict()}
+                       if _span is not None else {})}
+
         self.op_counters["search_total"] += 1
         self.op_counters["search_time_ms"] += took * 1000.0
         for name in names:
-            self.indices[name].search_slowlog.maybe_log(took,
-                                                        body.get("query"))
+            self.indices[name].search_slowlog.maybe_log(
+                took, body.get("query"), extra=_slow_extra)
         if len(names) == 1 and not remote_parts:
             for h in resp["hits"]["hits"]:
                 h["_index"] = names[0]
@@ -1053,7 +1073,8 @@ class Node:
             "wlm": self.wlm.stats(),
             "search_backpressure": self.search_backpressure.stats(),
             "persistent_tasks": self.persistent_tasks.stats(),
-            "uptime_in_millis": int((time.time() - self.start_time) * 1000),
+            "uptime_in_millis": int((time.monotonic() - self._start_mono)
+                                    * 1000),
         }
         if self.mesh_service is not None:
             out["mesh"] = self.mesh_service.stats()

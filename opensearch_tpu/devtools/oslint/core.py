@@ -127,8 +127,10 @@ def default_checkers() -> List[Checker]:
     from .dtype_rules import DtypeDisciplineChecker
     from .jit_rules import JitBoundaryChecker
     from .lock_rules import LockDisciplineChecker
+    from .telemetry_rules import TelemetryDisciplineChecker
     return [DtypeDisciplineChecker(), JitBoundaryChecker(),
-            BreakerDisciplineChecker(), LockDisciplineChecker()]
+            BreakerDisciplineChecker(), LockDisciplineChecker(),
+            TelemetryDisciplineChecker()]
 
 
 def run_source(src: str, path: str,

@@ -1,0 +1,147 @@
+"""OSL5xx — telemetry discipline.
+
+The unified-telemetry PR made two measurement invariants load-bearing;
+this family keeps them true as the codebase grows:
+
+- OSL501: durations inside `opensearch_tpu/` must come from a monotonic
+  clock (`time.monotonic()` / `time.perf_counter()`), never `time.time()`.
+  Wall clocks step under NTP slew and make latency histograms lie.
+  Detected structurally: a SUBTRACTION whose operand is a `time.time()`
+  call, or a local name assigned from one in the same scope. Plain
+  `time.time()` timestamps (slowlog entries, snapshot metadata, expiry
+  comparisons) stay legal — an absolute epoch is the only correct value
+  for cross-restart persistence; only differencing it is the bug.
+  Subtracting against a PERSISTED wall-clock epoch (index creation date)
+  is the one legitimate exception: justify it inline
+  (`# oslint: disable=OSL501 -- <why>`).
+- OSL502: hot-path counters (search/, ops/, parallel/) must go through
+  the metrics registry (`utils/metrics.py`: Counter.inc / CounterGroup),
+  not a module-level dict mutated with `+=` — the read-modify-write
+  races concurrent searches and silently drops counts, exactly the
+  `fastpath.STATS` bug this PR retired. Detected: `D[k] += n` where `D`
+  is a module-level ALL_CAPS name bound to a dict literal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+
+class TelemetryDisciplineChecker(Checker):
+    rules = ("OSL501", "OSL502")
+    name = "telemetry-discipline"
+
+    OSL502_SCOPES = ("search/", "ops/", "parallel/")
+
+    def applies(self, path: str) -> bool:
+        return True
+
+    # ---------------- helpers ----------------
+
+    @staticmethod
+    def _time_aliases(tree: ast.Module):
+        """-> (module aliases of `time`, direct callables that ARE
+        time.time, e.g. `from time import time as now`)."""
+        mods: Set[str] = set()
+        funcs: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        mods.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        funcs.add(a.asname or "time")
+        return mods, funcs
+
+    def _is_walltime_call(self, node: ast.AST, mods: Set[str],
+                          funcs: Set[str]) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d = _dotted(node.func)
+        if d in funcs:
+            return True
+        head, _, tail = d.rpartition(".")
+        return tail == "time" and head in mods
+
+    # ---------------- check ----------------
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        mods, funcs = self._time_aliases(tree)
+
+        # ---- OSL501: wall-clock subtraction = duration smell ----
+        if mods or funcs:
+            # scopes: module body + each function body (nested functions
+            # inherit the enclosing taint set — a closure differencing
+            # its enclosing scope's t0 is the same bug)
+            def scan(body, tainted: Set[str], sym_default: str) -> None:
+                local = set(tainted)
+
+                def expr_tainted(e: ast.AST) -> bool:
+                    if self._is_walltime_call(e, mods, funcs):
+                        return True
+                    return isinstance(e, ast.Name) and e.id in local
+
+                def visit(node: ast.AST) -> None:
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        scan(node.body, local, qmap.get(node, node.name))
+                        return
+                    if isinstance(node, ast.Assign) and \
+                            self._is_walltime_call(node.value, mods, funcs):
+                        for t in node.targets:
+                            if isinstance(t, ast.Name):
+                                local.add(t.id)
+                    if isinstance(node, ast.BinOp) and \
+                            isinstance(node.op, ast.Sub) and \
+                            (expr_tainted(node.left)
+                             or expr_tainted(node.right)):
+                        findings.append(Finding(
+                            "OSL501", path, node.lineno, node.col_offset,
+                            qmap.get(node, sym_default),
+                            "duration computed from time.time(); use "
+                            "time.monotonic()/perf_counter() — wall "
+                            "clocks step and make latency numbers lie",
+                            detail="walltime-sub"))
+                    for child in ast.iter_child_nodes(node):
+                        visit(child)
+
+                for stmt in body:
+                    visit(stmt)
+
+            scan(list(tree.body), set(), "")
+
+        # ---- OSL502: module-level CAPS counter dict mutated with += ----
+        if any(s in path for s in self.OSL502_SCOPES):
+            counter_dicts: Set[str] = set()
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Dict):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id.isupper() \
+                                and len(t.id) > 1:
+                            counter_dicts.add(t.id)
+            if counter_dicts:
+                for node in ast.walk(tree):
+                    if isinstance(node, ast.AugAssign) and \
+                            isinstance(node.target, ast.Subscript) and \
+                            isinstance(node.target.value, ast.Name) and \
+                            node.target.value.id in counter_dicts:
+                        dn = node.target.value.id
+                        findings.append(Finding(
+                            "OSL502", path, node.lineno, node.col_offset,
+                            qmap.get(node, ""),
+                            f"hot-path counter dict `{dn}` mutated with "
+                            "`+=` (read-modify-write races concurrent "
+                            "searches); route it through the metrics "
+                            "registry (utils/metrics.py CounterGroup/"
+                            "Counter.inc)",
+                            detail=f"dict:{dn}"))
+        return findings

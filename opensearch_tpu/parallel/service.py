@@ -25,6 +25,8 @@ import numpy as np
 from ..index.segment import next_pow2
 from ..search.compiler import (coerce_agg_ranges, grid_agg_precision,
                                hist_agg_interval, range_agg_spec)
+from ..utils.metrics import METRICS
+from ..utils.trace import TRACER
 from .spmd import (INT32_SENTINEL, StackedPhrasePairs, StackedShardIndex,
                    build_distributed_bincount,
                    build_distributed_cardinality,
@@ -147,6 +149,11 @@ class MeshSearchService:
     def _fall(self, shape: str, n: int = 1) -> None:
         self.fallbacks += n
         self.fallback_shapes[shape] = self.fallback_shapes.get(shape, 0) + n
+        # registry mirror: every decline site attributed by shape, so the
+        # Prometheus exposition carries the same why-did-it-host-loop
+        # breakdown _nodes/stats does
+        METRICS.counter("mesh.fallbacks").inc(n)
+        METRICS.counter(f"mesh.fallback.{shape}").inc(n)
 
     # ---------------- caches ----------------
 
@@ -908,14 +915,18 @@ class MeshSearchService:
                                k_class, fkey), []).append(item)
         for (is_phrase, nt_key, field, k1, b_eff, k_class,
              _fkey), items in groups.items():
-            if is_phrase:
-                self._run_phrase_group(name, svc, bodies, out, shard_segs,
-                                       stats, searchers, field, nt_key, k1,
-                                       b_eff, k_class, items)
-            else:
-                self._run_mesh_group(name, svc, bodies, out, shard_segs,
-                                     stats, searchers, field, k1, b_eff,
-                                     k_class, items)
+            with TRACER.span("mesh.dispatch_group", field=field,
+                             k_class=k_class, queries=len(items),
+                             phrase=is_phrase):
+                if is_phrase:
+                    self._run_phrase_group(name, svc, bodies, out,
+                                           shard_segs, stats, searchers,
+                                           field, nt_key, k1, b_eff,
+                                           k_class, items)
+                else:
+                    self._run_mesh_group(name, svc, bodies, out, shard_segs,
+                                         stats, searchers, field, k1, b_eff,
+                                         k_class, items)
         return self._mark_declined(bodies, out)
 
     def _mark_declined(self, bodies, out) -> list:
@@ -1629,6 +1640,9 @@ class MeshSearchService:
             for r in results:
                 r.took_ms = (time.monotonic() - t0) * 1000.0
             self.dispatched += 1
+            METRICS.counter("mesh.dispatched").inc()
+            METRICS.histogram("mesh.dispatch").record(
+                (time.monotonic() - t0) * 1000.0)
             if phrase:
                 self.phrase_dispatched += 1
             if _fk is not None:

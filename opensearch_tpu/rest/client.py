@@ -878,11 +878,23 @@ class RestClient:
             # where the phase-2 candidate-union rescore ran and what it
             # cost (host numpy fallback vs batched device launches)
             "fastpath_rescore": _fastpath.rescore_stats(),
+            # unified telemetry (utils/metrics.py): per-stage latency
+            # percentiles for every instrumented stage (search phases,
+            # fastpath ladder rungs, mesh dispatch, distnode RPCs) and
+            # the jit program-cache / compile-vs-execute attribution
+            "telemetry": self._telemetry_block(),
         }
         if n.mesh_service is not None:
             node_block["mesh"] = n.mesh_service.stats()
         return {"cluster_name": n.metadata.cluster_name,
                 "nodes": {n.node_name: node_block}}
+
+    @staticmethod
+    def _telemetry_block() -> dict:
+        from ..search import compiler as _compiler
+        from ..utils.metrics import METRICS
+        return {"stages": METRICS.stage_percentiles(),
+                "jit": _compiler.jit_attribution()}
 
     def get_traces(self, limit: int = 20) -> dict:
         """Recent completed request traces (reference telemetry in-memory

@@ -40,7 +40,8 @@ def _truthy(v) -> bool:
 
 
 # routes any authenticated principal may hit (cluster "monitor" class)
-_MONITOR_HEADS = {"", "_cluster", "_nodes", "_cat", "_stats", "_tasks"}
+_MONITOR_HEADS = {"", "_cluster", "_nodes", "_cat", "_stats", "_tasks",
+                  "_metrics"}
 # cluster-admin routes
 _ADMIN_HEADS = {"_index_template", "_template", "_remotestore", "_snapshot",
                 "_ingest", "_scripts", "_search_pipeline", "_data_stream",
@@ -143,6 +144,9 @@ class _Handler(BaseHTTPRequestHandler):
         if isinstance(payload, (dict, list)):
             data = json.dumps(payload).encode("utf-8")
         else:
+            # plain-text payloads (_cat tables, /_metrics Prometheus
+            # exposition) must not claim to be JSON
+            content_type = "text/plain; charset=utf-8"
             data = str(payload).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", content_type)
@@ -393,6 +397,13 @@ class _Handler(BaseHTTPRequestHandler):
                            f"unsupported _cluster route {parts}")
         if head == "_nodes":
             return 200, c.nodes_stats()
+        if head == "_metrics":
+            # Prometheus text exposition of the unified metrics registry
+            # (utils/metrics.py): counters, gauges, and latency-histogram
+            # summaries — the scrape surface of the same data
+            # `_nodes/stats` serves as JSON
+            from ..utils.metrics import METRICS, render_prometheus
+            return 200, render_prometheus(METRICS)
         if head == "_cat":
             kind = parts[1] if len(parts) > 1 else "indices"
             fn = getattr(c.cat, kind, None)

@@ -22,9 +22,10 @@ from __future__ import annotations
 
 import fnmatch as _fnmatch
 import re
+import time as _time_mod
 from bisect import bisect_left, bisect_right
 from dataclasses import dataclass, field as dc_field
-from functools import lru_cache
+from functools import lru_cache, wraps
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -42,6 +43,122 @@ from .aggregations import AggNode
 
 INT32_SENTINEL = np.int32(2**31 - 1)
 HLL_LOG2M = 14
+
+# ---------------------------------------------------------------------
+# jit program-cache + compile-vs-execute attribution (utils/metrics.py)
+# ---------------------------------------------------------------------
+#
+# Every jitted program builder in this module is lru_cache'd per
+# canonical spec; the instrumented wrapper mirrors cache traffic into the
+# registry and times the programs themselves. Attribution model: a
+# program's FIRST python-side invocation runs trace + lower + XLA compile
+# inline, so its wall lands in `search.jit.<family>.compile_ms`;
+# steady-state calls land in `.execute_ms` (dispatch wall — XLA execution
+# itself is async, so this is launch cost, not device busy time;
+# RESCORE_STATS carries the synced device walls). Programs whose input
+# shapes vary per segment can recompile on later calls — first-call
+# attribution is the bounded, zero-sync approximation the reference's
+# per-phase breakdowns also make.
+
+_JIT_FAMILIES = ("executor", "mask", "gather", "agg", "rescore", "join")
+
+
+class _TimedProgram:
+    __slots__ = ("_fn", "_family", "_shape", "_compiled")
+
+    def __init__(self, family: str, fn, shape: Optional[str] = None):
+        self._fn = fn
+        self._family = family
+        self._shape = shape
+        self._compiled = False
+
+    def __call__(self, *a, **kw):
+        from ..utils.metrics import METRICS
+        if not METRICS.enabled:
+            return self._fn(*a, **kw)
+        t0 = _time_mod.perf_counter()
+        out = self._fn(*a, **kw)
+        dt = (_time_mod.perf_counter() - t0) * 1e3
+        base = f"search.jit.{self._family}"
+        if not self._compiled:
+            # benign race: two threads can both attribute their first
+            # call as a compile — the histogram stays honest enough and
+            # a lock here would tax every launch
+            self._compiled = True
+            METRICS.histogram(f"{base}.compile_ms").record(dt)
+            if self._shape:
+                METRICS.histogram(
+                    f"{base}.shape.{self._shape}.compile_ms").record(dt)
+        else:
+            METRICS.counter(f"{base}.launches").inc()
+            METRICS.histogram(f"{base}.execute_ms").record(dt)
+            if self._shape:
+                METRICS.histogram(
+                    f"{base}.shape.{self._shape}.execute_ms").record(dt)
+        return out
+
+
+def _instrumented_program_cache(family: str, maxsize: int,
+                                shape_of: Optional[Callable] = None):
+    """lru_cache a program builder with registry attribution: requests
+    and misses count per family (hits = requests - misses), and the built
+    program is wrapped in `_TimedProgram` for compile-vs-execute walls.
+    `cache_info`/`cache_clear` keep functools semantics — tests ratchet
+    on them."""
+
+    def deco(build):
+        @lru_cache(maxsize=maxsize)
+        def cached(*key):
+            from ..utils.metrics import METRICS
+            if METRICS.enabled:
+                METRICS.counter(f"search.jit.{family}.cache_miss").inc()
+            return _TimedProgram(family, build(*key),
+                                 shape_of(*key) if shape_of else None)
+
+        @wraps(build)
+        def wrapper(*key):
+            # disabled-mode contract: no name formatting / registry lock
+            # on the per-launch hot path when telemetry is off
+            from ..utils.metrics import METRICS
+            if METRICS.enabled:
+                METRICS.counter(f"search.jit.{family}.requests").inc()
+            return cached(*key)
+
+        wrapper.cache_info = cached.cache_info
+        wrapper.cache_clear = cached.cache_clear
+        return wrapper
+
+    return deco
+
+
+def jit_attribution() -> Dict[str, dict]:
+    """Per-family program-cache and compile-vs-execute rollup (consumed
+    by `_nodes/stats` and the enriched `profile` response)."""
+    from ..utils.metrics import METRICS
+    snap = METRICS.snapshot()
+    cnt, hist = snap["counters"], snap["histograms"]
+    out: Dict[str, dict] = {}
+    for fam in _JIT_FAMILIES:
+        base = f"search.jit.{fam}"
+        requests = cnt.get(f"{base}.requests", 0)
+        if not requests:
+            continue
+        misses = cnt.get(f"{base}.cache_miss", 0)
+        comp = hist.get(f"{base}.compile_ms", {})
+        ex = hist.get(f"{base}.execute_ms", {})
+        out[fam] = {
+            "cache": {"requests": requests, "hits": requests - misses,
+                      "misses": misses},
+            "compile": {"count": comp.get("count", 0),
+                        "total_ms": comp.get("sum_ms", 0.0),
+                        "p50_ms": comp.get("p50_ms")},
+            "execute": {"count": ex.get("count", 0),
+                        "total_ms": ex.get("sum_ms", 0.0),
+                        "p50_ms": ex.get("p50_ms"),
+                        "p99_ms": ex.get("p99_ms")},
+        }
+    return out
+
 # reference PercentilesAggregationBuilder defaults — shared with the mesh
 # service so host and mesh never drift
 DEFAULT_PERCENTS = (1.0, 5.0, 25.0, 50.0, 75.0, 95.0, 99.0)
@@ -2235,7 +2352,7 @@ def _prepare_decay(fn, i: int, nid: int, seg: Segment, ctx: ShardContext,
     return ("decay", i, shape, kind, field, field in col_map, fspec)
 
 
-@lru_cache(maxsize=64)
+@_instrumented_program_cache("join", maxsize=64)
 def _build_join_scatter(gsize: int, need: Tuple[str, ...]):
     """Pass-1 kernel: scatter one segment's matched scores into the shard's
     join slot space (padding/unresolved slots are -1 -> sentinel -> dropped)."""
@@ -4728,7 +4845,7 @@ def _purge_masks_for_uid(uid: int) -> None:
             del _FILTER_MASK_CACHE[k]
 
 
-@lru_cache(maxsize=256)
+@_instrumented_program_cache("mask", maxsize=256)
 def _build_mask_executor(spec):
     import jax
 
@@ -4763,7 +4880,9 @@ def rescore_cand_bucket(n: int) -> Optional[int]:
     return min(max(next_pow2(n), RESCORE_C_MIN), RESCORE_C_MAX)
 
 
-@lru_cache(maxsize=64)
+@_instrumented_program_cache(
+    "rescore", maxsize=64,
+    shape_of=lambda T, C, k1, b: f"T{T}xC{C}")
 def build_rescore_program(T: int, C: int, k1: float, b: float):
     """Cached callable for one (term-slot, candidate-bucket, similarity)
     shape of ops/rescore.exact_rescore_batch."""
@@ -4969,7 +5088,7 @@ def prepare_collapse(collapse: Optional[dict], seg: Segment, ctx: ShardContext,
     return ("collapse", field, 2, False)
 
 
-@lru_cache(maxsize=512)
+@_instrumented_program_cache("executor", maxsize=512)
 def _build_executor(full_spec):
     import jax
 
@@ -5039,7 +5158,7 @@ def run_segment(query_spec, sort_spec, agg_specs, named_specs, k_pad: int,
     return exe(seg_arrays, cparams)
 
 
-@lru_cache(maxsize=256)
+@_instrumented_program_cache("gather", maxsize=256)
 def _build_gather_executor(query_spec):
     """Scores of a query at an explicit doc list (rescore second pass,
     reference `search/rescore/QueryRescorer.java`)."""
@@ -5062,7 +5181,7 @@ def run_gather_scores(query_spec, seg_arrays: dict, params: dict, docs: np.ndarr
     return exe(seg_arrays, params)
 
 
-@lru_cache(maxsize=128)
+@_instrumented_program_cache("agg", maxsize=128)
 def _build_agg_executor(key):
     """Aggs-only program (no top-k): the shard-wide sampler re-threshold
     pass re-runs just the agg tree with a global threshold param."""

@@ -786,10 +786,16 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     body = dict(body)
     body["_index_name"] = index_name
     stats = _global_stats_contexts(searchers)
+    from ..utils.metrics import METRICS
     from ..utils.trace import TRACER
+    if body.get("profile"):
+        # jit-attribution baseline: the profile response reports the
+        # DELTA this request caused (compiles triggered, cache traffic)
+        body["_jit_before"] = C.jit_attribution()
     results = []
     for i, s in enumerate(searchers):
-        with TRACER.span("query_phase", shard=i):
+        with TRACER.span("query_phase", shard=i), \
+                METRICS.timer("search.query_phase"):
             results.append(s.query_phase(body, shard_ord=i,
                                          stats_ctx=stats[i], task=task))
     if phase_hook is not None:
@@ -917,15 +923,17 @@ def _finish_search(searchers: List[ShardSearcher],
                    agg_nodes: List[AggNode]) -> dict:
     """Coordinator reduce + fetch + response assembly (the tail of
     query-then-fetch, shared by search and batched msearch)."""
+    from ..utils.metrics import METRICS
     from ..utils.trace import TRACER
-    with TRACER.span("reduce"):
+    with TRACER.span("reduce"), METRICS.timer("search.reduce"):
         reduced = reduce_shard_results(results, body, agg_nodes=agg_nodes,
                                        defer_pipelines=bool(agg_nodes))
     by_shard: Dict[int, List[Candidate]] = {}
     for c in reduced["selected"]:
         by_shard.setdefault(c.shard, []).append(c)
     hits_by_key: Dict[Tuple, dict] = {}
-    with TRACER.span("fetch_phase", hits=len(reduced["selected"])):
+    with TRACER.span("fetch_phase", hits=len(reduced["selected"])), \
+            METRICS.timer("search.fetch_phase"):
         for i, r in enumerate(results):
             sel = by_shard.get(r.shard, [])
             if not sel:
@@ -961,8 +969,10 @@ def _finish_search(searchers: List[ShardSearcher],
         track_n = int(track)
         if total > track_n:
             total, relation = track_n, "gte"
+    took_ms = (time.monotonic() - t0) * 1000.0
+    METRICS.histogram("search.total").record(took_ms)
     resp = {
-        "took": int((time.monotonic() - t0) * 1000),
+        "took": int(took_ms),
         "timed_out": False,
         "_shards": {"total": len(searchers), "successful": len(searchers),
                     "skipped": 0, "failed": 0},
@@ -990,10 +1000,20 @@ def _finish_search(searchers: List[ShardSearcher],
                           stats[0], scoring=True)) if stats else None
         except Exception:
             plan_tree = None
+        # device attribution: what this request cost the jit layer (cache
+        # traffic + compiles triggered, the DELTA vs the pre-request
+        # baseline search_shards stashed) and which phase-2 rescore path
+        # is active — the per-plan-node "why was this slow" the reference
+        # gets from search/profile/
+        from .fastpath import rescore_mode
+        device_attr = {"rescore_path": rescore_mode(),
+                       "jit": _jit_delta(body.pop("_jit_before", None),
+                                         C.jit_attribution())}
         shards_profile = []
         for r in results:
             entry: dict = {"id": f"[shard][{r.shard}]",
                            "query_ms": r.took_ms,
+                           "device": device_attr,
                            "searches": [{"query": [], "rewrite_time": 0,
                                          "collector": [{
                                              "name": "SimpleTopKCollector",
@@ -1003,6 +1023,7 @@ def _finish_search(searchers: List[ShardSearcher],
             if plan_tree is not None:
                 root = dict(plan_tree)
                 root["time_in_nanos"] = int(r.took_ms * 1e6)
+                root["device"] = device_attr
                 entry["searches"][0]["query"] = [root]
             shards_profile.append(entry)
         resp["profile"] = {"shards": shards_profile}
@@ -1012,6 +1033,25 @@ def _finish_search(searchers: List[ShardSearcher],
 # =====================================================================
 # helpers
 # =====================================================================
+
+def _jit_delta(before, after):
+    """Recursive numeric diff of two `compiler.jit_attribution()`
+    snapshots: count/total fields become this-request deltas, percentile
+    fields (registry-lifetime, not diffable) pass through from `after`."""
+    if not isinstance(before, dict) or not isinstance(after, dict):
+        return after
+    out = {}
+    for k, v in after.items():
+        if isinstance(v, dict):
+            out[k] = _jit_delta(before.get(k), v)
+        elif isinstance(v, (int, float)) and not k.startswith("p") \
+                and isinstance(before.get(k), (int, float)):
+            d = v - before[k]
+            out[k] = round(d, 3) if isinstance(d, float) else d
+        else:
+            out[k] = v
+    return out
+
 
 _STATS_FAMILY = {"min", "max", "sum", "avg", "stats", "extended_stats",
                  "value_count"}

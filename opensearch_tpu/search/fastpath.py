@@ -56,19 +56,27 @@ L_HEAD = 1 << 12
 _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 
 # served/fallback counters (surfaced in _nodes/stats; also used by tests to
-# prove the kernel actually engaged rather than silently falling back)
-STATS = {"pure_served": 0, "bool_served": 0, "fallback": 0,
-         "pruned_served": 0, "pruned_dview": 0, "pruned_rescued": 0,
-         "pruned_rescued2": 0, "pruned_escalated": 0,
-         "shard_view_served": 0}
+# prove the kernel actually engaged rather than silently falling back).
+# CounterGroup: dict-shaped reads (same keys/values as the old plain dict)
+# with atomic inc() writes through the metrics registry — concurrent
+# searches no longer lose counts to the `d[k] += 1` read-modify-write race
+from ..utils.metrics import METRICS, CounterGroup
+from ..utils.trace import TRACER
+
+STATS = CounterGroup(METRICS, "fastpath", {
+    "pure_served": 0, "bool_served": 0, "fallback": 0,
+    "pruned_served": 0, "pruned_dview": 0, "pruned_rescued": 0,
+    "pruned_rescued2": 0, "pruned_escalated": 0,
+    "shard_view_served": 0})
 
 # phase-2 rescore instrumentation (surfaced in _nodes/stats and read by
 # scripts/measure_escalation.py): where the candidate-union rescore ran
 # and what it cost. wall_ms includes the device_get sync, so device
 # numbers are honest end-to-end, not launch-and-forget.
-RESCORE_STATS = {"host_calls": 0, "host_wall_ms": 0.0,
-                 "device_launches": 0, "device_queries": 0,
-                 "device_cands": 0, "device_wall_ms": 0.0}
+RESCORE_STATS = CounterGroup(METRICS, "fastpath.rescore", {
+    "host_calls": 0, "host_wall_ms": 0.0,
+    "device_launches": 0, "device_queries": 0,
+    "device_cands": 0, "device_wall_ms": 0.0})
 
 _rescore_override: Optional[str] = None   # tests/scripts pin a path
 
@@ -1027,8 +1035,10 @@ def _rescore_many(seg: Segment, jobs: List[tuple]) -> List[tuple]:
     if rescore_mode() != "device":
         t0 = time.perf_counter()
         out = [_exact_rescore(seg, vq, cand) for vq, cand in jobs]
-        RESCORE_STATS["host_calls"] += len(jobs)
-        RESCORE_STATS["host_wall_ms"] += (time.perf_counter() - t0) * 1e3
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        RESCORE_STATS.inc("host_calls", len(jobs))
+        RESCORE_STATS.inc("host_wall_ms", dt_ms)
+        METRICS.histogram("fastpath.rescore.host_ms").record(dt_ms)
         return out
     return _rescore_many_device(seg, jobs)
 
@@ -1088,23 +1098,24 @@ def _rescore_many_device(seg: Segment, jobs: List[tuple]) -> List[tuple]:
             for qj, j in enumerate(part):
                 n = len(jobs[j][1])
                 out[j] = (exact[qj, :n], counts[qj, :n].astype(np.int64))
-            RESCORE_STATS["device_launches"] += 1
-            RESCORE_STATS["device_queries"] += len(part)
-            RESCORE_STATS["device_cands"] += int(
-                sum(len(jobs[j][1]) for j in part))
+            RESCORE_STATS.inc("device_launches")
+            RESCORE_STATS.inc("device_queries", len(part))
+            RESCORE_STATS.inc("device_cands", int(
+                sum(len(jobs[j][1]) for j in part)))
     t_host = 0.0
     for j in host_jobs:
         vq, cand = jobs[j]
         th = time.perf_counter()
         out[j] = _exact_rescore(seg, vq, cand)
         t_host += time.perf_counter() - th
-        RESCORE_STATS["host_calls"] += 1
+        RESCORE_STATS.inc("host_calls")
     # per-path attribution: a host-ineligible job's numpy time must not
     # inflate device_wall_ms — that's the serialization signal these
     # stats exist to expose
-    RESCORE_STATS["host_wall_ms"] += t_host * 1e3
-    RESCORE_STATS["device_wall_ms"] += \
-        (time.perf_counter() - t0 - t_host) * 1e3
+    dev_ms = (time.perf_counter() - t0 - t_host) * 1e3
+    RESCORE_STATS.inc("host_wall_ms", t_host * 1e3)
+    RESCORE_STATS.inc("device_wall_ms", dev_ms)
+    METRICS.histogram("fastpath.rescore.device_ms").record(dev_ms)
     return out
 
 
@@ -1147,7 +1158,7 @@ def _phase2_batch(seg: Segment, vq_lists, specs: Sequence, results: dict,
                          int(specs[qi].window or K), K, None)
         if ver is not None:
             results[id(vq)] = ver
-            STATS["pruned_rescued"] += 1
+            STATS.inc("pruned_rescued")
         else:
             tier2.append((qi, vq))
     jobs2: List[tuple] = []
@@ -1175,8 +1186,8 @@ def _phase2_batch(seg: Segment, vq_lists, specs: Sequence, results: dict,
                          else _al.rem_frontiers.get(row))
         if ver is not None:
             results[id(vq)] = ver
-            STATS["pruned_rescued"] += 1
-            STATS["pruned_rescued2"] += 1
+            STATS.inc("pruned_rescued")
+            STATS.inc("pruned_rescued2")
         else:
             still.append(qi)
     return still
@@ -1287,7 +1298,7 @@ def _dview_rescue(seg: Segment, ctx, lts: Sequence, specs: Sequence,
     for field, qis in by_field.items():
         still.extend(_dview_rescue_field(seg, ctx, lts, specs, vq_lists,
                                          results, qis, K, field))
-    STATS["pruned_dview"] += len(redo) - len(still)
+    STATS.inc("pruned_dview", len(redo) - len(still))
     return still
 
 
@@ -1395,28 +1406,35 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
     vq_lists = _prepare_vqueries(seg, ctx, lts, {}, prune=prune)
     if vq_lists is None:
         return None
-    results = _launch_pure_groups(seg, vq_lists, K)
+    # frontier rung: the impact-head (pruned) kernel first pass
+    with TRACER.span("fastpath.frontier", queries=len(lts)), \
+            METRICS.timer("fastpath.frontier"):
+        results = _launch_pure_groups(seg, vq_lists, K)
     redo = []
-    for qi, vqs in enumerate(vq_lists):
-        if vqs is None or len(vqs) != 1 or not vqs[0].head:
-            continue
-        vq = vqs[0]
-        if not vq.clamped:
-            continue                    # heads were the full rows: exact
-        sc, dc, total, _ = results[id(vq)]
-        ver = _verify_pruned(seg, vq, sc, dc, total,
-                             int(specs[qi].window or K), K)
-        if ver is None:
-            redo.append(qi)
-        else:
-            results[id(vq)] = ver
+    with TRACER.span("fastpath.verify"), METRICS.timer("fastpath.verify"):
+        for qi, vqs in enumerate(vq_lists):
+            if vqs is None or len(vqs) != 1 or not vqs[0].head:
+                continue
+            vq = vqs[0]
+            if not vq.clamped:
+                continue                # heads were the full rows: exact
+            sc, dc, total, _ = results[id(vq)]
+            ver = _verify_pruned(seg, vq, sc, dc, total,
+                                 int(specs[qi].window or K), K)
+            if ver is None:
+                redo.append(qi)
+            else:
+                results[id(vq)] = ver
     rescued = 0
     if redo:
         # middle rung: the candidate-union rescore for ALL failed queries,
         # batched into as few device launches as their shape buckets allow
         # (host numpy under JAX_PLATFORMS=cpu — see _rescore_many)
         n_redo = len(redo)
-        redo = _phase2_batch(seg, vq_lists, specs, results, redo, K)
+        with TRACER.span("fastpath.phase2_rescore", queries=n_redo,
+                         mode=rescore_mode()), \
+                METRICS.timer("fastpath.phase2_rescore"):
+            redo = _phase2_batch(seg, vq_lists, specs, results, redo, K)
         rescued += n_redo - len(redo)
     if redo:
         # last rung before dense: ONE batched exact launch over the
@@ -1424,22 +1442,26 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
         # it; a certify saves the 8x-bigger dense launch, a miss adds a
         # small fraction of the dense cost it was about to pay anyway
         n_redo = len(redo)
-        redo = _dview_rescue(seg, ctx, lts, specs, vq_lists, results,
-                             redo, K)
+        with TRACER.span("fastpath.quality_tier", queries=n_redo), \
+                METRICS.timer("fastpath.quality_tier"):
+            redo = _dview_rescue(seg, ctx, lts, specs, vq_lists, results,
+                                 redo, K)
         rescued += n_redo - len(redo)
     if redo:
-        STATS["pruned_escalated"] += len(redo)
-        dense_lists = _prepare_vqueries(seg, ctx, [lts[qi] for qi in redo],
-                                        {})
-        if dense_lists is None:
-            dense_lists = [None] * len(redo)
-        for qi, dvqs in zip(redo, dense_lists):
-            vq_lists[qi] = dvqs
-        results.update(_launch_pure_groups(seg, dense_lists, K))
-    STATS["pruned_served"] += sum(
+        STATS.inc("pruned_escalated", len(redo))
+        with TRACER.span("fastpath.dense", queries=len(redo)), \
+                METRICS.timer("fastpath.dense"):
+            dense_lists = _prepare_vqueries(seg, ctx,
+                                            [lts[qi] for qi in redo], {})
+            if dense_lists is None:
+                dense_lists = [None] * len(redo)
+            for qi, dvqs in zip(redo, dense_lists):
+                vq_lists[qi] = dvqs
+            results.update(_launch_pure_groups(seg, dense_lists, K))
+    STATS.inc("pruned_served", sum(
         1 for vqs in vq_lists
         if vqs is not None and len(vqs) == 1 and vqs[0].head
-        and vqs[0].clamped) - rescued
+        and vqs[0].clamped) - rescued)
     return _assemble(vq_lists, results, K)
 
 
@@ -2011,8 +2033,8 @@ def shard_search(searcher, ctx, spec: FastSpec, k: int
     out = batch_search(view, ctx, [spec], k, count_stats=False)
     if out is None or out[0] is None:
         return None
-    STATS["pure_served"] += 1
-    STATS["shard_view_served"] += 1
+    STATS.inc("pure_served")
+    STATS.inc("shard_view_served")
     return view, out[0]
 
 
@@ -2101,7 +2123,7 @@ def count_served(specs: Sequence[FastSpec], outs: Sequence[Optional[dict]]
                  ) -> None:
     for spec, r in zip(specs, outs):
         if r is None:
-            STATS["fallback"] += 1
+            STATS.inc("fallback")
         else:
-            STATS["pure_served" if spec.kind == "pure"
-                  else "bool_served"] += 1
+            STATS.inc("pure_served" if spec.kind == "pure"
+                      else "bool_served")

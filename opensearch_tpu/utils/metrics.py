@@ -1,0 +1,328 @@
+"""Unified telemetry: a thread-safe metrics registry for the query path.
+
+Reference analogs: `telemetry/metrics/MetricsRegistry.java` (counters /
+gauges / histograms behind one named registry) and the percentile plumbing
+of `search/profile/`. Three instrument kinds:
+
+- `Counter` — monotonic (or reset-by-tests) numeric cell; `inc()` is
+  atomic under the cell's lock, so concurrent searches never lose counts
+  the way the old plain-dict `STATS[k] += 1` pattern did.
+- `Gauge` — last-write-wins numeric cell.
+- `LatencyHistogram` — a DDSketch-style log-binned sketch reusing the
+  SAME bin math the `percentile_ranks` aggregation runs on device
+  (`ops/aggs.py: ddsketch_bin/ddsketch_value`, ~0.5% relative error,
+  mergeable by bin-wise addition). Bins are value-independent global
+  constants, so percentile queries are deterministic: the same recorded
+  multiset always yields the same p50/p95/p99 no matter the record order
+  or thread interleaving.
+
+The process-default registry is `METRICS`; `_nodes/stats` serves its
+snapshot (per-stage p50/p95/p99 + jit compile-vs-execute attribution) and
+`rest/http_server.py` exposes a Prometheus text rendition at `/_metrics`.
+Disabled mode (`METRICS.enabled = False`) turns `timer()` and histogram
+`record()` into near-no-ops — the fastpath overhead guard in
+tests/test_telemetry.py pins that cost.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+__all__ = ["Counter", "Gauge", "LatencyHistogram", "MetricsRegistry",
+           "CounterGroup", "render_prometheus", "METRICS"]
+
+
+_SKETCH_FNS = None
+
+
+def _sketch_fns():
+    """The proven DDSketch bin math from the percentile_ranks agg
+    (ops/aggs.py). Imported lazily — ops pulls in jax, and utils must
+    stay importable without touching the device stack — then cached so
+    hot-path records don't re-resolve the import per sample."""
+    global _SKETCH_FNS
+    if _SKETCH_FNS is None:
+        from ..ops.aggs import ddsketch_bin, ddsketch_value
+        _SKETCH_FNS = (ddsketch_bin, ddsketch_value)
+    return _SKETCH_FNS
+
+
+class Counter:
+    """Atomic numeric cell. Holds ints until a float is added (wall-ms
+    accumulators), mirroring the old STATS/RESCORE_STATS value types."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class LatencyHistogram:
+    """Sparse DDSketch: bin index -> count. `record` takes milliseconds.
+
+    Percentile queries use the nearest-rank definition (rank
+    ceil(p/100 * n)) over the sorted bins, then return the bin's
+    representative value — deterministic for a given recorded multiset,
+    within the sketch's ~0.5% relative error of the exact empirical
+    percentile (tests pin this against numpy)."""
+
+    __slots__ = ("name", "_bins", "count", "sum_ms", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._bins: Dict[int, int] = {}
+        self.count = 0
+        self.sum_ms = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, ms: float) -> None:
+        dd_bin, _ = _sketch_fns()
+        b = dd_bin(float(ms))
+        with self._lock:
+            self._bins[b] = self._bins.get(b, 0) + 1
+            self.count += 1
+            self.sum_ms += float(ms)
+
+    def percentile(self, p: float) -> Optional[float]:
+        _, dd_value = _sketch_fns()
+        with self._lock:
+            total = self.count
+            items = sorted(self._bins.items())
+        if total == 0:
+            return None
+        rank = max(1, -(-int(p * total) // 100))     # ceil(p/100 * total)
+        cum = 0
+        for b, c in items:
+            cum += c
+            if cum >= rank:
+                return float(dd_value(b))
+        return float(dd_value(items[-1][0]))
+
+    def snapshot(self, percentiles: Sequence[float] = (50, 95, 99)) -> dict:
+        out = {"count": self.count, "sum_ms": round(self.sum_ms, 3)}
+        for p in percentiles:
+            v = self.percentile(p)
+            out[f"p{int(p) if float(p).is_integer() else p}_ms"] = (
+                round(v, 4) if v is not None else None)
+        return out
+
+
+class MetricsRegistry:
+    """Named instruments behind one lock for creation; each instrument
+    carries its own fine-grained lock for updates."""
+
+    def __init__(self):
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._hists: Dict[str, LatencyHistogram] = {}
+
+    # -- instrument factories (create-on-first-use, stable identity) --
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def histogram(self, name: str) -> LatencyHistogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, LatencyHistogram(name))
+        return h
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        """Record a wall-time span (perf_counter, never time.time) into
+        the named latency histogram. Near-free when disabled."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.histogram(name).record(
+                (time.perf_counter() - t0) * 1000.0)
+
+    # -- queries --
+
+    def percentiles(self, name: str,
+                    ps: Sequence[float] = (50, 95, 99)) -> dict:
+        h = self._hists.get(name)
+        if h is None:
+            return {}
+        return h.snapshot(ps)
+
+    def stage_percentiles(self, prefix: str = "") -> Dict[str, dict]:
+        """p50/p95/p99 + count for every latency histogram (optionally
+        name-filtered), sorted by name — the `_nodes/stats` telemetry
+        stage block."""
+        with self._lock:
+            hists = sorted((n, h) for n, h in self._hists.items()
+                           if n.startswith(prefix))
+        return {n: h.snapshot() for n, h in hists}
+
+    def snapshot(self) -> dict:
+        """Deterministic full dump: sorted names, plain values."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._hists.items())
+        return {
+            "counters": {n: c.value for n, c in counters},
+            "gauges": {n: g.value for n, g in gauges},
+            "histograms": {n: h.snapshot() for n, h in hists},
+        }
+
+    def reset(self) -> None:
+        """Drop every instrument — isolation hook for bench runs and
+        tests that diff a cold registry. Instruments obtained before a
+        reset keep working but detach from future snapshots."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+class CounterGroup:
+    """A dict-shaped view over a family of registry counters — the
+    migration shim for `fastpath.STATS` / `fastpath.RESCORE_STATS`.
+
+    Reads (`d[k]`, `dict(d)`, iteration) serve the exact key set and value
+    types the old plain dicts had, so `_nodes/stats` shapes and the
+    delta-diff idiom in tests/bench stay byte-compatible. Writes go
+    through `inc()` (atomic) instead of the racy `d[k] += 1`; plain
+    `d[k] = v` assignment still works for test resets."""
+
+    __slots__ = ("_registry", "_prefix", "_keys")
+
+    def __init__(self, registry: MetricsRegistry, prefix: str,
+                 initial: Dict[str, Any]):
+        self._registry = registry
+        self._prefix = prefix
+        self._keys = list(initial)
+        for k, v in initial.items():
+            self._counter(k).set(v)
+
+    def _counter(self, key: str) -> Counter:
+        return self._registry.counter(f"{self._prefix}.{key}")
+
+    def inc(self, key: str, n=1) -> None:
+        if key not in self._keys:
+            raise KeyError(key)
+        self._counter(key).inc(n)
+
+    def __getitem__(self, key: str):
+        if key not in self._keys:
+            raise KeyError(key)
+        return self._counter(key).value
+
+    def __setitem__(self, key: str, v) -> None:
+        if key not in self._keys:
+            raise KeyError(key)
+        self._counter(key).set(v)
+
+    def keys(self) -> List[str]:
+        return list(self._keys)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._keys)
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def __contains__(self, key) -> bool:
+        return key in self._keys
+
+    def items(self):
+        return [(k, self[k]) for k in self._keys]
+
+    def values(self):
+        return [self[k] for k in self._keys]
+
+    def copy(self) -> dict:
+        return dict(self.items())
+
+    def __repr__(self) -> str:
+        return f"CounterGroup({self._prefix}, {self.copy()!r})"
+
+
+def _prom_name(name: str) -> str:
+    return "ostpu_" + "".join(
+        c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def render_prometheus(registry: MetricsRegistry) -> str:
+    """Prometheus text exposition format 0.0.4. Counters and gauges render
+    directly; latency histograms render as summaries (quantile series +
+    _count/_sum) since DDSketch quantiles are what the registry serves."""
+    snap = registry.snapshot()
+    lines: List[str] = []
+    for n, v in snap["counters"].items():
+        pn = _prom_name(n)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {v}")
+    for n, v in snap["gauges"].items():
+        pn = _prom_name(n)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    for n, h in snap["histograms"].items():
+        pn = _prom_name(n) + "_ms"
+        lines.append(f"# TYPE {pn} summary")
+        for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
+            if h.get(key) is not None:
+                lines.append(f'{pn}{{quantile="{q}"}} {h[key]}')
+        lines.append(f"{pn}_sum {h['sum_ms']}")
+        lines.append(f"{pn}_count {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# process-default registry (one node per process, like utils/trace.TRACER)
+METRICS = MetricsRegistry()

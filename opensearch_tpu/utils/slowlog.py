@@ -58,9 +58,17 @@ class SlowLog:
         self.entries: Deque[dict] = deque(maxlen=256)
         self.source_limit = source_limit
 
-    def maybe_log(self, took_s: float, source: Any) -> Optional[str]:
+    def maybe_log(self, took_s: float, source: Any,
+                  extra=None) -> Optional[str]:
         """Log at the most severe threshold `took_s` crosses; returns the
-        level (for tests/stats) or None."""
+        level (for tests/stats) or None.
+
+        `extra` enriches the entry with attribution — WHY the operation
+        was slow, not just how long: ladder-rung counters, the request's
+        root trace span, the rescore path. A dict merges directly; a
+        callable is invoked only when a threshold actually fires, so the
+        (possibly deep) span serialization costs nothing on fast
+        requests."""
         hit = None
         for level in LEVELS:           # warn is most severe; first hit wins
             thr = self.thresholds.get(level)
@@ -73,6 +81,10 @@ class SlowLog:
         entry = {"index": self.index, "level": hit,
                  "took_millis": int(took_s * 1000), "source": msg,
                  "timestamp": time.time()}
+        if callable(extra):
+            extra = extra()
+        if isinstance(extra, dict):
+            entry.update(extra)
         self.entries.append(entry)
         self.logger.log(_LOG_LEVEL[hit],
                         "[%s] took[%dms], source[%s]",

@@ -25,7 +25,8 @@ class Task:
         self.action = action
         self.description = description
         self.cancellable = cancellable
-        self.start_time = time.time()
+        self.start_time = time.time()          # wall clock, display only
+        self._start_mono = time.monotonic()    # durations (running time)
         self.cancelled = False
         self.cancel_reason: Optional[str] = None
         # resource tracking (utils/backpressure.py; reference
@@ -54,7 +55,7 @@ class Task:
                 "cancelled": self.cancelled,
                 "start_time_in_millis": int(self.start_time * 1000),
                 "running_time_in_nanos":
-                    int((time.time() - self.start_time) * 1e9),
+                    int((time.monotonic() - self._start_mono) * 1e9),
                 "resource_stats": {"device_time_seconds":
                                    round(self.device_seconds, 6),
                                    "memory_in_bytes": self.mem_bytes}}

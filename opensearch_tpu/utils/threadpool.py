@@ -8,6 +8,7 @@ reference's defaults scaled to the host core count."""
 
 from __future__ import annotations
 
+import contextvars
 import os
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Callable, Dict, List
@@ -24,10 +25,16 @@ class NamedPool:
 
     def submit(self, fn: Callable, *args, **kw) -> Future:
         self.submitted += 1
+        # carry the submitter's contextvars into the worker: tracer spans
+        # started on the pool thread attach under the submitting request's
+        # span instead of silently becoming detached roots (each task gets
+        # its own context copy, so concurrent tasks can't clobber each
+        # other's ambient span)
+        ctx = contextvars.copy_context()
 
         def run():
             try:
-                return fn(*args, **kw)
+                return ctx.run(fn, *args, **kw)
             finally:
                 self.completed += 1
 

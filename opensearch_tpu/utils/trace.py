@@ -6,7 +6,14 @@ contextvar, so instrumented layers (REST parse, per-shard query phase,
 reduce, fetch) nest naturally without passing a context object around.
 No exporter: completed root spans land in a bounded in-memory ring the
 stats API serves — the deterministic, dependency-free equivalent of an
-OTel in-memory span processor."""
+OTel in-memory span processor.
+
+Thread-safety contract: spans may START on pool threads (the
+context-carrying submit in `utils/threadpool.py` propagates the ambient
+parent into workers), so `parent.children.append` happens concurrently —
+child attachment is lock-guarded. Cross-process traces (cluster/distnode)
+graft serialized remote subtrees via `attach_remote`, keyed to the wire
+context from `wire_context()`."""
 
 from __future__ import annotations
 
@@ -21,10 +28,14 @@ from typing import Any, Dict, List, Optional
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "opensearch_tpu_span", default=None)
 
+# one lock for all child/remote attachment: attachment is rare relative to
+# span bodies and a per-span lock would cost a slot on every span
+_attach_lock = threading.Lock()
+
 
 class Span:
     __slots__ = ("span_id", "name", "attributes", "start", "end", "children",
-                 "parent")
+                 "parent", "remote_children")
 
     def __init__(self, span_id: int, name: str, attributes: Optional[dict],
                  parent: Optional["Span"]):
@@ -34,16 +45,22 @@ class Span:
         self.start = time.monotonic()
         self.end: Optional[float] = None
         self.children: List["Span"] = []
+        # pre-serialized subtrees grafted from other processes (distnode
+        # RPC responses carry the remote node's span tree)
+        self.remote_children: List[dict] = []
         self.parent = parent
 
     def to_dict(self) -> dict:
         dur = ((self.end if self.end is not None else time.monotonic())
                - self.start)
+        with _attach_lock:
+            kids = list(self.children)
+            remote = list(self.remote_children)
+        children = [c.to_dict() for c in kids] + remote
         return {"name": self.name, "span_id": self.span_id,
                 "duration_ms": round(dur * 1000.0, 3),
                 **({"attributes": self.attributes} if self.attributes else {}),
-                **({"children": [c.to_dict() for c in self.children]}
-                   if self.children else {})}
+                **({"children": children} if children else {})}
 
 
 class Tracer:
@@ -62,7 +79,10 @@ class Tracer:
         parent = _current.get()
         s = Span(next(self._ids), name, attributes, parent)
         if parent is not None:
-            parent.children.append(s)
+            # pool threads share a parent (context-carrying submit):
+            # concurrent appends must not lose children
+            with _attach_lock:
+                parent.children.append(s)
         token = _current.set(s)
         try:
             yield s
@@ -74,10 +94,37 @@ class Tracer:
                 if parent is None:
                     self._traces.append(s)
 
+    def current(self) -> Optional[Span]:
+        return _current.get()
+
     def set_attribute(self, key: str, value: Any) -> None:
         s = _current.get()
         if s is not None:
             s.attributes[key] = value
+
+    def attach_remote(self, span_dict: Optional[dict]) -> None:
+        """Graft a serialized span subtree (from another process's tracer,
+        carried over the RPC wire) under the current span, so a
+        distributed search reads as ONE parent-child trace."""
+        if not span_dict:
+            return
+        s = _current.get()
+        if s is not None:
+            with _attach_lock:
+                s.remote_children.append(span_dict)
+
+    def wire_context(self) -> Optional[dict]:
+        """Serializable trace context for cross-node propagation: the
+        remote side stamps these onto its local root span so a grafted
+        subtree stays attributable even when read from the remote node's
+        own ring."""
+        s = _current.get()
+        if s is None:
+            return None
+        root = s
+        while root.parent is not None:
+            root = root.parent
+        return {"trace_root_id": root.span_id, "parent_span_id": s.span_id}
 
     def traces(self, limit: int = 20) -> List[dict]:
         with self._lock:

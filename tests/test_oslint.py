@@ -331,6 +331,90 @@ class TestLockRules:
 
 
 # ----------------------------------------------------------------------
+# OSL5xx telemetry discipline
+# ----------------------------------------------------------------------
+
+class TestTelemetryRules:
+    def test_osl501_walltime_subtraction(self):
+        # the classic duration-from-wall-clock bug
+        src = """
+            import time
+
+            def measure(fn):
+                t0 = time.time()
+                fn()
+                return time.time() - t0
+        """
+        assert "OSL501" in rules_of(lint(src))
+
+    def test_osl501_tainted_var_pair(self):
+        src = """
+            import time as clock
+
+            def age(meta):
+                now = clock.time()
+                return now - meta.created
+        """
+        assert "OSL501" in rules_of(lint(src))
+
+    def test_osl501_quiet_on_monotonic(self):
+        src = """
+            import time
+
+            def measure(fn):
+                t0 = time.monotonic()
+                fn()
+                return time.monotonic() - t0
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl501_quiet_on_timestamp_and_compare(self):
+        # absolute epochs (slowlog timestamps, expiry comparisons) are the
+        # legitimate uses of the wall clock
+        src = """
+            import time
+
+            def entry(expires):
+                if time.time() > expires:
+                    return None
+                return {"timestamp": time.time()}
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl502_caps_dict_augassign(self):
+        # the retired fastpath.STATS pattern: racy += on a shared dict
+        src = """
+            STATS = {"served": 0}
+
+            def count():
+                STATS["served"] += 1
+        """
+        assert "OSL502" in rules_of(lint(src))
+
+    def test_osl502_quiet_on_registry_and_locals(self):
+        src = """
+            STATS = {"served": 0}
+
+            def count(registry):
+                registry.counter("fastpath.served").inc()
+                local = {"n": 0}
+                local["n"] += 1
+                STATS["served"] = 5      # reset assignment, not +=
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl502_out_of_scope_module_quiet(self):
+        src = """
+            COUNTS = {"n": 0}
+
+            def count():
+                COUNTS["n"] += 1
+        """
+        # hot-path counter discipline patrols search/, ops/, parallel/
+        assert rules_of(lint(src, "opensearch_tpu/cluster/admin.py")) == []
+
+
+# ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 
