@@ -366,6 +366,16 @@ class Node:
         # one-device-per-process reality
         from ..search import fastpath
         fastpath.set_breaker(self.breakers.breaker("fielddata"))
+        # the per-segment device column cache (Segment.device_arrays) and
+        # the compiler's nested sort-value columns charge the same budget
+        from ..index import segment as _segment_mod
+        _segment_mod.set_breaker(self.breakers.breaker("fielddata"))
+        # serving scheduler (serving/scheduler.py): coalesces concurrent
+        # eligible searches into one batched device program invocation.
+        # On by default whenever the mesh is attached; OPENSEARCH_TPU_SCHED
+        # forces it on (single-chip kernel batching) or off
+        from ..serving import ServingScheduler
+        self.serving = ServingScheduler(self)
         # persistent tasks (reference persistent/AllocatedPersistentTask):
         # durable task table + resumable executors; built-in: reindex
         from ..utils.persistent_tasks import PersistentTasksService
@@ -902,11 +912,13 @@ class Node:
 
     def search(self, expression: str, body: dict, phase_hook=None,
                phase_ctx: Optional[dict] = None,
-               copy_protect: bool = False) -> dict:
+               copy_protect: bool = False,
+               wlm_lane: Optional[str] = None) -> dict:
         """`copy_protect`: caller intends to mutate the response (search
         pipeline response processors) — deep-copy it iff it aliases a
         request-cache entry, so cached entries stay pristine without taxing
-        uncached paths."""
+        uncached paths. `wlm_lane`: serving-scheduler priority lane from
+        the request's workload group (REST layer resolves it)."""
         # a body the mesh already declined in this request (msearch batch
         # decline -> per-body retry) skips the mesh: one logical search
         # counts at most one mesh fallback, and the retry does no wasted
@@ -976,12 +988,28 @@ class Node:
                     resp = startree.try_answer(
                         searchers, body,
                         self.indices[names[0]].mappings.star_trees)
-                if (resp is None and self.mesh_service is not None
-                        and not mesh_declined and len(names) == 1
+                if (resp is None and not mesh_declined and len(names) == 1
                         and not remote_parts and phase_hook is None):
-                    resp = self.mesh_service.try_search(names[0],
-                                                        self.indices[names[0]],
-                                                        body)
+                    svc0 = self.indices[names[0]]
+                    sched = self.serving
+                    if sched is not None and sched.enabled:
+                        # serving scheduler: coalesce this request with
+                        # concurrent eligible ones into a single batched
+                        # program invocation; non-coalescable shapes
+                        # bypass unchanged
+                        if sched.accepts(body):
+                            resp = sched.execute(names[0], svc0, body,
+                                                 task=task,
+                                                 lane=wlm_lane
+                                                 or "interactive")
+                        else:
+                            sched.note_bypass()
+                            if self.mesh_service is not None:
+                                resp = self.mesh_service.try_search(
+                                    names[0], svc0, body)
+                    elif self.mesh_service is not None:
+                        resp = self.mesh_service.try_search(names[0], svc0,
+                                                            body)
                     body.pop("_mesh_declined", None)
                 if resp is None:
                     all_names = list(names) + [
@@ -1035,11 +1063,18 @@ class Node:
             searchers.extend(self.indices[name].searchers)
         resps: Optional[List[Optional[dict]]] = None
         if self.mesh_service is not None and len(names) == 1:
+            # ALWAYS consult the mesh — including single-shard indices it
+            # will decline: try_msearch attributes the decline
+            # (fallback_shapes["single_shard"]) and marks the bodies
+            # `_mesh_declined`, exactly like the direct per-request path,
+            # so scheduler/msearch traffic and direct traffic report
+            # identical mesh attribution (and the per-body retry derives
+            # identical request-cache keys — the marker is popped before
+            # key derivation)
             svc = self.indices[names[0]]
-            if svc.meta.num_shards >= 2:
-                resps = self.mesh_service.try_msearch(names[0], svc, bodies)
-                if all(r is None for r in resps):
-                    resps = None
+            resps = self.mesh_service.try_msearch(names[0], svc, bodies)
+            if all(r is None for r in resps):
+                resps = None
         if resps is None or any(r is None for r in resps):
             todo = ([i for i, r in enumerate(resps) if r is None]
                     if resps is not None else list(range(len(bodies))))

@@ -126,11 +126,11 @@ def default_checkers() -> List[Checker]:
     from .breaker_rules import BreakerDisciplineChecker
     from .dtype_rules import DtypeDisciplineChecker
     from .jit_rules import JitBoundaryChecker
-    from .lock_rules import LockDisciplineChecker
+    from .lock_rules import LockDisciplineChecker, WaitDisciplineChecker
     from .telemetry_rules import TelemetryDisciplineChecker
     return [DtypeDisciplineChecker(), JitBoundaryChecker(),
             BreakerDisciplineChecker(), LockDisciplineChecker(),
-            TelemetryDisciplineChecker()]
+            TelemetryDisciplineChecker(), WaitDisciplineChecker()]
 
 
 def run_source(src: str, path: str,

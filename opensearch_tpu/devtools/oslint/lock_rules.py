@@ -1,4 +1,5 @@
-"""OSL4xx — lock discipline for threaded modules.
+"""OSL4xx — lock discipline for threaded modules. OSL503 — wait
+discipline (no sleep-polling) for the serving/threadpool hot paths.
 
 The cluster/rest/ingest layers and the fastpath's shared caches are hit
 from request threads concurrently. Two invariants, both checked
@@ -160,3 +161,88 @@ class LockDisciplineChecker(Checker):
                 target.value.id == "self":
             return target.attr
         return ""
+
+
+class WaitDisciplineChecker(Checker):
+    """OSL503: no bare `time.sleep` polling loops in serving/threadpool
+    hot paths — waiting must ride `threading.Condition` / `Event`.
+
+    A sleep-poll in a request-serving loop both burns a core slot and
+    adds up to a full poll interval of tail latency per hop; the serving
+    scheduler's flush wait (`serving/scheduler.py: _wait_flush`) is the
+    motivating case — its deadline semantics only work because
+    `Condition.wait(timeout)` wakes on notify. Detected structurally: a
+    call to `time.sleep` (through any module alias or
+    `from time import sleep`) lexically inside a `while`/`for` loop.
+    One-shot sleeps outside loops (startup grace, test scaffolding
+    delays) stay legal. Suppress a justified poll of truly
+    signal-less external state with
+    `# oslint: disable=OSL503 -- <what cannot signal>`."""
+
+    rules = ("OSL503",)
+    name = "wait-discipline"
+
+    SCOPES = ("serving/", "utils/", "rest/")
+
+    def applies(self, path: str) -> bool:
+        return any(s in path for s in self.SCOPES)
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        if "sleep" not in src:
+            return findings
+        qmap = qualname_map(tree)
+        mods: Set[str] = set()
+        funcs: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        mods.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "sleep":
+                        funcs.add(a.asname or "sleep")
+
+        def is_sleep(call: ast.Call) -> bool:
+            d = _dotted(call.func)
+            if d in funcs:
+                return True
+            head, _, tail = d.rpartition(".")
+            return tail == "sleep" and head in mods
+
+        def walk(node: ast.AST, in_loop: bool) -> None:
+            if isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+                # only the BODY repeats; the else clause runs at most
+                # once (outer context), and a for's iterable evaluates
+                # once — but a while's TEST re-evaluates per iteration
+                for child in node.body:
+                    walk(child, True)
+                for child in node.orelse:
+                    walk(child, in_loop)
+                if isinstance(node, ast.While):
+                    walk(node.test, True)
+                else:
+                    walk(node.iter, in_loop)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # a nested def's body runs when CALLED, not where it sits
+                for child in ast.iter_child_nodes(node):
+                    walk(child, False)
+                return
+            if in_loop and isinstance(node, ast.Call) and is_sleep(node):
+                findings.append(Finding(
+                    "OSL503", path, node.lineno, node.col_offset,
+                    qmap.get(node, ""),
+                    "bare time.sleep inside a loop (sleep-polling) in a "
+                    "serving/threadpool hot path; wait on a "
+                    "threading.Condition/Event so wake-ups are "
+                    "notify-driven and deadlines stay tight",
+                    detail="sleep-poll"))
+            for child in ast.iter_child_nodes(node):
+                walk(child, in_loop)
+
+        for stmt in tree.body:
+            walk(stmt, False)
+        return findings

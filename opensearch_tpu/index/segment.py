@@ -36,6 +36,25 @@ from .mappings import FLOAT_TYPES, GEO_TYPES, FieldType, Mappings
 
 INT32_SENTINEL = np.int32(2**31 - 1)  # padded doc_id -> dropped by scatter
 
+# memory accounting for the per-segment DEVICE column cache
+# (`device_arrays` HBM residency): the Node wires its fielddata breaker in
+# here (cluster/node.py), the same budget the fastpath's aligned postings
+# charge. Charged once per (segment, device) pytree build, released by a
+# weakref finalizer when the segment is GC'd (segments are immutable and
+# replaced wholesale on refresh/merge).
+_breaker = None
+
+def set_breaker(breaker) -> None:
+    global _breaker
+    _breaker = breaker
+
+
+def _tree_nbytes(tree) -> int:
+    """Total array bytes of a (nested dict of) arrays pytree."""
+    if isinstance(tree, dict):
+        return sum(_tree_nbytes(v) for v in tree.values())
+    return int(getattr(tree, "nbytes", 0))
+
 
 def next_pow2(n: int, floor: int = 16) -> int:
     n = max(int(n), floor)
@@ -342,62 +361,20 @@ class Segment:
 
         key = device
         if key not in self._device_cache:
-            if device is not None:
-                jnp = _DevicePut(device)  # route jnp.asarray onto the device
-            dpad = self.ndocs_pad
-            post = {f: _post_field_arrays(pb, jnp)
-                    for f, pb in self.postings.items()}
-            ncols = {f: _num_field_arrays(col, dpad, jnp)
-                     for f, col in self.numeric_cols.items()}
-            kcols = {f: _kw_field_arrays(col, dpad, jnp)
-                     for f, col in self.keyword_cols.items()}
-            vcols = {}
-            for f, col in self.vector_cols.items():
-                dims = col.values.shape[1]
-                dpad128 = ((dims + 127) // 128) * 128  # MXU lane alignment
-                mat = np.zeros((dpad, dpad128), np.float32)
-                src = col.normed() if col.similarity == "cosine" else col.values
-                mat[: self.ndocs, :dims] = src
-                vcols[f] = {
-                    "mat": jnp.asarray(mat),
-                    "present": jnp.asarray(_pad_to(col.present, dpad, False)),
-                }
-                ivf = col.ivf()
-                if ivf is not None:
-                    # nlist padded pow2; padding rows are invalid (cvalid
-                    # False -> -inf centroid score, lists slots -1)
-                    lpad = next_pow2(ivf.nlist)
-                    cent = np.zeros((lpad, dpad128), np.float32)
-                    cent[: ivf.nlist, :dims] = ivf.centroids
-                    lists = np.full((lpad, ivf.cap), -1, np.int32)
-                    lists[: ivf.nlist] = ivf.lists
-                    cvalid = np.zeros(lpad, bool)
-                    cvalid[: ivf.nlist] = True
-                    vcols[f]["ivf_centroids"] = jnp.asarray(cent)
-                    vcols[f]["ivf_lists"] = jnp.asarray(lists)
-                    vcols[f]["ivf_cvalid"] = jnp.asarray(cvalid)
-            gcols = {f: _geo_field_arrays(col, dpad, jnp)
-                     for f, col in self.geo_cols.items()}
-            dls = {f: jnp.asarray(_pad_to(dl.astype(np.float32), dpad, np.float32(0)))
-                   for f, dl in self.doc_lens.items()}
-            # NOTE: values must all be arrays — plain ints would become traced
-            # jit arguments and poison static shape derivation downstream
-            nst = {}
-            for path, blk in self.nested.items():
-                carr = dict(blk.child.device_arrays(device))
-                cpad = blk.child.ndocs_pad
-                # padded children map to parent 0 but carry live=0, so every
-                # scatter-reduce contribution from padding is identically zero
-                carr["parent"] = jnp.asarray(
-                    _pad_to(blk.parent_of.astype(np.int32), cpad, np.int32(0)))
-                nst[path] = carr
-            self._device_cache[key] = {
-                "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
-                "vector": vcols, "doc_lens": dls, "nested": nst,
-            }
-            self._device_live_dirty[key] = True
+            # per-SEGMENT build lock: two request threads racing the same
+            # (segment, device) miss would otherwise both build and both
+            # charge the breaker (only one dict entry wins but both
+            # finalizers release — a persistent double-charge), while
+            # builds of DIFFERENT segments still overlap. dict.setdefault
+            # is atomic under the GIL, so every racer gets the same lock;
+            # reentrant because a parent's build recurses into nested
+            # children (child locks are acquired parent->child, acyclic).
+            lock = self.__dict__.setdefault(
+                "_device_build_lock", __import__("threading").RLock())
+            with lock:
+                if key not in self._device_cache:
+                    self._build_device_arrays(key, device)
         if self._device_live_dirty.get(key, True):
-            import jax.numpy as jnp
             live = _pad_to(self.live.astype(np.float32), self.ndocs_pad,
                            np.float32(0))
             self._device_cache[key]["live"] = (
@@ -405,6 +382,90 @@ class Segment:
                 else jax.device_put(live, device))
             self._device_live_dirty[key] = False
         return self._device_cache[key]
+
+    def _build_device_arrays(self, key, device) -> None:
+        """Build + breaker-charge one (segment, device) cache entry.
+        Caller holds _DEVICE_BUILD_LOCK and has re-checked the cache, so
+        exactly one thread ever charges a given entry."""
+        import jax.numpy as jnp
+
+        if device is not None:
+            jnp = _DevicePut(device)  # route jnp.asarray onto the device
+        dpad = self.ndocs_pad
+        post = {f: _post_field_arrays(pb, jnp)
+                for f, pb in self.postings.items()}
+        ncols = {f: _num_field_arrays(col, dpad, jnp)
+                 for f, col in self.numeric_cols.items()}
+        kcols = {f: _kw_field_arrays(col, dpad, jnp)
+                 for f, col in self.keyword_cols.items()}
+        vcols = {}
+        for f, col in self.vector_cols.items():
+            dims = col.values.shape[1]
+            dpad128 = ((dims + 127) // 128) * 128  # MXU lane alignment
+            mat = np.zeros((dpad, dpad128), np.float32)
+            src = col.normed() if col.similarity == "cosine" else col.values
+            mat[: self.ndocs, :dims] = src
+            vcols[f] = {
+                "mat": jnp.asarray(mat),
+                "present": jnp.asarray(_pad_to(col.present, dpad, False)),
+            }
+            ivf = col.ivf()
+            if ivf is not None:
+                # nlist padded pow2; padding rows are invalid (cvalid
+                # False -> -inf centroid score, lists slots -1)
+                lpad = next_pow2(ivf.nlist)
+                cent = np.zeros((lpad, dpad128), np.float32)
+                cent[: ivf.nlist, :dims] = ivf.centroids
+                lists = np.full((lpad, ivf.cap), -1, np.int32)
+                lists[: ivf.nlist] = ivf.lists
+                cvalid = np.zeros(lpad, bool)
+                cvalid[: ivf.nlist] = True
+                vcols[f]["ivf_centroids"] = jnp.asarray(cent)
+                vcols[f]["ivf_lists"] = jnp.asarray(lists)
+                vcols[f]["ivf_cvalid"] = jnp.asarray(cvalid)
+        gcols = {f: _geo_field_arrays(col, dpad, jnp)
+                 for f, col in self.geo_cols.items()}
+        dls = {f: jnp.asarray(_pad_to(dl.astype(np.float32), dpad, np.float32(0)))
+               for f, dl in self.doc_lens.items()}
+        # NOTE: values must all be arrays — plain ints would become traced
+        # jit arguments and poison static shape derivation downstream
+        nst = {}
+        for path, blk in self.nested.items():
+            carr = dict(blk.child.device_arrays(device))
+            cpad = blk.child.ndocs_pad
+            # padded children map to parent 0 but carry live=0, so every
+            # scatter-reduce contribution from padding is identically zero
+            carr["parent"] = jnp.asarray(
+                _pad_to(blk.parent_of.astype(np.int32), cpad, np.int32(0)))
+            nst[path] = carr
+        self._device_cache[key] = {
+            "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
+            "vector": vcols, "doc_lens": dls, "nested": nst,
+        }
+        if _breaker is not None:
+            import weakref
+            # charge THIS segment's new device residency: every group
+            # built above, the per-path "parent" maps, and the live
+            # plane (constant size across dirty rebuilds). The nested
+            # children's own arrays are charged by their recursive
+            # device_arrays() calls — counting them here would
+            # double-bill the breaker.
+            nbytes = sum(_tree_nbytes(self._device_cache[key][g])
+                         for g in ("postings", "numeric", "keyword",
+                                   "geo", "vector", "doc_lens"))
+            nbytes += sum(int(c["parent"].nbytes)
+                          for c in nst.values())
+            nbytes += self.ndocs_pad * 4          # live plane (f32)
+            try:
+                _breaker.add_estimate(nbytes,
+                                      f"segment-device[{self.name}]")
+            except Exception:
+                # tripped: drop the uncharged entry so a later retry
+                # re-attempts the charge instead of serving for free
+                del self._device_cache[key]
+                raise
+            weakref.finalize(self, _breaker.release, nbytes)
+        self._device_live_dirty[key] = True
 
     def pruned_arrays(self, device, needs: Dict[str, set]) -> dict:
         """Device arrays for ONLY the named fields — the filter-mask path

@@ -75,26 +75,34 @@ class _ByteLRU:
 
     def __init__(self, max_bytes: int):
         import collections
+        import threading
         self._od: "collections.OrderedDict" = collections.OrderedDict()
         self._bytes = 0
         self._max = max_bytes
+        # concurrent searches (HTTP threads with the serving scheduler
+        # off, msearch's per-body fallback pool) race move_to_end/popitem
+        # without this; the lock is uncontended in the scheduler-on
+        # steady state where one dispatcher thread owns the mesh
+        self._lock = threading.Lock()
 
     def get(self, key):
-        hit = self._od.get(key)
-        if hit is not None:
-            self._od.move_to_end(key)
-            return hit[0]
-        return None
+        with self._lock:
+            hit = self._od.get(key)
+            if hit is not None:
+                self._od.move_to_end(key)
+                return hit[0]
+            return None
 
     def put(self, key, value, nbytes: int) -> None:
-        old = self._od.pop(key, None)
-        if old is not None:
-            self._bytes -= old[1]
-        self._od[key] = (value, nbytes)
-        self._bytes += nbytes
-        while self._bytes > self._max and len(self._od) > 1:
-            _k, (_v, nb) = self._od.popitem(last=False)
-            self._bytes -= nb
+        with self._lock:
+            old = self._od.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._od[key] = (value, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self._max and len(self._od) > 1:
+                _k, (_v, nb) = self._od.popitem(last=False)
+                self._bytes -= nb
 
     def __len__(self) -> int:
         return len(self._od)
@@ -134,7 +142,17 @@ class MeshSearchService:
         # (index, field, kind, interval, offset) ->
         #     (generation, (bins_dev, min_b, nb)-or-None)
         self._stacked_bins = _ByteLRU(self._COLS_MAX_BYTES // 4)
+        # SPMD program invocations must not interleave: two concurrent
+        # runs of a collective program cross-join their per-device
+        # participants at the XLA rendezvous and deadlock (observed on
+        # the CPU backend under scheduler-off concurrent REST traffic).
+        # One launch at a time is also the physical truth — the chip
+        # serializes programs; the serving scheduler makes this lock
+        # uncontended (a single dispatcher thread owns the mesh)
+        import threading
+        self._dispatch_lock = threading.Lock()
         self.dispatched = 0      # searches served by the mesh
+        self.launches = 0        # scoring-program invocations (group = 1)
         self.fallbacks = 0       # searches declined -> host loop
         self.filtered_dispatched = 0   # of dispatched: bool-with-filters
         self.terms_agg_dispatched = 0  # of dispatched: with a terms agg
@@ -913,20 +931,22 @@ class MeshSearchService:
             nt_key = len(lt.terms) if is_phrase else 0
             groups.setdefault((is_phrase, nt_key, lt.field, k1, b_eff,
                                k_class, fkey), []).append(item)
-        for (is_phrase, nt_key, field, k1, b_eff, k_class,
-             _fkey), items in groups.items():
-            with TRACER.span("mesh.dispatch_group", field=field,
-                             k_class=k_class, queries=len(items),
-                             phrase=is_phrase):
-                if is_phrase:
-                    self._run_phrase_group(name, svc, bodies, out,
-                                           shard_segs, stats, searchers,
-                                           field, nt_key, k1, b_eff,
-                                           k_class, items)
-                else:
-                    self._run_mesh_group(name, svc, bodies, out, shard_segs,
-                                         stats, searchers, field, k1, b_eff,
-                                         k_class, items)
+        with self._dispatch_lock:
+            for (is_phrase, nt_key, field, k1, b_eff, k_class,
+                 _fkey), items in groups.items():
+                with TRACER.span("mesh.dispatch_group", field=field,
+                                 k_class=k_class, queries=len(items),
+                                 phrase=is_phrase):
+                    if is_phrase:
+                        self._run_phrase_group(name, svc, bodies, out,
+                                               shard_segs, stats,
+                                               searchers, field, nt_key,
+                                               k1, b_eff, k_class, items)
+                    else:
+                        self._run_mesh_group(name, svc, bodies, out,
+                                             shard_segs, stats, searchers,
+                                             field, k1, b_eff, k_class,
+                                             items)
         return self._mark_declined(bodies, out)
 
     def _mark_declined(self, bodies, out) -> list:
@@ -1070,6 +1090,11 @@ class MeshSearchService:
                  if filtered else None)
         fn = self._program_for(mesh, bucket, stacked.ndocs_pad, K, k1,
                                b_eff, filtered)
+        # one scoring-program invocation serves the whole query group —
+        # THE denominator for the serving scheduler's coalescing win
+        # (scripts/measure_concurrency.py: invocations per query)
+        self.launches += 1
+        METRICS.counter("mesh.launches").inc()
         gdocs_b, gvals_b, totals_b = fn(stacked.tree(), rows, boosts, msm,
                                         cscore, fmask)
         import jax
@@ -1716,6 +1741,8 @@ class MeshSearchService:
                                       n_terms, k1, b_eff, filtered)
         args = (stacked.tree(), pairs.tree(), rows, weights, slops,
                 avgdl) + ((fmask,) if filtered else ())
+        self.launches += 1
+        METRICS.counter("mesh.launches").inc()
         gdocs_b, gvals_b, totals_b = jax.device_get(fn(*args))
 
         self._emit_mesh_results(name, bodies, out, shard_segs, stats,
@@ -1973,6 +2000,7 @@ class MeshSearchService:
 
     def stats(self) -> dict:
         return {"devices": len(self.devices), "dispatched": self.dispatched,
+                "launches": self.launches,
                 "fallbacks": self.fallbacks,
                 "fallback_shapes": dict(self.fallback_shapes),
                 "filtered_dispatched": self.filtered_dispatched,

@@ -406,10 +406,15 @@ class RestClient:
                                         phase_ctx=phase_ctx)
                 return self._apply_response_pipeline(pipeline, resp,
                                                      phase_ctx, body)
+            # serving-scheduler lane: scroll-initiating searches ride the
+            # batch lane; everything else inherits its workload group's
+            # lane (interactive preempts batch at flush time)
             resp = self.node.search(
                 index, body, phase_hook=phase_hook, phase_ctx=phase_ctx,
                 copy_protect=bool(pipeline is not None
-                                  and pipeline.response_procs))
+                                  and pipeline.response_procs),
+                wlm_lane=("batch" if scroll
+                          else getattr(wg, "lane", "interactive")))
         except dsl.QueryParseError as e:
             # malformed DSL is a client error, not an engine crash
             raise ApiError(400, "parsing_exception", str(e))
@@ -695,11 +700,14 @@ class RestClient:
             # concurrent per-body fallback (reference
             # TransportMultiSearchAction runs items concurrently too):
             # device steps serialize but host work and device round trips
-            # overlap across bodies
-            import concurrent.futures as _cf
-            with _cf.ThreadPoolExecutor(max_workers=min(8, len(todo))) as ex:
-                for i, resp in zip(todo, ex.map(run_one, todo)):
-                    partial[i] = resp
+            # overlap across bodies. Runs on the node's named "search"
+            # pool (utils/threadpool.py) instead of a throwaway executor —
+            # bounded node-wide, counted in _nodes/stats, and the pool's
+            # contextvars carry the request's trace span into the workers
+            futs = [(i, self.node.thread_pools.pool("search").submit(
+                run_one, i)) for i in todo]
+            for i, fut in futs:
+                partial[i] = fut.result()
         else:
             for i in todo:
                 partial[i] = run_one(i)
@@ -868,6 +876,9 @@ class RestClient:
             "tasks": n.tasks.stats(),
             "wlm": n.wlm.stats(),
             "search_backpressure": n.search_backpressure.stats(),
+            # serving scheduler (serving/scheduler.py): queue depth,
+            # batch-size / queue-wait percentiles, flush reasons, lanes
+            "serving": n.serving.stats(),
             "search_pipelines": n.search_pipelines.stats(),
             "tracing": n.tracer.stats(),
             # device query-phase telemetry: kernel serve/fallback counters
@@ -994,10 +1005,14 @@ class RestClient:
 
     def put_workload_group(self, name: str, body: Optional[dict] = None) -> dict:
         body = body or {}
-        self.node.wlm.put_group(name, body.get("search_rate"),
-                                body.get("search_burst"),
-                                body.get("resource_limits"),
-                                body.get("mode", "monitor"))
+        try:
+            self.node.wlm.put_group(name, body.get("search_rate"),
+                                    body.get("search_burst"),
+                                    body.get("resource_limits"),
+                                    body.get("mode", "monitor"),
+                                    body.get("lane", "interactive"))
+        except ValueError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e))
         return {"acknowledged": True}
 
     # ---------------- search templates (reference modules/lang-mustache) ----

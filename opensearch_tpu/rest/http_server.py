@@ -16,6 +16,12 @@ auto-create). So concurrent HTTP writers on different indices proceed in
 parallel, two names resolving to one engine share one lock, and this
 transport stays lock-free.
 
+Under concurrent search load the per-thread requests do NOT each pay a
+device dispatch: eligible searches coalesce in the serving scheduler
+(`serving/scheduler.py`, docs/SERVING.md) into one batched program
+invocation per flush, and `stop()` drains that queue before the
+transport goes away.
+
 Usage:
     srv = HttpServer(client)          # or HttpServer(port=9200)
     port = srv.start()                # background thread, returns port
@@ -616,3 +622,10 @@ class HttpServer:
             self._srv.shutdown()
             self._srv.server_close()
             self._srv = None
+            # drain the serving scheduler so queued searches resolve
+            # before the transport disappears — WITHOUT closing it: the
+            # scheduler belongs to the Node, which may outlive this
+            # transport (serving/scheduler.py)
+            serving = getattr(self.client.node, "serving", None)
+            if serving is not None:
+                serving.drain()

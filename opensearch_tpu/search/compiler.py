@@ -3210,11 +3210,24 @@ def _nested_sort_values(seg: Segment, field: str, path: str, mode: str):
     """Per-parent aggregate of a nested child numeric column (reference
     NestedSortBuilder): min/max/sum/avg over each parent's block children.
     Cached per (field, path, mode). -> (values f64[ndocs], present bool) or
-    (None, None)."""
+    (None, None). The per-segment lock keeps concurrent first computations
+    of one key from double-charging the breaker (only one cache write
+    wins, but both finalizers would release)."""
     cache = seg.__dict__.setdefault("_nested_sort_cache", {})
     key = (field, path, mode)
     if key in cache:
         return cache[key]
+    lock = seg.__dict__.setdefault("_nested_sort_lock",
+                                   __import__("threading").Lock())
+    with lock:
+        if key in cache:
+            return cache[key]
+        return _nested_sort_values_build(seg, cache, key, field, path,
+                                         mode)
+
+
+def _nested_sort_values_build(seg: Segment, cache: dict, key, field: str,
+                              path: str, mode: str):
     blk = seg.nested.get(path)
     col = blk.child.numeric_cols.get(field) if blk is not None else None
     if col is None:
@@ -3241,6 +3254,17 @@ def _nested_sort_values(seg: Segment, field: str, path: str, mode: str):
         np.add.at(cnt, p, 1.0)
         out = np.divide(out, np.maximum(cnt, 1.0))
     out = np.where(present, out, 0.0)
+    # parent-docs-scale columns cached for the segment's lifetime: charge
+    # the same fielddata budget the fastpath layouts use, released when
+    # the (immutable) segment is GC'd — the cache dict lives on it
+    from ..index import segment as _segment_mod
+    _nb_breaker = _segment_mod._breaker
+    if _nb_breaker is not None:
+        import weakref
+        nbytes = out.nbytes + present.nbytes
+        _nb_breaker.add_estimate(nbytes,
+                                 f"nested-sort[{seg.name}][{path}.{field}]")
+        weakref.finalize(seg, _nb_breaker.release, nbytes)
     cache[key] = (out, present)
     return cache[key]
 

@@ -790,6 +790,9 @@ def _launch_pure_groups(seg: Segment,
         avg = np.array([[v.avgdl] for v in gvqs], np.float32)
         dlo = np.array([[v.dlo] for v in gvqs], np.int32)
         dhi = np.array([[v.dhi] for v in gvqs], np.int32)
+        # per-launch attribution (scripts/measure_concurrency.py divides
+        # served queries by launches to report the coalescing ratio)
+        METRICS.counter("fastpath.launches").inc()
         scores, docs, totals = fused_bm25_topk_tfdl(
             al.d_docs, al.d_tfdl, rowstarts, nrows, lens, skips, weights,
             msm, avg, dlo, dhi, T=T_pad, L=L, K=K_launch, k1=k1, b=b_eff)
@@ -1915,6 +1918,7 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         avg = np.array([[v.avgdl] for v in gvqs], np.float32)
         dlo = np.array([[v.dlo] for v in gvqs], np.int32)
         dhi = np.array([[v.dhi] for v in gvqs], np.int32)
+        METRICS.counter("fastpath.launches").inc()
         scores, docs, totals = fused_bm25_bool_topk(
             d_docs, d_tfdl, filt, rowstarts, nrows, lens, skips, weights,
             cw, thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,

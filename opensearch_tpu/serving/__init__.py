@@ -1,0 +1,5 @@
+"""Serving layer: cross-request dynamic batching (docs/SERVING.md)."""
+
+from .scheduler import LANES, SchedulerConfig, ServingScheduler
+
+__all__ = ["ServingScheduler", "SchedulerConfig", "LANES"]

@@ -1,0 +1,579 @@
+"""Serving scheduler: cross-request dynamic batching with deadline-aware
+flush and priority lanes (docs/SERVING.md).
+
+The runtime's device programs batch over the QUERY axis (mesh
+`try_msearch` groups, fastpath `msearch_batched` kernel grids), but only
+queries arriving inside one `_msearch` body ever shared a launch —
+concurrent independent searches from `ThreadingHTTPServer` threads each
+paid their own dispatch and serialized on the chip. This scheduler sits
+between the REST layer and `MeshSearchService`: eligible searches enqueue
+into a bounded two-lane queue, and a single dispatcher thread flushes the
+pending set as ONE batched program invocation when either `max_batch`
+requests are waiting or the oldest has waited `max_wait_us` (whichever
+first). Per-request futures carry results, errors and timeouts back to
+the submitting HTTP threads.
+
+Contracts:
+
+- **Bit-identical results.** A flushed batch rides the exact query-axis
+  batching `_msearch` already uses (`MeshSearchService.try_msearch`,
+  `executor.msearch_batched`); per-query scoring is independent of batch
+  composition (pow2 query padding, per-row f32 accumulation, per-query
+  top-k merge), so a coalesced search serves the same pages, scores and
+  tie-breaks as a direct one — the f32 tie-serve contract from
+  docs/FASTPATH.md is untouched. `SchedulerConfig.oracle` (env
+  `OPENSEARCH_TPU_SCHED_ORACLE=1`) re-runs every coalesced body through
+  the direct path on the dispatcher thread and counts mismatches.
+- **Graceful degradation.** Non-coalescable shapes bypass the queue
+  unchanged (`accepts`); a closed scheduler, an entry still queued at
+  the request timeout (wedged dispatcher), or a batch execution error
+  falls back to direct per-request execution (an entry already claimed
+  into an in-flight batch is waited out, not duplicated) — the scheduler
+  can only ever make an eligible request *batched*, never make it fail.
+- **Cancellation.** A cancelled `utils/tasks.py` task is dropped from the
+  pending set before launch: `Task.on_cancel` wakes the scheduler, which
+  resolves the entry with `TaskCancelledException` without dispatching it.
+- **Admission.** The queue is bounded (`queue_cap`); a full queue rejects
+  with `PressureRejectedException` (HTTP 429) and is counted by
+  `SearchBackpressureService` — concurrency converts to backpressure, not
+  unbounded growth.
+
+Lanes: requests carry a lane from their `utils/wlm.py` workload group
+("interactive" default; groups configured with `lane: "batch"`, and
+scroll-initiating searches, ride the batch lane). At flush time the
+interactive lane preempts the batch lane: interactive entries fill the
+batch first, batch/scroll entries only take the leftover slots.
+
+All waiting uses `threading.Condition` / `threading.Event` — no sleep
+polling (oslint OSL503, docs/STATIC_ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import json as _json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from ..utils.metrics import METRICS, MetricsRegistry
+from ..utils.tasks import TaskCancelledException
+from ..utils.wlm import PressureRejectedException
+
+LANES = ("interactive", "batch")
+
+# body keys MeshSearchService._eligible statically declines — queueing
+# these shapes would add latency for a guaranteed host-loop outcome, so
+# they bypass the scheduler unchanged (the decline still happens at the
+# same place it does today, with the same attribution)
+_BYPASS_KEYS = ("knn", "rescore", "min_score", "profile", "collapse",
+                "suggest", "search_after", "highlight", "script_fields")
+
+# entry states (transitions under the scheduler condition lock)
+_QUEUED, _CLAIMED, _DONE, _ABANDONED = "queued", "claimed", "done", "abandoned"
+
+
+class SchedulerConfig:
+    """Tuning knobs (env defaults; see docs/SERVING.md for the
+    latency/throughput trade-off each one moves)."""
+
+    def __init__(self, max_batch: Optional[int] = None,
+                 max_wait_us: Optional[int] = None,
+                 queue_cap: Optional[int] = None,
+                 oracle: Optional[bool] = None,
+                 kernel_batching: bool = True,
+                 request_timeout_s: float = 30.0,
+                 idle_timeout_s: float = 5.0):
+        env = os.environ
+        self.max_batch = int(max_batch if max_batch is not None
+                             else env.get("OPENSEARCH_TPU_SCHED_MAX_BATCH",
+                                          32))
+        self.max_wait_us = int(max_wait_us if max_wait_us is not None
+                               else env.get(
+                                   "OPENSEARCH_TPU_SCHED_MAX_WAIT_US", 1000))
+        self.queue_cap = int(queue_cap if queue_cap is not None
+                             else env.get("OPENSEARCH_TPU_SCHED_QUEUE_CAP",
+                                          256))
+        if oracle is None:
+            oracle = env.get("OPENSEARCH_TPU_SCHED_ORACLE",
+                             "") not in ("", "0")
+        self.oracle = bool(oracle)
+        # also coalesce mesh-declined / mesh-less bodies through the
+        # fastpath's grouped kernel launches (executor.msearch_batched)
+        self.kernel_batching = bool(kernel_batching)
+        self.request_timeout_s = float(request_timeout_s)
+        self.idle_timeout_s = float(idle_timeout_s)
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_wait_us < 0:
+            raise ValueError("max_wait_us must be >= 0")
+        if self.queue_cap < 1:
+            raise ValueError("queue_cap must be >= 1")
+
+
+class _Pending:
+    __slots__ = ("name", "svc", "body", "lane", "task", "enq", "done",
+                 "resp", "error", "state")
+
+    def __init__(self, name: str, svc, body: dict, lane: str, task):
+        self.name = name
+        self.svc = svc
+        self.body = body
+        self.lane = lane
+        self.task = task
+        self.enq = time.monotonic()
+        self.done = threading.Event()
+        self.resp = None            # response dict, or None (-> host loop)
+        self.error: Optional[BaseException] = None
+        self.state = _QUEUED
+
+
+class ServingScheduler:
+    """One per Node. `execute()` is the only entry point the search path
+    uses; everything else is dispatcher machinery and telemetry."""
+
+    def __init__(self, node, config: Optional[SchedulerConfig] = None,
+                 enabled: Optional[bool] = None):
+        self.node = node
+        self.config = config or SchedulerConfig()
+        if enabled is None:
+            flag = os.environ.get("OPENSEARCH_TPU_SCHED")
+            if flag is not None:
+                enabled = flag not in ("", "0")
+            else:
+                # default: on whenever there is a device batching substrate
+                # worth coalescing for (the SPMD mesh); single-chip nodes
+                # opt in with OPENSEARCH_TPU_SCHED=1 (kernel batching)
+                enabled = node.mesh_service is not None
+        self.enabled = bool(enabled)
+        self._cond = threading.Condition()
+        self._lanes: Dict[str, deque] = {lane: deque() for lane in LANES}
+        self._pending = 0
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        # counters (mutated under self._cond; mirrored into METRICS)
+        self.submitted = 0
+        self.batched_served = 0     # resolved with a batched response
+        self.declined = 0           # resolved None -> host loop
+        self.bypassed = 0           # accepts() said no -> direct path
+        self.rejected = 0           # queue full -> 429
+        self.cancelled_dropped = 0  # dropped before launch
+        self.direct_fallbacks = 0   # degraded mode: ran direct
+        self.batch_errors = 0
+        self.flushes = 0
+        self.flush_reasons = {"size": 0, "deadline": 0, "drain": 0}
+        self.lane_flushed = {lane: 0 for lane in LANES}
+        self.oracle_checks = 0
+        self.oracle_mismatches = 0
+        self.last_oracle_mismatch: Optional[str] = None
+        # per-instance histogram mirror: the process-global METRICS
+        # registry feeds /_metrics, but THIS node's `_nodes/stats` block
+        # must not blend in a co-resident node's flushes (remote-cluster
+        # peers, multi-node tests share the process)
+        self._local = MetricsRegistry()
+
+    # ---------------- eligibility ----------------
+
+    def accepts(self, body) -> bool:
+        """Cheap coalescability screen. Permissive by design: anything it
+        lets through still goes through the mesh/fastpath's own
+        eligibility and falls back to the host loop on decline; this only
+        spares statically-hopeless shapes the queue wait."""
+        if not isinstance(body, dict):
+            return False
+        for k in _BYPASS_KEYS:
+            if body.get(k) is not None:
+                return False
+        q = body.get("query")
+        if q is not None and not isinstance(q, dict):
+            return False
+        return True
+
+    # ---------------- request side ----------------
+
+    def execute(self, name: str, svc, body: dict, task=None,
+                lane: str = "interactive"):
+        """Coalesce one eligible search into the next flushed batch.
+        Returns the batched response dict, or None when the batch path
+        declined the body (caller runs the host shard loop — identical to
+        a direct mesh decline). Raises PressureRejectedException when the
+        queue is full and TaskCancelledException when the request's task
+        was cancelled before launch."""
+        if lane not in self._lanes:
+            lane = "interactive"
+        entry = _Pending(name, svc, body, lane, task)
+        # ONE critical section for closed-check, admission, dispatcher
+        # liveness and enqueue: the dispatcher's idle-exit decision runs
+        # under the same condition, so an entry can never land in the
+        # queue with no dispatcher alive and none restarted
+        with self._cond:
+            if self._closed:
+                self.direct_fallbacks += 1
+                METRICS.counter("serving.direct_fallbacks").inc()
+                closed = True
+            elif self._pending >= self.config.queue_cap:
+                self.rejected += 1
+                METRICS.counter("serving.rejected").inc()
+                self.node.search_backpressure.note_queue_rejection()
+                raise PressureRejectedException(
+                    f"serving scheduler queue full "
+                    f"({self._pending}/{self.config.queue_cap} pending); "
+                    f"rejecting search")
+            else:
+                closed = False
+                if not self._dispatcher_alive():
+                    self._start_dispatcher()
+                self.submitted += 1
+                METRICS.counter("serving.submitted").inc()
+                METRICS.counter(f"serving.lane.{lane}.submitted").inc()
+                self._lanes[lane].append(entry)
+                self._pending += 1
+                METRICS.gauge("serving.queue_depth").set(self._pending)
+                self._cond.notify_all()
+        if closed:
+            return self._direct(name, svc, body)
+        if task is not None and hasattr(task, "on_cancel"):
+            # wake + drop the entry the moment its task is cancelled (the
+            # flush assembly re-checks as a backstop)
+            task.on_cancel(lambda _t, e=entry: self._drop_cancelled(e))
+        return self._await(entry)
+
+    def _await(self, entry: _Pending):
+        if not entry.done.wait(self.config.request_timeout_s):
+            with self._cond:
+                if entry.state == _QUEUED:
+                    # scheduler wedged with the entry still queued: pull it
+                    # and degrade to direct execution on this thread
+                    try:
+                        self._lanes[entry.lane].remove(entry)
+                        self._pending -= 1
+                        METRICS.gauge("serving.queue_depth").set(
+                            self._pending)
+                        self._cond.notify_all()
+                    except ValueError:
+                        pass
+                    entry.state = _ABANDONED
+                    self.direct_fallbacks += 1
+                    METRICS.counter("serving.direct_fallbacks").inc()
+            if entry.state == _ABANDONED:
+                return self._direct(entry.name, entry.svc, entry.body)
+            # claimed: the batch is in flight on the device — duplicating
+            # it would be wasteful, so wait it out
+            entry.done.wait()
+        if entry.error is not None:
+            raise entry.error
+        return entry.resp
+
+    def _drop_cancelled(self, entry: _Pending) -> None:
+        with self._cond:
+            if entry.state != _QUEUED:
+                return
+            try:
+                self._lanes[entry.lane].remove(entry)
+                self._pending -= 1
+                METRICS.gauge("serving.queue_depth").set(self._pending)
+                self._cond.notify_all()      # wake drain() waiters
+            except ValueError:
+                return
+            self._resolve_cancelled(entry)
+
+    def _resolve_cancelled(self, entry: _Pending) -> None:
+        entry.state = _DONE
+        entry.error = TaskCancelledException(
+            f"task [{getattr(entry.task, 'id', '?')}] cancelled while "
+            f"queued for batch dispatch: "
+            f"{getattr(entry.task, 'cancel_reason', None)}")
+        self.cancelled_dropped += 1
+        METRICS.counter("serving.cancelled_dropped").inc()
+        entry.done.set()
+
+    # ---------------- dispatcher side ----------------
+
+    def _dispatcher_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _start_dispatcher(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="ostpu-serving-dispatcher",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        me = threading.current_thread()
+        while True:
+            with self._cond:
+                # idle wait: exit after idle_timeout so test suites that
+                # spin up hundreds of Nodes don't accumulate parked
+                # threads; submit() restarts the dispatcher lazily
+                while self._pending == 0 and not self._closed:
+                    if not self._cond.wait(self.config.idle_timeout_s) \
+                            and self._pending == 0:
+                        if self._thread is me:
+                            self._thread = None
+                        return
+                if self._closed and self._pending == 0:
+                    return
+                reason = self._wait_flush()
+                if self._pending == 0:
+                    continue
+                batch = self._assemble(reason)
+            if batch:
+                try:
+                    self._dispatch(batch)
+                except BaseException:           # noqa: BLE001
+                    # never strand claimed entries: whatever killed the
+                    # dispatch, every waiter degrades to the host loop
+                    for e in batch:
+                        if not e.done.is_set():
+                            e.resp = None
+                            e.state = _DONE
+                            e.done.set()
+                    raise
+
+    def _wait_flush(self) -> str:
+        """Block (under the cond) until the flush policy fires: size
+        (max_batch pending) or deadline (oldest waited max_wait_us)."""
+        max_wait_s = self.config.max_wait_us / 1e6
+        while True:
+            if self._closed:
+                return "drain"
+            if self._pending >= self.config.max_batch:
+                return "size"
+            heads = [self._lanes[lane][0].enq for lane in LANES
+                     if self._lanes[lane]]
+            oldest = min(heads) if heads else None
+            if oldest is None:
+                return "deadline"     # emptied while we slept
+            remaining = max_wait_s - (time.monotonic() - oldest)
+            if remaining <= 0:
+                return "deadline"
+            self._cond.wait(remaining)
+
+    def _assemble(self, reason: str) -> List[_Pending]:
+        """Pop up to max_batch entries — interactive lane first (FIFO
+        within a lane, batch/scroll lane fills the leftover slots) — and
+        drop entries whose task was cancelled while queued. One slot is
+        reserved for the batch lane whenever it has waiters: preemption
+        means the interactive lane goes first, not that sustained
+        interactive saturation starves scroll traffic into its request
+        timeout."""
+        batch: List[_Pending] = []
+        for lane in LANES:                  # interactive preempts batch
+            cap = self.config.max_batch
+            if lane == "interactive" and self._lanes["batch"] and cap > 1:
+                cap -= 1                    # starvation guard
+            q = self._lanes[lane]
+            while q and len(batch) < cap:
+                entry = q.popleft()
+                self._pending -= 1
+                if entry.task is not None and \
+                        getattr(entry.task, "cancelled", False):
+                    self._resolve_cancelled(entry)
+                    continue
+                entry.state = _CLAIMED
+                batch.append(entry)
+                self.lane_flushed[lane] += 1
+                METRICS.counter(f"serving.lane.{lane}.flushed").inc()
+        METRICS.gauge("serving.queue_depth").set(self._pending)
+        self._cond.notify_all()          # wake drain() waiters
+        if batch:
+            self.flushes += 1
+            self.flush_reasons[reason] = \
+                self.flush_reasons.get(reason, 0) + 1
+            METRICS.counter(f"serving.flush.{reason}").inc()
+            METRICS.histogram("serving.batch_size").record(len(batch))
+            self._local.histogram("serving.batch_size").record(len(batch))
+            now = time.monotonic()
+            for e in batch:
+                wait_ms = (now - e.enq) * 1000.0
+                METRICS.histogram("serving.queue_wait").record(wait_ms)
+                self._local.histogram("serving.queue_wait").record(wait_ms)
+        return batch
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        """Run the flushed batch grouped by index and hand every entry its
+        result. Never raises: a failed group degrades its entries to the
+        host loop (resp None)."""
+        # group by (name, service identity), not name alone: two entries
+        # can hold DIFFERENT IndexService snapshots for one name (index
+        # deleted + recreated between their enqueues) and each must be
+        # served from its own service, like the direct path would
+        groups: Dict[tuple, List[_Pending]] = {}
+        for e in batch:
+            groups.setdefault((e.name, id(e.svc)), []).append(e)
+        for (name, _svc_id), entries in groups.items():
+            svc = entries[0].svc
+            bodies = [e.body for e in entries]
+            try:
+                resps = self._run_batch(name, svc, bodies)
+            except Exception:                       # noqa: BLE001
+                with self._cond:
+                    self.batch_errors += 1
+                METRICS.counter("serving.batch_errors").inc()
+                resps = [None] * len(entries)
+            if self.config.oracle:
+                self._oracle_check(name, svc, entries, resps)
+            with self._cond:
+                for e, r in zip(entries, resps):
+                    if r is not None:
+                        self.batched_served += 1
+                    else:
+                        self.declined += 1
+            for e, r in zip(entries, resps):
+                e.resp = r
+                e.state = _DONE
+                e.done.set()
+            METRICS.counter("serving.batched_served").inc(
+                sum(1 for r in resps if r is not None))
+            METRICS.counter("serving.declined").inc(
+                sum(1 for r in resps if r is None))
+
+    def _run_batch(self, name: str, svc, bodies: List[dict]) -> list:
+        """One batched program invocation over the pending bodies: the
+        SPMD mesh first (multi-shard), then the fastpath's grouped kernel
+        launches for the remainder. Entries still None take the host loop
+        on their own request threads — which also parallelizes the
+        host-side fallback work instead of serializing it here."""
+        node = self.node
+        resps: List[Optional[dict]] = [None] * len(bodies)
+        if node.mesh_service is not None:
+            mesh = node.mesh_service.try_msearch(name, svc, bodies)
+            if mesh is not None:
+                resps = list(mesh)
+        todo = [i for i, r in enumerate(resps) if r is None]
+        # kernel batching only when there is something to coalesce: a
+        # LONE mesh-declined body must take exactly the scheduler-off
+        # path (host loop, incl. its shard-view/pruned rung attribution)
+        # — coalescing may change execution only when it actually fuses
+        if self.config.kernel_batching and len(todo) >= 2:
+            from ..search.executor import msearch_batched
+            batched = msearch_batched(svc.searchers,
+                                      [bodies[i] for i in todo],
+                                      index_name=name)
+            if batched is not None:
+                for i, r in zip(todo, batched):
+                    if resps[i] is None:
+                        resps[i] = r
+        return resps
+
+    # ---------------- degraded / oracle paths ----------------
+
+    def _direct(self, name: str, svc, body: dict):
+        """Direct per-request execution — exactly what Node.search does
+        with the scheduler off (mesh attempt; host loop stays with the
+        caller, which treats None as a decline)."""
+        if self.node.mesh_service is not None:
+            return self.node.mesh_service.try_search(name, svc, body)
+        return None
+
+    def _oracle_reference(self, name: str, svc, body: dict):
+        """The direct-execution equivalent of a SERVED batched body:
+        the mesh when it serves the shape, else a batch-of-one kernel
+        launch (probing the grouped kernel path's batch-size
+        invariance) — mirroring the two stages _run_batch composes."""
+        if self.node.mesh_service is not None:
+            direct = self.node.mesh_service.try_search(name, svc, body)
+            if direct is not None:
+                return direct
+        from ..search.executor import msearch_batched
+        single = msearch_batched(svc.searchers, [body], index_name=name)
+        return single[0] if single is not None else None
+
+    @staticmethod
+    def _normalize(resp) -> Optional[str]:
+        if resp is None:
+            return None
+        out = {k: v for k, v in resp.items() if k != "took"}
+        return _json.dumps(out, sort_keys=True, default=repr)
+
+    def _oracle_check(self, name: str, svc, entries: List[_Pending],
+                      resps: list) -> None:
+        """Run every body through the direct path too and compare (modulo
+        wall-clock `took`). Dispatch counters run twice in this mode — it
+        exists to prove the identical-results contract, not to serve."""
+        for e, r in zip(entries, resps):
+            if r is None:
+                # declined (or error-degraded): the caller's host loop
+                # serves it — nothing BATCHED was produced to verify
+                continue
+            oracle_body = _copy.deepcopy(e.body)
+            oracle_body.pop("_mesh_declined", None)
+            try:
+                direct = self._oracle_reference(name, svc, oracle_body)
+                match = self._normalize(r) == self._normalize(direct)
+            except Exception:                       # noqa: BLE001
+                match = False
+            with self._cond:
+                self.oracle_checks += 1
+                if not match:
+                    self.oracle_mismatches += 1
+                    self.last_oracle_mismatch = (
+                        f"index [{name}] body "
+                        f"{_json.dumps(e.body, default=repr)[:400]}: "
+                        f"batched != direct")
+            METRICS.counter("serving.oracle_checks").inc()
+            if not match:
+                METRICS.counter("serving.oracle_mismatches").inc()
+
+    # ---------------- lifecycle + stats ----------------
+
+    def drain(self, timeout: float = 5.0) -> bool:
+        """Block until the pending queue is empty WITHOUT closing the
+        scheduler (a transport shutting down must not end the Node-wide
+        scheduler's life — another transport, or the dict API, keeps
+        coalescing). Returns False when the timeout expired first."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(remaining)
+            return True
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the dispatcher. With drain=True pending entries are
+        flushed one last time; without it they degrade to direct
+        execution via the request-thread timeout path."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None and drain:
+            t.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        with self._cond:
+            depth = self._pending
+            out = {
+                "enabled": self.enabled,
+                "queue_depth": depth,
+                "queue_cap": self.config.queue_cap,
+                "max_batch": self.config.max_batch,
+                "max_wait_us": self.config.max_wait_us,
+                "submitted": self.submitted,
+                "batched_served": self.batched_served,
+                "declined": self.declined,
+                "bypassed": self.bypassed,
+                "rejected": self.rejected,
+                "cancelled_dropped": self.cancelled_dropped,
+                "direct_fallbacks": self.direct_fallbacks,
+                "batch_errors": self.batch_errors,
+                "flushes": self.flushes,
+                "flush_reasons": dict(self.flush_reasons),
+                "lanes": {lane: {"flushed": self.lane_flushed[lane]}
+                          for lane in LANES},
+                "oracle": {"enabled": self.config.oracle,
+                           "checks": self.oracle_checks,
+                           "mismatches": self.oracle_mismatches},
+            }
+        out["batch_size"] = self._local.percentiles("serving.batch_size")
+        out["queue_wait_ms"] = self._local.percentiles("serving.queue_wait")
+        return out
+
+    def note_bypass(self) -> None:
+        with self._cond:
+            self.bypassed += 1
+        METRICS.counter("serving.bypassed").inc()

@@ -34,6 +34,14 @@ class SearchBackpressureService:
         self.cancellation_count = 0
         self.rejection_count = 0
         self.limit_reached_count = 0
+        # serving-scheduler queue-full rejections (serving/scheduler.py):
+        # the scheduler's bounded queue is an admission surface too, and
+        # its 429s belong in the same backpressure ledger operators watch
+        self.scheduler_rejection_count = 0
+
+    def note_queue_rejection(self) -> None:
+        """A serving-scheduler enqueue was rejected (queue full -> 429)."""
+        self.scheduler_rejection_count += 1
 
     # -------- admission (reference admissioncontrol) --------
 
@@ -81,6 +89,7 @@ class SearchBackpressureService:
                 "cancellation_count": self.cancellation_count,
                 "limit_reached_count": self.limit_reached_count,
                 "rejection_count": self.rejection_count,
+                "scheduler_rejection_count": self.scheduler_rejection_count,
                 "cancel_min_device_seconds": self.cancel_min_device_s,
                 "max_in_flight": self.max_in_flight,
                 "hard_limit": self.hard_limit,

@@ -33,15 +33,44 @@ class Task:
         # TaskResourceTrackingService): accumulated at segment boundaries
         self.device_seconds = 0.0
         self.mem_bytes = 0
+        # cancellation listeners (serving/scheduler.py drops queued
+        # entries the moment their task is cancelled, instead of waiting
+        # for the next flush assembly to notice)
+        self._cancel_listeners: list = []
+        self._listener_lock = threading.Lock()
 
     def track(self, device_seconds: float = 0.0, mem_bytes: int = 0) -> None:
         self.device_seconds += device_seconds
         self.mem_bytes += mem_bytes
 
+    def on_cancel(self, callback) -> None:
+        """Register `callback(task)` to run when this task is cancelled;
+        fires immediately if the task is already cancelled. Listener
+        errors never poison the canceller."""
+        fire = False
+        with self._listener_lock:
+            if self.cancelled:
+                fire = True
+            else:
+                self._cancel_listeners.append(callback)
+        if fire:
+            try:
+                callback(self)
+            except Exception:       # noqa: BLE001
+                pass
+
     def cancel(self, reason: str = "by user request") -> None:
-        if self.cancellable:
+        if not self.cancellable:
+            return
+        with self._listener_lock:
             self.cancelled = True
             self.cancel_reason = reason
+            listeners, self._cancel_listeners = self._cancel_listeners, []
+        for cb in listeners:
+            try:
+                cb(self)
+            except Exception:       # noqa: BLE001
+                pass
 
     def ensure_not_cancelled(self) -> None:
         if self.cancelled:

@@ -50,12 +50,22 @@ class NamedPool:
 
 
 class ThreadPools:
-    """The node's pool set: write (bulk persistence), snapshot (repo IO),
-    management (merges, refresh bookkeeping), generic."""
+    """The node's pool set: search (msearch per-body fallback fan-out),
+    write (bulk persistence), snapshot (repo IO), management (merges,
+    refresh bookkeeping), generic.
+
+    Waiting discipline (oslint OSL503): code coordinating with these pools
+    blocks on `Future.result()` / `threading.Condition` / `Event`, never a
+    `time.sleep` polling loop — a poll both wastes a core and adds up to a
+    full poll interval of latency per hop."""
 
     def __init__(self, cores: int = 0):
         n = cores or os.cpu_count() or 1
         self.pools: Dict[str, NamedPool] = {
+            # reference search pool sizing is ~1.5x cores; host search
+            # work here is the msearch fallback + fetch fan-out, so a
+            # modest cap keeps the GIL convoy bounded
+            "search": NamedPool("search", max(2, min((3 * n) // 2, 12))),
             "write": NamedPool("write", max(1, n)),
             "snapshot": NamedPool("snapshot", max(1, min(n, 4))),
             "management": NamedPool("management", max(1, min(n, 2))),

@@ -105,8 +105,14 @@ class WorkloadGroup:
     def __init__(self, name: str, search_rate: Optional[float] = None,
                  search_burst: Optional[float] = None,
                  resource_limits: Optional[Dict[str, float]] = None,
-                 mode: str = "monitor"):
+                 mode: str = "monitor", lane: str = "interactive"):
         self.name = name
+        # serving-scheduler priority lane (serving/scheduler.py): the
+        # interactive lane preempts the batch lane at flush time; groups
+        # carrying offline/scroll traffic declare `lane: "batch"`
+        if lane not in ("interactive", "batch"):
+            raise ValueError(f"unknown workload lane [{lane}]")
+        self.lane = lane
         # rate=0 means "block" (a bucket that never refills), not unlimited;
         # burst=0 is honored (only refill admits)
         self.bucket = (TokenBucket(search_rate,
@@ -144,6 +150,7 @@ class WorkloadGroup:
                 "resource_rejections": self.resource_rejections,
                 "rate_limited": self.bucket is not None,
                 "mode": self.mode,
+                "lane": self.lane,
                 "resource_limits": self.resource_limits,
                 "cpu_usage_rate": round(self.usage.rate(), 4)}
 
@@ -157,9 +164,10 @@ class WorkloadManagement:
     def put_group(self, name: str, search_rate: Optional[float] = None,
                   search_burst: Optional[float] = None,
                   resource_limits: Optional[Dict[str, float]] = None,
-                  mode: str = "monitor") -> WorkloadGroup:
+                  mode: str = "monitor",
+                  lane: str = "interactive") -> WorkloadGroup:
         g = WorkloadGroup(name, search_rate, search_burst,
-                          resource_limits, mode)
+                          resource_limits, mode, lane)
         self.groups[name] = g
         return g
 

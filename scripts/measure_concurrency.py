@@ -1,0 +1,256 @@
+"""Closed-loop concurrency benchmark for the serving scheduler, on the
+8-virtual-device CPU mesh (no tunnel needed): index a scaled-down bench
+corpus across 4 shards, then hammer the product search path with
+N ∈ {1, 8, 32, 64} client threads, scheduler ON vs OFF, over the bench's
+match + filtered-bool mix.
+
+Per (N, mode) cell it reports QPS, p50/p95 request latency (DDSketch
+percentiles from utils/metrics.py — the registry's bin math), device
+scoring-program invocations (`mesh.launches` + `fastpath.launches`), and
+the mean flushed batch size; it asserts every response is byte-identical
+(modulo wall-clock `took`) across ALL cells, and — the acceptance gate —
+that at 32 threads the scheduler cuts program invocations >= 4x with a
+mean batch >= 4.
+
+Results land in BENCH_out.json under `extra.concurrency` (merged into an
+existing bench emission when present). Run:
+    python scripts/measure_concurrency.py [ndocs]
+Env: CONC_NQ (queries per cell, default 256), CONC_THREADS (comma list,
+default 1,8,32,64), CONC_ASSERT=0 to report without gating.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def build_client(ndocs: int):
+    import bench as B
+    from opensearch_tpu.cluster.node import Node
+    from opensearch_tpu.parallel import MeshSearchService
+    from opensearch_tpu.rest.client import RestClient
+
+    rng = np.random.default_rng(3)
+    starts, doc_ids, tfs, dl, df_per_term = B._cached(
+        f"body_{ndocs}", lambda: B.build_corpus(ndocs), True)
+    queries = B.pick_queries(df_per_term, 4096)
+    vocab_strs = [f"t{i:07d}" for i in range(len(df_per_term))]
+
+    svc = MeshSearchService()
+    client = RestClient(node=Node(mesh_service=svc))
+    client.indices.create("bench", {
+        "settings": {"number_of_shards": 4},
+        "mappings": {"properties": {
+            "body": {"type": "text"}, "status": {"type": "keyword"},
+            "price": {"type": "integer"}}}})
+    status_vals = ["draft", "review", "published"]
+    order = np.argsort(doc_ids, kind="stable")
+    term_of_posting = np.repeat(
+        np.arange(len(df_per_term)), np.diff(starts).astype(np.int64))
+    d_sorted = doc_ids[order]
+    t_sorted = term_of_posting[order]
+    tf_sorted = tfs[order].astype(np.int64)
+    bounds = np.searchsorted(d_sorted, np.arange(ndocs + 1))
+    bulk = []
+    for d in range(ndocs):
+        a, b = bounds[d], bounds[d + 1]
+        toks = np.repeat(t_sorted[a:b], tf_sorted[a:b])
+        bulk.append({"index": {"_index": "bench", "_id": str(d)}})
+        bulk.append({"body": " ".join(vocab_strs[t] for t in toks[:48]),
+                     "status": status_vals[d % 3],
+                     "price": int(rng.integers(0, 1000))})
+        if len(bulk) >= 20_000:
+            client.bulk(bulk)
+            bulk = []
+    if bulk:
+        client.bulk(bulk)
+    client.indices.refresh("bench")
+    client.indices.forcemerge("bench")
+    return client, queries, vocab_strs
+
+
+def make_bodies(queries, vocab_strs, nq: int):
+    """The bench mix the mesh serves: 60% two-term match, 40% filtered
+    bool — the cross-request coalescing target."""
+    bodies = []
+    for i in range(nq):
+        q = queries[i % len(queries)]
+        if i % 5 < 3:
+            bodies.append({"query": {"match": {"body": (
+                f"{vocab_strs[q[0]]} {vocab_strs[q[1]]}")}}, "size": 10})
+        else:
+            bodies.append({"query": {"bool": {
+                "must": [{"match": {"body": vocab_strs[q[0]]}}],
+                "filter": [{"term": {"status": "published"}}]}},
+                "size": 10})
+    return bodies
+
+
+def strip_took(resp: dict) -> str:
+    return json.dumps({k: v for k, v in resp.items() if k != "took"},
+                      sort_keys=True)
+
+
+def run_cell(client, bodies, nthreads: int, sched_on: bool, tag: str):
+    """Closed loop: `nthreads` client threads drain the shared query list;
+    every thread records its request wall into a DDSketch histogram."""
+    from opensearch_tpu.utils.metrics import METRICS, MetricsRegistry
+
+    node = client.node
+    node.serving.enabled = sched_on
+    mesh = node.mesh_service
+    reg = MetricsRegistry()
+    hist = reg.histogram("request_ms")
+    serving0 = node.serving.stats()
+    launches0 = mesh.launches
+    fp0 = METRICS.counter("fastpath.launches").value
+    results = [None] * len(bodies)
+    errors = []
+    cursor = [0]
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = cursor[0]
+                if i >= len(bodies):
+                    return
+                cursor[0] = i + 1
+            body = dict(bodies[i], _bench=f"conc-{tag}-{i}")
+            t0 = time.perf_counter()
+            try:
+                results[i] = client.search("bench", body)
+            except Exception as e:              # noqa: BLE001
+                # record and keep draining: one transient failure must
+                # not silently shrink the cell (the errored gate still
+                # fails the run, with honest per-cell counts)
+                errors.append(f"q{i}: {e!r}")
+                continue
+            hist.record((time.perf_counter() - t0) * 1000.0)
+
+    t0 = time.time()
+    threads = [threading.Thread(target=worker) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    serving1 = node.serving.stats()
+    launches = (mesh.launches - launches0) + \
+        (METRICS.counter("fastpath.launches").value - fp0)
+    flushes = serving1["flushes"] - serving0["flushes"]
+    batched = serving1["batched_served"] - serving0["batched_served"]
+    snap = hist.snapshot((50, 95))
+    cell = {
+        "threads": nthreads,
+        "scheduler": "on" if sched_on else "off",
+        "n": len(bodies),
+        "errors": len(errors),
+        "wall_s": round(wall, 3),
+        "qps": round(len(bodies) / wall, 1),
+        "p50_ms": snap["p50_ms"],
+        "p95_ms": snap["p95_ms"],
+        "program_invocations": int(launches),
+        "batched_served": batched,
+        "flushes": flushes,
+        "mean_batch": round(batched / flushes, 2) if flushes else None,
+    }
+    if errors:
+        cell["first_errors"] = errors[:3]
+    return cell, results
+
+
+def main():
+    ndocs = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
+    nq = int(os.environ.get("CONC_NQ", 256))
+    thread_counts = [int(t) for t in
+                     os.environ.get("CONC_THREADS", "1,8,32,64").split(",")]
+    gate = os.environ.get("CONC_ASSERT", "1") not in ("0", "")
+    t0 = time.time()
+    client, queries, vocab_strs = build_client(ndocs)
+    bodies = make_bodies(queries, vocab_strs, nq)
+    print(f"setup {time.time()-t0:.1f}s ndocs={ndocs} nq={nq}", flush=True)
+
+    canonical = None
+    cells = []
+    mismatched = 0
+    errored = 0
+    by_key = {}
+    for nthreads in thread_counts:
+        for sched_on in (False, True):
+            tag = f"{nthreads}-{'on' if sched_on else 'off'}"
+            cell, results = run_cell(client, bodies, nthreads, sched_on,
+                                     tag)
+            errored += cell["errors"]
+            digests = [strip_took(r) if r is not None else None
+                       for r in results]
+            if canonical is None:
+                canonical = digests
+            bad = sum(1 for a, b in zip(digests, canonical) if a != b)
+            cell["identical_responses"] = bad == 0
+            mismatched += bad
+            cells.append(cell)
+            by_key[(nthreads, sched_on)] = cell
+            print(json.dumps(cell), flush=True)
+
+    summary = {"ndocs": ndocs, "nq": nq,
+               "devices": len(jax.devices()),
+               "mix": "60% match2 / 40% filtered bool",
+               "identical_responses": mismatched == 0,
+               "cells": cells}
+    off32 = by_key.get((32, False))
+    on32 = by_key.get((32, True))
+    if off32 and on32 and on32["program_invocations"]:
+        summary["invocation_reduction_32t"] = round(
+            off32["program_invocations"] / on32["program_invocations"], 2)
+        summary["mean_batch_32t"] = on32["mean_batch"]
+        summary["qps_speedup_32t"] = round(
+            on32["qps"] / max(off32["qps"], 1e-9), 2)
+
+    # merge into the BENCH json emission (extra.concurrency)
+    out_path = os.path.join(_REPO, "BENCH_out.json")
+    try:
+        with open(out_path) as f:
+            bench_doc = json.load(f)
+    except (OSError, ValueError):
+        bench_doc = {"metric": "bm25_rest_qps_per_chip", "value": None,
+                     "unit": "queries/sec", "vs_baseline": None,
+                     "extra": {"status": "concurrency_only"}}
+    bench_doc.setdefault("extra", {})["concurrency"] = summary
+    with open(out_path, "w") as f:
+        json.dump(bench_doc, f, indent=2)
+    print(json.dumps({"summary": {k: v for k, v in summary.items()
+                                  if k != "cells"}}), flush=True)
+
+    if gate:
+        if errored:
+            raise SystemExit(f"{errored} request(s) errored")
+        if mismatched:
+            raise SystemExit(f"{mismatched} response(s) diverged between "
+                             f"cells — the scheduler broke bit-identity")
+        if off32 and on32:
+            red = summary.get("invocation_reduction_32t", 0)
+            mb = summary.get("mean_batch_32t") or 0
+            if red < 4:
+                raise SystemExit(f"program-invocation reduction at 32 "
+                                 f"threads is {red}x (< 4x)")
+            if mb < 4:
+                raise SystemExit(f"mean flushed batch at 32 threads is "
+                                 f"{mb} (< 4)")
+    print("OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

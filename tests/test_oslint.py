@@ -415,6 +415,103 @@ class TestTelemetryRules:
 
 
 # ----------------------------------------------------------------------
+# OSL503 wait discipline (no sleep-polling)
+# ----------------------------------------------------------------------
+
+class TestWaitDiscipline:
+    def test_osl503_sleep_polling_loop(self):
+        # the classic flush-wait bug the serving scheduler must not have:
+        # poll a flag on a fixed interval instead of waiting on a signal
+        src = """
+            import time
+
+            def wait_ready(state):
+                while not state.ready:
+                    time.sleep(0.01)
+        """
+        assert "OSL503" in rules_of(
+            lint(src, "opensearch_tpu/serving/scheduler.py"))
+
+    def test_osl503_from_import_alias_in_for_loop(self):
+        src = """
+            from time import sleep as snooze
+
+            def retry(fn):
+                for _ in range(5):
+                    snooze(0.1)
+                    fn()
+        """
+        assert "OSL503" in rules_of(
+            lint(src, "opensearch_tpu/utils/threadpool.py"))
+
+    def test_osl503_quiet_on_condition_wait(self):
+        src = """
+            import threading
+            import time
+
+            def wait_flush(cond, pending, deadline):
+                with cond:
+                    while not pending():
+                        cond.wait(0.01)
+                time.sleep(0.5)      # one-shot grace, not a poll
+        """
+        assert rules_of(lint(src, "opensearch_tpu/serving/scheduler.py")) \
+            == []
+
+    def test_osl503_out_of_scope_module_quiet(self):
+        src = """
+            import time
+
+            def spin():
+                while True:
+                    time.sleep(1.0)
+        """
+        # wait discipline patrols serving/, utils/, rest/
+        assert rules_of(lint(src, "opensearch_tpu/search/executor.py")) \
+            == []
+
+    def test_osl503_loop_else_clause_quiet(self):
+        # the else clause runs at most once after the loop — a one-shot
+        # grace sleep there is not polling; a sleep in the while TEST
+        # re-evaluates every iteration and IS
+        src = """
+            import time
+
+            def wait(state):
+                while state.busy():
+                    state.step()
+                else:
+                    time.sleep(0.2)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/utils/threadpool.py")) \
+            == []
+        src_test = """
+            import time
+
+            def wait(state):
+                while time.sleep(0.1) or state.busy():
+                    state.step()
+        """
+        assert "OSL503" in rules_of(
+            lint(src_test, "opensearch_tpu/utils/threadpool.py"))
+
+    def test_osl503_nested_def_inside_loop_quiet(self):
+        # a def nested in a loop runs when called, not where it sits
+        src = """
+            import time
+
+            def build(items):
+                out = []
+                for it in items:
+                    def backoff():
+                        time.sleep(0.1)
+                    out.append(backoff)
+                return out
+        """
+        assert rules_of(lint(src, "opensearch_tpu/rest/client.py")) == []
+
+
+# ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 
