@@ -11,6 +11,14 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   without a memory-breaker charge/release.
 - OSL401/OSL402 lock-discipline (`lock_rules`): attributes mutated both
   under and outside a lock; lock-order inversions.
+- OSL501/OSL502 telemetry-discipline (`telemetry_rules`): wall-clock
+  duration subtraction; module-level counter-dict `+=` in hot paths.
+- OSL503 wait-discipline (`lock_rules`): sleep-polling loops in serving
+  hot paths.
+- OSL504 device-sync discipline (`sync_rules`): blocking device syncs
+  (`jax.device_get`, `block_until_ready`, device-named `np.asarray`)
+  inside launch-stage code — the static guard on the pipelined
+  launch/fetch split (docs/SERVING.md).
 
 Run via `python scripts/oslint.py [--check]`; tier-1 runs it through
 tests/test_oslint.py. Suppress inline with
@@ -24,10 +32,12 @@ from .core import (Baseline, Checker, Finding, default_checkers,
 from .dtype_rules import DtypeDisciplineChecker
 from .jit_rules import JitBoundaryChecker
 from .lock_rules import LockDisciplineChecker
+from .sync_rules import DeviceSyncDisciplineChecker
 
 __all__ = [
     "Baseline", "Checker", "Finding", "default_checkers", "load_baseline",
     "run_paths", "run_source", "write_baseline",
     "DtypeDisciplineChecker", "JitBoundaryChecker",
     "BreakerDisciplineChecker", "LockDisciplineChecker",
+    "DeviceSyncDisciplineChecker",
 ]

@@ -127,10 +127,12 @@ def default_checkers() -> List[Checker]:
     from .dtype_rules import DtypeDisciplineChecker
     from .jit_rules import JitBoundaryChecker
     from .lock_rules import LockDisciplineChecker, WaitDisciplineChecker
+    from .sync_rules import DeviceSyncDisciplineChecker
     from .telemetry_rules import TelemetryDisciplineChecker
     return [DtypeDisciplineChecker(), JitBoundaryChecker(),
             BreakerDisciplineChecker(), LockDisciplineChecker(),
-            TelemetryDisciplineChecker(), WaitDisciplineChecker()]
+            TelemetryDisciplineChecker(), WaitDisciplineChecker(),
+            DeviceSyncDisciplineChecker()]
 
 
 def run_source(src: str, path: str,

@@ -1,0 +1,143 @@
+"""OSL504 — device-sync discipline for launch-stage code.
+
+The pipelined dispatch split (docs/SERVING.md) only buys overlap if the
+LAUNCH stage never blocks on device results: one stray `jax.device_get`
+in a `launch_*` body silently re-serializes host and device and the
+in-flight window measures nothing. This checker is the static guard that
+keeps the split from regressing.
+
+Scope: `search/`, `parallel/` and `serving/` modules. Launch-stage
+scopes are detected structurally:
+
+- any function whose name starts with `launch_` or `_launch` (the
+  repo-wide naming convention for launch-stage entry points and stages),
+- plus the serving dispatcher's hot-path methods in
+  `serving/scheduler.py` (`_loop`, `_wait_flush`, `_assemble`,
+  `_enqueue_inflight`) — the thread that must get back to assembling the
+  next batch immediately.
+
+Nested function definitions inside a launch scope are NOT checked: a
+closure's body runs when called, and the launch/fetch split's whole
+idiom is a `_fetch_*`/`_finish` closure capturing unfetched arrays for
+deferred execution.
+
+Flagged inside a launch scope:
+
+- `jax.device_get(...)` (through any module alias, or
+  `from jax import device_get`),
+- `<expr>.block_until_ready(...)`,
+- `np.asarray(x)` / `np.array(x)` where `x`'s name follows the repo's
+  device-array naming (`d_*`, `*_dev`, `dev_*`, or containing
+  `device`) — the lexical slice of "np.asarray on a jax Array forces a
+  transfer" that static analysis can see. Host-array asarray calls with
+  host-style names stay legal.
+
+Suppress a justified sync with
+`# oslint: disable=OSL504 -- <why this launch path must block>`.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_DEVICE_NAME_RE = re.compile(r"^d_|^dev_|_dev$|device")
+
+_DISPATCHER_METHODS = {"_loop", "_wait_flush", "_assemble",
+                       "_enqueue_inflight"}
+
+
+def _is_launch_scope(name: str, path: str) -> bool:
+    if name.startswith("launch_") or name.startswith("_launch"):
+        return True
+    return path.endswith("serving/scheduler.py") \
+        and name in _DISPATCHER_METHODS
+
+
+def _devicey(node: ast.AST) -> bool:
+    """True when the expression's trailing name segment follows the
+    repo's device-array naming convention."""
+    d = _dotted(node)
+    if not d:
+        return False
+    last = d.rsplit(".", 1)[-1]
+    return bool(_DEVICE_NAME_RE.search(last))
+
+
+class DeviceSyncDisciplineChecker(Checker):
+    rules = ("OSL504",)
+    name = "device-sync-discipline"
+
+    SCOPES = ("search/", "parallel/", "serving/")
+
+    def applies(self, path: str) -> bool:
+        return any(s in path for s in self.SCOPES)
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+
+        # module aliases so `import jax as j; j.device_get` and
+        # `from jax import device_get as dg` are both seen
+        jax_mods: Set[str] = set()
+        devget_funcs: Set[str] = set()
+        np_mods: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        jax_mods.add(a.asname or "jax")
+                    elif a.name == "numpy":
+                        np_mods.add(a.asname or "numpy")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "device_get":
+                            devget_funcs.add(a.asname or "device_get")
+        np_mods.add("np")       # function-local `import numpy as np`
+        jax_mods.add("jax")     # and `import jax` inside the function
+
+        def classify(call: ast.Call) -> str:
+            d = _dotted(call.func)
+            if d in devget_funcs:
+                return "device_get"
+            head, _, tail = d.rpartition(".")
+            if tail == "device_get" and head in jax_mods:
+                return "device_get"
+            if isinstance(call.func, ast.Attribute) and \
+                    call.func.attr == "block_until_ready":
+                return "block_until_ready"
+            if tail in ("asarray", "array") and head in np_mods \
+                    and call.args and _devicey(call.args[0]):
+                return f"asarray:{_dotted(call.args[0])}"
+            return ""
+
+        def walk(node: ast.AST, sym: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # deferred execution: the fetch-stage closure idiom
+                return
+            if isinstance(node, ast.Call):
+                what = classify(node)
+                if what:
+                    findings.append(Finding(
+                        "OSL504", path, node.lineno, node.col_offset, sym,
+                        f"blocking device sync ({what.split(':')[0]}) in "
+                        "launch-stage code; move it into the fetch "
+                        "closure — the launch stage must return with "
+                        "unfetched arrays (docs/SERVING.md pipeline)",
+                        detail=f"sync:{what}"))
+            for child in ast.iter_child_nodes(node):
+                walk(child, sym)
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_launch_scope(node.name, path):
+                sym = qmap.get(node, node.name)
+                for stmt in node.body:
+                    walk(stmt, sym)
+        return findings

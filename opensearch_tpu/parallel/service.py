@@ -151,6 +151,11 @@ class MeshSearchService:
         # uncontended (a single dispatcher thread owns the mesh)
         import threading
         self._dispatch_lock = threading.Lock()
+        # counter mutations can now come from several threads at once
+        # (the scheduler's completion worker fetches batch N while the
+        # dispatcher launches N+1, and direct request threads decline in
+        # parallel) — a GIL-sized lock keeps the tallies exact
+        self._stats_lock = threading.Lock()
         self.dispatched = 0      # searches served by the mesh
         self.launches = 0        # scoring-program invocations (group = 1)
         self.fallbacks = 0       # searches declined -> host loop
@@ -165,8 +170,10 @@ class MeshSearchService:
         self.fallback_shapes: Dict[str, int] = {}
 
     def _fall(self, shape: str, n: int = 1) -> None:
-        self.fallbacks += n
-        self.fallback_shapes[shape] = self.fallback_shapes.get(shape, 0) + n
+        with self._stats_lock:
+            self.fallbacks += n
+            self.fallback_shapes[shape] = \
+                self.fallback_shapes.get(shape, 0) + n
         # registry mirror: every decline site attributed by shape, so the
         # Prometheus exposition carries the same why-did-it-host-loop
         # breakdown _nodes/stats does
@@ -839,6 +846,11 @@ class MeshSearchService:
         return self.try_msearch(name, svc, [body])[0]
 
     def try_msearch(self, name: str, svc, bodies) -> list:
+        """Synchronous msearch through the SPMD mesh: launch + fetch
+        back-to-back (see `launch_msearch` for the split)."""
+        return self.launch_msearch(name, svc, bodies).fetch()
+
+    def launch_msearch(self, name: str, svc, bodies) -> "LaunchHandle":
         """A BATCH of search bodies over one index through the SPMD mesh:
         eligible bodies group by (similarity, window class) and run as ONE
         program invocation each — the query axis of the distributed
@@ -848,7 +860,17 @@ class MeshSearchService:
         as None for the host loop. Served shapes: scoring term groups
         (term/terms/match, any minimum_should_match) and filter-context
         groups (`terms`, constant score); multi-segment and empty shards;
-        windows to MAX_WINDOW."""
+        windows to MAX_WINDOW.
+
+        LAUNCH stage: parse/eligibility, program build, and every program
+        invocation run here — invocations serialized under
+        `_dispatch_lock` (concurrent collective invocations cross-join
+        their XLA rendezvous participants and deadlock), which is
+        RELEASED before any device sync. The returned handle's `fetch()`
+        performs the one-`device_get`-per-group transfer plus
+        coordinator-side result assembly and returns the per-body
+        response list (None entries -> host loop)."""
+        from ..search.launch import LaunchHandle
         from ..search import compiler as C
         from ..search import query_dsl as dsl
         from ..search.executor import (_global_stats_contexts,
@@ -862,7 +884,8 @@ class MeshSearchService:
         # compile + dispatch overhead for zero parallelism
         if svc.meta.num_shards < 2:
             self._fall("single_shard", len(bodies))
-            return self._mark_declined(bodies, out)
+            return LaunchHandle(
+                lambda: self._mark_declined(bodies, out), kind="mesh")
         # a shard may hold any number of segments (incl. zero for routing
         # holes) — the stacked index concatenates them per shard
         # ALL segments, including fully-deleted ones: the host's Lucene
@@ -909,7 +932,8 @@ class MeshSearchService:
             parsed.append((qi, lt, sort_specs, max(window, 1), const,
                            agg_nodes or [], fpair, qboost, msm_eff))
         if not parsed:
-            return self._mark_declined(bodies, out)
+            return LaunchHandle(
+                lambda: self._mark_declined(bodies, out), kind="mesh")
 
         # group by program parameters: field (via the stacked index), sim,
         # the pow2 WINDOW CLASS — co-batching a size=10 body with a
@@ -931,6 +955,12 @@ class MeshSearchService:
             nt_key = len(lt.terms) if is_phrase else 0
             groups.setdefault((is_phrase, nt_key, lt.field, k1, b_eff,
                                k_class, fkey), []).append(item)
+        # LAUNCH: every group's program invocation runs here, serialized
+        # under the dispatch lock; each returns a fetch closure capturing
+        # its unfetched device arrays. The lock is released before ANY
+        # fetch — the whole point of the split (the pipelined dispatcher
+        # launches batch N+1 while a completion worker fetches batch N)
+        fetchers = []
         with self._dispatch_lock:
             for (is_phrase, nt_key, field, k1, b_eff, k_class,
                  _fkey), items in groups.items():
@@ -938,16 +968,24 @@ class MeshSearchService:
                                  k_class=k_class, queries=len(items),
                                  phrase=is_phrase):
                     if is_phrase:
-                        self._run_phrase_group(name, svc, bodies, out,
-                                               shard_segs, stats,
-                                               searchers, field, nt_key,
-                                               k1, b_eff, k_class, items)
+                        fg = self._launch_phrase_group(
+                            name, svc, bodies, out, shard_segs, stats,
+                            searchers, field, nt_key, k1, b_eff, k_class,
+                            items)
                     else:
-                        self._run_mesh_group(name, svc, bodies, out,
-                                             shard_segs, stats, searchers,
-                                             field, k1, b_eff, k_class,
-                                             items)
-        return self._mark_declined(bodies, out)
+                        fg = self._launch_mesh_group(
+                            name, svc, bodies, out, shard_segs, stats,
+                            searchers, field, k1, b_eff, k_class, items)
+                    if fg is not None:
+                        fetchers.append(fg)
+
+        def _finish():
+            for fg in fetchers:
+                with TRACER.span("mesh.fetch_group"):
+                    fg()
+            return self._mark_declined(bodies, out)
+
+        return LaunchHandle(_finish, kind="mesh")
 
     def _mark_declined(self, bodies, out) -> list:
         """Tag every body this call declined so the caller's per-body retry
@@ -958,9 +996,15 @@ class MeshSearchService:
                 body["_mesh_declined"] = True
         return out
 
-    def _run_mesh_group(self, name, svc, bodies, out, shard_segs, stats,
-                        searchers, field, k1, b_eff, k_class,
-                        items) -> None:
+    def _launch_mesh_group(self, name, svc, bodies, out, shard_segs,
+                           stats, searchers, field, k1, b_eff, k_class,
+                           items):
+        """LAUNCH stage of one term-group program batch: agg-column
+        staging, program build, and every program invocation (scoring +
+        per-agg reduces) — returns a fetch closure over the unfetched
+        device arrays, or None when the whole group declined. Must not
+        block on device results (oslint OSL504); the single `device_get`
+        lives in the returned closure."""
         t0 = time.monotonic()
         stacked = self._stacked_for(name, svc, field, shard_segs)
         if stacked is None:
@@ -1440,178 +1484,187 @@ class MeshSearchService:
                                   cscore, col, pres, lows, highs, mcol,
                                   mpres) + ((fmask,) if filtered else ())
                         rsub_results[(rk, s.body["field"])] = rmfn(*rmargs)
-        fetched = jax.device_get((gdocs_b, gvals_b, totals_b,
-                                  metrics_by_field, tcounts_by_field,
-                                  hist_results, range_results,
-                                  tsub_results, hsub_results,
-                                  rsub_results, card_results,
-                                  dd_results, wavg_results, geo_results,
-                                  grid_results, fagg_results,
-                                  mterms_results, fsub_results))
-        (gdocs_b, gvals_b, totals_b, metrics_by_field,
-         tcounts_by_field, hist_results, range_results,
-         tsub_results, hsub_results, rsub_results,
-         card_results, dd_results, wavg_results,
-         geo_results, grid_results, fagg_results,
-         mterms_results, fsub_results) = fetched
 
-        # attach the globally-reduced agg partials to shard 0 (the values
-        # are already psum'd across the mesh; the coordinator merge sees
-        # exactly one partial per agg)
-        def _stat_partial(cnt, m4):
-            # the host metric partial shape (`_merge_stats` input): count,
-            # sum, sumsq always; extrema only meaningful when count > 0
-            cnt = float(cnt)
-            return {"count": cnt, "sum": float(m4[0]),
-                    "min": float(m4[1]) if cnt > 0 else float("inf"),
-                    "max": float(m4[2]) if cnt > 0 else float("-inf"),
-                    "sumsq": float(m4[3])}
+        # unfetched device outputs, captured for the deferred fetch (the
+        # tuple is the closure's only handle on them; names shadowed
+        # below so the outer bindings can be dropped with the handle)
+        _pending = (gdocs_b, gvals_b, totals_b, metrics_by_field,
+                    tcounts_by_field, hist_results, range_results,
+                    tsub_results, hsub_results, rsub_results, card_results,
+                    dd_results, wavg_results, geo_results, grid_results,
+                    fagg_results, mterms_results, fsub_results)
 
-        def _ordinal_partial(counts, vocab, subs_of=None):
-            # shared ordinal-bucket partial shape (terms / rare_terms /
-            # significant_terms / geo grids)
-            return {vocab[o]: {"doc_count": int(c),
-                               "subs": subs_of(o) if subs_of else {}}
-                    for o, c in enumerate(counts[: len(vocab)]) if c > 0}
+        def _fetch_group():
+            # ONE device->host transfer for the whole group's outputs —
+            # the same single-device_get discipline the synchronous path
+            # always had, just moved to the fetch stage
+            fetched = jax.device_get(_pending)
+            (gdocs_b, gvals_b, totals_b, metrics_by_field,
+             tcounts_by_field, hist_results, range_results,
+             tsub_results, hsub_results, rsub_results,
+             card_results, dd_results, wavg_results,
+             geo_results, grid_results, fagg_results,
+             mterms_results, fsub_results) = fetched
 
-        def _bucket_subs(an, sub_results, parent_key, bi, j):
-            out = {}
-            for s in an.subs:
-                cnts, m4 = sub_results[(parent_key, s.body["field"])]
-                out[s.name] = _stat_partial(cnts[bi][j], m4[bi][j])
-            return out
+            # attach the globally-reduced agg partials to shard 0 (the values
+            # are already psum'd across the mesh; the coordinator merge sees
+            # exactly one partial per agg)
+            def _stat_partial(cnt, m4):
+                # the host metric partial shape (`_merge_stats` input): count,
+                # sum, sumsq always; extrema only meaningful when count > 0
+                cnt = float(cnt)
+                return {"count": cnt, "sum": float(m4[0]),
+                        "min": float(m4[1]) if cnt > 0 else float("inf"),
+                        "max": float(m4[2]) if cnt > 0 else float("-inf"),
+                        "sumsq": float(m4[3])}
 
-        def attach_aggs(results, bi, aggs):
-            for an in aggs:
-                if an.kind in ("histogram", "date_histogram"):
-                    hk = _hist_key(an)
-                    counts, min_b, _nb, interval, offset = hist_results[hk]
-                    buckets = {min_b + j: {
-                        "doc_count": int(c),
-                        "subs": _bucket_subs(an, hsub_results, hk, bi, j)}
-                        for j, c in enumerate(counts[bi]) if c > 0}
-                    results[0].agg_partials[an.name] = [{
-                        "buckets": buckets, "interval": interval,
-                        "offset": offset}]
-                    continue
-                if an.kind in ("range", "date_range"):
-                    rk = _range_key(an)
-                    counts, rkeys, metas = range_results[rk]
-                    buckets = {key: {
-                        "doc_count": int(counts[bi][ri]),
-                        "meta": metas[ri],
-                        "subs": _bucket_subs(an, rsub_results, rk, bi, ri)}
-                        for ri, key in enumerate(rkeys)}
-                    results[0].agg_partials[an.name] = [{
-                        "buckets": buckets}]
-                    continue
-                if an.kind in ("terms", "rare_terms"):
-                    f = an.body["field"]
-                    buckets = _ordinal_partial(
-                        tcounts_by_field[f][bi], tvocab_by_field[f],
-                        (lambda o, _a=an, _f=f: _bucket_subs(
-                            _a, tsub_results, _f, bi, o))
-                        if an.subs else None)
-                    results[0].agg_partials[an.name] = [{"buckets":
-                                                         buckets}]
-                    continue
-                if an.kind in ("geohash_grid", "geotile_grid"):
-                    counts, gvocab = grid_results[_grid_key(an)]
-                    buckets = _ordinal_partial(counts[bi], gvocab)
-                    results[0].agg_partials[an.name] = [{"buckets":
-                                                         buckets}]
-                    continue
-                if an.kind in ("multi_terms", "composite"):
-                    mk = (("composite",) + self._composite_fields(an)
-                          if an.kind == "composite"
-                          else tuple(src["field"]
-                                     for src in an.body["terms"]))
-                    counts, mvocab = mterms_results[mk]
-                    buckets = _ordinal_partial(counts[bi], mvocab)
-                    results[0].agg_partials[an.name] = [{"buckets":
-                                                         buckets}]
-                    continue
-                if an.kind in ("filter", "missing"):
-                    _fn, combo, _m = an._mesh_filters[0]
-                    subs = {}
-                    for sub in an.subs:
-                        sc, sm4 = fsub_results[(combo, sub.body["field"])]
-                        subs[sub.name] = _stat_partial(sc[bi], sm4[bi])
-                    # doc_count rides the program's int32 count plane:
-                    # exact past the 2^24 f32 ceiling, no rounding
-                    results[0].agg_partials[an.name] = [{
-                        "doc_count": int(fagg_results[combo][0][bi]),
-                        "subs": subs}]
-                    continue
-                if an.kind in ("filters", "adjacency_matrix"):
-                    buckets = {
-                        fname: {"doc_count":
-                                int(fagg_results[combo][0][bi]),
-                                "subs": {}}
-                        for fname, combo, _m in an._mesh_filters}
-                    results[0].agg_partials[an.name] = [{"buckets":
-                                                         buckets}]
-                    continue
-                if an.kind == "significant_terms":
-                    f = an.body["field"]
-                    buckets = _ordinal_partial(tcounts_by_field[f][bi],
-                                               tvocab_by_field[f])
-                    bg, bg_total = self._sig_background(name, svc, f,
-                                                        shard_segs)
-                    results[0].agg_partials[an.name] = [{
-                        "buckets": buckets, "bg": bg,
-                        "fg_total": int(totals_b[bi]),
-                        "bg_total": bg_total}]
-                    continue
-                if an.kind == "cardinality":
-                    results[0].agg_partials[an.name] = [{
-                        "registers": card_results[an.body["field"]][bi]}]
-                    continue
-                if an.kind == "percentiles":
-                    from ..search.compiler import DEFAULT_PERCENTS
-                    percents = list(an.body.get("percents",
-                                                DEFAULT_PERCENTS))
-                    results[0].agg_partials[an.name] = [{
-                        "hist": dd_results[an.body["field"]][bi],
-                        "percents": percents}]
-                    continue
-                if an.kind == "percentile_ranks":
-                    results[0].agg_partials[an.name] = [{
-                        "hist": dd_results[an.body["field"]][bi],
-                        "values": [float(v) for v in
-                                   an.body.get("values", ())]}]
-                    continue
-                if an.kind == "median_absolute_deviation":
-                    results[0].agg_partials[an.name] = [{
-                        "hist": dd_results[an.body["field"]][bi]}]
-                    continue
-                if an.kind == "weighted_avg":
-                    wv = wavg_results[(an.body["value"]["field"],
-                                       an.body["weight"]["field"])][bi]
-                    results[0].agg_partials[an.name] = [{
-                        "vwsum": float(wv[0]), "wsum": float(wv[1]),
-                        "count": float(wv[2])}]
-                    continue
-                if an.kind in ("geo_bounds", "geo_centroid"):
-                    g = geo_results[an.body["field"]][bi]
-                    if an.kind == "geo_bounds":
+            def _ordinal_partial(counts, vocab, subs_of=None):
+                # shared ordinal-bucket partial shape (terms / rare_terms /
+                # significant_terms / geo grids)
+                return {vocab[o]: {"doc_count": int(c),
+                                   "subs": subs_of(o) if subs_of else {}}
+                        for o, c in enumerate(counts[: len(vocab)]) if c > 0}
+
+            def _bucket_subs(an, sub_results, parent_key, bi, j):
+                out = {}
+                for s in an.subs:
+                    cnts, m4 = sub_results[(parent_key, s.body["field"])]
+                    out[s.name] = _stat_partial(cnts[bi][j], m4[bi][j])
+                return out
+
+            def attach_aggs(results, bi, aggs):
+                for an in aggs:
+                    if an.kind in ("histogram", "date_histogram"):
+                        hk = _hist_key(an)
+                        counts, min_b, _nb, interval, offset = hist_results[hk]
+                        buckets = {min_b + j: {
+                            "doc_count": int(c),
+                            "subs": _bucket_subs(an, hsub_results, hk, bi, j)}
+                            for j, c in enumerate(counts[bi]) if c > 0}
                         results[0].agg_partials[an.name] = [{
-                            "count": float(g[0]), "top": float(g[1]),
-                            "bottom": float(g[2]), "left": float(g[3]),
-                            "right": float(g[4])}]
-                    else:
+                            "buckets": buckets, "interval": interval,
+                            "offset": offset}]
+                        continue
+                    if an.kind in ("range", "date_range"):
+                        rk = _range_key(an)
+                        counts, rkeys, metas = range_results[rk]
+                        buckets = {key: {
+                            "doc_count": int(counts[bi][ri]),
+                            "meta": metas[ri],
+                            "subs": _bucket_subs(an, rsub_results, rk, bi, ri)}
+                            for ri, key in enumerate(rkeys)}
                         results[0].agg_partials[an.name] = [{
-                            "count": float(g[0]), "slat": float(g[5]),
-                            "slon": float(g[6])}]
-                    continue
-                mc, m4 = metrics_by_field[an.body["field"]]
-                results[0].agg_partials[an.name] = [
-                    _stat_partial(mc[bi], m4[bi])]
+                            "buckets": buckets}]
+                        continue
+                    if an.kind in ("terms", "rare_terms"):
+                        f = an.body["field"]
+                        buckets = _ordinal_partial(
+                            tcounts_by_field[f][bi], tvocab_by_field[f],
+                            (lambda o, _a=an, _f=f: _bucket_subs(
+                                _a, tsub_results, _f, bi, o))
+                            if an.subs else None)
+                        results[0].agg_partials[an.name] = [{"buckets":
+                                                             buckets}]
+                        continue
+                    if an.kind in ("geohash_grid", "geotile_grid"):
+                        counts, gvocab = grid_results[_grid_key(an)]
+                        buckets = _ordinal_partial(counts[bi], gvocab)
+                        results[0].agg_partials[an.name] = [{"buckets":
+                                                             buckets}]
+                        continue
+                    if an.kind in ("multi_terms", "composite"):
+                        mk = (("composite",) + self._composite_fields(an)
+                              if an.kind == "composite"
+                              else tuple(src["field"]
+                                         for src in an.body["terms"]))
+                        counts, mvocab = mterms_results[mk]
+                        buckets = _ordinal_partial(counts[bi], mvocab)
+                        results[0].agg_partials[an.name] = [{"buckets":
+                                                             buckets}]
+                        continue
+                    if an.kind in ("filter", "missing"):
+                        _fn, combo, _m = an._mesh_filters[0]
+                        subs = {}
+                        for sub in an.subs:
+                            sc, sm4 = fsub_results[(combo, sub.body["field"])]
+                            subs[sub.name] = _stat_partial(sc[bi], sm4[bi])
+                        # doc_count rides the program's int32 count plane:
+                        # exact past the 2^24 f32 ceiling, no rounding
+                        results[0].agg_partials[an.name] = [{
+                            "doc_count": int(fagg_results[combo][0][bi]),
+                            "subs": subs}]
+                        continue
+                    if an.kind in ("filters", "adjacency_matrix"):
+                        buckets = {
+                            fname: {"doc_count":
+                                    int(fagg_results[combo][0][bi]),
+                                    "subs": {}}
+                            for fname, combo, _m in an._mesh_filters}
+                        results[0].agg_partials[an.name] = [{"buckets":
+                                                             buckets}]
+                        continue
+                    if an.kind == "significant_terms":
+                        f = an.body["field"]
+                        buckets = _ordinal_partial(tcounts_by_field[f][bi],
+                                                   tvocab_by_field[f])
+                        bg, bg_total = self._sig_background(name, svc, f,
+                                                            shard_segs)
+                        results[0].agg_partials[an.name] = [{
+                            "buckets": buckets, "bg": bg,
+                            "fg_total": int(totals_b[bi]),
+                            "bg_total": bg_total}]
+                        continue
+                    if an.kind == "cardinality":
+                        results[0].agg_partials[an.name] = [{
+                            "registers": card_results[an.body["field"]][bi]}]
+                        continue
+                    if an.kind == "percentiles":
+                        from ..search.compiler import DEFAULT_PERCENTS
+                        percents = list(an.body.get("percents",
+                                                    DEFAULT_PERCENTS))
+                        results[0].agg_partials[an.name] = [{
+                            "hist": dd_results[an.body["field"]][bi],
+                            "percents": percents}]
+                        continue
+                    if an.kind == "percentile_ranks":
+                        results[0].agg_partials[an.name] = [{
+                            "hist": dd_results[an.body["field"]][bi],
+                            "values": [float(v) for v in
+                                       an.body.get("values", ())]}]
+                        continue
+                    if an.kind == "median_absolute_deviation":
+                        results[0].agg_partials[an.name] = [{
+                            "hist": dd_results[an.body["field"]][bi]}]
+                        continue
+                    if an.kind == "weighted_avg":
+                        wv = wavg_results[(an.body["value"]["field"],
+                                           an.body["weight"]["field"])][bi]
+                        results[0].agg_partials[an.name] = [{
+                            "vwsum": float(wv[0]), "wsum": float(wv[1]),
+                            "count": float(wv[2])}]
+                        continue
+                    if an.kind in ("geo_bounds", "geo_centroid"):
+                        g = geo_results[an.body["field"]][bi]
+                        if an.kind == "geo_bounds":
+                            results[0].agg_partials[an.name] = [{
+                                "count": float(g[0]), "top": float(g[1]),
+                                "bottom": float(g[2]), "left": float(g[3]),
+                                "right": float(g[4])}]
+                        else:
+                            results[0].agg_partials[an.name] = [{
+                                "count": float(g[0]), "slat": float(g[5]),
+                                "slon": float(g[6])}]
+                        continue
+                    mc, m4 = metrics_by_field[an.body["field"]]
+                    results[0].agg_partials[an.name] = [
+                        _stat_partial(mc[bi], m4[bi])]
 
-        self._emit_mesh_results(name, bodies, out, shard_segs, stats,
-                                searchers, stacked, items, gdocs_b,
-                                gvals_b, totals_b, t0,
-                                attach_aggs=attach_aggs)
+            self._emit_mesh_results(name, bodies, out, shard_segs, stats,
+                                    searchers, stacked, items, gdocs_b,
+                                    gvals_b, totals_b, t0,
+                                    attach_aggs=attach_aggs)
+
+        return _fetch_group
 
 
     def _emit_mesh_results(self, name, bodies, out, shard_segs, stats,
@@ -1664,27 +1717,32 @@ class MeshSearchService:
                 attach_aggs(results, bi, aggs)
             for r in results:
                 r.took_ms = (time.monotonic() - t0) * 1000.0
-            self.dispatched += 1
+            # fetch-stage counters: taken on whichever thread completes
+            # the request (completion worker vs direct callers), so the
+            # tallies need the stats lock
+            with self._stats_lock:
+                self.dispatched += 1
+                if phrase:
+                    self.phrase_dispatched += 1
+                if _fk is not None:
+                    self.filtered_dispatched += 1
+                if any(an.kind == "terms" for an in aggs):
+                    self.terms_agg_dispatched += 1
             METRICS.counter("mesh.dispatched").inc()
             METRICS.histogram("mesh.dispatch").record(
                 (time.monotonic() - t0) * 1000.0)
-            if phrase:
-                self.phrase_dispatched += 1
-            if _fk is not None:
-                self.filtered_dispatched += 1
-            if any(an.kind == "terms" for an in aggs):
-                self.terms_agg_dispatched += 1
             body = dict(bodies[qi])
             body["_index_name"] = name
             out[qi] = _finish_search(searchers, results, body, stats,
                                      name, t0, [] if phrase else aggs)
 
-    def _run_phrase_group(self, name, svc, bodies, out, shard_segs, stats,
-                          searchers, field, n_terms, k1, b_eff, k_class,
-                          items) -> None:
-        """One program invocation for a batch of same-length match_phrase
-        bodies: shard-local positional pair-join + BM25 pseudo-term scoring
-        + all_gather merge (spmd.build_distributed_phrase)."""
+    def _launch_phrase_group(self, name, svc, bodies, out, shard_segs,
+                             stats, searchers, field, n_terms, k1, b_eff,
+                             k_class, items):
+        """LAUNCH stage of one match_phrase program batch: shard-local
+        positional pair-join + BM25 pseudo-term scoring + all_gather merge
+        (spmd.build_distributed_phrase). Returns a fetch closure over the
+        unfetched device arrays, or None when the group declined."""
         import jax
 
         t0 = time.monotonic()
@@ -1743,11 +1801,15 @@ class MeshSearchService:
                 avgdl) + ((fmask,) if filtered else ())
         self.launches += 1
         METRICS.counter("mesh.launches").inc()
-        gdocs_b, gvals_b, totals_b = jax.device_get(fn(*args))
+        _pending = fn(*args)            # invocation NOW, sync deferred
 
-        self._emit_mesh_results(name, bodies, out, shard_segs, stats,
-                                searchers, stacked, items, gdocs_b,
-                                gvals_b, totals_b, t0, phrase=True)
+        def _fetch_group():
+            gdocs_b, gvals_b, totals_b = jax.device_get(_pending)
+            self._emit_mesh_results(name, bodies, out, shard_segs, stats,
+                                    searchers, stacked, items, gdocs_b,
+                                    gvals_b, totals_b, t0, phrase=True)
+
+        return _fetch_group
 
     def _eligible(self, lroot, sort_specs, agg_nodes, named_nodes, body,
                   window: int) -> Optional[tuple]:

@@ -813,14 +813,32 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
 def msearch_batched(searchers: List[ShardSearcher],
                     bodies: List[dict], index_name: str = ""
                     ) -> Optional[List[dict]]:
+    """Synchronous batched msearch on the Pallas fast path: launch +
+    fetch back-to-back (see `launch_msearch_batched` for the split)."""
+    handle = launch_msearch_batched(searchers, bodies, index_name)
+    if handle is None:
+        return None
+    return handle.fetch()
+
+
+def launch_msearch_batched(searchers: List[ShardSearcher],
+                           bodies: List[dict], index_name: str = ""):
     """Batched msearch on the Pallas fast path: eligible bodies' queries
     over each segment run as ONE kernel launch per shape group (grid over
     queries) — server-side query batching, the production shape of a TPU
     search tier (reference analog: `action/search/TransportMultiSearchAction`
-    just loops; we fuse). Returns a per-body list whose entries are response
-    dicts for bodies the fast path served and None for the rest (the caller
-    runs those through the regular per-body search), or None wholesale when
-    the fast path is off."""
+    just loops; we fuse).
+
+    LAUNCH stage: parsing, spec building, and EVERY shard/segment's
+    frontier kernel enqueue run here, unfetched — all segments' launches
+    pipeline on the device before the first sync. The returned handle's
+    `fetch()` syncs each segment batch, collects top-ks, and finishes the
+    responses: a per-body list whose entries are response dicts for
+    bodies the fast path served and None for the rest (the caller runs
+    those through the regular per-body search). Returns None wholesale
+    when the fast path is off."""
+    from .launch import LaunchHandle
+
     if not fastpath.enabled() or not searchers:
         return None
     stats = _global_stats_contexts(searchers)
@@ -846,7 +864,11 @@ def msearch_batched(searchers: List[ShardSearcher],
     ok = [p is not None for p in parsed]
     results = [[ShardQueryResult(shard=i, segments=list(s.engine.segments))
                 for i, s in enumerate(searchers)] for _ in range(nb)]
-    served_batches: List[tuple] = []
+    # (shard idx, searcher, ctx, seg, seg_ord, launch-time live set,
+    #  fspecs, handle-or-None); a body invalidated by an EARLIER segment's
+    # fetch may still ride a later launch — per-query results are
+    # batch-composition invariant, so its entries are simply discarded
+    launches: List[tuple] = []
     for i, s in enumerate(searchers):
         if not any(ok):
             break
@@ -875,46 +897,64 @@ def msearch_batched(searchers: List[ShardSearcher],
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
                 continue
-            live_bis = [bi for bi in live_bis if ok[bi]]
-            if not live_bis:
-                break
-            # stats counted only for bodies served on every shard/segment —
-            # a later fallback discards that body's results and re-runs slow
-            outs = fastpath.batch_search(seg, ctx,
-                                         [fspecs[bi] for bi in live_bis],
-                                         max((parsed[bi][3]
-                                              for bi in live_bis), default=10),
-                                         count_stats=False)
-            if outs is None:
+            handle = fastpath.launch_batch(
+                seg, ctx, [fspecs[bi] for bi in live_bis],
+                max((parsed[bi][3] for bi in live_bis), default=10),
+                count_stats=False)
+            if handle is None:
+                # wholesale decline, known AT LAUNCH (segment can't take
+                # the fast path at all): fail these bodies now so later
+                # shards don't enqueue kernels for work that would only
+                # be discarded at fetch (same outcome as the synchronous
+                # path's `outs is None` break, same launch count too)
                 for bi in live_bis:
                     ok[bi] = False
                 break
-            for bi, o in zip(live_bis, outs):
+            launches.append((i, s, ctx, seg, seg_ord, list(live_bis),
+                             fspecs, handle))
+
+    def _finish():
+        served_batches: List[tuple] = []
+        for (i, s, ctx, seg, seg_ord, seg_live, fspecs,
+             handle) in launches:
+            live = [bi for bi in seg_live if ok[bi]]
+            if not live:
+                continue
+            # stats counted only for bodies served on every shard/segment
+            # — a later fallback discards that body's results, re-runs slow
+            outs = handle.fetch()
+            by_bi = dict(zip(seg_live, outs))
+            for bi in live:
+                o = by_bi[bi]
                 if o is not None:
                     served_batches.append((bi, fspecs[bi], o))
-            for bi, fout in zip(live_bis, outs):
+            for bi in live:
+                fout = by_bi[bi]
                 if fout is None:
                     ok[bi] = False
                     continue
                 body, _, sort_specs, window = parsed[bi]
                 s._collect_topk(results[bi][i], fout, seg, seg_ord, i,
                                 sort_specs, None, None, False, ctx)
-        for bi in range(nb):
-            if not ok[bi]:
-                continue
-            body, _, sort_specs, window = parsed[bi]
-            r = results[bi][i]
-            r.candidates.sort(key=lambda c: c.sort_values)
-            r.candidates = r.candidates[:window]
-            r.took_ms = (time.monotonic() - t0) * 1000.0
-    if not any(ok):
-        return [None] * nb
-    for bi, fs, o in served_batches:
-        if ok[bi]:
-            fastpath.count_served([fs], [o])
-    return [_finish_search(searchers, results[bi], parsed[bi][0], stats,
-                           index_name, t0, [])
-            if ok[bi] else None for bi in range(nb)]
+        for i in range(len(searchers)):
+            for bi in range(nb):
+                if not ok[bi]:
+                    continue
+                body, _, sort_specs, window = parsed[bi]
+                r = results[bi][i]
+                r.candidates.sort(key=lambda c: c.sort_values)
+                r.candidates = r.candidates[:window]
+                r.took_ms = (time.monotonic() - t0) * 1000.0
+        if not any(ok):
+            return [None] * nb
+        for bi, fs, o in served_batches:
+            if ok[bi]:
+                fastpath.count_served([fs], [o])
+        return [_finish_search(searchers, results[bi], parsed[bi][0],
+                               stats, index_name, t0, [])
+                if ok[bi] else None for bi in range(nb)]
+
+    return LaunchHandle(_finish, kind="fastpath")
 
 
 def _finish_search(searchers: List[ShardSearcher],

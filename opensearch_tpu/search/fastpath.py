@@ -754,11 +754,13 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict,
     return out
 
 
-def _launch_pure_groups(seg: Segment,
-                        vq_lists: List[Optional[List[_VQuery]]],
-                        K: int) -> dict:
-    """Group all kernel rows by shape, launch once per group.
-    -> id(vq) -> (scores, docs, total, relation)."""
+def _launch_pure_groups_async(seg: Segment,
+                              vq_lists: List[Optional[List[_VQuery]]],
+                              K: int) -> list:
+    """LAUNCH stage: group all kernel rows by shape, enqueue one kernel
+    per group, and return the pending launches WITHOUT any device sync
+    (oslint OSL504) — `_fetch_pure_groups` turns them into host results.
+    -> [(gvqs, K_keep, unfetched (scores, docs, totals)), ...]."""
     groups = {}
     for vqs in vq_lists:
         if vqs is None:
@@ -766,7 +768,7 @@ def _launch_pure_groups(seg: Segment,
         for vq in vqs:
             groups.setdefault((vq.field, vq.T_pad, vq.k1, vq.b_eff),
                               []).append(vq)
-    results = {}
+    pending = []
     for (field, T_pad, k1, b_eff), gvqs in groups.items():
         al = get_aligned(seg, field)
         # ONE launch per group: DMA volume is set by per-term `nrows`, not L,
@@ -793,19 +795,36 @@ def _launch_pure_groups(seg: Segment,
         # per-launch attribution (scripts/measure_concurrency.py divides
         # served queries by launches to report the coalescing ratio)
         METRICS.counter("fastpath.launches").inc()
-        scores, docs, totals = fused_bm25_topk_tfdl(
+        pending.append((gvqs, K_launch, fused_bm25_topk_tfdl(
             al.d_docs, al.d_tfdl, rowstarts, nrows, lens, skips, weights,
-            msm, avg, dlo, dhi, T=T_pad, L=L, K=K_launch, k1=k1, b=b_eff)
-        # ONE device->host transfer for all three outputs: each np.asarray
-        # is its own round trip, and on a tunneled host a round trip is
-        # ~70ms — 3 fetches would triple the batch-1 latency floor
-        import jax
-        scores, docs, totals = jax.device_get((scores, docs, totals))
+            msm, avg, dlo, dhi, T=T_pad, L=L, K=K_launch, k1=k1, b=b_eff)))
+    return pending
+
+
+def _fetch_pure_groups(pending: list, K: int) -> dict:
+    """FETCH stage for `_launch_pure_groups_async`:
+    -> id(vq) -> (scores, docs, total, relation)."""
+    # ONE device->host transfer for ALL groups' outputs: each np.asarray
+    # is its own round trip, and on a tunneled host a round trip is
+    # ~70ms — per-array fetches would multiply the batch-1 latency floor
+    import jax
+    fetched = jax.device_get([arrs for _gvqs, _kl, arrs in pending])
+    results = {}
+    for (gvqs, K_launch, _), (scores, docs, totals) in zip(pending,
+                                                           fetched):
         for j, vq in enumerate(gvqs):
             keep = K_launch if (vq.head and vq.clamped) else K
             results[id(vq)] = (scores[j][:keep], docs[j][:keep],
                                int(totals[j][0]), "eq")
     return results
+
+
+def _launch_pure_groups(seg: Segment,
+                        vq_lists: List[Optional[List[_VQuery]]],
+                        K: int) -> dict:
+    """Synchronous launch+fetch (escalation rungs, host-loop callers)."""
+    return _fetch_pure_groups(_launch_pure_groups_async(seg, vq_lists, K),
+                              K)
 
 
 def _unseen_bound(al: AlignedPostings, pb, dl_col, vq: _VQuery,
@@ -1401,10 +1420,11 @@ def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
     return (sc2, dc2, total_out, "gte")
 
 
-def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
-              K: int) -> Optional[List[Optional[dict]]]:
-    """The pure term-group path: pruned first pass, host verification, dense
-    rerun for the (rare) queries whose bound check fails."""
+def _launch_pure(seg: Segment, ctx, lts: Sequence,
+                 specs: Sequence[FastSpec], K: int) -> Optional[tuple]:
+    """LAUNCH stage of the pure term-group path: vquery prep + the
+    impact-head (pruned) kernel first pass, enqueued but unfetched.
+    Returns opaque state for `_finish_pure`, or None to fall back."""
     prune = [bool(s.prune_ok) for s in specs]
     vq_lists = _prepare_vqueries(seg, ctx, lts, {}, prune=prune)
     if vq_lists is None:
@@ -1412,7 +1432,19 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
     # frontier rung: the impact-head (pruned) kernel first pass
     with TRACER.span("fastpath.frontier", queries=len(lts)), \
             METRICS.timer("fastpath.frontier"):
-        results = _launch_pure_groups(seg, vq_lists, K)
+        pending = _launch_pure_groups_async(seg, vq_lists, K)
+    return (vq_lists, pending)
+
+
+def _finish_pure(seg: Segment, ctx, lts: Sequence,
+                 specs: Sequence[FastSpec], K: int,
+                 state: tuple) -> Optional[List[Optional[dict]]]:
+    """FETCH stage of the pure path: device sync of the frontier pass,
+    then host verification and the escalation ladder (whose rungs launch
+    their own follow-up device work synchronously — only the hard tail
+    pays a sync here) and final assembly."""
+    vq_lists, pending = state
+    results = _fetch_pure_groups(pending, K)
     redo = []
     with TRACER.span("fastpath.verify"), METRICS.timer("fastpath.verify"):
         for qi, vqs in enumerate(vq_lists):
@@ -1466,6 +1498,18 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
         if vqs is not None and len(vqs) == 1 and vqs[0].head
         and vqs[0].clamped) - rescued)
     return _assemble(vq_lists, results, K)
+
+
+def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
+              K: int) -> Optional[List[Optional[dict]]]:
+    """The pure term-group path, synchronous: pruned first pass, host
+    verification, dense rerun for the (rare) queries whose bound check
+    fails. Launch/fetch split available via `_launch_pure`/`_finish_pure`
+    (the serving pipeline's seam)."""
+    state = _launch_pure(seg, ctx, lts, specs, K)
+    if state is None:
+        return None
+    return _finish_pure(seg, ctx, lts, specs, K, state)
 
 
 def _assemble(vq_lists, results: dict, K: int, transform=None
@@ -1887,8 +1931,10 @@ def _prepare_bool_vqueries(seg: Segment, ctx, specs: Sequence[FastSpec],
     return out
 
 
-def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
-              ) -> List[Optional[dict]]:
+def _launch_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
+                 ) -> tuple:
+    """LAUNCH stage of the bool/filtered path: one kernel enqueue per
+    shape group, no device sync. Returns state for `_finish_bool`."""
     vq_lists = _prepare_bool_vqueries(seg, ctx, specs, {})
     groups = {}
     for vqs in vq_lists:
@@ -1898,7 +1944,7 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
             gk = (id(vq.albuf), vq.TS, vq.filtered,
                   id(vq.fl) if vq.fl is not None else None, vq.k1, vq.b_eff)
             groups.setdefault(gk, []).append(vq)
-    results = {}
+    pending = []
     for (_alid, TS, filtered, _flid, k1, b_eff), gvqs in groups.items():
         al = gvqs[0].albuf
         if al is not None:
@@ -1919,15 +1965,26 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         dlo = np.array([[v.dlo] for v in gvqs], np.int32)
         dhi = np.array([[v.dhi] for v in gvqs], np.int32)
         METRICS.counter("fastpath.launches").inc()
-        scores, docs, totals = fused_bm25_bool_topk(
+        pending.append((gvqs, fused_bm25_bool_topk(
             d_docs, d_tfdl, filt, rowstarts, nrows, lens, skips, weights,
             cw, thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,
-            filtered=filtered)
-        import jax
-        scores, docs, totals = jax.device_get((scores, docs, totals))
+            filtered=filtered)))
+    return (vq_lists, pending)
+
+
+def _finish_bool(specs: Sequence[FastSpec], K: int, state: tuple
+                 ) -> List[Optional[dict]]:
+    """FETCH stage of the bool/filtered path: one transfer for all
+    groups, then boost/const-score transform and assembly."""
+    vq_lists, pending = state
+    import jax
+    fetched = jax.device_get([arrs for _gvqs, arrs in pending])
+    results = {}
+    for (gvqs, _), (scores, docs, totals) in zip(pending, fetched):
         for j, vq in enumerate(gvqs):
             results[id(vq)] = (scores[j][:K], docs[j][:K],
                                int(totals[j][0]))
+
     def transform(qi, sc):
         spec = specs[qi]
         finite = np.isfinite(sc)
@@ -1938,6 +1995,11 @@ def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         return sc
 
     return _assemble(vq_lists, results, K, transform)
+
+
+def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
+              ) -> List[Optional[dict]]:
+    return _finish_bool(specs, K, _launch_bool(seg, ctx, specs, K))
 
 
 def segment_search(seg: Segment, ctx, spec: FastSpec, k: int
@@ -2042,52 +2104,80 @@ def shard_search(searcher, ctx, spec: FastSpec, k: int
     return view, out[0]
 
 
-def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
-                 count_stats: bool = True
-                 ) -> Optional[List[Optional[dict]]]:
-    """Many FastSpecs over ONE segment in as few kernel launches as
-    possible (grid over queries — the server-side query batching a TPU
-    search tier runs on). Pure term groups and bool/filtered shapes each
-    batch into their own launches; oversized posting rows split into
-    doc-range chunks that ride the same launches. Per-query fallbacks are
-    None entries."""
+def launch_batch(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
+                 count_stats: bool = True):
+    """LAUNCH stage of the batched kernel path: many FastSpecs over ONE
+    segment in as few kernel launches as possible (grid over queries —
+    the server-side query batching a TPU search tier runs on). Pure term
+    groups and the filtered-pure rung enqueue their frontier kernels
+    here, unfetched; the returned `LaunchHandle.fetch()` syncs them and
+    runs the verify/escalation ladder plus the leftover bool shapes
+    (whose eligibility is only known post-fetch) and returns the per-spec
+    result list (None entries -> per-query fallback). Returns None when
+    the segment can't take the fast path at all."""
+    from .launch import LaunchHandle
+
     if seg.live_count != seg.ndocs:
         return None
     K = min(next_pow2(max(k, 16)), MAX_K)
-    out: List[Optional[dict]] = [None] * len(specs)
     pure_idx = [i for i, s in enumerate(specs) if s.kind == "pure"]
     bool_idx = [i for i, s in enumerate(specs) if s.kind == "bool"]
+    pure_state = None
     if pure_idx:
-        rs = _run_pure(seg, ctx, [specs[i].lt for i in pure_idx],
-                       [specs[i] for i in pure_idx], K)
-        if rs is not None:
-            for i, r in zip(pure_idx, rs):
-                out[i] = r
+        pure_state = _launch_pure(seg, ctx,
+                                  [specs[i].lt for i in pure_idx],
+                                  [specs[i] for i in pure_idx], K)
+    filtered_launched = []
     if bool_idx:
         # family-only bool specs over a dense hot filter ride the PURE
         # pruned pipeline on the filter-specialized postings view —
         # impact heads cut the per-query work from O(filtered df) to
         # O(L_HEAD) exactly like unfiltered match queries
-        served = _try_filtered_pure_batch(
+        filtered_launched = _launch_filtered_pure_batch(
             seg, ctx, [(i, specs[i]) for i in bool_idx], K)
-        for i, r in served.items():
-            out[i] = r
-        bool_idx = [i for i in bool_idx if i not in served]
-    if bool_idx:
-        for i, r in zip(bool_idx,
-                        _run_bool(seg, ctx, [specs[i] for i in bool_idx], K)):
-            out[i] = r
-    if count_stats:
-        count_served(specs, out)
-    return out
+
+    def _finish():
+        out: List[Optional[dict]] = [None] * len(specs)
+        if pure_state is not None:
+            rs = _finish_pure(seg, ctx, [specs[i].lt for i in pure_idx],
+                              [specs[i] for i in pure_idx], K, pure_state)
+            if rs is not None:
+                for i, r in zip(pure_idx, rs):
+                    out[i] = r
+        rem = list(bool_idx)
+        if filtered_launched:
+            served = _finish_filtered_pure_batch(ctx, K, filtered_launched)
+            for i, r in served.items():
+                out[i] = r
+            rem = [i for i in rem if i not in served]
+        if rem:
+            for i, r in zip(rem, _run_bool(seg, ctx,
+                                           [specs[i] for i in rem], K)):
+                out[i] = r
+        if count_stats:
+            count_served(specs, out)
+        return out
+
+    return LaunchHandle(_finish, kind="fastpath")
 
 
-def _try_filtered_pure_batch(seg: Segment, ctx, idx_specs, K: int) -> dict:
-    """Serve family-only filtered bool specs through the pure pruned
-    pipeline over their FilteredSegViews, ONE _run_pure per (field,
-    filter) group so an msearch batch pays one launch per view, not one
-    per query. -> {spec index: result dict}; missing indices take the
-    regular bool path."""
+def batch_search(seg: Segment, ctx, specs: Sequence[FastSpec], k: int,
+                 count_stats: bool = True
+                 ) -> Optional[List[Optional[dict]]]:
+    """Synchronous batched kernel path: `launch_batch(...).fetch()`."""
+    handle = launch_batch(seg, ctx, specs, k, count_stats)
+    if handle is None:
+        return None
+    return handle.fetch()
+
+
+def _launch_filtered_pure_batch(seg: Segment, ctx, idx_specs,
+                                K: int) -> list:
+    """LAUNCH stage of the filtered-pure rung: serve family-only filtered
+    bool specs through the pure pruned pipeline over their
+    FilteredSegViews, ONE frontier launch per (field, filter) group so an
+    msearch batch pays one launch per view, not one per query. Returns
+    pending group launches for `_finish_filtered_pure_batch`."""
     groups: dict = {}
     for i, spec in idx_specs:
         if not _family_only(spec):
@@ -2100,11 +2190,24 @@ def _try_filtered_pure_batch(seg: Segment, ctx, idx_specs, K: int) -> dict:
             continue
         key = (seg.uid, spec.field, fl.key)
         groups.setdefault(key, (spec.field, fl, fp, []))[3].append((i, spec))
-    out: dict = {}
+    launched = []
     for key, (field, fl, fp, items) in groups.items():
         view = _filtered_view(seg, field, fp, key)
-        res = _run_pure(view, ctx, [_PseudoLT(s) for _, s in items],
-                        [s for _, s in items], K)
+        lts = [_PseudoLT(s) for _, s in items]
+        sspecs = [s for _, s in items]
+        state = _launch_pure(view, ctx, lts, sspecs, K)
+        if state is None:
+            continue
+        launched.append((view, fl, items, lts, sspecs, state))
+    return launched
+
+
+def _finish_filtered_pure_batch(ctx, K: int, launched: list) -> dict:
+    """FETCH stage of the filtered-pure rung. -> {spec index: result
+    dict}; missing indices take the regular bool path."""
+    out: dict = {}
+    for view, fl, items, lts, sspecs, state in launched:
+        res = _finish_pure(view, ctx, lts, sspecs, K, state)
         if res is None:
             continue
         for (i, spec), r in zip(items, res):

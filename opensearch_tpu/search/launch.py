@@ -1,0 +1,100 @@
+"""Launch/fetch split for device execution paths (docs/SERVING.md).
+
+The dispatch discipline this repo grew up with was fully synchronous:
+one thread assembled a batch, invoked the jitted program, `device_get`-ed
+the outputs, and rendered responses back-to-back — so the chip idled
+during every host phase and the host idled during every device phase.
+The inference-serving classic fixes that: *launch* returns as soon as
+the program invocation is enqueued (JAX's async dispatch hands back
+unfetched device arrays), and *fetch* — the single `jax.device_get`
+plus all host-side finishing (verify ladders, response rendering) —
+happens later, on whichever thread completes the request.
+
+`LaunchHandle` is the seam between the two stages:
+
+- `launch_*()` entry points (`MeshSearchService.launch_msearch`,
+  `executor.launch_msearch_batched`, `fastpath.launch_batch`) do every
+  host-side preparation AND the jitted call(s), then capture the
+  unfetched device arrays plus everything needed to finish the request
+  in a closure and return a handle. Launch-stage code must never block
+  on device results — oslint OSL504 enforces that statically.
+- `handle.fetch()` runs the closure exactly once (idempotent; a second
+  call returns the memoized result or re-raises the memoized error),
+  releases the captured device arrays, and records the launch→fetch
+  latency into the metrics registry (`serving.launch_to_fetch`).
+
+The synchronous entry points (`try_msearch`, `msearch_batched`,
+`batch_search`) are now `launch(...).fetch()` — byte-identical results,
+same transfer discipline (one `device_get` per program group), with the
+split available to the serving scheduler's pipelined dispatcher.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from ..utils.metrics import METRICS
+
+
+class LaunchHandle:
+    """One launched-but-unfetched unit of device work.
+
+    Created by a `launch_*()` entry point after the jitted program
+    call(s) were enqueued; `fetch()` performs the deferred device sync
+    and host-side finishing and returns the responses. The handle owns
+    the only reference to the captured device arrays — dropping an
+    unfetched handle releases them."""
+
+    __slots__ = ("kind", "launched_at", "fetched_at", "_finish", "_result",
+                 "_error", "_done")
+
+    def __init__(self, finish: Callable[[], object], kind: str = "device"):
+        self.kind = kind
+        self.launched_at = time.monotonic()
+        self.fetched_at: Optional[float] = None
+        self._finish = finish
+        self._result = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def fetch(self):
+        """Device sync + host finishing. Idempotent: the first call runs
+        the deferred stage, later calls replay its outcome.
+
+        Deliberately records only a retirement counter here: the
+        `serving.launch_to_fetch` latency histogram is the PIPELINE's
+        deferred-sync window and is recorded by the scheduler for the
+        handles it parks in the in-flight window — the synchronous
+        wrappers (`try_msearch` et al.) fetch back-to-back and would
+        drown the metric in zero-width samples."""
+        if self._done:
+            if self._error is not None:
+                raise self._error
+            return self._result
+        finish, self._finish = self._finish, None   # release on any exit
+        try:
+            self._result = finish()
+        except BaseException as e:
+            self._error = e
+            raise
+        finally:
+            self._done = True
+            self.fetched_at = time.monotonic()
+            METRICS.counter(f"launch.{self.kind}.fetched").inc()
+        return self._result
+
+    def launch_to_fetch_ms(self) -> Optional[float]:
+        if self.fetched_at is None:
+            return None
+        return (self.fetched_at - self.launched_at) * 1000.0
+
+
+def completed(result, kind: str = "host") -> LaunchHandle:
+    """A pre-resolved handle for paths that did no device work (e.g. a
+    wholesale mesh decline): fetch() just returns `result`."""
+    return LaunchHandle(lambda: result, kind=kind)

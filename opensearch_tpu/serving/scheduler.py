@@ -46,6 +46,25 @@ batch first, batch/scroll entries only take the leftover slots.
 
 All waiting uses `threading.Condition` / `threading.Event` — no sleep
 polling (oslint OSL503, docs/STATIC_ANALYSIS.md).
+
+**Pipelined dispatch** (this PR): the dispatch path is split into an
+explicit LAUNCH stage and a FETCH/RENDER stage connected by
+`search/launch.py` LaunchHandles. The dispatcher thread now only
+assembles and *launches* (program invocation under
+`MeshSearchService._dispatch_lock`, released before any sync); completed
+launches enter a bounded in-flight window and a completion worker thread
+performs the device sync, oracle re-check, response rendering and future
+resolution — so host assembly of batch N+1 overlaps device execution of
+batch N. `SchedulerConfig.pipeline_depth` bounds the window
+(`OPENSEARCH_TPU_PIPELINE_DEPTH`, default 2); depth 1 is byte-for-byte
+the old synchronous dispatcher (and the `JAX_PLATFORMS=cpu` oracle
+baseline). Degradation ladders extend to the new stage: a wedged
+completion worker abandons the claimed entry to direct execution on the
+request thread after a second `request_timeout_s`, and a task cancelled
+after launch but before fetch resolves immediately (the batch's result
+for it is discarded). Telemetry: `serving.inflight_depth` gauge,
+`serving.launch_to_fetch` histogram, and a launch/fetch stage overlap
+ratio in `_nodes/stats` "serving" -> "pipeline" and `/_metrics`.
 """
 
 from __future__ import annotations
@@ -56,6 +75,7 @@ import os
 import threading
 import time
 from collections import deque
+from contextlib import contextmanager
 from typing import Dict, List, Optional
 
 from ..utils.metrics import METRICS, MetricsRegistry
@@ -85,7 +105,8 @@ class SchedulerConfig:
                  oracle: Optional[bool] = None,
                  kernel_batching: bool = True,
                  request_timeout_s: float = 30.0,
-                 idle_timeout_s: float = 5.0):
+                 idle_timeout_s: float = 5.0,
+                 pipeline_depth: Optional[int] = None):
         env = os.environ
         self.max_batch = int(max_batch if max_batch is not None
                              else env.get("OPENSEARCH_TPU_SCHED_MAX_BATCH",
@@ -105,12 +126,22 @@ class SchedulerConfig:
         self.kernel_batching = bool(kernel_batching)
         self.request_timeout_s = float(request_timeout_s)
         self.idle_timeout_s = float(idle_timeout_s)
+        # bounded in-flight window for pipelined dispatch: at most this
+        # many launched-but-unfetched batches, so the device queue can't
+        # grow without bound. Depth 1 == the synchronous dispatcher the
+        # scheduler shipped with (launch+fetch on one thread) — the
+        # JAX_PLATFORMS=cpu oracle baseline for pipeline parity.
+        self.pipeline_depth = int(
+            pipeline_depth if pipeline_depth is not None
+            else env.get("OPENSEARCH_TPU_PIPELINE_DEPTH", 2))
         if self.max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if self.max_wait_us < 0:
             raise ValueError("max_wait_us must be >= 0")
         if self.queue_cap < 1:
             raise ValueError("queue_cap must be >= 1")
+        if self.pipeline_depth < 1:
+            raise ValueError("pipeline_depth must be >= 1")
 
 
 class _Pending:
@@ -128,6 +159,77 @@ class _Pending:
         self.resp = None            # response dict, or None (-> host loop)
         self.error: Optional[BaseException] = None
         self.state = _QUEUED
+
+
+class _StageMeter:
+    """Interval-union accounting for the launch and fetch stages: per-kind
+    busy seconds plus the union wall during which ANY stage was active.
+    overlap = launch_s + fetch_s - union_s is the wall the two stages ran
+    concurrently — the host-side witness that device execution (the fetch
+    stage blocks on it) overlapped host assembly. At pipeline depth 1 the
+    stages share one thread, so the overlap is identically zero."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = 0
+        self._mark = 0.0
+        self.stage_s = {"launch": 0.0, "fetch": 0.0}
+        self.union_s = 0.0
+
+    @contextmanager
+    def stage(self, kind: str):
+        t0 = time.monotonic()
+        with self._lock:
+            if self._active == 0:
+                self._mark = t0
+            self._active += 1
+        try:
+            yield
+        finally:
+            t1 = time.monotonic()
+            with self._lock:
+                self._active -= 1
+                self.stage_s[kind] += t1 - t0
+                if self._active == 0:
+                    self.union_s += t1 - self._mark
+                ratio = self._ratio_locked()
+            METRICS.gauge("serving.overlap_ratio").set(round(ratio, 4))
+
+    def _ratio_locked(self) -> float:
+        total = self.stage_s["launch"] + self.stage_s["fetch"]
+        if self.union_s <= 0.0:
+            return 0.0
+        return max(total - self.union_s, 0.0) / self.union_s
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            total = self.stage_s["launch"] + self.stage_s["fetch"]
+            return {
+                "launch_s": round(self.stage_s["launch"], 4),
+                "fetch_s": round(self.stage_s["fetch"], 4),
+                "union_s": round(self.union_s, 4),
+                "overlap_s": round(max(total - self.union_s, 0.0), 4),
+                "overlap_ratio": round(self._ratio_locked(), 4),
+            }
+
+
+class _InFlight:
+    """One launched batch parked in the in-flight window: per-(index,
+    service) groups, each holding its claimed entries and the launch
+    handles the completion worker will fetch."""
+
+    __slots__ = ("groups", "launched_at")
+
+    def __init__(self, groups: list):
+        # [(name, svc, entries, bodies, handles-or-None, launch_error)]
+        self.groups = groups
+        self.launched_at = time.monotonic()
+
+    def unresolved(self):
+        for _name, _svc, entries, _bodies, _handles, _err in self.groups:
+            for e in entries:
+                if not e.done.is_set():
+                    yield e
 
 
 class ServingScheduler:
@@ -153,6 +255,18 @@ class ServingScheduler:
         self._pending = 0
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        # pipelined dispatch: launched-but-unfetched batches (bounded by
+        # config.pipeline_depth; the head entry stays in the deque while
+        # the completion worker fetches it, so the window counts every
+        # batch the device still owes results for)
+        self._inflight: deque = deque()
+        self._cthread: Optional[threading.Thread] = None
+        self._meter = _StageMeter()
+        self._inflight_peak = 0
+        self.launched_batches = 0
+        self.completed_batches = 0
+        self.cancelled_inflight = 0     # cancelled after launch, pre-fetch
+        self.completion_abandoned = 0   # wedged completion -> ran direct
         # counters (mutated under self._cond; mirrored into METRICS)
         self.submitted = 0
         self.batched_served = 0     # resolved with a batched response
@@ -259,15 +373,44 @@ class ServingScheduler:
                     METRICS.counter("serving.direct_fallbacks").inc()
             if entry.state == _ABANDONED:
                 return self._direct(entry.name, entry.svc, entry.body)
-            # claimed: the batch is in flight on the device — duplicating
-            # it would be wasteful, so wait it out
-            entry.done.wait()
+            # claimed: the batch is in flight on the device. Duplicating
+            # it immediately would be wasteful, so give the completion
+            # stage one more request_timeout — but a WEDGED completion
+            # worker (hung fetch) must not hold the request hostage:
+            # abandon the entry and run direct on this thread (the batch
+            # result for it is discarded by the state guard).
+            if not entry.done.wait(self.config.request_timeout_s):
+                with self._cond:
+                    if entry.state == _CLAIMED:
+                        entry.state = _ABANDONED
+                        self.direct_fallbacks += 1
+                        self.completion_abandoned += 1
+                        METRICS.counter("serving.direct_fallbacks").inc()
+                        METRICS.counter(
+                            "serving.completion_abandoned").inc()
+                if entry.state == _ABANDONED:
+                    return self._direct(entry.name, entry.svc, entry.body)
+                entry.done.wait()     # resolved racing with our timeout
         if entry.error is not None:
             raise entry.error
         return entry.resp
 
     def _drop_cancelled(self, entry: _Pending) -> None:
         with self._cond:
+            if entry.state == _CLAIMED and not entry.done.is_set():
+                # already launched, not yet fetched: the device work can't
+                # be recalled, but the caller need not wait for it — mark
+                # the entry resolved-with-cancellation now; the completion
+                # stage's state guard discards the batch result for it
+                entry.state = _DONE
+                entry.error = TaskCancelledException(
+                    f"task [{getattr(entry.task, 'id', '?')}] cancelled "
+                    f"after batch launch, before fetch: "
+                    f"{getattr(entry.task, 'cancel_reason', None)}")
+                self.cancelled_inflight += 1
+                METRICS.counter("serving.cancelled_inflight").inc()
+                entry.done.set()
+                return
             if entry.state != _QUEUED:
                 return
             try:
@@ -318,19 +461,35 @@ class ServingScheduler:
                 reason = self._wait_flush()
                 if self._pending == 0:
                     continue
+                # in-flight window backpressure: launching past the
+                # window would let the device queue grow without bound —
+                # wait for the completion worker to retire a batch (the
+                # queue keeps admitting, and batching, meanwhile)
+                while len(self._inflight) >= self.config.pipeline_depth \
+                        and not self._closed:
+                    self._cond.wait(self.config.idle_timeout_s)
                 batch = self._assemble(reason)
-            if batch:
-                try:
-                    self._dispatch(batch)
-                except BaseException:           # noqa: BLE001
-                    # never strand claimed entries: whatever killed the
-                    # dispatch, every waiter degrades to the host loop
-                    for e in batch:
-                        if not e.done.is_set():
-                            e.resp = None
-                            e.state = _DONE
-                            e.done.set()
-                    raise
+            if not batch:
+                continue
+            try:
+                if self.config.pipeline_depth <= 1:
+                    # depth 1 == the pre-pipeline dispatcher: launch +
+                    # fetch + render synchronously on this thread
+                    with self._meter.stage("launch"):
+                        self._dispatch(batch)
+                else:
+                    with self._meter.stage("launch"):
+                        item = self._launch_stage(batch)
+                    self._enqueue_inflight(item)
+            except BaseException:           # noqa: BLE001
+                # never strand claimed entries: whatever killed the
+                # dispatch, every waiter degrades to the host loop
+                for e in batch:
+                    if not e.done.is_set():
+                        e.resp = None
+                        e.state = _DONE
+                        e.done.set()
+                raise
 
     def _wait_flush(self) -> str:
         """Block (under the cond) until the flush policy fires: size
@@ -393,19 +552,11 @@ class ServingScheduler:
         return batch
 
     def _dispatch(self, batch: List[_Pending]) -> None:
-        """Run the flushed batch grouped by index and hand every entry its
-        result. Never raises: a failed group degrades its entries to the
-        host loop (resp None)."""
-        # group by (name, service identity), not name alone: two entries
-        # can hold DIFFERENT IndexService snapshots for one name (index
-        # deleted + recreated between their enqueues) and each must be
-        # served from its own service, like the direct path would
-        groups: Dict[tuple, List[_Pending]] = {}
-        for e in batch:
-            groups.setdefault((e.name, id(e.svc)), []).append(e)
-        for (name, _svc_id), entries in groups.items():
-            svc = entries[0].svc
-            bodies = [e.body for e in entries]
+        """Depth-1 synchronous dispatch: run the flushed batch grouped by
+        index and hand every entry its result on this thread. Never
+        raises: a failed group degrades its entries to the host loop
+        (resp None)."""
+        for (name, svc, entries, bodies) in self._group(batch):
             try:
                 resps = self._run_batch(name, svc, bodies)
             except Exception:                       # noqa: BLE001
@@ -415,39 +566,107 @@ class ServingScheduler:
                 resps = [None] * len(entries)
             if self.config.oracle:
                 self._oracle_check(name, svc, entries, resps)
+            self._resolve_entries(entries, resps)
+
+    @staticmethod
+    def _group(batch: List[_Pending]) -> list:
+        """[(name, svc, entries, bodies)] grouped by (name, service
+        identity), not name alone: two entries can hold DIFFERENT
+        IndexService snapshots for one name (index deleted + recreated
+        between their enqueues) and each must be served from its own
+        service, like the direct path would.
+
+        Bodies are top-level COPIES: the batch paths insert top-level
+        keys (`_mesh_declined`, `_index_name`) and iterate the dict, and
+        an entry abandoned to direct execution (completion wedge) has its
+        ORIGINAL body concurrently read by the request thread — sharing
+        the dict would let a late fetch mutate it mid-iteration. Inner
+        structures are read-only on both sides and stay shared."""
+        groups: Dict[tuple, List[_Pending]] = {}
+        for e in batch:
+            groups.setdefault((e.name, id(e.svc)), []).append(e)
+        return [(name, entries[0].svc, entries,
+                 [dict(e.body) if isinstance(e.body, dict) else e.body
+                  for e in entries])
+                for (name, _sid), entries in groups.items()]
+
+    def _resolve_entries(self, entries: List[_Pending],
+                         resps: list) -> None:
+        """Hand each claimed entry its result. The state guard makes
+        resolution race-free against the in-flight degradation paths: an
+        entry cancelled after launch or abandoned to direct execution by
+        a wedged completion stage is NOT overwritten — its batch result
+        is discarded."""
+        served = declined = 0
+        for e, r in zip(entries, resps):
             with self._cond:
-                for e, r in zip(entries, resps):
-                    if r is not None:
-                        self.batched_served += 1
-                    else:
-                        self.declined += 1
-            for e, r in zip(entries, resps):
-                e.resp = r
+                if e.state != _CLAIMED:
+                    continue
                 e.state = _DONE
-                e.done.set()
-            METRICS.counter("serving.batched_served").inc(
-                sum(1 for r in resps if r is not None))
-            METRICS.counter("serving.declined").inc(
-                sum(1 for r in resps if r is None))
+                if r is not None:
+                    self.batched_served += 1
+                    served += 1
+                else:
+                    self.declined += 1
+                    declined += 1
+            e.resp = r
+            e.done.set()
+        METRICS.counter("serving.batched_served").inc(served)
+        METRICS.counter("serving.declined").inc(declined)
 
     def _run_batch(self, name: str, svc, bodies: List[dict]) -> list:
-        """One batched program invocation over the pending bodies: the
-        SPMD mesh first (multi-shard), then the fastpath's grouped kernel
-        launches for the remainder. Entries still None take the host loop
-        on their own request threads — which also parallelizes the
-        host-side fallback work instead of serializing it here."""
+        """One batched program invocation over the pending bodies,
+        synchronous: launch stage + fetch stage back-to-back."""
+        return self._finish_group(name, svc, bodies,
+                                  self._launch_group(name, svc, bodies))
+
+    # ---------------- pipelined dispatch ----------------
+
+    def _launch_group(self, name: str, svc, bodies: List[dict]) -> tuple:
+        """LAUNCH stage for one (index, service) group: the SPMD mesh's
+        program invocations (multi-shard), or — mesh-less nodes — the
+        fastpath's grouped kernel launches. Returns unfetched handles;
+        no device sync happens here (oslint OSL504)."""
         node = self.node
-        resps: List[Optional[dict]] = [None] * len(bodies)
+        mesh_handle = None
+        kernel_handle = None
         if node.mesh_service is not None:
-            mesh = node.mesh_service.try_msearch(name, svc, bodies)
+            mesh_handle = node.mesh_service.launch_msearch(name, svc,
+                                                           bodies)
+        elif self.config.kernel_batching and len(bodies) >= 2:
+            from ..search.executor import launch_msearch_batched
+            kernel_handle = launch_msearch_batched(svc.searchers, bodies,
+                                                   index_name=name)
+        return (mesh_handle, kernel_handle)
+
+    def _finish_group(self, name: str, svc, bodies: List[dict],
+                      handles: tuple) -> list:
+        """FETCH/RENDER stage for one group: sync the mesh launch, then
+        coalesce the mesh-declined remainder through the fastpath's
+        grouped kernel launches (their eligibility is only known once the
+        mesh results are back, so that stage launches-and-fetches here).
+        Entries still None take the host loop on their own request
+        threads — which also parallelizes the host-side fallback work
+        instead of serializing it here."""
+        mesh_handle, kernel_handle = handles
+        resps: List[Optional[dict]] = [None] * len(bodies)
+        if mesh_handle is not None:
+            mesh = mesh_handle.fetch()
             if mesh is not None:
                 resps = list(mesh)
         todo = [i for i, r in enumerate(resps) if r is None]
-        # kernel batching only when there is something to coalesce: a
-        # LONE mesh-declined body must take exactly the scheduler-off
-        # path (host loop, incl. its shard-view/pruned rung attribution)
-        # — coalescing may change execution only when it actually fuses
-        if self.config.kernel_batching and len(todo) >= 2:
+        if kernel_handle is not None:
+            batched = kernel_handle.fetch()
+            if batched is not None:
+                for i, r in zip(todo, batched):
+                    if resps[i] is None:
+                        resps[i] = r
+        elif mesh_handle is not None and self.config.kernel_batching \
+                and len(todo) >= 2:
+            # kernel batching only when there is something to coalesce: a
+            # LONE mesh-declined body must take exactly the scheduler-off
+            # path (host loop, incl. its shard-view/pruned attribution)
+            # — coalescing may change execution only when it fuses
             from ..search.executor import msearch_batched
             batched = msearch_batched(svc.searchers,
                                       [bodies[i] for i in todo],
@@ -456,7 +675,114 @@ class ServingScheduler:
                 for i, r in zip(todo, batched):
                     if resps[i] is None:
                         resps[i] = r
+        for h in (mesh_handle, kernel_handle):
+            ms = h.launch_to_fetch_ms() if h is not None else None
+            if ms is not None:
+                # scheduler-owned handles only: this is the pipeline's
+                # deferred-sync window, not a general fetch timer
+                METRICS.histogram("serving.launch_to_fetch").record(ms)
+                self._local.histogram("serving.launch_to_fetch").record(ms)
         return resps
+
+    def _launch_stage(self, batch: List[_Pending]) -> _InFlight:
+        """Dispatcher side of pipelined dispatch: launch every group's
+        programs and return the in-flight record. A group whose launch
+        raises is recorded as errored — its entries degrade to the host
+        loop at completion (never here: the dispatcher must get back to
+        `_wait_flush` immediately)."""
+        groups = []
+        for (name, svc, entries, bodies) in self._group(batch):
+            try:
+                handles = self._launch_group(name, svc, bodies)
+                err = False
+            except Exception:                       # noqa: BLE001
+                handles = None
+                err = True
+            groups.append((name, svc, entries, bodies, handles, err))
+        return _InFlight(groups)
+
+    def _enqueue_inflight(self, item: _InFlight) -> None:
+        with self._cond:
+            self._inflight.append(item)
+            self.launched_batches += 1
+            depth = len(self._inflight)
+            self._inflight_peak = max(self._inflight_peak, depth)
+            METRICS.counter("serving.pipeline.launched").inc()
+            METRICS.gauge("serving.inflight_depth").set(depth)
+            if not self._completion_alive():
+                self._start_completion()
+            self._cond.notify_all()
+
+    def _completion_alive(self) -> bool:
+        return self._cthread is not None and self._cthread.is_alive()
+
+    def _start_completion(self) -> None:
+        self._cthread = threading.Thread(
+            target=self._completion_loop,
+            name="ostpu-serving-completion", daemon=True)
+        self._cthread.start()
+
+    def _completion_loop(self) -> None:
+        """Completion worker: retire in-flight batches FIFO — device
+        sync, oracle re-check, response rendering, future resolution.
+        The head batch stays in the window while it is being fetched, so
+        the dispatcher's backpressure bound counts it."""
+        me = threading.current_thread()
+        while True:
+            with self._cond:
+                while not self._inflight and not self._closed:
+                    if not self._cond.wait(self.config.idle_timeout_s) \
+                            and not self._inflight:
+                        if self._cthread is me:
+                            self._cthread = None
+                        return
+                if not self._inflight:
+                    return          # closed and drained
+                item = self._inflight[0]
+            try:
+                with self._meter.stage("fetch"):
+                    self._complete(item)
+            finally:
+                # never strand entries, whatever killed the completion
+                for e in item.unresolved():
+                    with self._cond:
+                        if e.state != _CLAIMED:
+                            continue
+                        e.state = _DONE
+                    e.resp = None
+                    e.done.set()
+                with self._cond:
+                    if self._inflight and self._inflight[0] is item:
+                        self._inflight.popleft()
+                    self.completed_batches += 1
+                    METRICS.counter("serving.pipeline.completed").inc()
+                    METRICS.gauge("serving.inflight_depth").set(
+                        len(self._inflight))
+                    self._cond.notify_all()     # wake the dispatcher
+
+    def _complete(self, item: _InFlight) -> None:
+        """Fetch + render + resolve one in-flight batch. Never raises for
+        per-group failures: an errored group degrades its entries to the
+        host loop (resp None), exactly like the synchronous dispatcher."""
+        for (name, svc, entries, bodies, handles, err) in item.groups:
+            if err:
+                resps = [None] * len(entries)
+                with self._cond:
+                    self.batch_errors += 1
+                METRICS.counter("serving.batch_errors").inc()
+            else:
+                try:
+                    resps = self._finish_group(name, svc, bodies, handles)
+                except Exception:                   # noqa: BLE001
+                    with self._cond:
+                        self.batch_errors += 1
+                    METRICS.counter("serving.batch_errors").inc()
+                    resps = [None] * len(entries)
+            if self.config.oracle:
+                # pipelined batches re-run against the direct path too:
+                # pipeline on/off must be byte-identical
+                self._oracle_check(name, svc, entries, resps)
+            self._resolve_entries(entries, resps)
 
     # ---------------- degraded / oracle paths ----------------
 
@@ -526,7 +852,7 @@ class ServingScheduler:
         coalescing). Returns False when the timeout expired first."""
         deadline = time.monotonic() + timeout
         with self._cond:
-            while self._pending > 0:
+            while self._pending > 0 or self._inflight:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -534,15 +860,20 @@ class ServingScheduler:
             return True
 
     def close(self, drain: bool = True) -> None:
-        """Stop the dispatcher. With drain=True pending entries are
-        flushed one last time; without it they degrade to direct
-        execution via the request-thread timeout path."""
+        """Stop the dispatcher and the completion worker. With drain=True
+        pending entries are flushed one last time and in-flight launches
+        retired; without it they degrade to direct execution via the
+        request-thread timeout path."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
             t = self._thread
-        if t is not None and drain:
-            t.join(timeout=5.0)
+            ct = self._cthread
+        if drain:
+            if t is not None:
+                t.join(timeout=5.0)
+            if ct is not None:
+                ct.join(timeout=5.0)
 
     def stats(self) -> dict:
         with self._cond:
@@ -568,9 +899,21 @@ class ServingScheduler:
                 "oracle": {"enabled": self.config.oracle,
                            "checks": self.oracle_checks,
                            "mismatches": self.oracle_mismatches},
+                "pipeline": {
+                    "depth": self.config.pipeline_depth,
+                    "inflight": len(self._inflight),
+                    "inflight_peak": self._inflight_peak,
+                    "launched_batches": self.launched_batches,
+                    "completed_batches": self.completed_batches,
+                    "cancelled_inflight": self.cancelled_inflight,
+                    "completion_abandoned": self.completion_abandoned,
+                },
             }
+        out["pipeline"].update(self._meter.snapshot())
         out["batch_size"] = self._local.percentiles("serving.batch_size")
         out["queue_wait_ms"] = self._local.percentiles("serving.queue_wait")
+        out["launch_to_fetch_ms"] = self._local.percentiles(
+            "serving.launch_to_fetch")
         return out
 
     def note_bypass(self) -> None:

@@ -1,22 +1,28 @@
 """Closed-loop concurrency benchmark for the serving scheduler, on the
 8-virtual-device CPU mesh (no tunnel needed): index a scaled-down bench
 corpus across 4 shards, then hammer the product search path with
-N ∈ {1, 8, 32, 64} client threads, scheduler ON vs OFF, over the bench's
-match + filtered-bool mix.
+N ∈ {1, 8, 32, 64} client threads, over the bench's match + filtered-bool
+mix, across modes: scheduler OFF, and scheduler ON at each pipeline
+depth in CONC_DEPTHS (default 1,2 — depth 1 is the synchronous PR 4
+dispatcher, depth ≥ 2 the pipelined launch/fetch split).
 
 Per (N, mode) cell it reports QPS, p50/p95 request latency (DDSketch
 percentiles from utils/metrics.py — the registry's bin math), device
-scoring-program invocations (`mesh.launches` + `fastpath.launches`), and
-the mean flushed batch size; it asserts every response is byte-identical
-(modulo wall-clock `took`) across ALL cells, and — the acceptance gate —
-that at 32 threads the scheduler cuts program invocations >= 4x with a
-mean batch >= 4.
+scoring-program invocations (`mesh.launches` + `fastpath.launches`), the
+mean flushed batch size, and for scheduler-on cells the pipeline stage
+accounting (launch_s / fetch_s / overlap ratio) plus launch→fetch p50/p95;
+it asserts every response is byte-identical (modulo wall-clock `took`)
+across ALL cells — pipeline on/off included — and gates: at 32 threads
+the scheduler cuts program invocations >= 4x with a mean batch >= 4, and
+the pipelined path (max depth) beats depth-1 on throughput OR stage
+overlap.
 
 Results land in BENCH_out.json under `extra.concurrency` (merged into an
 existing bench emission when present). Run:
     python scripts/measure_concurrency.py [ndocs]
 Env: CONC_NQ (queries per cell, default 256), CONC_THREADS (comma list,
-default 1,8,32,64), CONC_ASSERT=0 to report without gating.
+default 1,8,32,64), CONC_DEPTHS (comma list, default 1,2),
+CONC_ASSERT=0 to report without gating.
 """
 
 import json
@@ -103,13 +109,25 @@ def strip_took(resp: dict) -> str:
                       sort_keys=True)
 
 
-def run_cell(client, bodies, nthreads: int, sched_on: bool, tag: str):
+def run_cell(client, bodies, nthreads: int, mode, tag: str):
     """Closed loop: `nthreads` client threads drain the shared query list;
-    every thread records its request wall into a DDSketch histogram."""
+    every thread records its request wall into a DDSketch histogram.
+    `mode` is None for scheduler-off, or a pipeline depth (int) for a
+    fresh scheduler-on cell at that depth."""
+    from opensearch_tpu.serving import SchedulerConfig, ServingScheduler
     from opensearch_tpu.utils.metrics import METRICS, MetricsRegistry
 
     node = client.node
-    node.serving.enabled = sched_on
+    old_serving = node.serving
+    sched_on = mode is not None
+    if sched_on:
+        # fresh scheduler per cell: per-instance stage/percentile
+        # accounting starts at zero, so the cell's pipeline numbers are
+        # the cell's alone
+        node.serving = ServingScheduler(
+            node, SchedulerConfig(pipeline_depth=int(mode)), enabled=True)
+    else:
+        node.serving.enabled = False
     mesh = node.mesh_service
     reg = MetricsRegistry()
     hist = reg.histogram("request_ms")
@@ -156,6 +174,7 @@ def run_cell(client, bodies, nthreads: int, sched_on: bool, tag: str):
     cell = {
         "threads": nthreads,
         "scheduler": "on" if sched_on else "off",
+        "mode": "off" if not sched_on else f"d{int(mode)}",
         "n": len(bodies),
         "errors": len(errors),
         "wall_s": round(wall, 3),
@@ -167,6 +186,19 @@ def run_cell(client, bodies, nthreads: int, sched_on: bool, tag: str):
         "flushes": flushes,
         "mean_batch": round(batched / flushes, 2) if flushes else None,
     }
+    if sched_on:
+        pipe = serving1["pipeline"]
+        cell["pipeline_depth"] = pipe["depth"]
+        cell["overlap_ratio"] = pipe["overlap_ratio"]
+        cell["launch_s"] = pipe["launch_s"]
+        cell["fetch_s"] = pipe["fetch_s"]
+        cell["inflight_peak"] = pipe["inflight_peak"]
+        ltf = serving1.get("launch_to_fetch_ms") or {}
+        if ltf.get("count"):
+            cell["launch_to_fetch_p50_ms"] = ltf.get("p50_ms")
+            cell["launch_to_fetch_p95_ms"] = ltf.get("p95_ms")
+        node.serving.close()
+    node.serving = old_serving
     if errors:
         cell["first_errors"] = errors[:3]
     return cell, results
@@ -177,22 +209,26 @@ def main():
     nq = int(os.environ.get("CONC_NQ", 256))
     thread_counts = [int(t) for t in
                      os.environ.get("CONC_THREADS", "1,8,32,64").split(",")]
+    depths = [int(d) for d in
+              os.environ.get("CONC_DEPTHS", "1,2").split(",")]
     gate = os.environ.get("CONC_ASSERT", "1") not in ("0", "")
     t0 = time.time()
     client, queries, vocab_strs = build_client(ndocs)
     bodies = make_bodies(queries, vocab_strs, nq)
-    print(f"setup {time.time()-t0:.1f}s ndocs={ndocs} nq={nq}", flush=True)
+    print(f"setup {time.time()-t0:.1f}s ndocs={ndocs} nq={nq} "
+          f"depths={depths}", flush=True)
 
+    modes = [None] + depths        # off, then scheduler-on per depth
     canonical = None
     cells = []
     mismatched = 0
     errored = 0
     by_key = {}
     for nthreads in thread_counts:
-        for sched_on in (False, True):
-            tag = f"{nthreads}-{'on' if sched_on else 'off'}"
-            cell, results = run_cell(client, bodies, nthreads, sched_on,
-                                     tag)
+        for mode in modes:
+            mname = "off" if mode is None else f"d{mode}"
+            tag = f"{nthreads}-{mname}"
+            cell, results = run_cell(client, bodies, nthreads, mode, tag)
             errored += cell["errors"]
             digests = [strip_took(r) if r is not None else None
                        for r in results]
@@ -202,22 +238,35 @@ def main():
             cell["identical_responses"] = bad == 0
             mismatched += bad
             cells.append(cell)
-            by_key[(nthreads, sched_on)] = cell
+            by_key[(nthreads, mname)] = cell
             print(json.dumps(cell), flush=True)
 
     summary = {"ndocs": ndocs, "nq": nq,
                "devices": len(jax.devices()),
                "mix": "60% match2 / 40% filtered bool",
                "identical_responses": mismatched == 0,
+               "pipeline_depths": depths,
                "cells": cells}
-    off32 = by_key.get((32, False))
-    on32 = by_key.get((32, True))
+    off32 = by_key.get((32, "off"))
+    on32 = by_key.get((32, f"d{depths[0]}"))
+    deep = f"d{max(depths)}" if len(depths) > 1 else None
+    on32p = by_key.get((32, deep)) if deep else None
     if off32 and on32 and on32["program_invocations"]:
         summary["invocation_reduction_32t"] = round(
             off32["program_invocations"] / on32["program_invocations"], 2)
         summary["mean_batch_32t"] = on32["mean_batch"]
         summary["qps_speedup_32t"] = round(
             on32["qps"] / max(off32["qps"], 1e-9), 2)
+    if on32 and on32p:
+        # the pipeline acceptance numbers: depth-1 (synchronous) vs the
+        # deepest pipelined cell at 32 closed-loop threads
+        summary["pipeline_32t"] = {
+            "depth1_qps": on32["qps"],
+            f"{deep}_qps": on32p["qps"],
+            "qps_gain": round(on32p["qps"] / max(on32["qps"], 1e-9), 3),
+            "depth1_overlap_ratio": on32.get("overlap_ratio"),
+            f"{deep}_overlap_ratio": on32p.get("overlap_ratio"),
+        }
 
     # merge into the BENCH json emission (extra.concurrency)
     out_path = os.path.join(_REPO, "BENCH_out.json")
@@ -249,6 +298,17 @@ def main():
             if mb < 4:
                 raise SystemExit(f"mean flushed batch at 32 threads is "
                                  f"{mb} (< 4)")
+        if on32 and on32p:
+            p = summary["pipeline_32t"]
+            d1_ov = p.get("depth1_overlap_ratio") or 0.0
+            dp_ov = p.get(f"{deep}_overlap_ratio") or 0.0
+            # pipelined must show measurably higher throughput OR stage
+            # overlap than depth-1 (on the CPU mesh, launch and fetch
+            # compete for the same cores, so overlap is the primary win)
+            if not (p["qps_gain"] > 1.0 or dp_ov > d1_ov + 0.05):
+                raise SystemExit(
+                    f"pipelined dispatch shows no win at 32 threads: "
+                    f"qps_gain={p['qps_gain']} overlap {d1_ov} -> {dp_ov}")
     print("OK", flush=True)
 
 

@@ -512,6 +512,97 @@ class TestWaitDiscipline:
 
 
 # ----------------------------------------------------------------------
+# OSL504 device-sync discipline (launch-stage code must not block)
+# ----------------------------------------------------------------------
+
+class TestDeviceSyncDiscipline:
+    def test_osl504_device_get_in_launch_function(self):
+        # the regression the rule exists for: a sync sneaking back into a
+        # launch-stage body re-serializes the pipeline silently
+        src = """
+            import jax
+
+            def _launch_group(fn, args):
+                out = fn(*args)
+                return jax.device_get(out)
+        """
+        assert "OSL504" in rules_of(
+            lint(src, "opensearch_tpu/parallel/service.py"))
+
+    def test_osl504_block_until_ready_and_from_import(self):
+        src = """
+            from jax import device_get as dg
+
+            def launch_batch(fn, args):
+                out = fn(*args)
+                out[0].block_until_ready()
+                return dg(out)
+        """
+        found = lint(src, "opensearch_tpu/search/fastpath.py")
+        assert [f for f in found if "block_until_ready" in f.detail]
+        assert [f for f in found if "device_get" in f.detail]
+
+    def test_osl504_asarray_on_device_named_array(self):
+        src = """
+            import numpy as np
+
+            def _launch_rows(al):
+                return np.asarray(al.d_docs)
+        """
+        assert "OSL504" in rules_of(
+            lint(src, "opensearch_tpu/search/fastpath.py"))
+
+    def test_osl504_quiet_on_host_asarray_and_fetch_closure(self):
+        # host-named asarray in launch code is legal; a sync inside the
+        # nested fetch closure is the DESIGN, not a violation
+        src = """
+            import jax
+            import numpy as np
+
+            def launch_batch(fn, rows):
+                stacked = np.asarray(rows)
+                out = fn(stacked)
+
+                def _fetch():
+                    return jax.device_get(out)
+                return _fetch
+        """
+        assert rules_of(lint(src, "opensearch_tpu/search/executor.py")) \
+            == []
+
+    def test_osl504_dispatcher_scope_and_out_of_scope_quiet(self):
+        # the serving dispatcher's hot path counts as launch-stage...
+        src = """
+            import jax
+
+            class S:
+                def _assemble(self, reason, out):
+                    return jax.device_get(out)
+        """
+        assert "OSL504" in rules_of(
+            lint(src, "opensearch_tpu/serving/scheduler.py"))
+        # ...but the same method name elsewhere, and non-launch functions
+        # anywhere, fetch freely (the sync paths still exist by design)
+        assert rules_of(lint(src, "opensearch_tpu/utils/metrics.py")) == []
+        src_fetch = """
+            import jax
+
+            def _fetch_pure_groups(pending):
+                return jax.device_get(pending)
+        """
+        assert rules_of(
+            lint(src_fetch, "opensearch_tpu/search/fastpath.py")) == []
+
+    def test_osl504_repo_launch_stages_clean(self):
+        # the ratchet at zero: every launch_*/_launch* body in the live
+        # tree stays sync-free (this is what keeps the split real)
+        findings = run_paths(["opensearch_tpu/search",
+                              "opensearch_tpu/parallel",
+                              "opensearch_tpu/serving"], REPO_ROOT)
+        assert [f for f in findings if f.rule == "OSL504"] == []
+
+
+# ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 

@@ -208,8 +208,12 @@ class TestCancellationAndAdmission:
         cm, _ = clients
         node = cm.node
         old = node.serving
+        # depth 1 pins the synchronous dispatcher so stalling _run_batch
+        # stalls the dispatcher in-batch (the pipelined window's own
+        # backpressure bound is covered by TestPipeline)
         sched = ServingScheduler(
-            node, SchedulerConfig(max_batch=1, max_wait_us=0, queue_cap=1),
+            node, SchedulerConfig(max_batch=1, max_wait_us=0, queue_cap=1,
+                                  pipeline_depth=1),
             enabled=True)
         node.serving = sched
         gate = threading.Event()
@@ -419,6 +423,234 @@ class TestHammerParity:
             assert sched.stats()["direct_fallbacks"] >= 1
         finally:
             node.serving = old
+
+
+class TestPipeline:
+    """Pipelined dispatch (launch/fetch split): byte-parity across
+    depths, the bounded in-flight window, completion-stage wedge
+    degradation, and cancellation of a launched-but-unfetched request."""
+
+    def test_depth_parity_hammer(self, clients):
+        """Pipeline on/off must be byte-identical: the same shape mix
+        hammered at depth 1 (the synchronous baseline), 2 and 4 serves
+        identical pages/scores/tie-breaks as direct execution."""
+        cm, ch = clients
+        node = cm.node
+        old = node.serving
+        nthreads, per = 8, 6
+        try:
+            for depth in (1, 2, 4):
+                # depth-unique _bench keys: identical keys across depth
+                # cells would serve depths 2/4 from the request cache and
+                # never exercise the scheduler
+                want = {}
+                for k in range(nthreads):
+                    for j in range(per):
+                        b = dict(BODIES[(k + j) % len(BODIES)],
+                                 _bench=f"pd{depth}-{k}-{j}")
+                        want[(k, j)] = _strip(ch.search("serv", dict(b)))
+                node.serving = ServingScheduler(
+                    node, SchedulerConfig(max_batch=16, max_wait_us=3000,
+                                          pipeline_depth=depth),
+                    enabled=True)
+                got = {}
+                errs = []
+
+                def worker(k):
+                    try:
+                        for j in range(per):
+                            b = dict(BODIES[(k + j) % len(BODIES)],
+                                     _bench=f"pd{depth}-{k}-{j}")
+                            got[(k, j)] = _strip(cm.search("serv", b))
+                    except Exception as e:        # noqa: BLE001
+                        errs.append(repr(e))
+
+                ts = [threading.Thread(target=worker, args=(k,))
+                      for k in range(nthreads)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=120)
+                assert errs == [], f"depth {depth}: {errs}"
+                assert len(got) == nthreads * per
+                for key, w in want.items():
+                    assert got[key] == w, f"depth {depth} diverged at {key}"
+                st = node.serving.stats()
+                assert st["batched_served"] > 0
+                assert st["pipeline"]["depth"] == depth
+                if depth > 1:
+                    assert st["pipeline"]["launched_batches"] > 0
+                    assert st["pipeline"]["completed_batches"] \
+                        == st["pipeline"]["launched_batches"]
+                    assert st["pipeline"]["inflight_peak"] <= depth
+                    assert st["launch_to_fetch_ms"].get("count", 0) > 0
+                else:
+                    # depth 1 == the synchronous dispatcher: nothing ever
+                    # parks in the window, and the stages can't overlap
+                    assert st["pipeline"]["launched_batches"] == 0
+                    assert st["pipeline"]["overlap_s"] == 0
+                node.serving.close()
+        finally:
+            node.serving = old
+
+    def test_inflight_window_backpressure(self, clients):
+        """The dispatcher must stop launching once pipeline_depth batches
+        are in flight — the window bounds the device queue; the request
+        queue keeps admitting (and batching) meanwhile."""
+        cm, _ = clients
+        node = cm.node
+        old = node.serving
+        sched = ServingScheduler(
+            node, SchedulerConfig(max_batch=1, max_wait_us=0,
+                                  pipeline_depth=2), enabled=True)
+        node.serving = sched
+        gate = threading.Event()
+        fetching = threading.Event()
+        real_finish = sched._finish_group
+
+        def stalled(name, svc, bodies, handles):
+            fetching.set()
+            gate.wait(timeout=60)
+            return real_finish(name, svc, bodies, handles)
+
+        sched._finish_group = stalled
+        results = {}
+
+        def worker(k):
+            results[k] = cm.search(
+                "serv", {"query": {"match": {"body": "alpha"}},
+                         "_bench": f"bp-{k}"})
+
+        try:
+            n = 6
+            ts = [threading.Thread(target=worker, args=(k,))
+                  for k in range(n)]
+            for t in ts:
+                t.start()
+            assert fetching.wait(timeout=10)
+            # window fills to 2 launched-unretired batches; the rest stay
+            # QUEUED because the dispatcher is blocked on the window
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                st = sched.stats()
+                if st["pipeline"]["inflight"] == 2 \
+                        and st["queue_depth"] >= n - 3:
+                    break
+                time.sleep(0.005)
+            st = sched.stats()
+            assert st["pipeline"]["inflight"] == 2
+            assert st["queue_depth"] >= n - 3
+            gate.set()
+            for t in ts:
+                t.join(timeout=60)
+            assert len(results) == n
+            assert all(isinstance(r, dict) for r in results.values())
+            st = sched.stats()
+            assert st["pipeline"]["inflight_peak"] <= 2
+            assert st["pipeline"]["completed_batches"] \
+                == st["pipeline"]["launched_batches"]
+        finally:
+            gate.set()
+            sched.close()
+            node.serving = old
+
+    def test_completion_wedge_degrades_direct(self, clients):
+        """A wedged completion stage (hung fetch) must not hold requests
+        hostage: after a second request_timeout the claimed entry is
+        abandoned and the request thread runs direct execution itself —
+        same response, counted as a completion_abandoned fallback."""
+        cm, ch = clients
+        node = cm.node
+        old = node.serving
+        sched = ServingScheduler(
+            node, SchedulerConfig(max_batch=4, pipeline_depth=2,
+                                  request_timeout_s=0.4), enabled=True)
+        node.serving = sched
+        wedge = threading.Event()
+
+        def hung(name, svc, bodies, handles):
+            wedge.wait(timeout=120)
+            return [None] * len(bodies)
+
+        sched._finish_group = hung
+        try:
+            body = {"query": {"match": {"body": "alpha beta"}}, "size": 5,
+                    "_bench": "wedge"}
+            got = cm.search("serv", dict(body))
+            want = ch.search("serv", dict(body))
+            assert _strip(got) == _strip(want)
+            st = sched.stats()
+            assert st["pipeline"]["completion_abandoned"] >= 1
+            assert st["direct_fallbacks"] >= 1
+        finally:
+            wedge.set()
+            sched.close()
+            node.serving = old
+
+    def test_cancel_after_launch_before_fetch(self, clients):
+        """A task cancelled while its batch is launched but not yet
+        fetched resolves immediately with the cancellation error — the
+        batch result for it is discarded by the state guard."""
+        cm, _ = clients
+        node = cm.node
+        old = node.serving
+        sched = ServingScheduler(
+            node, SchedulerConfig(max_batch=1, max_wait_us=0,
+                                  pipeline_depth=2), enabled=True)
+        node.serving = sched
+        gate = threading.Event()
+        fetching = threading.Event()
+        real_finish = sched._finish_group
+
+        def stalled(name, svc, bodies, handles):
+            fetching.set()
+            gate.wait(timeout=60)
+            return real_finish(name, svc, bodies, handles)
+
+        sched._finish_group = stalled
+        caught = {}
+
+        def worker():
+            try:
+                caught["resp"] = cm.search(
+                    "serv", {"query": {"match": {"body": "gamma"}},
+                             "_bench": "cancel-inflight"})
+            except ApiError as e:
+                caught["err"] = e
+
+        try:
+            t = threading.Thread(target=worker)
+            t.start()
+            assert fetching.wait(timeout=10)   # batch launched, unfetched
+            for task in node.tasks.all():
+                task.cancel("pipeline cancel test")
+            t.join(timeout=10)                 # resolves WITHOUT the gate
+            assert not t.is_alive()
+            assert "err" in caught
+            assert caught["err"].status == 400
+            assert "cancel" in caught["err"].reason
+            assert sched.stats()["pipeline"]["cancelled_inflight"] == 1
+        finally:
+            gate.set()
+            sched.close()
+            node.serving = old
+
+    def test_launch_handle_idempotent_and_error_replay(self):
+        from opensearch_tpu.search.launch import LaunchHandle, completed
+        calls = []
+        h = LaunchHandle(lambda: calls.append(1) or "r", kind="test")
+        assert h.fetch() == "r" and h.fetch() == "r" and calls == [1]
+        assert h.launch_to_fetch_ms() is not None
+
+        def boom():
+            raise ValueError("x")
+
+        hb = LaunchHandle(boom, kind="test")
+        with pytest.raises(ValueError):
+            hb.fetch()
+        with pytest.raises(ValueError):
+            hb.fetch()                          # memoized, not re-run
+        assert completed([1, 2]).fetch() == [1, 2]
 
 
 class TestTelemetrySurfaces:
