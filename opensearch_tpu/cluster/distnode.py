@@ -55,6 +55,7 @@ future work).
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
 import os
 import pickle
@@ -277,22 +278,28 @@ class DistClusterNode:
             self._apply_state(body["state"])
             return 200, {"acknowledged": True}
         if op == "dfs" and method == "POST":
-            with self._rpc_span("dist.dfs", body) as s:
+            with self._rpc_span("dist.dfs", body) as s, \
+                    self._rpc_timeline("dfs", body) as rtl:
                 rec = self._local_dfs(body["index"], body["body"])
-            return 200, {"rec": _b64(rec), "span": self._span_out(s)}
+            return 200, {"rec": _b64(rec), "span": self._span_out(s),
+                         "obs": self._obs_out(rtl)}
         if op == "query_phase" and method == "POST":
-            with self._rpc_span("dist.query_phase", body) as s:
+            with self._rpc_span("dist.query_phase", body) as s, \
+                    self._rpc_timeline("query_phase", body) as rtl:
                 results = self._local_query(body["index"], body["body"],
                                             _unb64(body["g"]))
             return 200, {"results": _b64(results),
-                         "span": self._span_out(s)}
+                         "span": self._span_out(s),
+                         "obs": self._obs_out(rtl)}
         if op == "fetch_phase" and method == "POST":
-            with self._rpc_span("dist.fetch_phase", body) as s:
+            with self._rpc_span("dist.fetch_phase", body) as s, \
+                    self._rpc_timeline("fetch_phase", body) as rtl:
                 hits = self._local_fetch(body["index"], body["body"],
                                          int(body["shard"]),
                                          _unb64(body["cands"]),
                                          _unb64(body["g"]))
-            return 200, {"hits": _b64(hits), "span": self._span_out(s)}
+            return 200, {"hits": _b64(hits), "span": self._span_out(s),
+                         "obs": self._obs_out(rtl)}
         if op == "state" and method == "GET":
             return 200, {"state": self._state()}
         if op == "create_index" and method == "POST":
@@ -328,25 +335,68 @@ class DistClusterNode:
     def _span_out(s) -> Optional[dict]:
         return s.to_dict() if s is not None else None
 
+    # ---------------- flight-recorder stitching over the wire ---------
+    #
+    # Mirrors the trace propagation above: the coordinator stamps its
+    # (node, timeline) onto every RPC; the serving node runs the local
+    # phase under its OWN timeline carrying the origin linkage, and the
+    # response returns that timeline's events, which the coordinator
+    # grafts into the request's journal (`RECORDER.graft`) — so one
+    # distributed search reads as ONE stitched cross-node timeline.
+
+    @contextlib.contextmanager
+    def _rpc_timeline(self, op: str, body: dict):
+        from ..obs import flight_recorder as _fr
+        ctx = body.get("obs_ctx")
+        if not _fr.RECORDER.enabled or not isinstance(ctx, dict):
+            yield 0
+            return
+        tl = _fr.RECORDER.start(f"rpc.{op}", node=self.name,
+                                origin_node=ctx.get("node"),
+                                origin_timeline=ctx.get("timeline"))
+        token = _fr.set_current(tl)
+        try:
+            if tl:
+                _fr.RECORDER.record(tl, "rpc.accept", op=op,
+                                    node=self.name)
+            yield tl
+        finally:
+            _fr.reset_current(token)
+
+    @staticmethod
+    def _obs_out(tl: int) -> Optional[list]:
+        if not tl:
+            return None
+        from ..obs import flight_recorder as _fr
+        return _fr.RECORDER.timeline_events(tl)
+
     def _rpc(self, member: str, op: str, payload: dict) -> dict:
         """Coordinator-side RPC with trace stamping + span grafting +
-        latency accounting."""
+        flight-recorder timeline stitching + latency accounting."""
+        from ..obs import flight_recorder as _fr
         from ..utils.metrics import METRICS
         from ..utils.trace import TRACER
         wctx = TRACER.wire_context()
         if wctx is not None:
             payload = dict(payload,
                            trace_ctx=dict(wctx, coordinator=self.name))
+        tl = _fr.current() if _fr.RECORDER.enabled else 0
+        if tl:
+            payload = dict(payload,
+                           obs_ctx={"node": self.name, "timeline": tl})
         t0 = time.monotonic()
         try:
             r = _http(self.members[member], "POST", f"/_internal/{op}",
                       payload)
         except Exception:
             METRICS.counter("dist.rpc.failed").inc()
+            if tl:
+                _fr.RECORDER.record(tl, "rpc.failed", op=op, node=member)
             raise
         METRICS.histogram(f"dist.rpc.{op}").record(
             (time.monotonic() - t0) * 1000.0)
         TRACER.attach_remote(r.get("span"))
+        _fr.RECORDER.graft(tl, r.get("obs"), node=member)
         return r
 
     # ---------------- cluster API ----------------
@@ -500,11 +550,27 @@ class DistClusterNode:
         """Distributed DFS_QUERY_THEN_FETCH across every member, reduced
         once on this node. The whole scatter/gather runs under ONE root
         span; every remote leg's span tree comes back on the RPC response
-        and nests under the coordinator's phase span."""
+        and nests under the coordinator's phase span. Same deal for the
+        flight recorder: the coordinator owns one timeline, every RPC
+        carries it, and the remote legs' events graft back into it."""
+        from ..obs import flight_recorder as _fr
         from ..utils.trace import TRACER
-        with TRACER.span("dist.search", index=index,
-                         coordinator=self.name):
-            return self._search_traced(index, body)
+        token = None
+        if _fr.RECORDER.enabled and not _fr.current():
+            tl = _fr.RECORDER.start("dist.search", index=index,
+                                    node=self.name)
+            token = _fr.set_current(tl)
+        try:
+            with TRACER.span("dist.search", index=index,
+                             coordinator=self.name):
+                if _fr.RECORDER.enabled and _fr.current():
+                    _fr.RECORDER.record(_fr.current(), "dist.accept",
+                                        index=index,
+                                        coordinator=self.name)
+                return self._search_traced(index, body)
+        finally:
+            if token is not None:
+                _fr.reset_current(token)
 
     def _search_traced(self, index: str, body: dict) -> dict:
         from ..utils.metrics import METRICS

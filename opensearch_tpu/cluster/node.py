@@ -21,6 +21,7 @@ from ..index.mappings import Mappings
 from ..ingest import IngestService
 from ..search.executor import ShardSearcher, msearch_batched, search_shards
 from ..utils.breaker import BreakerService
+from ..obs import flight_recorder as _fr
 from ..utils.slowlog import SlowLog
 from ..utils.tasks import TaskRegistry
 from ..utils.threadpool import ThreadPools
@@ -330,6 +331,9 @@ class Node:
         self.lifecycle = LifecycleService(self)
         from ..utils.trace import TRACER
         self.tracer = TRACER
+        # flight recorder (obs/flight_recorder.py): per-request black-box
+        # event journal + anomaly dumps; process singleton like TRACER
+        self.flight_recorder = _fr.RECORDER
         from .failure import FailureDetector
         self.failure_detector = FailureDetector(self)
         # node-level op counters (reference NodeIndicesStats rollup)
@@ -918,7 +922,31 @@ class Node:
         pipeline response processors) — deep-copy it iff it aliases a
         request-cache entry, so cached entries stay pristine without taxing
         uncached paths. `wlm_lane`: serving-scheduler priority lane from
-        the request's workload group (REST layer resolves it)."""
+        the request's workload group (REST layer resolves it).
+
+        Flight-recorder timeline ownership: the REST facade usually
+        starts the request's timeline (rest.accept); when none is
+        current — direct engine callers, tests — this entry point owns
+        one for the duration of the search, so every downstream event
+        (scheduler, mesh, fastpath ladder) lands on a journal."""
+        _rec = self.flight_recorder
+        tl = _fr.current() if _rec.enabled else 0
+        if not _rec.enabled or tl:
+            return self._search_recorded(expression, body, phase_hook,
+                                         phase_ctx, copy_protect,
+                                         wlm_lane, tl)
+        tl = _rec.start("search", index=expression, node=self.node_name)
+        token = _fr.set_current(tl)
+        try:
+            return self._search_recorded(expression, body, phase_hook,
+                                         phase_ctx, copy_protect,
+                                         wlm_lane, tl)
+        finally:
+            _fr.reset_current(token)
+
+    def _search_recorded(self, expression: str, body: dict, phase_hook,
+                         phase_ctx: Optional[dict], copy_protect: bool,
+                         wlm_lane: Optional[str], tl: int) -> dict:
         # a body the mesh already declined in this request (msearch batch
         # decline -> per-body retry) skips the mesh: one logical search
         # counts at most one mesh fallback, and the retry does no wasted
@@ -944,6 +972,11 @@ class Node:
                         similarity=rsvc.default_sim,
                         index_key=f"{alias}:{rn}"))
                 gens.append((alias, rn, rsvc.generation))
+        _rec = self.flight_recorder
+        if _rec.enabled and tl:
+            _rec.record(tl, "search.start", index=expression,
+                        shards=len(searchers),
+                        lane=wlm_lane or "interactive")
         # request cache (deterministic bodies only; a phase hook makes the
         # response depend on pipeline state, so it bypasses the cache)
         import json as _json
@@ -956,6 +989,8 @@ class Node:
         if cache_key is not None:
             cached = self.request_cache.get(cache_key)
             if cached is not None:
+                if _rec.enabled and tl:
+                    _rec.record(tl, "cache.hit", index=expression)
                 if copy_protect:
                     import copy as _copy
                     return _copy.deepcopy(cached)
@@ -966,6 +1001,7 @@ class Node:
         self.search_backpressure.check(self.tasks)
         task = self.tasks.register("indices:data/read/search",
                                    f"indices[{expression}]")
+        task.timeline_id = tl      # _tasks <-> flight-recorder linkage
         t0 = time.monotonic()
         # ladder-rung attribution for the slowlog: which fastpath rungs
         # this request exercised. A STATS delta over the request window
@@ -978,6 +1014,11 @@ class Node:
             with self.tracer.span("indices:data/read/search",
                                   index=expression,
                                   shards=len(searchers)) as root_span:
+                if _rec.enabled and tl and root_span is not None:
+                    # key the timeline to the existing trace context, so
+                    # journals and span trees cross-reference
+                    _rec.annotate(tl, trace_root_id=root_span.span_id,
+                                  task_id=task.id)
                 resp = None
                 if (len(names) == 1 and not remote_parts
                         and phase_hook is None
@@ -1019,6 +1060,10 @@ class Node:
                                          index_name=",".join(all_names),
                                          task=task, phase_hook=phase_hook,
                                          phase_ctx=phase_ctx)
+        except BaseException as e:
+            if _rec.enabled and tl:
+                _rec.record(tl, "search.error", error=type(e).__name__)
+            raise
         finally:
             self.tasks.unregister(task)
         took = time.monotonic() - t0
@@ -1036,9 +1081,18 @@ class Node:
 
         self.op_counters["search_total"] += 1
         self.op_counters["search_time_ms"] += took * 1000.0
+        if _rec.enabled and tl:
+            _rec.record(tl, "search.done",
+                        took_ms=round(took * 1000.0, 3),
+                        hits=resp["hits"]["total"]["value"]
+                        if isinstance(resp.get("hits", {}).get("total"),
+                                      dict) else None)
         for name in names:
+            # slowlog entries carry the timeline id, and a threshold hit
+            # triggers a flight-recorder dump (utils/slowlog.py)
             self.indices[name].search_slowlog.maybe_log(
-                took, body.get("query"), extra=_slow_extra)
+                took, body.get("query"), extra=_slow_extra,
+                timeline_id=tl)
         if len(names) == 1 and not remote_parts:
             for h in resp["hits"]["hits"]:
                 h["_index"] = names[0]

@@ -1,0 +1,184 @@
+"""OSL505 — flight-recorder / slowlog emission discipline.
+
+The flight recorder (obs/flight_recorder.py) lives on the serving and
+search hot paths; its whole design contract is that the DISABLED path
+costs one attribute read. Two ways an emission site silently breaks
+that, and one way it breaks forensics:
+
+- **Eager payloads.** `RECORDER.record(tl, kind, **fields)` builds its
+  keyword dict (and any f-strings inside it) BEFORE the callee can check
+  `enabled`. Every event-emission call must therefore sit inside a guard
+  that short-circuits when the recorder is off: an `if` whose test reads
+  `.enabled`, or an `if <tl>:` on the call's own timeline id (a timeline
+  id is only ever non-zero when the recorder was enabled at `start()`).
+- **Wall-clock timestamps.** Event times must come from the monotonic
+  clock; a `time.time()` anywhere in a record call's arguments makes the
+  journal re-orderable under NTP steps (the ring's dump conversion owns
+  the single wall anchor).
+- **Eager slowlog extras.** `SlowLog.maybe_log(..., extra=...)` invokes
+  a callable extra only when a threshold fires; passing a dict literal
+  (or anything holding an f-string) builds the attribution payload on
+  EVERY request — exactly the cost `maybe_log`'s lazy contract exists to
+  avoid.
+
+Event-emission calls are recognized structurally: an attribute call
+named `.record` with two or more positional arguments or any keyword
+argument — which distinguishes them from the one-argument histogram
+(`LatencyHistogram.record(ms)`) and workload (`WorkloadGroup.record(s)`)
+records.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+
+def _contains_enabled(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr == "enabled":
+            return True
+    return False
+
+
+def _test_names(test: ast.AST) -> Set[str]:
+    """Plain and dotted names referenced by a guard test (`tl`,
+    `e.tl`, `entry.tl` ...)."""
+    out: Set[str] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            d = _dotted(n)
+            if d:
+                out.add(d)
+    return out
+
+
+def _first_arg_name(call: ast.Call) -> Optional[str]:
+    if not call.args:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Name):
+        return a.id
+    if isinstance(a, ast.Attribute):
+        return _dotted(a) or None
+    return None
+
+
+class RecorderDisciplineChecker(Checker):
+    rules = ("OSL505",)
+    name = "recorder-discipline"
+
+    SCOPES = ("serving/", "search/", "parallel/", "rest/", "cluster/",
+              "utils/", "ops/")
+    EXEMPT = ("obs/", "devtools/")
+
+    def applies(self, path: str) -> bool:
+        if any(s in path for s in self.EXEMPT):
+            return False
+        return any(s in path for s in self.SCOPES)
+
+    # ---------------- helpers ----------------
+
+    @staticmethod
+    def _is_event_record(node: ast.Call) -> bool:
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and (len(node.args) >= 2 or bool(node.keywords)))
+
+    @staticmethod
+    def _walltime_in_args(node: ast.Call, mods: Set[str],
+                          funcs: Set[str]) -> bool:
+        for sub in ast.walk(node):
+            if sub is node or not isinstance(sub, ast.Call):
+                continue
+            d = _dotted(sub.func)
+            if d in funcs:
+                return True
+            head, _, tail = d.rpartition(".")
+            if tail == "time" and head in mods:
+                return True
+        return False
+
+    @staticmethod
+    def _time_aliases(tree: ast.Module):
+        mods: Set[str] = set()
+        funcs: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        mods.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        funcs.add(a.asname or "time")
+        return mods, funcs
+
+    @staticmethod
+    def _eager_extra(kw: ast.keyword) -> bool:
+        v = kw.value
+        if isinstance(v, (ast.Dict, ast.DictComp)):
+            return True
+        return any(isinstance(n, ast.JoinedStr) for n in ast.walk(v))
+
+    # ---------------- check ----------------
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        mods, funcs = self._time_aliases(tree)
+
+        def visit(node: ast.AST, guards: List[ast.AST]) -> None:
+            if isinstance(node, ast.If):
+                for child in node.body:
+                    visit(child, guards + [node.test])
+                for child in node.orelse:
+                    visit(child, guards)
+                return
+            if isinstance(node, ast.Call) and self._is_event_record(node):
+                tl_name = _first_arg_name(node)
+                guarded = any(
+                    _contains_enabled(t)
+                    or (tl_name is not None and tl_name in _test_names(t))
+                    for t in guards)
+                if not guarded:
+                    findings.append(Finding(
+                        "OSL505", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        "flight-recorder event emitted without an "
+                        "`if RECORDER.enabled:` (or `if <timeline>:`)"
+                        " guard — the payload dict is built even when "
+                        "the recorder is disabled",
+                        detail="unguarded-record"))
+                if self._walltime_in_args(node, mods, funcs):
+                    findings.append(Finding(
+                        "OSL505", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        "time.time() inside a recorder event — event "
+                        "timestamps must be monotonic (the dump "
+                        "conversion owns the single wall anchor)",
+                        detail="walltime-event"))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "maybe_log":
+                for kw in node.keywords:
+                    if kw.arg == "extra" and self._eager_extra(kw):
+                        findings.append(Finding(
+                            "OSL505", path, node.lineno, node.col_offset,
+                            qmap.get(node, ""),
+                            "slowlog `extra` built eagerly (dict "
+                            "literal / f-string); pass a callable so "
+                            "the attribution payload is only built "
+                            "when a threshold fires",
+                            detail="eager-slowlog-extra"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, guards)
+
+        visit(tree, [])
+        findings.sort(key=lambda f: (f.line, f.detail))
+        return findings

@@ -1,0 +1,11 @@
+"""Observability forensics: the flight recorder (per-request black-box
+event journal with anomaly-triggered dumps) and the hot-threads stack
+sampler. docs/OBSERVABILITY.md documents the event schema, the dump
+triggers, and the retention/overhead knobs."""
+
+from .flight_recorder import (FlightRecorder, RECORDER, current,
+                              reset_current, set_current)
+from .hot_threads import hot_threads
+
+__all__ = ["FlightRecorder", "RECORDER", "current", "set_current",
+           "reset_current", "hot_threads"]

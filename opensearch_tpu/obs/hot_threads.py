@@ -1,0 +1,130 @@
+"""`_nodes/hot_threads`: a Python-side stack sampler.
+
+Reference analog: `monitor/jvm/HotThreads.java` — sample every thread's
+stack a few times over a short interval, coalesce identical stacks, and
+report the busiest ones with idle threads filtered out. Here the stacks
+come from `sys._current_frames()` (the CPython equivalent of the JVM's
+ThreadMXBean dump), the interesting threads are this runtime's named
+actors — the serving scheduler's dispatcher and completion worker
+(`ostpu-serving-*`), the named host pools (`ostpu-search-*` etc.) — plus
+whatever HTTP request threads are mid-search.
+
+Idle filtering drops threads whose every snapshot parks in a known wait
+site (condition/event waits, selector polls, executor queue gets) —
+EXCEPT the runtime's own `ostpu-*` threads, which are always reported
+(their parked-ness is exactly what an operator diagnosing a wedge needs
+to see) with an `idle=true` annotation.
+
+The inter-snapshot sleep is a sampling interval, not a poll-for-condition
+loop — OSL503's no-sleep-polling rule patrols coordination code
+(serving/, utils/, rest/), not samplers."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+# (filename suffix, function name) pairs that mean "parked, not working".
+# A thread is idle when its INNERMOST frame matches one of these.
+_IDLE_SITES = (
+    ("threading.py", "wait"),
+    ("threading.py", "_wait_for_tstate_lock"),
+    ("selectors.py", "select"),
+    ("socketserver.py", "serve_forever"),
+    ("socketserver.py", "get_request"),
+    ("socket.py", "accept"),
+    ("thread.py", "_worker"),      # concurrent.futures executor idle
+    ("queue.py", "get"),
+)
+
+
+def _is_idle_stack(stack: List[traceback.FrameSummary]) -> bool:
+    if not stack:
+        return True
+    top = stack[-1]
+    fn = top.filename or ""
+    for suffix, name in _IDLE_SITES:
+        if fn.endswith(suffix) and top.name == name:
+            return True
+    return False
+
+
+def _sample(own_ident: int) -> Dict[int, List[traceback.FrameSummary]]:
+    frames = sys._current_frames()
+    out = {}
+    for ident, frame in frames.items():
+        if ident == own_ident:
+            continue
+        out[ident] = traceback.extract_stack(frame)
+    return out
+
+
+def hot_threads(node_name: str = "node", snapshots: int = 3,
+                interval_s: float = 0.02, ignore_idle: bool = True,
+                as_json: bool = False):
+    """Sample live thread stacks `snapshots` times, `interval_s` apart.
+
+    Returns the OpenSearch-flavoured plain-text report (default) or, with
+    as_json=True, a list of per-thread dicts:
+    {"name", "ident", "snapshots", "seen", "idle", "stack": [...]}
+    where `stack` is the thread's most frequent sampled stack, innermost
+    frame last."""
+    snapshots = max(1, min(int(snapshots), 10))
+    interval_s = max(0.0, min(float(interval_s), 1.0))
+    own = threading.get_ident()
+    samples: List[Dict[int, List[traceback.FrameSummary]]] = []
+    for i in range(snapshots):
+        if i:
+            time.sleep(interval_s)
+        samples.append(_sample(own))
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    idents = sorted({i for s in samples for i in s},
+                    key=lambda i: (not names.get(i, "").startswith("ostpu-"),
+                                   names.get(i, ""), i))
+    threads = []
+    for ident in idents:
+        stacks = [s[ident] for s in samples if ident in s]
+        # most frequent stack wins (ties: the latest)
+        keyed: Dict[Tuple, List] = {}
+        counts: Dict[Tuple, int] = {}
+        for st in stacks:
+            key = tuple((f.filename, f.lineno, f.name) for f in st)
+            keyed[key] = st
+            counts[key] = counts.get(key, 0) + 1
+        best_key = max(counts, key=lambda k: counts[k])
+        best = keyed[best_key]
+        idle = all(_is_idle_stack(st) for st in stacks)
+        name = names.get(ident, f"thread-{ident}")
+        if idle and ignore_idle and not name.startswith("ostpu-"):
+            continue
+        threads.append({
+            "name": name, "ident": ident,
+            "snapshots": snapshots, "seen": counts[best_key],
+            "idle": idle,
+            "stack": [{"file": f.filename, "line": f.lineno,
+                       "function": f.name,
+                       **({"code": f.line} if f.line else {})}
+                      for f in best],
+        })
+    if as_json:
+        return threads
+
+    lines = [f"::: {{{node_name}}}",
+             f"   Hot threads: {snapshots} snapshots, "
+             f"interval={interval_s * 1000:.0f}ms, "
+             f"ignore_idle={str(ignore_idle).lower()}, "
+             f"threads={len(threads)}", ""]
+    for t in threads:
+        state = "idle/waiting" if t["idle"] else "busy"
+        lines.append(f"   '{t['name']}' id={t['ident']} "
+                     f"{t['seen']}/{t['snapshots']} snapshots sharing "
+                     f"following stack ({state}):")
+        for f in t["stack"]:
+            lines.append(f"     {f['file']}:{f['line']} {f['function']}"
+                         + (f"  | {f['code']}" if f.get("code") else ""))
+        lines.append("")
+    return "\n".join(lines)

@@ -409,12 +409,30 @@ class RestClient:
             # serving-scheduler lane: scroll-initiating searches ride the
             # batch lane; everything else inherits its workload group's
             # lane (interactive preempts batch at flush time)
-            resp = self.node.search(
-                index, body, phase_hook=phase_hook, phase_ctx=phase_ctx,
-                copy_protect=bool(pipeline is not None
-                                  and pipeline.response_procs),
-                wlm_lane=("batch" if scroll
-                          else getattr(wg, "lane", "interactive")))
+            lane = ("batch" if scroll
+                    else getattr(wg, "lane", "interactive"))
+            # flight recorder: the REST facade is where a request's
+            # timeline begins (rest.accept + wlm lane classification);
+            # Node.search reuses the ambient timeline and stamps the
+            # engine-side events onto it
+            from ..obs import flight_recorder as _fr
+            _tl_token = None
+            if _fr.RECORDER.enabled and not _fr.current():
+                _tl = _fr.RECORDER.start("search", index=index,
+                                         node=self.node.node_name)
+                _tl_token = _fr.set_current(_tl)
+                _fr.RECORDER.record(_tl, "rest.accept", index=index,
+                                    group=wg.name, lane=lane)
+            try:
+                resp = self.node.search(
+                    index, body, phase_hook=phase_hook,
+                    phase_ctx=phase_ctx,
+                    copy_protect=bool(pipeline is not None
+                                      and pipeline.response_procs),
+                    wlm_lane=lane)
+            finally:
+                if _tl_token is not None:
+                    _fr.reset_current(_tl_token)
         except dsl.QueryParseError as e:
             # malformed DSL is a client error, not an engine crash
             raise ApiError(400, "parsing_exception", str(e))
@@ -881,6 +899,9 @@ class RestClient:
             "serving": n.serving.stats(),
             "search_pipelines": n.search_pipelines.stats(),
             "tracing": n.tracer.stats(),
+            # flight recorder (obs/flight_recorder.py): ring occupancy,
+            # timelines, anomaly-trigger counts, recent dump metadata
+            "flight_recorder": n.flight_recorder.stats(),
             # device query-phase telemetry: kernel serve/fallback counters
             # incl. pruned-path escalations (the pruning design is only as
             # good as its escalation rate), and the SPMD mesh dispatch
@@ -911,6 +932,35 @@ class RestClient:
         """Recent completed request traces (reference telemetry in-memory
         span exporter shape)."""
         return {"traces": self.node.tracer.traces(limit)}
+
+    # ------------- flight recorder + hot threads (obs/) -------------
+
+    def flight_recorder(self, dumps: int = 5) -> dict:
+        """`GET /_flight_recorder`: ring stats + the most recent dump
+        bundles (full timelines, newest first)."""
+        rec = self.node.flight_recorder
+        return {"recorder": rec.stats(), "dumps": rec.dumps(limit=dumps)}
+
+    def flight_recorder_dump(self, note: Optional[str] = None) -> dict:
+        """`POST /_flight_recorder/dump`: manual snapshot — freeze every
+        timeline currently in the ring into one bundle."""
+        rec = self.node.flight_recorder
+        if not rec.enabled:
+            raise ApiError(400, "illegal_argument_exception",
+                           "flight recorder is disabled on this node "
+                           "(OPENSEARCH_TPU_FLIGHT_RECORDER=0)")
+        bundle = rec.trigger("manual", None, note=note, force=True)
+        return {"acknowledged": True, "dump": bundle}
+
+    def hot_threads(self, snapshots: int = 3, interval_ms: float = 20.0,
+                    ignore_idle: bool = True, as_json: bool = False):
+        """`GET /_nodes/hot_threads`: live Python stacks of the runtime's
+        worker threads (serving dispatcher/completion, named pools, HTTP
+        request threads), idle-filtered, sampled `snapshots` times."""
+        from ..obs.hot_threads import hot_threads as _ht
+        return _ht(node_name=self.node.node_name, snapshots=snapshots,
+                   interval_s=interval_ms / 1000.0,
+                   ignore_idle=ignore_idle, as_json=as_json)
 
     # ---------------- tasks API (reference action/admin/cluster/node/tasks) --
 

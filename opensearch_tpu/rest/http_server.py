@@ -47,7 +47,7 @@ def _truthy(v) -> bool:
 
 # routes any authenticated principal may hit (cluster "monitor" class)
 _MONITOR_HEADS = {"", "_cluster", "_nodes", "_cat", "_stats", "_tasks",
-                  "_metrics"}
+                  "_metrics", "_flight_recorder"}
 # cluster-admin routes
 _ADMIN_HEADS = {"_index_template", "_template", "_remotestore", "_snapshot",
                 "_ingest", "_scripts", "_search_pipeline", "_data_stream",
@@ -83,6 +83,10 @@ def _classify(method: str, parts) -> Tuple[str, Optional[str]]:
             return CLUSTER_ADMIN, None
         if head == "_tasks" and method == "POST":
             return CLUSTER_ADMIN, None    # cancel is a mutating op
+        if head == "_flight_recorder" and method == "POST":
+            # manual dump mutates the bounded dump store (force=True
+            # bypasses cooldowns and can evict genuine anomaly bundles)
+            return CLUSTER_ADMIN, None
         return "monitor", None
     if head in _ADMIN_HEADS:
         return CLUSTER_ADMIN, None
@@ -402,7 +406,30 @@ class _Handler(BaseHTTPRequestHandler):
             raise ApiError(400, "illegal_argument_exception",
                            f"unsupported _cluster route {parts}")
         if head == "_nodes":
+            if len(parts) > 1 and parts[1] == "hot_threads":
+                # py-side stack sampler over the runtime's worker threads
+                # (obs/hot_threads.py); plain text like the reference,
+                # ?format=json for the structured form
+                return 200, c.hot_threads(
+                    snapshots=int(params.get("snapshots", 3)),
+                    interval_ms=float(params.get("interval_ms", 20)),
+                    ignore_idle=_truthy(params.get("ignore_idle",
+                                                   "true")),
+                    as_json=params.get("format") == "json")
             return 200, c.nodes_stats()
+        if head == "_flight_recorder":
+            # black-box event journal (obs/flight_recorder.py): ring
+            # stats + recent anomaly dumps; POST …/dump freezes a manual
+            # snapshot bundle
+            if len(parts) > 1 and parts[1] == "dump":
+                if method != "POST":
+                    raise ApiError(405, "method_not_allowed",
+                                   "dump requires POST")
+                body = self._json_body() or {}
+                return 200, c.flight_recorder_dump(
+                    note=body.get("note") or params.get("note"))
+            return 200, c.flight_recorder(
+                dumps=int(params.get("dumps", 5)))
         if head == "_metrics":
             # Prometheus text exposition of the unified metrics registry
             # (utils/metrics.py): counters, gauges, and latency-histogram

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..index.engine import Engine
 from ..index.segment import Segment, next_pow2
+from ..obs import flight_recorder as _flight
 from ..script.painless_lite import ScriptError as _ScriptError
 from . import compiler as C
 from . import fastpath
@@ -954,7 +955,13 @@ def launch_msearch_batched(searchers: List[ShardSearcher],
                                stats, index_name, t0, [])
                 if ok[bi] else None for bi in range(nb)]
 
-    return LaunchHandle(_finish, kind="fastpath")
+    info = None
+    if _flight.RECORDER.enabled:
+        # launch forensics for the scheduler's per-request journal
+        # (mirrors MeshSearchService.launch_msearch's handle.info)
+        info = {"path": "kernel", "bodies": int(sum(ok)),
+                "kernel_launches": len(launches)}
+    return LaunchHandle(_finish, kind="fastpath", info=info)
 
 
 def _finish_search(searchers: List[ShardSearcher],

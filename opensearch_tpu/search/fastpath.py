@@ -62,6 +62,11 @@ _enabled = True      # flipped by tests / OPENSEARCH_TPU_NO_FASTPATH
 # searches no longer lose counts to the `d[k] += 1` read-modify-write race
 from ..utils.metrics import METRICS, CounterGroup
 from ..utils.trace import TRACER
+# flight-recorder (obs/): escalation-ladder rung events on the ambient
+# request timeline. Emission discipline (oslint OSL505): every record()
+# below is guarded by RECORDER.enabled so the disabled path never builds
+# an event payload
+from ..obs import flight_recorder as _fr
 
 STATS = CounterGroup(METRICS, "fastpath", {
     "pure_served": 0, "bool_served": 0, "fallback": 0,
@@ -1466,6 +1471,10 @@ def _finish_pure(seg: Segment, ctx, lts: Sequence,
         # batched into as few device launches as their shape buckets allow
         # (host numpy under JAX_PLATFORMS=cpu — see _rescore_many)
         n_redo = len(redo)
+        if _fr.RECORDER.enabled and _fr.current():
+            _fr.RECORDER.record(_fr.current(), "fastpath.rung",
+                                rung="phase2_rescore", queries=n_redo,
+                                mode=rescore_mode())
         with TRACER.span("fastpath.phase2_rescore", queries=n_redo,
                          mode=rescore_mode()), \
                 METRICS.timer("fastpath.phase2_rescore"):
@@ -1477,6 +1486,9 @@ def _finish_pure(seg: Segment, ctx, lts: Sequence,
         # it; a certify saves the 8x-bigger dense launch, a miss adds a
         # small fraction of the dense cost it was about to pay anyway
         n_redo = len(redo)
+        if _fr.RECORDER.enabled and _fr.current():
+            _fr.RECORDER.record(_fr.current(), "fastpath.rung",
+                                rung="quality_tier", queries=n_redo)
         with TRACER.span("fastpath.quality_tier", queries=n_redo), \
                 METRICS.timer("fastpath.quality_tier"):
             redo = _dview_rescue(seg, ctx, lts, specs, vq_lists, results,
@@ -1484,6 +1496,9 @@ def _finish_pure(seg: Segment, ctx, lts: Sequence,
         rescued += n_redo - len(redo)
     if redo:
         STATS.inc("pruned_escalated", len(redo))
+        if _fr.RECORDER.enabled and _fr.current():
+            _fr.RECORDER.record(_fr.current(), "fastpath.rung",
+                                rung="dense_escalation", queries=len(redo))
         with TRACER.span("fastpath.dense", queries=len(redo)), \
                 METRICS.timer("fastpath.dense"):
             dense_lists = _prepare_vqueries(seg, ctx,
@@ -2228,9 +2243,15 @@ def _finish_filtered_pure_batch(ctx, K: int, launched: list) -> dict:
 
 def count_served(specs: Sequence[FastSpec], outs: Sequence[Optional[dict]]
                  ) -> None:
+    served = fell = 0
     for spec, r in zip(specs, outs):
         if r is None:
             STATS.inc("fallback")
+            fell += 1
         else:
             STATS.inc("pure_served" if spec.kind == "pure"
                       else "bool_served")
+            served += 1
+    if _fr.RECORDER.enabled and _fr.current():
+        _fr.RECORDER.record(_fr.current(), "fastpath.served",
+                            served=served, fallback=fell)

@@ -47,9 +47,10 @@ class LaunchHandle:
     unfetched handle releases them."""
 
     __slots__ = ("kind", "launched_at", "fetched_at", "_finish", "_result",
-                 "_error", "_done")
+                 "_error", "_done", "info")
 
-    def __init__(self, finish: Callable[[], object], kind: str = "device"):
+    def __init__(self, finish: Callable[[], object], kind: str = "device",
+                 info: Optional[dict] = None):
         self.kind = kind
         self.launched_at = time.monotonic()
         self.fetched_at: Optional[float] = None
@@ -57,6 +58,12 @@ class LaunchHandle:
         self._result = None
         self._error: Optional[BaseException] = None
         self._done = False
+        # launch-stage forensics the creator chooses to expose (dispatch
+        # lock wait, new program compiles, group count) — the serving
+        # scheduler copies this into per-request flight-recorder launch
+        # events; None when the recorder is disabled (obs/ lazy-payload
+        # discipline)
+        self.info = info
 
     @property
     def done(self) -> bool:

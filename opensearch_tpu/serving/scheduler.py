@@ -78,6 +78,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from ..obs import flight_recorder as _fr
 from ..utils.metrics import METRICS, MetricsRegistry
 from ..utils.tasks import TaskCancelledException
 from ..utils.wlm import PressureRejectedException
@@ -146,7 +147,7 @@ class SchedulerConfig:
 
 class _Pending:
     __slots__ = ("name", "svc", "body", "lane", "task", "enq", "done",
-                 "resp", "error", "state")
+                 "resp", "error", "state", "tl")
 
     def __init__(self, name: str, svc, body: dict, lane: str, task):
         self.name = name
@@ -159,6 +160,18 @@ class _Pending:
         self.resp = None            # response dict, or None (-> host loop)
         self.error: Optional[BaseException] = None
         self.state = _QUEUED
+        # flight-recorder timeline of the submitting request: the
+        # dispatcher/completion threads have no ambient timeline, so the
+        # id rides the entry explicitly (0 = recorder disabled)
+        self.tl = 0
+
+    def _stage(self, stage) -> None:
+        """Mark the live serving stage on the request's task (surfaced by
+        `_tasks`; None = left the scheduler); no-op for task-less
+        entries."""
+        t = self.task
+        if t is not None and hasattr(t, "set_stage"):
+            t.set_stage(stage)
 
 
 class _StageMeter:
@@ -318,10 +331,14 @@ class ServingScheduler:
         if lane not in self._lanes:
             lane = "interactive"
         entry = _Pending(name, svc, body, lane, task)
+        if _fr.RECORDER.enabled:
+            entry.tl = _fr.current()
         # ONE critical section for closed-check, admission, dispatcher
         # liveness and enqueue: the dispatcher's idle-exit decision runs
         # under the same condition, so an entry can never land in the
         # queue with no dispatcher alive and none restarted
+        rejected_depth = None
+        closed = False
         with self._cond:
             if self._closed:
                 self.direct_fallbacks += 1
@@ -331,12 +348,8 @@ class ServingScheduler:
                 self.rejected += 1
                 METRICS.counter("serving.rejected").inc()
                 self.node.search_backpressure.note_queue_rejection()
-                raise PressureRejectedException(
-                    f"serving scheduler queue full "
-                    f"({self._pending}/{self.config.queue_cap} pending); "
-                    f"rejecting search")
+                rejected_depth = self._pending
             else:
-                closed = False
                 if not self._dispatcher_alive():
                     self._start_dispatcher()
                 self.submitted += 1
@@ -345,8 +358,29 @@ class ServingScheduler:
                 self._lanes[lane].append(entry)
                 self._pending += 1
                 METRICS.gauge("serving.queue_depth").set(self._pending)
+                entry._stage("queued")
+                if _fr.RECORDER.enabled and entry.tl:
+                    _fr.RECORDER.record(entry.tl, "sched.enqueue",
+                                        lane=lane, depth=self._pending)
                 self._cond.notify_all()
+        if rejected_depth is not None:
+            # event + burst detection OUTSIDE the scheduler lock: a burst
+            # trigger freezes a dump bundle, and that scan must not stall
+            # every other submit/flush/cancel on _cond
+            if _fr.RECORDER.enabled:
+                if entry.tl:
+                    _fr.RECORDER.record(entry.tl, "sched.reject",
+                                        pending=rejected_depth,
+                                        cap=self.config.queue_cap)
+                _fr.RECORDER.note_rejection(entry.tl)
+            raise PressureRejectedException(
+                f"serving scheduler queue full "
+                f"({rejected_depth}/{self.config.queue_cap} pending); "
+                f"rejecting search")
         if closed:
+            if _fr.RECORDER.enabled and entry.tl:
+                _fr.RECORDER.record(entry.tl, "sched.degrade",
+                                    why="closed")
             return self._direct(name, svc, body)
         if task is not None and hasattr(task, "on_cancel"):
             # wake + drop the entry the moment its task is cancelled (the
@@ -372,6 +406,20 @@ class ServingScheduler:
                     self.direct_fallbacks += 1
                     METRICS.counter("serving.direct_fallbacks").inc()
             if entry.state == _ABANDONED:
+                # the request missed its deadline while STILL QUEUED — the
+                # dispatcher is wedged or starved. Freeze the timeline
+                # before degrading: this is exactly the after-the-fact
+                # forensic moment the flight recorder exists for
+                if _fr.RECORDER.enabled and entry.tl:
+                    _fr.RECORDER.record(
+                        entry.tl, "sched.degrade", why="deadline_miss",
+                        waited_ms=round(
+                            (time.monotonic() - entry.enq) * 1000.0, 3))
+                    _fr.RECORDER.trigger(
+                        "deadline_miss", [entry.tl],
+                        note=f"entry still queued after "
+                             f"{self.config.request_timeout_s}s")
+                entry._stage(None)
                 return self._direct(entry.name, entry.svc, entry.body)
             # claimed: the batch is in flight on the device. Duplicating
             # it immediately would be wasteful, so give the completion
@@ -389,6 +437,22 @@ class ServingScheduler:
                         METRICS.counter(
                             "serving.completion_abandoned").inc()
                 if entry.state == _ABANDONED:
+                    # launched but never fetched: the completion stage is
+                    # wedged. Dump the timeline (it already holds the
+                    # flush's batch peers and the launch boundary) before
+                    # running direct on this thread
+                    if _fr.RECORDER.enabled and entry.tl:
+                        _fr.RECORDER.record(
+                            entry.tl, "sched.degrade",
+                            why="completion_wedge",
+                            waited_ms=round(
+                                (time.monotonic() - entry.enq) * 1000.0,
+                                3))
+                        _fr.RECORDER.trigger(
+                            "completion_wedge", [entry.tl],
+                            note=f"claimed entry unresolved after "
+                                 f"2x{self.config.request_timeout_s}s")
+                    entry._stage(None)
                     return self._direct(entry.name, entry.svc, entry.body)
                 entry.done.wait()     # resolved racing with our timeout
         if entry.error is not None:
@@ -409,6 +473,10 @@ class ServingScheduler:
                     f"{getattr(entry.task, 'cancel_reason', None)}")
                 self.cancelled_inflight += 1
                 METRICS.counter("serving.cancelled_inflight").inc()
+                if _fr.RECORDER.enabled and entry.tl:
+                    _fr.RECORDER.record(entry.tl, "sched.cancel",
+                                        where="inflight")
+                entry._stage(None)
                 entry.done.set()
                 return
             if entry.state != _QUEUED:
@@ -430,6 +498,9 @@ class ServingScheduler:
             f"{getattr(entry.task, 'cancel_reason', None)}")
         self.cancelled_dropped += 1
         METRICS.counter("serving.cancelled_dropped").inc()
+        if _fr.RECORDER.enabled and entry.tl:
+            _fr.RECORDER.record(entry.tl, "sched.cancel", where="queued")
+        entry._stage(None)
         entry.done.set()
 
     # ---------------- dispatcher side ----------------
@@ -549,24 +620,84 @@ class ServingScheduler:
                 wait_ms = (now - e.enq) * 1000.0
                 METRICS.histogram("serving.queue_wait").record(wait_ms)
                 self._local.histogram("serving.queue_wait").record(wait_ms)
+            if _fr.RECORDER.enabled:
+                # batch peers: every timeline in this flush carries the
+                # full co-batched set, so a dump of ONE wedged request
+                # names the requests that shared its launch
+                peers = [e.tl for e in batch if e.tl]
+                for e in batch:
+                    if e.tl:
+                        _fr.RECORDER.record(
+                            e.tl, "sched.flush", reason=reason,
+                            size=len(batch), lane=e.lane,
+                            queue_wait_ms=round(
+                                (now - e.enq) * 1000.0, 3),
+                            peers=[p for p in peers if p != e.tl])
         return batch
 
     def _dispatch(self, batch: List[_Pending]) -> None:
         """Depth-1 synchronous dispatch: run the flushed batch grouped by
         index and hand every entry its result on this thread. Never
         raises: a failed group degrades its entries to the host loop
-        (resp None)."""
+        (resp None). Stage marks (launched/fetching/rendering) and the
+        per-entry launch/fetch boundary events mirror the pipelined
+        path's, so `_tasks` and timelines read identically at any depth."""
         for (name, svc, entries, bodies) in self._group(batch):
             try:
-                resps = self._run_batch(name, svc, bodies)
+                handles = self._launch_group(name, svc, bodies)
+                err = False
             except Exception:                       # noqa: BLE001
+                handles = None
+                err = True
+            for e in entries:
+                if e.state == _CLAIMED:   # not cancelled/abandoned since
+                    e._stage("launched")
+            self._record_launch(entries, handles, err)
+            if err:
                 with self._cond:
                     self.batch_errors += 1
                 METRICS.counter("serving.batch_errors").inc()
                 resps = [None] * len(entries)
+            else:
+                for e in entries:
+                    if e.state == _CLAIMED:
+                        e._stage("fetching")
+                try:
+                    resps = self._finish_group(name, svc, bodies, handles)
+                except Exception:                   # noqa: BLE001
+                    with self._cond:
+                        self.batch_errors += 1
+                    METRICS.counter("serving.batch_errors").inc()
+                    resps = [None] * len(entries)
+            for e in entries:
+                if e.state == _CLAIMED:
+                    e._stage("rendering")
             if self.config.oracle:
                 self._oracle_check(name, svc, entries, resps)
             self._resolve_entries(entries, resps)
+
+    def _record_launch(self, entries: List[_Pending], handles,
+                       err: bool) -> None:
+        """Per-entry launch-boundary events. The dispatcher thread has no
+        ambient timeline, so the ids ride the entries; `handle.info`
+        carries the mesh's launch forensics (dispatch-lock wait, new
+        program compiles)."""
+        if not _fr.RECORDER.enabled:
+            return
+        fields: dict = {"path": "none"}
+        if handles is not None:
+            mesh_handle, kernel_handle = handles
+            h = mesh_handle if mesh_handle is not None else kernel_handle
+            if h is not None:
+                fields["path"] = ("mesh" if mesh_handle is not None
+                                  else "kernel")
+                if getattr(h, "info", None):
+                    fields.update(h.info)
+        if err:
+            fields["error"] = True
+        for e in entries:
+            if e.tl:
+                _fr.RECORDER.record(e.tl, "sched.launch", **fields)
 
     @staticmethod
     def _group(batch: List[_Pending]) -> list:
@@ -610,15 +741,13 @@ class ServingScheduler:
                     self.declined += 1
                     declined += 1
             e.resp = r
+            if _fr.RECORDER.enabled and e.tl:
+                _fr.RECORDER.record(e.tl, "sched.resolve",
+                                    served=r is not None)
+            e._stage(None)
             e.done.set()
         METRICS.counter("serving.batched_served").inc(served)
         METRICS.counter("serving.declined").inc(declined)
-
-    def _run_batch(self, name: str, svc, bodies: List[dict]) -> list:
-        """One batched program invocation over the pending bodies,
-        synchronous: launch stage + fetch stage back-to-back."""
-        return self._finish_group(name, svc, bodies,
-                                  self._launch_group(name, svc, bodies))
 
     # ---------------- pipelined dispatch ----------------
 
@@ -698,6 +827,10 @@ class ServingScheduler:
             except Exception:                       # noqa: BLE001
                 handles = None
                 err = True
+            for e in entries:
+                if e.state == _CLAIMED:   # not cancelled/abandoned since
+                    e._stage("launched")
+            self._record_launch(entries, handles, err)
             groups.append((name, svc, entries, bodies, handles, err))
         return _InFlight(groups)
 
@@ -771,6 +904,10 @@ class ServingScheduler:
                     self.batch_errors += 1
                 METRICS.counter("serving.batch_errors").inc()
             else:
+                for e in entries:
+                    if e.state == _CLAIMED:
+                        e._stage("fetching")
+                t_fetch = time.monotonic()
                 try:
                     resps = self._finish_group(name, svc, bodies, handles)
                 except Exception:                   # noqa: BLE001
@@ -778,6 +915,16 @@ class ServingScheduler:
                         self.batch_errors += 1
                     METRICS.counter("serving.batch_errors").inc()
                     resps = [None] * len(entries)
+                if _fr.RECORDER.enabled:
+                    fetch_ms = round(
+                        (time.monotonic() - t_fetch) * 1000.0, 3)
+                    for e in entries:
+                        if e.tl:
+                            _fr.RECORDER.record(e.tl, "sched.fetch",
+                                                fetch_ms=fetch_ms)
+            for e in entries:
+                if e.state == _CLAIMED:
+                    e._stage("rendering")
             if self.config.oracle:
                 # pipelined batches re-run against the direct path too:
                 # pipeline on/off must be byte-identical
@@ -798,7 +945,8 @@ class ServingScheduler:
         """The direct-execution equivalent of a SERVED batched body:
         the mesh when it serves the shape, else a batch-of-one kernel
         launch (probing the grouped kernel path's batch-size
-        invariance) — mirroring the two stages _run_batch composes."""
+        invariance) — mirroring the launch+fetch stages _dispatch
+        composes."""
         if self.node.mesh_service is not None:
             direct = self.node.mesh_service.try_search(name, svc, body)
             if direct is not None:
@@ -842,6 +990,15 @@ class ServingScheduler:
             METRICS.counter("serving.oracle_checks").inc()
             if not match:
                 METRICS.counter("serving.oracle_mismatches").inc()
+                # a coalesced result diverging from direct execution is
+                # the worst anomaly this subsystem can produce — freeze
+                # the request's full journal for the postmortem
+                if _fr.RECORDER.enabled and e.tl:
+                    _fr.RECORDER.record(e.tl, "sched.oracle_mismatch",
+                                        index=name)
+                    _fr.RECORDER.trigger("oracle_mismatch", [e.tl],
+                                         note=f"index [{name}]: "
+                                              f"batched != direct")
 
     # ---------------- lifecycle + stats ----------------
 

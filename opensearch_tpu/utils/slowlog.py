@@ -59,7 +59,7 @@ class SlowLog:
         self.source_limit = source_limit
 
     def maybe_log(self, took_s: float, source: Any,
-                  extra=None) -> Optional[str]:
+                  extra=None, timeline_id: int = 0) -> Optional[str]:
         """Log at the most severe threshold `took_s` crosses; returns the
         level (for tests/stats) or None.
 
@@ -68,7 +68,12 @@ class SlowLog:
         root trace span, the rescore path. A dict merges directly; a
         callable is invoked only when a threshold actually fires, so the
         (possibly deep) span serialization costs nothing on fast
-        requests."""
+        requests.
+
+        `timeline_id` links the entry to the request's flight-recorder
+        timeline (obs/flight_recorder.py) and makes the threshold a dump
+        trigger: a slow query's full event journal is frozen the moment
+        the slowlog fires, before the ring can overwrite it."""
         hit = None
         for level in LEVELS:           # warn is most severe; first hit wins
             thr = self.thresholds.get(level)
@@ -85,10 +90,21 @@ class SlowLog:
             extra = extra()
         if isinstance(extra, dict):
             entry.update(extra)
+        if timeline_id:
+            entry["flight_recorder_timeline"] = timeline_id
         self.entries.append(entry)
         self.logger.log(_LOG_LEVEL[hit],
                         "[%s] took[%dms], source[%s]",
                         self.index, entry["took_millis"], msg)
+        if timeline_id:
+            # slow-threshold crossing = anomaly trigger: freeze this
+            # request's timeline (lazy import: utils must stay importable
+            # without obs, and the cost lands only on slow requests)
+            from ..obs.flight_recorder import RECORDER
+            RECORDER.trigger(
+                "slowlog", [timeline_id],
+                note=f"[{self.index}] {hit} threshold: "
+                     f"{entry['took_millis']}ms")
         return hit
 
     def stats(self) -> dict:

@@ -38,10 +38,36 @@ class Task:
         # for the next flush assembly to notice)
         self._cancel_listeners: list = []
         self._listener_lock = threading.Lock()
+        # live serving introspection (serving/scheduler.py stage marks):
+        # queued -> launched -> fetching -> rendering. None = the task
+        # never entered the scheduler (direct path) — `info()` then omits
+        # the serving block entirely, keeping the legacy shape.
+        self.stage: Optional[str] = None
+        self._stage_mono: Optional[float] = None
+        self._queue_enq_mono: Optional[float] = None
+        self.queue_wait_ms: Optional[float] = None
+        # flight-recorder timeline carrying this task's event journal
+        # (obs/flight_recorder.py); 0 = recorder disabled
+        self.timeline_id = 0
 
     def track(self, device_seconds: float = 0.0, mem_bytes: int = 0) -> None:
         self.device_seconds += device_seconds
         self.mem_bytes += mem_bytes
+
+    def set_stage(self, stage: Optional[str]) -> None:
+        """Mark the task's live serving stage (scheduler transitions).
+        The first transition OUT of "queued" freezes queue_wait_ms; while
+        still queued, `info()` reports the wait so far. Benign-racy by
+        design: single writes of plain attributes read by the stats
+        thread."""
+        now = time.monotonic()
+        if stage == "queued":
+            self._queue_enq_mono = now
+        elif self.stage == "queued" and self._queue_enq_mono is not None \
+                and self.queue_wait_ms is None:
+            self.queue_wait_ms = (now - self._queue_enq_mono) * 1000.0
+        self.stage = stage
+        self._stage_mono = now
 
     def on_cancel(self, callback) -> None:
         """Register `callback(task)` to run when this task is cancelled;
@@ -78,16 +104,34 @@ class Task:
                 f"task [{self.id}] was cancelled: {self.cancel_reason}")
 
     def info(self) -> dict:
-        return {"id": self.id, "action": self.action,
-                "description": self.description,
-                "cancellable": self.cancellable,
-                "cancelled": self.cancelled,
-                "start_time_in_millis": int(self.start_time * 1000),
-                "running_time_in_nanos":
-                    int((time.monotonic() - self._start_mono) * 1e9),
-                "resource_stats": {"device_time_seconds":
-                                   round(self.device_seconds, 6),
-                                   "memory_in_bytes": self.mem_bytes}}
+        out = {"id": self.id, "action": self.action,
+               "description": self.description,
+               "cancellable": self.cancellable,
+               "cancelled": self.cancelled,
+               "start_time_in_millis": int(self.start_time * 1000),
+               "running_time_in_nanos":
+                   int((time.monotonic() - self._start_mono) * 1e9),
+               "resource_stats": {"device_time_seconds":
+                                  round(self.device_seconds, 6),
+                                  "memory_in_bytes": self.mem_bytes}}
+        if self.timeline_id:
+            out["flight_recorder_timeline"] = self.timeline_id
+        stage = self.stage
+        if stage is not None:
+            now = time.monotonic()
+            mark = self._stage_mono
+            serving = {"stage": stage,
+                       "stage_elapsed_ms":
+                           round((now - mark) * 1000.0, 3)
+                           if mark is not None else None}
+            qw = self.queue_wait_ms
+            if qw is None and stage == "queued" \
+                    and self._queue_enq_mono is not None:
+                qw = (now - self._queue_enq_mono) * 1000.0
+            if qw is not None:
+                serving["queue_wait_so_far_ms"] = round(qw, 3)
+            out["serving"] = serving
+        return out
 
 
 class TaskRegistry:

@@ -17,6 +17,12 @@ the scheduler cuts program invocations >= 4x with a mean batch >= 4, and
 the pipelined path (max depth) beats depth-1 on throughput OR stage
 overlap.
 
+A final flight-recorder pair re-runs the (32-thread, deepest-depth) cell
+with the recorder pinned ON vs OFF (obs/flight_recorder.py; on is the
+process default) — responses must stay byte-identical in both, and the
+recorder-overhead gate requires recorder-on qps >= 0.98x recorder-off
+(`extra.concurrency.recorder_overhead_32t` in the BENCH json).
+
 Results land in BENCH_out.json under `extra.concurrency` (merged into an
 existing bench emission when present). Run:
     python scripts/measure_concurrency.py [ndocs]
@@ -109,15 +115,24 @@ def strip_took(resp: dict) -> str:
                       sort_keys=True)
 
 
-def run_cell(client, bodies, nthreads: int, mode, tag: str):
+def run_cell(client, bodies, nthreads: int, mode, tag: str,
+             recorder=None):
     """Closed loop: `nthreads` client threads drain the shared query list;
     every thread records its request wall into a DDSketch histogram.
     `mode` is None for scheduler-off, or a pipeline depth (int) for a
-    fresh scheduler-on cell at that depth."""
+    fresh scheduler-on cell at that depth. `recorder` pins the flight
+    recorder for the cell (True/False; None = leave the process default,
+    which is ON) — the recorder-overhead gate compares a pinned-on vs
+    pinned-off pair at 32 threads."""
+    from opensearch_tpu.obs.flight_recorder import RECORDER
     from opensearch_tpu.serving import SchedulerConfig, ServingScheduler
     from opensearch_tpu.utils.metrics import METRICS, MetricsRegistry
 
     node = client.node
+    rec_before = RECORDER.enabled
+    if recorder is not None:
+        RECORDER.enabled = bool(recorder)
+    RECORDER.reset()       # bound ring memory + per-cell trigger state
     old_serving = node.serving
     sched_on = mode is not None
     if sched_on:
@@ -174,6 +189,7 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str):
     cell = {
         "threads": nthreads,
         "scheduler": "on" if sched_on else "off",
+        "recorder": "on" if RECORDER.enabled else "off",
         "mode": "off" if not sched_on else f"d{int(mode)}",
         "n": len(bodies),
         "errors": len(errors),
@@ -199,6 +215,8 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str):
             cell["launch_to_fetch_p95_ms"] = ltf.get("p95_ms")
         node.serving.close()
     node.serving = old_serving
+    if recorder is not None:
+        RECORDER.enabled = rec_before
     if errors:
         cell["first_errors"] = errors[:3]
     return cell, results
@@ -241,12 +259,40 @@ def main():
             by_key[(nthreads, mname)] = cell
             print(json.dumps(cell), flush=True)
 
+    # recorder-overhead pair: the same (32-thread, deepest-pipeline)
+    # cell back-to-back with the flight recorder pinned ON vs OFF — the
+    # black box must ride along for ~free (gate: on-qps >= 0.98x off)
+    rec_pair = {}
+    rthreads = 32 if 32 in thread_counts else thread_counts[-1]
+    rdepth = max(depths)
+    for rlabel, rflag in (("rec_on", True), ("rec_off", False)):
+        tag = f"{rthreads}-d{rdepth}-{rlabel}"
+        cell, results = run_cell(client, bodies, rthreads, rdepth, tag,
+                                 recorder=rflag)
+        errored += cell["errors"]
+        digests = [strip_took(r) if r is not None else None
+                   for r in results]
+        bad = sum(1 for a, b in zip(digests, canonical) if a != b)
+        cell["identical_responses"] = bad == 0
+        mismatched += bad
+        cells.append(cell)
+        rec_pair[rlabel] = cell
+        print(json.dumps(cell), flush=True)
+
     summary = {"ndocs": ndocs, "nq": nq,
                "devices": len(jax.devices()),
                "mix": "60% match2 / 40% filtered bool",
                "identical_responses": mismatched == 0,
                "pipeline_depths": depths,
                "cells": cells}
+    if rec_pair:
+        on_c, off_c = rec_pair["rec_on"], rec_pair["rec_off"]
+        summary["recorder_overhead_32t"] = {
+            "threads": rthreads, "mode": f"d{rdepth}",
+            "recorder_on_qps": on_c["qps"],
+            "recorder_off_qps": off_c["qps"],
+            "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+        }
     off32 = by_key.get((32, "off"))
     on32 = by_key.get((32, f"d{depths[0]}"))
     deep = f"d{max(depths)}" if len(depths) > 1 else None
@@ -309,6 +355,12 @@ def main():
                 raise SystemExit(
                     f"pipelined dispatch shows no win at 32 threads: "
                     f"qps_gain={p['qps_gain']} overlap {d1_ov} -> {dp_ov}")
+        rp = summary.get("recorder_overhead_32t")
+        if rp and rp["qps_ratio"] < 0.98:
+            raise SystemExit(
+                f"flight-recorder overhead gate failed: recorder-on qps "
+                f"is {rp['qps_ratio']}x recorder-off (< 0.98x) at "
+                f"{rp['threads']} threads")
     print("OK", flush=True)
 
 

@@ -603,6 +603,105 @@ class TestDeviceSyncDiscipline:
 
 
 # ----------------------------------------------------------------------
+# OSL505 recorder/slowlog emission discipline
+# ----------------------------------------------------------------------
+
+class TestRecorderDiscipline:
+    def test_osl505_unguarded_event_record(self):
+        # the bug class: an event payload (kwargs dict + f-string) built
+        # on every request even with the recorder disabled
+        src = """
+            from opensearch_tpu.obs.flight_recorder import RECORDER
+
+            def resolve(tl, name):
+                RECORDER.record(tl, "sched.resolve",
+                                why=f"index {name} declined")
+        """
+        found = lint(src, "opensearch_tpu/serving/scheduler.py")
+        assert [f for f in found if f.detail == "unguarded-record"]
+
+    def test_osl505_quiet_under_enabled_guard(self):
+        src = """
+            from opensearch_tpu.obs.flight_recorder import RECORDER
+
+            def resolve(tl, name):
+                if RECORDER.enabled and tl:
+                    RECORDER.record(tl, "sched.resolve", index=name)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/serving/scheduler.py")) \
+            == []
+
+    def test_osl505_quiet_under_timeline_guard(self):
+        # `if e.tl:` is a sound guard — a timeline id is only non-zero
+        # when the recorder was enabled at start()
+        src = """
+            from opensearch_tpu.obs.flight_recorder import RECORDER
+
+            def resolve(e):
+                if e.tl:
+                    RECORDER.record(e.tl, "sched.resolve", served=True)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/serving/scheduler.py")) \
+            == []
+
+    def test_osl505_walltime_event_timestamp(self):
+        src = """
+            import time
+            from opensearch_tpu.obs.flight_recorder import RECORDER
+
+            def mark(tl):
+                if RECORDER.enabled and tl:
+                    RECORDER.record(tl, "mark", at=time.time())
+        """
+        found = lint(src, "opensearch_tpu/search/executor.py")
+        assert [f for f in found if f.detail == "walltime-event"]
+
+    def test_osl505_histogram_and_wlm_record_not_flagged(self):
+        # one-positional-arg records are the metrics/wlm kind, not event
+        # emissions — the rule must not force guards onto them
+        src = """
+            import time
+
+            def charge(hist, wg, t0):
+                hist.record((time.monotonic() - t0) * 1000.0)
+                wg.record(time.monotonic() - t0)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/rest/client.py")) == []
+
+    def test_osl505_eager_slowlog_extra(self):
+        src = """
+            def log(slowlog, took, body, rungs):
+                slowlog.maybe_log(took, body,
+                                  extra={"fastpath_rungs": rungs})
+        """
+        found = lint(src, "opensearch_tpu/cluster/node.py")
+        assert [f for f in found if f.detail == "eager-slowlog-extra"]
+
+    def test_osl505_lazy_slowlog_extra_quiet(self):
+        src = """
+            def log(slowlog, took, body, make_extra):
+                slowlog.maybe_log(took, body, extra=make_extra)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/cluster/node.py")) == []
+
+    def test_osl505_out_of_scope_quiet(self):
+        # the recorder's own internals (obs/) check enabled inside
+        src = """
+            class R:
+                def emit(self, tl):
+                    self.record(tl, "x", a=1)
+        """
+        assert rules_of(lint(
+            src, "opensearch_tpu/obs/flight_recorder.py")) == []
+
+    def test_osl505_repo_clean(self):
+        # the ratchet at zero: every live emission site is guarded and
+        # monotonic
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f for f in findings if f.rule == "OSL505"] == []
+
+
+# ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 

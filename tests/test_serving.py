@@ -208,9 +208,9 @@ class TestCancellationAndAdmission:
         cm, _ = clients
         node = cm.node
         old = node.serving
-        # depth 1 pins the synchronous dispatcher so stalling _run_batch
-        # stalls the dispatcher in-batch (the pipelined window's own
-        # backpressure bound is covered by TestPipeline)
+        # depth 1 pins the synchronous dispatcher so stalling the fetch
+        # stage stalls the dispatcher in-batch (the pipelined window's
+        # own backpressure bound is covered by TestPipeline)
         sched = ServingScheduler(
             node, SchedulerConfig(max_batch=1, max_wait_us=0, queue_cap=1,
                                   pipeline_depth=1),
@@ -218,14 +218,14 @@ class TestCancellationAndAdmission:
         node.serving = sched
         gate = threading.Event()
         entered = threading.Event()
-        real_run = sched._run_batch
+        real_finish = sched._finish_group
 
-        def stalled(name, svc, bodies):
+        def stalled(name, svc, bodies, handles):
             entered.set()
             gate.wait(timeout=30)
-            return real_run(name, svc, bodies)
+            return real_finish(name, svc, bodies, handles)
 
-        sched._run_batch = stalled
+        sched._finish_group = stalled
         rej0 = node.search_backpressure.scheduler_rejection_count
         try:
             results = {}
