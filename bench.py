@@ -845,6 +845,13 @@ def main():
         if stage.startswith(("search.", "fastpath.", "mesh."))
         and ".shape." not in stage}
     extra["jit_attribution"] = jit_attribution()
+    # byte-domain baselines (ISSUE 7): peak resident bytes by tenant kind
+    # + per-query data-movement percentiles — the committed numbers the
+    # impact-quantization PR (ROADMAP item 1) must beat
+    from opensearch_tpu.obs import query_cost as _query_cost
+    from opensearch_tpu.obs.hbm_ledger import LEDGER as _LEDGER
+    extra["hbm"] = _LEDGER.peak_stamp()
+    extra["bytes_per_query"] = _query_cost.bytes_per_query_stamp()
     extra["bench_wall_s"] = round(time.time() - bench_start, 1)
     result = {
         "metric": "bm25_rest_qps_per_chip",

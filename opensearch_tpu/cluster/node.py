@@ -364,16 +364,17 @@ class Node:
         # coordinator's single reduce — full-fidelity aggs and unified DFS
         # stats across clusters (ccs_minimize_roundtrips=false model)
         self.remote_clusters: Dict[str, "Node"] = {}
-        # account fast-path aligned postings (device HBM) against the
-        # fielddata breaker (charged at build, released at segment GC);
-        # module-level = one breaker per process, matching the
-        # one-device-per-process reality
-        from ..search import fastpath
-        fastpath.set_breaker(self.breakers.breaker("fielddata"))
-        # the per-segment device column cache (Segment.device_arrays) and
-        # the compiler's nested sort-value columns charge the same budget
-        from ..index import segment as _segment_mod
-        _segment_mod.set_breaker(self.breakers.breaker("fielddata"))
+        # HBM ledger (obs/hbm_ledger.py): the single source of truth for
+        # device memory. Every residency tenant — fastpath aligned
+        # postings, segment column pytrees, partial-residency arrays,
+        # filter-specialized copies, nested sort columns — registers an
+        # attributed allocation there, and the fielddata-breaker charge
+        # is DERIVED from the registration (oslint OSL506: the ledger is
+        # the sole charge path). Process singleton, matching the
+        # one-device-per-process reality.
+        from ..obs.hbm_ledger import LEDGER
+        self.hbm_ledger = LEDGER
+        LEDGER.set_breaker(self.breakers.breaker("fielddata"))
         # serving scheduler (serving/scheduler.py): coalesces concurrent
         # eligible searches into one batched device program invocation.
         # On by default whenever the mesh is attached; OPENSEARCH_TPU_SCHED

@@ -19,6 +19,11 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   (`jax.device_get`, `block_until_ready`, device-named `np.asarray`)
   inside launch-stage code — the static guard on the pipelined
   launch/fetch split (docs/SERVING.md).
+- OSL505 recorder/slowlog emission discipline (`recorder_rules`).
+- OSL506 memory-accounting discipline (`memory_rules`): direct breaker
+  `add_estimate`/`release` outside the HBM ledger; `jax.device_put`
+  residency in index/search/parallel without a ledger registration in
+  the enclosing scope.
 
 Run via `python scripts/oslint.py [--check]`; tier-1 runs it through
 tests/test_oslint.py. Suppress inline with
@@ -32,6 +37,7 @@ from .core import (Baseline, Checker, Finding, default_checkers,
 from .dtype_rules import DtypeDisciplineChecker
 from .jit_rules import JitBoundaryChecker
 from .lock_rules import LockDisciplineChecker
+from .memory_rules import MemoryAccountingChecker
 from .sync_rules import DeviceSyncDisciplineChecker
 
 __all__ = [
@@ -39,5 +45,5 @@ __all__ = [
     "run_paths", "run_source", "write_baseline",
     "DtypeDisciplineChecker", "JitBoundaryChecker",
     "BreakerDisciplineChecker", "LockDisciplineChecker",
-    "DeviceSyncDisciplineChecker",
+    "DeviceSyncDisciplineChecker", "MemoryAccountingChecker",
 ]

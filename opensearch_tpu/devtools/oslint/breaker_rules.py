@@ -13,8 +13,9 @@ Rule OSL301 fires when ONE function:
   2. allocates docs-scale host arrays (np.zeros/ones/full/empty/
      flatnonzero/nonzero/arange, or a FilterList) while mentioning
      `ndocs` — AND
-  3. never references a breaker (any name containing "breaker", e.g. the
-     module-level `_breaker` charged via `add_estimate`/`release`).
+  3. never references the memory accounting (any name containing
+     "breaker" or — since the HBM ledger became the sole charge path,
+     OSL506 — "ledger", e.g. `LEDGER.register(nbytes, ...)`).
 
 Condition 3 is deliberately loose: the rule's job is to force the author
 to THINK about accounting, not to verify the arithmetic. Suppress with
@@ -82,10 +83,12 @@ class BreakerDisciplineChecker(Checker):
             if isinstance(node, ast.Name):
                 if node.id == "ndocs":
                     mentions_ndocs = True
-                if "breaker" in node.id.lower():
+                if "breaker" in node.id.lower() or \
+                        "ledger" in node.id.lower():
                     mentions_breaker = True
             if isinstance(node, ast.Attribute) and \
-                    "breaker" in node.attr.lower():
+                    ("breaker" in node.attr.lower()
+                     or "ledger" in node.attr.lower()):
                 mentions_breaker = True
             if isinstance(node, ast.Call):
                 d = _dotted(node.func)
@@ -102,8 +105,8 @@ class BreakerDisciplineChecker(Checker):
             findings.append(Finding(
                 "OSL301", path, store.lineno, store.col_offset, sym,
                 "ndocs-scale host allocation cached on a long-lived "
-                "object without a memory-breaker charge; charge "
-                "`_breaker.add_estimate(nbytes, ...)` with a "
-                "`weakref.finalize(obj, _breaker.release, nbytes)` "
-                "paired release",
+                "object without memory accounting; register it with "
+                "`LEDGER.register(kind, nbytes, owner=obj, ...)` "
+                "(obs/hbm_ledger.py derives the breaker charge and the "
+                "owner-GC release)",
                 detail=f"cache@{sym}"))

@@ -127,13 +127,15 @@ def default_checkers() -> List[Checker]:
     from .dtype_rules import DtypeDisciplineChecker
     from .jit_rules import JitBoundaryChecker
     from .lock_rules import LockDisciplineChecker, WaitDisciplineChecker
+    from .memory_rules import MemoryAccountingChecker
     from .recorder_rules import RecorderDisciplineChecker
     from .sync_rules import DeviceSyncDisciplineChecker
     from .telemetry_rules import TelemetryDisciplineChecker
     return [DtypeDisciplineChecker(), JitBoundaryChecker(),
             BreakerDisciplineChecker(), LockDisciplineChecker(),
             TelemetryDisciplineChecker(), WaitDisciplineChecker(),
-            DeviceSyncDisciplineChecker(), RecorderDisciplineChecker()]
+            DeviceSyncDisciplineChecker(), RecorderDisciplineChecker(),
+            MemoryAccountingChecker()]
 
 
 def run_source(src: str, path: str,

@@ -1,0 +1,111 @@
+"""OSL506 — memory-accounting discipline: the HBM ledger is the sole
+breaker-charge path, and device residency never appears untracked.
+
+The ledger (`obs/hbm_ledger.py`) derives every circuit-breaker charge
+from an attributed allocation, which is what keeps the standing invariant
+`sum(live charged ledger bytes) == breaker.used` provable and the
+per-tenant residency rollups (`_nodes/stats` "hbm", `_cat/segments`)
+complete. Two ways code can silently break that:
+
+1. **Direct breaker charges.** Any `*.add_estimate(...)` call, or a
+   `.release(...)` call on a breaker-named object, outside the ledger
+   module (`obs/hbm_ledger.py`) and the breaker definition itself
+   (`utils/breaker.py`) bypasses attribution — the bytes exist on the
+   breaker but no tenant owns them, so the invariant fails and the
+   rollups lie.
+
+2. **Unregistered device residency.** A `jax.device_put(...)` call in
+   `index/`, `search/` or `parallel/` moves host bytes into HBM; when the
+   enclosing function scope never references the ledger (any name or
+   attribute containing "ledger", e.g. `LEDGER.register(...)`), the
+   residency is invisible to the byte-domain accounting. The rule is
+   deliberately loose (condition: *mentions* the ledger, not *charges
+   correctly*) — its job is to force the author to THINK about
+   attribution, same contract as OSL301.
+
+Transfer helpers whose CALLERS register (e.g. `_DevicePut.asarray`) and
+jit-argument uploads that are transient by construction suppress with
+`# oslint: disable=OSL506 -- <why the bytes are tracked or transient>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+# files allowed to touch the breaker API directly: the ledger (the sole
+# derivation path) and the breaker definition itself
+_CHARGE_EXEMPT = ("obs/hbm_ledger.py", "utils/breaker.py")
+
+# device-residency scope: the layers that build resident device arrays
+_RESIDENCY_SCOPES = ("opensearch_tpu/index/", "opensearch_tpu/search/",
+                     "opensearch_tpu/parallel/")
+
+
+class MemoryAccountingChecker(Checker):
+    rules = ("OSL506",)
+    name = "memory-accounting"
+
+    def applies(self, path: str) -> bool:
+        return path.startswith("opensearch_tpu/") \
+            and "devtools" not in path
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        charge_ok = any(path.endswith(e) for e in _CHARGE_EXEMPT)
+
+        # ---- rule 1: direct breaker charges outside the ledger ----
+        if not charge_ok:
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                base = _dotted(node.func.value)
+                if attr == "add_estimate" or (
+                        attr == "release" and "breaker" in base.lower()):
+                    findings.append(Finding(
+                        "OSL506", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        f"direct breaker charge (`{attr}`) outside the "
+                        "HBM ledger; register an attributed allocation "
+                        "via `LEDGER.register(kind, nbytes, ...)` "
+                        "(obs/hbm_ledger.py) so the charge is derived "
+                        "and the ledger↔breaker invariant holds",
+                        detail=f"charge:{attr}"))
+
+        # ---- rule 2: device_put without a ledger reference in scope ----
+        if not any(s in path for s in _RESIDENCY_SCOPES):
+            return findings
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            mentions_ledger = False
+            puts: List[ast.Call] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Name) and \
+                        "ledger" in node.id.lower():
+                    mentions_ledger = True
+                elif isinstance(node, ast.Attribute) and \
+                        "ledger" in node.attr.lower():
+                    mentions_ledger = True
+                elif isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    if d.rsplit(".", 1)[-1] == "device_put":
+                        puts.append(node)
+            if puts and not mentions_ledger:
+                sym = qmap.get(fn, fn.name)
+                for p in puts:
+                    findings.append(Finding(
+                        "OSL506", path, p.lineno, p.col_offset, sym,
+                        "device residency (`jax.device_put`) without a "
+                        "ledger registration in the enclosing scope; "
+                        "register the bytes with "
+                        "`LEDGER.register(kind, nbytes, owner=...)` or "
+                        "justify why they are tracked elsewhere",
+                        detail=f"device_put@{sym}"))
+        return findings

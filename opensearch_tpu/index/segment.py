@@ -37,16 +37,20 @@ from .mappings import FLOAT_TYPES, GEO_TYPES, FieldType, Mappings
 INT32_SENTINEL = np.int32(2**31 - 1)  # padded doc_id -> dropped by scatter
 
 # memory accounting for the per-segment DEVICE column cache
-# (`device_arrays` HBM residency): the Node wires its fielddata breaker in
-# here (cluster/node.py), the same budget the fastpath's aligned postings
-# charge. Charged once per (segment, device) pytree build, released by a
-# weakref finalizer when the segment is GC'd (segments are immutable and
-# replaced wholesale on refresh/merge).
-_breaker = None
+# (`device_arrays` HBM residency) goes through the HBM ledger
+# (obs/hbm_ledger.py), the single source of truth for device memory: the
+# Node wires its fielddata breaker into the LEDGER and every residency
+# build registers an attributed allocation there — the breaker charge is
+# derived from the registration (oslint OSL506). Charged once per
+# (segment, device) pytree build, released by a weakref finalizer when
+# the segment is GC'd (segments are immutable and replaced wholesale on
+# refresh/merge) or eagerly by `drop_device`.
+
 
 def set_breaker(breaker) -> None:
-    global _breaker
-    _breaker = breaker
+    """Legacy wiring shim: the breaker now lives on the ledger."""
+    from ..obs.hbm_ledger import LEDGER
+    LEDGER.set_breaker(breaker)
 
 
 def _tree_nbytes(tree) -> int:
@@ -70,7 +74,9 @@ class _DevicePut:
 
     def asarray(self, x):
         import jax
-        return jax.device_put(np.asarray(x), self.device)
+        # transfer helper: every caller (device_arrays/pruned_arrays
+        # builds) registers the residency with the ledger
+        return jax.device_put(np.asarray(x), self.device)  # oslint: disable=OSL506
 
 
 def _pad_to(arr: np.ndarray, size: int, fill) -> np.ndarray:
@@ -378,8 +384,10 @@ class Segment:
             live = _pad_to(self.live.astype(np.float32), self.ndocs_pad,
                            np.float32(0))
             self._device_cache[key]["live"] = (
+                # constant-size live plane, charged by the
+                # _build_device_arrays ledger registration
                 jnp.asarray(live) if device is None
-                else jax.device_put(live, device))
+                else jax.device_put(live, device))  # oslint: disable=OSL506
             self._device_live_dirty[key] = False
         return self._device_cache[key]
 
@@ -442,29 +450,43 @@ class Segment:
             "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
             "vector": vcols, "doc_lens": dls, "nested": nst,
         }
-        if _breaker is not None:
-            import weakref
-            # charge THIS segment's new device residency: every group
-            # built above, the per-path "parent" maps, and the live
-            # plane (constant size across dirty rebuilds). The nested
-            # children's own arrays are charged by their recursive
-            # device_arrays() calls — counting them here would
-            # double-bill the breaker.
-            nbytes = sum(_tree_nbytes(self._device_cache[key][g])
-                         for g in ("postings", "numeric", "keyword",
-                                   "geo", "vector", "doc_lens"))
-            nbytes += sum(int(c["parent"].nbytes)
-                          for c in nst.values())
-            nbytes += self.ndocs_pad * 4          # live plane (f32)
-            try:
-                _breaker.add_estimate(nbytes,
-                                      f"segment-device[{self.name}]")
-            except Exception:
-                # tripped: drop the uncharged entry so a later retry
-                # re-attempts the charge instead of serving for free
-                del self._device_cache[key]
-                raise
-            weakref.finalize(self, _breaker.release, nbytes)
+        from ..obs.hbm_ledger import LEDGER
+        # register THIS segment's new device residency with the HBM
+        # ledger (which derives the breaker charge): every group built
+        # above, the per-path "parent" maps, and the live plane
+        # (constant size across dirty rebuilds). The nested children's
+        # own arrays are registered by their recursive device_arrays()
+        # calls — counting them here would double-bill.
+        nbytes = sum(_tree_nbytes(self._device_cache[key][g])
+                     for g in ("postings", "numeric", "keyword",
+                               "geo", "vector", "doc_lens"))
+        nbytes += sum(int(c["parent"].nbytes)
+                      for c in nst.values())
+        nbytes += self.ndocs_pad * 4          # live plane (f32)
+        try:
+            alloc = LEDGER.register(
+                "segment_columns", nbytes, owner=self, segment=self,
+                device=key, label=f"segment-device[{self.name}]")
+        except Exception:
+            # tripped: drop the uncharged entry so a later retry
+            # re-attempts the charge instead of serving for free
+            del self._device_cache[key]
+            raise
+        self.__dict__.setdefault("_hbm_allocs", {})[key] = alloc
+        # full-residency promotion: the partial per-field arrays this
+        # device key accumulated via pruned_arrays() are now redundant —
+        # the full pytree supersedes them (pruned_arrays serves from it
+        # on every later call). Drop them and release their ledger
+        # charges, or the overlapping term arrays stay double-counted
+        # for the segment's lifetime.
+        fcache = self.__dict__.get("_field_device_cache")
+        if fcache:
+            for ck in [c for c in fcache if c[0] == key]:
+                del fcache[ck]
+        fallocs = self.__dict__.get("_field_device_allocs")
+        if fallocs:
+            for ck in [c for c in fallocs if c[0] == key]:
+                LEDGER.release(fallocs.pop(ck))
         self._device_live_dirty[key] = True
 
     def pruned_arrays(self, device, needs: Dict[str, set]) -> dict:
@@ -472,24 +494,48 @@ class Segment:
         uses this so building a status-term mask never ships the body
         postings to HBM (device_arrays is all-or-nothing; jit argument
         pruning happens after the transfer already paid). Per-field device
-        arrays are cached; a later full device_arrays() reuses nothing
-        (separate cache) but is also not forced by a mask build anymore.
+        arrays are cached and ledger-registered as `partial_columns`; a
+        later full device_arrays() build PROMOTES this partial residency —
+        the per-field arrays are dropped and their charges released, so
+        overlapping term arrays are never double-counted.
         `needs` keys: postings / numeric / keyword / geo -> field sets."""
-        import jax
-        import jax.numpy as _jnp
-
         key = device
         if key in self._device_cache:
             # the full pytree already exists: serve from it (no extra HBM)
             return self.device_arrays(device)
+        # the SAME per-segment build lock device_arrays takes: two racing
+        # partial builds of one field must not both register (the loser's
+        # charge would leak until segment GC), and the full build's
+        # promotion sweep iterates these dicts under this lock
+        lock = self.__dict__.setdefault(
+            "_device_build_lock", __import__("threading").RLock())
+        with lock:
+            return self._pruned_arrays_locked(key, device, needs)
+
+    def _pruned_arrays_locked(self, key, device, needs: Dict[str, set]
+                              ) -> dict:
+        import jax
+        import jax.numpy as _jnp
+
+        from ..obs.hbm_ledger import LEDGER
+
+        if key in self._device_cache:
+            # a racing full build won: serve the promoted pytree
+            return self.device_arrays(device)
         jnp = _DevicePut(device) if device is not None else _jnp
         cache = self.__dict__.setdefault("_field_device_cache", {})
+        allocs = self.__dict__.setdefault("_field_device_allocs", {})
         dpad = self.ndocs_pad
 
         def field(group: str, f: str, builder):
             k = (key, group, f)
             if k not in cache:
-                cache[k] = builder()
+                arrs = builder()
+                allocs[k] = LEDGER.register(
+                    "partial_columns", _tree_nbytes(arrs), owner=self,
+                    segment=self, device=key,
+                    label=f"segment-partial[{self.name}][{group}.{f}]")
+                cache[k] = arrs
             return cache[k]
 
         out: Dict[str, Any] = {"postings": {}, "numeric": {}, "keyword": {},
@@ -528,17 +574,30 @@ class Segment:
         if lk not in cache:
             for stale in [c for c in cache if c[1] == "#live"]:
                 del cache[stale]
+                LEDGER.release(allocs.pop(stale, None))
             live = _pad_to(self.live.astype(np.float32), self.ndocs_pad,
                            np.float32(0))
-            cache[lk] = (jax.device_put(live, device) if device is not None
-                         else _jnp.asarray(live))
+            arr = (jax.device_put(live, device) if device is not None
+                   else _jnp.asarray(live))
+            allocs[lk] = LEDGER.register(
+                "partial_columns", int(arr.nbytes), owner=self,
+                segment=self, device=key,
+                label=f"segment-partial[{self.name}][live]")
+            cache[lk] = arr
         out["live"] = cache[lk]
         return out
 
     def drop_device(self) -> None:
+        from ..obs.hbm_ledger import LEDGER
         self._device_cache = {}
         self._device_live_dirty = {}
         self.__dict__.pop("_field_device_cache", None)
+        # eager release: the arrays are gone NOW, so the ledger (and the
+        # derived breaker charge) must not wait for the segment's GC
+        for alloc in self.__dict__.pop("_hbm_allocs", {}).values():
+            LEDGER.release(alloc)
+        for alloc in self.__dict__.pop("_field_device_allocs", {}).values():
+            LEDGER.release(alloc)
         for blk in self.nested.values():
             blk.child.drop_device()
 

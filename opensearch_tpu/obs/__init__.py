@@ -1,11 +1,15 @@
 """Observability forensics: the flight recorder (per-request black-box
-event journal with anomaly-triggered dumps) and the hot-threads stack
-sampler. docs/OBSERVABILITY.md documents the event schema, the dump
-triggers, and the retention/overhead knobs."""
+event journal with anomaly-triggered dumps), the hot-threads stack
+sampler, the HBM ledger (attributed device-memory accounting, the sole
+breaker-charge path — oslint OSL506), and per-query device cost
+accounting (predicted vs. actual bytes gathered). docs/OBSERVABILITY.md
+documents the event schema, dump triggers, tenant taxonomy, and the
+cost-model formulas."""
 
 from .flight_recorder import (FlightRecorder, RECORDER, current,
                               reset_current, set_current)
+from .hbm_ledger import LEDGER, HBMLedger
 from .hot_threads import hot_threads
 
 __all__ = ["FlightRecorder", "RECORDER", "current", "set_current",
-           "reset_current", "hot_threads"]
+           "reset_current", "hot_threads", "LEDGER", "HBMLedger"]

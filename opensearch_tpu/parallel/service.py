@@ -72,14 +72,20 @@ class _ByteLRU:
     """Byte-budgeted LRU over an OrderedDict: one eviction policy for every
     device/host cache the service keeps (stacked agg columns, global
     ordinals, filter masks). Keeps a running byte total so eviction is O(1)
-    per evicted entry."""
+    per evicted entry.
 
-    def __init__(self, max_bytes: int):
+    `kind`: when set, every nonzero-byte entry is registered with the HBM
+    ledger (obs/hbm_ledger.py) under that tenant kind — eviction and
+    replacement release the allocation, so `_nodes/stats` "hbm" and the
+    breaker-derived charges track the mesh's device caches exactly."""
+
+    def __init__(self, max_bytes: int, kind: Optional[str] = None):
         import collections
         import threading
         self._od: "collections.OrderedDict" = collections.OrderedDict()
         self._bytes = 0
         self._max = max_bytes
+        self._kind = kind
         # concurrent searches (HTTP threads with the serving scheduler
         # off, msearch's per-body fallback pool) race move_to_end/popitem
         # without this; the lock is uncontended in the scheduler-on
@@ -95,15 +101,28 @@ class _ByteLRU:
             return None
 
     def put(self, key, value, nbytes: int) -> None:
+        from ..obs.hbm_ledger import LEDGER
+        alloc = None
+        if self._kind is not None and nbytes:
+            # register BEFORE taking the LRU lock (the ledger may raise
+            # the breaker's CircuitBreakingException on an over-budget
+            # build — nothing is cached in that case)
+            alloc = LEDGER.register(self._kind, nbytes,
+                                    label=f"mesh-lru{key!r}"[:160])
+        released = []
         with self._lock:
             old = self._od.pop(key, None)
             if old is not None:
                 self._bytes -= old[1]
-            self._od[key] = (value, nbytes)
+                released.append(old[2])
+            self._od[key] = (value, nbytes, alloc)
             self._bytes += nbytes
             while self._bytes > self._max and len(self._od) > 1:
-                _k, (_v, nb) = self._od.popitem(last=False)
+                _k, (_v, nb, al) = self._od.popitem(last=False)
                 self._bytes -= nb
+                released.append(al)
+        for al in released:
+            LEDGER.release(al)
 
     def __len__(self) -> int:
         return len(self._od)
@@ -128,21 +147,29 @@ class MeshSearchService:
         self._ddsketch_programs: Dict[Tuple, object] = {}
         self._wavg_programs: Dict[Tuple, object] = {}
         self._geo_programs: Dict[Tuple, object] = {}
-        # (index, field) -> (generation, arrays-or-None)
-        self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES)
+        # (index, field) -> (generation, arrays-or-None); device caches
+        # carry an HBM-ledger tenant kind so residency is attributed and
+        # breaker-charged through the ledger (host-side caches stay
+        # untracked — they hold RAM, not HBM)
+        self._stacked_cols = _ByteLRU(self._COLS_MAX_BYTES,
+                                      kind="mesh_columns")
         # (index, field) -> (generation, (val_doc, val_ord, vocab, vpad)
         #                    -or-None); smaller caps for the r5 caches so
         #        the aggregate device budget stays bounded near the original
         #        1 GiB rather than quadrupling
-        self._stacked_ords = _ByteLRU(self._COLS_MAX_BYTES // 4)
+        self._stacked_ords = _ByteLRU(self._COLS_MAX_BYTES // 4,
+                                      kind="mesh_columns")
         # filter-combo key -> per-shard host masks / device stacked mask
         self._host_masks = _ByteLRU(self._COLS_MAX_BYTES // 4)
-        self._dev_masks = _ByteLRU(self._COLS_MAX_BYTES // 4)
+        self._dev_masks = _ByteLRU(self._COLS_MAX_BYTES // 4,
+                                   kind="mesh_columns")
         # (index, field) -> (generation, StackedPhrasePairs-or-None)
-        self._stacked_pairs = _ByteLRU(self._COLS_MAX_BYTES // 2)
+        self._stacked_pairs = _ByteLRU(self._COLS_MAX_BYTES // 2,
+                                       kind="mesh_postings")
         # (index, field, kind, interval, offset) ->
         #     (generation, (bins_dev, min_b, nb)-or-None)
-        self._stacked_bins = _ByteLRU(self._COLS_MAX_BYTES // 4)
+        self._stacked_bins = _ByteLRU(self._COLS_MAX_BYTES // 4,
+                                      kind="mesh_columns")
         # SPMD program invocations must not interleave: two concurrent
         # runs of a collective program cross-join their per-device
         # participants at the XLA rendezvous and deadlock (observed on
@@ -207,6 +234,16 @@ class MeshSearchService:
         if mesh is None:
             return None
         stacked = StackedShardIndex.build(segments, field, mesh)
+        # attribute the stacked per-shard postings (the mesh's dominant
+        # HBM tenant) to the ledger; a generation bump replaces the dict
+        # entry and the old index's GC releases the charge
+        from ..obs.hbm_ledger import LEDGER
+        LEDGER.register(
+            "mesh_postings",
+            sum(int(getattr(a, "nbytes", 0)) for a in
+                (stacked.starts, stacked.doc_ids, stacked.tfs,
+                 stacked.dl, stacked.live)),
+            owner=stacked, label=f"mesh-stacked[{name}][{field}]")
         self._stacked[key] = (svc.generation, stacked)
         return stacked
 
@@ -328,8 +365,8 @@ class MeshSearchService:
                         gc.present.astype(np.float32)
                 off += seg.ndocs
         sh = NamedSharding(mesh, P("shard"))
-        out = (jax.device_put(lat, sh), jax.device_put(lon, sh),
-               jax.device_put(pres, sh))
+        out = (jax.device_put(lat, sh), jax.device_put(lon, sh),  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
+               jax.device_put(pres, sh))  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
         self._stacked_cols.put(key, (svc.generation, out),
                                lat.nbytes * 3)
         return out
@@ -384,7 +421,7 @@ class MeshSearchService:
                     local >= 0, remap[np.minimum(local, len(vs))], -1)
                 off += seg.ndocs
         sh = NamedSharding(mesh, P("shard"))
-        out = (jax.device_put(bins, sh), vocab)
+        out = (jax.device_put(bins, sh), vocab)  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
         self._stacked_cols.put(key, (svc.generation, out), bins.nbytes)
         return out
 
@@ -675,7 +712,7 @@ class MeshSearchService:
             self._stacked_bins.put(key, (svc.generation, None), 0)
             return None
         bins32 = np.where(present, raw - min_b, -1).astype(np.int32)
-        dev = jax.device_put(bins32, NamedSharding(mesh, P("shard")))
+        dev = jax.device_put(bins32, NamedSharding(mesh, P("shard")))  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
         out = (dev, min_b, nb, interval, offset)
         self._stacked_bins.put(key, (svc.generation, out), bins32.nbytes)
         return out
@@ -713,8 +750,8 @@ class MeshSearchService:
                         nc.present.astype(np.float32)
                 off += seg.ndocs
         sharding = NamedSharding(mesh, P("shard"))
-        out = (jax.device_put(col, sharding),
-               jax.device_put(pres, sharding))
+        out = (jax.device_put(col, sharding),  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
+               jax.device_put(pres, sharding))  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
         # byte-bounded LRU so long-lived nodes aggregating over many
         # fields/indices can't pin device columns forever
         self._stacked_cols.put(key, (svc.generation, out),
@@ -767,8 +804,8 @@ class MeshSearchService:
                     pos += n
                 off += seg.ndocs
         sharding = NamedSharding(mesh, P("shard"))
-        out = (jax.device_put(val_doc, sharding),
-               jax.device_put(val_ord, sharding), vocab,
+        out = (jax.device_put(val_doc, sharding),  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
+               jax.device_put(val_ord, sharding), vocab,  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
                next_pow2(len(vocab), floor=8))
         self._stacked_ords.put(key, (svc.generation, out),
                                val_doc.nbytes + val_ord.nbytes)
@@ -839,7 +876,7 @@ class MeshSearchService:
             for seg, m in zip(segs, masks):
                 fmask[si, off: off + seg.ndocs] = m.astype(np.float32)
                 off += seg.ndocs
-        out = jax.device_put(fmask, NamedSharding(mesh, P("shard")))
+        out = jax.device_put(fmask, NamedSharding(mesh, P("shard")))  # oslint: disable=OSL506 -- _ByteLRU kind registers at put()
         self._dev_masks.put(key, out, fmask.nbytes)
         return out
 
@@ -1919,7 +1956,8 @@ class MeshSearchService:
 
         if body.get("knn") or body.get("rescore") or body.get("min_score") \
                 is not None or body.get("profile") or body.get("collapse") \
-                or body.get("suggest") or body.get("search_after") is not None:
+                or body.get("suggest") or body.get("search_after") is not None \
+                or body.get("explain") == "device_plan":
             return None
         if named_nodes:
             return None

@@ -902,6 +902,13 @@ class RestClient:
             # flight recorder (obs/flight_recorder.py): ring occupancy,
             # timelines, anomaly-trigger counts, recent dump metadata
             "flight_recorder": n.flight_recorder.stats(),
+            # HBM ledger (obs/hbm_ledger.py): attributed device-memory
+            # residency by tenant kind, peaks, and breaker-derivation
+            # counters — the byte-domain companion to the breakers block.
+            # On silicon the snapshot carries the device allocator
+            # cross-check (drift beyond threshold has already fired a
+            # flight-recorder hbm_drift dump)
+            "hbm": self._hbm_block(),
             # device query-phase telemetry: kernel serve/fallback counters
             # incl. pruned-path escalations (the pruning design is only as
             # good as its escalation rate), and the SPMD mesh dispatch
@@ -920,6 +927,16 @@ class RestClient:
             node_block["mesh"] = n.mesh_service.stats()
         return {"cluster_name": n.metadata.cluster_name,
                 "nodes": {n.node_name: node_block}}
+
+    def _hbm_block(self) -> dict:
+        out = self.node.hbm_ledger.snapshot()
+        try:
+            check = self.node.hbm_ledger.check_device()
+        except Exception:           # stats probe must never fail a read
+            check = None
+        if check is not None:
+            out["device_check"] = check
+        return out
 
     @staticmethod
     def _telemetry_block() -> dict:
@@ -1889,16 +1906,29 @@ class CatClient:
 
     def segments(self, index: str = "_all",
                  format: str = "json") -> List[dict]:
+        """_cat/segments with per-segment DEVICE residency from the HBM
+        ledger: `memory.device` is the segment's total attributed HBM
+        bytes, `memory.device.tenants` the per-kind breakdown (e.g.
+        `aligned_postings=1048576,segment_columns=262144`)."""
+        residency = self.c.node.hbm_ledger.segment_residency()
         out = []
         for n in sorted(self.c.node.metadata.resolve(index)):
             svc = self.c.node.indices[n]
             for si, sh in enumerate(svc.shards):
                 for seg in sh.segments:
+                    res = residency.get(getattr(seg, "uid", None)) \
+                        or residency.get(seg.name) or {}
+                    kinds = res.get("kinds", {})
                     out.append({"index": n, "shard": str(si),
                                 "prirep": "p", "segment": seg.name,
                                 "docs.count": str(seg.live_count),
                                 "docs.deleted":
-                                    str(seg.ndocs - seg.live_count)})
+                                    str(seg.ndocs - seg.live_count),
+                                "memory.device":
+                                    str(res.get("total_bytes", 0)),
+                                "memory.device.tenants": ",".join(
+                                    f"{k}={v}" for k, v in
+                                    sorted(kinds.items()))})
         return out
 
     def aliases(self, format: str = "json") -> List[dict]:

@@ -64,7 +64,7 @@ _JIT_FAMILIES = ("executor", "mask", "gather", "agg", "rescore", "join")
 
 
 class _TimedProgram:
-    __slots__ = ("_fn", "_family", "_shape", "_compiled")
+    __slots__ = ("_fn", "_family", "_shape", "_compiled", "__weakref__")
 
     def __init__(self, family: str, fn, shape: Optional[str] = None):
         self._fn = fn
@@ -109,11 +109,21 @@ def _instrumented_program_cache(family: str, maxsize: int,
     def deco(build):
         @lru_cache(maxsize=maxsize)
         def cached(*key):
+            from ..obs.hbm_ledger import LEDGER
             from ..utils.metrics import METRICS
             if METRICS.enabled:
                 METRICS.counter(f"search.jit.{family}.cache_miss").inc()
-            return _TimedProgram(family, build(*key),
+            prog = _TimedProgram(family, build(*key),
                                  shape_of(*key) if shape_of else None)
+            # per-shape compiled-program footprint tenant: ADVISORY
+            # (bytes=0, uncharged) — XLA owns the executable's true HBM
+            # cost and the ledger's device cross-check covers the
+            # aggregate; the registration attributes program COUNT per
+            # family and releases on lru eviction / cache_clear
+            LEDGER.register("program", 0, owner=prog, charge=False,
+                            label=f"jit[{family}]"
+                                  f"{'.' + prog._shape if prog._shape else ''}")
+            return prog
 
         @wraps(build)
         def wrapper(*key):
@@ -1849,10 +1859,18 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
             dev = dev_cache.get(ck)
             if dev is None:
                 import jax
-                dev = (jax.device_put(_pad_to_sentinel(d, bucket)),
-                       jax.device_put(_pad_to_sentinel(p, bucket)))
+
+                from ..obs.hbm_ledger import LEDGER
+                d_dev = jax.device_put(_pad_to_sentinel(d, bucket))
+                p_dev = jax.device_put(_pad_to_sentinel(p, bucket))
+                alloc = LEDGER.register(
+                    "phrase_pairs", int(d_dev.nbytes + p_dev.nbytes),
+                    owner=seg, segment=seg,
+                    label=f"phrase-pairs[{seg.name}][{node.field}]")
+                dev = (d_dev, p_dev, alloc)
                 while len(dev_cache) >= 1024:
-                    dev_cache.pop(next(iter(dev_cache)))
+                    evicted = dev_cache.pop(next(iter(dev_cache)))
+                    LEDGER.release(evicted[2])
                 dev_cache[ck] = dev
             _p(params, f"q{nid}_d{i}", dev[0])
             _p(params, f"q{nid}_p{i}", dev[1])
@@ -3254,17 +3272,14 @@ def _nested_sort_values_build(seg: Segment, cache: dict, key, field: str,
         np.add.at(cnt, p, 1.0)
         out = np.divide(out, np.maximum(cnt, 1.0))
     out = np.where(present, out, 0.0)
-    # parent-docs-scale columns cached for the segment's lifetime: charge
-    # the same fielddata budget the fastpath layouts use, released when
-    # the (immutable) segment is GC'd — the cache dict lives on it
-    from ..index import segment as _segment_mod
-    _nb_breaker = _segment_mod._breaker
-    if _nb_breaker is not None:
-        import weakref
-        nbytes = out.nbytes + present.nbytes
-        _nb_breaker.add_estimate(nbytes,
-                                 f"nested-sort[{seg.name}][{path}.{field}]")
-        weakref.finalize(seg, _nb_breaker.release, nbytes)
+    # parent-docs-scale columns cached for the segment's lifetime:
+    # register with the HBM ledger (same fielddata budget the fastpath
+    # layouts charge, derived by the ledger), released when the
+    # (immutable) segment is GC'd — the cache dict lives on it
+    from ..obs.hbm_ledger import LEDGER
+    LEDGER.register("nested_sort", out.nbytes + present.nbytes, owner=seg,
+                    segment=seg,
+                    label=f"nested-sort[{seg.name}][{path}.{field}]")
     cache[key] = (out, present)
     return cache[key]
 

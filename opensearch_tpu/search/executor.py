@@ -21,6 +21,7 @@ import numpy as np
 from ..index.engine import Engine
 from ..index.segment import Segment, next_pow2
 from ..obs import flight_recorder as _flight
+from ..obs import query_cost as _qcost
 from ..script.painless_lite import ScriptError as _ScriptError
 from . import compiler as C
 from . import fastpath
@@ -95,6 +96,47 @@ def _norm_sort_specs(body: dict) -> List[dict]:
             else:
                 out.append({"field": f, **spec})
     return out
+
+
+_LNODE_CHILD_ATTRS = ("musts", "shoulds", "must_nots", "filters",
+                      "children", "child", "positive", "negative")
+
+
+def _cost_predicted(lroot, seg, window: int) -> None:
+    """Plan-time device-cost prediction from CSR block stats alone: each
+    scoring term row the query touches contributes its TRUE posting count
+    (8 bytes per slot — the cost model in docs/OBSERVABILITY.md). Noted
+    per planned segment BEFORE any launched program shape exists; the
+    launch sites note the padded shapes they actually move, and the
+    profile `cost` block reconciles the two."""
+    qc = _qcost.current()
+    if qc is None:
+        return
+    npost = 0
+    stack = [lroot]
+    while stack:
+        node = stack.pop()
+        if node is None:
+            continue
+        terms = None
+        if isinstance(node, (C.LTerms, C.LPhrase, C.LSourcePhrase)):
+            terms = node.terms
+        elif isinstance(node, C.LSparseDot):
+            terms = node.tokens
+        if terms:
+            pb = seg.postings.get(node.field)
+            if pb is not None:
+                for t in terms:
+                    npost += pb.doc_freq(t)
+        for attr in _LNODE_CHILD_ATTRS:
+            v = getattr(node, attr, None)
+            if isinstance(v, (list, tuple)):
+                stack.extend(v)
+            elif v is not None and not isinstance(v, (str, int, float,
+                                                      bool)):
+                stack.append(v)
+    qc.note_predicted(npost * _qcost.POSTING_SLOT_BYTES, npost, window,
+                      segment=seg)
 
 
 class ShardSearcher:
@@ -225,6 +267,11 @@ class ShardSearcher:
             sv = fastpath.shard_search(self, ctx, fast_spec, window)
             if sv is not None:
                 view, fout = sv
+                if _qcost.current() is not None:
+                    # the per-segment loop below won't run — predict per
+                    # view segment here (the view concatenates them)
+                    for vseg in view.segments:
+                        _cost_predicted(lroot, vseg, window)
                 self._collect_view_topk(result, view, fout, shard_ord,
                                         sort_specs, min_score, ctx)
                 result.candidates.sort(key=lambda c: c.sort_values)
@@ -250,6 +297,7 @@ class ShardSearcher:
                 # global/filter-family aggs see docs the query doesn't match,
                 # so ordinary agg trees still allow the skip
                 continue
+            _cost_predicted(lroot, seg, window)
             if fast_spec is not None:
                 fout = fastpath.segment_search(seg, ctx, fast_spec, window)
                 if fout is not None:
@@ -266,6 +314,13 @@ class ShardSearcher:
                 k_pad = min(next_pow2(max(window * oversample, 16)), seg.ndocs_pad)
             params: Dict[str, Any] = {}
             qspec = C.prepare(lroot, seg, ctx, params)
+            qc = _qcost.current()
+            if qc is not None:
+                # actual launched-shape cost of the XLA path: the program
+                # gathers the spec's pow2 buckets (ops.gather_postings)
+                # and extracts a k_pad top-k window
+                gb, slots = _qcost.spec_gather_shape(qspec)
+                qc.note_actual(gb, slots, k_pad, path="xla", segment=seg)
             sspec = C.prepare_sort(sort_specs, seg, params)
             agg_specs = []
             for i, an in enumerate(agg_nodes):
@@ -486,7 +541,7 @@ class ShardSearcher:
             names = result.named_by_doc.get((c.seg_ord, c.local_doc))
             if names:
                 hit["matched_queries"] = names
-            if body.get("explain"):
+            if body.get("explain") and body.get("explain") != "device_plan":
                 hit["_explanation"] = explain_doc(lroot, seg, c.local_doc, ctx)
             for nq in nested_ihs:
                 self._add_inner_hits(hit, nq, seg, c, ctx, ih_cache)
@@ -793,22 +848,36 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
         # jit-attribution baseline: the profile response reports the
         # DELTA this request caused (compiles triggered, cache traffic)
         body["_jit_before"] = C.jit_attribution()
-    results = []
-    for i, s in enumerate(searchers):
-        with TRACER.span("query_phase", shard=i), \
-                METRICS.timer("search.query_phase"):
-            results.append(s.query_phase(body, shard_ord=i,
-                                         stats_ctx=stats[i], task=task))
-    if phase_hook is not None:
-        phase_hook(results, body, phase_ctx if phase_ctx is not None else {})
-    agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
-    # pipelines whose buckets_path targets a refinement-resolved sub-agg are
-    # deferred until after _refine_complex_subs; the rest run in finalize so
-    # bucket_selector/bucket_sort still prune BEFORE per-bucket refinement
-    for an in agg_nodes:
-        _mark_deferred_pipelines(an)
-    return _finish_search(searchers, results, body, stats, index_name, t0,
-                          agg_nodes)
+    # per-query device cost accounting (obs/query_cost.py): one
+    # accumulator spans the whole shard loop + fastpath ladder; plan-time
+    # predictions and launched-shape actuals reconcile in the profile
+    # `cost` block and the cost.* histograms at finish
+    qc_token = None
+    if _qcost.enabled() and _qcost.current() is None:
+        _, qc_token = _qcost.start(
+            detail=body.get("explain") == "device_plan")
+    try:
+        results = []
+        for i, s in enumerate(searchers):
+            with TRACER.span("query_phase", shard=i), \
+                    METRICS.timer("search.query_phase"):
+                results.append(s.query_phase(body, shard_ord=i,
+                                             stats_ctx=stats[i], task=task))
+        if phase_hook is not None:
+            phase_hook(results, body,
+                       phase_ctx if phase_ctx is not None else {})
+        agg_nodes = parse_aggs(body.get("aggs", body.get("aggregations")))
+        # pipelines whose buckets_path targets a refinement-resolved
+        # sub-agg are deferred until after _refine_complex_subs; the rest
+        # run in finalize so bucket_selector/bucket_sort still prune
+        # BEFORE per-bucket refinement
+        for an in agg_nodes:
+            _mark_deferred_pipelines(an)
+        return _finish_search(searchers, results, body, stats, index_name,
+                              t0, agg_nodes)
+    finally:
+        if qc_token is not None:
+            _qcost.finish(qc_token)
 
 
 def msearch_batched(searchers: List[ShardSearcher],
@@ -850,7 +919,8 @@ def launch_msearch_batched(searchers: List[ShardSearcher],
         body["_index_name"] = index_name
         if (body.get("aggs") or body.get("aggregations") or body.get("rescore")
                 or body.get("search_after") is not None or body.get("min_score")
-                is not None or body.get("profile")):
+                is not None or body.get("profile")
+                or body.get("explain") == "device_plan"):
             parsed.append(None)
             continue
         try:
@@ -1074,6 +1144,19 @@ def _finish_search(searchers: List[ShardSearcher],
                 entry["searches"][0]["query"] = [root]
             shards_profile.append(entry)
         resp["profile"] = {"shards": shards_profile}
+        qc = _qcost.current()
+        if qc is not None:
+            # per-query device cost: plan-time prediction (CSR stats)
+            # reconciled against the launched program shapes — the byte
+            # domain the north star's ≥20× claim is argued in
+            resp["profile"]["cost"] = qc.snapshot()
+    if body.get("explain") == "device_plan":
+        # device-plan search view: the cost rollup + per-segment
+        # predicted/actual entries, without per-hit _explanation trees
+        qc = _qcost.current()
+        if qc is not None:
+            resp["device_plan"] = {"cost": qc.snapshot(),
+                                   "segments": list(qc.segments)}
     return resp
 
 

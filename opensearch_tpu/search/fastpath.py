@@ -67,6 +67,10 @@ from ..utils.trace import TRACER
 # below is guarded by RECORDER.enabled so the disabled path never builds
 # an event payload
 from ..obs import flight_recorder as _fr
+# per-query device cost accounting (obs/query_cost.py): every kernel
+# launch notes the bytes its DMA windows actually move — reconciled
+# against the plan-time CSR-stat prediction in the profile `cost` block
+from ..obs import query_cost as _qc
 
 STATS = CounterGroup(METRICS, "fastpath", {
     "pure_served": 0, "bool_served": 0, "fallback": 0,
@@ -114,15 +118,18 @@ def rescore_mode() -> str:
 def rescore_stats() -> dict:
     return dict(RESCORE_STATS)
 
-# optional memory accounting set by the Node (utils/breaker.py): charged
-# before aligned arrays go to device, released when the segment is GC'd
-# (segments are immutable and replaced on refresh/merge)
-_breaker = None
+# memory accounting: aligned postings, filter lists, filtered copies and
+# quality-tier views register with the HBM ledger (obs/hbm_ledger.py),
+# which derives the fielddata-breaker charge — the ledger is the sole
+# charge path (oslint OSL506). Released when the owning layout object
+# (or its segment) is GC'd; segments are immutable and replaced on
+# refresh/merge.
 
 
 def set_breaker(breaker) -> None:
-    global _breaker
-    _breaker = breaker
+    """Legacy wiring shim: the breaker now lives on the ledger."""
+    from ..obs.hbm_ledger import LEDGER
+    LEDGER.set_breaker(breaker)
 
 
 def set_enabled(flag: bool) -> None:
@@ -361,10 +368,9 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     a_starts, a_docs, a_packed = align_csr_rows(
         cat_starts, cat_docs, cat_packed, margin=MAX_L, alignment=LANES)
     nbytes = a_docs.nbytes + a_packed.nbytes
-    if _breaker is not None:
-        import weakref
-        _breaker.add_estimate(nbytes, f"fastpath[{seg.name}][{field}]")
-        weakref.finalize(seg, _breaker.release, nbytes)
+    from ..obs.hbm_ledger import LEDGER
+    LEDGER.register("aligned_postings", nbytes, owner=seg, segment=seg,
+                    label=f"fastpath[{seg.name}][{field}]")
     starts_rows = (a_starts[:-1] // LANES).astype(np.int64)
     head_starts_rows = starts_rows[:nterms].copy()
     head_lens = np.minimum(lens, L_HEAD)
@@ -800,6 +806,15 @@ def _launch_pure_groups_async(seg: Segment,
         # per-launch attribution (scripts/measure_concurrency.py divides
         # served queries by launches to report the coalescing ratio)
         METRICS.counter("fastpath.launches").inc()
+        cost = _qc.current()
+        if cost is not None:
+            # actual bytes moved = the kernel's DMA windows: per term,
+            # nrows lane-rows of 8-byte (doc, packed tf·dl) slots;
+            # scatter work = the true posting counts; top-k work = the
+            # K output lanes extracted per kernel row
+            cost.note_actual(int(nrows.sum()) * LANES * 8,
+                             int(lens.sum()), K_launch * len(gvqs),
+                             path="kernel")
         pending.append((gvqs, K_launch, fused_bm25_topk_tfdl(
             al.d_docs, al.d_tfdl, rowstarts, nrows, lens, skips, weights,
             msm, avg, dlo, dhi, T=T_pad, L=L, K=K_launch, k1=k1, b=b_eff)))
@@ -1282,11 +1297,10 @@ def _quality_tier(seg: Segment, field: str):
             nbytes = mask.nbytes + host_docs.nbytes
             fl = FilterList(host_docs, None, len(host_docs), nbytes, mask,
                             ("_quality", field, QUALITY_SHARE))
-            if _breaker is not None:
-                import weakref
-                _breaker.add_estimate(
-                    nbytes, f"fastpath-quality[{seg.name}][{field}]")
-                weakref.finalize(fl, _breaker.release, nbytes)
+            from ..obs.hbm_ledger import LEDGER
+            LEDGER.register(
+                "quality_tier", nbytes, owner=fl, segment=seg,
+                label=f"fastpath-quality[{seg.name}][{field}]")
             frontiers: dict = {}
 
             def frontier_of(row: int, _f=frontiers, _pb=pb, _dl=dl,
@@ -1635,11 +1649,10 @@ def _filter_list(seg: Segment, ctx, clauses) -> Optional[FilterList]:
                      and n * _MATERIALIZE_DENSITY > seg.ndocs)
     mask_kept = combined if dense_capable else None
     fl = FilterList(docs, jax.device_put(buf), n, buf.nbytes, mask_kept, key)
-    if _breaker is not None:
-        import weakref
-        charged = buf.nbytes + (combined.nbytes if dense_capable else 0)
-        _breaker.add_estimate(charged, f"fastpath-filter[{seg.name}]")
-        weakref.finalize(fl, _breaker.release, charged)
+    from ..obs.hbm_ledger import LEDGER
+    charged = buf.nbytes + (combined.nbytes if dense_capable else 0)
+    LEDGER.register("filter_list", charged, owner=fl, segment=seg,
+                    label=f"fastpath-filter[{seg.name}]")
     while len(cache) >= _MAX_FILTER_LISTS:
         cache.popitem(last=False)
     cache[key] = fl
@@ -1728,10 +1741,9 @@ def _filtered_postings(seg: Segment, field: str, fl: FilterList
                          jax.device_put(a_docs), jax.device_put(a_packed),
                          nbytes)
     fp = FilteredPostings(al, new_starts, new_docs, tfs, nbytes)
-    if _breaker is not None:
-        import weakref
-        _breaker.add_estimate(nbytes, f"fastpath-filtered[{seg.name}][{field}]")
-        weakref.finalize(fp, _breaker.release, nbytes)
+    from ..obs.hbm_ledger import LEDGER
+    LEDGER.register("filtered_postings", nbytes, owner=fp, segment=seg,
+                    label=f"fastpath-filtered[{seg.name}][{field}]")
     if not hasattr(seg, "_filtered_fin"):
         import weakref
         seg._filtered_fin = weakref.finalize(seg, _purge_filtered_for_uid,
@@ -1850,7 +1862,9 @@ def _dummy_hbm():
     global _dummy_hbm_arr
     if _dummy_hbm_arr is None:
         import jax
-        _dummy_hbm_arr = jax.device_put(
+        # one 4KB process-lifetime sentinel buffer; attributing it
+        # would be noise, not accounting
+        _dummy_hbm_arr = jax.device_put(  # oslint: disable=OSL506
             np.full(HBM_ALIGN, INT_SENTINEL, np.int32))
     return _dummy_hbm_arr
 
@@ -1980,6 +1994,11 @@ def _launch_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         dlo = np.array([[v.dlo] for v in gvqs], np.int32)
         dhi = np.array([[v.dhi] for v in gvqs], np.int32)
         METRICS.counter("fastpath.launches").inc()
+        cost = _qc.current()
+        if cost is not None:
+            cost.note_actual(int(nrows.sum()) * LANES * 8,
+                             int(lens.sum()), K * len(gvqs),
+                             path="kernel_bool")
         pending.append((gvqs, fused_bm25_bool_topk(
             d_docs, d_tfdl, filt, rowstarts, nrows, lens, skips, weights,
             cw, thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,

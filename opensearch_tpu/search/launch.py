@@ -47,7 +47,7 @@ class LaunchHandle:
     unfetched handle releases them."""
 
     __slots__ = ("kind", "launched_at", "fetched_at", "_finish", "_result",
-                 "_error", "_done", "info")
+                 "_error", "_done", "info", "ws_alloc", "__weakref__")
 
     def __init__(self, finish: Callable[[], object], kind: str = "device",
                  info: Optional[dict] = None):
@@ -64,6 +64,11 @@ class LaunchHandle:
         # events; None when the recorder is disabled (obs/ lazy-payload
         # discipline)
         self.info = info
+        # optional HBM-ledger workspace allocation (obs/hbm_ledger.py):
+        # the serving scheduler registers the in-flight batch's pinned
+        # output buffers against the launched handle; released (ledger
+        # release is idempotent) when the deferred sync retires it
+        self.ws_alloc = None
 
     @property
     def done(self) -> bool:
@@ -93,6 +98,10 @@ class LaunchHandle:
             self._done = True
             self.fetched_at = time.monotonic()
             METRICS.counter(f"launch.{self.kind}.fetched").inc()
+            if self.ws_alloc is not None:
+                from ..obs.hbm_ledger import LEDGER
+                LEDGER.release(self.ws_alloc)
+                self.ws_alloc = None
         return self._result
 
     def launch_to_fetch_ms(self) -> Optional[float]:

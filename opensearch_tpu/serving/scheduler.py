@@ -313,6 +313,11 @@ class ServingScheduler:
         for k in _BYPASS_KEYS:
             if body.get(k) is not None:
                 return False
+        if body.get("explain") == "device_plan":
+            # the device-plan cost view needs the requesting thread's own
+            # cost accumulator (obs/query_cost.py) — a coalesced launch
+            # on the dispatcher thread can't attribute per-request
+            return False
         q = body.get("query")
         if q is not None and not isinstance(q, dict):
             return False
@@ -766,6 +771,22 @@ class ServingScheduler:
             from ..search.executor import launch_msearch_batched
             kernel_handle = launch_msearch_batched(svc.searchers, bodies,
                                                    index_name=name)
+        handle = mesh_handle if mesh_handle is not None else kernel_handle
+        if handle is not None:
+            # batch workspace tenant: the pinned per-request top-k output
+            # buffers (score f32 + doc i32 per window slot) the device
+            # owes while this batch sits in the in-flight window;
+            # released at the handle's deferred sync (or the handle's GC
+            # — a wedged/abandoned batch must not pin the stamp).
+            # ADVISORY (uncharged): the programs are already launched,
+            # so a breaker trip here could only waste the device work by
+            # degrading the whole batch to the host loop
+            from ..obs.hbm_ledger import LEDGER
+            slots = sum(int(b.get("from", 0)) + int(b.get("size", 10))
+                        for b in bodies if isinstance(b, dict))
+            handle.ws_alloc = LEDGER.register(
+                "batch_workspace", slots * 8, owner=handle, charge=False,
+                label=f"sched-batch[{name}]x{len(bodies)}")
         return (mesh_handle, kernel_handle)
 
     def _finish_group(self, name: str, svc, bodies: List[dict],

@@ -1,7 +1,13 @@
 """Circuit breakers: HBM budget accounting. Analog of reference
 `indices/breaker/HierarchyCircuitBreakerService.java` — instead of JVM heap,
 we budget device HBM for segment residency and reject loads that would
-exceed the limit."""
+exceed the limit.
+
+Charge discipline (oslint OSL506): product code never calls
+`add_estimate`/`release` directly — every HBM tenant registers an
+attributed allocation with the ledger (`obs/hbm_ledger.py`), which
+derives the breaker charge and guarantees the standing invariant
+`sum(live charged ledger bytes) == breaker.used`."""
 
 from __future__ import annotations
 

@@ -1,0 +1,160 @@
+"""One-shot HBM + per-query cost report — eyeball regressions without a
+running node.
+
+Builds an in-process node over a corpus (synthetic by default, or a JSONL
+file of documents), replays a query file (one JSON search body per line;
+a built-in 3-query mix when omitted), and prints:
+
+- the HBM ledger snapshot (total/peak, per-tenant-kind bytes),
+- the top live tenants by bytes (kind, segment, label),
+- per-segment device residency (the `_cat/segments` columns),
+- bytes-per-query percentiles (predicted + actual, DDSketch) and the
+  predicted-vs-actual reconciliation from the replayed queries.
+
+Run:
+    python scripts/hbm_report.py [--ndocs 5000] [--docs docs.jsonl]
+                                 [--queries queries.jsonl] [--json]
+
+`--docs` lines: {"body": "...", ...} (indexed as-is, auto ids).
+`--queries` lines: full search bodies, e.g. {"query": {"match": {...}}}.
+Smoke-tested in tier-1 (tests/test_hbm_ledger.py).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def _synthetic_docs(ndocs: int):
+    """Deterministic zipf-ish corpus: small shared vocab, long tail."""
+    import numpy as np
+    rng = np.random.default_rng(7)
+    vocab = [f"w{i:05d}" for i in range(max(ndocs // 4, 64))]
+    probs = 1.0 / np.arange(1, len(vocab) + 1)
+    probs /= probs.sum()
+    for _ in range(ndocs):
+        toks = rng.choice(len(vocab), size=int(rng.integers(4, 24)),
+                          p=probs)
+        yield {"body": " ".join(vocab[t] for t in toks),
+               "status": ["draft", "review", "published"][int(
+                   rng.integers(0, 3))]}
+
+
+def _default_queries():
+    return [
+        {"query": {"match": {"body": "w00000 w00001"}}, "size": 10},
+        {"query": {"bool": {
+            "must": [{"match": {"body": "w00000"}}],
+            "filter": [{"term": {"status": "published"}}]}}, "size": 10},
+        {"query": {"match": {"body": "w00002 w00005 w00011"}}, "size": 10},
+    ]
+
+
+def build_report(ndocs: int, docs_path=None, queries_path=None) -> dict:
+    from opensearch_tpu.cluster.node import Node
+    from opensearch_tpu.obs import query_cost
+    from opensearch_tpu.obs.hbm_ledger import LEDGER
+    from opensearch_tpu.rest.client import RestClient
+
+    client = RestClient(node=Node(mesh_service=False))
+    client.indices.create("report", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {"body": {"type": "text"},
+                                    "status": {"type": "keyword"}}}})
+    if docs_path:
+        with open(docs_path) as fh:
+            docs = [json.loads(ln) for ln in fh if ln.strip()]
+    else:
+        docs = list(_synthetic_docs(ndocs))
+    bulk = []
+    for i, d in enumerate(docs):
+        bulk.append({"index": {"_index": "report", "_id": str(i)}})
+        bulk.append(d)
+        if len(bulk) >= 10_000:
+            client.bulk(bulk)
+            bulk = []
+    if bulk:
+        client.bulk(bulk)
+    client.indices.refresh("report")
+
+    if queries_path:
+        with open(queries_path) as fh:
+            queries = [json.loads(ln) for ln in fh if ln.strip()]
+    else:
+        queries = _default_queries()
+
+    costs = []
+    for body in queries:
+        resp = client.search("report", dict(body, profile=True))
+        cost = resp.get("profile", {}).get("cost")
+        if cost:
+            costs.append(cost)
+
+    return {
+        "ndocs": len(docs),
+        "queries_replayed": len(queries),
+        "ledger": LEDGER.snapshot(),
+        "top_tenants": LEDGER.top_tenants(10),
+        "segments": {str(k): v for k, v in
+                     LEDGER.segment_residency().items()},
+        "bytes_per_query": query_cost.bytes_per_query_stamp(),
+        "per_query_costs": costs,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ndocs", type=int, default=5000)
+    ap.add_argument("--docs", default=None,
+                    help="JSONL file of documents (default: synthetic)")
+    ap.add_argument("--queries", default=None,
+                    help="JSONL file of search bodies (default: built-in)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args(argv)
+
+    rep = build_report(args.ndocs, args.docs, args.queries)
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+        return 0
+
+    led = rep["ledger"]
+    print(f"corpus: {rep['ndocs']} docs, "
+          f"{rep['queries_replayed']} queries replayed")
+    print(f"HBM ledger: total {_fmt_bytes(led['total_bytes'])}  "
+          f"peak {_fmt_bytes(led['peak_bytes'])}  "
+          f"allocations {led['allocations']}")
+    print("tenants:")
+    for kind, t in sorted(led["tenants"].items(),
+                          key=lambda kv: -kv[1]["bytes"]):
+        print(f"  {kind:<20} {_fmt_bytes(t['bytes']):>12}  "
+              f"peak {_fmt_bytes(t['peak_bytes']):>12}  x{t['count']}")
+    print("top tenants:")
+    for t in rep["top_tenants"]:
+        print(f"  {_fmt_bytes(t['bytes']):>12}  {t['kind']:<18} "
+              f"seg={t['segment'] or '-':<10} {t['label']}")
+    bq = rep["bytes_per_query"]
+    print(f"bytes/query: actual {bq['actual']}  predicted "
+          f"{bq['predicted']}  pred/actual% "
+          f"{bq['predicted_vs_actual_pct']}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -21,7 +21,14 @@ A final flight-recorder pair re-runs the (32-thread, deepest-depth) cell
 with the recorder pinned ON vs OFF (obs/flight_recorder.py; on is the
 process default) — responses must stay byte-identical in both, and the
 recorder-overhead gate requires recorder-on qps >= 0.98x recorder-off
-(`extra.concurrency.recorder_overhead_32t` in the BENCH json).
+(`extra.concurrency.recorder_overhead_32t` in the BENCH json). A second
+pair does the same for HBM-ledger + per-query cost accounting
+(obs/query_cost.py) on the direct host-loop path (scheduler and mesh
+off, where the accounting engages): cost-on qps >= 0.98x cost-off with
+byte-identical responses (`extra.concurrency.cost_overhead_32t`), and
+the run stamps `extra.hbm` (peak resident bytes by tenant kind) +
+`extra.bytes_per_query` (predicted/actual DDSketch percentiles) — the
+committed byte-domain baseline for ROADMAP item 1.
 
 Results land in BENCH_out.json under `extra.concurrency` (merged into an
 existing bench emission when present). Run:
@@ -116,14 +123,15 @@ def strip_took(resp: dict) -> str:
 
 
 def run_cell(client, bodies, nthreads: int, mode, tag: str,
-             recorder=None):
+             recorder=None, cost=None):
     """Closed loop: `nthreads` client threads drain the shared query list;
     every thread records its request wall into a DDSketch histogram.
     `mode` is None for scheduler-off, or a pipeline depth (int) for a
     fresh scheduler-on cell at that depth. `recorder` pins the flight
     recorder for the cell (True/False; None = leave the process default,
     which is ON) — the recorder-overhead gate compares a pinned-on vs
-    pinned-off pair at 32 threads."""
+    pinned-off pair at 32 threads. `cost` pins per-query cost accounting
+    (obs/query_cost.py) the same way for the ledger+cost overhead gate."""
     from opensearch_tpu.obs.flight_recorder import RECORDER
     from opensearch_tpu.serving import SchedulerConfig, ServingScheduler
     from opensearch_tpu.utils.metrics import METRICS, MetricsRegistry
@@ -132,6 +140,9 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     rec_before = RECORDER.enabled
     if recorder is not None:
         RECORDER.enabled = bool(recorder)
+    cost_before = os.environ.get("OPENSEARCH_TPU_COST")
+    if cost is not None:
+        os.environ["OPENSEARCH_TPU_COST"] = "1" if cost else "0"
     RECORDER.reset()       # bound ring memory + per-cell trigger state
     old_serving = node.serving
     sched_on = mode is not None
@@ -143,11 +154,11 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
             node, SchedulerConfig(pipeline_depth=int(mode)), enabled=True)
     else:
         node.serving.enabled = False
-    mesh = node.mesh_service
+    mesh = node.mesh_service      # None on the direct-path cost pair
     reg = MetricsRegistry()
     hist = reg.histogram("request_ms")
     serving0 = node.serving.stats()
-    launches0 = mesh.launches
+    launches0 = mesh.launches if mesh is not None else 0
     fp0 = METRICS.counter("fastpath.launches").value
     results = [None] * len(bodies)
     errors = []
@@ -181,15 +192,17 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
         t.join()
     wall = time.time() - t0
     serving1 = node.serving.stats()
-    launches = (mesh.launches - launches0) + \
+    launches = ((mesh.launches if mesh is not None else 0) - launches0) + \
         (METRICS.counter("fastpath.launches").value - fp0)
     flushes = serving1["flushes"] - serving0["flushes"]
     batched = serving1["batched_served"] - serving0["batched_served"]
     snap = hist.snapshot((50, 95))
+    from opensearch_tpu.obs import query_cost as _qc
     cell = {
         "threads": nthreads,
         "scheduler": "on" if sched_on else "off",
         "recorder": "on" if RECORDER.enabled else "off",
+        "cost": "on" if _qc.enabled() else "off",
         "mode": "off" if not sched_on else f"d{int(mode)}",
         "n": len(bodies),
         "errors": len(errors),
@@ -217,6 +230,11 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     node.serving = old_serving
     if recorder is not None:
         RECORDER.enabled = rec_before
+    if cost is not None:
+        if cost_before is None:
+            os.environ.pop("OPENSEARCH_TPU_COST", None)
+        else:
+            os.environ["OPENSEARCH_TPU_COST"] = cost_before
     if errors:
         cell["first_errors"] = errors[:3]
     return cell, results
@@ -279,12 +297,64 @@ def main():
         rec_pair[rlabel] = cell
         print(json.dumps(cell), flush=True)
 
+    # ledger+cost overhead pair: scheduler AND mesh off, so every request
+    # runs the host shard loop where per-query cost accounting engages
+    # (obs/query_cost.py) — pinned cost OFF vs ON back-to-back after a
+    # warmup pass (the direct path pays its XLA compiles here; the grid
+    # cells above never exercised it, and a cold first cell would bench
+    # compile time, not accounting). Gate: cost-on qps >= 0.98x cost-off
+    # with byte-identical responses BETWEEN the pair's cells (the same
+    # discipline as the PR 6 recorder gate; mesh-vs-host parity has its
+    # own tests and is not re-litigated here).
+    cost_pair = {}
+    cost_digests = {}
+    mesh_saved = client.node.mesh_service
+    client.node.mesh_service = None
+    try:
+        run_cell(client, bodies, rthreads, None,
+                 f"{rthreads}-direct-warmup", cost=False)
+        for clabel, cflag in (("cost_off", False), ("cost_on", True)):
+            tag = f"{rthreads}-direct-{clabel}"
+            cell, results = run_cell(client, bodies, rthreads, None, tag,
+                                     cost=cflag)
+            errored += cell["errors"]
+            cost_digests[clabel] = [strip_took(r) if r is not None
+                                    else None for r in results]
+            cells.append(cell)
+            cost_pair[clabel] = cell
+            print(json.dumps(cell), flush=True)
+        pair_bad = sum(1 for a, b in zip(cost_digests["cost_off"],
+                                         cost_digests["cost_on"])
+                       if a != b)
+        cost_pair["cost_on"]["identical_responses"] = pair_bad == 0
+        cost_pair["cost_off"]["identical_responses"] = pair_bad == 0
+        mismatched += pair_bad
+    finally:
+        client.node.mesh_service = mesh_saved
+
     summary = {"ndocs": ndocs, "nq": nq,
                "devices": len(jax.devices()),
                "mix": "60% match2 / 40% filtered bool",
                "identical_responses": mismatched == 0,
                "pipeline_depths": depths,
                "cells": cells}
+    # HBM + bytes/query stamps for the BENCH json (ISSUE 7 baseline):
+    # peak resident bytes by tenant kind and the per-query byte
+    # percentiles accumulated by the cost-on cell
+    from opensearch_tpu.obs import query_cost as _query_cost
+    from opensearch_tpu.obs.hbm_ledger import LEDGER
+    hbm_stamp = LEDGER.peak_stamp()
+    bpq_stamp = _query_cost.bytes_per_query_stamp()
+    summary["hbm"] = hbm_stamp
+    summary["bytes_per_query"] = bpq_stamp
+    if cost_pair:
+        on_c, off_c = cost_pair["cost_on"], cost_pair["cost_off"]
+        summary["cost_overhead_32t"] = {
+            "threads": rthreads, "mode": "direct",
+            "cost_on_qps": on_c["qps"],
+            "cost_off_qps": off_c["qps"],
+            "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+        }
     if rec_pair:
         on_c, off_c = rec_pair["rec_on"], rec_pair["rec_off"]
         summary["recorder_overhead_32t"] = {
@@ -323,7 +393,11 @@ def main():
         bench_doc = {"metric": "bm25_rest_qps_per_chip", "value": None,
                      "unit": "queries/sec", "vs_baseline": None,
                      "extra": {"status": "concurrency_only"}}
-    bench_doc.setdefault("extra", {})["concurrency"] = summary
+    extra_doc = bench_doc.setdefault("extra", {})
+    extra_doc["concurrency"] = summary
+    # top-level BENCH stamps (don't clobber a fuller bench.py emission)
+    extra_doc.setdefault("hbm", hbm_stamp)
+    extra_doc.setdefault("bytes_per_query", bpq_stamp)
     with open(out_path, "w") as f:
         json.dump(bench_doc, f, indent=2)
     print(json.dumps({"summary": {k: v for k, v in summary.items()
@@ -361,6 +435,12 @@ def main():
                 f"flight-recorder overhead gate failed: recorder-on qps "
                 f"is {rp['qps_ratio']}x recorder-off (< 0.98x) at "
                 f"{rp['threads']} threads")
+        cp = summary.get("cost_overhead_32t")
+        if cp and cp["qps_ratio"] < 0.98:
+            raise SystemExit(
+                f"ledger+cost overhead gate failed: cost-on qps is "
+                f"{cp['qps_ratio']}x cost-off (< 0.98x) at "
+                f"{cp['threads']} threads")
     print("OK", flush=True)
 
 

@@ -16,3 +16,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def tmp_data_path(tmp_path):
     return str(tmp_path / "data")
+
+
+@pytest.fixture(autouse=True)
+def _hbm_ledger_breaker_invariant():
+    """Standing byte-domain invariant (ISSUE 7): after every tier-1 test,
+    each breaker with ledger charges satisfies
+    `sum(live charged ledger bytes) == breaker.used` — the HBM ledger is
+    the sole charge path (oslint OSL506), so any drift means a charge or
+    release bypassed attribution."""
+    yield
+    from opensearch_tpu.obs.hbm_ledger import LEDGER
+    problems = LEDGER.verify_breakers()
+    assert not problems, "HBM ledger/breaker invariant broken: " \
+        + "; ".join(problems)

@@ -382,7 +382,10 @@ class TestQualityTierBreaker:
         assert fastpath.get_aligned(seg, "body") is not None
         monkeypatch.setattr(fastpath, "QUALITY_MIN_NDOCS", 256)
         br = CircuitBreaker("test-fielddata", 1 << 30)
-        monkeypatch.setattr(fastpath, "_breaker", br)
+        # the ledger is the sole charge path now (OSL506): install the
+        # test breaker as its charge target (monkeypatch restores)
+        from opensearch_tpu.obs.hbm_ledger import LEDGER
+        monkeypatch.setattr(LEDGER, "_breaker", br)
 
         qt = fastpath._quality_tier(seg, "body")
         assert qt is not None

@@ -213,10 +213,20 @@ class TestBreakerRules:
         src = self.TIER % "pass"
         assert "OSL301" in rules_of(lint(src))
 
-    def test_osl301_quiet_when_breaker_charged(self):
+    def test_osl301_quiet_when_ledger_registered(self):
+        # the post-ISSUE-7 idiom: the HBM ledger derives the breaker
+        # charge from an attributed registration (OSL506)
+        src = self.TIER % (
+            'LEDGER.register("quality_tier", mask.nbytes + docs.nbytes, '
+            'owner=fl)')
+        assert rules_of(lint(src)) == []
+
+    def test_osl301_direct_charge_now_trips_osl506(self):
+        # the OLD idiom — a direct breaker charge — satisfies OSL301 but
+        # violates the ledger-is-the-sole-charge-path discipline
         src = self.TIER % (
             '_breaker.add_estimate(mask.nbytes + docs.nbytes, "q")')
-        assert rules_of(lint(src)) == []
+        assert rules_of(lint(src)) == ["OSL506"]
 
     def test_osl301_quiet_without_ndocs_scale(self):
         src = """
@@ -699,6 +709,87 @@ class TestRecorderDiscipline:
         # monotonic
         findings = run_paths(["opensearch_tpu"], REPO_ROOT)
         assert [f for f in findings if f.rule == "OSL505"] == []
+
+
+class TestMemoryAccounting:
+    # OSL506 memory-accounting discipline: the HBM ledger is the sole
+    # breaker-charge path, and device residency in index/search/parallel
+    # must reference the ledger in its enclosing scope
+
+    def test_osl506_direct_add_estimate(self):
+        src = """
+            def build(seg, breaker, nbytes):
+                breaker.add_estimate(nbytes, "layout")
+        """
+        found = lint(src, "opensearch_tpu/search/fastpath.py")
+        assert [f for f in found if f.rule == "OSL506"
+                and f.detail == "charge:add_estimate"]
+
+    def test_osl506_breaker_release(self):
+        src = """
+            def drop(self, nbytes):
+                self._breaker.release(nbytes)
+        """
+        found = lint(src, "opensearch_tpu/index/segment.py")
+        assert [f for f in found if f.rule == "OSL506"
+                and f.detail == "charge:release"]
+
+    def test_osl506_lock_release_not_flagged(self):
+        # .release on a non-breaker object (locks, semaphores) is fine
+        src = """
+            def unlock(self):
+                self._lock.release()
+        """
+        assert "OSL506" not in rules_of(lint(
+            src, "opensearch_tpu/search/fastpath.py"))
+
+    def test_osl506_ledger_module_exempt(self):
+        src = """
+            def register(self, breaker, nbytes, label):
+                breaker.add_estimate(nbytes, label)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/hbm_ledger.py")) == []
+
+    def test_osl506_device_put_without_ledger(self):
+        src = """
+            import jax
+
+            def build(self, arr):
+                self._cache["x"] = jax.device_put(arr)
+        """
+        found = lint(src, "opensearch_tpu/index/segment.py")
+        assert [f for f in found if f.rule == "OSL506"
+                and f.detail.startswith("device_put")]
+
+    def test_osl506_quiet_with_ledger_registration(self):
+        src = """
+            import jax
+            from opensearch_tpu.obs.hbm_ledger import LEDGER
+
+            def build(self, seg, arr):
+                dev = jax.device_put(arr)
+                LEDGER.register("aligned_postings", arr.nbytes, owner=seg)
+                return dev
+        """
+        assert "OSL506" not in rules_of(lint(
+            src, "opensearch_tpu/search/fastpath.py"))
+
+    def test_osl506_out_of_scope_layer_quiet(self):
+        # residency rule patrols index/search/parallel only
+        src = """
+            import jax
+
+            def warm(arr):
+                return jax.device_put(arr)
+        """
+        assert "OSL506" not in rules_of(lint(
+            src, "opensearch_tpu/ops/scoring.py"))
+
+    def test_osl506_repo_clean(self):
+        # the ratchet at zero: every charge goes through the ledger and
+        # every residency site registers or carries a justified disable
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f.render() for f in findings if f.rule == "OSL506"] == []
 
 
 # ----------------------------------------------------------------------

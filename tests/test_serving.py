@@ -740,8 +740,9 @@ class TestBreakerFolding:
         from opensearch_tpu.index.mappings import Mappings
         from opensearch_tpu.utils.breaker import CircuitBreaker
         br = CircuitBreaker("fielddata-test", 1 << 30)
-        old = segmod._breaker
-        segmod.set_breaker(br)
+        from opensearch_tpu.obs.hbm_ledger import LEDGER
+        old = LEDGER.breaker
+        segmod.set_breaker(br)     # shim -> LEDGER.set_breaker (OSL506)
         try:
             eng = Engine(Mappings({"properties": {
                 "body": {"type": "text"}}}))
@@ -770,8 +771,9 @@ class TestBreakerFolding:
         from opensearch_tpu.index.mappings import Mappings
         from opensearch_tpu.utils.breaker import CircuitBreaker
         br = CircuitBreaker("fielddata-test", 1 << 30)
-        old = segmod._breaker
-        segmod.set_breaker(br)
+        from opensearch_tpu.obs.hbm_ledger import LEDGER
+        old = LEDGER.breaker
+        segmod.set_breaker(br)     # shim -> LEDGER.set_breaker (OSL506)
         try:
             eng = Engine(Mappings({"properties": {
                 "items": {"type": "nested", "properties": {
