@@ -334,14 +334,169 @@ def make_index(client, body_csr, body_dl, title_csr, status_ord, price,
     seg.sources = _LazySources(ndocs)
     seg.id2doc = {}
     seg.live = np.ones(ndocs, dtype=bool)
+    from opensearch_tpu.index.segment import (CODEC_V2,
+                                              default_codec_version)
+    if default_codec_version() >= CODEC_V2:
+        # codec v2: quantized eager impacts + block-max sidecars, exactly
+        # like the refresh path builds them (direct CSR corpora opt in
+        # through the same Segment.build_impacts the engine uses)
+        seg.build_impacts()
     if create:
-        client.indices.create("bench", {"mappings": {"properties": {
-            "body": {"type": "text"}, "title": {"type": "text"},
-            "status": {"type": "keyword"}, "price": {"type": "integer"}}}})
+        # replicas 0: this wrapper hot-swaps the PRIMARY engine's segment
+        # list under an already-created index; a replica read copy would
+        # keep serving its pre-swap (empty) checkpoint and the round-robin
+        # would alternate real and empty pages (observed as the
+        # "0-hit every other call" bench artifact)
+        client.indices.create("bench", {
+            "settings": {"number_of_replicas": 0},
+            "mappings": {"properties": {
+                "body": {"type": "text"}, "title": {"type": "text"},
+                "status": {"type": "keyword"},
+                "price": {"type": "integer"}}}})
     eng = client.node.indices["bench"].shards[0]
     eng.segments = [seg]
     client.node.indices["bench"].generation += 1
     return seg
+
+
+def measure_impacts(client, seg, bodies, log, time_share=90.0):
+    """Codec v1 vs v2 A/B on the SAME corpus and query set — the BENCH
+    `extra.impacts` stamp (ISSUE 8 acceptance): per codec, a 32-thread
+    closed loop through the product search path measuring qps, per-query
+    actual bytes gathered (obs/query_cost histogram deltas) and resident
+    postings bytes (device arrays + ledger tenants), plus the codec-v2
+    device block-skip rate. Cells alternate v1/v2/v2/v1 (each codec once
+    early + once late, same box-noise discipline as the recorder gate)
+    and the stamp carries the paired best-of-reps ratio."""
+    import threading
+
+    from opensearch_tpu.obs.hbm_ledger import LEDGER
+    from opensearch_tpu.search import impactpath
+    from opensearch_tpu.utils.metrics import METRICS
+
+    bodies = [dict(b) for b in bodies]
+    for b in bodies:
+        b.pop("_bench", None)
+
+    def cost_hist():
+        h = METRICS.snapshot()["histograms"].get(
+            "cost.bytes_per_query") or {}
+        return h.get("count", 0), h.get("sum_ms", 0.0)
+
+    def postings_resident_bytes():
+        post = seg.device_arrays()["postings"]
+        return int(sum(int(a.nbytes) for f in post.values()
+                       for a in f.values()))
+
+    def closed_loop(nthreads=32):
+        queue = list(range(len(bodies)))
+        lock = threading.Lock()
+        errs = []
+
+        def worker():
+            while True:
+                with lock:
+                    if not queue:
+                        return
+                    i = queue.pop()
+                try:
+                    client.search("bench", bodies[i])
+                except Exception as e:          # noqa: BLE001
+                    errs.append(str(e))
+        t0 = time.time()
+        ts = [threading.Thread(target=worker) for _ in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs[0]
+        return len(bodies) / (time.time() - t0)
+
+    def set_codec(version):
+        if version == 1:
+            seg.drop_impacts()
+        else:
+            seg.build_impacts()
+            seg.drop_device()
+
+    def tag_bodies(tag):
+        # unique per-cell tags: the A/B must measure the serving path,
+        # not the request cache (identical bodies would all hit it)
+        for i, b in enumerate(bodies):
+            b["_bench"] = f"{tag}-{i}"
+
+    cells = {"v1": [], "v2": []}
+    details = {}
+    t_start = time.time()
+    for rep, label in enumerate(("v1", "v2", "v2", "v1")):
+        set_codec(1 if label == "v1" else 2)
+        ip0 = impactpath.stats()
+        tag_bodies(f"impw{label}{rep}")
+        closed_loop(nthreads=8)        # warm: compiles + residency
+        c0, s0 = cost_hist()
+        tag_bodies(f"impm{label}{rep}")
+        qps = closed_loop()
+        c1, s1 = cost_hist()
+        cells[label].append(qps)
+        if label not in details:
+            resident = postings_resident_bytes()
+            tenants = LEDGER.snapshot()["tenants"]
+            ip1 = impactpath.stats()
+            blk_tot = ip1["blocks_total"] - ip0["blocks_total"]
+            blk_skip = ip1["blocks_skipped"] - ip0["blocks_skipped"]
+            details[label] = {
+                "postings_resident_bytes": resident,
+                "ledger_impact_postings_bytes": tenants.get(
+                    "impact_postings", {}).get("bytes", 0),
+                "ledger_block_max_bytes": tenants.get(
+                    "block_max", {}).get("bytes", 0),
+                "mean_bytes_per_query": round(
+                    (s1 - s0) / max(c1 - c0, 1), 1),
+                "block_skip_rate": (round(blk_skip / blk_tot, 4)
+                                    if blk_tot else 0.0),
+                "impact_served": ip1["served"] - ip0["served"],
+                "impact_escalated": (ip1["escalated"]
+                                     - ip0["escalated"]),
+            }
+        if time.time() - t_start > time_share:
+            log("impacts A/B: budget-capped reps")
+            break
+    set_codec(2)                        # leave the index on the default
+    ip = seg.postings["body"].impact
+    out = {
+        "codec_mix": {"v2": 1},
+        "impact_bits": ip.bits,
+        "impact_plane_bytes": int(ip.q.nbytes),
+        "block_sidecar_bytes": int(ip.block_max.nbytes
+                                   + ip.block_off.nbytes
+                                   + ip.block_starts.nbytes),
+        "f32_tf_equivalent_bytes": int(seg.postings["body"].tfs.nbytes),
+        "v1": dict(details.get("v1", {}),
+                   qps_32t=round(max(cells["v1"]), 1) if cells["v1"]
+                   else None,
+                   qps_reps=[round(q, 1) for q in cells["v1"]]),
+        "v2": dict(details.get("v2", {}),
+                   qps_32t=round(max(cells["v2"]), 1) if cells["v2"]
+                   else None,
+                   qps_reps=[round(q, 1) for q in cells["v2"]]),
+    }
+    if cells["v1"] and cells["v2"]:
+        ratio = max(cells["v2"]) / max(max(cells["v1"]), 1e-9)
+        d1, d2 = details.get("v1", {}), details.get("v2", {})
+        out["qps_ratio_v2_over_v1"] = round(ratio, 4)
+        out["gates"] = {
+            "bytes_per_query_down": (d2.get("mean_bytes_per_query", 0)
+                                     < d1.get("mean_bytes_per_query",
+                                              float("inf"))),
+            # resident comparison: the v2 figure already includes the
+            # device impact planes (they live in the postings arrays)
+            "postings_resident_down": (
+                d2.get("postings_resident_bytes", 0)
+                < d1.get("postings_resident_bytes", float("inf"))),
+            "qps_no_worse": ratio >= 0.98,
+            "block_skip_nonzero": d2.get("block_skip_rate", 0.0) > 0.0,
+        }
+    return out
 
 
 def pick_queries(df_per_term, nq: int, seed: int = 1):
@@ -744,6 +899,48 @@ def main():
         _emit_partial("config1r_done")
     else:
         log("config 1r: skipped (budget)")
+
+    # ---- codec v1 vs v2 A/B (ISSUE 8 acceptance artifact): same corpus,
+    # same match query set, 32-thread closed loop per codec — qps,
+    # per-query bytes, resident postings bytes, block-skip rate
+    if remaining() > 60:
+        seg_b = client.node.indices["bench"].shards[0].segments[0]
+        # half the standing mid-frequency match pairs, half SKEWED pairs
+        # (stopword-class + long-tail term): equal-idf pairs are the
+        # block prune's worst case (every block prices alike), skewed
+        # pairs are the classic MaxScore win the sidecar exists for
+        rng_i = np.random.default_rng(17)
+        dford = np.argsort(-df_per_term)
+        stop_pool = dford[:64]
+        # mid-rare pool: df comfortably past the window so the rare
+        # term's posting-level witness prices the stopword blocks out
+        # (df < window terms can't dominate the boundary — no engine
+        # could skip the stopword list there)
+        tail_pool = dford[1000:8000]
+        tail_pool = tail_pool[df_per_term[tail_pool] >= 3 * TOPK]
+        nimp = min(nq, 192)
+
+        def skew_body(i, tag):
+            s = int(stop_pool[i % len(stop_pool)])
+            r = int(tail_pool[int(rng_i.integers(0, len(tail_pool)))])
+            return {"query": {"match": {
+                "body": f"{vocab_strs[s]} {vocab_strs[r]}"}},
+                "size": TOPK, "_bench": tag}
+
+        # tiny/quick corpora can empty the mid-rare pool — fall back to
+        # the plain match stream rather than aborting the bench
+        skew_ok = len(tail_pool) > 0 and len(stop_pool) > 0
+        imp_bodies = [match_body(i, f"imp{i}")
+                      if i % 2 == 0 or not skew_ok
+                      else skew_body(i, f"imp{i}")
+                      for i in range(nimp)]
+        extra["impacts"] = measure_impacts(
+            client, seg_b, imp_bodies, log,
+            time_share=min(120.0, remaining() * 0.35))
+        _emit_partial("impacts_ab_done")
+        log(f"impacts A/B done: {extra['impacts'].get('gates')}")
+    else:
+        log("impacts A/B: skipped (budget)")
 
     # ---- interactive latency (batch-1 is a VERDICT priority) before the
     # optional wide streams, so a timeout still records it

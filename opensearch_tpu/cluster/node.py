@@ -19,6 +19,7 @@ from ..analysis import AnalysisRegistry
 from ..index.engine import Engine
 from ..index.mappings import Mappings
 from ..ingest import IngestService
+from ..search import impactpath
 from ..search.executor import ShardSearcher, msearch_batched, search_shards
 from ..utils.breaker import BreakerService
 from ..obs import flight_recorder as _fr
@@ -289,7 +290,13 @@ class RequestCache:
 
     def put(self, key: tuple, value: dict) -> None:
         if len(self._store) >= self.max_entries:
-            self._store.pop(next(iter(self._store)))
+            try:
+                # concurrent putters can race the same eviction victim
+                # (32-thread closed loops hit this): the loser's pop must
+                # not raise out of the search path
+                self._store.pop(next(iter(self._store)), None)
+            except (StopIteration, RuntimeError):
+                pass  # store emptied/resized underfoot — nothing to evict
         self._store[key] = value
 
     def stats(self) -> dict:
@@ -1057,10 +1064,29 @@ class Node:
                     all_names = list(names) + [
                         f"{a}:{rn}" for a, _n, rns in remote_parts
                         for rn in rns]
-                    resp = search_shards(searchers, body,
-                                         index_name=",".join(all_names),
-                                         task=task, phase_hook=phase_hook,
-                                         phase_ctx=phase_ctx)
+                    # bit-consistency gate: when an SPMD mesh owns this
+                    # node's hot path, OR replica read copies round-robin
+                    # with the primary, a host-loop execution (decline,
+                    # scheduler bypass, degradation, replica pick) must
+                    # stay byte-identical to its XLA-domain siblings —
+                    # the codec-v2 impact ladder serves the host-oracle
+                    # f32 domain instead, so it only engages when this
+                    # node's serving is single-domain
+                    # (search/impactpath.py)
+                    replicated = any(
+                        getattr(self.indices[n], "replica_searchers",
+                                None)
+                        for n in names)
+                    tok = impactpath.mesh_attached_token(
+                        self.mesh_service is not None or replicated)
+                    try:
+                        resp = search_shards(searchers, body,
+                                             index_name=",".join(all_names),
+                                             task=task,
+                                             phase_hook=phase_hook,
+                                             phase_ctx=phase_ctx)
+                    finally:
+                        impactpath.reset_mesh_attached(tok)
         except BaseException as e:
             if _rec.enabled and tl:
                 _rec.record(tl, "search.error", error=type(e).__name__)

@@ -24,6 +24,10 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   `add_estimate`/`release` outside the HBM ledger; `jax.device_put`
   residency in index/search/parallel without a ledger registration in
   the enclosing scope.
+- OSL507 quantized-impact domain discipline (`impact_rules`): u8/u16
+  impact planes enter f32 score math only through the designated
+  dequant helpers; codec-version branches in search/ consult
+  Segment.codec_version and use the named codec constants.
 
 Run via `python scripts/oslint.py [--check]`; tier-1 runs it through
 tests/test_oslint.py. Suppress inline with
@@ -35,6 +39,7 @@ from .breaker_rules import BreakerDisciplineChecker
 from .core import (Baseline, Checker, Finding, default_checkers,
                    load_baseline, run_paths, run_source, write_baseline)
 from .dtype_rules import DtypeDisciplineChecker
+from .impact_rules import ImpactDomainChecker
 from .jit_rules import JitBoundaryChecker
 from .lock_rules import LockDisciplineChecker
 from .memory_rules import MemoryAccountingChecker
@@ -46,4 +51,5 @@ __all__ = [
     "DtypeDisciplineChecker", "JitBoundaryChecker",
     "BreakerDisciplineChecker", "LockDisciplineChecker",
     "DeviceSyncDisciplineChecker", "MemoryAccountingChecker",
+    "ImpactDomainChecker",
 ]

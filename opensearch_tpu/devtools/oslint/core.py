@@ -125,6 +125,7 @@ def _suppressed(f: Finding, sup: Dict[int, Optional[Set[str]]]) -> bool:
 def default_checkers() -> List[Checker]:
     from .breaker_rules import BreakerDisciplineChecker
     from .dtype_rules import DtypeDisciplineChecker
+    from .impact_rules import ImpactDomainChecker
     from .jit_rules import JitBoundaryChecker
     from .lock_rules import LockDisciplineChecker, WaitDisciplineChecker
     from .memory_rules import MemoryAccountingChecker
@@ -135,7 +136,7 @@ def default_checkers() -> List[Checker]:
             BreakerDisciplineChecker(), LockDisciplineChecker(),
             TelemetryDisciplineChecker(), WaitDisciplineChecker(),
             DeviceSyncDisciplineChecker(), RecorderDisciplineChecker(),
-            MemoryAccountingChecker()]
+            MemoryAccountingChecker(), ImpactDomainChecker()]
 
 
 def run_source(src: str, path: str,

@@ -1,0 +1,144 @@
+"""OSL507 — quantized-impact domain discipline (segment codec v2).
+
+The codec-v2 impact planes (index/segment.py `ImpactPlane`) live in a
+QUANTIZED integer domain: u8/u16 values whose only sound route into f32
+score math is the designated dequant helpers
+(`ops/scoring.py dequant_impact` / `dequant_impact_np`). Every ad-hoc
+`astype(float32)` / `float32(...)` promotion of impact data bypasses the
+one place the scale multiply (and therefore the serve-margin error
+bookkeeping, docs/INDEX_FORMAT.md) is defined. Three ways code breaks
+the discipline:
+
+1. **Raw dequantization.** A float cast/constructor applied to an
+   identifier that names impact-plane data (`*impact*`, `*block_max*`)
+   outside the helper definitions.
+2. **Version-blind layout branches.** Code in `search/` that branches on
+   the v2 layout (reads a `.impact` attribute) without consulting
+   `Segment.codec_version` anywhere in the same function: presence
+   checks alone rot when a codec v3 arrives, and the version attribute
+   is the documented gate (the `getattr(pb, "impact", ...)` duck form is
+   exempt — it is the facade-tolerant probe, not a layout branch).
+3. **Magic codec numbers.** Comparing `codec_version` against a bare int
+   literal instead of the named `CODEC_V1`/`CODEC_V2` constants.
+
+Suppress deliberate exceptions with
+`# oslint: disable=OSL507 -- <why the domain/gate is sound>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+# the helper definitions themselves (and their fixtures) may touch the
+# quantized domain directly
+_HELPER_FILES = ("ops/scoring.py",)
+_IMPACT_TOKENS = ("impact", "block_max")
+_FLOAT_CTORS = {"float32", "float64", "float16", "bfloat16", "float"}
+_SCOPES = ("opensearch_tpu/search/", "opensearch_tpu/ops/",
+           "opensearch_tpu/index/", "opensearch_tpu/parallel/")
+
+
+def _impactish(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _IMPACT_TOKENS)
+
+
+def _expr_name(node: ast.AST) -> str:
+    """Best-effort name of the value being cast ('plane.block_max',
+    'impacts', ...)."""
+    d = _dotted(node)
+    if d:
+        return d
+    if isinstance(node, ast.Subscript):
+        return _expr_name(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+class ImpactDomainChecker(Checker):
+    rules = ("OSL507",)
+    name = "impact-domain"
+
+    def applies(self, path: str) -> bool:
+        return any(s in path for s in _SCOPES) and "devtools" not in path
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        helper_file = any(path.endswith(h) for h in _HELPER_FILES)
+
+        # ---- rule 1: raw float promotion of impact-plane data ----
+        if not helper_file:
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = None
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args:
+                    dt = _dotted(node.args[0]).rsplit(".", 1)[-1]
+                    if dt in _FLOAT_CTORS:
+                        target = _expr_name(node.func.value)
+                else:
+                    fn = _dotted(node.func).rsplit(".", 1)[-1]
+                    if fn in _FLOAT_CTORS and node.args:
+                        target = _expr_name(node.args[0])
+                if target and _impactish(target):
+                    findings.append(Finding(
+                        "OSL507", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        f"raw float promotion of quantized impact data "
+                        f"(`{target}`); route through the designated "
+                        "dequant helpers (ops/scoring.py dequant_impact /"
+                        " dequant_impact_np) so the scale multiply and "
+                        "the serve-margin error bookkeeping stay in one "
+                        "place", detail=f"dequant:{target}"))
+
+        # ---- rules 2+3: codec-version gate discipline ----
+        in_search = "opensearch_tpu/search/" in path
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            mentions_codec = False
+            layout_reads: List[ast.Attribute] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute) \
+                        and node.attr == "codec_version":
+                    mentions_codec = True
+                elif isinstance(node, ast.Constant) \
+                        and node.value == "codec_version":
+                    mentions_codec = True   # getattr(seg, "codec_version")
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr == "impact":
+                    layout_reads.append(node)
+            if in_search and layout_reads and not mentions_codec:
+                n = layout_reads[0]
+                findings.append(Finding(
+                    "OSL507", path, n.lineno, n.col_offset,
+                    qmap.get(n, ""),
+                    "codec-v2 layout branch (reads `.impact`) without "
+                    "consulting Segment.codec_version in the same "
+                    "function — the version attribute is the documented "
+                    "gate (plane presence alone rots at the next codec "
+                    "rev)", detail="version-blind"))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides = [node.left] + list(node.comparators)
+            has_codec = any(isinstance(s, ast.Attribute)
+                            and s.attr == "codec_version" for s in sides)
+            lit = any(isinstance(s, ast.Constant)
+                      and isinstance(s.value, int)
+                      and not isinstance(s.value, bool) for s in sides)
+            if has_codec and lit:
+                findings.append(Finding(
+                    "OSL507", path, node.lineno, node.col_offset,
+                    qmap.get(node, ""),
+                    "codec_version compared against a bare int literal; "
+                    "use the named constants (index/segment.py "
+                    "CODEC_V1/CODEC_V2)", detail="magic-codec"))
+        return findings

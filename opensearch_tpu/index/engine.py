@@ -266,6 +266,17 @@ class Engine:
 
     # ---------------- index-wide stats for scoring ----------------
 
+    def codec_mix(self) -> Dict[int, int]:
+        """Live segments per codec version — the serving tier can carry a
+        mixed v1/v2 set indefinitely (v1 loads untouched; refresh/merge
+        emit the process default). Surfaced in bench `extra.impacts` and
+        scripts/hbm_report.py."""
+        mix: Dict[int, int] = {}
+        for s in self.segments:
+            v = int(getattr(s, "codec_version", 1))
+            mix[v] = mix.get(v, 0) + 1
+        return mix
+
     def field_stats(self, field: str):
         """Index-wide (doc_count, sum_dl, total_docs) for BM25 avgdl/idf —
         the analog of Lucene CollectionStatistics aggregated across leaves."""

@@ -10,8 +10,9 @@ from typing import Dict, List
 import numpy as np
 
 from ..ops import device_merge
-from .segment import (GeoColumn, KeywordColumn, NumericColumn, PostingsBlock, Segment,
-                      TextFieldStats, VectorColumn)
+from .segment import (CODEC_V2, GeoColumn, KeywordColumn, NumericColumn,
+                      PostingsBlock, Segment, TextFieldStats, VectorColumn,
+                      default_codec_version)
 
 
 class TieredMergePolicy:
@@ -281,6 +282,16 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
                      shape_cols=shape_cols,
                      stored_vals=stored_vals if any_stored else None)
     merged.term_vectors = term_vectors if tv_fields else None
+    # codec propagation: merges emit the PROCESS-DEFAULT codec — they are
+    # the natural rebuild point for the format rev (a v1+v2 merge
+    # upgrades the v1 half; under the OPENSEARCH_TPU_CODEC=1 rollback pin
+    # every merge demotes to v1, so the index converges back). Impacts
+    # are REBUILT from the merged tf/doc-len planes (the merged field's
+    # avgdl differs from every input's, so carried quantized values would
+    # bake a stale norm); the O(P) quantize map itself runs on device
+    # past the size threshold (ops/device_merge.quantize_impacts).
+    if default_codec_version() >= CODEC_V2:
+        merged.build_impacts()
     return merged
 
 

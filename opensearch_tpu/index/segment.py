@@ -25,6 +25,7 @@ TPU-first instead of disk-first:
 from __future__ import annotations
 
 import json
+import math
 import os
 from dataclasses import dataclass, field as dc_field
 from functools import cached_property
@@ -35,6 +36,168 @@ import numpy as np
 from .mappings import FLOAT_TYPES, GEO_TYPES, FieldType, Mappings
 
 INT32_SENTINEL = np.int32(2**31 - 1)  # padded doc_id -> dropped by scatter
+
+# ---------------------------------------------------------------------
+# segment codec versions (docs/INDEX_FORMAT.md)
+# ---------------------------------------------------------------------
+#
+# v1: CSR postings carry (doc_id i32, tf f32); every query re-derives the
+#     BM25 tf-saturation from tf + the doc-length column on the device.
+# v2: additionally carries a per-field *impact plane*: the BM25
+#     tf-saturation tf/(tf + k1·(1-b+b·dl/avgdl)) pre-evaluated at build
+#     time under nominal similarity params and quantized to u8/u16 with
+#     ONE global per-field scale (BM25S-style eager scoring, arxiv
+#     2407.03618), plus a per-128-posting block-max sidecar enabling
+#     MaxScore/block-max pruning (GPUSparse, arxiv 2606.26441). The query
+#     hot path becomes gather -> scatter-add over integer impacts with no
+#     per-query tf/doclen math (search/impactpath.py); exactness vs the
+#     f32 oracle is re-established by a certify-or-escalate ladder whose
+#     margin folds in the quantization error (ImpactPlane.quant_err).
+#     v1 segments still load and serve — the codec is version-gated
+#     everywhere (oslint OSL507: consult Segment.codec_version).
+CODEC_V1 = 1
+CODEC_V2 = 2
+IMPACT_BLOCK = 128        # postings per block-max sidecar entry
+IMPACT_K1 = 1.2           # nominal build-time similarity params; query-time
+IMPACT_B = 0.75           # drift is bounded by ImpactPlane.drift_bound
+
+
+def default_codec_version() -> int:
+    """Codec for NEW segments (refresh/merge). OPENSEARCH_TPU_CODEC=1
+    pins the legacy tf-only format (compat tests, rollback)."""
+    return CODEC_V1 if os.environ.get("OPENSEARCH_TPU_CODEC") == "1" \
+        else CODEC_V2
+
+
+def default_impact_bits() -> int:
+    """Impact quantization width: 16 (default, error ~scale/2^17) or 8
+    via OPENSEARCH_TPU_IMPACT_BITS=8 (half the plane bytes; the wider
+    error folds into the same serve margin)."""
+    return 8 if os.environ.get("OPENSEARCH_TPU_IMPACT_BITS") == "8" else 16
+
+
+@dataclass
+class ImpactPlane:
+    """Quantized eager BM25 impacts for one field's CSR postings (codec
+    v2). `q[i]` dequantizes through the designated helpers
+    (ops/scoring.py `dequant_impact`/`dequant_impact_np`, oslint OSL507)
+    to `q[i] * scale` ~= tf_i/(tf_i + k1·(1-b+b·dl_i/avgdl)) evaluated at
+    the BUILD-time nominal (k1, b, avgdl). The block sidecar stores, per
+    IMPACT_BLOCK-posting run of each row, the max quantized impact — an
+    exact upper bound in the quantized domain, so host/device pruning
+    decisions against it carry no extra error term."""
+
+    q: np.ndarray             # u8/u16[P] quantized impacts, CSR-flat
+    scale: float              # dequant scale: impact ~= q * scale
+    bits: int                 # 8 | 16
+    k1: float                 # build-time nominal similarity params
+    b: float
+    avgdl: float
+    dl_max: int               # max doc length seen (drift bound input)
+    block_starts: np.ndarray  # i64[nterms+1] block-CSR row pointers
+    block_off: np.ndarray     # i64[nblocks] flat element start per block
+    block_max: np.ndarray     # u8/u16[nblocks] max q per block
+
+    @property
+    def qmax(self) -> int:
+        return (1 << self.bits) - 1
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.q.nbytes + self.block_max.nbytes
+                   + self.block_off.nbytes + self.block_starts.nbytes)
+
+    def quant_err(self) -> float:
+        """Sound per-posting |exact f32 impact − q·scale| bound at the
+        BUILD params: half a quantization step plus f32 slack for the
+        dequant multiply."""
+        top = np.float32(self.scale) * np.float32(self.qmax)
+        return float(self.scale) * 0.5 + 2.0 * float(np.spacing(top))
+
+    def drift_bound(self, k1q: float, bq: float, avgdlq: float) -> float:
+        """Sound bound on |f_query − f_build| per posting when query-time
+        (k1, b, avgdl) differ from the baked build params: with
+        k(dl) = k1·(1-b+b·dl/avgdl) linear in dl, Δk is maximized at a dl
+        endpoint, and tf/((tf+ka)(tf+kb)) ≤ 1/(√ka+√kb)² (or its tf=1
+        value when the unconstrained max lies below tf=1)."""
+        if (float(k1q) == float(self.k1) and float(bq) == float(self.b)
+                and float(avgdlq) == float(self.avgdl)):
+            return 0.0
+
+        def k_of(dl, k1, b, avg):
+            return k1 * (1.0 - b + b * dl / max(avg, 1e-9))
+
+        dk = max(abs(k_of(0.0, k1q, bq, avgdlq)
+                     - k_of(0.0, self.k1, self.b, self.avgdl)),
+                 abs(k_of(float(self.dl_max), k1q, bq, avgdlq)
+                     - k_of(float(self.dl_max), self.k1, self.b,
+                            self.avgdl)))
+        ka = max(k_of(0.0, k1q, bq, avgdlq), 0.0)
+        kb = max(k_of(0.0, self.k1, self.b, self.avgdl), 0.0)
+        if ka * kb >= 1.0:
+            g = 1.0 / (math.sqrt(ka) + math.sqrt(kb)) ** 2
+        else:
+            g = 1.0 / ((1.0 + ka) * (1.0 + kb))
+        return min(dk * g, 1.0)
+
+    def row_block_range(self, row: int) -> Tuple[int, int]:
+        return int(self.block_starts[row]), int(self.block_starts[row + 1])
+
+
+def build_impact_plane(pb: "PostingsBlock", dl: Optional[np.ndarray],
+                       avgdl: Optional[float] = None,
+                       bits: Optional[int] = None) -> Optional[ImpactPlane]:
+    """Quantize one field's eager impacts + block-max sidecar (the codec
+    v2 build step, shared by refresh, merge and direct corpus wrappers).
+    The f32 expression mirrors the host oracle's per-posting arithmetic
+    (search/fastpath.py `_exact_rescore`) so the quantization-error bound
+    is measured against the exact serve domain."""
+    if pb.size == 0:
+        return None
+    bits = default_impact_bits() if bits is None else int(bits)
+    tfs = pb.tfs.astype(np.float32)
+    if dl is not None:
+        dl_of = dl[pb.doc_ids].astype(np.float32)
+        dl_max = int(dl.max()) if len(dl) else 0
+    else:
+        dl_of = np.zeros(pb.size, np.float32)
+        dl_max = 0
+    if avgdl is None:
+        pos = dl_of[dl_of > 0]
+        avgdl = float(pos.mean()) if len(pos) else 1.0
+    avgdl = max(float(avgdl), 1e-9)
+    from ..ops.device_merge import quantize_impacts, use_device_impacts
+    qmax = (1 << bits) - 1
+    if use_device_impacts(pb.size):
+        q32, scale = quantize_impacts(tfs, dl_of, IMPACT_K1, IMPACT_B,
+                                      avgdl, qmax)
+        q = q32.astype(np.uint8 if bits == 8 else np.uint16)
+    else:
+        kfac = IMPACT_K1 * (1.0 - IMPACT_B + IMPACT_B * dl_of / avgdl)
+        imp = tfs / (tfs + kfac)
+        m = float(imp.max()) if len(imp) else 0.0
+        scale = (m / qmax) if m > 0 else 1.0
+        q = np.minimum(np.round(imp / np.float32(scale)), qmax).astype(
+            np.uint8 if bits == 8 else np.uint16)
+    lens = np.diff(pb.starts)
+    nblk = -(-lens // IMPACT_BLOCK)           # ceil; empty rows -> 0 blocks
+    block_starts = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(nblk, out=block_starts[1:])
+    nblocks = int(block_starts[-1])
+    if nblocks:
+        # flat element offset of each block: row start + j*IMPACT_BLOCK
+        row_of_blk = np.repeat(np.arange(len(lens), dtype=np.int64), nblk)
+        j = np.arange(nblocks, dtype=np.int64) - block_starts[row_of_blk]
+        block_off = pb.starts[row_of_blk].astype(np.int64) \
+            + j * IMPACT_BLOCK
+        block_max = np.maximum.reduceat(q, block_off)
+    else:
+        block_off = np.zeros(0, np.int64)
+        block_max = np.zeros(0, q.dtype)
+    return ImpactPlane(q=q, scale=float(scale), bits=bits,
+                       k1=IMPACT_K1, b=IMPACT_B, avgdl=float(avgdl),
+                       dl_max=dl_max, block_starts=block_starts,
+                       block_off=block_off, block_max=block_max)
 
 # memory accounting for the per-segment DEVICE column cache
 # (`device_arrays` HBM residency) goes through the HBM ledger
@@ -108,6 +271,9 @@ class PostingsBlock:
     # optional positional data: pos_starts aligned with postings flat index
     pos_starts: Optional[np.ndarray] = None   # i64[P+1]
     positions: Optional[np.ndarray] = None    # i32[total_positions]
+    # codec v2: quantized eager impacts + block-max sidecar (None on v1
+    # segments and non-text planes — consumers must version-gate)
+    impact: Optional[ImpactPlane] = None
 
     @property
     def nterms(self) -> int:
@@ -307,7 +473,8 @@ class Segment:
                  vector_cols: Optional[Dict[str, VectorColumn]] = None,
                  nested: Optional[Dict[str, NestedBlock]] = None,
                  shape_cols: Optional[Dict[str, ShapeColumn]] = None,
-                 stored_vals: Optional[list] = None):
+                 stored_vals: Optional[list] = None,
+                 codec_version: int = CODEC_V1):
         Segment._seq += 1
         self.uid = Segment._seq  # stable identity (id() can be reused post-GC)
         self.name = name
@@ -337,6 +504,43 @@ class Segment:
         # (segment replication, reference indices/replication/)
         self._device_cache: Dict[Any, dict] = {}
         self._device_live_dirty: Dict[Any, bool] = {}
+        # segment codec (CODEC_V1 | CODEC_V2): consumers branching on the
+        # posting layout consult this attribute (oslint OSL507)
+        self.codec_version = int(codec_version)
+        # v2 fields whose f32 tf plane has been promoted back onto the
+        # device (exact-scoring programs on codec-v2 segments request it
+        # lazily via ensure_device_tfs; the hot impact path never does)
+        self._tf_promoted: set = set()
+
+    # ---------------- codec v2: impact planes ----------------
+
+    def build_impacts(self, bits: Optional[int] = None) -> None:
+        """Build quantized impact planes for every text-scored field
+        (fields with a doc-length column) and stamp the segment codec v2.
+        Idempotent; used by build_segment/merge and by direct CSR corpus
+        wrappers (bench.py, scripts/hbm_report.py)."""
+        for f, pb in self.postings.items():
+            if pb.impact is not None or f not in self.doc_lens:
+                continue
+            st = self.text_stats.get(f)
+            avgdl = (st.sum_dl / st.doc_count
+                     if st is not None and st.doc_count > 0 else None)
+            pb.impact = build_impact_plane(pb, self.doc_lens.get(f),
+                                           avgdl=avgdl, bits=bits)
+        for blk in self.nested.values():
+            blk.child.build_impacts(bits=bits)
+        self.codec_version = CODEC_V2
+
+    def drop_impacts(self) -> None:
+        """Demote to codec v1 (compat/ablation path): planes dropped,
+        device residency rebuilt with the tf plane on next use."""
+        for pb in self.postings.values():
+            pb.impact = None
+        for blk in self.nested.values():
+            blk.child.drop_impacts()
+        self.codec_version = CODEC_V1
+        self._tf_promoted = set()
+        self.drop_device()
 
     # ---------------- live docs / deletes ----------------
 
@@ -400,7 +604,9 @@ class Segment:
         if device is not None:
             jnp = _DevicePut(device)  # route jnp.asarray onto the device
         dpad = self.ndocs_pad
-        post = {f: _post_field_arrays(pb, jnp)
+        post = {f: _post_field_arrays(
+                    pb, jnp,
+                    with_tfs=(pb.impact is None or f in self._tf_promoted))
                 for f, pb in self.postings.items()}
         ncols = {f: _num_field_arrays(col, dpad, jnp)
                  for f, col in self.numeric_cols.items()}
@@ -456,23 +662,50 @@ class Segment:
         # above, the per-path "parent" maps, and the live plane
         # (constant size across dirty rebuilds). The nested children's
         # own arrays are registered by their recursive device_arrays()
-        # calls — counting them here would double-bill.
+        # calls — counting them here would double-bill. Codec v2 splits
+        # the quantized impact planes out into their own `impact_postings`
+        # tenant (and the host block-max sidecar into an advisory
+        # `block_max` tenant) so the format rev's footprint delta is a
+        # first-class ledger observable.
+        imp_bytes = sum(int(fa["impacts"].nbytes)
+                        for fa in post.values() if "impacts" in fa)
         nbytes = sum(_tree_nbytes(self._device_cache[key][g])
                      for g in ("postings", "numeric", "keyword",
                                "geo", "vector", "doc_lens"))
+        nbytes -= imp_bytes
         nbytes += sum(int(c["parent"].nbytes)
                       for c in nst.values())
         nbytes += self.ndocs_pad * 4          # live plane (f32)
+        allocs = []
         try:
-            alloc = LEDGER.register(
+            allocs.append(LEDGER.register(
                 "segment_columns", nbytes, owner=self, segment=self,
-                device=key, label=f"segment-device[{self.name}]")
+                device=key, label=f"segment-device[{self.name}]"))
+            if imp_bytes:
+                allocs.append(LEDGER.register(
+                    "impact_postings", imp_bytes, owner=self, segment=self,
+                    device=key, label=f"segment-impacts[{self.name}]"))
+                sidecar = sum(pb.impact.block_max.nbytes
+                              + pb.impact.block_off.nbytes
+                              + pb.impact.block_starts.nbytes
+                              for pb in self.postings.values()
+                              if pb.impact is not None)
+                # the sidecar is HOST-resident plan metadata (the XLA
+                # prune selects blocks before launch); advisory so the
+                # byte is visible per tenant without billing the breaker
+                allocs.append(LEDGER.register(
+                    "block_max", sidecar, owner=self, segment=self,
+                    device=key, charge=False,
+                    label=f"segment-blockmax[{self.name}]"))
         except Exception:
-            # tripped: drop the uncharged entry so a later retry
-            # re-attempts the charge instead of serving for free
+            # tripped mid-way: roll back what was charged and drop the
+            # entry so a later retry re-attempts instead of serving free
+            for a in allocs:
+                LEDGER.release(a)
             del self._device_cache[key]
             raise
-        self.__dict__.setdefault("_hbm_allocs", {})[key] = alloc
+        self.__dict__.setdefault("_hbm_allocs", {}).setdefault(
+            key, []).extend(allocs)
         # full-residency promotion: the partial per-field arrays this
         # device key accumulated via pruned_arrays() are now redundant —
         # the full pytree supersedes them (pruned_arrays serves from it
@@ -488,6 +721,44 @@ class Segment:
             for ck in [c for c in fallocs if c[0] == key]:
                 LEDGER.release(fallocs.pop(ck))
         self._device_live_dirty[key] = True
+
+    def ensure_device_tfs(self, field: str, device=None) -> None:
+        """Promote the f32 tf plane of one codec-v2 field back onto the
+        device. The v2 layout ships (doc_ids, quantized impacts) only —
+        the BM25 hot path never touches tf — but exact-scoring program
+        variants (non-BM25 similarities, combined_fields BM25F, the
+        impact ladder's dense escalation) still need it. Called at
+        prepare time (host side, before any launch); one upload per
+        (segment, field), every current and future device key included."""
+        pb = self.postings.get(field)
+        if pb is None or pb.impact is None or field in self._tf_promoted:
+            return
+        import jax
+        import jax.numpy as _jnp
+        from ..obs.hbm_ledger import LEDGER
+        lock = self.__dict__.setdefault(
+            "_device_build_lock", __import__("threading").RLock())
+        with lock:
+            if field in self._tf_promoted:
+                return
+            ppad = next_pow2(pb.size)
+            tf_host = _pad_to(pb.tfs.astype(np.float32), ppad,
+                              np.float32(0))
+            for key, cache in self._device_cache.items():
+                fa = cache["postings"].get(field)
+                if fa is None or "tfs" in fa:
+                    continue
+                arr = (_jnp.asarray(tf_host) if key is None
+                       else jax.device_put(tf_host, key))
+                alloc = LEDGER.register(
+                    "postings_tfs", int(arr.nbytes), owner=self,
+                    segment=self, device=key,
+                    label=f"segment-tfs[{self.name}][{field}]")
+                fa["tfs"] = arr
+                self.__dict__.setdefault("_hbm_allocs", {}).setdefault(
+                    key, []).append(alloc)
+            # future device builds include the plane from the start
+            self._tf_promoted.add(field)
 
     def pruned_arrays(self, device, needs: Dict[str, set]) -> dict:
         """Device arrays for ONLY the named fields — the filter-mask path
@@ -544,8 +815,11 @@ class Segment:
         for f in needs.get("postings", ()):
             pb = self.postings.get(f)
             if pb is not None:
+                # filter-mask views never score: no tf plane (v1 fields
+                # keep it — their layout has nothing else) and no impacts
                 out["postings"][f] = field(
-                    "postings", f, lambda pb=pb: _post_field_arrays(pb, jnp))
+                    "postings", f, lambda pb=pb: _post_field_arrays(
+                        pb, jnp, with_tfs=False, with_impacts=False))
         for f in needs.get("numeric", ()):
             col = self.numeric_cols.get(f)
             if col is not None:
@@ -594,8 +868,9 @@ class Segment:
         self.__dict__.pop("_field_device_cache", None)
         # eager release: the arrays are gone NOW, so the ledger (and the
         # derived breaker charge) must not wait for the segment's GC
-        for alloc in self.__dict__.pop("_hbm_allocs", {}).values():
-            LEDGER.release(alloc)
+        for allocs in self.__dict__.pop("_hbm_allocs", {}).values():
+            for alloc in allocs:
+                LEDGER.release(alloc)
         for alloc in self.__dict__.pop("_field_device_allocs", {}).values():
             LEDGER.release(alloc)
         for blk in self.nested.values():
@@ -607,7 +882,9 @@ class Segment:
         os.makedirs(path, exist_ok=True)
         arrays: Dict[str, np.ndarray] = {"live": self.live, "seq_nos": self.seq_nos}
         meta: Dict[str, Any] = {"name": self.name, "ndocs": self.ndocs,
+                                "codec": self.codec_version,
                                 "postings": {}, "numeric": {}, "keyword": {}, "geo": {},
+                                "impacts": {},
                                 "text_stats": {f: [s.doc_count, s.sum_dl]
                                                for f, s in self.text_stats.items()}}
         derived = self.__dict__.get("_derived_names", set())
@@ -621,6 +898,16 @@ class Segment:
             if pb.pos_starts is not None:
                 arrays[f"{key}__pos_starts"] = pb.pos_starts
                 arrays[f"{key}__positions"] = pb.positions
+            if pb.impact is not None:
+                ip = pb.impact
+                arrays[f"imp__{f}__q"] = ip.q
+                arrays[f"imp__{f}__bstarts"] = ip.block_starts
+                arrays[f"imp__{f}__boff"] = ip.block_off
+                arrays[f"imp__{f}__bmax"] = ip.block_max
+                meta["impacts"][f] = {"scale": ip.scale, "bits": ip.bits,
+                                      "k1": ip.k1, "b": ip.b,
+                                      "avgdl": ip.avgdl,
+                                      "dl_max": ip.dl_max}
             meta["postings"][f] = {"vocab_file": True, "positional": pb.pos_starts is not None}
             with open(os.path.join(path, f"vocab__{f.replace('/', '_')}.txt"), "w") as fh:
                 fh.write("\n".join(pb.vocab))
@@ -707,6 +994,16 @@ class Segment:
                 tfs=arrays[f"{key}__tfs"],
                 pos_starts=arrays.get(f"{key}__pos_starts"),
                 positions=arrays.get(f"{key}__positions"))
+            im = meta.get("impacts", {}).get(f)
+            if im is not None:
+                postings[f].impact = ImpactPlane(
+                    q=arrays[f"imp__{f}__q"], scale=float(im["scale"]),
+                    bits=int(im["bits"]), k1=float(im["k1"]),
+                    b=float(im["b"]), avgdl=float(im["avgdl"]),
+                    dl_max=int(im["dl_max"]),
+                    block_starts=arrays[f"imp__{f}__bstarts"],
+                    block_off=arrays[f"imp__{f}__boff"],
+                    block_max=arrays[f"imp__{f}__bmax"])
         numeric = {f: NumericColumn(f, m["kind"], arrays[f"num__{f}__values"],
                                     arrays[f"num__{f}__present"])
                    for f, m in meta["numeric"].items()}
@@ -744,7 +1041,10 @@ class Segment:
                   {f: TextFieldStats(dc, sd) for f, (dc, sd) in meta["text_stats"].items()},
                   ids, sources, seq_nos=arrays["seq_nos"], vector_cols=vectors,
                   nested=nested, shape_cols=shapes,
-                  stored_vals=stored_vals if any_stored else None)
+                  stored_vals=stored_vals if any_stored else None,
+                  # pre-rev metas carry no codec entry: those are v1
+                  # segments and keep serving unchanged
+                  codec_version=int(meta.get("codec", CODEC_V1)))
         seg.live = arrays["live"].copy()
         seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
         tv_path = os.path.join(path, "term_vectors.json")
@@ -758,15 +1058,27 @@ class Segment:
         return seg
 
 
-def _post_field_arrays(pb: "PostingsBlock", jnp) -> dict:
+def _post_field_arrays(pb: "PostingsBlock", jnp, with_tfs: bool = True,
+                       with_impacts: bool = True) -> dict:
+    """Device arrays of one CSR postings field. Codec v2 fields ship the
+    quantized impact plane instead of the f32 tf plane (callers decide
+    via `with_tfs`; exact-scoring programs promote tf back lazily through
+    Segment.ensure_device_tfs) — the resident postings bytes per slot drop
+    from 8 (doc+tf) to 5/6 (doc+u8/u16 impact)."""
     ppad = next_pow2(pb.size)
     rpad = next_pow2(pb.nterms + 2)
     starts = _pad_to(pb.starts.astype(np.int32), rpad, np.int32(pb.size))
-    return {
+    out = {
         "starts": jnp.asarray(starts),
         "doc_ids": jnp.asarray(_pad_to(pb.doc_ids.astype(np.int32), ppad, INT32_SENTINEL)),
-        "tfs": jnp.asarray(_pad_to(pb.tfs.astype(np.float32), ppad, np.float32(0))),
     }
+    if with_tfs or pb.impact is None:
+        out["tfs"] = jnp.asarray(
+            _pad_to(pb.tfs.astype(np.float32), ppad, np.float32(0)))
+    if with_impacts and pb.impact is not None:
+        out["impacts"] = jnp.asarray(
+            _pad_to(pb.impact.q, ppad, pb.impact.q.dtype.type(0)))
+    return out
 
 
 def _num_field_arrays(col: "NumericColumn", dpad: int, jnp) -> dict:
@@ -1113,6 +1425,10 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                   doc_lens, text_stats, ids, sources, seq_nos=seq,
                   vector_cols=vector_cols, nested=nested,
                   shape_cols=shape_cols, stored_vals=stored_vals)
+    if default_codec_version() >= CODEC_V2:
+        # codec v2: eager quantized impacts + block-max sidecar per
+        # text-scored field (nested children recurse in build_impacts)
+        seg.build_impacts()
     # term_vector=with_positions_offsets fields: per-doc (term, pos, start,
     # end) for the FVH path (host-only, like _source)
     seg.term_vectors = term_vectors

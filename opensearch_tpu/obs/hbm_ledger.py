@@ -65,6 +65,9 @@ __all__ = ["Allocation", "HBMLedger", "LEDGER"]
 # strings are accepted, but the known kinds keep dashboards stable
 KINDS = (
     "segment_columns",      # Segment.device_arrays full pytree
+    "impact_postings",      # codec-v2 quantized impact planes (u8/u16)
+    "block_max",            # codec-v2 block-max sidecars (host, advisory)
+    "postings_tfs",         # f32 tf planes promoted back onto v2 segments
     "partial_columns",      # Segment.pruned_arrays per-field arrays
     "aligned_postings",     # fastpath AlignedPostings (docs + packed tfdl)
     "filtered_postings",    # filter-specialized aligned copies

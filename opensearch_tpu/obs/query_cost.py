@@ -11,17 +11,21 @@ Model (documented in docs/OBSERVABILITY.md):
 
 - **Predicted, at plan time, from CSR block stats only.** For each scoring
   term group the query touches in a segment, every term row contributes
-  its true posting count `df`; a posting slot is 8 bytes (doc_id i32 +
-  tf/packed-tfdl f32/i32 — both storage layouts pay the same pair).
-  `predicted_bytes_gathered = Σ df × 8`, `predicted_scatter_adds = Σ df`,
-  `predicted_topk_work = window` per planned segment.
+  its true posting count `df`; a codec-v1 posting slot is 8 bytes
+  (doc_id i32 + tf/packed-tfdl f32/i32), a codec-v2 eager slot is
+  `4 + bits/8` bytes (doc_id i32 + u8/u16 quantized impact — the
+  executor's `_cost_predicted` consults the segment codec per field).
+  `predicted_bytes_gathered = Σ df × slot`, `predicted_scatter_adds =
+  Σ df`, `predicted_topk_work = window` per planned segment.
 - **Actual, from launched program shapes.** The programs gather PADDED
   shapes: the XLA path flattens a term group into a pow2 `bucket`
   (`ops.pick_bucket`), so it moves `bucket × 8` bytes and scatter-adds
-  `bucket` slots; the fastpath kernel DMAs per-term lane-aligned windows
+  `bucket` slots; the codec-v2 impact pass (search/impactpath.py, path
+  "impact") moves `bucket × (4 + bits/8)` bytes over its block-pruned
+  windows; the fastpath kernel DMAs per-term lane-aligned windows
   (`nrows × LANES` slots of 8 bytes) and extracts `K` top-k lanes per
   kernel row. The predicted/actual gap is therefore exactly the padding +
-  alignment tax — the first number impact quantization will shrink.
+  alignment tax.
 
 An accumulator rides a contextvar for the duration of one
 `executor.search_shards` call (the host shard loop + fastpath ladder; the

@@ -69,3 +69,55 @@ def use_device_merge(total_postings: int) -> bool:
     if os.environ.get("OPENSEARCH_TPU_NO_DEVICE_MERGE"):
         return False
     return total_postings >= DEVICE_MERGE_MIN
+
+
+# ---------------------------------------------------------------------
+# codec v2: device-side impact quantization (index/refresh/merge time)
+# ---------------------------------------------------------------------
+#
+# The eager-impact build (index/segment.py build_impact_plane) is an O(P)
+# dense map — exactly the shape the device does at HBM bandwidth while the
+# host packer is busy. The f32 expression mirrors the host oracle
+# (fastpath._exact_rescore) so the quantization-error bound measured
+# against the exact serve domain holds for either build path; the plane
+# only steers candidate selection and prune bounds, so host/device build
+# parity is a quality property, not a correctness requirement (the
+# impact ladder's certify-or-escalate rungs keep served pages oracle-
+# exact regardless — see docs/INDEX_FORMAT.md).
+
+DEVICE_IMPACT_MIN = 1 << 16
+
+
+@partial(jax.jit, static_argnames=("k1", "b", "qmax"))
+def _quantize_impacts(tfs, dl_of, avgdl, k1: float, b: float, qmax: int):
+    kfac = k1 * (1.0 - b + b * dl_of / avgdl)
+    imp = tfs / (tfs + kfac)
+    m = jnp.max(imp, initial=jnp.float32(0.0))
+    scale = jnp.where(m > 0, m / qmax, 1.0).astype(jnp.float32)
+    q = jnp.minimum(jnp.round(imp / scale), qmax).astype(jnp.int32)
+    return q, scale
+
+
+def quantize_impacts(tfs: np.ndarray, dl_of: np.ndarray, k1: float,
+                     b: float, avgdl: float, qmax: int
+                     ) -> Tuple[np.ndarray, float]:
+    """-> (q i32[P], scale): quantized eager impacts computed on device.
+    Shapes are pow2-padded (tf=0 padding quantizes to 0) so segment sizes
+    don't storm the jit cache."""
+    n = len(tfs)
+    pad = 1 << int(np.ceil(np.log2(max(n, 2))))
+    tfs_p = np.zeros(pad, np.float32)
+    tfs_p[:n] = tfs
+    dl_p = np.zeros(pad, np.float32)
+    dl_p[:n] = dl_of
+    q, scale = _quantize_impacts(tfs_p, dl_p,
+                                 np.float32(max(avgdl, 1e-9)),
+                                 float(k1), float(b), int(qmax))
+    return np.asarray(q)[:n], float(np.asarray(scale))
+
+
+def use_device_impacts(total_postings: int) -> bool:
+    import os
+    if os.environ.get("OPENSEARCH_TPU_NO_DEVICE_MERGE"):
+        return False
+    return total_postings >= DEVICE_IMPACT_MIN

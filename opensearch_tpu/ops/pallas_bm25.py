@@ -795,6 +795,200 @@ def fused_bm25_bool_topk(docs_hbm: jnp.ndarray, tfdl_hbm: jnp.ndarray,
     return scores, doc_ids, totals
 
 
+# ---------------------------------------------------------------------
+# codec-v2 variant: quantized eager impacts (BM25S), no per-posting math
+# ---------------------------------------------------------------------
+#
+# The tfdl kernel spends VPU work per posting on the BM25 saturation
+# (shift/mask decode + div) and needs avgdl/k1/b per query. With codec v2
+# (index/segment.py ImpactPlane) the saturation was evaluated at index
+# time: the posting payload is the quantized impact held in an i32 lane
+# (the HBM 1D tiling is i32-granular; the u8/u16 density win belongs to
+# the XLA path's resident planes), and the per-posting math collapses to
+# ONE multiply by a weight that folds idf·boost·scale. Block-max skipping
+# happens where the DMA windows are planned: the HOST prices each
+# IMPACT_BLOCK run off the plane's block-max sidecar (exact in the
+# quantized domain) and passes only the kept, compacted windows through
+# rowstarts/nrows/lens/skips — a skipped block never leaves HBM, the same
+# contract as the impact-ordered head regions. Exactness of served pages
+# stays with the fastpath verify ladder: results of this kernel are
+# candidate partials whose certification must add the caller's
+# quantization-error margin (ImpactPlane.quant_err/drift_bound) to the
+# unseen-doc bound.
+
+
+def _bm25_impact_kernel(T: int, L: int, K: int, sizes: tuple,
+                        rowstart_ref, nrows_ref, lens_ref, skips_ref,
+                        weights_ref, msm_ref, dlo_ref, dhi_ref,
+                        docs_hbm, imp_hbm, out_scores, out_docs, out_totals,
+                        docs_v, imp_v, sems):
+    q = pl.program_id(0)
+    rows_per_term = L // LANES
+
+    for t in range(T):
+        nr = nrows_ref[t, q]
+        row_start = pl.multiple_of(rowstart_ref[t, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(t=t, s=s, row_start=row_start):
+                pltpu.make_async_copy(docs_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t]).start()
+                pltpu.make_async_copy(imp_hbm.at[pl.ds(row_start, s)],
+                                      imp_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t + 1]).start()
+    for t in range(T):
+        nr = nrows_ref[t, q]
+        row_start = pl.multiple_of(rowstart_ref[t, q], HBM_ALIGN // LANES)
+        for s in sizes:
+            @pl.when(nr == s)
+            def _(t=t, s=s, row_start=row_start):
+                pltpu.make_async_copy(docs_hbm.at[pl.ds(row_start, s)],
+                                      docs_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t]).wait()
+                pltpu.make_async_copy(imp_hbm.at[pl.ds(row_start, s)],
+                                      imp_v.at[t, pl.ds(0, s)],
+                                      sems.at[2 * t + 1]).wait()
+
+    R = (T * L) // LANES
+    docs2 = docs_v[:].reshape(R, LANES)
+    imp2 = imp_v[:].reshape(R, LANES)
+    rows, lanes = _ids((R, LANES))
+    term_of_row = rows // rows_per_term
+    pos_in_term = (rows % rows_per_term) * LANES + lanes
+
+    w_row = jnp.zeros((R, LANES), jnp.float32)
+    len_row = jnp.zeros((R, LANES), jnp.int32)
+    skip_row = jnp.zeros((R, LANES), jnp.int32)
+    for t in range(T):
+        sel = term_of_row == t
+        w_row = jnp.where(sel, weights_ref[t, q], w_row)
+        len_row = jnp.where(sel, lens_ref[t, q], len_row)
+        skip_row = jnp.where(sel, skips_ref[t, q], skip_row)
+    dlo = dlo_ref[0, q]
+    dhi = dhi_ref[0, q]
+    in_pos = (pos_in_term >= skip_row) & (pos_in_term < skip_row + len_row)
+    valid = in_pos & (docs2 >= dlo) & (docs2 < dhi)
+    is_prefix = pos_in_term < skip_row
+    keys = jnp.where(is_prefix | (in_pos & (docs2 < dlo)), NEG_SENTINEL,
+                     jnp.where(valid, docs2, INT_SENTINEL))
+
+    # the WHOLE per-posting score: one multiply (weights fold
+    # idf·boost·scale — the designated dequant shape, oslint OSL507)
+    contrib = jnp.where(valid, w_row * imp2.astype(jnp.float32), 0.0)
+
+    half = L
+    while half < T * L:
+        keys, contrib = _merge_pairs(keys, contrib, half)
+        half *= 2
+
+    score = contrib
+    kk = keys
+    cc = contrib
+    count = jnp.ones((R, LANES), jnp.float32)
+    for _ in range(T - 1):
+        kk = _flat_shift_down(kk, INT_SENTINEL)
+        cc = _flat_shift_down(cc, 0.0)
+        eq = (kk == keys) & (keys < INT_SENTINEL)
+        score = score + jnp.where(eq, cc, 0.0)
+        count = count + jnp.where(eq, 1.0, 0.0)
+    knext = _flat_shift_up(keys, INT_SENTINEL)
+    is_last = (knext != keys) & (keys < INT_SENTINEL) & (keys > NEG_SENTINEL)
+    msm = msm_ref[0, q]
+    final = jnp.where(is_last & (count >= msm), score, NEG_INF)
+
+    total = jnp.sum((final > NEG_INF).astype(jnp.int32))
+    out_totals[q, :] = jnp.full((LANES,), total, jnp.int32)
+
+    acc_s = jnp.full((1, LANES), NEG_INF, jnp.float32)
+    acc_d = jnp.full((1, LANES), -1, jnp.int32)
+    out_lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    for j in range(K):
+        best = jnp.max(final)
+        sel = final == best
+        bdoc = jnp.min(jnp.where(sel, keys, INT_SENTINEL))
+        got = best > NEG_INF
+        best_or = jnp.where(got, best, NEG_INF)
+        bdoc_or = jnp.where(got, bdoc, -1)
+        hit = out_lane == j
+        acc_s = jnp.where(hit, best_or, acc_s)
+        acc_d = jnp.where(hit, bdoc_or, acc_d)
+        final = jnp.where(sel & (keys == bdoc), NEG_INF, final)
+    out_scores[q, :] = acc_s[0]
+    out_docs[q, :] = acc_d[0]
+
+
+@functools.partial(jax.jit, static_argnames=("T", "L", "K"))
+def fused_bm25_topk_impact(docs_hbm: jnp.ndarray, imp_hbm: jnp.ndarray,
+                           rowstarts: jnp.ndarray, nrows: jnp.ndarray,
+                           lens: jnp.ndarray, skips: jnp.ndarray,
+                           weights: jnp.ndarray, msm: jnp.ndarray,
+                           dlo: jnp.ndarray, dhi: jnp.ndarray,
+                           T: int, L: int, K: int):
+    """Batched fused top-k over codec-v2 quantized impacts.
+
+    docs_hbm  i32[P] — doc ids, CSR-flat, rows 128-lane aligned
+    imp_hbm   i32[P] — quantized impact per posting (u8/u16 widened to
+              the i32 HBM lane granularity)
+    weights   f32[QB, T] — idf · boost · plane scale, folded on host
+    (rowstarts/nrows/lens/skips/msm/dlo/dhi as in fused_bm25_topk_tfdl;
+    the host's block-max prune compacts skipped blocks OUT of these
+    windows.) No similarity statics: the kernel is one multiply per
+    posting, and one compiled (T, L, K) variant serves every similarity
+    the plane was built under.
+    Returns (scores f32[QB, 128], doc_ids i32[QB, 128], totals)."""
+    QB = rowstarts.shape[0]
+    rowstarts = rowstarts.T
+    nrows = nrows.T
+    lens = lens.T
+    skips = skips.T
+    weights = weights.T
+    msm = msm.T
+    dlo = dlo.T
+    dhi = dhi.T
+    assert docs_hbm.shape[0] % LANES == 0
+    docs_hbm = docs_hbm.reshape(-1, LANES)
+    imp_hbm = imp_hbm.reshape(-1, LANES)
+    min_rows = HBM_ALIGN // LANES
+    sizes = []
+    s = min_rows
+    while s <= L // LANES:
+        sizes.append(s)
+        s *= 2
+    kernel = functools.partial(_bm25_impact_kernel, T, L, K, tuple(sizes))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=8,
+        grid=(QB,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
+            pltpu.VMEM((T, L // LANES, LANES), jnp.int32),
+            pltpu.SemaphoreType.DMA((2 * T,)),
+        ],
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((QB, LANES), jnp.float32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((QB, LANES), jnp.int32),
+    ]
+    scores, doc_ids, totals = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+    )(rowstarts, nrows, lens, skips, weights, msm, dlo, dhi,
+      docs_hbm, imp_hbm)
+    return scores, doc_ids, totals
+
+
 def align_csr_rows(starts: np.ndarray, doc_ids: np.ndarray, *vals: np.ndarray,
                    margin: int, alignment: int = HBM_ALIGN):
     """Re-pack CSR postings so every row begins at a 128-aligned offset

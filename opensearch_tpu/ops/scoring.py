@@ -122,6 +122,111 @@ def score_term_group(field_arrays: dict, dl: jnp.ndarray, live: jnp.ndarray,
     return ScoredMask(jnp.where(live_ok, scores, 0.0), jnp.where(live_ok, counts, 0.0))
 
 
+# ---------------- codec v2: quantized-impact domain ----------------
+#
+# u8/u16 impact planes may only enter f32 score math through these two
+# designated dequant helpers (oslint OSL507): the quantized domain is
+# where block-max prune compares stay exact, and every implicit
+# int->float promotion outside the helpers is a bound the serve
+# certificates don't know about.
+
+
+def dequant_impact(q: jnp.ndarray, scale) -> jnp.ndarray:
+    """THE device-side dequantizer: quantized impact plane -> f32 score
+    contributions. `scale` may be a scalar (the plane's global scale) or
+    a broadcastable array with weights pre-folded in."""
+    return q.astype(jnp.float32) * scale
+
+
+def dequant_impact_np(q, scale):
+    """Host mirror of `dequant_impact` (planning bounds, head selection,
+    bench stamps)."""
+    return np.asarray(q).astype(np.float32) * np.float32(scale)
+
+
+def gather_impact_blocks(doc_ids: jnp.ndarray, impacts: jnp.ndarray,
+                         bstart: jnp.ndarray, blen: jnp.ndarray,
+                         bucket: int):
+    """Flatten explicit posting-block windows [bstart_i, bstart_i+blen_i)
+    into static width `bucket` — the block-granular analog of
+    `gather_postings` for the codec-v2 impact path, where the host's
+    block-max prune selects WHICH blocks are gathered at all (skipped
+    blocks never move bytes). Returns (docs i32[B], iq uint[B],
+    block_idx i32[B], valid bool[B]); iq stays in the quantized integer
+    domain — callers dequantize via `dequant_impact`."""
+    nblk = bstart.shape[0]
+    cum = jnp.cumsum(blen)
+    total = cum[-1]
+    i = jnp.arange(bucket, dtype=jnp.int32)
+    b_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    b_idx = jnp.minimum(b_idx, nblk - 1)
+    prev = jnp.where(b_idx > 0, cum[jnp.maximum(b_idx - 1, 0)], 0)
+    src = bstart[b_idx] + (i - prev)
+    valid = i < total
+    src = jnp.clip(src, 0, doc_ids.shape[0] - 1)
+    docs = jnp.where(valid, doc_ids[src], jnp.int32(2**31 - 1))
+    iq = jnp.where(valid, impacts[src], 0)
+    return docs, iq, b_idx, valid
+
+
+def impact_score_blocks(doc_ids: jnp.ndarray, impacts: jnp.ndarray,
+                        live: jnp.ndarray, bstart: jnp.ndarray,
+                        blen: jnp.ndarray, bweight: jnp.ndarray,
+                        bucket: int, ndocs_pad: int) -> ScoredMask:
+    """The codec-v2 eager hot loop: gather quantized impacts over the
+    kept blocks, one dequant multiply (weight·scale pre-folded per block
+    on the host), scatter-add. NO per-posting tf/doclen math — the BM25
+    saturation was evaluated at index time (BM25S eager scoring). Counts
+    are exact for the gathered blocks: postings partition (term, doc)
+    pairs, so counting postings counts matching terms."""
+    docs, iq, b_idx, valid = gather_impact_blocks(doc_ids, impacts,
+                                                  bstart, blen, bucket)
+    contrib = jnp.where(valid, dequant_impact(iq, bweight[b_idx]), 0.0)
+    scores = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(contrib,
+                                                            mode="drop")
+    counts = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(
+        jnp.where(valid, 1.0, 0.0), mode="drop")
+    live_ok = live > 0
+    return ScoredMask(jnp.where(live_ok, scores, 0.0),
+                      jnp.where(live_ok, counts, 0.0))
+
+
+def gather_docs_only(starts: jnp.ndarray, doc_ids: jnp.ndarray,
+                     rows: jnp.ndarray, bucket: int):
+    """`gather_postings` without the tf plane: (docs, valid) only. The
+    codec-v2 layout has no resident f32 tfs, and non-scoring consumers
+    (filter masks) never needed them — a real posting always has tf>0."""
+    nrows_pad = starts.shape[0]
+    rows = jnp.where(rows < 0, nrows_pad - 2, rows)
+    row_start = starts[rows]
+    row_end = starts[rows + 1]
+    lens = row_end - row_start
+    cum = jnp.cumsum(lens)
+    total = cum[-1]
+    i = jnp.arange(bucket, dtype=jnp.int32)
+    term_idx = jnp.searchsorted(cum, i, side="right").astype(jnp.int32)
+    term_idx = jnp.minimum(term_idx, rows.shape[0] - 1)
+    prev = jnp.where(term_idx > 0, cum[jnp.maximum(term_idx - 1, 0)], 0)
+    src = row_start[term_idx] + (i - prev)
+    valid = i < total
+    src = jnp.clip(src, 0, doc_ids.shape[0] - 1)
+    docs = jnp.where(valid, doc_ids[src], jnp.int32(2**31 - 1))
+    return docs, valid
+
+
+def term_match_mask(field_arrays: dict, live: jnp.ndarray,
+                    rows: jnp.ndarray, bucket: int,
+                    ndocs_pad: int) -> jnp.ndarray:
+    """Non-scoring terms filter over the codec-v2 layout: identical
+    semantics to `term_filter_mask` (every real posting has tf > 0) with
+    no tf plane touched — 4 bytes gathered per slot instead of 8."""
+    docs, valid = gather_docs_only(field_arrays["starts"],
+                                   field_arrays["doc_ids"], rows, bucket)
+    hits = jnp.zeros(ndocs_pad, jnp.float32).at[docs].add(
+        jnp.where(valid, 1.0, 0.0), mode="drop")
+    return (hits > 0) & (live > 0)
+
+
 def gather_tf_dense(field_arrays: dict, rows: jnp.ndarray, bucket: int,
                     ndocs_pad: int, t_pad: int) -> jnp.ndarray:
     """Per-term dense raw term frequencies: f32[t_pad, ndocs_pad].

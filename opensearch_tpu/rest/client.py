@@ -917,6 +917,10 @@ class RestClient:
             # where the phase-2 candidate-union rescore ran and what it
             # cost (host numpy fallback vs batched device launches)
             "fastpath_rescore": _fastpath.rescore_stats(),
+            # codec-v2 eager-impact path (search/impactpath.py): serve /
+            # escalation ladder counters plus the device block-skip rate
+            # (blocks the block-max prune never gathered)
+            "impactpath": self._impactpath_block(),
             # unified telemetry (utils/metrics.py): per-stage latency
             # percentiles for every instrumented stage (search phases,
             # fastpath ladder rungs, mesh dispatch, distnode RPCs) and
@@ -927,6 +931,13 @@ class RestClient:
             node_block["mesh"] = n.mesh_service.stats()
         return {"cluster_name": n.metadata.cluster_name,
                 "nodes": {n.node_name: node_block}}
+
+    @staticmethod
+    def _impactpath_block() -> dict:
+        from ..search import impactpath as _ip
+        out = _ip.stats()
+        out["block_skip_rate"] = round(_ip.block_skip_rate(), 4)
+        return out
 
     def _hbm_block(self) -> dict:
         out = self.node.hbm_ledger.snapshot()

@@ -33,7 +33,8 @@ import numpy as np
 from ..index.mappings import (FLOAT_TYPES, INT_TYPES, KEYWORD_TYPES,
                               RANGE_MEMBER, RANGE_TYPES, TEXT_TYPES,
                               Mappings, coerce_value, _parse_range_value)
-from ..index.segment import Segment, next_pow2, split_i64
+from ..index.segment import (CODEC_V1, CODEC_V2, Segment, next_pow2,
+                             split_i64)
 from ..models.similarity import Similarity, resolve_similarity
 from ..ops import aggs as agg_ops
 from ..ops import scoring as ops
@@ -1783,6 +1784,17 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
                     a, b = pb.row_slice(r)
                     total += b - a
         bucket = ops.pick_bucket(total)
+        # codec-version branch (consults Segment.codec_version, OSL507):
+        # v2 fields carry no resident f32 tf plane. Filter-mode programs
+        # run the tf-free gather (layout tag below); exact-scoring
+        # programs still need tf/dl math, so prepare promotes the plane
+        # back onto the device once per (segment, field) — the eager
+        # impact hot path (search/impactpath.py) never does.
+        v2 = (getattr(seg, "codec_version", CODEC_V1) >= CODEC_V2
+              and pb is not None and pb.impact is not None)
+        layout = "impact" if v2 else "tf"
+        if v2 and node.mode != "filter":
+            seg.ensure_device_tfs(node.field)
         w = np.zeros(T_pad, dtype=np.float32)
         w[: len(node.terms)] = node.weights
         a = np.zeros(T_pad, dtype=np.float32)
@@ -1796,7 +1808,7 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         sim = node.sim
         b_eff = sim.b if node.has_norms else 0.0
         return ("terms", nid, node.field, T_pad, bucket, sim.sim_id,
-                float(sim.k1), float(b_eff), node.mode)
+                float(sim.k1), float(b_eff), node.mode, layout)
 
     if isinstance(node, LSourcePhrase):
         pb = seg.postings.get(node.field)
@@ -1897,7 +1909,10 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         bucket = ops.pick_bucket(total)
         _p(params, f"q{nid}_rows", rows)
         _scalar_f32(params, f"q{nid}_boost", node.boost)
-        return ("xterms", nid, node.field, T_pad, bucket)
+        layout = ("impact" if getattr(seg, "codec_version",
+                                      CODEC_V1) >= CODEC_V2
+                  and pb is not None and pb.impact is not None else "tf")
+        return ("xterms", nid, node.field, T_pad, bucket, layout)
 
     if isinstance(node, LMatchAll):
         _scalar_f32(params, f"q{nid}_boost", node.boost)
@@ -2172,6 +2187,10 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         avgdl_c = 0.0
         for fi, (fname, w) in enumerate(node.fields):
             pb = seg.postings.get(fname)
+            if pb is not None and pb.impact is not None:
+                # BM25F needs raw tf BEFORE saturation: promote the tf
+                # plane on codec-v2 segments (once per segment/field)
+                seg.ensure_device_tfs(fname)
             rows = np.full(T_pad, -1, np.int32)
             total = 0
             if pb is not None:
@@ -2613,13 +2632,20 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
     zeros = jnp.zeros(ndocs_pad, jnp.float32)
 
     if kind == "terms":
-        _, _, field, T_pad, bucket, sim_id, k1, b, mode = spec
+        _, _, field, T_pad, bucket, sim_id, k1, b, mode, layout = spec
         post = seg_arrays["postings"].get(field)
         if post is None:
             return ops.ScoredMask(zeros, zeros)
         dl = seg_arrays["doc_lens"].get(field, zeros)
         if mode == "filter":
-            mask = ops.term_filter_mask(post, live, params[f"q{nid}_rows"], bucket, ndocs_pad)
+            # codec-v2 layout: no resident tf plane — the tf-free gather
+            # moves half the bytes for identical mask semantics
+            if layout == "impact":
+                mask = ops.term_match_mask(post, live,
+                                           params[f"q{nid}_rows"], bucket,
+                                           ndocs_pad)
+            else:
+                mask = ops.term_filter_mask(post, live, params[f"q{nid}_rows"], bucket, ndocs_pad)
             boost = params[f"q{nid}_boost"]
             m = mask.astype(jnp.float32)
             return ops.ScoredMask(m * boost, m)
@@ -2666,11 +2692,15 @@ def emit(spec, seg_arrays: dict, params: dict) -> ops.ScoredMask:  # noqa: C901
         return ops.ScoredMask(scores, matched.astype(jnp.float32))
 
     if kind == "xterms":
-        _, _, field, T_pad, bucket = spec
+        _, _, field, T_pad, bucket, layout = spec
         post = seg_arrays["postings"].get(field)
         if post is None:
             return ops.ScoredMask(zeros, zeros)
-        mask = ops.term_filter_mask(post, live, params[f"q{nid}_rows"], bucket, ndocs_pad)
+        if layout == "impact":
+            mask = ops.term_match_mask(post, live, params[f"q{nid}_rows"],
+                                       bucket, ndocs_pad)
+        else:
+            mask = ops.term_filter_mask(post, live, params[f"q{nid}_rows"], bucket, ndocs_pad)
         m = mask.astype(jnp.float32)
         return ops.ScoredMask(m * params[f"q{nid}_boost"], m)
 
@@ -4932,6 +4962,38 @@ def build_rescore_program(T: int, C: int, k1: float, b: float):
                                    avgdl, cand, T=T, C=C, k1=k1, b=b)
 
     return run
+
+
+# ---------------------------------------------------------------------
+# codec-v2 impact program (search/impactpath.py first pass)
+# ---------------------------------------------------------------------
+#
+# Program variants are KEYED BY CODEC layout: (impact bit width, block
+# slot bucket, gather bucket, candidate window). The program is the
+# whole eager hot loop — integer impact gather over the host-pruned
+# block windows, one dequant multiply, scatter-add, masked top-C — with
+# no tf/doclen math anywhere in the trace.
+
+
+@_instrumented_program_cache(
+    "impact", maxsize=128,
+    shape_of=lambda B, bucket, C, bits: f"B{B}xG{bucket}xC{C}u{bits}")
+def build_impact_program(B: int, bucket: int, C: int, bits: int):
+    import jax
+
+    def run(d_docs, d_impacts, live, bstart, blen, bweight, msm):
+        import jax.numpy as jnp
+        ndocs_pad = live.shape[0]
+        sm = ops.impact_score_blocks(d_docs, d_impacts, live, bstart,
+                                     blen, bweight, bucket, ndocs_pad)
+        ok = (sm.count >= msm) & (live > 0)
+        masked = jnp.where(ok, sm.scores, ops.NEG_INF)
+        total = jnp.sum(ok.astype(jnp.int32))
+        kk = min(C, ndocs_pad)
+        vals, idx = jax.lax.top_k(masked, kk)
+        return vals, idx, total
+
+    return jax.jit(run)
 
 
 # spec kinds whose second element is a node id (everything `prepare`

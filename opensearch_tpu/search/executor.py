@@ -25,6 +25,7 @@ from ..obs import query_cost as _qcost
 from ..script.painless_lite import ScriptError as _ScriptError
 from . import compiler as C
 from . import fastpath
+from . import impactpath
 from . import query_dsl as dsl
 from .aggregations import (AggNode, _apply_bucket_pipelines,
                            apply_pipelines_tree, finalize, merge_partials,
@@ -105,14 +106,16 @@ _LNODE_CHILD_ATTRS = ("musts", "shoulds", "must_nots", "filters",
 def _cost_predicted(lroot, seg, window: int) -> None:
     """Plan-time device-cost prediction from CSR block stats alone: each
     scoring term row the query touches contributes its TRUE posting count
-    (8 bytes per slot — the cost model in docs/OBSERVABILITY.md). Noted
-    per planned segment BEFORE any launched program shape exists; the
-    launch sites note the padded shapes they actually move, and the
-    profile `cost` block reconciles the two."""
+    (8 bytes per slot on codec v1; 4 + impact width on codec-v2 eager
+    fields — the cost model in docs/OBSERVABILITY.md). Noted per planned
+    segment BEFORE any launched program shape exists; the launch sites
+    note the padded shapes they actually move, and the profile `cost`
+    block reconciles the two."""
     qc = _qcost.current()
     if qc is None:
         return
     npost = 0
+    nbytes = 0
     stack = [lroot]
     while stack:
         node = stack.pop()
@@ -126,8 +129,18 @@ def _cost_predicted(lroot, seg, window: int) -> None:
         if terms:
             pb = seg.postings.get(node.field)
             if pb is not None:
-                for t in terms:
-                    npost += pb.doc_freq(t)
+                df = sum(pb.doc_freq(t) for t in terms)
+                npost += df
+                v2 = (getattr(seg, "codec_version", C.CODEC_V1)
+                      >= C.CODEC_V2 and pb.impact is not None)
+                if v2 and isinstance(node, C.LTerms) \
+                        and node.mode == "score":
+                    # codec v2: the eager plane replaces the f32 tf slot
+                    # with a u8/u16 impact — predict the SMALLER volume
+                    # (the claim the actual-launch stamps reconcile)
+                    nbytes += df * (4 + pb.impact.bits // 8)
+                else:
+                    nbytes += df * _qcost.POSTING_SLOT_BYTES
         for attr in _LNODE_CHILD_ATTRS:
             v = getattr(node, attr, None)
             if isinstance(v, (list, tuple)):
@@ -135,8 +148,7 @@ def _cost_predicted(lroot, seg, window: int) -> None:
             elif v is not None and not isinstance(v, (str, int, float,
                                                       bool)):
                 stack.append(v)
-    qc.note_predicted(npost * _qcost.POSTING_SLOT_BYTES, npost, window,
-                      segment=seg)
+    qc.note_predicted(nbytes, npost, window, segment=seg)
 
 
 class ShardSearcher:
@@ -257,6 +269,15 @@ class ShardSearcher:
                                         named_nodes, search_after, window,
                                         body)
                      if fastpath.enabled() and self.device is None else None)
+        # codec-v2 eager-impact path (search/impactpath.py): the same pure
+        # BM25 top-k shape class served from the quantized impact plane
+        # with host block-max pruning — XLA, so it engages on every
+        # backend. Segments decline per-segment (v1 codec, no plane), and
+        # a failed serve certificate falls through to the exact program.
+        imp_spec = (impactpath.make_spec(lroot, sort_specs, agg_nodes,
+                                         named_nodes, search_after, window,
+                                         body)
+                    if self.device is None else None)
 
         # concurrent segment search, TPU-style: a many-segment shard runs
         # as ONE kernel launch over the concatenated shard view instead of
@@ -305,6 +326,14 @@ class ShardSearcher:
                     self._collect_topk(result, fout, seg, seg_ord, shard_ord,
                                        sort_specs, rescores, min_score,
                                        is_field_sort, ctx)
+                    continue
+            if imp_spec is not None:
+                iout = impactpath.segment_search(seg, ctx, imp_spec, window)
+                if iout is not None:
+                    ran_segs.append(seg)
+                    self._collect_topk(result, iout, seg, seg_ord,
+                                       shard_ord, sort_specs, rescores,
+                                       min_score, is_field_sort, ctx)
                     continue
             if sort_specs and sort_specs[0]["field"] == "_script":
                 # script order is host-computed: collect the full segment

@@ -245,9 +245,13 @@ class AlignedPostings:
             a, b = pb.row_slice(row)
             dls = (dl_col[pb.doc_ids[a:b]] if dl_col is not None
                    else np.zeros(b - a, np.int64))
+            plane = getattr(pb, "impact", None)
             keep, fr = _head_select(pb.doc_ids[a:b], pb.tfs[a:b],
                                     np.asarray(dls, np.int64),
-                                    l_head=4 * L_HEAD)
+                                    l_head=4 * L_HEAD,
+                                    imp=(_plane_impacts_slice(plane, a, b)
+                                         if plane is not None
+                                         else None))
             got = (pb.doc_ids[a:b][keep], fr)
             self._head2[row] = got
         return got
@@ -295,20 +299,48 @@ def _nominal_impact(tfs: np.ndarray, dls: np.ndarray,
     return tfs / (tfs + 1.2 * (0.25 + 0.75 * dls / avg))
 
 
+def _plane_impacts(pb) -> Optional[np.ndarray]:
+    """Codec-v2 fast source for the nominal impact order: the segment
+    already carries quantized eager impacts built with the SAME nominal
+    params (index/segment.py IMPACT_K1/IMPACT_B), so head selection and
+    the quality tier reuse them instead of re-deriving an O(P) f32 map
+    per (segment, field) layout build. Ordering by the quantized plane
+    is sound — selection only steers which postings are kept; the exact
+    (tf, dl) remainder frontiers still carry correctness. None on v1
+    segments and facade views (recompute path unchanged)."""
+    plane = getattr(pb, "impact", None)
+    if plane is None:
+        return None
+    from ..ops.scoring import dequant_impact_np
+    return dequant_impact_np(plane.q, plane.scale)
+
+
+def _plane_impacts_slice(plane, a: int, b: int) -> np.ndarray:
+    """Dequantized impacts of ONE row slice — per-row consumers (tier-2
+    head cuts) must stay O(df), not O(P) over the whole field plane."""
+    from ..ops.scoring import dequant_impact_np
+    return dequant_impact_np(plane.q[a:b], plane.scale)
+
+
 def _head_select(doc_ids: np.ndarray, tfs: np.ndarray, dl_of: np.ndarray,
-                 l_head: int = None
+                 l_head: int = None, imp: Optional[np.ndarray] = None
                  ) -> Tuple[np.ndarray, tuple]:
     """Pick the L_HEAD highest-impact postings of one oversized row.
     Impact = tf/(tf + k1·(1-b+b·dl/avgdl)) with nominal params — the order
     only steers which postings we keep; correctness rides on the returned
     REMAINDER FRONTIER (tf -> min dl of the non-kept postings), which
     bounds any remaining posting's contribution under any query-time
-    similarity. Returns (kept positions ASCENDING — i.e. doc-ascending, as
-    the kernel's merge network requires —, remainder frontier)."""
+    similarity. On codec v2 `imp` carries the row's precomputed quantized
+    impacts (`_plane_impacts`) so no per-posting math reruns here.
+    Returns (kept positions ASCENDING — i.e. doc-ascending, as the
+    kernel's merge network requires —, remainder frontier)."""
     tf = tfs.astype(np.float32)
     dlf = dl_of.astype(np.float32)
-    avg = max(float(dlf.mean()), 1.0)
-    c = _nominal_impact(tf, dlf, avg)
+    if imp is not None:
+        c = imp
+    else:
+        avg = max(float(dlf.mean()), 1.0)
+        c = _nominal_impact(tf, dlf, avg)
     # stable sort: impact ties keep doc-ascending order, matching the exact
     # path's doc-id tie-break so a tied top-k boundary selects the same docs
     order = np.argsort(-c, kind="stable")
@@ -346,11 +378,15 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     cat_docs = pb.doc_ids
     cat_packed = packed
     if len(big):
+        plane_imp = _plane_impacts(pb)
         h_docs, h_packed, h_lens = [], [], []
         for r in big:
             a, b = int(pb.starts[r]), int(pb.starts[r + 1])
             keep, rem_fr = _head_select(pb.doc_ids[a:b], tfs[a:b],
-                                        dl_of[a:b])
+                                        dl_of[a:b],
+                                        imp=(plane_imp[a:b]
+                                             if plane_imp is not None
+                                             else None))
             h_docs.append(pb.doc_ids[a:b][keep])
             h_packed.append(packed[a:b][keep])
             h_lens.append(len(keep))
@@ -1279,10 +1315,12 @@ def _quality_tier(seg: Segment, field: str):
     if (pb is not None and pb.size > 0 and seg.ndocs >= QUALITY_MIN_NDOCS
             and getattr(seg, "uid", None) is not None
             and get_aligned(seg, field) is not None):
-        dl_of = (dl[pb.doc_ids].astype(np.float32) if dl is not None
-                 else np.zeros(len(pb.doc_ids), np.float32))
-        avg = max(float(dl_of.mean()), 1.0)
-        imp = _nominal_impact(pb.tfs, dl_of, avg)
+        imp = _plane_impacts(pb)     # codec v2: precomputed, no O(P) map
+        if imp is None:
+            dl_of = (dl[pb.doc_ids].astype(np.float32) if dl is not None
+                     else np.zeros(len(pb.doc_ids), np.float32))
+            avg = max(float(dl_of.mean()), 1.0)
+            imp = _nominal_impact(pb.tfs, dl_of, avg)
         docmax = np.zeros(seg.ndocs, np.float32)
         np.maximum.at(docmax, pb.doc_ids, imp)
         target = max(seg.ndocs // QUALITY_SHARE, QUALITY_MIN_NDOCS // 4)

@@ -1,0 +1,492 @@
+"""Codec-v2 eager-impact serving path: quantized gather → scatter-add
+with device block-max pruning, certified exact against the f32 oracle.
+
+The XLA hot path for plain BM25 term/match top-k (the same shape class
+`search/fastpath.py` serves through the Pallas kernels on TPU) over
+codec-v2 segments (index/segment.py `ImpactPlane`). Per query:
+
+1. **Plan (host).** The per-row block-max sidecar prices every
+   IMPACT_BLOCK-posting block at `w_t · scale · block_max` and keeps the
+   top-valued blocks until the kept posting mass covers the candidate
+   window; every pruned block is *skipped at gather time* — its bytes
+   never move (GPUSparse-style block-level metadata, arxiv 2606.26441).
+   The pruned remainder is summarized as one sound scalar `B_rem =
+   Σ_t w_t·scale·max(pruned block_max of t)`.
+2. **First pass (device).** ONE jit program (compiler.build_impact_program,
+   keyed by the codec layout): integer impact gather over the kept block
+   windows, a single dequant multiply through `ops.scoring.dequant_impact`
+   (weights pre-folded per block), scatter-add, masked top-C. No
+   per-posting tf/doclen math anywhere — the BM25 saturation was
+   evaluated at index time (BM25S eager scoring, arxiv 2407.03618).
+3. **Certify (host).** Candidates are exact-rescored against the full
+   f32 BM25 expression (the same arithmetic the v1 XLA program and the
+   fastpath oracle serve — parity-tested bit-for-bit). The served window
+   is proven exact when no non-candidate doc can displace it:
+   `max(approx_floor + E + B_rem, B_rem) < θ`, where θ is the window
+   boundary's exact score and `E` folds the quantization half-step,
+   the build→query similarity-param drift bound and f32 accumulation
+   slack (ImpactPlane.quant_err / drift_bound).
+4. **Escalate.** A failed certificate first widens candidates to every
+   doc any kept block mentions (the fastpath `_phase2_batch` trick — the
+   union bound drops to `B_rem` alone), then falls back to the exact
+   dense program (the caller reruns the v1-style XLA plan; codec v2
+   promotes the tf plane lazily for exactly this rung).
+
+Totals are exact (`eq`) on unpruned passes and a lower bound (`gte`)
+under pruning — the same contract as the reference's default
+track-total-hits cap; bodies with an explicit `track_total_hits` are
+planned unpruned. Pruning also requires msm == 1 (a pruned pass cannot
+count matched terms exactly; multi-msm queries ride the unpruned impact
+pass, which still moves 5/6 bytes per slot instead of 8).
+
+Served scores live in the HOST-ORACLE f32 domain (term-ordered numpy
+accumulation — the same domain the fastpath ladder's rescued pages
+serve). The XLA dense program may contract mul+add chains into FMA and
+land ~1 ULP away on individual scores; page IDS and order agree. For
+that reason the path only engages on MESH-LESS serving (see
+`_MESH_ATTACHED`): a mesh-attached node's host loop must stay
+byte-identical to its coalesced SPMD siblings.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..index.segment import (CODEC_V1, CODEC_V2, IMPACT_BLOCK, Segment,
+                             next_pow2)
+from ..obs import flight_recorder as _fr
+from ..obs import query_cost as _qc
+from ..ops import scoring as ops
+from ..ops.scoring import dequant_impact_np
+from ..utils.metrics import METRICS, CounterGroup
+from ..utils.trace import TRACER
+from .fastpath import _body_eligible, _ok_group
+
+# candidate window floor for the first pass; the block prune keeps at
+# least KEEP_FACTOR * C postings so the candidate pool stays deep enough
+# to certify without an escalation on well-behaved corpora
+CAND_FLOOR = 32
+KEEP_FACTOR = 8
+KEEP_MIN = 512
+
+STATS = CounterGroup(METRICS, "impactpath", {
+    "served": 0, "pruned_served": 0, "phase2_served": 0,
+    "escalated": 0, "fallback": 0,
+    "blocks_total": 0, "blocks_skipped": 0,
+    "postings_total": 0, "postings_skipped": 0})
+
+
+# bit-consistency gate: the impact ladder serves the HOST-ORACLE f32
+# domain (term-ordered numpy accumulation); batched SPMD mesh programs
+# and device-pinned replica searchers serve XLA's (FMA-contracted)
+# domain, and the two can differ by ~1 ULP per posting. When a node's
+# serving is multi-domain — an SPMD mesh owns the hot path (declines,
+# scheduler bypasses and degradation retries must stay BYTE-identical to
+# their coalesced siblings), or replica read copies round-robin with the
+# primary — the node pins this contextvar around search_shards and the
+# impact path stands down. Single-domain serving (no mesh, no replica
+# copies: single-device nodes, the direct-path benches) gets the eager
+# path unconditionally.
+_MESH_ATTACHED: contextvars.ContextVar = contextvars.ContextVar(
+    "impactpath_mesh_attached", default=False)
+
+
+def mesh_attached_token(attached: bool):
+    return _MESH_ATTACHED.set(bool(attached))
+
+
+def reset_mesh_attached(token) -> None:
+    _MESH_ATTACHED.reset(token)
+
+
+def enabled() -> bool:
+    if _MESH_ATTACHED.get():
+        return False
+    return not os.environ.get("OPENSEARCH_TPU_NO_IMPACT")
+
+
+def stats() -> dict:
+    return dict(STATS)
+
+
+def block_skip_rate() -> float:
+    """Fraction of sidecar blocks the device never gathered (planned
+    queries only) — the bench `extra.impacts.block_skip_rate` stamp."""
+    total = STATS["blocks_total"]
+    return (STATS["blocks_skipped"] / total) if total else 0.0
+
+
+class ImpactSpec:
+    """A search the impact path can serve: the pure BM25 term-group
+    top-k shape (single unfiltered group, _score sort, no aggs)."""
+
+    __slots__ = ("lt", "window", "prune_ok")
+
+    def __init__(self, lt, window: int, prune_ok: bool):
+        self.lt = lt
+        self.window = window
+        self.prune_ok = prune_ok
+
+
+def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
+              search_after, window: int, body: dict
+              ) -> Optional[ImpactSpec]:
+    if not enabled():
+        return None
+    if not _ok_group(lroot):
+        return None
+    if not _body_eligible(sort_specs, agg_nodes, named_nodes, search_after,
+                          window, body):
+        return None
+    # pruning changes total-hit semantics (lower bound, "gte") and
+    # relaxed-msm counting is unsound — explicit total tracking or
+    # msm > 1 ride the unpruned impact pass
+    prune_ok = ("track_total_hits" not in body
+                and int(lroot.msm) <= 1)
+    return ImpactSpec(lroot, int(window), prune_ok)
+
+
+# pruned-remainder budget as a fraction of θ̂: the per-term cut keeps
+# Σ_t max(pruned_t) ≤ PRUNE_MARGIN·θ̂ < θ̂ ≤ θ2 (the θ̂-witness blocks are
+# priced ≥ θ̂ > τ, so their docs are always in the phase-2 union), which
+# makes a pruned plan certify by construction up to live/tie edge cases.
+# 0.5 leaves enough headroom that the PHASE-1 certificate
+# (approx_C + E + rem < θ) usually passes outright — the phase-2 union
+# rescore stays an escalation rung, not a per-query tax; raising the
+# margin prunes more and leans harder on phase 2.
+PRUNE_MARGIN = 0.5
+
+
+def _plan_blocks(pb, plane, rows: np.ndarray, weights: np.ndarray,
+                 C: int, prune: bool, window: int, eps: float):
+    """Select the gathered block set. Returns (bstart i64[NB], blen
+    i32[NB], bweight f32[NB], kept_postings, rem_bound, n_total_blocks,
+    total_postings) — bweight folds w_t·scale so the device does ONE
+    multiply per posting.
+
+    The prune threshold is derived from a SOUND lower bound θ̂ on the
+    true window-boundary score: distinct blocks of one row are distinct
+    docs, and each block contains a posting attaining its block_max, so
+    the window-th highest block_max of any single term witnesses `window`
+    real docs scoring ≥ w·(scale·bmax − eps) (eps = quantization +
+    param-drift error). Pruning only blocks priced below
+    `PRUNE_MARGIN·θ̂/T` keeps the remainder bound Σ_t max(pruned_t) ≤
+    PRUNE_MARGIN·θ̂ < θ — so a pruned plan certifies by construction
+    (phase 2 at the latest) instead of escalating to the dense rerun.
+    `eps` also prices the abstention: when quantization/drift error
+    swamps θ̂, nothing is pruned."""
+    offs_l, lens_l, w_l, term_l, val_l, act_w = [], [], [], [], [], []
+    act_rows = []
+    scale = np.float32(plane.scale)
+    row_ends = pb.starts[1:]
+    for i, r in enumerate(rows):
+        if r < 0:
+            continue
+        a, b = plane.row_block_range(int(r))
+        if b <= a:
+            continue
+        act_rows.append(int(r))
+        off = plane.block_off[a:b]
+        ln = np.minimum(np.int64(IMPACT_BLOCK),
+                        int(row_ends[int(r)]) - off).astype(np.int32)
+        bm = plane.block_max[a:b]
+        offs_l.append(off)
+        lens_l.append(ln)
+        w_l.append(np.full(b - a, np.float32(weights[i]) * scale,
+                           np.float32))
+        term_l.append(np.full(b - a, i, np.int32))
+        val_l.append(dequant_impact_np(bm, float(weights[i])
+                                       * float(plane.scale)))
+        act_w.append(abs(float(weights[i])))
+    if not offs_l:
+        z = np.zeros(0, np.int64)
+        return (z, np.zeros(0, np.int32), np.zeros(0, np.float32),
+                0, 0.0, 0, 0)
+    offs = np.concatenate(offs_l)
+    lens = np.concatenate(lens_l)
+    bw = np.concatenate(w_l)
+    terms = np.concatenate(term_l)
+    vals = np.concatenate(val_l)
+    total_post = int(lens.sum())
+    nblocks = len(offs)
+    keep_min = max(KEEP_FACTOR * C, KEEP_MIN)
+    if not prune or total_post <= keep_min:
+        return offs, lens, bw, total_post, 0.0, nblocks, total_post
+    # θ̂: best single-term witness on the window-th highest impact,
+    # error-deducted. Postings of one row are distinct docs, so the
+    # window-th highest quantized impact of ANY term witnesses `window`
+    # real docs scoring ≥ w·(scale·q − eps) — sharper than the
+    # block-level witness (top postings can concentrate in few blocks)
+    # and exactly the MaxScore insight: one rare high-idf term alone can
+    # price every stopword block out of the gather. Rows past the
+    # partition budget fall back to the block-max witness (each block
+    # max is attained by a distinct doc, so it is also sound).
+    theta_hat = 0.0
+    n_active = len(val_l)
+    kcache = plane.__dict__.setdefault("_kth_cache", {})
+    for r, bm_v, w_i in zip(act_rows, val_l, act_w):
+        a, b = int(pb.starts[r]), int(pb.starts[r + 1])
+        if b - a >= window and b - a <= (1 << 17):
+            # cached per (row, window): the partition over a stopword
+            # row is the plan's only O(df) step, and zipf queries repeat
+            # rows constantly (benign to race — value is deterministic)
+            kth_q = kcache.get((r, window))
+            if kth_q is None:
+                kth_q = float(np.partition(plane.q[a:b], b - a - window)
+                              [b - a - window])
+                kcache[(r, window)] = kth_q
+            wit = float(dequant_impact_np(
+                np.float32(kth_q), w_i * float(plane.scale)))
+            theta_hat = max(theta_hat, wit - w_i * eps)
+        elif len(bm_v) >= window:
+            kth = float(np.partition(bm_v, len(bm_v) - window)
+                        [len(bm_v) - window])
+            theta_hat = max(theta_hat, kth - w_i * eps)
+    if theta_hat <= 0.0:
+        return offs, lens, bw, total_post, 0.0, nblocks, total_post
+    tau = PRUNE_MARGIN * theta_hat / max(n_active, 1)
+    prune_mask = vals < tau
+    kept_post = int(lens[~prune_mask].sum())
+    if kept_post < keep_min:
+        # un-prune the priciest pruned blocks back to the posting floor
+        pruned_idx = np.nonzero(prune_mask)[0]
+        order = pruned_idx[np.argsort(-vals[pruned_idx], kind="stable")]
+        cum = kept_post + np.cumsum(lens[order])
+        back = int(np.searchsorted(cum, keep_min, side="left")) + 1
+        prune_mask[order[:back]] = False
+    kept = np.nonzero(~prune_mask)[0]
+    pruned_idx = np.nonzero(prune_mask)[0]
+    rem = 0.0
+    if len(pruned_idx):
+        # per-term max pruned block value, summed — the sound bound on
+        # any doc's missing (never-gathered) contribution
+        T = int(rows.shape[0])
+        per_term = np.zeros(T, np.float64)
+        np.maximum.at(per_term, terms[pruned_idx],
+                      vals[pruned_idx].astype(np.float64))
+        rem = float(per_term.sum())
+    return (offs[kept], lens[kept], bw[kept], int(lens[kept].sum()),
+            rem, nblocks, total_post)
+
+
+def _exact_scores(seg: Segment, field: str, rows: np.ndarray,
+                  weights: np.ndarray, k1: float, b_eff: float,
+                  avgdl: float, cand: np.ndarray):
+    """Exact f32 BM25 of `cand` against the FULL rows — term-ordered
+    accumulation mirroring the fastpath host oracle (`_exact_rescore`)
+    bit for bit, which is the domain served pages live in."""
+    pb = seg.postings.get(field)
+    dl = seg.doc_lens.get(field)
+    dl_c = (dl[cand].astype(np.float32) if dl is not None
+            else np.zeros(len(cand), np.float32))
+    kfac = float(k1) * (1.0 - b_eff + b_eff * dl_c
+                        / max(float(avgdl), 1e-9))
+    exact = np.zeros(len(cand), np.float32)
+    counts = np.zeros(len(cand), np.int64)
+    for i, r in enumerate(rows):
+        if r < 0:
+            continue
+        a, b = pb.row_slice(int(r))
+        if b <= a:
+            continue
+        rowdocs = pb.doc_ids[a:b]
+        pos = np.searchsorted(rowdocs, cand)
+        pos_c = np.minimum(pos, b - a - 1)
+        found = rowdocs[pos_c] == cand
+        tf = np.where(found, pb.tfs[a + pos_c], 0.0).astype(np.float32)
+        exact += np.where(found, np.float32(weights[i]) * tf / (tf + kfac),
+                          0.0).astype(np.float32)
+        counts += found
+    return exact, counts
+
+
+def _error_bound(plane, weights: np.ndarray, rows: np.ndarray,
+                 k1q: float, bq: float, avgdlq: float) -> float:
+    """Sound |exact − approx| per-doc bound: per-term quantization
+    half-step + build→query param drift, plus f32 accumulation slack on
+    both sums (≤ T adds each against the max representable score)."""
+    quant = plane.quant_err()
+    drift = plane.drift_bound(k1q, bq, avgdlq)
+    wsum = float(np.abs(weights[rows >= 0]).sum())
+    e = wsum * (quant + drift)
+    t = int((rows >= 0).sum())
+    umax = max(wsum * float(plane.scale) * plane.qmax, 1e-30)
+    e += 4.0 * (t + 2) * float(np.spacing(np.float32(umax)))
+    return e
+
+
+def _result(exact_m: np.ndarray, cand: np.ndarray, order: np.ndarray,
+            window: int, total: int, rel: str) -> dict:
+    keep = order[:window]
+    sc = exact_m[keep]
+    dc = cand[keep].astype(np.int32)
+    finite = np.isfinite(sc)
+    sc = np.where(finite, sc, -np.inf).astype(np.float32)
+    dc = np.where(finite, dc, -1)
+    ms = float(sc[0]) if len(sc) and np.isfinite(sc[0]) else -np.inf
+    return {"topk_key": sc, "topk_idx": dc, "topk_scores": sc,
+            "total": int(total), "max_score": ms, "total_rel": rel}
+
+
+def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
+                   ) -> Optional[dict]:
+    """Serve one pure spec over one codec-v2 segment, or None to fall
+    back to the exact dense program. Codec-version gate consults
+    Segment.codec_version (OSL507); v1 segments and facade views (shard
+    views, filtered views — their PostingsBlocks carry no plane) decline
+    here, so every caller keeps serving the legacy path unchanged."""
+    lt = spec.lt
+    if getattr(seg, "codec_version", CODEC_V1) < CODEC_V2:
+        return None
+    pb = seg.postings.get(lt.field)
+    if pb is None or pb.impact is None or pb.size == 0:
+        return None
+    import jax
+
+    from . import compiler as C
+
+    plane = pb.impact
+    window = max(int(spec.window or k), 1)
+    ndocs_pad = seg.ndocs_pad
+    Ccand = min(next_pow2(max(2 * window, CAND_FLOOR)), ndocs_pad)
+    nt = len(lt.terms)
+    rows = np.full(nt, -1, np.int64)
+    for i, t in enumerate(lt.terms):
+        rows[i] = pb.row(t)
+    weights = np.asarray(lt.weights, np.float32)[:nt]
+    if np.any(weights < 0):
+        return None              # negative boosts void the prune bounds
+    sim = lt.sim
+    b_eff = float(sim.b) if lt.has_norms else 0.0
+    avgdlq = float(ctx.avgdl(lt.field))
+    msm = float(lt.msm)
+
+    eps_imp = plane.quant_err() + plane.drift_bound(float(sim.k1), b_eff,
+                                                    avgdlq)
+    offs, lens, bw, kept_post, rem, nblocks, total_post = _plan_blocks(
+        pb, plane, rows, weights, Ccand, spec.prune_ok, window, eps_imp)
+    pruned = rem > 0.0 or kept_post < total_post
+    STATS.inc("blocks_total", nblocks)
+    STATS.inc("blocks_skipped", nblocks - len(offs))
+    STATS.inc("postings_total", total_post)
+    STATS.inc("postings_skipped", total_post - kept_post)
+    if kept_post == 0:
+        # no queried term has postings here: an exact empty page
+        STATS.inc("served")
+        z = np.full(window, -np.inf, np.float32)
+        return {"topk_key": z, "topk_idx": np.full(window, -1, np.int32),
+                "topk_scores": z, "total": 0, "max_score": -np.inf,
+                "total_rel": "eq"}
+
+    B_pad = next_pow2(len(offs), floor=8)
+    bstart = np.zeros(B_pad, np.int32)
+    blen = np.zeros(B_pad, np.int32)
+    bweight = np.zeros(B_pad, np.float32)
+    bstart[: len(offs)] = offs.astype(np.int32)
+    blen[: len(offs)] = lens
+    bweight[: len(offs)] = bw
+    bucket = ops.pick_bucket(kept_post)
+
+    arrs = seg.device_arrays()
+    post = arrs["postings"][lt.field]
+    cost = _qc.current()
+    if cost is not None:
+        # actual moved bytes of the eager pass: doc i32 + u8/u16 impact
+        # per gathered slot — the codec-v2 byte-volume claim, measured
+        cost.note_actual(bucket * (4 + plane.bits // 8), kept_post,
+                         Ccand, path="impact", segment=seg)
+    with TRACER.span("impactpath.gather", blocks=int(len(offs)),
+                     bucket=bucket), METRICS.timer("impactpath.gather"):
+        prog = C.build_impact_program(B_pad, bucket, Ccand, plane.bits)
+        vals, idx, total = jax.device_get(prog(
+            post["doc_ids"], post["impacts"], arrs["live"], bstart, blen,
+            bweight, np.float32(1.0 if pruned else msm)))
+    vals = np.asarray(vals)
+    idx = np.asarray(idx)
+    nvalid = int((vals > -np.inf).sum())
+    total = int(total)
+    rel = "gte" if pruned else "eq"
+
+    if nvalid == 0:
+        if pruned:
+            # matches may hide entirely in pruned blocks
+            STATS.inc("escalated")
+            return None
+        STATS.inc("served")
+        z = np.full(window, -np.inf, np.float32)
+        return {"topk_key": z, "topk_idx": np.full(window, -1, np.int32),
+                "topk_scores": z, "total": 0, "max_score": -np.inf,
+                "total_rel": "eq"}
+
+    cand = idx[:nvalid].astype(np.int64)
+    exact, counts = _exact_scores(seg, lt.field, rows, weights,
+                                  float(sim.k1), b_eff, avgdlq, cand)
+    pass_msm = counts >= msm
+    exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
+    n_pass = int(pass_msm.sum())
+    order = np.lexsort((cand, -exact_m))
+    theta = (float(exact_m[order[window - 1]]) if n_pass >= window
+             else -np.inf)
+    E = _error_bound(plane, weights, rows, float(sim.k1), b_eff, avgdlq)
+
+    # displacement bound for every non-candidate doc: seen-but-lost docs
+    # (only exist when the kernel window filled) carry approx ≤ the C-th
+    # approx value plus quant/drift error plus whatever pruning hid;
+    # never-seen docs are bounded by the pruned remainder PLUS the same
+    # error term (the sidecar prices blocks in the quantized domain —
+    # the true f32 contribution can sit up to eps above it)
+    bound = (rem + E) if pruned else -np.inf
+    if nvalid == Ccand:
+        bound = max(bound, float(vals[nvalid - 1]) + E + rem)
+    if theta > -np.inf and bound < theta:
+        STATS.inc("served")
+        if pruned:
+            STATS.inc("pruned_served")
+        tot = total if not pruned or msm <= 1 else n_pass
+        return _result(exact_m, cand, order, window, tot, rel)
+    if not pruned and nvalid < Ccand:
+        # the candidate set IS every matching doc: exact by construction
+        # (window may be short — that's the true result set)
+        STATS.inc("served")
+        return _result(exact_m, cand, order, window, total, "eq")
+
+    # ---- phase 2: widen to every doc any kept block mentions — unseen
+    # docs are then bounded by the pruned remainder alone ----
+    if pruned:
+        if _fr.RECORDER.enabled and _fr.current():
+            _fr.RECORDER.record(_fr.current(), "impactpath.rung",
+                                rung="phase2_union", blocks=int(len(offs)))
+        with TRACER.span("impactpath.phase2", postings=kept_post), \
+                METRICS.timer("impactpath.phase2"):
+            ids = [pb.doc_ids[int(o): int(o) + int(l)]
+                   for o, l in zip(offs, lens)]
+            union = np.unique(np.concatenate(ids)).astype(np.int64)
+            if len(union) and seg.live_count != seg.ndocs:
+                union = union[seg.live[union]]
+            exact2, counts2 = _exact_scores(seg, lt.field, rows, weights,
+                                            float(sim.k1), b_eff, avgdlq,
+                                            union)
+            pass2 = counts2 >= msm
+            exact2_m = np.where(pass2, exact2, -np.inf).astype(np.float32)
+            n2 = int(pass2.sum())
+            order2 = np.lexsort((union, -exact2_m))
+            theta2 = (float(exact2_m[order2[window - 1]])
+                      if n2 >= window else -np.inf)
+            # + E: the remainder is a quantized-domain price; the true
+            # exact contribution of a pruned posting can exceed it by
+            # the per-term quant/drift epsilon
+            if theta2 > -np.inf and rem + E < theta2:
+                STATS.inc("served")
+                STATS.inc("pruned_served")
+                STATS.inc("phase2_served")
+                return _result(exact2_m, union, order2, window, n2, "gte")
+
+    STATS.inc("escalated")
+    if _fr.RECORDER.enabled and _fr.current():
+        _fr.RECORDER.record(_fr.current(), "impactpath.rung",
+                            rung="dense_escalation")
+    return None

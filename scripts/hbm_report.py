@@ -99,12 +99,46 @@ def build_report(ndocs: int, docs_path=None, queries_path=None) -> dict:
     else:
         queries = _default_queries()
 
+    from opensearch_tpu.search import impactpath
+
+    ip0 = impactpath.stats()
     costs = []
     for body in queries:
         resp = client.search("report", dict(body, profile=True))
         cost = resp.get("profile", {}).get("cost")
         if cost:
             costs.append(cost)
+    ip1 = impactpath.stats()
+
+    # codec-v2 impact stamp: version mix, plane bytes vs the f32 tf
+    # bytes they replace, and the replay's device block-skip rate
+    eng = client.node.indices["report"].shards[0]
+    mix = eng.codec_mix()
+    imp_bytes = sidecar_bytes = f32_eq = 0
+    bits = set()
+    for seg in eng.segments:
+        for pb in seg.postings.values():
+            if pb.impact is None:
+                continue
+            imp_bytes += int(pb.impact.q.nbytes)
+            sidecar_bytes += int(pb.impact.block_max.nbytes
+                                 + pb.impact.block_off.nbytes
+                                 + pb.impact.block_starts.nbytes)
+            f32_eq += int(pb.tfs.nbytes)
+            bits.add(pb.impact.bits)
+    blk_tot = ip1["blocks_total"] - ip0["blocks_total"]
+    impacts = {
+        "codec_mix": {f"v{k}": v for k, v in sorted(mix.items())},
+        "impact_bits": sorted(bits),
+        "impact_plane_bytes": imp_bytes,
+        "block_sidecar_bytes": sidecar_bytes,
+        "f32_tf_equivalent_bytes": f32_eq,
+        "block_skip_rate": (round((ip1["blocks_skipped"]
+                                   - ip0["blocks_skipped"]) / blk_tot, 4)
+                            if blk_tot else 0.0),
+        "path_counters": {k: ip1[k] - ip0[k] for k in ip1
+                          if ip1[k] != ip0[k]},
+    }
 
     return {
         "ndocs": len(docs),
@@ -114,6 +148,7 @@ def build_report(ndocs: int, docs_path=None, queries_path=None) -> dict:
         "segments": {str(k): v for k, v in
                      LEDGER.segment_residency().items()},
         "bytes_per_query": query_cost.bytes_per_query_stamp(),
+        "impacts": impacts,
         "per_query_costs": costs,
     }
 
@@ -153,6 +188,12 @@ def main(argv=None) -> int:
     print(f"bytes/query: actual {bq['actual']}  predicted "
           f"{bq['predicted']}  pred/actual% "
           f"{bq['predicted_vs_actual_pct']}")
+    im = rep["impacts"]
+    print(f"impacts: codec {im['codec_mix']}  "
+          f"plane {_fmt_bytes(im['impact_plane_bytes'])} "
+          f"(+sidecar {_fmt_bytes(im['block_sidecar_bytes'])}) vs f32 tf "
+          f"{_fmt_bytes(im['f32_tf_equivalent_bytes'])}  "
+          f"block-skip {im['block_skip_rate']}")
     return 0
 
 

@@ -21,11 +21,19 @@ A final flight-recorder pair re-runs the (32-thread, deepest-depth) cell
 with the recorder pinned ON vs OFF (obs/flight_recorder.py; on is the
 process default) — responses must stay byte-identical in both, and the
 recorder-overhead gate requires recorder-on qps >= 0.98x recorder-off
-(`extra.concurrency.recorder_overhead_32t` in the BENCH json). A second
+(`extra.concurrency.recorder_overhead_32t` in the BENCH json). The pair
+is box-condition robust: one warmup cell, then alternating
+off/on/on/off/off/on reps in the SAME process (each label early, middle
+and late cancels warmup/thermal/neighbor drift), gated on the paired
+best-of-reps ratio with the threshold relaxed to the measured
+within-label noise floor — a shared container's neighbors swing single
+reps 10-20%, which is how PR 7 observed a ~0.3x false red at an
+unmodified HEAD. A second
 pair does the same for HBM-ledger + per-query cost accounting
 (obs/query_cost.py) on the direct host-loop path (scheduler and mesh
-off, where the accounting engages): cost-on qps >= 0.98x cost-off with
-byte-identical responses (`extra.concurrency.cost_overhead_32t`), and
+off, where the accounting engages): cost-on vs cost-off under the same
+alternating-reps/noise-floor protocol with byte-identical responses
+(`extra.concurrency.cost_overhead_32t`), and
 the run stamps `extra.hbm` (peak resident bytes by tenant kind) +
 `extra.bytes_per_query` (predicted/actual DDSketch percentiles) — the
 committed byte-domain baseline for ROADMAP item 1.
@@ -277,14 +285,27 @@ def main():
             by_key[(nthreads, mname)] = cell
             print(json.dumps(cell), flush=True)
 
-    # recorder-overhead pair: the same (32-thread, deepest-pipeline)
-    # cell back-to-back with the flight recorder pinned ON vs OFF — the
-    # black box must ride along for ~free (gate: on-qps >= 0.98x off)
+    # recorder-overhead pair: the (32-thread, deepest-pipeline) cell with
+    # the flight recorder pinned ON vs OFF — the black box must ride
+    # along for ~free (gate: on-qps >= 0.98x off). Box-condition
+    # robustness (ISSUE 8; PR 7 measured a ~0.3x FALSE red at an
+    # unmodified HEAD on a noisy container): both labels run in THIS
+    # process, in ALTERNATING order (off/on/on/off — each label runs once
+    # early and once late, cancelling warmup and thermal/neighbor drift),
+    # after a warmup cell at the same shape, and the gate compares the
+    # PAIRED best-of-reps ratio — a GC pause or cron burst that lands in
+    # one rep no longer fails the run.
     rec_pair = {}
     rthreads = 32 if 32 in thread_counts else thread_counts[-1]
     rdepth = max(depths)
-    for rlabel, rflag in (("rec_on", True), ("rec_off", False)):
-        tag = f"{rthreads}-d{rdepth}-{rlabel}"
+    run_cell(client, bodies, rthreads, rdepth,
+             f"{rthreads}-d{rdepth}-rec-warmup")
+    rec_reps = {"rec_on": [], "rec_off": []}
+    for rep, (rlabel, rflag) in enumerate(
+            (("rec_off", False), ("rec_on", True),
+             ("rec_on", True), ("rec_off", False),
+             ("rec_off", False), ("rec_on", True))):
+        tag = f"{rthreads}-d{rdepth}-{rlabel}-r{rep}"
         cell, results = run_cell(client, bodies, rthreads, rdepth, tag,
                                  recorder=rflag)
         errored += cell["errors"]
@@ -294,8 +315,10 @@ def main():
         cell["identical_responses"] = bad == 0
         mismatched += bad
         cells.append(cell)
-        rec_pair[rlabel] = cell
+        rec_reps[rlabel].append(cell)
         print(json.dumps(cell), flush=True)
+    rec_pair = {lab: max(reps, key=lambda c: c["qps"])
+                for lab, reps in rec_reps.items()}
 
     # ledger+cost overhead pair: scheduler AND mesh off, so every request
     # runs the host shard loop where per-query cost accounting engages
@@ -307,22 +330,31 @@ def main():
     # discipline as the PR 6 recorder gate; mesh-vs-host parity has its
     # own tests and is not re-litigated here).
     cost_pair = {}
+    cost_reps = {"cost_off": [], "cost_on": []}
     cost_digests = {}
     mesh_saved = client.node.mesh_service
     client.node.mesh_service = None
     try:
         run_cell(client, bodies, rthreads, None,
                  f"{rthreads}-direct-warmup", cost=False)
-        for clabel, cflag in (("cost_off", False), ("cost_on", True)):
-            tag = f"{rthreads}-direct-{clabel}"
+        # same box-noise discipline as the recorder pair: alternating
+        # reps in one process, byte-identity within the pair, paired
+        # best-of-reps ratio against a noise-floor-relaxed threshold
+        for rep, (clabel, cflag) in enumerate(
+                (("cost_off", False), ("cost_on", True),
+                 ("cost_on", True), ("cost_off", False))):
+            tag = f"{rthreads}-direct-{clabel}-r{rep}"
             cell, results = run_cell(client, bodies, rthreads, None, tag,
                                      cost=cflag)
             errored += cell["errors"]
-            cost_digests[clabel] = [strip_took(r) if r is not None
-                                    else None for r in results]
+            cost_digests.setdefault(clabel, [strip_took(r)
+                                             if r is not None else None
+                                             for r in results])
             cells.append(cell)
-            cost_pair[clabel] = cell
+            cost_reps[clabel].append(cell)
             print(json.dumps(cell), flush=True)
+        cost_pair = {lab: max(reps, key=lambda c: c["qps"])
+                     for lab, reps in cost_reps.items()}
         pair_bad = sum(1 for a, b in zip(cost_digests["cost_off"],
                                          cost_digests["cost_on"])
                        if a != b)
@@ -349,19 +381,45 @@ def main():
     summary["bytes_per_query"] = bpq_stamp
     if cost_pair:
         on_c, off_c = cost_pair["cost_on"], cost_pair["cost_off"]
+        cnoise = max(
+            (1.0 - min(c["qps"] for c in reps)
+             / max(max(c["qps"] for c in reps), 1e-9))
+            for reps in cost_reps.values())
         summary["cost_overhead_32t"] = {
             "threads": rthreads, "mode": "direct",
+            "protocol": "warmup + alternating off/on/on/off reps; paired "
+                        "best-of-reps ratio, noise-floor threshold",
             "cost_on_qps": on_c["qps"],
             "cost_off_qps": off_c["qps"],
+            "cost_on_reps": [c["qps"] for c in cost_reps["cost_on"]],
+            "cost_off_reps": [c["qps"] for c in cost_reps["cost_off"]],
+            "noise_floor": round(cnoise, 4),
             "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+            "gate_threshold": round(min(0.98, 1.0 - cnoise), 4),
         }
     if rec_pair:
         on_c, off_c = rec_pair["rec_on"], rec_pair["rec_off"]
+        # the gate cannot resolve an effect smaller than the box's own
+        # within-label rep-to-rep spread: the threshold relaxes to the
+        # measured noise floor (a shared container's neighbors routinely
+        # swing single reps 10-20% — the PR 7 false red)
+        noise = max(
+            (1.0 - min(c["qps"] for c in reps)
+             / max(max(c["qps"] for c in reps), 1e-9))
+            for reps in rec_reps.values())
         summary["recorder_overhead_32t"] = {
             "threads": rthreads, "mode": f"d{rdepth}",
+            "protocol": "warmup + alternating off/on/on/off/off/on reps "
+                        "in one process; paired best-of-reps ratio, "
+                        "threshold relaxed to the within-label noise "
+                        "floor",
             "recorder_on_qps": on_c["qps"],
             "recorder_off_qps": off_c["qps"],
+            "recorder_on_reps": [c["qps"] for c in rec_reps["rec_on"]],
+            "recorder_off_reps": [c["qps"] for c in rec_reps["rec_off"]],
+            "noise_floor": round(noise, 4),
             "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+            "gate_threshold": round(min(0.98, 1.0 - noise), 4),
         }
     off32 = by_key.get((32, "off"))
     on32 = by_key.get((32, f"d{depths[0]}"))
@@ -430,17 +488,19 @@ def main():
                     f"pipelined dispatch shows no win at 32 threads: "
                     f"qps_gain={p['qps_gain']} overlap {d1_ov} -> {dp_ov}")
         rp = summary.get("recorder_overhead_32t")
-        if rp and rp["qps_ratio"] < 0.98:
+        if rp and rp["qps_ratio"] < rp["gate_threshold"]:
             raise SystemExit(
                 f"flight-recorder overhead gate failed: recorder-on qps "
-                f"is {rp['qps_ratio']}x recorder-off (< 0.98x) at "
-                f"{rp['threads']} threads")
+                f"is {rp['qps_ratio']}x recorder-off "
+                f"(< {rp['gate_threshold']}x; within-label noise floor "
+                f"{rp['noise_floor']}) at {rp['threads']} threads")
         cp = summary.get("cost_overhead_32t")
-        if cp and cp["qps_ratio"] < 0.98:
+        if cp and cp["qps_ratio"] < cp["gate_threshold"]:
             raise SystemExit(
                 f"ledger+cost overhead gate failed: cost-on qps is "
-                f"{cp['qps_ratio']}x cost-off (< 0.98x) at "
-                f"{cp['threads']} threads")
+                f"{cp['qps_ratio']}x cost-off "
+                f"(< {cp['gate_threshold']}x; noise floor "
+                f"{cp['noise_floor']}) at {cp['threads']} threads")
     print("OK", flush=True)
 
 

@@ -187,15 +187,18 @@ class TestPartialPromotion:
         # NOT stacked on top of the full pytree's charge (the
         # "later full device_arrays() reuses nothing" double-charge)
         seg.device_arrays(None)
-        full_alloc = seg.__dict__["_hbm_allocs"][None]
-        assert breaker.used == used0 + full_alloc.nbytes
+        # codec v2 splits the full build across per-kind allocations
+        # (segment_columns + impact_postings + advisory block_max)
+        full_bytes = sum(a.nbytes for a in
+                         seg.__dict__["_hbm_allocs"][None] if a.charged)
+        assert breaker.used == used0 + full_bytes
         assert not any(k[0] is None for k in
                        seg.__dict__.get("_field_device_allocs", {}))
         assert all(not a.live for a in partial_allocs.values())
         # and pruned_arrays now serves from the full pytree, charging
         # nothing new
         seg.pruned_arrays(None, {"postings": {"status"}})
-        assert breaker.used == used0 + full_alloc.nbytes
+        assert breaker.used == used0 + full_bytes
         assert not LEDGER.verify_breakers()
 
     def test_drop_device_releases_eagerly(self):
@@ -288,12 +291,29 @@ class TestQueryCost:
             "body": "alpha beta gamma"}}, "profile": True})
         cost = r["profile"]["cost"]
         # predicted, from CSR stats alone: (3 + 3 + 2) true postings,
-        # 8 bytes per (doc_id i32, tf f32) slot
+        # 6 bytes per codec-v2 slot (doc_id i32 + u16 quantized impact)
+        assert cost["predicted_bytes_gathered"] == 8 * 6
+        assert cost["predicted_scatter_adds"] == 8
+        # actual, from the launched program shape: the eager impact pass
+        # (search/impactpath.py) flattens the kept blocks into
+        # pick_bucket(8) = 256 slots (pow2 floor 256) of 6 bytes; the
+        # scatter count is the TRUE kept posting count
+        assert cost["actual_bytes_gathered"] == 256 * 6
+        assert cost["actual_scatter_adds"] == 8
+        assert cost["launches"] == 1
+        assert cost["predicted_vs_actual_pct"] == pytest.approx(
+            100.0 * 48 / 1536, abs=0.01)
+
+    def test_profile_cost_v1_oracle(self, monkeypatch):
+        """The legacy codec keeps the 8-byte slot model and the XLA
+        bucket-gather actuals."""
+        monkeypatch.setenv("OPENSEARCH_TPU_CODEC", "1")
+        c = self._fixed_corpus()
+        r = c.search("hbmt", {"query": {"match": {
+            "body": "alpha beta gamma"}}, "profile": True})
+        cost = r["profile"]["cost"]
         assert cost["predicted_bytes_gathered"] == 8 * 8
         assert cost["predicted_scatter_adds"] == 8
-        # actual, from the launched program shape: the XLA path flattens
-        # the group into pick_bucket(8) = 256 slots (pow2 floor 256),
-        # one segment, one launch -> 256 * 8 = 2048 bytes
         assert cost["actual_bytes_gathered"] == 256 * 8
         assert cost["actual_scatter_adds"] == 256
         assert cost["launches"] == 1
@@ -305,10 +325,11 @@ class TestQueryCost:
         r = c.search("hbmt", {"query": {"match": {"body": "alpha beta"}},
                               "explain": "device_plan"})
         plan = r["device_plan"]
-        assert plan["cost"]["predicted_bytes_gathered"] == 6 * 8
+        # 6 postings x 6-byte codec-v2 slots
+        assert plan["cost"]["predicted_bytes_gathered"] == 6 * 6
         segs = plan["segments"]
         assert any("predicted_bytes_gathered" in e for e in segs)
-        assert any(e.get("path") == "xla" for e in segs)
+        assert any(e.get("path") in ("xla", "impact") for e in segs)
         # device_plan must not attach per-hit _explanation trees
         assert all("_explanation" not in h for h in r["hits"]["hits"])
 

@@ -796,6 +796,105 @@ class TestMemoryAccounting:
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 
+class TestImpactDomain:
+    """OSL507 — codec-v2 quantized-impact domain discipline."""
+
+    def test_osl507_raw_astype_promotion(self):
+        src = """
+            import numpy as np
+
+            def score(plane, w):
+                return w * plane.block_max.astype(np.float32)
+        """
+        assert "OSL507" in rules_of(lint(src))
+
+    def test_osl507_raw_float32_ctor(self):
+        src = """
+            import numpy as np
+
+            def bound(impacts, w):
+                return w * np.float32(impacts[0])
+        """
+        assert "OSL507" in rules_of(lint(src))
+
+    def test_osl507_quiet_through_dequant_helper(self):
+        src = """
+            from opensearch_tpu.ops.scoring import dequant_impact_np
+
+            def score(plane, w):
+                return w * dequant_impact_np(plane.block_max, plane.scale)
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl507_helper_definition_file_exempt(self):
+        src = """
+            import numpy as np
+
+            def dequant_impact_np(impacts, scale):
+                return impacts.astype(np.float32) * np.float32(scale)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/ops/scoring.py")) == []
+
+    def test_osl507_version_blind_layout_branch(self):
+        # search/ code branching on .impact without consulting
+        # Segment.codec_version in the same function
+        src = """
+            def serve(seg, pb):
+                if pb.impact is not None:
+                    return "v2"
+                return "v1"
+        """
+        assert "OSL507" in rules_of(lint(src))
+
+    def test_osl507_quiet_when_codec_version_consulted(self):
+        src = """
+            CODEC_V2 = 2
+
+            def serve(seg, pb):
+                if seg.codec_version >= CODEC_V2 and pb.impact is not None:
+                    return "v2"
+                return "v1"
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl507_quiet_getattr_probe(self):
+        # the facade-tolerant duck probe is not a layout branch
+        src = """
+            def probe(pb):
+                return getattr(pb, "impact", None)
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl507_layout_branch_outside_search_quiet(self):
+        src = """
+            def serve(seg, pb):
+                if pb.impact is not None:
+                    return "v2"
+                return "v1"
+        """
+        assert rules_of(lint(src, "opensearch_tpu/index/merge.py")) == []
+
+    def test_osl507_magic_codec_literal(self):
+        src = """
+            CODEC_V2 = 2
+
+            def gate(seg, pb):
+                if seg.codec_version >= 2 and pb.impact is not None:
+                    return True
+                return False
+        """
+        assert "OSL507" in rules_of(lint(src))
+
+    def test_osl507_suppression(self):
+        src = """
+            import numpy as np
+
+            def stamp(plane):
+                return float(plane.block_max[0])  # oslint: disable=OSL507 -- report stamp, not score math
+        """
+        assert rules_of(lint(src)) == []
+
+
 class TestSuppressionAndBaseline:
     SRC = """
         def doc_count(fagg, bi):
