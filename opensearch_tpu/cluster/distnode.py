@@ -39,11 +39,26 @@ Design (primaries-only v1, documented):
   transport is binary object serialization for the same reason. The
   `/_internal/*` surface is a trusted node-to-node wire (security is a
   declared exclusion, SURVEY §2.9).
-- **Failure**: a dead member fails only ITS shards — the coordinator
-  serves partial results and reports `_shards.failed` (reference
-  allow_partial_search_results=true default). The kill-one-node test
-  (`tests/test_distnode.py`) asserts the survivor keeps serving its
-  shards' data.
+- **Failure domain** (docs/RESILIENCE.md): every `/_internal` RPC
+  carries the request's remaining deadline budget (`deadline_ctx`,
+  stamped exactly like the `trace_ctx`/`obs_ctx` pair) and derives its
+  socket timeout from it — `min(remaining, cap)` instead of a fixed
+  per-hop 30 s; a hop arriving with an exhausted budget answers an
+  immediate 408 shard failure. A failed shard RPC retries in place with
+  jittered exponential backoff under a per-request retry budget, then
+  FAILS OVER to the shard's next copy (`number_of_node_replicas` copies
+  assigned at create_index; `MemberFailureDetector` findings demote
+  suspect members in the preference order). A shard with no live copy
+  left fails honestly: `_shards.failed` with per-shard reasons,
+  `timed_out`/`terminated_early` response flags, and
+  `allow_partial_search_results=false` converting any partiality into a
+  whole-request error (reference parity). Fetch never fails over — doc
+  coordinates are copy-local, so fetch sticks to the copy that ran the
+  query phase (reference query-and-fetch affinity) and a copy lost
+  between phases fails its shard. The seeded chaos harness
+  (`cluster/faults.py`) injects drop/delay/error/blackhole at the RPC
+  send/receive sites so the kill-one-node and deadline tests replay
+  exact interleavings.
 
 Unsupported on a distributed index (explicit 400, never silently wrong):
 non-`_score` sorts, collapse, rescore, search_after/scroll/PIT, suggest,
@@ -59,6 +74,7 @@ import contextlib
 import json
 import os
 import pickle
+import random
 import threading
 import time
 import urllib.error
@@ -72,10 +88,101 @@ from ..search import query_dsl as dsl
 from ..search.aggregations import parse_aggs
 from ..search.executor import (Candidate, ShardQueryResult,
                                _global_stats_contexts, reduce_shard_results)
+from ..utils import deadline as _dl
+from . import faults as _faults
+from .failure import MemberFailureDetector
 from .node import Node
-from .routing import shard_for
+from .routing import assign_copies, order_copies, shard_for
 
-_RPC_TIMEOUT_S = 30.0
+# transport cap, NOT the per-hop timeout: every RPC derives its actual
+# socket timeout from the request's remaining deadline budget
+# (min(remaining, cap)); only deadline-less requests see the full cap
+_RPC_TIMEOUT_CAP_S = float(os.environ.get("OPENSEARCH_TPU_RPC_CAP_S",
+                                          30.0))
+
+
+class RetryPolicy:
+    """Per-shard retry + failover knobs (docs/RESILIENCE.md). In-place
+    retries are jittered-exponential-backoff re-sends to the SAME member
+    (transient blips); the per-request `budget` bounds total retries
+    across all shards so a sick cluster degrades to honest shard
+    failures instead of a retry storm; `storm_n` is the request-level
+    retry count that freezes a flight-recorder dump."""
+
+    def __init__(self, same_member_retries: Optional[int] = None,
+                 budget: Optional[int] = None,
+                 base_backoff_s: float = 0.025,
+                 backoff_mult: float = 2.0,
+                 max_backoff_s: float = 0.5,
+                 storm_n: Optional[int] = None):
+        env = os.environ
+        self.same_member_retries = int(
+            same_member_retries if same_member_retries is not None
+            else env.get("OPENSEARCH_TPU_RPC_RETRIES", 1))
+        self.budget = int(budget if budget is not None
+                          else env.get("OPENSEARCH_TPU_RETRY_BUDGET", 4))
+        self.base_backoff_s = float(base_backoff_s)
+        self.backoff_mult = float(backoff_mult)
+        self.max_backoff_s = float(max_backoff_s)
+        # storm threshold defaults to the retry budget: a request that
+        # burns its WHOLE budget is the forensic moment (a default
+        # above the budget would make the dump unreachable — retries
+        # are capped at the budget)
+        self.storm_n = int(storm_n if storm_n is not None
+                           else env.get("OPENSEARCH_TPU_RETRY_STORM_N",
+                                        self.budget))
+
+
+class _ShardCallFailed(Exception):
+    """One member terminally failed a shard-group call (retries spent).
+    `reason` is the per-shard failure record the response surfaces."""
+
+    def __init__(self, member: str, kind: str, attempts: int):
+        super().__init__(f"[{member}] {kind} after {attempts} attempt(s)")
+        self.member = member
+        self.kind = kind
+        self.attempts = attempts
+
+
+class _RequestState:
+    """Per-request resilience accounting: the deadline, the shared retry
+    budget, the deterministic backoff RNG (seeded from the installed
+    chaos schedule so replayed interleavings draw identical jitter), and
+    the flags/failure reasons the response assembly reads."""
+
+    def __init__(self, policy: RetryPolicy, dl, tl: int):
+        self.policy = policy
+        self.dl = dl
+        self.tl = tl
+        self.retries = 0
+        self.failovers = 0
+        self.timed_out = False
+        self.storm_fired = False
+        sched = _faults.installed()
+        self.rng = random.Random(sched.seed if sched is not None else None)
+
+    def rpc_timeout_s(self) -> float:
+        if self.dl is None:
+            return _RPC_TIMEOUT_CAP_S
+        return self.dl.rpc_timeout_s(_RPC_TIMEOUT_CAP_S)
+
+    def take_retry(self) -> bool:
+        if self.retries >= self.policy.budget:
+            return False
+        self.retries += 1
+        return True
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full-jitter exponential backoff, bounded by the cap and by
+        the remaining deadline (never sleep past the budget)."""
+        p = self.policy
+        ceil = min(p.base_backoff_s * (p.backoff_mult ** max(attempt - 1,
+                                                             0)),
+                   p.max_backoff_s)
+        b = self.rng.uniform(0.0, ceil)
+        if self.dl is not None:
+            b = min(b, max(self.dl.remaining_s(), 0.0))
+        return b
 
 
 # ---------------------------------------------------------------------
@@ -169,7 +276,7 @@ def _unb64(s: str):
 
 
 def _http(addr: str, method: str, path: str, payload=None,
-          timeout: float = _RPC_TIMEOUT_S) -> dict:
+          timeout: float = _RPC_TIMEOUT_CAP_S) -> dict:
     data = json.dumps(payload).encode() if payload is not None else None
     headers = {"Content-Type": "application/json"}
     # shared-secret node-to-node trust: when the cluster runs with REST
@@ -203,7 +310,8 @@ class DistClusterNode:
     """
 
     def __init__(self, name: str, seed: Optional[str] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 retry_policy: Optional[RetryPolicy] = None):
         self.name = name
         self.node = Node()
         self.client = RestClient(node=self.node)
@@ -217,8 +325,16 @@ class DistClusterNode:
         self.version = 0
         self.leader = name if seed is None else None
         self.members: Dict[str, str] = {name: self.addr}
+        # primary owner per shard (back-compat view of copies[...][0])
         self.routing: Dict[str, Dict[int, str]] = {}   # index -> shard -> node
+        # full copy lists, primary first (index -> shard -> [members])
+        self.copies: Dict[str, Dict[int, List[str]]] = {}
         self.index_bodies: Dict[str, dict] = {}
+        self.retry_policy = retry_policy or RetryPolicy()
+        # member-level failure detection feeding copy selection: suspect
+        # members are demoted in every shard's preference order until a
+        # successful probe/RPC (cluster/failure.py)
+        self.member_fd = MemberFailureDetector()
         if seed is not None:
             st = _http(seed, "POST", "/_internal/join",
                        {"name": name, "addr": self.addr})
@@ -231,6 +347,8 @@ class DistClusterNode:
                 "leader": self.leader, "members": self.members,
                 "routing": {i: {str(s): n for s, n in r.items()}
                             for i, r in self.routing.items()},
+                "copies": {i: {str(s): list(c) for s, c in r.items()}
+                           for i, r in self.copies.items()},
                 "index_bodies": self.index_bodies}
 
     def _apply_state(self, st: dict) -> None:
@@ -241,6 +359,11 @@ class DistClusterNode:
             self.members = dict(st["members"])
             self.routing = {i: {int(s): n for s, n in r.items()}
                             for i, r in st["routing"].items()}
+            # pre-copies states (rolling upgrade shape): primaries only
+            self.copies = {i: {int(s): list(c) for s, c in r.items()}
+                           for i, r in st.get("copies", {}).items()}
+            for i, r in self.routing.items():
+                self.copies.setdefault(i, {s: [n] for s, n in r.items()})
             self.index_bodies = dict(st["index_bodies"])
             # idempotently materialize any index this node doesn't have yet
             for iname, body in self.index_bodies.items():
@@ -256,19 +379,30 @@ class DistClusterNode:
         with self._lock:
             self.version += 1
             st = self._state()
+        from ..utils.metrics import METRICS
         for name, addr in list(self.members.items()):
             if name == self.name:
                 continue
             try:
                 _http(addr, "POST", "/_internal/publish", {"state": st})
             except (urllib.error.URLError, OSError):
-                pass
+                # best-effort publish by design — but never silently:
+                # the member keeps its shards in routing and searches
+                # report them failed until it rejoins (OSL508)
+                METRICS.counter("dist.publish.failed").inc()
 
     # ---------------- internal RPC handler (called by HttpServer) --------
 
     def handle_internal(self, method: str, parts: List[str], body: dict
                         ) -> Tuple[int, dict]:
         op = parts[1] if len(parts) > 1 else ""
+        if _faults.enabled():
+            # serving-side chaos site: a rule here makes THIS node the
+            # slow/flaky one (cluster/faults.py)
+            _faults.on_rpc_recv(self.name, op)
+        if op == "ping" and method == "GET":
+            # failure-detector probe target (cluster/failure.py)
+            return 200, {"ok": True, "node": self.name}
         if op == "join" and method == "POST":
             with self._lock:
                 self.members[body["name"]] = body["addr"]
@@ -277,29 +411,20 @@ class DistClusterNode:
         if op == "publish" and method == "POST":
             self._apply_state(body["state"])
             return 200, {"acknowledged": True}
-        if op == "dfs" and method == "POST":
-            with self._rpc_span("dist.dfs", body) as s, \
-                    self._rpc_timeline("dfs", body) as rtl:
-                rec = self._local_dfs(body["index"], body["body"])
-            return 200, {"rec": _b64(rec), "span": self._span_out(s),
-                         "obs": self._obs_out(rtl)}
-        if op == "query_phase" and method == "POST":
-            with self._rpc_span("dist.query_phase", body) as s, \
-                    self._rpc_timeline("query_phase", body) as rtl:
-                results = self._local_query(body["index"], body["body"],
-                                            _unb64(body["g"]))
-            return 200, {"results": _b64(results),
-                         "span": self._span_out(s),
-                         "obs": self._obs_out(rtl)}
-        if op == "fetch_phase" and method == "POST":
-            with self._rpc_span("dist.fetch_phase", body) as s, \
-                    self._rpc_timeline("fetch_phase", body) as rtl:
-                hits = self._local_fetch(body["index"], body["body"],
-                                         int(body["shard"]),
-                                         _unb64(body["cands"]),
-                                         _unb64(body["g"]))
-            return 200, {"hits": _b64(hits), "span": self._span_out(s),
-                         "obs": self._obs_out(rtl)}
+        if op in ("dfs", "query_phase", "fetch_phase"):
+            # deadline propagation: re-anchor the remaining budget the
+            # coordinator stamped; an already-exhausted budget answers an
+            # immediate 408 shard failure instead of a full local phase
+            dl = _dl.Deadline.from_wire(body.get("deadline_ctx"))
+            if dl is not None and dl.exhausted():
+                from ..utils.metrics import METRICS
+                METRICS.counter("dist.deadline.expired_on_arrival").inc()
+                return 408, {"error": {
+                    "type": "request_timeout_exception",
+                    "reason": f"[{op}] arrived with an exhausted "
+                              f"deadline budget"}}
+            with _dl.scope(dl):
+                return self._handle_phase(op, body)
         if op == "state" and method == "GET":
             return 200, {"state": self._state()}
         if op == "create_index" and method == "POST":
@@ -311,6 +436,33 @@ class DistClusterNode:
             return 200, self.search(body["index"], body["body"])
         return 404, {"error": {"type": "resource_not_found_exception",
                                "reason": f"unknown internal op [{op}]"}}
+
+    def _handle_phase(self, op: str, body: dict) -> Tuple[int, dict]:
+        shards = ([int(s) for s in body["shards"]]
+                  if body.get("shards") is not None else None)
+        if op == "dfs":
+            with self._rpc_span("dist.dfs", body) as s, \
+                    self._rpc_timeline("dfs", body) as rtl:
+                recs = self._local_dfs(body["index"], body["body"],
+                                       shards)
+            return 200, {"recs": _b64(recs), "span": self._span_out(s),
+                         "obs": self._obs_out(rtl)}
+        if op == "query_phase":
+            with self._rpc_span("dist.query_phase", body) as s, \
+                    self._rpc_timeline("query_phase", body) as rtl:
+                results = self._local_query(body["index"], body["body"],
+                                            _unb64(body["g"]), shards)
+            return 200, {"results": _b64(results),
+                         "span": self._span_out(s),
+                         "obs": self._obs_out(rtl)}
+        with self._rpc_span("dist.fetch_phase", body) as s, \
+                self._rpc_timeline("fetch_phase", body) as rtl:
+            hits = self._local_fetch(body["index"], body["body"],
+                                     int(body["shard"]),
+                                     _unb64(body["cands"]),
+                                     _unb64(body["g"]))
+        return 200, {"hits": _b64(hits), "span": self._span_out(s),
+                     "obs": self._obs_out(rtl)}
 
     # ---------------- trace propagation over the wire ----------------
     #
@@ -370,12 +522,22 @@ class DistClusterNode:
         from ..obs import flight_recorder as _fr
         return _fr.RECORDER.timeline_events(tl)
 
-    def _rpc(self, member: str, op: str, payload: dict) -> dict:
+    def _rpc(self, member: str, op: str, payload: dict,
+             timeout_s: Optional[float] = None,
+             dl: Optional[_dl.Deadline] = None) -> dict:
         """Coordinator-side RPC with trace stamping + span grafting +
-        flight-recorder timeline stitching + latency accounting."""
+        flight-recorder timeline stitching + deadline propagation +
+        latency accounting. The socket timeout is deadline-derived
+        (min(remaining, cap)); the remaining budget rides the payload as
+        `deadline_ctx` exactly like `trace_ctx`/`obs_ctx` do."""
         from ..obs import flight_recorder as _fr
         from ..utils.metrics import METRICS
         from ..utils.trace import TRACER
+        if dl is None:
+            dl = _dl.current()
+        if timeout_s is None:
+            timeout_s = (dl.rpc_timeout_s(_RPC_TIMEOUT_CAP_S)
+                         if dl is not None else _RPC_TIMEOUT_CAP_S)
         wctx = TRACER.wire_context()
         if wctx is not None:
             payload = dict(payload,
@@ -384,38 +546,144 @@ class DistClusterNode:
         if tl:
             payload = dict(payload,
                            obs_ctx={"node": self.name, "timeline": tl})
+        if dl is not None:
+            # stamped at send time: the receiving hop re-anchors what is
+            # left, so queue/transit time is charged to the budget
+            payload = dict(payload, deadline_ctx=dl.to_wire())
         t0 = time.monotonic()
         try:
+            if _faults.enabled():
+                # inside the try: injected faults go through the SAME
+                # failure accounting (metrics, detector, events) as real
+                # ones — the harness must not produce divergent journals
+                _faults.on_rpc_send(member, op, timeout_s)
             r = _http(self.members[member], "POST", f"/_internal/{op}",
-                      payload)
-        except Exception:
+                      payload, timeout=timeout_s)
+        except urllib.error.HTTPError as e:
+            if e.code < 500:
+                # the member ANSWERED (408 deadline refusal, 4xx API
+                # error): that is member health, not member death — no
+                # detector demotion, no transport-failure count
+                raise
             METRICS.counter("dist.rpc.failed").inc()
+            self.member_fd.note_failure(member)
             if tl:
                 _fr.RECORDER.record(tl, "rpc.failed", op=op, node=member)
             raise
+        except Exception:
+            METRICS.counter("dist.rpc.failed").inc()
+            self.member_fd.note_failure(member)
+            if tl:
+                _fr.RECORDER.record(tl, "rpc.failed", op=op, node=member)
+            raise
+        self.member_fd.note_success(member)
         METRICS.histogram(f"dist.rpc.{op}").record(
             (time.monotonic() - t0) * 1000.0)
         TRACER.attach_remote(r.get("span"))
         _fr.RECORDER.graft(tl, r.get("obs"), node=member)
         return r
 
+    def _rpc_failsafe(self, member: str, op: str, payload: dict,
+                      rs: _RequestState) -> dict:
+        """`_rpc` under the retry policy: in-place re-sends with jittered
+        exponential backoff for transient failures, bounded by the
+        per-request retry budget and the deadline. Terminal outcomes:
+
+        - `DeadlineExhausted` — the budget ran out (locally, or the
+          remote answered 408); never retried, the shard fails with a
+          timeout reason and the response gets `timed_out: true`.
+        - `_ShardCallFailed` — retries spent; the caller fails the
+          shard over to its next copy (`rpc.failover`) or surfaces it.
+        - Any non-5xx HTTPError — a genuine API error (e.g. 400),
+          re-raised untouched.
+        """
+        from ..obs import flight_recorder as _fr
+        from ..utils.metrics import METRICS
+        attempts = 0
+        while True:
+            if rs.dl is not None and rs.dl.exhausted():
+                rs.timed_out = True
+                METRICS.counter("dist.deadline.exhausted").inc()
+                if rs.tl:
+                    _fr.RECORDER.record(rs.tl, "deadline.exhausted",
+                                        op=op, node=member)
+                raise _dl.DeadlineExhausted(
+                    f"[{op}] to [{member}]: request budget exhausted")
+            try:
+                return self._rpc(member, op, payload,
+                                 timeout_s=rs.rpc_timeout_s(), dl=rs.dl)
+            except urllib.error.HTTPError as e:
+                if e.code == 408:
+                    # the hop measured the budget exhausted — retrying
+                    # cannot help inside the same budget
+                    rs.timed_out = True
+                    METRICS.counter("dist.deadline.exhausted").inc()
+                    if rs.tl:
+                        _fr.RECORDER.record(rs.tl, "deadline.exhausted",
+                                            op=op, node=member)
+                    raise _dl.DeadlineExhausted(
+                        f"[{member}] rejected [{op}]: budget exhausted")
+                if e.code < 500:
+                    raise
+                kind = "internal_error"
+            except (urllib.error.URLError, TimeoutError, OSError):
+                kind = "node_unreachable"
+            attempts += 1
+            if attempts > rs.policy.same_member_retries \
+                    or not rs.take_retry():
+                raise _ShardCallFailed(member, kind, attempts)
+            backoff = rs.backoff_s(attempts)
+            METRICS.counter("dist.rpc.retry").inc()
+            METRICS.histogram("dist.rpc.backoff_ms").record(
+                backoff * 1000.0)
+            if rs.tl:
+                _fr.RECORDER.record(rs.tl, "rpc.retry", op=op,
+                                    node=member, attempt=attempts,
+                                    backoff_ms=round(backoff * 1000.0, 3))
+            if not rs.storm_fired and rs.retries >= rs.policy.storm_n:
+                # retry storm: the forensic moment — freeze the journal
+                # before the request degrades further
+                rs.storm_fired = True
+                if _fr.RECORDER.enabled and rs.tl:
+                    _fr.RECORDER.trigger(
+                        "retry_storm", [rs.tl],
+                        note=f"{rs.retries} retries in one request "
+                             f"(storm_n={rs.policy.storm_n})")
+            if backoff > 0:
+                time.sleep(backoff)
+
     # ---------------- cluster API ----------------
 
     def cluster_state(self) -> dict:
         return self._state()
 
+    @staticmethod
+    def _node_replicas(body: dict) -> int:
+        """`index.number_of_node_replicas` — CROSS-NODE shard copies
+        (distinct from `number_of_replicas`, which allocates intra-node
+        device copies). Default 0: primaries-only, the pre-resilience
+        layout."""
+        settings = (body or {}).get("settings", {}) or {}
+        v = settings.get("index", {}).get(
+            "number_of_node_replicas",
+            settings.get("number_of_node_replicas", 0))
+        return max(int(v), 0)
+
     def create_index(self, name: str, body: dict) -> dict:
-        """Leader-only (forwarded if called on a follower): create on every
-        member, assign shard owners round-robin over sorted member names."""
+        """Leader-only (forwarded if called on a follower): create on
+        every member, assign each shard an ordered COPY list (primary
+        first, `number_of_node_replicas` extra members) round-robin over
+        sorted member names."""
         if self.leader != self.name:
             return _http(self.members[self.leader], "POST",
                          f"/_internal/create_index/{name}", body)
         with self._lock:
             self.client.indices.create(name, body)
             n_shards = self.node.indices[name].meta.num_shards
-            order = sorted(self.members)
-            self.routing[name] = {s: order[s % len(order)]
-                                  for s in range(n_shards)}
+            self.copies[name] = assign_copies(
+                n_shards, self.members, 1 + self._node_replicas(body))
+            self.routing[name] = {s: c[0]
+                                  for s, c in self.copies[name].items()}
             self.index_bodies[name] = body
             for mname, addr in self.members.items():
                 if mname == self.name:
@@ -423,18 +691,56 @@ class DistClusterNode:
                 _http(addr, "PUT", f"/{name}", body)
             self._publish()
         return {"acknowledged": True, "index": name,
-                "routing": self.routing[name]}
+                "routing": self.routing[name],
+                "copies": self.copies[name]}
 
     def index_doc(self, index: str, doc: dict, id: str,
                   refresh: bool = False) -> dict:
-        """Route by doc id; forward non-local docs to the owner's public
-        doc endpoint."""
-        owner = self._owner(index, id)
+        """Route by doc id; write through EVERY copy holder of the doc's
+        shard (primary first) over the public doc endpoint — copies stay
+        byte-identical when writers are externally ordered (one
+        coordinator per doc id, the bulk-load shape): every holder then
+        applies the same doc stream in the same order. CONCURRENT
+        same-id writes through different coordinators can interleave
+        differently per holder (no primary sequencing yet — reference
+        primary-term ordering is future work). A primary failure fails
+        the write with
+        nothing applied; a REPLICA failure after the primary applied is
+        surfaced as a 500 naming the diverged copy (counted in
+        `dist.replica_write_failed`) — the caller must retry or drop the
+        copy; silent divergence would poison failover byte-identity
+        (stale-copy repair is future work)."""
+        from ..utils.metrics import METRICS
+        r = self.routing.get(index)
+        if r is None:
+            raise ApiError(404, "index_not_found_exception",
+                           f"no such index [{index}]")
+        n = self.node.indices[index].meta.num_shards
+        shard = shard_for(id, n)
+        holders = self.copies.get(index, {}).get(shard, [r[shard]])
         refresh_q = "?refresh=true" if refresh else ""
-        if owner == self.name:
-            return self.client.index(index, doc, id=id, refresh=refresh)
-        return _http(self.members[owner], "PUT",
-                     f"/{index}/_doc/{id}{refresh_q}", doc)
+        out = None
+        for ord_, holder in enumerate(holders):
+            try:
+                if holder == self.name:
+                    res = self.client.index(index, doc, id=id,
+                                            refresh=refresh)
+                else:
+                    res = _http(self.members[holder], "PUT",
+                                f"/{index}/_doc/{id}{refresh_q}", doc)
+            except (urllib.error.URLError, OSError) as e:
+                if ord_ == 0:
+                    raise   # primary never applied: clean failure
+                METRICS.counter("dist.replica_write_failed").inc()
+                raise ApiError(
+                    500, "replica_write_exception",
+                    f"doc [{id}] applied on {holders[:ord_]} but copy "
+                    f"[{holder}] failed ({type(e).__name__}): copies "
+                    f"have diverged — retry the write or remove the "
+                    f"copy")
+            if out is None:
+                out = res
+        return out
 
     def get(self, index: str, id: str) -> dict:
         owner = self._owner(index, id)
@@ -447,6 +753,7 @@ class DistClusterNode:
                            f"[{id}] not found")
 
     def refresh(self, index: str) -> None:
+        from ..utils.metrics import METRICS
         self.client.indices.refresh(index)
         for mname, addr in self.members.items():
             if mname == self.name:
@@ -454,7 +761,10 @@ class DistClusterNode:
             try:
                 _http(addr, "POST", f"/{index}/_refresh")
             except (urllib.error.URLError, OSError):
-                pass
+                # an unreachable member misses the refresh; its copies
+                # serve stale until it rejoins — counted, never silent
+                # (OSL508)
+                METRICS.counter("dist.refresh.failed").inc()
 
     def _owner(self, index: str, id: str) -> str:
         r = self.routing.get(index)
@@ -489,29 +799,43 @@ class DistClusterNode:
                                "distributed index")
         return agg_nodes or []
 
-    def _local_dfs(self, index: str, body: dict) -> dict:
+    def _local_dfs(self, index: str, body: dict,
+                   shards: Optional[List[int]] = None) -> Dict[int, dict]:
+        """Per-SHARD collection statistics (the coordinator sums exactly
+        one copy of every shard, so replicated copies never double-count
+        df/avgdl). `shards=None` covers every local shard — a
+        convenience for direct callers/tests; the search path always
+        sends an explicit plan."""
         svc = self.node.indices[index]
         searchers = svc.searchers
-        segs = [g for s in searchers for g in s.engine.segments]
-        ctx = RecordingStatsContext(svc.mappings, segs, svc.default_sim,
-                                    getattr(svc, "field_similarities", None))
-        try:
-            from ..search.executor import _collect_named
-            lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx,
-                              scoring=True)
-            # named queries are fetch-side state that does not cross the
-            # wire yet; piggyback the check on the rewrite DFS already does
-            ctx.rec["named"] = bool(_collect_named(lroot))
-        except dsl.QueryParseError:
-            pass
-        _ = ctx.num_docs          # maxDoc is always part of the DFS result
-        # avgdl (per-field doc_count + sum_dl) is consumed at the prepare
-        # stage, not rewrite — record it for every text field this node
-        # holds so the merged fs covers whatever the query touches
-        for s in segs:
-            for f in s.text_stats:
-                ctx.field_stats(f)
-        return ctx.rec
+        if shards is None:
+            shards = list(range(len(searchers)))
+        out: Dict[int, dict] = {}
+        for sid in shards:
+            segs = list(searchers[sid].engine.segments)
+            ctx = RecordingStatsContext(
+                svc.mappings, segs, svc.default_sim,
+                getattr(svc, "field_similarities", None))
+            try:
+                from ..search.executor import _collect_named
+                lroot = C.rewrite(dsl.parse_query(body.get("query")), ctx,
+                                  scoring=True)
+                # named queries are fetch-side state that does not cross
+                # the wire yet; piggyback the check on the rewrite DFS
+                # already does
+                ctx.rec["named"] = bool(_collect_named(lroot))
+            except dsl.QueryParseError:
+                pass
+            _ = ctx.num_docs      # maxDoc is always part of the DFS result
+            # avgdl (per-field doc_count + sum_dl) is consumed at the
+            # prepare stage, not rewrite — record it for every text field
+            # this shard holds so the merged fs covers whatever the query
+            # touches
+            for s in segs:
+                for f in s.text_stats:
+                    ctx.field_stats(f)
+            out[sid] = ctx.rec
+        return out
 
     def _global_ctx(self, index: str, g: dict) -> GlobalStatsContext:
         svc = self.node.indices[index]
@@ -520,15 +844,22 @@ class DistClusterNode:
                                   getattr(svc, "field_similarities", None),
                                   g)
 
-    def _local_query(self, index: str, body: dict, g: dict
+    def _local_query(self, index: str, body: dict, g: dict,
+                     shards: Optional[List[int]] = None
                      ) -> List[ShardQueryResult]:
-        """Per-shard query phase with global stats; results stripped of
-        segment references (they do not cross the wire)."""
+        """Query phase for the REQUESTED shards (the coordinator's plan
+        assigns each shard to exactly one live copy holder) with global
+        stats; results stripped of segment references (they do not cross
+        the wire). `shards=None` runs every local shard — direct
+        callers/tests only; the search path always sends a plan."""
         svc = self.node.indices[index]
         ctx = self._global_ctx(index, g)
+        if shards is None:
+            shards = list(range(len(svc.searchers)))
         out = []
-        for i, s in enumerate(svc.searchers):
-            r = s.query_phase(dict(body), shard_ord=i, stats_ctx=ctx)
+        for i in shards:
+            r = svc.searchers[i].query_phase(dict(body), shard_ord=i,
+                                             stats_ctx=ctx)
             r.segments = []        # host-local only
             r.named_by_doc = {}
             out.append(r)
@@ -552,17 +883,25 @@ class DistClusterNode:
         span; every remote leg's span tree comes back on the RPC response
         and nests under the coordinator's phase span. Same deal for the
         flight recorder: the coordinator owns one timeline, every RPC
-        carries it, and the remote legs' events graft back into it."""
+        carries it, and the remote legs' events graft back into it.
+        A `timeout` in the body becomes the request deadline: every RPC
+        and every local segment loop downstream derives its budget from
+        it (utils/deadline.py)."""
         from ..obs import flight_recorder as _fr
         from ..utils.trace import TRACER
+        try:
+            dl = (_dl.current() or _dl.Deadline.from_body(body))
+        except ValueError as e:
+            raise ApiError(400, "parsing_exception", str(e))
         token = None
         if _fr.RECORDER.enabled and not _fr.current():
             tl = _fr.RECORDER.start("dist.search", index=index,
                                     node=self.name)
             token = _fr.set_current(tl)
         try:
-            with TRACER.span("dist.search", index=index,
-                             coordinator=self.name):
+            with _dl.scope(dl), \
+                    TRACER.span("dist.search", index=index,
+                                coordinator=self.name):
                 if _fr.RECORDER.enabled and _fr.current():
                     _fr.RECORDER.record(_fr.current(), "dist.accept",
                                         index=index,
@@ -572,7 +911,98 @@ class DistClusterNode:
             if token is not None:
                 _fr.reset_current(token)
 
+    # ---------------- per-phase scatter with retry + failover ----------
+
+    def _scatter_phase(self, op: str, plan: Dict[int, List[str]],
+                       shards: List[int], rs: _RequestState,
+                       failures: Dict[int, dict], run_local,
+                       run_remote) -> Tuple[Dict[int, object],
+                                            Dict[int, str]]:
+        """Run one phase over `shards`: group by each shard's preferred
+        live copy, serve self-legs locally, RPC the rest, and on a
+        member's terminal failure FAIL each of its shards OVER to the
+        next copy in `plan` (mutated in place so later phases inherit
+        the discovered topology). A shard with no copies left lands in
+        `failures` with its per-shard reason. Returns (per-shard
+        outputs, per-shard serving member)."""
+        from ..obs import flight_recorder as _fr
+        from ..utils.metrics import METRICS
+        outputs: Dict[int, object] = {}
+        assigned: Dict[int, str] = {}
+        pending = [s for s in shards if s not in failures]
+        while pending:
+            groups: Dict[str, List[int]] = {}
+            for s in pending:
+                groups.setdefault(plan[s][0], []).append(s)
+            next_pending: List[int] = []
+            for member in sorted(groups):
+                mshards = sorted(groups[member])
+                try:
+                    if rs.dl is not None and rs.dl.exhausted():
+                        rs.timed_out = True
+                        raise _dl.DeadlineExhausted(
+                            f"[{op}] budget exhausted")
+                    if member == self.name:
+                        res = run_local(mshards)
+                    else:
+                        res = run_remote(member, mshards)
+                except _dl.DeadlineExhausted:
+                    # terminal for the whole phase: every still-pending
+                    # shard fails with a timeout reason — within budget,
+                    # never a transport-cap stall
+                    rs.timed_out = True
+                    for s in mshards + next_pending + [
+                            s2 for m2 in sorted(groups)
+                            if m2 > member for s2 in groups[m2]]:
+                        failures.setdefault(s, {
+                            "type": "timeout_exception",
+                            "node": plan[s][0] if plan[s] else None,
+                            "reason": "request budget exhausted"})
+                    return outputs, assigned
+                except _ShardCallFailed as e:
+                    for s in mshards:
+                        plan[s] = [m for m in plan[s] if m != e.member]
+                        if plan[s]:
+                            rs.failovers += 1
+                            METRICS.counter("dist.rpc.failover").inc()
+                            if rs.tl:
+                                _fr.RECORDER.record(
+                                    rs.tl, "rpc.failover", op=op,
+                                    shard=s, from_node=e.member,
+                                    to_node=plan[s][0])
+                            next_pending.append(s)
+                        else:
+                            METRICS.counter("dist.shard_failed").inc()
+                            failures[s] = {"type": e.kind,
+                                           "node": e.member,
+                                           "attempts": e.attempts}
+                    continue
+                for s in mshards:
+                    outputs[s] = res[s]
+                    assigned[s] = member
+            pending = next_pending
+        return outputs, assigned
+
+    def _remote_runner(self, op: str, rs: _RequestState, build_payload,
+                       extract):
+        """Wrap an RPC phase leg: `_rpc_failsafe` for the wire, and a
+        malformed/incomplete response converts to a member failure (the
+        old `KeyError` handling) instead of a coordinator crash."""
+
+        def run(member: str, shards: List[int]):
+            r = self._rpc_failsafe(member, op, build_payload(shards), rs)
+            try:
+                out = extract(r, shards)
+                if any(s not in out for s in shards):
+                    raise KeyError("incomplete phase response")
+            except Exception:
+                self.member_fd.note_failure(member)
+                raise _ShardCallFailed(member, "bad_response", 1)
+            return out
+        return run
+
     def _search_traced(self, index: str, body: dict) -> dict:
+        from ..obs import flight_recorder as _fr
         from ..utils.metrics import METRICS
         from ..utils.trace import TRACER
         t0 = time.monotonic()
@@ -582,72 +1012,72 @@ class DistClusterNode:
             raise ApiError(404, "index_not_found_exception",
                            f"no such index [{index}]")
         n_shards = svc.meta.num_shards
-        owners = self.routing.get(index, {s: self.name
-                                          for s in range(n_shards)})
-        remote_members = sorted({n for n in owners.values()
-                                 if n != self.name})
+        copies = self.copies.get(
+            index, {s: [self.name] for s in range(n_shards)})
+        # per-request copy preference: configured order with
+        # detector-deprioritized members demoted; the scatter phases
+        # mutate the plan as they discover dead copies, so later phases
+        # inherit the topology the earlier ones learned
+        depri = self.member_fd.deprioritized()
+        plan = {s: order_copies(copies.get(s, [self.name]), depri)
+                for s in range(n_shards)}
+        rs = _RequestState(self.retry_policy, _dl.current(),
+                           _fr.current() if _fr.RECORDER.enabled else 0)
+        failures: Dict[int, dict] = {}
+        all_shards = list(range(n_shards))
 
-        # --- phase 1: DFS (collection statistics from every node)
-        dead: List[str] = []
-        with TRACER.span("dist.dfs", nodes=1 + len(remote_members)), \
+        # --- phase 1: DFS (one copy of every shard's collection stats)
+        with TRACER.span("dist.dfs", shards=n_shards), \
                 METRICS.timer("dist.dfs"):
-            parts = [self._local_dfs(index, body)]
-            if parts[0].get("named"):
-                raise ApiError(400, "illegal_argument_exception",
-                               "named queries (_name) are not supported "
-                               "on a distributed index")
-            for m in remote_members:
-                try:
-                    r = self._rpc(m, "dfs", {"index": index, "body": body})
-                    parts.append(_unb64(r["rec"]))
-                except (urllib.error.URLError, OSError, KeyError):
-                    dead.append(m)
-        g = _merge_dfs(parts)
+            dfs_out, _dfs_assigned = self._scatter_phase(
+                "dfs", plan, all_shards, rs, failures,
+                run_local=lambda sh: self._local_dfs(index, body, sh),
+                run_remote=self._remote_runner(
+                    "dfs", rs,
+                    lambda sh: {"index": index, "body": body,
+                                "shards": sh},
+                    lambda r, sh: {s: rec for s, rec in
+                                   _unb64(r["recs"]).items()
+                                   if s in set(sh)}))
+        if any(rec.get("named") for rec in dfs_out.values()):
+            raise ApiError(400, "illegal_argument_exception",
+                           "named queries (_name) are not supported "
+                           "on a distributed index")
+        g = _merge_dfs([dfs_out[s] for s in sorted(dfs_out)])
 
-        # --- phase 2: QUERY everywhere with pinned global stats
-        remote_results: Dict[int, ShardQueryResult] = {}
-        with TRACER.span("dist.query", nodes=1 + len(remote_members)), \
+        # --- phase 2: QUERY the same copies with pinned global stats
+        with TRACER.span("dist.query", shards=len(dfs_out)), \
                 METRICS.timer("dist.query"):
-            results = self._local_query(index, body, g)
-            for m in remote_members:
-                if m in dead:
-                    continue
-                try:
-                    r = self._rpc(m, "query_phase",
-                                  {"index": index, "body": body,
-                                   "g": _b64(g)})
-                    for sr in _unb64(r["results"]):
-                        # only the owner's copy of a shard carries data;
-                        # the coordinator keeps the owned legs and drops
-                        # empty non-owned duplicates
-                        if owners.get(sr.shard) == m:
-                            remote_results[sr.shard] = sr
-                except (urllib.error.URLError, OSError, KeyError):
-                    dead.append(m)
-        merged: List[ShardQueryResult] = []
-        failed_shards = []
-        for s in range(n_shards):
-            owner = owners.get(s, self.name)
-            if owner == self.name:
-                merged.append(results[s])
-            elif s in remote_results:
-                merged.append(remote_results[s])
-            else:
-                failed_shards.append((s, owner))
+            q_out, q_assigned = self._scatter_phase(
+                "query_phase", plan, sorted(dfs_out), rs, failures,
+                run_local=lambda sh: {
+                    r.shard: r
+                    for r in self._local_query(index, body, g, sh)},
+                run_remote=self._remote_runner(
+                    "query_phase", rs,
+                    lambda sh: {"index": index, "body": body,
+                                "g": _b64(g), "shards": sh},
+                    lambda r, sh: {sr.shard: sr
+                                   for sr in _unb64(r["results"])
+                                   if sr.shard in sh}))
+        merged = [q_out[s] for s in sorted(q_out)]
 
         with TRACER.span("dist.reduce", shards=len(merged)):
             reduced = reduce_shard_results(merged, body,
                                            agg_nodes=agg_nodes)
 
-        # --- phase 3: FETCH winners from their owning nodes
+        # --- phase 3: FETCH winners from the copy that ran their query
+        # phase (doc coordinates are copy-local: fetch retries in place
+        # but never fails over — a copy lost between phases fails its
+        # shard honestly, reference query-and-fetch affinity)
         by_shard: Dict[int, List[Candidate]] = {}
         for c in reduced["selected"]:
             by_shard.setdefault(c.shard, []).append(c)
         hits_by_key: Dict[Tuple, dict] = {}
         with TRACER.span("dist.fetch", shards=len(by_shard)), \
                 METRICS.timer("dist.fetch"):
-            for s_id, sel in by_shard.items():
-                owner = owners.get(s_id, self.name)
+            for s_id, sel in sorted(by_shard.items()):
+                owner = q_assigned.get(s_id, self.name)
                 if owner == self.name:
                     sr = self.node.indices[index].searchers[s_id]
                     segs = (list(sr.replica.segments)
@@ -662,17 +1092,29 @@ class DistClusterNode:
                               list(c.sort_values), list(c.raw_sort_values))
                              for c in sel]
                     try:
-                        r = self._rpc(owner, "fetch_phase",
-                                      {"index": index, "body": body,
-                                       "shard": s_id, "cands": _b64(cands),
-                                       "g": _b64(g)})
+                        r = self._rpc_failsafe(
+                            owner, "fetch_phase",
+                            {"index": index, "body": body,
+                             "shard": s_id, "cands": _b64(cands),
+                             "g": _b64(g)}, rs)
                         fetched = _unb64(r["hits"])
-                    except (urllib.error.URLError, OSError, KeyError):
-                        # the owner died BETWEEN query and fetch: this
+                    except _dl.DeadlineExhausted:
+                        rs.timed_out = True
+                        failures[s_id] = {
+                            "type": "timeout_exception", "node": owner,
+                            "reason": "request budget exhausted"}
+                        fetched = []
+                    except (_ShardCallFailed, KeyError) as e:
+                        # the copy died BETWEEN query and fetch: this
                         # shard's winners can no longer be hydrated —
                         # report the shard failed instead of silently
                         # returning fewer hits
-                        failed_shards.append((s_id, owner))
+                        METRICS.counter("dist.shard_failed").inc()
+                        failures[s_id] = {
+                            "type": getattr(e, "kind",
+                                            "node_unreachable"),
+                            "node": owner,
+                            "attempts": getattr(e, "attempts", 1)}
                         fetched = []
                 for c, h in zip(sel, fetched):
                     hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
@@ -688,28 +1130,58 @@ class DistClusterNode:
             track_n = int(track)
             if total > track_n:
                 total, relation = track_n, "gte"
+        timed_out = rs.timed_out or any(
+            getattr(r, "timed_out", False) for r in merged)
+        terminated_early = any(getattr(r, "terminated_early", False)
+                               for r in merged)
+        failed_list = [{"shard": s, "node": f.get("node"),
+                        "reason": {k: v for k, v in f.items()
+                                   if k != "node"}}
+                       for s, f in sorted(failures.items())]
+        if body.get("allow_partial_search_results", True) is False \
+                and (failed_list or timed_out):
+            # reference parity: partial results refused -> the whole
+            # request fails (SearchPhaseExecutionException shape)
+            raise ApiError(
+                503, "search_phase_execution_exception",
+                f"{len(failed_list)} shard failure(s)"
+                f"{' and a timeout' if timed_out else ''} with "
+                f"allow_partial_search_results=false")
         resp = {
             "took": int((time.monotonic() - t0) * 1000),
-            "timed_out": False,
+            "timed_out": timed_out,
             "_shards": {"total": n_shards,
-                        "successful": n_shards - len(failed_shards),
-                        "skipped": 0, "failed": len(failed_shards),
-                        **({"failures": [
-                            {"shard": s, "node": n,
-                             "reason": {"type": "node_unreachable"}}
-                            for s, n in failed_shards]}
-                           if failed_shards else {})},
+                        "successful": n_shards - len(failed_list),
+                        "skipped": 0, "failed": len(failed_list),
+                        **({"failures": failed_list}
+                           if failed_list else {})},
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": (reduced["max_score"]
                                    if reduced["max_score"] != float("-inf")
                                    else None),
                      "hits": hits},
         }
+        if terminated_early:
+            resp["terminated_early"] = True
         if reduced["aggs"]:
             resp["aggregations"] = reduced["aggs"]
         return resp
 
-    # ---------------- lifecycle ----------------
+    # ---------------- lifecycle + stats ----------------
+
+    def resilience_stats(self) -> dict:
+        """This node's failure-domain view: member detector state + the
+        retry policy in force (the counter rollup lives in
+        `_nodes/stats` "resilience" and `/_metrics`)."""
+        p = self.retry_policy
+        return {"member_detector": self.member_fd.stats(),
+                "retry_policy": {
+                    "same_member_retries": p.same_member_retries,
+                    "budget": p.budget,
+                    "base_backoff_s": p.base_backoff_s,
+                    "max_backoff_s": p.max_backoff_s,
+                    "storm_n": p.storm_n},
+                "rpc_timeout_cap_s": _RPC_TIMEOUT_CAP_S}
 
     def stop(self) -> None:
         self.server.stop()

@@ -16,6 +16,7 @@ reference's allocation response to a left node."""
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -99,3 +100,106 @@ class FailureDetector:
                 "failure_threshold": self.failure_threshold,
                 "suspect": {str(k): v for k, v in self.consecutive.items()
                             if v > 0}}
+
+
+class MemberFailureDetector:
+    """Cross-node sibling of `FailureDetector`: tracks consecutive RPC /
+    probe failures per cluster MEMBER and feeds the finding back into
+    shard-copy selection (cluster/routing.py `order_copies`) instead of
+    letting a dead member be rediscovered at RPC time on every request.
+
+    A member past `failure_threshold` consecutive failures is
+    DEPRIORITIZED — demoted to the back of every shard's copy preference
+    list — not removed: it still serves shards that have no other copy,
+    and one successful probe or RPC restores it (reference
+    FollowersChecker semantics: suspicion is cheap to enter, cheap to
+    leave). The caller owns the clock: RPC outcomes arrive via
+    `note_failure`/`note_success`, and `tick(members)` runs one explicit
+    probe round over the suspects so recovery is deterministic in tests.
+    """
+
+    def __init__(self, failure_threshold: int = 3,
+                 prober: Optional[Callable] = None,
+                 probe_timeout_s: float = 1.0):
+        self.failure_threshold = int(failure_threshold)
+        self.prober = prober            # (member, addr) -> bool
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._lock = threading.Lock()
+        self.consecutive: Dict[str, int] = {}
+        self._depri: set = set()
+        self.rounds = 0
+
+    def note_failure(self, member: str) -> bool:
+        """Record one failed RPC/probe. Returns True when this crossing
+        newly deprioritized the member."""
+        with self._lock:
+            n = self.consecutive.get(member, 0) + 1
+            self.consecutive[member] = n
+            if n >= self.failure_threshold and member not in self._depri:
+                self._depri.add(member)
+                return True
+        return False
+
+    def note_success(self, member: str) -> None:
+        with self._lock:
+            self.consecutive[member] = 0
+            self._depri.discard(member)
+
+    def deprioritized(self) -> set:
+        with self._lock:
+            return set(self._depri)
+
+    def _default_probe(self, member: str, addr: str) -> bool:
+        import json
+        import os
+        import urllib.request
+        headers = {}
+        # same node-to-node trust as the RPC wire (`distnode._http`):
+        # without the cluster token a security-enabled member answers
+        # 403 and a demoted peer could never probe-recover
+        tok = os.environ.get("OPENSEARCH_TPU_CLUSTER_TOKEN")
+        if tok:
+            headers["X-Cluster-Token"] = tok
+        try:
+            req = urllib.request.Request(f"http://{addr}/_internal/ping",
+                                         method="GET", headers=headers)
+            with urllib.request.urlopen(
+                    req, timeout=self.probe_timeout_s) as r:
+                return bool(json.loads(r.read().decode()).get("ok"))
+        except Exception:
+            return False
+
+    def tick(self, members: Dict[str, str]) -> List[dict]:
+        """One probe round over the currently-suspect members. A
+        successful probe clears the suspicion (and the deprioritization);
+        a failed one deepens it. Returns the events."""
+        self.rounds += 1
+        probe = self.prober or self._default_probe
+        events: List[dict] = []
+        with self._lock:
+            suspects = set(self._depri) | {
+                m for m, n in self.consecutive.items() if n > 0}
+        for member in sorted(suspects):
+            addr = members.get(member)
+            if addr is None:
+                continue
+            if probe(member, addr):
+                after = self.consecutive.get(member, 0)
+                self.note_success(member)
+                events.append({"member": member, "event": "recovered",
+                               "after_failures": after})
+            else:
+                crossed = self.note_failure(member)
+                events.append({"member": member, "event": "probe_failed",
+                               "consecutive": self.consecutive[member],
+                               **({"deprioritized": True}
+                                  if crossed else {})})
+        return events
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"failure_threshold": self.failure_threshold,
+                    "rounds": self.rounds,
+                    "deprioritized": sorted(self._depri),
+                    "suspect": {m: n for m, n in self.consecutive.items()
+                                if n > 0}}

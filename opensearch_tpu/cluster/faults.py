@@ -1,0 +1,277 @@
+"""Seeded deterministic fault injection for the distributed serving tier
+(docs/RESILIENCE.md).
+
+The resilience machinery in `cluster/distnode.py` — deadline propagation,
+per-shard retry with replica failover, the hardened partial-results
+contract — is only trustworthy if exact failure interleavings can be
+REPLAYED. This module is the injection layer: a `ChaosSchedule` holds an
+ordered rule list; every rule matches an injection site deterministically
+(per-rule call counters, plus a seeded RNG for probabilistic rules drawn
+in call order), fires a bounded number of times, and appends what it did
+to a journal. Same schedule + same call sequence -> byte-identical
+journal, which is what the tier-1 replay tests assert.
+
+Injection sites (the hooks live in product code, behind an `enabled()`
+fast path that is one module-global read when no schedule is installed):
+
+- `rpc.send`    — coordinator side of every `/_internal` RPC
+                  (`DistClusterNode._rpc`), keyed by target member + op
+- `rpc.recv`    — serving side (`DistClusterNode.handle_internal`)
+- `sched.complete` — the serving scheduler's completion stage
+                  (slow-fetch injection; serving/scheduler.py)
+
+Actions:
+
+- `drop`       — raise `FaultInjected` (an OSError: looks like a refused
+                 connection to the retry machinery)
+- `delay`      — sleep `delay_s`, then proceed (slow node / slow fetch)
+- `error`      — raise `FaultInjected` tagged as a remote 5xx
+- `blackhole`  — sleep the CALLER's deadline-derived RPC timeout (capped
+                 by `delay_s`), then raise `FaultTimeout` — the
+                 wire-level signature of a hung peer, without ever
+                 holding a test for the full 30 s transport cap
+- `breaker_trip` — raise CircuitBreakingException at the site
+
+Node-level helpers compose these: `kill_node(m)` black-holes every
+future send to `m` instantly (drop), `pause_node(m, s)` delays them.
+
+This is a test/bench surface: nothing here is imported on the serving
+hot path unless a schedule is installed, and `install()` is explicit.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+_SITES = ("rpc.send", "rpc.recv", "sched.complete")
+_ACTIONS = ("drop", "delay", "error", "blackhole", "breaker_trip")
+
+# cap on how long a blackhole may hold a call when the caller has no
+# deadline (tests must never stall for the full transport cap)
+_BLACKHOLE_CAP_S = 2.0
+
+
+class FaultInjected(OSError):
+    """An injected transport-level fault (drop / remote error)."""
+
+    def __init__(self, site: str, action: str, member=None, op=None):
+        super().__init__(f"chaos[{action}] at {site} "
+                         f"(member={member}, op={op})")
+        self.site = site
+        self.action = action
+        self.member = member
+        self.op = op
+
+
+class FaultTimeout(FaultInjected, TimeoutError):
+    """An injected hang: the call 'waited' its full timeout and died."""
+
+
+class _Rule:
+    __slots__ = ("site", "action", "op", "member", "at", "after", "times",
+                 "delay_s", "p", "calls", "fired")
+
+    def __init__(self, site: str, action: str, op: Optional[str],
+                 member: Optional[str], at, after: Optional[int],
+                 times: Optional[int], delay_s: float, p: Optional[float]):
+        if site not in _SITES:
+            raise ValueError(f"unknown chaos site [{site}]")
+        if action not in _ACTIONS:
+            raise ValueError(f"unknown chaos action [{action}]")
+        self.site = site
+        self.action = action
+        self.op = op                    # None = any op
+        self.member = member            # None = any member/node
+        self.at = set(at) if at else None      # 1-based matching-call idxs
+        if after is None and self.at is None and p is None:
+            # a rule with no selector means "every matching call" —
+            # without this default it would match forever and never
+            # fire, passing chaos tests vacuously
+            after = 1
+        self.after = after              # fire on every call >= after
+        self.times = times              # max fires (None = unbounded)
+        self.delay_s = float(delay_s)
+        self.p = p                      # probability (seeded rng)
+        self.calls = 0                  # matching calls seen
+        self.fired = 0
+
+    def describe(self) -> dict:
+        return {"site": self.site, "action": self.action, "op": self.op,
+                "member": self.member,
+                "at": sorted(self.at) if self.at else None,
+                "after": self.after, "times": self.times, "p": self.p,
+                "delay_s": self.delay_s, "fired": self.fired}
+
+
+class ChaosSchedule:
+    """An ordered, seeded fault plan. Rules are evaluated in add() order;
+    the FIRST matching rule that decides to fire wins the call."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.rules: List[_Rule] = []
+        self.journal: List[dict] = []
+        self._seq = 0
+
+    # ---------------- plan construction ----------------
+
+    def add(self, site: str, action: str, op: Optional[str] = None,
+            member: Optional[str] = None, at=None,
+            after: Optional[int] = None, times: Optional[int] = None,
+            delay_s: float = 0.05,
+            p: Optional[float] = None) -> "ChaosSchedule":
+        self.rules.append(_Rule(site, action, op, member, at, after,
+                                times, delay_s, p))
+        return self
+
+    def kill_node(self, member: str) -> "ChaosSchedule":
+        """Every future send to `member` fails instantly (SIGKILL shape:
+        connection refused, no partial responses)."""
+        return self.add("rpc.send", "drop", member=member, after=1)
+
+    def pause_node(self, member: str, delay_s: float) -> "ChaosSchedule":
+        """Every future send to `member` stalls `delay_s` then proceeds
+        (GC pause / overloaded-node shape)."""
+        return self.add("rpc.send", "delay", member=member, after=1,
+                        delay_s=delay_s)
+
+    # ---------------- firing ----------------
+
+    def fire(self, site: str, op: Optional[str] = None,
+             member: Optional[str] = None) -> Optional[dict]:
+        """Consult the plan for one call at `site`. Returns the action
+        record to apply (journaled), or None. Deterministic: per-rule
+        matching-call counters + the seeded RNG drawn in call order."""
+        with self._lock:
+            for idx, r in enumerate(self.rules):
+                if r.site != site:
+                    continue
+                if r.op is not None and r.op != op:
+                    continue
+                if r.member is not None and r.member != member:
+                    continue
+                r.calls += 1
+                if r.times is not None and r.fired >= r.times:
+                    continue
+                hit = False
+                if r.at is not None:
+                    hit = r.calls in r.at
+                elif r.after is not None:
+                    hit = r.calls >= r.after
+                if r.p is not None:
+                    # drawn even when positionally hit, so the rng stream
+                    # consumption is a pure function of the call sequence
+                    draw = self._rng.random()
+                    hit = (hit or (r.at is None and r.after is None)) \
+                        and draw < r.p
+                if not hit:
+                    continue
+                r.fired += 1
+                self._seq += 1
+                rec = {"seq": self._seq, "rule": idx, "site": site,
+                       "op": op, "member": member, "action": r.action,
+                       "call": r.calls, "delay_s": r.delay_s}
+                self.journal.append(rec)
+                return rec
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"seed": self.seed, "fired": self._seq,
+                    "rules": [r.describe() for r in self.rules]}
+
+
+# ---------------------------------------------------------------------
+# module-global installation + site hooks
+# ---------------------------------------------------------------------
+
+_INSTALLED: Optional[ChaosSchedule] = None
+
+
+def install(schedule: ChaosSchedule) -> ChaosSchedule:
+    global _INSTALLED
+    _INSTALLED = schedule
+    return schedule
+
+
+def uninstall() -> None:
+    global _INSTALLED
+    _INSTALLED = None
+
+
+def enabled() -> bool:
+    return _INSTALLED is not None
+
+
+def installed() -> Optional[ChaosSchedule]:
+    return _INSTALLED
+
+
+def stats() -> dict:
+    sched = _INSTALLED
+    return {"installed": sched is not None,
+            **(sched.stats() if sched is not None else {})}
+
+
+def _apply(rec: dict, site: str, member, op,
+           timeout_s: Optional[float]) -> None:
+    from ..utils.metrics import METRICS
+    METRICS.counter(f"chaos.{rec['action']}").inc()
+    action = rec["action"]
+    if action == "delay":
+        time.sleep(rec["delay_s"])
+        return
+    if action == "drop":
+        raise FaultInjected(site, action, member, op)
+    if action == "error":
+        raise FaultInjected(site, "error", member, op)
+    if action == "blackhole":
+        # hold the call exactly as long as a hung peer would: the
+        # caller's own (deadline-derived) timeout, never more than the
+        # rule's cap — then die the way a socket timeout dies
+        hold = min(timeout_s if timeout_s is not None else _BLACKHOLE_CAP_S,
+                   rec["delay_s"] if rec["delay_s"] > 0
+                   else _BLACKHOLE_CAP_S)
+        time.sleep(max(hold, 0.0))
+        raise FaultTimeout(site, action, member, op)
+    if action == "breaker_trip":
+        from ..utils.breaker import CircuitBreakingException
+        raise CircuitBreakingException(f"chaos[breaker_trip] at {site}")
+
+
+def on_rpc_send(member: str, op: str,
+                timeout_s: Optional[float] = None) -> None:
+    """Coordinator-side hook: called before the wire write of every
+    `/_internal` RPC."""
+    sched = _INSTALLED
+    if sched is None:
+        return
+    rec = sched.fire("rpc.send", op=op, member=member)
+    if rec is not None:
+        _apply(rec, "rpc.send", member, op, timeout_s)
+
+
+def on_rpc_recv(node: str, op: str) -> None:
+    """Serving-side hook: called as the `/_internal` handler accepts."""
+    sched = _INSTALLED
+    if sched is None:
+        return
+    rec = sched.fire("rpc.recv", op=op, member=node)
+    if rec is not None:
+        _apply(rec, "rpc.recv", node, op, None)
+
+
+def on_sched_complete(node: str) -> None:
+    """Serving-scheduler completion-stage hook (slow fetch / wedge
+    shapes; serving/scheduler.py)."""
+    sched = _INSTALLED
+    if sched is None:
+        return
+    rec = sched.fire("sched.complete", member=node)
+    if rec is not None:
+        _apply(rec, "sched.complete", node, None, None)

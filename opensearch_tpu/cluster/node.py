@@ -1123,7 +1123,9 @@ class Node:
         if len(names) == 1 and not remote_parts:
             for h in resp["hits"]["hits"]:
                 h["_index"] = names[0]
-        if cache_key is not None:
+        if cache_key is not None and not resp.get("timed_out"):
+            # a timed-out page is whatever the budget allowed at that
+            # wall-clock moment — never representative, never cached
             self.request_cache.put(cache_key, resp)
             if copy_protect:
                 import copy as _copy

@@ -47,6 +47,28 @@ def murmur3_x86_32(data: bytes, seed: int = 0) -> int:
     return h
 
 
+def assign_copies(num_shards: int, members, n_copies: int):
+    """Shard -> ordered copy list (primary first), round-robin over the
+    sorted member names with each subsequent copy on the next distinct
+    member — the compact analog of the reference's balanced allocator.
+    `n_copies` is clamped to the member count (a copy per member at
+    most)."""
+    order = sorted(members)
+    n = max(1, min(int(n_copies), len(order)))
+    return {s: [order[(s + i) % len(order)] for i in range(n)]
+            for s in range(num_shards)}
+
+
+def order_copies(copies, deprioritized):
+    """Per-request copy preference: the configured order (primary first)
+    with members the failure detector currently deprioritizes demoted to
+    the back, original order preserved within each class. Deterministic —
+    a recovered path must pick the same replica every time so the parity
+    harness can hold it byte-identical."""
+    depri = [m for m in copies if m in deprioritized]
+    return [m for m in copies if m not in deprioritized] + depri
+
+
 def shard_for(routing: str, num_shards: int) -> int:
     from .. import native
     if native.available():

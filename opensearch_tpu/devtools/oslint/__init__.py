@@ -24,6 +24,8 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   `add_estimate`/`release` outside the HBM ledger; `jax.device_put`
   residency in index/search/parallel without a ledger registration in
   the enclosing scope.
+- OSL508 RPC-path discipline (`rpc_rules`): no unbounded wire calls and
+  no silently-swallowed transport errors in `cluster/`.
 - OSL507 quantized-impact domain discipline (`impact_rules`): u8/u16
   impact planes enter f32 score math only through the designated
   dequant helpers; codec-version branches in search/ consult

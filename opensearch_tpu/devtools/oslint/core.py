@@ -130,13 +130,15 @@ def default_checkers() -> List[Checker]:
     from .lock_rules import LockDisciplineChecker, WaitDisciplineChecker
     from .memory_rules import MemoryAccountingChecker
     from .recorder_rules import RecorderDisciplineChecker
+    from .rpc_rules import RpcDisciplineChecker
     from .sync_rules import DeviceSyncDisciplineChecker
     from .telemetry_rules import TelemetryDisciplineChecker
     return [DtypeDisciplineChecker(), JitBoundaryChecker(),
             BreakerDisciplineChecker(), LockDisciplineChecker(),
             TelemetryDisciplineChecker(), WaitDisciplineChecker(),
             DeviceSyncDisciplineChecker(), RecorderDisciplineChecker(),
-            MemoryAccountingChecker(), ImpactDomainChecker()]
+            MemoryAccountingChecker(), ImpactDomainChecker(),
+            RpcDisciplineChecker()]
 
 
 def run_source(src: str, path: str,

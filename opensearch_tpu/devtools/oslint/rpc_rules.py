@@ -1,0 +1,108 @@
+"""OSL508 — RPC-path discipline for the cluster transport layer.
+
+The resilience layer (docs/RESILIENCE.md) only holds if every wire call
+in `cluster/` is deadline-bounded and every RPC failure is ACCOUNTED —
+a single unbounded `urlopen` reintroduces the 30 s-stall class the
+deadline ladder exists to kill, and a swallowed transport error is a
+shard failure the response never reports. Two shapes:
+
+1. **Unbounded wire call.** `urllib.request.urlopen(...)` (any alias
+   spelling ending in `urlopen`) or `socket.create_connection(...)` in
+   `cluster/` without an explicit `timeout=` keyword. The timeout must
+   exist syntactically — deriving it from the deadline is the helper's
+   job (`_http` / `Deadline.rpc_timeout_s`), the rule just refuses the
+   unbounded default.
+
+2. **Swallowed RPC error.** An `except` handler in `cluster/` whose
+   type mentions a transport error (URLError / HTTPError / OSError /
+   ConnectionError / TimeoutError / socket.timeout) and whose body is
+   ONLY `pass`/`continue`/`...` — no call, no raise, no assignment:
+   nothing recorded a shard failure, a metric, or an event, so the
+   failure is invisible. Recording a counter (`METRICS.counter(...)
+   .inc()`), re-raising, or stashing the error all satisfy the rule.
+
+Genuinely fire-and-forget sites suppress with
+`# oslint: disable=OSL508 -- <why the loss is accounted elsewhere>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_SCOPE = "opensearch_tpu/cluster/"
+
+_TRANSPORT_ERRS = ("URLError", "HTTPError", "OSError", "ConnectionError",
+                   "TimeoutError", "timeout")
+
+
+def _mentions_transport_err(type_node) -> bool:
+    if type_node is None:
+        return True          # bare except swallows transport errors too
+    names: List[str] = []
+    nodes = (list(type_node.elts) if isinstance(type_node, ast.Tuple)
+             else [type_node])
+    for n in nodes:
+        d = _dotted(n)
+        if d:
+            names.append(d.rsplit(".", 1)[-1])
+    return any(n in _TRANSPORT_ERRS for n in names)
+
+
+def _body_is_silent(body) -> bool:
+    """True when the handler does nothing observable: only pass /
+    continue / bare-ellipsis statements."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant) and \
+                stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+class RpcDisciplineChecker(Checker):
+    rules = ("OSL508",)
+    name = "rpc-discipline"
+
+    def applies(self, path: str) -> bool:
+        return path.startswith(_SCOPE)
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                leaf = d.rsplit(".", 1)[-1]
+                is_wire = (leaf == "urlopen"
+                           or d.endswith("socket.create_connection")
+                           or leaf == "create_connection")
+                if is_wire and not any(kw.arg == "timeout"
+                                       for kw in node.keywords):
+                    findings.append(Finding(
+                        "OSL508", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        f"unbounded wire call (`{leaf}` without "
+                        "`timeout=`): every cluster RPC must derive its "
+                        "socket timeout from the request deadline "
+                        "(utils/deadline.py rpc_timeout_s) or an "
+                        "explicit cap",
+                        detail=f"no-timeout:{leaf}"))
+            elif isinstance(node, ast.ExceptHandler):
+                if _mentions_transport_err(node.type) \
+                        and _body_is_silent(node.body):
+                    findings.append(Finding(
+                        "OSL508", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        "swallowed RPC error: a transport failure in "
+                        "cluster/ must record a shard failure, a "
+                        "metric, or a flight-recorder event before "
+                        "being dropped",
+                        detail="swallowed-rpc-error"))
+        return findings

@@ -1908,8 +1908,8 @@ class MeshSearchService:
     # key (e.g. `"profile": false`) did NOT cause the decline and must
     # not be blamed for it
     _HOST_LOOP_KEYS_TRUTHY = ("knn", "rescore", "profile", "collapse",
-                              "suggest")
-    _HOST_LOOP_KEYS_PRESENT = ("min_score", "search_after")
+                              "suggest", "terminate_after")
+    _HOST_LOOP_KEYS_PRESENT = ("min_score", "search_after", "timeout")
 
     def _host_loop_shape(self, body: dict, agg_nodes) -> str:
         """Finer decline attribution for `_eligible`-rejected bodies:
@@ -1957,8 +1957,22 @@ class MeshSearchService:
         if body.get("knn") or body.get("rescore") or body.get("min_score") \
                 is not None or body.get("profile") or body.get("collapse") \
                 or body.get("suggest") or body.get("search_after") is not None \
-                or body.get("explain") == "device_plan":
+                or body.get("explain") == "device_plan" \
+                or body.get("terminate_after"):
+            # terminate_after is a per-segment collection budget — only
+            # the host shard loop can stop between segment programs
             return None
+        if body.get("timeout") is not None:
+            # a LIVE deadline budget needs the deadline-aware host loop
+            # too (a mesh launch cannot stop mid-program); the reference
+            # no-timeout sentinel (-1) parses to no budget and stays
+            # mesh-eligible
+            from ..utils.deadline import parse_timeout_s
+            try:
+                if parse_timeout_s(body.get("timeout")) is not None:
+                    return None
+            except ValueError:
+                return None          # junk -> host loop raises the 400
         if named_nodes:
             return None
         # metric aggs reduce over the mesh (psum/pmin/pmax); keyword terms

@@ -375,6 +375,28 @@ class RestClient:
                scroll: Optional[str] = None, **kw) -> dict:
         body = dict(body or {})
         body.update({k: v for k, v in kw.items() if v is not None})
+        # request deadline: the budget is anchored HERE, at REST accept,
+        # so scheduler queue wait and every downstream stage spend from
+        # the same clock (utils/deadline.py; docs/RESILIENCE.md)
+        from ..utils import deadline as _ddl
+        _dl_token = None
+        if _ddl.current() is None:
+            try:
+                _dl_obj = _ddl.Deadline.from_body(body)
+            except ValueError as e:
+                raise ApiError(400, "parsing_exception", str(e))
+            if _dl_obj is not None:
+                _dl_token = _ddl.set_current(_dl_obj)
+        try:
+            return self._search_deadlined(index, body, scroll)
+        except _ddl.PartialResultsUnacceptable as e:
+            raise ApiError(503, "search_phase_execution_exception", str(e))
+        finally:
+            if _dl_token is not None:
+                _ddl.reset_current(_dl_token)
+
+    def _search_deadlined(self, index: str, body: dict,
+                          scroll: Optional[str]) -> dict:
         # workload-group admission (reference wlm/): token-bucket rate
         # limit + resource-tracking QueryGroup enforcement
         group = body.pop("_workload_group", None)
@@ -926,6 +948,12 @@ class RestClient:
             # fastpath ladder rungs, mesh dispatch, distnode RPCs) and
             # the jit program-cache / compile-vs-execute attribution
             "telemetry": self._telemetry_block(),
+            # fault tolerance (docs/RESILIENCE.md): distnode RPC retry /
+            # failover / deadline counters, backoff percentiles, and the
+            # chaos-harness installation state (cluster/faults.py).
+            # Process-global like /_metrics — co-resident test nodes
+            # share the rollup
+            "resilience": self._resilience_block(),
         }
         if n.mesh_service is not None:
             node_block["mesh"] = n.mesh_service.stats()
@@ -948,6 +976,28 @@ class RestClient:
         if check is not None:
             out["device_check"] = check
         return out
+
+    @staticmethod
+    def _resilience_block() -> dict:
+        from ..cluster import faults as _faults
+        from ..utils.metrics import METRICS
+
+        def c(name):
+            return METRICS.counter(name).value
+        return {
+            "rpc": {"failed": c("dist.rpc.failed"),
+                    "retries": c("dist.rpc.retry"),
+                    "failovers": c("dist.rpc.failover"),
+                    "backoff_ms": METRICS.percentiles(
+                        "dist.rpc.backoff_ms")},
+            "deadline": {"exhausted": c("dist.deadline.exhausted"),
+                         "expired_on_arrival":
+                             c("dist.deadline.expired_on_arrival")},
+            "shards_failed": c("dist.shard_failed"),
+            "publish_failed": c("dist.publish.failed"),
+            "refresh_failed": c("dist.refresh.failed"),
+            "chaos": _faults.stats(),
+        }
 
     @staticmethod
     def _telemetry_block() -> dict:

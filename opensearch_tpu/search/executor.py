@@ -23,6 +23,7 @@ from ..index.segment import Segment, next_pow2
 from ..obs import flight_recorder as _flight
 from ..obs import query_cost as _qcost
 from ..script.painless_lite import ScriptError as _ScriptError
+from ..utils import deadline as _dl
 from . import compiler as C
 from . import fastpath
 from . import impactpath
@@ -60,6 +61,22 @@ class ShardQueryResult:
     segments: List[Segment] = dc_field(default_factory=list)
     named_by_doc: Dict[Tuple[int, int], List[str]] = dc_field(default_factory=dict)
     took_ms: float = 0.0
+    # partial-results contract (docs/RESILIENCE.md): the deadline budget
+    # ran out between segments / the terminate_after doc budget was hit —
+    # both cross the distnode wire inside the pickled result
+    timed_out: bool = False
+    terminated_early: bool = False
+
+
+def _suppress_score(body: dict) -> bool:
+    """Reference `track_scores` semantics under a field sort: an
+    explicit `track_scores: false` nulls per-hit `_score`. Absent
+    track_scores keeps this engine's historical behavior — scores are
+    free on device (documented divergence, docs/RESILIENCE.md)."""
+    if body.get("track_scores") is not False or not body.get("sort"):
+        return False
+    specs = _norm_sort_specs(body)
+    return bool(specs) and specs[0]["field"] != "_score"
 
 
 _GEO_SORT_OPTS = {"order", "unit", "mode", "distance_type",
@@ -259,6 +276,14 @@ class ShardSearcher:
                 raise dsl.QueryParseError(
                     "cannot use [collapse] with a primary _script sort")
 
+        # per-shard doc budget (reference terminate_after) + the ambient
+        # request deadline (utils/deadline.py): both are enforced at
+        # segment granularity — one segment is one device program, the
+        # natural cancellation point — and both mark the result partial
+        # (`terminated_early` / `timed_out`) with honest `gte` totals
+        ta = int(body.get("terminate_after") or 0)
+        deadline = _dl.current()
+
         result = ShardQueryResult(shard=shard_ord, segments=segments)
         ran_segs: List[Segment] = []
 
@@ -284,7 +309,10 @@ class ShardSearcher:
         # the serial per-segment loop (reference
         # ConcurrentQueryPhaseSearcher parallelizes with threads; a TPU
         # wants one bigger launch) — pure term-group specs only
-        if fast_spec is not None and len(segments) > 1 and not rescores:
+        if fast_spec is not None and len(segments) > 1 and not rescores \
+                and not ta:
+            # (terminate_after needs the per-segment loop: the concat
+            # shard-view launch scans every segment in one program)
             sv = fastpath.shard_search(self, ctx, fast_spec, window)
             if sv is not None:
                 view, fout = sv
@@ -304,6 +332,16 @@ class ShardSearcher:
 
         seg_t0 = time.monotonic()
         for seg_ord, seg in enumerate(segments):
+            if ta and result.total >= ta:
+                result.terminated_early = True
+                if any(s.live_count for s in segments[seg_ord:]):
+                    result.total_rel = "gte"
+                break
+            if deadline is not None and deadline.exhausted():
+                result.timed_out = True
+                if any(s.live_count for s in segments[seg_ord:]):
+                    result.total_rel = "gte"
+                break
             if task is not None:
                 # cooperative cancellation between segment programs
                 # (reference CancellableTask checks between leaves) +
@@ -415,6 +453,12 @@ class ShardSearcher:
                 names = [nm for nm, arr in named_np.items() if arr[j]]
                 if names:
                     result.named_by_doc[(seg_ord, d)] = names
+
+        if ta and result.total >= ta:
+            # the budget was crossed (possibly exactly on the final
+            # segment): the reference flags terminated_early whenever the
+            # collector hit its limit, whether or not docs remained
+            result.terminated_early = True
 
         self._resample_samplers(agg_nodes, result, ran_segs, ctx, lroot)
 
@@ -563,10 +607,12 @@ class ShardSearcher:
         perc_multi = [pq for pq in _walk_query_nodes(qtree, dsl.PercolateQuery)
                       if len(pq.documents) > 1]
         ih_cache: Dict[Tuple[int, int], Any] = {}
+        suppress = _suppress_score(body) if body.get("sort") else False
         hits = []
         for c in selected:
             seg = result.segments[c.seg_ord]
-            hit = self._fetch_one(seg, c, body, hl_terms)
+            hit = self._fetch_one(seg, c, body, hl_terms,
+                                  suppress_score=suppress)
             names = result.named_by_doc.get((c.seg_ord, c.local_doc))
             if names:
                 hit["matched_queries"] = names
@@ -716,7 +762,8 @@ class ShardSearcher:
                      "hits": child_hits}}
 
     def _fetch_one(self, seg: Segment, c: Candidate, body: dict,
-                   hl_terms: Optional[dict] = None) -> dict:
+                   hl_terms: Optional[dict] = None,
+                   suppress_score: Optional[bool] = None) -> dict:
         # per-searcher index label (multi-index and cross-cluster searches
         # need the concrete "alias:index" name, not the joined expression)
         hit = {"_index": self.index_key or body.get("_index_name", ""),
@@ -724,6 +771,10 @@ class ShardSearcher:
                "_score": c.score}
         if body.get("sort"):
             hit["sort"] = list(c.raw_sort_values)
+            if suppress_score is None:
+                suppress_score = _suppress_score(body)
+            if suppress_score:
+                hit["_score"] = None
         stored_opt = body.get("stored_fields")
         # reference semantics: asking for stored_fields suppresses _source
         # unless the request opts back in explicitly
@@ -885,6 +936,17 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     if _qcost.enabled() and _qcost.current() is None:
         _, qc_token = _qcost.start(
             detail=body.get("explain") == "device_plan")
+    # request deadline: REST/distnode installs the ambient budget at
+    # accept time (queue wait counts); direct engine callers get one
+    # derived from the body's `timeout` here
+    dl_token = None
+    if _dl.current() is None:
+        try:
+            _deadline = _dl.Deadline.from_body(body)
+        except ValueError as e:
+            raise dsl.QueryParseError(str(e))
+        if _deadline is not None:
+            dl_token = _dl.set_current(_deadline)
     try:
         results = []
         for i, s in enumerate(searchers):
@@ -905,6 +967,8 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
         return _finish_search(searchers, results, body, stats, index_name,
                               t0, agg_nodes)
     finally:
+        if dl_token is not None:
+            _dl.reset_current(dl_token)
         if qc_token is not None:
             _qcost.finish(qc_token)
 
@@ -1117,15 +1181,30 @@ def _finish_search(searchers: List[ShardSearcher],
             total, relation = track_n, "gte"
     took_ms = (time.monotonic() - t0) * 1000.0
     METRICS.histogram("search.total").record(took_ms)
+    timed_out = any(r.timed_out for r in results)
+    terminated_early = any(r.terminated_early for r in results)
+    if body.get("allow_partial_search_results", True) is False \
+            and timed_out:
+        # reference parity: partial pages refused -> whole-request error
+        # (the REST facade maps this to a 503
+        # search_phase_execution_exception)
+        raise _dl.PartialResultsUnacceptable(
+            "request timed out with allow_partial_search_results=false")
+    # track_scores (reference): a field-sorted request normally reports
+    # max_score null; track_scores=true opts the rollup back in (the
+    # engine computes scores regardless — they are free on device)
+    show_max = not body.get("sort") or bool(body.get("track_scores"))
     resp = {
         "took": int(took_ms),
-        "timed_out": False,
+        "timed_out": timed_out,
         "_shards": {"total": len(searchers), "successful": len(searchers),
                     "skipped": 0, "failed": 0},
         "hits": {"total": {"value": total, "relation": relation},
-                 "max_score": reduced["max_score"] if not body.get("sort") else None,
+                 "max_score": reduced["max_score"] if show_max else None,
                  "hits": hits},
     }
+    if terminated_early:
+        resp["terminated_early"] = True
     if reduced["aggs"]:
         resp["aggregations"] = reduced["aggs"]
     if body.get("suggest"):

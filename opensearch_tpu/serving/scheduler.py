@@ -90,7 +90,18 @@ LANES = ("interactive", "batch")
 # they bypass the scheduler unchanged (the decline still happens at the
 # same place it does today, with the same attribution)
 _BYPASS_KEYS = ("knn", "rescore", "min_score", "profile", "collapse",
-                "suggest", "search_after", "highlight", "script_fields")
+                "suggest", "search_after", "highlight", "script_fields",
+                # budgeted bodies need the deadline-AWARE executor: only
+                # the host shard loop stops between segment programs
+                # (terminate_after) / checks the deadline — the batched
+                # mesh/kernel launches are deadline-blind, so a `timeout`
+                # body coalesced into a batch could blow its budget
+                # inside one launch with no partial-results exit. The
+                # entry.wait_s derivation below still serves requests
+                # whose deadline arrives AMBIENTLY (hop-propagated
+                # deadline_ctx, no body timeout — ROADMAP item 2's
+                # per-node schedulers)
+                "terminate_after", "timeout")
 
 # entry states (transitions under the scheduler condition lock)
 _QUEUED, _CLAIMED, _DONE, _ABANDONED = "queued", "claimed", "done", "abandoned"
@@ -147,7 +158,7 @@ class SchedulerConfig:
 
 class _Pending:
     __slots__ = ("name", "svc", "body", "lane", "task", "enq", "done",
-                 "resp", "error", "state", "tl")
+                 "resp", "error", "state", "tl", "wait_s")
 
     def __init__(self, name: str, svc, body: dict, lane: str, task):
         self.name = name
@@ -164,6 +175,10 @@ class _Pending:
         # dispatcher/completion threads have no ambient timeline, so the
         # id rides the entry explicitly (0 = recorder disabled)
         self.tl = 0
+        # scheduler deadline, derived from the request's remaining
+        # budget at enqueue (deadline ladder, docs/RESILIENCE.md); None
+        # = no ambient deadline, wait the configured request timeout
+        self.wait_s: Optional[float] = None
 
     def _stage(self, stage) -> None:
         """Mark the live serving stage on the request's task (surfaced by
@@ -311,8 +326,18 @@ class ServingScheduler:
         if not isinstance(body, dict):
             return False
         for k in _BYPASS_KEYS:
-            if body.get(k) is not None:
-                return False
+            if body.get(k) is None:
+                continue
+            if k == "timeout":
+                # only a LIVE budget forces the host loop; the reference
+                # no-timeout sentinel (-1 -> no deadline) keeps batching
+                from ..utils.deadline import parse_timeout_s
+                try:
+                    if parse_timeout_s(body["timeout"]) is None:
+                        continue
+                except ValueError:
+                    pass             # junk -> host loop raises the 400
+            return False
         if body.get("explain") == "device_plan":
             # the device-plan cost view needs the requesting thread's own
             # cost accumulator (obs/query_cost.py) — a coalesced launch
@@ -338,6 +363,14 @@ class ServingScheduler:
         entry = _Pending(name, svc, body, lane, task)
         if _fr.RECORDER.enabled:
             entry.tl = _fr.current()
+        from ..utils import deadline as _ddl
+        _dl = _ddl.current()
+        if _dl is not None:
+            # the scheduler's own deadline derives from what is LEFT of
+            # the request budget at enqueue — queue wait spends from the
+            # same clock as everything downstream
+            entry.wait_s = max(min(self.config.request_timeout_s,
+                                   _dl.remaining_s()), 0.0)
         # ONE critical section for closed-check, admission, dispatcher
         # liveness and enqueue: the dispatcher's idle-exit decision runs
         # under the same condition, so an entry can never land in the
@@ -394,7 +427,11 @@ class ServingScheduler:
         return self._await(entry)
 
     def _await(self, entry: _Pending):
-        if not entry.done.wait(self.config.request_timeout_s):
+        wait1 = (entry.wait_s if entry.wait_s is not None
+                 else self.config.request_timeout_s)
+        deadline_cut = entry.wait_s is not None \
+            and entry.wait_s < self.config.request_timeout_s
+        if not entry.done.wait(wait1):
             with self._cond:
                 if entry.state == _QUEUED:
                     # scheduler wedged with the entry still queued: pull it
@@ -411,6 +448,21 @@ class ServingScheduler:
                     self.direct_fallbacks += 1
                     METRICS.counter("serving.direct_fallbacks").inc()
             if entry.state == _ABANDONED:
+                if deadline_cut:
+                    # the REQUEST's budget (shorter than the scheduler
+                    # timeout) ran out while queued — not a wedge, no
+                    # dump: degrade to direct execution, which the
+                    # executor's own deadline check turns into an
+                    # immediate honest timed_out partial page
+                    if _fr.RECORDER.enabled and entry.tl:
+                        _fr.RECORDER.record(
+                            entry.tl, "sched.degrade",
+                            why="request_deadline",
+                            waited_ms=round(
+                                (time.monotonic() - entry.enq) * 1000.0,
+                                3))
+                    entry._stage(None)
+                    return self._direct(entry.name, entry.svc, entry.body)
                 # the request missed its deadline while STILL QUEUED — the
                 # dispatcher is wedged or starved. Freeze the timeline
                 # before degrading: this is exactly the after-the-fact
@@ -918,6 +970,13 @@ class ServingScheduler:
         """Fetch + render + resolve one in-flight batch. Never raises for
         per-group failures: an errored group degrades its entries to the
         host loop (resp None), exactly like the synchronous dispatcher."""
+        from ..cluster import faults as _faults
+        if _faults.enabled():
+            # chaos site: slow-fetch / completion-stage fault injection
+            # (cluster/faults.py; the degradation ladder above this —
+            # completion wedge -> request-thread direct — is what the
+            # injected stall exercises)
+            _faults.on_sched_complete(self.node.node_name)
         for (name, svc, entries, bodies, handles, err) in item.groups:
             if err:
                 resps = [None] * len(entries)

@@ -1,0 +1,151 @@
+"""Request deadline propagation (docs/RESILIENCE.md).
+
+A search request's `timeout` becomes ONE budget, fixed at accept time,
+that every stage downstream derives its own limit from — the executor's
+between-segment budget check, the serving scheduler's queue wait, and
+every cross-node `/_internal` RPC timeout (cluster/distnode.py stamps the
+remaining budget onto the RPC payload exactly like the `trace_ctx` /
+`obs_ctx` pair). The reference analog is the coordinator's
+`SearchTimeoutException` ladder: one `timeout` on the request, honored
+end-to-end, instead of a fixed per-hop transport timeout.
+
+Two invariants:
+
+- **Monotonic only.** The budget is a duration anchored to
+  `time.monotonic()`; the wire carries `remaining_ms` (a duration
+  re-anchored on arrival), never an absolute wall timestamp — clocks on
+  two nodes need not agree (OSL501 discipline).
+- **Ambient, not threaded.** The active deadline rides a contextvar so
+  the executor / scheduler / RPC layers consult it without plumbing a
+  parameter through every signature; `scope()` owns set/reset.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+from typing import Optional
+
+# an RPC must never be issued with a zero/negative socket timeout (urllib
+# treats 0 as "no timeout"); the floor converts "nearly exhausted" into
+# "fail fast" instead of "wait forever"
+MIN_RPC_TIMEOUT_S = 0.001
+
+
+class DeadlineExhausted(Exception):
+    """An operation was attempted with no request budget left."""
+
+
+class PartialResultsUnacceptable(Exception):
+    """`allow_partial_search_results=false` and a shard failed or the
+    request timed out — the whole request fails instead of serving a
+    partial page (reference SearchPhaseExecutionException)."""
+
+
+def parse_timeout_s(spec) -> Optional[float]:
+    """Parse a search `timeout` value into seconds. Accepts reference
+    time-value strings (`"500ms"`, `"2s"`, `"1m"`, `"1h"`, `"250micros"`,
+    `"10nanos"`) and bare numbers, which are milliseconds (reference
+    TimeValue default unit). None/False -> no deadline. NEGATIVE values
+    are the reference's "no timeout" sentinel (`-1`,
+    `search.default_search_timeout=-1`) -> no deadline; an explicit zero
+    is a legitimate degenerate budget (instantly exhausted)."""
+    if spec is None or spec is False:
+        return None
+    if isinstance(spec, bool):
+        raise ValueError(f"failed to parse timeout [{spec}]")
+    if isinstance(spec, (int, float)):
+        v = float(spec) / 1000.0
+        return None if v < 0 else v
+    s = str(spec).strip().lower()
+    units = (("nanos", 1e-9), ("micros", 1e-6), ("ms", 1e-3),
+             ("s", 1.0), ("m", 60.0), ("h", 3600.0), ("d", 86400.0))
+    try:
+        v = None
+        for suffix, mult in units:
+            if s.endswith(suffix):
+                v = float(s[: -len(suffix)]) * mult
+                break
+        if v is None:
+            v = float(s) / 1000.0
+    except ValueError:
+        raise ValueError(f"failed to parse timeout [{spec}]")
+    return None if v < 0 else v
+
+
+class Deadline:
+    """A fixed budget anchored at creation; every consumer derives from
+    `remaining_s()` so the ladder is consistent no matter how many hops
+    or stages the request crosses."""
+
+    __slots__ = ("budget_s", "_t0")
+
+    def __init__(self, budget_s: float, _t0: Optional[float] = None):
+        self.budget_s = float(budget_s)
+        self._t0 = time.monotonic() if _t0 is None else _t0
+
+    @classmethod
+    def from_body(cls, body) -> Optional["Deadline"]:
+        """Deadline from a search body's `timeout` key (None when the
+        request carries no timeout). Raises ValueError on junk."""
+        if not isinstance(body, dict):
+            return None
+        budget = parse_timeout_s(body.get("timeout"))
+        return cls(budget) if budget is not None else None
+
+    def remaining_s(self) -> float:
+        return self.budget_s - (time.monotonic() - self._t0)
+
+    def exhausted(self) -> bool:
+        return self.remaining_s() <= 0.0
+
+    def rpc_timeout_s(self, cap_s: float) -> float:
+        """The per-hop RPC timeout: min(remaining budget, transport cap),
+        floored so a nearly-exhausted budget fails fast instead of
+        turning into an unbounded socket wait."""
+        return max(min(cap_s, self.remaining_s()), MIN_RPC_TIMEOUT_S)
+
+    # ---- wire form: a duration, re-anchored by the receiving hop ----
+
+    def to_wire(self) -> dict:
+        return {"remaining_ms": max(self.remaining_s(), 0.0) * 1000.0}
+
+    @classmethod
+    def from_wire(cls, ctx) -> Optional["Deadline"]:
+        if not isinstance(ctx, dict) or "remaining_ms" not in ctx:
+            return None
+        try:
+            return cls(float(ctx["remaining_ms"]) / 1000.0)
+        except (TypeError, ValueError):
+            return None
+
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "ostpu_deadline", default=None)
+
+
+def current() -> Optional[Deadline]:
+    return _current.get()
+
+
+def set_current(dl: Optional[Deadline]):
+    return _current.set(dl)
+
+
+def reset_current(token) -> None:
+    _current.reset(token)
+
+
+@contextlib.contextmanager
+def scope(dl: Optional[Deadline]):
+    """Install `dl` as the ambient deadline for the duration (no-op when
+    dl is None, so callers need not branch)."""
+    if dl is None:
+        yield None
+        return
+    token = set_current(dl)
+    try:
+        yield dl
+    finally:
+        reset_current(token)

@@ -1,0 +1,197 @@
+"""Fault-injection bench for the distributed serving tier
+(docs/RESILIENCE.md): an in-process 3-node cluster with one replica per
+shard, a fixed seeded query stream, and a scenario ladder driven by the
+chaos harness (`cluster/faults.py`):
+
+- `baseline`     — no faults; the byte-identity oracle for every
+                   recovered scenario
+- `kill_node`    — one member hard-killed (every RPC to it drops):
+                   replica failover must serve IDENTICAL pages with
+                   `_shards.failed == 0`
+- `flaky`        — p=0.3 seeded drop on every RPC send to one member:
+                   retry + failover absorb the noise
+- `slow_node`    — 25 ms injected delay per RPC to one member: the
+                   latency cost of a degraded (not dead) peer
+- `deadline`     — 30 s blackhole on one member + 250 ms request
+                   timeouts on a primaries-only index: every page must
+                   come back `timed_out` WITHIN budget
+
+Reports per scenario: wall, qps, p50/p95 latency, pages with failed
+shards / timed_out, byte-identity vs baseline, and the retry/failover/
+deadline counter deltas. Exit code 1 if a recovered scenario diverges
+from baseline or the deadline scenario stalls.
+
+Run: `python scripts/measure_faults.py [nqueries] [--json out.json]`
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+from opensearch_tpu.cluster import faults
+from opensearch_tpu.cluster.distnode import DistClusterNode, RetryPolicy
+from opensearch_tpu.utils.metrics import METRICS
+
+WORDS = ["alpha", "beta", "gamma", "delta", "eps", "zeta", "kappa",
+         "lambda", "sigma", "omega", "tau", "phi"]
+NDOCS = 2000
+VICTIM = "fb"
+
+_COUNTERS = ("dist.rpc.retry", "dist.rpc.failover",
+             "dist.deadline.exhausted", "dist.rpc.failed")
+
+
+def build_cluster():
+    policy = RetryPolicy(same_member_retries=1, budget=6,
+                         base_backoff_s=0.002, max_backoff_s=0.01)
+    a = DistClusterNode("fa", retry_policy=policy)
+    b = DistClusterNode("fb", seed=a.addr)
+    c = DistClusterNode("fc", seed=a.addr)
+    rng = np.random.default_rng(42)
+    a.create_index("fidx", {
+        "settings": {"number_of_shards": 6,
+                     "number_of_node_replicas": 1},
+        "mappings": {"properties": {"body": {"type": "text"},
+                                    "num": {"type": "integer"}}}})
+    a.create_index("fprim", {
+        "settings": {"number_of_shards": 3},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    for i in range(NDOCS):
+        doc = {"body": " ".join(rng.choice(WORDS,
+                                           size=int(rng.integers(4, 10)))),
+               "num": int(rng.integers(0, 1000))}
+        a.index_doc("fidx", doc, id=str(i))
+        if i % 4 == 0:
+            a.index_doc("fprim", {"body": doc["body"]}, id=str(i))
+    a.refresh("fidx")
+    a.refresh("fprim")
+    return a, b, c
+
+
+def query_stream(n, seed=7):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        terms = " ".join(rng.choice(WORDS, size=int(rng.integers(1, 3))))
+        out.append({"query": {"match": {"body": terms}}, "size": 10})
+    return out
+
+
+def norm(resp):
+    return json.dumps({k: v for k, v in resp.items() if k != "took"},
+                      sort_keys=True)
+
+
+def counter_snap():
+    return {c: METRICS.counter(c).value for c in _COUNTERS}
+
+
+def run_scenario(name, coord, index, bodies, schedule, extra_body=None):
+    if schedule is not None:
+        faults.install(schedule)
+    lats, pages, partial = [], [], []
+    failed_pages = timed_out_pages = 0
+    before = counter_snap()
+    t0 = time.monotonic()
+    try:
+        for body in bodies:
+            b = dict(body, **(extra_body or {}))
+            q0 = time.monotonic()
+            r = coord.search(index, b)
+            lats.append((time.monotonic() - q0) * 1000.0)
+            pages.append(norm(r))
+            partial.append(bool(r["_shards"]["failed"]))
+            if r["_shards"]["failed"]:
+                failed_pages += 1
+            if r["timed_out"]:
+                timed_out_pages += 1
+    finally:
+        faults.uninstall()
+        coord.member_fd.note_success(VICTIM)
+    wall = time.monotonic() - t0
+    after = counter_snap()
+    lat = np.asarray(lats)
+    return {"scenario": name, "queries": len(bodies),
+            "wall_s": round(wall, 3),
+            "qps": round(len(bodies) / wall, 1) if wall else None,
+            "lat_ms_p50": round(float(np.percentile(lat, 50)), 2),
+            "lat_ms_p95": round(float(np.percentile(lat, 95)), 2),
+            "pages_with_failed_shards": failed_pages,
+            "pages_timed_out": timed_out_pages,
+            "counters": {k: after[k] - before[k] for k in _COUNTERS},
+            }, pages, partial
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("nqueries", nargs="?", type=int, default=64)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    a, b, c = build_cluster()
+    bodies = query_stream(args.nqueries)
+    results = []
+    ok = True
+    try:
+        base, base_pages, _ = run_scenario("baseline", a, "fidx",
+                                           bodies, None)
+        results.append(base)
+
+        for name, sched, allow_partial in (
+                ("kill_node",
+                 faults.ChaosSchedule(seed=1).kill_node(VICTIM), False),
+                # flaky drops can land on a FETCH rpc, which by design
+                # never fails over (doc coordinates are copy-local): a
+                # few honest partial pages are the contract, so the gate
+                # is "every CLEAN page is byte-identical"
+                ("flaky",
+                 faults.ChaosSchedule(seed=2).add(
+                     "rpc.send", "drop", member=VICTIM, p=0.3), True),
+                ("slow_node",
+                 faults.ChaosSchedule(seed=3).pause_node(VICTIM,
+                                                         0.025), False)):
+            row, pages, partial = run_scenario(name, a, "fidx", bodies,
+                                               sched)
+            clean_ident = all(p == bp for p, bp, part
+                              in zip(pages, base_pages, partial)
+                              if not part)
+            row["clean_pages_byte_identical"] = clean_ident
+            row["recovered_clean"] = clean_ident and (
+                allow_partial or row["pages_with_failed_shards"] == 0)
+            ok = ok and row["recovered_clean"]
+            results.append(row)
+
+        dl_row, _, _ = run_scenario(
+            "deadline", a, "fprim", bodies[: max(args.nqueries // 4, 8)],
+            faults.ChaosSchedule(seed=4).add(
+                "rpc.send", "blackhole", member=VICTIM, after=1,
+                delay_s=30.0),
+            extra_body={"timeout": "250ms"})
+        dl_row["within_budget"] = dl_row["lat_ms_p95"] < 2000.0
+        dl_row["all_timed_out"] = (dl_row["pages_timed_out"]
+                                   == dl_row["queries"])
+        ok = ok and dl_row["within_budget"]
+        results.append(dl_row)
+    finally:
+        for n in (a, b, c):
+            n.stop()
+
+    out = {"bench": "measure_faults", "ndocs": NDOCS,
+           "nqueries": args.nqueries, "victim": VICTIM,
+           "scenarios": results, "gate_ok": ok}
+    print(json.dumps(out, indent=2))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(out, fh, indent=2)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -792,6 +792,104 @@ class TestMemoryAccounting:
         assert [f.render() for f in findings if f.rule == "OSL506"] == []
 
 
+class TestRpcDiscipline:
+    """OSL508 — RPC-path discipline in cluster/: deadline-derived
+    timeouts on every wire call, no silently-swallowed transport
+    errors."""
+
+    def test_osl508_urlopen_without_timeout(self):
+        src = """
+            import urllib.request
+
+            def rpc(addr, req):
+                with urllib.request.urlopen(req) as r:
+                    return r.read()
+        """
+        found = lint(src, "opensearch_tpu/cluster/distnode.py")
+        assert [f for f in found if f.rule == "OSL508"
+                and f.detail == "no-timeout:urlopen"]
+
+    def test_osl508_quiet_with_timeout_kwarg(self):
+        src = """
+            import urllib.request
+
+            def rpc(addr, req, deadline):
+                t = deadline.rpc_timeout_s(30.0)
+                with urllib.request.urlopen(req, timeout=t) as r:
+                    return r.read()
+        """
+        assert rules_of(lint(src, "opensearch_tpu/cluster/distnode.py")) \
+            == []
+
+    def test_osl508_swallowed_transport_error(self):
+        src = """
+            import urllib.error
+
+            def publish(addrs, push):
+                for a in addrs:
+                    try:
+                        push(a)
+                    except (urllib.error.URLError, OSError):
+                        pass
+        """
+        found = lint(src, "opensearch_tpu/cluster/distnode.py")
+        assert [f for f in found if f.rule == "OSL508"
+                and f.detail == "swallowed-rpc-error"]
+
+    def test_osl508_quiet_when_failure_recorded(self):
+        src = """
+            import urllib.error
+
+            def publish(addrs, push, metrics):
+                for a in addrs:
+                    try:
+                        push(a)
+                    except (urllib.error.URLError, OSError):
+                        metrics.counter("dist.publish.failed").inc()
+        """
+        assert rules_of(lint(src, "opensearch_tpu/cluster/distnode.py")) \
+            == []
+
+    def test_osl508_bare_except_pass_flagged(self):
+        # a bare except swallows transport errors with everything else
+        src = """
+            def fire(push):
+                try:
+                    push()
+                except:
+                    pass
+        """
+        found = lint(src, "opensearch_tpu/cluster/replication.py")
+        assert [f for f in found if f.detail == "swallowed-rpc-error"]
+
+    def test_osl508_non_transport_except_quiet(self):
+        src = """
+            def parse(blob):
+                try:
+                    return int(blob)
+                except ValueError:
+                    pass
+        """
+        assert rules_of(lint(src, "opensearch_tpu/cluster/node.py")) == []
+
+    def test_osl508_out_of_scope_quiet(self):
+        # the discipline patrols cluster/ only (bench scripts and tests
+        # probe without deadlines by design)
+        src = """
+            import urllib.request
+
+            def probe(req):
+                return urllib.request.urlopen(req).read()
+        """
+        assert rules_of(lint(src, "opensearch_tpu/rest/client.py")) == []
+
+    def test_osl508_repo_clean(self):
+        # the ratchet at zero: every cluster/ wire call is bounded and
+        # every transport-error handler records the loss
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f.render() for f in findings if f.rule == "OSL508"] == []
+
+
 # ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
