@@ -100,6 +100,13 @@ from .routing import assign_copies, order_copies, shard_for
 _RPC_TIMEOUT_CAP_S = float(os.environ.get("OPENSEARCH_TPU_RPC_CAP_S",
                                           30.0))
 
+# observability scrapes (cluster stats / hot_threads / history fan-out)
+# get a TIGHTER default cap: a monitoring poll against a wedged member
+# must degrade to a per-node `failed` entry in seconds, never hold the
+# coordinator for the full transport cap. A live request deadline still
+# tightens it further (deadline-ctx rides the scrape like any RPC).
+_SCRAPE_CAP_S = float(os.environ.get("OPENSEARCH_TPU_SCRAPE_CAP_S", 5.0))
+
 
 class RetryPolicy:
     """Per-shard retry + failover knobs (docs/RESILIENCE.md). In-place
@@ -335,6 +342,11 @@ class DistClusterNode:
         # members are demoted in every shard's preference order until a
         # successful probe/RPC (cluster/failure.py)
         self.member_fd = MemberFailureDetector()
+        # registry this node answers fleet scrapes from. None -> the
+        # process-default METRICS (the one-node-per-process deployment);
+        # in-process multi-node tests inject distinct registries so the
+        # merge math federates genuinely disjoint streams
+        self.obs_registry = None
         if seed is not None:
             st = _http(seed, "POST", "/_internal/join",
                        {"name": name, "addr": self.addr})
@@ -411,10 +423,13 @@ class DistClusterNode:
         if op == "publish" and method == "POST":
             self._apply_state(body["state"])
             return 200, {"acknowledged": True}
-        if op in ("dfs", "query_phase", "fetch_phase"):
+        if op in ("dfs", "query_phase", "fetch_phase",
+                  "stats", "node_stats", "hot_threads", "history"):
             # deadline propagation: re-anchor the remaining budget the
             # coordinator stamped; an already-exhausted budget answers an
             # immediate 408 shard failure instead of a full local phase
+            # (observability scrapes ride the same contract — a fleet
+            # poll under a request deadline degrades honestly)
             dl = _dl.Deadline.from_wire(body.get("deadline_ctx"))
             if dl is not None and dl.exhausted():
                 from ..utils.metrics import METRICS
@@ -424,6 +439,9 @@ class DistClusterNode:
                     "reason": f"[{op}] arrived with an exhausted "
                               f"deadline budget"}}
             with _dl.scope(dl):
+                if op in ("stats", "node_stats", "hot_threads",
+                          "history"):
+                    return 200, self._handle_obs(op, body)
                 return self._handle_phase(op, body)
         if op == "state" and method == "GET":
             return 200, {"state": self._state()}
@@ -888,6 +906,7 @@ class DistClusterNode:
         and every local segment loop downstream derives its budget from
         it (utils/deadline.py)."""
         from ..obs import flight_recorder as _fr
+        from ..utils.metrics import METRICS
         from ..utils.trace import TRACER
         try:
             dl = (_dl.current() or _dl.Deadline.from_body(body))
@@ -898,6 +917,10 @@ class DistClusterNode:
             tl = _fr.RECORDER.start("dist.search", index=index,
                                     node=self.name)
             token = _fr.set_current(tl)
+        # per-lane SLIs at the COORDINATOR boundary (the distributed
+        # path never crosses Node.search): the same requests/errors
+        # counters + latency sketch the SLO engine windows (obs/slo.py)
+        t0 = time.monotonic()
         try:
             with _dl.scope(dl), \
                     TRACER.span("dist.search", index=index,
@@ -906,10 +929,21 @@ class DistClusterNode:
                     _fr.RECORDER.record(_fr.current(), "dist.accept",
                                         index=index,
                                         coordinator=self.name)
-                return self._search_traced(index, body)
+                resp = self._search_traced(index, body)
+        except BaseException as e:
+            # client-side 4xx API errors are the caller's fault, not
+            # lost availability (the Node.search contract)
+            if getattr(e, "status", 500) >= 500:
+                METRICS.counter("search.lane.interactive.errors").inc()
+            raise
         finally:
             if token is not None:
                 _fr.reset_current(token)
+        METRICS.counter("search.lane.interactive.requests").inc()
+        if METRICS.enabled:
+            METRICS.histogram("search.lane.interactive.latency_ms").record(
+                (time.monotonic() - t0) * 1000.0)
+        return resp
 
     # ---------------- per-phase scatter with retry + failover ----------
 
@@ -1166,6 +1200,223 @@ class DistClusterNode:
         if reduced["aggs"]:
             resp["aggregations"] = reduced["aggs"]
         return resp
+
+    # ---------------- fleet observability federation ----------------
+    #
+    # `GET /_cluster/stats`, `_nodes/stats`, `_nodes/{id}/hot_threads`
+    # and `_nodes/stats/history` fan out over the same `/_internal` RPC
+    # plane the search phases ride (docs/OBSERVABILITY.md "fleet"):
+    # counters SUM cluster-wide, gauges roll up PER NODE, and DDSketch
+    # histograms merge bin-wise (`utils/metrics.merge_sketches`) so
+    # fleet p50/p95/p99 come from ONE merged sketch — never from
+    # averaged per-node percentiles. Scrape failures degrade honestly:
+    # an unreachable member contributes a per-node `failed` entry and
+    # the `_nodes` rollup counts it; the coordinator never stalls past
+    # the scrape cap (deadline-ctx rides the scrape like any RPC).
+
+    def _obs_reg(self):
+        if self.obs_registry is not None:
+            return self.obs_registry
+        from ..utils.metrics import METRICS
+        return METRICS
+
+    def _handle_obs(self, op: str, body: dict) -> dict:
+        """Serving side of a fleet scrape (`/_internal/{stats,node_stats,
+        hot_threads,history}`)."""
+        if op == "stats":
+            return {"node": self.name,
+                    "wire": self._obs_reg().to_wire(),
+                    "indices": self.client.indices_summary()}
+        if op == "node_stats":
+            local = self.client.nodes_stats()
+            block = local["nodes"].get(self.node.node_name) or {}
+            return {"node": self.name, "stats": block}
+        if op == "hot_threads":
+            from ..obs.hot_threads import hot_threads as _ht
+            return {"node": self.name, "result": _ht(
+                node_name=self.name,
+                snapshots=int(body.get("snapshots", 3)),
+                interval_s=float(body.get("interval_ms", 20)) / 1000.0,
+                ignore_idle=bool(body.get("ignore_idle", True)),
+                as_json=bool(body.get("as_json", False)))}
+        # history
+        from ..obs.timeseries import SAMPLER
+        return {"node": self.name,
+                "history": SAMPLER.history(
+                    str(body.get("metric") or ""),
+                    float(body.get("window_s", 60.0)))}
+
+    def _scrape_timeout_s(self) -> float:
+        dl = _dl.current()
+        cap = min(_RPC_TIMEOUT_CAP_S, _SCRAPE_CAP_S)
+        return dl.rpc_timeout_s(cap) if dl is not None else cap
+
+    def _scrape(self, op: str, payload: dict,
+                members: Optional[List[str]] = None) -> Dict[str, tuple]:
+        """Fan one obs RPC out CONCURRENTLY; returns member ->
+        ("ok", result) or ("failed", reason). The self leg never crosses
+        the wire. Remote legs run on per-member threads carrying the
+        caller's context (deadline/trace/obs ctx ride each scrape), so
+        the whole fan-out is bounded by ONE scrape timeout — k wedged
+        members cost max(cap), not k*cap."""
+        import contextvars
+        from ..utils.metrics import METRICS
+        want = sorted(members if members is not None else self.members)
+        timeout_s = self._scrape_timeout_s()
+        out: Dict[str, tuple] = {}
+
+        def leg(member: str) -> tuple:
+            try:
+                return ("ok", self._rpc(member, op, payload,
+                                        timeout_s=timeout_s))
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                METRICS.counter("dist.scrape.failed").inc()
+                return ("failed", f"{type(e).__name__}: {e}"[:200])
+
+        threads = []
+        for member in want:
+            if member == self.name:
+                continue
+            ctx = contextvars.copy_context()
+            t = threading.Thread(
+                target=lambda m=member, c=ctx: out.__setitem__(
+                    m, c.run(leg, m)),
+                name=f"ostpu-scrape-{member}", daemon=True)
+            t.start()
+            threads.append(t)
+        if self.name in want:
+            out[self.name] = ("ok", self._handle_obs(op, payload))
+        for t in threads:
+            t.join()
+        return out
+
+    def _resolve_member(self, node_id: Optional[str]) -> List[str]:
+        """`_nodes/{id}/...` member filter. `_all`/`_local`/None keep
+        reference semantics; an unknown id is a 404, never a silent
+        coordinator-only answer."""
+        if node_id in (None, "_all"):
+            return sorted(self.members)
+        if node_id == "_local":
+            return [self.name]
+        if node_id in self.members:
+            return [node_id]
+        raise ApiError(404, "resource_not_found_exception",
+                       f"no such node [{node_id}]")
+
+    def cluster_stats(self) -> dict:
+        """`GET /_cluster/stats`: the fleet rollup. Counters sum, gauges
+        stay per-node, histograms merge into true fleet percentiles,
+        index totals sum over exactly the members that answered."""
+        from ..utils.metrics import merge_sketches, sketch_snapshot
+        scraped = self._scrape("stats", {})
+        nodes: Dict[str, dict] = {}
+        counters: Dict[str, float] = {}
+        hist_wires: Dict[str, list] = {}
+        indices = {"docs": 0, "store_in_bytes": 0, "segments": 0}
+        ok = 0
+        for member, (status, res) in scraped.items():
+            if status != "ok":
+                nodes[member] = {"status": "failed", "error": res}
+                continue
+            ok += 1
+            wire = res.get("wire") or {}
+            nodes[member] = {"status": "ok",
+                             "gauges": wire.get("gauges", {}),
+                             "counters": wire.get("counters", {}),
+                             "indices": res.get("indices", {})}
+            for k, v in (wire.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0) + v
+            for k, w in (wire.get("histograms") or {}).items():
+                hist_wires.setdefault(k, []).append(w)
+            for k in indices:
+                indices[k] += int((res.get("indices") or {}).get(k, 0))
+        merged = {k: merge_sketches(ws)
+                  for k, ws in sorted(hist_wires.items())}
+        return {
+            "cluster_name": self.node.metadata.cluster_name,
+            "coordinator": self.name,
+            "_nodes": {"total": len(scraped), "successful": ok,
+                       "failed": len(scraped) - ok},
+            "nodes": nodes,
+            "indices": indices,
+            "counters": dict(sorted(counters.items())),
+            # fleet percentiles FROM MERGED SKETCHES (the per-node
+            # sketches are also returned so a reader can re-derive)
+            "percentiles": {k: sketch_snapshot(w)
+                            for k, w in merged.items()},
+            "histograms": merged,
+        }
+
+    def nodes_stats_federated(self, node_id: Optional[str] = None
+                              ) -> dict:
+        """`GET /_nodes[/{id}]/stats` with node fan-out: each targeted
+        member's full per-node stats block under its cluster member
+        name; unreachable members degrade to `{"failed": ...}` entries,
+        an unknown id is a 404 (never a silent whole-fleet answer)."""
+        scraped = self._scrape("node_stats", {},
+                               self._resolve_member(node_id))
+        nodes = {}
+        ok = 0
+        for member, (status, res) in scraped.items():
+            if status == "ok":
+                ok += 1
+                nodes[member] = res.get("stats") or {}
+            else:
+                nodes[member] = {"failed": res}
+        return {"cluster_name": self.node.metadata.cluster_name,
+                "_nodes": {"total": len(scraped), "successful": ok,
+                           "failed": len(scraped) - ok},
+                "nodes": nodes}
+
+    def hot_threads_federated(self, node_id: Optional[str] = None,
+                              snapshots: int = 3,
+                              interval_ms: float = 20.0,
+                              ignore_idle: bool = True,
+                              as_json: bool = False):
+        """`GET /_nodes[/{id}]/hot_threads` across the cluster: per-node
+        sections (each member samples ITS OWN process — before this,
+        the coordinator silently sampled only itself), unreachable
+        members as explicit failed sections."""
+        members = self._resolve_member(node_id)
+        payload = {"snapshots": int(snapshots),
+                   "interval_ms": float(interval_ms),
+                   "ignore_idle": bool(ignore_idle),
+                   "as_json": bool(as_json)}
+        scraped = self._scrape("hot_threads", payload, members)
+        if as_json:
+            return {"nodes": {
+                m: ({"threads": res.get("result")} if status == "ok"
+                    else {"failed": res})
+                for m, (status, res) in scraped.items()}}
+        parts = []
+        for m, (status, res) in scraped.items():
+            if status == "ok":
+                parts.append(str(res.get("result")))
+            else:
+                parts.append(f"::: {{{m}}}\n   <hot_threads scrape "
+                             f"failed: {res}>\n")
+        return "".join(parts)
+
+    def history_federated(self, metric: str, window_s: float = 60.0,
+                          node_id: Optional[str] = None) -> dict:
+        """`GET /_nodes[/{id}]/stats/history`: each member's local
+        time-series window for one metric (obs/timeseries.py)."""
+        members = self._resolve_member(node_id)
+        scraped = self._scrape(
+            "history", {"metric": metric, "window_s": float(window_s)},
+            members)
+        nodes = {}
+        ok = 0
+        for m, (status, res) in scraped.items():
+            if status == "ok":
+                ok += 1
+                nodes[m] = res.get("history") or {}
+            else:
+                nodes[m] = {"failed": res}
+        return {"metric": metric, "window_s": float(window_s),
+                "_nodes": {"total": len(scraped), "successful": ok,
+                           "failed": len(scraped) - ok},
+                "nodes": nodes}
 
     # ---------------- lifecycle + stats ----------------
 

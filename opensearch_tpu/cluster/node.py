@@ -388,6 +388,18 @@ class Node:
         # forces it on (single-chip kernel batching) or off
         from ..serving import ServingScheduler
         self.serving = ServingScheduler(self)
+        # fleet observability (obs/timeseries.py + obs/slo.py): the
+        # time-series retention ring behind `_nodes/stats/history` and
+        # the SLO burn-rate engine behind `GET /_slo`. Process singletons
+        # like METRICS/RECORDER/LEDGER; the sampler thread does NOT
+        # auto-start (tests tick deterministically) unless
+        # OPENSEARCH_TPU_TS=1 pins always-on retention for servers
+        from ..obs.slo import SLO_ENGINE
+        from ..obs.timeseries import SAMPLER
+        self.timeseries = SAMPLER
+        self.slo = SLO_ENGINE
+        if os.environ.get("OPENSEARCH_TPU_TS") not in (None, "", "0"):
+            SAMPLER.ensure_started()
         # persistent tasks (reference persistent/AllocatedPersistentTask):
         # durable task table + resumable executors; built-in: reindex
         from ..utils.persistent_tasks import PersistentTasksService
@@ -937,20 +949,45 @@ class Node:
         current — direct engine callers, tests — this entry point owns
         one for the duration of the search, so every downstream event
         (scheduler, mesh, fastpath ladder) lands on a journal."""
+        # per-lane SLIs (docs/OBSERVABILITY.md "fleet"): every search
+        # lands one requests/errors/rejected count and one latency sample
+        # under its lane — the counters the time-series sampler windows
+        # and the SLO burn-rate engine judges (obs/slo.py). Recorded at
+        # THIS boundary so cache hits, scheduler 429s and host-loop
+        # fallbacks all count exactly once.
+        from ..utils.metrics import METRICS as _m
+        from ..utils.wlm import PressureRejectedException as _rej
+        lane = wlm_lane or "interactive"
+        _t0 = time.monotonic()
         _rec = self.flight_recorder
         tl = _fr.current() if _rec.enabled else 0
-        if not _rec.enabled or tl:
-            return self._search_recorded(expression, body, phase_hook,
-                                         phase_ctx, copy_protect,
-                                         wlm_lane, tl)
-        tl = _rec.start("search", index=expression, node=self.node_name)
-        token = _fr.set_current(tl)
+        token = None
+        if _rec.enabled and not tl:
+            tl = _rec.start("search", index=expression,
+                            node=self.node_name)
+            token = _fr.set_current(tl)
         try:
-            return self._search_recorded(expression, body, phase_hook,
+            resp = self._search_recorded(expression, body, phase_hook,
                                          phase_ctx, copy_protect,
                                          wlm_lane, tl)
+        except _rej:
+            _m.counter(f"search.lane.{lane}.rejected").inc()
+            raise
+        except BaseException as e:
+            # client-side 4xx API errors (bad query, missing index) are
+            # the caller's fault, not lost availability — only server
+            # faults burn the error budget
+            if getattr(e, "status", 500) >= 500:
+                _m.counter(f"search.lane.{lane}.errors").inc()
+            raise
         finally:
-            _fr.reset_current(token)
+            if token is not None:
+                _fr.reset_current(token)
+        _m.counter(f"search.lane.{lane}.requests").inc()
+        if _m.enabled:
+            _m.histogram(f"search.lane.{lane}.latency_ms").record(
+                (time.monotonic() - _t0) * 1000.0)
+        return resp
 
     def _search_recorded(self, expression: str, body: dict, phase_hook,
                          phase_ctx: Optional[dict], copy_protect: bool,

@@ -131,6 +131,7 @@ def default_checkers() -> List[Checker]:
     from .memory_rules import MemoryAccountingChecker
     from .recorder_rules import RecorderDisciplineChecker
     from .rpc_rules import RpcDisciplineChecker
+    from .sampler_rules import SamplerDisciplineChecker
     from .sync_rules import DeviceSyncDisciplineChecker
     from .telemetry_rules import TelemetryDisciplineChecker
     return [DtypeDisciplineChecker(), JitBoundaryChecker(),
@@ -138,7 +139,7 @@ def default_checkers() -> List[Checker]:
             TelemetryDisciplineChecker(), WaitDisciplineChecker(),
             DeviceSyncDisciplineChecker(), RecorderDisciplineChecker(),
             MemoryAccountingChecker(), ImpactDomainChecker(),
-            RpcDisciplineChecker()]
+            RpcDisciplineChecker(), SamplerDisciplineChecker()]
 
 
 def run_source(src: str, path: str,

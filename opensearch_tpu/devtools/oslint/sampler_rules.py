@@ -1,0 +1,186 @@
+"""OSL509 — sampler / retention discipline.
+
+The time-series sampler (obs/timeseries.py) runs forever in the
+background of a serving node. Three ways that quietly goes wrong, each
+encoded here (the discipline the module's design follows):
+
+- **Wall-clock samples.** A sampler that stamps ticks with
+  `time.time()` produces series an NTP step can reorder and rates that
+  go negative; every timestamp and cadence decision in sampler code
+  must come from the monotonic clock (the single (wall, mono) display
+  anchor lives outside the loop).
+- **Unbounded retention.** A sampler loop that `self.<attr>.append(...)`s
+  onto a plain list grows without bound — a memory leak with an
+  observability costume. Persistent sample storage must be a bounded
+  ring: `deque(maxlen=...)` (or an equivalent the file can prove
+  bounded). Per-tick LOCAL lists are fine — they die with the tick.
+- **Windowless SLOs.** An `SLO(...)` definition without explicit
+  `fast_window_s`/`slow_window_s` keywords is a dashboard, not an
+  alert: the evaluation window is the objective's semantics
+  (obs/slo.py makes them required at runtime; the lint catches the
+  construction site before it runs).
+
+Sampler scope is structural: functions named like a sampler tick
+(`sample_once`, `_sample*`, `_tick*`, `_run_sampler`) and every method
+of a class whose name contains `Sampler`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_SAMPLER_FN_NAMES = ("sample_once", "_run_sampler", "sampler_loop")
+_SAMPLER_FN_PREFIXES = ("_sample", "_tick")
+
+
+def _is_sampler_fn(name: str, in_sampler_class: bool) -> bool:
+    if in_sampler_class:
+        # constructors are exempt: capturing the ONE (wall, mono)
+        # display anchor at construction is the sanctioned pattern —
+        # the rule patrols recurring tick code, not setup
+        return not name.startswith("__")
+    return (name in _SAMPLER_FN_NAMES
+            or any(name.startswith(p) for p in _SAMPLER_FN_PREFIXES))
+
+
+class SamplerDisciplineChecker(Checker):
+    rules = ("OSL509",)
+    name = "sampler-discipline"
+
+    SCOPES = ("obs/", "serving/", "utils/", "cluster/", "search/")
+    EXEMPT = ("devtools/",)
+
+    def applies(self, path: str) -> bool:
+        if any(s in path for s in self.EXEMPT):
+            return False
+        return any(s in path for s in self.SCOPES)
+
+    # ---------------- helpers ----------------
+
+    @staticmethod
+    def _time_aliases(tree: ast.Module):
+        mods: Set[str] = set()
+        funcs: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        mods.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        funcs.add(a.asname or "time")
+        return mods, funcs
+
+    @staticmethod
+    def _bounded_attrs(tree: ast.Module) -> Set[str]:
+        """Attribute names the file PROVES bounded: assigned from a
+        `deque(...)` call carrying a `maxlen=` keyword (any enclosing
+        scope — the ring is usually built in __init__)."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and _dotted(value.func).split(".")[-1] == "deque"
+                    and any(kw.arg == "maxlen" for kw in value.keywords)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    out.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    out.add(t.id)
+        return out
+
+    @staticmethod
+    def _walltime_call(node: ast.Call, mods: Set[str],
+                       funcs: Set[str]) -> bool:
+        d = _dotted(node.func)
+        if d in funcs:
+            return True
+        head, _, tail = d.rpartition(".")
+        return tail == "time" and head in mods
+
+    # ---------------- check ----------------
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        mods, funcs = self._time_aliases(tree)
+        bounded = self._bounded_attrs(tree)
+
+        def scan_fn(fn: ast.AST, sym: str) -> None:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._walltime_call(node, mods, funcs):
+                    findings.append(Finding(
+                        "OSL509", path, node.lineno, node.col_offset,
+                        sym,
+                        "wall clock in sampler code — sample stamps and "
+                        "cadence must be monotonic (time.monotonic); "
+                        "wall display goes through one anchor outside "
+                        "the loop",
+                        detail="sampler-walltime"))
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "append"
+                        and isinstance(node.func.value, ast.Attribute)):
+                    attr = node.func.value.attr
+                    if attr not in bounded:
+                        findings.append(Finding(
+                            "OSL509", path, node.lineno,
+                            node.col_offset, sym,
+                            f"sampler appends to `.{attr}` which this "
+                            f"file never builds as a bounded ring "
+                            f"(deque(maxlen=...)) — background "
+                            f"retention must be bounded",
+                            detail=f"unbounded-ring:{attr}"))
+
+        def visit(node: ast.AST, in_sampler_class: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, in_sampler_class
+                          or "Sampler" in child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    if _is_sampler_fn(child.name, in_sampler_class):
+                        scan_fn(child, qmap.get(child, child.name))
+                    else:
+                        visit(child, in_sampler_class)
+                else:
+                    visit(child, in_sampler_class)
+
+        visit(tree, False)
+
+        # SLO definitions must declare their evaluation windows
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func).split(".")[-1]
+            if callee != "SLO":
+                continue
+            kwargs = {kw.arg for kw in node.keywords}
+            if None in kwargs:
+                continue           # **kwargs splat: unknowable, trust it
+            # positional coverage: (name, kind, target, fast, slow)
+            npos = len(node.args)
+            has_fast = "fast_window_s" in kwargs or npos >= 4
+            has_slow = "slow_window_s" in kwargs or npos >= 5
+            if not (has_fast and has_slow):
+                findings.append(Finding(
+                    "OSL509", path, node.lineno, node.col_offset,
+                    qmap.get(node, ""),
+                    "SLO defined without explicit evaluation windows "
+                    "(fast_window_s / slow_window_s) — an objective "
+                    "without a window is a dashboard, not an alert",
+                    detail="slo-no-window"))
+
+        findings.sort(key=lambda f: (f.line, f.detail))
+        return findings

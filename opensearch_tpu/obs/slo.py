@@ -1,0 +1,365 @@
+"""SLO engine: declared objectives evaluated as multi-window burn rates.
+
+The last observability gap between "survived" and "detected": the
+telemetry registry holds totals, the time-series ring holds history, but
+until now nothing *judged* — a chaos run was declared healthy by a human
+reading a bench JSON. This module declares objectives per lane /
+workload group and evaluates them continuously over sliding windows of
+the time-series ring (`obs/timeseries.py`), Google-SRE style
+(multiwindow, multi-burn-rate alerting: an alert fires only when BOTH a
+fast and a slow window burn the error budget faster than the threshold —
+the fast window gives detection latency, the slow window suppresses
+blips).
+
+The model, uniformly for every objective kind:
+
+    bad_ratio(window)  = bad_events / total_events     over the window
+    budget             = 1 - target                    (target in (0,1))
+    burn_rate(window)  = bad_ratio / budget
+
+    FIRING  iff  burn(fast) > threshold  AND  burn(slow) > threshold
+
+Objective kinds map (lane-parameterized) onto the per-lane SLI
+instrumentation `cluster/node.py` records on every search:
+
+- ``latency``        — bad = requests whose recorded latency exceeded
+  `latency_budget_ms` (counted bin-granularly from the windowed sketch
+  delta); a `target` of 0.99 declares "p99 <= budget".
+- ``error_rate``     — bad = `search.lane.{lane}.errors`.
+- ``availability``   — bad = errors + backpressure rejections (any
+  request the node failed to serve).
+- ``rejection_rate`` — bad = `search.lane.{lane}.rejected` (the 429
+  path; `serving.lane.{lane}.rejected` mirrors the scheduler's own).
+- ``counter_ratio``  — explicit `bad_metrics` / `total_metrics` counter
+  lists; the escape hatch the chaos bench uses to watch transport
+  health (`dist.rpc.failed` + `dist.deadline.exhausted` per request).
+
+A firing transition emits an ``slo.burn`` flight-recorder event carrying
+the offending window's time series, freezes a dump bundle
+(reason ``slo_burn``), bumps `slo.alerts_total`, and flips the
+`slo.{name}.firing` gauge — visible at `GET /_slo`, in `_nodes/stats`
+("slo" block) and in `/_metrics`. Resolution is the fast window dropping
+back under threshold.
+
+Every SLO MUST declare its evaluation windows (`fast_window_s`,
+`slow_window_s`) — no defaults, and oslint OSL509 enforces the
+declaration statically at construction sites: an objective without a
+window is a dashboard, not an alert.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.metrics import METRICS, MetricsRegistry
+from .timeseries import SAMPLER, TimeSeriesSampler
+
+__all__ = ["SLO", "SLOEngine", "SLO_ENGINE", "default_slos"]
+
+_KINDS = ("latency", "error_rate", "availability", "rejection_rate",
+          "counter_ratio")
+
+# at most this many points of each offending series ride an alert's
+# recorder event (dumps are bounded; a 512-sample ring must not be)
+_ALERT_SERIES_POINTS = 120
+
+
+class SLO:
+    """One declared objective. Windows are mandatory (oslint OSL509)."""
+
+    def __init__(self, name: str, kind: str, target: float,
+                 fast_window_s: float, slow_window_s: float,
+                 lane: str = "interactive",
+                 latency_budget_ms: Optional[float] = None,
+                 burn_threshold: float = 10.0,
+                 min_events: int = 1,
+                 bad_metrics: Optional[Sequence[str]] = None,
+                 total_metrics: Optional[Sequence[str]] = None):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown SLO kind [{kind}] "
+                             f"(one of {_KINDS})")
+        if not 0.0 < float(target) < 1.0:
+            raise ValueError("SLO target must be in (0, 1) — the error "
+                             "budget is 1 - target")
+        if not (float(fast_window_s) > 0 and float(slow_window_s) > 0):
+            raise ValueError("SLO windows must be positive seconds")
+        if float(fast_window_s) > float(slow_window_s):
+            raise ValueError("fast window must not exceed the slow window")
+        if kind == "latency" and latency_budget_ms is None:
+            raise ValueError("latency SLOs need latency_budget_ms")
+        if kind == "counter_ratio" and not (bad_metrics and total_metrics):
+            raise ValueError("counter_ratio SLOs need bad_metrics and "
+                             "total_metrics")
+        self.name = name
+        self.kind = kind
+        self.target = float(target)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.lane = lane
+        self.latency_budget_ms = (float(latency_budget_ms)
+                                  if latency_budget_ms is not None else None)
+        self.burn_threshold = float(burn_threshold)
+        self.min_events = int(min_events)
+        self.bad_metrics = list(bad_metrics or [])
+        self.total_metrics = list(total_metrics or [])
+
+    # -- metric resolution (lane-parameterized SLI names) --
+
+    @property
+    def latency_hist(self) -> str:
+        return f"search.lane.{self.lane}.latency_ms"
+
+    def _lane_counter(self, leaf: str) -> str:
+        return f"search.lane.{self.lane}.{leaf}"
+
+    def tracked_histograms(self) -> List[str]:
+        return [self.latency_hist] if self.kind == "latency" else []
+
+    def series_metrics(self) -> List[str]:
+        """The metrics whose windowed series ride a firing alert's
+        recorder event — the forensic "what the engine saw"."""
+        if self.kind == "latency":
+            return [self.latency_hist]
+        if self.kind == "counter_ratio":
+            return list(self.bad_metrics) + list(self.total_metrics)
+        out = [self._lane_counter("requests")]
+        if self.kind in ("error_rate", "availability"):
+            out.append(self._lane_counter("errors"))
+        if self.kind in ("availability", "rejection_rate"):
+            out.append(self._lane_counter("rejected"))
+        return out
+
+    def bad_total(self, sampler: TimeSeriesSampler,
+                  window_s: float) -> tuple:
+        """(bad, total) event counts over the window."""
+        if self.kind == "latency":
+            return sampler.window_over_budget(
+                self.latency_hist, window_s, self.latency_budget_ms)
+        if self.kind == "counter_ratio":
+            bad = sum(sampler.counter_delta(m, window_s)
+                      for m in self.bad_metrics)
+            total = sum(sampler.counter_delta(m, window_s)
+                        for m in self.total_metrics)
+            return bad, total
+        req = sampler.counter_delta(self._lane_counter("requests"),
+                                    window_s)
+        err = sampler.counter_delta(self._lane_counter("errors"), window_s)
+        rej = sampler.counter_delta(self._lane_counter("rejected"),
+                                    window_s)
+        if self.kind == "error_rate":
+            return err, req + err
+        if self.kind == "availability":
+            return err + rej, req + err + rej
+        return rej, req + rej                     # rejection_rate
+
+    def burn(self, sampler: TimeSeriesSampler, window_s: float) -> dict:
+        bad, total = self.bad_total(sampler, window_s)
+        ratio = (bad / total) if total else 0.0
+        budget = 1.0 - self.target
+        return {"window_s": window_s, "bad": int(bad), "total": int(total),
+                "bad_ratio": round(ratio, 6),
+                "burn_rate": round(ratio / budget, 4) if budget else 0.0}
+
+    def describe(self) -> dict:
+        out = {"name": self.name, "kind": self.kind, "target": self.target,
+               "lane": self.lane,
+               "fast_window_s": self.fast_window_s,
+               "slow_window_s": self.slow_window_s,
+               "burn_threshold": self.burn_threshold,
+               "min_events": self.min_events}
+        if self.latency_budget_ms is not None:
+            out["latency_budget_ms"] = self.latency_budget_ms
+        if self.kind == "counter_ratio":
+            out["bad_metrics"] = self.bad_metrics
+            out["total_metrics"] = self.total_metrics
+        return out
+
+
+def default_slos(lane: str = "interactive",
+                 latency_budget_ms: float = 2000.0,
+                 fast_window_s: float = 5.0,
+                 slow_window_s: float = 30.0) -> List[SLO]:
+    """The standing objective set the benches arm: one of each kind for
+    the given lane, windows scaled to bench runs (production deployments
+    declare hours-scale windows; the math is identical)."""
+    return [
+        SLO(f"{lane}-latency-p99", "latency", target=0.99,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            lane=lane, latency_budget_ms=latency_budget_ms),
+        SLO(f"{lane}-errors", "error_rate", target=0.999,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            lane=lane),
+        SLO(f"{lane}-availability", "availability", target=0.999,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            lane=lane),
+        SLO(f"{lane}-rejections", "rejection_rate", target=0.95,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            lane=lane),
+    ]
+
+
+class SLOEngine:
+    """Holds armed objectives, evaluates them per sampler tick, owns the
+    alert state machine. Disarmed (the default) it is inert: zero armed
+    SLOs means `evaluate()` returns immediately and no listener rides
+    the sampler — clean-run responses and timings stay untouched."""
+
+    def __init__(self, sampler: Optional[TimeSeriesSampler] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 recorder=None):
+        self.sampler = sampler if sampler is not None else SAMPLER
+        self.registry = registry if registry is not None else METRICS
+        self._recorder = recorder         # None -> module RECORDER, lazily
+        self._lock = threading.Lock()
+        self._slos: "OrderedDict[str, SLO]" = OrderedDict()
+        self._status: Dict[str, dict] = {}
+        self._alerts: deque = deque(maxlen=64)
+        self.alerts_fired = 0
+        self.refire_cooldown_s = 30.0
+
+    # ---------------- arm / disarm ----------------
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._slos)
+
+    def arm(self, slos: Sequence[SLO], start_sampler: bool = False) -> None:
+        """Register objectives and hook evaluation onto the sampler's
+        tick. Idempotent per SLO name (latest wins)."""
+        with self._lock:
+            for s in slos:
+                self._slos[s.name] = s
+                self._status.setdefault(s.name, {
+                    "state": "ok", "since_mono": time.monotonic()})
+                for h in s.tracked_histograms():
+                    self.sampler.track_histogram(h)
+        self.sampler.add_listener(self._on_sample)
+        if start_sampler:
+            self.sampler.ensure_started()
+
+    def disarm(self) -> None:
+        self.sampler.remove_listener(self._on_sample)
+        with self._lock:
+            self._slos.clear()
+            self._status.clear()
+            self._alerts.clear()
+
+    def _on_sample(self, _sampler) -> None:
+        self.evaluate()
+
+    # ---------------- evaluation ----------------
+
+    def evaluate(self) -> Dict[str, dict]:
+        """One pass over every armed SLO; returns the status map. Called
+        per sampler tick (listener) or directly by tests/surfaces."""
+        with self._lock:
+            slos = list(self._slos.values())
+        out: Dict[str, dict] = {}
+        for s in slos:
+            fast = s.burn(self.sampler, s.fast_window_s)
+            slow = s.burn(self.sampler, s.slow_window_s)
+            firing = (fast["burn_rate"] > s.burn_threshold
+                      and slow["burn_rate"] > s.burn_threshold
+                      and fast["total"] + slow["total"] >= s.min_events)
+            g = self.registry.gauge
+            g(f"slo.{s.name}.burn_fast").set(fast["burn_rate"])
+            g(f"slo.{s.name}.burn_slow").set(slow["burn_rate"])
+            g(f"slo.{s.name}.firing").set(1.0 if firing else 0.0)
+            now = time.monotonic()
+            with self._lock:
+                st = self._status.setdefault(
+                    s.name, {"state": "ok", "since_mono": now})
+                was = st["state"]
+                st["fast"] = fast
+                st["slow"] = slow
+                st["evaluated_mono"] = round(now, 6)
+                if firing and was != "firing":
+                    st["state"] = "firing"
+                    st["since_mono"] = now
+                    # the cooldown rate-limits alerts to one per window;
+                    # the stamp moves ONLY when an alert actually fires —
+                    # stamping suppressed edges would let a fast flapper
+                    # silence itself forever
+                    refire_ok = (now - st.get("last_fired_mono", -1e18)
+                                 >= self.refire_cooldown_s)
+                    if refire_ok:
+                        st["last_fired_mono"] = now
+                        self.alerts_fired += 1
+                        self.registry.counter("slo.alerts_total").inc()
+                        self._fire_locked(s, fast, slow, now)
+                elif not firing and was == "firing":
+                    st["state"] = "ok"
+                    st["since_mono"] = now
+                out[s.name] = dict(st)
+        return out
+
+    # ---------------- firing ----------------
+
+    def _fire_locked(self, s: SLO, fast: dict, slow: dict,
+                     now: float) -> None:
+        """Rising-edge actions (called under self._lock): alert-log
+        entry, `slo.burn` recorder event carrying the offending window's
+        series, and a frozen dump bundle."""
+        series = {m: self._bounded_series(m, s.slow_window_s)
+                  for m in s.series_metrics()}
+        alert = {"slo": s.name, "slo_kind": s.kind, "lane": s.lane,
+                 "at_mono": round(now, 6),
+                 "fast": fast, "slow": slow,
+                 "burn_threshold": s.burn_threshold}
+        self._alerts.append(dict(alert, series_metrics=sorted(series)))
+        rec = self._rec()
+        if rec is not None and rec.enabled:
+            tl = rec.start("slo", slo=s.name, slo_kind=s.kind,
+                           lane=s.lane)
+            if tl:
+                rec.record(tl, "slo.burn", **dict(alert, series=series))
+                rec.trigger(
+                    "slo_burn", [tl],
+                    note=f"SLO [{s.name}] burn fast="
+                         f"{fast['burn_rate']}x slow={slow['burn_rate']}x "
+                         f"(threshold {s.burn_threshold}x)")
+
+    def _bounded_series(self, metric: str, window_s: float) -> dict:
+        h = self.sampler.history(metric, window_s)
+        pts = h["points"]
+        if len(pts) > _ALERT_SERIES_POINTS:
+            h["points"] = pts[-_ALERT_SERIES_POINTS:]
+            h["truncated"] = True
+        return h
+
+    def _rec(self):
+        if self._recorder is not None:
+            return self._recorder
+        from .flight_recorder import RECORDER
+        return RECORDER
+
+    # ---------------- surfaces ----------------
+
+    def status(self) -> dict:
+        """`GET /_slo` payload: definitions + live burn/state + the
+        recent alert log."""
+        with self._lock:
+            slos = [s.describe() for s in self._slos.values()]
+            status = {n: dict(st) for n, st in self._status.items()}
+            alerts = list(self._alerts)
+        return {"armed": bool(slos), "slos": slos, "status": status,
+                "alerts": alerts, "alerts_fired": self.alerts_fired}
+
+    def stats(self) -> dict:
+        """`_nodes/stats` "slo" block (compact: no alert log)."""
+        with self._lock:
+            states = {n: st.get("state", "ok")
+                      for n, st in self._status.items()}
+            burns = {n: {"fast": (st.get("fast") or {}).get("burn_rate"),
+                         "slow": (st.get("slow") or {}).get("burn_rate")}
+                     for n, st in self._status.items()}
+        return {"armed": self.armed, "objectives": len(states),
+                "alerts_fired": self.alerts_fired,
+                "states": states, "burn_rates": burns}
+
+
+# process-default engine over the process-default sampler
+SLO_ENGINE = SLOEngine()

@@ -879,14 +879,10 @@ class RestClient:
                             "available_in_bytes": du.free}}
         except OSError:
             fs = {}
-        docs = 0
-        store = 0
-        seg_count = 0
-        for svc in n.indices.values():
-            st = svc.stats()
-            docs += st["docs"]["count"]
-            store += st["store"]["size_in_bytes"]
-            seg_count += st["segments"]["count"]
+        summ = self.indices_summary()
+        docs = summ["docs"]
+        store = summ["store_in_bytes"]
+        seg_count = summ["segments"]
         oc = n.op_counters
         node_block = {
             "name": n.node_name,
@@ -954,6 +950,12 @@ class RestClient:
             # Process-global like /_metrics — co-resident test nodes
             # share the rollup
             "resilience": self._resilience_block(),
+            # time-series retention ring (obs/timeseries.py): sampler
+            # state behind `_nodes/stats/history`
+            "timeseries": n.timeseries.stats(),
+            # SLO burn-rate engine (obs/slo.py): armed objectives, live
+            # burn rates and alert counts (full view at GET /_slo)
+            "slo": n.slo.stats(),
         }
         if n.mesh_service is not None:
             node_block["mesh"] = n.mesh_service.stats()
@@ -1005,6 +1007,59 @@ class RestClient:
         from ..utils.metrics import METRICS
         return {"stages": METRICS.stage_percentiles(),
                 "jit": _compiler.jit_attribution()}
+
+    # ------------- fleet observability (docs/OBSERVABILITY.md "fleet") ----
+
+    def indices_summary(self) -> dict:
+        """Node-local index totals — one scrape leg of `_cluster/stats`
+        (and the `_nodes/stats` indices rollup above)."""
+        docs = store = seg_count = 0
+        for svc in self.node.indices.values():
+            st = svc.stats()
+            docs += st["docs"]["count"]
+            store += st["store"]["size_in_bytes"]
+            seg_count += st["segments"]["count"]
+        return {"docs": docs, "store_in_bytes": store,
+                "segments": seg_count}
+
+    def cluster_stats(self) -> dict:
+        """`GET /_cluster/stats` on an UNclustered node: the same shape
+        the distnode federation serves (cluster/distnode.py
+        `cluster_stats`), degenerated to a fleet of one — so dashboards
+        and tests read one schema everywhere."""
+        from ..utils.metrics import METRICS, sketch_snapshot
+        wire = METRICS.to_wire()
+        name = self.node.node_name
+        indices = self.indices_summary()
+        return {
+            "cluster_name": self.node.metadata.cluster_name,
+            "coordinator": name,
+            "_nodes": {"total": 1, "successful": 1, "failed": 0},
+            "nodes": {name: {"status": "ok",
+                             "gauges": wire["gauges"],
+                             "counters": wire["counters"],
+                             "indices": indices}},
+            "indices": indices,
+            "counters": wire["counters"],
+            "percentiles": {k: sketch_snapshot(w)
+                            for k, w in wire["histograms"].items()},
+            "histograms": wire["histograms"],
+        }
+
+    def metrics_history(self, metric: str, window_s: float = 60.0) -> dict:
+        """`GET /_nodes/stats/history` on an unclustered node: the local
+        sampler's window for one metric, in the federated response
+        shape (obs/timeseries.py)."""
+        name = self.node.node_name
+        return {"metric": metric, "window_s": float(window_s),
+                "_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "nodes": {name: self.node.timeseries.history(
+                    metric, window_s)}}
+
+    def slo_status(self) -> dict:
+        """`GET /_slo`: armed objectives, live burn rates, alert log
+        (obs/slo.py)."""
+        return self.node.slo.status()
 
     def get_traces(self, limit: int = 20) -> dict:
         """Recent completed request traces (reference telemetry in-memory

@@ -47,7 +47,7 @@ def _truthy(v) -> bool:
 
 # routes any authenticated principal may hit (cluster "monitor" class)
 _MONITOR_HEADS = {"", "_cluster", "_nodes", "_cat", "_stats", "_tasks",
-                  "_metrics", "_flight_recorder"}
+                  "_metrics", "_flight_recorder", "_slo"}
 # cluster-admin routes
 _ADMIN_HEADS = {"_index_template", "_template", "_remotestore", "_snapshot",
                 "_ingest", "_scripts", "_search_pipeline", "_data_stream",
@@ -394,11 +394,22 @@ class _Handler(BaseHTTPRequestHandler):
                          "tagline": "TPU-native search"}
 
         head = parts[0]
+        # the cluster transport owner, when this server fronts a
+        # DistClusterNode: observability reads fan out fleet-wide
+        owner = getattr(self.server, "owner", None)
+        dist = owner.dist if owner is not None else None
         # ---- cluster-level ----
         if head == "_cluster":
             if len(parts) >= 2 and parts[1] == "health":
                 return 200, c.cluster.health(parts[2] if len(parts) > 2
                                              else None)
+            if len(parts) >= 2 and parts[1] == "stats":
+                # fleet rollup (docs/OBSERVABILITY.md "fleet"): counters
+                # summed, gauges per-node, DDSketch sketches MERGED so
+                # the percentiles are fleet-true; unclustered nodes
+                # serve the same shape as a fleet of one
+                return 200, (dist.cluster_stats() if dist is not None
+                             else c.cluster_stats())
             if len(parts) >= 2 and parts[1] == "settings":
                 if method == "PUT":
                     return 200, c.cluster.put_settings(self._json_body())
@@ -406,17 +417,56 @@ class _Handler(BaseHTTPRequestHandler):
             raise ApiError(400, "illegal_argument_exception",
                            f"unsupported _cluster route {parts}")
         if head == "_nodes":
-            if len(parts) > 1 and parts[1] == "hot_threads":
+            # /_nodes[/{id}]/hot_threads | /_nodes/stats[/history] |
+            # /_nodes[/stats]
+            sub = parts[1] if len(parts) > 1 else None
+            node_id = None
+            if sub is not None and sub not in ("stats", "hot_threads"):
+                node_id = sub
+                sub = parts[2] if len(parts) > 2 else None
+            if sub == "hot_threads":
                 # py-side stack sampler over the runtime's worker threads
                 # (obs/hot_threads.py); plain text like the reference,
-                # ?format=json for the structured form
-                return 200, c.hot_threads(
+                # ?format=json for the structured form. Clustered: fans
+                # out so every member samples ITS OWN process, with
+                # per-node sections + unreachable-node degradation
+                ht_kw = dict(
                     snapshots=int(params.get("snapshots", 3)),
                     interval_ms=float(params.get("interval_ms", 20)),
                     ignore_idle=_truthy(params.get("ignore_idle",
                                                    "true")),
                     as_json=params.get("format") == "json")
+                if dist is not None:
+                    return 200, dist.hot_threads_federated(
+                        node_id=node_id, **ht_kw)
+                if node_id not in (None, "_all", "_local",
+                                   c.node.node_name):
+                    raise ApiError(404, "resource_not_found_exception",
+                                   f"no such node [{node_id}]")
+                return 200, c.hot_threads(**ht_kw)
+            if sub == "stats" and "history" in parts:
+                # time-series retention (obs/timeseries.py): windowed
+                # per-node series with delta/rate derivation
+                metric = params.get("metric")
+                if not metric:
+                    raise ApiError(400, "illegal_argument_exception",
+                                   "history requires ?metric=<name>")
+                window_s = float(params.get("window", 60.0))
+                if dist is not None:
+                    return 200, dist.history_federated(
+                        metric, window_s, node_id=node_id)
+                return 200, c.metrics_history(metric, window_s)
+            if dist is not None:
+                return 200, dist.nodes_stats_federated(node_id=node_id)
+            if node_id not in (None, "_all", "_local",
+                               c.node.node_name):
+                raise ApiError(404, "resource_not_found_exception",
+                               f"no such node [{node_id}]")
             return 200, c.nodes_stats()
+        if head == "_slo":
+            # SLO burn-rate engine (obs/slo.py): armed objectives, live
+            # multi-window burn rates, the recent alert log
+            return 200, c.slo_status()
         if head == "_flight_recorder":
             # black-box event journal (obs/flight_recorder.py): ring
             # stats + recent anomaly dumps; POST …/dump freezes a manual
@@ -436,7 +486,10 @@ class _Handler(BaseHTTPRequestHandler):
             # summaries — the scrape surface of the same data
             # `_nodes/stats` serves as JSON
             from ..utils.metrics import METRICS, render_prometheus
-            return 200, render_prometheus(METRICS)
+            # node label: federated scrapes of several processes must
+            # not collapse identically-named series into one stream
+            return 200, render_prometheus(METRICS,
+                                          node=c.node.node_name)
         if head == "_cat":
             kind = parts[1] if len(parts) > 1 else "indices"
             fn = getattr(c.cat, kind, None)

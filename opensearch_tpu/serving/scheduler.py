@@ -385,6 +385,9 @@ class ServingScheduler:
             elif self._pending >= self.config.queue_cap:
                 self.rejected += 1
                 METRICS.counter("serving.rejected").inc()
+                # per-lane mirror: the SLO engine's rejection-rate
+                # objectives window rejections BY lane (obs/slo.py)
+                METRICS.counter(f"serving.lane.{lane}.rejected").inc()
                 self.node.search_backpressure.note_queue_rejection()
                 rejected_depth = self._pending
             else:

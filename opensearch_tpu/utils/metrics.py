@@ -22,6 +22,18 @@ snapshot (per-stage p50/p95/p99 + jit compile-vs-execute attribution) and
 Disabled mode (`METRICS.enabled = False`) turns `timer()` and histogram
 `record()` into near-no-ops — the fastpath overhead guard in
 tests/test_telemetry.py pins that cost.
+
+Fleet federation (docs/OBSERVABILITY.md "fleet"): sketches are mergeable
+by bin-wise addition — `LatencyHistogram.merge_wire` / `merge_sketches`
+let a coordinator compute TRUE fleet-wide percentiles from per-node
+sketches instead of averaging per-node percentiles (which is wrong for
+any skewed distribution). `MetricsRegistry.to_wire()` is the JSON-safe
+scrape payload a node answers on `/_internal/stats`: counters and gauges
+as plain values, histograms in wire form (bins keyed by stringified bin
+index). Merging is exact: a sketch merged from N nodes holds the same
+bin multiset as one sketch fed the union stream, so nearest-rank
+percentile queries agree bit-for-bit (tests/test_observatory.py pins
+commutativity, associativity, and union parity).
 """
 
 from __future__ import annotations
@@ -32,7 +44,8 @@ import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
 
 __all__ = ["Counter", "Gauge", "LatencyHistogram", "MetricsRegistry",
-           "CounterGroup", "render_prometheus", "METRICS"]
+           "CounterGroup", "render_prometheus", "METRICS",
+           "merge_sketches", "sketch_percentile"]
 
 
 _SKETCH_FNS = None
@@ -120,19 +133,10 @@ class LatencyHistogram:
             self.sum_ms += float(ms)
 
     def percentile(self, p: float) -> Optional[float]:
-        _, dd_value = _sketch_fns()
         with self._lock:
             total = self.count
-            items = sorted(self._bins.items())
-        if total == 0:
-            return None
-        rank = max(1, -(-int(p * total) // 100))     # ceil(p/100 * total)
-        cum = 0
-        for b, c in items:
-            cum += c
-            if cum >= rank:
-                return float(dd_value(b))
-        return float(dd_value(items[-1][0]))
+            bins = dict(self._bins)
+        return sketch_percentile(bins, total, p)
 
     def snapshot(self, percentiles: Sequence[float] = (50, 95, 99)) -> dict:
         out = {"count": self.count, "sum_ms": round(self.sum_ms, 3)}
@@ -141,6 +145,86 @@ class LatencyHistogram:
             out[f"p{int(p) if float(p).is_integer() else p}_ms"] = (
                 round(v, 4) if v is not None else None)
         return out
+
+    # -- federation: sketches cross the wire and merge bin-wise --
+
+    def to_wire(self) -> dict:
+        """JSON-safe serialized form (bin keys stringified). The bins are
+        global constants of the DDSketch mapping, so wire forms from
+        different nodes merge without any re-binning."""
+        with self._lock:
+            return {"bins": {str(b): c for b, c in self._bins.items()},
+                    "count": self.count,
+                    "sum_ms": round(self.sum_ms, 6)}
+
+    def merge_wire(self, wire: dict) -> None:
+        """Fold another sketch's wire form into this one (bin-wise add).
+        Exact: merging preserves the bin multiset, so percentile queries
+        on the merged sketch equal those on a sketch fed the union
+        stream."""
+        bins = wire.get("bins") or {}
+        with self._lock:
+            for b, c in bins.items():
+                bi = int(b)
+                self._bins[bi] = self._bins.get(bi, 0) + int(c)
+            self.count += int(wire.get("count", 0))
+            self.sum_ms += float(wire.get("sum_ms", 0.0))
+
+
+def sketch_percentile(bins: Dict[int, int], total: int,
+                      p: float) -> Optional[float]:
+    """Nearest-rank percentile over sparse DDSketch bins (rank
+    ceil(p/100 * n) over the sorted bins, returning the bin's
+    representative value) — the single definition the instance
+    percentile, windowed time-series deltas (obs/timeseries.py), and
+    fleet-merged sketches (cluster federation) all share."""
+    if total <= 0:
+        return None
+    _, dd_value = _sketch_fns()
+    items = sorted(bins.items())
+    if not items:
+        return None
+    rank = max(1, -(-int(p * total) // 100))     # ceil(p/100 * total)
+    cum = 0
+    for b, c in items:
+        cum += c
+        if cum >= rank:
+            return float(dd_value(b))
+    return float(dd_value(items[-1][0]))
+
+
+def merge_sketches(wires: Sequence[dict]) -> dict:
+    """Merge several sketch wire forms into one (bin-wise addition).
+    Commutative and associative — the order nodes answer a fleet scrape
+    in can never change the merged percentiles."""
+    bins: Dict[int, int] = {}
+    count = 0
+    sum_ms = 0.0
+    for w in wires:
+        if not isinstance(w, dict):
+            continue
+        for b, c in (w.get("bins") or {}).items():
+            bi = int(b)
+            bins[bi] = bins.get(bi, 0) + int(c)
+        count += int(w.get("count", 0))
+        sum_ms += float(w.get("sum_ms", 0.0))
+    return {"bins": {str(b): c for b, c in sorted(bins.items())},
+            "count": count, "sum_ms": round(sum_ms, 6)}
+
+
+def sketch_snapshot(wire: dict,
+                    percentiles: Sequence[float] = (50, 95, 99)) -> dict:
+    """The `LatencyHistogram.snapshot` shape computed from a wire form —
+    what `_cluster/stats` serves for fleet-merged sketches."""
+    bins = {int(b): int(c) for b, c in (wire.get("bins") or {}).items()}
+    total = int(wire.get("count", 0))
+    out = {"count": total, "sum_ms": round(float(wire.get("sum_ms", 0.0)),
+                                           3)}
+    for p in percentiles:
+        v = sketch_percentile(bins, total, p)
+        out[f"p{int(p) if float(p).is_integer() else p}_ms"] = (
+            round(v, 4) if v is not None else None)
+    return out
 
 
 class MetricsRegistry:
@@ -221,6 +305,20 @@ class MetricsRegistry:
             "histograms": {n: h.snapshot() for n, h in hists},
         }
 
+    def to_wire(self) -> dict:
+        """JSON-safe federation payload: counters/gauges as plain values,
+        histograms in mergeable wire form — what a node answers on a
+        `/_internal/stats` fleet scrape (cluster/distnode.py)."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = sorted(self._hists.items())
+        return {
+            "counters": {n: c.value for n, c in counters},
+            "gauges": {n: g.value for n, g in gauges},
+            "histograms": {n: h.to_wire() for n, h in hists},
+        }
+
     def reset(self) -> None:
         """Drop every instrument — isolation hook for bench runs and
         tests that diff a cold registry. Instruments obtained before a
@@ -295,32 +393,62 @@ class CounterGroup:
 
 
 def _prom_name(name: str) -> str:
+    """Stable metric-name sanitization: every character outside
+    Prometheus's [a-zA-Z0-9_] maps to ONE underscore (no run collapsing
+    — collapsing would let `a.b` and `a..b` collide), and ASCII-only
+    (any non-ASCII alphanumeric maps to `_` too, so the mapping is the
+    same on every locale). The `ostpu_` prefix keeps the result from
+    starting with a digit."""
     return "ostpu_" + "".join(
-        c if (c.isalnum() or c == "_") else "_" for c in name)
+        c if (c.isascii() and (c.isalnum() or c == "_")) else "_"
+        for c in name)
 
 
-def render_prometheus(registry: MetricsRegistry) -> str:
+def _prom_label_value(v: str) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double quote, and newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def render_prometheus(registry: MetricsRegistry,
+                      node: Optional[str] = None) -> str:
     """Prometheus text exposition format 0.0.4. Counters and gauges render
     directly; latency histograms render as summaries (quantile series +
-    _count/_sum) since DDSketch quantiles are what the registry serves."""
+    _count/_sum) since DDSketch quantiles are what the registry serves.
+
+    Every sample line carries a `# HELP` + `# TYPE` header pair, and when
+    `node` is given every sample gets a `node` label — without it, a
+    Prometheus federating several opensearch-tpu processes would collapse
+    their identically-named series into one incoherent stream."""
     snap = registry.snapshot()
+    nl = f'node="{_prom_label_value(node)}"' if node is not None else ""
+
+    def labeled(pn: str, extra: str = "") -> str:
+        labels = ",".join(x for x in (nl, extra) if x)
+        return f"{pn}{{{labels}}}" if labels else pn
+
     lines: List[str] = []
     for n, v in snap["counters"].items():
         pn = _prom_name(n)
+        lines.append(f"# HELP {pn} registry counter {n}")
         lines.append(f"# TYPE {pn} counter")
-        lines.append(f"{pn} {v}")
+        lines.append(f"{labeled(pn)} {v}")
     for n, v in snap["gauges"].items():
         pn = _prom_name(n)
+        lines.append(f"# HELP {pn} registry gauge {n}")
         lines.append(f"# TYPE {pn} gauge")
-        lines.append(f"{pn} {v}")
+        lines.append(f"{labeled(pn)} {v}")
     for n, h in snap["histograms"].items():
         pn = _prom_name(n) + "_ms"
+        lines.append(f"# HELP {pn} DDSketch latency summary {n} (ms)")
         lines.append(f"# TYPE {pn} summary")
         for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):
             if h.get(key) is not None:
-                lines.append(f'{pn}{{quantile="{q}"}} {h[key]}')
-        lines.append(f"{pn}_sum {h['sum_ms']}")
-        lines.append(f"{pn}_count {h['count']}")
+                qlab = 'quantile="%s"' % q
+                lines.append(f"{labeled(pn, qlab)} {h[key]}")
+        lines.append(f"{labeled(pn + '_sum')} {h['sum_ms']}")
+        lines.append(f"{labeled(pn + '_count')} {h['count']}")
     return "\n".join(lines) + "\n"
 
 

@@ -36,7 +36,12 @@ alternating-reps/noise-floor protocol with byte-identical responses
 (`extra.concurrency.cost_overhead_32t`), and
 the run stamps `extra.hbm` (peak resident bytes by tenant kind) +
 `extra.bytes_per_query` (predicted/actual DDSketch percentiles) — the
-committed byte-domain baseline for ROADMAP item 1.
+committed byte-domain baseline for ROADMAP item 1. A third pair does
+the same for the time-series sampler + armed SLO engine
+(obs/timeseries.py + obs/slo.py, 50 ms ticks — 20x the production
+rate): byte-identical responses, sampler-on qps >= 0.98x off
+(`extra.concurrency.sampler_overhead_32t`), and zero SLO false alarms
+on the clean run.
 
 Results land in BENCH_out.json under `extra.concurrency` (merged into an
 existing bench emission when present). Run:
@@ -131,7 +136,7 @@ def strip_took(resp: dict) -> str:
 
 
 def run_cell(client, bodies, nthreads: int, mode, tag: str,
-             recorder=None, cost=None):
+             recorder=None, cost=None, sampler=None):
     """Closed loop: `nthreads` client threads drain the shared query list;
     every thread records its request wall into a DDSketch histogram.
     `mode` is None for scheduler-off, or a pipeline depth (int) for a
@@ -139,8 +144,13 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     recorder for the cell (True/False; None = leave the process default,
     which is ON) — the recorder-overhead gate compares a pinned-on vs
     pinned-off pair at 32 threads. `cost` pins per-query cost accounting
-    (obs/query_cost.py) the same way for the ledger+cost overhead gate."""
+    (obs/query_cost.py) the same way for the ledger+cost overhead gate.
+    `sampler` pins the time-series sampler + armed SLO engine
+    (obs/timeseries.py + obs/slo.py, running at a 50 ms tick — 20x the
+    production default rate) for the sampler-overhead gate."""
     from opensearch_tpu.obs.flight_recorder import RECORDER
+    from opensearch_tpu.obs.slo import SLO_ENGINE, default_slos
+    from opensearch_tpu.obs.timeseries import SAMPLER
     from opensearch_tpu.serving import SchedulerConfig, ServingScheduler
     from opensearch_tpu.utils.metrics import METRICS, MetricsRegistry
 
@@ -151,6 +161,14 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     cost_before = os.environ.get("OPENSEARCH_TPU_COST")
     if cost is not None:
         os.environ["OPENSEARCH_TPU_COST"] = "1" if cost else "0"
+    sampler_interval_before = SAMPLER.interval_s
+    if sampler:
+        SAMPLER.stop()
+        SAMPLER.reset()
+        SAMPLER.interval_s = 0.05
+        SLO_ENGINE.arm(default_slos(fast_window_s=2.0,
+                                    slow_window_s=10.0))
+        SAMPLER.ensure_started()
     RECORDER.reset()       # bound ring memory + per-cell trigger state
     old_serving = node.serving
     sched_on = mode is not None
@@ -243,6 +261,15 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
             os.environ.pop("OPENSEARCH_TPU_COST", None)
         else:
             os.environ["OPENSEARCH_TPU_COST"] = cost_before
+    if sampler is not None:
+        cell["sampler"] = "on" if sampler else "off"
+    if sampler:
+        cell["sampler_ticks"] = SAMPLER.stats()["ticks"]
+        cell["slo_alerts"] = SLO_ENGINE.alerts_fired
+        SAMPLER.stop()
+        SLO_ENGINE.disarm()
+        SAMPLER.interval_s = sampler_interval_before
+        SAMPLER.reset()
     if errors:
         cell["first_errors"] = errors[:3]
     return cell, results
@@ -364,6 +391,35 @@ def main():
     finally:
         client.node.mesh_service = mesh_saved
 
+    # sampler-overhead pair (ISSUE 10): the (32-thread, deepest-depth)
+    # cell with the time-series sampler + armed SLO engine pinned ON
+    # (50 ms ticks — 20x the production default rate) vs OFF, under the
+    # same alternating-reps/noise-floor protocol as the recorder and
+    # cost gates: byte-identical responses, paired best-of-reps qps
+    # ratio >= 0.98x (noise-floor relaxed). Continuous retention and
+    # burn-rate evaluation must ride along for ~free.
+    samp_pair = {}
+    samp_reps = {"sampler_off": [], "sampler_on": []}
+    run_cell(client, bodies, rthreads, rdepth,
+             f"{rthreads}-d{rdepth}-samp-warmup")
+    for rep, (slabel, sflag) in enumerate(
+            (("sampler_off", False), ("sampler_on", True),
+             ("sampler_on", True), ("sampler_off", False))):
+        tag = f"{rthreads}-d{rdepth}-{slabel}-r{rep}"
+        cell, results = run_cell(client, bodies, rthreads, rdepth, tag,
+                                 sampler=sflag)
+        errored += cell["errors"]
+        digests = [strip_took(r) if r is not None else None
+                   for r in results]
+        bad = sum(1 for a, b in zip(digests, canonical) if a != b)
+        cell["identical_responses"] = bad == 0
+        mismatched += bad
+        cells.append(cell)
+        samp_reps[slabel].append(cell)
+        print(json.dumps(cell), flush=True)
+    samp_pair = {lab: max(reps, key=lambda c: c["qps"])
+                 for lab, reps in samp_reps.items()}
+
     summary = {"ndocs": ndocs, "nq": nq,
                "devices": len(jax.devices()),
                "mix": "60% match2 / 40% filtered bool",
@@ -396,6 +452,32 @@ def main():
             "noise_floor": round(cnoise, 4),
             "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
             "gate_threshold": round(min(0.98, 1.0 - cnoise), 4),
+        }
+    if samp_pair:
+        on_c, off_c = samp_pair["sampler_on"], samp_pair["sampler_off"]
+        snoise = max(
+            (1.0 - min(c["qps"] for c in reps)
+             / max(max(c["qps"] for c in reps), 1e-9))
+            for reps in samp_reps.values())
+        summary["sampler_overhead_32t"] = {
+            "threads": rthreads, "mode": f"d{rdepth}",
+            "protocol": "warmup + alternating off/on/on/off reps; "
+                        "paired best-of-reps ratio, noise-floor "
+                        "threshold; sampler at 50ms ticks + default "
+                        "SLOs armed",
+            "sampler_on_qps": on_c["qps"],
+            "sampler_off_qps": off_c["qps"],
+            "sampler_on_reps": [c["qps"] for c in
+                                samp_reps["sampler_on"]],
+            "sampler_off_reps": [c["qps"] for c in
+                                 samp_reps["sampler_off"]],
+            "sampler_ticks": max(c.get("sampler_ticks", 0)
+                                 for c in samp_reps["sampler_on"]),
+            "slo_false_alarms": max(c.get("slo_alerts", 0)
+                                    for c in samp_reps["sampler_on"]),
+            "noise_floor": round(snoise, 4),
+            "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+            "gate_threshold": round(min(0.98, 1.0 - snoise), 4),
         }
     if rec_pair:
         on_c, off_c = rec_pair["rec_on"], rec_pair["rec_off"]
@@ -501,6 +583,17 @@ def main():
                 f"{cp['qps_ratio']}x cost-off "
                 f"(< {cp['gate_threshold']}x; noise floor "
                 f"{cp['noise_floor']}) at {cp['threads']} threads")
+        sp = summary.get("sampler_overhead_32t")
+        if sp and sp["qps_ratio"] < sp["gate_threshold"]:
+            raise SystemExit(
+                f"sampler overhead gate failed: sampler-on qps is "
+                f"{sp['qps_ratio']}x sampler-off "
+                f"(< {sp['gate_threshold']}x; noise floor "
+                f"{sp['noise_floor']}) at {sp['threads']} threads")
+        if sp and sp["slo_false_alarms"]:
+            raise SystemExit(
+                f"SLO engine false-fired {sp['slo_false_alarms']} "
+                f"alert(s) on a clean concurrency run")
     print("OK", flush=True)
 
 

@@ -16,10 +16,23 @@ chaos harness (`cluster/faults.py`):
                    timeouts on a primaries-only index: every page must
                    come back `timed_out` WITHIN budget
 
+The run is observed, not just survived (ISSUE 10): every scenario runs
+with the time-series sampler ticking and the SLO burn-rate engine ARMED
+(obs/slo.py — transport-health and deadline-health counter-ratio
+objectives plus an interactive-lane latency objective, short fast/slow
+windows scaled to bench wall time). The gate now demands DETECTION:
+kill_node and flaky must fire a burn alert within the fast window (and
+freeze an `slo_burn` flight-recorder dump bundling the offending
+series), the deadline scenario must fire deadline-health, and baseline
+must fire NOTHING. A fleet timeline (per-metric series for the whole
+run) and the `_cluster/stats` fleet rollup land in BENCH_out.json under
+`extra.faults`.
+
 Reports per scenario: wall, qps, p50/p95 latency, pages with failed
-shards / timed_out, byte-identity vs baseline, and the retry/failover/
-deadline counter deltas. Exit code 1 if a recovered scenario diverges
-from baseline or the deadline scenario stalls.
+shards / timed_out, byte-identity vs baseline, the retry/failover/
+deadline counter deltas, and the scenario's SLO verdict. Exit code 1 if
+a recovered scenario diverges from baseline, the deadline scenario
+stalls, or the burn-rate engine misses (or false-fires) a detection.
 
 Run: `python scripts/measure_faults.py [nqueries] [--json out.json]`
 """
@@ -37,6 +50,9 @@ import numpy as np
 
 from opensearch_tpu.cluster import faults
 from opensearch_tpu.cluster.distnode import DistClusterNode, RetryPolicy
+from opensearch_tpu.obs.flight_recorder import RECORDER
+from opensearch_tpu.obs.slo import SLO, SLOEngine
+from opensearch_tpu.obs.timeseries import SAMPLER
 from opensearch_tpu.utils.metrics import METRICS
 
 WORDS = ["alpha", "beta", "gamma", "delta", "eps", "zeta", "kappa",
@@ -46,6 +62,36 @@ VICTIM = "fb"
 
 _COUNTERS = ("dist.rpc.retry", "dist.rpc.failover",
              "dist.deadline.exhausted", "dist.rpc.failed")
+
+# SLO windows scaled to bench wall time (a 64-query scenario runs a few
+# seconds; production objectives use the same math over hours)
+FAST_W = 3.0
+SLOW_W = 15.0
+_REQS = "search.lane.interactive.requests"
+
+# fleet-timeline metrics stamped into the BENCH json
+_TIMELINE_METRICS = ("dist.rpc.retry", "dist.rpc.failed",
+                     "dist.rpc.failover", "dist.deadline.exhausted",
+                     _REQS, "search.lane.interactive.latency_ms")
+
+
+def make_slos():
+    """The armed objective set: transport health (any RPC terminally
+    failing), deadline health (budgets exhausting), and an interactive
+    latency budget — each chaos scenario must light up exactly its own."""
+    return [
+        SLO("transport-health", "counter_ratio", target=0.95,
+            fast_window_s=FAST_W, slow_window_s=SLOW_W,
+            bad_metrics=["dist.rpc.failed"], total_metrics=[_REQS],
+            burn_threshold=2.0),
+        SLO("deadline-health", "counter_ratio", target=0.95,
+            fast_window_s=FAST_W, slow_window_s=SLOW_W,
+            bad_metrics=["dist.deadline.exhausted"],
+            total_metrics=[_REQS], burn_threshold=2.0),
+        SLO("interactive-latency", "latency", target=0.99,
+            fast_window_s=FAST_W, slow_window_s=SLOW_W,
+            latency_budget_ms=2000.0, burn_threshold=1.0),
+    ]
 
 
 def build_cluster():
@@ -94,6 +140,14 @@ def counter_snap():
 
 
 def run_scenario(name, coord, index, bodies, schedule, extra_body=None):
+    """One scenario under an ARMED burn-rate engine: a fresh SLOEngine
+    per scenario (clean alert attribution), the shared sampler ticked
+    per query (deterministic windows regardless of box speed)."""
+    SAMPLER.reset()
+    RECORDER.reset()                 # scenario-local dump attribution
+    engine = SLOEngine(sampler=SAMPLER, registry=METRICS)
+    engine.arm(make_slos())
+    SAMPLER.sample_once()            # baseline tick before any chaos
     if schedule is not None:
         faults.install(schedule)
     lats, pages, partial = [], [], []
@@ -112,11 +166,25 @@ def run_scenario(name, coord, index, bodies, schedule, extra_body=None):
                 failed_pages += 1
             if r["timed_out"]:
                 timed_out_pages += 1
+            SAMPLER.sample_once()
     finally:
         faults.uninstall()
         coord.member_fd.note_success(VICTIM)
     wall = time.monotonic() - t0
     after = counter_snap()
+    st = engine.status()
+    alerts = st["alerts"]
+    firing = sorted(n for n, s in st["status"].items()
+                    if s["state"] == "firing")
+    dump_ok = any(d["reason"] == "slo_burn" for d in RECORDER.dumps()) \
+        if alerts else False
+    engine.disarm()
+    # the scenario's fleet timeline (bounded per-metric series) — the
+    # run's story as the sampler saw it, stamped into the BENCH json
+    timeline = {}
+    for m in _TIMELINE_METRICS:
+        h = SAMPLER.history(m, window_s=1e9)
+        timeline[m] = {"kind": h["kind"], "points": h["points"][-64:]}
     lat = np.asarray(lats)
     return {"scenario": name, "queries": len(bodies),
             "wall_s": round(wall, 3),
@@ -126,7 +194,37 @@ def run_scenario(name, coord, index, bodies, schedule, extra_body=None):
             "pages_with_failed_shards": failed_pages,
             "pages_timed_out": timed_out_pages,
             "counters": {k: after[k] - before[k] for k in _COUNTERS},
+            "slo": {
+                "alerts": len(alerts),
+                "fired": sorted({a["slo"] for a in alerts}),
+                "firing_at_end": firing,
+                "time_to_detect_s": (round(alerts[0]["at_mono"] - t0, 3)
+                                     if alerts else None),
+                "dump_frozen": dump_ok,
+            },
+            "fleet_timeline": timeline,
             }, pages, partial
+
+
+def slo_gate(row, must_fire=None, must_not_fire=False):
+    """Detection verdict for one scenario: the named objective fired
+    within the fast window (+1s tick slack) with a frozen dump; or —
+    for baseline — nothing fired at all."""
+    s = row["slo"]
+    if must_not_fire:
+        ok = s["alerts"] == 0
+        s["detection"] = "clean" if ok else "FALSE_ALARM"
+        return ok
+    if must_fire is None:
+        s["detection"] = "unjudged"
+        return True
+    ok = (must_fire in s["fired"]
+          and s["time_to_detect_s"] is not None
+          and s["time_to_detect_s"] <= FAST_W + 1.0
+          and s["dump_frozen"])
+    s["detection"] = ("detected" if ok else
+                      f"MISSED[{must_fire}]")
+    return ok
 
 
 def main():
@@ -142,21 +240,29 @@ def main():
     try:
         base, base_pages, _ = run_scenario("baseline", a, "fidx",
                                            bodies, None)
+        # a clean run must stay clean on the SLO pane too: an engine
+        # that cries wolf at baseline detects nothing
+        ok = slo_gate(base, must_not_fire=True) and ok
         results.append(base)
 
-        for name, sched, allow_partial in (
+        for name, sched, allow_partial, must_fire in (
                 ("kill_node",
-                 faults.ChaosSchedule(seed=1).kill_node(VICTIM), False),
+                 faults.ChaosSchedule(seed=1).kill_node(VICTIM), False,
+                 "transport-health"),
                 # flaky drops can land on a FETCH rpc, which by design
                 # never fails over (doc coordinates are copy-local): a
                 # few honest partial pages are the contract, so the gate
                 # is "every CLEAN page is byte-identical"
                 ("flaky",
                  faults.ChaosSchedule(seed=2).add(
-                     "rpc.send", "drop", member=VICTIM, p=0.3), True),
+                     "rpc.send", "drop", member=VICTIM, p=0.3), True,
+                 "transport-health"),
+                # a slow (not dead) peer produces no failures — nothing
+                # to detect at these budgets; report-only
                 ("slow_node",
                  faults.ChaosSchedule(seed=3).pause_node(VICTIM,
-                                                         0.025), False)):
+                                                         0.025), False,
+                 None)):
             row, pages, partial = run_scenario(name, a, "fidx", bodies,
                                                sched)
             clean_ident = all(p == bp for p, bp, part
@@ -166,6 +272,7 @@ def main():
             row["recovered_clean"] = clean_ident and (
                 allow_partial or row["pages_with_failed_shards"] == 0)
             ok = ok and row["recovered_clean"]
+            ok = slo_gate(row, must_fire=must_fire) and ok
             results.append(row)
 
         dl_row, _, _ = run_scenario(
@@ -178,18 +285,49 @@ def main():
         dl_row["all_timed_out"] = (dl_row["pages_timed_out"]
                                    == dl_row["queries"])
         ok = ok and dl_row["within_budget"]
+        ok = slo_gate(dl_row, must_fire="deadline-health") and ok
         results.append(dl_row)
+
+        # fleet rollup stamp: the federation pane over the live 3-node
+        # cluster (merged-sketch percentiles; in ONE process the three
+        # members share the registry, so sums are process-wide — the
+        # per-process deployment federates disjoint registries)
+        cs = a.cluster_stats()
+        fleet = {"_nodes": cs["_nodes"],
+                 "percentiles": {k: v for k, v in
+                                 cs["percentiles"].items()
+                                 if k.startswith(("dist.", "search."))}}
     finally:
         for n in (a, b, c):
             n.stop()
 
     out = {"bench": "measure_faults", "ndocs": NDOCS,
            "nqueries": args.nqueries, "victim": VICTIM,
-           "scenarios": results, "gate_ok": ok}
-    print(json.dumps(out, indent=2))
+           "slo_windows": {"fast_s": FAST_W, "slow_s": SLOW_W},
+           "scenarios": results, "fleet": fleet, "gate_ok": ok}
+    print(json.dumps({"bench": out["bench"], "gate_ok": ok,
+                      "scenarios": [
+                          {k: v for k, v in r.items()
+                           if k != "fleet_timeline"}
+                          for r in results]}, indent=2))
     if args.json:
         with open(args.json, "w") as fh:
             json.dump(out, fh, indent=2)
+    # merge into the BENCH json emission (extra.faults), the
+    # measure_concurrency pattern: the chaos run is now part of the
+    # repo's standing bench record, fleet timeline included
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out_path = os.path.join(repo, "BENCH_out.json")
+    try:
+        with open(out_path) as fh:
+            bench_doc = json.load(fh)
+    except (OSError, ValueError):
+        bench_doc = {"metric": "bm25_rest_qps_per_chip", "value": None,
+                     "unit": "queries/sec", "vs_baseline": None,
+                     "extra": {"status": "faults_only"}}
+    bench_doc.setdefault("extra", {})["faults"] = out
+    with open(out_path, "w") as fh:
+        json.dump(bench_doc, fh, indent=2)
     return 0 if ok else 1
 
 

@@ -890,6 +890,129 @@ class TestRpcDiscipline:
         assert [f.render() for f in findings if f.rule == "OSL508"] == []
 
 
+class TestSamplerDiscipline:
+    """OSL509 — sampler/retention discipline (obs/timeseries.py): tick
+    code must be monotonic-clocked and persistent sample storage must be
+    a bounded ring; SLO definitions must declare evaluation windows."""
+
+    def test_osl509_walltime_in_sampler_loop(self):
+        src = """
+            import time
+
+            class MetricSampler:
+                def tick(self):
+                    return {"t": time.time()}
+        """
+        found = lint(src, "opensearch_tpu/obs/timeseries.py")
+        assert [f for f in found if f.detail == "sampler-walltime"]
+
+    def test_osl509_walltime_by_function_name(self):
+        # the structural net also catches free sampler functions
+        src = """
+            from time import time as now
+
+            def _sample_registry(reg):
+                return (now(), dict(reg))
+        """
+        found = lint(src, "opensearch_tpu/utils/metrics.py")
+        assert [f for f in found if f.detail == "sampler-walltime"]
+
+    def test_osl509_quiet_on_monotonic_and_anchor(self):
+        # monotonic ticks are the discipline; the ONE wall anchor at
+        # construction is the sanctioned display-conversion pattern
+        src = """
+            import time
+            from collections import deque
+
+            class MetricSampler:
+                def __init__(self):
+                    self._ring = deque(maxlen=64)
+                    self._anchor_wall = time.time()
+                    self._anchor_mono = time.monotonic()
+
+                def tick(self, reg):
+                    self._ring.append((time.monotonic(), dict(reg)))
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/timeseries.py")) \
+            == []
+
+    def test_osl509_unbounded_list_append(self):
+        # the leak wearing an observability costume: list.append forever
+        src = """
+            import time
+
+            class QueueSampler:
+                def __init__(self):
+                    self._samples = []
+
+                def _tick(self, depth):
+                    self._samples.append((time.monotonic(), depth))
+        """
+        found = lint(src, "opensearch_tpu/serving/scheduler.py")
+        assert [f for f in found
+                if f.detail == "unbounded-ring:_samples"]
+
+    def test_osl509_local_per_tick_list_quiet(self):
+        # a LOCAL list built per tick dies with the tick — not retention
+        src = """
+            import time
+            from collections import deque
+
+            class MetricSampler:
+                def __init__(self):
+                    self._ring = deque(maxlen=64)
+
+                def sample_once(self, names, reg):
+                    vals = []
+                    for n in names:
+                        vals.append(reg[n])
+                    self._ring.append((time.monotonic(), vals))
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/timeseries.py")) \
+            == []
+
+    def test_osl509_slo_without_window(self):
+        src = """
+            from opensearch_tpu.obs.slo import SLO
+
+            def objectives():
+                return [SLO("p99", "latency", target=0.99,
+                            latency_budget_ms=250.0)]
+        """
+        found = lint(src, "opensearch_tpu/obs/slo.py")
+        assert [f for f in found if f.detail == "slo-no-window"]
+
+    def test_osl509_slo_with_windows_quiet(self):
+        src = """
+            from opensearch_tpu.obs.slo import SLO
+
+            def objectives():
+                return [SLO("p99", "latency", target=0.99,
+                            fast_window_s=5.0, slow_window_s=30.0,
+                            latency_budget_ms=250.0)]
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/slo.py")) == []
+
+    def test_osl509_out_of_scope_quiet(self):
+        # the discipline patrols obs/serving/utils/cluster/search; a
+        # bench script's sampling loop is out of scope by design
+        src = """
+            import time
+
+            class LoadSampler:
+                def tick(self):
+                    return time.time()
+        """
+        assert rules_of(lint(src, "opensearch_tpu/models/similarity.py")) \
+            == []
+
+    def test_osl509_repo_clean(self):
+        # the ratchet at zero: the live sampler and every SLO
+        # construction site are disciplined
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f.render() for f in findings if f.rule == "OSL509"] == []
+
+
 # ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
