@@ -49,6 +49,7 @@ import os
 import signal
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -214,6 +215,55 @@ def build_corpus(ndocs: int, vocab: int = 200_000, avg_dl: int = 56, seed: int =
     true_dl = np.zeros(ndocs, np.int64)
     np.add.at(true_dl, doc_ids, counts)
     return starts, doc_ids, tfs, true_dl, df_per_term
+
+
+def build_corpus_topical(ndocs: int, vocab: int = 200_000, avg_dl: int = 56,
+                         ntopics: Optional[int] = None,
+                         frac_topical: float = 0.5, seed: int = 0):
+    """MS-MARCO-shaped corpus WITH topical co-occurrence: each doc draws
+    one topic; ~`frac_topical` of its tokens come from that topic's own
+    vocabulary slice (zipf within the slice), the rest from the global
+    zipf background (stopword-heavy, like `build_corpus`). Real passages
+    are topical — docs about one subject share its vocabulary — and that
+    co-occurrence is exactly the signal BP doc-id reordering
+    (index/reorder.py) clusters on; an iid-token synthetic is the ONE
+    corpus shape where reordering provably cannot help (measured: zero
+    per-term range concentration), so the reorder bench runs on this
+    shape instead (docs/BENCH_CORPUS.md §topical). Returns the same
+    (starts, doc_ids, tfs, dl, df) contract as build_corpus, plus the
+    per-doc topic array."""
+    rng = np.random.default_rng(seed)
+    if ntopics is None:
+        # ~8k docs per topic: topical term dfs land in the low thousands,
+        # the selective-but-multi-block band block-max pruning cares about
+        ntopics = max(ndocs >> 13, 8)
+    bg_vocab = vocab // 2
+    slice_sz = max((vocab - bg_vocab) // ntopics, 8)
+    dl = np.clip(rng.lognormal(np.log(avg_dl), 0.4, ndocs), 8,
+                 256).astype(np.int64)
+    total = int(dl.sum())
+    doc_of_tok = np.repeat(np.arange(ndocs, dtype=np.int64), dl)
+    topic = rng.integers(0, ntopics, ndocs).astype(np.int64)
+    is_top = rng.random(total) < frac_topical
+    bg = rng.zipf(1.15, total).astype(np.int64)
+    bg = np.where(bg > bg_vocab, rng.integers(1, bg_vocab, total), bg) - 1
+    loc = rng.zipf(1.3, total).astype(np.int64)
+    loc = np.where(loc > slice_sz, rng.integers(1, slice_sz, total),
+                   loc) - 1
+    topical = bg_vocab + topic[doc_of_tok] * slice_sz + loc
+    terms = np.where(is_top, topical, bg)
+    keys = terms * ndocs + doc_of_tok
+    uniq, counts = np.unique(keys, return_counts=True)
+    term_arr = (uniq // ndocs).astype(np.int64)
+    doc_ids = (uniq % ndocs).astype(np.int32)
+    tfs = counts.astype(np.float32)
+    nvocab = bg_vocab + ntopics * slice_sz
+    df_per_term = np.bincount(term_arr, minlength=nvocab)
+    starts = np.zeros(nvocab + 1, dtype=np.int64)
+    np.cumsum(df_per_term, out=starts[1:])
+    true_dl = np.zeros(ndocs, np.int64)
+    np.add.at(true_dl, doc_ids, counts)
+    return starts, doc_ids, tfs, true_dl, df_per_term, topic
 
 
 def build_title_corpus(ndocs: int, npairs: int = 2000, tvocab: int = 1000,
@@ -495,6 +545,191 @@ def measure_impacts(client, seg, bodies, log, time_share=90.0):
                 < d1.get("postings_resident_bytes", float("inf"))),
             "qps_no_worse": ratio >= 0.98,
             "block_skip_nonzero": d2.get("block_skip_rate", 0.0) > 0.0,
+        }
+    return out
+
+
+def pick_queries_equal_idf(df_per_term, nq: int, nterms: int = 4,
+                           seed: int = 11, band_tol: float = 0.10,
+                           pool=None):
+    """Equal-idf multi-term queries — the known block-max pruning gap
+    (ROADMAP item 2): every term of a query has df within `band_tol` of
+    the others, so no single term's upper bound dominates and per-term
+    MaxScore-style pruning has nothing skewed to grab onto. `pool`
+    overrides the candidate term ids (config6 passes the topical band);
+    default is the mid-frequency band (selective enough to have real
+    top-k competition, frequent enough to span many 128-posting
+    blocks)."""
+    rng = np.random.default_rng(seed)
+    if pool is None:
+        order = np.argsort(-df_per_term)
+        pool = order[200: 40_000]
+        pool = pool[df_per_term[pool] >= 256]   # >= 2 blocks per term
+    pool = np.asarray(pool)
+    dfs = df_per_term[pool]
+    out = np.zeros((nq, nterms), np.int64)
+    for i in range(nq):
+        anchor = int(rng.integers(0, len(pool)))
+        lo_df = dfs[anchor] * (1.0 - band_tol)
+        hi_df = dfs[anchor] * (1.0 + band_tol)
+        band = np.nonzero((dfs >= lo_df) & (dfs <= hi_df))[0]
+        if len(band) < nterms:
+            band = np.arange(max(anchor - 2 * nterms, 0),
+                             min(anchor + 2 * nterms, len(pool)))
+        out[i] = pool[rng.choice(band, size=nterms, replace=False)]
+    return out
+
+
+def measure_reorder(client, seg, df_per_term, vocab_strs, log,
+                    nq: int = 256, time_share: float = 600.0,
+                    single_pool=None, multi_pool=None, passes: int = 3):
+    """BP-reorder A/B on the SAME corpus and query sets — the BENCH
+    `extra.reorder` stamp (ISSUE 11 acceptance). Two arms (arrival order
+    vs impact-clustered BP order, index/reorder.py) x two query-shape
+    mixes (single-term — the regime codec v2 already prunes — and
+    equal-idf multi-term — the known gap). Per cell: qps + per-query
+    p50/p99 latency through the product search path, device block-skip
+    rate, escalation count, and actual bytes gathered per query."""
+    import threading
+
+    from opensearch_tpu.index import reorder as R
+    from opensearch_tpu.search import impactpath
+    from opensearch_tpu.utils.metrics import METRICS
+
+    t_start = time.time()
+    log("reorder: computing BP permutation")
+    t0 = time.time()
+    perm = R.compute_permutation(seg)
+    assert perm is not None, "segment ineligible for reorder"
+    seg_bp = R.apply_permutation(seg, perm)
+    reorder_s = time.time() - t0
+    log(f"reorder: permutation + apply in {reorder_s:.1f}s")
+
+    eng = client.node.indices["bench"].shards[0]
+
+    rng = np.random.default_rng(13)
+    if single_pool is None:
+        order = np.argsort(-df_per_term)
+        single_pool = order[200: 40_000]
+        single_pool = single_pool[df_per_term[single_pool] >= 256]
+    singles = rng.choice(np.asarray(single_pool), size=nq, replace=True)
+    multis = pick_queries_equal_idf(df_per_term, nq, pool=multi_pool)
+
+    def bodies_of(mix, tag):
+        out = []
+        for i in range(nq):
+            if mix == "single":
+                text = vocab_strs[int(singles[i])]
+            else:
+                text = " ".join(vocab_strs[int(t)] for t in multis[i])
+            out.append({"query": {"match": {"body": text}}, "size": TOPK,
+                        "_bench": f"{tag}-{i}"})
+        return out
+
+    def cost_hist():
+        h = METRICS.snapshot()["histograms"].get(
+            "cost.bytes_per_query") or {}
+        return h.get("count", 0), h.get("sum_ms", 0.0)
+
+    # closed-loop concurrency scaled to the host: 32 client threads on a
+    # 2-core container measures GIL/scheduler queueing (p99 blows up on
+    # BOTH arms), not engine throughput; 4x cores keeps the device
+    # saturated without oversubscription pathology
+    nthreads_mix = min(32, 4 * (os.cpu_count() or 8))
+
+    def closed_loop(bodies, nthreads=None):
+        nthreads = nthreads_mix if nthreads is None else nthreads
+        queue = list(range(len(bodies)))
+        lock = threading.Lock()
+        errs = []
+        lats = []
+
+        def worker():
+            while True:
+                with lock:
+                    if not queue:
+                        return
+                    i = queue.pop()
+                t1 = time.perf_counter()
+                try:
+                    client.search("bench", bodies[i])
+                except Exception as e:          # noqa: BLE001
+                    errs.append(str(e))
+                    return
+                dt = (time.perf_counter() - t1) * 1e3
+                with lock:
+                    lats.append(dt)
+        t0 = time.time()
+        ts = [threading.Thread(target=worker) for _ in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs, errs[0]
+        wall = time.time() - t0
+        return len(bodies) / wall, lats
+
+    out = {"reorder_wall_s": round(reorder_s, 1),
+           "ndocs": int(seg.ndocs), "nthreads": nthreads_mix,
+           "arms": {}}
+    for arm, s in (("orig", seg), ("bp", seg_bp)):
+        other = seg_bp if s is seg else seg
+        other.drop_device()
+        eng.segments = [s]
+        client.node.indices["bench"].generation += 1
+        arm_out = {}
+        for mix in ("single", "multi_eq"):
+            bodies = bodies_of(mix, f"ro-{arm}-{mix}-w")
+            closed_loop(bodies, nthreads=8)      # warm: compiles+residency
+            ip0 = impactpath.stats()
+            c0, s0 = cost_hist()
+            # one 5s closed loop per cell is noise-dominated on a small
+            # host: sample `passes` loops and report the median qps
+            qps_samples = []
+            lats = []
+            for p in range(passes):
+                bodies = bodies_of(mix, f"ro-{arm}-{mix}-m{p}")
+                q, ls = closed_loop(bodies)
+                qps_samples.append(q)
+                lats.extend(ls)
+            qps = float(np.median(qps_samples))
+            ip1 = impactpath.stats()
+            c1, s1 = cost_hist()
+            blk_tot = ip1["blocks_total"] - ip0["blocks_total"]
+            blk_skip = ip1["blocks_skipped"] - ip0["blocks_skipped"]
+            pt = ip1["postings_total"] - ip0["postings_total"]
+            ps = ip1["postings_skipped"] - ip0["postings_skipped"]
+            arm_out[mix] = {
+                "qps": round(qps, 1),
+                "qps_samples": [round(q, 1) for q in qps_samples],
+                "lat_ms_p50": round(pct(lats, 50), 2),
+                "lat_ms_p99": round(pct(lats, 99), 2),
+                "block_skip_rate": (round(blk_skip / blk_tot, 4)
+                                    if blk_tot else 0.0),
+                "posting_skip_rate": (round(ps / pt, 4) if pt else 0.0),
+                "impact_served": ip1["served"] - ip0["served"],
+                "escalated": ip1["escalated"] - ip0["escalated"],
+                "mean_bytes_per_query": round((s1 - s0)
+                                              / max(c1 - c0, 1), 1),
+            }
+            log(f"reorder[{arm}/{mix}]: qps={arm_out[mix]['qps']} "
+                f"skip={arm_out[mix]['block_skip_rate']} "
+                f"esc={arm_out[mix]['escalated']}")
+            if time.time() - t_start > time_share:
+                log("reorder: budget-capped")
+                break
+        out["arms"][arm] = arm_out
+    eng.segments = [seg_bp]          # leave the index on the BP arm
+    client.node.indices["bench"].generation += 1
+    a, b = out["arms"].get("orig", {}), out["arms"].get("bp", {})
+    if "multi_eq" in a and "multi_eq" in b:
+        out["gates"] = {
+            "multi_term_skip_up": (b["multi_eq"]["block_skip_rate"]
+                                   > a["multi_eq"]["block_skip_rate"]),
+            "multi_term_qps_up": (b["multi_eq"]["qps"]
+                                  > a["multi_eq"]["qps"]),
+            "zero_escalations": (b["multi_eq"]["escalated"] == 0
+                                 and b["single"]["escalated"] == 0),
         }
     return out
 

@@ -279,6 +279,58 @@ def config5():
     return out
 
 
+def config6():
+    """North-star-scale reorder A/B (ISSUE 11 / ROADMAP item 2): a 1M+
+    doc single-segment corpus served through the codec-v2 impact ladder,
+    arrival order vs BP impact-clustered order (index/reorder.py), on
+    single-term and equal-idf multi-term query mixes. Produces the
+    BENCH_r08 `reorder` stamp: p50/p99 latency, qps, block-skip rate,
+    escalations, bytes/query per (arm, mix)."""
+    from opensearch_tpu.rest.client import RestClient
+
+    ndocs = int(os.environ.get("BENCH6_NDOCS", 1_000_000))
+    t0 = time.time()
+    # topical corpus (build_corpus_topical): real passages share topic
+    # vocabulary, which is the co-occurrence signal BP clusters on — on
+    # the iid-token synthetic, reordering measurably cannot concentrate
+    # anything (zero per-term range concentration) and the A/B would
+    # test nothing
+    starts, doc_ids, tfs, dl, df, _topic = B._cached(
+        f"reorder_top_{ndocs}",
+        lambda: B.build_corpus_topical(ndocs, seed=0), True)
+    corpus_s = time.time() - t0
+    B.log(f"config6: topical corpus {ndocs} docs / {len(doc_ids)} "
+          f"postings in {corpus_s:.1f}s")
+    tstarts, tdoc_ids, ttfs, tpos_starts, tpositions, first, second, _pc = \
+        B._cached(f"reorder_title_{ndocs}",
+                  lambda: B.build_title_corpus(ndocs), True)
+    rng = np.random.default_rng(3)
+    status_ord = rng.integers(0, 3, ndocs).astype(np.int32)
+    price = rng.integers(0, 10_000, ndocs).astype(np.int64)
+    vocab_strs = [f"t{i:07d}" for i in range(len(df))]
+    tvocab_strs = [f"p{i:04d}" for i in range(len(tstarts) - 1)]
+    client = RestClient()
+    t0 = time.time()
+    seg = B.make_index(client, (starts, doc_ids, tfs, vocab_strs), dl,
+                       (tstarts, tdoc_ids, ttfs, tpos_starts, tpositions,
+                        tvocab_strs), status_ord, price)
+    B.log(f"config6: segment + impact planes in {time.time()-t0:.1f}s")
+    # query pools from the TOPICAL band (vocab upper half): df high
+    # enough to span many 128-posting blocks, low enough to be
+    # selective — the gap shape the reorder pass exists for
+    topical = np.arange(len(df) // 2, len(df))
+    pool = topical[(df[topical] >= 1024) & (df[topical] <= (1 << 17))]
+    out = B.measure_reorder(client, seg, df, vocab_strs, B.log,
+                            nq=int(os.environ.get("BENCH6_NQ", 256)),
+                            single_pool=pool, multi_pool=pool)
+    out["postings"] = int(len(doc_ids))
+    out["corpus_build_s"] = round(corpus_s, 1)
+    _OUT["config6_reorder"] = out
+    _emit("config6_done")
+    B.log(f"config6: {out.get('gates')}")
+    return out
+
+
 def main():
     which = os.environ.get("BENCH45", "45")
     if "4" in which:
@@ -287,6 +339,9 @@ def main():
     if "5" in which:
         out5 = config5()
         _merge_published("config5_multisegment", out5)
+    if "6" in which:
+        out6 = config6()
+        _merge_published("config6_reorder", out6)
     _emit("complete")
     print(json.dumps(_OUT))
 

@@ -132,6 +132,7 @@ def default_checkers() -> List[Checker]:
     from .recorder_rules import RecorderDisciplineChecker
     from .rpc_rules import RpcDisciplineChecker
     from .sampler_rules import SamplerDisciplineChecker
+    from .score_plane_rules import ScorePlaneChecker
     from .sync_rules import DeviceSyncDisciplineChecker
     from .telemetry_rules import TelemetryDisciplineChecker
     return [DtypeDisciplineChecker(), JitBoundaryChecker(),
@@ -139,7 +140,8 @@ def default_checkers() -> List[Checker]:
             TelemetryDisciplineChecker(), WaitDisciplineChecker(),
             DeviceSyncDisciplineChecker(), RecorderDisciplineChecker(),
             MemoryAccountingChecker(), ImpactDomainChecker(),
-            RpcDisciplineChecker(), SamplerDisciplineChecker()]
+            RpcDisciplineChecker(), SamplerDisciplineChecker(),
+            ScorePlaneChecker()]
 
 
 def run_source(src: str, path: str,

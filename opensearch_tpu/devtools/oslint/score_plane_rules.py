@@ -1,0 +1,129 @@
+"""OSL601 — per-doc score-plane materialization discipline.
+
+The north-star corpus is 1M-8.8M docs per segment. At that scale a full
+per-doc f32 plane is 4-35 MB *per allocation, per query* — host serving
+code that materializes one (a dense score accumulator, a per-doc rank
+plane) turns every query into an O(ndocs) memory write regardless of how
+selective the query is, and the allocation storms defeat the HBM
+ledger's byte accounting (the plane never registers). The ONLY places a
+full per-doc score plane may exist are the frontier kernels and their
+program builders — `ops/` (pallas kernels, XLA scatter programs run ON
+the device where the plane is the scatter target) — where XLA owns the
+buffer for the duration of one launch.
+
+Rule OSL601 fires when host serving code (`search/`, `serving/`,
+`cluster/`) allocates an ndocs-scale FLOAT array with HOST numpy:
+
+    np.zeros(seg.ndocs, np.float32)          # OSL601
+    np.full(ndocs_pad, -np.inf)              # OSL601
+    np.zeros(seg.ndocs, dtype=bool)          # quiet: masks are cheap+
+                                             # legitimate (filters, live)
+    np.zeros(len(cand), np.float32)          # quiet: candidate-scale
+    jnp.zeros(ndocs_pad, jnp.float32)        # quiet: a traced jnp plane
+                                             # is a DEVICE scatter target
+                                             # inside one launch — the
+                                             # frontier-program domain
+
+"ndocs-scale" is syntactic: the size expression mentions an
+`ndocs`/`ndocs_pad`/`dpad` name. Integer and bool planes stay quiet —
+doc masks and ordinal planes are the engine's bread and butter; it is
+the SCORE domain (float) that belongs to the frontier pass. `jnp`
+allocations stay quiet because program builders (compiler.py emit
+functions) trace them into the launch where XLA owns the buffer — the
+rule patrols the HOST heap, which the HBM ledger cannot see.
+
+Suppress deliberate exceptions with
+`# oslint: disable=OSL601 -- <why this plane is size-gated or O(1)>` —
+the justification should name the runtime gate (e.g. "only below
+QUALITY_MIN_NDOCS", "ndocs_pad here is a nested-child space").
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_SCOPES = ("opensearch_tpu/search/", "opensearch_tpu/serving/",
+           "opensearch_tpu/cluster/")
+_ALLOC_FNS = {"zeros", "full", "empty", "ones", "zeros_like", "full_like",
+              "ones_like", "empty_like"}
+_FLOAT_DTYPES = {"float32", "float64", "float16", "bfloat16", "float"}
+_NONFLOAT_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+                    "uint32", "uint64", "bool", "bool_", "intp"}
+_NDOCS_NAMES = ("ndocs", "ndocs_pad", "dpad")
+
+
+def _mentions_ndocs(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        if name is None:
+            continue
+        low = name.lower()
+        if any(tok == low or low.endswith("_" + tok) or tok in low
+               for tok in _NDOCS_NAMES):
+            return True
+    return False
+
+
+def _dtype_token(node: ast.Call) -> str:
+    """Best-effort dtype of the allocation: '' = unspecified (float by
+    numpy default), else the trailing dtype identifier."""
+    cands = []
+    fn = _dotted(node.func).rsplit(".", 1)[-1]
+    # np.zeros(shape, dtype) / np.full(shape, fill, dtype)
+    pos = 2 if fn in ("full", "full_like") else 1
+    if len(node.args) > pos:
+        cands.append(node.args[pos])
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            cands.append(kw.value)
+    for c in cands:
+        tok = _dotted(c).rsplit(".", 1)[-1]
+        if tok:
+            return tok
+    return ""
+
+
+class ScorePlaneChecker(Checker):
+    rules = ("OSL601",)
+    name = "score-plane"
+
+    def applies(self, path: str) -> bool:
+        return any(s in path for s in _SCOPES) and "devtools" not in path
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            dn = _dotted(node.func)
+            fn = dn.rsplit(".", 1)[-1]
+            if fn not in _ALLOC_FNS:
+                continue
+            root = dn.split(".", 1)[0]
+            if root not in ("np", "numpy"):
+                continue
+            if not _mentions_ndocs(node.args[0]):
+                continue
+            dt = _dtype_token(node)
+            if dt in _NONFLOAT_DTYPES:
+                continue            # doc masks / ordinal planes: fine
+            findings.append(Finding(
+                "OSL601", path, node.lineno, node.col_offset,
+                qmap.get(node, ""),
+                f"materializes a full per-doc float plane "
+                f"(`{fn}` over an ndocs-scale shape) on the host serving "
+                "path; at north-star scale (>2^20-doc segments) per-doc "
+                "SCORE planes live only in the frontier kernels/programs "
+                "(ops/) — score candidates, not the corpus; suppress "
+                "with the runtime size-gate as justification",
+                detail=f"plane:{fn}:{dt or 'default-float'}"))
+        return findings

@@ -20,8 +20,21 @@ from typing import Dict, List, Optional
 
 from .mappings import Mappings, ParsedDocument
 from .merge import TieredMergePolicy, merge_segments
-from .segment import Segment, build_segment
+from .segment import (Segment, build_segment, build_segment_streaming,
+                      stream_eligible)
 from .translog import Translog
+
+# refresh buffers at or past this many docs take the streaming builder
+# (chunked pack + disk spill-and-merge, index/segment.py
+# StreamingSegmentBuilder) — the in-memory pack's transient Python token
+# buffers dominate host memory well before the final CSR does. Output is
+# bit-identical either way, so the threshold is purely a memory knob.
+STREAM_REFRESH_MIN_DOCS = 1 << 16
+
+
+def stream_refresh_min_docs() -> int:
+    return int(os.environ.get("OPENSEARCH_TPU_STREAM_REFRESH_DOCS",
+                              STREAM_REFRESH_MIN_DOCS))
 
 
 class VersionConflictError(Exception):
@@ -163,7 +176,16 @@ class Engine:
         seqs = [s for _, s in live_docs]
         name = f"_{self._seg_counter}"
         self._seg_counter += 1
-        seg = build_segment(name, docs, self.mappings, seq_nos=seqs)
+        if len(docs) >= stream_refresh_min_docs() and stream_eligible(docs):
+            seg = build_segment_streaming(name, docs, self.mappings,
+                                          seq_nos=seqs,
+                                          spill_dir=(os.path.join(
+                                              self.path, "_stream_spill")
+                                              if self.path else None))
+            self.stats["stream_refreshes"] = \
+                self.stats.get("stream_refreshes", 0) + 1
+        else:
+            seg = build_segment(name, docs, self.mappings, seq_nos=seqs)
         self.segments.append(seg)
         for local, d in enumerate(docs):
             self.version_map[d.doc_id] = DocLocation(
@@ -196,6 +218,21 @@ class Engine:
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         if len(self.segments) > max_num_segments:
+            self.force_merge_group(list(self.segments))
+            return
+        # a lone codec-v2 segment still takes the merge-time BP reorder
+        # pass (index/reorder.py): forcemerge is the "optimize layout"
+        # call, and whether the corpus arrived in one refresh or ten must
+        # not decide whether the pass ran. Gated on the pass actually
+        # being applicable so small/v1/already-reordered segments keep
+        # the historical no-op.
+        from . import reorder
+        from .segment import CODEC_V2
+        if (len(self.segments) == 1 and reorder.enabled()
+                and getattr(self.segments[0], "codec_version", 1)
+                >= CODEC_V2
+                and not self.segments[0].__dict__.get("_reordered")
+                and self.segments[0].ndocs >= reorder.min_docs()):
             self.force_merge_group(list(self.segments))
 
     def flush(self) -> None:

@@ -282,6 +282,25 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
                      shape_cols=shape_cols,
                      stored_vals=stored_vals if any_stored else None)
     merged.term_vectors = term_vectors if tv_fields else None
+    if any(s.__dict__.get("_reordered") for s in segments):
+        # a BP-reordered input sits in the concatenation in PERMUTED
+        # order, so the merged segment's internal ids no longer encode
+        # arrival — thread the inputs' arrival planes through (offset per
+        # input, live-compacted) or exact-score ties in the merged
+        # segment break differently from the unreordered arm's merge of
+        # the same corpus (the cross-arm parity contract). Values only
+        # need to be order-preserving, not dense.
+        parts = []
+        offset = 0
+        for s, m in zip(segments, live_masks):
+            r = s.tie_ranks()
+            if r is None:
+                r = np.arange(s.ndocs, dtype=np.int64)
+            parts.append(r[m] + offset)
+            offset += s.ndocs
+        merged.__dict__["_tie_rank"] = np.concatenate(parts) if parts \
+            else np.zeros(0, np.int64)
+        merged.__dict__["_reordered"] = True
     # codec propagation: merges emit the PROCESS-DEFAULT codec — they are
     # the natural rebuild point for the format rev (a v1+v2 merge
     # upgrades the v1 half; under the OPENSEARCH_TPU_CODEC=1 rollback pin
@@ -292,6 +311,16 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
     # past the size threshold (ops/device_merge.quantize_impacts).
     if default_codec_version() >= CODEC_V2:
         merged.build_impacts()
+        if "/" not in name:
+            # BP-style impact-clustered doc-id reordering (index/reorder.py):
+            # merges are the one point the whole doc set is in hand and the
+            # impact planes are fresh — nested CHILD merges (name carries a
+            # "/") skip, because the parent's apply_permutation re-sorts
+            # children against the permuted parent ids itself. The pass is
+            # deterministic, so copy holders re-running this merge stay
+            # byte-identical (PR-9 replication contract).
+            from .reorder import maybe_reorder
+            merged = maybe_reorder(merged)
     return merged
 
 

@@ -228,6 +228,41 @@ def next_pow2(n: int, floor: int = 16) -> int:
     return 1 << (n - 1).bit_length()
 
 
+class _BuildLock:
+    """Reentrant per-segment build lock that also exposes its hold depth.
+    Pressure eviction (`Segment.evict_device`) must refuse a segment
+    whose build is in flight, but the evictor frequently runs ON the
+    builder's own thread (ledger register -> `_evict_lru` -> evictor,
+    all inside a build's critical section) — a bare RLock's reentrant
+    acquire would succeed there and let a mid-build plane be dropped.
+    The depth counter is only mutated while the lock is held, so reading
+    `depth > 1` after a successful acquire is exact."""
+
+    __slots__ = ("_lock", "depth")
+
+    def __init__(self) -> None:
+        import threading
+        self._lock = threading.RLock()
+        self.depth = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            self.depth += 1
+        return ok
+
+    def release(self) -> None:
+        self.depth -= 1
+        self._lock.release()
+
+    def __enter__(self) -> "_BuildLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
 class _DevicePut:
     """jnp stand-in whose asarray lands on a specific device (replica
     re-hosting path in Segment.device_arrays)."""
@@ -512,6 +547,39 @@ class Segment:
         # lazily via ensure_device_tfs; the hot impact path never does)
         self._tf_promoted: set = set()
 
+    # ---------------- arrival-order tie ranks ----------------
+
+    def tie_ranks(self) -> Optional[np.ndarray]:
+        """Arrival-rank tie-break plane, or None when internal doc order
+        IS arrival order (every segment the BP reorder pass has not
+        touched — ids are assigned in write order and merges
+        concatenate, so seq_nos ascend with doc id). After the merge-time
+        doc-id reorder (index/reorder.py) score ties must still break in
+        a layout-invariant order — the reorder parity contract: the same
+        corpus indexed with and without the permutation serves
+        byte-identical pages — so serving-path selections/sorts key ties
+        on rank-of-seq_no instead of the (permuted) internal id. Lazy,
+        cached; i64[ndocs] when present."""
+        if "_tie_rank" not in self.__dict__:
+            # gate on the explicit reorder marker, NOT a seq_no shape
+            # heuristic: ordinary tiered merges concatenate segments in
+            # live_count order, so never-reordered segments routinely
+            # carry non-monotonic seq_nos — inferring "reordered" from
+            # that would change their historical tie semantics (and tax
+            # every query with the tie machinery). apply_permutation
+            # pins the exact plane; this branch only reconstructs it
+            # for marked segments reloaded without a persisted plane.
+            s = np.asarray(self.seq_nos, np.int64)
+            if not self.__dict__.get("_reordered") or len(s) < 2 \
+                    or bool(np.all(np.diff(s) >= 0)):
+                self.__dict__["_tie_rank"] = None
+            else:
+                tr = np.empty(len(s), np.int64)
+                tr[np.argsort(s, kind="stable")] = np.arange(
+                    len(s), dtype=np.int64)
+                self.__dict__["_tie_rank"] = tr
+        return self.__dict__["_tie_rank"]
+
     # ---------------- codec v2: impact planes ----------------
 
     def build_impacts(self, bits: Optional[int] = None) -> None:
@@ -570,7 +638,17 @@ class Segment:
         import jax.numpy as jnp
 
         key = device
-        if key not in self._device_cache:
+        from ..obs.hbm_ledger import LEDGER
+        # recency signal for LRU pressure eviction (lock-free hot path)
+        LEDGER.touch(self, key)
+        # SNAPSHOT the cache dict: pressure eviction (evict_device ->
+        # drop_device) swaps `_device_cache` for a fresh dict rather than
+        # mutating it, so a reader holding this reference keeps a valid
+        # entry even when the evictor fires between its membership check
+        # and its deref — the arrays stay alive until the last consumer
+        # drops them
+        cache = self._device_cache
+        if key not in cache:
             # per-SEGMENT build lock: two request threads racing the same
             # (segment, device) miss would otherwise both build and both
             # charge the breaker (only one dict entry wins but both
@@ -580,20 +658,28 @@ class Segment:
             # reentrant because a parent's build recurses into nested
             # children (child locks are acquired parent->child, acyclic).
             lock = self.__dict__.setdefault(
-                "_device_build_lock", __import__("threading").RLock())
+                "_device_build_lock", _BuildLock())
             with lock:
-                if key not in self._device_cache:
+                # the evictor takes this same lock, so the re-read below
+                # cannot race a drop of THIS segment's residency
+                cache = self._device_cache
+                if key not in cache:
                     self._build_device_arrays(key, device)
-        if self._device_live_dirty.get(key, True):
+                    cache = self._device_cache
+        entry = cache[key]
+        # `"live" not in entry` backstops a torn (old-cache, new-dirty)
+        # pair: a stale reader's dirty=False write must never leave a
+        # freshly rebuilt entry serving without its live plane
+        if self._device_live_dirty.get(key, True) or "live" not in entry:
             live = _pad_to(self.live.astype(np.float32), self.ndocs_pad,
                            np.float32(0))
-            self._device_cache[key]["live"] = (
+            entry["live"] = (
                 # constant-size live plane, charged by the
                 # _build_device_arrays ledger registration
                 jnp.asarray(live) if device is None
                 else jax.device_put(live, device))  # oslint: disable=OSL506
             self._device_live_dirty[key] = False
-        return self._device_cache[key]
+        return entry
 
     def _build_device_arrays(self, key, device) -> None:
         """Build + breaker-charge one (segment, device) cache entry.
@@ -678,13 +764,18 @@ class Segment:
         nbytes += self.ndocs_pad * 4          # live plane (f32)
         allocs = []
         try:
+            # evictor: under breaker pressure the ledger may call
+            # evict_device (weakly held) to reclaim this whole plane
+            # group — the entry rebuilds transparently on next use
             allocs.append(LEDGER.register(
                 "segment_columns", nbytes, owner=self, segment=self,
-                device=key, label=f"segment-device[{self.name}]"))
+                device=key, label=f"segment-device[{self.name}]",
+                evictor=self.evict_device))
             if imp_bytes:
                 allocs.append(LEDGER.register(
                     "impact_postings", imp_bytes, owner=self, segment=self,
-                    device=key, label=f"segment-impacts[{self.name}]"))
+                    device=key, label=f"segment-impacts[{self.name}]",
+                    evictor=self.evict_device))
                 sidecar = sum(pb.impact.block_max.nbytes
                               + pb.impact.block_off.nbytes
                               + pb.impact.block_starts.nbytes
@@ -737,7 +828,7 @@ class Segment:
         import jax.numpy as _jnp
         from ..obs.hbm_ledger import LEDGER
         lock = self.__dict__.setdefault(
-            "_device_build_lock", __import__("threading").RLock())
+            "_device_build_lock", _BuildLock())
         with lock:
             if field in self._tf_promoted:
                 return
@@ -753,7 +844,8 @@ class Segment:
                 alloc = LEDGER.register(
                     "postings_tfs", int(arr.nbytes), owner=self,
                     segment=self, device=key,
-                    label=f"segment-tfs[{self.name}][{field}]")
+                    label=f"segment-tfs[{self.name}][{field}]",
+                    evictor=self.evict_device)
                 fa["tfs"] = arr
                 self.__dict__.setdefault("_hbm_allocs", {}).setdefault(
                     key, []).append(alloc)
@@ -771,6 +863,8 @@ class Segment:
         overlapping term arrays are never double-counted.
         `needs` keys: postings / numeric / keyword / geo -> field sets."""
         key = device
+        from ..obs.hbm_ledger import LEDGER
+        LEDGER.touch(self, key)
         if key in self._device_cache:
             # the full pytree already exists: serve from it (no extra HBM)
             return self.device_arrays(device)
@@ -779,7 +873,7 @@ class Segment:
         # charge would leak until segment GC), and the full build's
         # promotion sweep iterates these dicts under this lock
         lock = self.__dict__.setdefault(
-            "_device_build_lock", __import__("threading").RLock())
+            "_device_build_lock", _BuildLock())
         with lock:
             return self._pruned_arrays_locked(key, device, needs)
 
@@ -805,7 +899,8 @@ class Segment:
                 allocs[k] = LEDGER.register(
                     "partial_columns", _tree_nbytes(arrs), owner=self,
                     segment=self, device=key,
-                    label=f"segment-partial[{self.name}][{group}.{f}]")
+                    label=f"segment-partial[{self.name}][{group}.{f}]",
+                    evictor=self.evict_device)
                 cache[k] = arrs
             return cache[k]
 
@@ -861,6 +956,41 @@ class Segment:
         out["live"] = cache[lk]
         return out
 
+    def evict_device(self) -> bool:
+        """Pressure-eviction hook (obs/hbm_ledger.py `_evict_lru`): drop
+        this segment's device residency UNLESS a build is in flight —
+        the ledger calls this with its own lock held, so blocking on the
+        build lock here would invert the (build lock -> ledger lock)
+        order every `_build_device_arrays` takes. A depth check backs up
+        the non-blocking acquire: the evictor often runs on the builder's
+        OWN thread (a build's ledger registration triggers eviction of a
+        sibling this thread is also mid-building, e.g. a nested parent),
+        where the reentrant acquire would succeed. Returns True when the
+        residency was actually released."""
+        held = []
+        try:
+            # drop_device recurses into nested children, and the
+            # compiler builds child planes (ensure_device_tfs) under the
+            # CHILD's lock only — so the whole family must be idle, not
+            # just the parent, or a pressure evict rips a plane out from
+            # under a mid-flight child build
+            stack = [self]
+            while stack:
+                s = stack.pop()
+                lock = s.__dict__.setdefault(
+                    "_device_build_lock", _BuildLock())
+                if not lock.acquire(blocking=False):
+                    return False
+                held.append(lock)
+                if lock.depth > 1:  # this thread is building this segment
+                    return False
+                stack.extend(blk.child for blk in s.nested.values())
+            self.drop_device()
+            return True
+        finally:
+            for lock in reversed(held):
+                lock.release()
+
     def drop_device(self) -> None:
         from ..obs.hbm_ledger import LEDGER
         self._device_cache = {}
@@ -881,8 +1011,25 @@ class Segment:
     def save(self, path: str) -> None:
         os.makedirs(path, exist_ok=True)
         arrays: Dict[str, np.ndarray] = {"live": self.live, "seq_nos": self.seq_nos}
+        tr = self.__dict__.get("_tie_rank")
+        if tr is not None:
+            # persist the pinned arrival plane verbatim: the seq_no
+            # reconstruction on load is only an approximation when the
+            # pre-permutation concatenation wasn't seq-ascending (tiered
+            # merges order inputs by live_count) or seq_nos are
+            # degenerate (direct-CSR corpora default to zeros) — the
+            # plane must be byte-identical across a restart or tie pages
+            # drift from their replicas
+            arrays["tie_rank"] = tr
         meta: Dict[str, Any] = {"name": self.name, "ndocs": self.ndocs,
                                 "codec": self.codec_version,
+                                # BP reorder pass already ran (index/
+                                # reorder.py) — without this, the first
+                                # force_merge after a restart re-merges
+                                # and re-reorders an already-clustered
+                                # segment (~minutes at 1M docs)
+                                "reordered": bool(
+                                    self.__dict__.get("_reordered", False)),
                                 "postings": {}, "numeric": {}, "keyword": {}, "geo": {},
                                 "impacts": {},
                                 "text_stats": {f: [s.doc_count, s.sum_dl]
@@ -1046,6 +1193,15 @@ class Segment:
                   # segments and keep serving unchanged
                   codec_version=int(meta.get("codec", CODEC_V1)))
         seg.live = arrays["live"].copy()
+        if meta.get("reordered"):
+            seg.__dict__["_reordered"] = True
+            # pin exactly what was saved: a reordered segment persists
+            # its plane verbatim (save()), and a no-op-marked segment
+            # (pass ran, nothing clustered) has none — reconstructing
+            # one from seq_nos here would invent a tie order the
+            # pre-restart process never served
+            seg.__dict__["_tie_rank"] = (arrays["tie_rank"]
+                                         if "tie_rank" in arrays else None)
         seg.id2doc = {d: i for i, d in enumerate(ids) if seg.live[i]}
         tv_path = os.path.join(path, "term_vectors.json")
         if os.path.exists(tv_path):
@@ -1241,6 +1397,21 @@ def pack_postings(parsed_docs: list, with_positions: bool) -> Dict[str, Postings
     return out
 
 
+def _numeric_kind(mappings: Mappings, fname: str) -> str:
+    """Storage kind of one numeric doc-value column — shared by the
+    in-memory and streaming builders so the two paths cannot diverge."""
+    ft = mappings.resolve_field(fname)
+    if fname.endswith(("#lo", "#hi")) and ft is None:
+        # range-field bound columns: member type decides the kind
+        from .mappings import RANGE_MEMBER
+        rft = mappings.resolve_field(fname[:-3])
+        member = RANGE_MEMBER.get(rft.type) if rft is not None else None
+        return "float" if member in ("float", "double") else "int"
+    if ft is not None and ft.type == "unsigned_long":
+        return "uint"        # biased i64: exact order, unbiased f32 view
+    return "float" if (ft is not None and ft.type in FLOAT_TYPES) else "int"
+
+
 def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                   seq_nos: Optional[List[int]] = None,
                   with_positions: bool = True) -> Segment:
@@ -1309,18 +1480,7 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
     vec_fields = {f for pd in parsed_docs for f in pd.vectors}
 
     for fname in num_fields:
-        ft = mappings.resolve_field(fname)
-        if fname.endswith(("#lo", "#hi")) and ft is None:
-            # range-field bound columns: member type decides the kind
-            from .mappings import RANGE_MEMBER
-            rft = mappings.resolve_field(fname[:-3])
-            member = RANGE_MEMBER.get(rft.type) if rft is not None else None
-            kind = "float" if member in ("float", "double") else "int"
-        elif ft is not None and ft.type == "unsigned_long":
-            kind = "uint"    # biased i64: exact order, unbiased f32 view
-        else:
-            kind = "float" if (ft is not None and ft.type in FLOAT_TYPES) \
-                else "int"
+        kind = _numeric_kind(mappings, fname)
         dtype = np.float64 if kind == "float" else np.int64
         values = np.zeros(ndocs, dtype=dtype)
         present = np.zeros(ndocs, dtype=bool)
@@ -1433,3 +1593,481 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
     # end) for the FVH path (host-only, like _source)
     seg.term_vectors = term_vectors
     return seg
+
+
+# ---------------------------------------------------------------------
+# streaming segment build (chunked posting accumulation, spill-and-merge)
+# ---------------------------------------------------------------------
+#
+# The in-memory build (`build_segment` -> `pack_postings`) flattens the
+# WHOLE doc buffer's token stream into Python lists before packing: at
+# north-star scale (1M-8.8M docs, ~56 tokens/doc) that is hundreds of
+# millions of Python string references — tens of GB of transient host
+# memory for a segment whose final CSR arrays are ~1 GB. The streaming
+# builder bounds the transient: docs are packed in fixed-size CHUNKS
+# (each chunk through the same `pack_postings` native/python packer),
+# every chunk's CSR + doc-value planes SPILL to disk, and `finish()`
+# merges the sorted chunk runs into the final arrays with a vectorized
+# run-scatter — no global sort, because chunk doc ranges are disjoint
+# and ascending, so per-term concatenation in chunk order IS (term, doc)
+# order. Peak host memory ~= final arrays + one chunk.
+#
+# Output is BIT-IDENTICAL to `build_segment` on the same docs
+# (tests/test_stream_build.py pins it array-for-array): same vocab
+# union, same CSR layout, same tf/position values, same doc-value
+# columns, same text stats — and therefore the same codec-v2 impact
+# planes, since those derive from (tf, dl, avgdl) alone.
+#
+# Scope: the streaming-eligible families are text/keyword-ish postings,
+# numeric / keyword / geo / vector doc values and doc lengths — the
+# north-star corpus shape. Docs carrying nested blocks, geo shapes,
+# term-vector offsets or rank-features raise: those buffers are
+# host-object-heavy either way, and the refresh path routes them to the
+# in-memory build (`Engine.refresh` checks eligibility first).
+
+
+def stream_eligible(parsed_docs) -> bool:
+    """True when every doc uses only streaming-supported field families."""
+    return not any(pd.nested or pd.shapes or pd.offsets or pd.features
+                   for pd in parsed_docs if pd is not None)
+
+
+class StreamingSegmentBuilder:
+    """Bounded-memory segment construction: `add()` docs, `finish()` the
+    Segment. One chunk of parsed docs is resident at a time; chunk CSRs
+    spill to `spill_dir` (a private temp dir by default)."""
+
+    def __init__(self, name: str, mappings: Mappings,
+                 chunk_docs: int = 8192, spill_dir: Optional[str] = None,
+                 with_positions: bool = True):
+        import tempfile
+        self.name = name
+        self.mappings = mappings
+        self.chunk_docs = max(int(chunk_docs), 1)
+        self.with_positions = with_positions
+        self._own_dir = spill_dir is None
+        self._dir = spill_dir or tempfile.mkdtemp(prefix="ostpu_stream_")
+        os.makedirs(self._dir, exist_ok=True)
+        self._chunk: list = []
+        self._chunks: list = []      # per-chunk meta dicts
+        self._ndocs = 0
+        self.ids: List[str] = []
+        self.sources: List[dict] = []
+        self._stored: list = []
+        self._any_stored = False
+        self._text_stats: Dict[str, TextFieldStats] = {}
+        self._vec_sim: Dict[str, tuple] = {}
+        self._npz_cache: Dict[int, Any] = {}
+        self._finished = False
+
+    # ---------------- ingest ----------------
+
+    def add(self, parsed) -> None:
+        if parsed.nested or parsed.shapes or parsed.offsets \
+                or parsed.features:
+            raise ValueError(
+                "streaming build supports text/numeric/keyword/geo/vector "
+                "families only; nested/shape/term_vector/feature docs take "
+                "the in-memory build (see Engine.refresh eligibility gate)")
+        self._chunk.append(parsed)
+        if len(self._chunk) >= self.chunk_docs:
+            self._flush_chunk()
+
+    def add_many(self, parsed_iter) -> None:
+        for pd in parsed_iter:
+            self.add(pd)
+
+    @property
+    def ndocs(self) -> int:
+        return self._ndocs + len(self._chunk)
+
+    def _flush_chunk(self) -> None:
+        docs = self._chunk
+        self._chunk = []
+        if not docs:
+            return
+        base = self._ndocs
+        n = len(docs)
+        self._ndocs += n
+        arrays: Dict[str, np.ndarray] = {}
+        meta = {"base": base, "n": n, "post": {}, "num": {}, "kw": {},
+                "geo": [], "vec": {}, "dl": []}
+
+        src_on = getattr(self.mappings, "source_enabled", True)
+        for pd in docs:
+            self.ids.append(pd.doc_id)
+            self.sources.append(pd.source if src_on else {})
+            sv = dict(pd.stored) if pd.stored else None
+            self._any_stored = self._any_stored or bool(sv)
+            self._stored.append(sv)
+
+        # ---- text stats + per-chunk doc lengths (mirrors build_segment) --
+        dl_f: Dict[str, np.ndarray] = {}
+        for di, pd in enumerate(docs):
+            for fname, terms in pd.terms.items():
+                ft = self.mappings.resolve_field(fname)
+                if ft is not None and ft.type == "text":
+                    st = self._text_stats.setdefault(fname,
+                                                     TextFieldStats())
+                    st.doc_count += 1
+                    st.sum_dl += len(terms)
+                    dl = dl_f.setdefault(fname, np.zeros(n, np.int64))
+                    dl[di] = len(terms)
+        for fname, dl in dl_f.items():
+            arrays[f"dl__{len(meta['dl'])}"] = dl
+            meta["dl"].append(fname)
+
+        # ---- postings: one packer run per chunk ----
+        for fi, (fname, pb) in enumerate(
+                sorted(pack_postings(docs, self.with_positions).items())):
+            key = f"post__{fi}"
+            arrays[f"{key}__starts"] = pb.starts
+            arrays[f"{key}__doc_ids"] = pb.doc_ids
+            arrays[f"{key}__tfs"] = pb.tfs
+            positional = pb.pos_starts is not None
+            if positional:
+                arrays[f"{key}__pos_starts"] = pb.pos_starts
+                arrays[f"{key}__positions"] = pb.positions
+            meta["post"][fname] = {"i": fi, "vocab": pb.vocab,
+                                   "positional": positional}
+
+        # ---- doc values ----
+        num_fields = {f for pd in docs for f in pd.numerics}
+        for fi, fname in enumerate(sorted(num_fields)):
+            kind = _numeric_kind(self.mappings, fname)
+            dtype = np.float64 if kind == "float" else np.int64
+            values = np.zeros(n, dtype=dtype)
+            present = np.zeros(n, dtype=bool)
+            for di, pd in enumerate(docs):
+                vals = pd.numerics.get(fname)
+                if vals:
+                    values[di] = vals[0]
+                    present[di] = True
+            arrays[f"num__{fi}__values"] = values
+            arrays[f"num__{fi}__present"] = present
+            meta["num"][fname] = {"i": fi, "kind": kind}
+
+        kw_fields = {f for pd in docs for f in pd.keywords}
+        for fi, fname in enumerate(sorted(kw_fields)):
+            value_set = set()
+            for pd in docs:
+                value_set.update(pd.keywords.get(fname, ()))
+            vocab = sorted(value_set)
+            ord_of = {v: i for i, v in enumerate(vocab)}
+            starts = np.zeros(n + 1, dtype=np.int64)
+            flat_ords: List[int] = []
+            flat_docs: List[int] = []
+            min_ord = np.full(n, -1, dtype=np.int32)
+            for di, pd in enumerate(docs):
+                vals = pd.keywords.get(fname, ())
+                ords = sorted(ord_of[v] for v in set(vals))
+                for o in ords:
+                    flat_ords.append(o)
+                    flat_docs.append(di)
+                if ords:
+                    min_ord[di] = ords[0]
+                starts[di + 1] = len(flat_ords)
+            arrays[f"kw__{fi}__starts"] = starts
+            arrays[f"kw__{fi}__ords"] = np.asarray(flat_ords, np.int32)
+            arrays[f"kw__{fi}__docs"] = np.asarray(flat_docs, np.int32)
+            arrays[f"kw__{fi}__min_ord"] = min_ord
+            meta["kw"][fname] = {"i": fi, "vocab": vocab}
+
+        geo_fields = {f for pd in docs for f in pd.geos}
+        for fi, fname in enumerate(sorted(geo_fields)):
+            lat = np.zeros(n, dtype=np.float32)
+            lon = np.zeros(n, dtype=np.float32)
+            present = np.zeros(n, dtype=bool)
+            for di, pd in enumerate(docs):
+                vals = pd.geos.get(fname)
+                if vals:
+                    lat[di], lon[di] = vals[0]
+                    present[di] = True
+            arrays[f"geo__{fi}__lat"] = lat
+            arrays[f"geo__{fi}__lon"] = lon
+            arrays[f"geo__{fi}__present"] = present
+            meta["geo"].append(fname)
+
+        vec_fields = {f for pd in docs for f in pd.vectors}
+        for fi, fname in enumerate(sorted(vec_fields)):
+            ft = self.mappings.resolve_field(fname)
+            dims = next(len(pd.vectors[fname]) for pd in docs
+                        if fname in pd.vectors)
+            self._vec_sim.setdefault(fname, (
+                dims,
+                ft.vector_similarity if ft is not None else "cosine",
+                ft.vector_method if ft is not None else None))
+            values = np.zeros((n, dims), np.float32)
+            present = np.zeros(n, bool)
+            for di, pd in enumerate(docs):
+                vec = pd.vectors.get(fname)
+                if vec is not None:
+                    values[di] = vec
+                    present[di] = True
+            arrays[f"vec__{fi}__values"] = values
+            arrays[f"vec__{fi}__present"] = present
+            meta["vec"][fname] = {"i": fi}
+
+        np.savez(os.path.join(self._dir, f"chunk{len(self._chunks)}.npz"),
+                 **arrays)
+        self._chunks.append(meta)
+
+    # ---------------- merge ----------------
+
+    # open .npz handles kept during finish(): each holds an OS file
+    # descriptor, so cap well under common ulimits (an 8.8M-doc build is
+    # ~1075 chunks); merge loops walk chunks in ascending order, so FIFO
+    # eviction drops exactly the handles not needed soon
+    _NPZ_CACHE_FDS = 64
+
+    def _chunk_arrays(self, ci: int):
+        # one open NpzFile per chunk while it is being visited: members
+        # load lazily, but every np.load re-parses the zip central
+        # directory — the merge loops visit each chunk up to 3x per field
+        arrs = self._npz_cache.get(ci)
+        if arrs is None:
+            while len(self._npz_cache) >= self._NPZ_CACHE_FDS:
+                old = next(iter(self._npz_cache))
+                try:
+                    self._npz_cache.pop(old).close()
+                except Exception:
+                    pass
+            arrs = np.load(os.path.join(self._dir, f"chunk{ci}.npz"),
+                           allow_pickle=False)
+            self._npz_cache[ci] = arrs
+        return arrs
+
+    def _merge_postings_field(self, fname: str) -> PostingsBlock:
+        """Spill-and-merge of one field's chunk CSR runs: union vocab,
+        then a vectorized run-scatter per chunk. Chunk doc ranges are
+        disjoint ascending, so filling runs in chunk order lands every
+        row in (doc ascending) order — identical to the global pack."""
+        from .merge import _ranges_gather
+
+        chunks = [(ci, m["post"][fname]) for ci, m in
+                  enumerate(self._chunks) if fname in m["post"]]
+        vocab = sorted({t for _ci, pm in chunks for t in pm["vocab"]})
+        new_row_of = {t: i for i, t in enumerate(vocab)}
+        nterms = len(vocab)
+        positional = self.with_positions
+        lens_u = np.zeros(nterms, np.int64)
+        row_maps = {}
+        for ci, pm in chunks:
+            rm = np.fromiter((new_row_of[t] for t in pm["vocab"]),
+                             np.int64, count=len(pm["vocab"]))
+            row_maps[ci] = rm
+            arrs = self._chunk_arrays(ci)
+            clens = np.diff(arrs[f"post__{pm['i']}__starts"])
+            np.add.at(lens_u, rm, clens)
+        starts = np.zeros(nterms + 1, np.int64)
+        np.cumsum(lens_u, out=starts[1:])
+        total = int(starts[-1])
+        doc_ids = np.empty(total, np.int32)
+        tfs = np.empty(total, np.float32)
+        plens = np.zeros(total, np.int64) if positional else None
+        filled = np.zeros(nterms, np.int64)
+        dsts = {}
+        for ci, pm in chunks:
+            arrs = self._chunk_arrays(ci)
+            key = f"post__{pm['i']}"
+            cstarts = arrs[f"{key}__starts"]
+            clens = np.diff(cstarts)
+            rm = row_maps[ci]
+            run_dst = starts[rm] + filled[rm]
+            pc = int(cstarts[-1])
+            dst = (np.repeat(run_dst, clens)
+                   + np.arange(pc, dtype=np.int64)
+                   - np.repeat(cstarts[:-1], clens))
+            base = self._chunks[ci]["base"]
+            doc_ids[dst] = arrs[f"{key}__doc_ids"] + np.int32(base)
+            tfs[dst] = arrs[f"{key}__tfs"]
+            if positional:
+                plens[dst] = np.diff(arrs[f"{key}__pos_starts"])
+            filled[rm] += clens
+            dsts[ci] = dst
+        pos_starts = positions = None
+        if positional:
+            pos_starts = np.zeros(total + 1, np.int64)
+            np.cumsum(plens, out=pos_starts[1:])
+            positions = np.empty(int(pos_starts[-1]), np.int32)
+            for ci, pm in chunks:
+                arrs = self._chunk_arrays(ci)
+                key = f"post__{pm['i']}"
+                dst = dsts[ci]
+                cplens = np.diff(arrs[f"{key}__pos_starts"])
+                idx = _ranges_gather(pos_starts[:-1][dst], cplens)
+                positions[idx] = arrs[f"{key}__positions"]
+        return PostingsBlock(fname, vocab, new_row_of, starts, doc_ids,
+                             tfs, pos_starts, positions)
+
+    def finish(self, seq_nos: Optional[List[int]] = None) -> Segment:
+        assert not self._finished
+        self._finished = True
+        self._flush_chunk()
+        ndocs = self._ndocs
+        try:
+            post_fields = sorted({f for m in self._chunks
+                                  for f in m["post"]})
+            postings = {f: self._merge_postings_field(f)
+                        for f in post_fields}
+
+            numeric_cols: Dict[str, NumericColumn] = {}
+            for f in sorted({f for m in self._chunks for f in m["num"]}):
+                kind = next(m["num"][f]["kind"] for m in self._chunks
+                            if f in m["num"])
+                dtype = np.float64 if kind == "float" else np.int64
+                values = np.zeros(ndocs, dtype=dtype)
+                present = np.zeros(ndocs, dtype=bool)
+                for ci, m in enumerate(self._chunks):
+                    nm = m["num"].get(f)
+                    if nm is None:
+                        continue
+                    arrs = self._chunk_arrays(ci)
+                    sl = slice(m["base"], m["base"] + m["n"])
+                    values[sl] = arrs[f"num__{nm['i']}__values"]
+                    present[sl] = arrs[f"num__{nm['i']}__present"]
+                numeric_cols[f] = NumericColumn(f, kind, values, present)
+
+            keyword_cols: Dict[str, KeywordColumn] = {}
+            for f in sorted({f for m in self._chunks for f in m["kw"]}):
+                vocab = sorted({v for m in self._chunks
+                                if f in m["kw"]
+                                for v in m["kw"][f]["vocab"]})
+                ord_of = {v: i for i, v in enumerate(vocab)}
+                starts = np.zeros(ndocs + 1, np.int64)
+                ord_parts, doc_parts = [], []
+                min_ord = np.full(ndocs, -1, np.int32)
+                counts = np.zeros(ndocs, np.int64)
+                for ci, m in enumerate(self._chunks):
+                    km = m["kw"].get(f)
+                    if km is None:
+                        continue
+                    arrs = self._chunk_arrays(ci)
+                    remap = np.fromiter(
+                        (ord_of[v] for v in km["vocab"]), np.int64,
+                        count=len(km["vocab"]))
+                    cords = arrs[f"kw__{km['i']}__ords"]
+                    cdocs = arrs[f"kw__{km['i']}__docs"]
+                    cstarts = arrs[f"kw__{km['i']}__starts"]
+                    cmin = arrs[f"kw__{km['i']}__min_ord"]
+                    base = m["base"]
+                    # monotone remap keeps per-doc ord order + min identity
+                    ord_parts.append(remap[cords].astype(np.int32)
+                                     if len(cords) else
+                                     np.empty(0, np.int32))
+                    doc_parts.append((cdocs + np.int32(base)))
+                    counts[base: base + m["n"]] = np.diff(cstarts)
+                    sl = min_ord[base: base + m["n"]]
+                    sel = cmin >= 0
+                    sl[sel] = remap[cmin[sel]].astype(np.int32)
+                np.cumsum(counts, out=starts[1:])
+                ords = (np.concatenate(ord_parts) if ord_parts
+                        else np.empty(0, np.int32))
+                docs_flat = (np.concatenate(doc_parts) if doc_parts
+                             else np.empty(0, np.int32))
+                keyword_cols[f] = KeywordColumn(f, vocab, starts,
+                                                ords.astype(np.int32),
+                                                docs_flat.astype(np.int32),
+                                                min_ord)
+
+            geo_cols: Dict[str, GeoColumn] = {}
+            for f in sorted({f for m in self._chunks for f in m["geo"]}):
+                lat = np.zeros(ndocs, np.float32)
+                lon = np.zeros(ndocs, np.float32)
+                present = np.zeros(ndocs, bool)
+                for ci, m in enumerate(self._chunks):
+                    if f not in m["geo"]:
+                        continue
+                    fi = m["geo"].index(f)
+                    arrs = self._chunk_arrays(ci)
+                    sl = slice(m["base"], m["base"] + m["n"])
+                    lat[sl] = arrs[f"geo__{fi}__lat"]
+                    lon[sl] = arrs[f"geo__{fi}__lon"]
+                    present[sl] = arrs[f"geo__{fi}__present"]
+                geo_cols[f] = GeoColumn(f, lat, lon, present)
+
+            vector_cols: Dict[str, VectorColumn] = {}
+            for f in sorted({f for m in self._chunks for f in m["vec"]}):
+                dims, sim, method = self._vec_sim[f]
+                values = np.zeros((ndocs, dims), np.float32)
+                present = np.zeros(ndocs, bool)
+                for ci, m in enumerate(self._chunks):
+                    vm = m["vec"].get(f)
+                    if vm is None:
+                        continue
+                    arrs = self._chunk_arrays(ci)
+                    sl = slice(m["base"], m["base"] + m["n"])
+                    values[sl] = arrs[f"vec__{vm['i']}__values"]
+                    present[sl] = arrs[f"vec__{vm['i']}__present"]
+                vector_cols[f] = VectorColumn(f, values, present, sim,
+                                              method=method)
+
+            doc_lens: Dict[str, np.ndarray] = {}
+            for f in sorted({f for m in self._chunks for f in m["dl"]}):
+                dl = np.zeros(ndocs, np.int64)
+                for ci, m in enumerate(self._chunks):
+                    if f not in m["dl"]:
+                        continue
+                    arrs = self._chunk_arrays(ci)
+                    fi = m["dl"].index(f)
+                    dl[m["base"]: m["base"] + m["n"]] = arrs[f"dl__{fi}"]
+                doc_lens[f] = dl
+
+            seq = (np.asarray(seq_nos, dtype=np.int64)
+                   if seq_nos is not None else None)
+            seg = Segment(self.name, ndocs, postings, numeric_cols,
+                          keyword_cols, geo_cols, doc_lens,
+                          self._text_stats, self.ids, self.sources,
+                          seq_nos=seq, vector_cols=vector_cols,
+                          stored_vals=(self._stored if self._any_stored
+                                       else None))
+            if default_codec_version() >= CODEC_V2:
+                seg.build_impacts()
+            seg.term_vectors = None
+            return seg
+        finally:
+            self._cleanup()
+
+    def _cleanup(self) -> None:
+        import shutil
+        for arrs in self._npz_cache.values():
+            try:
+                arrs.close()
+            except Exception:
+                pass
+        self._npz_cache = {}
+        if self._own_dir:
+            shutil.rmtree(self._dir, ignore_errors=True)
+        else:
+            # remove by directory listing, not by self._chunks count: an
+            # aborted build (exception in add/_flush_chunk) may have
+            # spilled more chunk files than _chunks records, and a
+            # persistent engine spill_dir would otherwise retain them
+            # forever (each failed refresh can strand a buffer's worth)
+            for fn in os.listdir(self._dir):
+                if fn.startswith("chunk") and fn.endswith(".npz"):
+                    try:
+                        os.remove(os.path.join(self._dir, fn))
+                    except OSError:
+                        pass
+
+
+def build_segment_streaming(name: str, parsed_docs, mappings: Mappings,
+                            seq_nos: Optional[List[int]] = None,
+                            chunk_docs: int = 8192,
+                            spill_dir: Optional[str] = None,
+                            with_positions: bool = True) -> Segment:
+    """Streaming counterpart of `build_segment` (same output, bounded
+    transient memory): accepts any iterable of parsed docs."""
+    b = StreamingSegmentBuilder(name, mappings, chunk_docs=chunk_docs,
+                                spill_dir=spill_dir,
+                                with_positions=with_positions)
+    try:
+        b.add_many(parsed_docs)
+    except BaseException:
+        # finish() cleans up after itself; a failure BEFORE finish must
+        # too, or a persistent spill_dir (Engine.refresh) strands every
+        # already-spilled chunk of the aborted buffer on disk
+        b._cleanup()
+        raise
+    return b.finish(seq_nos=seq_nos)

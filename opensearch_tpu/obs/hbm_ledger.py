@@ -86,11 +86,11 @@ class Allocation:
     """One live attributed device-memory tenant."""
 
     __slots__ = ("aid", "kind", "nbytes", "segment", "seg_uid", "device",
-                 "label", "charged", "breaker", "live")
+                 "label", "charged", "breaker", "live", "evictor")
 
     def __init__(self, aid: int, kind: str, nbytes: int, segment: str,
                  seg_uid: Optional[int], device: str, label: str,
-                 breaker) -> None:
+                 breaker, evictor=None) -> None:
         self.aid = aid
         self.kind = kind
         self.nbytes = int(nbytes)
@@ -101,6 +101,9 @@ class Allocation:
         self.breaker = breaker        # breaker CHARGED at register time
         self.charged = breaker is not None
         self.live = True
+        # weak callable releasing this tenant's residency under memory
+        # pressure (Segment.evict_device); None = not evictable
+        self.evictor = evictor
 
 
 def _device_key(device) -> str:
@@ -137,6 +140,18 @@ class HBMLedger:
         self.drift_checks = 0
         self.drift_dumps = 0
         self._last_drift_dump = 0.0    # monotonic; rate-limits dumps
+        # LRU-by-segment-plane eviction under pressure: (seg_uid, device)
+        # -> last-touch sequence. Writes are lock-free (GIL-atomic dict
+        # assignment + thread-safe itertools.count) because touch() sits
+        # on every query's device_arrays access.
+        self._touch: Dict[tuple, int] = {}
+        self._touch_seq = itertools.count(1)
+        # live-allocation count per (seg_uid, device) plane group — O(1)
+        # last-alloc detection on release (the alternative, scanning
+        # _allocs, is quadratic over bulk drop_device/close churn) and
+        # the failed-build guard for _touch cleanup
+        self._group_refs: Dict[tuple, int] = {}
+        self.pressure_evictions = 0
 
     # ---------------- wiring ----------------
 
@@ -152,9 +167,64 @@ class HBMLedger:
 
     # ---------------- the write path ----------------
 
+    def touch(self, segment, device=None) -> None:
+        """Record query-time use of one segment's device residency — the
+        recency signal LRU pressure eviction orders by. Lock-free (hot
+        path): GIL-atomic dict write + thread-safe counter."""
+        uid = getattr(segment, "uid", None)
+        if uid is None:
+            return
+        self._touch[(uid, _device_key(device))] = next(self._touch_seq)
+
+    def _evict_lru(self, breaker, exclude_uid) -> bool:
+        """Evict the least-recently-used evictable segment-plane group
+        charged to `breaker` (skipping `exclude_uid`, the tenant being
+        built). Returns True when a group's evictor actually released
+        residency. Caller holds the ledger lock (RLock — the evictor's
+        releases re-enter it). Known coarseness: the victim is chosen
+        per (segment, device) group but Segment.evict_device drops the
+        segment's residency on EVERY device, so on multi-device hosts a
+        pressure event also evicts the segment's other-device planes
+        (and `bytes` below records only the chosen group's share)."""
+        groups: Dict[tuple, list] = {}
+        for a in self._allocs.values():
+            if a.evictor is None or a.breaker is not breaker:
+                continue
+            if a.seg_uid is None or a.seg_uid == exclude_uid:
+                continue
+            groups.setdefault((a.seg_uid, a.device), []).append(a)
+        # oldest-touch first; never-touched groups (built, never queried)
+        # are the coldest of all
+        order = sorted(groups, key=lambda k: (self._touch.get(k, 0), k[0]))
+        for key in order:
+            allocs = groups[key]
+            evictor = None
+            for a in allocs:
+                evictor = a.evictor() if a.evictor is not None else None
+                if evictor is not None:
+                    break
+            if evictor is None:
+                # owner GC'd mid-flight: its finalizers release the bytes
+                continue
+            freed = sum(a.nbytes for a in allocs)
+            if not evictor():
+                continue            # owner busy building: try the next
+            self.pressure_evictions += 1
+            self._touch.pop(key, None)
+            if METRICS.enabled:
+                METRICS.counter("hbm.pressure_evictions").inc()
+            if _fr.RECORDER.enabled:
+                tl = _fr.current()
+                if tl:
+                    _fr.RECORDER.record(
+                        tl, "hbm.evict_pressure", segment=allocs[0].segment,
+                        bytes=freed, device=allocs[0].device)
+            return True
+        return False
+
     def register(self, kind: str, nbytes: int, *, owner=None, segment=None,
                  device=None, label: str = "",
-                 charge: bool = True) -> Allocation:
+                 charge: bool = True, evictor=None) -> Allocation:
         """Record one attributed allocation and derive its breaker charge.
 
         `owner`: when given, a weakref finalizer releases the allocation
@@ -162,10 +232,17 @@ class HBMLedger:
         is idempotent per allocation). `segment` may be a Segment-like
         object (name/uid extracted) or a plain string. `charge=False`
         registers an advisory tenant (tracked, never billed — compiled
-        program footprints whose true HBM cost XLA owns).
+        program footprints whose true HBM cost XLA owns). `evictor`: a
+        bound method (held weakly) that releases this tenant's residency
+        on demand — registrations carrying one become candidates for
+        LRU pressure eviction.
 
-        Raises the breaker's CircuitBreakingException on an over-budget
-        charged registration; nothing is recorded in that case."""
+        An over-budget charged registration first tries to make room by
+        evicting least-recently-used evictable segment planes charged to
+        the same breaker (ROADMAP item 2: a 1M+ doc index must LOAD
+        under a fixed budget, not fail); only when nothing evictable
+        remains does the breaker's CircuitBreakingException propagate —
+        nothing is recorded in that case."""
         seg_name = ""
         seg_uid = None
         if segment is not None:
@@ -176,29 +253,56 @@ class HBMLedger:
                 seg_uid = getattr(segment, "uid", None)
         nbytes = int(nbytes)
         breaker = self._breaker if (charge and nbytes > 0) else None
+        if evictor is not None and not isinstance(evictor, weakref.ref):
+            evictor = (weakref.WeakMethod(evictor)
+                       if hasattr(evictor, "__self__")
+                       else weakref.ref(evictor))
         alloc = Allocation(next(self._aid), kind, nbytes, seg_name, seg_uid,
-                           _device_key(device), label, breaker)
+                           _device_key(device), label, breaker,
+                           evictor=evictor)
         with self._lock:
             if breaker is not None:
-                try:
-                    # charge INSIDE the ledger lock: CircuitBreaker is
-                    # not thread-safe (check-then-act + bare `used +=`),
-                    # and the ledger is its sole mutator — serializing
-                    # here is what makes the breaker↔ledger invariant
-                    # exact under concurrency
-                    breaker.add_estimate(nbytes, label or f"hbm[{kind}]")
-                except Exception:
-                    self.breaker_trips += 1
-                    if METRICS.enabled:
-                        METRICS.counter("hbm.breaker_trips").inc()
-                    if _fr.RECORDER.enabled:
-                        tl = _fr.current()
-                        if tl:
-                            _fr.RECORDER.record(tl, "hbm.breaker_trip",
-                                                tenant=kind, bytes=nbytes,
-                                                label=label)
-                    raise
+                while True:
+                    try:
+                        # charge INSIDE the ledger lock: CircuitBreaker is
+                        # not thread-safe (check-then-act + bare `used +=`),
+                        # and the ledger is its sole mutator — serializing
+                        # here is what makes the breaker↔ledger invariant
+                        # exact under concurrency
+                        breaker.add_estimate(nbytes,
+                                             label or f"hbm[{kind}]")
+                        break
+                    except Exception:
+                        # pressure path: drop the LRU evictable plane and
+                        # retry; give up (and re-raise) when nothing is
+                        # left to evict
+                        if self._evict_lru(breaker, seg_uid):
+                            continue
+                        self.breaker_trips += 1
+                        if METRICS.enabled:
+                            METRICS.counter("hbm.breaker_trips").inc()
+                        if _fr.RECORDER.enabled:
+                            tl = _fr.current()
+                            if tl:
+                                _fr.RECORDER.record(tl, "hbm.breaker_trip",
+                                                    tenant=kind,
+                                                    bytes=nbytes,
+                                                    label=label)
+                        if seg_uid is not None and not self._group_refs.get(
+                                (seg_uid, alloc.device)):
+                            # the build's pre-registration touch
+                            # (Segment.device_arrays) minted a recency
+                            # key for a group that never got an
+                            # allocation — without this, sustained
+                            # nothing-evictable pressure leaks a _touch
+                            # entry per failed build forever (release
+                            # cleanup only fires for groups that lived)
+                            self._touch.pop((seg_uid, alloc.device), None)
+                        raise
             self._allocs[alloc.aid] = alloc
+            if seg_uid is not None:
+                gk = (seg_uid, alloc.device)
+                self._group_refs[gk] = self._group_refs.get(gk, 0) + 1
             self._by_kind[kind] = self._by_kind.get(kind, 0) + nbytes
             self._peak_by_kind[kind] = max(
                 self._peak_by_kind.get(kind, 0), self._by_kind[kind])
@@ -241,6 +345,18 @@ class HBMLedger:
                 self._by_kind.get(alloc.kind, 0) - alloc.nbytes
             self._total -= alloc.nbytes
             self.releases += 1
+            if alloc.seg_uid is not None:
+                gk = (alloc.seg_uid, alloc.device)
+                n = self._group_refs.get(gk, 1) - 1
+                if n <= 0:
+                    # last allocation of this (segment, device) plane
+                    # group: drop its LRU recency key too, or merge/
+                    # refresh churn (every merge mints a new uid) leaks
+                    # _touch entries in the process-singleton forever
+                    self._group_refs.pop(gk, None)
+                    self._touch.pop(gk, None)
+                else:
+                    self._group_refs[gk] = n
             if alloc.breaker is not None:
                 ent = self._charged.get(id(alloc.breaker))
                 if ent is not None:
@@ -295,6 +411,7 @@ class HBMLedger:
                     "registrations": self.registrations,
                     "releases": self.releases,
                     "breaker_trips": self.breaker_trips,
+                    "pressure_evictions": self.pressure_evictions,
                     "tenants": tenants}
 
     def peak_stamp(self) -> dict:
@@ -427,6 +544,9 @@ class HBMLedger:
             self.registrations = 0
             self.releases = 0
             self.breaker_trips = 0
+            self.pressure_evictions = 0
+            self._touch = {}
+            self._group_refs = {}
 
 
 # process-default ledger (one node per process, like TRACER/METRICS)

@@ -5135,8 +5135,9 @@ def _mask_for_key(key, spec, local: dict, mapping: Dict[int, int],
         # use whichever device already hosts this segment (replica copies
         # must not trigger a default-device re-host just for the cache)
         dev_key = None
-        if seg._device_cache and None not in seg._device_cache:
-            dev_key = next(iter(seg._device_cache))
+        dc = seg._device_cache   # snapshot: pressure eviction swaps the dict
+        if dc and None not in dc:
+            dev_key = next(iter(dc))
         # jit against the CANONICAL spec/params so structurally identical
         # filters share one compiled program across requests
         canon = _canon_spec(spec, dict(mapping))

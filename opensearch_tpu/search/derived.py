@@ -152,9 +152,22 @@ def _purge_query_caches(seg, names: List[str]) -> None:
     from . import compiler as C
     from . import fastpath as FP
 
-    seg._device_cache.clear()
-    seg._device_live_dirty.clear()
+    # SWAP, don't clear in place: Segment.device_arrays readers hold a
+    # snapshot reference to the dict and rely on its entries staying put
+    # (same contract as drop_device / pressure eviction). Release the
+    # dropped caches' ledger charges NOW, like drop_device does — the
+    # rebuild registers a fresh set, and stale live charges would read
+    # as ~2x the segment's footprint to the breaker, driving premature
+    # pressure eviction (or trips) of other tenants
+    from ..obs.hbm_ledger import LEDGER
+    seg._device_cache = {}
+    seg._device_live_dirty = {}
     seg.__dict__.pop("_field_device_cache", None)
+    for allocs in seg.__dict__.pop("_hbm_allocs", {}).values():
+        for alloc in allocs:
+            LEDGER.release(alloc)
+    for alloc in seg.__dict__.pop("_field_device_allocs", {}).values():
+        LEDGER.release(alloc)
     C._purge_masks_for_uid(seg.uid)
     FP._purge_filtered_for_uid(seg.uid)
     seg.__dict__.get("_fastpath_filters", {}).clear()

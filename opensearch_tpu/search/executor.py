@@ -50,6 +50,24 @@ class Candidate:
     collapse_key: Any = None      # field-collapse group value (None = null group)
 
 
+def _tie_collect_order(keys: np.ndarray, idx: np.ndarray,
+                       valid: np.ndarray, seg) -> np.ndarray:
+    """Candidate append order for one top-k window: device order
+    normally (the stable shard sort then breaks full-tuple ties by
+    append order == device doc-id order), but on a BP-reordered segment
+    (index/reorder.py) key ties re-break by ARRIVAL rank first, so the
+    served page does not depend on the permuted internal ids — the
+    reorder parity contract. `tie_ranks()` is None everywhere else and
+    this is a plain nonzero."""
+    jj = np.nonzero(valid)[0]
+    f = getattr(seg, "tie_ranks", None)
+    tr = f() if f is not None else None
+    if tr is None or len(jj) == 0:
+        return jj
+    d = np.clip(idx[jj].astype(np.int64), 0, len(tr) - 1)
+    return jj[np.lexsort((tr[d], -keys[jj].astype(np.float64)))]
+
+
 @dataclass
 class ShardQueryResult:
     shard: int
@@ -373,12 +391,22 @@ class ShardSearcher:
                                        shard_ord, sort_specs, rescores,
                                        min_score, is_field_sort, ctx)
                     continue
+            tief = getattr(seg, "tie_ranks", None)
+            tie_aware = tief is not None and tief() is not None
             if sort_specs and sort_specs[0]["field"] == "_script":
                 # script order is host-computed: collect the full segment
                 # window so the host re-sort sees every matching doc
                 k_pad = seg.ndocs_pad
             else:
                 k_pad = min(next_pow2(max(window * oversample, 16)), seg.ndocs_pad)
+                if tie_aware:
+                    # BP-reordered segment: seed the window deep enough
+                    # that a saturated all-distinct extraction already
+                    # holds >= window*oversample strictly-better lanes
+                    # above its deepest key — otherwise the widen loop
+                    # below pays a second launch with zero ties present
+                    k_pad = min(next_pow2(max(window * oversample * 2, 32)),
+                                seg.ndocs_pad)
             params: Dict[str, Any] = {}
             qspec = C.prepare(lroot, seg, ctx, params)
             qc = _qcost.current()
@@ -407,19 +435,49 @@ class ShardSearcher:
                 params["after_key"] = np.float32(
                     _after_key_value(search_after, sort_specs, seg))
             cspec = C.prepare_collapse(collapse, seg, ctx, params)
-            try:
-                out = C.run_segment(qspec, sspec, agg_specs, named_specs, k_pad,
-                                    seg.device_arrays(self.device), params,
-                                    has_after, collapse_spec=cspec)
-            except _ScriptError as e:
-                # device-script trace failures are user errors (HTTP 400)
-                raise dsl.QueryParseError(f"script compile error: {e}")
+            while True:
+                try:
+                    out = C.run_segment(qspec, sspec, agg_specs,
+                                        named_specs, k_pad,
+                                        seg.device_arrays(self.device),
+                                        params, has_after,
+                                        collapse_spec=cspec)
+                except _ScriptError as e:
+                    # device-script trace failures are user errors (HTTP 400)
+                    raise dsl.QueryParseError(f"script compile error: {e}")
+                keys = np.asarray(out["topk_key"])
+                idx = np.asarray(out["topk_idx"])
+                scores = np.asarray(out["topk_scores"])
+                valid = keys > -np.inf
+                if not tie_aware or sort_specs:
+                    # widen only for score sorts: a field sort's primary
+                    # key can tie across most of the segment (enum-like
+                    # fields), where widening would walk k_pad all the
+                    # way to ndocs_pad per query — those ties break by
+                    # the host's full sort tuple downstream, the same
+                    # oversample approximation unreordered segments use
+                    break
+                # BP-reordered segment (index/reorder.py): device top-k
+                # breaks key ties by PERMUTED internal id, so a tie class
+                # cut at the extraction edge may have dropped its
+                # arrival-earliest members — _tie_collect_order can only
+                # re-sort lanes that were extracted. A cut class always
+                # contains the deepest extracted key; it is provably
+                # complete when extraction didn't saturate. Widen until
+                # the page-relevant classes are whole, then drop the
+                # (possibly cut) deepest class — safe once enough
+                # strictly-better candidates cover this segment's
+                # contribution cap (window * oversample).
+                nvalid = int(valid.sum())
+                if nvalid < k_pad or k_pad >= seg.ndocs_pad:
+                    break
+                kmin = keys[valid].min()
+                if int((keys > kmin).sum()) >= window * oversample:
+                    valid &= keys > kmin
+                    break
+                k_pad = min(next_pow2(k_pad * 2), seg.ndocs_pad)
 
             ran_segs.append(seg)
-            keys = np.asarray(out["topk_key"])
-            idx = np.asarray(out["topk_idx"])
-            scores = np.asarray(out["topk_scores"])
-            valid = keys > -np.inf
             result.total += int(out["total"])
             ms = float(out["max_score"])
             if ms > result.max_score:
@@ -436,7 +494,7 @@ class ShardSearcher:
             if rescores:
                 scores = self._apply_rescores(rescores, ctx, seg, idx, valid, scores)
 
-            for j in np.nonzero(valid)[0]:
+            for j in _tie_collect_order(keys, idx, valid, seg):
                 d = int(idx[j])
                 if d >= seg.ndocs:
                     continue
@@ -493,7 +551,7 @@ class ShardSearcher:
         ms = float(out["max_score"])
         if ms > result.max_score:
             result.max_score = ms
-        for j in np.nonzero(valid)[0]:
+        for j in _tie_collect_order(keys, idx, valid, view):
             d = int(idx[j])
             if d < 0 or d >= view.ndocs:
                 continue
@@ -524,7 +582,7 @@ class ShardSearcher:
             result.max_score = ms
         if rescores:
             scores = self._apply_rescores(rescores, ctx, seg, idx, valid, scores)
-        for j in np.nonzero(valid)[0]:
+        for j in _tie_collect_order(keys, idx, valid, seg):
             d = int(idx[j])
             if d < 0 or d >= seg.ndocs:
                 continue

@@ -22,11 +22,12 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..index.segment import Segment, next_pow2
+from ..index.segment import CODEC_V1, CODEC_V2, Segment, next_pow2
 from ..ops import scoring as ops
 from ..ops.pallas_bm25 import (DL_BITS, DL_MAX, HBM_ALIGN, INT_SENTINEL,
                                LANES, REQ_W, TF_MAX, align_csr_rows,
-                               fused_bm25_bool_topk, fused_bm25_topk_tfdl)
+                               fused_bm25_bool_topk, fused_bm25_topk_impact,
+                               fused_bm25_topk_tfdl)
 
 MAX_T = 8            # pow2-padded term slots per query group
 MAX_L = 1 << 16      # per-term VMEM bucket cap (elements)
@@ -76,7 +77,8 @@ STATS = CounterGroup(METRICS, "fastpath", {
     "pure_served": 0, "bool_served": 0, "fallback": 0,
     "pruned_served": 0, "pruned_dview": 0, "pruned_rescued": 0,
     "pruned_rescued2": 0, "pruned_escalated": 0,
-    "shard_view_served": 0})
+    "shard_view_served": 0, "impact_frontier": 0,
+    "reorder_tie_fallback": 0})
 
 # phase-2 rescore instrumentation (surfaced in _nodes/stats and read by
 # scripts/measure_escalation.py): where the candidate-union rescore ran
@@ -205,14 +207,15 @@ class AlignedPostings:
 
     __slots__ = ("starts_rows", "lens", "d_docs", "d_tfdl", "nbytes",
                  "head_starts_rows", "head_lens", "rem_frontiers",
-                 "head_ids", "_full_frontiers", "_head2")
+                 "head_ids", "_full_frontiers", "_head2", "d_imp")
 
     def __init__(self, starts_rows: np.ndarray, lens: np.ndarray,
                  d_docs, d_tfdl, nbytes: int,
                  head_starts_rows: Optional[np.ndarray] = None,
                  head_lens: Optional[np.ndarray] = None,
                  rem_frontiers: Optional[dict] = None,
-                 head_ids: Optional[dict] = None):
+                 head_ids: Optional[dict] = None,
+                 d_imp=None):
         self.starts_rows = starts_rows    # i64[nterms] aligned start / LANES
         self.lens = lens                  # i64[nterms] true posting counts
         self.d_docs = d_docs
@@ -234,6 +237,11 @@ class AlignedPostings:
         # row -> (ids, remainder frontier) of the TIER-2 head (4x deeper,
         # host-only): built lazily on first escalation past tier 1, cached
         self._head2: dict = {}
+        # codec v2 only: the quantized impact plane in the SAME aligned
+        # layout as d_docs (u8/u16 widened to the i32 lane granularity) —
+        # the frontier pass then rides `fused_bm25_topk_impact`, one
+        # multiply per posting, no per-query tf/doclen math
+        self.d_imp = d_imp
 
     def head2(self, pb, dl_col, row: int) -> tuple:
         """Lazy 4x-deeper head for the second escalation rung: top
@@ -377,9 +385,17 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     cat_starts = pb.starts
     cat_docs = pb.doc_ids
     cat_packed = packed
+    # codec v2 (gate: Segment.codec_version, OSL507): carry the quantized
+    # impact plane through the SAME aligned layout (widened to i32 — the
+    # impact kernel's HBM lane granularity) so the frontier pass can ride
+    # `fused_bm25_topk_impact`
+    plane = (pb.impact
+             if getattr(seg, "codec_version", CODEC_V1) >= CODEC_V2
+             else None)
+    cat_imp = (plane.q.astype(np.int32) if plane is not None else None)
     if len(big):
         plane_imp = _plane_impacts(pb)
-        h_docs, h_packed, h_lens = [], [], []
+        h_docs, h_packed, h_lens, h_imp = [], [], [], []
         for r in big:
             a, b = int(pb.starts[r]), int(pb.starts[r + 1])
             keep, rem_fr = _head_select(pb.doc_ids[a:b], tfs[a:b],
@@ -390,10 +406,14 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
             h_docs.append(pb.doc_ids[a:b][keep])
             h_packed.append(packed[a:b][keep])
             h_lens.append(len(keep))
+            if cat_imp is not None:
+                h_imp.append(plane.q[a:b][keep].astype(np.int32))
             rem_frontiers[int(r)] = rem_fr
             head_ids[int(r)] = h_docs[-1]
         cat_docs = np.concatenate([pb.doc_ids] + h_docs)
         cat_packed = np.concatenate([packed] + h_packed)
+        if cat_imp is not None:
+            cat_imp = np.concatenate([cat_imp] + h_imp)
         cat_starts = np.concatenate([
             pb.starts,
             pb.starts[-1] + np.cumsum(np.asarray(h_lens, np.int64))])
@@ -401,9 +421,13 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     # rows align to 128 lanes only; DMA windows align DOWN to the 1024
     # HBM tile and mask the spilled prefix positionally (skip) — the Zipf
     # long tail would otherwise pay up to 1023 pad slots per rare term
-    a_starts, a_docs, a_packed = align_csr_rows(
-        cat_starts, cat_docs, cat_packed, margin=MAX_L, alignment=LANES)
-    nbytes = a_docs.nbytes + a_packed.nbytes
+    extra = (cat_imp,) if cat_imp is not None else ()
+    aligned = align_csr_rows(cat_starts, cat_docs, cat_packed, *extra,
+                             margin=MAX_L, alignment=LANES)
+    a_starts, a_docs, a_packed = aligned[0], aligned[1], aligned[2]
+    a_imp = aligned[3] if cat_imp is not None else None
+    nbytes = a_docs.nbytes + a_packed.nbytes \
+        + (a_imp.nbytes if a_imp is not None else 0)
     from ..obs.hbm_ledger import LEDGER
     LEDGER.register("aligned_postings", nbytes, owner=seg, segment=seg,
                     label=f"fastpath[{seg.name}][{field}]")
@@ -415,7 +439,9 @@ def _build_aligned(seg: Segment, field: str) -> Optional[AlignedPostings]:
     return AlignedPostings(starts_rows[:nterms], lens,
                            jax.device_put(a_docs), jax.device_put(a_packed),
                            nbytes, head_starts_rows, head_lens,
-                           rem_frontiers, head_ids)
+                           rem_frontiers, head_ids,
+                           d_imp=(jax.device_put(a_imp)
+                                  if a_imp is not None else None))
 
 
 def _body_eligible(sort_specs: List[dict], agg_nodes, named_nodes,
@@ -609,7 +635,8 @@ class _VQuery:
 
     __slots__ = ("qi", "T_pad", "L", "rowstarts", "nrows", "lens", "skips",
                  "weights", "msm", "avgdl", "dlo", "dhi", "k1", "b_eff",
-                 "field", "head", "clamped", "miss", "msm_true", "rows")
+                 "field", "head", "clamped", "miss", "msm_true", "rows",
+                 "impact_pass", "eps")
 
     def __init__(self, **kw):
         self.head = False       # streams impact heads instead of full rows
@@ -617,6 +644,9 @@ class _VQuery:
         self.miss = None        # f32[T_pad]: w_t * remainder bound per term
         self.msm_true = 1.0     # real msm (kernel gets 1.0 when clamped)
         self.rows = None        # i64[T_pad] term-dict rows (for rescore)
+        self.impact_pass = False  # frontier pass rides the impact kernel
+        self.eps = 0.0          # per-doc |exact - kernel| bound (impact
+        #                         kernel only; 0.0 = exact f32 kernel)
         for k, v in kw.items():
             setattr(self, k, v)
 
@@ -685,6 +715,28 @@ def _chunk_slots(slots: List[Optional[Tuple[np.ndarray, int]]], ndocs: int,
             return per_chunk
         nchunk *= 2
     return None
+
+
+def _impact_eps(plane, weights: np.ndarray, rows: np.ndarray, k1: float,
+                b_eff: float, avgdl: float) -> float:
+    """Sound per-doc |exact f32 score − impact-kernel score| bound —
+    THE impactpath._error_bound serve margin (one definition: the
+    frontier kernel's verify rungs must certify against exactly the
+    epsilon the XLA impact pass uses, or a future bound fix silently
+    diverges the two ladders)."""
+    from .impactpath import _error_bound
+    return _error_bound(plane, weights, rows, k1, b_eff, avgdl)
+
+
+def impact_frontier_enabled() -> bool:
+    """The codec-v2 frontier-kernel gate: on by default, pinned off via
+    OPENSEARCH_TPU_NO_IMPACT_FRONTIER (ablation / rollback — the dense
+    tf·dl kernel then serves the frontier pass as before the rev).
+    `=0` means "not disabled", matching the `!= "0"` parse every other
+    flag in this module family uses (OPENSEARCH_TPU_REORDER & co.)."""
+    import os
+    return os.environ.get("OPENSEARCH_TPU_NO_IMPACT_FRONTIER", "0") \
+        in ("", "0")
 
 
 def _term_slot(al: AlignedPostings, pb, r: int
@@ -777,6 +829,20 @@ def _prepare_vqueries(seg: Segment, ctx, lts: Sequence, avgdl_cache: dict,
                 vq.miss = miss
                 vq.msm_true = float(lt.msm)
                 vq.rows = rows
+                # codec-v2 frontier kernel: the head pass scores from the
+                # aligned quantized impact plane (fused_bm25_topk_impact,
+                # ONE multiply per posting) and the verify rungs absorb
+                # the kernel epsilon — outputs are candidate partials
+                # either way. Negative boosts void the one-sided error
+                # bound; those stay on the exact tf·dl kernel.
+                plane = getattr(pb, "impact", None)
+                if (plane is not None and al.d_imp is not None
+                        and impact_frontier_enabled()
+                        and not np.any(weights[:nt] < 0)):
+                    vq.impact_pass = True
+                    vq.eps = _impact_eps(plane, weights, rows,
+                                         float(sim.k1), b_eff,
+                                         float(common["avgdl"]))
                 if clamped and vq.msm_true > 1.0:
                     # kernel collects by raw sum; the true msm filter runs
                     # in the exact rescore (a doc matching all terms but
@@ -808,15 +874,22 @@ def _launch_pure_groups_async(seg: Segment,
     per group, and return the pending launches WITHOUT any device sync
     (oslint OSL504) — `_fetch_pure_groups` turns them into host results.
     -> [(gvqs, K_keep, unfetched (scores, docs, totals)), ...]."""
+    tie_aware = _seg_tie_aware(seg)
     groups = {}
     for vqs in vq_lists:
         if vqs is None:
             continue
         for vq in vqs:
-            groups.setdefault((vq.field, vq.T_pad, vq.k1, vq.b_eff),
-                              []).append(vq)
+            # impact-frontier rows compile a DIFFERENT kernel (no
+            # similarity statics), so they group apart from tf·dl rows —
+            # and BECAUSE it takes no statics, (k1, b) must not split
+            # their groups: one launch coalesces rows whose similarity
+            # params differ (k1/b only feed each row's eps + host rescore)
+            key = ((vq.field, vq.T_pad, None, None, True) if vq.impact_pass
+                   else (vq.field, vq.T_pad, vq.k1, vq.b_eff, False))
+            groups.setdefault(key, []).append(vq)
     pending = []
-    for (field, T_pad, k1, b_eff), gvqs in groups.items():
+    for (field, T_pad, k1, b_eff, impact), gvqs in groups.items():
         al = get_aligned(seg, field)
         # ONE launch per group: DMA volume is set by per-term `nrows`, not L,
         # so every row rides the group's max-L variant — launch (and its
@@ -827,9 +900,19 @@ def _launch_pure_groups_async(seg: Segment,
         # just the page window: the verifier's unseen-doc bound uses the
         # deepest kernel partial, and a 10-candidate pool leaves it so
         # high that every realistic multi-term query escalates (the
-        # balanced mid-partial docs the page needs sit at ranks 10..128)
-        K_launch = (LANES if any(v.head and v.clamped for v in gvqs)
+        # balanced mid-partial docs the page needs sit at ranks 10..128).
+        # Impact-kernel rows do the same — their verify certifies seen-
+        # but-lost docs against the deepest (approx + eps) partial.
+        K_launch = (LANES if any(v.head and (v.clamped or v.impact_pass)
+                                 for v in gvqs)
                     else K)
+        if tie_aware:
+            # BP-reordered segment: the kernel breaks score ties by
+            # PERMUTED doc id, so `_assemble` re-breaks them by arrival
+            # rank on host — extract the full lane window so the re-sort
+            # sees past the page boundary (a tie class cut exactly at K
+            # would otherwise keep the wrong member)
+            K_launch = max(K_launch, LANES)
         rowstarts = np.stack([v.rowstarts for v in gvqs])
         nrows = np.stack([v.nrows for v in gvqs])
         lens = np.stack([v.lens for v in gvqs])
@@ -843,6 +926,28 @@ def _launch_pure_groups_async(seg: Segment,
         # served queries by launches to report the coalescing ratio)
         METRICS.counter("fastpath.launches").inc()
         cost = _qc.current()
+        if impact:
+            # frontier pass on the quantized plane: weights fold
+            # idf·boost·scale so the kernel is ONE multiply per posting
+            # (the designated dequant shape, oslint OSL507); no
+            # similarity statics — one compiled (T, L, K) variant serves
+            # every (k1, b). Only codec-v2 segments emit impact_pass rows
+            # (the aligned-layout build consults Segment.codec_version)
+            assert getattr(seg, "codec_version", CODEC_V1) >= CODEC_V2
+            plane = seg.postings[field].impact
+            w_fold = (weights * np.float32(plane.scale)).astype(np.float32)
+            if cost is not None:
+                # the profile `cost` block names the kernel (acceptance:
+                # fused_bm25_topk_impact reachable from the fastpath)
+                cost.note_actual(int(nrows.sum()) * LANES * 8,
+                                 int(lens.sum()), K_launch * len(gvqs),
+                                 path="fused_bm25_topk_impact",
+                                 segment=seg)
+            STATS.inc("impact_frontier", len(gvqs))
+            pending.append((gvqs, K_launch, fused_bm25_topk_impact(
+                al.d_docs, al.d_imp, rowstarts, nrows, lens, skips,
+                w_fold, msm, dlo, dhi, T=T_pad, L=L, K=K_launch)))
+            continue
         if cost is not None:
             # actual bytes moved = the kernel's DMA windows: per term,
             # nrows lane-rows of 8-byte (doc, packed tf·dl) slots;
@@ -857,9 +962,12 @@ def _launch_pure_groups_async(seg: Segment,
     return pending
 
 
-def _fetch_pure_groups(pending: list, K: int) -> dict:
+def _fetch_pure_groups(pending: list, K: int,
+                       tie_aware: bool = False) -> dict:
     """FETCH stage for `_launch_pure_groups_async`:
-    -> id(vq) -> (scores, docs, total, relation)."""
+    -> id(vq) -> (scores, docs, total, relation). `tie_aware` (the
+    launching segment is BP-reordered) keeps every extracted lane so
+    `_assemble`'s arrival-rank re-sort sees the full window."""
     # ONE device->host transfer for ALL groups' outputs: each np.asarray
     # is its own round trip, and on a tunneled host a round trip is
     # ~70ms — per-array fetches would multiply the batch-1 latency floor
@@ -869,7 +977,9 @@ def _fetch_pure_groups(pending: list, K: int) -> dict:
     for (gvqs, K_launch, _), (scores, docs, totals) in zip(pending,
                                                            fetched):
         for j, vq in enumerate(gvqs):
-            keep = K_launch if (vq.head and vq.clamped) else K
+            keep = (K_launch
+                    if (vq.head and (vq.clamped or vq.impact_pass))
+                    or tie_aware else K)
             results[id(vq)] = (scores[j][:keep], docs[j][:keep],
                                int(totals[j][0]), "eq")
     return results
@@ -880,7 +990,7 @@ def _launch_pure_groups(seg: Segment,
                         K: int) -> dict:
     """Synchronous launch+fetch (escalation rungs, host-loop callers)."""
     return _fetch_pure_groups(_launch_pure_groups_async(seg, vq_lists, K),
-                              K)
+                              K, tie_aware=_seg_tie_aware(seg))
 
 
 def _unseen_bound(al: AlignedPostings, pb, dl_col, vq: _VQuery,
@@ -903,7 +1013,10 @@ def _unseen_bound(al: AlignedPostings, pb, dl_col, vq: _VQuery,
     With msm > 1 that argument breaks (the kernel collects with msm
     relaxed to 1, and the host msm filter can drop high-kernel-score
     candidates, pushing theta BELOW partial_k), so the S = {} bound must
-    stay in."""
+    stay in. The IMPACT frontier kernel (vq.eps > 0) breaks it too: its
+    partials live in the quantized domain, so a candidate's exact score
+    no longer dominates its kernel score — callers pass partial_k
+    already inflated by eps, and S = {} stays in."""
     T = len(vq.rows)
     cl = [i for i in range(T) if vq.miss is not None and vq.miss[i] > 0.0]
     # per-term single-posting bounds (lazy frontier, cached on the layout)
@@ -912,7 +1025,7 @@ def _unseen_bound(al: AlignedPostings, pb, dl_col, vq: _VQuery,
         if r >= 0:
             fb[i] = vq.weights[i] * al.full_bound(
                 pb, int(r), vq.k1, vq.b_eff, float(vq.avgdl), dl_col)
-    best = partial_k if vq.msm_true > 1.0 else -np.inf
+    best = partial_k if (vq.msm_true > 1.0 or vq.eps > 0.0) else -np.inf
     for mask in range(1, 1 << len(cl)):
         in_s = [cl[j] for j in range(len(cl)) if mask >> j & 1]
         rem_part = float(sum(vq.miss[i] for i in in_s))
@@ -966,6 +1079,68 @@ def _tie_serves(al: AlignedPostings, vq: _VQuery, theta: float,
     contrib2 = (vq.weights[0] * tfv / (tfv + kfac2)).astype(np.float32)
     ids = np.where(contrib2 < contrib, id_dlmin, id_any)
     return int(ids[att].min()) > int(cand[order[window - 1]])
+
+
+def _seg_tie_aware(seg) -> bool:
+    """True when `seg` is BP-reordered (index/reorder.py): host sorts
+    must re-break score ties by arrival rank, and kernel-verbatim
+    windows cannot be served past an unresolved boundary tie."""
+    f = getattr(seg, "tie_ranks", None)
+    return f is not None and f() is not None
+
+
+def _tie_key(seg, cand: np.ndarray) -> np.ndarray:
+    """Layout-invariant tie-break key for host (score, tie) sorts: the
+    arrival rank on BP-reordered segments (index/reorder.py parity
+    contract — pages must not depend on the permuted internal ids), the
+    doc id everywhere else (identical by construction when doc order IS
+    arrival order, so unreordered segments keep their historical
+    ordering bit for bit)."""
+    f = getattr(seg, "tie_ranks", None)
+    tr = f() if f is not None else None
+    return tr[cand] if tr is not None else cand
+
+
+def _arrival_sort(seg, sc: np.ndarray, dc: np.ndarray):
+    """Re-break a kernel window's score ties by arrival rank (invalid
+    lanes last). Returns (sc, dc, full) — `full` True when every lane is
+    valid, i.e. the extraction saturated and a boundary tie class may
+    extend past its edge. THE one definition shared by every serving
+    rung that re-sorts kernel-verbatim windows (a divergent copy here is
+    a parity hole)."""
+    ok = np.isfinite(sc) & (dc >= 0)
+    key = np.where(ok, _tie_key(seg, np.maximum(dc, 0)),
+                   np.int64(np.iinfo(np.int64).max))
+    order = np.lexsort((key, -sc))
+    return sc[order], dc[order], int(ok.sum()) == len(sc)
+
+
+def _tie_cut_at_edge(sc: np.ndarray, full: bool, K: int) -> bool:
+    """True when the page-boundary tie class reaches the END of a
+    saturated extracted window: an unextracted doc with the same score
+    but earlier arrival may deserve the slot — the caller must decline
+    to a rung that resolves the class exactly."""
+    return full and len(sc) >= K and sc[K - 1] == sc[-1]
+
+
+def _chunk_tie_ambiguous(parts, sc: np.ndarray, dc: np.ndarray,
+                        K: int) -> bool:
+    """Multi-chunk analog of `_tie_cut_at_edge` over the merged window:
+    a FULL chunk window whose deepest lane ties (or beats) the merged
+    page boundary may have cut an arrival-earlier tie member at its own
+    extraction edge (unextracted chunk docs score <= its deepest lane,
+    so a strictly lower deepest lane proves the chunk complete above the
+    boundary)."""
+    if len(sc) < K or not np.isfinite(sc[K - 1]) or int(dc[K - 1]) < 0:
+        return False
+    boundary = float(sc[K - 1])
+    for p in parts:
+        psc, pdc = p[0], p[1]
+        pok = np.isfinite(psc) & (pdc >= 0)
+        if len(psc) and int(pok.sum()) == len(psc) \
+                and float(psc[-1]) >= boundary:
+            return True
+    return False
 
 
 def _exact_rescore(seg: Segment, vq: _VQuery, cand: np.ndarray
@@ -1076,13 +1251,14 @@ def _p2_candidates(vq: _VQuery, pb, ids_of) -> Optional[np.ndarray]:
 
 def _p2_decide(al: AlignedPostings, vq: _VQuery, cand: np.ndarray,
                exact: np.ndarray, counts: np.ndarray, window: int, K: int,
-               frontier_of) -> Optional[tuple]:
+               frontier_of, tie: Optional[np.ndarray] = None
+               ) -> Optional[tuple]:
     """Serve-or-escalate decision on exact-rescored candidates: certify the
     window against the dl-consistent `_noheads_bound` or return None."""
     pass_msm = counts >= vq.msm_true
     n_pass = int(pass_msm.sum())
     exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
-    order = np.lexsort((cand, -exact_m))
+    order = np.lexsort((cand if tie is None else tie, -exact_m))
     theta = (float(exact_m[order[window - 1]]) if n_pass >= window
              else -np.inf)
     bound = _noheads_bound(al, vq, frontier_of)
@@ -1233,7 +1409,8 @@ def _phase2_batch(seg: Segment, vq_lists, specs: Sequence, results: dict,
                                                _rescore_many(seg, jobs)):
         al = get_aligned(seg, vq.field)
         ver = _p2_decide(al, vq, cand, exact, counts,
-                         int(specs[qi].window or K), K, None)
+                         int(specs[qi].window or K), K, None,
+                         tie=_tie_key(seg, cand))
         if ver is not None:
             results[id(vq)] = ver
             STATS.inc("pruned_rescued")
@@ -1261,7 +1438,8 @@ def _phase2_batch(seg: Segment, vq_lists, specs: Sequence, results: dict,
                          int(specs[qi].window or K), K,
                          lambda row, _h2=h2, _al=al:
                          _h2[row][1] if row in _h2
-                         else _al.rem_frontiers.get(row))
+                         else _al.rem_frontiers.get(row),
+                         tie=_tie_key(seg, cand))
         if ver is not None:
             results[id(vq)] = ver
             STATS.inc("pruned_rescued")
@@ -1397,19 +1575,38 @@ def _dview_rescue_field(seg: Segment, ctx, lts: Sequence, specs: Sequence,
     if dlists is None:
         return redo
     vres = _launch_pure_groups(view, dlists, K)
+    tie_aware = _seg_tie_aware(seg)
     still = []
     for qi, dvqs in zip(redo, dlists):
         served = False
+        ambiguous = False
         if dvqs is not None:
             if len(dvqs) == 1:
                 sc, dc, total, _ = vres[id(dvqs[0])]
+                if tie_aware:
+                    # reordered segment: re-break the device window's
+                    # score ties in arrival order (view docs are
+                    # original ids, so the parent plane applies) —
+                    # decline on a boundary tie at the extraction edge:
+                    # this rung serves into `exact_ids`, so nothing
+                    # downstream would re-check
+                    sc, dc, full = _arrival_sort(seg, sc, dc)
+                    ambiguous = _tie_cut_at_edge(sc, full, K)
             else:
                 parts = [vres[id(v)] for v in dvqs]
                 sc = np.concatenate([p[0] for p in parts])
                 dc = np.concatenate([p[1] for p in parts])
                 total = sum(p[2] for p in parts)
-                order = np.lexsort((dc, -sc))[:K]
+                ok = dc >= 0
+                key = np.where(ok, _tie_key(seg, np.maximum(dc, 0)),
+                               np.int64(np.iinfo(np.int64).max))
+                order = np.lexsort((key, -sc))[:K]
                 sc, dc = sc[order], dc[order]
+                if tie_aware:
+                    ambiguous = _chunk_tie_ambiguous(parts, sc, dc, K)
+            if ambiguous:
+                STATS.inc("reorder_tie_fallback")
+        if dvqs is not None and not ambiguous:
             valid = np.isfinite(sc) & (dc >= 0)
             window = int(specs[qi].window or K)
             theta = (float(sc[valid][window - 1])
@@ -1453,10 +1650,14 @@ def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
     exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
     # the unseen-doc in-head bound: the DEEPEST kernel partial. Zero when
     # the kernel window wasn't full — then every head-matched doc is
-    # already a candidate and an unseen doc has no in-head part at all
-    partial_k = float(sc[valid][-1]) if len(cand) == len(sc) else 0.0
+    # already a candidate and an unseen doc has no in-head part at all.
+    # Impact-kernel partials are quantized-domain: + eps lifts them to a
+    # sound exact-domain bound (eps == 0.0 on the tf·dl kernel)
+    partial_k = (float(sc[valid][-1]) + vq.eps
+                 if len(cand) == len(sc) else 0.0)
     bound = _unseen_bound(al, pb, dl, vq, partial_k)
-    order = np.lexsort((cand, -exact_m))
+    tie = _tie_key(seg, cand)
+    order = np.lexsort((tie, -exact_m))
     theta = (float(exact_m[order[window - 1]]) if n_pass >= window
              else -np.inf)
     # >= not >: the frontier bounds are ATTAINED by real docs, so an unseen
@@ -1464,9 +1665,14 @@ def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
     # doc-id tie-break — equality must escalate to the dense pass, UNLESS
     # the tie witness below proves every attaining doc sorts after the
     # window boundary (single-term case: score quantization makes boundary
-    # ties the COMMON case, and escalating on them re-runs dense every time)
+    # ties the COMMON case, and escalating on them re-runs dense every
+    # time). The witness argument needs the EXACT kernel domain, so
+    # impact-frontier passes (eps > 0) always escalate on a tie; so do
+    # reordered segments (tie is the ARRIVAL rank there, and the frontier
+    # id witness only bounds the permuted-id order).
     if bound >= theta:
-        if not _tie_serves(al, vq, theta, cand, order, window):
+        if (vq.eps > 0.0 or tie is not cand
+                or not _tie_serves(al, vq, theta, cand, order, window)):
             return None
     keep = order[pass_msm[order]][:K]
     sc2 = np.full(K, -np.inf, np.float32)
@@ -1475,6 +1681,43 @@ def _verify_pruned(seg: Segment, vq: _VQuery, sc: np.ndarray, dc: np.ndarray,
     dc2[: len(keep)] = cand[keep]
     total_out = n_pass if vq.msm_true > 1 else total
     return (sc2, dc2, total_out, "gte")
+
+
+def _verify_impact_exact(seg: Segment, vq: _VQuery, sc: np.ndarray,
+                         dc: np.ndarray, total: int, window: int, K: int
+                         ) -> Optional[tuple]:
+    """Certify an UNCLAMPED impact-kernel frontier pass (heads were the
+    full rows, so the kernel saw EVERY posting — but its partials live in
+    the quantized domain and cannot serve directly). Candidates are
+    exact-rescored; when the kernel window wasn't full the candidate set
+    is every matching doc and the page is exact by construction;
+    otherwise a seen-but-lost doc carries kernel partial <= the deepest
+    extracted value, so exact <= that + eps — certify it under theta or
+    escalate. Totals are exact either way (the kernel counts every
+    matching doc)."""
+    valid = np.isfinite(sc) & (dc >= 0)
+    cand = dc[valid].astype(np.int64)
+    if len(cand) == 0:
+        return (sc[:K], dc[:K], total, "eq")    # truly empty result set
+    exact, counts = _exact_rescore(seg, vq, cand)
+    pass_msm = counts >= vq.msm_true
+    n_pass = int(pass_msm.sum())
+    exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
+    order = np.lexsort((_tie_key(seg, cand), -exact_m))
+    if len(cand) == len(sc):
+        theta = (float(exact_m[order[window - 1]]) if n_pass >= window
+                 else -np.inf)
+        bound = float(sc[valid][-1]) + vq.eps
+        # equality escalates: a lost doc's exact score can tie theta and
+        # would deserve the slot under the doc-id tie-break
+        if bound >= theta:
+            return None
+    keep = order[pass_msm[order]][:K]
+    sc2 = np.full(K, -np.inf, np.float32)
+    dc2 = np.full(K, -1, np.int32)
+    sc2[: len(keep)] = exact_m[keep]
+    dc2[: len(keep)] = cand[keep]
+    return (sc2, dc2, total, "eq")
 
 
 def _launch_pure(seg: Segment, ctx, lts: Sequence,
@@ -1501,23 +1744,38 @@ def _finish_pure(seg: Segment, ctx, lts: Sequence,
     their own follow-up device work synchronously — only the hard tail
     pays a sync here) and final assembly."""
     vq_lists, pending = state
-    results = _fetch_pure_groups(pending, K)
+    results = _fetch_pure_groups(pending, K,
+                                 tie_aware=_seg_tie_aware(seg))
     redo = []
+    # id(vq) whose served entry the verify/rescue rungs produced in exact
+    # arrival order — _assemble's reorder tie handling skips these
+    exact_ids = set()
     with TRACER.span("fastpath.verify"), METRICS.timer("fastpath.verify"):
         for qi, vqs in enumerate(vq_lists):
             if vqs is None or len(vqs) != 1 or not vqs[0].head:
                 continue
             vq = vqs[0]
-            if not vq.clamped:
+            if not vq.clamped and not vq.impact_pass:
                 continue                # heads were the full rows: exact
             sc, dc, total, _ = results[id(vq)]
-            ver = _verify_pruned(seg, vq, sc, dc, total,
-                                 int(specs[qi].window or K), K)
+            if vq.clamped:
+                ver = _verify_pruned(seg, vq, sc, dc, total,
+                                     int(specs[qi].window or K), K)
+            else:
+                # impact kernel over full rows: exact-rescore + certify
+                # against (deepest approx partial + eps)
+                ver = _verify_impact_exact(seg, vq, sc, dc, total,
+                                           int(specs[qi].window or K), K)
             if ver is None:
                 redo.append(qi)
             else:
                 results[id(vq)] = ver
-    rescued = 0
+                exact_ids.add(id(vq))
+    # rescued CLAMPED queries only: `pruned_served` below counts clamped
+    # heads, so rescued impact-frontier (unclamped) queries must not be
+    # subtracted from it — they were never in its base (the counter is
+    # monotonic; an unmatched subtraction drives it negative)
+    rescued_clamped = 0
     if redo:
         # middle rung: the candidate-union rescore for ALL failed queries,
         # batched into as few device launches as their shape buckets allow
@@ -1530,8 +1788,13 @@ def _finish_pure(seg: Segment, ctx, lts: Sequence,
         with TRACER.span("fastpath.phase2_rescore", queries=n_redo,
                          mode=rescore_mode()), \
                 METRICS.timer("fastpath.phase2_rescore"):
+            before = redo
             redo = _phase2_batch(seg, vq_lists, specs, results, redo, K)
-        rescued += n_redo - len(redo)
+        for qi in set(before) - set(redo):
+            vq = vq_lists[qi][0]
+            exact_ids.add(id(vq))
+            if vq.clamped:
+                rescued_clamped += 1
     if redo:
         # last rung before dense: ONE batched exact launch over the
         # quality-tier view (~1/8 the postings). Only the hard tail pays
@@ -1543,9 +1806,14 @@ def _finish_pure(seg: Segment, ctx, lts: Sequence,
                                 rung="quality_tier", queries=n_redo)
         with TRACER.span("fastpath.quality_tier", queries=n_redo), \
                 METRICS.timer("fastpath.quality_tier"):
+            before = redo
             redo = _dview_rescue(seg, ctx, lts, specs, vq_lists, results,
                                  redo, K)
-        rescued += n_redo - len(redo)
+        for qi in set(before) - set(redo):
+            vq = vq_lists[qi][0]
+            exact_ids.add(id(vq))
+            if vq.clamped:
+                rescued_clamped += 1
     if redo:
         STATS.inc("pruned_escalated", len(redo))
         if _fr.RECORDER.enabled and _fr.current():
@@ -1563,8 +1831,8 @@ def _finish_pure(seg: Segment, ctx, lts: Sequence,
     STATS.inc("pruned_served", sum(
         1 for vqs in vq_lists
         if vqs is not None and len(vqs) == 1 and vqs[0].head
-        and vqs[0].clamped) - rescued)
-    return _assemble(vq_lists, results, K)
+        and vqs[0].clamped) - rescued_clamped)
+    return _assemble(vq_lists, results, K, seg=seg, exact_ids=exact_ids)
 
 
 def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
@@ -1579,11 +1847,15 @@ def _run_pure(seg: Segment, ctx, lts: Sequence, specs: Sequence[FastSpec],
     return _finish_pure(seg, ctx, lts, specs, K, state)
 
 
-def _assemble(vq_lists, results: dict, K: int, transform=None
-              ) -> List[Optional[dict]]:
+def _assemble(vq_lists, results: dict, K: int, transform=None,
+              seg=None, exact_ids=frozenset()) -> List[Optional[dict]]:
     """Reassemble per-query outputs from per-kernel-row results (chunked
     queries merge their chunk top-Ks on host; stable merge: score desc,
-    doc asc on ties, matching the kernel)."""
+    doc asc on ties, matching the kernel — arrival-rank ties on
+    reordered segments when `seg` is passed). `exact_ids`: id(vq) of
+    entries the verify/rescue rungs already produced in exact arrival
+    order (they skip the reorder tie handling)."""
+    tie_aware = seg is not None and _seg_tie_aware(seg)
     out: List[Optional[dict]] = []
     for qi, vqs in enumerate(vq_lists):
         if vqs is None:
@@ -1595,14 +1867,40 @@ def _assemble(vq_lists, results: dict, K: int, transform=None
             sc, dc, total = entry[0], entry[1], entry[2]
             if len(entry) > 3:
                 rel = entry[3]
+            if tie_aware and id(vqs[0]) not in exact_ids:
+                # kernel-verbatim window on a BP-reordered segment: the
+                # kernel broke score ties by PERMUTED id — re-break by
+                # arrival rank (reorder parity contract). The deep
+                # K_launch extraction (tie_aware launch) makes this sort
+                # see past the page boundary. Entries the verify/rescue
+                # rungs produced (`exact_ids`) are already arrival-
+                # ordered exact pages and skip this.
+                sc, dc, full = _arrival_sort(seg, sc, dc)
+                if _tie_cut_at_edge(sc, full, K):
+                    # decline: the general path widens its extraction
+                    # window until the boundary class is whole
+                    STATS.inc("reorder_tie_fallback")
+                    out.append(None)
+                    continue
+                sc, dc = sc[:K], dc[:K]
         else:
             parts = [results[id(v)] for v in vqs]
             sc_all = np.concatenate([p[0] for p in parts])
             dc_all = np.concatenate([p[1] for p in parts])
             total = sum(p[2] for p in parts)
-            order = np.lexsort((dc_all, -sc_all))[:K]
+            if seg is not None:
+                key = np.where(dc_all >= 0,
+                               _tie_key(seg, np.maximum(dc_all, 0)),
+                               np.int64(np.iinfo(np.int64).max))
+            else:
+                key = dc_all
+            order = np.lexsort((key, -sc_all))[:K]
             sc = sc_all[order]
             dc = dc_all[order]
+            if tie_aware and _chunk_tie_ambiguous(parts, sc, dc, K):
+                STATS.inc("reorder_tie_fallback")
+                out.append(None)
+                continue
         if transform is not None:
             sc = transform(qi, sc)
         total_i = int(total)
@@ -1824,6 +2122,9 @@ class FilteredSegView:
             starts=fp.starts.astype(np.int64), doc_ids=fp.host_docs,
             tfs=fp.host_tfs)}
         self.doc_lens = seg.doc_lens
+        # doc ids are original, so the parent's arrival tie ranks apply
+        # verbatim (reorder parity: ties must not break on permuted ids)
+        self.tie_ranks = seg.tie_ranks
 
 
 def _filtered_view(seg: Segment, field: str, fp: "FilteredPostings",
@@ -2003,6 +2304,10 @@ def _launch_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
     """LAUNCH stage of the bool/filtered path: one kernel enqueue per
     shape group, no device sync. Returns state for `_finish_bool`."""
     vq_lists = _prepare_bool_vqueries(seg, ctx, specs, {})
+    # BP-reordered segment: extract the full lane window so _assemble's
+    # arrival-rank re-sort sees past the page boundary (reorder parity —
+    # the kernel's own tie order is the permuted id)
+    K_extract = max(K, LANES) if _seg_tie_aware(seg) else K
     groups = {}
     for vqs in vq_lists:
         if vqs is None:
@@ -2035,17 +2340,17 @@ def _launch_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
         cost = _qc.current()
         if cost is not None:
             cost.note_actual(int(nrows.sum()) * LANES * 8,
-                             int(lens.sum()), K * len(gvqs),
+                             int(lens.sum()), K_extract * len(gvqs),
                              path="kernel_bool")
         pending.append((gvqs, fused_bm25_bool_topk(
             d_docs, d_tfdl, filt, rowstarts, nrows, lens, skips, weights,
-            cw, thresh, avg, dlo, dhi, TS=TS, L=L, K=K, k1=k1, b=b_eff,
-            filtered=filtered)))
+            cw, thresh, avg, dlo, dhi, TS=TS, L=L, K=K_extract, k1=k1,
+            b=b_eff, filtered=filtered)))
     return (vq_lists, pending)
 
 
-def _finish_bool(specs: Sequence[FastSpec], K: int, state: tuple
-                 ) -> List[Optional[dict]]:
+def _finish_bool(specs: Sequence[FastSpec], K: int, state: tuple,
+                 seg=None) -> List[Optional[dict]]:
     """FETCH stage of the bool/filtered path: one transfer for all
     groups, then boost/const-score transform and assembly."""
     vq_lists, pending = state
@@ -2054,8 +2359,10 @@ def _finish_bool(specs: Sequence[FastSpec], K: int, state: tuple
     results = {}
     for (gvqs, _), (scores, docs, totals) in zip(pending, fetched):
         for j, vq in enumerate(gvqs):
-            results[id(vq)] = (scores[j][:K], docs[j][:K],
-                               int(totals[j][0]))
+            # keep every extracted lane (K on plain segments, the deep
+            # K_extract window on reordered ones — _assemble cuts to K
+            # after its arrival-rank re-sort)
+            results[id(vq)] = (scores[j], docs[j], int(totals[j][0]))
 
     def transform(qi, sc):
         spec = specs[qi]
@@ -2066,12 +2373,13 @@ def _finish_bool(specs: Sequence[FastSpec], K: int, state: tuple
             return np.where(finite, sc * np.float32(spec.boost), -np.inf)
         return sc
 
-    return _assemble(vq_lists, results, K, transform)
+    return _assemble(vq_lists, results, K, transform, seg=seg)
 
 
 def _run_bool(seg: Segment, ctx, specs: Sequence[FastSpec], K: int
               ) -> List[Optional[dict]]:
-    return _finish_bool(specs, K, _launch_bool(seg, ctx, specs, K))
+    return _finish_bool(specs, K, _launch_bool(seg, ctx, specs, K),
+                        seg=seg)
 
 
 def segment_search(seg: Segment, ctx, spec: FastSpec, k: int
@@ -2137,6 +2445,26 @@ class ShardView:
         vi = int(np.searchsorted(self.seg_bases, view_doc, "right") - 1)
         return (self.seg_ords[vi], self.segments[vi],
                 int(view_doc - self.seg_bases[vi]))
+
+    def tie_ranks(self) -> Optional[np.ndarray]:
+        """Concatenated arrival tie ranks over the member segments, or
+        None when no member is reordered. Members sit in engine creation
+        order with disjoint ascending seq ranges, so base + member-rank
+        is the view-global arrival rank."""
+        if "_tie_rank" not in self.__dict__:
+            per = [s.tie_ranks() for s in self.segments]
+            if all(p is None for p in per):
+                self.__dict__["_tie_rank"] = None
+            else:
+                parts = []
+                for s, p, base in zip(self.segments, per, self.seg_bases):
+                    local = (p if p is not None
+                             else np.arange(s.ndocs, dtype=np.int64))
+                    parts.append(int(base) + local)
+                self.__dict__["_tie_rank"] = (
+                    np.concatenate(parts) if parts
+                    else np.zeros(0, np.int64))
+        return self.__dict__["_tie_rank"]
 
 
 def shard_view(searcher) -> Optional[ShardView]:

@@ -160,9 +160,150 @@ def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
 # margin prunes more and leans harder on phase 2.
 PRUNE_MARGIN = 0.5
 
+# ---------------------------------------------------------------------
+# doc-range (live-block) pruning — the plan equal-idf multi-term
+# queries need, and the one BP doc-id reordering (index/reorder.py)
+# feeds. The per-term cut above is structurally blind to them: with T
+# equal weights, τ = PRUNE_MARGIN·θ̂/T sits BELOW the smallest possible
+# posting impact (tf=1 at dl_max still lands ~0.3·max), so no block of
+# any term can ever price under it. The doc-space cut works on the SUM:
+# partition doc ids into 2^DOC_RANGE_SHIFT-doc ranges, upper-bound every
+# range at Σ_t w_t·scale·max_q(t, range), and prune ranges that cannot
+# reach RANGE_MARGIN·θ̂. Soundness: a doc in a pruned range scores
+# ≤ bound(range) + Σ_t w_t·eps ≤ rem, and the existing certificate /
+# phase-2 machinery consumes that rem unchanged; a doc in a KEPT range
+# is fully gathered (every posting of it lies in a 128-posting block
+# that intersects its kept range, and blocks are kept per intersection),
+# so the seen-but-lost analysis is also unchanged. On an arrival-order
+# corpus every block spans nearly the whole doc space and intersects
+# some kept range — nothing skips, which is why this plan only fires
+# after the merge-time reorder clusters each term's impact mass into
+# narrow doc runs (the classic BMW/live-block force-multiplier).
+# Per-row range maxima are query-independent and cached on the plane.
+DOC_RANGE_SHIFT = 7          # 128-doc ranges (the BP leaf granularity)
+RANGE_MARGIN = 0.99          # prune ranges priced under 0.99·θ̂: the 1%
+#                              keep-band is certify headroom — rem lands
+#                              ≤ 0.99·θ̂ + eps, strictly under θ, so the
+#                              phase-1 certificate holds with room for E
+#                              (the probe witness keeps θ̂ within ~eps of
+#                              the real boundary, so the band is real)
+PROBE_TOP = 32               # top postings per row feeding the probe-doc
+#                              witness (sound multi-term θ̂ sharpener)
+
+
+def _probe_witness(pb, plane, act_rows, act_w, window: int,
+                   eps_sum: float) -> float:
+    """Sharper sound θ̂ for multi-term queries: take each row's top
+    PROBE_TOP postings by quantized impact (REAL docs), sum each probe
+    doc's approx score across ALL queried rows, and witness the
+    window-th highest minus the summed error. The single-term kth
+    witness only ever sees one row; when query terms co-occur the true
+    boundary sits near the SUM and this witness finds it — which is
+    what lets the doc-range cut price single-term ranges out."""
+    if window > PROBE_TOP:
+        return 0.0
+    docs_l = []
+    for row in act_rows:
+        cache = plane.__dict__.setdefault("_probe_top", {})
+        got = cache.get(row)
+        if got is None:
+            a, b = pb.row_slice(row)
+            qs = plane.q[a:b]
+            m = min(PROBE_TOP, b - a)
+            sel = np.argpartition(qs, b - a - m)[b - a - m:] if b - a > m \
+                else np.arange(b - a)
+            got = pb.doc_ids[a:b][sel].astype(np.int64)
+            if len(cache) >= (1 << 15):
+                cache.clear()   # <=PROBE_TOP i64 per row; hard cap ~8MB
+            cache[row] = got
+        docs_l.append(got)
+    probe = np.unique(np.concatenate(docs_l))
+    if len(probe) < window:
+        return 0.0
+    approx = np.zeros(len(probe), np.float64)
+    scale = float(plane.scale)
+    for row, w in zip(act_rows, act_w):
+        a, b = pb.row_slice(row)
+        rowdocs = pb.doc_ids[a:b]
+        pos = np.searchsorted(rowdocs, probe)
+        pos_c = np.minimum(pos, b - a - 1)
+        found = rowdocs[pos_c] == probe
+        approx += np.where(found,
+                           w * scale * plane.q[a:b][pos_c].astype(
+                               np.float64), 0.0)
+    kth = float(np.partition(approx, len(approx) - window)
+                [len(approx) - window])
+    return kth - eps_sum
+
+
+_RANGE_MAX_CACHE_BYTES = 1 << 25    # 32MB per plane, then start over
+
+
+def _row_range_max(pb, plane, row: int, shift: int):
+    """(range_ids i64[R], max_q[R]) of one row — max quantized impact
+    per touched doc range; cached (query-independent). Entries are
+    O(touched ranges) arrays (~9 B/range — a 1M-doc stopword row is
+    ~70KB), so the cache is byte-capped, not entry-capped: a long-lived
+    node serving a wide vocabulary must not accumulate host memory
+    proportional to every row ever queried."""
+    cache = plane.__dict__.setdefault("_range_max", {})
+    got = cache.get(row)
+    if got is None:
+        a, b = pb.row_slice(row)
+        docs = pb.doc_ids[a:b]
+        buck = (docs >> shift).astype(np.int64)
+        head = np.flatnonzero(np.diff(buck)) + 1
+        idx = np.concatenate(([np.int64(0)], head))
+        maxq = np.maximum.reduceat(plane.q[a:b], idx) if b > a \
+            else np.zeros(0, plane.q.dtype)
+        got = (buck[idx] if b > a else np.zeros(0, np.int64), maxq)
+        nb = int(got[0].nbytes) + int(got[1].nbytes)
+        used = plane.__dict__.get("_range_max_bytes", 0)
+        if used + nb > _RANGE_MAX_CACHE_BYTES:
+            cache.clear()       # benign to race: values are deterministic
+            used = 0
+        plane.__dict__["_range_max_bytes"] = used + nb
+        cache[row] = got
+    return got
+
+
+def _range_plan(pb, plane, act_rows, act_w, offs, lens,
+                theta_hat: float, eps: float, ndocs: int):
+    """Doc-range plan over the active rows' blocks. Returns
+    (keep_mask bool[nblocks], rem) or None when the cut keeps everything
+    (or prices itself out)."""
+    if ndocs <= 0 or theta_hat <= 0.0:
+        return None
+    shift = DOC_RANGE_SHIFT
+    nb = ((ndocs - 1) >> shift) + 1
+    bound = np.zeros(nb, np.float64)
+    scale = float(plane.scale)
+    eps_sum = 0.0
+    for row, w in zip(act_rows, act_w):
+        bids, maxq = _row_range_max(pb, plane, row, shift)
+        bound[bids] += w * scale * maxq.astype(np.float64)
+        eps_sum += w * eps
+    tau_r = RANGE_MARGIN * theta_hat - eps_sum
+    if tau_r <= 0.0:
+        return None
+    kept_r = bound >= tau_r
+    if kept_r.all():
+        return None
+    # block kept iff its doc span intersects any kept range
+    cum = np.zeros(nb + 1, np.int64)
+    np.cumsum(kept_r, out=cum[1:])
+    first = pb.doc_ids[offs].astype(np.int64) >> shift
+    last = pb.doc_ids[offs + lens.astype(np.int64) - 1].astype(
+        np.int64) >> shift
+    keep_b = (cum[last + 1] - cum[first]) > 0
+    pruned_b = bound[~kept_r]
+    rem = float(pruned_b.max() + eps_sum) if len(pruned_b) else 0.0
+    return keep_b, rem
+
 
 def _plan_blocks(pb, plane, rows: np.ndarray, weights: np.ndarray,
-                 C: int, prune: bool, window: int, eps: float):
+                 C: int, prune: bool, window: int, eps: float,
+                 ndocs: int = 0):
     """Select the gathered block set. Returns (bstart i64[NB], blen
     i32[NB], bweight f32[NB], kept_postings, rem_bound, n_total_blocks,
     total_postings) — bweight folds w_t·scale so the device does ONE
@@ -238,7 +379,11 @@ def _plan_blocks(pb, plane, rows: np.ndarray, weights: np.ndarray,
             if kth_q is None:
                 kth_q = float(np.partition(plane.q[a:b], b - a - window)
                               [b - a - window])
-                kcache[(r, window)] = kth_q
+                if len(kcache) >= (1 << 16):
+                    kcache.clear()      # scalar entries; hard cap ~6MB
+                # one float per (row, window), never an ndocs-scale
+                # array, and the cap above bounds the dict itself
+                kcache[(r, window)] = kth_q  # oslint: disable=OSL301
             wit = float(dequant_impact_np(
                 np.float32(kth_q), w_i * float(plane.scale)))
             theta_hat = max(theta_hat, wit - w_i * eps)
@@ -246,6 +391,12 @@ def _plan_blocks(pb, plane, rows: np.ndarray, weights: np.ndarray,
             kth = float(np.partition(bm_v, len(bm_v) - window)
                         [len(bm_v) - window])
             theta_hat = max(theta_hat, kth - w_i * eps)
+    # probe-doc witness: real docs' summed approx scores — sharpens θ̂
+    # past the single-term kth when query terms co-occur
+    eps_sum = float(sum(act_w)) * eps
+    theta_hat = max(theta_hat,
+                    _probe_witness(pb, plane, act_rows, act_w, window,
+                                   eps_sum))
     if theta_hat <= 0.0:
         return offs, lens, bw, total_post, 0.0, nblocks, total_post
     tau = PRUNE_MARGIN * theta_hat / max(n_active, 1)
@@ -258,18 +409,35 @@ def _plan_blocks(pb, plane, rows: np.ndarray, weights: np.ndarray,
         cum = kept_post + np.cumsum(lens[order])
         back = int(np.searchsorted(cum, keep_min, side="left")) + 1
         prune_mask[order[:back]] = False
-    kept = np.nonzero(~prune_mask)[0]
-    pruned_idx = np.nonzero(prune_mask)[0]
+        kept_post = int(lens[~prune_mask].sum())
     rem = 0.0
-    if len(pruned_idx):
+    if prune_mask.any():
         # per-term max pruned block value, summed — the sound bound on
         # any doc's missing (never-gathered) contribution
         T = int(rows.shape[0])
+        pruned_idx = np.nonzero(prune_mask)[0]
         per_term = np.zeros(T, np.float64)
         np.maximum.at(per_term, terms[pruned_idx],
                       vals[pruned_idx].astype(np.float64))
         rem = float(per_term.sum())
-    return (offs[kept], lens[kept], bw[kept], int(lens[kept].sum()),
+
+    # doc-range plan (the equal-idf multi-term cut): compete against the
+    # per-term plan and take whichever ships fewer postings — on a
+    # BP-reordered segment the range cut usually wins multi-term shapes
+    # outright, on arrival-order corpora it keeps everything and the
+    # per-term plan stands
+    if n_active >= 1:
+        rp = _range_plan(pb, plane, act_rows, act_w, offs, lens,
+                         theta_hat, eps, ndocs)
+        if rp is not None:
+            keep_b, rem_r = rp
+            kept_post_r = int(lens[keep_b].sum())
+            if kept_post_r >= keep_min and kept_post_r < kept_post:
+                kept = np.nonzero(keep_b)[0]
+                return (offs[kept], lens[kept], bw[kept], kept_post_r,
+                        rem_r, nblocks, total_post)
+    kept = np.nonzero(~prune_mask)[0]
+    return (offs[kept], lens[kept], bw[kept], kept_post,
             rem, nblocks, total_post)
 
 
@@ -368,7 +536,8 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
     eps_imp = plane.quant_err() + plane.drift_bound(float(sim.k1), b_eff,
                                                     avgdlq)
     offs, lens, bw, kept_post, rem, nblocks, total_post = _plan_blocks(
-        pb, plane, rows, weights, Ccand, spec.prune_ok, window, eps_imp)
+        pb, plane, rows, weights, Ccand, spec.prune_ok, window, eps_imp,
+        ndocs=seg.ndocs)
     pruned = rem > 0.0 or kept_post < total_post
     STATS.inc("blocks_total", nblocks)
     STATS.inc("blocks_skipped", nblocks - len(offs))
@@ -428,7 +597,10 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
     pass_msm = counts >= msm
     exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
     n_pass = int(pass_msm.sum())
-    order = np.lexsort((cand, -exact_m))
+    # score ties break on the layout-invariant arrival rank (== doc id
+    # on unreordered segments): the BP reorder parity contract
+    tr = seg.tie_ranks()
+    order = np.lexsort((cand if tr is None else tr[cand], -exact_m))
     theta = (float(exact_m[order[window - 1]]) if n_pass >= window
              else -np.inf)
     E = _error_bound(plane, weights, rows, float(sim.k1), b_eff, avgdlq)
@@ -473,7 +645,8 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
             pass2 = counts2 >= msm
             exact2_m = np.where(pass2, exact2, -np.inf).astype(np.float32)
             n2 = int(pass2.sum())
-            order2 = np.lexsort((union, -exact2_m))
+            order2 = np.lexsort((union if tr is None else tr[union],
+                                 -exact2_m))
             theta2 = (float(exact2_m[order2[window - 1]])
                       if n2 >= window else -np.inf)
             # + E: the remainder is a quantized-domain price; the true

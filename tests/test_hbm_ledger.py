@@ -69,6 +69,12 @@ class TestLedgerCore:
         """32 threads register/release concurrently; the final balance is
         exactly zero on both the ledger side and the derived breaker."""
         NT, PER = 32, 100
+        # other test modules may legitimately keep segments (and their
+        # cached filtered-postings tenants) alive in module globals —
+        # assert this hammer's own balance, not a global absolute zero,
+        # so the test doesn't depend on file execution order
+        base = LEDGER.snapshot()["tenants"].get("filtered_postings",
+                                                {}).get("bytes", 0)
         errs = []
 
         def worker(tid):
@@ -97,7 +103,7 @@ class TestLedgerCore:
         assert scratch_breaker.used == 0
         snap = LEDGER.snapshot()
         assert snap["tenants"].get("filtered_postings",
-                                   {}).get("bytes", 0) == 0
+                                   {}).get("bytes", 0) == base
         assert not LEDGER.verify_breakers()
 
     def test_weakref_finalize_releases_exactly_once(self, scratch_breaker):
@@ -387,3 +393,176 @@ class TestHbmReport:
         assert rep["ledger"]["total_bytes"] > 0
         assert rep["per_query_costs"] and \
             rep["per_query_costs"][0]["actual_bytes_gathered"] > 0
+
+
+class TestPressureEviction:
+    """ROADMAP item 2: loading past the HBM budget must EVICT the
+    least-recently-used segment planes and succeed, not fail — a 1M+ doc
+    index's residency is budget-bounded, not load-bounded."""
+
+    def _mk(self, name, n=300):
+        from opensearch_tpu.index.mappings import Mappings
+        from opensearch_tpu.index.segment import build_segment
+        m = Mappings({"properties": {"body": {"type": "text"}}})
+        docs = [m.parse(f"{name}{i}", {"body": "alpha beta gamma delta"})
+                for i in range(n)]
+        return build_segment(name, docs, m)
+
+    @staticmethod
+    def _one_bytes(s):
+        """One segment's full device footprint, measured as a ledger
+        DELTA: earlier tests' segments may still be resident (charged to
+        their own nodes' breakers), so the absolute total would inflate
+        the eviction budget and the breaker would never trip."""
+        gc.collect()               # flush pending weakref releases first
+        before = LEDGER.total_bytes()
+        s.device_arrays()
+        one = LEDGER.total_bytes() - before
+        s.drop_device()
+        return one
+
+    def test_load_past_budget_evicts_lru_and_succeeds(self):
+        s1, s2, s3 = self._mk("ev_a"), self._mk("ev_b"), self._mk("ev_c")
+        one = self._one_bytes(s1)
+        old = LEDGER.breaker
+        br = CircuitBreaker("evict-test", int(one * 2.5))
+        LEDGER.set_breaker(br)
+        try:
+            base_ev = LEDGER.pressure_evictions
+            s1.device_arrays()
+            s2.device_arrays()          # both fit
+            # regression: this used to raise CircuitBreakingException —
+            # now the LRU plane group (s1: loaded first, never re-used)
+            # is evicted and the load proceeds
+            s3.device_arrays()
+            assert LEDGER.pressure_evictions == base_ev + 1
+            assert not s1._device_cache          # the LRU victim
+            assert s2._device_cache and s3._device_cache
+            # the evicted segment transparently rebuilds on next use
+            # (evicting the new LRU, s2)
+            s1.device_arrays()
+            assert LEDGER.pressure_evictions == base_ev + 2
+            assert not s2._device_cache
+            assert not LEDGER.verify_breakers()
+        finally:
+            LEDGER.set_breaker(old)
+            for s in (s1, s2, s3):
+                s.drop_device()
+
+    def test_recency_touch_orders_victims(self):
+        s1, s2, s3 = self._mk("tr_a"), self._mk("tr_b"), self._mk("tr_c")
+        one = self._one_bytes(s1)
+        old = LEDGER.breaker
+        br = CircuitBreaker("touch-test", int(one * 2.5))
+        LEDGER.set_breaker(br)
+        try:
+            s1.device_arrays()
+            s2.device_arrays()
+            s1.device_arrays()          # touch s1: s2 becomes LRU
+            s3.device_arrays()
+            assert s1._device_cache and not s2._device_cache
+        finally:
+            LEDGER.set_breaker(old)
+            for s in (s1, s2, s3):
+                s.drop_device()
+
+    def test_eviction_skips_segment_mid_build(self):
+        s1, s2 = self._mk("mb_a"), self._mk("mb_b")
+        one = self._one_bytes(s1)
+        old = LEDGER.breaker
+        br = CircuitBreaker("busy-test", int(one * 1.5))
+        LEDGER.set_breaker(br)
+        try:
+            s1.device_arrays()
+            # hold s1's build lock: the evictor must refuse it and, with
+            # nothing else evictable, the breaker exception propagates
+            lock = s1.__dict__["_device_build_lock"]
+            assert lock.acquire(blocking=False)
+            try:
+                with pytest.raises(CircuitBreakingException):
+                    s2.device_arrays()
+            finally:
+                lock.release()
+            # lock released: the same load now evicts s1 and succeeds
+            s2.device_arrays()
+            assert not s1._device_cache and s2._device_cache
+            assert not LEDGER.verify_breakers()
+        finally:
+            LEDGER.set_breaker(old)
+            for s in (s1, s2):
+                s.drop_device()
+
+    def test_evict_pressure_event_on_recorder_timeline(self):
+        from opensearch_tpu.obs import flight_recorder as fr
+        s1, s2 = self._mk("rc_a"), self._mk("rc_b")
+        one = self._one_bytes(s1)
+        old = LEDGER.breaker
+        br = CircuitBreaker("rec-test", int(one * 1.5))
+        LEDGER.set_breaker(br)
+        was_enabled = fr.RECORDER.enabled
+        fr.RECORDER.enabled = True
+        tl = fr.RECORDER.start("search", test="evict")
+        tok = fr.set_current(tl)
+        try:
+            s1.device_arrays()
+            s2.device_arrays()
+            events = [e for e in fr.RECORDER.timeline_events(tl)
+                      if e.get("kind") == "hbm.evict_pressure"]
+            assert events and events[0]["segment"] == "rc_a"
+            assert events[0]["bytes"] > 0
+        finally:
+            fr.reset_current(tok)
+            fr.RECORDER.enabled = was_enabled
+            LEDGER.set_breaker(old)
+            for s in (s1, s2):
+                s.drop_device()
+
+
+class TestTouchCleanup:
+    """Code-review regression: `_touch` recency keys must not outlive
+    their (segment, device) plane group — merge/refresh churn mints a new
+    uid per merge, so retained keys leak in the process singleton."""
+
+    def _mk(self, name, n=120):
+        from opensearch_tpu.index.mappings import Mappings
+        from opensearch_tpu.index.segment import build_segment
+        m = Mappings({"properties": {"body": {"type": "text"}}})
+        docs = [m.parse(f"{name}{i}", {"body": "alpha beta gamma"})
+                for i in range(n)]
+        return build_segment(name, docs, m)
+
+    def test_drop_device_removes_touch_key(self):
+        s = self._mk("tk_a")
+        s.device_arrays()
+        key = (s.uid, "default")
+        assert any(k[0] == s.uid for k in LEDGER._touch)
+        s.drop_device()
+        gc.collect()        # flush any weakref finalizer releases
+        assert not any(k[0] == s.uid for k in LEDGER._touch), key
+
+    def test_gc_of_segment_removes_touch_key(self):
+        s = self._mk("tk_b")
+        s.device_arrays()
+        uid = s.uid
+        del s
+        gc.collect()
+        assert not any(k[0] == uid for k in LEDGER._touch)
+
+    def test_failed_build_cleans_touch_key(self, scratch_breaker):
+        """A build that trips the breaker with nothing evictable never
+        registered an allocation, so the release-side cleanup can't fire
+        — the register failure path must drop the pre-build touch key or
+        sustained pressure leaks one entry per failed build (code-review
+        regression)."""
+        from opensearch_tpu.utils.breaker import (CircuitBreaker,
+                                                  CircuitBreakingException)
+        tiny = CircuitBreaker("tiny", 1)       # nothing fits, nothing to evict
+        old = LEDGER.breaker
+        LEDGER.set_breaker(tiny)
+        try:
+            s = self._mk("tk_fail")
+            with pytest.raises(CircuitBreakingException):
+                s.device_arrays()
+            assert not any(k[0] == s.uid for k in LEDGER._touch)
+        finally:
+            LEDGER.set_breaker(old)

@@ -1116,6 +1116,87 @@ class TestImpactDomain:
         assert rules_of(lint(src)) == []
 
 
+class TestScorePlaneRules:
+    """OSL601 — per-doc score-plane materialization discipline."""
+
+    def test_osl601_host_ndocs_float_plane(self):
+        src = """
+            import numpy as np
+
+            def collect(seg):
+                scores = np.zeros(seg.ndocs, np.float32)
+                return scores
+        """
+        assert "OSL601" in rules_of(lint(src))
+
+    def test_osl601_default_dtype_is_float(self):
+        src = """
+            import numpy as np
+
+            def collect(ndocs_pad):
+                return np.full(ndocs_pad, -np.inf)
+        """
+        assert "OSL601" in rules_of(lint(src))
+
+    def test_osl601_quiet_on_bool_and_int_masks(self):
+        src = """
+            import numpy as np
+
+            def masks(seg, ndocs):
+                live = np.zeros(seg.ndocs, dtype=bool)
+                ords = np.full(ndocs, -1, np.int32)
+                return live, ords
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl601_quiet_on_candidate_scale(self):
+        src = """
+            import numpy as np
+
+            def rescore(cand):
+                return np.zeros(len(cand), np.float32)
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl601_quiet_on_jnp_device_plane(self):
+        # traced jnp planes are DEVICE scatter targets inside one launch
+        # (the frontier-program domain compiler.py emit functions build)
+        src = """
+            import jax.numpy as jnp
+
+            def emit(ndocs_pad):
+                return jnp.zeros(ndocs_pad, jnp.float32)
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl601_out_of_scope_quiet(self):
+        src = """
+            import numpy as np
+
+            def plane(ndocs):
+                return np.zeros(ndocs, np.float32)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/index/segment.py")) == []
+
+    def test_osl601_suppression(self):
+        src = """
+            import numpy as np
+
+            def tier(seg):
+                best = np.zeros(seg.ndocs, np.float32)  # oslint: disable=OSL601 -- built once per segment behind QUALITY_MIN_NDOCS
+                return best
+        """
+        assert rules_of(lint(src)) == []
+
+    def test_osl601_repo_serving_paths_baselined(self):
+        # the live findings in search/ are all justified baseline entries;
+        # anything new fails test_repo_has_no_unbaselined_findings
+        bl = load_baseline(BASELINE)
+        osl601 = [e for e in bl.entries if e["rule"] == "OSL601"]
+        assert osl601, "OSL601 baseline entries expected"
+        assert all(e.get("reason") for e in osl601)
+
+
 class TestSuppressionAndBaseline:
     SRC = """
         def doc_count(fagg, bi):

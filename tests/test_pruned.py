@@ -59,13 +59,55 @@ def sim_fused_bm25_topk_tfdl(d_docs, d_tfdl, rowstarts, nrows, lens, skips,
     return out_s, out_d, out_t
 
 
+def sim_fused_bm25_topk_impact(d_docs, d_imp, rowstarts, nrows, lens,
+                               skips, weights, msm, dlo, dhi, T, L, K):
+    """Numpy reference of the codec-v2 impact frontier kernel
+    (fused_bm25_topk_impact): one multiply per posting over the aligned
+    quantized plane, msm counting, top-K by (approx desc, doc asc) —
+    the v2 frontier rung these corpora now take by default (ISSUE 11)."""
+    docs_a = np.asarray(d_docs).ravel()
+    imp_a = np.asarray(d_imp).ravel()
+    QB = rowstarts.shape[0]
+    out_s = np.full((QB, 128), -np.inf, np.float32)
+    out_d = np.full((QB, 128), -1, np.int32)
+    out_t = np.zeros((QB, 128), np.int32)
+    for q in range(QB):
+        scores: dict = {}
+        counts: dict = {}
+        for t in range(T):
+            ln = int(lens[q, t])
+            if ln == 0:
+                continue
+            base = int(rowstarts[q, t]) * LANES + int(skips[q, t])
+            w = float(weights[q, t])
+            dd = docs_a[base: base + ln]
+            ii = imp_a[base: base + ln]
+            sel = (dd >= dlo[q, 0]) & (dd < dhi[q, 0])
+            for d, v in zip(dd[sel], ii[sel]):
+                d = int(d)
+                scores[d] = scores.get(d, 0.0) + w * float(v)
+                counts[d] = counts.get(d, 0) + 1
+        passing = [(s, d) for d, s in scores.items()
+                   if counts[d] >= msm[q, 0]]
+        out_t[q, :] = len(passing)
+        passing.sort(key=lambda sd: (-sd[0], sd[1]))
+        for j, (s, d) in enumerate(passing[:K]):
+            out_s[q, j] = np.float32(s)
+            out_d[q, j] = d
+    return out_s, out_d, out_t
+
+
 @pytest.fixture()
 def small_head(monkeypatch):
     """Shrink L_HEAD so a 5k-doc corpus exercises clamping, and stand the
-    simulator in for the TPU kernel."""
+    simulators in for the TPU kernels (both frontier variants: the v2
+    impact kernel serves codec-v2 segments by default, the tf·dl kernel
+    serves v1 / negative-boost shapes)."""
     monkeypatch.setattr(fastpath, "L_HEAD", 64)
     monkeypatch.setattr(fastpath, "fused_bm25_topk_tfdl",
                         sim_fused_bm25_topk_tfdl)
+    monkeypatch.setattr(fastpath, "fused_bm25_topk_impact",
+                        sim_fused_bm25_topk_impact)
     monkeypatch.setattr(fastpath, "_backend_ok", True)
 
 
@@ -240,6 +282,8 @@ class TestFilteredPure:
         monkeypatch.setattr(fastpath, "L_HEAD", 64)
         monkeypatch.setattr(fastpath, "fused_bm25_topk_tfdl",
                             sim_fused_bm25_topk_tfdl)
+        monkeypatch.setattr(fastpath, "fused_bm25_topk_impact",
+                            sim_fused_bm25_topk_impact)
         monkeypatch.setattr(fastpath, "_backend_ok", True)
         monkeypatch.setattr(fastpath, "_MATERIALIZE_MIN_DOCS", 16)
         # skip the warm-up hop through the (TPU-only) bool kernel: treat
@@ -409,6 +453,8 @@ class TestQualityView:
         monkeypatch.setattr(fastpath, "L_HEAD", 64)
         monkeypatch.setattr(fastpath, "fused_bm25_topk_tfdl",
                             sim_fused_bm25_topk_tfdl)
+        monkeypatch.setattr(fastpath, "fused_bm25_topk_impact",
+                            sim_fused_bm25_topk_impact)
         monkeypatch.setattr(fastpath, "_backend_ok", True)
         monkeypatch.setattr(fastpath, "QUALITY_MIN_NDOCS", 2048)
         rng = np.random.default_rng(21)

@@ -1,0 +1,350 @@
+"""BP-style impact-clustered doc-id reordering (index/reorder.py).
+
+The standing contract: reordering is INVISIBLE to every consumer — the
+same corpus indexed with and without the permutation serves identical
+top-k pages (scores AND `_id`s), across refresh and across replica
+failover; only the internal doc-id layout (and therefore the block-max
+sidecar skew) changes. Plus unit coverage for the permutation itself:
+valid permutation, every per-doc plane threads through, impacts carried
+with recomputed sidecars, determinism."""
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index import reorder as R
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.rest.client import RestClient
+
+WORDS = [f"w{i:03d}" for i in range(120)]
+
+
+def _docs(n, seed=0):
+    """Corpus with dl spread wide enough that window-boundary scores are
+    distinct — the parity assertion compares pages byte-for-byte, and a
+    boundary TIE breaks by internal doc id, which is exactly what the
+    permutation changes."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        k = int(rng.integers(3, 40))
+        toks = [WORDS[int(t) % 120] for t in rng.zipf(1.25, k)]
+        out.append({"body": " ".join(toks),
+                    "status": ["a", "b", "c"][i % 3],
+                    "price": int(rng.integers(0, 1000))})
+    return out
+
+
+MAP = {"properties": {"body": {"type": "text"},
+                      "status": {"type": "keyword"},
+                      "price": {"type": "integer"}}}
+
+
+def _page(client, index, body, probe):
+    r = client.search(index, dict(body, _probe=probe))
+    return (r["hits"]["total"]["value"],
+            [(h["_id"], h["_score"]) for h in r["hits"]["hits"]])
+
+
+QUERIES = [
+    {"query": {"match": {"body": "w001 w004"}}, "size": 10},
+    {"query": {"match": {"body": "w000"}}, "size": 10},
+    {"query": {"bool": {"must": [{"match": {"body": "w002 w005 w009"}}],
+                        "filter": [{"term": {"status": "a"}}]}},
+     "size": 10},
+    {"query": {"range": {"price": {"gte": 100, "lt": 700}}},
+     "sort": [{"price": "asc"}, {"_id": "asc"}], "size": 10},
+]
+
+
+class TestPermutationUnit:
+    @pytest.fixture(scope="class")
+    def seg(self):
+        m = Mappings(MAP)
+        eng = Engine(m)
+        for i, src in enumerate(_docs(3000, seed=2)):
+            eng.index_doc(f"d{i}", src)
+        eng.refresh()
+        eng.force_merge(1)
+        return eng.segments[0]
+
+    def test_permutation_is_valid_and_deterministic(self, seg):
+        p1 = R.compute_permutation(seg, leaf=64)
+        p2 = R.compute_permutation(seg, leaf=64)
+        assert p1 is not None
+        assert np.array_equal(np.sort(p1), np.arange(seg.ndocs))
+        assert np.array_equal(p1, p2)
+        # a permutation that actually moves docs (not identity)
+        assert not np.array_equal(p1, np.arange(seg.ndocs))
+
+    def test_apply_threads_every_plane(self, seg):
+        perm = R.compute_permutation(seg, leaf=64)
+        out = R.apply_permutation(seg, perm)
+        old2new = np.empty(seg.ndocs, np.int64)
+        old2new[perm] = np.arange(seg.ndocs)
+        # ids / sources / seq_nos / doc values follow the permutation
+        for new in (0, 7, 1234, seg.ndocs - 1):
+            old = int(perm[new])
+            assert out.ids[new] == seg.ids[old]
+            assert out.sources[new] == seg.sources[old]
+            assert out.seq_nos[new] == seg.seq_nos[old]
+            assert out.numeric_cols["price"].values[new] \
+                == seg.numeric_cols["price"].values[old]
+            assert out.keyword_cols["status"].min_ord[new] \
+                == seg.keyword_cols["status"].min_ord[old]
+            assert out.doc_lens["body"][new] == seg.doc_lens["body"][old]
+        # postings: every row stays doc-ascending, same (term -> doc set)
+        pa, pb = seg.postings["body"], out.postings["body"]
+        assert np.array_equal(pa.starts, pb.starts)
+        for r in range(0, pb.nterms, 17):
+            a, b = pb.row_slice(r)
+            row = pb.doc_ids[a:b]
+            assert np.all(np.diff(row) > 0)
+            assert np.array_equal(np.sort(old2new[pa.doc_ids[a:b]]), row)
+        # impacts: same quantized multiset per row, sidecar recomputed
+        ia, ib = pa.impact, pb.impact
+        assert ia.scale == ib.scale and ia.bits == ib.bits
+        assert np.array_equal(np.sort(ia.q), np.sort(ib.q))
+        if len(ib.block_off):
+            assert np.array_equal(
+                ib.block_max, np.maximum.reduceat(ib.q, ib.block_off))
+
+    def test_skip_gates(self, seg, monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER", "0")
+        assert R.maybe_reorder(seg) is seg
+        monkeypatch.delenv("OPENSEARCH_TPU_REORDER")
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "100000")
+        assert R.maybe_reorder(seg) is seg
+        # v1 segments never reorder
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "16")
+        import copy
+        v1 = copy.copy(seg)
+        v1.codec_version = 1
+        assert R.maybe_reorder(v1) is v1
+
+    @staticmethod
+    def _reorder_degenerate_seq_nos(seg):
+        """Reorder a copy of `seg` whose seq_nos carry no order (the
+        direct-CSR corpora default — bench make_index)."""
+        import copy
+        z = copy.copy(seg)
+        z.__dict__ = dict(seg.__dict__)
+        z.__dict__.pop("_tie_rank", None)
+        z.seq_nos = np.zeros(seg.ndocs, np.int64)
+        assert z.tie_ranks() is None         # heuristic alone is blind
+        perm = R.compute_permutation(z, leaf=64)
+        return R.apply_permutation(z, perm), perm
+
+    def test_tie_plane_pinned_without_seq_nos(self, seg):
+        """Zero seq_nos blind Segment.tie_ranks's monotonicity heuristic
+        — apply_permutation must pin the arrival-rank plane explicitly
+        or the reordered arm silently loses the whole tie-parity
+        machinery (code-review regression)."""
+        out, perm = self._reorder_degenerate_seq_nos(seg)
+        tr = out.tie_ranks()
+        assert tr is not None
+        # source doc order WAS arrival order, so the permuted plane is
+        # exactly the permutation (arrival rank of new doc = its old id)
+        assert np.array_equal(tr, np.asarray(perm, np.int64))
+
+    def test_pinned_tie_plane_survives_save_load(self, seg, tmp_path):
+        """Degenerate seq_nos can't recover the pinned plane after a
+        reload — save() must persist it (code-review regression)."""
+        out, _ = self._reorder_degenerate_seq_nos(seg)
+        from opensearch_tpu.index.segment import Segment
+        d = str(tmp_path / "zseg")
+        out.save(d)
+        back = Segment.load(d)
+        tr2 = back.tie_ranks()
+        assert tr2 is not None and np.array_equal(tr2, out.tie_ranks())
+
+    def test_noop_pass_marks_reordered(self, seg, monkeypatch):
+        """An applicable segment whose signature band is empty must still
+        be marked: engine.force_merge's lone-segment gate would otherwise
+        re-run a full single-segment merge on every call (code-review
+        regression)."""
+        import copy
+        s = copy.copy(seg)
+        s.__dict__ = dict(seg.__dict__)
+        s.__dict__.pop("_reordered", None)
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "16")
+        monkeypatch.setattr(R, "compute_permutation", lambda *a, **k: None)
+        assert R.maybe_reorder(s) is s
+        assert s.__dict__.get("_reordered")
+
+    def test_reordered_marker_survives_save_load(self, seg, tmp_path):
+        """After flush/restart the first force_merge must not re-merge an
+        already-clustered segment: the marker rides the codec meta."""
+        from opensearch_tpu.index.segment import Segment
+        perm = R.compute_permutation(seg, leaf=64)
+        out = R.apply_permutation(seg, perm)
+        out.__dict__["_reordered"] = True
+        d = str(tmp_path / "seg")
+        out.save(d)
+        back = Segment.load(d)
+        assert back.__dict__.get("_reordered")
+        # the reloaded permuted seq_nos keep the tie plane armed too
+        assert back.tie_ranks() is not None
+
+    def test_merge_drives_reorder(self, monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "256")
+        m = Mappings(MAP)
+        eng = Engine(m)
+        for i, src in enumerate(_docs(900, seed=4)):
+            eng.index_doc(f"d{i}", src)
+            if i % 300 == 299:
+                eng.refresh()
+        eng.refresh()
+        eng.force_merge(1)
+        merged = eng.segments[0]
+        assert merged.__dict__.get("_reordered")
+        # version map re-anchored: realtime get serves the right doc
+        got = eng.get("d123")
+        assert got["found"] and got["_source"] == _docs(900, seed=4)[123]
+
+
+class TestServingParityOracle:
+    """Same corpus, two indices: reorder ON vs OFF. Every served page —
+    scores and _ids — must be byte-identical, across refresh rounds."""
+
+    @pytest.fixture()
+    def pair(self, monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "256")
+        client = RestClient()
+        docs = _docs(1200, seed=9)
+        for name, flag in (("ron", "1"), ("roff", "0")):
+            monkeypatch.setenv("OPENSEARCH_TPU_REORDER", flag)
+            client.indices.create(name, {
+                "settings": {"number_of_replicas": 0},
+                "mappings": MAP})
+            for i, src in enumerate(docs[:900]):
+                client.index(name, src, id=f"d{i}")
+            client.indices.refresh(name)
+            client.indices.forcemerge(name)
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER", "1")
+        return client, docs
+
+    def test_pages_identical_and_reorder_engaged(self, pair, monkeypatch):
+        client, docs = pair
+        ron = client.node.indices["ron"].shards[0].segments
+        assert any(s.__dict__.get("_reordered") for s in ron)
+        for qi, q in enumerate(QUERIES):
+            a = _page(client, "ron", q, f"p{qi}a")
+            b = _page(client, "roff", q, f"p{qi}b")
+            assert a == b, (qi, a, b)
+
+    def test_parity_across_second_merge_with_ties(self, monkeypatch):
+        """A merge that CONSUMES a reordered segment places it in the
+        concatenation in permuted order — merge_segments must thread the
+        inputs' arrival planes through (code-review regression) or
+        exact-score ties in the merged segment break differently from
+        the unreordered arm's merge of the same corpus."""
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "256")
+        client = RestClient()
+        rng = np.random.default_rng(13)
+        docs = []
+        for i in range(1200):
+            if i % 3 == 0:
+                docs.append({"body": "tie alpha beta"})  # big tie class
+            else:
+                k = int(rng.integers(3, 30))
+                docs.append({"body": " ".join(WORDS[int(t) % 120]
+                                              for t in rng.zipf(1.3, k))})
+        for name, flag in (("m2on", "1"), ("m2off", "0")):
+            monkeypatch.setenv("OPENSEARCH_TPU_REORDER", flag)
+            client.indices.create(name, {
+                "settings": {"number_of_replicas": 0}, "mappings": MAP})
+            for i, src in enumerate(docs[:800]):
+                client.index(name, src, id=f"d{i}")
+            client.indices.refresh(name)
+            client.indices.forcemerge(name)       # reorder applies (on arm)
+            for i, src in enumerate(docs[800:]):
+                client.index(name, src, id=f"d{800 + i}")
+            client.indices.refresh(name)
+            client.indices.forcemerge(name)       # merge CONSUMES it
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER", "1")
+        segs = client.node.indices["m2on"].shards[0].segments
+        assert len(segs) == 1 and segs[0].tie_ranks() is not None
+        for qi, q in enumerate(["tie", "tie alpha", "alpha beta"]):
+            body = {"query": {"match": {"body": q}}, "size": 10}
+            a = _page(client, "m2on", body, f"m2{qi}a")
+            b = _page(client, "m2off", body, f"m2{qi}b")
+            assert a == b, (q, a, b)
+
+    def test_boundary_tie_class_parity_general_path(self, monkeypatch):
+        """A bigger-than-k_pad exact-score tie class straddling the page
+        boundary, served by the GENERAL (XLA) path: device top-k breaks
+        ties by permuted internal id on the reordered arm, so the
+        executor must widen its extraction window until the class is
+        whole (code-review regression — the fastpath DECLINES boundary
+        ties to this path assuming it resolves them exactly)."""
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "256")
+        client = RestClient()
+        rng = np.random.default_rng(11)
+        docs = []
+        for i in range(1200):
+            if i % 4 == 0:
+                # ~300 docs with identical body: one exact-score tie
+                # class far wider than the k_pad=16 device window
+                docs.append({"body": "tie alpha beta"})
+            else:
+                k = int(rng.integers(3, 30))
+                docs.append({"body": " ".join(WORDS[int(t) % 120]
+                                              for t in rng.zipf(1.3, k))})
+        for name, flag in (("tron", "1"), ("troff", "0")):
+            monkeypatch.setenv("OPENSEARCH_TPU_REORDER", flag)
+            client.indices.create(name, {
+                "settings": {"number_of_replicas": 0}, "mappings": MAP})
+            for i, src in enumerate(docs):
+                client.index(name, src, id=f"d{i}")
+            client.indices.refresh(name)
+            client.indices.forcemerge(name)
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER", "1")
+        assert any(s.__dict__.get("_reordered")
+                   for s in client.node.indices["tron"].shards[0].segments)
+        for qi, q in enumerate(["tie", "tie alpha", "alpha beta"]):
+            body = {"query": {"match": {"body": q}}, "size": 10}
+            a = _page(client, "tron", body, f"bt{qi}a")
+            b = _page(client, "troff", body, f"bt{qi}b")
+            assert a == b, (q, a, b)
+
+    def test_parity_across_refresh(self, pair, monkeypatch):
+        client, docs = pair
+        # a second indexing round + refresh on both arms (reorder state
+        # per-arm preserved via the env the fixture leaves at "1": the
+        # roff arm is re-pinned off per write round)
+        for name, flag in (("ron", "1"), ("roff", "0")):
+            monkeypatch.setenv("OPENSEARCH_TPU_REORDER", flag)
+            for i, src in enumerate(docs[900:]):
+                client.index(name, src, id=f"d{900 + i}")
+            client.indices.refresh(name)
+        for qi, q in enumerate(QUERIES):
+            a = _page(client, "ron", q, f"r{qi}a")
+            b = _page(client, "roff", q, f"r{qi}b")
+            assert a == b, (qi, a, b)
+
+
+class TestReplicaFailoverParity:
+    def test_failover_serves_identical_pages_on_reordered_index(
+            self, monkeypatch):
+        """Replica copies of a reordered index stay byte-identical: after
+        primary failover the promoted replica serves the same pages."""
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER_MIN_DOCS", "256")
+        monkeypatch.setenv("OPENSEARCH_TPU_REORDER", "1")
+        client = RestClient()
+        client.indices.create("rf", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+            "mappings": MAP})
+        for i, src in enumerate(_docs(800, seed=6)):
+            client.index("rf", src, id=f"d{i}")
+        client.indices.refresh("rf")
+        client.indices.forcemerge("rf")
+        svc = client.node.indices["rf"]
+        assert any(s.__dict__.get("_reordered")
+                   for s in svc.shards[0].segments)
+        before = [_page(client, "rf", q, f"f{qi}a")
+                  for qi, q in enumerate(QUERIES)]
+        svc.fail_primary(0)
+        after = [_page(client, "rf", q, f"f{qi}b")
+                 for qi, q in enumerate(QUERIES)]
+        assert before == after

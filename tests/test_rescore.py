@@ -21,7 +21,8 @@ from opensearch_tpu.search import compiler as C
 from opensearch_tpu.search import fastpath
 from opensearch_tpu.search import query_dsl as dsl
 from opensearch_tpu.search.executor import ShardSearcher
-from tests.test_pruned import sim_fused_bm25_topk_tfdl
+from tests.test_pruned import (sim_fused_bm25_topk_impact,
+                               sim_fused_bm25_topk_tfdl)
 
 
 class TestKernelParity:
@@ -102,6 +103,9 @@ def small_head(monkeypatch):
     monkeypatch.setattr(fastpath, "L_HEAD", 64)
     monkeypatch.setattr(fastpath, "fused_bm25_topk_tfdl",
                         sim_fused_bm25_topk_tfdl)
+    # codec-v2 segments ride the impact frontier kernel now (ISSUE 11)
+    monkeypatch.setattr(fastpath, "fused_bm25_topk_impact",
+                        sim_fused_bm25_topk_impact)
     monkeypatch.setattr(fastpath, "_backend_ok", True)
 
 

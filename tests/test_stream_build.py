@@ -1,0 +1,223 @@
+"""Streaming segment builder (index/segment.py StreamingSegmentBuilder):
+chunked/spill build must be BIT-IDENTICAL to the in-memory build — same
+CSR arrays, same doc values, same impact planes — because refresh picks
+the path by buffer size alone (index/engine.py stream_refresh_min_docs)
+and replicas/oracles assume one canonical segment per doc set."""
+
+import os
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.index.segment import (StreamingSegmentBuilder,
+                                          build_segment,
+                                          build_segment_streaming,
+                                          stream_eligible)
+
+MAPPINGS = {
+    "properties": {
+        "body": {"type": "text"},
+        "title": {"type": "text"},
+        "status": {"type": "keyword"},
+        "price": {"type": "integer"},
+        "rating": {"type": "float"},
+        "loc": {"type": "geo_point"},
+        "vec": {"type": "knn_vector", "dimension": 4},
+    }
+}
+
+
+def _corpus(n, seed=0, vocab=400):
+    rng = np.random.default_rng(seed)
+    m = Mappings(MAPPINGS)
+    words = [f"w{i:04d}" for i in range(vocab)]
+    docs = []
+    for i in range(n):
+        src = {"body": " ".join(
+            words[int(t)] for t in rng.zipf(1.3, rng.integers(2, 9)) % vocab)}
+        if i % 3 == 0:
+            src["title"] = f"{words[i % vocab]} {words[(i * 7) % vocab]}"
+        if i % 2 == 0:
+            src["status"] = ["a", "b", "c"][i % 3]
+        if i % 5 == 0:
+            src["price"] = int(rng.integers(0, 500))
+        if i % 7 == 0:
+            src["rating"] = float(rng.random())
+        if i % 11 == 0:
+            src["loc"] = {"lat": float(rng.uniform(-80, 80)),
+                          "lon": float(rng.uniform(-170, 170))}
+        if i % 13 == 0:
+            src["vec"] = [float(x) for x in rng.random(4)]
+        docs.append(m.parse(f"d{i}", src))
+    return m, docs
+
+
+def assert_segments_identical(a, b):
+    assert a.ndocs == b.ndocs
+    assert a.codec_version == b.codec_version
+    assert set(a.postings) == set(b.postings)
+    for f, pa in a.postings.items():
+        pb = b.postings[f]
+        assert pa.vocab == pb.vocab
+        for attr in ("starts", "doc_ids", "tfs"):
+            xa, xb = getattr(pa, attr), getattr(pb, attr)
+            assert xa.dtype == xb.dtype, (f, attr)
+            assert np.array_equal(xa, xb), (f, attr)
+        assert (pa.pos_starts is None) == (pb.pos_starts is None)
+        if pa.pos_starts is not None:
+            assert np.array_equal(pa.pos_starts, pb.pos_starts)
+            assert np.array_equal(pa.positions, pb.positions)
+        assert (pa.impact is None) == (pb.impact is None)
+        if pa.impact is not None:
+            ia, ib = pa.impact, pb.impact
+            assert np.array_equal(ia.q, ib.q)
+            assert ia.scale == ib.scale and ia.bits == ib.bits
+            assert ia.avgdl == ib.avgdl and ia.dl_max == ib.dl_max
+            assert np.array_equal(ia.block_starts, ib.block_starts)
+            assert np.array_equal(ia.block_off, ib.block_off)
+            assert np.array_equal(ia.block_max, ib.block_max)
+    assert set(a.numeric_cols) == set(b.numeric_cols)
+    for f, ca in a.numeric_cols.items():
+        cb = b.numeric_cols[f]
+        assert ca.kind == cb.kind
+        assert ca.values.dtype == cb.values.dtype
+        assert np.array_equal(ca.values, cb.values)
+        assert np.array_equal(ca.present, cb.present)
+    assert set(a.keyword_cols) == set(b.keyword_cols)
+    for f, ca in a.keyword_cols.items():
+        cb = b.keyword_cols[f]
+        assert ca.vocab == cb.vocab
+        for attr in ("starts", "ords", "doc_of_value", "min_ord"):
+            assert np.array_equal(getattr(ca, attr), getattr(cb, attr)), \
+                (f, attr)
+    for f, ca in a.geo_cols.items():
+        cb = b.geo_cols[f]
+        assert np.array_equal(ca.lat, cb.lat)
+        assert np.array_equal(ca.lon, cb.lon)
+        assert np.array_equal(ca.present, cb.present)
+    for f, ca in a.vector_cols.items():
+        cb = b.vector_cols[f]
+        assert np.array_equal(ca.values, cb.values)
+        assert np.array_equal(ca.present, cb.present)
+        assert ca.similarity == cb.similarity
+    assert set(a.doc_lens) == set(b.doc_lens)
+    for f in a.doc_lens:
+        assert np.array_equal(a.doc_lens[f], b.doc_lens[f])
+    assert {f: (s.doc_count, s.sum_dl) for f, s in a.text_stats.items()} \
+        == {f: (s.doc_count, s.sum_dl) for f, s in b.text_stats.items()}
+    assert list(a.ids) == list(b.ids)
+    assert list(a.sources) == list(b.sources)
+    assert np.array_equal(a.seq_nos, b.seq_nos)
+    assert (a.stored_vals is None) == (b.stored_vals is None)
+
+
+class TestStreamingEquivalence:
+    def test_50k_doc_chunked_spill_build_bit_identical(self, tmp_path):
+        """The ISSUE-11 satellite gate: a 50k-doc corpus through the
+        chunked/spill path is array-for-array identical to the in-memory
+        build (impact planes included)."""
+        m, docs = _corpus(50_000, seed=3)
+        seqs = list(range(len(docs)))
+        mem = build_segment("s", docs, m, seq_nos=seqs)
+        stream = build_segment_streaming("s", docs, m, seq_nos=seqs,
+                                         chunk_docs=4096,
+                                         spill_dir=str(tmp_path))
+        assert_segments_identical(mem, stream)
+        # the spill dir is cleaned up after finish
+        assert not any(f.endswith(".npz") for f in os.listdir(tmp_path))
+
+    def test_chunk_size_does_not_change_output(self):
+        m, docs = _corpus(700, seed=5)
+        a = build_segment_streaming("s", docs, m, chunk_docs=64)
+        b = build_segment_streaming("s", docs, m, chunk_docs=701)
+        mem = build_segment("s", docs, m)
+        assert_segments_identical(mem, a)
+        assert_segments_identical(mem, b)
+
+    def test_positions_survive_chunk_boundaries(self):
+        m = Mappings({"properties": {"body": {"type": "text"}}})
+        docs = [m.parse(str(i), {"body": f"x y x z w{i % 7} x"})
+                for i in range(300)]
+        mem = build_segment("s", docs, m)
+        st = build_segment_streaming("s", docs, m, chunk_docs=37)
+        assert_segments_identical(mem, st)
+        # sanity: a mid-corpus doc's positions for the tripled term
+        pb = st.postings["body"]
+        r = pb.row("x")
+        a, b = pb.row_slice(r)
+        k = a + int(np.searchsorted(pb.doc_ids[a:b], 153))
+        assert pb.doc_ids[k] == 153
+        ps, pe = pb.pos_starts[k], pb.pos_starts[k + 1]
+        assert list(pb.positions[ps:pe]) == [0, 2, 5]
+
+    def test_ineligible_docs_raise_and_gate_reports(self):
+        m = Mappings({"properties": {
+            "n": {"type": "nested", "properties": {
+                "a": {"type": "keyword"}}}}})
+        pd = m.parse("1", {"n": [{"a": "x"}]})
+        assert not stream_eligible([pd])
+        b = StreamingSegmentBuilder("s", m)
+        with pytest.raises(ValueError):
+            b.add(pd)
+        b._cleanup()
+
+    def test_empty_and_single_chunk(self):
+        m = Mappings({"properties": {"body": {"type": "text"}}})
+        docs = [m.parse("only", {"body": "solo token"})]
+        mem = build_segment("s", docs, m)
+        st = build_segment_streaming("s", docs, m, chunk_docs=10)
+        assert_segments_identical(mem, st)
+
+
+class TestEngineStreamingRefresh:
+    def test_refresh_routes_large_buffers_through_streaming(self,
+                                                            monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_STREAM_REFRESH_DOCS", "100")
+        m = Mappings({"properties": {"body": {"type": "text"}}})
+        eng = Engine(m)
+        for i in range(250):
+            eng.index_doc(str(i), {"body": f"alpha w{i % 17} beta"})
+        eng.refresh()
+        assert eng.stats.get("stream_refreshes", 0) == 1
+        assert eng.num_docs == 250
+        # realtime get still resolves through the streamed segment
+        got = eng.get("137")
+        assert got is not None and got["found"]
+
+    def test_streamed_and_buffered_refresh_segments_identical(
+            self, monkeypatch):
+        m = Mappings({"properties": {"body": {"type": "text"},
+                                     "status": {"type": "keyword"}}})
+
+        def fill(e):
+            for i in range(180):
+                e.index_doc(str(i), {"body": f"tok{i % 23} common",
+                                     "status": ["x", "y"][i % 2]})
+            e.refresh()
+
+        monkeypatch.setenv("OPENSEARCH_TPU_STREAM_REFRESH_DOCS", "50")
+        eng_s = Engine(m)
+        fill(eng_s)
+        monkeypatch.setenv("OPENSEARCH_TPU_STREAM_REFRESH_DOCS", "100000")
+        eng_m = Engine(m)
+        fill(eng_m)
+        assert eng_s.stats.get("stream_refreshes", 0) == 1
+        assert eng_m.stats.get("stream_refreshes", 0) == 0
+        assert_segments_identical(eng_m.segments[0], eng_s.segments[0])
+
+    def test_nested_docs_fall_back_to_in_memory_build(self, monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_STREAM_REFRESH_DOCS", "10")
+        m = Mappings({"properties": {
+            "body": {"type": "text"},
+            "n": {"type": "nested", "properties": {
+                "a": {"type": "keyword"}}}}})
+        eng = Engine(m)
+        for i in range(40):
+            eng.index_doc(str(i), {"body": "alpha",
+                                   "n": [{"a": f"v{i % 3}"}]})
+        eng.refresh()
+        assert eng.stats.get("stream_refreshes", 0) == 0
+        assert eng.num_docs == 40
+        assert "n" in eng.segments[0].nested
