@@ -347,6 +347,12 @@ class DistClusterNode:
         # in-process multi-node tests inject distinct registries so the
         # merge math federates genuinely disjoint streams
         self.obs_registry = None
+        # insights engine this node answers `/_internal/insights` from.
+        # None -> the process-default INSIGHTS; in-process multi-node
+        # tests inject distinct engines so the heavy-hitter merge
+        # federates genuinely disjoint workloads (the obs_registry
+        # pattern above)
+        self.insights_engine = None
         if seed is not None:
             st = _http(seed, "POST", "/_internal/join",
                        {"name": name, "addr": self.addr})
@@ -424,7 +430,8 @@ class DistClusterNode:
             self._apply_state(body["state"])
             return 200, {"acknowledged": True}
         if op in ("dfs", "query_phase", "fetch_phase",
-                  "stats", "node_stats", "hot_threads", "history"):
+                  "stats", "node_stats", "hot_threads", "history",
+                  "insights"):
             # deadline propagation: re-anchor the remaining budget the
             # coordinator stamped; an already-exhausted budget answers an
             # immediate 408 shard failure instead of a full local phase
@@ -440,7 +447,7 @@ class DistClusterNode:
                               f"deadline budget"}}
             with _dl.scope(dl):
                 if op in ("stats", "node_stats", "hot_threads",
-                          "history"):
+                          "history", "insights"):
                     return 200, self._handle_obs(op, body)
                 return self._handle_phase(op, body)
         if op == "state" and method == "GET":
@@ -919,8 +926,15 @@ class DistClusterNode:
             token = _fr.set_current(tl)
         # per-lane SLIs at the COORDINATOR boundary (the distributed
         # path never crosses Node.search): the same requests/errors
-        # counters + latency sketch the SLO engine windows (obs/slo.py)
+        # counters + latency sketch the SLO engine windows (obs/slo.py),
+        # and the same query-insights fingerprinting — distributed
+        # workloads aggregate under the identical shape identity a
+        # single node derives (obs/insights.py)
+        from ..obs import insights as _ins
         t0 = time.monotonic()
+        obs, ins_token = _ins.begin(body if isinstance(body, dict)
+                                    else {}, "interactive")
+        ins_tl = _fr.current() if _fr.RECORDER.enabled else 0
         try:
             with _dl.scope(dl), \
                     TRACER.span("dist.search", index=index,
@@ -933,16 +947,22 @@ class DistClusterNode:
         except BaseException as e:
             # client-side 4xx API errors are the caller's fault, not
             # lost availability (the Node.search contract)
-            if getattr(e, "status", 500) >= 500:
+            is_5xx = getattr(e, "status", 500) >= 500
+            if is_5xx:
                 METRICS.counter("search.lane.interactive.errors").inc()
+            _ins.finish(ins_token, obs, error=is_5xx,
+                        timeline_id=ins_tl)
             raise
         finally:
             if token is not None:
                 _fr.reset_current(token)
         METRICS.counter("search.lane.interactive.requests").inc()
+        took_ms = (time.monotonic() - t0) * 1000.0
         if METRICS.enabled:
             METRICS.histogram("search.lane.interactive.latency_ms").record(
-                (time.monotonic() - t0) * 1000.0)
+                took_ms)
+        _ins.finish(ins_token, obs, latency_ms=took_ms,
+                    timeline_id=ins_tl)
         return resp
 
     # ---------------- per-phase scatter with retry + failover ----------
@@ -1239,12 +1259,23 @@ class DistClusterNode:
                 interval_s=float(body.get("interval_ms", 20)) / 1000.0,
                 ignore_idle=bool(body.get("ignore_idle", True)),
                 as_json=bool(body.get("as_json", False)))}
+        if op == "insights":
+            w = body.get("window_s")
+            return {"node": self.name,
+                    "wire": self._insights().to_wire(
+                        window_s=float(w) if w is not None else None)}
         # history
         from ..obs.timeseries import SAMPLER
         return {"node": self.name,
                 "history": SAMPLER.history(
                     str(body.get("metric") or ""),
                     float(body.get("window_s", 60.0)))}
+
+    def _insights(self):
+        if self.insights_engine is not None:
+            return self.insights_engine
+        from ..obs.insights import INSIGHTS
+        return INSIGHTS
 
     def _scrape_timeout_s(self) -> float:
         dl = _dl.current()
@@ -1417,6 +1448,57 @@ class DistClusterNode:
                 "_nodes": {"total": len(scraped), "successful": ok,
                            "failed": len(scraped) - ok},
                 "nodes": nodes}
+
+    def top_queries_federated(self, by: str = "latency", n: int = 10,
+                              window_s: Optional[float] = None,
+                              node_id: Optional[str] = None) -> dict:
+        """`GET /_insights/top_queries` on a cluster: every member's
+        heavy-hitter sketch wire merges through the commutative
+        space-saving merge (`obs/insights.py merge_wires`), so the
+        fleet's top-N is computed from ONE merged summary — never from
+        concatenated per-node top lists (which under-rank a shape that
+        is #11 everywhere but #1 fleet-wide). Unreachable members
+        degrade to per-node `failed` entries, the merge covers whoever
+        answered."""
+        from ..obs import insights as _ins
+        if by not in _ins.TOP_BY:
+            raise ApiError(400, "illegal_argument_exception",
+                           f"unknown top_queries ranking [{by}] "
+                           f"(one of {_ins.TOP_BY})")
+        payload = ({"window_s": float(window_s)}
+                   if window_s is not None else {})
+        scraped = self._scrape("insights", payload,
+                               self._resolve_member(node_id))
+        wires = []
+        nodes: Dict[str, dict] = {}
+        ok = 0
+        for member, (status, res) in scraped.items():
+            if status == "ok":
+                ok += 1
+                wires.append(res.get("wire") or {})
+                nodes[member] = {"status": "ok"}
+            else:
+                nodes[member] = {"status": "failed", "error": res}
+        cap = self._insights().capacity
+        n = max(int(n), 0)     # the QueryInsights.top clamp, mirrored
+        if window_s is not None:
+            merged = _ins.merge_windowed_wires(wires, cap,
+                                               float(window_s))
+            top = sorted(merged["entries"],
+                         key=_ins.QueryInsights._rank_key(by))[:n]
+        else:
+            merged = _ins.merge_wires(wires, cap)
+            top = sorted((_ins._derived(d) for d in merged["entries"]),
+                         key=_ins.QueryInsights._rank_key(by))[:n]
+        return {"by": by, "n": int(n),
+                **({"window_s": float(window_s)}
+                   if window_s is not None else {}),
+                "capacity": cap,
+                "total_records": merged["total_records"],
+                "_nodes": {"total": len(scraped), "successful": ok,
+                           "failed": len(scraped) - ok},
+                "nodes": nodes,
+                "top_queries": top}
 
     # ---------------- lifecycle + stats ----------------
 

@@ -398,6 +398,12 @@ class Node:
         from ..obs.timeseries import SAMPLER
         self.timeseries = SAMPLER
         self.slo = SLO_ENGINE
+        # query insights (obs/insights.py): workload fingerprinting +
+        # heavy-hitter attribution at the search boundary — the input
+        # the SLO-burn → remediation loop attributes blame with.
+        # Process singleton like METRICS/RECORDER/SAMPLER.
+        from ..obs.insights import INSIGHTS
+        self.insights = INSIGHTS
         if os.environ.get("OPENSEARCH_TPU_TS") not in (None, "", "0"):
             SAMPLER.ensure_started()
         # persistent tasks (reference persistent/AllocatedPersistentTask):
@@ -955,6 +961,7 @@ class Node:
         # and the SLO burn-rate engine judges (obs/slo.py). Recorded at
         # THIS boundary so cache hits, scheduler 429s and host-loop
         # fallbacks all count exactly once.
+        from ..obs import insights as _ins
         from ..utils.metrics import METRICS as _m
         from ..utils.wlm import PressureRejectedException as _rej
         lane = wlm_lane or "interactive"
@@ -966,12 +973,19 @@ class Node:
             tl = _rec.start("search", index=expression,
                             node=self.node_name)
             token = _fr.set_current(tl)
+        # query insights (obs/insights.py): fingerprint the body at THIS
+        # boundary — the same place the per-lane SLIs land — so cache
+        # hits, rejections, errors and host-ladder attribution all
+        # aggregate under one bounded query shape
+        obs, ins_token = _ins.begin(body if isinstance(body, dict)
+                                    else {}, lane)
         try:
             resp = self._search_recorded(expression, body, phase_hook,
                                          phase_ctx, copy_protect,
                                          wlm_lane, tl)
         except _rej:
             _m.counter(f"search.lane.{lane}.rejected").inc()
+            _ins.finish(ins_token, obs, rejected=True, timeline_id=tl)
             raise
         except BaseException as e:
             # client-side 4xx API errors (bad query, missing index) are
@@ -979,14 +993,19 @@ class Node:
             # faults burn the error budget
             if getattr(e, "status", 500) >= 500:
                 _m.counter(f"search.lane.{lane}.errors").inc()
+                _ins.finish(ins_token, obs, error=True, timeline_id=tl)
+            else:
+                _ins.finish(ins_token, obs, timeline_id=tl)
             raise
         finally:
             if token is not None:
                 _fr.reset_current(token)
         _m.counter(f"search.lane.{lane}.requests").inc()
+        took_ms = (time.monotonic() - _t0) * 1000.0
         if _m.enabled:
             _m.histogram(f"search.lane.{lane}.latency_ms").record(
-                (time.monotonic() - _t0) * 1000.0)
+                took_ms)
+        _ins.finish(ins_token, obs, latency_ms=took_ms, timeline_id=tl)
         return resp
 
     def _search_recorded(self, expression: str, body: dict, phase_hook,
@@ -1034,6 +1053,8 @@ class Node:
         if cache_key is not None:
             cached = self.request_cache.get(cache_key)
             if cached is not None:
+                from ..obs import insights as _ins
+                _ins.note_cache_hit()
                 if _rec.enabled and tl:
                     _rec.record(tl, "cache.hit", index=expression)
                 if copy_protect:
@@ -1135,11 +1156,17 @@ class Node:
         def _slow_extra(_span=root_span, _before=rungs_before):
             # built only when a slowlog threshold fires: rung deltas say
             # WHICH escalation path burned the time, the root span says
-            # WHERE inside the request it went
+            # WHERE inside the request it went; the insights fingerprint
+            # says WHAT KIND of query this was (obs/insights.py — the
+            # handle into `GET /_insights/top_queries`)
+            from ..obs import insights as _ins
             rungs = {k: _fp.STATS[k] - _before.get(k, 0) for k in _before
                      if _fp.STATS[k] != _before.get(k, 0)}
+            _obs = _ins.current()
             return {"fastpath_rungs": rungs,
                     "rescore_path": _fp.rescore_mode(),
+                    **({"fingerprint": _obs.key} if _obs is not None
+                       else {}),
                     **({"trace": _span.to_dict()}
                        if _span is not None else {})}
 

@@ -42,6 +42,7 @@ from .core import (Baseline, Checker, Finding, default_checkers,
                    load_baseline, run_paths, run_source, write_baseline)
 from .dtype_rules import DtypeDisciplineChecker
 from .impact_rules import ImpactDomainChecker
+from .insights_rules import InsightsCardinalityChecker
 from .jit_rules import JitBoundaryChecker
 from .lock_rules import LockDisciplineChecker
 from .memory_rules import MemoryAccountingChecker
@@ -53,5 +54,5 @@ __all__ = [
     "DtypeDisciplineChecker", "JitBoundaryChecker",
     "BreakerDisciplineChecker", "LockDisciplineChecker",
     "DeviceSyncDisciplineChecker", "MemoryAccountingChecker",
-    "ImpactDomainChecker",
+    "ImpactDomainChecker", "InsightsCardinalityChecker",
 ]

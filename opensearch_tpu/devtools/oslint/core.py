@@ -126,6 +126,7 @@ def default_checkers() -> List[Checker]:
     from .breaker_rules import BreakerDisciplineChecker
     from .dtype_rules import DtypeDisciplineChecker
     from .impact_rules import ImpactDomainChecker
+    from .insights_rules import InsightsCardinalityChecker
     from .jit_rules import JitBoundaryChecker
     from .lock_rules import LockDisciplineChecker, WaitDisciplineChecker
     from .memory_rules import MemoryAccountingChecker
@@ -141,7 +142,7 @@ def default_checkers() -> List[Checker]:
             DeviceSyncDisciplineChecker(), RecorderDisciplineChecker(),
             MemoryAccountingChecker(), ImpactDomainChecker(),
             RpcDisciplineChecker(), SamplerDisciplineChecker(),
-            ScorePlaneChecker()]
+            ScorePlaneChecker(), InsightsCardinalityChecker()]
 
 
 def run_source(src: str, path: str,

@@ -1,0 +1,225 @@
+"""OSL602 — cardinality discipline for workload-keyed observability.
+
+The query-insights engine (obs/insights.py) aggregates per query SHAPE:
+a key derived from user traffic. Two ways that quietly goes wrong, each
+encoded here (the discipline the module's design follows):
+
+- **Unbounded keyed growth.** A record path that does
+  `self.<attr>[key] = ...` / `.setdefault(key, ...)` keyed by workload
+  input grows with workload *cardinality* — O(distinct shapes) memory
+  wearing an attribution costume. Every keyed store on an obs/ record
+  path must carry an explicit capacity bound IN SCOPE: built as a
+  `deque(maxlen=...)`, or guarded by a `len(...)`-vs-capacity check /
+  eviction (`.pop`/`.popitem`/`del`) on the same attribute in the same
+  file. Per-call LOCAL dicts are fine — they die with the call.
+- **Raw query text in label positions.** A metric name built from a
+  variable that smells like query text (`query`, `body`, `text`,
+  `source`, `q_str`) puts unbounded user strings into the metrics
+  registry AND leaks request content into scrape output. Labels and
+  metric names carry shape HASHES, lane names, and enum-like kinds —
+  never the query. (`fingerprint()` strips values structurally; this
+  rule patrols the registry boundary.)
+
+Scope: the keyed-growth rule patrols `obs/` record paths (functions
+named `record*`/`note*`/`observe*`/`ingest*`/`_record*`/`_note*`);
+the label rule patrols `obs/`, `utils/`, `rest/`, `search/`,
+`serving/`, `cluster/` — everywhere instruments are minted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_RECORD_PREFIXES = ("record", "note", "observe", "ingest",
+                    "_record", "_note", "_observe", "_ingest")
+
+# variables whose NAME marks them as (potential) raw query text; the
+# discriminator is the name at the registry boundary, which is exactly
+# what a reviewer reads
+_TEXTY_NAMES = ("query", "body", "text", "source", "q_str", "raw")
+
+_INSTRUMENT_FACTORIES = ("counter", "gauge", "histogram", "timer")
+
+_EVICT_METHODS = ("pop", "popitem", "popleft", "clear")
+
+_CAP_NAMES = ("cap", "capacity", "max", "limit", "bound")
+
+
+def _is_record_fn(name: str) -> bool:
+    return any(name.startswith(p) for p in _RECORD_PREFIXES)
+
+
+def _texty(name: str) -> bool:
+    low = name.lower()
+    return any(t in low for t in _TEXTY_NAMES)
+
+
+class InsightsCardinalityChecker(Checker):
+    rules = ("OSL602",)
+    name = "insights-cardinality"
+
+    GROWTH_SCOPES = ("obs/",)
+    LABEL_SCOPES = ("obs/", "utils/", "rest/", "search/", "serving/",
+                    "cluster/")
+    EXEMPT = ("devtools/",)
+
+    def applies(self, path: str) -> bool:
+        if any(s in path for s in self.EXEMPT):
+            return False
+        return any(s in path for s in self.LABEL_SCOPES)
+
+    # ---------------- bounded-evidence collection ----------------
+
+    @staticmethod
+    def _bounded_attrs(tree: ast.Module) -> Set[str]:
+        """Attribute names the file proves bounded:
+        - assigned from `deque(maxlen=...)`;
+        - appearing inside a `len(self.<attr>)` comparison (the
+          explicit capacity check);
+        - target of an eviction call (`self.<attr>.pop/popitem/...`)
+          or a `del self.<attr>[...]` anywhere in the file."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = node.value
+                bounded_ctor = (
+                    isinstance(value, ast.Call)
+                    and _dotted(value.func).split(".")[-1] == "deque"
+                    and any(kw.arg == "maxlen"
+                            for kw in value.keywords))
+                # a fixed-size slot ring: `[None] * capacity` — bounded
+                # by construction (the flight-recorder pattern)
+                fixed_ring = (
+                    isinstance(value, ast.BinOp)
+                    and isinstance(value.op, ast.Mult)
+                    and any(isinstance(s, ast.List)
+                            for s in (value.left, value.right)))
+                if bounded_ctor or fixed_ring:
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        if isinstance(t, ast.Attribute):
+                            out.add(t.attr)
+            elif isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    if (isinstance(side, ast.Call)
+                            and _dotted(side.func) == "len"
+                            and side.args
+                            and isinstance(side.args[0], ast.Attribute)):
+                        out.add(side.args[0].attr)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _EVICT_METHODS
+                        and isinstance(f.value, ast.Attribute)):
+                    out.add(f.value.attr)
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Attribute):
+                        out.add(t.value.attr)
+        return out
+
+    # ---------------- the two sub-rules ----------------
+
+    @staticmethod
+    def _self_attr(node: ast.AST):
+        """`self.<attr>` -> attr name, else None — the rule patrols
+        INSTANCE state (what outlives the call); locals and entry
+        objects die with their owner's own bounds."""
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _scan_growth(self, fn: ast.AST, sym: str, bounded: Set[str],
+                     path: str, findings: List[Finding]) -> None:
+        for node in ast.walk(fn):
+            attr = None
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Subscript)
+                            and not isinstance(t.slice, ast.Constant)):
+                        attr = self._self_attr(t.value)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in ("setdefault", "append")):
+                    attr = self._self_attr(f.value)
+            if attr is None or attr in bounded:
+                continue
+            findings.append(Finding(
+                "OSL602", path, node.lineno, node.col_offset, sym,
+                f"workload-keyed growth of `.{attr}` on an obs/ record "
+                f"path with no capacity bound in scope — per-key stores "
+                f"must be a deque(maxlen=...), len()-capacity-checked, "
+                f"or evicted in this file (memory must be O(capacity), "
+                f"not O(workload cardinality))",
+                detail=f"unbounded-keyed-growth:{attr}"))
+
+    @staticmethod
+    def _name_smells(expr: ast.AST) -> bool:
+        """Does a metric-name expression interpolate a query-texty
+        variable? f-strings, %-format, .format and + concat."""
+        parts: List[ast.AST] = []
+        if isinstance(expr, ast.JoinedStr):
+            parts = [v.value for v in expr.values
+                     if isinstance(v, ast.FormattedValue)]
+        elif isinstance(expr, ast.BinOp):
+            parts = [expr.left, expr.right]
+        elif (isinstance(expr, ast.Call)
+              and isinstance(expr.func, ast.Attribute)
+              and expr.func.attr == "format"):
+            parts = list(expr.args)
+        for p in parts:
+            d = _dotted(p)
+            if d and any(_texty(seg) for seg in d.split(".")):
+                return True
+        return False
+
+    def _scan_labels(self, tree: ast.Module, qmap, path: str,
+                     findings: List[Finding]) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _INSTRUMENT_FACTORIES):
+                continue
+            if not node.args:
+                continue
+            if self._name_smells(node.args[0]):
+                findings.append(Finding(
+                    "OSL602", path, node.lineno, node.col_offset,
+                    qmap.get(node, ""),
+                    "metric name interpolates a query/body-like "
+                    "variable — labels and names carry shape hashes, "
+                    "lanes and enum kinds, never raw query text "
+                    "(fingerprint it first: obs/insights.py)",
+                    detail="raw-query-in-metric-name"))
+
+    # ---------------- driver ----------------
+
+    def check(self, tree: ast.Module, path: str,
+              src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        self._scan_labels(tree, qmap, path, findings)
+        if any(s in path for s in self.GROWTH_SCOPES):
+            bounded = self._bounded_attrs(tree)
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and _is_record_fn(node.name):
+                    self._scan_growth(node, qmap.get(node, node.name),
+                                      bounded, path, findings)
+        findings.sort(key=lambda f: (f.line, f.detail))
+        return findings

@@ -95,7 +95,7 @@ class QueryCost:
             self.predicted_scatter += int(scatter)
             self.predicted_topk += int(topk)
             if self.detail and segment is not None:
-                self.segments.append(
+                self.segments.append(  # oslint: disable=OSL602 -- per-request accumulator: dies at finish(), bounded by the request's own plan size, never workload cardinality
                     {"segment": getattr(segment, "name", str(segment)),
                      "predicted_bytes_gathered": int(bytes_),
                      "predicted_scatter_adds": int(scatter),
@@ -110,7 +110,7 @@ class QueryCost:
             self.actual_topk += int(topk)
             self.launches += int(launches)
             if self.detail:
-                self.segments.append(
+                self.segments.append(  # oslint: disable=OSL602 -- per-request accumulator: dies at finish(), bounded by the request's own plan size, never workload cardinality
                     {"segment": (getattr(segment, "name", str(segment))
                                  if segment is not None else None),
                      "path": path,

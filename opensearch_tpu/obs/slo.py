@@ -66,6 +66,10 @@ _KINDS = ("latency", "error_rate", "availability", "rejection_rate",
 # recorder event (dumps are bounded; a 512-sample ring must not be)
 _ALERT_SERIES_POINTS = 120
 
+# top query fingerprints attached to a firing alert (obs/insights.py):
+# the offending window's heaviest shapes, worst-timeline linked
+_ALERT_TOP_FINGERPRINTS = 5
+
 
 class SLO:
     """One declared objective. Windows are mandatory (oslint OSL509)."""
@@ -302,13 +306,19 @@ class SLOEngine:
                      now: float) -> None:
         """Rising-edge actions (called under self._lock): alert-log
         entry, `slo.burn` recorder event carrying the offending window's
-        series, and a frozen dump bundle."""
+        series AND the top query fingerprints active in that window
+        (obs/insights.py — the blame half of detection: WHAT burned the
+        budget, not just that it burned), and a frozen dump bundle.
+        Each fingerprint entry links its worst flight-recorder timeline,
+        so the dump is one hop from a full request journal."""
         series = {m: self._bounded_series(m, s.slow_window_s)
                   for m in s.series_metrics()}
+        top_fps = self._insights_top(s.slow_window_s)
         alert = {"slo": s.name, "slo_kind": s.kind, "lane": s.lane,
                  "at_mono": round(now, 6),
                  "fast": fast, "slow": slow,
-                 "burn_threshold": s.burn_threshold}
+                 "burn_threshold": s.burn_threshold,
+                 "top_fingerprints": top_fps}
         self._alerts.append(dict(alert, series_metrics=sorted(series)))
         rec = self._rec()
         if rec is not None and rec.enabled:
@@ -321,6 +331,19 @@ class SLOEngine:
                     note=f"SLO [{s.name}] burn fast="
                          f"{fast['burn_rate']}x slow={slow['burn_rate']}x "
                          f"(threshold {s.burn_threshold}x)")
+
+    @staticmethod
+    def _insights_top(window_s: float) -> list:
+        """Top query fingerprints active in the offending window —
+        bounded, label-safe (hashes + numbers + value-free shapes).
+        Forensics must never break firing: any insights fault reads as
+        an empty attribution list."""
+        try:
+            from .insights import INSIGHTS
+            return INSIGHTS.top_fingerprints(window_s,
+                                             n=_ALERT_TOP_FINGERPRINTS)
+        except Exception:       # noqa: BLE001 — attribution is advisory
+            return []
 
     def _bounded_series(self, metric: str, window_s: float) -> dict:
         h = self.sampler.history(metric, window_s)

@@ -404,6 +404,13 @@ class RestClient:
         try:
             wg.admit_search()
         except PressureRejectedException as e:
+            # a wlm admission 429 never reaches Node.search — record
+            # the rejection against the query's shape here so admission
+            # pressure is attributable per workload (obs/insights.py)
+            from ..obs import insights as _ins
+            _ins.INSIGHTS.record_rejection(
+                body, getattr(wg, "lane", "interactive"),
+                source="wlm_admission")
             raise ApiError(429, "rejected_execution_exception", str(e))
         _wg_t0 = time.monotonic()
         if body.get("query") is not None:
@@ -956,6 +963,9 @@ class RestClient:
             # SLO burn-rate engine (obs/slo.py): armed objectives, live
             # burn rates and alert counts (full view at GET /_slo)
             "slo": n.slo.stats(),
+            # query insights (obs/insights.py): workload fingerprint
+            # sketch occupancy (full view at GET /_insights/top_queries)
+            "insights": n.insights.stats(),
         }
         if n.mesh_service is not None:
             node_block["mesh"] = n.mesh_service.stats()
@@ -1060,6 +1070,32 @@ class RestClient:
         """`GET /_slo`: armed objectives, live burn rates, alert log
         (obs/slo.py)."""
         return self.node.slo.status()
+
+    def insights_top_queries(self, by: str = "latency", n: int = 10,
+                             window_s: Optional[float] = None) -> dict:
+        """`GET /_insights/top_queries` on an UNclustered node: the
+        same schema the distnode federation serves (cluster/distnode.py
+        `top_queries_federated`), degenerated to a fleet of one."""
+        from ..obs import insights as _ins
+        eng = self.node.insights
+        try:
+            top = eng.top(by=by, n=n, window_s=window_s)
+        except ValueError as e:
+            raise ApiError(400, "illegal_argument_exception", str(e))
+        name = self.node.node_name
+        return {"by": by, "n": int(n),
+                **({"window_s": float(window_s)}
+                   if window_s is not None else {}),
+                "capacity": eng.capacity,
+                "total_records": eng.sketch.total_records,
+                "_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "nodes": {name: {"status": "ok"}},
+                "top_queries": top}
+
+    def insights_status(self) -> dict:
+        """`GET /_insights`: engine state (capacity, entries,
+        evictions, window occupancy)."""
+        return {"insights": self.node.insights.stats()}
 
     def get_traces(self, limit: int = 20) -> dict:
         """Recent completed request traces (reference telemetry in-memory

@@ -47,7 +47,7 @@ def _truthy(v) -> bool:
 
 # routes any authenticated principal may hit (cluster "monitor" class)
 _MONITOR_HEADS = {"", "_cluster", "_nodes", "_cat", "_stats", "_tasks",
-                  "_metrics", "_flight_recorder", "_slo"}
+                  "_metrics", "_flight_recorder", "_slo", "_insights"}
 # cluster-admin routes
 _ADMIN_HEADS = {"_index_template", "_template", "_remotestore", "_snapshot",
                 "_ingest", "_scripts", "_search_pipeline", "_data_stream",
@@ -467,6 +467,40 @@ class _Handler(BaseHTTPRequestHandler):
             # SLO burn-rate engine (obs/slo.py): armed objectives, live
             # multi-window burn rates, the recent alert log
             return 200, c.slo_status()
+        if head == "_insights":
+            # query insights (obs/insights.py): workload fingerprints +
+            # heavy-hitter attribution. /_insights/top_queries is the
+            # read surface; clustered nodes merge every member's sketch
+            # (commutative space-saving merge) before ranking
+            if len(parts) > 1 and parts[1] == "top_queries":
+                if method != "GET":
+                    raise ApiError(405, "method_not_allowed",
+                                   "top_queries requires GET")
+                by = params.get("by", "latency")
+                try:
+                    n_top = int(params.get("n", 10))
+                    window_s = (float(params["window"])
+                                if "window" in params else None)
+                except (TypeError, ValueError):
+                    raise ApiError(400, "parsing_exception",
+                                   "top_queries ?n= and ?window= must "
+                                   "be numeric")
+                if window_s is not None and window_s <= 0:
+                    raise ApiError(400, "illegal_argument_exception",
+                                   "top_queries ?window= must be "
+                                   "positive seconds")
+                if n_top < 0:
+                    raise ApiError(400, "illegal_argument_exception",
+                                   "top_queries ?n= must be >= 0")
+                if dist is not None:
+                    return 200, dist.top_queries_federated(
+                        by=by, n=n_top, window_s=window_s)
+                return 200, c.insights_top_queries(by=by, n=n_top,
+                                                   window_s=window_s)
+            if method != "GET":
+                raise ApiError(405, "method_not_allowed",
+                               "_insights requires GET")
+            return 200, c.insights_status()
         if head == "_flight_recorder":
             # black-box event journal (obs/flight_recorder.py): ring
             # stats + recent anomaly dumps; POST …/dump freezes a manual
@@ -485,11 +519,15 @@ class _Handler(BaseHTTPRequestHandler):
             # (utils/metrics.py): counters, gauges, and latency-histogram
             # summaries — the scrape surface of the same data
             # `_nodes/stats` serves as JSON
+            from ..obs.insights import INSIGHTS
             from ..utils.metrics import METRICS, render_prometheus
             # node label: federated scrapes of several processes must
-            # not collapse identically-named series into one stream
-            return 200, render_prometheus(METRICS,
-                                          node=c.node.node_name)
+            # not collapse identically-named series into one stream;
+            # the insights export is the BOUNDED top-K (shape hashes
+            # only — workload cardinality never inflates the scrape)
+            return 200, render_prometheus(
+                METRICS, node=c.node.node_name,
+                insights=INSIGHTS.prometheus_top())
         if head == "_cat":
             kind = parts[1] if len(parts) > 1 else "indices"
             fn = getattr(c.cat, kind, None)

@@ -991,8 +991,9 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     # predictions and launched-shape actuals reconcile in the profile
     # `cost` block and the cost.* histograms at finish
     qc_token = None
+    qc_acc = None
     if _qcost.enabled() and _qcost.current() is None:
-        _, qc_token = _qcost.start(
+        qc_acc, qc_token = _qcost.start(
             detail=body.get("explain") == "device_plan")
     # request deadline: REST/distnode installs the ambient budget at
     # accept time (queue wait counts); direct engine callers get one
@@ -1028,6 +1029,15 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
         if dl_token is not None:
             _dl.reset_current(dl_token)
         if qc_token is not None:
+            if qc_acc is not None and qc_acc.actual_bytes:
+                # feed the measured bytes-moved into the request's
+                # query-insights observation (obs/insights.py) — the
+                # per-SHAPE bytes attribution `top_queries?by=bytes`
+                # ranks on. Same-thread contextvar, so coalesced
+                # scheduler batches (other threads) stay unattributed
+                # exactly like query_cost itself documents.
+                from ..obs import insights as _ins
+                _ins.note_bytes(qc_acc.actual_bytes)
             _qcost.finish(qc_token)
 
 

@@ -59,6 +59,7 @@ import numpy as np
 from ..index.segment import (CODEC_V1, CODEC_V2, IMPACT_BLOCK, Segment,
                              next_pow2)
 from ..obs import flight_recorder as _fr
+from ..obs import insights as _ins
 from ..obs import query_cost as _qc
 from ..ops import scoring as ops
 from ..ops.scoring import dequant_impact_np
@@ -543,6 +544,9 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
     STATS.inc("blocks_skipped", nblocks - len(offs))
     STATS.inc("postings_total", total_post)
     STATS.inc("postings_skipped", total_post - kept_post)
+    # per-SHAPE skip attribution (obs/insights.py): the global STATS
+    # smear under concurrency; the request's observation doesn't
+    _ins.note_blocks(nblocks, nblocks - len(offs))
     if kept_post == 0:
         # no queried term has postings here: an exact empty page
         STATS.inc("served")
@@ -584,6 +588,7 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
         if pruned:
             # matches may hide entirely in pruned blocks
             STATS.inc("escalated")
+            _ins.note_escalation()
             return None
         STATS.inc("served")
         z = np.full(window, -np.inf, np.float32)
@@ -659,6 +664,7 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
                 return _result(exact2_m, union, order2, window, n2, "gte")
 
     STATS.inc("escalated")
+    _ins.note_escalation()
     if _fr.RECORDER.enabled and _fr.current():
         _fr.RECORDER.record(_fr.current(), "impactpath.rung",
                             rung="dense_escalation")

@@ -405,6 +405,11 @@ class ServingScheduler:
                                         lane=lane, depth=self._pending)
                 self._cond.notify_all()
         if rejected_depth is not None:
+            # attribute the 429 to the request's query shape: the
+            # insights engine counts rejections per fingerprint, the
+            # admission-threshold remediation input (obs/insights.py)
+            from ..obs import insights as _ins
+            _ins.note_rejection_source("scheduler")
             # event + burst detection OUTSIDE the scheduler lock: a burst
             # trigger freezes a dump bundle, and that scan must not stall
             # every other submit/flush/cancel on _cond

@@ -411,8 +411,23 @@ def _prom_label_value(v: str) -> str:
             .replace("\n", "\\n"))
 
 
+# the bounded query-insights exposition: metric name -> (entry field,
+# HELP text). Labels carry the shape HASH only — raw query text never
+# reaches a label position (oslint OSL602; obs/insights.py)
+_INSIGHTS_SERIES = (
+    ("insights.top_query.count", "count",
+     "estimated request count of a top-K query shape (space-saving "
+     "bound; label is the shape hash, never query text)"),
+    ("insights.top_query.latency_ms_total", "latency_sum_ms",
+     "total recorded latency of a top-K query shape (ms)"),
+    ("insights.top_query.bytes_moved_total", "bytes_moved",
+     "total device bytes moved by a top-K query shape"),
+)
+
+
 def render_prometheus(registry: MetricsRegistry,
-                      node: Optional[str] = None) -> str:
+                      node: Optional[str] = None,
+                      insights: Optional[Sequence[dict]] = None) -> str:
     """Prometheus text exposition format 0.0.4. Counters and gauges render
     directly; latency histograms render as summaries (quantile series +
     _count/_sum) since DDSketch quantiles are what the registry serves.
@@ -420,7 +435,13 @@ def render_prometheus(registry: MetricsRegistry,
     Every sample line carries a `# HELP` + `# TYPE` header pair, and when
     `node` is given every sample gets a `node` label — without it, a
     Prometheus federating several opensearch-tpu processes would collapse
-    their identically-named series into one incoherent stream."""
+    their identically-named series into one incoherent stream.
+
+    `insights` is the BOUNDED top-K query-shape export from
+    `obs/insights.py QueryInsights.prometheus_top()`: one sample per
+    (metric, fingerprint) pair, at most K fingerprints — workload
+    cardinality can never inflate the scrape, and the only label value
+    is the shape hash."""
     snap = registry.snapshot()
     nl = f'node="{_prom_label_value(node)}"' if node is not None else ""
 
@@ -449,6 +470,14 @@ def render_prometheus(registry: MetricsRegistry,
                 lines.append(f"{labeled(pn, qlab)} {h[key]}")
         lines.append(f"{labeled(pn + '_sum')} {h['sum_ms']}")
         lines.append(f"{labeled(pn + '_count')} {h['count']}")
+    for name, field, help_ in (_INSIGHTS_SERIES if insights else ()):
+        pn = _prom_name(name)
+        lines.append(f"# HELP {pn} {help_}")
+        lines.append(f"# TYPE {pn} gauge")
+        for e in insights:
+            fplab = 'fingerprint="%s"' % _prom_label_value(
+                str(e.get("fingerprint", "")))
+            lines.append(f"{labeled(pn, fplab)} {e.get(field, 0)}")
     return "\n".join(lines) + "\n"
 
 

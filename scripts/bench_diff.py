@@ -1,0 +1,248 @@
+"""Perf-trajectory differ over the committed BENCH_r0*.json ladder.
+
+Every round commits a BENCH artifact, but nothing ever COMPARED them —
+"did PR N regress the PR N-1 numbers" was a human eyeballing two JSON
+files. This script extracts the comparable metric surface from any two
+rounds (qps, latency percentiles, bytes-per-query, block-skip rates,
+concurrency/overhead gates) and reports deltas with direction-aware
+regression classification; `--gate` turns it into a CI-shaped exit code.
+
+The ladder has two artifact shapes (docs/BENCH_CORPUS.md "Reading the
+trajectory"):
+
+- **wrapper docs** (r01-r05): `{"n": ..., "cmd": ..., "rc": ...,
+  "tail": "<captured stdout>"}` — the bench emission is the last JSON
+  line of `tail`; a nonzero `rc`/unparseable tail loads as a
+  `status: unparsed` stub (comparable-metric set empty, never a crash).
+- **direct docs** (r06+): the bench.py emission itself
+  (`{"metric", "value", "unit", "extra": {...}}`).
+
+Metric directionality: higher-better for qps / skip rates / invocation
+reduction / mean batch / overhead ratios; lower-better for latency
+percentiles and bytes-per-query. A REGRESSION is a change in the bad
+direction past `--threshold` (default 10%).
+
+Usage:
+    python scripts/bench_diff.py BENCH_r06.json BENCH_r08.json
+    python scripts/bench_diff.py old.json new.json --gate --threshold 0.15
+    python scripts/bench_diff.py --ladder           # walk every committed round
+
+Exit codes: 0 ok, 1 regression past threshold (only with --gate),
+2 usage / unreadable input.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# metric-key suffix -> direction ("up" = higher is better)
+_HIGHER_BETTER = ("qps", "skip_rate", "invocation_reduction",
+                  "mean_batch", "qps_ratio", "overhead", "recall")
+_LOWER_BETTER = ("p50", "p95", "p99", "ms", "bytes", "escalated",
+                 "escalations", "wall_s")
+
+
+def direction(key: str) -> str:
+    """'up' | 'down' | 'unknown' — matched on the LAST path segment so
+    `reorder.bp.multi_eq.qps` classifies by `qps`."""
+    leaf = key.rsplit(".", 1)[-1]
+    for tok in _HIGHER_BETTER:
+        if tok in leaf:
+            return "up"
+    for tok in _LOWER_BETTER:
+        if tok in leaf:
+            return "down"
+    return "unknown"
+
+
+def load_bench(path: str) -> dict:
+    """Load one ladder artifact: direct bench emission, or wrapper doc
+    whose `tail` holds the emission as its last JSON line."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if "metric" in doc and "extra" in doc:
+        return doc
+    if "tail" in doc:
+        for line in reversed(str(doc.get("tail", "")).splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                inner = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(inner, dict) and "metric" in inner:
+                inner.setdefault("extra", {})
+                inner["_round"] = doc.get("n")
+                return inner
+        return {"metric": None, "value": None,
+                "extra": {"status": "unparsed"}, "_round": doc.get("n")}
+    raise ValueError(f"[{path}] is neither a bench emission nor a "
+                     f"wrapper doc")
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def metrics_of(doc: dict) -> dict:
+    """The flat comparable-metric surface of one bench emission. Keys
+    are dotted paths; only numeric leaves that have a known meaning
+    across rounds are extracted."""
+    out = {}
+    extra = doc.get("extra") or {}
+    if _num(doc.get("value")) is not None:
+        out["qps"] = doc["value"]
+    for k in ("cpu_maxscore_match_qps", "cpu_maxscore_bool_qps",
+              "cpu_qps", "recall_at_10_vs_cpu"):
+        if _num(extra.get(k)) is not None:
+            out[k] = extra[k]
+    bpq = extra.get("bytes_per_query") or {}
+    for side in ("actual", "predicted"):
+        d = bpq.get(side) or {}
+        for p in ("p50", "p95"):
+            if _num(d.get(p)) is not None:
+                out[f"bytes_per_query.{side}.{p}_bytes"] = d[p]
+    lat = extra.get("latency_percentiles") or {}
+    for stage, snap in lat.items():
+        if isinstance(snap, dict):
+            for p in ("p50_ms", "p95_ms", "p99_ms"):
+                if _num(snap.get(p)) is not None:
+                    out[f"latency.{stage}.{p}"] = snap[p]
+    conc = extra.get("concurrency") or {}
+    for k in ("invocation_reduction_32t", "mean_batch_32t",
+              "qps_speedup_32t"):
+        if _num(conc.get(k)) is not None:
+            out[f"concurrency.{k}"] = conc[k]
+    for gate in ("recorder_overhead_32t", "cost_overhead_32t",
+                 "sampler_overhead_32t", "insights_overhead_32t"):
+        g = conc.get(gate) or {}
+        if _num(g.get("qps_ratio")) is not None:
+            out[f"concurrency.{gate}.qps_ratio"] = g["qps_ratio"]
+    for cell in conc.get("cells") or []:
+        if not isinstance(cell, dict):
+            continue
+        tagbits = [str(cell.get("threads")), str(cell.get("mode"))]
+        extras = [k for k in ("recorder", "cost", "sampler", "insights")
+                  if cell.get(k) == "off"]
+        if extras or cell.get("errors"):
+            continue     # overhead-pair cells are gated separately
+        tag = "t".join([""] + tagbits[:1]) + "." + tagbits[1]
+        for k in ("qps", "p50_ms", "p95_ms"):
+            if _num(cell.get(k)) is not None:
+                # keep the FIRST (grid) occurrence: later overhead-pair
+                # reps share the same (threads, mode) tag
+                out.setdefault(f"concurrency.cell{tag}.{k}", cell[k])
+    imp = extra.get("impacts") or {}
+    for arm in ("v1", "v2"):
+        a = imp.get(arm) or {}
+        for k, suf in (("qps_32t", "qps"),
+                       ("block_skip_rate", "block_skip_rate"),
+                       ("mean_bytes_per_query", "mean_bytes_per_query")):
+            if _num(a.get(k)) is not None:
+                out[f"impacts.{arm}.{suf}"] = a[k]
+    reorder = (extra.get("reorder") or {}).get("arms") or {}
+    for arm, mixes in reorder.items():
+        if not isinstance(mixes, dict):
+            continue
+        for mix, cell in mixes.items():
+            if not isinstance(cell, dict):
+                continue
+            for k in ("qps", "lat_ms_p50", "lat_ms_p99",
+                      "block_skip_rate", "mean_bytes_per_query"):
+                if _num(cell.get(k)) is not None:
+                    out[f"reorder.{arm}.{mix}.{k}"] = cell[k]
+    return out
+
+
+def diff(old: dict, new: dict, threshold: float) -> dict:
+    """Compare two flat metric maps. Each shared key reports old/new,
+    the relative change, its direction class, and whether it regresses
+    past the threshold."""
+    rows = []
+    regressions = []
+    for key in sorted(set(old) & set(new)):
+        a, b = float(old[key]), float(new[key])
+        rel = (b - a) / abs(a) if a else (0.0 if b == a else float("inf"))
+        d = direction(key)
+        regressed = False
+        if d == "up":
+            regressed = rel < -threshold
+        elif d == "down":
+            regressed = rel > threshold
+        row = {"metric": key, "old": a, "new": b,
+               "change_pct": round(rel * 100.0, 2),
+               "direction": d, "regressed": regressed}
+        rows.append(row)
+        if regressed:
+            regressions.append(row)
+    return {"threshold_pct": round(threshold * 100.0, 2),
+            "compared": len(rows),
+            "only_old": sorted(set(old) - set(new)),
+            "only_new": sorted(set(new) - set(old)),
+            "rows": rows,
+            "regressions": regressions}
+
+
+def diff_files(old_path: str, new_path: str, threshold: float) -> dict:
+    old_doc, new_doc = load_bench(old_path), load_bench(new_path)
+    rep = diff(metrics_of(old_doc), metrics_of(new_doc), threshold)
+    rep["old"] = os.path.basename(old_path)
+    rep["new"] = os.path.basename(new_path)
+    return rep
+
+
+def ladder(threshold: float):
+    """Walk the committed BENCH_r*.json ladder pairwise, oldest first."""
+    paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+    reports = []
+    for a, b in zip(paths, paths[1:]):
+        reports.append(diff_files(a, b, threshold))
+    return reports
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH ladder artifacts")
+    ap.add_argument("old", nargs="?", help="older BENCH json")
+    ap.add_argument("new", nargs="?", help="newer BENCH json")
+    ap.add_argument("--ladder", action="store_true",
+                    help="diff every committed adjacent round pair")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any metric regresses past the "
+                         "threshold")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative regression threshold (default 0.10)")
+    args = ap.parse_args(argv)
+    if args.threshold <= 0:
+        print("threshold must be positive", file=sys.stderr)
+        return 2
+    try:
+        if args.ladder:
+            reports = ladder(args.threshold)
+        elif args.old and args.new:
+            reports = [diff_files(args.old, args.new, args.threshold)]
+        else:
+            ap.print_usage(sys.stderr)
+            return 2
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    bad = 0
+    for rep in reports:
+        print(json.dumps(rep, indent=2))
+        bad += len(rep["regressions"])
+    if args.gate and bad:
+        print(f"bench_diff: {bad} regression(s) past "
+              f"{args.threshold:.0%}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

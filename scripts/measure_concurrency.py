@@ -41,7 +41,11 @@ the same for the time-series sampler + armed SLO engine
 (obs/timeseries.py + obs/slo.py, 50 ms ticks — 20x the production
 rate): byte-identical responses, sampler-on qps >= 0.98x off
 (`extra.concurrency.sampler_overhead_32t`), and zero SLO false alarms
-on the clean run.
+on the clean run. A fourth pair does the same for the query-insights
+engine (obs/insights.py; ISSUE 12): per-search fingerprinting + the
+space-saving heavy-hitter sketch pinned ON vs OFF, byte-identical
+responses, paired best-of-reps qps >= 0.98x (noise-floored) →
+`extra.concurrency.insights_overhead_32t`.
 
 Results land in BENCH_out.json under `extra.concurrency` (merged into an
 existing bench emission when present). Run:
@@ -136,7 +140,7 @@ def strip_took(resp: dict) -> str:
 
 
 def run_cell(client, bodies, nthreads: int, mode, tag: str,
-             recorder=None, cost=None, sampler=None):
+             recorder=None, cost=None, sampler=None, insights=None):
     """Closed loop: `nthreads` client threads drain the shared query list;
     every thread records its request wall into a DDSketch histogram.
     `mode` is None for scheduler-off, or a pipeline depth (int) for a
@@ -147,8 +151,12 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     (obs/query_cost.py) the same way for the ledger+cost overhead gate.
     `sampler` pins the time-series sampler + armed SLO engine
     (obs/timeseries.py + obs/slo.py, running at a 50 ms tick — 20x the
-    production default rate) for the sampler-overhead gate."""
+    production default rate) for the sampler-overhead gate. `insights`
+    pins the query-insights engine (obs/insights.py; on is the process
+    default) for the insights-overhead gate — fingerprinting + the
+    heavy-hitter sketch must ride the search boundary for ~free."""
     from opensearch_tpu.obs.flight_recorder import RECORDER
+    from opensearch_tpu.obs.insights import INSIGHTS
     from opensearch_tpu.obs.slo import SLO_ENGINE, default_slos
     from opensearch_tpu.obs.timeseries import SAMPLER
     from opensearch_tpu.serving import SchedulerConfig, ServingScheduler
@@ -158,6 +166,10 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     rec_before = RECORDER.enabled
     if recorder is not None:
         RECORDER.enabled = bool(recorder)
+    ins_before = INSIGHTS.enabled
+    if insights is not None:
+        INSIGHTS.reset()       # per-cell sketch state, bounded ring
+        INSIGHTS.enabled = bool(insights)
     cost_before = os.environ.get("OPENSEARCH_TPU_COST")
     if cost is not None:
         os.environ["OPENSEARCH_TPU_COST"] = "1" if cost else "0"
@@ -256,6 +268,10 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     node.serving = old_serving
     if recorder is not None:
         RECORDER.enabled = rec_before
+    if insights is not None:
+        cell["insights"] = "on" if INSIGHTS.enabled else "off"
+        cell["insights_entries"] = INSIGHTS.stats()["entries"]
+        INSIGHTS.enabled = ins_before
     if cost is not None:
         if cost_before is None:
             os.environ.pop("OPENSEARCH_TPU_COST", None)
@@ -420,6 +436,33 @@ def main():
     samp_pair = {lab: max(reps, key=lambda c: c["qps"])
                  for lab, reps in samp_reps.items()}
 
+    # insights-overhead pair (ISSUE 12): the (32-thread, deepest-depth)
+    # cell with the query-insights engine pinned ON vs OFF — per-search
+    # fingerprinting + the space-saving heavy-hitter sketch must ride
+    # the search boundary for ~free, under the same alternating-reps /
+    # noise-floor / byte-identity protocol as the other three gates.
+    ins_pair = {}
+    ins_reps = {"insights_off": [], "insights_on": []}
+    run_cell(client, bodies, rthreads, rdepth,
+             f"{rthreads}-d{rdepth}-ins-warmup")
+    for rep, (ilabel, iflag) in enumerate(
+            (("insights_off", False), ("insights_on", True),
+             ("insights_on", True), ("insights_off", False))):
+        tag = f"{rthreads}-d{rdepth}-{ilabel}-r{rep}"
+        cell, results = run_cell(client, bodies, rthreads, rdepth, tag,
+                                 insights=iflag)
+        errored += cell["errors"]
+        digests = [strip_took(r) if r is not None else None
+                   for r in results]
+        bad = sum(1 for a, b in zip(digests, canonical) if a != b)
+        cell["identical_responses"] = bad == 0
+        mismatched += bad
+        cells.append(cell)
+        ins_reps[ilabel].append(cell)
+        print(json.dumps(cell), flush=True)
+    ins_pair = {lab: max(reps, key=lambda c: c["qps"])
+                for lab, reps in ins_reps.items()}
+
     summary = {"ndocs": ndocs, "nq": nq,
                "devices": len(jax.devices()),
                "mix": "60% match2 / 40% filtered bool",
@@ -478,6 +521,29 @@ def main():
             "noise_floor": round(snoise, 4),
             "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
             "gate_threshold": round(min(0.98, 1.0 - snoise), 4),
+        }
+    if ins_pair:
+        on_c, off_c = ins_pair["insights_on"], ins_pair["insights_off"]
+        inoise = max(
+            (1.0 - min(c["qps"] for c in reps)
+             / max(max(c["qps"] for c in reps), 1e-9))
+            for reps in ins_reps.values())
+        summary["insights_overhead_32t"] = {
+            "threads": rthreads, "mode": f"d{rdepth}",
+            "protocol": "warmup + alternating off/on/on/off reps; "
+                        "paired best-of-reps ratio, noise-floor "
+                        "threshold",
+            "insights_on_qps": on_c["qps"],
+            "insights_off_qps": off_c["qps"],
+            "insights_on_reps": [c["qps"] for c in
+                                 ins_reps["insights_on"]],
+            "insights_off_reps": [c["qps"] for c in
+                                  ins_reps["insights_off"]],
+            "sketch_entries": max(c.get("insights_entries", 0)
+                                  for c in ins_reps["insights_on"]),
+            "noise_floor": round(inoise, 4),
+            "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+            "gate_threshold": round(min(0.98, 1.0 - inoise), 4),
         }
     if rec_pair:
         on_c, off_c = rec_pair["rec_on"], rec_pair["rec_off"]
@@ -594,6 +660,13 @@ def main():
             raise SystemExit(
                 f"SLO engine false-fired {sp['slo_false_alarms']} "
                 f"alert(s) on a clean concurrency run")
+        ip = summary.get("insights_overhead_32t")
+        if ip and ip["qps_ratio"] < ip["gate_threshold"]:
+            raise SystemExit(
+                f"query-insights overhead gate failed: insights-on qps "
+                f"is {ip['qps_ratio']}x insights-off "
+                f"(< {ip['gate_threshold']}x; noise floor "
+                f"{ip['noise_floor']}) at {ip['threads']} threads")
     print("OK", flush=True)
 
 

@@ -1,0 +1,130 @@
+"""bench_diff (ISSUE 12 satellite): the perf-trajectory differ over the
+committed BENCH ladder — both artifact shapes load, direction-aware
+regression classification works, --gate exits nonzero past threshold,
+and the committed ladder itself parses end to end."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_diff", os.path.join(REPO_ROOT, "scripts", "bench_diff.py"))
+bench_diff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_diff)
+
+
+def _direct_doc(qps, p99, bytes_p50, skip):
+    return {"metric": "bm25_rest_qps_per_chip", "value": qps,
+            "unit": "queries/sec", "vs_baseline": None,
+            "extra": {
+                "bytes_per_query": {"actual": {"count": 10,
+                                               "p50": bytes_p50,
+                                               "p95": bytes_p50 * 4}},
+                "latency_percentiles": {
+                    "search.total": {"count": 10, "p50_ms": p99 / 3,
+                                     "p99_ms": p99}},
+                "impacts": {"v2": {"qps_32t": qps,
+                                   "block_skip_rate": skip,
+                                   "mean_bytes_per_query": bytes_p50}},
+            }}
+
+
+class TestLoad:
+    def test_direct_doc(self, tmp_path):
+        p = tmp_path / "a.json"
+        p.write_text(json.dumps(_direct_doc(100.0, 200.0, 4096, 0.5)))
+        doc = bench_diff.load_bench(str(p))
+        assert doc["value"] == 100.0
+
+    def test_wrapper_doc_parses_tail(self, tmp_path):
+        inner = _direct_doc(50.0, 100.0, 2048, 0.4)
+        p = tmp_path / "w.json"
+        p.write_text(json.dumps({
+            "n": 3, "cmd": "python bench.py", "rc": 0,
+            "tail": "WARNING: some log line\n" + json.dumps(inner) + "\n"}))
+        doc = bench_diff.load_bench(str(p))
+        assert doc["value"] == 50.0 and doc["_round"] == 3
+
+    def test_wrapper_doc_unparsed_tail(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"n": 4, "cmd": "x", "rc": 124,
+                                 "tail": "timed out\n"}))
+        doc = bench_diff.load_bench(str(p))
+        assert doc["extra"]["status"] == "unparsed"
+        assert bench_diff.metrics_of(doc) == {}
+
+    def test_garbage_raises(self, tmp_path):
+        p = tmp_path / "g.json"
+        p.write_text(json.dumps({"something": "else"}))
+        with pytest.raises(ValueError):
+            bench_diff.load_bench(str(p))
+
+
+class TestDiff:
+    def test_direction_classification(self):
+        assert bench_diff.direction("qps") == "up"
+        assert bench_diff.direction("reorder.bp.multi_eq.qps") == "up"
+        assert bench_diff.direction("reorder.bp.multi_eq.lat_ms_p99") \
+            == "down"
+        assert bench_diff.direction("impacts.v2.block_skip_rate") == "up"
+        assert bench_diff.direction(
+            "bytes_per_query.actual.p50_bytes") == "down"
+
+    def test_improvement_is_not_regression(self):
+        old = bench_diff.metrics_of(_direct_doc(100.0, 400.0, 8192, 0.2))
+        new = bench_diff.metrics_of(_direct_doc(150.0, 200.0, 2048, 0.7))
+        rep = bench_diff.diff(old, new, 0.10)
+        assert rep["compared"] > 0
+        assert rep["regressions"] == []
+
+    def test_regression_detected_and_gated(self, tmp_path):
+        a = tmp_path / "old.json"
+        b = tmp_path / "new.json"
+        a.write_text(json.dumps(_direct_doc(100.0, 200.0, 2048, 0.6)))
+        # qps down 30%, p99 up 2x, bytes up 4x: all three directions bad
+        b.write_text(json.dumps(_direct_doc(70.0, 400.0, 8192, 0.6)))
+        rep = bench_diff.diff_files(str(a), str(b), 0.10)
+        bad = {r["metric"] for r in rep["regressions"]}
+        assert "qps" in bad
+        assert "latency.search.total.p99_ms" in bad
+        assert "bytes_per_query.actual.p50_bytes" in bad
+        # --gate exits 1; without it, informational exit 0
+        assert bench_diff.main([str(a), str(b), "--gate"]) == 1
+        assert bench_diff.main([str(a), str(b)]) == 0
+
+    def test_threshold_suppresses_noise(self, tmp_path):
+        a = tmp_path / "old.json"
+        b = tmp_path / "new.json"
+        a.write_text(json.dumps(_direct_doc(100.0, 200.0, 2048, 0.6)))
+        b.write_text(json.dumps(_direct_doc(95.0, 210.0, 2100, 0.58)))
+        rep = bench_diff.diff_files(str(a), str(b), 0.10)
+        assert rep["regressions"] == []
+        # a tighter threshold catches the same drift
+        rep2 = bench_diff.diff_files(str(a), str(b), 0.03)
+        assert any(r["metric"] == "qps" for r in rep2["regressions"])
+
+    def test_usage_errors(self):
+        assert bench_diff.main([]) == 2
+        assert bench_diff.main(["nope.json", "also_nope.json"]) == 2
+
+
+class TestCommittedLadder:
+    def test_every_committed_round_loads(self):
+        import glob
+        paths = sorted(glob.glob(os.path.join(REPO_ROOT,
+                                              "BENCH_r*.json")))
+        assert len(paths) >= 2, "the committed ladder exists"
+        for p in paths:
+            doc = bench_diff.load_bench(p)
+            assert isinstance(bench_diff.metrics_of(doc), dict)
+
+    def test_ladder_walk(self):
+        reports = bench_diff.ladder(0.10)
+        assert reports, "adjacent pairs compared"
+        for rep in reports:
+            assert rep["compared"] >= 0
+            assert "regressions" in rep

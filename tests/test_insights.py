@@ -1,0 +1,665 @@
+"""Query insights (ISSUE 12): workload fingerprinting, the space-saving
+heavy-hitter sketch, federation, and SLO-burn attribution.
+
+- Fingerprinting: values stripped (same shape + different values -> one
+  fingerprint; raw text never in the shape), distinct structures split,
+  lane/agg/sort/size features, garbage-safe.
+- Space-saving sketch: exactness under capacity, the classic error
+  bounds over an overflowing stream (`true <= est <= true + error`,
+  `error <= N/capacity`, heavy hitters always monitored), a 32-thread
+  record hammer (no lost or torn entries within capacity), O(capacity)
+  memory under a 10k-distinct-shape workload.
+- Merge: commutativity, merged-vs-union-oracle parity under capacity,
+  absence pricing against full wires.
+- Engine + REST: real searches populate `GET /_insights/top_queries`
+  (by=latency|count|bytes, windowed, 405/bad-window handling), the
+  bounded `/_metrics` export, cache-hit/bytes attribution.
+- Federation: two DistClusterNodes with injected engines — the merged
+  fleet top-N equals a single engine fed the union workload; dead
+  members degrade honestly.
+- SLO burn: a firing alert carries the top fingerprints active in the
+  offending window, worst-timeline linked (the remediation input).
+- Disabled engine: near-zero overhead at the search boundary.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.obs.insights import (INSIGHTS, QueryInsights,
+                                         SpaceSavingSketch, fingerprint,
+                                         merge_windowed_wires,
+                                         merge_wires)
+from opensearch_tpu.rest.client import ApiError, RestClient
+
+
+def _get(addr, path, timeout=15):
+    with urllib.request.urlopen(f"http://{addr}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+# ----------------------------------------------------------------------
+# fingerprinting
+# ----------------------------------------------------------------------
+
+class TestFingerprint:
+    def test_values_stripped_same_shape_one_fingerprint(self):
+        k1, s1, _ = fingerprint({"query": {"match": {
+            "body": "confidential payroll data"}}})
+        k2, s2, _ = fingerprint({"query": {"match": {
+            "body": "totally different words here"}}})
+        assert k1 == k2 and s1 == s2 == "match(body)"
+        # the raw text never survives into shape or features
+        assert "confidential" not in s1
+
+    def test_no_value_tokens_anywhere(self):
+        secret = "user-secret-string-xyzzy"
+        body = {"query": {"bool": {
+            "must": [{"match": {"title": secret}}],
+            "filter": [{"term": {"tenant": secret}}],
+            "should": [{"range": {"price": {"gte": 42}}}]}},
+            "aggs": {"a": {"terms": {"field": "tenant"}}}}
+        key, shape, features = fingerprint(body)
+        blob = json.dumps([key, shape, features])
+        assert secret not in blob
+        assert "42" not in shape
+
+    def test_distinct_structures_split(self):
+        k1, _, _ = fingerprint({"query": {"match": {"body": "x"}}})
+        k2, _, _ = fingerprint({"query": {"match": {"title": "x"}}})
+        k3, _, _ = fingerprint({"query": {"term": {"body": "x"}}})
+        assert len({k1, k2, k3}) == 3
+
+    def test_lane_and_size_and_sort_split(self):
+        b = {"query": {"match": {"body": "x"}}}
+        ki, _, _ = fingerprint(b, "interactive")
+        kb, _, _ = fingerprint(b, "batch")
+        assert ki != kb
+        k10, _, _ = fingerprint(dict(b, size=10))
+        k500, _, f500 = fingerprint(dict(b, size=500))
+        assert k10 != k500 and f500["size_bucket"] == 512
+        ks, _, fs = fingerprint(dict(b, sort=[{"price": "desc"}]))
+        assert ks != k10 and fs["sort"] == "field"
+
+    def test_term_count_bucket_in_identity(self):
+        # a 1-term and a 30-term match are different workloads: the
+        # pow2 term-count bucket rides the digest (nearby counts still
+        # share one fingerprint — cardinality stays bounded)
+        k1, _, f1 = fingerprint({"query": {"match": {"body": "one"}}})
+        k30, _, f30 = fingerprint({"query": {"match": {
+            "body": " ".join(f"w{i}" for i in range(30))}}})
+        assert k1 != k30
+        assert f1["terms_bucket"] == 1 and f30["terms_bucket"] == 32
+        k3, _, _ = fingerprint({"query": {"match": {"body": "a b c"}}})
+        k4, _, _ = fingerprint({"query": {"match": {"body": "a b c d"}}})
+        assert k3 == k4          # same pow2 bucket
+
+    def test_agg_features(self):
+        _, _, f = fingerprint({"query": {"match_all": {}},
+                               "aggs": {"g": {"terms": {"field": "s"},
+                                              "aggs": {"m": {"avg": {
+                                                  "field": "p"}}}}}})
+        assert "terms" in f["aggs"] and "avg" in f["aggs"]
+
+    def test_term_count_feature(self):
+        _, _, f = fingerprint({"query": {"match": {
+            "body": "one two three four"}}})
+        assert f["terms"] == 4
+
+    def test_garbage_never_raises(self):
+        for body in ({}, {"query": 7}, {"query": {"bool": {"must": 7}}},
+                     {"query": {"bool": None}}, {"size": "huge"},
+                     {"query": {(1, 2): "x"}} if False else
+                     {"query": {"weird": object()}}):
+            key, shape, _ = fingerprint(body)      # must not raise
+            assert isinstance(key, str) and len(key) == 12
+
+    def test_deep_nesting_bounded(self):
+        q = {"match": {"f": "x"}}
+        for _ in range(50):
+            q = {"bool": {"must": [q]}}
+        key, shape, _ = fingerprint({"query": q})
+        assert len(shape) <= 512 and len(key) == 12
+
+
+# ----------------------------------------------------------------------
+# the space-saving sketch
+# ----------------------------------------------------------------------
+
+class TestSpaceSaving:
+    def test_exact_under_capacity(self):
+        sk = SpaceSavingSketch(16)
+        for i in range(10):
+            for _ in range(i + 1):
+                sk.record(f"k{i}", f"k{i}", {})
+        w = sk.to_wire()
+        assert not w["full"]
+        by = {e["fingerprint"]: e for e in w["entries"]}
+        for i in range(10):
+            assert by[f"k{i}"]["count"] == i + 1
+            assert by[f"k{i}"]["error"] == 0
+
+    def test_error_bounds_over_overflowing_stream(self):
+        # the classic space-saving guarantees on a skewed stream far
+        # past capacity: overestimation bounded by per-entry error,
+        # error bounded by N/capacity, heavy hitters always monitored
+        rng = np.random.default_rng(7)
+        cap = 32
+        sk = SpaceSavingSketch(cap)
+        true = {}
+        n = 6000
+        keys = [f"s{int(k)}" for k in
+                rng.zipf(1.3, size=n) % 500]
+        for k in keys:
+            true[k] = true.get(k, 0) + 1
+            sk.record(k, k, {})
+        w = sk.to_wire()
+        assert len(w["entries"]) == cap
+        assert w["total_records"] == n
+        for e in w["entries"]:
+            t = true.get(e["fingerprint"], 0)
+            assert t <= e["count"] <= t + e["error"]
+            assert e["error"] <= n / cap
+        monitored = {e["fingerprint"] for e in w["entries"]}
+        for k, t in true.items():
+            if t > n / cap:
+                assert k in monitored, (k, t)
+
+    def test_memory_bounded_10k_distinct_shapes(self):
+        eng = QueryInsights(capacity=64, window_capacity=256,
+                            enabled=True)
+        for i in range(10_000):
+            eng.sketch.record(f"shape{i}", f"kind{i}(f)", {})
+        assert len(eng.sketch) == 64
+        assert eng.sketch.total_records == 10_000
+        assert eng.sketch.evictions == 10_000 - 64
+        assert len(eng.top(by="count", n=10)) == 10
+
+    def test_hammer_32_threads_no_lost_entries(self):
+        # within capacity every (key, record) must land exactly once —
+        # 32 writers over 16 keys, per-key counts sum to the total
+        sk = SpaceSavingSketch(64)
+        nthreads, per = 32, 200
+
+        def worker(tid):
+            for i in range(per):
+                k = f"k{(tid + i) % 16}"
+                sk.record(k, k, {}, latency_ms=float(i % 7),
+                          bytes_moved=8)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(nthreads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        w = sk.to_wire()
+        assert sk.total_records == nthreads * per
+        assert sum(e["count"] for e in w["entries"]) == nthreads * per
+        assert sum(e["latency"]["count"]
+                   for e in w["entries"]) == nthreads * per
+        assert sum(e["bytes_moved"]
+                   for e in w["entries"]) == nthreads * per * 8
+        assert all(e["error"] == 0 for e in w["entries"])
+
+    def test_aggregate_fields(self):
+        sk = SpaceSavingSketch(8)
+        sk.record("a", "match(f)", {}, latency_ms=100.0, bytes_moved=64,
+                  blocks_total=10, blocks_skipped=7, cache_hit=True,
+                  timeline_id=99)
+        sk.record("a", "match(f)", {}, latency_ms=10.0, rejected=True,
+                  error=True, escalations=1)
+        e = sk.to_wire()["entries"][0]
+        assert e["cache_hits"] == 1 and e["rejections"] == 1
+        assert e["errors"] == 1 and e["escalations"] == 1
+        assert e["blocks_total"] == 10 and e["blocks_skipped"] == 7
+        assert e["worst_ms"] == 100.0 and e["worst_timeline"] == 99
+        assert e["latency"]["count"] == 2
+
+
+# ----------------------------------------------------------------------
+# merge algebra
+# ----------------------------------------------------------------------
+
+def _fill(sketch, counts, **kw):
+    for k, n in counts.items():
+        for _ in range(n):
+            sketch.record(k, k, {}, **kw)
+
+
+class TestMerge:
+    def test_commutative(self):
+        a, b = SpaceSavingSketch(8), SpaceSavingSketch(8)
+        _fill(a, {"x": 5, "y": 3}, latency_ms=10.0)
+        _fill(b, {"y": 2, "z": 7}, latency_ms=20.0)
+        wa, wb = a.to_wire(), b.to_wire()
+        assert merge_wires([wa, wb], 8) == merge_wires([wb, wa], 8)
+
+    def test_merged_vs_union_oracle_parity(self):
+        # under capacity the sketch is exact, so a two-node merge must
+        # equal ONE sketch fed the union stream — counts, errors,
+        # latency sketches, aggregate tallies, the whole entry set
+        a, b = SpaceSavingSketch(32), SpaceSavingSketch(32)
+        oracle = SpaceSavingSketch(32)
+        rng = np.random.default_rng(3)
+        for i in range(300):
+            k = f"k{int(rng.integers(0, 20))}"
+            lat = float(rng.uniform(1, 500))
+            nb = int(rng.integers(0, 4096))
+            if i % 2:
+                a.record(k, k, {}, latency_ms=lat, bytes_moved=nb)
+            else:
+                b.record(k, k, {}, latency_ms=lat, bytes_moved=nb)
+            oracle.record(k, k, {}, latency_ms=lat, bytes_moved=nb)
+        merged = merge_wires([a.to_wire(), b.to_wire()], 32)
+        ow = oracle.to_wire()
+        m_by = {e["fingerprint"]: e for e in merged["entries"]}
+        assert set(m_by) == {e["fingerprint"] for e in ow["entries"]}
+        for oe in ow["entries"]:
+            me = m_by[oe["fingerprint"]]
+            assert me["count"] == oe["count"]
+            assert me["error"] == 0
+            assert me["bytes_moved"] == oe["bytes_moved"]
+            assert me["latency"]["bins"] == oe["latency"]["bins"]
+            assert me["latency"]["count"] == oe["latency"]["count"]
+        assert merged["total_records"] == ow["total_records"]
+
+    def test_absence_priced_against_full_wires(self):
+        # a key missing from a FULL sketch may hide up to min_count
+        # occurrences there: the merged error must widen by that bound
+        a = SpaceSavingSketch(2)
+        _fill(a, {"x": 10, "y": 6, "z": 1})     # z evicted/overflowed
+        b = SpaceSavingSketch(2)
+        _fill(b, {"q": 4, "r": 2})
+        merged = merge_wires([a.to_wire(), b.to_wire()], 4)
+        by = {e["fingerprint"]: e for e in merged["entries"]}
+        # q is absent from a (full, min_count known): error widens
+        assert by["q"]["error"] >= a.to_wire()["min_count"]
+
+    def test_windowed_merge_commutative_and_sums(self):
+        wa = {"entries": [{"fingerprint": "x", "count": 3,
+                           "latency_sum_ms": 30.0, "max_ms": 20.0,
+                           "bytes_moved": 64, "shape": "match(f)"}]}
+        wb = {"entries": [{"fingerprint": "x", "count": 2,
+                           "latency_sum_ms": 10.0, "max_ms": 8.0,
+                           "bytes_moved": 16, "shape": "match(f)"}]}
+        m1 = merge_windowed_wires([wa, wb], 8, 60.0)
+        m2 = merge_windowed_wires([wb, wa], 8, 60.0)
+        assert m1 == m2
+        e = m1["entries"][0]
+        assert e["count"] == 5 and e["latency_sum_ms"] == 40.0
+        assert e["bytes_moved"] == 80 and e["max_ms"] == 20.0
+        assert e["latency_mean_ms"] == 8.0
+
+    def test_windowed_merge_worst_timeline_follows_worst_latency(self):
+        # the timeline link must point at the SLOWEST request's journal
+        # no matter which member answered first
+        wa = {"entries": [{"fingerprint": "x", "count": 1,
+                           "latency_sum_ms": 10.0, "max_ms": 10.0,
+                           "bytes_moved": 0, "shape": "match(f)",
+                           "worst_timeline": 101}]}
+        wb = {"entries": [{"fingerprint": "x", "count": 1,
+                           "latency_sum_ms": 900.0, "max_ms": 900.0,
+                           "bytes_moved": 0, "shape": "match(f)",
+                           "worst_timeline": 202}]}
+        for order in ([wa, wb], [wb, wa]):
+            e = merge_windowed_wires(order, 8, 60.0)["entries"][0]
+            assert e["max_ms"] == 900.0
+            assert e["worst_timeline"] == 202
+
+    def test_lifetime_merge_worst_timeline_follows_worst_ms(self):
+        a, b = SpaceSavingSketch(4), SpaceSavingSketch(4)
+        a.record("x", "x", {}, latency_ms=10.0, timeline_id=101)
+        b.record("x", "x", {}, latency_ms=900.0, timeline_id=202)
+        for order in ([a.to_wire(), b.to_wire()],
+                      [b.to_wire(), a.to_wire()]):
+            e = merge_wires(order, 4)["entries"][0]
+            assert e["worst_timeline"] == 202
+
+
+# ----------------------------------------------------------------------
+# engine + REST surface (single node over real HTTP)
+# ----------------------------------------------------------------------
+
+@pytest.fixture()
+def http_node():
+    from opensearch_tpu.rest.http_server import HttpServer
+    INSIGHTS.reset()
+    c = RestClient()
+    c.indices.create("qi", {"mappings": {"properties": {
+        "body": {"type": "text"}, "status": {"type": "keyword"}}}})
+    for i in range(8):
+        c.index("qi", {"body": f"alpha beta w{i}",
+                       "status": "a" if i % 2 else "b"}, id=str(i))
+    c.indices.refresh("qi")
+    srv = HttpServer(c)
+    port = srv.start()
+    try:
+        yield c, f"127.0.0.1:{port}"
+    finally:
+        srv.stop()
+        INSIGHTS.reset()
+
+
+class TestEngineAndRest:
+    def test_searches_populate_top_queries(self, http_node):
+        c, addr = http_node
+        for _ in range(4):
+            c.search("qi", {"query": {"match": {"body": "alpha"}}})
+        c.search("qi", {"query": {"bool": {
+            "must": [{"match": {"body": "alpha"}}],
+            "filter": [{"term": {"status": "a"}}]}}})
+        out = _get(addr, "/_insights/top_queries?by=count&n=5")
+        assert out["_nodes"]["successful"] == 1
+        top = out["top_queries"]
+        assert top and top[0]["shape"] == "match(body)"
+        assert top[0]["count"] == 4
+        # request-cache hits count as activity AND as cache hits
+        assert top[0]["cache_hits"] == 3
+        assert top[0]["latency"]["count"] == 4
+        shapes = [t["shape"] for t in top]
+        assert "bool(must:[match(body)],filter:[term(status)])" in shapes
+        # latency/bytes orderings serve the same entry set
+        for by in ("latency", "bytes"):
+            o2 = _get(addr, f"/_insights/top_queries?by={by}")
+            assert {t["fingerprint"] for t in o2["top_queries"]} \
+                == {t["fingerprint"] for t in top}
+
+    def test_windowed_top_queries(self, http_node):
+        c, addr = http_node
+        c.search("qi", {"query": {"match": {"body": "alpha"}}})
+        out = _get(addr, "/_insights/top_queries?by=latency&window=60")
+        assert out["window_s"] == 60.0
+        assert out["top_queries"][0]["count"] >= 1
+        assert "latency_mean_ms" in out["top_queries"][0]
+        # a zero-width window excludes everything that isn't imminent
+        out2 = _get(addr,
+                    "/_insights/top_queries?by=latency&window=0.0001")
+        assert isinstance(out2["top_queries"], list)
+
+    def test_rest_error_shapes(self, http_node):
+        _c, addr = http_node
+        # 405: POST against a read surface
+        req = urllib.request.Request(
+            f"http://{addr}/_insights/top_queries", data=b"{}",
+            method="POST", headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 405
+        # 400: bad window / bad by / bad n (negative n must not dump
+        # the sketch on the federated path — same contract everywhere)
+        for q in ("window=abc", "window=-5", "by=nope", "n=abc",
+                  "n=-1"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(addr, f"/_insights/top_queries?{q}")
+            assert ei.value.code == 400, q
+
+    def test_insights_status_and_nodes_stats_block(self, http_node):
+        c, addr = http_node
+        c.search("qi", {"query": {"match": {"body": "alpha"}}})
+        st = _get(addr, "/_insights")["insights"]
+        assert st["enabled"] and st["entries"] >= 1
+        blk = c.nodes_stats()["nodes"][c.node.node_name]["insights"]
+        assert blk["capacity"] == INSIGHTS.capacity
+        assert blk["total_records"] >= 1
+
+    def test_metrics_export_bounded_and_text_free(self, http_node):
+        c, addr = http_node
+        secret = "needle-string-qq"
+        for _ in range(3):
+            c.search("qi", {"query": {"match": {"body": secret}}})
+        with urllib.request.urlopen(f"http://{addr}/_metrics",
+                                    timeout=10) as r:
+            text = r.read().decode()
+        assert "ostpu_insights_top_query_count{" in text
+        assert 'fingerprint="' in text
+        assert secret not in text
+        # bounded: at most 10 fingerprints per series regardless of
+        # workload cardinality
+        lines = [ln for ln in text.splitlines()
+                 if ln.startswith("ostpu_insights_top_query_count{")]
+        assert 0 < len(lines) <= 10
+
+    def test_slowlog_carries_fingerprint(self, http_node):
+        c, _addr = http_node
+        svc = c.node.indices["qi"]
+        svc.search_slowlog.thresholds = {"trace": 0.0}
+        c.search("qi", {"query": {"match": {"body": "alpha slow"}}})
+        entries = list(svc.search_slowlog.entries)
+        assert entries
+        key, _, _ = fingerprint({"query": {"match": {
+            "body": "alpha slow"}}})
+        assert entries[-1].get("fingerprint") == key
+
+    def test_wlm_rejection_attributed(self, http_node):
+        c, _addr = http_node
+        c.node.wlm.put_group("throttled", search_rate=0,
+                             search_burst=0)
+        body = {"query": {"match": {"body": "alpha"}},
+                "_workload_group": "throttled"}
+        with pytest.raises(ApiError) as ei:
+            c.search("qi", dict(body))
+        assert ei.value.status == 429
+        key, _, _ = fingerprint({"query": {"match": {"body": "alpha"}}})
+        wire = INSIGHTS.sketch.to_wire()
+        by = {e["fingerprint"]: e for e in wire["entries"]}
+        assert by[key]["rejections"] >= 1
+
+    def test_disabled_engine_records_nothing_and_is_cheap(self,
+                                                         http_node):
+        c, _addr = http_node
+        from opensearch_tpu.obs import insights as _ins
+        INSIGHTS.reset()
+        INSIGHTS.enabled = False
+        try:
+            c.search("qi", {"query": {"match": {"body": "alpha"}}})
+            assert len(INSIGHTS.sketch) == 0
+            # the boundary guard is one attribute read: 10k begin/finish
+            # pairs must be effectively free
+            t0 = time.perf_counter()
+            for _ in range(10_000):
+                obs, tok = _ins.begin({"query": {}}, "interactive")
+                _ins.finish(tok, obs, latency_ms=1.0)
+            dt = time.perf_counter() - t0
+            assert dt < 10_000 * 30e-6, f"disabled overhead {dt:.3f}s"
+        finally:
+            INSIGHTS.enabled = True
+
+
+# ----------------------------------------------------------------------
+# two-node federation
+# ----------------------------------------------------------------------
+
+@pytest.fixture()
+def cluster():
+    from opensearch_tpu.cluster.distnode import DistClusterNode
+    a = DistClusterNode("qa")
+    b = DistClusterNode("qb", seed=a.addr)
+    a.create_index("qidx", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    for i in range(10):
+        a.index_doc("qidx", {"body": f"alpha w{i}"}, id=str(i))
+    a.refresh("qidx")
+    try:
+        yield a, b
+    finally:
+        a.stop()
+        try:
+            b.stop()
+        except Exception:       # noqa: BLE001 — already stopped
+            pass
+
+
+class TestFederation:
+    def _workloads(self, cap=64):
+        # disjoint + overlapping synthetic workloads, >10k distinct
+        # shapes total (the ISSUE 12 acceptance bar): memory must stay
+        # at the configured capacity on every node
+        ea = QueryInsights(capacity=cap, enabled=True)
+        eb = QueryInsights(capacity=cap, enabled=True)
+        oracle = QueryInsights(capacity=cap, enabled=True)
+        rng = np.random.default_rng(17)
+        for i in range(20_500):
+            k = f"hot{int(rng.integers(0, 10))}" if i % 2 else \
+                f"cold{i}"
+            eng = ea if i % 3 else eb
+            eng.sketch.record(k, f"{k}-shape", {},
+                              latency_ms=float(rng.uniform(1, 50)))
+            oracle.sketch.record(k, f"{k}-shape", {})
+        # > 10k distinct shapes hit the two nodes combined
+        assert oracle.sketch.total_records == 20_500
+        return ea, eb, oracle
+
+    def test_federated_top_matches_oracle_heavy_hitters(self, cluster):
+        a, b = cluster
+        ea, eb, oracle = self._workloads()
+        a.insights_engine, b.insights_engine = ea, eb
+        assert len(ea.sketch) <= 64 and len(eb.sketch) <= 64
+        out = a.top_queries_federated(by="count", n=10)
+        assert out["_nodes"] == {"total": 2, "successful": 2,
+                                 "failed": 0}
+        got = [(e["fingerprint"], e["count"])
+               for e in out["top_queries"]]
+        # the hot shapes dominate and their merged counts carry the
+        # space-saving bound vs the oracle's
+        oracle_top = {e["fingerprint"]: e["count"]
+                      for e in oracle.top(by="count", n=10)}
+        for fp, cnt in got:
+            if fp.startswith("hot"):
+                t = oracle_top.get(fp)
+                assert t is not None and cnt >= t > 0
+        assert sum(1 for fp, _ in got if fp.startswith("hot")) >= 8
+
+    def test_both_coordinators_answer_identically(self, cluster):
+        a, b = cluster
+        ea, eb, _ = self._workloads()
+        a.insights_engine, b.insights_engine = ea, eb
+        ta = a.top_queries_federated(by="count", n=10)["top_queries"]
+        tb = b.top_queries_federated(by="count", n=10)["top_queries"]
+        assert ta == tb
+
+    def test_federated_over_http_and_real_search(self, cluster):
+        a, _b = cluster
+        INSIGHTS.reset()
+        # a REAL distributed search lands on the coordinator's process
+        # engine under the same shape identity a single node derives
+        a.search("qidx", {"query": {"match": {"body": "alpha"}}})
+        out = _get(a.addr, "/_insights/top_queries?by=count")
+        assert out["_nodes"]["total"] == 2
+        key, _, _ = fingerprint({"query": {"match": {"body": "alpha"}}})
+        assert any(e["fingerprint"] == key
+                   for e in out["top_queries"])
+        INSIGHTS.reset()
+
+    def test_windowed_federation(self, cluster):
+        a, b = cluster
+        ea = QueryInsights(capacity=16, enabled=True)
+        eb = QueryInsights(capacity=16, enabled=True)
+        a.insights_engine, b.insights_engine = ea, eb
+        for eng, n in ((ea, 3), (eb, 2)):
+            for _ in range(n):
+                eng.record_observation(
+                    _obs("x-shape"), latency_ms=10.0)
+        out = a.top_queries_federated(by="count", n=5, window_s=60.0)
+        assert out["window_s"] == 60.0
+        e = out["top_queries"][0]
+        assert e["count"] == 5 and e["latency_sum_ms"] == 50.0
+
+    def test_dead_member_degrades(self, cluster):
+        a, b = cluster
+        ea, eb, _ = self._workloads(cap=16)
+        a.insights_engine, b.insights_engine = ea, eb
+        b.stop()
+        out = a.top_queries_federated(by="count", n=5)
+        assert out["_nodes"]["failed"] == 1
+        assert out["nodes"]["qb"]["status"] == "failed"
+        assert out["top_queries"], "the live member still answers"
+
+    def test_bad_by_is_400(self, cluster):
+        a, _b = cluster
+        with pytest.raises(ApiError) as ei:
+            a.top_queries_federated(by="nope")
+        assert ei.value.status == 400
+
+
+def _obs(key: str):
+    from opensearch_tpu.obs.insights import Observation
+    return Observation(key, f"{key}!", {}, "interactive")
+
+
+# ----------------------------------------------------------------------
+# SLO-burn attribution
+# ----------------------------------------------------------------------
+
+class TestSLOBurnAttribution:
+    def test_firing_alert_carries_top_fingerprints(self):
+        from opensearch_tpu.obs.flight_recorder import RECORDER
+        from opensearch_tpu.obs.slo import SLO, SLOEngine
+        from opensearch_tpu.obs.timeseries import TimeSeriesSampler
+        from opensearch_tpu.utils.metrics import MetricsRegistry
+        RECORDER.reset()
+        INSIGHTS.reset()
+        # the offending window's workload: two shapes, one dominant,
+        # worst-timeline linked
+        for i in range(6):
+            o = _obs("heavyshape000")
+            o.bytes_moved = 1024
+            INSIGHTS.record_observation(o, latency_ms=400.0 + i,
+                                        timeline_id=77)
+        INSIGHTS.record_observation(_obs("lightshape111"),
+                                    latency_ms=1.0)
+        reg = MetricsRegistry()
+        sampler = TimeSeriesSampler(registry=reg, interval_s=0.01,
+                                    capacity=64)
+        engine = SLOEngine(sampler=sampler, registry=reg)
+        engine.arm([SLO("burnit", "counter_ratio", target=0.95,
+                        fast_window_s=60.0, slow_window_s=120.0,
+                        bad_metrics=["bad"], total_metrics=["total"],
+                        burn_threshold=2.0)])
+        reg.counter("total").inc(50)
+        sampler.sample_once()
+        reg.counter("bad").inc(50)
+        reg.counter("total").inc(50)
+        sampler.sample_once()
+        status = engine.status()
+        assert status["status"]["burnit"]["state"] == "firing"
+        alert = status["alerts"][0]
+        fps = alert["top_fingerprints"]
+        assert fps, "a firing alert names the offending workload"
+        assert fps[0]["fingerprint"] == "heavyshape000"
+        assert fps[0]["count"] == 6
+        assert fps[0]["worst_timeline"] == 77
+        # the frozen dump's slo.burn event carries the same attribution
+        dumps = [d for d in RECORDER.dumps()
+                 if d["reason"] == "slo_burn"]
+        assert dumps
+        evs = [e for tl in dumps[0]["timelines"].values()
+               for e in tl["events"] if e["kind"] == "slo.burn"]
+        assert evs and evs[0]["top_fingerprints"]
+        assert evs[0]["top_fingerprints"][0]["fingerprint"] \
+            == "heavyshape000"
+        engine.disarm()
+        RECORDER.reset()
+        INSIGHTS.reset()
+
+    def test_attribution_never_breaks_firing(self):
+        # a poisoned insights engine must read as an empty attribution
+        # list, never a failed alert
+        from opensearch_tpu.obs.slo import SLOEngine
+        import opensearch_tpu.obs.insights as ins_mod
+        saved = ins_mod.INSIGHTS
+        class _Boom:
+            def top_fingerprints(self, *a, **k):
+                raise RuntimeError("poisoned")
+        try:
+            ins_mod.INSIGHTS = _Boom()
+            assert SLOEngine._insights_top(60.0) == []
+        finally:
+            ins_mod.INSIGHTS = saved

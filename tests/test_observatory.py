@@ -140,19 +140,30 @@ class TestPrometheusExposition:
             h.record(v)
         return reg
 
+    def _golden_insights(self):
+        # the bounded top-K query-shape export (obs/insights.py): shape
+        # HASHES as labels, never query text — extending the golden file
+        # pins the exposition shape AND the label discipline
+        return [{"fingerprint": "a1b2c3d4e5f6", "count": 42,
+                 "latency_sum_ms": 1234.5, "bytes_moved": 81920},
+                {"fingerprint": "0f9e8d7c6b5a", "count": 7,
+                 "latency_sum_ms": 77.25, "bytes_moved": 4096}]
+
     def test_golden_file(self):
-        text = render_prometheus(self._golden_registry(), node="node-a")
+        text = render_prometheus(self._golden_registry(), node="node-a",
+                                 insights=self._golden_insights())
         with open(GOLDEN) as fh:
             assert text == fh.read()
 
     def test_help_type_pairs_for_every_sample(self):
-        text = render_prometheus(self._golden_registry(), node="n")
+        text = render_prometheus(self._golden_registry(), node="n",
+                                 insights=self._golden_insights())
         lines = text.strip().splitlines()
         helps = {ln.split()[2] for ln in lines
                  if ln.startswith("# HELP")}
         types = {ln.split()[2] for ln in lines
                  if ln.startswith("# TYPE")}
-        assert helps == types and len(helps) == 5
+        assert helps == types and len(helps) == 8
         # every sample line's metric (modulo _sum/_count suffix) has a
         # TYPE header
         for ln in lines:

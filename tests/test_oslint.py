@@ -1013,6 +1013,137 @@ class TestSamplerDiscipline:
         assert [f.render() for f in findings if f.rule == "OSL509"] == []
 
 
+class TestInsightsCardinality:
+    """OSL602 — cardinality discipline for workload-keyed observability
+    (obs/insights.py): per-key stores on obs/ record paths need an
+    explicit capacity bound in scope; metric names never interpolate
+    raw query/body text."""
+
+    def test_osl602_unbounded_keyed_growth(self):
+        # the leak the rule exists for: per-fingerprint dict grows with
+        # workload cardinality, no bound anywhere in the file
+        src = """
+            class ShapeStats:
+                def __init__(self):
+                    self._by_shape = {}
+
+                def record(self, key, ms):
+                    self._by_shape[key] = self._by_shape.get(key, 0) + 1
+        """
+        found = lint(src, "opensearch_tpu/obs/insights.py")
+        assert [f for f in found
+                if f.detail == "unbounded-keyed-growth:_by_shape"]
+
+    def test_osl602_setdefault_growth(self):
+        src = """
+            class ShapeStats:
+                def __init__(self):
+                    self._agg = {}
+
+                def note_latency(self, key, ms):
+                    self._agg.setdefault(key, []).append(ms)
+        """
+        found = lint(src, "opensearch_tpu/obs/insights.py")
+        assert [f for f in found
+                if f.detail == "unbounded-keyed-growth:_agg"]
+
+    def test_osl602_quiet_with_eviction_in_scope(self):
+        # the sanctioned space-saving pattern: len()-vs-capacity check +
+        # eviction on the same attribute
+        src = """
+            class Sketch:
+                def __init__(self, capacity):
+                    self.capacity = capacity
+                    self._entries = {}
+
+                def record(self, key):
+                    if key not in self._entries and \\
+                            len(self._entries) >= self.capacity:
+                        victim = min(self._entries)
+                        self._entries.pop(victim)
+                    self._entries[key] = self._entries.get(key, 0) + 1
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/insights.py")) \
+            == []
+
+    def test_osl602_quiet_on_bounded_ring_and_fixed_slots(self):
+        # deque(maxlen=) rings and [None]*capacity slot stores are
+        # bounded by construction (the recorder/timeseries patterns)
+        src = """
+            from collections import deque
+
+            class Ring:
+                def __init__(self, capacity):
+                    self._recent = deque(maxlen=capacity)
+                    self._slots = [None] * capacity
+                    self._n = capacity
+
+                def record(self, key, ms):
+                    self._recent.append((key, ms))
+                    self._slots[hash(key) % self._n] = ms
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/insights.py")) \
+            == []
+
+    def test_osl602_local_dict_quiet(self):
+        # a per-call local aggregation dies with the call — not
+        # retention, any key cardinality is fine
+        src = """
+            class Reader:
+                def record_window(self, events):
+                    agg = {}
+                    for key, ms in events:
+                        agg[key] = agg.get(key, 0) + 1
+                    return agg
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/insights.py")) \
+            == []
+
+    def test_osl602_raw_query_in_metric_name(self):
+        # unbounded user strings as metric names: cardinality bomb AND
+        # a request-content leak into scrape output
+        src = """
+            from opensearch_tpu.utils.metrics import METRICS
+
+            def count_query(query_text):
+                METRICS.counter(f"search.shape.{query_text}").inc()
+        """
+        found = lint(src, "opensearch_tpu/obs/insights.py")
+        assert [f for f in found
+                if f.detail == "raw-query-in-metric-name"]
+
+    def test_osl602_hash_and_lane_labels_quiet(self):
+        # shape hashes, lanes and enum kinds are the sanctioned label
+        # vocabulary
+        src = """
+            from opensearch_tpu.utils.metrics import METRICS
+
+            def count_shape(fingerprint, lane):
+                METRICS.counter(f"search.lane.{lane}.requests").inc()
+                METRICS.gauge(f"insights.{fingerprint}.count").set(1)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/insights.py")) \
+            == []
+
+    def test_osl602_growth_scope_is_obs(self):
+        # the keyed-growth rule patrols obs/ — a search-layer cache with
+        # its own eviction story is other rules' business
+        src = """
+            class Cache:
+                def record(self, key, v):
+                    self._store[key] = v
+        """
+        assert rules_of(lint(src, "opensearch_tpu/search/cache.py")) \
+            == []
+
+    def test_osl602_repo_clean(self):
+        # the ratchet at zero: the live insights engine, recorder,
+        # ledger and cost accumulators are all disciplined (or carry
+        # inline justifications)
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f.render() for f in findings if f.rule == "OSL602"] == []
+
+
 # ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
