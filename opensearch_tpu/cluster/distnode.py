@@ -342,6 +342,13 @@ class DistClusterNode:
         # members are demoted in every shard's preference order until a
         # successful probe/RPC (cluster/failure.py)
         self.member_fd = MemberFailureDetector()
+        # wire the detector into an already-armed remediation actuator
+        # (OPENSEARCH_TPU_REMEDIATION=1 arms at Node init, BEFORE this
+        # cluster wrapper exists): without this, the deprioritize_member
+        # action would be silently inert on the production arm path
+        rem = self.node.remediation
+        if rem is not None and rem.member_fd is None:
+            rem.member_fd = self.member_fd
         # registry this node answers fleet scrapes from. None -> the
         # process-default METRICS (the one-node-per-process deployment);
         # in-process multi-node tests inject distinct registries so the
@@ -353,6 +360,11 @@ class DistClusterNode:
         # federates genuinely disjoint workloads (the obs_registry
         # pattern above)
         self.insights_engine = None
+        # remediation actuator this node's admission path consults and
+        # `/_internal/remediation` answers from. None -> the
+        # process-default REMEDIATOR; the traffic harness injects
+        # per-node instances (same pattern as insights_engine)
+        self.remediation_engine = None
         if seed is not None:
             st = _http(seed, "POST", "/_internal/join",
                        {"name": name, "addr": self.addr})
@@ -431,7 +443,7 @@ class DistClusterNode:
             return 200, {"acknowledged": True}
         if op in ("dfs", "query_phase", "fetch_phase",
                   "stats", "node_stats", "hot_threads", "history",
-                  "insights"):
+                  "insights", "remediation"):
             # deadline propagation: re-anchor the remaining budget the
             # coordinator stamped; an already-exhausted budget answers an
             # immediate 408 shard failure instead of a full local phase
@@ -447,7 +459,7 @@ class DistClusterNode:
                               f"deadline budget"}}
             with _dl.scope(dl):
                 if op in ("stats", "node_stats", "hot_threads",
-                          "history", "insights"):
+                          "history", "insights", "remediation"):
                     return 200, self._handle_obs(op, body)
                 return self._handle_phase(op, body)
         if op == "state" and method == "GET":
@@ -457,8 +469,10 @@ class DistClusterNode:
         if op == "search" and method == "POST":
             # run a DISTRIBUTED search coordinated by THIS node (any member
             # can coordinate, like any reference node with the coordinator
-            # role)
-            return 200, self.search(body["index"], body["body"])
+            # role); the origin lane rides the payload so remediation
+            # admission and per-lane SLIs hold on this path too
+            return 200, self.search(body["index"], body["body"],
+                                    lane=body.get("lane", "interactive"))
         return 404, {"error": {"type": "resource_not_found_exception",
                                "reason": f"unknown internal op [{op}]"}}
 
@@ -902,7 +916,8 @@ class DistClusterNode:
         return s.fetch_phase(result, sel, dict(body),
                              stats_ctx=self._global_ctx(index, g))
 
-    def search(self, index: str, body: dict) -> dict:
+    def search(self, index: str, body: dict,
+               lane: str = "interactive") -> dict:
         """Distributed DFS_QUERY_THEN_FETCH across every member, reduced
         once on this node. The whole scatter/gather runs under ONE root
         span; every remote leg's span tree comes back on the RPC response
@@ -911,14 +926,32 @@ class DistClusterNode:
         carries it, and the remote legs' events graft back into it.
         A `timeout` in the body becomes the request deadline: every RPC
         and every local segment loop downstream derives its budget from
-        it (utils/deadline.py)."""
+        it (utils/deadline.py). `lane` is the workload lane the SLIs and
+        the remediation admission match run under (the wlm lane the REST
+        facade derives on the single-node path)."""
         from ..obs import flight_recorder as _fr
         from ..utils.metrics import METRICS
         from ..utils.trace import TRACER
+        from ..utils.wlm import PressureRejectedException
         try:
             dl = (_dl.current() or _dl.Deadline.from_body(body))
         except ValueError as e:
             raise ApiError(400, "parsing_exception", str(e))
+        # remediation admission at the COORDINATOR boundary
+        # (serving/remediator.py): an alert-named shape on the batch
+        # lane sheds with 429 + Retry-After. A matching interactive
+        # request is counted as deprioritized, but SLIs and insights
+        # keep the ORIGIN lane — the distributed path has no scheduler
+        # lanes to demote into, and relabeling would hide the burn
+        # from the SLO that fired it. Inert while no action engaged.
+        try:
+            self._remediation().admit(body, lane)
+        except PressureRejectedException as e:
+            self._insights().record_rejection(
+                body if isinstance(body, dict) else {}, lane,
+                source="remediation")
+            from ..rest.client import _rejected_429
+            raise _rejected_429(e)
         token = None
         if _fr.RECORDER.enabled and not _fr.current():
             tl = _fr.RECORDER.start("dist.search", index=index,
@@ -933,7 +966,7 @@ class DistClusterNode:
         from ..obs import insights as _ins
         t0 = time.monotonic()
         obs, ins_token = _ins.begin(body if isinstance(body, dict)
-                                    else {}, "interactive")
+                                    else {}, lane)
         ins_tl = _fr.current() if _fr.RECORDER.enabled else 0
         try:
             with _dl.scope(dl), \
@@ -949,17 +982,17 @@ class DistClusterNode:
             # lost availability (the Node.search contract)
             is_5xx = getattr(e, "status", 500) >= 500
             if is_5xx:
-                METRICS.counter("search.lane.interactive.errors").inc()
+                METRICS.counter(f"search.lane.{lane}.errors").inc()
             _ins.finish(ins_token, obs, error=is_5xx,
                         timeline_id=ins_tl)
             raise
         finally:
             if token is not None:
                 _fr.reset_current(token)
-        METRICS.counter("search.lane.interactive.requests").inc()
+        METRICS.counter(f"search.lane.{lane}.requests").inc()
         took_ms = (time.monotonic() - t0) * 1000.0
         if METRICS.enabled:
-            METRICS.histogram("search.lane.interactive.latency_ms").record(
+            METRICS.histogram(f"search.lane.{lane}.latency_ms").record(
                 took_ms)
         _ins.finish(ins_token, obs, latency_ms=took_ms,
                     timeline_id=ins_tl)
@@ -1264,6 +1297,9 @@ class DistClusterNode:
             return {"node": self.name,
                     "wire": self._insights().to_wire(
                         window_s=float(w) if w is not None else None)}
+        if op == "remediation":
+            return {"node": self.name, "status": "ok",
+                    **self._remediation().status()}
         # history
         from ..obs.timeseries import SAMPLER
         return {"node": self.name,
@@ -1276,6 +1312,12 @@ class DistClusterNode:
             return self.insights_engine
         from ..obs.insights import INSIGHTS
         return INSIGHTS
+
+    def _remediation(self):
+        if self.remediation_engine is not None:
+            return self.remediation_engine
+        from ..serving.remediator import REMEDIATOR
+        return REMEDIATOR
 
     def _scrape_timeout_s(self) -> float:
         dl = _dl.current()
@@ -1499,6 +1541,31 @@ class DistClusterNode:
                            "failed": len(scraped) - ok},
                 "nodes": nodes,
                 "top_queries": top}
+
+    def remediation_federated(self, node_id: Optional[str] = None
+                              ) -> dict:
+        """`GET /_remediation` on a cluster: every member's live action
+        table + engage/release counters, fanned out on the `/_internal`
+        plane with the standard unreachable-member degradation — the
+        operator's one-stop "what is the fleet doing to itself right
+        now" pane."""
+        scraped = self._scrape("remediation", {},
+                               self._resolve_member(node_id))
+        nodes: Dict[str, dict] = {}
+        ok = 0
+        active_total = 0
+        for member, (status, res) in scraped.items():
+            if status == "ok":
+                ok += 1
+                nodes[member] = {k: v for k, v in res.items()
+                                 if k != "node"}
+                active_total += len(res.get("active") or [])
+            else:
+                nodes[member] = {"status": "failed", "error": res}
+        return {"_nodes": {"total": len(scraped), "successful": ok,
+                           "failed": len(scraped) - ok},
+                "active_actions_total": active_total,
+                "nodes": nodes}
 
     # ---------------- lifecycle + stats ----------------
 

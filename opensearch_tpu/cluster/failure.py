@@ -127,6 +127,12 @@ class MemberFailureDetector:
         self._lock = threading.Lock()
         self.consecutive: Dict[str, int] = {}
         self._depri: set = set()
+        # remediation-pinned members (serving/remediator.py): demoted in
+        # copy preference like suspicion-deprioritized ones, but a
+        # successful probe/RPC does NOT clear a pin — only the actuator's
+        # own TTL/green release (unpin) does, so a flapping member can't
+        # immediately re-promote itself mid-remediation
+        self._pinned: set = set()
         self.rounds = 0
 
     def note_failure(self, member: str) -> bool:
@@ -147,7 +153,25 @@ class MemberFailureDetector:
 
     def deprioritized(self) -> set:
         with self._lock:
-            return set(self._depri)
+            return set(self._depri) | set(self._pinned)
+
+    def pin(self, member: str) -> bool:
+        """Remediation engage: demote `member` in every shard's copy
+        preference until `unpin` (the paired release — oslint OSL603).
+        Returns True when this call newly pinned it."""
+        with self._lock:
+            if member in self._pinned:
+                return False
+            self._pinned.add(member)
+            return True
+
+    def unpin(self, member: str) -> None:
+        with self._lock:
+            self._pinned.discard(member)
+
+    def pinned(self) -> set:
+        with self._lock:
+            return set(self._pinned)
 
     def _default_probe(self, member: str, addr: str) -> bool:
         import json
@@ -201,5 +225,6 @@ class MemberFailureDetector:
             return {"failure_threshold": self.failure_threshold,
                     "rounds": self.rounds,
                     "deprioritized": sorted(self._depri),
+                    "pinned": sorted(self._pinned),
                     "suspect": {m: n for m, n in self.consecutive.items()
                                 if n > 0}}

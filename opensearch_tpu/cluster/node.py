@@ -404,6 +404,18 @@ class Node:
         # Process singleton like METRICS/RECORDER/SAMPLER.
         from ..obs.insights import INSIGHTS
         self.insights = INSIGHTS
+        # remediation actuator (serving/remediator.py): the closed loop
+        # from a firing slo.burn alert to bounded admission-level action
+        # (shed offending shapes, tighten admission, deprioritize a sick
+        # member). Process singleton, DISARMED by default — the serving
+        # hot path pays one attribute read; OPENSEARCH_TPU_REMEDIATION=1
+        # arms it against this node's SLO engine at init (servers), and
+        # the traffic harness / tests arm injected instances explicitly
+        from ..serving.remediator import REMEDIATOR
+        self.remediation = REMEDIATOR
+        if os.environ.get("OPENSEARCH_TPU_REMEDIATION") \
+                not in (None, "", "0"):
+            REMEDIATOR.arm(node=self)
         if os.environ.get("OPENSEARCH_TPU_TS") not in (None, "", "0"):
             SAMPLER.ensure_started()
         # persistent tasks (reference persistent/AllocatedPersistentTask):
@@ -943,12 +955,19 @@ class Node:
     def search(self, expression: str, body: dict, phase_hook=None,
                phase_ctx: Optional[dict] = None,
                copy_protect: bool = False,
-               wlm_lane: Optional[str] = None) -> dict:
+               wlm_lane: Optional[str] = None,
+               sli_lane: Optional[str] = None) -> dict:
         """`copy_protect`: caller intends to mutate the response (search
         pipeline response processors) — deep-copy it iff it aliases a
         request-cache entry, so cached entries stay pristine without taxing
         uncached paths. `wlm_lane`: serving-scheduler priority lane from
         the request's workload group (REST layer resolves it).
+        `sli_lane`: the lane the per-lane SLIs and query-insights
+        fingerprinting record under — defaults to `wlm_lane`, and
+        differs only when the remediation actuator DEMOTED the request
+        (serving/remediator.py): deprioritization changes scheduling
+        priority, never accounting, or a demoted-to-batch interactive
+        burn would vanish from the interactive SLO it fired.
 
         Flight-recorder timeline ownership: the REST facade usually
         starts the request's timeline (rest.accept); when none is
@@ -964,7 +983,7 @@ class Node:
         from ..obs import insights as _ins
         from ..utils.metrics import METRICS as _m
         from ..utils.wlm import PressureRejectedException as _rej
-        lane = wlm_lane or "interactive"
+        lane = sli_lane or wlm_lane or "interactive"
         _t0 = time.monotonic()
         _rec = self.flight_recorder
         tl = _fr.current() if _rec.enabled else 0

@@ -30,6 +30,10 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   impact planes enter f32 score math only through the designated
   dequant helpers; codec-version branches in search/ consult
   Segment.codec_version and use the named codec constants.
+- OSL603 actuator discipline (`actuator_rules`): every
+  remediation/shed/deprioritize engage site in serving/ or cluster/
+  carries a paired release path or TTL bound in file — bounded,
+  reversible actions only (docs/RESILIENCE.md "Self-healing loop").
 
 Run via `python scripts/oslint.py [--check]`; tier-1 runs it through
 tests/test_oslint.py. Suppress inline with
@@ -37,6 +41,7 @@ tests/test_oslint.py. Suppress inline with
 the checked-in `oslint_baseline.json`.
 """
 
+from .actuator_rules import ActuatorDisciplineChecker
 from .breaker_rules import BreakerDisciplineChecker
 from .core import (Baseline, Checker, Finding, default_checkers,
                    load_baseline, run_paths, run_source, write_baseline)
@@ -55,4 +60,5 @@ __all__ = [
     "BreakerDisciplineChecker", "LockDisciplineChecker",
     "DeviceSyncDisciplineChecker", "MemoryAccountingChecker",
     "ImpactDomainChecker", "InsightsCardinalityChecker",
+    "ActuatorDisciplineChecker",
 ]

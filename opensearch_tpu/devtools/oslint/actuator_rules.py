@@ -1,0 +1,129 @@
+"""OSL603 — actuator discipline for the self-healing serving loop.
+
+The remediation actuator (serving/remediator.py, docs/RESILIENCE.md
+"Self-healing loop") acts on live traffic: it sheds shapes, tightens
+admission, and pins members out of copy preference. The one invariant
+that keeps an actuator safe is that EVERY engage path has a visible way
+back: a paired release in the same file, or a TTL bound that expires
+the action without human help. An engage with neither is a permanent
+config mutation wearing a remediation costume — exactly the class of
+"temporary" mitigation that outlives its incident.
+
+The rule, enforced over `serving/` and `cluster/`:
+
+- An **engage site** is a call with arguments whose method name is an
+  actuation verb (`engage*`, `shed*`, `deprioritize*`, `pin*`), or a
+  `def` of such a verb taking real parameters (no-arg accessors like
+  `deprioritized()` / `pinned()` are reads, not actuations).
+- A file containing an engage site must, IN THE SAME FILE, show a
+  **release path**: a call or `def` whose name carries a release verb
+  (`release`, `unpin`, `restore`, `disarm`), or **TTL evidence**: a
+  `ttl`/`ttl_s` keyword on a call or an attribute/name containing
+  `ttl` (the auto-expiry bound).
+
+Deliberately one-shot sites (none exist today) suppress with
+`# oslint: disable=OSL603 -- <who releases this, and when>`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_SCOPES = ("opensearch_tpu/serving/", "opensearch_tpu/cluster/")
+
+_ENGAGE_VERBS = ("engage", "shed", "deprioritize", "pin")
+_RELEASE_TOKENS = ("release", "unpin", "restore", "disarm")
+
+
+def _is_engage_name(name: str) -> bool:
+    n = name.lstrip("_")
+    for v in _ENGAGE_VERBS:
+        if n == v or n.startswith(v + "_"):
+            return True
+    return False
+
+
+def _is_release_name(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _RELEASE_TOKENS)
+
+
+def _has_args(call: ast.Call) -> bool:
+    return bool(call.args) or bool(call.keywords)
+
+
+def _real_params(fn) -> bool:
+    """True when the def takes parameters beyond self/cls — an accessor
+    like `def pinned(self)` is a read, not an actuation."""
+    args = [a.arg for a in fn.args.posonlyargs + fn.args.args
+            if a.arg not in ("self", "cls")]
+    return bool(args or fn.args.vararg or fn.args.kwonlyargs
+                or fn.args.kwarg)
+
+
+class ActuatorDisciplineChecker(Checker):
+    rules = ("OSL603",)
+    name = "actuator-discipline"
+
+    def applies(self, path: str) -> bool:
+        return any(path.startswith(s) for s in _SCOPES)
+
+    # ---------------- release / TTL evidence ----------------
+
+    @staticmethod
+    def _file_evidence(tree: ast.Module) -> dict:
+        has_release = False
+        has_ttl = False
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _is_release_name(node.name):
+                    has_release = True
+            elif isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                if d and _is_release_name(d.rsplit(".", 1)[-1]):
+                    has_release = True
+                for kw in node.keywords:
+                    if kw.arg and "ttl" in kw.arg.lower():
+                        has_ttl = True
+            elif isinstance(node, ast.Attribute):
+                if "ttl" in node.attr.lower():
+                    has_ttl = True
+            elif isinstance(node, ast.Name):
+                if "ttl" in node.id.lower():
+                    has_ttl = True
+        return {"release": has_release, "ttl": has_ttl}
+
+    def check(self, tree: ast.Module, path: str,
+              src: str) -> List[Finding]:
+        evidence = self._file_evidence(tree)
+        if evidence["release"] or evidence["ttl"]:
+            return []
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        for node in ast.walk(tree):
+            name = None
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func)
+                leaf = d.rsplit(".", 1)[-1] if d else ""
+                if leaf and _is_engage_name(leaf) and _has_args(node):
+                    name = leaf
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                if _is_engage_name(node.name) and _real_params(node):
+                    name = node.name
+            if name is None:
+                continue
+            findings.append(Finding(
+                "OSL603", path, node.lineno, node.col_offset,
+                qmap.get(node, ""),
+                f"engage site [{name}] with no paired release/TTL "
+                "bound in file: every remediation/shed/deprioritize "
+                "action needs a visible way back (a release/unpin/"
+                "restore path or a ttl bound) — docs/RESILIENCE.md "
+                "\"Self-healing loop\"",
+                detail=f"unreleased-engage:{name}"))
+        return findings

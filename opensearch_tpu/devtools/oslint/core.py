@@ -123,6 +123,7 @@ def _suppressed(f: Finding, sup: Dict[int, Optional[Set[str]]]) -> bool:
 
 
 def default_checkers() -> List[Checker]:
+    from .actuator_rules import ActuatorDisciplineChecker
     from .breaker_rules import BreakerDisciplineChecker
     from .dtype_rules import DtypeDisciplineChecker
     from .impact_rules import ImpactDomainChecker
@@ -142,7 +143,8 @@ def default_checkers() -> List[Checker]:
             DeviceSyncDisciplineChecker(), RecorderDisciplineChecker(),
             MemoryAccountingChecker(), ImpactDomainChecker(),
             RpcDisciplineChecker(), SamplerDisciplineChecker(),
-            ScorePlaneChecker(), InsightsCardinalityChecker()]
+            ScorePlaneChecker(), InsightsCardinalityChecker(),
+            ActuatorDisciplineChecker()]
 
 
 def run_source(src: str, path: str,

@@ -223,6 +223,11 @@ class SLOEngine:
         self._alerts: deque = deque(maxlen=64)
         self.alerts_fired = 0
         self.refire_cooldown_s = 30.0
+        # alert subscribers (serving/remediator.py closes the loop from
+        # detection to ACTION here): invoked OUTSIDE the engine lock with
+        # the alert dict, exception-isolated — a listener fault can never
+        # break firing or deadlock evaluation
+        self._alert_listeners: List = []
 
     # ---------------- arm / disarm ----------------
 
@@ -251,6 +256,18 @@ class SLOEngine:
             self._status.clear()
             self._alerts.clear()
 
+    def add_alert_listener(self, fn) -> None:
+        """Subscribe to firing alerts (idempotent). `fn(alert_dict)` runs
+        after every rising-edge fire, outside the engine lock."""
+        with self._lock:
+            if fn not in self._alert_listeners:
+                self._alert_listeners.append(fn)
+
+    def remove_alert_listener(self, fn) -> None:
+        with self._lock:
+            if fn in self._alert_listeners:
+                self._alert_listeners.remove(fn)
+
     def _on_sample(self, _sampler) -> None:
         self.evaluate()
 
@@ -262,6 +279,7 @@ class SLOEngine:
         with self._lock:
             slos = list(self._slos.values())
         out: Dict[str, dict] = {}
+        fired: List[dict] = []
         for s in slos:
             fast = s.burn(self.sampler, s.fast_window_s)
             slow = s.burn(self.sampler, s.slow_window_s)
@@ -293,24 +311,36 @@ class SLOEngine:
                         st["last_fired_mono"] = now
                         self.alerts_fired += 1
                         self.registry.counter("slo.alerts_total").inc()
-                        self._fire_locked(s, fast, slow, now)
+                        fired.append(self._fire_locked(s, fast, slow,
+                                                       now))
                 elif not firing and was == "firing":
                     st["state"] = "ok"
                     st["since_mono"] = now
                 out[s.name] = dict(st)
+        if fired:
+            with self._lock:
+                listeners = list(self._alert_listeners)
+            for alert in fired:
+                for fn in listeners:
+                    try:
+                        fn(dict(alert))
+                    except Exception:   # noqa: BLE001 — a remediation
+                        # listener fault must never break detection
+                        pass
         return out
 
     # ---------------- firing ----------------
 
     def _fire_locked(self, s: SLO, fast: dict, slow: dict,
-                     now: float) -> None:
+                     now: float) -> dict:
         """Rising-edge actions (called under self._lock): alert-log
         entry, `slo.burn` recorder event carrying the offending window's
         series AND the top query fingerprints active in that window
         (obs/insights.py — the blame half of detection: WHAT burned the
         budget, not just that it burned), and a frozen dump bundle.
         Each fingerprint entry links its worst flight-recorder timeline,
-        so the dump is one hop from a full request journal."""
+        so the dump is one hop from a full request journal. Returns the
+        alert dict for the (post-lock) listener fan-out."""
         series = {m: self._bounded_series(m, s.slow_window_s)
                   for m in s.series_metrics()}
         top_fps = self._insights_top(s.slow_window_s)
@@ -331,6 +361,7 @@ class SLOEngine:
                     note=f"SLO [{s.name}] burn fast="
                          f"{fast['burn_rate']}x slow={slow['burn_rate']}x "
                          f"(threshold {s.burn_threshold}x)")
+        return alert
 
     @staticmethod
     def _insights_top(window_s: float) -> list:

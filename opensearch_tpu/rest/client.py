@@ -30,15 +30,32 @@ from ..utils.wlm import PressureRejectedException
 
 
 class ApiError(Exception):
-    def __init__(self, status: int, err_type: str, reason: str):
+    def __init__(self, status: int, err_type: str, reason: str,
+                 headers: Optional[dict] = None):
         super().__init__(reason)
         self.status = status
         self.err_type = err_type
         self.reason = reason
+        # extra HTTP response headers (e.g. Retry-After on 429s); the
+        # wire layer sends them, dict-level callers can read them
+        self.headers = dict(headers or {})
 
     def body(self) -> dict:
         return {"error": {"type": self.err_type, "reason": self.reason},
                 "status": self.status}
+
+
+def _rejected_429(e) -> ApiError:
+    """PressureRejectedException -> 429, carrying the rejecting layer's
+    Retry-After hint (scheduler queue drain estimate / remediation TTL)
+    as an HTTP header — delay-seconds form, ceil'd, min 1."""
+    import math
+    headers = {}
+    ra = getattr(e, "retry_after_s", None)
+    if ra is not None and ra > 0:
+        headers["Retry-After"] = str(max(int(math.ceil(ra)), 1))
+    return ApiError(429, "rejected_execution_exception", str(e),
+                    headers=headers)
 
 
 def _run_update_script_or_400(script_body, src: dict, meta: dict):
@@ -402,16 +419,23 @@ class RestClient:
         group = body.pop("_workload_group", None)
         wg = self.node.wlm.group(group)
         try:
-            wg.admit_search()
+            # admission cost > 1 while the remediation actuator holds a
+            # tighten_admission action (serving/remediator.py): the
+            # token bucket contracts without any config mutation
+            wg.admit_search(cost=self.node.remediation.wlm_cost())
         except PressureRejectedException as e:
             # a wlm admission 429 never reaches Node.search — record
             # the rejection against the query's shape here so admission
-            # pressure is attributable per workload (obs/insights.py)
+            # pressure is attributable per workload (obs/insights.py),
+            # and mirror it into the ONE consistent rejection name
+            # every admission layer shares (docs/SERVING.md)
             from ..obs import insights as _ins
-            _ins.INSIGHTS.record_rejection(
-                body, getattr(wg, "lane", "interactive"),
-                source="wlm_admission")
-            raise ApiError(429, "rejected_execution_exception", str(e))
+            from ..utils.metrics import METRICS as _m
+            _lane = getattr(wg, "lane", "interactive")
+            _ins.INSIGHTS.record_rejection(body, _lane,
+                                           source="wlm_admission")
+            _m.counter(f"serving.lane.{_lane}.rejected").inc()
+            raise _rejected_429(e)
         _wg_t0 = time.monotonic()
         if body.get("query") is not None:
             body["query"] = self._resolve_percolate_refs(body["query"])
@@ -440,6 +464,22 @@ class RestClient:
             # lane (interactive preempts batch at flush time)
             lane = ("batch" if scroll
                     else getattr(wg, "lane", "interactive"))
+            # remediation admission (serving/remediator.py): while the
+            # actuator holds shed actions, the body is re-fingerprinted
+            # and matched against the alert's offending shapes — a shed
+            # batch-lane shape 429s with Retry-After, an interactive
+            # match is demoted to the batch lane for SCHEDULING only
+            # (SLIs/insights keep the origin lane: deprioritization
+            # must never hide a burn from the SLO that fired it).
+            # Inert (one attribute read) while no action is engaged.
+            sli_lane = lane
+            try:
+                lane = self.node.remediation.admit(body, lane)
+            except PressureRejectedException as e:
+                from ..obs import insights as _ins
+                _ins.INSIGHTS.record_rejection(body, lane,
+                                               source="remediation")
+                raise _rejected_429(e)
             # flight recorder: the REST facade is where a request's
             # timeline begins (rest.accept + wlm lane classification);
             # Node.search reuses the ambient timeline and stamps the
@@ -458,7 +498,7 @@ class RestClient:
                     phase_ctx=phase_ctx,
                     copy_protect=bool(pipeline is not None
                                       and pipeline.response_procs),
-                    wlm_lane=lane)
+                    wlm_lane=lane, sli_lane=sli_lane)
             finally:
                 if _tl_token is not None:
                     _fr.reset_current(_tl_token)
@@ -473,8 +513,9 @@ class RestClient:
             raise ApiError(400, "index_closed_exception", str(e))
         except PressureRejectedException as e:
             # search backpressure admission control (reference
-            # ratelimitting/admissioncontrol)
-            raise ApiError(429, "rejected_execution_exception", str(e))
+            # ratelimitting/admissioncontrol); scheduler queue-full
+            # rejections carry a queue-depth-derived Retry-After
+            raise _rejected_429(e)
         finally:
             # charge the group's resource tracker unconditionally — PIT
             # searches and searches that FAIL after consuming device time
@@ -966,6 +1007,9 @@ class RestClient:
             # query insights (obs/insights.py): workload fingerprint
             # sketch occupancy (full view at GET /_insights/top_queries)
             "insights": n.insights.stats(),
+            # remediation actuator (serving/remediator.py): live action
+            # count + engage/shed totals (full view at GET /_remediation)
+            "remediation": n.remediation.stats(),
         }
         if n.mesh_service is not None:
             node_block["mesh"] = n.mesh_service.stats()
@@ -1096,6 +1140,15 @@ class RestClient:
         """`GET /_insights`: engine state (capacity, entries,
         evictions, window occupancy)."""
         return {"insights": self.node.insights.stats()}
+
+    def remediation_status(self) -> dict:
+        """`GET /_remediation` on an UNclustered node: the same schema
+        the distnode federation serves (cluster/distnode.py
+        `remediation_federated`), degenerated to a fleet of one."""
+        name = self.node.node_name
+        return {"_nodes": {"total": 1, "successful": 1, "failed": 0},
+                "nodes": {name: {"status": "ok",
+                                 **self.node.remediation.status()}}}
 
     def get_traces(self, limit: int = 20) -> dict:
         """Recent completed request traces (reference telemetry in-memory

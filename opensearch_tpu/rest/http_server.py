@@ -47,7 +47,8 @@ def _truthy(v) -> bool:
 
 # routes any authenticated principal may hit (cluster "monitor" class)
 _MONITOR_HEADS = {"", "_cluster", "_nodes", "_cat", "_stats", "_tasks",
-                  "_metrics", "_flight_recorder", "_slo", "_insights"}
+                  "_metrics", "_flight_recorder", "_slo", "_insights",
+                  "_remediation"}
 # cluster-admin routes
 _ADMIN_HEADS = {"_index_template", "_template", "_remotestore", "_snapshot",
                 "_ingest", "_scripts", "_search_pipeline", "_data_stream",
@@ -150,7 +151,8 @@ class _Handler(BaseHTTPRequestHandler):
                               if ln.strip()]
         return self._ndjson_cache
 
-    def _send(self, status: int, payload, content_type="application/json"):
+    def _send(self, status: int, payload,
+              content_type="application/json", headers=None):
         if isinstance(payload, (dict, list)):
             data = json.dumps(payload).encode("utf-8")
         else:
@@ -161,6 +163,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            # error-shaped extras (Retry-After on 429s): the rejecting
+            # layer decides the value, the wire layer just carries it
+            self.send_header(k, str(v))
         self.end_headers()
         if self.command != "HEAD":
             self.wfile.write(data)
@@ -179,7 +185,7 @@ class _Handler(BaseHTTPRequestHandler):
             status, payload = self._route(self.command, parts, params)
             self._send(status, payload)
         except ApiError as e:
-            self._send(e.status, e.body())
+            self._send(e.status, e.body(), headers=e.headers)
         except IndexNotFoundError as e:
             self._send(404, {"error": {"type": "index_not_found_exception",
                                        "reason": str(e)}, "status": 404})
@@ -467,6 +473,16 @@ class _Handler(BaseHTTPRequestHandler):
             # SLO burn-rate engine (obs/slo.py): armed objectives, live
             # multi-window burn rates, the recent alert log
             return 200, c.slo_status()
+        if head == "_remediation":
+            # remediation actuator (serving/remediator.py): the live
+            # action table + engage/release history; clustered nodes
+            # fan the read out over /_internal like the observatory
+            if method != "GET":
+                raise ApiError(405, "method_not_allowed",
+                               "_remediation requires GET")
+            if dist is not None:
+                return 200, dist.remediation_federated()
+            return 200, c.remediation_status()
         if head == "_insights":
             # query insights (obs/insights.py): workload fingerprints +
             # heavy-hitter attribution. /_insights/top_queries is the

@@ -1,5 +1,9 @@
-"""Serving layer: cross-request dynamic batching (docs/SERVING.md)."""
+"""Serving layer: cross-request dynamic batching (docs/SERVING.md) and
+the closed-loop remediation actuator (docs/RESILIENCE.md
+"Self-healing loop")."""
 
+from .remediator import REMEDIATOR, Action, RemediationConfig, Remediator
 from .scheduler import LANES, SchedulerConfig, ServingScheduler
 
-__all__ = ["ServingScheduler", "SchedulerConfig", "LANES"]
+__all__ = ["ServingScheduler", "SchedulerConfig", "LANES",
+           "Remediator", "RemediationConfig", "Action", "REMEDIATOR"]

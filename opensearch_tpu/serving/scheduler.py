@@ -348,6 +348,29 @@ class ServingScheduler:
             return False
         return True
 
+    # ---------------- admission state ----------------
+
+    def _effective_cap(self) -> int:
+        """The live admission bound: queue_cap, contracted by the
+        remediation actuator's admission factor while a
+        tighten_admission action holds (never below 1)."""
+        cap = self.config.queue_cap
+        rem = getattr(self.node, "remediation", None)
+        if rem is not None and rem.tightened:
+            cap = max(1, int(cap * rem.queue_factor()))
+        return cap
+
+    def _retry_after_s(self, depth: int) -> float:
+        """The honest `Retry-After` hint for a queue-full 429, derived
+        from the admission state the client just hit: the estimated
+        drain time of the current queue (batches needed x the flush
+        deadline), floored so a zero-wait config still asks for a
+        beat of backoff."""
+        per_flush_s = max(self.config.max_wait_us / 1e6, 0.01)
+        batches = max((depth + self.config.max_batch - 1)
+                      // self.config.max_batch, 1)
+        return max(batches * per_flush_s, 0.05)
+
     # ---------------- request side ----------------
 
     def execute(self, name: str, svc, body: dict, task=None,
@@ -377,16 +400,23 @@ class ServingScheduler:
         # queue with no dispatcher alive and none restarted
         rejected_depth = None
         closed = False
+        # admission cap: the configured bound, contracted while a
+        # remediation tighten_admission action is engaged
+        # (serving/remediator.py) — 429s fire earlier under active
+        # remediation, and relax to exactly queue_cap on release
+        cap = self._effective_cap()
         with self._cond:
             if self._closed:
                 self.direct_fallbacks += 1
                 METRICS.counter("serving.direct_fallbacks").inc()
                 closed = True
-            elif self._pending >= self.config.queue_cap:
+            elif self._pending >= cap:
                 self.rejected += 1
                 METRICS.counter("serving.rejected").inc()
-                # per-lane mirror: the SLO engine's rejection-rate
-                # objectives window rejections BY lane (obs/slo.py)
+                # per-lane mirror: ONE consistent rejection name across
+                # every admission layer (wlm, scheduler, remediation) —
+                # the SLO engine's rejection-rate objectives and the
+                # remediation loop both window serving.lane.*.rejected
                 METRICS.counter(f"serving.lane.{lane}.rejected").inc()
                 self.node.search_backpressure.note_queue_rejection()
                 rejected_depth = self._pending
@@ -417,12 +447,14 @@ class ServingScheduler:
                 if entry.tl:
                     _fr.RECORDER.record(entry.tl, "sched.reject",
                                         pending=rejected_depth,
-                                        cap=self.config.queue_cap)
+                                        cap=cap)
                 _fr.RECORDER.note_rejection(entry.tl)
             raise PressureRejectedException(
                 f"serving scheduler queue full "
-                f"({rejected_depth}/{self.config.queue_cap} pending); "
-                f"rejecting search")
+                f"({rejected_depth}/{cap} pending); "
+                f"rejecting search",
+                retry_after_s=self._retry_after_s(rejected_depth),
+                source="scheduler")
         if closed:
             if _fr.RECORDER.enabled and entry.tl:
                 _fr.RECORDER.record(entry.tl, "sched.degrade",
@@ -1127,6 +1159,7 @@ class ServingScheduler:
                 "enabled": self.enabled,
                 "queue_depth": depth,
                 "queue_cap": self.config.queue_cap,
+                "effective_queue_cap": self._effective_cap(),
                 "max_batch": self.config.max_batch,
                 "max_wait_us": self.config.max_wait_us,
                 "submitted": self.submitted,

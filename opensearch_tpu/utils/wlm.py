@@ -13,7 +13,18 @@ from typing import Dict, Optional
 
 
 class PressureRejectedException(Exception):
-    """HTTP 429 (reference OpenSearchRejectedExecutionException)."""
+    """HTTP 429 (reference OpenSearchRejectedExecutionException).
+
+    `retry_after_s`, when set by the rejecting layer (scheduler queue
+    depth, remediation admission state), surfaces as the HTTP
+    `Retry-After` header — a 429 that tells the client WHEN to come
+    back instead of inviting an immediate hammer-retry."""
+
+    def __init__(self, *args, retry_after_s: Optional[float] = None,
+                 source: Optional[str] = None):
+        super().__init__(*args)
+        self.retry_after_s = retry_after_s
+        self.source = source
 
 
 class IndexingPressure:
@@ -126,12 +137,25 @@ class WorkloadGroup:
         self.rejections = 0
         self.resource_rejections = 0
 
-    def admit_search(self) -> None:
+    def admit_search(self, cost: float = 1.0) -> None:
+        """`cost` > 1 is the remediation admission-tightening hook
+        (serving/remediator.py): while a tighten_admission action is
+        engaged, every search spends `cost` tokens from the group's
+        bucket instead of one — the rate limit contracts by that factor
+        without touching the configured rate, and releases to exactly
+        the configured behavior when the action expires. The cost is
+        capped at the bucket's burst (floor 1): a group whose burst can
+        never hold `cost` tokens must contract to its own capacity, not
+        silently turn into a 100% outage for the action's TTL."""
         self.searches += 1
-        if self.bucket is not None and not self.bucket.try_take():
-            self.rejections += 1
-            raise PressureRejectedException(
-                f"workload group [{self.name}] search rate limit exceeded")
+        if self.bucket is not None:
+            cost = min(max(float(cost), 1.0),
+                       max(self.bucket.burst, 1.0))
+            if not self.bucket.try_take(cost):
+                self.rejections += 1
+                raise PressureRejectedException(
+                    f"workload group [{self.name}] search rate limit "
+                    f"exceeded")
         cpu_cap = self.resource_limits.get("cpu")
         if cpu_cap is not None and self.mode == "enforced" \
                 and self.usage.rate() > cpu_cap:

@@ -41,9 +41,12 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # metric-key suffix -> direction ("up" = higher is better)
 _HIGHER_BETTER = ("qps", "skip_rate", "invocation_reduction",
-                  "mean_batch", "qps_ratio", "overhead", "recall")
+                  "mean_batch", "qps_ratio", "overhead", "recall",
+                  "green_ok", "released_ok", "shed_fraction",
+                  "byte_stable")
 _LOWER_BETTER = ("p50", "p95", "p99", "ms", "bytes", "escalated",
-                 "escalations", "wall_s")
+                 "escalations", "wall_s", "time_to_green_s",
+                 "time_to_detect_s")
 
 
 def direction(key: str) -> str:
@@ -146,6 +149,30 @@ def metrics_of(doc: dict) -> dict:
                        ("mean_bytes_per_query", "mean_bytes_per_query")):
             if _num(a.get(k)) is not None:
                 out[f"impacts.{arm}.{suf}"] = a[k]
+    # traffic-harness emission (scripts/traffic_harness.py): per-scenario
+    # time-to-green / detect, shed fraction, and the closed-loop
+    # green-under-load booleans (1.0/0.0 so the differ gates them —
+    # a True->False flip reads as a 100% regression)
+    traffic = extra.get("traffic") or {}
+    for sc in traffic.get("scenarios") or []:
+        if not isinstance(sc, dict):
+            continue
+        tag = sc.get("scenario")
+        if not tag:
+            continue
+        for k in ("time_to_green_s", "time_to_detect_s",
+                  "shed_fraction"):
+            if _num(sc.get(k)) is not None:
+                out[f"traffic.{tag}.{k}"] = sc[k]
+        for k, suffix in (("green_within_window", "green_ok"),
+                          ("byte_stable", "byte_stable"),
+                          ("released_all", "released_ok")):
+            if isinstance(sc.get(k), bool):
+                out[f"traffic.{tag}.{suffix}"] = 1.0 if sc[k] else 0.0
+        ld = sc.get("load") or {}
+        for k in ("lat_ms_p50", "lat_ms_p95"):
+            if _num(ld.get(k)) is not None:
+                out[f"traffic.{tag}.{k}"] = ld[k]
     reorder = (extra.get("reorder") or {}).get("arms") or {}
     for arm, mixes in reorder.items():
         if not isinstance(mixes, dict):

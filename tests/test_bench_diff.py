@@ -112,6 +112,57 @@ class TestDiff:
         assert bench_diff.main(["nope.json", "also_nope.json"]) == 2
 
 
+def _traffic_doc(t2g, shed, green, p95):
+    return {"metric": "bm25_rest_qps_per_chip", "value": None,
+            "unit": "queries/sec", "vs_baseline": None,
+            "extra": {"traffic": {"scenarios": [
+                {"scenario": "overload", "time_to_green_s": t2g,
+                 "time_to_detect_s": 2.0, "shed_fraction": shed,
+                 "green_within_window": green, "byte_stable": True,
+                 "released_all": True,
+                 "load": {"lat_ms_p50": p95 / 3, "lat_ms_p95": p95}},
+                {"scenario": "baseline", "byte_stable": True,
+                 "load": {"lat_ms_p50": 5.0, "lat_ms_p95": 20.0}},
+            ]}}}
+
+
+class TestTrafficShape:
+    """The traffic-harness emission (scripts/traffic_harness.py): the
+    differ extracts per-scenario time-to-green / shed fraction /
+    green-under-load booleans and gates them like BENCH rounds."""
+
+    def test_extraction(self):
+        m = bench_diff.metrics_of(_traffic_doc(1.5, 0.8, True, 300.0))
+        assert m["traffic.overload.time_to_green_s"] == 1.5
+        assert m["traffic.overload.shed_fraction"] == 0.8
+        assert m["traffic.overload.green_ok"] == 1.0
+        assert m["traffic.overload.released_ok"] == 1.0
+        assert m["traffic.overload.byte_stable"] == 1.0
+        assert m["traffic.overload.lat_ms_p95"] == 300.0
+        assert m["traffic.baseline.byte_stable"] == 1.0
+
+    def test_directions(self):
+        assert bench_diff.direction(
+            "traffic.overload.time_to_green_s") == "down"
+        assert bench_diff.direction(
+            "traffic.overload.green_ok") == "up"
+        assert bench_diff.direction(
+            "traffic.overload.shed_fraction") == "up"
+        assert bench_diff.direction(
+            "traffic.overload.lat_ms_p95") == "down"
+
+    def test_green_flip_is_a_gated_regression(self, tmp_path):
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps(_traffic_doc(1.5, 0.8, True, 300.0)))
+        # recovery stops fitting the window AND slows 3x: both gate
+        b.write_text(json.dumps(_traffic_doc(4.5, 0.8, False, 300.0)))
+        rep = bench_diff.diff_files(str(a), str(b), 0.10)
+        bad = {r["metric"] for r in rep["regressions"]}
+        assert "traffic.overload.green_ok" in bad
+        assert "traffic.overload.time_to_green_s" in bad
+        assert bench_diff.main([str(a), str(b), "--gate"]) == 1
+
+
 class TestCommittedLadder:
     def test_every_committed_round_loads(self):
         import glob

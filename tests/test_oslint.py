@@ -1145,6 +1145,84 @@ class TestInsightsCardinality:
 
 
 # ----------------------------------------------------------------------
+# OSL603 actuator discipline (remediation engage/release pairing)
+# ----------------------------------------------------------------------
+
+class TestActuatorDiscipline:
+    """OSL603 — every engage site in serving/ or cluster/ needs a
+    paired release path or TTL bound in file."""
+
+    def test_osl603_unreleased_engage_call(self):
+        src = """
+            class Actuator:
+                def on_alert(self, alert):
+                    self.scheduler.shed(alert["fingerprint"])
+        """
+        found = lint(src, "opensearch_tpu/serving/actuator.py")
+        assert [f for f in found
+                if f.detail == "unreleased-engage:shed"]
+
+    def test_osl603_unreleased_engage_def(self):
+        src = """
+            class Detector:
+                def deprioritize_member(self, member):
+                    self._down.add(member)
+        """
+        found = lint(src, "opensearch_tpu/cluster/detector.py")
+        assert [f for f in found
+                if f.detail == "unreleased-engage:deprioritize_member"]
+
+    def test_osl603_quiet_with_paired_release(self):
+        src = """
+            class Detector:
+                def pin(self, member):
+                    self._pinned.add(member)
+
+                def unpin(self, member):
+                    self._pinned.discard(member)
+        """
+        assert rules_of(lint(src,
+                             "opensearch_tpu/cluster/detector.py")) \
+            == []
+
+    def test_osl603_quiet_with_ttl_bound(self):
+        src = """
+            class Actuator:
+                def engage_shed(self, key):
+                    self._actions[key] = Action(key,
+                                                ttl_s=self.ttl_s)
+        """
+        assert rules_of(lint(src,
+                             "opensearch_tpu/serving/actuator.py")) \
+            == []
+
+    def test_osl603_accessors_are_reads_not_actuations(self):
+        # `deprioritized()` / `pinned()` take no real arguments: they
+        # report state, they do not change it
+        src = """
+            class Plan:
+                def order(self, fd):
+                    down = fd.deprioritized()
+                    return [m for m in self.copies if m not in down]
+        """
+        assert rules_of(lint(src,
+                             "opensearch_tpu/cluster/plan.py")) == []
+
+    def test_osl603_out_of_scope_quiet(self):
+        src = """
+            def shed(load):
+                drop(load)
+        """
+        assert rules_of(lint(src, "opensearch_tpu/search/mod.py")) == []
+
+    def test_osl603_repo_clean(self):
+        # the ratchet at zero: the remediator and failure detector pair
+        # every engage with a release path and a TTL bound
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f.render() for f in findings if f.rule == "OSL603"] == []
+
+
+# ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 

@@ -1,0 +1,733 @@
+"""Remediation actuator tests (serving/remediator.py,
+docs/RESILIENCE.md "Self-healing loop").
+
+1. Admission-time fingerprint matching: shed decisions are byte-stable
+   for identical bodies under 32-thread load, never fire on unlisted
+   shapes, and release cleanly after TTL while hammered.
+2. The engage policy: which alert kinds engage which bounded actions,
+   hysteresis (cooldown refreshes, never stacks), the max-actions
+   bound, and the member pin/unpin pairing with the failure detector.
+3. The closed loop in miniature: a real SLOEngine firing a real alert
+   engages the actuator through the listener plumbing, and green
+   evaluations release it.
+4. The admission surfaces: scheduler queue-full 429s carry a
+   queue-depth-derived Retry-After, the wlm rejection mirrors into the
+   consistent `serving.lane.{lane}.rejected` name, shed rejections ride
+   real HTTP with a Retry-After header, and `GET /_remediation` serves
+   the status schema (unclustered + federated)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from opensearch_tpu.cluster.failure import MemberFailureDetector
+from opensearch_tpu.obs.insights import fingerprint
+from opensearch_tpu.obs.slo import SLO, SLOEngine
+from opensearch_tpu.obs.timeseries import TimeSeriesSampler
+from opensearch_tpu.serving.remediator import (RemediationConfig,
+                                               Remediator)
+from opensearch_tpu.utils.metrics import MetricsRegistry
+from opensearch_tpu.utils.wlm import PressureRejectedException
+
+BODY = {"query": {"match": {"body": "alpha beta"}}, "size": 10}
+OTHER = {"query": {"match": {"title": "gamma"}}, "size": 10}
+
+
+def mk(ttl=5.0, **kw):
+    cfg = RemediationConfig(ttl_s=ttl, green_hold_s=0.05,
+                            engage_cooldown_s=0.0, **kw)
+    return Remediator(cfg, registry=MetricsRegistry())
+
+
+def shed_key(body, lane="batch"):
+    return fingerprint(body, lane)[0]
+
+
+# ---------------------------------------------------------------------
+# admission-time fingerprint matching
+# ---------------------------------------------------------------------
+
+class TestAdmission:
+    def test_inactive_is_passthrough(self):
+        rem = mk()
+        assert rem.admit(BODY, "batch") == "batch"
+        assert rem.admit(None, "interactive") == "interactive"
+        assert not rem.active
+
+    def test_shed_rejects_batch_lane_only(self):
+        rem = mk()
+        rem._engage("shed_shape", shed_key(BODY, "batch"), "s")
+        with pytest.raises(PressureRejectedException) as ei:
+            rem.admit(BODY, "batch")
+        assert ei.value.source == "remediation"
+        assert ei.value.retry_after_s is not None
+        assert 1.0 <= ei.value.retry_after_s <= 30.0
+        # the same SHAPE on the interactive lane has a different
+        # (lane-bearing) fingerprint: untouched
+        assert rem.admit(BODY, "interactive") == "interactive"
+
+    def test_interactive_match_is_demoted_not_rejected(self):
+        rem = mk()
+        rem._engage("shed_shape", shed_key(BODY, "interactive"), "s")
+        assert rem.admit(BODY, "interactive") == "batch"
+        assert rem.deprioritized_total == 1
+        # and the demoted request's batch-lane key is NOT shed
+        assert rem.admit(BODY, "batch") == "batch"
+
+    def test_unlisted_shapes_never_fire(self):
+        rem = mk()
+        rem._engage("shed_shape", shed_key(BODY, "batch"), "s")
+        for _ in range(20):
+            assert rem.admit(OTHER, "batch") == "batch"
+            assert rem.admit(OTHER, "interactive") == "interactive"
+        assert rem.shed_total == 0
+        assert rem.deprioritized_total == 0
+
+    def test_shed_decisions_byte_stable_32_threads(self):
+        """Identical bodies -> identical decisions, every time, from
+        every thread: the fingerprint is deterministic and the shed
+        snapshot is read atomically."""
+        rem = mk()
+        rem._engage("shed_shape", shed_key(BODY, "batch"), "s")
+        n_threads, per = 32, 50
+        outcomes = {"shed": 0, "served_listed": 0, "served_other": 0,
+                    "shed_other": 0}
+        lock = threading.Lock()
+
+        def worker(i):
+            local = {"shed": 0, "served_listed": 0, "served_other": 0,
+                     "shed_other": 0}
+            for k in range(per):
+                body = dict(BODY) if k % 2 == 0 else dict(OTHER)
+                listed = k % 2 == 0
+                try:
+                    rem.admit(body, "batch")
+                    local["served_listed" if listed
+                          else "served_other"] += 1
+                except PressureRejectedException:
+                    local["shed" if listed else "shed_other"] += 1
+            with lock:
+                for key, v in local.items():
+                    outcomes[key] += v
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # zero flaps in either direction
+        assert outcomes["shed"] == n_threads * (per // 2)
+        assert outcomes["served_other"] == n_threads * (per // 2)
+        assert outcomes["served_listed"] == 0
+        assert outcomes["shed_other"] == 0
+        assert rem.shed_total == outcomes["shed"]
+
+    def test_ttl_enforced_lazily_at_admission(self):
+        """The hard bound holds with a DEAD evaluation loop: nothing
+        ever calls tick(), yet an expired action retires the moment
+        admission consults it."""
+        rem = mk(ttl=0.05)
+        rem._engage("shed_shape", shed_key(BODY, "batch"), "s")
+        with pytest.raises(PressureRejectedException):
+            rem.admit(dict(BODY), "batch")
+        time.sleep(0.08)
+        assert rem.admit(dict(BODY), "batch") == "batch"
+        assert rem.status()["active"] == []
+        assert rem.released_total == 1
+
+    def test_ttl_release_under_32_thread_load(self):
+        """The hard auto-release bound holds while hammered: after the
+        TTL tick, every thread sees pass-through, the action table is
+        empty, and engage/release counters balance."""
+        rem = mk(ttl=0.25)
+        rem._engage("shed_shape", shed_key(BODY, "batch"), "s")
+        stop = threading.Event()
+        post_release_served = []
+
+        def worker():
+            while not stop.is_set():
+                try:
+                    rem.admit(dict(BODY), "batch")
+                    post_release_served.append(time.monotonic())
+                except PressureRejectedException:
+                    pass
+
+        threads = [threading.Thread(target=worker) for _ in range(32)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        rem.tick()          # the hammering admits may already have
+        t_released = time.monotonic()       # lazily retired it (TTL
+        time.sleep(0.1)                     # enforcement at admission)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert [h["why"] for h in rem.status()["history"]
+                if h["event"] == "release"] == ["ttl"]
+        assert rem.status()["active"] == []
+        assert rem.engaged_total == rem.released_total == 1
+        # every admit strictly after the release served
+        assert any(ts >= t_released for ts in post_release_served)
+        assert rem.admit(dict(BODY), "batch") == "batch"
+
+
+# ---------------------------------------------------------------------
+# engage policy
+# ---------------------------------------------------------------------
+
+def _alert(kind="latency", slo="s1", fps=("aaa", "bbb")):
+    return {"slo": slo, "slo_kind": kind, "lane": "interactive",
+            "fast": {}, "slow": {},
+            "top_fingerprints": [{"fingerprint": f} for f in fps]}
+
+
+class TestEngagePolicy:
+    def test_latency_alert_sheds_and_tightens(self):
+        rem = mk()
+        rem.on_alert(_alert())
+        st = rem.status()
+        assert sorted(st["shed_fingerprints"]) == ["aaa", "bbb"]
+        assert st["tightened"]
+        assert rem.queue_factor() == rem.config.admission_factor
+        assert rem.wlm_cost() == rem.config.wlm_cost
+
+    def test_rejection_alert_engages_nothing(self):
+        # acting on a rejection burn would amplify it — the actuator's
+        # own exhaust must not feed back
+        rem = mk()
+        rem.on_alert(_alert(kind="rejection_rate"))
+        assert rem.status()["active"] == []
+        assert rem.queue_factor() == 1.0
+        assert rem.wlm_cost() == 1.0
+
+    def test_cooldown_refreshes_instead_of_stacking(self):
+        cfg = RemediationConfig(ttl_s=5.0, green_hold_s=0.05,
+                                engage_cooldown_s=10.0)
+        rem = Remediator(cfg, registry=MetricsRegistry())
+        rem.on_alert(_alert(fps=("aaa",)))
+        n = rem.engaged_total
+        age0 = rem.status()["active"][0]["age_s"]
+        time.sleep(0.05)
+        rem.on_alert(_alert(fps=("aaa", "ccc")))     # within cooldown
+        assert rem.engaged_total == n                # nothing stacked
+        assert "ccc" not in rem.status()["shed_fingerprints"]
+        # TTL refreshed: age reset at the re-alert
+        assert rem.status()["active"][0]["age_s"] <= age0 + 0.06
+
+    def test_max_actions_bound(self):
+        rem = mk(max_shed_shapes=10)
+        rem.config.max_actions = 3
+        rem.on_alert(_alert(fps=("a1", "a2", "a3", "a4", "a5")))
+        assert len(rem.status()["active"]) == 3
+
+    def test_member_pin_paired_with_release(self):
+        fd = MemberFailureDetector(failure_threshold=2)
+        fd.note_failure("m2")
+        fd.note_failure("m2")
+        rem = mk()
+        rem.member_fd = fd
+        rem.on_alert(_alert(kind="counter_ratio", fps=()))
+        assert "m2" in fd.pinned()
+        assert "m2" in fd.deprioritized()
+        # ordinary probe success clears SUSPICION but not the pin
+        fd.note_success("m2")
+        assert "m2" in fd.pinned()
+        assert "m2" in fd.deprioritized()
+        # TTL release unpins
+        rem.tick(now=time.monotonic() + 100.0)
+        assert fd.pinned() == set()
+        assert "m2" not in fd.deprioritized()
+
+    def test_transport_alert_without_suspect_engages_nothing(self):
+        rem = mk()
+        rem.member_fd = MemberFailureDetector()
+        rem.on_alert(_alert(kind="counter_ratio", fps=()))
+        assert rem.status()["active"] == []
+
+
+# ---------------------------------------------------------------------
+# the closed loop in miniature (real engine, no HTTP)
+# ---------------------------------------------------------------------
+
+class TestClosedLoop:
+    def test_alert_listener_engages_and_green_releases(self):
+        reg = MetricsRegistry()
+        sampler = TimeSeriesSampler(registry=reg)
+        engine = SLOEngine(sampler=sampler, registry=reg)
+        engine.arm([SLO("err", "error_rate", target=0.9,
+                        fast_window_s=0.5, slow_window_s=1.0,
+                        burn_threshold=2.0, min_events=1)])
+        rem = Remediator(
+            RemediationConfig(ttl_s=30.0, green_hold_s=0.0,
+                              engage_cooldown_s=0.0),
+            registry=reg)
+        rem.arm(slo_engine=engine, sampler=sampler)
+        try:
+            sampler.sample_once()                    # baseline
+            reg.counter("search.lane.interactive.errors").inc(50)
+            reg.counter("search.lane.interactive.requests").inc(10)
+            sampler.sample_once()                    # burn -> fire
+            assert engine.alerts_fired >= 1
+            # the listener closed the loop: admission is tightened
+            # (no insights engine feeding fingerprints -> no shed set)
+            assert rem.tightened
+            assert rem.queue_factor() < 1.0
+            # pressure clears: counters stop moving, windows slide
+            time.sleep(1.1)
+            sampler.sample_once()                    # green evaluation
+            sampler.sample_once()                    # release tick
+            assert rem.status()["active"] == []
+            assert "green" in {h["why"]
+                               for h in rem.status()["history"]
+                               if h["event"] == "release"}
+        finally:
+            rem.disarm()
+            engine.disarm()
+
+    def test_sustained_burn_reattributes(self):
+        """Alerts are edge-triggered; a shape whose requests were
+        still in flight at the first edge must be caught by a later
+        attribution pull while the SLO keeps firing."""
+        from opensearch_tpu.obs.insights import (QueryInsights,
+                                                 fingerprint)
+        reg = MetricsRegistry()
+        sampler = TimeSeriesSampler(registry=reg)
+        engine = SLOEngine(sampler=sampler, registry=reg)
+        engine.arm([SLO("lat", "latency", target=0.9,
+                        fast_window_s=0.5, slow_window_s=1.0,
+                        latency_budget_ms=10.0, burn_threshold=1.0)])
+        ins = QueryInsights(capacity=16)
+        rem = Remediator(
+            RemediationConfig(ttl_s=30.0, green_hold_s=0.1,
+                              engage_cooldown_s=0.0),
+            registry=reg)
+        rem.arm(slo_engine=engine, sampler=sampler, insights=ins)
+        try:
+            # first edge: empty attribution (the offender is in flight)
+            rem.on_alert({"slo": "lat", "slo_kind": "latency",
+                          "lane": "batch", "top_fingerprints": []})
+            assert rem.status()["shed_fingerprints"] == []
+            # the SLO reads firing; now the offender COMPLETES and
+            # lands in the live window
+            engine._status["lat"] = {"state": "firing"}
+            body = {"query": {"match": {"body": "flood"}}, "size": 20}
+            key, shape, feats = fingerprint(body, "batch")
+            ins.sketch.record(key, shape, feats, latency_ms=5000.0)
+            ins._recent.append((time.monotonic(), key, 5000.0, 0))
+            rem.tick()
+            assert key in rem.status()["shed_fingerprints"]
+            # once green, the burning context clears and no further
+            # pulls happen
+            engine._status["lat"] = {"state": "ok"}
+            rem.tick()
+            assert rem._burning_ctx == {}
+        finally:
+            rem.disarm()
+            engine.disarm()
+
+    def test_sustained_burn_keeps_tighten_and_pin_alive(self):
+        """A burn outlasting ttl_s has no new alert edge: the
+        re-attribution path must re-engage tighten_admission and the
+        member pin, not let them lapse mid-burn."""
+        from opensearch_tpu.obs.insights import QueryInsights
+        reg = MetricsRegistry()
+        sampler = TimeSeriesSampler(registry=reg)
+        engine = SLOEngine(sampler=sampler, registry=reg)
+        engine.arm([SLO("lat", "latency", target=0.9,
+                        fast_window_s=0.5, slow_window_s=1.0,
+                        latency_budget_ms=10.0),
+                    SLO("tr", "counter_ratio", target=0.99,
+                        fast_window_s=0.5, slow_window_s=1.0,
+                        bad_metrics=["b"], total_metrics=["t"])])
+        fd = MemberFailureDetector(failure_threshold=2)
+        fd.note_failure("mS")
+        fd.note_failure("mS")
+        rem = Remediator(
+            RemediationConfig(ttl_s=0.2, green_hold_s=5.0,
+                              engage_cooldown_s=0.0),
+            registry=reg)
+        rem.arm(slo_engine=engine, sampler=sampler, member_fd=fd,
+                insights=QueryInsights(capacity=8))
+        try:
+            rem.on_alert(_alert(kind="latency", slo="lat", fps=()))
+            rem.on_alert(_alert(kind="counter_ratio", slo="tr",
+                                fps=()))
+            assert rem.tightened and "mS" in fd.pinned()
+            engine._status["lat"] = {"state": "firing"}
+            engine._status["tr"] = {"state": "firing"}
+            # past the TTL while STILL firing: the release pass expires
+            # the actions, the re-attribution pass re-engages them
+            time.sleep(0.25)
+            rem.tick()
+            assert rem.tightened, "tighten lapsed mid-burn"
+            assert "mS" in fd.pinned(), "pin lapsed mid-burn"
+            # and once green, everything releases for real
+            engine._status["lat"] = {"state": "ok"}
+            engine._status["tr"] = {"state": "ok"}
+            rem.config.green_hold_s = 0.0
+            time.sleep(0.25)
+            rem.tick()      # ttl/green release
+            rem.tick()
+            assert rem.status()["active"] == []
+            assert fd.pinned() == set()
+        finally:
+            rem.disarm()
+            engine.disarm()
+
+    def test_stale_release_never_strips_a_live_pin(self):
+        """Release/re-engage race: an unpin from an already-superseded
+        release must not clear the pin a live action owns."""
+        fd = MemberFailureDetector()
+        rem = mk()
+        rem.member_fd = fd
+        rem._engage("deprioritize_member", "mR", "s")
+        assert "mR" in fd.pinned()
+        with rem._lock:
+            stale = rem._release_locked(
+                rem._actions[("deprioritize_member", "mR")], why="ttl")
+            rem._rebuild_locked()
+        # a concurrent re-engage lands before the stale unpin runs
+        rem._engage("deprioritize_member", "mR", "s")
+        rem._record_release(stale)
+        assert "mR" in fd.pinned(), "stale unpin stripped a live pin"
+        # the real release still unpins
+        rem.tick(now=time.monotonic() + 100.0)
+        assert fd.pinned() == set()
+
+    def test_disarmed_reattribution_never_engages(self):
+        """A disarm racing an in-flight tick must not re-engage:
+        stranded actions would have no release clock at all."""
+        rem = mk()
+        rem.armed = False                   # disarm flips this FIRST
+        rem._burning_ctx["s"] = {"kind": "latency", "lane": "batch"}
+        rem._last_engage_mono["s"] = -1e18
+        rem.engine = None                   # every SLO reads green-less
+        # force the not-green path by faking a firing engine
+        class _Eng:
+            _status = {"s": {"state": "firing"}}
+            _slos = {}
+        rem.engine = _Eng()
+        rem.tick()
+        assert rem.status()["active"] == []
+
+    def test_rearm_drops_previous_engine_subscription(self):
+        """arm() is idempotent, not accumulative: re-arming against a
+        different engine/sampler must unsubscribe from the old ones, or
+        an abandoned engine's alerts keep driving the actuator."""
+        reg = MetricsRegistry()
+        s1, s2 = (TimeSeriesSampler(registry=reg),
+                  TimeSeriesSampler(registry=reg))
+        e1 = SLOEngine(sampler=s1, registry=reg)
+        e2 = SLOEngine(sampler=s2, registry=reg)
+        rem = mk()
+        rem.arm(slo_engine=e1, sampler=s1)
+        rem.arm(slo_engine=e2, sampler=s2)
+        try:
+            assert rem.on_alert not in e1._alert_listeners
+            assert rem.on_alert in e2._alert_listeners
+            assert rem._on_tick not in s1._listeners
+            assert rem._on_tick in s2._listeners
+        finally:
+            rem.disarm()
+
+    def test_disarm_releases_everything(self):
+        rem = mk()
+        fd = MemberFailureDetector()
+        fd.note_failure("mX")
+        fd.note_failure("mX")
+        fd.note_failure("mX")
+        rem.member_fd = fd
+        rem.on_alert(_alert())
+        rem.on_alert(_alert(kind="counter_ratio", slo="s2", fps=()))
+        assert rem.status()["active"]
+        rem.disarm()
+        assert rem.status()["active"] == []
+        assert fd.pinned() == set()
+        assert not rem.active
+        assert rem.admit(BODY, "batch") == "batch"
+
+
+# ---------------------------------------------------------------------
+# admission surfaces: scheduler Retry-After, wlm mirror, HTTP, status
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def client():
+    from opensearch_tpu.rest.client import RestClient
+    c = RestClient()
+    c.indices.create("remidx", {"mappings": {"properties": {
+        "body": {"type": "text"}}}})
+    for i, words in enumerate(["alpha beta", "beta gamma", "alpha"]):
+        c.index("remidx", {"body": words}, id=str(i))
+    c.indices.refresh("remidx")
+    return c
+
+
+class TestSchedulerRetryAfter:
+    def test_retry_after_derivation(self, client):
+        from opensearch_tpu.serving import (SchedulerConfig,
+                                            ServingScheduler)
+        sched = ServingScheduler(
+            client.node,
+            SchedulerConfig(queue_cap=8, max_batch=4,
+                            max_wait_us=100_000),
+            enabled=True)
+        # 8 pending / batch 4 -> 2 flushes x 0.1s deadline
+        assert sched._retry_after_s(8) == pytest.approx(0.2)
+        assert sched._retry_after_s(1) == pytest.approx(0.1)
+        # zero-wait config still asks for a beat of backoff
+        sched.config.max_wait_us = 0
+        assert sched._retry_after_s(4) >= 0.05
+
+    def test_queue_full_429_carries_retry_after(self, client):
+        from opensearch_tpu.serving import (SchedulerConfig,
+                                            ServingScheduler)
+        node = client.node
+        sched = ServingScheduler(
+            node, SchedulerConfig(queue_cap=1, max_batch=4,
+                                  max_wait_us=200_000,
+                                  request_timeout_s=0.3),
+            enabled=True)
+        # pin a never-running dispatcher so the first entry stays queued
+        sched._start_dispatcher = lambda: None
+        sched._dispatcher_alive = lambda: True
+        svc = node.indices["remidx"]
+        done = []
+
+        def first():
+            done.append(sched.execute("remidx", svc,
+                                      {"query": {"match_all": {}}}))
+
+        t = threading.Thread(target=first)
+        t.start()
+        deadline = time.monotonic() + 5
+        while sched.stats()["queue_depth"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
+        with pytest.raises(PressureRejectedException) as ei:
+            sched.execute("remidx", svc, {"query": {"match_all": {}}})
+        assert ei.value.retry_after_s is not None
+        assert ei.value.retry_after_s > 0
+        assert ei.value.source == "scheduler"
+        t.join(timeout=5)
+
+    def test_tightened_admission_contracts_cap(self, client):
+        from opensearch_tpu.serving import (SchedulerConfig,
+                                            ServingScheduler)
+        node = client.node
+        sched = ServingScheduler(node, SchedulerConfig(queue_cap=64),
+                                 enabled=True)
+        old = node.remediation
+        rem = mk()
+        node.remediation = rem
+        try:
+            assert sched._effective_cap() == 64
+            rem._engage("tighten_admission", "", "s")
+            assert sched._effective_cap() == \
+                max(1, int(64 * rem.config.admission_factor))
+            rem.tick(now=time.monotonic() + 100.0)   # TTL release
+            assert sched._effective_cap() == 64
+        finally:
+            node.remediation = old
+
+    def test_stats_reports_effective_cap(self, client):
+        assert "effective_queue_cap" in client.node.serving.stats()
+
+
+class TestRejectionNaming:
+    def test_wlm_rejection_mirrors_serving_lane_counter(self, client):
+        from opensearch_tpu.rest.client import ApiError
+        from opensearch_tpu.utils.metrics import METRICS
+        client.put_workload_group("blocked", body={"search_rate": 0,
+                                                   "search_burst": 0})
+        before = METRICS.counter(
+            "serving.lane.interactive.rejected").value
+        with pytest.raises(ApiError) as ei:
+            client.search("remidx", {"query": {"match_all": {}},
+                                     "_workload_group": "blocked"})
+        assert ei.value.status == 429
+        assert METRICS.counter(
+            "serving.lane.interactive.rejected").value == before + 1
+
+    def test_wlm_admission_cost_scales_with_remediation(self, client):
+        from opensearch_tpu.utils.wlm import WorkloadGroup
+        g = WorkloadGroup("tight", search_rate=0.0, search_burst=3.0)
+        # cost 1: three admissions fit the burst
+        g.admit_search(cost=1.0)
+        g.admit_search(cost=1.0)
+        g.admit_search(cost=1.0)
+        with pytest.raises(PressureRejectedException):
+            g.admit_search(cost=1.0)
+        g2 = WorkloadGroup("tight2", search_rate=0.0, search_burst=3.0)
+        # tightened cost 2: only one admission fits
+        g2.admit_search(cost=2.0)
+        with pytest.raises(PressureRejectedException):
+            g2.admit_search(cost=2.0)
+
+    def test_wlm_cost_capped_at_burst_never_outage(self):
+        """A group whose burst can never hold the tightened cost must
+        contract to its own capacity, not black out for the TTL."""
+        from opensearch_tpu.utils.wlm import WorkloadGroup
+        g = WorkloadGroup("small", search_rate=1000.0, search_burst=1.0)
+        # cost 2 > burst 1: capped to 1 — the admission still works
+        g.admit_search(cost=2.0)
+        assert g.rejections == 0
+
+
+class TestHttpSurfaces:
+    @pytest.fixture()
+    def http(self, client):
+        from opensearch_tpu.rest.http_server import HttpServer
+        srv = HttpServer(client)
+        port = srv.start()
+        yield f"http://127.0.0.1:{port}"
+        srv.stop()
+
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return json.loads(r.read().decode())
+
+    def test_shed_429_carries_retry_after_header(self, client, http):
+        client.put_workload_group("offline", body={"lane": "batch"})
+        old = client.node.remediation
+        rem = mk(ttl=7.0)
+        client.node.remediation = rem
+        try:
+            body = {"query": {"match": {"body": "alpha"}}, "size": 10,
+                    "_workload_group": "offline"}
+            rem._engage("shed_shape",
+                        shed_key({"query": body["query"],
+                                  "size": 10}, "batch"), "s")
+            req = urllib.request.Request(
+                f"{http}/remidx/_search", method="POST",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 429
+            ra = ei.value.headers.get("Retry-After")
+            assert ra is not None and int(ra) >= 1
+        finally:
+            client.node.remediation = old
+
+    def test_remediation_status_route(self, client, http):
+        old = client.node.remediation
+        rem = mk()
+        rem._engage("tighten_admission", "", "slo-x")
+        client.node.remediation = rem
+        try:
+            out = self._get(f"{http}/_remediation")
+            assert out["_nodes"]["successful"] == 1
+            node = out["nodes"][client.node.node_name]
+            assert node["tightened"] is True
+            assert [a["kind"] for a in node["active"]] \
+                == ["tighten_admission"]
+            assert node["active"][0]["ttl_remaining_s"] > 0
+        finally:
+            client.node.remediation = old
+
+    def test_remediation_route_post_is_405(self, client, http):
+        req = urllib.request.Request(f"{http}/_remediation",
+                                     method="POST", data=b"{}")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 405
+
+
+class TestFederation:
+    def test_armed_actuator_gets_member_fd_wired(self):
+        """The env-flag arm path runs at Node init, before the cluster
+        wrapper exists — DistClusterNode must wire its detector into
+        the already-armed actuator or deprioritize_member is inert in
+        production."""
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        from opensearch_tpu.serving.remediator import REMEDIATOR
+        old = REMEDIATOR.member_fd
+        REMEDIATOR.member_fd = None
+        a = DistClusterNode("rmw")
+        try:
+            assert REMEDIATOR.member_fd is a.member_fd
+        finally:
+            REMEDIATOR.member_fd = old
+            a.stop()
+
+    def test_internal_search_op_forwards_lane(self):
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        a = DistClusterNode("rml")
+        seen = {}
+
+        def capture(index, body, lane="interactive"):
+            seen["lane"] = lane
+            return {"ok": True}
+
+        a.search = capture
+        try:
+            a.handle_internal("POST", ["_internal", "search"],
+                              {"index": "x", "body": {},
+                               "lane": "batch"})
+            assert seen["lane"] == "batch"
+            a.handle_internal("POST", ["_internal", "search"],
+                              {"index": "x", "body": {}})
+            assert seen["lane"] == "interactive"
+        finally:
+            a.stop()
+
+    def test_remediation_federated_two_nodes(self):
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        a = DistClusterNode("rma")
+        b = DistClusterNode("rmb", seed=a.addr)
+        rem_a, rem_b = mk(), mk()
+        a.remediation_engine = rem_a
+        b.remediation_engine = rem_b
+        try:
+            rem_b._engage("tighten_admission", "", "slo-y")
+            out = a.remediation_federated()
+            assert out["_nodes"] == {"total": 2, "successful": 2,
+                                     "failed": 0}
+            assert out["active_actions_total"] == 1
+            assert out["nodes"]["rma"]["active"] == []
+            assert [x["kind"] for x in out["nodes"]["rmb"]["active"]] \
+                == ["tighten_admission"]
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_dist_search_admission_shed(self):
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        from opensearch_tpu.obs.insights import QueryInsights
+        from opensearch_tpu.rest.client import ApiError
+        a = DistClusterNode("rmc")
+        rem = mk()
+        ins = QueryInsights(capacity=16)
+        a.remediation_engine = rem
+        a.insights_engine = ins
+        try:
+            a.create_index("dsidx", {
+                "settings": {"number_of_shards": 1},
+                "mappings": {"properties": {
+                    "body": {"type": "text"}}}})
+            a.index_doc("dsidx", {"body": "alpha"}, id="1")
+            a.refresh("dsidx")
+            body = {"query": {"match": {"body": "alpha"}}, "size": 10}
+            assert a.search("dsidx", dict(body))["hits"]["total"][
+                "value"] == 1
+            rem._engage("shed_shape", shed_key(body, "batch"), "s")
+            with pytest.raises(ApiError) as ei:
+                a.search("dsidx", dict(body), lane="batch")
+            assert ei.value.status == 429
+            assert "Retry-After" in ei.value.headers
+            # the rejection is attributed to the shape in the injected
+            # insights engine
+            wire = ins.to_wire()
+            assert any(e["rejections"] >= 1
+                       for e in wire["entries"])
+            # interactive lane: different key, still served
+            assert a.search("dsidx", dict(body))["hits"]["total"][
+                "value"] == 1
+        finally:
+            a.stop()

@@ -1,0 +1,101 @@
+"""CI miniature of the closed-loop traffic harness
+(scripts/traffic_harness.py): 2 nodes, 2k docs, the baseline-silence
+gate plus ONE burn-and-recover scenario, tier-1 and non-slow. The full
+3-node fleet run (overload + churn, committed BENCH artifact) stays a
+script.
+
+Also unit-covers the harness's own moving parts: the zipf popularity
+weights, the insight-distinctness of the shape catalog, and the gate
+judge."""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_spec = importlib.util.spec_from_file_location(
+    "traffic_harness", os.path.join(_REPO, "scripts",
+                                    "traffic_harness.py"))
+th = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(th)
+
+
+class TestHarnessParts:
+    def test_zipf_weights_are_a_popularity_law(self):
+        w = th.zipf_weights(6)
+        np.testing.assert_allclose(w.sum(), 1.0)
+        assert all(w[i] > w[i + 1] for i in range(len(w) - 1))
+        # the head genuinely dominates
+        assert w[0] > 2.5 * w[-1]
+
+    def test_shapes_are_insight_distinct(self):
+        from opensearch_tpu.obs.insights import fingerprint
+        rng = np.random.default_rng(0)
+        keys = {}
+        for name in sorted(th.SHAPES):
+            keys[name] = fingerprint(th.SHAPES[name](rng), "batch")[0]
+        assert len(set(keys.values())) == len(keys), keys
+
+    def test_judge_requires_the_whole_ladder(self):
+        row = th.ScenarioResult(
+            scenario="overload", alert_fired=True,
+            top_fingerprints_named=True, green_within_window=True,
+            released_all=True, byte_stable=True, shed_fraction=0.0,
+            dump_reasons=["remediation", "slo_burn"],
+            remediation={"engaged_total": 2, "shed_total": 0,
+                         "active_actions": 0},
+            load={"counts": {"errors": 0}}, engage_history=[])
+        assert not th.judge(row)              # no shed -> not healed
+        assert "shed_acted" in row["verdict"]
+        row["remediation"]["shed_total"] = 5
+        assert not th.judge(row)     # bystander sheds are not enough:
+        assert "hostile_shed" in row["verdict"]
+        row["shed_fraction"] = 0.4   # the flooding shape itself shed
+        assert th.judge(row)
+        assert row["verdict"] == "self_healed"
+
+    def test_judge_baseline_demands_silence(self):
+        row = th.ScenarioResult(
+            scenario="baseline", alerts=0, byte_stable=True,
+            remediation={"engaged_total": 0},
+            load={"counts": {"errors": 0}})
+        assert th.judge(row)
+        row["alerts"] = 1
+        assert not th.judge(row)
+
+
+class TestMiniatureBurnAndRecover:
+    def test_two_node_fleet_self_heals(self):
+        """The acceptance ladder in miniature, end to end with zero
+        human action: baseline silent + byte-stable, then the overload
+        scenario fires a burn, the actuator sheds the named shape
+        (recorded in the flight recorder), the fleet re-enters green
+        within the declared window, and every action auto-releases."""
+        out = th.run(mini=True)
+        rows = {r["scenario"]: r for r in out["scenarios"]}
+        assert set(rows) == {"baseline", "overload"}
+        base, over = rows["baseline"], rows["overload"]
+        detail = json.dumps({r["scenario"]: r.get("verdict")
+                             for r in out["scenarios"]})
+        # baseline: silence, no engagement, byte-identical pages
+        assert base["alerts"] == 0, detail
+        assert base["remediation"]["engaged_total"] == 0
+        assert base["byte_stable"]
+        assert base["load"]["counts"]["errors"] == 0
+        # sessions and both lanes genuinely ran
+        assert base["load"]["counts"]["sessions"] > 0
+        assert base["load"]["counts"]["ok"] > 0
+        # overload: detect -> attribute -> act -> green -> release
+        checks = over.get("checks") or {}
+        assert all(checks.values()), (detail, checks)
+        assert over["alerts"] >= 1
+        assert over["top_fingerprints_named"]
+        assert over["remediation"]["shed_total"] > 0
+        assert over["shed_fraction"] > 0
+        assert over["time_to_green_s"] <= over["recovery_window_s"]
+        assert {"remediation", "slo_burn"} <= set(over["dump_reasons"])
+        assert over["release_whys"]          # auto-released, recorded
+        assert over["remediation"]["active_actions"] == 0
+        assert out["gate_ok"], detail
