@@ -815,8 +815,12 @@ class DistClusterNode:
 
     # ---------------- distributed search ----------------
 
+    # knn left this list with the hybrid-retrieval subsystem (PR 15):
+    # the per-shard knn program needs no cross-shard state beyond the
+    # DFS stats that already ride every scatter, so both the ES-style
+    # top-level `knn` section and `query.knn` serve distributed now
     _UNSUPPORTED = ("collapse", "rescore", "search_after", "suggest",
-                    "profile", "knn", "scroll", "pit")
+                    "profile", "scroll", "pit")
 
     def _check_supported(self, body: dict) -> List:
         for k in self._UNSUPPORTED:
@@ -1092,6 +1096,20 @@ class DistClusterNode:
         from ..obs import flight_recorder as _fr
         from ..utils.metrics import METRICS
         from ..utils.trace import TRACER
+        from ..search import fusion
+        if fusion.is_hybrid_body(body):
+            # hybrid retrieval at the DISTRIBUTED coordinator: each
+            # sub-query runs the full DFS→scatter→reduce→fetch ladder
+            # (replica failover, deadline propagation and all) and the
+            # fused page is the same pure function of the ranked
+            # sub-pages the single-node arm computes — byte-identical
+            # across arms by construction (search/fusion.py)
+            try:
+                hq = fusion.parse_hybrid(body)
+            except dsl.QueryParseError as e:
+                raise ApiError(400, "parsing_exception", str(e))
+            return fusion.run_hybrid(
+                body, lambda sub: self._search_traced(index, sub), q=hq)
         t0 = time.monotonic()
         agg_nodes = self._check_supported(body)
         svc = self.node.indices.get(index)

@@ -34,6 +34,11 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   remediation/shed/deprioritize engage site in serving/ or cluster/
   carries a paired release path or TTL bound in file — bounded,
   reversible actions only (docs/RESILIENCE.md "Self-healing loop").
+- OSL604 fusion score-domain discipline (`fusion_rules`): linear
+  combinations of sub-query scores in fusion-shaped functions pass
+  through a designated normalizer (fusion.normalize_scores) or fuse in
+  the rank domain (RRF) — raw BM25/cosine/sparse-dot scores are
+  incomparable (docs/HYBRID.md).
 
 Run via `python scripts/oslint.py [--check]`; tier-1 runs it through
 tests/test_oslint.py. Suppress inline with
@@ -46,6 +51,7 @@ from .breaker_rules import BreakerDisciplineChecker
 from .core import (Baseline, Checker, Finding, default_checkers,
                    load_baseline, run_paths, run_source, write_baseline)
 from .dtype_rules import DtypeDisciplineChecker
+from .fusion_rules import FusionDomainChecker
 from .impact_rules import ImpactDomainChecker
 from .insights_rules import InsightsCardinalityChecker
 from .jit_rules import JitBoundaryChecker
@@ -56,7 +62,8 @@ from .sync_rules import DeviceSyncDisciplineChecker
 __all__ = [
     "Baseline", "Checker", "Finding", "default_checkers", "load_baseline",
     "run_paths", "run_source", "write_baseline",
-    "DtypeDisciplineChecker", "JitBoundaryChecker",
+    "DtypeDisciplineChecker", "FusionDomainChecker",
+    "JitBoundaryChecker",
     "BreakerDisciplineChecker", "LockDisciplineChecker",
     "DeviceSyncDisciplineChecker", "MemoryAccountingChecker",
     "ImpactDomainChecker", "InsightsCardinalityChecker",

@@ -126,6 +126,7 @@ def default_checkers() -> List[Checker]:
     from .actuator_rules import ActuatorDisciplineChecker
     from .breaker_rules import BreakerDisciplineChecker
     from .dtype_rules import DtypeDisciplineChecker
+    from .fusion_rules import FusionDomainChecker
     from .impact_rules import ImpactDomainChecker
     from .insights_rules import InsightsCardinalityChecker
     from .jit_rules import JitBoundaryChecker
@@ -144,7 +145,7 @@ def default_checkers() -> List[Checker]:
             MemoryAccountingChecker(), ImpactDomainChecker(),
             RpcDisciplineChecker(), SamplerDisciplineChecker(),
             ScorePlaneChecker(), InsightsCardinalityChecker(),
-            ActuatorDisciplineChecker()]
+            ActuatorDisciplineChecker(), FusionDomainChecker()]
 
 
 def run_source(src: str, path: str,

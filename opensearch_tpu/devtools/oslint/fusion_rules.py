@@ -1,0 +1,132 @@
+"""OSL604 — hybrid-fusion score-domain discipline.
+
+Hybrid retrieval (search/fusion.py) fuses ranked lists whose scores live
+in INCOMPARABLE similarity domains: BM25 term sums are unbounded and
+corpus-dependent, cosine kNN lives in [0, 1], learned-sparse dot
+products scale with model weight magnitudes. A linear combination of
+raw scores from different sub-queries is therefore meaningless — it
+silently ranks by whichever domain has the largest magnitude. The
+engine's contract (docs/HYBRID.md):
+
+- every LINEAR combination of sub-query scores passes each list through
+  a designated normalizer first (`fusion.normalize_scores` /
+  `minmax_normalize` / `l2_normalize`), and
+- RRF fuses in the RANK domain (`rank_constant`), which is
+  score-domain-free by construction and needs no normalizer.
+
+The rule: inside any fusion-shaped function (name mentions
+fuse/combine/hybrid) in `search/` or `serving/`, an additive
+combination whose operands are score-named expressions flags UNLESS the
+function either calls a normalizer or demonstrably fuses in the rank
+domain (`rank_constant` in scope). Accessors and out-of-scope files
+stay quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+_FUSE_MARKERS = ("fuse", "combine", "hybrid")
+
+# the designated score-domain normalizers (search/fusion.py); any
+# project-local helper ending in `_normalize` also counts — the point is
+# an EXPLICIT normalization step, not one blessed symbol
+_NORMALIZERS = ("normalize_scores", "minmax_normalize", "l2_normalize")
+
+
+def _is_fuse_fn(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _FUSE_MARKERS)
+
+
+def _scorey(expr: ast.AST) -> bool:
+    """Does this operand reference a score-named value (possibly through
+    a weight multiply or a subscript)?"""
+    for node in ast.walk(expr):
+        d = _dotted(node)
+        if d and any("score" in seg.lower() for seg in d.split(".")):
+            return True
+        if isinstance(node, ast.Subscript):
+            d = _dotted(node.value)
+            if d and any("score" in seg.lower() for seg in d.split(".")):
+                return True
+    return False
+
+
+class FusionDomainChecker(Checker):
+    rules = ("OSL604",)
+    name = "fusion-domain"
+
+    SCOPES = ("search/", "serving/")
+    EXEMPT = ("devtools/",)
+
+    def applies(self, path: str) -> bool:
+        if any(s in path for s in self.EXEMPT):
+            return False
+        return any(s in path for s in self.SCOPES)
+
+    @staticmethod
+    def _has_normalizer(fn: ast.AST) -> bool:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                leaf = _dotted(node.func).split(".")[-1]
+                if leaf in _NORMALIZERS or leaf.endswith("_normalize"):
+                    return True
+        return False
+
+    @staticmethod
+    def _rank_domain(fn: ast.AST) -> bool:
+        """RRF evidence: the function reads `rank_constant` (a name or
+        a subscript key) — reciprocal-rank fusion never touches raw
+        scores, so it is exempt by construction."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and "rank_constant" in node.id:
+                return True
+            if isinstance(node, ast.Constant) \
+                    and node.value == "rank_constant":
+                return True
+        return False
+
+    def _scan_fn(self, fn: ast.AST, sym: str, path: str,
+                 findings: List[Finding]) -> None:
+        if self._has_normalizer(fn) or self._rank_domain(fn):
+            return
+        for node in ast.walk(fn):
+            if isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                          ast.Add):
+                # a + b: both sides must look like scores (a weighted
+                # multiply counts through _scorey's walk)
+                if not (_scorey(node.left) and _scorey(node.right)):
+                    continue
+            elif isinstance(node, ast.AugAssign) \
+                    and isinstance(node.op, ast.Add):
+                # accumulating into (or from) a score-named variable
+                if not (_scorey(node.target) or _scorey(node.value)):
+                    continue
+            else:
+                continue
+            findings.append(Finding(
+                "OSL604", path, node.lineno, node.col_offset, sym,
+                "linear combination of raw sub-query scores without a "
+                "score-domain normalizer in scope — BM25/cosine/"
+                "sparse-dot scores are incomparable; pass each list "
+                "through fusion.normalize_scores (min_max/l2) first, "
+                "or fuse in the rank domain (RRF / rank_constant) "
+                "(docs/HYBRID.md)",
+                detail="unnormalized-linear-fusion"))
+
+    def check(self, tree: ast.Module, path: str,
+              src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_fuse_fn(node.name):
+                self._scan_fn(node, qmap.get(node, node.name), path,
+                              findings)
+        findings.sort(key=lambda f: (f.line, f.detail))
+        return findings

@@ -91,6 +91,11 @@ class FieldType:
     # term_vector: "with_positions_offsets" persists per-doc (term, pos,
     # start, end) for the real FastVectorHighlighter path
     term_vector: str = "no"
+    # rank_features/sparse_vector opt-in: build a codec-v2 FEATURE
+    # impact plane (quantized model-assigned weights + block-max
+    # sidecar) so neural_sparse serves through the impact ladder
+    # (search/impactpath.py, docs/HYBRID.md)
+    index_impacts: bool = False
 
     @property
     def is_indexed_terms(self) -> bool:
@@ -379,6 +384,12 @@ class Mappings:
             ft.relations = {p: (c if isinstance(c, list) else [c])
                             for p, c in cfg.get("relations", {}).items()}
         ft.positive_score_impact = bool(cfg.get("positive_score_impact", True))
+        if "index_impacts" in cfg:
+            if ftype not in FEATURE_TYPES:
+                raise ValueError(
+                    f"Field [{path}]: [index_impacts] only applies to "
+                    f"rank_features/sparse_vector fields")
+            ft.index_impacts = bool(cfg["index_impacts"])
         if ftype == "scaled_float":
             if "scaling_factor" not in cfg:
                 raise ValueError(

@@ -310,7 +310,12 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
     # bake a stale norm); the O(P) quantize map itself runs on device
     # past the size threshold (ops/device_merge.quantize_impacts).
     if default_codec_version() >= CODEC_V2:
-        merged.build_impacts()
+        # feature planes (rank_features index_impacts opt-in) rebuild
+        # whenever ANY input carried one for the field — the opt-in
+        # travels with the data, so merges never need the mappings
+        ffields = {f for s in segments for f, pb in s.postings.items()
+                   if pb.impact is not None and pb.impact.kind == "feature"}
+        merged.build_impacts(feature_fields=ffields)
         if "/" not in name:
             # BP-style impact-clustered doc-id reordering (index/reorder.py):
             # merges are the one point the whole doc set is in hand and the

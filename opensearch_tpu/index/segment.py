@@ -29,7 +29,7 @@ import math
 import os
 from dataclasses import dataclass, field as dc_field
 from functools import cached_property
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -97,6 +97,14 @@ class ImpactPlane:
     block_starts: np.ndarray  # i64[nterms+1] block-CSR row pointers
     block_off: np.ndarray     # i64[nblocks] flat element start per block
     block_max: np.ndarray     # u8/u16[nblocks] max q per block
+    # "bm25": q dequantizes to the BM25 tf-saturation under the baked
+    #   nominal (k1, b, avgdl) — query-time drift priced by drift_bound.
+    # "feature": q dequantizes DIRECTLY to the model-assigned feature
+    #   weight of a rank_features/sparse_vector posting (opt-in
+    #   `index_impacts` mapping param) — weights are query-independent,
+    #   so the only serve error is the quantization half-step
+    #   (quant_err); drift_bound must never be consulted.
+    kind: str = "bm25"
 
     @property
     def qmax(self) -> int:
@@ -179,6 +187,17 @@ def build_impact_plane(pb: "PostingsBlock", dl: Optional[np.ndarray],
         scale = (m / qmax) if m > 0 else 1.0
         q = np.minimum(np.round(imp / np.float32(scale)), qmax).astype(
             np.uint8 if bits == 8 else np.uint16)
+    block_starts, block_off, block_max = _impact_sidecar(pb, q)
+    return ImpactPlane(q=q, scale=float(scale), bits=bits,
+                       k1=IMPACT_K1, b=IMPACT_B, avgdl=float(avgdl),
+                       dl_max=dl_max, block_starts=block_starts,
+                       block_off=block_off, block_max=block_max)
+
+
+def _impact_sidecar(pb: "PostingsBlock", q: np.ndarray):
+    """Per-IMPACT_BLOCK-posting block-max sidecar over one quantized
+    plane: (block_starts i64[nterms+1], block_off i64[nblocks],
+    block_max u8/u16[nblocks])."""
     lens = np.diff(pb.starts)
     nblk = -(-lens // IMPACT_BLOCK)           # ceil; empty rows -> 0 blocks
     block_starts = np.zeros(len(lens) + 1, np.int64)
@@ -194,10 +213,38 @@ def build_impact_plane(pb: "PostingsBlock", dl: Optional[np.ndarray],
     else:
         block_off = np.zeros(0, np.int64)
         block_max = np.zeros(0, q.dtype)
+    return block_starts, block_off, block_max
+
+
+def build_feature_impact_plane(pb: "PostingsBlock",
+                               bits: Optional[int] = None
+                               ) -> Optional[ImpactPlane]:
+    """Quantize one rank_features/sparse_vector field's model-assigned
+    weights into a codec-v2 impact plane (`kind="feature"`, opt-in via
+    the `index_impacts` mapping param). The CSR "tf" slot of a feature
+    field IS the weight, so the plane stores round(w / scale) with one
+    global scale — the learned-sparse dot product then serves through
+    the SAME block-max prune → integer gather → certify-or-escalate
+    ladder as BM25 impacts (GPUSparse, arxiv 2606.26441), with
+    quantization as the only error source (no similarity-param drift:
+    weights are query-independent). Mapping-level validation guarantees
+    positive weights; a degenerate all-zero plane declines."""
+    if pb.size == 0:
+        return None
+    bits = default_impact_bits() if bits is None else int(bits)
+    qmax = (1 << bits) - 1
+    w = pb.tfs.astype(np.float32)
+    m = float(w.max()) if len(w) else 0.0
+    if m <= 0.0:
+        return None
+    scale = m / qmax
+    q = np.minimum(np.round(w / np.float32(scale)), qmax).astype(
+        np.uint8 if bits == 8 else np.uint16)
+    block_starts, block_off, block_max = _impact_sidecar(pb, q)
     return ImpactPlane(q=q, scale=float(scale), bits=bits,
-                       k1=IMPACT_K1, b=IMPACT_B, avgdl=float(avgdl),
-                       dl_max=dl_max, block_starts=block_starts,
-                       block_off=block_off, block_max=block_max)
+                       k1=0.0, b=0.0, avgdl=1.0, dl_max=0,
+                       block_starts=block_starts, block_off=block_off,
+                       block_max=block_max, kind="feature")
 
 # memory accounting for the per-segment DEVICE column cache
 # (`device_arrays` HBM residency) goes through the HBM ledger
@@ -582,13 +629,24 @@ class Segment:
 
     # ---------------- codec v2: impact planes ----------------
 
-    def build_impacts(self, bits: Optional[int] = None) -> None:
+    def build_impacts(self, bits: Optional[int] = None,
+                      feature_fields: Sequence[str] = ()) -> None:
         """Build quantized impact planes for every text-scored field
         (fields with a doc-length column) and stamp the segment codec v2.
+        `feature_fields` names rank_features/sparse_vector fields whose
+        mapping opted into `index_impacts`: those get a FEATURE plane
+        (model-assigned weights quantized directly, kind="feature") so
+        `neural_sparse` serves through the impact ladder.
         Idempotent; used by build_segment/merge and by direct CSR corpus
         wrappers (bench.py, scripts/hbm_report.py)."""
+        feature_fields = set(feature_fields)
         for f, pb in self.postings.items():
-            if pb.impact is not None or f not in self.doc_lens:
+            if pb.impact is not None:
+                continue
+            if f in feature_fields and f not in self.doc_lens:
+                pb.impact = build_feature_impact_plane(pb, bits=bits)
+                continue
+            if f not in self.doc_lens:
                 continue
             st = self.text_stats.get(f)
             avgdl = (st.sum_dl / st.doc_count
@@ -755,9 +813,18 @@ class Segment:
         # first-class ledger observable.
         imp_bytes = sum(int(fa["impacts"].nbytes)
                         for fa in post.values() if "impacts" in fa)
+        # dense-vector residency is its own tenant pair (ISSUE 15: kNN
+        # as a first-class serving citizen needs its HBM bytes visible):
+        # the doc matrices under `vector_columns`, the balanced-IVF
+        # probe structures (centroids + dense lists + validity) under
+        # `ann_ivf` — both still charged, just attributed
+        ivf_bytes = sum(int(v[k2].nbytes) for v in vcols.values()
+                        for k2 in ("ivf_centroids", "ivf_lists",
+                                   "ivf_cvalid") if k2 in v)
+        vec_bytes = _tree_nbytes(vcols) - ivf_bytes
         nbytes = sum(_tree_nbytes(self._device_cache[key][g])
                      for g in ("postings", "numeric", "keyword",
-                               "geo", "vector", "doc_lens"))
+                               "geo", "doc_lens"))
         nbytes -= imp_bytes
         nbytes += sum(int(c["parent"].nbytes)
                       for c in nst.values())
@@ -771,6 +838,17 @@ class Segment:
                 "segment_columns", nbytes, owner=self, segment=self,
                 device=key, label=f"segment-device[{self.name}]",
                 evictor=self.evict_device))
+            if vec_bytes:
+                allocs.append(LEDGER.register(
+                    "vector_columns", vec_bytes, owner=self,
+                    segment=self, device=key,
+                    label=f"segment-vectors[{self.name}]",
+                    evictor=self.evict_device))
+            if ivf_bytes:
+                allocs.append(LEDGER.register(
+                    "ann_ivf", ivf_bytes, owner=self, segment=self,
+                    device=key, label=f"segment-ivf[{self.name}]",
+                    evictor=self.evict_device))
             if imp_bytes:
                 allocs.append(LEDGER.register(
                     "impact_postings", imp_bytes, owner=self, segment=self,
@@ -1054,7 +1132,8 @@ class Segment:
                 meta["impacts"][f] = {"scale": ip.scale, "bits": ip.bits,
                                       "k1": ip.k1, "b": ip.b,
                                       "avgdl": ip.avgdl,
-                                      "dl_max": ip.dl_max}
+                                      "dl_max": ip.dl_max,
+                                      "kind": ip.kind}
             meta["postings"][f] = {"vocab_file": True, "positional": pb.pos_starts is not None}
             with open(os.path.join(path, f"vocab__{f.replace('/', '_')}.txt"), "w") as fh:
                 fh.write("\n".join(pb.vocab))
@@ -1150,7 +1229,8 @@ class Segment:
                     dl_max=int(im["dl_max"]),
                     block_starts=arrays[f"imp__{f}__bstarts"],
                     block_off=arrays[f"imp__{f}__boff"],
-                    block_max=arrays[f"imp__{f}__bmax"])
+                    block_max=arrays[f"imp__{f}__bmax"],
+                    kind=str(im.get("kind", "bm25")))
         numeric = {f: NumericColumn(f, m["kind"], arrays[f"num__{f}__values"],
                                     arrays[f"num__{f}__present"])
                    for f, m in meta["numeric"].items()}
@@ -1412,6 +1492,18 @@ def _numeric_kind(mappings: Mappings, fname: str) -> str:
     return "float" if (ft is not None and ft.type in FLOAT_TYPES) else "int"
 
 
+def feature_impact_fields(mappings: Mappings, fields) -> List[str]:
+    """The subset of feature-postings fields whose mapping opted into
+    `index_impacts` (rank_features/sparse_vector only) — the fields that
+    get a codec-v2 FEATURE impact plane at build/merge time."""
+    out = []
+    for f in sorted(fields):
+        ft = mappings.resolve_field(f)
+        if ft is not None and getattr(ft, "index_impacts", False):
+            out.append(f)
+    return out
+
+
 def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                   seq_nos: Optional[List[int]] = None,
                   with_positions: bool = True) -> Segment:
@@ -1587,8 +1679,12 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                   shape_cols=shape_cols, stored_vals=stored_vals)
     if default_codec_version() >= CODEC_V2:
         # codec v2: eager quantized impacts + block-max sidecar per
-        # text-scored field (nested children recurse in build_impacts)
-        seg.build_impacts()
+        # text-scored field (nested children recurse in build_impacts),
+        # plus FEATURE planes for rank_features/sparse_vector fields
+        # whose mapping opted into index_impacts (learned-sparse on the
+        # impact ladder, docs/HYBRID.md)
+        seg.build_impacts(feature_fields=feature_impact_fields(
+            mappings, feat_fields))
     # term_vector=with_positions_offsets fields: per-doc (term, pos, start,
     # end) for the FVH path (host-only, like _source)
     seg.term_vectors = term_vectors
@@ -2022,6 +2118,15 @@ class StreamingSegmentBuilder:
                           stored_vals=(self._stored if self._any_stored
                                        else None))
             if default_codec_version() >= CODEC_V2:
+                # no feature_fields here BY INVARIANT: docs carrying
+                # rank_features are not stream-eligible
+                # (`stream_eligible` rejects pd.features), so the
+                # refresh path routes them to `build_segment`, which
+                # derives the index_impacts opt-in from the mappings.
+                # If streaming ever learns feature postings, thread
+                # `feature_impact_fields(self.mappings, ...)` through
+                # here or big-buffer refreshes silently lose the plane
+                # (and merges of such segments lose the opt-in forever).
                 seg.build_impacts()
             seg.term_vectors = None
             return seg

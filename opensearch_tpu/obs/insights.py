@@ -85,7 +85,8 @@ _FIELD_KINDS = frozenset((
     "match", "match_phrase", "match_phrase_prefix", "match_bool_prefix",
     "term", "terms", "prefix", "wildcard", "regexp", "fuzzy", "range",
     "rank_feature", "distance_feature", "geo_distance", "geo_shape",
-    "geo_bounding_box", "intervals", "span_term", "knn"))
+    "geo_bounding_box", "intervals", "span_term", "knn",
+    "neural_sparse"))
 _TERMY_KINDS = frozenset((
     "match", "match_phrase", "match_phrase_prefix", "match_bool_prefix"))
 _COMPOUND_LIST_KEYS = ("must", "should", "must_not", "filter")
@@ -133,7 +134,7 @@ def _shape_node(node, depth: int, st: _ShapeStats) -> str:
                              for s in subs[:_MAX_CHILDREN])
             parts.append(f"{ck}:[{inner}]")
         return f"bool({','.join(parts)})"
-    if kind in ("dis_max",) and isinstance(spec, dict):
+    if kind in ("dis_max", "hybrid") and isinstance(spec, dict):
         subs = spec.get("queries") or []
         inner = ",".join(_shape_node(s, depth + 1, st)
                          for s in subs[:_MAX_CHILDREN])
@@ -225,13 +226,43 @@ def fingerprint(body: dict, lane: str = "interactive"
         terms_b = 1
         while terms_b < max(st.terms, 1) and terms_b < 256:
             terms_b <<= 1
+        # vector/hybrid workload descriptors (ISSUE 15): a hybrid body
+        # carries its sub-query COUNT and the set of retrieval-family
+        # kinds as identity — a 2-sub lexical+knn hybrid and a 3-sub
+        # hybrid with learned-sparse are different workloads the
+        # heavy-hitter attribution (and the PR-14 remediator's shed
+        # match) must tell apart. knn also derives from the QUERY tree
+        # (query.knn / a knn sub-query), not just the ES-style body key.
+        sub_kinds: List[str] = []
+        hybrid_n = 0
+        if isinstance(q, dict) and isinstance(q.get("hybrid"), dict):
+            subs = q["hybrid"].get("queries")
+            if isinstance(subs, list):
+                hybrid_n = len(subs)
+                sub_kinds = sorted({next(iter(s)) for s in
+                                    subs[:_MAX_CHILDREN]
+                                    if isinstance(s, dict) and s})[:8]
+        # the FEATURE flag derives from every vector form (ES-style
+        # body key, query.knn, knn sub-queries) — but the CANON slot
+        # keeps only the body-key bit it always carried: query.knn and
+        # hybrid sub-kinds are already identity-bearing via the shape
+        # string / the hybrid suffix below, and re-deriving the canon
+        # flag would change every pre-existing query.knn digest
+        knn_feature = knn or "knn(" in shape or "knn" in sub_kinds
         features = {"kind": shape.split("(", 1)[0], "terms": st.terms,
                     "terms_bucket": terms_b, "depth": st.depth,
                     "clauses": st.clauses, "aggs": aggs, "sort": sort,
-                    "size_bucket": size_b, "lane": lane, "knn": knn}
+                    "size_bucket": size_b, "lane": lane,
+                    "knn": knn_feature,
+                    "hybrid": hybrid_n > 0, "sub_queries": hybrid_n,
+                    "sub_kinds": sub_kinds}
         canon = (f"{shape}|lane={lane}|sort={sort}|"
                  f"aggs={','.join(aggs)}|size={size_b}|knn={int(knn)}|"
                  f"terms={terms_b}")
+        if hybrid_n:
+            # appended ONLY for hybrid bodies so every pre-existing
+            # shape digest stays stable across the format rev
+            canon += f"|hybrid={hybrid_n}|subs={','.join(sub_kinds)}"
     except Exception:       # noqa: BLE001 — fingerprinting must never
         # fail a search; a pathological body lands in one bucket
         shape, features = "unparseable", {"kind": "unparseable",

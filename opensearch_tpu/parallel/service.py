@@ -944,6 +944,13 @@ class MeshSearchService:
             except dsl.QueryParseError:
                 self._fall("parse_error")
                 continue
+            if isinstance(query, dsl.HybridQuery):
+                # hybrid fuses at the coordinator AFTER N independent
+                # retrievals (search/fusion.py) — declined BEFORE rewrite
+                # (the rewriter 400s on nested hybrid) with its own
+                # attributed shape, never the flat query_shape bucket
+                self._fall("query_hybrid")
+                continue
             lroot = C.rewrite(query, ctx, scoring=True)
             sort_specs = _norm_sort_specs(body)
             agg_nodes = parse_aggs(body.get("aggs",
@@ -1932,6 +1939,16 @@ class MeshSearchService:
         for k in self._HOST_LOOP_KEYS_TRUTHY:
             if body.get(k):
                 return f"body_{k}"
+        # vector/hybrid retrieval families decline by QUERY kind, not a
+        # body key: a pure-knn / neural_sparse / hybrid query must show
+        # up attributed in fallback_shapes (ISSUE 15 satellite — a
+        # vector flood the remediator can shed needs a name), never as
+        # the flat query_shape bucket
+        q = body.get("query")
+        if isinstance(q, dict) and len(q) == 1:
+            qk = next(iter(q))
+            if qk in ("knn", "hybrid", "neural_sparse"):
+                return f"query_{qk}"
         for k in self._HOST_LOOP_KEYS_PRESENT:
             if body.get(k) is not None:
                 return f"body_{k}"

@@ -987,6 +987,10 @@ class RestClient:
             # escalation ladder counters plus the device block-skip rate
             # (blocks the block-max prune never gathered)
             "impactpath": self._impactpath_block(),
+            # hybrid retrieval (search/fusion.py): fused searches by
+            # method, sub-query volume, and the coalesced pure-knn batch
+            # launch counters (executor._launch_knn_segment)
+            "hybridpath": self._hybridpath_block(),
             # unified telemetry (utils/metrics.py): per-stage latency
             # percentiles for every instrumented stage (search phases,
             # fastpath ladder rungs, mesh dispatch, distnode RPCs) and
@@ -1022,6 +1026,11 @@ class RestClient:
         out = _ip.stats()
         out["block_skip_rate"] = round(_ip.block_skip_rate(), 4)
         return out
+
+    @staticmethod
+    def _hybridpath_block() -> dict:
+        from ..search import fusion as _fusion
+        return _fusion.stats()
 
     def _hbm_block(self) -> dict:
         out = self.node.hbm_ledger.snapshot()

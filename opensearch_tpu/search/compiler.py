@@ -99,6 +99,32 @@ class _TimedProgram:
         return out
 
 
+# every program-builder lru cache in the process, for
+# `clear_program_caches` — the jitted wrappers these hold pin mmap'd
+# JIT-code regions for as long as they live
+_PROGRAM_CACHE_CLEARERS: List[Callable] = []
+
+
+def clear_program_caches() -> None:
+    """Drop every compiled-program cache in the process: the engine's
+    lru program builders AND JAX's internal jit caches. Each XLA-CPU
+    executable pins a triplet of mmap'd JIT-code regions; a process
+    that compiles unboundedly many program shapes (full test suites,
+    multi-corpus bench runs) accumulates tens of thousands of maps and
+    can cross the kernel's `vm.max_map_count` ceiling — the same limit
+    the reference engine's bootstrap check guards (Elasticsearch/
+    OpenSearch demand vm.max_map_count >= 262144) — after which the
+    next mmap inside a compile fails as a SIGSEGV. Everything
+    recompiles on demand; counters and telemetry are untouched."""
+    import gc
+
+    import jax
+    for clear in list(_PROGRAM_CACHE_CLEARERS):
+        clear()
+    jax.clear_caches()
+    gc.collect()
+
+
 def _instrumented_program_cache(family: str, maxsize: int,
                                 shape_of: Optional[Callable] = None):
     """lru_cache a program builder with registry attribution: requests
@@ -137,6 +163,7 @@ def _instrumented_program_cache(family: str, maxsize: int,
 
         wrapper.cache_info = cached.cache_info
         wrapper.cache_clear = cached.cache_clear
+        _PROGRAM_CACHE_CLEARERS.append(cached.cache_clear)
         return wrapper
 
     return deco
@@ -769,6 +796,15 @@ def _numeric_eq_node(ft, field: str, value: Any, boost: float) -> LNode:
 
 def _rewrite(q: dsl.Query, ctx: ShardContext, scoring: bool) -> LNode:  # noqa: C901
     m = ctx.mappings
+
+    if isinstance(q, dsl.HybridQuery):
+        # hybrid is a COORDINATOR construct (search/fusion.py): the
+        # top-level interceptors (search_shards, distnode) consume it
+        # before any per-shard plan exists. Reaching the rewriter means
+        # it was nested inside another query — a structural 400.
+        raise dsl.QueryParseError(
+            "[hybrid] must be the top-level query — sub-queries fuse at "
+            "the coordinator merge and cannot nest inside other queries")
 
     if isinstance(q, dsl.MatchAllQuery):
         return LMatchAll(boost=q.boost)
@@ -2054,6 +2090,10 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
             return ("rank_feature_col", nid, node.field, node.fn, node.positive,
                     node.field in seg.numeric_cols)
         pb = seg.postings.get(node.field)
+        if pb is not None and pb.impact is not None:
+            # feature-impact field: rank_feature's monotone functions
+            # need the exact f32 weights (see LSparseDot above)
+            seg.ensure_device_tfs(node.field)
         row = pb.row(node.feature) if pb is not None else -1
         df = pb.doc_freq(node.feature) if pb is not None else 0
         _p(params, f"q{nid}_rows", np.asarray([row], np.int32))
@@ -2064,6 +2104,13 @@ def prepare(node: LNode, seg: Segment, ctx: ShardContext, params: dict):  # noqa
         pb = seg.postings.get(node.field)
         if pb is None:
             return ("match_none", nid)
+        if pb.impact is not None:
+            # feature-impact field (index_impacts): the v2 device layout
+            # ships the quantized plane without the f32 weight plane; the
+            # generic sparse_dot program (bool-embedded neural_sparse,
+            # mesh-attached nodes, dense escalation of the sparse impact
+            # ladder) still scores from exact weights — promote lazily
+            seg.ensure_device_tfs(node.field)
         T_pad = next_pow2(len(node.tokens), floor=8)
         rows = np.full(T_pad, -1, np.int32)
         rows[: len(node.tokens)] = [pb.row(t) for t in node.tokens]
@@ -5194,6 +5241,14 @@ def prepare_collapse(collapse: Optional[dict], seg: Segment, ctx: ShardContext,
 def _build_executor(full_spec):
     import jax
 
+    return jax.jit(_executor_run_fn(full_spec))
+
+
+def _executor_run_fn(full_spec):
+    """The raw (unjitted) per-segment executor body, jitted by
+    `_build_executor` — the ONE program both the direct path and the
+    coalesced knn batch (`launch_segment_batch`) invoke, which is what
+    makes a batched page byte-identical to its direct sibling."""
     (query_spec, sort_spec, agg_specs, k_pad, named_specs, has_after,
      collapse_spec) = full_spec
 
@@ -5241,7 +5296,46 @@ def _build_executor(full_spec):
             out["named"] = named
         return out
 
-    return jax.jit(run)
+    return run
+
+
+def launch_segment_batch(prepared: list, seg_arrays: dict):
+    """LAUNCH a coalesced batch of per-query executor programs over one
+    segment: every query's invocation of THE direct-path program
+    (`_build_executor`, shared jit cache — structurally identical
+    queries compile once) enqueues here UNFETCHED; the returned closure
+    performs one deferred `device_get` sweep for the whole batch
+    (oslint OSL504). `prepared` is a list of `(full_spec, params)`
+    already canonicalized via `canon_query`.
+
+    Deliberately NOT a vmapped mega-program: vmap's batched dot_general
+    lands ~1 ULP away from the scalar program's contraction on real
+    backends, and a scheduler-coalesced page must stay BYTE-identical
+    to its scheduler-off sibling (the f32 single-domain serving
+    contract, docs/FASTPATH.md) — the batching win here is cross-request
+    coalescing + async launch pipelining, with the score domain pinned
+    by construction."""
+    import jax
+
+    pending = []
+    for full_spec, cparams in prepared:
+        exe = _build_executor(full_spec)
+        pending.append(exe(seg_arrays, cparams))   # invocation, no sync
+
+    def _fetch():
+        return jax.device_get(pending)
+
+    return _fetch
+
+
+def canon_query(query_spec, sort_spec, k_pad: int, params: dict):
+    """Canonicalize one prepared (query, sort, k_pad) triple + params the
+    way `run_segment` does — the grouping key for batched launches."""
+    mapping: Dict[int, int] = {}
+    full = _canon_spec((query_spec, sort_spec, (), k_pad, (), False,
+                        None), mapping)
+    return full, {_canon_param_key(k, mapping): v
+                  for k, v in params.items()}
 
 
 def run_segment(query_spec, sort_spec, agg_specs, named_specs, k_pad: int,

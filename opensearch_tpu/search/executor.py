@@ -168,11 +168,14 @@ def _cost_predicted(lroot, seg, window: int) -> None:
                 npost += df
                 v2 = (getattr(seg, "codec_version", C.CODEC_V1)
                       >= C.CODEC_V2 and pb.impact is not None)
-                if v2 and isinstance(node, C.LTerms) \
-                        and node.mode == "score":
+                if v2 and ((isinstance(node, C.LTerms)
+                            and node.mode == "score")
+                           or (isinstance(node, C.LSparseDot)
+                               and pb.impact.kind == "feature")):
                     # codec v2: the eager plane replaces the f32 tf slot
                     # with a u8/u16 impact — predict the SMALLER volume
-                    # (the claim the actual-launch stamps reconcile)
+                    # (the claim the actual-launch stamps reconcile);
+                    # learned-sparse feature planes price identically
                     nbytes += df * (4 + pb.impact.bits // 8)
                 else:
                     nbytes += df * _qcost.POSTING_SLOT_BYTES
@@ -184,6 +187,36 @@ def _cost_predicted(lroot, seg, window: int) -> None:
                                                       bool)):
                 stack.append(v)
     qc.note_predicted(nbytes, npost, window, segment=seg)
+
+
+def compose_knn_query(body: dict) -> Optional[dsl.Query]:
+    """The body's effective query tree, folding the ES-style top-level
+    `knn` section ({"field", "query_vector", "k", "filter"}) into the DSL
+    tree: knn alone, or bool-should'ed with the query (reference
+    SearchSourceBuilder knn handling). Shared by the per-shard query
+    phase and the batched-launch classifier so the two can never
+    disagree on what a body means."""
+    query = dsl.parse_query(body.get("query")) if (body.get("query")
+                                                   or "knn" not in body) \
+        else None
+    knn_spec = body.get("knn")
+    if knn_spec is not None:
+        _np = knn_spec.get("method_parameters", {}).get(
+            "nprobe", knn_spec.get("nprobe"))
+        kq = dsl.KnnQuery(field=knn_spec["field"],
+                          vector=list(knn_spec.get("query_vector",
+                                                   knn_spec.get("vector",
+                                                                []))),
+                          k=int(knn_spec.get("k", 10)),
+                          filter=(dsl.parse_query(knn_spec["filter"])
+                                  if knn_spec.get("filter") else None),
+                          boost=float(knn_spec.get("boost", 1.0)),
+                          nprobe=int(_np) if _np is not None else None,
+                          exact=bool(knn_spec.get("exact", False)))
+        query = dsl.BoolQuery(should=[query, kq],
+                              minimum_should_match="1") \
+            if query is not None else kq
+    return query
 
 
 class ShardSearcher:
@@ -251,24 +284,7 @@ class ShardSearcher:
                         derived_mod.ensure(seg, ctx.mappings, ddefs, names)
                 except (ScriptError, ValueError) as e:
                     raise dsl.QueryParseError(f"derived field: {e}")
-        query = dsl.parse_query(body.get("query")) if (body.get("query")
-                                                        or "knn" not in body) else None
-        knn_spec = body.get("knn")
-        if knn_spec is not None:
-            # ES-style top-level knn: {"field", "query_vector", "k", "filter"}
-            _np = knn_spec.get("method_parameters", {}).get(
-                "nprobe", knn_spec.get("nprobe"))
-            kq = dsl.KnnQuery(field=knn_spec["field"],
-                              vector=list(knn_spec.get("query_vector",
-                                                       knn_spec.get("vector", []))),
-                              k=int(knn_spec.get("k", 10)),
-                              filter=(dsl.parse_query(knn_spec["filter"])
-                                      if knn_spec.get("filter") else None),
-                              boost=float(knn_spec.get("boost", 1.0)),
-                              nprobe=int(_np) if _np is not None else None,
-                              exact=bool(knn_spec.get("exact", False)))
-            query = dsl.BoolQuery(should=[query, kq], minimum_should_match="1") \
-                if query is not None else kq
+        query = compose_knn_query(body)
         lroot = C.rewrite(query, ctx, scoring=True)
         ctx._current_lroot = lroot  # children/parent aggs join against it
 
@@ -976,6 +992,19 @@ def search_shards(searchers: List[ShardSearcher], body: dict,
     phase-results slot (reference SearchPhaseResultsProcessor.java): it runs
     after the per-shard device query phase, before the coordinator reduce.
     """
+    from . import fusion
+    if fusion.is_hybrid_body(body):
+        # hybrid retrieval (search/fusion.py): each sub-query runs as an
+        # independent retrieval through THIS same entry (its own serving
+        # ladder, its own cost accumulator feeding the shared insights
+        # observation); the fused page is a pure function of the ranked
+        # sub-pages
+        hq = fusion.parse_hybrid(body)
+        return fusion.run_hybrid(
+            body,
+            lambda sub: search_shards(searchers, sub, index_name,
+                                      task=task),
+            q=hq)
     t0 = time.monotonic()
     body = dict(body)
     body["_index_name"] = index_name
@@ -1070,7 +1099,14 @@ def launch_msearch_batched(searchers: List[ShardSearcher],
     when the fast path is off."""
     from .launch import LaunchHandle
 
-    if not fastpath.enabled() or not searchers:
+    if not searchers:
+        return None
+    fp_on = fastpath.enabled()
+    if not fp_on and not any(_maybe_knn_body(b) for b in bodies):
+        # the Pallas kernels are TPU-only, but the batched pure-knn
+        # route is plain XLA (vmapped executor twin) and engages on
+        # every backend — only bail wholesale when NEITHER route can
+        # serve anything
         return None
     stats = _global_stats_contexts(searchers)
     nb = len(bodies)
@@ -1085,8 +1121,8 @@ def launch_msearch_batched(searchers: List[ShardSearcher],
             parsed.append(None)
             continue
         try:
-            query = dsl.parse_query(body.get("query"))
-        except dsl.QueryParseError:
+            query = compose_knn_query(body)
+        except (dsl.QueryParseError, KeyError, TypeError, ValueError):
             parsed.append(None)     # slow path surfaces the error per body
             continue
         parsed.append((body, query, _norm_sort_specs(body),
@@ -1101,12 +1137,14 @@ def launch_msearch_batched(searchers: List[ShardSearcher],
     # fetch may still ride a later launch — per-query results are
     # batch-composition invariant, so its entries are simply discarded
     launches: List[tuple] = []
+    knn_launches: List[tuple] = []
     for i, s in enumerate(searchers):
         if not any(ok):
             break
         ctx = stats[i]
         segments = list(s.engine.segments)
         fspecs: List[Optional[Any]] = [None] * nb
+        kroots: List[Optional[Any]] = [None] * nb
         for bi, p in enumerate(parsed):
             if not ok[bi]:
                 continue
@@ -1119,34 +1157,79 @@ def launch_msearch_batched(searchers: List[ShardSearcher],
             if _collect_named(lroot):
                 ok[bi] = False
                 continue
-            fspecs[bi] = fastpath.make_spec(lroot, sort_specs, [], [], None,
-                                            window, body)
+            fspecs[bi] = (fastpath.make_spec(lroot, sort_specs, [], [],
+                                             None, window, body)
+                          if fp_on else None)
             if fspecs[bi] is None:
-                ok[bi] = False
-        live_bis = [bi for bi in range(nb) if ok[bi]]
-        if not live_bis:
+                # pure-knn route: a lone LKnn root (query.knn, or the
+                # ES-style top-level knn section with no query) batches
+                # through the vmapped twin of the SAME general program
+                # the direct path runs — first-class vector serving
+                # (ISSUE 15), byte-identical per query by construction
+                if isinstance(lroot, C.LKnn) \
+                        and _knn_batch_body_ok(sort_specs, body, window):
+                    kroots[bi] = lroot
+                else:
+                    if isinstance(lroot, C.LKnn):
+                        from ..search import fusion as _fusion
+                        _fusion.STATS.inc("knn_batch_declined")
+                    ok[bi] = False
+        live_bis = [bi for bi in range(nb)
+                    if ok[bi] and fspecs[bi] is not None]
+        knn_bis = [bi for bi in range(nb) if ok[bi] and kroots[bi] is not None]
+        if not live_bis and not knn_bis:
             continue
         for seg_ord, seg in enumerate(segments):
             if seg.live_count == 0:
                 continue
-            handle = fastpath.launch_batch(
-                seg, ctx, [fspecs[bi] for bi in live_bis],
-                max((parsed[bi][3] for bi in live_bis), default=10),
-                count_stats=False)
-            if handle is None:
-                # wholesale decline, known AT LAUNCH (segment can't take
-                # the fast path at all): fail these bodies now so later
-                # shards don't enqueue kernels for work that would only
-                # be discarded at fetch (same outcome as the synchronous
-                # path's `outs is None` break, same launch count too)
-                for bi in live_bis:
-                    ok[bi] = False
-                break
-            launches.append((i, s, ctx, seg, seg_ord, list(live_bis),
-                             fspecs, handle))
+            if live_bis:
+                handle = fastpath.launch_batch(
+                    seg, ctx, [fspecs[bi] for bi in live_bis],
+                    max((parsed[bi][3] for bi in live_bis), default=10),
+                    count_stats=False)
+                if handle is None:
+                    # wholesale decline, known AT LAUNCH (segment can't
+                    # take the fast path at all): fail these bodies now
+                    # so later shards don't enqueue kernels for work that
+                    # would only be discarded at fetch (same outcome as
+                    # the synchronous path's `outs is None` break, same
+                    # launch count too)
+                    for bi in live_bis:
+                        ok[bi] = False
+                    live_bis = []
+                else:
+                    launches.append((i, s, ctx, seg, seg_ord,
+                                     list(live_bis), fspecs, handle))
+            if knn_bis:
+                got = _launch_knn_segment(s, ctx, seg, seg_ord, i,
+                                          [(bi, kroots[bi],
+                                            parsed[bi][2], parsed[bi][3])
+                                           for bi in knn_bis])
+                if got is None:
+                    # tie-aware segment (BP reorder widen loop) or
+                    # can-prepare failure: parity demands the direct
+                    # path's per-segment machinery — decline these
+                    # bodies wholesale
+                    from ..search import fusion as _fusion
+                    _fusion.STATS.inc("knn_batch_declined", len(knn_bis))
+                    for bi in knn_bis:
+                        ok[bi] = False
+                    knn_bis = []
+                else:
+                    knn_launches.extend(got)
 
     def _finish():
         served_batches: List[tuple] = []
+        for (i, s, ctx, seg, seg_ord, bis, fetch_fn) in knn_launches:
+            live = [bi for bi in bis if ok[bi]]
+            if not live:
+                continue
+            outs = fetch_fn()
+            by_bi = dict(zip(bis, outs))
+            for bi in live:
+                _b, _q, k_sort_specs, _w = parsed[bi]
+                s._collect_topk(results[bi][i], by_bi[bi], seg, seg_ord,
+                                i, k_sort_specs, None, None, False, ctx)
         for (i, s, ctx, seg, seg_ord, seg_live, fspecs,
              handle) in launches:
             live = [bi for bi in seg_live if ok[bi]]
@@ -1191,8 +1274,81 @@ def launch_msearch_batched(searchers: List[ShardSearcher],
         # launch forensics for the scheduler's per-request journal
         # (mirrors MeshSearchService.launch_msearch's handle.info)
         info = {"path": "kernel", "bodies": int(sum(ok)),
-                "kernel_launches": len(launches)}
+                "kernel_launches": len(launches),
+                "knn_batch_launches": len(knn_launches)}
     return LaunchHandle(_finish, kind="fastpath", info=info)
+
+
+def _maybe_knn_body(body) -> bool:
+    """Cheap screen: could this body take the batched pure-knn route?"""
+    if not isinstance(body, dict):
+        return False
+    if isinstance(body.get("knn"), dict):
+        return True
+    q = body.get("query")
+    return isinstance(q, dict) and "knn" in q
+
+
+def _knn_batch_body_ok(sort_specs, body: dict, window: int) -> bool:
+    """Body checks for the batched pure-knn route — the shape class the
+    direct general path serves with oversample 1 and no per-segment
+    budget stops (terminate_after / a live timeout need the
+    deadline-aware host loop; a non-score sort needs host re-sorting)."""
+    if window < 1 or window > 1024:
+        return False
+    if sort_specs and not (len(sort_specs) == 1
+                           and sort_specs[0]["field"] == "_score"
+                           and sort_specs[0].get("order", "desc")
+                           == "desc"):
+        return False
+    if body.get("collapse") or body.get("suggest") \
+            or body.get("terminate_after"):
+        return False
+    if body.get("timeout") is not None:
+        from ..utils.deadline import parse_timeout_s
+        try:
+            if parse_timeout_s(body["timeout"]) is not None:
+                return False
+        except ValueError:
+            return False
+    return True
+
+
+def _launch_knn_segment(s: ShardSearcher, ctx, seg: Segment, seg_ord: int,
+                        shard_i: int, items: List[tuple]
+                        ) -> Optional[List[tuple]]:
+    """LAUNCH the coalesced pure-knn batch for one segment: prepare
+    each body exactly like the direct general path (same k_pad, same
+    spec/params via canon_query — structurally identical bodies share
+    one compiled program), enqueue every per-query invocation of the
+    DIRECT-path executor unfetched, and defer the device sync to one
+    fetch sweep (compiler.launch_segment_batch — deliberately not a
+    vmapped mega-program; see its docstring for the byte-parity
+    rationale). Returns [(shard_i, s, ctx, seg, seg_ord, [bi...],
+    fetch_fn)] or None to decline the whole segment (BP-reordered
+    tie-aware segments need the direct path's widen loop)."""
+    from ..search import fusion as _fusion
+
+    tief = getattr(seg, "tie_ranks", None)
+    if tief is not None and tief() is not None:
+        return None
+    prepared: List[tuple] = []
+    bis: List[int] = []
+    for bi, lroot, sort_specs, window in items:
+        k_pad = min(next_pow2(max(window, 16)), seg.ndocs_pad)
+        params: Dict[str, Any] = {}
+        try:
+            qspec = C.prepare(lroot, seg, ctx, params)
+            sspec = C.prepare_sort(sort_specs, seg, params)
+        except dsl.QueryParseError:
+            return None
+        full, cparams = C.canon_query(qspec, sspec, k_pad, params)
+        prepared.append((full, cparams))
+        bis.append(bi)
+    fetch_fn = C.launch_segment_batch(prepared, seg.device_arrays(s.device))
+    _fusion.STATS.inc("knn_batch_launches")
+    _fusion.STATS.inc("knn_batched", len(prepared))
+    return [(shard_i, s, ctx, seg, seg_ord, bis, fetch_fn)]
 
 
 def _finish_search(searchers: List[ShardSearcher],

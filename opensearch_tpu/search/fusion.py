@@ -1,0 +1,304 @@
+"""Hybrid retrieval fusion: N independent sub-query retrievals fused at
+the coordinator merge (reference neural-search plugin normalization
+processor + HybridQueryBuilder; Anserini-HNSW dense+lexical hybrid
+serving, arxiv 2304.12139).
+
+Design contract (docs/HYBRID.md):
+
+- A `hybrid` query runs each sub-query as a COMPLETE independent
+  retrieval (its own per-shard query phase, its own serving ladder —
+  fastpath / impactpath / knn / mesh decline — its own fetch) with a
+  fixed rank-window `window_size`. Fusion is then a PURE function of the
+  N ranked sub-pages, so the fused page is byte-identical on every
+  serving arm that serves byte-identical sub-pages: single-node vs
+  `cluster/distnode.py` distributed, scheduler on/off, replica failover.
+- Hit identity across sub-pages is `(_index, _id)` — topology-invariant,
+  unlike internal doc coordinates.
+- **RRF** (`method: rrf`): score(d) = Σ_i w_i / (rank_constant +
+  rank_i(d)), rank 1-based, absent lists contribute 0. Rank-domain,
+  score-domain-free by construction.
+- **Linear** (`method: linear`): per-list scores pass through a
+  NORMALIZER first — `min_max` ((s-min)/(max-min); a degenerate
+  constant list maps to 1.0 for present docs) or `l2` (s/‖s‖₂) — then
+  fused = Σ_i w_i · norm_i(d). Raw sub-query scores live in
+  incomparable similarity domains (BM25 sums vs cosine vs sparse dot);
+  combining them unnormalized is an oslint error (OSL604).
+- Deterministic total order: fused score desc, then the best
+  (sub-query index, rank) coordinate a doc holds, then `(_index, _id)`.
+  Commutative over shard/node arrival order because it never looks at
+  arrival order.
+- Pagination: `from + size` must fit inside `window_size` (400
+  otherwise). The fused list over fixed-depth windows is one
+  deterministic list — page 2 continues exactly where page 1 stopped.
+- Totals are an honest lower bound: the union size of N sub-result sets
+  is unknowable from their top windows, so `hits.total` reports the max
+  sub-total with relation `gte` (unless there is a single sub-query).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs import flight_recorder as _fr
+from ..utils.metrics import METRICS, CounterGroup
+from ..utils.trace import TRACER
+from . import query_dsl as dsl
+
+STATS = CounterGroup(METRICS, "hybridpath", {
+    "searches": 0, "sub_queries": 0, "rrf_fused": 0, "linear_fused": 0,
+    "knn_batched": 0, "knn_batch_launches": 0, "knn_batch_declined": 0})
+
+
+def stats() -> dict:
+    return dict(STATS)
+
+
+# body keys a hybrid search cannot carry: they either change per-shard
+# collection semantics in ways the N independent sub-retrievals cannot
+# honor coherently, or they re-rank outside the fusion contract
+_FORBIDDEN_BODY_KEYS = ("sort", "aggs", "aggregations", "collapse",
+                        "suggest", "rescore", "search_after", "min_score",
+                        "knn", "terminate_after", "scroll", "pit")
+
+# body keys that ride ALONG to every sub-search so the winning hits come
+# back fully hydrated (the fused page reuses the sub-pages' hit dicts)
+_PASSTHROUGH_KEYS = ("_source", "stored_fields", "docvalue_fields",
+                     "fields", "script_fields", "highlight", "explain",
+                     "derived", "track_scores", "track_total_hits",
+                     "timeout", "allow_partial_search_results", "profile",
+                     "preference")
+
+
+def is_hybrid_body(body) -> bool:
+    """Cheap top-level screen — True iff the body's query is `hybrid`."""
+    if not isinstance(body, dict):
+        return False
+    q = body.get("query")
+    return isinstance(q, dict) and "hybrid" in q
+
+
+def parse_hybrid(body: dict) -> Optional[dsl.HybridQuery]:
+    """-> the validated HybridQuery of a hybrid body, or None. Raises
+    QueryParseError (HTTP 400) on malformed hybrid bodies."""
+    if not is_hybrid_body(body):
+        return None
+    q = dsl.parse_query(body.get("query"))
+    if not isinstance(q, dsl.HybridQuery):
+        return None
+    for k in _FORBIDDEN_BODY_KEYS:
+        if body.get(k):
+            raise dsl.QueryParseError(
+                f"[hybrid] does not support [{k}] — each sub-query is an "
+                f"independent retrieval; fused pages re-rank at the "
+                f"coordinator only")
+    frm = int(body.get("from", 0))
+    size = int(body.get("size", 10))
+    window = int(q.fusion["window_size"])
+    if frm + size > window:
+        raise dsl.QueryParseError(
+            f"[hybrid] from + size ({frm + size}) exceeds the fusion "
+            f"window_size ({window}); raise fusion.window_size — pages "
+            f"fuse over a FIXED rank window so pagination stays stable")
+    return q
+
+
+def sub_bodies(body: dict, q: dsl.HybridQuery) -> List[dict]:
+    """The N independent sub-search bodies: each sub-query retrieves its
+    own fixed `window_size`-deep page with the parent's hydration
+    options."""
+    window = int(q.fusion["window_size"])
+    out = []
+    for sub in q.queries:
+        sb = {"query": sub, "from": 0, "size": window}
+        for k in _PASSTHROUGH_KEYS:
+            if k in body:
+                sb[k] = body[k]
+        out.append(sb)
+    return out
+
+
+# ---------------------------------------------------------------------
+# fusion algebra (pure host functions — the oracle tests mirror these)
+# ---------------------------------------------------------------------
+
+def minmax_normalize(scores: List[float]) -> List[float]:
+    """(s - min)/(max - min) per list; a constant list (max == min) maps
+    every present doc to 1.0 — presence in the window is the only signal
+    the list carries (reference MinMaxScoreNormalizationTechnique)."""
+    if not scores:
+        return []
+    lo, hi = min(scores), max(scores)
+    if hi <= lo:
+        return [1.0] * len(scores)
+    rng = hi - lo
+    return [(s - lo) / rng for s in scores]
+
+
+def l2_normalize(scores: List[float]) -> List[float]:
+    """s / ||s||_2 per list (reference L2ScoreNormalizationTechnique);
+    an all-zero list stays zero."""
+    nrm = sum(s * s for s in scores) ** 0.5
+    if nrm <= 0.0:
+        return [0.0] * len(scores)
+    return [s / nrm for s in scores]
+
+
+def normalize_scores(scores: List[float], how: str) -> List[float]:
+    """THE designated score-domain normalizer (oslint OSL604): every
+    linear combination of sub-query scores passes through here."""
+    if how == "l2":
+        return l2_normalize(scores)
+    if how == "min_max":
+        return minmax_normalize(scores)
+    raise ValueError(f"unknown normalization [{how}]")
+
+
+def fuse_ranked_lists(lists: List[List[Tuple[Any, float]]],
+                      fusion: Dict[str, Any]) -> List[Tuple[Any, float]]:
+    """Fuse N ranked `(key, score)` lists -> one ranked `(key, fused)`
+    list under the spec's method. Deterministic total order: fused desc,
+    best (list index, rank) asc, key asc. Commutative in shard/node
+    arrival order because nothing here ever sees arrival order."""
+    method = fusion["method"]
+    weights = fusion["weights"]
+    fused: Dict[Any, float] = {}
+    best_coord: Dict[Any, Tuple[int, int]] = {}
+    for li, lst in enumerate(lists):
+        w = float(weights[li])
+        if method == "rrf":
+            k = float(fusion["rank_constant"])
+            contribs = [w / (k + rank) for rank in range(1, len(lst) + 1)]
+        else:
+            norms = normalize_scores([s for _, s in lst],
+                                     fusion["normalization"])
+            contribs = [w * n for n in norms]
+        for rank0, ((key, _s), c) in enumerate(zip(lst, contribs)):
+            fused[key] = fused.get(key, 0.0) + c
+            coord = (li, rank0)
+            if key not in best_coord or coord < best_coord[key]:
+                best_coord[key] = coord
+    order = sorted(fused,
+                   key=lambda key: (-fused[key], best_coord[key], key))
+    return [(key, fused[key]) for key in order]
+
+
+def _hit_key(hit: dict) -> Tuple[str, str]:
+    return (str(hit.get("_index", "")), str(hit.get("_id", "")))
+
+
+# ---------------------------------------------------------------------
+# coordinator-side hybrid execution
+# ---------------------------------------------------------------------
+
+def run_hybrid(body: dict, run_sub: Callable[[dict], dict],
+               q: Optional[dsl.HybridQuery] = None) -> dict:
+    """Execute one hybrid search: run every sub-body through `run_sub`
+    (single-node `search_shards` or the distnode scatter — whatever arm
+    owns this request), fuse the ranked sub-pages, and assemble the
+    fused response. The fused page's hit documents are reused from the
+    first sub-page (by sub-query order) that retrieved each winner, with
+    `_score` replaced by the fused score."""
+    if q is None:
+        q = parse_hybrid(body)
+    assert q is not None
+    t0 = time.monotonic()
+    fusion = q.fusion
+    frm = int(body.get("from", 0))
+    size = int(body.get("size", 10))
+    STATS.inc("searches")
+    STATS.inc("sub_queries", len(q.queries))
+    STATS.inc("rrf_fused" if fusion["method"] == "rrf" else "linear_fused")
+
+    sub_resps: List[dict] = []
+    with TRACER.span("hybrid.sub_queries", n=len(q.queries)), \
+            METRICS.timer("hybrid.sub_queries"):
+        for i, sb in enumerate(sub_bodies(body, q)):
+            with TRACER.span("hybrid.sub", i=i):
+                sub_resps.append(run_sub(sb))
+
+    lists = []
+    by_key: Dict[Tuple[str, str], dict] = {}
+    for resp in sub_resps:
+        hits = resp.get("hits", {}).get("hits", [])
+        lst = []
+        for h in hits:
+            key = _hit_key(h)
+            sc = h.get("_score")
+            lst.append((key, float(sc) if sc is not None else 0.0))
+            if key not in by_key:
+                by_key[key] = h
+        lists.append(lst)
+    with TRACER.span("hybrid.fuse"), METRICS.timer("hybrid.fuse"):
+        fused = fuse_ranked_lists(lists, fusion)
+    if _fr.RECORDER.enabled and _fr.current():
+        _fr.RECORDER.record(_fr.current(), "hybrid.fuse",
+                            method=fusion["method"], subs=len(lists),
+                            candidates=len(fused))
+
+    selected = fused[frm: frm + size]
+    page = []
+    for key, score in selected:
+        h = dict(by_key[key])
+        h["_score"] = round(float(score), 7)
+        page.append(h)
+
+    # honest union bound: the true |set-union| of N sub-result sets is
+    # unknowable from their top windows
+    totals = [r.get("hits", {}).get("total", {}) for r in sub_resps]
+    tvals = [int(t.get("value", 0)) for t in totals if isinstance(t, dict)]
+    total = max(tvals) if tvals else 0
+    if len(sub_resps) == 1:
+        rel = totals[0].get("relation", "eq") if totals else "eq"
+    else:
+        rel = "gte" if total else "eq"
+    if any(isinstance(t, dict) and t.get("relation") == "gte"
+           for t in totals):
+        rel = "gte" if total else rel
+
+    # shard bookkeeping: every sub-query scattered over the same shard
+    # set; report that set once with the worst failure story any sub saw
+    shards = dict(sub_resps[0].get("_shards",
+                                   {"total": 0, "successful": 0,
+                                    "skipped": 0, "failed": 0}))
+    for r in sub_resps[1:]:
+        s = r.get("_shards", {})
+        if int(s.get("failed", 0)) > int(shards.get("failed", 0)):
+            shards = dict(s)
+    took_ms = (time.monotonic() - t0) * 1000.0
+    METRICS.histogram("hybrid.total").record(took_ms)
+    resp = {
+        "took": int(took_ms),
+        "timed_out": any(r.get("timed_out") for r in sub_resps),
+        "_shards": shards,
+        "hits": {"total": {"value": total, "relation": rel},
+                 "max_score": (round(float(fused[0][1]), 7) if fused
+                               else None),
+                 "hits": page},
+    }
+    if any(r.get("terminated_early") for r in sub_resps):
+        resp["terminated_early"] = True
+    if body.get("profile"):
+        # per-sub-query attribution: which retrieval family produced
+        # which candidates at what cost (each sub resp carries its own
+        # profile/cost block — the query-cost bytes of the whole hybrid
+        # request are the sum of its sub-query accumulators)
+        resp["profile"] = {
+            "hybrid": {
+                "fusion": {k: fusion[k] for k in
+                           ("method", "rank_constant", "weights",
+                            "normalization", "window_size")},
+                "sub_queries": [
+                    {"query": q.queries[i],
+                     "took": r.get("took"),
+                     "total": r.get("hits", {}).get("total"),
+                     "max_score": r.get("hits", {}).get("max_score"),
+                     "candidates": len(lists[i]),
+                     "profile": r.get("profile")}
+                    for i, r in enumerate(sub_resps)],
+            }}
+    if body.get("explain") == "device_plan":
+        plans = [r.get("device_plan") for r in sub_resps]
+        if any(p is not None for p in plans):
+            resp["device_plan"] = {"hybrid": plans}
+    return resp

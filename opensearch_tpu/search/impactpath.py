@@ -123,14 +123,32 @@ def block_skip_rate() -> float:
 
 class ImpactSpec:
     """A search the impact path can serve: the pure BM25 term-group
-    top-k shape (single unfiltered group, _score sort, no aggs)."""
+    top-k shape (kind "bm25") or the pure learned-sparse dot-product
+    top-k shape over a feature-impact field (kind "sparse") — single
+    unfiltered group, _score sort, no aggs."""
 
-    __slots__ = ("lt", "window", "prune_ok")
+    __slots__ = ("lt", "window", "prune_ok", "kind")
 
-    def __init__(self, lt, window: int, prune_ok: bool):
+    def __init__(self, lt, window: int, prune_ok: bool,
+                 kind: str = "bm25"):
         self.lt = lt
         self.window = window
         self.prune_ok = prune_ok
+        self.kind = kind
+
+
+def _ok_sparse(lroot) -> bool:
+    """LSparseDot usable as the sparse impact-ladder root: a plain
+    `neural_sparse` dot product (non-negative token weights — the plan's
+    witness/remainder bounds assume monotone contributions)."""
+    from . import compiler as C
+
+    if not isinstance(lroot, C.LSparseDot):
+        return False
+    if not len(lroot.tokens):
+        return False
+    w = np.asarray(lroot.weights, np.float32)
+    return bool(np.all(w >= 0)) and float(lroot.boost) >= 0.0
 
 
 def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
@@ -138,17 +156,22 @@ def make_spec(lroot, sort_specs: List[dict], agg_nodes, named_nodes,
               ) -> Optional[ImpactSpec]:
     if not enabled():
         return None
-    if not _ok_group(lroot):
-        return None
     if not _body_eligible(sort_specs, agg_nodes, named_nodes, search_after,
                           window, body):
         return None
-    # pruning changes total-hit semantics (lower bound, "gte") and
-    # relaxed-msm counting is unsound — explicit total tracking or
-    # msm > 1 ride the unpruned impact pass
-    prune_ok = ("track_total_hits" not in body
-                and int(lroot.msm) <= 1)
-    return ImpactSpec(lroot, int(window), prune_ok)
+    if _ok_group(lroot):
+        # pruning changes total-hit semantics (lower bound, "gte") and
+        # relaxed-msm counting is unsound — explicit total tracking or
+        # msm > 1 ride the unpruned impact pass
+        prune_ok = ("track_total_hits" not in body
+                    and int(lroot.msm) <= 1)
+        return ImpactSpec(lroot, int(window), prune_ok)
+    if _ok_sparse(lroot):
+        # learned-sparse: any-token match (msm == 1 semantics), so only
+        # explicit total tracking blocks the prune
+        return ImpactSpec(lroot, int(window),
+                          "track_total_hits" not in body, kind="sparse")
+    return None
 
 
 # pruned-remainder budget as a fraction of θ̂: the per-term cut keeps
@@ -444,10 +467,13 @@ def _plan_blocks(pb, plane, rows: np.ndarray, weights: np.ndarray,
 
 def _exact_scores(seg: Segment, field: str, rows: np.ndarray,
                   weights: np.ndarray, k1: float, b_eff: float,
-                  avgdl: float, cand: np.ndarray):
-    """Exact f32 BM25 of `cand` against the FULL rows — term-ordered
+                  avgdl: float, cand: np.ndarray, dot: bool = False):
+    """Exact f32 scores of `cand` against the FULL rows — term-ordered
     accumulation mirroring the fastpath host oracle (`_exact_rescore`)
-    bit for bit, which is the domain served pages live in."""
+    bit for bit, which is the domain served pages live in. `dot=True` is
+    the learned-sparse domain: contribution w_t · weight(t, d) (the CSR
+    "tf" slot of a feature field IS the stored weight) instead of the
+    BM25 saturation."""
     pb = seg.postings.get(field)
     dl = seg.doc_lens.get(field)
     dl_c = (dl[cand].astype(np.float32) if dl is not None
@@ -467,19 +493,25 @@ def _exact_scores(seg: Segment, field: str, rows: np.ndarray,
         pos_c = np.minimum(pos, b - a - 1)
         found = rowdocs[pos_c] == cand
         tf = np.where(found, pb.tfs[a + pos_c], 0.0).astype(np.float32)
-        exact += np.where(found, np.float32(weights[i]) * tf / (tf + kfac),
-                          0.0).astype(np.float32)
+        contrib = (np.float32(weights[i]) * tf if dot
+                   else np.float32(weights[i]) * tf / (tf + kfac))
+        exact += np.where(found, contrib, 0.0).astype(np.float32)
         counts += found
     return exact, counts
 
 
 def _error_bound(plane, weights: np.ndarray, rows: np.ndarray,
-                 k1q: float, bq: float, avgdlq: float) -> float:
+                 k1q: float, bq: float, avgdlq: float,
+                 drift: Optional[float] = None) -> float:
     """Sound |exact − approx| per-doc bound: per-term quantization
     half-step + build→query param drift, plus f32 accumulation slack on
-    both sums (≤ T adds each against the max representable score)."""
+    both sums (≤ T adds each against the max representable score).
+    Feature planes pass drift=0.0 explicitly — their weights are
+    query-independent, so drift_bound (a BM25 construct) never applies
+    (ImpactPlane.kind, OSL507)."""
     quant = plane.quant_err()
-    drift = plane.drift_bound(k1q, bq, avgdlq)
+    if drift is None:
+        drift = plane.drift_bound(k1q, bq, avgdlq)
     wsum = float(np.abs(weights[rows >= 0]).sum())
     e = wsum * (quant + drift)
     t = int((rows >= 0).sum())
@@ -519,23 +551,55 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
     from . import compiler as C
 
     plane = pb.impact
+    is_sparse = spec.kind == "sparse"
+    # plane/spec kind agreement (OSL507 version-discipline sibling): a
+    # BM25 group must read a BM25 plane, a learned-sparse dot a FEATURE
+    # plane — the dequant domain is baked into the quantized values
+    if (plane.kind if plane.kind else "bm25") != (
+            "feature" if is_sparse else "bm25"):
+        return None
     window = max(int(spec.window or k), 1)
     ndocs_pad = seg.ndocs_pad
     Ccand = min(next_pow2(max(2 * window, CAND_FLOOR)), ndocs_pad)
-    nt = len(lt.terms)
-    rows = np.full(nt, -1, np.int64)
-    for i, t in enumerate(lt.terms):
-        rows[i] = pb.row(t)
-    weights = np.asarray(lt.weights, np.float32)[:nt]
+    if is_sparse:
+        # learned-sparse dot: rows are feature vocab entries. The PLAN
+        # (τ/θ̂/rem pricing) works in the boost-folded domain
+        # (w·boost), but the SERVED exact scores mirror the generic
+        # sparse_dot XLA program's ordering — term-ordered Σ w·weight,
+        # THEN one multiply by boost — so certified and escalated
+        # segments of one query serve the same score domain. The ≤ ~T-
+        # ULP gap between Σ(w·boost)·tf and (Σ w·tf)·boost is inside
+        # the certificate's f32 accumulation slack (_error_bound).
+        tokens = list(lt.tokens)
+        nt = len(tokens)
+        rows = np.full(nt, -1, np.int64)
+        for i, t in enumerate(tokens):
+            rows[i] = pb.row(t)
+        exact_weights = np.asarray(lt.weights, np.float32)[:nt]
+        exact_scale = np.float32(lt.boost)
+        weights = exact_weights * exact_scale
+        k1q, b_eff, avgdlq = 0.0, 0.0, 1.0
+        msm = 1.0
+        drift = 0.0
+    else:
+        nt = len(lt.terms)
+        rows = np.full(nt, -1, np.int64)
+        for i, t in enumerate(lt.terms):
+            rows[i] = pb.row(t)
+        weights = np.asarray(lt.weights, np.float32)[:nt]
+        sim = lt.sim
+        k1q = float(sim.k1)
+        b_eff = float(sim.b) if lt.has_norms else 0.0
+        avgdlq = float(ctx.avgdl(lt.field))
+        msm = float(lt.msm)
+        drift = None
+        exact_weights = weights
+        exact_scale = np.float32(1.0)
     if np.any(weights < 0):
         return None              # negative boosts void the prune bounds
-    sim = lt.sim
-    b_eff = float(sim.b) if lt.has_norms else 0.0
-    avgdlq = float(ctx.avgdl(lt.field))
-    msm = float(lt.msm)
 
-    eps_imp = plane.quant_err() + plane.drift_bound(float(sim.k1), b_eff,
-                                                    avgdlq)
+    eps_imp = plane.quant_err() + (
+        0.0 if is_sparse else plane.drift_bound(k1q, b_eff, avgdlq))
     offs, lens, bw, kept_post, rem, nblocks, total_post = _plan_blocks(
         pb, plane, rows, weights, Ccand, spec.prune_ok, window, eps_imp,
         ndocs=seg.ndocs)
@@ -597,8 +661,11 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
                 "total_rel": "eq"}
 
     cand = idx[:nvalid].astype(np.int64)
-    exact, counts = _exact_scores(seg, lt.field, rows, weights,
-                                  float(sim.k1), b_eff, avgdlq, cand)
+    exact, counts = _exact_scores(seg, lt.field, rows, exact_weights,
+                                  k1q, b_eff, avgdlq, cand,
+                                  dot=is_sparse)
+    if exact_scale != np.float32(1.0):
+        exact = (exact * exact_scale).astype(np.float32)
     pass_msm = counts >= msm
     exact_m = np.where(pass_msm, exact, -np.inf).astype(np.float32)
     n_pass = int(pass_msm.sum())
@@ -608,7 +675,8 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
     order = np.lexsort((cand if tr is None else tr[cand], -exact_m))
     theta = (float(exact_m[order[window - 1]]) if n_pass >= window
              else -np.inf)
-    E = _error_bound(plane, weights, rows, float(sim.k1), b_eff, avgdlq)
+    E = _error_bound(plane, weights, rows, k1q, b_eff, avgdlq,
+                     drift=drift)
 
     # displacement bound for every non-candidate doc: seen-but-lost docs
     # (only exist when the kernel window filled) carry approx ≤ the C-th
@@ -644,9 +712,12 @@ def segment_search(seg: Segment, ctx, spec: ImpactSpec, k: int
             union = np.unique(np.concatenate(ids)).astype(np.int64)
             if len(union) and seg.live_count != seg.ndocs:
                 union = union[seg.live[union]]
-            exact2, counts2 = _exact_scores(seg, lt.field, rows, weights,
-                                            float(sim.k1), b_eff, avgdlq,
-                                            union)
+            exact2, counts2 = _exact_scores(seg, lt.field, rows,
+                                            exact_weights, k1q, b_eff,
+                                            avgdlq, union,
+                                            dot=is_sparse)
+            if exact_scale != np.float32(1.0):
+                exact2 = (exact2 * exact_scale).astype(np.float32)
             pass2 = counts2 >= msm
             exact2_m = np.where(pass2, exact2, -np.inf).astype(np.float32)
             n2 = int(pass2.sum())

@@ -473,6 +473,22 @@ class NeuralSparseQuery(Query):
 
 
 @dataclass
+class HybridQuery(Query):
+    """Top-level hybrid retrieval (reference neural-search plugin
+    HybridQueryBuilder): N independent sub-queries — lexical,
+    `neural_sparse`, `knn` — each executed as its own per-shard retrieval
+    in its own score domain, fused at the coordinator merge
+    (search/fusion.py) with RRF or normalized linear combination.
+    Sub-queries stay RAW dicts: each one is re-parsed and served through
+    the full serving ladder exactly as if it were the only query."""
+
+    queries: List[dict] = dc_field(default_factory=list)
+    # validated fusion parameters (method, rank_constant, weights,
+    # normalization, window_size) — see fusion.FusionSpec
+    fusion: Dict[str, Any] = dc_field(default_factory=dict)
+
+
+@dataclass
 class PercolateQuery(Query):
     """Match stored percolator queries against candidate document(s)
     (reference modules/percolator PercolateQueryBuilder)."""
@@ -1018,6 +1034,28 @@ def parse_query(dsl: Optional[dict]) -> Query:
         _common(q, spec)
         return q
 
+    if kind == "hybrid":
+        subs = body.get("queries")
+        if not isinstance(subs, list) or not subs:
+            raise QueryParseError("[hybrid] requires a non-empty [queries] "
+                                  "list")
+        if len(subs) > MAX_HYBRID_SUB_QUERIES:
+            raise QueryParseError(
+                f"[hybrid] supports at most {MAX_HYBRID_SUB_QUERIES} "
+                f"sub-queries, got {len(subs)}")
+        for sub in subs:
+            if not isinstance(sub, dict):
+                raise QueryParseError("[hybrid] sub-queries must be query "
+                                      "objects")
+            inner = parse_query(sub)   # surface malformed subs as 400s now
+            if isinstance(inner, HybridQuery):
+                raise QueryParseError("[hybrid] queries cannot nest")
+        q = HybridQuery(queries=[dict(s) for s in subs],
+                        fusion=parse_fusion_spec(body.get("fusion"),
+                                                 len(subs)))
+        _common(q, body)
+        return q
+
     if kind == "percolate":
         docs = body.get("documents")
         if docs is None and body.get("document") is not None:
@@ -1032,6 +1070,66 @@ def parse_query(dsl: Optional[dict]) -> Query:
         return q
 
     raise QueryParseError(f"unknown query [{kind}]")
+
+
+# reference neural-search HybridQueryBuilder caps sub-queries at 5
+MAX_HYBRID_SUB_QUERIES = 5
+
+_FUSION_METHODS = ("rrf", "linear")
+_FUSION_NORMS = ("min_max", "l2")
+# fused pages must be stable under pagination: the fused list is computed
+# over fixed-depth per-sub-query rank windows, so `from`/`size` page INTO
+# one deterministic list instead of re-fusing a different window per page
+DEFAULT_FUSION_WINDOW = 100
+
+
+def parse_fusion_spec(spec, n_sub: int) -> Dict[str, Any]:
+    """Validate the [hybrid] fusion parameters -> canonical dict.
+
+    - method: "rrf" (default) | "linear"
+    - rank_constant: RRF k (default 60, >= 1)
+    - weights: per-sub-query weights (default all 1.0, non-negative)
+    - normalization: "min_max" (default) | "l2" — linear only; RRF fuses
+      in the rank domain, which is score-domain-free by construction
+    - window_size: per-sub-query rank-list depth the fusion sees
+      (default 100); `from + size` beyond it is a 400, never a silent
+      re-fusion at a different depth
+    """
+    spec = dict(spec or {})
+    method = str(spec.get("method", "rrf")).lower()
+    if method not in _FUSION_METHODS:
+        raise QueryParseError(
+            f"[hybrid] unknown fusion method [{method}] "
+            f"(supported: {', '.join(_FUSION_METHODS)})")
+    norm = str(spec.get("normalization", "min_max")).lower()
+    if norm not in _FUSION_NORMS:
+        # raw sub-query scores live in incomparable similarity domains
+        # (BM25 vs cosine vs learned-sparse dot); a linear combination
+        # without a normalizer is meaningless — refuse it (OSL604)
+        raise QueryParseError(
+            f"[hybrid] unknown normalization [{norm}] "
+            f"(supported: {', '.join(_FUSION_NORMS)})")
+    try:
+        rank_constant = float(spec.get("rank_constant", 60))
+        window = int(spec.get("window_size", DEFAULT_FUSION_WINDOW))
+        weights = [float(w) for w in spec.get("weights",
+                                              [1.0] * n_sub)]
+    except (TypeError, ValueError) as e:
+        raise QueryParseError(f"[hybrid] malformed fusion spec: {e}")
+    if rank_constant < 1:
+        raise QueryParseError("[hybrid] rank_constant must be >= 1")
+    if window < 1:
+        raise QueryParseError("[hybrid] window_size must be >= 1")
+    if len(weights) != n_sub:
+        raise QueryParseError(
+            f"[hybrid] weights length [{len(weights)}] must match the "
+            f"sub-query count [{n_sub}]")
+    if any(w < 0 or w != w for w in weights):
+        raise QueryParseError("[hybrid] weights must be finite and "
+                              "non-negative")
+    return {"method": method, "rank_constant": rank_constant,
+            "weights": weights, "normalization": norm,
+            "window_size": window}
 
 
 def parse_script_spec(spec) -> Tuple[str, dict]:

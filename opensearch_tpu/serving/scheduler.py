@@ -88,8 +88,12 @@ LANES = ("interactive", "batch")
 # body keys MeshSearchService._eligible statically declines — queueing
 # these shapes would add latency for a guaranteed host-loop outcome, so
 # they bypass the scheduler unchanged (the decline still happens at the
-# same place it does today, with the same attribution)
-_BYPASS_KEYS = ("knn", "rescore", "min_score", "profile", "collapse",
+# same place it does today, with the same attribution).
+# `knn` is NOT in this list (ISSUE 15): pure-knn bodies are first-class
+# scheduler citizens — they enqueue, ride the lanes/admission/429 path
+# (so the remediator can shed vector floods), and coalesce through the
+# vmapped batched-knn program (executor._launch_knn_segment)
+_BYPASS_KEYS = ("rescore", "min_score", "profile", "collapse",
                 "suggest", "search_after", "highlight", "script_fields",
                 # budgeted bodies need the deadline-AWARE executor: only
                 # the host shard loop stops between segment programs

@@ -43,7 +43,11 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _HIGHER_BETTER = ("qps", "skip_rate", "invocation_reduction",
                   "mean_batch", "qps_ratio", "overhead", "recall",
                   "green_ok", "released_ok", "shed_fraction",
-                  "byte_stable")
+                  "byte_stable",
+                  # hybrid bench (ISSUE 15): bytes_ratio is
+                  # exact-arm-over-impact-arm — bigger = more gather
+                  # volume saved; `_ok` carries the 0/1 gate booleans
+                  "bytes_ratio", "_ok")
 _LOWER_BETTER = ("p50", "p95", "p99", "ms", "bytes", "escalated",
                  "escalations", "wall_s", "time_to_green_s",
                  "time_to_detect_s")
@@ -173,6 +177,30 @@ def metrics_of(doc: dict) -> dict:
         for k in ("lat_ms_p50", "lat_ms_p95"):
             if _num(ld.get(k)) is not None:
                 out[f"traffic.{tag}.{k}"] = ld[k]
+    # hybrid/vector bench (ISSUE 15, `extra.hybrid`): fused-mix
+    # qps/latency, the learned-sparse impact-vs-sparse_dot A/B, and the
+    # acceptance gates as 0/1 booleans (a True->False flip reads as a
+    # 100% regression under --gate)
+    hyb = extra.get("hybrid") or {}
+    for k in ("fused_qps", "lat_ms_p50", "lat_ms_p99"):
+        if _num(hyb.get(k)) is not None:
+            out[f"hybrid.{k}"] = hyb[k]
+    if _num(hyb.get("bytes_ratio_dot_over_impact")) is not None:
+        out["hybrid.sparse.bytes_ratio"] = \
+            hyb["bytes_ratio_dot_over_impact"]
+    for arm in ("sparse_impact", "sparse_dot_baseline"):
+        a = hyb.get(arm) or {}
+        for k in ("qps", "p99_ms", "mean_bytes_per_query",
+                  "block_skip_rate"):
+            if _num(a.get(k)) is not None:
+                out[f"hybrid.{arm}.{k}"] = a[k]
+    gsuffix = {"block_skip_gt_0p3": "block_skip_ok",
+               "bytes_per_query_2x_down": "bytes_2x_ok",
+               "equal_top10": "equal_top10_ok"}
+    for k, suf in gsuffix.items():
+        v = (hyb.get("gates") or {}).get(k)
+        if isinstance(v, bool):
+            out[f"hybrid.gate.{suf}"] = 1.0 if v else 0.0
     reorder = (extra.get("reorder") or {}).get("arms") or {}
     for arm, mixes in reorder.items():
         if not isinstance(mixes, dict):

@@ -102,6 +102,23 @@ def _w(rng, n=1):
                                                         size=n))
 
 
+# fixed pools for the vector/hybrid shapes: zipf popularity only means
+# anything when popular bodies RECUR byte-identically, so queries draw
+# from small deterministic pools instead of fresh random floats
+QVECS = [[round(((i * 7 + j * 3) % 17) / 17.0, 4) for j in range(8)]
+         for i in range(6)]
+QTOKS = [{f"f{(i * 5 + k) % 40}": round(3.0 / (k + 1), 2)
+          for k in range(5)} for i in range(6)]
+
+
+def _qvec(rng):
+    return QVECS[int(rng.integers(0, len(QVECS)))]
+
+
+def _qtok(rng):
+    return QTOKS[int(rng.integers(0, len(QTOKS)))]
+
+
 SHAPES = {
     # interactive mix (zipf-ranked in this order)
     "match1": lambda rng: {"query": {"match": {"body": _w(rng)}},
@@ -119,6 +136,19 @@ SHAPES = {
         "lte": int(rng.integers(5, 9)) * 100}}}, "size": 10},
     "phrase": lambda rng: {"query": {"match_phrase": {"body": _w(rng, 2)}},
                            "size": 10},
+    # vector + hybrid retrieval (ISSUE 15): the learned-sparse and
+    # dense families ride the same admission/SLO/insight machinery —
+    # and the insights fingerprints name them, so a vector flood is
+    # sheddable by shape like everything else
+    "neural_sparse": lambda rng: {"query": {"neural_sparse": {"emb": {
+        "query_tokens": _qtok(rng)}}}, "size": 10},
+    "knn": lambda rng: {"query": {"knn": {"vec": {
+        "vector": _qvec(rng), "k": 10}}}, "size": 10},
+    "hybrid": lambda rng: {"query": {"hybrid": {
+        "queries": [{"match": {"body": _w(rng)}},
+                    {"knn": {"vec": {"vector": _qvec(rng), "k": 10}}}],
+        "fusion": {"method": "rrf", "rank_constant": 20,
+                   "window_size": 20}}}, "size": 10},
     # batch mix
     "aggs": lambda rng: {"query": {"match": {"body": _w(rng)}},
                          "size": 0,
@@ -130,8 +160,8 @@ SHAPES = {
         {"match": {"body": WORDS[i]}} for i in range(6)]}}, "size": 20},
 }
 INTERACTIVE_SHAPES = ["match1", "bool_filter", "match3", "title",
-                      "range", "phrase"]
-BATCH_SHAPES = ["aggs", "match3"]
+                      "range", "phrase", "knn", "hybrid"]
+BATCH_SHAPES = ["aggs", "match3", "neural_sparse"]
 ZIPF_S = 1.1
 
 
@@ -163,13 +193,21 @@ def build_fleet(n_nodes=3, ndocs=6000, n_shards=6):
                      "number_of_node_replicas": 1},
         "mappings": {"properties": {
             "body": {"type": "text"}, "title": {"type": "text"},
-            "tag": {"type": "keyword"}, "num": {"type": "integer"}}}})
+            "tag": {"type": "keyword"}, "num": {"type": "integer"},
+            "emb": {"type": "rank_features", "index_impacts": True},
+            "vec": {"type": "dense_vector", "dims": 8,
+                    "similarity": "cosine"}}}})
     for i in range(ndocs):
         a.index_doc("tidx", {
             "body": _w(rng, int(rng.integers(5, 12))),
             "title": _w(rng),
             "tag": TAGS[int(rng.integers(0, 4))],
-            "num": int(rng.integers(0, 1000))}, id=str(i))
+            "num": int(rng.integers(0, 1000)),
+            "emb": {f"f{int(rng.integers(0, 40))}":
+                    round(float(rng.random()) + 0.05, 3)
+                    for _ in range(4)},
+            "vec": [round(float(rng.random()), 4)
+                    for _ in range(8)]}, id=str(i))
     a.refresh("tidx")
     # the sessioned-user index lives on the coordinator's local node
     # (scroll/PIT are stateful contexts the distributed tier declines)
@@ -412,6 +450,16 @@ def calibrate(coord, n=24):
     for name in sorted(SHAPES):
         for _ in range(3):
             coord.search("tidx", SHAPES[name](rng))
+    # vector-family shapes draw from fixed pools whose members can land
+    # in DIFFERENT pow2 program buckets (df-dependent gather widths):
+    # walk every pool entry so no armed-scenario request pays — or
+    # races — a jit compile under full concurrency
+    for v in QVECS:
+        coord.search("tidx", {"query": {"knn": {"vec": {
+            "vector": v, "k": 10}}}, "size": 10})
+    for t in QTOKS:
+        coord.search("tidx", {"query": {"neural_sparse": {"emb": {
+            "query_tokens": t}}}, "size": 10})
     c = coord.client
     r = c.search("tsess", {"query": {"match": {"body": _w(rng)}},
                            "size": 5}, scroll="30s")

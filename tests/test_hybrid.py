@@ -1,0 +1,616 @@
+"""Hybrid retrieval engine (ISSUE 15): fusion algebra vs a brute-force
+host oracle, distributed-merge commutativity (fused pages identical on
+every serving arm), pagination stability, the learned-sparse impact
+plane (parity vs the exact sparse_dot path + hostile-margin forced
+escalation), and the first-class batched-knn serving route."""
+
+import json
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.cluster.node import Node
+from opensearch_tpu.index.segment import CODEC_V2
+from opensearch_tpu.obs.insights import fingerprint
+from opensearch_tpu.rest.client import ApiError, RestClient
+from opensearch_tpu.search import fusion, impactpath
+from opensearch_tpu.search import query_dsl as dsl
+from opensearch_tpu.search.executor import msearch_batched, search_shards
+from opensearch_tpu.serving import SchedulerConfig, ServingScheduler
+
+MAPPING = {"mappings": {"properties": {
+    "body": {"type": "text"},
+    "emb": {"type": "rank_features", "index_impacts": True},
+    "vec": {"type": "dense_vector", "dims": 8, "similarity": "cosine"},
+    "cat": {"type": "keyword"}}}}
+
+VOCAB = [f"w{i}" for i in range(30)]
+FEATS = [f"t{i}" for i in range(25)]
+
+
+def _mk_docs(n=300, seed=7):
+    rng = random.Random(seed)
+    docs = {}
+    for i in range(n):
+        toks = rng.sample(VOCAB, rng.randint(2, 6))
+        feats = {f: round(rng.expovariate(1.0) + 0.05, 3)
+                 for f in rng.sample(FEATS, rng.randint(2, 5))}
+        docs[str(i)] = {
+            "body": " ".join(toks),
+            "emb": feats,
+            "vec": [rng.random() for _ in range(8)],
+            "cat": "odd" if i % 2 else "even"}
+    return docs
+
+
+def _client(docs, shards=1):
+    c = RestClient(node=Node())
+    body = dict(MAPPING)
+    if shards > 1:
+        body = {**MAPPING,
+                "settings": {"index": {"number_of_shards": shards}}}
+    c.indices.create("hx", body)
+    for did, d in docs.items():
+        c.index("hx", d, id=did)
+    c.indices.refresh("hx")
+    return c
+
+
+def _hits(resp):
+    return [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]]
+
+
+def _page_bytes(resp):
+    """The byte-comparable identity of a served page."""
+    return json.dumps({"hits": _hits(resp),
+                       "total": resp["hits"]["total"],
+                       "max": resp["hits"]["max_score"]},
+                      sort_keys=True)
+
+
+SUBS = [
+    {"match": {"body": "w1 w2 w3"}},
+    {"neural_sparse": {"emb": {"query_tokens": {"t1": 2.0, "t2": 1.0,
+                                                "t7": 0.4}}}},
+    {"knn": {"vec": {"vector": [0.9, 0.1, 0.5, 0.3, 0.7, 0.2, 0.6, 0.4],
+                     "k": 20}}},
+]
+
+
+def _hybrid_body(method="rrf", size=10, frm=0, window=50, weights=None,
+                 norm=None, subs=None):
+    f = {"method": method, "rank_constant": 20, "window_size": window}
+    if weights is not None:
+        f["weights"] = weights
+    if norm is not None:
+        f["normalization"] = norm
+    return {"query": {"hybrid": {"queries": list(subs or SUBS),
+                                 "fusion": f}},
+            "from": frm, "size": size}
+
+
+# ----------------------------------------------------------------------
+# fusion algebra vs the brute-force oracle
+# ----------------------------------------------------------------------
+
+class TestFusionAlgebra:
+    def test_minmax_normalize(self):
+        assert fusion.minmax_normalize([4.0, 2.0, 3.0]) == [1.0, 0.0, 0.5]
+        # degenerate constant list: presence is the only signal
+        assert fusion.minmax_normalize([2.0, 2.0]) == [1.0, 1.0]
+        assert fusion.minmax_normalize([]) == []
+
+    def test_l2_normalize(self):
+        out = fusion.l2_normalize([3.0, 4.0])
+        assert out == pytest.approx([0.6, 0.8])
+        assert fusion.l2_normalize([0.0, 0.0]) == [0.0, 0.0]
+
+    def test_rrf_matches_hand_oracle(self):
+        lists = [[("a", 9.0), ("b", 5.0), ("c", 1.0)],
+                 [("b", 0.9), ("d", 0.7)]]
+        spec = {"method": "rrf", "rank_constant": 10.0,
+                "weights": [1.0, 2.0], "normalization": "min_max"}
+        got = fusion.fuse_ranked_lists(lists, spec)
+        want = {"a": 1 / 11, "b": 1 / 12 + 2 / 11, "c": 1 / 13,
+                "d": 2 / 12}
+        assert {k: pytest.approx(v) for k, v in dict(got).items()} == want
+        assert [k for k, _ in got] == sorted(
+            want, key=lambda k: -want[k])
+
+    def test_linear_matches_hand_oracle(self):
+        lists = [[("a", 10.0), ("b", 6.0), ("c", 2.0)],
+                 [("c", 0.8), ("a", 0.4)]]
+        spec = {"method": "linear", "rank_constant": 60.0,
+                "weights": [1.0, 1.0], "normalization": "min_max"}
+        got = dict(fusion.fuse_ranked_lists(lists, spec))
+        assert got["a"] == pytest.approx(1.0 + 0.0)
+        assert got["b"] == pytest.approx(0.5)
+        assert got["c"] == pytest.approx(0.0 + 1.0)
+
+    def test_tie_break_is_deterministic_and_arrival_free(self):
+        # two docs with identical fused scores break on the best
+        # (sub-query index, rank) coordinate, then the key
+        lists = [[("b", 5.0), ("x", 4.0)], [("a", 5.0), ("y", 4.0)]]
+        spec = {"method": "rrf", "rank_constant": 60.0,
+                "weights": [1.0, 1.0], "normalization": "min_max"}
+        got = [k for k, _ in fusion.fuse_ranked_lists(lists, spec)]
+        # b and a tie by score; b holds (0, 0) < a's (1, 0)
+        assert got == ["b", "a", "x", "y"]
+
+    def test_fusion_is_commutative_over_key_insertion_order(self):
+        rng = random.Random(3)
+        lists = [[(f"d{rng.randrange(40)}", rng.random() * 10)
+                  for _ in range(20)] for _ in range(3)]
+        # dedupe keys within a list, keep first occurrence (rank order)
+        lists = [list(dict(lst).items()) for lst in lists]
+        spec = {"method": "linear", "rank_constant": 60.0,
+                "weights": [1.0, 0.5, 2.0], "normalization": "l2"}
+        a = fusion.fuse_ranked_lists(lists, spec)
+        b = fusion.fuse_ranked_lists(list(lists), spec)
+        assert a == b
+
+
+# ----------------------------------------------------------------------
+# end-to-end single node: oracle parity + pagination + validation
+# ----------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def docs():
+    return _mk_docs()
+
+
+@pytest.fixture(scope="module")
+def client(docs):
+    return _client(docs)
+
+
+class TestHybridSearch:
+    def _oracle_page(self, c, method, norm="min_max", weights=None,
+                     window=50, frm=0, size=10):
+        """Brute-force oracle: run each sub-query alone at the fusion
+        window, fuse with an independent implementation, page."""
+        w = weights or [1.0] * len(SUBS)
+        lists = []
+        for sub in SUBS:
+            r = c.search("hx", {"query": sub, "size": window})
+            lists.append([(h["_id"], h["_score"])
+                          for h in r["hits"]["hits"]])
+        fused = {}
+        coord = {}
+        for li, lst in enumerate(lists):
+            if method == "rrf":
+                contribs = [w[li] / (20.0 + r) for r in
+                            range(1, len(lst) + 1)]
+            else:
+                scores = [s for _, s in lst]
+                if norm == "l2":
+                    nrm = sum(s * s for s in scores) ** 0.5 or 1.0
+                    ns = [s / nrm for s in scores]
+                else:
+                    lo, hi = (min(scores), max(scores)) if scores \
+                        else (0, 0)
+                    ns = [1.0] * len(scores) if hi <= lo else \
+                        [(s - lo) / (hi - lo) for s in scores]
+                contribs = [w[li] * n for n in ns]
+            for r0, ((k, _), cb) in enumerate(zip(lst, contribs)):
+                fused[k] = fused.get(k, 0.0) + cb
+                coord.setdefault(k, (li, r0))
+                if (li, r0) < coord[k]:
+                    coord[k] = (li, r0)
+        order = sorted(fused, key=lambda k: (-fused[k], coord[k],
+                                             ("hx", k)))
+        return [(k, round(fused[k], 7))
+                for k in order[frm: frm + size]]
+
+    @pytest.mark.parametrize("method,norm", [("rrf", "min_max"),
+                                             ("linear", "min_max"),
+                                             ("linear", "l2")])
+    def test_engine_matches_oracle(self, client, method, norm):
+        r = client.search("hx", _hybrid_body(method=method, norm=norm))
+        assert _hits(r) == self._oracle_page(client, method, norm)
+
+    def test_weights_shift_the_page(self, client):
+        r = client.search("hx", _hybrid_body(
+            method="linear", weights=[0.0, 0.0, 5.0]))
+        knn_only = client.search(
+            "hx", {"query": SUBS[2], "size": 10})
+        assert [h for h, _ in _hits(r)] == [h for h, _ in
+                                            _hits(knn_only)]
+
+    def test_pagination_is_stable(self, client):
+        whole = client.search("hx", _hybrid_body(size=12))
+        p1 = client.search("hx", _hybrid_body(size=6))
+        p2 = client.search("hx", _hybrid_body(size=6, frm=6))
+        assert _hits(p1) + _hits(p2) == _hits(whole)
+
+    def test_from_size_beyond_window_is_400(self, client):
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", _hybrid_body(size=10, frm=45,
+                                             window=50))
+
+    def test_validation_400s(self, client):
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", {"query": {"hybrid": {
+                "queries": SUBS, "fusion": {"method": "magic"}}}})
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", {"query": {"hybrid": {
+                "queries": SUBS, "fusion": {"weights": [1.0]}}}})
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", {"query": {"hybrid": {"queries": []}}})
+        # nested hybrid is structural 400
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", {"query": {"hybrid": {"queries": [
+                {"hybrid": {"queries": [SUBS[0]]}}]}}})
+        # aggs/sort cannot ride a hybrid body
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", {**_hybrid_body(),
+                                 "aggs": {"c": {"terms": {
+                                     "field": "cat"}}}})
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", {**_hybrid_body(),
+                                 "sort": [{"cat": "asc"}]})
+        # hybrid nested inside bool is a structural 400 too
+        with pytest.raises((ApiError, dsl.QueryParseError)):
+            client.search("hx", {"query": {"bool": {"must": [
+                {"hybrid": {"queries": [SUBS[0]]}}]}}})
+
+    def test_total_is_honest_union_bound(self, client, docs):
+        r = client.search("hx", _hybrid_body())
+        subs_totals = [client.search("hx", {"query": s, "size": 0})
+                       ["hits"]["total"]["value"] for s in SUBS]
+        assert r["hits"]["total"]["value"] == max(subs_totals)
+        assert r["hits"]["total"]["relation"] == "gte"
+
+    def test_profile_carries_sub_query_attribution(self, client):
+        r = client.search("hx", {**_hybrid_body(), "profile": True})
+        hp = r["profile"]["hybrid"]
+        assert hp["fusion"]["method"] == "rrf"
+        assert len(hp["sub_queries"]) == len(SUBS)
+        for sq in hp["sub_queries"]:
+            assert sq["candidates"] > 0
+            assert sq["total"]["value"] > 0
+
+    def test_hybridpath_stats_move(self, client):
+        before = fusion.stats()["searches"]
+        client.search("hx", _hybrid_body(size=3, window=20))
+        assert fusion.stats()["searches"] == before + 1
+
+    def test_single_sub_query_passthrough_ranks(self, client):
+        r = client.search("hx", _hybrid_body(subs=[SUBS[0]], size=5))
+        alone = client.search("hx", {"query": SUBS[0], "size": 5})
+        assert [h for h, _ in _hits(r)] == [h for h, _ in _hits(alone)]
+        # single sub: totals keep the sub's exact relation
+        assert r["hits"]["total"] == alone["hits"]["total"]
+
+
+# ----------------------------------------------------------------------
+# distributed merge commutativity + serving-arm byte-parity
+# ----------------------------------------------------------------------
+
+class TestDistributedParity:
+    def test_fused_page_identical_on_every_arm(self, docs):
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        a = DistClusterNode("ha")
+        b = DistClusterNode("hb", seed=a.addr)
+        try:
+            a.create_index("hx", {**MAPPING, "settings": {
+                "index": {"number_of_shards": 2}}})
+            for did, d in docs.items():
+                a.index_doc("hx", d, id=did)
+            a.refresh("hx")
+            oracle = _client(docs, shards=2)
+            bodies = [_hybrid_body(),
+                      _hybrid_body(method="linear", norm="l2"),
+                      _hybrid_body(size=4, frm=3, window=30)]
+            for body in bodies:
+                pages = [a.search("hx", dict(body)),
+                         b.search("hx", dict(body)),
+                         oracle.search("hx", dict(body))]
+                # coordinator A == coordinator B == single node: the
+                # distributed merge is commutative over shard/node
+                # arrival order and the fusion is a pure function
+                assert (_page_bytes(pages[0]) == _page_bytes(pages[1])
+                        == _page_bytes(pages[2]))
+        finally:
+            b.stop()
+            a.stop()
+
+    def test_pure_knn_serves_distributed(self, docs):
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        a = DistClusterNode("ka")
+        try:
+            a.create_index("hx", MAPPING)
+            for did, d in docs.items():
+                a.index_doc("hx", d, id=did)
+            a.refresh("hx")
+            oracle = _client(docs)
+            body = {"query": SUBS[2], "size": 8}
+            assert _page_bytes(a.search("hx", dict(body))) \
+                == _page_bytes(oracle.search("hx", dict(body)))
+        finally:
+            a.stop()
+
+
+# ----------------------------------------------------------------------
+# scheduler arm: hybrid + knn coalesce and stay byte-identical
+# ----------------------------------------------------------------------
+
+class TestSchedulerParity:
+    def test_knn_is_no_longer_a_bypass_key(self, docs):
+        c = _client(docs)
+        node = c.node
+        sched = ServingScheduler(node, SchedulerConfig(
+            max_batch=8, max_wait_us=50_000))
+        assert sched.accepts({"query": SUBS[2], "size": 5})
+        assert sched.accepts({"knn": {"field": "vec",
+                                      "query_vector": [0.0] * 8,
+                                      "k": 5}})
+        assert sched.accepts(_hybrid_body())
+        sched.close()
+
+    def test_scheduler_on_off_pages_byte_identical(self, docs):
+        c = _client(docs)
+        node = c.node
+        rng = random.Random(11)
+        bodies = []
+        for i in range(12):
+            kind = i % 3
+            if kind == 0:
+                bodies.append(_hybrid_body(size=5, window=20))
+            elif kind == 1:
+                bodies.append({"query": {"knn": {"vec": {
+                    "vector": [rng.random() for _ in range(8)],
+                    "k": 8}}}, "size": 8})
+            else:
+                bodies.append({"query": SUBS[1], "size": 6})
+        off = [c.search("hx", dict(b)) for b in bodies]
+        node.request_cache._store.clear()
+        node.serving = ServingScheduler(node, SchedulerConfig(
+            max_batch=16, max_wait_us=200_000))
+        try:
+            on = [None] * len(bodies)
+
+            def run(i):
+                on[i] = c.search("hx", dict(bodies[i]))
+
+            ts = [threading.Thread(target=run, args=(i,))
+                  for i in range(len(bodies))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            for i in range(len(bodies)):
+                assert _page_bytes(on[i]) == _page_bytes(off[i]), i
+        finally:
+            node.serving.close()
+            node.serving = None
+
+    def test_batched_knn_route_serves_and_matches_direct(self, docs):
+        c = _client(docs)
+        searchers = c.node.indices["hx"].searchers
+        rng = random.Random(2)
+        bodies = [{"query": {"knn": {"vec": {
+            "vector": [rng.random() for _ in range(8)], "k": 6}}},
+            "size": 6} for _ in range(4)]
+        bodies.append({"knn": {"field": "vec",
+                               "query_vector": [rng.random()
+                                                for _ in range(8)],
+                               "k": 4}, "size": 4})
+        bodies.append({"query": {"knn": {"vec": {
+            "vector": [rng.random() for _ in range(8)], "k": 5,
+            "filter": {"term": {"cat": "odd"}}}}}, "size": 5})
+        before = fusion.stats()
+        rs = msearch_batched(searchers, bodies, "hx")
+        after = fusion.stats()
+        assert all(r is not None for r in rs)
+        assert after["knn_batched"] - before["knn_batched"] \
+            == len(bodies)
+        assert after["knn_batch_launches"] > before["knn_batch_launches"]
+        direct = [search_shards(searchers, dict(b), "hx")
+                  for b in bodies]
+        for got, want in zip(rs, direct):
+            assert _page_bytes(got) == _page_bytes(want)
+
+
+# ----------------------------------------------------------------------
+# learned-sparse on the impact ladder
+# ----------------------------------------------------------------------
+
+def _sparse_corpus(n=4000, seed=0, opt_in=True):
+    rng = random.Random(seed)
+    # mesh-less node: the impact ladder only engages on single-domain
+    # serving (search/impactpath.py _MESH_ATTACHED) — the conftest's
+    # virtual 8-device CPU mesh would otherwise stand it down
+    c = RestClient(node=Node(mesh_service=False))
+    mapping = {"settings": {"number_of_shards": 1,
+                            "number_of_replicas": 0},
+               "mappings": {"properties": {
+                   "emb": {"type": "rank_features",
+                           **({"index_impacts": True}
+                              if opt_in else {})}}}}
+    c.indices.create("sx", mapping)
+    docs = {}
+    for i in range(n):
+        toks = {f"t{rng.randrange(30)}": round(rng.expovariate(1.0)
+                                               + 0.05, 3)
+                for _ in range(6)}
+        docs[str(i)] = toks
+        c.index("sx", {"emb": toks}, id=str(i))
+    c.indices.refresh("sx")
+    return c, docs
+
+
+QTOKENS = {"t1": 3.0, "t2": 1.5, "t7": 0.3, "t9": 0.15, "t11": 0.1}
+
+
+def _sparse_body(size=10):
+    return {"query": {"neural_sparse": {"emb": {
+        "query_tokens": dict(QTOKENS)}}}, "size": size}
+
+
+class TestSparseImpactLadder:
+    def test_opt_in_builds_feature_plane(self):
+        c, _ = _sparse_corpus(n=300)
+        seg = c.node.indices["sx"].shards[0].segments[0]
+        plane = seg.postings["emb"].impact
+        assert plane is not None and plane.kind == "feature"
+        assert seg.codec_version == CODEC_V2
+
+    def test_no_opt_in_no_plane(self):
+        c, _ = _sparse_corpus(n=200, opt_in=False)
+        seg = c.node.indices["sx"].shards[0].segments[0]
+        assert seg.postings["emb"].impact is None
+
+    def test_ladder_serves_with_block_skip(self):
+        c, _ = _sparse_corpus()
+        before = dict(impactpath.STATS)
+        r = c.search("sx", _sparse_body())
+        after = dict(impactpath.STATS)
+        assert after["served"] == before["served"] + 1
+        assert after["blocks_skipped"] > before["blocks_skipped"]
+        assert len(r["hits"]["hits"]) == 10
+
+    def test_parity_vs_exact_sparse_dot(self, monkeypatch):
+        c, docs = _sparse_corpus(seed=5)
+        got = c.search("sx", _sparse_body())
+        # the exact arm: impact ladder disabled -> generic sparse_dot
+        # XLA program (fresh node so no request cache aliasing)
+        monkeypatch.setenv("OPENSEARCH_TPU_NO_IMPACT", "1")
+        c2 = RestClient(node=Node(mesh_service=False))
+        c2.indices.create("sx", {"mappings": {"properties": {
+            "emb": {"type": "rank_features", "index_impacts": True}}}})
+        for did, d in docs.items():
+            c2.index("sx", {"emb": d}, id=did)
+        c2.indices.refresh("sx")
+        want = c2.search("sx", _sparse_body())
+        assert [h for h, _ in _hits(got)] == [h for h, _ in _hits(want)]
+        for (_, a), (_, b) in zip(_hits(got), _hits(want)):
+            assert a == pytest.approx(b, rel=1e-5)
+
+    def test_parity_vs_host_oracle(self):
+        c, docs = _sparse_corpus(seed=9)
+        r = c.search("sx", _sparse_body())
+        scores = {}
+        for did, toks in docs.items():
+            s = np.float32(0.0)
+            hitn = 0
+            for t in sorted(QTOKENS):
+                if t in toks:
+                    s = np.float32(s + np.float32(
+                        np.float32(QTOKENS[t]) * np.float32(toks[t])))
+                    hitn += 1
+            if hitn:
+                scores[did] = float(s)
+        want = sorted(scores.items(),
+                      key=lambda kv: (-kv[1], int(kv[0])))[:10]
+        assert [h for h, _ in _hits(r)] == [d for d, _ in want]
+        for (_, a), (_, b) in zip(_hits(r), want):
+            assert a == pytest.approx(b, abs=1e-5)
+
+    def test_hostile_margin_forces_escalation_and_stays_exact(
+            self, monkeypatch):
+        monkeypatch.setattr(impactpath, "PRUNE_MARGIN", 1e9)
+        monkeypatch.setattr(impactpath, "KEEP_MIN", 32)
+        monkeypatch.setattr(impactpath, "KEEP_FACTOR", 1)
+        c, docs = _sparse_corpus(seed=13)
+        before = dict(impactpath.STATS)
+        r = c.search("sx", _sparse_body())
+        after = dict(impactpath.STATS)
+        # the hostile margin prunes past certification: the ladder must
+        # escalate (phase-2 or dense) — never serve an uncertified page
+        assert (after["escalated"] > before["escalated"]
+                or after["phase2_served"] > before["phase2_served"])
+        scores = {}
+        for did, toks in docs.items():
+            s = np.float32(0.0)
+            for t in sorted(QTOKENS):
+                if t in toks:
+                    s = np.float32(s + np.float32(
+                        np.float32(QTOKENS[t]) * np.float32(toks[t])))
+            if s > 0:
+                scores[did] = float(s)
+        want = [d for d, _ in sorted(
+            scores.items(), key=lambda kv: (-kv[1], int(kv[0])))[:10]]
+        assert [h for h, _ in _hits(r)] == want
+
+    def test_boosted_sparse_serves_the_generic_score_domain(self):
+        # one score domain per query: the certified ladder must serve
+        # (Σ w·tf) · boost — the generic sparse_dot ordering — so
+        # certified and escalated segments never mix domains
+        c, docs = _sparse_corpus(seed=31)
+        before = dict(impactpath.STATS)
+        r = c.search("sx", {"query": {"neural_sparse": {"emb": {
+            "query_tokens": dict(QTOKENS), "boost": 2.0}}}, "size": 10})
+        assert impactpath.STATS["served"] == before["served"] + 1
+        scores = {}
+        for did, toks in docs.items():
+            s = np.float32(0.0)
+            for t in sorted(QTOKENS):
+                if t in toks:
+                    s = np.float32(s + np.float32(
+                        np.float32(QTOKENS[t]) * np.float32(toks[t])))
+            if s > 0:
+                scores[did] = float(np.float32(s * np.float32(2.0)))
+        want = sorted(scores.items(),
+                      key=lambda kv: (-kv[1], int(kv[0])))[:10]
+        assert _hits(r) == [(d, pytest.approx(sc, abs=1e-6))
+                            for d, sc in want]
+
+    def test_track_total_hits_rides_unpruned(self):
+        c, _ = _sparse_corpus(seed=3)
+        before = dict(impactpath.STATS)
+        r = c.search("sx", {**_sparse_body(),
+                            "track_total_hits": True})
+        after = dict(impactpath.STATS)
+        assert after["pruned_served"] == before["pruned_served"]
+        assert r["hits"]["total"]["relation"] == "eq"
+
+    def test_merge_preserves_feature_plane(self):
+        c, _ = _sparse_corpus(n=600, seed=21)
+        # force a second segment then merge
+        rng = random.Random(99)
+        for i in range(600, 900):
+            c.index("sx", {"emb": {f"t{rng.randrange(30)}": 1.0}},
+                    id=str(i))
+        c.indices.refresh("sx")
+        svc = c.node.indices["sx"]
+        assert len(svc.shards[0].segments) == 2
+        svc.force_merge(1)
+        seg = svc.shards[0].segments[0]
+        assert seg.postings["emb"].impact is not None
+        assert seg.postings["emb"].impact.kind == "feature"
+
+    def test_bool_embedded_neural_sparse_still_serves(self):
+        # non-pure shapes decline the ladder and run the generic
+        # sparse_dot program — which lazily promotes the f32 weights
+        c, _ = _sparse_corpus(n=500, seed=4)
+        r = c.search("sx", {"query": {"bool": {
+            "must": [{"neural_sparse": {"emb": {
+                "query_tokens": {"t1": 1.0}}}},
+                {"neural_sparse": {"emb": {
+                    "query_tokens": {"t2": 0.5}}}}]}}, "size": 5})
+        assert len(r["hits"]["hits"]) == 5
+
+
+# ----------------------------------------------------------------------
+# insights: vector/hybrid workload identity
+# ----------------------------------------------------------------------
+
+class TestInsightsFeatures:
+    def test_hybrid_fingerprint_carries_sub_query_features(self):
+        k, shape, feats = fingerprint(_hybrid_body())
+        assert feats["hybrid"] and feats["sub_queries"] == 3
+        assert "knn" in feats["sub_kinds"]
+        assert feats["knn"] is True
+        assert shape.startswith("hybrid([")
+
+    def test_distinct_sub_families_are_distinct_shapes(self):
+        a = fingerprint(_hybrid_body(subs=[SUBS[0], SUBS[2]]))[0]
+        b = fingerprint(_hybrid_body(subs=[SUBS[0], SUBS[1]]))[0]
+        assert a != b
+
+    def test_query_knn_counts_as_vector_workload(self):
+        _, _, feats = fingerprint({"query": SUBS[2], "size": 5})
+        assert feats["knn"] is True and not feats["hybrid"]

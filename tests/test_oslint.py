@@ -1223,6 +1223,91 @@ class TestActuatorDiscipline:
 
 
 # ----------------------------------------------------------------------
+# OSL604 fusion score-domain discipline (hybrid retrieval)
+# ----------------------------------------------------------------------
+
+class TestFusionDomain:
+    """OSL604 — linear combinations of sub-query scores pass through a
+    normalizer or fuse in the rank domain (docs/HYBRID.md)."""
+
+    def test_osl604_raw_linear_combination(self):
+        src = """
+            def fuse_pages(bm25_scores, knn_scores, w1, w2):
+                out = []
+                for i in range(len(bm25_scores)):
+                    out.append(w1 * bm25_scores[i] + w2 * knn_scores[i])
+                return out
+        """
+        found = lint(src, "opensearch_tpu/search/fusion.py")
+        assert [f for f in found
+                if f.detail == "unnormalized-linear-fusion"]
+
+    def test_osl604_augassign_accumulation(self):
+        src = """
+            def combine(lists):
+                fused = {}
+                for sub_scores in lists:
+                    for key, sc in sub_scores:
+                        total_score = fused.get(key, 0.0)
+                        total_score += sc
+                        fused[key] = total_score
+                return fused
+        """
+        found = lint(src, "opensearch_tpu/serving/merge.py")
+        assert [f for f in found
+                if f.detail == "unnormalized-linear-fusion"]
+
+    def test_osl604_quiet_with_normalizer(self):
+        src = """
+            def fuse_pages(lists, weights):
+                fused = {}
+                for w, lst in zip(weights, lists):
+                    norms = normalize_scores([s for _, s in lst], "l2")
+                    for (key, _), n in zip(lst, norms):
+                        fused[key] = fused.get(key, 0.0) + w * n
+                return fused
+        """
+        assert rules_of(lint(src, "opensearch_tpu/search/fusion.py")) \
+            == []
+
+    def test_osl604_quiet_in_rank_domain(self):
+        src = """
+            def fuse_rrf(lists, fusion):
+                k = fusion["rank_constant"]
+                fused = {}
+                for lst in lists:
+                    for rank, (key, _score) in enumerate(lst, start=1):
+                        fused[key] = fused.get(key, 0.0) + 1.0 / (k + rank)
+                return fused
+        """
+        assert rules_of(lint(src, "opensearch_tpu/search/fusion.py")) \
+            == []
+
+    def test_osl604_non_fusion_functions_quiet(self):
+        # additive score math OUTSIDE fusion-shaped functions is the
+        # engine's bread and butter (BM25 sums) — never flagged
+        src = """
+            def accumulate(scores, extra_scores):
+                return scores + extra_scores
+        """
+        assert rules_of(lint(src, "opensearch_tpu/search/scoring.py")) \
+            == []
+
+    def test_osl604_out_of_scope_quiet(self):
+        src = """
+            def fuse(a_scores, b_scores):
+                return a_scores + b_scores
+        """
+        assert rules_of(lint(src, "opensearch_tpu/obs/mod.py")) == []
+
+    def test_osl604_repo_clean(self):
+        # the ratchet at zero: search/fusion.py's linear combiner runs
+        # through normalize_scores, RRF fuses in the rank domain
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f.render() for f in findings if f.rule == "OSL604"] == []
+
+
+# ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 
