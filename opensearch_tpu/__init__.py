@@ -16,6 +16,15 @@ A from-scratch rebuild of the capabilities of OpenSearch (reference:
   per-device top-k followed by an `all_gather` merge over ICI.
 """
 
+import os as _os
+
+if _os.environ.get("OPENSEARCH_TPU_LOCKWITNESS") == "1":
+    # arm BEFORE any submodule import constructs a lock: the witness
+    # wraps locks at creation, so it must patch the threading factories
+    # first (see devtools/lockwitness.py and docs/STATIC_ANALYSIS.md)
+    from .devtools import lockwitness as _lockwitness
+    _lockwitness.install()
+
 from .version import __version__
 
 __all__ = ["__version__", "Node", "RestClient"]

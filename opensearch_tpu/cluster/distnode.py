@@ -434,9 +434,14 @@ class DistClusterNode:
             # failure-detector probe target (cluster/failure.py)
             return 200, {"ok": True, "node": self.name}
         if op == "join" and method == "POST":
+            # record the member under the lock, but fan the publish out
+            # AFTER releasing it: _publish RPCs every member, and holding
+            # the state lock across those sends serialized every other
+            # join/search-route against the slowest member (OSL702)
             with self._lock:
                 self.members[body["name"]] = body["addr"]
-                self._publish()
+            self._publish()
+            with self._lock:
                 return 200, {"state": self._state()}
         if op == "publish" and method == "POST":
             self._apply_state(body["state"])
@@ -716,22 +721,28 @@ class DistClusterNode:
         if self.leader != self.name:
             return _http(self.members[self.leader], "POST",
                          f"/_internal/create_index/{name}", body)
+        # mutate routing state under the lock, then fan the member PUTs
+        # and the publish out AFTER releasing it: a slow/dead member
+        # otherwise blocks every search-route and join for the full HTTP
+        # timeout while we hold the state lock (OSL702). The snapshots
+        # taken under the lock keep the returned routing/copies coherent
+        # even if a concurrent create lands between release and return.
         with self._lock:
             self.client.indices.create(name, body)
             n_shards = self.node.indices[name].meta.num_shards
-            self.copies[name] = assign_copies(
+            copies = assign_copies(
                 n_shards, self.members, 1 + self._node_replicas(body))
-            self.routing[name] = {s: c[0]
-                                  for s, c in self.copies[name].items()}
+            routing = {s: c[0] for s, c in copies.items()}
+            self.copies[name] = copies
+            self.routing[name] = routing
             self.index_bodies[name] = body
-            for mname, addr in self.members.items():
-                if mname == self.name:
-                    continue
-                _http(addr, "PUT", f"/{name}", body)
-            self._publish()
+            targets = [(m, a) for m, a in self.members.items()
+                       if m != self.name]
+        for _mname, addr in targets:
+            _http(addr, "PUT", f"/{name}", body)
+        self._publish()
         return {"acknowledged": True, "index": name,
-                "routing": self.routing[name],
-                "copies": self.copies[name]}
+                "routing": routing, "copies": copies}
 
     def index_doc(self, index: str, doc: dict, id: str,
                   refresh: bool = False) -> dict:

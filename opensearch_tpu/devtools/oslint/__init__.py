@@ -39,6 +39,16 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   through a designated normalizer (fusion.normalize_scores) or fuse in
   the rank domain (RRF) — raw BM25/cosine/sparse-dot scores are
   incomparable (docs/HYBRID.md).
+- OSL701-OSL704 whole-program concurrency suite (`concurrency/`):
+  unlike every rule above, these run INTERPROCEDURALLY over the full
+  package — a lock inventory with alias resolution, a call-graph walk
+  of lock regions, and fixpoint may-acquire/may-block summaries.
+  OSL701 lock-order cycles (potential deadlock) + non-reentrant
+  re-acquire; OSL702 locks held across blocking ops (RPC sends, device
+  syncs, sleeps, foreign waits); OSL703 cross-thread unlocked attribute
+  writes; OSL704 check-then-act atomicity splits. The derived
+  lock-order graph is committed as `lock_order.json` (ratcheted by
+  tier-1) and validated at runtime by devtools/lockwitness.py.
 
 Run via `python scripts/oslint.py [--check]`; tier-1 runs it through
 tests/test_oslint.py. Suppress inline with
@@ -48,6 +58,9 @@ the checked-in `oslint_baseline.json`.
 
 from .actuator_rules import ActuatorDisciplineChecker
 from .breaker_rules import BreakerDisciplineChecker
+from .concurrency import (CONCURRENCY_RULES, build_lock_order,
+                          build_program, diff_lock_order,
+                          run_program_scope)
 from .core import (Baseline, Checker, Finding, default_checkers,
                    load_baseline, run_paths, run_source, write_baseline)
 from .dtype_rules import DtypeDisciplineChecker
@@ -68,4 +81,6 @@ __all__ = [
     "DeviceSyncDisciplineChecker", "MemoryAccountingChecker",
     "ImpactDomainChecker", "InsightsCardinalityChecker",
     "ActuatorDisciplineChecker",
+    "CONCURRENCY_RULES", "build_lock_order", "build_program",
+    "diff_lock_order", "run_program_scope",
 ]

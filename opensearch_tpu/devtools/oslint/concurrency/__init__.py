@@ -1,0 +1,48 @@
+"""oslint concurrency suite — whole-program lock analysis (ISSUE 16).
+
+Where the OSL4xx lock rules are per-function pattern checks, this
+package builds an interprocedural model of the entire package:
+
+- `program.Program` inventories every lock object (threading.Lock /
+  RLock / Condition / Semaphore, the `_BuildLock` hold-depth wrapper,
+  module-level and instance-attribute locks), resolving aliases through
+  attributes, constructor assignments, and local variables; walks each
+  function with a lexical held-lock stack; resolves a best-effort call
+  graph; and computes fixpoint may-acquire / may-block summaries.
+- `rules` turns the model into findings:
+    OSL701  lock-order cycle in the whole-program lock-order graph
+            (potential deadlock), and reentrant re-acquire of a
+            non-reentrant Lock (self-deadlock);
+    OSL702  lock held across a blocking operation — device syncs
+            (`jax.device_get` / `block_until_ready`), `/_internal` RPC
+            sends (via `urlopen` reachability), `time.sleep`, and
+            waits on foreign locks/events — the `_dispatch_lock`-class
+            bug, generalized across call boundaries;
+    OSL703  shared mutable attribute written without a lock from code
+            reachable from more than one thread-entry root (dispatcher /
+            completion / sampler / remediator / HTTP-handler threads);
+    OSL704  check-then-act atomicity split on dict/deque attribute
+            state in a lock-bearing class.
+- `rules.build_lock_order` emits the reviewable `lock_order.json`
+  artifact (nodes, acquired-while-held edges, cycles); tier-1 ratchets
+  it — a new edge or cycle fails until the artifact is regenerated and
+  any cycle justified.
+
+The committed graph is validated at runtime by the lock-witness
+sanitizer (`opensearch_tpu.devtools.lockwitness`), which records actual
+acquisition orders during the 32-thread hammer tests and flags
+inversions against this model. See docs/STATIC_ANALYSIS.md
+("Concurrency suite").
+"""
+
+from .program import Program, build_program
+from .rules import (CONCURRENCY_RULES, analyze, build_lock_order,
+                    diff_lock_order, load_lock_order, program_files,
+                    run_program, run_program_scope, write_lock_order)
+
+__all__ = [
+    "Program", "build_program", "analyze", "run_program",
+    "run_program_scope", "program_files", "build_lock_order",
+    "diff_lock_order", "load_lock_order", "write_lock_order",
+    "CONCURRENCY_RULES",
+]

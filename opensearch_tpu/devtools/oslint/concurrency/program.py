@@ -1,0 +1,1092 @@
+"""Whole-program concurrency model: lock inventory, alias resolution,
+call graph, and fixpoint held/blocking summaries.
+
+Two phases over the package's parsed modules:
+
+Phase A (declarations) — per module, record every lock declaration
+(`self.X = threading.Lock()` in a class body/method, `NAME = RLock()`
+at module level, `__dict__.setdefault("attr", _BuildLock())`), every
+constructor-typed instance attribute (`self.fd = MemberFailureDetector()`
+— the alias path for cross-object lock resolution), module-level
+singletons (`RECORDER = FlightRecorder()`), imports, class bases, and
+thread-handle attributes.
+
+Phase B (functions) — walk each function body with a lexical held-lock
+stack: `with lock:` regions (plus linear `.acquire()`/`.release()`
+pairs), call sites with the held-lock tuple, attribute writes with a
+locked flag, container tests/mutations for check-then-act analysis,
+direct blocking operations, and thread-entry registrations
+(`threading.Thread(target=...)`, listener/callback hookups).
+
+The model is a sound-enough over-approximation, not an exact points-to
+analysis: lock identity is class-attribute-level (two instances of one
+class share a graph node), unresolvable lock-ish names collapse into a
+shared `attr::<name>` node, and call resolution falls back to
+unique-name matching. False positives flow through the standard oslint
+triage workflow (inline suppression / baseline justification); the
+runtime lock witness (devtools/lockwitness.py) cross-checks the model
+against actual execution.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import dotted_name
+
+LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore"}
+# semaphores bound concurrency; holding one across blocking work is the
+# point, so OSL702 skips them
+SEMAPHORE_KINDS = {"Semaphore", "BoundedSemaphore"}
+NON_REENTRANT_KINDS = {"Lock"}
+LOCKISH_TOKENS = ("lock", "cond", "mutex", "sem")
+
+# container-mutating method names: a call `self.X.append(...)` is a
+# write to X for the cross-thread and check-then-act rules
+MUTATORS = {"append", "appendleft", "extend", "insert", "add", "discard",
+            "remove", "pop", "popleft", "popitem", "clear", "update",
+            "setdefault", "rotate", "move_to_end"}
+
+CALLBACK_REGISTRARS = {"add_listener", "add_alert_listener", "on_cancel"}
+
+
+def lockish(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in LOCKISH_TOKENS)
+
+
+def short_lock(lock_id: str) -> str:
+    """Compact stable form for messages/details:
+    'opensearch_tpu/serving/remediator.py::Remediator._lock' ->
+    'serving/remediator::Remediator._lock'."""
+    if lock_id.startswith("attr::"):
+        return lock_id
+    head, _, tail = lock_id.partition("::")
+    if head.startswith("opensearch_tpu/"):
+        head = head[len("opensearch_tpu/"):]
+    if head.endswith(".py"):
+        head = head[:-3]
+    return f"{head}::{tail}"
+
+
+@dataclass(frozen=True)
+class LockDecl:
+    lock_id: str
+    kind: str          # Lock/RLock/Condition/Semaphore/.../BuildLock/attr
+    path: str
+    line: int
+
+
+@dataclass
+class CallSite:
+    dotted: str
+    line: int
+    held: Tuple[str, ...]
+    region: Optional[int]
+
+
+@dataclass
+class BlockOp:
+    op: str                          # human label ("time.sleep", ...)
+    receiver: Optional[str]          # lock id of a .wait() receiver
+    line: int
+    held: Tuple[str, ...] = ()
+    chain: Tuple[str, ...] = ()      # call chain for propagated ops
+
+
+@dataclass
+class AttrWrite:
+    attr: str
+    line: int
+    locked: bool
+    container: bool                  # subscript/mutator (dict/deque op)
+
+
+@dataclass
+class AttrTouch:
+    attr: str
+    line: int
+    region: Optional[int]
+
+
+@dataclass
+class FuncInfo:
+    path: str
+    qual: str
+    cls: Optional[str]
+    line: int
+    assumed_held: bool = False       # `_locked`-suffix convention
+    calls: List[CallSite] = field(default_factory=list)
+    direct_acquires: List[Tuple[str, int]] = field(default_factory=list)
+    local_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    self_acquires: List[Tuple[str, int]] = field(default_factory=list)
+    writes: List[AttrWrite] = field(default_factory=list)
+    tests: List[AttrTouch] = field(default_factory=list)
+    mutations: List[AttrTouch] = field(default_factory=list)
+    blocks: List[BlockOp] = field(default_factory=list)
+    root_refs: List[Tuple[str, str, int]] = field(default_factory=list)
+    # ^ (kind, dotted-or-qual, line): thread targets / callback args
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.path, self.qual)
+
+    def is_init(self) -> bool:
+        return self.qual.endswith("__init__")
+
+
+def _module_name(path: str) -> str:
+    mod = path[:-3] if path.endswith(".py") else path
+    if mod.endswith("/__init__"):
+        mod = mod[:-len("/__init__")]
+    return mod.replace("/", ".")
+
+
+class Program:
+    """The assembled whole-program model; see module docstring."""
+
+    def __init__(self) -> None:
+        self.files: List[Tuple[str, ast.Module, str]] = []
+        # phase A
+        self.class_locks: Dict[Tuple[str, str], Dict[str, LockDecl]] = {}
+        self.module_locks: Dict[Tuple[str, str], LockDecl] = {}
+        self.attr_locks: Dict[str, List[LockDecl]] = {}
+        self.instance_attr_types: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.module_instance_types: Dict[Tuple[str, str], str] = {}
+        self.class_index: Dict[str, List[str]] = {}      # name -> [path]
+        self.class_bases: Dict[Tuple[str, str], List[str]] = {}
+        self.thread_attrs: Dict[Tuple[str, str], Set[str]] = {}
+        self.method_aliases: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self.imports: Dict[str, Dict[str, str]] = {}     # path -> name->dotted
+        self.mod_to_path: Dict[str, str] = {}
+        # phase B
+        self.functions: Dict[Tuple[str, str], FuncInfo] = {}
+        # derived (finalize)
+        self.callees: Dict[Tuple[str, str],
+                           List[Tuple[Tuple[str, str], CallSite]]] = {}
+        self.lock_kind: Dict[str, str] = {}
+        self.lock_decl: Dict[str, LockDecl] = {}
+        self.roots: Dict[Tuple[str, str], str] = {}      # key -> label
+        self.roots_reaching: Dict[Tuple[str, str], Set[str]] = {}
+        self.always_held: Set[Tuple[str, str]] = set()
+        self.may_acquire: Dict[Tuple[str, str],
+                               Dict[str, Tuple[str, ...]]] = {}
+        self.may_block: Dict[Tuple[str, str], Dict[str, BlockOp]] = {}
+        self.edges: Dict[Tuple[str, str],
+                         Tuple[str, str, int, Tuple[str, ...]]] = {}
+        # ^ (a,b) -> deterministic min (path, qual, line, via-chain)
+        self.self_edges: Dict[str, Tuple[str, str, int]] = {}
+        self.unresolved_withs: int = 0
+
+    # ---------------- phase A: declarations ----------------
+
+    def scan_declarations(self, path: str, tree: ast.Module) -> None:
+        self.mod_to_path[_module_name(path)] = path
+        imports = self.imports.setdefault(path, {})
+        modname = _module_name(path)
+        is_pkg = path.endswith("/__init__.py")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(modname, is_pkg,
+                                          node.level, node.module)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imports[a.asname or a.name] = (
+                        f"{base}.{a.name}" if base else a.name)
+        for stmt in tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                self._scan_class(path, stmt)
+            elif isinstance(stmt, ast.Assign):
+                self._scan_module_assign(path, stmt)
+        # `__dict__.setdefault("attr", _BuildLock())` — lazy per-instance
+        # lock slots (index/segment.py): inventoried by attribute name
+        for node in ast.walk(tree):
+            got = self._setdefault_lock(node)
+            if got is not None:
+                attr, kind = got
+                decl = LockDecl(f"attr::{attr}", kind, path, node.lineno)
+                if not any(d.lock_id == decl.lock_id
+                           for d in self.attr_locks.get(attr, [])):
+                    self.attr_locks.setdefault(attr, []).append(decl)
+
+    @staticmethod
+    def _resolve_from(modname: str, is_pkg: bool, level: int,
+                      module: Optional[str]) -> str:
+        if level == 0:
+            return module or ""
+        parts = modname.split(".")
+        if not is_pkg:
+            parts = parts[:-1]
+        if level > 1:
+            parts = parts[:len(parts) - (level - 1)]
+        return ".".join(parts + ([module] if module else []))
+
+    @staticmethod
+    def _lock_ctor_kind(call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        func = call.func
+        # `__import__("threading").RLock()` — the lazy module-singleton
+        # idiom (search/derived.py, search/fastpath.py)
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Call)
+                and dotted_name(func.value.func) == "__import__"
+                and func.value.args
+                and isinstance(func.value.args[0], ast.Constant)
+                and func.value.args[0].value == "threading"
+                and func.attr in LOCK_CTORS):
+            return func.attr
+        d = dotted_name(call.func)
+        if not d:
+            return None
+        head, _, tail = d.rpartition(".")
+        if tail in LOCK_CTORS and head in ("", "threading"):
+            return tail
+        if tail.endswith("BuildLock"):
+            return "BuildLock"
+        return None
+
+    @classmethod
+    def _setdefault_lock(cls, node: ast.AST) -> Optional[Tuple[str, str]]:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "setdefault"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            return None
+        kind = cls._lock_ctor_kind(node.args[1])
+        return (node.args[0].value, kind) if kind else None
+
+    def _record_class_attr(self, path: str, cname: str, attr: str,
+                           value: ast.AST, line: int) -> None:
+        kind = self._lock_ctor_kind(value)
+        if kind is not None:
+            decl = LockDecl(f"{path}::{cname}.{attr}", kind, path, line)
+            self.class_locks.setdefault((path, cname), {})[attr] = decl
+            self.attr_locks.setdefault(attr, []).append(decl)
+            return
+        if isinstance(value, ast.Call):
+            d = dotted_name(value.func)
+            tail = d.rsplit(".", 1)[-1] if d else ""
+            if tail == "Thread":
+                self.thread_attrs.setdefault((path, cname), set()).add(attr)
+            elif tail[:1].isupper():
+                self.instance_attr_types.setdefault(
+                    (path, cname), {})[attr] = d
+
+    def _scan_class(self, path: str, cdef: ast.ClassDef) -> None:
+        cname = cdef.name
+        self.class_index.setdefault(cname, []).append(path)
+        self.class_bases[(path, cname)] = [
+            dotted_name(b) for b in cdef.bases if dotted_name(b)]
+        for stmt in cdef.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        # `do_GET = do_POST = _dispatch` — class-body
+                        # method aliasing (http.server handler idiom)
+                        if isinstance(stmt.value, ast.Name):
+                            self.method_aliases.setdefault(
+                                (path, cname), {})[t.id] = stmt.value.id
+                        else:
+                            self._record_class_attr(path, cname, t.id,
+                                                    stmt.value,
+                                                    stmt.lineno)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for node in ast.walk(stmt):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            self._record_class_attr(path, cname, t.attr,
+                                                    node.value, node.lineno)
+
+    def _scan_module_assign(self, path: str, stmt: ast.Assign) -> None:
+        for t in stmt.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            kind = self._lock_ctor_kind(stmt.value)
+            if kind is not None:
+                decl = LockDecl(f"{path}::{t.id}", kind, path, stmt.lineno)
+                self.module_locks[(path, t.id)] = decl
+                self.attr_locks.setdefault(t.id, []).append(decl)
+            elif isinstance(stmt.value, ast.Call):
+                d = dotted_name(stmt.value.func)
+                tail = d.rsplit(".", 1)[-1] if d else ""
+                if tail[:1].isupper():
+                    self.module_instance_types[(path, t.id)] = d
+
+    # ---------------- name resolution helpers ----------------
+
+    def resolve_class(self, dotted: str, path: str
+                      ) -> Optional[Tuple[str, str]]:
+        """'MemberFailureDetector' / 'mod.Cls' -> (decl path, class)."""
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        tail = parts[-1]
+        imp = self.imports.get(path, {}).get(parts[0])
+        if imp is not None:
+            if len(parts) == 1:
+                # `from x.y import Cls` -> imp == "x.y.Cls"
+                mod, _, name = imp.rpartition(".")
+                mpath = self.mod_to_path.get(mod)
+                if mpath and name in self.class_index \
+                        and mpath in self.class_index[name]:
+                    return (mpath, name)
+            else:
+                # `import x.y as m` + "m.Cls"
+                mpath = self.mod_to_path.get(imp)
+                if mpath and tail in self.class_index \
+                        and mpath in self.class_index[tail]:
+                    return (mpath, tail)
+        paths = self.class_index.get(tail, [])
+        if path in paths:
+            return (path, tail)
+        if len(paths) == 1:
+            return (paths[0], tail)
+        return None
+
+    def iter_bases(self, path: str, cls: str, _depth: int = 0
+                   ) -> List[Tuple[str, str]]:
+        if _depth > 4:
+            return []
+        out: List[Tuple[str, str]] = []
+        for b in self.class_bases.get((path, cls), []):
+            key = self.resolve_class(b, path)
+            if key is not None:
+                out.append(key)
+                out.extend(self.iter_bases(key[0], key[1], _depth + 1))
+        return out
+
+    def _attr_fallback(self, name: str) -> Optional[str]:
+        decls = self.attr_locks.get(name, [])
+        uniq = sorted({d.lock_id for d in decls})
+        if len(uniq) == 1:
+            return uniq[0]
+        if len(uniq) > 1:
+            return f"attr::{name}"
+        if lockish(name):
+            return f"attr::{name}"
+        return None
+
+    def _class_lock(self, key: Tuple[str, str], attr: str
+                    ) -> Optional[LockDecl]:
+        decl = self.class_locks.get(key, {}).get(attr)
+        if decl is not None:
+            return decl
+        for bkey in self.iter_bases(*key):
+            decl = self.class_locks.get(bkey, {}).get(attr)
+            if decl is not None:
+                return decl
+        return None
+
+    def resolve_lock_dotted(self, dotted: str, path: str,
+                            cls: Optional[str],
+                            aliases: Dict[str, str],
+                            local_types: Dict[str, str]) -> Optional[str]:
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        if parts[0] in aliases and len(parts) == 1:
+            return aliases[parts[0]]
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2:
+                decl = self._class_lock((path, cls), parts[1])
+                if decl is not None:
+                    return decl.lock_id
+                return self._attr_fallback(parts[1])
+            if len(parts) == 3:
+                owner = self.instance_attr_types.get(
+                    (path, cls), {}).get(parts[1])
+                okey = self.resolve_class(owner, path) if owner else None
+                if okey is not None:
+                    decl = self._class_lock(okey, parts[2])
+                    if decl is not None:
+                        return decl.lock_id
+                return self._attr_fallback(parts[2])
+            return self._attr_fallback(parts[-1])
+        if len(parts) == 1:
+            decl = self.module_locks.get((path, parts[0]))
+            if decl is not None:
+                return decl.lock_id
+            imp = self.imports.get(path, {}).get(parts[0])
+            if imp is not None:
+                mod, _, name = imp.rpartition(".")
+                mpath = self.mod_to_path.get(mod)
+                if mpath is not None:
+                    decl = self.module_locks.get((mpath, name))
+                    if decl is not None:
+                        return decl.lock_id
+            return self._attr_fallback(parts[0])
+        if len(parts) == 2:
+            okey = self._instance_key(path, parts[0], local_types)
+            if okey is not None:
+                decl = self._class_lock(okey, parts[1])
+                if decl is not None:
+                    return decl.lock_id
+            imp = self.imports.get(path, {}).get(parts[0])
+            if imp is not None:
+                mpath = self.mod_to_path.get(imp)
+                if mpath is not None:
+                    decl = self.module_locks.get((mpath, parts[1]))
+                    if decl is not None:
+                        return decl.lock_id
+        return self._attr_fallback(parts[-1])
+
+    def _instance_key(self, path: str, name: str,
+                      local_types: Dict[str, str]
+                      ) -> Optional[Tuple[str, str]]:
+        """Type of a bare instance name: local `reg = MetricsRegistry()`,
+        module-level `RECORDER = FlightRecorder()`, or an imported
+        module singleton."""
+        d = local_types.get(name) \
+            or self.module_instance_types.get((path, name))
+        if d is None:
+            imp = self.imports.get(path, {}).get(name)
+            if imp is not None:
+                mod, _, nm = imp.rpartition(".")
+                mpath = self.mod_to_path.get(mod)
+                if mpath is not None:
+                    d = self.module_instance_types.get((mpath, nm))
+                    if d is not None:
+                        return self.resolve_class(d, mpath)
+            return None
+        return self.resolve_class(d, path)
+
+    def resolve_call(self, caller: FuncInfo, dotted: str,
+                     local_types: Dict[str, str]
+                     ) -> Optional[Tuple[str, str]]:
+        if not dotted:
+            return None
+        parts = dotted.split(".")
+        path, cls = caller.path, caller.cls
+        if parts[0] == "self" and cls is not None:
+            if len(parts) == 2:
+                key = self._method_key(path, cls, parts[1])
+                if key is not None:
+                    return key
+            if len(parts) == 3:
+                owner = self.instance_attr_types.get(
+                    (path, cls), {}).get(parts[1])
+                okey = self.resolve_class(owner, path) if owner else None
+                if okey is not None:
+                    return self._method_key(okey[0], okey[1], parts[2])
+            return None
+        if len(parts) == 1:
+            nested = (path, f"{caller.qual}.<locals>.{parts[0]}")
+            if nested in self.functions:
+                return nested
+            if (path, parts[0]) in self.functions:
+                return (path, parts[0])
+            imp = self.imports.get(path, {}).get(parts[0])
+            if imp is not None:
+                mod, _, name = imp.rpartition(".")
+                mpath = self.mod_to_path.get(mod)
+                if mpath is not None and (mpath, name) in self.functions:
+                    return (mpath, name)
+            # constructor: Cls(...) -> Cls.__init__
+            ckey = self.resolve_class(parts[0], path)
+            if ckey is not None:
+                return self._method_key(ckey[0], ckey[1], "__init__")
+            return None
+        if len(parts) == 2:
+            okey = self._instance_key(path, parts[0], local_types)
+            if okey is not None:
+                return self._method_key(okey[0], okey[1], parts[1])
+            imp = self.imports.get(path, {}).get(parts[0])
+            if imp is not None:
+                mpath = self.mod_to_path.get(imp)
+                if mpath is not None and (mpath, parts[1]) in self.functions:
+                    return (mpath, parts[1])
+                # `from x import Cls` + Cls.method / Cls(...) attr chain
+                ckey = self.resolve_class(parts[0], path)
+                if ckey is not None:
+                    return self._method_key(ckey[0], ckey[1], parts[1])
+        return None
+
+    def _method_key(self, path: str, cls: str, meth: str
+                    ) -> Optional[Tuple[str, str]]:
+        key = (path, f"{cls}.{meth}")
+        if key in self.functions:
+            return key
+        for bpath, bcls in self.iter_bases(path, cls):
+            bkey = (bpath, f"{bcls}.{meth}")
+            if bkey in self.functions:
+                return bkey
+        return None
+
+    # ---------------- phase B driver ----------------
+
+    def extract_functions(self, path: str, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._walk_function(path, None, stmt.name, stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self._walk_function(path, stmt.name,
+                                            f"{stmt.name}.{sub.name}", sub)
+
+    def _walk_function(self, path: str, cls: Optional[str], qual: str,
+                       node: ast.AST) -> FuncInfo:
+        name = qual.rsplit(".", 1)[-1]
+        info = FuncInfo(path=path, qual=qual, cls=cls, line=node.lineno,
+                        assumed_held=name.endswith("_locked"))
+        self.functions[info.key] = info
+        _FuncWalker(self, info, node).run()
+        return info
+
+    # ---------------- finalize: graph + fixpoints ----------------
+
+    def finalize(self) -> None:
+        for decls in ([d for ds in self.attr_locks.values() for d in ds]
+                      + list(self.module_locks.values())):
+            self.lock_kind[decls.lock_id] = decls.kind
+            self.lock_decl.setdefault(decls.lock_id, decls)
+        self._resolve_call_edges()
+        self._collect_roots()
+        self._compute_always_held()
+        self._fixpoint_acquire()
+        self._fixpoint_block()
+        self._build_edges()
+
+    def _resolve_call_edges(self) -> None:
+        for key in sorted(self.functions):
+            f = self.functions[key]
+            out: List[Tuple[Tuple[str, str], CallSite]] = []
+            for c in f.calls:
+                callee = self.resolve_call(f, c.dotted, {})
+                if callee is not None and callee != key:
+                    out.append((callee, c))
+            self.callees[key] = out
+
+    def _collect_roots(self) -> None:
+        for key in sorted(self.functions):
+            f = self.functions[key]
+            for kind, ref, _line in f.root_refs:
+                rkey: Optional[Tuple[str, str]]
+                if kind == "qual":
+                    rkey = (f.path, ref)
+                else:
+                    rkey = self.resolve_call(f, ref, {})
+                if rkey is not None and rkey in self.functions:
+                    self.roots.setdefault(
+                        rkey, f"{rkey[0]}::{rkey[1]}")
+        # HTTP request-handler threads: every do_* method of a
+        # BaseHTTPRequestHandler subclass is an entry root
+        for (path, cname), bases in sorted(self.class_bases.items()):
+            if not any(b.rsplit(".", 1)[-1] == "BaseHTTPRequestHandler"
+                       for b in bases):
+                continue
+            for key in sorted(self.functions):
+                fpath, fqual = key
+                if fpath == path and fqual.startswith(f"{cname}.do_"):
+                    self.roots.setdefault(key, f"{fpath}::{fqual}")
+            for alias, target in sorted(
+                    self.method_aliases.get((path, cname), {}).items()):
+                if not alias.startswith("do_"):
+                    continue
+                tkey = self._method_key(path, cname, target)
+                if tkey is not None:
+                    self.roots.setdefault(
+                        tkey, f"{path}::{cname}.{alias}")
+        # reachability
+        reach: Dict[Tuple[str, str], Set[str]] = {
+            k: set() for k in self.functions}
+        for rkey, label in sorted(self.roots.items()):
+            seen = {rkey}
+            frontier = [rkey]
+            while frontier:
+                cur = frontier.pop()
+                reach[cur].add(label)
+                for callee, _c in self.callees.get(cur, []):
+                    if callee not in seen:
+                        seen.add(callee)
+                        frontier.append(callee)
+        self.roots_reaching = reach
+
+    def _compute_always_held(self) -> None:
+        incoming: Dict[Tuple[str, str],
+                       List[Tuple[Tuple[str, str], bool]]] = {
+            k: [] for k in self.functions}
+        for key, outs in self.callees.items():
+            for callee, c in outs:
+                incoming[callee].append((key, bool(c.held)))
+        changed = True
+        while changed:
+            changed = False
+            for key in sorted(self.functions):
+                if key in self.always_held or key in self.roots:
+                    continue
+                f = self.functions[key]
+                if f.is_init():
+                    continue
+                inc = incoming[key]
+                if not inc:
+                    continue
+
+                def _held(caller: Tuple[str, str], held: bool) -> bool:
+                    cf = self.functions[caller]
+                    return (held or cf.assumed_held
+                            or caller in self.always_held)
+                if all(_held(cal, h) for cal, h in inc):
+                    self.always_held.add(key)
+                    changed = True
+
+    def _fixpoint_acquire(self) -> None:
+        acq: Dict[Tuple[str, str], Dict[str, Tuple[str, ...]]] = {}
+        for key in sorted(self.functions):
+            f = self.functions[key]
+            acq[key] = {lid: () for lid, _ in f.direct_acquires}
+        for _ in range(50):
+            changed = False
+            for key in sorted(self.functions):
+                for callee, _c in self.callees[key]:
+                    cqual = callee[1]
+                    for lid, chain in acq.get(callee, {}).items():
+                        if lid not in acq[key]:
+                            acq[key][lid] = ((cqual,) + chain)[:4]
+                            changed = True
+            if not changed:
+                break
+        self.may_acquire = acq
+
+    def _fixpoint_block(self) -> None:
+        blk: Dict[Tuple[str, str], Dict[str, BlockOp]] = {}
+        for key in sorted(self.functions):
+            f = self.functions[key]
+            blk[key] = {}
+            for b in f.blocks:
+                blk[key].setdefault(
+                    b.op, BlockOp(b.op, b.receiver, b.line))
+        for _ in range(50):
+            changed = False
+            for key in sorted(self.functions):
+                for callee, c in self.callees[key]:
+                    cqual = callee[1]
+                    for op, b in blk.get(callee, {}).items():
+                        if op not in blk[key]:
+                            blk[key][op] = BlockOp(
+                                b.op, b.receiver, c.line,
+                                chain=((cqual,) + b.chain)[:4])
+                            changed = True
+            if not changed:
+                break
+        self.may_block = blk
+
+    def _add_edge(self, a: str, b: str, path: str, qual: str, line: int,
+                  via: Tuple[str, ...] = ()) -> None:
+        if a == b:
+            if self.lock_kind.get(a) in NON_REENTRANT_KINDS:
+                cur = self.self_edges.get(a)
+                site = (path, qual, line)
+                if cur is None or site < cur:
+                    self.self_edges[a] = site
+            return
+        site = (path, qual, line, via)
+        cur = self.edges.get((a, b))
+        if cur is None or site < cur:
+            self.edges[(a, b)] = site
+
+    def _build_edges(self) -> None:
+        for key in sorted(self.functions):
+            f = self.functions[key]
+            for a, b, line in f.local_edges:
+                self._add_edge(a, b, f.path, f.qual, line)
+            for lid, line in f.self_acquires:
+                self._add_edge(lid, lid, f.path, f.qual, line)
+            for callee, c in self.callees[key]:
+                if not c.held:
+                    continue
+                for lid, chain in self.may_acquire.get(callee, {}).items():
+                    via = ((callee[1],) + chain)[:4]
+                    for a in c.held:
+                        self._add_edge(a, lid, f.path, f.qual,
+                                       c.line, via)
+
+    def cycles(self) -> List[List[str]]:
+        """SCCs of the lock-order graph with more than one member —
+        each is a potential deadlock (Tarjan, deterministic order)."""
+        graph: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            graph.setdefault(a, []).append(b)
+            graph.setdefault(b, [])
+        for k in graph:
+            graph[k].sort()
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        out: List[List[str]] = []
+
+        def strong(v: str) -> None:
+            # iterative Tarjan (the graph is small, but avoid recursion
+            # limits on adversarial fixtures)
+            work = [(v, 0)]
+            while work:
+                node, pi = work[-1]
+                if pi == 0:
+                    index[node] = low[node] = counter[0]
+                    counter[0] += 1
+                    stack.append(node)
+                    on_stack.add(node)
+                recurse = False
+                succs = graph[node]
+                while pi < len(succs):
+                    w = succs[pi]
+                    pi += 1
+                    if w not in index:
+                        work[-1] = (node, pi)
+                        work.append((w, 0))
+                        recurse = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if recurse:
+                    continue
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        out.append(sorted(scc))
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+
+        for v in sorted(graph):
+            if v not in index:
+                strong(v)
+        out.sort()
+        return out
+
+
+class _FuncWalker:
+    """Lexical walk of one function body with a held-lock stack.
+    Nested defs/lambdas become separate FuncInfos (a closure runs when
+    called, not where defined — it inherits no held locks)."""
+
+    def __init__(self, prog: Program, info: FuncInfo,
+                 node: ast.AST) -> None:
+        self.prog = prog
+        self.info = info
+        self.node = node
+        self.held: List[Tuple[str, int]] = []
+        self.region_n = 0
+        self.aliases: Dict[str, str] = {}
+        self.local_types: Dict[str, str] = {}
+        self.explicit: List[str] = []   # linear .acquire() holds
+
+    def run(self) -> None:
+        self.stmts(getattr(self.node, "body", []))
+
+    # -------- held bookkeeping --------
+
+    def held_ids(self) -> Tuple[str, ...]:
+        return tuple(lid for lid, _ in self.held)
+
+    def region(self) -> Optional[int]:
+        return self.held[-1][1] if self.held else None
+
+    def _push(self, lid: str, line: int) -> None:
+        cur = self.held_ids()
+        if lid in cur:
+            self.info.self_acquires.append((lid, line))
+        else:
+            for a in cur:
+                self.info.local_edges.append((a, lid, line))
+        self.info.direct_acquires.append((lid, line))
+        self.region_n += 1
+        self.held.append((lid, self.region_n))
+
+    def _pop(self, lid: str) -> None:
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i][0] == lid:
+                del self.held[i]
+                return
+
+    # -------- lock expression resolution --------
+
+    def resolve_lock_expr(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            got = Program._setdefault_lock(expr)
+            if got is not None:
+                return f"attr::{got[0]}"
+            return None
+        d = dotted_name(expr)
+        if not d:
+            return None
+        return self.prog.resolve_lock_dotted(
+            d, self.info.path, self.info.cls, self.aliases,
+            self.local_types)
+
+    # -------- statement dispatch --------
+
+    def stmts(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self.stmt(stmt)
+
+    def stmt(self, s: ast.stmt) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.prog._walk_function(
+                self.info.path, self.info.cls,
+                f"{self.info.qual}.<locals>.{s.name}", s)
+            return
+        if isinstance(s, ast.ClassDef):
+            return
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            pushed: List[str] = []
+            for item in s.items:
+                self.expr(item.context_expr)
+                lid = self.resolve_lock_expr(item.context_expr)
+                if lid is not None:
+                    self._push(lid, s.lineno)
+                    pushed.append(lid)
+                    if isinstance(item.optional_vars, ast.Name):
+                        self.aliases[item.optional_vars.id] = lid
+            self.stmts(s.body)
+            for lid in reversed(pushed):
+                self._pop(lid)
+            return
+        if isinstance(s, ast.Assign):
+            self.expr(s.value)
+            for t in s.targets:
+                self._assign_target(t, s.value, s.lineno)
+            return
+        if isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self.expr(s.value)
+                self._assign_target(s.target, s.value, s.lineno)
+            return
+        if isinstance(s, ast.AugAssign):
+            self.expr(s.value)
+            self._write_target(s.target, s.lineno,
+                               container=isinstance(s.target, ast.Subscript))
+            return
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                self._write_target(t, s.lineno,
+                                   container=isinstance(t, ast.Subscript))
+            return
+        if isinstance(s, (ast.If, ast.While)):
+            self._collect_tests(s.test, s.lineno)
+            self.expr(s.test)
+            self.stmts(s.body)
+            self.stmts(s.orelse)
+            return
+        if isinstance(s, ast.For):
+            self.expr(s.iter)
+            self.stmts(s.body)
+            self.stmts(s.orelse)
+            return
+        if isinstance(s, ast.Try):
+            self.stmts(s.body)
+            for h in s.handlers:
+                self.stmts(h.body)
+            self.stmts(s.orelse)
+            self.stmts(s.finalbody)
+            return
+        if isinstance(s, (ast.Return, ast.Expr, ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self.expr(child)
+            return
+        # anything else: visit child statements/expressions generically
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.stmt):
+                self.stmt(child)
+            elif isinstance(child, ast.expr):
+                self.expr(child)
+
+    def _assign_target(self, t: ast.AST, value: ast.AST,
+                       line: int) -> None:
+        if isinstance(t, ast.Name):
+            lid = self.resolve_lock_expr(value)
+            if lid is not None:
+                self.aliases[t.id] = lid
+            else:
+                self.aliases.pop(t.id, None)
+                if isinstance(value, ast.Call):
+                    d = dotted_name(value.func)
+                    tail = d.rsplit(".", 1)[-1] if d else ""
+                    if tail[:1].isupper():
+                        self.local_types[t.id] = d
+                    else:
+                        self.local_types.pop(t.id, None)
+            return
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._assign_target(el, ast.Constant(value=None), line)
+            return
+        self._write_target(t, line,
+                           container=isinstance(t, ast.Subscript))
+
+    def _write_target(self, t: ast.AST, line: int,
+                      container: bool) -> None:
+        base = t.value if isinstance(t, ast.Subscript) else t
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"):
+            locked = bool(self.held)
+            self.info.writes.append(
+                AttrWrite(base.attr, line, locked, container))
+            if container:
+                self.info.mutations.append(
+                    AttrTouch(base.attr, line, self.region()))
+
+    def _collect_tests(self, test: ast.expr, line: int) -> None:
+        for node in ast.walk(test):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                    and isinstance(node.ctx, ast.Load)):
+                self.info.tests.append(
+                    AttrTouch(node.attr, line, self.region()))
+
+    # -------- expression dispatch (calls) --------
+
+    def expr(self, e: ast.AST) -> None:
+        if isinstance(e, ast.Lambda):
+            sub = self.prog._walk_function(
+                self.info.path, self.info.cls,
+                f"{self.info.qual}.<lambda@{e.lineno}>",
+                _LambdaBody(e))
+            # remember the synthetic qual so Thread(target=lambda ...)
+            # resolves the lambda body as a root
+            e._oslint_qual = sub.qual  # type: ignore[attr-defined]
+            return
+        if isinstance(e, ast.Call):
+            self._call(e)
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                self.expr(child)
+
+    def _call(self, call: ast.Call) -> None:
+        d = dotted_name(call.func)
+        tail = d.rsplit(".", 1)[-1] if d else ""
+        line = call.lineno
+        if d:
+            self.info.calls.append(
+                CallSite(d, line, self.held_ids(), self.region()))
+        # container mutators on self attributes
+        parts = d.split(".") if d else []
+        if (len(parts) == 3 and parts[0] == "self"
+                and parts[2] in MUTATORS):
+            self.info.writes.append(
+                AttrWrite(parts[1], line, bool(self.held), True))
+            self.info.mutations.append(
+                AttrTouch(parts[1], line, self.region()))
+        # thread-entry registrations
+        if tail == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    self._root_ref(kw.value, line)
+        elif tail in CALLBACK_REGISTRARS and call.args:
+            self._root_ref(call.args[0], line)
+        # explicit acquire/release (linear, same statement list —
+        # covers the `acquire(); try: ... finally: release()` shape)
+        if tail == "acquire" and len(parts) >= 2:
+            rid = self.prog.resolve_lock_dotted(
+                d[: -(len(tail) + 1)], self.info.path, self.info.cls,
+                self.aliases, self.local_types)
+            if rid is not None:
+                self._push(rid, line)
+                self.explicit.append(rid)
+        elif tail == "release" and len(parts) >= 2:
+            rid = self.prog.resolve_lock_dotted(
+                d[: -(len(tail) + 1)], self.info.path, self.info.cls,
+                self.aliases, self.local_types)
+            if rid is not None and rid in self.explicit:
+                self.explicit.remove(rid)
+                self._pop(rid)
+        # blocking operations
+        self._classify_blocking(d, tail, line)
+
+    def _root_ref(self, expr: ast.AST, line: int) -> None:
+        if isinstance(expr, ast.Lambda):
+            qual = getattr(expr, "_oslint_qual", None)
+            if qual is None:
+                sub = self.prog._walk_function(
+                    self.info.path, self.info.cls,
+                    f"{self.info.qual}.<lambda@{expr.lineno}>",
+                    _LambdaBody(expr))
+                qual = sub.qual
+                expr._oslint_qual = qual  # type: ignore[attr-defined]
+            self.info.root_refs.append(("qual", qual, line))
+            return
+        d = dotted_name(expr)
+        if d:
+            self.info.root_refs.append(("dotted", d, line))
+
+    def _classify_blocking(self, d: str, tail: str, line: int) -> None:
+        if not d:
+            return
+        receiver = d[: -(len(tail) + 1)] if "." in d else ""
+        op: Optional[str] = None
+        rid: Optional[str] = None
+        if d == "time.sleep" or (d == "sleep" and "time" not in d):
+            op = "time.sleep"
+        elif tail == "urlopen":
+            op = "urllib urlopen (RPC send)"
+        elif tail == "device_get":
+            op = "jax.device_get (device sync)"
+        elif tail == "block_until_ready":
+            op = "block_until_ready (device sync)"
+        elif tail in ("wait", "wait_for"):
+            rid = self.prog.resolve_lock_dotted(
+                receiver, self.info.path, self.info.cls, self.aliases,
+                self.local_types) if receiver else None
+            op = f"{tail}() on `{receiver or '?'}`"
+        elif tail == "join" and receiver:
+            rparts = receiver.split(".")
+            is_thread = ("thread" in rparts[-1].lower()
+                         or (self.info.cls is not None
+                             and rparts[-1] in self.prog.thread_attrs.get(
+                                 (self.info.path, self.info.cls), set())))
+            if is_thread:
+                op = f"thread join() on `{receiver}`"
+        if op is None:
+            return
+        self.info.blocks.append(
+            BlockOp(op, rid, line, held=self.held_ids()))
+
+
+class _LambdaBody:
+    """Adapter presenting a Lambda's expression as a one-statement
+    function body for _FuncWalker."""
+
+    def __init__(self, lam: ast.Lambda) -> None:
+        self.lineno = lam.lineno
+        self.body = [ast.Expr(value=lam.body)]
+        ast.copy_location(self.body[0], lam.body)
+
+
+def build_program(files: Sequence[Tuple[str, ast.Module, str]]) -> Program:
+    """Assemble the whole-program model from parsed (path, tree, src)
+    triples (paths repo-relative, forward slashes)."""
+    prog = Program()
+    prog.files = list(files)
+    for path, tree, _src in files:
+        prog.scan_declarations(path, tree)
+    for path, tree, _src in files:
+        prog.extract_functions(path, tree)
+    prog.finalize()
+    return prog

@@ -1,0 +1,342 @@
+"""OSL7xx concurrency rules over the whole-program model, plus the
+`lock_order.json` artifact (build / load / diff).
+
+Rule family (see docs/STATIC_ANALYSIS.md "Concurrency suite"):
+
+OSL701  potential deadlock — a cycle in the whole-program lock-order
+        graph, or a lexical/interprocedural re-acquire of a
+        non-reentrant `threading.Lock` (self-deadlock).
+OSL702  lock held across a blocking operation: `time.sleep`, `urlopen`
+        (every `/_internal` RPC send funnels through it), device syncs
+        (`jax.device_get` / `block_until_ready`), waits on *foreign*
+        condition variables / events, and thread joins. Waiting on a
+        condition whose lock you hold is exempt (the wait releases it);
+        semaphores are exempt (holding one across work is their job).
+OSL703  cross-thread unlocked write: an instance attribute written
+        without any lock from code reachable from two or more distinct
+        thread-entry roots (Thread targets, listener callbacks, HTTP
+        `do_*` handlers).
+OSL704  check-then-act split: in a lock-bearing class, a container
+        mutation (`self.d[k] = ...`, `self.q.popleft()`, `del`, ...)
+        outside any lock region that is guarded by an earlier test of
+        the same attribute — the test and the act are not atomic.
+
+Findings go through the standard oslint triage pipeline: inline
+`# oslint: disable=OSL70x -- why` suppressions and the count-ratcheted
+baseline. The lock-order graph itself is ratcheted separately via
+`build_lock_order` / `diff_lock_order` and the committed
+`lock_order.json`.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import (Finding, _suppressed, iter_py_files,
+                    parse_suppressions)
+from .program import (SEMAPHORE_KINDS, Program, build_program, short_lock)
+
+CONCURRENCY_RULES = ("OSL701", "OSL702", "OSL703", "OSL704")
+
+UNJUSTIFIED = "UNJUSTIFIED: new cycle — break the order or justify here"
+
+
+# --------------------------------------------------------------------
+# rule emission
+# --------------------------------------------------------------------
+
+def _cycle_findings(prog: Program) -> List[Finding]:
+    out: List[Finding] = []
+    for cycle in prog.cycles():
+        members = set(cycle)
+        # deterministic anchor: smallest edge site inside the cycle
+        sites = sorted(site for (a, b), site in prog.edges.items()
+                       if a in members and b in members)
+        path, qual, line, via = sites[0] if sites else ("", "", 1, ())
+        shorts = [short_lock(m) for m in cycle]
+        out.append(Finding(
+            "OSL701", path, line, 0, qual,
+            "lock-order cycle (potential deadlock): "
+            + " -> ".join(shorts + [shorts[0]])
+            + "; regenerate lock_order.json and justify or break the "
+              "order",
+            detail="cycle:" + "|".join(shorts)))
+    for lid, (path, qual, line) in sorted(prog.self_edges.items()):
+        out.append(Finding(
+            "OSL701", path, line, 0, qual,
+            f"re-acquire of non-reentrant Lock {short_lock(lid)} while "
+            "already held (self-deadlock); use an RLock or a _locked "
+            "variant",
+            detail=f"self:{short_lock(lid)}"))
+    return out
+
+
+def _blocking_findings(prog: Program) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[Tuple[str, str, str, str]] = set()
+
+    def emit(fkey: Tuple[str, str], held: Tuple[str, ...],
+             op: str, receiver: Optional[str], line: int,
+             via: Tuple[str, ...]) -> None:
+        f = prog.functions[fkey]
+        for h in held:
+            if h == receiver:
+                continue  # cond.wait() releases the lock it guards
+            if prog.lock_kind.get(h) in SEMAPHORE_KINDS:
+                continue
+            key = (f.path, f.qual, h, op)
+            if key in seen:
+                continue
+            seen.add(key)
+            chain = f" (via {' -> '.join(via)})" if via else ""
+            out.append(Finding(
+                "OSL702", f.path, line, 0, f.qual,
+                f"{short_lock(h)} held across blocking {op}{chain}; "
+                "snapshot under the lock, block outside it",
+                detail=f"held:{short_lock(h)}~{op}"))
+
+    for fkey in sorted(prog.functions):
+        f = prog.functions[fkey]
+        for b in f.blocks:
+            if b.held:
+                emit(fkey, b.held, b.op, b.receiver, b.line, ())
+        for callee, c in prog.callees.get(fkey, []):
+            if not c.held:
+                continue
+            for op, b in sorted(prog.may_block.get(callee, {}).items()):
+                via = ((callee[1],) + b.chain)[:4]
+                emit(fkey, c.held, op, b.receiver, c.line, via)
+    return out
+
+
+def _held_anywhere(prog: Program, fkey: Tuple[str, str]) -> bool:
+    f = prog.functions[fkey]
+    return f.assumed_held or fkey in prog.always_held
+
+
+def _in_init(qual: str) -> bool:
+    return qual.split(".<locals>")[0].endswith("__init__")
+
+
+def _class_funcs(prog: Program) -> Dict[Tuple[str, str],
+                                        List[Tuple[str, str]]]:
+    out: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+    for key in sorted(prog.functions):
+        f = prog.functions[key]
+        if f.cls is not None:
+            out.setdefault((f.path, f.cls), []).append(key)
+    return out
+
+
+def _xthread_findings(prog: Program) -> List[Finding]:
+    out: List[Finding] = []
+    for ckey, fkeys in sorted(_class_funcs(prog).items()):
+        path, cls = ckey
+        lock_attrs = set(prog.class_locks.get(ckey, {}))
+        thread_attrs = prog.thread_attrs.get(ckey, set())
+        # attr -> (roots that can run a writer, first unlocked write)
+        per_attr: Dict[str, Tuple[Set[str],
+                                  Optional[Tuple[int, str]]]] = {}
+        for fkey in fkeys:
+            f = prog.functions[fkey]
+            init = _in_init(f.qual)
+            for w in f.writes:
+                if (w.attr in lock_attrs or w.attr in thread_attrs
+                        or w.attr.endswith("lock")
+                        or w.attr.endswith("cond")):
+                    continue
+                roots, first = per_attr.get(w.attr, (set(), None))
+                if not init:
+                    roots |= prog.roots_reaching.get(fkey, set())
+                unlocked = (not w.locked and not init
+                            and not _held_anywhere(prog, fkey))
+                if unlocked and (first is None
+                                 or (w.line, f.qual) < first):
+                    first = (w.line, f.qual)
+                per_attr[w.attr] = (roots, first)
+        for attr in sorted(per_attr):
+            roots, first = per_attr[attr]
+            if first is None or len(roots) < 2:
+                continue
+            line, qual = first
+            nroots = len(roots)
+            out.append(Finding(
+                "OSL703", path, line, 0, qual,
+                f"self.{attr} written without a lock but reachable from "
+                f"{nroots} thread-entry roots; guard the write or "
+                "document the single-writer/GIL-atomic contract inline",
+                detail=f"xthread:{cls}.{attr}"))
+    return out
+
+
+def _check_then_act_findings(prog: Program) -> List[Finding]:
+    out: List[Finding] = []
+    for ckey, fkeys in sorted(_class_funcs(prog).items()):
+        if not prog.class_locks.get(ckey):
+            continue  # only lock-bearing classes promise atomicity
+        path, cls = ckey
+        for fkey in fkeys:
+            f = prog.functions[fkey]
+            if (_in_init(f.qual) or f.assumed_held
+                    or fkey in prog.always_held):
+                continue
+            flagged: Set[str] = set()
+            for m in f.mutations:
+                if m.region is not None or m.attr in flagged:
+                    continue
+                guard = next(
+                    (t for t in f.tests
+                     if t.attr == m.attr and t.line < m.line
+                     and t.region != m.region), None)
+                if guard is None:
+                    continue
+                flagged.add(m.attr)
+                out.append(Finding(
+                    "OSL704", path, m.line, 0, f.qual,
+                    f"check-then-act on self.{m.attr}: tested at line "
+                    f"{guard.line} but mutated outside any lock region "
+                    "— the pair is not atomic; move both under "
+                    "the lock",
+                    detail=f"cta:{cls}.{m.attr}"))
+    return out
+
+
+def analyze(prog: Program) -> List[Finding]:
+    """All OSL7xx findings for the model, unsuppressed and unsorted."""
+    return (_cycle_findings(prog) + _blocking_findings(prog)
+            + _xthread_findings(prog) + _check_then_act_findings(prog))
+
+
+def run_program(files: Sequence[Tuple[str, ast.Module, str]]
+                ) -> Tuple[Program, List[Finding]]:
+    """Build the model from parsed (path, tree, src) triples, emit
+    findings, and apply each file's inline suppressions."""
+    prog = build_program(files)
+    sups = {path: parse_suppressions(src) for path, _t, src in files}
+    findings = [f for f in analyze(prog)
+                if not _suppressed(f, sups.get(f.path, {}))]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    return prog, findings
+
+
+def program_files(repo_root: str, package: str = "opensearch_tpu"
+                  ) -> List[Tuple[str, ast.Module, str]]:
+    """Parse the package for the whole-program pass. devtools/ is
+    excluded: the analyzer and the lock witness manipulate locks in
+    ways the model deliberately flags."""
+    files: List[Tuple[str, ast.Module, str]] = []
+    pkg_root = os.path.join(repo_root, package)
+    for fp in iter_py_files(pkg_root):
+        rel = os.path.relpath(fp, repo_root).replace(os.sep, "/")
+        if rel.startswith(f"{package}/devtools/"):
+            continue
+        with open(fp, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # the per-file pass reports OSL000
+        files.append((rel, tree, src))
+    return files
+
+
+def run_program_scope(repo_root: str, package: str = "opensearch_tpu"
+                      ) -> List[Finding]:
+    _prog, findings = run_program(program_files(repo_root, package))
+    return findings
+
+
+# --------------------------------------------------------------------
+# lock_order.json artifact
+# --------------------------------------------------------------------
+
+def _cycle_key(members: Sequence[str]) -> str:
+    return "|".join(sorted(members))
+
+
+def build_lock_order(prog: Program,
+                     justifications: Optional[Dict[str, str]] = None
+                     ) -> dict:
+    """The reviewable artifact: every inventoried lock, every
+    acquired-while-held edge with one deterministic witness site, and
+    every cycle with its justification. Fully sorted so regeneration
+    is byte-stable."""
+    justifications = justifications or {}
+    lock_ids = sorted(set(prog.lock_decl)
+                      | {x for e in prog.edges for x in e})
+    locks = []
+    for lid in lock_ids:
+        decl = prog.lock_decl.get(lid)
+        locks.append({
+            "id": lid,
+            "kind": decl.kind if decl else "attr",
+            "declared": f"{decl.path}:{decl.line}" if decl else "",
+        })
+    edges = []
+    for (a, b) in sorted(prog.edges):
+        path, qual, _line, via = prog.edges[(a, b)]
+        site = f"{path}::{qual}"
+        if via:
+            site += f" (via {' -> '.join(via)})"
+        edges.append({"from": a, "to": b, "site": site})
+    cycles = []
+    for members in prog.cycles():
+        key = _cycle_key(members)
+        cycles.append({
+            "members": members,
+            "justification": justifications.get(key, UNJUSTIFIED),
+        })
+    return {"version": 1, "locks": locks, "edges": edges,
+            "cycles": cycles}
+
+
+def load_lock_order(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def write_lock_order(graph: dict, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(graph, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def diff_lock_order(committed: dict, current: dict) -> dict:
+    """Ratchet comparison. Edge identity is (from, to) and cycle
+    identity is the sorted member set — witness sites and declaration
+    line numbers may drift with unrelated edits without failing.
+
+    `new_edges` / `new_cycles` fail tier-1 until the artifact is
+    regenerated (scripts/oslint.py --write-lock-graph) and reviewed;
+    `unjustified_cycles` fail until each committed cycle carries a
+    real justification; `stale_edges` are informational debt.
+    """
+    def edge_set(g: dict) -> Set[Tuple[str, str]]:
+        return {(e["from"], e["to"]) for e in g.get("edges", [])}
+
+    def cycle_map(g: dict) -> Dict[str, dict]:
+        return {_cycle_key(c["members"]): c for c in g.get("cycles", [])}
+
+    old_e, new_e = edge_set(committed), edge_set(current)
+    old_c, new_c = cycle_map(committed), cycle_map(current)
+    sites = {(e["from"], e["to"]): e.get("site", "")
+             for e in current.get("edges", [])}
+    return {
+        "new_edges": [
+            {"from": a, "to": b, "site": sites.get((a, b), "")}
+            for a, b in sorted(new_e - old_e)],
+        "stale_edges": [{"from": a, "to": b}
+                        for a, b in sorted(old_e - new_e)],
+        "new_cycles": [new_c[k]["members"]
+                       for k in sorted(set(new_c) - set(old_c))],
+        "stale_cycles": [old_c[k]["members"]
+                         for k in sorted(set(old_c) - set(new_c))],
+        "unjustified_cycles": [
+            c["members"] for k, c in sorted(old_c.items())
+            if k in new_c and (not c.get("justification")
+                               or c["justification"].startswith(
+                                   "UNJUSTIFIED"))],
+    }

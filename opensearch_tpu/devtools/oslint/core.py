@@ -179,13 +179,21 @@ def iter_py_files(root: str) -> Iterable[str]:
 
 
 def run_paths(paths: Sequence[str], repo_root: str,
-              checkers: Optional[Sequence[Checker]] = None
-              ) -> List[Finding]:
+              checkers: Optional[Sequence[Checker]] = None,
+              program: Optional[bool] = None) -> List[Finding]:
+    """Per-file rules over `paths`, plus — when `program` is true, or
+    left None and a path covers the whole opensearch_tpu package — the
+    interprocedural OSL7xx concurrency pass, which only makes sense
+    with the full package in view (scripts/oslint.py --changed turns it
+    off explicitly)."""
     files: List[str] = []
+    whole_package = False
     for p in paths:
         ap = p if os.path.isabs(p) else os.path.join(repo_root, p)
         if os.path.isdir(ap):
             files.extend(iter_py_files(ap))
+            if os.path.basename(os.path.normpath(ap)) == "opensearch_tpu":
+                whole_package = True
         else:
             files.append(ap)
     findings: List[Finding] = []
@@ -194,6 +202,10 @@ def run_paths(paths: Sequence[str], repo_root: str,
         with open(f, "r", encoding="utf-8") as fh:
             src = fh.read()
         findings.extend(run_source(src, rel, checkers))
+    if program or (program is None and whole_package):
+        from .concurrency import run_program_scope  # cycle-free: lazy
+        findings.extend(run_program_scope(repo_root))
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
     return findings
 
 

@@ -283,7 +283,12 @@ class _BuildLock:
     all inside a build's critical section) — a bare RLock's reentrant
     acquire would succeed there and let a mid-build plane be dropped.
     The depth counter is only mutated while the lock is held, so reading
-    `depth > 1` after a successful acquire is exact."""
+    `depth > 1` after a successful acquire is exact.
+
+    The static concurrency pass models this wrapper as a reentrant lock
+    kind ("BuildLock"), so the build path's re-entry is exempt from the
+    OSL701 self-deadlock rule while its nesting over the HBM ledger
+    stays a committed edge in lock_order.json."""
 
     __slots__ = ("_lock", "depth")
 

@@ -324,6 +324,24 @@ class FlightRecorder:
                          note=f"{len(recent)} scheduler rejections in "
                               f"{self.burst_window_s}s")
 
+    def note_lock_inversion(self, first: str, second: str,
+                            stack_now: str, stack_prior: str) -> None:
+        """Freeze a dump when the runtime lock witness
+        (devtools/lockwitness.py) observes an acquisition-order
+        inversion — both stacks ride in the bundle so the two
+        conflicting code paths are named even after the process moves
+        on. Always dumps (force=True): a witnessed inversion is a
+        latent deadlock, never storm noise."""
+        if not self.enabled:
+            return
+        tl = self.start("lock_inversion", first=first, second=second)
+        self.record(tl, "lock_inversion", first=first, second=second,
+                    stack_now=stack_now, stack_prior=stack_prior)
+        self.trigger("lock_inversion", [tl],
+                     note=f"{second} acquired while holding {first} "
+                          "after the opposite order was witnessed",
+                     force=True)
+
     def dumps(self, limit: Optional[int] = None) -> List[dict]:
         with self._dump_lock:
             out = list(self._dumps)

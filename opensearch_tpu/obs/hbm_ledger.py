@@ -174,7 +174,10 @@ class HBMLedger:
         uid = getattr(segment, "uid", None)
         if uid is None:
             return
-        self._touch[(uid, _device_key(device))] = next(self._touch_seq)
+        # GIL-atomic single dict store + itertools.count (thread-safe in
+        # CPython); readers (_evict_lru) snapshot under the ledger lock
+        # and tolerate a stale recency value by design
+        self._touch[(uid, _device_key(device))] = next(self._touch_seq)  # oslint: disable=OSL703 -- documented lock-free hot path
 
     def _evict_lru(self, breaker, exclude_uid) -> bool:
         """Evict the least-recently-used evictable segment-plane group

@@ -176,7 +176,11 @@ class MeshSearchService:
         # the CPU backend under scheduler-off concurrent REST traffic).
         # One launch at a time is also the physical truth — the chip
         # serializes programs; the serving scheduler makes this lock
-        # uncontended (a single dispatcher thread owns the mesh)
+        # uncontended (a single dispatcher thread owns the mesh).
+        # Everything this lock may nest over (ledger, stats, metrics,
+        # tracer) is committed in lock_order.json and ratcheted by
+        # tier-1 — and OSL702 rejects holding it across a device sync,
+        # which is the shape of the original deadlock
         import threading
         self._dispatch_lock = threading.Lock()
         # counter mutations can now come from several threads at once

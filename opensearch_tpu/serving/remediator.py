@@ -207,7 +207,10 @@ class Remediator:
         self.shed_total = 0
         self.deprioritized_total = 0
         # ---- admission fast-path snapshots (GIL-atomic attribute swaps;
-        # the hot path reads these WITHOUT the lock) ----
+        # the hot path reads these WITHOUT the lock — the sanctioned
+        # lock-free pattern, see docs/STATIC_ANALYSIS.md "Concurrency
+        # suite": single-reference rebind-then-swap only; any
+        # read-modify-write here must move under self._lock) ----
         self._active = False
         self._shed: frozenset = frozenset()
         self._tightened = False

@@ -282,6 +282,10 @@ class ServingScheduler:
                 # opt in with OPENSEARCH_TPU_SCHED=1 (kernel batching)
                 enabled = node.mesh_service is not None
         self.enabled = bool(enabled)
+        # the one condition every enqueue/flush/close handshake rides;
+        # its only committed downstream acquisition is the metrics
+        # registry (lock_order.json) — never call out to RPC/device
+        # work while holding it (OSL702)
         self._cond = threading.Condition()
         self._lanes: Dict[str, deque] = {lane: deque() for lane in LANES}
         self._pending = 0

@@ -45,7 +45,12 @@ on the clean run. A fourth pair does the same for the query-insights
 engine (obs/insights.py; ISSUE 12): per-search fingerprinting + the
 space-saving heavy-hitter sketch pinned ON vs OFF, byte-identical
 responses, paired best-of-reps qps >= 0.98x (noise-floored) →
-`extra.concurrency.insights_overhead_32t`.
+`extra.concurrency.insights_overhead_32t`. A fifth pair (ISSUE 16) does
+the same for the runtime lock-witness sanitizer
+(devtools/lockwitness.py) armed vs unarmed —
+`extra.concurrency.lockwitness_overhead_32t` — and additionally gates
+the armed cells on zero witnessed inversions and zero acquisition-order
+conflicts against the committed lock_order.json.
 
 Results land in BENCH_out.json under `extra.concurrency` (merged into an
 existing bench emission when present). Run:
@@ -140,7 +145,8 @@ def strip_took(resp: dict) -> str:
 
 
 def run_cell(client, bodies, nthreads: int, mode, tag: str,
-             recorder=None, cost=None, sampler=None, insights=None):
+             recorder=None, cost=None, sampler=None, insights=None,
+             lockwitness=None):
     """Closed loop: `nthreads` client threads drain the shared query list;
     every thread records its request wall into a DDSketch histogram.
     `mode` is None for scheduler-off, or a pipeline depth (int) for a
@@ -154,7 +160,12 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     production default rate) for the sampler-overhead gate. `insights`
     pins the query-insights engine (obs/insights.py; on is the process
     default) for the insights-overhead gate — fingerprinting + the
-    heavy-hitter sketch must ride the search boundary for ~free."""
+    heavy-hitter sketch must ride the search boundary for ~free.
+    `lockwitness` pins the runtime lock-witness sanitizer
+    (devtools/lockwitness.py) — armed BEFORE the cell's fresh scheduler
+    is constructed, so the locks the serving path actually contends
+    (the dispatcher condition handshake) are wrapped and every
+    acquisition order is recorded, for the lockwitness-overhead gate."""
     from opensearch_tpu.obs.flight_recorder import RECORDER
     from opensearch_tpu.obs.insights import INSIGHTS
     from opensearch_tpu.obs.slo import SLO_ENGINE, default_slos
@@ -182,6 +193,15 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
                                     slow_window_s=10.0))
         SAMPLER.ensure_started()
     RECORDER.reset()       # bound ring memory + per-cell trigger state
+    wit_state = None
+    if lockwitness is not None:
+        from opensearch_tpu.devtools import lockwitness as _lw
+        _lw.uninstall()                 # clean slate either way
+        if lockwitness:
+            # armed BEFORE the fresh scheduler below is constructed —
+            # the witness wraps locks at creation time
+            wit_state = _lw.install(strict=False)
+            _lw.reset()
     old_serving = node.serving
     sched_on = mode is not None
     if sched_on:
@@ -277,6 +297,18 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
             os.environ.pop("OPENSEARCH_TPU_COST", None)
         else:
             os.environ["OPENSEARCH_TPU_COST"] = cost_before
+    if lockwitness is not None:
+        from opensearch_tpu.devtools import lockwitness as _lw
+        cell["lockwitness"] = "on" if lockwitness else "off"
+        if lockwitness:
+            rep = _lw.verify_against(
+                os.path.join(_REPO, "lock_order.json"))
+            cell["lockwitness_wrapped"] = wit_state.wrapped
+            cell["lockwitness_edges"] = len(_lw.edges())
+            cell["lockwitness_inversions"] = len(_lw.inversions())
+            cell["lockwitness_order_conflicts"] = \
+                len(rep["order_conflicts"])
+            _lw.uninstall()
     if sampler is not None:
         cell["sampler"] = "on" if sampler else "off"
     if sampler:
@@ -463,6 +495,37 @@ def main():
     ins_pair = {lab: max(reps, key=lambda c: c["qps"])
                 for lab, reps in ins_reps.items()}
 
+    # lockwitness-overhead pair (ISSUE 16): the (32-thread,
+    # deepest-depth) cell with the runtime lock-witness sanitizer
+    # (devtools/lockwitness.py) armed vs unarmed — per acquire the
+    # witness costs one thread-local append plus a dict probe per held
+    # lock, and the gate proves that rides along for ~free under the
+    # same alternating-reps / noise-floor / byte-identity protocol as
+    # the other four gates. The armed cells double as a production-shaped
+    # witness run: zero inversions and zero order conflicts against the
+    # committed lock_order.json are gated too.
+    lw_pair = {}
+    lw_reps = {"lockwitness_off": [], "lockwitness_on": []}
+    run_cell(client, bodies, rthreads, rdepth,
+             f"{rthreads}-d{rdepth}-lw-warmup")
+    for rep, (wlabel, wflag) in enumerate(
+            (("lockwitness_off", False), ("lockwitness_on", True),
+             ("lockwitness_on", True), ("lockwitness_off", False))):
+        tag = f"{rthreads}-d{rdepth}-{wlabel}-r{rep}"
+        cell, results = run_cell(client, bodies, rthreads, rdepth, tag,
+                                 lockwitness=wflag)
+        errored += cell["errors"]
+        digests = [strip_took(r) if r is not None else None
+                   for r in results]
+        bad = sum(1 for a, b in zip(digests, canonical) if a != b)
+        cell["identical_responses"] = bad == 0
+        mismatched += bad
+        cells.append(cell)
+        lw_reps[wlabel].append(cell)
+        print(json.dumps(cell), flush=True)
+    lw_pair = {lab: max(reps, key=lambda c: c["qps"])
+               for lab, reps in lw_reps.items()}
+
     summary = {"ndocs": ndocs, "nq": nq,
                "devices": len(jax.devices()),
                "mix": "60% match2 / 40% filtered bool",
@@ -544,6 +607,38 @@ def main():
             "noise_floor": round(inoise, 4),
             "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
             "gate_threshold": round(min(0.98, 1.0 - inoise), 4),
+        }
+    if lw_pair:
+        on_c, off_c = (lw_pair["lockwitness_on"],
+                       lw_pair["lockwitness_off"])
+        wnoise = max(
+            (1.0 - min(c["qps"] for c in reps)
+             / max(max(c["qps"] for c in reps), 1e-9))
+            for reps in lw_reps.values())
+        summary["lockwitness_overhead_32t"] = {
+            "threads": rthreads, "mode": f"d{rdepth}",
+            "protocol": "warmup + alternating off/on/on/off reps; "
+                        "paired best-of-reps ratio, noise-floor "
+                        "threshold; witness armed before the cell's "
+                        "scheduler construction",
+            "lockwitness_on_qps": on_c["qps"],
+            "lockwitness_off_qps": off_c["qps"],
+            "lockwitness_on_reps": [c["qps"] for c in
+                                    lw_reps["lockwitness_on"]],
+            "lockwitness_off_reps": [c["qps"] for c in
+                                     lw_reps["lockwitness_off"]],
+            "wrapped_locks": max(c.get("lockwitness_wrapped", 0)
+                                 for c in lw_reps["lockwitness_on"]),
+            "witnessed_edges": max(c.get("lockwitness_edges", 0)
+                                   for c in lw_reps["lockwitness_on"]),
+            "inversions": sum(c.get("lockwitness_inversions", 0)
+                              for c in lw_reps["lockwitness_on"]),
+            "order_conflicts": sum(
+                c.get("lockwitness_order_conflicts", 0)
+                for c in lw_reps["lockwitness_on"]),
+            "noise_floor": round(wnoise, 4),
+            "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+            "gate_threshold": round(min(0.98, 1.0 - wnoise), 4),
         }
     if rec_pair:
         on_c, off_c = rec_pair["rec_on"], rec_pair["rec_off"]
@@ -667,6 +762,21 @@ def main():
                 f"is {ip['qps_ratio']}x insights-off "
                 f"(< {ip['gate_threshold']}x; noise floor "
                 f"{ip['noise_floor']}) at {ip['threads']} threads")
+        wp = summary.get("lockwitness_overhead_32t")
+        if wp and wp["qps_ratio"] < wp["gate_threshold"]:
+            raise SystemExit(
+                f"lockwitness overhead gate failed: witness-on qps is "
+                f"{wp['qps_ratio']}x witness-off "
+                f"(< {wp['gate_threshold']}x; noise floor "
+                f"{wp['noise_floor']}) at {wp['threads']} threads")
+        if wp and wp["inversions"]:
+            raise SystemExit(
+                f"lock witness recorded {wp['inversions']} acquisition-"
+                f"order inversion(s) on a clean concurrency run")
+        if wp and wp["order_conflicts"]:
+            raise SystemExit(
+                f"witnessed acquisition order contradicts the committed "
+                f"lock_order.json in {wp['order_conflicts']} edge(s)")
     print("OK", flush=True)
 
 

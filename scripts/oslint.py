@@ -5,26 +5,77 @@ Usage:
     python scripts/oslint.py                 # report NEW findings
     python scripts/oslint.py --check        # exit 1 on new findings (CI)
     python scripts/oslint.py --all          # include baselined findings
+    python scripts/oslint.py --json         # machine-readable output
+    python scripts/oslint.py --changed      # lint only git-changed files
     python scripts/oslint.py --write-baseline   # triage current findings
+    python scripts/oslint.py --write-lock-graph # regenerate lock_order.json
     python scripts/oslint.py path/to/file.py    # lint a subset
 
 Findings already triaged in oslint_baseline.json (with a justification
 per entry) do not fail --check; stale baseline entries (debt that was
-paid) are reported so the file shrinks over time. See
+paid) are reported so the file shrinks over time.
+
+`--changed` is the fast pre-commit mode: file selection is scoped to
+`git diff` (worktree + index vs HEAD), and the interprocedural OSL7xx
+concurrency pass is skipped — it needs the whole package in view, so it
+runs on full invocations and in tier-1 (tests/test_oslint_concurrency.py
+ratchets the committed lock_order.json there). See
 docs/STATIC_ANALYSIS.md.
 """
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-from opensearch_tpu.devtools.oslint import (load_baseline, run_paths,
-                                            write_baseline)
+from opensearch_tpu.devtools.oslint import (build_lock_order, build_program,
+                                            diff_lock_order, load_baseline,
+                                            run_paths, write_baseline)
+from opensearch_tpu.devtools.oslint.concurrency.rules import (
+    program_files, write_lock_order)
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "oslint_baseline.json")
+DEFAULT_LOCK_GRAPH = os.path.join(REPO_ROOT, "lock_order.json")
+
+
+def changed_paths() -> list:
+    """Package .py files touched in the working tree / index vs HEAD
+    (the pre-commit scope). Deleted files drop out naturally."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", "HEAD", "--", "opensearch_tpu"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=False)
+    if out.returncode != 0:
+        return []
+    paths = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if (line.endswith(".py")
+                and os.path.exists(os.path.join(REPO_ROOT, line))):
+            paths.append(line)
+    return sorted(set(paths))
+
+
+def regen_lock_graph(path: str) -> int:
+    """Regenerate lock_order.json, preserving the justification text of
+    every cycle that survives (new cycles get the UNJUSTIFIED marker the
+    ratchet rejects until a human writes a reason)."""
+    prog = build_program(program_files(REPO_ROOT))
+    old_just = {}
+    if os.path.exists(path):
+        with open(path, "r", encoding="utf-8") as fh:
+            for c in json.load(fh).get("cycles", []):
+                old_just["|".join(sorted(c["members"]))] = \
+                    c.get("justification", "")
+    graph = build_lock_order(prog, justifications=old_just)
+    write_lock_order(graph, path)
+    print(f"wrote {len(graph['locks'])} lock(s), "
+          f"{len(graph['edges'])} edge(s), {len(graph['cycles'])} "
+          f"cycle(s) to {path}")
+    return 0
 
 
 def main(argv=None) -> int:
@@ -37,13 +88,37 @@ def main(argv=None) -> int:
                     help="exit nonzero on findings not in the baseline")
     ap.add_argument("--all", action="store_true",
                     help="show baselined findings too")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON on stdout")
+    ap.add_argument("--changed", action="store_true",
+                    help="incremental mode: only git-changed package "
+                         "files; skips the whole-program OSL7xx pass")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write ALL current findings to the baseline "
                          "(then edit in per-entry justifications)")
+    ap.add_argument("--write-lock-graph", action="store_true",
+                    help="regenerate lock_order.json from the current "
+                         "tree, preserving surviving cycle "
+                         "justifications")
     args = ap.parse_args(argv)
 
-    paths = args.paths or ["opensearch_tpu"]
-    findings = run_paths(paths, REPO_ROOT)
+    if args.write_lock_graph:
+        return regen_lock_graph(DEFAULT_LOCK_GRAPH)
+
+    program = None
+    if args.changed:
+        paths = changed_paths()
+        program = False
+        if not paths:
+            if args.as_json:
+                print(json.dumps({"new": [], "baselined": 0, "total": 0,
+                                  "stale": [], "scope": "changed"}))
+            else:
+                print("oslint: no changed package files")
+            return 0
+    else:
+        paths = args.paths or ["opensearch_tpu"]
+    findings = run_paths(paths, REPO_ROOT, program=program)
 
     if args.write_baseline:
         write_baseline(findings, args.baseline)
@@ -54,18 +129,34 @@ def main(argv=None) -> int:
     new = baseline.new_findings(findings)
     shown = findings if args.all else new
 
+    # stale entries only meaningful on a full-default run
+    full_run = not args.changed and paths == ["opensearch_tpu"]
+    stale = baseline.stale_entries(findings) if full_run else []
+
+    if args.as_json:
+        def fjson(f):
+            return {"rule": f.rule, "path": f.path, "line": f.line,
+                    "col": f.col, "symbol": f.symbol, "msg": f.msg,
+                    "detail": f.detail, "new": f in new}
+        print(json.dumps({
+            "new": [fjson(f) for f in new],
+            "findings": [fjson(f) for f in shown],
+            "baselined": len(findings) - len(new),
+            "total": len(findings),
+            "stale": stale,
+            "scope": "changed" if args.changed else "full",
+        }, indent=2))
+        return 1 if (args.check and new) else 0
+
     for f in shown:
         tag = "" if f in new else "  [baselined]"
         print(f.render() + tag)
 
-    # stale entries only meaningful on a full-default run
-    if paths == ["opensearch_tpu"]:
-        stale = baseline.stale_entries(findings)
-        for e in stale:
-            print(f"stale baseline entry (debt paid — shrink its count or "
-                  f"remove it): {e['rule']} {e['path']} "
-                  f"[{e.get('symbol', '')}] {e.get('detail', '')} "
-                  f"count={e.get('count', 1)}")
+    for e in stale:
+        print(f"stale baseline entry (debt paid — shrink its count or "
+              f"remove it): {e['rule']} {e['path']} "
+              f"[{e.get('symbol', '')}] {e.get('detail', '')} "
+              f"count={e.get('count', 1)}")
 
     n_base = len(findings) - len(new)
     print(f"oslint: {len(new)} new finding(s), {n_base} baselined, "

@@ -214,3 +214,82 @@ class TestCluster:
                       if owners[shard_for(d, NSHARDS)] == "b")
         with pytest.raises((ApiError, OSError)):
             a.get("idx", some_b)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline regressions (OSL702): the state lock must never be held
+# across a member RPC send — a slow/dead member otherwise serializes every
+# join and search-route against the HTTP timeout. These reproduce the two
+# findings the oslint concurrency pass raised on this file (and fixed).
+# ---------------------------------------------------------------------------
+
+import threading
+
+import opensearch_tpu.cluster.distnode as dn_mod
+
+
+def _blocked_http(started, release):
+    def stub(addr, method, path, body=None, **kw):
+        started.set()
+        assert release.wait(15.0), "test forgot to release the RPC stub"
+        return {}
+    return stub
+
+
+def test_create_index_fans_out_rpcs_outside_state_lock(monkeypatch):
+    """While the member PUT fan-out is in flight (stub blocked), the
+    state lock must be free: concurrent joins/routes proceed."""
+    node = DistClusterNode("solo_ci")
+    started, release = threading.Event(), threading.Event()
+    try:
+        node.members["ghost"] = "127.0.0.1:1"
+        monkeypatch.setattr(dn_mod, "_http",
+                            _blocked_http(started, release))
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault(
+                "resp", node.create_index("idx_ci", MAPPING)))
+        t.start()
+        assert started.wait(10.0), "create_index never reached the RPC"
+        got = node._lock.acquire(timeout=2.0)
+        assert got, "state lock held across create_index RPC fan-out"
+        node._lock.release()
+        release.set()
+        t.join(15.0)
+        assert not t.is_alive()
+        # routing/copies snapshots taken under the lock stay coherent
+        assert out["resp"]["acknowledged"] is True
+        assert set(out["resp"]["routing"].values()) <= {"solo_ci", "ghost"}
+    finally:
+        release.set()
+        node.stop()
+
+
+def test_join_publishes_outside_state_lock(monkeypatch):
+    """While the join-triggered publish RPC is in flight (stub blocked),
+    the state lock must be free."""
+    node = DistClusterNode("solo_j")
+    started, release = threading.Event(), threading.Event()
+    try:
+        monkeypatch.setattr(dn_mod, "_http",
+                            _blocked_http(started, release))
+        out = {}
+        t = threading.Thread(
+            target=lambda: out.setdefault(
+                "resp", node.handle_internal(
+                    "POST", ["_internal", "join"],
+                    {"name": "ghost", "addr": "127.0.0.1:1"})))
+        t.start()
+        assert started.wait(10.0), "join never reached the publish RPC"
+        got = node._lock.acquire(timeout=2.0)
+        assert got, "state lock held across join publish RPC"
+        node._lock.release()
+        release.set()
+        t.join(15.0)
+        assert not t.is_alive()
+        status, resp = out["resp"]
+        assert status == 200
+        assert "ghost" in resp["state"]["members"]
+    finally:
+        release.set()
+        node.stop()

@@ -1,0 +1,236 @@
+"""Runtime lock-witness sanitizer (devtools/lockwitness.py).
+
+The witness is the execution half of the static lock-order contract:
+it must catch a seeded acquisition-order inversion under a 32-thread
+hammer (naming both stacks and freezing a flight-recorder dump), stay
+quiet on disciplined nesting, track reentrancy without false self
+edges, and join its runtime creation-site keys to the committed
+`lock_order.json` via verify_against().
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from opensearch_tpu.devtools import lockwitness
+from opensearch_tpu.obs.flight_recorder import RECORDER
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK_GRAPH = os.path.join(REPO_ROOT, "lock_order.json")
+
+
+@pytest.fixture()
+def witness():
+    st = lockwitness.install(strict=False)
+    lockwitness.reset()
+    yield st
+    lockwitness.uninstall()
+
+
+def _wrap_pair():
+    a = lockwitness.wrap(threading.Lock(), "fixture/seed.py:1")
+    b = lockwitness.wrap(threading.Lock(), "fixture/seed.py:2")
+    return a, b
+
+
+class TestWitnessCore:
+    def test_nesting_records_edges_with_stacks(self, witness):
+        a, b = _wrap_pair()
+        with a:
+            with b:
+                pass
+        es = lockwitness.edges()
+        assert ("fixture/seed.py:1", "fixture/seed.py:2") in es
+        info = es[("fixture/seed.py:1", "fixture/seed.py:2")]
+        assert "test_lockwitness" in info["stack"]
+        assert info["site"]
+        assert lockwitness.inversions() == []
+
+    def test_consistent_order_never_inverts(self, witness):
+        a, b = _wrap_pair()
+        for _ in range(100):
+            with a:
+                with b:
+                    pass
+        assert lockwitness.inversions() == []
+
+    def test_reentrant_rlock_no_self_edge(self, witness):
+        r = lockwitness.wrap(threading.RLock(), "fixture/seed.py:9")
+        with r:
+            with r:
+                pass
+        assert all(e[0] != e[1] for e in lockwitness.edges())
+        assert lockwitness.inversions() == []
+
+    def test_failed_try_acquire_not_recorded(self, witness):
+        a, b = _wrap_pair()
+        with a:
+            held_elsewhere = threading.Thread(target=b.acquire)
+            held_elsewhere.start()
+            held_elsewhere.join()
+            assert b.acquire(blocking=False) is False
+        b.release()
+        # the failed try-acquire must not have minted an (a, b) edge
+        assert ("fixture/seed.py:1", "fixture/seed.py:2") \
+            not in lockwitness.edges()
+
+    def test_seeded_inversion_caught_32_thread_hammer(self, witness):
+        """The acceptance fixture: 32 threads witness a->b, then 32
+        threads run the inverted order. The witness flags it, names
+        both stacks, and freezes a flight-recorder dump — without the
+        test ever risking the actual deadlock (the phases are
+        disjoint, so the inversion is latent, exactly the case only a
+        witness can catch)."""
+        a, b = _wrap_pair()
+        dumps0 = RECORDER.trigger_counts.get("lock_inversion", 0)
+
+        def run(first, second):
+            for _ in range(25):
+                with first:
+                    with second:
+                        pass
+
+        phase1 = [threading.Thread(target=run, args=(a, b))
+                  for _ in range(32)]
+        for t in phase1:
+            t.start()
+        for t in phase1:
+            t.join()
+        assert lockwitness.inversions() == []
+
+        phase2 = [threading.Thread(target=run, args=(b, a))
+                  for _ in range(32)]
+        for t in phase2:
+            t.start()
+        for t in phase2:
+            t.join()
+
+        inv = lockwitness.inversions()
+        assert inv, "witness missed the seeded inversion"
+        rec = inv[0]
+        assert {rec["first"], rec["second"]} \
+            == {"fixture/seed.py:1", "fixture/seed.py:2"}
+        # both conflicting code paths are named
+        assert rec["stack"] and rec["prior_stack"]
+        assert rec["site"] and rec["prior_site"]
+        assert rec["thread"] and rec["prior_thread"]
+        # and the black box froze (forced — never cooldown-suppressed)
+        if RECORDER.enabled:
+            assert RECORDER.trigger_counts.get("lock_inversion", 0) \
+                == dumps0 + 1
+            dump = [d for d in RECORDER.dumps()
+                    if d["reason"] == "lock_inversion"][-1]
+            evs = [e for tl in dump["timelines"].values()
+                   for e in tl["events"] if e["kind"] == "lock_inversion"]
+            assert evs and evs[0]["stack_now"] and evs[0]["stack_prior"]
+
+    def test_strict_mode_raises(self):
+        st = lockwitness.install(strict=True)
+        lockwitness.reset()
+        try:
+            a, b = _wrap_pair()
+            with a:
+                with b:
+                    pass
+            with pytest.raises(lockwitness.LockOrderInversion) as ei:
+                with b:
+                    with a:
+                        pass
+            assert "fixture/seed.py" in str(ei.value)
+            # the raise aborted mid-acquire: the inner lock is held but
+            # untracked — release it so nothing leaks into other tests
+            a.release()
+        finally:
+            lockwitness.uninstall()
+
+
+class TestInstallation:
+    def test_package_locks_wrapped_at_creation_site(self, witness):
+        # objects constructed while armed get witnessed locks whose key
+        # is the creation site — the join point to lock_order.json
+        from opensearch_tpu.serving.remediator import (RemediationConfig,
+                                                       Remediator)
+        from opensearch_tpu.utils.metrics import MetricsRegistry
+        rem = Remediator(RemediationConfig(), registry=MetricsRegistry())
+        assert isinstance(rem._lock, lockwitness.WitnessLock)
+        key = rem._lock._key
+        assert key.startswith("opensearch_tpu/serving/remediator.py:")
+        graph = json.load(open(LOCK_GRAPH))
+        declared = {l["declared"] for l in graph["locks"]}
+        assert key in declared, (
+            "witness creation-site key no longer joins to the static "
+            f"inventory: {key}")
+
+    def test_non_package_locks_stay_raw(self, witness):
+        lk = threading.Lock()  # created in tests/, not the package
+        assert not isinstance(lk, lockwitness.WitnessLock)
+
+    def test_uninstall_restores_factories(self):
+        lockwitness.install(strict=False)
+        assert getattr(threading.Lock, "_lockwitness", False)
+        lockwitness.uninstall()
+        assert not getattr(threading.Lock, "_lockwitness", False)
+        assert not lockwitness.active()
+
+    def test_env_activation(self):
+        """OPENSEARCH_TPU_LOCKWITNESS=1 arms the witness at package
+        import, before any submodule creates a lock."""
+        env = dict(os.environ,
+                   OPENSEARCH_TPU_LOCKWITNESS="1", JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import threading\n"
+             "import opensearch_tpu\n"
+             "from opensearch_tpu.devtools import lockwitness\n"
+             "assert lockwitness.active()\n"
+             "assert getattr(threading.Lock, '_lockwitness', False)\n"
+             "print('armed')"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "armed" in out.stdout
+
+
+class TestVerifyAgainst:
+    def test_conflict_unmodeled_unmapped(self, witness, tmp_path):
+        graph = {
+            "version": 1,
+            "locks": [
+                {"id": "m::A", "kind": "Lock",
+                 "declared": "fixture/seed.py:1"},
+                {"id": "m::B", "kind": "Lock",
+                 "declared": "fixture/seed.py:2"},
+                {"id": "m::C", "kind": "Lock",
+                 "declared": "fixture/seed.py:3"},
+            ],
+            "edges": [{"from": "m::A", "to": "m::B", "site": "s"}],
+            "cycles": [],
+        }
+        gp = tmp_path / "graph.json"
+        gp.write_text(json.dumps(graph))
+        a, b = _wrap_pair()
+        c = lockwitness.wrap(threading.Lock(), "fixture/seed.py:3")
+        u = lockwitness.wrap(threading.Lock(), "fixture/unknown.py:7")
+        with b:
+            with a:        # reverse of the committed A->B order
+                pass
+        with a:
+            with c:        # neither direction committed
+                pass
+        with a:
+            with u:        # endpoint the model never inventoried
+                pass
+        rep = lockwitness.verify_against(str(gp))
+        assert [(x["from_id"], x["to_id"])
+                for x in rep["order_conflicts"]] == [("m::B", "m::A")]
+        assert [(x["from_id"], x["to_id"])
+                for x in rep["unmodeled_edges"]] == [("m::A", "m::C")]
+        assert rep["unmapped"] == ["fixture/unknown.py:7"]
+
+    def test_committed_graph_loads(self, witness):
+        rep = lockwitness.verify_against(LOCK_GRAPH)
+        assert rep["order_conflicts"] == []

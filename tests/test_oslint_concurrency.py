@@ -1,0 +1,543 @@
+"""oslint concurrency suite — the whole-program OSL7xx pass
+(devtools/oslint/concurrency) and the committed lock-order artifact.
+
+Three jobs:
+1. Per-rule fixture pairs: each OSL7xx rule fires on the bug class it
+   was built for and stays quiet on the disciplined counterpart.
+2. Model fidelity: the inventory names the locks this repo actually
+   relies on; analysis output is deterministic.
+3. The tier-1 ratchet: the repo analyzes clean, and regenerating
+   `lock_order.json` reproduces the committed artifact byte-for-byte —
+   a new edge or cycle fails here until the artifact is regenerated
+   (scripts/oslint.py --write-lock-graph) and any cycle justified.
+"""
+
+import ast
+import json
+import os
+import textwrap
+
+from opensearch_tpu.devtools.oslint.concurrency import (
+    build_lock_order, build_program, diff_lock_order, run_program)
+from opensearch_tpu.devtools.oslint.concurrency.rules import (
+    UNJUSTIFIED, program_files)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOCK_GRAPH = os.path.join(REPO_ROOT, "lock_order.json")
+
+
+def prog_lint(*mods):
+    """Run the whole-program pass over (path, src) fixture modules."""
+    files = []
+    for path, src in mods:
+        src = textwrap.dedent(src)
+        files.append((path, ast.parse(src), src))
+    return run_program(files)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+P = "opensearch_tpu/serving/mod.py"
+
+
+# ----------------------------------------------------------------------
+# OSL701 — lock-order cycles & self-deadlock
+# ----------------------------------------------------------------------
+
+class TestCycleRule:
+    CYCLIC = """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+    """
+
+    def test_cycle_flagged(self):
+        prog, findings = prog_lint((P, self.CYCLIC))
+        assert "OSL701" in rules_of(findings)
+        (f,) = [f for f in findings if f.rule == "OSL701"]
+        assert f.detail.startswith("cycle:")
+        assert prog.cycles()  # and the graph exposes it for the artifact
+
+    def test_consistent_order_quiet(self):
+        src = """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def f():
+                with A:
+                    with B:
+                        pass
+
+            def g():
+                with A:
+                    with B:
+                        pass
+        """
+        prog, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+        assert prog.cycles() == []
+
+    def test_interprocedural_cycle_flagged(self):
+        # the order inversion crosses a function boundary: f holds A and
+        # calls helper (acquires B); g holds B and calls back into code
+        # that acquires A — no single function shows both orders
+        src = """
+            import threading
+
+            A = threading.Lock()
+            B = threading.Lock()
+
+            def take_b():
+                with B:
+                    pass
+
+            def take_a():
+                with A:
+                    pass
+
+            def f():
+                with A:
+                    take_b()
+
+            def g():
+                with B:
+                    take_a()
+        """
+        _, findings = prog_lint((P, src))
+        assert "OSL701" in rules_of(findings)
+
+    def test_self_deadlock_through_call(self):
+        # non-reentrant Lock re-acquired via a helper — the _BuildLock
+        # evictor-vs-builder reentrancy class (PR 11)
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def outer(self):
+                    with self._lock:
+                        self.helper()
+
+                def helper(self):
+                    with self._lock:
+                        pass
+        """
+        _, findings = prog_lint((P, src))
+        assert any(f.rule == "OSL701" and f.detail.startswith("self:")
+                   for f in findings)
+
+    def test_rlock_reacquire_quiet(self):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+
+                def outer(self):
+                    with self._lock:
+                        self.helper()
+
+                def helper(self):
+                    with self._lock:
+                        pass
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+
+# ----------------------------------------------------------------------
+# OSL702 — lock held across blocking operations
+# ----------------------------------------------------------------------
+
+class TestBlockingRule:
+    def test_rpc_under_lock_flagged(self):
+        # the _dispatch_lock / distnode.create_index class of bug
+        src = """
+            import threading
+            from urllib.request import urlopen
+
+            class Node:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.members = {}
+
+                def publish(self, state):
+                    with self._lock:
+                        for addr in self.members.values():
+                            urlopen(addr, state)
+        """
+        _, findings = prog_lint((P, src))
+        assert any(f.rule == "OSL702" and "urlopen" in f.msg
+                   for f in findings)
+
+    def test_rpc_under_lock_transitive_flagged(self):
+        # the blocking call hides one call-graph hop away
+        src = """
+            import threading
+            from urllib.request import urlopen
+
+            def _http(addr, body):
+                return urlopen(addr, body)
+
+            class Node:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.members = {}
+
+                def publish(self, state):
+                    with self._lock:
+                        for addr in self.members.values():
+                            _http(addr, state)
+        """
+        _, findings = prog_lint((P, src))
+        (f,) = [f for f in findings if f.rule == "OSL702"]
+        assert "_http" in f.msg  # the via-chain names the path
+
+    def test_snapshot_then_rpc_outside_quiet(self):
+        src = """
+            import threading
+            from urllib.request import urlopen
+
+            class Node:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.members = {}
+
+                def publish(self, state):
+                    with self._lock:
+                        targets = list(self.members.values())
+                    for addr in targets:
+                        urlopen(addr, state)
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+    def test_sleep_and_device_sync_under_lock_flagged(self):
+        src = """
+            import threading
+            import time
+            import jax
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poll(self, x):
+                    with self._lock:
+                        time.sleep(0.1)
+
+                def fetch(self, x):
+                    with self._lock:
+                        return jax.device_get(x)
+        """
+        _, findings = prog_lint((P, src))
+        ops = {f.detail for f in findings if f.rule == "OSL702"}
+        assert any("time.sleep" in o for o in ops)
+        assert any("device_get" in o for o in ops)
+
+    def test_condition_wait_on_own_lock_quiet(self):
+        # the scheduler pattern: waiting on the condition you hold
+        # RELEASES it — not a held-across-blocking bug
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def await_work(self):
+                    with self._cond:
+                        self._cond.wait(0.1)
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+    def test_foreign_event_wait_under_lock_flagged(self):
+        src = """
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._done = threading.Event()
+
+                def drain(self):
+                    with self._lock:
+                        self._done.wait(5.0)
+        """
+        _, findings = prog_lint((P, src))
+        assert any(f.rule == "OSL702" and "wait" in f.msg
+                   for f in findings)
+
+    def test_inline_suppression_honored(self):
+        src = """
+            import threading
+            import time
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def poll(self):
+                    with self._lock:
+                        time.sleep(0.1)  # oslint: disable=OSL702 -- test
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+
+# ----------------------------------------------------------------------
+# OSL703 — cross-thread unlocked writes
+# ----------------------------------------------------------------------
+
+class TestCrossThreadRule:
+    RACY = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.stats = {}
+                self._t1 = threading.Thread(target=self._loop)
+                self._t2 = threading.Thread(target=self._drain)
+
+            def _loop(self):
+                self.stats["in"] = 1
+
+            def _drain(self):
+                self.stats["out"] = 2
+    """
+
+    def test_two_roots_unlocked_write_flagged(self):
+        _, findings = prog_lint((P, self.RACY))
+        (f,) = [f for f in findings if f.rule == "OSL703"]
+        assert f.detail == "xthread:Worker.stats"
+
+    def test_locked_writes_quiet(self):
+        src = """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.stats = {}
+                    self._t1 = threading.Thread(target=self._loop)
+                    self._t2 = threading.Thread(target=self._drain)
+
+                def _loop(self):
+                    with self._lock:
+                        self.stats["in"] = 1
+
+                def _drain(self):
+                    with self._lock:
+                        self.stats["out"] = 2
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+    def test_single_root_quiet(self):
+        # one thread-entry root: no cross-thread interleaving to guard
+        src = """
+            import threading
+
+            class Worker:
+                def __init__(self):
+                    self.stats = {}
+                    self._t1 = threading.Thread(target=self._loop)
+
+                def _loop(self):
+                    self.stats["in"] = 1
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+    def test_listener_registration_is_a_root(self):
+        # remediator-style: a callback registered on another component
+        # runs on that component's thread
+        src = """
+            import threading
+
+            class Healer:
+                def __init__(self, alerts):
+                    self.active = {}
+                    alerts.add_listener(self.on_alert)
+                    self._t = threading.Thread(target=self._tick)
+
+                def on_alert(self, a):
+                    self.active[a] = 1
+
+                def _tick(self):
+                    self.active.clear()
+        """
+        _, findings = prog_lint((P, src))
+        assert any(f.rule == "OSL703"
+                   and f.detail == "xthread:Healer.active"
+                   for f in findings)
+
+
+# ----------------------------------------------------------------------
+# OSL704 — check-then-act atomicity splits
+# ----------------------------------------------------------------------
+
+class TestCheckThenActRule:
+    def test_locked_check_unlocked_act_flagged(self):
+        # the RequestCache.put eviction-race class (PR 8): the test and
+        # the mutation straddle the lock region boundary
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.entries = {}
+
+                def evict(self, k):
+                    found = False
+                    with self._lock:
+                        if k in self.entries:
+                            found = True
+                    if found:
+                        self.entries.pop(k)
+        """
+        _, findings = prog_lint((P, src))
+        (f,) = [f for f in findings if f.rule == "OSL704"]
+        assert f.detail == "cta:Cache.entries"
+
+    def test_check_and_act_same_region_quiet(self):
+        src = """
+            import threading
+
+            class Cache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.entries = {}
+
+                def evict(self, k):
+                    with self._lock:
+                        if k in self.entries:
+                            self.entries.pop(k)
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+    def test_lockless_class_quiet(self):
+        # only lock-bearing classes promise atomicity; a plain
+        # single-threaded container class is out of scope
+        src = """
+            class Cache:
+                def __init__(self):
+                    self.entries = {}
+
+                def evict(self, k):
+                    if k in self.entries:
+                        self.entries.pop(k)
+        """
+        _, findings = prog_lint((P, src))
+        assert rules_of(findings) == []
+
+
+# ----------------------------------------------------------------------
+# model fidelity + determinism
+# ----------------------------------------------------------------------
+
+class TestModel:
+    def test_known_locks_inventoried(self):
+        graph = json.load(open(LOCK_GRAPH))
+        ids = {l["id"] for l in graph["locks"]}
+        for want in (
+            "opensearch_tpu/cluster/distnode.py::DistClusterNode._lock",
+            "opensearch_tpu/serving/remediator.py::Remediator._lock",
+            "opensearch_tpu/serving/scheduler.py::ServingScheduler._cond",
+            "opensearch_tpu/parallel/service.py::"
+            "MeshSearchService._dispatch_lock",
+            "opensearch_tpu/obs/hbm_ledger.py::HBMLedger._lock",
+            "attr::_device_build_lock",
+        ):
+            assert want in ids, f"lock inventory lost {want}"
+
+    def test_every_lock_has_declaration_site(self):
+        graph = json.load(open(LOCK_GRAPH))
+        missing = [l["id"] for l in graph["locks"] if not l["declared"]]
+        assert missing == [], (
+            "locks without a declaration site cannot be joined to the "
+            f"runtime witness: {missing}")
+
+    def test_analysis_deterministic(self):
+        files = program_files(REPO_ROOT)
+        prog1, f1 = run_program(files)
+        prog2, f2 = run_program(files)
+        assert [f.render() for f in f1] == [f.render() for f in f2]
+        g1 = build_lock_order(prog1)
+        g2 = build_lock_order(prog2)
+        assert json.dumps(g1, sort_keys=True) \
+            == json.dumps(g2, sort_keys=True)
+
+
+# ----------------------------------------------------------------------
+# the tier-1 ratchet
+# ----------------------------------------------------------------------
+
+class TestLockOrderRatchet:
+    def test_artifact_matches_tree(self):
+        """Regenerating the graph from the current tree must reproduce
+        the committed artifact exactly. A diff here means the lock
+        surface changed: run `python scripts/oslint.py
+        --write-lock-graph`, review the new edges/cycles in the diff,
+        and justify any cycle inline before committing."""
+        committed = json.load(open(LOCK_GRAPH))
+        just = {"|".join(sorted(c["members"])): c["justification"]
+                for c in committed.get("cycles", [])}
+        prog = build_program(program_files(REPO_ROOT))
+        current = build_lock_order(prog, justifications=just)
+        d = diff_lock_order(committed, current)
+        assert d["new_edges"] == [], (
+            "NEW lock-order edge(s) — regenerate lock_order.json and "
+            f"review: {d['new_edges']}")
+        assert d["new_cycles"] == [], (
+            "NEW lock-order cycle(s) (potential deadlock) — break the "
+            f"order or justify: {d['new_cycles']}")
+        assert d["stale_edges"] == [], (
+            "committed graph has edges the tree no longer exhibits — "
+            f"regenerate lock_order.json: {d['stale_edges']}")
+        assert current == committed, (
+            "lock_order.json drifted from the tree — regenerate with "
+            "scripts/oslint.py --write-lock-graph and review the diff")
+
+    def test_every_committed_cycle_justified(self):
+        committed = json.load(open(LOCK_GRAPH))
+        bad = [c["members"] for c in committed.get("cycles", [])
+               if not c.get("justification")
+               or c["justification"].startswith("UNJUSTIFIED")]
+        assert bad == [], f"unjustified lock-order cycle(s): {bad}"
+
+    def test_diff_semantics(self):
+        old = {"locks": [], "edges": [{"from": "a", "to": "b",
+                                       "site": "s"}],
+               "cycles": [{"members": ["a", "b"],
+                           "justification": UNJUSTIFIED}]}
+        new = {"locks": [], "edges": [{"from": "b", "to": "c",
+                                       "site": "t"}],
+               "cycles": [{"members": ["a", "b"],
+                           "justification": UNJUSTIFIED}]}
+        d = diff_lock_order(old, new)
+        assert d["new_edges"] == [{"from": "b", "to": "c", "site": "t"}]
+        assert d["stale_edges"] == [{"from": "a", "to": "b"}]
+        assert d["new_cycles"] == [] and d["stale_cycles"] == []
+        assert d["unjustified_cycles"] == [["a", "b"]]
