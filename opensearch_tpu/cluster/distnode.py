@@ -373,16 +373,29 @@ class DistClusterNode:
     # ---------------- state machine ----------------
 
     def _state(self) -> dict:
-        return {"term": self.term, "version": self.version,
-                "leader": self.leader, "members": self.members,
-                "routing": {i: {str(s): n for s, n in r.items()}
-                            for i, r in self.routing.items()},
-                "copies": {i: {str(s): list(c) for s, c in r.items()}
-                           for i, r in self.copies.items()},
-                "index_bodies": self.index_bodies}
+        # snapshot under the (reentrant) state lock, copying the member
+        # and body maps: publishes json.dumps this dict OUTSIDE the lock
+        # (OSL702 fan-out), so handing out live references let a
+        # concurrent join blow up the serializer ("dict changed size
+        # during iteration") or ship different member sets per target
+        with self._lock:
+            return {"term": self.term, "version": self.version,
+                    "leader": self.leader, "members": dict(self.members),
+                    "routing": {i: {str(s): n for s, n in r.items()}
+                                for i, r in self.routing.items()},
+                    "copies": {i: {str(s): list(c) for s, c in r.items()}
+                               for i, r in self.copies.items()},
+                    "index_bodies": dict(self.index_bodies)}
 
     def _apply_state(self, st: dict) -> None:
         with self._lock:
+            # Publish fan-outs run unserialized (outside the state
+            # lock), so a slow send can deliver version N after a fast
+            # one delivered N+1; applying it would regress to stale
+            # state and silently drop the newer member/index. Ignore
+            # anything not strictly newer (a higher term always wins).
+            if (st["term"], st["version"]) <= (self.term, self.version):
+                return
             self.term = st["term"]
             self.version = st["version"]
             self.leader = st["leader"]

@@ -226,7 +226,17 @@ class WitnessLock:
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         ok = self._inner.acquire(blocking, timeout)
         if ok:
-            _note_acquired(self._key)
+            try:
+                _note_acquired(self._key)
+            except LockOrderInversion:
+                # strict mode raises out of the bookkeeping AFTER the
+                # inner lock was taken; propagating without releasing
+                # would leave it held forever — turning the report into
+                # the very deadlock it exists to prevent. The key was
+                # never pushed onto the thread's held stack (the raise
+                # happens before the append), so no _note_released here.
+                self._inner.release()
+                raise
         return ok
 
     def release(self) -> None:

@@ -293,3 +293,63 @@ def test_join_publishes_outside_state_lock(monkeypatch):
     finally:
         release.set()
         node.stop()
+
+
+def test_apply_state_ignores_stale_version():
+    """Publishes fan out unserialized (outside the state lock), so a
+    slow send can deliver version N after a fast one delivered N+1.
+    Applying the late post must not regress members/routing — the
+    reviewer-found regression: the new member silently vanished."""
+    node = DistClusterNode("solo_mono")
+    try:
+        newer = {"term": 1, "version": 5, "leader": "ldr",
+                 "members": {"solo_mono": node.addr,
+                             "ldr": "127.0.0.1:1",
+                             "new_member": "127.0.0.1:2"},
+                 "routing": {}, "copies": {}, "index_bodies": {}}
+        node._apply_state(newer)
+        assert node.version == 5
+        assert "new_member" in node.members
+
+        stale = {"term": 1, "version": 4, "leader": "ldr",
+                 "members": {"solo_mono": node.addr, "ldr": "127.0.0.1:1"},
+                 "routing": {}, "copies": {}, "index_bodies": {}}
+        node._apply_state(stale)   # late delivery of the older post
+        assert node.version == 5, "stale publish regressed the version"
+        assert "new_member" in node.members, \
+            "stale publish silently dropped the newer member"
+
+        # equal version: redelivery of the same post is ignored too
+        node._apply_state(dict(newer, members={}))
+        assert "new_member" in node.members
+
+        # a higher term always wins, regardless of version (new leader
+        # restarting the version sequence)
+        node._apply_state({"term": 2, "version": 1, "leader": "ldr2",
+                           "members": {"ldr2": "127.0.0.1:3"},
+                           "routing": {}, "copies": {},
+                           "index_bodies": {}})
+        assert node.term == 2 and node.version == 1
+        assert node.leader == "ldr2"
+    finally:
+        node.stop()
+
+
+def test_state_snapshot_isolated_from_concurrent_mutation():
+    """_publish serializes the _state() snapshot OUTSIDE the lock; the
+    snapshot must not alias the live member/body maps, or a concurrent
+    join mid-json.dumps raises "dict changed size during iteration"
+    (and different targets receive different member sets)."""
+    import json as _json
+    node = DistClusterNode("solo_snap")
+    try:
+        node.index_bodies["idx_snap"] = {"settings": {}}
+        st = node._state()
+        # mutate the live maps after the snapshot was taken
+        node.members["late_joiner"] = "127.0.0.1:9"
+        node.index_bodies["idx_late"] = {"settings": {}}
+        assert "late_joiner" not in st["members"]
+        assert "idx_late" not in st["index_bodies"]
+        _json.dumps(st)  # the fan-out serialization the snapshot feeds
+    finally:
+        node.stop()

@@ -141,9 +141,17 @@ class TestWitnessCore:
                     with a:
                         pass
             assert "fixture/seed.py" in str(ei.value)
-            # the raise aborted mid-acquire: the inner lock is held but
-            # untracked — release it so nothing leaks into other tests
-            a.release()
+            # regression: the strict raise fires AFTER the inner lock
+            # was taken; acquire() must release it before propagating,
+            # or the diagnostic leaves `a` held forever and converts
+            # the report into the very deadlock it exists to prevent
+            assert not a.locked(), \
+                "strict-mode raise leaked the inner lock"
+            assert not b.locked()
+            # the aborted acquire left no phantom entry on the held
+            # stack (the key is only pushed after the order checks), so
+            # the thread ends the episode holding nothing
+            assert st.held() == []
         finally:
             lockwitness.uninstall()
 
