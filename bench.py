@@ -758,7 +758,194 @@ def measure_hybrid(log, ndocs: int = 30_000, nq: int = 256,
             "equal_top10": bool(equal_top10),
         },
     }
+    # parallel-legs A/B (ISSUE 17): failure is a FAILED gate, never a
+    # silently-missing one
+    try:
+        out["legs_ab"] = measure_legs_ab(log)
+        for k, v in out["legs_ab"]["gates"].items():
+            out["gates"][f"legs_{k}" if not k.startswith("legs_")
+                         else k] = v
+    except Exception as e:                               # noqa: BLE001
+        out["legs_ab"] = {"status":
+                          f"failed: {type(e).__name__}: {e}"}
+        out["gates"]["legs_p50_le_0p6x_serial"] = False
+        out["gates"]["legs_pages_byte_identical"] = False
     return out
+
+
+def measure_legs_ab(log, ndocs: int = 4000, nq: int = 32,
+                    seed: int = 13, member_delay_ms: float = 10.0):
+    """Parallel-legs A/B (ISSUE 17) — the `extra.hybrid.legs_ab` cell.
+
+    The legs primitive turns the two serving hot loops from SUM-shaped
+    to MAX-shaped latency: hybrid sub-retrievals and the cross-node
+    scatter fan out concurrently. The topology is the one the feature
+    exists for: a 3-PROCESS cluster (in-process coordinator + two
+    `tests/_dist_child.py` members) where every remote leg is a socket
+    wait on another process's CPU.
+
+    Member service latency is MODELED, and the cell says so: the
+    product's own chaos `delay` rule holds every member RPC
+    `member_delay_ms` (a LAN/cross-AZ-shaped round trip; at bench-cell
+    corpus sizes real member service time is microseconds, so with 0 ms
+    modeled latency the measurement degenerates into a benchmark of the
+    coordinator's GIL-bound JSON marshalling — reported anyway as
+    `no_delay` for honesty). Serial pays the delay once per RPC
+    (~9 member RPCs per sub-retrieval), legs pay it once per join
+    layer. A ≥3-sub hybrid mix runs a single-caller closed loop
+    (latency regime, not saturation) with `OPENSEARCH_TPU_LEGS` flipped
+    per arm, alternating arms best-of-2 against box noise. Gates:
+    fused-mix p50 with legs ≤ 0.6× serial, and the first 16 result
+    pages byte-identical across arms (parity pass runs chaos-free)."""
+    import random as _random
+    import subprocess
+
+    from opensearch_tpu.cluster import faults
+    from opensearch_tpu.cluster.distnode import DistClusterNode
+    from opensearch_tpu.utils.metrics import METRICS
+
+    rng = _random.Random(seed)
+    t0 = time.time()
+    coord = DistClusterNode("bl0")
+    children = []
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # children must not init the TPU
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        for name in ("bl1", "bl2"):
+            p = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(_REPO, "tests", "_dist_child.py"),
+                 coord.addr, name],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env, cwd=_REPO)
+            children.append(p)
+        for p in children:
+            line = p.stdout.readline()
+            assert line.startswith("READY"), f"child failed: {line!r}"
+        deadline = time.time() + 30
+        while len(coord.members) < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        assert len(coord.members) == 3, coord.members
+
+        feats = [f"t{i}" for i in range(300)]
+        fw = [1.0 / (r ** 1.1) for r in range(1, len(feats) + 1)]
+        vocab = [f"w{i}" for i in range(800)]
+        coord.create_index("legsb", {
+            "settings": {"number_of_shards": 6,
+                         "number_of_node_replicas": 0},
+            "mappings": {"properties": {
+                "body": {"type": "text"},
+                "emb": {"type": "rank_features", "index_impacts": True},
+                "vec": {"type": "dense_vector", "dims": 32,
+                        "similarity": "cosine"}}}})
+        for i in range(ndocs):
+            coord.index_doc("legsb", {
+                "body": " ".join(rng.choices(vocab, k=8)),
+                "emb": {t: round(rng.expovariate(1.0) + 0.05, 3)
+                        for t in rng.choices(feats, weights=fw, k=6)},
+                "vec": [rng.gauss(0.0, 1.0) for _ in range(32)]},
+                id=str(i))
+        coord.refresh("legsb")
+        build_s = time.time() - t0
+
+        def qtokens():
+            head = rng.sample(feats[120:], 3)
+            tail = list(dict.fromkeys(
+                rng.choices(feats[:100], weights=fw[:100], k=8)))
+            toks = {}
+            for r, t in enumerate(head):
+                toks[t] = round(3.0 / (r + 1), 3)
+            for r, t in enumerate(tail):
+                toks.setdefault(t, round(0.25 / (1 + r) + 0.02, 3))
+            return toks
+
+        bodies = [{"query": {"hybrid": {"queries": [
+            {"match": {"body": " ".join(rng.choices(vocab[:400], k=3))}},
+            {"neural_sparse": {"emb": {"query_tokens": qtokens()}}},
+            {"knn": {"vec": {"vector":
+                             [round(rng.gauss(0.0, 1.0), 4)
+                              for _ in range(32)], "k": 20}}}],
+            "fusion": {"method": "rrf", "rank_constant": 60,
+                       "window_size": 50}}}, "size": 10}
+            for _ in range(nq)]
+
+        METRICS.histogram("legs.warm").record(1.0)   # DDSketch warmup
+
+        def page(resp):
+            return json.dumps(
+                [(h["_id"], h["_score"])
+                 for h in resp["hits"]["hits"]], sort_keys=True)
+
+        def run_arm(flag):
+            os.environ["OPENSEARCH_TPU_LEGS"] = flag
+            lats = []
+            for b in bodies:
+                t1 = time.perf_counter()
+                coord.search("legsb", b)
+                lats.append((time.perf_counter() - t1) * 1000.0)
+            return lats
+
+        # warm every process's compiled programs on both arms
+        for flag in ("1", "0"):
+            os.environ["OPENSEARCH_TPU_LEGS"] = flag
+            for b in bodies[:12]:
+                coord.search("legsb", b)
+
+        def measure(delay_ms):
+            if delay_ms > 0:
+                faults.install(faults.ChaosSchedule(seed=0).add(
+                    "rpc.send", "delay", after=1,
+                    delay_s=delay_ms / 1000.0))
+            try:
+                arms = {"1": None, "0": None}
+                for flag in ("0", "1", "0", "1"):   # alternate, best-of-2
+                    lats = run_arm(flag)
+                    p50 = pct(lats, 50)
+                    if arms[flag] is None or p50 < arms[flag]["p50_ms"]:
+                        arms[flag] = {"p50_ms": round(p50, 2),
+                                      "p99_ms": round(pct(lats, 99), 2)}
+            finally:
+                faults.uninstall()
+            ratio = arms["1"]["p50_ms"] / max(arms["0"]["p50_ms"], 1e-9)
+            return {"legs_on": arms["1"], "serial": arms["0"],
+                    "p50_ratio_legs_over_serial": round(ratio, 3)}
+
+        delayed = measure(member_delay_ms)
+        no_delay = measure(0.0)
+        pages = {}
+        for flag in ("1", "0"):
+            os.environ["OPENSEARCH_TPU_LEGS"] = flag
+            pages[flag] = [page(coord.search("legsb", b))
+                           for b in bodies[:16]]
+        os.environ.pop("OPENSEARCH_TPU_LEGS", None)
+        ratio = delayed["p50_ratio_legs_over_serial"]
+        out = {
+            "topology": "3-process (coordinator + 2 members), 6 shards",
+            "ndocs": ndocs, "nq": nq, "subs_per_query": 3,
+            "member_delay_ms": member_delay_ms,
+            "corpus_build_s": round(build_s, 1),
+            **delayed,
+            "no_delay": no_delay,
+            "pages_byte_identical": pages["1"] == pages["0"],
+            "gates": {
+                "legs_p50_le_0p6x_serial": ratio <= 0.6,
+                "pages_byte_identical": pages["1"] == pages["0"],
+            },
+        }
+        log(f"legs A/B ({member_delay_ms}ms member delay): p50 "
+            f"{delayed['legs_on']['p50_ms']}ms (legs) vs "
+            f"{delayed['serial']['p50_ms']}ms (serial), ratio "
+            f"{ratio:.3f}; no-delay ratio "
+            f"{no_delay['p50_ratio_legs_over_serial']:.3f}; pages "
+            f"identical={out['pages_byte_identical']}")
+        return out
+    finally:
+        for p in children:
+            p.kill()
+        for p in children:
+            p.wait(timeout=10)
+        coord.stop()
 
 
 def pick_queries_equal_idf(df_per_term, nq: int, nterms: int = 4,

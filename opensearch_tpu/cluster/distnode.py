@@ -71,6 +71,7 @@ from __future__ import annotations
 
 import base64
 import contextlib
+import contextvars
 import json
 import os
 import pickle
@@ -89,6 +90,7 @@ from ..search.aggregations import parse_aggs
 from ..search.executor import (Candidate, ShardQueryResult,
                                _global_stats_contexts, reduce_shard_results)
 from ..utils import deadline as _dl
+from ..utils import legs as _legs
 from . import faults as _faults
 from .failure import MemberFailureDetector
 from .node import Node
@@ -106,6 +108,19 @@ _RPC_TIMEOUT_CAP_S = float(os.environ.get("OPENSEARCH_TPU_RPC_CAP_S",
 # coordinator for the full transport cap. A live request deadline still
 # tightens it further (deadline-ctx rides the scrape like any RPC).
 _SCRAPE_CAP_S = float(os.environ.get("OPENSEARCH_TPU_SCRAPE_CAP_S", 5.0))
+
+# Failure-detector snapshot for one top-level request.  A hybrid body
+# fans its sub-retrievals out as parallel legs; each sub-search plans
+# its scatter from the detector-deprioritized member set, and a plan
+# taken mid-request would otherwise depend on WHEN a sibling leg's
+# failure landed in the detector — a thread race.  The hybrid entry
+# point snapshots the set once, and every leg (the contextvar rides
+# the leg's captured context) plans against that same view, so the
+# serial and parallel arms issue the same RPCs and seeded chaos
+# journals stay byte-identical across arms.  Mid-request failures
+# still drive retries/failover through the per-request plan state.
+_fd_snap: contextvars.ContextVar[Optional[frozenset]] = \
+    contextvars.ContextVar("ostpu_fd_snapshot", default=None)
 
 
 class RetryPolicy:
@@ -153,9 +168,13 @@ class _ShardCallFailed(Exception):
 
 class _RequestState:
     """Per-request resilience accounting: the deadline, the shared retry
-    budget, the deterministic backoff RNG (seeded from the installed
-    chaos schedule so replayed interleavings draw identical jitter), and
-    the flags/failure reasons the response assembly reads."""
+    budget, the deterministic backoff RNGs, and the flags/failure
+    reasons the response assembly reads. Member legs of one request run
+    CONCURRENTLY (utils/legs.py), so the retry budget is taken under a
+    lock and the backoff jitter is drawn from a per-(member, leg) RNG
+    seeded from the installed chaos schedule via a stable hash — thread
+    interleaving can change neither a leg's jitter sequence nor a
+    replay's."""
 
     def __init__(self, policy: RetryPolicy, dl, tl: int):
         self.policy = policy
@@ -166,7 +185,9 @@ class _RequestState:
         self.timed_out = False
         self.storm_fired = False
         sched = _faults.installed()
-        self.rng = random.Random(sched.seed if sched is not None else None)
+        self._chaos_seed = sched.seed if sched is not None else None
+        self._lock = threading.Lock()
+        self._rngs: Dict[tuple, random.Random] = {}
 
     def rpc_timeout_s(self) -> float:
         if self.dl is None:
@@ -174,19 +195,37 @@ class _RequestState:
         return self.dl.rpc_timeout_s(_RPC_TIMEOUT_CAP_S)
 
     def take_retry(self) -> bool:
-        if self.retries >= self.policy.budget:
-            return False
-        self.retries += 1
-        return True
+        with self._lock:
+            if self.retries >= self.policy.budget:
+                return False
+            self.retries += 1
+            return True
 
-    def backoff_s(self, attempt: int) -> float:
+    def _rng_for(self, member: Optional[str]) -> random.Random:
+        key = (member, _legs.current_path())
+        with self._lock:
+            rng = self._rngs.get(key)
+            if rng is None:
+                if self._chaos_seed is None:
+                    rng = random.Random()
+                else:
+                    import hashlib
+                    h = hashlib.sha256(
+                        f"{self._chaos_seed}|{key[0]}|{key[1]}"
+                        .encode()).digest()
+                    rng = random.Random(int.from_bytes(h[:8], "big"))
+                self._rngs[key] = rng
+            return rng
+
+    def backoff_s(self, attempt: int,
+                  member: Optional[str] = None) -> float:
         """Full-jitter exponential backoff, bounded by the cap and by
         the remaining deadline (never sleep past the budget)."""
         p = self.policy
         ceil = min(p.base_backoff_s * (p.backoff_mult ** max(attempt - 1,
                                                              0)),
                    p.max_backoff_s)
-        b = self.rng.uniform(0.0, ceil)
+        b = self._rng_for(member).uniform(0.0, ceil)
         if self.dl is not None:
             b = min(b, max(self.dl.remaining_s(), 0.0))
         return b
@@ -689,7 +728,7 @@ class DistClusterNode:
             if attempts > rs.policy.same_member_retries \
                     or not rs.take_retry():
                 raise _ShardCallFailed(member, kind, attempts)
-            backoff = rs.backoff_s(attempts)
+            backoff = rs.backoff_s(attempts, member=member)
             METRICS.counter("dist.rpc.retry").inc()
             METRICS.histogram("dist.rpc.backoff_ms").record(
                 backoff * 1000.0)
@@ -1034,12 +1073,18 @@ class DistClusterNode:
                        run_remote) -> Tuple[Dict[int, object],
                                             Dict[int, str]]:
         """Run one phase over `shards`: group by each shard's preferred
-        live copy, serve self-legs locally, RPC the rest, and on a
-        member's terminal failure FAIL each of its shards OVER to the
-        next copy in `plan` (mutated in place so later phases inherit
-        the discovered topology). A shard with no copies left lands in
-        `failures` with its per-shard reason. Returns (per-shard
-        outputs, per-shard serving member)."""
+        live copy, fan every member group of the round out as one
+        parallel leg (`utils/legs.py` — self-legs run locally, the rest
+        RPC), JOIN, and on a member's terminal failure FAIL each of its
+        shards OVER to the next copy in `plan` (mutated in place so
+        later phases inherit the discovered topology). A shard with no
+        copies left lands in `failures` with its per-shard reason.
+        Round latency is the MAX of the member legs, not the SUM; the
+        failover re-planning between rounds runs on THIS thread in
+        sorted member order, so plan mutation and failure bookkeeping
+        stay exactly as deterministic as the serial loop
+        (`OPENSEARCH_TPU_LEGS=0`). Returns (per-shard outputs,
+        per-shard serving member)."""
         from ..obs import flight_recorder as _fr
         from ..utils.metrics import METRICS
         outputs: Dict[int, object] = {}
@@ -1050,51 +1095,74 @@ class DistClusterNode:
             for s in pending:
                 groups.setdefault(plan[s][0], []).append(s)
             next_pending: List[int] = []
-            for member in sorted(groups):
+            members = sorted(groups)
+            ls = _legs.LegSet(f"dist.{op}")
+            for member in members:
                 mshards = sorted(groups[member])
-                try:
+
+                def leg(member=member, mshards=mshards):
                     if rs.dl is not None and rs.dl.exhausted():
-                        rs.timed_out = True
                         raise _dl.DeadlineExhausted(
                             f"[{op}] budget exhausted")
                     if member == self.name:
-                        res = run_local(mshards)
-                    else:
-                        res = run_remote(member, mshards)
-                except _dl.DeadlineExhausted:
-                    # terminal for the whole phase: every still-pending
-                    # shard fails with a timeout reason — within budget,
-                    # never a transport-cap stall
+                        return run_local(mshards)
+                    return run_remote(member, mshards)
+                ls.add_leg(leg, name=member)
+            deadline_hit = False
+            for member, leg_out in zip(members, ls.join()):
+                mshards = sorted(groups[member])
+                err = leg_out.error
+                if err is None:
+                    res = leg_out.value
+                    for s in mshards:
+                        outputs[s] = res[s]
+                        assigned[s] = member
+                elif isinstance(err, (_dl.DeadlineExhausted,
+                                      _legs.LegWedged)):
+                    # terminal for the whole phase: this leg's shards
+                    # fail with a timeout reason — within budget, never
+                    # a transport-cap stall. Sibling legs that DID
+                    # complete keep their results (the serial arm would
+                    # simply never have attempted them), and no further
+                    # failover round starts (below).
                     rs.timed_out = True
-                    for s in mshards + next_pending + [
-                            s2 for m2 in sorted(groups)
-                            if m2 > member for s2 in groups[m2]]:
+                    deadline_hit = True
+                    for s in mshards:
                         failures.setdefault(s, {
                             "type": "timeout_exception",
                             "node": plan[s][0] if plan[s] else None,
                             "reason": "request budget exhausted"})
-                    return outputs, assigned
-                except _ShardCallFailed as e:
+                elif isinstance(err, _ShardCallFailed):
                     for s in mshards:
-                        plan[s] = [m for m in plan[s] if m != e.member]
+                        plan[s] = [m for m in plan[s] if m != err.member]
                         if plan[s]:
                             rs.failovers += 1
                             METRICS.counter("dist.rpc.failover").inc()
                             if rs.tl:
                                 _fr.RECORDER.record(
                                     rs.tl, "rpc.failover", op=op,
-                                    shard=s, from_node=e.member,
+                                    shard=s, from_node=err.member,
                                     to_node=plan[s][0])
                             next_pending.append(s)
                         else:
                             METRICS.counter("dist.shard_failed").inc()
-                            failures[s] = {"type": e.kind,
-                                           "node": e.member,
-                                           "attempts": e.attempts}
-                    continue
-                for s in mshards:
-                    outputs[s] = res[s]
-                    assigned[s] = member
+                            failures[s] = {"type": err.kind,
+                                           "node": err.member,
+                                           "attempts": err.attempts}
+                else:
+                    # genuine API/coordinator errors propagate exactly
+                    # as they did from the serial loop (first in member
+                    # order)
+                    raise err
+            if deadline_hit or (next_pending and rs.dl is not None
+                                and rs.dl.exhausted()):
+                rs.timed_out = True
+                for s in next_pending:
+                    failures.setdefault(s, {
+                        "type": "timeout_exception",
+                        "node": plan[s][0] if plan[s] else None,
+                        "reason": "request budget exhausted"})
+                return outputs, assigned
             pending = next_pending
         return outputs, assigned
 
@@ -1132,8 +1200,13 @@ class DistClusterNode:
                 hq = fusion.parse_hybrid(body)
             except dsl.QueryParseError as e:
                 raise ApiError(400, "parsing_exception", str(e))
-            return fusion.run_hybrid(
-                body, lambda sub: self._search_traced(index, sub), q=hq)
+            tok = _fd_snap.set(frozenset(self.member_fd.deprioritized()))
+            try:
+                return fusion.run_hybrid(
+                    body, lambda sub: self._search_traced(index, sub),
+                    q=hq)
+            finally:
+                _fd_snap.reset(tok)
         t0 = time.monotonic()
         agg_nodes = self._check_supported(body)
         svc = self.node.indices.get(index)
@@ -1146,8 +1219,13 @@ class DistClusterNode:
         # per-request copy preference: configured order with
         # detector-deprioritized members demoted; the scatter phases
         # mutate the plan as they discover dead copies, so later phases
-        # inherit the topology the earlier ones learned
-        depri = self.member_fd.deprioritized()
+        # inherit the topology the earlier ones learned.  Inside a
+        # hybrid fan-out, every sub-retrieval plans from the snapshot
+        # taken at the hybrid entry (see _fd_snap) rather than a
+        # mid-request read that would race with sibling legs.
+        snap = _fd_snap.get()
+        depri = set(snap) if snap is not None \
+            else self.member_fd.deprioritized()
         plan = {s: order_copies(copies.get(s, [self.name]), depri)
                 for s in range(n_shards)}
         rs = _RequestState(self.retry_policy, _dl.current(),
@@ -1205,46 +1283,66 @@ class DistClusterNode:
         hits_by_key: Dict[Tuple, dict] = {}
         with TRACER.span("dist.fetch", shards=len(by_shard)), \
                 METRICS.timer("dist.fetch"):
-            for s_id, sel in sorted(by_shard.items()):
+            # one leg per shard (fetch has no failover — retries in
+            # place, copy affinity): legs overlap the per-copy fetch
+            # RPCs, the per-shard failure bookkeeping below runs on
+            # this thread in shard order
+            fetch_items = sorted(by_shard.items())
+            fls = _legs.LegSet("dist.fetch")
+            for s_id, sel in fetch_items:
                 owner = q_assigned.get(s_id, self.name)
-                if owner == self.name:
-                    sr = self.node.indices[index].searchers[s_id]
-                    segs = (list(sr.replica.segments)
-                            if sr.replica is not None
-                            else list(sr.engine.segments))
-                    res = ShardQueryResult(shard=s_id, segments=segs)
-                    fetched = sr.fetch_phase(
-                        res, sel, dict(body),
-                        stats_ctx=self._global_ctx(index, g))
-                else:
+
+                def fleg(s_id=s_id, sel=sel, owner=owner):
+                    if owner == self.name:
+                        sr = self.node.indices[index].searchers[s_id]
+                        segs = (list(sr.replica.segments)
+                                if sr.replica is not None
+                                else list(sr.engine.segments))
+                        res = ShardQueryResult(shard=s_id, segments=segs)
+                        return sr.fetch_phase(
+                            res, sel, dict(body),
+                            stats_ctx=self._global_ctx(index, g))
                     cands = [(c.seg_ord, c.local_doc, c.score,
-                              list(c.sort_values), list(c.raw_sort_values))
+                              list(c.sort_values),
+                              list(c.raw_sort_values))
                              for c in sel]
-                    try:
-                        r = self._rpc_failsafe(
-                            owner, "fetch_phase",
-                            {"index": index, "body": body,
-                             "shard": s_id, "cands": _b64(cands),
-                             "g": _b64(g)}, rs)
-                        fetched = _unb64(r["hits"])
-                    except _dl.DeadlineExhausted:
-                        rs.timed_out = True
-                        failures[s_id] = {
-                            "type": "timeout_exception", "node": owner,
-                            "reason": "request budget exhausted"}
-                        fetched = []
-                    except (_ShardCallFailed, KeyError) as e:
-                        # the copy died BETWEEN query and fetch: this
-                        # shard's winners can no longer be hydrated —
-                        # report the shard failed instead of silently
-                        # returning fewer hits
-                        METRICS.counter("dist.shard_failed").inc()
-                        failures[s_id] = {
-                            "type": getattr(e, "kind",
-                                            "node_unreachable"),
-                            "node": owner,
-                            "attempts": getattr(e, "attempts", 1)}
-                        fetched = []
+                    r = self._rpc_failsafe(
+                        owner, "fetch_phase",
+                        {"index": index, "body": body,
+                         "shard": s_id, "cands": _b64(cands),
+                         "g": _b64(g)}, rs)
+                    return _unb64(r["hits"])
+                fls.add_leg(fleg, name=str(s_id))
+            for (s_id, sel), leg_out in zip(fetch_items, fls.join()):
+                owner = q_assigned.get(s_id, self.name)
+                err = leg_out.error
+                remote = owner != self.name
+                if err is None:
+                    fetched = leg_out.value
+                elif remote and isinstance(err, (_dl.DeadlineExhausted,
+                                                 _legs.LegWedged)):
+                    rs.timed_out = True
+                    failures[s_id] = {
+                        "type": "timeout_exception", "node": owner,
+                        "reason": "request budget exhausted"}
+                    fetched = []
+                elif remote and isinstance(err,
+                                           (_ShardCallFailed, KeyError)):
+                    # the copy died BETWEEN query and fetch: this
+                    # shard's winners can no longer be hydrated —
+                    # report the shard failed instead of silently
+                    # returning fewer hits
+                    METRICS.counter("dist.shard_failed").inc()
+                    failures[s_id] = {
+                        "type": getattr(err, "kind",
+                                        "node_unreachable"),
+                        "node": owner,
+                        "attempts": getattr(err, "attempts", 1)}
+                    fetched = []
+                else:
+                    # local-leg errors propagate exactly as the serial
+                    # (un-tried) local branch did
+                    raise err
                 for c, h in zip(sel, fetched):
                     hits_by_key[(c.shard, c.seg_ord, c.local_doc)] = h
         hits = [hits_by_key[(c.shard, c.seg_ord, c.local_doc)]
@@ -1373,14 +1471,14 @@ class DistClusterNode:
         the wire. Remote legs run on per-member threads carrying the
         caller's context (deadline/trace/obs ctx ride each scrape), so
         the whole fan-out is bounded by ONE scrape timeout — k wedged
-        members cost max(cap), not k*cap."""
-        import contextvars
+        members cost max(cap), not k*cap (utils/legs.py)."""
         from ..utils.metrics import METRICS
         want = sorted(members if members is not None else self.members)
         timeout_s = self._scrape_timeout_s()
-        out: Dict[str, tuple] = {}
 
         def leg(member: str) -> tuple:
+            if member == self.name:
+                return ("ok", self._handle_obs(op, payload))
             try:
                 return ("ok", self._rpc(member, op, payload,
                                         timeout_s=timeout_s))
@@ -1388,21 +1486,18 @@ class DistClusterNode:
                 METRICS.counter("dist.scrape.failed").inc()
                 return ("failed", f"{type(e).__name__}: {e}"[:200])
 
-        threads = []
+        ls = _legs.LegSet(f"dist.scrape.{op}")
         for member in want:
-            if member == self.name:
-                continue
-            ctx = contextvars.copy_context()
-            t = threading.Thread(
-                target=lambda m=member, c=ctx: out.__setitem__(
-                    m, c.run(leg, m)),
-                name=f"ostpu-scrape-{member}", daemon=True)
-            t.start()
-            threads.append(t)
-        if self.name in want:
-            out[self.name] = ("ok", self._handle_obs(op, payload))
-        for t in threads:
-            t.join()
+            ls.add_leg(lambda m=member: leg(m), name=member)
+        out: Dict[str, tuple] = {}
+        for member, leg_out in zip(want, ls.join(timeout_s=timeout_s
+                                                 + _legs.JOIN_GRACE_S)):
+            if leg_out.error is not None:
+                out[member] = ("failed",
+                               f"{type(leg_out.error).__name__}: "
+                               f"{leg_out.error}"[:200])
+            else:
+                out[member] = leg_out.value
         return out
 
     def _resolve_member(self, node_id: Optional[str]) -> List[str]:

@@ -5,11 +5,18 @@ The resilience machinery in `cluster/distnode.py` — deadline propagation,
 per-shard retry with replica failover, the hardened partial-results
 contract — is only trustworthy if exact failure interleavings can be
 REPLAYED. This module is the injection layer: a `ChaosSchedule` holds an
-ordered rule list; every rule matches an injection site deterministically
-(per-rule call counters, plus a seeded RNG for probabilistic rules drawn
-in call order), fires a bounded number of times, and appends what it did
-to a journal. Same schedule + same call sequence -> byte-identical
-journal, which is what the tier-1 replay tests assert.
+ordered rule list; every rule matches an injection site deterministically,
+fires a bounded number of times, and appends what it did to a journal.
+
+Determinism under PARALLEL LEGS (utils/legs.py): per-rule call counters
+are keyed by the call's stable identity `(op, member, leg path)` — a
+pure function of request structure — and probabilistic draws derive
+from `sha256(seed | rule | site | identity | call#)` instead of a shared
+RNG stream consumed in arrival order. Thread interleaving can therefore
+never change WHICH calls a rule fires on, and the `journal` property
+returns entries in a canonical total order rather than arrival order.
+Same schedule + same call set -> byte-identical journal, serial or
+parallel, which is what the tier-1 replay tests assert.
 
 Injection sites (the hooks live in product code, behind an `enabled()`
 fast path that is one module-global read when no schedule is installed):
@@ -41,7 +48,6 @@ hot path unless a schedule is installed, and `install()` is explicit.
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 from typing import Dict, List, Optional
@@ -72,7 +78,7 @@ class FaultTimeout(FaultInjected, TimeoutError):
 
 class _Rule:
     __slots__ = ("site", "action", "op", "member", "at", "after", "times",
-                 "delay_s", "p", "calls", "fired")
+                 "delay_s", "p", "calls", "fired", "calls_by_key")
 
     def __init__(self, site: str, action: str, op: Optional[str],
                  member: Optional[str], at, after: Optional[int],
@@ -85,7 +91,9 @@ class _Rule:
         self.action = action
         self.op = op                    # None = any op
         self.member = member            # None = any member/node
-        self.at = set(at) if at else None      # 1-based matching-call idxs
+        # 1-based matching-call indexes, counted PER call identity
+        # (op, member, leg path) so parallel legs can't perturb them
+        self.at = set(at) if at else None
         if after is None and self.at is None and p is None:
             # a rule with no selector means "every matching call" —
             # without this default it would match forever and never
@@ -95,8 +103,11 @@ class _Rule:
         self.times = times              # max fires (None = unbounded)
         self.delay_s = float(delay_s)
         self.p = p                      # probability (seeded rng)
-        self.calls = 0                  # matching calls seen
+        self.calls = 0                  # matching calls seen (total)
         self.fired = 0
+        # matching calls per stable call identity (op, member, leg
+        # path): the counter parallel legs cannot perturb
+        self.calls_by_key: Dict[tuple, int] = {}
 
     def describe(self) -> dict:
         return {"site": self.site, "action": self.action, "op": self.op,
@@ -112,11 +123,32 @@ class ChaosSchedule:
 
     def __init__(self, seed: int = 0):
         self.seed = int(seed)
-        self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self.rules: List[_Rule] = []
-        self.journal: List[dict] = []
+        self._journal: List[dict] = []   # arrival order (diagnostics)
         self._seq = 0
+
+    @property
+    def journal(self) -> List[dict]:
+        """Fired-fault records in CANONICAL order — sorted by (rule,
+        site, op, member, leg, call), not thread arrival order — with
+        `seq` recomputed as the canonical position. This is the replay
+        artifact: byte-identical across reruns and across the
+        serial/parallel legs arms (arrival order is not; use
+        `journal_arrivals()` for diagnostics)."""
+        with self._lock:
+            recs = list(self._journal)
+        recs.sort(key=lambda e: (e["rule"], e["site"], e["op"] or "",
+                                 e["member"] or "", e.get("leg") or "",
+                                 e["call"]))
+        return [{**e, "seq": i + 1} for i, e in enumerate(recs)]
+
+    def journal_arrivals(self) -> List[dict]:
+        """The journal in raw arrival order (nondeterministic under
+        parallel legs — never asserted on, useful when debugging an
+        interleaving)."""
+        with self._lock:
+            return list(self._journal)
 
     # ---------------- plan construction ----------------
 
@@ -142,11 +174,31 @@ class ChaosSchedule:
 
     # ---------------- firing ----------------
 
+    def _draw(self, rule_idx: int, site: str, key: tuple,
+              call: int) -> float:
+        """Uniform [0,1) derived from the call's stable identity —
+        hashlib, NOT Python hash() (PYTHONHASHSEED-randomized) and NOT
+        a shared stream (arrival-order-dependent). Replays and the
+        serial/parallel arms see identical draws for identical calls."""
+        import hashlib
+        h = hashlib.sha256(
+            f"{self.seed}|{rule_idx}|{site}|{key[0]}|{key[1]}|{key[2]}|"
+            f"{call}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2.0 ** 64
+
     def fire(self, site: str, op: Optional[str] = None,
              member: Optional[str] = None) -> Optional[dict]:
         """Consult the plan for one call at `site`. Returns the action
-        record to apply (journaled), or None. Deterministic: per-rule
-        matching-call counters + the seeded RNG drawn in call order."""
+        record to apply (journaled), or None. Deterministic under
+        concurrency: per-rule matching-call counters and probability
+        draws are keyed by the call's stable identity (op, member, leg
+        path) — thread interleaving cannot change which calls fire.
+        The one order-sensitive residue: a `times`-capped rule whose
+        selector hits on SEVERAL identities racing in the same round
+        fires on whichever acquires the lock first; keep `times` rules
+        keyed to a specific member/op for byte-stable replay."""
+        from ..utils import legs as _legs
+        key = (op, member, _legs.current_path())
         with self._lock:
             for idx, r in enumerate(self.rules):
                 if r.site != site:
@@ -156,17 +208,17 @@ class ChaosSchedule:
                 if r.member is not None and r.member != member:
                     continue
                 r.calls += 1
+                n = r.calls_by_key.get(key, 0) + 1
+                r.calls_by_key[key] = n
                 if r.times is not None and r.fired >= r.times:
                     continue
                 hit = False
                 if r.at is not None:
-                    hit = r.calls in r.at
+                    hit = n in r.at
                 elif r.after is not None:
-                    hit = r.calls >= r.after
+                    hit = n >= r.after
                 if r.p is not None:
-                    # drawn even when positionally hit, so the rng stream
-                    # consumption is a pure function of the call sequence
-                    draw = self._rng.random()
+                    draw = self._draw(idx, site, key, n)
                     hit = (hit or (r.at is None and r.after is None)) \
                         and draw < r.p
                 if not hit:
@@ -174,9 +226,10 @@ class ChaosSchedule:
                 r.fired += 1
                 self._seq += 1
                 rec = {"seq": self._seq, "rule": idx, "site": site,
-                       "op": op, "member": member, "action": r.action,
-                       "call": r.calls, "delay_s": r.delay_s}
-                self.journal.append(rec)
+                       "op": op, "member": member, "leg": key[2],
+                       "action": r.action, "call": n,
+                       "delay_s": r.delay_s}
+                self._journal.append(rec)
                 return rec
         return None
 

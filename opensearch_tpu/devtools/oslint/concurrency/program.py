@@ -50,7 +50,11 @@ MUTATORS = {"append", "appendleft", "extend", "insert", "add", "discard",
             "remove", "pop", "popleft", "popitem", "clear", "update",
             "setdefault", "rotate", "move_to_end"}
 
-CALLBACK_REGISTRARS = {"add_listener", "add_alert_listener", "on_cancel"}
+# callables handed to these methods run on OTHER threads: listener
+# fan-outs, cancellation callbacks, and parallel legs (utils/legs.py
+# LegSet.add_leg — every leg body is a thread entry root)
+CALLBACK_REGISTRARS = {"add_listener", "add_alert_listener", "on_cancel",
+                       "add_leg"}
 
 
 def lockish(name: str) -> bool:

@@ -33,6 +33,14 @@ Design contract (docs/HYBRID.md):
 - Totals are an honest lower bound: the union size of N sub-result sets
   is unknowable from their top windows, so `hits.total` reports the max
   sub-total with relation `gte` (unless there is a single sub-query).
+- Aggregations ride as ONE extra sub-search over the fused candidate
+  window (an `ids` query, size 0) after fusion: buckets/metrics
+  describe the fused candidate set — a pure function of the sub-pages,
+  so agg bytes are as arm-invariant as the fused page itself.
+- Sub-retrievals run as parallel legs (`utils/legs.py`;
+  `OPENSEARCH_TPU_LEGS=0` selects the serial arm): hybrid latency is
+  the MAX of the sub-retrievals, not the SUM, and the fused bytes are
+  identical across arms because fusion never sees scheduling.
 """
 
 from __future__ import annotations
@@ -41,12 +49,14 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..obs import flight_recorder as _fr
+from ..utils import legs as _legs
 from ..utils.metrics import METRICS, CounterGroup
 from ..utils.trace import TRACER
 from . import query_dsl as dsl
 
 STATS = CounterGroup(METRICS, "hybridpath", {
     "searches": 0, "sub_queries": 0, "rrf_fused": 0, "linear_fused": 0,
+    "agg_over_fusion": 0,
     "knn_batched": 0, "knn_batch_launches": 0, "knn_batch_declined": 0})
 
 
@@ -56,8 +66,11 @@ def stats() -> dict:
 
 # body keys a hybrid search cannot carry: they either change per-shard
 # collection semantics in ways the N independent sub-retrievals cannot
-# honor coherently, or they re-rank outside the fusion contract
-_FORBIDDEN_BODY_KEYS = ("sort", "aggs", "aggregations", "collapse",
+# honor coherently, or they re-rank outside the fusion contract.
+# `aggs`/`aggregations` are NOT forbidden: they run as one extra
+# sub-search over the fused candidate window after fusion (see
+# run_hybrid / docs/HYBRID.md "aggregations over fused results").
+_FORBIDDEN_BODY_KEYS = ("sort", "collapse",
                         "suggest", "rescore", "search_after", "min_score",
                         "knn", "terminate_after", "scroll", "pit")
 
@@ -210,12 +223,17 @@ def run_hybrid(body: dict, run_sub: Callable[[dict], dict],
     STATS.inc("sub_queries", len(q.queries))
     STATS.inc("rrf_fused" if fusion["method"] == "rrf" else "linear_fused")
 
-    sub_resps: List[dict] = []
+    # Every sub-retrieval is an independent leg: latency is the MAX of
+    # the legs, not the SUM, and fusion below is a pure function of the
+    # ranked sub-pages so the fused bytes cannot depend on the arm.
+    # Errors propagate first-by-sub-query-index — exactly the error the
+    # serial loop would have raised.
     with TRACER.span("hybrid.sub_queries", n=len(q.queries)), \
             METRICS.timer("hybrid.sub_queries"):
+        ls = _legs.LegSet("hybrid.sub")
         for i, sb in enumerate(sub_bodies(body, q)):
-            with TRACER.span("hybrid.sub", i=i):
-                sub_resps.append(run_sub(sb))
+            ls.add_leg(lambda sb=sb: run_sub(sb), name=str(i))
+        sub_resps: List[dict] = [leg.result() for leg in ls.join()]
 
     lists = []
     by_key: Dict[Tuple[str, str], dict] = {}
@@ -235,6 +253,26 @@ def run_hybrid(body: dict, run_sub: Callable[[dict], dict],
         _fr.RECORDER.record(_fr.current(), "hybrid.fuse",
                             method=fusion["method"], subs=len(lists),
                             candidates=len(fused))
+
+    # aggregations over fused results: one extra sub-search constrained
+    # to the fused candidate window (an `ids` query over the union of
+    # sub-retrieval windows, size 0). The bucket/metric domain is the
+    # fused candidate set — a pure function of the sub-pages, so agg
+    # bytes are arm-invariant exactly like the fused page. Ids are
+    # sorted for compiled-program cache stability.
+    agg_spec = body.get("aggs") or body.get("aggregations")
+    agg_resp = None
+    if agg_spec:
+        STATS.inc("agg_over_fusion")
+        agg_body = {"query": {"ids": {"values":
+                                      sorted({key[1] for key, _ in fused})}},
+                    "from": 0, "size": 0, "aggs": agg_spec}
+        for k in ("timeout", "preference", "allow_partial_search_results"):
+            if k in body:
+                agg_body[k] = body[k]
+        with TRACER.span("hybrid.aggs", candidates=len(fused)), \
+                METRICS.timer("hybrid.aggs"):
+            agg_resp = run_sub(agg_body)
 
     selected = fused[frm: frm + size]
     page = []
@@ -276,6 +314,13 @@ def run_hybrid(body: dict, run_sub: Callable[[dict], dict],
                                else None),
                  "hits": page},
     }
+    if agg_resp is not None:
+        resp["aggregations"] = agg_resp.get("aggregations", {})
+        if agg_resp.get("timed_out"):
+            resp["timed_out"] = True
+        s = agg_resp.get("_shards", {})
+        if int(s.get("failed", 0)) > int(resp["_shards"].get("failed", 0)):
+            resp["_shards"] = dict(s)
     if any(r.get("terminated_early") for r in sub_resps):
         resp["terminated_early"] = True
     if body.get("profile"):

@@ -1,0 +1,410 @@
+"""Deadline-aware parallel legs — the fan-out/join primitive shared by
+hybrid fusion, the distributed scatter phases, and the federation
+scrapes (ROADMAP item 3).
+
+A *leg* is one independent branch of a request: one hybrid
+sub-retrieval, one member's shard group in a scatter round, one remote
+scrape.  The serial coordinator loops made request latency the SUM of
+leg latencies; a :class:`LegSet` makes it the MAX while changing
+nothing else:
+
+- **Context travels with the leg.**  Every ``add_leg`` captures
+  ``contextvars.copy_context()``, so the ambient :class:`Deadline`,
+  the tracer span stack, the flight-recorder timeline, the insights
+  Observation and the query-cost accumulator all follow the leg onto
+  its worker thread — the same discipline as ``NamedPool.submit``.
+- **Joins honor the ambient deadline.**  ``join()`` waits for each leg
+  at most ``remaining + grace``; a leg that does not come back in time
+  is *abandoned* (``leg.wedged``) rather than waited on forever, so a
+  wedged member costs one cap, not the whole request.
+- **Exceptions are captured per leg**, never lost and never allowed to
+  tear down sibling legs.  Callers decide the merge policy: fusion
+  re-raises the first error in sub-query order, the scatter converts
+  member errors into failover re-planning.
+- **Results come back in add order** regardless of completion order,
+  which is what makes the serial and parallel arms byte-identical:
+  every merge step downstream of a join sees the same inputs in the
+  same order.
+
+Serial arm: ``OPENSEARCH_TPU_LEGS=0`` (or ``LegSet(parallel=False)``)
+runs the legs in add order on the caller's thread — same contexts,
+same leg paths, same outcome objects — so bench pairs and parity
+tests compare *scheduling only*.
+
+Determinism hook: each leg runs under a stable *leg path*
+(``parent/label:name``, exposed via :func:`current_path`).  The chaos
+harness keys its per-rule call counters and probability draws by this
+path, which is a pure function of request structure rather than thread
+interleaving — seeded fault journals replay byte-identically whether
+legs run serial or parallel (see ``cluster/faults.py``).
+
+Nested fan-outs (a hybrid sub-retrieval that is itself a distributed
+search which scatters again) must never share a pool with their
+parents: a parent leg blocked in ``join()`` could occupy the only pool
+slot its children need (classic pool-starvation deadlock).  Each
+fan-out DEPTH therefore gets its own bounded pool — a leg at depth d
+only ever waits on depth d+1, so per-depth pools cannot form a wait
+cycle — and depths past ``_POOLED_DEPTH`` spill to dedicated per-leg
+threads.  Depth is tracked with a context variable so the scheduling
+decision needs no global coordination.
+
+When a depth pool is saturated (every slot busy — the process is
+already running as many legs as it has workers), overflow legs are NOT
+queued: they run inline on the joining caller's thread (caller-runs,
+counted in ``legs.inline_overflow``).  Queueing behind a saturated
+pool buys no parallelism, only queue latency; caller-runs makes the
+fan-out degrade gracefully toward the serial arm under load.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutTimeout
+from typing import Any, Callable, List, Optional
+
+from . import deadline as _dl
+from .metrics import METRICS
+from .trace import TRACER
+
+__all__ = ["Leg", "LegSet", "LegWedged", "enabled", "current_path",
+           "pool_stats"]
+
+# Extra time join() grants a leg past the ambient deadline before
+# abandoning it.  Legs are themselves deadline-aware (RPC socket
+# timeouts are derived from the same Deadline), so in practice they
+# return within the budget; the grace only bounds how long a truly
+# wedged leg can hold the join.
+JOIN_GRACE_S = 0.5
+
+# Hard cap on a join wait when there is neither an ambient deadline nor
+# an explicit timeout.  High enough to never trip in tests or serving
+# (blackhole caps at 2 s, scrape caps are single-digit seconds); its
+# only job is making "no deadline + wedged member" survivable.
+JOIN_DEFAULT_CAP_S = 120.0
+
+
+def enabled() -> bool:
+    """Parallel arm toggle (``OPENSEARCH_TPU_LEGS``, default on).
+
+    Read per call so tests and bench pairs can flip arms without
+    re-importing; serial mode keeps LegSet semantics (contexts, leg
+    paths, outcome objects) and changes only the scheduling.
+    """
+    return os.environ.get("OPENSEARCH_TPU_LEGS", "1").lower() not in (
+        "0", "false", "no", "off")
+
+
+class LegWedged(Exception):
+    """A leg did not return within the join budget and was abandoned.
+
+    The leg's thread may still complete later; its result is discarded.
+    Scatter treats this like deadline exhaustion for the leg's shards.
+    """
+
+
+# ---------------------------------------------------------------------------
+# leg identity
+# ---------------------------------------------------------------------------
+
+# "" at top level; "hybrid.sub:1/dist.query_phase:rb" two levels down.
+_path: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "ostpu_leg_path", default="")
+
+
+def current_path() -> str:
+    """Stable identity of the currently executing leg ("" outside legs).
+
+    Deterministic across serial/parallel arms and across replays — the
+    chaos harness keys seeded draws by it.
+    """
+    return _path.get()
+
+
+def _depth() -> int:
+    p = _path.get()
+    return 0 if not p else p.count("/") + 1
+
+
+# ---------------------------------------------------------------------------
+# shared bounded pools, one per fan-out depth (deeper levels spill)
+# ---------------------------------------------------------------------------
+
+# A leg at depth d only ever blocks on resources at depth d+1, so pools
+# keyed BY depth can never deadlock each other: level-0 legs (hybrid
+# subs) park in join() waiting on level-1 legs (scatter members), which
+# wait on level-2+ legs running on dedicated threads.  Capping the
+# pooled levels at _POOLED_DEPTH keeps the thread budget bounded while
+# sparing the two hot fan-out layers the per-leg thread-spawn cost.
+_POOLED_DEPTH = 2          # depths 0..1 pooled; deeper legs spill
+
+_pool_lock = threading.Lock()
+_pools: dict = {}          # depth -> ThreadPoolExecutor
+_slots: dict = {}          # depth -> Semaphore(max_workers)
+
+
+def _pool_size() -> int:
+    try:
+        ncpu = os.cpu_count() or 8
+    except Exception:  # pragma: no cover
+        ncpu = 8
+    return max(8, min(4 * ncpu, 32))
+
+
+def _get_pool(depth: int):
+    """-> (pool, slots) for a pooled depth, (None, None) past it."""
+    if depth >= _POOLED_DEPTH:
+        return None, None
+    p = _pools.get(depth)
+    if p is None:
+        with _pool_lock:
+            p = _pools.get(depth)
+            if p is None:
+                p = ThreadPoolExecutor(
+                    max_workers=_pool_size(),
+                    thread_name_prefix=f"ostpu-legs{depth}")
+                _slots[depth] = threading.Semaphore(_pool_size())
+                _pools[depth] = p
+    return p, _slots[depth]
+
+
+def pool_stats() -> dict:
+    """Introspection for tests and the stats endpoint."""
+    with _pool_lock:
+        pools = dict(_pools)
+    return {"created": bool(pools),
+            "max_workers": _pool_size(),
+            "levels": {d: {"max_workers": p._max_workers,
+                           "threads": len(p._threads)}
+                       for d, p in sorted(pools.items())},
+            "threads": sum(len(p._threads) for p in pools.values())}
+
+
+# ---------------------------------------------------------------------------
+# outcome object
+# ---------------------------------------------------------------------------
+
+class Leg:
+    """One branch of a fan-out: callable + captured context + outcome."""
+
+    __slots__ = ("name", "fn", "ctx", "path", "future", "value", "error",
+                 "wedged", "duration_ms")
+
+    def __init__(self, name: str, fn: Callable[[], Any], ctx, path: str):
+        self.name = name
+        self.fn = fn
+        self.ctx = ctx
+        self.path = path
+        self.future: Optional[Future] = None
+        self.value: Any = None
+        self.error: Optional[BaseException] = None
+        self.wedged = False
+        self.duration_ms = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and not self.wedged
+
+    def result(self) -> Any:
+        """Value or raise — for callers with propagate-first semantics."""
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+
+class LegSet:
+    """Deadline-aware fan-out/join over context-carrying legs.
+
+    Usage::
+
+        ls = LegSet("hybrid.sub")
+        for i, sb in enumerate(bodies):
+            ls.add_leg(lambda sb=sb: run_sub(sb), name=str(i))
+        for leg in ls.join():          # add order, errors captured
+            ...
+
+    ``join()`` may be called exactly once; the LegSet is single-shot.
+    """
+
+    def __init__(self, label: str, parallel: Optional[bool] = None):
+        self.label = label
+        self.parallel = enabled() if parallel is None else bool(parallel)
+        self.legs: List[Leg] = []
+        self._joined = False
+
+    # -- build ------------------------------------------------------------
+
+    def add_leg(self, fn: Callable[[], Any], name: Optional[str] = None) -> Leg:
+        """Register a leg.  Context (deadline/trace/obs/insights/cost) is
+        captured NOW, on the caller's thread."""
+        if self._joined:
+            raise RuntimeError("LegSet already joined")
+        name = str(len(self.legs)) if name is None else str(name)
+        parent = _path.get()
+        path = (parent + "/" if parent else "") + f"{self.label}:{name}"
+        leg = Leg(name, fn, contextvars.copy_context(), path)
+        self.legs.append(leg)
+        return leg
+
+    # -- run --------------------------------------------------------------
+
+    def _run_leg(self, leg: Leg) -> None:
+        """Body of one leg; runs inside leg.ctx.  Never raises."""
+        tok = _path.set(leg.path)
+        t0 = time.monotonic()
+        try:
+            with TRACER.span("legs.leg", label=self.label, leg=leg.name):
+                leg.value = leg.fn()
+        except BaseException as e:  # captured, merged by the caller
+            leg.error = e
+        finally:
+            _path.reset(tok)
+            leg.duration_ms = (time.monotonic() - t0) * 1000.0
+            self._record_leg(leg)
+
+    def _record_leg(self, leg: Leg) -> None:
+        from ..obs import flight_recorder as _fr
+        if _fr.RECORDER.enabled:
+            tl = _fr.current()
+            if tl:
+                _fr.RECORDER.record(
+                    tl, "legs.leg", label=self.label, name=leg.name,
+                    ms=round(leg.duration_ms, 3), ok=leg.error is None,
+                    err=(type(leg.error).__name__
+                         if leg.error is not None else None))
+
+    def _launch(self) -> List[Leg]:
+        """Dispatch legs; return the ones deferred to the caller thread.
+
+        Caller-runs overflow: when the depth pool's slots are all busy
+        (the process is saturated with concurrent fan-outs), queueing a
+        leg behind the pool buys no parallelism — it only adds queue
+        latency and context switches.  Those legs are run inline on the
+        caller's thread during join(), which is parked waiting anyway;
+        under saturation the fan-out degrades gracefully toward the
+        serial arm instead of convoying behind a shared queue.
+        """
+        pool, slots = _get_pool(_depth())
+        inline: List[Leg] = []
+        for leg in self.legs:
+            fut: Future = Future()
+
+            def run(leg=leg, fut=fut, release=False):
+                try:
+                    leg.ctx.run(self._run_leg, leg)
+                finally:
+                    if release:
+                        slots.release()
+                    fut.set_result(None)
+
+            if pool is not None:
+                if slots.acquire(blocking=False):
+                    leg.future = pool.submit(run, release=True)
+                else:
+                    METRICS.counter("legs.inline_overflow").inc()
+                    leg.future = fut
+                    inline.append(leg)
+            else:
+                # Deep fan-out (depth >= _POOLED_DEPTH): dedicated
+                # thread per leg so a parent leg parked in join() can't
+                # starve its children of pool slots.
+                leg.future = fut
+                t = threading.Thread(
+                    target=run, name=f"ostpu-leg-{leg.path}", daemon=True)
+                t.start()
+        return inline
+
+    # -- join -------------------------------------------------------------
+
+    def join(self, timeout_s: Optional[float] = None) -> List[Leg]:
+        """Run/await every leg; return them in add order.
+
+        Parallel arm: waits each leg up to ``ambient-deadline remaining
+        + JOIN_GRACE_S`` (or ``timeout_s`` when no deadline); a leg that
+        misses the window is abandoned with ``wedged=True`` and a
+        :class:`LegWedged` error.  Serial arm: runs legs in add order on
+        this thread (no abandonment — each leg is deadline-aware
+        itself).
+        """
+        if self._joined:
+            raise RuntimeError("LegSet already joined")
+        self._joined = True
+        n = len(self.legs)
+        if n == 0:
+            return self.legs
+        t0 = time.monotonic()
+        run_parallel = self.parallel and n > 1
+        if not run_parallel:
+            for leg in self.legs:
+                leg.ctx.run(self._run_leg, leg)
+        else:
+            inline = self._launch()
+            # Overflow legs run here, on the caller thread, while the
+            # pooled legs execute — the caller would only be parked in
+            # the wait loop below otherwise.  Add order is preserved
+            # within the inline subset; results merge in add order
+            # regardless.
+            for leg in inline:
+                leg.ctx.run(self._run_leg, leg)
+                leg.future.set_result(None)
+            dl = _dl.current()
+            for leg in self.legs:
+                while True:
+                    if dl is not None:
+                        wait = max(dl.remaining_s(), 0.0) + JOIN_GRACE_S
+                    elif timeout_s is not None:
+                        wait = max(timeout_s - (time.monotonic() - t0), 0.0)
+                    else:
+                        wait = JOIN_DEFAULT_CAP_S
+                    try:
+                        leg.future.result(timeout=wait)
+                        break
+                    except _FutTimeout:
+                        leg.wedged = True
+                        leg.error = LegWedged(
+                            f"leg {leg.path} abandoned after "
+                            f"{time.monotonic() - t0:.3f}s")
+                        METRICS.counter("legs.wedged").inc()
+                        break
+        self._account(t0, run_parallel)
+        return self.legs
+
+    def _account(self, t0: float, ran_parallel: bool) -> None:
+        wall_ms = (time.monotonic() - t0) * 1000.0
+        done = [leg for leg in self.legs if not leg.wedged]
+        METRICS.counter("legs.joins").inc()
+        METRICS.counter("legs.launched").inc(len(self.legs))
+        METRICS.counter("legs.completed").inc(len(done))
+        nerr = sum(1 for leg in done if leg.error is not None)
+        if nerr:
+            METRICS.counter("legs.errors").inc(nerr)
+        if METRICS.enabled:
+            METRICS.histogram("legs.fanout").record(len(self.legs))
+            METRICS.histogram("legs.join_ms").record(wall_ms)
+            for leg in done:
+                METRICS.histogram("legs.leg_ms").record(leg.duration_ms)
+            if ran_parallel and wall_ms > 0.0:
+                # >1.0 means legs actually overlapped; == 1.0 is serial.
+                overlap = sum(leg.duration_ms for leg in done) / wall_ms
+                METRICS.histogram("legs.overlap").record(overlap)
+        from ..obs import flight_recorder as _fr
+        if _fr.RECORDER.enabled:
+            tl = _fr.current()
+            if tl:
+                _fr.RECORDER.record(
+                    tl, "legs.join", label=self.label, n=len(self.legs),
+                    ms=round(wall_ms, 3), parallel=ran_parallel,
+                    wedged=len(self.legs) - len(done), errors=nerr)
+
+
+def run_legs(label: str, fns: List[Callable[[], Any]],
+             names: Optional[List[str]] = None,
+             parallel: Optional[bool] = None) -> List[Leg]:
+    """One-shot convenience: build a LegSet, add ``fns``, join."""
+    ls = LegSet(label, parallel=parallel)
+    for i, fn in enumerate(fns):
+        ls.add_leg(fn, name=None if names is None else names[i])
+    return ls.join()

@@ -4,8 +4,9 @@ Every round commits a BENCH artifact, but nothing ever COMPARED them —
 "did PR N regress the PR N-1 numbers" was a human eyeballing two JSON
 files. This script extracts the comparable metric surface from any two
 rounds (qps, latency percentiles, bytes-per-query, block-skip rates,
-concurrency/overhead gates) and reports deltas with direction-aware
-regression classification; `--gate` turns it into a CI-shaped exit code.
+concurrency/overhead gates, the parallel-legs and parallel-scatter A/B
+pairs) and reports deltas with direction-aware regression
+classification; `--gate` turns it into a CI-shaped exit code.
 
 The ladder has two artifact shapes (docs/BENCH_CORPUS.md "Reading the
 trajectory"):
@@ -201,6 +202,50 @@ def metrics_of(doc: dict) -> dict:
         v = (hyb.get("gates") or {}).get(k)
         if isinstance(v, bool):
             out[f"hybrid.gate.{suf}"] = 1.0 if v else 0.0
+    # parallel-legs A/B (ISSUE 17, `extra.hybrid.legs_ab`): the legs/
+    # serial p50 pair under modeled member latency, the SUM->MAX ratio
+    # (lower = more overlap), the chaos-free overhead ratio, and the
+    # gates as 0/1 booleans
+    lab = hyb.get("legs_ab") or {}
+    for arm in ("legs_on", "serial"):
+        a = lab.get(arm) or {}
+        for k in ("p50_ms", "p99_ms"):
+            if _num(a.get(k)) is not None:
+                out[f"hybrid.legs_ab.{arm}.{k}"] = a[k]
+    if _num(lab.get("p50_ratio_legs_over_serial")) is not None:
+        out["hybrid.legs_ab.ratio_p50"] = \
+            lab["p50_ratio_legs_over_serial"]
+    nd = lab.get("no_delay") or {}
+    if _num(nd.get("p50_ratio_legs_over_serial")) is not None:
+        out["hybrid.legs_ab.no_delay_ratio_p50"] = \
+            nd["p50_ratio_legs_over_serial"]
+    for k, suf in (("legs_p50_le_0p6x_serial", "speedup_ok"),
+                   ("pages_byte_identical", "pages_identical_ok")):
+        v = (lab.get("gates") or {}).get(k)
+        if isinstance(v, bool):
+            out[f"hybrid.legs_ab.gate.{suf}"] = 1.0 if v else 0.0
+    # fault bench (scripts/measure_faults.py, `extra.faults`): the
+    # parallel-scatter A/B pair plus per-scenario latency/identity
+    flt = extra.get("faults") or {}
+    pscat = flt.get("parallel_scatter") or {}
+    for k in ("p50_ms_legs", "p50_ms_serial"):
+        if _num(pscat.get(k)) is not None:
+            out[f"faults.parallel_scatter.{k}"] = pscat[k]
+    if _num(pscat.get("p50_ratio_legs_over_serial")) is not None:
+        out["faults.parallel_scatter.ratio_p50"] = \
+            pscat["p50_ratio_legs_over_serial"]
+    for k, suf in (("pages_byte_identical", "pages_identical_ok"),
+                   ("gate_ok", "gate_ok")):
+        if isinstance(pscat.get(k), bool):
+            out[f"faults.parallel_scatter.{suf}"] = \
+                1.0 if pscat[k] else 0.0
+    for sc in flt.get("scenarios") or []:
+        if not isinstance(sc, dict) or not sc.get("scenario"):
+            continue
+        tag = sc["scenario"]
+        for k in ("lat_ms_p50", "lat_ms_p95"):
+            if _num(sc.get(k)) is not None:
+                out[f"faults.{tag}.{k}"] = sc[k]
     reorder = (extra.get("reorder") or {}).get("arms") or {}
     for arm, mixes in reorder.items():
         if not isinstance(mixes, dict):

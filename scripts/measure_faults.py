@@ -15,6 +15,12 @@ chaos harness (`cluster/faults.py`):
 - `deadline`     — 30 s blackhole on one member + 250 ms request
                    timeouts on a primaries-only index: every page must
                    come back `timed_out` WITHIN budget
+- `parallel_scatter_{legs,serial}` — mesh-wide 10 ms RPC latency with
+                   `OPENSEARCH_TPU_LEGS` flipped per arm (ISSUE 17):
+                   the serial scatter pays the delay once per member
+                   per round, parallel legs once per round — legs must
+                   beat serial on p50 with pages byte-identical to
+                   baseline in BOTH arms
 
 The run is observed, not just survived (ISSUE 10): every scenario runs
 with the time-series sampler ticking and the SLO burn-rate engine ARMED
@@ -275,6 +281,38 @@ def main():
             ok = slo_gate(row, must_fire=must_fire) and ok
             results.append(row)
 
+        # parallel-scatter A/B (ISSUE 17): mesh-wide 10 ms RPC latency
+        # (every member slow, the shape where the serial scatter pays
+        # the delay once PER MEMBER per round and parallel legs pay it
+        # once per round). Both arms must serve pages byte-identical to
+        # the no-chaos baseline; legs must beat serial on p50.
+        ps = {}
+        for arm, flag in (("legs", "1"), ("serial", "0")):
+            os.environ["OPENSEARCH_TPU_LEGS"] = flag
+            row, pages, _ = run_scenario(
+                f"parallel_scatter_{arm}", a, "fidx", bodies,
+                faults.ChaosSchedule(seed=5).add(
+                    "rpc.send", "delay", after=1, delay_s=0.010))
+            row["pages_byte_identical_to_baseline"] = pages == base_pages
+            ps[arm] = row
+            results.append(row)
+        os.environ.pop("OPENSEARCH_TPU_LEGS", None)
+        scatter_ratio = (ps["legs"]["lat_ms_p50"]
+                         / max(ps["serial"]["lat_ms_p50"], 1e-9))
+        scatter_ident = (ps["legs"]["pages_byte_identical_to_baseline"]
+                         and ps["serial"][
+                             "pages_byte_identical_to_baseline"])
+        scatter_ok = scatter_ident and scatter_ratio < 1.0
+        ok = ok and scatter_ok
+        parallel_scatter = {
+            "member_delay_ms": 10.0,
+            "p50_ms_legs": ps["legs"]["lat_ms_p50"],
+            "p50_ms_serial": ps["serial"]["lat_ms_p50"],
+            "p50_ratio_legs_over_serial": round(scatter_ratio, 3),
+            "pages_byte_identical": scatter_ident,
+            "gate_ok": scatter_ok,
+        }
+
         dl_row, _, _ = run_scenario(
             "deadline", a, "fprim", bodies[: max(args.nqueries // 4, 8)],
             faults.ChaosSchedule(seed=4).add(
@@ -304,7 +342,8 @@ def main():
     out = {"bench": "measure_faults", "ndocs": NDOCS,
            "nqueries": args.nqueries, "victim": VICTIM,
            "slo_windows": {"fast_s": FAST_W, "slow_s": SLOW_W},
-           "scenarios": results, "fleet": fleet, "gate_ok": ok}
+           "scenarios": results, "parallel_scatter": parallel_scatter,
+           "fleet": fleet, "gate_ok": ok}
     print(json.dumps({"bench": out["bench"], "gate_ok": ok,
                       "scenarios": [
                           {k: v for k, v in r.items()

@@ -1,5 +1,6 @@
-"""Child process for the two-process cluster tests (tests/test_distnode.py):
-brings up a full DistClusterNode, joins the seed, serves until killed."""
+"""Child process for multi-process cluster harnesses (tests/test_distnode.py,
+bench.py's legs A/B cell): brings up a full DistClusterNode under the given
+name, joins the seed, serves until killed."""
 
 import sys
 import time
@@ -15,7 +16,8 @@ from opensearch_tpu.cluster.distnode import DistClusterNode  # noqa: E402
 
 def main():
     seed = sys.argv[1]
-    n = DistClusterNode("b", seed=seed)
+    name = sys.argv[2] if len(sys.argv) > 2 else "b"
+    n = DistClusterNode(name, seed=seed)
     print(f"READY {n.addr}", flush=True)
     while True:
         time.sleep(1)

@@ -243,11 +243,8 @@ class TestHybridSearch:
         with pytest.raises((ApiError, dsl.QueryParseError)):
             client.search("hx", {"query": {"hybrid": {"queries": [
                 {"hybrid": {"queries": [SUBS[0]]}}]}}})
-        # aggs/sort cannot ride a hybrid body
-        with pytest.raises((ApiError, dsl.QueryParseError)):
-            client.search("hx", {**_hybrid_body(),
-                                 "aggs": {"c": {"terms": {
-                                     "field": "cat"}}}})
+        # sort cannot ride a hybrid body (aggs CAN, since PR 17 — they
+        # run over the fused candidate window, see tests/test_legs.py::TestHybridParity)
         with pytest.raises((ApiError, dsl.QueryParseError)):
             client.search("hx", {**_hybrid_body(),
                                  "sort": [{"cat": "asc"}]})

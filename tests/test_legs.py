@@ -1,0 +1,566 @@
+"""Deadline-aware parallel legs (ISSUE 17, utils/legs.py) and the two
+hot loops refactored onto them.
+
+The tentpole invariants, asserted here:
+
+- `LegSet.join()` returns outcomes in ADD order with per-leg exception
+  capture; ambient context (Deadline, contextvars) travels with every
+  leg; nested fan-outs spill to dedicated threads and cannot starve
+  the bounded pool; a wedged leg is abandoned after the ambient budget
+  plus grace, never waited on forever.
+- The serial arm (`OPENSEARCH_TPU_LEGS=0`) is the SAME primitive minus
+  the scheduling: identical leg paths, identical outcome objects — so
+  every downstream merge (hybrid fusion, scatter reduce) is
+  byte-identical across arms, under 32-thread load, under seeded chaos
+  (kill / flaky / blackhole), on both distnode coordinators.
+- `ChaosSchedule` keys per-rule call counters and probability draws by
+  the call's stable identity (op, member, leg path): seeded journals
+  replay byte-identically no matter how threads interleave, and the
+  serial and parallel arms produce the SAME canonical journal.
+- A single slow leg no longer stalls its siblings: hybrid latency is
+  the MAX of the sub-retrievals, not the SUM.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.cluster import faults
+from opensearch_tpu.cluster.distnode import DistClusterNode, RetryPolicy
+from opensearch_tpu.rest.client import RestClient
+from opensearch_tpu.search import fusion
+from opensearch_tpu.utils import deadline as dl
+from opensearch_tpu.utils import legs
+from opensearch_tpu.utils.metrics import METRICS
+
+
+@pytest.fixture()
+def serial_arm(monkeypatch):
+    monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "0")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_chaos():
+    yield
+    faults.uninstall()
+
+
+# ---------------------------------------------------------------------
+# the primitive
+# ---------------------------------------------------------------------
+
+class TestLegSetPrimitive:
+    def test_results_in_add_order_and_overlap(self):
+        ls = legs.LegSet("t")
+        for i in range(6):
+            ls.add_leg(lambda i=i: (time.sleep(0.08), i)[1], name=str(i))
+        t0 = time.monotonic()
+        out = ls.join()
+        wall = time.monotonic() - t0
+        assert [leg.value for leg in out] == list(range(6))
+        assert all(leg.ok for leg in out)
+        # 6 x 80ms overlapped: max-shaped, not sum-shaped
+        assert wall < 0.35
+
+    def test_serial_arm_same_outcomes(self, serial_arm):
+        ls = legs.LegSet("t")
+        for i in range(3):
+            ls.add_leg(lambda i=i: (i, legs.current_path()), name=str(i))
+        out = ls.join()
+        assert [leg.value for leg in out] == [
+            (0, "t:0"), (1, "t:1"), (2, "t:2")]
+
+    def test_leg_paths_identical_across_arms(self, monkeypatch):
+        def run():
+            def sub(i):
+                inner = legs.LegSet("inner")
+                for j in range(2):
+                    inner.add_leg(lambda: legs.current_path(),
+                                  name=str(j))
+                return [leg.value for leg in inner.join()]
+            ls = legs.LegSet("outer")
+            for i in range(2):
+                ls.add_leg(lambda i=i: sub(i), name=str(i))
+            return [leg.value for leg in ls.join()]
+
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "1")
+        par = run()
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "0")
+        ser = run()
+        assert par == ser == [
+            ["outer:0/inner:0", "outer:0/inner:1"],
+            ["outer:1/inner:0", "outer:1/inner:1"]]
+        assert legs.current_path() == ""   # restored outside legs
+
+    def test_context_travels_with_leg(self):
+        d = dl.Deadline(30.0)
+        with dl.scope(d):
+            ls = legs.LegSet("ctx")
+            ls.add_leg(lambda: dl.current() is d)
+            out = ls.join()
+        assert out[0].value is True
+
+    def test_exception_capture_and_result_raises(self):
+        ls = legs.LegSet("e")
+        ls.add_leg(lambda: 1 / 0, name="boom")
+        ls.add_leg(lambda: 42, name="fine")
+        out = ls.join()
+        assert isinstance(out[0].error, ZeroDivisionError)
+        assert out[1].value == 42 and out[1].ok
+        with pytest.raises(ZeroDivisionError):
+            out[0].result()
+
+    def test_wedged_leg_abandoned_within_budget(self):
+        release = threading.Event()
+        with dl.scope(dl.Deadline(0.05)):
+            ls = legs.LegSet("w")
+            ls.add_leg(lambda: release.wait(10.0), name="wedge")
+            ls.add_leg(lambda: "fast", name="ok")
+            t0 = time.monotonic()
+            out = ls.join()
+            wall = time.monotonic() - t0
+        release.set()
+        assert out[0].wedged and isinstance(out[0].error, legs.LegWedged)
+        assert out[1].value == "fast"
+        # deadline (50ms) + grace, never the 10 s wedge
+        assert wall < legs.JOIN_GRACE_S + 1.0
+
+    def test_nested_fanout_wider_than_pool_completes(self):
+        """Parents blocked in join() must never starve their children
+        of pool slots: a two-level fan-out wider than the shared pool
+        completes because nested LegSets spill to dedicated threads."""
+        width = legs.pool_stats()["max_workers"] + 4
+
+        def parent(i):
+            inner = legs.LegSet("inner")
+            for j in range(2):
+                inner.add_leg(lambda j=j: (time.sleep(0.01), j)[1])
+            return sum(leg.value for leg in inner.join())
+
+        ls = legs.LegSet("outer")
+        for i in range(width):
+            ls.add_leg(lambda i=i: parent(i))
+        out = ls.join()
+        assert [leg.value for leg in out] == [1] * width
+
+    def test_join_metrics_account(self):
+        before = METRICS.counter("legs.launched").value
+        ls = legs.LegSet("m")
+        for i in range(3):
+            ls.add_leg(lambda: None)
+        ls.join()
+        assert METRICS.counter("legs.launched").value == before + 3
+
+    def test_single_shot(self):
+        ls = legs.LegSet("s")
+        ls.add_leg(lambda: 1)
+        ls.join()
+        with pytest.raises(RuntimeError):
+            ls.join()
+        with pytest.raises(RuntimeError):
+            ls.add_leg(lambda: 2)
+
+
+# ---------------------------------------------------------------------
+# chaos determinism under concurrent legs (the keyed-draw contract)
+# ---------------------------------------------------------------------
+
+class TestChaosKeyedDeterminism:
+    def _storm(self, sched, nthreads=8, ncalls=25):
+        """Fire the same keyed call pattern from many threads at once:
+        every thread is one 'leg' with a distinct stable path, arrival
+        order fully scrambled."""
+        barrier = threading.Barrier(nthreads)
+
+        def worker(t):
+            ls = legs.LegSet("storm")
+
+            def leg():
+                try:                     # serial arm: barrier can't fill
+                    barrier.wait(timeout=0.5)
+                except threading.BrokenBarrierError:
+                    pass
+                for c in range(ncalls):
+                    try:
+                        sched.fire("rpc.send", op="query_phase",
+                                   member=f"m{t % 3}")
+                    except Exception:
+                        pass
+            ls.add_leg(leg, name=str(t))
+            return ls.join()
+
+        outer = legs.LegSet("outer")
+        for t in range(nthreads):
+            outer.add_leg(lambda t=t: worker(t), name=str(t))
+        outer.join()
+
+    def test_concurrent_replay_byte_stable(self):
+        """Same seed + same call set -> byte-identical canonical
+        journal, regardless of thread interleaving (the satellite's
+        regression oracle)."""
+        journals = []
+        for _ in range(2):
+            s = (faults.ChaosSchedule(seed=9)
+                 .add("rpc.send", "delay", member="m1", p=0.5,
+                      delay_s=0.0)
+                 .add("rpc.send", "delay", op="query_phase", at=[3, 7],
+                      delay_s=0.0))
+            self._storm(s)
+            journals.append(json.dumps(s.journal, sort_keys=True))
+        assert journals[0] == journals[1]
+        assert json.loads(journals[0])    # non-vacuous: faults fired
+
+    def test_serial_and_parallel_arms_same_journal(self, monkeypatch):
+        def run():
+            s = (faults.ChaosSchedule(seed=5)
+                 .add("rpc.send", "delay", member="m0", p=0.4,
+                      delay_s=0.0))
+            self._storm(s, nthreads=6, ncalls=10)
+            return json.dumps(s.journal, sort_keys=True)
+
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "1")
+        par = run()
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "0")
+        ser = run()
+        assert par == ser
+
+    def test_at_counts_per_identity(self):
+        """at=[2] means 'the 2nd call of EACH identity', so a sibling
+        leg's calls can never shift which call a rule fires on."""
+        s = faults.ChaosSchedule(seed=0).add(
+            "rpc.send", "delay", at=[2], delay_s=0.0)
+        assert s.fire("rpc.send", op="q", member="a") is None
+        assert s.fire("rpc.send", op="q", member="b") is None  # own count
+        assert s.fire("rpc.send", op="q", member="a")["member"] == "a"
+        assert s.fire("rpc.send", op="q", member="b")["member"] == "b"
+
+    def test_journal_canonical_not_arrival(self):
+        s = faults.ChaosSchedule(seed=0) \
+            .add("rpc.send", "delay", after=1, delay_s=0.0)
+        s.fire("rpc.send", op="q", member="b")
+        s.fire("rpc.send", op="q", member="a")
+        j = s.journal
+        assert [e["member"] for e in j] == ["a", "b"]   # canonical order
+        assert [e["seq"] for e in j] == [1, 2]          # recomputed
+        assert [e["member"] for e in s.journal_arrivals()] == ["b", "a"]
+
+
+# ---------------------------------------------------------------------
+# hybrid: serial-vs-parallel byte parity + aggs over fusion
+# ---------------------------------------------------------------------
+
+MAPPING = {"mappings": {"properties": {
+    "body": {"type": "text"},
+    "emb": {"type": "rank_features", "index_impacts": True},
+    "vec": {"type": "dense_vector", "dims": 8, "similarity": "cosine"},
+    "cat": {"type": "keyword"},
+    "num": {"type": "integer"}}}}
+
+SUBS = [
+    {"match": {"body": "w1 w2 w3"}},
+    {"neural_sparse": {"emb": {"query_tokens": {"t1": 2.0, "t2": 1.0,
+                                                "t7": 0.4}}}},
+    {"knn": {"vec": {"vector": [0.9, 0.1, 0.5, 0.3, 0.7, 0.2, 0.6, 0.4],
+                     "k": 20}}},
+]
+
+
+def _mk_docs(n=200, seed=7):
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(25)]
+    feats = [f"t{i}" for i in range(20)]
+    docs = {}
+    for i in range(n):
+        toks = rng.choice(vocab, size=int(rng.integers(2, 6)))
+        fsel = rng.choice(feats, size=int(rng.integers(2, 5)),
+                          replace=False)
+        docs[str(i)] = {
+            "body": " ".join(toks),
+            "emb": {f: round(float(rng.exponential(1.0) + 0.05), 3)
+                    for f in fsel},
+            "vec": [float(x) for x in rng.random(8)],
+            "cat": "odd" if i % 2 else "even",
+            "num": int(rng.integers(0, 100))}
+    return docs
+
+
+def _hybrid_body(size=10, frm=0, window=50, method="rrf", aggs=None):
+    fusion_spec = {"method": method, "window_size": window}
+    if method == "linear":
+        fusion_spec["normalization"] = "min_max"
+    body = {"query": {"hybrid": {"queries": SUBS,
+                                 "fusion": fusion_spec}},
+            "from": frm, "size": size}
+    if aggs:
+        body["aggs"] = aggs
+    return body
+
+
+def _page_bytes(resp):
+    return json.dumps(
+        {"hits": [(h["_id"], h["_score"]) for h in resp["hits"]["hits"]],
+         "total": resp["hits"]["total"],
+         "max": resp["hits"]["max_score"],
+         "aggs": resp.get("aggregations"),
+         "shards": {k: v for k, v in resp.get("_shards", {}).items()}},
+        sort_keys=True)
+
+
+@pytest.fixture(scope="module")
+def hybrid_client():
+    docs = _mk_docs()
+    c = RestClient()
+    c.indices.create("lhx", {**MAPPING, "settings": {
+        "index": {"number_of_shards": 2}}})
+    for did, d in docs.items():
+        c.index("lhx", d, id=did)
+    c.indices.refresh("lhx")
+    return c
+
+
+class TestHybridParity:
+    AGGS = {"cats": {"terms": {"field": "cat"}},
+            "n": {"value_count": {"field": "cat"}}}
+
+    def _pages(self, c, bodies):
+        out = []
+        for b in bodies:
+            c.node.request_cache._store.clear()
+            out.append(_page_bytes(c.search("lhx", dict(b))))
+        return out
+
+    def test_legs_on_off_byte_identical(self, hybrid_client,
+                                        monkeypatch):
+        bodies = [_hybrid_body(),
+                  _hybrid_body(method="linear"),
+                  _hybrid_body(size=4, frm=3, window=30),
+                  _hybrid_body(aggs=self.AGGS)]
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "1")
+        on = self._pages(hybrid_client, bodies)
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "0")
+        off = self._pages(hybrid_client, bodies)
+        assert on == off
+
+    def test_aggs_over_fused_window_oracle(self, hybrid_client):
+        """Hybrid aggs == the same aggs over an explicit ids query on
+        the fused candidate window — and present on the fused page."""
+        c = hybrid_client
+        body = _hybrid_body(aggs=self.AGGS)
+        r = c.search("lhx", dict(body))
+        q = fusion.parse_hybrid(body)
+        subs = [c.search("lhx", sb) for sb in fusion.sub_bodies(body, q)]
+        lists = [[((h["_index"], h["_id"]), h["_score"])
+                  for h in s["hits"]["hits"]] for s in subs]
+        fused = fusion.fuse_ranked_lists(lists, q.fusion)
+        oracle = c.search("lhx", {
+            "query": {"ids": {"values": sorted({k[1] for k, _ in fused})}},
+            "size": 0, "aggs": self.AGGS})
+        assert r["aggregations"] == oracle["aggregations"]
+        assert r["aggregations"]["cats"]["buckets"]
+
+    def test_parity_under_32_thread_load(self, hybrid_client,
+                                         monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "0")
+        c = hybrid_client
+        body = _hybrid_body(size=8, aggs=self.AGGS)
+        expect = _page_bytes(c.search("lhx", dict(body)))
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "1")
+        got = [None] * 32
+        errors = []
+
+        def worker(i):
+            try:
+                got[i] = _page_bytes(c.search("lhx", dict(body)))
+            except Exception as e:       # surfaced after join
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(32)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert errors == []
+        assert all(g == expect for g in got)
+
+    def test_slow_leg_does_not_stall_siblings(self):
+        """The ISSUE pin: blackhole ONE sub-retrieval -> total wall is
+        ≈ that leg's own latency, while the sibling legs complete
+        during its window (serial would be the SUM)."""
+        calls = []
+
+        def run_sub(sb):
+            i = len(calls)
+            calls.append(sb)
+            time.sleep(0.5 if i == 1 else 0.2)
+            return {"hits": {"total": {"value": 1, "relation": "eq"},
+                             "max_score": 1.0,
+                             "hits": [{"_index": "x", "_id": f"d{i}",
+                                       "_score": 1.0}]},
+                    "_shards": {"total": 1, "successful": 1,
+                                "skipped": 0, "failed": 0},
+                    "timed_out": False}
+
+        body = {"query": {"hybrid": {"queries": SUBS,
+                                     "fusion": {"method": "rrf",
+                                                "window_size": 10}}},
+                "size": 5}
+        t0 = time.monotonic()
+        resp = fusion.run_hybrid(body, run_sub)
+        wall = time.monotonic() - t0
+        assert len(resp["hits"]["hits"]) == 3     # every sibling landed
+        # MAX-shaped (~0.5 s slow leg), nowhere near the 0.9 s SUM
+        assert wall < 0.8, wall
+
+
+# ---------------------------------------------------------------------
+# distributed: both coordinators, chaos, serial-vs-parallel parity
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster3():
+    policy = RetryPolicy(same_member_retries=1, budget=6,
+                         base_backoff_s=0.001, max_backoff_s=0.005)
+    a = DistClusterNode("la", retry_policy=policy)
+    b = DistClusterNode("lb", seed=a.addr)
+    c = DistClusterNode("lc", seed=a.addr)
+    docs = _mk_docs(n=120, seed=3)
+    a.create_index("ldx", {
+        **MAPPING,
+        "settings": {"number_of_shards": 4,
+                     "number_of_node_replicas": 1}})
+    for did, d in docs.items():
+        a.index_doc("ldx", d, id=did)
+    a.refresh("ldx")
+    yield a, b, c, docs
+    for n in (a, b, c):
+        n.stop()
+
+
+def _reset_fd(*nodes):
+    for n in nodes:
+        for m in sorted(n.members):
+            n.member_fd.note_success(m)
+
+
+class TestDistributedLegsParity:
+    BODIES = [
+        {"query": {"match": {"body": "w1 w2"}}, "size": 10},
+        {"query": {"match": {"body": "w3"}}, "size": 5,
+         "aggs": {"c": {"terms": {"field": "cat"}}}},
+        _hybrid_body(size=6, window=30),
+    ]
+
+    def _arm_pages(self, coord, chaos_seed=None, chaos=None):
+        """One arm's pages for every probe body (fresh chaos schedule
+        per arm so both arms see identical injection plans)."""
+        pages = []
+        journal = None
+        if chaos is not None:
+            sched = chaos(faults.ChaosSchedule(seed=chaos_seed))
+            faults.install(sched)
+        try:
+            for body in self.BODIES:
+                pages.append(_page_bytes(coord.search("ldx",
+                                                      dict(body))))
+            if chaos is not None:
+                journal = json.dumps(faults.installed().journal,
+                                     sort_keys=True)
+        finally:
+            faults.uninstall()
+        return pages, journal
+
+    def _parity(self, coord, monkeypatch, chaos=None, seed=0,
+                expect_fired=False):
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "1")
+        _reset_fd(coord)
+        on, jon = self._arm_pages(coord, seed, chaos)
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "0")
+        _reset_fd(coord)
+        off, joff = self._arm_pages(coord, seed, chaos)
+        _reset_fd(coord)
+        assert on == off
+        if chaos is not None:
+            assert jon == joff          # canonical journals byte-equal
+            if expect_fired:
+                assert json.loads(jon)
+        return on
+
+    def test_clean_parity_both_coordinators(self, cluster3,
+                                            monkeypatch):
+        a, b, *_ = cluster3
+        pa = self._parity(a, monkeypatch)
+        pb = self._parity(b, monkeypatch)
+        assert pa == pb                 # coordinator-invariant too
+
+    def test_kill_chaos_parity(self, cluster3, monkeypatch):
+        a, b, *_ = cluster3
+        # replicas present: kill -> failover -> same bytes as clean
+        clean = self._parity(a, monkeypatch)
+        killed = self._parity(a, monkeypatch,
+                              chaos=lambda s: s.kill_node("lb"),
+                              seed=4, expect_fired=True)
+        assert killed == clean
+        self._parity(b, monkeypatch,
+                     chaos=lambda s: s.kill_node("lc"), seed=4,
+                     expect_fired=True)
+
+    def test_flaky_chaos_parity(self, cluster3, monkeypatch):
+        a, *_ = cluster3
+        self._parity(
+            a, monkeypatch,
+            chaos=lambda s: s.add("rpc.send", "drop", member="lb",
+                                  p=0.4),
+            seed=11, expect_fired=True)
+
+    def test_blackhole_chaos_parity(self, cluster3, monkeypatch):
+        a, *_ = cluster3
+        # short blackhole: FaultTimeout -> retry/failover (no request
+        # deadline, so both arms take the same keyed failover path)
+        self._parity(
+            a, monkeypatch,
+            chaos=lambda s: s.add("rpc.send", "blackhole", member="lc",
+                                  op="query_phase", after=1,
+                                  delay_s=0.05),
+            seed=12, expect_fired=True)
+
+    def test_blackholed_member_bounds_wall_not_sum(self, cluster3,
+                                                   monkeypatch):
+        """Parallel legs under a blackholed member: the round's wall is
+        ONE blackhole hold (all member legs overlap), and the other
+        members' shards still serve."""
+        a, *_ = cluster3
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "1")
+        _reset_fd(a)
+        faults.install(faults.ChaosSchedule(seed=13).add(
+            "rpc.send", "blackhole", member="lb", after=1,
+            delay_s=0.4))
+        try:
+            t0 = time.monotonic()
+            r = a.search("ldx", {"query": {"match": {"body": "w1"}},
+                                 "size": 5})
+            wall = time.monotonic() - t0
+        finally:
+            faults.uninstall()
+        _reset_fd(a)
+        assert r["_shards"]["failed"] == 0      # replicas absorbed it
+        # dfs+query+fetch each see at most one 0.4 s hold + retries;
+        # the serial arm pays the hold PER MEMBER GROUP in sequence
+        assert wall < 4.0
+
+    def test_federation_scrape_parity(self, cluster3, monkeypatch):
+        a, *_ = cluster3
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "1")
+        on = a.cluster_stats()
+        monkeypatch.setenv("OPENSEARCH_TPU_LEGS", "0")
+        off = a.cluster_stats()
+        assert on["_nodes"] == off["_nodes"]
+        assert sorted(on["nodes"]) == sorted(off["nodes"]) \
+            == sorted(a.members)
+        assert all(v["status"] == "ok" for v in on["nodes"].values())
+        stats = a.nodes_stats_federated()
+        assert stats["_nodes"]["successful"] == len(a.members)

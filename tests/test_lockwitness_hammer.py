@@ -143,3 +143,65 @@ class TestSchedulerHammer:
             sched.close(drain=True)
         assert errors == []
         _assert_clean("scheduler hammer")
+
+
+class TestLegsHammer:
+    def test_legs_hammer_witness_clean(self, witness):
+        """Witness-armed parallel legs (PR 17): an in-process distnode
+        pair built AFTER install() — so the legs pool lock, per-request
+        state lock, chaos-schedule lock, and every node lock report as
+        WitnessLocks — hammered with hybrid + distributed searches from
+        8 threads while the legs pool fans out sub-retrieval and
+        scatter legs underneath each one. No inversion, no order the
+        committed lock_order.json forbids."""
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        from opensearch_tpu.utils import legs
+
+        a = DistClusterNode("lwa")
+        b = DistClusterNode("lwb", seed=a.addr)
+        assert isinstance(legs._pool_lock, lockwitness.WitnessLock) \
+            or legs._pools              # pools may predate install
+        try:
+            a.create_index("lwd", {"mappings": {"properties": {
+                "body": {"type": "text"},
+                "emb": {"type": "rank_features"}}},
+                "settings": {"number_of_shards": 2,
+                             "number_of_node_replicas": 1}})
+            for i in range(24):
+                a.index_doc("lwd", {
+                    "body": f"alpha {'beta' if i % 2 else 'gamma'} w{i}",
+                    "emb": {"t1": 1.0 + i % 3, "t2": 0.5}}, id=str(i))
+            a.refresh("lwd")
+
+            hybrid = {"query": {"hybrid": {"queries": [
+                {"match": {"body": "alpha beta"}},
+                {"neural_sparse": {"emb": {"query_tokens":
+                                           {"t1": 1.0, "t2": 0.5}}}}],
+                "fusion": {"method": "rrf", "window_size": 20}}},
+                "size": 5}
+            errors = []
+
+            def worker(i):
+                try:
+                    for k in range(4):
+                        coord = a if (i + k) % 2 == 0 else b
+                        body = dict(hybrid) if k % 2 == 0 else \
+                            {"query": {"match": {"body": "alpha"}},
+                             "size": 5}
+                        r = coord.search("lwd", body)
+                        assert r["hits"]["hits"]
+                    coord.cluster_stats()
+                except Exception as e:      # surfaced after join
+                    errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            a.stop()
+            b.stop()
+        assert errors == []
+        _assert_clean("legs hammer")

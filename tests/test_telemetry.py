@@ -288,8 +288,17 @@ class TestDistributedTrace:
             phases = {c["name"]: c for c in root["children"]}
             assert {"dist.dfs", "dist.query", "dist.reduce",
                     "dist.fetch"} <= set(phases)
+
+            # remote spans live INSIDE each phase's subtree — since the
+            # scatter went parallel (utils/legs.py) they sit one level
+            # down, under the member's legs.leg span, on both arms
+            def walk(span):
+                yield span
+                for ch in span.get("children", []):
+                    yield from walk(ch)
+
             remote = [ch for ph in ("dist.dfs", "dist.query", "dist.fetch")
-                      for ch in phases[ph].get("children", [])
+                      for ch in walk(phases[ph])
                       if ch.get("attributes", {}).get("node") == "b"]
             assert remote, "no remote spans nested under coordinator"
             # remote spans carry the propagated wire context
