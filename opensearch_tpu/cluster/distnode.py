@@ -500,7 +500,7 @@ class DistClusterNode:
             return 200, {"acknowledged": True}
         if op in ("dfs", "query_phase", "fetch_phase",
                   "stats", "node_stats", "hot_threads", "history",
-                  "insights", "remediation"):
+                  "insights", "remediation", "indexing"):
             # deadline propagation: re-anchor the remaining budget the
             # coordinator stamped; an already-exhausted budget answers an
             # immediate 408 shard failure instead of a full local phase
@@ -516,7 +516,8 @@ class DistClusterNode:
                               f"deadline budget"}}
             with _dl.scope(dl):
                 if op in ("stats", "node_stats", "hot_threads",
-                          "history", "insights", "remediation"):
+                          "history", "insights", "remediation",
+                          "indexing"):
                     return 200, self._handle_obs(op, body)
                 return self._handle_phase(op, body)
         if op == "state" and method == "GET":
@@ -812,6 +813,9 @@ class DistClusterNode:
         `dist.replica_write_failed`) — the caller must retry or drop the
         copy; silent divergence would poison failover byte-identity
         (stale-copy repair is future work)."""
+        import time as _t
+
+        from ..obs import ingest_obs as _iobs
         from ..utils.metrics import METRICS
         r = self.routing.get(index)
         if r is None:
@@ -821,6 +825,7 @@ class DistClusterNode:
         shard = shard_for(id, n)
         holders = self.copies.get(index, {}).get(shard, [r[shard]])
         refresh_q = "?refresh=true" if refresh else ""
+        t0 = _t.perf_counter()
         out = None
         for ord_, holder in enumerate(holders):
             try:
@@ -834,6 +839,7 @@ class DistClusterNode:
                 if ord_ == 0:
                     raise   # primary never applied: clean failure
                 METRICS.counter("dist.replica_write_failed").inc()
+                _iobs.count("indexing.replica.failed")
                 raise ApiError(
                     500, "replica_write_exception",
                     f"doc [{id}] applied on {holders[:ord_]} but copy "
@@ -842,6 +848,13 @@ class DistClusterNode:
                     f"copy")
             if out is None:
                 out = res
+        if len(holders) > 1 and _iobs.enabled():
+            # whole-fanout wall time (primary + every copy), the
+            # write-through analog of the replica sync span
+            METRICS.counter("indexing.replica.write_through").inc(
+                len(holders) - 1)
+            METRICS.histogram("indexing.replica.fanout_ms").record(
+                (_t.perf_counter() - t0) * 1000.0)
         return out
 
     def get(self, index: str, id: str) -> dict:
@@ -865,8 +878,11 @@ class DistClusterNode:
             except (urllib.error.URLError, OSError):
                 # an unreachable member misses the refresh; its copies
                 # serve stale until it rejoins — counted, never silent
-                # (OSL508)
+                # (OSL508). Mirrored into the write-path failure family
+                # so the ingest observatory sees it too.
                 METRICS.counter("dist.refresh.failed").inc()
+                from ..obs import ingest_obs as _iobs
+                _iobs.count("indexing.refresh.fanout_failed")
 
     def _owner(self, index: str, id: str) -> str:
         r = self.routing.get(index)
@@ -1424,6 +1440,14 @@ class DistClusterNode:
             local = self.client.nodes_stats()
             block = local["nodes"].get(self.node.node_name) or {}
             return {"node": self.name, "stats": block}
+        if op == "indexing":
+            # this node's `indexing.*` registry slice in wire form — the
+            # coordinator sums counters/gauges and MERGES the sketches
+            # (obs/ingest_obs.merge_parts), so fleet refresh-to-visible
+            # percentiles come from one merged sketch
+            from ..obs import ingest_obs as _iobs
+            return {"node": self.name,
+                    "parts": _iobs.local_parts(self._obs_reg())}
         if op == "hot_threads":
             from ..obs.hot_threads import hot_threads as _ht
             return {"node": self.name, "result": _ht(
@@ -1556,6 +1580,34 @@ class DistClusterNode:
                             for k, w in merged.items()},
             "histograms": merged,
         }
+
+    def indexing_stats(self) -> dict:
+        """`GET /_nodes/stats/indexing` federated: scrape every member's
+        `indexing.*` wire parts, fold them (counters and gauges sum —
+        the fleet writer buffer is the sum of node buffers; DDSketch
+        histograms merge bin-wise), then assemble the SAME block shape
+        one node serves (obs/ingest_obs.assemble_block). Percentiles are
+        computed from the merged sketch, never averaged. Unreachable
+        members degrade to `failed` entries in `_nodes`."""
+        from ..obs import ingest_obs as _iobs
+        scraped = self._scrape("indexing", {})
+        parts = []
+        nodes = {}
+        ok = 0
+        for member, (status, res) in scraped.items():
+            if status == "ok":
+                ok += 1
+                parts.append(res.get("parts") or {})
+                nodes[member] = {"status": "ok"}
+            else:
+                nodes[member] = {"status": "failed", "error": res}
+        block = _iobs.assemble_block(_iobs.merge_parts(parts), nodes=ok)
+        return {"cluster_name": self.node.metadata.cluster_name,
+                "coordinator": self.name,
+                "_nodes": {"total": len(scraped), "successful": ok,
+                           "failed": len(scraped) - ok},
+                "nodes": nodes,
+                "indexing": block}
 
     def nodes_stats_federated(self, node_id: Optional[str] = None
                               ) -> dict:

@@ -23,6 +23,8 @@ from ..search import impactpath
 from ..search.executor import ShardSearcher, msearch_batched, search_shards
 from ..utils.breaker import BreakerService
 from ..obs import flight_recorder as _fr
+from ..obs import ingest_obs as _iobs
+from ..utils.metrics import METRICS
 from ..utils.slowlog import SlowLog
 from ..utils.tasks import TaskRegistry
 from ..utils.threadpool import ThreadPools
@@ -50,6 +52,7 @@ class IndexService:
         for sid in range(meta.num_shards):
             path = os.path.join(data_path, meta.name, str(sid)) if data_path else None
             eng = Engine(self.mappings, path=path)
+            eng.index_name = meta.name   # labels per-index write-path obs
             self.shards.append(eng)
             self.searchers.append(ShardSearcher(eng, shard_id=sid,
                                                 similarity=self.default_sim,
@@ -193,8 +196,13 @@ class IndexService:
     def refresh(self) -> None:
         for s in self.shards:
             s.refresh()
-        for rep in self.replicas.values():
-            rep.sync()
+        if self.replicas:
+            t0 = time.perf_counter()
+            for rep in self.replicas.values():
+                rep.sync()
+            if _iobs.enabled():
+                _iobs.record_replica_sync(
+                    len(self.replicas), (time.perf_counter() - t0) * 1000.0)
         self.generation += 1
 
     def flush(self) -> None:
@@ -218,14 +226,18 @@ class IndexService:
                     try:
                         self.remote.upload_shard(eng.path, sid)
                     except Exception:   # noqa: BLE001
-                        pass   # failure + lag recorded by the tracker
+                        # failure + lag recorded by the tracker; also
+                        # counted into the write-path failure family
+                        _iobs.count("indexing.flush.remote_failed")
             try:
                 self.remote.upload_index_meta({
                     "settings": self.meta.settings,
                     "mappings": self.mappings.to_dict(),
                     "state": self.meta.state})
             except Exception:           # noqa: BLE001
-                pass   # counted by upload_index_meta itself
+                # counted by upload_index_meta itself, mirrored here so
+                # `indexing.flush.remote_failed` covers every swallow
+                _iobs.count("indexing.flush.remote_failed")
 
     def force_merge(self, max_num_segments: int = 1) -> None:
         for s in self.shards:
@@ -251,16 +263,28 @@ class IndexService:
                     store_bytes += col.values.nbytes
         ops = {k: sum(s.stats[k] for s in self.shards)
                for k in ("index_ops", "delete_ops", "refreshes", "flushes", "merges")}
+        buf = [s.buffer_stats() for s in self.shards]
+        # per-index refresh-to-visible percentiles: the accept→searchable
+        # sketch this index's refreshes recorded ({} until the first one)
+        rtv = METRICS.percentiles(
+            f"indexing.index.{self.meta.name}.refresh_to_visible_ms")
         return {"docs": {"count": self.num_docs},
                 "store": {"size_in_bytes": store_bytes},
                 "slowlog": {"search": self.search_slowlog.stats(),
                             "indexing": self.index_slowlog.stats()},
                 "segments": {"count": seg_count},
                 "indexing": {"index_total": ops["index_ops"],
-                             "delete_total": ops["delete_ops"]},
-                "refresh": {"total": ops["refreshes"]},
+                             "delete_total": ops["delete_ops"],
+                             "buffer": {
+                                 "docs": sum(b["docs"] for b in buf),
+                                 "bytes": sum(b["bytes"] for b in buf)}},
+                "refresh": {"total": ops["refreshes"],
+                            **({"refresh_to_visible_ms": rtv}
+                               if rtv else {})},
                 "flush": {"total": ops["flushes"]},
-                "merges": {"total": ops["merges"]},
+                "merges": {"total": ops["merges"],
+                           "backlog": sum(s.merge_backlog()
+                                          for s in self.shards)},
                 **({"remote_store": self.remote.stats()}
                    if self.remote is not None else {})}
 

@@ -39,6 +39,12 @@ docs/STATIC_ANALYSIS.md for rationale and ADVICE.md lineage):
   through a designated normalizer (fusion.normalize_scores) or fuse in
   the rank domain (RRF) — raw BM25/cosine/sparse-dot scores are
   incomparable (docs/HYBRID.md).
+- OSL605 write-path emission discipline (`ingest_obs_rules`):
+  wall-clock duration subtraction / in-loop `time.time()`,
+  per-iteration metric-registry emission, and unguarded recorder
+  events in `index/` + `ingest/` — the ingest observatory's contract
+  that hot modules call one guarded helper (docs/OBSERVABILITY.md
+  "Ingest observatory").
 - OSL701-OSL704 whole-program concurrency suite (`concurrency/`):
   unlike every rule above, these run INTERPROCEDURALLY over the full
   package — a lock inventory with alias resolution, a call-graph walk
@@ -66,6 +72,7 @@ from .core import (Baseline, Checker, Finding, default_checkers,
 from .dtype_rules import DtypeDisciplineChecker
 from .fusion_rules import FusionDomainChecker
 from .impact_rules import ImpactDomainChecker
+from .ingest_obs_rules import IngestObsDisciplineChecker
 from .insights_rules import InsightsCardinalityChecker
 from .jit_rules import JitBoundaryChecker
 from .lock_rules import LockDisciplineChecker
@@ -79,7 +86,8 @@ __all__ = [
     "JitBoundaryChecker",
     "BreakerDisciplineChecker", "LockDisciplineChecker",
     "DeviceSyncDisciplineChecker", "MemoryAccountingChecker",
-    "ImpactDomainChecker", "InsightsCardinalityChecker",
+    "ImpactDomainChecker", "IngestObsDisciplineChecker",
+    "InsightsCardinalityChecker",
     "ActuatorDisciplineChecker",
     "CONCURRENCY_RULES", "build_lock_order", "build_program",
     "diff_lock_order", "run_program_scope",

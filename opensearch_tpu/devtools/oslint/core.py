@@ -128,6 +128,7 @@ def default_checkers() -> List[Checker]:
     from .dtype_rules import DtypeDisciplineChecker
     from .fusion_rules import FusionDomainChecker
     from .impact_rules import ImpactDomainChecker
+    from .ingest_obs_rules import IngestObsDisciplineChecker
     from .insights_rules import InsightsCardinalityChecker
     from .jit_rules import JitBoundaryChecker
     from .lock_rules import LockDisciplineChecker, WaitDisciplineChecker
@@ -145,7 +146,8 @@ def default_checkers() -> List[Checker]:
             MemoryAccountingChecker(), ImpactDomainChecker(),
             RpcDisciplineChecker(), SamplerDisciplineChecker(),
             ScorePlaneChecker(), InsightsCardinalityChecker(),
-            ActuatorDisciplineChecker(), FusionDomainChecker()]
+            ActuatorDisciplineChecker(), FusionDomainChecker(),
+            IngestObsDisciplineChecker()]
 
 
 def run_source(src: str, path: str,

@@ -1,0 +1,248 @@
+"""OSL605 — write-path emission discipline.
+
+The ingest observatory (obs/ingest_obs.py) threads counters, gauges,
+and DDSketch histograms through bulk accept, refresh, merge, translog,
+and replica fan-out. Those are the hottest loops the engine owns — a
+refresh walks every buffered doc, a merge walks every segment — so the
+instrumentation contract is strict: hot modules take timestamps and
+call ONE guarded emission helper; the loops over metric names live in
+obs/ where OSL605 does not look.
+
+Three ways a write-path emission site quietly breaks that contract:
+
+- **Wall-clock durations.** A `time.time()` subtraction (or any
+  `time.time()` call inside a `for`/`while` body) measures a duration
+  with a clock NTP can step. Stage attribution that must sum to total
+  refresh wall time cannot survive a negative stage. Durations come
+  from `time.perf_counter()`/`time.monotonic()`; wall time is for
+  metadata stamps only, outside loops.
+- **Per-iteration metric emission.** `METRICS.counter(...).inc()` (or
+  `.histogram(...).record(...)`, `.gauge(...).set(...)`) inside a loop
+  body pays a registry lock + dict lookup per element. Hoist the
+  handle, accumulate locally and emit once after the loop, or use the
+  vectorized `record_many`. The ONE sanctioned in-loop form is
+  `_iobs.count(...)` — it checks the observatory's enabled flag before
+  touching the registry, which is the whole point.
+- **Unguarded event emission.** A flight-recorder event call
+  (`.record` with >= 2 positional args or any keyword) builds its
+  payload dict before the callee can check `enabled`. Same contract as
+  OSL505, extended to the write path: guard with `if ...enabled:` or
+  `if <timeline>:`.
+
+Scope is `index/` and `ingest/`; `obs/` and `devtools/` are exempt
+(the emission helpers and this checker's own fixtures live there).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import Checker, Finding, qualname_map
+from .core import dotted_name as _dotted
+
+# registry-emission attribute terminals: the lookup half and the
+# emission half of a `METRICS.counter("x").inc()` chain
+_REGISTRY_LOOKUPS = ("counter", "histogram", "gauge")
+
+
+def _contains_enabled(test: ast.AST) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Attribute) and n.attr == "enabled":
+            return True
+        if isinstance(n, ast.Call) and _dotted(n.func).endswith("enabled"):
+            return True
+    return False
+
+
+def _test_names(test: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            d = _dotted(n)
+            if d:
+                out.add(d)
+    return out
+
+
+def _first_arg_name(call: ast.Call) -> Optional[str]:
+    if not call.args:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Name):
+        return a.id
+    if isinstance(a, ast.Attribute):
+        return _dotted(a) or None
+    return None
+
+
+class IngestObsDisciplineChecker(Checker):
+    rules = ("OSL605",)
+    name = "ingest-obs-discipline"
+
+    SCOPES = ("index/", "ingest/")
+    EXEMPT = ("obs/", "devtools/")
+
+    def applies(self, path: str) -> bool:
+        if any(s in path for s in self.EXEMPT):
+            return False
+        return any(s in path for s in self.SCOPES)
+
+    # ---------------- helpers ----------------
+
+    @staticmethod
+    def _time_aliases(tree: ast.Module):
+        mods: Set[str] = set()
+        funcs: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        mods.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "time":
+                        funcs.add(a.asname or "time")
+        return mods, funcs
+
+    def _is_walltime(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        d = _dotted(node.func)
+        if d in self._funcs:
+            return True
+        head, _, tail = d.rpartition(".")
+        return tail == "time" and head in self._mods
+
+    def _walltime_within(self, node: ast.AST) -> bool:
+        return any(self._is_walltime(n) for n in ast.walk(node))
+
+    @staticmethod
+    def _is_registry_emission(node: ast.Call) -> bool:
+        """A `METRICS.counter("x")` lookup, or an `.inc`/`.record`/`.set`
+        chained directly off one. The chained form reports at the
+        emission site; the bare-lookup form catches the hoistable
+        handle being re-fetched each iteration."""
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return False
+        if fn.attr in _REGISTRY_LOOKUPS:
+            base = _dotted(fn.value)
+            return base.split(".")[-1] == "METRICS" or base.endswith("registry")
+        if fn.attr in ("inc", "record", "set"):
+            inner = fn.value
+            return (isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr in _REGISTRY_LOOKUPS)
+        return False
+
+    @staticmethod
+    def _is_sanctioned_count(node: ast.Call) -> bool:
+        """`_iobs.count(...)` / `ingest_obs.count(...)` — the guarded
+        loop-safe form (it reads the enabled flag before the registry)."""
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "count"):
+            return False
+        base = _dotted(fn.value).split(".")[-1]
+        return base in ("_iobs", "iobs", "ingest_obs")
+
+    @staticmethod
+    def _is_event_record(node: ast.Call) -> bool:
+        return (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and (len(node.args) >= 2 or bool(node.keywords)))
+
+    # ---------------- check ----------------
+
+    def check(self, tree: ast.Module, path: str, src: str) -> List[Finding]:
+        findings: List[Finding] = []
+        qmap = qualname_map(tree)
+        self._mods, self._funcs = self._time_aliases(tree)
+
+        # ancestor Call chains, so a chained `METRICS.counter("x").inc()`
+        # reports once (at the outer emission call), not twice
+        _parents = {}
+
+        def link(node: ast.AST, chain: List[ast.Call]) -> None:
+            nxt = chain + [node] if isinstance(node, ast.Call) else chain
+            for child in ast.iter_child_nodes(node):
+                _parents[id(child)] = nxt
+                link(child, nxt)
+
+        link(tree, [])
+
+        def visit(node: ast.AST, guards: List[ast.AST],
+                  loop_depth: int) -> None:
+            if isinstance(node, ast.If):
+                for child in node.body:
+                    visit(child, guards + [node.test], loop_depth)
+                for child in node.orelse:
+                    visit(child, guards, loop_depth)
+                return
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                # the iterable/test evaluates once; only the body loops
+                for child in node.body + node.orelse:
+                    visit(child, guards, loop_depth + 1)
+                return
+
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if (self._walltime_within(node.left)
+                        or self._walltime_within(node.right)):
+                    findings.append(Finding(
+                        "OSL605", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        "duration computed by subtracting time.time() — "
+                        "write-path stage attribution must use "
+                        "time.perf_counter()/time.monotonic(); wall time "
+                        "is for metadata stamps only",
+                        detail="walltime-duration"))
+
+            if isinstance(node, ast.Call):
+                if loop_depth > 0 and self._is_walltime(node):
+                    findings.append(Finding(
+                        "OSL605", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        "time.time() inside a write-path loop body — "
+                        "per-element stamps must be monotonic "
+                        "(time.monotonic/perf_counter); one wall anchor "
+                        "lives outside the loop",
+                        detail="walltime-in-loop"))
+                if (loop_depth > 0 and self._is_registry_emission(node)
+                        and not self._is_sanctioned_count(node)
+                        and not any(isinstance(p, ast.Call)
+                                    and self._is_registry_emission(p)
+                                    for p in _parents.get(id(node), []))):
+                    findings.append(Finding(
+                        "OSL605", path, node.lineno, node.col_offset,
+                        qmap.get(node, ""),
+                        "metric registry emission inside a write-path "
+                        "loop — hoist the handle / accumulate and emit "
+                        "once after the loop (or record_many); the "
+                        "guarded `_iobs.count(...)` is the one "
+                        "sanctioned in-loop form",
+                        detail="metric-in-loop"))
+                if self._is_event_record(node):
+                    tl_name = _first_arg_name(node)
+                    guarded = any(
+                        _contains_enabled(t)
+                        or (tl_name is not None
+                            and tl_name in _test_names(t))
+                        for t in guards)
+                    if not guarded:
+                        findings.append(Finding(
+                            "OSL605", path, node.lineno, node.col_offset,
+                            qmap.get(node, ""),
+                            "flight-recorder event on the write path "
+                            "without an enabled/timeline guard — the "
+                            "payload dict is built even when the "
+                            "recorder is off",
+                            detail="unguarded-record"))
+
+            for child in ast.iter_child_nodes(node):
+                visit(child, guards, loop_depth)
+
+        visit(tree, [], 0)
+        findings.sort(key=lambda f: (f.line, f.detail))
+        return findings

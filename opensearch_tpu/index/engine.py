@@ -18,6 +18,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from ..obs import ingest_obs as _iobs
+from ..utils.metrics import METRICS
 from .mappings import Mappings, ParsedDocument
 from .merge import TieredMergePolicy, merge_segments
 from .segment import (Segment, build_segment, build_segment_streaming,
@@ -58,9 +60,22 @@ class Engine:
         self.path = path
         self.merge_policy = merge_policy or TieredMergePolicy()
         self.primary_term = primary_term
+        self.index_name = ""       # set by IndexService; labels per-index obs
         self.segments: List[Segment] = []
         self.buffer: List[ParsedDocument] = []
         self.buffer_seq: List[int] = []
+        # accept-time monotonic stamp per buffered doc (parallel to
+        # `buffer`; survives tombstoning) — refresh publishes the
+        # accept→searchable delta as `indexing.refresh_to_visible_ms`
+        self.buffer_accepts: List[float] = []
+        # what THIS engine contributed to the process buffer gauges —
+        # refresh subtracts exactly this, so enable toggles mid-buffer
+        # never skew the totals
+        self._obs_buf_docs = 0
+        self._obs_buf_bytes = 0
+        # accepted docs not yet folded into the process gauges/counters
+        # (amortized every ingest_obs.FLUSH_EVERY docs and at refresh)
+        self._obs_pend_docs = 0
         self._buffer_ids: Dict[str, int] = {}
         self.seq_no = -1
         self._seg_counter = 0
@@ -109,10 +124,20 @@ class Engine:
         self._buffer_ids[doc_id] = len(self.buffer)
         self.buffer.append(parsed)
         self.buffer_seq.append(seq)
+        self.buffer_accepts.append(time.monotonic())
         self.version_map[doc_id] = DocLocation(seq, in_buffer=True,
                                                buffer_idx=len(self.buffer) - 1)
         self._tombstones.pop(doc_id, None)
         self.stats["index_ops"] += 1
+        if _iobs.enabled():
+            # ONE int add — this runs under the index write lock on every
+            # accepted doc; byte sizing and registry emission are
+            # amortized via _obs_flush_pending (every FLUSH_EVERY docs +
+            # at refresh). Anything heavier here is a measurable bulk
+            # throughput hit at 32 submit threads.
+            self._obs_pend_docs += 1
+            if self._obs_pend_docs >= _iobs.FLUSH_EVERY:
+                self._obs_flush_pending()
         return {"_id": doc_id, "_seq_no": seq, "_primary_term": self.primary_term,
                 "result": "updated" if existed else "created"}
 
@@ -128,6 +153,8 @@ class Engine:
             del self.version_map[doc_id]
             self._tombstones[doc_id] = seq
         self.stats["delete_ops"] += 1
+        if _iobs.enabled():
+            METRICS.counter("indexing.docs.deleted").inc()
         return {"_id": doc_id, "_seq_no": seq, "_primary_term": self.primary_term,
                 "result": "deleted" if found else "not_found"}
 
@@ -166,32 +193,64 @@ class Engine:
             sum(1 for d in self.buffer if d is not None)
 
     def refresh(self) -> bool:
-        live_docs = [(d, s) for d, s in zip(self.buffer, self.buffer_seq) if d is not None]
+        # Stage boundaries t0..t4 partition the refresh wall time EXACTLY
+        # (stage_i = t_{i+1} - t_i, so collect+build+publish+merge equals
+        # the total by construction — tests/test_ingest_obs.py pins it).
+        # Stamps are unconditional (4 perf_counter reads per refresh);
+        # everything else is gated on the ingest-obs flag.
+        t0 = time.perf_counter()
+        obs_on = _iobs.enabled()
+        self._obs_flush_pending()
+        live_docs = [(d, s, a) for d, s, a in
+                     zip(self.buffer, self.buffer_seq, self.buffer_accepts)
+                     if d is not None]
         self.buffer = []
         self.buffer_seq = []
+        self.buffer_accepts = []
         self._buffer_ids = {}
+        if self._obs_buf_docs or self._obs_buf_bytes:
+            _iobs.buffer_delta(-self._obs_buf_docs, -self._obs_buf_bytes)
+            self._obs_buf_docs = 0
+            self._obs_buf_bytes = 0
         if not live_docs:
             return False
-        docs = [d for d, _ in live_docs]
-        seqs = [s for _, s in live_docs]
+        docs = [d for d, _, _ in live_docs]
+        seqs = [s for _, s, _ in live_docs]
+        accepts = [a for _, _, a in live_docs]
         name = f"_{self._seg_counter}"
         self._seg_counter += 1
-        if len(docs) >= stream_refresh_min_docs() and stream_eligible(docs):
-            seg = build_segment_streaming(name, docs, self.mappings,
-                                          seq_nos=seqs,
-                                          spill_dir=(os.path.join(
-                                              self.path, "_stream_spill")
-                                              if self.path else None))
-            self.stats["stream_refreshes"] = \
-                self.stats.get("stream_refreshes", 0) + 1
-        else:
-            seg = build_segment(name, docs, self.mappings, seq_nos=seqs)
+        t1 = time.perf_counter()
+        streamed = False
+        with _iobs.stage_scope() as build_detail:
+            if len(docs) >= stream_refresh_min_docs() and stream_eligible(docs):
+                seg = build_segment_streaming(name, docs, self.mappings,
+                                              seq_nos=seqs,
+                                              spill_dir=(os.path.join(
+                                                  self.path, "_stream_spill")
+                                                  if self.path else None))
+                self.stats["stream_refreshes"] = \
+                    self.stats.get("stream_refreshes", 0) + 1
+                streamed = True
+            else:
+                seg = build_segment(name, docs, self.mappings, seq_nos=seqs)
+        t2 = time.perf_counter()
         self.segments.append(seg)
         for local, d in enumerate(docs):
             self.version_map[d.doc_id] = DocLocation(
                 seqs[local], in_buffer=False, segment=seg, local_doc=local)
         self.stats["refreshes"] += 1
+        t3 = time.perf_counter()
+        # the docs became searchable at publish (t3): record the honest
+        # accept→visible delta BEFORE the piggybacked merge work
+        if obs_on:
+            _iobs.record_refresh_to_visible(self.index_name, accepts,
+                                            time.monotonic())
         self.maybe_merge()
+        t4 = time.perf_counter()
+        if obs_on:
+            _iobs.record_refresh(self.index_name, len(docs), streamed,
+                                 (t0, t1, t2, t3, t4), build_detail,
+                                 self.merge_backlog())
         return True
 
     def maybe_merge(self) -> None:
@@ -199,6 +258,42 @@ class Engine:
             if len(group) < 2 and not any(s.live_count < s.ndocs for s in group):
                 continue
             self.force_merge_group(group)
+
+    def _obs_flush_pending(self) -> None:
+        """Fold the accepted docs since the last fold into the process
+        buffer gauges and the indexed counter (amortization contract:
+        ingest_obs.FLUSH_EVERY). Bytes are a sampled structural
+        estimate: size at most BYTES_SAMPLE docs from the buffer tail
+        (the ones this fold covers) and scale to the fold — the gauge
+        is an estimate by contract, and sizing every doc is a measured
+        bulk-throughput hit. Must run before the buffer is cleared."""
+        n = self._obs_pend_docs
+        if not n:
+            return
+        tail = self.buffer[-n:]
+        samples = [p for p in tail[::max(1, n // _iobs.BYTES_SAMPLE)]
+                   if p is not None][:_iobs.BYTES_SAMPLE]
+        est = (int(sum(_iobs.doc_bytes(p.source) for p in samples)
+                   / len(samples) * n) if samples else 0)
+        self._obs_buf_docs += n
+        self._obs_buf_bytes += est
+        self._obs_pend_docs = 0
+        _iobs.buffer_delta(n, est)
+        METRICS.counter("indexing.docs.indexed").inc(n)
+
+    def merge_backlog(self) -> int:
+        """Merge groups the policy would run right now — this engine's
+        slice of the `indexing.merge.backlog` write-pressure gauge (0
+        right after `maybe_merge` unless max_merged_docs defers work)."""
+        return len([g for g in self.merge_policy.find_merges(self.segments)
+                    if len(g) >= 2
+                    or any(s.live_count < s.ndocs for s in g)])
+
+    def buffer_stats(self) -> dict:
+        """Live writer-buffer shape (docs pending refresh + tracked byte
+        estimate) for `_stats` / `_cat/indices`."""
+        return {"docs": sum(1 for d in self.buffer if d is not None),
+                "bytes": self._obs_buf_bytes}
 
     def force_merge_group(self, group: List[Segment]) -> Segment:
         name = f"_m{self._seg_counter}"
@@ -238,6 +333,7 @@ class Engine:
     def flush(self) -> None:
         """Durable commit: segments to disk + commit point, translog rolled
         (reference: InternalEngine#flush -> Lucene commit + translog trim)."""
+        t0 = time.perf_counter()
         self.refresh()
         if self.path is None:
             return
@@ -252,6 +348,9 @@ class Engine:
                 import numpy as np
                 seg.save(d)
             committed.append(seg.name)
+        # translog age at commit = how stale durability was just before
+        # this flush (measured BEFORE rollover resets the generation)
+        tl_age = self.translog.age_s() if self.translog else 0.0
         gen = self.translog.rollover() if self.translog else 0
         commit = {"segments": committed, "seq_no": self.seq_no,
                   "translog_gen": gen, "primary_term": self.primary_term,
@@ -264,6 +363,8 @@ class Engine:
             self.translog.prune_below(gen)
         self.last_commit_gen = gen
         self.stats["flushes"] += 1
+        if _iobs.enabled():
+            _iobs.record_flush((time.perf_counter() - t0) * 1000.0, tl_age)
 
     # ---------------- recovery ----------------
 

@@ -5,10 +5,12 @@ CSR arrays (deleted docs are compacted away, exactly like Lucene merges).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List
 
 import numpy as np
 
+from ..obs import ingest_obs as _iobs
 from ..ops import device_merge
 from .segment import (CODEC_V2, GeoColumn, KeywordColumn, NumericColumn,
                       PostingsBlock, Segment, TextFieldStats, VectorColumn,
@@ -36,6 +38,12 @@ class TieredMergePolicy:
 
 def merge_segments(name: str, segments: List[Segment]) -> Segment:
     """Compacting multiway merge of N segments into one."""
+    # instrumentation is TOP-LEVEL only: nested child merges (name
+    # carries a "/") recurse through here and their wall time / sizes
+    # are already inside the parent's numbers
+    _obs = "/" not in name and _iobs.enabled()
+    _t0 = time.perf_counter()
+    _in_bytes = sum(_iobs.segment_nbytes(s) for s in segments) if _obs else 0
     live_masks = [s.live.astype(bool) for s in segments]
     live_counts = [int(m.sum()) for m in live_masks]
     ndocs = sum(live_counts)
@@ -309,13 +317,17 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
     # avgdl differs from every input's, so carried quantized values would
     # bake a stale norm); the O(P) quantize map itself runs on device
     # past the size threshold (ops/device_merge.quantize_impacts).
+    _reorder_s = 0.0
+    _reordered = False
     if default_codec_version() >= CODEC_V2:
         # feature planes (rank_features index_impacts opt-in) rebuild
         # whenever ANY input carried one for the field — the opt-in
         # travels with the data, so merges never need the mappings
         ffields = {f for s in segments for f, pb in s.postings.items()
                    if pb.impact is not None and pb.impact.kind == "feature"}
+        _q0 = time.perf_counter()
         merged.build_impacts(feature_fields=ffields)
+        _iobs.note_stage("quantize", time.perf_counter() - _q0)
         if "/" not in name:
             # BP-style impact-clustered doc-id reordering (index/reorder.py):
             # merges are the one point the whole doc set is in hand and the
@@ -325,7 +337,17 @@ def merge_segments(name: str, segments: List[Segment]) -> Segment:
             # deterministic, so copy holders re-running this merge stay
             # byte-identical (PR-9 replication contract).
             from .reorder import maybe_reorder
+            _r0 = time.perf_counter()
+            _pre = merged
             merged = maybe_reorder(merged)
+            _reorder_s = time.perf_counter() - _r0
+            _reordered = merged is not _pre
+    if _obs:
+        # input counts pre-compaction (deleted docs included) so
+        # input_docs - output_docs reads as "deletes reclaimed"
+        _iobs.record_merge(len(segments), sum(s.ndocs for s in segments),
+                           _in_bytes, merged, time.perf_counter() - _t0,
+                           _reorder_s, _reordered)
     return merged
 
 

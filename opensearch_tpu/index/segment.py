@@ -27,12 +27,14 @@ from __future__ import annotations
 import json
 import math
 import os
+import time
 from dataclasses import dataclass, field as dc_field
 from functools import cached_property
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs.ingest_obs import note_stage
 from .mappings import FLOAT_TYPES, GEO_TYPES, FieldType, Mappings
 
 INT32_SENTINEL = np.int32(2**31 - 1)  # padded doc_id -> dropped by scatter
@@ -748,6 +750,7 @@ class Segment:
         """Build + breaker-charge one (segment, device) cache entry.
         Caller holds _DEVICE_BUILD_LOCK and has re-checked the cache, so
         exactly one thread ever charges a given entry."""
+        _t_dev = time.perf_counter()
         import jax.numpy as jnp
 
         if device is not None:
@@ -805,6 +808,9 @@ class Segment:
             "postings": post, "numeric": ncols, "keyword": kcols, "geo": gcols,
             "vector": vcols, "doc_lens": dls, "nested": nst,
         }
+        # attributed only while a refresh/merge build is collecting —
+        # lazy query-time promotion hits the no-op path
+        note_stage("device_promote", time.perf_counter() - _t_dev)
         from ..obs.hbm_ledger import LEDGER
         # register THIS segment's new device residency with the HBM
         # ledger (which derives the breaker charge): every group built
@@ -1544,7 +1550,9 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
                 dl = doc_lens.setdefault(fname, np.zeros(ndocs, dtype=np.int64))
                 dl[doc_i] = len(terms)
 
+    _t_pack = time.perf_counter()
     postings = pack_postings(parsed_docs, with_positions)
+    note_stage("pack", time.perf_counter() - _t_pack)
 
     # ---- feature postings (rank_features / sparse_vector): CSR rows are
     # features, "tf" carries the feature weight — the device scores them with
@@ -1688,8 +1696,10 @@ def build_segment(name: str, parsed_docs: list, mappings: Mappings,
         # plus FEATURE planes for rank_features/sparse_vector fields
         # whose mapping opted into index_impacts (learned-sparse on the
         # impact ladder, docs/HYBRID.md)
+        _t_q = time.perf_counter()
         seg.build_impacts(feature_fields=feature_impact_fields(
             mappings, feat_fields))
+        note_stage("quantize", time.perf_counter() - _t_q)
     # term_vector=with_positions_offsets fields: per-doc (term, pos, start,
     # end) for the FVH path (host-only, like _source)
     seg.term_vectors = term_vectors
@@ -1787,6 +1797,7 @@ class StreamingSegmentBuilder:
         self._chunk = []
         if not docs:
             return
+        _t_spill = time.perf_counter()
         base = self._ndocs
         n = len(docs)
         self._ndocs += n
@@ -1912,6 +1923,7 @@ class StreamingSegmentBuilder:
         np.savez(os.path.join(self._dir, f"chunk{len(self._chunks)}.npz"),
                  **arrays)
         self._chunks.append(meta)
+        note_stage("spill", time.perf_counter() - _t_spill)
 
     # ---------------- merge ----------------
 
@@ -2005,6 +2017,7 @@ class StreamingSegmentBuilder:
         assert not self._finished
         self._finished = True
         self._flush_chunk()
+        _t_merge = time.perf_counter()
         ndocs = self._ndocs
         try:
             post_fields = sorted({f for m in self._chunks
@@ -2122,6 +2135,7 @@ class StreamingSegmentBuilder:
                           seq_nos=seq, vector_cols=vector_cols,
                           stored_vals=(self._stored if self._any_stored
                                        else None))
+            note_stage("chunk_merge", time.perf_counter() - _t_merge)
             if default_codec_version() >= CODEC_V2:
                 # no feature_fields here BY INVARIANT: docs carrying
                 # rank_features are not stream-eligible
@@ -2132,7 +2146,9 @@ class StreamingSegmentBuilder:
                 # `feature_impact_fields(self.mappings, ...)` through
                 # here or big-buffer refreshes silently lose the plane
                 # (and merges of such segments lose the opt-in forever).
+                _t_q = time.perf_counter()
                 seg.build_impacts()
+                note_stage("quantize", time.perf_counter() - _t_q)
             seg.term_vectors = None
             return seg
         finally:

@@ -7,7 +7,10 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Iterator, Optional
+
+from ..obs import ingest_obs as _iobs
 
 
 class Translog:
@@ -17,6 +20,10 @@ class Translog:
         self.generation = generation
         self._fh = open(self._gen_path(generation), "a", encoding="utf-8")
         self.ops_count = 0
+        # generation start (monotonic): age of the oldest un-committed op
+        # is bounded by now - this stamp, the `indexing.translog.age_s`
+        # gauge the flush path publishes
+        self._gen_started = time.monotonic()
 
     def _gen_path(self, gen: int) -> str:
         return os.path.join(self.dir, f"translog-{gen}.log")
@@ -29,10 +36,18 @@ class Translog:
         self._append({"op": "delete", "_id": doc_id, "seq_no": seq_no})
 
     def _append(self, rec: dict) -> None:
-        self._fh.write(json.dumps(rec) + "\n")
+        line = json.dumps(rec) + "\n"
+        self._fh.write(line)
         self._fh.flush()
         os.fsync(self._fh.fileno())
         self.ops_count += 1
+        if _iobs.enabled():
+            _iobs.record_translog_append(len(line))
+
+    def age_s(self) -> float:
+        """Seconds since this generation started — an upper bound on the
+        age of the oldest op not yet covered by a commit point."""
+        return time.monotonic() - self._gen_started
 
     def rollover(self) -> int:
         """Start a new generation (at flush/commit); returns the new gen id
@@ -41,6 +56,7 @@ class Translog:
         self.generation += 1
         self._fh = open(self._gen_path(self.generation), "a", encoding="utf-8")
         self.ops_count = 0
+        self._gen_started = time.monotonic()
         return self.generation
 
     def prune_below(self, gen: int) -> None:

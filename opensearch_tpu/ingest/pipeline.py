@@ -9,7 +9,10 @@ import copy
 import datetime as _dt
 import re
 import threading as _threading
+import time as _time
 from typing import Any, Callable, Dict, List, Optional
+
+from ..obs import ingest_obs as _iobs
 
 
 class IngestProcessorException(Exception):
@@ -324,9 +327,16 @@ class Pipeline:
                 return None
             except IngestProcessorException:
                 if on_failure:
+                    # the on_failure chain replaces (and swallows) the
+                    # original error — count it or it vanishes without
+                    # a trace (write-path swallowed-exception audit)
+                    _iobs.count("indexing.pipeline.failed")
                     for fp in on_failure:
                         fp(doc)
-                elif not ignore_failure:
+                elif ignore_failure:
+                    # swallowed silently by config — still counted
+                    _iobs.count("indexing.pipeline.failed")
+                else:
                     raise
         return doc
 
@@ -348,7 +358,13 @@ class IngestService:
         p = self.pipelines.get(pid)
         if p is None:
             raise IngestProcessorException(f"pipeline [{pid}] does not exist")
-        return p.run(doc)
+        if not _iobs.enabled():
+            return p.run(doc)
+        t0 = _time.perf_counter()
+        out = p.run(doc)
+        _iobs.record_pipeline((_time.perf_counter() - t0) * 1000.0,
+                              out is None)
+        return out
 
     def simulate(self, config: dict, docs: List[dict]) -> List[dict]:
         p = Pipeline("_simulate", config, service=self)

@@ -13,10 +13,10 @@ from .flight_recorder import (FlightRecorder, RECORDER, current,
                               reset_current, set_current)
 from .hbm_ledger import LEDGER, HBMLedger
 from .hot_threads import hot_threads
-from .slo import SLO, SLO_ENGINE, SLOEngine, default_slos
+from .slo import SLO, SLO_ENGINE, SLOEngine, default_slos, ingest_slos
 from .timeseries import SAMPLER, TimeSeriesSampler
 
 __all__ = ["FlightRecorder", "RECORDER", "current", "set_current",
            "reset_current", "hot_threads", "LEDGER", "HBMLedger",
            "SAMPLER", "TimeSeriesSampler", "SLO", "SLOEngine",
-           "SLO_ENGINE", "default_slos"]
+           "SLO_ENGINE", "default_slos", "ingest_slos"]

@@ -105,7 +105,7 @@ class FlightRecorder:
     # anomaly reasons with a cooldown (storm-shaped triggers must not
     # flood the dump store); wedges/deadline misses always dump
     _COOLDOWN_REASONS = ("rejection_burst", "slowlog", "oracle_mismatch",
-                         "retry_storm", "slo_burn")
+                         "retry_storm", "slo_burn", "refresh_stall")
 
     def __init__(self, capacity: Optional[int] = None,
                  max_dumps: Optional[int] = None,

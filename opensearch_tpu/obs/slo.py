@@ -57,7 +57,7 @@ from typing import Dict, List, Optional, Sequence
 from ..utils.metrics import METRICS, MetricsRegistry
 from .timeseries import SAMPLER, TimeSeriesSampler
 
-__all__ = ["SLO", "SLOEngine", "SLO_ENGINE", "default_slos"]
+__all__ = ["SLO", "SLOEngine", "SLO_ENGINE", "default_slos", "ingest_slos"]
 
 _KINDS = ("latency", "error_rate", "availability", "rejection_rate",
           "counter_ratio")
@@ -81,7 +81,8 @@ class SLO:
                  burn_threshold: float = 10.0,
                  min_events: int = 1,
                  bad_metrics: Optional[Sequence[str]] = None,
-                 total_metrics: Optional[Sequence[str]] = None):
+                 total_metrics: Optional[Sequence[str]] = None,
+                 histogram: Optional[str] = None):
         if kind not in _KINDS:
             raise ValueError(f"unknown SLO kind [{kind}] "
                              f"(one of {_KINDS})")
@@ -109,12 +110,16 @@ class SLO:
         self.min_events = int(min_events)
         self.bad_metrics = list(bad_metrics or [])
         self.total_metrics = list(total_metrics or [])
+        # explicit histogram override: a latency-kind objective over ANY
+        # registry sketch (ingest SLOs window refresh-to-visible or the
+        # merge-backlog depth sketch instead of a search lane)
+        self.histogram = histogram
 
     # -- metric resolution (lane-parameterized SLI names) --
 
     @property
     def latency_hist(self) -> str:
-        return f"search.lane.{self.lane}.latency_ms"
+        return self.histogram or f"search.lane.{self.lane}.latency_ms"
 
     def _lane_counter(self, leaf: str) -> str:
         return f"search.lane.{self.lane}.{leaf}"
@@ -176,6 +181,8 @@ class SLO:
                "min_events": self.min_events}
         if self.latency_budget_ms is not None:
             out["latency_budget_ms"] = self.latency_budget_ms
+        if self.histogram is not None:
+            out["histogram"] = self.histogram
         if self.kind == "counter_ratio":
             out["bad_metrics"] = self.bad_metrics
             out["total_metrics"] = self.total_metrics
@@ -202,6 +209,35 @@ def default_slos(lane: str = "interactive",
         SLO(f"{lane}-rejections", "rejection_rate", target=0.95,
             fast_window_s=fast_window_s, slow_window_s=slow_window_s,
             lane=lane),
+    ]
+
+
+def ingest_slos(refresh_budget_ms: float = 1000.0,
+                backlog_budget_segments: float = 8.0,
+                fast_window_s: float = 5.0,
+                slow_window_s: float = 30.0) -> List[SLO]:
+    """The write-path objective pair the ingest observatory arms.
+
+    Both ride the latency machinery over explicit histograms rather than
+    a search lane:
+
+    - refresh-lag: fraction of refresh-to-visible samples within
+      `refresh_budget_ms` must stay >= target. A stalled or throttled
+      refresh pushes accept->searchable deltas over budget and burns.
+    - merge-backlog burn: the backlog-depth sketch (sampled each refresh)
+      treated as a "latency" whose budget is a segment count. Sustained
+      backlog above `backlog_budget_segments` burns error budget — the
+      signal a defer-merges actuator would consume.
+    """
+    return [
+        SLO("ingest-refresh-lag", "latency", target=0.95,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            lane="ingest", latency_budget_ms=refresh_budget_ms,
+            histogram="indexing.refresh_to_visible_ms"),
+        SLO("ingest-merge-backlog", "latency", target=0.90,
+            fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+            lane="ingest", latency_budget_ms=backlog_budget_segments,
+            histogram="indexing.merge.backlog_depth"),
     ]
 
 

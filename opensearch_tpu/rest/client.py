@@ -24,6 +24,7 @@ from ..search import compiler as C
 from ..search import fastpath as _fastpath
 from ..search import query_dsl as dsl
 from ..search.pipeline import SearchPipelineException
+from ..obs import ingest_obs as _iobs
 from ..utils.breaker import CircuitBreakingException
 from ..utils.tasks import TaskCancelledException
 from ..utils.wlm import PressureRejectedException
@@ -319,6 +320,7 @@ class RestClient:
     def bulk(self, body, index: Optional[str] = None, refresh: bool = False) -> dict:
         """Bulk API. Accepts NDJSON string or a list of alternating
         action/source dicts (reference RestBulkAction)."""
+        t0 = time.perf_counter()
         if isinstance(body, str):
             lines = [json.loads(ln) for ln in body.splitlines() if ln.strip()]
         else:
@@ -329,9 +331,14 @@ class RestClient:
         try:
             self.node.wlm.indexing.acquire(est_bytes)
         except PressureRejectedException as e:
+            _iobs.count("indexing.bulk.rejected")
             raise ApiError(429, "rejected_execution_exception", str(e))
         try:
-            return self._bulk_inner(lines, index, refresh)
+            out = self._bulk_inner(lines, index, refresh)
+            if _iobs.enabled():
+                _iobs.record_bulk(len(out["items"]), est_bytes,
+                                  (time.perf_counter() - t0) * 1000.0)
+            return out
         finally:
             self.node.wlm.indexing.release(est_bytes)
 
@@ -373,6 +380,10 @@ class RestClient:
                 touched.add(idx)
             except ApiError as e:
                 errors = True
+                # the per-item error is reported in the response but the
+                # request as a whole succeeds — count it or bulk failures
+                # are invisible to dashboards (swallowed-exception audit)
+                _iobs.count("indexing.bulk.item_failed")
                 items.append({action: {"_index": idx, "_id": doc_id,
                                        "status": e.status, "error": e.body()["error"]}})
         if refresh:
@@ -1014,11 +1025,22 @@ class RestClient:
             # remediation actuator (serving/remediator.py): live action
             # count + engage/shed totals (full view at GET /_remediation)
             "remediation": n.remediation.stats(),
+            # ingest observatory (obs/ingest_obs.py): the whole write
+            # path — bulk accept, pipelines, writer buffer, refresh with
+            # stage attribution + refresh-to-visible, merge + reorder,
+            # flush, translog, replica fan-out. Federated fleet-wide by
+            # `DistClusterNode.indexing_stats` (summed counters, MERGED
+            # sketches — percentiles never averaged)
+            "indexing": self._indexing_block(),
         }
         if n.mesh_service is not None:
             node_block["mesh"] = n.mesh_service.stats()
         return {"cluster_name": n.metadata.cluster_name,
                 "nodes": {n.node_name: node_block}}
+
+    @staticmethod
+    def _indexing_block() -> dict:
+        return _iobs.assemble_block(_iobs.local_parts())
 
     @staticmethod
     def _impactpath_block() -> dict:
@@ -2055,12 +2077,21 @@ class CatClient:
         out = []
         for n, svc in sorted(self.c.node.indices.items()):
             st = svc.stats()
+            buf = st["indexing"].get("buffer", {})
             out.append({"health": svc.health_status(), "status": "open",
                         "index": n,
                         "pri": str(svc.meta.num_shards),
                         "rep": str(svc.meta.num_replicas),
                         "docs.count": str(st["docs"]["count"]),
-                        "store.size": str(st["store"]["size_in_bytes"])})
+                        "store.size": str(st["store"]["size_in_bytes"]),
+                        # write-pressure columns (ingest observatory):
+                        # docs/bytes sitting in the writer buffer, merges
+                        # run so far, merge groups still pending
+                        "buffer.docs": str(buf.get("docs", 0)),
+                        "buffer.bytes": str(buf.get("bytes", 0)),
+                        "merges.total": str(st["merges"]["total"]),
+                        "merges.backlog": str(st["merges"].get("backlog",
+                                                               0))})
         return out
 
     def shards(self, index: str = "_all", format: str = "json") -> List[dict]:

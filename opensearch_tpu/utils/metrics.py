@@ -132,6 +132,32 @@ class LatencyHistogram:
             self.count += 1
             self.sum_ms += float(ms)
 
+    def record_many(self, values) -> None:
+        """Vectorized `record` for a batch (the refresh-to-visible path:
+        one refresh lands one delta per published doc). Binning runs the
+        same f32 arithmetic as `ops/aggs.ddsketch_bin` element-wise, so a
+        value records into the identical bin either way (tests pin scalar
+        /vector parity), and the whole batch costs ONE lock acquisition."""
+        import numpy as np
+        from ..ops.aggs import DD_HALF, DD_LN_GAMMA, DD_MIN_MAG
+        arr = np.asarray(values, dtype=np.float64)
+        if arr.size == 0:
+            return
+        mag = np.abs(arr).astype(np.float32)
+        ln = np.log(np.maximum(mag, np.float32(DD_MIN_MAG)))
+        idx = np.floor((ln - np.float32(np.log(DD_MIN_MAG)))
+                       / np.float32(DD_LN_GAMMA)).astype(np.int64)
+        np.clip(idx, 0, DD_HALF - 1, out=idx)
+        b = np.where(arr > 0, DD_HALF + 1 + idx,
+                     np.where(arr < 0, DD_HALF - 1 - idx, DD_HALF))
+        bins_u, counts = np.unique(b, return_counts=True)
+        batch_sum = float(arr.sum())
+        with self._lock:
+            for bi, c in zip(bins_u.tolist(), counts.tolist()):
+                self._bins[bi] = self._bins.get(bi, 0) + c
+            self.count += int(arr.size)
+            self.sum_ms += batch_sum
+
     def percentile(self, p: float) -> Optional[float]:
         with self._lock:
             total = self.count
@@ -461,7 +487,9 @@ def render_prometheus(registry: MetricsRegistry,
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{labeled(pn)} {v}")
     for n, h in snap["histograms"].items():
-        pn = _prom_name(n) + "_ms"
+        pn = _prom_name(n)
+        if not pn.endswith("_ms"):     # unit suffix, never doubled
+            pn += "_ms"
         lines.append(f"# HELP {pn} DDSketch latency summary {n} (ms)")
         lines.append(f"# TYPE {pn} summary")
         for q, key in ((0.5, "p50_ms"), (0.95, "p95_ms"), (0.99, "p99_ms")):

@@ -44,7 +44,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _HIGHER_BETTER = ("qps", "skip_rate", "invocation_reduction",
                   "mean_batch", "qps_ratio", "overhead", "recall",
                   "green_ok", "released_ok", "shed_fraction",
-                  "byte_stable",
+                  "byte_stable", "docs_per_s",
                   # hybrid bench (ISSUE 15): bytes_ratio is
                   # exact-arm-over-impact-arm — bigger = more gather
                   # volume saved; `_ok` carries the 0/1 gate booleans
@@ -128,7 +128,8 @@ def metrics_of(doc: dict) -> dict:
         if _num(conc.get(k)) is not None:
             out[f"concurrency.{k}"] = conc[k]
     for gate in ("recorder_overhead_32t", "cost_overhead_32t",
-                 "sampler_overhead_32t", "insights_overhead_32t"):
+                 "sampler_overhead_32t", "insights_overhead_32t",
+                 "ingest_obs_overhead_32t"):
         g = conc.get(gate) or {}
         if _num(g.get("qps_ratio")) is not None:
             out[f"concurrency.{gate}.qps_ratio"] = g["qps_ratio"]
@@ -246,6 +247,20 @@ def metrics_of(doc: dict) -> dict:
         for k in ("lat_ms_p50", "lat_ms_p95"):
             if _num(sc.get(k)) is not None:
                 out[f"faults.{tag}.{k}"] = sc[k]
+    # ingest bench (scripts/measure_ingest.py, `extra.ingest`): bulk
+    # docs/s, honest refresh-to-visible percentiles, and query p99
+    # while indexing — the write-path surface (ISSUE 18). Direction:
+    # docs_per_s up, every *_ms down, degradation ratio down.
+    ing = extra.get("ingest") or {}
+    for k in ("docs_per_s", "query_p99_ms_baseline",
+              "query_p99_ms_while_indexing",
+              "query_p99_degradation_ratio"):
+        if _num(ing.get(k)) is not None:
+            out[f"ingest.{k}"] = ing[k]
+    rtv = ing.get("refresh_to_visible") or {}
+    for p in ("p50_ms", "p95_ms"):
+        if _num(rtv.get(p)) is not None:
+            out[f"ingest.refresh_to_visible.{p}"] = rtv[p]
     reorder = (extra.get("reorder") or {}).get("arms") or {}
     for arm, mixes in reorder.items():
         if not isinstance(mixes, dict):

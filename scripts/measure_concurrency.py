@@ -50,14 +50,25 @@ the same for the runtime lock-witness sanitizer
 (devtools/lockwitness.py) armed vs unarmed —
 `extra.concurrency.lockwitness_overhead_32t` — and additionally gates
 the armed cells on zero witnessed inversions and zero acquisition-order
-conflicts against the committed lock_order.json.
+conflicts against the committed lock_order.json. A sixth pair
+(ISSUE 18) covers the WRITE path: bulk-indexing docs/s with the ingest
+observatory (obs/ingest_obs.py) pinned ON vs OFF — 32 submit threads
+drain a deterministic chunk list into a recreated index per rep, under
+the same alternating-reps/noise-floor protocol, with bulk responses
+byte-identical between the on and off cells (digests normalize `took`
+and `_seq_no`, whose assignment order is submit-thread interleaving) →
+`extra.concurrency.ingest_obs_overhead_32t`.
 
 Results land in BENCH_out.json under `extra.concurrency` (merged into an
 existing bench emission when present). Run:
     python scripts/measure_concurrency.py [ndocs]
 Env: CONC_NQ (queries per cell, default 256), CONC_THREADS (comma list,
 default 1,8,32,64), CONC_DEPTHS (comma list, default 1,2),
-CONC_ASSERT=0 to report without gating.
+CONC_ASSERT=0 to report without gating, CONC_INGEST_DOCS (bulk docs per
+ingest-pair rep, default 4000), CONC_ONLY=ingest to run JUST the
+ingest-obs pair against a bare node (no search corpus) and merge it
+into BENCH_out.json — the cheap re-measure path for write-path-only
+changes.
 """
 
 import json
@@ -323,6 +334,174 @@ def run_cell(client, bodies, nthreads: int, mode, tag: str,
     return cell, results
 
 
+def _ingest_chunks(ndocs: int, chunk: int):
+    """Deterministic bulk bodies for the ingest pair: the same docs in
+    the same chunk order every rep, so the only variable between the
+    obs-on and obs-off cells is the observatory itself."""
+    lines = []
+    for d in range(ndocs):
+        lines.append({"index": {"_index": "ingestbench",
+                                "_id": f"d{d:06d}"}})
+        lines.append({"body": f"w{d % 97} w{d % 311} w{d % 13} common",
+                      "price": d % 1000})
+    step = 2 * chunk
+    return [lines[i:i + step] for i in range(0, len(lines), step)]
+
+
+def strip_bulk_variant(resp) -> str:
+    """Bulk-response digest for the ingest pair: zeroes `took` and
+    `_seq_no` — with 32 submit threads the per-shard seq assignment
+    order is interleaving-dependent — so ids, results, statuses and
+    the error flag must be byte-identical between cells."""
+    def scrub(o):
+        if isinstance(o, dict):
+            return {k: (0 if k in ("took", "_seq_no") else scrub(v))
+                    for k, v in o.items()}
+        if isinstance(o, list):
+            return [scrub(x) for x in o]
+        return o
+    return json.dumps(scrub(resp), sort_keys=True)
+
+
+def run_ingest_cell(client, chunks, nthreads: int, tag: str,
+                    obs_on: bool):
+    """One bulk-indexing rep: recreate the bench index, drain the chunk
+    list from `nthreads` submit threads (writes serialize on the index
+    write lock — the realistic concurrent-bulk shape), refresh, report
+    docs/s. The ingest observatory is pinned for the cell."""
+    from opensearch_tpu.obs import ingest_obs as _iobs
+    prev = _iobs.set_enabled(obs_on)
+    try:
+        if client.indices.exists("ingestbench"):
+            client.indices.delete("ingestbench")
+        client.indices.create("ingestbench", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "price": {"type": "integer"}}}})
+        results = [None] * len(chunks)
+        errors = [0]
+        pos = [0]
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = pos[0]
+                    if i >= len(chunks):
+                        return
+                    pos[0] += 1
+                try:
+                    results[i] = client.bulk(chunks[i])
+                except Exception:
+                    with lock:
+                        errors[0] += 1
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=worker) for _ in range(nthreads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        client.indices.refresh("ingestbench")
+        wall = time.perf_counter() - t0
+    finally:
+        _iobs.set_enabled(prev)
+    ndocs = sum(len(c) // 2 for c in chunks)
+    cell = {"tag": tag, "threads": nthreads, "mode": "bulk",
+            "ingest_obs": "on" if obs_on else "off", "docs": ndocs,
+            "errors": errors[0], "wall_s": round(wall, 4),
+            "qps": round(ndocs / max(wall, 1e-9), 1)}
+    return cell, results
+
+
+def ingest_obs_pair(client, rthreads: int):
+    """The ingest-obs overhead pair under the standard protocol: one
+    warmup rep, then alternating off/on/on/off bulk reps; returns
+    (summary block, errored count). Cells print as they land but are
+    NOT merged into the search grid's cell list — docs/s and search
+    qps are different units."""
+    ing_docs = int(os.environ.get("CONC_INGEST_DOCS", 4000))
+    chunks = _ingest_chunks(ing_docs, 200)
+    reps = {"ingest_obs_off": [], "ingest_obs_on": []}
+    digests = {}
+    errors = 0
+    run_ingest_cell(client, chunks, rthreads,
+                    f"{rthreads}-bulk-iobs-warmup", True)
+    for rep, (olabel, oflag) in enumerate(
+            (("ingest_obs_off", False), ("ingest_obs_on", True),
+             ("ingest_obs_on", True), ("ingest_obs_off", False))):
+        tag = f"{rthreads}-bulk-{olabel}-r{rep}"
+        cell, results = run_ingest_cell(client, chunks, rthreads, tag,
+                                        oflag)
+        errors += cell["errors"]
+        digests.setdefault(olabel, [strip_bulk_variant(r)
+                                    if r is not None else None
+                                    for r in results])
+        reps[olabel].append(cell)
+        print(json.dumps(cell), flush=True)
+    pair = {lab: max(rr, key=lambda c: c["qps"])
+            for lab, rr in reps.items()}
+    bad = sum(1 for a, b in zip(digests["ingest_obs_off"],
+                                digests["ingest_obs_on"]) if a != b)
+    on_c, off_c = pair["ingest_obs_on"], pair["ingest_obs_off"]
+    noise = max(
+        (1.0 - min(c["qps"] for c in rr)
+         / max(max(c["qps"] for c in rr), 1e-9))
+        for rr in reps.values())
+    block = {
+        "threads": rthreads, "mode": "bulk",
+        "protocol": "warmup + alternating off/on/on/off bulk reps into "
+                    "a recreated index; paired best-of-reps docs/s "
+                    "ratio, noise-floor threshold; digests normalize "
+                    "took + _seq_no (seq order is submit-thread "
+                    "interleaving)",
+        "docs": ing_docs,
+        "ingest_obs_on_docs_per_s": on_c["qps"],
+        "ingest_obs_off_docs_per_s": off_c["qps"],
+        "ingest_obs_on_reps": [c["qps"]
+                               for c in reps["ingest_obs_on"]],
+        "ingest_obs_off_reps": [c["qps"]
+                                for c in reps["ingest_obs_off"]],
+        "identical_responses": bad == 0,
+        "noise_floor": round(noise, 4),
+        "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
+        "gate_threshold": round(min(0.98, 1.0 - noise), 4),
+    }
+    return block, errors
+
+
+def _gate_ingest_pair(gp) -> None:
+    if gp["qps_ratio"] < gp["gate_threshold"]:
+        raise SystemExit(
+            f"ingest-obs overhead gate failed: obs-on bulk docs/s is "
+            f"{gp['qps_ratio']}x obs-off (< {gp['gate_threshold']}x; "
+            f"noise floor {gp['noise_floor']}) at {gp['threads']} "
+            f"threads")
+    if not gp["identical_responses"]:
+        raise SystemExit(
+            "bulk responses diverged between ingest-obs on and off "
+            "cells — instrumentation changed write-path behavior")
+
+
+def _merge_bench_out(update_concurrency: dict) -> dict:
+    """Merge pair blocks into BENCH_out.json's extra.concurrency
+    without clobbering a fuller emission."""
+    out_path = os.path.join(_REPO, "BENCH_out.json")
+    try:
+        with open(out_path) as f:
+            bench_doc = json.load(f)
+    except (OSError, ValueError):
+        bench_doc = {"metric": "bm25_rest_qps_per_chip", "value": None,
+                     "unit": "queries/sec", "vs_baseline": None,
+                     "extra": {"status": "concurrency_only"}}
+    conc = bench_doc.setdefault("extra", {}).setdefault(
+        "concurrency", {})
+    conc.update(update_concurrency)
+    with open(out_path, "w") as f:
+        json.dump(bench_doc, f, indent=2)
+    return bench_doc
+
+
 def main():
     ndocs = int(sys.argv[1]) if len(sys.argv) > 1 else 60_000
     nq = int(os.environ.get("CONC_NQ", 256))
@@ -331,6 +510,22 @@ def main():
     depths = [int(d) for d in
               os.environ.get("CONC_DEPTHS", "1,2").split(",")]
     gate = os.environ.get("CONC_ASSERT", "1") not in ("0", "")
+    if os.environ.get("CONC_ONLY") == "ingest":
+        # write-path-only re-measure: no search corpus, just the pair
+        from opensearch_tpu.cluster.node import Node
+        from opensearch_tpu.parallel import MeshSearchService
+        from opensearch_tpu.rest.client import RestClient
+        client = RestClient(node=Node(mesh_service=MeshSearchService()))
+        rthreads = int(os.environ.get("CONC_INGEST_THREADS", "32"))
+        block, errs = ingest_obs_pair(client, rthreads)
+        _merge_bench_out({"ingest_obs_overhead_32t": block})
+        print(json.dumps({"ingest_obs_overhead_32t": block}), flush=True)
+        if gate:
+            if errs:
+                raise SystemExit(f"{errs} bulk request(s) errored")
+            _gate_ingest_pair(block)
+        print("OK", flush=True)
+        return
     t0 = time.time()
     client, queries, vocab_strs = build_client(ndocs)
     bodies = make_bodies(queries, vocab_strs, nq)
@@ -526,6 +721,11 @@ def main():
     lw_pair = {lab: max(reps, key=lambda c: c["qps"])
                for lab, reps in lw_reps.items()}
 
+    # ingest-obs overhead pair (ISSUE 18): write-path telemetry must
+    # ride bulk indexing for ~free — same protocol, bulk workload
+    ing_block, ing_err = ingest_obs_pair(client, rthreads)
+    errored += ing_err
+
     summary = {"ndocs": ndocs, "nq": nq,
                "devices": len(jax.devices()),
                "mix": "60% match2 / 40% filtered bool",
@@ -640,6 +840,8 @@ def main():
             "qps_ratio": round(on_c["qps"] / max(off_c["qps"], 1e-9), 4),
             "gate_threshold": round(min(0.98, 1.0 - wnoise), 4),
         }
+    if ing_block:
+        summary["ingest_obs_overhead_32t"] = ing_block
     if rec_pair:
         on_c, off_c = rec_pair["rec_on"], rec_pair["rec_off"]
         # the gate cannot resolve an effect smaller than the box's own
@@ -777,6 +979,9 @@ def main():
             raise SystemExit(
                 f"witnessed acquisition order contradicts the committed "
                 f"lock_order.json in {wp['order_conflicts']} edge(s)")
+        gp = summary.get("ingest_obs_overhead_32t")
+        if gp:
+            _gate_ingest_pair(gp)
     print("OK", flush=True)
 
 

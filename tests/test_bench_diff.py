@@ -163,6 +163,65 @@ class TestTrafficShape:
         assert bench_diff.main([str(a), str(b), "--gate"]) == 1
 
 
+def _ingest_doc(docs_per_s, rtv_p50, rtv_p95, q_p99_idle, q_p99_busy):
+    return {"metric": "ingest_docs_per_s", "value": docs_per_s,
+            "unit": "docs/sec",
+            "extra": {"ingest": {
+                "docs_per_s": docs_per_s,
+                "refresh_to_visible": {"count": 500, "p50_ms": rtv_p50,
+                                       "p95_ms": rtv_p95},
+                "query_p99_ms_baseline": q_p99_idle,
+                "query_p99_ms_while_indexing": q_p99_busy,
+                "query_p99_degradation_ratio":
+                    round(q_p99_busy / q_p99_idle, 4)}}}
+
+
+class TestIngestShape:
+    """The ingest bench emission (scripts/measure_ingest.py): docs/s,
+    refresh-to-visible percentiles, and query-p99-while-indexing are
+    direction-aware gated metrics (ISSUE 18 satellite)."""
+
+    def test_extraction(self):
+        m = bench_diff.metrics_of(
+            _ingest_doc(5000.0, 40.0, 120.0, 20.0, 30.0))
+        assert m["ingest.docs_per_s"] == 5000.0
+        assert m["ingest.refresh_to_visible.p50_ms"] == 40.0
+        assert m["ingest.refresh_to_visible.p95_ms"] == 120.0
+        assert m["ingest.query_p99_ms_while_indexing"] == 30.0
+        assert m["ingest.query_p99_degradation_ratio"] == 1.5
+
+    def test_directions(self):
+        assert bench_diff.direction("ingest.docs_per_s") == "up"
+        assert bench_diff.direction(
+            "ingest.refresh_to_visible.p95_ms") == "down"
+        assert bench_diff.direction(
+            "ingest.query_p99_ms_while_indexing") == "down"
+        assert bench_diff.direction(
+            "ingest.query_p99_degradation_ratio") == "down"
+        assert bench_diff.direction(
+            "concurrency.ingest_obs_overhead_32t.qps_ratio") == "up"
+
+    def test_throughput_drop_and_lag_spike_gate(self, tmp_path):
+        a, b = tmp_path / "a.json", tmp_path / "b.json"
+        a.write_text(json.dumps(
+            _ingest_doc(5000.0, 40.0, 120.0, 20.0, 30.0)))
+        # docs/s halves AND refresh lag triples: both must gate
+        b.write_text(json.dumps(
+            _ingest_doc(2500.0, 40.0, 360.0, 20.0, 30.0)))
+        rep = bench_diff.diff_files(str(a), str(b), 0.10)
+        bad = {r["metric"] for r in rep["regressions"]}
+        assert "ingest.docs_per_s" in bad
+        assert "ingest.refresh_to_visible.p95_ms" in bad
+        assert bench_diff.main([str(a), str(b), "--gate"]) == 1
+
+    def test_obs_overhead_pair_extracted(self):
+        doc = {"metric": "x", "value": 1.0, "extra": {"concurrency": {
+            "ingest_obs_overhead_32t": {"qps_ratio": 0.995}}}}
+        m = bench_diff.metrics_of(doc)
+        assert m["concurrency.ingest_obs_overhead_32t.qps_ratio"] \
+            == 0.995
+
+
 class TestCommittedLadder:
     def test_every_committed_round_loads(self):
         import glob

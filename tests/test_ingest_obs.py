@@ -1,0 +1,288 @@
+"""Ingest observatory (ISSUE 18): refresh-to-visible honesty against a
+wall-clock oracle, the exact refresh stage partition, fleet federation
+of the `indexing` block against a union oracle (merged sketches, summed
+counters), the `refresh_stall` flight-recorder trigger, and the ingest
+SLOs firing under a throttled refresh."""
+
+import time
+
+import numpy as np
+import pytest
+
+from opensearch_tpu.index.engine import Engine
+from opensearch_tpu.index.mappings import Mappings
+from opensearch_tpu.obs import ingest_obs as _iobs
+from opensearch_tpu.obs.flight_recorder import RECORDER
+from opensearch_tpu.obs.slo import SLOEngine, ingest_slos
+from opensearch_tpu.obs.timeseries import TimeSeriesSampler
+from opensearch_tpu.utils.metrics import (METRICS, MetricsRegistry,
+                                          sketch_snapshot)
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "price": {"type": "integer"}}}
+
+
+def _mk_engine(index_name="rtvidx"):
+    eng = Engine(Mappings(MAPPING))
+    eng.index_name = index_name
+    return eng
+
+
+def _fill(eng, n, tag=""):
+    for i in range(n):
+        eng.index_doc(f"d{tag}{i}", {"body": f"w{i % 7} common{tag}",
+                                     "price": i})
+
+
+@pytest.fixture()
+def clean_obs():
+    """Pin the observatory ON over a reset global registry; restore the
+    prior enable state and re-reset on the way out so neighbours never
+    see this module's counters."""
+    METRICS.reset()
+    _iobs.reset_buffer_totals()
+    prev = _iobs.set_enabled(True)
+    yield
+    METRICS.reset()
+    _iobs.reset_buffer_totals()
+    _iobs.set_enabled(prev)
+
+
+# ----------------------------------------------------------------------
+# refresh-to-visible
+# ----------------------------------------------------------------------
+
+class TestRefreshToVisible:
+    def test_delta_matches_wall_clock_oracle(self, clean_obs):
+        """Every published doc lands one accept→searchable delta, and the
+        deltas bound the wall time the docs actually sat buffered."""
+        eng = _mk_engine()
+        _fill(eng, 20)
+        time.sleep(0.05)
+        t_before = time.monotonic()
+        eng.refresh()
+        ceiling_ms = (time.monotonic() - t_before) * 1000.0 + 50.0 + 100.0
+        h = METRICS.histogram("indexing.refresh_to_visible_ms")
+        assert h.count == 20
+        # every doc waited at least the sleep (sketch error ~0.5%)
+        assert h.percentile(50) >= 45.0
+        assert h.sum_ms / h.count >= 45.0
+        # ... and no delta can exceed accept→publish wall time
+        assert h.percentile(99) <= ceiling_ms
+
+    def test_per_index_sketch_and_counter(self, clean_obs):
+        eng = _mk_engine("per_idx")
+        _fill(eng, 8)
+        eng.refresh()
+        assert METRICS.histogram(
+            "indexing.index.per_idx.refresh_to_visible_ms").count == 8
+        assert METRICS.counter("indexing.docs.indexed").value == 8
+        assert METRICS.counter("indexing.refresh.total").value == 1
+
+    def test_overwritten_doc_records_one_delta(self, clean_obs):
+        """A doc overwritten before the refresh publishes is visible
+        once — the tombstoned buffer slot must not inflate the sketch."""
+        eng = _mk_engine()
+        eng.index_doc("same", {"body": "v1", "price": 1})
+        eng.index_doc("same", {"body": "v2", "price": 2})
+        eng.refresh()
+        assert METRICS.histogram(
+            "indexing.refresh_to_visible_ms").count == 1
+
+    def test_buffer_gauges_fill_and_drain(self, clean_obs):
+        eng = _mk_engine()
+        _fill(eng, 3 * _iobs.FLUSH_EVERY)
+        g = METRICS.gauge("indexing.buffer.docs")
+        assert g.value == 3 * _iobs.FLUSH_EVERY
+        assert METRICS.gauge("indexing.buffer.bytes").value > 0
+        eng.refresh()
+        assert METRICS.gauge("indexing.buffer.docs").value == 0
+        assert METRICS.gauge("indexing.buffer.bytes").value == 0
+        # the amortized fold never loses the sub-FLUSH_EVERY tail
+        assert METRICS.counter("indexing.docs.indexed").value \
+            == 3 * _iobs.FLUSH_EVERY
+
+
+# ----------------------------------------------------------------------
+# stage partition
+# ----------------------------------------------------------------------
+
+class TestStagePartition:
+    STAGES = ("collect", "build", "publish", "merge")
+
+    def test_stages_sum_to_total(self, clean_obs):
+        """The boundary stamps t0..t4 partition the refresh wall time
+        EXACTLY: collect+build+publish+merge == total by construction."""
+        eng = _mk_engine()
+        _fill(eng, 60)
+        eng.refresh()
+        total = METRICS.histogram("indexing.refresh.time_ms")
+        assert total.count == 1
+        parts = [METRICS.histogram(f"indexing.refresh.stage.{s}_ms")
+                 for s in self.STAGES]
+        assert all(p.count == 1 for p in parts)
+        assert sum(p.sum_ms for p in parts) \
+            == pytest.approx(total.sum_ms, rel=1e-6)
+
+    def test_build_attribution_stages_are_known(self, clean_obs):
+        """Whatever the builder attributed is drawn from the declared
+        stage vocabulary (pack/spill/chunk_merge/quantize/
+        device_promote) and every attribution fits inside the build
+        stage it partitions."""
+        eng = _mk_engine()
+        _fill(eng, 60)
+        eng.refresh()
+        known = {"pack", "spill", "chunk_merge", "quantize",
+                 "device_promote"}
+        seen = {}
+        for name, h in METRICS.snapshot()["histograms"].items():
+            if name.startswith("indexing.refresh.build."):
+                seen[name[len("indexing.refresh.build."):-3]] = h
+        assert seen, "the builder attributed at least one stage"
+        assert set(seen) <= known
+        build = METRICS.histogram("indexing.refresh.stage.build_ms")
+        assert sum(h["sum_ms"] for h in seen.values()) \
+            <= build.sum_ms + 1e-6
+
+
+# ----------------------------------------------------------------------
+# federation
+# ----------------------------------------------------------------------
+
+class TestFederation:
+    def test_two_node_block_matches_union_oracle(self):
+        """`indexing_stats()` over two members with disjoint registries
+        equals one node fed the union: counters/gauges sum, and the
+        refresh-to-visible percentiles come from ONE merged sketch —
+        never from averaging the per-node percentiles."""
+        from opensearch_tpu.cluster.distnode import DistClusterNode
+        a = DistClusterNode("ia")
+        b = DistClusterNode("ib", seed=a.addr)
+        try:
+            rng = np.random.default_rng(7)
+            sa = rng.lognormal(2.0, 1.0, 400)    # fast node
+            sb = rng.lognormal(5.5, 0.4, 60)     # slow node
+            ra, rb = MetricsRegistry(), MetricsRegistry()
+            oracle = MetricsRegistry()
+            for reg, stream, docs, buf in ((ra, sa, 400, 5),
+                                           (rb, sb, 60, 7)):
+                for v in stream:
+                    reg.histogram(
+                        "indexing.refresh_to_visible_ms").record(float(v))
+                    oracle.histogram(
+                        "indexing.refresh_to_visible_ms").record(float(v))
+                reg.counter("indexing.docs.indexed").inc(docs)
+                oracle.counter("indexing.docs.indexed").inc(docs)
+                reg.gauge("indexing.buffer.docs").set(buf)
+            oracle.gauge("indexing.buffer.docs").set(5 + 7)
+            a.obs_registry, b.obs_registry = ra, rb
+
+            out = a.indexing_stats()
+            assert out["_nodes"] == {"total": 2, "successful": 2,
+                                     "failed": 0}
+            blk = out["indexing"]
+            want = _iobs.assemble_block(_iobs.local_parts(oracle),
+                                        nodes=2)
+            assert blk["indexing"]["index_total"] == 460
+            assert blk == want
+            # the averaged-percentiles anti-oracle must NOT match: the
+            # union median sits in the fast node's stream, while a mean
+            # of per-node medians is dragged way up by the slow node
+            p50_avg = np.mean([sketch_snapshot(
+                r.histogram("indexing.refresh_to_visible_ms").to_wire()
+            )["p50_ms"] for r in (ra, rb)])
+            p50_merged = blk["refresh"]["refresh_to_visible_ms"]["p50_ms"]
+            assert abs(p50_merged - p50_avg) / p50_avg > 0.5
+
+            # any member coordinates to the same block
+            outb = b.indexing_stats()
+            assert outb["indexing"] == blk
+            assert outb["coordinator"] == "ib"
+        finally:
+            a.stop()
+            b.stop()
+
+
+# ----------------------------------------------------------------------
+# refresh_stall
+# ----------------------------------------------------------------------
+
+class TestRefreshStall:
+    def test_stall_freezes_dump_with_stage_partition(self, clean_obs,
+                                                     monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_REFRESH_STALL_MS", "0")
+        RECORDER.reset()
+        eng = _mk_engine("stalled")
+        _fill(eng, 10)
+        eng.refresh()
+        assert METRICS.counter("indexing.refresh.stalls").value == 1
+        dumps = RECORDER.dumps()
+        assert len(dumps) == 1
+        d = dumps[0]
+        assert d["reason"] == "refresh_stall"
+        assert "stalled" in (d.get("note") or "")
+        evs = [ev for tl in d["timelines"].values()
+               for ev in tl["events"] if ev["kind"] == "refresh.stall"]
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["total_ms"] > 0
+        assert ev["stall_threshold_ms"] == 0.0
+        for s in TestStagePartition.STAGES:
+            assert f"{s}_ms" in ev
+
+    def test_stall_trigger_is_cooldown_limited(self, clean_obs,
+                                               monkeypatch):
+        monkeypatch.setenv("OPENSEARCH_TPU_REFRESH_STALL_MS", "0")
+        RECORDER.reset()
+        eng = _mk_engine("stormy")
+        for r in range(3):
+            _fill(eng, 5, tag=f"r{r}_")
+            eng.refresh()
+        # every stall is counted, but the storm freezes ONE dump
+        assert METRICS.counter("indexing.refresh.stalls").value == 3
+        assert len(RECORDER.dumps()) == 1
+        assert RECORDER.stats()["suppressed_triggers"] >= 2
+
+
+# ----------------------------------------------------------------------
+# ingest SLOs
+# ----------------------------------------------------------------------
+
+class TestIngestSLOs:
+    def test_shapes(self):
+        slos = {s.name: s for s in ingest_slos(refresh_budget_ms=250.0,
+                                               backlog_budget_segments=4)}
+        lag = slos["ingest-refresh-lag"]
+        assert lag.kind == "latency"
+        assert lag.latency_hist == "indexing.refresh_to_visible_ms"
+        assert lag.latency_budget_ms == 250.0
+        assert lag.describe()["histogram"] \
+            == "indexing.refresh_to_visible_ms"
+        backlog = slos["ingest-merge-backlog"]
+        assert backlog.latency_hist == "indexing.merge.backlog_depth"
+
+    def test_refresh_lag_fires_under_throttled_refresh(self, clean_obs):
+        """End to end: a refresh held past the lag budget burns the
+        error budget in both windows and flips the SLO to firing."""
+        sampler = TimeSeriesSampler(registry=METRICS, interval_s=0.01,
+                                    capacity=128)
+        engine = SLOEngine(sampler=sampler, registry=METRICS)
+        engine.arm(ingest_slos(refresh_budget_ms=10.0,
+                               fast_window_s=60.0, slow_window_s=120.0))
+        try:
+            sampler.sample_once()                   # baseline tick
+            eng = _mk_engine("lagging")
+            _fill(eng, 30)
+            time.sleep(0.05)                        # throttled refresh:
+            eng.refresh()                           # 30 docs > 10ms lag
+            sampler.sample_once()                   # evaluation tick
+            st = engine.status()["status"]["ingest-refresh-lag"]
+            assert st["state"] == "firing"
+            assert st["fast"]["bad"] == 30
+            assert METRICS.gauge(
+                "slo.ingest-refresh-lag.firing").value == 1.0
+            # the healthy objective stays quiet
+            assert engine.status()["status"]["ingest-merge-backlog"][
+                "state"] == "ok"
+        finally:
+            engine.disarm()

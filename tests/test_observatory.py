@@ -138,6 +138,16 @@ class TestPrometheusExposition:
         h = reg.histogram("search.lane.interactive.latency")
         for v in (1.0, 2.5, 10.0, 100.0, 250.0):
             h.record(v)
+        # ingest observatory series (ostpu_indexing_*): one of each
+        # shape the write path emits — counter, extensive gauge, and the
+        # refresh-to-visible sketch exported as a summary
+        reg.counter("indexing.bulk.items").inc(120)
+        reg.counter("indexing.refresh.total").inc(4)
+        reg.gauge("indexing.buffer.bytes").set(16384.0)
+        reg.gauge("indexing.merge.backlog").set(2.0)
+        rtv = reg.histogram("indexing.refresh_to_visible_ms")
+        for v in (12.0, 40.0, 95.0, 300.0):
+            rtv.record(v)
         return reg
 
     def _golden_insights(self):
@@ -163,7 +173,7 @@ class TestPrometheusExposition:
                  if ln.startswith("# HELP")}
         types = {ln.split()[2] for ln in lines
                  if ln.startswith("# TYPE")}
-        assert helps == types and len(helps) == 8
+        assert helps == types and len(helps) == 13
         # every sample line's metric (modulo _sum/_count suffix) has a
         # TYPE header
         for ln in lines:

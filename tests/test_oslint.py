@@ -1308,6 +1308,121 @@ class TestFusionDomain:
 
 
 # ----------------------------------------------------------------------
+# OSL605 write-path emission discipline (ingest observatory)
+# ----------------------------------------------------------------------
+
+class TestIngestObsDiscipline:
+    """OSL605 — index/ + ingest/ hot loops: monotonic durations, no
+    per-iteration registry emission, guarded recorder events
+    (docs/OBSERVABILITY.md "Ingest observatory")."""
+
+    def test_osl605_walltime_in_loop(self):
+        src = """
+            import time
+            def refresh(self):
+                for doc in self.buffer:
+                    doc["ts"] = time.time()
+        """
+        found = lint(src, "opensearch_tpu/index/engine.py")
+        assert [f for f in found if f.detail == "walltime-in-loop"]
+
+    def test_osl605_walltime_duration_subtraction(self):
+        src = """
+            import time
+            def flush(self):
+                t0 = self.start
+                return time.time() - t0
+        """
+        found = lint(src, "opensearch_tpu/index/engine.py")
+        assert [f for f in found if f.detail == "walltime-duration"]
+
+    def test_osl605_metric_emission_in_loop(self):
+        src = """
+            from ..utils.metrics import METRICS
+            def refresh(self):
+                for doc in self.buffer:
+                    METRICS.counter("indexing.docs.indexed").inc()
+        """
+        found = lint(src, "opensearch_tpu/index/engine.py")
+        # chained lookup+inc reports ONCE, at the emission site
+        hits = [f for f in found if f.detail == "metric-in-loop"]
+        assert len(hits) == 1
+
+    def test_osl605_bare_lookup_in_loop(self):
+        # re-fetching the handle each iteration is the hoistable half
+        src = """
+            from ..utils.metrics import METRICS
+            def refresh(self):
+                for doc in self.buffer:
+                    h = METRICS.histogram("indexing.refresh.time_ms")
+                h.record(1.0)
+        """
+        found = lint(src, "opensearch_tpu/ingest/pipeline.py")
+        assert [f for f in found if f.detail == "metric-in-loop"]
+
+    def test_osl605_sanctioned_count_quiet(self):
+        # _iobs.count checks the enabled flag before the registry —
+        # the one sanctioned in-loop form
+        src = """
+            from ..obs import ingest_obs as _iobs
+            def run(self, doc):
+                for proc in self.processors:
+                    _iobs.count("indexing.pipeline.failed")
+        """
+        assert rules_of(lint(src, "opensearch_tpu/ingest/pipeline.py")) \
+            == []
+
+    def test_osl605_unguarded_record(self):
+        src = """
+            def refresh(self):
+                tl = RECORDER.start("refresh")
+                RECORDER.record(tl, "refresh.stall", total_ms=9.0)
+        """
+        found = lint(src, "opensearch_tpu/index/engine.py")
+        assert [f for f in found if f.detail == "unguarded-record"]
+
+    def test_osl605_guarded_emission_quiet(self):
+        # hoisted handle + monotonic duration + guarded event: the
+        # shape engine.refresh actually has
+        src = """
+            import time
+            from ..utils.metrics import METRICS
+            def refresh(self):
+                t0 = time.perf_counter()
+                n = 0
+                for doc in self.buffer:
+                    n += 1
+                METRICS.histogram("indexing.refresh.time_ms").record(
+                    (time.perf_counter() - t0) * 1000.0)
+                meta = {"ts": time.time()}
+                tl = RECORDER.start("refresh")
+                if tl:
+                    RECORDER.record(tl, "refresh.done", n=n)
+                return meta
+        """
+        assert rules_of(lint(src, "opensearch_tpu/index/engine.py")) \
+            == []
+
+    def test_osl605_out_of_scope_quiet(self):
+        # the emission helpers themselves loop over metric names —
+        # obs/ is exempt, exactly like OSL505
+        src = """
+            from .metrics import METRICS
+            def record_refresh(stages):
+                for name, v in stages.items():
+                    METRICS.histogram(name).record(v)
+        """
+        found = lint(src, "opensearch_tpu/obs/ingest_obs.py")
+        assert [f for f in found if f.rule == "OSL605"] == []
+
+    def test_osl605_repo_clean(self):
+        # the ratchet at zero: write-path instrumentation takes stamps
+        # in index//ingest/ and emits through obs/ingest_obs helpers
+        findings = run_paths(["opensearch_tpu"], REPO_ROOT)
+        assert [f.render() for f in findings if f.rule == "OSL605"] == []
+
+
+# ----------------------------------------------------------------------
 # suppression + baseline mechanics
 # ----------------------------------------------------------------------
 
